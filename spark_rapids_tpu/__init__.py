@@ -1,0 +1,17 @@
+"""spark_rapids_tpu — TPU-native columnar SQL acceleration framework.
+
+A from-scratch re-design of the RAPIDS Accelerator for Apache Spark
+(reference: mythrocks/spark-rapids, mounted at /root/reference) targeting TPUs:
+JAX/XLA/Pallas as the compute substrate, Arrow as the host columnar format,
+jax.sharding meshes + XLA collectives as the distributed backbone.
+"""
+
+__version__ = "25.08.0"
+
+# Spark semantics require 64-bit longs/doubles; JAX defaults to 32-bit.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .session import Column, DataFrame, TpuSession, get_session  # noqa: F401
+from .config import RapidsConf, default_conf  # noqa: F401

@@ -1,0 +1,2 @@
+from .vector import TpuColumnVector, TpuScalar, bucket_capacity, row_mask  # noqa: F401
+from .batch import TpuColumnarBatch, compact, concat_batches, gather, slice_batch  # noqa: F401

@@ -1,0 +1,227 @@
+"""Device columnar batches + host↔device conversion.
+
+TPU analogue of Spark's `ColumnarBatch` of `GpuColumnVector`s and the reference's
+row↔columnar transitions (/root/reference/sql-plugin/.../GpuColumnarToRowExec.scala,
+GpuRowToColumnarExec.scala, HostColumnarToGpu.scala). The host substrate is Arrow
+(pyarrow.RecordBatch/Table) rather than Spark InternalRow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import DataType, StructField, StructType, from_arrow as arrow_to_type
+from .vector import TpuColumnVector, bucket_capacity, row_mask
+
+
+@dataclass
+class TpuColumnarBatch:
+    """A batch of device columns sharing num_rows/capacity."""
+
+    columns: List[TpuColumnVector]
+    num_rows: int
+    names: Optional[List[str]] = None
+
+    def __post_init__(self) -> None:
+        for c in self.columns:
+            assert c.num_rows == self.num_rows, "column row counts must agree"
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else bucket_capacity(self.num_rows)
+
+    def schema(self) -> StructType:
+        names = self.names or [f"c{i}" for i in range(self.num_columns)]
+        return StructType([StructField(n, c.dtype) for n, c in zip(names, self.columns)])
+
+    def column(self, i: int) -> TpuColumnVector:
+        return self.columns[i]
+
+    def device_memory_size(self) -> int:
+        return sum(c.device_memory_size() for c in self.columns)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        names = self.names or [f"c{i}" for i in range(self.num_columns)]
+        arrays = [c.to_arrow() for c in self.columns]
+        return pa.table(dict(zip(names, arrays))) if arrays else pa.table({})
+
+    def to_pylist(self) -> List[dict]:
+        return self.to_arrow().to_pylist()
+
+    @staticmethod
+    def from_arrow(table, bucket: bool = True) -> "TpuColumnarBatch":
+        """Arrow table/record-batch → device batch (H→D; reference HostColumnarToGpu)."""
+        import pyarrow as pa
+        if isinstance(table, pa.RecordBatch):
+            table = pa.table(table)
+        table = table.combine_chunks()
+        cols = [TpuColumnVector.from_arrow(table.column(i), bucket=bucket)
+                for i in range(table.num_columns)]
+        # all columns in one batch must share a row capacity
+        if cols:
+            cap = max(c.capacity for c in cols)
+            cols = [_repad(c, cap) for c in cols]
+        return TpuColumnarBatch(cols, table.num_rows, list(table.column_names))
+
+    @staticmethod
+    def from_pydict(data: Dict[str, Sequence], types: Optional[Dict[str, DataType]] = None,
+                    bucket: bool = True) -> "TpuColumnarBatch":
+        import pyarrow as pa
+        from ..types import to_arrow as type_to_arrow
+        arrays = {}
+        for name, vals in data.items():
+            at = type_to_arrow(types[name]) if types and name in types else None
+            arrays[name] = pa.array(vals, type=at)
+        return TpuColumnarBatch.from_arrow(pa.table(arrays), bucket=bucket)
+
+    def select(self, indices: Sequence[int]) -> "TpuColumnarBatch":
+        names = self.names
+        return TpuColumnarBatch([self.columns[i] for i in indices], self.num_rows,
+                                [names[i] for i in indices] if names else None)
+
+    def rename(self, names: List[str]) -> "TpuColumnarBatch":
+        return TpuColumnarBatch(self.columns, self.num_rows, list(names))
+
+
+def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
+    if col.capacity == capacity:
+        return col
+    if col.capacity > capacity:
+        raise ValueError("cannot shrink capacity")
+    pad = capacity - col.capacity
+    if col.offsets is not None:
+        last = col.offsets[-1]
+        offsets = jnp.concatenate([col.offsets, jnp.full((pad,), last, jnp.int32)])
+        data = col.data
+    else:
+        offsets = None
+        data = jnp.concatenate([col.data, jnp.zeros((pad,), col.data.dtype)])
+    validity = col.validity
+    if validity is not None:
+        validity = jnp.concatenate([validity, jnp.zeros((pad,), jnp.bool_)])
+    return TpuColumnVector(col.dtype, data, validity, col.num_rows, offsets=offsets)
+
+
+def gather(batch: TpuColumnarBatch, indices, out_rows: int,
+           out_capacity: Optional[int] = None) -> TpuColumnarBatch:
+    """Row gather across all columns (reference: cudf Table.gather / GatherMap).
+
+    `indices` is a device int32 array of length >= out_capacity; entries beyond
+    out_rows are ignored (padding). Out-of-range entries yield null rows, matching
+    cuDF OutOfBoundsPolicy.NULLIFY.
+    """
+    cap = out_capacity if out_capacity is not None else bucket_capacity(out_rows)
+    idx = jnp.asarray(indices)[:cap].astype(jnp.int32)
+    valid_idx = (idx >= 0) & (idx < batch.num_rows)
+    safe = jnp.where(valid_idx, idx, 0)
+    pad_mask = row_mask(out_rows, cap)
+    out_cols = []
+    for col in batch.columns:
+        out_cols.append(_gather_column(col, safe, valid_idx & pad_mask, out_rows, cap))
+    return TpuColumnarBatch(out_cols, out_rows, batch.names)
+
+
+def _gather_column(col: TpuColumnVector, safe_idx, valid, out_rows: int,
+                   cap: int) -> TpuColumnVector:
+    if col.offsets is not None:
+        return _gather_strings(col, safe_idx, valid, out_rows, cap)
+    data = jnp.take(col.data, safe_idx, axis=0)
+    if col.validity is not None:
+        v = jnp.take(col.validity, safe_idx, axis=0) & valid
+    else:
+        v = valid
+    data = jnp.where(v, data, jnp.zeros((), data.dtype))
+    return TpuColumnVector(col.dtype, data, v, out_rows)
+
+
+def _gather_strings(col: TpuColumnVector, safe_idx, valid, out_rows: int,
+                    cap: int) -> TpuColumnVector:
+    """String gather: host-assisted for now. Device offsets/lengths are computed in
+    XLA; byte movement runs on host until the Pallas ragged-gather kernel lands
+    (tracked kernels/strings.py). The reference does this fully in cuDF."""
+    starts = jnp.take(col.offsets[:-1], safe_idx)
+    ends = jnp.take(col.offsets[1:], safe_idx)
+    lens = jnp.where(valid, ends - starts, 0)
+    new_offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(lens).astype(jnp.int32)])
+    # host byte shuffle
+    h_starts = np.asarray(starts)
+    h_lens = np.asarray(lens)
+    h_chars = np.asarray(col.data)
+    total = int(np.asarray(new_offsets)[-1])
+    out = np.zeros(bucket_capacity(max(total, 1)), dtype=np.uint8)
+    pos = 0
+    for i in range(out_rows):
+        l = int(h_lens[i])
+        if l:
+            s = int(h_starts[i])
+            out[pos:pos + l] = h_chars[s:s + l]
+            pos += l
+    v = valid
+    if col.validity is not None:
+        v = jnp.take(col.validity, safe_idx) & valid
+    return TpuColumnVector(col.dtype, jnp.asarray(out), v, out_rows,
+                           offsets=new_offsets)
+
+
+def compact(batch: TpuColumnarBatch, keep_mask) -> TpuColumnarBatch:
+    """Filter: keep rows where mask is True, preserving order
+    (reference GpuFilter: boolean mask + cudf apply_boolean_mask,
+    basicPhysicalOperators.scala:638). Uses a stable cumsum-scatter; the kept-row
+    count is synced to host (it becomes the new logical num_rows)."""
+    mask = jnp.asarray(keep_mask)
+    cap = batch.capacity
+    mask = mask & row_mask(batch.num_rows, cap)
+    positions = jnp.cumsum(mask) - 1  # output slot per kept row
+    n_keep = int(jnp.sum(mask))  # D→H sync: one scalar per batch
+    # build gather indices: for each output slot, index of the kept input row
+    idx = jnp.full((cap,), cap, dtype=jnp.int32)
+    idx = idx.at[jnp.where(mask, positions, cap)].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    return gather(batch, idx, n_keep, out_capacity=cap)
+
+
+def slice_batch(batch: TpuColumnarBatch, start: int, length: int) -> TpuColumnarBatch:
+    length = max(0, min(length, batch.num_rows - start))
+    idx = jnp.arange(batch.capacity, dtype=jnp.int32) + start
+    return gather(batch, idx, length, out_capacity=batch.capacity)
+
+
+def concat_batches(batches: List[TpuColumnarBatch]) -> TpuColumnarBatch:
+    """Concatenate batches (reference: cudf Table.concatenate, used by coalesce).
+    Routed through Arrow host concat for ragged columns; fixed-width stays on device."""
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.num_rows for b in batches)
+    names = batches[0].names
+    out_cols: List[TpuColumnVector] = []
+    for ci in range(batches[0].num_columns):
+        cols = [b.columns[ci] for b in batches]
+        if cols[0].offsets is not None:
+            import pyarrow as pa
+            merged = pa.concat_arrays([c.to_arrow() for c in cols])
+            out_cols.append(TpuColumnVector.from_arrow(merged))
+        else:
+            cap = bucket_capacity(total)
+            data = jnp.zeros((cap,), cols[0].data.dtype)
+            validity = jnp.zeros((cap,), jnp.bool_)
+            pos = 0
+            for c in cols:
+                n = c.num_rows
+                data = data.at[pos:pos + n].set(c.data[:n])
+                validity = validity.at[pos:pos + n].set(
+                    c.validity[:n] if c.validity is not None else jnp.ones((n,), jnp.bool_))
+                pos += n
+            validity = validity & row_mask(total, cap)
+            out_cols.append(TpuColumnVector(cols[0].dtype, data, validity, total))
+    return TpuColumnarBatch(out_cols, total, names)

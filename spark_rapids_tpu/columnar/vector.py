@@ -1,0 +1,255 @@
+"""Device-resident column vectors backed by jax.Array.
+
+TPU analogue of the reference's `GpuColumnVector` (a Spark ColumnVector wrapping a
+cuDF device column, /root/reference/sql-plugin/src/main/java/com/nvidia/spark/rapids/
+GpuColumnVector.java:40). Differences driven by XLA's compilation model:
+
+  * Static shapes: every column has a *physical capacity* (bucketed to powers of two
+    when `spark.rapids.tpu.batch.bucketPadding.enabled`) and a *logical* `num_rows`
+    kept host-side. Rows in [num_rows, capacity) are padding and always invalid.
+    cuDF kernels take dynamic sizes; XLA would recompile per size, so we bucket.
+  * Validity is a dense bool array (Arrow uses bitmaps; a bool vector vectorizes
+    better through XLA and converts to/from Arrow bitmaps at the host boundary).
+  * Strings/binary are Arrow-style offset+data pairs (int32 offsets, uint8 bytes).
+  * No refcounting: jax.Arrays are immutable and GC'd; the spill framework tracks
+    byte accounting instead (see memory/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (BinaryType, BooleanType, DataType, DecimalType, NullType,
+                     StringType, is_fixed_width)
+
+
+def bucket_capacity(n: int, enabled: bool = True, minimum: int = 16) -> int:
+    """Round row counts up to power-of-two buckets to bound XLA recompilation."""
+    if not enabled:
+        return max(n, 1)
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _np_to_jax(arr: np.ndarray) -> jax.Array:
+    return jnp.asarray(arr)
+
+
+@dataclass
+class TpuColumnVector:
+    """One device column. `data` layout by type:
+       fixed-width: (capacity,) of the type's carrier dtype
+       string/binary: `data` is uint8 (char_capacity,), `offsets` int32 (capacity+1,)
+    Padding rows carry zeros and validity False."""
+
+    dtype: DataType
+    data: jax.Array
+    validity: Optional[jax.Array]  # bool (capacity,); None == all-valid
+    num_rows: int
+    offsets: Optional[jax.Array] = None  # strings/binary only
+
+    @property
+    def capacity(self) -> int:
+        if self.offsets is not None:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def validity_or_true(self) -> jax.Array:
+        if self.validity is not None:
+            return self.validity
+        return row_mask(self.num_rows, self.capacity)
+
+    def device_memory_size(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        if self.validity is not None:
+            n += self.validity.size
+        if self.offsets is not None:
+            n += self.offsets.size * 4
+        return int(n)
+
+    # ---- host materialization (the D→H boundary) ----
+    def to_numpy(self) -> np.ndarray:
+        """Logical values as a numpy array; nulls surfaced via to_arrow instead."""
+        return np.asarray(self.data[: self.num_rows])
+
+    def to_arrow(self):
+        import pyarrow as pa
+        from ..types import to_arrow as t2a
+        n = self.num_rows
+        if self.validity is not None:
+            valid = np.asarray(self.validity[:n])
+            mask = ~valid
+        else:
+            mask = None
+        if isinstance(self.dtype, (StringType, BinaryType)):
+            offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
+            chars = np.asarray(self.data[: int(offs[-1])]).tobytes() if n else b""
+            buf_offs = pa.py_buffer(offs.tobytes())
+            buf_data = pa.py_buffer(chars)
+            if mask is not None:
+                bitmap = pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+                nulls = int(mask.sum())
+            else:
+                bitmap, nulls = None, 0
+            atype = pa.string() if isinstance(self.dtype, StringType) else pa.binary()
+            return pa.Array.from_buffers(atype, n, [bitmap, buf_offs, buf_data], null_count=nulls)
+        vals = np.asarray(self.data[:n])
+        if isinstance(self.dtype, DecimalType):
+            # int64-scaled carrier -> arrow decimal128
+            import decimal as _d
+            scale = self.dtype.scale
+            py = [None if (mask is not None and mask[i]) else
+                  _d.Decimal(int(vals[i])).scaleb(-scale) for i in range(n)]
+            return pa.array(py, type=t2a(self.dtype))
+        arrow_type = t2a(self.dtype)
+        return pa.array(vals, type=arrow_type, mask=mask)
+
+    def to_pylist(self):
+        return self.to_arrow().to_pylist()
+
+    # ---- constructors ----
+    @staticmethod
+    def from_numpy(dtype: DataType, values: np.ndarray,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None,
+                   bucket: bool = True) -> "TpuColumnVector":
+        n = len(values)
+        cap = capacity if capacity is not None else bucket_capacity(n, bucket)
+        carrier = dtype.np_dtype
+        buf = np.zeros(cap, dtype=carrier)
+        buf[:n] = values.astype(carrier, copy=False)
+        vmask = None
+        if validity is not None and not validity.all():
+            v = np.zeros(cap, dtype=bool)
+            v[:n] = validity
+            vmask = _np_to_jax(v)
+        return TpuColumnVector(dtype, _np_to_jax(buf), vmask, n)
+
+    @staticmethod
+    def from_strings(dtype: DataType, offsets: np.ndarray, chars: np.ndarray,
+                     validity: Optional[np.ndarray] = None,
+                     capacity: Optional[int] = None,
+                     char_capacity: Optional[int] = None,
+                     bucket: bool = True) -> "TpuColumnVector":
+        n = len(offsets) - 1
+        cap = capacity if capacity is not None else bucket_capacity(n, bucket)
+        ccap = char_capacity if char_capacity is not None else bucket_capacity(
+            max(int(offsets[-1]), 1), bucket)
+        obuf = np.full(cap + 1, offsets[-1], dtype=np.int32)
+        obuf[: n + 1] = offsets
+        cbuf = np.zeros(ccap, dtype=np.uint8)
+        cbuf[: int(offsets[-1])] = chars[: int(offsets[-1])]
+        vmask = None
+        if validity is not None and not validity.all():
+            v = np.zeros(cap, dtype=bool)
+            v[:n] = validity
+            vmask = _np_to_jax(v)
+        return TpuColumnVector(dtype, _np_to_jax(cbuf), vmask, n, offsets=_np_to_jax(obuf))
+
+    @staticmethod
+    def from_arrow(arr, bucket: bool = True) -> "TpuColumnVector":
+        """Host Arrow array → device column (the H→D upload)."""
+        import pyarrow as pa
+        from ..types import from_arrow as a2t
+        dtype = a2t(arr.type)
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        n = len(arr)
+        if arr.null_count:
+            validity = np.asarray(arr.is_valid())
+        else:
+            validity = None
+        if isinstance(dtype, (StringType, BinaryType)):
+            if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+                arr = arr.cast(pa.string() if isinstance(dtype, StringType) else pa.binary())
+            bufs = arr.buffers()
+            off0 = arr.offset
+            offsets = np.frombuffer(bufs[1], dtype=np.int32,
+                                    count=n + 1, offset=off0 * 4).copy()
+            base = offsets[0]
+            offsets -= base
+            chars = np.frombuffer(bufs[2], dtype=np.uint8,
+                                  count=int(offsets[-1]), offset=int(base)).copy()
+            if validity is not None:
+                # zero out data regions of null rows? keep: gathers only read valid rows
+                pass
+            return TpuColumnVector.from_strings(dtype, offsets, chars,
+                                                validity, bucket=bucket)
+        if isinstance(dtype, NullType):
+            buf = np.zeros(n, dtype=bool)
+            return TpuColumnVector.from_numpy(dtype, buf, np.zeros(n, dtype=bool),
+                                              bucket=bucket)
+        if isinstance(dtype, DecimalType):
+            if dtype.precision > DecimalType.MAX_DEVICE_PRECISION:
+                raise TypeError("decimal128 columns stay host-side (CPU fallback)")
+            scaled = np.array(
+                [0 if v is None else int(v.scaleb(dtype.scale)) for v in arr.to_pylist()],
+                dtype=np.int64)
+            return TpuColumnVector.from_numpy(dtype, scaled, validity, bucket=bucket)
+        carrier = dtype.np_dtype
+        if pa.types.is_boolean(arr.type):
+            np_arr = np.asarray(arr.fill_null(False).to_numpy(zero_copy_only=False))
+        else:
+            # read the raw fixed-width values buffer: exact (to_numpy would route
+            # nullable ints through float64, corrupting large int64 values)
+            bufs = arr.buffers()
+            phys = np.dtype(arr.type.to_pandas_dtype()) if not pa.types.is_timestamp(arr.type) \
+                else np.dtype(np.int64)
+            if pa.types.is_date32(arr.type):
+                phys = np.dtype(np.int32)
+            np_arr = np.frombuffer(bufs[1], dtype=phys, count=n,
+                                   offset=arr.offset * phys.itemsize).copy()
+            if validity is not None:
+                np_arr[~validity] = 0
+            np_arr = np_arr.astype(carrier, copy=False)
+        return TpuColumnVector.from_numpy(dtype, np_arr, validity, bucket=bucket)
+
+    @staticmethod
+    def from_scalar(value: Any, dtype: DataType, num_rows: int,
+                    capacity: Optional[int] = None) -> "TpuColumnVector":
+        cap = capacity if capacity is not None else bucket_capacity(num_rows)
+        if isinstance(dtype, (StringType, BinaryType)):
+            if value is None:
+                offs = np.zeros(num_rows + 1, dtype=np.int32)
+                return TpuColumnVector.from_strings(
+                    dtype, offs, np.zeros(0, np.uint8),
+                    np.zeros(num_rows, dtype=bool), capacity=cap)
+            raw = value.encode() if isinstance(value, str) else bytes(value)
+            offs = (np.arange(num_rows + 1, dtype=np.int32) * len(raw))
+            chars = np.tile(np.frombuffer(raw, dtype=np.uint8), max(num_rows, 1))
+            return TpuColumnVector.from_strings(dtype, offs, chars, None, capacity=cap)
+        if value is None:
+            buf = np.zeros(num_rows, dtype=dtype.np_dtype or np.bool_)
+            return TpuColumnVector.from_numpy(dtype, buf,
+                                              np.zeros(num_rows, dtype=bool), capacity=cap)
+        if isinstance(dtype, DecimalType):
+            import decimal as _d
+            value = int(_d.Decimal(value).scaleb(dtype.scale))
+        buf = np.full(num_rows, value, dtype=dtype.np_dtype)
+        return TpuColumnVector.from_numpy(dtype, buf, None, capacity=cap)
+
+
+def row_mask(num_rows: int, capacity: int) -> jax.Array:
+    """Mask that is True for logical rows, False for padding."""
+    return jnp.arange(capacity) < num_rows
+
+
+@dataclass(frozen=True)
+class TpuScalar:
+    """Device scalar (reference: cudf Scalar). value is a python value; nulls allowed."""
+    dtype: DataType
+    value: Any  # None == null
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None

@@ -1,0 +1,394 @@
+"""Typed configuration registry for the TPU accelerator.
+
+TPU-native re-design of the reference's `RapidsConf` system
+(/root/reference/sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:126-235
+entry-builder DSL; 236 `spark.rapids.*` entries). We keep the same design: typed entries
+declared once with docs/defaults, a session-level immutable snapshot re-read per query,
+`internal`/`startup_only`/`commonly_used` attributes, and markdown doc generation
+(reference `RapidsConf.help`, RapidsConf.scala:2318).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"invalid boolean config value: {v!r}")
+
+
+_SIZE_SUFFIXES = {
+    "b": 1,
+    "k": 1 << 10, "kb": 1 << 10, "kib": 1 << 10,
+    "m": 1 << 20, "mb": 1 << 20, "mib": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "gib": 1 << 30,
+    "t": 1 << 40, "tb": 1 << 40, "tib": 1 << 40,
+}
+
+
+def parse_bytes(v: Any) -> int:
+    """Parse '512m', '1g', '1024' into a byte count (reference: byteStringAsBytes)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    num, suffix = s, ""
+    for i, ch in enumerate(s):
+        if not (ch.isdigit() or ch == "." or (ch == "-" and i == 0)):
+            num, suffix = s[:i], s[i:].strip()
+            break
+    if suffix and suffix not in _SIZE_SUFFIXES:
+        raise ValueError(f"invalid byte-size suffix in config value: {v!r}")
+    return int(float(num) * _SIZE_SUFFIXES.get(suffix, 1))
+
+
+@dataclass
+class ConfEntry:
+    key: str
+    doc: str
+    default: Any
+    converter: Callable[[Any], Any]
+    internal: bool = False
+    startup_only: bool = False
+    commonly_used: bool = False
+    checker: Optional[Callable[[Any], None]] = None
+
+    def get(self, settings: Dict[str, str]) -> Any:
+        raw = settings.get(self.key)
+        if raw is None:
+            return self.default
+        val = self.converter(raw)
+        if self.checker is not None:
+            self.checker(val)
+        return val
+
+
+class _ConfBuilder:
+    """Mirrors the reference's `conf("key").doc(...).booleanConf.createWithDefault(...)`."""
+
+    def __init__(self, registry: "ConfRegistry", key: str):
+        self._registry = registry
+        self._key = key
+        self._doc = ""
+        self._internal = False
+        self._startup_only = False
+        self._commonly_used = False
+        self._checker: Optional[Callable[[Any], None]] = None
+
+    def doc(self, text: str) -> "_ConfBuilder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "_ConfBuilder":
+        self._internal = True
+        return self
+
+    def startup_only(self) -> "_ConfBuilder":
+        self._startup_only = True
+        return self
+
+    def commonly_used(self) -> "_ConfBuilder":
+        self._commonly_used = True
+        return self
+
+    def check(self, fn: Callable[[Any], None]) -> "_ConfBuilder":
+        self._checker = fn
+        return self
+
+    def _create(self, default: Any, converter: Callable[[Any], Any]) -> ConfEntry:
+        entry = ConfEntry(
+            key=self._key, doc=self._doc, default=default, converter=converter,
+            internal=self._internal, startup_only=self._startup_only,
+            commonly_used=self._commonly_used, checker=self._checker)
+        self._registry.register(entry)
+        return entry
+
+    def boolean(self, default: bool) -> ConfEntry:
+        return self._create(default, _parse_bool)
+
+    def integer(self, default: int) -> ConfEntry:
+        return self._create(default, lambda v: int(str(v), 0))
+
+    def double(self, default: float) -> ConfEntry:
+        return self._create(default, float)
+
+    def string(self, default: Optional[str]) -> ConfEntry:
+        return self._create(default, str)
+
+    def bytes(self, default: int) -> ConfEntry:
+        return self._create(default, parse_bytes)
+
+    def string_list(self, default: List[str]) -> ConfEntry:
+        return self._create(
+            default,
+            lambda v: [s.strip() for s in str(v).split(",") if s.strip()] if not isinstance(v, list) else v)
+
+
+class ConfRegistry:
+    def __init__(self) -> None:
+        self.entries: Dict[str, ConfEntry] = {}
+
+    def conf(self, key: str) -> _ConfBuilder:
+        return _ConfBuilder(self, key)
+
+    def register(self, entry: ConfEntry) -> None:
+        if entry.key in self.entries:
+            raise ValueError(f"duplicate config key {entry.key}")
+        self.entries[entry.key] = entry
+
+    def help_markdown(self, include_internal: bool = False) -> str:
+        """Generate docs/configs.md content (reference RapidsConf.scala:2318)."""
+        lines = [
+            "# TPU Accelerator Configuration",
+            "",
+            "| Name | Description | Default | Applicable at |",
+            "|---|---|---|---|",
+        ]
+        for key in sorted(self.entries):
+            e = self.entries[key]
+            if e.internal and not include_internal:
+                continue
+            when = "Startup" if e.startup_only else "Runtime"
+            lines.append(f"| {e.key} | {e.doc} | {e.default} | {when} |")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = ConfRegistry()
+_conf = REGISTRY.conf
+
+# ---------------------------------------------------------------------------
+# Core enablement (reference RapidsConf.scala: spark.rapids.sql.enabled et al.)
+# ---------------------------------------------------------------------------
+SQL_ENABLED = _conf("spark.rapids.sql.enabled").doc(
+    "Enable (true) or disable (false) TPU acceleration of SQL plans."
+).commonly_used().boolean(True)
+
+SQL_MODE = _conf("spark.rapids.sql.mode").doc(
+    "executeOnTPU runs converted plans on the TPU; explainOnly only reports what would "
+    "run on the TPU (reference GpuOverrides.scala:4579-4584) and executes on CPU."
+).check(lambda v: None if v in ("executeontpu", "explainonly", "executeOnTPU", "explainOnly")
+        else (_ for _ in ()).throw(ValueError(f"invalid sql.mode {v}"))).string("executeOnTPU")
+
+EXPLAIN = _conf("spark.rapids.sql.explain").doc(
+    "NONE, NOT_ON_TPU (log reasons operators fall back to CPU) or ALL."
+).commonly_used().string("NOT_ON_TPU")
+
+TEST_ASSERT_ON_TPU = _conf("spark.rapids.sql.test.enabled").doc(
+    "Testing only: fail if any operator in the plan did not convert to the TPU "
+    "(reference GpuTransitionOverrides.assertIsOnTheGpu, GpuTransitionOverrides.scala:616)."
+).internal().boolean(False)
+
+ALLOW_CPU_FALLBACK_EXPRS = _conf("spark.rapids.sql.cpuExpressions.enabled").doc(
+    "Allow individual expressions without a TPU kernel to run on the host inside a "
+    "TPU-resident plan (per-expression fallback)."
+).boolean(True)
+
+INCOMPATIBLE_OPS = _conf("spark.rapids.sql.incompatibleOps.enabled").doc(
+    "Enable operators whose results differ from Spark in corner cases "
+    "(reference RapidsConf incompatibleOps)."
+).boolean(True)
+
+ANSI_ENABLED = _conf("spark.sql.ansi.enabled").doc(
+    "ANSI mode: arithmetic overflow and invalid casts raise instead of returning null."
+).boolean(False)
+
+CASE_SENSITIVE = _conf("spark.sql.caseSensitive").doc(
+    "Case-sensitive attribute resolution."
+).boolean(False)
+
+SESSION_TZ = _conf("spark.sql.session.timeZone").doc(
+    "Session timezone for timestamp semantics."
+).string("UTC")
+
+# ---------------------------------------------------------------------------
+# Batching / memory (reference RapidsConf.scala:544-567, 464, 508)
+# ---------------------------------------------------------------------------
+CONCURRENT_TPU_TASKS = _conf("spark.rapids.tpu.concurrentTpuTasks").doc(
+    "Number of concurrent tasks that may hold TPU HBM at once; gated by the TPU "
+    "semaphore (reference GpuSemaphore, RapidsConf.scala:544-551 default 2)."
+).commonly_used().integer(2)
+
+BATCH_SIZE_BYTES = _conf("spark.rapids.sql.batchSizeBytes").doc(
+    "Target size in bytes of output batches (reference GPU_BATCH_SIZE_BYTES default 1GiB "
+    "max 2GiB, RapidsConf.scala:559-567). Smaller default on TPU: static-shape compilation "
+    "favors stable bucketed capacities."
+).commonly_used().bytes(512 * 1024 * 1024)
+
+BATCH_SIZE_ROWS = _conf("spark.rapids.sql.batchSizeRows").doc(
+    "Target maximum rows per columnar batch."
+).integer(1 << 20)
+
+HBM_ALLOC_FRACTION = _conf("spark.rapids.memory.tpu.allocFraction").doc(
+    "Fraction of TPU HBM budgeted for columnar data (reference RMM_ALLOC_FRACTION, "
+    "RapidsConf.scala:464). XLA owns the physical allocator; this bounds our accounting."
+).startup_only().double(0.75)
+
+HOST_SPILL_STORAGE_SIZE = _conf("spark.rapids.memory.host.spillStorageSize").doc(
+    "Amount of host memory used to cache spilled device batches before disk "
+    "(reference HOST_SPILL_STORAGE_SIZE, RapidsConf.scala:508)."
+).startup_only().bytes(1 << 30)
+
+OOM_RETRY_MAX = _conf("spark.rapids.memory.tpu.oomMaxRetries").doc(
+    "Retries of an allocation after synchronizing + spilling before declaring OOM."
+).integer(3)
+
+BUCKET_PADDING = _conf("spark.rapids.tpu.batch.bucketPadding.enabled").doc(
+    "Pad batch capacities to power-of-two buckets to bound XLA recompilation under "
+    "data-dependent row counts (TPU-specific; no reference analogue — cuDF kernels "
+    "accept dynamic sizes, XLA does not)."
+).boolean(True)
+
+# ---------------------------------------------------------------------------
+# Shuffle (reference RapidsConf.scala:1663-1677, 1855-1866)
+# ---------------------------------------------------------------------------
+SHUFFLE_MODE = _conf("spark.rapids.shuffle.mode").doc(
+    "MULTITHREADED (host Arrow-serialized shuffle files, parallel writer/reader threads) "
+    "or ICI (device-resident all-to-all over the TPU interconnect within a mesh) "
+    "(reference SHUFFLE_MANAGER_MODE: MULTITHREADED/UCX/CACHE_ONLY)."
+).string("MULTITHREADED")
+
+SHUFFLE_WRITER_THREADS = _conf("spark.rapids.shuffle.multiThreaded.writer.threads").doc(
+    "Threads for the multithreaded shuffle writer (reference RapidsConf.scala:1855)."
+).integer(8)
+
+SHUFFLE_READER_THREADS = _conf("spark.rapids.shuffle.multiThreaded.reader.threads").doc(
+    "Threads for the multithreaded shuffle reader (reference RapidsConf.scala:1866)."
+).integer(8)
+
+SHUFFLE_COMPRESSION_CODEC = _conf("spark.rapids.shuffle.compression.codec").doc(
+    "Codec for shuffle batch buffers: none, zstd, lz4 (reference nvcomp LZ4/ZSTD codecs)."
+).string("zstd")
+
+SHUFFLE_PARTITIONS = _conf("spark.sql.shuffle.partitions").doc(
+    "Default number of shuffle partitions."
+).integer(16)
+
+# ---------------------------------------------------------------------------
+# I/O (reference RapidsConf.scala:1067-1088 and chunked-reader confs)
+# ---------------------------------------------------------------------------
+PARQUET_READER_TYPE = _conf("spark.rapids.sql.format.parquet.reader.type").doc(
+    "AUTO, PERFILE, COALESCING or MULTITHREADED multi-file reader strategy "
+    "(reference GpuMultiFileReader, RapidsConf.scala:1067-1088)."
+).string("AUTO")
+
+MULTITHREAD_READ_NUM_THREADS = _conf("spark.rapids.sql.multiThreadedRead.numThreads").doc(
+    "Thread-pool size for multithreaded file reading."
+).integer(8)
+
+PARQUET_ENABLED = _conf("spark.rapids.sql.format.parquet.enabled").doc(
+    "Enable TPU parquet scans/writes.").boolean(True)
+CSV_ENABLED = _conf("spark.rapids.sql.format.csv.enabled").doc(
+    "Enable TPU CSV scans.").boolean(True)
+JSON_ENABLED = _conf("spark.rapids.sql.format.json.enabled").doc(
+    "Enable TPU JSON scans.").boolean(True)
+ORC_ENABLED = _conf("spark.rapids.sql.format.orc.enabled").doc(
+    "Enable TPU ORC scans/writes.").boolean(True)
+
+# ---------------------------------------------------------------------------
+# Operator toggles (reference: spark.rapids.sql.exec.* generated per rule)
+# ---------------------------------------------------------------------------
+HASH_AGG_ENABLED = _conf("spark.rapids.sql.exec.HashAggregateExec").doc(
+    "Enable TPU hash aggregation.").boolean(True)
+SORT_ENABLED = _conf("spark.rapids.sql.exec.SortExec").doc(
+    "Enable TPU sort.").boolean(True)
+JOIN_ENABLED = _conf("spark.rapids.sql.exec.ShuffledHashJoinExec").doc(
+    "Enable TPU shuffled hash join.").boolean(True)
+BROADCAST_JOIN_ENABLED = _conf("spark.rapids.sql.exec.BroadcastHashJoinExec").doc(
+    "Enable TPU broadcast hash join.").boolean(True)
+WINDOW_ENABLED = _conf("spark.rapids.sql.exec.WindowExec").doc(
+    "Enable TPU window functions.").boolean(True)
+PROJECT_ENABLED = _conf("spark.rapids.sql.exec.ProjectExec").doc(
+    "Enable TPU projection.").boolean(True)
+FILTER_ENABLED = _conf("spark.rapids.sql.exec.FilterExec").doc(
+    "Enable TPU filter.").boolean(True)
+
+STABLE_SORT = _conf("spark.rapids.sql.stableSort.enabled").doc(
+    "Force stable sorts (reference RapidsConf stableSort)."
+).boolean(False)
+
+JOIN_SIZED_BUILD_HEURISTIC = _conf("spark.rapids.sql.join.buildSideRows.max").doc(
+    "Max build-side rows before a shuffled hash join sub-partitions its inputs "
+    "(reference GpuSubPartitionHashJoin)."
+).integer(1 << 22)
+
+# ---------------------------------------------------------------------------
+# Metrics / profiling / debug (reference GpuExec.scala:41-61, profiler.scala)
+# ---------------------------------------------------------------------------
+METRICS_LEVEL = _conf("spark.rapids.sql.metrics.level").doc(
+    "ESSENTIAL, MODERATE, or DEBUG metric verbosity (reference GpuMetric levels)."
+).string("MODERATE")
+
+PROFILE_PATH_PREFIX = _conf("spark.rapids.profile.pathPrefix").doc(
+    "If set, write jax profiler traces for task execution under this path "
+    "(reference spark.rapids.profile.* CUPTI profiler)."
+).string(None)
+
+TEST_RETRY_OOM_INJECTION = _conf("spark.rapids.memory.tpu.state.debug.retryOomInjection").doc(
+    "Testing only: inject TpuRetryOOM/TpuSplitAndRetryOOM at allocation points "
+    "(reference RmmSpark.forceRetryOOM test hooks)."
+).internal().string(None)
+
+
+class RapidsConf:
+    """Immutable snapshot of settings, one per query compilation.
+
+    Reference: `new RapidsConf(plan.conf)` per-query (GpuOverrides.scala:4565).
+    """
+
+    def __init__(self, settings: Optional[Dict[str, str]] = None):
+        self._settings = dict(settings or {})
+        self._cache: Dict[str, Any] = {}
+
+    def get(self, entry: ConfEntry) -> Any:
+        if entry.key not in self._cache:
+            self._cache[entry.key] = entry.get(self._settings)
+        return self._cache[entry.key]
+
+    def get_raw(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._settings.get(key, default)
+
+    def is_op_enabled(self, key: str, default: bool = True) -> bool:
+        raw = self._settings.get(key)
+        return default if raw is None else _parse_bool(raw)
+
+    # Convenience accessors used on hot paths
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain_only(self) -> bool:
+        return str(self.get(SQL_MODE)).lower() == "explainonly"
+
+    @property
+    def ansi_enabled(self) -> bool:
+        return self.get(ANSI_ENABLED)
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    def with_overrides(self, **kv: str) -> "RapidsConf":
+        s = dict(self._settings)
+        s.update({k.replace("__", "."): v for k, v in kv.items()})
+        return RapidsConf(s)
+
+
+_DEFAULT = RapidsConf()
+
+
+def default_conf() -> RapidsConf:
+    return _DEFAULT

@@ -1,0 +1,611 @@
+"""Hash-aggregate execs: CPU (arrow group_by oracle) and TPU (sort-based
+segmented reduction on device).
+
+Reference: GpuHashAggregateExec (GpuAggregateExec.scala:1711) with the
+update/merge decomposition of aggregateFunctions.scala. TPU algorithm choice:
+cuDF has a device hash-groupby; on TPU, data-dependent hash tables fight XLA's
+static shapes, while sort+segment-reduce maps cleanly onto MXU/VPU-friendly
+primitives (argsort, segment-sum via scatter-add), so the *primary* path here is
+what the reference uses as its fallback (sort-based aggregation,
+GpuAggregateExec.scala:757) — deliberately inverted for the hardware.
+
+Modes mirror the reference: Partial (update → state columns), Final (merge
+states → results), Complete (both, single partition). The planner emits
+Partial → [exchange] → Final once the shuffle lands; Complete otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import TpuColumnarBatch, concat_batches, gather
+from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
+from ..expressions.aggregates import (AggregateFunction, Average, Count, First,
+                                      Last, Max, Min, StddevBase, StddevPop,
+                                      StddevSamp, Sum, VariancePop, VarianceSamp)
+from ..expressions.base import (Alias, AttributeReference, Expression, to_column)
+from ..types import (DataType, DoubleT, FloatType, DoubleType, LongT, StringType)
+from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
+                   bind_references)
+
+
+def split_result_exprs(aggregates: Sequence[Expression]):
+    """Split each output expression into its AggregateFunction leaves + a result
+    projection over them (reference resultExpressions handling)."""
+    agg_fns: List[AggregateFunction] = []
+    result_exprs: List[Expression] = []
+    for e in aggregates:
+        def rule(x: Expression):
+            if isinstance(x, AggregateFunction):
+                for i, existing in enumerate(agg_fns):
+                    if existing is x:
+                        idx = i
+                        break
+                else:
+                    agg_fns.append(x)
+                    idx = len(agg_fns) - 1
+                return AttributeReference(f"__agg_{idx}", x.dtype, x.nullable,
+                                          expr_id=-(idx + 1))
+            return None
+        result_exprs.append(e.transform(rule))
+    return agg_fns, result_exprs
+
+
+class CpuHashAggregateExec(CpuExec):
+    """Arrow group_by based aggregate (the CPU oracle / fallback target)."""
+
+    def __init__(self, grouping: Sequence[Expression],
+                 aggregates: Sequence[Expression], child: PhysicalPlan,
+                 output: List[AttributeReference]):
+        super().__init__([child])
+        self.grouping = bind_all(list(grouping), child.output)
+        self.aggregates = [bind_references(a, child.output) for a in aggregates]
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"CpuHashAggregate[keys={len(self.grouping)}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        child = self.children[0]
+        tables = []
+        for p in range(child.num_partitions()):
+            tables.extend(child.execute_partition(p, ctx))
+        if not tables:
+            base = None
+        else:
+            base = pa.concat_tables(tables)
+        agg_fns, result_exprs = split_result_exprs(self.aggregates)
+        if base is None or base.num_rows == 0:
+            from ..types import to_arrow
+            if self.grouping:
+                yield pa.schema([(a.name, to_arrow(a.dtype))
+                                 for a in self._output]).empty_table()
+                return
+            base = pa.schema([(a.name, to_arrow(a.dtype))
+                              for a in self.children[0].output]).empty_table()
+        # pre-project: key cols + agg input cols
+        proj: Dict[str, object] = {}
+        key_names = []
+        for i, g in enumerate(self.grouping):
+            arr = g.eval_cpu(base, ctx.eval_ctx)
+            arr = _normalize_fp_key_arrow(arr)
+            name = f"__key_{i}"
+            proj[name] = arr
+            key_names.append(name)
+        agg_specs = []
+        for i, fn in enumerate(agg_fns):
+            inp = fn.children[0] if fn.children else None
+            name = f"__in_{i}"
+            if inp is None:
+                proj[name] = pa.array(np.ones(base.num_rows, np.int64))
+            else:
+                r = inp.eval_cpu(base, ctx.eval_ctx)
+                if not isinstance(r, (pa.Array, pa.ChunkedArray)):
+                    from ..types import to_arrow
+                    r = pa.array([r] * base.num_rows, type=to_arrow(inp.dtype))
+                proj[name] = r
+            agg_specs.append((name, fn))
+        if base.num_rows == 0 and not self.grouping:
+            flat = pa.table({k: pa.array([], type=getattr(v, "type", pa.int64()))
+                             for k, v in proj.items()})
+        else:
+            flat = pa.table(proj)
+        agg_table = _arrow_aggregate(flat, key_names, agg_specs, self.grouping)
+        # result projection over (keys + __agg_i) — bind the special refs
+        out_cols = []
+        ng = len(self.grouping)
+        for ri, (expr, attr) in enumerate(zip(result_exprs, self._output[ng:])):
+            bound = _bind_agg_refs(expr, agg_table, ng)
+            r = bound.eval_cpu(agg_table, ctx.eval_ctx)
+            if not isinstance(r, (pa.Array, pa.ChunkedArray)):
+                from ..types import to_arrow
+                r = pa.array([r] * agg_table.num_rows, type=to_arrow(attr.dtype))
+            out_cols.append(r)
+        names = [a.name for a in self._output]
+        key_arrays = [agg_table.column(i) for i in range(ng)]
+        yield pa.table(dict(zip(names, key_arrays + out_cols)))
+
+
+def _normalize_fp_key_arrow(arr):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if isinstance(arr, (pa.Array, pa.ChunkedArray)) and pa.types.is_floating(arr.type):
+        # -0.0 → 0.0 (NaNs group together in arrow hashing already)
+        zero = pa.scalar(0.0, arr.type)
+        return pc.if_else(pc.equal(arr, zero), zero, arr)
+    return arr
+
+
+_ARROW_AGG = {"sum": "sum", "count": "count", "min": "min", "max": "max",
+              "avg": "mean", "first": "first", "last": "last",
+              "stddev_samp": "stddev", "stddev_pop": "stddev",
+              "var_samp": "variance", "var_pop": "variance"}
+
+
+def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
+    """Grouped aggregation with Spark semantics layered over arrow group_by.
+    Spark orders NaN greater than all doubles: fp min skips NaN unless the whole
+    group is NaN; fp max is NaN when any NaN is present — arrow propagates NaN
+    instead, so fp min/max decompose into clean-min/any-nan/all-nan parts."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    work = {k: flat.column(k) for k in key_names}
+    plans = []  # per output agg: (mode, [work col names], fn)
+    for i, (name, fn) in enumerate(agg_specs):
+        col = flat.column(name)
+        is_fp = pa.types.is_floating(col.type)
+        if is_fp and fn.update_op in ("min", "max"):
+            nan = pc.is_nan(col)
+            neutral = pa.scalar(np.inf if fn.update_op == "min" else -np.inf,
+                                col.type)
+            clean = pc.if_else(pc.fill_null(nan, False), neutral, col)
+            work[f"__c_{i}"] = clean
+            work[f"__n_{i}"] = pc.cast(nan, pa.int8())  # null-preserving
+            plans.append(("fp_minmax", [f"__c_{i}", f"__n_{i}"], fn))
+        else:
+            work[f"__c_{i}"] = col
+            plans.append(("plain", [f"__c_{i}"], fn))
+
+    agg_calls = []
+    for mode, names, fn in plans:
+        op = _ARROW_AGG[fn.update_op]
+        if fn.update_op in ("stddev_samp", "var_samp"):
+            agg_calls.append((names[0], op, pc.VarianceOptions(ddof=1)))
+        elif fn.update_op in ("stddev_pop", "var_pop"):
+            agg_calls.append((names[0], op, pc.VarianceOptions(ddof=0)))
+        elif fn.update_op in ("first", "last"):
+            agg_calls.append((names[0], op, pc.ScalarAggregateOptions(
+                skip_nulls=getattr(fn, "ignore_nulls", False))))
+        elif mode == "fp_minmax":
+            agg_calls.append((names[0], op, None))
+            agg_calls.append((names[1], "min", None))  # all-nan flag
+            agg_calls.append((names[1], "max", None))  # any-nan flag
+        else:
+            agg_calls.append((names[0], op, None))
+
+    work_table = pa.table(work)
+    if key_names:
+        gb = pa.TableGroupBy(work_table, key_names)
+        res = gb.aggregate([(n, op) if o is None else (n, op, o)
+                            for n, op, o in agg_calls])
+        get = lambda n, op: res.column(f"{n}_{op}")
+        n_out = res.num_rows
+    else:
+        scalar_fns = {"sum": pc.sum, "count": pc.count, "min": pc.min,
+                      "max": pc.max, "mean": pc.mean, "first": pc.first,
+                      "last": pc.last, "stddev": pc.stddev,
+                      "variance": pc.variance}
+        results = {}
+        for n, op, o in agg_calls:
+            col = work_table.column(n)
+            f = scalar_fns[op]
+            if op in ("stddev", "variance"):
+                v = f(col, ddof=o.ddof)
+            elif op in ("first", "last"):
+                v = f(col, skip_nulls=o.skip_nulls)
+            else:
+                v = f(col)
+            results[f"{n}_{op}"] = pa.array(
+                [v.as_py()], type=v.type if v.type != pa.null() else pa.int64())
+        get = lambda n, op: results[f"{n}_{op}"]
+        n_out = 1
+
+    out_cols = {}
+    for i, (mode, names, fn) in enumerate(plans):
+        op = _ARROW_AGG[fn.update_op]
+        if mode == "fp_minmax":
+            red = get(names[0], op)
+            all_nan = get(names[1], "min")
+            any_nan = get(names[1], "max")
+            nan_scalar = pa.scalar(float("nan"), red.type if hasattr(red, 'type') else pa.float64())
+            if fn.update_op == "min":
+                flag = pc.equal(pc.fill_null(all_nan, 0), 1)
+            else:
+                flag = pc.equal(pc.fill_null(any_nan, 0), 1)
+            out = pc.if_else(flag, nan_scalar, red)
+        else:
+            out = get(names[0], op)
+        out_cols[f"__out_{i}"] = out
+
+    if key_names:
+        key_arrays = [res.column(k) for k in key_names]
+    else:
+        key_arrays = []
+    arrays = key_arrays + [out_cols[f"__out_{i}"] for i in range(len(plans))]
+    names_out = key_names + [f"__out_{i}" for i in range(len(plans))]
+    return pa.table(dict(zip(names_out, arrays)))
+
+
+def _bind_agg_refs(expr: Expression, agg_table, num_keys: int) -> Expression:
+    """Rewrite __agg_i refs (expr_id=-(i+1)) to ordinals in the aggregated table."""
+
+    def rule(e: Expression):
+        if isinstance(e, AttributeReference) and e.expr_id < 0:
+            i = -e.expr_id - 1
+            return AttributeReference(e.name, e.dtype, e.nullable,
+                                      ordinal=num_keys + i, expr_id=e.expr_id)
+        return None
+
+    return expr.transform(rule)
+
+
+# ---------------------------------------------------------------------------
+# TPU path
+# ---------------------------------------------------------------------------
+
+def _sortable_bits(col: TpuColumnVector):
+    """Order/equality-preserving integer encoding of a fixed-width column
+    (floats: sign-flipped IEEE bits with NaN canonicalized and -0→0 — the same
+    trick radix sorts use; cuDF does this inside its sort kernels)."""
+    d = col.data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+        canon = jnp.asarray(np.array(np.nan, d.dtype))
+        d = jnp.where(jnp.isnan(d), canon, d)
+        if d.dtype == jnp.float64:
+            bits = d.view(jnp.int64)
+            flipped = jnp.where(bits < 0, ~bits, bits | jnp.int64(np.int64(-2**63)))
+            return flipped.view(jnp.int64) ^ jnp.int64(np.int64(-2**63))
+        bits = d.view(jnp.int32)
+        flipped = jnp.where(bits < 0, ~bits, bits | jnp.int32(np.int32(-2**31)))
+        return flipped ^ jnp.int32(np.int32(-2**31))
+    if d.dtype == jnp.bool_:
+        return d.astype(jnp.int32)
+    return d
+
+
+def encode_group_keys(cols: List[TpuColumnVector], num_rows: int, capacity: int):
+    """Per-key (sortable_value, validity) pairs. Strings are dictionary-encoded
+    host-side (codes preserve equality; order not needed for grouping)."""
+    out = []
+    for c in cols:
+        if isinstance(c.dtype, StringType):
+            import pyarrow as pa
+            import pyarrow.compute as pc
+            arr = c.to_arrow()
+            enc = pc.dictionary_encode(arr)
+            if isinstance(enc, pa.ChunkedArray):
+                enc = enc.combine_chunks()
+            codes = enc.indices
+            vals = np.asarray(codes.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+            buf = np.zeros(capacity, np.int32)
+            buf[:num_rows] = vals
+            out.append((jnp.asarray(buf), c.validity))
+        else:
+            out.append((_sortable_bits(c), c.validity))
+    return out
+
+
+def lex_sort_permutation(keys, num_rows: int, capacity: int,
+                         orders: Optional[List[Tuple[bool, bool]]] = None):
+    """Stable lexicographic sort permutation over encoded keys.
+    keys: list of (values, validity_or_None); orders: per-key (ascending,
+    nulls_first); padding rows always sort last."""
+    perm = jnp.arange(capacity, dtype=jnp.int32)
+    if orders is None:
+        orders = [(True, True)] * len(keys)
+    # least-significant key first; each pass is a stable argsort
+    for (vals, validity), (asc, nulls_first) in list(zip(keys, orders))[::-1]:
+        v = jnp.take(vals, perm)
+        if not asc:
+            v = _invert_order(v)
+        if validity is not None:
+            nv = jnp.take(validity, perm)
+            v = _apply_null_order(v, nv, nulls_first)
+        order = jnp.argsort(v, stable=True)
+        perm = jnp.take(perm, order)
+    # padding last: single extra pass on is_padding
+    pad = (perm >= num_rows).astype(jnp.int32)
+    order = jnp.argsort(pad, stable=True)
+    return jnp.take(perm, order)
+
+
+def _invert_order(v):
+    if v.dtype == jnp.int64:
+        return jnp.int64(-1) ^ v
+    return (-1 ^ v.astype(jnp.int32))
+
+
+def _apply_null_order(v, valid, nulls_first):
+    """Map values to (flag, v) ordering via a shifted representation: since we
+    cannot widen beyond int64 safely, sort nulls via a pre-pass trick: encode
+    null rows to extreme values. Ties between null rows keep stability."""
+    if v.dtype == jnp.int64:
+        lo = jnp.int64(np.int64(-2**63))
+        hi = jnp.int64(np.int64(2**63 - 1))
+    else:
+        info = np.iinfo(np.asarray(v).dtype if hasattr(v, 'dtype') else np.int32)
+        lo = jnp.asarray(info.min, v.dtype)
+        hi = jnp.asarray(info.max, v.dtype)
+    sentinel = lo if nulls_first else hi
+    return jnp.where(valid, v, sentinel)
+
+
+class AggState:
+    """Per-group device state columns for one aggregate fn."""
+
+    def __init__(self, arrays: Dict[str, jnp.ndarray]):
+        self.arrays = arrays
+
+
+def _segment_update(fn: AggregateFunction, col: Optional[TpuColumnVector],
+                    seg_ids, n_groups_cap: int, capacity: int, num_rows: int,
+                    sorted_perm) -> Dict[str, jnp.ndarray]:
+    """Compute partial state per group via scatter reductions over sorted rows."""
+    mask = row_mask(num_rows, capacity)
+    if col is not None:
+        data = jnp.take(col.data, sorted_perm)
+        valid = jnp.take(col.validity, sorted_perm) if col.validity is not None else mask
+        valid = valid & jnp.take(mask, sorted_perm)
+    else:
+        data = jnp.ones((capacity,), jnp.int64)
+        valid = jnp.take(mask, sorted_perm)
+    op = fn.update_op
+    if op == "count":
+        cnt = jnp.zeros((n_groups_cap,), jnp.int64).at[seg_ids].add(
+            valid.astype(jnp.int64), mode="drop")
+        return {"count": cnt}
+    if op == "sum":
+        acc_dtype = fn.dtype.np_dtype
+        contrib = jnp.where(valid, data, jnp.zeros((), data.dtype)).astype(acc_dtype)
+        s = jnp.zeros((n_groups_cap,), acc_dtype).at[seg_ids].add(contrib, mode="drop")
+        nn = jnp.zeros((n_groups_cap,), jnp.int64).at[seg_ids].add(
+            valid.astype(jnp.int64), mode="drop")
+        return {"sum": s, "nonnull": nn}
+    if op == "avg":
+        contrib = jnp.where(valid, data, jnp.zeros((), data.dtype)).astype(jnp.float64)
+        s = jnp.zeros((n_groups_cap,), jnp.float64).at[seg_ids].add(contrib, mode="drop")
+        n = jnp.zeros((n_groups_cap,), jnp.int64).at[seg_ids].add(
+            valid.astype(jnp.int64), mode="drop")
+        return {"sum": s, "count": n}
+    if op in ("min", "max"):
+        is_fp = jnp.issubdtype(data.dtype, jnp.floating)
+        nn = jnp.zeros((n_groups_cap,), jnp.int64).at[seg_ids].add(
+            valid.astype(jnp.int64), mode="drop")
+        if is_fp:
+            # Spark orders NaN greater than everything: min skips NaN unless the
+            # whole group is NaN; max returns NaN if any NaN present.
+            neutral = jnp.asarray(np.inf if op == "min" else -np.inf, data.dtype)
+            nan_in = jnp.isnan(data) & valid
+            clean = jnp.where(valid & ~jnp.isnan(data), data, neutral)
+            init = jnp.full((n_groups_cap,), neutral, data.dtype)
+            red = init.at[seg_ids].min(clean, mode="drop") if op == "min" \
+                else init.at[seg_ids].max(clean, mode="drop")
+            nan_any = jnp.zeros((n_groups_cap,), jnp.bool_).at[seg_ids].max(
+                nan_in, mode="drop")
+            nonnan = jnp.zeros((n_groups_cap,), jnp.int64).at[seg_ids].add(
+                (valid & ~jnp.isnan(data)).astype(jnp.int64), mode="drop")
+            if op == "min":
+                red = jnp.where((nonnan == 0) & (nn > 0),
+                                jnp.asarray(np.nan, data.dtype), red)
+            else:
+                red = jnp.where(nan_any, jnp.asarray(np.nan, data.dtype), red)
+            return {op: red, "nonnull": nn}
+        info = np.iinfo(np.asarray(jnp.zeros((), data.dtype)).dtype)
+        neutral = jnp.asarray(info.max if op == "min" else info.min, data.dtype)
+        contrib = jnp.where(valid, data, neutral)
+        init = jnp.full((n_groups_cap,), neutral, data.dtype)
+        red = init.at[seg_ids].min(contrib, mode="drop") if op == "min" \
+            else init.at[seg_ids].max(contrib, mode="drop")
+        return {op: red, "nonnull": nn}
+    if op in ("first", "last"):
+        pos = jnp.arange(capacity, dtype=jnp.int32)
+        ignore = getattr(fn, "ignore_nulls", False)
+        eligible = valid if ignore else jnp.take(mask, sorted_perm)
+        bad = jnp.asarray(np.int32(2**31 - 1))
+        cand = jnp.where(eligible, pos, bad if op == "first" else jnp.int32(-1))
+        init = jnp.full((n_groups_cap,), bad if op == "first" else jnp.int32(-1), jnp.int32)
+        sel = init.at[seg_ids].min(cand, mode="drop") if op == "first" \
+            else init.at[seg_ids].max(cand, mode="drop")
+        has = (sel != (bad if op == "first" else -1))
+        safe = jnp.clip(sel, 0, capacity - 1)
+        vals = jnp.take(data, safe)
+        vvalid = jnp.take(valid, safe) & has
+        return {op: jnp.where(vvalid, vals, jnp.zeros((), vals.dtype)),
+                "has": has, f"{op}_valid": vvalid}
+    if op in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+        x = jnp.where(valid, data, jnp.zeros((), data.dtype)).astype(jnp.float64)
+        n = jnp.zeros((n_groups_cap,), jnp.int64).at[seg_ids].add(
+            valid.astype(jnp.int64), mode="drop")
+        s = jnp.zeros((n_groups_cap,), jnp.float64).at[seg_ids].add(x, mode="drop")
+        s2 = jnp.zeros((n_groups_cap,), jnp.float64).at[seg_ids].add(x * x, mode="drop")
+        return {"n": n, "sum": s, "sumsq": s2}
+    raise NotImplementedError(f"update op {op}")
+
+
+def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
+                  n_groups: int, cap: int) -> TpuColumnVector:
+    gmask = row_mask(n_groups, cap)
+    op = fn.update_op
+    if op == "count":
+        return TpuColumnVector(LongT, state["count"], None, n_groups)
+    if op == "sum":
+        valid = (state["nonnull"] > 0) & gmask
+        return TpuColumnVector(fn.dtype, state["sum"], valid, n_groups)
+    if op == "avg":
+        n = state["count"]
+        valid = (n > 0) & gmask
+        data = state["sum"] / jnp.where(n > 0, n, 1).astype(jnp.float64)
+        return TpuColumnVector(DoubleT, jnp.where(valid, data, 0.0), valid, n_groups)
+    if op in ("min", "max"):
+        valid = (state["nonnull"] > 0) & gmask
+        data = jnp.where(valid, state[op], jnp.zeros((), state[op].dtype))
+        return TpuColumnVector(fn.dtype, data, valid, n_groups)
+    if op in ("first", "last"):
+        valid = state[f"{op}_valid"] & gmask
+        return TpuColumnVector(fn.dtype, state[op], valid, n_groups)
+    if op in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+        n = state["n"].astype(jnp.float64)
+        s, s2 = state["sum"], state["sumsq"]
+        m2 = s2 - (s * s) / jnp.where(n > 0, n, 1.0)
+        ddof = 1.0 if op.endswith("samp") else 0.0
+        denom = n - ddof
+        ok = denom > 0
+        var = jnp.where(ok, m2 / jnp.where(ok, denom, 1.0), 0.0)
+        var = jnp.maximum(var, 0.0)
+        out = jnp.sqrt(var) if op.startswith("stddev") else var
+        valid = ok & (n > 0) & gmask
+        return TpuColumnVector(DoubleT, jnp.where(valid, out, 0.0), valid, n_groups)
+    raise NotImplementedError(op)
+
+
+class TpuHashAggregateExec(TpuExec):
+    """Sort-based grouped aggregation on device (complete mode)."""
+
+    def __init__(self, grouping: Sequence[Expression],
+                 aggregates: Sequence[Expression], child: PhysicalPlan,
+                 output: List[AttributeReference], mode: str = "complete"):
+        super().__init__([child])
+        self.grouping = bind_all(list(grouping), child.output)
+        self.aggregates = [bind_references(a, child.output) for a in aggregates]
+        self._output = output
+        self.mode = mode
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"TpuHashAggregate[keys={len(self.grouping)}]"
+
+    def additional_metrics(self):
+        return {"sortTime": "MODERATE", "reduceTime": "MODERATE",
+                "numGroups": "DEBUG"}
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        child = self.children[0]
+        batches: List[TpuColumnarBatch] = []
+        for p in range(child.num_partitions()):
+            batches.extend(child.execute_partition(p, ctx))
+        agg_fns, result_exprs = split_result_exprs(self.aggregates)
+        if not batches:
+            if not self.grouping:
+                yield self._empty_global_result(agg_fns, result_exprs, ctx)
+            return
+        batch = concat_batches(batches) if len(batches) > 1 else batches[0]
+        out = self._aggregate_batch(batch, agg_fns, result_exprs, ctx)
+        yield out
+
+    def _aggregate_batch(self, batch: TpuColumnarBatch, agg_fns, result_exprs,
+                         ctx: TaskContext) -> TpuColumnarBatch:
+        cap = batch.capacity
+        n = batch.num_rows
+        key_cols = [to_column(g.eval_tpu(batch, ctx.eval_ctx), batch, g.dtype)
+                    for g in self.grouping]
+        in_cols: List[Optional[TpuColumnVector]] = []
+        for fn in agg_fns:
+            if fn.children:
+                in_cols.append(to_column(fn.children[0].eval_tpu(batch, ctx.eval_ctx),
+                                         batch, fn.children[0].dtype))
+            else:
+                in_cols.append(None)
+        if self.grouping:
+            with self.metrics["sortTime"].timed():
+                enc = encode_group_keys(key_cols, n, cap)
+                perm = lex_sort_permutation(enc, n, cap)
+                # boundaries in sorted order
+                is_new = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
+                for vals, validity in enc:
+                    sv = jnp.take(vals, perm)
+                    neq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                           sv[1:] != sv[:-1]])
+                    if validity is not None:
+                        nv = jnp.take(validity, perm)
+                        vneq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                                nv[1:] != nv[:-1]])
+                        neq = neq | vneq
+                    is_new = is_new | neq
+                pad = jnp.take(row_mask(n, cap), perm)
+                is_new = is_new & pad
+                seg_ids = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+                n_groups = int(jnp.max(jnp.where(pad, seg_ids, -1))) + 1
+            self.metrics["numGroups"].add(n_groups)
+        else:
+            perm = jnp.arange(cap, dtype=jnp.int32)
+            seg_ids = jnp.zeros((cap,), jnp.int32)
+            n_groups = 1
+        g_cap = bucket_capacity(max(n_groups, 1))
+        with self.metrics["reduceTime"].timed():
+            states = [_segment_update(fn, col, seg_ids, g_cap, cap, n, perm)
+                      for fn, col in zip(agg_fns, in_cols)]
+            agg_cols = [_evaluate_agg(fn, st, n_groups, g_cap)
+                        for fn, st in zip(agg_fns, states)]
+        # group key output: first row of each segment
+        out_key_cols = []
+        if self.grouping:
+            first_pos = jnp.zeros((g_cap,), jnp.int32).at[
+                jnp.where(is_new, seg_ids, g_cap)].set(
+                jnp.arange(cap, dtype=jnp.int32), mode="drop")
+            key_rows = jnp.take(perm, first_pos)
+            key_batch = TpuColumnarBatch(key_cols, n)
+            gathered = gather(key_batch, key_rows, n_groups, out_capacity=g_cap)
+            out_key_cols = gathered.columns
+        # result projection over agg columns
+        agg_batch = TpuColumnarBatch(list(out_key_cols) + agg_cols, n_groups)
+        ng = len(self.grouping)
+        final_cols = list(out_key_cols)
+        for expr, attr in zip(result_exprs, self._output[ng:]):
+            bound = _bind_agg_refs(expr, None, ng)
+            r = bound.eval_tpu(agg_batch, ctx.eval_ctx)
+            final_cols.append(to_column(r, agg_batch, attr.dtype))
+        return TpuColumnarBatch(final_cols, n_groups,
+                                [a.name for a in self._output])
+
+    def _empty_global_result(self, agg_fns, result_exprs, ctx):
+        """Global aggregate over zero rows: count=0, others null (Spark)."""
+        cols = []
+        for fn in agg_fns:
+            if isinstance(fn, Count):
+                cols.append(TpuColumnVector.from_numpy(LongT, np.zeros(1, np.int64)))
+            else:
+                cols.append(TpuColumnVector.from_scalar(None, fn.dtype, 1))
+        agg_batch = TpuColumnarBatch(cols, 1)
+        final = []
+        for expr, attr in zip(result_exprs, self._output):
+            bound = _bind_agg_refs(expr, None, 0)
+            final.append(to_column(bound.eval_tpu(agg_batch, ctx.eval_ctx),
+                                   agg_batch, attr.dtype))
+        return TpuColumnarBatch(final, 1, [a.name for a in self._output])
+
+
+def plan_cpu_aggregate(plan, conf):
+    from ..plan.planner import plan_physical
+    child = plan_physical(plan.children[0], conf)
+    return CpuHashAggregateExec(plan.grouping, plan.aggregates, child, plan.output)

@@ -1,0 +1,163 @@
+"""Physical-plan base classes: CpuExec (host Arrow path) and TpuExec (device path).
+
+Reference: the `GpuExec` trait (/root/reference/sql-plugin/.../GpuExec.scala:236,
+doExecuteColumnar:387) producing RDD[ColumnarBatch]. Here a physical operator
+produces an iterator of batches per partition; the CPU flavor streams
+pyarrow Tables (standing in for Spark's row/columnar CPU operators and serving as
+the parity oracle), the TPU flavor streams TpuColumnarBatch.
+
+Metrics follow the reference's GpuMetric taxonomy (GpuExec.scala:41-61):
+ESSENTIAL/MODERATE/DEBUG levels, standard names (numOutputRows, numOutputBatches,
+opTime, ...).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..config import RapidsConf, default_conf
+from ..expressions.base import AttributeReference, EvalContext, Expression
+from ..types import StructField, StructType
+
+ESSENTIAL = "ESSENTIAL"
+MODERATE = "MODERATE"
+DEBUG = "DEBUG"
+
+
+class TpuMetric:
+    """Accumulator metric (reference GpuMetric)."""
+
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: str = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v: int) -> None:
+        self.value += v
+
+    @contextmanager
+    def timed(self):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.value += time.perf_counter_ns() - t0
+
+
+class TaskContext:
+    """Per-task execution context (partition id, conf, metric sink).
+    Reference analogue: Spark TaskContext + GpuTaskMetrics."""
+
+    def __init__(self, partition_id: int = 0, conf: Optional[RapidsConf] = None):
+        self.partition_id = partition_id
+        self.conf = conf or default_conf()
+        self.eval_ctx = EvalContext(self.conf)
+        self.task_metrics: Dict[str, int] = {}
+
+
+class PhysicalPlan:
+    """Base physical operator."""
+
+    children: List["PhysicalPlan"]
+
+    def __init__(self, children: Sequence["PhysicalPlan"]):
+        self.children = list(children)
+        self.metrics: Dict[str, TpuMetric] = {}
+        self._register_metrics()
+
+    # --- metadata ---------------------------------------------------------
+    @property
+    def output(self) -> List[AttributeReference]:
+        raise NotImplementedError
+
+    def schema(self) -> StructType:
+        return StructType([StructField(a.name, a.dtype, a.nullable) for a in self.output])
+
+    @property
+    def is_tpu(self) -> bool:
+        return isinstance(self, TpuExec)
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def node_desc(self) -> str:
+        return self.node_name()
+
+    # --- metrics ----------------------------------------------------------
+    def _register_metrics(self) -> None:
+        self.metrics["numOutputRows"] = TpuMetric("numOutputRows", ESSENTIAL)
+        self.metrics["numOutputBatches"] = TpuMetric("numOutputBatches", MODERATE)
+        self.metrics["opTime"] = TpuMetric("opTime", MODERATE)
+        for name, level in self.additional_metrics().items():
+            self.metrics[name] = TpuMetric(name, level)
+
+    def additional_metrics(self) -> Dict[str, str]:
+        return {}
+
+    # --- execution --------------------------------------------------------
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions() if self.children else 1
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        raise NotImplementedError
+
+    # --- plan utilities ---------------------------------------------------
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + ("*" if self.is_tpu else " ") + " " + self.node_desc()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def collect_nodes(self) -> List["PhysicalPlan"]:
+        out = [self]
+        for c in self.children:
+            out.extend(c.collect_nodes())
+        return out
+
+
+class CpuExec(PhysicalPlan):
+    """Host operator over pyarrow Tables (stands in for Spark's CPU operators —
+    the thing the reference falls back TO)."""
+
+
+class TpuExec(PhysicalPlan):
+    """Device operator over TpuColumnarBatch (reference GpuExec).
+    Subclasses implement internal_do_execute_columnar per partition."""
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        out_rows = self.metrics["numOutputRows"]
+        out_batches = self.metrics["numOutputBatches"]
+        for batch in self.internal_do_execute_columnar(idx, ctx):
+            out_rows.add(batch.num_rows)
+            out_batches.add(1)
+            yield batch
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        raise NotImplementedError
+
+
+def bind_references(expr: Expression, inputs: List[AttributeReference]) -> Expression:
+    """Rewrite AttributeReferences to carry the ordinal of the matching input
+    (reference GpuBindReferences, GpuBoundAttribute.scala)."""
+    by_id = {a.expr_id: i for i, a in enumerate(inputs)}
+
+    def rule(e: Expression):
+        if isinstance(e, AttributeReference):
+            if e.expr_id not in by_id:
+                raise ValueError(
+                    f"cannot bind {e.name}#{e.expr_id}; inputs: "
+                    f"{[f'{a.name}#{a.expr_id}' for a in inputs]}")
+            return AttributeReference(e.name, e.dtype, e.nullable,
+                                      ordinal=by_id[e.expr_id], expr_id=e.expr_id)
+        return None
+
+    return expr.transform(rule)
+
+
+def bind_all(exprs: Sequence[Expression],
+             inputs: List[AttributeReference]) -> List[Expression]:
+    return [bind_references(e, inputs) for e in exprs]

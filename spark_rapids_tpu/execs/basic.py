@@ -1,0 +1,212 @@
+"""Basic TPU operators: project, filter, range, union, limit, coalesce-batches.
+
+Reference: basicPhysicalOperators.scala (GpuProjectExec:350, GpuFilterExec:795,
+GpuRangeExec:1128, GpuUnionExec:1219) and GpuCoalesceBatches.scala. Projection
+evaluates all bound expressions against the device batch — XLA fuses the whole
+expression forest into one executable per batch shape (the reference launches one
+cuDF kernel per op), which is the main TPU-side win of this design.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import TpuColumnarBatch, compact, concat_batches, slice_batch
+from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
+from ..expressions.base import (AttributeReference, Expression, to_column)
+from .base import PhysicalPlan, TaskContext, TpuExec, bind_all, bind_references
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, exprs: Sequence[Expression], child: PhysicalPlan,
+                 output: List[AttributeReference]):
+        super().__init__([child])
+        self.exprs = bind_all(list(exprs), child.output)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"TpuProject[{', '.join(e.pretty() for e in self.exprs)}]"
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        names = [a.name for a in self._output]
+        op_time = self.metrics["opTime"]
+        for batch in self.children[0].execute_partition(idx, ctx):
+            with op_time.timed():
+                cols = [to_column(e.eval_tpu(batch, ctx.eval_ctx), batch, a.dtype)
+                        for e, a in zip(self.exprs, self._output)]
+                yield TpuColumnarBatch(cols, batch.num_rows, names)
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, condition: Expression, child: PhysicalPlan):
+        super().__init__([child])
+        self.condition = bind_references(condition, child.output)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"TpuFilter[{self.condition.pretty()}]"
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        op_time = self.metrics["opTime"]
+        for batch in self.children[0].execute_partition(idx, ctx):
+            with op_time.timed():
+                mask_col = to_column(self.condition.eval_tpu(batch, ctx.eval_ctx), batch)
+                mask = mask_col.data.astype(jnp.bool_)
+                if mask_col.validity is not None:
+                    mask = mask & mask_col.validity  # null predicate → drop row
+                yield compact(batch, mask)
+
+
+class TpuRangeExec(TpuExec):
+    """reference GpuRangeExec (basicPhysicalOperators.scala:1128)."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 output: List[AttributeReference], batch_rows: int = 1 << 20):
+        super().__init__([])
+        self.start, self.end, self.step = start, end, step
+        self._num_partitions = max(1, num_partitions)
+        self._output = output
+        self.batch_rows = batch_rows
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..types import LongT
+        total = max(0, -(-(self.end - self.start) // self.step))
+        base = total // self._num_partitions
+        lo = idx * base + min(idx, total % self._num_partitions)
+        cnt = base + (1 if idx < total % self._num_partitions else 0)
+        pos = 0
+        while pos < cnt or (cnt == 0 and pos == 0):
+            n = min(self.batch_rows, cnt - pos)
+            cap = bucket_capacity(max(n, 1))
+            vals = (jnp.arange(cap, dtype=jnp.int64) + (lo + pos)) * self.step + self.start
+            col = TpuColumnVector(LongT, vals, None, n)
+            yield TpuColumnarBatch([col], n, ["id"])
+            pos += max(n, 1)
+            if cnt == 0:
+                break
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: Sequence[PhysicalPlan],
+                 output: List[AttributeReference]):
+        super().__init__(list(children))
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions() for c in self.children)
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        names = [a.name for a in self._output]
+        for c in self.children:
+            n = c.num_partitions()
+            if idx < n:
+                for b in c.execute_partition(idx, ctx):
+                    yield b.rename(names)
+                return
+            idx -= n
+
+
+class TpuLocalLimitExec(TpuExec):
+    def __init__(self, n: int, child: PhysicalPlan):
+        super().__init__([child])
+        self.n = n
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        remaining = self.n
+        for b in self.children[0].execute_partition(idx, ctx):
+            if remaining <= 0:
+                break
+            if b.num_rows <= remaining:
+                remaining -= b.num_rows
+                yield b
+            else:
+                yield slice_batch(b, 0, remaining)
+                remaining = 0
+
+
+class TpuGlobalLimitExec(TpuExec):
+    def __init__(self, n: int, child: PhysicalPlan, offset: int = 0):
+        super().__init__([child])
+        self.n = n
+        self.offset = offset
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        got: List[TpuColumnarBatch] = []
+        need = self.offset + self.n
+        for p in range(self.children[0].num_partitions()):
+            for b in self.children[0].execute_partition(p, ctx):
+                got.append(b)
+                if sum(x.num_rows for x in got) >= need:
+                    break
+        if not got:
+            return
+        whole = concat_batches(got)
+        yield slice_batch(whole, self.offset, self.n)
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concatenate small batches up to a target size (reference CoalesceGoal /
+    GpuCoalesceIterator, GpuCoalesceBatches.scala:110-248,697)."""
+
+    def __init__(self, child: PhysicalPlan, goal: str = "target",
+                 target_rows: Optional[int] = None):
+        super().__init__([child])
+        self.goal = goal  # "target" | "require_single"
+        self.target_rows = target_rows
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def additional_metrics(self):
+        return {"concatTime": "MODERATE", "numInputBatches": "DEBUG"}
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        target = self.target_rows or ctx.conf.batch_size_rows
+        pending: List[TpuColumnarBatch] = []
+        rows = 0
+        concat_time = self.metrics["concatTime"]
+        n_in = self.metrics["numInputBatches"]
+        for b in self.children[0].execute_partition(idx, ctx):
+            n_in.add(1)
+            pending.append(b)
+            rows += b.num_rows
+            if self.goal != "require_single" and rows >= target:
+                with concat_time.timed():
+                    yield concat_batches(pending)
+                pending, rows = [], 0
+        if pending:
+            with concat_time.timed():
+                yield concat_batches(pending)

@@ -1,0 +1,260 @@
+"""CPU physical operators over pyarrow Tables.
+
+These stand in for Spark's CPU operators: the baseline the override layer starts
+from, the per-operator fallback target, and the parity oracle for tests
+(reference test strategy: CPU-vs-GPU equality, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..expressions.base import (Alias, AttributeReference, Expression, output_name)
+from ..plan.logical import SortOrder
+from .base import CpuExec, PhysicalPlan, TaskContext, bind_all, bind_references
+
+
+def _slice_partitions(table, n: int):
+    import pyarrow as pa
+    rows = table.num_rows
+    base = rows // n
+    out = []
+    start = 0
+    for i in range(n):
+        cnt = base + (1 if i < rows % n else 0)
+        out.append(table.slice(start, cnt))
+        start += cnt
+    return out
+
+
+class CpuLocalTableScanExec(CpuExec):
+    def __init__(self, table, num_partitions: int,
+                 output: List[AttributeReference]):
+        super().__init__([])
+        self.table = table
+        self._num_partitions = max(1, num_partitions)
+        self._output = output
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        parts = _slice_partitions(self.table, self._num_partitions)
+        t = parts[idx]
+        # stream in batches of conf batchSizeRows
+        max_rows = ctx.conf.batch_size_rows
+        for start in range(0, max(t.num_rows, 1), max_rows):
+            chunk = t.slice(start, max_rows)
+            if chunk.num_rows or t.num_rows == 0:
+                yield chunk
+            if t.num_rows == 0:
+                break
+
+
+class CpuRangeExec(CpuExec):
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 output: List[AttributeReference]):
+        super().__init__([])
+        self.start, self.end, self.step = start, end, step
+        self._num_partitions = max(1, num_partitions)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        total = max(0, -(-(self.end - self.start) // self.step))
+        base = total // self._num_partitions
+        lo = idx * base + min(idx, total % self._num_partitions)
+        cnt = base + (1 if idx < total % self._num_partitions else 0)
+        vals = self.start + (lo + np.arange(cnt, dtype=np.int64)) * self.step
+        yield pa.table({"id": pa.array(vals, pa.int64())})
+
+
+class CpuProjectExec(CpuExec):
+    def __init__(self, exprs: Sequence[Expression], child: PhysicalPlan,
+                 output: List[AttributeReference]):
+        super().__init__([child])
+        self.exprs = bind_all(list(exprs), child.output)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"CpuProject[{', '.join(e.pretty() for e in self.exprs)}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        for t in self.children[0].execute_partition(idx, ctx):
+            cols = []
+            for e, attr in zip(self.exprs, self._output):
+                r = e.eval_cpu(t, ctx.eval_ctx)
+                if not isinstance(r, (pa.Array, pa.ChunkedArray)):
+                    from ..types import to_arrow
+                    r = pa.array([r] * t.num_rows, type=to_arrow(attr.dtype))
+                cols.append(r)
+            yield pa.table(dict(zip([a.name for a in self._output], cols)))
+
+
+class CpuFilterExec(CpuExec):
+    def __init__(self, condition: Expression, child: PhysicalPlan):
+        super().__init__([child])
+        self.condition = bind_references(condition, child.output)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"CpuFilter[{self.condition.pretty()}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        for t in self.children[0].execute_partition(idx, ctx):
+            mask = self.condition.eval_cpu(t, ctx.eval_ctx)
+            mask = pc.fill_null(mask, False)
+            yield t.filter(mask)
+
+
+class CpuLocalLimitExec(CpuExec):
+    def __init__(self, n: int, child: PhysicalPlan):
+        super().__init__([child])
+        self.n = n
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        remaining = self.n
+        for t in self.children[0].execute_partition(idx, ctx):
+            if remaining <= 0:
+                break
+            out = t.slice(0, remaining)
+            remaining -= out.num_rows
+            yield out
+
+
+class CpuGlobalLimitExec(CpuExec):
+    """Single-partition global limit (planner inserts a single-partition exchange)."""
+
+    def __init__(self, n: int, child: PhysicalPlan, offset: int = 0):
+        super().__init__([child])
+        self.n = n
+        self.offset = offset
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        tables = []
+        for p in range(self.children[0].num_partitions()):
+            tables.extend(self.children[0].execute_partition(p, ctx))
+        whole = pa.concat_tables(tables) if tables else None
+        if whole is None:
+            return
+        yield whole.slice(self.offset, self.n)
+
+
+class CpuUnionExec(CpuExec):
+    def __init__(self, children: Sequence[PhysicalPlan],
+                 output: List[AttributeReference]):
+        super().__init__(list(children))
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions() for c in self.children)
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        for c in self.children:
+            n = c.num_partitions()
+            if idx < n:
+                for t in c.execute_partition(idx, ctx):
+                    yield t.rename_columns([a.name for a in self._output])
+                return
+            idx -= n
+
+
+def sort_table(table, order: List[SortOrder], ctx: TaskContext):
+    """Spark-semantic sort of an Arrow table: NULLS FIRST/LAST per order, NaN
+    sorts greater than all numbers (arrow does this natively for floats? arrow
+    places NaN after numbers and before nulls in 'ascending' — matching Spark's
+    NaN-greatest) (reference GpuSortExec/SortUtils)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    # arrow's null_placement is global while Spark's is per-key: encode each key
+    # as (null_flag, value) where the flag orders nulls to the requested side;
+    # a trailing row-index key guarantees stability.
+    sort_cols = {}
+    sort_keys = []
+    n = table.num_rows
+    for i, o in enumerate(order):
+        arr = o.child.eval_cpu(table, ctx.eval_ctx)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        is_null = pc.is_null(arr)
+        flag = pc.if_else(is_null,
+                          pa.scalar(0 if o.nulls_first else 1, pa.int8()),
+                          pa.scalar(1 if o.nulls_first else 0, pa.int8()))
+        sort_cols[f"__nf_{i}"] = flag
+        sort_keys.append((f"__nf_{i}", "ascending"))
+        sort_cols[f"__sv_{i}"] = arr
+        sort_keys.append((f"__sv_{i}", "ascending" if o.ascending else "descending"))
+    sort_cols["__row__"] = pa.array(np.arange(n, dtype=np.int64))
+    sort_keys.append(("__row__", "ascending"))
+    key_table = pa.table(sort_cols)
+    idx = pc.sort_indices(key_table, sort_keys=sort_keys,
+                          null_placement="at_end")
+    return table.take(idx)
+
+
+class CpuSortExec(CpuExec):
+    def __init__(self, order: List[SortOrder], global_sort: bool, child: PhysicalPlan):
+        super().__init__([child])
+        self.order = [SortOrder(bind_references(o.child, child.output), o.ascending,
+                                o.nulls_first) for o in order]
+        self.global_sort = global_sort
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self) -> int:
+        return 1 if self.global_sort else self.children[0].num_partitions()
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        if self.global_sort:
+            tables = []
+            for p in range(self.children[0].num_partitions()):
+                tables.extend(self.children[0].execute_partition(p, ctx))
+            if not tables:
+                return
+            whole = pa.concat_tables(tables)
+            yield sort_table(whole, self.order, ctx)
+        else:
+            for t in self.children[0].execute_partition(idx, ctx):
+                yield sort_table(t, self.order, ctx)

@@ -1,0 +1,5 @@
+"""Join execs — land in the joins milestone (next)."""
+
+
+def plan_cpu_join(plan, conf):
+    raise NotImplementedError("joins land in the next milestone")

@@ -1,0 +1,59 @@
+"""CPU↔TPU transition operators.
+
+Reference: GpuRowToColumnarExec / GpuColumnarToRowExec / HostColumnarToGpu
+(/root/reference/sql-plugin/.../GpuColumnarToRowExec.scala:129,
+HostColumnarToGpu.scala). Our host substrate is already columnar (Arrow), so the
+transitions are H→D upload and D→H download of Arrow batches; the row↔columnar
+leg of the reference collapses away.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..columnar.batch import TpuColumnarBatch
+from .base import CpuExec, PhysicalPlan, TaskContext, TpuExec
+
+
+class HostToDeviceExec(TpuExec):
+    """Upload host Arrow batches to device columns (reference GpuRowToColumnarExec
+    + HostColumnarToGpu)."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def additional_metrics(self):
+        return {"uploadTime": "MODERATE"}
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        names = [a.name for a in self.output]
+        with_time = self.metrics["uploadTime"]
+        for t in self.children[0].execute_partition(idx, ctx):
+            with with_time.timed():
+                b = TpuColumnarBatch.from_arrow(t)
+            yield b.rename(names)
+
+
+class DeviceToHostExec(CpuExec):
+    """Download device batches to host Arrow (reference GpuColumnarToRowExec)."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__([child])
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def additional_metrics(self):
+        return {"downloadTime": "MODERATE"}
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        with_time = self.metrics["downloadTime"]
+        for b in self.children[0].execute_partition(idx, ctx):
+            with with_time.timed():
+                t = b.to_arrow()
+            yield t

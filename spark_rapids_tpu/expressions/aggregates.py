@@ -1,0 +1,193 @@
+"""Aggregate function declarations (reference org/apache/spark/sql/rapids/aggregate/
+aggregateFunctions.scala, 8314 LoC incl. shims).
+
+Each aggregate declares: result dtype, the partial-state columns it produces
+(update), and how partial states merge — the same update/merge decomposition the
+reference uses (GpuAggregateFunction update/merge aggregates), which is what makes
+partial-before-shuffle / final-after-shuffle work.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..types import (BooleanT, DataType, DecimalType, DoubleT, FractionalType,
+                     IntegralType, LongT, NumericType)
+from .base import Expression, _DEFAULT_CTX
+
+
+class AggregateFunction(Expression):
+    """Declarative aggregate; evaluated by the aggregate execs, not columnar_eval."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    #: name of the device reduction for update ("sum"|"count"|"min"|"max"|...)
+    update_op: str = ""
+
+    @property
+    def dtype(self) -> DataType:
+        raise NotImplementedError
+
+    def pretty(self) -> str:
+        return f"{type(self).__name__.lower()}({', '.join(c.pretty() for c in self.children)})"
+
+    # partial-state schema: list of (suffix, dtype, reduce_op_for_merge)
+    def state_fields(self) -> List[Tuple[str, DataType, str]]:
+        raise NotImplementedError
+
+
+class Sum(AggregateFunction):
+    update_op = "sum"
+
+    @property
+    def dtype(self) -> DataType:
+        ct = self.child.dtype
+        if isinstance(ct, IntegralType):
+            return LongT
+        if isinstance(ct, DecimalType):
+            return DecimalType(min(ct.precision + 10, 38), ct.scale)
+        return DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def state_fields(self):
+        return [("sum", self.dtype, "sum"), ("nonnull", LongT, "sum")]
+
+
+class Count(AggregateFunction):
+    update_op = "count"
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def state_fields(self):
+        return [("count", LongT, "sum")]
+
+
+class Min(AggregateFunction):
+    update_op = "min"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def state_fields(self):
+        return [("min", self.dtype, "min"), ("nonnull", LongT, "sum")]
+
+
+class Max(AggregateFunction):
+    update_op = "max"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def state_fields(self):
+        return [("max", self.dtype, "max"), ("nonnull", LongT, "sum")]
+
+
+class Average(AggregateFunction):
+    update_op = "avg"
+
+    @property
+    def dtype(self) -> DataType:
+        ct = self.child.dtype
+        if isinstance(ct, DecimalType):
+            return DecimalType(min(ct.precision + 4, 38), min(ct.scale + 4, 38))
+        return DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def state_fields(self):
+        return [("sum", DoubleT, "sum"), ("count", LongT, "sum")]
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    update_op = "first"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def state_fields(self):
+        return [("first", self.dtype, "first"), ("has", BooleanT, "max")]
+
+
+class Last(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    update_op = "last"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def state_fields(self):
+        return [("last", self.dtype, "last"), ("has", BooleanT, "max")]
+
+
+class StddevBase(AggregateFunction):
+    """Welford-style via (n, sum, m2) partial state (reference M2/stddev/variance)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def state_fields(self):
+        return [("n", LongT, "sum"), ("sum", DoubleT, "sum"),
+                ("sumsq", DoubleT, "sum")]
+
+
+class StddevSamp(StddevBase):
+    update_op = "stddev_samp"
+
+
+class StddevPop(StddevBase):
+    update_op = "stddev_pop"
+
+
+class VarianceSamp(StddevBase):
+    update_op = "var_samp"
+
+
+class VariancePop(StddevBase):
+    update_op = "var_pop"
+
+
+class CountDistinct(AggregateFunction):
+    update_op = "count_distinct"
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def state_fields(self):
+        raise NotImplementedError("count distinct expands via grouped dedup")

@@ -1,0 +1,404 @@
+"""Expression-layer core: the `columnar_eval` contract, binding, null helpers.
+
+TPU re-design of the reference expression layer
+(/root/reference/sql-plugin/.../GpuExpressions.scala — trait GpuExpression:113,
+columnarEval:155; binding GpuBoundAttribute.scala). Each expression implements
+  * eval_tpu(batch, ctx)  -> TpuColumnVector | TpuScalar   (device, jax/XLA)
+  * eval_cpu(table, ctx)  -> pyarrow Array | python scalar (host fallback + parity oracle)
+The planner's tagging layer (plan/meta.py) decides per-expression which path runs,
+mirroring the reference's per-expression CPU fallback.
+
+Unlike the reference (JVM objects wrapping JNI handles), evaluation here is pure:
+expressions build jax computations over the batch's arrays; XLA fuses the whole
+projection into one program (the reference pays one kernel launch per op).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RapidsConf, default_conf
+from ..types import (BooleanT, BooleanType, DataType, DecimalType, DoubleT, LongT,
+                     NullT, NullType, StringType, numeric_promote)
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+
+
+class EvalContext:
+    """Per-task evaluation context: conf snapshot + ANSI flag."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or default_conf()
+        self.ansi = self.conf.ansi_enabled
+
+
+_DEFAULT_CTX = EvalContext()
+
+
+class ExpressionError(Exception):
+    """Runtime error raised by ANSI-mode expression failures."""
+
+
+class Expression:
+    """Base logical expression; doubles as the evaluable node (no separate
+    Catalyst-vs-Gpu split — tagging chooses the eval path instead)."""
+
+    children: Tuple["Expression", ...] = ()
+
+    @property
+    def dtype(self) -> DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def foldable(self) -> bool:
+        return bool(self.children) and all(c.foldable for c in self.children)
+
+    @property
+    def resolved(self) -> bool:
+        return all(c.resolved for c in self.children)
+
+    #: Whether a device kernel exists (tagging gate; reference: expr rule present
+    #: in GpuOverrides.commonExpressions)
+    tpu_supported = True
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        import copy
+        new = copy.copy(self)
+        new.children = tuple(children)
+        return new
+
+    # --- evaluation -------------------------------------------------------
+    def eval_tpu(self, batch, ctx: EvalContext = _DEFAULT_CTX):
+        raise NotImplementedError(f"no TPU kernel for {type(self).__name__}")
+
+    def eval_cpu(self, table, ctx: EvalContext = _DEFAULT_CTX):
+        raise NotImplementedError(f"no CPU fallback for {type(self).__name__}")
+
+    # --- utils ------------------------------------------------------------
+    def pretty(self) -> str:
+        name = type(self).__name__
+        if self.children:
+            return f"{name}({', '.join(c.pretty() for c in self.children)})"
+        return name
+
+    def transform(self, fn: Callable[["Expression"], Optional["Expression"]]) -> "Expression":
+        """Bottom-up transform (Catalyst transformUp)."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self if all(a is b for a, b in zip(new_children, self.children)) \
+            else self.with_children(new_children)
+        replaced = fn(node)
+        return replaced if replaced is not None else node
+
+    def collect(self, pred: Callable[["Expression"], bool]) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+
+@dataclass(init=False)
+class Literal(Expression):
+    value: Any
+    _dtype: DataType
+
+    def __init__(self, value: Any, dtype: Optional[DataType] = None):
+        self.children = ()
+        if dtype is None:
+            dtype = infer_literal_type(value)
+        self.value = value
+        self._dtype = dtype
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    @property
+    def foldable(self) -> bool:
+        return True
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return TpuScalar(self._dtype, self.value)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return self.value
+
+    def pretty(self) -> str:
+        return repr(self.value)
+
+
+def infer_literal_type(value: Any) -> DataType:
+    import datetime as _dt
+    import decimal as _decimal
+    from ..types import (DateT, IntegerT, StringT, TimestampT)
+    if value is None:
+        return NullT
+    if isinstance(value, bool):
+        return BooleanT
+    if isinstance(value, (int, np.integer)):
+        return IntegerT if -(2**31) <= int(value) < 2**31 else LongT
+    if isinstance(value, (float, np.floating)):
+        return DoubleT
+    if isinstance(value, str):
+        return StringT
+    if isinstance(value, _decimal.Decimal):
+        sign, digits, exp = value.as_tuple()
+        scale = max(0, -exp)
+        return DecimalType(max(len(digits), scale), scale)
+    if isinstance(value, _dt.datetime):
+        return TimestampT
+    if isinstance(value, _dt.date):
+        return DateT
+    raise TypeError(f"cannot infer literal type of {value!r}")
+
+
+@dataclass(init=False)
+class UnresolvedAttribute(Expression):
+    name: str
+
+    def __init__(self, name: str):
+        self.children = ()
+        self.name = name
+
+    @property
+    def resolved(self) -> bool:
+        return False
+
+    @property
+    def dtype(self) -> DataType:
+        raise ValueError(f"unresolved attribute {self.name}")
+
+    def pretty(self) -> str:
+        return f"'{self.name}"
+
+
+_NEXT_EXPR_ID = [0]
+
+
+def _new_expr_id() -> int:
+    _NEXT_EXPR_ID[0] += 1
+    return _NEXT_EXPR_ID[0]
+
+
+@dataclass(init=False)
+class AttributeReference(Expression):
+    """Resolved column reference. Carries a Catalyst-style unique expr_id (so
+    self-joins disambiguate) and, after binding, the ordinal of its slot in the
+    input batch (reference GpuBoundReference, GpuBoundAttribute.scala)."""
+    name: str
+    _dtype: DataType
+    _nullable: bool
+    ordinal: int
+    expr_id: int
+
+    def __init__(self, name: str, dtype: DataType, nullable: bool = True,
+                 ordinal: int = -1, expr_id: Optional[int] = None):
+        self.children = ()
+        self.name = name
+        self._dtype = dtype
+        self._nullable = nullable
+        self.ordinal = ordinal
+        self.expr_id = expr_id if expr_id is not None else _new_expr_id()
+
+    def renewed(self) -> "AttributeReference":
+        """Copy with a fresh expr_id (used when a relation is re-instantiated)."""
+        return AttributeReference(self.name, self._dtype, self._nullable)
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return batch.column(self.ordinal)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return table.column(self.ordinal).combine_chunks()
+
+    def pretty(self) -> str:
+        return self.name
+
+
+@dataclass(init=False)
+class Alias(Expression):
+    name: str
+
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return self.child.eval_tpu(batch, ctx)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return self.child.eval_cpu(table, ctx)
+
+    def pretty(self) -> str:
+        return f"{self.child.pretty()} AS {self.name}"
+
+
+def output_name(expr: Expression, default: Optional[str] = None) -> str:
+    if isinstance(expr, Alias):
+        return expr.name
+    if isinstance(expr, (AttributeReference, UnresolvedAttribute)):
+        return expr.name
+    return default if default is not None else expr.pretty()
+
+
+# ---------------------------------------------------------------------------
+# Device-eval helpers: broadcasting + null propagation
+# ---------------------------------------------------------------------------
+
+ColOrScalar = Union[TpuColumnVector, TpuScalar]
+
+
+def is_null_scalar(x: ColOrScalar) -> bool:
+    return isinstance(x, TpuScalar) and x.is_null
+
+
+def device_parts(x: ColOrScalar, capacity: int):
+    """Return (data, validity_or_None) with data broadcastable to (capacity,).
+    Fixed-width only; strings use expressions/strings.py helpers."""
+    if isinstance(x, TpuScalar):
+        if x.value is None:
+            dt = x.dtype.np_dtype or np.bool_
+            return jnp.zeros((), dt), jnp.zeros((capacity,), jnp.bool_)
+        val = x.value
+        if isinstance(x.dtype, DecimalType):
+            import decimal as _d
+            val = int(_d.Decimal(val).scaleb(x.dtype.scale))
+        return jnp.asarray(val, x.dtype.np_dtype), None
+    return x.data, x.validity
+
+
+def combine_validity(capacity: int, *vs) -> Optional[jax.Array]:
+    acc = None
+    for v in vs:
+        if v is None:
+            continue
+        acc = v if acc is None else (acc & v)
+    return acc
+
+
+def make_column(dtype: DataType, data: jax.Array, validity, num_rows: int,
+                offsets=None) -> TpuColumnVector:
+    if validity is not None:
+        # zero out null slots so downstream kernels never see garbage
+        if offsets is None:
+            data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+    return TpuColumnVector(dtype, data, validity, num_rows, offsets=offsets)
+
+
+def to_column(x: ColOrScalar, batch, dtype: Optional[DataType] = None) -> TpuColumnVector:
+    """Materialize a scalar result as a full column (used by execs)."""
+    if isinstance(x, TpuColumnVector):
+        return x
+    dt = dtype or x.dtype
+    return TpuColumnVector.from_scalar(x.value, dt, batch.num_rows,
+                                       capacity=batch.capacity)
+
+
+class BinaryExpression(Expression):
+    """Binary op with standard null propagation (null if either side null)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+    @property
+    def nullable(self) -> bool:
+        return self.left.nullable or self.right.nullable
+
+    def _compute(self, ldata, rdata, ctx: EvalContext, valid):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        if isinstance(l, TpuScalar) and isinstance(r, TpuScalar):
+            # fold on host via cpu path
+            import pyarrow as pa
+            res = self.eval_cpu(None, ctx)
+            return TpuScalar(self.dtype, res)
+        cap = batch.capacity
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        valid = combine_validity(cap, lv, rv,
+                                 row_mask(batch.num_rows, cap))
+        data = self._compute(ld, rd, ctx, valid)
+        return make_column(self.dtype, data, valid, batch.num_rows)
+
+
+class UnaryExpression(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def _compute(self, data, ctx: EvalContext, valid):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        d, v = device_parts(c, cap)
+        if isinstance(c, TpuScalar):
+            d = jnp.broadcast_to(d, (cap,))
+        valid = combine_validity(cap, v, row_mask(batch.num_rows, cap))
+        data = self._compute(d, ctx, valid)
+        return make_column(self.dtype, data, valid, batch.num_rows)
+
+
+def arrow_value(x, i=None):
+    """pyarrow scalar/array → python value helpers for CPU eval."""
+    import pyarrow as pa
+    if isinstance(x, (pa.Array, pa.ChunkedArray)):
+        return x
+    return x

@@ -1,0 +1,200 @@
+"""Conditional expressions (reference conditionalExpressions.scala: GpuIf, GpuCaseWhen,
+GpuGreatest, GpuLeast). On TPU both branches evaluate eagerly and are blended with
+jnp.where — the vectorized-engine norm (the reference does the same: cuDF computes
+both sides then copy_if_else; lazy side evaluation is a CPU-row-engine concept)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..types import DataType
+from ..columnar.vector import TpuScalar, row_mask
+from .base import (Expression, _DEFAULT_CTX, combine_validity, device_parts,
+                   make_column)
+from .predicates import nan_aware_lt
+
+
+class If(Expression):
+    def __init__(self, predicate: Expression, true_value: Expression,
+                 false_value: Expression):
+        self.children = (predicate, true_value, false_value)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[1].dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.children[1].nullable or self.children[2].nullable
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        p = self.children[0].eval_tpu(batch, ctx)
+        t = self.children[1].eval_tpu(batch, ctx)
+        f = self.children[2].eval_tpu(batch, ctx)
+        pd, pv = device_parts(p, cap)
+        cond = jnp.broadcast_to(pd, (cap,)).astype(jnp.bool_)
+        if pv is not None:
+            cond = cond & pv  # null predicate → false branch (Spark semantics)
+        td, tv = device_parts(t, cap)
+        fd, fv = device_parts(f, cap)
+        td = jnp.broadcast_to(td, (cap,))
+        fd = jnp.broadcast_to(fd, (cap,)).astype(td.dtype)
+        data = jnp.where(cond, td, fd)
+        tv = tv if tv is not None else mask
+        fv = fv if fv is not None else mask
+        valid = jnp.where(cond, tv, fv)
+        return make_column(self.dtype, data, valid & mask, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        p = self.children[0].eval_cpu(table, ctx)
+        t = self.children[1].eval_cpu(table, ctx)
+        f = self.children[2].eval_cpu(table, ctx)
+        return pc.if_else(pc.fill_null(p, False), t, f)
+
+    def pretty(self) -> str:
+        c = self.children
+        return f"if({c[0].pretty()}, {c[1].pretty()}, {c[2].pretty()})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 … ELSE ve END; branches stored as flat children:
+    (p1, v1, p2, v2, …[, else])."""
+
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for p, v in branches:
+            flat.extend((p, v))
+        if else_value is not None:
+            flat.append(else_value)
+        self.children = tuple(flat)
+        self._n_branches = len(branches)
+        self._has_else = else_value is not None
+
+    @property
+    def branches(self):
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self._n_branches)]
+
+    @property
+    def else_value(self) -> Optional[Expression]:
+        return self.children[-1] if self._has_else else None
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[1].dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        decided = jnp.zeros((cap,), jnp.bool_)
+        data = None
+        valid = jnp.zeros((cap,), jnp.bool_)
+        for pred, value in self.branches:
+            pd, pv = device_parts(pred.eval_tpu(batch, ctx), cap)
+            cond = jnp.broadcast_to(pd, (cap,)).astype(jnp.bool_)
+            if pv is not None:
+                cond = cond & pv
+            take = cond & ~decided
+            vd, vv = device_parts(value.eval_tpu(batch, ctx), cap)
+            vd = jnp.broadcast_to(vd, (cap,))
+            vv = vv if vv is not None else mask
+            if data is None:
+                data = jnp.where(take, vd, jnp.zeros((), vd.dtype))
+            else:
+                data = jnp.where(take, vd.astype(data.dtype), data)
+            valid = jnp.where(take, vv, valid)
+            decided = decided | cond
+        if self.else_value is not None:
+            ed, ev = device_parts(self.else_value.eval_tpu(batch, ctx), cap)
+            ed = jnp.broadcast_to(ed, (cap,))
+            ev = ev if ev is not None else mask
+            data = jnp.where(~decided, ed.astype(data.dtype), data)
+            valid = jnp.where(~decided, ev, valid)
+        # no else: undecided rows are null (valid stays False)
+        return make_column(self.dtype, data, valid & mask, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        result = (self.else_value.eval_cpu(table, ctx) if self.else_value is not None
+                  else pa.scalar(None, type=_arrow_type_of(self.dtype)))
+        for pred, value in reversed(self.branches):
+            p = pc.fill_null(pred.eval_cpu(table, ctx), False)
+            result = pc.if_else(p, value.eval_cpu(table, ctx), result)
+        return result
+
+    def pretty(self) -> str:
+        parts = [f"WHEN {p.pretty()} THEN {v.pretty()}" for p, v in self.branches]
+        if self.else_value is not None:
+            parts.append(f"ELSE {self.else_value.pretty()}")
+        return "CASE " + " ".join(parts) + " END"
+
+
+def _arrow_type_of(dt: DataType):
+    from ..types import to_arrow
+    return to_arrow(dt)
+
+
+class Greatest(Expression):
+    """greatest(...): max ignoring nulls; NaN greater than everything
+    (reference GpuGreatest)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _pick(self, cur, cur_v, cand, cand_v):
+        better = cand_v & (~cur_v | nan_aware_lt(cur, cand))
+        return jnp.where(better, cand, cur), cur_v | cand_v
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return _fold_minmax(self, batch, ctx, self._pick)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.max_element_wise(*[c.eval_cpu(table, ctx) for c in self.children])
+
+
+class Least(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _pick(self, cur, cur_v, cand, cand_v):
+        better = cand_v & (~cur_v | nan_aware_lt(cand, cur))
+        return jnp.where(better, cand, cur), cur_v | cand_v
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return _fold_minmax(self, batch, ctx, self._pick)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.min_element_wise(*[c.eval_cpu(table, ctx) for c in self.children])
+
+
+def _fold_minmax(expr, batch, ctx, pick):
+    cap = batch.capacity
+    mask = row_mask(batch.num_rows, cap)
+    cur = None
+    cur_v = jnp.zeros((cap,), jnp.bool_)
+    for c in expr.children:
+        d, v = device_parts(c.eval_tpu(batch, ctx), cap)
+        d = jnp.broadcast_to(d, (cap,))
+        v = v if v is not None else mask
+        if cur is None:
+            cur, cur_v = jnp.where(v, d, jnp.zeros((), d.dtype)), v
+        else:
+            cur, cur_v = pick(cur, cur_v, d.astype(cur.dtype), v)
+    return make_column(expr.dtype, cur, cur_v & mask, batch.num_rows)

@@ -1,0 +1,297 @@
+"""Hash functions — Spark-exact Murmur3 (seed 42) and xxhash64.
+
+Reference: HashFunctions.scala + spark-rapids-jni `Hash` CUDA kernels. Spark's
+hash() is Murmur3 x86_32 applied per column with the running hash as seed, nulls
+skipped. It is THE shuffle-partitioning hash (HashPartitioning), so bit-exactness
+here is what makes TPU↔CPU shuffles agree (reference GpuHashPartitioningBase).
+
+Device implementation uses uint32 arithmetic (wrapping multiplies) which XLA
+lowers to the VPU; strings hash on device when the column fits a padded byte
+matrix, host otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (BooleanType, ByteType, DataType, DateType, DoubleType,
+                     FloatType, IntegerT, IntegerType, LongType, ShortType,
+                     StringType, TimestampType)
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from .base import Expression, _DEFAULT_CTX, device_parts, make_column
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = (k1 * _C1).astype(jnp.uint32)
+    k1 = _rotl(k1, 15)
+    return (k1 * _C2).astype(jnp.uint32)
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return (h1 * np.uint32(5) + np.uint32(0xE6546B64)).astype(jnp.uint32)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = (h1 * np.uint32(0x85EBCA6B)).astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = (h1 * np.uint32(0xC2B2AE35)).astype(jnp.uint32)
+    return h1 ^ (h1 >> 16)
+
+
+def murmur3_int(values_u32, seed_u32):
+    """hashInt: one 4-byte block."""
+    h1 = _mix_h1(seed_u32, _mix_k1(values_u32))
+    return _fmix(h1, 4)
+
+
+def murmur3_long(values_i64, seed_u32):
+    """hashLong: low word then high word."""
+    lo = (values_i64 & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = ((values_i64 >> 32) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    h1 = _mix_h1(seed_u32, _mix_k1(lo))
+    h1 = _mix_h1(h1, _mix_k1(hi))
+    return _fmix(h1, 8)
+
+
+def _normalize_double(d):
+    """Spark: -0.0 → 0.0 and NaN → canonical NaN bits before hashing."""
+    d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+    canon = jnp.asarray(np.float64(np.nan), d.dtype)
+    return jnp.where(jnp.isnan(d), canon, d)
+
+
+def murmur3_col(col: TpuColumnVector, seed, capacity: int):
+    """Hash one device column, returning updated per-row seeds (uint32).
+    Null rows keep their incoming seed (Spark skips nulls)."""
+    dt = col.dtype
+    d = col.data
+    if isinstance(dt, (BooleanType,)):
+        h = murmur3_int(d.astype(jnp.uint32), seed)
+    elif isinstance(dt, (ByteType, ShortType, IntegerType, DateType)):
+        h = murmur3_int(d.astype(jnp.int32).view(jnp.uint32), seed)
+    elif isinstance(dt, (LongType, TimestampType)):
+        h = murmur3_long(d.astype(jnp.int64), seed)
+    elif isinstance(dt, FloatType):
+        f = _normalize_float(d)
+        h = murmur3_int(f.view(jnp.uint32), seed)
+    elif isinstance(dt, DoubleType):
+        f = _normalize_double(d)
+        h = murmur3_long(f.view(jnp.int64), seed)
+    elif isinstance(dt, StringType):
+        h = _murmur3_string_device(col, seed, capacity)
+    else:
+        raise NotImplementedError(f"murmur3 of {dt}")
+    if col.validity is not None:
+        h = jnp.where(col.validity, h, seed)
+    return h
+
+
+def _normalize_float(d):
+    d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+    canon = jnp.asarray(np.float32(np.nan), d.dtype)
+    return jnp.where(jnp.isnan(d), canon, d)
+
+
+def _murmur3_string_device(col: TpuColumnVector, seed, capacity: int):
+    """Spark hashUnsafeBytes: 4-byte little-endian blocks, then a *signed-byte*
+    tail loop (each remaining byte hashed via hashInt of its signed value).
+    Implemented as a padded gather: rows are processed in max_len/4 block steps.
+    Cost is O(cap * max_len) — fine for typical key strings; long-tail columns
+    should be hashed host-side (tagging prices this)."""
+    starts = col.offsets[:-1]
+    lens = (col.offsets[1:] - starts).astype(jnp.int32)
+    max_len = int(jnp.max(lens)) if col.num_rows else 0
+    nblocks = max_len // 4
+    h1 = jnp.broadcast_to(seed, (capacity,)).astype(jnp.uint32)
+    data = col.data
+    ncap = max(int(data.shape[0]) - 1, 0)
+    for b in range(nblocks):
+        base = starts + 4 * b
+        idx = jnp.clip(base[:, None] + jnp.arange(4)[None, :], 0, ncap)
+        bytes4 = jnp.take(data, idx).astype(jnp.uint32)
+        word = (bytes4[:, 0] | (bytes4[:, 1] << 8) | (bytes4[:, 2] << 16)
+                | (bytes4[:, 3] << 24))
+        active = lens >= 4 * (b + 1)
+        new_h1 = _mix_h1(h1, _mix_k1(word))
+        h1 = jnp.where(active, new_h1, h1)
+    max_tail = max_len % 4 if max_len else 0
+    # tail bytes: Spark treats each as SIGNED int, one mix per byte
+    for t in range(3):
+        pos = (lens // 4) * 4 + t
+        idx = jnp.clip(starts + pos, 0, ncap)
+        byte = jnp.take(data, idx).astype(jnp.int8)
+        signed = byte.astype(jnp.int32).view(jnp.uint32)
+        active = pos < lens
+        new_h1 = _mix_h1(h1, _mix_k1(signed))
+        h1 = jnp.where(active, new_h1, h1)
+    return _fmix_lengths(h1, lens)
+
+
+def _fmix_lengths(h1, lens):
+    h1 = h1 ^ lens.view(jnp.uint32) if lens.dtype == jnp.int32 else h1 ^ lens.astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = (h1 * np.uint32(0x85EBCA6B)).astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = (h1 * np.uint32(0xC2B2AE35)).astype(jnp.uint32)
+    return h1 ^ (h1 >> 16)
+
+
+def murmur3_batch(cols: Sequence[TpuColumnVector], num_rows: int, capacity: int,
+                  seed: int = 42):
+    """Row hash over several columns (Spark HashExpression fold)."""
+    h = jnp.full((capacity,), np.uint32(seed), jnp.uint32)
+    for c in cols:
+        h = murmur3_col(c, h, capacity)
+    return h.view(jnp.int32)
+
+
+# ---- CPU (numpy) mirror, used by the CPU plan path and tests -----------------
+
+def _np_u32(x):
+    return np.asarray(x).astype(np.uint32)
+
+
+def np_murmur3_int(v_u32, seed_u32):
+    k1 = (v_u32 * np.uint32(0xCC9E2D51)).astype(np.uint32)
+    k1 = ((k1 << np.uint32(15)) | (k1 >> np.uint32(17))).astype(np.uint32)
+    k1 = (k1 * np.uint32(0x1B873593)).astype(np.uint32)
+    h1 = (seed_u32 ^ k1).astype(np.uint32)
+    h1 = ((h1 << np.uint32(13)) | (h1 >> np.uint32(19))).astype(np.uint32)
+    h1 = (h1 * np.uint32(5) + np.uint32(0xE6546B64)).astype(np.uint32)
+    h1 ^= np.uint32(4)
+    h1 ^= h1 >> np.uint32(16)
+    h1 = (h1 * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(13)
+    h1 = (h1 * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+class Murmur3Hash(Expression):
+    """hash(...) expression returning int (reference GpuMurmur3Hash)."""
+
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import to_column
+        cap = batch.capacity
+        cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
+                for c in self.children]
+        h = murmur3_batch(cols, batch.num_rows, cap, self.seed)
+        return make_column(IntegerT, h, None, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = [c.eval_cpu(table, ctx) for c in self.children]
+        n = len(vals[0]) if isinstance(vals[0], (pa.Array, pa.ChunkedArray)) else 1
+        out = np.full(n, np.uint32(self.seed), np.uint32)
+        for c, v in zip(self.children, vals):
+            out = _np_hash_col(c.dtype, v, out)
+        return pa.array(out.view(np.int32))
+
+    def pretty(self) -> str:
+        return f"hash({', '.join(c.pretty() for c in self.children)})"
+
+
+def _np_hash_col(dt: DataType, arr, seeds: np.ndarray) -> np.ndarray:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    nulls = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False)).astype(bool)
+    if isinstance(dt, StringType):
+        out = seeds.copy()
+        for i, s in enumerate(a.to_pylist()):
+            if s is None:
+                continue
+            out[i] = _np_murmur3_bytes(s.encode(), seeds[i])
+        return out
+    vals = np.asarray(a.fill_null(0).to_numpy(zero_copy_only=False))
+    if isinstance(dt, (BooleanType,)):
+        h = np_murmur3_int(vals.astype(np.uint32), seeds)
+    elif isinstance(dt, (ByteType, ShortType, IntegerType, DateType)):
+        h = np_murmur3_int(vals.astype(np.int32).view(np.uint32), seeds)
+    elif isinstance(dt, (LongType, TimestampType)):
+        h = _np_murmur3_long(vals.astype(np.int64), seeds)
+    elif isinstance(dt, FloatType):
+        v = vals.astype(np.float32)
+        v = np.where(v == 0.0, np.float32(0.0), v)
+        v = np.where(np.isnan(v), np.float32(np.nan), v)
+        h = np_murmur3_int(v.view(np.uint32), seeds)
+    elif isinstance(dt, DoubleType):
+        v = vals.astype(np.float64)
+        v = np.where(v == 0.0, 0.0, v)
+        v = np.where(np.isnan(v), np.nan, v)
+        h = _np_murmur3_long(v.view(np.int64), seeds)
+    else:
+        raise NotImplementedError(f"cpu murmur3 of {dt}")
+    return np.where(nulls, seeds, h)
+
+
+def _np_murmur3_long(v_i64: np.ndarray, seeds: np.ndarray) -> np.ndarray:
+    lo = (v_i64 & 0xFFFFFFFF).astype(np.uint32)
+    hi = ((v_i64 >> 32) & 0xFFFFFFFF).astype(np.uint32)
+    h1 = _np_mix_h1(seeds, _np_mix_k1(lo))
+    h1 = _np_mix_h1(h1, _np_mix_k1(hi))
+    return _np_fmix(h1, np.uint32(8))
+
+
+def _np_mix_k1(k1):
+    k1 = (k1 * np.uint32(0xCC9E2D51)).astype(np.uint32)
+    k1 = ((k1 << np.uint32(15)) | (k1 >> np.uint32(17))).astype(np.uint32)
+    return (k1 * np.uint32(0x1B873593)).astype(np.uint32)
+
+
+def _np_mix_h1(h1, k1):
+    h1 = (h1 ^ k1).astype(np.uint32)
+    h1 = ((h1 << np.uint32(13)) | (h1 >> np.uint32(19))).astype(np.uint32)
+    return (h1 * np.uint32(5) + np.uint32(0xE6546B64)).astype(np.uint32)
+
+
+def _np_fmix(h1, length):
+    h1 = (h1 ^ length).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    h1 = (h1 * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(13)
+    h1 = (h1 * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+def _np_murmur3_bytes(data: bytes, seed: np.uint32) -> np.uint32:
+    """Spark hashUnsafeBytes: word blocks then per-byte signed tail.
+    uint32 wraparound is intended; numpy's overflow warnings are suppressed."""
+    with np.errstate(over="ignore"):
+        h1 = np.uint32(seed)
+        n = len(data)
+        nblocks = n // 4
+        for b in range(nblocks):
+            word = np.uint32(int.from_bytes(data[4 * b:4 * b + 4], "little"))
+            h1 = _np_mix_h1(h1, _np_mix_k1(word))
+        for t in range(nblocks * 4, n):
+            signed = np.int8(data[t] if data[t] < 128 else data[t] - 256)
+            h1 = _np_mix_h1(h1, _np_mix_k1(np.int32(signed).view(np.uint32)))
+        return _np_fmix(h1, np.uint32(n))

@@ -1,0 +1,365 @@
+"""Math expressions (reference mathExpressions.scala). Spark quirks preserved:
+log of non-positive → null (non-ANSI), floor/ceil of fp return bigint, round is
+HALF_UP (not banker's)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (DataType, DoubleT, DoubleType, FloatType, FractionalType,
+                     IntegralType, LongT)
+from ..columnar.vector import row_mask
+from .base import (Expression, UnaryExpression, _DEFAULT_CTX, combine_validity,
+                   device_parts, make_column)
+
+
+class _DoubleUnary(UnaryExpression):
+    """Unary math fn returning double."""
+    _np_fn = None
+    _jnp_fn = None
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def _compute(self, d, ctx, valid):
+        return type(self)._jnp_fn(d.astype(jnp.float64))
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        vals = np.asarray(pc.cast(_chunk(c), pa.float64()).fill_null(0.0)
+                          .to_numpy(zero_copy_only=False))
+        mask = np.asarray(pc.is_null(c).to_numpy(zero_copy_only=False)).astype(bool)
+        with np.errstate(all="ignore"):
+            out = type(self)._np_fn(vals)
+        return pa.array(out, mask=mask)
+
+    def pretty(self) -> str:
+        return f"{type(self).__name__.lower()}({self.child.pretty()})"
+
+
+def _chunk(c):
+    import pyarrow as pa
+    return c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+
+
+class Sqrt(_DoubleUnary):
+    _np_fn = staticmethod(np.sqrt)   # sqrt(-x) = NaN, matching Spark
+    _jnp_fn = staticmethod(jnp.sqrt)
+
+
+class Cbrt(_DoubleUnary):
+    _np_fn = staticmethod(np.cbrt)
+    _jnp_fn = staticmethod(jnp.cbrt)
+
+
+class Exp(_DoubleUnary):
+    _np_fn = staticmethod(np.exp)
+    _jnp_fn = staticmethod(jnp.exp)
+
+
+class Expm1(_DoubleUnary):
+    _np_fn = staticmethod(np.expm1)
+    _jnp_fn = staticmethod(jnp.expm1)
+
+
+class Sin(_DoubleUnary):
+    _np_fn = staticmethod(np.sin)
+    _jnp_fn = staticmethod(jnp.sin)
+
+
+class Cos(_DoubleUnary):
+    _np_fn = staticmethod(np.cos)
+    _jnp_fn = staticmethod(jnp.cos)
+
+
+class Tan(_DoubleUnary):
+    _np_fn = staticmethod(np.tan)
+    _jnp_fn = staticmethod(jnp.tan)
+
+
+class Asin(_DoubleUnary):
+    _np_fn = staticmethod(np.arcsin)
+    _jnp_fn = staticmethod(jnp.arcsin)
+
+
+class Acos(_DoubleUnary):
+    _np_fn = staticmethod(np.arccos)
+    _jnp_fn = staticmethod(jnp.arccos)
+
+
+class Atan(_DoubleUnary):
+    _np_fn = staticmethod(np.arctan)
+    _jnp_fn = staticmethod(jnp.arctan)
+
+
+class Sinh(_DoubleUnary):
+    _np_fn = staticmethod(np.sinh)
+    _jnp_fn = staticmethod(jnp.sinh)
+
+
+class Cosh(_DoubleUnary):
+    _np_fn = staticmethod(np.cosh)
+    _jnp_fn = staticmethod(jnp.cosh)
+
+
+class Tanh(_DoubleUnary):
+    _np_fn = staticmethod(np.tanh)
+    _jnp_fn = staticmethod(jnp.tanh)
+
+
+class _LogBase(UnaryExpression):
+    """Spark log family: non-positive input → null (non-ANSI)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    _jnp_fn = None
+    _np_fn = None
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        d, v = device_parts(c, cap)
+        d = jnp.broadcast_to(d, (cap,)).astype(jnp.float64)
+        bad = d <= 0
+        data = type(self)._jnp_fn(jnp.where(bad, 1.0, d))
+        valid = combine_validity(cap, v, ~bad, row_mask(batch.num_rows, cap))
+        return make_column(DoubleT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        vals = np.asarray(pc.cast(_chunk(c), pa.float64()).fill_null(1.0)
+                          .to_numpy(zero_copy_only=False))
+        mask = np.asarray(pc.is_null(c).to_numpy(zero_copy_only=False)).astype(bool)
+        bad = ~(vals > 0)
+        with np.errstate(all="ignore"):
+            out = type(self)._np_fn(np.where(bad, 1.0, vals))
+        return pa.array(out, mask=mask | bad)
+
+
+class Log(_LogBase):
+    _jnp_fn = staticmethod(jnp.log)
+    _np_fn = staticmethod(np.log)
+
+
+class Log10(_LogBase):
+    _jnp_fn = staticmethod(jnp.log10)
+    _np_fn = staticmethod(np.log10)
+
+
+class Log2(_LogBase):
+    _jnp_fn = staticmethod(jnp.log2)
+    _np_fn = staticmethod(np.log2)
+
+
+class Log1p(_LogBase):
+    # valid domain: x > -1
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        d, v = device_parts(c, cap)
+        d = jnp.broadcast_to(d, (cap,)).astype(jnp.float64)
+        bad = d <= -1
+        data = jnp.log1p(jnp.where(bad, 0.0, d))
+        valid = combine_validity(cap, v, ~bad, row_mask(batch.num_rows, cap))
+        return make_column(DoubleT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        vals = np.asarray(pc.cast(_chunk(c), pa.float64()).fill_null(0.0)
+                          .to_numpy(zero_copy_only=False))
+        mask = np.asarray(pc.is_null(c).to_numpy(zero_copy_only=False)).astype(bool)
+        bad = ~(vals > -1)
+        with np.errstate(all="ignore"):
+            out = np.log1p(np.where(bad, 0.0, vals))
+        return pa.array(out, mask=mask | bad)
+
+
+class Pow(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        data = jnp.power(jnp.broadcast_to(ld, (cap,)).astype(jnp.float64),
+                         jnp.broadcast_to(rd, (cap,)).astype(jnp.float64))
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        return make_column(DoubleT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.power(self.children[0].eval_cpu(table, ctx),
+                        self.children[1].eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"pow({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class Atan2(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        data = jnp.arctan2(jnp.broadcast_to(ld, (cap,)).astype(jnp.float64),
+                           jnp.broadcast_to(rd, (cap,)).astype(jnp.float64))
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        return make_column(DoubleT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        l = self.children[0].eval_cpu(table, ctx)
+        r = self.children[1].eval_cpu(table, ctx)
+        return pc.atan2(l, r)
+
+
+class Signum(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def _compute(self, d, ctx, valid):
+        return jnp.sign(d.astype(jnp.float64))
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        return pc.cast(pc.sign(c), pa.float64())
+
+
+_I64_MIN = np.int64(-2**63)
+_I64_MAX = np.int64(2**63 - 1)
+_TWO63 = np.float64(2.0**63)  # exactly representable; 2**63-1 is not
+
+
+def _java_double_to_long(d):
+    """(long) cast semantics: NaN→0, out-of-range clamps to MIN/MAX."""
+    v = jnp.where(jnp.isnan(d), 0.0, d)
+    in_range = (v > -_TWO63) & (v < _TWO63)
+    safe = jnp.where(in_range, v, 0.0).astype(jnp.int64)
+    return jnp.where(v >= _TWO63, _I64_MAX,
+                     jnp.where(v <= -_TWO63, _I64_MIN, safe))
+
+
+def _np_java_double_to_long(v):
+    v = np.where(np.isnan(v), 0.0, v)
+    in_range = (v > -_TWO63) & (v < _TWO63)
+    safe = np.where(in_range, v, 0.0).astype(np.int64)
+    return np.where(v >= _TWO63, _I64_MAX,
+                    np.where(v <= -_TWO63, _I64_MIN, safe))
+
+
+class Floor(UnaryExpression):
+    """floor(double) → bigint (Spark return type; java (long) conversion)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT if isinstance(self.child.dtype, FractionalType) else self.child.dtype
+
+    def _compute(self, d, ctx, valid):
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            return _java_double_to_long(jnp.floor(d))
+        return d
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        if pa.types.is_floating(c.type):
+            vals = np.asarray(_chunk(c).fill_null(0.0).to_numpy(zero_copy_only=False))
+            mask = np.asarray(pc.is_null(c).to_numpy(zero_copy_only=False)).astype(bool)
+            return pa.array(_np_java_double_to_long(np.floor(vals)), mask=mask)
+        return c
+
+
+class Ceil(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return LongT if isinstance(self.child.dtype, FractionalType) else self.child.dtype
+
+    def _compute(self, d, ctx, valid):
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            return _java_double_to_long(jnp.ceil(d))
+        return d
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        if pa.types.is_floating(c.type):
+            vals = np.asarray(_chunk(c).fill_null(0.0).to_numpy(zero_copy_only=False))
+            mask = np.asarray(pc.is_null(c).to_numpy(zero_copy_only=False)).astype(bool)
+            return pa.array(_np_java_double_to_long(np.ceil(vals)), mask=mask)
+        return c
+
+
+class Round(Expression):
+    """round(x, scale) HALF_UP (Spark), not banker's rounding."""
+
+    def __init__(self, child: Expression, scale: Expression):
+        self.children = (child, scale)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import Literal
+        cap = batch.capacity
+        c = self.children[0].eval_tpu(batch, ctx)
+        scale = self.children[1].value if isinstance(self.children[1], Literal) else 0
+        d, v = device_parts(c, cap)
+        d = jnp.broadcast_to(d, (cap,))
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            m = 10.0 ** scale
+            scaled = d.astype(jnp.float64) * m
+            # HALF_UP: add 0.5 away from zero then truncate
+            rounded = jnp.trunc(scaled + jnp.where(scaled >= 0, 0.5, -0.5)) / m
+            data = rounded.astype(d.dtype)
+        elif scale >= 0:
+            data = d
+        else:
+            m = np.int64(10 ** (-scale))
+            half = m // 2
+            adj = jnp.where(d >= 0, d + half, d - half)
+            data = (adj // m) * m
+        valid = combine_validity(cap, v, row_mask(batch.num_rows, cap))
+        return make_column(self.dtype, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from .base import Literal
+        c = self.children[0].eval_cpu(table, ctx)
+        scale = self.children[1].value if isinstance(self.children[1], Literal) else 0
+        return pc.round(c, ndigits=scale, round_mode="half_away_from_zero")
+
+    def pretty(self) -> str:
+        return f"round({self.children[0].pretty()}, {self.children[1].pretty()})"

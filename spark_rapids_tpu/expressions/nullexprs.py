@@ -1,0 +1,161 @@
+"""Null-handling expressions (reference org/apache/spark/sql/rapids/nullExpressions.scala)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..types import BooleanT, DataType
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from .base import (Expression, UnaryExpression, _DEFAULT_CTX, combine_validity,
+                   device_parts, make_column)
+
+
+class IsNull(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        if isinstance(c, TpuScalar):
+            data = jnp.broadcast_to(jnp.asarray(c.is_null), (cap,)) & mask
+        else:
+            data = (~c.validity if c.validity is not None
+                    else jnp.zeros((cap,), jnp.bool_)) & mask
+        return make_column(BooleanT, data, None, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.is_null(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"{self.child.pretty()} IS NULL"
+
+
+class IsNotNull(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        if isinstance(c, TpuScalar):
+            data = jnp.broadcast_to(jnp.asarray(not c.is_null), (cap,)) & mask
+        else:
+            data = (c.validity if c.validity is not None else mask) & mask
+        return make_column(BooleanT, data, None, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.is_valid(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"{self.child.pretty()} IS NOT NULL"
+
+
+class IsNaN(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        d, v = device_parts(c, cap)
+        data = jnp.isnan(jnp.broadcast_to(d, (cap,)))
+        if v is not None:
+            data = data & v
+        return make_column(BooleanT, data & row_mask(batch.num_rows, cap),
+                           None, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.fill_null(pc.is_nan(self.child.eval_cpu(table, ctx)), False)
+
+
+class Coalesce(Expression):
+    """First non-null argument (reference GpuCoalesce)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    @property
+    def nullable(self) -> bool:
+        return all(c.nullable for c in self.children)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        data = None
+        valid = jnp.zeros((cap,), jnp.bool_)
+        for c in self.children:
+            r = c.eval_tpu(batch, ctx)
+            rd, rv = device_parts(r, cap)
+            rd = jnp.broadcast_to(rd, (cap,))
+            rv = rv if rv is not None else mask
+            if data is None:
+                data, valid = rd, rv
+            else:
+                take = ~valid & rv
+                data = jnp.where(take, rd, data)
+                valid = valid | rv
+        return make_column(self.dtype, data, valid & mask, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.coalesce(*[c.eval_cpu(table, ctx) for c in self.children])
+
+    def pretty(self) -> str:
+        return f"coalesce({', '.join(c.pretty() for c in self.children)})"
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN (reference GpuNaNvl)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        a = self.children[0].eval_tpu(batch, ctx)
+        b = self.children[1].eval_tpu(batch, ctx)
+        ad, av = device_parts(a, cap)
+        bd, bv = device_parts(b, cap)
+        ad = jnp.broadcast_to(ad, (cap,))
+        isnan = jnp.isnan(ad)
+        data = jnp.where(isnan, jnp.broadcast_to(bd, (cap,)).astype(ad.dtype), ad)
+        av = av if av is not None else mask
+        bv = bv if bv is not None else mask
+        valid = jnp.where(isnan, bv, av)
+        return make_column(self.dtype, data, valid & mask, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        a = self.children[0].eval_cpu(table, ctx)
+        b = self.children[1].eval_cpu(table, ctx)
+        return pc.if_else(pc.fill_null(pc.is_nan(a), False), b, a)

@@ -1,0 +1,371 @@
+"""Comparison and boolean predicates with Spark semantics.
+
+Reference: /root/reference/sql-plugin/src/main/scala/org/apache/spark/sql/rapids/
+predicates.scala. Spark quirks preserved:
+  * NaN is equal to NaN and sorts greater than any other double (unlike IEEE);
+    the reference normalizes NaN via cuDF NaNEquality — here we branch in XLA.
+  * AND/OR use Kleene three-valued logic (false AND null = false, true OR null = true).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import BooleanT, DataType, StringType
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from .base import (BinaryExpression, EvalContext, Expression, UnaryExpression,
+                   _DEFAULT_CTX, combine_validity, device_parts, make_column)
+
+
+def _is_float(d) -> bool:
+    return jnp.issubdtype(d.dtype, jnp.floating)
+
+
+def nan_aware_eq(l, r):
+    out = l == r
+    if _is_float(l):
+        out = out | (jnp.isnan(l) & jnp.isnan(r))
+    return out
+
+
+def nan_aware_lt(l, r):
+    if _is_float(l):
+        # NaN is greatest: l < r iff (l not nan and r nan) or plain l < r
+        return (~jnp.isnan(l) & jnp.isnan(r)) | (l < r)
+    return l < r
+
+
+def nan_aware_le(l, r):
+    if _is_float(l):
+        return jnp.isnan(r) | ((~jnp.isnan(l)) & (l <= r))
+    return l <= r
+
+
+class BinaryComparison(BinaryExpression):
+    symbol = "?"
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def pretty(self) -> str:
+        return f"({self.children[0].pretty()} {self.symbol} {self.children[1].pretty()})"
+
+    def _device_cmp(self, l, r):
+        raise NotImplementedError
+
+    def _np_cmp(self, l, r):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from . import strings as _s
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        if isinstance(self.left.dtype, StringType):
+            return _s.string_compare(self, l, r, batch)
+        cap = batch.capacity
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        data = self._device_cmp(ld, rd)
+        return make_column(BooleanT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        l = self.left.eval_cpu(table, ctx)
+        r = self.right.eval_cpu(table, ctx)
+        lt = l.type if isinstance(l, (pa.Array, pa.ChunkedArray)) else None
+        if lt is not None and (pa.types.is_floating(lt)) and _has_nan(l, r):
+            return self._cpu_nan_path(l, r)
+        return self._arrow_cmp(pc, l, r)
+
+    def _cpu_nan_path(self, l, r):
+        import pyarrow as pa
+        ln, lm = _to_np(l)
+        rn, rm = _to_np(r)
+        with np.errstate(invalid="ignore"):
+            out = self._np_cmp(ln, rn)
+        return pa.array(out, mask=lm | rm)
+
+
+def _has_nan(l, r) -> bool:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    for x in (l, r):
+        if isinstance(x, (pa.Array, pa.ChunkedArray)):
+            if bool(pc.any(pc.fill_null(pc.is_nan(x), False)).as_py()):
+                return True
+        elif isinstance(x, float) and np.isnan(x):
+            return True
+    return False
+
+
+def _to_np(x):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if isinstance(x, (pa.Array, pa.ChunkedArray)):
+        arr = x.combine_chunks() if isinstance(x, pa.ChunkedArray) else x
+        mask = np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False)).astype(bool)
+        vals = np.asarray(arr.fill_null(0).to_numpy(zero_copy_only=False))
+        # restore NaNs that fill_null(0) left intact (only nulls were replaced)
+        return vals, mask
+    return np.asarray(x), np.zeros(1, dtype=bool)
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def _device_cmp(self, l, r):
+        return nan_aware_eq(l, r)
+
+    def _np_cmp(self, l, r):
+        return (l == r) | (np.isnan(l) & np.isnan(r))
+
+    def _arrow_cmp(self, pc, l, r):
+        return pc.equal(l, r)
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def _device_cmp(self, l, r):
+        return nan_aware_lt(l, r)
+
+    def _np_cmp(self, l, r):
+        return (~np.isnan(l) & np.isnan(r)) | (l < r)
+
+    def _arrow_cmp(self, pc, l, r):
+        return pc.less(l, r)
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def _device_cmp(self, l, r):
+        return nan_aware_le(l, r)
+
+    def _np_cmp(self, l, r):
+        return np.isnan(r) | (~np.isnan(l) & (l <= r))
+
+    def _arrow_cmp(self, pc, l, r):
+        return pc.less_equal(l, r)
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def _device_cmp(self, l, r):
+        return nan_aware_lt(r, l)
+
+    def _np_cmp(self, l, r):
+        return (~np.isnan(r) & np.isnan(l)) | (l > r)
+
+    def _arrow_cmp(self, pc, l, r):
+        return pc.greater(l, r)
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def _device_cmp(self, l, r):
+        return nan_aware_le(r, l)
+
+    def _np_cmp(self, l, r):
+        return np.isnan(l) | (~np.isnan(r) & (l >= r))
+
+    def _arrow_cmp(self, pc, l, r):
+        return pc.greater_equal(l, r)
+
+
+class EqualNullSafe(BinaryComparison):
+    """`<=>`: null-safe equality — never returns null."""
+    symbol = "<=>"
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from . import strings as _s
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        if isinstance(self.left.dtype, StringType):
+            eq = _s.string_compare(EqualTo(self.left, self.right), l, r, batch)
+            lv = l.validity_or_true() if isinstance(l, TpuColumnVector) else (
+                jnp.zeros((cap,), jnp.bool_) if l.is_null else mask)
+            rv = r.validity_or_true() if isinstance(r, TpuColumnVector) else (
+                jnp.zeros((cap,), jnp.bool_) if r.is_null else mask)
+            data = jnp.where(lv & rv, eq.data, lv == rv)
+            return make_column(BooleanT, data & mask | (~mask & False), None, batch.num_rows)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        lv = lv if lv is not None else mask
+        rv = rv if rv is not None else mask
+        both = lv & rv
+        data = jnp.where(both, nan_aware_eq(ld, rd), lv == rv) & mask
+        return make_column(BooleanT, data, None, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        l = self.left.eval_cpu(table, ctx)
+        r = self.right.eval_cpu(table, ctx)
+        lt = l.type if isinstance(l, (pa.Array, pa.ChunkedArray)) else None
+        if lt is not None and pa.types.is_floating(lt) and _has_nan(l, r):
+            ln, lm = _to_np(l)
+            rn, rm = _to_np(r)
+            with np.errstate(invalid="ignore"):
+                eq = (ln == rn) | (np.isnan(ln) & np.isnan(rn))
+            out = np.where(~lm & ~rm, eq, lm == rm)
+            return pa.array(out)
+        eq = pc.equal(l, r)
+        lnull = pc.is_null(l) if isinstance(l, (pa.Array, pa.ChunkedArray)) else pa.scalar(l is None)
+        rnull = pc.is_null(r) if isinstance(r, (pa.Array, pa.ChunkedArray)) else pa.scalar(r is None)
+        both_null = pc.and_(lnull, rnull)
+        return pc.if_else(pc.is_null(eq), both_null, eq)
+
+
+class And(BinaryExpression):
+    """Kleene AND (reference GpuAnd)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        lv = lv if lv is not None else mask
+        rv = rv if rv is not None else mask
+        lfalse = lv & ~ld.astype(jnp.bool_)
+        rfalse = rv & ~rd.astype(jnp.bool_)
+        valid = (lv & rv) | lfalse | rfalse
+        data = ld.astype(jnp.bool_) & rd.astype(jnp.bool_)
+        return make_column(BooleanT, data & valid, valid & mask, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.and_kleene(self.left.eval_cpu(table, ctx),
+                             self.right.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"({self.children[0].pretty()} AND {self.children[1].pretty()})"
+
+
+class Or(BinaryExpression):
+    """Kleene OR."""
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        lv = lv if lv is not None else mask
+        rv = rv if rv is not None else mask
+        ltrue = lv & ld.astype(jnp.bool_)
+        rtrue = rv & rd.astype(jnp.bool_)
+        valid = (lv & rv) | ltrue | rtrue
+        data = ltrue | rtrue
+        return make_column(BooleanT, data, valid & mask, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.or_kleene(self.left.eval_cpu(table, ctx),
+                            self.right.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"({self.children[0].pretty()} OR {self.children[1].pretty()})"
+
+
+class Not(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def _compute(self, d, ctx, valid):
+        return ~d.astype(jnp.bool_)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.invert(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"NOT {self.child.pretty()}"
+
+
+class In(Expression):
+    """`value IN (literals…)` with Spark null semantics: null value → null;
+    no match with a null in the list → null (reference GpuInSet)."""
+
+    def __init__(self, value: Expression, items: List[Expression]):
+        self.children = (value, *items)
+
+    @property
+    def value(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def items(self):
+        return self.children[1:]
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import Literal
+        v = self.value.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        vd, vv = device_parts(v, cap)
+        vv = vv if vv is not None else mask
+        has_null_item = any(isinstance(i, Literal) and i.value is None for i in self.items)
+        found = jnp.zeros((cap,), jnp.bool_)
+        for item in self.items:
+            iv = item.eval_tpu(batch, ctx)
+            if isinstance(iv, TpuScalar) and iv.is_null:
+                continue
+            idata, ivalid = device_parts(iv, cap)
+            hit = nan_aware_eq(vd, idata)
+            if ivalid is not None:
+                hit = hit & ivalid
+            found = found | hit
+        if has_null_item:
+            valid = vv & (found | jnp.zeros((cap,), jnp.bool_)) & mask
+            valid = vv & found & mask  # unmatched rows become null
+        else:
+            valid = vv & mask
+        return make_column(BooleanT, found & vv, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from .base import Literal
+        v = self.value.eval_cpu(table, ctx)
+        vals = [i.value for i in self.items if isinstance(i, Literal)]
+        has_null = any(x is None for x in vals)
+        non_null = [x for x in vals if x is not None]
+        vset = pa.array(non_null, type=v.type if isinstance(v, (pa.Array, pa.ChunkedArray)) else None)
+        found = pc.is_in(v, value_set=vset)
+        if has_null:
+            found = pc.if_else(found, True, pa.scalar(None, pa.bool_()))
+        return pc.if_else(pc.is_null(v), pa.scalar(None, pa.bool_()), found)
+
+    def pretty(self) -> str:
+        return f"{self.value.pretty()} IN ({', '.join(i.pretty() for i in self.items)})"

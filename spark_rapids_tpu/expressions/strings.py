@@ -1,0 +1,332 @@
+"""String expressions (reference stringFunctions.scala, 2433 LoC).
+
+TPU strategy (SURVEY.md §7 "Variable-width strings in XLA"): columns are Arrow
+offset+data byte arrays on device. Ops with regular access patterns (length,
+prefix/suffix tests vs a scalar, ASCII case mapping) run as XLA gathers; ragged
+column-vs-column ops run host-side via Arrow for now and are priced as
+host-assisted by the tagging layer (the reference similarly prices ops via
+incompat/typesig notes). Pallas ragged kernels are the planned upgrade path
+(kernels/strings.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import BooleanT, DataType, IntegerT, StringT
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from .base import (Expression, UnaryExpression, _DEFAULT_CTX, combine_validity,
+                   make_column)
+
+
+def _to_arrow_side(x, batch):
+    """Column-or-scalar device value → arrow array/py value (host hop)."""
+    import pyarrow as pa
+    if isinstance(x, TpuScalar):
+        return x.value
+    return x.to_arrow()
+
+
+def _bool_result_from_arrow(arr, batch):
+    import pyarrow.compute as pc
+    import pyarrow as pa
+    n = batch.num_rows
+    vals = np.asarray(pc.fill_null(arr, False).to_numpy(zero_copy_only=False)).astype(bool)
+    nulls = np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False)).astype(bool)
+    return TpuColumnVector.from_numpy(BooleanT, vals, ~nulls if nulls.any() else None,
+                                      capacity=batch.capacity)
+
+
+def _string_result_from_arrow(arr, batch):
+    col = TpuColumnVector.from_arrow(arr)
+    # align row capacity with the batch
+    if col.capacity != batch.capacity:
+        from ..columnar.batch import _repad
+        col = _repad(col, batch.capacity)
+    return col
+
+
+def string_compare(cmp_expr, l, r, batch):
+    """Lexicographic (UTF-8 byte order, matching Spark) comparison. Host-assisted."""
+    import pyarrow.compute as pc
+    la = _to_arrow_side(l, batch)
+    ra = _to_arrow_side(r, batch)
+    out = cmp_expr._arrow_cmp(pc, la, ra)
+    return _bool_result_from_arrow(out, batch)
+
+
+class Length(UnaryExpression):
+    """char_length: number of UTF-8 *characters* (not bytes), like Spark.
+    Device: count non-continuation bytes ((b & 0xC0) != 0x80) per row via a
+    segment reduction over the byte array."""
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            v = None if c.value is None else len(c.value)
+            return TpuScalar(IntegerT, v)
+        cap = batch.capacity
+        # char counts: map each byte to its row via searchsorted on offsets, then
+        # segment-sum of "is not continuation byte"
+        nbytes = c.data.shape[0]
+        is_start = ((c.data & 0xC0) != 0x80).astype(jnp.int32)
+        byte_row = jnp.searchsorted(c.offsets[1:], jnp.arange(nbytes), side="right")
+        counts = jnp.zeros((cap,), jnp.int32).at[byte_row].add(
+            is_start, mode="drop")
+        # rows past the last offset contribute to out-of-range (dropped)
+        valid = combine_validity(cap, c.validity, row_mask(batch.num_rows, cap))
+        return make_column(IntegerT, counts, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.utf8_length(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"length({self.child.pretty()})"
+
+
+class Upper(UnaryExpression):
+    """ASCII uppercase on device; full-unicode via host when non-ASCII present
+    (Spark is locale-independent unicode; reference marks case ops incompat for
+    some locales too)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(StringT, None if c.value is None else c.value.upper())
+        is_ascii = bool(jnp.all(c.data < 0x80))
+        if is_ascii:
+            lower = (c.data >= ord('a')) & (c.data <= ord('z'))
+            data = jnp.where(lower, c.data - 32, c.data)
+            return TpuColumnVector(StringT, data, c.validity, c.num_rows,
+                                   offsets=c.offsets)
+        import pyarrow.compute as pc
+        return _string_result_from_arrow(pc.utf8_upper(c.to_arrow()), batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.utf8_upper(self.child.eval_cpu(table, ctx))
+
+
+class Lower(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(StringT, None if c.value is None else c.value.lower())
+        is_ascii = bool(jnp.all(c.data < 0x80))
+        if is_ascii:
+            upper = (c.data >= ord('A')) & (c.data <= ord('Z'))
+            data = jnp.where(upper, c.data + 32, c.data)
+            return TpuColumnVector(StringT, data, c.validity, c.num_rows,
+                                   offsets=c.offsets)
+        import pyarrow.compute as pc
+        return _string_result_from_arrow(pc.utf8_lower(c.to_arrow()), batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.utf8_lower(self.child.eval_cpu(table, ctx))
+
+
+class _ScalarPatternPredicate(Expression):
+    """Base for startswith/endswith/contains against a literal pattern."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def _pattern(self, ctx):
+        from .base import Literal
+        r = self.children[1]
+        if isinstance(r, Literal):
+            return r.value
+        return None
+
+
+class StartsWith(_ScalarPatternPredicate):
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.children[0].eval_tpu(batch, ctx)
+        pat = self._pattern(ctx)
+        cap = batch.capacity
+        if isinstance(c, TpuColumnVector) and pat is not None:
+            pb = np.frombuffer(pat.encode(), dtype=np.uint8)
+            plen = len(pb)
+            starts = c.offsets[:-1]
+            lens = c.offsets[1:] - starts
+            if plen == 0:
+                data = jnp.ones((cap,), jnp.bool_)
+            else:
+                # gather a plen-wide window at each row start (clamped), compare
+                idx = jnp.clip(starts[:, None] + jnp.arange(plen)[None, :],
+                               0, max(int(c.data.shape[0]) - 1, 0))
+                window = jnp.take(c.data, idx)
+                match = jnp.all(window == jnp.asarray(pb)[None, :], axis=1)
+                data = match & (lens >= plen)
+            valid = combine_validity(cap, c.validity, row_mask(batch.num_rows, cap))
+            return make_column(BooleanT, data, valid, batch.num_rows)
+        import pyarrow.compute as pc
+        la = _to_arrow_side(c, batch)
+        ra = _to_arrow_side(self.children[1].eval_tpu(batch, ctx), batch)
+        return _bool_result_from_arrow(pc.starts_with(la, pattern=ra), batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        from .base import Literal
+        l = self.children[0].eval_cpu(table, ctx)
+        pat = self._pattern(ctx)
+        if pat is None:
+            raise NotImplementedError("startswith with non-literal pattern")
+        return pc.starts_with(l, pattern=pat)
+
+    def pretty(self) -> str:
+        return f"startswith({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class EndsWith(_ScalarPatternPredicate):
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.children[0].eval_tpu(batch, ctx)
+        pat = self._pattern(ctx)
+        cap = batch.capacity
+        if isinstance(c, TpuColumnVector) and pat is not None:
+            pb = np.frombuffer(pat.encode(), dtype=np.uint8)
+            plen = len(pb)
+            ends = c.offsets[1:]
+            lens = ends - c.offsets[:-1]
+            if plen == 0:
+                data = jnp.ones((cap,), jnp.bool_)
+            else:
+                idx = jnp.clip(ends[:, None] - plen + jnp.arange(plen)[None, :],
+                               0, max(int(c.data.shape[0]) - 1, 0))
+                window = jnp.take(c.data, idx)
+                match = jnp.all(window == jnp.asarray(pb)[None, :], axis=1)
+                data = match & (lens >= plen)
+            valid = combine_validity(cap, c.validity, row_mask(batch.num_rows, cap))
+            return make_column(BooleanT, data, valid, batch.num_rows)
+        import pyarrow.compute as pc
+        la = _to_arrow_side(c, batch)
+        ra = _to_arrow_side(self.children[1].eval_tpu(batch, ctx), batch)
+        return _bool_result_from_arrow(pc.ends_with(la, pattern=ra), batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        pat = self._pattern(ctx)
+        if pat is None:
+            raise NotImplementedError("endswith with non-literal pattern")
+        return pc.ends_with(self.children[0].eval_cpu(table, ctx), pattern=pat)
+
+
+class Contains(_ScalarPatternPredicate):
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        c = self.children[0].eval_tpu(batch, ctx)
+        pat = self._pattern(ctx)
+        la = _to_arrow_side(c, batch)
+        return _bool_result_from_arrow(pc.match_substring(la, pattern=pat), batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        pat = self._pattern(ctx)
+        if pat is None:
+            raise NotImplementedError("contains with non-literal pattern")
+        return pc.match_substring(self.children[0].eval_cpu(table, ctx), pattern=pat)
+
+
+class Substring(Expression):
+    """substring(str, pos, len) with Spark 1-based/negative-pos semantics."""
+
+    def __init__(self, child: Expression, pos: Expression, length: Expression):
+        self.children = (child, pos, length)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from .base import Literal
+        s = self.children[0].eval_cpu(table, ctx)
+        pos = self.children[1].value if isinstance(self.children[1], Literal) else None
+        ln = self.children[2].value if isinstance(self.children[2], Literal) else None
+        if pos is None or ln is None:
+            raise NotImplementedError("substring with non-literal pos/len")
+        # Spark: 1-based; pos 0 behaves like 1; negative counts from end
+        if pos > 0:
+            start = pos - 1
+        elif pos == 0:
+            start = 0
+        else:
+            start = pos  # negative: from end
+        stop = None if ln is None else (start + ln if start >= 0 else
+                                        (start + ln if start + ln < 0 else None))
+        if start >= 0:
+            return pc.utf8_slice_codeunits(s, start=start, stop=start + max(ln, 0))
+        out = pc.utf8_slice_codeunits(s, start=start,
+                                      stop=stop if stop is not None else np.iinfo(np.int32).max)
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        # host-assisted (ragged); arrow slice then re-upload
+        c = self.children[0].eval_tpu(batch, ctx)
+        import pyarrow as pa
+        arr = _to_arrow_side(c, batch)
+        out = self._cpu_on_arrow(arr, ctx)
+        return _string_result_from_arrow(out, batch)
+
+    def _cpu_on_arrow(self, arr, ctx):
+        import pyarrow.compute as pc
+        from .base import Literal
+        pos = self.children[1].value
+        ln = self.children[2].value
+        start = pos - 1 if pos > 0 else (0 if pos == 0 else pos)
+        if start >= 0:
+            return pc.utf8_slice_codeunits(arr, start=start, stop=start + max(ln, 0))
+        stop = start + ln if start + ln < 0 else np.iinfo(np.int32).max
+        return pc.utf8_slice_codeunits(arr, start=start, stop=stop)
+
+    def pretty(self) -> str:
+        c = self.children
+        return f"substring({c[0].pretty()}, {c[1].pretty()}, {c[2].pretty()})"
+
+
+class ConcatStr(Expression):
+    """concat(...) for strings: null if any input null (Spark concat semantics)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        args = [c.eval_cpu(table, ctx) for c in self.children]
+        return pc.binary_join_element_wise(*args, "",
+                                           null_handling="emit_null")
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        args = [_to_arrow_side(c.eval_tpu(batch, ctx), batch) for c in self.children]
+        out = pc.binary_join_element_wise(*args, "", null_handling="emit_null")
+        return _string_result_from_arrow(out, batch)
+
+    def pretty(self) -> str:
+        return f"concat({', '.join(c.pretty() for c in self.children)})"

@@ -1,0 +1,225 @@
+"""pyspark.sql.functions-compatible surface."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from .expressions import arithmetic as _A
+from .expressions import aggregates as _G
+from .expressions import conditional as _C
+from .expressions import hashexprs as _H
+from .expressions import mathexprs as _M
+from .expressions import nullexprs as _N
+from .expressions import predicates as _P
+from .expressions import strings as _S
+from .expressions.base import Alias, Expression, Literal, UnresolvedAttribute
+from .expressions.cast import Cast
+from .session import Column, _expr
+
+
+def col(name: str) -> Column:
+    return Column(UnresolvedAttribute(name))
+
+
+column = col
+
+
+def lit(value: Any) -> Column:
+    return Column(Literal(value))
+
+
+def expr_col(e: Expression) -> Column:
+    return Column(e)
+
+
+def alias(c, name: str) -> Column:
+    return Column(Alias(_expr(c), name))
+
+
+# --- conditional -----------------------------------------------------------
+
+class WhenBuilder(Column):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(_C.CaseWhen(branches))
+
+    def when(self, condition, value) -> "WhenBuilder":
+        return WhenBuilder(self._branches + [(_expr(condition), _expr(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(_C.CaseWhen(self._branches, _expr(value)))
+
+
+def when(condition, value) -> WhenBuilder:
+    return WhenBuilder([(_expr(condition), _expr(value))])
+
+
+def coalesce(*cols) -> Column:
+    return Column(_N.Coalesce(*[_expr(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    return Column(_N.IsNull(_expr(c)))
+
+
+def isnan(c) -> Column:
+    return Column(_N.IsNaN(_expr(c)))
+
+
+def nanvl(a, b) -> Column:
+    return Column(_N.NaNvl(_expr(a), _expr(b)))
+
+
+def greatest(*cols) -> Column:
+    return Column(_C.Greatest(*[_expr(c) for c in cols]))
+
+
+def least(*cols) -> Column:
+    return Column(_C.Least(*[_expr(c) for c in cols]))
+
+
+# --- math ------------------------------------------------------------------
+
+def _unary(cls):
+    def fn(c) -> Column:
+        e = UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+        return Column(cls(e))
+    return fn
+
+
+sqrt = _unary(_M.Sqrt)
+cbrt = _unary(_M.Cbrt)
+exp = _unary(_M.Exp)
+expm1 = _unary(_M.Expm1)
+log = _unary(_M.Log)
+log10 = _unary(_M.Log10)
+log2 = _unary(_M.Log2)
+log1p = _unary(_M.Log1p)
+sin = _unary(_M.Sin)
+cos = _unary(_M.Cos)
+tan = _unary(_M.Tan)
+asin = _unary(_M.Asin)
+acos = _unary(_M.Acos)
+atan = _unary(_M.Atan)
+sinh = _unary(_M.Sinh)
+cosh = _unary(_M.Cosh)
+tanh = _unary(_M.Tanh)
+signum = _unary(_M.Signum)
+floor = _unary(_M.Floor)
+ceil = _unary(_M.Ceil)
+ceiling = ceil
+abs = _unary(_A.Abs)  # noqa: A001 - pyspark exports `abs` too
+
+
+def pow(l, r) -> Column:  # noqa: A001
+    return Column(_M.Pow(_expr_or_col(l), _expr_or_col(r)))
+
+
+def atan2(l, r) -> Column:
+    return Column(_M.Atan2(_expr_or_col(l), _expr_or_col(r)))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(_M.Round(_expr_or_col(c), Literal(scale)))
+
+
+def pmod(l, r) -> Column:
+    return Column(_A.Pmod(_expr_or_col(l), _expr_or_col(r)))
+
+
+def negative(c) -> Column:
+    return Column(_A.UnaryMinus(_expr_or_col(c)))
+
+
+def _expr_or_col(c) -> Expression:
+    if isinstance(c, str):
+        return UnresolvedAttribute(c)
+    return _expr(c)
+
+
+# --- strings ---------------------------------------------------------------
+
+def length(c) -> Column:
+    return Column(_S.Length(_expr_or_col(c)))
+
+
+def upper(c) -> Column:
+    return Column(_S.Upper(_expr_or_col(c)))
+
+
+def lower(c) -> Column:
+    return Column(_S.Lower(_expr_or_col(c)))
+
+
+def substring(c, pos: int, length_: int) -> Column:
+    return Column(_S.Substring(_expr_or_col(c), Literal(pos), Literal(length_)))
+
+
+def concat(*cols) -> Column:
+    return Column(_S.ConcatStr(*[_expr_or_col(c) for c in cols]))
+
+
+# --- hash ------------------------------------------------------------------
+
+def hash(*cols) -> Column:  # noqa: A001
+    return Column(_H.Murmur3Hash(*[_expr_or_col(c) for c in cols]))
+
+
+# --- aggregates ------------------------------------------------------------
+
+def sum(c) -> Column:  # noqa: A001
+    return Column(_G.Sum(_expr_or_col(c)))
+
+
+def count(c) -> Column:
+    return Column(_G.Count(_expr_or_col(c) if not isinstance(c, str) or c != "*"
+                           else Literal(1)))
+
+
+def avg(c) -> Column:
+    return Column(_G.Average(_expr_or_col(c)))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(_G.Min(_expr_or_col(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(_G.Max(_expr_or_col(c)))
+
+
+def first(c, ignorenulls: bool = False) -> Column:
+    return Column(_G.First(_expr_or_col(c), ignorenulls))
+
+
+def last(c, ignorenulls: bool = False) -> Column:
+    return Column(_G.Last(_expr_or_col(c), ignorenulls))
+
+
+def stddev(c) -> Column:
+    return Column(_G.StddevSamp(_expr_or_col(c)))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    return Column(_G.StddevPop(_expr_or_col(c)))
+
+
+def variance(c) -> Column:
+    return Column(_G.VarianceSamp(_expr_or_col(c)))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    return Column(_G.VariancePop(_expr_or_col(c)))
+
+
+def count_star() -> Column:
+    return Column(_G.Count(Literal(1)))

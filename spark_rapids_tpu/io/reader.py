@@ -1,0 +1,49 @@
+"""DataFrameReader: file-format scan entry points (round-1: eager pyarrow read
+into a LocalRelation; the real multi-strategy TPU scan layer lands with io/parquet.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self._session = session
+        self._options = {}
+
+    def option(self, key, value):
+        self._options[str(key)] = value
+        return self
+
+    def parquet(self, *paths: str):
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+        from ..plan.logical import LocalRelation
+        from ..session import DataFrame
+        tables = [pq.read_table(p) for p in paths]
+        table = pa.concat_tables(tables)
+        return DataFrame(LocalRelation(table, max(1, len(paths))), self._session)
+
+    def csv(self, path: str, header: bool = None, inferSchema: bool = None, **kw):
+        import pyarrow.csv as pacsv
+        from ..plan.logical import LocalRelation
+        from ..session import DataFrame
+        header = header if header is not None else \
+            str(self._options.get("header", "false")).lower() == "true"
+        ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
+        table = pacsv.read_csv(path, read_options=ropts)
+        return DataFrame(LocalRelation(table, 1), self._session)
+
+    def json(self, path: str):
+        import pyarrow.json as pajson
+        from ..plan.logical import LocalRelation
+        from ..session import DataFrame
+        table = pajson.read_json(path)
+        return DataFrame(LocalRelation(table, 1), self._session)
+
+    def orc(self, path: str):
+        import pyarrow.orc as paorc
+        from ..plan.logical import LocalRelation
+        from ..session import DataFrame
+        table = paorc.read_table(path)
+        return DataFrame(LocalRelation(table, 1), self._session)

@@ -1,0 +1,70 @@
+"""TPU device manager: device discovery + HBM budget accounting bootstrap.
+
+Reference: GpuDeviceManager.scala (initializeGpuAndMemory:150, initializeRmm:275).
+On TPU the XLA runtime owns the physical HBM allocator, so the RMM-pool analogue
+is byte *accounting* against a budget (allocFraction × HBM) plus the spill/retry
+machinery in memory/ (SURVEY.md §2.4 TPU mapping note).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..config import HBM_ALLOC_FRACTION, RapidsConf, default_conf
+
+log = logging.getLogger("spark_rapids_tpu")
+
+# v5e has 16 GiB HBM per chip; used when the runtime doesn't report memory stats
+_DEFAULT_HBM_BYTES = 16 * 1024 ** 3
+
+
+class TpuDeviceManager:
+    _lock = threading.Lock()
+    _initialized = False
+    _device = None
+    _hbm_budget_bytes: int = 0
+
+    @classmethod
+    def initialize(cls, conf: Optional[RapidsConf] = None) -> None:
+        with cls._lock:
+            if cls._initialized:
+                return
+            conf = conf or default_conf()
+            import jax
+            devices = jax.devices()
+            cls._device = devices[0]
+            total = _DEFAULT_HBM_BYTES
+            try:
+                stats = cls._device.memory_stats()
+                if stats and "bytes_limit" in stats:
+                    total = int(stats["bytes_limit"])
+            except Exception:
+                pass
+            frac = conf.get(HBM_ALLOC_FRACTION)
+            cls._hbm_budget_bytes = int(total * frac)
+            cls._initialized = True
+            log.info("TpuDeviceManager: device=%s hbm_budget=%d bytes",
+                     cls._device, cls._hbm_budget_bytes)
+
+    @classmethod
+    def device(cls):
+        cls.initialize()
+        return cls._device
+
+    @classmethod
+    def hbm_budget_bytes(cls) -> int:
+        cls.initialize()
+        return cls._hbm_budget_bytes
+
+    @classmethod
+    def synchronize(cls) -> None:
+        """Block until outstanding device work completes (reference Cuda.deviceSynchronize)."""
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._initialized = False

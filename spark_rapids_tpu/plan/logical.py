@@ -1,0 +1,362 @@
+"""Logical plan nodes + analysis (attribute resolution, type coercion).
+
+This stands in for Spark Catalyst's analyzed logical plan: the thing our planner
+lowers to physical operators that the override layer then retargets to TPU.
+The reference plugs into Catalyst and never owns this layer; a standalone
+framework must, so this is intentionally a compact analyzer (resolution by name
+→ AttributeReference with expr_ids; Spark's implicit-cast coercion rules).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..expressions.base import (Alias, AttributeReference, Expression, Literal,
+                                UnresolvedAttribute, output_name)
+from ..expressions.cast import Cast
+from ..expressions import arithmetic as A
+from ..expressions import predicates as P
+from ..types import (BooleanT, DataType, DecimalType, DoubleT, FractionalType,
+                     IntegralType, LongT, NullType, NumericType, StringType,
+                     StructField, StructType, numeric_promote)
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        raise NotImplementedError
+
+    def schema(self) -> StructType:
+        return StructType([StructField(a.name, a.dtype, a.nullable)
+                           for a in self.output])
+
+    def resolve_name(self, name: str, case_sensitive: bool = False) -> AttributeReference:
+        matches = [a for a in self.output
+                   if (a.name == name if case_sensitive else a.name.lower() == name.lower())]
+        if not matches:
+            raise ValueError(f"cannot resolve column {name!r}; "
+                             f"available: {[a.name for a in self.output]}")
+        if len(matches) > 1:
+            raise ValueError(f"ambiguous column {name!r}")
+        return matches[0]
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.node_desc()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def node_desc(self) -> str:
+        return type(self).__name__
+
+
+class LocalRelation(LogicalPlan):
+    """In-memory Arrow table, optionally pre-split into partitions."""
+
+    def __init__(self, table, num_partitions: int = 1):
+        import pyarrow as pa
+        from ..types import from_arrow
+        self.table = table
+        self.num_partitions = num_partitions
+        self._output = [AttributeReference(f.name, from_arrow(f.type), True)
+                        for f in table.schema]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"LocalRelation[{self.table.num_rows} rows]"
+
+
+class Range(LogicalPlan):
+    """spark.range analogue (reference GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1, num_partitions: int = 1):
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self._output = [AttributeReference("id", LongT, False)]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"Range({self.start}, {self.end}, step={self.step})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
+        self.children = (child,)
+        self.exprs = [_aliased(resolve_expression(e, child)) for e in exprs]
+        self._output = [AttributeReference(output_name(e), e.dtype, e.nullable,
+                                           expr_id=_reuse_id(e))
+                        for e in self.exprs]
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"Project[{', '.join(e.pretty() for e in self.exprs)}]"
+
+
+def _reuse_id(e: Expression) -> Optional[int]:
+    """Pass-through attributes keep their expr_id so chains of projects resolve."""
+    if isinstance(e, AttributeReference):
+        return e.expr_id
+    if isinstance(e, Alias) and isinstance(e.child, AttributeReference):
+        return None
+    return None
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.children = (child,)
+        cond = resolve_expression(condition, child)
+        if not isinstance(cond.dtype, type(BooleanT)):
+            cond = Cast(cond, BooleanT)
+        self.condition = cond
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.child.output
+
+    def node_desc(self) -> str:
+        return f"Filter[{self.condition.pretty()}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan, offset: int = 0):
+        self.children = (child,)
+        self.n = n
+        self.offset = offset
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"Limit[{self.n}]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, plans: Sequence[LogicalPlan]):
+        self.children = tuple(plans)
+        first = plans[0]
+        for p in plans[1:]:
+            if len(p.output) != len(first.output):
+                raise ValueError("UNION requires same number of columns")
+        self._output = [AttributeReference(a.name, a.dtype,
+                                           any(p.output[i].nullable for p in plans))
+                        for i, a in enumerate(first.output)]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+
+class SortOrder:
+    def __init__(self, child: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.child = child
+        self.ascending = ascending
+        # Spark default: NULLS FIRST for ASC, NULLS LAST for DESC
+        self.nulls_first = nulls_first if nulls_first is not None else ascending
+
+    def pretty(self) -> str:
+        d = "ASC" if self.ascending else "DESC"
+        n = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.child.pretty()} {d} {n}"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, order: Sequence[SortOrder], global_sort: bool,
+                 child: LogicalPlan):
+        self.children = (child,)
+        self.order = [SortOrder(resolve_expression(o.child, child), o.ascending,
+                                o.nulls_first) for o in order]
+        self.global_sort = global_sort
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"Sort[{', '.join(o.pretty() for o in self.order)}]"
+
+
+class Aggregate(LogicalPlan):
+    """Group-by aggregate. agg_exprs are Alias(AggregateFunction(...)) or
+    grouping attributes."""
+
+    def __init__(self, grouping: Sequence[Expression], aggregates: Sequence[Expression],
+                 child: LogicalPlan):
+        self.children = (child,)
+        self.grouping = [resolve_expression(g, child) for g in grouping]
+        self.aggregates = [_aliased(resolve_expression(a, child)) for a in aggregates]
+        self._output = [AttributeReference(output_name(e), e.dtype, e.nullable)
+                        for e in list(self.grouping) + list(self.aggregates)]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def node_desc(self) -> str:
+        g = ", ".join(e.pretty() for e in self.grouping)
+        a = ", ".join(e.pretty() for e in self.aggregates)
+        return f"Aggregate[groupBy=({g}) agg=({a})]"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, join_type: str,
+                 left_keys: Sequence[Expression] = (),
+                 right_keys: Sequence[Expression] = (),
+                 condition: Optional[Expression] = None):
+        self.children = (left, right)
+        self.join_type = join_type.lower().replace("_", "")
+        self.left_keys = [resolve_expression(k, left) for k in left_keys]
+        self.right_keys = [resolve_expression(k, right) for k in right_keys]
+        self.condition = (resolve_expression(condition, _JoinScope(left, right))
+                          if condition is not None else None)
+
+    @property
+    def left(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def right(self) -> LogicalPlan:
+        return self.children[1]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        jt = self.join_type
+        if jt in ("inner", "cross"):
+            return self.left.output + self.right.output
+        if jt in ("leftouter", "left"):
+            return self.left.output + [_as_nullable(a) for a in self.right.output]
+        if jt in ("rightouter", "right"):
+            return [_as_nullable(a) for a in self.left.output] + self.right.output
+        if jt in ("fullouter", "outer", "full"):
+            return ([_as_nullable(a) for a in self.left.output]
+                    + [_as_nullable(a) for a in self.right.output])
+        if jt in ("leftsemi", "semi", "leftanti", "anti"):
+            return self.left.output
+        raise ValueError(f"unknown join type {self.join_type}")
+
+    def node_desc(self) -> str:
+        keys = ", ".join(f"{l.pretty()}={r.pretty()}"
+                         for l, r in zip(self.left_keys, self.right_keys))
+        return f"Join[{self.join_type}]({keys})"
+
+
+def _as_nullable(a: AttributeReference) -> AttributeReference:
+    return AttributeReference(a.name, a.dtype, True, expr_id=a.expr_id)
+
+
+class _JoinScope(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        self.children = (left, right)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output + self.children[1].output
+
+
+class Repartition(LogicalPlan):
+    """Exchange request: hash/range/round-robin/single
+    (reference GpuOverrides `parts` registry, GpuOverrides.scala:3876)."""
+
+    def __init__(self, child: LogicalPlan, num_partitions: int,
+                 partitioning: str = "roundrobin",
+                 keys: Sequence[Expression] = ()):
+        self.children = (child,)
+        self.num_partitions = num_partitions
+        self.partitioning = partitioning
+        self.keys = [resolve_expression(k, child) for k in keys]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+
+# ---------------------------------------------------------------------------
+# Resolution + Spark implicit type coercion
+# ---------------------------------------------------------------------------
+
+def _aliased(e: Expression) -> Expression:
+    if isinstance(e, (Alias, AttributeReference)):
+        return e
+    return Alias(e, output_name(e))
+
+
+def resolve_expression(expr: Expression, scope: LogicalPlan) -> Expression:
+    def rule(e: Expression):
+        if isinstance(e, UnresolvedAttribute):
+            return scope.resolve_name(e.name)
+        return None
+
+    resolved = expr.transform(rule)
+    return coerce_types(resolved)
+
+
+def coerce_types(expr: Expression) -> Expression:
+    """Insert implicit casts per Spark's binary-op coercion rules."""
+
+    def rule(e: Expression):
+        if isinstance(e, A.Divide):
+            l, r = e.children
+            lt, rt = l.dtype, r.dtype
+            if isinstance(lt, IntegralType) or isinstance(rt, IntegralType) \
+                    or lt != rt:
+                if not isinstance(lt, DecimalType) and not isinstance(rt, DecimalType):
+                    return A.Divide(_cast_if(l, DoubleT), _cast_if(r, DoubleT))
+            return None
+        if isinstance(e, (A.Add, A.Subtract, A.Multiply, A.Remainder, A.Pmod,
+                          P.EqualTo, P.EqualNullSafe, P.LessThan, P.LessThanOrEqual,
+                          P.GreaterThan, P.GreaterThanOrEqual)):
+            l, r = e.children
+            lt, rt = l.dtype, r.dtype
+            if lt == rt:
+                return None
+            common = _common_type(lt, rt)
+            if common is None:
+                return None
+            return e.with_children([_cast_if(l, common), _cast_if(r, common)])
+        return None
+
+    return expr.transform(rule)
+
+
+def _cast_if(e: Expression, to: DataType) -> Expression:
+    return e if e.dtype == to else Cast(e, to)
+
+
+def _common_type(a: DataType, b: DataType) -> Optional[DataType]:
+    from ..types import (DateT, StringT, TimestampT)
+    if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    if isinstance(a, NumericType) and isinstance(b, NumericType) \
+            and not isinstance(a, DecimalType) and not isinstance(b, DecimalType):
+        return numeric_promote(a, b)
+    if isinstance(a, StringType) and isinstance(b, NumericType):
+        return DoubleT if not isinstance(b, DecimalType) else b
+    if isinstance(b, StringType) and isinstance(a, NumericType):
+        return DoubleT if not isinstance(a, DecimalType) else a
+    if {type(a), type(b)} == {type(DateT), type(TimestampT)}:
+        return TimestampT
+    return None

@@ -1,0 +1,244 @@
+"""TpuOverrides: the plan-override engine retargeting CPU operators to TPU.
+
+Reference: GpuOverrides.scala (apply:4557, wrapAndTagPlan:4358, doConvertPlan:4364,
+applyOverrides:4685) + GpuTransitionOverrides.scala (insert transitions at
+CPU↔device boundaries). Flow:
+  CPU physical plan → wrap in PlanMeta tree → tag (reasons) → convert supported
+  subtrees to Tpu execs → insert HostToDevice/DeviceToHost at boundaries →
+  explain/fallback reporting (spark.rapids.sql.explain) and explainOnly mode.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Type
+
+from ..config import (EXPLAIN, FILTER_ENABLED, PROJECT_ENABLED, RapidsConf,
+                      SQL_ENABLED, TEST_ASSERT_ON_TPU)
+from ..execs import basic as TB
+from ..execs import cpu as CE
+from ..execs.base import CpuExec, PhysicalPlan, TpuExec
+from ..execs.transitions import DeviceToHostExec, HostToDeviceExec
+from .meta import PlanMeta
+
+log = logging.getLogger("spark_rapids_tpu")
+
+
+class ExecRule:
+    """Replacement rule for one CPU exec class (reference `exec[INPUT](...)`,
+    GpuOverrides.scala:817)."""
+
+    def __init__(self, cpu_cls: type, desc: str, conf_key: str,
+                 tag: Callable[[PlanMeta], None],
+                 convert: Callable[[PlanMeta, List[PhysicalPlan]], PhysicalPlan]):
+        self.cpu_cls = cpu_cls
+        self.desc = desc
+        self.conf_key = conf_key
+        self._tag = tag
+        self._convert = convert
+
+    def tag(self, meta: PlanMeta) -> None:
+        if not meta.conf.is_op_enabled(self.conf_key, True):
+            meta.will_not_work_on_tpu(f"disabled via {self.conf_key}")
+        self._tag(meta)
+
+    def convert(self, meta: PlanMeta, children: List[PhysicalPlan]) -> PhysicalPlan:
+        children = [ensure_device(c) for c in children]
+        return self._convert(meta, children)
+
+
+def ensure_device(plan: PhysicalPlan) -> PhysicalPlan:
+    if plan.is_tpu:
+        return plan
+    return HostToDeviceExec(plan)
+
+
+def ensure_host(plan: PhysicalPlan) -> PhysicalPlan:
+    if plan.is_tpu:
+        return DeviceToHostExec(plan)
+    return plan
+
+
+_EXEC_RULES: Dict[type, ExecRule] = {}
+
+
+def register_exec(cpu_cls: type, desc: str, conf_key: str, tag=None, convert=None):
+    _EXEC_RULES[cpu_cls] = ExecRule(cpu_cls, desc, conf_key,
+                                    tag or (lambda m: None), convert)
+
+
+def exec_rules() -> Dict[type, ExecRule]:
+    return dict(_EXEC_RULES)
+
+
+# ---------------------------------------------------------------------------
+# Built-in rules
+# ---------------------------------------------------------------------------
+
+def _tag_project(meta: PlanMeta) -> None:
+    meta.add_exprs(meta.plan.exprs)
+
+
+def _convert_project(meta: PlanMeta, children):
+    p = meta.plan
+    return TB.TpuProjectExec(p.exprs, children[0], p.output)
+
+
+def _tag_filter(meta: PlanMeta) -> None:
+    meta.add_exprs([meta.plan.condition])
+
+
+def _convert_filter(meta: PlanMeta, children):
+    return TB.TpuFilterExec(meta.plan.condition, children[0])
+
+
+def _convert_scan(meta: PlanMeta, children):
+    # local table scan stays host-side; upload happens via transition
+    raise AssertionError("scan conversion handled via transition")
+
+
+register_exec(CE.CpuProjectExec, "projection", "spark.rapids.sql.exec.ProjectExec",
+              _tag_project, _convert_project)
+register_exec(CE.CpuFilterExec, "filter", "spark.rapids.sql.exec.FilterExec",
+              _tag_filter, _convert_filter)
+register_exec(
+    CE.CpuRangeExec, "range", "spark.rapids.sql.exec.RangeExec",
+    lambda m: None,
+    lambda m, ch: TB.TpuRangeExec(m.plan.start, m.plan.end, m.plan.step,
+                                  m.plan.num_partitions(), m.plan.output))
+register_exec(
+    CE.CpuUnionExec, "union", "spark.rapids.sql.exec.UnionExec",
+    lambda m: None,
+    lambda m, ch: TB.TpuUnionExec(ch, m.plan.output))
+register_exec(
+    CE.CpuLocalLimitExec, "local limit", "spark.rapids.sql.exec.LocalLimitExec",
+    lambda m: None,
+    lambda m, ch: TB.TpuLocalLimitExec(m.plan.n, ch[0]))
+register_exec(
+    CE.CpuGlobalLimitExec, "global limit", "spark.rapids.sql.exec.GlobalLimitExec",
+    lambda m: None,
+    lambda m, ch: TB.TpuGlobalLimitExec(m.plan.n, ch[0], m.plan.offset))
+
+
+def _tag_sort(meta: PlanMeta) -> None:
+    meta.add_exprs([o.child for o in meta.plan.order])
+
+
+def _convert_sort(meta: PlanMeta, ch):
+    from ..execs.sort import TpuSortExec
+    return TpuSortExec(meta.plan.order, meta.plan.global_sort, ch[0])
+
+
+register_exec(CE.CpuSortExec, "sort", "spark.rapids.sql.exec.SortExec",
+              _tag_sort, _convert_sort)
+
+
+def _tag_aggregate(meta: PlanMeta) -> None:
+    from ..execs.aggregates import split_result_exprs
+    from ..expressions.aggregates import AggregateFunction
+    p = meta.plan
+    meta.add_exprs(p.grouping)
+    agg_fns, result_exprs = split_result_exprs(p.aggregates)
+    supported = {"sum", "count", "min", "max", "avg", "first", "last",
+                 "stddev_samp", "stddev_pop", "var_samp", "var_pop"}
+    for fn in agg_fns:
+        if fn.update_op not in supported:
+            meta.will_not_work_on_tpu(
+                f"aggregate {type(fn).__name__} is not supported on TPU")
+        for c in fn.children:
+            meta.add_exprs([c])
+    meta.add_exprs(result_exprs)
+
+
+def _convert_aggregate(meta: PlanMeta, ch):
+    from ..execs.aggregates import TpuHashAggregateExec
+    p = meta.plan
+    return TpuHashAggregateExec(p.grouping, p.aggregates, ch[0], p.output)
+
+
+from ..execs.aggregates import CpuHashAggregateExec as _CpuAgg  # noqa: E402
+
+register_exec(_CpuAgg, "hash aggregate", "spark.rapids.sql.exec.HashAggregateExec",
+              _tag_aggregate, _convert_aggregate)
+
+
+def wrap_and_tag_plan(plan: PhysicalPlan, conf: RapidsConf) -> PlanMeta:
+    """reference wrapAndTagPlan (GpuOverrides.scala:4358)."""
+    rule = _EXEC_RULES.get(type(plan))
+    meta = PlanMeta(plan, conf, rule)
+    meta.child_plans = [wrap_and_tag_plan(c, conf) for c in plan.children]
+    for cm in meta.child_plans:
+        cm.parent = meta
+    return meta
+
+
+class TpuOverrides:
+    """reference GpuOverrides.apply (GpuOverrides.scala:4557)."""
+
+    @staticmethod
+    def apply(plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
+        if not conf.get(SQL_ENABLED):
+            return plan
+        meta = wrap_and_tag_plan(plan, conf)
+        meta.tag_for_tpu()
+        explain = str(conf.get(EXPLAIN)).upper()
+        if explain in ("NOT_ON_TPU", "ALL"):
+            reasons: List[str] = []
+            meta.collect_fallback_reasons(reasons)
+            for r in reasons:
+                log.info(r)
+        if conf.explain_only:
+            reasons = []
+            meta.collect_fallback_reasons(reasons)
+            return plan  # explainOnly: report, execute on CPU
+        converted = meta.convert_if_needed()
+        return TpuTransitionOverrides.apply(converted, conf)
+
+    @staticmethod
+    def explain_plan(plan: PhysicalPlan, conf: RapidsConf) -> str:
+        """reference ExplainPlan.explainCatalystSQLPlan."""
+        meta = wrap_and_tag_plan(plan, conf)
+        meta.tag_for_tpu()
+        reasons: List[str] = []
+        meta.collect_fallback_reasons(reasons)
+        if not reasons:
+            return "The whole plan can run on the TPU"
+        return "\n".join(reasons)
+
+
+class TpuTransitionOverrides:
+    """reference GpuTransitionOverrides.scala: final boundary fixups + the
+    everything-on-TPU test assertion (assertIsOnTheGpu:616)."""
+
+    @staticmethod
+    def apply(plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
+        plan = _collapse_transitions(plan)
+        plan = ensure_host(plan)  # query output is host rows
+        if conf.get(TEST_ASSERT_ON_TPU):
+            TpuTransitionOverrides.assert_is_on_tpu(plan)
+        return plan
+
+    @staticmethod
+    def assert_is_on_tpu(plan: PhysicalPlan) -> None:
+        allowed_cpu = (DeviceToHostExec, HostToDeviceExec,
+                       CE.CpuLocalTableScanExec)
+        for node in plan.collect_nodes():
+            if isinstance(node, CpuExec) and not isinstance(node, allowed_cpu):
+                raise AssertionError(
+                    f"Part of the plan is not columnar: {node.node_desc()}\n"
+                    + plan.tree_string())
+
+
+def _collapse_transitions(plan: PhysicalPlan) -> PhysicalPlan:
+    """Remove HostToDevice(DeviceToHost(x)) → x and vice versa."""
+    new_children = [_collapse_transitions(c) for c in plan.children]
+    if isinstance(plan, HostToDeviceExec) and isinstance(new_children[0], DeviceToHostExec):
+        return new_children[0].children[0]
+    if isinstance(plan, DeviceToHostExec) and isinstance(new_children[0], HostToDeviceExec):
+        return new_children[0].children[0]
+    if all(a is b for a, b in zip(new_children, plan.children)):
+        return plan
+    import copy
+    new = copy.copy(plan)
+    new.children = new_children
+    return new

@@ -1,0 +1,50 @@
+"""Planner: analyzed logical plan → CPU physical plan.
+
+Stands in for Spark's SparkPlanner (the reference never owns this; a standalone
+framework must). The produced plan is all-CPU; TpuOverrides then retargets it,
+matching the reference's flow where Spark plans first and the plugin rewrites
+(SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..config import RapidsConf
+from ..execs import cpu as CE
+from ..execs.base import PhysicalPlan
+from . import logical as L
+
+
+def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    if isinstance(plan, L.LocalRelation):
+        return CE.CpuLocalTableScanExec(plan.table, plan.num_partitions, plan.output)
+    if isinstance(plan, L.Range):
+        return CE.CpuRangeExec(plan.start, plan.end, plan.step,
+                               plan.num_partitions, plan.output)
+    if isinstance(plan, L.Project):
+        child = plan_physical(plan.child, conf)
+        return CE.CpuProjectExec(plan.exprs, child, plan.output)
+    if isinstance(plan, L.Filter):
+        child = plan_physical(plan.child, conf)
+        return CE.CpuFilterExec(plan.condition, child)
+    if isinstance(plan, L.Limit):
+        child = plan_physical(plan.children[0], conf)
+        return CE.CpuGlobalLimitExec(plan.n, CE.CpuLocalLimitExec(plan.n, child),
+                                     plan.offset)
+    if isinstance(plan, L.Union):
+        children = [plan_physical(c, conf) for c in plan.children]
+        return CE.CpuUnionExec(children, plan.output)
+    if isinstance(plan, L.Sort):
+        child = plan_physical(plan.children[0], conf)
+        return CE.CpuSortExec(plan.order, plan.global_sort, child)
+    if isinstance(plan, L.Aggregate):
+        from ..execs.aggregates import plan_cpu_aggregate
+        return plan_cpu_aggregate(plan, conf)
+    if isinstance(plan, L.Join):
+        from ..execs.joins import plan_cpu_join
+        return plan_cpu_join(plan, conf)
+    if isinstance(plan, L.Repartition):
+        from ..shuffle.exchange import plan_cpu_exchange
+        return plan_cpu_exchange(plan, conf)
+    raise NotImplementedError(f"no physical plan for {type(plan).__name__}")

@@ -1,0 +1,113 @@
+"""Expression/exec registries + type-support matrix (reference TypeChecks.scala +
+the rule registries in GpuOverrides.scala:769-905).
+
+`register_expr` is the analogue of `expr[INPUT](...)` (GpuOverrides.scala:769):
+each registration carries the TypeSig its TPU kernel supports; the doc generator
+(docs_gen) emits docs/supported_ops.md from this table, mirroring
+SupportedOpsDocs (TypeChecks.scala:1709).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from ..types import TypeSig, TypeSigs
+
+_EXPR_RULES: Dict[type, "ExprRule"] = {}
+
+
+class ExprRule:
+    def __init__(self, cls: type, type_sig: Optional[TypeSig], desc: str,
+                 incompat: Optional[str] = None, host_assisted: bool = False):
+        self.cls = cls
+        self.type_sig = type_sig
+        self.desc = desc
+        self.incompat = incompat
+        self.host_assisted = host_assisted  # correct but runs partly on host
+
+
+def register_expr(cls: type, type_sig: Optional[TypeSig], desc: str,
+                  incompat: Optional[str] = None,
+                  host_assisted: bool = False) -> None:
+    _EXPR_RULES[cls] = ExprRule(cls, type_sig, desc, incompat, host_assisted)
+
+
+def is_expr_registered(cls: type) -> bool:
+    return cls in _EXPR_RULES
+
+
+def expr_sig_for(cls: type) -> Optional[TypeSig]:
+    r = _EXPR_RULES.get(cls)
+    return r.type_sig if r else None
+
+
+def all_expr_rules() -> Dict[type, ExprRule]:
+    return dict(_EXPR_RULES)
+
+
+def _register_builtin_exprs() -> None:
+    from ..expressions import (arithmetic as A, base as B, cast as C,
+                               conditional as CO, hashexprs as H,
+                               mathexprs as M, nullexprs as N, predicates as P,
+                               strings as S)
+    sig_num = TypeSigs.numeric
+    sig_cmp = TypeSigs.comparable
+    sig_all = TypeSigs.all_basic + TypeSigs.NULL
+
+    register_expr(B.Literal, sig_all, "literal value")
+    register_expr(B.AttributeReference, sig_all, "column reference")
+    register_expr(B.Alias, sig_all, "named expression")
+    register_expr(C.Cast, sig_all, "cast between types")
+
+    for cls in (A.Add, A.Subtract, A.Multiply):
+        register_expr(cls, sig_num, f"{cls.__name__.lower()} of numerics")
+    register_expr(A.Divide, sig_num, "fractional division")
+    register_expr(A.IntegralDivide, sig_num, "integral division")
+    register_expr(A.Remainder, sig_num, "remainder (java sign semantics)")
+    register_expr(A.Pmod, sig_num, "positive modulus")
+    register_expr(A.UnaryMinus, sig_num, "negation")
+    register_expr(A.UnaryPositive, sig_num, "unary plus")
+    register_expr(A.Abs, sig_num, "absolute value")
+
+    for cls in (P.EqualTo, P.EqualNullSafe, P.LessThan, P.LessThanOrEqual,
+                P.GreaterThan, P.GreaterThanOrEqual):
+        register_expr(cls, TypeSigs.BOOLEAN, f"comparison {cls.symbol}")
+    register_expr(P.And, TypeSigs.BOOLEAN, "logical AND (Kleene)")
+    register_expr(P.Or, TypeSigs.BOOLEAN, "logical OR (Kleene)")
+    register_expr(P.Not, TypeSigs.BOOLEAN, "logical NOT")
+    register_expr(P.In, TypeSigs.BOOLEAN, "IN (literal list)")
+
+    register_expr(N.IsNull, TypeSigs.BOOLEAN, "IS NULL")
+    register_expr(N.IsNotNull, TypeSigs.BOOLEAN, "IS NOT NULL")
+    register_expr(N.IsNaN, TypeSigs.BOOLEAN, "IS NaN")
+    register_expr(N.Coalesce, sig_cmp, "first non-null")
+    register_expr(N.NaNvl, TypeSigs.fp, "NaN replacement")
+
+    register_expr(CO.If, sig_cmp, "if/else")
+    register_expr(CO.CaseWhen, sig_cmp, "CASE WHEN")
+    register_expr(CO.Greatest, sig_cmp, "row-wise greatest")
+    register_expr(CO.Least, sig_cmp, "row-wise least")
+
+    for cls in (M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Asin,
+                M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Log, M.Log10, M.Log2,
+                M.Log1p, M.Pow, M.Atan2, M.Signum, M.Floor, M.Ceil, M.Round):
+        register_expr(cls, TypeSigs.numeric + TypeSigs.fp,
+                      f"math fn {cls.__name__.lower()}")
+
+    register_expr(H.Murmur3Hash, TypeSigs.integral, "spark murmur3 hash")
+
+    register_expr(S.Length, TypeSigs.integral, "string char length")
+    register_expr(S.Upper, TypeSigs.STRING, "uppercase",
+                  incompat="non-ASCII handled via host path")
+    register_expr(S.Lower, TypeSigs.STRING, "lowercase",
+                  incompat="non-ASCII handled via host path")
+    register_expr(S.StartsWith, TypeSigs.BOOLEAN, "prefix test")
+    register_expr(S.EndsWith, TypeSigs.BOOLEAN, "suffix test")
+    register_expr(S.Contains, TypeSigs.BOOLEAN, "substring test",
+                  host_assisted=True)
+    register_expr(S.Substring, TypeSigs.STRING, "substring", host_assisted=True)
+    register_expr(S.ConcatStr, TypeSigs.STRING, "string concat",
+                  host_assisted=True)
+
+
+_register_builtin_exprs()

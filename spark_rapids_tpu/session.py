@@ -1,0 +1,552 @@
+"""User-facing session + DataFrame API (the PySpark-shaped front door).
+
+The reference is a plugin inside Spark; a standalone framework needs its own
+entry point. The API mirrors pyspark.sql so a spark-rapids user finds the same
+surface: TpuSession.builder, createDataFrame/range/read, DataFrame
+select/filter/groupBy/join/sort/limit/union/collect, conf get/set, explain.
+Execution: logical plan → planner (CPU physical) → TpuOverrides (retarget to
+TPU + transitions) → partition-parallel execution.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+from .config import RapidsConf
+from .expressions.base import (Alias, AttributeReference, Expression, Literal,
+                               UnresolvedAttribute)
+from .plan import logical as L
+from .plan.overrides import TpuOverrides
+from .plan.planner import plan_physical
+from .execs.base import TaskContext
+
+
+class Column:
+    """Expression wrapper with pyspark.sql.Column operator surface."""
+
+    def __init__(self, expr: Expression):
+        self._expr = expr
+
+    # arithmetic
+    def __add__(self, other):
+        from .expressions.arithmetic import Add
+        return Column(Add(self._expr, _expr(other)))
+
+    def __radd__(self, other):
+        from .expressions.arithmetic import Add
+        return Column(Add(_expr(other), self._expr))
+
+    def __sub__(self, other):
+        from .expressions.arithmetic import Subtract
+        return Column(Subtract(self._expr, _expr(other)))
+
+    def __rsub__(self, other):
+        from .expressions.arithmetic import Subtract
+        return Column(Subtract(_expr(other), self._expr))
+
+    def __mul__(self, other):
+        from .expressions.arithmetic import Multiply
+        return Column(Multiply(self._expr, _expr(other)))
+
+    def __rmul__(self, other):
+        from .expressions.arithmetic import Multiply
+        return Column(Multiply(_expr(other), self._expr))
+
+    def __truediv__(self, other):
+        from .expressions.arithmetic import Divide
+        return Column(Divide(self._expr, _expr(other)))
+
+    def __rtruediv__(self, other):
+        from .expressions.arithmetic import Divide
+        return Column(Divide(_expr(other), self._expr))
+
+    def __mod__(self, other):
+        from .expressions.arithmetic import Remainder
+        return Column(Remainder(self._expr, _expr(other)))
+
+    def __neg__(self):
+        from .expressions.arithmetic import UnaryMinus
+        return Column(UnaryMinus(self._expr))
+
+    # comparisons
+    def __eq__(self, other):  # type: ignore[override]
+        from .expressions.predicates import EqualTo
+        return Column(EqualTo(self._expr, _expr(other)))
+
+    def __ne__(self, other):  # type: ignore[override]
+        from .expressions.predicates import EqualTo, Not
+        return Column(Not(EqualTo(self._expr, _expr(other))))
+
+    def __lt__(self, other):
+        from .expressions.predicates import LessThan
+        return Column(LessThan(self._expr, _expr(other)))
+
+    def __le__(self, other):
+        from .expressions.predicates import LessThanOrEqual
+        return Column(LessThanOrEqual(self._expr, _expr(other)))
+
+    def __gt__(self, other):
+        from .expressions.predicates import GreaterThan
+        return Column(GreaterThan(self._expr, _expr(other)))
+
+    def __ge__(self, other):
+        from .expressions.predicates import GreaterThanOrEqual
+        return Column(GreaterThanOrEqual(self._expr, _expr(other)))
+
+    # boolean
+    def __and__(self, other):
+        from .expressions.predicates import And
+        return Column(And(self._expr, _expr(other)))
+
+    def __or__(self, other):
+        from .expressions.predicates import Or
+        return Column(Or(self._expr, _expr(other)))
+
+    def __invert__(self):
+        from .expressions.predicates import Not
+        return Column(Not(self._expr))
+
+    # methods
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self._expr, name))
+
+    name = alias
+
+    def cast(self, to) -> "Column":
+        from .expressions.cast import Cast
+        from . import types as T
+        if isinstance(to, str):
+            to = _type_from_string(to)
+        return Column(Cast(self._expr, to))
+
+    def isNull(self) -> "Column":
+        from .expressions.nullexprs import IsNull
+        return Column(IsNull(self._expr))
+
+    def isNotNull(self) -> "Column":
+        from .expressions.nullexprs import IsNotNull
+        return Column(IsNotNull(self._expr))
+
+    def isin(self, *values) -> "Column":
+        from .expressions.predicates import In
+        items = values[0] if len(values) == 1 and isinstance(values[0], (list, tuple)) \
+            else values
+        return Column(In(self._expr, [_expr(v) for v in items]))
+
+    def startswith(self, other) -> "Column":
+        from .expressions.strings import StartsWith
+        return Column(StartsWith(self._expr, _expr(other)))
+
+    def endswith(self, other) -> "Column":
+        from .expressions.strings import EndsWith
+        return Column(EndsWith(self._expr, _expr(other)))
+
+    def contains(self, other) -> "Column":
+        from .expressions.strings import Contains
+        return Column(Contains(self._expr, _expr(other)))
+
+    def substr(self, start: int, length: int) -> "Column":
+        from .expressions.strings import Substring
+        return Column(Substring(self._expr, Literal(start), Literal(length)))
+
+    def asc(self) -> "L.SortOrder":
+        return L.SortOrder(self._expr, True)
+
+    def desc(self) -> "L.SortOrder":
+        return L.SortOrder(self._expr, False)
+
+    def asc_nulls_last(self) -> "L.SortOrder":
+        return L.SortOrder(self._expr, True, nulls_first=False)
+
+    def desc_nulls_first(self) -> "L.SortOrder":
+        return L.SortOrder(self._expr, False, nulls_first=True)
+
+    def __repr__(self) -> str:
+        return f"Column<{self._expr.pretty()}>"
+
+
+def _expr(x) -> Expression:
+    if isinstance(x, Column):
+        return x._expr
+    if isinstance(x, Expression):
+        return x
+    return Literal(x)
+
+
+def _type_from_string(s: str):
+    from . import types as T
+    m = {"boolean": T.BooleanT, "byte": T.ByteT, "tinyint": T.ByteT,
+         "short": T.ShortT, "smallint": T.ShortT, "int": T.IntegerT,
+         "integer": T.IntegerT, "long": T.LongT, "bigint": T.LongT,
+         "float": T.FloatT, "double": T.DoubleT, "string": T.StringT,
+         "binary": T.BinaryT, "date": T.DateT, "timestamp": T.TimestampT}
+    key = s.strip().lower()
+    if key in m:
+        return m[key]
+    if key.startswith("decimal"):
+        import re
+        mt = re.match(r"decimal\((\d+),\s*(\d+)\)", key)
+        if mt:
+            return T.DecimalType(int(mt.group(1)), int(mt.group(2)))
+        return T.DecimalType(10, 0)
+    raise ValueError(f"unknown type string {s!r}")
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session: "TpuSession"):
+        self._plan = plan
+        self.session = session
+
+    # --- column access ----------------------------------------------------
+    def __getitem__(self, name: str) -> Column:
+        return Column(self._plan.resolve_name(name))
+
+    def col(self, name: str) -> Column:
+        return self[name]
+
+    @property
+    def columns(self) -> List[str]:
+        return [a.name for a in self._plan.output]
+
+    @property
+    def schema(self):
+        return self._plan.schema()
+
+    # --- transformations --------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = [self._to_named(c) for c in cols]
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def _to_named(self, c) -> Expression:
+        if isinstance(c, str):
+            if c == "*":
+                raise ValueError("use select('*') via df.select(*df.columns)")
+            return UnresolvedAttribute(c)
+        return _expr(c)
+
+    def selectExpr(self, *exprs):  # minimal: attribute names only for now
+        return self.select(*exprs)
+
+    def filter(self, condition) -> "DataFrame":
+        return DataFrame(L.Filter(_expr(condition), self._plan), self.session)
+
+    where = filter
+
+    def withColumn(self, name: str, col) -> "DataFrame":
+        exprs: List[Expression] = []
+        replaced = False
+        for a in self._plan.output:
+            if a.name == name:
+                exprs.append(Alias(_expr(col), name))
+                replaced = True
+            else:
+                exprs.append(a)
+        if not replaced:
+            exprs.append(Alias(_expr(col), name))
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [Alias(a, new) if a.name == old else a for a in self._plan.output]
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [a for a in self._plan.output if a.name not in names]
+        return DataFrame(L.Project(keep, self._plan), self.session)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self._plan), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self._plan, other._plan]), self.session)
+
+    unionAll = union
+
+    def sort(self, *cols, ascending: Union[bool, List[bool], None] = None) -> "DataFrame":
+        order = []
+        for i, c in enumerate(cols):
+            if isinstance(c, L.SortOrder):
+                order.append(c)
+            else:
+                e = UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                asc = ascending[i] if isinstance(ascending, list) else (
+                    ascending if ascending is not None else True)
+                order.append(L.SortOrder(e, asc))
+        return DataFrame(L.Sort(order, True, self._plan), self.session)
+
+    orderBy = sort
+
+    def sortWithinPartitions(self, *cols) -> "DataFrame":
+        order = [c if isinstance(c, L.SortOrder)
+                 else L.SortOrder(UnresolvedAttribute(c) if isinstance(c, str) else _expr(c), True)
+                 for c in cols]
+        return DataFrame(L.Sort(order, False, self._plan), self.session)
+
+    def repartition(self, num: int, *cols) -> "DataFrame":
+        if cols:
+            keys = [UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                    for c in cols]
+            node = L.Repartition(self._plan, num, "hash", keys)
+        else:
+            node = L.Repartition(self._plan, num, "roundrobin")
+        return DataFrame(node, self.session)
+
+    def coalesce(self, num: int) -> "DataFrame":
+        return DataFrame(L.Repartition(self._plan, num, "coalesce"), self.session)
+
+    def groupBy(self, *cols) -> "GroupedData":
+        keys = [UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                for c in cols]
+        return GroupedData(self, keys)
+
+    groupby = groupBy
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+        left, right = self._plan, other._plan
+        if on is None:
+            raise ValueError("join requires `on`")
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lk = [left.resolve_name(c) for c in on]
+            rk = [right.resolve_name(c) for c in on]
+            node = L.Join(left, right, how, lk, rk)
+            df = DataFrame(node, self.session)
+            # pyspark drops the duplicate USING columns from the right side
+            if node.join_type not in ("leftsemi", "semi", "leftanti", "anti"):
+                keep = [a for a in node.output
+                        if not any(a.expr_id == r.expr_id for r in rk)]
+                return DataFrame(L.Project(keep, node), self.session)
+            return df
+        # join on a Column condition: extract equi-keys when possible
+        cond = _expr(on)
+        lk, rk, residual = _extract_equi_keys(cond, left, right)
+        node = L.Join(left, right, how, lk, rk, residual)
+        return DataFrame(node, self.session)
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Join(self._plan, other._plan, "cross"), self.session)
+
+    # --- actions ----------------------------------------------------------
+    def to_arrow(self):
+        import pyarrow as pa
+        return self.session._execute(self._plan)
+
+    toArrow = to_arrow
+
+    def collect(self) -> List[dict]:
+        return self.to_arrow().to_pylist()
+
+    def toPandas(self):
+        return self.to_arrow().to_pandas()
+
+    def count(self) -> int:
+        return self.to_arrow().num_rows
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).to_arrow().to_pandas().to_string())
+
+    def explain(self, mode: str = "formatted") -> str:
+        conf = self.session._rapids_conf()
+        cpu_plan = plan_physical(self._plan, conf)
+        final = TpuOverrides.apply(cpu_plan, conf)
+        s = final.tree_string()
+        print(s)
+        return s
+
+    def explain_fallback(self) -> str:
+        """reference ExplainPlan: report what would not run on TPU."""
+        conf = self.session._rapids_conf()
+        cpu_plan = plan_physical(self._plan, conf)
+        return TpuOverrides.explain_plan(cpu_plan, conf)
+
+
+def _extract_equi_keys(cond: Expression, left, right):
+    """Split an AND-tree of EqualTo(left_attr, right_attr) into key lists +
+    residual condition (reference GpuHashJoin key extraction)."""
+    from .expressions.predicates import And, EqualTo
+    left_ids = {a.expr_id for a in left.output}
+    right_ids = {a.expr_id for a in right.output}
+    conjuncts: List[Expression] = []
+
+    def flatten(e):
+        if isinstance(e, And):
+            flatten(e.children[0])
+            flatten(e.children[1])
+        else:
+            conjuncts.append(e)
+
+    flatten(cond)
+    lk, rk, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, EqualTo):
+            a, b = c.children
+            ids_a = {x.expr_id for x in a.collect(lambda e: isinstance(e, AttributeReference))}
+            ids_b = {x.expr_id for x in b.collect(lambda e: isinstance(e, AttributeReference))}
+            if ids_a <= left_ids and ids_b <= right_ids:
+                lk.append(a)
+                rk.append(b)
+                continue
+            if ids_a <= right_ids and ids_b <= left_ids:
+                lk.append(b)
+                rk.append(a)
+                continue
+        residual.append(c)
+    res = None
+    if residual:
+        from .expressions.predicates import And as _And
+        res = residual[0]
+        for c in residual[1:]:
+            res = _And(res, c)
+    return lk, rk, res
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Expression]):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *aggs) -> DataFrame:
+        exprs = [_expr(a) for a in aggs]
+        node = L.Aggregate(self._keys, exprs, self._df._plan)
+        return DataFrame(node, self._df.session)
+
+    def count(self) -> DataFrame:
+        from .expressions.aggregates import Count
+        from .expressions.base import Alias, Literal
+        return self.agg(Column(Alias(Count(Literal(1)), "count")))
+
+    def sum(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Sum
+        return self.agg(*[Column(Alias(Sum(UnresolvedAttribute(n)), f"sum({n})"))
+                          for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Average
+        return self.agg(*[Column(Alias(Average(UnresolvedAttribute(n)), f"avg({n})"))
+                          for n in names])
+
+    mean = avg
+
+    def min(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Min
+        return self.agg(*[Column(Alias(Min(UnresolvedAttribute(n)), f"min({n})"))
+                          for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Max
+        return self.agg(*[Column(Alias(Max(UnresolvedAttribute(n)), f"max({n})"))
+                          for n in names])
+
+
+class TpuSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, str] = {}
+
+    def config(self, key: str, value: Any) -> "TpuSessionBuilder":
+        self._conf[key] = str(value)
+        return self
+
+    def appName(self, name: str) -> "TpuSessionBuilder":
+        self._conf["spark.app.name"] = name
+        return self
+
+    def master(self, m: str) -> "TpuSessionBuilder":
+        return self
+
+    def getOrCreate(self) -> "TpuSession":
+        return TpuSession(self._conf)
+
+
+class TpuSession:
+    """The SparkSession analogue. `spark.plugins=com.nvidia.spark.SQLPlugin` ≙
+    constructing this session: it installs the override rules, device manager,
+    and shuffle env (reference Plugin.scala driver/executor init, SURVEY §3.1)."""
+
+    builder = property(lambda self: TpuSessionBuilder())
+
+    def __init__(self, conf: Optional[Dict[str, str]] = None):
+        self._settings: Dict[str, str] = dict(conf or {})
+        from .memory.device import TpuDeviceManager
+        TpuDeviceManager.initialize(self._rapids_conf())
+        self._pool: Optional[_fut.ThreadPoolExecutor] = None
+
+    # conf API
+    class _Conf:
+        def __init__(self, session: "TpuSession"):
+            self._s = session
+
+        def set(self, key: str, value: Any) -> None:
+            self._s._settings[key] = str(value)
+
+        def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+            return self._s._settings.get(key, default)
+
+        def unset(self, key: str) -> None:
+            self._s._settings.pop(key, None)
+
+    @property
+    def conf(self) -> "_Conf":
+        return TpuSession._Conf(self)
+
+    def _rapids_conf(self) -> RapidsConf:
+        return RapidsConf(self._settings)
+
+    # --- data sources -----------------------------------------------------
+    def createDataFrame(self, data, schema=None, num_partitions: int = 1) -> DataFrame:
+        import pyarrow as pa
+        if isinstance(data, pa.Table):
+            table = data
+        elif hasattr(data, "to_records") or str(type(data).__module__).startswith("pandas"):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, list) and data and isinstance(data[0], dict):
+            table = pa.Table.from_pylist(data)
+        elif isinstance(data, list) and schema is not None:
+            names = schema if isinstance(schema, list) else schema.field_names
+            cols = list(zip(*data)) if data else [[] for _ in names]
+            table = pa.table({n: list(c) for n, c in zip(names, cols)})
+        else:
+            raise TypeError(f"cannot create DataFrame from {type(data)}")
+        return DataFrame(L.LocalRelation(table, num_partitions), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              numPartitions: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.Range(start, end, step, numPartitions), self)
+
+    @property
+    def read(self):
+        from .io.reader import DataFrameReader
+        return DataFrameReader(self)
+
+    # --- execution --------------------------------------------------------
+    def _execute(self, plan: L.LogicalPlan):
+        import pyarrow as pa
+        conf = self._rapids_conf()
+        cpu_plan = plan_physical(plan, conf)
+        final = TpuOverrides.apply(cpu_plan, conf)
+        names = [a.name for a in final.output]
+        from .types import to_arrow as t2a
+        schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
+        tables = []
+        for p in range(final.num_partitions()):
+            ctx = TaskContext(p, conf)
+            for t in final.execute_partition(p, ctx):
+                if t.num_rows:
+                    tables.append(t.rename_columns(names))
+        if not tables:
+            return schema.empty_table()
+        return pa.concat_tables(tables).cast(schema)
+
+    def stop(self) -> None:
+        pass
+
+
+def get_session(**conf) -> TpuSession:
+    return TpuSession({k.replace("__", "."): str(v) for k, v in conf.items()})

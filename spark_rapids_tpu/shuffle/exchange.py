@@ -1,0 +1,5 @@
+"""Shuffle exchange — lands with the shuffle milestone."""
+
+
+def plan_cpu_exchange(plan, conf):
+    raise NotImplementedError("exchange lands with the shuffle milestone")

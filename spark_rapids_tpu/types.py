@@ -1,0 +1,478 @@
+"""Spark-compatible data-type system + per-operator type-support signatures.
+
+TPU re-design of the reference's type layer:
+  * DataType hierarchy mirrors Spark SQL types (the surface `TypeChecks.scala` gates).
+  * `TypeSig` is the reference's static type-support matrix
+    (/root/reference/sql-plugin/.../TypeChecks.scala:543) — a set of types an
+    operator/expression supports on the accelerator, with notes for partial support.
+On TPU the physical carriers differ from cuDF: fixed-width types map to jax dtypes,
+strings/binary to Arrow offset+data buffers, decimals <=18 digits to scaled int64
+(decimal128 falls back to host), dates to int32 days, timestamps to int64 micros.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+
+class DataType:
+    """Base of the Spark-mirroring logical type hierarchy."""
+
+    #: numpy dtype of the device carrier, or None when not fixed-width
+    np_dtype: Optional[np.dtype] = None
+
+    def simple_string(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return self.simple_string()
+
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self).__name__)
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, NumericType)
+
+    @property
+    def default_size(self) -> int:
+        return self.np_dtype.itemsize if self.np_dtype is not None else 8
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.bool_)  # carrier irrelevant; all rows null
+
+    def simple_string(self) -> str:
+        return "void"
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class ByteType(IntegralType):
+    np_dtype = np.dtype(np.int8)
+
+    def simple_string(self) -> str:
+        return "tinyint"
+
+
+class ShortType(IntegralType):
+    np_dtype = np.dtype(np.int16)
+
+    def simple_string(self) -> str:
+        return "smallint"
+
+
+class IntegerType(IntegralType):
+    np_dtype = np.dtype(np.int32)
+
+    def simple_string(self) -> str:
+        return "int"
+
+
+class LongType(IntegralType):
+    np_dtype = np.dtype(np.int64)
+
+    def simple_string(self) -> str:
+        return "bigint"
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class FloatType(FractionalType):
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(FractionalType):
+    np_dtype = np.dtype(np.float64)
+
+
+@dataclass(frozen=True, eq=False)
+class DecimalType(FractionalType):
+    """Decimal(precision, scale). Precision<=18 carried as scaled int64 on device
+    (reference carries <=38 via cuDF 128-bit, spark-rapids-jni DecimalUtils)."""
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 38
+    MAX_DEVICE_PRECISION = 18  # int64-scaled carrier
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        return np.dtype(np.int64) if self.precision <= self.MAX_DEVICE_PRECISION else None
+
+    def simple_string(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, DecimalType) and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __hash__(self) -> int:
+        return hash(("decimal", self.precision, self.scale))
+
+
+class StringType(DataType):
+    np_dtype = None  # Arrow offsets(int32/int64) + uint8 data on device
+
+
+class BinaryType(DataType):
+    np_dtype = None
+
+
+class DateType(DataType):
+    np_dtype = np.dtype(np.int32)  # days since epoch (Spark internal repr)
+
+
+class TimestampType(DataType):
+    np_dtype = np.dtype(np.int64)  # microseconds since epoch UTC
+
+    def simple_string(self) -> str:
+        return "timestamp"
+
+
+class CalendarIntervalType(DataType):
+    np_dtype = None
+
+
+@dataclass(eq=False)
+class ArrayType(DataType):
+    element_type: DataType = field(default_factory=lambda: NullType())
+    contains_null: bool = True
+    np_dtype = None
+
+    def simple_string(self) -> str:
+        return f"array<{self.element_type.simple_string()}>"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ArrayType) and other.element_type == self.element_type
+
+    def __hash__(self) -> int:
+        return hash(("array", self.element_type))
+
+
+@dataclass(eq=False)
+class MapType(DataType):
+    key_type: DataType = field(default_factory=lambda: NullType())
+    value_type: DataType = field(default_factory=lambda: NullType())
+    value_contains_null: bool = True
+    np_dtype = None
+
+    def simple_string(self) -> str:
+        return f"map<{self.key_type.simple_string()},{self.value_type.simple_string()}>"
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, MapType) and other.key_type == self.key_type
+                and other.value_type == self.value_type)
+
+    def __hash__(self) -> int:
+        return hash(("map", self.key_type, self.value_type))
+
+
+@dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+@dataclass(eq=False)
+class StructType(DataType):
+    fields: Tuple[StructField, ...] = ()
+    np_dtype = None
+
+    def __init__(self, fields: Iterable[StructField] = ()):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def field_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def simple_string(self) -> str:
+        inner = ",".join(f"{f.name}:{f.data_type.simple_string()}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self) -> int:
+        return hash(("struct", self.fields))
+
+
+# Singletons (Spark convention)
+NullT = NullType()
+BooleanT = BooleanType()
+ByteT = ByteType()
+ShortT = ShortType()
+IntegerT = IntegerType()
+LongT = LongType()
+FloatT = FloatType()
+DoubleT = DoubleType()
+StringT = StringType()
+BinaryT = BinaryType()
+DateT = DateType()
+TimestampT = TimestampType()
+
+
+def is_fixed_width(dt: DataType) -> bool:
+    return dt.np_dtype is not None and not isinstance(dt, NullType)
+
+
+INTEGRAL_TYPES: Tuple[DataType, ...] = (ByteT, ShortT, IntegerT, LongT)
+FRACTIONAL_TYPES: Tuple[DataType, ...] = (FloatT, DoubleT)
+NUMERIC_TYPES: Tuple[DataType, ...] = INTEGRAL_TYPES + FRACTIONAL_TYPES
+
+
+def numeric_promote(a: DataType, b: DataType) -> DataType:
+    """Spark's binary-arithmetic common type for non-decimal numerics."""
+    order = [ByteT, ShortT, IntegerT, LongT, FloatT, DoubleT]
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        raise ValueError("decimal promotion handled by decimal rules")
+    ia, ib = order.index(a), order.index(b)
+    hi = order[max(ia, ib)]
+    # long (op) float => double in Spark? Spark: long+float -> float. Keep simple widening.
+    return hi
+
+
+# ---------------------------------------------------------------------------
+# TypeSig: the can-this-run-on-TPU matrix (reference TypeChecks.scala:543)
+# ---------------------------------------------------------------------------
+
+class TypeEnum:
+    BOOLEAN = "BOOLEAN"
+    BYTE = "BYTE"
+    SHORT = "SHORT"
+    INT = "INT"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    DATE = "DATE"
+    TIMESTAMP = "TIMESTAMP"
+    STRING = "STRING"
+    BINARY = "BINARY"
+    DECIMAL_64 = "DECIMAL_64"
+    DECIMAL_128 = "DECIMAL_128"
+    NULL = "NULL"
+    ARRAY = "ARRAY"
+    MAP = "MAP"
+    STRUCT = "STRUCT"
+    CALENDAR = "CALENDAR"
+    UDT = "UDT"
+
+    ALL = (BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, DATE, TIMESTAMP, STRING,
+           BINARY, DECIMAL_64, DECIMAL_128, NULL, ARRAY, MAP, STRUCT, CALENDAR, UDT)
+
+
+def _type_enum_of(dt: DataType) -> str:
+    if isinstance(dt, BooleanType):
+        return TypeEnum.BOOLEAN
+    if isinstance(dt, ByteType):
+        return TypeEnum.BYTE
+    if isinstance(dt, ShortType):
+        return TypeEnum.SHORT
+    if isinstance(dt, IntegerType):
+        return TypeEnum.INT
+    if isinstance(dt, LongType):
+        return TypeEnum.LONG
+    if isinstance(dt, FloatType):
+        return TypeEnum.FLOAT
+    if isinstance(dt, DoubleType):
+        return TypeEnum.DOUBLE
+    if isinstance(dt, DateType):
+        return TypeEnum.DATE
+    if isinstance(dt, TimestampType):
+        return TypeEnum.TIMESTAMP
+    if isinstance(dt, StringType):
+        return TypeEnum.STRING
+    if isinstance(dt, BinaryType):
+        return TypeEnum.BINARY
+    if isinstance(dt, DecimalType):
+        return (TypeEnum.DECIMAL_64 if dt.precision <= DecimalType.MAX_DEVICE_PRECISION
+                else TypeEnum.DECIMAL_128)
+    if isinstance(dt, NullType):
+        return TypeEnum.NULL
+    if isinstance(dt, ArrayType):
+        return TypeEnum.ARRAY
+    if isinstance(dt, MapType):
+        return TypeEnum.MAP
+    if isinstance(dt, StructType):
+        return TypeEnum.STRUCT
+    if isinstance(dt, CalendarIntervalType):
+        return TypeEnum.CALENDAR
+    return TypeEnum.UDT
+
+
+class TypeSig:
+    """A set of supported `TypeEnum`s, with per-type notes and nested-type scoping.
+
+    Reference: TypeSig (TypeChecks.scala:543) with combinators `+`, `withPsNote`,
+    `nested`. `check(dt)` returns None when supported or a human-readable reason.
+    """
+
+    def __init__(self, initial: Iterable[str] = (), child: Optional["TypeSig"] = None,
+                 notes: Optional[Dict[str, str]] = None):
+        self.types = frozenset(initial)
+        self.child = child
+        self.notes = dict(notes or {})
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        notes = dict(self.notes)
+        notes.update(other.notes)
+        child = self.child or other.child
+        return TypeSig(self.types | other.types, child, notes)
+
+    def with_ps_note(self, type_enum: str, note: str) -> "TypeSig":
+        notes = dict(self.notes)
+        notes[type_enum] = note
+        return TypeSig(self.types, self.child, notes)
+
+    def nested(self, child: Optional["TypeSig"] = None) -> "TypeSig":
+        return TypeSig(self.types, child if child is not None else self, self.notes)
+
+    def supports(self, dt: DataType) -> bool:
+        return self.check(dt) is None
+
+    def check(self, dt: DataType) -> Optional[str]:
+        te = _type_enum_of(dt)
+        if te not in self.types:
+            return f"{dt.simple_string()} is not supported"
+        inner = self.child or self
+        if isinstance(dt, ArrayType):
+            r = inner.check(dt.element_type)
+            if r:
+                return f"array element: {r}"
+        elif isinstance(dt, MapType):
+            r = inner.check(dt.key_type) or inner.check(dt.value_type)
+            if r:
+                return f"map entry: {r}"
+        elif isinstance(dt, StructType):
+            for f in dt.fields:
+                r = inner.check(f.data_type)
+                if r:
+                    return f"struct field {f.name}: {r}"
+        return None
+
+
+def _sig(*types: str) -> TypeSig:
+    return TypeSig(types)
+
+
+class TypeSigs:
+    """Standard signatures, mirroring reference TypeSig companion object."""
+    none = _sig()
+    BOOLEAN = _sig(TypeEnum.BOOLEAN)
+    integral = _sig(TypeEnum.BYTE, TypeEnum.SHORT, TypeEnum.INT, TypeEnum.LONG)
+    fp = _sig(TypeEnum.FLOAT, TypeEnum.DOUBLE)
+    DECIMAL_64 = _sig(TypeEnum.DECIMAL_64)
+    DECIMAL_128 = _sig(TypeEnum.DECIMAL_64, TypeEnum.DECIMAL_128)
+    numeric = integral + fp + DECIMAL_64
+    STRING = _sig(TypeEnum.STRING)
+    BINARY = _sig(TypeEnum.BINARY)
+    DATE = _sig(TypeEnum.DATE)
+    TIMESTAMP = _sig(TypeEnum.TIMESTAMP)
+    NULL = _sig(TypeEnum.NULL)
+    datetime = DATE + TIMESTAMP
+    comparable = integral + fp + DECIMAL_64 + BOOLEAN + STRING + datetime + NULL
+    common_scalar = comparable
+    orderable = comparable
+    all_basic = comparable + BINARY
+    ARRAY = _sig(TypeEnum.ARRAY)
+    MAP = _sig(TypeEnum.MAP)
+    STRUCT = _sig(TypeEnum.STRUCT)
+    nested_common = (all_basic + ARRAY + STRUCT + MAP).nested()
+    all = TypeSig(TypeEnum.ALL).nested()
+
+
+def from_arrow(at) -> DataType:
+    """Arrow → Spark type (host interop boundary)."""
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BooleanT
+    if pa.types.is_int8(at):
+        return ByteT
+    if pa.types.is_int16(at):
+        return ShortT
+    if pa.types.is_int32(at):
+        return IntegerT
+    if pa.types.is_int64(at):
+        return LongT
+    if pa.types.is_float32(at):
+        return FloatT
+    if pa.types.is_float64(at):
+        return DoubleT
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return StringT
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return BinaryT
+    if pa.types.is_date32(at):
+        return DateT
+    if pa.types.is_timestamp(at):
+        return TimestampT
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
+    if pa.types.is_struct(at):
+        return StructType([StructField(f.name, from_arrow(f.type), f.nullable) for f in at])
+    if pa.types.is_null(at):
+        return NullT
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow(dt: DataType):
+    """Spark → Arrow type."""
+    import pyarrow as pa
+    if isinstance(dt, BooleanType):
+        return pa.bool_()
+    if isinstance(dt, ByteType):
+        return pa.int8()
+    if isinstance(dt, ShortType):
+        return pa.int16()
+    if isinstance(dt, IntegerType):
+        return pa.int32()
+    if isinstance(dt, LongType):
+        return pa.int64()
+    if isinstance(dt, FloatType):
+        return pa.float32()
+    if isinstance(dt, DoubleType):
+        return pa.float64()
+    if isinstance(dt, StringType):
+        return pa.string()
+    if isinstance(dt, BinaryType):
+        return pa.binary()
+    if isinstance(dt, DateType):
+        return pa.date32()
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow(dt.element_type))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow(dt.key_type), to_arrow(dt.value_type))
+    if isinstance(dt, StructType):
+        return pa.struct([(f.name, to_arrow(f.data_type)) for f in dt.fields])
+    if isinstance(dt, NullType):
+        return pa.null()
+    raise TypeError(f"unsupported type {dt}")

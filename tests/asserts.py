@@ -1,0 +1,103 @@
+"""CPU-vs-TPU equality harness.
+
+Mirrors the reference integration-test machinery
+(/root/reference/integration_tests/src/main/python/asserts.py:479
+`_assert_gpu_and_cpu_are_equal`, `_assert_equal`:29 with float ULP tolerance):
+run the same DataFrame-producing function with the plugin enabled and disabled
+and diff results recursively.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+from spark_rapids_tpu.session import DataFrame, TpuSession
+
+
+def with_cpu_session(fn: Callable[[TpuSession], object], conf=None):
+    s = TpuSession({**(conf or {}), "spark.rapids.sql.enabled": "false"})
+    return fn(s)
+
+
+def with_tpu_session(fn: Callable[[TpuSession], object], conf=None):
+    s = TpuSession({**(conf or {}),
+                    "spark.rapids.sql.enabled": "true",
+                    "spark.rapids.sql.test.enabled": "true"})
+    return fn(s)
+
+
+def _assert_value_equal(c, t, path: str, approx_float: bool):
+    if c is None or t is None:
+        assert (c is None) == (t is None), f"{path}: CPU={c!r} TPU={t!r}"
+        return
+    if isinstance(c, float) and isinstance(t, float):
+        if math.isnan(c) or math.isnan(t):
+            assert math.isnan(c) == math.isnan(t), f"{path}: CPU={c!r} TPU={t!r}"
+            return
+        if approx_float:
+            assert c == t or math.isclose(c, t, rel_tol=1e-9, abs_tol=1e-11), \
+                f"{path}: CPU={c!r} TPU={t!r}"
+        else:
+            assert c == t, f"{path}: CPU={c!r} TPU={t!r}"
+        return
+    if isinstance(c, dict):
+        assert set(c) == set(t), f"{path}: keys differ"
+        for k in c:
+            _assert_value_equal(c[k], t[k], f"{path}.{k}", approx_float)
+        return
+    if isinstance(c, (list, tuple)):
+        assert len(c) == len(t), f"{path}: lengths differ"
+        for i, (a, b) in enumerate(zip(c, t)):
+            _assert_value_equal(a, b, f"{path}[{i}]", approx_float)
+        return
+    assert c == t, f"{path}: CPU={c!r} TPU={t!r}"
+
+
+def _rows_sort_key(row: dict):
+    def k(v):
+        if v is None:
+            return (0, "")
+        if isinstance(v, float) and math.isnan(v):
+            return (3, "")
+        if isinstance(v, (int, float, bool)):
+            return (1, str((float(v), )))
+        return (2, str(v))
+    return [k(v) for v in row.values()]
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+        df_fn: Callable[[TpuSession], DataFrame],
+        conf: Optional[dict] = None,
+        ignore_order: bool = False,
+        approx_float: bool = False,
+        allow_non_tpu: bool = False):
+    """Run df_fn on CPU and TPU sessions and compare collected rows."""
+    cpu_rows = with_cpu_session(lambda s: df_fn(s).collect(), conf)
+    tconf = dict(conf or {})
+    if allow_non_tpu:
+        tconf["spark.rapids.sql.test.enabled"] = "false"
+        t = TpuSession({**tconf, "spark.rapids.sql.enabled": "true"})
+        tpu_rows = df_fn(t).collect()
+    else:
+        tpu_rows = with_tpu_session(lambda s: df_fn(s).collect(), conf)
+    assert len(cpu_rows) == len(tpu_rows), \
+        f"row counts differ: CPU={len(cpu_rows)} TPU={len(tpu_rows)}"
+    if ignore_order:
+        cpu_rows = sorted(cpu_rows, key=_rows_sort_key)
+        tpu_rows = sorted(tpu_rows, key=_rows_sort_key)
+    for i, (c, t) in enumerate(zip(cpu_rows, tpu_rows)):
+        _assert_value_equal(c, t, f"row[{i}]", approx_float)
+
+
+def assert_tpu_fallback_collect(df_fn, fallback_exec_name: str, conf=None):
+    """Assert the plan DID fall back to CPU for the named exec and results match
+    (reference assert_gpu_fallback_collect, asserts.py:443)."""
+    s = TpuSession({**(conf or {}), "spark.rapids.sql.enabled": "true"})
+    df = df_fn(s)
+    reasons = df.explain_fallback()
+    assert fallback_exec_name in reasons, \
+        f"expected fallback of {fallback_exec_name}; got:\n{reasons}"
+    cpu_rows = with_cpu_session(lambda s2: df_fn(s2).collect(), conf)
+    tpu_rows = df.collect()
+    assert sorted(map(str, cpu_rows)) == sorted(map(str, tpu_rows))

@@ -1,0 +1,30 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform BEFORE jax
+initializes, so sharding/mesh tests run without TPU hardware (the driver's
+dryrun_multichip uses the same mechanism)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets axon (real TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# the axon site-hook rewrites jax_platforms to "axon,cpu"; force CPU for tests
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def session():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({})
+
+
+@pytest.fixture()
+def cpu_session():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.sql.enabled": "false"})

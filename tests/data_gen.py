@@ -1,0 +1,168 @@
+"""Composable random data generators (reference integration_tests data_gen.py:
+per-type generators with nullability + special values, seeded determinism)."""
+
+from __future__ import annotations
+
+import datetime
+import string
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+class DataGen:
+    arrow_type: pa.DataType = None
+    special_values: list = []
+
+    def __init__(self, nullable: bool = True, null_prob: float = 0.1):
+        self.nullable = nullable
+        self.null_prob = null_prob
+
+    def _values(self, rng: np.random.Generator, n: int) -> list:
+        raise NotImplementedError
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = list(self._values(rng, n))
+        # splice in special values deterministically
+        for i, sv in enumerate(self.special_values):
+            if n > 0:
+                vals[int(rng.integers(0, n))] = sv
+        if self.nullable and n > 0:
+            mask = rng.random(n) < self.null_prob
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return pa.array(vals, type=self.arrow_type)
+
+
+class BooleanGen(DataGen):
+    arrow_type = pa.bool_()
+
+    def _values(self, rng, n):
+        return [bool(b) for b in rng.integers(0, 2, n)]
+
+
+class ByteGen(DataGen):
+    arrow_type = pa.int8()
+    special_values = [-128, 127, 0]
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(-128, 128, n)]
+
+
+class ShortGen(DataGen):
+    arrow_type = pa.int16()
+    special_values = [-32768, 32767, 0]
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(-32768, 32768, n)]
+
+
+class IntegerGen(DataGen):
+    arrow_type = pa.int32()
+    special_values = [-2**31, 2**31 - 1, 0]
+
+    def __init__(self, nullable=True, min_val=-2**31, max_val=2**31 - 1, **kw):
+        super().__init__(nullable, **kw)
+        self.min_val, self.max_val = min_val, max_val
+        if not (min_val <= -2**31 or max_val >= 2**31 - 1):
+            self.special_values = []
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(self.min_val, self.max_val + 1, n,
+                                             dtype=np.int64)]
+
+
+class LongGen(DataGen):
+    arrow_type = pa.int64()
+    special_values = [-2**63, 2**63 - 1, 0]
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(-2**63, 2**63 - 1, n, dtype=np.int64)]
+
+
+class FloatGen(DataGen):
+    arrow_type = pa.float32()
+    special_values = [float("nan"), float("inf"), float("-inf"), 0.0, -0.0]
+
+    def _values(self, rng, n):
+        return [float(np.float32(v)) for v in rng.standard_normal(n) * 1e6]
+
+
+class DoubleGen(DataGen):
+    arrow_type = pa.float64()
+    special_values = [float("nan"), float("inf"), float("-inf"), 0.0, -0.0]
+
+    def _values(self, rng, n):
+        return [float(v) for v in rng.standard_normal(n) * 1e12]
+
+
+class StringGen(DataGen):
+    arrow_type = pa.string()
+    special_values = ["", " ", "\t", "é—unicode✓"]
+
+    def __init__(self, nullable=True, alphabet=string.ascii_letters + string.digits,
+                 max_len=20, **kw):
+        super().__init__(nullable, **kw)
+        self.alphabet = alphabet
+        self.max_len = max_len
+
+    def _values(self, rng, n):
+        lens = rng.integers(0, self.max_len + 1, n)
+        chars = rng.integers(0, len(self.alphabet), int(lens.sum()) if n else 0)
+        out = []
+        pos = 0
+        for l in lens:
+            out.append("".join(self.alphabet[c] for c in chars[pos:pos + l]))
+            pos += l
+        return out
+
+
+class DateGen(DataGen):
+    arrow_type = pa.date32()
+    special_values = [datetime.date(1970, 1, 1), datetime.date(1582, 10, 15),
+                      datetime.date(9999, 12, 31)]
+
+    def _values(self, rng, n):
+        days = rng.integers(-100000, 100000, n)
+        return [datetime.date(1970, 1, 1) + datetime.timedelta(days=int(d))
+                for d in days]
+
+
+class TimestampGen(DataGen):
+    arrow_type = pa.timestamp("us", tz="UTC")
+
+    def _values(self, rng, n):
+        us = rng.integers(-2**45, 2**45, n)
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+        return [epoch + datetime.timedelta(microseconds=int(u)) for u in us]
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision=10, scale=2, nullable=True, **kw):
+        super().__init__(nullable, **kw)
+        import decimal
+        self.precision, self.scale = precision, scale
+        self.arrow_type = pa.decimal128(precision, scale)
+
+    def _values(self, rng, n):
+        import decimal
+        limit = 10 ** self.precision - 1
+        unscaled = rng.integers(-limit, limit, n)
+        return [decimal.Decimal(int(u)).scaleb(-self.scale) for u in unscaled]
+
+
+def gen_df(gens: List[tuple], n: int = 1024, seed: int = 42) -> pa.Table:
+    """[(name, DataGen), ...] → deterministic arrow table."""
+    rng = np.random.default_rng(seed)
+    cols = {}
+    for name, g in gens:
+        cols[name] = g.generate(rng, n)
+    return pa.table(cols)
+
+
+# standard suites (reference data_gen.py naming)
+numeric_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen(), FloatGen(),
+                DoubleGen()]
+integral_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+all_basic_gens = numeric_gens + [BooleanGen(), StringGen(), DateGen(),
+                                 TimestampGen()]

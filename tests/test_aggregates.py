@@ -1,0 +1,138 @@
+"""Hash-aggregate CPU-vs-TPU equality (reference hash_aggregate_test.py slices)."""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (BooleanGen, DoubleGen, FloatGen, IntegerGen, LongGen,
+                      StringGen, gen_df)
+
+import spark_rapids_tpu.functions as F
+
+
+def _df(s, gens, n=512, parts=1, seed=42):
+    return s.createDataFrame(gen_df(gens, n, seed), num_partitions=parts)
+
+
+def test_groupby_sum_count():
+    gens = [("k", IntegerGen(min_val=0, max_val=10)),
+            ("v", IntegerGen()), ("d", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k").agg(
+            F.sum(F.col("v")).alias("sv"),
+            F.count(F.col("v")).alias("cv"),
+            F.sum(F.col("d")).alias("sd"),
+        ), ignore_order=True, approx_float=True)
+
+
+def test_groupby_min_max_avg():
+    gens = [("k", IntegerGen(min_val=0, max_val=5, null_prob=0.3)),
+            ("v", LongGen()), ("d", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k").agg(
+            F.min(F.col("v")).alias("mn"),
+            F.max(F.col("v")).alias("mx"),
+            F.avg(F.col("d")).alias("av"),
+            F.min(F.col("d")).alias("mnd"),
+            F.max(F.col("d")).alias("mxd"),
+        ), ignore_order=True, approx_float=True)
+
+
+def test_groupby_string_key():
+    gens = [("k", StringGen(alphabet="abc", max_len=2, null_prob=0.2)),
+            ("v", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k").agg(
+            F.sum(F.col("v")).alias("s"),
+            F.count(F.col("v")).alias("c"),
+        ), ignore_order=True)
+
+
+def test_groupby_multi_key():
+    gens = [("k1", IntegerGen(min_val=0, max_val=3, null_prob=0.2)),
+            ("k2", BooleanGen()), ("v", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k1", "k2").agg(
+            F.count(F.col("v")).alias("c"),
+            F.sum(F.col("v")).alias("s"),
+        ), ignore_order=True, approx_float=True)
+
+
+def test_global_aggregate():
+    gens = [("v", IntegerGen()), ("d", DoubleGen(null_prob=0.3))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).agg(
+            F.sum(F.col("v")).alias("s"),
+            F.count(F.col("v")).alias("c"),
+            F.avg(F.col("d")).alias("a"),
+            F.min(F.col("v")).alias("mn"),
+            F.max(F.col("v")).alias("mx"),
+        ), approx_float=True)
+
+
+def test_global_aggregate_empty_input():
+    gens = [("v", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens, n=0).agg(
+            F.count(F.col("v")).alias("c"),
+            F.sum(F.col("v")).alias("s"),
+        ))
+
+
+def test_groupby_all_null_values():
+    gens = [("k", IntegerGen(min_val=0, max_val=2)),
+            ("v", IntegerGen(null_prob=1.0))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k").agg(
+            F.sum(F.col("v")).alias("s"),
+            F.count(F.col("v")).alias("c"),
+        ), ignore_order=True)
+
+
+def test_groupby_stddev_variance():
+    gens = [("k", IntegerGen(min_val=0, max_val=4)),
+            ("v", DoubleGen(null_prob=0.2))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k").agg(
+            F.stddev(F.col("v")).alias("sd"),
+            F.var_pop(F.col("v")).alias("vp"),
+        ), ignore_order=True, approx_float=True)
+
+
+def test_agg_result_expression():
+    """sum(x) + count(y) style post-projection over aggregates."""
+    gens = [("k", IntegerGen(min_val=0, max_val=4)), ("v", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).groupBy("k").agg(
+            (F.sum(F.col("v")) + F.count(F.col("v"))).alias("sc")),
+        ignore_order=True)
+
+
+def test_tpch_q1_shape():
+    """TPC-H Q1-shaped query: BASELINE milestone config #2."""
+    gens = [("returnflag", StringGen(alphabet="ABC", max_len=1, null_prob=0.0)),
+            ("linestatus", StringGen(alphabet="OF", max_len=1, null_prob=0.0)),
+            ("quantity", IntegerGen(min_val=1, max_val=50)),
+            ("extendedprice", DoubleGen(null_prob=0.0)),
+            ("discount", DoubleGen(null_prob=0.0)),
+            ("tax", DoubleGen(null_prob=0.0))]
+
+    def q1(s):
+        df = _df(s, gens, n=2048)
+        return (df
+                .withColumn("disc_price",
+                            F.col("extendedprice") * (1 - F.col("discount")))
+                .withColumn("charge",
+                            F.col("extendedprice") * (1 - F.col("discount"))
+                            * (1 + F.col("tax")))
+                .groupBy("returnflag", "linestatus")
+                .agg(F.sum(F.col("quantity")).alias("sum_qty"),
+                     F.sum(F.col("extendedprice")).alias("sum_base_price"),
+                     F.sum(F.col("disc_price")).alias("sum_disc_price"),
+                     F.sum(F.col("charge")).alias("sum_charge"),
+                     F.avg(F.col("quantity")).alias("avg_qty"),
+                     F.avg(F.col("extendedprice")).alias("avg_price"),
+                     F.avg(F.col("discount")).alias("avg_disc"),
+                     F.count(F.col("quantity")).alias("count_order"))
+                .sort("returnflag", "linestatus"))
+
+    assert_tpu_and_cpu_are_equal_collect(q1, approx_float=True)

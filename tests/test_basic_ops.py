@@ -1,0 +1,187 @@
+"""Project/filter/limit/union/sort end-to-end CPU-vs-TPU equality
+(reference: integration_tests arithmetic_ops_test.py / cmp_test.py slices)."""
+
+import pytest
+
+from asserts import (assert_tpu_and_cpu_are_equal_collect,
+                     assert_tpu_fallback_collect, with_tpu_session)
+from data_gen import (DoubleGen, FloatGen, IntegerGen, LongGen, StringGen,
+                      BooleanGen, gen_df)
+
+import spark_rapids_tpu.functions as F
+
+
+def _df(s, gens, n=256, parts=1, seed=42):
+    return s.createDataFrame(gen_df(gens, n, seed), num_partitions=parts)
+
+
+def test_project_arithmetic():
+    gens = [("a", IntegerGen()), ("b", IntegerGen()), ("c", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            (F.col("a") + F.col("b")).alias("add"),
+            (F.col("a") - F.col("b")).alias("sub"),
+            (F.col("a") * F.col("b")).alias("mul"),
+            (-F.col("a")).alias("neg"),
+            F.abs(F.col("a")).alias("abs"),
+        ))
+
+
+def test_project_division():
+    gens = [("a", IntegerGen(min_val=-1000, max_val=1000)),
+            ("b", IntegerGen(min_val=-5, max_val=5)),
+            ("c", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            (F.col("a") / F.col("b")).alias("div"),
+            (F.col("c") / F.col("a")).alias("fdiv"),
+            (F.col("a") % F.col("b")).alias("mod"),
+        ), approx_float=True)
+
+
+def test_comparisons_with_nan():
+    gens = [("x", DoubleGen()), ("y", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            (F.col("x") == F.col("y")).alias("eq"),
+            (F.col("x") < F.col("y")).alias("lt"),
+            (F.col("x") >= F.col("y")).alias("ge"),
+            F.isnan(F.col("x")).alias("nan"),
+        ))
+
+
+def test_filter_basic():
+    gens = [("a", IntegerGen()), ("b", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).filter(
+            (F.col("a") > 0) & F.col("b").isNotNull()))
+
+
+def test_boolean_kleene_logic():
+    gens = [("p", BooleanGen()), ("q", BooleanGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            (F.col("p") & F.col("q")).alias("and"),
+            (F.col("p") | F.col("q")).alias("or"),
+            (~F.col("p")).alias("not"),
+        ))
+
+
+def test_conditionals():
+    gens = [("a", IntegerGen()), ("b", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.when(F.col("a") > 0, F.col("b")).otherwise(-F.col("b")).alias("w"),
+            F.coalesce(F.col("a"), F.col("b"), F.lit(0)).alias("c"),
+            F.greatest(F.col("a"), F.col("b")).alias("g"),
+            F.least(F.col("a"), F.col("b")).alias("l"),
+        ))
+
+
+def test_null_predicates():
+    gens = [("a", IntegerGen(null_prob=0.5)), ("s", StringGen(null_prob=0.5))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.col("a").isNull().alias("n"),
+            F.col("a").isNotNull().alias("nn"),
+            F.col("s").isNull().alias("sn"),
+        ))
+
+
+def test_in_list():
+    gens = [("a", IntegerGen(min_val=0, max_val=10))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.col("a").isin(1, 2, 3).alias("in3")))
+
+
+def test_math_functions():
+    gens = [("x", DoubleGen()), ("p", IntegerGen(min_val=1, max_val=100))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.sqrt(F.abs(F.col("x"))).alias("sqrt"),
+            F.log("p").alias("log"),
+            F.floor(F.col("x") / 1e10).alias("floor"),
+            F.ceil(F.col("x") / 1e10).alias("ceil"),
+        ), approx_float=True)
+
+
+def test_cast_numeric():
+    gens = [("a", IntegerGen()), ("d", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.col("a").cast("long").alias("i2l"),
+            F.col("a").cast("double").alias("i2d"),
+            F.col("d").cast("int").alias("d2i"),
+            F.col("a").cast("string").alias("i2s"),
+        ))
+
+
+def test_limit_and_union():
+    gens = [("a", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).limit(17).union(_df(s, gens, seed=7).limit(5)),
+        ignore_order=True)
+
+
+def test_range():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(0, 1000, 3).select(
+            (F.col("id") * 2).alias("x")))
+
+
+def test_sort_with_nulls_and_nans():
+    gens = [("a", DoubleGen(null_prob=0.3)), ("b", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).sort(F.col("a").asc(), F.col("b").desc()))
+
+
+def test_sort_strings():
+    gens = [("s", StringGen(null_prob=0.2)), ("a", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).sort("s"))
+
+
+def test_string_functions():
+    gens = [("s", StringGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.length(F.col("s")).alias("len"),
+            F.upper(F.col("s")).alias("up"),
+            F.lower(F.col("s")).alias("lo"),
+            F.col("s").startswith("a").alias("sw"),
+            F.col("s").endswith("z").alias("ew"),
+            F.col("s").contains("q").alias("ct"),
+        ))
+
+
+def test_hash_parity():
+    gens = [("a", IntegerGen()), ("b", LongGen()), ("s", StringGen()),
+            ("d", DoubleGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.hash(F.col("a"), F.col("b"), F.col("s"), F.col("d")).alias("h")))
+
+
+def test_explain_only_mode_runs_on_cpu():
+    import spark_rapids_tpu.functions as F
+
+    def fn(s):
+        return s.range(0, 10).select((F.col("id") + 1).alias("x"))
+    rows = with_tpu_session(
+        lambda s: fn(s).collect(),
+        conf={"spark.rapids.sql.mode": "explainOnly",
+              "spark.rapids.sql.test.enabled": "false"})
+    assert [r["x"] for r in rows] == list(range(1, 11))
+
+
+def test_tagging_fallback_reports_reason():
+    from spark_rapids_tpu.session import TpuSession
+
+    def fn(s):
+        return s.range(0, 10).select((F.col("id") + 1).alias("x"))
+    s = TpuSession({"spark.rapids.sql.exec.ProjectExec": "false"})
+    reasons = fn(s).explain_fallback()
+    assert "ProjectExec" in reasons and "disabled" in reasons
+    rows = fn(s).collect()
+    assert [r["x"] for r in rows] == list(range(1, 11))
