@@ -1,0 +1,136 @@
+"""Fully-jittable TPC-H Q1 pipeline kernel — the framework's flagship compiled
+query step (BASELINE milestone config #2).
+
+This is the shape the exec layer lowers hot aggregations to when key
+cardinality is small and known (dictionary-encoded keys): filter + projection
+fused with a fixed-capacity scatter-add group table, no host synchronization
+anywhere — one XLA executable per batch shape. The general exec path
+(execs/aggregates.py) handles arbitrary cardinality with a sort-based plan.
+
+Reference analogue: the fused scan→project→partial-agg iterator chain of
+GpuAggFirstPassIterator (GpuAggregateExec.scala:549) — but compiled as ONE
+program instead of a kernel launch per expression.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Q1 groups by (returnflag, linestatus): tiny key domain → direct-indexed table
+N_FLAGS = 4
+N_STATUS = 4
+N_GROUPS = N_FLAGS * N_STATUS
+
+
+class Q1Inputs(NamedTuple):
+    """One columnar batch of lineitem (dictionary-encoded keys)."""
+    returnflag: jax.Array   # int32 codes [0, N_FLAGS)
+    linestatus: jax.Array   # int32 codes [0, N_STATUS)
+    quantity: jax.Array     # float32
+    extendedprice: jax.Array  # float32
+    discount: jax.Array     # float32
+    tax: jax.Array          # float32
+    shipdate: jax.Array     # int32 days since epoch
+    valid: jax.Array        # bool row mask (padding/validity)
+
+
+class Q1State(NamedTuple):
+    """Per-group partial aggregate state (the shuffle payload in multi-chip)."""
+    sum_qty: jax.Array
+    sum_base_price: jax.Array
+    sum_disc_price: jax.Array
+    sum_charge: jax.Array
+    sum_disc: jax.Array
+    count: jax.Array
+
+
+def q1_partial(batch: Q1Inputs, cutoff_days: jnp.int32) -> Q1State:
+    """Filter (shipdate <= cutoff) + project + grouped partial aggregation.
+
+    Segment-sum strategy: with a small known group count, the reduction is a
+    one-hot matmul — [n, 6 measures]ᵀ gathered through onehot[n, 16] on the MXU.
+    Scatter-add (`.at[].add`) serializes under index collisions on TPU; the
+    matmul form keeps the whole pipeline bandwidth-bound (this is the central
+    "design for the MXU" decision of the aggregation layer)."""
+    keep = batch.valid & (batch.shipdate <= cutoff_days)
+    group = (batch.returnflag * N_STATUS + batch.linestatus).astype(jnp.int32)
+    w = keep.astype(jnp.float32)
+
+    qty = batch.quantity * w
+    price = batch.extendedprice * w
+    disc_price = batch.extendedprice * (1.0 - batch.discount) * w
+    charge = disc_price * (1.0 + batch.tax)
+    disc = batch.discount * w
+
+    measures = jnp.stack([qty, price, disc_price, charge, disc, w], axis=1)
+    onehot = jax.nn.one_hot(group, N_GROUPS, dtype=jnp.float32)
+    sums = jnp.einsum("ng,nm->gm", onehot, measures,
+                      preferred_element_type=jnp.float32)
+
+    return Q1State(
+        sum_qty=sums[:, 0],
+        sum_base_price=sums[:, 1],
+        sum_disc_price=sums[:, 2],
+        sum_charge=sums[:, 3],
+        sum_disc=sums[:, 4],
+        count=sums[:, 5].astype(jnp.int32),
+    )
+
+
+def q1_final(state: Q1State):
+    """Final projection: averages from sums/counts (reference
+    GpuAggFinalPassIterator result projection)."""
+    n = jnp.maximum(state.count, 1).astype(jnp.float32)
+    return {
+        "sum_qty": state.sum_qty,
+        "sum_base_price": state.sum_base_price,
+        "sum_disc_price": state.sum_disc_price,
+        "sum_charge": state.sum_charge,
+        "avg_qty": state.sum_qty / n,
+        "avg_price": state.sum_base_price / n,
+        "avg_disc": state.sum_disc / n,
+        "count_order": state.count,
+    }
+
+
+@jax.jit
+def q1_step(batch: Q1Inputs, cutoff_days: jnp.int32):
+    """Single-chip forward step: one compiled program for the whole query."""
+    return q1_final(q1_partial(batch, cutoff_days))
+
+
+def make_example_batch(n: int = 1 << 16, seed: int = 0) -> Tuple[Q1Inputs, np.int32]:
+    rng = np.random.default_rng(seed)
+    batch = Q1Inputs(
+        returnflag=jnp.asarray(rng.integers(0, 3, n, dtype=np.int32)),
+        linestatus=jnp.asarray(rng.integers(0, 2, n, dtype=np.int32)),
+        quantity=jnp.asarray(rng.integers(1, 51, n).astype(np.float32)),
+        extendedprice=jnp.asarray((rng.random(n) * 1e5).astype(np.float32)),
+        discount=jnp.asarray((rng.random(n) * 0.1).astype(np.float32)),
+        tax=jnp.asarray((rng.random(n) * 0.08).astype(np.float32)),
+        shipdate=jnp.asarray(rng.integers(8000, 11000, n, dtype=np.int32)),
+        valid=jnp.ones((n,), jnp.bool_),
+    )
+    return batch, np.int32(10471)  # 1998-09-02 in days-since-epoch
+
+
+def q1_reference_numpy(batch: Q1Inputs, cutoff: int) -> Dict[str, np.ndarray]:
+    """Pure-numpy oracle for correctness checks."""
+    b = {k: np.asarray(v) for k, v in batch._asdict().items()}
+    keep = b["valid"] & (b["shipdate"] <= cutoff)
+    group = b["returnflag"] * N_STATUS + b["linestatus"]
+    out = {}
+    disc_price = b["extendedprice"] * (1 - b["discount"])
+    charge = disc_price * (1 + b["tax"])
+    sums = {"sum_qty": b["quantity"], "sum_base_price": b["extendedprice"],
+            "sum_disc_price": disc_price, "sum_charge": charge}
+    for name, col in sums.items():
+        out[name] = np.bincount(group[keep], weights=col[keep],
+                                minlength=N_GROUPS).astype(np.float64)
+    out["count_order"] = np.bincount(group[keep], minlength=N_GROUPS)
+    return out
