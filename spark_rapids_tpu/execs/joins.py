@@ -1,5 +1,516 @@
-"""Join execs — land in the joins milestone (next)."""
+"""Join execs: TPU equi-join (sorted-build + searchsorted probe) and CPU oracle.
+
+Reference: GpuShuffledHashJoinExec + GpuHashJoin trait (execution/GpuHashJoin.scala:994,
+gather-map iterators :259-985), GpuBroadcastNestedLoopJoinExec, GpuSortMergeJoinMeta
+(SMJ replaced by hash join on the accelerator — same policy here).
+
+TPU algorithm (XLA-static-shape friendly — cuDF's dynamic hash table does not
+map to TPU):
+  1. composite 64-bit mix of the equi-key columns on both sides (null keys never
+     match: rows with any null key are excluded from candidates)
+  2. sort the build side by hash; probe via two searchsorted calls → per-row
+     candidate ranges (hash collisions included)
+  3. expand ranges into candidate pairs (one host sync for the pair count →
+     bucketed output capacity, like the reference's gather-map sizing)
+  4. verify true key equality per pair (collision + null filtering)
+  5. join-type specific assembly: inner gathers both sides; left/right/full add
+     null-extended unmatched rows; semi/anti reduce to per-row match flags.
+Residual (non-equi) conditions evaluate over the joined batch and recompute
+match bookkeeping, mirroring the reference's conditional-join iterators.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import TpuColumnarBatch, compact, concat_batches, gather
+from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
+from ..expressions.base import (AttributeReference, Expression, to_column)
+from ..types import StringType
+from .aggregates import _sortable_bits
+from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
+                   bind_references)
+
+_MIX = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(h, v):
+    """64-bit mix chain (splitmix-style); the verified-equality pass makes
+    collisions harmless."""
+    h = (h ^ v) * jnp.uint64(_MIX)
+    h = h ^ (h >> 29)
+    return h
+
+
+def _encode_sides(left_cols: List[TpuColumnVector], right_cols: List[TpuColumnVector],
+                  l_rows: int, r_rows: int, l_cap: int, r_cap: int):
+    """Comparable per-key codes for both sides; string keys dictionary-encode
+    over the UNION of both sides so codes are cross-side comparable."""
+    l_enc, r_enc = [], []
+    for lc, rc in zip(left_cols, right_cols):
+        if isinstance(lc.dtype, StringType):
+            import pyarrow as pa
+            import pyarrow.compute as pc
+            la, ra = lc.to_arrow(), rc.to_arrow()
+            combined = pa.concat_arrays([la.cast(pa.string()), ra.cast(pa.string())])
+            enc = pc.dictionary_encode(combined)
+            if isinstance(enc, pa.ChunkedArray):
+                enc = enc.combine_chunks()
+            codes = np.asarray(enc.indices.fill_null(-1).to_numpy(zero_copy_only=False))
+            lbuf = np.zeros(l_cap, np.int64)
+            lbuf[:l_rows] = codes[:l_rows]
+            rbuf = np.zeros(r_cap, np.int64)
+            rbuf[:r_rows] = codes[l_rows:l_rows + r_rows]
+            l_enc.append((jnp.asarray(lbuf), lc.validity))
+            r_enc.append((jnp.asarray(rbuf), rc.validity))
+        else:
+            l_enc.append((_sortable_bits(lc).astype(jnp.int64), lc.validity))
+            r_enc.append((_sortable_bits(rc).astype(jnp.int64), rc.validity))
+    return l_enc, r_enc
+
+
+def _composite_hash(enc, num_rows: int, capacity: int):
+    """64-bit composite hash + all-keys-valid mask."""
+    h = jnp.full((capacity,), jnp.uint64(0x243F6A8885A308D3), jnp.uint64)
+    ok = row_mask(num_rows, capacity)
+    for vals, validity in enc:
+        h = _mix64(h, vals.view(jnp.uint64))
+        if validity is not None:
+            ok = ok & validity
+    return h, ok
+
+
+def _device_equi_join(build_enc, build_rows: int, probe_enc, probe_rows: int):
+    """Core matcher. Returns (pair_probe_idx, pair_build_idx, verified_mask,
+    total_candidates, out_capacity). Index arrays have out_capacity entries."""
+    b_cap = build_enc[0][0].shape[0]
+    p_cap = probe_enc[0][0].shape[0]
+    bh, b_ok = _composite_hash(build_enc, build_rows, b_cap)
+    ph, p_ok = _composite_hash(probe_enc, probe_rows, p_cap)
+    # exclude invalid build rows: sort them to the end under a max sentinel
+    sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    sort_key = jnp.where(b_ok, bh, sentinel)
+    order = jnp.argsort(sort_key)
+    bh_sorted = jnp.take(sort_key, order)
+    ph_safe = jnp.where(p_ok, ph, jnp.uint64(0))
+    lo = jnp.searchsorted(bh_sorted, ph_safe, side="left")
+    hi = jnp.searchsorted(bh_sorted, ph_safe, side="right")
+    counts = jnp.where(p_ok, hi - lo, 0)
+    total = int(jnp.sum(counts))  # host sync: candidate-pair count
+    out_cap = bucket_capacity(max(total, 1))
+    ends = jnp.cumsum(counts)
+    starts = ends - counts
+    j = jnp.arange(out_cap)
+    pi = jnp.clip(jnp.searchsorted(ends, j, side="right"), 0, p_cap - 1).astype(jnp.int32)
+    off = j - jnp.take(starts, pi)
+    bi_sorted = jnp.take(lo, pi) + off
+    bi = jnp.take(order, jnp.clip(bi_sorted, 0, b_cap - 1)).astype(jnp.int32)
+    ok = (j < total) & jnp.take(b_ok, bi) & jnp.take(p_ok, pi)
+    for (bv, _), (pv, _) in zip(build_enc, probe_enc):
+        ok = ok & (jnp.take(bv, bi) == jnp.take(pv, pi))
+    return pi, bi, ok, total, out_cap
+
+
+def _compact_pairs(pi, bi, ok, out_cap: int):
+    """Stable-compact verified pairs; one host sync for the kept count."""
+    n = int(jnp.sum(ok))
+    pos = jnp.cumsum(ok) - 1
+    idx = jnp.full((out_cap,), out_cap, jnp.int32)
+    idx = idx.at[jnp.where(ok, pos, out_cap)].set(
+        jnp.arange(out_cap, dtype=jnp.int32), mode="drop")
+    take = jnp.clip(idx, 0, out_cap - 1)
+    slot_ok = jnp.arange(out_cap) < n
+    return jnp.take(pi, take), jnp.take(bi, take), slot_ok, n
+
+
+def _all_null_cols(attrs_or_cols, num_rows: int, capacity: int):
+    out = []
+    for c in attrs_or_cols:
+        dt = c.dtype
+        out.append(TpuColumnVector.from_scalar(None, dt, num_rows, capacity))
+    return out
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    """Equi-join with optional residual condition (reference
+    GpuShuffledHashJoinExec; build side = right, Spark's BuildRight default)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, join_type: str,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 condition: Optional[Expression],
+                 output: List[AttributeReference]):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = bind_all(list(left_keys), left.output)
+        self.right_keys = bind_all(list(right_keys), right.output)
+        self.condition = (bind_references(condition, left.output + right.output)
+                          if condition is not None else None)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"TpuShuffledHashJoin[{self.join_type}]"
+
+    def additional_metrics(self):
+        return {"buildTime": "MODERATE", "joinTime": "MODERATE",
+                "numPairs": "DEBUG"}
+
+    def _collect_side(self, child: PhysicalPlan, ctx) -> Optional[TpuColumnarBatch]:
+        batches = []
+        for p in range(child.num_partitions()):
+            batches.extend(child.execute_partition(p, ctx))
+        return concat_batches(batches) if batches else None
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        left = self._collect_side(self.children[0], ctx)
+        right = self._collect_side(self.children[1], ctx)
+        jt = self.join_type
+        names = [a.name for a in self._output]
+        l_empty = left is None or left.num_rows == 0
+        r_empty = right is None or right.num_rows == 0
+        if l_empty and r_empty:
+            return
+        if l_empty:
+            if jt in ("rightouter", "right", "fullouter", "outer", "full"):
+                nulls_l = _all_null_cols(self.children[0].output,
+                                         right.num_rows, right.capacity)
+                yield TpuColumnarBatch(nulls_l + right.columns, right.num_rows, names)
+            return
+        if r_empty:
+            if jt in ("leftsemi", "semi", "inner", "cross"):
+                return
+            if jt in ("leftanti", "anti"):
+                yield left.rename(names)
+                return
+            nulls_r = _all_null_cols(self.children[1].output,
+                                     left.num_rows, left.capacity)
+            yield TpuColumnarBatch(left.columns + nulls_r, left.num_rows, names)
+            return
+        with self.metrics["joinTime"].timed():
+            yield self._join(left, right, ctx)
+
+    def _join(self, left: TpuColumnarBatch, right: TpuColumnarBatch,
+              ctx: TaskContext) -> TpuColumnarBatch:
+        jt = self.join_type
+        names = [a.name for a in self._output]
+        l_cap, r_cap = left.capacity, right.capacity
+        lk = [to_column(k.eval_tpu(left, ctx.eval_ctx), left, k.dtype)
+              for k in self.left_keys]
+        rk = [to_column(k.eval_tpu(right, ctx.eval_ctx), right, k.dtype)
+              for k in self.right_keys]
+        l_enc, r_enc = _encode_sides(lk, rk, left.num_rows, right.num_rows,
+                                     l_cap, r_cap)
+        # probe = left, build = right
+        pi, bi, ok, total, out_cap = _device_equi_join(
+            r_enc, right.num_rows, l_enc, left.num_rows)
+        self.metrics["numPairs"].add(total)
+        cpi, cbi, slot_ok, n_pairs = _compact_pairs(pi, bi, ok, out_cap)
+
+        lg = gather(left, jnp.where(slot_ok, cpi, -1), n_pairs, out_cap)
+        rg = gather(right, jnp.where(slot_ok, cbi, -1), n_pairs, out_cap)
+        joined = TpuColumnarBatch(lg.columns + rg.columns, n_pairs)
+
+        pair_keep = slot_ok
+        if self.condition is not None:
+            cond = to_column(self.condition.eval_tpu(joined, ctx.eval_ctx), joined)
+            keep = cond.data.astype(jnp.bool_)
+            if cond.validity is not None:
+                keep = keep & cond.validity
+            pair_keep = pair_keep & keep
+            joined = compact(joined, keep)
+
+        if jt in ("inner", "cross"):
+            return joined.rename(names)
+
+        # bookkeeping over VERIFIED+residual-surviving pairs
+        match_cnt = jnp.zeros((l_cap + 1,), jnp.int32).at[
+            jnp.where(pair_keep, cpi, l_cap)].add(1, mode="drop")[:l_cap]
+        build_matched = jnp.zeros((r_cap + 1,), jnp.bool_).at[
+            jnp.where(pair_keep, cbi, r_cap)].max(True, mode="drop")[:r_cap]
+
+        lmask = row_mask(left.num_rows, l_cap)
+        if jt in ("leftsemi", "semi"):
+            return compact(left, (match_cnt > 0) & lmask).rename(names)
+        if jt in ("leftanti", "anti"):
+            return compact(left, (match_cnt == 0) & lmask).rename(names)
+
+        parts = [joined] if joined.num_rows else []
+        if jt in ("leftouter", "left", "fullouter", "outer", "full"):
+            unmatched_l = compact(left, (match_cnt == 0) & lmask)
+            if unmatched_l.num_rows:
+                nulls_r = _all_null_cols(right.columns, unmatched_l.num_rows,
+                                         unmatched_l.capacity)
+                parts.append(TpuColumnarBatch(unmatched_l.columns + nulls_r,
+                                              unmatched_l.num_rows))
+        if jt in ("rightouter", "right", "fullouter", "outer", "full"):
+            rmask = row_mask(right.num_rows, r_cap)
+            unmatched_r = compact(right, (~build_matched) & rmask)
+            if unmatched_r.num_rows:
+                nulls_l = _all_null_cols(left.columns, unmatched_r.num_rows,
+                                         unmatched_r.capacity)
+                parts.append(TpuColumnarBatch(nulls_l + unmatched_r.columns,
+                                              unmatched_r.num_rows))
+        if not parts:
+            parts = [joined]
+        return concat_batches(parts).rename(names)
+
+
+class TpuBroadcastNestedLoopJoinExec(TpuExec):
+    """Cross join / conditional non-equi join (reference
+    GpuBroadcastNestedLoopJoinExec). Blockwise cartesian expansion + filter."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, join_type: str,
+                 condition: Optional[Expression],
+                 output: List[AttributeReference]):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.condition = (bind_references(condition, left.output + right.output)
+                          if condition is not None else None)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"TpuBroadcastNestedLoopJoin[{self.join_type}]"
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        def side(child):
+            batches = []
+            for p in range(child.num_partitions()):
+                batches.extend(child.execute_partition(p, ctx))
+            return concat_batches(batches) if batches else None
+
+        left, right = side(self.children[0]), side(self.children[1])
+        if left is None or right is None or not left.num_rows or not right.num_rows:
+            return
+        n_l, n_r = left.num_rows, right.num_rows
+        total = n_l * n_r
+        out_cap = bucket_capacity(max(total, 1))
+        j = jnp.arange(out_cap)
+        li = jnp.where(j < total, j // n_r, -1).astype(jnp.int32)
+        ri = jnp.where(j < total, j % n_r, -1).astype(jnp.int32)
+        lg = gather(left, li, total, out_cap)
+        rg = gather(right, ri, total, out_cap)
+        joined = TpuColumnarBatch(lg.columns + rg.columns, total)
+        if self.condition is not None:
+            cond = to_column(self.condition.eval_tpu(joined, ctx.eval_ctx), joined)
+            keep = cond.data.astype(jnp.bool_)
+            if cond.validity is not None:
+                keep = keep & cond.validity
+            joined = compact(joined, keep)
+        yield joined.rename([a.name for a in self._output])
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle
+# ---------------------------------------------------------------------------
+
+_ARROW_JOIN_TYPE = {"inner": "inner", "leftouter": "left outer", "left": "left outer",
+                    "rightouter": "right outer", "right": "right outer",
+                    "fullouter": "full outer", "outer": "full outer",
+                    "full": "full outer", "leftsemi": "left semi",
+                    "semi": "left semi", "leftanti": "left anti",
+                    "anti": "left anti"}
+
+
+class CpuShuffledHashJoinExec(CpuExec):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, join_type: str,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 condition: Optional[Expression],
+                 output: List[AttributeReference]):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = bind_all(list(left_keys), left.output)
+        self.right_keys = bind_all(list(right_keys), right.output)
+        self.condition = (bind_references(condition, left.output + right.output)
+                          if condition is not None else None)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"CpuShuffledHashJoin[{self.join_type}]"
+
+    def _side_table(self, child, ctx, prefix: str):
+        """Collect one side with positionally-unique column names (both sides may
+        share user-visible names; expressions bind by ordinal, not name)."""
+        import pyarrow as pa
+        from ..types import to_arrow
+        tables = []
+        for p in range(child.num_partitions()):
+            tables.extend(child.execute_partition(p, ctx))
+        names = [f"{prefix}{i}" for i in range(len(child.output))]
+        if tables:
+            return pa.concat_tables(
+                [t.rename_columns(names) for t in tables])
+        return pa.schema([(n, to_arrow(a.dtype))
+                          for n, a in zip(names, child.output)]).empty_table()
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        lt = self._side_table(self.children[0], ctx, "l")
+        rt = self._side_table(self.children[1], ctx, "r")
+        jt = self.join_type
+        n_l = len(self.children[0].output)
+        n_r = len(self.children[1].output)
+        lkeys, rkeys = [], []
+        for i, k in enumerate(self.left_keys):
+            lt = lt.append_column(f"__lk_{i}", _norm_key(
+                _as_arr(k.eval_cpu(lt, ctx.eval_ctx))))
+            lkeys.append(f"__lk_{i}")
+        for i, k in enumerate(self.right_keys):
+            rt = rt.append_column(f"__rk_{i}", _norm_key(
+                _as_arr(k.eval_cpu(rt, ctx.eval_ctx))))
+            rkeys.append(f"__rk_{i}")
+        l_out = [f"l{i}" for i in range(n_l)]
+        r_out = [f"r{i}" for i in range(n_r)]
+        if jt in ("leftsemi", "semi", "leftanti", "anti"):
+            sel = l_out
+        else:
+            sel = l_out + r_out
+        out_names = [a.name for a in self._output]
+        if self.condition is not None:
+            yield self._conditional(lt, rt, lkeys, rkeys, l_out, r_out,
+                                    sel, out_names, ctx)
+            return
+        res = lt.join(rt, keys=lkeys, right_keys=rkeys,
+                      join_type=_ARROW_JOIN_TYPE[jt], coalesce_keys=False)
+        yield res.select(sel).rename_columns(out_names)
+
+    def _conditional(self, lt, rt, lkeys, rkeys, l_out, r_out, sel, out_names, ctx):
+        """Residual condition joins: inner pairs + filter, then reconstruct
+        unmatched rows via row ids."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from ..types import to_arrow
+        jt = self.join_type
+        lt = lt.append_column("__lrow", pa.array(np.arange(lt.num_rows)))
+        rt = rt.append_column("__rrow", pa.array(np.arange(rt.num_rows)))
+        inner = lt.join(rt, keys=lkeys, right_keys=rkeys, join_type="inner",
+                        coalesce_keys=False)
+        mask = pc.fill_null(self.condition.eval_cpu(
+            inner.select(l_out + r_out), ctx.eval_ctx), False)
+        kept = inner.filter(mask)
+        if jt in ("inner", "cross"):
+            return kept.select(sel).rename_columns(out_names)
+        l_matched = set(kept.column("__lrow").to_pylist())
+        r_matched = set(kept.column("__rrow").to_pylist())
+        if jt in ("leftsemi", "semi"):
+            keep = pa.array([i in l_matched for i in range(lt.num_rows)])
+            return lt.filter(keep).select(sel).rename_columns(out_names)
+        if jt in ("leftanti", "anti"):
+            keep = pa.array([i not in l_matched for i in range(lt.num_rows)])
+            return lt.filter(keep).select(sel).rename_columns(out_names)
+        parts = [kept.select(sel)]
+        r_attrs = self.children[1].output
+        l_attrs = self.children[0].output
+        if jt in ("leftouter", "left", "fullouter", "outer", "full"):
+            keep = pa.array([i not in l_matched for i in range(lt.num_rows)])
+            lu = lt.filter(keep).select(l_out)
+            for name, a in zip(r_out, r_attrs):
+                lu = lu.append_column(name, pa.nulls(lu.num_rows, to_arrow(a.dtype)))
+            parts.append(lu.select(sel))
+        if jt in ("rightouter", "right", "fullouter", "outer", "full"):
+            keep = pa.array([i not in r_matched for i in range(rt.num_rows)])
+            ru = rt.filter(keep).select(r_out)
+            for name, a in reversed(list(zip(l_out, l_attrs))):
+                ru = ru.add_column(0, name, pa.nulls(ru.num_rows, to_arrow(a.dtype)))
+            parts.append(ru.select(sel))
+        return pa.concat_tables(parts).rename_columns(out_names)
+
+
+def _as_arr(x):
+    import pyarrow as pa
+    return x.combine_chunks() if isinstance(x, pa.ChunkedArray) else x
+
+
+def _norm_key(arr):
+    """NaN/-0.0 normalization for join keys (Spark: NaN==NaN in joins)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if isinstance(arr, (pa.Array, pa.ChunkedArray)) and pa.types.is_floating(arr.type):
+        zero = pa.scalar(0.0, arr.type)
+        arr = pc.if_else(pc.equal(arr, zero), zero, arr)
+    return arr
+
+
+class CpuBroadcastNestedLoopJoinExec(CpuExec):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, join_type: str,
+                 condition: Optional[Expression],
+                 output: List[AttributeReference]):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.condition = (bind_references(condition, left.output + right.output)
+                          if condition is not None else None)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"CpuBroadcastNestedLoopJoin[{self.join_type}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        def side(child, prefix):
+            tables = []
+            for p in range(child.num_partitions()):
+                tables.extend(child.execute_partition(p, ctx))
+            names = [f"{prefix}{i}" for i in range(len(child.output))]
+            if tables:
+                return pa.concat_tables([t.rename_columns(names) for t in tables])
+            from ..types import to_arrow
+            return pa.schema([(n, to_arrow(a.dtype))
+                              for n, a in zip(names, child.output)]).empty_table()
+
+        lt, rt = side(self.children[0], "l"), side(self.children[1], "r")
+        n_l, n_r = lt.num_rows, rt.num_rows
+        if n_l == 0 or n_r == 0:
+            return
+        li = np.repeat(np.arange(n_l), n_r)
+        ri = np.tile(np.arange(n_r), n_l)
+        joined = lt.take(pa.array(li))
+        for i, name in enumerate(rt.column_names):
+            joined = joined.append_column(name, rt.column(i).take(pa.array(ri)))
+        if self.condition is not None:
+            mask = self.condition.eval_cpu(joined, ctx.eval_ctx)
+            joined = joined.filter(pc.fill_null(mask, False))
+        yield joined.rename_columns([a.name for a in self._output])
 
 
 def plan_cpu_join(plan, conf):
-    raise NotImplementedError("joins land in the next milestone")
+    from ..plan.planner import plan_physical
+    left = plan_physical(plan.left, conf)
+    right = plan_physical(plan.right, conf)
+    if plan.left_keys:
+        return CpuShuffledHashJoinExec(left, right, plan.join_type,
+                                       plan.left_keys, plan.right_keys,
+                                       plan.condition, plan.output)
+    return CpuBroadcastNestedLoopJoinExec(left, right, plan.join_type,
+                                          plan.condition, plan.output)

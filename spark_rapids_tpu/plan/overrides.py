@@ -162,6 +162,44 @@ register_exec(_CpuAgg, "hash aggregate", "spark.rapids.sql.exec.HashAggregateExe
               _tag_aggregate, _convert_aggregate)
 
 
+def _tag_hash_join(meta: PlanMeta) -> None:
+    p = meta.plan
+    meta.add_exprs(p.left_keys)
+    meta.add_exprs(p.right_keys)
+    if p.condition is not None:
+        meta.add_exprs([p.condition])
+
+
+def _convert_hash_join(meta: PlanMeta, ch):
+    from ..execs.joins import TpuShuffledHashJoinExec
+    p = meta.plan
+    return TpuShuffledHashJoinExec(ch[0], ch[1], p.join_type, p.left_keys,
+                                   p.right_keys, p.condition, p.output)
+
+
+def _tag_bnlj(meta: PlanMeta) -> None:
+    if meta.plan.condition is not None:
+        meta.add_exprs([meta.plan.condition])
+
+
+def _convert_bnlj(meta: PlanMeta, ch):
+    from ..execs.joins import TpuBroadcastNestedLoopJoinExec
+    p = meta.plan
+    return TpuBroadcastNestedLoopJoinExec(ch[0], ch[1], p.join_type,
+                                          p.condition, p.output)
+
+
+from ..execs.joins import (CpuBroadcastNestedLoopJoinExec as _CpuBnlj,  # noqa: E402
+                           CpuShuffledHashJoinExec as _CpuShj)
+
+register_exec(_CpuShj, "shuffled hash join",
+              "spark.rapids.sql.exec.ShuffledHashJoinExec",
+              _tag_hash_join, _convert_hash_join)
+register_exec(_CpuBnlj, "broadcast nested loop join",
+              "spark.rapids.sql.exec.BroadcastNestedLoopJoinExec",
+              _tag_bnlj, _convert_bnlj)
+
+
 def wrap_and_tag_plan(plan: PhysicalPlan, conf: RapidsConf) -> PlanMeta:
     """reference wrapAndTagPlan (GpuOverrides.scala:4358)."""
     rule = _EXEC_RULES.get(type(plan))

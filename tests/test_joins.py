@@ -1,0 +1,142 @@
+"""Join CPU-vs-TPU equality (reference join_test.py slices)."""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (DoubleGen, FloatGen, IntegerGen, LongGen, StringGen,
+                      gen_df)
+
+import spark_rapids_tpu.functions as F
+
+ALL_JOIN_TYPES = ["inner", "left", "right", "full", "semi", "anti"]
+
+
+def _sides(s, n_left=128, n_right=64, key_lo=0, key_hi=20, seed_l=1, seed_r=2,
+           null_prob=0.2):
+    left = s.createDataFrame(gen_df(
+        [("k", IntegerGen(min_val=key_lo, max_val=key_hi, null_prob=null_prob)),
+         ("lv", IntegerGen())], n_left, seed_l))
+    right = s.createDataFrame(gen_df(
+        [("k", IntegerGen(min_val=key_lo, max_val=key_hi, null_prob=null_prob)),
+         ("rv", DoubleGen())], n_right, seed_r))
+    return left, right
+
+
+@pytest.mark.parametrize("join_type", ALL_JOIN_TYPES)
+def test_join_int_key(join_type):
+    def fn(s):
+        l, r = _sides(s)
+        return l.join(r, on="k", how=join_type)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+@pytest.mark.parametrize("join_type", ["inner", "left", "full"])
+def test_join_string_key(join_type):
+    def fn(s):
+        l = s.createDataFrame(gen_df(
+            [("k", StringGen(alphabet="abcde", max_len=3, null_prob=0.2)),
+             ("lv", IntegerGen())], 100, 3))
+        r = s.createDataFrame(gen_df(
+            [("k", StringGen(alphabet="abcde", max_len=3, null_prob=0.2)),
+             ("rv", IntegerGen())], 60, 4))
+        return l.join(r, on="k", how=join_type)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_join_multi_key():
+    def fn(s):
+        l = s.createDataFrame(gen_df(
+            [("k1", IntegerGen(min_val=0, max_val=5)),
+             ("k2", IntegerGen(min_val=0, max_val=3, null_prob=0.2)),
+             ("lv", IntegerGen())], 100, 5))
+        r = s.createDataFrame(gen_df(
+            [("k1", IntegerGen(min_val=0, max_val=5)),
+             ("k2", IntegerGen(min_val=0, max_val=3, null_prob=0.2)),
+             ("rv", IntegerGen())], 80, 6))
+        return l.join(r, on=["k1", "k2"], how="inner")
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_join_float_key_nan():
+    """Spark joins match NaN==NaN and -0.0==0.0 (normalized keys)."""
+    def fn(s):
+        import pyarrow as pa
+        l = s.createDataFrame(pa.table({
+            "k": pa.array([1.0, float("nan"), -0.0, None, 2.5], pa.float64()),
+            "lv": pa.array([1, 2, 3, 4, 5])}))
+        r = s.createDataFrame(pa.table({
+            "k": pa.array([float("nan"), 0.0, 2.5, None], pa.float64()),
+            "rv": pa.array([10, 20, 30, 40])}))
+        return l.join(r, on="k", how="inner")
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_join_condition_expression_keys():
+    def fn(s):
+        l, r = _sides(s)
+        lr = l.withColumnRenamed("k", "lk")
+        return lr.join(r, on=lr["lk"] == r["k"], how="inner")
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+@pytest.mark.parametrize("join_type", ["inner", "left", "semi", "anti"])
+def test_join_with_residual_condition(join_type):
+    def fn(s):
+        l, r = _sides(s, null_prob=0.1)
+        lr = l.withColumnRenamed("k", "lk")
+        cond = (lr["lk"] == r["k"]) & (lr["lv"] > r["rv"])
+        return lr.join(r, on=cond, how=join_type)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_cross_join():
+    def fn(s):
+        l = s.range(0, 13).withColumnRenamed("id", "a")
+        r = s.range(0, 7).withColumnRenamed("id", "b")
+        return l.crossJoin(r)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_nested_loop_conditional_join():
+    def fn(s):
+        l = s.range(0, 40).withColumnRenamed("id", "a")
+        r = s.range(0, 30).withColumnRenamed("id", "b")
+        return l.join(r, on=(l["a"] % 7) > (r["b"] % 5), how="inner")
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_join_empty_sides():
+    def fn_empty_right(s):
+        l, _ = _sides(s)
+        r = s.createDataFrame(gen_df(
+            [("k", IntegerGen()), ("rv", DoubleGen())], 0))
+        return l.join(r, on="k", how="left")
+    assert_tpu_and_cpu_are_equal_collect(fn_empty_right, ignore_order=True)
+
+
+def test_tpch_q3_shape():
+    """TPC-H Q3-shaped query: scan→join→join→agg (BASELINE milestone #3)."""
+    def fn(s):
+        cust = s.createDataFrame(gen_df(
+            [("custkey", IntegerGen(min_val=0, max_val=200, null_prob=0.0)),
+             ("mktsegment", StringGen(alphabet="AB", max_len=1, null_prob=0.0))],
+            200, 11))
+        orders = s.createDataFrame(gen_df(
+            [("orderkey", IntegerGen(min_val=0, max_val=500, null_prob=0.0)),
+             ("o_custkey", IntegerGen(min_val=0, max_val=200, null_prob=0.0)),
+             ("orderdate", IntegerGen(min_val=8000, max_val=11000, null_prob=0.0))],
+            500, 12))
+        lineitem = s.createDataFrame(gen_df(
+            [("l_orderkey", IntegerGen(min_val=0, max_val=500, null_prob=0.0)),
+             ("extendedprice", DoubleGen(null_prob=0.0)),
+             ("discount", DoubleGen(null_prob=0.0))], 1000, 13))
+        return (cust.filter(F.col("mktsegment") == "A")
+                .join(orders, on=cust["custkey"] == orders["o_custkey"])
+                .join(lineitem, on=orders["orderkey"] == lineitem["l_orderkey"])
+                .withColumn("revenue",
+                            F.col("extendedprice") * (1 - F.col("discount")))
+                .groupBy("orderkey", "orderdate")
+                .agg(F.sum(F.col("revenue")).alias("rev"))
+                .sort(F.col("rev").desc(), F.col("orderdate").asc())
+                .limit(10))
+    assert_tpu_and_cpu_are_equal_collect(fn, approx_float=True)
