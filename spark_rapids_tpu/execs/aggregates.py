@@ -59,18 +59,21 @@ class CpuHashAggregateExec(CpuExec):
 
     def __init__(self, grouping: Sequence[Expression],
                  aggregates: Sequence[Expression], child: PhysicalPlan,
-                 output: List[AttributeReference]):
+                 output: List[AttributeReference], per_partition: bool = False):
         super().__init__([child])
         self.grouping = bind_all(list(grouping), child.output)
         self.aggregates = [bind_references(a, child.output) for a in aggregates]
         self._output = output
+        # per_partition: child is hash-distributed by the grouping keys (an
+        # exchange below us) so each partition aggregates independently
+        self.per_partition = per_partition
 
     @property
     def output(self):
         return self._output
 
     def num_partitions(self) -> int:
-        return 1
+        return self.children[0].num_partitions() if self.per_partition else 1
 
     def node_desc(self) -> str:
         return f"CpuHashAggregate[keys={len(self.grouping)}]"
@@ -80,8 +83,11 @@ class CpuHashAggregateExec(CpuExec):
         import pyarrow.compute as pc
         child = self.children[0]
         tables = []
-        for p in range(child.num_partitions()):
-            tables.extend(child.execute_partition(p, ctx))
+        if self.per_partition:
+            tables.extend(child.execute_partition(idx, ctx))
+        else:
+            for p in range(child.num_partitions()):
+                tables.extend(child.execute_partition(p, ctx))
         if not tables:
             base = None
         else:
@@ -488,19 +494,21 @@ class TpuHashAggregateExec(TpuExec):
 
     def __init__(self, grouping: Sequence[Expression],
                  aggregates: Sequence[Expression], child: PhysicalPlan,
-                 output: List[AttributeReference], mode: str = "complete"):
+                 output: List[AttributeReference], mode: str = "complete",
+                 per_partition: bool = False):
         super().__init__([child])
         self.grouping = bind_all(list(grouping), child.output)
         self.aggregates = [bind_references(a, child.output) for a in aggregates]
         self._output = output
         self.mode = mode
+        self.per_partition = per_partition
 
     @property
     def output(self):
         return self._output
 
     def num_partitions(self) -> int:
-        return 1
+        return self.children[0].num_partitions() if self.per_partition else 1
 
     def node_desc(self) -> str:
         return f"TpuHashAggregate[keys={len(self.grouping)}]"
@@ -512,8 +520,11 @@ class TpuHashAggregateExec(TpuExec):
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         child = self.children[0]
         batches: List[TpuColumnarBatch] = []
-        for p in range(child.num_partitions()):
-            batches.extend(child.execute_partition(p, ctx))
+        if self.per_partition:
+            batches.extend(child.execute_partition(idx, ctx))
+        else:
+            for p in range(child.num_partitions()):
+                batches.extend(child.execute_partition(p, ctx))
         agg_fns, result_exprs = split_result_exprs(self.aggregates)
         if not batches:
             if not self.grouping:
@@ -605,7 +616,4 @@ class TpuHashAggregateExec(TpuExec):
         return TpuColumnarBatch(final, 1, [a.name for a in self._output])
 
 
-def plan_cpu_aggregate(plan, conf):
-    from ..plan.planner import plan_physical
-    child = plan_physical(plan.children[0], conf)
-    return CpuHashAggregateExec(plan.grouping, plan.aggregates, child, plan.output)
+

@@ -141,7 +141,7 @@ class TpuShuffledHashJoinExec(TpuExec):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, join_type: str,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  condition: Optional[Expression],
-                 output: List[AttributeReference]):
+                 output: List[AttributeReference], per_partition: bool = False):
         super().__init__([left, right])
         self.join_type = join_type
         self.left_keys = bind_all(list(left_keys), left.output)
@@ -149,13 +149,16 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.condition = (bind_references(condition, left.output + right.output)
                           if condition is not None else None)
         self._output = output
+        # per_partition: both sides are co-partitioned by the join keys (hash
+        # exchanges below us) so each partition joins independently
+        self.per_partition = per_partition
 
     @property
     def output(self):
         return self._output
 
     def num_partitions(self) -> int:
-        return 1
+        return self.children[0].num_partitions() if self.per_partition else 1
 
     def node_desc(self) -> str:
         return f"TpuShuffledHashJoin[{self.join_type}]"
@@ -164,15 +167,18 @@ class TpuShuffledHashJoinExec(TpuExec):
         return {"buildTime": "MODERATE", "joinTime": "MODERATE",
                 "numPairs": "DEBUG"}
 
-    def _collect_side(self, child: PhysicalPlan, ctx) -> Optional[TpuColumnarBatch]:
+    def _collect_side(self, child: PhysicalPlan, ctx, idx: int) -> Optional[TpuColumnarBatch]:
         batches = []
-        for p in range(child.num_partitions()):
-            batches.extend(child.execute_partition(p, ctx))
+        if self.per_partition:
+            batches.extend(child.execute_partition(idx, ctx))
+        else:
+            for p in range(child.num_partitions()):
+                batches.extend(child.execute_partition(p, ctx))
         return concat_batches(batches) if batches else None
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
-        left = self._collect_side(self.children[0], ctx)
-        right = self._collect_side(self.children[1], ctx)
+        left = self._collect_side(self.children[0], ctx, idx)
+        right = self._collect_side(self.children[1], ctx, idx)
         jt = self.join_type
         names = [a.name for a in self._output]
         l_empty = left is None or left.num_rows == 0
@@ -331,7 +337,7 @@ class CpuShuffledHashJoinExec(CpuExec):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, join_type: str,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  condition: Optional[Expression],
-                 output: List[AttributeReference]):
+                 output: List[AttributeReference], per_partition: bool = False):
         super().__init__([left, right])
         self.join_type = join_type
         self.left_keys = bind_all(list(left_keys), left.output)
@@ -339,25 +345,29 @@ class CpuShuffledHashJoinExec(CpuExec):
         self.condition = (bind_references(condition, left.output + right.output)
                           if condition is not None else None)
         self._output = output
+        self.per_partition = per_partition
 
     @property
     def output(self):
         return self._output
 
     def num_partitions(self) -> int:
-        return 1
+        return self.children[0].num_partitions() if self.per_partition else 1
 
     def node_desc(self) -> str:
         return f"CpuShuffledHashJoin[{self.join_type}]"
 
-    def _side_table(self, child, ctx, prefix: str):
+    def _side_table(self, child, ctx, prefix: str, idx: int = 0):
         """Collect one side with positionally-unique column names (both sides may
         share user-visible names; expressions bind by ordinal, not name)."""
         import pyarrow as pa
         from ..types import to_arrow
         tables = []
-        for p in range(child.num_partitions()):
-            tables.extend(child.execute_partition(p, ctx))
+        if self.per_partition:
+            tables.extend(child.execute_partition(idx, ctx))
+        else:
+            for p in range(child.num_partitions()):
+                tables.extend(child.execute_partition(p, ctx))
         names = [f"{prefix}{i}" for i in range(len(child.output))]
         if tables:
             return pa.concat_tables(
@@ -368,8 +378,8 @@ class CpuShuffledHashJoinExec(CpuExec):
     def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
         import pyarrow as pa
         import pyarrow.compute as pc
-        lt = self._side_table(self.children[0], ctx, "l")
-        rt = self._side_table(self.children[1], ctx, "r")
+        lt = self._side_table(self.children[0], ctx, "l", idx)
+        rt = self._side_table(self.children[1], ctx, "r", idx)
         jt = self.join_type
         n_l = len(self.children[0].output)
         n_r = len(self.children[1].output)
@@ -504,13 +514,4 @@ class CpuBroadcastNestedLoopJoinExec(CpuExec):
         yield joined.rename_columns([a.name for a in self._output])
 
 
-def plan_cpu_join(plan, conf):
-    from ..plan.planner import plan_physical
-    left = plan_physical(plan.left, conf)
-    right = plan_physical(plan.right, conf)
-    if plan.left_keys:
-        return CpuShuffledHashJoinExec(left, right, plan.join_type,
-                                       plan.left_keys, plan.right_keys,
-                                       plan.condition, plan.output)
-    return CpuBroadcastNestedLoopJoinExec(left, right, plan.join_type,
-                                          plan.condition, plan.output)
+

@@ -153,7 +153,8 @@ def _tag_aggregate(meta: PlanMeta) -> None:
 def _convert_aggregate(meta: PlanMeta, ch):
     from ..execs.aggregates import TpuHashAggregateExec
     p = meta.plan
-    return TpuHashAggregateExec(p.grouping, p.aggregates, ch[0], p.output)
+    return TpuHashAggregateExec(p.grouping, p.aggregates, ch[0], p.output,
+                                per_partition=p.per_partition)
 
 
 from ..execs.aggregates import CpuHashAggregateExec as _CpuAgg  # noqa: E402
@@ -174,7 +175,8 @@ def _convert_hash_join(meta: PlanMeta, ch):
     from ..execs.joins import TpuShuffledHashJoinExec
     p = meta.plan
     return TpuShuffledHashJoinExec(ch[0], ch[1], p.join_type, p.left_keys,
-                                   p.right_keys, p.condition, p.output)
+                                   p.right_keys, p.condition, p.output,
+                                   per_partition=p.per_partition)
 
 
 def _tag_bnlj(meta: PlanMeta) -> None:
@@ -198,6 +200,24 @@ register_exec(_CpuShj, "shuffled hash join",
 register_exec(_CpuBnlj, "broadcast nested loop join",
               "spark.rapids.sql.exec.BroadcastNestedLoopJoinExec",
               _tag_bnlj, _convert_bnlj)
+
+
+def _tag_exchange(meta: PlanMeta) -> None:
+    meta.add_exprs(meta.plan.keys)
+
+
+def _convert_exchange(meta: PlanMeta, ch):
+    from ..shuffle.exchange import TpuShuffleExchangeExec
+    p = meta.plan
+    return TpuShuffleExchangeExec(ch[0], p.partitioning, p.keys,
+                                  p.num_partitions())
+
+
+from ..shuffle.exchange import CpuShuffleExchangeExec as _CpuExch  # noqa: E402
+
+register_exec(_CpuExch, "shuffle exchange",
+              "spark.rapids.sql.exec.ShuffleExchangeExec",
+              _tag_exchange, _convert_exchange)
 
 
 def wrap_and_tag_plan(plan: PhysicalPlan, conf: RapidsConf) -> PlanMeta:

@@ -39,11 +39,42 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
         child = plan_physical(plan.children[0], conf)
         return CE.CpuSortExec(plan.order, plan.global_sort, child)
     if isinstance(plan, L.Aggregate):
-        from ..execs.aggregates import plan_cpu_aggregate
-        return plan_cpu_aggregate(plan, conf)
+        from ..config import SHUFFLE_PARTITIONS
+        from ..execs.aggregates import CpuHashAggregateExec
+        from ..shuffle.exchange import CpuShuffleExchangeExec
+        child = plan_physical(plan.children[0], conf)
+        if plan.grouping and child.num_partitions() > 1:
+            # distribute by grouping keys so each output partition holds whole
+            # groups (Spark: partial agg → Exchange(hash) → final agg; partial
+            # state compaction before the exchange is a planned optimization)
+            n = min(conf.get(SHUFFLE_PARTITIONS), max(child.num_partitions(), 2))
+            child = CpuShuffleExchangeExec(child, "hash", plan.grouping, n)
+            return CpuHashAggregateExec(plan.grouping, plan.aggregates, child,
+                                        plan.output, per_partition=True)
+        return CpuHashAggregateExec(plan.grouping, plan.aggregates, child,
+                                    plan.output)
     if isinstance(plan, L.Join):
-        from ..execs.joins import plan_cpu_join
-        return plan_cpu_join(plan, conf)
+        from ..config import SHUFFLE_PARTITIONS
+        from ..execs.joins import (CpuBroadcastNestedLoopJoinExec,
+                                   CpuShuffledHashJoinExec)
+        from ..shuffle.exchange import CpuShuffleExchangeExec
+        left = plan_physical(plan.left, conf)
+        right = plan_physical(plan.right, conf)
+        if plan.left_keys:
+            if left.num_partitions() > 1 or right.num_partitions() > 1:
+                n = min(conf.get(SHUFFLE_PARTITIONS),
+                        max(left.num_partitions(), right.num_partitions(), 2))
+                left = CpuShuffleExchangeExec(left, "hash", plan.left_keys, n)
+                right = CpuShuffleExchangeExec(right, "hash", plan.right_keys, n)
+                return CpuShuffledHashJoinExec(left, right, plan.join_type,
+                                               plan.left_keys, plan.right_keys,
+                                               plan.condition, plan.output,
+                                               per_partition=True)
+            return CpuShuffledHashJoinExec(left, right, plan.join_type,
+                                           plan.left_keys, plan.right_keys,
+                                           plan.condition, plan.output)
+        return CpuBroadcastNestedLoopJoinExec(left, right, plan.join_type,
+                                              plan.condition, plan.output)
     if isinstance(plan, L.Repartition):
         from ..shuffle.exchange import plan_cpu_exchange
         return plan_cpu_exchange(plan, conf)

@@ -1,0 +1,166 @@
+"""Shuffle exchange execs: repartition data across N output partitions.
+
+Reference: GpuShuffleExchangeExecBase.scala (prepareBatchShuffleDependency:277 —
+partition on device then hand slices to the shuffle manager) + ShuffledBatchRDD.
+Map side runs once per exchange (memoized, like Spark materializing a shuffle
+stage); reduce side reads its partition's blocks through the multithreaded
+manager and re-uploads to device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar.batch import TpuColumnarBatch
+from ..config import SHUFFLE_PARTITIONS
+from ..expressions.base import AttributeReference, Expression
+from .manager import TpuShuffleManager
+from .partitioner import (hash_partition_ids, np_hash_partition_ids,
+                          round_robin_partition_ids, split_by_partition)
+from ..execs.base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all)
+
+
+class _ExchangeBase:
+    """Shared map-side materialization (runs once, guarded)."""
+
+    def _init_exchange(self, partitioning: str, keys, num_partitions: int):
+        self.partitioning = partitioning
+        self.keys = keys
+        self._n_out = num_partitions
+        self._mat_lock = threading.Lock()
+        self._shuffle_id: Optional[int] = None
+        self._n_maps = 0
+
+    def num_partitions(self) -> int:
+        return self._n_out
+
+    def _ensure_materialized(self, ctx: TaskContext) -> None:
+        with self._mat_lock:
+            if self._shuffle_id is not None:
+                return
+            mgr = TpuShuffleManager.get(ctx.conf)
+            sid = mgr.new_shuffle_id()
+            child = self.children[0]
+            self._n_maps = child.num_partitions()
+            for map_id in range(self._n_maps):
+                map_ctx = TaskContext(map_id, ctx.conf)
+                tables = self._partition_map_task(map_id, map_ctx)
+                mgr.write_map_output(sid, map_id, tables)
+            self._shuffle_id = sid
+
+
+class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
+    def __init__(self, child: PhysicalPlan, partitioning: str,
+                 keys: Sequence[Expression], num_partitions: int):
+        TpuExec.__init__(self, [child])
+        self._init_exchange(partitioning, bind_all(list(keys), child.output),
+                            num_partitions)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"TpuShuffleExchange[{self.partitioning}, n={self._n_out}]"
+
+    def additional_metrics(self):
+        return {"partitionTime": "MODERATE", "serializationTime": "MODERATE",
+                "deserializationTime": "MODERATE"}
+
+    def _partition_map_task(self, map_id: int, ctx: TaskContext) -> List:
+        """Run one map task: device partition-split then download slices."""
+        import pyarrow as pa
+        n = self._n_out
+        acc: List[List] = [[] for _ in range(n)]
+        for batch in self.children[0].execute_partition(map_id, ctx):
+            if batch.num_rows == 0:
+                continue
+            with self.metrics["partitionTime"].timed():
+                if self.partitioning == "hash":
+                    pids = hash_partition_ids(batch, self.keys, n, ctx)
+                    parts = split_by_partition(batch, pids, n)
+                elif self.partitioning in ("roundrobin", "coalesce"):
+                    pids = round_robin_partition_ids(batch, n, map_id)
+                    parts = split_by_partition(batch, pids, n)
+                elif self.partitioning == "single":
+                    parts = [batch] + [None] * (n - 1)
+                else:
+                    raise NotImplementedError(self.partitioning)
+            with self.metrics["serializationTime"].timed():
+                for p, sub in enumerate(parts):
+                    if sub is not None and sub.num_rows:
+                        acc[p].append(sub.to_arrow())
+        out = []
+        for p in range(n):
+            out.append(pa.concat_tables(acc[p]) if acc[p] else None)
+        return out
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        self._ensure_materialized(ctx)
+        mgr = TpuShuffleManager.get(ctx.conf)
+        with self.metrics["deserializationTime"].timed():
+            tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps)
+        names = [a.name for a in self.output]
+        for t in tables:
+            if t.num_rows:
+                yield TpuColumnarBatch.from_arrow(t).rename(names)
+
+
+class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
+    def __init__(self, child: PhysicalPlan, partitioning: str,
+                 keys: Sequence[Expression], num_partitions: int):
+        CpuExec.__init__(self, [child])
+        self._init_exchange(partitioning, bind_all(list(keys), child.output),
+                            num_partitions)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"CpuShuffleExchange[{self.partitioning}, n={self._n_out}]"
+
+    def _partition_map_task(self, map_id: int, ctx: TaskContext) -> List:
+        import pyarrow as pa
+        n = self._n_out
+        acc: List[List] = [[] for _ in range(n)]
+        for t in self.children[0].execute_partition(map_id, ctx):
+            if t.num_rows == 0:
+                continue
+            if self.partitioning == "hash":
+                pids = np_hash_partition_ids(t, self.keys, n, ctx)
+            elif self.partitioning in ("roundrobin", "coalesce"):
+                pids = (np.arange(t.num_rows) + map_id) % n
+            elif self.partitioning == "single":
+                acc[0].append(t)
+                continue
+            else:
+                raise NotImplementedError(self.partitioning)
+            for p in range(n):
+                sel = np.nonzero(pids == p)[0]
+                if len(sel):
+                    acc[p].append(t.take(pa.array(sel)))
+        return [pa.concat_tables(a) if a else None for a in acc]
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        self._ensure_materialized(ctx)
+        mgr = TpuShuffleManager.get(ctx.conf)
+        tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps)
+        names = [a.name for a in self.output]
+        for t in tables:
+            if t.num_rows:
+                yield t.rename_columns(names)
+
+
+def plan_cpu_exchange(plan, conf):
+    from ..plan.planner import plan_physical
+    child = plan_physical(plan.children[0], conf)
+    part = plan.partitioning
+    n = plan.num_partitions
+    if part == "coalesce" and n >= child.num_partitions():
+        return child  # coalesce to >= current count: no-op
+    return CpuShuffleExchangeExec(child, "hash" if plan.keys else part,
+                                  plan.keys, n)

@@ -1,0 +1,125 @@
+"""Multithreaded shuffle manager: thread-pool parallel write/read of shuffle
+blocks on local storage.
+
+Reference: RapidsShuffleInternalManagerBase.scala MULTITHREADED mode
+(RapidsShuffleThreadedWriterBase:238, ...ReaderBase:569, BytesInFlightLimiter:529).
+The ICI mode (device-resident exchange over the interconnect, UCX analogue)
+lives in parallel/distributed.py and is selected via spark.rapids.shuffle.mode.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..config import (RapidsConf, SHUFFLE_COMPRESSION_CODEC,
+                      SHUFFLE_READER_THREADS, SHUFFLE_WRITER_THREADS,
+                      default_conf)
+from .serializer import deserialize_table, get_codec, serialize_table
+
+
+class BytesInFlightLimiter:
+    """Caps bytes held by in-flight shuffle IO (reference
+    RapidsShuffleInternalManagerBase.scala:529)."""
+
+    def __init__(self, limit_bytes: int = 512 * 1024 * 1024):
+        self._limit = limit_bytes
+        self._in_flight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._in_flight > 0 and self._in_flight + n > self._limit:
+                self._cv.wait()
+            self._in_flight += n
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._in_flight -= n
+            self._cv.notify_all()
+
+
+class TpuShuffleManager:
+    """Per-process shuffle block store (Spark shuffle-files analogue)."""
+
+    _instance: Optional["TpuShuffleManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        conf = conf or default_conf()
+        self.root = tempfile.mkdtemp(prefix="tpu_shuffle_")
+        self.codec_name = conf.get(SHUFFLE_COMPRESSION_CODEC)
+        self._writers = ThreadPoolExecutor(
+            max_workers=conf.get(SHUFFLE_WRITER_THREADS),
+            thread_name_prefix="shuffle-writer")
+        self._readers = ThreadPoolExecutor(
+            max_workers=conf.get(SHUFFLE_READER_THREADS),
+            thread_name_prefix="shuffle-reader")
+        self._limiter = BytesInFlightLimiter()
+        self._next_shuffle_id = 0
+        self._id_lock = threading.Lock()
+        self.bytes_written = 0
+        self.bytes_read = 0
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None) -> "TpuShuffleManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = TpuShuffleManager(conf)
+            return cls._instance
+
+    def new_shuffle_id(self) -> int:
+        with self._id_lock:
+            self._next_shuffle_id += 1
+            return self._next_shuffle_id
+
+    def _path(self, shuffle_id: int, map_id: int, reduce_id: int) -> str:
+        d = os.path.join(self.root, f"shuffle_{shuffle_id}")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"map_{map_id}_reduce_{reduce_id}.block")
+
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         partition_tables: List) -> None:
+        """Write one map task's per-reduce-partition tables in parallel."""
+        codec = get_codec(self.codec_name)
+
+        def write_one(reduce_id: int, table) -> None:
+            if table is None or table.num_rows == 0:
+                return
+            block = serialize_table(table, codec)
+            self._limiter.acquire(len(block))
+            try:
+                with open(self._path(shuffle_id, map_id, reduce_id), "wb") as f:
+                    f.write(block)
+                self.bytes_written += len(block)
+            finally:
+                self._limiter.release(len(block))
+
+        futures = [self._writers.submit(write_one, r, t)
+                   for r, t in enumerate(partition_tables)]
+        for f in futures:
+            f.result()
+
+    def read_partition(self, shuffle_id: int, reduce_id: int,
+                       n_maps: int) -> List:
+        """Fetch one reduce partition's blocks from all maps in parallel."""
+
+        def read_one(map_id: int):
+            p = self._path(shuffle_id, map_id, reduce_id)
+            if not os.path.exists(p):
+                return None
+            with open(p, "rb") as f:
+                block = f.read()
+            self.bytes_read += len(block)
+            return deserialize_table(block)
+
+        futures = [self._readers.submit(read_one, m) for m in range(n_maps)]
+        return [t for t in (f.result() for f in futures) if t is not None]
+
+    def cleanup(self, shuffle_id: int) -> None:
+        shutil.rmtree(os.path.join(self.root, f"shuffle_{shuffle_id}"),
+                      ignore_errors=True)

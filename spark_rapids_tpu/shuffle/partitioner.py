@@ -1,0 +1,70 @@
+"""Partitioners: split device batches by hash/round-robin/range/single.
+
+Reference: GpuPartitioning.scala:64-118 (murmur3 on device + contiguousSplit),
+GpuHashPartitioningBase.scala (Spark pid = pmod(murmur3(keys, 42), n)),
+GpuRangePartitioner.scala. Device strategy: compute pids, stable-sort rows by
+pid, sync the n partition boundaries to host, slice — the static-shape analogue
+of cuDF's contiguous split.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import TpuColumnarBatch, gather
+from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
+from ..expressions.base import Expression, to_column
+from ..expressions.hashexprs import murmur3_batch
+
+
+def hash_partition_ids(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
+                       n: int, ctx) -> jnp.ndarray:
+    """Spark HashPartitioning: pmod(murmur3(keys, seed=42), n)."""
+    cols = [to_column(k.eval_tpu(batch, ctx.eval_ctx), batch, k.dtype)
+            for k in key_exprs]
+    h = murmur3_batch(cols, batch.num_rows, batch.capacity, 42)
+    pid = h % n
+    return jnp.where(pid < 0, pid + n, pid).astype(jnp.int32)
+
+
+def round_robin_partition_ids(batch: TpuColumnarBatch, n: int,
+                              start: int = 0) -> jnp.ndarray:
+    return ((jnp.arange(batch.capacity, dtype=jnp.int32) + start) % n)
+
+
+def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[TpuColumnarBatch]]:
+    """Device split: stable sort by pid, host-sync boundaries, gather slices."""
+    cap = batch.capacity
+    mask = row_mask(batch.num_rows, cap)
+    key = jnp.where(mask, pids, n)  # padding last
+    order = jnp.argsort(key, stable=True)
+    sorted_pid = jnp.take(key, order)
+    bounds = np.asarray(jnp.searchsorted(sorted_pid, jnp.arange(n + 1)))  # host sync
+    out: List[Optional[TpuColumnarBatch]] = []
+    for p in range(n):
+        lo, hi = int(bounds[p]), int(bounds[p + 1])
+        cnt = hi - lo
+        if cnt == 0:
+            out.append(None)
+            continue
+        idx = jnp.take(order, jnp.clip(jnp.arange(bucket_capacity(cnt)) + lo,
+                                       0, cap - 1))
+        out.append(gather(batch, idx, cnt, bucket_capacity(cnt)))
+    return out
+
+
+def np_hash_partition_ids(table, key_exprs, n: int, ctx) -> np.ndarray:
+    """Host mirror for the CPU exchange path."""
+    from ..expressions.hashexprs import _np_hash_col
+    import pyarrow as pa
+    seeds = np.full(table.num_rows, np.uint32(42), np.uint32)
+    for k in key_exprs:
+        arr = k.eval_cpu(table, ctx.eval_ctx)
+        if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
+            arr = pa.array([arr] * table.num_rows)
+        seeds = _np_hash_col(k.dtype, arr, seeds)
+    h = seeds.view(np.int32).astype(np.int64)
+    return ((h % n) + n) % n

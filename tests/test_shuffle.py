@@ -1,0 +1,102 @@
+"""Shuffle/exchange tests: repartition, partitioned aggregate + join,
+serializer roundtrip (reference repart_test.py + shuffle suites)."""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+
+
+def test_serializer_roundtrip():
+    import pyarrow as pa
+    from spark_rapids_tpu.shuffle.serializer import (deserialize_table,
+                                                     get_codec, serialize_table)
+    t = pa.table({"a": [1, 2, None], "s": ["x", None, "zz"]})
+    for codec in ("none", "zstd"):
+        blk = serialize_table(t, get_codec(codec))
+        back = deserialize_table(blk)
+        assert back.equals(t)
+
+
+def test_repartition_preserves_rows():
+    gens = [("a", IntegerGen()), ("s", StringGen())]
+
+    def fn(s):
+        df = s.createDataFrame(gen_df(gens, 300, 9), num_partitions=3)
+        return df.repartition(5, "a")
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_roundrobin_repartition():
+    def fn(s):
+        return s.range(0, 500, numPartitions=4).repartition(3)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_partitioned_groupby():
+    gens = [("k", IntegerGen(min_val=0, max_val=50, null_prob=0.2)),
+            ("v", LongGen()), ("d", DoubleGen())]
+
+    def fn(s):
+        df = s.createDataFrame(gen_df(gens, 1000, 21), num_partitions=4)
+        return df.groupBy("k").agg(
+            F.sum(F.col("v")).alias("sv"),
+            F.count(F.col("v")).alias("cv"),
+            F.avg(F.col("d")).alias("ad"))
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True,
+                                         approx_float=True)
+
+
+def test_partitioned_groupby_string_key():
+    gens = [("k", StringGen(alphabet="abcd", max_len=2, null_prob=0.1)),
+            ("v", IntegerGen())]
+
+    def fn(s):
+        df = s.createDataFrame(gen_df(gens, 600, 22), num_partitions=4)
+        return df.groupBy("k").agg(F.sum(F.col("v")).alias("sv"))
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+@pytest.mark.parametrize("join_type", ["inner", "left", "full"])
+def test_partitioned_join(join_type):
+    def fn(s):
+        l = s.createDataFrame(gen_df(
+            [("k", IntegerGen(min_val=0, max_val=30, null_prob=0.1)),
+             ("lv", IntegerGen())], 400, 31), num_partitions=4)
+        r = s.createDataFrame(gen_df(
+            [("k", IntegerGen(min_val=0, max_val=30, null_prob=0.1)),
+             ("rv", DoubleGen())], 300, 32), num_partitions=3)
+        return l.join(r, on="k", how=join_type)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_partitioned_join_then_agg():
+    """Q3-ish over partitions: join + groupby across exchanges."""
+    def fn(s):
+        l = s.createDataFrame(gen_df(
+            [("k", IntegerGen(min_val=0, max_val=20, null_prob=0.0)),
+             ("g", IntegerGen(min_val=0, max_val=5, null_prob=0.0)),
+             ("lv", IntegerGen())], 500, 41), num_partitions=4)
+        r = s.createDataFrame(gen_df(
+            [("k", IntegerGen(min_val=0, max_val=20, null_prob=0.0)),
+             ("rv", DoubleGen(null_prob=0.0))], 200, 42), num_partitions=2)
+        return (l.join(r, on="k", how="inner")
+                .groupBy("g")
+                .agg(F.sum(F.col("rv")).alias("srv"),
+                     F.count(F.col("lv")).alias("c")))
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True,
+                                         approx_float=True)
+
+
+def test_exchange_on_tpu_plan():
+    """Assert the exchange itself converts (no CPU fallback in tpu test mode)."""
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.sql.test.enabled": "true"})
+    import pyarrow as pa
+    df = s.createDataFrame(
+        pa.table({"k": list(range(100)), "v": [float(i) for i in range(100)]}),
+        num_partitions=4)
+    out = df.groupBy("k").agg(F.sum(F.col("v")).alias("s")).collect()
+    assert len(out) == 100
