@@ -85,12 +85,13 @@ class TpuShuffleManager:
     def write_map_output(self, shuffle_id: int, map_id: int,
                          partition_tables: List) -> None:
         """Write one map task's per-reduce-partition tables in parallel."""
-        codec = get_codec(self.codec_name)
 
         def write_one(reduce_id: int, table) -> None:
             if table is None or table.num_rows == 0:
                 return
-            block = serialize_table(table, codec)
+            # codec per task: zstandard compressor objects are not safe under
+            # concurrent use from multiple writer threads
+            block = serialize_table(table, get_codec(self.codec_name))
             self._limiter.acquire(len(block))
             try:
                 with open(self._path(shuffle_id, map_id, reduce_id), "wb") as f:
