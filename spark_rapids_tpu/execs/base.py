@@ -57,6 +57,19 @@ class TaskContext:
         self.conf = conf or default_conf()
         self.eval_ctx = EvalContext(self.conf)
         self.task_metrics: Dict[str, int] = {}
+        self._completion_listeners = []
+
+    def add_completion_listener(self, cb) -> None:
+        """Register a callback run at task end (reference ScalableTaskCompletion)."""
+        self._completion_listeners.append(cb)
+
+    def complete(self) -> None:
+        for cb in reversed(self._completion_listeners):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - completion must not mask results
+                pass
+        self._completion_listeners.clear()
 
 
 class PhysicalPlan:

@@ -229,7 +229,8 @@ def _np_hash_col(dt: DataType, arr, seeds: np.ndarray) -> np.ndarray:
                 continue
             out[i] = _np_murmur3_bytes(s.encode(), seeds[i])
         return out
-    vals = np.asarray(a.fill_null(0).to_numpy(zero_copy_only=False))
+    fill = False if isinstance(dt, BooleanType) else 0
+    vals = np.asarray(a.fill_null(fill).to_numpy(zero_copy_only=False))
     if isinstance(dt, (BooleanType,)):
         h = np_murmur3_int(vals.astype(np.uint32), seeds)
     elif isinstance(dt, (ByteType, ShortType, IntegerType, DateType)):

@@ -1,0 +1,66 @@
+"""Scan-layer shared helpers: predicate pushdown conversion.
+
+Reference: the row-group filter handler of GpuParquetScan
+(GpuParquetFileFilterHandler:446) — filters prune row groups by footer
+statistics before any decode. pyarrow.parquet applies the same pruning given
+DNF filter tuples; we convert the supported subset of our expression tree and
+keep the full Filter exec above the scan for exactness (like the reference)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..expressions.base import AttributeReference, Expression, Literal
+from ..expressions import predicates as P
+from ..expressions.nullexprs import IsNotNull, IsNull
+
+
+def _leaf_filter(e: Expression) -> Optional[Tuple[str, str, object]]:
+    ops = {P.EqualTo: "==", P.LessThan: "<", P.LessThanOrEqual: "<=",
+           P.GreaterThan: ">", P.GreaterThanOrEqual: ">="}
+    flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+    for cls, op in ops.items():
+        if isinstance(e, cls):
+            l, r = e.children
+            if isinstance(l, AttributeReference) and isinstance(r, Literal) \
+                    and r.value is not None:
+                return (l.name, op, r.value)
+            if isinstance(r, AttributeReference) and isinstance(l, Literal) \
+                    and l.value is not None:
+                return (r.name, flipped[op], l.value)
+    if isinstance(e, P.In) and isinstance(e.value, AttributeReference):
+        vals = [i.value for i in e.items
+                if isinstance(i, Literal) and i.value is not None]
+        if len(vals) == len(e.items):
+            return (e.value.name, "in", vals)
+    # IsNull/IsNotNull: footer statistics cannot prune these portably — skip
+    return None
+
+
+def arrow_filter_from_condition(conjuncts: Sequence[Expression]):
+    """AND-list of expressions → pyarrow DNF filter (single conjunction), or
+    None when nothing is convertible."""
+    leaves = []
+    for c in conjuncts:
+        leaf = _leaf_filter(c)
+        if leaf is not None:
+            leaves.append(leaf)
+    return leaves or None
+
+
+def split_conjuncts(cond: Expression) -> List[Expression]:
+    out: List[Expression] = []
+
+    def walk(e: Expression):
+        if isinstance(e, P.And):
+            walk(e.children[0])
+            walk(e.children[1])
+        else:
+            out.append(e)
+
+    walk(cond)
+    return out
+
+
+def pushable(e: Expression) -> bool:
+    return _leaf_filter(e) is not None

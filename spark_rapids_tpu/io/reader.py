@@ -1,49 +1,72 @@
-"""DataFrameReader: file-format scan entry points (round-1: eager pyarrow read
-into a LocalRelation; the real multi-strategy TPU scan layer lands with io/parquet.py)."""
+"""DataFrameReader: lazy file-source scans (reference GpuFileSourceScanExec
+wiring + the read-side of GpuDataSource)."""
 
 from __future__ import annotations
 
+import glob as _glob
+import os
 from typing import List, Optional
+
+
+def _expand(paths) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for ext in ("parquet", "orc", "csv", "json"):
+                out.extend(sorted(_glob.glob(os.path.join(p, f"*.{ext}"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files for {paths}")
+    return out
 
 
 class DataFrameReader:
     def __init__(self, session):
         self._session = session
         self._options = {}
+        self._schema = None
 
-    def option(self, key, value):
+    def option(self, key, value) -> "DataFrameReader":
         self._options[str(key)] = value
         return self
 
-    def parquet(self, *paths: str):
-        import pyarrow.parquet as pq
-        import pyarrow as pa
-        from ..plan.logical import LocalRelation
-        from ..session import DataFrame
-        tables = [pq.read_table(p) for p in paths]
-        table = pa.concat_tables(tables)
-        return DataFrame(LocalRelation(table, max(1, len(paths))), self._session)
+    def options(self, **kw) -> "DataFrameReader":
+        self._options.update({str(k): v for k, v in kw.items()})
+        return self
 
-    def csv(self, path: str, header: bool = None, inferSchema: bool = None, **kw):
-        import pyarrow.csv as pacsv
-        from ..plan.logical import LocalRelation
+    def schema(self, schema) -> "DataFrameReader":
+        self._schema = schema
+        return self
+
+    def format(self, fmt: str) -> "DataFrameReader":
+        self._options["__format__"] = fmt
+        return self
+
+    def load(self, path: str):
+        fmt = self._options.pop("__format__", "parquet")
+        return self._scan([path], fmt)
+
+    def _scan(self, paths, fmt: str):
+        from ..plan.logical import FileScan
         from ..session import DataFrame
-        header = header if header is not None else \
-            str(self._options.get("header", "false")).lower() == "true"
-        ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
-        table = pacsv.read_csv(path, read_options=ropts)
-        return DataFrame(LocalRelation(table, 1), self._session)
+        files = _expand(paths)
+        return DataFrame(FileScan(files, fmt, options=self._options),
+                         self._session)
+
+    def parquet(self, *paths: str):
+        return self._scan(paths, "parquet")
+
+    def csv(self, path: str, header: Optional[bool] = None,
+            inferSchema: Optional[bool] = None, **kw):
+        if header is not None:
+            self._options["header"] = str(bool(header)).lower()
+        return self._scan([path], "csv")
 
     def json(self, path: str):
-        import pyarrow.json as pajson
-        from ..plan.logical import LocalRelation
-        from ..session import DataFrame
-        table = pajson.read_json(path)
-        return DataFrame(LocalRelation(table, 1), self._session)
+        return self._scan([path], "json")
 
     def orc(self, path: str):
-        import pyarrow.orc as paorc
-        from ..plan.logical import LocalRelation
-        from ..session import DataFrame
-        table = paorc.read_table(path)
-        return DataFrame(LocalRelation(table, 1), self._session)
+        return self._scan([path], "orc")

@@ -1,0 +1,132 @@
+"""DataFrameWriter: columnar file writers.
+
+Reference: ColumnarOutputWriter.scala (251, retry-aware base) +
+GpuParquetFileFormat.scala / GpuOrcFileFormat.scala / GpuFileFormatDataWriter
+(dynamic partitioning). Host pyarrow writers consume the executed plan's
+partition streams — one output file per partition (part-NNNNN), Spark layout,
+with dynamic partitionBy subdirectories."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self._df = df
+        self._mode = "errorifexists"
+        self._options = {}
+        self._partition_by: List[str] = []
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m.lower()
+        return self
+
+    def option(self, key, value) -> "DataFrameWriter":
+        self._options[str(key)] = value
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    def _prepare_dir(self, path: str) -> None:
+        if os.path.exists(path):
+            if self._mode == "overwrite":
+                shutil.rmtree(path)
+            elif self._mode in ("ignore",):
+                return
+            elif self._mode != "append":
+                raise FileExistsError(f"path {path} exists (mode={self._mode})")
+        os.makedirs(path, exist_ok=True)
+
+    def _execute_partitions(self):
+        """Yield (partition_index, arrow table) from the physical plan."""
+        from ..execs.base import TaskContext
+        from ..plan.overrides import TpuOverrides
+        from ..plan.planner import plan_physical
+        session = self._df.session
+        conf = session._rapids_conf()
+        cpu_plan = plan_physical(self._df._plan, conf)
+        final = TpuOverrides.apply(cpu_plan, conf)
+        names = [a.name for a in final.output]
+        import pyarrow as pa
+        for p in range(final.num_partitions()):
+            ctx = TaskContext(p, conf)
+            try:
+                tables = [t.rename_columns(names)
+                          for t in final.execute_partition(p, ctx) if t.num_rows]
+            finally:
+                ctx.complete()
+            if tables:
+                yield p, pa.concat_tables(tables)
+
+    def _write(self, path: str, ext: str, write_fn) -> None:
+        import pyarrow as pa
+        self._prepare_dir(path)
+        wrote = False
+        for p, table in self._execute_partitions():
+            if self._partition_by:
+                self._write_dynamic(path, ext, write_fn, p, table)
+                wrote = True
+                continue
+            write_fn(table, os.path.join(path, f"part-{p:05d}.{ext}"))
+            wrote = True
+        if not wrote:
+            # empty result: still record the schema (parquet only)
+            from ..types import to_arrow
+            schema = pa.schema([(a.name, to_arrow(a.dtype))
+                                for a in self._df._plan.output])
+            write_fn(schema.empty_table(),
+                     os.path.join(path, f"part-00000.{ext}"))
+
+    def _write_dynamic(self, path, ext, write_fn, p, table) -> None:
+        """Dynamic-partition layout: key1=v1/key2=v2/part-NNNNN (reference
+        GpuFileFormatDataWriter dynamic partitioning)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        keys = self._partition_by
+        data_cols = [c for c in table.column_names if c not in keys]
+        combos = table.select(keys).group_by(keys).aggregate([])
+        for row in combos.to_pylist():
+            mask = None
+            for k in keys:
+                v = row[k]
+                m = pc.is_null(table.column(k)) if v is None \
+                    else pc.equal(table.column(k), v)
+                m = pc.fill_null(m, False)
+                mask = m if mask is None else pc.and_(mask, m)
+            sub = table.filter(mask).select(data_cols)
+            subdir = "/".join(
+                f"{k}={'__HIVE_DEFAULT_PARTITION__' if row[k] is None else row[k]}"
+                for k in keys)
+            d = os.path.join(path, subdir)
+            os.makedirs(d, exist_ok=True)
+            write_fn(sub, os.path.join(d, f"part-{p:05d}.{ext}"))
+
+    def parquet(self, path: str) -> None:
+        import pyarrow.parquet as pq
+        compression = self._options.get("compression", "snappy")
+        self._write(path, "parquet",
+                    lambda t, p: pq.write_table(t, p, compression=compression))
+
+    def orc(self, path: str) -> None:
+        import pyarrow.orc as paorc
+        self._write(path, "orc", lambda t, p: paorc.write_table(t, p))
+
+    def csv(self, path: str) -> None:
+        import pyarrow.csv as pacsv
+        header = str(self._options.get("header", "true")).lower() == "true"
+        opts = pacsv.WriteOptions(include_header=header)
+        self._write(path, "csv",
+                    lambda t, p: pacsv.write_csv(t, p, write_options=opts))
+
+    def json(self, path: str) -> None:
+        def write_json(t, p):
+            import json as _json
+            with open(p, "w") as f:
+                for row in t.to_pylist():
+                    f.write(_json.dumps(row, default=str) + "\n")
+        self._write(path, "json", write_json)

@@ -1,0 +1,66 @@
+"""TpuSemaphore: admission control limiting concurrent tasks holding HBM.
+
+Reference: GpuSemaphore.scala (acquireIfNecessary/releaseIfNecessary; default
+concurrency spark.rapids.tpu.concurrentTpuTasks=2, RapidsConf.scala:544-551).
+A task acquires once before its first device allocation and releases at task
+completion (guaranteed by the TaskContext completion listener); operators may
+release around long host-IO waits to let other tasks use the device, exactly
+the reference's pattern around shuffle/scan IO.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..config import CONCURRENT_TPU_TASKS, RapidsConf, default_conf
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.BoundedSemaphore(permits)
+        self._holders: Dict[int, int] = {}  # task id -> acquire depth
+        self._state_lock = threading.Lock()
+        self.total_waits_ns = 0
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                conf = conf or default_conf()
+                cls._instance = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def acquire_if_necessary(self, ctx) -> None:
+        """First call for a task blocks for a permit; later calls are no-ops.
+        Registers release at task completion (reference: task-completion
+        listener guarantees release, GpuSemaphore.scala)."""
+        import time
+        tid = id(ctx)
+        with self._state_lock:
+            if tid in self._holders:
+                self._holders[tid] += 1
+                return
+        t0 = time.perf_counter_ns()
+        self._sem.acquire()
+        self.total_waits_ns += time.perf_counter_ns() - t0
+        with self._state_lock:
+            self._holders[tid] = 1
+        ctx.add_completion_listener(lambda: self.release_if_necessary(ctx))
+
+    def release_if_necessary(self, ctx) -> None:
+        tid = id(ctx)
+        with self._state_lock:
+            if tid not in self._holders:
+                return
+            del self._holders[tid]
+        self._sem.release()

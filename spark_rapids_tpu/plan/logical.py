@@ -71,6 +71,49 @@ class LocalRelation(LogicalPlan):
         return f"LocalRelation[{self.table.num_rows} rows]"
 
 
+class FileScan(LogicalPlan):
+    """Lazy file-source relation (reference GpuFileSourceScanExec / v2 scans)."""
+
+    def __init__(self, paths, fmt: str, schema_attrs=None, options=None,
+                 num_partitions=None):
+        self.paths = list(paths)
+        self.fmt = fmt
+        self.options = dict(options or {})
+        self.num_partitions = num_partitions
+        if schema_attrs is None:
+            schema_attrs = self._infer_schema()
+        self._output = schema_attrs
+
+    def _infer_schema(self):
+        from ..types import from_arrow
+        import pyarrow as pa
+        p = self.paths[0]
+        if self.fmt == "parquet":
+            import pyarrow.parquet as pq
+            sch = pq.read_schema(p)
+        elif self.fmt == "orc":
+            import pyarrow.orc as paorc
+            sch = paorc.ORCFile(p).schema
+        elif self.fmt == "csv":
+            import pyarrow.csv as pacsv
+            header = str(self.options.get("header", "false")).lower() == "true"
+            ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
+            sch = pacsv.read_csv(p, read_options=ropts).schema
+        elif self.fmt == "json":
+            import pyarrow.json as pajson
+            sch = pajson.read_json(p).schema
+        else:
+            raise ValueError(f"unknown format {self.fmt}")
+        return [AttributeReference(f.name, from_arrow(f.type), True) for f in sch]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"FileScan[{self.fmt}, {len(self.paths)} files]"
+
+
 class Range(LogicalPlan):
     """spark.range analogue (reference GpuRangeExec)."""
 

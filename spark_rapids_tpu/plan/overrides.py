@@ -220,6 +220,30 @@ register_exec(_CpuExch, "shuffle exchange",
               _tag_exchange, _convert_exchange)
 
 
+def _tag_file_scan(meta: PlanMeta) -> None:
+    from ..config import (CSV_ENABLED, JSON_ENABLED, ORC_ENABLED,
+                          PARQUET_ENABLED)
+    fmt_keys = {"parquet": PARQUET_ENABLED, "csv": CSV_ENABLED,
+                "json": JSON_ENABLED, "orc": ORC_ENABLED}
+    entry = fmt_keys.get(meta.plan.fmt)
+    if entry is not None and not meta.conf.get(entry):
+        meta.will_not_work_on_tpu(f"{meta.plan.fmt} scans disabled via {entry.key}")
+
+
+def _convert_file_scan(meta: PlanMeta, ch):
+    from ..io.parquet import TpuFileScanExec
+    p = meta.plan
+    return TpuFileScanExec(p.paths, p.fmt, p.output,
+                           pushed_filters=p.pushed_filters, options=p.options,
+                           num_partitions=p.num_partitions())
+
+
+from ..io.parquet import CpuFileScanExec as _CpuScan  # noqa: E402
+
+register_exec(_CpuScan, "file scan", "spark.rapids.sql.exec.FileSourceScanExec",
+              _tag_file_scan, _convert_file_scan)
+
+
 def wrap_and_tag_plan(plan: PhysicalPlan, conf: RapidsConf) -> PlanMeta:
     """reference wrapAndTagPlan (GpuOverrides.scala:4358)."""
     rule = _EXEC_RULES.get(type(plan))

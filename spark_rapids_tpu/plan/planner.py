@@ -22,11 +22,28 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
     if isinstance(plan, L.Range):
         return CE.CpuRangeExec(plan.start, plan.end, plan.step,
                                plan.num_partitions, plan.output)
+    if isinstance(plan, L.FileScan):
+        from ..io.parquet import CpuFileScanExec
+        return CpuFileScanExec(plan.paths, plan.fmt, plan.output,
+                               options=plan.options,
+                               num_partitions=plan.num_partitions)
     if isinstance(plan, L.Project):
         child = plan_physical(plan.child, conf)
         return CE.CpuProjectExec(plan.exprs, child, plan.output)
     if isinstance(plan, L.Filter):
         child = plan_physical(plan.child, conf)
+        if isinstance(plan.child, L.FileScan):
+            # predicate pushdown: route pushable conjuncts to row-group pruning,
+            # keep the exact Filter above (reference GpuParquetFileFilterHandler)
+            from ..io.base_scan import pushable, split_conjuncts
+            from ..io.parquet import CpuFileScanExec
+            conjuncts = split_conjuncts(plan.condition)
+            pushed = [c for c in conjuncts if pushable(c)]
+            if pushed and isinstance(child, CpuFileScanExec):
+                child = CpuFileScanExec(child.paths, child.fmt, child.output,
+                                        pushed_filters=pushed,
+                                        options=child.options,
+                                        num_partitions=child.num_partitions())
         return CE.CpuFilterExec(plan.condition, child)
     if isinstance(plan, L.Limit):
         child = plan_physical(plan.children[0], conf)

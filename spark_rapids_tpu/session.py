@@ -330,6 +330,11 @@ class DataFrame:
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(L.Join(self._plan, other._plan, "cross"), self.session)
 
+    @property
+    def write(self):
+        from .io.writer import DataFrameWriter
+        return DataFrameWriter(self)
+
     # --- actions ----------------------------------------------------------
     def to_arrow(self):
         import pyarrow as pa
@@ -537,9 +542,12 @@ class TpuSession:
         tables = []
         for p in range(final.num_partitions()):
             ctx = TaskContext(p, conf)
-            for t in final.execute_partition(p, ctx):
-                if t.num_rows:
-                    tables.append(t.rename_columns(names))
+            try:
+                for t in final.execute_partition(p, ctx):
+                    if t.num_rows:
+                        tables.append(t.rename_columns(names))
+            finally:
+                ctx.complete()
         if not tables:
             return schema.empty_table()
         return pa.concat_tables(tables).cast(schema)

@@ -47,7 +47,10 @@ class _ExchangeBase:
             self._n_maps = child.num_partitions()
             for map_id in range(self._n_maps):
                 map_ctx = TaskContext(map_id, ctx.conf)
-                tables = self._partition_map_task(map_id, map_ctx)
+                try:
+                    tables = self._partition_map_task(map_id, map_ctx)
+                finally:
+                    map_ctx.complete()  # releases the semaphore, if held
                 mgr.write_map_output(sid, map_id, tables)
             self._shuffle_id = sid
 

@@ -1,0 +1,123 @@
+"""File I/O tests: parquet/orc/csv/json scan + write, pushdown, multi-file
+strategies (reference parquet_test.py / orc_test.py / csv_test.py slices)."""
+
+import os
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect, with_cpu_session
+from data_gen import (BooleanGen, DateGen, DoubleGen, IntegerGen, LongGen,
+                      StringGen, TimestampGen, gen_df)
+
+import spark_rapids_tpu.functions as F
+
+GENS = [("a", IntegerGen()), ("b", LongGen()), ("d", DoubleGen()),
+        ("s", StringGen()), ("bo", BooleanGen()), ("dt", DateGen()),
+        ("ts", TimestampGen())]
+
+
+@pytest.fixture()
+def pq_files(tmp_path):
+    import pyarrow.parquet as pq
+    paths = []
+    for i in range(3):
+        t = gen_df(GENS, 200, seed=100 + i)
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    return paths
+
+
+def test_parquet_read_roundtrip(pq_files, tmp_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(*pq_files), ignore_order=True)
+
+
+@pytest.mark.parametrize("strategy", ["PERFILE", "COALESCING", "MULTITHREADED"])
+def test_parquet_multifile_strategies(pq_files, strategy):
+    conf = {"spark.rapids.sql.format.parquet.reader.type": strategy}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(*pq_files).select(
+            F.col("a"), F.col("s"), (F.col("b") + 1).alias("b1")),
+        conf=conf, ignore_order=True)
+
+
+def test_parquet_pushdown_filter(pq_files):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(*pq_files)
+        .filter((F.col("a") > 0) & (F.col("d") < 1e11)),
+        ignore_order=True)
+
+
+def test_parquet_scan_then_agg(pq_files):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(*pq_files)
+        .groupBy("bo").agg(F.count(F.col("a")).alias("c"),
+                           F.sum(F.col("b")).alias("sb")),
+        ignore_order=True)
+
+
+def test_parquet_write_read(tmp_path):
+    out = str(tmp_path / "out_pq")
+
+    def run(s):
+        df = s.createDataFrame(gen_df(GENS, 300, 7), num_partitions=3)
+        df.write.mode("overwrite").parquet(out)
+        return s.read.parquet(out)
+    assert_tpu_and_cpu_are_equal_collect(run, ignore_order=True)
+
+
+def test_parquet_partitioned_write(tmp_path):
+    out = str(tmp_path / "out_part")
+
+    def run(s):
+        df = s.createDataFrame(gen_df(
+            [("k", IntegerGen(min_val=0, max_val=3, null_prob=0.0)),
+             ("v", DoubleGen())], 100, 8))
+        df.write.mode("overwrite").partitionBy("k").parquet(out)
+        import glob
+        return sorted(glob.glob(os.path.join(out, "k=*", "*.parquet")))
+    dirs = with_cpu_session(run)
+    assert len(dirs) >= 4
+
+
+def test_csv_roundtrip(tmp_path):
+    out = str(tmp_path / "out_csv")
+    gens = [("a", IntegerGen(null_prob=0.0)),
+            ("s", StringGen(alphabet="abcXYZ", null_prob=0.0))]
+
+    def run(s):
+        df = s.createDataFrame(gen_df(gens, 100, 5))
+        df.write.mode("overwrite").option("header", "true").csv(out)
+        import glob
+        f = sorted(glob.glob(os.path.join(out, "*.csv")))[0]
+        return s.read.csv(f, header=True)
+    assert_tpu_and_cpu_are_equal_collect(run, ignore_order=True)
+
+
+def test_orc_roundtrip(tmp_path):
+    out = str(tmp_path / "out_orc")
+    gens = [("a", IntegerGen()), ("d", DoubleGen()), ("s", StringGen())]
+
+    def run(s):
+        df = s.createDataFrame(gen_df(gens, 150, 6))
+        df.write.mode("overwrite").orc(out)
+        return s.read.orc(os.path.join(out, "part-00000.orc"))
+    assert_tpu_and_cpu_are_equal_collect(run, ignore_order=True)
+
+
+def test_json_scan(tmp_path):
+    p = str(tmp_path / "data.json")
+    with open(p, "w") as f:
+        f.write('{"a": 1, "s": "x"}\n{"a": null, "s": "y"}\n{"a": 3, "s": null}\n')
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.json(p).select(F.col("a"), F.col("s")),
+        ignore_order=True)
+
+
+def test_scan_on_tpu_plan(pq_files):
+    """The scan itself must convert (no CPU fallback) in tpu test mode."""
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.sql.test.enabled": "true"})
+    rows = s.read.parquet(*pq_files).filter(F.col("a") > 0).count()
+    assert rows > 0
