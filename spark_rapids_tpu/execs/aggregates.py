@@ -531,8 +531,11 @@ class TpuHashAggregateExec(TpuExec):
                 yield self._empty_global_result(agg_fns, result_exprs, ctx)
             return
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
-        out = self._aggregate_batch(batch, agg_fns, result_exprs, ctx)
-        yield out
+        from ..memory.retry import with_retry_no_split
+        from ..memory.spill import SpillableColumnarBatch
+        yield with_retry_no_split(
+            SpillableColumnarBatch(batch),
+            lambda b: self._aggregate_batch(b, agg_fns, result_exprs, ctx))
 
     def _aggregate_batch(self, batch: TpuColumnarBatch, agg_fns, result_exprs,
                          ctx: TaskContext) -> TpuColumnarBatch:

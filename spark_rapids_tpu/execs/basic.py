@@ -35,13 +35,22 @@ class TpuProjectExec(TpuExec):
         return f"TpuProject[{', '.join(e.pretty() for e in self.exprs)}]"
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..memory.spill import SpillableColumnarBatch
+        from ..memory.retry import with_retry
         names = [a.name for a in self._output]
         op_time = self.metrics["opTime"]
+
+        def project(batch: TpuColumnarBatch) -> TpuColumnarBatch:
+            cols = [to_column(e.eval_tpu(batch, ctx.eval_ctx), batch, a.dtype)
+                    for e, a in zip(self.exprs, self._output)]
+            return TpuColumnarBatch(cols, batch.num_rows, names)
+
         for batch in self.children[0].execute_partition(idx, ctx):
             with op_time.timed():
-                cols = [to_column(e.eval_tpu(batch, ctx.eval_ctx), batch, a.dtype)
-                        for e, a in zip(self.exprs, self._output)]
-                yield TpuColumnarBatch(cols, batch.num_rows, names)
+                # spillable + retry-with-split: projection is row-wise, so split
+                # halves are independently valid outputs (reference
+                # GpuProjectExec withRetrySingleBatch, basicPhysicalOperators.scala:581)
+                yield from with_retry(SpillableColumnarBatch(batch), project)
 
 
 class TpuFilterExec(TpuExec):
@@ -57,14 +66,20 @@ class TpuFilterExec(TpuExec):
         return f"TpuFilter[{self.condition.pretty()}]"
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..memory.spill import SpillableColumnarBatch
+        from ..memory.retry import with_retry
         op_time = self.metrics["opTime"]
+
+        def do_filter(batch: TpuColumnarBatch) -> TpuColumnarBatch:
+            mask_col = to_column(self.condition.eval_tpu(batch, ctx.eval_ctx), batch)
+            mask = mask_col.data.astype(jnp.bool_)
+            if mask_col.validity is not None:
+                mask = mask & mask_col.validity  # null predicate → drop row
+            return compact(batch, mask)
+
         for batch in self.children[0].execute_partition(idx, ctx):
             with op_time.timed():
-                mask_col = to_column(self.condition.eval_tpu(batch, ctx.eval_ctx), batch)
-                mask = mask_col.data.astype(jnp.bool_)
-                if mask_col.validity is not None:
-                    mask = mask & mask_col.validity  # null predicate → drop row
-                yield compact(batch, mask)
+                yield from with_retry(SpillableColumnarBatch(batch), do_filter)
 
 
 class TpuRangeExec(TpuExec):
@@ -199,14 +214,24 @@ class TpuCoalesceBatchesExec(TpuExec):
         rows = 0
         concat_time = self.metrics["concatTime"]
         n_in = self.metrics["numInputBatches"]
+        from ..memory.spill import SpillableColumnarBatch
+        from ..memory.retry import with_retry_no_split
+
+        def concat_spillables(spillables):
+            batches = [sp.get_batch() for sp in spillables]
+            out = concat_batches(batches)
+            for sp in spillables:
+                sp.close()
+            return out
+
         for b in self.children[0].execute_partition(idx, ctx):
             n_in.add(1)
-            pending.append(b)
+            pending.append(SpillableColumnarBatch(b))
             rows += b.num_rows
             if self.goal != "require_single" and rows >= target:
                 with concat_time.timed():
-                    yield concat_batches(pending)
+                    yield concat_spillables(pending)
                 pending, rows = [], 0
         if pending:
             with concat_time.timed():
-                yield concat_batches(pending)
+                yield concat_spillables(pending)

@@ -1,0 +1,112 @@
+"""HBM budget accounting — the RMM-pool analogue for TPU.
+
+Reference: RMM via GpuDeviceManager.initializeRmm (GpuDeviceManager.scala:275)
++ DeviceMemoryEventHandler.onAllocFailure (drain spill store, retry alloc,
+DeviceMemoryEventHandler.scala:36,108). XLA owns the physical HBM allocator
+(SURVEY §2.4 mapping note), so this layer tracks *logical* bytes of live
+columnar data against a budget; exceeding it triggers the same synchronous
+spill→retry→OOM escalation the reference drives from RMM callbacks, raising
+TpuRetryOOM/TpuSplitAndRetryOOM for the retry framework to absorb.
+
+Test hooks mirror RmmSpark.forceRetryOOM / forceSplitAndRetryOOM
+(spark-rapids-jni; used by the reference's retry suites, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..config import OOM_RETRY_MAX, RapidsConf, default_conf
+from .device import TpuDeviceManager
+
+
+class TpuOOM(MemoryError):
+    """Unrecoverable device OOM (reference GpuOOM)."""
+
+
+class TpuRetryOOM(TpuOOM):
+    """Retryable: caller should release, spill, and re-execute
+    (reference GpuRetryOOM)."""
+
+
+class TpuSplitAndRetryOOM(TpuOOM):
+    """Retryable with input splitting (reference GpuSplitAndRetryOOM)."""
+
+
+class HbmBudget:
+    """Logical HBM accounting with synchronous spill-on-pressure."""
+
+    _instance: Optional["HbmBudget"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, budget_bytes: int, oom_max_retries: int = 3):
+        self.budget = budget_bytes
+        self.used = 0
+        self.oom_max_retries = oom_max_retries
+        self._alloc_lock = threading.RLock()
+        self._spill_callback: Optional[Callable[[int], int]] = None
+        # test injection state (RmmSpark.force*OOM analogue)
+        self._forced_retry = 0
+        self._forced_split_retry = 0
+        self.peak_used = 0
+        self.alloc_count = 0
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None) -> "HbmBudget":
+        with cls._lock:
+            if cls._instance is None:
+                conf = conf or default_conf()
+                cls._instance = HbmBudget(TpuDeviceManager.hbm_budget_bytes(),
+                                          conf.get(OOM_RETRY_MAX))
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls, budget_bytes: Optional[int] = None) -> "HbmBudget":
+        with cls._lock:
+            cls._instance = HbmBudget(budget_bytes
+                                      or TpuDeviceManager.hbm_budget_bytes())
+            return cls._instance
+
+    def set_spill_callback(self, cb: Callable[[int], int]) -> None:
+        """cb(bytes_needed) -> bytes_freed; called under allocation pressure
+        (reference RmmEventHandler.onAllocFailure wiring)."""
+        self._spill_callback = cb
+
+    # --- test injection (reference RmmSpark.forceRetryOOM) -----------------
+    def force_retry_oom(self, n: int = 1) -> None:
+        self._forced_retry = n
+
+    def force_split_and_retry_oom(self, n: int = 1) -> None:
+        self._forced_split_retry = n
+
+    # --- allocation --------------------------------------------------------
+    def allocate(self, nbytes: int) -> None:
+        with self._alloc_lock:
+            self.alloc_count += 1
+            if self._forced_split_retry > 0:
+                self._forced_split_retry -= 1
+                raise TpuSplitAndRetryOOM(
+                    f"injected split-retry OOM ({nbytes} bytes)")
+            if self._forced_retry > 0:
+                self._forced_retry -= 1
+                raise TpuRetryOOM(f"injected retry OOM ({nbytes} bytes)")
+            retries = 0
+            while self.used + nbytes > self.budget:
+                freed = 0
+                if self._spill_callback is not None:
+                    freed = self._spill_callback(
+                        self.used + nbytes - self.budget)
+                if freed <= 0:
+                    retries += 1
+                    if retries > self.oom_max_retries:
+                        raise TpuRetryOOM(
+                            f"HBM budget exhausted: used={self.used} "
+                            f"request={nbytes} budget={self.budget}")
+                    TpuDeviceManager.synchronize()
+            self.used += nbytes
+            self.peak_used = max(self.peak_used, self.used)
+
+    def free(self, nbytes: int) -> None:
+        with self._alloc_lock:
+            self.used = max(0, self.used - nbytes)

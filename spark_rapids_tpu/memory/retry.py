@@ -1,0 +1,91 @@
+"""Retry framework: idempotent re-execution + input splitting under OOM.
+
+Reference: RmmRapidsRetryIterator.scala:62-200 (withRetry / withRetryNoSplit /
+RetryIterator; split on GpuSplitAndRetryOOM; inputs must already be spillable).
+This is the key robustness mechanism of the whole design (SURVEY §7 point 3):
+any batch-level work can be retried after a spill, or split in half when a
+single batch cannot fit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, TypeVar
+
+from ..columnar.batch import TpuColumnarBatch, slice_batch
+from .hbm import HbmBudget, TpuRetryOOM, TpuSplitAndRetryOOM
+from .spill import SpillableColumnarBatch, TpuBufferCatalog
+
+T = TypeVar("T")
+
+
+class RetryStats:
+    def __init__(self) -> None:
+        self.retries = 0
+        self.split_retries = 0
+
+
+def split_in_half(spillable: SpillableColumnarBatch) -> List[SpillableColumnarBatch]:
+    """Default split policy (reference splitSpillableInHalfByRows)."""
+    batch = spillable.get_batch()
+    n = batch.num_rows
+    if n < 2:
+        raise TpuSplitAndRetryOOM("cannot split a batch of fewer than 2 rows")
+    half = n // 2
+    first = SpillableColumnarBatch(slice_batch(batch, 0, half))
+    second = SpillableColumnarBatch(slice_batch(batch, half, n - half))
+    spillable.close()
+    return [first, second]
+
+
+def with_retry(
+    spillable: SpillableColumnarBatch,
+    fn: Callable[[TpuColumnarBatch], T],
+    split_policy: Optional[Callable[[SpillableColumnarBatch],
+                                    List[SpillableColumnarBatch]]] = split_in_half,
+    max_retries: int = 8,
+    stats: Optional[RetryStats] = None,
+) -> Iterator[T]:
+    """Run fn over the spillable input, retrying on TpuRetryOOM (after letting
+    the catalog spill) and splitting the input on TpuSplitAndRetryOOM. fn MUST
+    be idempotent w.r.t. the input batch (reference withRetry contract).
+    Yields one result per (sub-)batch."""
+    pending: List[SpillableColumnarBatch] = [spillable]
+    attempts = 0
+    while pending:
+        cur = pending[0]
+        try:
+            batch = cur.get_batch()
+            result = fn(batch)
+            pending.pop(0)
+            cur.close()
+            yield result
+            attempts = 0
+        except TpuSplitAndRetryOOM:
+            if stats:
+                stats.split_retries += 1
+            if split_policy is None:
+                for s in pending:
+                    s.close()
+                raise
+            pending = split_policy(cur) + pending[1:]
+        except TpuRetryOOM:
+            if stats:
+                stats.retries += 1
+            attempts += 1
+            if attempts > max_retries:
+                for s in pending:
+                    s.close()
+                raise
+            # let pressure drain: spill everything spillable, then retry
+            TpuBufferCatalog.get().synchronous_spill(cur.size_bytes)
+
+
+def with_retry_no_split(spillable: SpillableColumnarBatch,
+                        fn: Callable[[TpuColumnarBatch], T],
+                        max_retries: int = 8,
+                        stats: Optional[RetryStats] = None) -> T:
+    """Retry without splitting (reference withRetryNoSplit)."""
+    results = list(with_retry(spillable, fn, split_policy=None,
+                              max_retries=max_retries, stats=stats))
+    assert len(results) == 1
+    return results[0]

@@ -1,0 +1,200 @@
+"""Spill framework: tiered buffer catalog DEVICE → HOST → DISK.
+
+Reference: RapidsBufferCatalog.scala (1018; handle-based), RapidsBufferStore /
+RapidsDeviceMemoryStore / RapidsHostMemoryStore / RapidsDiskStore,
+SpillPriorities.scala, SpillableColumnarBatch.scala:29,90. Device batches
+register for a handle; under HBM pressure the catalog spills lowest-priority
+buffers to host Arrow tables, then to Arrow IPC files on disk; `get_batch`
+unspills on demand. jax.Arrays are immutable so "spill" = materialize to host
+and drop the device reference (XLA frees it), accounting via HbmBudget.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from ..columnar.batch import TpuColumnarBatch
+from ..config import HOST_SPILL_STORAGE_SIZE, RapidsConf, default_conf
+from .hbm import HbmBudget
+
+TIER_DEVICE = "DEVICE"
+TIER_HOST = "HOST"
+TIER_DISK = "DISK"
+
+# Spill priorities (reference SpillPriorities.scala): lower value spills first
+ACTIVE_ON_DECK_PRIORITY = -100
+ACTIVE_BATCHING_PRIORITY = 0
+OUTPUT_FOR_SHUFFLE_PRIORITY = 100
+
+
+class _Entry:
+    __slots__ = ("handle", "tier", "priority", "batch", "host_table",
+                 "disk_path", "nbytes", "names")
+
+    def __init__(self, handle: int, batch: TpuColumnarBatch, priority: int):
+        self.handle = handle
+        self.tier = TIER_DEVICE
+        self.priority = priority
+        self.batch = batch
+        self.host_table = None
+        self.disk_path: Optional[str] = None
+        self.nbytes = batch.device_memory_size()
+        self.names = batch.names
+
+
+class TpuBufferCatalog:
+    """Handle-based spillable-buffer registry (reference RapidsBufferCatalog)."""
+
+    _instance: Optional["TpuBufferCatalog"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        conf = conf or default_conf()
+        self._entries: Dict[int, _Entry] = {}
+        self._next_handle = 0
+        self._reg_lock = threading.RLock()
+        self._disk_dir = tempfile.mkdtemp(prefix="tpu_spill_")
+        self.host_limit = conf.get(HOST_SPILL_STORAGE_SIZE)
+        self.host_used = 0
+        self.spilled_to_host = 0
+        self.spilled_to_disk = 0
+        HbmBudget.get(conf).set_spill_callback(self.synchronous_spill)
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None) -> "TpuBufferCatalog":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = TpuBufferCatalog(conf)
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "TpuBufferCatalog":
+        with cls._lock:
+            cls._instance = TpuBufferCatalog()
+            return cls._instance
+
+    # --- registration ------------------------------------------------------
+    def add_batch(self, batch: TpuColumnarBatch,
+                  priority: int = ACTIVE_BATCHING_PRIORITY) -> int:
+        with self._reg_lock:
+            self._next_handle += 1
+            h = self._next_handle
+            e = _Entry(h, batch, priority)
+            self._entries[h] = e
+            HbmBudget.get().allocate(e.nbytes)
+            return h
+
+    def remove(self, handle: int) -> None:
+        with self._reg_lock:
+            e = self._entries.pop(handle, None)
+            if e is None:
+                return
+            if e.tier == TIER_DEVICE:
+                HbmBudget.get().free(e.nbytes)
+            elif e.tier == TIER_HOST:
+                self.host_used -= e.nbytes
+            elif e.disk_path and os.path.exists(e.disk_path):
+                os.unlink(e.disk_path)
+
+    # --- access ------------------------------------------------------------
+    def get_batch(self, handle: int) -> TpuColumnarBatch:
+        with self._reg_lock:
+            e = self._entries[handle]
+            if e.tier == TIER_DEVICE:
+                return e.batch
+            self._unspill(e)
+            return e.batch
+
+    def _unspill(self, e: _Entry) -> None:
+        import pyarrow as pa
+        if e.tier == TIER_DISK:
+            with pa.ipc.open_file(e.disk_path) as r:
+                e.host_table = r.read_all()
+            os.unlink(e.disk_path)
+            e.disk_path = None
+            e.tier = TIER_HOST
+            self.host_used += e.nbytes
+        if e.tier == TIER_HOST:
+            HbmBudget.get().allocate(e.nbytes)
+            batch = TpuColumnarBatch.from_arrow(e.host_table)
+            if e.names:
+                batch = batch.rename(e.names)
+            e.batch = batch
+            e.host_table = None
+            self.host_used -= e.nbytes
+            e.tier = TIER_DEVICE
+
+    # --- spilling ----------------------------------------------------------
+    def synchronous_spill(self, bytes_needed: int) -> int:
+        """Spill lowest-priority device buffers until bytes_needed freed
+        (reference: RMM alloc-failure drains the device store)."""
+        freed = 0
+        with self._reg_lock:
+            device_entries = sorted(
+                (e for e in self._entries.values() if e.tier == TIER_DEVICE),
+                key=lambda e: e.priority)
+            for e in device_entries:
+                if freed >= bytes_needed:
+                    break
+                freed += self._spill_entry_to_host(e)
+        return freed
+
+    def _spill_entry_to_host(self, e: _Entry) -> int:
+        e.host_table = e.batch.to_arrow()
+        e.batch = None
+        e.tier = TIER_HOST
+        HbmBudget.get().free(e.nbytes)
+        self.host_used += e.nbytes
+        self.spilled_to_host += e.nbytes
+        if self.host_used > self.host_limit:
+            self._spill_host_to_disk()
+        return e.nbytes
+
+    def _spill_host_to_disk(self) -> None:
+        import pyarrow as pa
+        with self._reg_lock:
+            host_entries = sorted(
+                (e for e in self._entries.values() if e.tier == TIER_HOST),
+                key=lambda e: e.priority)
+            for e in host_entries:
+                if self.host_used <= self.host_limit:
+                    break
+                path = os.path.join(self._disk_dir, f"buf_{e.handle}.arrow")
+                with pa.ipc.new_file(path, e.host_table.schema) as w:
+                    w.write_table(e.host_table)
+                e.host_table = None
+                e.disk_path = path
+                e.tier = TIER_DISK
+                self.host_used -= e.nbytes
+                self.spilled_to_disk += e.nbytes
+
+
+class SpillableColumnarBatch:
+    """RAII wrapper: batch registered in the catalog, retrievable, closable
+    (reference SpillableColumnarBatch.scala)."""
+
+    def __init__(self, batch: TpuColumnarBatch,
+                 priority: int = ACTIVE_BATCHING_PRIORITY):
+        self._catalog = TpuBufferCatalog.get()
+        self._handle: Optional[int] = self._catalog.add_batch(batch, priority)
+        self.num_rows = batch.num_rows
+        self.size_bytes = batch.device_memory_size()
+
+    def get_batch(self) -> TpuColumnarBatch:
+        if self._handle is None:
+            raise ValueError("spillable batch already closed")
+        return self._catalog.get_batch(self._handle)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._catalog.remove(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "SpillableColumnarBatch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
