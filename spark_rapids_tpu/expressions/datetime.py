@@ -1,0 +1,398 @@
+"""Date/time expressions.
+
+Reference: org/apache/spark/sql/rapids/datetimeExpressions.scala (1266) +
+spark-rapids-jni DateTimeRebase/GpuTimeZoneDB. Carriers: DateType = int32 days
+since epoch, TimestampType = int64 micros since epoch UTC (Spark internal
+representation). Device field extraction uses Howard Hinnant's civil-calendar
+integer algorithms — pure elementwise integer math, ideal for the VPU (the
+reference calls cuDF datetime kernels). Session-timezone math beyond UTC is
+gated by the tagging layer (non-UTC → CPU, like the reference before its
+TimeZoneDB support).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import DataType, DateT, DateType, IntegerT, LongT, TimestampT, TimestampType
+from ..columnar.vector import row_mask
+from .base import (EvalContext, Expression, UnaryExpression, _DEFAULT_CTX,
+                   combine_validity, device_parts, make_column)
+
+MICROS_PER_DAY = 86_400_000_000
+MICROS_PER_SECOND = 1_000_000
+
+
+def _floor_div(a, b):
+    return a // b  # python/jax floor semantics match Spark's floorDiv here
+
+
+def civil_from_days(z):
+    """days-since-epoch → (year, month, day); Hinnant's algorithm."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = (m.astype(jnp.int64) + jnp.where(m > 2, -3, 9))
+    doy = (153 * mp + 2) // 5 + d.astype(jnp.int64) - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _days_of(d, dtype):
+    if isinstance(dtype, TimestampType):
+        return _floor_div(d.astype(jnp.int64), MICROS_PER_DAY).astype(jnp.int32)
+    return d.astype(jnp.int32)
+
+
+class _DateField(UnaryExpression):
+    """Extract an integer field from date/timestamp."""
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    _arrow_fn = ""
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        d, v = device_parts(c, cap)
+        days = _days_of(jnp.broadcast_to(d, (cap,)), self.child.dtype)
+        data = self._field(days, jnp.broadcast_to(d, (cap,)))
+        valid = combine_validity(cap, v, row_mask(batch.num_rows, cap))
+        return make_column(IntegerT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        return pc.cast(getattr(pc, self._arrow_fn)(c), pa.int32())
+
+    def pretty(self) -> str:
+        return f"{type(self).__name__.lower()}({self.child.pretty()})"
+
+
+class Year(_DateField):
+    _arrow_fn = "year"
+
+    def _field(self, days, raw):
+        y, m, d = civil_from_days(days)
+        return y
+
+
+class Month(_DateField):
+    _arrow_fn = "month"
+
+    def _field(self, days, raw):
+        y, m, d = civil_from_days(days)
+        return m
+
+
+class DayOfMonth(_DateField):
+    _arrow_fn = "day"
+
+    def _field(self, days, raw):
+        y, m, d = civil_from_days(days)
+        return d
+
+
+class Quarter(_DateField):
+    _arrow_fn = "quarter"
+
+    def _field(self, days, raw):
+        y, m, d = civil_from_days(days)
+        return (m - 1) // 3 + 1
+
+
+class DayOfWeek(_DateField):
+    """Spark: 1 = Sunday … 7 = Saturday. 1970-01-01 was a Thursday."""
+
+    def _field(self, days, raw):
+        return ((days.astype(jnp.int64) + 4) % 7 + 1).astype(jnp.int32)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        # Spark: 1=Sunday..7=Saturday == arrow week_start=7, count_from_zero=False
+        dow = pc.day_of_week(c, week_start=7, count_from_zero=False)
+        return pc.cast(dow, pa.int32())
+
+
+class WeekDay(_DateField):
+    """Spark weekday(): 0 = Monday … 6 = Sunday."""
+
+    def _field(self, days, raw):
+        return ((days.astype(jnp.int64) + 3) % 7).astype(jnp.int32)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        return pc.cast(pc.day_of_week(self.child.eval_cpu(table, ctx)), pa.int32())
+
+
+class DayOfYear(_DateField):
+    _arrow_fn = "day_of_year"
+
+    def _field(self, days, raw):
+        y, m, d = civil_from_days(days)
+        jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return (days - jan1 + 1).astype(jnp.int32)
+
+
+class WeekOfYear(_DateField):
+    """ISO 8601 week number (Spark weekofyear)."""
+
+    def _field(self, days, raw):
+        d64 = days.astype(jnp.int64)
+        # ISO: week of the Thursday of this week
+        dow_mon0 = (d64 + 3) % 7  # 0=Monday
+        thursday = d64 + (3 - dow_mon0)
+        y, m, d = civil_from_days(thursday.astype(jnp.int32))
+        jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d)).astype(jnp.int64)
+        return ((thursday - jan1) // 7 + 1).astype(jnp.int32)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        return pc.cast(pc.iso_week(self.child.eval_cpu(table, ctx)), pa.int32())
+
+
+class _TimeField(_DateField):
+    def _tod_micros(self, raw):
+        micros = raw.astype(jnp.int64)
+        days = _floor_div(micros, MICROS_PER_DAY)
+        return micros - days * MICROS_PER_DAY
+
+
+class Hour(_TimeField):
+    _arrow_fn = "hour"
+
+    def _field(self, days, raw):
+        return (self._tod_micros(raw) // 3_600_000_000).astype(jnp.int32)
+
+
+class Minute(_TimeField):
+    _arrow_fn = "minute"
+
+    def _field(self, days, raw):
+        return ((self._tod_micros(raw) // 60_000_000) % 60).astype(jnp.int32)
+
+
+class Second(_TimeField):
+    _arrow_fn = "second"
+
+    def _field(self, days, raw):
+        return ((self._tod_micros(raw) // MICROS_PER_SECOND) % 60).astype(jnp.int32)
+
+
+class LastDay(UnaryExpression):
+    """Last day of the month of the given date."""
+
+    @property
+    def dtype(self) -> DataType:
+        return DateT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        d, v = device_parts(c, cap)
+        days = _days_of(jnp.broadcast_to(d, (cap,)), self.child.dtype)
+        y, m, _ = civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        first_next = days_from_civil(ny, nm, jnp.ones_like(nm))
+        valid = combine_validity(cap, v, row_mask(batch.num_rows, cap))
+        return make_column(DateT, first_next - 1, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import datetime
+        import pyarrow as pa
+        vals = self.child.eval_cpu(table, ctx).to_pylist()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            else:
+                nxt = datetime.date(v.year + (v.month == 12),
+                                    1 if v.month == 12 else v.month + 1, 1)
+                out.append(nxt - datetime.timedelta(days=1))
+        return pa.array(out, pa.date32())
+
+
+class DateAdd(Expression):
+    """date_add(date, days)."""
+
+    def __init__(self, date: Expression, days: Expression, negate: bool = False):
+        self.children = (date, days)
+        self.negate = negate
+
+    @property
+    def dtype(self) -> DataType:
+        return DateT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        delta = jnp.broadcast_to(rd, (cap,)).astype(jnp.int32)
+        if self.negate:
+            delta = -delta
+        data = jnp.broadcast_to(ld, (cap,)).astype(jnp.int32) + delta
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        return make_column(DateT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        l = self.children[0].eval_cpu(table, ctx)
+        r = self.children[1].eval_cpu(table, ctx)
+        days32 = pc.cast(l, pa.int32())
+        delta = pc.cast(r, pa.int32())
+        if self.negate:
+            delta = pc.negate(delta)
+        return pc.cast(pc.add(days32, delta), pa.date32())
+
+    def pretty(self) -> str:
+        op = "date_sub" if self.negate else "date_add"
+        return f"{op}({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class DateDiff(Expression):
+    """datediff(end, start) in days."""
+
+    def __init__(self, end: Expression, start: Expression):
+        self.children = (end, start)
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        data = (jnp.broadcast_to(ld, (cap,)).astype(jnp.int32)
+                - jnp.broadcast_to(rd, (cap,)).astype(jnp.int32))
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        return make_column(IntegerT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        l = pc.cast(self.children[0].eval_cpu(table, ctx), pa.int32())
+        r = pc.cast(self.children[1].eval_cpu(table, ctx), pa.int32())
+        return pc.subtract(l, r)
+
+
+class AddMonths(Expression):
+    def __init__(self, date: Expression, months: Expression):
+        self.children = (date, months)
+
+    @property
+    def dtype(self) -> DataType:
+        return DateT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        days = jnp.broadcast_to(ld, (cap,)).astype(jnp.int32)
+        y, m, d = civil_from_days(days)
+        total = (y.astype(jnp.int64) * 12 + (m - 1)
+                 + jnp.broadcast_to(rd, (cap,)).astype(jnp.int64))
+        ny = (total // 12).astype(jnp.int32)
+        nm = (total % 12 + 1).astype(jnp.int32)
+        # clamp day to last day of target month (Spark semantics)
+        nny = jnp.where(nm == 12, ny + 1, ny)
+        nnm = jnp.where(nm == 12, 1, nm + 1)
+        last = days_from_civil(nny, nnm, jnp.ones_like(nnm)) - 1
+        _, _, last_d = civil_from_days(last)
+        nd = jnp.minimum(d, last_d)
+        data = days_from_civil(ny, nm, nd)
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        return make_column(DateT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import calendar
+        import datetime
+        import pyarrow as pa
+        dates = self.children[0].eval_cpu(table, ctx).to_pylist()
+        months = self.children[1].eval_cpu(table, ctx)
+        months = months.to_pylist() if hasattr(months, "to_pylist") \
+            else [months] * len(dates)
+        out = []
+        for v, mo in zip(dates, months):
+            if v is None or mo is None:
+                out.append(None)
+                continue
+            total = v.year * 12 + (v.month - 1) + int(mo)
+            y, m = total // 12, total % 12 + 1
+            d = min(v.day, calendar.monthrange(y, m)[1])
+            out.append(datetime.date(y, m, d))
+        return pa.array(out, pa.date32())
+
+
+class UnixTimestampFromTs(UnaryExpression):
+    """unix_timestamp(ts): seconds since epoch (floor)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    def _compute(self, d, ctx, valid):
+        return _floor_div(d.astype(jnp.int64), MICROS_PER_SECOND)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        micros = pc.cast(c, pa.int64())
+        # floor division for negative timestamps
+        import numpy as np
+        vals, mask = _np_mask(micros)
+        return pa.array(np.floor_divide(vals, MICROS_PER_SECOND), mask=mask)
+
+
+class ToUnixMicros(UnaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    def _compute(self, d, ctx, valid):
+        return d.astype(jnp.int64)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        return pc.cast(self.child.eval_cpu(table, ctx), pa.int64())
+
+
+def _np_mask(arr):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False)).astype(bool)
+    vals = np.asarray(a.fill_null(0).to_numpy(zero_copy_only=False))
+    return vals, mask

@@ -223,3 +223,71 @@ def var_pop(c) -> Column:
 
 def count_star() -> Column:
     return Column(_G.Count(Literal(1)))
+
+
+# --- datetime --------------------------------------------------------------
+
+from .expressions import datetime as _D
+
+
+year = _unary(_D.Year)
+month = _unary(_D.Month)
+dayofmonth = _unary(_D.DayOfMonth)
+quarter = _unary(_D.Quarter)
+dayofweek = _unary(_D.DayOfWeek)
+weekday = _unary(_D.WeekDay)
+dayofyear = _unary(_D.DayOfYear)
+weekofyear = _unary(_D.WeekOfYear)
+hour = _unary(_D.Hour)
+minute = _unary(_D.Minute)
+second = _unary(_D.Second)
+last_day = _unary(_D.LastDay)
+
+
+def date_add(date, days) -> Column:
+    return Column(_D.DateAdd(_expr_or_col(date), _expr_or_col(days)))
+
+
+def date_sub(date, days) -> Column:
+    return Column(_D.DateAdd(_expr_or_col(date), _expr_or_col(days), negate=True))
+
+
+def datediff(end, start) -> Column:
+    return Column(_D.DateDiff(_expr_or_col(end), _expr_or_col(start)))
+
+
+def add_months(date, months) -> Column:
+    return Column(_D.AddMonths(_expr_or_col(date), _expr_or_col(months)))
+
+
+def unix_timestamp(ts) -> Column:
+    return Column(_D.UnixTimestampFromTs(_expr_or_col(ts)))
+
+
+# --- window functions ------------------------------------------------------
+
+def row_number() -> Column:
+    from .window import RowNumber
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from .window import Rank
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from .window import DenseRank
+    return Column(DenseRank())
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from .window import Lead
+    d = Literal(default) if default is not None else None
+    return Column(Lead(_expr_or_col(c), offset, d))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from .window import Lag
+    d = Literal(default) if default is not None else None
+    return Column(Lag(_expr_or_col(c), offset, d))

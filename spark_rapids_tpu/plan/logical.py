@@ -239,6 +239,43 @@ class Sort(LogicalPlan):
         return f"Sort[{', '.join(o.pretty() for o in self.order)}]"
 
 
+class WindowOp(LogicalPlan):
+    """Window evaluation node: output = child.output + one column per window
+    expression (Spark extracts window expressions from Project the same way)."""
+
+    def __init__(self, window_exprs, child: LogicalPlan):
+        from ..window import WindowExpression, WindowSpec
+        self.children = (child,)
+        resolved = []
+        for we in window_exprs:
+            fn = resolve_expression(we.function, child)
+            spec = we.spec
+            new_spec = WindowSpec(
+                [resolve_expression(p, child) for p in spec.partition_by],
+                [SortOrder(resolve_expression(o.child, child), o.ascending,
+                           o.nulls_first) for o in spec.order_by],
+                spec.frame, spec.frame_type)
+            nwe = WindowExpression(fn, new_spec)
+            if hasattr(we.function, "offset"):
+                nwe.function.offset = we.function.offset
+                nwe.function.default = we.function.default
+            resolved.append(nwe)
+        self.window_exprs = resolved
+        self._win_attrs = [AttributeReference(f"_we{i}", w.dtype, w.nullable)
+                           for i, w in enumerate(resolved)]
+
+    @property
+    def window_attrs(self) -> List[AttributeReference]:
+        return self._win_attrs
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output + self._win_attrs
+
+    def node_desc(self) -> str:
+        return f"Window[{', '.join(w.pretty() for w in self.window_exprs)}]"
+
+
 class Aggregate(LogicalPlan):
     """Group-by aggregate. agg_exprs are Alias(AggregateFunction(...)) or
     grouping attributes."""

@@ -244,6 +244,43 @@ register_exec(_CpuScan, "file scan", "spark.rapids.sql.exec.FileSourceScanExec",
               _tag_file_scan, _convert_file_scan)
 
 
+def _tag_window(meta: PlanMeta) -> None:
+    from ..expressions.aggregates import AggregateFunction
+    from ..window import (DenseRank, Lag, Lead, Rank, RowNumber,
+                          UNBOUNDED_FOLLOWING, UNBOUNDED_PRECEDING, CURRENT_ROW)
+    for we in meta.plan.window_exprs:
+        fn = we.function
+        if isinstance(fn, AggregateFunction):
+            if fn.update_op not in ("sum", "count", "avg", "min", "max"):
+                meta.will_not_work_on_tpu(
+                    f"window aggregate {type(fn).__name__} not supported on TPU")
+            if fn.update_op in ("min", "max") and we.spec.frame is not None:
+                lo, hi = we.spec.frame
+                ok = (lo == UNBOUNDED_PRECEDING and
+                      hi in (CURRENT_ROW, UNBOUNDED_FOLLOWING))
+                if not ok:
+                    meta.will_not_work_on_tpu(
+                        "bounded min/max window frames not supported on TPU yet")
+            for c in fn.children:
+                meta.add_exprs([c])
+        elif not isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag)):
+            meta.will_not_work_on_tpu(
+                f"window function {type(fn).__name__} not supported on TPU")
+        meta.add_exprs(we.spec.partition_by)
+        meta.add_exprs([o.child for o in we.spec.order_by])
+
+
+def _convert_window(meta: PlanMeta, ch):
+    from ..execs.window import TpuWindowExec
+    return TpuWindowExec(meta.plan.window_exprs, ch[0], meta.plan.output)
+
+
+from ..execs.window import CpuWindowExec as _CpuWin  # noqa: E402
+
+register_exec(_CpuWin, "window", "spark.rapids.sql.exec.WindowExec",
+              _tag_window, _convert_window)
+
+
 def wrap_and_tag_plan(plan: PhysicalPlan, conf: RapidsConf) -> PlanMeta:
     """reference wrapAndTagPlan (GpuOverrides.scala:4358)."""
     rule = _EXEC_RULES.get(type(plan))

@@ -92,6 +92,10 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
                                            plan.condition, plan.output)
         return CpuBroadcastNestedLoopJoinExec(left, right, plan.join_type,
                                               plan.condition, plan.output)
+    if isinstance(plan, L.WindowOp):
+        from ..execs.window import CpuWindowExec
+        child = plan_physical(plan.children[0], conf)
+        return CpuWindowExec(plan.window_exprs, child, plan.output)
     if isinstance(plan, L.Repartition):
         from ..shuffle.exchange import plan_cpu_exchange
         return plan_cpu_exchange(plan, conf)

@@ -96,6 +96,17 @@ def _register_builtin_exprs() -> None:
 
     register_expr(H.Murmur3Hash, TypeSigs.integral, "spark murmur3 hash")
 
+    from ..expressions import datetime as DT
+    for cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Quarter, DT.DayOfWeek,
+                DT.WeekDay, DT.DayOfYear, DT.WeekOfYear, DT.Hour, DT.Minute,
+                DT.Second, DT.DateDiff):
+        register_expr(cls, TypeSigs.integral, f"datetime field {cls.__name__.lower()}")
+    register_expr(DT.LastDay, TypeSigs.DATE, "last day of month")
+    register_expr(DT.DateAdd, TypeSigs.DATE, "date add/sub days")
+    register_expr(DT.AddMonths, TypeSigs.DATE, "add months (day-clamped)")
+    register_expr(DT.UnixTimestampFromTs, TypeSigs.integral, "unix seconds")
+    register_expr(DT.ToUnixMicros, TypeSigs.integral, "unix micros")
+
     register_expr(S.Length, TypeSigs.integral, "string char length")
     register_expr(S.Upper, TypeSigs.STRING, "uppercase",
                   incompat="non-ASCII handled via host path")

@@ -150,6 +150,10 @@ class Column:
         from .expressions.strings import Substring
         return Column(Substring(self._expr, Literal(start), Literal(length)))
 
+    def over(self, spec) -> "Column":
+        from .window import WindowExpression
+        return Column(WindowExpression(self._expr, spec))
+
     def asc(self) -> "L.SortOrder":
         return L.SortOrder(self._expr, True)
 
@@ -216,6 +220,8 @@ class DataFrame:
     # --- transformations --------------------------------------------------
     def select(self, *cols) -> "DataFrame":
         exprs = [self._to_named(c) for c in cols]
+        if _has_window(exprs):
+            return _project_with_windows(exprs, self)
         return DataFrame(L.Project(exprs, self._plan), self.session)
 
     def _to_named(self, c) -> Expression:
@@ -244,6 +250,8 @@ class DataFrame:
                 exprs.append(a)
         if not replaced:
             exprs.append(Alias(_expr(col), name))
+        if _has_window(exprs):
+            return _project_with_windows(exprs, self)
         return DataFrame(L.Project(exprs, self._plan), self.session)
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
@@ -367,6 +375,37 @@ class DataFrame:
         conf = self.session._rapids_conf()
         cpu_plan = plan_physical(self._plan, conf)
         return TpuOverrides.explain_plan(cpu_plan, conf)
+
+
+def _has_window(exprs) -> bool:
+    from .window import WindowExpression
+    return any(e.collect(lambda x: isinstance(x, WindowExpression))
+               for e in exprs)
+
+
+def _project_with_windows(exprs, df: "DataFrame") -> "DataFrame":
+    """Extract WindowExpressions into a WindowOp node, replace their occurrences
+    with references to the window output columns, then project
+    (Spark's ExtractWindowExpressions rule)."""
+    from .window import WindowExpression
+    windows: List = []
+    for e in exprs:
+        for w in e.collect(lambda x: isinstance(x, WindowExpression)):
+            if not any(w is x for x in windows):
+                windows.append(w)
+    node = L.WindowOp(windows, df._plan)
+    attrs = node.window_attrs
+
+    def replace(e: Expression) -> Expression:
+        def rule(x: Expression):
+            for i, w in enumerate(windows):
+                if x is w:
+                    return attrs[i]
+            return None
+        return e.transform(rule)
+
+    new_exprs = [replace(e) for e in exprs]
+    return DataFrame(L.Project(new_exprs, node), df.session)
 
 
 def _extract_equi_keys(cond: Expression, left, right):

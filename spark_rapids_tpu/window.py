@@ -1,0 +1,194 @@
+"""Window specification API (pyspark.sql.Window-shaped).
+
+Reference: window/GpuWindowExec.scala + GpuWindowExpression.scala. Frame model:
+row-based frames with the Spark boundary constants; range frames currently
+support only UNBOUNDED/CURRENT combinations (the common cases; full range
+frames follow with the datetime work).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Sequence
+
+from .expressions.base import Expression, UnresolvedAttribute
+
+UNBOUNDED_PRECEDING = -sys.maxsize
+UNBOUNDED_FOLLOWING = sys.maxsize
+CURRENT_ROW = 0
+
+
+class WindowSpec:
+    def __init__(self, partition_by: Sequence[Expression] = (),
+                 order_by: Sequence = (),
+                 frame: Optional[tuple] = None,
+                 frame_type: str = "rows"):
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.frame = frame  # (start, end) in row offsets, None = default
+        self.frame_type = frame_type
+
+    def partitionBy(self, *cols) -> "WindowSpec":
+        from .session import _expr
+        exprs = [UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                 for c in cols]
+        return WindowSpec(exprs, self.order_by, self.frame, self.frame_type)
+
+    def orderBy(self, *cols) -> "WindowSpec":
+        from .plan.logical import SortOrder
+        from .session import _expr
+        orders = []
+        for c in cols:
+            if isinstance(c, SortOrder):
+                orders.append(c)
+            else:
+                e = UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                orders.append(SortOrder(e, True))
+        return WindowSpec(self.partition_by, orders, self.frame, self.frame_type)
+
+    def rowsBetween(self, start: int, end: int) -> "WindowSpec":
+        return WindowSpec(self.partition_by, self.order_by, (start, end), "rows")
+
+    def rangeBetween(self, start: int, end: int) -> "WindowSpec":
+        if (start, end) not in ((UNBOUNDED_PRECEDING, CURRENT_ROW),
+                                (UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING),
+                                (CURRENT_ROW, UNBOUNDED_FOLLOWING)):
+            raise NotImplementedError(
+                "general range frames not yet supported; use rowsBetween")
+        return WindowSpec(self.partition_by, self.order_by, (start, end), "range")
+
+
+class Window:
+    unboundedPreceding = UNBOUNDED_PRECEDING
+    unboundedFollowing = UNBOUNDED_FOLLOWING
+    currentRow = CURRENT_ROW
+
+    @staticmethod
+    def partitionBy(*cols) -> WindowSpec:
+        return WindowSpec().partitionBy(*cols)
+
+    @staticmethod
+    def orderBy(*cols) -> WindowSpec:
+        return WindowSpec().orderBy(*cols)
+
+
+class WindowFunction(Expression):
+    """Ranking/offset window functions (reference GpuWindowExpression rank/
+    row_number/lead/lag)."""
+
+    name = ""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def pretty(self) -> str:
+        return f"{self.name}({', '.join(c.pretty() for c in self.children)})"
+
+
+class RowNumber(WindowFunction):
+    name = "row_number"
+
+    @property
+    def dtype(self):
+        from .types import IntegerT
+        return IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class Rank(WindowFunction):
+    name = "rank"
+
+    @property
+    def dtype(self):
+        from .types import IntegerT
+        return IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class DenseRank(WindowFunction):
+    name = "dense_rank"
+
+    @property
+    def dtype(self):
+        from .types import IntegerT
+        return IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class NTile(WindowFunction):
+    name = "ntile"
+
+    def __init__(self, n: Expression):
+        super().__init__(n)
+
+    @property
+    def dtype(self):
+        from .types import IntegerT
+        return IntegerT
+
+
+class Lead(WindowFunction):
+    name = "lead"
+
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Expression] = None):
+        super().__init__(child)
+        self.offset = offset
+        self.default = default
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+
+class Lag(WindowFunction):
+    name = "lag"
+
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Expression] = None):
+        super().__init__(child)
+        self.offset = offset
+        self.default = default
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+
+class WindowExpression(Expression):
+    """fn OVER spec."""
+
+    def __init__(self, function: Expression, spec: WindowSpec):
+        self.children = (function,)
+        self.spec = spec
+
+    @property
+    def function(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.function.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.function.nullable
+
+    def pretty(self) -> str:
+        parts = []
+        if self.spec.partition_by:
+            parts.append("PARTITION BY " + ", ".join(
+                p.pretty() for p in self.spec.partition_by))
+        if self.spec.order_by:
+            parts.append("ORDER BY " + ", ".join(
+                o.pretty() for o in self.spec.order_by))
+        return f"{self.function.pretty()} OVER ({' '.join(parts)})"

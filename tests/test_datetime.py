@@ -1,0 +1,70 @@
+"""Datetime expression tests (reference date_time_test.py slices)."""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DateGen, IntegerGen, TimestampGen, gen_df
+
+import spark_rapids_tpu.functions as F
+
+
+def _df(s, n=300, seed=70):
+    gens = [("dt", DateGen(null_prob=0.1)),
+            ("ts", TimestampGen(null_prob=0.1)),
+            ("n", IntegerGen(min_val=-1000, max_val=1000))]
+    return s.createDataFrame(gen_df(gens, n, seed))
+
+
+def test_date_fields():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.year("dt").alias("y"),
+            F.month("dt").alias("m"),
+            F.dayofmonth("dt").alias("d"),
+            F.quarter("dt").alias("q"),
+            F.dayofweek("dt").alias("dow"),
+            F.weekday("dt").alias("wd"),
+            F.dayofyear("dt").alias("doy"),
+            F.weekofyear("dt").alias("woy"),
+        ))
+
+
+def test_timestamp_fields():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.year("ts").alias("y"),
+            F.month("ts").alias("m"),
+            F.dayofmonth("ts").alias("d"),
+            F.hour("ts").alias("h"),
+            F.minute("ts").alias("mi"),
+            F.second("ts").alias("sec"),
+        ))
+
+
+def test_date_arithmetic():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.date_add(F.col("dt"), F.col("n")).alias("added"),
+            F.date_sub(F.col("dt"), 30).alias("subbed"),
+            F.datediff(F.col("dt"), F.date_add(F.col("dt"), 10)).alias("dd"),
+            F.last_day("dt").alias("ld"),
+        ))
+
+
+def test_add_months():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.add_months(F.col("dt"), F.col("n") % 50).alias("am")))
+
+
+def test_unix_timestamp():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.unix_timestamp(F.col("ts")).alias("ut")))
+
+
+def test_group_by_date():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy(F.year("dt").alias("y"))
+        .agg(F.count(F.col("dt")).alias("c")),
+        ignore_order=True)

@@ -1,0 +1,101 @@
+"""Window function tests (reference window_function_test.py slices)."""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.window import Window
+
+
+def _df(s, n=200, seed=50):
+    gens = [("k", IntegerGen(min_val=0, max_val=5, null_prob=0.1)),
+            ("o", IntegerGen(min_val=0, max_val=100)),
+            ("v", LongGen(null_prob=0.2)),
+            ("d", DoubleGen(null_prob=0.2))]
+    return s.createDataFrame(gen_df(gens, n, seed))
+
+
+def test_row_number():
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("o"),
+            F.row_number().over(w).alias("rn")),
+        ignore_order=True)
+
+
+def test_rank_dense_rank():
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("o"),
+            F.rank().over(w).alias("r"),
+            F.dense_rank().over(w).alias("dr")),
+        ignore_order=True)
+
+
+def test_lead_lag():
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("o"), F.col("v"),
+            F.lead(F.col("v")).over(w).alias("ld"),
+            F.lag(F.col("v"), 2).over(w).alias("lg2")),
+        ignore_order=True)
+
+
+def test_running_aggregates():
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("o"), F.col("v"),
+            F.sum(F.col("v")).over(w).alias("rsum"),
+            F.count(F.col("v")).over(w).alias("rcnt"),
+            F.min(F.col("v")).over(w).alias("rmin"),
+            F.max(F.col("v")).over(w).alias("rmax")),
+        ignore_order=True)
+
+
+def test_whole_partition_aggregate():
+    w = Window.partitionBy("k")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("v"),
+            F.sum(F.col("v")).over(w).alias("total"),
+            F.avg(F.col("d")).over(w).alias("mean")),
+        ignore_order=True, approx_float=True)
+
+
+def test_bounded_rows_frame():
+    w = Window.partitionBy("k").orderBy("o", "v").rowsBetween(-2, 2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("o"), F.col("v"),
+            F.sum(F.col("v")).over(w).alias("wsum"),
+            F.count(F.col("v")).over(w).alias("wcnt"),
+            F.avg(F.col("v")).over(w).alias("wavg")),
+        ignore_order=True, approx_float=True)
+
+
+def test_window_no_partition():
+    w = Window.orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=80).select(
+            F.col("o"), F.col("v"),
+            F.row_number().over(w).alias("rn"),
+            F.sum(F.col("v")).over(w).alias("rsum")),
+        ignore_order=True)
+
+
+def test_window_string_partition():
+    def fn(s):
+        df = s.createDataFrame(gen_df(
+            [("g", StringGen(alphabet="xyz", max_len=1, null_prob=0.1)),
+             ("o", IntegerGen()), ("v", IntegerGen())], 150, 60))
+        w = Window.partitionBy("g").orderBy("o", "v")
+        return df.select(F.col("g"), F.col("o"),
+                         F.row_number().over(w).alias("rn"),
+                         F.sum(F.col("v")).over(w).alias("rs"))
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
