@@ -41,9 +41,21 @@ def test_timestamp_fields():
         ))
 
 
+class _BoundedDateGen(DateGen):
+    """Dates where ±1000 days / ±50 months stay inside Spark's valid date range
+    (0001-01-01..9999-12-31) — overflow past it is out of contract."""
+    special_values = [DateGen.special_values[0], DateGen.special_values[1]]
+
+
+def _bounded_df(s, n=300, seed=71):
+    gens = [("dt", _BoundedDateGen(null_prob=0.1)),
+            ("n", IntegerGen(min_val=-1000, max_val=1000))]
+    return s.createDataFrame(gen_df(gens, n, seed))
+
+
 def test_date_arithmetic():
     assert_tpu_and_cpu_are_equal_collect(
-        lambda s: _df(s).select(
+        lambda s: _bounded_df(s).select(
             F.date_add(F.col("dt"), F.col("n")).alias("added"),
             F.date_sub(F.col("dt"), 30).alias("subbed"),
             F.datediff(F.col("dt"), F.date_add(F.col("dt"), 10)).alias("dd"),
@@ -53,7 +65,7 @@ def test_date_arithmetic():
 
 def test_add_months():
     assert_tpu_and_cpu_are_equal_collect(
-        lambda s: _df(s).select(
+        lambda s: _bounded_df(s).select(
             F.add_months(F.col("dt"), F.col("n") % 50).alias("am")))
 
 
