@@ -1,0 +1,215 @@
+// Host-native runtime kernels for spark_rapids_tpu.
+//
+// TPU-native equivalents of the reference's native host components
+// (SURVEY §2.4): spark-rapids-jni `Hash` (Spark-exact Murmur3 over column
+// batches), `RowConversion` (fixed-width row<->columnar), and the
+// JCudfSerialization/nvcomp pair (block framing + zstd compression via
+// libzstd). Exposed as a C ABI consumed through ctypes
+// (spark_rapids_tpu/native_bridge.py); every entry point has a pure-python
+// fallback so the framework runs without the .so.
+
+#include <cstdint>
+#include <cstring>
+#include <zstd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Murmur3 x86_32, Spark flavor (seed chaining per column, nulls keep seed)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xcc9e2d51u;
+  k1 = rotl32(k1, 15);
+  k1 *= 0x1b873593u;
+  return k1;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + 0xe6546b64u;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t len) {
+  h1 ^= len;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+static inline uint32_t hash_int(uint32_t v, uint32_t seed) {
+  return fmix(mix_h1(seed, mix_k1(v)), 4);
+}
+
+static inline uint32_t hash_long(int64_t v, uint32_t seed) {
+  uint32_t lo = (uint32_t)(v & 0xffffffffLL);
+  uint32_t hi = (uint32_t)((v >> 32) & 0xffffffffLL);
+  uint32_t h1 = mix_h1(seed, mix_k1(lo));
+  h1 = mix_h1(h1, mix_k1(hi));
+  return fmix(h1, 8);
+}
+
+// validity: 1 byte per row (1 = valid) or nullptr
+void murmur3_i32(const int32_t* vals, const uint8_t* validity, int64_t n,
+                 uint32_t* seeds_io) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity == nullptr || validity[i]) {
+      seeds_io[i] = hash_int((uint32_t)vals[i], seeds_io[i]);
+    }
+  }
+}
+
+void murmur3_i64(const int64_t* vals, const uint8_t* validity, int64_t n,
+                 uint32_t* seeds_io) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity == nullptr || validity[i]) {
+      seeds_io[i] = hash_long(vals[i], seeds_io[i]);
+    }
+  }
+}
+
+void murmur3_f32(const float* vals, const uint8_t* validity, int64_t n,
+                 uint32_t* seeds_io) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity == nullptr || validity[i]) {
+      float v = vals[i];
+      if (v == 0.0f) v = 0.0f;            // -0.0 -> 0.0
+      if (v != v) {                       // canonical NaN bits
+        uint32_t canon = 0x7fc00000u;
+        seeds_io[i] = hash_int(canon, seeds_io[i]);
+      } else {
+        uint32_t bits;
+        std::memcpy(&bits, &v, 4);
+        seeds_io[i] = hash_int(bits, seeds_io[i]);
+      }
+    }
+  }
+}
+
+void murmur3_f64(const double* vals, const uint8_t* validity, int64_t n,
+                 uint32_t* seeds_io) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity == nullptr || validity[i]) {
+      double v = vals[i];
+      if (v == 0.0) v = 0.0;
+      if (v != v) {
+        int64_t canon = 0x7ff8000000000000LL;
+        seeds_io[i] = hash_long(canon, seeds_io[i]);
+      } else {
+        int64_t bits;
+        std::memcpy(&bits, &v, 8);
+        seeds_io[i] = hash_long(bits, seeds_io[i]);
+      }
+    }
+  }
+}
+
+// Spark hashUnsafeBytes: 4-byte LE words, then per-byte signed tail
+static inline uint32_t hash_bytes(const uint8_t* data, int32_t len,
+                                  uint32_t seed) {
+  uint32_t h1 = seed;
+  int32_t nblocks = len / 4;
+  for (int32_t b = 0; b < nblocks; ++b) {
+    uint32_t word;
+    std::memcpy(&word, data + 4 * b, 4);  // x86 is little-endian
+    h1 = mix_h1(h1, mix_k1(word));
+  }
+  for (int32_t t = nblocks * 4; t < len; ++t) {
+    int32_t s = (int8_t)data[t];
+    h1 = mix_h1(h1, mix_k1((uint32_t)s));
+  }
+  return fmix(h1, (uint32_t)len);
+}
+
+// Arrow layout: offsets int32[n+1], chars uint8[]
+void murmur3_str(const int32_t* offsets, const uint8_t* chars,
+                 const uint8_t* validity, int64_t n, uint32_t* seeds_io) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity == nullptr || validity[i]) {
+      int32_t start = offsets[i];
+      int32_t len = offsets[i + 1] - start;
+      seeds_io[i] = hash_bytes(chars + start, len, seeds_io[i]);
+    }
+  }
+}
+
+// pid = pmod(hash, n)
+void pmod_partition(const uint32_t* hashes, int64_t n, int32_t num_parts,
+                    int32_t* pids_out) {
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t h = (int32_t)hashes[i];
+    int32_t p = h % num_parts;
+    pids_out[i] = p < 0 ? p + num_parts : p;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Fixed-width row <-> columnar conversion (reference RowConversion)
+// Row format: tightly packed fixed-width fields + trailing null bitset byte
+// per field (1 byte per field, 1 = valid).
+// ---------------------------------------------------------------------------
+
+// cols: array of pointers to column data; widths: bytes per field
+void columns_to_rows(const uint8_t** cols, const uint8_t** validities,
+                     const int32_t* widths, int32_t ncols, int64_t nrows,
+                     uint8_t* rows_out, int64_t row_stride) {
+  for (int64_t r = 0; r < nrows; ++r) {
+    uint8_t* row = rows_out + r * row_stride;
+    int64_t off = 0;
+    for (int32_t c = 0; c < ncols; ++c) {
+      std::memcpy(row + off, cols[c] + r * widths[c], widths[c]);
+      off += widths[c];
+    }
+    for (int32_t c = 0; c < ncols; ++c) {
+      row[off + c] = validities[c] == nullptr ? 1 : validities[c][r];
+    }
+  }
+}
+
+void rows_to_columns(const uint8_t* rows, int64_t row_stride, int64_t nrows,
+                     const int32_t* widths, int32_t ncols, uint8_t** cols_out,
+                     uint8_t** validities_out) {
+  for (int64_t r = 0; r < nrows; ++r) {
+    const uint8_t* row = rows + r * row_stride;
+    int64_t off = 0;
+    for (int32_t c = 0; c < ncols; ++c) {
+      std::memcpy(cols_out[c] + r * widths[c], row + off, widths[c]);
+      off += widths[c];
+    }
+    for (int32_t c = 0; c < ncols; ++c) {
+      validities_out[c][r] = row[off + c];
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Shuffle block compression (reference nvcomp codecs -> libzstd on host)
+// ---------------------------------------------------------------------------
+
+int64_t zstd_compress_bound(int64_t src_len) {
+  return (int64_t)ZSTD_compressBound((size_t)src_len);
+}
+
+int64_t zstd_compress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                      int64_t dst_cap, int32_t level) {
+  size_t r = ZSTD_compress(dst, (size_t)dst_cap, src, (size_t)src_len, level);
+  if (ZSTD_isError(r)) return -1;
+  return (int64_t)r;
+}
+
+int64_t zstd_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                        int64_t dst_cap) {
+  size_t r = ZSTD_decompress(dst, (size_t)dst_cap, src, (size_t)src_len);
+  if (ZSTD_isError(r)) return -1;
+  return (int64_t)r;
+}
+
+}  // extern "C"

@@ -220,15 +220,44 @@ class Murmur3Hash(Expression):
 def _np_hash_col(dt: DataType, arr, seeds: np.ndarray) -> np.ndarray:
     import pyarrow as pa
     import pyarrow.compute as pc
+    from .. import native_bridge
     a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
     nulls = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False)).astype(bool)
+    validity = (~nulls).astype(np.uint8) if nulls.any() else None
     if isinstance(dt, StringType):
+        if native_bridge.available():
+            s = a.cast(pa.string())
+            bufs = s.buffers()
+            offsets = np.frombuffer(bufs[1], np.int32, count=len(s) + 1,
+                                    offset=s.offset * 4)
+            base = offsets[0]
+            offsets = (offsets - base).astype(np.int32)
+            chars = np.frombuffer(bufs[2], np.uint8,
+                                  count=int(offsets[-1]), offset=int(base)) \
+                if offsets[-1] else np.zeros(0, np.uint8)
+            out = seeds.copy()
+            if native_bridge.murmur3_column("str", np.zeros(0), validity, out,
+                                            offsets=offsets, chars=chars):
+                return out
         out = seeds.copy()
         for i, s in enumerate(a.to_pylist()):
             if s is None:
                 continue
             out[i] = _np_murmur3_bytes(s.encode(), seeds[i])
         return out
+    if native_bridge.available() and isinstance(
+            dt, (ByteType, ShortType, IntegerType, DateType, LongType,
+                 TimestampType, FloatType, DoubleType)):
+        fill = 0
+        vals = np.asarray(a.fill_null(fill).to_numpy(zero_copy_only=False))
+        out = seeds.copy()
+        kind = {np.dtype(np.float32): "f32", np.dtype(np.float64): "f64"}.get(
+            vals.dtype)
+        if kind is None:
+            kind = "i64" if isinstance(dt, (LongType, TimestampType)) else "i32"
+            vals = vals.astype(np.int64 if kind == "i64" else np.int32)
+        if native_bridge.murmur3_column(kind, vals, validity, out):
+            return out
     fill = False if isinstance(dt, BooleanType) else 0
     vals = np.asarray(a.fill_null(fill).to_numpy(zero_copy_only=False))
     if isinstance(dt, (BooleanType,)):

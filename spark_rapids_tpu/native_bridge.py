@@ -1,0 +1,147 @@
+"""ctypes bridge to the C++ host runtime (native/src/native.cpp).
+
+The reference consumes its native kernels through JNI (`ai.rapids.cudf`,
+spark-rapids-jni); here the host-side native surface (Spark-exact murmur3,
+fixed-width row conversion, zstd block codec) loads via ctypes, auto-building
+with `make -C native` on first use. Every caller has a pure-python fallback, so
+a missing toolchain degrades performance, not correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("spark_rapids_tpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "build", "libsr_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_f32p = ctypes.POINTER(ctypes.c_float)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
+
+def _build() -> bool:
+    mk = os.path.join(_REPO_ROOT, "native")
+    try:
+        subprocess.run(["make", "-C", mk], check=True, capture_output=True,
+                       timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception as e:  # noqa: BLE001 - degrade to python fallback
+        log.warning("native build failed (%s); using python fallbacks", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("cannot load native lib: %s", e)
+            _load_failed = True
+            return None
+        for name, args, res in [
+            ("murmur3_i32", [_i32p, _u8p, ctypes.c_int64, _u32p], None),
+            ("murmur3_i64", [_i64p, _u8p, ctypes.c_int64, _u32p], None),
+            ("murmur3_f32", [_f32p, _u8p, ctypes.c_int64, _u32p], None),
+            ("murmur3_f64", [_f64p, _u8p, ctypes.c_int64, _u32p], None),
+            ("murmur3_str", [_i32p, _u8p, _u8p, ctypes.c_int64, _u32p], None),
+            ("pmod_partition", [_u32p, ctypes.c_int64, ctypes.c_int32, _i32p], None),
+            ("zstd_compress_bound", [ctypes.c_int64], ctypes.c_int64),
+            ("zstd_compress",
+             [_u8p, ctypes.c_int64, _u8p, ctypes.c_int64, ctypes.c_int32],
+             ctypes.c_int64),
+            ("zstd_decompress",
+             [_u8p, ctypes.c_int64, _u8p, ctypes.c_int64], ctypes.c_int64),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = res
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _ptr(arr: np.ndarray, ptype):
+    return arr.ctypes.data_as(ptype)
+
+
+def murmur3_column(dtype_kind: str, values: np.ndarray,
+                   validity: Optional[np.ndarray],
+                   seeds: np.ndarray,
+                   offsets: Optional[np.ndarray] = None,
+                   chars: Optional[np.ndarray] = None) -> bool:
+    """In-place update of seeds (uint32). Returns False if native unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    n = len(seeds)
+    v = _ptr(np.ascontiguousarray(validity, np.uint8), _u8p) \
+        if validity is not None else ctypes.cast(None, _u8p)
+    sp = _ptr(seeds, _u32p)
+    if dtype_kind == "i32":
+        lib.murmur3_i32(_ptr(np.ascontiguousarray(values, np.int32), _i32p), v, n, sp)
+    elif dtype_kind == "i64":
+        lib.murmur3_i64(_ptr(np.ascontiguousarray(values, np.int64), _i64p), v, n, sp)
+    elif dtype_kind == "f32":
+        lib.murmur3_f32(_ptr(np.ascontiguousarray(values, np.float32), _f32p), v, n, sp)
+    elif dtype_kind == "f64":
+        lib.murmur3_f64(_ptr(np.ascontiguousarray(values, np.float64), _f64p), v, n, sp)
+    elif dtype_kind == "str":
+        lib.murmur3_str(_ptr(np.ascontiguousarray(offsets, np.int32), _i32p),
+                        _ptr(np.ascontiguousarray(chars, np.uint8), _u8p),
+                        v, n, sp)
+    else:
+        return False
+    return True
+
+
+def zstd_compress(data: bytes, level: int = 1) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.frombuffer(data, np.uint8)
+    bound = lib.zstd_compress_bound(len(data))
+    dst = np.empty(bound, np.uint8)
+    r = lib.zstd_compress(_ptr(src, _u8p), len(data), _ptr(dst, _u8p),
+                          bound, level)
+    if r < 0:
+        return None
+    return dst[:r].tobytes()
+
+
+def zstd_decompress(data: bytes, raw_len: int) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.frombuffer(data, np.uint8)
+    dst = np.empty(raw_len, np.uint8)
+    r = lib.zstd_decompress(_ptr(src, _u8p), len(data), _ptr(dst, _u8p), raw_len)
+    if r < 0:
+        return None
+    return dst[:r].tobytes()

@@ -28,18 +28,30 @@ class CompressionCodec:
 
 
 class ZstdCodec(CompressionCodec):
+    """zstd via the C++ native bridge when built, python zstandard otherwise."""
+
     name = "zstd"
 
     def __init__(self, level: int = 1):
-        import zstandard
-        self._c = zstandard.ZstdCompressor(level=level)
-        self._d = zstandard.ZstdDecompressor()
+        self._level = level
+        from .. import native_bridge
+        self._native = native_bridge if native_bridge.available() else None
+        if self._native is None:
+            import zstandard
+            self._c = zstandard.ZstdCompressor(level=level)
+            self._d = zstandard.ZstdDecompressor()
 
     def compress(self, data: bytes) -> bytes:
-        return self._c.compress(data)
+        if self._native is not None:
+            out = self._native.zstd_compress(data, self._level)
+            if out is not None:
+                return out
+        import zstandard
+        return zstandard.ZstdCompressor(level=self._level).compress(data)
 
     def decompress(self, data: bytes) -> bytes:
-        return self._d.decompress(data)
+        import zstandard
+        return zstandard.ZstdDecompressor().decompress(data)
 
 
 def get_codec(name: str) -> CompressionCodec:

@@ -1,0 +1,72 @@
+"""C++ native bridge parity tests (reference: the JNI kernels are covered by the
+Scala unit suites; here the native hash/codec must agree bit-for-bit with the
+pure-python implementations)."""
+
+import numpy as np
+import pytest
+import pyarrow as pa
+
+from spark_rapids_tpu import native_bridge
+
+
+needs_native = pytest.mark.skipif(not native_bridge.available(),
+                                  reason="native lib not built")
+
+
+@needs_native
+def test_native_murmur3_matches_python_ints():
+    from spark_rapids_tpu.expressions.hashexprs import (_np_mix_h1, _np_mix_k1,
+                                                        _np_fmix)
+    vals = np.array([0, 1, -1, 2**31 - 1, -2**31], np.int32)
+    seeds = np.full(5, np.uint32(42), np.uint32)
+    native = seeds.copy()
+    assert native_bridge.murmur3_column("i32", vals, None, native)
+    py = _np_fmix(_np_mix_h1(seeds, _np_mix_k1(vals.view(np.uint32))),
+                  np.uint32(4))
+    assert (native == py).all()
+
+
+@needs_native
+def test_native_murmur3_strings_match_python():
+    from spark_rapids_tpu.expressions.hashexprs import _np_murmur3_bytes
+    strings = ["", "a", "abcd", "abcdefg", "é—unicode✓", "x" * 100]
+    arr = pa.array(strings)
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32, count=len(strings) + 1)
+    chars = np.frombuffer(bufs[2], np.uint8, count=int(offsets[-1]))
+    seeds = np.full(len(strings), np.uint32(42), np.uint32)
+    native = seeds.copy()
+    assert native_bridge.murmur3_column("str", np.zeros(0), None, native,
+                                        offsets=offsets, chars=chars)
+    py = np.array([_np_murmur3_bytes(s.encode(), np.uint32(42))
+                   for s in strings], np.uint32)
+    assert (native == py).all()
+
+
+@needs_native
+def test_native_murmur3_doubles_with_specials():
+    from spark_rapids_tpu.expressions.hashexprs import _np_hash_col
+    from spark_rapids_tpu.types import DoubleT
+    vals = pa.array([1.5, -0.0, 0.0, float("nan"), None, 1e300], pa.float64())
+    seeds = np.full(6, np.uint32(42), np.uint32)
+    native = _np_hash_col(DoubleT, vals, seeds)  # uses native when available
+    # compare against the jax device implementation (already parity-tested)
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.expressions.hashexprs import murmur3_col
+    col = TpuColumnVector.from_arrow(vals)
+    dev = np.asarray(murmur3_col(col, jnp.full((col.capacity,), np.uint32(42),
+                                               jnp.uint32), col.capacity))
+    assert (native.view(np.int32) == dev[:6].view(np.int32)).all()
+
+
+@needs_native
+def test_native_zstd_roundtrip():
+    data = b"spark rapids tpu native codec" * 1000
+    comp = native_bridge.zstd_compress(data, 1)
+    assert comp is not None and len(comp) < len(data)
+    back = native_bridge.zstd_decompress(comp, len(data))
+    assert back == data
+    # python zstandard can decompress native-compressed frames
+    import zstandard
+    assert zstandard.ZstdDecompressor().decompress(comp) == data
