@@ -315,6 +315,11 @@ STABLE_SORT = _conf("spark.rapids.sql.stableSort.enabled").doc(
     "Force stable sorts (reference RapidsConf stableSort)."
 ).boolean(False)
 
+AUTO_BROADCAST_JOIN_THRESHOLD = _conf("spark.sql.autoBroadcastJoinThreshold").doc(
+    "Broadcast the build side of an equi-join when its estimated size is below "
+    "this many bytes (-1 disables)."
+).bytes(10 * 1024 * 1024)
+
 JOIN_SIZED_BUILD_HEURISTIC = _conf("spark.rapids.sql.join.buildSideRows.max").doc(
     "Max build-side rows before a shuffled hash join sub-partitions its inputs "
     "(reference GpuSubPartitionHashJoin)."

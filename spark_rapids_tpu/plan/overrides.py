@@ -197,6 +197,18 @@ from ..execs.joins import (CpuBroadcastNestedLoopJoinExec as _CpuBnlj,  # noqa: 
 register_exec(_CpuShj, "shuffled hash join",
               "spark.rapids.sql.exec.ShuffledHashJoinExec",
               _tag_hash_join, _convert_hash_join)
+def _convert_broadcast_join(meta: PlanMeta, ch):
+    from ..execs.broadcast import TpuBroadcastHashJoinExec
+    p = meta.plan
+    return TpuBroadcastHashJoinExec(ch[0], ch[1], p.join_type, p.left_keys,
+                                    p.right_keys, p.condition, p.output)
+
+
+from ..execs.broadcast import CpuBroadcastHashJoinExec as _CpuBhj  # noqa: E402
+
+register_exec(_CpuBhj, "broadcast hash join",
+              "spark.rapids.sql.exec.BroadcastHashJoinExec",
+              _tag_hash_join, _convert_broadcast_join)
 register_exec(_CpuBnlj, "broadcast nested loop join",
               "spark.rapids.sql.exec.BroadcastNestedLoopJoinExec",
               _tag_bnlj, _convert_bnlj)

@@ -78,6 +78,18 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
         left = plan_physical(plan.left, conf)
         right = plan_physical(plan.right, conf)
         if plan.left_keys:
+            from ..config import AUTO_BROADCAST_JOIN_THRESHOLD
+            from ..execs.broadcast import (BROADCAST_RIGHT_TYPES,
+                                           CpuBroadcastHashJoinExec,
+                                           estimated_size_bytes)
+            threshold = conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
+            r_size = estimated_size_bytes(right)
+            if (threshold > 0 and r_size is not None and r_size <= threshold
+                    and plan.join_type in BROADCAST_RIGHT_TYPES
+                    and left.num_partitions() > 1):
+                return CpuBroadcastHashJoinExec(
+                    left, right, plan.join_type, plan.left_keys,
+                    plan.right_keys, plan.condition, plan.output)
             if left.num_partitions() > 1 or right.num_partitions() > 1:
                 n = min(conf.get(SHUFFLE_PARTITIONS),
                         max(left.num_partitions(), right.num_partitions(), 2))
