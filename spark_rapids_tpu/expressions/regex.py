@@ -1,0 +1,268 @@
+"""Regex support with the reference's validate/rewrite/reject architecture.
+
+Reference: RegexParser.scala (RegexParser:44, CudfRegexTranspiler:687,
+rewrite optimizations :2030) + RegexComplexityEstimator. The reference parses
+Java regex, transpiles to the cuDF dialect, and *rejects* untranspilable
+patterns so tagging falls back to CPU. Here the target engines are:
+  1. cheap device ops for rewritable patterns (^lit → startswith, lit$ →
+     endswith, plain literal → contains) — same rewrites as RegexParser:2030
+  2. Python `re` on host for everything else that parses (host-assisted)
+  3. reject → expression tagged unsupported → operator falls back
+Java-vs-Python dialect differences that change semantics (possessive
+quantifiers, \\p{...} variants) are rejected rather than silently wrong.
+"""
+
+from __future__ import annotations
+
+import re as _re
+from typing import Optional, Tuple
+
+from ..types import BooleanT, DataType, IntegerT, StringT
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from .base import Expression, _DEFAULT_CTX, combine_validity, make_column
+from .strings import (Contains, EndsWith, StartsWith, _bool_result_from_arrow,
+                      _string_result_from_arrow, _to_arrow_side)
+
+_META = set(".^$*+?()[]{}|\\")
+
+# constructs Java supports but python re does not (or differs) → reject
+_REJECT_PATTERNS = [
+    _re.compile(r"\*\+|\+\+|\?\+"),           # possessive quantifiers
+    _re.compile(r"\\[pP]\{"),                  # unicode property classes
+    _re.compile(r"\(\?<[=!]"),                 # lookbehind (py supports but
+                                               # fixed-width only; differs)
+    _re.compile(r"\\[GZ]"),                    # Java-only anchors
+]
+
+
+def transpile(pattern: str) -> Optional[str]:
+    """Java regex → python-re pattern, or None if rejected
+    (reference CudfRegexTranspiler.transpile)."""
+    for rej in _REJECT_PATTERNS:
+        if rej.search(pattern):
+            return None
+    try:
+        _re.compile(pattern)
+    except _re.error:
+        return None
+    return pattern
+
+
+def literal_prefix_rewrite(pattern: str) -> Optional[Tuple[str, str]]:
+    """Recognize trivially-rewritable patterns (reference RegexParser
+    optimizations :2030): returns (kind, literal) with kind in
+    startswith/endswith/contains/equals."""
+
+    def is_literal(s: str) -> bool:
+        i = 0
+        while i < len(s):
+            if s[i] == "\\" and i + 1 < len(s) and s[i + 1] in _META:
+                i += 2
+                continue
+            if s[i] in _META:
+                return False
+            i += 1
+        return True
+
+    def unescape(s: str) -> str:
+        out = []
+        i = 0
+        while i < len(s):
+            if s[i] == "\\" and i + 1 < len(s):
+                out.append(s[i + 1])
+                i += 2
+            else:
+                out.append(s[i])
+                i += 1
+        return "".join(out)
+
+    body = pattern
+    anchored_start = body.startswith("^")
+    anchored_end = body.endswith("$") and not body.endswith("\\$")
+    core = body[1 if anchored_start else 0:
+                len(body) - 1 if anchored_end else len(body)]
+    if not is_literal(core):
+        return None
+    lit = unescape(core)
+    if anchored_start and anchored_end:
+        return ("equals", lit)
+    if anchored_start:
+        return ("startswith", lit)
+    if anchored_end:
+        return ("endswith", lit)
+    # bare literal: Java regex `find` semantics for RLike = contains
+    return ("contains", lit)
+
+
+class RLike(Expression):
+    """rlike / regexp: Java `find` semantics (reference GpuRLike)."""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self._transpiled = transpile(pattern)
+        self._rewrite = (literal_prefix_rewrite(pattern)
+                         if self._transpiled is not None else None)
+
+    tpu_supported = property(lambda self: self._transpiled is not None)  # type: ignore
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def pretty(self) -> str:
+        return f"{self.children[0].pretty()} RLIKE {self.pattern!r}"
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import Literal
+        c = self.children[0]
+        if self._rewrite is not None:
+            kind, lit = self._rewrite
+            if kind == "startswith":
+                return StartsWith(c, Literal(lit)).eval_tpu(batch, ctx)
+            if kind == "endswith":
+                return EndsWith(c, Literal(lit)).eval_tpu(batch, ctx)
+            if kind == "contains":
+                return Contains(c, Literal(lit)).eval_tpu(batch, ctx)
+            # equals
+            from .predicates import EqualTo
+            return EqualTo(c, Literal(lit)).eval_tpu(batch, ctx)
+        import pyarrow.compute as pc
+        arr = _to_arrow_side(c.eval_tpu(batch, ctx), batch)
+        out = pc.match_substring_regex(arr, pattern=self._transpiled)
+        return _bool_result_from_arrow(out, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        prog = _re.compile(self.pattern)
+        return pa.array([None if v is None else prog.search(v) is not None
+                         for v in vals], pa.bool_())
+
+
+class RegexpReplace(Expression):
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self.replacement = replacement
+        self._transpiled = transpile(pattern)
+
+    tpu_supported = property(lambda self: self._transpiled is not None)  # type: ignore
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def pretty(self) -> str:
+        return (f"regexp_replace({self.children[0].pretty()}, "
+                f"{self.pattern!r}, {self.replacement!r})")
+
+    def _java_to_py_repl(self) -> str:
+        # Java uses $1; python re uses \1
+        return _re.sub(r"\$(\d+)", r"\\\1", self.replacement)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        out = pc.replace_substring_regex(arr, pattern=self._transpiled,
+                                         replacement=self._java_to_py_repl())
+        return _string_result_from_arrow(out, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        prog = _re.compile(self.pattern)
+        repl = self._java_to_py_repl()
+        return pa.array([None if v is None else prog.sub(repl, v)
+                         for v in vals], pa.string())
+
+
+class RegexpExtract(Expression):
+    def __init__(self, child: Expression, pattern: str, group: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.group = group
+        self._transpiled = transpile(pattern)
+
+    tpu_supported = property(lambda self: self._transpiled is not None)  # type: ignore
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def pretty(self) -> str:
+        return (f"regexp_extract({self.children[0].pretty()}, "
+                f"{self.pattern!r}, {self.group})")
+
+    def _extract(self, vals):
+        prog = _re.compile(self.pattern)
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            m = prog.search(v)
+            if m is None:
+                out.append("")  # Spark: no match → empty string
+            else:
+                g = m.group(self.group)
+                out.append(g if g is not None else "")
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        out = pa.array(self._extract(arr.to_pylist()), pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._extract(vals), pa.string())
+
+
+class Like(Expression):
+    """SQL LIKE: % and _ wildcards with escape (reference GpuLike)."""
+
+    def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
+        self.children = (child,)
+        self.pattern = pattern
+        self.escape = escape
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def pretty(self) -> str:
+        return f"{self.children[0].pretty()} LIKE {self.pattern!r}"
+
+    def _to_regex(self) -> str:
+        out = ["^"]
+        i = 0
+        p = self.pattern
+        while i < len(p):
+            ch = p[i]
+            if ch == self.escape and i + 1 < len(p):
+                out.append(_re.escape(p[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        out.append("$")
+        return "".join(out)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        out = pc.match_like(arr, pattern=self.pattern)
+        return _bool_result_from_arrow(out, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.match_like(self.children[0].eval_cpu(table, ctx),
+                             pattern=self.pattern)

@@ -330,3 +330,277 @@ class ConcatStr(Expression):
 
     def pretty(self) -> str:
         return f"concat({', '.join(c.pretty() for c in self.children)})"
+
+
+class _HostStringUnary(UnaryExpression):
+    """Host-assisted unary string op via arrow compute."""
+
+    _pc_fn = ""
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            import pyarrow as pa
+            v = getattr(pc, self._pc_fn)(pa.array([c.value]))[0].as_py() \
+                if c.value is not None else None
+            return TpuScalar(StringT, v)
+        return _string_result_from_arrow(getattr(pc, self._pc_fn)(c.to_arrow()),
+                                         batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return getattr(pc, self._pc_fn)(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"{type(self).__name__.lower()}({self.child.pretty()})"
+
+
+class Trim(_HostStringUnary):
+    _pc_fn = "utf8_trim_whitespace"
+
+
+class LTrim(_HostStringUnary):
+    _pc_fn = "utf8_ltrim_whitespace"
+
+
+class RTrim(_HostStringUnary):
+    _pc_fn = "utf8_rtrim_whitespace"
+
+
+class Reverse(_HostStringUnary):
+    _pc_fn = "utf8_reverse"
+
+
+class InitCap(_HostStringUnary):
+    """Spark initcap: capitalize first letter of each whitespace-separated word."""
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        c = self.child.eval_tpu(batch, ctx)
+        arr = _to_arrow_side(c, batch)
+        out = pa.array(self._initcap_list(arr.to_pylist()), pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.child.eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._initcap_list(vals), pa.string())
+
+    @staticmethod
+    def _initcap_list(vals):
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            out.append(" ".join(w[:1].upper() + w[1:].lower() if w else w
+                                for w in v.split(" ")))
+        return out
+
+
+class StringRepeat(Expression):
+    def __init__(self, child: Expression, times: Expression):
+        self.children = (child, times)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from .base import Literal
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        n = self.children[1].value if isinstance(self.children[1], Literal) else 1
+        return pa.array([None if v is None else v * max(int(n), 0)
+                         for v in vals], pa.string())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from .base import Literal
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        n = self.children[1].value if isinstance(self.children[1], Literal) else 1
+        out = pa.array([None if v is None else v * max(int(n), 0)
+                        for v in arr.to_pylist()], pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def pretty(self) -> str:
+        return f"repeat({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) — literal replacement."""
+
+    def __init__(self, child: Expression, search: Expression, replace: Expression):
+        self.children = (child, search, replace)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _args(self):
+        from .base import Literal
+        s = self.children[1].value if isinstance(self.children[1], Literal) else None
+        r = self.children[2].value if isinstance(self.children[2], Literal) else ""
+        return s, r
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        s, r = self._args()
+        out = pc.replace_substring(arr, pattern=s, replacement=r)
+        return _string_result_from_arrow(out, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        s, r = self._args()
+        return pc.replace_substring(self.children[0].eval_cpu(table, ctx),
+                                    pattern=s, replacement=r)
+
+    def pretty(self) -> str:
+        c = self.children
+        return f"replace({c[0].pretty()}, {c[1].pretty()}, {c[2].pretty()})"
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, pos]) — 1-based, 0 when absent (instr = pos 1)."""
+
+    def __init__(self, substr: Expression, child: Expression,
+                 pos: Optional[Expression] = None):
+        from .base import Literal
+        self.children = (substr, child, pos if pos is not None else Literal(1))
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import IntegerT
+        return IntegerT
+
+    def _compute_list(self, subs, vals, start):
+        out = []
+        for v in vals:
+            if v is None or subs is None:
+                out.append(None)
+            elif start < 1:
+                out.append(0)
+            else:
+                out.append(v.find(subs, start - 1) + 1)
+        return out
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from .base import Literal
+        subs = self.children[0].value if isinstance(self.children[0], Literal) else None
+        vals = self.children[1].eval_cpu(table, ctx).to_pylist()
+        start = self.children[2].value if isinstance(self.children[2], Literal) else 1
+        return pa.array(self._compute_list(subs, vals, start), pa.int32())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from .base import Literal
+        from ..columnar.batch import _repad
+        subs = self.children[0].value if isinstance(self.children[0], Literal) else None
+        arr = _to_arrow_side(self.children[1].eval_tpu(batch, ctx), batch)
+        start = self.children[2].value if isinstance(self.children[2], Literal) else 1
+        out = pa.array(self._compute_list(subs, arr.to_pylist(), start), pa.int32())
+        col = TpuColumnVector.from_arrow(out)
+        if col.capacity != batch.capacity:
+            col = _repad(col, batch.capacity)
+        return col
+
+    def pretty(self) -> str:
+        return f"locate({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class _PadBase(Expression):
+    left_side = True
+
+    def __init__(self, child: Expression, length: Expression, pad: Expression):
+        self.children = (child, length, pad)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _compute_list(self, vals, n, pad):
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            elif len(v) >= n:
+                out.append(v[:n])  # Spark truncates to length
+            elif not pad:
+                out.append(v)
+            else:
+                fill = (pad * n)[: n - len(v)]
+                out.append(fill + v if self.left_side else v + fill)
+        return out
+
+    def _eval(self, arr, ctx):
+        import pyarrow as pa
+        from .base import Literal
+        n = self.children[1].value if isinstance(self.children[1], Literal) else 0
+        pad = self.children[2].value if isinstance(self.children[2], Literal) else " "
+        return pa.array(self._compute_list(arr.to_pylist(), int(n), pad),
+                        pa.string())
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return self._eval(self.children[0].eval_cpu(table, ctx), ctx)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        return _string_result_from_arrow(self._eval(arr, ctx), batch)
+
+
+class LPad(_PadBase):
+    left_side = True
+
+
+class RPad(_PadBase):
+    left_side = False
+
+
+class StringTranslate(Expression):
+    """translate(str, from, to) — per-char mapping (reference GpuTranslate)."""
+
+    def __init__(self, child: Expression, from_str: Expression, to_str: Expression):
+        self.children = (child, from_str, to_str)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _table(self):
+        from .base import Literal
+        f = self.children[1].value if isinstance(self.children[1], Literal) else ""
+        t = self.children[2].value if isinstance(self.children[2], Literal) else ""
+        m = {}
+        for i, ch in enumerate(f):
+            if ch not in m:
+                m[ch] = t[i] if i < len(t) else None  # None = delete
+        return m
+
+    def _compute_list(self, vals):
+        m = self._table()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            else:
+                out.append("".join(m.get(ch, ch) for ch in v
+                                   if m.get(ch, ch) is not None))
+        return out
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._compute_list(vals), pa.string())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        out = pa.array(self._compute_list(arr.to_pylist()), pa.string())
+        return _string_result_from_arrow(out, batch)

@@ -159,6 +159,63 @@ def concat(*cols) -> Column:
     return Column(_S.ConcatStr(*[_expr_or_col(c) for c in cols]))
 
 
+trim = _unary(_S.Trim)
+ltrim = _unary(_S.LTrim)
+rtrim = _unary(_S.RTrim)
+reverse = _unary(_S.Reverse)
+initcap = _unary(_S.InitCap)
+
+
+def repeat(c, n: int) -> Column:
+    return Column(_S.StringRepeat(_expr_or_col(c), Literal(n)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    from .expressions.regex import RegexpReplace
+    return Column(RegexpReplace(_expr_or_col(c), pattern, replacement))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    from .expressions.regex import RegexpExtract
+    return Column(RegexpExtract(_expr_or_col(c), pattern, idx))
+
+
+def rlike(c, pattern: str) -> Column:
+    from .expressions.regex import RLike
+    return Column(RLike(_expr_or_col(c), pattern))
+
+
+def like(c, pattern: str) -> Column:
+    from .expressions.regex import Like
+    return Column(Like(_expr_or_col(c), pattern))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(_S.StringLocate(Literal(substr), _expr_or_col(c), Literal(pos)))
+
+
+def instr(c, substr: str) -> Column:
+    return Column(_S.StringLocate(Literal(substr), _expr_or_col(c)))
+
+
+def lpad(c, length_: int, pad: str = " ") -> Column:
+    return Column(_S.LPad(_expr_or_col(c), Literal(length_), Literal(pad)))
+
+
+def rpad(c, length_: int, pad: str = " ") -> Column:
+    return Column(_S.RPad(_expr_or_col(c), Literal(length_), Literal(pad)))
+
+
+def translate(c, from_str: str, to_str: str) -> Column:
+    return Column(_S.StringTranslate(_expr_or_col(c), Literal(from_str),
+                                     Literal(to_str)))
+
+
+def replace(c, search: str, replacement: str = "") -> Column:
+    return Column(_S.StringReplace(_expr_or_col(c), Literal(search),
+                                   Literal(replacement)))
+
+
 # --- hash ------------------------------------------------------------------
 
 def hash(*cols) -> Column:  # noqa: A001

@@ -119,6 +119,22 @@ def _register_builtin_exprs() -> None:
     register_expr(S.Substring, TypeSigs.STRING, "substring", host_assisted=True)
     register_expr(S.ConcatStr, TypeSigs.STRING, "string concat",
                   host_assisted=True)
+    for cls in (S.Trim, S.LTrim, S.RTrim, S.Reverse, S.InitCap, S.StringRepeat,
+                S.StringReplace, S.LPad, S.RPad, S.StringTranslate):
+        register_expr(cls, TypeSigs.STRING,
+                      f"string fn {cls.__name__.lower()}", host_assisted=True)
+    register_expr(S.StringLocate, TypeSigs.integral, "locate/instr",
+                  host_assisted=True)
+
+    from ..expressions import regex as RX
+    register_expr(RX.RLike, TypeSigs.BOOLEAN,
+                  "regex match (transpiled or rewritten; rejects fall back)",
+                  host_assisted=True)
+    register_expr(RX.RegexpReplace, TypeSigs.STRING, "regex replace",
+                  host_assisted=True)
+    register_expr(RX.RegexpExtract, TypeSigs.STRING, "regex extract",
+                  host_assisted=True)
+    register_expr(RX.Like, TypeSigs.BOOLEAN, "SQL LIKE", host_assisted=True)
 
 
 _register_builtin_exprs()

@@ -1,0 +1,76 @@
+"""String + regex expression tests (reference string_test.py / regexp_test.py)."""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import IntegerGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+
+
+def _df(s, n=200, seed=80, alphabet="abc XY%_z", max_len=12):
+    return s.createDataFrame(gen_df(
+        [("s", StringGen(alphabet=alphabet, max_len=max_len))], n, seed))
+
+
+def test_trim_family():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, alphabet=" ab ").select(
+            F.trim(F.col("s")).alias("t"),
+            F.ltrim(F.col("s")).alias("lt"),
+            F.rtrim(F.col("s")).alias("rt")))
+
+
+def test_pad_repeat_reverse():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.lpad(F.col("s"), 8, "*").alias("lp"),
+            F.rpad(F.col("s"), 8, "#").alias("rp"),
+            F.repeat(F.col("s"), 2).alias("rep"),
+            F.reverse(F.col("s")).alias("rev"),
+            F.initcap(F.col("s")).alias("ic")))
+
+
+def test_replace_translate_locate():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.replace(F.col("s"), "ab", "Q").alias("rep"),
+            F.translate(F.col("s"), "abX", "xy").alias("tr"),
+            F.locate("b", F.col("s")).alias("loc"),
+            F.instr(F.col("s"), "ab").alias("ins")))
+
+
+def test_like():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.like(F.col("s"), "a%").alias("l1"),
+            F.like(F.col("s"), "%b").alias("l2"),
+            F.like(F.col("s"), "_b%").alias("l3")))
+
+
+def test_rlike_rewrites_and_regex():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.rlike(F.col("s"), "^ab").alias("pre"),
+            F.rlike(F.col("s"), "bc$").alias("suf"),
+            F.rlike(F.col("s"), "ab").alias("ct"),
+            F.rlike(F.col("s"), "^a.*c$").alias("full"),
+            F.rlike(F.col("s"), "[abc]{2}").alias("cls")))
+
+
+def test_regexp_replace_extract():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.regexp_replace(F.col("s"), "a+", "<A>").alias("rr"),
+            F.regexp_extract(F.col("s"), "(a+)(b*)", 1).alias("g1"),
+            F.regexp_extract(F.col("s"), "(a+)(b*)", 2).alias("g2")))
+
+
+def test_rejected_regex_falls_back():
+    """Possessive quantifiers are untranspilable → operator falls back to CPU
+    (reference: transpiler reject → tagging fallback)."""
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    df = _df(s).select(F.rlike(F.col("s"), "a*+b").alias("x"))
+    reasons = df.explain_fallback()
+    assert "RLike" in reasons and "disabled" in reasons or "RLike" in reasons
