@@ -136,5 +136,12 @@ def _register_builtin_exprs() -> None:
                   host_assisted=True)
     register_expr(RX.Like, TypeSigs.BOOLEAN, "SQL LIKE", host_assisted=True)
 
+    from .. import udf as U
+    register_expr(U.TpuColumnarUDF, TypeSigs.all, "columnar device UDF (RapidsUDF)")
+    register_expr(U.ArrowPandasUDF, TypeSigs.all, "arrow/pandas UDF",
+                  host_assisted=True)
+    register_expr(U.RowPythonUDF, TypeSigs.all, "row python UDF",
+                  host_assisted=True)
+
 
 _register_builtin_exprs()

@@ -1,0 +1,184 @@
+"""User-defined functions: columnar TPU UDFs, Arrow/pandas UDFs, row fallback.
+
+Reference (§2.8): RapidsUDF.evaluateColumnar (sql-plugin-api/.../RapidsUDF.java:22
+— user code receives device columns), GpuArrowEvalPythonExec + Pandas UDFs
+(Arrow exchange with python workers), and GpuRowBasedScalaUDF (row-at-a-time
+CPU lambda over accelerator-resident data, GpuScalaUDF.scala:94).
+
+TPU mapping:
+  * tpu_udf      — the RapidsUDF analogue: the user function receives jax
+    arrays (data, validity) per argument and returns (data, validity); it runs
+    inside the device plan and XLA fuses it with the surrounding projection.
+  * pandas_udf   — receives pyarrow arrays on host (the Arrow-exchange path);
+    no separate worker process is needed because we're already in python — the
+    PythonWorkerSemaphore concern collapses away.
+  * udf          — row-at-a-time python fallback (GpuRowBasedScalaUDF analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .columnar.vector import TpuColumnVector, row_mask
+from .expressions.base import (EvalContext, Expression, _DEFAULT_CTX,
+                               combine_validity, device_parts, make_column,
+                               to_column)
+from .types import DataType
+
+
+class TpuColumnarUDF(Expression):
+    """RapidsUDF analogue: fn(*(data, validity) jax arrays) -> (data, validity)."""
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 children: Sequence[Expression], name: str = "tpu_udf"):
+        self.children = tuple(children)
+        self.fn = fn
+        self._dtype = return_type
+        self._name = name
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    def pretty(self) -> str:
+        return f"{self._name}({', '.join(c.pretty() for c in self.children)})"
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        args = []
+        for c in self.children:
+            col = to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
+            args.append((col.data, col.validity_or_true()))
+        data, validity = self.fn(*args)
+        valid = combine_validity(cap, validity, row_mask(batch.num_rows, cap))
+        return make_column(self._dtype, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        """CPU path re-uses the jax fn on host arrays (jax runs on CPU too) —
+        the UDF contract is hardware-portable by construction."""
+        import jax.numpy as jnp
+        import pyarrow as pa
+        from .types import to_arrow
+        n = table.num_rows if table is not None else 0
+        args = []
+        for c in self.children:
+            arr = c.eval_cpu(table, ctx)
+            col = TpuColumnVector.from_arrow(
+                arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr)
+            args.append((col.data, col.validity_or_true()))
+        data, validity = self.fn(*args)
+        vals = np.asarray(data)[:n]
+        mask = None
+        if validity is not None:
+            mask = ~np.asarray(validity)[:n]
+        return pa.array(vals, type=to_arrow(self._dtype), mask=mask)
+
+
+class ArrowPandasUDF(Expression):
+    """pandas_udf analogue: fn(*pyarrow.Array) -> pyarrow.Array (host)."""
+
+    tpu_supported = True  # runs host-side inside a TPU plan (host-assisted)
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 children: Sequence[Expression], name: str = "pandas_udf"):
+        self.children = tuple(children)
+        self.fn = fn
+        self._dtype = return_type
+        self._name = name
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    def pretty(self) -> str:
+        return f"{self._name}({', '.join(c.pretty() for c in self.children)})"
+
+    def _call(self, arrays):
+        import pyarrow as pa
+        from .types import to_arrow
+        out = self.fn(*arrays)
+        if not isinstance(out, (pa.Array, pa.ChunkedArray)):
+            out = pa.array(out, type=to_arrow(self._dtype))
+        return out.cast(to_arrow(self._dtype))
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .expressions.strings import _string_result_from_arrow
+        from .columnar.batch import _repad
+        args = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype).to_arrow()
+                for c in self.children]
+        out = self._call(args)
+        col = TpuColumnVector.from_arrow(out)
+        if col.capacity != batch.capacity:
+            col = _repad(col, batch.capacity)
+        return col
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        args = []
+        for c in self.children:
+            a = c.eval_cpu(table, ctx)
+            args.append(a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a)
+        return self._call(args)
+
+
+class RowPythonUDF(ArrowPandasUDF):
+    """Row-at-a-time python UDF (GpuRowBasedScalaUDF analogue): wraps the row
+    lambda into an arrow-batch evaluator."""
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 children: Sequence[Expression], name: str = "udf"):
+        def batch_fn(*arrays):
+            import pyarrow as pa
+            from .types import to_arrow
+            cols = [a.to_pylist() for a in arrays]
+            out = [fn(*row) for row in zip(*cols)] if cols else []
+            return pa.array(out, type=to_arrow(return_type))
+
+        super().__init__(batch_fn, return_type, children, name)
+
+
+def tpu_udf(return_type, name: str = "tpu_udf"):
+    """Decorator: columnar device UDF over (data, validity) jax-array pairs."""
+    from .session import Column, _expr, _type_from_string
+    rt = _type_from_string(return_type) if isinstance(return_type, str) else return_type
+
+    def wrap(fn: Callable):
+        def call(*cols) -> Column:
+            return Column(TpuColumnarUDF(fn, rt, [_expr(c) for c in cols],
+                                         getattr(fn, "__name__", name)))
+        call.__name__ = getattr(fn, "__name__", name)
+        return call
+
+    return wrap
+
+
+def pandas_udf(return_type, name: str = "pandas_udf"):
+    from .session import Column, _expr, _type_from_string
+    rt = _type_from_string(return_type) if isinstance(return_type, str) else return_type
+
+    def wrap(fn: Callable):
+        def call(*cols) -> Column:
+            return Column(ArrowPandasUDF(fn, rt, [_expr(c) for c in cols],
+                                         getattr(fn, "__name__", name)))
+        call.__name__ = getattr(fn, "__name__", name)
+        return call
+
+    return wrap
+
+
+def udf(fn=None, returnType="string"):
+    """pyspark.sql.functions.udf-compatible row UDF."""
+    from .session import Column, _expr, _type_from_string
+    rt = _type_from_string(returnType) if isinstance(returnType, str) else returnType
+
+    def wrap(f: Callable):
+        def call(*cols) -> Column:
+            return Column(RowPythonUDF(f, rt, [_expr(c) for c in cols],
+                                       getattr(f, "__name__", "udf")))
+        return call
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

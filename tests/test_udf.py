@@ -1,0 +1,65 @@
+"""UDF tests (reference §2.8: RapidsUDF columnar, pandas/Arrow, row-based)."""
+
+import numpy as np
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.udf import pandas_udf, tpu_udf, udf
+
+
+def _df(s, n=100, seed=33):
+    return s.createDataFrame(gen_df(
+        [("a", IntegerGen()), ("b", DoubleGen()), ("s", StringGen())], n, seed))
+
+
+def test_tpu_columnar_udf():
+    import jax.numpy as jnp
+
+    @tpu_udf("double")
+    def hypot3(a, b):
+        ad, av = a
+        bd, bv = b
+        return jnp.sqrt(ad.astype(jnp.float64) ** 2 + bd ** 2), av & bv
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(hypot3(F.col("a"), F.col("b")).alias("h")),
+        approx_float=True)
+
+
+def test_tpu_udf_stays_on_device():
+    from spark_rapids_tpu.session import TpuSession
+    import jax.numpy as jnp
+
+    @tpu_udf("long")
+    def double_it(a):
+        d, v = a
+        return d * 2, v
+
+    s = TpuSession({"spark.rapids.sql.test.enabled": "true"})
+    rows = s.range(0, 50).select(double_it(F.col("id")).alias("x")).collect()
+    assert [r["x"] for r in rows] == [2 * i for i in range(50)]
+
+
+def test_pandas_arrow_udf():
+    import pyarrow.compute as pc
+
+    @pandas_udf("string")
+    def shout(s):
+        return pc.utf8_upper(s)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(shout(F.col("s")).alias("u")))
+
+
+def test_row_python_udf():
+    @udf(returnType="int")
+    def strange(a):
+        if a is None:
+            return -1
+        return (a % 7) * 3
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(strange(F.col("a")).alias("x")))
