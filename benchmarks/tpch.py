@@ -1,0 +1,109 @@
+"""TPC-H-style benchmark queries running through the full framework
+(reference: integration_tests mortgage Benchmarks.scala + ScaleTest harness).
+
+Usage: python benchmarks/tpch.py [--rows N] [--queries q1,q3,q6] [--cpu]
+Prints per-query wall-clock for the TPU plan and (optionally) the CPU plan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_session(tpu: bool):
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.sql.enabled": str(tpu).lower(),
+                       "spark.sql.shuffle.partitions": "8"})
+
+
+def load_tables(s, rows: int, parts: int = 4):
+    from spark_rapids_tpu.datagen import (tpch_customer, tpch_lineitem,
+                                          tpch_orders)
+    li = s.createDataFrame(tpch_lineitem(rows).generate(42, rows, parts),
+                          num_partitions=parts)
+    orders = s.createDataFrame(
+        tpch_orders(rows // 4).generate(42, rows // 4, parts),
+        num_partitions=parts)
+    cust = s.createDataFrame(
+        tpch_customer(rows // 40).generate(42, rows // 40, 1))
+    return li, orders, cust
+
+
+def q1(s, li, orders, cust):
+    import spark_rapids_tpu.functions as F
+    return (li.filter(F.col("l_shipdate") <= 10471)
+            .withColumn("disc_price",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .withColumn("charge",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount"))
+                        * (1 + F.col("l_tax")))
+            .groupBy("l_returnflag", "l_linestatus")
+            .agg(F.sum(F.col("l_quantity")).alias("sum_qty"),
+                 F.sum(F.col("l_extendedprice")).alias("sum_base_price"),
+                 F.sum(F.col("disc_price")).alias("sum_disc_price"),
+                 F.sum(F.col("charge")).alias("sum_charge"),
+                 F.avg(F.col("l_quantity")).alias("avg_qty"),
+                 F.avg(F.col("l_extendedprice")).alias("avg_price"),
+                 F.avg(F.col("l_discount")).alias("avg_disc"),
+                 F.count(F.col("l_quantity")).alias("count_order"))
+            .sort("l_returnflag", "l_linestatus"))
+
+
+def q3(s, li, orders, cust):
+    import spark_rapids_tpu.functions as F
+    return (cust.filter(F.col("c_mktsegment") == "A")
+            .join(orders, on=cust["c_custkey"] == orders["o_custkey"])
+            .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
+            .withColumn("revenue",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .groupBy("o_orderkey", "o_orderdate")
+            .agg(F.sum(F.col("revenue")).alias("revenue"))
+            .sort(F.col("revenue").desc())
+            .limit(10))
+
+
+def q6(s, li, orders, cust):
+    import spark_rapids_tpu.functions as F
+    return (li.filter((F.col("l_shipdate") >= 8766)
+                      & (F.col("l_shipdate") < 9131)
+                      & (F.col("l_discount") >= 0.05)
+                      & (F.col("l_discount") <= 0.07)
+                      & (F.col("l_quantity") < 24))
+            .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+                 .alias("revenue")))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q6": q6}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--queries", default="q1,q3,q6")
+    ap.add_argument("--cpu", action="store_true",
+                    help="also time the CPU (fallback) plan")
+    args = ap.parse_args()
+
+    results = {}
+    for mode in (["tpu", "cpu"] if args.cpu else ["tpu"]):
+        s = make_session(tpu=(mode == "tpu"))
+        li, orders, cust = load_tables(s, args.rows)
+        for name in args.queries.split(","):
+            fn = QUERIES[name.strip()]
+            df = fn(s, li, orders, cust)
+            t0 = time.perf_counter()
+            out = df.to_arrow()
+            dt = time.perf_counter() - t0
+            results[f"{name}_{mode}_s"] = round(dt, 4)
+            results[f"{name}_rows"] = out.num_rows
+    print(json.dumps({"metric": "tpch_suite", "rows": args.rows, **results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Deterministic scale-test data generator.
+
+Reference: datagen/ (bigDataGen.scala, README.md:1-36) — seed-mapping design:
+every cell is a pure function of (seed, table, column, row) so any slice of a
+huge dataset regenerates identically without storing it; controllable
+cardinality and skew. Used by the scale tests and the TPC-H-style benchmarks
+(benchmarks/).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+def _cell_rng(seed: int, table: str, column: str, part: int) -> np.random.Generator:
+    # stable per (seed, table, column, partition) stream — the seed-mapping idea
+    key = abs(hash((seed, table, column, part))) % (2**63)
+    return np.random.default_rng(key)
+
+
+class ColumnSpec:
+    def __init__(self, name: str, kind: str, *, cardinality: Optional[int] = None,
+                 skew: float = 0.0, min_val=None, max_val=None,
+                 null_prob: float = 0.0, alphabet: str = "abcdefghij",
+                 max_len: int = 12):
+        self.name = name
+        self.kind = kind  # int/long/double/string/date/bool/key
+        self.cardinality = cardinality
+        self.skew = skew  # 0 = uniform; >0 zipf-ish concentration
+        self.min_val = min_val
+        self.max_val = max_val
+        self.null_prob = null_prob
+        self.alphabet = alphabet
+        self.max_len = max_len
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        if self.kind in ("key", "int", "long"):
+            if self.cardinality:
+                if self.skew > 0:
+                    # zipf-like: rank^-skew weights over the key domain
+                    ranks = np.arange(1, self.cardinality + 1, dtype=np.float64)
+                    w = ranks ** (-self.skew)
+                    w /= w.sum()
+                    vals = rng.choice(self.cardinality, size=n, p=w)
+                else:
+                    vals = rng.integers(0, self.cardinality, n)
+            else:
+                lo = self.min_val if self.min_val is not None else 0
+                hi = self.max_val if self.max_val is not None else 2**31 - 1
+                vals = rng.integers(lo, hi + 1, n, dtype=np.int64)
+            t = pa.int64() if self.kind == "long" else pa.int32()
+            arr = pa.array(vals.astype(np.int64 if self.kind == "long" else np.int32), t)
+        elif self.kind == "double":
+            lo = self.min_val if self.min_val is not None else 0.0
+            hi = self.max_val if self.max_val is not None else 1.0
+            arr = pa.array(rng.random(n) * (hi - lo) + lo, pa.float64())
+        elif self.kind == "bool":
+            arr = pa.array(rng.integers(0, 2, n).astype(bool))
+        elif self.kind == "date":
+            lo = self.min_val if self.min_val is not None else 8000
+            hi = self.max_val if self.max_val is not None else 12000
+            arr = pa.array(rng.integers(lo, hi, n).astype(np.int32), pa.date32())
+        elif self.kind == "string":
+            card = self.cardinality or 0
+            if card:
+                # dictionary of `card` distinct strings, zipf-weighted picks
+                dict_rng = np.random.default_rng(card * 7919 + 13)
+                lens = dict_rng.integers(1, self.max_len + 1, card)
+                words = ["".join(self.alphabet[c] for c in
+                                 dict_rng.integers(0, len(self.alphabet), l))
+                         for l in lens]
+                idx = rng.integers(0, card, n)
+                arr = pa.array([words[i] for i in idx])
+            else:
+                lens = rng.integers(0, self.max_len + 1, n)
+                chars = rng.integers(0, len(self.alphabet), int(lens.sum()))
+                out, pos = [], 0
+                for l in lens:
+                    out.append("".join(self.alphabet[c]
+                                       for c in chars[pos:pos + l]))
+                    pos += l
+                arr = pa.array(out)
+        else:
+            raise ValueError(f"unknown column kind {self.kind}")
+        if self.null_prob > 0:
+            mask = rng.random(n) < self.null_prob
+            arr = pa.array([None if m else v
+                            for v, m in zip(arr.to_pylist(), mask)],
+                           type=arr.type)
+        return arr
+
+
+class TableSpec:
+    def __init__(self, name: str, columns: Sequence[ColumnSpec]):
+        self.name = name
+        self.columns = list(columns)
+
+    def generate_partition(self, seed: int, part: int, rows: int) -> pa.Table:
+        cols = {}
+        for c in self.columns:
+            rng = _cell_rng(seed, self.name, c.name, part)
+            cols[c.name] = c.generate(rng, rows)
+        return pa.table(cols)
+
+    def generate(self, seed: int, rows: int, partitions: int = 1) -> pa.Table:
+        per = rows // partitions
+        tables = [self.generate_partition(seed, p,
+                                          per + (1 if p < rows % partitions else 0))
+                  for p in range(partitions)]
+        return pa.concat_tables(tables)
+
+
+# --- TPC-H-style schema at a given scale (rows ~ SF * base) -----------------
+
+def tpch_lineitem(scale_rows: int) -> TableSpec:
+    return TableSpec("lineitem", [
+        ColumnSpec("l_orderkey", "key", cardinality=max(scale_rows // 4, 1)),
+        ColumnSpec("l_partkey", "key", cardinality=max(scale_rows // 20, 1)),
+        ColumnSpec("l_quantity", "int", min_val=1, max_val=50),
+        ColumnSpec("l_extendedprice", "double", min_val=900.0, max_val=105000.0),
+        ColumnSpec("l_discount", "double", min_val=0.0, max_val=0.1),
+        ColumnSpec("l_tax", "double", min_val=0.0, max_val=0.08),
+        ColumnSpec("l_returnflag", "string", cardinality=3, max_len=1,
+                   alphabet="RAN"),
+        ColumnSpec("l_linestatus", "string", cardinality=2, max_len=1,
+                   alphabet="OF"),
+        ColumnSpec("l_shipdate", "date", min_val=8035, max_val=10590),
+    ])
+
+
+def tpch_orders(scale_rows: int) -> TableSpec:
+    return TableSpec("orders", [
+        ColumnSpec("o_orderkey", "key", cardinality=max(scale_rows, 1)),
+        ColumnSpec("o_custkey", "key", cardinality=max(scale_rows // 10, 1)),
+        ColumnSpec("o_orderdate", "date", min_val=8035, max_val=10590),
+        ColumnSpec("o_totalprice", "double", min_val=800.0, max_val=600000.0),
+    ])
+
+
+def tpch_customer(scale_rows: int) -> TableSpec:
+    return TableSpec("customer", [
+        ColumnSpec("c_custkey", "key", cardinality=max(scale_rows, 1)),
+        ColumnSpec("c_mktsegment", "string", cardinality=5, max_len=1,
+                   alphabet="ABCDE"),
+        ColumnSpec("c_acctbal", "double", min_val=-1000.0, max_val=10000.0),
+    ])

@@ -1,0 +1,43 @@
+"""Cached-relation storage: parquet-compressed host batches.
+
+Reference: ParquetCachedBatchSerializer.scala (1407) — df.cache() stores
+compressed parquet-encoded batches on the host, decoded on access. The logical
+node keeps data parquet-compressed in memory and decodes per scan."""
+
+from __future__ import annotations
+
+import io
+from typing import List
+
+from ..expressions.base import AttributeReference
+from ..plan.logical import LogicalPlan
+from ..types import from_arrow
+
+
+class CachedRelation(LogicalPlan):
+    """In-memory parquet-compressed cache of a materialized result."""
+
+    def __init__(self, table, compression: str = "zstd"):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        buf = io.BytesIO()
+        pq.write_table(table, buf, compression=compression)
+        self._blob = buf.getvalue()
+        self.num_rows = table.num_rows
+        self._output = [AttributeReference(f.name, from_arrow(f.type), True)
+                        for f in table.schema]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    @property
+    def compressed_bytes(self) -> int:
+        return len(self._blob)
+
+    def table(self):
+        import pyarrow.parquet as pq
+        return pq.read_table(io.BytesIO(self._blob))
+
+    def node_desc(self) -> str:
+        return f"CachedRelation[{self.num_rows} rows, {len(self._blob)} bytes]"

@@ -47,7 +47,13 @@ class DataFrameReader:
 
     def load(self, path: str):
         fmt = self._options.pop("__format__", "parquet")
+        if fmt == "delta":
+            return self.delta(path)
         return self._scan([path], fmt)
+
+    def delta(self, path: str):
+        from .delta import read_delta
+        return read_delta(self._session, path)
 
     def _scan(self, paths, fmt: str):
         from ..plan.logical import FileScan

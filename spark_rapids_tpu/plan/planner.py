@@ -17,6 +17,9 @@ from . import logical as L
 
 
 def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    from ..io.cache import CachedRelation
+    if isinstance(plan, CachedRelation):
+        return CE.CpuLocalTableScanExec(plan.table(), 1, plan.output)
     if isinstance(plan, L.LocalRelation):
         return CE.CpuLocalTableScanExec(plan.table, plan.num_partitions, plan.output)
     if isinstance(plan, L.Range):

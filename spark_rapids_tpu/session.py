@@ -343,6 +343,17 @@ class DataFrame:
         from .io.writer import DataFrameWriter
         return DataFrameWriter(self)
 
+    def cache(self) -> "DataFrame":
+        """Materialize once and replace the plan with the cached result
+        (reference ParquetCachedBatchSerializer: df.cache() stores compressed
+        parquet-encoded batches on host). Host storage is Arrow here; the
+        compressed-at-rest variant is the cache serializer in io/cache.py."""
+        from .io.cache import CachedRelation
+        table = self.to_arrow()
+        return DataFrame(CachedRelation(table), self.session)
+
+    persist = cache
+
     # --- actions ----------------------------------------------------------
     def to_arrow(self):
         import pyarrow as pa

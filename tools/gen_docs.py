@@ -1,0 +1,52 @@
+"""Generate docs/configs.md and docs/supported_ops.md from the registries
+(reference: RapidsConf.help → docs/configs.md, SupportedOpsDocs → supported_ops.md;
+drift between code and docs is a test failure, SURVEY §4 tier 4)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def gen_configs_md() -> str:
+    from spark_rapids_tpu.config import REGISTRY
+    return REGISTRY.help_markdown()
+
+
+def gen_supported_ops_md() -> str:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.plan.typechecks import all_expr_rules
+    from spark_rapids_tpu.plan.overrides import exec_rules
+    lines = ["# Supported Operators and Expressions", "",
+             "## Execs", "",
+             "| CPU operator | TPU replacement rule | Enable/disable config |",
+             "|---|---|---|"]
+    for cls, rule in sorted(exec_rules().items(), key=lambda kv: kv[0].__name__):
+        lines.append(f"| {cls.__name__} | {rule.desc} | {rule.conf_key} |")
+    lines += ["", "## Expressions", "",
+              "| Expression | Description | Notes |", "|---|---|---|"]
+    for cls, rule in sorted(all_expr_rules().items(),
+                            key=lambda kv: kv[0].__name__):
+        notes = []
+        if rule.incompat:
+            notes.append(f"incompat: {rule.incompat}")
+        if rule.host_assisted:
+            notes.append("host-assisted")
+        lines.append(f"| {cls.__name__} | {rule.desc} | {'; '.join(notes)} |")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    root = os.path.join(os.path.dirname(__file__), "..", "docs")
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, "configs.md"), "w") as f:
+        f.write(gen_configs_md())
+    with open(os.path.join(root, "supported_ops.md"), "w") as f:
+        f.write(gen_supported_ops_md())
+    print("wrote docs/configs.md and docs/supported_ops.md")
+
+
+if __name__ == "__main__":
+    main()
