@@ -95,6 +95,9 @@ class TpuColumnarBatch:
 def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
     if col.capacity == capacity:
         return col
+    if col.host_data is not None:
+        return TpuColumnVector(col.dtype, col.data, col.validity, col.num_rows,
+                               host_data=col.host_data, host_capacity=capacity)
     if col.capacity > capacity:
         raise ValueError("cannot shrink capacity")
     pad = capacity - col.capacity
@@ -108,7 +111,8 @@ def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
     validity = col.validity
     if validity is not None:
         validity = jnp.concatenate([validity, jnp.zeros((pad,), jnp.bool_)])
-    return TpuColumnVector(col.dtype, data, validity, col.num_rows, offsets=offsets)
+    return TpuColumnVector(col.dtype, data, validity, col.num_rows, offsets=offsets,
+                           child=col.child)
 
 
 def gather(batch: TpuColumnarBatch, indices, out_rows: int,
@@ -132,6 +136,8 @@ def gather(batch: TpuColumnarBatch, indices, out_rows: int,
 
 def _gather_column(col: TpuColumnVector, safe_idx, valid, out_rows: int,
                    cap: int) -> TpuColumnVector:
+    if col.child is not None or col.host_data is not None:
+        return _gather_lists(col, safe_idx, valid, out_rows, cap)
     if col.offsets is not None:
         return _gather_strings(col, safe_idx, valid, out_rows, cap)
     data = jnp.take(col.data, safe_idx, axis=0)
@@ -173,6 +179,22 @@ def _gather_strings(col: TpuColumnVector, safe_idx, valid, out_rows: int,
                            offsets=new_offsets)
 
 
+def _gather_lists(col: TpuColumnVector, safe_idx, valid, out_rows: int,
+                  cap: int) -> TpuColumnVector:
+    """List-column gather: host-assisted via Arrow take (same status as the
+    string path — offsets math is device-able, element movement awaits a Pallas
+    ragged-gather kernel). Reference: cuDF gathers LIST columns natively."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    idx_np = np.asarray(safe_idx)[:cap].astype(np.int64)
+    valid_np = np.asarray(valid)[:cap]
+    take_idx = pa.array(np.where(valid_np, idx_np, 0)[:out_rows],
+                        mask=~valid_np[:out_rows])
+    taken = pc.take(col.to_arrow(), take_idx)
+    out = TpuColumnVector.from_arrow(taken)
+    return _repad(out, cap) if out.capacity < cap else out
+
+
 def compact(batch: TpuColumnarBatch, keep_mask) -> TpuColumnarBatch:
     """Filter: keep rows where mask is True, preserving order
     (reference GpuFilter: boolean mask + cudf apply_boolean_mask,
@@ -207,7 +229,7 @@ def concat_batches(batches: List[TpuColumnarBatch]) -> TpuColumnarBatch:
     out_cols: List[TpuColumnVector] = []
     for ci in range(batches[0].num_columns):
         cols = [b.columns[ci] for b in batches]
-        if cols[0].offsets is not None:
+        if cols[0].offsets is not None or cols[0].host_data is not None:
             import pyarrow as pa
             merged = pa.concat_arrays([c.to_arrow() for c in cols])
             out_cols.append(TpuColumnVector.from_arrow(merged))

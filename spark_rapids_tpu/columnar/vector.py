@@ -24,8 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..types import (BinaryType, BooleanType, DataType, DecimalType, NullType,
-                     StringType, is_fixed_width)
+from ..types import (ArrayType, BinaryType, BooleanType, DataType, DecimalType,
+                     NullType, StringType, is_fixed_width)
 
 
 def bucket_capacity(n: int, enabled: bool = True, minimum: int = 16) -> int:
@@ -42,6 +42,19 @@ def _np_to_jax(arr: np.ndarray) -> jax.Array:
     return jnp.asarray(arr)
 
 
+def device_layout_ok(dt: DataType) -> bool:
+    """Whether a type has a device (jax.Array) layout. Maps/structs and
+    decimal128 stay host-side (host_data-backed columns)."""
+    from ..types import MapType, StructType
+    if isinstance(dt, (MapType, StructType)):
+        return False
+    if isinstance(dt, ArrayType):
+        return device_layout_ok(dt.element_type)
+    if isinstance(dt, DecimalType):
+        return dt.precision <= DecimalType.MAX_DEVICE_PRECISION
+    return True
+
+
 @dataclass
 class TpuColumnVector:
     """One device column. `data` layout by type:
@@ -53,10 +66,23 @@ class TpuColumnVector:
     data: jax.Array
     validity: Optional[jax.Array]  # bool (capacity,); None == all-valid
     num_rows: int
-    offsets: Optional[jax.Array] = None  # strings/binary only
+    offsets: Optional[jax.Array] = None  # strings/binary/lists
+    #: list columns only: the flattened element vector (child.num_rows == total
+    #: element count == offsets[num_rows]). Mirrors cuDF's LIST column layout
+    #: (a device offsets buffer + a child column) — the same offsets+data shape
+    #: strings already use, generalized one level.
+    child: Optional["TpuColumnVector"] = None
+    #: map/struct columns (no device layout yet): the column stays host-side as
+    #: a pyarrow Array; device `data` is an empty placeholder. Host-assisted
+    #: expressions consume it via to_arrow/to_pylist; gathers route through
+    #: arrow take. The tagging layer prices these ops as host_assisted.
+    host_data: Optional[Any] = None
+    host_capacity: int = 0
 
     @property
     def capacity(self) -> int:
+        if self.host_data is not None:
+            return self.host_capacity
         if self.offsets is not None:
             return int(self.offsets.shape[0]) - 1
         return int(self.data.shape[0])
@@ -76,6 +102,8 @@ class TpuColumnVector:
             n += self.validity.size
         if self.offsets is not None:
             n += self.offsets.size * 4
+        if self.child is not None:
+            n += self.child.device_memory_size()
         return int(n)
 
     # ---- host materialization (the D→H boundary) ----
@@ -87,11 +115,28 @@ class TpuColumnVector:
         import pyarrow as pa
         from ..types import to_arrow as t2a
         n = self.num_rows
+        if self.host_data is not None:
+            return self.host_data.slice(0, n) if len(self.host_data) > n \
+                else self.host_data
         if self.validity is not None:
             valid = np.asarray(self.validity[:n])
             mask = ~valid
         else:
             mask = None
+        if isinstance(self.dtype, ArrayType):
+            offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
+            n_elems = int(offs[-1]) if n else 0
+            elems = self.child.to_arrow() if self.child.num_rows == n_elems else \
+                self.child.to_arrow().slice(0, n_elems)
+            if mask is not None:
+                bitmap = pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+                nulls = int(mask.sum())
+            else:
+                bitmap, nulls = None, 0
+            atype = pa.list_(elems.type)
+            return pa.Array.from_buffers(
+                atype, n, [bitmap, pa.py_buffer(offs.tobytes())],
+                null_count=nulls, children=[elems])
         if isinstance(self.dtype, (StringType, BinaryType)):
             offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
             chars = np.asarray(self.data[: int(offs[-1])]).tobytes() if n else b""
@@ -165,10 +210,36 @@ class TpuColumnVector:
         dtype = a2t(arr.type)
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
         n = len(arr)
+        if not device_layout_ok(dtype):
+            return TpuColumnVector(dtype, jnp.zeros((0,), jnp.int8), None, n,
+                                   host_data=arr,
+                                   host_capacity=bucket_capacity(n, bucket))
         if arr.null_count:
             validity = np.asarray(arr.is_valid())
         else:
             validity = None
+        if isinstance(dtype, ArrayType):
+            if pa.types.is_large_list(arr.type):
+                arr = arr.cast(pa.list_(arr.type.value_type))
+            bufs = arr.buffers()
+            off0 = arr.offset
+            offsets = np.frombuffer(bufs[1], dtype=np.int32,
+                                    count=n + 1, offset=off0 * 4).copy()
+            base = int(offsets[0])
+            offsets -= base
+            n_elems = int(offsets[-1])
+            values = arr.values.slice(base, n_elems)
+            child = TpuColumnVector.from_arrow(values, bucket=bucket)
+            cap = bucket_capacity(n, bucket)
+            obuf = np.full(cap + 1, n_elems, dtype=np.int32)
+            obuf[: n + 1] = offsets
+            vmask = None
+            if validity is not None and not validity.all():
+                v = np.zeros(cap, dtype=bool)
+                v[:n] = validity
+                vmask = _np_to_jax(v)
+            return TpuColumnVector(dtype, child.data, vmask, n,
+                                   offsets=_np_to_jax(obuf), child=child)
         if isinstance(dtype, (StringType, BinaryType)):
             if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
                 arr = arr.cast(pa.string() if isinstance(dtype, StringType) else pa.binary())
@@ -218,6 +289,27 @@ class TpuColumnVector:
     def from_scalar(value: Any, dtype: DataType, num_rows: int,
                     capacity: Optional[int] = None) -> "TpuColumnVector":
         cap = capacity if capacity is not None else bucket_capacity(num_rows)
+        if not device_layout_ok(dtype):
+            import pyarrow as pa
+            from ..types import to_arrow as t2a
+            pa_arr = pa.array([value] * num_rows, type=t2a(dtype))
+            return TpuColumnVector(dtype, jnp.zeros((0,), jnp.int8), None,
+                                   num_rows, host_data=pa_arr, host_capacity=cap)
+        if isinstance(dtype, ArrayType):
+            import pyarrow as pa
+            from ..types import to_arrow as t2a
+            pa_arr = pa.array([value] * num_rows, type=t2a(dtype))
+            col = TpuColumnVector.from_arrow(pa_arr)
+            if col.capacity < cap:
+                pad = cap - col.capacity
+                offs = jnp.concatenate(
+                    [col.offsets, jnp.full((pad,), col.offsets[-1], jnp.int32)])
+                validity = col.validity
+                if validity is not None:
+                    validity = jnp.concatenate([validity, jnp.zeros((pad,), jnp.bool_)])
+                col = TpuColumnVector(dtype, col.data, validity, num_rows,
+                                      offsets=offs, child=col.child)
+            return col
         if isinstance(dtype, (StringType, BinaryType)):
             if value is None:
                 offs = np.zeros(num_rows + 1, dtype=np.int32)

@@ -48,12 +48,21 @@ class BinaryArithmetic(BinaryExpression):
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa
         import pyarrow.compute as pc
+        from ..types import to_arrow as type_to_arrow
         l = self.left.eval_cpu(table, ctx)
         r = self.right.eval_cpu(table, ctx)
         try:
-            return self._cpu_compute(l, r, ctx)
+            out = self._cpu_compute(l, r, ctx)
         except pa.ArrowInvalid as e:
             raise ExpressionError(str(e)) from e
+        # arrow promotes array-op-pyscalar to the wider type; Spark (and the
+        # device kernel) keep the operand type with two's-complement wrap
+        if isinstance(self.dtype, IntegralType) \
+                and isinstance(out, (pa.Array, pa.ChunkedArray)):
+            at = type_to_arrow(self.dtype)
+            if out.type != at:
+                out = pc.cast(out, at, safe=False)
+        return out
 
 
 class Add(BinaryArithmetic):

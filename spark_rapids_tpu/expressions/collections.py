@@ -1,0 +1,1710 @@
+"""Collection (array/map) expressions + higher-order functions.
+
+TPU re-design of the reference collection layer
+(/root/reference/sql-plugin/src/main/scala/org/apache/spark/sql/rapids/
+collectionOperations.scala and higherOrderFunctions.scala). cuDF has native LIST
+kernels; here a list column is an int32 offsets vector plus a flattened child
+vector (columnar/vector.py), and the device kernels are XLA *segment ops* over
+the flat child:
+
+  * per-row reductions (array_min/max/contains/exists/forall) use
+    jax.ops.segment_{min,max,sum} with segment ids computed by a searchsorted
+    over the offsets — one fused XLA program per op, no per-list loops.
+  * element lookups (a[i], element_at) are flat gathers at offsets[:-1]+i.
+  * lambdas (transform/filter/exists/forall) evaluate the lambda body over a
+    pseudo-batch wrapping the FLAT child column, so the lambda runs as ordinary
+    vectorized expression code over all elements of all rows at once; outer-row
+    references are expanded by gathering the row value per element segment.
+
+Set-like ops (sort_array, array_distinct/union/intersect/except, maps) are
+host-assisted (arrow/python hop inside eval_tpu), the same status as the
+ragged string kernels; the tagging layer prices this via host_assisted rules.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (ArrayType, BooleanT, BooleanType, DataType, DoubleType,
+                     FloatType, IntegerT, LongT, MapType, StringType, StructField,
+                     StructType, is_fixed_width, to_arrow as type_to_arrow)
+from ..columnar.vector import TpuColumnVector, TpuScalar, bucket_capacity, row_mask
+from .base import (AttributeReference, BinaryExpression, Expression, Literal,
+                   UnaryExpression, _DEFAULT_CTX, ExpressionError, combine_validity,
+                   make_column)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _is_float(dt: DataType) -> bool:
+    return isinstance(dt, (FloatType, DoubleType))
+
+
+def _result_from_pylist(values, dtype, batch):
+    """pylist → device column padded to the batch capacity."""
+    import pyarrow as pa
+    col = TpuColumnVector.from_arrow(pa.array(values, type=type_to_arrow(dtype)))
+    if col.capacity < batch.capacity:
+        from ..columnar.batch import _repad
+        col = _repad(col, batch.capacity)
+    return col
+
+
+def _pylist_of(x, batch, ctx, expr, n):
+    """Evaluate and materialize as a python list of length n (host hop)."""
+    r = expr.eval_tpu(batch, ctx)
+    if isinstance(r, TpuScalar):
+        return [r.value] * n
+    return r.to_pylist()
+
+
+def _segments(col: TpuColumnVector):
+    """Per-element segment (row) ids for a list column.
+
+    Returns (seg_ids, in_data) where seg_ids[e] is the owning row of flat
+    element e (clipped into range) and in_data marks real (non-padding)
+    element slots. Pure XLA — searchsorted lowers to a vectorized binary
+    search on TPU."""
+    child = col.child
+    elem_cap = child.capacity
+    offsets = col.offsets
+    pos = jnp.arange(elem_cap, dtype=jnp.int32)
+    seg = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    in_data = pos < offsets[-1]
+    return jnp.clip(seg, 0, col.capacity - 1), in_data
+
+
+def _lengths(col: TpuColumnVector):
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def _segment_reduce(vals, seg, drop_mask, row_cap: int, kind: str):
+    """Segment reduction dropping masked elements (drop_mask True == drop)."""
+    seg_ids = jnp.where(drop_mask, row_cap, seg)
+    fn = {"max": jax.ops.segment_max, "min": jax.ops.segment_min,
+          "sum": jax.ops.segment_sum}[kind]
+    out = fn(vals, seg_ids, num_segments=row_cap + 1)
+    return out[:row_cap]
+
+
+def _list_validity(col: TpuColumnVector, batch):
+    v = col.validity
+    return combine_validity(batch.capacity, v, row_mask(col.num_rows, batch.capacity))
+
+
+def _eval_list(expr: Expression, batch, ctx):
+    """Evaluate a child producing a list column; scalars are expanded."""
+    r = expr.eval_tpu(batch, ctx)
+    if isinstance(r, TpuScalar):
+        return TpuColumnVector.from_scalar(r.value, r.dtype, batch.num_rows,
+                                           capacity=batch.capacity)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# size / element access
+# ---------------------------------------------------------------------------
+
+class Size(UnaryExpression):
+    """size(array|map). Reference GpuSize (collectionOperations.scala); Spark
+    legacy semantics: size(null) == -1 unless spark.sql.legacy.sizeOfNull=false."""
+
+    def __init__(self, child: Expression, legacy_size_of_null: bool = True):
+        super().__init__(child)
+        self.legacy = legacy_size_of_null
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return not self.legacy
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        col = _eval_list(self.child, batch, ctx)
+        if isinstance(self.child.dtype, MapType) or col.child is None:
+            # maps live host-side
+            vals = [None if v is None else len(v)
+                    for v in col.to_pylist()]
+            if self.legacy:
+                vals = [-1 if v is None else v for v in vals]
+            return _result_from_pylist(vals, IntegerT, batch)
+        lens = _lengths(col).astype(jnp.int32)
+        valid = _list_validity(col, batch)
+        if self.legacy:
+            data = jnp.where(valid if valid is not None else True, lens, -1)
+            return make_column(IntegerT, data,
+                               row_mask(col.num_rows, batch.capacity)
+                               if col.num_rows < batch.capacity else None,
+                               col.num_rows)
+        return make_column(IntegerT, lens, valid, col.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = self.child.eval_cpu(table, ctx)
+        out = [(-1 if self.legacy else None) if v is None else len(v)
+               for v in arr.to_pylist()]
+        return pa.array(out, type=pa.int32())
+
+    def pretty(self) -> str:
+        return f"size({self.child.pretty()})"
+
+
+class GetArrayItem(BinaryExpression):
+    """a[i] — 0-based; out-of-bounds → null (ANSI: error). Also dispatches
+    map[key] (Column.getItem can't know the type pre-resolution).
+    Reference GpuGetArrayItem / GpuGetMapValue (complexTypeExtractors)."""
+
+    @property
+    def dtype(self) -> DataType:
+        lt = self.left.dtype
+        return lt.value_type if isinstance(lt, MapType) else lt.element_type
+
+    def _as_map_value(self) -> "GetMapValue":
+        return GetMapValue(self.left, self.right)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        if isinstance(self.left.dtype, MapType):
+            return self._as_map_value().eval_tpu(batch, ctx)
+        col = _eval_list(self.left, batch, ctx)
+        idx = self.right.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        if isinstance(idx, TpuScalar):
+            if idx.value is None:
+                return TpuScalar(self.dtype, None)
+            idx_d = jnp.full((cap,), int(idx.value), jnp.int32)
+            idx_v = None
+        else:
+            idx_d = idx.data.astype(jnp.int32)
+            idx_v = idx.validity
+        if not is_fixed_width(self.dtype) or col.child is None:
+            lists = col.to_pylist()
+            h_idx = np.asarray(idx_d)[:col.num_rows]
+            h_iv = np.asarray(idx_v)[:col.num_rows] if idx_v is not None else None
+            out = []
+            for k, lst in enumerate(lists):
+                if lst is None or (h_iv is not None and not h_iv[k]):
+                    out.append(None)
+                    continue
+                i = int(h_idx[k])
+                out.append(lst[i] if 0 <= i < len(lst) else None)
+            return _result_from_pylist(out, self.dtype, batch)
+        lens = _lengths(col)
+        in_range = (idx_d >= 0) & (idx_d < lens)
+        valid = combine_validity(cap, _list_validity(col, batch), idx_v, in_range)
+        abs_idx = jnp.clip(col.offsets[:-1] + jnp.maximum(idx_d, 0), 0,
+                           max(col.child.capacity - 1, 0))
+        data = jnp.take(col.child.data, abs_idx)
+        cv = col.child.validity
+        if cv is not None:
+            valid = combine_validity(cap, valid, jnp.take(cv, abs_idx))
+        return make_column(self.dtype, data, valid, col.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        if isinstance(self.left.dtype, MapType):
+            return self._as_map_value().eval_cpu(table, ctx)
+        arr = self.left.eval_cpu(table, ctx)
+        idx = self.right.eval_cpu(table, ctx)
+        lists = arr.to_pylist()
+        idxs = idx.to_pylist() if isinstance(idx, (pa.Array, pa.ChunkedArray)) \
+            else [idx] * len(lists)
+        out = []
+        for lst, i in zip(lists, idxs):
+            out.append(None if lst is None or i is None or not (0 <= i < len(lst))
+                       else lst[i])
+        return pa.array(out, type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"{self.left.pretty()}[{self.right.pretty()}]"
+
+
+class ElementAt(BinaryExpression):
+    """element_at(array, i) 1-based (negative from end; 0 errors) or
+    element_at(map, key). Reference GpuElementAt."""
+
+    @property
+    def dtype(self) -> DataType:
+        lt = self.left.dtype
+        return lt.value_type if isinstance(lt, MapType) else lt.element_type
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        lt = self.left.dtype
+        if isinstance(lt, MapType):
+            return self._map_eval(batch, ctx)
+        col = _eval_list(self.left, batch, ctx)
+        idx = self.right.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        # the 0-index error only fires for rows where the array itself is
+        # non-null (Spark nullSafeEval short-circuits null inputs)
+        arr_valid = _list_validity(col, batch)
+        if isinstance(idx, TpuScalar):
+            if idx.value is None:
+                return TpuScalar(self.dtype, None)
+            if int(idx.value) == 0:
+                any_valid = bool(jnp.any(arr_valid)) if arr_valid is not None \
+                    else col.num_rows > 0
+                if any_valid:
+                    raise ExpressionError("SQL array indices start at 1")
+            idx_d = jnp.full((cap,), int(idx.value), jnp.int64)
+            idx_v = None
+        else:
+            idx_d = idx.data.astype(jnp.int64)
+            idx_v = idx.validity
+            rowv = combine_validity(cap, idx_v, arr_valid,
+                                    row_mask(col.num_rows, cap))
+            zero = (idx_d == 0) & (rowv if rowv is not None else True)
+            if bool(jnp.any(zero)):  # host sync: error semantics need a decision
+                raise ExpressionError("SQL array indices start at 1")
+        if not is_fixed_width(self.dtype) or col.child is None:
+            lists = col.to_pylist()
+            h_idx = np.asarray(idx_d)[:col.num_rows]
+            h_iv = np.asarray(idx_v)[:col.num_rows] if idx_v is not None else None
+            out = []
+            for k, lst in enumerate(lists):
+                if lst is None or (h_iv is not None and not h_iv[k]):
+                    out.append(None)
+                    continue
+                i = int(h_idx[k])
+                if i > 0:
+                    out.append(lst[i - 1] if i <= len(lst) else None)
+                else:
+                    out.append(lst[i] if -i <= len(lst) else None)
+            return _result_from_pylist(out, self.dtype, batch)
+        lens = _lengths(col).astype(jnp.int64)
+        pos0 = jnp.where(idx_d > 0, idx_d - 1, lens + idx_d)
+        in_range = (pos0 >= 0) & (pos0 < lens)
+        valid = combine_validity(cap, _list_validity(col, batch), idx_v, in_range)
+        abs_idx = jnp.clip(col.offsets[:-1] + jnp.maximum(pos0, 0).astype(jnp.int32),
+                           0, max(col.child.capacity - 1, 0))
+        data = jnp.take(col.child.data, abs_idx)
+        cv = col.child.validity
+        if cv is not None:
+            valid = combine_validity(cap, valid, jnp.take(cv, abs_idx))
+        return make_column(self.dtype, data, valid, col.num_rows)
+
+    def _map_eval(self, batch, ctx):
+        maps = _pylist_of(None, batch, ctx, self.left, batch.num_rows)
+        keys = _pylist_of(None, batch, ctx, self.right, batch.num_rows)
+        out = []
+        for m, k in zip(maps, keys):
+            if m is None or k is None:
+                out.append(None)
+            else:
+                d = dict(m) if not isinstance(m, dict) else m
+                out.append(d.get(k))
+        return _result_from_pylist(out, self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = self.left.eval_cpu(table, ctx)
+        idx = self.right.eval_cpu(table, ctx)
+        lists = arr.to_pylist()
+        idxs = idx.to_pylist() if isinstance(idx, (pa.Array, pa.ChunkedArray)) \
+            else [idx] * len(lists)
+        out = []
+        is_map = isinstance(self.left.dtype, MapType)
+        for lst, i in zip(lists, idxs):
+            if lst is None or i is None:
+                out.append(None)
+            elif is_map:
+                d = dict(lst) if not isinstance(lst, dict) else lst
+                out.append(d.get(i))
+            elif i == 0:
+                raise ExpressionError("SQL array indices start at 1")
+            elif i > 0:
+                out.append(lst[i - 1] if i <= len(lst) else None)
+            else:
+                out.append(lst[i] if -i <= len(lst) else None)
+        return pa.array(out, type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"element_at({self.left.pretty()}, {self.right.pretty()})"
+
+
+# ---------------------------------------------------------------------------
+# membership / reductions
+# ---------------------------------------------------------------------------
+
+class ArrayContains(BinaryExpression):
+    """array_contains(arr, value). Null semantics: null arr or null value → null;
+    no match but null element present → null (reference GpuArrayContains)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        col = _eval_list(self.left, batch, ctx)
+        val = self.right.eval_tpu(batch, ctx)
+        elem_t = self.left.dtype.element_type
+        cap = batch.capacity
+        if (not is_fixed_width(elem_t) or col.child is None
+                or not isinstance(val, TpuScalar)):
+            return self._host(batch, ctx, col, val)
+        if val.value is None:
+            return TpuScalar(BooleanT, None)
+        seg, in_data = _segments(col)
+        elem = col.child.data
+        target = jnp.asarray(val.value, elem.dtype)
+        if _is_float(elem_t) and isinstance(val.value, float) and math.isnan(val.value):
+            match = jnp.isnan(elem)
+        else:
+            match = elem == target
+        ev = col.child.validity
+        evalid = in_data if ev is None else (in_data & ev)
+        row_cap = col.capacity
+        any_match = _segment_reduce(
+            (match & evalid).astype(jnp.int32), seg, ~in_data, row_cap, "max") > 0
+        any_null = _segment_reduce(
+            ((~evalid) & in_data).astype(jnp.int32), seg, ~in_data, row_cap, "max") > 0
+        valid = combine_validity(cap, _list_validity(col, batch),
+                                 ~((~any_match) & any_null))
+        return make_column(BooleanT, any_match, valid, col.num_rows)
+
+    def _host(self, batch, ctx, col, val):
+        lists = col.to_pylist()
+        vals = [val.value] * len(lists) if isinstance(val, TpuScalar) \
+            else val.to_pylist()
+        out = [_contains_one(l, v) for l, v in zip(lists, vals)]
+        return _result_from_pylist(out, BooleanT, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = self.left.eval_cpu(table, ctx)
+        v = self.right.eval_cpu(table, ctx)
+        lists = arr.to_pylist()
+        vals = v.to_pylist() if isinstance(v, (pa.Array, pa.ChunkedArray)) \
+            else [v] * len(lists)
+        return pa.array([_contains_one(l, x) for l, x in zip(lists, vals)],
+                        type=pa.bool_())
+
+    def pretty(self) -> str:
+        return f"array_contains({self.left.pretty()}, {self.right.pretty()})"
+
+
+def _eq_value(a, b):
+    if isinstance(a, float) and isinstance(b, float) \
+            and math.isnan(a) and math.isnan(b):
+        return True
+    return a == b
+
+
+def _contains_one(lst, v):
+    if lst is None or v is None:
+        return None
+    found = any(e is not None and _eq_value(e, v) for e in lst)
+    if found:
+        return True
+    return None if any(e is None for e in lst) else False
+
+
+class ArrayPosition(BinaryExpression):
+    """array_position(arr, val): 1-based first match, 0 when absent."""
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        col = _eval_list(self.left, batch, ctx)
+        val = self.right.eval_tpu(batch, ctx)
+        lists = col.to_pylist()
+        vals = [val.value] * len(lists) if isinstance(val, TpuScalar) \
+            else val.to_pylist()
+        return _result_from_pylist(
+            [_position_one(l, v) for l, v in zip(lists, vals)], LongT, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        lists = self.left.eval_cpu(table, ctx).to_pylist()
+        v = self.right.eval_cpu(table, ctx)
+        vals = v.to_pylist() if isinstance(v, (pa.Array, pa.ChunkedArray)) \
+            else [v] * len(lists)
+        return pa.array([_position_one(l, x) for l, x in zip(lists, vals)],
+                        type=pa.int64())
+
+    def pretty(self) -> str:
+        return f"array_position({self.left.pretty()}, {self.right.pretty()})"
+
+
+def _position_one(lst, v):
+    if lst is None or v is None:
+        return None
+    for i, e in enumerate(lst):
+        if e is not None and _eq_value(e, v):
+            return i + 1
+    return 0
+
+
+class _ArrayMinMax(UnaryExpression):
+    _kind = "min"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype.element_type
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        col = _eval_list(self.child, batch, ctx)
+        elem_t = self.dtype
+        if not is_fixed_width(elem_t) or col.child is None:
+            lists = col.to_pylist()
+            return _result_from_pylist([_minmax_one(l, self._kind) for l in lists],
+                                       elem_t, batch)
+        seg, in_data = _segments(col)
+        ev = col.child.validity
+        evalid = in_data if ev is None else (in_data & ev)
+        vals = col.child.data
+        row_cap = col.capacity
+        cap = batch.capacity
+        if _is_float(elem_t):
+            nan = jnp.isnan(vals)
+            sent = jnp.inf if self._kind == "min" else -jnp.inf
+            clean = jnp.where(nan, sent, vals)
+            red = _segment_reduce(clean, seg, ~evalid, row_cap, self._kind)
+            nonnan = _segment_reduce(((~nan) & evalid).astype(jnp.int32), seg,
+                                     ~in_data, row_cap, "sum")
+            has_nan = _segment_reduce((nan & evalid).astype(jnp.int32), seg,
+                                      ~in_data, row_cap, "sum") > 0
+            count = _segment_reduce(evalid.astype(jnp.int32), seg, ~in_data,
+                                    row_cap, "sum")
+            if self._kind == "max":
+                data = jnp.where(has_nan, jnp.nan, red)
+            else:
+                data = jnp.where(nonnan > 0, red, jnp.nan)
+            valid = combine_validity(cap, _list_validity(col, batch), count > 0)
+            return make_column(elem_t, data, valid, col.num_rows)
+        red = _segment_reduce(vals, seg, ~evalid, row_cap, self._kind)
+        count = _segment_reduce(evalid.astype(jnp.int32), seg, ~in_data,
+                                row_cap, "sum")
+        valid = combine_validity(cap, _list_validity(col, batch), count > 0)
+        return make_column(elem_t, red, valid, col.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        lists = self.child.eval_cpu(table, ctx).to_pylist()
+        return pa.array([_minmax_one(l, self._kind) for l in lists],
+                        type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"array_{self._kind}({self.child.pretty()})"
+
+
+def _minmax_one(lst, kind):
+    if lst is None:
+        return None
+    vals = [e for e in lst if e is not None]
+    if not vals:
+        return None
+    floats = [v for v in vals if isinstance(v, float)]
+    nans = [v for v in floats if math.isnan(v)]
+    if nans:
+        clean = [v for v in vals if not (isinstance(v, float) and math.isnan(v))]
+        if kind == "max":
+            return float("nan")
+        return min(clean) if clean else float("nan")
+    return min(vals) if kind == "min" else max(vals)
+
+
+class ArrayMin(_ArrayMinMax):
+    _kind = "min"
+
+
+class ArrayMax(_ArrayMinMax):
+    _kind = "max"
+
+
+# ---------------------------------------------------------------------------
+# constructors / shape ops
+# ---------------------------------------------------------------------------
+
+def _common_elem_type(types: Sequence[DataType]) -> DataType:
+    """Least-common type over array() arguments (Spark's coerceArrayType:
+    numeric widening; otherwise the first non-null type)."""
+    from ..types import NullType, NumericType, numeric_promote
+    cur = types[0]
+    for t in types[1:]:
+        if t == cur:
+            continue
+        if isinstance(cur, NullType):
+            cur = t
+            continue
+        if isinstance(t, NullType):
+            continue
+        if isinstance(cur, NumericType) and isinstance(t, NumericType):
+            cur = numeric_promote(cur, t)
+            continue
+        raise ExpressionError(
+            f"cannot resolve array() due to data type mismatch: {cur} vs {t}")
+    return cur
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...). Device path interleaves the evaluated child columns
+    into the flat element vector (reference GpuCreateArray)."""
+
+    def __init__(self, children: Sequence[Expression]):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        if not self.children:
+            from ..types import NullT
+            return ArrayType(NullT, True)
+        elem = _common_elem_type([c.dtype for c in self.children])
+        return ArrayType(elem, any(c.nullable for c in self.children))
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        elem_t = self.dtype.element_type
+        k = len(self.children)
+        cap = batch.capacity
+        n = batch.num_rows
+        if not is_fixed_width(elem_t) or k == 0:
+            cols = [_pylist_of(None, batch, ctx, c, n) for c in self.children]
+            out = [[col[i] for col in cols] for i in range(n)]
+            return _result_from_pylist(out, self.dtype, batch)
+        datas, valids = [], []
+        for c in self.children:
+            r = c.eval_tpu(batch, ctx)
+            if isinstance(r, TpuScalar):
+                if r.value is None:
+                    datas.append(jnp.zeros((cap,), elem_t.np_dtype))
+                    valids.append(jnp.zeros((cap,), jnp.bool_))
+                else:
+                    datas.append(jnp.full((cap,), r.value, elem_t.np_dtype))
+                    valids.append(jnp.ones((cap,), jnp.bool_))
+            else:
+                datas.append(r.data.astype(elem_t.np_dtype))
+                valids.append(r.validity if r.validity is not None
+                              else jnp.ones((cap,), jnp.bool_))
+        flat = jnp.stack(datas, axis=1).reshape(-1)       # (cap*k,)
+        flat_v = jnp.stack(valids, axis=1).reshape(-1)
+        elem_mask = jnp.repeat(row_mask(n, cap), k)
+        flat_v = flat_v & elem_mask
+        offsets = (jnp.minimum(jnp.arange(cap + 1, dtype=jnp.int32), n) * k)
+        child = TpuColumnVector(elem_t, flat, flat_v, n * k)
+        return TpuColumnVector(self.dtype, flat, None, n, offsets=offsets,
+                               child=child)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = table.num_rows
+        cols = []
+        for c in self.children:
+            r = c.eval_cpu(table, ctx)
+            cols.append(r.to_pylist() if isinstance(r, (pa.Array, pa.ChunkedArray))
+                        else [r] * n)
+        out = [[col[i] for col in cols] for i in range(n)]
+        return pa.array(out, type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"array({', '.join(c.pretty() for c in self.children)})"
+
+
+class _HostListOp(Expression):
+    """Base for host-assisted list ops: children evaluated, pylists combined."""
+
+    def _combine(self, *lists_per_child):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        n = batch.num_rows
+        cols = [_pylist_of(None, batch, ctx, c, n) for c in self.children]
+        out = [self._combine(*[col[i] for col in cols]) for i in range(n)]
+        return _result_from_pylist(out, self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = table.num_rows
+        cols = []
+        for c in self.children:
+            r = c.eval_cpu(table, ctx)
+            cols.append(r.to_pylist() if isinstance(r, (pa.Array, pa.ChunkedArray))
+                        else [r] * n)
+        out = [self._combine(*[col[i] for col in cols]) for i in range(n)]
+        return pa.array(out, type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        name = type(self).__name__
+        return f"{name}({', '.join(c.pretty() for c in self.children)})"
+
+
+class SortArray(_HostListOp):
+    """sort_array(arr, asc): nulls first when ascending, last when descending
+    (Spark semantics; reference GpuSortArray)."""
+
+    def __init__(self, child: Expression, ascending: Expression = None):
+        asc = ascending if ascending is not None else Literal(True)
+        self.children = (child, asc)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, lst, asc):
+        if lst is None or asc is None:
+            return None
+        non_null = sorted([e for e in lst if e is not None],
+                          key=_sort_key, reverse=not asc)
+        nulls = [None] * (len(lst) - len(non_null))
+        return nulls + non_null if asc else non_null + nulls
+
+
+def _sort_key(v):
+    # NaN sorts greatest (Spark ordering)
+    if isinstance(v, float) and math.isnan(v):
+        return (1, 0.0)
+    if isinstance(v, (int, float)):
+        return (0, v)
+    return (0, v)
+
+
+class ArrayDistinct(_HostListOp):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, lst):
+        if lst is None:
+            return None
+        return _dedupe(lst, keep_null=True)
+
+
+def _canon(e):
+    if isinstance(e, float) and math.isnan(e):
+        return "__nan__"
+    return e
+
+
+def _dedupe(lst, keep_null=True):
+    seen, out, saw_null = set(), [], False
+    for e in lst:
+        if e is None:
+            if keep_null and not saw_null:
+                saw_null = True
+                out.append(None)
+            continue
+        k = _canon(e)
+        if k not in seen:
+            seen.add(k)
+            out.append(e)
+    return out
+
+
+class ArrayUnion(_HostListOp):
+    def __init__(self, l: Expression, r: Expression):
+        self.children = (l, r)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, a, b):
+        if a is None or b is None:
+            return None
+        return _dedupe(list(a) + list(b), keep_null=True)
+
+
+class ArrayIntersect(_HostListOp):
+    def __init__(self, l: Expression, r: Expression):
+        self.children = (l, r)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, a, b):
+        if a is None or b is None:
+            return None
+        bset = {_canon(e) for e in b if e is not None}
+        b_null = any(e is None for e in b)
+        out = []
+        for e in _dedupe(a, keep_null=True):
+            if e is None:
+                if b_null:
+                    out.append(None)
+            elif _canon(e) in bset:
+                out.append(e)
+        return out
+
+
+class ArrayExcept(_HostListOp):
+    def __init__(self, l: Expression, r: Expression):
+        self.children = (l, r)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, a, b):
+        if a is None or b is None:
+            return None
+        bset = {_canon(e) for e in b if e is not None}
+        b_null = any(e is None for e in b)
+        out = []
+        for e in _dedupe(a, keep_null=True):
+            if e is None:
+                if not b_null:
+                    out.append(None)
+            elif _canon(e) not in bset:
+                out.append(e)
+        return out
+
+
+class ArraysOverlap(_HostListOp):
+    def __init__(self, l: Expression, r: Expression):
+        self.children = (l, r)
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def _combine(self, a, b):
+        if a is None or b is None:
+            return None
+        aset = {_canon(e) for e in a if e is not None}
+        bset = {_canon(e) for e in b if e is not None}
+        if aset & bset:
+            return True
+        if (any(e is None for e in a) and len(b) > 0) or \
+                (any(e is None for e in b) and len(a) > 0):
+            return None
+        return False
+
+
+class ArrayRepeat(_HostListOp):
+    def __init__(self, elem: Expression, count: Expression):
+        self.children = (elem, count)
+
+    @property
+    def dtype(self) -> DataType:
+        return ArrayType(self.children[0].dtype, self.children[0].nullable)
+
+    def _combine(self, e, cnt):
+        if cnt is None:
+            return None
+        return [e] * max(0, int(cnt))
+
+
+class Slice(_HostListOp):
+    """slice(arr, start, length): 1-based; negative start counts from end."""
+
+    def __init__(self, arr: Expression, start: Expression, length: Expression):
+        self.children = (arr, start, length)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, lst, start, length):
+        if lst is None or start is None or length is None:
+            return None
+        if start == 0:
+            raise ExpressionError("Unexpected value for start in slice: 0")
+        if length < 0:
+            raise ExpressionError(f"Unexpected value for length in slice: {length}")
+        i = start - 1 if start > 0 else len(lst) + start
+        if i < 0:
+            return []
+        return lst[i:i + length]
+
+
+class ConcatArrays(_HostListOp):
+    """concat(a1, a2, ...) for array inputs (strings use ConcatStr)."""
+
+    def __init__(self, children: Sequence[Expression]):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, *lists):
+        out = []
+        for l in lists:
+            if l is None:
+                return None
+            out.extend(l)
+        return out
+
+
+class Flatten(_HostListOp):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype.element_type
+
+    def _combine(self, lst):
+        if lst is None:
+            return None
+        out = []
+        for inner in lst:
+            if inner is None:
+                return None
+            out.extend(inner)
+        return out
+
+
+class ArrayJoin(_HostListOp):
+    def __init__(self, arr: Expression, delim: Expression,
+                 null_replacement: Optional[Expression] = None):
+        self.children = (arr, delim) + \
+            ((null_replacement,) if null_replacement is not None else ())
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import StringT
+        return StringT
+
+    def _combine(self, lst, delim, *rep):
+        if lst is None or delim is None:
+            return None
+        repl = rep[0] if rep else None
+        parts = []
+        for e in lst:
+            if e is None:
+                if repl is not None:
+                    parts.append(str(repl))
+            else:
+                parts.append(str(e))
+        return delim.join(parts)
+
+
+class Sequence(_HostListOp):
+    """sequence(start, stop[, step]) — inclusive. Reference GpuSequence."""
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: Optional[Expression] = None):
+        self.children = (start, stop) + ((step,) if step is not None else ())
+
+    @property
+    def dtype(self) -> DataType:
+        return ArrayType(self.children[0].dtype, False)
+
+    def _combine(self, start, stop, *step):
+        if start is None or stop is None or (step and step[0] is None):
+            return None
+        s = step[0] if step else (1 if stop >= start else -1)
+        if s == 0:
+            raise ExpressionError("sequence step must not be zero")
+        if (stop - start) * s < 0:
+            return []
+        out = list(range(int(start), int(stop) + (1 if s > 0 else -1), int(s)))
+        return out
+
+
+class ArrayReverse(_HostListOp):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, lst):
+        return None if lst is None else list(reversed(lst))
+
+
+class ArraysZip(_HostListOp):
+    def __init__(self, children: Sequence[Expression], names: Optional[List[str]] = None):
+        self.children = tuple(children)
+        self._names = names or [str(i) for i in range(len(self.children))]
+
+    @property
+    def dtype(self) -> DataType:
+        fields = [StructField(n, c.dtype.element_type, True)
+                  for n, c in zip(self._names, self.children)]
+        return ArrayType(StructType(fields), True)
+
+    def _combine(self, *lists):
+        if any(l is None for l in lists):
+            return None
+        m = max((len(l) for l in lists), default=0)
+        return [{n: (l[i] if i < len(l) else None)
+                 for n, l in zip(self._names, lists)} for i in range(m)]
+
+
+# ---------------------------------------------------------------------------
+# map expressions (host-side; map columns have no device layout yet)
+# ---------------------------------------------------------------------------
+
+def _as_pairs(m):
+    if m is None:
+        return None
+    if isinstance(m, dict):
+        return list(m.items())
+    return list(m)
+
+
+def _dedupe_pairs(pairs):
+    """Last-win key dedup (spark.sql.mapKeyDedupPolicy=LAST_WIN), preserving
+    first-insertion order and NaN-key equality consistent with GetMapValue."""
+    out = {}
+    for k, v in pairs:
+        out[_canon(k)] = (k, v)
+    return list(out.values())
+
+
+class CreateMap(_HostListOp):
+    def __init__(self, children: Sequence[Expression]):
+        assert len(children) % 2 == 0
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        k = self.children[0].dtype
+        v = self.children[1].dtype
+        return MapType(k, v, any(c.nullable for c in self.children[1::2]))
+
+    def _combine(self, *vals):
+        keys = vals[0::2]
+        vs = vals[1::2]
+        if any(k is None for k in keys):
+            raise ExpressionError("Cannot use null as map key")
+        return _dedupe_pairs(zip(keys, vs))
+
+
+class MapKeys(_HostListOp):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self) -> DataType:
+        return ArrayType(self.children[0].dtype.key_type, False)
+
+    def _combine(self, m):
+        p = _as_pairs(m)
+        return None if p is None else [k for k, _ in p]
+
+
+class MapValues(_HostListOp):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self) -> DataType:
+        mt = self.children[0].dtype
+        return ArrayType(mt.value_type, mt.value_contains_null)
+
+    def _combine(self, m):
+        p = _as_pairs(m)
+        return None if p is None else [v for _, v in p]
+
+
+class GetMapValue(_HostListOp):
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype.value_type
+
+    def _combine(self, m, k):
+        p = _as_pairs(m)
+        if p is None or k is None:
+            return None
+        for ek, ev in p:
+            if _eq_value(ek, k):
+                return ev
+        return None
+
+
+class MapConcat(_HostListOp):
+    def __init__(self, children: Sequence[Expression]):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, *maps):
+        pairs = []
+        for m in maps:
+            p = _as_pairs(m)
+            if p is None:
+                return None
+            pairs.extend(p)
+        return _dedupe_pairs(pairs)
+
+
+class MapFromArrays(_HostListOp):
+    def __init__(self, keys: Expression, values: Expression):
+        self.children = (keys, values)
+
+    @property
+    def dtype(self) -> DataType:
+        kt = self.children[0].dtype.element_type
+        vt = self.children[1].dtype.element_type
+        return MapType(kt, vt, True)
+
+    def _combine(self, ks, vs):
+        if ks is None or vs is None:
+            return None
+        if len(ks) != len(vs):
+            raise ExpressionError("map_from_arrays: key/value lengths differ")
+        if any(k is None for k in ks):
+            raise ExpressionError("Cannot use null as map key")
+        return _dedupe_pairs(zip(ks, vs))
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions
+# ---------------------------------------------------------------------------
+
+_NEXT_LAMBDA_ID = [0]
+
+
+class NamedLambdaVariable(Expression):
+    """A lambda argument (reference NamedLambdaVariable). Identity by object."""
+
+    def __init__(self, name: str, dtype: DataType, nullable: bool = True):
+        self.children = ()
+        self.name = name
+        self._dtype = dtype
+        self._nullable = nullable
+        _NEXT_LAMBDA_ID[0] += 1
+        self.var_id = _NEXT_LAMBDA_ID[0]
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    def pretty(self) -> str:
+        return self.name
+
+
+class _BoundLambdaVar(Expression):
+    """Lambda variable bound to an ordinal of the element pseudo-batch."""
+
+    def __init__(self, ordinal: int, dtype: DataType, nullable: bool = True):
+        self.children = ()
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return batch.column(self.ordinal)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return table.column(self.ordinal).combine_chunks()
+
+    def pretty(self) -> str:
+        return f"lambda#{self.ordinal}"
+
+
+class LambdaFunction(Expression):
+    """(x[, i]) -> body. children = (body,); arguments kept separately."""
+
+    def __init__(self, body: Expression, arguments: Sequence[NamedLambdaVariable]):
+        self.children = (body,)
+        self.arguments = list(arguments)
+
+    @property
+    def body(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self) -> DataType:
+        return self.body.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.body.nullable
+
+    def pretty(self) -> str:
+        args = ", ".join(a.name for a in self.arguments)
+        return f"({args}) -> {self.body.pretty()}"
+
+
+class HigherOrderFunction(Expression):
+    """Base: evaluates the lambda body over the FLAT element vector.
+
+    Both eval paths share the structure: flatten → vectorized body eval over a
+    pseudo input (elements, [position], [outer cols expanded per element]) →
+    segment-level recombination. This turns a per-list lambda into one
+    batch-wide XLA program — no per-row interpretation (the reference instead
+    relies on cuDF per-list kernels)."""
+
+    def __init__(self, argument: Expression, function: LambdaFunction):
+        self.children = (argument, function)
+
+    @property
+    def argument(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def function(self) -> LambdaFunction:
+        return self.children[1]
+
+    def _sync_vars(self) -> None:
+        """Fill lambda-variable types from the (now resolved) argument type.
+        Lambda vars are shared object identities across tree copies, so this
+        mutation is visible wherever the body is evaluated (the analogue of
+        Spark's ResolveLambdaVariables rule)."""
+        at = self.argument.dtype
+        if isinstance(at, ArrayType):
+            args = self.function.arguments
+            if args:
+                args[0]._dtype = at.element_type
+                args[0]._nullable = at.contains_null
+            if len(args) > 1:
+                args[1]._dtype = IntegerT
+                args[1]._nullable = False
+
+    @property
+    def resolved(self) -> bool:
+        ok = all(c.resolved for c in self.children)
+        if ok:
+            self._sync_vars()
+        return ok
+
+    # -- binding -----------------------------------------------------------
+    def _bound_body(self, with_index: bool):
+        """Replace lambda vars with pseudo-batch ordinals; collect outer refs.
+        Pseudo layout: [0]=element, [1]=position (if used), [2+]=outer refs."""
+        fn = self.function
+        var_ids = {v.var_id: i for i, v in enumerate(fn.arguments)}
+        outer: List[AttributeReference] = []
+        base = 2 if with_index else 1
+
+        def rule(e: Expression):
+            if isinstance(e, NamedLambdaVariable):
+                return _BoundLambdaVar(var_ids[e.var_id], e.dtype, e.nullable)
+            if isinstance(e, AttributeReference):
+                for j, o in enumerate(outer):
+                    if o.expr_id == e.expr_id:
+                        return _BoundLambdaVar(base + j, e.dtype, e.nullable)
+                outer.append(e)
+                return _BoundLambdaVar(base + len(outer) - 1, e.dtype, e.nullable)
+            return None
+
+        body = fn.body.transform(rule)
+        return body, outer
+
+    @property
+    def _uses_index(self) -> bool:
+        return len(self.function.arguments) > 1
+
+    # -- device ------------------------------------------------------------
+    def _device_pseudo(self, col: TpuColumnVector, batch, ctx, outer):
+        """Build the element pseudo-batch on device."""
+        from ..columnar.batch import TpuColumnarBatch
+        child = col.child
+        seg, in_data = _segments(col)
+        cols = [child]
+        if self._uses_index:
+            pos = jnp.arange(child.capacity, dtype=jnp.int32)
+            idx = pos - jnp.take(col.offsets, seg)
+            cols.append(TpuColumnVector(IntegerT, idx, None, child.num_rows))
+        for o in outer:
+            oc = o.eval_tpu(batch, ctx)
+            od = jnp.take(oc.data, seg)
+            ov = jnp.take(oc.validity, seg) if oc.validity is not None else None
+            cols.append(TpuColumnVector(oc.dtype, od, ov, child.num_rows))
+        return TpuColumnarBatch(cols, child.num_rows), seg, in_data
+
+    def _device_ok(self, col: TpuColumnVector, outer) -> bool:
+        if col.child is None or not is_fixed_width(col.child.dtype):
+            return False
+        return all(is_fixed_width(o.dtype) for o in outer)
+
+    # -- host --------------------------------------------------------------
+    def _host_pseudo(self, lists, batch_or_table, ctx, outer, is_tpu: bool):
+        """Flatten python lists into a pyarrow pseudo-table for eval_cpu."""
+        import pyarrow as pa
+        elem_t = self.argument.dtype.element_type
+        flat, pos, seg = [], [], []
+        for i, lst in enumerate(lists):
+            if lst is None:
+                continue
+            for j, e in enumerate(lst):
+                flat.append(e)
+                pos.append(j)
+                seg.append(i)
+        cols = {"elem": pa.array(flat, type=type_to_arrow(elem_t))}
+        if self._uses_index:
+            cols["pos"] = pa.array(pos, type=pa.int32())
+        for k, o in enumerate(outer):
+            if is_tpu:
+                ovals = o.eval_tpu(batch_or_table, ctx).to_pylist()
+            else:
+                r = o.eval_cpu(batch_or_table, ctx)
+                ovals = r.to_pylist()
+            cols[f"outer{k}"] = pa.array([ovals[s] for s in seg],
+                                         type=type_to_arrow(o.dtype))
+        return pa.table(cols)
+
+
+class ArrayTransform(HigherOrderFunction):
+    """transform(arr, x -> f(x)). Reference GpuArrayTransform."""
+
+    @property
+    def dtype(self) -> DataType:
+        self._sync_vars()
+        return ArrayType(self.function.dtype, True)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        self._sync_vars()
+        col = _eval_list(self.argument, batch, ctx)
+        body, outer = self._bound_body(self._uses_index)
+        if self._device_ok(col, outer) and is_fixed_width(self.function.dtype):
+            pseudo, seg, in_data = self._device_pseudo(col, batch, ctx, outer)
+            res = body.eval_tpu(pseudo, ctx)
+            from .base import to_column
+            res_col = to_column(res, pseudo, self.function.dtype)
+            new_child = TpuColumnVector(self.function.dtype, res_col.data,
+                                        res_col.validity, col.child.num_rows)
+            return TpuColumnVector(self.dtype, new_child.data, col.validity,
+                                   col.num_rows, offsets=col.offsets,
+                                   child=new_child)
+        # host path
+        lists = col.to_pylist()
+        pseudo = self._host_pseudo(lists, batch, ctx, outer, is_tpu=True)
+        out_flat = body.eval_cpu(pseudo, ctx)
+        return _result_from_pylist(
+            _regroup(lists, out_flat.to_pylist() if hasattr(out_flat, "to_pylist")
+                     else [out_flat] * pseudo.num_rows),
+            self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        self._sync_vars()
+        lists = self.argument.eval_cpu(table, ctx).to_pylist()
+        body, outer = self._bound_body(self._uses_index)
+        pseudo = self._host_pseudo(lists, table, ctx, outer, is_tpu=False)
+        out_flat = body.eval_cpu(pseudo, ctx)
+        vals = out_flat.to_pylist() if isinstance(out_flat, (pa.Array, pa.ChunkedArray)) \
+            else [out_flat] * pseudo.num_rows
+        return pa.array(_regroup(lists, vals), type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"transform({self.argument.pretty()}, {self.function.pretty()})"
+
+
+def _regroup(lists, flat_vals):
+    out, p = [], 0
+    for lst in lists:
+        if lst is None:
+            out.append(None)
+        else:
+            out.append(flat_vals[p:p + len(lst)])
+            p += len(lst)
+    return out
+
+
+class _ArrayPredicateHOF(HigherOrderFunction):
+    """exists / forall: three-valued segment reduction of the predicate."""
+
+    _kind = "exists"  # or "forall"
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        self._sync_vars()
+        col = _eval_list(self.argument, batch, ctx)
+        body, outer = self._bound_body(self._uses_index)
+        cap = batch.capacity
+        if self._device_ok(col, outer):
+            pseudo, seg, in_data = self._device_pseudo(col, batch, ctx, outer)
+            from .base import to_column
+            res = to_column(body.eval_tpu(pseudo, ctx), pseudo, BooleanT)
+            pred = res.data.astype(jnp.bool_)
+            pv = res.validity
+            known = in_data if pv is None else (in_data & pv)
+            row_cap = col.capacity
+            any_true = _segment_reduce((pred & known).astype(jnp.int32), seg,
+                                       ~in_data, row_cap, "max") > 0
+            any_false = _segment_reduce(((~pred) & known).astype(jnp.int32), seg,
+                                        ~in_data, row_cap, "max") > 0
+            any_unknown = _segment_reduce(((~known) & in_data).astype(jnp.int32),
+                                          seg, ~in_data, row_cap, "max") > 0
+            if self._kind == "exists":
+                data = any_true
+                unknown = (~any_true) & any_unknown
+            else:
+                data = ~any_false
+                unknown = (~any_false) & any_unknown
+            valid = combine_validity(cap, _list_validity(col, batch), ~unknown)
+            return make_column(BooleanT, data, valid, col.num_rows)
+        lists = col.to_pylist()
+        pseudo = self._host_pseudo(lists, batch, ctx, outer, is_tpu=True)
+        flat = body.eval_cpu(pseudo, ctx)
+        vals = flat.to_pylist() if hasattr(flat, "to_pylist") \
+            else [flat] * pseudo.num_rows
+        return _result_from_pylist(
+            [_pred_one(g, self._kind) for g in _regroup(lists, vals)],
+            BooleanT, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        self._sync_vars()
+        lists = self.argument.eval_cpu(table, ctx).to_pylist()
+        body, outer = self._bound_body(self._uses_index)
+        pseudo = self._host_pseudo(lists, table, ctx, outer, is_tpu=False)
+        flat = body.eval_cpu(pseudo, ctx)
+        vals = flat.to_pylist() if isinstance(flat, (pa.Array, pa.ChunkedArray)) \
+            else [flat] * pseudo.num_rows
+        return pa.array([_pred_one(g, self._kind) for g in _regroup(lists, vals)],
+                        type=pa.bool_())
+
+    def pretty(self) -> str:
+        return f"{self._kind}({self.argument.pretty()}, {self.function.pretty()})"
+
+
+def _pred_one(group, kind):
+    if group is None:
+        return None
+    if kind == "exists":
+        if any(v is True for v in group):
+            return True
+        return None if any(v is None for v in group) else False
+    if any(v is False for v in group):
+        return False
+    return None if any(v is None for v in group) else True
+
+
+class ArrayExists(_ArrayPredicateHOF):
+    _kind = "exists"
+
+
+class ArrayForAll(_ArrayPredicateHOF):
+    _kind = "forall"
+
+
+class ArrayFilter(HigherOrderFunction):
+    """filter(arr, x -> pred): keeps elements where pred is true (null → drop)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return self.argument.dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.batch import TpuColumnarBatch, compact
+        self._sync_vars()
+        col = _eval_list(self.argument, batch, ctx)
+        body, outer = self._bound_body(self._uses_index)
+        if self._device_ok(col, outer):
+            pseudo, seg, in_data = self._device_pseudo(col, batch, ctx, outer)
+            from .base import to_column
+            res = to_column(body.eval_tpu(pseudo, ctx), pseudo, BooleanT)
+            keep = res.data.astype(jnp.bool_)
+            if res.validity is not None:
+                keep = keep & res.validity
+            keep = keep & in_data
+            row_cap = col.capacity
+            new_lens = _segment_reduce(keep.astype(jnp.int32), seg, ~in_data,
+                                       row_cap, "sum")
+            new_offsets = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(new_lens).astype(jnp.int32)])
+            kept = compact(TpuColumnarBatch([col.child], col.child.num_rows), keep)
+            new_child = kept.columns[0]
+            return TpuColumnVector(self.dtype, new_child.data, col.validity,
+                                   col.num_rows, offsets=new_offsets,
+                                   child=new_child)
+        lists = col.to_pylist()
+        pseudo = self._host_pseudo(lists, batch, ctx, outer, is_tpu=True)
+        flat = body.eval_cpu(pseudo, ctx)
+        vals = flat.to_pylist() if hasattr(flat, "to_pylist") \
+            else [flat] * pseudo.num_rows
+        return _result_from_pylist(_filter_groups(lists, vals), self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        self._sync_vars()
+        lists = self.argument.eval_cpu(table, ctx).to_pylist()
+        body, outer = self._bound_body(self._uses_index)
+        pseudo = self._host_pseudo(lists, table, ctx, outer, is_tpu=False)
+        flat = body.eval_cpu(pseudo, ctx)
+        vals = flat.to_pylist() if isinstance(flat, (pa.Array, pa.ChunkedArray)) \
+            else [flat] * pseudo.num_rows
+        return pa.array(_filter_groups(lists, vals), type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"filter({self.argument.pretty()}, {self.function.pretty()})"
+
+
+def _filter_groups(lists, flat_preds):
+    out, p = [], 0
+    for lst in lists:
+        if lst is None:
+            out.append(None)
+        else:
+            preds = flat_preds[p:p + len(lst)]
+            p += len(lst)
+            out.append([e for e, keep in zip(lst, preds) if keep is True])
+    return out
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish]).
+
+    Vectorized fold: iterate element POSITIONS (max list length times), each
+    step evaluating the merge body over full row-width columns — device when
+    types are fixed-width, arrow otherwise. children = (argument, zero,
+    merge_lambda[, finish_lambda])."""
+
+    def __init__(self, argument: Expression, zero: Expression,
+                 merge: LambdaFunction, finish: Optional[LambdaFunction] = None):
+        self.children = (argument, zero, merge) + \
+            ((finish,) if finish is not None else ())
+
+    @property
+    def argument(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def zero(self) -> Expression:
+        return self.children[1]
+
+    @property
+    def merge(self) -> LambdaFunction:
+        return self.children[2]
+
+    @property
+    def finish(self) -> Optional[LambdaFunction]:
+        return self.children[3] if len(self.children) > 3 else None
+
+    def _sync_vars(self) -> None:
+        at = self.argument.dtype
+        margs = self.merge.arguments
+        margs[0]._dtype = self.zero.dtype
+        if isinstance(at, ArrayType):
+            margs[1]._dtype = at.element_type
+            margs[1]._nullable = at.contains_null
+        if self.finish is not None:
+            self.finish.arguments[0]._dtype = self.merge.dtype
+
+    @property
+    def dtype(self) -> DataType:
+        self._sync_vars()
+        return self.finish.dtype if self.finish is not None else self.merge.dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        self._sync_vars()
+        col = _eval_list(self.argument, batch, ctx)
+        lists = col.to_pylist()
+        return _result_from_pylist(self._fold(lists, batch, ctx, is_tpu=True),
+                                   self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        self._sync_vars()
+        lists = self.argument.eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._fold(lists, table, ctx, is_tpu=False),
+                        type=type_to_arrow(self.dtype))
+
+    def _fold(self, lists, batch_or_table, ctx, is_tpu: bool):
+        """Per-position vectorized fold over arrow arrays (host)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        n = len(lists)
+        acc_t = self.merge.dtype
+        # zero
+        if is_tpu:
+            z = self.zero.eval_tpu(batch_or_table, ctx)
+            zvals = [z.value] * n if isinstance(z, TpuScalar) else z.to_pylist()
+        else:
+            z = self.zero.eval_cpu(batch_or_table, ctx)
+            zvals = z.to_pylist() if isinstance(z, (pa.Array, pa.ChunkedArray)) \
+                else [z] * n
+        acc = list(zvals)
+        max_len = max((len(l) for l in lists if l is not None), default=0)
+        acc_var, elem_var = self.merge.arguments[0], self.merge.arguments[1]
+
+        # bind lambda vars to pseudo ordinals 0/1 and outer column refs to 2+
+        # (the fold pseudo table is row-aligned, so outer columns pass through)
+        outer: List[AttributeReference] = []
+
+        def bind(body):
+            def rule(e):
+                if isinstance(e, NamedLambdaVariable):
+                    if e.var_id == acc_var.var_id:
+                        return _BoundLambdaVar(0, acc_var.dtype)
+                    return _BoundLambdaVar(1, elem_var.dtype)
+                if isinstance(e, AttributeReference):
+                    for j, o in enumerate(outer):
+                        if o.expr_id == e.expr_id:
+                            return _BoundLambdaVar(2 + j, e.dtype, e.nullable)
+                    outer.append(e)
+                    return _BoundLambdaVar(2 + len(outer) - 1, e.dtype, e.nullable)
+                return None
+            return body.transform(rule)
+
+        merge_body = bind(self.merge.body)
+        outer_cols = {}
+        for j, o in enumerate(outer):
+            if is_tpu:
+                ov = o.eval_tpu(batch_or_table, ctx).to_pylist()
+            else:
+                ov = o.eval_cpu(batch_or_table, ctx).to_pylist()
+            outer_cols[f"outer{j}"] = pa.array(ov, type=type_to_arrow(o.dtype))
+        for k in range(max_len):
+            elems = [l[k] if l is not None and k < len(l) else None for l in lists]
+            in_range = [l is not None and k < len(l) for l in lists]
+            pseudo = pa.table({
+                "acc": pa.array(acc, type=type_to_arrow(acc_t)),
+                "elem": pa.array(elems,
+                                 type=type_to_arrow(self.argument.dtype.element_type)),
+                **outer_cols,
+            })
+            merged = merge_body.eval_cpu(pseudo, ctx)
+            mvals = merged.to_pylist() if isinstance(merged, (pa.Array, pa.ChunkedArray)) \
+                else [merged] * n
+            acc = [mv if ir else a for mv, ir, a in zip(mvals, in_range, acc)]
+        out = [a if l is not None else None for a, l in zip(acc, lists)]
+        if self.finish is not None:
+            fv = self.finish.arguments[0]
+            fouter: List[AttributeReference] = []
+
+            def frule(e):
+                if isinstance(e, NamedLambdaVariable) and e.var_id == fv.var_id:
+                    return _BoundLambdaVar(0, fv.dtype)
+                if isinstance(e, AttributeReference):
+                    for j, o in enumerate(fouter):
+                        if o.expr_id == e.expr_id:
+                            return _BoundLambdaVar(1 + j, e.dtype, e.nullable)
+                    fouter.append(e)
+                    return _BoundLambdaVar(len(fouter), e.dtype, e.nullable)
+                return None
+            fbody = self.finish.body.transform(frule)
+            fcols = {"acc": pa.array(out, type=type_to_arrow(acc_t))}
+            for j, o in enumerate(fouter):
+                ov = o.eval_tpu(batch_or_table, ctx).to_pylist() if is_tpu \
+                    else o.eval_cpu(batch_or_table, ctx).to_pylist()
+                fcols[f"fouter{j}"] = pa.array(ov, type=type_to_arrow(o.dtype))
+            pseudo = pa.table(fcols)
+            fin = fbody.eval_cpu(pseudo, ctx)
+            fvals = fin.to_pylist() if isinstance(fin, (pa.Array, pa.ChunkedArray)) \
+                else [fin] * n
+            out = [f if l is not None else None for f, l in zip(fvals, lists)]
+        return out
+
+    def pretty(self) -> str:
+        return (f"aggregate({self.argument.pretty()}, {self.zero.pretty()}, "
+                f"{self.merge.pretty()})")
+
+
+class ZipWith(_HostListOp):
+    """zip_with(a, b, (x, y) -> f): pads the shorter with nulls."""
+
+    def __init__(self, left: Expression, right: Expression, function: LambdaFunction):
+        self.children = (left, right, function)
+
+    @property
+    def function(self) -> LambdaFunction:
+        return self.children[2]
+
+    def _sync_vars(self) -> None:
+        lt, rt = self.children[0].dtype, self.children[1].dtype
+        args = self.function.arguments
+        if isinstance(lt, ArrayType):
+            args[0]._dtype = lt.element_type
+        if isinstance(rt, ArrayType):
+            args[1]._dtype = rt.element_type
+        args[0]._nullable = True  # shorter side padded with nulls
+        args[1]._nullable = True
+
+    @property
+    def dtype(self) -> DataType:
+        self._sync_vars()
+        return ArrayType(self.function.dtype, True)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        self._sync_vars()
+        n = batch.num_rows
+        a = _pylist_of(None, batch, ctx, self.children[0], n)
+        b = _pylist_of(None, batch, ctx, self.children[1], n)
+        return _result_from_pylist(self._zip(a, b, ctx, batch, True),
+                                   self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        self._sync_vars()
+        a = self.children[0].eval_cpu(table, ctx).to_pylist()
+        b = self.children[1].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._zip(a, b, ctx, table, False),
+                        type=type_to_arrow(self.dtype))
+
+    def _zip(self, a_lists, b_lists, ctx, batch_or_table, is_tpu: bool):
+        import pyarrow as pa
+        fn = self.function
+        xv, yv = fn.arguments[0], fn.arguments[1]
+        outer: List[AttributeReference] = []
+
+        def rule(e):
+            if isinstance(e, NamedLambdaVariable):
+                if e.var_id == xv.var_id:
+                    return _BoundLambdaVar(0, xv.dtype)
+                return _BoundLambdaVar(1, yv.dtype)
+            if isinstance(e, AttributeReference):
+                for j, o in enumerate(outer):
+                    if o.expr_id == e.expr_id:
+                        return _BoundLambdaVar(2 + j, e.dtype, e.nullable)
+                outer.append(e)
+                return _BoundLambdaVar(2 + len(outer) - 1, e.dtype, e.nullable)
+            return None
+        body = fn.body.transform(rule)
+        flat_a, flat_b, shape, seg = [], [], [], []
+        for ri, (a, b) in enumerate(zip(a_lists, b_lists)):
+            if a is None or b is None:
+                shape.append(None)
+                continue
+            m = max(len(a), len(b))
+            shape.append(m)
+            for i in range(m):
+                flat_a.append(a[i] if i < len(a) else None)
+                flat_b.append(b[i] if i < len(b) else None)
+                seg.append(ri)
+        cols = {
+            "x": pa.array(flat_a, type=type_to_arrow(xv.dtype)),
+            "y": pa.array(flat_b, type=type_to_arrow(yv.dtype))}
+        for j, o in enumerate(outer):
+            ov = o.eval_tpu(batch_or_table, ctx).to_pylist() if is_tpu \
+                else o.eval_cpu(batch_or_table, ctx).to_pylist()
+            cols[f"outer{j}"] = pa.array([ov[s] for s in seg],
+                                         type=type_to_arrow(o.dtype))
+        pseudo = pa.table(cols)
+        res = body.eval_cpu(pseudo, ctx)
+        vals = res.to_pylist() if isinstance(res, (pa.Array, pa.ChunkedArray)) \
+            else [res] * pseudo.num_rows
+        out, p = [], 0
+        for m in shape:
+            if m is None:
+                out.append(None)
+            else:
+                out.append(vals[p:p + m])
+                p += m
+        return out

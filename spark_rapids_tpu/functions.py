@@ -348,3 +348,161 @@ def lag(c, offset: int = 1, default=None) -> Column:
     from .window import Lag
     d = Literal(default) if default is not None else None
     return Column(Lag(_expr_or_col(c), offset, d))
+
+
+# --- collection functions --------------------------------------------------
+# (reference: collectionOperations.scala / higherOrderFunctions.scala rules in
+#  GpuOverrides.commonExpressions)
+
+from .expressions import collections as _CL
+
+
+def _make_lambda(f, n_args: int):
+    """Python callable → LambdaFunction over fresh NamedLambdaVariables.
+    Variable types are filled in by the HOF's _sync_vars at resolution."""
+    from .types import NullT
+    names = ("x", "y", "z")
+    vars_ = [_CL.NamedLambdaVariable(names[i], NullT) for i in range(n_args)]
+    body = _expr(f(*[Column(v) for v in vars_]))
+    return _CL.LambdaFunction(body, vars_)
+
+
+def _lambda_arity(f) -> int:
+    import inspect
+    return len(inspect.signature(f).parameters)
+
+
+def array(*cols) -> Column:
+    return Column(_CL.CreateArray([_expr_or_col(c) for c in cols]))
+
+
+def size(c) -> Column:
+    return Column(_CL.Size(_expr_or_col(c)))
+
+
+def array_contains(c, value) -> Column:
+    return Column(_CL.ArrayContains(_expr_or_col(c), _expr(value)))
+
+
+def element_at(c, extraction) -> Column:
+    return Column(_CL.ElementAt(_expr_or_col(c), _expr(extraction)))
+
+
+def get(c, index) -> Column:
+    return Column(_CL.GetArrayItem(_expr_or_col(c), _expr(index)))
+
+
+def array_position(c, value) -> Column:
+    return Column(_CL.ArrayPosition(_expr_or_col(c), _expr(value)))
+
+
+def array_min(c) -> Column:
+    return Column(_CL.ArrayMin(_expr_or_col(c)))
+
+
+def array_max(c) -> Column:
+    return Column(_CL.ArrayMax(_expr_or_col(c)))
+
+
+def sort_array(c, asc: bool = True) -> Column:
+    return Column(_CL.SortArray(_expr_or_col(c), Literal(asc)))
+
+
+def array_distinct(c) -> Column:
+    return Column(_CL.ArrayDistinct(_expr_or_col(c)))
+
+
+def array_union(a, b) -> Column:
+    return Column(_CL.ArrayUnion(_expr_or_col(a), _expr_or_col(b)))
+
+
+def array_intersect(a, b) -> Column:
+    return Column(_CL.ArrayIntersect(_expr_or_col(a), _expr_or_col(b)))
+
+
+def array_except(a, b) -> Column:
+    return Column(_CL.ArrayExcept(_expr_or_col(a), _expr_or_col(b)))
+
+
+def arrays_overlap(a, b) -> Column:
+    return Column(_CL.ArraysOverlap(_expr_or_col(a), _expr_or_col(b)))
+
+
+def array_repeat(c, count) -> Column:
+    return Column(_CL.ArrayRepeat(_expr(c), _expr(count)))
+
+
+def slice(c, start, length) -> Column:  # noqa: A001 - pyspark name
+    return Column(_CL.Slice(_expr_or_col(c), _expr(start), _expr(length)))
+
+
+def concat_arrays(*cols) -> Column:
+    return Column(_CL.ConcatArrays([_expr_or_col(c) for c in cols]))
+
+
+def flatten(c) -> Column:
+    return Column(_CL.Flatten(_expr_or_col(c)))
+
+
+def array_join(c, delimiter: str, null_replacement=None) -> Column:
+    rep = Literal(null_replacement) if null_replacement is not None else None
+    return Column(_CL.ArrayJoin(_expr_or_col(c), Literal(delimiter), rep))
+
+
+def sequence(start, stop, step=None) -> Column:
+    s = _expr_or_col(step) if step is not None else None
+    return Column(_CL.Sequence(_expr_or_col(start), _expr_or_col(stop), s))
+
+
+def array_reverse(c) -> Column:
+    return Column(_CL.ArrayReverse(_expr_or_col(c)))
+
+
+def arrays_zip(*cols) -> Column:
+    return Column(_CL.ArraysZip([_expr_or_col(c) for c in cols]))
+
+
+def create_map(*cols) -> Column:
+    return Column(_CL.CreateMap([_expr_or_col(c) for c in cols]))
+
+
+def map_keys(c) -> Column:
+    return Column(_CL.MapKeys(_expr_or_col(c)))
+
+
+def map_values(c) -> Column:
+    return Column(_CL.MapValues(_expr_or_col(c)))
+
+
+def map_concat(*cols) -> Column:
+    return Column(_CL.MapConcat([_expr_or_col(c) for c in cols]))
+
+
+def map_from_arrays(keys, values) -> Column:
+    return Column(_CL.MapFromArrays(_expr_or_col(keys), _expr_or_col(values)))
+
+
+def transform(c, f) -> Column:
+    return Column(_CL.ArrayTransform(_expr_or_col(c), _make_lambda(f, _lambda_arity(f))))
+
+
+def exists(c, f) -> Column:
+    return Column(_CL.ArrayExists(_expr_or_col(c), _make_lambda(f, 1)))
+
+
+def forall(c, f) -> Column:
+    return Column(_CL.ArrayForAll(_expr_or_col(c), _make_lambda(f, 1)))
+
+
+def filter(c, f) -> Column:  # noqa: A001 - pyspark name
+    return Column(_CL.ArrayFilter(_expr_or_col(c), _make_lambda(f, _lambda_arity(f))))
+
+
+def aggregate(c, zero, merge, finish=None) -> Column:
+    m = _make_lambda(merge, 2)
+    fin = _make_lambda(finish, 1) if finish is not None else None
+    return Column(_CL.ArrayAggregate(_expr_or_col(c), _expr(zero), m, fin))
+
+
+def zip_with(a, b, f) -> Column:
+    return Column(_CL.ZipWith(_expr_or_col(a), _expr_or_col(b), _make_lambda(f, 2)))

@@ -54,9 +54,10 @@ def _register_builtin_exprs() -> None:
     sig_cmp = TypeSigs.comparable
     sig_all = TypeSigs.all_basic + TypeSigs.NULL
 
-    register_expr(B.Literal, sig_all, "literal value")
-    register_expr(B.AttributeReference, sig_all, "column reference")
-    register_expr(B.Alias, sig_all, "named expression")
+    sig_all_nested = TypeSigs.nested_common + TypeSigs.NULL
+    register_expr(B.Literal, sig_all_nested, "literal value")
+    register_expr(B.AttributeReference, sig_all_nested, "column reference")
+    register_expr(B.Alias, sig_all_nested, "named expression")
     register_expr(C.Cast, sig_all, "cast between types")
 
     for cls in (A.Add, A.Subtract, A.Multiply):
@@ -135,6 +136,42 @@ def _register_builtin_exprs() -> None:
     register_expr(RX.RegexpExtract, TypeSigs.STRING, "regex extract",
                   host_assisted=True)
     register_expr(RX.Like, TypeSigs.BOOLEAN, "SQL LIKE", host_assisted=True)
+
+    from ..expressions import collections as CL
+    sig_nested = TypeSigs.nested_common
+    register_expr(CL.Size, TypeSigs.integral, "size of array/map",
+                  host_assisted=True)  # map inputs hop to host
+    register_expr(CL.GetArrayItem, sig_nested, "array[i] access",
+                  host_assisted=True)  # non-fixed-width elements hop to host
+    register_expr(CL.ElementAt, sig_nested, "element_at (array 1-based / map key)",
+                  host_assisted=True)
+    register_expr(CL.ArrayContains, TypeSigs.BOOLEAN, "array_contains",
+                  host_assisted=True)  # column-valued needle hops to host
+    register_expr(CL.ArrayPosition, TypeSigs.integral, "array_position",
+                  host_assisted=True)
+    register_expr(CL.ArrayMin, sig_nested, "array_min (nulls skipped, NaN greatest)")
+    register_expr(CL.ArrayMax, sig_nested, "array_max (nulls skipped, NaN greatest)")
+    register_expr(CL.CreateArray, sig_nested, "array(...) constructor")
+    for cls in (CL.SortArray, CL.ArrayDistinct, CL.ArrayUnion, CL.ArrayIntersect,
+                CL.ArrayExcept, CL.ArraysOverlap, CL.ArrayRepeat, CL.Slice,
+                CL.ConcatArrays, CL.Flatten, CL.ArrayJoin, CL.Sequence,
+                CL.ArrayReverse, CL.ArraysZip):
+        register_expr(cls, sig_nested, f"array fn {cls.__name__}",
+                      host_assisted=True)
+    for cls in (CL.CreateMap, CL.MapKeys, CL.MapValues, CL.GetMapValue,
+                CL.MapConcat, CL.MapFromArrays):
+        register_expr(cls, sig_nested, f"map fn {cls.__name__}",
+                      host_assisted=True)
+    register_expr(CL.LambdaFunction, TypeSigs.all, "lambda function")
+    register_expr(CL.NamedLambdaVariable, TypeSigs.all, "lambda variable")
+    register_expr(CL.ArrayTransform, sig_nested,
+                  "transform(arr, lambda) — flat-element XLA eval")
+    register_expr(CL.ArrayExists, TypeSigs.BOOLEAN, "exists(arr, pred)")
+    register_expr(CL.ArrayForAll, TypeSigs.BOOLEAN, "forall(arr, pred)")
+    register_expr(CL.ArrayFilter, sig_nested, "filter(arr, pred)")
+    register_expr(CL.ArrayAggregate, sig_nested, "aggregate/reduce fold",
+                  host_assisted=True)
+    register_expr(CL.ZipWith, sig_nested, "zip_with", host_assisted=True)
 
     from .. import udf as U
     register_expr(U.TpuColumnarUDF, TypeSigs.all, "columnar device UDF (RapidsUDF)")

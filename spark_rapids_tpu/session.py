@@ -146,6 +146,20 @@ class Column:
         from .expressions.strings import Contains
         return Column(Contains(self._expr, _expr(other)))
 
+    def getItem(self, key) -> "Column":
+        """array[i] (0-based) or map[key] access (reference GpuGetArrayItem /
+        GpuGetMapValue)."""
+        from .expressions import collections as _CL
+        from .types import MapType
+        e = self._expr
+        try:
+            is_map = isinstance(e.dtype, MapType)
+        except Exception:  # unresolved — assume array; maps resolve via col refs
+            is_map = False
+        if is_map:
+            return Column(_CL.GetMapValue(e, _expr(key)))
+        return Column(_CL.GetArrayItem(e, _expr(key)))
+
     def substr(self, start: int, length: int) -> "Column":
         from .expressions.strings import Substring
         return Column(Substring(self._expr, Literal(start), Literal(length)))
@@ -561,6 +575,22 @@ class TpuSession:
             table = pa.table(data)
         elif isinstance(data, list) and data and isinstance(data[0], dict):
             table = pa.Table.from_pylist(data)
+            # Spark maps python dict VALUES to MapType, not StructType (pyarrow
+            # default); re-cast any struct-typed column whose row values were
+            # plain dicts of uniform value type
+            casts = []
+            for i, f in enumerate(table.schema):
+                if pa.types.is_struct(f.type) \
+                        and any(isinstance(r.get(f.name), dict) for r in data):
+                    vt = {ft.type for ft in f.type}
+                    if len(vt) == 1:
+                        mt = pa.map_(pa.string(), vt.pop())
+                        vals = [r.get(f.name) for r in data]
+                        casts.append((i, f.name,
+                                      pa.array([None if v is None else list(v.items())
+                                                for v in vals], type=mt)))
+            for i, name, arr in casts:
+                table = table.set_column(i, name, arr)
         elif isinstance(data, list) and schema is not None:
             names = schema if isinstance(schema, list) else schema.field_names
             cols = list(zip(*data)) if data else [[] for _ in names]

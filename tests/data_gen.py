@@ -151,6 +151,50 @@ class DecimalGen(DataGen):
         return [decimal.Decimal(int(u)).scaleb(-self.scale) for u in unscaled]
 
 
+class ArrayGen(DataGen):
+    """Array-of-child generator (reference ArrayGen in data_gen.py)."""
+
+    def __init__(self, child: DataGen, min_len: int = 0, max_len: int = 6,
+                 nullable: bool = True, null_prob: float = 0.1):
+        super().__init__(nullable, null_prob)
+        self.child = child
+        self.min_len = min_len
+        self.max_len = max_len
+        self.arrow_type = pa.list_(child.arrow_type)
+
+    def _values(self, rng, n):
+        out = []
+        for _ in range(n):
+            ln = int(rng.integers(self.min_len, self.max_len + 1))
+            out.append(self.child.generate(rng, ln).to_pylist())
+        return out
+
+
+class MapGen(DataGen):
+    """Map generator with unique keys per row."""
+
+    def __init__(self, key: DataGen, value: DataGen, max_len: int = 4,
+                 nullable: bool = True, null_prob: float = 0.1):
+        super().__init__(nullable, null_prob)
+        self.key = key
+        self.value = value
+        self.max_len = max_len
+        self.arrow_type = pa.map_(key.arrow_type, value.arrow_type)
+
+    def _values(self, rng, n):
+        out = []
+        for _ in range(n):
+            ln = int(rng.integers(0, self.max_len + 1))
+            ks, vs = [], self.value.generate(rng, ln).to_pylist()
+            seen = set()
+            for k in self.key.generate(rng, ln * 2).to_pylist():
+                if k is not None and k not in seen and len(ks) < ln:
+                    seen.add(k)
+                    ks.append(k)
+            out.append(list(zip(ks, vs[:len(ks)])))
+        return out
+
+
 def gen_df(gens: List[tuple], n: int = 1024, seed: int = 42) -> pa.Table:
     """[(name, DataGen), ...] → deterministic arrow table."""
     rng = np.random.default_rng(seed)

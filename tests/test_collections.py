@@ -1,0 +1,248 @@
+"""Collection (array/map) + higher-order function tests.
+
+Reference: integration_tests collection_ops_test.py, array_test.py, map_test.py,
+higher_order_functions_test.py — CPU-vs-TPU equality over generated data.
+"""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (ArrayGen, DoubleGen, IntegerGen, LongGen, MapGen,
+                      StringGen, gen_df)
+
+import spark_rapids_tpu.functions as F
+
+
+def _adf(s, child=None, n=100, seed=7, **kw):
+    child = child or IntegerGen()
+    return s.createDataFrame(gen_df(
+        [("a", ArrayGen(child, **kw)), ("x", IntegerGen())], n, seed))
+
+
+def test_size():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(F.size(F.col("a")).alias("n"),
+                                 F.col("x")))
+
+
+def test_get_array_item():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.get(F.col("a"), 0).alias("first"),
+            F.get(F.col("a"), 3).alias("oob"),
+            F.col("a").getItem(1).alias("second")))
+
+
+def test_element_at():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.element_at(F.col("a"), 1).alias("e1"),
+            F.element_at(F.col("a"), -1).alias("em1"),
+            F.element_at(F.col("a"), 9).alias("oob")))
+
+
+def test_array_contains():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.array_contains(F.col("a"), 3).alias("c3"),
+            F.array_contains(F.col("a"), -1).alias("cm1")))
+
+
+def test_array_contains_nan():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s, child=DoubleGen()).select(
+            F.array_contains(F.col("a"), float("nan")).alias("cnan")))
+
+
+def test_array_min_max_int():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.array_min(F.col("a")).alias("mn"),
+            F.array_max(F.col("a")).alias("mx")))
+
+
+def test_array_min_max_double_nan():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s, child=DoubleGen()).select(
+            F.array_min(F.col("a")).alias("mn"),
+            F.array_max(F.col("a")).alias("mx")))
+
+
+def test_array_position():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.array_position(F.col("a"), 2).alias("p")))
+
+
+def test_create_array():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.array(F.col("x"), F.col("x") + 1, F.lit(7)).alias("arr")))
+
+
+def test_sort_array():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.sort_array(F.col("a")).alias("asc"),
+            F.sort_array(F.col("a"), asc=False).alias("desc")))
+
+
+def test_set_ops():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("a", ArrayGen(IntegerGen(), max_len=5)),
+             ("b", ArrayGen(IntegerGen(), max_len=5))], 100, 11)).select(
+            F.array_distinct(F.col("a")).alias("d"),
+            F.array_union(F.col("a"), F.col("b")).alias("u"),
+            F.array_intersect(F.col("a"), F.col("b")).alias("i"),
+            F.array_except(F.col("a"), F.col("b")).alias("e"),
+            F.arrays_overlap(F.col("a"), F.col("b")).alias("o")))
+
+
+def test_shape_ops():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.slice(F.col("a"), 2, 2).alias("sl"),
+            F.slice(F.col("a"), -2, 2).alias("sln"),
+            F.array_repeat(F.col("x"), F.lit(3)).alias("rep"),
+            F.array_reverse(F.col("a")).alias("rev"),
+            F.concat_arrays(F.col("a"), F.col("a")).alias("cc")))
+
+
+def test_flatten_and_zip():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("aa", ArrayGen(ArrayGen(IntegerGen(), max_len=3), max_len=3))],
+            80, 13)).select(F.flatten(F.col("aa")).alias("f")))
+
+
+def test_array_join():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s, child=StringGen(alphabet="ab", max_len=3)).select(
+            F.array_join(F.col("a"), ",").alias("j"),
+            F.array_join(F.col("a"), "-", "NULL").alias("jr")))
+
+
+def test_sequence():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("x", IntegerGen(nullable=False))], 50, 17)).select(
+            F.sequence(F.lit(1), (F.col("x") % 5) + 2).alias("seq")))
+
+
+def test_transform():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.transform(F.col("a"), lambda x: x * 2 + 1).alias("t")))
+
+
+def test_transform_with_index():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.transform(F.col("a"), lambda x, i: x + i).alias("ti")))
+
+
+def test_transform_outer_ref():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.transform(F.col("a"), lambda x: x + F.col("x")).alias("to")))
+
+
+def test_exists_forall():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.exists(F.col("a"), lambda x: x > 0).alias("ex"),
+            F.forall(F.col("a"), lambda x: x > 0).alias("fa")))
+
+
+def test_filter_hof():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.filter(F.col("a"), lambda x: x % 2 == 0).alias("f")))
+
+
+def test_aggregate_hof():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.aggregate(F.col("a"), F.lit(0), lambda acc, x: acc + x).alias("agg")))
+
+
+def test_aggregate_hof_finish():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.aggregate(F.col("a"), F.lit(0), lambda acc, x: acc + x,
+                        lambda acc: acc * 10).alias("agg")))
+
+
+def test_zip_with():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("a", ArrayGen(IntegerGen(), max_len=4)),
+             ("b", ArrayGen(IntegerGen(), max_len=4))], 80, 23)).select(
+            F.zip_with(F.col("a"), F.col("b"),
+                       lambda x, y: x + y).alias("z")))
+
+
+def test_map_ops():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("m", MapGen(StringGen(alphabet="ab", max_len=2, nullable=False),
+                          IntegerGen()))], 80, 29)).select(
+            F.map_keys(F.col("m")).alias("ks"),
+            F.map_values(F.col("m")).alias("vs"),
+            F.element_at(F.col("m"), "a").alias("ea")))
+
+
+def test_create_map():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("x", IntegerGen(nullable=False))], 50, 31)).select(
+            F.create_map(F.lit("k1"), F.col("x"),
+                         F.lit("k2"), F.col("x") + 1).alias("m")))
+
+
+def test_arrays_zip():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("a", ArrayGen(IntegerGen(), max_len=3)),
+             ("b", ArrayGen(LongGen(), max_len=4))], 60, 37)).select(
+            F.arrays_zip(F.col("a"), F.col("b")).alias("z")))
+
+
+def test_filter_on_array_result():
+    """Filter a table by a collection predicate (exec-level integration)."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).filter(F.size(F.col("a")) > 2).select(
+            F.col("x"), F.size(F.col("a")).alias("n")))
+
+
+def test_aggregate_outer_ref():
+    """Regression: outer column refs in aggregate/zip_with lambdas must bind
+    to the row batch, not pseudo ordinals."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.aggregate(F.col("a"), F.lit(0),
+                        lambda acc, v: acc + v * F.col("x")).alias("s")))
+
+
+def test_zip_with_outer_ref():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.zip_with(F.col("a"), F.col("a"),
+                       lambda x, y: x + y + F.col("x")).alias("z")))
+
+
+def test_get_array_item_null_index():
+    """Regression: null index must yield null, incl. string elements."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(gen_df(
+            [("a", ArrayGen(StringGen(alphabet="pq", max_len=3), max_len=4)),
+             ("i", IntegerGen(null_prob=0.5))], 60, 41)).select(
+            F.get(F.col("a"), F.col("i") % 4).alias("g")))
+
+
+def test_create_array_mixed_types():
+    """Regression: array() coerces mixed numerics to the common type."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.array(F.col("x"), F.lit(2.5)).alias("a")))
