@@ -133,7 +133,7 @@ class CpuHashAggregateExec(CpuExec):
         out_cols = []
         ng = len(self.grouping)
         for ri, (expr, attr) in enumerate(zip(result_exprs, self._output[ng:])):
-            bound = _bind_agg_refs(expr, agg_table, ng)
+            bound = _bind_agg_refs(expr, agg_table, ng, self.grouping)
             r = bound.eval_cpu(agg_table, ctx.eval_ctx)
             if not isinstance(r, (pa.Array, pa.ChunkedArray)):
                 from ..types import to_arrow
@@ -256,14 +256,24 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
     return pa.table(dict(zip(names_out, arrays)))
 
 
-def _bind_agg_refs(expr: Expression, agg_table, num_keys: int) -> Expression:
-    """Rewrite __agg_i refs (expr_id=-(i+1)) to ordinals in the aggregated table."""
+def _bind_agg_refs(expr: Expression, agg_table, num_keys: int,
+                   grouping: Sequence[Expression] = ()) -> Expression:
+    """Rewrite __agg_i refs (expr_id=-(i+1)) to ordinals in the aggregated
+    table; references to grouping attributes rebind to their key slot (so
+    result projections over keys — e.g. grouping_id() — evaluate against the
+    aggregated layout, not the child's)."""
+    key_slot = {g.expr_id: j for j, g in enumerate(grouping)
+                if isinstance(g, AttributeReference)}
 
     def rule(e: Expression):
         if isinstance(e, AttributeReference) and e.expr_id < 0:
             i = -e.expr_id - 1
             return AttributeReference(e.name, e.dtype, e.nullable,
                                       ordinal=num_keys + i, expr_id=e.expr_id)
+        if isinstance(e, AttributeReference) and e.expr_id in key_slot:
+            return AttributeReference(e.name, e.dtype, e.nullable,
+                                      ordinal=key_slot[e.expr_id],
+                                      expr_id=e.expr_id)
         return None
 
     return expr.transform(rule)
@@ -596,7 +606,7 @@ class TpuHashAggregateExec(TpuExec):
         ng = len(self.grouping)
         final_cols = list(out_key_cols)
         for expr, attr in zip(result_exprs, self._output[ng:]):
-            bound = _bind_agg_refs(expr, None, ng)
+            bound = _bind_agg_refs(expr, None, ng, self.grouping)
             r = bound.eval_tpu(agg_batch, ctx.eval_ctx)
             final_cols.append(to_column(r, agg_batch, attr.dtype))
         return TpuColumnarBatch(final_cols, n_groups,

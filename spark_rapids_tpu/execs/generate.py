@@ -1,0 +1,397 @@
+"""Generate (explode/posexplode/stack) and Expand (grouping sets) operators.
+
+Reference: GpuGenerateExec.scala (GpuGenerateExec, GpuExplode, GpuPosExplode,
+GpuStack) and GpuExpandExec.scala. TPU re-design:
+
+* Explode runs entirely in XLA: the list column is already offsets+child on
+  device, so the parent-row gather map is `repeat(arange(n), counts)` and the
+  element column is an indexed gather of the flattened child — no per-row host
+  loop (the reference calls cudf `explode`/`explode_position` kernels).
+* Expand evaluates each grouping-set projection over the same device batch and
+  emits one output batch per projection — XLA fuses each projection into one
+  program; no row replication buffer is materialized (the reference builds each
+  projected table the same way, GpuExpandExec.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import (TpuColumnarBatch, _gather_column, gather)
+from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
+from ..expressions.base import (AttributeReference, Expression, to_column)
+from ..expressions.generators import Explode, Generator, ReplicateRows, Stack
+from ..types import ArrayType, IntegerT, MapType
+from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
+                   bind_references)
+
+
+class CpuGenerateExec(CpuExec):
+    """Host oracle for generators (Arrow compute)."""
+
+    def __init__(self, generator: Generator, gen_names: List[str],
+                 child: PhysicalPlan, output: List[AttributeReference]):
+        super().__init__([child])
+        self.generator = _bind_generator(generator, child.output)
+        self.gen_names = gen_names
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"CpuGenerate[{self.generator.pretty()}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        for t in self.children[0].execute_partition(idx, ctx):
+            yield _cpu_generate(self.generator, self.gen_names, t, ctx,
+                                [a.name for a in self._output])
+
+
+def _map_as_list(arr):
+    """Map arrays lack list kernels in Arrow; view as list<struct<key,value>>."""
+    import pyarrow as pa
+    if pa.types.is_map(arr.type):
+        t = arr.type
+        return arr.cast(pa.list_(pa.struct([("key", t.key_type),
+                                            ("value", t.item_type)])))
+    return arr
+
+
+def _bind_generator(gen: Generator, inputs) -> Generator:
+    bound = bind_all(list(gen.children), inputs)
+    return gen.with_children(bound)
+
+
+def _host_explode_parts(arr, n: int, outer: bool):
+    """Shared host explode math: (parents, pos, elem_valid, elems, total).
+    `elems` is an Arrow array of length `total` with NULLs on outer filler
+    rows (null/empty input lists)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    counts = pc.fill_null(pc.list_value_length(arr), 0) \
+        .to_numpy(zero_copy_only=False).astype(np.int64)
+    out_counts = np.maximum(counts, 1) if outer else counts
+    parents = np.repeat(np.arange(n, dtype=np.int64), out_counts)
+    total = int(out_counts.sum())
+    # element positions within each row (exclusive prefix sum of counts)
+    starts = np.concatenate([[0], np.cumsum(out_counts)[:-1]]).astype(np.int64)
+    pos = np.arange(total, dtype=np.int64) - starts[parents]
+    elem_valid = pos < counts[parents]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    flat = pc.list_flatten(arr)
+    elem_idx = offsets[parents] + np.minimum(pos, np.maximum(counts[parents] - 1, 0))
+    take_idx = pa.array(np.where(elem_valid, elem_idx, 0), mask=~elem_valid)
+    elems = pc.take(flat, take_idx) if len(flat) else pa.nulls(total, flat.type)
+    return parents, pos, elem_valid, elems, total
+
+
+def _host_stack_cells(gen: Stack, t, ctx, n: int) -> List:
+    """Shared host stack math: one Arrow array per generated column, rows
+    interleaved input-row-major (row i emits its gen.n rows consecutively)."""
+    import pyarrow as pa
+    from ..types import to_arrow as type_to_arrow
+    gen_cols = []
+    for c, (_, dt, _null) in enumerate(gen.element_schema()):
+        at = type_to_arrow(dt)
+        candidates = []
+        for r in range(gen.n):
+            i = r * gen.num_cols + c
+            if i < len(gen.children):
+                v = gen.children[i].eval_cpu(t, ctx.eval_ctx)
+                if not isinstance(v, (pa.Array, pa.ChunkedArray)):
+                    v = pa.array([v] * n, type=at)
+                elif isinstance(v, pa.ChunkedArray):
+                    v = v.combine_chunks()
+                v = v.cast(at) if v.type != at else v
+            else:
+                v = pa.nulls(n, type=at)
+            candidates.append(v.to_pylist())
+        out = [candidates[r][i] for i in range(n) for r in range(gen.n)]
+        gen_cols.append(pa.array(out, type=at))
+    return gen_cols
+
+
+def _cpu_generate(gen: Generator, gen_names: List[str], t, ctx, out_names):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    n = t.num_rows
+    if isinstance(gen, Explode):
+        arr = gen.child.eval_cpu(t, ctx.eval_ctx)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        arr = _map_as_list(arr)
+        parents, pos, elem_valid, elems, total = \
+            _host_explode_parts(arr, n, gen.outer)
+        cols = [pc.take(t.column(i), pa.array(parents))
+                for i in range(t.num_columns)]
+        gen_cols = []
+        if gen.with_position:
+            gen_cols.append(pa.array(pos.astype(np.int32), pa.int32(),
+                                     mask=~elem_valid))
+        if isinstance(gen.child.dtype, MapType):
+            gen_cols.append(pc.struct_field(elems, [0]))
+            gen_cols.append(pc.struct_field(elems, [1]))
+        else:
+            gen_cols.append(elems)
+        return pa.table(dict(zip(out_names, cols + gen_cols)))
+    if isinstance(gen, Stack):
+        parents = np.repeat(np.arange(n, dtype=np.int64), gen.n)
+        cols = [pc.take(t.column(i), pa.array(parents))
+                for i in range(t.num_columns)]
+        gen_cols = _host_stack_cells(gen, t, ctx, n)
+        return pa.table(dict(zip(out_names, cols + gen_cols)))
+    raise NotImplementedError(type(gen).__name__)
+
+
+class TpuGenerateExec(TpuExec):
+    """Device generator exec (reference GpuGenerateExec.scala)."""
+
+    def __init__(self, generator: Generator, gen_names: List[str],
+                 child: PhysicalPlan, output: List[AttributeReference]):
+        super().__init__([child])
+        self.generator = _bind_generator(generator, child.output)
+        self.gen_names = gen_names
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"TpuGenerate[{self.generator.pretty()}]"
+
+    def additional_metrics(self):
+        return {"numInputRows": "DEBUG"}
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..memory.retry import with_retry
+        from ..memory.spill import SpillableColumnarBatch
+        op_time = self.metrics["opTime"]
+        gen = self.generator
+
+        def do_generate(batch: TpuColumnarBatch) -> TpuColumnarBatch:
+            if isinstance(gen, Explode):
+                return _device_explode(gen, batch, ctx,
+                                       [a.name for a in self._output])
+            if isinstance(gen, Stack):
+                return _device_stack(gen, batch, ctx,
+                                     [a.name for a in self._output])
+            raise NotImplementedError(type(gen).__name__)
+
+        for batch in self.children[0].execute_partition(idx, ctx):
+            self.metrics["numInputRows"].add(batch.num_rows)
+            with op_time.timed():
+                # generators multiply rows; retry-with-split keeps halves valid
+                yield from with_retry(SpillableColumnarBatch(batch), do_generate)
+
+
+def _device_explode(gen: Explode, batch: TpuColumnarBatch, ctx,
+                    out_names: List[str]) -> TpuColumnarBatch:
+    col = to_column(gen.child.eval_tpu(batch, ctx.eval_ctx), batch)
+    if col.host_data is not None or isinstance(gen.child.dtype, MapType):
+        return _host_assisted_explode(gen, batch, col, ctx, out_names)
+    assert col.offsets is not None and col.child is not None, \
+        "explode expects a device list column"
+    cap = batch.capacity
+    n = batch.num_rows
+    offs = col.offsets.astype(jnp.int64)
+    counts = offs[1:] - offs[:-1]  # (cap,)
+    valid_row = row_mask(n, cap)
+    if col.validity is not None:
+        valid_list = col.validity & valid_row
+    else:
+        valid_list = valid_row
+    counts = jnp.where(valid_list, counts, 0)
+    if gen.outer:
+        out_counts = jnp.where(valid_row, jnp.maximum(counts, 1), 0)
+    else:
+        out_counts = counts
+    total = int(jnp.sum(out_counts))  # D→H sync: output row count
+    cap_out = bucket_capacity(max(total, 1))
+    parent = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), out_counts,
+                        total_repeat_length=cap_out)
+    starts = jnp.cumsum(out_counts) - out_counts  # exclusive prefix sum
+    pos = jnp.arange(cap_out, dtype=jnp.int64) - jnp.take(starts, parent)
+    out_mask = row_mask(total, cap_out)
+    elem_valid = (pos < jnp.take(counts, parent)) & out_mask
+    elem_idx = (jnp.take(offs[:-1], parent) + pos).astype(jnp.int32)
+    safe_elem = jnp.where(elem_valid, elem_idx, 0)
+    # required child columns: gather by parent
+    gathered = gather(batch, parent, total, out_capacity=cap_out)
+    gen_cols: List[TpuColumnVector] = []
+    if gen.with_position:
+        # outer filler rows (null/empty list) have pos NULL, like every other
+        # generator output (Spark GenerateExec outer semantics)
+        pdata = jnp.where(elem_valid, pos, 0).astype(jnp.int32)
+        gen_cols.append(TpuColumnVector(IntegerT, pdata, elem_valid, total))
+    gen_cols.append(_gather_column(col.child, safe_elem, elem_valid, total,
+                                   cap_out))
+    return TpuColumnarBatch(gathered.columns + gen_cols, total, out_names)
+
+
+def _host_assisted_explode(gen: Explode, batch: TpuColumnarBatch,
+                           col: TpuColumnVector, ctx,
+                           out_names: List[str]) -> TpuColumnarBatch:
+    """Map columns have no device layout yet: route the generator columns
+    through Arrow, keep the parent gather on device."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    arr = _map_as_list(col.to_arrow())
+    n = batch.num_rows
+    parents, pos, elem_valid, elems, total = \
+        _host_explode_parts(arr, n, gen.outer)
+    cap_out = bucket_capacity(max(total, 1))
+    parent_idx = np.full(cap_out, n, dtype=np.int32)
+    parent_idx[:total] = parents
+    gathered = gather(batch, jnp.asarray(parent_idx), total, out_capacity=cap_out)
+    gen_cols = []
+    if gen.with_position:
+        pdata = np.zeros(cap_out, dtype=np.int32)
+        pdata[:total] = np.where(elem_valid, pos, 0)
+        pvalid = np.zeros(cap_out, dtype=bool)
+        pvalid[:total] = elem_valid
+        gen_cols.append(TpuColumnVector(IntegerT, jnp.asarray(pdata),
+                                        jnp.asarray(pvalid), total))
+    if isinstance(gen.child.dtype, MapType):
+        gen_cols.append(TpuColumnVector.from_arrow(pc.struct_field(elems, [0])))
+        gen_cols.append(TpuColumnVector.from_arrow(pc.struct_field(elems, [1])))
+    else:
+        gen_cols.append(TpuColumnVector.from_arrow(elems))
+    return TpuColumnarBatch(gathered.columns + gen_cols, total, out_names)
+
+
+def _device_stack(gen: Stack, batch: TpuColumnarBatch, ctx,
+                  out_names: List[str]) -> TpuColumnarBatch:
+    k = gen.num_cols
+    rows_per = gen.n
+    n = batch.num_rows
+    cap = batch.capacity
+    total = n * rows_per
+    cap_out = bucket_capacity(max(total, 1))
+    out_i = jnp.arange(cap_out, dtype=jnp.int32)
+    parent = out_i // rows_per
+    pos = out_i % rows_per
+    out_mask = row_mask(total, cap_out)
+    gathered = gather(batch, jnp.where(out_mask, parent, n), total,
+                      out_capacity=cap_out)
+    schema = gen.element_schema()
+    gen_cols: List[TpuColumnVector] = []
+    for c, (_, dt, _null) in enumerate(schema):
+        if dt.np_dtype is None:
+            return _host_stack_fallback(gen, batch, gathered, ctx, out_names,
+                                        total, cap_out)
+        datas, valids = [], []
+        for r in range(rows_per):
+            i = r * k + c
+            if i < len(gen.children):
+                v = to_column(gen.children[i].eval_tpu(batch, ctx.eval_ctx),
+                              batch, dt)
+                datas.append(v.data.astype(dt.np_dtype))
+                valids.append(v.validity_or_true())
+            else:
+                datas.append(jnp.zeros((cap,), dt.np_dtype))
+                valids.append(jnp.zeros((cap,), jnp.bool_))
+        stacked = jnp.stack(datas)          # (rows_per, cap)
+        vstacked = jnp.stack(valids)
+        safe_parent = jnp.where(out_mask, parent, 0)
+        data = stacked[pos, safe_parent]
+        valid = vstacked[pos, safe_parent] & out_mask
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        gen_cols.append(TpuColumnVector(dt, data, valid, total))
+    return TpuColumnarBatch(gathered.columns + gen_cols, total, out_names)
+
+
+def _host_stack_fallback(gen: Stack, batch, gathered, ctx, out_names,
+                         total, cap_out):
+    """String/nested stack cells: route generator columns through Arrow."""
+    gen_cols = [TpuColumnVector.from_arrow(a)
+                for a in _host_stack_cells(gen, batch.to_arrow(), ctx,
+                                           batch.num_rows)]
+    return TpuColumnarBatch(gathered.columns + gen_cols, total, out_names)
+
+
+# ---------------------------------------------------------------------------
+# Expand (grouping sets)
+# ---------------------------------------------------------------------------
+
+class CpuExpandExec(CpuExec):
+    """Host oracle for Expand (reference GpuExpandExec.scala)."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 child: PhysicalPlan, output: List[AttributeReference]):
+        super().__init__([child])
+        self.projections = [bind_all(p, child.output) for p in projections]
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"CpuExpand[{len(self.projections)} projections]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        from ..types import to_arrow as type_to_arrow
+        names = [a.name for a in self._output]
+        for t in self.children[0].execute_partition(idx, ctx):
+            for proj in self.projections:
+                cols = []
+                for e, attr in zip(proj, self._output):
+                    at = type_to_arrow(attr.dtype)
+                    v = e.eval_cpu(t, ctx.eval_ctx)
+                    if not isinstance(v, (pa.Array, pa.ChunkedArray)):
+                        v = pa.array([v] * t.num_rows, type=at)
+                    elif isinstance(v, pa.ChunkedArray):
+                        v = v.combine_chunks()
+                    if v.type != at:
+                        v = v.cast(at)
+                    cols.append(v)
+                yield pa.table(dict(zip(names, cols)))
+
+
+class TpuExpandExec(TpuExec):
+    """Device Expand: one output batch per projection per input batch — each
+    projection is a fused XLA program over the shared input batch; no row
+    replication buffer (reference GpuExpandExec.scala builds each projection
+    as its own cudf table the same way)."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 child: PhysicalPlan, output: List[AttributeReference]):
+        super().__init__([child])
+        self.projections = [bind_all(p, child.output) for p in projections]
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"TpuExpand[{len(self.projections)} projections]"
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..memory.retry import with_retry
+        from ..memory.spill import SpillableColumnarBatch
+        op_time = self.metrics["opTime"]
+        names = [a.name for a in self._output]
+
+        for batch in self.children[0].execute_partition(idx, ctx):
+            with SpillableColumnarBatch(batch) as spill:
+                for proj in self.projections:
+                    def project(b: TpuColumnarBatch, _proj=proj) -> TpuColumnarBatch:
+                        cols = [to_column(e.eval_tpu(b, ctx.eval_ctx), b, a.dtype)
+                                for e, a in zip(_proj, self._output)]
+                        return TpuColumnarBatch(cols, b.num_rows, names)
+
+                    with op_time.timed():
+                        # each projection gets its own retryable handle over the
+                        # shared device arrays (outer handle keeps them spillable)
+                        yield from with_retry(
+                            SpillableColumnarBatch(spill.get_batch()), project)

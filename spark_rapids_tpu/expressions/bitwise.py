@@ -1,0 +1,217 @@
+"""Bitwise and shift expressions.
+
+Reference: /root/reference/sql-plugin/src/main/scala/org/apache/spark/sql/rapids/
+bitwise.scala (GpuBitwiseAnd/Or/Xor/Not, GpuShiftLeft/Right/RightUnsigned).
+Device path is a single XLA elementwise op; Spark semantics notes:
+  * shift distance is taken modulo the bit width (Java <</>>/>>> behavior);
+  * >>> (unsigned shift) reinterprets the value as unsigned for the shift.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..types import DataType, IntegralType
+from .base import (BinaryExpression, EvalContext, UnaryExpression, _DEFAULT_CTX,
+                   combine_validity, device_parts, make_column)
+
+
+class _BitwiseBinary(BinaryExpression):
+    symbol = "?"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.left.dtype
+
+    def pretty(self) -> str:
+        return f"({self.left.pretty()} {self.symbol} {self.right.pretty()})"
+
+    def _compute(self, ld, rd, ctx, valid):
+        raise NotImplementedError
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        l = self.left.eval_cpu(table, ctx)
+        r = self.right.eval_cpu(table, ctx)
+        return self._cpu_compute(l, r, ctx)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    symbol = "&"
+
+    def _compute(self, ld, rd, ctx, valid):
+        return ld & rd
+
+    def _cpu_compute(self, l, r, ctx):
+        import pyarrow.compute as pc
+        return pc.bit_wise_and(l, r)
+
+
+class BitwiseOr(_BitwiseBinary):
+    symbol = "|"
+
+    def _compute(self, ld, rd, ctx, valid):
+        return ld | rd
+
+    def _cpu_compute(self, l, r, ctx):
+        import pyarrow.compute as pc
+        return pc.bit_wise_or(l, r)
+
+
+class BitwiseXor(_BitwiseBinary):
+    symbol = "^"
+
+    def _compute(self, ld, rd, ctx, valid):
+        return ld ^ rd
+
+    def _cpu_compute(self, l, r, ctx):
+        import pyarrow.compute as pc
+        return pc.bit_wise_xor(l, r)
+
+
+class BitwiseNot(UnaryExpression):
+    def _compute(self, data, ctx, valid):
+        return ~data
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.bit_wise_not(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"~{self.child.pretty()}"
+
+
+class BitwiseCount(UnaryExpression):
+    """bit_count: number of set bits (negative inputs counted in
+    two's-complement, per Spark)."""
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import IntegerT
+        return IntegerT
+
+    def _compute(self, data, ctx, valid):
+        w = data.dtype.itemsize * 8
+        u = data.astype({8: jnp.uint8, 16: jnp.uint16,
+                         32: jnp.uint32, 64: jnp.uint64}[w])
+        return jax.lax.population_count(u).astype(jnp.int32)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import numpy as np
+        v = self.child.eval_cpu(table, ctx)
+        if isinstance(v, pa.ChunkedArray):
+            v = v.combine_chunks()
+        if not isinstance(v, pa.Array):
+            if v is None:
+                return None
+            return int(bin(v & (2 ** 64 - 1) if v < 0 else v).count("1"))
+        npv = v.to_numpy(zero_copy_only=False)
+        width = v.type.bit_width
+        u = np.asarray(npv, dtype=f"int{width}").astype(f"uint{width}")
+        counts = np.array([bin(int(x)).count("1") for x in u], dtype=np.int32)
+        mask = np.asarray(v.is_null())
+        return pa.array(counts, mask=mask)
+
+
+class _ShiftBase(BinaryExpression):
+    symbol = "?"
+
+    @property
+    def dtype(self) -> DataType:
+        return self.left.dtype
+
+    def pretty(self) -> str:
+        return f"({self.left.pretty()} {self.symbol} {self.right.pretty()})"
+
+    def _shift(self, ld, dist):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.vector import row_mask
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        width = jnp.asarray(ld).dtype.itemsize * 8
+        dist = (rd.astype(jnp.int32) & (width - 1))  # Java shift-mod semantics
+        data = self._shift(ld, dist)
+        return make_column(self.dtype, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import numpy as np
+        import pyarrow as pa
+        l = self.left.eval_cpu(table, ctx)
+        r = self.right.eval_cpu(table, ctx)
+        l_arr = isinstance(l, (pa.Array, pa.ChunkedArray))
+        r_arr = isinstance(r, (pa.Array, pa.ChunkedArray))
+        if not l_arr and not r_arr:
+            if l is None or r is None:
+                return None
+            ln = np.array([l])
+            rn = np.array([r])
+            out = self._np_shift(ln, rn)
+            return out[0].item()
+        if isinstance(l, pa.ChunkedArray):
+            l = l.combine_chunks()
+        if isinstance(r, pa.ChunkedArray):
+            r = r.combine_chunks()
+        n = len(l) if l_arr else len(r)
+        lm = np.asarray(l.is_null()) if l_arr else np.zeros(n, bool)
+        rm = np.asarray(r.is_null()) if r_arr else np.zeros(n, bool)
+        ln = l.to_numpy(zero_copy_only=False) if l_arr else np.full(n, l)
+        rn = r.to_numpy(zero_copy_only=False) if r_arr else np.full(n, r)
+        mask = lm | rm
+        ln = np.where(mask, 0, ln)
+        rn = np.where(mask, 0, rn)
+        out = self._np_shift(np.asarray(ln), np.asarray(rn))
+        return pa.array(out, mask=mask)
+
+    def _np_shift(self, ln, rn):
+        raise NotImplementedError
+
+
+class ShiftLeft(_ShiftBase):
+    symbol = "<<"
+
+    def _shift(self, ld, dist):
+        return ld << dist.astype(ld.dtype)
+
+    def _np_shift(self, ln, rn):
+        import numpy as np
+        width = ln.dtype.itemsize * 8
+        return ln << (rn.astype(np.int64) & (width - 1)).astype(ln.dtype)
+
+
+class ShiftRight(_ShiftBase):
+    """Arithmetic (sign-extending) right shift."""
+    symbol = ">>"
+
+    def _shift(self, ld, dist):
+        return ld >> dist.astype(ld.dtype)
+
+    def _np_shift(self, ln, rn):
+        import numpy as np
+        width = ln.dtype.itemsize * 8
+        return ln >> (rn.astype(np.int64) & (width - 1)).astype(ln.dtype)
+
+
+class ShiftRightUnsigned(_ShiftBase):
+    """Logical (zero-filling) right shift (Java >>>)."""
+    symbol = ">>>"
+
+    def _shift(self, ld, dist):
+        width = ld.dtype.itemsize * 8
+        u = ld.astype({8: jnp.uint8, 16: jnp.uint16,
+                       32: jnp.uint32, 64: jnp.uint64}[width])
+        return (u >> dist.astype(u.dtype)).astype(ld.dtype)
+
+    def _np_shift(self, ln, rn):
+        import numpy as np
+        width = ln.dtype.itemsize * 8
+        u = ln.astype(f"uint{width}")
+        d = (rn.astype(np.int64) & (width - 1)).astype(u.dtype)
+        return (u >> d).astype(ln.dtype)

@@ -1,0 +1,188 @@
+"""Generator expressions: explode / posexplode / stack (+ _outer variants).
+
+TPU re-design of the reference's generator support
+(/root/reference/sql-plugin/src/main/scala/com/nvidia/spark/rapids/GpuGenerateExec.scala:
+GpuExplode, GpuPosExplode, GpuStack and the GpuGenerator trait). A generator maps
+one input row to zero or more output rows; the exec layer (execs/generate.py)
+gathers the required child columns by a parent-row index map produced here.
+
+Device strategy (vs the reference's cudf `explode`/`explode_position` kernels):
+the list column already holds offsets + flattened child on device, so explode is
+  counts  = offsets[1:] - offsets[:-1]
+  parent  = repeat(arange(n), counts)          # gather map for child columns
+  element = child[offsets[parent] + pos]       # contiguous, so a slice when !outer
+computed entirely in XLA ops; the only host sync is the output row count (the
+same data-dependent-size sync a filter pays).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ..types import ArrayType, DataType, IntegerT, MapType
+from .base import Expression, UnaryExpression
+
+
+class Generator(Expression):
+    """Base generator: produces `element_schema()` columns and a variable number
+    of rows per input row. Not evaluable via columnar_eval — the Generate exec
+    drives it (reference GpuGenerator, GpuGenerateExec.scala)."""
+
+    outer: bool = False
+
+    def element_schema(self) -> List[Tuple[str, DataType, bool]]:
+        """(name, dtype, nullable) for each generated column."""
+        raise NotImplementedError
+
+    @property
+    def dtype(self) -> DataType:
+        # a generator has no single result type; exposed for error messages only
+        raise TypeError(f"{type(self).__name__} is a generator, not a value expression")
+
+
+class MultiAlias(Expression):
+    """Names for a multi-column generator, e.g.
+    posexplode(m).alias("p", "k", "v") (Spark MultiAlias)."""
+
+    def __init__(self, child: Generator, names: Sequence[str]):
+        self.children = (child,)
+        self.names = list(names)
+
+    @property
+    def child(self) -> Generator:
+        return self.children[0]
+
+    def pretty(self) -> str:
+        return f"{self.child.pretty()} AS ({', '.join(self.names)})"
+
+
+class Explode(Generator):
+    """explode(array) / explode(map) → one row per element (per entry).
+    Reference: GpuExplode (GpuGenerateExec.scala)."""
+
+    def __init__(self, child: Expression, outer: bool = False,
+                 with_position: bool = False):
+        self.children = (child,)
+        self.outer = outer
+        self.with_position = with_position
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def element_schema(self):
+        ct = self.child.dtype
+        cols: List[Tuple[str, DataType, bool]] = []
+        if self.with_position:
+            # outer: the filler row for a null/empty input has pos NULL (Spark
+            # GenerateExec nulls ALL generator outputs on outer filler rows)
+            cols.append(("pos", IntegerT, self.outer))
+        if isinstance(ct, ArrayType):
+            cols.append(("col", ct.element_type,
+                         ct.contains_null or self.outer))
+        elif isinstance(ct, MapType):
+            cols.append(("key", ct.key_type, self.outer))
+            cols.append(("value", ct.value_type,
+                         ct.value_contains_null or self.outer))
+        else:
+            raise TypeError(f"explode expects array or map, got {ct}")
+        return cols
+
+    def pretty(self) -> str:
+        name = "posexplode" if self.with_position else "explode"
+        return f"{name}{'_outer' if self.outer else ''}({self.child.pretty()})"
+
+
+class Stack(Generator):
+    """stack(n, e1, ..., ek): n rows of k/n columns per input row.
+    Reference: GpuStack (GpuGenerateExec.scala)."""
+
+    def __init__(self, n: int, exprs: Sequence[Expression]):
+        if n <= 0:
+            raise ValueError("stack row count must be positive")
+        if not exprs:
+            raise ValueError("stack requires at least one value expression")
+        self.children = tuple(exprs)
+        self.n = n
+        self.num_cols = -(-len(exprs) // n)  # ceil
+
+    def element_schema(self):
+        from ..types import NullT
+        cols = []
+        for c in range(self.num_cols):
+            # column type = common type of exprs at positions r*num_cols + c
+            dts = []
+            nullable = False
+            for r in range(self.n):
+                i = r * self.num_cols + c
+                if i < len(self.children):
+                    dts.append(self.children[i].dtype)
+                    nullable = nullable or self.children[i].nullable
+                else:
+                    nullable = True
+            first = next((d for d in dts if d != NullT), dts[0] if dts else NullT)
+            for d in dts:
+                if d != first and d != NullT:
+                    raise TypeError(
+                        f"stack column {c}: incompatible types {first} vs {d}")
+            cols.append((f"col{c}", first, nullable))
+        return cols
+
+    def pretty(self) -> str:
+        return f"stack({self.n}, {', '.join(c.pretty() for c in self.children)})"
+
+
+class ReplicateRows(Generator):
+    """replicate_rows(n, cols...): repeats the row n times (reference
+    GpuReplicateRows, GpuGenerateExec.scala — used by some Delta paths)."""
+
+    def __init__(self, exprs: Sequence[Expression]):
+        self.children = tuple(exprs)
+
+    def element_schema(self):
+        return [(f"col{i}", e.dtype, e.nullable)
+                for i, e in enumerate(self.children[1:])]
+
+    def pretty(self) -> str:
+        return f"replicate_rows({', '.join(c.pretty() for c in self.children)})"
+
+
+# ---------------------------------------------------------------------------
+# Grouping-set markers (Spark grouping.scala: Grouping / GroupingID / Cube /
+# Rollup; resolved away by the grouping-analytics rewrite in session.py)
+# ---------------------------------------------------------------------------
+
+class GroupingID(Expression):
+    """grouping_id(): bitmask of nulled-out grouping columns; replaced by a
+    reference to the Expand gid column during grouping-sets lowering."""
+
+    children = ()
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import LongT
+        return LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def pretty(self) -> str:
+        return "grouping_id()"
+
+
+class GroupingExpr(UnaryExpression):
+    """grouping(col): 1 if col is nulled-out in this grouping set else 0;
+    lowered to (gid >> bit) & 1 during grouping-sets rewrite."""
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ByteT
+        return ByteT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def pretty(self) -> str:
+        return f"grouping({self.child.pretty()})"

@@ -506,3 +506,44 @@ def aggregate(c, zero, merge, finish=None) -> Column:
 
 def zip_with(a, b, f) -> Column:
     return Column(_CL.ZipWith(_expr_or_col(a), _expr_or_col(b), _make_lambda(f, 2)))
+
+
+# --- generators (reference GpuExplode/GpuPosExplode/GpuStack, GpuGenerateExec.scala)
+
+def explode(c) -> Column:
+    from .expressions.generators import Explode
+    return Column(Explode(_expr_or_col(c)))
+
+
+def explode_outer(c) -> Column:
+    from .expressions.generators import Explode
+    return Column(Explode(_expr_or_col(c), outer=True))
+
+
+def posexplode(c) -> Column:
+    from .expressions.generators import Explode
+    return Column(Explode(_expr_or_col(c), with_position=True))
+
+
+def posexplode_outer(c) -> Column:
+    from .expressions.generators import Explode
+    return Column(Explode(_expr_or_col(c), outer=True, with_position=True))
+
+
+def stack(n, *cols) -> Column:
+    from .expressions.generators import Stack
+    if isinstance(n, Column):
+        from .expressions.base import Literal as _Lit
+        assert isinstance(n._expr, _Lit), "stack row count must be a literal"
+        n = n._expr.value
+    return Column(Stack(int(n), [_expr_or_col(c) for c in cols]))
+
+
+def grouping_id() -> Column:
+    from .expressions.generators import GroupingID
+    return Column(GroupingID())
+
+
+def grouping(c) -> Column:
+    from .expressions.generators import GroupingExpr
+    return Column(GroupingExpr(_expr_or_col(c)))

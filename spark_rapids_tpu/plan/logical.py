@@ -353,6 +353,63 @@ class _JoinScope(LogicalPlan):
         return self.children[0].output + self.children[1].output
 
 
+class Generate(LogicalPlan):
+    """Generator node: output = child.output ++ generator columns
+    (Spark GenerateExec; reference GpuGenerateExec.scala)."""
+
+    def __init__(self, generator, child: LogicalPlan,
+                 gen_names: Optional[Sequence[str]] = None):
+        from ..expressions.generators import Generator
+        self.children = (child,)
+        gen = generator.with_children(
+            [resolve_expression(c, child) for c in generator.children])
+        assert isinstance(gen, Generator)
+        self.generator = gen
+        schema = gen.element_schema()
+        if gen_names is None:
+            gen_names = [n for n, _, _ in schema]
+        if len(gen_names) != len(schema):
+            raise ValueError(
+                f"generator produces {len(schema)} columns, got names {gen_names}")
+        self.gen_names = list(gen_names)
+        self._gen_attrs = [AttributeReference(nm, dt, nl)
+                           for nm, (_, dt, nl) in zip(self.gen_names, schema)]
+
+    @property
+    def generator_output(self) -> List[AttributeReference]:
+        return self._gen_attrs
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output + self._gen_attrs
+
+    def node_desc(self) -> str:
+        return f"Generate[{self.generator.pretty()}]"
+
+
+class Expand(LogicalPlan):
+    """Row multiplexer for grouping sets (Spark ExpandExec; reference
+    GpuExpandExec.scala): each projection emits one output row per input row."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 output_attrs: Sequence[AttributeReference],
+                 child: LogicalPlan, resolve: bool = True):
+        self.children = (child,)
+        if resolve:
+            self.projections = [[resolve_expression(e, child) for e in p]
+                                for p in projections]
+        else:
+            self.projections = [list(p) for p in projections]
+        self._output = list(output_attrs)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def node_desc(self) -> str:
+        return f"Expand[{len(self.projections)} projections]"
+
+
 class Repartition(LogicalPlan):
     """Exchange request: hash/range/round-robin/single
     (reference GpuOverrides `parts` registry, GpuOverrides.scala:3876)."""

@@ -293,6 +293,40 @@ register_exec(_CpuWin, "window", "spark.rapids.sql.exec.WindowExec",
               _tag_window, _convert_window)
 
 
+def _tag_generate(meta: PlanMeta) -> None:
+    from ..expressions.generators import Explode, Stack
+    gen = meta.plan.generator
+    if not isinstance(gen, (Explode, Stack)):
+        meta.will_not_work_on_tpu(
+            f"generator {type(gen).__name__} is not supported on TPU")
+    meta.add_exprs(list(gen.children))
+
+
+def _convert_generate(meta: PlanMeta, ch):
+    from ..execs.generate import TpuGenerateExec
+    p = meta.plan
+    return TpuGenerateExec(p.generator, p.gen_names, ch[0], p.output)
+
+
+def _tag_expand(meta: PlanMeta) -> None:
+    for proj in meta.plan.projections:
+        meta.add_exprs(proj)
+
+
+def _convert_expand(meta: PlanMeta, ch):
+    from ..execs.generate import TpuExpandExec
+    return TpuExpandExec(meta.plan.projections, ch[0], meta.plan.output)
+
+
+from ..execs.generate import (CpuExpandExec as _CpuExpand,  # noqa: E402
+                              CpuGenerateExec as _CpuGen)
+
+register_exec(_CpuGen, "generate", "spark.rapids.sql.exec.GenerateExec",
+              _tag_generate, _convert_generate)
+register_exec(_CpuExpand, "expand", "spark.rapids.sql.exec.ExpandExec",
+              _tag_expand, _convert_expand)
+
+
 def wrap_and_tag_plan(plan: PhysicalPlan, conf: RapidsConf) -> PlanMeta:
     """reference wrapAndTagPlan (GpuOverrides.scala:4358)."""
     rule = _EXEC_RULES.get(type(plan))

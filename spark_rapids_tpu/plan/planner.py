@@ -107,6 +107,14 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
                                            plan.condition, plan.output)
         return CpuBroadcastNestedLoopJoinExec(left, right, plan.join_type,
                                               plan.condition, plan.output)
+    if isinstance(plan, L.Generate):
+        from ..execs.generate import CpuGenerateExec
+        child = plan_physical(plan.children[0], conf)
+        return CpuGenerateExec(plan.generator, plan.gen_names, child, plan.output)
+    if isinstance(plan, L.Expand):
+        from ..execs.generate import CpuExpandExec
+        child = plan_physical(plan.children[0], conf)
+        return CpuExpandExec(plan.projections, child, plan.output)
     if isinstance(plan, L.WindowOp):
         from ..execs.window import CpuWindowExec
         child = plan_physical(plan.children[0], conf)

@@ -173,6 +173,22 @@ def _register_builtin_exprs() -> None:
                   host_assisted=True)
     register_expr(CL.ZipWith, sig_nested, "zip_with", host_assisted=True)
 
+    from ..expressions import bitwise as BW
+    for cls in (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor):
+        register_expr(cls, TypeSigs.integral, f"bitwise {cls.symbol}")
+    register_expr(BW.BitwiseNot, TypeSigs.integral, "bitwise NOT")
+    register_expr(BW.BitwiseCount, TypeSigs.integral, "bit_count")
+    for cls in (BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned):
+        register_expr(cls, TypeSigs.integral, f"shift {cls.symbol}")
+
+    from ..expressions import generators as G
+    register_expr(G.Explode, TypeSigs.nested_common + TypeSigs.NULL,
+                  "explode/posexplode generator")
+    register_expr(G.Stack, TypeSigs.all_basic + TypeSigs.NULL,
+                  "stack generator")
+    register_expr(G.GroupingID, TypeSigs.integral,
+                  "grouping_id (lowered to the Expand gid column)")
+
     from .. import udf as U
     register_expr(U.TpuColumnarUDF, TypeSigs.all, "columnar device UDF (RapidsUDF)")
     register_expr(U.ArrowPandasUDF, TypeSigs.all, "arrow/pandas UDF",

@@ -15,7 +15,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 from .config import RapidsConf
 from .expressions.base import (Alias, AttributeReference, Expression, Literal,
-                               UnresolvedAttribute)
+                               UnresolvedAttribute, output_name)
 from .plan import logical as L
 from .plan.overrides import TpuOverrides
 from .plan.planner import plan_physical
@@ -108,8 +108,13 @@ class Column:
         return Column(Not(self._expr))
 
     # methods
-    def alias(self, name: str) -> "Column":
-        return Column(Alias(self._expr, name))
+    def alias(self, *names: str) -> "Column":
+        from .expressions.generators import Generator, MultiAlias
+        if len(names) > 1:
+            if not isinstance(self._expr, Generator):
+                raise ValueError("multi-name alias requires a generator column")
+            return Column(MultiAlias(self._expr, list(names)))
+        return Column(Alias(self._expr, names[0]))
 
     name = alias
 
@@ -234,6 +239,8 @@ class DataFrame:
     # --- transformations --------------------------------------------------
     def select(self, *cols) -> "DataFrame":
         exprs = [self._to_named(c) for c in cols]
+        if _has_generator(exprs):
+            return _project_with_generator(exprs, self)
         if _has_window(exprs):
             return _project_with_windows(exprs, self)
         return DataFrame(L.Project(exprs, self._plan), self.session)
@@ -264,6 +271,8 @@ class DataFrame:
                 exprs.append(a)
         if not replaced:
             exprs.append(Alias(_expr(col), name))
+        if _has_generator(exprs):
+            return _project_with_generator(exprs, self)
         if _has_window(exprs):
             return _project_with_windows(exprs, self)
         return DataFrame(L.Project(exprs, self._plan), self.session)
@@ -322,6 +331,41 @@ class DataFrame:
         return GroupedData(self, keys)
 
     groupby = groupBy
+
+    def rollup(self, *cols) -> "GroupedData":
+        """GROUP BY ROLLUP: grouping sets (all), (all-1), ..., () (Spark
+        Dataset.rollup; lowered via Expand — reference GpuExpandExec)."""
+        keys = [UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                for c in cols]
+        sets = [list(range(i)) for i in range(len(keys), -1, -1)]
+        return GroupedData(self, keys, grouping_sets=sets)
+
+    def cube(self, *cols) -> "GroupedData":
+        """GROUP BY CUBE: all 2^n grouping sets."""
+        keys = [UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                for c in cols]
+        n = len(keys)
+        sets = [[i for i in range(n) if (mask >> i) & 1 == 0]
+                for mask in range(1 << n)]
+        sets.sort(key=lambda s: (len(s) * -1, s))
+        return GroupedData(self, keys, grouping_sets=sets)
+
+    def groupingSets(self, sets, *cols) -> "GroupedData":
+        """Explicit GROUPING SETS: `sets` is a list of lists of column names
+        (each a subset of `cols`)."""
+        keys = [UnresolvedAttribute(c) if isinstance(c, str) else _expr(c)
+                for c in cols]
+        names = [c if isinstance(c, str) else None for c in cols]
+        idx_sets = []
+        for s in sets:
+            idxs = []
+            for item in s:
+                if isinstance(item, int):
+                    idxs.append(item)
+                else:
+                    idxs.append(names.index(item))
+            idx_sets.append(idxs)
+        return GroupedData(self, keys, grouping_sets=idx_sets)
 
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
@@ -402,6 +446,54 @@ class DataFrame:
         return TpuOverrides.explain_plan(cpu_plan, conf)
 
 
+def _has_generator(exprs) -> bool:
+    from .expressions.generators import Generator
+    return any(e.collect(lambda x: isinstance(x, Generator)) for e in exprs)
+
+
+def _project_with_generator(exprs, df: "DataFrame") -> "DataFrame":
+    """Extract the (single) generator into a Generate node, then project the
+    selected columns with the generator replaced by its output attributes
+    (Spark's ExtractGenerator rule; reference GpuGenerateExec)."""
+    from .expressions.generators import Generator, MultiAlias
+    gens = []
+    for e in exprs:
+        for g in e.collect(lambda x: isinstance(x, Generator)):
+            if not any(g is x for x in gens):
+                gens.append(g)
+    if len(gens) != 1:
+        raise ValueError("only one generator allowed per select clause")
+    gen = gens[0]
+    # names: from Alias / MultiAlias wrapper if present
+    gen_names = None
+    for e in exprs:
+        if isinstance(e, MultiAlias) and e.child is gen:
+            gen_names = e.names
+        elif isinstance(e, Alias) and e.child is gen:
+            n_out = len(gen.element_schema()) if all(
+                c.resolved for c in gen.children) else 1
+            if n_out != 1:
+                raise ValueError(
+                    f"generator produces {n_out} columns; use "
+                    f".alias({', '.join(repr(f'n{i}') for i in range(n_out))})")
+            gen_names = [e.name]
+    # resolve generator children against the child plan first so names work
+    node = L.Generate(gen, df._plan, gen_names)
+    attrs = node.generator_output
+
+    new_exprs: List[Expression] = []
+    for e in exprs:
+        if (isinstance(e, (Alias, MultiAlias)) and e.child is gen) or e is gen:
+            new_exprs.extend(attrs)
+        elif e.collect(lambda x: isinstance(x, Generator)):
+            raise ValueError(
+                f"generators are not supported when nested in expressions: "
+                f"{e.pretty()}")
+        else:
+            new_exprs.append(e)
+    return DataFrame(L.Project(new_exprs, node), df.session)
+
+
 def _has_window(exprs) -> bool:
     from .window import WindowExpression
     return any(e.collect(lambda x: isinstance(x, WindowExpression))
@@ -474,14 +566,93 @@ def _extract_equi_keys(cond: Expression, left, right):
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, keys: List[Expression]):
+    def __init__(self, df: DataFrame, keys: List[Expression],
+                 grouping_sets: Optional[List[List[int]]] = None):
         self._df = df
         self._keys = keys
+        self._grouping_sets = grouping_sets
 
     def agg(self, *aggs) -> DataFrame:
         exprs = [_expr(a) for a in aggs]
+        if self._grouping_sets is not None:
+            return self._agg_grouping_sets(exprs)
         node = L.Aggregate(self._keys, exprs, self._df._plan)
         return DataFrame(node, self._df.session)
+
+    def _agg_grouping_sets(self, agg_exprs: List[Expression]) -> DataFrame:
+        """Lower grouping sets to Expand + Aggregate + Project (Spark's
+        ResolveGroupingAnalytics; reference GpuExpandExec.scala). The Expand
+        output keeps all child columns (aggregates see real values — Spark
+        semantics), adds one nulled-or-real column per grouping expr (renamed
+        _gset_i to avoid ambiguity) plus the _gid bitmask, all of which become
+        the hash-agg keys."""
+        from .expressions.base import Literal
+        from .expressions.generators import GroupingExpr, GroupingID
+        from .types import LongT
+        child = self._df._plan
+        keys = [L.resolve_expression(k, child) for k in self._keys]
+        n = len(keys)
+        gset_attrs = [AttributeReference(f"_gset_{i}", k.dtype, True)
+                      for i, k in enumerate(keys)]
+        gid_attr = AttributeReference("_gid", LongT, False)
+        out_attrs = list(child.output) + gset_attrs + [gid_attr]
+        projections: List[List[Expression]] = []
+        for s in self._grouping_sets:
+            included = set(s)
+            # Spark gid: bit (n-1-i) set when grouping expr i is NOT in the set
+            gid = 0
+            proj: List[Expression] = list(child.output)
+            for i, k in enumerate(keys):
+                if i in included:
+                    proj.append(k)
+                else:
+                    proj.append(Literal(None, k.dtype))
+                    gid |= 1 << (n - 1 - i)
+            proj.append(Literal(gid, LongT))
+            projections.append(proj)
+        expand = L.Expand(projections, out_attrs, child, resolve=False)
+
+        def lower_markers(e: Expression) -> Expression:
+            def rule(x: Expression):
+                from .expressions import arithmetic as A_
+                if isinstance(x, GroupingID):
+                    return gid_attr
+                if isinstance(x, GroupingExpr):
+                    inner = L.resolve_expression(x.child, child)
+                    for i, k in enumerate(keys):
+                        if (isinstance(inner, AttributeReference)
+                                and isinstance(k, AttributeReference)
+                                and inner.expr_id == k.expr_id):
+                            from .expressions.bitwise import ShiftRight, BitwiseAnd
+                            from .expressions.cast import Cast as _Cast
+                            from .types import ByteT
+                            return _Cast(BitwiseAnd(
+                                ShiftRight(gid_attr, Literal(n - 1 - i)),
+                                Literal(1, LongT)), ByteT)
+                    raise ValueError(
+                        f"grouping() argument {inner.pretty()} is not a grouping column")
+                return None
+            return e.transform(rule)
+
+        lowered = []
+        for e in agg_exprs:
+            low = lower_markers(e)
+            # preserve the user-visible name when the marker was not aliased
+            # (Spark names these "grouping_id()"/"grouping(k)")
+            if low is not e and not isinstance(e, Alias):
+                low = Alias(low, L.resolve_expression(e, child).pretty())
+            lowered.append(low)
+        agg_exprs = lowered
+        grouping = list(gset_attrs) + [gid_attr]
+        node = L.Aggregate(grouping, agg_exprs, expand)
+        # final projection: grouping cols under their original names + aggs,
+        # dropping the internal _gid
+        out_exprs: List[Expression] = []
+        for i, k in enumerate(keys):
+            out_exprs.append(Alias(node.output[i], output_name(k)))
+        for j in range(len(agg_exprs)):
+            out_exprs.append(node.output[n + 1 + j])
+        return DataFrame(L.Project(out_exprs, node), self._df.session)
 
     def count(self) -> DataFrame:
         from .expressions.aggregates import Count

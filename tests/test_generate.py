@@ -1,0 +1,184 @@
+"""Generate (explode/posexplode/stack) + Expand (rollup/cube/grouping sets).
+
+Reference: integration_tests generate_expr_test.py and the grouping-sets cases
+of hash_aggregate_test.py — CPU-vs-TPU equality over generated data.
+"""
+
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (ArrayGen, DoubleGen, IntegerGen, LongGen, MapGen,
+                      StringGen, gen_df)
+
+import spark_rapids_tpu.functions as F
+
+
+def _adf(s, child=None, n=60, seed=11, **kw):
+    child = child or IntegerGen()
+    return s.createDataFrame(gen_df(
+        [("a", ArrayGen(child, **kw)), ("x", IntegerGen())], n, seed))
+
+
+def test_explode_array():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(F.col("x"), F.explode(F.col("a")).alias("e")))
+
+
+def test_explode_keeps_only_selected():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(F.explode(F.col("a")).alias("e")))
+
+
+def test_explode_outer():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.col("x"), F.explode_outer(F.col("a")).alias("e")))
+
+
+def test_posexplode():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.col("x"), F.posexplode(F.col("a")).alias("p", "e")))
+
+
+def test_posexplode_outer():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).select(
+            F.col("x"), F.posexplode_outer(F.col("a")).alias("p", "e")))
+
+
+def test_explode_strings():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s, child=StringGen()).select(
+            F.col("x"), F.explode(F.col("a")).alias("e")))
+
+
+def test_explode_doubles():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s, child=DoubleGen()).select(
+            F.explode(F.col("a")).alias("e")))
+
+
+def test_explode_map():
+    def make(s):
+        df = s.createDataFrame(gen_df(
+            [("m", MapGen(StringGen(nullable=False), IntegerGen())),
+             ("x", IntegerGen())], 40, 3))
+        return df.select(F.col("x"), F.explode(F.col("m")).alias("k", "v"))
+    assert_tpu_and_cpu_are_equal_collect(make)
+
+
+def test_explode_withcolumn():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _adf(s).withColumn("e", F.explode(F.col("a"))))
+
+
+def test_stack():
+    def make(s):
+        df = s.createDataFrame(gen_df(
+            [("p", IntegerGen()), ("q", IntegerGen()), ("x", LongGen())], 50, 5))
+        return df.select(
+            F.col("x"), F.stack(2, F.col("p"), F.col("q")).alias("v"))
+    assert_tpu_and_cpu_are_equal_collect(make)
+
+
+def test_stack_two_cols():
+    def make(s):
+        df = s.createDataFrame(gen_df(
+            [("p", IntegerGen()), ("q", StringGen()),
+             ("r", IntegerGen()), ("t", StringGen())], 50, 5))
+        return df.select(
+            F.stack(2, F.col("p"), F.col("q"), F.col("r"), F.col("t"))
+            .alias("n", "s"))
+    assert_tpu_and_cpu_are_equal_collect(make)
+
+
+# --- grouping sets ---------------------------------------------------------
+
+def _gdf(s, n=80, seed=17):
+    return s.createDataFrame(gen_df(
+        [("k1", IntegerGen(min_val=0, max_val=3)),
+         ("k2", StringGen(nullable=True)),
+         ("v", LongGen())], n, seed))
+
+
+def test_rollup():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _gdf(s).rollup("k1", "k2").agg(
+            F.sum(F.col("v")).alias("s"), F.count(F.col("v")).alias("c")),
+        ignore_order=True)
+
+
+def test_cube():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _gdf(s).cube("k1", "k2").agg(
+            F.sum(F.col("v")).alias("s")),
+        ignore_order=True)
+
+
+def test_rollup_grouping_id():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _gdf(s).rollup("k1", "k2").agg(
+            F.sum(F.col("v")).alias("s"),
+            F.grouping_id().alias("gid"),
+            F.grouping(F.col("k1")).alias("g1")),
+        ignore_order=True)
+
+
+def test_grouping_sets():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _gdf(s).groupingSets([["k1"], ["k2"], []], "k1", "k2").agg(
+            F.sum(F.col("v")).alias("s")),
+        ignore_order=True)
+
+
+def test_rollup_aggregate_over_grouping_col():
+    # aggregates must see the REAL column values, not the nulled copies
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _gdf(s).rollup("k1").agg(
+            F.sum(F.col("k1")).alias("sk"), F.max(F.col("v")).alias("m")),
+        ignore_order=True)
+
+
+def test_posexplode_outer_null_pos():
+    # Spark nulls ALL generator outputs (incl. pos) on outer filler rows
+    import pyarrow as pa
+    from asserts import with_cpu_session, with_tpu_session
+
+    def make(s):
+        df = s.createDataFrame(pa.table({
+            "x": pa.array([1, 2, 3]),
+            "a": pa.array([[10, 20], [], None],
+                          type=pa.list_(pa.int32()))}))
+        return df.select(F.col("x"),
+                         F.posexplode_outer(F.col("a")).alias("p", "e"))
+
+    for run in (with_cpu_session, with_tpu_session):
+        rows = run(lambda s: make(s).collect())
+        by_x = {}
+        for r in rows:
+            by_x.setdefault(r["x"], []).append((r["p"], r["e"]))
+        assert by_x[1] == [(0, 10), (1, 20)]
+        assert by_x[2] == [(None, None)]
+        assert by_x[3] == [(None, None)]
+
+
+def test_grouping_marker_names():
+    from asserts import with_cpu_session
+
+    def make(s):
+        return _gdf(s).rollup("k1").agg(
+            F.sum(F.col("v")), F.grouping_id(), F.grouping(F.col("k1")))
+
+    cols = with_cpu_session(lambda s: make(s).columns)
+    assert "grouping_id()" in cols
+    assert "grouping(k1)" in cols
+
+
+def test_nested_generator_rejected():
+    import pytest as _pt
+    from asserts import with_cpu_session
+    with _pt.raises(ValueError, match="nested"):
+        with_cpu_session(
+            lambda s: _adf(s).select((F.explode(F.col("a")) + F.lit(1))
+                                     .alias("x")))
