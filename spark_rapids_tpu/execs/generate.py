@@ -147,6 +147,16 @@ def _cpu_generate(gen: Generator, gen_names: List[str], t, ctx, out_names):
                 for i in range(t.num_columns)]
         gen_cols = _host_stack_cells(gen, t, ctx, n)
         return pa.table(dict(zip(out_names, cols + gen_cols)))
+    from ..expressions.json import JsonTuple
+    if isinstance(gen, JsonTuple):
+        arr = gen.child.eval_cpu(t, ctx.eval_ctx)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        rows = gen.extract_rows(arr.to_pylist())
+        cols = [t.column(i) for i in range(t.num_columns)]
+        gen_cols = [pa.array([r[c] for r in rows], type=pa.string())
+                    for c in range(len(gen.fields))]
+        return pa.table(dict(zip(out_names, cols + gen_cols)))
     raise NotImplementedError(type(gen).__name__)
 
 
@@ -177,12 +187,16 @@ class TpuGenerateExec(TpuExec):
         gen = self.generator
 
         def do_generate(batch: TpuColumnarBatch) -> TpuColumnarBatch:
+            from ..expressions.json import JsonTuple
             if isinstance(gen, Explode):
                 return _device_explode(gen, batch, ctx,
                                        [a.name for a in self._output])
             if isinstance(gen, Stack):
                 return _device_stack(gen, batch, ctx,
                                      [a.name for a in self._output])
+            if isinstance(gen, JsonTuple):
+                return _json_tuple_batch(gen, batch, ctx,
+                                         [a.name for a in self._output])
             raise NotImplementedError(type(gen).__name__)
 
         for batch in self.children[0].execute_partition(idx, ctx):
@@ -315,6 +329,26 @@ def _host_stack_fallback(gen: Stack, batch, gathered, ctx, out_names,
                 for a in _host_stack_cells(gen, batch.to_arrow(), ctx,
                                            batch.num_rows)]
     return TpuColumnarBatch(gathered.columns + gen_cols, total, out_names)
+
+
+def _json_tuple_batch(gen, batch: TpuColumnarBatch, ctx,
+                      out_names: List[str]) -> TpuColumnarBatch:
+    """json_tuple emits exactly one row per input row: pass-through columns
+    stay put, field columns come back from the host parse (reference
+    GpuJsonTuple.scala is similarly one-row-per-input)."""
+    import pyarrow as pa
+    col = to_column(gen.child.eval_tpu(batch, ctx.eval_ctx), batch)
+    rows = gen.extract_rows(col.to_arrow().to_pylist())
+    gen_cols = []
+    for c in range(len(gen.fields)):
+        arr = pa.array([r[c] for r in rows], type=pa.string())
+        v = TpuColumnVector.from_arrow(arr)
+        if v.capacity < batch.capacity:
+            from ..columnar.batch import _repad
+            v = _repad(v, batch.capacity)
+        gen_cols.append(v)
+    return TpuColumnarBatch(list(batch.columns) + gen_cols, batch.num_rows,
+                            out_names)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,443 @@
+"""JSON expressions: get_json_object, from_json, to_json, json_tuple.
+
+Reference: GpuGetJsonObject.scala, GpuJsonToStructs.scala + GpuJsonReadCommon.scala,
+GpuStructsToJson.scala, GpuJsonTuple.scala (backed by JNI JSONUtils + the cuDF
+JSON reader). TPU strategy: JSON text has no device layout, so these are
+host-assisted expressions — parse with Python's json (Spark parity caveats are
+handled explicitly below), then rebuild an Arrow column; the tagging layer
+prices them as host_assisted, the same way the reference prices JSON ops as
+incompat/off-by-default (spark.rapids.sql.expression.GetJsonObject defaults
+false, GpuOverrides.scala).
+
+Spark-parity notes implemented here:
+  * get_json_object path grammar: $, .field, ['field'], [index], [*]; invalid
+    path or malformed document → NULL; string results are unquoted; object /
+    array results re-serialized compactly.
+  * from_json PERMISSIVE mode: malformed row → NULL struct; field type
+    mismatches null out the single field (Spark's partial-result behavior).
+  * to_json omits null fields (spark.sql.jsonGenerator.ignoreNullFields=true
+    default).
+"""
+
+from __future__ import annotations
+
+import json as _json
+import re
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..types import (ArrayType, BooleanType, ByteType, DataType, DateType,
+                     DecimalType, DoubleType, FloatType, IntegerType, IntegralType,
+                     LongType, MapType, ShortType, StringT, StringType,
+                     StructField, StructType, TimestampType)
+from .base import Expression, UnaryExpression, _DEFAULT_CTX
+from .generators import Generator
+
+
+# ---------------------------------------------------------------------------
+# JSONPath subset (Spark's JsonPathParser: root, named field, array index, *)
+# ---------------------------------------------------------------------------
+
+_PATH_TOKEN = re.compile(
+    r"\.(?P<dot>[^.\[\]]+)"        # .field
+    r"|\[\'(?P<quoted>[^']*)\'\]"  # ['field']
+    r"|\[(?P<index>\d+)\]"         # [0]
+    r"|\[\*\]"                     # [*]
+    r"|(?P<star>\.\*)"             # .*
+)
+
+
+def parse_json_path(path: str) -> Optional[List[Any]]:
+    """'$.a[0].b' → ['a', 0, 'b']; '[*]' → WILDCARD marker. None if invalid."""
+    if not path or not path.startswith("$"):
+        return None
+    out: List[Any] = []
+    pos = 1
+    while pos < len(path):
+        m = _PATH_TOKEN.match(path, pos)
+        if m is None:
+            return None
+        if m.group("dot") is not None:
+            name = m.group("dot")
+            if name == "*":
+                out.append(WILDCARD)
+            else:
+                out.append(name)
+        elif m.group("quoted") is not None:
+            out.append(m.group("quoted"))
+        elif m.group("index") is not None:
+            out.append(int(m.group("index")))
+        else:  # [*] or .*
+            out.append(WILDCARD)
+        pos = m.end()
+    return out
+
+
+class _Wildcard:
+    def __repr__(self):
+        return "*"
+
+
+WILDCARD = _Wildcard()
+
+
+def _walk(value: Any, steps: List[Any], i: int = 0):
+    """Evaluate path steps; returns list of matches (wildcards fan out)."""
+    if i == len(steps):
+        return [value]
+    step = steps[i]
+    if step is WILDCARD:
+        if isinstance(value, list):
+            out = []
+            for v in value:
+                out.extend(_walk(v, steps, i + 1))
+            return out
+        if isinstance(value, dict):
+            out = []
+            for v in value.values():
+                out.extend(_walk(v, steps, i + 1))
+            return out
+        return []
+    if isinstance(step, int):
+        if isinstance(value, list) and 0 <= step < len(value):
+            return _walk(value[step], steps, i + 1)
+        return []
+    # named field
+    if isinstance(value, dict) and step in value:
+        return _walk(value[step], steps, i + 1)
+    # Spark: name step on an ARRAY maps over the elements (e.g. $.a.b where a
+    # is an array of objects)
+    if isinstance(value, list):
+        out = []
+        for v in value:
+            if isinstance(v, dict) and step in v:
+                out.extend(_walk(v[step], steps, i + 1))
+        return out
+    return []
+
+
+def _render(matches: List[Any], had_wildcard: bool) -> Optional[str]:
+    if not matches:
+        return None
+    if len(matches) == 1 and not had_wildcard:
+        v = matches[0]
+        if v is None:
+            return None
+        if isinstance(v, str):
+            return v
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (dict, list)):
+            return _json.dumps(v, separators=(",", ":"))
+        return _json.dumps(v)
+    if len(matches) == 1:
+        v = matches[0]
+        if isinstance(v, (dict, list)):
+            return _json.dumps(v, separators=(",", ":"))
+        return _json.dumps(v) if not isinstance(v, str) else v
+    return _json.dumps(matches, separators=(",", ":"))
+
+
+def get_json_object_impl(doc: Optional[str], path_steps) -> Optional[str]:
+    if doc is None or path_steps is None:
+        return None
+    try:
+        value = _json.loads(doc)
+    except (ValueError, RecursionError):
+        return None
+    had_wildcard = any(s is WILDCARD for s in path_steps)
+    return _render(_walk(value, path_steps), had_wildcard)
+
+
+class GetJsonObject(Expression):
+    """get_json_object(json, path) → string (reference GpuGetJsonObject.scala,
+    JNI JSONUtils.getJsonObject)."""
+
+    def __init__(self, child: Expression, path: Expression):
+        self.children = (child, path)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _path_steps(self, ctx):
+        from .base import Literal
+        p = self.children[1]
+        if not isinstance(p, Literal):
+            raise ValueError("get_json_object path must be a literal")
+        return parse_json_path(p.value) if p.value is not None else None
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        steps = self._path_steps(ctx)
+        arr = self.children[0].eval_cpu(table, ctx)
+        if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
+            return get_json_object_impl(arr, steps)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        return pa.array([get_json_object_impl(v, steps)
+                         for v in arr.to_pylist()], type=pa.string())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.vector import TpuScalar
+        from .strings import _string_result_from_arrow
+        import pyarrow as pa
+        steps = self._path_steps(ctx)
+        c = self.children[0].eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(StringT, get_json_object_impl(c.value, steps))
+        out = pa.array([get_json_object_impl(v, steps)
+                        for v in c.to_arrow().to_pylist()], type=pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def pretty(self) -> str:
+        return f"get_json_object({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+# ---------------------------------------------------------------------------
+# from_json
+# ---------------------------------------------------------------------------
+
+def _coerce_json_value(v: Any, dt: DataType) -> Any:
+    """Spark JacksonParser-style coercion; mismatch → None (partial results)."""
+    if v is None:
+        return None
+    try:
+        if isinstance(dt, StringType):
+            if isinstance(v, (dict, list)):
+                return _json.dumps(v, separators=(",", ":"))
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return v if isinstance(v, str) else _json.dumps(v)
+        if isinstance(dt, BooleanType):
+            return v if isinstance(v, bool) else None
+        if isinstance(dt, IntegralType):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                if isinstance(v, str):
+                    return None  # Spark: quoted numbers don't parse as ints
+                return None
+            if isinstance(v, float):
+                return None  # Spark: JSON float tokens don't parse as ints
+            iv = int(v)
+            bits = {ByteType: 8, ShortType: 16, IntegerType: 32,
+                    LongType: 64}[type(dt)]
+            lo, hi = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+            return iv if lo <= iv <= hi else None
+        if isinstance(dt, (DoubleType, FloatType)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            return float(v)
+        if isinstance(dt, DecimalType):
+            import decimal
+            if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+                return None
+            d = decimal.Decimal(str(v)).quantize(
+                decimal.Decimal(1).scaleb(-dt.scale),
+                rounding=decimal.ROUND_HALF_UP)
+            # overflow vs declared precision → null (PERMISSIVE)
+            if len(d.as_tuple().digits) - max(0, -d.as_tuple().exponent) \
+                    > dt.precision - dt.scale:
+                return None
+            return d
+        if isinstance(dt, DateType):
+            import datetime as _dt
+            if not isinstance(v, str):
+                return None
+            return _dt.date.fromisoformat(v.strip()[:10])
+        if isinstance(dt, TimestampType):
+            import datetime as _dt
+            if not isinstance(v, str):
+                return None
+            ts = _dt.datetime.fromisoformat(v.strip().replace("Z", "+00:00"))
+            if ts.tzinfo is None:
+                ts = ts.replace(tzinfo=_dt.timezone.utc)
+            return ts
+        if isinstance(dt, StructType):
+            if not isinstance(v, dict):
+                return None
+            return {f.name: _coerce_json_value(v.get(f.name), f.data_type)
+                    for f in dt.fields}
+        if isinstance(dt, ArrayType):
+            if not isinstance(v, list):
+                return None
+            return [_coerce_json_value(x, dt.element_type) for x in v]
+        if isinstance(dt, MapType):
+            if not isinstance(v, dict):
+                return None
+            return [( k, _coerce_json_value(x, dt.value_type))
+                    for k, x in v.items()]
+    except (ValueError, TypeError, OverflowError):
+        return None
+    return None
+
+
+def from_json_impl(doc: Optional[str], schema: StructType) -> Optional[dict]:
+    if doc is None:
+        return None
+    try:
+        v = _json.loads(doc)
+    except (ValueError, RecursionError):
+        return None
+    if not isinstance(v, dict):
+        return None
+    return _coerce_json_value(v, schema)
+
+
+class JsonToStructs(UnaryExpression):
+    """from_json(json, schema) (reference GpuJsonToStructs.scala; cuDF JSON
+    reader per batch there, row-wise host parse here)."""
+
+    def __init__(self, child: Expression, schema: StructType):
+        super().__init__(child)
+        if not isinstance(schema, StructType):
+            raise TypeError("from_json schema must be a StructType")
+        self.schema_type = schema
+
+    @property
+    def dtype(self) -> DataType:
+        return self.schema_type
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from ..types import to_arrow
+        arr = self.child.eval_cpu(table, ctx)
+        at = to_arrow(self.schema_type)
+        if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
+            one = from_json_impl(arr, self.schema_type)
+            return pa.array([one], type=at)[0]
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        rows = [from_json_impl(v, self.schema_type) for v in arr.to_pylist()]
+        return pa.array(rows, type=at)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.vector import TpuColumnVector, TpuScalar
+        import pyarrow as pa
+        from ..types import to_arrow
+        c = self.child.eval_tpu(batch, ctx)
+        at = to_arrow(self.schema_type)
+        if isinstance(c, TpuScalar):
+            rows = [from_json_impl(c.value, self.schema_type)] * batch.num_rows
+        else:
+            rows = [from_json_impl(v, self.schema_type)
+                    for v in c.to_arrow().to_pylist()]
+        col = TpuColumnVector.from_arrow(pa.array(rows, type=at))
+        if col.capacity < batch.capacity:
+            from ..columnar.batch import _repad
+            col = _repad(col, batch.capacity)
+        return col
+
+    def pretty(self) -> str:
+        return f"from_json({self.child.pretty()})"
+
+
+class StructsToJson(UnaryExpression):
+    """to_json(struct) (reference GpuStructsToJson.scala). Null fields omitted
+    (Spark ignoreNullFields default)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    @staticmethod
+    def _to_jsonable(v: Any, dt: DataType) -> Any:
+        if v is None:
+            return None
+        if isinstance(dt, StructType):
+            return {f.name: StructsToJson._to_jsonable(v.get(f.name), f.data_type)
+                    for f in dt.fields
+                    if v.get(f.name) is not None}
+        if isinstance(dt, ArrayType):
+            return [StructsToJson._to_jsonable(x, dt.element_type) for x in v]
+        if isinstance(dt, MapType):
+            items = v.items() if isinstance(v, dict) else v
+            return {str(k): StructsToJson._to_jsonable(x, dt.value_type)
+                    for k, x in items}
+        if isinstance(dt, DecimalType):
+            return float(v)
+        if isinstance(dt, (DateType, TimestampType)):
+            return str(v)
+        return v
+
+    def _row_to_json(self, v: Any) -> Optional[str]:
+        if v is None:
+            return None
+        return _json.dumps(self._to_jsonable(v, self.child.dtype),
+                           separators=(",", ":"))
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = self.child.eval_cpu(table, ctx)
+        if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
+            return self._row_to_json(arr)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        return pa.array([self._row_to_json(v) for v in arr.to_pylist()],
+                        type=pa.string())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.vector import TpuScalar
+        from .strings import _string_result_from_arrow
+        import pyarrow as pa
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(StringT, self._row_to_json(c.value))
+        out = pa.array([self._row_to_json(v) for v in c.to_arrow().to_pylist()],
+                       type=pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def pretty(self) -> str:
+        return f"to_json({self.child.pretty()})"
+
+
+# ---------------------------------------------------------------------------
+# json_tuple — a generator producing exactly one row of N string fields
+# ---------------------------------------------------------------------------
+
+class JsonTuple(Generator):
+    """json_tuple(json, f1, ..., fn) (reference GpuJsonTuple.scala).
+    Top-level field extraction only, results rendered like get_json_object."""
+
+    def __init__(self, child: Expression, fields: List[str]):
+        self.children = (child,)
+        if not fields:
+            raise ValueError("json_tuple requires at least one field name")
+        self.fields = list(fields)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def element_schema(self):
+        return [(f"c{i}", StringT, True) for i in range(len(self.fields))]
+
+    def extract_rows(self, docs: List[Optional[str]]) -> List[List[Optional[str]]]:
+        """Per input doc, the extracted field values (one output row each)."""
+        out = []
+        for doc in docs:
+            row: List[Optional[str]] = []
+            obj = None
+            if doc is not None:
+                try:
+                    parsed = _json.loads(doc)
+                    obj = parsed if isinstance(parsed, dict) else None
+                except (ValueError, RecursionError):
+                    obj = None
+            for f in self.fields:
+                v = obj.get(f) if obj is not None else None
+                if v is None:
+                    row.append(None)
+                elif isinstance(v, str):
+                    row.append(v)
+                elif isinstance(v, bool):
+                    row.append("true" if v else "false")
+                elif isinstance(v, (dict, list)):
+                    row.append(_json.dumps(v, separators=(",", ":")))
+                else:
+                    row.append(_json.dumps(v))
+            out.append(row)
+        return out
+
+    def pretty(self) -> str:
+        return f"json_tuple({self.child.pretty()}, {', '.join(self.fields)})"

@@ -547,3 +547,53 @@ def grouping_id() -> Column:
 def grouping(c) -> Column:
     from .expressions.generators import GroupingExpr
     return Column(GroupingExpr(_expr_or_col(c)))
+
+
+# --- JSON (reference GpuGetJsonObject/GpuJsonToStructs/GpuStructsToJson/GpuJsonTuple)
+
+def get_json_object(c, path: str) -> Column:
+    from .expressions.json import GetJsonObject
+    return Column(GetJsonObject(_expr_or_col(c), Literal(path)))
+
+
+def from_json(c, schema) -> Column:
+    from .expressions.json import JsonToStructs
+    from .types import StructType, parse_ddl
+    if isinstance(schema, str):
+        schema = parse_ddl(schema)
+    return Column(JsonToStructs(_expr_or_col(c), schema))
+
+
+def to_json(c) -> Column:
+    from .expressions.json import StructsToJson
+    return Column(StructsToJson(_expr_or_col(c)))
+
+
+def json_tuple(c, *fields: str) -> Column:
+    from .expressions.json import JsonTuple
+    return Column(JsonTuple(_expr_or_col(c), list(fields)))
+
+
+def schema_of_json(sample: str):
+    """Infer a StructType from one JSON document (host-side helper)."""
+    import json as _j
+    from .types import (ArrayType, BooleanT, DoubleT, LongT, NullT, StringT,
+                        StructField, StructType)
+
+    def infer(v):
+        if isinstance(v, bool):
+            return BooleanT
+        if isinstance(v, int):
+            return LongT
+        if isinstance(v, float):
+            return DoubleT
+        if isinstance(v, str):
+            return StringT
+        if isinstance(v, list):
+            return ArrayType(infer(v[0]) if v else StringT)
+        if isinstance(v, dict):
+            return StructType(tuple(StructField(k, infer(x), True)
+                                    for k, x in v.items()))
+        return StringT
+
+    return infer(_j.loads(sample))

@@ -44,8 +44,43 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
     elif fmt == "csv":
         import pyarrow.csv as pacsv
         header = str(options.get("header", "false")).lower() == "true"
-        ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
-        t = pacsv.read_csv(path, read_options=ropts)
+        sep = options.get("sep", options.get("delimiter", ","))
+        popts = pacsv.ParseOptions(delimiter=sep)
+        copts = None
+        ddl = options.get("__user_schema__")
+        if ddl is not None:
+            # user schema: read named columns at the declared types (reference
+            # GpuCSVScan type-cast post-pass)
+            from ..types import to_arrow as type_to_arrow
+            names = [f.name for f in ddl.fields]
+            ropts = pacsv.ReadOptions(column_names=names,
+                                      skip_rows=1 if header else 0)
+            copts = pacsv.ConvertOptions(column_types={
+                f.name: type_to_arrow(f.data_type) for f in ddl.fields})
+        else:
+            ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
+        try:
+            t = pacsv.read_csv(path, read_options=ropts, parse_options=popts,
+                               convert_options=copts)
+        except pa.lib.ArrowInvalid:
+            if ddl is None:
+                raise
+            # PERMISSIVE column-count mismatch: extra file columns dropped,
+            # missing schema columns null (Spark CSV default mode)
+            ropts2 = pacsv.ReadOptions(autogenerate_column_names=not header)
+            raw = pacsv.read_csv(path, read_options=ropts2, parse_options=popts)
+            out = {}
+            for i, f in enumerate(ddl.fields):
+                at = type_to_arrow(f.data_type)
+                if header and f.name in raw.column_names:
+                    src = raw.column(f.name)
+                elif not header and i < raw.num_columns:
+                    src = raw.column(i)
+                else:
+                    src = None
+                out[f.name] = pa.nulls(raw.num_rows, at) if src is None \
+                    else src.cast(at)
+            t = pa.table(out)
         if columns:
             t = t.select([c for c in columns if c in t.column_names])
     elif fmt == "json":

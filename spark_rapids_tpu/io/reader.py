@@ -59,16 +59,31 @@ class DataFrameReader:
         from ..plan.logical import FileScan
         from ..session import DataFrame
         files = _expand(paths)
-        return DataFrame(FileScan(files, fmt, options=self._options),
+        schema_attrs = None
+        if self._schema is not None:
+            from ..expressions.base import AttributeReference
+            from ..types import StructType, parse_ddl
+            st = self._schema if isinstance(self._schema, StructType) \
+                else parse_ddl(str(self._schema))
+            self._options["__user_schema__"] = st
+            schema_attrs = [AttributeReference(f.name, f.data_type, f.nullable)
+                            for f in st.fields]
+        return DataFrame(FileScan(files, fmt, schema_attrs=schema_attrs,
+                                  options=self._options),
                          self._session)
 
     def parquet(self, *paths: str):
         return self._scan(paths, "parquet")
 
     def csv(self, path: str, header: Optional[bool] = None,
-            inferSchema: Optional[bool] = None, **kw):
+            inferSchema: Optional[bool] = None, sep: Optional[str] = None,
+            schema=None, **kw):
         if header is not None:
             self._options["header"] = str(bool(header)).lower()
+        if sep is not None:
+            self._options["sep"] = sep
+        if schema is not None:
+            self._schema = schema
         return self._scan([path], "csv")
 
     def json(self, path: str):

@@ -97,8 +97,11 @@ class FileScan(LogicalPlan):
         elif self.fmt == "csv":
             import pyarrow.csv as pacsv
             header = str(self.options.get("header", "false")).lower() == "true"
+            sep = self.options.get("sep", self.options.get("delimiter", ","))
             ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
-            sch = pacsv.read_csv(p, read_options=ropts).schema
+            popts = pacsv.ParseOptions(delimiter=sep)
+            sch = pacsv.read_csv(p, read_options=ropts,
+                                 parse_options=popts).schema
         elif self.fmt == "json":
             import pyarrow.json as pajson
             sch = pajson.read_json(p).schema

@@ -295,8 +295,9 @@ register_exec(_CpuWin, "window", "spark.rapids.sql.exec.WindowExec",
 
 def _tag_generate(meta: PlanMeta) -> None:
     from ..expressions.generators import Explode, Stack
+    from ..expressions.json import JsonTuple
     gen = meta.plan.generator
-    if not isinstance(gen, (Explode, Stack)):
+    if not isinstance(gen, (Explode, Stack, JsonTuple)):
         meta.will_not_work_on_tpu(
             f"generator {type(gen).__name__} is not supported on TPU")
     meta.add_exprs(list(gen.children))

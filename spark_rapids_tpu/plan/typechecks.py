@@ -189,6 +189,16 @@ def _register_builtin_exprs() -> None:
     register_expr(G.GroupingID, TypeSigs.integral,
                   "grouping_id (lowered to the Expand gid column)")
 
+    from ..expressions import json as J
+    register_expr(J.GetJsonObject, TypeSigs.STRING,
+                  "get_json_object (JSONPath subset)", host_assisted=True)
+    register_expr(J.JsonToStructs, TypeSigs.nested_common,
+                  "from_json (PERMISSIVE)", host_assisted=True)
+    register_expr(J.StructsToJson, TypeSigs.STRING, "to_json",
+                  host_assisted=True)
+    register_expr(J.JsonTuple, TypeSigs.STRING, "json_tuple generator",
+                  host_assisted=True)
+
     from .. import udf as U
     register_expr(U.TpuColumnarUDF, TypeSigs.all, "columnar device UDF (RapidsUDF)")
     register_expr(U.ArrowPandasUDF, TypeSigs.all, "arrow/pandas UDF",

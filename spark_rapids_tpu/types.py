@@ -476,3 +476,76 @@ def to_arrow(dt: DataType):
     if isinstance(dt, NullType):
         return pa.null()
     raise TypeError(f"unsupported type {dt}")
+
+
+# ---------------------------------------------------------------------------
+# DDL schema strings ("a INT, b STRUCT<x: BIGINT, y: STRING>") — the schema
+# syntax Spark accepts in from_json / createDataFrame (StructType.fromDDL)
+# ---------------------------------------------------------------------------
+
+def parse_ddl(ddl: str) -> StructType:
+    ddl = ddl.strip()
+    # Spark also accepts the full 'struct<a: int, ...>' form at top level
+    if ddl.lower().startswith("struct<") and ddl.endswith(">"):
+        ddl = ddl[7:-1]
+    fields = []
+    for part in _split_top_level(ddl):
+        part = part.strip()
+        if not part:
+            continue
+        # "name type" or "name: type" (struct-field style)
+        if ":" in part.split("<")[0]:
+            name, typ = part.split(":", 1)
+        else:
+            bits = part.split(None, 1)
+            if len(bits) != 2:
+                raise ValueError(f"cannot parse DDL field {part!r}")
+            name, typ = bits
+        fields.append(StructField(name.strip().strip("`"),
+                                  parse_ddl_type(typ.strip()), True))
+    return StructType(tuple(fields))
+
+
+def _split_top_level(s: str, sep: str = ",") -> list:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "<(":
+            depth += 1
+        elif ch in ">)":
+            depth -= 1
+        if ch == sep and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+_DDL_SIMPLE = {
+    "boolean": BooleanType, "tinyint": ByteType, "byte": ByteType,
+    "smallint": ShortType, "short": ShortType, "int": IntegerType,
+    "integer": IntegerType, "bigint": LongType, "long": LongType,
+    "float": FloatType, "real": FloatType, "double": DoubleType,
+    "string": StringType, "binary": BinaryType, "date": DateType,
+    "timestamp": TimestampType, "void": NullType, "null": NullType,
+}
+
+
+def parse_ddl_type(s: str) -> DataType:
+    s = s.strip()
+    low = s.lower()
+    if low in _DDL_SIMPLE:
+        return _DDL_SIMPLE[low]()
+    if low.startswith("decimal"):
+        m = s[s.index("(") + 1: s.rindex(")")] if "(" in s else "10,0"
+        p, sc = (m.split(",") + ["0"])[:2]
+        return DecimalType(int(p), int(sc))
+    if low.startswith("array<") and s.endswith(">"):
+        return ArrayType(parse_ddl_type(s[6:-1]))
+    if low.startswith("map<") and s.endswith(">"):
+        k, v = _split_top_level(s[4:-1])
+        return MapType(parse_ddl_type(k), parse_ddl_type(v))
+    if low.startswith("struct<") and s.endswith(">"):
+        return parse_ddl(s[7:-1])
+    raise ValueError(f"cannot parse DDL type {s!r}")

@@ -121,3 +121,39 @@ def test_scan_on_tpu_plan(pq_files):
     s = TpuSession({"spark.rapids.sql.test.enabled": "true"})
     rows = s.read.parquet(*pq_files).filter(F.col("a") > 0).count()
     assert rows > 0
+
+
+def test_csv_user_schema_and_sep(tmp_path):
+    p = str(tmp_path / "t.csv")
+    with open(p, "w") as f:
+        f.write("a|b|c\n1|x|2.5\n2|y|-1.0\n3||0.0\n")
+    import spark_rapids_tpu.functions as F
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.csv(p, header=True, sep="|",
+                             schema="a INT, b STRING, c DOUBLE")
+        .select(F.col("a"), F.col("b"), F.col("c")))
+
+
+def test_csv_schema_no_header(tmp_path):
+    p = str(tmp_path / "t.csv")
+    with open(p, "w") as f:
+        f.write("1,x\n2,y\n")
+    import spark_rapids_tpu.functions as F
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.csv(p, schema="k BIGINT, v STRING")
+        .select(F.col("k"), F.col("v")))
+
+
+def test_csv_schema_column_mismatch(tmp_path):
+    # PERMISSIVE: extra file columns dropped, missing schema columns null
+    p = str(tmp_path / "t.csv")
+    with open(p, "w") as f:
+        f.write("a,b,c\n1,x,2.5\n2,y,-1.0\n")
+    import spark_rapids_tpu.functions as F
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.csv(p, header=True, schema="a INT, b STRING")
+        .select(F.col("a"), F.col("b")))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.csv(p, header=True,
+                             schema="a INT, b STRING, c DOUBLE, d BIGINT")
+        .select(F.col("a"), F.col("d")))

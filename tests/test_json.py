@@ -1,0 +1,146 @@
+"""JSON expression tests: get_json_object, from_json, to_json, json_tuple.
+
+Reference: integration_tests json_test.py, get_json_test.py — CPU-vs-TPU
+equality plus explicit Spark-semantics probes (malformed docs, type coercion,
+path grammar).
+"""
+
+import pyarrow as pa
+import pytest
+
+from asserts import (assert_tpu_and_cpu_are_equal_collect, with_cpu_session,
+                     with_tpu_session)
+
+import spark_rapids_tpu.functions as F
+
+DOCS = [
+    '{"a": 1, "b": "x", "c": [1,2,3], "d": {"e": 2.5}}',
+    '{"a": 2, "b": null, "c": [], "d": {"e": -1.0}}',
+    '{"a": "notanint", "b": "y"}',
+    'not json at all',
+    None,
+    '{"a": 99, "c": [{"f": 1}, {"f": 2}]}',
+    '[]',
+    '{"b": "true", "a": 3}',
+]
+
+
+def _jdf(s):
+    return s.createDataFrame(pa.table({
+        "j": pa.array(DOCS, type=pa.string()),
+        "x": pa.array(list(range(len(DOCS))))}))
+
+
+def test_get_json_object_fields():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _jdf(s).select(
+            F.col("x"),
+            F.get_json_object(F.col("j"), "$.a").alias("a"),
+            F.get_json_object(F.col("j"), "$.b").alias("b"),
+            F.get_json_object(F.col("j"), "$.d.e").alias("e"),
+            F.get_json_object(F.col("j"), "$.c[1]").alias("c1"),
+            F.get_json_object(F.col("j"), "$.c").alias("c"),
+            F.get_json_object(F.col("j"), "$.missing").alias("m")))
+
+
+def test_get_json_object_semantics():
+    def q(s):
+        return _jdf(s).select(
+            F.get_json_object(F.col("j"), "$.a").alias("a"),
+            F.get_json_object(F.col("j"), "$.c[*].f").alias("w")).collect()
+    rows = with_tpu_session(q)
+    # string results unquoted; objects/arrays compact JSON; malformed → null
+    assert rows[0]["a"] == "1"
+    assert rows[2]["a"] == "notanint"
+    assert rows[3]["a"] is None
+    assert rows[4]["a"] is None
+    assert rows[5]["w"] == "[1,2]"
+    assert rows == with_cpu_session(q)
+
+
+def test_from_json_struct():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _jdf(s).select(
+            F.col("x"),
+            F.from_json(F.col("j"), "a INT, b STRING").alias("s")))
+
+
+def test_from_json_coercion():
+    def q(s):
+        return _jdf(s).select(
+            F.from_json(F.col("j"), "a INT, b STRING").alias("s")).collect()
+    rows = with_tpu_session(q)
+    assert rows[0]["s"] == {"a": 1, "b": "x"}
+    # "notanint" → null field, doc still parses (partial results)
+    assert rows[2]["s"] == {"a": None, "b": "y"}
+    assert rows[3]["s"] is None       # malformed → null struct
+    assert rows[6]["s"] is None       # top-level array vs struct schema
+    assert rows == with_cpu_session(q)
+
+
+def test_from_json_nested():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _jdf(s).select(
+            F.from_json(F.col("j"),
+                        "a BIGINT, c ARRAY<INT>, d STRUCT<e: DOUBLE>")
+            .alias("s")))
+
+
+def test_to_json_roundtrip():
+    def q(s):
+        return _jdf(s).select(
+            F.to_json(F.from_json(F.col("j"), "a INT, b STRING").alias("s"))
+            .alias("out"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["out"] == '{"a":1,"b":"x"}'
+    assert rows[1]["out"] == '{"a":2}'  # null fields omitted
+
+
+def test_json_tuple():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _jdf(s).select(
+            F.col("x"),
+            F.json_tuple(F.col("j"), "a", "b", "missing").alias("a", "b", "m")))
+
+
+def test_json_tuple_semantics():
+    def q(s):
+        return _jdf(s).select(
+            F.json_tuple(F.col("j"), "a", "c").alias("a", "c")).collect()
+    rows = with_tpu_session(q)
+    assert rows[0]["a"] == "1" and rows[0]["c"] == "[1,2,3]"
+    assert rows[3]["a"] is None       # malformed
+    assert rows == with_cpu_session(q)
+
+
+def test_json_scan(tmp_path):
+    # line-delimited JSON file scan (reference GpuJsonScan / cuDF JSON reader)
+    p = str(tmp_path / "data.json")
+    with open(p, "w") as f:
+        f.write('{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}\n{"a": null, "b": "z"}\n')
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.json(p).select(F.col("a"), F.col("b")))
+
+
+def test_from_json_date_ts_decimal():
+    docs = ['{"d": "2020-01-31", "t": "2021-06-01T12:30:00", "m": 1.234}',
+            '{"d": "bad", "t": null, "m": 12345.6}',
+            '{"d": null, "m": 2.5}']
+    def q(s):
+        df = s.createDataFrame(pa.table({"j": pa.array(docs)}))
+        return df.select(F.from_json(
+            F.col("j"), "d DATE, t TIMESTAMP, m DECIMAL(5,2)").alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    import datetime, decimal
+    assert rows[0]["s"]["d"] == datetime.date(2020, 1, 31)
+    assert rows[0]["s"]["m"] == decimal.Decimal("1.23")
+    assert rows[1]["s"]["d"] is None
+    assert rows[1]["s"]["m"] is None  # overflows DECIMAL(5,2)
+
+
+def test_parse_ddl_struct_form():
+    from spark_rapids_tpu.types import parse_ddl
+    s = parse_ddl("struct<a: int, b: string>")
+    assert [f.name for f in s.fields] == ["a", "b"]
