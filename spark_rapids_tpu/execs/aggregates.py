@@ -27,7 +27,8 @@ from ..expressions.aggregates import (AggregateFunction, Average, Count, First,
                                       Last, Max, Min, StddevBase, StddevPop,
                                       StddevSamp, Sum, VariancePop, VarianceSamp)
 from ..expressions.base import (Alias, AttributeReference, Expression, to_column)
-from ..types import (DataType, DoubleT, FloatType, DoubleType, LongT, StringType)
+from ..types import (DataType, DecimalType, DoubleT, FloatType, DoubleType,
+                     LongT, StringType)
 from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
                    bind_references)
 
@@ -111,17 +112,23 @@ class CpuHashAggregateExec(CpuExec):
             proj[name] = arr
             key_names.append(name)
         agg_specs = []
+
+        def eval_input(inp):
+            r = inp.eval_cpu(base, ctx.eval_ctx)
+            if not isinstance(r, (pa.Array, pa.ChunkedArray)):
+                from ..types import to_arrow
+                r = pa.array([r] * base.num_rows, type=to_arrow(inp.dtype))
+            return r
+
         for i, fn in enumerate(agg_fns):
             inp = fn.children[0] if fn.children else None
             name = f"__in_{i}"
             if inp is None:
                 proj[name] = pa.array(np.ones(base.num_rows, np.int64))
             else:
-                r = inp.eval_cpu(base, ctx.eval_ctx)
-                if not isinstance(r, (pa.Array, pa.ChunkedArray)):
-                    from ..types import to_arrow
-                    r = pa.array([r] * base.num_rows, type=to_arrow(inp.dtype))
-                proj[name] = r
+                proj[name] = eval_input(inp)
+            if len(fn.children) >= 2:
+                proj[f"__in2_{i}"] = eval_input(fn.children[1])
             agg_specs.append((name, fn))
         if base.num_rows == 0 and not self.grouping:
             flat = pa.table({k: pa.array([], type=getattr(v, "type", pa.int64()))
@@ -157,7 +164,109 @@ def _normalize_fp_key_arrow(arr):
 _ARROW_AGG = {"sum": "sum", "count": "count", "min": "min", "max": "max",
               "avg": "mean", "first": "first", "last": "last",
               "stddev_samp": "stddev", "stddev_pop": "stddev",
-              "var_samp": "variance", "var_pop": "variance"}
+              "var_samp": "variance", "var_pop": "variance",
+              "collect_list": "list", "collect_set": "distinct"}
+
+#: aggregates with no Arrow group_by kernel — python-grouped on the oracle
+_CUSTOM_CPU_AGGS = {"percentile", "approx_percentile",
+                    "covar_samp", "covar_pop", "corr", "bloom_filter"}
+
+
+def _dedup_key(v):
+    """Hashable identity key for set dedup matching the device semantics
+    (_dedup_bits): all NaNs equal; -0.0 and 0.0 distinct; nested values by
+    structure."""
+    import struct as _struct
+    if isinstance(v, float):
+        if v != v:
+            return ("__nan__",)
+        return ("__f__", _struct.pack(">d", v))
+    if isinstance(v, list):
+        return ("__l__", tuple(_dedup_key(x) for x in v))
+    if isinstance(v, dict):
+        return ("__m__", tuple(sorted((k, _dedup_key(x))
+                                      for k, x in v.items())))
+    return v
+
+
+def _dedup_values(items):
+    seen, uniq = set(), []
+    for v in items:
+        k = _dedup_key(v)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(v)
+    return uniq
+
+
+def _custom_cpu_agg(fn, cols_py: List[list], rows: List[int]):
+    """One group's value for a python-grouped aggregate (oracle path)."""
+    import math
+    op = fn.update_op
+    if op == "bloom_filter":
+        vals = [v for v in (cols_py[0][r] for r in rows) if v is not None]
+        return fn.build(np.asarray(vals, np.int64)) if vals else None
+    if op in ("collect_list", "collect_set"):
+        items = [v for v in (cols_py[0][r] for r in rows) if v is not None]
+        if op == "collect_list":
+            return items
+        uniq = _dedup_values(items)
+        try:
+            uniq = sorted(uniq)  # match the device's value-sorted sets
+        except TypeError:
+            pass
+        return uniq
+    if op in ("percentile", "approx_percentile"):
+        vals, nans = [], []
+        for r in rows:
+            v = cols_py[0][r]
+            if v is None:
+                continue
+            if isinstance(v, float) and v != v:
+                nans.append(v)
+            else:
+                vals.append(v)
+        vals.sort()
+        vals.extend(nans)  # NaN greatest, like the device bit encoding
+        if not vals:
+            return None
+        n = len(vals)
+        outs = []
+        for p in fn.percentages:
+            t = p * (n - 1)
+            if op == "percentile":
+                lo, hi = math.floor(t), math.ceil(t)
+                outs.append(float(vals[lo])
+                            + (float(vals[hi]) - float(vals[lo])) * (t - lo))
+            else:  # nearest rank (round-half-even, matching jnp.round)
+                outs.append(vals[round(t)])
+        return outs if fn.is_array else outs[0]
+    # covariance family
+    xs, ys = [], []
+    for r in rows:
+        x, y = cols_py[0][r], cols_py[1][r]
+        if x is None or y is None:
+            continue
+        xs.append(float(x))
+        ys.append(float(y))
+    n = len(xs)
+    if n == 0 or (op != "covar_pop" and n < 2):
+        return None
+    sx, sy = sum(xs), sum(ys)
+    sxy = sum(x * y for x, y in zip(xs, ys))
+    cov = sxy - sx * sy / n
+    if op == "covar_pop":
+        return cov / n
+    if op == "covar_samp":
+        return cov / (n - 1)
+    sx2 = sum(x * x for x in xs)
+    sy2 = sum(y * y for y in ys)
+    mx2 = max(sx2 - sx * sx / n, 0.0)
+    my2 = max(sy2 - sy * sy / n, 0.0)
+    denom = math.sqrt(mx2 * my2)
+    if denom == 0:
+        return None
+    return cov / denom
 
 
 def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
@@ -174,7 +283,18 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
     for i, (name, fn) in enumerate(agg_specs):
         col = flat.column(name)
         is_fp = pa.types.is_floating(col.type)
-        if is_fp and fn.update_op in ("min", "max"):
+        if fn.update_op in _CUSTOM_CPU_AGGS or (
+                fn.update_op in ("collect_set", "collect_list")
+                and pa.types.is_nested(col.type)):
+            # nested collect: Arrow's hash_list/hash_distinct lack nested
+            # kernels → python-grouped path
+            names = [f"__c_{i}"]
+            work[f"__c_{i}"] = col
+            if f"__in2_{i}" in flat.column_names:
+                work[f"__c2_{i}"] = flat.column(f"__in2_{i}")
+                names.append(f"__c2_{i}")
+            plans.append(("custom", names, fn))
+        elif is_fp and fn.update_op in ("min", "max"):
             nan = pc.is_nan(col)
             neutral = pa.scalar(np.inf if fn.update_op == "min" else -np.inf,
                                 col.type)
@@ -188,6 +308,8 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
 
     agg_calls = []
     for mode, names, fn in plans:
+        if mode == "custom":
+            continue
         op = _ARROW_AGG[fn.update_op]
         if fn.update_op in ("stddev_samp", "var_samp"):
             agg_calls.append((names[0], op, pc.VarianceOptions(ddof=1)))
@@ -204,7 +326,13 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
             agg_calls.append((names[0], op, None))
 
     work_table = pa.table(work)
+    have_custom = any(m == "custom" for m, _, _ in plans)
     if key_names:
+        if not agg_calls:
+            # keys only (all aggs custom): still need the distinct-key rows
+            work_table = work_table.append_column(
+                "__dummy", pa.array(np.ones(work_table.num_rows, np.int8)))
+            agg_calls.append(("__dummy", "count", None))
         gb = pa.TableGroupBy(work_table, key_names)
         res = gb.aggregate([(n, op) if o is None else (n, op, o)
                             for n, op, o in agg_calls])
@@ -218,6 +346,11 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
         results = {}
         for n, op, o in agg_calls:
             col = work_table.column(n)
+            if op in ("list", "distinct"):
+                # raw collect; null-drop/dedup happens in the shared cleanup
+                results[f"{n}_{op}"] = pa.array([col.to_pylist()],
+                                                type=pa.list_(col.type))
+                continue
             f = scalar_fns[op]
             if op in ("stddev", "variance"):
                 v = f(col, ddof=o.ddof)
@@ -230,9 +363,50 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
         get = lambda n, op: results[f"{n}_{op}"]
         n_out = 1
 
+    # custom (python-grouped) aggregates, aligned to the output key rows
+    custom_vals = {}
+    if have_custom:
+        def canon(t):
+            return tuple("__nan__" if isinstance(v, float) and v != v else v
+                         for v in t)
+        if key_names:
+            in_keys = list(zip(*[work_table.column(k).to_pylist()
+                                 for k in key_names]))
+            groups: Dict[tuple, list] = {}
+            for ri, kt in enumerate(in_keys):
+                groups.setdefault(canon(kt), []).append(ri)
+            out_keys = [canon(t) for t in zip(*[res.column(k).to_pylist()
+                                               for k in key_names])]
+        else:
+            groups = {(): list(range(work_table.num_rows))}
+            out_keys = [()]
+        for i, (mode, names, fn) in enumerate(plans):
+            if mode != "custom":
+                continue
+            cols_py = [work_table.column(nm).to_pylist() for nm in names]
+            vals = [_custom_cpu_agg(fn, [c for c in cols_py],
+                                    groups.get(k, [])) for k in out_keys]
+            from ..types import to_arrow as type_to_arrow
+            custom_vals[i] = pa.array(vals, type=type_to_arrow(fn.dtype))
+
     out_cols = {}
     for i, (mode, names, fn) in enumerate(plans):
+        if mode == "custom":
+            out_cols[f"__out_{i}"] = custom_vals[i]
+            continue
         op = _ARROW_AGG[fn.update_op]
+        if op in ("list", "distinct"):
+            raw = get(names[0], op)
+            cleaned = []
+            for lst in raw.to_pylist():
+                items = [v for v in (lst or []) if v is not None]
+                if op == "distinct":
+                    items = _dedup_values(items)
+                cleaned.append(items)
+            from ..types import to_arrow as type_to_arrow
+            out_cols[f"__out_{i}"] = pa.array(cleaned,
+                                              type=type_to_arrow(fn.dtype))
+            continue
         if mode == "fp_minmax":
             red = get(names[0], op)
             all_nan = get(names[1], "min")
@@ -381,7 +555,19 @@ class AggState:
 def _segment_update(fn: AggregateFunction, col: Optional[TpuColumnVector],
                     seg_ids, n_groups_cap: int, capacity: int, num_rows: int,
                     sorted_perm) -> Dict[str, jnp.ndarray]:
-    """Compute partial state per group via scatter reductions over sorted rows."""
+    """Compute partial state per group via scatter reductions over sorted rows.
+    `col` is the evaluated input column (a tuple of columns for two-input
+    aggregates like covar/corr)."""
+    if fn.update_op in ("collect_list", "collect_set",
+                        "percentile", "approx_percentile"):
+        return _segment_collect(fn, col, seg_ids, n_groups_cap, capacity,
+                                num_rows, sorted_perm)
+    if fn.update_op in ("covar_samp", "covar_pop", "corr"):
+        return _segment_covar(fn, col, seg_ids, n_groups_cap, capacity,
+                              num_rows, sorted_perm)
+    if fn.update_op == "bloom_filter":
+        return _segment_bloom(fn, col, seg_ids, n_groups_cap, capacity,
+                              num_rows, sorted_perm)
     mask = row_mask(num_rows, capacity)
     if col is not None:
         data = jnp.take(col.data, sorted_perm)
@@ -463,6 +649,198 @@ def _segment_update(fn: AggregateFunction, col: Optional[TpuColumnVector],
     raise NotImplementedError(f"update op {op}")
 
 
+def _dedup_bits(col_data):
+    """Equality-preserving bit view for set dedup: NaNs canonicalized (Java
+    HashSet merges NaNs) but -0.0 and 0.0 kept distinct (Double.equals)."""
+    d = col_data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        canon = jnp.asarray(np.array(np.nan, d.dtype))
+        d = jnp.where(jnp.isnan(d), canon, d)
+        return d.view(jnp.int64 if d.dtype == jnp.float64 else jnp.int32)
+    if d.dtype == jnp.bool_:
+        return d.astype(jnp.int32)
+    return d
+
+
+def _compact_to_indices(keep, perm, capacity: int):
+    """Sorted-domain keep mask → (orig-row index array, total, elem_cap).
+    Groups are contiguous in sorted order, so a global stable compact keeps
+    per-group element runs contiguous — exactly the list-column child layout."""
+    pos_out = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    total = int(jnp.sum(keep))
+    elem_cap = bucket_capacity(max(total, 1))
+    idx = jnp.full((elem_cap,), capacity, jnp.int32).at[
+        jnp.where(keep, pos_out, elem_cap)].set(
+        perm.astype(jnp.int32), mode="drop")
+    return idx, total, elem_cap
+
+
+def _segment_collect(fn, col: TpuColumnVector, seg_ids, g_cap: int,
+                     capacity: int, num_rows: int, perm):
+    """collect_list / collect_set / percentile / approx_percentile.
+
+    The input is already key-sorted (groups contiguous), so collect_list is a
+    null-compact + offsets-from-counts; collect_set and the percentiles add a
+    value sort within each segment (lexsort on (segment, value bits)) — the
+    same segmented-sort shape cuDF's groupby collect/percentile kernels use.
+    """
+    mask = row_mask(num_rows, capacity)
+    valid_orig = (col.validity & mask) if col.validity is not None else mask
+    valid = jnp.take(valid_orig, perm)  # sorted domain
+    op = fn.update_op
+    device_layout = col.offsets is None and col.host_data is None
+
+    if op == "collect_list":
+        counts = jnp.zeros((g_cap,), jnp.int32).at[seg_ids].add(
+            valid.astype(jnp.int32), mode="drop")
+        idx, total, elem_cap = _compact_to_indices(valid, perm, capacity)
+        from ..columnar.batch import _gather_column
+        child = _gather_column(col, jnp.where(idx < capacity, idx, 0),
+                               row_mask(total, elem_cap), total, elem_cap)
+        offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(counts).astype(jnp.int32)])
+        return {"__list_child": child, "__list_offsets": offsets}
+
+    if not device_layout:
+        return _host_collect(fn, col, seg_ids, g_cap, capacity, num_rows, perm)
+
+    data = jnp.take(col.data, perm)  # sorted domain values
+    # secondary sort by value within segment; invalid rows to a trailing bucket
+    bits = _dedup_bits(data) if op == "collect_set" else _sortable_bits(
+        TpuColumnVector(col.dtype, data, None, num_rows))
+    seg_key = jnp.where(valid, seg_ids, g_cap)
+    perm2 = jnp.lexsort((bits, seg_key))  # value-sorted within each segment
+    seg2 = jnp.take(seg_key, perm2)
+    valid2 = jnp.take(valid, perm2)
+    bits2 = jnp.take(bits, perm2)
+
+    if op == "collect_set":
+        first = valid2 & jnp.concatenate([
+            jnp.ones((1,), jnp.bool_),
+            (seg2[1:] != seg2[:-1]) | (bits2[1:] != bits2[:-1])])
+        counts = jnp.zeros((g_cap,), jnp.int32).at[
+            jnp.where(valid2, seg2, g_cap)].add(
+            first.astype(jnp.int32), mode="drop")
+        orig_idx = jnp.take(perm, perm2)
+        idx, total, elem_cap = _compact_to_indices(first, orig_idx, capacity)
+        from ..columnar.batch import _gather_column
+        child = _gather_column(col, jnp.where(idx < capacity, idx, 0),
+                               row_mask(total, elem_cap), total, elem_cap)
+        offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(counts).astype(jnp.int32)])
+        return {"__list_child": child, "__list_offsets": offsets}
+
+    # percentiles: per-group sorted run [start, start+n_g)
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    n_g = jnp.zeros((g_cap,), jnp.int64).at[
+        jnp.where(valid2, seg2, g_cap)].add(
+        valid2.astype(jnp.int64), mode="drop")
+    starts = jnp.full((g_cap,), capacity, jnp.int32).at[
+        jnp.where(valid2, seg2, g_cap)].min(pos, mode="drop")
+    vals2 = jnp.take(data, perm2)
+    # decimal columns carry scaled ints; exact percentile interpolates in
+    # doubles, so unscale (approx gathers raw carrier values — no unscale)
+    unscale = (10.0 ** -col.dtype.scale) \
+        if isinstance(col.dtype, DecimalType) else 1.0
+    out = {"n": n_g}
+    for k, p in enumerate(fn.percentages):
+        t = p * jnp.maximum(n_g.astype(jnp.float64) - 1.0, 0.0)
+        if op == "percentile":
+            lo = jnp.floor(t).astype(jnp.int64)
+            hi = jnp.ceil(t).astype(jnp.int64)
+            frac = t - lo.astype(jnp.float64)
+            v_lo = jnp.take(vals2, jnp.clip(starts.astype(jnp.int64) + lo,
+                                            0, capacity - 1)).astype(jnp.float64) * unscale
+            v_hi = jnp.take(vals2, jnp.clip(starts.astype(jnp.int64) + hi,
+                                            0, capacity - 1)).astype(jnp.float64) * unscale
+            out[f"p{k}"] = v_lo + (v_hi - v_lo) * frac
+        else:  # approx: nearest-rank, input-typed
+            r = jnp.round(t).astype(jnp.int64)
+            out[f"p{k}"] = jnp.take(vals2, jnp.clip(
+                starts.astype(jnp.int64) + r, 0, capacity - 1))
+    return out
+
+
+def _host_collect(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
+    """Arrow-assisted collect_set for string/nested inputs (value bits don't
+    exist on device); produces the same value-sorted-set layout."""
+    import pyarrow as pa
+    arr = col.to_arrow()  # original row domain
+    perm_np = np.asarray(perm)[:capacity]
+    seg_np = np.asarray(seg_ids)[:capacity]
+    vals = arr.to_pylist()
+    sets: Dict[int, list] = {}
+    for i in range(capacity):
+        row = int(perm_np[i])
+        if row >= num_rows:
+            continue
+        v = vals[row] if row < len(vals) else None
+        if v is None:
+            continue
+        sets.setdefault(int(seg_np[i]), []).append(v)
+    out_lists = []
+    for g in range(g_cap):
+        uniq = _dedup_values(sets.get(g, []))
+        try:
+            uniq = sorted(uniq)  # device parity: value-sorted sets
+        except TypeError:
+            pass  # nested elements: keep first-seen order
+        out_lists.append(uniq)
+    from ..types import to_arrow as type_to_arrow
+    list_arr = pa.array(out_lists, type=type_to_arrow(fn.dtype))
+    final = TpuColumnVector.from_arrow(list_arr)
+    return {"__final": final}
+
+
+def _segment_bloom(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
+    """Per-group bloom blobs (host bit math over device-hashed longs; the
+    reference's JNI BloomFilter kernel analogue). Empty group → null blob."""
+    import pyarrow as pa
+    mask_np = np.zeros(capacity, dtype=bool)
+    mask_np[:num_rows] = True
+    perm_np = np.asarray(perm)[:capacity]
+    seg_np = np.asarray(seg_ids)[:capacity]
+    valid = mask_np[perm_np]
+    if col.validity is not None:
+        valid &= np.asarray(col.validity)[perm_np]
+    vals = np.asarray(col.data)[perm_np].astype(np.int64)
+    # group rows once via a segment sort instead of one full scan per group
+    vv = vals[valid]
+    ss = seg_np[valid]
+    order = np.argsort(ss, kind="stable")
+    ss, vv = ss[order], vv[order]
+    bounds = np.searchsorted(ss, np.arange(g_cap + 1))
+    blobs: List[Optional[bytes]] = []
+    for g in range(g_cap):
+        lo, hi = bounds[g], bounds[g + 1]
+        blobs.append(fn.build(vv[lo:hi]) if hi > lo else None)
+    final = TpuColumnVector.from_arrow(pa.array(blobs, type=pa.binary()))
+    return {"__final": final}
+
+
+def _segment_covar(fn, cols, seg_ids, g_cap: int, capacity: int,
+                   num_rows: int, perm):
+    cx, cy = cols
+    mask = row_mask(num_rows, capacity)
+    vx = (cx.validity & mask) if cx.validity is not None else mask
+    vy = (cy.validity & mask) if cy.validity is not None else mask
+    pair = jnp.take(vx & vy, perm)
+    sx_scale = (10.0 ** -cx.dtype.scale) if isinstance(cx.dtype, DecimalType) else 1.0
+    sy_scale = (10.0 ** -cy.dtype.scale) if isinstance(cy.dtype, DecimalType) else 1.0
+    x = jnp.where(pair, jnp.take(cx.data, perm), 0).astype(jnp.float64) * sx_scale
+    y = jnp.where(pair, jnp.take(cy.data, perm), 0).astype(jnp.float64) * sy_scale
+    z = lambda: jnp.zeros((g_cap,), jnp.float64)
+    return {
+        "n": jnp.zeros((g_cap,), jnp.int64).at[seg_ids].add(
+            pair.astype(jnp.int64), mode="drop"),
+        "sx": z().at[seg_ids].add(x, mode="drop"),
+        "sy": z().at[seg_ids].add(y, mode="drop"),
+        "sxy": z().at[seg_ids].add(x * y, mode="drop"),
+        "sx2": z().at[seg_ids].add(x * x, mode="drop"),
+        "sy2": z().at[seg_ids].add(y * y, mode="drop"),
+    }
+
+
 def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
                   n_groups: int, cap: int) -> TpuColumnVector:
     gmask = row_mask(n_groups, cap)
@@ -495,6 +873,54 @@ def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
         var = jnp.maximum(var, 0.0)
         out = jnp.sqrt(var) if op.startswith("stddev") else var
         valid = ok & (n > 0) & gmask
+        return TpuColumnVector(DoubleT, jnp.where(valid, out, 0.0), valid, n_groups)
+    if "__final" in state:  # host-assembled column (e.g. string collect_set)
+        f = state["__final"]
+        from ..columnar.batch import _repad
+        if f.capacity < cap:
+            f = _repad(f, cap)
+        return TpuColumnVector(f.dtype, f.data, f.validity, n_groups,
+                               offsets=f.offsets, child=f.child,
+                               host_data=f.host_data, host_capacity=f.host_capacity)
+    if op in ("collect_list", "collect_set"):
+        child = state["__list_child"]
+        offsets = state["__list_offsets"]
+        return TpuColumnVector(fn.dtype, child.data, None, n_groups,
+                               offsets=offsets, child=child)
+    if op in ("percentile", "approx_percentile"):
+        n = state["n"]
+        valid = (n > 0) & gmask
+        ps = [state[f"p{k}"] for k in range(len(fn.percentages))]
+        if not fn.is_array:
+            data = jnp.where(valid, ps[0], jnp.zeros((), ps[0].dtype))
+            elem_t = DoubleT if op == "percentile" else fn.dtype
+            return TpuColumnVector(elem_t, data, valid, n_groups)
+        k = len(ps)
+        stacked = jnp.stack(ps, axis=1).reshape((cap * k,))  # row-major per group
+        elem_t = fn.dtype.element_type
+        child = TpuColumnVector(elem_t, stacked, None, n_groups * k)
+        offsets = (jnp.arange(cap + 1, dtype=jnp.int32) * k)
+        return TpuColumnVector(fn.dtype, child.data, valid, n_groups,
+                               offsets=offsets, child=child)
+    if op in ("covar_samp", "covar_pop", "corr"):
+        n = state["n"].astype(jnp.float64)
+        sx, sy = state["sx"], state["sy"]
+        sxy, sx2, sy2 = state["sxy"], state["sx2"], state["sy2"]
+        safe_n = jnp.where(n > 0, n, 1.0)
+        cov = sxy - sx * sy / safe_n
+        if op == "covar_pop":
+            valid = (state["n"] > 0) & gmask
+            out = cov / safe_n
+        elif op == "covar_samp":
+            valid = (state["n"] > 1) & gmask
+            out = cov / jnp.where(n > 1, n - 1.0, 1.0)
+        else:  # corr: null when n<2 or either variance is 0 (Spark divide-null);
+            # NaN inputs propagate as NaN (denom != 0 holds for NaN)
+            mx2 = sx2 - sx * sx / safe_n
+            my2 = sy2 - sy * sy / safe_n
+            denom = jnp.sqrt(jnp.maximum(mx2, 0.0) * jnp.maximum(my2, 0.0))
+            valid = (state["n"] > 1) & (denom != 0) & gmask
+            out = cov / jnp.where(denom != 0, denom, 1.0)
         return TpuColumnVector(DoubleT, jnp.where(valid, out, 0.0), valid, n_groups)
     raise NotImplementedError(op)
 
@@ -555,7 +981,11 @@ class TpuHashAggregateExec(TpuExec):
                     for g in self.grouping]
         in_cols: List[Optional[TpuColumnVector]] = []
         for fn in agg_fns:
-            if fn.children:
+            if len(fn.children) >= 2:
+                in_cols.append(tuple(
+                    to_column(c.eval_tpu(batch, ctx.eval_ctx), batch, c.dtype)
+                    for c in fn.children))
+            elif fn.children:
                 in_cols.append(to_column(fn.children[0].eval_tpu(batch, ctx.eval_ctx),
                                          batch, fn.children[0].dtype))
             else:
@@ -618,6 +1048,11 @@ class TpuHashAggregateExec(TpuExec):
         for fn in agg_fns:
             if isinstance(fn, Count):
                 cols.append(TpuColumnVector.from_numpy(LongT, np.zeros(1, np.int64)))
+            elif fn.update_op in ("collect_list", "collect_set"):
+                import pyarrow as pa
+                from ..types import to_arrow as type_to_arrow
+                cols.append(TpuColumnVector.from_arrow(
+                    pa.array([[]], type=type_to_arrow(fn.dtype))))
             else:
                 cols.append(TpuColumnVector.from_scalar(None, fn.dtype, 1))
         agg_batch = TpuColumnarBatch(cols, 1)

@@ -191,3 +191,122 @@ class CountDistinct(AggregateFunction):
 
     def state_fields(self):
         raise NotImplementedError("count distinct expands via grouped dedup")
+
+
+class CollectList(AggregateFunction):
+    """collect_list (reference GpuCollectList, aggregateFunctions.scala).
+    Returns [] (never null) for empty/all-null groups, like Spark."""
+
+    update_op = "collect_list"
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ArrayType
+        return ArrayType(self.child.dtype, contains_null=False)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def state_fields(self):
+        return [("list", self.dtype, "concat")]
+
+
+class CollectSet(AggregateFunction):
+    """collect_set (reference GpuCollectSet). Element order is unspecified —
+    the device impl yields value-sorted sets; wrap in sort_array for stable
+    comparisons (the reference's tests do the same)."""
+
+    update_op = "collect_set"
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ArrayType
+        return ArrayType(self.child.dtype, contains_null=False)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def state_fields(self):
+        return [("set", self.dtype, "union")]
+
+
+class Percentile(AggregateFunction):
+    """Exact percentile with linear interpolation (reference GpuPercentile.scala).
+    percentage is a literal double or list of doubles."""
+
+    update_op = "percentile"
+
+    def __init__(self, child: Expression, percentage):
+        super().__init__(child)
+        self.percentages = list(percentage) if isinstance(percentage, (list, tuple)) \
+            else [float(percentage)]
+        self.is_array = isinstance(percentage, (list, tuple))
+        for p in self.percentages:
+            if not (0.0 <= p <= 1.0):
+                raise ValueError("percentile must be in [0, 1]")
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ArrayType
+        return ArrayType(DoubleT, contains_null=False) if self.is_array else DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def pretty(self) -> str:
+        return f"percentile({self.child.pretty()}, {self.percentages})"
+
+
+class ApproximatePercentile(Percentile):
+    """approx_percentile (reference GpuApproximatePercentile.scala, t-digest).
+    Implemented exactly (nearest-rank on the full sorted data): exact answers
+    satisfy any accuracy bound; returns input-typed values like Spark."""
+
+    update_op = "approx_percentile"
+
+    def __init__(self, child: Expression, percentage, accuracy: int = 10000):
+        super().__init__(child, percentage)
+        self.accuracy = accuracy
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ArrayType
+        base = self.child.dtype
+        return ArrayType(base, contains_null=False) if self.is_array else base
+
+
+class _CovarianceBase(AggregateFunction):
+    """Two-input aggregates over (x, y); rows with any null are skipped
+    (reference GpuCovPopulation/GpuCovSample, aggregateFunctions.scala)."""
+
+    def __init__(self, x: Expression, y: Expression):
+        super().__init__(x, y)
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def state_fields(self):
+        return [("n", LongT, "sum"), ("sx", DoubleT, "sum"),
+                ("sy", DoubleT, "sum"), ("sxy", DoubleT, "sum"),
+                ("sx2", DoubleT, "sum"), ("sy2", DoubleT, "sum")]
+
+
+class CovSample(_CovarianceBase):
+    update_op = "covar_samp"
+
+
+class CovPopulation(_CovarianceBase):
+    update_op = "covar_pop"
+
+
+class Corr(_CovarianceBase):
+    """Pearson correlation (reference GpuPearsonCorrelation)."""
+    update_op = "corr"

@@ -152,6 +152,9 @@ def infer_literal_type(value: Any) -> DataType:
         return DoubleT
     if isinstance(value, str):
         return StringT
+    if isinstance(value, (bytes, bytearray)):
+        from ..types import BinaryT
+        return BinaryT
     if isinstance(value, _decimal.Decimal):
         sign, digits, exp = value.as_tuple()
         scale = max(0, -exp)

@@ -597,3 +597,48 @@ def schema_of_json(sample: str):
         return StringT
 
     return infer(_j.loads(sample))
+
+
+# --- collection / statistical aggregates (reference aggregateFunctions.scala,
+#     GpuPercentile.scala, GpuApproximatePercentile.scala)
+
+def collect_list(c) -> Column:
+    return Column(_G.CollectList(_expr_or_col(c)))
+
+
+def collect_set(c) -> Column:
+    return Column(_G.CollectSet(_expr_or_col(c)))
+
+
+def percentile(c, percentage) -> Column:
+    return Column(_G.Percentile(_expr_or_col(c), percentage))
+
+
+def percentile_approx(c, percentage, accuracy: int = 10000) -> Column:
+    return Column(_G.ApproximatePercentile(_expr_or_col(c), percentage, accuracy))
+
+
+approx_percentile = percentile_approx
+
+
+def covar_samp(x, y) -> Column:
+    return Column(_G.CovSample(_expr_or_col(x), _expr_or_col(y)))
+
+
+def covar_pop(x, y) -> Column:
+    return Column(_G.CovPopulation(_expr_or_col(x), _expr_or_col(y)))
+
+
+def corr(x, y) -> Column:
+    return Column(_G.Corr(_expr_or_col(x), _expr_or_col(y)))
+
+
+def bloom_filter_agg(c, estimated_items: int = 1_000_000,
+                     num_bits: int = 8_388_608) -> Column:
+    from .expressions.bloom import BloomFilterAggregate
+    return Column(BloomFilterAggregate(_expr_or_col(c), estimated_items, num_bits))
+
+
+def might_contain(bloom, value) -> Column:
+    from .expressions.bloom import BloomFilterMightContain
+    return Column(BloomFilterMightContain(_expr(bloom), _expr_or_col(value)))

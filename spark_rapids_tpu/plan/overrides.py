@@ -140,7 +140,10 @@ def _tag_aggregate(meta: PlanMeta) -> None:
     meta.add_exprs(p.grouping)
     agg_fns, result_exprs = split_result_exprs(p.aggregates)
     supported = {"sum", "count", "min", "max", "avg", "first", "last",
-                 "stddev_samp", "stddev_pop", "var_samp", "var_pop"}
+                 "stddev_samp", "stddev_pop", "var_samp", "var_pop",
+                 "collect_list", "collect_set", "percentile",
+                 "approx_percentile", "covar_samp", "covar_pop", "corr",
+                 "bloom_filter"}
     for fn in agg_fns:
         if fn.update_op not in supported:
             meta.will_not_work_on_tpu(

@@ -199,6 +199,10 @@ def _register_builtin_exprs() -> None:
     register_expr(J.JsonTuple, TypeSigs.STRING, "json_tuple generator",
                   host_assisted=True)
 
+    from ..expressions import bloom as BF
+    register_expr(BF.BloomFilterMightContain, TypeSigs.BOOLEAN,
+                  "bloom-filter membership probe", host_assisted=True)
+
     from .. import udf as U
     register_expr(U.TpuColumnarUDF, TypeSigs.all, "columnar device UDF (RapidsUDF)")
     register_expr(U.ArrowPandasUDF, TypeSigs.all, "arrow/pandas UDF",

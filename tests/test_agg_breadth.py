@@ -1,0 +1,204 @@
+"""Collection + statistical aggregates: collect_list/set, percentile,
+approx_percentile, covariance/correlation.
+
+Reference: integration_tests hash_aggregate_test.py collect/percentile cases.
+"""
+
+import pyarrow as pa
+import pytest
+
+from asserts import (assert_tpu_and_cpu_are_equal_collect, with_cpu_session,
+                     with_tpu_session)
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+
+
+def _df(s, n=80, seed=23, vgen=None):
+    return s.createDataFrame(gen_df(
+        [("k", IntegerGen(min_val=0, max_val=4, nullable=True)),
+         ("v", vgen or LongGen()),
+         ("w", DoubleGen())], n, seed))
+
+
+def test_collect_list():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("k").agg(
+            F.collect_list(F.col("v")).alias("l")),
+        ignore_order=True)
+
+
+def test_collect_list_strings():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, vgen=StringGen()).groupBy("k").agg(
+            F.collect_list(F.col("v")).alias("l")),
+        ignore_order=True)
+
+
+def test_collect_set_sorted():
+    # set order is unspecified; sort_array for a stable comparison
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, vgen=IntegerGen(min_val=0, max_val=9)).groupBy("k")
+        .agg(F.sort_array(F.collect_set(F.col("v"))).alias("st")),
+        ignore_order=True)
+
+
+def test_collect_set_strings():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, vgen=StringGen(nullable=True)).groupBy("k")
+        .agg(F.sort_array(F.collect_set(F.col("v"))).alias("st")),
+        ignore_order=True)
+
+
+def test_collect_global():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).agg(
+            F.sort_array(F.collect_set(F.col("k"))).alias("ks")))
+
+
+def test_percentile():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("k").agg(
+            F.percentile(F.col("v"), 0.5).alias("med"),
+            F.percentile(F.col("w"), 0.25).alias("q1")),
+        ignore_order=True, approx_float=True)
+
+
+def test_percentile_array():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("k").agg(
+            F.percentile(F.col("v"), [0.0, 0.5, 1.0]).alias("ps")),
+        ignore_order=True, approx_float=True)
+
+
+def test_approx_percentile():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("k").agg(
+            F.percentile_approx(F.col("v"), 0.5).alias("m"),
+            F.percentile_approx(F.col("v"), [0.1, 0.9]).alias("pq")),
+        ignore_order=True)
+
+
+def test_covariance_corr():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("k").agg(
+            F.covar_samp(F.col("v"), F.col("w")).alias("cs"),
+            F.covar_pop(F.col("v"), F.col("w")).alias("cp"),
+            F.corr(F.col("v"), F.col("w")).alias("r")),
+        ignore_order=True, approx_float=True)
+
+
+def test_covariance_global():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).agg(
+            F.covar_pop(F.col("v"), F.col("w")).alias("cp"),
+            F.corr(F.col("v"), F.col("w")).alias("r")),
+        approx_float=True)
+
+
+def test_corr_degenerate():
+    # constant column → zero variance → corr null; single pair → covar_samp null
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "k": pa.array([1, 1, 2]),
+            "x": pa.array([5.0, 5.0, 1.0]),
+            "y": pa.array([1.0, 2.0, 3.0])}))
+        return df.groupBy("k").agg(
+            F.corr(F.col("x"), F.col("y")).alias("r"),
+            F.covar_samp(F.col("x"), F.col("y")).alias("cs"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    rows = sorted(with_tpu_session(lambda s: q(s).collect()),
+                  key=lambda r: r["k"])
+    assert rows[0]["r"] is None      # zero variance in x
+    assert rows[1]["cs"] is None     # n == 1
+
+
+def test_collect_list_empty_groups():
+    # all-null group values → empty list, not null (Spark)
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "k": pa.array([1, 1, 2]),
+            "v": pa.array([None, None, 3], type=pa.int64())}))
+        return df.groupBy("k").agg(F.collect_list(F.col("v")).alias("l"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    rows = sorted(with_tpu_session(lambda s: q(s).collect()),
+                  key=lambda r: r["k"])
+    assert rows[0]["l"] == []
+
+
+def test_bloom_filter_agg_and_might_contain():
+    # build a bloom from one dataframe, probe membership from another
+    def run(sess_fn):
+        def inner(s):
+            df = s.createDataFrame(pa.table({
+                "v": pa.array([10, 20, 30, 40, None], type=pa.int64())}))
+            blob_row = df.agg(
+                F.bloom_filter_agg(F.col("v"), 100, 1024).alias("bf")).collect()
+            blob = blob_row[0]["bf"]
+            probe = s.createDataFrame(pa.table({
+                "x": pa.array([10, 11, 30, 999, None], type=pa.int64())}))
+            return probe.select(
+                F.col("x"),
+                F.might_contain(F.lit(blob), F.col("x")).alias("m")).collect()
+        return sess_fn(inner)
+    cpu = run(with_cpu_session)
+    tpu = run(with_tpu_session)
+    assert cpu == tpu
+    got = {r["x"]: r["m"] for r in tpu}
+    assert got[10] is True and got[30] is True  # no false negatives
+    assert got[None] is None
+
+
+def test_bloom_filter_empty_and_grouped():
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "k": pa.array([1, 1, 2]),
+            "v": pa.array([7, 8, None], type=pa.int64())}))
+        return df.groupBy("k").agg(
+            F.bloom_filter_agg(F.col("v"), 10, 256).alias("bf"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    rows = sorted(with_tpu_session(lambda s: q(s).collect()),
+                  key=lambda r: r["k"])
+    assert rows[0]["bf"] is not None
+    assert rows[1]["bf"] is None  # all-null group → null blob
+
+
+def test_percentile_covar_decimal():
+    import decimal
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "k": pa.array([1, 1, 1, 2]),
+            "d": pa.array([decimal.Decimal("1.50"), decimal.Decimal("2.50"),
+                           decimal.Decimal("3.50"), decimal.Decimal("9.25")],
+                          type=pa.decimal128(4, 2)),
+            "w": pa.array([1.0, 2.0, 3.0, 4.0])}))
+        return df.groupBy("k").agg(
+            F.percentile(F.col("d"), 0.5).alias("p"),
+            F.covar_pop(F.col("d"), F.col("w")).alias("cv"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True,
+                                         approx_float=True)
+    rows = sorted(with_tpu_session(lambda s: q(s).collect()),
+                  key=lambda r: r["k"])
+    assert abs(rows[0]["p"] - 2.5) < 1e-9
+
+
+def test_collect_set_nested():
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "k": pa.array([1, 1, 1, 2]),
+            "a": pa.array([[1, 2], [1, 2], [3], None],
+                          type=pa.list_(pa.int32()))}))
+        return df.groupBy("k").agg(F.collect_set(F.col("a")).alias("st"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_collect_set_float_semantics():
+    # NaNs dedup to one; -0.0 and 0.0 stay distinct (Java Double semantics)
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "v": pa.array([float("nan"), float("nan"), 1.0, -0.0, 0.0])}))
+        return df.agg(F.sort_array(F.collect_set(F.col("v"))).alias("st"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    st = rows[0]["st"]
+    assert len(st) == 4  # one NaN, -0.0, 0.0, 1.0
