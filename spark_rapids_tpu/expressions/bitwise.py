@@ -107,9 +107,9 @@ class BitwiseCount(UnaryExpression):
             if v is None:
                 return None
             return int(bin(v & (2 ** 64 - 1) if v < 0 else v).count("1"))
-        npv = v.to_numpy(zero_copy_only=False)
         width = v.type.bit_width
-        u = np.asarray(npv, dtype=f"int{width}").astype(f"uint{width}")
+        npv = v.fill_null(0).to_numpy(zero_copy_only=False)
+        u = np.asarray(npv).astype(f"int{width}").astype(f"uint{width}")
         counts = np.array([bin(int(x)).count("1") for x in u], dtype=np.int32)
         mask = np.asarray(v.is_null())
         return pa.array(counts, mask=mask)
@@ -162,11 +162,12 @@ class _ShiftBase(BinaryExpression):
         n = len(l) if l_arr else len(r)
         lm = np.asarray(l.is_null()) if l_arr else np.zeros(n, bool)
         rm = np.asarray(r.is_null()) if r_arr else np.zeros(n, bool)
-        ln = l.to_numpy(zero_copy_only=False) if l_arr else np.full(n, l)
-        rn = r.to_numpy(zero_copy_only=False) if r_arr else np.full(n, r)
+        lt = np.dtype(l.type.to_pandas_dtype()) if l_arr else np.int64
+        ln = l.fill_null(0).to_numpy(zero_copy_only=False).astype(lt) \
+            if l_arr else np.full(n, l, dtype=np.int64)
+        rn = r.fill_null(0).to_numpy(zero_copy_only=False).astype(np.int64) \
+            if r_arr else np.full(n, r, dtype=np.int64)
         mask = lm | rm
-        ln = np.where(mask, 0, ln)
-        rn = np.where(mask, 0, rn)
         out = self._np_shift(np.asarray(ln), np.asarray(rn))
         return pa.array(out, mask=mask)
 

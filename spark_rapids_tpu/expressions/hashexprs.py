@@ -325,3 +325,254 @@ def _np_murmur3_bytes(data: bytes, seed: np.uint32) -> np.uint32:
             signed = np.int8(data[t] if data[t] < 128 else data[t] - 256)
             h1 = _np_mix_h1(h1, _np_mix_k1(np.int32(signed).view(np.uint32)))
         return _np_fmix(h1, np.uint32(n))
+
+
+# ---------------------------------------------------------------------------
+# XxHash64 + HiveHash (reference HashFunctions.scala: GpuXxHash64, GpuHiveHash,
+# backed by the JNI Hash kernel). Host numpy implementations with Spark-exact
+# bit math; per-column seed chaining like murmur3 (null rows keep the seed).
+# ---------------------------------------------------------------------------
+
+_XP1 = np.uint64(0x9E3779B185EBCA87)
+_XP2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XP3 = np.uint64(0x165667B19E3779F9)
+_XP4 = np.uint64(0x85EBCA77C2B2AE63)
+_XP5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _xrotl(x, r):
+    r = np.uint64(r)
+    return (x << r) | (x >> (np.uint64(64) - r))
+
+
+def _xfmix(h):
+    h = h ^ (h >> np.uint64(33))
+    h = h * _XP2
+    h = h ^ (h >> np.uint64(29))
+    h = h * _XP3
+    return h ^ (h >> np.uint64(32))
+
+
+def np_xxhash64_int(v_i32, seed_u64):
+    """Spark XXH64.hashInt."""
+    h = seed_u64 + _XP5 + np.uint64(4)
+    u = (np.asarray(v_i32).astype(np.int64) & np.int64(0xFFFFFFFF)).astype(np.uint64)
+    h = h ^ (u * _XP1)
+    h = _xrotl(h, 23) * _XP2 + _XP3
+    return _xfmix(h)
+
+
+def np_xxhash64_long(v_i64, seed_u64):
+    """Spark XXH64.hashLong."""
+    h = seed_u64 + _XP5 + np.uint64(8)
+    u = np.asarray(v_i64).astype(np.uint64)
+    h = h ^ (_xrotl(u * _XP2, 31) * _XP1)
+    h = _xrotl(h, 27) * _XP1 + _XP4
+    return _xfmix(h)
+
+
+def _xx_round(acc, val):
+    acc = acc + val * _XP2
+    return _xrotl(acc, 31) * _XP1
+
+
+def np_xxhash64_bytes(data: bytes, seed: int) -> int:
+    """Spark XXH64.hashUnsafeBytes (standard XXH64)."""
+    with np.errstate(over="ignore"):
+        seed = np.uint64(seed)
+        n = len(data)
+        i = 0
+        if n >= 32:
+            v1 = seed + _XP1 + _XP2
+            v2 = seed + _XP2
+            v3 = seed + np.uint64(0)
+            v4 = seed - _XP1
+            while i <= n - 32:
+                v1 = _xx_round(v1, np.frombuffer(data, np.uint64, 1, i)[0])
+                v2 = _xx_round(v2, np.frombuffer(data, np.uint64, 1, i + 8)[0])
+                v3 = _xx_round(v3, np.frombuffer(data, np.uint64, 1, i + 16)[0])
+                v4 = _xx_round(v4, np.frombuffer(data, np.uint64, 1, i + 24)[0])
+                i += 32
+            h = (_xrotl(v1, 1) + _xrotl(v2, 7) + _xrotl(v3, 12)
+                 + _xrotl(v4, 18))
+            for v in (v1, v2, v3, v4):
+                h = (h ^ _xx_round(np.uint64(0), v)) * _XP1 + _XP4
+        else:
+            h = seed + _XP5
+        h = h + np.uint64(n)
+        while i <= n - 8:
+            h = h ^ (_xrotl(np.frombuffer(data, np.uint64, 1, i)[0] * _XP2, 31)
+                     * _XP1)
+            h = _xrotl(h, 27) * _XP1 + _XP4
+            i += 8
+        if i <= n - 4:
+            w = np.uint64(np.frombuffer(data, np.uint32, 1, i)[0])
+            h = h ^ (w * _XP1)
+            h = _xrotl(h, 23) * _XP2 + _XP3
+            i += 4
+        while i < n:
+            h = h ^ (np.uint64(data[i]) * _XP5)
+            h = _xrotl(h, 11) * _XP1
+            i += 1
+        return int(_xfmix(h))
+
+
+def _np_xxhash_col(dt: DataType, arr, seeds: np.ndarray) -> np.ndarray:
+    """One column pass: per-row updated uint64 seeds (nulls unchanged)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    from ..types import (BooleanType, ByteType, DateType, DoubleType,
+                         FloatType, IntegerType, LongType, ShortType,
+                         StringType, TimestampType)
+    a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    nulls = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False)).astype(bool)
+    with np.errstate(over="ignore"):
+        if isinstance(dt, StringType):
+            out = seeds.copy()
+            for i, s in enumerate(a.to_pylist()):
+                if s is not None:
+                    out[i] = np_xxhash64_bytes(s.encode(), seeds[i])
+            return out
+        vals = np.asarray(a.fill_null(0).to_numpy(zero_copy_only=False))
+        if isinstance(dt, (LongType, TimestampType)):
+            h = np_xxhash64_long(vals.astype(np.int64), seeds)
+        elif isinstance(dt, DoubleType):
+            v = np.where(vals == 0.0, 0.0, vals)  # -0.0 → 0.0
+            h = np_xxhash64_long(v.astype(np.float64).view(np.int64), seeds)
+        elif isinstance(dt, FloatType):
+            v = np.where(vals == 0.0, np.float32(0.0), vals.astype(np.float32))
+            h = np_xxhash64_int(v.view(np.int32), seeds)
+        elif isinstance(dt, BooleanType):
+            h = np_xxhash64_int(vals.astype(np.int32), seeds)
+        elif isinstance(dt, (ByteType, ShortType, IntegerType, DateType)):
+            h = np_xxhash64_int(vals.astype(np.int32), seeds)
+        else:
+            raise ExpressionError(f"xxhash64 of {dt} is not supported")
+    return np.where(nulls, seeds, h)
+
+
+class XxHash64(Expression):
+    """xxhash64(...) → long (reference GpuXxHash64, HashFunctions.scala)."""
+
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import LongT
+        return LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def _hash_arrays(self, vals, n):
+        out = np.full(n, np.uint64(self.seed), np.uint64)
+        for c, v in zip(self.children, vals):
+            out = _np_xxhash_col(c.dtype, v, out)
+        return out.view(np.int64)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = [c.eval_cpu(table, ctx) for c in self.children]
+        n = table.num_rows
+        vals = [v if isinstance(v, (pa.Array, pa.ChunkedArray))
+                else pa.array([v] * n) for v in vals]
+        return pa.array(self._hash_arrays(vals, n))
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import to_column
+        from ..types import LongT
+        import pyarrow as pa
+        cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
+                for c in self.children]
+        vals = [c.to_arrow() for c in cols]
+        h = self._hash_arrays(vals, batch.num_rows)
+        return TpuColumnVector.from_numpy(LongT, h,
+                                          capacity=batch.capacity)
+
+    def pretty(self) -> str:
+        return f"xxhash64({', '.join(c.pretty() for c in self.children)})"
+
+
+def _hive_hash_value(dt: DataType, v) -> int:
+    from ..types import (ArrayType, BooleanType, ByteType, DateType,
+                         DoubleType, FloatType, IntegerType, LongType,
+                         ShortType, StringType)
+    import struct as _struct
+    if v is None:
+        return 0
+    if isinstance(dt, BooleanType):
+        return 1 if v else 0
+    if isinstance(dt, (ByteType, ShortType, IntegerType, DateType)):
+        return int(v) if not hasattr(v, "toordinal") else \
+            (v - __import__("datetime").date(1970, 1, 1)).days
+    if isinstance(dt, LongType):
+        l = int(v) & 0xFFFFFFFFFFFFFFFF
+        return ((l >> 32) ^ l) & 0xFFFFFFFF
+    if isinstance(dt, FloatType):
+        f = np.float32(0.0) if v == 0.0 else np.float32(v)
+        return int(np.asarray(f).view(np.int32)) & 0xFFFFFFFF
+    if isinstance(dt, DoubleType):
+        d = 0.0 if v == 0.0 else float(v)
+        l = int(np.asarray(np.float64(d)).view(np.int64)) & 0xFFFFFFFFFFFFFFFF
+        return ((l >> 32) ^ l) & 0xFFFFFFFF
+    if isinstance(dt, StringType):
+        h = 0
+        for ch in v.encode("utf-8"):
+            h = (31 * h + (ch if ch < 128 else ch - 256)) & 0xFFFFFFFF
+        return h
+    if isinstance(dt, ArrayType):
+        h = 0
+        for x in v:
+            h = (31 * h + _hive_hash_value(dt.element_type, x)) & 0xFFFFFFFF
+        return h
+    raise ExpressionError(f"hive hash of {dt} is not supported")
+
+
+class HiveHash(Expression):
+    """hive-hash(...) → int (reference GpuHiveHash; Hive bucketing hash:
+    h = 31*h + fieldHash, Java int overflow)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def _hash_rows(self, cols_py, n):
+        out = np.zeros(n, np.int64)
+        for ri in range(n):
+            h = 0
+            for c, vals in zip(self.children, cols_py):
+                h = (31 * h + _hive_hash_value(c.dtype, vals[ri])) & 0xFFFFFFFF
+            out[ri] = h
+        return out.astype(np.uint32).view(np.int32).astype(np.int32)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = table.num_rows
+        cols_py = []
+        for c in self.children:
+            v = c.eval_cpu(table, ctx)
+            cols_py.append(v.to_pylist() if isinstance(v, (pa.Array, pa.ChunkedArray))
+                           else [v] * n)
+        return pa.array(self._hash_rows(cols_py, n), type=pa.int32())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import to_column
+        cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
+                for c in self.children]
+        cols_py = [c.to_arrow().to_pylist() for c in cols]
+        h = self._hash_rows(cols_py, batch.num_rows)
+        return TpuColumnVector.from_numpy(IntegerT, h.astype(np.int32),
+                                          capacity=batch.capacity)
+
+    def pretty(self) -> str:
+        return f"hive_hash({', '.join(c.pretty() for c in self.children)})"

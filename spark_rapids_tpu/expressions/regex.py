@@ -266,3 +266,55 @@ class Like(Expression):
         import pyarrow.compute as pc
         return pc.match_like(self.children[0].eval_cpu(table, ctx),
                              pattern=self.pattern)
+
+
+class RegexpExtractAll(Expression):
+    """regexp_extract_all(str, pattern, idx) → array<string>
+    (reference GpuRegExpExtractAll)."""
+
+    def __init__(self, child: Expression, pattern: str, group: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.group = group
+        self._transpiled = transpile(pattern)
+
+    tpu_supported = property(lambda self: self._transpiled is not None)  # type: ignore
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ArrayType
+        return ArrayType(StringT, contains_null=False)
+
+    def pretty(self) -> str:
+        return (f"regexp_extract_all({self.children[0].pretty()}, "
+                f"{self.pattern!r}, {self.group})")
+
+    def _extract(self, vals):
+        prog = _re.compile(self.pattern)
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            row = []
+            for m in prog.finditer(v):
+                g = m.group(self.group)
+                row.append(g if g is not None else "")
+            out.append(row)
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        out = pa.array(self._extract(arr.to_pylist()),
+                       pa.list_(pa.string()))
+        col = TpuColumnVector.from_arrow(out)
+        if col.capacity < batch.capacity:
+            from ..columnar.batch import _repad
+            col = _repad(col, batch.capacity)
+        return col
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._extract(vals), pa.list_(pa.string()))

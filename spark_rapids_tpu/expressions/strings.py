@@ -604,3 +604,326 @@ class StringTranslate(Expression):
         arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
         out = pa.array(self._compute_list(arr.to_pylist()), pa.string())
         return _string_result_from_arrow(out, batch)
+
+
+# ---------------------------------------------------------------------------
+# String breadth 2 (reference stringFunctions.scala: GpuConcatWs,
+# GpuStringSplit, GpuSubstringIndex, GpuOctetLength, GpuBitLength,
+# GpuFormatNumber, GpuConv, GpuStringToMap)
+# ---------------------------------------------------------------------------
+
+def _rows_of(x, n):
+    """Arrow array / scalar → python list of length n."""
+    import pyarrow as pa
+    if isinstance(x, pa.ChunkedArray):
+        x = x.combine_chunks()
+    if isinstance(x, pa.Array):
+        return x.to_pylist()
+    return [x] * n
+
+
+class _HostRowOp(Expression):
+    """Host-assisted op computed row-wise over python values (the pattern the
+    reference prices as incompat/host; Pallas ragged kernels are the upgrade
+    path). Subclasses define _row(vals...) and _out_arrow_type()."""
+
+    def _out_arrow_type(self):
+        from ..types import to_arrow
+        return to_arrow(self.dtype)
+
+    def _num_rows_cpu(self, table):
+        return table.num_rows
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = self._num_rows_cpu(table)
+        ins = [_rows_of(c.eval_cpu(table, ctx), n) for c in self.children]
+        return pa.array([self._row(*vals, ctx=ctx) for vals in zip(*ins)],
+                        type=self._out_arrow_type())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from ..columnar.vector import TpuScalar
+        n = batch.num_rows
+        ins = []
+        for c in self.children:
+            v = c.eval_tpu(batch, ctx)
+            ins.append([v.value] * n if isinstance(v, TpuScalar)
+                       else v.to_arrow().to_pylist())
+        out = pa.array([self._row(*vals, ctx=ctx) for vals in zip(*ins)],
+                       type=self._out_arrow_type())
+        col = TpuColumnVector.from_arrow(out)
+        if col.capacity < batch.capacity:
+            from ..columnar.batch import _repad
+            col = _repad(col, batch.capacity)
+        return col
+
+    def _row(self, *vals, ctx):
+        raise NotImplementedError
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, cols...): skips nulls; array<string> args are flattened;
+    null only when sep is null (reference GpuConcatWs)."""
+
+    def __init__(self, sep: Expression, *cols: Expression):
+        self.children = (sep,) + tuple(cols)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    @property
+    def nullable(self) -> bool:
+        return self.children[0].nullable
+
+    def _join(self, sep, parts):
+        if sep is None:
+            return None
+        flat = []
+        for p in parts:
+            if p is None:
+                continue
+            if isinstance(p, list):
+                flat.extend(x for x in p if x is not None)
+            else:
+                flat.append(p)
+        return sep.join(flat)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = table.num_rows
+        ins = [_rows_of(c.eval_cpu(table, ctx), n) for c in self.children]
+        return pa.array([self._join(vals[0], vals[1:]) for vals in zip(*ins)],
+                        type=pa.string())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from ..columnar.vector import TpuScalar
+        n = batch.num_rows
+        ins = []
+        for c in self.children:
+            v = c.eval_tpu(batch, ctx)
+            ins.append([v.value] * n if isinstance(v, TpuScalar)
+                       else v.to_arrow().to_pylist())
+        out = pa.array([self._join(vals[0], vals[1:]) for vals in zip(*ins)],
+                       type=pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def pretty(self) -> str:
+        return f"concat_ws({', '.join(c.pretty() for c in self.children)})"
+
+
+class StringSplit(_HostRowOp):
+    """split(str, javaRegex, limit) → array<string> (reference GpuStringSplit;
+    Java split semantics: limit=-1 keeps trailing empties, limit>0 caps parts)."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 limit: Expression = None):
+        from .base import Literal
+        if limit is None:
+            limit = Literal(-1)
+        self.children = (child, pattern, limit)
+        pat = pattern.value if isinstance(pattern, Literal) else None
+        from .regex import transpile
+        self._pat = None if pat is None else transpile(pat)
+
+    tpu_supported = True
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import ArrayType
+        return ArrayType(StringT, contains_null=False)
+
+    def _row(self, s, pat, limit, ctx):
+        import re as _re2
+        if s is None or pat is None:
+            return None
+        p = self._pat if self._pat is not None else pat
+        if limit is None:
+            limit = -1
+        if limit > 0:
+            return _re2.split(p, s, maxsplit=limit - 1)
+        parts = _re2.split(p, s)
+        if limit == 0:  # Java: drop trailing empty strings
+            while parts and parts[-1] == "":
+                parts.pop()
+        return parts
+
+    def pretty(self) -> str:
+        return f"split({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class SubstringIndex(_HostRowOp):
+    """substring_index(str, delim, count) (reference GpuSubstringIndex)."""
+
+    def __init__(self, child: Expression, delim: Expression, count: Expression):
+        self.children = (child, delim, count)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _row(self, s, delim, count, ctx):
+        if s is None or delim is None or count is None:
+            return None
+        if delim == "" or count == 0:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        return delim.join(parts[count:])
+
+
+class OctetLength(UnaryExpression):
+    """octet_length: UTF-8 byte count — pure device op on the offsets buffer."""
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        from ..columnar.vector import TpuScalar
+        if isinstance(c, TpuScalar):
+            v = None if c.value is None else len(c.value.encode("utf-8"))
+            return TpuScalar(IntegerT, v)
+        lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
+        valid = combine_validity(c.capacity, c.validity,
+                                 row_mask(batch.num_rows, c.capacity))
+        return make_column(IntegerT, lens, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.binary_length(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"octet_length({self.child.pretty()})"
+
+
+class BitLength(OctetLength):
+    """bit_length = octet_length * 8."""
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        r = super().eval_tpu(batch, ctx)
+        from ..columnar.vector import TpuScalar
+        if isinstance(r, TpuScalar):
+            return TpuScalar(IntegerT, None if r.value is None else r.value * 8)
+        return TpuColumnVector(IntegerT, r.data * 8, r.validity, r.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.multiply(super().eval_cpu(table, ctx), 8)
+
+    def pretty(self) -> str:
+        return f"bit_length({self.child.pretty()})"
+
+
+class FormatNumber(_HostRowOp):
+    """format_number(x, d): thousands separators + d decimals, HALF_EVEN like
+    Java DecimalFormat (reference GpuFormatNumber)."""
+
+    def __init__(self, child: Expression, d: Expression):
+        self.children = (child, d)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _row(self, x, d, ctx):
+        if x is None or d is None or d < 0:
+            return None
+        import decimal as _dec
+        if isinstance(x, float):
+            if x != x or x in (float("inf"), float("-inf")):
+                return None
+            q = _dec.Decimal(repr(x)).quantize(
+                _dec.Decimal(1).scaleb(-d), rounding=_dec.ROUND_HALF_EVEN)
+        else:
+            q = _dec.Decimal(x).quantize(
+                _dec.Decimal(1).scaleb(-d), rounding=_dec.ROUND_HALF_EVEN)
+        return f"{q:,.{d}f}"
+
+
+class Conv(_HostRowOp):
+    """conv(numStr, fromBase, toBase): Java NumberConverter semantics —
+    unsigned 64-bit wraparound, negative toBase → signed output, leading
+    digits parsed until the first invalid character (reference GpuConv)."""
+
+    def __init__(self, child: Expression, from_base: Expression,
+                 to_base: Expression):
+        self.children = (child, from_base, to_base)
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    _DIGITS = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+    def _row(self, s, fb, tb, ctx):
+        if s is None or fb is None or tb is None:
+            return None
+        if not (2 <= abs(fb) <= 36 and 2 <= abs(tb) <= 36):
+            return None
+        s = s.strip()
+        if not s:
+            return None
+        neg = s.startswith("-")
+        if neg:
+            s = s[1:]
+        val = 0
+        seen = False
+        for ch in s.upper():
+            d = self._DIGITS.find(ch)
+            if d < 0 or d >= abs(fb):
+                break
+            val = (val * abs(fb) + d) & 0xFFFFFFFFFFFFFFFF
+            seen = True
+        if not seen:
+            return "0"
+        if neg:
+            val = (-val) & 0xFFFFFFFFFFFFFFFF
+        if tb < 0:  # signed output
+            sval = val - (1 << 64) if val >= (1 << 63) else val
+            sign = "-" if sval < 0 else ""
+            sval = abs(sval)
+            base = abs(tb)
+        else:
+            sign = ""
+            sval = val
+            base = tb
+        if sval == 0:
+            return "0"
+        out = []
+        while sval:
+            out.append(self._DIGITS[sval % base])
+            sval //= base
+        return sign + "".join(reversed(out))
+
+
+class StringToMap(_HostRowOp):
+    """str_to_map(str, pairDelim=',', keyValueDelim=':')
+    (reference GpuStringToMap)."""
+
+    def __init__(self, child: Expression, pair_delim: Expression = None,
+                 kv_delim: Expression = None):
+        from .base import Literal
+        self.children = (child,
+                         pair_delim if pair_delim is not None else Literal(","),
+                         kv_delim if kv_delim is not None else Literal(":"))
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import MapType
+        return MapType(StringT, StringT)
+
+    def _row(self, s, pd, kd, ctx):
+        import re as _re2
+        if s is None or pd is None or kd is None:
+            return None
+        out = {}
+        for pair in _re2.split(pd, s):
+            kv = _re2.split(kd, pair, maxsplit=1)
+            # duplicate keys: LAST_WIN (Spark's non-exception dedup policy)
+            out[kv[0]] = kv[1] if len(kv) > 1 else None
+        return list(out.items())

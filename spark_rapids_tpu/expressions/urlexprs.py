@@ -1,0 +1,78 @@
+"""parse_url expression (reference GpuParseUrl.scala, JNI ParseURI kernel).
+
+Host-assisted via urllib.parse (the reference's kernel mirrors java.net.URI;
+urllib is slightly more lenient on malformed URLs — priced as incompat)."""
+
+from __future__ import annotations
+
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..types import DataType, StringT
+from .base import Expression, _DEFAULT_CTX
+from .strings import _HostRowOp
+
+
+_PARTS = {"HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE", "AUTHORITY",
+          "USERINFO"}
+
+
+def parse_url_part(url: Optional[str], part: Optional[str],
+                   key: Optional[str] = None) -> Optional[str]:
+    if url is None or part is None:
+        return None
+    if part not in _PARTS:
+        return None
+    try:
+        u = urlparse(url.strip())
+    except ValueError:
+        return None
+    if not u.scheme:
+        return None
+    if part == "PROTOCOL":
+        return u.scheme or None
+    if part == "HOST":
+        try:
+            return u.hostname
+        except ValueError:
+            return None
+    if part == "PATH":
+        return u.path
+    if part == "QUERY":
+        if not u.query:
+            return None
+        if key is None:
+            return u.query
+        vals = parse_qs(u.query, keep_blank_values=True).get(key)
+        return vals[0] if vals else None
+    if part == "REF":
+        return u.fragment or None
+    if part == "FILE":
+        return u.path + (f"?{u.query}" if u.query else "")
+    if part == "AUTHORITY":
+        return u.netloc or None
+    if part == "USERINFO":
+        if "@" not in u.netloc:
+            return None
+        return u.netloc.rsplit("@", 1)[0]
+    return None
+
+
+class ParseUrl(_HostRowOp):
+    """parse_url(url, part[, key]) → string."""
+
+    def __init__(self, url: Expression, part: Expression,
+                 key: Expression = None):
+        self.children = (url, part) + ((key,) if key is not None else ())
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def _row(self, *vals, ctx):
+        url, part = vals[0], vals[1]
+        key = vals[2] if len(vals) > 2 else None
+        return parse_url_part(url, part, key)
+
+    def pretty(self) -> str:
+        return f"parse_url({', '.join(c.pretty() for c in self.children)})"

@@ -642,3 +642,94 @@ def bloom_filter_agg(c, estimated_items: int = 1_000_000,
 def might_contain(bloom, value) -> Column:
     from .expressions.bloom import BloomFilterMightContain
     return Column(BloomFilterMightContain(_expr(bloom), _expr_or_col(value)))
+
+
+# --- string breadth 2 + hashes + url (reference stringFunctions.scala,
+#     HashFunctions.scala, GpuParseUrl.scala, bitwise.scala)
+
+def concat_ws(sep: str, *cols) -> Column:
+    from .expressions.strings import ConcatWs
+    return Column(ConcatWs(Literal(sep) if isinstance(sep, str) else _expr(sep),
+                           *[_expr_or_col(c) for c in cols]))
+
+
+def split(c, pattern: str, limit: int = -1) -> Column:
+    from .expressions.strings import StringSplit
+    return Column(StringSplit(_expr_or_col(c), Literal(pattern), Literal(limit)))
+
+
+def substring_index(c, delim: str, count: int) -> Column:
+    from .expressions.strings import SubstringIndex
+    return Column(SubstringIndex(_expr_or_col(c), Literal(delim), Literal(count)))
+
+
+def octet_length(c) -> Column:
+    from .expressions.strings import OctetLength
+    return Column(OctetLength(_expr_or_col(c)))
+
+
+def bit_length(c) -> Column:
+    from .expressions.strings import BitLength
+    return Column(BitLength(_expr_or_col(c)))
+
+
+def format_number(c, d: int) -> Column:
+    from .expressions.strings import FormatNumber
+    return Column(FormatNumber(_expr_or_col(c), Literal(d)))
+
+
+def conv(c, from_base: int, to_base: int) -> Column:
+    from .expressions.strings import Conv
+    return Column(Conv(_expr_or_col(c), Literal(from_base), Literal(to_base)))
+
+
+def str_to_map(c, pair_delim: str = ",", kv_delim: str = ":") -> Column:
+    from .expressions.strings import StringToMap
+    return Column(StringToMap(_expr_or_col(c), Literal(pair_delim),
+                              Literal(kv_delim)))
+
+
+def regexp_extract_all(c, pattern: str, idx: int = 1) -> Column:
+    from .expressions.regex import RegexpExtractAll
+    return Column(RegexpExtractAll(_expr_or_col(c), pattern, idx))
+
+
+def xxhash64(*cols) -> Column:
+    from .expressions.hashexprs import XxHash64
+    return Column(XxHash64(*[_expr_or_col(c) for c in cols]))
+
+
+def hive_hash(*cols) -> Column:
+    from .expressions.hashexprs import HiveHash
+    return Column(HiveHash(*[_expr_or_col(c) for c in cols]))
+
+
+def parse_url(c, part: str, key: str = None) -> Column:
+    from .expressions.urlexprs import ParseUrl
+    return Column(ParseUrl(_expr_or_col(c), Literal(part),
+                           Literal(key) if key is not None else None))
+
+
+def bitwise_not(c) -> Column:
+    from .expressions.bitwise import BitwiseNot
+    return Column(BitwiseNot(_expr_or_col(c)))
+
+
+def bit_count(c) -> Column:
+    from .expressions.bitwise import BitwiseCount
+    return Column(BitwiseCount(_expr_or_col(c)))
+
+
+def shiftleft(c, n: int) -> Column:
+    from .expressions.bitwise import ShiftLeft
+    return Column(ShiftLeft(_expr_or_col(c), Literal(n)))
+
+
+def shiftright(c, n: int) -> Column:
+    from .expressions.bitwise import ShiftRight
+    return Column(ShiftRight(_expr_or_col(c), Literal(n)))
+
+
+def shiftrightunsigned(c, n: int) -> Column:
+    from .expressions.bitwise import ShiftRightUnsigned
+    return Column(ShiftRightUnsigned(_expr_or_col(c), Literal(n)))

@@ -96,6 +96,10 @@ def _register_builtin_exprs() -> None:
                       f"math fn {cls.__name__.lower()}")
 
     register_expr(H.Murmur3Hash, TypeSigs.integral, "spark murmur3 hash")
+    register_expr(H.XxHash64, TypeSigs.integral, "spark xxhash64",
+                  host_assisted=True)
+    register_expr(H.HiveHash, TypeSigs.integral, "hive bucketing hash",
+                  host_assisted=True)
 
     from ..expressions import datetime as DT
     for cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Quarter, DT.DayOfWeek,
@@ -126,6 +130,26 @@ def _register_builtin_exprs() -> None:
                       f"string fn {cls.__name__.lower()}", host_assisted=True)
     register_expr(S.StringLocate, TypeSigs.integral, "locate/instr",
                   host_assisted=True)
+    register_expr(S.ConcatWs, TypeSigs.STRING, "concat_ws", host_assisted=True)
+    register_expr(S.StringSplit, TypeSigs.nested_common, "split to array",
+                  host_assisted=True)
+    register_expr(S.SubstringIndex, TypeSigs.STRING, "substring_index",
+                  host_assisted=True)
+    register_expr(S.OctetLength, TypeSigs.integral,
+                  "byte length (device offsets math)")
+    register_expr(S.BitLength, TypeSigs.integral,
+                  "bit length (device offsets math)")
+    register_expr(S.FormatNumber, TypeSigs.STRING, "format_number",
+                  host_assisted=True)
+    register_expr(S.Conv, TypeSigs.STRING, "base conversion",
+                  host_assisted=True)
+    register_expr(S.StringToMap, TypeSigs.nested_common, "str_to_map",
+                  host_assisted=True)
+
+    from ..expressions import urlexprs as URL
+    register_expr(URL.ParseUrl, TypeSigs.STRING, "parse_url",
+                  incompat="urllib leniency differs from java.net.URI",
+                  host_assisted=True)
 
     from ..expressions import regex as RX
     register_expr(RX.RLike, TypeSigs.BOOLEAN,
@@ -136,6 +160,8 @@ def _register_builtin_exprs() -> None:
     register_expr(RX.RegexpExtract, TypeSigs.STRING, "regex extract",
                   host_assisted=True)
     register_expr(RX.Like, TypeSigs.BOOLEAN, "SQL LIKE", host_assisted=True)
+    register_expr(RX.RegexpExtractAll, TypeSigs.nested_common,
+                  "regexp_extract_all", host_assisted=True)
 
     from ..expressions import collections as CL
     sig_nested = TypeSigs.nested_common

@@ -1,0 +1,186 @@
+"""String breadth 2 + hashes + parse_url + bitwise function tests.
+
+Reference: integration_tests string_test.py, hashing_test.py, url_test.py,
+cmp_test.py bitwise cases.
+"""
+
+import pyarrow as pa
+import pytest
+
+from asserts import (assert_tpu_and_cpu_are_equal_collect, with_cpu_session,
+                     with_tpu_session)
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+
+
+def _sdf(s, n=60, seed=31):
+    return s.createDataFrame(gen_df(
+        [("a", StringGen(nullable=True)), ("b", StringGen(nullable=True)),
+         ("x", IntegerGen()), ("y", LongGen()), ("d", DoubleGen())], n, seed))
+
+
+def test_concat_ws():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _sdf(s).select(
+            F.concat_ws("-", F.col("a"), F.col("b")).alias("c1"),
+            F.concat_ws("", F.col("a"), F.col("a")).alias("c2")))
+
+
+def test_split():
+    def q(s):
+        df = s.createDataFrame(pa.table({"v": pa.array(
+            ["a,b,c", "a,,c,", "", None, "nosep"])}))
+        return df.select(F.split(F.col("v"), ",").alias("p"),
+                         F.split(F.col("v"), ",", 2).alias("p2"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["p"] == ["a", "b", "c"]
+    assert rows[1]["p"] == ["a", "", "c", ""]   # limit -1 keeps trailing empty
+    assert rows[1]["p2"] == ["a", ",c,"]
+
+
+def test_substring_index():
+    def q(s):
+        df = s.createDataFrame(pa.table({"v": pa.array(
+            ["www.apache.org", "a.b", "nodot", None])}))
+        return df.select(
+            F.substring_index(F.col("v"), ".", 2).alias("p"),
+            F.substring_index(F.col("v"), ".", -1).alias("m"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["p"] == "www.apache"
+    assert rows[0]["m"] == "org"
+
+
+def test_octet_bit_length():
+    def q(s):
+        df = s.createDataFrame(pa.table({"v": pa.array(
+            ["abc", "", "héllo", None])}))
+        return df.select(F.octet_length(F.col("v")).alias("o"),
+                         F.bit_length(F.col("v")).alias("b"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["o"] == 3 and rows[0]["b"] == 24
+    assert rows[2]["o"] == 6  # é is 2 bytes
+
+
+def test_format_number():
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "v": pa.array([1234567.891, 0.5, -0.5, None, 2.5])}))
+        return df.select(F.format_number(F.col("v"), 2).alias("f"),
+                         F.format_number(F.col("v"), 0).alias("f0"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["f"] == "1,234,567.89"
+    assert rows[4]["f0"] == "2"  # HALF_EVEN
+
+
+def test_conv():
+    def q(s):
+        df = s.createDataFrame(pa.table({"v": pa.array(
+            ["100", "ff", "-10", "zz9", "", None])}))
+        return df.select(
+            F.conv(F.col("v"), 16, 10).alias("h2d"),
+            F.conv(F.col("v"), 10, 2).alias("d2b"),
+            F.conv(F.col("v"), 10, -16).alias("d2hs"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["h2d"] == "256"
+    assert rows[1]["h2d"] == "255"
+    assert rows[2]["d2hs"] == "-A"  # signed negative output
+
+
+def test_str_to_map():
+    def q(s):
+        df = s.createDataFrame(pa.table({"v": pa.array(
+            ["a:1,b:2", "a:1,a:3", "novalue", None])}))
+        return df.select(F.str_to_map(F.col("v")).alias("m"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert dict(rows[0]["m"]) == {"a": "1", "b": "2"}
+    assert dict(rows[1]["m"]) == {"a": "3"}  # LAST_WIN
+    assert dict(rows[2]["m"]) == {"novalue": None}
+
+
+def test_regexp_extract_all():
+    def q(s):
+        df = s.createDataFrame(pa.table({"v": pa.array(
+            ["a1b2c3", "xyz", "", None])}))
+        return df.select(
+            F.regexp_extract_all(F.col("v"), r"([a-z])(\d)", 2).alias("ds"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["ds"] == ["1", "2", "3"]
+    assert rows[1]["ds"] == []
+
+
+def test_xxhash64_hive_hash():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _sdf(s).select(
+            F.xxhash64(F.col("x"), F.col("y"), F.col("a")).alias("xx"),
+            F.hive_hash(F.col("x"), F.col("a"), F.col("d")).alias("hh")))
+
+
+def test_xxhash64_known_types():
+    # stability probe: same values must hash identically across sessions
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "l": pa.array([0, 1, -1, None], type=pa.int64()),
+            "i": pa.array([0, 1, -1, 2], type=pa.int32()),
+            "s": pa.array(["", "a", "hello world, this is a longer string!",
+                           None])}))
+        return df.select(F.xxhash64(F.col("l")).alias("hl"),
+                         F.xxhash64(F.col("i")).alias("hi"),
+                         F.xxhash64(F.col("s")).alias("hs"))
+    assert with_tpu_session(lambda s: q(s).collect()) == \
+        with_cpu_session(lambda s: q(s).collect())
+
+
+def test_parse_url():
+    def q(s):
+        df = s.createDataFrame(pa.table({"u": pa.array([
+            "http://user:pw@spark.apache.org:8080/path/p2?query=1&k=v#frag",
+            "https://example.com", "not a url", None])}))
+        return df.select(
+            F.parse_url(F.col("u"), "HOST").alias("host"),
+            F.parse_url(F.col("u"), "PROTOCOL").alias("proto"),
+            F.parse_url(F.col("u"), "PATH").alias("path"),
+            F.parse_url(F.col("u"), "QUERY").alias("q"),
+            F.parse_url(F.col("u"), "QUERY", "k").alias("qk"),
+            F.parse_url(F.col("u"), "REF").alias("ref"),
+            F.parse_url(F.col("u"), "USERINFO").alias("ui"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["host"] == "spark.apache.org"
+    assert rows[0]["qk"] == "v"
+    assert rows[0]["ui"] == "user:pw"
+    assert rows[1]["q"] is None
+
+
+def test_bitwise_functions():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _sdf(s).select(
+            (F.col("x") & F.lit(0xFF)).alias("band") if False else
+            F.bit_count(F.col("y")).alias("bc"),
+            F.bitwise_not(F.col("x")).alias("bn"),
+            F.shiftleft(F.col("x"), 3).alias("sl"),
+            F.shiftright(F.col("x"), 2).alias("sr"),
+            F.shiftrightunsigned(F.col("x"), 2).alias("sru")))
+
+
+def test_shift_mod_semantics():
+    # Java: shift distance taken mod bit-width
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "i": pa.array([1, -8], type=pa.int32()),
+            "l": pa.array([1, -8], type=pa.int64())}))
+        return df.select(F.shiftleft(F.col("i"), 33).alias("i33"),
+                         F.shiftleft(F.col("l"), 65).alias("l65"),
+                         F.shiftrightunsigned(F.col("i"), 1).alias("u1"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = with_tpu_session(lambda s: q(s).collect())
+    assert rows[0]["i33"] == 2       # 33 % 32 == 1
+    assert rows[0]["l65"] == 2       # 65 % 64 == 1
+    assert rows[1]["u1"] == 2147483644  # -8 >>> 1
