@@ -733,3 +733,16 @@ def shiftright(c, n: int) -> Column:
 def shiftrightunsigned(c, n: int) -> Column:
     from .expressions.bitwise import ShiftRightUnsigned
     return Column(ShiftRightUnsigned(_expr_or_col(c), Literal(n)))
+
+
+def interleave_bits(*cols) -> Column:
+    """Z-order clustering key: bit-interleave of integral columns (reference
+    zorder/GpuInterleaveBits.scala)."""
+    from .expressions.zorder import InterleaveBits
+    return Column(InterleaveBits([_expr_or_col(c) for c in cols]))
+
+
+def hilbert_index(num_bits: int, *cols) -> Column:
+    """Hilbert-curve clustering key (reference zorder/GpuHilbertLongIndex.scala)."""
+    from .expressions.zorder import HilbertLongIndex
+    return Column(HilbertLongIndex(num_bits, [_expr_or_col(c) for c in cols]))

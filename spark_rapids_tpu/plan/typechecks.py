@@ -229,6 +229,12 @@ def _register_builtin_exprs() -> None:
     register_expr(BF.BloomFilterMightContain, TypeSigs.BOOLEAN,
                   "bloom-filter membership probe", host_assisted=True)
 
+    from ..expressions import zorder as Z
+    register_expr(Z.InterleaveBits, TypeSigs.BINARY,
+                  "z-order bit interleave (delta OPTIMIZE ZORDER)")
+    register_expr(Z.HilbertLongIndex, TypeSigs.integral,
+                  "hilbert-curve clustering index")
+
     from .. import udf as U
     register_expr(U.TpuColumnarUDF, TypeSigs.all, "columnar device UDF (RapidsUDF)")
     register_expr(U.ArrowPandasUDF, TypeSigs.all, "arrow/pandas UDF",

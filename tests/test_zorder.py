@@ -1,0 +1,102 @@
+"""Z-order expression tests: interleave_bits / hilbert_index device-vs-host
+parity plus algorithmic properties (reference zorder/ + delta OPTIMIZE ZORDER)."""
+
+import numpy as np
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import IntegerGen, LongGen, ShortGen, gen_df
+
+import spark_rapids_tpu.functions as F
+
+
+def _df(s, gens, n=256, seed=7):
+    return s.createDataFrame(gen_df(gens, n, seed), num_partitions=1)
+
+
+def test_interleave_bits_int_parity():
+    gens = [("a", IntegerGen()), ("b", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.interleave_bits(F.col("a"), F.col("b")).alias("z")))
+
+
+def test_interleave_bits_three_cols():
+    gens = [("a", IntegerGen()), ("b", IntegerGen()), ("c", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.interleave_bits(F.col("a"), F.col("b"), F.col("c")).alias("z")))
+
+
+def test_interleave_bits_short():
+    gens = [("a", ShortGen()), ("b", ShortGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.interleave_bits(F.col("a"), F.col("b")).alias("z")))
+
+
+def test_interleave_bits_known_values(session):
+    # one column: interleave is the identity (big-endian bytes of the value)
+    df = session.createDataFrame({"a": np.array([0, 1, 0x01020304], np.int32)})
+    rows = df.select(F.interleave_bits(F.col("a")).alias("z")).collect()
+    assert rows[0]["z"] == b"\x00\x00\x00\x00"
+    assert rows[1]["z"] == b"\x00\x00\x00\x01"
+    assert rows[2]["z"] == b"\x01\x02\x03\x04"
+    # two columns, all-ones in one: alternating bits 0b10101010 = 0xAA
+    df2 = session.createDataFrame({"a": np.array([-1], np.int32),
+                                   "b": np.array([0], np.int32)})
+    rows = df2.select(F.interleave_bits(F.col("a"), F.col("b")).alias("z")).collect()
+    assert rows[0]["z"] == b"\xaa" * 8
+
+
+def test_hilbert_index_parity():
+    gens = [("a", IntegerGen(min_val=0, max_val=1023)),
+            ("b", IntegerGen(min_val=0, max_val=1023))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, gens).select(
+            F.hilbert_index(10, F.col("a"), F.col("b")).alias("h")))
+
+
+def test_hilbert_index_is_bijective_2d(session):
+    # 8x8 grid with 3 bits per axis: distances must be a permutation of 0..63
+    xs, ys = np.meshgrid(np.arange(8, dtype=np.int32),
+                         np.arange(8, dtype=np.int32))
+    df = session.createDataFrame({"x": xs.ravel(), "y": ys.ravel()})
+    rows = df.select(F.hilbert_index(3, F.col("x"), F.col("y")).alias("h")).collect()
+    dists = sorted(r["h"] for r in rows)
+    assert dists == list(range(64))
+
+
+def test_hilbert_index_locality(session):
+    # Hilbert property: consecutive distances are adjacent grid cells.
+    xs, ys = np.meshgrid(np.arange(16, dtype=np.int32),
+                         np.arange(16, dtype=np.int32))
+    df = session.createDataFrame({"x": xs.ravel(), "y": ys.ravel()})
+    rows = df.select(F.col("x"), F.col("y"),
+                     F.hilbert_index(4, F.col("x"), F.col("y")).alias("h")).collect()
+    by_dist = sorted(rows, key=lambda r: r["h"])
+    for prev, cur in zip(by_dist, by_dist[1:]):
+        step = abs(prev["x"] - cur["x"]) + abs(prev["y"] - cur["y"])
+        assert step == 1, f"non-adjacent hop at h={cur['h']}"
+
+
+def test_hilbert_num_bits_cap():
+    from spark_rapids_tpu.expressions.zorder import HilbertLongIndex
+    from spark_rapids_tpu.expressions.base import Literal
+    with pytest.raises(ValueError):
+        HilbertLongIndex(33, [Literal(1), Literal(2)])
+    with pytest.raises(ValueError):
+        HilbertLongIndex(0, [Literal(1)])
+    with pytest.raises(ValueError):
+        HilbertLongIndex(40, [Literal(1)])
+
+
+def test_interleave_bits_rejects_mixed_and_nonintegral(session):
+    import numpy as np
+    df = session.createDataFrame({"i": np.array([1], np.int32),
+                                  "l": np.array([1], np.int64),
+                                  "d": np.array([1.5], np.float64)})
+    with pytest.raises(TypeError, match="one integral type"):
+        df.select(F.interleave_bits(F.col("i"), F.col("l")).alias("z")).collect()
+    with pytest.raises(TypeError, match="integral columns"):
+        df.select(F.interleave_bits(F.col("d")).alias("z")).collect()
