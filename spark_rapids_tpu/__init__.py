@@ -15,3 +15,4 @@ _jax.config.update("jax_enable_x64", True)
 
 from .session import Column, DataFrame, TpuSession, get_session  # noqa: F401
 from .config import RapidsConf, default_conf  # noqa: F401
+from .io.delta import DeltaTable  # noqa: F401,E402

@@ -1,11 +1,22 @@
-"""Delta Lake table provider: transaction-log snapshot → parquet scan.
+"""Delta Lake provider: snapshot read, transactional writes, and table commands.
 
-Reference: delta-lake/ (35k LoC across versions) + DeltaProvider interface
-(sql-plugin/.../delta/DeltaProvider.scala). Round-1 scope: read path — replay
-the _delta_log (JSON commits + parquet checkpoints) into the current snapshot's
-add-file set, surface partition values as columns, and hand the file list to
-the standard TPU parquet scan. Deletion vectors and the write path
-(MERGE/UPDATE/DELETE/OPTIMIZE) are tracked for a later round.
+Reference: delta-lake/ (35k LoC across delta versions) + the DeltaProvider
+interface (sql-plugin/.../delta/DeltaProvider.scala). Coverage here:
+  * read: _delta_log replay (JSON commits + parquet checkpoints), partition
+    columns from the log, deletion-vector row filtering, time travel
+    (versionAsOf), per-file stats pruning hooks.
+  * write: append/overwrite with per-file stats (GpuStatisticsCollection
+    analogue), dynamic partitioning, first-commit protocol+metadata.
+  * commands (DeltaTable): DELETE / UPDATE (copy-on-write rewrite of matched
+    files, or deletion-vector write when `delta.enableDeletionVectors` is set),
+    MERGE INTO (join-based, reference GpuRapidsProcessDeltaMergeJoinExec),
+    OPTIMIZE compaction + ZORDER BY (zorder/ expressions), VACUUM, history.
+
+Design notes vs the reference: the reference patches each Delta version's
+command classes to swap GPU scans/writes into Delta's own transaction code;
+here the transaction protocol is implemented directly (delta_log.py) and the
+data movement runs through our TPU plan stack — session DataFrames built over
+per-file scans, so filters/joins/projections execute on device.
 """
 
 from __future__ import annotations
@@ -13,15 +24,24 @@ from __future__ import annotations
 import glob
 import json
 import os
+import time
 from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .delta_dv import DeletionVectorDescriptor, write_dv_file
+from .delta_log import DeltaLog, collect_stats, delta_to_type
 
 
 class DeltaSnapshot:
-    def __init__(self, table_path: str):
+    def __init__(self, table_path: str, version: Optional[int] = None):
         self.table_path = table_path
         self.files: Dict[str, dict] = {}
         self.metadata: Optional[dict] = None
+        self.protocol: Optional[dict] = None
+        self.tombstones: Dict[str, dict] = {}  # unexpired remove actions
         self.version = -1
+        self._max_version = version
         self._load()
 
     def _log_dir(self) -> str:
@@ -33,15 +53,21 @@ class DeltaSnapshot:
             raise FileNotFoundError(f"not a delta table: {self.table_path}")
         # checkpoint (parquet) then incremental JSON commits after it
         checkpoints = sorted(glob.glob(os.path.join(log_dir, "*.checkpoint.parquet")))
+        if self._max_version is not None:
+            checkpoints = [c for c in checkpoints
+                           if int(os.path.basename(c).split(".")[0]) <= self._max_version]
         start_version = -1
         if checkpoints:
             cp = checkpoints[-1]
             start_version = int(os.path.basename(cp).split(".")[0])
             self._apply_checkpoint(cp)
+            self.version = start_version
         for commit in sorted(glob.glob(os.path.join(log_dir, "*.json"))):
             v = int(os.path.basename(commit).split(".")[0])
             if v <= start_version:
                 continue
+            if self._max_version is not None and v > self._max_version:
+                break
             with open(commit) as f:
                 for line in f:
                     if line.strip():
@@ -51,22 +77,37 @@ class DeltaSnapshot:
     def _apply_checkpoint(self, path: str) -> None:
         import pyarrow.parquet as pq
         t = pq.read_table(path)
+
+        def fix(d):  # arrow map columns come back as key/value pair lists
+            if isinstance(d, dict):
+                return {k: fix(v) for k, v in d.items() if v is not None}
+            if isinstance(d, list) and d and isinstance(d[0], tuple):
+                return dict(d)
+            return d
+
         for row in t.to_pylist():
             if row.get("add"):
-                self._apply_action({"add": row["add"]})
+                self._apply_action({"add": fix(row["add"])})
             elif row.get("remove"):
-                self._apply_action({"remove": row["remove"]})
+                self._apply_action({"remove": fix(row["remove"])})
             elif row.get("metaData"):
-                self._apply_action({"metaData": row["metaData"]})
+                self._apply_action({"metaData": fix(row["metaData"])})
+            elif row.get("protocol"):
+                self._apply_action({"protocol": fix(row["protocol"])})
 
     def _apply_action(self, action: dict) -> None:
         if "add" in action and action["add"]:
             a = action["add"]
             self.files[a["path"]] = a
+            self.tombstones.pop(a["path"], None)
         elif "remove" in action and action["remove"]:
-            self.files.pop(action["remove"]["path"], None)
+            r = action["remove"]
+            self.files.pop(r["path"], None)
+            self.tombstones[r["path"]] = r
         elif "metaData" in action and action["metaData"]:
             self.metadata = action["metaData"]
+        elif "protocol" in action and action["protocol"]:
+            self.protocol = action["protocol"]
 
     def data_files(self) -> List[str]:
         return [os.path.join(self.table_path, p) for p in sorted(self.files)]
@@ -79,41 +120,674 @@ class DeltaSnapshot:
             return list(cols or [])
         return []
 
+    def configuration(self) -> dict:
+        return (self.metadata or {}).get("configuration") or {}
+
+    def schema(self):
+        """Table schema from metaData.schemaString → StructType, or None."""
+        if self.metadata and self.metadata.get("schemaString"):
+            return delta_to_type(json.loads(self.metadata["schemaString"]))
+        return None
+
     def partition_values(self) -> Dict[str, Dict[str, Optional[str]]]:
         return {os.path.join(self.table_path, p): (a.get("partitionValues") or {})
                 for p, a in self.files.items()}
 
+    def deletion_vectors(self) -> Dict[str, np.ndarray]:
+        """abs file path → sorted uint64 deleted-row indexes, for files that
+        carry a deletionVector descriptor."""
+        out: Dict[str, np.ndarray] = {}
+        for p, a in self.files.items():
+            dv = a.get("deletionVector")
+            if dv:
+                desc = DeletionVectorDescriptor.from_json(dv)
+                out[os.path.join(self.table_path, p)] = desc.read_rows(self.table_path)
+        return out
 
-def read_delta(session, path: str):
+    def file_stats(self) -> Dict[str, dict]:
+        out = {}
+        for p, a in self.files.items():
+            s = a.get("stats")
+            if s:
+                try:
+                    out[os.path.join(self.table_path, p)] = json.loads(s)
+                except (TypeError, ValueError):
+                    pass
+        return out
+
+
+def read_delta(session, path: str, version: Optional[int] = None):
     """Build a DataFrame over the snapshot. Partition columns (hive-style,
-    stored in the log not the files) are attached as literal columns per file."""
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-    from ..plan.logical import FileScan, LocalRelation, Union
+    stored in the log not the files) are attached as literal columns per file;
+    deletion vectors become per-file row masks applied before device upload;
+    per-file min/max stats ride along for scan-time pruning."""
+    from ..plan.logical import FileScan
     from ..session import DataFrame
+    from ..types import StructType
 
-    snap = DeltaSnapshot(path)
+    snap = DeltaSnapshot(path, version=version)
     files = snap.data_files()
     if not files:
-        raise FileNotFoundError(f"delta table {path} has no data files")
+        # empty table: zero-row relation with the declared schema
+        import pyarrow as pa
+        from ..plan.logical import LocalRelation
+        from ..types import to_arrow
+        st = snap.schema()
+        if st is None:
+            raise FileNotFoundError(f"delta table {path} has no data files")
+        schema = pa.schema([(f.name, to_arrow(f.data_type)) for f in st.fields])
+        return DataFrame(LocalRelation(schema.empty_table(), 1), session)
     part_cols = snap.partition_columns()
+    dvs = snap.deletion_vectors()
+    stats = snap.file_stats()
+
+    def scan_options():
+        opts = {}
+        if dvs:
+            opts["__dv_rows__"] = dvs
+        if stats:
+            opts["__file_stats__"] = stats
+        return opts
+
     if not part_cols:
-        return DataFrame(FileScan(files, "parquet"), session)
+        return DataFrame(FileScan(files, "parquet", options=scan_options()),
+                         session)
     # group files by partition values; one scan per partition combo with
     # the partition columns projected in as literals
     import spark_rapids_tpu.functions as F
+    from ..expressions.cast import Cast
+    st = snap.schema()
+    part_types = {f.name: f.data_type for f in st.fields} if st else {}
     pvals = snap.partition_values()
     groups: Dict[Tuple, List[str]] = {}
     for f in files:
         key = tuple(pvals[f].get(c) for c in part_cols)
         groups.setdefault(key, []).append(f)
     dfs = []
-    for key, fs in sorted(groups.items()):
-        df = DataFrame(FileScan(fs, "parquet"), session)
+    for key, fs in sorted(groups.items(), key=lambda kv: tuple(map(str, kv[0]))):
+        df = DataFrame(FileScan(fs, "parquet", options=scan_options()), session)
         for c, v in zip(part_cols, key):
-            df = df.withColumn(c, F.lit(v))
+            col = F.lit(v)
+            if c in part_types and v is not None:
+                col = F.Column(Cast(F._expr_or_col(col), part_types[c]))
+            df = df.withColumn(c, col)
         dfs.append(df)
     out = dfs[0]
     for d in dfs[1:]:
         out = out.union(d)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Write path
+# ---------------------------------------------------------------------------
+
+def write_delta(df, path: str, mode: str, partition_by: List[str],
+                options: Optional[dict] = None) -> None:
+    """df.write.format("delta").save(path): parquet files + one commit."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from ..types import from_arrow, StructField, StructType
+
+    log = DeltaLog(path)
+    exists = log.exists() and log.latest_version() >= 0
+    mode = mode.lower()
+    if exists and mode == "errorifexists":
+        raise FileExistsError(f"delta table {path} exists (mode=errorifexists)")
+    if exists and mode == "ignore":
+        return
+
+    table = df.to_arrow()
+    st = StructType([StructField(f.name, from_arrow(f.type), f.nullable)
+                     for f in table.schema])
+    os.makedirs(path, exist_ok=True)
+    actions: List[dict] = []
+    snap = DeltaSnapshot(path) if exists else None
+    dv_enabled = str(dict(options or {}).get("delta.enableDeletionVectors", "")
+                     ).lower() == "true"
+    if not exists:
+        actions.append(log.protocol_action(dvs=dv_enabled))
+        actions.append(log.metadata_action(st, partition_by,
+                                           configuration=dict(options or {})))
+    elif mode == "overwrite":
+        for p, a in snap.files.items():
+            actions.append(log.remove_action(p, partition_values=a.get("partitionValues")))
+    elif mode != "append":
+        raise ValueError(f"bad delta write mode {mode}")
+
+    if exists and partition_by and partition_by != snap.partition_columns():
+        raise ValueError(
+            f"partitionBy {partition_by} conflicts with the table's partition "
+            f"columns {snap.partition_columns()}")
+    part_cols = partition_by or (snap.partition_columns() if snap else [])
+    ts = int(time.time() * 1000)
+    if part_cols:
+        actions += _write_partitioned(log, path, table, part_cols, ts)
+    else:
+        rel = _data_file_name(ts)
+        fp = os.path.join(path, rel)
+        pq.write_table(table, fp, compression="snappy")
+        actions.append(log.add_action(rel, os.path.getsize(fp),
+                                      collect_stats(table)))
+    actions.append(log.commit_info_action(
+        "WRITE", {"mode": mode.capitalize(), "partitionBy": json.dumps(part_cols)}))
+    log.commit(actions)
+
+
+def _data_file_name(ts: int) -> str:
+    import uuid as _uuid
+    return f"part-00000-{ts}-{_uuid.uuid4().hex[:12]}.snappy.parquet"
+
+
+def _write_partitioned(log: DeltaLog, path: str, table, part_cols: List[str],
+                       ts: int) -> List[dict]:
+    import pyarrow.parquet as pq
+    from .layout import iter_hive_partitions
+    actions = []
+    for pvals, subdir, sub in iter_hive_partitions(table, part_cols):
+        os.makedirs(os.path.join(path, subdir), exist_ok=True)
+        rel = f"{subdir}/{_data_file_name(ts)}"
+        fp = os.path.join(path, rel)
+        pq.write_table(sub, fp, compression="snappy")
+        actions.append(log.add_action(rel, os.path.getsize(fp),
+                                      collect_stats(sub), pvals))
+    return actions
+
+
+# ---------------------------------------------------------------------------
+# DeltaTable command API
+# ---------------------------------------------------------------------------
+
+class DeltaMergeBuilder:
+    """merge(source, cond) fluent builder (reference MergeIntoCommandMeta /
+    GpuRapidsProcessDeltaMergeJoinExec: the merge is executed as a join)."""
+
+    def __init__(self, table: "DeltaTable", source, condition):
+        self._table = table
+        self._source = source
+        self._condition = condition
+        self._matched: List[tuple] = []      # ("update"|"delete", cond, set)
+        self._not_matched: List[tuple] = []  # ("insert", cond, values)
+
+    def whenMatchedUpdate(self, condition=None, set: Optional[dict] = None):
+        self._matched.append(("update", condition, set or {}))
+        return self
+
+    def whenMatchedUpdateAll(self, condition=None):
+        self._matched.append(("update_all", condition, None))
+        return self
+
+    def whenMatchedDelete(self, condition=None):
+        self._matched.append(("delete", condition, None))
+        return self
+
+    def whenNotMatchedInsert(self, condition=None, values: Optional[dict] = None):
+        self._not_matched.append(("insert", condition, values or {}))
+        return self
+
+    def whenNotMatchedInsertAll(self, condition=None):
+        self._not_matched.append(("insert_all", condition, None))
+        return self
+
+    def execute(self) -> None:
+        self._table._run_merge(self)
+
+
+class DeltaOptimizeBuilder:
+    def __init__(self, table: "DeltaTable"):
+        self._table = table
+
+    def executeCompaction(self) -> None:
+        self._table._optimize(zorder_cols=None)
+
+    def executeZOrderBy(self, *cols: str) -> None:
+        self._table._optimize(zorder_cols=list(cols))
+
+
+class DeltaTable:
+    """deltalake DeltaTable analogue executing through the TPU plan stack."""
+
+    def __init__(self, session, path: str):
+        self.session = session
+        self.path = path
+        if not DeltaLog(path).exists():
+            raise FileNotFoundError(f"not a delta table: {path}")
+
+    forPath = staticmethod(lambda session, path: DeltaTable(session, path))
+
+    def toDF(self):
+        return read_delta(self.session, self.path)
+
+    def history(self) -> List[dict]:
+        out = []
+        log_dir = os.path.join(self.path, "_delta_log")
+        for commit in sorted(glob.glob(os.path.join(log_dir, "*.json")), reverse=True):
+            v = int(os.path.basename(commit).split(".")[0])
+            with open(commit) as f:
+                for line in f:
+                    if line.strip():
+                        a = json.loads(line)
+                        if "commitInfo" in a:
+                            out.append({"version": v, **a["commitInfo"]})
+        return out
+
+    # -- DELETE / UPDATE ---------------------------------------------------
+    def _dv_enabled(self, snap: DeltaSnapshot) -> bool:
+        return str(snap.configuration().get("delta.enableDeletionVectors", "")
+                   ).lower() == "true"
+
+    def delete(self, condition=None) -> None:
+        """DELETE FROM t WHERE cond. Copy-on-write rewrite of files containing
+        matches; with delta.enableDeletionVectors=true, writes a deletion
+        vector per touched file instead of rewriting the data."""
+        self._mutate("DELETE", condition, set_exprs=None)
+
+    def update(self, condition=None, set: Optional[dict] = None) -> None:
+        """UPDATE t SET ... WHERE cond (always copy-on-write)."""
+        if not set:
+            raise ValueError("update() requires set={col: Column/value}")
+        self._mutate("UPDATE", condition, set_exprs=set)
+
+    def _mutate(self, op: str, condition, set_exprs: Optional[dict]) -> None:
+        import pyarrow.parquet as pq
+        import spark_rapids_tpu.functions as F
+        from ..plan.logical import FileScan
+        from ..session import Column, DataFrame
+
+        snap = DeltaSnapshot(self.path)
+        log = DeltaLog(self.path)
+        cond_col = _as_condition(condition)
+        part_cols = snap.partition_columns()
+        if set_exprs and set(set_exprs) & set(part_cols):
+            raise ValueError(
+                f"UPDATE of partition columns {sorted(set(set_exprs) & set(part_cols))} "
+                "is not supported; rewrite via merge/overwrite instead")
+        pvals = snap.partition_values()
+        dvs = snap.deletion_vectors()
+        use_dv = op == "DELETE" and self._dv_enabled(snap)
+        actions: List[dict] = []
+        ts = int(time.time() * 1000)
+        n = 0
+        for rel, add in sorted(snap.files.items()):
+            fp = os.path.join(self.path, rel)
+            df = DataFrame(FileScan([fp], "parquet"), self.session)
+            parts = pvals.get(fp) or {}
+            for c in part_cols:  # partition columns live in the log, not the file
+                df = df.withColumn(c, F.lit(_cast_part(parts.get(c), c, snap)))
+            cond = cond_col if cond_col is not None else F.lit(True)
+            # rows where cond is exactly TRUE are affected (Spark semantics)
+            hit = Column(_is_true(cond._expr))
+            marked = df.withColumn("__hit__", hit)
+            table = marked.to_arrow()
+            hits = np.asarray(table.column("__hit__").to_numpy(zero_copy_only=False),
+                              dtype=bool)
+            existing_dv = dvs.get(fp)
+            if existing_dv is not None:
+                keep_mask = np.ones(len(hits), dtype=bool)
+                keep_mask[existing_dv.astype(np.int64)] = False
+                hits = hits & keep_mask  # already-deleted rows can't match again
+            if not hits.any():
+                continue
+            n += int(hits.sum())
+            if use_dv:
+                all_deleted = np.flatnonzero(hits)
+                if existing_dv is not None:
+                    all_deleted = np.union1d(all_deleted,
+                                             existing_dv.astype(np.int64))
+                desc = write_dv_file(self.path, all_deleted)
+                actions.append(log.remove_action(rel, partition_values=add.get("partitionValues")))
+                new_add = dict(add)
+                new_add["deletionVector"] = desc.to_json()
+                new_add["modificationTime"] = ts
+                actions.append({"add": new_add})
+                continue
+            # copy-on-write rewrite
+            data = table.drop_columns(["__hit__"] + [c for c in part_cols
+                                                     if c in table.column_names])
+            if existing_dv is not None:
+                live = np.ones(len(hits), dtype=bool)
+                live[existing_dv.astype(np.int64)] = False
+            else:
+                live = np.ones(len(hits), dtype=bool)
+            if op == "DELETE":
+                out = data.filter(live & ~hits)
+            else:  # UPDATE: apply set exprs to hit rows
+                upd_df = marked
+                for name, val in (set_exprs or {}).items():
+                    val_col = val if isinstance(val, Column) else F.lit(val)
+                    upd_df = upd_df.withColumn(
+                        name, F.when(Column(F._expr_or_col(F.col("__hit__"))),
+                                     val_col).otherwise(F.col(name)))
+                out = upd_df.to_arrow().drop_columns(
+                    ["__hit__"] + [c for c in part_cols if c in table.column_names])
+                out = out.filter(live)
+            actions.append(log.remove_action(rel, partition_values=add.get("partitionValues")))
+            if out.num_rows:
+                new_rel = _sibling_name(rel, ts)
+                new_fp = os.path.join(self.path, new_rel)
+                os.makedirs(os.path.dirname(new_fp), exist_ok=True)
+                pq.write_table(out, new_fp, compression="snappy")
+                actions.append(log.add_action(new_rel, os.path.getsize(new_fp),
+                                              collect_stats(out),
+                                              add.get("partitionValues")))
+        if actions:
+            actions.append(log.commit_info_action(op, {"numAffectedRows": n}))
+            log.commit(actions)
+
+    # -- MERGE -------------------------------------------------------------
+    def merge(self, source, condition) -> DeltaMergeBuilder:
+        return DeltaMergeBuilder(self, source, condition)
+
+    def _run_merge(self, b: DeltaMergeBuilder) -> None:
+        """Join-based merge: full-snapshot rewrite in one commit. The
+        reference prunes to touched files; correctness-first here, the commit
+        protocol is identical."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        import spark_rapids_tpu.functions as F
+        from ..session import Column
+
+        import numpy as _np
+        from ..plan.logical import LocalRelation
+        from ..session import DataFrame
+
+        target = self.toDF()
+        source = b._source
+        t_cols = target.columns
+        s_cols = source.columns
+        cond = _as_condition(b._condition)
+
+        # materialize the target with a row id so multi-source matches are
+        # detectable (Delta errors on them rather than duplicating rows)
+        t_table = target.to_arrow()
+        t_table = t_table.append_column(
+            "__tid__", pa.array(_np.arange(t_table.num_rows), pa.int64()))
+
+        # tag source rows, join, and bucket rows by match status
+        src = source.select(*[F.col(c).alias(f"__s_{c}") for c in s_cols]) \
+                    .withColumn("__src__", F.lit(True))
+        tgt = DataFrame(LocalRelation(t_table, 1), self.session) \
+            .withColumn("__tgt__", F.lit(True))
+        cond_renamed = Column(_rename_sources(cond._expr, t_cols, s_cols))
+        joined = tgt.join(src, on=cond_renamed, how="fullouter")
+        rows = joined.to_arrow()
+
+        import pyarrow.compute as pc
+        is_matched = pc.and_(pc.fill_null(pc.is_valid(rows.column("__tgt__")), False),
+                             pc.fill_null(pc.is_valid(rows.column("__src__")), False))
+        tgt_only = pc.and_(pc.is_valid(rows.column("__tgt__")),
+                           pc.invert(is_matched))
+        src_only = pc.and_(pc.is_valid(rows.column("__src__")),
+                           pc.invert(is_matched))
+
+        out_batches: List[pa.Table] = []
+        keep = rows.filter(tgt_only).select(t_cols)
+        if keep.num_rows:
+            out_batches.append(keep)
+        matched = rows.filter(is_matched)
+        if matched.num_rows and b._matched:
+            counts = pc.value_counts(matched.column("__tid__"))
+            if pc.max(counts.field("counts")).as_py() > 1:
+                raise ValueError(
+                    "MERGE failed: multiple source rows matched the same "
+                    "target row (non-deterministic update/delete)")
+        if matched.num_rows:
+            out_batches.extend(self._apply_matched_clauses(b, matched, t_cols, s_cols))
+        unmatched_src = rows.filter(src_only)
+        if unmatched_src.num_rows:
+            out_batches.extend(self._apply_insert_clauses(b, unmatched_src,
+                                                          t_cols, s_cols))
+        schema = None
+        for t in out_batches:
+            schema = t.schema if schema is None else schema
+        result = pa.concat_tables([t.cast(schema) for t in out_batches],
+                                  promote_options="permissive") \
+            if out_batches else None
+
+        # one-commit overwrite
+        log = DeltaLog(self.path)
+        snap = DeltaSnapshot(self.path)
+        actions = [log.remove_action(p, partition_values=a.get("partitionValues"))
+                   for p, a in snap.files.items()]
+        ts = int(time.time() * 1000)
+        if result is not None and result.num_rows:
+            part_cols = snap.partition_columns()
+            if part_cols:
+                actions += _write_partitioned(log, self.path, result, part_cols, ts)
+            else:
+                rel = _data_file_name(ts)
+                fp = os.path.join(self.path, rel)
+                pq.write_table(result, fp, compression="snappy")
+                actions.append(log.add_action(rel, os.path.getsize(fp),
+                                              collect_stats(result)))
+        actions.append(log.commit_info_action("MERGE", {}))
+        log.commit(actions)
+
+    def _apply_matched_clauses(self, b, matched, t_cols, s_cols):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        out = []
+        remaining = matched
+        handled_any = False
+        for kind, cond, set_exprs in b._matched:
+            if remaining.num_rows == 0:
+                break
+            mask = _eval_clause_cond(self.session, remaining, cond, t_cols, s_cols)
+            hit = remaining.filter(mask)
+            remaining = remaining.filter(pc.invert(mask))
+            handled_any = True
+            if kind == "delete" or hit.num_rows == 0:
+                continue
+            if kind == "update_all":
+                set_exprs = {c: _src_col(c) for c in t_cols if f"__s_{c}" in
+                             hit.column_names}
+            upd = _project_merge_rows(self.session, hit, t_cols, s_cols,
+                                      set_exprs, base="target")
+            out.append(upd)
+        if remaining.num_rows:
+            out.append(remaining.select(t_cols))  # untouched matched rows stay
+        return out
+
+    def _apply_insert_clauses(self, b, src_rows, t_cols, s_cols):
+        import pyarrow.compute as pc
+        out = []
+        remaining = src_rows
+        for kind, cond, values in b._not_matched:
+            if remaining.num_rows == 0:
+                break
+            mask = _eval_clause_cond(self.session, remaining, cond, t_cols, s_cols)
+            hit = remaining.filter(mask)
+            remaining = remaining.filter(pc.invert(mask))
+            if hit.num_rows == 0:
+                continue
+            if kind == "insert_all":
+                values = {c: _src_col(c) for c in t_cols if f"__s_{c}" in
+                          hit.column_names}
+            ins = _project_merge_rows(self.session, hit, t_cols, s_cols,
+                                      values, base="null")
+            out.append(ins)
+        return out
+
+    # -- OPTIMIZE / VACUUM -------------------------------------------------
+    def optimize(self) -> DeltaOptimizeBuilder:
+        return DeltaOptimizeBuilder(self)
+
+    def _optimize(self, zorder_cols: Optional[List[str]]) -> None:
+        """Compaction: rewrite the snapshot as one file per partition combo
+        (dataChange=false). ZORDER: additionally sort by the interleaved-bit
+        key of the clustering columns' range-partition ranks (reference
+        ZOrderRules: GpuPartitionerExpr feeding GpuInterleaveBits)."""
+        import pyarrow.parquet as pq
+        import spark_rapids_tpu.functions as F
+
+        snap = DeltaSnapshot(self.path)
+        log = DeltaLog(self.path)
+        df = self.toDF()
+        if zorder_cols:
+            from ..expressions.zorder import InterleaveBits
+            from ..expressions.cast import Cast
+            from ..types import IntegerType
+            from ..session import Column
+            ranks = [Cast(F._expr_or_col(F.col(c)), IntegerType())
+                     for c in zorder_cols]
+            df = df.withColumn("__zkey__", Column(InterleaveBits(ranks))) \
+                   .sort("__zkey__").drop("__zkey__")
+        table = df.to_arrow()
+        part_cols = snap.partition_columns()
+        actions = [log.remove_action(p, data_change=False,
+                                     partition_values=a.get("partitionValues"))
+                   for p, a in snap.files.items()]
+        ts = int(time.time() * 1000)
+        if part_cols:
+            adds = _write_partitioned(log, self.path, table, part_cols, ts)
+            for a in adds:
+                a["add"]["dataChange"] = False
+            actions += adds
+        elif table.num_rows:
+            rel = _data_file_name(ts)
+            fp = os.path.join(self.path, rel)
+            pq.write_table(table, fp, compression="snappy")
+            actions.append(log.add_action(rel, os.path.getsize(fp),
+                                          collect_stats(table), data_change=False))
+        op = "OPTIMIZE" if not zorder_cols else "OPTIMIZE ZORDER"
+        actions.append(log.commit_info_action(op, {"zOrderBy":
+                                                   json.dumps(zorder_cols or [])}))
+        log.commit(actions)
+
+    def vacuum(self, retention_hours: float = 168.0) -> List[str]:
+        """Delete data files no longer referenced by the current snapshot and
+        older than the retention window. Returns deleted paths."""
+        snap = DeltaSnapshot(self.path)
+        live = set(snap.data_files())
+        for rel, a in snap.files.items():
+            dv = a.get("deletionVector")
+            if dv and dv.get("storageType") in ("u", "p"):
+                live.add(DeletionVectorDescriptor.from_json(dv)
+                         .absolute_path(self.path))
+        cutoff = time.time() - retention_hours * 3600
+        deleted = []
+        for root, dirs, files in os.walk(self.path):
+            if "_delta_log" in root:
+                continue
+            for f in files:
+                fp = os.path.join(root, f)
+                if fp not in live and os.path.getmtime(fp) < cutoff:
+                    os.remove(fp)
+                    deleted.append(fp)
+        return deleted
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _as_condition(condition):
+    import spark_rapids_tpu.functions as F
+    if condition is None:
+        return None
+    if isinstance(condition, str):
+        raise TypeError("string predicates are not supported; pass a Column "
+                        "built from spark_rapids_tpu.functions")
+    return condition
+
+
+def _is_true(expr):
+    from ..expressions.predicates import EqualNullSafe
+    from ..expressions.base import Literal
+    from ..types import BooleanType
+    return EqualNullSafe(expr, Literal(True, BooleanType()))
+
+
+def _cast_part(v: Optional[str], col: str, snap: DeltaSnapshot):
+    """Partition values are stored as strings in the log; bring them back to
+    the schema type so predicates compare correctly (delta PROTOCOL.md
+    partition-value serialization)."""
+    st = snap.schema()
+    if v is None or st is None:
+        return v
+    import datetime as _dt
+    import decimal as _dec
+    from ..types import (BooleanType, ByteType, DateType, DecimalType,
+                         DoubleType, FloatType, IntegerType, LongType,
+                         ShortType, TimestampType)
+    for f in st.fields:
+        if f.name == col:
+            dt = f.data_type
+            if isinstance(dt, (ByteType, ShortType, IntegerType, LongType)):
+                return int(v)
+            if isinstance(dt, (FloatType, DoubleType)):
+                return float(v)
+            if isinstance(dt, BooleanType):
+                return v.lower() == "true"
+            if isinstance(dt, DateType):
+                return _dt.date.fromisoformat(v)
+            if isinstance(dt, TimestampType):
+                return _dt.datetime.fromisoformat(v)
+            if isinstance(dt, DecimalType):
+                return _dec.Decimal(v)
+    return v
+
+
+def _sibling_name(rel: str, ts: int) -> str:
+    d = os.path.dirname(rel)
+    name = _data_file_name(ts)
+    return os.path.join(d, name) if d else name
+
+
+def _src_col(name: str):
+    import spark_rapids_tpu.functions as F
+    return F.col(f"__s_{name}")
+
+
+def _rename_sources(expr, t_cols, s_cols):
+    """In a merge condition, column refs that name source columns resolve to
+    the __s_-prefixed join-side names; target-named refs win on conflicts."""
+    from ..expressions.base import UnresolvedAttribute
+
+    def fix(e):
+        if isinstance(e, UnresolvedAttribute):
+            if e.name.startswith("source."):
+                return UnresolvedAttribute(f"__s_{e.name[7:]}")
+            if e.name.startswith("target."):
+                return UnresolvedAttribute(e.name[7:])
+            if e.name not in t_cols and e.name in s_cols:
+                return UnresolvedAttribute(f"__s_{e.name}")
+        return None
+    return expr.transform(fix)
+
+
+def _eval_clause_cond(session, rows, cond, t_cols, s_cols):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if cond is None:
+        return pa.array(np.ones(rows.num_rows, dtype=bool))
+    from ..session import Column, DataFrame
+    from ..plan.logical import LocalRelation
+    df = DataFrame(LocalRelation(rows, 1), session)
+    fixed = Column(_is_true(_rename_sources(_as_condition(cond)._expr,
+                                            t_cols, s_cols)))
+    out = df.select(fixed.alias("__m__")).to_arrow()
+    return pc.fill_null(out.column("__m__").combine_chunks(), False)
+
+
+def _project_merge_rows(session, rows, t_cols, s_cols, set_exprs, base: str):
+    """Project merge output rows: target schema, applying set/insert values.
+    base="target": unset columns keep target values; base="null": unset
+    columns are NULL (insert with explicit values)."""
+    import spark_rapids_tpu.functions as F
+    from ..session import Column, DataFrame
+    from ..plan.logical import LocalRelation
+    df = DataFrame(LocalRelation(rows, 1), session)
+    cols = []
+    set_exprs = dict(set_exprs or {})
+    for c in t_cols:
+        if c in set_exprs:
+            v = set_exprs[c]
+            col = v if isinstance(v, Column) else F.lit(v)
+            col = Column(_rename_sources(F._expr_or_col(col), t_cols, s_cols))
+        elif base == "target":
+            col = F.col(c)
+        else:
+            col = F.lit(None)
+        cols.append(col.alias(c))
+    return df.select(*cols).to_arrow()

@@ -1,0 +1,267 @@
+"""Delta Lake transaction-log writer: commit protocol, schema JSON, file stats,
+checkpoints.
+
+Reference: the write side of delta-lake/ (GpuOptimisticTransaction variants,
+GpuStatisticsCollection for per-file stats, auto checkpointing). The log
+protocol itself is engine-neutral JSON (delta PROTOCOL.md): one
+`{version:020d}.json` of newline-delimited actions per commit, parquet
+checkpoints every N commits plus a `_last_checkpoint` pointer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ..types import (ArrayType, BinaryType, BooleanType, ByteType, DataType,
+                     DateType, DecimalType, DoubleType, FloatType, IntegerType,
+                     LongType, MapType, ShortType, StringType, StructField,
+                     StructType, TimestampType)
+
+CHECKPOINT_INTERVAL = 10
+
+_PRIMITIVES = [
+    (BooleanType, "boolean"), (ByteType, "byte"), (ShortType, "short"),
+    (IntegerType, "integer"), (LongType, "long"), (FloatType, "float"),
+    (DoubleType, "double"), (StringType, "string"), (BinaryType, "binary"),
+    (DateType, "date"), (TimestampType, "timestamp"),
+]
+
+
+def type_to_delta(dt: DataType):
+    if isinstance(dt, DecimalType):
+        return f"decimal({dt.precision},{dt.scale})"
+    for cls, name in _PRIMITIVES:
+        if isinstance(dt, cls):
+            return name
+    if isinstance(dt, ArrayType):
+        return {"type": "array", "elementType": type_to_delta(dt.element_type),
+                "containsNull": True}
+    if isinstance(dt, MapType):
+        return {"type": "map", "keyType": type_to_delta(dt.key_type),
+                "valueType": type_to_delta(dt.value_type),
+                "valueContainsNull": True}
+    if isinstance(dt, StructType):
+        return schema_to_delta(dt)
+    raise TypeError(f"no delta type for {dt}")
+
+
+def schema_to_delta(st: StructType) -> dict:
+    return {"type": "struct",
+            "fields": [{"name": f.name, "type": type_to_delta(f.data_type),
+                        "nullable": f.nullable, "metadata": {}}
+                       for f in st.fields]}
+
+
+def delta_to_type(t) -> DataType:
+    from ..types import parse_ddl_type
+    if isinstance(t, str):
+        return parse_ddl_type(t)
+    kind = t.get("type")
+    if kind == "struct":
+        return StructType([StructField(f["name"], delta_to_type(f["type"]),
+                                       f.get("nullable", True))
+                           for f in t["fields"]])
+    if kind == "array":
+        return ArrayType(delta_to_type(t["elementType"]))
+    if kind == "map":
+        return MapType(delta_to_type(t["keyType"]), delta_to_type(t["valueType"]))
+    raise TypeError(f"bad delta type {t}")
+
+
+def collect_stats(table) -> str:
+    """Per-file stats JSON for the add action (reference
+    GpuStatisticsCollection: numRecords/minValues/maxValues/nullCount)."""
+    import pyarrow.compute as pc
+    import pyarrow as pa
+    mins: Dict[str, object] = {}
+    maxs: Dict[str, object] = {}
+    nulls: Dict[str, int] = {}
+    for name in table.column_names:
+        col = table.column(name)
+        nulls[name] = col.null_count
+        t = col.type
+        if pa.types.is_nested(t) or pa.types.is_binary(t) or pa.types.is_null(t):
+            continue
+        if col.null_count == len(col):
+            continue
+        try:
+            mn, mx = pc.min(col).as_py(), pc.max(col).as_py()
+        except pa.lib.ArrowNotImplementedError:
+            continue
+        if isinstance(mn, float) and (mn != mn or mx != mx):
+            continue  # NaN poisons ordering stats
+        for d, v in ((mins, mn), (maxs, mx)):
+            if hasattr(v, "isoformat"):
+                v = v.isoformat()
+            d[name] = v
+    return json.dumps({"numRecords": table.num_rows, "minValues": mins,
+                       "maxValues": maxs, "nullCount": nulls}, default=str)
+
+
+class DeltaLog:
+    """Commit-side view of a table's _delta_log."""
+
+    def __init__(self, table_path: str):
+        self.table_path = table_path
+        self.log_dir = os.path.join(table_path, "_delta_log")
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.log_dir)
+
+    def latest_version(self) -> int:
+        if not self.exists():
+            return -1
+        vs = [int(f.split(".")[0]) for f in os.listdir(self.log_dir)
+              if f.endswith(".json") and f.split(".")[0].isdigit()]
+        return max(vs) if vs else -1
+
+    def protocol_action(self, dvs: bool = False) -> dict:
+        if dvs:
+            return {"protocol": {"minReaderVersion": 3, "minWriterVersion": 7,
+                                 "readerFeatures": ["deletionVectors"],
+                                 "writerFeatures": ["deletionVectors"]}}
+        return {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}
+
+    def metadata_action(self, schema: StructType, partition_cols: List[str],
+                        configuration: Optional[dict] = None,
+                        table_id: Optional[str] = None) -> dict:
+        return {"metaData": {
+            "id": table_id or str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(schema_to_delta(schema)),
+            "partitionColumns": partition_cols,
+            "configuration": configuration or {},
+            "createdTime": int(time.time() * 1000)}}
+
+    def add_action(self, rel_path: str, size: int, stats: Optional[str],
+                   partition_values: Optional[dict] = None,
+                   data_change: bool = True, dv_descriptor=None) -> dict:
+        a = {"path": rel_path, "partitionValues": partition_values or {},
+             "size": size, "modificationTime": int(time.time() * 1000),
+             "dataChange": data_change}
+        if stats:
+            a["stats"] = stats
+        if dv_descriptor is not None:
+            a["deletionVector"] = dv_descriptor.to_json()
+        return {"add": a}
+
+    def remove_action(self, rel_path: str, data_change: bool = True,
+                      partition_values: Optional[dict] = None) -> dict:
+        return {"remove": {"path": rel_path,
+                           "deletionTimestamp": int(time.time() * 1000),
+                           "dataChange": data_change,
+                           "partitionValues": partition_values or {}}}
+
+    def commit_info_action(self, operation: str, params: Optional[dict] = None) -> dict:
+        return {"commitInfo": {"timestamp": int(time.time() * 1000),
+                               "operation": operation,
+                               "operationParameters": params or {},
+                               "engineInfo": "spark-rapids-tpu"}}
+
+    def commit(self, actions: List[dict], expected_version: Optional[int] = None) -> int:
+        """Write the next commit atomically (O_CREAT|O_EXCL gives the
+        optimistic-concurrency conflict check on a local/posix store)."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        version = (expected_version if expected_version is not None
+                   else self.latest_version() + 1)
+        path = os.path.join(self.log_dir, f"{version:020d}.json")
+        payload = "".join(json.dumps(a) + "\n" for a in actions)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        try:
+            os.write(fd, payload.encode())
+        finally:
+            os.close(fd)
+        if version > 0 and version % CHECKPOINT_INTERVAL == 0:
+            self._write_checkpoint(version)
+        return version
+
+    def _write_checkpoint(self, version: int) -> None:
+        """Parquet checkpoint of the snapshot state at `version` + the
+        `_last_checkpoint` pointer (read side: delta.py replays from it)."""
+        from .delta import DeltaSnapshot
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        snap = DeltaSnapshot(self.table_path, version=version)
+        # explicit schema: partitionValues is map<string,string> (delta
+        # checkpoint spec; an inferred empty struct is unwritable)
+        dv_t = pa.struct([("storageType", pa.string()),
+                          ("pathOrInlineDv", pa.string()),
+                          ("offset", pa.int32()),
+                          ("sizeInBytes", pa.int32()),
+                          ("cardinality", pa.int64())])
+        add_t = pa.struct([("path", pa.string()),
+                           ("partitionValues", pa.map_(pa.string(), pa.string())),
+                           ("size", pa.int64()),
+                           ("modificationTime", pa.int64()),
+                           ("dataChange", pa.bool_()),
+                           ("stats", pa.string()),
+                           ("deletionVector", dv_t)])
+        meta_t = pa.struct([("id", pa.string()),
+                            ("schemaString", pa.string()),
+                            ("partitionColumns", pa.list_(pa.string())),
+                            ("configuration", pa.map_(pa.string(), pa.string())),
+                            ("createdTime", pa.int64())])
+        remove_t = pa.struct([("path", pa.string()),
+                              ("deletionTimestamp", pa.int64()),
+                              ("dataChange", pa.bool_()),
+                              ("partitionValues", pa.map_(pa.string(), pa.string()))])
+        proto_t = pa.struct([("minReaderVersion", pa.int32()),
+                             ("minWriterVersion", pa.int32()),
+                             ("readerFeatures", pa.list_(pa.string())),
+                             ("writerFeatures", pa.list_(pa.string()))])
+
+        def add_row(a: dict) -> dict:
+            return {"path": a.get("path"),
+                    "partitionValues": list((a.get("partitionValues") or {}).items()),
+                    "size": a.get("size"),
+                    "modificationTime": a.get("modificationTime"),
+                    "dataChange": a.get("dataChange", True),
+                    "stats": a.get("stats"),
+                    "deletionVector": a.get("deletionVector")}
+
+        adds = [add_row(a) for a in snap.files.values()]
+        metas: List[Optional[dict]] = [None] * len(adds)
+        removes: List[Optional[dict]] = [None] * len(adds)
+        protos: List[Optional[dict]] = [None] * len(adds)
+        # spec: a checkpoint must carry protocol + metaData and the unexpired
+        # remove tombstones (external VACUUM relies on them)
+        if snap.metadata:
+            m = snap.metadata
+            adds.append(None)
+            removes.append(None)
+            protos.append(None)
+            metas.append({"id": m.get("id"),
+                          "schemaString": m.get("schemaString"),
+                          "partitionColumns": m.get("partitionColumns") or [],
+                          "configuration": list((m.get("configuration") or {}).items()),
+                          "createdTime": m.get("createdTime")})
+        proto = snap.protocol or self.protocol_action()["protocol"]
+        adds.append(None)
+        metas.append(None)
+        removes.append(None)
+        protos.append({"minReaderVersion": proto.get("minReaderVersion", 1),
+                       "minWriterVersion": proto.get("minWriterVersion", 2),
+                       "readerFeatures": proto.get("readerFeatures"),
+                       "writerFeatures": proto.get("writerFeatures")})
+        for r in snap.tombstones.values():
+            adds.append(None)
+            metas.append(None)
+            protos.append(None)
+            removes.append({"path": r.get("path"),
+                            "deletionTimestamp": r.get("deletionTimestamp"),
+                            "dataChange": r.get("dataChange", True),
+                            "partitionValues": list((r.get("partitionValues")
+                                                     or {}).items())})
+        table = pa.table({"add": pa.array(adds, type=add_t),
+                          "metaData": pa.array(metas, type=meta_t),
+                          "remove": pa.array(removes, type=remove_t),
+                          "protocol": pa.array(protos, type=proto_t)})
+        rows = adds
+        cp = os.path.join(self.log_dir, f"{version:020d}.checkpoint.parquet")
+        pq.write_table(table, cp)
+        with open(os.path.join(self.log_dir, "_last_checkpoint"), "w") as f:
+            json.dump({"version": version, "size": len(rows)}, f)

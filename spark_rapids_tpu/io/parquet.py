@@ -37,6 +37,15 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
     import pyarrow as pa
     if fmt == "parquet":
         import pyarrow.parquet as pq
+        dv_rows = (options or {}).get("__dv_rows__", {}).get(path)
+        if dv_rows is not None:
+            # deletion vector: positions are file-absolute, so read without
+            # row-group filters, then drop deleted rows (delta DV read path)
+            import numpy as np
+            t = pq.read_table(path, columns=columns)
+            keep = np.ones(t.num_rows, dtype=bool)
+            keep[dv_rows.astype(np.int64)] = False
+            return t.filter(pa.array(keep))
         return pq.read_table(path, columns=columns, filters=arrow_filter)
     if fmt == "orc":
         import pyarrow.orc as paorc
@@ -93,6 +102,49 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
     return t
 
 
+def _stats_may_match(stats: Optional[dict], arrow_filter) -> bool:
+    """Conservative per-file pruning: False only when a pushed min/max leaf
+    provably excludes every row of the file."""
+    if not stats:
+        return True
+    mins = stats.get("minValues") or {}
+    maxs = stats.get("maxValues") or {}
+    num = stats.get("numRecords")
+    nullc = stats.get("nullCount") or {}
+    for col, op, val in arrow_filter:
+        mn, mx = mins.get(col), maxs.get(col)
+        if op == "in":
+            if mn is None or mx is None:
+                continue
+            try:
+                if all(v < mn or v > mx for v in val):
+                    return False
+            except TypeError:
+                continue
+            continue
+        if mn is None or mx is None:
+            continue
+        try:
+            if op == "==" and (val < mn or val > mx):
+                return False
+            if op == "<" and mn >= val:
+                return False
+            if op == "<=" and mn > val:
+                return False
+            if op == ">" and mx <= val:
+                return False
+            if op == ">=" and mx < val:
+                return False
+        except TypeError:
+            continue  # incomparable stat (e.g. isoformat string vs date)
+    # all-null file vs any comparison leaf: no row can match
+    if num is not None and arrow_filter:
+        for col, op, val in arrow_filter:
+            if nullc.get(col) == num:
+                return False
+    return True
+
+
 class FileScanBase:
     def _init_scan(self, paths: List[str], fmt: str,
                    output: List[AttributeReference],
@@ -121,6 +173,12 @@ class FileScanBase:
         """Host-side reads for one partition under the selected strategy."""
         import pyarrow as pa
         files = _split_files(self.paths, self._n_parts)[idx]
+        file_stats = self.options.get("__file_stats__")
+        if file_stats and self._arrow_filter:
+            # data skipping on delta per-file stats (the delta analogue of the
+            # reference's row-group pruning by footer statistics)
+            files = [f for f in files
+                     if _stats_may_match(file_stats.get(f), self._arrow_filter)]
         if not files:
             return
         cols = [a.name for a in self._output_attrs]

@@ -53,7 +53,9 @@ class DataFrameReader:
 
     def delta(self, path: str):
         from .delta import read_delta
-        return read_delta(self._session, path)
+        version = self._options.get("versionAsOf")
+        return read_delta(self._session, path,
+                          version=None if version is None else int(version))
 
     def _scan(self, paths, fmt: str):
         from ..plan.logical import FileScan

@@ -32,6 +32,29 @@ class DataFrameWriter:
         self._partition_by = list(cols)
         return self
 
+    def format(self, fmt: str) -> "DataFrameWriter":
+        self._options["__format__"] = str(fmt).lower()
+        return self
+
+    def save(self, path: str) -> None:
+        fmt = self._options.pop("__format__", "parquet")
+        if fmt == "delta":
+            return self.delta(path)
+        writers = {"parquet": self.parquet, "orc": self.orc, "csv": self.csv,
+                   "json": self.json}
+        if fmt not in writers:
+            raise ValueError(f"unknown write format {fmt}")
+        return writers[fmt](path)
+
+    def delta(self, path: str) -> None:
+        """Transactional delta write (reference delta-lake/ write side)."""
+        from .delta import write_delta
+        mode = {"errorifexists": "errorifexists", "error": "errorifexists"}.get(
+            self._mode, self._mode)
+        write_delta(self._df, path, mode, self._partition_by,
+                    options={k: v for k, v in self._options.items()
+                             if k.startswith("delta.")})
+
     def _prepare_dir(self, path: str) -> None:
         if os.path.exists(path):
             if self._mode == "overwrite":
@@ -85,23 +108,8 @@ class DataFrameWriter:
     def _write_dynamic(self, path, ext, write_fn, p, table) -> None:
         """Dynamic-partition layout: key1=v1/key2=v2/part-NNNNN (reference
         GpuFileFormatDataWriter dynamic partitioning)."""
-        import pyarrow as pa
-        import pyarrow.compute as pc
-        keys = self._partition_by
-        data_cols = [c for c in table.column_names if c not in keys]
-        combos = table.select(keys).group_by(keys).aggregate([])
-        for row in combos.to_pylist():
-            mask = None
-            for k in keys:
-                v = row[k]
-                m = pc.is_null(table.column(k)) if v is None \
-                    else pc.equal(table.column(k), v)
-                m = pc.fill_null(m, False)
-                mask = m if mask is None else pc.and_(mask, m)
-            sub = table.filter(mask).select(data_cols)
-            subdir = "/".join(
-                f"{k}={'__HIVE_DEFAULT_PARTITION__' if row[k] is None else row[k]}"
-                for k in keys)
+        from .layout import iter_hive_partitions
+        for _, subdir, sub in iter_hive_partitions(table, self._partition_by):
             d = os.path.join(path, subdir)
             os.makedirs(d, exist_ok=True)
             write_fn(sub, os.path.join(d, f"part-{p:05d}.{ext}"))

@@ -1,0 +1,265 @@
+"""Delta Lake write side: transactional writes, DELETE/UPDATE/MERGE, OPTIMIZE
+ZORDER, deletion vectors, time travel, vacuum, checkpoints.
+
+Reference behavior modeled: delta-lake/ write commands (SURVEY §2.9) — GPU
+writes with stats collection, MERGE INTO via join, deletion-vector handling."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu import DeltaTable
+
+
+def _mk(session, path, n=10):
+    df = session.createDataFrame({"id": np.arange(n, dtype=np.int64),
+                                  "v": np.arange(n, dtype=np.float64) * 1.5})
+    df.write.format("delta").save(path)
+    return DeltaTable.forPath(session, path)
+
+
+def test_write_and_read_roundtrip(session, tmp_path):
+    path = str(tmp_path / "t")
+    _mk(session, path)
+    rows = sorted(session.read.format("delta").load(path).collect(),
+                  key=lambda r: r["id"])
+    assert len(rows) == 10 and rows[3] == {"id": 3, "v": 4.5}
+
+
+def test_append_and_time_travel(session, tmp_path):
+    path = str(tmp_path / "t")
+    _mk(session, path)
+    session.createDataFrame({"id": np.array([100], np.int64),
+                             "v": np.array([0.0])}) \
+        .write.mode("append").format("delta").save(path)
+    assert session.read.delta(path).count() == 11
+    assert session.read.option("versionAsOf", 0).delta(path).count() == 10
+
+
+def test_overwrite_and_error_modes(session, tmp_path):
+    path = str(tmp_path / "t")
+    _mk(session, path)
+    df = session.createDataFrame({"id": np.array([1], np.int64),
+                                  "v": np.array([2.0])})
+    with pytest.raises(FileExistsError):
+        df.write.format("delta").save(path)
+    df.write.mode("overwrite").format("delta").save(path)
+    assert session.read.delta(path).count() == 1
+    df.write.mode("ignore").format("delta").save(path)  # no-op
+    assert session.read.delta(path).count() == 1
+
+
+def test_write_records_stats(session, tmp_path):
+    path = str(tmp_path / "t")
+    _mk(session, path)
+    commit = os.path.join(path, "_delta_log", "00000000000000000000.json")
+    adds = [json.loads(l)["add"] for l in open(commit) if '"add"' in l]
+    stats = json.loads(adds[0]["stats"])
+    assert stats["numRecords"] == 10
+    assert stats["minValues"]["id"] == 0 and stats["maxValues"]["id"] == 9
+
+
+def test_delete_copy_on_write(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path)
+    t.delete(F.col("id") >= 7)
+    assert sorted(r["id"] for r in t.toDF().collect()) == list(range(7))
+    # null-condition rows are kept (DELETE only removes cond IS TRUE):
+    # id=0 -> v/id = 0/0 -> NULL in Spark -> NULL > 1e9 is NULL -> keep
+    t.delete(F.col("v") / F.col("id") > 1e9)
+    ids = sorted(r["id"] for r in t.toDF().collect())
+    assert ids == list(range(7))
+
+
+def test_update(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path)
+    t.update(F.col("id") < 3, set={"v": F.col("v") + 100})
+    rows = {r["id"]: r["v"] for r in t.toDF().collect()}
+    assert rows[0] == 100.0 and rows[2] == 103.0 and rows[5] == 7.5
+
+
+def test_merge_upsert(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path, n=5)
+    src = session.createDataFrame({"id": np.array([3, 4, 7], np.int64),
+                                   "v": np.array([30.0, 40.0, 70.0])})
+    t.merge(src, F.col("id") == F.col("source.id")) \
+        .whenMatchedUpdateAll() \
+        .whenNotMatchedInsertAll() \
+        .execute()
+    rows = {r["id"]: r["v"] for r in t.toDF().collect()}
+    assert rows == {0: 0.0, 1: 1.5, 2: 3.0, 3: 30.0, 4: 40.0, 7: 70.0}
+
+
+def test_merge_delete_and_conditional_insert(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path, n=5)
+    src = session.createDataFrame({"id": np.array([1, 2, 9, 10], np.int64),
+                                   "v": np.array([0.0, 0.0, 90.0, 100.0])})
+    t.merge(src, F.col("id") == F.col("source.id")) \
+        .whenMatchedDelete(condition=(F.col("id") == 1)) \
+        .whenMatchedUpdate(set={"v": F.lit(-1.0)}) \
+        .whenNotMatchedInsert(condition=(F.col("source.v") > 95),
+                              values={"id": F.col("source.id"),
+                                      "v": F.col("source.v")}) \
+        .execute()
+    rows = {r["id"]: r["v"] for r in t.toDF().collect()}
+    # id=1 deleted; id=2 updated to -1; id=9 filtered out; id=10 inserted
+    assert rows == {0: 0.0, 2: -1.0, 3: 4.5, 4: 6.0, 10: 100.0}
+
+
+def test_optimize_zorder_compacts_and_sorts(session, tmp_path):
+    path = str(tmp_path / "t")
+    for i in range(3):  # three commits -> three files
+        session.createDataFrame({"id": np.arange(i * 4, i * 4 + 4, dtype=np.int64),
+                                 "v": np.zeros(4)}) \
+            .write.mode("append" if i else "errorifexists") \
+            .format("delta").save(path)
+    t = DeltaTable.forPath(session, path)
+    assert len(glob.glob(os.path.join(path, "*.parquet"))) == 3
+    t.optimize().executeZOrderBy("id")
+    from spark_rapids_tpu.io.delta import DeltaSnapshot
+    snap = DeltaSnapshot(path)
+    assert len(snap.files) == 1  # compacted
+    assert sorted(r["id"] for r in t.toDF().collect()) == list(range(12))
+    assert t.history()[0]["operation"] == "OPTIMIZE ZORDER"
+
+
+def test_partitioned_write_and_mutation(session, tmp_path):
+    path = str(tmp_path / "t")
+    session.createDataFrame({"k": np.array([1, 1, 2, 2, 3], np.int64),
+                             "v": np.arange(5, dtype=np.float64)}) \
+        .write.partitionBy("k").format("delta").save(path)
+    assert os.path.isdir(os.path.join(path, "k=1"))
+    df = session.read.delta(path)
+    assert sorted((r["k"], r["v"]) for r in df.collect()) == \
+        [(1, 0.0), (1, 1.0), (2, 2.0), (2, 3.0), (3, 4.0)]
+    t = DeltaTable.forPath(session, path)
+    t.delete(F.col("k") == 2)
+    assert sorted(r["k"] for r in t.toDF().collect()) == [1, 1, 3]
+
+
+def test_deletion_vectors(session, tmp_path):
+    path = str(tmp_path / "t")
+    session.createDataFrame({"k": np.arange(8, dtype=np.int64)}) \
+        .write.option("delta.enableDeletionVectors", "true") \
+        .format("delta").save(path)
+    t = DeltaTable.forPath(session, path)
+    t.delete(F.col("k") % 2 == 0)
+    assert sorted(r["k"] for r in t.toDF().collect()) == [1, 3, 5, 7]
+    # second DV delete merges with the first; data file is never rewritten
+    t.delete(F.col("k") == 3)
+    assert sorted(r["k"] for r in t.toDF().collect()) == [1, 5, 7]
+    assert len(glob.glob(os.path.join(path, "part-*.parquet"))) == 1
+    assert glob.glob(os.path.join(path, "deletion_vector_*.bin"))
+
+
+def test_vacuum(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path)
+    t.delete(F.col("id") < 5)  # rewrites the file, orphaning the original
+    deleted = t.vacuum(retention_hours=0.0)
+    assert len(deleted) == 1
+    assert session.read.delta(path).count() == 5  # table intact
+
+
+def test_checkpoint_roundtrip(session, tmp_path):
+    path = str(tmp_path / "t")
+    _mk(session, path, n=2)
+    for i in range(10):
+        session.createDataFrame({"id": np.array([100 + i], np.int64),
+                                 "v": np.array([0.0])}) \
+            .write.mode("append").format("delta").save(path)
+    assert glob.glob(os.path.join(path, "_delta_log", "*.checkpoint.parquet"))
+    assert session.read.delta(path).count() == 12
+
+
+def test_stats_skipping_prunes_files(session, tmp_path):
+    path = str(tmp_path / "t")
+    for i in range(3):
+        session.createDataFrame({"id": np.arange(i * 10, i * 10 + 10,
+                                                 dtype=np.int64)}) \
+            .write.mode("append" if i else "errorifexists") \
+            .format("delta").save(path)
+    from spark_rapids_tpu.io.parquet import _stats_may_match
+    from spark_rapids_tpu.io.delta import DeltaSnapshot
+    stats = DeltaSnapshot(path).file_stats()
+    assert len(stats) == 3
+    fs = sorted(stats.items())
+    # file [0..9] cannot match id > 15
+    assert not _stats_may_match(fs[0][1], [("id", ">", 15)])
+    assert _stats_may_match(fs[1][1], [("id", ">", 15)])
+    # end-to-end: filtered read returns correct rows
+    out = session.read.delta(path).filter(F.col("id") > 15).collect()
+    assert sorted(r["id"] for r in out) == list(range(16, 30))
+
+
+def test_roaring_bitmap_roundtrip():
+    from spark_rapids_tpu.io.delta_dv import (deserialize_bitmap_array,
+                                              serialize_bitmap_array)
+    cases = [
+        np.array([], np.uint64),
+        np.array([0, 1, 2, 65535, 65536, 70000], np.uint64),
+        np.arange(0, 10000, 2, dtype=np.uint64),          # bitmap container
+        np.array([1, (1 << 32) + 5, (2 << 32) + 7], np.uint64),  # multi-bucket
+        np.arange(5000, dtype=np.uint64),                 # >4096 dense
+    ]
+    for c in cases:
+        got = deserialize_bitmap_array(serialize_bitmap_array(c))
+        assert np.array_equal(np.sort(got), c), c[:5]
+
+
+def test_merge_multiple_source_matches_errors(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path, n=3)
+    src = session.createDataFrame({"id": np.array([1, 1], np.int64),
+                                   "v": np.array([10.0, 20.0])})
+    with pytest.raises(ValueError, match="multiple source rows"):
+        t.merge(src, F.col("id") == F.col("source.id")) \
+            .whenMatchedUpdateAll().execute()
+
+
+def test_append_with_conflicting_partitioning_errors(session, tmp_path):
+    path = str(tmp_path / "t")
+    session.createDataFrame({"a": np.array([1], np.int64),
+                             "b": np.array([2], np.int64)}) \
+        .write.partitionBy("a").format("delta").save(path)
+    with pytest.raises(ValueError, match="partition"):
+        session.createDataFrame({"a": np.array([3], np.int64),
+                                 "b": np.array([4], np.int64)}) \
+            .write.mode("append").partitionBy("b").format("delta").save(path)
+
+
+def test_update_partition_column_errors(session, tmp_path):
+    path = str(tmp_path / "t")
+    session.createDataFrame({"k": np.array([1, 2], np.int64),
+                             "v": np.array([1.0, 2.0])}) \
+        .write.partitionBy("k").format("delta").save(path)
+    from spark_rapids_tpu import DeltaTable as DT
+    with pytest.raises(ValueError, match="partition columns"):
+        DT.forPath(session, path).update(F.col("v") > 0, set={"k": F.lit(9)})
+
+
+def test_checkpoint_carries_protocol_and_tombstones(session, tmp_path):
+    path = str(tmp_path / "t")
+    t = _mk(session, path, n=2)
+    t.delete(F.col("id") == 0)  # creates a tombstone
+    for i in range(10):
+        session.createDataFrame({"id": np.array([100 + i], np.int64),
+                                 "v": np.array([0.0])}) \
+            .write.mode("append").format("delta").save(path)
+    import pyarrow.parquet as pq
+    cps = glob.glob(os.path.join(path, "_delta_log", "*.checkpoint.parquet"))
+    assert cps
+    cp = pq.read_table(cps[0])
+    prot = [r for r in cp.column("protocol").to_pylist() if r]
+    rem = [r for r in cp.column("remove").to_pylist() if r]
+    assert prot and prot[0]["minReaderVersion"] >= 1
+    assert rem  # the deleted file's tombstone survives into the checkpoint
+    from spark_rapids_tpu.io.delta import DeltaSnapshot
+    assert DeltaSnapshot(path).protocol is not None
