@@ -97,6 +97,14 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
         t = pajson.read_json(path)
         if columns:
             t = t.select([c for c in columns if c in t.column_names])
+    elif fmt == "avro":
+        from .avro import read_avro
+        t = read_avro(path, columns=columns)
+    elif fmt == "hivetext":
+        from .hive_text import read_hive_text
+        t = read_hive_text(path, options)
+        if columns:
+            t = t.select([c for c in columns if c in t.column_names])
     else:
         raise ValueError(f"unknown scan format {fmt}")
     return t

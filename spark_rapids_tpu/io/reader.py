@@ -12,7 +12,7 @@ def _expand(paths) -> List[str]:
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            for ext in ("parquet", "orc", "csv", "json"):
+            for ext in ("parquet", "orc", "csv", "json", "avro", "txt"):
                 out.extend(sorted(_glob.glob(os.path.join(p, f"*.{ext}"))))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(_glob.glob(p)))
@@ -93,3 +93,14 @@ class DataFrameReader:
 
     def orc(self, path: str):
         return self._scan([path], "orc")
+
+    def avro(self, path: str):
+        """Reference GpuAvroScan (loaded via AvroProvider when spark-avro is
+        on the classpath); here avro is always available."""
+        return self._scan([path], "avro")
+
+    def hive_text(self, path: str, schema=None):
+        """Reference GpuHiveTableScanExec (LazySimpleSerDe delimited text)."""
+        if schema is not None:
+            self._schema = schema
+        return self._scan([path], "hivetext")

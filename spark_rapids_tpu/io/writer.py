@@ -41,7 +41,8 @@ class DataFrameWriter:
         if fmt == "delta":
             return self.delta(path)
         writers = {"parquet": self.parquet, "orc": self.orc, "csv": self.csv,
-                   "json": self.json}
+                   "json": self.json, "avro": self.avro,
+                   "hivetext": self.hive_text}
         if fmt not in writers:
             raise ValueError(f"unknown write format {fmt}")
         return writers[fmt](path)
@@ -138,3 +139,14 @@ class DataFrameWriter:
                 for row in t.to_pylist():
                     f.write(_json.dumps(row, default=str) + "\n")
         self._write(path, "json", write_json)
+
+    def avro(self, path: str) -> None:
+        from .avro import write_avro
+        codec = str(self._options.get("compression", "snappy")).lower()
+        codec = {"uncompressed": "null", "zstd": "zstandard"}.get(codec, codec)
+        self._write(path, "avro", lambda t, p: write_avro(t, p, codec=codec))
+
+    def hive_text(self, path: str) -> None:
+        from .hive_text import write_hive_text
+        opts = dict(self._options)
+        self._write(path, "txt", lambda t, p: write_hive_text(t, p, opts))

@@ -105,6 +105,15 @@ class FileScan(LogicalPlan):
         elif self.fmt == "json":
             import pyarrow.json as pajson
             sch = pajson.read_json(p).schema
+        elif self.fmt == "avro":
+            from ..io.avro import read_header, schema_to_arrow
+            with open(p, "rb") as f:
+                avro_schema, _, _, _ = read_header(f)
+            sch = pa.schema([(fl["name"], schema_to_arrow(fl["type"]))
+                             for fl in avro_schema["fields"]])
+        elif self.fmt == "hivetext":
+            from ..io.hive_text import infer_hive_schema
+            sch = infer_hive_schema(p, self.options)
         else:
             raise ValueError(f"unknown format {self.fmt}")
         return [AttributeReference(f.name, from_arrow(f.type), True) for f in sch]
