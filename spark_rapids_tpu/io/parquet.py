@@ -38,6 +38,11 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
     if fmt == "parquet":
         import pyarrow.parquet as pq
         dv_rows = (options or {}).get("__dv_rows__", {}).get(path)
+        fid_map = (options or {}).get("__iceberg_field_ids__")
+        if fid_map is not None:
+            from .iceberg import read_iceberg_parquet
+            return read_iceberg_parquet(path, columns, fid_map,
+                                        dv_rows=dv_rows)
         if dv_rows is not None:
             # deletion vector: positions are file-absolute, so read without
             # row-group filters, then drop deleted rows (delta DV read path)

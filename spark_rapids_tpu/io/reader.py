@@ -49,6 +49,8 @@ class DataFrameReader:
         fmt = self._options.pop("__format__", "parquet")
         if fmt == "delta":
             return self.delta(path)
+        if fmt == "iceberg":
+            return self.iceberg(path)
         return self._scan([path], fmt)
 
     def delta(self, path: str):
@@ -56,6 +58,17 @@ class DataFrameReader:
         version = self._options.get("versionAsOf")
         return read_delta(self._session, path,
                           version=None if version is None else int(version))
+
+    def iceberg(self, path: str):
+        """Reference IcebergProvider (ExternalSource.scala:41-66)."""
+        from .iceberg import read_iceberg
+        snap = self._options.get("snapshot-id",
+                                 self._options.get("snapshotId"))
+        ts = self._options.get("as-of-timestamp",
+                               self._options.get("timestampAsOf"))
+        return read_iceberg(self._session, path,
+                            snapshot_id=None if snap is None else int(snap),
+                            as_of_timestamp_ms=None if ts is None else int(ts))
 
     def _scan(self, paths, fmt: str):
         from ..plan.logical import FileScan

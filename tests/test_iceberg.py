@@ -1,0 +1,345 @@
+"""Iceberg provider tests (reference iceberg_test.py slice: snapshot reads,
+time travel, deletes, schema evolution by field id)."""
+
+import json
+import os
+import uuid
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.io.avro import write_avro
+from spark_rapids_tpu.io.iceberg import (IcebergTable, read_iceberg,
+                                         write_iceberg)
+
+
+def _table(n=100, base=0):
+    return pa.table({
+        "id": pa.array(range(base, base + n), type=pa.int64()),
+        "k": pa.array([i % 4 for i in range(base, base + n)],
+                      type=pa.int32()),
+        "v": pa.array([float(i) * 0.5 for i in range(base, base + n)]),
+        "s": pa.array([f"s{i % 9}" for i in range(base, base + n)]),
+    })
+
+
+def test_write_read_roundtrip(tmp_path, session):
+    p = str(tmp_path / "t")
+    write_iceberg(_table(200), p)
+    df = session.read.iceberg(p)
+    rows = df.collect()
+    assert len(rows) == 200
+    assert sorted(r["id"] for r in rows) == list(range(200))
+
+
+def test_append_and_time_travel(tmp_path, session):
+    p = str(tmp_path / "t")
+    write_iceberg(_table(100), p)
+    first_snap = IcebergTable(p).snapshot()["snapshot-id"]
+    write_iceberg(_table(50, base=100), p, mode="append")
+    assert len(session.read.iceberg(p).collect()) == 150
+    old = session.read.option("snapshot-id", first_snap).iceberg(p)
+    assert len(old.collect()) == 100
+
+
+def test_overwrite(tmp_path, session):
+    p = str(tmp_path / "t")
+    write_iceberg(_table(100), p)
+    write_iceberg(_table(30, base=500), p, mode="overwrite")
+    rows = session.read.iceberg(p).collect()
+    assert sorted(r["id"] for r in rows) == list(range(500, 530))
+
+
+def test_tpu_vs_cpu_query(tmp_path):
+    d = str(tmp_path / "t")
+    write_iceberg(_table(400), d)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.iceberg(d)
+        .filter(F.col("v") > 10.0)
+        .groupBy("k").agg(F.sum(F.col("v")).alias("sv"),
+                          F.count(F.col("id")).alias("c")),
+        ignore_order=True)
+
+
+def _add_position_deletes(table_path: str, data_file: str, positions):
+    """Author a v2 position-delete manifest against an existing table."""
+    meta_dir = os.path.join(table_path, "metadata")
+    t = IcebergTable(table_path)
+    del_path = os.path.join(table_path, "data",
+                            f"del-{uuid.uuid4().hex}.parquet")
+    pq.write_table(pa.table({
+        "file_path": pa.array([data_file] * len(positions)),
+        "pos": pa.array(positions, type=pa.int64()),
+    }), del_path)
+    manifest_rows = pa.table({
+        "status": pa.array([1], type=pa.int32()),
+        "snapshot_id": pa.array([999], type=pa.int64()),
+        "sequence_number": pa.array([99], type=pa.int64()),
+        "data_file": pa.array([{
+            "content": 1, "file_path": del_path, "file_format": "PARQUET",
+            "record_count": len(positions),
+            "file_size_in_bytes": os.path.getsize(del_path),
+        }], type=pa.struct([("content", pa.int32()),
+                            ("file_path", pa.string()),
+                            ("file_format", pa.string()),
+                            ("record_count", pa.int64()),
+                            ("file_size_in_bytes", pa.int64())])),
+    })
+    mpath = os.path.join(meta_dir, f"manifest-{uuid.uuid4().hex}.avro")
+    write_avro(manifest_rows, mpath, codec="deflate")
+    # extend the current snapshot's manifest list
+    from spark_rapids_tpu.io.avro import read_avro
+    snap = t.snapshot()
+    mlist = read_avro(t._resolve(snap["manifest-list"])).to_pylist()
+    mlist.append({"manifest_path": mpath,
+                  "manifest_length": os.path.getsize(mpath),
+                  "partition_spec_id": 0, "sequence_number": 99})
+    new_list = pa.table({
+        "manifest_path": pa.array([m["manifest_path"] for m in mlist]),
+        "manifest_length": pa.array([m["manifest_length"] for m in mlist],
+                                    type=pa.int64()),
+        "partition_spec_id": pa.array([m["partition_spec_id"] for m in mlist],
+                                      type=pa.int32()),
+        "sequence_number": pa.array([m["sequence_number"] for m in mlist],
+                                    type=pa.int64()),
+    })
+    nlp = os.path.join(meta_dir, f"snap-999-{uuid.uuid4().hex}.avro")
+    write_avro(new_list, nlp, codec="deflate")
+    meta = dict(t.meta)
+    for s in meta["snapshots"]:
+        if s["snapshot-id"] == snap["snapshot-id"]:
+            s["manifest-list"] = nlp
+    v = int(open(os.path.join(meta_dir, "version-hint.text")).read()) + 1
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(v))
+
+
+def test_position_deletes(tmp_path, session):
+    p = str(tmp_path / "t")
+    write_iceberg(_table(100), p)
+    t = IcebergTable(p)
+    data, _, _ = t.plan_scan(t.snapshot())
+    data_file = t._resolve(data[0]["file_path"])
+    _add_position_deletes(p, data_file, [0, 5, 7])
+    rows = session.read.iceberg(p).collect()
+    ids = sorted(r["id"] for r in rows)
+    assert len(ids) == 97 and 0 not in ids and 5 not in ids and 7 not in ids
+
+
+def test_equality_deletes(tmp_path, session):
+    p = str(tmp_path / "t")
+    write_iceberg(_table(100), p)
+    # author an equality-delete file on k (field id 2)
+    del_path = os.path.join(p, "data", f"eqdel-{uuid.uuid4().hex}.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array([1, 3], type=pa.int32()),
+    }).cast(pa.schema([pa.field("k", pa.int32(),
+                                metadata={b"PARQUET:field_id": b"2"})])),
+        del_path)
+    meta_dir = os.path.join(p, "metadata")
+    manifest_rows = pa.table({
+        "status": pa.array([1], type=pa.int32()),
+        "snapshot_id": pa.array([998], type=pa.int64()),
+        "sequence_number": pa.array([99], type=pa.int64()),
+        "data_file": pa.array([{
+            "content": 2, "file_path": del_path, "file_format": "PARQUET",
+            "record_count": 2, "file_size_in_bytes":
+                os.path.getsize(del_path),
+            "equality_ids": [2],
+        }], type=pa.struct([("content", pa.int32()),
+                            ("file_path", pa.string()),
+                            ("file_format", pa.string()),
+                            ("record_count", pa.int64()),
+                            ("file_size_in_bytes", pa.int64()),
+                            ("equality_ids", pa.list_(pa.int32()))])),
+    })
+    t = IcebergTable(p)
+    mpath = os.path.join(meta_dir, f"manifest-{uuid.uuid4().hex}.avro")
+    write_avro(manifest_rows, mpath, codec="deflate")
+    from spark_rapids_tpu.io.avro import read_avro
+    snap = t.snapshot()
+    mlist = read_avro(t._resolve(snap["manifest-list"])).to_pylist()
+    mlist.append({"manifest_path": mpath,
+                  "manifest_length": os.path.getsize(mpath),
+                  "partition_spec_id": 0, "sequence_number": 99})
+    new_list = pa.table({
+        "manifest_path": pa.array([m["manifest_path"] for m in mlist]),
+        "manifest_length": pa.array([m["manifest_length"] for m in mlist],
+                                    type=pa.int64()),
+        "partition_spec_id": pa.array([m["partition_spec_id"] for m in mlist],
+                                      type=pa.int32()),
+        "sequence_number": pa.array([m["sequence_number"] for m in mlist],
+                                    type=pa.int64()),
+    })
+    nlp = os.path.join(meta_dir, f"snap-998-{uuid.uuid4().hex}.avro")
+    write_avro(new_list, nlp, codec="deflate")
+    meta = dict(t.meta)
+    for s in meta["snapshots"]:
+        if s["snapshot-id"] == snap["snapshot-id"]:
+            s["manifest-list"] = nlp
+    v = int(open(os.path.join(meta_dir, "version-hint.text")).read()) + 1
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(v))
+
+    rows = session.read.iceberg(p).collect()
+    ks = {r["k"] for r in rows}
+    assert ks == {0, 2} and len(rows) == 50
+
+
+def test_equality_delete_sequence_scoping(tmp_path, session):
+    """v2 spec: an equality delete applies only to data files with a smaller
+    data sequence number — rows re-inserted after the delete must survive."""
+    p = str(tmp_path / "t")
+    write_iceberg(_table(20), p)          # seq 1: k in {0,1,2,3}
+    # author the equality delete at seq 99 (deletes k=1 from seq-1 files)
+    del_path = os.path.join(p, "data", f"eqdel-{uuid.uuid4().hex}.parquet")
+    pq.write_table(pa.table({"k": pa.array([1], type=pa.int32())}).cast(
+        pa.schema([pa.field("k", pa.int32(),
+                            metadata={b"PARQUET:field_id": b"2"})])), del_path)
+    t = IcebergTable(p)
+    meta_dir = os.path.join(p, "metadata")
+    manifest_rows = pa.table({
+        "status": pa.array([1], type=pa.int32()),
+        "snapshot_id": pa.array([998], type=pa.int64()),
+        "sequence_number": pa.array([99], type=pa.int64()),
+        "data_file": pa.array([{
+            "content": 2, "file_path": del_path, "file_format": "PARQUET",
+            "record_count": 1,
+            "file_size_in_bytes": os.path.getsize(del_path),
+            "equality_ids": [2],
+        }], type=pa.struct([("content", pa.int32()),
+                            ("file_path", pa.string()),
+                            ("file_format", pa.string()),
+                            ("record_count", pa.int64()),
+                            ("file_size_in_bytes", pa.int64()),
+                            ("equality_ids", pa.list_(pa.int32()))])),
+    })
+    mpath = os.path.join(meta_dir, f"manifest-{uuid.uuid4().hex}.avro")
+    write_avro(manifest_rows, mpath, codec="deflate")
+    from spark_rapids_tpu.io.avro import read_avro
+    snap = t.snapshot()
+    mlist = read_avro(t._resolve(snap["manifest-list"])).to_pylist()
+    mlist.append({"manifest_path": mpath,
+                  "manifest_length": os.path.getsize(mpath),
+                  "partition_spec_id": 0, "sequence_number": 99})
+    new_list = pa.table({
+        "manifest_path": pa.array([m["manifest_path"] for m in mlist]),
+        "manifest_length": pa.array([m["manifest_length"] for m in mlist],
+                                    type=pa.int64()),
+        "partition_spec_id": pa.array([m["partition_spec_id"] for m in mlist],
+                                      type=pa.int32()),
+        "sequence_number": pa.array([m["sequence_number"] for m in mlist],
+                                    type=pa.int64()),
+    })
+    nlp = os.path.join(meta_dir, f"snap-998b-{uuid.uuid4().hex}.avro")
+    write_avro(new_list, nlp, codec="deflate")
+    meta = dict(t.meta)
+    for s in meta["snapshots"]:
+        if s["snapshot-id"] == snap["snapshot-id"]:
+            s["manifest-list"] = nlp
+    meta["last-sequence-number"] = 99  # next append lands at seq 100 > 99
+    v = int(open(os.path.join(meta_dir, "version-hint.text")).read()) + 1
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(v))
+    # re-insert k=1 rows AFTER the delete (data seq 100)
+    write_iceberg(pa.table({
+        "id": pa.array([900, 901], type=pa.int64()),
+        "k": pa.array([1, 1], type=pa.int32()),
+        "v": pa.array([9.0, 9.5]),
+        "s": pa.array(["z", "z"]),
+    }), p, mode="append")
+    rows = session.read.iceberg(p).collect()
+    k1_ids = sorted(r["id"] for r in rows if r["k"] == 1)
+    # the 5 original k=1 rows (ids 1,5,9,13,17) are deleted; 900/901 survive
+    assert k1_ids == [900, 901]
+    assert len(rows) == 15 + 2
+
+
+def test_append_reordered_columns_keeps_field_ids(tmp_path, session):
+    """Appending a batch with a different column order must not renumber
+    field ids (data would silently swap otherwise)."""
+    p = str(tmp_path / "t")
+    write_iceberg(_table(10), p)
+    reordered = pa.table({
+        "k": pa.array([7, 7], type=pa.int32()),
+        "id": pa.array([100, 101], type=pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+        "s": pa.array(["a", "b"]),
+    })
+    write_iceberg(reordered, p, mode="append")
+    rows = session.read.iceberg(p).collect()
+    assert len(rows) == 12
+    by_id = {r["id"]: r for r in rows}
+    assert by_id[100]["k"] == 7 and by_id[0]["k"] == 0
+    # ids unchanged: v still resolves for both old and new files
+    assert by_id[100]["v"] == 1.0
+
+
+def test_schema_evolution_rename(tmp_path, session):
+    """Rename a column in metadata only: reads must resolve via field id."""
+    p = str(tmp_path / "t")
+    write_iceberg(_table(60), p)
+    meta_dir = os.path.join(p, "metadata")
+    t = IcebergTable(p)
+    meta = dict(t.meta)
+    for f in meta["schemas"][0]["fields"]:
+        if f["name"] == "v":
+            f["name"] = "value_renamed"
+    v = int(open(os.path.join(meta_dir, "version-hint.text")).read()) + 1
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(v))
+    df = session.read.iceberg(p)
+    assert "value_renamed" in [a.name for a in df._plan.output]
+    rows = df.select(F.col("value_renamed")).collect()
+    assert len(rows) == 60
+    assert sorted(r["value_renamed"] for r in rows)[:3] == [0.0, 0.5, 1.0]
+
+
+def test_schema_evolution_add_column(tmp_path, session):
+    """Column added after a file was written reads as nulls for old files."""
+    p = str(tmp_path / "t")
+    write_iceberg(_table(40), p)
+    meta_dir = os.path.join(p, "metadata")
+    t = IcebergTable(p)
+    meta = dict(t.meta)
+    meta["schemas"][0]["fields"].append(
+        {"id": 99, "name": "extra", "required": False, "type": "long"})
+    v = int(open(os.path.join(meta_dir, "version-hint.text")).read()) + 1
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(v))
+    rows = session.read.iceberg(p).collect()
+    assert len(rows) == 40 and all(r["extra"] is None for r in rows)
+
+
+def test_empty_table(tmp_path, session):
+    """Metadata with no snapshots reads as an empty, correctly-typed frame."""
+    p = str(tmp_path / "t")
+    write_iceberg(_table(10), p)
+    meta_dir = os.path.join(p, "metadata")
+    t = IcebergTable(p)
+    meta = dict(t.meta)
+    meta["snapshots"] = []
+    meta.pop("current-snapshot-id", None)
+    v = int(open(os.path.join(meta_dir, "version-hint.text")).read()) + 1
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(v))
+    df = session.read.iceberg(p)
+    assert df.collect() == []
+    assert [a.name for a in df._plan.output] == ["id", "k", "v", "s"]
