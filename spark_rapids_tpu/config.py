@@ -292,6 +292,14 @@ JSON_ENABLED = _conf("spark.rapids.sql.format.json.enabled").doc(
     "Enable TPU JSON scans.").boolean(True)
 ORC_ENABLED = _conf("spark.rapids.sql.format.orc.enabled").doc(
     "Enable TPU ORC scans/writes.").boolean(True)
+AVRO_ENABLED = _conf("spark.rapids.sql.format.avro.enabled").doc(
+    "Enable TPU Avro scans.").boolean(True)
+HIVE_TEXT_ENABLED = _conf("spark.rapids.sql.format.hive.text.enabled").doc(
+    "Enable TPU Hive delimited-text scans/writes.").boolean(True)
+UDF_COMPILER_ENABLED = _conf("spark.rapids.sql.udfCompiler.enabled").doc(
+    "Translate row python UDF bytecode into columnar device expressions "
+    "where possible (reference udf-compiler/ LogicalPlanRules); "
+    "untranslatable UDFs keep the row fallback.").boolean(False)
 
 # ---------------------------------------------------------------------------
 # Operator toggles (reference: spark.rapids.sql.exec.* generated per rule)

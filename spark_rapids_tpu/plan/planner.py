@@ -16,6 +16,16 @@ from ..execs.base import PhysicalPlan
 from . import logical as L
 
 
+def _compile_udfs(exprs, conf: RapidsConf):
+    """Reference udf-compiler LogicalPlanRules hook: rewrite row python UDFs
+    into columnar expression trees when the compiler is enabled."""
+    from ..config import UDF_COMPILER_ENABLED
+    if not conf.get(UDF_COMPILER_ENABLED):
+        return list(exprs)
+    from ..udf_compiler import rewrite_compiled_udfs
+    return [rewrite_compiled_udfs(e, conf) for e in exprs]
+
+
 def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
     from ..io.cache import CachedRelation
     if isinstance(plan, CachedRelation):
@@ -32,7 +42,8 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
                                num_partitions=plan.num_partitions)
     if isinstance(plan, L.Project):
         child = plan_physical(plan.child, conf)
-        return CE.CpuProjectExec(plan.exprs, child, plan.output)
+        return CE.CpuProjectExec(_compile_udfs(plan.exprs, conf), child,
+                                 plan.output)
     if isinstance(plan, L.Filter):
         child = plan_physical(plan.child, conf)
         if isinstance(plan.child, L.FileScan):
@@ -47,7 +58,8 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
                                         pushed_filters=pushed,
                                         options=child.options,
                                         num_partitions=child.num_partitions())
-        return CE.CpuFilterExec(plan.condition, child)
+        return CE.CpuFilterExec(_compile_udfs([plan.condition], conf)[0],
+                                child)
     if isinstance(plan, L.Limit):
         child = plan_physical(plan.children[0], conf)
         return CE.CpuGlobalLimitExec(plan.n, CE.CpuLocalLimitExec(plan.n, child),

@@ -129,6 +129,8 @@ class RowPythonUDF(ArrowPandasUDF):
 
     def __init__(self, fn: Callable, return_type: DataType,
                  children: Sequence[Expression], name: str = "udf"):
+        self.row_fn = fn  # kept for the UDF compiler (udf_compiler.py)
+
         def batch_fn(*arrays):
             import pyarrow as pa
             from .types import to_arrow

@@ -1,0 +1,426 @@
+"""UDF compiler: Python bytecode → columnar expression tree.
+
+Reference: udf-compiler/ (5809 LoC) — CFG extraction (CFG.scala) + abstract
+interpretation of JVM opcodes rebuilding Catalyst expressions
+(CatalystExpressionBuilder.scala:45), injected as a logical rule
+(LogicalPlanRules.scala:29) behind `spark.rapids.sql.udfCompiler.enabled`.
+
+TPU analogue: abstract interpretation of CPython bytecode (`dis`) over a
+symbolic value stack. Straight-line arithmetic/comparison/boolean logic,
+conditional expressions (both branches executed symbolically and merged with
+`If`), `is None` tests, `in (tuple)` membership, math.* / builtins calls.
+Anything else — loops, stores, attribute access, unknown globals — makes
+`compile_python_udf` return None and the UDF stays a row-python fallback,
+mirroring the reference's bail-to-CPU contract.
+"""
+
+from __future__ import annotations
+
+import dis
+import math
+from typing import Any, Callable, List, Optional, Sequence
+
+from .expressions.arithmetic import (Abs, Add, Divide, IntegralDivide,
+                                     Multiply, Remainder, Subtract, UnaryMinus)
+from .expressions.base import Expression, Literal
+from .expressions.bitwise import (BitwiseAnd, BitwiseNot, BitwiseOr,
+                                  BitwiseXor, ShiftLeft, ShiftRight)
+from .expressions.cast import Cast
+from .expressions.conditional import Greatest, If, Least
+from .expressions.mathexprs import (Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos,
+                                    Cosh, Exp, Expm1, Floor, Log, Log1p, Log2,
+                                    Log10, Pow, Signum, Sin, Sinh, Sqrt, Tan,
+                                    Tanh)
+from .expressions.nullexprs import IsNotNull, IsNull
+from .expressions.predicates import (EqualTo, GreaterThan, GreaterThanOrEqual,
+                                     In, LessThan, LessThanOrEqual, Not)
+from .types import BooleanType, DataType
+
+_MAX_STEPS = 500
+
+
+class _Bail(Exception):
+    """Untranslatable construct — fall back to the row UDF."""
+
+
+def _bin(cls):
+    return lambda a, b: cls(a, b)
+
+
+_MATH_FNS = {
+    math.sqrt: lambda x: Sqrt(x),
+    math.exp: lambda x: Exp(x),
+    math.expm1: lambda x: Expm1(x),
+    math.log: lambda x, *rest: Log(x) if not rest else Divide(Log(x),
+                                                              Log(rest[0])),
+    math.log10: lambda x: Log10(x),
+    math.log2: lambda x: Log2(x),
+    math.log1p: lambda x: Log1p(x),
+    math.sin: lambda x: Sin(x),
+    math.cos: lambda x: Cos(x),
+    math.tan: lambda x: Tan(x),
+    math.asin: lambda x: Asin(x),
+    math.acos: lambda x: Acos(x),
+    math.atan: lambda x: Atan(x),
+    math.atan2: lambda y, x: Atan2(y, x),
+    math.sinh: lambda x: Sinh(x),
+    math.cosh: lambda x: Cosh(x),
+    math.tanh: lambda x: Tanh(x),
+    math.floor: lambda x: Floor(x),
+    math.ceil: lambda x: Ceil(x),
+    math.pow: lambda a, b: Pow(a, b),
+    math.cbrt: lambda x: Cbrt(x),
+    math.fabs: lambda x: Abs(x),
+    abs: lambda x: Abs(x),
+    max: lambda *xs: Greatest(*xs),
+    min: lambda *xs: Least(*xs),
+}
+
+def _is_float(dt: DataType) -> bool:
+    from .types import DoubleType, FloatType
+    return isinstance(dt, (DoubleType, FloatType))
+
+
+def _promote(a: Expression, b: Expression):
+    """Python numeric semantics: any float operand → double math; integer
+    math widens to long (Python ints don't overflow at 32 bits)."""
+    from .types import DoubleType, IntegralType, LongType
+    target: DataType
+    if _is_float(a.dtype) or _is_float(b.dtype):
+        target = DoubleType()
+    elif isinstance(a.dtype, IntegralType) and isinstance(b.dtype,
+                                                          IntegralType):
+        target = LongType()
+    else:
+        return a, b
+    if a.dtype != target:
+        a = Cast(a, target)
+    if b.dtype != target:
+        b = Cast(b, target)
+    return a, b
+
+
+def _py_arith(cls):
+    def build(a, b):
+        a, b = _promote(a, b)
+        return cls(a, b)
+    return build
+
+
+def _py_truediv(a, b):
+    from .types import DoubleType
+    if not _is_float(a.dtype):
+        a = Cast(a, DoubleType())
+    if not _is_float(b.dtype):
+        b = Cast(b, DoubleType())
+    return Divide(a, b)  # Python / is always float division
+
+
+def _py_floordiv(a, b):
+    from .types import DoubleType, IntegralType
+    if isinstance(a.dtype, IntegralType) and isinstance(b.dtype,
+                                                        IntegralType):
+        # exact integer path (doubles lose precision past 2^53): Spark's
+        # integral divide truncates toward zero, Python floors — subtract 1
+        # when the remainder is non-zero and the signs differ
+        a, b = _promote(a, b)
+        q = IntegralDivide(a, b)
+        r = Remainder(a, b)
+        zero = Literal(0)
+        signs_differ = Not(EqualTo(LessThan(a, zero), LessThan(b, zero)))
+        adjust = If(Not(EqualTo(r, zero)), signs_differ, Literal(False))
+        return If(adjust, Subtract(q, Literal(1)), q)
+    e = Floor(_py_truediv(a, b))  # Python // floors; Spark floor(double)→long
+    return Cast(e, DoubleType())
+
+
+def _py_mod(a, b):
+    # Python % sign follows the divisor; Spark Remainder follows the
+    # dividend: ((a % b) + b) % b matches Python for both signs (and stays
+    # exact on the integer path).
+    from .types import IntegralType
+    a, b = _promote(a, b)
+    if isinstance(a.dtype, IntegralType) and isinstance(b.dtype,
+                                                        IntegralType):
+        return Remainder(Add(Remainder(a, b), b), b)
+    q = _py_floordiv(a, b)
+    if q.dtype != a.dtype:
+        q = Cast(q, a.dtype)
+    return Subtract(a, Multiply(q, b))
+
+
+def _py_shift(cls):
+    def build(a, b):
+        from .types import IntegralType, LongType
+        if isinstance(a.dtype, IntegralType) and \
+                not isinstance(a.dtype, LongType):
+            a = Cast(a, LongType())  # Python ints don't wrap at 32 bits
+        return cls(a, b)
+    return build
+
+
+_BINOPS = {
+    "+": _py_arith(Add), "-": _py_arith(Subtract), "*": _py_arith(Multiply),
+    "/": _py_truediv, "//": _py_floordiv, "%": _py_mod,
+    "**": _py_arith(Pow), "&": _bin(BitwiseAnd), "|": _bin(BitwiseOr),
+    "^": _bin(BitwiseXor), "<<": _py_shift(ShiftLeft),
+    ">>": _py_shift(ShiftRight),
+}
+
+
+def _py_cmp(cls, nan_result: bool = False, null_result: Optional[bool] = None):
+    """Python/IEEE comparison semantics: any NaN operand makes <,<=,>,>=,==
+    False and != True (Spark instead orders NaN largest, hence the explicit
+    guard). For == / !=, a None operand yields False / True in Python while
+    SQL yields NULL — null_result pins the Python answer."""
+    def build(a, b):
+        from .expressions.nullexprs import IsNaN
+        from .expressions.predicates import Or
+        a, b = _promote(a, b)
+        e: Expression = cls(a, b)
+        nan_checks = [IsNaN(x) for x in (a, b) if _is_float(x.dtype)]
+        if nan_checks:
+            any_nan = nan_checks[0] if len(nan_checks) == 1 \
+                else Or(nan_checks[0], nan_checks[1])
+            e = If(any_nan, Literal(nan_result), e)
+        if null_result is not None:
+            null_checks = [IsNull(x) for x in (a, b) if x.nullable]
+            if null_checks:
+                any_null = null_checks[0] if len(null_checks) == 1 \
+                    else Or(null_checks[0], null_checks[1])
+                e = If(any_null, Literal(null_result), e)
+        return e
+    return build
+
+
+_CMPOPS = {
+    "<": _py_cmp(LessThan), "<=": _py_cmp(LessThanOrEqual),
+    "==": _py_cmp(EqualTo, null_result=False),
+    "!=": _py_cmp(lambda x, y: Not(EqualTo(x, y)), nan_result=True,
+                  null_result=True),
+    ">": _py_cmp(GreaterThan), ">=": _py_cmp(GreaterThanOrEqual),
+}
+
+
+def _as_expr(v: Any) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    if callable(v):
+        raise _Bail("callable left on stack")
+    return Literal(v)
+
+
+def _truthy(v: Any) -> Expression:
+    e = _as_expr(v)
+    if not isinstance(e.dtype, BooleanType):
+        raise _Bail("non-boolean branch condition")
+    return e
+
+
+class _SymExec:
+    def __init__(self, fn: Callable, args: Sequence[Expression]):
+        self.fn = fn
+        self.args = list(args)
+        code = fn.__code__
+        if code.co_argcount != len(args):
+            raise _Bail("arity mismatch")
+        self.instrs = list(dis.get_instructions(fn))
+        self.by_offset = {i.offset: idx for idx, i in enumerate(self.instrs)}
+        self.steps = 0
+
+    def resolve_global(self, name: str) -> Any:
+        if name in self.fn.__globals__:
+            return self.fn.__globals__[name]
+        import builtins
+        if hasattr(builtins, name):
+            return getattr(builtins, name)
+        raise _Bail(f"unknown global {name}")
+
+    def run(self, idx: int, stack: List[Any]) -> Expression:
+        instrs = self.instrs
+        while True:
+            self.steps += 1
+            if self.steps > _MAX_STEPS:
+                raise _Bail("too many steps (loop?)")
+            instr = instrs[idx]
+            op = instr.opname
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL", "PUSH_NULL",
+                      "EXTENDED_ARG", "MAKE_CELL", "COPY_FREE_VARS"):
+                idx += 1
+            elif op == "RETURN_VALUE":
+                return _as_expr(stack.pop())
+            elif op == "RETURN_CONST":
+                return Literal(instr.argval)
+            elif op in ("LOAD_FAST", "LOAD_FAST_CHECK",
+                        "LOAD_FAST_AND_CLEAR"):
+                vi = self.fn.__code__.co_varnames.index(instr.argval)
+                if vi >= len(self.args):
+                    raise _Bail("local variable store/load unsupported")
+                stack.append(self.args[vi])
+                idx += 1
+            elif op == "LOAD_CONST":
+                stack.append(instr.argval)
+                idx += 1
+            elif op == "LOAD_DEREF":
+                # closure cell holding a plain scalar → literal
+                names = (self.fn.__code__.co_cellvars
+                         + self.fn.__code__.co_freevars)
+                ci = names.index(instr.argval)
+                cells = (self.fn.__closure__ or ())
+                if ci >= len(cells):
+                    raise _Bail("cellvar unsupported")
+                stack.append(cells[ci].cell_contents)
+                idx += 1
+            elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
+                stack.append(self.resolve_global(instr.argval))
+                idx += 1
+            elif op == "LOAD_ATTR":
+                base = stack.pop()
+                if isinstance(base, Expression):
+                    raise _Bail("attribute access on column")
+                stack.append(getattr(base, instr.argval.strip("()")
+                                     if isinstance(instr.argval, str)
+                                     else instr.argval))
+                idx += 1
+            elif op == "LOAD_METHOD":
+                base = stack.pop()
+                stack.append(getattr(base, instr.argval))
+                idx += 1
+            elif op == "BINARY_OP":
+                rhs, lhs = stack.pop(), stack.pop()
+                sym = instr.argrepr.rstrip("=") or instr.argrepr
+                if sym not in _BINOPS:
+                    raise _Bail(f"binary op {instr.argrepr}")
+                if isinstance(lhs, Expression) or isinstance(rhs, Expression):
+                    stack.append(_BINOPS[sym](_as_expr(lhs), _as_expr(rhs)))
+                else:  # pure-constant folding on host
+                    stack.append(self._const_binop(sym, lhs, rhs))
+                idx += 1
+            elif op == "COMPARE_OP":
+                rhs, lhs = stack.pop(), stack.pop()
+                sym = instr.argrepr.replace("bool(", "").rstrip(")")
+                if sym not in _CMPOPS:
+                    raise _Bail(f"compare {instr.argrepr}")
+                stack.append(_CMPOPS[sym](_as_expr(lhs), _as_expr(rhs)))
+                idx += 1
+            elif op == "IS_OP":
+                rhs, lhs = stack.pop(), stack.pop()
+                if rhs is not None:
+                    raise _Bail("'is' against non-None")
+                e = IsNull(_as_expr(lhs))
+                stack.append(Not(e) if instr.arg == 1 else e)
+                idx += 1
+            elif op == "CONTAINS_OP":
+                container, needle = stack.pop(), stack.pop()
+                if isinstance(container, Expression):
+                    raise _Bail("'in' over a column")
+                items = [Literal(x) for x in container]
+                ne = _as_expr(needle)
+                e: Expression = In(ne, items)
+                if ne.nullable:
+                    # Python: None in (…) → False (SQL IN would give NULL)
+                    e = If(IsNull(ne), Literal(False), e)
+                stack.append(Not(e) if instr.arg == 1 else e)
+                idx += 1
+            elif op == "UNARY_NEGATIVE":
+                from .types import IntegralType, LongType
+                e = _as_expr(stack.pop())
+                if isinstance(e.dtype, IntegralType) and \
+                        not isinstance(e.dtype, LongType):
+                    e = Cast(e, LongType())  # Python ints don't wrap at 32 bit
+                stack.append(UnaryMinus(e))
+                idx += 1
+            elif op == "UNARY_NOT":
+                stack.append(Not(_truthy(stack.pop())))
+                idx += 1
+            elif op == "UNARY_INVERT":
+                stack.append(BitwiseNot(_as_expr(stack.pop())))
+                idx += 1
+            elif op == "COPY":
+                stack.append(stack[-instr.arg])
+                idx += 1
+            elif op == "SWAP":
+                stack[-1], stack[-instr.arg] = stack[-instr.arg], stack[-1]
+                idx += 1
+            elif op == "POP_TOP":
+                stack.pop()
+                idx += 1
+            elif op in ("JUMP_FORWARD", "JUMP_ABSOLUTE"):
+                idx = self.by_offset[instr.argval]
+            elif op == "JUMP_BACKWARD":
+                raise _Bail("loop")
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                cond = _truthy(stack.pop())
+                jump_idx = self.by_offset[instr.argval]
+                fall = self.run(idx + 1, list(stack))
+                jumped = self.run(jump_idx, list(stack))
+                if op == "POP_JUMP_IF_FALSE":
+                    return If(cond, fall, jumped)
+                return If(cond, jumped, fall)
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                v = _as_expr(stack.pop())
+                cond = IsNull(v)
+                jump_idx = self.by_offset[instr.argval]
+                fall = self.run(idx + 1, list(stack))
+                jumped = self.run(jump_idx, list(stack))
+                if op == "POP_JUMP_IF_NONE":
+                    return If(cond, jumped, fall)
+                return If(cond, fall, jumped)
+            elif op == "CALL":
+                # NULL sentinels (PUSH_NULL / LOAD_GLOBAL push-null bit) are
+                # never materialized on our symbolic stack, so the layout here
+                # is simply [callable, arg0..argN-1]
+                argc = instr.arg
+                call_args = [stack.pop() for _ in range(argc)][::-1]
+                callee = stack.pop()
+                builder = _MATH_FNS.get(callee)
+                if builder is None:
+                    raise _Bail(f"call to {callee}")
+                if all(not isinstance(a, Expression) for a in call_args):
+                    stack.append(callee(*call_args))  # pure-constant call
+                else:
+                    stack.append(builder(*[_as_expr(a) for a in call_args]))
+                idx += 1
+            elif op == "KW_NAMES":
+                raise _Bail("keyword arguments")
+            else:
+                raise _Bail(f"opcode {op}")
+
+    @staticmethod
+    def _const_binop(sym: str, a, b):
+        import operator
+        ops = {"+": operator.add, "-": operator.sub, "*": operator.mul,
+               "/": operator.truediv, "//": operator.floordiv,
+               "%": operator.mod, "**": operator.pow, "&": operator.and_,
+               "|": operator.or_, "^": operator.xor, "<<": operator.lshift,
+               ">>": operator.rshift}
+        return ops[sym](a, b)
+
+
+def compile_python_udf(fn: Callable, children: Sequence[Expression],
+                       return_type: DataType) -> Optional[Expression]:
+    """Try to rebuild `fn` as a columnar expression over `children`;
+    None ⇒ keep the row-python fallback (reference bail contract)."""
+    try:
+        ex = _SymExec(fn, children)
+        result = ex.run(0, [])
+    except _Bail:
+        return None
+    except Exception:  # malformed bytecode patterns: never break planning
+        return None
+    if result.dtype != return_type:
+        result = Cast(result, return_type)
+    return result
+
+
+def rewrite_compiled_udfs(expr: Expression, conf) -> Expression:
+    """transformUp replacing RowPythonUDF nodes whose lambdas compile
+    (reference LogicalPlanRules injection point)."""
+    from .udf import RowPythonUDF
+
+    def replace(e: Expression) -> Optional[Expression]:
+        if isinstance(e, RowPythonUDF) and getattr(e, "row_fn", None):
+            return compile_python_udf(e.row_fn, list(e.children), e.dtype)
+        return None
+
+    return expr.transform(replace)
