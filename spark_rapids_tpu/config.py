@@ -296,6 +296,22 @@ AVRO_ENABLED = _conf("spark.rapids.sql.format.avro.enabled").doc(
     "Enable TPU Avro scans.").boolean(True)
 HIVE_TEXT_ENABLED = _conf("spark.rapids.sql.format.hive.text.enabled").doc(
     "Enable TPU Hive delimited-text scans/writes.").boolean(True)
+OPTIMIZER_ENABLED = _conf("spark.rapids.sql.optimizer.enabled").doc(
+    "Cost-based optimizer: revert plan sections whose estimated TPU cost "
+    "(incl. transitions) exceeds the CPU cost (reference "
+    "CostBasedOptimizer.scala).").boolean(False)
+OPTIMIZER_CPU_ROW_COST = _conf(
+    "spark.rapids.sql.optimizer.cpu.exec.defaultRowCost").doc(
+    "Default per-row CPU operator cost for the CBO.").double(0.0002)
+OPTIMIZER_TPU_ROW_COST = _conf(
+    "spark.rapids.sql.optimizer.tpu.exec.defaultRowCost").doc(
+    "Default per-row TPU operator cost for the CBO.").double(0.0001)
+OPTIMIZER_TRANSITION_ROW_COST = _conf(
+    "spark.rapids.sql.optimizer.transitionRowCost").doc(
+    "Per-row cost charged for each row↔columnar transition at a section "
+    "boundary. Kept low by default: every pipeline here starts host-side, "
+    "so the upload edge is priced as one amortized copy, not a per-operator "
+    "penalty.").double(0.00002)
 UDF_COMPILER_ENABLED = _conf("spark.rapids.sql.udfCompiler.enabled").doc(
     "Translate row python UDF bytecode into columnar device expressions "
     "where possible (reference udf-compiler/ LogicalPlanRules); "

@@ -216,9 +216,20 @@ def sort_table(table, order: List[SortOrder], ctx: TaskContext):
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
         is_null = pc.is_null(arr)
+        # flag levels sorted ascending: nulls-first nulls (0) < NaN-in-desc
+        # (1) < values (2) < NaN-in-asc (3) < nulls-last nulls (4). Spark
+        # orders NaN greater than every number (desc ⇒ NaN leads), which
+        # arrow's own NaN placement does not honor in descending order.
         flag = pc.if_else(is_null,
-                          pa.scalar(0 if o.nulls_first else 1, pa.int8()),
-                          pa.scalar(1 if o.nulls_first else 0, pa.int8()))
+                          pa.scalar(0 if o.nulls_first else 4, pa.int8()),
+                          pa.scalar(2, pa.int8()))
+        if pa.types.is_floating(arr.type):
+            is_nan = pc.and_(pc.is_nan(pc.fill_null(arr, 0.0)),
+                             pc.invert(is_null))
+            flag = pc.if_else(is_nan,
+                              pa.scalar(3 if o.ascending else 1, pa.int8()),
+                              flag)
+            arr = pc.if_else(is_nan, pa.scalar(0.0, arr.type), arr)
         sort_cols[f"__nf_{i}"] = flag
         sort_keys.append((f"__nf_{i}", "ascending"))
         sort_cols[f"__sv_{i}"] = arr
@@ -229,6 +240,48 @@ def sort_table(table, order: List[SortOrder], ctx: TaskContext):
     idx = pc.sort_indices(key_table, sort_keys=sort_keys,
                           null_placement="at_end")
     return table.take(idx)
+
+
+class CpuTopNExec(CpuExec):
+    """Sort+slice fusion of Limit(Sort) (reference TakeOrderedAndProject /
+    GpuTopN): per-partition top-N then a single merge, no global sort."""
+
+    def __init__(self, n: int, order: List[SortOrder], child: PhysicalPlan,
+                 offset: int = 0):
+        super().__init__([child])
+        self.n = n
+        self.offset = offset
+        self.order = [SortOrder(bind_references(o.child, child.output),
+                                o.ascending, o.nulls_first) for o in order]
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"CpuTopN[n={self.n}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        keep = self.offset + self.n
+        tops = []
+        for p in range(self.children[0].num_partitions()):
+            running = None
+            for t in self.children[0].execute_partition(p, ctx):
+                cand = t if running is None else \
+                    pa.concat_tables([running, t])
+                running = sort_table(cand, self.order, ctx).slice(0, keep)
+            if running is not None:
+                tops.append(running)
+        if not tops:
+            return
+        whole = sort_table(pa.concat_tables(tops), self.order, ctx)
+        out = whole.slice(self.offset, self.n)
+        if out.num_rows:
+            yield out
 
 
 class CpuSortExec(CpuExec):

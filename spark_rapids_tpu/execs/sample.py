@@ -1,0 +1,166 @@
+"""Sample execs: Bernoulli / Poisson row sampling.
+
+Reference: GpuSampleExec (basicPhysicalOperators.scala:873 — host
+RandomSampler parity) and GpuFastSampleExec (:948 — device RNG, results
+differ from CPU Spark and are gated by `spark.rapids.sql.fast.sample`).
+
+TPU design: a counter-based hash RNG (murmur3-style 32-bit finalizer over
+``(seed, partition, row_index)``) evaluated identically in numpy (CPU exec)
+and jax (TPU exec), so TPU and CPU sessions produce *identical* samples for a
+given seed — stronger than the reference, where only the non-default fast
+sampler runs on device. Without replacement: keep rows whose uniform is below
+the fraction. With replacement: per-row Poisson(fraction) counts via
+inverse-CDF on the same uniform, rows repeated count times.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..columnar.batch import TpuColumnarBatch, compact, gather
+from .base import CpuExec, PhysicalPlan, TaskContext, TpuExec
+
+_C1 = np.uint32(0x85EBCA6B)
+_C2 = np.uint32(0xC2B2AE35)
+_GOLDEN = np.uint32(0x9E3779B1)
+
+
+def _mix_np(h):
+    h = h.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h *= _C1
+    h ^= h >> np.uint32(13)
+    h *= _C2
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def _uniform_np(seed: int, part: int, start: int, n: int) -> np.ndarray:
+    idx = np.arange(start, start + n, dtype=np.uint32)
+    s = ((seed & 0xFFFFFFFF) * 0x9E3779B1) & 0xFFFFFFFF
+    p = (part * 0x85EBCA6B) & 0xFFFFFFFF
+    h = idx ^ np.uint32(s) ^ np.uint32(p)
+    return _mix_np(h).astype(np.float64) / float(1 << 32)
+
+
+def _uniform_jnp(seed: int, part: int, start: int, n: int):
+    """Same bit pattern as _uniform_np, in uint32 jax ops."""
+    import jax.numpy as jnp
+    idx = jnp.arange(start, start + n, dtype=jnp.uint32)
+    h = idx ^ jnp.uint32((seed & 0xFFFFFFFF) * 0x9E3779B1 & 0xFFFFFFFF) \
+        ^ jnp.uint32((part * 0x85EBCA6B) & 0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h.astype(jnp.float64) / float(1 << 32)
+
+
+def _poisson_thresholds(lam: float) -> List[float]:
+    """Cumulative P(X<=k); count = searchsorted(thresholds, u). The tail is
+    carried far enough past the mean that clamping bias is negligible."""
+    max_k = max(16, int(lam + 10.0 * math.sqrt(lam) + 10.0))
+    p = math.exp(-lam)
+    cum = p
+    out = [cum]
+    for k in range(1, max_k + 1):
+        p *= lam / k
+        cum += p
+        out.append(cum)
+        if cum > 1.0 - 1e-12:
+            break
+    return out
+
+
+class _SampleBase:
+    def _counts(self, uniform) -> Optional[np.ndarray]:
+        """With-replacement repeat counts (host numpy), else None."""
+        if not self.with_replacement:
+            return None
+        th = np.array(_poisson_thresholds(self.fraction))
+        return np.searchsorted(th, np.asarray(uniform), side="right")
+
+
+class CpuSampleExec(_SampleBase, CpuExec):
+    def __init__(self, fraction: float, with_replacement: bool, seed: int,
+                 child: PhysicalPlan):
+        CpuExec.__init__(self, [child])
+        self.fraction = fraction
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        return f"CpuSample[{self.fraction}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        start = 0
+        for t in self.children[0].execute_partition(idx, ctx):
+            u = _uniform_np(self.seed, idx, start, t.num_rows)
+            start += t.num_rows
+            if self.with_replacement:
+                counts = self._counts(u)
+                indices = np.repeat(np.arange(t.num_rows), counts)
+                if len(indices):
+                    yield t.take(pa.array(indices))
+            else:
+                keep = u < self.fraction
+                if keep.any():
+                    yield t.filter(pa.array(keep))
+
+
+class TpuSampleExec(_SampleBase, TpuExec):
+    def __init__(self, fraction: float, with_replacement: bool, seed: int,
+                 child: PhysicalPlan):
+        TpuExec.__init__(self, [child])
+        self.fraction = fraction
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        r = ", replace" if self.with_replacement else ""
+        return f"TpuSample[{self.fraction}{r}]"
+
+    def additional_metrics(self):
+        return {"sampleTime": "MODERATE"}
+
+    def internal_do_execute_columnar(self, idx: int,
+                                     ctx: TaskContext) -> Iterator:
+        import jax.numpy as jnp
+        start = 0
+        for b in self.children[0].execute_partition(idx, ctx):
+            n = b.num_rows
+            with self.metrics["sampleTime"].timed():
+                if self.with_replacement:
+                    # counts on host (tiny), gather on device
+                    u = _uniform_np(self.seed, idx, start, n)
+                    counts = self._counts(u)
+                    indices = np.repeat(np.arange(n), counts)
+                    start += n
+                    if not len(indices):
+                        continue
+                    from ..columnar.batch import bucket_capacity
+                    cap = bucket_capacity(len(indices))
+                    padded = np.full(cap, -1, dtype=np.int32)
+                    padded[:len(indices)] = indices
+                    yield gather(b, jnp.asarray(padded), len(indices), cap)
+                else:
+                    # device mask + on-device compaction (same path as filter)
+                    u = _uniform_jnp(self.seed, idx, start, b.capacity)
+                    start += n
+                    keep = (u < self.fraction) & (jnp.arange(b.capacity) < n)
+                    out = compact(b, keep)
+                    if out.num_rows:
+                        yield out

@@ -54,6 +54,61 @@ def sort_batch(batch: TpuColumnarBatch, order: List[SortOrder],
     return gather(batch, perm, n, out_capacity=cap)
 
 
+class TpuTopNExec(TpuExec):
+    """Top-N: per-partition sort+slice with a running top-N, then one final
+    merge — avoids the global sort exchange (reference GpuTopN, limit.scala:
+    sort+slice fusion of TakeOrderedAndProject)."""
+
+    def __init__(self, n: int, order: List[SortOrder], child: PhysicalPlan,
+                 offset: int = 0):
+        super().__init__([child])
+        self.n = n
+        self.offset = offset
+        self.order = [SortOrder(bind_references(o.child, child.output),
+                                o.ascending, o.nulls_first) for o in order]
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        keys = ", ".join(o.pretty() for o in self.order)
+        return f"TpuTopN[n={self.n}, {keys}]"
+
+    def additional_metrics(self):
+        return {"sortTime": "MODERATE"}
+
+    def _topn_of_partition(self, p: int, ctx: TaskContext, keep: int):
+        running = None
+        for b in self.children[0].execute_partition(p, ctx):
+            cand = b if running is None else concat_batches([running, b])
+            with self.metrics["sortTime"].timed():
+                s = sort_batch(cand, self.order, ctx)
+            from ..columnar.batch import slice_batch
+            running = slice_batch(s, 0, min(keep, s.num_rows))
+        return running
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..columnar.batch import slice_batch
+        keep = self.offset + self.n
+        tops = []
+        for p in range(self.children[0].num_partitions()):
+            t = self._topn_of_partition(p, ctx, keep)
+            if t is not None:
+                tops.append(t)
+        if not tops:
+            return
+        whole = concat_batches(tops)
+        with self.metrics["sortTime"].timed():
+            s = sort_batch(whole, self.order, ctx)
+        out = slice_batch(s, self.offset, self.n)
+        if out.num_rows:
+            yield out
+
+
 class TpuSortExec(TpuExec):
     def __init__(self, order: List[SortOrder], global_sort: bool,
                  child: PhysicalPlan):

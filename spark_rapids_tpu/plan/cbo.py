@@ -1,0 +1,155 @@
+"""Cost-based optimizer: revert plan sections not worth moving to the TPU.
+
+Reference: CostBasedOptimizer.scala (`CostBasedOptimizer:54`,
+`CpuCostModel:284`, `GpuCostModel:334`, `RowCountPlanVisitor:437`) — an
+optional pass over the tagged meta tree that estimates per-section CPU vs
+accelerator cost (including row/columnar transition overhead at the section
+boundaries) and marks sections that are cheaper on CPU with an
+`[optimization]`-prefixed fallback reason. Disabled by default, like the
+reference (`spark.rapids.sql.optimizer.enabled`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import (OPTIMIZER_CPU_ROW_COST, OPTIMIZER_ENABLED,
+                      OPTIMIZER_TPU_ROW_COST, OPTIMIZER_TRANSITION_ROW_COST,
+                      RapidsConf)
+from .meta import PlanMeta
+
+
+class RowCountPlanVisitor:
+    """reference RowCountPlanVisitor (CostBasedOptimizer.scala:437):
+    bottom-up cardinality estimate with per-operator selectivity defaults."""
+
+    FILTER_SELECTIVITY = 0.5
+    AGG_RATIO = 0.1
+    FILE_ROW_BYTES = 100.0
+
+    @classmethod
+    def estimate(cls, plan, _cache: Optional[dict] = None) -> float:
+        """Memoized per optimize() pass — _section_costs revisits nodes, and
+        FileScan estimates stat the filesystem."""
+        if _cache is not None and id(plan) in _cache:
+            return _cache[id(plan)]
+        v = cls._estimate(plan, _cache)
+        if _cache is not None:
+            _cache[id(plan)] = v
+        return v
+
+    @classmethod
+    def _estimate(cls, plan, _cache) -> float:
+        import os
+        name = type(plan).__name__
+        children = [cls.estimate(c, _cache) for c in plan.children]
+        child = children[0] if children else 0.0
+        if name.endswith("LocalTableScanExec"):
+            t = getattr(plan, "table", None)
+            return float(t.num_rows) if t is not None else 1000.0
+        if name.endswith("RangeExec"):
+            try:
+                return float(max(0, (plan.end - plan.start) // plan.step))
+            except Exception:
+                return 1000.0
+        if "FileScan" in name:
+            total = 0
+            for p in getattr(plan, "paths", []):
+                try:
+                    total += os.path.getsize(p)
+                except OSError:
+                    total += 1 << 20
+            return max(1.0, total / cls.FILE_ROW_BYTES)
+        if "Filter" in name:
+            return child * cls.FILTER_SELECTIVITY
+        if "Aggregate" in name:
+            return max(1.0, child * cls.AGG_RATIO)
+        if "Join" in name:
+            return max(children) if children else child
+        if "Union" in name:
+            return float(sum(children))
+        if "Limit" in name or "TopN" in name:
+            n = getattr(plan, "n", None)
+            return float(n) if n is not None else child
+        if "Sample" in name:
+            return child * getattr(plan, "fraction", 1.0)
+        return child
+
+
+def _op_weight(plan) -> float:
+    """Relative per-row operator weight (joins/sorts/aggs cost more than
+    projections; mirrors the reference's per-operator cost overrides)."""
+    name = type(plan).__name__
+    if "Join" in name:
+        return 4.0
+    if "Sort" in name or "TopN" in name:
+        return 3.0
+    if "Aggregate" in name or "Window" in name:
+        return 3.0
+    if "Exchange" in name:
+        return 2.0
+    return 1.0
+
+
+class CostBasedOptimizer:
+    @staticmethod
+    def optimize(meta: PlanMeta, conf: RapidsConf) -> List[str]:
+        """Walk section roots; revert sections whose estimated TPU cost
+        (incl. boundary transitions) exceeds the CPU cost. Returns the list
+        of applied optimizations (for explain/tests)."""
+        applied: List[str] = []
+        CostBasedOptimizer._walk(meta, None, conf, applied, {})
+        return applied
+
+    @staticmethod
+    def _walk(meta: PlanMeta, parent: Optional[PlanMeta], conf: RapidsConf,
+              applied: List[str], cache: dict) -> None:
+        is_section_root = meta.can_this_be_replaced and (
+            parent is None or not parent.can_this_be_replaced)
+        if is_section_root:
+            cpu, tpu = CostBasedOptimizer._section_costs(meta, conf,
+                                                         at_root=True,
+                                                         cache=cache)
+            if tpu >= cpu:
+                reason = (f"[optimization] section {type(meta.plan).__name__} "
+                          f"not worth moving to TPU "
+                          f"(cpu={cpu:.2f} <= tpu={tpu:.2f})")
+                CostBasedOptimizer._revert(meta, reason)
+                applied.append(reason)
+        for c in meta.child_plans:
+            CostBasedOptimizer._walk(c, meta, conf, applied, cache)
+
+    @staticmethod
+    def _section_costs(meta: PlanMeta, conf: RapidsConf, at_root: bool,
+                       cache: dict) -> tuple:
+        rows = RowCountPlanVisitor.estimate(meta.plan, cache)
+        w = _op_weight(meta.plan)
+        cpu = rows * w * conf.get(OPTIMIZER_CPU_ROW_COST)
+        tpu = rows * w * conf.get(OPTIMIZER_TPU_ROW_COST)
+        trans = conf.get(OPTIMIZER_TRANSITION_ROW_COST)
+        if at_root:
+            tpu += rows * trans  # columnar→row at the section's top edge
+        for c in meta.child_plans:
+            if c.can_this_be_replaced:
+                ccpu, ctpu = CostBasedOptimizer._section_costs(c, conf, False,
+                                                               cache)
+                cpu += ccpu
+                tpu += ctpu
+            else:
+                # row→columnar transition where a CPU child feeds the section
+                crows = RowCountPlanVisitor.estimate(c.plan, cache)
+                tpu += crows * trans
+        return cpu, tpu
+
+    @staticmethod
+    def _revert(meta: PlanMeta, reason: str) -> None:
+        meta.will_not_work_on_tpu(reason)
+        for c in meta.child_plans:
+            if c.can_this_be_replaced:
+                CostBasedOptimizer._revert(meta=c, reason=reason)
+
+
+def apply_cbo(meta: PlanMeta, conf: RapidsConf) -> List[str]:
+    if not conf.get(OPTIMIZER_ENABLED):
+        return []
+    return CostBasedOptimizer.optimize(meta, conf)

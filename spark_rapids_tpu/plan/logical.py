@@ -205,6 +205,29 @@ class Limit(LogicalPlan):
         return f"Limit[{self.n}]"
 
 
+class Sample(LogicalPlan):
+    """df.sample (reference GpuSampleExec / GpuFastSampleExec,
+    basicPhysicalOperators.scala:873,948)."""
+
+    def __init__(self, child: LogicalPlan, fraction: float,
+                 with_replacement: bool = False, seed: Optional[int] = None):
+        self.children = (child,)
+        self.fraction = float(fraction)
+        self.with_replacement = bool(with_replacement)
+        if seed is None:
+            import random
+            seed = random.randrange(1 << 31)  # pyspark draws a random seed
+        self.seed = int(seed)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        r = ", replace" if self.with_replacement else ""
+        return f"Sample[{self.fraction}{r}, seed={self.seed}]"
+
+
 class Union(LogicalPlan):
     def __init__(self, plans: Sequence[LogicalPlan]):
         self.children = tuple(plans)

@@ -120,6 +120,30 @@ register_exec(
     lambda m, ch: TB.TpuGlobalLimitExec(m.plan.n, ch[0], m.plan.offset))
 
 
+register_exec(
+    CE.CpuTopNExec, "top-N (sort+limit fusion)",
+    "spark.rapids.sql.exec.TakeOrderedAndProjectExec",
+    lambda m: m.add_exprs([o.child for o in m.plan.order]),
+    lambda m, ch: _TpuTopN(m.plan.n, m.plan.order, ch[0], m.plan.offset))
+
+
+def _TpuTopN(n, order, child, offset):
+    from ..execs.sort import TpuTopNExec
+    return TpuTopNExec(n, order, child, offset)
+
+
+def _register_sample():
+    from ..execs.sample import CpuSampleExec, TpuSampleExec
+    register_exec(
+        CpuSampleExec, "sample", "spark.rapids.sql.exec.SampleExec",
+        lambda m: None,
+        lambda m, ch: TpuSampleExec(m.plan.fraction, m.plan.with_replacement,
+                                    m.plan.seed, ch[0]))
+
+
+_register_sample()
+
+
 def _tag_sort(meta: PlanMeta) -> None:
     meta.add_exprs([o.child for o in meta.plan.order])
 
@@ -350,6 +374,9 @@ class TpuOverrides:
             return plan
         meta = wrap_and_tag_plan(plan, conf)
         meta.tag_for_tpu()
+        from .cbo import apply_cbo
+        for opt in apply_cbo(meta, conf):
+            log.info(opt)
         explain = str(conf.get(EXPLAIN)).upper()
         if explain in ("NOT_ON_TPU", "ALL"):
             reasons: List[str] = []

@@ -61,9 +61,23 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
         return CE.CpuFilterExec(_compile_udfs([plan.condition], conf)[0],
                                 child)
     if isinstance(plan, L.Limit):
+        inner = plan.children[0]
+        if isinstance(inner, L.Sort) and inner.global_sort:
+            # Limit(Sort) → TopN (reference TakeOrderedAndProject/GpuTopN):
+            # per-partition top-N + merge instead of a global sort
+            child = plan_physical(inner.children[0], conf)
+            return CE.CpuTopNExec(plan.n, inner.order, child, plan.offset)
+        child = plan_physical(inner, conf)
+        # local limit must keep offset+n rows — the global stage still has
+        # `offset` rows to skip
+        return CE.CpuGlobalLimitExec(
+            plan.n, CE.CpuLocalLimitExec(plan.n + plan.offset, child),
+            plan.offset)
+    if isinstance(plan, L.Sample):
+        from ..execs.sample import CpuSampleExec
         child = plan_physical(plan.children[0], conf)
-        return CE.CpuGlobalLimitExec(plan.n, CE.CpuLocalLimitExec(plan.n, child),
-                                     plan.offset)
+        return CpuSampleExec(plan.fraction, plan.with_replacement, plan.seed,
+                             child)
     if isinstance(plan, L.Union):
         children = [plan_physical(c, conf) for c in plan.children]
         return CE.CpuUnionExec(children, plan.output)

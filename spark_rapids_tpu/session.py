@@ -288,6 +288,18 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self._plan), self.session)
 
+    def sample(self, withReplacement=None, fraction=None, seed=None
+               ) -> "DataFrame":
+        """pyspark-style sample: sample(fraction), sample(fraction, seed),
+        sample(withReplacement, fraction[, seed])."""
+        if not isinstance(withReplacement, bool) and withReplacement is not None:
+            # positional sample(fraction[, seed]) form
+            withReplacement, fraction, seed = False, withReplacement, fraction
+        if fraction is None:
+            raise ValueError("sample() requires a fraction")
+        return DataFrame(L.Sample(self._plan, fraction,
+                                  bool(withReplacement), seed), self.session)
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(L.Union([self._plan, other._plan]), self.session)
 

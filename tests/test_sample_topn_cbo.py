@@ -1,0 +1,120 @@
+"""Sample exec, TopN fusion, and cost-based-optimizer tests (reference
+GpuSampleExec/GpuFastSampleExec, GpuTopN, CostBasedOptimizer suites)."""
+
+import pyarrow as pa
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.session import TpuSession
+
+
+def _df(s, n=5000, seed=1):
+    return s.createDataFrame(gen_df(
+        [("a", IntegerGen()), ("d", DoubleGen()), ("s", StringGen())],
+        n, seed))
+
+
+def test_sample_deterministic_tpu_equals_cpu():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).sample(fraction=0.25, seed=11))
+
+
+def test_sample_with_replacement_tpu_equals_cpu():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).sample(True, 0.5, seed=3), ignore_order=True)
+
+
+def test_sample_fraction_bounds(session):
+    n = 20000
+    df = session.createDataFrame(pa.table({"a": pa.array(range(n))}))
+    got = len(df.sample(fraction=0.1, seed=5).collect())
+    assert 0.08 * n < got < 0.12 * n
+    # seed stability
+    again = len(df.sample(fraction=0.1, seed=5).collect())
+    assert got == again
+    other = len(df.sample(fraction=0.1, seed=6).collect())
+    assert other != got
+
+
+def test_sample_positional_forms(session):
+    """sample(fraction, seed) must parse as a Bernoulli sample (pyspark call
+    form), not as (withReplacement, fraction)."""
+    df = session.createDataFrame(pa.table({"a": pa.array(range(1000))}))
+    got = df.sample(0.5, 3).collect()
+    assert got == df.sample(fraction=0.5, seed=3).collect()
+    assert 400 < len(got) < 600
+    # unseeded samples draw random seeds — two samples should differ
+    r1 = {r["a"] for r in df.sample(0.3).collect()}
+    r2 = {r["a"] for r in df.sample(0.3).collect()}
+    assert r1 != r2
+
+
+def test_sample_on_tpu_plan(session):
+    df = _df(session).sample(fraction=0.5, seed=1)
+    assert "TpuSample" in df.explain()
+
+
+def test_topn_fusion_in_plan(session):
+    df = _df(session).orderBy(F.col("a")).limit(7)
+    plan = df.explain()
+    assert "TpuTopN" in plan
+    assert "TpuSort" not in plan  # the global sort was fused away
+
+
+def test_topn_matches_sort_limit():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).orderBy(F.col("d").desc(), F.col("a")).limit(20))
+
+
+def test_topn_with_strings():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).orderBy(F.col("s"), F.col("a").desc()).limit(15))
+
+
+def test_topn_n_larger_than_input():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=10).orderBy(F.col("a")).limit(100))
+
+
+# ---------------------------------------------------------------------------
+# CBO
+
+
+CBO_ON = {"spark.rapids.sql.optimizer.enabled": "true"}
+
+
+def test_cbo_reverts_tiny_section():
+    """A tiny local relation is not worth two transitions — with aggressive
+    transition cost the whole section must stay on CPU."""
+    s = TpuSession(dict(CBO_ON,
+                        **{"spark.rapids.sql.optimizer.transitionRowCost":
+                           "1000.0"}))
+    df = s.createDataFrame(pa.table({"a": pa.array(range(10))})) \
+        .select((F.col("a") + 1).alias("b"))
+    plan = df.explain()
+    assert "TpuProject" not in plan
+    assert [r["b"] for r in df.collect()] == list(range(1, 11))
+
+
+def test_cbo_keeps_worthwhile_section():
+    """With default costs (TPU cheaper per row) big sections stay on TPU."""
+    s = TpuSession(dict(CBO_ON))
+    df = _df(s, n=5000).groupBy("a").agg(F.sum(F.col("d")).alias("sd"))
+    assert "TpuHashAggregate" in df.explain()
+
+
+def test_cbo_off_by_default():
+    s = TpuSession({"spark.rapids.sql.optimizer.transitionRowCost": "1000.0"})
+    df = s.createDataFrame(pa.table({"a": pa.array(range(10))})) \
+        .select((F.col("a") + 1).alias("b"))
+    assert "TpuProject" in df.explain()
+
+
+def test_cbo_results_unchanged():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).filter(F.col("a") > 0)
+        .groupBy("s").agg(F.count(F.col("a")).alias("c")),
+        conf=CBO_ON, ignore_order=True)
