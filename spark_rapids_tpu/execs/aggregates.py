@@ -508,16 +508,26 @@ def lex_sort_permutation(keys, num_rows: int, capacity: int,
     perm = jnp.arange(capacity, dtype=jnp.int32)
     if orders is None:
         orders = [(True, True)] * len(keys)
-    # least-significant key first; each pass is a stable argsort
+    # least-significant key first; each pass is a stable argsort. Within one
+    # key the order is (null group, value): a value pass then a null-flag
+    # pass — sentinel encodings would collide with real extreme values
+    # (e.g. a null vs an actual INT32_MIN).
     for (vals, validity), (asc, nulls_first) in list(zip(keys, orders))[::-1]:
         v = jnp.take(vals, perm)
+        if validity is not None:
+            # null lanes hold garbage payloads — pin them to a constant so
+            # the value pass keeps prior-pass (secondary-key) order for ties
+            nv0 = jnp.take(validity, perm)
+            v = jnp.where(nv0, v, jnp.zeros((), v.dtype))
         if not asc:
             v = _invert_order(v)
-        if validity is not None:
-            nv = jnp.take(validity, perm)
-            v = _apply_null_order(v, nv, nulls_first)
         order = jnp.argsort(v, stable=True)
         perm = jnp.take(perm, order)
+        if validity is not None:
+            nv = jnp.take(validity, perm)
+            flag = jnp.where(nv, 1, 0) if nulls_first else jnp.where(nv, 0, 1)
+            order = jnp.argsort(flag, stable=True)
+            perm = jnp.take(perm, order)
     # padding last: single extra pass on is_padding
     pad = (perm >= num_rows).astype(jnp.int32)
     order = jnp.argsort(pad, stable=True)
@@ -528,21 +538,6 @@ def _invert_order(v):
     if v.dtype == jnp.int64:
         return jnp.int64(-1) ^ v
     return (-1 ^ v.astype(jnp.int32))
-
-
-def _apply_null_order(v, valid, nulls_first):
-    """Map values to (flag, v) ordering via a shifted representation: since we
-    cannot widen beyond int64 safely, sort nulls via a pre-pass trick: encode
-    null rows to extreme values. Ties between null rows keep stability."""
-    if v.dtype == jnp.int64:
-        lo = jnp.int64(np.int64(-2**63))
-        hi = jnp.int64(np.int64(2**63 - 1))
-    else:
-        info = np.iinfo(np.asarray(v).dtype if hasattr(v, 'dtype') else np.int32)
-        lo = jnp.asarray(info.min, v.dtype)
-        hi = jnp.asarray(info.max, v.dtype)
-    sentinel = lo if nulls_first else hi
-    return jnp.where(valid, v, sentinel)
 
 
 class AggState:
@@ -568,6 +563,14 @@ def _segment_update(fn: AggregateFunction, col: Optional[TpuColumnVector],
     if fn.update_op == "bloom_filter":
         return _segment_bloom(fn, col, seg_ids, n_groups_cap, capacity,
                               num_rows, sorted_perm)
+    if fn.update_op in ("min", "max", "first", "last") and col is not None \
+            and not isinstance(col, tuple) \
+            and (col.offsets is not None or col.host_data is not None):
+        # variable-width input (strings/binary/nested): host-assisted segment
+        # min/max/first/last over the arrow values (the reference does these
+        # in cuDF device kernels; no TPU ragged reduce yet)
+        return _host_segment_minmax(fn, col, seg_ids, n_groups_cap, capacity,
+                                    num_rows, sorted_perm)
     mask = row_mask(num_rows, capacity)
     if col is not None:
         data = jnp.take(col.data, sorted_perm)
@@ -792,6 +795,43 @@ def _host_collect(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
     return {"__final": final}
 
 
+def _host_segment_minmax(fn, col, seg_ids, g_cap: int, capacity: int,
+                         num_rows: int, perm):
+    """min/max/first/last for variable-width columns, host-side over sorted
+    segments (groups are contiguous after the key sort)."""
+    import pyarrow as pa
+    arr = col.to_arrow()  # original row domain
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    perm_np = np.asarray(perm)[:num_rows]
+    seg_np = np.asarray(seg_ids)[:num_rows]
+    vals = arr.to_pylist()
+    op = fn.update_op
+    ignore_nulls = getattr(fn, "ignore_nulls", False)
+    n_groups = int(seg_np.max()) + 1 if num_rows else 0
+    out: List = [None] * n_groups
+    seen: List[bool] = [False] * n_groups
+    for pos in range(num_rows):
+        g = int(seg_np[pos])
+        v = vals[int(perm_np[pos])]
+        if op == "first":
+            if not seen[g] and (v is not None or not ignore_nulls):
+                out[g] = v
+                seen[g] = True
+        elif op == "last":
+            if v is not None or not ignore_nulls:
+                out[g] = v
+                seen[g] = True
+        elif v is not None:
+            if out[g] is None or (op == "min" and v < out[g]) or \
+                    (op == "max" and v > out[g]):
+                out[g] = v
+    from ..types import to_arrow as type_to_arrow
+    final = TpuColumnVector.from_arrow(
+        pa.array(out, type=type_to_arrow(fn.dtype)))
+    return {"__final": final}
+
+
 def _segment_bloom(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
     """Per-group bloom blobs (host bit math over device-hashed longs; the
     reference's JNI BloomFilter kernel analogue). Empty group → null blob."""
@@ -845,6 +885,15 @@ def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
                   n_groups: int, cap: int) -> TpuColumnVector:
     gmask = row_mask(n_groups, cap)
     op = fn.update_op
+    if "__final" in state:  # host-assembled column (strings, nested, blobs)
+        f = state["__final"]
+        from ..columnar.batch import _repad
+        if f.capacity < cap:
+            f = _repad(f, cap)
+        return TpuColumnVector(f.dtype, f.data, f.validity, n_groups,
+                               offsets=f.offsets, child=f.child,
+                               host_data=f.host_data,
+                               host_capacity=f.host_capacity)
     if op == "count":
         return TpuColumnVector(LongT, state["count"], None, n_groups)
     if op == "sum":
@@ -874,14 +923,6 @@ def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
         out = jnp.sqrt(var) if op.startswith("stddev") else var
         valid = ok & (n > 0) & gmask
         return TpuColumnVector(DoubleT, jnp.where(valid, out, 0.0), valid, n_groups)
-    if "__final" in state:  # host-assembled column (e.g. string collect_set)
-        f = state["__final"]
-        from ..columnar.batch import _repad
-        if f.capacity < cap:
-            f = _repad(f, cap)
-        return TpuColumnVector(f.dtype, f.data, f.validity, n_groups,
-                               offsets=f.offsets, child=f.child,
-                               host_data=f.host_data, host_capacity=f.host_capacity)
     if op in ("collect_list", "collect_set"):
         child = state["__list_child"]
         offsets = state["__list_offsets"]
@@ -954,6 +995,7 @@ class TpuHashAggregateExec(TpuExec):
                 "numGroups": "DEBUG"}
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..config import BATCH_SIZE_ROWS
         child = self.children[0]
         batches: List[TpuColumnarBatch] = []
         if self.per_partition:
@@ -966,12 +1008,37 @@ class TpuHashAggregateExec(TpuExec):
             if not self.grouping:
                 yield self._empty_global_result(agg_fns, result_exprs, ctx)
             return
+        max_rows = ctx.conf.get(BATCH_SIZE_ROWS)
+        total = sum(b.num_rows for b in batches)
+        if self.grouping and total > max_rows:
+            # overflow: out-of-core sort by the grouping keys, then aggregate
+            # key-boundary-aligned slices — the reference's sort-based
+            # fallback (GpuAggregateExec.scala:757, GpuOutOfCoreSortIterator
+            # reuse); no group straddles a slice so no state merge is needed
+            yield from self._sort_fallback(batches, agg_fns, result_exprs,
+                                           ctx, max_rows)
+            return
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
         from ..memory.retry import with_retry_no_split
         from ..memory.spill import SpillableColumnarBatch
         yield with_retry_no_split(
             SpillableColumnarBatch(batch),
             lambda b: self._aggregate_batch(b, agg_fns, result_exprs, ctx))
+
+    def _sort_fallback(self, batches, agg_fns, result_exprs, ctx,
+                       max_rows: int) -> Iterator:
+        from ..plan.logical import SortOrder
+        from .oocsort import OutOfCoreSorter
+        order = [SortOrder(g, True, True) for g in self.grouping]
+        ooc = OutOfCoreSorter(order, ctx)
+        try:
+            with self.metrics["sortTime"].timed():
+                for b in batches:
+                    ooc.add_batch(b)
+            for sl in ooc.iter_sorted(max_rows, group_boundaries=True):
+                yield self._aggregate_batch(sl, agg_fns, result_exprs, ctx)
+        finally:
+            ooc.close()
 
     def _aggregate_batch(self, batch: TpuColumnarBatch, agg_fns, result_exprs,
                          ctx: TaskContext) -> TpuColumnarBatch:

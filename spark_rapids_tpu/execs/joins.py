@@ -183,26 +183,61 @@ class TpuShuffledHashJoinExec(TpuExec):
         names = [a.name for a in self._output]
         l_empty = left is None or left.num_rows == 0
         r_empty = right is None or right.num_rows == 0
-        if l_empty and r_empty:
+        if l_empty or r_empty:
+            out = self._join_pair(left if not l_empty else None,
+                                  right if not r_empty else None, names, ctx)
+            if out is not None and out.num_rows:
+                yield out
             return
-        if l_empty:
-            if jt in ("rightouter", "right", "fullouter", "outer", "full"):
-                nulls_l = _all_null_cols(self.children[0].output,
-                                         right.num_rows, right.capacity)
-                yield TpuColumnarBatch(nulls_l + right.columns, right.num_rows, names)
-            return
-        if r_empty:
-            if jt in ("leftsemi", "semi", "inner", "cross"):
-                return
-            if jt in ("leftanti", "anti"):
-                yield left.rename(names)
-                return
-            nulls_r = _all_null_cols(self.children[1].output,
-                                     left.num_rows, left.capacity)
-            yield TpuColumnarBatch(left.columns + nulls_r, left.num_rows, names)
+        from ..config import BATCH_SIZE_ROWS
+        max_rows = ctx.conf.get(BATCH_SIZE_ROWS)
+        if self.left_keys and max(left.num_rows, right.num_rows) > max_rows:
+            # sub-partitioning: both sides split by the same key hash, each
+            # pair joined independently — keys land in exactly one pair so
+            # outer/semi/anti semantics compose (reference
+            # GpuSubPartitionHashJoin.scala)
+            from ..shuffle.partitioner import (hash_partition_ids,
+                                               split_by_partition)
+            k = max(2, -(-max(left.num_rows, right.num_rows) // max_rows))
+            l_ids = hash_partition_ids(left, self.left_keys, k, ctx)
+            r_ids = hash_partition_ids(right, self.right_keys, k, ctx)
+            l_parts = split_by_partition(left, l_ids, k)
+            r_parts = split_by_partition(right, r_ids, k)
+            with self.metrics["joinTime"].timed():
+                for lp, rp in zip(l_parts, r_parts):
+                    out = self._join_pair(lp, rp, names, ctx)
+                    if out is not None and out.num_rows:
+                        yield out
             return
         with self.metrics["joinTime"].timed():
             yield self._join(left, right, ctx)
+
+    def _join_pair(self, lp, rp, names, ctx):
+        """One sub-partition pair with the empty-side fast paths preserved."""
+        jt = self.join_type
+        l_empty = lp is None or lp.num_rows == 0
+        r_empty = rp is None or rp.num_rows == 0
+        if l_empty and r_empty:
+            return None
+        if l_empty:
+            if jt in ("rightouter", "right", "fullouter", "outer", "full"):
+                nulls_l = _all_null_cols(self.children[0].output,
+                                         rp.num_rows, rp.capacity)
+                return TpuColumnarBatch(nulls_l + rp.columns, rp.num_rows,
+                                        names)
+            return None
+        if r_empty:
+            if jt in ("leftanti", "anti"):
+                return lp.rename(names)
+            if jt in ("leftouter", "left", "fullouter", "outer", "full"):
+                # only left/full outer pad unmatched left rows; a right outer
+                # join emits nothing for a partition with no right rows
+                nulls_r = _all_null_cols(self.children[1].output,
+                                         lp.num_rows, lp.capacity)
+                return TpuColumnarBatch(lp.columns + nulls_r, lp.num_rows,
+                                        names)
+            return None
+        return self._join(lp, rp, ctx)
 
     def _join(self, left: TpuColumnarBatch, right: TpuColumnarBatch,
               ctx: TaskContext) -> TpuColumnarBatch:

@@ -1,0 +1,167 @@
+"""Out-of-core sort: spillable sorted runs + host-key global merge.
+
+Reference: GpuOutOfCoreSortIterator (GpuSortExec.scala:281 — sorted runs split
+to spillable batches, k-way merged by first-row keys) and the sort-based
+aggregate overflow fallback that reuses it (GpuAggregateExec.scala:757-759).
+
+TPU design: the device only ever holds one bounded working batch; completed
+sorted runs live in the spill catalog (HBM→host-DRAM→disk tiers). The global
+merge order is computed on host over the order-preserving int64 key encodings
+(8 bytes/row/key — payloads stay spilled), then each output slice gathers its
+rows run by run and finish-sorts in-core. For aggregation consumers, slice
+ends snap to group-key boundaries so no group ever straddles two output
+batches (GpuKeyBatchingIterator's contract)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import (TpuColumnarBatch, bucket_capacity,
+                              concat_batches, gather)
+from ..expressions.base import to_column
+from ..memory.spill import SpillableColumnarBatch
+from ..plan.logical import SortOrder
+from ..types import StringType
+from .aggregates import _sortable_bits
+
+class OutOfCoreSorter:
+    def __init__(self, order: List[SortOrder], ctx):
+        self.order = order
+        self.ctx = ctx
+        self.runs: List[SpillableColumnarBatch] = []
+        # per run: per key either ("int", int64 values, valid|None) or
+        # ("str", object ndarray, valid) — strings rank globally at merge time
+        self.run_keys: List[List[Tuple]] = []
+        self.total_rows = 0
+
+    def add_batch(self, batch: TpuColumnarBatch) -> None:
+        """Sort the run in-core, snapshot its host keys, park it spillable."""
+        from .sort import sort_batch
+        sb = sort_batch(batch, self.order, self.ctx)
+        n = sb.num_rows
+        keys = []
+        for o in self.order:
+            col = to_column(o.child.eval_tpu(sb, self.ctx.eval_ctx), sb,
+                            o.child.dtype)
+            valid = None
+            if col.validity is not None:
+                valid = np.asarray(col.validity)[:n].astype(bool)
+            if isinstance(col.dtype, StringType):
+                arr = col.to_arrow()
+                vals = np.asarray(arr.to_pylist(), dtype=object)
+                if valid is None:
+                    valid = ~np.asarray([v is None for v in vals])
+                keys.append(("str", vals, valid))
+            else:
+                vals = np.asarray(_sortable_bits(col))[:n].astype(np.int64)
+                keys.append(("int", vals, valid))
+        self.runs.append(SpillableColumnarBatch(sb))
+        self.run_keys.append(keys)
+        self.total_rows += n
+
+    # -- host-side global order --------------------------------------------
+
+    def _transformed_keys(self) -> List[np.ndarray]:
+        """Per sort key, TWO int64 arrays over all runs — (null_flag, value)
+        — so ascending np.lexsort yields the requested order without a
+        sentinel encoding (a sentinel would collide with real extremes, e.g.
+        a null vs an actual INT64_MIN; same reasoning as the device
+        lex_sort_permutation null-flag pass)."""
+        out = []
+        for ki, o in enumerate(self.order):
+            kind = self.run_keys[0][ki][0] if self.run_keys else "int"
+            vals_parts = [rk[ki][1] for rk in self.run_keys]
+            valid_parts = [rk[ki][2] for rk in self.run_keys]
+            if kind == "str":
+                allv = np.concatenate(vals_parts) if vals_parts else \
+                    np.array([], dtype=object)
+                valid = np.concatenate(valid_parts)
+                safe = np.where(valid, allv, "")
+                # global dense rank — order-preserving across runs
+                _, inv = np.unique(safe.astype(str), return_inverse=True)
+                v = inv.astype(np.int64)
+            else:
+                v = np.concatenate(vals_parts) if vals_parts else \
+                    np.array([], dtype=np.int64)
+                valids = [vp if vp is not None else np.ones(len(vv), bool)
+                          for vp, vv in zip(valid_parts, vals_parts)]
+                valid = np.concatenate(valids) if valids else \
+                    np.array([], dtype=bool)
+            if not o.ascending:
+                v = np.int64(-1) ^ v
+            v = v.copy()
+            v[~valid] = 0  # pin garbage payloads; the flag key disambiguates
+            flag = np.where(valid, 1, 0) if o.nulls_first \
+                else np.where(valid, 0, 1)
+            out.append(flag.astype(np.int64))
+            out.append(v)
+        return out
+
+    def _global_order(self):
+        """→ (run_id, row_id, keys) arrays in global sorted order."""
+        run_ids = np.concatenate(
+            [np.full(len(rk[0][1]) if rk else 0, i, dtype=np.int32)
+             for i, rk in enumerate(self.run_keys)]) \
+            if self.run_keys else np.array([], np.int32)
+        row_ids = np.concatenate(
+            [np.arange(len(rk[0][1]), dtype=np.int64)
+             for rk in self.run_keys]) if self.run_keys else \
+            np.array([], np.int64)
+        keys = self._transformed_keys()
+        if not len(run_ids):
+            return run_ids, row_ids, keys
+        # np.lexsort: LAST key is primary; stability keeps (run, row) order
+        order = np.lexsort(tuple(reversed(keys)))
+        return run_ids[order], row_ids[order], [k[order] for k in keys]
+
+    # -- output ------------------------------------------------------------
+
+    def iter_sorted(self, target_rows: int,
+                    group_boundaries: bool = False) -> Iterator[TpuColumnarBatch]:
+        """Emit globally-sorted slices of ≈target_rows. With
+        group_boundaries, slice ends move forward to the next key change."""
+        from .sort import sort_batch
+        rid, row, keys = self._global_order()
+        total = len(rid)
+        if not total:
+            return
+        boundary = None
+        if group_boundaries and keys:
+            neq = np.zeros(total, dtype=bool)
+            for k in keys:
+                neq[1:] |= k[1:] != k[:-1]
+            boundary = np.nonzero(neq)[0]  # positions where a new group starts
+        start = 0
+        while start < total:
+            end = min(start + max(1, target_rows), total)
+            if boundary is not None and end < total:
+                nxt = boundary[np.searchsorted(boundary, end)] \
+                    if np.searchsorted(boundary, end) < len(boundary) else total
+                end = int(nxt) if nxt > start else total
+            yield self._emit_slice(rid, row, start, end, sort_batch)
+            start = end
+
+    def _emit_slice(self, rid, row, start: int, end: int,
+                    sort_batch) -> TpuColumnarBatch:
+        pieces = []
+        sl_rid = rid[start:end]
+        sl_row = row[start:end]
+        for run_idx in np.unique(sl_rid):
+            sel = sl_row[sl_rid == run_idx]
+            b = self.runs[run_idx].get_batch()
+            cap = bucket_capacity(len(sel))
+            padded = np.full(cap, -1, dtype=np.int32)
+            padded[:len(sel)] = sel
+            pieces.append(gather(b, jnp.asarray(padded), len(sel), cap))
+        whole = pieces[0] if len(pieces) == 1 else concat_batches(pieces)
+        # finish-sort the bounded slice in-core (pieces interleave)
+        return sort_batch(whole, self.order, self.ctx)
+
+    def close(self) -> None:
+        for r in self.runs:
+            r.close()
+        self.runs = []
+        self.run_keys = []

@@ -131,11 +131,36 @@ class TpuSortExec(TpuExec):
         return {"sortTime": "MODERATE"}
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..config import BATCH_SIZE_ROWS
         child = self.children[0]
         if self.global_sort:
+            max_rows = ctx.conf.get(BATCH_SIZE_ROWS)
             batches: List[TpuColumnarBatch] = []
+            total = 0
+            ooc = None
             for p in range(child.num_partitions()):
-                batches.extend(child.execute_partition(p, ctx))
+                for b in child.execute_partition(p, ctx):
+                    total += b.num_rows
+                    if ooc is not None:
+                        ooc.add_batch(b)
+                        continue
+                    batches.append(b)
+                    if total > max_rows:
+                        # input exceeds one device batch → out-of-core path
+                        # (reference GpuOutOfCoreSortIterator)
+                        from .oocsort import OutOfCoreSorter
+                        ooc = OutOfCoreSorter(self.order, ctx)
+                        with self.metrics["sortTime"].timed():
+                            for queued in batches:
+                                ooc.add_batch(queued)
+                        batches = []
+            if ooc is not None:
+                try:
+                    with self.metrics["sortTime"].timed():
+                        yield from ooc.iter_sorted(max_rows)
+                finally:
+                    ooc.close()
+                return
             if not batches:
                 return
             whole = concat_batches(batches)

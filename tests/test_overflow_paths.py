@@ -1,0 +1,129 @@
+"""Memory-overflow execution paths (reference GpuOutOfCoreSortIterator,
+sort-based aggregate fallback GpuAggregateExec.scala:757,
+GpuSubPartitionHashJoin): forced by a tiny batchSizeRows so the suite runs
+them without real memory pressure."""
+
+import pyarrow as pa
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.session import TpuSession
+
+TINY_BATCH = {"spark.rapids.sql.batchSizeRows": "257"}
+
+
+def _df(s, n=3000, seed=9):
+    return s.createDataFrame(gen_df(
+        [("a", IntegerGen()), ("b", LongGen()), ("d", DoubleGen()),
+         ("s", StringGen())], n, seed))
+
+
+def test_out_of_core_sort_matches_in_core():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).orderBy(F.col("a"), F.col("d").desc()),
+        conf=TINY_BATCH)
+
+
+def test_out_of_core_sort_strings():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).orderBy(F.col("s").desc(), F.col("a")),
+        conf=TINY_BATCH)
+
+
+def test_out_of_core_sort_emits_bounded_batches():
+    s = TpuSession(dict(TINY_BATCH))
+    df = _df(s, n=2000).orderBy(F.col("a"))
+    rows = df.collect()
+    assert len(rows) == 2000
+    vals = [r["a"] for r in rows]
+    non_null = [v for v in vals if v is not None]
+    assert non_null == sorted(non_null)
+
+
+def test_agg_sort_fallback_matches():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("a").agg(
+            F.sum(F.col("b")).alias("sb"), F.count(F.col("d")).alias("c"),
+            F.min(F.col("d")).alias("mn"), F.max(F.col("s")).alias("mx")),
+        conf=TINY_BATCH, ignore_order=True)
+
+
+def test_agg_sort_fallback_string_keys():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("s").agg(
+            F.avg(F.col("d")).alias("ad"), F.count(F.col("a")).alias("c")),
+        conf=TINY_BATCH, ignore_order=True)
+
+
+def test_agg_sort_fallback_groups_not_split():
+    """Each group must appear exactly once in the output (no straddling)."""
+    s = TpuSession(dict(TINY_BATCH))
+    t = pa.table({"k": pa.array([i % 7 for i in range(5000)]),
+                  "v": pa.array(range(5000), type=pa.int64())})
+    rows = s.createDataFrame(t).groupBy("k").agg(
+        F.sum(F.col("v")).alias("sv"), F.count(F.col("v")).alias("c")
+    ).collect()
+    assert len(rows) == 7
+    by_k = {r["k"]: r for r in rows}
+    for k in range(7):
+        expect = sum(v for v in range(5000) if v % 7 == k)
+        assert by_k[k]["sv"] == expect and by_k[k]["c"] == len(
+            [v for v in range(5000) if v % 7 == k])
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_subpartition_join_matches(how):
+    def q(s):
+        left = _df(s, n=2500, seed=1)
+        right = _df(s, n=2000, seed=2).select(
+            F.col("a").alias("ra"), F.col("b").alias("rb"))
+        return left.join(right, left["a"] == right["ra"], how)
+    assert_tpu_and_cpu_are_equal_collect(q, conf=TINY_BATCH,
+                                         ignore_order=True)
+
+
+def test_subpartition_join_with_condition():
+    def q(s):
+        left = _df(s, n=2200, seed=3)
+        right = _df(s, n=2200, seed=4).select(
+            F.col("a").alias("ra"), F.col("d").alias("rd"))
+        return left.join(right, (left["a"] == right["ra"]) &
+                         (left["d"] < right["rd"]), "inner")
+    assert_tpu_and_cpu_are_equal_collect(q, conf=TINY_BATCH,
+                                         ignore_order=True)
+
+
+def test_subpartition_right_outer_skewed():
+    """A hash sub-partition with left rows but no right rows must emit
+    nothing for a right outer join (regression: nulls were fabricated)."""
+    def q(s):
+        left = s.createDataFrame(pa.table(
+            {"a": pa.array(list(range(4000)), type=pa.int32())}))
+        right = s.createDataFrame(pa.table(
+            {"ra": pa.array([1, 2, 3] * 5, type=pa.int32()),
+             "rv": pa.array(list(range(15)), type=pa.int64())}))
+        return left.join(right, left["a"] == right["ra"], "right")
+    assert_tpu_and_cpu_are_equal_collect(q, conf=TINY_BATCH,
+                                         ignore_order=True)
+
+
+def test_sort_secondary_key_under_null_primary():
+    """Rows with a null primary key must still order by the secondary key."""
+    def q(s):
+        t = pa.table({
+            "a": pa.array([None] * 1500 + list(range(1500)),
+                          type=pa.int32()),
+            "b": pa.array(list(range(3000, 0, -1)), type=pa.int64()),
+        })
+        return s.createDataFrame(t).orderBy(F.col("a"), F.col("b"))
+    assert_tpu_and_cpu_are_equal_collect(q, conf=TINY_BATCH)
+    assert_tpu_and_cpu_are_equal_collect(q)  # in-core path too
+
+
+def test_topn_under_tiny_batches():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).orderBy(F.col("b")).limit(25), conf=TINY_BATCH)
