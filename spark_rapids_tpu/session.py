@@ -803,14 +803,22 @@ class TpuSession:
         from .types import to_arrow as t2a
         schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
         tables = []
-        for p in range(final.num_partitions()):
-            ctx = TaskContext(p, conf)
-            try:
-                for t in final.execute_partition(p, ctx):
-                    if t.num_rows:
-                        tables.append(t.rename_columns(names))
-            finally:
-                ctx.complete()
+        try:
+            for p in range(final.num_partitions()):
+                ctx = TaskContext(p, conf)
+                try:
+                    for t in final.execute_partition(p, ctx):
+                        if t.num_rows:
+                            tables.append(t.rename_columns(names))
+                finally:
+                    ctx.complete()
+        finally:
+            # release shuffle blocks/files at query end (reference: Spark's
+            # ContextCleaner removing shuffle state); exchanges re-materialize
+            # if the same DataFrame is collected again
+            for node in final.collect_nodes():
+                if hasattr(node, "cleanup_shuffle"):
+                    node.cleanup_shuffle(conf)
         if not tables:
             return schema.empty_table()
         return pa.concat_tables(tables).cast(schema)

@@ -37,6 +37,10 @@ class _ExchangeBase:
     def num_partitions(self) -> int:
         return self._n_out
 
+    def _shuffle_mode(self, ctx: TaskContext) -> str:
+        from ..config import SHUFFLE_MODE
+        return str(ctx.conf.get(SHUFFLE_MODE)).upper()
+
     def _ensure_materialized(self, ctx: TaskContext) -> None:
         with self._mat_lock:
             if self._shuffle_id is not None:
@@ -46,13 +50,37 @@ class _ExchangeBase:
             child = self.children[0]
             self._n_maps = child.num_partitions()
             for map_id in range(self._n_maps):
-                map_ctx = TaskContext(map_id, ctx.conf)
-                try:
-                    tables = self._partition_map_task(map_id, map_ctx)
-                finally:
-                    map_ctx.complete()  # releases the semaphore, if held
-                mgr.write_map_output(sid, map_id, tables)
+                self._materialize_map(sid, map_id, ctx, mgr)
             self._shuffle_id = sid
+
+    def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
+                         mgr) -> None:
+        map_ctx = TaskContext(map_id, ctx.conf)
+        try:
+            commit = self._run_map_task(sid, map_id, map_ctx, mgr)
+        finally:
+            map_ctx.complete()  # releases the semaphore, if held
+        if commit is not None:
+            commit()  # host-side file I/O happens OFF the device semaphore
+
+    def _run_map_task(self, sid: int, map_id: int, map_ctx: TaskContext,
+                      mgr):
+        """Returns a deferred host-commit callable, or None if the output
+        was committed device-side (ICI)."""
+        tables = self._partition_map_task(map_id, map_ctx)
+        return lambda: mgr.write_map_output(sid, map_id, tables)
+
+    def cleanup_shuffle(self, conf) -> None:
+        """Release this exchange's shuffle blocks/files and allow
+        re-materialization (called at query end by the session)."""
+        with self._mat_lock:
+            sid = self._shuffle_id
+            self._shuffle_id = None
+        if sid is None:
+            return
+        from .ici import IciShuffleCatalog
+        IciShuffleCatalog.get().cleanup(sid)
+        TpuShuffleManager.get(conf).cleanup(sid)
 
 
 class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
@@ -73,11 +101,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         return {"partitionTime": "MODERATE", "serializationTime": "MODERATE",
                 "deserializationTime": "MODERATE"}
 
-    def _partition_map_task(self, map_id: int, ctx: TaskContext) -> List:
-        """Run one map task: device partition-split then download slices."""
-        import pyarrow as pa
+    def _device_parts(self, map_id: int, ctx: TaskContext) -> Iterator[List]:
+        """Device partition-split of each input batch (shared by both
+        shuffle modes; reference prepareBatchShuffleDependency:277)."""
         n = self._n_out
-        acc: List[List] = [[] for _ in range(n)]
         for batch in self.children[0].execute_partition(map_id, ctx):
             if batch.num_rows == 0:
                 continue
@@ -92,6 +119,14 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                     parts = [batch] + [None] * (n - 1)
                 else:
                     raise NotImplementedError(self.partitioning)
+            yield parts
+
+    def _partition_map_task(self, map_id: int, ctx: TaskContext) -> List:
+        """MULTITHREADED mode map task: split on device, serialize to host."""
+        import pyarrow as pa
+        n = self._n_out
+        acc: List[List] = [[] for _ in range(n)]
+        for parts in self._device_parts(map_id, ctx):
             with self.metrics["serializationTime"].timed():
                 for p, sub in enumerate(parts):
                     if sub is not None and sub.num_rows:
@@ -101,12 +136,64 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             out.append(pa.concat_tables(acc[p]) if acc[p] else None)
         return out
 
+    def _run_map_task(self, sid: int, map_id: int, map_ctx: TaskContext,
+                      mgr):
+        if self._shuffle_mode(map_ctx) == "ICI":
+            # ICI / device-resident mode (reference UCX RapidsCachingWriter):
+            # blocks stay on device as spillable batches — no serialization;
+            # the device-side commit happens here, under the semaphore (it IS
+            # device work), so there is no deferred host commit
+            from ..columnar.batch import concat_batches
+            from .ici import IciShuffleCatalog, ShuffleHeartbeatManager
+            catalog = IciShuffleCatalog.get()
+            ShuffleHeartbeatManager.get().register_peer(f"executor-{map_id}")
+            acc: List[List[TpuColumnarBatch]] = [[] for _ in range(self._n_out)]
+            for parts in self._device_parts(map_id, map_ctx):
+                for p, sub in enumerate(parts):
+                    if sub is not None and sub.num_rows:
+                        acc[p].append(sub)
+            for p, batches in enumerate(acc):
+                if batches:
+                    blk = batches[0] if len(batches) == 1 \
+                        else concat_batches(batches)
+                    catalog.put_block(sid, map_id, p, blk,
+                                      owner=f"executor-{map_id}")
+            catalog.mark_map_complete(sid, map_id)
+            return None
+        tables = self._partition_map_task(map_id, map_ctx)
+        return lambda: mgr.write_map_output(sid, map_id, tables)
+
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         self._ensure_materialized(ctx)
+        names = [a.name for a in self.output]
+        if self._shuffle_mode(ctx) == "ICI":
+            # device-resident read (reference RapidsCachingReader): local
+            # catalog hit, no host round trip; blocks unspill if evicted.
+            # FetchFailed (peer lost, output invalidated) re-runs the missing
+            # map tasks — Spark's stage-retry analogue.
+            from .ici import FetchFailedError, IciShuffleCatalog
+            catalog = IciShuffleCatalog.get()
+            mgr = TpuShuffleManager.get(ctx.conf)
+            for _attempt in range(2):
+                try:
+                    with self.metrics["deserializationTime"].timed():
+                        blocks = list(catalog.iter_blocks(
+                            self._shuffle_id, idx, self._n_maps))
+                    break
+                except FetchFailedError as ff:
+                    with self._mat_lock:
+                        for map_id in ff.map_ids:
+                            self._materialize_map(self._shuffle_id, map_id,
+                                                  ctx, mgr)
+            else:
+                raise RuntimeError("shuffle re-materialization failed twice")
+            for b in blocks:
+                if b.num_rows:
+                    yield b.rename(names)
+            return
         mgr = TpuShuffleManager.get(ctx.conf)
         with self.metrics["deserializationTime"].timed():
             tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps)
-        names = [a.name for a in self.output]
         for t in tables:
             if t.num_rows:
                 yield TpuColumnarBatch.from_arrow(t).rename(names)

@@ -1,0 +1,194 @@
+"""ICI shuffle mode: device-resident shuffle catalog + peer heartbeat.
+
+Reference mapping (SURVEY.md §2.7): the UCX mode keeps shuffle blocks
+device-resident in a ShuffleBufferCatalog served peer-to-peer over
+RDMA/NVLink (RapidsShuffleServer/Client, BufferSendState/BufferReceiveState),
+with a driver-coordinated heartbeat discovering peers
+(RapidsShuffleHeartbeatManager, Plugin.scala:436-447).
+
+TPU re-design: within one mesh/slice the data plane is XLA's `all_to_all`
+over ICI (parallel/distributed.py `ici_all_to_all_exchange` — the compiler
+schedules the interconnect transfers, replacing hand-written UCX
+transactions). At the exec layer, ICI mode keeps every shuffle block as a
+*spillable device batch* in this catalog — no Arrow serialization, no disk
+round trip; reduce tasks concat blocks directly on device (≙ the reference's
+RapidsCachingWriter/RapidsCachingReader pair). Blocks are spillable, so HBM
+pressure pushes them down the usual HBM→host→disk tiers instead of OOMing.
+The heartbeat registry tracks peer liveness; a lost peer invalidates its map
+outputs so the exchange re-materializes them (Spark would re-run the map
+stage)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..columnar.batch import TpuColumnarBatch
+from ..memory.spill import SpillableColumnarBatch
+
+
+class ShuffleHeartbeatManager:
+    """Driver-side peer registry (reference RapidsShuffleHeartbeatManager):
+    executors announce themselves and heartbeat; peers missing beyond the
+    timeout are reported lost exactly once."""
+
+    _instance: Optional["ShuffleHeartbeatManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+        self._peers: Dict[str, float] = {}
+        self._registered_order: List[str] = []
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "ShuffleHeartbeatManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "ShuffleHeartbeatManager":
+        with cls._lock:
+            cls._instance = cls()
+            return cls._instance
+
+    def register_peer(self, executor_id: str,
+                      now: Optional[float] = None) -> List[str]:
+        """Returns the already-known peers (RapidsExecutorStartupMsg reply)."""
+        with self._mu:
+            known = list(self._registered_order)
+            if executor_id not in self._peers:
+                self._registered_order.append(executor_id)
+            self._peers[executor_id] = now if now is not None else time.time()
+            return known
+
+    def heartbeat(self, executor_id: str,
+                  now: Optional[float] = None) -> None:
+        with self._mu:
+            if executor_id in self._peers:
+                self._peers[executor_id] = now if now is not None \
+                    else time.time()
+
+    def lost_peers(self, now: Optional[float] = None) -> List[str]:
+        t = now if now is not None else time.time()
+        with self._mu:
+            lost = [e for e, last in self._peers.items()
+                    if t - last > self.timeout_s]
+            for e in lost:
+                del self._peers[e]
+                self._registered_order.remove(e)
+            return lost
+
+    def peers(self) -> List[str]:
+        with self._mu:
+            return list(self._registered_order)
+
+
+class FetchFailedError(RuntimeError):
+    """A map output is missing (peer lost / invalidated) — the exchange must
+    re-materialize those map tasks (Spark: FetchFailed → stage retry)."""
+
+    def __init__(self, shuffle_id: int, map_ids: List[int]):
+        super().__init__(f"shuffle {shuffle_id}: missing map output for "
+                         f"maps {map_ids}")
+        self.shuffle_id = shuffle_id
+        self.map_ids = map_ids
+
+
+class IciShuffleCatalog:
+    """Device-resident shuffle block store (reference ShuffleBufferCatalog +
+    ShuffleReceivedBufferCatalog): (shuffle_id, map_id, reduce_id) →
+    spillable device batch. Map completion is tracked separately so a
+    missing block distinguishes 'legitimately empty partition' from
+    'lost/invalidated output' (the latter raises FetchFailedError)."""
+
+    _instance: Optional["IciShuffleCatalog"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._blocks: Dict[Tuple[int, int, int], SpillableColumnarBatch] = {}
+        self._owner: Dict[Tuple[int, int], str] = {}  # (sid, map_id) → exec
+        self._complete: set = set()  # (sid, map_id) with committed output
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "IciShuffleCatalog":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "IciShuffleCatalog":
+        with cls._lock:
+            cls._instance = cls()
+            return cls._instance
+
+    def put_block(self, shuffle_id: int, map_id: int, reduce_id: int,
+                  batch: TpuColumnarBatch,
+                  owner: Optional[str] = None) -> None:
+        from ..memory.spill import OUTPUT_FOR_SHUFFLE_PRIORITY
+        sb = SpillableColumnarBatch(batch,
+                                    priority=OUTPUT_FOR_SHUFFLE_PRIORITY)
+        with self._mu:
+            key = (shuffle_id, map_id, reduce_id)
+            old = self._blocks.pop(key, None)
+            self._blocks[key] = sb
+            if owner is not None:
+                self._owner[(shuffle_id, map_id)] = owner
+        if old is not None:
+            old.close()
+
+    def mark_map_complete(self, shuffle_id: int, map_id: int) -> None:
+        with self._mu:
+            self._complete.add((shuffle_id, map_id))
+
+    def iter_blocks(self, shuffle_id: int, reduce_id: int,
+                    n_maps: int) -> Iterator[TpuColumnarBatch]:
+        """Raises FetchFailedError when any map's output was invalidated."""
+        with self._mu:
+            missing = [m for m in range(n_maps)
+                       if (shuffle_id, m) not in self._complete]
+        if missing:
+            raise FetchFailedError(shuffle_id, missing)
+        for map_id in range(n_maps):
+            with self._mu:
+                sb = self._blocks.get((shuffle_id, map_id, reduce_id))
+                # fetch under the lock: a concurrent invalidate/cleanup
+                # could close the spillable after we release it
+                batch = sb.get_batch() if sb is not None else None
+            if batch is not None:
+                yield batch
+
+    def invalidate_owner(self, executor_id: str) -> List[Tuple[int, int]]:
+        """Drop all blocks produced by a lost peer; returns the
+        (shuffle_id, map_id) pairs that need re-running."""
+        with self._mu:
+            lost = [sm for sm, o in self._owner.items() if o == executor_id]
+            lost_set = set(lost)
+            victims = [k for k in self._blocks if (k[0], k[1]) in lost_set]
+            closed = [self._blocks.pop(k) for k in victims]
+            for sm in lost:
+                del self._owner[sm]
+                self._complete.discard(sm)
+        for sb in closed:
+            sb.close()
+        return lost
+
+    def cleanup(self, shuffle_id: int) -> None:
+        with self._mu:
+            victims = [k for k in self._blocks if k[0] == shuffle_id]
+            closed = [self._blocks.pop(k) for k in victims]
+            self._owner = {sm: o for sm, o in self._owner.items()
+                           if sm[0] != shuffle_id}
+            self._complete = {sm for sm in self._complete
+                              if sm[0] != shuffle_id}
+        for sb in closed:
+            sb.close()
+
+    def block_count(self) -> int:
+        with self._mu:
+            return len(self._blocks)

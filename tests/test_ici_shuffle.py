@@ -1,0 +1,121 @@
+"""ICI (device-resident) shuffle mode tests — reference UCX-mode analogue:
+RapidsCachingWriter/Reader over a ShuffleBufferCatalog + heartbeat registry
+(SURVEY.md §2.7)."""
+
+import pyarrow as pa
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.shuffle.ici import (IciShuffleCatalog,
+                                          ShuffleHeartbeatManager)
+
+ICI = {"spark.rapids.shuffle.mode": "ICI"}
+
+
+def _df(s, n=2000, seed=21):
+    return s.createDataFrame(gen_df(
+        [("a", IntegerGen()), ("b", LongGen()), ("d", DoubleGen()),
+         ("s", StringGen())], n, seed))
+
+
+def test_ici_agg_matches_cpu():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("a").agg(F.sum(F.col("b")).alias("sb"),
+                                          F.count(F.col("s")).alias("c")),
+        conf=ICI, ignore_order=True)
+
+
+def test_ici_join_matches_cpu():
+    def q(s):
+        left = _df(s, n=1500, seed=1)
+        right = _df(s, n=1200, seed=2).select(F.col("a").alias("ra"),
+                                              F.col("d").alias("rd"))
+        return left.join(right, left["a"] == right["ra"], "inner")
+    assert_tpu_and_cpu_are_equal_collect(q, conf=ICI, ignore_order=True)
+
+
+def test_ici_repartition_strings():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).groupBy("s").agg(F.avg(F.col("d")).alias("ad")),
+        conf=ICI, ignore_order=True)
+
+
+def test_ici_blocks_stay_device_resident(monkeypatch):
+    """ICI mode must not serialize shuffle output to host files — the
+    multithreaded manager's writer must never be called."""
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    def forbidden(self, *a, **kw):
+        raise AssertionError("ICI mode wrote a host shuffle file")
+
+    monkeypatch.setattr(TpuShuffleManager, "write_map_output", forbidden)
+    catalog = IciShuffleCatalog.reset_for_tests()
+    s = TpuSession(dict(ICI))
+    df = _df(s).repartition(4, "a").groupBy("a").agg(
+        F.sum(F.col("b")).alias("sb"))
+    assert "TpuShuffleExchange" in df.explain()
+    rows = df.collect()
+    assert len(rows) > 0
+    # blocks were registered during the query and released at query end
+    assert catalog.block_count() == 0
+
+
+def test_catalog_cleanup():
+    catalog = IciShuffleCatalog.reset_for_tests()
+    t = pa.table({"x": pa.array(range(10), type=pa.int64())})
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    b = TpuColumnarBatch.from_arrow(t)
+    catalog.put_block(7, 0, 0, b, owner="executor-0")
+    catalog.put_block(7, 0, 1, b, owner="executor-0")
+    catalog.put_block(8, 0, 0, b, owner="executor-0")
+    catalog.mark_map_complete(7, 0)
+    catalog.mark_map_complete(8, 0)
+    assert catalog.block_count() == 3
+    catalog.cleanup(7)
+    assert catalog.block_count() == 1
+    got = list(catalog.iter_blocks(8, 0, 1))
+    assert len(got) == 1 and got[0].num_rows == 10
+    # cleanup removed shuffle 7's completion markers: reads now FetchFail
+    from spark_rapids_tpu.shuffle.ici import FetchFailedError
+    with pytest.raises(FetchFailedError):
+        list(catalog.iter_blocks(7, 0, 1))
+
+
+def test_heartbeat_lost_peer_invalidates_blocks():
+    hb = ShuffleHeartbeatManager.reset_for_tests()
+    catalog = IciShuffleCatalog.reset_for_tests()
+    t = pa.table({"x": pa.array(range(5), type=pa.int64())})
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    b = TpuColumnarBatch.from_arrow(t)
+    hb.register_peer("executor-0", now=100.0)
+    known = hb.register_peer("executor-1", now=100.0)
+    assert known == ["executor-0"]  # startup reply lists earlier peers
+    catalog.put_block(1, 0, 0, b, owner="executor-0")
+    catalog.put_block(1, 1, 0, b, owner="executor-1")
+    catalog.mark_map_complete(1, 0)
+    catalog.mark_map_complete(1, 1)
+    hb.heartbeat("executor-1", now=150.0)
+    lost = hb.lost_peers(now=150.0)  # executor-0 silent for 50s > 30s timeout
+    assert lost == ["executor-0"]
+    remaps = catalog.invalidate_owner("executor-0")
+    assert remaps == [(1, 0)]
+    assert catalog.block_count() == 1
+    assert hb.peers() == ["executor-1"]
+    # a reduce read now reports the lost map output instead of silently
+    # returning partial results
+    from spark_rapids_tpu.shuffle.ici import FetchFailedError
+    with pytest.raises(FetchFailedError) as ei:
+        list(catalog.iter_blocks(1, 0, 2))
+    assert ei.value.map_ids == [0]
+
+
+def test_ici_sort_query():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).filter(F.col("b") > 0)
+        .groupBy("a").agg(F.max(F.col("d")).alias("md"))
+        .orderBy(F.col("a")),
+        conf=ICI)
