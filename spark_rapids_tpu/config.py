@@ -296,6 +296,9 @@ AVRO_ENABLED = _conf("spark.rapids.sql.format.avro.enabled").doc(
     "Enable TPU Avro scans.").boolean(True)
 HIVE_TEXT_ENABLED = _conf("spark.rapids.sql.format.hive.text.enabled").doc(
     "Enable TPU Hive delimited-text scans/writes.").boolean(True)
+DEBUG_DUMP_PATH = _conf("spark.rapids.sql.debug.dumpPath").doc(
+    "When set, operators dump their last good batch to parquet under this "
+    "directory on failure (reference DumpUtils.scala).").string(None)
 OPTIMIZER_ENABLED = _conf("spark.rapids.sql.optimizer.enabled").doc(
     "Cost-based optimizer: revert plan sections whose estimated TPU cost "
     "(incl. transitions) exceeds the CPU cost (reference "

@@ -142,12 +142,53 @@ class TpuExec(PhysicalPlan):
     Subclasses implement internal_do_execute_columnar per partition."""
 
     def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        from .. import profiling
+        from ..config import DEBUG_DUMP_PATH
         out_rows = self.metrics["numOutputRows"]
         out_batches = self.metrics["numOutputBatches"]
-        for batch in self.internal_do_execute_columnar(idx, ctx):
+        dump = ctx.conf.get(DEBUG_DUMP_PATH)
+        keep_last = bool(dump)
+        self._last_batch = None  # don't attribute a prior partition's batch
+        it = self.internal_do_execute_columnar(idx, ctx)
+        tracing = profiling._PROFILING_ACTIVE
+        if not (tracing or keep_last):
+            # hot path: no per-batch scope/bookkeeping overhead
+            for batch in it:
+                out_rows.add(batch.num_rows)
+                out_batches.add(1)
+                yield batch
+            return
+        name = self.node_name()
+        while True:
+            # NVTX-range analogue: each batch pull is one named scope in the
+            # xprof timeline (reference NvtxWithMetrics around operator work)
+            with profiling.trace_scope(name):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                except Exception:
+                    self._dump_on_failure(ctx)
+                    raise
             out_rows.add(batch.num_rows)
             out_batches.add(1)
+            if keep_last:
+                self._last_batch = batch
             yield batch
+
+    def _dump_on_failure(self, ctx: TaskContext) -> None:
+        """Dump the operator's last good output batch for offline repro when
+        spark.rapids.sql.debug.dumpPath is set (reference DumpUtils)."""
+        from ..config import DEBUG_DUMP_PATH
+        path = ctx.conf.get(DEBUG_DUMP_PATH)
+        batch = getattr(self, "_last_batch", None)
+        if not path or batch is None:
+            return
+        try:
+            from ..profiling import dump_batch
+            dump_batch(batch, str(path), self.node_name())
+        except Exception:  # noqa: BLE001 — dumping must not mask the error
+            pass
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         raise NotImplementedError

@@ -63,6 +63,8 @@ def with_retry(
         except TpuSplitAndRetryOOM:
             if stats:
                 stats.split_retries += 1
+            from ..profiling import TaskMetricsRegistry
+            TaskMetricsRegistry.get().add("splitAndRetryCount", 1)
             if split_policy is None:
                 for s in pending:
                     s.close()
@@ -71,13 +73,19 @@ def with_retry(
         except TpuRetryOOM:
             if stats:
                 stats.retries += 1
+            from ..profiling import TaskMetricsRegistry
+            TaskMetricsRegistry.get().add("retryCount", 1)
             attempts += 1
             if attempts > max_retries:
                 for s in pending:
                     s.close()
                 raise
             # let pressure drain: spill everything spillable, then retry
+            import time as _time
+            t0 = _time.perf_counter_ns()
             TpuBufferCatalog.get().synchronous_spill(cur.size_bytes)
+            TaskMetricsRegistry.get().add("retryBlockTimeNs",
+                                          _time.perf_counter_ns() - t0)
 
 
 def with_retry_no_split(spillable: SpillableColumnarBatch,

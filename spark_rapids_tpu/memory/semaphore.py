@@ -52,7 +52,10 @@ class TpuSemaphore:
                 return
         t0 = time.perf_counter_ns()
         self._sem.acquire()
-        self.total_waits_ns += time.perf_counter_ns() - t0
+        waited = time.perf_counter_ns() - t0
+        self.total_waits_ns += waited
+        from ..profiling import TaskMetricsRegistry
+        TaskMetricsRegistry.get().add("semaphoreWaitNs", waited)
         with self._state_lock:
             self._holders[tid] = 1
         ctx.add_completion_listener(lambda: self.release_if_necessary(ctx))

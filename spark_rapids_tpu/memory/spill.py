@@ -110,6 +110,14 @@ class TpuBufferCatalog:
 
     def _unspill(self, e: _Entry) -> None:
         import pyarrow as pa
+        import time as _time
+        from ..profiling import TaskMetricsRegistry
+        t0 = _time.perf_counter_ns()
+        self._unspill_inner(e, pa)
+        TaskMetricsRegistry.get().add("readSpillTimeNs",
+                                      _time.perf_counter_ns() - t0)
+
+    def _unspill_inner(self, e: _Entry, pa) -> None:
         if e.tier == TIER_DISK:
             with pa.ipc.open_file(e.disk_path) as r:
                 e.host_table = r.read_all()
@@ -149,6 +157,8 @@ class TpuBufferCatalog:
         HbmBudget.get().free(e.nbytes)
         self.host_used += e.nbytes
         self.spilled_to_host += e.nbytes
+        from ..profiling import TaskMetricsRegistry
+        TaskMetricsRegistry.get().add("spillToHostBytes", e.nbytes)
         if self.host_used > self.host_limit:
             self._spill_host_to_disk()
         return e.nbytes
@@ -170,6 +180,8 @@ class TpuBufferCatalog:
                 e.tier = TIER_DISK
                 self.host_used -= e.nbytes
                 self.spilled_to_disk += e.nbytes
+                from ..profiling import TaskMetricsRegistry
+                TaskMetricsRegistry.get().add("spillToDiskBytes", e.nbytes)
 
 
 class SpillableColumnarBatch:

@@ -1,0 +1,184 @@
+"""Profiling, tracing, and task-level metrics.
+
+Reference (SURVEY.md §5 tracing/profiling):
+  (a) NVTX ranges around every operator (NvtxWithMetrics.scala) → here
+      `trace_scope` emits jax.profiler TraceAnnotations, visible in
+      xprof/TensorBoard timelines;
+  (b) the built-in sampled profiler (profiler.scala:37, JNI CUPTI Profiler,
+      `spark.rapids.profile.*` configs) → `TpuProfiler` drives
+      jax.profiler.start_trace/stop_trace writing to
+      `spark.rapids.profile.pathPrefix`;
+  (c) per-task accumulators GpuTaskMetrics (semaphore-wait, retry count/time,
+      spill-to-host/disk bytes, GpuTaskMetrics.scala:82-101) →
+      `TaskMetricsRegistry`;
+  (d) per-operator SQLMetrics at ESSENTIAL/MODERATE/DEBUG (GpuExec.scala:41)
+      → TpuMetric on every exec, surfaced via `collect_plan_metrics`;
+  (e) DumpUtils.scala (dump problem batches to parquet for offline repro) →
+      `dump_batch`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# (c) task metrics
+
+
+class TaskMetricsRegistry:
+    """Process-wide accumulators mirroring GpuTaskMetrics: semaphore wait,
+    retry counts/time, spill bytes, read-spill time."""
+
+    _instance: Optional["TaskMetricsRegistry"] = None
+    _lock = threading.Lock()
+
+    KNOWN = ("semaphoreWaitNs", "retryCount", "splitAndRetryCount",
+             "retryBlockTimeNs", "spillToHostBytes", "spillToDiskBytes",
+             "readSpillTimeNs")
+
+    def __init__(self):
+        self._vals: Dict[str, int] = {k: 0 for k in self.KNOWN}
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "TaskMetricsRegistry":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "TaskMetricsRegistry":
+        with cls._lock:
+            cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, value: int) -> None:
+        with self._mu:
+            self._vals[name] = self._vals.get(name, 0) + int(value)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._vals)
+
+
+# ---------------------------------------------------------------------------
+# (a) operator trace scopes (NVTX analogue)
+
+_PROFILING_ACTIVE = False
+
+
+@contextlib.contextmanager
+def trace_scope(name: str):
+    """NVTX-range analogue: a named scope in the xprof timeline. Free when no
+    trace is being captured."""
+    if not _PROFILING_ACTIVE:
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# (b) the profiler driver
+
+
+class TpuProfiler:
+    """Capture an xprof trace of a query region (reference ProfilerOnExecutor:
+    scoped by configs, written under spark.rapids.profile.pathPrefix)."""
+
+    def __init__(self, path_prefix: str):
+        self.path = os.path.join(path_prefix,
+                                 f"rapids-tpu-profile-{int(time.time())}")
+        self._active = False
+
+    def start(self) -> None:
+        global _PROFILING_ACTIVE
+        import jax.profiler
+        os.makedirs(self.path, exist_ok=True)
+        jax.profiler.start_trace(self.path)
+        self._active = True
+        _PROFILING_ACTIVE = True
+
+    def stop(self) -> None:
+        global _PROFILING_ACTIVE
+        if not self._active:
+            return
+        import jax.profiler
+        jax.profiler.stop_trace()
+        self._active = False
+        _PROFILING_ACTIVE = False
+
+    def __enter__(self) -> "TpuProfiler":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# (d) plan metric collection
+
+
+_LEVEL_ORDER = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
+def collect_plan_metrics(plan, level: str = "MODERATE") -> Dict[str, Dict[str, int]]:
+    """Per-operator metric values at or above the requested level
+    (ESSENTIAL ⊂ MODERATE ⊂ DEBUG, reference GpuMetric levels)."""
+    want = _LEVEL_ORDER.get(str(level).upper(), 1)
+    out: Dict[str, Dict[str, int]] = {}
+    for i, node in enumerate(plan.collect_nodes()):
+        vals = {m.name: m.value for m in node.metrics.values()
+                if _LEVEL_ORDER.get(m.level, 1) <= want and m.value}
+        if vals:
+            out[f"{i}:{node.node_name()}"] = vals
+    return out
+
+
+def snapshot_plan_metrics(plan) -> Dict[str, Dict[str, tuple]]:
+    """All non-zero metrics with their levels, as plain data — lets the
+    session drop the plan reference after the query (no device buffers
+    pinned) while still supporting level filtering later."""
+    out: Dict[str, Dict[str, tuple]] = {}
+    for i, node in enumerate(plan.collect_nodes()):
+        vals = {m.name: (m.value, m.level) for m in node.metrics.values()
+                if m.value}
+        if vals:
+            out[f"{i}:{node.node_name()}"] = vals
+    return out
+
+
+def metric_level_filter(snapshot: Dict[str, Dict[str, tuple]],
+                        level: str) -> Dict[str, Dict[str, int]]:
+    want = _LEVEL_ORDER.get(str(level).upper(), 1)
+    out: Dict[str, Dict[str, int]] = {}
+    for op, vals in snapshot.items():
+        kept = {n: v for n, (v, lvl) in vals.items()
+                if _LEVEL_ORDER.get(lvl, 1) <= want}
+        if kept:
+            out[op] = kept
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (e) batch dump for offline repro
+
+
+def dump_batch(batch, path_prefix: str, op_name: str) -> str:
+    """Write a problem batch to parquet for offline repro (reference
+    DumpUtils.scala). Returns the written path."""
+    import pyarrow.parquet as pq
+    os.makedirs(path_prefix, exist_ok=True)
+    p = os.path.join(path_prefix,
+                     f"dump-{op_name}-{int(time.time() * 1000)}.parquet")
+    table = batch if hasattr(batch, "num_columns") and not hasattr(
+        batch, "to_arrow") else batch.to_arrow()
+    pq.write_table(table, p)
+    return p

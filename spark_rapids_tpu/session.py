@@ -802,6 +802,8 @@ class TpuSession:
         names = [a.name for a in final.output]
         from .types import to_arrow as t2a
         schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
+        from .profiling import TaskMetricsRegistry, snapshot_plan_metrics
+        task_metrics_before = TaskMetricsRegistry.get().snapshot()
         tables = []
         try:
             for p in range(final.num_partitions()):
@@ -813,6 +815,13 @@ class TpuSession:
                 finally:
                     ctx.complete()
         finally:
+            # snapshot metrics into plain dicts so the plan (and any device
+            # buffers it references) is not pinned past the query
+            self._last_metrics_snapshot = snapshot_plan_metrics(final)
+            after = TaskMetricsRegistry.get().snapshot()
+            self._last_task_metrics = {
+                k: after.get(k, 0) - task_metrics_before.get(k, 0)
+                for k in after}
             # release shuffle blocks/files at query end (reference: Spark's
             # ContextCleaner removing shuffle state); exchanges re-materialize
             # if the same DataFrame is collected again
@@ -822,6 +831,34 @@ class TpuSession:
         if not tables:
             return schema.empty_table()
         return pa.concat_tables(tables).cast(schema)
+
+    def last_query_metrics(self, level: Optional[str] = None):
+        """Per-operator metrics of the last executed query (the reference
+        surfaces these as SQLMetrics in the Spark SQL UI)."""
+        from .config import METRICS_LEVEL
+        snap = getattr(self, "_last_metrics_snapshot", None)
+        if snap is None:
+            return {}
+        lvl = str(level or self._rapids_conf().get(METRICS_LEVEL)).upper()
+        from .profiling import metric_level_filter
+        return metric_level_filter(snap, lvl)
+
+    def last_task_metrics(self):
+        """Task-accumulator deltas for the last query alone (reference
+        GpuTaskMetrics shown per SQL execution): semaphore wait, retry
+        counts/time, spill bytes, read-spill time."""
+        return dict(getattr(self, "_last_task_metrics", {}))
+
+    def profiler(self):
+        """Context manager capturing an xprof trace of the enclosed queries
+        (reference ProfilerOnExecutor; requires
+        spark.rapids.profile.pathPrefix)."""
+        from .config import PROFILE_PATH_PREFIX
+        from .profiling import TpuProfiler
+        prefix = self._rapids_conf().get(PROFILE_PATH_PREFIX)
+        if not prefix or prefix == "None":
+            raise ValueError("set spark.rapids.profile.pathPrefix to profile")
+        return TpuProfiler(prefix)
 
     def stop(self) -> None:
         pass

@@ -1,0 +1,185 @@
+"""Profiling/metrics/tracing tests (reference §5: GpuTaskMetrics, GpuMetric
+levels, ProfilerOnExecutor, DumpUtils)."""
+
+import glob
+import os
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.profiling import (TaskMetricsRegistry,
+                                        collect_plan_metrics, dump_batch)
+from spark_rapids_tpu.session import TpuSession
+
+
+def _q(s, n=5000):
+    t = pa.table({"k": pa.array([i % 11 for i in range(n)], type=pa.int32()),
+                  "v": pa.array([i * 0.5 for i in range(n)])})
+    return (s.createDataFrame(t).filter(F.col("v") > 10.0)
+            .groupBy("k").agg(F.sum(F.col("v")).alias("sv")))
+
+
+def test_operator_metrics_collected():
+    s = TpuSession({})
+    _q(s).collect()
+    m = s.last_query_metrics()
+    joined = " ".join(m.keys())
+    assert "TpuHashAggregateExec" in joined and "TpuFilterExec" in joined
+    agg = next(v for k, v in m.items() if "HashAggregate" in k)
+    assert agg["numOutputRows"] == 11
+    assert "opTime" in agg or "sortTime" in agg  # MODERATE level included
+
+
+def test_metrics_level_filtering():
+    s = TpuSession({"spark.rapids.sql.metrics.level": "ESSENTIAL"})
+    _q(s).collect()
+    for vals in s.last_query_metrics().values():
+        assert set(vals) <= {"numOutputRows"}
+    # explicit DEBUG includes everything recorded
+    dbg = s.last_query_metrics(level="DEBUG")
+    assert any(len(v) > 1 for v in dbg.values())
+
+
+def test_task_metrics_semaphore_and_spill():
+    reg = TaskMetricsRegistry.reset_for_tests()
+    s = TpuSession({})
+    _q(s).collect()
+    snap = reg.snapshot()
+    assert snap["semaphoreWaitNs"] >= 0
+    assert set(TaskMetricsRegistry.KNOWN) <= set(snap)
+
+
+def test_task_metrics_retry_counts():
+    """Injected OOM inside a with_retry region increments the accumulator
+    (reference GpuTaskMetrics retry counts)."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.memory.hbm import HbmBudget
+    from spark_rapids_tpu.memory.retry import with_retry
+    from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+    reg = TaskMetricsRegistry.reset_for_tests()
+    budget = HbmBudget.get()
+    t = pa.table({"a": pa.array(np.arange(64), type=pa.int64())})
+    sb = SpillableColumnarBatch(TpuColumnarBatch.from_arrow(t))
+    budget.force_retry_oom(2)
+    out = list(with_retry(sb, lambda b: (budget.allocate(0), b.num_rows)[1]))
+    assert out == [64]
+    assert reg.snapshot()["retryCount"] == 2
+    assert reg.snapshot()["retryBlockTimeNs"] > 0
+
+
+def test_dump_batch_roundtrip(tmp_path):
+    t = pa.table({"a": pa.array(range(10), type=pa.int64())})
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    p = dump_batch(TpuColumnarBatch.from_arrow(t), str(tmp_path), "TestOp")
+    import pyarrow.parquet as pq
+    back = pq.read_table(p)
+    assert back.column("a").to_pylist() == list(range(10))
+
+
+def test_dump_on_operator_failure(tmp_path):
+    """An operator that already emitted a batch dumps it to parquet when a
+    later batch of the SAME partition fails (reference DumpUtils)."""
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.execs.base import TaskContext, TpuExec
+    from spark_rapids_tpu.config import RapidsConf
+
+    class TwoBatchThenBoom(TpuExec):
+        def __init__(self):
+            super().__init__([])
+
+        @property
+        def output(self):
+            from spark_rapids_tpu.expressions.base import AttributeReference
+            from spark_rapids_tpu.types import LongType
+            return [AttributeReference("a", LongType(), True)]
+
+        def internal_do_execute_columnar(self, idx, ctx):
+            yield TpuColumnarBatch.from_arrow(
+                pa.table({"a": pa.array([1, 2, 3], type=pa.int64())}))
+            raise RuntimeError("boom after first batch")
+
+    conf = RapidsConf({"spark.rapids.sql.debug.dumpPath": str(tmp_path)})
+    exec_ = TwoBatchThenBoom()
+    ctx = TaskContext(0, conf)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(exec_.execute_partition(0, ctx))
+    dumps = glob.glob(str(tmp_path) + "/dump-TwoBatchThenBoom-*.parquet")
+    assert len(dumps) == 1
+    assert pq.read_table(dumps[0]).column("a").to_pylist() == [1, 2, 3]
+
+
+def test_no_dump_of_prior_partition(tmp_path):
+    """A failure on the FIRST batch of a partition must not dump the
+    previous partition's output (stale attribution regression)."""
+    import glob as g
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.execs.base import TaskContext, TpuExec
+    from spark_rapids_tpu.config import RapidsConf
+
+    class GoodThenImmediateBoom(TpuExec):
+        def __init__(self):
+            super().__init__([])
+
+        @property
+        def output(self):
+            from spark_rapids_tpu.expressions.base import AttributeReference
+            from spark_rapids_tpu.types import LongType
+            return [AttributeReference("a", LongType(), True)]
+
+        def num_partitions(self):
+            return 2
+
+        def internal_do_execute_columnar(self, idx, ctx):
+            if idx == 0:
+                yield TpuColumnarBatch.from_arrow(
+                    pa.table({"a": pa.array([9], type=pa.int64())}))
+                return
+            raise RuntimeError("partition 1 fails before any batch")
+
+    conf = RapidsConf({"spark.rapids.sql.debug.dumpPath": str(tmp_path)})
+    exec_ = GoodThenImmediateBoom()
+    list(exec_.execute_partition(0, TaskContext(0, conf)))
+    with pytest.raises(RuntimeError):
+        list(exec_.execute_partition(1, TaskContext(1, conf)))
+    assert g.glob(str(tmp_path) + "/dump-*.parquet") == []
+
+
+def test_profiler_writes_trace(tmp_path):
+    s = TpuSession({"spark.rapids.profile.pathPrefix": str(tmp_path)})
+    with s.profiler():
+        _q(s, n=500).collect()
+    written = glob.glob(str(tmp_path) + "/**/*", recursive=True)
+    assert any(os.path.isfile(f) for f in written)
+
+
+def test_profiler_requires_prefix():
+    s = TpuSession({})
+    with pytest.raises(ValueError):
+        s.profiler()
+
+
+def test_collect_plan_metrics_levels_are_nested():
+    s = TpuSession({})
+    _q(s).collect()
+    c = lambda d: sum(len(v) for v in d.values())
+    ess = c(s.last_query_metrics(level="ESSENTIAL"))
+    mod = c(s.last_query_metrics(level="MODERATE"))
+    dbg = c(s.last_query_metrics(level="DEBUG"))
+    assert 0 < ess <= mod <= dbg
+
+
+def test_last_task_metrics_is_per_query():
+    """Task metrics reported per query, not merged across queries."""
+    TaskMetricsRegistry.reset_for_tests()
+    s = TpuSession({})
+    _q(s).collect()
+    first = s.last_task_metrics()
+    _q(s, n=100).collect()
+    second = s.last_task_metrics()
+    assert set(first) == set(TaskMetricsRegistry.KNOWN)
+    # the second query's deltas are independent of the first's totals
+    assert second["semaphoreWaitNs"] <= first["semaphoreWaitNs"] + \
+        TaskMetricsRegistry.get().snapshot()["semaphoreWaitNs"]
