@@ -296,6 +296,27 @@ AVRO_ENABLED = _conf("spark.rapids.sql.format.avro.enabled").doc(
     "Enable TPU Avro scans.").boolean(True)
 HIVE_TEXT_ENABLED = _conf("spark.rapids.sql.format.hive.text.enabled").doc(
     "Enable TPU Hive delimited-text scans/writes.").boolean(True)
+FILECACHE_ENABLED = _conf("spark.rapids.filecache.enabled").doc(
+    "Cache remote scan inputs (s3/gs/hdfs/...) on local disk (reference: "
+    "the spark-rapids-private FileCache; SURVEY.md §1 notes the TPU build "
+    "implements it directly).").boolean(False)
+FILECACHE_PATH = _conf("spark.rapids.filecache.path").doc(
+    "Local directory for the file cache (defaults to a temp dir)."
+).string(None)
+FILECACHE_MAX_BYTES = _conf("spark.rapids.filecache.maxBytes").doc(
+    "File-cache size budget; least-recently-used files are evicted."
+).bytes(100 * (1 << 30))
+CORE_DUMP_DIR = _conf("spark.rapids.tpu.coreDump.dir").doc(
+    "When set, fatal device errors write a diagnostic bundle (device "
+    "topology, HBM accounting, task metrics, traceback) here before the "
+    "executor exits (reference GpuCoreDumpHandler + "
+    "spark.rapids.gpu.coreDump.*).").string(None)
+FATAL_ERROR_EXIT = _conf("spark.rapids.tpu.fatalError.exit").doc(
+    "Exit the process on a fatal device error so a cluster manager can "
+    "reschedule (reference RapidsExecutorPlugin.logGpuDebugInfoAndExit). "
+    "Off by default: this engine runs in the driver process, so exiting "
+    "would kill the user's application — enable it only when running as a "
+    "managed executor.").boolean(False)
 DEBUG_DUMP_PATH = _conf("spark.rapids.sql.debug.dumpPath").doc(
     "When set, operators dump their last good batch to parquet under this "
     "directory on failure (reference DumpUtils.scala).").string(None)

@@ -1,0 +1,106 @@
+"""Fatal device-error detection and diagnostic capture.
+
+Reference (SURVEY.md §5 failure detection):
+  * RapidsExecutorPlugin.onTaskFailed → containsCudaFatalException →
+    logGpuDebugInfoAndExit (Plugin.scala:669-695,635): a fatal device error
+    kills the executor so Spark reschedules its tasks elsewhere;
+  * GpuCoreDumpHandler (GpuCoreDumpHandler.scala:38-190): capture a device
+    core dump to distributed storage before exiting.
+
+TPU analogue: XLA surfaces device failures as XlaRuntimeError (and jax
+raises RuntimeError for device-side crashes). `handle_task_failure`
+classifies the error; for fatal ones it writes a diagnostic bundle (device
+topology, memory stats, task metrics, the error) under
+`spark.rapids.tpu.coreDump.dir` and — when `exit_on_fatal` — terminates the
+process so the cluster manager reschedules (tests use exit_on_fatal=False).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from typing import Optional
+
+_FATAL_MARKERS = (
+    "DEADLINE_EXCEEDED", "INTERNAL", "device halted", "HBM OOM",
+    "Device or resource busy", "failed to synchronize", "UNAVAILABLE",
+    "hardware error", "data loss",
+)
+
+
+def is_fatal_device_error(exc: BaseException) -> bool:
+    """Classify: does this error mean the device/runtime is unusable
+    (reference containsCudaFatalException walking the cause chain)?"""
+    seen = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        name = type(cur).__name__
+        if name == "XlaRuntimeError":
+            msg = str(cur)
+            if any(m in msg for m in _FATAL_MARKERS):
+                return True
+        cur = cur.__cause__ or cur.__context__
+    return False
+
+
+def write_diagnostic_bundle(exc: BaseException, dump_dir: str,
+                            extra: Optional[dict] = None) -> str:
+    """GpuCoreDumpHandler analogue: capture device topology, memory
+    accounting, task metrics and the failure into a JSON bundle."""
+    os.makedirs(dump_dir, exist_ok=True)
+    bundle = {
+        "timestamp": time.time(),
+        "error_type": type(exc).__name__,
+        "error": str(exc),
+        "traceback": traceback.format_exception(type(exc), exc,
+                                                exc.__traceback__),
+    }
+    try:
+        import jax
+        bundle["devices"] = [
+            {"id": d.id, "kind": getattr(d, "device_kind", "?"),
+             "platform": d.platform} for d in jax.devices()]
+    except Exception:  # noqa: BLE001 — a dead runtime must not stop the dump
+        bundle["devices"] = "unavailable"
+    try:
+        from .memory.hbm import HbmBudget
+        b = HbmBudget.get()
+        bundle["hbm"] = {"budget": b.budget, "used": b.used}
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .profiling import TaskMetricsRegistry
+        bundle["task_metrics"] = TaskMetricsRegistry.get().snapshot()
+    except Exception:  # noqa: BLE001
+        pass
+    if extra:
+        bundle["extra"] = extra
+    path = os.path.join(dump_dir,
+                        f"tpu-diagnostic-{int(time.time() * 1000)}.json")
+    with open(path, "w") as f:
+        json.dump(bundle, f, indent=2, default=str)
+    return path
+
+
+def handle_task_failure(exc: BaseException, conf,
+                        exit_on_fatal: bool = True) -> Optional[str]:
+    """Executor failure hook (reference RapidsExecutorPlugin.onTaskFailed).
+    Returns the diagnostic path when a fatal error was captured."""
+    from .config import CORE_DUMP_DIR
+    if not is_fatal_device_error(exc):
+        return None
+    dump_dir = conf.get(CORE_DUMP_DIR)
+    path = None
+    if dump_dir:
+        try:
+            path = write_diagnostic_bundle(exc, str(dump_dir))
+        except Exception:  # noqa: BLE001 — never mask the original failure
+            pass
+    if exit_on_fatal:
+        # the reference exits the executor so Spark reschedules elsewhere
+        # (logGpuDebugInfoAndExit); tests pass exit_on_fatal=False
+        os._exit(1)
+    return path

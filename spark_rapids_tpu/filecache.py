@@ -1,0 +1,169 @@
+"""Local-disk file cache for remote scan inputs.
+
+Reference: the closed-source `spark-rapids-private` FileCache (imported at
+Plugin.scala:32 and GpuExec.scala:21; config surfaced through
+RapidsPrivateUtil.scala:32) — caches remote parquet/ORC byte ranges on local
+disk so repeated scans of cloud-object-store files hit local SSD. SURVEY.md
+§1 notes the TPU build must implement this itself.
+
+Design: whole-file granularity keyed by (path, size, mtime) with LRU
+eviction under a byte budget. `resolve()` returns a local path — a cache hit
+for already-copied files, a miss that populates the cache otherwise; local
+files pass through untouched unless caching of local paths is forced (used
+by tests and by NFS-like mounts where a local copy still wins)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from .config import (FILECACHE_ENABLED, FILECACHE_MAX_BYTES, FILECACHE_PATH,
+                     RapidsConf)
+
+_REMOTE_SCHEMES = ("s3://", "s3a://", "gs://", "hdfs://", "abfs://",
+                   "wasb://", "http://", "https://")
+
+
+#: entries handed out within this window are never evicted — resolve()
+#: returns a raw path, so the caller needs time to open it (a refcount API
+#: would be stronger; the grace window keeps the caller contract simple)
+_EVICTION_GRACE_S = 60.0
+
+
+class FileCache:
+    #: one instance per (cache_dir, max_bytes) so differently-configured
+    #: sessions in one process don't silently share the first caller's cache
+    _instances: dict = {}
+    _lock = threading.Lock()
+
+    def __init__(self, cache_dir: str, max_bytes: int):
+        self.cache_dir = cache_dir
+        self.max_bytes = max_bytes
+        os.makedirs(cache_dir, exist_ok=True)
+        # key → (local path, size, last handed-out time); insertion order=LRU
+        self._entries: "OrderedDict[str, list]" = OrderedDict()
+        self._used = 0
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    _test_override: Optional["FileCache"] = None
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None) -> "FileCache":
+        from .config import default_conf
+        if cls._test_override is not None:
+            return cls._test_override
+        c = conf or default_conf()
+        path = c.get(FILECACHE_PATH)
+        if not path or path == "None":
+            import tempfile
+            path = os.path.join(tempfile.gettempdir(),
+                                "rapids_tpu_filecache")
+        key = (str(path), int(c.get(FILECACHE_MAX_BYTES)))
+        with cls._lock:
+            inst = cls._instances.get(key)
+            if inst is None:
+                inst = FileCache(key[0], key[1])
+                cls._instances[key] = inst
+            return inst
+
+    @classmethod
+    def reset_for_tests(cls, cache_dir: Optional[str] = None,
+                        max_bytes: int = 1 << 30) -> "FileCache":
+        import tempfile
+        d = cache_dir or tempfile.mkdtemp(prefix="tpu_fc_")
+        with cls._lock:
+            cls._instances = {}
+            cls._test_override = FileCache(d, max_bytes)
+            return cls._test_override
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def is_remote(path: str) -> bool:
+        return path.startswith(_REMOTE_SCHEMES)
+
+    @staticmethod
+    def _source_of(path: str) -> str:
+        """Filesystem-reachable source for a possibly-remote URI (an
+        object-store client would stream instead in a real deployment)."""
+        for scheme in _REMOTE_SCHEMES:
+            if path.startswith(scheme):
+                return "/" + path[len(scheme):].split("/", 1)[1]
+        return path
+
+    def _key(self, path: str) -> str:
+        # stat the actual source so a changed file gets a new key (stale
+        # cached bytes are never served)
+        try:
+            st = os.stat(self._source_of(path))
+            tag = f"{st.st_size}-{st.st_mtime_ns}"
+        except OSError:
+            tag = "unknown"
+        import hashlib
+        return hashlib.sha1(f"{path}|{tag}".encode()).hexdigest()
+
+    def resolve(self, path: str, conf: RapidsConf,
+                force: bool = False) -> str:
+        """Return a local path for `path`, copying through the cache when the
+        input is remote (or force=True). Non-cacheable inputs pass through."""
+        if not conf.get(FILECACHE_ENABLED):
+            return path
+        if not (force or self.is_remote(path)):
+            return path
+        key = self._key(path)
+        with self._mu:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)  # LRU touch
+                hit[2] = time.monotonic()
+                self.hits += 1
+                return hit[0]
+            self.misses += 1
+        return self._populate(key, path)
+
+    def _populate(self, key: str, path: str) -> str:
+        ext = os.path.splitext(path)[1]
+        local = os.path.join(self.cache_dir, f"{key}{ext}")
+        tmp = f"{local}.tmp-{threading.get_ident()}"
+        shutil.copyfile(self._source_of(path), tmp)
+        size = os.path.getsize(tmp)
+        os.replace(tmp, local)  # atomic: concurrent writers converge
+        with self._mu:
+            if key not in self._entries:  # lost-race double-count guard
+                self._entries[key] = [local, size, time.monotonic()]
+                self._used += size
+                self._evict_locked()
+            else:
+                self._entries[key][2] = time.monotonic()
+        return local
+
+    def _evict_locked(self) -> None:
+        now = time.monotonic()
+        scanned = 0
+        while self._used > self.max_bytes and \
+                scanned < len(self._entries) and len(self._entries) > 1:
+            key, (victim, size, handed) = next(iter(self._entries.items()))
+            if now - handed < _EVICTION_GRACE_S:
+                # recently handed out — a reader may not have opened it yet
+                self._entries.move_to_end(key)
+                scanned += 1
+                continue
+            del self._entries[key]
+            self._used -= size
+            self.evictions += 1
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "bytes": self._used,
+                    "entries": len(self._entries)}

@@ -35,9 +35,22 @@ def _split_files(paths: List[str], n: int) -> List[List[str]]:
 def _read_one(path: str, fmt: str, columns: Optional[List[str]],
               arrow_filter, options: dict):
     import pyarrow as pa
+    # deletion vectors / stats are keyed by the ORIGINAL path; look them up
+    # before the file cache rewrites it to a local copy
+    dv_rows = (options or {}).get("__dv_rows__", {}).get(path)
+    conf = (options or {}).get("__conf__")
+    if conf is not None:
+        # remote inputs route through the local file cache (reference: the
+        # spark-rapids-private FileCache hooks in GpuExec/Plugin)
+        from ..filecache import FileCache
+        from ..config import FILECACHE_ENABLED
+        if conf.get(FILECACHE_ENABLED):
+            path = FileCache.get(conf).resolve(
+                path, conf,
+                force=str((options or {}).get("filecache.force",
+                                              "false")).lower() == "true")
     if fmt == "parquet":
         import pyarrow.parquet as pq
-        dv_rows = (options or {}).get("__dv_rows__", {}).get(path)
         fid_map = (options or {}).get("__iceberg_field_ids__")
         if fid_map is not None:
             from .iceberg import read_iceberg_parquet
@@ -185,6 +198,7 @@ class FileScanBase:
     def _partition_tables(self, idx: int, ctx: TaskContext) -> Iterator:
         """Host-side reads for one partition under the selected strategy."""
         import pyarrow as pa
+        self.options["__conf__"] = ctx.conf  # file-cache resolution
         files = _split_files(self.paths, self._n_parts)[idx]
         file_stats = self.options.get("__file_stats__")
         if file_stats and self._arrow_filter:

@@ -812,6 +812,16 @@ class TpuSession:
                     for t in final.execute_partition(p, ctx):
                         if t.num_rows:
                             tables.append(t.rename_columns(names))
+                except BaseException as exc:
+                    # fatal device errors capture diagnostics and (outside
+                    # tests) exit so the cluster manager reschedules
+                    # (reference RapidsExecutorPlugin.onTaskFailed)
+                    from .config import FATAL_ERROR_EXIT
+                    from .failure import handle_task_failure
+                    handle_task_failure(
+                        exc, conf,
+                        exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
+                    raise
                 finally:
                     ctx.complete()
         finally:

@@ -1,0 +1,183 @@
+"""File cache + fatal-failure-handling tests (reference: spark-rapids-private
+FileCache, RapidsExecutorPlugin fatal-error path, GpuCoreDumpHandler)."""
+
+import json
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.failure import (handle_task_failure,
+                                      is_fatal_device_error,
+                                      write_diagnostic_bundle)
+from spark_rapids_tpu.filecache import FileCache
+from spark_rapids_tpu.session import TpuSession
+
+
+def _write_parquet(path, n=500, base=0):
+    pq.write_table(pa.table({
+        "a": pa.array(range(base, base + n), type=pa.int64()),
+        "v": pa.array([i * 0.5 for i in range(base, base + n)]),
+    }), path)
+
+
+def test_filecache_hit_miss(tmp_path):
+    fc = FileCache.reset_for_tests(str(tmp_path / "cache"))
+    src = str(tmp_path / "data.parquet")
+    _write_parquet(src)
+    conf = RapidsConf({"spark.rapids.filecache.enabled": "true"})
+    p1 = fc.resolve(src, conf, force=True)
+    assert p1 != src and os.path.exists(p1)
+    assert fc.stats()["misses"] == 1
+    p2 = fc.resolve(src, conf, force=True)
+    assert p2 == p1 and fc.stats()["hits"] == 1
+    # identical content
+    assert pq.read_table(p1).equals(pq.read_table(src))
+
+
+def test_filecache_local_passthrough(tmp_path):
+    fc = FileCache.reset_for_tests(str(tmp_path / "cache"))
+    src = str(tmp_path / "d.parquet")
+    _write_parquet(src)
+    conf = RapidsConf({"spark.rapids.filecache.enabled": "true"})
+    assert fc.resolve(src, conf) == src  # local, not forced → untouched
+    conf_off = RapidsConf({})
+    assert fc.resolve(src, conf_off, force=True) == src  # disabled
+
+
+def test_filecache_invalidation_on_modify(tmp_path):
+    fc = FileCache.reset_for_tests(str(tmp_path / "cache"))
+    src = str(tmp_path / "d.parquet")
+    _write_parquet(src, n=100)
+    conf = RapidsConf({"spark.rapids.filecache.enabled": "true"})
+    fc.resolve(src, conf, force=True)
+    os.utime(src, (1, 1))  # mtime change → new cache key
+    fc.resolve(src, conf, force=True)
+    assert fc.stats()["misses"] == 2
+
+
+def test_filecache_lru_eviction(tmp_path, monkeypatch):
+    import spark_rapids_tpu.filecache as fcmod
+    monkeypatch.setattr(fcmod, "_EVICTION_GRACE_S", 0.0)
+    small = 40_000  # bytes — fits ~2 of our parquet files
+    fc = FileCache.reset_for_tests(str(tmp_path / "cache"), max_bytes=small)
+    conf = RapidsConf({"spark.rapids.filecache.enabled": "true"})
+    locals_ = []
+    for i in range(4):
+        src = str(tmp_path / f"f{i}.parquet")
+        _write_parquet(src, n=2000, base=i * 1000)
+        locals_.append(fc.resolve(src, conf, force=True))
+    st = fc.stats()
+    assert st["evictions"] >= 1
+    assert st["bytes"] <= small or st["entries"] == 1
+
+
+def test_filecache_through_scan(tmp_path):
+    fc = FileCache.reset_for_tests(str(tmp_path / "cache"))
+    src = str(tmp_path / "scan.parquet")
+    _write_parquet(src, n=800)
+    s = TpuSession({"spark.rapids.filecache.enabled": "true"})
+    df = s.read.option("filecache.force", "true").parquet(src)
+    # reader options flow into the scan; read twice → second is a hit
+    total1 = len(df.filter(F.col("a") >= 0).collect())
+    df2 = s.read.option("filecache.force", "true").parquet(src)
+    total2 = len(df2.filter(F.col("a") >= 0).collect())
+    assert total1 == total2 == 800
+    st = fc.stats()
+    assert st["misses"] >= 1 and st["hits"] >= 1
+
+
+def test_filecache_preserves_deletion_vectors(tmp_path):
+    """DV row masks are keyed by the original path — the cache rewrite must
+    not drop them (regression: deleted rows reappearing)."""
+    FileCache.reset_for_tests(str(tmp_path / "cache"))
+    d = str(tmp_path / "tbl")
+    s = TpuSession({"spark.rapids.filecache.enabled": "true"})
+    src = s.createDataFrame(pa.table({
+        "a": pa.array(range(100), type=pa.int64())}))
+    src.write.format("delta").option("delta.enableDeletionVectors", "true") \
+        .save(d)
+    from spark_rapids_tpu.io.delta import DeltaTable
+    DeltaTable.forPath(s, d).delete(F.col("a") < 50)
+    rows = s.read.option("filecache.force", "true").format("delta") \
+        .load(d).collect()
+    got = sorted(r["a"] for r in rows)
+    assert got == list(range(50, 100))
+
+
+def test_filecache_concurrent_populate_single_accounting(tmp_path):
+    import threading as th
+    fc = FileCache.reset_for_tests(str(tmp_path / "cache"))
+    src = str(tmp_path / "c.parquet")
+    _write_parquet(src, n=3000)
+    conf = RapidsConf({"spark.rapids.filecache.enabled": "true"})
+    results = []
+
+    def run():
+        results.append(fc.resolve(src, conf, force=True))
+
+    threads = [th.Thread(target=run) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(results)) == 1
+    st = fc.stats()
+    assert st["entries"] == 1
+    assert st["bytes"] == os.path.getsize(results[0])  # no double count
+
+
+# ---------------------------------------------------------------------------
+# failure handling
+
+
+class _FakeXlaError(RuntimeError):
+    pass
+
+
+_FakeXlaError.__name__ = "XlaRuntimeError"
+
+
+def test_fatal_classification():
+    assert is_fatal_device_error(_FakeXlaError("INTERNAL: device halted"))
+    assert not is_fatal_device_error(ValueError("bad argument"))
+    assert not is_fatal_device_error(_FakeXlaError("INVALID_ARGUMENT: shape"))
+    # cause-chain walk
+    outer = RuntimeError("wrapper")
+    outer.__cause__ = _FakeXlaError("UNAVAILABLE: connection lost")
+    assert is_fatal_device_error(outer)
+
+
+def test_diagnostic_bundle(tmp_path):
+    err = _FakeXlaError("INTERNAL: device halted")
+    p = write_diagnostic_bundle(err, str(tmp_path), extra={"stage": 3})
+    with open(p) as f:
+        bundle = json.load(f)
+    assert bundle["error_type"] == "XlaRuntimeError"
+    assert "device halted" in bundle["error"]
+    assert bundle["extra"]["stage"] == 3
+    assert "task_metrics" in bundle and "devices" in bundle
+
+
+def test_handle_task_failure_writes_and_skips_exit(tmp_path):
+    conf = RapidsConf({"spark.rapids.tpu.coreDump.dir": str(tmp_path)})
+    err = _FakeXlaError("INTERNAL: hardware error detected")
+    path = handle_task_failure(err, conf, exit_on_fatal=False)
+    assert path is not None and os.path.exists(path)
+    # non-fatal → no bundle
+    assert handle_task_failure(ValueError("x"), conf,
+                               exit_on_fatal=False) is None
+
+
+def test_nonfatal_query_error_propagates():
+    """Ordinary expression errors pass through the failure hook unchanged."""
+    from spark_rapids_tpu.udf import udf
+    s = TpuSession({"spark.rapids.tpu.fatalError.exit": "false"})
+    boom = udf(lambda a: 1 // 0, returnType="int")
+    df = s.createDataFrame(pa.table({"a": pa.array([1, 2])})) \
+        .select(boom(F.col("a")).alias("x"))
+    with pytest.raises(ZeroDivisionError):
+        df.collect()
