@@ -356,6 +356,28 @@ WINDOW_ENABLED = _conf("spark.rapids.sql.exec.WindowExec").doc(
     "Enable TPU window functions.").boolean(True)
 PROJECT_ENABLED = _conf("spark.rapids.sql.exec.ProjectExec").doc(
     "Enable TPU projection.").boolean(True)
+RANGE_ENABLED = _conf("spark.rapids.sql.exec.RangeExec").doc(
+    "Enable TPU range.").boolean(True)
+UNION_ENABLED = _conf("spark.rapids.sql.exec.UnionExec").doc(
+    "Enable TPU union.").boolean(True)
+LOCAL_LIMIT_ENABLED = _conf("spark.rapids.sql.exec.LocalLimitExec").doc(
+    "Enable TPU local limit.").boolean(True)
+GLOBAL_LIMIT_ENABLED = _conf("spark.rapids.sql.exec.GlobalLimitExec").doc(
+    "Enable TPU global limit.").boolean(True)
+TOPN_ENABLED = _conf("spark.rapids.sql.exec.TakeOrderedAndProjectExec").doc(
+    "Enable TPU top-N (sort+limit fusion).").boolean(True)
+SAMPLE_ENABLED = _conf("spark.rapids.sql.exec.SampleExec").doc(
+    "Enable TPU sampling.").boolean(True)
+BNLJ_ENABLED = _conf("spark.rapids.sql.exec.BroadcastNestedLoopJoinExec").doc(
+    "Enable TPU broadcast nested-loop join.").boolean(True)
+EXCHANGE_ENABLED = _conf("spark.rapids.sql.exec.ShuffleExchangeExec").doc(
+    "Enable TPU shuffle exchange.").boolean(True)
+FILE_SCAN_ENABLED = _conf("spark.rapids.sql.exec.FileSourceScanExec").doc(
+    "Enable TPU file-source scans.").boolean(True)
+GENERATE_ENABLED = _conf("spark.rapids.sql.exec.GenerateExec").doc(
+    "Enable TPU generate (explode/posexplode/stack/json_tuple).").boolean(True)
+EXPAND_ENABLED = _conf("spark.rapids.sql.exec.ExpandExec").doc(
+    "Enable TPU expand (grouping sets).").boolean(True)
 FILTER_ENABLED = _conf("spark.rapids.sql.exec.FilterExec").doc(
     "Enable TPU filter.").boolean(True)
 

@@ -1075,6 +1075,8 @@ _NEXT_LAMBDA_ID = [0]
 class NamedLambdaVariable(Expression):
     """A lambda argument (reference NamedLambdaVariable). Identity by object."""
 
+    unevaluable = True  # bound by the enclosing higher-order function
+
     def __init__(self, name: str, dtype: DataType, nullable: bool = True):
         self.children = ()
         self.name = name
@@ -1124,6 +1126,8 @@ class _BoundLambdaVar(Expression):
 
 class LambdaFunction(Expression):
     """(x[, i]) -> body. children = (body,); arguments kept separately."""
+
+    unevaluable = True  # evaluated by the enclosing higher-order function
 
     def __init__(self, body: Expression, arguments: Sequence[NamedLambdaVariable]):
         self.children = (body,)

@@ -29,6 +29,7 @@ class Generator(Expression):
     drives it (reference GpuGenerator, GpuGenerateExec.scala)."""
 
     outer: bool = False
+    unevaluable = True  # driven by GenerateExec (reference GpuUnevaluable)
 
     def element_schema(self) -> List[Tuple[str, DataType, bool]]:
         """(name, dtype, nullable) for each generated column."""
@@ -157,6 +158,7 @@ class GroupingID(Expression):
     reference to the Expand gid column during grouping-sets lowering."""
 
     children = ()
+    unevaluable = True  # rewritten away before evaluation
 
     @property
     def dtype(self) -> DataType:

@@ -1,0 +1,95 @@
+"""Static API validation of the exec/expression registries.
+
+Reference: api_validation/ (ApiValidation.scala, 175 LoC) — compares each
+GpuExec's constructor signature against the corresponding Spark exec per
+version to catch shim drift. Here the analogue checks, per registered rule:
+
+  * every exec rule names a config key that exists in the config registry;
+  * every CPU exec class implements the physical-plan contract
+    (execute_partition, output);
+  * every registered expression either has a device kernel (eval_tpu
+    overridden) or is explicitly flagged host-assisted / CPU-fallback — an
+    unflagged expression without a kernel would be tagged onto the device
+    and crash at runtime;
+  * every expression with a type signature can answer a check() call.
+
+Run as a script (exits non-zero on violations) or through
+`validate() -> List[str]` from the test suite (SURVEY §4 tier 4).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def validate():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.config import REGISTRY
+    from spark_rapids_tpu.execs.base import CpuExec, PhysicalPlan
+    from spark_rapids_tpu.expressions.base import Expression
+    from spark_rapids_tpu.plan.overrides import exec_rules
+    from spark_rapids_tpu.plan.typechecks import all_expr_rules
+
+    violations = []
+
+    # exec rules ----------------------------------------------------------
+    for cls, rule in exec_rules().items():
+        if rule.conf_key and rule.conf_key not in REGISTRY.entries:
+            violations.append(
+                f"exec {cls.__name__}: conf key {rule.conf_key!r} is not a "
+                f"registered config entry")
+        if not issubclass(cls, CpuExec):
+            violations.append(
+                f"exec rule for {cls.__name__} is not keyed by a CpuExec "
+                f"subclass")
+        if cls.execute_partition is PhysicalPlan.execute_partition:
+            violations.append(
+                f"exec {cls.__name__} does not implement execute_partition")
+        if rule._convert is None:  # rule.convert is a bound wrapper — check
+            violations.append(     # the actual registered callable
+                f"exec {cls.__name__}: rule has no convert fn")
+
+    # expression rules ----------------------------------------------------
+    base_eval_tpu = Expression.eval_tpu
+    base_eval_cpu = Expression.eval_cpu
+    for cls, rule in all_expr_rules().items():
+        if getattr(cls, "unevaluable", False):
+            continue  # structural: driven by its exec (reference Unevaluable)
+        has_tpu = cls.eval_tpu is not base_eval_tpu
+        has_cpu = cls.eval_cpu is not base_eval_cpu
+        supported = getattr(cls, "tpu_supported", True)
+        if supported and not (has_tpu or rule.host_assisted):
+            violations.append(
+                f"expression {cls.__name__}: registered as device-supported "
+                f"but neither overrides eval_tpu nor is flagged "
+                f"host_assisted")
+        if not has_cpu and not has_tpu:
+            violations.append(
+                f"expression {cls.__name__}: no evaluation path at all")
+        if rule.type_sig is not None:
+            try:
+                rule.type_sig.check  # noqa: B018 — attribute must exist
+            except AttributeError:
+                violations.append(
+                    f"expression {cls.__name__}: type_sig lacks check()")
+
+    return violations
+
+
+def main() -> int:
+    violations = validate()
+    if violations:
+        print(f"{len(violations)} API validation failure(s):")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("API validation passed: "
+          "all exec/expression registry contracts hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

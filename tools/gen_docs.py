@@ -9,6 +9,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _md(s: str) -> str:
+    """Escape literal pipes so table cells stay aligned."""
+    return str(s).replace("|", "\\|")
+
+
 def gen_configs_md() -> str:
     from spark_rapids_tpu.config import REGISTRY
     return REGISTRY.help_markdown()
@@ -24,7 +29,7 @@ def gen_supported_ops_md() -> str:
              "| CPU operator | TPU replacement rule | Enable/disable config |",
              "|---|---|---|"]
     for cls, rule in sorted(exec_rules().items(), key=lambda kv: kv[0].__name__):
-        lines.append(f"| {cls.__name__} | {rule.desc} | {rule.conf_key} |")
+        lines.append(f"| {cls.__name__} | {_md(rule.desc)} | {rule.conf_key} |")
     lines += ["", "## Expressions", "",
               "| Expression | Description | Notes |", "|---|---|---|"]
     for cls, rule in sorted(all_expr_rules().items(),
@@ -34,7 +39,7 @@ def gen_supported_ops_md() -> str:
             notes.append(f"incompat: {rule.incompat}")
         if rule.host_assisted:
             notes.append("host-assisted")
-        lines.append(f"| {cls.__name__} | {rule.desc} | {'; '.join(notes)} |")
+        lines.append(f"| {cls.__name__} | {_md(rule.desc)} | {_md('; '.join(notes))} |")
     return "\n".join(lines) + "\n"
 
 
