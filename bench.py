@@ -30,14 +30,24 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from spark_rapids_tpu.kernels.q1 import (make_example_batch, q1_reference_numpy,
-                                             q1_step)
+    from spark_rapids_tpu.kernels.q1 import (make_example_batch, q1_final,
+                                             q1_reference_numpy)
+    from spark_rapids_tpu.kernels.q1 import q1_step as q1_step_xla
+    from spark_rapids_tpu.kernels.q1_pallas import q1_partial_pallas
 
     n = 1 << 24  # 16.7M rows (~470 MB of lineitem columns)
     batch, cutoff = make_example_batch(n)
     cutoff = jnp.int32(cutoff)
 
-    # device warm-up + compile
+    # kernel selection AT THE BENCHMARK SHAPE: fused single-pass pallas when
+    # the backend takes it, XLA einsum path otherwise — and report which ran
+    pallas_step = jax.jit(
+        lambda b, c: q1_final(q1_partial_pallas(b, c)))
+    try:
+        jax.block_until_ready(pallas_step(batch, cutoff))
+        q1_step, kernel = pallas_step, "pallas"
+    except Exception:  # noqa: BLE001 — backend rejected the pallas lowering
+        q1_step, kernel = q1_step_xla, "xla"
     out = q1_step(batch, cutoff)
     jax.block_until_ready(out)
 
@@ -63,6 +73,7 @@ def main() -> None:
         "vs_baseline": round(speedup / 3.8, 3),
         "detail": {
             "rows": n,
+            "kernel": kernel,
             "tpu_s": round(tpu_s, 6),
             "cpu_s": round(cpu_s, 6),
             "speedup_vs_cpu": round(speedup, 2),

@@ -158,7 +158,8 @@ class ConfRegistry:
             if e.internal and not include_internal:
                 continue
             when = "Startup" if e.startup_only else "Runtime"
-            lines.append(f"| {e.key} | {e.doc} | {e.default} | {when} |")
+            doc = str(e.doc).replace("|", "\\|")  # keep table cells aligned
+            lines.append(f"| {e.key} | {doc} | {e.default} | {when} |")
         return "\n".join(lines) + "\n"
 
 

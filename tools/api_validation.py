@@ -48,6 +48,9 @@ def validate():
         if cls.execute_partition is PhysicalPlan.execute_partition:
             violations.append(
                 f"exec {cls.__name__} does not implement execute_partition")
+        if cls.output is PhysicalPlan.output:
+            violations.append(
+                f"exec {cls.__name__} does not implement output")
         if rule._convert is None:  # rule.convert is a bound wrapper — check
             violations.append(     # the actual registered callable
                 f"exec {cls.__name__}: rule has no convert fn")
