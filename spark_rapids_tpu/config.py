@@ -297,6 +297,15 @@ AVRO_ENABLED = _conf("spark.rapids.sql.format.avro.enabled").doc(
     "Enable TPU Avro scans.").boolean(True)
 HIVE_TEXT_ENABLED = _conf("spark.rapids.sql.format.hive.text.enabled").doc(
     "Enable TPU Hive delimited-text scans/writes.").boolean(True)
+AQE_COALESCE_ENABLED = _conf(
+    "spark.sql.adaptive.coalescePartitions.enabled").doc(
+    "Coalesce small shuffle partitions after materialization using map "
+    "output sizes (reference GpuCustomShuffleReaderExec / AQE coalesced "
+    "partition specs).").boolean(False)
+AQE_ADVISORY_PARTITION_BYTES = _conf(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes").doc(
+    "Target combined size of a coalesced shuffle-read partition."
+).bytes(64 * (1 << 20))
 FILECACHE_ENABLED = _conf("spark.rapids.filecache.enabled").doc(
     "Cache remote scan inputs (s3/gs/hdfs/...) on local disk (reference: "
     "the spark-rapids-private FileCache; SURVEY.md §1 notes the TPU build "

@@ -246,10 +246,29 @@ def _tag_exchange(meta: PlanMeta) -> None:
 
 
 def _convert_exchange(meta: PlanMeta, ch):
-    from ..shuffle.exchange import TpuShuffleExchangeExec
+    from ..config import (AQE_COALESCE_ENABLED,
+                          AQE_ADVISORY_PARTITION_BYTES)
+    from ..shuffle.exchange import (TpuShuffleExchangeExec,
+                                    TpuShuffleReaderExec)
     p = meta.plan
-    return TpuShuffleExchangeExec(ch[0], p.partitioning, p.keys,
+    exch = TpuShuffleExchangeExec(ch[0], p.partitioning, p.keys,
                                   p.num_partitions())
+    # AQE partition coalescing (reference GpuCustomShuffleReaderExec).
+    # NOT applied when the exchange feeds a co-partitioned join: each side
+    # would coalesce on its own sizes and partition i of the left would no
+    # longer hold the same key hashes as partition i of the right (Spark's
+    # AQE coordinates both sides through the query stage; we keep the safe
+    # subset — aggregates and other single-input consumers).
+    parent_plan = meta.parent.plan if meta.parent is not None else None
+    feeds_join = parent_plan is not None and \
+        "Join" in type(parent_plan).__name__
+    if meta.conf.get(AQE_COALESCE_ENABLED) and p.partitioning == "hash" \
+            and not feeds_join:
+        reader = TpuShuffleReaderExec(
+            exch, meta.conf.get(AQE_ADVISORY_PARTITION_BYTES))
+        reader._conf = meta.conf
+        return reader
+    return exch
 
 
 from ..shuffle.exchange import CpuShuffleExchangeExec as _CpuExch  # noqa: E402

@@ -437,6 +437,83 @@ class DataFrame:
     def toPandas(self):
         return self.to_arrow().to_pandas()
 
+    def to_device_batches(self) -> List:
+        """ML interop (reference ColumnarRdd, README.md:47-56: zero-copy
+        handoff of the internal Table RDD to XGBoost etc.): execute the plan
+        and hand back the device-resident TpuColumnarBatch per partition —
+        columns are jax Arrays usable directly in a jax ML pipeline, no
+        host round trip for device-resident stages."""
+        from .execs.base import TaskContext
+        from .execs.transitions import DeviceToHostExec
+        from .plan.overrides import TpuOverrides
+        from .plan.planner import plan_physical
+        from .columnar.batch import TpuColumnarBatch
+        conf = self.session._rapids_conf()
+        final = TpuOverrides.apply(plan_physical(self._plan, conf), conf)
+        # strip the final device→host transition: the caller wants device data
+        while isinstance(final, DeviceToHostExec):
+            final = final.children[0]
+        out: List = []
+        try:
+            for p in range(final.num_partitions()):
+                ctx = TaskContext(p, conf)
+                try:
+                    for b in final.execute_partition(p, ctx):
+                        if isinstance(b, TpuColumnarBatch):
+                            out.append(b)
+                        else:  # CPU-resident plan: upload (reference
+                            # InternalColumnarRddConverter host→device path)
+                            out.append(TpuColumnarBatch.from_arrow(b))
+                finally:
+                    ctx.complete()
+        finally:
+            # same end-of-query shuffle release as _execute; the returned
+            # batches keep their arrays alive independently of the catalog
+            for node in final.collect_nodes():
+                if hasattr(node, "cleanup_shuffle"):
+                    node.cleanup_shuffle(conf)
+        return out
+
+    def to_device_arrays(self) -> dict:
+        """Column-name → jax Array of the whole result (single concatenated
+        batch) — the convenient form for feeding jax/flax training steps.
+        Nullable columns come back zero-filled at null positions with a
+        companion boolean mask under ``<name>__valid`` (a raw device buffer
+        cannot express SQL nulls; training on unmasked lanes would be
+        silent garbage)."""
+        import jax.numpy as jnp
+        from .columnar.batch import concat_batches
+        batches = self.to_device_batches()
+        if not batches:
+            out = {}
+            for a in self._plan.output:
+                npdt = getattr(a.dtype, "np_dtype", None)
+                if npdt is not None:
+                    out[a.name] = jnp.zeros((0,), npdt)
+                else:
+                    import pyarrow as pa
+                    from .types import to_arrow as t2a
+                    out[a.name] = pa.array([], type=t2a(a.dtype))
+            return out
+        whole = batches[0] if len(batches) == 1 else concat_batches(batches)
+        names = [a.name for a in self._plan.output]
+        out = {}
+        for name, col in zip(names, whole.columns):
+            data = col.data
+            if data is not None and col.offsets is None \
+                    and col.host_data is None:
+                n = whole.num_rows
+                if col.validity is not None:
+                    v = col.validity[:n]
+                    out[name] = jnp.where(v, data[:n],
+                                          jnp.zeros((), data.dtype))
+                    out[f"{name}__valid"] = v
+                else:
+                    out[name] = data[:n]
+            else:  # strings/nested stay host-side
+                out[name] = col.to_arrow()
+        return out
+
     def count(self) -> int:
         return self.to_arrow().num_rows
 

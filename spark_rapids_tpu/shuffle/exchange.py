@@ -70,6 +70,39 @@ class _ExchangeBase:
         tables = self._partition_map_task(map_id, map_ctx)
         return lambda: mgr.write_map_output(sid, map_id, tables)
 
+    def partition_sizes(self, ctx: TaskContext) -> List[int]:
+        """Post-materialization byte size per reduce partition (the map
+        output statistics AQE plans against)."""
+        import os
+        self._ensure_materialized(ctx)
+        sizes = [0] * self._n_out
+        if self._shuffle_mode(ctx) == "ICI":
+            from .ici import FetchFailedError, IciShuffleCatalog
+            catalog = IciShuffleCatalog.get()
+            mgr2 = TpuShuffleManager.get(ctx.conf)
+            for r in range(self._n_out):
+                try:
+                    blocks = list(catalog.iter_blocks(self._shuffle_id, r,
+                                                      self._n_maps))
+                except FetchFailedError as ff:
+                    # same recovery as the read path: re-run lost maps
+                    with self._mat_lock:
+                        for map_id in ff.map_ids:
+                            self._materialize_map(self._shuffle_id, map_id,
+                                                  ctx, mgr2)
+                    blocks = list(catalog.iter_blocks(self._shuffle_id, r,
+                                                      self._n_maps))
+                for b in blocks:
+                    sizes[r] += b.device_memory_size()
+            return sizes
+        mgr = TpuShuffleManager.get(ctx.conf)
+        for r in range(self._n_out):
+            for m in range(self._n_maps):
+                p = mgr._path(self._shuffle_id, m, r)
+                if os.path.exists(p):
+                    sizes[r] += os.path.getsize(p)
+        return sizes
+
     def cleanup_shuffle(self, conf) -> None:
         """Release this exchange's shuffle blocks/files and allow
         re-materialization (called at query end by the session)."""
@@ -243,6 +276,62 @@ class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
         for t in tables:
             if t.num_rows:
                 yield t.rename_columns(names)
+
+
+class TpuShuffleReaderExec(TpuExec):
+    """AQE shuffle reader (reference GpuCustomShuffleReaderExec,
+    execution/GpuCustomShuffleReaderExec.scala:37): reads the materialized
+    exchange with a coalesced partition spec — small reduce partitions are
+    grouped up to the advisory size, so downstream tasks see fewer,
+    better-filled partitions. (Skew splitting is handled at the join level
+    by sub-partitioning, execs/joins.py, where key co-location is not
+    required to survive.)"""
+
+    def __init__(self, child, advisory_bytes: int):
+        super().__init__([child])
+        self.advisory_bytes = advisory_bytes
+        self._specs: Optional[List[List[int]]] = None
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        n = len(self._specs) if self._specs is not None else "?"
+        return f"TpuShuffleReader[coalesced, n={n}]"
+
+    def _ensure_specs(self, ctx: TaskContext) -> List[List[int]]:
+        if self._specs is None:
+            sizes = self.children[0].partition_sizes(ctx)
+            specs: List[List[int]] = []
+            cur: List[int] = []
+            cur_bytes = 0
+            for r, sz in enumerate(sizes):
+                if cur and cur_bytes + sz > self.advisory_bytes:
+                    specs.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(r)
+                cur_bytes += sz
+            if cur:
+                specs.append(cur)
+            self._specs = specs or [[0]]
+        return self._specs
+
+    def num_partitions(self) -> int:
+        from ..execs.base import TaskContext
+        from ..config import default_conf
+        # sizes require materialization; use the session conf snapshot the
+        # planner stored on the exchange path
+        ctx = TaskContext(0, getattr(self, "_conf", None) or default_conf())
+        try:
+            return len(self._ensure_specs(ctx))
+        finally:
+            ctx.complete()
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        specs = self._ensure_specs(ctx)
+        for reduce_id in specs[idx]:
+            yield from self.children[0].execute_partition(reduce_id, ctx)
 
 
 def plan_cpu_exchange(plan, conf):

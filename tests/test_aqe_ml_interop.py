@@ -1,0 +1,128 @@
+"""AQE coalesced shuffle reader + ML interop (reference
+GpuCustomShuffleReaderExec and ColumnarRdd)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, LongGen, gen_df
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.session import TpuSession
+
+AQE = {"spark.sql.adaptive.coalescePartitions.enabled": "true"}
+
+
+def _df(s, n=4000, seed=2):
+    return s.createDataFrame(gen_df(
+        [("a", IntegerGen()), ("b", LongGen()), ("d", DoubleGen())], n, seed))
+
+
+def test_coalesced_reader_in_plan_and_correct():
+    s = TpuSession(dict(AQE))
+    df = _df(s).repartition(16, "a").groupBy("a").agg(
+        F.sum(F.col("b")).alias("sb"))
+    plan = df.explain()
+    assert "TpuShuffleReader" in plan
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    want = sorted((tuple(r.values()) for r in
+                   _df(cpu).groupBy("a").agg(
+                       F.sum(F.col("b")).alias("sb")).collect()), key=str)
+    got = sorted((tuple(r.values()) for r in df.collect()), key=str)
+    assert got == want
+
+
+def test_coalesced_reader_reduces_partitions():
+    s = TpuSession(dict(AQE))
+    df = _df(s, n=500).repartition(32, "a")
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    conf = s._rapids_conf()
+    final = TpuOverrides.apply(plan_physical(df._plan, conf), conf)
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleReaderExec
+    readers = [n for n in final.collect_nodes()
+               if isinstance(n, TpuShuffleReaderExec)]
+    assert readers
+    # 500 tiny rows over 32 partitions fit far under the 64 MiB advisory
+    assert readers[0].num_partitions() < 32
+
+
+def test_aqe_equality_with_joins():
+    def q(s):
+        left = _df(s, n=2000, seed=5).repartition(12, "a")
+        right = _df(s, n=1500, seed=6).select(
+            F.col("a").alias("ra"), F.col("d").alias("rd"))
+        return left.join(right, left["a"] == right["ra"], "inner")
+    assert_tpu_and_cpu_are_equal_collect(q, conf=AQE, ignore_order=True)
+
+
+def test_aqe_off_by_default():
+    s = TpuSession({})
+    df = _df(s).repartition(8, "a").groupBy("a").agg(
+        F.count(F.col("b")).alias("c"))
+    assert "TpuShuffleReader" not in df.explain()
+
+
+# ---------------------------------------------------------------------------
+# ML interop
+
+
+def test_to_device_batches_returns_jax_arrays():
+    import jax
+    s = TpuSession({})
+    df = _df(s, n=300).select(F.col("a"), (F.col("d") * 2).alias("d2"))
+    batches = df.to_device_batches()
+    assert batches
+    col = batches[0].columns[0]
+    assert isinstance(col.data, jax.Array)
+    total = sum(b.num_rows for b in batches)
+    assert total == 300
+
+
+def test_to_device_arrays_feed_jax():
+    """The ColumnarRdd use case: result columns feed a jax computation with
+    no host round trip."""
+    import jax.numpy as jnp
+    s = TpuSession({})
+    t = pa.table({"x": pa.array([float(i) for i in range(1000)]),
+                  "y": pa.array([2.0 * i + 1 for i in range(1000)])})
+    arrays = s.createDataFrame(t).filter(F.col("x") < 500.0) \
+        .to_device_arrays()
+    x, y = arrays["x"], arrays["y"]
+    assert x.shape[0] == 500
+    # least-squares slope on device
+    slope = float(jnp.sum(x * y) / jnp.maximum(jnp.sum(x * x), 1e-9))
+    assert abs(slope - 2.0) < 0.1
+
+
+def test_to_device_arrays_values_match_collect():
+    s = TpuSession({})
+    df = _df(s, n=400).select(F.col("b"))
+    arrays = df.to_device_arrays()
+    got = np.asarray(arrays["b"])
+    valid = np.asarray(arrays["b__valid"])
+    rows = df.collect()
+    want_mask = np.array([r["b"] is not None for r in rows])
+    np.testing.assert_array_equal(valid, want_mask)
+    want = np.array([r["b"] if r["b"] is not None else 0 for r in rows])
+    np.testing.assert_array_equal(got, want)  # nulls zero-filled
+
+
+def test_aqe_join_sides_not_coalesced():
+    """Co-partitioned join inputs must keep aligned partitioning — the
+    reader wraps only single-input consumers (regression: desynced specs)."""
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleReaderExec
+    s = TpuSession(dict(AQE))
+    left = _df(s, n=800, seed=7).repartition(8, "a")
+    right = _df(s, n=700, seed=8).select(F.col("a").alias("ra"))
+    df = left.join(right, left["a"] == right["ra"], "inner")
+    conf = s._rapids_conf()
+    final = TpuOverrides.apply(plan_physical(df._plan, conf), conf)
+    joins = [n for n in final.collect_nodes()
+             if "Join" in type(n).__name__]
+    for j in joins:
+        for child in j.children:
+            assert not isinstance(child, TpuShuffleReaderExec)
