@@ -52,7 +52,10 @@ class TpuColumnarBatch:
         import pyarrow as pa
         names = self.names or [f"c{i}" for i in range(self.num_columns)]
         arrays = [c.to_arrow() for c in self.columns]
-        return pa.table(dict(zip(names, arrays))) if arrays else pa.table({})
+        # from_arrays, not pa.table(dict(...)): names may repeat (e.g. join
+        # output carrying the same key name from both sides)
+        return (pa.Table.from_arrays(arrays, names=list(names))
+                if arrays else pa.table({}))
 
     def to_pylist(self) -> List[dict]:
         return self.to_arrow().to_pylist()

@@ -352,12 +352,7 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
                                                 type=pa.list_(col.type))
                 continue
             f = scalar_fns[op]
-            if op in ("stddev", "variance"):
-                v = f(col, ddof=o.ddof)
-            elif op in ("first", "last"):
-                v = f(col, skip_nulls=o.skip_nulls)
-            else:
-                v = f(col)
+            v = f(col, options=o) if o is not None else f(col)
             results[f"{n}_{op}"] = pa.array(
                 [v.as_py()], type=v.type if v.type != pa.null() else pa.int64())
         get = lambda n, op: results[f"{n}_{op}"]
@@ -966,6 +961,73 @@ def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
     raise NotImplementedError(op)
 
 
+def _global_mergeable(fn) -> bool:
+    """Whether the ungrouped chunked-merge path can combine this aggregate's
+    partial states (order-sensitive and collection aggs are excluded; they keep
+    the concat path)."""
+    op = fn.update_op
+    if op in ("count", "sum", "avg", "stddev_samp", "stddev_pop", "var_samp",
+              "var_pop", "covar_samp", "covar_pop", "corr"):
+        return True
+    if op in ("min", "max", "first", "last"):
+        from ..types import is_fixed_width
+        child = fn.children[0] if fn.children else None
+        return child is None or is_fixed_width(child.dtype)
+    return False
+
+
+def _merge_global_states(fn, states: List[Dict]) -> Dict:
+    """Merge per-chunk one-group partial states into a single state dict (the
+    reference's merge aggregation expressions, aggregateFunctions.scala)."""
+    if len(states) == 1:
+        return states[0]
+    op = fn.update_op
+    stk = {k: jnp.stack([s[k] for s in states]) for k in states[0]}
+    if op == "count":
+        return {"count": stk["count"].sum(0)}
+    if op == "sum":
+        return {"sum": stk["sum"].sum(0), "nonnull": stk["nonnull"].sum(0)}
+    if op == "avg":
+        return {"sum": stk["sum"].sum(0), "count": stk["count"].sum(0)}
+    if op in ("stddev_samp", "stddev_pop", "var_samp", "var_pop") \
+            or op in ("covar_samp", "covar_pop", "corr"):
+        return {k: v.sum(0) for k, v in stk.items()}
+    if op in ("min", "max"):
+        red, nn = stk[op], stk["nonnull"]
+        nonnull = nn.sum(0)
+        has = nn > 0
+        if jnp.issubdtype(red.dtype, jnp.floating):
+            # chunk red is NaN iff (min) the chunk was all-NaN / (max) any NaN
+            isnan = jnp.isnan(red)
+            if op == "max":
+                neutral = jnp.asarray(-np.inf, red.dtype)
+                m = jnp.where(has & ~isnan, red, neutral).max(0)
+                m = jnp.where((has & isnan).any(0),
+                              jnp.asarray(np.nan, red.dtype), m)
+            else:
+                neutral = jnp.asarray(np.inf, red.dtype)
+                m = jnp.where(has & ~isnan, red, neutral).min(0)
+                m = jnp.where(~(has & ~isnan).any(0) & (nonnull > 0),
+                              jnp.asarray(np.nan, red.dtype), m)
+            return {op: m, "nonnull": nonnull}
+        info = np.iinfo(np.asarray(jnp.zeros((), red.dtype)).dtype)
+        neutral = jnp.asarray(info.max if op == "min" else info.min, red.dtype)
+        clean = jnp.where(has, red, neutral)
+        m = clean.min(0) if op == "min" else clean.max(0)
+        return {op: m, "nonnull": nonnull}
+    if op in ("first", "last"):
+        has, vals, vvalid = stk["has"], stk[op], stk[f"{op}_valid"]
+        nch = has.shape[0]
+        idxs = jnp.arange(nch)[:, None]
+        sel = jnp.where(has, idxs, nch).min(0) if op == "first" \
+            else jnp.where(has, idxs, -1).max(0)
+        sel_c = jnp.clip(sel, 0, nch - 1)[None, :]
+        return {op: jnp.take_along_axis(vals, sel_c, 0)[0],
+                "has": has.any(0),
+                f"{op}_valid": jnp.take_along_axis(vvalid, sel_c, 0)[0]}
+    raise NotImplementedError(f"merge of {op}")
+
+
 class TpuHashAggregateExec(TpuExec):
     """Sort-based grouped aggregation on device (complete mode)."""
 
@@ -1018,6 +1080,15 @@ class TpuHashAggregateExec(TpuExec):
             yield from self._sort_fallback(batches, agg_fns, result_exprs,
                                            ctx, max_rows)
             return
+        if not self.grouping and total > max_rows and len(batches) > 1 \
+                and all(_global_mergeable(fn) for fn in agg_fns):
+            # ungrouped overflow: per-chunk partial states merged into one
+            # final state (the reference's update→merge decomposition,
+            # GpuAggregateExec.scala GpuMergeAggregateIterator) — never
+            # concatenates the whole input on device
+            yield self._global_chunked(batches, agg_fns, result_exprs, ctx,
+                                       max_rows)
+            return
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
         from ..memory.retry import with_retry_no_split
         from ..memory.spill import SpillableColumnarBatch
@@ -1040,23 +1111,63 @@ class TpuHashAggregateExec(TpuExec):
         finally:
             ooc.close()
 
+    def _eval_agg_input(self, fn, batch: TpuColumnarBatch, ctx: TaskContext):
+        if len(fn.children) >= 2:
+            return tuple(
+                to_column(c.eval_tpu(batch, ctx.eval_ctx), batch, c.dtype)
+                for c in fn.children)
+        if fn.children:
+            return to_column(fn.children[0].eval_tpu(batch, ctx.eval_ctx),
+                             batch, fn.children[0].dtype)
+        return None
+
+    def _global_chunked(self, batches, agg_fns, result_exprs, ctx,
+                        max_rows: int) -> TpuColumnarBatch:
+        """Ungrouped aggregate over the row budget: chunk the input, compute a
+        one-group partial state per chunk, merge states, finalize once."""
+        chunks: List[List[TpuColumnarBatch]] = []
+        cur: List[TpuColumnarBatch] = []
+        cur_rows = 0
+        for b in batches:
+            if cur and cur_rows + b.num_rows > max_rows:
+                chunks.append(cur)
+                cur, cur_rows = [], 0
+            cur.append(b)
+            cur_rows += b.num_rows
+        if cur:
+            chunks.append(cur)
+        g_cap = bucket_capacity(1)
+        per_fn: List[List[Dict]] = [[] for _ in agg_fns]
+        with self.metrics["reduceTime"].timed():
+            for group in chunks:
+                chunk = concat_batches(group) if len(group) > 1 else group[0]
+                cap, n = chunk.capacity, chunk.num_rows
+                perm = jnp.arange(cap, dtype=jnp.int32)
+                seg_ids = jnp.zeros((cap,), jnp.int32)
+                for i, fn in enumerate(agg_fns):
+                    col = self._eval_agg_input(fn, chunk, ctx)
+                    per_fn[i].append(
+                        _segment_update(fn, col, seg_ids, g_cap, cap, n, perm))
+            states = [_merge_global_states(fn, sts)
+                      for fn, sts in zip(agg_fns, per_fn)]
+            agg_cols = [_evaluate_agg(fn, st, 1, g_cap)
+                        for fn, st in zip(agg_fns, states)]
+        agg_batch = TpuColumnarBatch(agg_cols, 1)
+        final_cols = []
+        for expr, attr in zip(result_exprs, self._output):
+            bound = _bind_agg_refs(expr, None, 0)
+            final_cols.append(to_column(bound.eval_tpu(agg_batch, ctx.eval_ctx),
+                                        agg_batch, attr.dtype))
+        return TpuColumnarBatch(final_cols, 1, [a.name for a in self._output])
+
     def _aggregate_batch(self, batch: TpuColumnarBatch, agg_fns, result_exprs,
                          ctx: TaskContext) -> TpuColumnarBatch:
         cap = batch.capacity
         n = batch.num_rows
         key_cols = [to_column(g.eval_tpu(batch, ctx.eval_ctx), batch, g.dtype)
                     for g in self.grouping]
-        in_cols: List[Optional[TpuColumnVector]] = []
-        for fn in agg_fns:
-            if len(fn.children) >= 2:
-                in_cols.append(tuple(
-                    to_column(c.eval_tpu(batch, ctx.eval_ctx), batch, c.dtype)
-                    for c in fn.children))
-            elif fn.children:
-                in_cols.append(to_column(fn.children[0].eval_tpu(batch, ctx.eval_ctx),
-                                         batch, fn.children[0].dtype))
-            else:
-                in_cols.append(None)
+        in_cols: List[Optional[TpuColumnVector]] = [
+            self._eval_agg_input(fn, batch, ctx) for fn in agg_fns]
         if self.grouping:
             with self.metrics["sortTime"].timed():
                 enc = encode_group_keys(key_cols, n, cap)

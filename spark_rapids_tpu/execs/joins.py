@@ -199,8 +199,11 @@ class TpuShuffledHashJoinExec(TpuExec):
             from ..shuffle.partitioner import (hash_partition_ids,
                                                split_by_partition)
             k = max(2, -(-max(left.num_rows, right.num_rows) // max_rows))
-            l_ids = hash_partition_ids(left, self.left_keys, k, ctx)
-            r_ids = hash_partition_ids(right, self.right_keys, k, ctx)
+            # seed 100 (not the exchange's 42): upstream co-partitioning fixes
+            # h42 % N, so re-bucketing with the same seed would collapse into
+            # few sub-partitions (GpuSubPartitionHashJoin.scala hashSeed=100)
+            l_ids = hash_partition_ids(left, self.left_keys, k, ctx, seed=100)
+            r_ids = hash_partition_ids(right, self.right_keys, k, ctx, seed=100)
             l_parts = split_by_partition(left, l_ids, k)
             r_parts = split_by_partition(right, r_ids, k)
             with self.metrics["joinTime"].timed():
@@ -336,7 +339,31 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             return concat_batches(batches) if batches else None
 
         left, right = side(self.children[0]), side(self.children[1])
-        if left is None or right is None or not left.num_rows or not right.num_rows:
+        jt = self.join_type
+        names = [a.name for a in self._output]
+        l_empty = left is None or not left.num_rows
+        r_empty = right is None or not right.num_rows
+        if l_empty or r_empty:
+            # empty-side semantics (reference GpuBroadcastNestedLoopJoinExec
+            # computeBuildRowCount special cases)
+            if not l_empty:
+                if jt in ("leftsemi", "semi"):
+                    return
+                if jt in ("leftanti", "anti"):
+                    yield left.rename(names)
+                    return
+                if jt in ("leftouter", "left", "fullouter", "outer", "full"):
+                    nulls_r = _all_null_cols(self.children[1].output,
+                                             left.num_rows, left.capacity)
+                    yield TpuColumnarBatch(left.columns + nulls_r,
+                                           left.num_rows, names)
+                    return
+            if not r_empty and jt in ("rightouter", "right", "fullouter",
+                                      "outer", "full"):
+                nulls_l = _all_null_cols(self.children[0].output,
+                                         right.num_rows, right.capacity)
+                yield TpuColumnarBatch(nulls_l + right.columns,
+                                       right.num_rows, names)
             return
         n_l, n_r = left.num_rows, right.num_rows
         total = n_l * n_r
@@ -347,13 +374,48 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         lg = gather(left, li, total, out_cap)
         rg = gather(right, ri, total, out_cap)
         joined = TpuColumnarBatch(lg.columns + rg.columns, total)
+        keep = j < total
         if self.condition is not None:
             cond = to_column(self.condition.eval_tpu(joined, ctx.eval_ctx), joined)
-            keep = cond.data.astype(jnp.bool_)
+            keep = keep & cond.data.astype(jnp.bool_)
             if cond.validity is not None:
                 keep = keep & cond.validity
-            joined = compact(joined, keep)
-        yield joined.rename([a.name for a in self._output])
+        if jt in ("inner", "cross"):
+            yield compact(joined, keep).rename(names)
+            return
+        # per-side match flags (scatter-max over pair keep mask; padding pairs
+        # route to the dropped slot n)
+        safe_li = jnp.where(j < total, li, n_l)
+        safe_ri = jnp.where(j < total, ri, n_r)
+        l_matched = jnp.zeros((n_l,), jnp.bool_).at[safe_li].max(keep, mode="drop")
+        r_matched = jnp.zeros((n_r,), jnp.bool_).at[safe_ri].max(keep, mode="drop")
+        l_pad = jnp.zeros((left.capacity,), jnp.bool_).at[
+            jnp.arange(n_l)].set(l_matched)
+        r_pad = jnp.zeros((right.capacity,), jnp.bool_).at[
+            jnp.arange(n_r)].set(r_matched)
+        if jt in ("leftsemi", "semi"):
+            yield compact(left, l_pad).rename(names)
+            return
+        if jt in ("leftanti", "anti"):
+            mask = (~l_pad) & row_mask(left.num_rows, left.capacity)
+            yield compact(left, mask).rename(names)
+            return
+        parts = [compact(joined, keep)]
+        if jt in ("leftouter", "left", "fullouter", "outer", "full"):
+            lo_mask = (~l_pad) & row_mask(left.num_rows, left.capacity)
+            lo = compact(left, lo_mask)
+            if lo.num_rows:
+                nulls_r = _all_null_cols(self.children[1].output,
+                                         lo.num_rows, lo.capacity)
+                parts.append(TpuColumnarBatch(lo.columns + nulls_r, lo.num_rows))
+        if jt in ("rightouter", "right", "fullouter", "outer", "full"):
+            ro_mask = (~r_pad) & row_mask(right.num_rows, right.capacity)
+            ro = compact(right, ro_mask)
+            if ro.num_rows:
+                nulls_l = _all_null_cols(self.children[0].output,
+                                         ro.num_rows, ro.capacity)
+                parts.append(TpuColumnarBatch(nulls_l + ro.columns, ro.num_rows))
+        yield concat_batches(parts).rename(names)
 
 
 # ---------------------------------------------------------------------------
@@ -536,7 +598,28 @@ class CpuBroadcastNestedLoopJoinExec(CpuExec):
 
         lt, rt = side(self.children[0], "l"), side(self.children[1], "r")
         n_l, n_r = lt.num_rows, rt.num_rows
+        jt = self.join_type
+        names = [a.name for a in self._output]
+
+        def with_nulls(keep_t, null_src, left_side: bool):
+            kept = [keep_t.column(i) for i in range(keep_t.num_columns)]
+            nulls = [pa.nulls(keep_t.num_rows, null_src.column(i).type)
+                     for i in range(null_src.num_columns)]
+            cols = kept + nulls if left_side else nulls + kept
+            # from_arrays, not pa.table(dict(...)): output names may repeat
+            return pa.Table.from_arrays(
+                [c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+                 for c in cols], names=names)
+
         if n_l == 0 or n_r == 0:
+            if n_l:
+                if jt in ("leftanti", "anti"):
+                    yield lt.rename_columns(names)
+                elif jt in ("leftouter", "left", "fullouter", "outer", "full"):
+                    yield with_nulls(lt, rt, True)
+            elif n_r and jt in ("rightouter", "right", "fullouter", "outer",
+                                "full"):
+                yield with_nulls(rt, lt, False)
             return
         li = np.repeat(np.arange(n_l), n_r)
         ri = np.tile(np.arange(n_r), n_l)
@@ -545,8 +628,34 @@ class CpuBroadcastNestedLoopJoinExec(CpuExec):
             joined = joined.append_column(name, rt.column(i).take(pa.array(ri)))
         if self.condition is not None:
             mask = self.condition.eval_cpu(joined, ctx.eval_ctx)
-            joined = joined.filter(pc.fill_null(mask, False))
-        yield joined.rename_columns([a.name for a in self._output])
+            mask_np = np.asarray(pc.fill_null(
+                pa.array(mask) if not isinstance(mask, (pa.Array, pa.ChunkedArray))
+                else mask, False))
+        else:
+            mask_np = np.ones(n_l * n_r, bool)
+        if jt in ("inner", "cross"):
+            yield joined.filter(pa.array(mask_np)).rename_columns(names)
+            return
+        l_matched = np.zeros(n_l, bool)
+        l_matched[li[mask_np]] = True
+        r_matched = np.zeros(n_r, bool)
+        r_matched[ri[mask_np]] = True
+        if jt in ("leftsemi", "semi"):
+            yield lt.filter(pa.array(l_matched)).rename_columns(names)
+            return
+        if jt in ("leftanti", "anti"):
+            yield lt.filter(pa.array(~l_matched)).rename_columns(names)
+            return
+        parts = [joined.filter(pa.array(mask_np)).rename_columns(names)]
+        if jt in ("leftouter", "left", "fullouter", "outer", "full"):
+            lo = lt.filter(pa.array(~l_matched))
+            if lo.num_rows:
+                parts.append(with_nulls(lo, rt, True))
+        if jt in ("rightouter", "right", "fullouter", "outer", "full"):
+            ro = rt.filter(pa.array(~r_matched))
+            if ro.num_rows:
+                parts.append(with_nulls(ro, lt, False))
+        yield pa.concat_tables(parts)
 
 
 

@@ -360,8 +360,28 @@ def read_iceberg(session, path: str, snapshot_id: Optional[int] = None,
             _, cols, t = parsed_deletes[i]
             by_cols.setdefault(cols, []).append(t)
         for cols, tables in by_cols.items():
-            del_df = session.createDataFrame(pa.concat_tables(tables))
-            part = part.join(del_df, on=list(cols), how="left_anti")
+            del_t = pa.concat_tables(tables)
+            # Iceberg spec: a null in an equality delete row matches null in
+            # the data row — SQL equality never does. Split: null-free delete
+            # rows use the linear hash anti-join; the (typically few)
+            # null-bearing rows use a null-safe (<=>) nested-loop anti-join.
+            import pyarrow.compute as pc
+            null_mask = None
+            for c in cols:
+                isn = pc.is_null(del_t.column(c))
+                null_mask = isn if null_mask is None else pc.or_(null_mask, isn)
+            null_rows = del_t.filter(null_mask)
+            clean_rows = del_t.filter(pc.invert(null_mask))
+            if clean_rows.num_rows:
+                part = part.join(session.createDataFrame(clean_rows),
+                                 on=list(cols), how="left_anti")
+            if null_rows.num_rows:
+                del_df = session.createDataFrame(null_rows)
+                cond = None
+                for c in cols:
+                    eq = part[c].eqNullSafe(del_df[c])
+                    cond = eq if cond is None else (cond & eq)
+                part = part.join(del_df, on=cond, how="left_anti")
         df = part if df is None else df.union(part)
     return df
 
@@ -491,6 +511,7 @@ def write_iceberg(arrow_table, path: str, mode: str = "append") -> None:
     write_avro(manifest_rows, mpath, codec="deflate")
 
     prev_manifests: List[str] = []
+    prev_seqs: List[int] = []
     if mode == "append" and existing_meta is not None:
         prev_snap = None
         cur = existing_meta.get("current-snapshot-id")
@@ -502,6 +523,13 @@ def write_iceberg(arrow_table, path: str, mode: str = "append") -> None:
             prev_list = read_avro(
                 existing._resolve(prev_snap["manifest-list"]))
             prev_manifests = prev_list.column("manifest_path").to_pylist()
+            # v2 spec: each carried-forward manifest keeps its ORIGINAL
+            # sequence number (delete scoping for external readers); only the
+            # new manifest gets this snapshot's seq
+            if "sequence_number" in prev_list.column_names:
+                prev_seqs = prev_list.column("sequence_number").to_pylist()
+            prev_seqs = [s if s is not None else 0 for s in prev_seqs]
+            prev_seqs += [0] * (len(prev_manifests) - len(prev_seqs))
 
     mlist_rows = pa.table({
         "manifest_path": pa.array(prev_manifests + [mpath]),
@@ -510,8 +538,7 @@ def write_iceberg(arrow_table, path: str, mode: str = "append") -> None:
             + [os.path.getsize(mpath)], type=pa.int64()),
         "partition_spec_id": pa.array([0] * (len(prev_manifests) + 1),
                                       type=pa.int32()),
-        "sequence_number": pa.array([seq] * (len(prev_manifests) + 1),
-                                    type=pa.int64()),
+        "sequence_number": pa.array(prev_seqs + [seq], type=pa.int64()),
     })
     mlist_path = os.path.join(meta_dir,
                               f"snap-{snap_id}-{_uuid.uuid4().hex}.avro")

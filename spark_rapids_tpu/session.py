@@ -94,6 +94,10 @@ class Column:
         from .expressions.predicates import GreaterThanOrEqual
         return Column(GreaterThanOrEqual(self._expr, _expr(other)))
 
+    def eqNullSafe(self, other):
+        from .expressions.predicates import EqualNullSafe
+        return Column(EqualNullSafe(self._expr, _expr(other)))
+
     # boolean
     def __and__(self, other):
         from .expressions.predicates import And

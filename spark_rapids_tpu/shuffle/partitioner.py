@@ -21,11 +21,13 @@ from ..expressions.hashexprs import murmur3_batch
 
 
 def hash_partition_ids(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
-                       n: int, ctx) -> jnp.ndarray:
-    """Spark HashPartitioning: pmod(murmur3(keys, seed=42), n)."""
+                       n: int, ctx, seed: int = 42) -> jnp.ndarray:
+    """Spark HashPartitioning: pmod(murmur3(keys, seed=42), n). Sub-partition
+    callers pass a distinct seed so their buckets are independent of the
+    upstream exchange's (reference GpuSubPartitionHashJoin.scala hashSeed=100)."""
     cols = [to_column(k.eval_tpu(batch, ctx.eval_ctx), batch, k.dtype)
             for k in key_exprs]
-    h = murmur3_batch(cols, batch.num_rows, batch.capacity, 42)
+    h = murmur3_batch(cols, batch.num_rows, batch.capacity, seed)
     pid = h % n
     return jnp.where(pid < 0, pid + n, pid).astype(jnp.int32)
 

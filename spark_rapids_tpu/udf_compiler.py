@@ -184,11 +184,17 @@ def _py_cmp(cls, nan_result: bool = False, null_result: Optional[bool] = None):
                 else Or(nan_checks[0], nan_checks[1])
             e = If(any_nan, Literal(nan_result), e)
         if null_result is not None:
+            from .expressions.predicates import And
             null_checks = [IsNull(x) for x in (a, b) if x.nullable]
             if null_checks:
                 any_null = null_checks[0] if len(null_checks) == 1 \
                     else Or(null_checks[0], null_checks[1])
                 e = If(any_null, Literal(null_result), e)
+                # Python: None == None is True, None != None is False — the
+                # inverse of the any-null answer; guard both-null first
+                if len(null_checks) == 2:
+                    e = If(And(null_checks[0], null_checks[1]),
+                           Literal(not null_result), e)
         return e
     return build
 

@@ -127,3 +127,30 @@ def test_sort_secondary_key_under_null_primary():
 def test_topn_under_tiny_batches():
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: _df(s).orderBy(F.col("b")).limit(25), conf=TINY_BATCH)
+
+
+@pytest.mark.parametrize("with_nan", [False, True])
+def test_global_agg_over_budget_chunked_merge(with_nan):
+    """Ungrouped aggregate over the row budget takes the chunked
+    partial-state-merge path (never concatenates all input on device) and must
+    match the in-core answer (reference GpuMergeAggregateIterator)."""
+    def fn(s):
+        df = _df(s, n=3000)
+        if with_nan:
+            df = df.withColumn("d", F.when(F.col("a") % 11 == 0,
+                                           float("nan")).otherwise(F.col("d")))
+        return df.agg(
+            F.count(F.col("a")), F.sum(F.col("b")), F.avg(F.col("d")),
+            F.min(F.col("a")), F.max(F.col("a")), F.min(F.col("d")),
+            F.max(F.col("d")), F.stddev(F.col("d")),
+            F.first(F.col("b")), F.last(F.col("b")))
+    assert_tpu_and_cpu_are_equal_collect(fn, conf=TINY_BATCH)
+
+
+def test_global_agg_over_budget_collect_still_works():
+    """Non-mergeable aggregates (collect_set) keep the concat path."""
+    def fn(s):
+        df = s.createDataFrame(gen_df(
+            [("a", IntegerGen(min_val=0, max_val=50))], 2000, 3))
+        return df.agg(F.size(F.collect_set(F.col("a"))))
+    assert_tpu_and_cpu_are_equal_collect(fn, conf=TINY_BATCH)

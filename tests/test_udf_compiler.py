@@ -168,3 +168,24 @@ def test_compile_closure_constant():
 def test_compile_chained_comparison_or():
     e = compile_python_udf(lambda a: a < 0 or a > 10, [A], BooleanType())
     assert e is not None
+
+
+@pytest.mark.parametrize("op", ["eq", "ne"])
+def test_compiled_null_equality_matches_python(op):
+    """Python: None == None is True, None != None is False — the compiled
+    expression must agree with the row-fallback lambda on both-null rows."""
+    fn = (lambda a, b: a == b) if op == "eq" else (lambda a, b: a != b)
+
+    def q(s):
+        df = s.createDataFrame(gen_df(
+            [("a", IntegerGen(min_val=0, max_val=2, null_prob=0.5)),
+             ("b", IntegerGen(min_val=0, max_val=2, null_prob=0.5))], 200, 11))
+        u = udf(fn, BooleanType())
+        return df.select(F.col("a"), F.col("b"),
+                         u(F.col("a"), F.col("b")).alias("r"))
+
+    from spark_rapids_tpu.session import TpuSession
+    compiled = q(TpuSession(dict(COMPILER_ON))).collect()
+    row_lambda = q(TpuSession({})).collect()
+    assert compiled == row_lambda
+    assert any(r["a"] is None and r["b"] is None for r in compiled)
