@@ -261,6 +261,19 @@ SHUFFLE_WRITER_THREADS = _conf("spark.rapids.shuffle.multiThreaded.writer.thread
     "Threads for the multithreaded shuffle writer (reference RapidsConf.scala:1855)."
 ).integer(8)
 
+MESH_ENABLED = _conf("spark.rapids.tpu.mesh.enabled").doc(
+    "Execute hash exchanges as one collective all_to_all over a "
+    "jax.sharding.Mesh when the device topology allows it (the UCX-mode data "
+    "plane of the reference, shuffle-plugin/UCXShuffleTransport.scala, "
+    "re-expressed as an XLA collective over ICI). Requires "
+    "spark.rapids.shuffle.mode=ICI and shuffle partitions == mesh size."
+).boolean(False)
+
+MESH_SIZE = _conf("spark.rapids.tpu.mesh.size").doc(
+    "Mesh size (number of devices) for the collective exchange; 0 = all "
+    "visible devices."
+).integer(0)
+
 SHUFFLE_READER_THREADS = _conf("spark.rapids.shuffle.multiThreaded.reader.threads").doc(
     "Threads for the multithreaded shuffle reader (reference RapidsConf.scala:1866)."
 ).integer(8)

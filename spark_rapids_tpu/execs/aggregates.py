@@ -1138,16 +1138,26 @@ class TpuHashAggregateExec(TpuExec):
             chunks.append(cur)
         g_cap = bucket_capacity(1)
         per_fn: List[List[Dict]] = [[] for _ in agg_fns]
+        from ..memory.retry import with_retry_no_split
+        from ..memory.spill import SpillableColumnarBatch
+
+        def chunk_states(chunk: TpuColumnarBatch) -> List[Dict]:
+            cap, n = chunk.capacity, chunk.num_rows
+            perm = jnp.arange(cap, dtype=jnp.int32)
+            seg_ids = jnp.zeros((cap,), jnp.int32)
+            return [_segment_update(fn, self._eval_agg_input(fn, chunk, ctx),
+                                    seg_ids, g_cap, cap, n, perm)
+                    for fn in agg_fns]
+
         with self.metrics["reduceTime"].timed():
             for group in chunks:
                 chunk = concat_batches(group) if len(group) > 1 else group[0]
-                cap, n = chunk.capacity, chunk.num_rows
-                perm = jnp.arange(cap, dtype=jnp.int32)
-                seg_ids = jnp.zeros((cap,), jnp.int32)
-                for i, fn in enumerate(agg_fns):
-                    col = self._eval_agg_input(fn, chunk, ctx)
-                    per_fn[i].append(
-                        _segment_update(fn, col, seg_ids, g_cap, cap, n, perm))
+                # same OOM-retry discipline as the in-core path: the chunk is
+                # spillable while its partial state is computed
+                states = with_retry_no_split(SpillableColumnarBatch(chunk),
+                                             chunk_states)
+                for i in range(len(agg_fns)):
+                    per_fn[i].append(states[i])
             states = [_merge_global_states(fn, sts)
                       for fn, sts in zip(agg_fns, per_fn)]
             agg_cols = [_evaluate_agg(fn, st, 1, g_cap)

@@ -381,7 +381,10 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             if cond.validity is not None:
                 keep = keep & cond.validity
         if jt in ("inner", "cross"):
-            yield compact(joined, keep).rename(names)
+            if self.condition is None:
+                yield joined.rename(names)  # keep == row mask: no copy needed
+            else:
+                yield compact(joined, keep).rename(names)
             return
         # per-side match flags (scatter-max over pair keep mask; padding pairs
         # route to the dropped slot n)

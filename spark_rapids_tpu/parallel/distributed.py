@@ -23,6 +23,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..kernels.q1 import Q1Inputs, Q1State, q1_final, q1_partial
 
+import warnings
+
+with warnings.catch_warnings():
+    # the experimental path keeps the check_rep kwarg this jax version needs
+    warnings.simplefilter("ignore", DeprecationWarning)
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     devs = jax.devices()
@@ -49,7 +56,6 @@ def distributed_q1_step(mesh: Mesh, axis: str = "data"):
         merged = jax.tree.map(lambda x: jax.lax.psum(x, axis), state)
         return q1_final(Q1State(*merged))
 
-    from jax.experimental.shard_map import shard_map
     spec = P(axis)
     in_specs = (Q1Inputs(*([spec] * 8)), P())
     out_spec = P()  # replicated results
@@ -106,7 +112,6 @@ def ici_all_to_all_exchange(mesh: Mesh, axis: str = "data"):
                                       tiled=False).reshape(-1)
         return a2a(buf_k), a2a(buf_v), a2a(buf_ok)
 
-    from jax.experimental.shard_map import shard_map
     spec = P(axis)
     return jax.jit(shard_map(exchange, mesh=mesh,
                              in_specs=(spec, spec, spec),
@@ -143,3 +148,53 @@ def dryrun_multichip(n_devices: int) -> None:
                                  np.uint32(42)).view(np.int32).astype(np.int64)) % n_devices
     owner = np.repeat(np.arange(n_devices), n_local)
     assert (dest[rok_np] == owner[rok_np]).all(), "exchange misrouted rows"
+
+    # (c) FRAMEWORK query over the mesh: session -> plan -> collective
+    # all_to_all exchange -> per-shard aggregation/join, vs the CPU oracle
+    # (the exec-layer integration of the UCX-mode shuffle, VERDICT.md #2)
+    import pyarrow as pa
+
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 40, 4096),
+                  "v": rng.normal(size=4096),
+                  "w": rng.integers(-50, 50, 4096)})
+    t2 = pa.table({"k": rng.integers(0, 40, 512),
+                   "r": rng.integers(0, 9, 512)})
+    mesh_conf = {"spark.rapids.shuffle.mode": "ICI",
+                 "spark.rapids.tpu.mesh.enabled": "true",
+                 "spark.sql.shuffle.partitions": str(n_devices),
+                 "spark.sql.autoBroadcastJoinThreshold": "0"}
+    tpu_s = TpuSession(dict(mesh_conf))
+    cpu_s = TpuSession({"spark.rapids.sql.enabled": "false"})
+
+    collective_runs = []
+    orig = TpuShuffleExchangeExec._try_materialize_collective
+
+    def spy(self, sid, ctx):
+        used = orig(self, sid, ctx)
+        collective_runs.append(used)
+        return used
+
+    TpuShuffleExchangeExec._try_materialize_collective = spy
+    try:
+        def query(sess):
+            df = sess.createDataFrame(t, num_partitions=min(4, n_devices))
+            d2 = sess.createDataFrame(t2, num_partitions=2)
+            return (df.join(d2, on="k", how="inner")
+                    .groupBy("k").agg(F.sum(F.col("v")),
+                                      F.count(F.col("w")),
+                                      F.max(F.col("r"))))
+        got = {r["k"]: list(r.values()) for r in query(tpu_s).collect()}
+        want = {r["k"]: list(r.values()) for r in query(cpu_s).collect()}
+    finally:
+        TpuShuffleExchangeExec._try_materialize_collective = orig
+    assert set(got) == set(want), "framework mesh query lost groups"
+    for k in got:
+        for x, y in zip(got[k], want[k]):
+            assert (x == y) or abs(x - y) < 1e-6, (k, x, y)
+    assert any(collective_runs), \
+        "framework query never used the mesh collective exchange"

@@ -1,0 +1,236 @@
+"""Mesh context + the collective (ICI) data plane for the exec-layer shuffle.
+
+This is the framework integration of the UCX-mode shuffle (SURVEY.md §2.7:
+shuffle-plugin/ UCXShuffleTransport.scala, RapidsShuffleInternalManagerBase.
+scala:238): when a jax.sharding.Mesh is configured, `TpuShuffleExchangeExec`
+routes its hash exchange through ONE jitted `shard_map` program whose
+`lax.all_to_all` moves every column's rows between shards over the
+interconnect — XLA schedules the ICI transfers that the reference hand-codes
+as UCX transactions. The exchange is collective: all map inputs are sharded
+row-wise over the mesh, re-bucketed by murmur3(key) % n_shards on-device, and
+each shard receives exactly its reduce partition.
+
+Static-shape strategy (XLA cannot size buffers data-dependently):
+  1. partition ids are computed per shard-group batch with the normal
+     expression path (shuffle/partitioner.py);
+  2. ONE host sync reads the per-(shard, dest) counts and picks a bucketed
+     slot capacity — the analogue of the reference sizing contiguousSplit
+     slices before handing them to the transport;
+  3. the jitted exchange scatters rows into [n_shards, slot_cap] send
+     buffers and `all_to_all`s them; receive-validity rides along.
+Compiled programs are cached by (mesh, capacity, slot_cap, column dtypes) so
+steady-state queries reuse one executable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..columnar.batch import TpuColumnarBatch, _repad, compact
+from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
+from ..config import MESH_ENABLED, MESH_SIZE
+
+_AXIS = "data"
+
+
+class MeshContext:
+    """Process-wide mesh handle (the TPU analogue of the executor's device
+    topology discovered via the shuffle heartbeat, Plugin.scala:436-447)."""
+
+    _lock = threading.Lock()
+    _meshes: Dict[int, Mesh] = {}
+
+    @classmethod
+    def get(cls, conf, n: Optional[int] = None) -> Optional[Mesh]:
+        """Mesh of exactly `n` devices (default: the configured/maximum
+        size); None when disabled or the topology is too small."""
+        if not conf.get(MESH_ENABLED):
+            return None
+        limit = conf.get(MESH_SIZE)
+        devs = jax.devices()
+        avail = min(limit, len(devs)) if limit else len(devs)
+        n = n if n is not None else avail
+        if n > avail or n < 2:
+            return None
+        with cls._lock:
+            if n not in cls._meshes:
+                cls._meshes[n] = Mesh(np.array(devs[:n]), (_AXIS,))
+            return cls._meshes[n]
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._meshes = {}
+
+
+def mesh_eligible_output(output) -> bool:
+    """Static (plan-time) eligibility: every column must have a fixed-width
+    device layout for the all_to_all to carry it. Strings/nested fall back to
+    the in-process catalog path until the ragged device layout lands."""
+    from ..columnar.vector import device_layout_ok
+    from ..types import is_fixed_width
+    return all(is_fixed_width(a.dtype) and device_layout_ok(a.dtype)
+               for a in output)
+
+
+# compiled exchange cache: (mesh, cap, slot_cap, col sig) -> jitted fn
+_EXCHANGE_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+
+
+def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
+                    sig: Tuple[Tuple[str, bool], ...]):
+    """One jitted shard_map program moving `len(sig)` columns + validity via
+    all_to_all. `sig` is ((dtype_str, has_validity), ...)."""
+    key = (mesh, n_dev, slot_cap, sig)
+    fn = _EXCHANGE_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_cols = len(sig)
+
+    def exchange(dest, *flat):
+        # per-shard local views: dest [cap], columns/validities [cap]
+        cap = dest.shape[0]
+        order = jnp.argsort(dest, stable=True)
+        sorted_dest = jnp.take(dest, order)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        one = jnp.ones((cap,), jnp.int32)
+        run_start = jnp.zeros((n_dev + 2,), jnp.int32).at[
+            sorted_dest + 1].add(one, mode="drop")
+        starts = jnp.cumsum(run_start)[:-1]
+        pos_in_bucket = idx - jnp.take(starts, sorted_dest)
+        live = sorted_dest < n_dev
+        keep = live & (pos_in_bucket < slot_cap)
+        send_slot = jnp.where(keep, sorted_dest * slot_cap + pos_in_bucket,
+                              n_dev * slot_cap)
+
+        def a2a(x):
+            x = x.reshape(n_dev, slot_cap)
+            return jax.lax.all_to_all(x, _AXIS, split_axis=0, concat_axis=0,
+                                      tiled=False).reshape(-1)
+
+        def scatter_send(x, fill, dt):
+            buf = jnp.full((n_dev * slot_cap,), fill, dt).at[send_slot].set(
+                jnp.take(x, order), mode="drop")
+            return a2a(buf)
+
+        rowok = a2a(jnp.zeros((n_dev * slot_cap,), jnp.bool_).at[
+            send_slot].set(keep, mode="drop"))
+        outs = [rowok]
+        datas = flat[:n_cols]
+        valids = flat[n_cols:]
+        for (dt, has_v), d, v in zip(sig, datas, valids):
+            outs.append(scatter_send(d, 0, d.dtype))
+            if has_v:
+                outs.append(scatter_send(v, False, jnp.bool_))
+        return tuple(outs)
+
+    from .distributed import shard_map
+    spec = P(_AXIS)
+    n_valid = sum(1 for _, has_v in sig if has_v)
+    in_specs = tuple([spec] * (1 + 2 * n_cols))
+    out_specs = tuple([spec] * (1 + n_cols + n_valid))
+    fn = jax.jit(shard_map(exchange, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False))
+    _EXCHANGE_CACHE[key] = fn
+    return fn
+
+
+def mesh_hash_exchange(mesh: Mesh, group_batches: List[Optional[TpuColumnarBatch]],
+                       pids_list: List[Optional[jnp.ndarray]],
+                       names: Sequence[str]) -> List[TpuColumnarBatch]:
+    """Collective hash exchange: `group_batches[d]` is the (possibly empty)
+    concatenated map input assigned to shard d, `pids_list[d]` its
+    destination-partition ids. Returns one compacted device batch per reduce
+    partition (= per shard)."""
+    n_dev = mesh.devices.size
+    assert len(group_batches) == n_dev
+    ref = next(b for b in group_batches if b is not None)
+    dtypes = [c.dtype for c in ref.columns]
+    cap = bucket_capacity(max([b.capacity for b in group_batches
+                               if b is not None] + [1]))
+
+    # per-(shard, dest) counts -> slot capacity (one host sync)
+    max_count = 1
+    for b, pids in zip(group_batches, pids_list):
+        if b is None or not b.num_rows:
+            continue
+        counts = np.bincount(np.asarray(pids)[: b.num_rows],
+                             minlength=n_dev)
+        max_count = max(max_count, int(counts.max()))
+    slot_cap = bucket_capacity(max_count)
+
+    # stack per-shard arrays into globally sharded [n_dev * cap] inputs
+    sharding = NamedSharding(mesh, P(_AXIS))
+    sig = []
+    col_data: List[List[jnp.ndarray]] = []
+    col_valid: List[List[jnp.ndarray]] = []
+    has_valid = [any(b is not None and b.columns[i].validity is not None
+                     for b in group_batches)
+                 for i in range(len(dtypes))]
+    for i, dt in enumerate(dtypes):
+        carrier = ref.columns[i].data.dtype
+        sig.append((str(carrier), has_valid[i]))
+        datas, valids = [], []
+        for b in group_batches:
+            if b is None:
+                datas.append(jnp.zeros((cap,), carrier))
+                valids.append(jnp.zeros((cap,), jnp.bool_))
+            else:
+                c = _repad(b.columns[i], cap)
+                datas.append(c.data)
+                valids.append(c.validity if c.validity is not None
+                              else row_mask(b.num_rows, cap))
+        col_data.append(datas)
+        col_valid.append(valids)
+    dests = []
+    for b, pids in zip(group_batches, pids_list):
+        if b is None or not b.num_rows:
+            dests.append(jnp.full((cap,), n_dev, jnp.int32))
+        else:
+            p = jnp.asarray(pids)[:cap].astype(jnp.int32)
+            if p.shape[0] < cap:
+                p = jnp.concatenate(
+                    [p, jnp.full((cap - p.shape[0],), n_dev, jnp.int32)])
+            dests.append(jnp.where(row_mask(b.num_rows, cap), p, n_dev))
+
+    def shard(arrs):
+        return jax.device_put(jnp.concatenate(arrs), sharding)
+
+    dest_g = shard(dests)
+    flat = [shard(col_data[i]) for i in range(len(dtypes))] + \
+           [shard(col_valid[i]) for i in range(len(dtypes))]
+    fn = _build_exchange(mesh, n_dev, slot_cap, tuple(sig))
+    outs = fn(dest_g, *flat)
+    rowok = outs[0]
+    pos = 1
+    recv_data: List[jnp.ndarray] = []
+    recv_valid: List[Optional[jnp.ndarray]] = []
+    for i in range(len(dtypes)):
+        recv_data.append(outs[pos])
+        pos += 1
+        if has_valid[i]:
+            recv_valid.append(outs[pos])
+            pos += 1
+        else:
+            recv_valid.append(None)
+
+    # slice per shard, compact out the slot gaps
+    local = n_dev * slot_cap
+    results: List[TpuColumnarBatch] = []
+    for r in range(n_dev):
+        sl = slice(r * local, (r + 1) * local)
+        ok = rowok[sl]
+        cols = []
+        for i, dt in enumerate(dtypes):
+            v = recv_valid[i][sl] if recv_valid[i] is not None else None
+            cols.append(TpuColumnVector(dt, recv_data[i][sl], v, local))
+        batch = TpuColumnarBatch(cols, local, list(names))
+        results.append(compact(batch, ok))
+    return results

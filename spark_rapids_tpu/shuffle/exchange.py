@@ -48,10 +48,18 @@ class _ExchangeBase:
             mgr = TpuShuffleManager.get(ctx.conf)
             sid = mgr.new_shuffle_id()
             child = self.children[0]
+            if self._try_materialize_collective(sid, ctx):
+                self._n_maps = 1  # one collective "map": the whole exchange
+                self._shuffle_id = sid
+                return
             self._n_maps = child.num_partitions()
             for map_id in range(self._n_maps):
                 self._materialize_map(sid, map_id, ctx, mgr)
             self._shuffle_id = sid
+
+    def _try_materialize_collective(self, sid: int, ctx: TaskContext) -> bool:
+        """Mesh collective data plane; overridden by the device exchange."""
+        return False
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
                          mgr) -> None:
@@ -133,6 +141,79 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
     def additional_metrics(self):
         return {"partitionTime": "MODERATE", "serializationTime": "MODERATE",
                 "deserializationTime": "MODERATE"}
+
+    def _try_materialize_collective(self, sid: int, ctx: TaskContext) -> bool:
+        """ICI-mesh data plane (reference UCX mode, shuffle-plugin/
+        UCXShuffleTransport.scala): ONE jitted all_to_all moves every shard's
+        hash-bucketed rows to its reduce partition's shard. Used when a mesh
+        is configured, the exchange is a hash partitioning onto exactly
+        mesh-size partitions, and all columns have fixed-width device layouts.
+        Results land in the device-resident catalog keyed as a single
+        collective map output; FetchFailed recovery re-runs the collective."""
+        if self._shuffle_mode(ctx) != "ICI" or self.partitioning != "hash":
+            return False
+        from ..parallel.mesh import (MeshContext, mesh_eligible_output,
+                                     mesh_hash_exchange)
+        mesh = MeshContext.get(ctx.conf, self._n_out)
+        if mesh is None:
+            return False
+        if not mesh_eligible_output(self.output):
+            return False
+        from ..columnar.batch import concat_batches
+        from ..memory.spill import SpillableColumnarBatch
+        from .ici import IciShuffleCatalog
+        n_dev = self._n_out
+        child = self.children[0]
+        # collect per-shard groups as SPILLABLE batches so HBM pressure from
+        # later map partitions can evict earlier outputs (the per-map ICI path
+        # gets this from the catalog; the collective must provide it itself)
+        groups: List[List[SpillableColumnarBatch]] = [[] for _ in range(n_dev)]
+        try:
+            for m in range(child.num_partitions()):
+                mctx = TaskContext(m, ctx.conf)
+                try:
+                    for b in child.execute_partition(m, mctx):
+                        if b.num_rows:
+                            groups[m % n_dev].append(SpillableColumnarBatch(b))
+                finally:
+                    mctx.complete()
+            if not any(groups):
+                IciShuffleCatalog.get().mark_map_complete(sid, 0)
+                self._collective = True
+                return True
+            with self.metrics["partitionTime"].timed():
+                batches = []
+                for g in groups:
+                    if not g:
+                        batches.append(None)
+                        continue
+                    got = [sb.get_batch() for sb in g]
+                    batches.append(concat_batches(got) if len(got) > 1
+                                   else got[0])
+                pids = [hash_partition_ids(b, self.keys, n_dev, ctx)
+                        if b is not None else None for b in batches]
+                parts = mesh_hash_exchange(mesh, batches, pids,
+                                           [a.name for a in self.output])
+        finally:
+            for g in groups:
+                for sb in g:
+                    sb.close()
+        catalog = IciShuffleCatalog.get()
+        for r, blk in enumerate(parts):
+            if blk.num_rows:
+                catalog.put_block(sid, 0, r, blk, owner="mesh-collective")
+        catalog.mark_map_complete(sid, 0)
+        self._collective = True
+        return True
+
+    def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
+                         mgr) -> None:
+        if getattr(self, "_collective", False):
+            # collective recovery: re-run the whole exchange (a lost block in
+            # mesh mode means the collective result was invalidated)
+            self._try_materialize_collective(sid, ctx)
+            return
+        super()._materialize_map(sid, map_id, ctx, mgr)
 
     def _device_parts(self, map_id: int, ctx: TaskContext) -> Iterator[List]:
         """Device partition-split of each input batch (shared by both
