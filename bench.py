@@ -1,12 +1,21 @@
-"""Headline benchmark: TPC-H Q1 pipeline throughput on the TPU chip.
+"""Headline benchmark: TPC-H Q1 through the FULL framework (session → plan →
+override engine → whole-stage compiled aggregation) on the TPU chip, with the
+hand-fused kernel as the ceiling reference.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-vs_baseline semantics: the reference's in-tree headline is the ETL demo speedup
-of 3.8x over CPU (BASELINE.md: CPU 1736s -> GPU 457s on T4s). We measure the
-same style of ratio — this framework's TPU Q1 throughput over a single-node CPU
-(numpy) run of the identical pipeline — and report vs_baseline =
-our_speedup / 3.8 (>1.0 beats the reference's headline ratio).
+vs_baseline semantics: the reference's in-tree headline is the ETL demo
+speedup of 3.8x over CPU (BASELINE.md: CPU 1736s -> GPU 457s on T4s). We
+report the same style of ratio — the framework's TPU Q1 throughput over a
+multithreaded CPU (pyarrow compute) run of the identical pipeline — scaled as
+vs_baseline = our_speedup / 3.8 (>1.0 beats the reference's headline ratio).
+
+The framework number runs the real exec path: TpuSession plans the query, the
+override engine converts it, and the whole-stage compiler fuses
+scan→filter→project→groupBy into one XLA program over a device-cached
+relation (io/cache.py DeviceCachedRelation). detail reports the kernel
+ceiling, the framework/kernel ratio, and the effective HBM bandwidth
+fraction of the framework run.
 """
 
 from __future__ import annotations
@@ -15,6 +24,8 @@ import json
 import time
 
 import numpy as np
+
+HBM_BYTES_PER_S = 819e9  # v5e-class chip peak HBM bandwidth
 
 
 def _time_best(fn, iters: int = 5) -> float:
@@ -26,56 +37,168 @@ def _time_best(fn, iters: int = 5) -> float:
     return best
 
 
-def main() -> None:
+def _kernel_q1(n: int):
+    """The hand-fused single-program ceiling (kernels/q1[_pallas])."""
     import jax
     import jax.numpy as jnp
 
-    from spark_rapids_tpu.kernels.q1 import (make_example_batch, q1_final,
-                                             q1_reference_numpy)
+    from spark_rapids_tpu.kernels.q1 import make_example_batch, q1_final
     from spark_rapids_tpu.kernels.q1 import q1_step as q1_step_xla
     from spark_rapids_tpu.kernels.q1_pallas import q1_partial_pallas
 
-    n = 1 << 24  # 16.7M rows (~470 MB of lineitem columns)
     batch, cutoff = make_example_batch(n)
     cutoff = jnp.int32(cutoff)
-
-    # kernel selection AT THE BENCHMARK SHAPE: fused single-pass pallas when
-    # the backend takes it, XLA einsum path otherwise — and report which ran
-    pallas_step = jax.jit(
-        lambda b, c: q1_final(q1_partial_pallas(b, c)))
+    pallas_step = jax.jit(lambda b, c: q1_final(q1_partial_pallas(b, c)))
     try:
         jax.block_until_ready(pallas_step(batch, cutoff))
         q1_step, kernel = pallas_step, "pallas"
     except Exception:  # noqa: BLE001 — backend rejected the pallas lowering
         q1_step, kernel = q1_step_xla, "xla"
-    out = q1_step(batch, cutoff)
-    jax.block_until_ready(out)
+    jax.block_until_ready(q1_step(batch, cutoff))
 
-    def tpu_run():
-        # materialize a result scalar: block_until_ready alone under-reports
-        # through the axon relay's async dispatch
+    def run():
         o = q1_step(batch, cutoff)
         float(np.asarray(o["count_order"]).sum())
 
-    tpu_s = _time_best(tpu_run, iters=10)
-    tpu_rows_per_s = n / tpu_s
+    return _time_best(run, iters=10), kernel
 
-    # CPU single-node baseline: identical pipeline in numpy
-    host = jax.tree.map(np.asarray, batch)
-    cpu_s = _time_best(lambda: q1_reference_numpy(host, int(cutoff)), iters=3)
+
+def _lineitem_table(n: int):
+    """Q1-shaped lineitem columns (strings for the group keys, like TPC-H)."""
+    import pyarrow as pa
+    rng = np.random.default_rng(42)
+    return pa.table({
+        "l_returnflag": pa.array(
+            np.array(["A", "N", "R"])[rng.integers(0, 3, n)]),
+        "l_linestatus": pa.array(np.array(["F", "O"])[rng.integers(0, 2, n)]),
+        "l_quantity": rng.uniform(1, 50, n),
+        "l_extendedprice": rng.uniform(900, 100000, n),
+        "l_discount": rng.uniform(0, 0.1, n),
+        "l_tax": rng.uniform(0, 0.08, n),
+        "l_shipdate": rng.integers(8766, 10957, n).astype(np.int32),
+    })
+
+
+def _framework_query(df):
+    import spark_rapids_tpu.functions as F
+    return (df.filter(F.col("l_shipdate") <= 10471)
+            .withColumn("disc_price",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .withColumn("charge",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount"))
+                        * (1 + F.col("l_tax")))
+            .groupBy("l_returnflag", "l_linestatus")
+            .agg(F.sum(F.col("l_quantity")).alias("sum_qty"),
+                 F.sum(F.col("l_extendedprice")).alias("sum_base_price"),
+                 F.sum(F.col("disc_price")).alias("sum_disc_price"),
+                 F.sum(F.col("charge")).alias("sum_charge"),
+                 F.avg(F.col("l_quantity")).alias("avg_qty"),
+                 F.avg(F.col("l_extendedprice")).alias("avg_price"),
+                 F.avg(F.col("l_discount")).alias("avg_disc"),
+                 F.count(F.col("l_quantity")).alias("count_order")))
+
+
+def _framework_q1(table) -> dict:
+    """Full path: session → plan → overrides → compiled stage, over a
+    device-cached relation (upload amortized like any resident table)."""
+    from spark_rapids_tpu.session import TpuSession
+    # one resident batch: fewer dispatch chains per run (HBM holds it easily)
+    s = TpuSession({"spark.rapids.sql.batchSizeRows": str(table.num_rows)})
+    df = s.createDataFrame(table, num_partitions=1).device_cache()
+    q = _framework_query(df)
+    plan = q.explain()
+    rows = q.collect()  # warm: compiles the stage, memoizes dictionaries
+    assert rows, "q1 returned nothing"
+    sec = _time_best(lambda: q.collect(), iters=5)
+    # bytes the stage actually streams per run (used columns of the cache)
+    used = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate")
+    batches = df._plan.batches()
+    byte_count = 0
+    for b in batches:
+        for name, col in zip(b.names or [], b.columns):
+            if name in used:
+                if name in ("l_returnflag", "l_linestatus"):
+                    # the stage streams the memoized int32 dictionary codes
+                    byte_count += 4 * col.capacity
+                else:
+                    byte_count += col.data.size * col.data.dtype.itemsize
+    return {"sec": sec, "compiled": "TpuCompiledAggStage" in plan,
+            "bytes": byte_count}
+
+
+def _framework_q6(table) -> float:
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.sql.batchSizeRows": str(table.num_rows)})
+    df = s.createDataFrame(table, num_partitions=1).device_cache()
+    q = (df.filter((F.col("l_shipdate") >= 8766)
+                   & (F.col("l_shipdate") < 9131)
+                   & (F.col("l_discount") >= 0.05)
+                   & (F.col("l_discount") <= 0.07)
+                   & (F.col("l_quantity") < 24))
+         .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+              .alias("revenue")))
+    q.collect()
+    return _time_best(lambda: q.collect(), iters=5)
+
+
+def _cpu_q1(table) -> float:
+    """Multithreaded CPU baseline: the same pipeline in pyarrow compute
+    (arrow kernels parallelize internally — a fair single-node denominator,
+    unlike single-threaded numpy)."""
+    import pyarrow.compute as pc
+
+    def run():
+        t = table.filter(pc.less_equal(table.column("l_shipdate"), 10471))
+        price = t.column("l_extendedprice")
+        disc = t.column("l_discount")
+        disc_price = pc.multiply(price, pc.subtract(1.0, disc))
+        charge = pc.multiply(disc_price, pc.add(1.0, t.column("l_tax")))
+        t = t.append_column("disc_price", disc_price)
+        t = t.append_column("charge", charge)
+        out = t.group_by(["l_returnflag", "l_linestatus"]).aggregate(
+            [("l_quantity", "sum"), ("l_extendedprice", "sum"),
+             ("disc_price", "sum"), ("charge", "sum"),
+             ("l_quantity", "mean"), ("l_extendedprice", "mean"),
+             ("l_discount", "mean"), ("l_quantity", "count")])
+        out.num_rows
+
+    return _time_best(run, iters=3)
+
+
+def main() -> None:
+    n = 1 << 24  # 16.7M rows
+    kernel_s, kernel = _kernel_q1(n)
+    kernel_rows_per_s = n / kernel_s
+
+    table = _lineitem_table(n)
+    fw = _framework_q1(table)
+    fw_rows_per_s = n / fw["sec"]
+    q6_s = _framework_q6(table)
+
+    cpu_s = _cpu_q1(table)
     cpu_rows_per_s = n / cpu_s
 
-    speedup = tpu_rows_per_s / cpu_rows_per_s
+    speedup = fw_rows_per_s / cpu_rows_per_s
     print(json.dumps({
-        "metric": "tpch_q1_pipeline_throughput",
-        "value": round(tpu_rows_per_s / 1e6, 3),
+        "metric": "tpch_q1_framework_throughput",
+        "value": round(fw_rows_per_s / 1e6, 3),
         "unit": "Mrows/s",
         "vs_baseline": round(speedup / 3.8, 3),
         "detail": {
             "rows": n,
+            "framework_s": round(fw["sec"], 6),
+            "framework_compiled_stage": fw["compiled"],
+            "framework_hbm_fraction": round(
+                fw["bytes"] / fw["sec"] / HBM_BYTES_PER_S, 4),
             "kernel": kernel,
-            "tpu_s": round(tpu_s, 6),
+            "kernel_s": round(kernel_s, 6),
+            "kernel_Mrows_per_s": round(kernel_rows_per_s / 1e6, 3),
+            "framework_over_kernel": round(kernel_s / fw["sec"], 3),
+            "q6_framework_s": round(q6_s, 6),
             "cpu_s": round(cpu_s, 6),
+            "cpu_baseline": "pyarrow compute (multithreaded)",
             "speedup_vs_cpu": round(speedup, 2),
             "baseline": "reference ETL headline 3.8x (BASELINE.md)",
         },

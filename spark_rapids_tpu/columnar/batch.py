@@ -49,9 +49,44 @@ class TpuColumnarBatch:
         return sum(c.device_memory_size() for c in self.columns)
 
     def to_arrow(self):
+        import jax
         import pyarrow as pa
         names = self.names or [f"c{i}" for i in range(self.num_columns)]
-        arrays = [c.to_arrow() for c in self.columns]
+        # ONE device_get for every device buffer in the batch: each
+        # np.asarray on a jax.Array is a blocking round trip, which dominates
+        # result materialization on high-latency links (tunneled TPUs)
+        leaves: List = []
+
+        def collect(c):
+            if c.host_data is not None:
+                return
+            for buf in (c.data, c.validity, c.offsets):
+                if buf is not None and not isinstance(buf, np.ndarray):
+                    leaves.append(buf)
+            if c.child is not None:
+                collect(c.child)
+
+        for c in self.columns:
+            collect(c)
+        fetched = iter(jax.device_get(leaves)) if leaves else iter(())
+
+        def localize(c):
+            if c.host_data is not None:
+                return c
+            data, validity, offsets = c.data, c.validity, c.offsets
+            if data is not None and not isinstance(data, np.ndarray):
+                data = next(fetched)
+            if validity is not None and not isinstance(validity, np.ndarray):
+                validity = next(fetched)
+            if offsets is not None and not isinstance(offsets, np.ndarray):
+                offsets = next(fetched)
+            child = localize(c.child) if c.child is not None else None
+            return TpuColumnVector(c.dtype, data, validity, c.num_rows,
+                                   offsets=offsets, child=child,
+                                   host_data=c.host_data,
+                                   host_capacity=c.host_capacity)
+
+        arrays = [localize(c).to_arrow() for c in self.columns]
         # from_arrays, not pa.table(dict(...)): names may repeat (e.g. join
         # output carrying the same key name from both sides)
         return (pa.Table.from_arrays(arrays, names=list(names))
@@ -61,18 +96,63 @@ class TpuColumnarBatch:
         return self.to_arrow().to_pylist()
 
     @staticmethod
-    def from_arrow(table, bucket: bool = True) -> "TpuColumnarBatch":
-        """Arrow table/record-batch → device batch (H→D; reference HostColumnarToGpu)."""
+    def from_arrow(table, bucket: bool = True,
+                   to_device: bool = True) -> "TpuColumnarBatch":
+        """Arrow table/record-batch → device batch (H→D; reference
+        HostColumnarToGpu). All buffers ship in ONE device_put.
+        `to_device=False` keeps numpy buffers (valid column payloads — jax
+        ops upload them implicitly on first use): right for tiny result
+        tables that are usually collected straight back to the host."""
+        import jax
         import pyarrow as pa
+
+        from .vector import _keep_host
         if isinstance(table, pa.RecordBatch):
             table = pa.table(table)
         table = table.combine_chunks()
-        cols = [TpuColumnVector.from_arrow(table.column(i), bucket=bucket)
-                for i in range(table.num_columns)]
-        # all columns in one batch must share a row capacity
-        if cols:
-            cap = max(c.capacity for c in cols)
-            cols = [_repad(c, cap) for c in cols]
+        _keep_host.active = True
+        try:
+            cols = [TpuColumnVector.from_arrow(table.column(i), bucket=bucket)
+                    for i in range(table.num_columns)]
+            # all columns in one batch must share a row capacity
+            if cols:
+                cap = max(c.capacity for c in cols)
+                cols = [_repad(c, cap) for c in cols]
+        finally:
+            _keep_host.active = False
+        if not to_device:
+            return TpuColumnarBatch(cols, table.num_rows,
+                                    list(table.column_names))
+
+        # single upload of every numpy buffer across all columns
+        leaves: List[np.ndarray] = []
+
+        def collect(c: TpuColumnVector):
+            for buf in (c.data, c.validity, c.offsets):
+                if isinstance(buf, np.ndarray):
+                    leaves.append(buf)
+            if c.child is not None:
+                collect(c.child)
+
+        for c in cols:
+            collect(c)
+        uploaded = iter(jax.device_put(leaves)) if leaves else iter(())
+
+        def rebuild(c: TpuColumnVector) -> TpuColumnVector:
+            data, validity, offsets = c.data, c.validity, c.offsets
+            if isinstance(data, np.ndarray):
+                data = next(uploaded)
+            if isinstance(validity, np.ndarray):
+                validity = next(uploaded)
+            if isinstance(offsets, np.ndarray):
+                offsets = next(uploaded)
+            child = rebuild(c.child) if c.child is not None else None
+            return TpuColumnVector(c.dtype, data, validity, c.num_rows,
+                                   offsets=offsets, child=child,
+                                   host_data=c.host_data,
+                                   host_capacity=c.host_capacity)
+
+        cols = [rebuild(c) for c in cols]
         return TpuColumnarBatch(cols, table.num_rows, list(table.column_names))
 
     @staticmethod
@@ -104,16 +184,21 @@ def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
     if col.capacity > capacity:
         raise ValueError("cannot shrink capacity")
     pad = capacity - col.capacity
+    # stay in the numpy domain for host-built columns (deferred batch upload)
+    xp = np if isinstance(col.data, np.ndarray) else jnp
     if col.offsets is not None:
         last = col.offsets[-1]
-        offsets = jnp.concatenate([col.offsets, jnp.full((pad,), last, jnp.int32)])
+        oxp = np if isinstance(col.offsets, np.ndarray) else jnp
+        offsets = oxp.concatenate(
+            [col.offsets, oxp.full((pad,), last, oxp.int32)])
         data = col.data
     else:
         offsets = None
-        data = jnp.concatenate([col.data, jnp.zeros((pad,), col.data.dtype)])
+        data = xp.concatenate([col.data, xp.zeros((pad,), col.data.dtype)])
     validity = col.validity
     if validity is not None:
-        validity = jnp.concatenate([validity, jnp.zeros((pad,), jnp.bool_)])
+        vxp = np if isinstance(validity, np.ndarray) else jnp
+        validity = vxp.concatenate([validity, vxp.zeros((pad,), vxp.bool_)])
     return TpuColumnVector(col.dtype, data, validity, col.num_rows, offsets=offsets,
                            child=col.child)
 

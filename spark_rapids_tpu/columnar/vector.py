@@ -38,7 +38,23 @@ def bucket_capacity(n: int, enabled: bool = True, minimum: int = 16) -> int:
     return cap
 
 
-def _np_to_jax(arr: np.ndarray) -> jax.Array:
+import threading as _threading
+
+
+class _KeepHost(_threading.local):
+    """When active, column constructors keep numpy buffers instead of
+    uploading each one — the batch-level builder then ships ALL buffers in a
+    single device_put (one transfer instead of one per buffer, which matters
+    on high-latency links)."""
+    active = False
+
+
+_keep_host = _KeepHost()
+
+
+def _np_to_jax(arr: np.ndarray):
+    if _keep_host.active:
+        return arr
     return jnp.asarray(arr)
 
 

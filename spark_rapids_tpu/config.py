@@ -274,6 +274,20 @@ MESH_SIZE = _conf("spark.rapids.tpu.mesh.size").doc(
     "visible devices."
 ).integer(0)
 
+COMPILED_AGG_ENABLED = _conf("spark.rapids.tpu.agg.compiledStage.enabled").doc(
+    "Fuse eligible scan->filter->project->groupBy pipelines into ONE jitted "
+    "XLA program with a direct-indexed group table (small key domains only). "
+    "Eliminates per-expression dispatch latency — the TPU analogue of the "
+    "reference's fused aggregation iterator chain "
+    "(GpuAggregateExec.scala:549). Ineligible or overflowing stages fall "
+    "back to the general sort-based aggregate transparently."
+).boolean(True)
+
+COMPILED_AGG_MAX_GROUPS = _conf("spark.rapids.tpu.agg.compiled.maxGroups").doc(
+    "Largest combined group-key domain the compiled aggregation stage may "
+    "direct-index; beyond this the general sort-based path runs."
+).integer(4096)
+
 SHUFFLE_READER_THREADS = _conf("spark.rapids.shuffle.multiThreaded.reader.threads").doc(
     "Threads for the multithreaded shuffle reader (reference RapidsConf.scala:1866)."
 ).integer(8)
@@ -369,6 +383,8 @@ UDF_COMPILER_ENABLED = _conf("spark.rapids.sql.udfCompiler.enabled").doc(
 # ---------------------------------------------------------------------------
 HASH_AGG_ENABLED = _conf("spark.rapids.sql.exec.HashAggregateExec").doc(
     "Enable TPU hash aggregation.").boolean(True)
+IN_MEMORY_SCAN_ENABLED = _conf("spark.rapids.sql.exec.InMemoryTableScanExec").doc(
+    "Enable the TPU device-cached relation scan.").boolean(True)
 SORT_ENABLED = _conf("spark.rapids.sql.exec.SortExec").doc(
     "Enable TPU sort.").boolean(True)
 JOIN_ENABLED = _conf("spark.rapids.sql.exec.ShuffledHashJoinExec").doc(

@@ -38,6 +38,58 @@ class HostToDeviceExec(TpuExec):
             yield b.rename(names)
 
 
+class CpuDeviceScanExec(CpuExec):
+    """CPU view of a device-cached relation (downloads per batch); converts
+    to TpuDeviceScanExec under the override engine — the reference's
+    InMemoryTableScan over the cached-batch serializer."""
+
+    def __init__(self, batches, output):
+        super().__init__([])
+        self.batches = list(batches)
+        self._output = list(output)
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return max(1, len(self.batches))
+
+    def node_desc(self) -> str:
+        return f"CpuDeviceScan[{len(self.batches)} batches]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        if idx < len(self.batches):
+            yield self.batches[idx].to_arrow()
+
+
+class TpuDeviceScanExec(TpuExec):
+    """Serve device-resident cached batches with zero upload cost; column
+    objects are stable across runs, so memoized per-column statistics
+    (group-by dictionaries/ranges) survive between queries."""
+
+    def __init__(self, batches, output):
+        super().__init__([])
+        self.batches = list(batches)
+        self._output = list(output)
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return max(1, len(self.batches))
+
+    def node_desc(self) -> str:
+        rows = sum(b.num_rows for b in self.batches)
+        return f"TpuDeviceScan[{len(self.batches)} batches, {rows} rows]"
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        names = [a.name for a in self._output]
+        if idx < len(self.batches):
+            yield self.batches[idx].rename(names)
+
+
 class DeviceToHostExec(CpuExec):
     """Download device batches to host Arrow (reference GpuColumnarToRowExec)."""
 

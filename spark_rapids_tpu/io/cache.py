@@ -41,3 +41,27 @@ class CachedRelation(LogicalPlan):
 
     def node_desc(self) -> str:
         return f"CachedRelation[{self.num_rows} rows, {len(self._blob)} bytes]"
+
+
+class DeviceCachedRelation(LogicalPlan):
+    """Device-resident cache: the materialized result is held as
+    TpuColumnarBatch partitions in HBM (reference GpuInMemoryTableScanExec
+    over the cache serializer). Repeated queries skip the host→device upload
+    AND keep per-column memoized stats (group-by dictionaries/ranges), which
+    is what lets the compiled aggregation stage hit its compile cache."""
+
+    def __init__(self, batches: List, output):
+        self._batches = list(batches)
+        self._output = list(output)
+        self.num_rows = sum(b.num_rows for b in batches)
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def batches(self) -> List:
+        return self._batches
+
+    def node_desc(self) -> str:
+        return (f"DeviceCachedRelation[{self.num_rows} rows, "
+                f"{len(self._batches)} batches]")

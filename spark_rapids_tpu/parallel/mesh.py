@@ -156,13 +156,15 @@ def mesh_hash_exchange(mesh: Mesh, group_batches: List[Optional[TpuColumnarBatch
     cap = bucket_capacity(max([b.capacity for b in group_batches
                                if b is not None] + [1]))
 
-    # per-(shard, dest) counts -> slot capacity (one host sync)
+    # per-(shard, dest) counts -> slot capacity (ONE host sync for all
+    # shards' pid arrays; a per-shard np.asarray loop would pay one round
+    # trip each on high-latency links)
+    live = [(b, p) for b, p in zip(group_batches, pids_list)
+            if b is not None and b.num_rows]
+    fetched = jax.device_get([p for _b, p in live]) if live else []
     max_count = 1
-    for b, pids in zip(group_batches, pids_list):
-        if b is None or not b.num_rows:
-            continue
-        counts = np.bincount(np.asarray(pids)[: b.num_rows],
-                             minlength=n_dev)
+    for (b, _p), pids_np in zip(live, fetched):
+        counts = np.bincount(pids_np[: b.num_rows], minlength=n_dev)
         max_count = max(max_count, int(counts.max()))
     slot_cap = bucket_capacity(max_count)
 

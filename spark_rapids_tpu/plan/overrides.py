@@ -106,6 +106,18 @@ register_exec(
     lambda m: None,
     lambda m, ch: TB.TpuRangeExec(m.plan.start, m.plan.end, m.plan.step,
                                   m.plan.num_partitions(), m.plan.output))
+
+from ..execs.transitions import CpuDeviceScanExec as _CpuDevScan  # noqa: E402
+
+
+def _convert_device_scan(meta: PlanMeta, ch):
+    from ..execs.transitions import TpuDeviceScanExec
+    return TpuDeviceScanExec(meta.plan.batches, meta.plan.output)
+
+
+register_exec(_CpuDevScan, "device-cached scan",
+              "spark.rapids.sql.exec.InMemoryTableScanExec",
+              lambda m: None, _convert_device_scan)
 register_exec(
     CE.CpuUnionExec, "union", "spark.rapids.sql.exec.UnionExec",
     lambda m: None,
@@ -407,7 +419,9 @@ class TpuOverrides:
             meta.collect_fallback_reasons(reasons)
             return plan  # explainOnly: report, execute on CPU
         converted = meta.convert_if_needed()
-        return TpuTransitionOverrides.apply(converted, conf)
+        final = TpuTransitionOverrides.apply(converted, conf)
+        from ..execs.compiled import compile_agg_stages
+        return compile_agg_stages(final, conf)
 
     @staticmethod
     def explain_plan(plan: PhysicalPlan, conf: RapidsConf) -> str:

@@ -27,9 +27,12 @@ def _compile_udfs(exprs, conf: RapidsConf):
 
 
 def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
-    from ..io.cache import CachedRelation
+    from ..io.cache import CachedRelation, DeviceCachedRelation
     if isinstance(plan, CachedRelation):
         return CE.CpuLocalTableScanExec(plan.table(), 1, plan.output)
+    if isinstance(plan, DeviceCachedRelation):
+        from ..execs.transitions import CpuDeviceScanExec
+        return CpuDeviceScanExec(plan.batches(), plan.output)
     if isinstance(plan, L.LocalRelation):
         return CE.CpuLocalTableScanExec(plan.table, plan.num_partitions, plan.output)
     if isinstance(plan, L.Range):

@@ -428,6 +428,18 @@ class DataFrame:
 
     persist = cache
 
+    def device_cache(self) -> "DataFrame":
+        """Materialize once into device-resident batches (HBM) and replace
+        the plan with a device scan — repeated queries skip the host→device
+        upload entirely (reference GpuInMemoryTableScanExec over the cached
+        batch serializer). Column objects are stable across runs, so
+        per-column memoized statistics (group-by dictionaries, key ranges)
+        and the compiled-stage program cache stay warm."""
+        from .io.cache import DeviceCachedRelation
+        batches = self.to_device_batches()
+        return DataFrame(DeviceCachedRelation(batches, self._plan.output),
+                         self.session)
+
     # --- actions ----------------------------------------------------------
     def to_arrow(self):
         import pyarrow as pa

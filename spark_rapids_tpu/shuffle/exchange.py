@@ -160,6 +160,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         if not mesh_eligible_output(self.output):
             return False
         from ..columnar.batch import concat_batches
+        from ..memory.hbm import TpuOOM
         from ..memory.spill import SpillableColumnarBatch
         from .ici import IciShuffleCatalog
         n_dev = self._n_out
@@ -194,6 +195,12 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                         if b is not None else None for b in batches]
                 parts = mesh_hash_exchange(mesh, batches, pids,
                                            [a.name for a in self.output])
+        except TpuOOM:
+            # memory pressure while staging the collective: the per-map path
+            # has the full incremental-spill discipline; drop any partial
+            # state for this shuffle id and let the caller run per-map
+            IciShuffleCatalog.get().cleanup(sid)
+            return False
         finally:
             for g in groups:
                 for sb in g:

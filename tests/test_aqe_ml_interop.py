@@ -11,7 +11,10 @@ from data_gen import DoubleGen, IntegerGen, LongGen, gen_df
 import spark_rapids_tpu.functions as F
 from spark_rapids_tpu.session import TpuSession
 
-AQE = {"spark.sql.adaptive.coalescePartitions.enabled": "true"}
+AQE = {"spark.sql.adaptive.coalescePartitions.enabled": "true",
+       # these tests exercise the AQE reader over a materialized exchange;
+       # the compiled agg stage would bypass both
+       "spark.rapids.tpu.agg.compiledStage.enabled": "false"}
 
 
 def _df(s, n=4000, seed=2):

@@ -1,0 +1,212 @@
+"""Whole-stage compiled aggregation (execs/compiled.py): eligibility,
+CPU-oracle parity across key/measure types, and the transparent fallbacks."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.session import TpuSession
+
+
+def _cpu():
+    return TpuSession({"spark.rapids.sql.enabled": "false"})
+
+
+def _compare(q, approx=True):
+    a = q(TpuSession({})).collect()
+    b = q(_cpu()).collect()
+    ka = sorted(map(repr, ({k: (round(v, 6) if isinstance(v, float)
+                                and not math.isnan(v) else v)
+                            for k, v in r.items()} for r in a)))
+    kb = sorted(map(repr, ({k: (round(v, 6) if isinstance(v, float)
+                                and not math.isnan(v) else v)
+                            for k, v in r.items()} for r in b)))
+    assert ka == kb, (ka[:3], kb[:3])
+    return a
+
+
+def _uses_stage(df) -> bool:
+    return "TpuCompiledAggStage" in df.explain()
+
+
+def test_stage_compiles_string_keys_full_q1_shape():
+    rng = np.random.default_rng(1)
+    n = 20000
+    t = pa.table({
+        "flag": pa.array([None if x % 19 == 0 else f"f{int(x) % 3}"
+                          for x in rng.integers(0, 100, n)]),
+        "qty": rng.normal(size=n) * 10,
+        "price": rng.normal(size=n) * 100,
+        "disc": rng.random(n),
+        "ship": rng.integers(0, 3000, n).astype(np.int32)})
+
+    def q(s):
+        df = s.createDataFrame(t, num_partitions=3)
+        return (df.filter(F.col("ship") <= 2500)
+                .withColumn("dp", F.col("price") * (1 - F.col("disc")))
+                .groupBy("flag")
+                .agg(F.sum(F.col("qty")), F.sum(F.col("dp")),
+                     F.avg(F.col("qty")), F.min(F.col("price")),
+                     F.max(F.col("price")), F.count(F.col("qty"))))
+
+    assert _uses_stage(q(TpuSession({})))
+    _compare(q)
+
+
+def test_stage_int_and_bool_keys_with_nulls():
+    rng = np.random.default_rng(2)
+    n = 5000
+    t = pa.table({
+        "ik": pa.array([None if x % 13 == 0 else int(x)
+                        for x in rng.integers(-20, 20, n)], pa.int64()),
+        "bk": pa.array([None if x % 7 == 0 else bool(x % 2)
+                        for x in rng.integers(0, 100, n)]),
+        "v": rng.normal(size=n)})
+
+    def q(s):
+        return (s.createDataFrame(t, num_partitions=2)
+                .groupBy("ik", "bk")
+                .agg(F.count(F.col("v")), F.sum(F.col("v")),
+                     F.min(F.col("v")), F.max(F.col("v"))))
+
+    assert _uses_stage(q(TpuSession({})))
+    _compare(q)
+
+
+def test_stage_nan_min_max_semantics():
+    t = pa.table({
+        "k": pa.array([1, 1, 1, 2, 2, 3, 3], pa.int32()),
+        "x": pa.array([1.0, float("nan"), 2.0,
+                       float("nan"), float("nan"),
+                       None, 5.0], pa.float64())})
+
+    def q(s):
+        return (s.createDataFrame(t).groupBy("k")
+                .agg(F.min(F.col("x")).alias("mn"),
+                     F.max(F.col("x")).alias("mx")))
+
+    rows = {r["k"]: r for r in _compare(q)}
+    assert rows[1]["mn"] == 1.0 and math.isnan(rows[1]["mx"])
+    assert math.isnan(rows[2]["mn"]) and math.isnan(rows[2]["mx"])
+    assert rows[3]["mn"] == 5.0 and rows[3]["mx"] == 5.0
+
+
+def test_stage_global_agg():
+    rng = np.random.default_rng(3)
+    t = pa.table({"x": rng.normal(size=4000), "f": rng.random(4000)})
+
+    def q(s):
+        return (s.createDataFrame(t, num_partitions=2)
+                .filter(F.col("f") < 0.5)
+                .agg(F.sum(F.col("x") * F.col("f")).alias("r"),
+                     F.count(F.col("x")).alias("c")))
+
+    assert _uses_stage(q(TpuSession({})))
+    _compare(q)
+
+
+def test_stage_empty_input():
+    t = pa.table({"k": pa.array([], pa.int32()),
+                  "v": pa.array([], pa.float64())})
+
+    def qg(s):
+        return s.createDataFrame(t).groupBy("k").agg(F.sum(F.col("v")))
+
+    def qglobal(s):
+        return s.createDataFrame(t).agg(F.count(F.col("v")),
+                                        F.sum(F.col("v")))
+
+    assert _compare(qg) == []
+    rows = _compare(qglobal)
+    assert len(rows) == 1
+
+
+def test_stage_all_null_int_key():
+    t = pa.table({"k": pa.array([None, None, None], pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+
+    def q(s):
+        return s.createDataFrame(t).groupBy("k").agg(F.sum(F.col("v")))
+
+    rows = _compare(q)
+    assert len(rows) == 1 and rows[0]["k"] is None
+
+
+def test_stage_high_cardinality_falls_back():
+    """Key domain beyond maxGroups: general sort-based path answers."""
+    n = 20000
+    t = pa.table({"k": pa.array(range(n), pa.int64()),
+                  "v": pa.array([1.0] * n)})
+
+    def q(s):
+        return s.createDataFrame(t).groupBy("k").agg(F.count(F.col("v")))
+
+    rows = _compare(q)
+    assert len(rows) == n
+
+
+def test_stage_string_measure_not_compiled():
+    """String aggregation inputs are ineligible; plan keeps the general agg."""
+    t = pa.table({"k": pa.array([1, 2], pa.int32()),
+                  "s": pa.array(["a", "b"])})
+    df = (TpuSession({}).createDataFrame(t)
+          .groupBy("k").agg(F.max(F.col("s"))))
+    assert not _uses_stage(df)
+
+
+def test_stage_disabled_by_conf():
+    t = pa.table({"k": pa.array([1, 2], pa.int32()),
+                  "v": pa.array([1.0, 2.0])})
+    df = (TpuSession({"spark.rapids.tpu.agg.compiledStage.enabled": "false"})
+          .createDataFrame(t).groupBy("k").agg(F.sum(F.col("v"))))
+    assert not _uses_stage(df)
+
+
+def test_stage_repeated_runs_reuse_compiled_program():
+    """Process-wide compile cache: re-planning the same query must not grow
+    the cache (re-trace) on every run."""
+    from spark_rapids_tpu.execs import compiled as C
+    rng = np.random.default_rng(5)
+    t = pa.table({"k": rng.integers(0, 10, 2000).astype(np.int32),
+                  "v": rng.normal(size=2000)})
+    s = TpuSession({})
+    df = s.createDataFrame(t).groupBy("k").agg(F.sum(F.col("v")))
+    df.collect()
+    size_after_first = len(C._STAGE_FN_CACHE)
+    for _ in range(3):
+        df.collect()
+    assert len(C._STAGE_FN_CACHE) == size_after_first
+
+
+def test_stage_date_key():
+    import datetime as dt
+    days = [dt.date(2024, 1, 1) + dt.timedelta(days=int(i % 5))
+            for i in range(300)]
+    t = pa.table({"d": pa.array(days, pa.date32()),
+                  "v": pa.array([float(i) for i in range(300)])})
+
+    def q(s):
+        return s.createDataFrame(t).groupBy("d").agg(F.sum(F.col("v")))
+
+    assert _uses_stage(q(TpuSession({})))
+    _compare(q)
+
+
+def test_stage_result_feeds_downstream_sort_limit():
+    """The stage's host-assembled result must be consumable by device execs
+    above it (sort/limit), not just the final collect."""
+    rng = np.random.default_rng(7)
+    t = pa.table({"k": rng.integers(0, 8, 3000).astype(np.int32),
+                  "v": rng.normal(size=3000)})
+
+    def q(s):
+        return (s.createDataFrame(t).groupBy("k")
+                .agg(F.sum(F.col("v")).alias("sv"))
+                .sort(F.col("sv").desc()).limit(3))
+
+    a = [r["k"] for r in q(TpuSession({})).collect()]
+    b = [r["k"] for r in q(_cpu()).collect()]
+    assert a == b

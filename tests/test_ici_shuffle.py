@@ -13,7 +13,8 @@ from spark_rapids_tpu.session import TpuSession
 from spark_rapids_tpu.shuffle.ici import (IciShuffleCatalog,
                                           ShuffleHeartbeatManager)
 
-ICI = {"spark.rapids.shuffle.mode": "ICI"}
+ICI = {"spark.rapids.shuffle.mode": "ICI",
+       "spark.rapids.tpu.agg.compiledStage.enabled": "false"}
 
 
 def _df(s, n=2000, seed=21):

@@ -16,6 +16,9 @@ MESH_CONF = {
     "spark.rapids.tpu.mesh.enabled": "true",
     "spark.sql.shuffle.partitions": "8",
     "spark.sql.autoBroadcastJoinThreshold": "0",
+    # these tests exercise the exchange itself; the compiled agg stage would
+    # bypass it for small-key group-bys
+    "spark.rapids.tpu.agg.compiledStage.enabled": "false",
 }
 
 

@@ -12,7 +12,10 @@ from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_df
 import spark_rapids_tpu.functions as F
 from spark_rapids_tpu.session import TpuSession
 
-TINY_BATCH = {"spark.rapids.sql.batchSizeRows": "257"}
+TINY_BATCH = {"spark.rapids.sql.batchSizeRows": "257",
+              # these tests exercise the general sort/overflow paths the
+              # compiled agg stage would bypass
+              "spark.rapids.tpu.agg.compiledStage.enabled": "false"}
 
 
 def _df(s, n=3000, seed=9):

@@ -25,10 +25,12 @@ def test_operator_metrics_collected():
     _q(s).collect()
     m = s.last_query_metrics()
     joined = " ".join(m.keys())
-    assert "TpuHashAggregateExec" in joined and "TpuFilterExec" in joined
-    agg = next(v for k, v in m.items() if "HashAggregate" in k)
+    assert "TpuCompiledAggStageExec" in joined \
+        or ("TpuHashAggregateExec" in joined and "TpuFilterExec" in joined)
+    agg = next(v for k, v in m.items()
+               if "HashAggregate" in k or "CompiledAggStage" in k)
     assert agg["numOutputRows"] == 11
-    assert "opTime" in agg or "sortTime" in agg  # MODERATE level included
+    assert "opTime" in agg or "sortTime" in agg or "stageTime" in agg  # MODERATE level included
 
 
 def test_metrics_level_filtering():
