@@ -17,11 +17,14 @@ from __future__ import annotations
 import re as _re
 from typing import Optional, Tuple
 
+import jax.numpy as jnp
+import numpy as np
+
 from ..types import BooleanT, DataType, IntegerT, StringT
 from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
 from .base import Expression, _DEFAULT_CTX, combine_validity, make_column
 from .strings import (Contains, EndsWith, StartsWith, _bool_result_from_arrow,
-                      _string_result_from_arrow, _to_arrow_side)
+                      _dev_str, _string_result_from_arrow, _to_arrow_side)
 
 _META = set(".^$*+?()[]{}|\\")
 
@@ -256,9 +259,87 @@ class Like(Expression):
         out.append("$")
         return "".join(out)
 
+    def _segments(self):
+        """Parse the LIKE pattern into %-separated segments of
+        (bytes, wildcard-mask) — `_` positions match any single char."""
+        segs = [[]]
+        p, esc = self.pattern, self.escape
+        i = 0
+        while i < len(p):
+            ch = p[i]
+            if ch == esc and i + 1 < len(p):
+                segs[-1].append((p[i + 1], False))
+                i += 2
+                continue
+            if ch == "%":
+                segs.append([])
+            elif ch == "_":
+                segs[-1].append(("\0", True))
+            else:
+                segs[-1].append((ch, False))
+            i += 1
+        out = []
+        for seg in segs:
+            b = np.array([ord(c) for c, _ in seg], dtype=np.uint8)
+            w = np.array([wild for _, wild in seg], dtype=bool)
+            out.append((b, w))
+        return out
+
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow.compute as pc
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        from ..kernels import strings as SK
+        c = self.children[0].eval_tpu(batch, ctx)
+        if _dev_str(c) and self.pattern.isascii() and SK.is_ascii(c.data):
+            cap = c.capacity
+            starts = c.offsets[:-1]
+            lens = c.offsets[1:] - starts
+            nbytes = int(c.data.shape[0])
+            segs = self._segments()
+            valid = combine_validity(cap, c.validity,
+                                     row_mask(batch.num_rows, cap))
+            if nbytes == 0:
+                ok = jnp.full((cap,), all(len(b) == 0 for b, _ in segs),
+                              jnp.bool_) & (lens == 0) if len(segs) == 1 \
+                    else jnp.full((cap,), all(len(b) == 0 for b, _ in segs),
+                                  jnp.bool_)
+                return make_column(BooleanT, ok, valid, batch.num_rows)
+
+            def hit_at(hit, pos_in_row, seg_len):
+                """hit gathered at per-row byte position (row-relative)."""
+                idx = jnp.clip(starts + pos_in_row, 0, nbytes - 1)
+                ok_pos = (pos_in_row >= 0) & (pos_in_row + seg_len <= lens)
+                return jnp.where(ok_pos, hit[idx], False)
+
+            if len(segs) == 1:
+                b, w = segs[0]
+                if len(b) == 0:
+                    ok = lens == 0
+                else:
+                    hit = SK.match_windows(c.data, c.offsets, b, w)
+                    ok = (lens == len(b)) & hit_at(hit, jnp.zeros_like(lens),
+                                                   len(b))
+                return make_column(BooleanT, ok, valid, batch.num_rows)
+            ok = jnp.ones((cap,), jnp.bool_)
+            cur = jnp.zeros((cap,), jnp.int32)
+            first_b, first_w = segs[0]
+            if len(first_b):
+                hit = SK.match_windows(c.data, c.offsets, first_b, first_w)
+                ok = ok & hit_at(hit, jnp.zeros_like(lens), len(first_b))
+                cur = jnp.full((cap,), len(first_b), jnp.int32)
+            for b, w in segs[1:-1]:
+                if len(b) == 0:
+                    continue
+                pos = SK.first_match(c.data, c.offsets, b, from_pos=cur,
+                                     wildcard=w)
+                ok = ok & (pos >= 0)
+                cur = jnp.where(pos >= 0, pos + len(b), cur)
+            last_b, last_w = segs[-1]
+            if len(last_b):
+                hit = SK.match_windows(c.data, c.offsets, last_b, last_w)
+                tail = lens - len(last_b)
+                ok = ok & (tail >= cur) & hit_at(hit, tail, len(last_b))
+            return make_column(BooleanT, ok, valid, batch.num_rows)
+        arr = _to_arrow_side(c, batch)
         out = pc.match_like(arr, pattern=self.pattern)
         return _bool_result_from_arrow(out, batch)
 

@@ -1,12 +1,15 @@
 """String expressions (reference stringFunctions.scala, 2433 LoC).
 
-TPU strategy (SURVEY.md §7 "Variable-width strings in XLA"): columns are Arrow
-offset+data byte arrays on device. Ops with regular access patterns (length,
-prefix/suffix tests vs a scalar, ASCII case mapping) run as XLA gathers; ragged
-column-vs-column ops run host-side via Arrow for now and are priced as
-host-assisted by the tagging layer (the reference similarly prices ops via
-incompat/typesig notes). Pallas ragged kernels are the planned upgrade path
-(kernels/strings.py).
+TPU strategy (SURVEY.md §7 "Variable-width strings in XLA"): columns live on
+device as Arrow offset+byte arrays, and the hot ops run there as compositions
+of the ragged kernels in kernels/strings.py — byte→row maps, segment
+reductions, and static-capacity ragged gathers. Byte-oriented ops (concat,
+replace, repeat, substring_index, contains/starts/ends) are UTF-8 safe and run
+on device unconditionally; character-oriented ops (substring, pad, locate,
+initcap, reverse, trim, like, case mapping) take the device path when the
+column is pure ASCII (one scalar device reduction gates this — chars == bytes)
+and fall back to the host Arrow path for non-ASCII, the same pricing the
+reference applies to locale-sensitive ops via incompat tags.
 """
 
 from __future__ import annotations
@@ -17,7 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..types import BooleanT, DataType, IntegerT, StringT
-from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from ..columnar.vector import (TpuColumnVector, TpuScalar, bucket_capacity,
+                               row_mask)
+from ..kernels import strings as SK
 from .base import (Expression, UnaryExpression, _DEFAULT_CTX, combine_validity,
                    make_column)
 
@@ -47,6 +52,42 @@ def _string_result_from_arrow(arr, batch):
         from ..columnar.batch import _repad
         col = _repad(col, batch.capacity)
     return col
+
+
+# ---------------------------------------------------------------------------
+# device-path helpers
+# ---------------------------------------------------------------------------
+
+def _dev_str(x) -> bool:
+    """Value has a device string layout the kernels can consume."""
+    return (isinstance(x, TpuColumnVector) and x.offsets is not None
+            and x.host_data is None)
+
+
+def _ascii_dev(x) -> bool:
+    """Device layout AND pure-ASCII bytes (char ops can use byte positions)."""
+    return _dev_str(x) and SK.is_ascii(x.data)
+
+
+def _sl(c: TpuColumnVector):
+    """(starts, byte lengths) over the column's full capacity."""
+    return SK.starts_lengths(c.offsets)
+
+
+def _str_col(batch, data, offsets, validity, template: TpuColumnVector
+             ) -> TpuColumnVector:
+    return TpuColumnVector(StringT, data, validity, batch.num_rows,
+                           offsets=offsets)
+
+
+def _scalar_to_col(x, batch) -> TpuColumnVector:
+    """Materialize a string scalar as a device column at batch capacity."""
+    return TpuColumnVector.from_scalar(x.value, StringT, batch.num_rows,
+                                       capacity=batch.capacity)
+
+
+def _pat_bytes(s: str) -> np.ndarray:
+    return np.frombuffer(s.encode("utf-8"), dtype=np.uint8)
 
 
 def string_compare(cmp_expr, l, r, batch):
@@ -105,8 +146,7 @@ class Upper(UnaryExpression):
         c = self.child.eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
             return TpuScalar(StringT, None if c.value is None else c.value.upper())
-        is_ascii = bool(jnp.all(c.data < 0x80))
-        if is_ascii:
+        if _ascii_dev(c):
             lower = (c.data >= ord('a')) & (c.data <= ord('z'))
             data = jnp.where(lower, c.data - 32, c.data)
             return TpuColumnVector(StringT, data, c.validity, c.num_rows,
@@ -128,8 +168,7 @@ class Lower(UnaryExpression):
         c = self.child.eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
             return TpuScalar(StringT, None if c.value is None else c.value.lower())
-        is_ascii = bool(jnp.all(c.data < 0x80))
-        if is_ascii:
+        if _ascii_dev(c):
             upper = (c.data >= ord('A')) & (c.data <= ord('Z'))
             data = jnp.where(upper, c.data + 32, c.data)
             return TpuColumnVector(StringT, data, c.validity, c.num_rows,
@@ -165,8 +204,8 @@ class StartsWith(_ScalarPatternPredicate):
         c = self.children[0].eval_tpu(batch, ctx)
         pat = self._pattern(ctx)
         cap = batch.capacity
-        if isinstance(c, TpuColumnVector) and pat is not None:
-            pb = np.frombuffer(pat.encode(), dtype=np.uint8)
+        if _dev_str(c) and pat is not None:
+            pb = _pat_bytes(pat)
             plen = len(pb)
             starts = c.offsets[:-1]
             lens = c.offsets[1:] - starts
@@ -204,8 +243,8 @@ class EndsWith(_ScalarPatternPredicate):
         c = self.children[0].eval_tpu(batch, ctx)
         pat = self._pattern(ctx)
         cap = batch.capacity
-        if isinstance(c, TpuColumnVector) and pat is not None:
-            pb = np.frombuffer(pat.encode(), dtype=np.uint8)
+        if _dev_str(c) and pat is not None:
+            pb = _pat_bytes(pat)
             plen = len(pb)
             ends = c.offsets[1:]
             lens = ends - c.offsets[:-1]
@@ -233,10 +272,23 @@ class EndsWith(_ScalarPatternPredicate):
 
 
 class Contains(_ScalarPatternPredicate):
+    """contains(str, literal): device sliding-window match + per-row any
+    (byte matching of well-formed UTF-8 substrings is char-safe)."""
+
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
-        import pyarrow.compute as pc
         c = self.children[0].eval_tpu(batch, ctx)
         pat = self._pattern(ctx)
+        cap = batch.capacity
+        if _dev_str(c) and pat is not None:
+            pb = _pat_bytes(pat)
+            if len(pb) == 0:
+                data = jnp.ones((cap,), jnp.bool_)
+            else:
+                first = SK.first_match(c.data, c.offsets, pb)
+                data = first >= 0
+            valid = combine_validity(cap, c.validity, row_mask(batch.num_rows, cap))
+            return make_column(BooleanT, data, valid, batch.num_rows)
+        import pyarrow.compute as pc
         la = _to_arrow_side(c, batch)
         return _bool_result_from_arrow(pc.match_substring(la, pattern=pat), batch)
 
@@ -249,7 +301,9 @@ class Contains(_ScalarPatternPredicate):
 
 
 class Substring(Expression):
-    """substring(str, pos, len) with Spark 1-based/negative-pos semantics."""
+    """substring(str, pos, len) with Spark 1-based/negative-pos semantics.
+    Device for ASCII columns (chars == bytes): clamp per-row ranges + one
+    ragged gather. Non-ASCII falls back to the host Arrow slice."""
 
     def __init__(self, child: Expression, pos: Expression, length: Expression):
         self.children = (child, pos, length)
@@ -258,41 +312,44 @@ class Substring(Expression):
     def dtype(self) -> DataType:
         return StringT
 
-    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
-        import pyarrow as pa
-        import pyarrow.compute as pc
+    def _literals(self):
         from .base import Literal
-        s = self.children[0].eval_cpu(table, ctx)
         pos = self.children[1].value if isinstance(self.children[1], Literal) else None
         ln = self.children[2].value if isinstance(self.children[2], Literal) else None
+        return pos, ln
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        s = self.children[0].eval_cpu(table, ctx)
+        pos, ln = self._literals()
         if pos is None or ln is None:
             raise NotImplementedError("substring with non-literal pos/len")
-        # Spark: 1-based; pos 0 behaves like 1; negative counts from end
-        if pos > 0:
-            start = pos - 1
-        elif pos == 0:
-            start = 0
-        else:
-            start = pos  # negative: from end
-        stop = None if ln is None else (start + ln if start >= 0 else
-                                        (start + ln if start + ln < 0 else None))
-        if start >= 0:
-            return pc.utf8_slice_codeunits(s, start=start, stop=start + max(ln, 0))
-        out = pc.utf8_slice_codeunits(s, start=start,
-                                      stop=stop if stop is not None else np.iinfo(np.int32).max)
-        return out
+        return self._cpu_on_arrow(s, ctx)
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
-        # host-assisted (ragged); arrow slice then re-upload
         c = self.children[0].eval_tpu(batch, ctx)
-        import pyarrow as pa
+        pos, ln = self._literals()
+        if _ascii_dev(c) and pos is not None and ln is not None:
+            starts, lens = _sl(c)
+            pos_i, ln_i = int(pos), int(ln)
+            if pos_i > 0:
+                s0 = jnp.full_like(lens, pos_i - 1)
+            elif pos_i == 0:
+                s0 = jnp.zeros_like(lens)
+            else:
+                s0 = lens + pos_i
+            e0 = s0 + max(ln_i, 0)
+            s_c = jnp.clip(s0, 0, lens)
+            e_c = jnp.clip(e0, 0, lens)
+            out, offs = SK.build_ranges(c.data, starts + s_c, e_c - s_c,
+                                        int(c.data.shape[0]) or 1)
+            return _str_col(batch, out, offs, c.validity, c)
         arr = _to_arrow_side(c, batch)
         out = self._cpu_on_arrow(arr, ctx)
         return _string_result_from_arrow(out, batch)
 
     def _cpu_on_arrow(self, arr, ctx):
         import pyarrow.compute as pc
-        from .base import Literal
         pos = self.children[1].value
         ln = self.children[2].value
         start = pos - 1 if pos > 0 else (0 if pos == 0 else pos)
@@ -307,7 +364,8 @@ class Substring(Expression):
 
 
 class ConcatStr(Expression):
-    """concat(...) for strings: null if any input null (Spark concat semantics)."""
+    """concat(...) for strings: null if any input null (Spark concat
+    semantics). Device: one multi-source ragged gather (UTF-8 safe)."""
 
     def __init__(self, *children: Expression):
         self.children = tuple(children)
@@ -323,8 +381,28 @@ class ConcatStr(Expression):
                                            null_handling="emit_null")
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        cols = []
+        for v in vals:
+            if isinstance(v, TpuScalar):
+                cols.append(_scalar_to_col(v, batch))
+            else:
+                cols.append(v)
+        if all(_dev_str(c) for c in cols):
+            cap = batch.capacity
+            parts, validity = [], None
+            out_cap = 0
+            for c in cols:
+                starts, lens = _sl(c)
+                parts.append((c.data, starts, lens))
+                out_cap += int(c.data.shape[0])
+                validity = combine_validity(cap, validity, c.validity)
+            out, offs = SK.concat_columns(parts, bucket_capacity(out_cap))
+            valid = combine_validity(cap, validity,
+                                     row_mask(batch.num_rows, cap))
+            return _str_col(batch, out, offs, valid, cols[0])
         import pyarrow.compute as pc
-        args = [_to_arrow_side(c.eval_tpu(batch, ctx), batch) for c in self.children]
+        args = [_to_arrow_side(v, batch) for v in vals]
         out = pc.binary_join_element_wise(*args, "", null_handling="emit_null")
         return _string_result_from_arrow(out, batch)
 
@@ -332,10 +410,15 @@ class ConcatStr(Expression):
         return f"concat({', '.join(c.pretty() for c in self.children)})"
 
 
-class _HostStringUnary(UnaryExpression):
-    """Host-assisted unary string op via arrow compute."""
+class _TrimBase(UnaryExpression):
+    """trim family: per-row first/last non-whitespace via segment min/max,
+    then one ragged gather. ASCII device path; unicode whitespace via host."""
 
+    trim_left = True
+    trim_right = True
     _pc_fn = ""
+    # ASCII whitespace, matching Arrow's trim_whitespace on ASCII input
+    _WS = np.array([9, 10, 11, 12, 13, 32], dtype=np.uint8)
 
     @property
     def dtype(self) -> DataType:
@@ -349,6 +432,30 @@ class _HostStringUnary(UnaryExpression):
             v = getattr(pc, self._pc_fn)(pa.array([c.value]))[0].as_py() \
                 if c.value is not None else None
             return TpuScalar(StringT, v)
+        if _ascii_dev(c):
+            starts, lens = _sl(c)
+            nbytes = int(c.data.shape[0])
+            if nbytes == 0:
+                return c
+            is_ws = jnp.isin(c.data, jnp.asarray(self._WS))
+            rows = SK.byte_rows(c.offsets, nbytes)
+            pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - c.offsets[rows]
+            n = int(starts.shape[0])
+            nonws_pos = jnp.where(~is_ws, pos_in_row, SK._BIG)
+            first = SK.segment_min(nonws_pos, rows, n)
+            last = SK.segment_max(jnp.where(~is_ws, pos_in_row, -1), rows, n)
+            has = last >= 0
+            if self.trim_left:
+                lead = jnp.where(has, first, lens)  # all-ws → empty
+            else:
+                lead = jnp.zeros_like(lens)
+            if self.trim_right:
+                end = jnp.where(has, last + 1, lead)
+            else:
+                end = lens
+            out, offs = SK.build_ranges(c.data, starts + lead, end - lead,
+                                        nbytes)
+            return _str_col(batch, out, offs, c.validity, c)
         return _string_result_from_arrow(getattr(pc, self._pc_fn)(c.to_arrow()),
                                          batch)
 
@@ -360,28 +467,80 @@ class _HostStringUnary(UnaryExpression):
         return f"{type(self).__name__.lower()}({self.child.pretty()})"
 
 
-class Trim(_HostStringUnary):
+class Trim(_TrimBase):
     _pc_fn = "utf8_trim_whitespace"
 
 
-class LTrim(_HostStringUnary):
+class LTrim(_TrimBase):
+    trim_right = False
     _pc_fn = "utf8_ltrim_whitespace"
 
 
-class RTrim(_HostStringUnary):
+class RTrim(_TrimBase):
+    trim_left = False
     _pc_fn = "utf8_rtrim_whitespace"
 
 
-class Reverse(_HostStringUnary):
-    _pc_fn = "utf8_reverse"
+class Reverse(UnaryExpression):
+    """reverse(str): ASCII device via a stride(-1) ragged gather; unicode
+    (char-level reversal) via host."""
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(StringT, None if c.value is None else c.value[::-1])
+        if _ascii_dev(c):
+            starts, lens = _sl(c)
+            stride = jnp.full_like(starts, -1)
+            out, offs = SK.build_ranges(c.data, starts + lens - 1, lens,
+                                        int(c.data.shape[0]) or 1,
+                                        stride=stride)
+            return _str_col(batch, out, offs, c.validity, c)
+        return _string_result_from_arrow(pc.utf8_reverse(c.to_arrow()), batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        return pc.utf8_reverse(self.child.eval_cpu(table, ctx))
+
+    def pretty(self) -> str:
+        return f"reverse({self.child.pretty()})"
 
 
-class InitCap(_HostStringUnary):
-    """Spark initcap: capitalize first letter of each whitespace-separated word."""
+class InitCap(UnaryExpression):
+    """Spark initcap: capitalize first letter of each space-separated word,
+    lowercase the rest. ASCII device: word-start mask + case map."""
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
         c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            v = None if c.value is None else self._initcap_list([c.value])[0]
+            return TpuScalar(StringT, v)
+        if _ascii_dev(c):
+            nbytes = int(c.data.shape[0])
+            if nbytes == 0:
+                return c
+            # offsets == nbytes (empty/padding rows) fall out of range and drop
+            row_start = jnp.zeros((nbytes,), jnp.bool_).at[
+                c.offsets[:-1]].set(True, mode="drop")
+            prev = jnp.concatenate([jnp.zeros((1,), c.data.dtype), c.data[:-1]])
+            word_start = row_start | (prev == 32)
+            b = c.data
+            is_lower = (b >= ord('a')) & (b <= ord('z'))
+            is_upper = (b >= ord('A')) & (b <= ord('Z'))
+            out = jnp.where(word_start & is_lower, b - 32,
+                            jnp.where(~word_start & is_upper, b + 32, b))
+            return TpuColumnVector(StringT, out, c.validity, c.num_rows,
+                                   offsets=c.offsets)
         arr = _to_arrow_side(c, batch)
         out = pa.array(self._initcap_list(arr.to_pylist()), pa.string())
         return _string_result_from_arrow(out, batch)
@@ -402,8 +561,13 @@ class InitCap(_HostStringUnary):
                                 for w in v.split(" ")))
         return out
 
+    def pretty(self) -> str:
+        return f"initcap({self.child.pretty()})"
+
 
 class StringRepeat(Expression):
+    """repeat(str, n): device byte tiling (UTF-8 safe)."""
+
     def __init__(self, child: Expression, times: Expression):
         self.children = (child, times)
 
@@ -411,19 +575,31 @@ class StringRepeat(Expression):
     def dtype(self) -> DataType:
         return StringT
 
+    def _times(self):
+        from .base import Literal
+        t = self.children[1]
+        return t.value if isinstance(t, Literal) else None
+
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa
-        from .base import Literal
         vals = self.children[0].eval_cpu(table, ctx).to_pylist()
-        n = self.children[1].value if isinstance(self.children[1], Literal) else 1
+        n = self._times()
+        n = 1 if n is None else n
         return pa.array([None if v is None else v * max(int(n), 0)
                          for v in vals], pa.string())
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
-        from .base import Literal
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
-        n = self.children[1].value if isinstance(self.children[1], Literal) else 1
+        c = self.children[0].eval_tpu(batch, ctx)
+        n = self._times()
+        if _dev_str(c) and n is not None:
+            n = max(int(n), 0)
+            starts, lens = _sl(c)
+            out_cap = bucket_capacity(int(c.data.shape[0]) * max(n, 1))
+            out, offs = SK.build_repeat(c.data, starts, lens, n, out_cap)
+            return _str_col(batch, out, offs, c.validity, c)
+        arr = _to_arrow_side(c, batch)
+        n = 1 if n is None else n
         out = pa.array([None if v is None else v * max(int(n), 0)
                         for v in arr.to_pylist()], pa.string())
         return _string_result_from_arrow(out, batch)
@@ -433,7 +609,9 @@ class StringRepeat(Expression):
 
 
 class StringReplace(Expression):
-    """replace(str, search, replace) — literal replacement."""
+    """replace(str, search, replace) — literal replacement. Device: greedy
+    non-overlapping window matches + contribution-scatter rebuild (UTF-8 safe:
+    byte matching of well-formed UTF-8 is char-aligned)."""
 
     def __init__(self, child: Expression, search: Expression, replace: Expression):
         self.children = (child, search, replace)
@@ -450,14 +628,41 @@ class StringReplace(Expression):
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow.compute as pc
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        c = self.children[0].eval_tpu(batch, ctx)
         s, r = self._args()
+        if _dev_str(c) and s is not None:
+            if s == "":
+                return c  # Spark: empty search leaves the string unchanged
+            sb, rb = _pat_bytes(s), _pat_bytes(r)
+            nbytes = int(c.data.shape[0])
+            if nbytes == 0:
+                return c
+            taken = SK.greedy_matches(c.data, c.offsets, sb)
+            # bytes covered by a taken match window
+            delta = jnp.zeros((nbytes + 1,), jnp.int32)
+            pos = jnp.arange(nbytes, dtype=jnp.int32)
+            delta = delta.at[jnp.where(taken, pos, nbytes)].add(1, mode="drop")
+            delta = delta.at[jnp.where(taken, pos + len(sb),
+                                       nbytes)].add(-1, mode="drop")
+            covered = jnp.cumsum(delta[:-1]) > 0
+            if len(rb) <= len(sb):
+                out_cap = nbytes
+            else:
+                out_cap = bucket_capacity(
+                    (nbytes // len(sb)) * len(rb) + nbytes)
+            out, offs = SK.build_from_contributions(
+                c.data, ~covered, c.offsets, out_cap,
+                replace_at=taken, replacement=rb)
+            return _str_col(batch, out, offs, c.validity, c)
+        arr = _to_arrow_side(c, batch)
         out = pc.replace_substring(arr, pattern=s, replacement=r)
         return _string_result_from_arrow(out, batch)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow.compute as pc
         s, r = self._args()
+        if s == "":
+            return self.children[0].eval_cpu(table, ctx)
         return pc.replace_substring(self.children[0].eval_cpu(table, ctx),
                                     pattern=s, replacement=r)
 
@@ -467,7 +672,8 @@ class StringReplace(Expression):
 
 
 class StringLocate(Expression):
-    """locate(substr, str[, pos]) — 1-based, 0 when absent (instr = pos 1)."""
+    """locate(substr, str[, pos]) — 1-based, 0 when absent (instr = pos 1).
+    ASCII device via first_match; non-ASCII host (char positions)."""
 
     def __init__(self, substr: Expression, child: Expression,
                  pos: Optional[Expression] = None):
@@ -503,8 +709,25 @@ class StringLocate(Expression):
         from .base import Literal
         from ..columnar.batch import _repad
         subs = self.children[0].value if isinstance(self.children[0], Literal) else None
-        arr = _to_arrow_side(self.children[1].eval_tpu(batch, ctx), batch)
+        c = self.children[1].eval_tpu(batch, ctx)
         start = self.children[2].value if isinstance(self.children[2], Literal) else 1
+        cap = batch.capacity
+        if _ascii_dev(c) and subs is not None and subs.isascii():
+            valid = combine_validity(cap, c.validity,
+                                     row_mask(batch.num_rows, cap))
+            if start < 1:
+                data = jnp.zeros((cap,), jnp.int32)
+            elif subs == "":
+                # python find("", k): k when k <= len else -1
+                _, lens = _sl(c)
+                data = jnp.where(start - 1 <= lens, start, 0).astype(jnp.int32)
+            else:
+                from_pos = jnp.full((c.capacity,), start - 1, jnp.int32)
+                first = SK.first_match(c.data, c.offsets, _pat_bytes(subs),
+                                       from_pos=from_pos)
+                data = first + 1
+            return make_column(IntegerT, data, valid, batch.num_rows)
+        arr = _to_arrow_side(c, batch)
         out = pa.array(self._compute_list(subs, arr.to_pylist(), start), pa.int32())
         col = TpuColumnVector.from_arrow(out)
         if col.capacity != batch.capacity:
@@ -525,6 +748,12 @@ class _PadBase(Expression):
     def dtype(self) -> DataType:
         return StringT
 
+    def _literals(self):
+        from .base import Literal
+        n = self.children[1].value if isinstance(self.children[1], Literal) else None
+        pad = self.children[2].value if isinstance(self.children[2], Literal) else None
+        return n, pad
+
     def _compute_list(self, vals, n, pad):
         out = []
         for v in vals:
@@ -541,17 +770,31 @@ class _PadBase(Expression):
 
     def _eval(self, arr, ctx):
         import pyarrow as pa
-        from .base import Literal
-        n = self.children[1].value if isinstance(self.children[1], Literal) else 0
-        pad = self.children[2].value if isinstance(self.children[2], Literal) else " "
-        return pa.array(self._compute_list(arr.to_pylist(), int(n), pad),
+        n, pad = self._literals()
+        n = 0 if n is None else int(n)
+        pad = " " if pad is None else pad
+        return pa.array(self._compute_list(arr.to_pylist(), n, pad),
                         pa.string())
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         return self._eval(self.children[0].eval_cpu(table, ctx), ctx)
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        c = self.children[0].eval_tpu(batch, ctx)
+        n, pad = self._literals()
+        if (_ascii_dev(c) and n is not None and pad is not None
+                and pad.isascii()):
+            n = max(int(n), 0)
+            starts, lens = _sl(c)
+            out_cap = bucket_capacity(max(int(c.data.shape[0]),
+                                          int(c.capacity) * n))
+            out, offs = SK.build_pad(c.data, starts, lens, n,
+                                     _pat_bytes(pad), self.left_side, out_cap,
+                                     active=row_mask(batch.num_rows,
+                                                     c.capacity))
+            # Spark: null rows stay null; pad fills even empty non-null rows
+            return _str_col(batch, out, offs, c.validity, c)
+        arr = _to_arrow_side(c, batch)
         return _string_result_from_arrow(self._eval(arr, ctx), batch)
 
 
@@ -564,7 +807,8 @@ class RPad(_PadBase):
 
 
 class StringTranslate(Expression):
-    """translate(str, from, to) — per-char mapping (reference GpuTranslate)."""
+    """translate(str, from, to) — per-char mapping (reference GpuTranslate).
+    ASCII device: a 256-entry LUT + contribution rebuild (deletions shrink)."""
 
     def __init__(self, child: Expression, from_str: Expression, to_str: Expression):
         self.children = (child, from_str, to_str)
@@ -601,7 +845,27 @@ class StringTranslate(Expression):
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        c = self.children[0].eval_tpu(batch, ctx)
+        m = self._table()
+        table_ascii = all(ord(k) < 128 and (v is None or (len(v) == 1 and ord(v) < 128))
+                          for k, v in m.items())
+        if _ascii_dev(c) and table_ascii:
+            nbytes = int(c.data.shape[0])
+            if nbytes == 0:
+                return c
+            lut = np.arange(256, dtype=np.uint8)
+            drop = np.zeros(256, dtype=bool)
+            for k, v in m.items():
+                if v is None:
+                    drop[ord(k)] = True
+                else:
+                    lut[ord(k)] = ord(v)
+            mapped = jnp.asarray(lut)[c.data]
+            keep = ~jnp.asarray(drop)[c.data]
+            out, offs = SK.build_from_contributions(c.data, keep, c.offsets,
+                                                    nbytes, mapped=mapped)
+            return _str_col(batch, out, offs, c.validity, c)
+        arr = _to_arrow_side(c, batch)
         out = pa.array(self._compute_list(arr.to_pylist()), pa.string())
         return _string_result_from_arrow(out, batch)
 
@@ -664,7 +928,8 @@ class _HostRowOp(Expression):
 
 class ConcatWs(Expression):
     """concat_ws(sep, cols...): skips nulls; array<string> args are flattened;
-    null only when sep is null (reference GpuConcatWs)."""
+    null only when sep is null (reference GpuConcatWs). Device when sep is a
+    literal and all args are plain string columns."""
 
     def __init__(self, sep: Expression, *cols: Expression):
         self.children = (sep,) + tuple(cols)
@@ -699,14 +964,52 @@ class ConcatWs(Expression):
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
+        from .base import Literal
         from ..columnar.vector import TpuScalar
+        from ..types import StringType
+        sep_e = self.children[0]
+        sep = sep_e.value if isinstance(sep_e, Literal) else None
+        args = self.children[1:]
+        vals = None
+        if (sep is not None and args
+                and all(isinstance(a.dtype, StringType) for a in args)):
+            vals = [a.eval_tpu(batch, ctx) for a in args]
+            cols = [(_scalar_to_col(v, batch) if isinstance(v, TpuScalar)
+                     else v) for v in vals]
+            if all(_dev_str(c) for c in cols):
+                cap = batch.capacity
+                sep_b = _pat_bytes(sep)
+                parts, emits, seps = [], [], []
+                any_before = jnp.zeros((cap,), jnp.bool_)
+                out_cap = 0
+                logical = row_mask(batch.num_rows, cap)
+                for i, c in enumerate(cols):
+                    starts, lens = _sl(c)
+                    parts.append((c.data, starts, lens))
+                    nonnull = (c.validity if c.validity is not None else
+                               jnp.ones((cap,), jnp.bool_)) & logical
+                    emits.append(nonnull)
+                    if i == 0:
+                        seps.append(None)
+                    else:
+                        seps.append((sep_b, nonnull & any_before))
+                    any_before = any_before | nonnull
+                    out_cap += int(c.data.shape[0]) + len(sep_b) * int(cap)
+                out, offs = SK.concat_columns(parts, bucket_capacity(out_cap),
+                                              part_emit=emits, seps=seps)
+                valid = combine_validity(cap, None,
+                                         row_mask(batch.num_rows, cap))
+                return _str_col(batch, out, offs, valid, cols[0])
         n = batch.num_rows
-        ins = []
-        for c in self.children:
-            v = c.eval_tpu(batch, ctx)
+        sep_v = sep_e.eval_tpu(batch, ctx)
+        ins = [[sep_v.value] * n if isinstance(sep_v, TpuScalar)
+               else sep_v.to_arrow().to_pylist()]
+        if vals is None:  # device gate failed before evaluating the args
+            vals = [a.eval_tpu(batch, ctx) for a in args]
+        for v in vals:
             ins.append([v.value] * n if isinstance(v, TpuScalar)
                        else v.to_arrow().to_pylist())
-        out = pa.array([self._join(vals[0], vals[1:]) for vals in zip(*ins)],
+        out = pa.array([self._join(r[0], r[1:]) for r in zip(*ins)],
                        type=pa.string())
         return _string_result_from_arrow(out, batch)
 
@@ -754,8 +1057,9 @@ class StringSplit(_HostRowOp):
         return f"split({self.children[0].pretty()}, {self.children[1].pretty()})"
 
 
-class SubstringIndex(_HostRowOp):
-    """substring_index(str, delim, count) (reference GpuSubstringIndex)."""
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) (reference GpuSubstringIndex).
+    Device via nth-match ranking (UTF-8 safe byte matching)."""
 
     def __init__(self, child: Expression, delim: Expression, count: Expression):
         self.children = (child, delim, count)
@@ -764,7 +1068,13 @@ class SubstringIndex(_HostRowOp):
     def dtype(self) -> DataType:
         return StringT
 
-    def _row(self, s, delim, count, ctx):
+    def _literals(self):
+        from .base import Literal
+        d = self.children[1].value if isinstance(self.children[1], Literal) else None
+        cnt = self.children[2].value if isinstance(self.children[2], Literal) else None
+        return d, cnt
+
+    def _row(self, s, delim, count):
         if s is None or delim is None or count is None:
             return None
         if delim == "" or count == 0:
@@ -773,6 +1083,47 @@ class SubstringIndex(_HostRowOp):
         if count > 0:
             return delim.join(parts[:count])
         return delim.join(parts[count:])
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        d, cnt = self._literals()
+        return pa.array([self._row(v, d, cnt) for v in vals], pa.string())
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        c = self.children[0].eval_tpu(batch, ctx)
+        d, cnt = self._literals()
+        if _dev_str(c) and d is not None and cnt is not None:
+            starts, lens = _sl(c)
+            nbytes = int(c.data.shape[0]) or 1
+            if d == "" or cnt == 0:
+                out, offs = SK.build_ranges(c.data, starts,
+                                            jnp.zeros_like(lens), nbytes)
+                return _str_col(batch, out, offs, c.validity, c)
+            db = _pat_bytes(d)
+            cnt = int(cnt)
+            # Spark splits on non-overlapping occurrences; split() semantics
+            # and greedy left-to-right agree for counting here
+            if cnt > 0:
+                pos = SK.nth_match(c.data, c.offsets, db, cnt)
+                new_start = starts
+                new_len = jnp.where(pos >= 0, pos, lens)
+            else:
+                pos = SK.nth_match(c.data, c.offsets, db, cnt)
+                s0 = jnp.where(pos >= 0, pos + len(db), 0)
+                new_start = starts + s0
+                new_len = lens - s0
+            out, offs = SK.build_ranges(c.data, new_start, new_len, nbytes)
+            return _str_col(batch, out, offs, c.validity, c)
+        arr = _to_arrow_side(c, batch)
+        out = pa.array([self._row(v, d, cnt) for v in arr.to_pylist()],
+                       pa.string())
+        return _string_result_from_arrow(out, batch)
+
+    def pretty(self) -> str:
+        cs = self.children
+        return f"substring_index({cs[0].pretty()}, {cs[1].pretty()}, {cs[2].pretty()})"
 
 
 class OctetLength(UnaryExpression):

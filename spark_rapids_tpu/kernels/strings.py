@@ -1,0 +1,378 @@
+"""Device string kernels over the Arrow offsets+bytes layout.
+
+The reference implements its string surface as hand-written CUDA over cuDF's
+string columns (stringFunctions.scala, 2433 LoC; cudf strings/ kernels). The
+TPU-native formulation (SURVEY.md §7 "Variable-width strings in XLA") keeps the
+same physical layout — int32 offsets + a flat uint8 byte buffer, both resident
+in HBM — and expresses every op as a composition of three XLA-friendly pieces:
+
+  1. a byte→row map (`searchsorted` over the offsets),
+  2. segment reductions over that map (first/last/any/count per row),
+  3. one ragged gather that materializes the output byte buffer from
+     per-row (start, length) ranges — with a *static* output capacity bound
+     computed host-side, so XLA never sees a dynamic shape.
+
+Everything here is pure jax on fixed shapes: no host hop, no per-row Python.
+Ops with character (not byte) semantics take the ASCII fast path on device and
+leave non-ASCII to the caller's host fallback — the same pricing the reference
+applies via incompat tags for locale-sensitive ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIG = np.int32(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# layout helpers
+# ---------------------------------------------------------------------------
+
+def starts_lengths(offsets: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row (start, byte-length) from an offsets vector."""
+    starts = offsets[:-1]
+    return starts, offsets[1:] - starts
+
+
+def byte_rows(offsets: jax.Array, nbytes: int) -> jax.Array:
+    """Row index of every byte position in [0, nbytes). Bytes past the last
+    offset map to the last row (callers mask with `in-range` tests)."""
+    return jnp.searchsorted(offsets[1:], jnp.arange(nbytes, dtype=jnp.int32),
+                            side="right").astype(jnp.int32)
+
+
+def is_ascii(data: jax.Array) -> bool:
+    """Host-synced scalar: True when every byte is ASCII. One scalar D→H
+    transfer gates the device fast path (chars == bytes)."""
+    if int(data.shape[0]) == 0:
+        return True
+    return bool(jnp.all(data < 0x80))
+
+
+def segment_min(values: jax.Array, rows: jax.Array, n: int,
+                init=_BIG) -> jax.Array:
+    return jnp.full((n,), init, values.dtype).at[rows].min(values, mode="drop")
+
+
+def segment_max(values: jax.Array, rows: jax.Array, n: int,
+                init=np.int32(-1)) -> jax.Array:
+    return jnp.full((n,), init, values.dtype).at[rows].max(values, mode="drop")
+
+
+def segment_sum(values: jax.Array, rows: jax.Array, n: int) -> jax.Array:
+    return jnp.zeros((n,), values.dtype).at[rows].add(values, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# the ragged output builder
+# ---------------------------------------------------------------------------
+
+def build_ranges(data: jax.Array, starts: jax.Array, lengths: jax.Array,
+                 out_cap: int, stride: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Materialize a new string column whose row i is the byte range
+    data[starts[i] : starts[i] + lengths[i]] (negative lengths clamp to 0).
+
+    `out_cap` is the static output byte capacity — callers bound it host-side
+    (e.g. substring output never exceeds input capacity). `stride`, when given,
+    replaces the unit step: output byte k of row i reads
+    data[starts[i] + k*stride[i]] (stride -1 + start at row end = reverse).
+
+    Returns (out_bytes[out_cap], new_offsets[n+1]).
+    """
+    n = int(starts.shape[0])
+    nbytes = int(data.shape[0])
+    lengths = jnp.maximum(lengths, 0).astype(jnp.int32)
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(lengths, dtype=jnp.int32)])
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, max(n - 1, 0))
+    pos = j - new_offs[row_c]
+    step = stride[row_c] if stride is not None else 1
+    src = starts[row_c] + pos * step
+    in_range = j < new_offs[n]
+    if nbytes == 0:
+        return jnp.zeros((out_cap,), jnp.uint8), new_offs
+    out = jnp.where(in_range, data[jnp.clip(src, 0, nbytes - 1)],
+                    jnp.uint8(0))
+    return out, new_offs
+
+
+def build_from_contributions(data: jax.Array, keep: jax.Array,
+                             offsets: jax.Array, out_cap: int,
+                             replace_at: Optional[jax.Array] = None,
+                             replacement: Optional[np.ndarray] = None,
+                             mapped: Optional[jax.Array] = None
+                             ) -> Tuple[jax.Array, jax.Array]:
+    """Per-input-byte output construction: input byte j emits
+      * `replacement` (len r static) when replace_at[j] (a taken match start),
+      * nothing when not keep[j],
+      * else the single byte mapped[j] (defaults to data[j]).
+
+    This is the translate/replace/delete builder: output position of byte j is
+    the exclusive cumsum of per-byte emit counts; scatter resolves the rest.
+    Returns (out_bytes[out_cap], new_offsets[n+1]).
+    """
+    n = int(offsets.shape[0]) - 1
+    nbytes = int(data.shape[0])
+    rlen = 0 if replacement is None else int(replacement.shape[0])
+    contrib = keep.astype(jnp.int32)
+    if replace_at is not None:
+        contrib = jnp.where(replace_at, jnp.int32(rlen), contrib)
+    cum = jnp.cumsum(contrib, dtype=jnp.int32)
+    out_pos = cum - contrib  # exclusive
+    rows = byte_rows(offsets, nbytes)
+    new_lens = segment_sum(contrib, rows, n)
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(new_lens, dtype=jnp.int32)])
+    src = data if mapped is None else mapped
+    out = jnp.zeros((out_cap,), jnp.uint8)
+    plain = keep & ((replace_at == False) if replace_at is not None  # noqa: E712
+                    else jnp.ones_like(keep))
+    idx = jnp.where(plain, out_pos, out_cap)  # out-of-range drops
+    out = out.at[idx].set(src.astype(jnp.uint8), mode="drop")
+    if replace_at is not None and rlen:
+        for k in range(rlen):
+            idx_k = jnp.where(replace_at, out_pos + k, out_cap)
+            out = out.at[idx_k].set(jnp.uint8(replacement[k]), mode="drop")
+    return out, new_offs
+
+
+def concat_columns(parts: Sequence[Tuple[jax.Array, jax.Array, jax.Array]],
+                   out_cap: int,
+                   part_emit: Optional[Sequence[jax.Array]] = None,
+                   seps: Optional[Sequence[Tuple[np.ndarray, jax.Array]]] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Row-wise concatenation of K string columns.
+
+    parts: per column (data, starts, lengths). part_emit: per column a bool[n]
+    — rows where the column contributes nothing (concat_ws null-skip). seps:
+    optional per-column (sep_bytes, emit_sep bool[n]) PREPENDED before that
+    column's bytes when emit_sep (concat_ws separators between non-null parts).
+    Returns (out_bytes[out_cap], new_offsets[n+1]).
+    """
+    n = int(parts[0][1].shape[0])
+    k = len(parts)
+    eff_lens = []
+    for i, (_, _, ln) in enumerate(parts):
+        ln = jnp.maximum(ln, 0)
+        if part_emit is not None:
+            ln = jnp.where(part_emit[i], ln, 0)
+        if seps is not None and seps[i] is not None:
+            sep_b, emit = seps[i]
+            ln = ln + jnp.where(emit, np.int32(len(sep_b)), 0)
+        eff_lens.append(ln.astype(jnp.int32))
+    total = sum(eff_lens)
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(total, dtype=jnp.int32)])
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, max(n - 1, 0))
+    pos = j - new_offs[row_c]
+    out = jnp.zeros((out_cap,), jnp.uint8)
+    cum = jnp.zeros((n,), jnp.int32)
+    for i, (data, st, ln) in enumerate(parts):
+        ln = jnp.maximum(ln, 0)
+        if part_emit is not None:
+            ln = jnp.where(part_emit[i], ln, 0)
+        if seps is not None and seps[i] is not None:
+            sep_b, emit = seps[i]
+            slen = jnp.where(emit, np.int32(len(sep_b)), 0)
+            sel = (pos >= cum[row_c]) & (pos < cum[row_c] + slen[row_c])
+            sp = jnp.clip(pos - cum[row_c], 0, max(len(sep_b) - 1, 0))
+            sep_arr = jnp.asarray(sep_b, jnp.uint8) if len(sep_b) else \
+                jnp.zeros((1,), jnp.uint8)
+            out = jnp.where(sel & (j < new_offs[n]), sep_arr[sp], out)
+            cum = cum + slen
+        nb = int(data.shape[0])
+        sel = (pos >= cum[row_c]) & (pos < cum[row_c] + ln[row_c])
+        src = st[row_c] + pos - cum[row_c]
+        if nb:
+            out = jnp.where(sel & (j < new_offs[n]),
+                            data[jnp.clip(src, 0, nb - 1)], out)
+        cum = cum + ln
+    return out, new_offs
+
+
+# ---------------------------------------------------------------------------
+# pattern search
+# ---------------------------------------------------------------------------
+
+def match_windows(data: jax.Array, offsets: jax.Array,
+                  pattern: np.ndarray,
+                  wildcard: Optional[np.ndarray] = None) -> jax.Array:
+    """bool[nbytes]: position j starts a full in-row match of `pattern`
+    (static bytes). `wildcard` marks pattern bytes that match any byte
+    (LIKE `_`). Empty patterns match everywhere."""
+    nbytes = int(data.shape[0])
+    plen = int(pattern.shape[0])
+    if nbytes == 0:
+        return jnp.zeros((0,), jnp.bool_)
+    if plen == 0:
+        return jnp.ones((nbytes,), jnp.bool_)
+    j = jnp.arange(nbytes, dtype=jnp.int32)
+    idx = j[:, None] + jnp.arange(plen, dtype=jnp.int32)[None, :]
+    window = data[jnp.clip(idx, 0, nbytes - 1)]
+    eq = window == jnp.asarray(pattern, jnp.uint8)[None, :]
+    if wildcard is not None and wildcard.any():
+        eq = eq | jnp.asarray(wildcard, jnp.bool_)[None, :]
+    hit = jnp.all(eq, axis=1)
+    # window must stay inside the row: byte j and j+plen-1 share a row
+    rows = byte_rows(offsets, nbytes)
+    row_end = offsets[rows + 1]
+    return hit & (j + plen <= row_end)
+
+
+def first_match(data: jax.Array, offsets: jax.Array, pattern: np.ndarray,
+                from_pos: Optional[jax.Array] = None,
+                wildcard: Optional[np.ndarray] = None) -> jax.Array:
+    """int32[n]: byte position *within the row* of the first match of
+    `pattern`, or -1. `from_pos` (int32[n]) restricts to positions >= it."""
+    n = int(offsets.shape[0]) - 1
+    nbytes = int(data.shape[0])
+    if nbytes == 0 or n == 0:
+        return jnp.full((n,), -1, jnp.int32)
+    hit = match_windows(data, offsets, pattern, wildcard)
+    rows = byte_rows(offsets, nbytes)
+    pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - offsets[rows]
+    ok = hit
+    if from_pos is not None:
+        ok = ok & (pos_in_row >= from_pos[rows])
+    cand = jnp.where(ok, pos_in_row, _BIG)
+    first = segment_min(cand, rows, n)
+    return jnp.where(first == _BIG, -1, first)
+
+
+def nth_match(data: jax.Array, offsets: jax.Array, pattern: np.ndarray,
+              nth: int) -> jax.Array:
+    """int32[n]: in-row byte position of the nth (1-based) *non-overlapping
+    left-to-right* match (split() semantics), or -1. Negative nth counts from
+    the end (-1 = last match)."""
+    n = int(offsets.shape[0]) - 1
+    nbytes = int(data.shape[0])
+    if nbytes == 0 or n == 0:
+        return jnp.full((n,), -1, jnp.int32)
+    hit = greedy_matches(data, offsets, pattern)
+    rows = byte_rows(offsets, nbytes)
+    pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - offsets[rows]
+    hits_i = hit.astype(jnp.int32)
+    # rank of each hit within its row (1-based): global cumsum minus the
+    # cumsum just before the row start
+    gcum = jnp.cumsum(hits_i, dtype=jnp.int32)
+    row_base = jnp.concatenate([jnp.zeros((1,), jnp.int32), gcum])[offsets[:-1]]
+    rank = gcum - row_base[rows]
+    if nth >= 0:
+        want = jnp.full((n,), nth, jnp.int32)
+    else:
+        total = segment_sum(hits_i, rows, n)
+        want = total + (nth + 1)
+    sel = hit & (rank == want[rows])
+    cand = jnp.where(sel, pos_in_row, _BIG)
+    first = segment_min(cand, rows, n)
+    return jnp.where(first == _BIG, -1, first)
+
+
+def greedy_matches(data: jax.Array, offsets: jax.Array,
+                   pattern: np.ndarray) -> jax.Array:
+    """bool[nbytes]: left-to-right non-overlapping ("greedy") match starts —
+    the semantics of replace(). When the pattern cannot overlap itself (no
+    proper border, the common case) every window match is taken and this is
+    pure vector code; self-overlapping patterns resolve the overlap chains
+    with an O(nbytes) `lax.scan` that stays on device."""
+    plen = int(pattern.shape[0])
+    hit = match_windows(data, offsets, pattern)
+    if plen <= 1:
+        return hit
+    # self-overlap check (host, on the static pattern): proper border exists?
+    p = pattern.tobytes()
+    self_overlaps = any(p[:k] == p[-k:] for k in range(1, plen))
+    if not self_overlaps:
+        return hit
+    nbytes = int(data.shape[0])
+    if nbytes == 0:
+        return hit
+    rows = byte_rows(offsets, nbytes)
+    row_start = offsets[rows]
+
+    def step(carry, x):
+        allowed, cur_row = carry
+        h, j, r, rs = x
+        allowed = jnp.where(r != cur_row, rs, allowed)
+        take = h & (j >= allowed)
+        allowed = jnp.where(take, j + plen, allowed)
+        return (allowed, r), take
+
+    xs = (hit, jnp.arange(nbytes, dtype=jnp.int32), rows, row_start)
+    (_, _), taken = jax.lax.scan(step, (jnp.int32(0), jnp.int32(-1)), xs)
+    return taken
+
+
+def build_repeat(data: jax.Array, starts: jax.Array, lengths: jax.Array,
+                 times: int, out_cap: int) -> Tuple[jax.Array, jax.Array]:
+    """repeat(str, times): row i becomes its bytes tiled `times` times.
+    Byte-level tiling is UTF-8 safe. Returns (out_bytes, new_offsets)."""
+    n = int(starts.shape[0])
+    nbytes = int(data.shape[0])
+    lengths = jnp.maximum(lengths, 0).astype(jnp.int32)
+    times = max(int(times), 0)
+    new_lens = lengths * times
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(new_lens, dtype=jnp.int32)])
+    if nbytes == 0 or times == 0:
+        return jnp.zeros((out_cap,), jnp.uint8), new_offs
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, max(n - 1, 0))
+    pos = j - new_offs[row_c]
+    src = starts[row_c] + pos % jnp.maximum(lengths[row_c], 1)
+    out = jnp.where(j < new_offs[n], data[jnp.clip(src, 0, nbytes - 1)],
+                    jnp.uint8(0))
+    return out, new_offs
+
+
+def build_pad(data: jax.Array, starts: jax.Array, lengths: jax.Array,
+              target: int, pad: np.ndarray, left: bool, out_cap: int,
+              active: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """lpad/rpad to `target` chars with literal `pad` (ASCII caller-gated:
+    chars == bytes). Spark semantics: longer inputs truncate to target; empty
+    pad leaves short inputs unchanged. `active` (bool[n]) limits padding to
+    logical rows so batch-capacity padding rows stay empty.
+    Returns (out_bytes, new_offsets)."""
+    n = int(starts.shape[0])
+    nbytes = int(data.shape[0])
+    plen = int(pad.shape[0])
+    target = max(int(target), 0)
+    lengths = jnp.maximum(lengths, 0).astype(jnp.int32)
+    if plen == 0:
+        new_lens = jnp.minimum(lengths, target)
+        return build_ranges(data, starts, new_lens, out_cap)
+    new_lens = jnp.full((n,), target, jnp.int32)
+    if active is not None:
+        new_lens = jnp.where(active, new_lens, 0)
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(new_lens, dtype=jnp.int32)])
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, max(n - 1, 0))
+    pos = j - new_offs[row_c]
+    ln = lengths[row_c]
+    fill = jnp.maximum(target - ln, 0)
+    pad_arr = jnp.asarray(pad, jnp.uint8)
+    if left:
+        from_pad = pos < fill
+        src = starts[row_c] + pos - fill
+        pad_pos = pos % plen
+    else:
+        from_pad = pos >= jnp.minimum(ln, target)
+        src = starts[row_c] + pos
+        pad_pos = jnp.maximum(pos - ln, 0) % plen
+    byte = pad_arr[pad_pos]
+    if nbytes:
+        byte = jnp.where(from_pad, byte, data[jnp.clip(src, 0, nbytes - 1)])
+    out = jnp.where(j < new_offs[n], byte, jnp.uint8(0))
+    return out, new_offs

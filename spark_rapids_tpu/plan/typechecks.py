@@ -119,21 +119,29 @@ def _register_builtin_exprs() -> None:
                   incompat="non-ASCII handled via host path")
     register_expr(S.StartsWith, TypeSigs.BOOLEAN, "prefix test")
     register_expr(S.EndsWith, TypeSigs.BOOLEAN, "suffix test")
-    register_expr(S.Contains, TypeSigs.BOOLEAN, "substring test",
-                  host_assisted=True)
-    register_expr(S.Substring, TypeSigs.STRING, "substring", host_assisted=True)
-    register_expr(S.ConcatStr, TypeSigs.STRING, "string concat",
-                  host_assisted=True)
-    for cls in (S.Trim, S.LTrim, S.RTrim, S.Reverse, S.InitCap, S.StringRepeat,
-                S.StringReplace, S.LPad, S.RPad, S.StringTranslate):
+    register_expr(S.Contains, TypeSigs.BOOLEAN,
+                  "substring test (device window match)",
+                  incompat="non-literal pattern via host path")
+    register_expr(S.Substring, TypeSigs.STRING, "substring (device ragged gather)",
+                  incompat="non-ASCII / non-literal pos via host path")
+    register_expr(S.ConcatStr, TypeSigs.STRING,
+                  "string concat (device multi-source gather)")
+    for cls in (S.StringRepeat, S.StringReplace, S.SubstringIndex):
         register_expr(cls, TypeSigs.STRING,
-                      f"string fn {cls.__name__.lower()}", host_assisted=True)
-    register_expr(S.StringLocate, TypeSigs.integral, "locate/instr",
-                  host_assisted=True)
-    register_expr(S.ConcatWs, TypeSigs.STRING, "concat_ws", host_assisted=True)
+                      f"string fn {cls.__name__.lower()} (device, UTF-8 safe)",
+                      incompat="non-literal arguments via host path")
+    for cls in (S.Trim, S.LTrim, S.RTrim, S.Reverse, S.InitCap, S.LPad,
+                S.RPad, S.StringTranslate):
+        register_expr(cls, TypeSigs.STRING,
+                      f"string fn {cls.__name__.lower()} (device)",
+                      incompat="non-ASCII handled via host path")
+    register_expr(S.StringLocate, TypeSigs.integral,
+                  "locate/instr (device first-match)",
+                  incompat="non-ASCII handled via host path")
+    register_expr(S.ConcatWs, TypeSigs.STRING,
+                  "concat_ws (device)",
+                  incompat="array args / non-literal separator via host path")
     register_expr(S.StringSplit, TypeSigs.nested_common, "split to array",
-                  host_assisted=True)
-    register_expr(S.SubstringIndex, TypeSigs.STRING, "substring_index",
                   host_assisted=True)
     register_expr(S.OctetLength, TypeSigs.integral,
                   "byte length (device offsets math)")
@@ -159,7 +167,9 @@ def _register_builtin_exprs() -> None:
                   host_assisted=True)
     register_expr(RX.RegexpExtract, TypeSigs.STRING, "regex extract",
                   host_assisted=True)
-    register_expr(RX.Like, TypeSigs.BOOLEAN, "SQL LIKE", host_assisted=True)
+    register_expr(RX.Like, TypeSigs.BOOLEAN,
+                  "SQL LIKE (device segment matcher)",
+                  incompat="non-ASCII handled via host path")
     register_expr(RX.RegexpExtractAll, TypeSigs.nested_common,
                   "regexp_extract_all", host_assisted=True)
 
