@@ -415,14 +415,37 @@ class ArrayPosition(BinaryExpression):
         return LongT
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
-        import pyarrow as pa
         col = _eval_list(self.left, batch, ctx)
         val = self.right.eval_tpu(batch, ctx)
-        lists = col.to_pylist()
-        vals = [val.value] * len(lists) if isinstance(val, TpuScalar) \
-            else val.to_pylist()
-        return _result_from_pylist(
-            [_position_one(l, v) for l, v in zip(lists, vals)], LongT, batch)
+        elem_t = self.left.dtype.element_type
+        cap = batch.capacity
+        if (not is_fixed_width(elem_t) or col.child is None
+                or col.host_data is not None or not isinstance(val, TpuScalar)):
+            lists = col.to_pylist()
+            vals = [val.value] * len(lists) if isinstance(val, TpuScalar) \
+                else val.to_pylist()
+            return _result_from_pylist(
+                [_position_one(l, v) for l, v in zip(lists, vals)], LongT, batch)
+        if val.value is None:
+            return TpuScalar(LongT, None)
+        seg, in_data = _segments(col)
+        elem = col.child.data
+        if _is_float(elem_t) and isinstance(val.value, float) \
+                and math.isnan(val.value):
+            match = jnp.isnan(elem)
+        else:
+            match = elem == jnp.asarray(val.value, elem.dtype)
+        ev = col.child.validity
+        hit = match & in_data & (ev if ev is not None else True)
+        pos_in_row = (jnp.arange(col.child.capacity, dtype=jnp.int32)
+                      - col.offsets[seg])
+        big = jnp.int32(2**31 - 1)
+        first = jnp.full((col.capacity,), big, jnp.int32).at[
+            jnp.where(in_data, seg, col.capacity)].min(
+            jnp.where(hit, pos_in_row, big), mode="drop")
+        data = jnp.where(first == big, 0, first + 1).astype(jnp.int64)
+        valid = _list_validity(col, batch)
+        return make_column(LongT, data, valid, col.num_rows)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa
@@ -621,8 +644,15 @@ class _HostListOp(Expression):
         raise NotImplementedError
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        return self._host_from_vals(vals, batch)
+
+    def _host_from_vals(self, vals, batch):
+        """Host combine over ALREADY-evaluated child values — device-path
+        guards fall back here so child expressions never run twice."""
         n = batch.num_rows
-        cols = [_pylist_of(None, batch, ctx, c, n) for c in self.children]
+        cols = [[v.value] * n if isinstance(v, TpuScalar) else v.to_pylist()[:n]
+                for v in vals]
         out = [self._combine(*[col[i] for col in cols]) for i in range(n)]
         return _result_from_pylist(out, self.dtype, batch)
 
@@ -640,6 +670,267 @@ class _HostListOp(Expression):
     def pretty(self) -> str:
         name = type(self).__name__
         return f"{name}({', '.join(c.pretty() for c in self.children)})"
+
+
+# ---------------------------------------------------------------------------
+# device list machinery (shares the ragged gather_plan with kernels/strings:
+# a list column is offsets + a flat fixed-width child, exactly a string column
+# with wider "bytes" — reference cuDF LIST kernels, collectionOperations.scala)
+# ---------------------------------------------------------------------------
+
+def _fixed_list(col) -> bool:
+    """List column whose flat child is fixed-width device-resident data."""
+    return (isinstance(col, TpuColumnVector) and col.child is not None
+            and col.host_data is None and col.child.host_data is None
+            and col.child.child is None and is_fixed_width(col.child.dtype))
+
+
+def _list_from_plan(col, starts, lengths, out_cap, validity, num_rows,
+                    stride=None, dtype=None):
+    """Ragged gather over a list column's flat child → new list column.
+    One scalar D→H sync fixes the new child's logical element count."""
+    from ..kernels.strings import gather_plan
+    child = col.child
+    src, in_range, new_offs = gather_plan(starts, lengths, out_cap,
+                                          stride=stride)
+    ecap = max(int(child.capacity), 1)
+    src_c = jnp.clip(src, 0, ecap - 1)
+    data = jnp.where(in_range, child.data[src_c],
+                     jnp.zeros((), child.data.dtype))
+    cv = None
+    if child.validity is not None:
+        cv = jnp.where(in_range, child.validity[src_c], False)
+    n_elems = int(new_offs[num_rows])
+    new_child = TpuColumnVector(child.dtype, data, cv, n_elems)
+    return TpuColumnVector(dtype or col.dtype, data, validity, num_rows,
+                           offsets=new_offs, child=new_child)
+
+
+def _int_operand(x, cap, dtype=jnp.int32):
+    """Evaluated int operand → (int array over capacity, validity) or
+    (None, None) when it is a null scalar."""
+    if isinstance(x, TpuScalar):
+        if x.value is None:
+            return None, None
+        return jnp.full((cap,), int(x.value), dtype), None
+    return x.data.astype(dtype), x.validity
+
+
+def _all_null_list(dtype, batch):
+    return TpuColumnVector.from_scalar(None, dtype, batch.num_rows,
+                                       capacity=batch.capacity)
+
+
+def _expand_list(v, batch):
+    """Already-evaluated list value → column (scalars expand, no re-eval)."""
+    if isinstance(v, TpuScalar):
+        return TpuColumnVector.from_scalar(v.value, v.dtype, batch.num_rows,
+                                           capacity=batch.capacity)
+    return v
+
+
+def _elem_sort_keys(child: TpuColumnVector):
+    """Total-order integer sort keys for fixed-width element data. Floats use
+    the IEEE bit trick with -0.0→0.0 and canonical-NaN normalization, giving
+    Spark's ordering (NaN greatest) AND SQL equality (NaN==NaN, -0.0==0.0) as
+    plain integer comparison — one key serves sort, dedup, and membership."""
+    v = child.data
+    if _is_float(child.dtype):
+        v = jnp.where(v == 0, jnp.zeros((), v.dtype), v)
+        v = jnp.where(jnp.isnan(v), jnp.full((), jnp.nan, v.dtype), v)
+        ity = jnp.int32 if v.dtype == jnp.float32 else jnp.int64
+        bits = jax.lax.bitcast_convert_type(v, ity)
+        imin = jnp.iinfo(ity).min
+        key = jnp.where(bits >= 0, bits, ~bits + imin)
+        return key
+    if isinstance(child.dtype, BooleanType):
+        return v.astype(jnp.int32)
+    return v
+
+
+def _ragged_sort_perm(col, ascending: bool):
+    """Permutation that sorts each row's elements in place (rows keep their
+    offset ranges; ascending puts nulls first, descending last — Spark
+    sort_array). Works because the flat layout is already segment-contiguous:
+    a stable sort with segment as primary key leaves row boundaries fixed."""
+    child = col.child
+    seg, in_data = _segments(col)
+    cap = col.capacity
+    key = _elem_sort_keys(child)
+    cv = child.validity
+    valid_e = cv if cv is not None else jnp.ones((child.capacity,), jnp.bool_)
+    key = jnp.where(valid_e, key, 0)
+    if ascending:
+        nrank = jnp.where(valid_e, 0, -1)
+    else:
+        nrank = jnp.where(valid_e, 0, 1)
+        key = ~key
+    seg_key = jnp.where(in_data, seg, cap)
+    return jnp.lexsort((key, nrank, seg_key))
+
+
+def _distinct_keep(col):
+    """bool[elem_cap]: element is the first occurrence of its value within its
+    row (nulls form one group; key normalization makes NaN/-0.0 collapse).
+    Original order is preserved by ranking candidates by position."""
+    child = col.child
+    seg, in_data = _segments(col)
+    cap = col.capacity
+    ecap = int(child.capacity)
+    key = _elem_sort_keys(child)
+    cv = child.validity
+    valid_e = cv if cv is not None else jnp.ones((ecap,), jnp.bool_)
+    key = jnp.where(valid_e, key, 0)
+    nullg = (~valid_e).astype(jnp.int32)
+    seg_key = jnp.where(in_data, seg, cap)
+    pos = jnp.arange(ecap, dtype=jnp.int32)
+    perm = jnp.lexsort((pos, key, nullg, seg_key))
+    s_seg, s_key, s_null = seg_key[perm], key[perm], nullg[perm]
+    prev_ne = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                               (s_seg[1:] != s_seg[:-1])
+                               | (s_key[1:] != s_key[:-1])
+                               | (s_null[1:] != s_null[:-1])])
+    keep = jnp.zeros((ecap,), jnp.bool_).at[perm].set(prev_ne)
+    return keep & in_data
+
+
+def _compact_list(col, keep, validity, num_rows, dtype):
+    """Rebuild a list column keeping flagged elements in original order."""
+    child = col.child
+    ecap = int(child.capacity)
+    seg, in_data = _segments(col)
+    cap = col.capacity
+    keep_i = keep.astype(jnp.int32)
+    new_lens = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(in_data, seg, cap)].add(keep_i, mode="drop")
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(new_lens, dtype=jnp.int32)])
+    out_pos = jnp.cumsum(keep_i) - keep_i
+    idx = jnp.where(keep, out_pos, ecap)
+    data = jnp.zeros((ecap,), child.data.dtype).at[idx].set(
+        child.data, mode="drop")
+    cv = None
+    if child.validity is not None:
+        cv = jnp.zeros((ecap,), jnp.bool_).at[idx].set(
+            child.validity, mode="drop")
+    n_elems = int(new_offs[num_rows])
+    new_child = TpuColumnVector(child.dtype, data, cv, n_elems)
+    return TpuColumnVector(dtype, data, validity, num_rows,
+                           offsets=new_offs, child=new_child)
+
+
+def _member_in(a_col, b_col):
+    """bool[a_elem_cap]: a's element value appears among b's NON-NULL elements
+    of the same row. Vectorized per-row binary search over b sorted in place
+    (nulls ranked last so each row's search range is its non-null prefix)."""
+    a_child, b_child = a_col.child, b_col.child
+    cap = a_col.capacity
+    # sort b ascending with nulls ranked last, so each row's search range is
+    # its non-null prefix
+    b_valid = b_child.validity if b_child.validity is not None else \
+        jnp.ones((b_child.capacity,), jnp.bool_)
+    b_key = jnp.where(b_valid, _elem_sort_keys(b_child), 0)
+    b_seg, b_in = _segments(b_col)
+    nrank = jnp.where(b_valid, 0, 1)  # nulls last within each row
+    perm = jnp.lexsort((b_key, nrank, jnp.where(b_in, b_seg, b_col.capacity)))
+    sorted_bkey = b_key[perm]
+    b_nulls = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(b_in, b_seg, cap)].add((~b_valid).astype(jnp.int32),
+                                         mode="drop")
+    a_key = _elem_sort_keys(a_child)
+    a_seg, a_in = _segments(a_col)
+    a_seg_c = jnp.clip(a_seg, 0, cap - 1)
+    lo = b_col.offsets[:-1][a_seg_c].astype(jnp.int32)
+    hi = (b_col.offsets[1:][a_seg_c] - b_nulls[a_seg_c]).astype(jnp.int32)
+    hi0 = hi
+    ecap_b = max(int(b_child.capacity), 1)
+    steps = max(int(ecap_b).bit_length(), 1)
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        active = lo < hi
+        go = sorted_bkey[jnp.clip(mid, 0, ecap_b - 1)] < a_key
+        lo, hi = (jnp.where(active & go, mid + 1, lo),
+                  jnp.where(active & ~go, mid, hi))
+    found = (lo < hi0) & (sorted_bkey[jnp.clip(lo, 0, ecap_b - 1)] == a_key)
+    return found & a_in
+
+
+def _seg_any(flags, col):
+    """Per-row OR of an element-level bool vector."""
+    seg, in_data = _segments(col)
+    cap = col.capacity
+    cnt = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(in_data, seg, cap)].add(flags.astype(jnp.int32), mode="drop")
+    return cnt > 0
+
+
+def _intersect_except_eval(op, batch, ctx, invert: bool):
+    """Shared device body of array_intersect (invert=False: keep a-elements
+    present in b) and array_except (invert=True: keep those absent). Null
+    element kept when b's null-presence matches the same polarity."""
+    vals = [c.eval_tpu(batch, ctx) for c in op.children]
+    a = _expand_list(vals[0], batch)
+    b = _expand_list(vals[1], batch)
+    if not (_fixed_list(a) and _fixed_list(b)
+            and a.child.data.dtype == b.child.data.dtype):
+        return op._host_from_vals(vals, batch)
+    cap = batch.capacity
+    a_valid_e = a.child.validity if a.child.validity is not None else \
+        jnp.ones((a.child.capacity,), jnp.bool_)
+    b_valid_e = b.child.validity if b.child.validity is not None else \
+        jnp.ones((b.child.capacity,), jnp.bool_)
+    member = _member_in(a, b)
+    b_has_null = _seg_any(~b_valid_e, b)
+    a_seg, _ = _segments(a)
+    a_seg_c = jnp.clip(a_seg, 0, cap - 1)
+    keep = _distinct_keep(a) & jnp.where(
+        a_valid_e, member ^ invert, b_has_null[a_seg_c] ^ invert)
+    valid = combine_validity(cap, _list_validity(a, batch),
+                             _list_validity(b, batch))
+    return _compact_list(a, keep, valid, batch.num_rows, op.dtype)
+
+
+def _concat_list_cols(cols, batch, dtype):
+    """Device row-wise concatenation of K list columns, or None when any
+    column lacks the fixed-width device layout."""
+    if not cols or not all(_fixed_list(c) for c in cols) or \
+            len({c.child.data.dtype for c in cols}) != 1:
+        return None
+    cap = batch.capacity
+    part_lens = [jnp.maximum(_lengths(c), 0) for c in cols]
+    total = sum(part_lens)
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(total, dtype=jnp.int32)])
+    out_cap = bucket_capacity(sum(max(int(c.child.capacity), 1)
+                                  for c in cols))
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, cap - 1)
+    pos = j - new_offs[row_c]
+    in_range = j < new_offs[cap]
+    dt = cols[0].child.data.dtype
+    data = jnp.zeros((out_cap,), dt)
+    eval_out = jnp.ones((out_cap,), jnp.bool_)
+    has_ev = any(c.child.validity is not None for c in cols)
+    cum = jnp.zeros((cap,), jnp.int32)
+    validity = None
+    for c, ln in zip(cols, part_lens):
+        sel = in_range & (pos >= cum[row_c]) & (pos < cum[row_c] + ln[row_c])
+        src = jnp.clip(c.offsets[:-1][row_c] + pos - cum[row_c], 0,
+                       max(int(c.child.capacity), 1) - 1)
+        data = jnp.where(sel, c.child.data[src], data)
+        if has_ev:
+            cv = c.child.validity if c.child.validity is not None else \
+                jnp.ones((int(c.child.capacity),), jnp.bool_)
+            eval_out = jnp.where(sel, cv[src], eval_out)
+        cum = cum + ln
+        validity = combine_validity(cap, validity, c.validity)
+    valid = combine_validity(cap, validity, row_mask(batch.num_rows, cap))
+    n_elems = int(new_offs[batch.num_rows])
+    new_child = TpuColumnVector(cols[0].child.dtype, data,
+                                eval_out if has_ev else None, n_elems)
+    return TpuColumnVector(dtype, data, valid, batch.num_rows,
+                           offsets=new_offs, child=new_child)
 
 
 class SortArray(_HostListOp):
@@ -661,6 +952,21 @@ class SortArray(_HostListOp):
                           key=_sort_key, reverse=not asc)
         nulls = [None] * (len(lst) - len(non_null))
         return nulls + non_null if asc else non_null + nulls
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        asc_e = self.children[1]
+        asc = asc_e.value if isinstance(asc_e, Literal) else None
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        col = _expand_list(vals[0], batch)
+        if asc is None or not _fixed_list(col):
+            return self._host_from_vals(vals, batch)
+        child = col.child
+        perm = _ragged_sort_perm(col, bool(asc))
+        data = child.data[perm]
+        cv = child.validity[perm] if child.validity is not None else None
+        new_child = TpuColumnVector(child.dtype, data, cv, child.num_rows)
+        return TpuColumnVector(self.dtype, data, col.validity, col.num_rows,
+                               offsets=col.offsets, child=new_child)
 
 
 def _sort_key(v):
@@ -684,6 +990,14 @@ class ArrayDistinct(_HostListOp):
         if lst is None:
             return None
         return _dedupe(lst, keep_null=True)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        col = _expand_list(vals[0], batch)
+        if not _fixed_list(col):
+            return self._host_from_vals(vals, batch)
+        keep = _distinct_keep(col)
+        return _compact_list(col, keep, col.validity, col.num_rows, self.dtype)
 
 
 def _canon(e):
@@ -720,6 +1034,16 @@ class ArrayUnion(_HostListOp):
             return None
         return _dedupe(list(a) + list(b), keep_null=True)
 
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        cols = [_expand_list(v, batch) for v in vals]
+        cat = _concat_list_cols(cols, batch, self.dtype)
+        if cat is None:
+            return self._host_from_vals(vals, batch)
+        keep = _distinct_keep(cat)
+        return _compact_list(cat, keep, cat.validity, batch.num_rows,
+                             self.dtype)
+
 
 class ArrayIntersect(_HostListOp):
     def __init__(self, l: Expression, r: Expression):
@@ -742,6 +1066,9 @@ class ArrayIntersect(_HostListOp):
             elif _canon(e) in bset:
                 out.append(e)
         return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return _intersect_except_eval(self, batch, ctx, invert=False)
 
 
 class ArrayExcept(_HostListOp):
@@ -766,6 +1093,9 @@ class ArrayExcept(_HostListOp):
                 out.append(e)
         return out
 
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return _intersect_except_eval(self, batch, ctx, invert=True)
+
 
 class ArraysOverlap(_HostListOp):
     def __init__(self, l: Expression, r: Expression):
@@ -787,6 +1117,30 @@ class ArraysOverlap(_HostListOp):
             return None
         return False
 
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        a = _expand_list(vals[0], batch)
+        b = _expand_list(vals[1], batch)
+        if not (_fixed_list(a) and _fixed_list(b)
+                and a.child.data.dtype == b.child.data.dtype):
+            return self._host_from_vals(vals, batch)
+        cap = batch.capacity
+        a_valid_e = a.child.validity if a.child.validity is not None else \
+            jnp.ones((a.child.capacity,), jnp.bool_)
+        b_valid_e = b.child.validity if b.child.validity is not None else \
+            jnp.ones((b.child.capacity,), jnp.bool_)
+        member = _member_in(a, b) & a_valid_e
+        overlap = _seg_any(member, a)
+        a_has_null = _seg_any(~a_valid_e, a)
+        b_has_null = _seg_any(~b_valid_e, b)
+        a_len = _lengths(a)
+        b_len = _lengths(b)
+        unknown = (~overlap) & ((a_has_null & (b_len > 0))
+                                | (b_has_null & (a_len > 0)))
+        valid = combine_validity(cap, _list_validity(a, batch),
+                                 _list_validity(b, batch), ~unknown)
+        return make_column(BooleanT, overlap, valid, batch.num_rows)
+
 
 class ArrayRepeat(_HostListOp):
     def __init__(self, elem: Expression, count: Expression):
@@ -800,6 +1154,38 @@ class ArrayRepeat(_HostListOp):
         if cnt is None:
             return None
         return [e] * max(0, int(cnt))
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        elem_t = self.children[0].dtype
+        if not is_fixed_width(elem_t):
+            return super().eval_tpu(batch, ctx)
+        cap = batch.capacity
+        ev = self.children[0].eval_tpu(batch, ctx)
+        if isinstance(ev, TpuScalar):
+            from .base import to_column
+            ev = to_column(ev, batch, elem_t)
+        cnt_arr, cnt_val = _int_operand(self.children[1].eval_tpu(batch, ctx),
+                                        cap)
+        if cnt_arr is None:
+            return _all_null_list(self.dtype, batch)
+        valid = combine_validity(cap, cnt_val, row_mask(batch.num_rows, cap))
+        act = valid if valid is not None else row_mask(batch.num_rows, cap)
+        lens = jnp.where(act, jnp.maximum(cnt_arr, 0), 0)
+        out_cap = bucket_capacity(max(int(jnp.sum(lens)), 1))
+        new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                    jnp.cumsum(lens, dtype=jnp.int32)])
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+        row_c = jnp.clip(row, 0, cap - 1)
+        in_range = j < new_offs[cap]
+        data = jnp.where(in_range, ev.data[row_c], jnp.zeros((), ev.data.dtype))
+        ev_valid = None
+        if ev.validity is not None:
+            ev_valid = jnp.where(in_range, ev.validity[row_c], False)
+        n_elems = int(new_offs[batch.num_rows])
+        child = TpuColumnVector(elem_t, data, ev_valid, n_elems)
+        return TpuColumnVector(self.dtype, data, valid, batch.num_rows,
+                               offsets=new_offs, child=child)
 
 
 class Slice(_HostListOp):
@@ -824,6 +1210,33 @@ class Slice(_HostListOp):
             return []
         return lst[i:i + length]
 
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        col = _expand_list(vals[0], batch)
+        if not _fixed_list(col):
+            return self._host_from_vals(vals, batch)
+        cap = batch.capacity
+        s_arr, s_val = _int_operand(vals[1], cap)
+        l_arr, l_val = _int_operand(vals[2], cap)
+        if s_arr is None or l_arr is None:
+            return _all_null_list(self.dtype, batch)
+        lens = _lengths(col)
+        valid = combine_validity(cap, _list_validity(col, batch), s_val, l_val)
+        act = valid if valid is not None else row_mask(col.num_rows, cap)
+        if bool(jnp.any(act & (s_arr == 0))):
+            raise ExpressionError("Unexpected value for start in slice: 0")
+        bad_len = act & (l_arr < 0)
+        if bool(jnp.any(bad_len)):
+            v = int(jnp.min(jnp.where(bad_len, l_arr, 0)))
+            raise ExpressionError(f"Unexpected value for length in slice: {v}")
+        i = jnp.where(s_arr > 0, s_arr - 1, lens + s_arr)
+        i_c = jnp.clip(i, 0, lens)
+        new_len = jnp.where(i < 0, 0,
+                            jnp.minimum(jnp.maximum(l_arr, 0), lens - i_c))
+        return _list_from_plan(col, col.offsets[:-1] + i_c, new_len,
+                               max(int(col.child.capacity), 1), valid,
+                               col.num_rows)
+
 
 class ConcatArrays(_HostListOp):
     """concat(a1, a2, ...) for array inputs (strings use ConcatStr)."""
@@ -841,6 +1254,13 @@ class ConcatArrays(_HostListOp):
             if l is None:
                 return None
             out.extend(l)
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cols = [_eval_list(c, batch, ctx) for c in self.children]
+        out = _concat_list_cols(cols, batch, self.dtype)
+        if out is None:
+            return super().eval_tpu(batch, ctx)
         return out
 
 
@@ -861,6 +1281,34 @@ class Flatten(_HostListOp):
                 return None
             out.extend(inner)
         return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        col = _expand_list(vals[0], batch)
+        inner = col.child if isinstance(col, TpuColumnVector) else None
+        if (inner is None or inner.child is None or col.host_data is not None
+                or inner.host_data is not None):
+            return self._host_from_vals(vals, batch)
+        cap = batch.capacity
+        # offset composition: new row i spans inner rows [O[i], O[i+1]) whose
+        # elements are [I[O[i]], I[O[i+1]]) — one gather, child shared as-is
+        m = int(inner.offsets.shape[0]) - 1
+        new_offs = inner.offsets[jnp.clip(col.offsets, 0, m)]
+        valid = _list_validity(col, batch)
+        if inner.validity is not None:
+            # Spark: any null inner array → whole row null
+            icap = inner.capacity
+            irows = jnp.searchsorted(col.offsets[1:],
+                                     jnp.arange(icap, dtype=jnp.int32),
+                                     side="right").astype(jnp.int32)
+            in_data = jnp.arange(icap) < col.offsets[cap]
+            nulls = jnp.zeros((cap,), jnp.int32).at[
+                jnp.where(in_data, irows, cap)].add(
+                (~inner.validity).astype(jnp.int32), mode="drop")
+            valid = combine_validity(cap, valid, nulls == 0)
+        return TpuColumnVector(self.dtype, inner.child.data, valid,
+                               col.num_rows, offsets=new_offs,
+                               child=inner.child)
 
 
 class ArrayJoin(_HostListOp):
@@ -910,6 +1358,52 @@ class Sequence(_HostListOp):
         out = list(range(int(start), int(stop) + (1 if s > 0 else -1), int(s)))
         return out
 
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..types import IntegerType, LongType, ShortType, ByteType
+        elem = self.children[0].dtype
+        if not isinstance(elem, (IntegerType, LongType, ShortType, ByteType)):
+            return super().eval_tpu(batch, ctx)
+        cap = batch.capacity
+        raw = [c.eval_tpu(batch, ctx) for c in self.children]
+        # arithmetic runs in the element carrier (int64 for bigint — an int32
+        # intermediate would truncate values and wrap the range computation)
+        wide = jnp.int64 if np.dtype(elem.np_dtype).itemsize >= 8 else jnp.int32
+        vals = [_int_operand(v, cap, dtype=wide) for v in raw]
+        if any(a is None for a, _ in vals):
+            return _all_null_list(self.dtype, batch)
+        s_arr, s_val = vals[0]
+        e_arr, e_val = vals[1]
+        if len(vals) > 2:
+            st_arr, st_val = vals[2]
+        else:
+            st_arr = jnp.where(e_arr >= s_arr, 1, -1).astype(wide)
+            st_val = None
+        valid = combine_validity(cap, s_val, e_val, st_val,
+                                 row_mask(batch.num_rows, cap))
+        act = valid if valid is not None else row_mask(batch.num_rows, cap)
+        if bool(jnp.any(act & (st_arr == 0))):
+            raise ExpressionError("sequence step must not be zero")
+        st_safe = jnp.where(st_arr == 0, 1, st_arr)
+        diff = e_arr - s_arr
+        empty = jnp.sign(diff) * jnp.sign(st_safe) < 0
+        lens = jnp.where(act & ~empty, diff // st_safe + 1, 0).astype(jnp.int32)
+        out_cap = bucket_capacity(max(int(jnp.sum(lens)), 1))
+        new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                    jnp.cumsum(lens, dtype=jnp.int32)])
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+        row_c = jnp.clip(row, 0, cap - 1)
+        pos = j - new_offs[row_c]
+        in_range = j < new_offs[cap]
+        carrier = elem.np_dtype
+        data = jnp.where(in_range,
+                         (s_arr[row_c] + pos.astype(wide) * st_arr[row_c]),
+                         0).astype(carrier)
+        n_elems = int(new_offs[batch.num_rows])
+        child = TpuColumnVector(elem, data, None, n_elems)
+        return TpuColumnVector(self.dtype, data, valid, batch.num_rows,
+                               offsets=new_offs, child=child)
+
 
 class ArrayReverse(_HostListOp):
     def __init__(self, child: Expression):
@@ -921,6 +1415,17 @@ class ArrayReverse(_HostListOp):
 
     def _combine(self, lst):
         return None if lst is None else list(reversed(lst))
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        col = _expand_list(vals[0], batch)
+        if not _fixed_list(col):
+            return self._host_from_vals(vals, batch)
+        lens = _lengths(col)
+        stride = jnp.full((col.capacity,), -1, jnp.int32)
+        return _list_from_plan(col, col.offsets[:-1] + lens - 1, lens,
+                               max(int(col.child.capacity), 1),
+                               col.validity, col.num_rows, stride=stride)
 
 
 class ArraysZip(_HostListOp):

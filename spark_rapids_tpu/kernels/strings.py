@@ -72,6 +72,27 @@ def segment_sum(values: jax.Array, rows: jax.Array, n: int) -> jax.Array:
 # the ragged output builder
 # ---------------------------------------------------------------------------
 
+def gather_plan(starts: jax.Array, lengths: jax.Array, out_cap: int,
+                stride: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The shared ragged-gather index computation (strings AND list columns):
+    output slot j of row i reads source index starts[i] + k*stride[i] where k
+    is j's position within the row. Returns (src_idx[out_cap],
+    in_range[out_cap], new_offsets[n+1]); callers gather data/validity with
+    the same plan."""
+    n = int(starts.shape[0])
+    lengths = jnp.maximum(lengths, 0).astype(jnp.int32)
+    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(lengths, dtype=jnp.int32)])
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, max(n - 1, 0))
+    pos = j - new_offs[row_c]
+    step = stride[row_c] if stride is not None else 1
+    src = starts[row_c] + pos * step
+    return src, j < new_offs[n], new_offs
+
+
 def build_ranges(data: jax.Array, starts: jax.Array, lengths: jax.Array,
                  out_cap: int, stride: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
     """Materialize a new string column whose row i is the byte range
@@ -84,18 +105,9 @@ def build_ranges(data: jax.Array, starts: jax.Array, lengths: jax.Array,
 
     Returns (out_bytes[out_cap], new_offsets[n+1]).
     """
-    n = int(starts.shape[0])
     nbytes = int(data.shape[0])
-    lengths = jnp.maximum(lengths, 0).astype(jnp.int32)
-    new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                jnp.cumsum(lengths, dtype=jnp.int32)])
-    j = jnp.arange(out_cap, dtype=jnp.int32)
-    row = jnp.searchsorted(new_offs[1:], j, side="right").astype(jnp.int32)
-    row_c = jnp.clip(row, 0, max(n - 1, 0))
-    pos = j - new_offs[row_c]
-    step = stride[row_c] if stride is not None else 1
-    src = starts[row_c] + pos * step
-    in_range = j < new_offs[n]
+    src, in_range, new_offs = gather_plan(starts, lengths, out_cap,
+                                          stride=stride)
     if nbytes == 0:
         return jnp.zeros((out_cap,), jnp.uint8), new_offs
     out = jnp.where(in_range, data[jnp.clip(src, 0, nbytes - 1)],

@@ -175,23 +175,34 @@ def _register_builtin_exprs() -> None:
 
     from ..expressions import collections as CL
     sig_nested = TypeSigs.nested_common
-    register_expr(CL.Size, TypeSigs.integral, "size of array/map",
-                  host_assisted=True)  # map inputs hop to host
-    register_expr(CL.GetArrayItem, sig_nested, "array[i] access",
-                  host_assisted=True)  # non-fixed-width elements hop to host
-    register_expr(CL.ElementAt, sig_nested, "element_at (array 1-based / map key)",
-                  host_assisted=True)
-    register_expr(CL.ArrayContains, TypeSigs.BOOLEAN, "array_contains",
-                  host_assisted=True)  # column-valued needle hops to host
-    register_expr(CL.ArrayPosition, TypeSigs.integral, "array_position",
-                  host_assisted=True)
+    register_expr(CL.Size, TypeSigs.integral,
+                  "size of array/map (device offsets math)",
+                  incompat="map inputs via host path")
+    register_expr(CL.GetArrayItem, sig_nested, "array[i] access (flat gather)",
+                  incompat="non-fixed-width elements via host path")
+    register_expr(CL.ElementAt, sig_nested,
+                  "element_at (array 1-based / map key)",
+                  incompat="maps / non-fixed-width elements via host path")
+    register_expr(CL.ArrayContains, TypeSigs.BOOLEAN,
+                  "array_contains (segment reduce)",
+                  incompat="column-valued needle via host path")
+    register_expr(CL.ArrayPosition, TypeSigs.integral,
+                  "array_position (segment reduce)",
+                  incompat="column-valued needle via host path")
     register_expr(CL.ArrayMin, sig_nested, "array_min (nulls skipped, NaN greatest)")
     register_expr(CL.ArrayMax, sig_nested, "array_max (nulls skipped, NaN greatest)")
     register_expr(CL.CreateArray, sig_nested, "array(...) constructor")
-    for cls in (CL.SortArray, CL.ArrayDistinct, CL.ArrayUnion, CL.ArrayIntersect,
-                CL.ArrayExcept, CL.ArraysOverlap, CL.ArrayRepeat, CL.Slice,
-                CL.ConcatArrays, CL.Flatten, CL.ArrayJoin, CL.Sequence,
-                CL.ArrayReverse, CL.ArraysZip):
+    for cls in (CL.SortArray, CL.ArrayDistinct, CL.ArrayUnion,
+                CL.ArrayIntersect, CL.ArrayExcept, CL.ArraysOverlap):
+        register_expr(cls, sig_nested,
+                      f"array fn {cls.__name__} (device ragged sort/search)",
+                      incompat="non-fixed-width elements via host path")
+    for cls in (CL.ArrayRepeat, CL.Slice, CL.ConcatArrays, CL.Flatten,
+                CL.Sequence, CL.ArrayReverse):
+        register_expr(cls, sig_nested,
+                      f"array fn {cls.__name__} (device ragged gather)",
+                      incompat="non-fixed-width elements via host path")
+    for cls in (CL.ArrayJoin, CL.ArraysZip):
         register_expr(cls, sig_nested, f"array fn {cls.__name__}",
                       host_assisted=True)
     for cls in (CL.CreateMap, CL.MapKeys, CL.MapValues, CL.GetMapValue,
