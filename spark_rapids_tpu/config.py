@@ -420,6 +420,27 @@ EXPAND_ENABLED = _conf("spark.rapids.sql.exec.ExpandExec").doc(
 FILTER_ENABLED = _conf("spark.rapids.sql.exec.FilterExec").doc(
     "Enable TPU filter.").boolean(True)
 
+CARTESIAN_ENABLED = _conf("spark.rapids.sql.exec.CartesianProductExec").doc(
+    "Enable the TPU cartesian product.").boolean(True)
+WRITE_EXEC_ENABLED = _conf("spark.rapids.sql.exec.DataWritingCommandExec").doc(
+    "Enable the TPU data-writing command (writes run through the override "
+    "engine with tagging and metrics).").boolean(True)
+SUBQUERY_BROADCAST_ENABLED = _conf(
+    "spark.rapids.sql.exec.SubqueryBroadcastExec").doc(
+    "Enable the TPU subquery broadcast (dynamic partition pruning key "
+    "collection).").boolean(True)
+SYMMETRIC_JOIN_ENABLED = _conf(
+    "spark.rapids.sql.join.useShuffledSymmetricHashJoin").doc(
+    "Use the symmetric shuffled hash join, which picks the build side "
+    "per partition by materialized size instead of always building on the "
+    "right (reference GpuShuffledSymmetricHashJoinExec)."
+).boolean(True)
+PARQUET_WRITE_ENABLED = _conf(
+    "spark.rapids.sql.format.parquet.write.enabled").doc(
+    "Enable accelerated parquet writes.").boolean(True)
+ORC_WRITE_ENABLED = _conf("spark.rapids.sql.format.orc.write.enabled").doc(
+    "Enable accelerated ORC writes.").boolean(True)
+
 STABLE_SORT = _conf("spark.rapids.sql.stableSort.enabled").doc(
     "Force stable sorts (reference RapidsConf stableSort)."
 ).boolean(False)

@@ -55,7 +55,7 @@ class TaskContext:
     def __init__(self, partition_id: int = 0, conf: Optional[RapidsConf] = None):
         self.partition_id = partition_id
         self.conf = conf or default_conf()
-        self.eval_ctx = EvalContext(self.conf)
+        self.eval_ctx = EvalContext(self.conf, partition_id=partition_id)
         self.task_metrics: Dict[str, int] = {}
         self._completion_listeners = []
 

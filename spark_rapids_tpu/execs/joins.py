@@ -662,3 +662,187 @@ class CpuBroadcastNestedLoopJoinExec(CpuExec):
 
 
 
+
+
+# ---------------------------------------------------------------------------
+# symmetric shuffled hash join (reference GpuShuffledSymmetricHashJoinExec,
+# 1225 LoC: the join that picks its build side by the size actually
+# materialized per partition rather than trusting the planner's estimate)
+# ---------------------------------------------------------------------------
+
+_MIRROR_JOIN = {"inner": "inner", "cross": "cross",
+                "leftouter": "rightouter", "left": "rightouter",
+                "rightouter": "leftouter", "right": "leftouter",
+                "fullouter": "fullouter", "outer": "fullouter",
+                "full": "fullouter"}
+
+
+class TpuShuffledSymmetricHashJoinExec(TpuShuffledHashJoinExec):
+    """Size-adaptive build side: each partition builds on whichever side
+    materialized smaller, flipping the join orientation (and mirroring the
+    join type) when the left is the better build side. Semi/anti joins are
+    direction-bound and keep the fixed orientation."""
+
+    def __init__(self, left, right, join_type, left_keys, right_keys,
+                 condition, output, per_partition: bool = False):
+        super().__init__(left, right, join_type, left_keys, right_keys,
+                         condition, output, per_partition)
+        self._can_flip = join_type in _MIRROR_JOIN
+        if self._can_flip:
+            self._twin = TpuShuffledHashJoinExec(
+                right, left, _MIRROR_JOIN[join_type], right_keys, left_keys,
+                condition, list(right.output) + list(left.output),
+                per_partition)
+            self._n_left_cols = len(left.output)
+
+    def node_desc(self) -> str:
+        return f"TpuShuffledSymmetricHashJoin[{self.join_type}]"
+
+    def additional_metrics(self):
+        m = dict(super().additional_metrics())
+        m["buildSideFlips"] = "DEBUG"
+        return m
+
+    def _join(self, left: TpuColumnarBatch, right: TpuColumnarBatch,
+              ctx: TaskContext) -> TpuColumnarBatch:
+        # the base implementation builds on the RIGHT; flip when the left is
+        # smaller so the hash table always comes from the smaller side
+        if self._can_flip and left.num_rows < right.num_rows:
+            self.metrics["buildSideFlips"].add(1)
+            self._twin.metrics = self.metrics  # shared sink
+            out = self._twin._join(right, left, ctx)
+            nl = self._n_left_cols
+            cols = out.columns[len(out.columns) - nl:] + \
+                out.columns[: len(out.columns) - nl]
+            names = [a.name for a in self._output]
+            return TpuColumnarBatch(cols, out.num_rows, names)
+        return super()._join(left, right, ctx)
+
+
+# ---------------------------------------------------------------------------
+# cartesian product (reference org/apache/spark/sql/rapids/
+# GpuCartesianProductExec.scala: dedicated pairwise-partition product for
+# large×large inner joins where neither side broadcasts)
+# ---------------------------------------------------------------------------
+
+class CpuCartesianProductExec(CpuExec):
+    """Host cartesian product: output partition k = left part (k // nr) ×
+    right part (k % nr)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 condition: Optional[Expression],
+                 output: List[AttributeReference]):
+        super().__init__([left, right])
+        self.condition = (bind_references(condition, left.output + right.output)
+                          if condition is not None else None)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions() * \
+            self.children[1].num_partitions()
+
+    def node_desc(self) -> str:
+        return "CpuCartesianProduct"
+
+    def _pair_tables(self, idx: int, ctx: TaskContext):
+        import pyarrow as pa
+        from ..types import to_arrow
+        nr = self.children[1].num_partitions()
+        li, ri = idx // nr, idx % nr
+
+        def side(child, p, prefix):
+            tables = list(child.execute_partition(p, ctx))
+            names = [f"{prefix}{i}" for i in range(len(child.output))]
+            if tables:
+                return pa.concat_tables([t.rename_columns(names)
+                                         for t in tables])
+            return pa.schema([(n, to_arrow(a.dtype))
+                              for n, a in zip(names, child.output)]).empty_table()
+
+        return side(self.children[0], li, "l"), side(self.children[1], ri, "r")
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import numpy as np
+        import pyarrow as pa
+        lt, rt = self._pair_tables(idx, ctx)
+        nl, nr_rows = lt.num_rows, rt.num_rows
+        if nl == 0 or nr_rows == 0:
+            return
+        li = np.repeat(np.arange(nl), nr_rows)
+        ri = np.tile(np.arange(nr_rows), nl)
+        joined = pa.Table.from_arrays(
+            [lt.column(i).take(pa.array(li)) for i in range(lt.num_columns)]
+            + [rt.column(i).take(pa.array(ri)) for i in range(rt.num_columns)],
+            names=list(lt.column_names) + list(rt.column_names))
+        if self.condition is not None:
+            import pyarrow.compute as pc
+            keep = self.condition.eval_cpu(joined, ctx.eval_ctx)
+            joined = joined.filter(pc.fill_null(keep, False))
+        yield joined.rename_columns([a.name for a in self._output])
+
+
+class TpuCartesianProductExec(TpuExec):
+    """Device cartesian product: the repeat/tile expansion is two gathers over
+    an index grid — the same kernel BNLJ uses, but scoped to one
+    (left-partition, right-partition) pair per output partition so the
+    expansion never exceeds a partition pair's footprint."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 condition: Optional[Expression],
+                 output: List[AttributeReference]):
+        super().__init__([left, right])
+        self.condition = (bind_references(condition, left.output + right.output)
+                          if condition is not None else None)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions() * \
+            self.children[1].num_partitions()
+
+    def node_desc(self) -> str:
+        return "TpuCartesianProduct"
+
+    def additional_metrics(self):
+        return {"joinTime": "MODERATE", "numPairs": "DEBUG"}
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        nr = self.children[1].num_partitions()
+        li, ri = idx // nr, idx % nr
+
+        def side(child, p):
+            batches = list(child.execute_partition(p, ctx))
+            return concat_batches(batches) if batches else None
+
+        left, right = side(self.children[0], li), side(self.children[1], ri)
+        if left is None or right is None or not left.num_rows \
+                or not right.num_rows:
+            return
+        names = [a.name for a in self._output]
+        n_l, n_r = left.num_rows, right.num_rows
+        total = n_l * n_r
+        self.metrics["numPairs"].add(total)
+        with self.metrics["joinTime"].timed():
+            out_cap = bucket_capacity(max(total, 1))
+            j = jnp.arange(out_cap)
+            gl = gather(left, jnp.where(j < total, j // n_r, -1).astype(jnp.int32),
+                        total, out_cap)
+            gr = gather(right, jnp.where(j < total, j % n_r, -1).astype(jnp.int32),
+                        total, out_cap)
+            joined = TpuColumnarBatch(gl.columns + gr.columns, total)
+            if self.condition is not None:
+                cond = to_column(self.condition.eval_tpu(joined, ctx.eval_ctx),
+                                 joined)
+                keep = (j < total) & cond.data.astype(jnp.bool_)
+                if cond.validity is not None:
+                    keep = keep & cond.validity
+                joined = compact(joined, keep)
+            if joined.num_rows:
+                yield joined.rename(names)

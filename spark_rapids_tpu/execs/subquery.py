@@ -1,0 +1,115 @@
+"""Subquery broadcast: dynamic partition pruning key collection.
+
+Reference: GpuSubqueryBroadcastExec
+(sql-plugin/.../execution/GpuSubqueryBroadcastExec.scala) — Spark plans
+DynamicPruningExpression(InSubquery(SubqueryBroadcastExec(buildPlan))) under
+a partitioned scan; at execution the build side runs once, its distinct join
+keys are collected, and the scan prunes partitions whose values can't match.
+
+Here the pruning handle hangs off the scan's options (the scan evaluates it
+before any file IO — see FileScanBase._prune_by_partition_values). The build
+plan itself goes through the override engine on first evaluation, so the key
+collection runs on device when the build side does."""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+from .base import CpuExec, PhysicalPlan, TaskContext, TpuExec
+
+
+class _SubqueryBase:
+    """Shared: run the child once, collect DISTINCT values of one output
+    column. Thread-safe lazy evaluation with a cached result."""
+
+    def _init_subquery(self, child: PhysicalPlan, key_ordinal: int):
+        self.key_ordinal = key_ordinal
+        self._values: Optional[set] = None
+        self._lock = threading.Lock()
+
+    @property
+    def output(self):
+        return [self.children[0].output[self.key_ordinal]]
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        name = self.children[0].output[self.key_ordinal].name
+        return f"{type(self).__name__}[{name}]"
+
+    def values(self, conf) -> set:
+        """Distinct build-side key values (None excluded — null never matches
+        a pruning comparison). Runs the child plan once, lazily."""
+        with self._lock:
+            if self._values is None:
+                self._values = self._collect(conf)
+            return self._values
+
+    def _collect(self, conf) -> set:
+        ctx = TaskContext(0, conf)
+        out: set = set()
+        try:
+            for table in self._host_tables(ctx):
+                col = table.column(self.key_ordinal)
+                out.update(v for v in col.to_pylist() if v is not None)
+        finally:
+            ctx.complete()
+        return out
+
+    def _host_tables(self, ctx):
+        raise NotImplementedError
+
+
+class CpuSubqueryBroadcastExec(_SubqueryBase, CpuExec):
+    def __init__(self, child: PhysicalPlan, key_ordinal: int):
+        CpuExec.__init__(self, [child])
+        self._init_subquery(child, key_ordinal)
+
+    def _host_tables(self, ctx):
+        # the build plan goes through the override engine itself, so DPP key
+        # collection runs on device whenever the build side converts
+        from ..plan.overrides import TpuOverrides
+        final = TpuOverrides.apply(self.children[0], ctx.conf)
+        for p in range(final.num_partitions()):
+            yield from final.execute_partition(p, ctx)
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        from ..types import to_arrow
+        a = self.output[0]
+        vals = sorted(self.values(ctx.conf))
+        yield pa.table({a.name: pa.array(vals, type=to_arrow(a.dtype))})
+
+
+class TpuSubqueryBroadcastExec(_SubqueryBase, TpuExec):
+    """Device flavor: the child runs as a TPU plan; distinct happens on the
+    collected key column (reference runs this reuse of the broadcast batch)."""
+
+    def __init__(self, child: PhysicalPlan, key_ordinal: int):
+        TpuExec.__init__(self, [child])
+        self._init_subquery(child, key_ordinal)
+
+    def _host_tables(self, ctx):
+        child = self.children[0]
+        for p in range(child.num_partitions()):
+            for batch in child.execute_partition(p, ctx):
+                yield batch.to_arrow()
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        from ..columnar.batch import TpuColumnarBatch
+        from ..types import to_arrow
+        a = self.output[0]
+        vals = sorted(self.values(ctx.conf))
+        t = pa.table({a.name: pa.array(vals, type=to_arrow(a.dtype))})
+        yield TpuColumnarBatch.from_arrow(t)
+
+
+def plan_dynamic_pruning(scan_options: dict, partition_col: str,
+                         subquery) -> None:
+    """Attach a DPP handle to a scan's options. The scan consults it during
+    file selection (DynamicPruningExpression analogue)."""
+    scan_options.setdefault("__dpp_filters__", []).append(
+        (partition_col, subquery))

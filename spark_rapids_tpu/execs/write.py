@@ -1,0 +1,118 @@
+"""Data-writing command exec: writes run through the override engine.
+
+Reference: GpuDataWritingCommandExec / GpuFileFormatDataWriter
+(sql-plugin/.../GpuFileFormatDataWriter.scala) — the write is a plan node, so
+it is tagged (format toggles, unsupported types fall back), converted, and
+metered like any other operator, instead of the driver hand-executing
+partitions. The TPU flavor consumes device batches straight from its TPU
+child (the device→host materialization IS the write boundary); the CPU
+flavor consumes arrow tables from a fallback child.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional
+
+from .base import CpuExec, PhysicalPlan, TaskContext, TpuExec
+
+
+@dataclass
+class WriteSpec:
+    """Everything the write exec needs to emit one partition's files."""
+
+    fmt: str
+    path: str
+    ext: str
+    write_fn: Callable  # (arrow table, file path) -> None
+    partition_by: List[str] = field(default_factory=list)
+    options: Dict[str, str] = field(default_factory=dict)
+
+    def write_partition(self, table, part_idx: int) -> int:
+        """Write one partition's table; returns number of files written."""
+        if self.partition_by:
+            from ..io.layout import iter_hive_partitions
+            n = 0
+            for _, subdir, sub in iter_hive_partitions(table,
+                                                       self.partition_by):
+                d = os.path.join(self.path, subdir)
+                os.makedirs(d, exist_ok=True)
+                self.write_fn(sub,
+                              os.path.join(d, f"part-{part_idx:05d}.{self.ext}"))
+                n += 1
+            return n
+        self.write_fn(table,
+                      os.path.join(self.path, f"part-{part_idx:05d}.{self.ext}"))
+        return 1
+
+
+class CpuDataWritingCommandExec(CpuExec):
+    """Fallback write: consumes arrow tables from the (possibly fallen-back)
+    child plan."""
+
+    def __init__(self, child: PhysicalPlan, spec: WriteSpec):
+        super().__init__([child])
+        self.spec = spec
+
+    @property
+    def output(self):
+        return []
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def node_desc(self) -> str:
+        return f"CpuDataWritingCommand[{self.spec.fmt}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        names = [a.name for a in self.children[0].output]
+        tables = [t.rename_columns(names)
+                  for t in self.children[0].execute_partition(idx, ctx)
+                  if t.num_rows]
+        if tables:
+            self.spec.write_partition(pa.concat_tables(tables), idx)
+        return iter(())
+
+
+class TpuDataWritingCommandExec(TpuExec):
+    """Accelerated write (reference GpuDataWritingCommandExec): device batches
+    stream from the TPU child and materialize to host exactly once, at the
+    file boundary. Metrics mirror the reference's GpuFileFormatDataWriter
+    (write time, rows, files)."""
+
+    def __init__(self, child: PhysicalPlan, spec: WriteSpec):
+        super().__init__([child])
+        self.spec = spec
+
+    @property
+    def output(self):
+        return []
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def node_desc(self) -> str:
+        return f"TpuDataWritingCommand[{self.spec.fmt}]"
+
+    def additional_metrics(self):
+        return {"writeTime": "ESSENTIAL", "numFiles": "ESSENTIAL",
+                "numWrittenRows": "ESSENTIAL"}
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        import pyarrow as pa
+        names = [a.name for a in self.children[0].output]
+        tables = []
+        rows = 0
+        for batch in self.children[0].execute_partition(idx, ctx):
+            if not batch.num_rows:
+                continue
+            rows += batch.num_rows
+            tables.append(batch.to_arrow().rename_columns(names))
+        if tables:
+            with self.metrics["writeTime"].timed():
+                n = self.spec.write_partition(pa.concat_tables(tables), idx)
+            self.metrics["numFiles"].add(n)
+            self.metrics["numWrittenRows"].add(rows)
+        return iter(())

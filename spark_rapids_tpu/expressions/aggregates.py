@@ -19,6 +19,8 @@ from .base import Expression, _DEFAULT_CTX
 class AggregateFunction(Expression):
     """Declarative aggregate; evaluated by the aggregate execs, not columnar_eval."""
 
+    unevaluable = True  # driven by the aggregate execs (reference Unevaluable)
+
     def __init__(self, *children: Expression):
         self.children = tuple(children)
 

@@ -29,11 +29,21 @@ from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
 
 
 class EvalContext:
-    """Per-task evaluation context: conf snapshot + ANSI flag."""
+    """Per-task evaluation context: conf snapshot + ANSI flag + task-scoped
+    fields nondeterministic expressions read (partition id, current input
+    file, running row counters — reference TaskContext + InputFileUtils)."""
 
-    def __init__(self, conf: Optional[RapidsConf] = None):
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 partition_id: int = 0):
         self.conf = conf or default_conf()
         self.ansi = self.conf.ansi_enabled
+        self.partition_id = partition_id
+        self.input_file: Optional[str] = None
+        self.input_block_start: int = -1
+        self.input_block_length: int = -1
+        #: per-expression running row offsets (monotonically_increasing_id,
+        #: rand) keyed by id(expr)
+        self.row_counters: dict = {}
 
 
 _DEFAULT_CTX = EvalContext()

@@ -2217,3 +2217,315 @@ class ZipWith(_HostListOp):
                 out.append(vals[p:p + m])
                 p += m
         return out
+
+
+# ---------------------------------------------------------------------------
+# breadth 2: array_remove, map entry/lambda ops, struct field access
+# (reference collectionOperations.scala GpuArrayRemove/GpuMapEntries,
+# higherOrderFunctions.scala GpuMapFilter/GpuTransformKeys/GpuTransformValues,
+# complexTypeExtractors.scala GpuGetStructField/GpuGetArrayStructFields,
+# complexTypeCreator.scala GpuCreateNamedStruct)
+# ---------------------------------------------------------------------------
+
+class ArrayRemove(_HostListOp):
+    """array_remove(arr, elem): drops elements equal to elem (NaN equals NaN,
+    like array ops' ordering equivalence); nulls are kept."""
+
+    def __init__(self, arr: Expression, elem: Expression):
+        self.children = (arr, elem)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _combine(self, lst, v):
+        if lst is None or v is None:
+            return None
+        return [e for e in lst if e is None or not _eq_value(e, v)]
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        col = _expand_list(vals[0], batch)
+        elem = vals[1]
+        if not _fixed_list(col) or not isinstance(elem, TpuScalar):
+            return self._host_from_vals(vals, batch)
+        if elem.value is None:
+            return _all_null_list(self.dtype, batch)
+        child = col.child
+        ev = child.validity if child.validity is not None else \
+            jnp.ones((child.capacity,), jnp.bool_)
+        if _is_float(child.dtype) and isinstance(elem.value, float) \
+                and math.isnan(elem.value):
+            match = jnp.isnan(child.data)
+        else:
+            match = child.data == jnp.asarray(elem.value, child.data.dtype)
+        _, in_data = _segments(col)
+        keep = in_data & ~(match & ev)
+        valid = _list_validity(col, batch)
+        return _compact_list(col, keep, valid, col.num_rows, self.dtype)
+
+
+class MapEntries(_HostListOp):
+    """map_entries(m) → array<struct<key,value>>."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self) -> DataType:
+        mt = self.children[0].dtype
+        return ArrayType(StructType([StructField("key", mt.key_type, False),
+                                     StructField("value", mt.value_type)]),
+                         contains_null=False)
+
+    def _combine(self, m):
+        if m is None:
+            return None
+        return [{"key": k, "value": v} for k, v in m]
+
+
+class _MapLambdaOp(_HostListOp):
+    """Host lambda-over-map-entries base (pattern: ZipWith — bind (k, v) to
+    flat pseudo-table columns, evaluate the body once over all entries)."""
+
+    def __init__(self, child: Expression, function):
+        self.children = (child, function)
+
+    @property
+    def function(self):
+        return self.children[1]
+
+    def _sync_vars(self) -> None:
+        mt = self.children[0].dtype
+        args = self.function.arguments
+        if isinstance(mt, MapType):
+            args[0]._dtype = mt.key_type
+            if len(args) > 1:
+                args[1]._dtype = mt.value_type
+
+    def _apply(self, maps, ctx, batch_or_table, is_tpu: bool):
+        import pyarrow as pa
+        fn = self.function
+        args = fn.arguments
+        outer: List[AttributeReference] = []
+
+        def rule(e):
+            if isinstance(e, NamedLambdaVariable):
+                for ai, a in enumerate(args):
+                    if e.var_id == a.var_id:
+                        return _BoundLambdaVar(ai, a.dtype)
+                return None
+            if isinstance(e, AttributeReference):
+                for j, o in enumerate(outer):
+                    if o.expr_id == e.expr_id:
+                        return _BoundLambdaVar(2 + j, e.dtype, e.nullable)
+                outer.append(e)
+                return _BoundLambdaVar(2 + len(outer) - 1, e.dtype, e.nullable)
+            return None
+
+        body = fn.body.transform(rule)
+        flat_k, flat_v, shape, seg = [], [], [], []
+        for ri, m in enumerate(maps):
+            if m is None:
+                shape.append(None)
+                continue
+            shape.append(len(m))
+            for k, v in m:
+                flat_k.append(k)
+                flat_v.append(v)
+                seg.append(ri)
+        mt = self.children[0].dtype
+        cols = {"k": pa.array(flat_k, type=type_to_arrow(mt.key_type)),
+                "v": pa.array(flat_v, type=type_to_arrow(mt.value_type))}
+        for j, o in enumerate(outer):
+            ov = o.eval_tpu(batch_or_table, ctx).to_pylist() if is_tpu \
+                else o.eval_cpu(batch_or_table, ctx).to_pylist()
+            cols[f"outer{j}"] = pa.array([ov[s] for s in seg],
+                                         type=type_to_arrow(o.dtype))
+        pseudo = pa.table(cols)
+        res = body.eval_cpu(pseudo, ctx)
+        vals = res.to_pylist() if isinstance(res, (pa.Array, pa.ChunkedArray)) \
+            else [res] * pseudo.num_rows
+        out, p = [], 0
+        for ri, m in enumerate(shape):
+            if m is None:
+                out.append(None)
+            else:
+                out.append(self._regroup(maps[ri], vals[p:p + m]))
+                p += m
+        return out
+
+    def _regroup(self, entries, lambda_vals):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        self._sync_vars()
+        maps = _pylist_of(None, batch, ctx, self.children[0], batch.num_rows)
+        return _result_from_pylist(self._apply(maps, ctx, batch, True),
+                                   self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        self._sync_vars()
+        maps = self.children[0].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._apply(maps, ctx, table, False),
+                        type=type_to_arrow(self.dtype))
+
+
+class MapFilter(_MapLambdaOp):
+    """map_filter(m, (k, v) -> pred)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype
+
+    def _regroup(self, entries, lambda_vals):
+        return [(k, v) for (k, v), keep in zip(entries, lambda_vals)
+                if keep is True]
+
+
+class TransformValues(_MapLambdaOp):
+    """transform_values(m, (k, v) -> newv)."""
+
+    @property
+    def dtype(self) -> DataType:
+        mt = self.children[0].dtype
+        self._sync_vars()
+        return MapType(mt.key_type, self.function.dtype, True)
+
+    def _regroup(self, entries, lambda_vals):
+        return [(k, nv) for (k, _), nv in zip(entries, lambda_vals)]
+
+
+class TransformKeys(_MapLambdaOp):
+    """transform_keys(m, (k, v) -> newk). Duplicate result keys follow
+    LAST_WIN dedup (Spark's non-exception mapKeyDedupPolicy); a null result
+    key is a runtime error, as in Spark."""
+
+    @property
+    def dtype(self) -> DataType:
+        mt = self.children[0].dtype
+        self._sync_vars()
+        return MapType(self.function.dtype, mt.value_type,
+                       getattr(mt, "value_contains_null", True))
+
+    def _regroup(self, entries, lambda_vals):
+        out = {}
+        for (_, v), nk in zip(entries, lambda_vals):
+            if nk is None:
+                raise ExpressionError("Cannot use null as map key")
+            out[nk] = v
+        return list(out.items())
+
+
+class GetStructField(UnaryExpression):
+    """struct.field access (reference GpuGetStructField). Structs are
+    host-resident; this is a host dict-field gather."""
+
+    def __init__(self, child: Expression, name: str):
+        super().__init__(child)
+        self.name = name
+
+    @property
+    def dtype(self) -> DataType:
+        st = self.child.dtype
+        for f in st.fields:
+            if f.name == self.name:
+                return f.data_type
+        raise KeyError(self.name)
+
+    def _gather(self, vals):
+        return [None if v is None else v.get(self.name) for v in vals]
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            v = c.value
+            return TpuScalar(self.dtype,
+                             None if v is None else v.get(self.name))
+        return _result_from_pylist(self._gather(c.to_pylist()), self.dtype,
+                                   batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.child.eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._gather(vals), type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"{self.child.pretty()}.{self.name}"
+
+
+class GetArrayStructFields(UnaryExpression):
+    """arr_of_struct.field → array of the field (reference
+    GpuGetArrayStructFields)."""
+
+    def __init__(self, child: Expression, name: str):
+        super().__init__(child)
+        self.name = name
+
+    @property
+    def dtype(self) -> DataType:
+        st = self.child.dtype.element_type
+        for f in st.fields:
+            if f.name == self.name:
+                return ArrayType(f.data_type, True)
+        raise KeyError(self.name)
+
+    def _gather(self, lists):
+        out = []
+        for lst in lists:
+            if lst is None:
+                out.append(None)
+            else:
+                out.append([None if e is None else e.get(self.name)
+                            for e in lst])
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        return _result_from_pylist(self._gather(c.to_pylist()), self.dtype,
+                                   batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        lists = self.child.eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._gather(lists), type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        return f"{self.child.pretty()}.{self.name}"
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(name1, val1, ...) (reference GpuCreateNamedStruct)."""
+
+    def __init__(self, names: Sequence[str], values: Sequence[Expression]):
+        self.names = list(names)
+        self.children = tuple(values)
+
+    @property
+    def dtype(self) -> DataType:
+        return StructType([StructField(n, c.dtype, c.nullable)
+                           for n, c in zip(self.names, self.children)])
+
+    def _rows(self, cols, n):
+        return [{nm: col[i] for nm, col in zip(self.names, cols)}
+                for i in range(n)]
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        n = batch.num_rows
+        cols = [_pylist_of(None, batch, ctx, c, n) for c in self.children]
+        return _result_from_pylist(self._rows(cols, n), self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = table.num_rows
+        cols = []
+        for c in self.children:
+            r = c.eval_cpu(table, ctx)
+            cols.append(r.to_pylist() if isinstance(r, (pa.Array, pa.ChunkedArray))
+                        else [r] * n)
+        return pa.array(self._rows(cols, n), type=type_to_arrow(self.dtype))
+
+    def pretty(self) -> str:
+        parts = [f"{n}={c.pretty()}" for n, c in zip(self.names, self.children)]
+        return f"named_struct({', '.join(parts)})"

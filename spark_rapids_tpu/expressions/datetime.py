@@ -396,3 +396,303 @@ def _np_mask(arr):
     mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False)).astype(bool)
     vals = np.asarray(a.fill_null(0).to_numpy(zero_copy_only=False))
     return vals, mask
+
+
+class DateSub(DateAdd):
+    """date_sub(date, days) (reference GpuDateSub)."""
+
+    def __init__(self, date: Expression, days: Expression):
+        super().__init__(date, days, negate=True)
+
+    def pretty(self) -> str:
+        return f"date_sub({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class _EpochToTimestamp(UnaryExpression):
+    """seconds/millis/micros → timestamp (reference GpuSecondsToTimestamp
+    family): integer scaling on device."""
+
+    _scale = MICROS_PER_SECOND  # micros per input unit
+
+    @property
+    def dtype(self) -> DataType:
+        return TimestampT
+
+    def _compute(self, d, ctx, valid):
+        return (d.astype(jnp.int64) * self._scale).astype(jnp.int64)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        c = self.child.eval_cpu(table, ctx)
+        micros = pc.multiply(pc.cast(c, pa.int64()), self._scale)
+        return pc.cast(micros, pa.timestamp("us", tz="UTC"))
+
+    def pretty(self) -> str:
+        return f"{type(self).__name__.lower()}({self.child.pretty()})"
+
+
+class SecondsToTimestamp(_EpochToTimestamp):
+    _scale = MICROS_PER_SECOND
+
+
+class MillisToTimestamp(_EpochToTimestamp):
+    _scale = 1000
+
+
+class MicrosToTimestamp(_EpochToTimestamp):
+    _scale = 1
+
+
+def _java_to_strftime(pattern: str) -> str:
+    """Java SimpleDateFormat subset → strftime. Quoted literals ('T', '')
+    copy through; unknown directives (incl. SSS/DD, which have no exact
+    strftime width) raise ValueError — callers set tpu_supported=False at
+    construction so tagging rejects the expression instead of crashing
+    mid-query (mirroring GpuToTimestamp.COMPATIBLE_FORMATS)."""
+    out = []
+    i = 0
+    mapping = {"yyyy": "%Y", "yy": "%y", "MMM": "%b", "MM": "%m", "dd": "%d",
+               "HH": "%H", "mm": "%M", "ss": "%S", "EEEE": "%A", "EEE": "%a",
+               "a": "%p"}
+    toks = ("yyyy", "EEEE", "MMM", "EEE", "yy", "MM", "dd", "HH", "mm", "ss",
+            "a")
+    while i < len(pattern):
+        if pattern[i] == "'":
+            # Java quoted literal; '' inside quotes is a literal quote
+            if pattern.startswith("''", i):
+                out.append("'")
+                i += 2
+                continue
+            j = pattern.find("'", i + 1)
+            if j < 0:
+                raise ValueError("unterminated quote in datetime pattern")
+            lit = pattern[i + 1: j]
+            out.append(lit.replace("%", "%%") if lit else "'")
+            i = j + 1
+            continue
+        matched = False
+        for tok in toks:
+            if pattern.startswith(tok, i):
+                out.append(mapping[tok])
+                i += len(tok)
+                matched = True
+                break
+        if matched:
+            continue
+        ch = pattern[i]
+        if ch.isalpha():
+            raise ValueError(f"unsupported datetime pattern token: {ch}")
+        out.append("%%" if ch == "%" else ch)
+        i += 1
+    return "".join(out)
+
+
+def _fmt_supported(fmt) -> bool:
+    """Constructor-time pattern validation (the tagging gate)."""
+    if fmt is None:
+        return True
+    try:
+        _java_to_strftime(fmt)
+        return True
+    except ValueError:
+        return False
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(seconds, fmt) → string, UTC session timezone
+    (reference GpuFromUnixTime). Host-assisted formatting."""
+
+    def __init__(self, sec: Expression, fmt: Expression = None):
+        from .base import Literal
+        self.children = (sec, fmt if fmt is not None
+                         else Literal("yyyy-MM-dd HH:mm:ss"))
+        f = self.children[1]
+        self.tpu_supported = _fmt_supported(
+            f.value if isinstance(f, Literal) else None)
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import StringT
+        return StringT
+
+    def _fmt(self):
+        from .base import Literal
+        f = self.children[1]
+        return f.value if isinstance(f, Literal) else None
+
+    def _format_list(self, secs):
+        import datetime as _dt
+        fmt = self._fmt()
+        sf = _java_to_strftime(fmt) if fmt is not None else None
+        out = []
+        for s in secs:
+            if s is None or sf is None:
+                out.append(None)
+            else:
+                t = _dt.datetime.fromtimestamp(int(s), _dt.timezone.utc)
+                txt = t.strftime(sf)
+                out.append(txt)
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.vector import TpuScalar
+        from .collections import _result_from_pylist
+        c = self.children[0].eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            v = self._format_list([c.value])[0]
+            return TpuScalar(self.dtype, v)
+        return _result_from_pylist(self._format_list(c.to_pylist()),
+                                   self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._format_list(vals), pa.string())
+
+    def pretty(self) -> str:
+        return f"from_unixtime({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class DateFormatClass(Expression):
+    """date_format(ts, fmt) → string (reference GpuDateFormatClass). UTC only;
+    host-assisted formatting over the civil fields."""
+
+    def __init__(self, ts: Expression, fmt: Expression):
+        from .base import Literal
+        self.children = (ts, fmt)
+        self.tpu_supported = _fmt_supported(
+            fmt.value if isinstance(fmt, Literal) else None)
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import StringT
+        return StringT
+
+    def _format_list(self, vals):
+        from .base import Literal
+        import datetime as _dt
+        f = self.children[1]
+        fmt = f.value if isinstance(f, Literal) else None
+        sf = _java_to_strftime(fmt) if fmt is not None else None
+        out = []
+        for v in vals:
+            if v is None or sf is None:
+                out.append(None)
+                continue
+            if isinstance(v, _dt.datetime):
+                t = v
+            elif isinstance(v, _dt.date):
+                t = _dt.datetime(v.year, v.month, v.day)
+            else:
+                t = _dt.datetime.fromtimestamp(int(v) / 1e6, _dt.timezone.utc)
+            out.append(t.strftime(sf))
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from ..columnar.vector import TpuScalar
+        from .collections import _result_from_pylist
+        c = self.children[0].eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(self.dtype, self._format_list([c.value])[0])
+        return _result_from_pylist(self._format_list(c.to_pylist()),
+                                   self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.children[0].eval_cpu(table, ctx).to_pylist()
+        return pa.array(self._format_list(vals), pa.string())
+
+    def pretty(self) -> str:
+        return f"date_format({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class ToUnixTimestamp(Expression):
+    """to_unix_timestamp(str|ts|date, fmt) → bigint seconds (reference
+    GpuToUnixTimestamp). String inputs parse host-side (UTC); timestamp/date
+    inputs scale on device."""
+
+    def __init__(self, child: Expression, fmt: Expression = None):
+        from .base import Literal
+        self.children = (child, fmt if fmt is not None
+                         else Literal("yyyy-MM-dd HH:mm:ss"))
+        f = self.children[1]
+        self.tpu_supported = _fmt_supported(
+            f.value if isinstance(f, Literal) else None)
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    def _fmt(self):
+        from .base import Literal
+        f = self.children[1]
+        return f.value if isinstance(f, Literal) else None
+
+    def _parse_list(self, vals):
+        import datetime as _dt
+        fmt = self._fmt()
+        sf = _java_to_strftime(fmt) if fmt is not None else None
+        out = []
+        for v in vals:
+            if v is None or sf is None:
+                out.append(None)
+                continue
+            try:
+                t = _dt.datetime.strptime(v, sf).replace(tzinfo=_dt.timezone.utc)
+                out.append(int(t.timestamp()))
+            except ValueError:
+                out.append(None)  # Spark: unparseable → null
+        return out
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        from ..columnar.batch import _repad
+        from ..columnar.vector import TpuColumnVector, TpuScalar
+        from ..types import DateType, StringType, TimestampType
+        src = self.children[0]
+        c = src.eval_tpu(batch, ctx)
+        if isinstance(src.dtype, TimestampType) and isinstance(c, TpuColumnVector):
+            data = _floor_div(c.data.astype(jnp.int64), MICROS_PER_SECOND)
+            valid = combine_validity(batch.capacity, c.validity,
+                                     row_mask(batch.num_rows, batch.capacity))
+            return make_column(LongT, data, valid, batch.num_rows)
+        if isinstance(src.dtype, DateType) and isinstance(c, TpuColumnVector):
+            data = c.data.astype(jnp.int64) * 86400
+            valid = combine_validity(batch.capacity, c.validity,
+                                     row_mask(batch.num_rows, batch.capacity))
+            return make_column(LongT, data, valid, batch.num_rows)
+        from .collections import _result_from_pylist
+        vals = [c.value] * batch.num_rows if isinstance(c, TpuScalar) \
+            else c.to_pylist()
+        return _result_from_pylist(self._parse_list(vals), LongT, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import datetime as _dt
+        import pyarrow as pa
+        from ..types import DateType, StringType, TimestampType
+        src = self.children[0]
+        vals = src.eval_cpu(table, ctx).to_pylist()
+        if isinstance(src.dtype, TimestampType):
+            out = [None if v is None else
+                   int(v.timestamp() // 1) if isinstance(v, _dt.datetime)
+                   else int(v) // 1000000 for v in vals]
+            return pa.array(out, pa.int64())
+        if isinstance(src.dtype, DateType):
+            out = [None if v is None else
+                   int(_dt.datetime(v.year, v.month, v.day,
+                                    tzinfo=_dt.timezone.utc).timestamp())
+                   for v in vals]
+            return pa.array(out, pa.int64())
+        return pa.array(self._parse_list(vals), pa.int64())
+
+    def pretty(self) -> str:
+        return f"to_unix_timestamp({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class UnixTimestamp(ToUnixTimestamp):
+    """unix_timestamp(...) — same semantics as to_unix_timestamp
+    (reference GpuUnixTimestamp)."""
+
+    def pretty(self) -> str:
+        return f"unix_timestamp({self.children[0].pretty()}, {self.children[1].pretty()})"

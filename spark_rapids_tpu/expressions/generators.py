@@ -45,6 +45,8 @@ class MultiAlias(Expression):
     """Names for a multi-column generator, e.g.
     posexplode(m).alias("p", "k", "v") (Spark MultiAlias)."""
 
+    unevaluable = True  # naming wrapper resolved by GenerateExec
+
     def __init__(self, child: Generator, names: Sequence[str]):
         self.children = (child,)
         self.names = list(names)

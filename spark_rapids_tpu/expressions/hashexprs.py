@@ -21,7 +21,8 @@ from ..types import (BooleanType, ByteType, DataType, DateType, DoubleType,
                      FloatType, IntegerT, IntegerType, LongType, ShortType,
                      StringType, TimestampType)
 from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
-from .base import Expression, _DEFAULT_CTX, device_parts, make_column
+from .base import (Expression, UnaryExpression, _DEFAULT_CTX, device_parts,
+                   make_column)
 
 _C1 = np.uint32(0xCC9E2D51)
 _C2 = np.uint32(0x1B873593)
@@ -576,3 +577,38 @@ class HiveHash(Expression):
 
     def pretty(self) -> str:
         return f"hive_hash({', '.join(c.pretty() for c in self.children)})"
+
+
+class Md5(UnaryExpression):
+    """md5(binary|string) → 32-char hex string (reference GpuMd5, JNI).
+    Host-assisted: hashlib per row — MD5 is a sequential byte algorithm with
+    no vectorizable structure worth a device port."""
+
+    @property
+    def dtype(self) -> DataType:
+        from ..types import StringT
+        return StringT
+
+    @staticmethod
+    def _hex(v):
+        import hashlib
+        if v is None:
+            return None
+        data = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        return hashlib.md5(data).hexdigest()
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .collections import _result_from_pylist
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            return TpuScalar(self.dtype, self._hex(c.value))
+        return _result_from_pylist([self._hex(v) for v in c.to_pylist()],
+                                   self.dtype, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.child.eval_cpu(table, ctx).to_pylist()
+        return pa.array([self._hex(v) for v in vals], pa.string())
+
+    def pretty(self) -> str:
+        return f"md5({self.child.pretty()})"

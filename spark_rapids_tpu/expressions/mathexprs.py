@@ -363,3 +363,168 @@ class Round(Expression):
 
     def pretty(self) -> str:
         return f"round({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+# ---------------------------------------------------------------------------
+# Math breadth 2 (reference mathExpressions.scala: GpuAsinh/GpuAcosh/GpuAtanh,
+# GpuCot, GpuHypot, GpuLogarithm, GpuRint, GpuBRound, GpuToDegrees/ToRadians)
+# ---------------------------------------------------------------------------
+
+def _as_f64_array(x, n):
+    """eval_cpu result (array or scalar) → (float64 values[n], null mask[n])."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if isinstance(x, (pa.Array, pa.ChunkedArray)):
+        arr = _chunk(pc.cast(x, pa.float64()))
+        vals = np.asarray(arr.fill_null(0.0).to_numpy(zero_copy_only=False))
+        mask = np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False)).astype(bool)
+        return vals, mask
+    v = x.as_py() if hasattr(x, "as_py") else x
+    if v is None:
+        return np.zeros(n), np.ones(n, dtype=bool)
+    return np.full(n, float(v)), np.zeros(n, dtype=bool)
+
+
+class Asinh(_DoubleUnary):
+    _np_fn = staticmethod(np.arcsinh)
+    _jnp_fn = staticmethod(jnp.arcsinh)
+
+
+class Acosh(_DoubleUnary):
+    _np_fn = staticmethod(np.arccosh)   # x < 1 → NaN, matching Spark StrictMath
+    _jnp_fn = staticmethod(jnp.arccosh)
+
+
+class Atanh(_DoubleUnary):
+    _np_fn = staticmethod(np.arctanh)
+    _jnp_fn = staticmethod(jnp.arctanh)
+
+
+class Cot(_DoubleUnary):
+    _np_fn = staticmethod(lambda x: 1.0 / np.tan(x))
+    _jnp_fn = staticmethod(lambda x: 1.0 / jnp.tan(x))
+
+
+class ToDegrees(_DoubleUnary):
+    _np_fn = staticmethod(np.degrees)
+    _jnp_fn = staticmethod(jnp.degrees)
+
+
+class ToRadians(_DoubleUnary):
+    _np_fn = staticmethod(np.radians)
+    _jnp_fn = staticmethod(jnp.radians)
+
+
+class Rint(_DoubleUnary):
+    """rint: round to nearest even, result stays double (Spark GpuRint)."""
+    _np_fn = staticmethod(np.rint)
+    _jnp_fn = staticmethod(jnp.round)
+
+
+class Hypot(Expression):
+    """hypot(a, b) = sqrt(a² + b²) without intermediate overflow."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+        data = jnp.hypot(jnp.broadcast_to(ld, (cap,)).astype(jnp.float64),
+                         jnp.broadcast_to(rd, (cap,)).astype(jnp.float64))
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        return make_column(DoubleT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        l = _as_f64_array(self.children[0].eval_cpu(table, ctx), table.num_rows)
+        r = _as_f64_array(self.children[1].eval_cpu(table, ctx), table.num_rows)
+        lv, lm = l
+        rv, rm = r
+        return pa.array(np.hypot(lv, rv), mask=(lm | rm))
+
+    def pretty(self) -> str:
+        return f"hypot({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class Logarithm(Expression):
+    """log(base, x): null when x <= 0 (Spark non-ANSI null-on-domain-error)."""
+
+    def __init__(self, base: Expression, child: Expression):
+        self.children = (base, child)
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        b = self.children[0].eval_tpu(batch, ctx)
+        c = self.children[1].eval_tpu(batch, ctx)
+        bd, bv = device_parts(b, cap)
+        cd, cv = device_parts(c, cap)
+        bd = jnp.broadcast_to(bd, (cap,)).astype(jnp.float64)
+        cd = jnp.broadcast_to(cd, (cap,)).astype(jnp.float64)
+        bad = (cd <= 0) | (bd <= 0)
+        data = jnp.log(jnp.where(bad, 1.0, cd)) / jnp.log(jnp.where(bad, 2.0, bd))
+        valid = combine_validity(cap, bv, cv, ~bad,
+                                 row_mask(batch.num_rows, cap))
+        return make_column(DoubleT, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        bv, bm = _as_f64_array(self.children[0].eval_cpu(table, ctx),
+                               table.num_rows)
+        cv, cm = _as_f64_array(self.children[1].eval_cpu(table, ctx),
+                               table.num_rows)
+        mask = bm | cm | (cv <= 0) | (bv <= 0)
+        with np.errstate(all="ignore"):
+            out = np.log(np.where(cv <= 0, 1.0, cv)) / \
+                np.log(np.where(bv <= 0, 2.0, bv))
+        return pa.array(out, mask=mask)
+
+    def pretty(self) -> str:
+        return f"log({self.children[0].pretty()}, {self.children[1].pretty()})"
+
+
+class BRound(Round):
+    """bround(x, scale): HALF_EVEN (banker's) rounding — Spark GpuBRound."""
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import Literal
+        cap = batch.capacity
+        c = self.children[0].eval_tpu(batch, ctx)
+        scale = self.children[1].value if isinstance(self.children[1], Literal) else 0
+        d, v = device_parts(c, cap)
+        d = jnp.broadcast_to(d, (cap,))
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            m = 10.0 ** scale
+            data = (jnp.round(d.astype(jnp.float64) * m) / m).astype(d.dtype)
+        elif scale >= 0:
+            data = d
+        else:
+            m = np.int64(10 ** (-scale))
+            q = d // m          # floor quotient; remainder below is in [0, m)
+            rem = d - q * m
+            half = m // 2
+            up = (rem > half) | ((rem == half) & (q % 2 != 0))
+            data = (q + up.astype(q.dtype)) * m
+        valid = combine_validity(cap, v, row_mask(batch.num_rows, cap))
+        return make_column(self.dtype, data, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow.compute as pc
+        from .base import Literal
+        c = self.children[0].eval_cpu(table, ctx)
+        scale = self.children[1].value if isinstance(self.children[1], Literal) else 0
+        return pc.round(c, ndigits=scale, round_mode="half_to_even")
+
+    def pretty(self) -> str:
+        return f"bround({self.children[0].pretty()}, {self.children[1].pretty()})"

@@ -1,0 +1,188 @@
+"""Nondeterministic / task-context expressions.
+
+Reference: GpuMonotonicallyIncreasingID, GpuSparkPartitionID, GpuRand
+(catalyst/expressions/GpuRandomExpressions.scala), GpuInputFileName /
+GpuInputFileBlockStart / GpuInputFileBlockLength (InputFileBlockRule).
+These read task-scoped state from EvalContext (partition id, input-file info,
+running row counters) instead of JVM TaskContext thread-locals.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..types import DataType, DoubleT, IntegerT, LongT, StringT
+from ..columnar.vector import TpuColumnVector, TpuScalar, row_mask
+from .base import Expression, _DEFAULT_CTX, make_column
+
+
+class _LeafExpression(Expression):
+    children = ()
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class SparkPartitionID(_LeafExpression):
+    """spark_partition_id(): the task's partition index."""
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        data = jnp.full((cap,), ctx.partition_id, jnp.int32)
+        return make_column(IntegerT, data, row_mask(batch.num_rows, cap),
+                           batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        return pa.array([ctx.partition_id] * table.num_rows, pa.int32())
+
+    def pretty(self) -> str:
+        return "spark_partition_id()"
+
+
+class MonotonicallyIncreasingID(_LeafExpression):
+    """monotonically_increasing_id(): (partition_id << 33) + row index within
+    the partition, accumulated across batches via the ctx row counter — the
+    same layout Spark documents (33 bits of per-partition record number)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    def _offset(self, ctx, n: int) -> int:
+        off = ctx.row_counters.get(id(self), 0)
+        ctx.row_counters[id(self)] = off + n
+        return off
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        off = self._offset(ctx, batch.num_rows)
+        base = (ctx.partition_id << 33) + off
+        data = base + jnp.arange(cap, dtype=jnp.int64)
+        return make_column(LongT, data, row_mask(batch.num_rows, cap),
+                           batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        n = table.num_rows
+        off = self._offset(ctx, n)
+        base = (ctx.partition_id << 33) + off
+        return pa.array(range(base, base + n), pa.int64())
+
+    def pretty(self) -> str:
+        return "monotonically_increasing_id()"
+
+
+class Rand(_LeafExpression):
+    """rand(seed): uniform [0,1) doubles, deterministic per
+    (seed, partition, row). Uses jax's threefry counter PRNG keyed by
+    (seed, partition) and indexed by absolute row position — reproducible
+    under re-execution like Spark's XORShiftRandom, though the sequence
+    itself differs (priced as incompat)."""
+
+    def __init__(self, seed: Expression = None):
+        from .base import Literal
+        self.children = (seed if seed is not None else Literal(0),)
+
+    @property
+    def dtype(self) -> DataType:
+        return DoubleT
+
+    def _seed(self):
+        from .base import Literal
+        s = self.children[0]
+        return int(s.value) if isinstance(s, Literal) and s.value is not None else 0
+
+    def _offset(self, ctx, n: int) -> int:
+        off = ctx.row_counters.get(id(self), 0)
+        ctx.row_counters[id(self)] = off + n
+        return off
+
+    def _values(self, ctx, off: int, n: int):
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed()),
+                                 ctx.partition_id)
+        # counter-mode: one fold per batch start keeps draws independent of
+        # batch boundaries without materializing per-row keys
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(off, off + n, dtype=jnp.uint32))
+        return jax.vmap(lambda k: jax.random.uniform(k, dtype=jnp.float64))(keys)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        vals = self._values(ctx, self._offset(ctx, batch.num_rows), cap)
+        return make_column(DoubleT, vals, row_mask(batch.num_rows, cap),
+                           batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import numpy as np
+        import pyarrow as pa
+        n = table.num_rows
+        vals = self._values(ctx, self._offset(ctx, n), n)
+        return pa.array(np.asarray(vals, dtype=np.float64), pa.float64())
+
+    def pretty(self) -> str:
+        return f"rand({self.children[0].pretty()})"
+
+
+class InputFileName(_LeafExpression):
+    """input_file_name(): current scan file, '' outside a file scan
+    (Spark semantics; set by the multi-file readers via EvalContext)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return StringT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        name = ctx.input_file or ""
+        return TpuColumnVector.from_scalar(name, StringT, batch.num_rows,
+                                           capacity=batch.capacity)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        return pa.array([ctx.input_file or ""] * table.num_rows, pa.string())
+
+    def pretty(self) -> str:
+        return "input_file_name()"
+
+
+class _InputFileLong(_LeafExpression):
+    _field = "input_block_start"
+
+    @property
+    def dtype(self) -> DataType:
+        return LongT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        data = jnp.full((cap,), getattr(ctx, self._field), jnp.int64)
+        return make_column(LongT, data, row_mask(batch.num_rows, cap),
+                           batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        return pa.array([getattr(ctx, self._field)] * table.num_rows,
+                        pa.int64())
+
+
+class InputFileBlockStart(_InputFileLong):
+    _field = "input_block_start"
+
+    def pretty(self) -> str:
+        return "input_file_block_start()"
+
+
+class InputFileBlockLength(_InputFileLong):
+    _field = "input_block_length"
+
+    def pretty(self) -> str:
+        return "input_file_block_length()"

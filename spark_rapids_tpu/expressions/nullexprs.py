@@ -159,3 +159,139 @@ class NaNvl(Expression):
         a = self.children[0].eval_cpu(table, ctx)
         b = self.children[1].eval_cpu(table, ctx)
         return pc.if_else(pc.fill_null(pc.is_nan(a), False), b, a)
+
+
+class AtLeastNNonNulls(Expression):
+    """Filter helper used by df.na.drop (reference GpuAtLeastNNonNulls):
+    true when at least n of the children evaluate non-null (NaN counts as
+    null for float children, matching Spark)."""
+
+    def __init__(self, n: int, *children: Expression):
+        self.n = int(n)
+        self.children = tuple(children)
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        cap = batch.capacity
+        count = jnp.zeros((cap,), jnp.int32)
+        for c in self.children:
+            v = c.eval_tpu(batch, ctx)
+            if isinstance(v, TpuScalar):
+                import math
+                nn = v.value is not None and not (
+                    isinstance(v.value, float) and math.isnan(v.value))
+                nonnull = jnp.full((cap,), nn, jnp.bool_)
+            else:
+                nonnull = v.validity if v.validity is not None \
+                    else jnp.ones((cap,), jnp.bool_)
+                if jnp.issubdtype(v.data.dtype, jnp.floating):
+                    nonnull = nonnull & ~jnp.isnan(v.data)
+            count = count + nonnull.astype(jnp.int32)
+        data = (count >= self.n) & row_mask(batch.num_rows, cap)
+        return make_column(BooleanT, data, None, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import math
+        import pyarrow as pa
+        n = table.num_rows
+        cols = []
+        for c in self.children:
+            r = c.eval_cpu(table, ctx)
+            cols.append(r.to_pylist() if isinstance(r, (pa.Array, pa.ChunkedArray))
+                        else [r] * n)
+        out = []
+        for row in zip(*cols) if cols else []:
+            nn = sum(1 for v in row
+                     if v is not None and not (isinstance(v, float) and math.isnan(v)))
+            out.append(nn >= self.n)
+        if not cols:
+            out = [0 >= self.n] * table.num_rows
+        return pa.array(out, pa.bool_())
+
+    def pretty(self) -> str:
+        return f"atleastnnonnulls({self.n}, {', '.join(c.pretty() for c in self.children)})"
+
+
+class KnownNotNull(UnaryExpression):
+    """Optimizer marker: child is known non-null (reference GpuKnownNotNull).
+    Evaluation is a passthrough that drops the validity mask."""
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        # pure passthrough: the marker is a planner assertion, not a cast —
+        # stripping validity here would turn erroneously-null rows into zeros
+        return self.child.eval_tpu(batch, ctx)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return self.child.eval_cpu(table, ctx)
+
+    def pretty(self) -> str:
+        return f"knownnotnull({self.child.pretty()})"
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    """Optimizer marker: NaN/-0.0 already normalized — pure passthrough
+    (reference GpuKnownFloatingPointNormalized)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        return self.child.eval_tpu(batch, ctx)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        return self.child.eval_cpu(table, ctx)
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize NaN bit patterns and -0.0 → 0.0 so float grouping/join
+    keys compare by equality (reference GpuNormalizeNaNAndZero)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            import math
+            v = c.value
+            if isinstance(v, float):
+                if math.isnan(v):
+                    v = float("nan")
+                elif v == 0.0:
+                    v = 0.0
+            return TpuScalar(c.dtype, v)
+        d = c.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+            d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, d.dtype), d)
+        return TpuColumnVector(c.dtype, d, c.validity, c.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        arr = self.child.eval_cpu(table, ctx)
+        if not (pa.types.is_floating(arr.type)):
+            return arr
+        import numpy as np
+        import pyarrow.compute as pc
+        vals = np.asarray(arr.fill_null(0).to_numpy(zero_copy_only=False)).copy()
+        vals[vals == 0] = 0.0
+        vals[np.isnan(vals)] = float("nan")
+        mask = np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False)).astype(bool)
+        return pa.array(vals, mask=mask)

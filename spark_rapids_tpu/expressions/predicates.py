@@ -369,3 +369,73 @@ class In(Expression):
 
     def pretty(self) -> str:
         return f"{self.value.pretty()} IN ({', '.join(i.pretty() for i in self.items)})"
+
+
+class InSet(Expression):
+    """`value IN <set>` for a pre-materialized literal set — the optimizer's
+    large-list form of IN (reference GpuInSet). Device: one jnp.isin over a
+    constant device array (no per-item loop)."""
+
+    def __init__(self, value: Expression, items):
+        self.children = (value,)
+        self.items = list(items)
+        self._has_null = any(i is None for i in self.items)
+        self._non_null = [i for i in self.items if i is not None]
+
+    @property
+    def value(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self) -> DataType:
+        return BooleanT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import numpy as np
+        from ..types import StringType
+        v = self.value.eval_tpu(batch, ctx)
+        cap = batch.capacity
+        mask = row_mask(batch.num_rows, cap)
+        if isinstance(self.value.dtype, StringType):
+            # strings: reuse the In item-loop via literals (host hop avoided
+            # only for fixed-width carriers)
+            from .base import Literal
+            return In(self.value,
+                      [Literal(i) for i in self.items]).eval_tpu(batch, ctx)
+        vd, vv = device_parts(v, cap)
+        vv = vv if vv is not None else mask
+        if self._non_null:
+            items = jnp.asarray(np.array(self._non_null, dtype=vd.dtype))
+            found = jnp.isin(jnp.broadcast_to(vd, (cap,)), items)
+            if jnp.issubdtype(vd.dtype, jnp.floating) and \
+                    any(isinstance(i, float) and i != i for i in self._non_null):
+                found = found | jnp.isnan(vd)
+        else:
+            found = jnp.zeros((cap,), jnp.bool_)
+        if self._has_null:
+            valid = vv & found & mask  # unmatched rows become null
+        else:
+            valid = vv & mask
+        return make_column(BooleanT, found & vv, valid, batch.num_rows)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import math
+        import pyarrow as pa
+        vals = self.value.eval_cpu(table, ctx).to_pylist()
+        non_null = self._non_null
+        has_nan = any(isinstance(i, float) and math.isnan(i) for i in non_null)
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            elif isinstance(v, float) and math.isnan(v):
+                out.append(True if has_nan else (None if self._has_null else False))
+            elif any(v == i for i in non_null
+                     if not (isinstance(i, float) and math.isnan(i))):
+                out.append(True)
+            else:
+                out.append(None if self._has_null else False)
+        return pa.array(out, pa.bool_())
+
+    def pretty(self) -> str:
+        return f"{self.value.pretty()} INSET ({len(self.items)} values)"

@@ -1278,3 +1278,54 @@ class StringToMap(_HostRowOp):
             # duplicate keys: LAST_WIN (Spark's non-exception dedup policy)
             out[kv[0]] = kv[1] if len(kv) > 1 else None
         return list(out.items())
+
+
+class Ascii(UnaryExpression):
+    """ascii(str): code point of the first character, 0 for empty, null for
+    null (reference GpuAscii). Device: gather the first byte per row (exact
+    for ASCII; non-ASCII falls back to host for the full code point)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return IntegerT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        if isinstance(c, TpuScalar):
+            v = c.value
+            return TpuScalar(IntegerT, None if v is None
+                             else (ord(v[0]) if v else 0))
+        if _ascii_dev(c):
+            starts, lens = _sl(c)
+            nbytes = int(c.data.shape[0])
+            if nbytes == 0:
+                data = jnp.zeros((c.capacity,), jnp.int32)
+            else:
+                first = c.data[jnp.clip(starts, 0, nbytes - 1)].astype(jnp.int32)
+                data = jnp.where(lens > 0, first, 0)
+            valid = combine_validity(c.capacity, c.validity,
+                                     row_mask(batch.num_rows, c.capacity))
+            return make_column(IntegerT, data, valid, batch.num_rows)
+        from .collections import _result_from_pylist
+        arr = _to_arrow_side(c, batch)
+        return _result_from_pylist([None if v is None else (ord(v[0]) if v else 0)
+                                    for v in arr.to_pylist()], IntegerT, batch)
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
+        vals = self.child.eval_cpu(table, ctx).to_pylist()
+        return pa.array([None if v is None else (ord(v[0]) if v else 0)
+                         for v in vals], pa.int32())
+
+    def pretty(self) -> str:
+        return f"ascii({self.child.pretty()})"
+
+
+class StringInstr(StringLocate):
+    """instr(str, substr) == locate(substr, str, 1) (reference GpuStringInstr)."""
+
+    def __init__(self, child: Expression, substr: Expression):
+        super().__init__(substr, child)
+
+    def pretty(self) -> str:
+        return f"instr({self.children[1].pretty()}, {self.children[0].pretty()})"

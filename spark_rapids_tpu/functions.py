@@ -317,8 +317,20 @@ def add_months(date, months) -> Column:
     return Column(_D.AddMonths(_expr_or_col(date), _expr_or_col(months)))
 
 
-def unix_timestamp(ts) -> Column:
-    return Column(_D.UnixTimestampFromTs(_expr_or_col(ts)))
+def unix_timestamp(ts, fmt: str = None) -> Column:
+    """unix_timestamp(ts) for timestamp columns; string columns parse with
+    fmt (default yyyy-MM-dd HH:mm:ss, host-assisted, UTC)."""
+    from .types import StringType
+    e = _expr_or_col(ts)
+    if fmt is not None:
+        return Column(_D.UnixTimestamp(e, Literal(fmt)))
+    try:
+        is_string = isinstance(e.dtype, StringType)
+    except Exception:  # unresolved attribute: dtype unknown until binding
+        is_string = False
+    if is_string:
+        return Column(_D.UnixTimestamp(e, Literal("yyyy-MM-dd HH:mm:ss")))
+    return Column(_D.UnixTimestampFromTs(e))
 
 
 # --- window functions ------------------------------------------------------
@@ -746,3 +758,175 @@ def hilbert_index(num_bits: int, *cols) -> Column:
     """Hilbert-curve clustering key (reference zorder/GpuHilbertLongIndex.scala)."""
     from .expressions.zorder import HilbertLongIndex
     return Column(HilbertLongIndex(num_bits, [_expr_or_col(c) for c in cols]))
+
+
+# ---------------------------------------------------------------------------
+# breadth 2: math / null / misc / datetime / map-struct functions
+# ---------------------------------------------------------------------------
+
+def asinh(c) -> Column:
+    from .expressions.mathexprs import Asinh
+    return Column(Asinh(_expr_or_col(c)))
+
+
+def acosh(c) -> Column:
+    from .expressions.mathexprs import Acosh
+    return Column(Acosh(_expr_or_col(c)))
+
+
+def atanh(c) -> Column:
+    from .expressions.mathexprs import Atanh
+    return Column(Atanh(_expr_or_col(c)))
+
+
+def cot(c) -> Column:
+    from .expressions.mathexprs import Cot
+    return Column(Cot(_expr_or_col(c)))
+
+
+def degrees(c) -> Column:
+    from .expressions.mathexprs import ToDegrees
+    return Column(ToDegrees(_expr_or_col(c)))
+
+
+def radians(c) -> Column:
+    from .expressions.mathexprs import ToRadians
+    return Column(ToRadians(_expr_or_col(c)))
+
+
+def rint(c) -> Column:
+    from .expressions.mathexprs import Rint
+    return Column(Rint(_expr_or_col(c)))
+
+
+def hypot(a, b) -> Column:
+    from .expressions.mathexprs import Hypot
+    return Column(Hypot(_expr_or_col(a), _expr_or_col(b)))
+
+
+def log(base, c=None) -> Column:
+    """log(x) natural log, or log(base, x)."""
+    from .expressions.mathexprs import Log, Logarithm
+    if c is None:
+        return Column(Log(_expr_or_col(base)))
+    return Column(Logarithm(_expr_or_col(base), _expr_or_col(c)))
+
+
+def bround(c, scale: int = 0) -> Column:
+    from .expressions.mathexprs import BRound
+    return Column(BRound(_expr_or_col(c), Literal(scale)))
+
+
+def ascii(c) -> Column:
+    from .expressions.strings import Ascii
+    return Column(Ascii(_expr_or_col(c)))
+
+
+def md5(c) -> Column:
+    from .expressions.hashexprs import Md5
+    return Column(Md5(_expr_or_col(c)))
+
+
+def spark_partition_id() -> Column:
+    from .expressions.misc import SparkPartitionID
+    return Column(SparkPartitionID())
+
+
+def monotonically_increasing_id() -> Column:
+    from .expressions.misc import MonotonicallyIncreasingID
+    return Column(MonotonicallyIncreasingID())
+
+
+def rand(seed: int = 0) -> Column:
+    from .expressions.misc import Rand
+    return Column(Rand(Literal(seed)))
+
+
+def input_file_name() -> Column:
+    from .expressions.misc import InputFileName
+    return Column(InputFileName())
+
+
+def input_file_block_start() -> Column:
+    from .expressions.misc import InputFileBlockStart
+    return Column(InputFileBlockStart())
+
+
+def input_file_block_length() -> Column:
+    from .expressions.misc import InputFileBlockLength
+    return Column(InputFileBlockLength())
+
+
+def timestamp_seconds(c) -> Column:
+    from .expressions.datetime import SecondsToTimestamp
+    return Column(SecondsToTimestamp(_expr_or_col(c)))
+
+
+def timestamp_millis(c) -> Column:
+    from .expressions.datetime import MillisToTimestamp
+    return Column(MillisToTimestamp(_expr_or_col(c)))
+
+
+def timestamp_micros(c) -> Column:
+    from .expressions.datetime import MicrosToTimestamp
+    return Column(MicrosToTimestamp(_expr_or_col(c)))
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    from .expressions.datetime import FromUnixTime
+    return Column(FromUnixTime(_expr_or_col(c), Literal(fmt)))
+
+
+def date_format(c, fmt: str) -> Column:
+    from .expressions.datetime import DateFormatClass
+    return Column(DateFormatClass(_expr_or_col(c), Literal(fmt)))
+
+
+def to_unix_timestamp(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    from .expressions.datetime import ToUnixTimestamp
+    return Column(ToUnixTimestamp(_expr_or_col(c), Literal(fmt)))
+
+
+def array_remove(c, elem) -> Column:
+    from .expressions.collections import ArrayRemove
+    e = elem if isinstance(elem, Column) else lit(elem)
+    return Column(ArrayRemove(_expr_or_col(c), _expr_or_col(e)))
+
+
+def map_entries(c) -> Column:
+    from .expressions.collections import MapEntries
+    return Column(MapEntries(_expr_or_col(c)))
+
+
+def map_filter(c, fn) -> Column:
+    from .expressions.collections import MapFilter
+    return Column(MapFilter(_expr_or_col(c), _lambda2(fn)))
+
+
+def transform_keys(c, fn) -> Column:
+    from .expressions.collections import TransformKeys
+    return Column(TransformKeys(_expr_or_col(c), _lambda2(fn)))
+
+
+def transform_values(c, fn) -> Column:
+    from .expressions.collections import TransformValues
+    return Column(TransformValues(_expr_or_col(c), _lambda2(fn)))
+
+
+def named_struct(*name_value_pairs) -> Column:
+    """named_struct(name1, col1, name2, col2, ...)."""
+    from .expressions.collections import CreateNamedStruct
+    names = [name_value_pairs[i] for i in range(0, len(name_value_pairs), 2)]
+    vals = [_expr_or_col(name_value_pairs[i])
+            for i in range(1, len(name_value_pairs), 2)]
+    return Column(CreateNamedStruct(names, vals))
+
+
+def _lambda2(fn):
+    """Python (k, v) -> Column lambda → LambdaFunction over two vars."""
+    from .expressions.collections import LambdaFunction, NamedLambdaVariable
+    from .types import StringT
+    k = NamedLambdaVariable("k", StringT)
+    v = NamedLambdaVariable("v", StringT)
+    body = fn(Column(k), Column(v))
+    return LambdaFunction(_expr_or_col(body), [k, v])

@@ -15,6 +15,28 @@ from ..expressions import predicates as P
 from ..expressions.nullexprs import IsNotNull, IsNull
 
 
+def _as_literal(e: Expression) -> Optional[Literal]:
+    """Literal, possibly under a VALUE-PRESERVING cast the analyzer inserted
+    (e.g. `k = cast(3 AS bigint)`). Only numeric-to-numeric casts of numeric
+    literals fold — a value-changing cast (string→long, string→date) must
+    not push its raw pre-cast value into pruning/row filters."""
+    from ..expressions.cast import Cast
+    from ..types import FractionalType, IntegralType
+    while isinstance(e, Cast):
+        inner = e.children[0]
+        if not isinstance(inner, Literal):
+            return None
+        v = inner.value
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if not isinstance(e.dtype, (IntegralType, FractionalType)):
+            return None
+        if isinstance(e.dtype, IntegralType) and not isinstance(v, int):
+            return None
+        e = inner
+    return e if isinstance(e, Literal) else None
+
+
 def _leaf_filter(e: Expression) -> Optional[Tuple[str, str, object]]:
     ops = {P.EqualTo: "==", P.LessThan: "<", P.LessThanOrEqual: "<=",
            P.GreaterThan: ">", P.GreaterThanOrEqual: ">="}
@@ -22,12 +44,13 @@ def _leaf_filter(e: Expression) -> Optional[Tuple[str, str, object]]:
     for cls, op in ops.items():
         if isinstance(e, cls):
             l, r = e.children
-            if isinstance(l, AttributeReference) and isinstance(r, Literal) \
-                    and r.value is not None:
-                return (l.name, op, r.value)
-            if isinstance(r, AttributeReference) and isinstance(l, Literal) \
-                    and l.value is not None:
-                return (r.name, flipped[op], l.value)
+            rl, ll = _as_literal(r), _as_literal(l)
+            if isinstance(l, AttributeReference) and rl is not None \
+                    and rl.value is not None:
+                return (l.name, op, rl.value)
+            if isinstance(r, AttributeReference) and ll is not None \
+                    and ll.value is not None:
+                return (r.name, flipped[op], ll.value)
     if isinstance(e, P.In) and isinstance(e.value, AttributeReference):
         vals = [i.value for i in e.items
                 if isinstance(i, Literal) and i.value is not None]

@@ -195,6 +195,82 @@ class FileScanBase:
         pf = f", pushed={len(self.pushed_filters)}" if self.pushed_filters else ""
         return f"{type(self).__name__}[{self.fmt}, {len(self.paths)} files{pf}]"
 
+    def _partition_columns(self):
+        return self.options.get("__partition_cols__", ())
+
+    def _attach_partition_cols(self, table, f: str):
+        """Append the file's hive-partition values as constant columns
+        (reference GpuFileSourceScanExec partitionColumns append)."""
+        pcols = self._partition_columns()
+        if not pcols:
+            return table
+        import pyarrow as pa
+        from ..types import to_arrow
+        vals = self.options.get("__partition_values__", {}).get(f, {})
+        for name, dtype in pcols:
+            raw = vals.get(name)
+            if raw == "__HIVE_DEFAULT_PARTITION__":
+                raw = None
+            py = None if raw is None else \
+                (int(raw) if to_arrow(dtype) == pa.int64() else raw)
+            col = pa.array([py] * table.num_rows, type=to_arrow(dtype))
+            table = table.append_column(name, col)
+        return table
+
+    def _prune_by_partition_values(self, files, conf=None):
+        """Static + dynamic partition pruning: drop files whose partition
+        values cannot satisfy the pushed filters, or that a runtime subquery
+        broadcast (DPP) rules out — all before any IO (reference: partition
+        filters + DynamicPruningExpression evaluated by the file index)."""
+        pcols = dict(self._partition_columns())
+        dpp = self.options.get("__dpp_filters__", ())
+        if not pcols or not (self._arrow_filter or dpp):
+            return files
+        import pyarrow as pa
+        from ..types import to_arrow
+        pvals = self.options.get("__partition_values__", {})
+        if dpp and conf is not None:
+            for name, subq in dpp:
+                if name not in pcols:
+                    continue
+                allowed = subq.values(conf)
+                kept = []
+                for f in files:
+                    raw = pvals.get(f, {}).get(name)
+                    if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
+                        continue
+                    v = int(raw) if to_arrow(pcols[name]) == pa.int64() else raw
+                    if v in allowed:
+                        kept.append(f)
+                files = kept
+        if not self._arrow_filter:
+            return files
+
+        def file_ok(f):
+            vals = pvals.get(f, {})
+            for name, op, lit in self._arrow_filter:
+                if name not in pcols:
+                    continue
+                raw = vals.get(name)
+                if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
+                    return False  # null partition never matches a comparison
+                v = int(raw) if to_arrow(pcols[name]) == pa.int64() else raw
+                if op == "==" and not v == lit:
+                    return False
+                if op == "<" and not v < lit:
+                    return False
+                if op == "<=" and not v <= lit:
+                    return False
+                if op == ">" and not v > lit:
+                    return False
+                if op == ">=" and not v >= lit:
+                    return False
+                if op == "in" and v not in lit:
+                    return False
+            return True
+
+        return [f for f in files if file_ok(f)]
+
     def _partition_tables(self, idx: int, ctx: TaskContext) -> Iterator:
         """Host-side reads for one partition under the selected strategy."""
         import pyarrow as pa
@@ -206,30 +282,40 @@ class FileScanBase:
             # reference's row-group pruning by footer statistics)
             files = [f for f in files
                      if _stats_may_match(file_stats.get(f), self._arrow_filter)]
+        files = self._prune_by_partition_values(files, ctx.conf)
         if not files:
             return
-        cols = [a.name for a in self._output_attrs]
+        part_names = {n for n, _ in self._partition_columns()}
+        cols = [a.name for a in self._output_attrs if a.name not in part_names]
+        # partition-column filters were applied above; only data-column
+        # leaves push down into the file reads
+        row_filter = None
+        if self._arrow_filter:
+            row_filter = [leaf for leaf in self._arrow_filter
+                          if leaf[0] not in part_names] or None
+
+        def read(f):
+            return self._attach_partition_cols(
+                _read_one(f, self.fmt, cols, row_filter, self.options), f)
+
         strategy = str(ctx.conf.get(PARQUET_READER_TYPE)).upper()
         if strategy == "AUTO":
             strategy = "COALESCING" if len(files) > 1 else "PERFILE"
         if strategy == "MULTITHREADED":
             n_threads = ctx.conf.get(MULTITHREAD_READ_NUM_THREADS)
             with _fut.ThreadPoolExecutor(max_workers=n_threads) as pool:
-                futs = [pool.submit(_read_one, f, self.fmt, cols,
-                                    self._arrow_filter, self.options)
-                        for f in files]
+                futs = [pool.submit(read, f) for f in files]
                 for f in futs:
                     t = f.result()
                     if t.num_rows:
                         yield t
         elif strategy == "COALESCING":
-            tables = [_read_one(f, self.fmt, cols, self._arrow_filter,
-                                self.options) for f in files]
+            tables = [read(f) for f in files]
             tables = [t for t in tables if t.num_rows] or tables[:1]
             yield pa.concat_tables(tables, promote_options="permissive")
         else:  # PERFILE
             for f in files:
-                t = _read_one(f, self.fmt, cols, self._arrow_filter, self.options)
+                t = read(f)
                 if t.num_rows:
                     yield t
 

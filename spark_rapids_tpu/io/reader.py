@@ -8,19 +8,77 @@ import os
 from typing import List, Optional
 
 
-def _expand(paths) -> List[str]:
+_DATA_EXTS = ("parquet", "orc", "csv", "json", "avro", "txt")
+
+
+def _dir_files(d: str) -> List[str]:
     out: List[str] = []
+    for ext in _DATA_EXTS:
+        out.extend(sorted(_glob.glob(os.path.join(d, f"*.{ext}"))))
+    return out
+
+
+def _discover(d: str, parts: dict, files: List[str], pvals: dict) -> None:
+    """Recursive hive-layout discovery: key=value subdirectories become
+    partition columns attached per file (reference PartitioningAwareFileIndex
+    / GpuFileSourceScanExec partition columns)."""
+    for f in _dir_files(d):
+        files.append(f)
+        pvals[f] = dict(parts)
+    for sub in sorted(os.listdir(d)):
+        full = os.path.join(d, sub)
+        if os.path.isdir(full) and "=" in sub:
+            k, _, v = sub.partition("=")
+            _discover(full, {**parts, k: v}, files, pvals)
+
+
+def _expand(paths, want_partitions: bool = False):
+    """Resolve paths to data files. With want_partitions, also returns
+    (partition column order, per-file partition values) discovered from
+    hive-style key=value directories."""
+    out: List[str] = []
+    pvals: dict = {}
+    pcols: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            for ext in ("parquet", "orc", "csv", "json", "avro", "txt"):
-                out.extend(sorted(_glob.glob(os.path.join(p, f"*.{ext}"))))
+            direct = _dir_files(p)
+            if direct or not want_partitions:
+                out.extend(direct)
+            else:
+                _discover(p, {}, out, pvals)
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(_glob.glob(p)))
         else:
             out.append(p)
     if not out:
         raise FileNotFoundError(f"no input files for {paths}")
+    if pvals:
+        seen = []
+        for f in out:
+            for k in pvals.get(f, {}):
+                if k not in seen:
+                    seen.append(k)
+        pcols = seen
+    if want_partitions:
+        return out, pcols, pvals
     return out
+
+
+def _partition_attr_types(pcols, pvals):
+    """Infer each partition column's type: bigint when every value parses as
+    an int, string otherwise (Spark's partition-column type inference,
+    restricted to the two common cases)."""
+    from ..types import LongT, StringT
+    types = {}
+    for c in pcols:
+        vals = [v.get(c) for v in pvals.values() if v.get(c) is not None]
+        try:
+            for v in vals:
+                int(v)
+            types[c] = LongT
+        except (TypeError, ValueError):
+            types[c] = StringT
+    return types
 
 
 class DataFrameReader:
@@ -73,18 +131,28 @@ class DataFrameReader:
     def _scan(self, paths, fmt: str):
         from ..plan.logical import FileScan
         from ..session import DataFrame
-        files = _expand(paths)
+        files, pcols, pvals = _expand(paths, want_partitions=True)
+        # per-scan copy: partition metadata must not leak into later loads
+        # through the same (reusable) reader object
+        scan_options = dict(self._options)
+        if pcols:
+            scan_options["__partition_cols__"] = [
+                (c, t) for c, t in _partition_attr_types(pcols, pvals).items()]
+            scan_options["__partition_values__"] = pvals
         schema_attrs = None
         if self._schema is not None:
             from ..expressions.base import AttributeReference
             from ..types import StructType, parse_ddl
             st = self._schema if isinstance(self._schema, StructType) \
                 else parse_ddl(str(self._schema))
-            self._options["__user_schema__"] = st
+            scan_options["__user_schema__"] = st
             schema_attrs = [AttributeReference(f.name, f.data_type, f.nullable)
                             for f in st.fields]
+            if pcols:
+                for c, t in _partition_attr_types(pcols, pvals).items():
+                    schema_attrs.append(AttributeReference(c, t, True))
         return DataFrame(FileScan(files, fmt, schema_attrs=schema_attrs,
-                                  options=self._options),
+                                  options=scan_options),
                          self._session)
 
     def parquet(self, *paths: str):

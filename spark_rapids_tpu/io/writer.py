@@ -67,7 +67,8 @@ class DataFrameWriter:
         os.makedirs(path, exist_ok=True)
 
     def _execute_partitions(self):
-        """Yield (partition_index, arrow table) from the physical plan."""
+        """Yield (partition_index, arrow table) from the physical plan
+        (non-file consumers: delta/iceberg transaction logs)."""
         from ..execs.base import TaskContext
         from ..plan.overrides import TpuOverrides
         from ..plan.planner import plan_physical
@@ -87,33 +88,42 @@ class DataFrameWriter:
             if tables:
                 yield p, pa.concat_tables(tables)
 
-    def _write(self, path: str, ext: str, write_fn) -> None:
+    def _write(self, path: str, ext: str, write_fn, fmt: str = None) -> None:
+        """File-format writes run as a DataWritingCommandExec at the plan
+        root, so the override engine tags/converts/meters the write
+        (reference GpuDataWritingCommandExec) instead of the driver
+        hand-executing partitions."""
         import pyarrow as pa
+        from ..execs.base import TaskContext
+        from ..execs.write import CpuDataWritingCommandExec, WriteSpec
+        from ..plan.overrides import TpuOverrides
+        from ..plan.planner import plan_physical
         self._prepare_dir(path)
-        wrote = False
-        for p, table in self._execute_partitions():
-            if self._partition_by:
-                self._write_dynamic(path, ext, write_fn, p, table)
-                wrote = True
-                continue
-            write_fn(table, os.path.join(path, f"part-{p:05d}.{ext}"))
-            wrote = True
-        if not wrote:
+        session = self._df.session
+        conf = session._rapids_conf()
+        child = plan_physical(self._df._plan, conf)
+        spec = WriteSpec(fmt or ext, path, ext, write_fn,
+                         list(self._partition_by), dict(self._options))
+        cmd = CpuDataWritingCommandExec(child, spec)
+        final = TpuOverrides.apply(cmd, conf)
+        wrote_files = False
+        for p in range(final.num_partitions()):
+            ctx = TaskContext(p, conf)
+            try:
+                for _ in final.execute_partition(p, ctx):
+                    pass
+            finally:
+                ctx.complete()
+        wrote_files = any(
+            os.path.isfile(os.path.join(root, f))
+            for root, _, files in os.walk(path) for f in files)
+        if not wrote_files:
             # empty result: still record the schema (parquet only)
             from ..types import to_arrow
             schema = pa.schema([(a.name, to_arrow(a.dtype))
                                 for a in self._df._plan.output])
             write_fn(schema.empty_table(),
                      os.path.join(path, f"part-00000.{ext}"))
-
-    def _write_dynamic(self, path, ext, write_fn, p, table) -> None:
-        """Dynamic-partition layout: key1=v1/key2=v2/part-NNNNN (reference
-        GpuFileFormatDataWriter dynamic partitioning)."""
-        from .layout import iter_hive_partitions
-        for _, subdir, sub in iter_hive_partitions(table, self._partition_by):
-            d = os.path.join(path, subdir)
-            os.makedirs(d, exist_ok=True)
-            write_fn(sub, os.path.join(d, f"part-{p:05d}.{ext}"))
 
     def parquet(self, path: str) -> None:
         import pyarrow.parquet as pq

@@ -116,7 +116,13 @@ class FileScan(LogicalPlan):
             sch = infer_hive_schema(p, self.options)
         else:
             raise ValueError(f"unknown format {self.fmt}")
-        return [AttributeReference(f.name, from_arrow(f.type), True) for f in sch]
+        attrs = [AttributeReference(f.name, from_arrow(f.type), True)
+                 for f in sch]
+        # hive-layout partition columns discovered by the reader: appended
+        # after the data columns, Spark's partitioned-read column order
+        for name, dtype in self.options.get("__partition_cols__", ()):
+            attrs.append(AttributeReference(name, dtype, True))
+        return attrs
 
     @property
     def output(self) -> List[AttributeReference]:

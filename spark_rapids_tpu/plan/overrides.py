@@ -211,8 +211,14 @@ def _tag_hash_join(meta: PlanMeta) -> None:
 
 
 def _convert_hash_join(meta: PlanMeta, ch):
-    from ..execs.joins import TpuShuffledHashJoinExec
+    from ..config import SYMMETRIC_JOIN_ENABLED
+    from ..execs.joins import (_MIRROR_JOIN, TpuShuffledHashJoinExec,
+                               TpuShuffledSymmetricHashJoinExec)
     p = meta.plan
+    if meta.conf.get(SYMMETRIC_JOIN_ENABLED) and p.join_type in _MIRROR_JOIN:
+        return TpuShuffledSymmetricHashJoinExec(
+            ch[0], ch[1], p.join_type, p.left_keys, p.right_keys,
+            p.condition, p.output, per_partition=p.per_partition)
     return TpuShuffledHashJoinExec(ch[0], ch[1], p.join_type, p.left_keys,
                                    p.right_keys, p.condition, p.output,
                                    per_partition=p.per_partition)
@@ -251,6 +257,52 @@ register_exec(_CpuBhj, "broadcast hash join",
 register_exec(_CpuBnlj, "broadcast nested loop join",
               "spark.rapids.sql.exec.BroadcastNestedLoopJoinExec",
               _tag_bnlj, _convert_bnlj)
+
+
+def _convert_cartesian(meta: PlanMeta, ch):
+    from ..execs.joins import TpuCartesianProductExec
+    p = meta.plan
+    return TpuCartesianProductExec(ch[0], ch[1], p.condition, p.output)
+
+
+from ..execs.joins import CpuCartesianProductExec as _CpuCart  # noqa: E402
+
+register_exec(_CpuCart, "cartesian product",
+              "spark.rapids.sql.exec.CartesianProductExec",
+              _tag_bnlj, _convert_cartesian)
+
+
+def _tag_write(meta: PlanMeta) -> None:
+    from ..config import ORC_WRITE_ENABLED, PARQUET_WRITE_ENABLED
+    fmt = meta.plan.spec.fmt
+    keys = {"parquet": PARQUET_WRITE_ENABLED, "orc": ORC_WRITE_ENABLED}
+    entry = keys.get(fmt)
+    if entry is not None and not meta.conf.get(entry):
+        meta.will_not_work_on_tpu(f"{fmt} writes disabled via {entry.key}")
+
+
+def _convert_write(meta: PlanMeta, ch):
+    from ..execs.write import TpuDataWritingCommandExec
+    return TpuDataWritingCommandExec(ch[0], meta.plan.spec)
+
+
+from ..execs.write import CpuDataWritingCommandExec as _CpuWrite  # noqa: E402
+
+register_exec(_CpuWrite, "data writing command",
+              "spark.rapids.sql.exec.DataWritingCommandExec",
+              _tag_write, _convert_write)
+
+
+def _convert_subquery_broadcast(meta: PlanMeta, ch):
+    from ..execs.subquery import TpuSubqueryBroadcastExec
+    return TpuSubqueryBroadcastExec(ch[0], meta.plan.key_ordinal)
+
+
+from ..execs.subquery import CpuSubqueryBroadcastExec as _CpuSubq  # noqa: E402
+
+register_exec(_CpuSubq, "subquery broadcast (DPP key collection)",
+              "spark.rapids.sql.exec.SubqueryBroadcastExec",
+              None, _convert_subquery_broadcast)
 
 
 def _tag_exchange(meta: PlanMeta) -> None:

@@ -116,6 +116,7 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
                                            estimated_size_bytes)
             threshold = conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
             r_size = estimated_size_bytes(right)
+            _plan_dpp(plan, left, right, conf, threshold, r_size)
             if (threshold > 0 and r_size is not None and r_size <= threshold
                     and plan.join_type in BROADCAST_RIGHT_TYPES
                     and left.num_partitions() > 1):
@@ -134,6 +135,17 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
             return CpuShuffledHashJoinExec(left, right, plan.join_type,
                                            plan.left_keys, plan.right_keys,
                                            plan.condition, plan.output)
+        if plan.join_type in ("inner", "cross"):
+            from ..config import AUTO_BROADCAST_JOIN_THRESHOLD
+            from ..execs.broadcast import estimated_size_bytes
+            from ..execs.joins import CpuCartesianProductExec
+            threshold = conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
+            r_size = estimated_size_bytes(right)
+            # neither side broadcastable → dedicated pairwise-partition
+            # product (Spark CartesianProductExec), not a broadcast NLJ
+            if threshold > 0 and r_size is not None and r_size > threshold:
+                return CpuCartesianProductExec(left, right, plan.condition,
+                                               plan.output)
         return CpuBroadcastNestedLoopJoinExec(left, right, plan.join_type,
                                               plan.condition, plan.output)
     if isinstance(plan, L.Generate):
@@ -152,3 +164,49 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
         from ..shuffle.exchange import plan_cpu_exchange
         return plan_cpu_exchange(plan, conf)
     raise NotImplementedError(f"no physical plan for {type(plan).__name__}")
+
+
+def _plan_dpp(join_plan, left_phys, right_phys, conf, threshold, r_size) -> None:
+    """Dynamic partition pruning (reference GpuSubqueryBroadcastExec +
+    DynamicPruningExpression): when an equi-join key is a hive partition
+    column of a scan on the probe side and the build side is small, attach a
+    runtime subquery that collects the build side's distinct keys so the scan
+    skips partitions before any IO. Pruning the left side is sound for join
+    types that cannot resurrect unmatched left rows."""
+    from ..config import SUBQUERY_BROADCAST_ENABLED
+    from ..execs.subquery import (CpuSubqueryBroadcastExec,
+                                  plan_dynamic_pruning)
+    from ..io.parquet import FileScanBase
+    if not conf.get(SUBQUERY_BROADCAST_ENABLED):
+        return
+    if join_plan.join_type not in ("inner", "leftsemi", "semi"):
+        return
+    if threshold <= 0 or r_size is None or r_size > threshold:
+        return
+    scans = [n for n in left_phys.collect_nodes()
+             if isinstance(n, FileScanBase)
+             and n.options.get("__partition_cols__")]
+    if not scans:
+        return
+    for lk, rk in zip(join_plan.left_keys, join_plan.right_keys):
+        name = getattr(lk, "name", None)
+        key_id = getattr(lk, "expr_id", None)
+        if name is None or key_id is None:
+            continue
+        ordinal = next((i for i, a in enumerate(right_phys.output)
+                        if a.expr_id == getattr(rk, "expr_id", None)), None)
+        if ordinal is None:
+            continue
+        subq = None  # one shared key collection per join key
+        for scan in scans:
+            # the join key must BE this scan's partition-column attribute
+            # (expr_id match) — a name-only match would prune unrelated
+            # scans that happen to share the partition column's name
+            if not any(a.expr_id == key_id and a.name == name
+                       for a in scan.output):
+                continue
+            if any(name == pc for pc, _ in
+                   scan.options.get("__partition_cols__", ())):
+                if subq is None:
+                    subq = CpuSubqueryBroadcastExec(right_phys, ordinal)
+                plan_dynamic_pruning(scan.options, name, subq)

@@ -220,6 +220,121 @@ def _register_builtin_exprs() -> None:
                   host_assisted=True)
     register_expr(CL.ZipWith, sig_nested, "zip_with", host_assisted=True)
 
+    for cls in (M.Asinh, M.Acosh, M.Atanh, M.Cot, M.ToDegrees, M.ToRadians,
+                M.Rint, M.Hypot):
+        register_expr(cls, TypeSigs.numeric + TypeSigs.fp,
+                      f"math fn {cls.__name__.lower()}")
+    register_expr(M.Logarithm, TypeSigs.fp, "log(base, x) — null on domain error")
+    register_expr(M.BRound, TypeSigs.numeric, "bround (HALF_EVEN)")
+
+    from ..expressions import misc as MISC
+    register_expr(MISC.SparkPartitionID, TypeSigs.integral,
+                  "spark_partition_id()")
+    register_expr(MISC.MonotonicallyIncreasingID, TypeSigs.integral,
+                  "monotonically_increasing_id()")
+    register_expr(MISC.Rand, TypeSigs.fp, "rand(seed) — device threefry PRNG",
+                  incompat="sequence differs from Spark XORShiftRandom")
+    register_expr(MISC.InputFileName, TypeSigs.STRING, "input_file_name()")
+    register_expr(MISC.InputFileBlockStart, TypeSigs.integral,
+                  "input_file_block_start()")
+    register_expr(MISC.InputFileBlockLength, TypeSigs.integral,
+                  "input_file_block_length()")
+
+    register_expr(N.AtLeastNNonNulls, TypeSigs.BOOLEAN,
+                  "at-least-n-non-nulls filter (na.drop)")
+    register_expr(N.KnownNotNull, sig_all_nested, "known-not-null marker")
+    register_expr(N.KnownFloatingPointNormalized, TypeSigs.fp,
+                  "known-normalized marker (passthrough)")
+    register_expr(N.NormalizeNaNAndZero, TypeSigs.fp,
+                  "NaN/-0.0 canonicalization")
+    register_expr(P.InSet, TypeSigs.BOOLEAN, "IN over a literal set (isin)")
+
+    register_expr(S.Ascii, TypeSigs.integral, "ascii (device first byte)",
+                  incompat="non-ASCII handled via host path")
+    register_expr(S.StringInstr, TypeSigs.integral,
+                  "instr (device first-match)",
+                  incompat="non-ASCII handled via host path")
+    register_expr(H.Md5, TypeSigs.STRING, "md5 hex digest", host_assisted=True)
+
+    register_expr(DT.DateSub, TypeSigs.DATE, "date_sub")
+    for cls in (DT.SecondsToTimestamp, DT.MillisToTimestamp,
+                DT.MicrosToTimestamp):
+        register_expr(cls, TypeSigs.TIMESTAMP,
+                      f"{cls.__name__.lower()} (device scaling)")
+    register_expr(DT.FromUnixTime, TypeSigs.STRING,
+                  "from_unixtime formatting (UTC)", host_assisted=True)
+    register_expr(DT.DateFormatClass, TypeSigs.STRING,
+                  "date_format (UTC)", host_assisted=True)
+    register_expr(DT.ToUnixTimestamp, TypeSigs.integral,
+                  "to_unix_timestamp (device for ts/date)",
+                  incompat="string parsing via host path, UTC only")
+    register_expr(DT.UnixTimestamp, TypeSigs.integral,
+                  "unix_timestamp (device for ts/date)",
+                  incompat="string parsing via host path, UTC only")
+
+    register_expr(CL.ArrayRemove, sig_nested,
+                  "array_remove (device for fixed-width + literal)",
+                  incompat="non-fixed-width / column needle via host path")
+    for cls in (CL.MapEntries, CL.MapFilter, CL.TransformKeys,
+                CL.TransformValues):
+        register_expr(cls, sig_nested, f"map fn {cls.__name__}",
+                      host_assisted=True)
+    for cls in (CL.GetStructField, CL.GetArrayStructFields,
+                CL.CreateNamedStruct):
+        register_expr(cls, sig_nested, f"struct fn {cls.__name__}",
+                      host_assisted=True)
+
+    # aggregate functions (reference GpuOverrides expr[Sum]/expr[Max]/... —
+    # each aggregate is an expression rule in its own right)
+    from ..expressions import aggregates as AGG
+    register_expr(AGG.Sum, TypeSigs.numeric, "sum aggregate (overflow-checked)")
+    register_expr(AGG.Average, TypeSigs.numeric, "average aggregate")
+    register_expr(AGG.Min, TypeSigs.comparable, "min aggregate")
+    register_expr(AGG.Max, TypeSigs.comparable, "max aggregate")
+    register_expr(AGG.Count, TypeSigs.integral, "count aggregate")
+    register_expr(AGG.CountDistinct, TypeSigs.integral, "count(distinct)")
+    register_expr(AGG.First, TypeSigs.all_basic + TypeSigs.NULL,
+                  "first(ignoreNulls) aggregate")
+    register_expr(AGG.Last, TypeSigs.all_basic + TypeSigs.NULL,
+                  "last(ignoreNulls) aggregate")
+    register_expr(AGG.StddevPop, TypeSigs.fp, "stddev_pop (Welford merge)")
+    register_expr(AGG.StddevSamp, TypeSigs.fp, "stddev_samp (Welford merge)")
+    register_expr(AGG.VariancePop, TypeSigs.fp, "var_pop (Welford merge)")
+    register_expr(AGG.VarianceSamp, TypeSigs.fp, "var_samp (Welford merge)")
+    register_expr(AGG.Corr, TypeSigs.fp, "corr aggregate")
+    register_expr(AGG.CovPopulation, TypeSigs.fp, "covar_pop aggregate")
+    register_expr(AGG.CovSample, TypeSigs.fp, "covar_samp aggregate")
+    register_expr(AGG.Percentile, TypeSigs.fp, "exact percentile (device sort)")
+    register_expr(AGG.ApproximatePercentile, TypeSigs.fp,
+                  "approx_percentile (t-digest style merge)",
+                  incompat="approximation differs from Spark's t-digest")
+    register_expr(AGG.CollectList, TypeSigs.nested_common, "collect_list")
+    register_expr(AGG.CollectSet, TypeSigs.nested_common, "collect_set")
+    from ..expressions import bloom as BLOOM
+    register_expr(BLOOM.BloomFilterAggregate, TypeSigs.BINARY,
+                  "bloom_filter_agg (device murmur3 bitset)")
+
+    # window functions (reference expr[Rank]/expr[Lag]/... in GpuOverrides)
+    from .. import window as WIN
+    register_expr(WIN.WindowExpression, TypeSigs.all_basic + TypeSigs.NULL,
+                  "windowed aggregate/function application")
+    register_expr(WIN.RowNumber, TypeSigs.integral, "row_number()")
+    register_expr(WIN.Rank, TypeSigs.integral, "rank()")
+    register_expr(WIN.DenseRank, TypeSigs.integral, "dense_rank()")
+    register_expr(WIN.NTile, TypeSigs.integral, "ntile(n)")
+    register_expr(WIN.Lag, TypeSigs.all_basic + TypeSigs.NULL,
+                  "lag(col, offset, default)")
+    register_expr(WIN.Lead, TypeSigs.all_basic + TypeSigs.NULL,
+                  "lead(col, offset, default)")
+
+    from ..expressions import generators as GEN2
+    register_expr(GEN2.ReplicateRows, TypeSigs.all_basic + TypeSigs.NULL,
+                  "replicate_rows generator (device gather)")
+    register_expr(GEN2.MultiAlias, TypeSigs.all_basic + TypeSigs.NULL,
+                  "multi-output alias")
+    register_expr(GEN2.GroupingExpr, TypeSigs.all_basic + TypeSigs.NULL,
+                  "grouping set marker")
+
     from ..expressions import bitwise as BW
     for cls in (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor):
         register_expr(cls, TypeSigs.integral, f"bitwise {cls.symbol}")

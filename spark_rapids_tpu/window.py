@@ -77,6 +77,7 @@ class WindowFunction(Expression):
     row_number/lead/lag)."""
 
     name = ""
+    unevaluable = True  # driven by the window exec (reference Unevaluable)
 
     def __init__(self, *children: Expression):
         self.children = tuple(children)
@@ -166,6 +167,8 @@ class Lag(WindowFunction):
 
 class WindowExpression(Expression):
     """fn OVER spec."""
+
+    unevaluable = True  # driven by the window exec (reference Unevaluable)
 
     def __init__(self, function: Expression, spec: WindowSpec):
         self.children = (function,)
