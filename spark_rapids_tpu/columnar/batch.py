@@ -194,7 +194,8 @@ def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
         data = col.data
     else:
         offsets = None
-        data = xp.concatenate([col.data, xp.zeros((pad,), col.data.dtype)])
+        data = xp.concatenate(
+            [col.data, xp.zeros((pad,) + col.data.shape[1:], col.data.dtype)])
     validity = col.validity
     if validity is not None:
         vxp = np if isinstance(validity, np.ndarray) else jnp
@@ -233,7 +234,8 @@ def _gather_column(col: TpuColumnVector, safe_idx, valid, out_rows: int,
         v = jnp.take(col.validity, safe_idx, axis=0) & valid
     else:
         v = valid
-    data = jnp.where(v, data, jnp.zeros((), data.dtype))
+    vb = v[:, None] if data.ndim == 2 else v  # decimal128 limb pairs
+    data = jnp.where(vb, data, jnp.zeros((), data.dtype))
     return TpuColumnVector(col.dtype, data, v, out_rows)
 
 
@@ -323,7 +325,8 @@ def concat_batches(batches: List[TpuColumnarBatch]) -> TpuColumnarBatch:
             out_cols.append(TpuColumnVector.from_arrow(merged))
         else:
             cap = bucket_capacity(total)
-            data = jnp.zeros((cap,), cols[0].data.dtype)
+            data = jnp.zeros((cap,) + cols[0].data.shape[1:],
+                             cols[0].data.dtype)
             validity = jnp.zeros((cap,), jnp.bool_)
             pos = 0
             for c in cols:

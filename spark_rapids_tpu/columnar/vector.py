@@ -59,15 +59,17 @@ def _np_to_jax(arr: np.ndarray):
 
 
 def device_layout_ok(dt: DataType) -> bool:
-    """Whether a type has a device (jax.Array) layout. Maps/structs and
-    decimal128 stay host-side (host_data-backed columns)."""
+    """Whether a type has a device (jax.Array) layout. Maps/structs stay
+    host-side (host_data-backed columns); decimal beyond precision 18 carries
+    as two int64 limbs per row (kernels/decimal128.py, reference
+    spark-rapids-jni DecimalUtils __int128)."""
     from ..types import MapType, StructType
     if isinstance(dt, (MapType, StructType)):
         return False
     if isinstance(dt, ArrayType):
         return device_layout_ok(dt.element_type)
     if isinstance(dt, DecimalType):
-        return dt.precision <= DecimalType.MAX_DEVICE_PRECISION
+        return dt.precision <= DecimalType.MAX_PRECISION
     return True
 
 
@@ -167,9 +169,16 @@ class TpuColumnVector:
             return pa.Array.from_buffers(atype, n, [bitmap, buf_offs, buf_data], null_count=nulls)
         vals = np.asarray(self.data[:n])
         if isinstance(self.dtype, DecimalType):
-            # int64-scaled carrier -> arrow decimal128
             import decimal as _d
             scale = self.dtype.scale
+            if vals.ndim == 2:  # two-limb decimal128 carrier
+                from ..kernels.decimal128 import limbs_to_int, scaled_decimal
+                py = [None if (mask is not None and mask[i]) else
+                      scaled_decimal(limbs_to_int(vals[i, 0], vals[i, 1]),
+                                     scale)
+                      for i in range(n)]
+                return pa.array(py, type=t2a(self.dtype))
+            # int64-scaled carrier -> arrow decimal128
             py = [None if (mask is not None and mask[i]) else
                   _d.Decimal(int(vals[i])).scaleb(-scale) for i in range(n)]
             return pa.array(py, type=t2a(self.dtype))
@@ -278,7 +287,20 @@ class TpuColumnVector:
                                               bucket=bucket)
         if isinstance(dtype, DecimalType):
             if dtype.precision > DecimalType.MAX_DEVICE_PRECISION:
-                raise TypeError("decimal128 columns stay host-side (CPU fallback)")
+                # two-limb carrier: (capacity, 2) int64 [hi, lo]
+                from ..kernels.decimal128 import pack, unscaled_int
+                unscaled = [0 if v is None else unscaled_int(v, dtype.scale)
+                            for v in arr.to_pylist()]
+                limbs = pack(unscaled)
+                cap = bucket_capacity(n, bucket)
+                buf = np.zeros((cap, 2), np.int64)
+                buf[:n] = limbs
+                vmask = None
+                if validity is not None and not validity.all():
+                    v = np.zeros(cap, dtype=bool)
+                    v[:n] = validity
+                    vmask = _np_to_jax(v)
+                return TpuColumnVector(dtype, _np_to_jax(buf), vmask, n)
             scaled = np.array(
                 [0 if v is None else int(v.scaleb(dtype.scale)) for v in arr.to_pylist()],
                 dtype=np.int64)
@@ -336,13 +358,25 @@ class TpuColumnVector:
             offs = (np.arange(num_rows + 1, dtype=np.int32) * len(raw))
             chars = np.tile(np.frombuffer(raw, dtype=np.uint8), max(num_rows, 1))
             return TpuColumnVector.from_strings(dtype, offs, chars, None, capacity=cap)
+        dec128 = (isinstance(dtype, DecimalType)
+                  and dtype.precision > DecimalType.MAX_DEVICE_PRECISION)
         if value is None:
+            if dec128:
+                buf = np.zeros((cap, 2), np.int64)
+                v = np.zeros(cap, dtype=bool)
+                return TpuColumnVector(dtype, _np_to_jax(buf), _np_to_jax(v),
+                                       num_rows)
             buf = np.zeros(num_rows, dtype=dtype.np_dtype or np.bool_)
             return TpuColumnVector.from_numpy(dtype, buf,
                                               np.zeros(num_rows, dtype=bool), capacity=cap)
         if isinstance(dtype, DecimalType):
-            import decimal as _d
-            value = int(_d.Decimal(value).scaleb(dtype.scale))
+            from ..kernels.decimal128 import unscaled_int
+            value = unscaled_int(value, dtype.scale)
+            if dec128:
+                from ..kernels.decimal128 import int_to_limbs
+                buf = np.zeros((cap, 2), np.int64)
+                buf[:num_rows] = int_to_limbs(value)
+                return TpuColumnVector(dtype, _np_to_jax(buf), None, num_rows)
         buf = np.full(num_rows, value, dtype=dtype.np_dtype)
         return TpuColumnVector.from_numpy(dtype, buf, None, capacity=cap)
 

@@ -457,6 +457,13 @@ def _sortable_bits(col: TpuColumnVector):
     (floats: sign-flipped IEEE bits with NaN canonicalized and -0→0 — the same
     trick radix sorts use; cuDF does this inside its sort kernels)."""
     d = col.data
+    if getattr(d, "ndim", 1) != 1:
+        # decimal128 limb pairs have no single-int64 order encoding; the
+        # tagging layer keeps such columns off device sorts/joins — raising
+        # here turns a would-be silent mis-sort into a loud error
+        raise NotImplementedError(
+            f"no sortable encoding for {col.dtype.simple_string()} "
+            f"(two-limb carrier)")
     if jnp.issubdtype(d.dtype, jnp.floating):
         d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
         canon = jnp.asarray(np.array(np.nan, d.dtype))

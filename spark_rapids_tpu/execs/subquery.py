@@ -9,7 +9,13 @@ keys are collected, and the scan prunes partitions whose values can't match.
 Here the pruning handle hangs off the scan's options (the scan evaluates it
 before any file IO — see FileScanBase._prune_by_partition_values). The build
 plan itself goes through the override engine on first evaluation, so the key
-collection runs on device when the build side does."""
+collection runs on device when the build side does.
+
+Known cost vs the reference: the join re-executes the same build subtree for
+its own hash table (the reference reuses the materialized broadcast batch).
+One subquery instance is shared across all scans per join key, so the build
+side runs at most twice per query; broadcast-result reuse is the planned
+refinement."""
 
 from __future__ import annotations
 

@@ -35,12 +35,103 @@ def _ansi_check(flag, ctx: EvalContext, message: str) -> None:
 class BinaryArithmetic(BinaryExpression):
     symbol = "?"
 
+    #: decimal128 limb kernel (kernels/decimal128), set on Add/Subtract/Multiply
+    _dec128_op = None
+
     @property
     def dtype(self) -> DataType:
         return self.left.dtype
 
     def pretty(self) -> str:
         return f"({self.children[0].pretty()} {self.symbol} {self.children[1].pretty()})"
+
+    def _is_dec128(self) -> bool:
+        return (isinstance(self.dtype, DecimalType)
+                and self.dtype.precision > DecimalType.MAX_DEVICE_PRECISION
+                and type(self)._dec128_op is not None)
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        if self._is_dec128():
+            return self._dec128_eval(batch, ctx)
+        return super().eval_tpu(batch, ctx)
+
+    def _dec128_eval(self, batch, ctx):
+        """Two-limb 128-bit path (reference spark-rapids-jni DecimalUtils):
+        overflow beyond the result precision → null (ANSI: error), Spark's
+        decimal overflow semantics."""
+        from .base import combine_validity, device_parts, make_column
+        from ..columnar.vector import row_mask
+        from ..kernels import decimal128 as D
+        cap = batch.capacity
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        ld, lv = device_parts(l, cap)
+        rd, rv = device_parts(r, cap)
+
+        def limbs(d):
+            if getattr(d, "ndim", 0) == 2:
+                if d.shape[0] == 1:                  # scalar limb pair (1, 2)
+                    return (jnp.full((cap,), d[0, 0], jnp.int64),
+                            jnp.full((cap,), d[0, 1], jnp.int64))
+                return d[:, 0], d[:, 1]              # (cap, 2) column
+            # scaled-int64 (≤18) operand: sign-extend into limbs
+            return D.from_int64(jnp.broadcast_to(d, (cap,)))
+
+        lh, ll = limbs(ld)
+        rh, rl = limbs(rd)
+        h, lo, ovf = type(self)._dec128_op(lh, ll, rh, rl)
+        ovf = ovf | D.precision_overflow(h, lo, self.dtype.precision)
+        valid = combine_validity(cap, lv, rv, row_mask(batch.num_rows, cap))
+        if ctx.ansi:
+            bad = ovf if valid is None else (ovf & valid)
+            _ansi_check(bad, ctx,
+                        f"decimal overflow in {type(self).__name__.lower()}")
+        valid = combine_validity(cap, valid, ~ovf)
+        data = jnp.stack([h, lo], axis=1)
+        return make_column(self.dtype, data, valid, batch.num_rows)
+
+    def _py_op(self, a: int, b: int) -> int:
+        raise NotImplementedError
+
+    def _dec128_cpu(self, l, r, ctx):
+        """Host oracle for decimal128: exact python ints with Spark's
+        null-on-overflow (ANSI: error)."""
+        import pyarrow as pa
+        from ..kernels.decimal128 import scaled_decimal, unscaled_int
+        from ..types import to_arrow as type_to_arrow
+        scale = self.dtype.scale
+        bound = 10 ** self.dtype.precision - 1
+
+        def vals(x, n):
+            if isinstance(x, (pa.Array, pa.ChunkedArray)):
+                return [None if v is None else
+                        unscaled_int(v, _scale_of(x.type))
+                        for v in x.to_pylist()], len(x)
+            return None, n
+
+        la = l if isinstance(l, (pa.Array, pa.ChunkedArray)) else None
+        ra = r if isinstance(r, (pa.Array, pa.ChunkedArray)) else None
+        n = len(la) if la is not None else len(ra)
+        lv, _ = vals(l, n)
+        rv, _ = vals(r, n)
+        if lv is None:
+            lv = [None if l is None else unscaled_int(l, scale)] * n
+        if rv is None:
+            rv = [None if r is None else unscaled_int(r, scale)] * n
+        out = []
+        for a, b in zip(lv, rv):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            v = self._py_op(a, b)
+            if abs(v) > bound:
+                if ctx.ansi:
+                    raise ExpressionError(
+                        f"decimal overflow in {type(self).__name__.lower()}")
+                out.append(None)
+            else:
+                out.append(scaled_decimal(v, scale))
+        return pa.array(out, type=type_to_arrow(self.dtype))
 
     def _arrow_fn(self, ctx: EvalContext):
         raise NotImplementedError
@@ -51,6 +142,8 @@ class BinaryArithmetic(BinaryExpression):
         from ..types import to_arrow as type_to_arrow
         l = self.left.eval_cpu(table, ctx)
         r = self.right.eval_cpu(table, ctx)
+        if self._is_dec128():
+            return self._dec128_cpu(l, r, ctx)
         try:
             out = self._cpu_compute(l, r, ctx)
         except pa.ArrowInvalid as e:
@@ -67,6 +160,9 @@ class BinaryArithmetic(BinaryExpression):
 
 class Add(BinaryArithmetic):
     symbol = "+"
+
+    def _py_op(self, a, b):
+        return a + b
 
     def _compute(self, l, r, ctx, valid):
         out = l + r  # int overflow wraps (XLA two's-complement), matching Java
@@ -85,6 +181,9 @@ class Add(BinaryArithmetic):
 class Subtract(BinaryArithmetic):
     symbol = "-"
 
+    def _py_op(self, a, b):
+        return a - b
+
     def _compute(self, l, r, ctx, valid):
         out = l - r
         if ctx.ansi and isinstance(self.dtype, IntegralType):
@@ -101,6 +200,9 @@ class Subtract(BinaryArithmetic):
 
 class Multiply(BinaryArithmetic):
     symbol = "*"
+
+    def _py_op(self, a, b):
+        return a * b
 
     def _compute(self, l, r, ctx, valid):
         out = l * r
@@ -366,3 +468,18 @@ class Abs(UnaryExpression):
         import pyarrow.compute as pc
         c = self.child.eval_cpu(table, ctx)
         return pc.abs_checked(c) if ctx.ansi else pc.abs(c)
+
+
+def _scale_of(arrow_type) -> int:
+    import pyarrow as pa
+    return arrow_type.scale if pa.types.is_decimal(arrow_type) else 0
+
+
+def _wire_dec128():
+    from ..kernels import decimal128 as D
+    Add._dec128_op = staticmethod(D.add128)
+    Subtract._dec128_op = staticmethod(D.sub128)
+    Multiply._dec128_op = staticmethod(D.mul128)
+
+
+_wire_dec128()

@@ -35,8 +35,10 @@ class EvalContext:
 
     def __init__(self, conf: Optional[RapidsConf] = None,
                  partition_id: int = 0):
+        from ..config import SESSION_TZ
         self.conf = conf or default_conf()
         self.ansi = self.conf.ansi_enabled
+        self.tz = self.conf.get(SESSION_TZ) or "UTC"
         self.partition_id = partition_id
         self.input_file: Optional[str] = None
         self.input_block_start: int = -1
@@ -303,13 +305,22 @@ def device_parts(x: ColOrScalar, capacity: int):
     """Return (data, validity_or_None) with data broadcastable to (capacity,).
     Fixed-width only; strings use expressions/strings.py helpers."""
     if isinstance(x, TpuScalar):
+        dec128 = (isinstance(x.dtype, DecimalType)
+                  and x.dtype.precision > DecimalType.MAX_DEVICE_PRECISION)
         if x.value is None:
+            if dec128:
+                # (1, 2): row axis present so a 2-row unbucketed column can
+                # never be mistaken for a scalar limb pair
+                return jnp.zeros((1, 2), jnp.int64), jnp.zeros((capacity,), jnp.bool_)
             dt = x.dtype.np_dtype or np.bool_
             return jnp.zeros((), dt), jnp.zeros((capacity,), jnp.bool_)
         val = x.value
         if isinstance(x.dtype, DecimalType):
-            import decimal as _d
-            val = int(_d.Decimal(val).scaleb(x.dtype.scale))
+            from ..kernels.decimal128 import unscaled_int
+            val = unscaled_int(val, x.dtype.scale)
+            if dec128:
+                from ..kernels.decimal128 import int_to_limbs
+                return jnp.asarray([int_to_limbs(val)], jnp.int64), None
         return jnp.asarray(val, x.dtype.np_dtype), None
     return x.data, x.validity
 
@@ -328,7 +339,8 @@ def make_column(dtype: DataType, data: jax.Array, validity, num_rows: int,
     if validity is not None:
         # zero out null slots so downstream kernels never see garbage
         if offsets is None:
-            data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+            vb = validity[:, None] if getattr(data, "ndim", 1) == 2 else validity
+            data = jnp.where(vb, data, jnp.zeros((), data.dtype))
     return TpuColumnVector(dtype, data, validity, num_rows, offsets=offsets)
 
 

@@ -5,9 +5,11 @@ spark-rapids-jni DateTimeRebase/GpuTimeZoneDB. Carriers: DateType = int32 days
 since epoch, TimestampType = int64 micros since epoch UTC (Spark internal
 representation). Device field extraction uses Howard Hinnant's civil-calendar
 integer algorithms — pure elementwise integer math, ideal for the VPU (the
-reference calls cuDF datetime kernels). Session-timezone math beyond UTC is
-gated by the tagging layer (non-UTC → CPU, like the reference before its
-TimeZoneDB support).
+reference calls cuDF datetime kernels). Session-timezone math runs on device
+for any zone with a TZif table: tzdb.TimeZoneDB loads transition tables and
+the conversion is a searchsorted + gather before the civil-calendar math
+(reference GpuTimeZoneDB); zones without a table fall back to the host arrow
+path inside the op.
 """
 
 from __future__ import annotations
@@ -59,8 +61,33 @@ def _days_of(d, dtype):
     return d.astype(jnp.int32)
 
 
+def _localize_micros(d, dtype, ctx):
+    """Timestamp micros → session-timezone wall-clock micros (device TZ DB
+    binary search; reference GpuTimeZoneDB). Non-timestamp inputs and UTC
+    sessions pass through. Returns None when the zone has no TZif table —
+    callers fall back to the host arrow path."""
+    from ..tzdb import TimeZoneDB, is_utc
+    if not isinstance(dtype, TimestampType) or is_utc(getattr(ctx, "tz", None)):
+        return d
+    db = TimeZoneDB.get(ctx.tz)
+    if db is None:
+        return None
+    return db.utc_to_local(d.astype(jnp.int64))
+
+
+def _cpu_session_ts(arr, ctx):
+    """Arrow timestamp column re-flagged to the session timezone so arrow's
+    temporal kernels extract LOCAL fields (instant unchanged)."""
+    import pyarrow as pa
+    if pa.types.is_timestamp(arr.type):
+        tz = getattr(ctx, "tz", None) or "UTC"
+        return arr.cast(pa.timestamp(arr.type.unit, tz=tz))
+    return arr
+
+
 class _DateField(UnaryExpression):
-    """Extract an integer field from date/timestamp."""
+    """Extract an integer field from date/timestamp (session-timezone aware
+    for timestamps)."""
 
     @property
     def dtype(self) -> DataType:
@@ -72,16 +99,28 @@ class _DateField(UnaryExpression):
         c = self.child.eval_tpu(batch, ctx)
         cap = batch.capacity
         d, v = device_parts(c, cap)
-        days = _days_of(jnp.broadcast_to(d, (cap,)), self.child.dtype)
-        data = self._field(days, jnp.broadcast_to(d, (cap,)))
+        d = jnp.broadcast_to(d, (cap,))
+        local = _localize_micros(d, self.child.dtype, ctx)
+        if local is None:  # zone has no TZif table → host oracle path
+            from .base import to_column
+            from .collections import _result_from_pylist
+            col = to_column(c, batch, self.child.dtype)
+            arr = _cpu_session_ts(col.to_arrow(), ctx)
+            return _result_from_pylist(self._arrow_field(arr).to_pylist(),
+                                       IntegerT, batch)
+        days = _days_of(local, self.child.dtype)
+        data = self._field(days, local)
         valid = combine_validity(cap, v, row_mask(batch.num_rows, cap))
         return make_column(IntegerT, data, valid, batch.num_rows)
 
-    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+    def _arrow_field(self, arr):
         import pyarrow as pa
         import pyarrow.compute as pc
-        c = self.child.eval_cpu(table, ctx)
-        return pc.cast(getattr(pc, self._arrow_fn)(c), pa.int32())
+        return pc.cast(getattr(pc, self._arrow_fn)(arr), pa.int32())
+
+    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+        c = _cpu_session_ts(self.child.eval_cpu(table, ctx), ctx)
+        return self._arrow_field(c)
 
     def pretty(self) -> str:
         return f"{type(self).__name__.lower()}({self.child.pretty()})"
@@ -125,12 +164,11 @@ class DayOfWeek(_DateField):
     def _field(self, days, raw):
         return ((days.astype(jnp.int64) + 4) % 7 + 1).astype(jnp.int32)
 
-    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+    def _arrow_field(self, arr):
         import pyarrow as pa
         import pyarrow.compute as pc
-        c = self.child.eval_cpu(table, ctx)
         # Spark: 1=Sunday..7=Saturday == arrow week_start=7, count_from_zero=False
-        dow = pc.day_of_week(c, week_start=7, count_from_zero=False)
+        dow = pc.day_of_week(arr, week_start=7, count_from_zero=False)
         return pc.cast(dow, pa.int32())
 
 
@@ -140,10 +178,10 @@ class WeekDay(_DateField):
     def _field(self, days, raw):
         return ((days.astype(jnp.int64) + 3) % 7).astype(jnp.int32)
 
-    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+    def _arrow_field(self, arr):
         import pyarrow as pa
         import pyarrow.compute as pc
-        return pc.cast(pc.day_of_week(self.child.eval_cpu(table, ctx)), pa.int32())
+        return pc.cast(pc.day_of_week(arr), pa.int32())
 
 
 class DayOfYear(_DateField):
@@ -167,10 +205,10 @@ class WeekOfYear(_DateField):
         jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d)).astype(jnp.int64)
         return ((thursday - jan1) // 7 + 1).astype(jnp.int32)
 
-    def eval_cpu(self, table, ctx=_DEFAULT_CTX):
+    def _arrow_field(self, arr):
         import pyarrow as pa
         import pyarrow.compute as pc
-        return pc.cast(pc.iso_week(self.child.eval_cpu(table, ctx)), pa.int32())
+        return pc.cast(pc.iso_week(arr), pa.int32())
 
 
 class _TimeField(_DateField):
@@ -488,6 +526,36 @@ def _java_to_strftime(pattern: str) -> str:
     return "".join(out)
 
 
+def _session_zone(ctx):
+    """tzinfo of the session timezone (UTC default; unknown zones fall back
+    to UTC rather than crashing the host formatting path)."""
+    import datetime as _dt
+    from ..tzdb import is_utc
+    tz = getattr(ctx, "tz", None)
+    if is_utc(tz):
+        return _dt.timezone.utc
+    try:
+        from zoneinfo import ZoneInfo
+        return ZoneInfo(tz)
+    except Exception:  # noqa: BLE001 — unknown zone name
+        return _dt.timezone.utc
+
+
+_SF_CACHE: dict = {}
+
+
+def _strftime_cached(fmt):
+    """fmt → strftime string (memoized); None for null/unsupported fmt."""
+    if fmt is None:
+        return None
+    if fmt not in _SF_CACHE:
+        try:
+            _SF_CACHE[fmt] = _java_to_strftime(fmt)
+        except ValueError:
+            _SF_CACHE[fmt] = None
+    return _SF_CACHE[fmt]
+
+
 def _fmt_supported(fmt) -> bool:
     """Constructor-time pattern validation (the tagging gate)."""
     if fmt is None:
@@ -521,34 +589,51 @@ class FromUnixTime(Expression):
         f = self.children[1]
         return f.value if isinstance(f, Literal) else None
 
-    def _format_list(self, secs):
+    def _format_list(self, secs, ctx, fmts=None):
         import datetime as _dt
-        fmt = self._fmt()
-        sf = _java_to_strftime(fmt) if fmt is not None else None
+        tz = _session_zone(ctx)
         out = []
-        for s in secs:
+        for i, s in enumerate(secs):
+            fmt = fmts[i] if fmts is not None else self._fmt()
+            sf = _strftime_cached(fmt)
             if s is None or sf is None:
                 out.append(None)
             else:
-                t = _dt.datetime.fromtimestamp(int(s), _dt.timezone.utc)
-                txt = t.strftime(sf)
-                out.append(txt)
+                t = _dt.datetime.fromtimestamp(int(s), tz)
+                out.append(t.strftime(sf))
         return out
+
+    def _fmts_of(self, batch_or_table, ctx, n, is_tpu):
+        """Per-row formats when the fmt child is not a literal."""
+        from .base import Literal
+        f = self.children[1]
+        if isinstance(f, Literal):
+            return None
+        v = f.eval_tpu(batch_or_table, ctx) if is_tpu \
+            else f.eval_cpu(batch_or_table, ctx)
+        from ..columnar.vector import TpuScalar
+        if isinstance(v, TpuScalar):
+            return [v.value] * n
+        return v.to_pylist()[:n] if hasattr(v, "to_pylist") else [v] * n
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         from ..columnar.vector import TpuScalar
         from .collections import _result_from_pylist
         c = self.children[0].eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
-            v = self._format_list([c.value])[0]
+            v = self._format_list([c.value], ctx,
+                                  self._fmts_of(batch, ctx, 1, True))[0]
             return TpuScalar(self.dtype, v)
-        return _result_from_pylist(self._format_list(c.to_pylist()),
+        vals = c.to_pylist()
+        fmts = self._fmts_of(batch, ctx, len(vals), True)
+        return _result_from_pylist(self._format_list(vals, ctx, fmts),
                                    self.dtype, batch)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa
         vals = self.children[0].eval_cpu(table, ctx).to_pylist()
-        return pa.array(self._format_list(vals), pa.string())
+        fmts = self._fmts_of(table, ctx, len(vals), False)
+        return pa.array(self._format_list(vals, ctx, fmts), pa.string())
 
     def pretty(self) -> str:
         return f"from_unixtime({self.children[0].pretty()}, {self.children[1].pretty()})"
@@ -569,39 +654,53 @@ class DateFormatClass(Expression):
         from ..types import StringT
         return StringT
 
-    def _format_list(self, vals):
+    def _format_list(self, vals, ctx, fmts=None):
         from .base import Literal
         import datetime as _dt
         f = self.children[1]
-        fmt = f.value if isinstance(f, Literal) else None
-        sf = _java_to_strftime(fmt) if fmt is not None else None
+        lit_fmt = f.value if isinstance(f, Literal) else None
+        tz = _session_zone(ctx)
         out = []
-        for v in vals:
+        for i, v in enumerate(vals):
+            fmt = fmts[i] if fmts is not None else lit_fmt
+            sf = _strftime_cached(fmt)
             if v is None or sf is None:
                 out.append(None)
                 continue
             if isinstance(v, _dt.datetime):
-                t = v
+                t = v.astimezone(tz) if v.tzinfo is not None else v
             elif isinstance(v, _dt.date):
                 t = _dt.datetime(v.year, v.month, v.day)
             else:
-                t = _dt.datetime.fromtimestamp(int(v) / 1e6, _dt.timezone.utc)
+                t = _dt.datetime.fromtimestamp(int(v) / 1e6, tz)
             out.append(t.strftime(sf))
         return out
+
+    def _fmts_of(self, batch_or_table, ctx, n, is_tpu):
+        from .base import Literal
+        f = self.children[1]
+        if isinstance(f, Literal):
+            return None
+        v = f.eval_tpu(batch_or_table, ctx) if is_tpu \
+            else f.eval_cpu(batch_or_table, ctx)
+        from ..columnar.vector import TpuScalar
+        if isinstance(v, TpuScalar):
+            return [v.value] * n
+        return v.to_pylist()[:n] if hasattr(v, "to_pylist") else [v] * n
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         from ..columnar.vector import TpuScalar
         from .collections import _result_from_pylist
         c = self.children[0].eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
-            return TpuScalar(self.dtype, self._format_list([c.value])[0])
-        return _result_from_pylist(self._format_list(c.to_pylist()),
+            return TpuScalar(self.dtype, self._format_list([c.value], ctx)[0])
+        return _result_from_pylist(self._format_list(c.to_pylist(), ctx),
                                    self.dtype, batch)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa
         vals = self.children[0].eval_cpu(table, ctx).to_pylist()
-        return pa.array(self._format_list(vals), pa.string())
+        return pa.array(self._format_list(vals, ctx), pa.string())
 
     def pretty(self) -> str:
         return f"date_format({self.children[0].pretty()}, {self.children[1].pretty()})"
@@ -629,21 +728,36 @@ class ToUnixTimestamp(Expression):
         f = self.children[1]
         return f.value if isinstance(f, Literal) else None
 
-    def _parse_list(self, vals):
+    def _parse_list(self, vals, ctx, fmts=None):
         import datetime as _dt
-        fmt = self._fmt()
-        sf = _java_to_strftime(fmt) if fmt is not None else None
+        tz = _session_zone(ctx)
         out = []
-        for v in vals:
+        for i, v in enumerate(vals):
+            fmt = fmts[i] if fmts is not None else self._fmt()
+            sf = _strftime_cached(fmt)
             if v is None or sf is None:
                 out.append(None)
                 continue
             try:
-                t = _dt.datetime.strptime(v, sf).replace(tzinfo=_dt.timezone.utc)
+                # fold=0: ambiguous wall times take the earlier offset,
+                # matching java.time (and the device TZ-DB kernel)
+                t = _dt.datetime.strptime(v, sf).replace(tzinfo=tz, fold=0)
                 out.append(int(t.timestamp()))
             except ValueError:
                 out.append(None)  # Spark: unparseable → null
         return out
+
+    def _fmts_of(self, batch_or_table, ctx, n, is_tpu):
+        from .base import Literal
+        f = self.children[1]
+        if isinstance(f, Literal):
+            return None
+        v = f.eval_tpu(batch_or_table, ctx) if is_tpu \
+            else f.eval_cpu(batch_or_table, ctx)
+        from ..columnar.vector import TpuScalar
+        if isinstance(v, TpuScalar):
+            return [v.value] * n
+        return v.to_pylist()[:n] if hasattr(v, "to_pylist") else [v] * n
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
@@ -658,14 +772,25 @@ class ToUnixTimestamp(Expression):
                                      row_mask(batch.num_rows, batch.capacity))
             return make_column(LongT, data, valid, batch.num_rows)
         if isinstance(src.dtype, DateType) and isinstance(c, TpuColumnVector):
-            data = c.data.astype(jnp.int64) * 86400
+            from ..tzdb import TimeZoneDB, is_utc
+            local_midnight = c.data.astype(jnp.int64) * MICROS_PER_DAY
+            if is_utc(getattr(ctx, "tz", None)):
+                utc = local_midnight
+            else:
+                db = TimeZoneDB.get(ctx.tz)
+                if db is None:
+                    raise ValueError(f"unknown session timezone {ctx.tz}")
+                utc = db.local_to_utc(local_midnight)
+            data = _floor_div(utc, MICROS_PER_SECOND)
             valid = combine_validity(batch.capacity, c.validity,
                                      row_mask(batch.num_rows, batch.capacity))
             return make_column(LongT, data, valid, batch.num_rows)
         from .collections import _result_from_pylist
         vals = [c.value] * batch.num_rows if isinstance(c, TpuScalar) \
             else c.to_pylist()
-        return _result_from_pylist(self._parse_list(vals), LongT, batch)
+        fmts = self._fmts_of(batch, ctx, len(vals), True)
+        return _result_from_pylist(self._parse_list(vals, ctx, fmts),
+                                   LongT, batch)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import datetime as _dt
@@ -679,12 +804,14 @@ class ToUnixTimestamp(Expression):
                    else int(v) // 1000000 for v in vals]
             return pa.array(out, pa.int64())
         if isinstance(src.dtype, DateType):
+            tz = _session_zone(ctx)
             out = [None if v is None else
                    int(_dt.datetime(v.year, v.month, v.day,
-                                    tzinfo=_dt.timezone.utc).timestamp())
+                                    tzinfo=tz, fold=0).timestamp())
                    for v in vals]
             return pa.array(out, pa.int64())
-        return pa.array(self._parse_list(vals), pa.int64())
+        fmts = self._fmts_of(table, ctx, len(vals), False)
+        return pa.array(self._parse_list(vals, ctx, fmts), pa.int64())
 
     def pretty(self) -> str:
         return f"to_unix_timestamp({self.children[0].pretty()}, {self.children[1].pretty()})"

@@ -298,25 +298,42 @@ class FileScanBase:
             return self._attach_partition_cols(
                 _read_one(f, self.fmt, cols, row_filter, self.options), f)
 
+        def set_input_file(f):
+            """Expose the current scan file to input_file_name()/block exprs
+            through the task's eval context (reference InputFileUtils)."""
+            import os as _os
+            ec = ctx.eval_ctx
+            ec.input_file = f
+            ec.input_block_start = 0
+            try:
+                ec.input_block_length = _os.path.getsize(f)
+            except OSError:
+                ec.input_block_length = -1
+
         strategy = str(ctx.conf.get(PARQUET_READER_TYPE)).upper()
         if strategy == "AUTO":
             strategy = "COALESCING" if len(files) > 1 else "PERFILE"
         if strategy == "MULTITHREADED":
             n_threads = ctx.conf.get(MULTITHREAD_READ_NUM_THREADS)
             with _fut.ThreadPoolExecutor(max_workers=n_threads) as pool:
-                futs = [pool.submit(read, f) for f in files]
-                for f in futs:
-                    t = f.result()
+                futs = [(f, pool.submit(read, f)) for f in files]
+                for f, fut in futs:
+                    t = fut.result()
                     if t.num_rows:
+                        set_input_file(f)
                         yield t
         elif strategy == "COALESCING":
             tables = [read(f) for f in files]
             tables = [t for t in tables if t.num_rows] or tables[:1]
+            # coalesced batches span files; expose the first (the reference's
+            # coalescing reader tracks per-block, a planned refinement)
+            set_input_file(files[0])
             yield pa.concat_tables(tables, promote_options="permissive")
         else:  # PERFILE
             for f in files:
                 t = read(f)
                 if t.num_rows:
+                    set_input_file(f)
                     yield t
 
 

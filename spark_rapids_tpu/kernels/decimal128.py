@@ -1,0 +1,185 @@
+"""128-bit decimal arithmetic as two int64 limbs.
+
+Reference: spark-rapids-jni DecimalUtils (CUDA __int128 kernels). TPUs have
+no native 128-bit integers either, so a decimal(>18) value v is carried as
+  hi = v >> 64   (signed int64)
+  lo = v & mask  (low 64 bits, stored as the int64 BIT PATTERN)
+and every op is built from int64 adds/multiplies with explicit carries —
+pure elementwise VPU code. Unsigned comparison of bit patterns uses the
+sign-flip trick (u(x) < u(y) ⟺ (x^MIN) < (y^MIN) signed).
+
+Scale handling lives in the expression layer (Spark's type coercion aligns
+scales before the kernel, exactly as with the scaled-int64 ≤18 carrier);
+these kernels are pure 128-bit integer math plus precision-overflow checks.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MIN = np.int64(np.iinfo(np.int64).min)
+_MASK64 = (1 << 64) - 1
+
+
+# ---------------------------------------------------------------------------
+# host-side conversion
+# ---------------------------------------------------------------------------
+
+_DEC_CTX = None
+
+
+def _ctx():
+    global _DEC_CTX
+    if _DEC_CTX is None:
+        import decimal
+        _DEC_CTX = decimal.Context(prec=60)  # default prec=28 would ROUND
+    return _DEC_CTX
+
+
+def unscaled_int(value, scale: int) -> int:
+    """Decimal/str/int → exact unscaled int at `scale` (no context rounding)."""
+    import decimal
+    d = value if isinstance(value, decimal.Decimal) else decimal.Decimal(value)
+    return int(d.scaleb(scale, context=_ctx()))
+
+
+def scaled_decimal(unscaled: int, scale: int):
+    """Exact unscaled int → Decimal at `scale` (no context rounding)."""
+    import decimal
+    return decimal.Decimal(unscaled).scaleb(-scale, context=_ctx())
+
+
+def int_to_limbs(v: int) -> Tuple[int, int]:
+    """python int → (hi, lo) with lo as a signed-int64 bit pattern."""
+    lo = v & _MASK64
+    if lo >= 1 << 63:
+        lo -= 1 << 64
+    return (v >> 64, lo)
+
+
+def limbs_to_int(hi: int, lo: int) -> int:
+    return (int(hi) << 64) | (int(lo) & _MASK64)
+
+
+def pack(values) -> np.ndarray:
+    """iterable of python ints → (n, 2) int64 [hi, lo] array."""
+    out = np.zeros((len(values), 2), np.int64)
+    for i, v in enumerate(values):
+        h, l = int_to_limbs(int(v))
+        out[i, 0] = h
+        out[i, 1] = l
+    return out
+
+
+def unpack(arr: np.ndarray):
+    return [limbs_to_int(h, l) for h, l in np.asarray(arr)]
+
+
+# ---------------------------------------------------------------------------
+# limb primitives (jax)
+# ---------------------------------------------------------------------------
+
+def _ult(x, y):
+    """unsigned x < y on int64 bit patterns."""
+    return (x ^ _MIN) < (y ^ _MIN)
+
+
+def add128(ah, al, bh, bl):
+    """(hi, lo) + (hi, lo) with wraparound; returns (hi, lo, signed_overflow)."""
+    lo = al + bl  # two's-complement wrap == mod 2^64
+    carry = _ult(lo, al).astype(jnp.int64)
+    hi = ah + bh + carry
+    # signed 128 overflow: same-sign operands, different-sign result
+    ovf = ((ah >= 0) == (bh >= 0)) & ((hi >= 0) != (ah >= 0))
+    return hi, lo, ovf
+
+
+def neg128(h, l):
+    lo = -l
+    hi = ~h + (l == 0).astype(jnp.int64)
+    return hi, lo
+
+
+def sub128(ah, al, bh, bl):
+    nh, nl = neg128(bh, bl)
+    return add128(ah, al, nh, nl)
+
+
+def _umul64(a, b):
+    """unsigned 64x64 → (hi64, lo64) via 32-bit halves (int64 bit patterns)."""
+    mask32 = jnp.int64(0xFFFFFFFF)
+    a_lo = a & mask32
+    a_hi = (a >> 32) & mask32
+    b_lo = b & mask32
+    b_hi = (b >> 32) & mask32
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> 32 & mask32) + (lh & mask32) + (hl & mask32)
+    lo = (ll & mask32) | (mid << 32)
+    hi = hh + ((lh >> 32) & mask32) + ((hl >> 32) & mask32) + \
+        ((mid >> 32) & mask32)
+    return hi, lo
+
+
+def mul128(ah, al, bh, bl):
+    """128x128 → 128 with overflow detection. Sign-magnitude: negate to
+    magnitudes, multiply unsigned, re-apply the sign."""
+    a_neg = ah < 0
+    b_neg = bh < 0
+    mah, mal = neg128(ah, al)
+    mah = jnp.where(a_neg, mah, ah)
+    mal = jnp.where(a_neg, mal, al)
+    mbh, mbl = neg128(bh, bl)
+    mbh = jnp.where(b_neg, mbh, bh)
+    mbl = jnp.where(b_neg, mbl, bl)
+    # |a| = mah*2^64 + u(mal); |b| = mbh*2^64 + u(mbl); magnitudes < 2^127 so
+    # mah/mbh are non-negative
+    p_hi, p_lo = _umul64(mal, mbl)
+    c1_hi, c1_lo = _umul64(mal, mbh)
+    c2_hi, c2_lo = _umul64(mah, mbl)
+    hi = p_hi + c1_lo
+    ovf = _ult(hi, p_hi)  # carry out of bit 127 of the magnitude
+    hi2 = hi + c2_lo
+    ovf = ovf | _ult(hi2, hi)
+    ovf = ovf | ((mah != 0) & (mbh != 0)) | (c1_hi != 0) | (c2_hi != 0)
+    # magnitude must fit 127 bits (sign bit clear)
+    ovf = ovf | (hi2 < 0)
+    out_neg = a_neg != b_neg
+    nh, nl = neg128(hi2, p_lo)
+    rh = jnp.where(out_neg, nh, hi2)
+    rl = jnp.where(out_neg, nl, p_lo)
+    return rh, rl, ovf
+
+
+def cmp128(ah, al, bh, bl):
+    """-1 / 0 / +1 like a signed 128-bit compare."""
+    lt = (ah < bh) | ((ah == bh) & _ult(al, bl))
+    gt = (ah > bh) | ((ah == bh) & _ult(bl, al))
+    return jnp.where(lt, -1, jnp.where(gt, 1, 0)).astype(jnp.int32)
+
+
+def abs_exceeds(h, l, bound: int):
+    """|value| > bound (python int bound < 2^127), elementwise."""
+    bh, bl = int_to_limbs(bound)
+    neg = h < 0
+    mh, ml = neg128(h, l)
+    mh = jnp.where(neg, mh, h)
+    ml = jnp.where(neg, ml, l)
+    return (mh > bh) | ((mh == bh) & _ult(jnp.asarray(bl, jnp.int64), ml))
+
+
+def from_int64(v):
+    """int64 vector → limb pair (sign-extended)."""
+    v = v.astype(jnp.int64)
+    return jnp.where(v < 0, jnp.int64(-1), jnp.int64(0)), v
+
+
+def precision_overflow(h, l, precision: int):
+    """Spark decimal overflow: |v| >= 10^precision (unscaled)."""
+    return abs_exceeds(h, l, 10 ** precision - 1)

@@ -60,8 +60,11 @@ def _register_builtin_exprs() -> None:
     register_expr(B.Alias, sig_all_nested, "named expression")
     register_expr(C.Cast, sig_all, "cast between types")
 
+    # add/sub/mul cover decimal128 via the two-int64-limb kernels
+    # (kernels/decimal128.py, reference spark-rapids-jni DecimalUtils)
     for cls in (A.Add, A.Subtract, A.Multiply):
-        register_expr(cls, sig_num, f"{cls.__name__.lower()} of numerics")
+        register_expr(cls, sig_num + TypeSigs.DECIMAL_128,
+                      f"{cls.__name__.lower()} of numerics (incl. decimal128)")
     register_expr(A.Divide, sig_num, "fractional division")
     register_expr(A.IntegralDivide, sig_num, "integral division")
     register_expr(A.Remainder, sig_num, "remainder (java sign semantics)")

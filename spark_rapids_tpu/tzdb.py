@@ -1,0 +1,160 @@
+"""Timezone transition tables as device arrays.
+
+Reference: GpuTimeZoneDB (spark-rapids-jni) + TimeZoneDB.scala — the reference
+loads java.time zone rules into GPU-resident transition tables so timestamp
+ops run on device for any timezone. Here the tables come straight from the
+system TZif files (/usr/share/zoneinfo, RFC 8536): one sorted vector of
+transition instants and one of UTC offsets, and the conversion kernels are a
+`searchsorted` plus a gather — pure XLA.
+
+Semantics match java.time resolution (what Spark uses):
+  * UTC→local: offset of the interval containing the instant.
+  * local→UTC: ambiguous wall times (DST fall-back overlap) take the EARLIER
+    offset; skipped wall times (spring-forward gap) resolve with the
+    pre-transition offset, which shifts them forward by the gap — both are
+    java.time ZonedDateTime.of's documented behavior.
+
+Instants beyond the last explicit transition use the final offset; TZif v2+
+files carry transitions far into the future (typically ≥2037), and the POSIX
+footer rule beyond that is intentionally not modeled (tagging keeps such
+extrapolation on the host oracle's zoneinfo path in tests).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+MICROS = 1_000_000
+_TZDIRS = ("/usr/share/zoneinfo", "/usr/lib/zoneinfo", "/etc/zoneinfo")
+
+_UTC_NAMES = {"UTC", "GMT", "Etc/UTC", "Etc/GMT", "Z", "+00:00", "UTC+00:00"}
+
+
+def is_utc(tz: Optional[str]) -> bool:
+    return tz is None or tz in _UTC_NAMES
+
+
+def _parse_tzif(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """TZif bytes → (transition instants [s], offsets [s], len n and n+1)."""
+
+    def parse_block(buf, off, time_size, time_fmt):
+        (isutcnt, isstdcnt, leapcnt, timecnt, typecnt,
+         charcnt) = struct.unpack_from(">6I", buf, off + 20)
+        p = off + 44
+        trans = np.frombuffer(buf, dtype=np.dtype(time_fmt).newbyteorder(">"),
+                              count=timecnt, offset=p).astype(np.int64)
+        p += timecnt * time_size
+        idx = np.frombuffer(buf, dtype=np.uint8, count=timecnt, offset=p)
+        p += timecnt
+        utoffs = np.empty(typecnt, np.int64)
+        for i in range(typecnt):
+            utoff, _isdst, _abbr = struct.unpack_from(">iBB", buf, p + 6 * i)
+            utoffs[i] = utoff
+        p += typecnt * 6 + charcnt + leapcnt * (time_size + 4)
+        p += (isstdcnt + isutcnt)
+        return trans, idx, utoffs, p
+
+    assert raw[:4] == b"TZif", "not a TZif file"
+    version = raw[4:5]
+    trans, idx, utoffs, end = parse_block(raw, 0, 4, np.int32)
+    if version in (b"2", b"3", b"4") and raw[end:end + 4] == b"TZif":
+        trans, idx, utoffs, _ = parse_block(raw, end, 8, np.int64)
+    if len(trans) == 0:
+        base = utoffs[0] if len(utoffs) else 0
+        return (np.zeros(0, np.int64), np.array([base], np.int64))
+    # offsets[0] = pre-first-transition offset (the first non-DST type per
+    # RFC 8536 §3.2 guidance; fall back to type of the first transition)
+    first = utoffs[idx[0]] if len(idx) else utoffs[0]
+    offsets = np.concatenate([[first], utoffs[idx]])
+    return trans, offsets
+
+
+class TimeZoneDB:
+    """Loaded transition table for one zone; arrays are numpy host-side and
+    upload lazily as jax constants inside the conversion kernels."""
+
+    _cache: Dict[str, Optional["TimeZoneDB"]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, name: str, trans_s: np.ndarray, offsets_s: np.ndarray):
+        self.name = name
+        self.trans_micros = trans_s * MICROS
+        self.offsets_micros = offsets_s * MICROS
+        # wall-clock start of each interval i>=1 (used by local→UTC)
+        if len(trans_s):
+            self.local_starts_micros = (trans_s + offsets_s[1:]) * MICROS
+            self.prev_local_ends_micros = (trans_s + offsets_s[:-1]) * MICROS
+        else:
+            self.local_starts_micros = np.zeros(0, np.int64)
+            self.prev_local_ends_micros = np.zeros(0, np.int64)
+
+    @classmethod
+    def get(cls, tz: Optional[str]) -> Optional["TimeZoneDB"]:
+        """Load (cached); None when the zone has no TZif file."""
+        if tz is None:
+            return None
+        with cls._lock:
+            if tz in cls._cache:
+                return cls._cache[tz]
+            db = None
+            for d in _TZDIRS:
+                p = os.path.join(d, tz)
+                if os.path.isfile(p):
+                    try:
+                        with open(p, "rb") as f:
+                            trans, offsets = _parse_tzif(f.read())
+                        db = cls(tz, trans, offsets)
+                    except Exception:  # noqa: BLE001 — unparseable file
+                        db = None
+                    break
+            cls._cache[tz] = db
+            return db
+
+    # ---- device kernels --------------------------------------------------
+    def utc_to_local(self, micros):
+        """UTC micros → wall-clock micros in this zone (jax)."""
+        import jax.numpy as jnp
+        if len(self.trans_micros) == 0:
+            return micros + int(self.offsets_micros[0])
+        trans = jnp.asarray(self.trans_micros)
+        offs = jnp.asarray(self.offsets_micros)
+        k = jnp.searchsorted(trans, micros, side="right")
+        return micros + offs[k]
+
+    def local_to_utc(self, local_micros):
+        """Wall-clock micros → UTC micros with java.time gap/overlap rules."""
+        import jax.numpy as jnp
+        if len(self.trans_micros) == 0:
+            return local_micros - int(self.offsets_micros[0])
+        starts = jnp.asarray(self.local_starts_micros)
+        prev_ends = jnp.asarray(self.prev_local_ends_micros)
+        offs = jnp.asarray(self.offsets_micros)
+        k = jnp.searchsorted(starts, local_micros, side="right")
+        # overlap: the wall time also exists in interval k-1 → earlier offset
+        ambiguous = (k >= 1) & (local_micros <
+                                prev_ends[jnp.clip(k - 1, 0, len(self.trans_micros) - 1)])
+        k = jnp.where(ambiguous, k - 1, k)
+        return local_micros - offs[k]
+
+    # ---- host mirrors (oracle/parity paths) ------------------------------
+    def utc_to_local_np(self, micros: np.ndarray) -> np.ndarray:
+        if len(self.trans_micros) == 0:
+            return micros + int(self.offsets_micros[0])
+        k = np.searchsorted(self.trans_micros, micros, side="right")
+        return micros + self.offsets_micros[k]
+
+    def local_to_utc_np(self, local_micros: np.ndarray) -> np.ndarray:
+        if len(self.trans_micros) == 0:
+            return local_micros - int(self.offsets_micros[0])
+        k = np.searchsorted(self.local_starts_micros, local_micros,
+                            side="right")
+        amb = (k >= 1) & (local_micros <
+                          self.prev_local_ends_micros[
+                              np.clip(k - 1, 0, len(self.trans_micros) - 1)])
+        k = np.where(amb, k - 1, k)
+        return local_micros - self.offsets_micros[k]
