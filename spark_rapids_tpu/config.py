@@ -333,6 +333,20 @@ AQE_ADVISORY_PARTITION_BYTES = _conf(
     "spark.sql.adaptive.advisoryPartitionSizeInBytes").doc(
     "Target combined size of a coalesced shuffle-read partition."
 ).bytes(64 * (1 << 20))
+AQE_SKEW_JOIN_ENABLED = _conf(
+    "spark.sql.adaptive.skewJoin.enabled").doc(
+    "Split skewed shuffle partitions into map-range slices on one join side "
+    "and replicate the other side's matching partition (reference "
+    "OptimizeSkewedJoin + PartialReducerPartitionSpec).").boolean(False)
+AQE_SKEW_THRESHOLD = _conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes").doc(
+    "A shuffle partition is skew-eligible only above this size."
+).bytes(256 * (1 << 20))
+AQE_SKEW_FACTOR = _conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor").doc(
+    "A partition is skewed when larger than this factor times the median "
+    "partition size (and above the threshold)."
+).integer(5)
 FILECACHE_ENABLED = _conf("spark.rapids.filecache.enabled").doc(
     "Cache remote scan inputs (s3/gs/hdfs/...) on local disk (reference: "
     "the spark-rapids-private FileCache; SURVEY.md §1 notes the TPU build "

@@ -277,8 +277,68 @@ class TpuWindowExec(TpuExec):
                 per_row = jnp.take(red, seg_ids)
                 valid = jnp.take(nn, seg_ids) > 0
                 return jnp.where(valid, per_row, jnp.zeros((), sdata.dtype)), valid
-            raise NotImplementedError("bounded min/max window frames")
+            # general bounded frame: sparse-table range min/max — the TPU
+            # formulation of the reference's batched-bounded strategy
+            # (GpuWindowExecMeta.scala:262-299): O(n log n) doubling tables +
+            # two gathers per row, all static shapes
+            return self._bounded_minmax(op, sdata, svalid, lo, hi, cap)
         raise NotImplementedError(f"window aggregate {op}")
+
+    def _bounded_minmax(self, op, sdata, svalid, lo, hi, cap):
+        """Range min/max over per-row [lo, hi] via doubling sparse tables:
+        tbl[k][i] reduces [i, i+2^k); a length-L query is the overlap of two
+        length-2^floor(log2 L) blocks. NaN follows Spark ordering (greatest):
+        max → NaN when the frame holds any NaN; min → NaN only when every
+        valid value in the frame is NaN (same as execs/aggregates.py)."""
+        neutral = self._neutral(op, sdata.dtype)
+        is_fp = jnp.issubdtype(sdata.dtype, jnp.floating)
+        nanmask = (svalid & jnp.isnan(sdata)) if is_fp \
+            else jnp.zeros((cap,), jnp.bool_)
+        clean_valid = svalid & ~nanmask
+        vals = jnp.where(clean_valid, sdata, neutral)
+        levels = max(int(np.ceil(np.log2(max(cap, 2)))), 1) + 1
+        reduce2 = jnp.minimum if op == "min" else jnp.maximum
+        tbls = [vals]
+        vtbls = [clean_valid.astype(jnp.int32)]
+        ntbls = [nanmask.astype(jnp.int32)]
+        for k in range(1, levels):
+            shift = 1 << (k - 1)
+            prev, pv, pn = tbls[-1], vtbls[-1], ntbls[-1]
+            if shift >= cap:
+                tbls.append(prev)
+                vtbls.append(pv)
+                ntbls.append(pn)
+                continue
+            shifted = jnp.concatenate(
+                [prev[shift:], jnp.full((shift,), neutral, prev.dtype)])
+            tbls.append(reduce2(prev, shifted))
+            sv = jnp.concatenate([pv[shift:], jnp.zeros((shift,), jnp.int32)])
+            vtbls.append(jnp.maximum(pv, sv))
+            sn = jnp.concatenate([pn[shift:], jnp.zeros((shift,), jnp.int32)])
+            ntbls.append(jnp.maximum(pn, sn))
+        T = jnp.stack(tbls)   # (levels, cap)
+        V = jnp.stack(vtbls)
+        N = jnp.stack(ntbls)
+        ln = (hi - lo + 1).astype(jnp.int64)
+        empty = ln <= 0
+        ln_safe = jnp.maximum(ln, 1)
+        k = (63 - jax.lax.clz(ln_safe)).astype(jnp.int32)
+        k = jnp.clip(k, 0, levels - 1)
+        blk = jnp.left_shift(jnp.int64(1), k.astype(jnp.int64))
+        a = jnp.clip(lo, 0, cap - 1).astype(jnp.int32)
+        b = jnp.clip(hi - blk + 1, 0, cap - 1).astype(jnp.int32)
+        red = reduce2(T[k, a], T[k, b])
+        any_clean = (jnp.maximum(V[k, a], V[k, b]) > 0) & ~empty
+        any_nan = (jnp.maximum(N[k, a], N[k, b]) > 0) & ~empty
+        valid = any_clean | any_nan
+        if is_fp:
+            nan = jnp.asarray(np.nan, sdata.dtype)
+            if op == "max":
+                red = jnp.where(any_nan, nan,
+                                jnp.where(any_clean, red, nan))
+            else:
+                red = jnp.where(any_clean, red, nan)  # all-NaN frame → NaN
+        return jnp.where(valid, red, jnp.zeros((), sdata.dtype)), valid
 
     @staticmethod
     def _neutral(op, dtype):
@@ -289,9 +349,15 @@ class TpuWindowExec(TpuExec):
 
     def _running_minmax(self, op, x, svalid, is_new_seg, seg_start, idxs,
                         sdata, cap):
-        """Segmented running min/max via associative scan over (reset, value)."""
+        """Segmented running min/max via associative scan over (reset, value).
+        NaN follows Spark ordering (greatest): kept out of the scan values and
+        re-injected from a NaN-seen flag, same as _bounded_minmax."""
         neutral = self._neutral(op, sdata.dtype)
-        vals = jnp.where(svalid, sdata, neutral)
+        is_fp = jnp.issubdtype(sdata.dtype, jnp.floating)
+        nanmask = (svalid & jnp.isnan(sdata)) if is_fp \
+            else jnp.zeros((cap,), jnp.bool_)
+        clean_valid = svalid & ~nanmask
+        vals = jnp.where(clean_valid, sdata, neutral)
         is_start = idxs == seg_start
 
         def combine(a, b):
@@ -303,15 +369,25 @@ class TpuWindowExec(TpuExec):
             return (a_flag | b_flag, merged)
 
         _, running = jax.lax.associative_scan(combine, (is_start, vals))
-        nn = None
-        # validity: any non-null seen so far in segment
+
+        # segmented "any so far" flags
         def combine2(a, b):
             a_flag, a_any = a
             b_flag, b_any = b
             return (a_flag | b_flag, jnp.where(b_flag, b_any, a_any | b_any))
 
-        _, any_valid = jax.lax.associative_scan(combine2, (is_start, svalid))
-        return jnp.where(any_valid, running, jnp.zeros((), sdata.dtype)), any_valid
+        _, any_clean = jax.lax.associative_scan(combine2, (is_start, clean_valid))
+        _, any_nan = jax.lax.associative_scan(combine2, (is_start, nanmask))
+        any_valid = any_clean | any_nan
+        if is_fp:
+            nan = jnp.asarray(np.nan, sdata.dtype)
+            if op == "max":
+                running = jnp.where(any_nan, nan,
+                                    jnp.where(any_clean, running, nan))
+            else:
+                running = jnp.where(any_clean, running, nan)
+        return (jnp.where(any_valid, running, jnp.zeros((), sdata.dtype)),
+                any_valid)
 
 
 class CpuWindowExec(CpuExec):
@@ -442,13 +518,21 @@ class CpuWindowExec(CpuExec):
                 elif op == "avg":
                     results[r] = sum(nn) / len(nn)
                 elif op == "min":
-                    results[r] = min(nn)
+                    # Spark float ordering: NaN greatest (python min would
+                    # propagate whichever NaN it compares first)
+                    results[r] = min(nn, key=_nan_greatest_key)
                 elif op == "max":
-                    results[r] = max(nn)
+                    results[r] = max(nn, key=_nan_greatest_key)
                 else:
                     raise NotImplementedError(op)
             return
         raise NotImplementedError(type(fn).__name__)
+
+
+def _nan_greatest_key(v):
+    if isinstance(v, float) and v != v:
+        return (1, 0.0)
+    return (0, v)
 
 
 def _orderable(v):

@@ -215,6 +215,7 @@ def _convert_hash_join(meta: PlanMeta, ch):
     from ..execs.joins import (_MIRROR_JOIN, TpuShuffledHashJoinExec,
                                TpuShuffledSymmetricHashJoinExec)
     p = meta.plan
+    ch = _maybe_coordinated_readers(meta, ch)
     if meta.conf.get(SYMMETRIC_JOIN_ENABLED) and p.join_type in _MIRROR_JOIN:
         return TpuShuffledSymmetricHashJoinExec(
             ch[0], ch[1], p.join_type, p.left_keys, p.right_keys,
@@ -222,6 +223,39 @@ def _convert_hash_join(meta: PlanMeta, ch):
     return TpuShuffledHashJoinExec(ch[0], ch[1], p.join_type, p.left_keys,
                                    p.right_keys, p.condition, p.output,
                                    per_partition=p.per_partition)
+
+
+def _maybe_coordinated_readers(meta: PlanMeta, ch):
+    """Wrap a co-partitioned join's two exchanges in coordinated AQE readers
+    (shared coalesce + skew-split specs — reference OptimizeSkewedJoin /
+    CoalesceShufflePartitions planning GpuCustomShuffleReaderExec)."""
+    from ..config import (AQE_ADVISORY_PARTITION_BYTES, AQE_COALESCE_ENABLED,
+                          AQE_SKEW_FACTOR, AQE_SKEW_JOIN_ENABLED,
+                          AQE_SKEW_THRESHOLD)
+    from ..shuffle.aqe import (JoinReaderCoordinator,
+                               TpuCoordinatedShuffleReaderExec)
+    from ..shuffle.exchange import TpuShuffleExchangeExec
+    p = meta.plan
+    coalesce = meta.conf.get(AQE_COALESCE_ENABLED)
+    skew = meta.conf.get(AQE_SKEW_JOIN_ENABLED)
+    if not (coalesce or skew):
+        return ch
+    if not (getattr(p, "per_partition", False)
+            and isinstance(ch[0], TpuShuffleExchangeExec)
+            and isinstance(ch[1], TpuShuffleExchangeExec)
+            and ch[0].partitioning == "hash"
+            and ch[1].partitioning == "hash"):
+        return ch
+    coord = JoinReaderCoordinator(
+        ch[0], ch[1], p.join_type,
+        meta.conf.get(AQE_ADVISORY_PARTITION_BYTES),
+        meta.conf.get(AQE_SKEW_THRESHOLD) if skew else (1 << 62),
+        meta.conf.get(AQE_SKEW_FACTOR), coalesce=bool(coalesce))
+    l = TpuCoordinatedShuffleReaderExec(ch[0], coord, 0)
+    r = TpuCoordinatedShuffleReaderExec(ch[1], coord, 1)
+    l._conf = meta.conf
+    r._conf = meta.conf
+    return [l, r]
 
 
 def _tag_bnlj(meta: PlanMeta) -> None:
@@ -376,13 +410,8 @@ def _tag_window(meta: PlanMeta) -> None:
             if fn.update_op not in ("sum", "count", "avg", "min", "max"):
                 meta.will_not_work_on_tpu(
                     f"window aggregate {type(fn).__name__} not supported on TPU")
-            if fn.update_op in ("min", "max") and we.spec.frame is not None:
-                lo, hi = we.spec.frame
-                ok = (lo == UNBOUNDED_PRECEDING and
-                      hi in (CURRENT_ROW, UNBOUNDED_FOLLOWING))
-                if not ok:
-                    meta.will_not_work_on_tpu(
-                        "bounded min/max window frames not supported on TPU yet")
+            # bounded min/max frames run via the sparse-table range reduce
+            # (TpuWindowExec._bounded_minmax) — no frame restriction anymore
             for c in fn.children:
                 meta.add_exprs([c])
         elif not isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag)):

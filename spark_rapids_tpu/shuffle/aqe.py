@@ -1,0 +1,176 @@
+"""AQE join-input shuffle readers: coordinated coalescing + skew splitting.
+
+Reference: GpuCustomShuffleReaderExec (execution/GpuCustomShuffleReaderExec.
+scala:37) handles both CoalescedPartitionSpec and PartialReducerPartitionSpec,
+planned by Spark's AQE rules (CoalesceShufflePartitions / OptimizeSkewedJoin).
+Here the coordinator stands in for the query-stage planner: it reads both
+exchanges' materialized partition statistics ONCE and derives one shared spec
+list, so partition i of the left reader always pairs with partition i of the
+right reader:
+
+  * coalesce: consecutive small reduce partitions group up to the advisory
+    size using the COMBINED (left+right) sizes — both sides group
+    identically, preserving co-partitioning.
+  * skew split: a reduce partition much larger than the median on one side
+    splits into map-range slices near the advisory size; the OTHER side's
+    matching partition is replicated per slice (exactly Spark's skew-join
+    shape). Splitting side s is sound only when side s's rows appear in
+    exactly one slice and the other side is a pure lookup: inner both sides,
+    left outer/semi/anti split left only, right outer split right only,
+    full outer never splits.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from ..execs.base import TaskContext, TpuExec
+
+# spec entries:
+#   ("group", [reduce_ids])                 both sides read the whole group
+#   ("slice", side, reduce_id, [map_ids])   `side` reads the map slice, the
+#                                           other side replicates reduce_id
+Spec = Tuple
+
+
+_SPLIT_LEFT = {"inner", "cross", "leftouter", "left", "leftsemi", "semi",
+               "leftanti", "anti"}
+_SPLIT_RIGHT = {"inner", "cross", "rightouter", "right"}
+
+
+class JoinReaderCoordinator:
+    """Shared partition-spec planner for the two sides of a shuffled join."""
+
+    def __init__(self, left_exchange, right_exchange, join_type: str,
+                 advisory_bytes: int, skew_threshold: int, skew_factor: float,
+                 coalesce: bool = True):
+        self.left = left_exchange
+        self.right = right_exchange
+        self.join_type = join_type
+        self.advisory_bytes = advisory_bytes
+        self.skew_threshold = skew_threshold
+        self.skew_factor = skew_factor
+        self.coalesce = coalesce
+        self._specs: Optional[List[Spec]] = None
+        self._lock = threading.Lock()
+        self.skew_splits = 0  # observability
+
+    def specs(self, ctx: TaskContext) -> List[Spec]:
+        with self._lock:
+            if self._specs is None:
+                self._specs = self._plan(ctx)
+            return self._specs
+
+    def _median(self, sizes: List[int]) -> float:
+        """Median over ALL partitions, zeros included — the single-hot-key
+        shape (one huge partition, rest empty) must register as skewed
+        (Spark OptimizeSkewedJoin medianSize)."""
+        if not sizes:
+            return 0.0
+        return float(sorted(sizes)[len(sizes) // 2])
+
+    def _skewed(self, size: int, med: float) -> bool:
+        return size > max(self.skew_threshold, self.skew_factor * med)
+
+    def _slices(self, exchange, reduce_id: int, ctx) -> List[List[int]]:
+        """Partition the reduce partition's maps into near-advisory groups."""
+        msizes = exchange.map_block_sizes(reduce_id, ctx)
+        if len(msizes) <= 1:
+            return []
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_b = 0
+        for m, sz in enumerate(msizes):
+            if cur and cur_b + sz > self.advisory_bytes:
+                groups.append(cur)
+                cur, cur_b = [], 0
+            cur.append(m)
+            cur_b += sz
+        if cur:
+            groups.append(cur)
+        return groups if len(groups) > 1 else []
+
+    def _plan(self, ctx: TaskContext) -> List[Spec]:
+        L = self.left.partition_sizes(ctx)
+        R = self.right.partition_sizes(ctx)
+        med_l, med_r = self._median(L), self._median(R)
+        can_l = self.join_type in _SPLIT_LEFT
+        can_r = self.join_type in _SPLIT_RIGHT
+        specs: List[Spec] = []
+        group: List[int] = []
+        group_b = 0
+
+        def flush():
+            nonlocal group, group_b
+            if group:
+                specs.append(("group", group))
+                group, group_b = [], 0
+
+        for r in range(len(L)):
+            combined = L[r] + R[r]
+            slices: List[List[int]] = []
+            side = 0
+            if can_l and self._skewed(L[r], med_l):
+                slices = self._slices(self.left, r, ctx)
+                side = 0
+            if not slices and can_r and self._skewed(R[r], med_r):
+                slices = self._slices(self.right, r, ctx)
+                side = 1
+            if slices:
+                flush()
+                self.skew_splits += len(slices)
+                for maps in slices:
+                    specs.append(("slice", side, r, maps))
+                continue
+            if group and (not self.coalesce
+                          or group_b + combined > self.advisory_bytes):
+                flush()
+            group.append(r)
+            group_b += combined
+        flush()
+        return specs or [("group", [0])]
+
+
+class TpuCoordinatedShuffleReaderExec(TpuExec):
+    """One side of a coordinated join-reader pair (reference
+    GpuCustomShuffleReaderExec with coalesced AND partial-reducer specs)."""
+
+    def __init__(self, exchange, coordinator: JoinReaderCoordinator,
+                 side: int):
+        super().__init__([exchange])
+        self.coordinator = coordinator
+        self.side = side
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def node_desc(self) -> str:
+        n = len(self.coordinator._specs) if self.coordinator._specs is not None \
+            else "?"
+        s = self.coordinator.skew_splits
+        extra = f", skewSplits={s}" if s else ""
+        return f"TpuCoordinatedShuffleReader[{'LR'[self.side]}, n={n}{extra}]"
+
+    def num_partitions(self) -> int:
+        from ..config import default_conf
+        ctx = TaskContext(0, getattr(self, "_conf", None) or default_conf())
+        try:
+            return len(self.coordinator.specs(ctx))
+        finally:
+            ctx.complete()
+
+    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        spec = self.coordinator.specs(ctx)[idx]
+        exch = self.children[0]
+        if spec[0] == "group":
+            for r in spec[1]:
+                yield from exch.execute_partition(r, ctx)
+            return
+        _, side, reduce_id, maps = spec
+        if side == self.side:
+            yield from exch.execute_partition_maps(reduce_id, maps, ctx)
+        else:
+            # the non-split side replicates the full partition per slice
+            yield from exch.execute_partition(reduce_id, ctx)

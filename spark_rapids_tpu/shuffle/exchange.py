@@ -111,6 +111,27 @@ class _ExchangeBase:
                     sizes[r] += os.path.getsize(p)
         return sizes
 
+    def map_block_sizes(self, reduce_id: int, ctx: TaskContext) -> List[int]:
+        """Per-map byte sizes of one reduce partition — the granularity AQE
+        skew splitting slices on (reference PartialReducerPartitionSpec maps).
+        Returns [] when the exchange has no per-map blocks (collective mode
+        materializes one fused block, which cannot be sliced)."""
+        import os
+        self._ensure_materialized(ctx)
+        if self._shuffle_mode(ctx) == "ICI":
+            from .ici import IciShuffleCatalog
+            catalog = IciShuffleCatalog.get()
+            if self._n_maps <= 1:
+                return []
+            return catalog.block_sizes(self._shuffle_id, reduce_id,
+                                       self._n_maps)
+        mgr = TpuShuffleManager.get(ctx.conf)
+        out = []
+        for m in range(self._n_maps):
+            p = mgr._path(self._shuffle_id, m, reduce_id)
+            out.append(os.path.getsize(p) if os.path.exists(p) else 0)
+        return out
+
     def cleanup_shuffle(self, conf) -> None:
         """Release this exchange's shuffle blocks/files and allow
         re-materialization (called at query end by the session)."""
@@ -315,6 +336,27 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         mgr = TpuShuffleManager.get(ctx.conf)
         with self.metrics["deserializationTime"].timed():
             tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps)
+        for t in tables:
+            if t.num_rows:
+                yield TpuColumnarBatch.from_arrow(t).rename(names)
+
+    def execute_partition_maps(self, idx: int, map_ids: Sequence[int],
+                               ctx: TaskContext) -> Iterator:
+        """One reduce partition restricted to a subset of map outputs — a
+        skew SLICE (reference PartialReducerPartitionSpec read)."""
+        self._ensure_materialized(ctx)
+        names = [a.name for a in self.output]
+        if self._shuffle_mode(ctx) == "ICI":
+            from .ici import IciShuffleCatalog
+            catalog = IciShuffleCatalog.get()
+            for b in catalog.iter_blocks(self._shuffle_id, idx, self._n_maps,
+                                         map_ids=list(map_ids)):
+                if b.num_rows:
+                    yield b.rename(names)
+            return
+        mgr = TpuShuffleManager.get(ctx.conf)
+        tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps,
+                                    map_ids=list(map_ids))
         for t in tables:
             if t.num_rows:
                 yield TpuColumnarBatch.from_arrow(t).rename(names)

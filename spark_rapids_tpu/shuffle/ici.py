@@ -147,14 +147,15 @@ class IciShuffleCatalog:
             self._complete.add((shuffle_id, map_id))
 
     def iter_blocks(self, shuffle_id: int, reduce_id: int,
-                    n_maps: int) -> Iterator[TpuColumnarBatch]:
-        """Raises FetchFailedError when any map's output was invalidated."""
+                    n_maps: int, map_ids=None) -> Iterator[TpuColumnarBatch]:
+        """Raises FetchFailedError when any map's output was invalidated.
+        `map_ids` restricts to a subset of maps (AQE skew slices)."""
         with self._mu:
             missing = [m for m in range(n_maps)
                        if (shuffle_id, m) not in self._complete]
         if missing:
             raise FetchFailedError(shuffle_id, missing)
-        for map_id in range(n_maps):
+        for map_id in (range(n_maps) if map_ids is None else map_ids):
             with self._mu:
                 sb = self._blocks.get((shuffle_id, map_id, reduce_id))
                 # fetch under the lock: a concurrent invalidate/cleanup
@@ -162,6 +163,18 @@ class IciShuffleCatalog:
                 batch = sb.get_batch() if sb is not None else None
             if batch is not None:
                 yield batch
+
+    def block_sizes(self, shuffle_id: int, reduce_id: int,
+                    n_maps: int) -> List[int]:
+        """Per-map device byte sizes of one reduce partition — one lock pass
+        (AQE skew planning granularity)."""
+        out = [0] * n_maps
+        with self._mu:
+            for m in range(n_maps):
+                sb = self._blocks.get((shuffle_id, m, reduce_id))
+                if sb is not None:
+                    out[m] = sb.size_bytes
+        return out
 
     def invalidate_owner(self, executor_id: str) -> List[Tuple[int, int]]:
         """Drop all blocks produced by a lost peer; returns the

@@ -106,8 +106,9 @@ class TpuShuffleManager:
             f.result()
 
     def read_partition(self, shuffle_id: int, reduce_id: int,
-                       n_maps: int) -> List:
-        """Fetch one reduce partition's blocks from all maps in parallel."""
+                       n_maps: int, map_ids=None) -> List:
+        """Fetch one reduce partition's blocks from all maps in parallel.
+        `map_ids` restricts to a subset of maps (AQE skew slices)."""
 
         def read_one(map_id: int):
             p = self._path(shuffle_id, map_id, reduce_id)
@@ -118,7 +119,8 @@ class TpuShuffleManager:
             self.bytes_read += len(block)
             return deserialize_table(block)
 
-        futures = [self._readers.submit(read_one, m) for m in range(n_maps)]
+        maps = range(n_maps) if map_ids is None else map_ids
+        futures = [self._readers.submit(read_one, m) for m in maps]
         return [t for t in (f.result() for f in futures) if t is not None]
 
     def cleanup(self, shuffle_id: int) -> None:

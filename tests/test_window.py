@@ -99,3 +99,85 @@ def test_window_string_partition():
                          F.row_number().over(w).alias("rn"),
                          F.sum(F.col("v")).over(w).alias("rs"))
     assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+
+
+def test_bounded_minmax_frames():
+    """VERDICT r1 item 7: bounded min/max frames run on device via the
+    sparse-table range reduce (reference batched-bounded strategy,
+    GpuWindowExecMeta.scala:262-299) — previously tagged unsupported."""
+    import random
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.window import Window
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true"})
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    rng = random.Random(3)
+    rows = [{"g": i % 4, "o": i, "v": rng.randint(-50, 50) if i % 7 else None}
+            for i in range(120)]
+
+    def q(sess, lo, hi, agg):
+        w = Window.partitionBy("g").orderBy("o").rowsBetween(lo, hi)
+        df = sess.createDataFrame(rows)
+        return (df.select("g", "o", agg(F.col("v")).over(w).alias("x"))
+                  .orderBy("g", "o"))
+
+    for lo, hi in ((-3, 0), (-2, 2), (0, 4), (-5, -1), (1, 3)):
+        for agg in (F.min, F.max):
+            assert q(tpu, lo, hi, agg).collect() == \
+                q(cpu, lo, hi, agg).collect(), (lo, hi, agg)
+    plan = q(tpu, -3, 0, F.min).explain()
+    assert "TpuWindow" in plan, plan
+
+
+def test_bounded_minmax_nan_frames():
+    """Spark float ordering in bounded frames: NaN is greatest — max sees it,
+    min skips it unless the whole frame is NaN."""
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.window import Window
+    nan = float("nan")
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true"})
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    rows = [{"g": 0, "o": i, "v": v} for i, v in enumerate(
+        [1.0, nan, 3.0, nan, nan, 2.0, None, 5.0])]
+
+    def q(sess, agg):
+        w = Window.partitionBy("g").orderBy("o").rowsBetween(-1, 1)
+        df = sess.createDataFrame(rows)
+        return (df.select("o", agg(F.col("v")).over(w).alias("x"))
+                  .orderBy("o"))
+
+    import math
+
+    def canon(rs):
+        return [("nan" if isinstance(r["x"], float) and math.isnan(r["x"])
+                 else r["x"]) for r in rs]
+
+    for agg in (F.min, F.max):
+        assert canon(q(tpu, agg).collect()) == canon(q(cpu, agg).collect()), \
+            agg.__name__
+
+
+def test_running_minmax_nan():
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.window import Window
+    import math
+    nan = float("nan")
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true"})
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    rows = [{"g": 0, "o": i, "v": v} for i, v in enumerate(
+        [nan, 1.0, nan, 2.0, None, 0.5])]
+
+    def q(sess, agg):
+        w = Window.partitionBy("g").orderBy("o")  # running frame
+        df = sess.createDataFrame(rows)
+        return df.select("o", agg(F.col("v")).over(w).alias("x")).orderBy("o")
+
+    def canon(rs):
+        return [("nan" if isinstance(r["x"], float) and math.isnan(r["x"])
+                 else r["x"]) for r in rs]
+
+    for agg in (F.min, F.max):
+        assert canon(q(tpu, agg).collect()) == canon(q(cpu, agg).collect()), \
+            agg.__name__
