@@ -347,6 +347,14 @@ AQE_SKEW_FACTOR = _conf(
     "A partition is skewed when larger than this factor times the median "
     "partition size (and above the threshold)."
 ).integer(5)
+CACHE_BATCH_ROWS = _conf("spark.rapids.sql.cache.batchSizeRows").doc(
+    "Rows per parquet-compressed cached batch in df.cache() (reference "
+    "ParquetCachedBatchSerializer per-batch encoding)."
+).integer(1 << 18)
+CACHE_HOST_LIMIT = _conf("spark.rapids.sql.cache.hostMemoryLimit").doc(
+    "Host-memory budget for cached-relation blobs; overflow spills whole "
+    "compressed batches to local disk (0 disables the cap)."
+).bytes(0)
 FILECACHE_ENABLED = _conf("spark.rapids.filecache.enabled").doc(
     "Cache remote scan inputs (s3/gs/hdfs/...) on local disk (reference: "
     "the spark-rapids-private FileCache; SURVEY.md §1 notes the TPU build "

@@ -57,6 +57,34 @@ class CpuLocalTableScanExec(CpuExec):
                 break
 
 
+class CpuCachedScanExec(CpuExec):
+    """Scan over a per-batch parquet-compressed CachedRelation — each batch
+    decodes independently (reference: the read side of
+    ParquetCachedBatchSerializer). A CPU source like the local table scan;
+    transitions upload its output."""
+
+    def __init__(self, relation, output: List[AttributeReference]):
+        super().__init__([])
+        self.relation = relation
+        self._output = output
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return f"CpuCachedScan[{self.relation.node_desc()}]"
+
+    def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        names = [a.name for a in self._output]
+        for t in self.relation.iter_tables():
+            if t.num_rows:
+                yield t.rename_columns(names)
+
+
 class CpuRangeExec(CpuExec):
     def __init__(self, start: int, end: int, step: int, num_partitions: int,
                  output: List[AttributeReference]):

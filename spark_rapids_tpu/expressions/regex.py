@@ -165,10 +165,22 @@ class RegexpReplace(Expression):
         return _re.sub(r"\$(\d+)", r"\\\1", self.replacement)
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import pyarrow as pa
         import pyarrow.compute as pc
         arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
-        out = pc.replace_substring_regex(arr, pattern=self._transpiled,
-                                         replacement=self._java_to_py_repl())
+        prog = _re.compile(self._transpiled)
+        if prog.match(""):
+            # empty-matchable patterns: arrow's RE2 global replace advances
+            # differently from Java after a non-empty match ('c?' on "xcx":
+            # re2 → yxyxy, Java/python → yxyyxy — found by the regex fuzzer);
+            # keep those on the python engine that matches Java
+            repl = self._java_to_py_repl()
+            out = pa.array([None if v is None else prog.sub(repl, v)
+                            for v in arr.to_pylist()], pa.string())
+        else:
+            out = pc.replace_substring_regex(
+                arr, pattern=self._transpiled,
+                replacement=self._java_to_py_repl())
         return _string_result_from_arrow(out, batch)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
@@ -340,13 +352,22 @@ class Like(Expression):
                 ok = ok & (tail >= cur) & hit_at(hit, tail, len(last_b))
             return make_column(BooleanT, ok, valid, batch.num_rows)
         arr = _to_arrow_side(c, batch)
-        out = pc.match_like(arr, pattern=self.pattern)
+        out = self._match_host(arr)
         return _bool_result_from_arrow(out, batch)
 
+    def _match_host(self, arr):
+        """Host LIKE via the regex translation — arrow's match_like treats a
+        backslash before a NON-wildcard as a literal backslash, unlike
+        Spark/Java where \\x is always the literal x (found by the LIKE
+        fuzzer: 'c\\b%' vs 'cb...')."""
+        import pyarrow as pa
+        prog = _re.compile(self._to_regex(), _re.DOTALL)
+        # fullmatch: '$' alone would accept a trailing newline (python quirk)
+        return pa.array([None if v is None else bool(prog.fullmatch(v))
+                         for v in arr.to_pylist()], pa.bool_())
+
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
-        import pyarrow.compute as pc
-        return pc.match_like(self.children[0].eval_cpu(table, ctx),
-                             pattern=self.pattern)
+        return self._match_host(self.children[0].eval_cpu(table, ctx))
 
 
 class RegexpExtractAll(Expression):

@@ -1,31 +1,109 @@
-"""Cached-relation storage: parquet-compressed host batches.
+"""Cached-relation storage: per-batch parquet-compressed spillable entries.
 
-Reference: ParquetCachedBatchSerializer.scala (1407) — df.cache() stores
-compressed parquet-encoded batches on the host, decoded on access. The logical
-node keeps data parquet-compressed in memory and decodes per scan."""
+Reference: ParquetCachedBatchSerializer.scala (1407 LoC) — df.cache() encodes
+each batch to compressed parquet bytes; batches decode independently on
+access, and cold entries can spill to local disk. This replaces the r1
+whole-relation blob: a cached relation is now a list of CachedBatch entries,
+each one parquet-encoded, individually decodable, and movable HOST→DISK
+under a host-memory budget (the host tier of the spill story, SURVEY §5).
+"""
 
 from __future__ import annotations
 
 import io
-from typing import List
+import os
+import tempfile
+import threading
+from typing import Iterator, List, Optional
 
 from ..expressions.base import AttributeReference
 from ..plan.logical import LogicalPlan
 from ..types import from_arrow
 
 
-class CachedRelation(LogicalPlan):
-    """In-memory parquet-compressed cache of a materialized result."""
+class CachedBatch:
+    """One parquet-compressed batch. Blob lives in host memory until spilled
+    to a local file; decode works from either tier."""
 
-    def __init__(self, table, compression: str = "zstd"):
-        import pyarrow as pa
+    def __init__(self, table, compression: str):
         import pyarrow.parquet as pq
         buf = io.BytesIO()
         pq.write_table(table, buf, compression=compression)
-        self._blob = buf.getvalue()
+        self._blob: Optional[bytes] = buf.getvalue()
+        self._path: Optional[str] = None
+        self.num_rows = table.num_rows
+        self.compressed_bytes = len(self._blob)
+
+    @property
+    def on_disk(self) -> bool:
+        return self._path is not None
+
+    def spill(self, directory: str) -> int:
+        """Move the blob to disk; returns host bytes released."""
+        if self._blob is None:
+            return 0
+        fd, path = tempfile.mkstemp(suffix=".parquet", dir=directory)
+        with os.fdopen(fd, "wb") as f:
+            f.write(self._blob)
+        self._path = path
+        released = len(self._blob)
+        self._blob = None
+        return released
+
+    def table(self):
+        import pyarrow.parquet as pq
+        if self._blob is not None:
+            return pq.read_table(io.BytesIO(self._blob))
+        return pq.read_table(self._path)
+
+    def close(self) -> None:
+        self._blob = None
+        if self._path is not None:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+            self._path = None
+
+
+class CachedRelation(LogicalPlan):
+    """In-memory parquet-compressed cache of a materialized result,
+    chunked per batch."""
+
+    def __init__(self, table, compression: str = "zstd",
+                 batch_rows: Optional[int] = None,
+                 host_limit_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        from ..config import (CACHE_BATCH_ROWS, CACHE_HOST_LIMIT,
+                              default_conf)
+        conf = default_conf()
+        rows = batch_rows or conf.get(CACHE_BATCH_ROWS)
+        self._host_limit = (host_limit_bytes if host_limit_bytes is not None
+                            else conf.get(CACHE_HOST_LIMIT))
+        self._spill_dir = spill_dir or tempfile.gettempdir()
+        self._lock = threading.Lock()
+        self.batches: List[CachedBatch] = []
+        for start in range(0, max(table.num_rows, 1), rows):
+            self.batches.append(
+                CachedBatch(table.slice(start, rows), compression))
         self.num_rows = table.num_rows
         self._output = [AttributeReference(f.name, from_arrow(f.type), True)
                         for f in table.schema]
+        self._enforce_host_limit()
+
+    def _enforce_host_limit(self) -> None:
+        """Spill oldest in-memory batches until under the host budget
+        (the reference's host-store eviction to disk)."""
+        if self._host_limit <= 0:
+            return
+        with self._lock:
+            host_bytes = sum(b.compressed_bytes for b in self.batches
+                             if not b.on_disk)
+            for b in self.batches:
+                if host_bytes <= self._host_limit:
+                    break
+                if not b.on_disk:
+                    host_bytes -= b.spill(self._spill_dir)
 
     @property
     def output(self) -> List[AttributeReference]:
@@ -33,14 +111,32 @@ class CachedRelation(LogicalPlan):
 
     @property
     def compressed_bytes(self) -> int:
-        return len(self._blob)
+        return sum(b.compressed_bytes for b in self.batches)
+
+    @property
+    def host_bytes(self) -> int:
+        return sum(b.compressed_bytes for b in self.batches if not b.on_disk)
+
+    def iter_tables(self) -> Iterator:
+        """Decode batch-by-batch — consumers never hold the whole relation
+        decompressed (the per-batch contract of the reference serializer)."""
+        for b in self.batches:
+            yield b.table()
 
     def table(self):
-        import pyarrow.parquet as pq
-        return pq.read_table(io.BytesIO(self._blob))
+        import pyarrow as pa
+        return pa.concat_tables(list(self.iter_tables()))
+
+    def unpersist(self) -> None:
+        for b in self.batches:
+            b.close()
+        self.batches = []
 
     def node_desc(self) -> str:
-        return f"CachedRelation[{self.num_rows} rows, {len(self._blob)} bytes]"
+        disk = sum(1 for b in self.batches if b.on_disk)
+        return (f"CachedRelation[{self.num_rows} rows, "
+                f"{len(self.batches)} batches, {self.compressed_bytes} bytes"
+                + (f", {disk} on disk" if disk else "") + "]")
 
 
 class DeviceCachedRelation(LogicalPlan):

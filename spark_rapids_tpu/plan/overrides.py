@@ -531,7 +531,7 @@ class TpuTransitionOverrides:
     @staticmethod
     def assert_is_on_tpu(plan: PhysicalPlan) -> None:
         allowed_cpu = (DeviceToHostExec, HostToDeviceExec,
-                       CE.CpuLocalTableScanExec)
+                       CE.CpuLocalTableScanExec, CE.CpuCachedScanExec)
         for node in plan.collect_nodes():
             if isinstance(node, CpuExec) and not isinstance(node, allowed_cpu):
                 raise AssertionError(

@@ -29,7 +29,8 @@ def _compile_udfs(exprs, conf: RapidsConf):
 def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
     from ..io.cache import CachedRelation, DeviceCachedRelation
     if isinstance(plan, CachedRelation):
-        return CE.CpuLocalTableScanExec(plan.table(), 1, plan.output)
+        from ..execs.cpu import CpuCachedScanExec
+        return CpuCachedScanExec(plan, plan.output)
     if isinstance(plan, DeviceCachedRelation):
         from ..execs.transitions import CpuDeviceScanExec
         return CpuDeviceScanExec(plan.batches(), plan.output)

@@ -74,3 +74,39 @@ def test_cache_roundtrip():
     assert r1 == r2
     expected = df.filter(F.col("a") > 0).count()
     assert r1[0]["c"] == expected
+
+
+def test_cache_per_batch_serializer(tmp_path):
+    """VERDICT r1 item 10: df.cache() stores per-batch parquet-compressed
+    entries that decode independently and spill whole batches to disk under
+    a host budget (reference ParquetCachedBatchSerializer)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.io.cache import CachedRelation
+    t = pa.table({"a": list(range(10_000)), "b": [f"s{i}" for i in range(10_000)]})
+    rel = CachedRelation(t, batch_rows=1024)
+    assert len(rel.batches) == 10  # ceil(10000/1024)
+    assert rel.table().equals(t)
+    # per-batch decode
+    chunks = list(rel.iter_tables())
+    assert [c.num_rows for c in chunks][:3] == [1024, 1024, 1024]
+    # host budget forces disk spill of whole compressed batches
+    budget = rel.compressed_bytes // 2
+    rel2 = CachedRelation(t, batch_rows=1024, host_limit_bytes=budget,
+                          spill_dir=str(tmp_path))
+    assert any(b.on_disk for b in rel2.batches)
+    assert rel2.host_bytes <= budget
+    assert rel2.table().equals(t)  # decodes transparently from both tiers
+    rel2.unpersist()
+    assert not any(b.on_disk and b._path for b in rel2.batches)
+
+
+def test_cache_through_session():
+    from spark_rapids_tpu.session import TpuSession
+    import spark_rapids_tpu.functions as F
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true"})
+    df = tpu.createDataFrame([{"k": i % 5, "v": i} for i in range(200)])
+    cached = df.cache()
+    r1 = cached.groupBy("k").agg(F.sum(F.col("v")).alias("s")).orderBy("k").collect()
+    r2 = cached.groupBy("k").agg(F.sum(F.col("v")).alias("s")).orderBy("k").collect()
+    assert r1 == r2 and len(r1) == 5
+    assert "CachedRelation" in cached.explain()
