@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# CI gate: docs-drift + full test suite on the virtual 8-device CPU mesh.
+# Mirrors the reference's premerge flow (jenkins/spark-premerge-build.sh):
+# static validation first, then the correctness net.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== docs drift =="
+python tools/gen_docs.py >/dev/null
+if ! git diff --quiet -- docs/; then
+  echo "FAIL: docs/ drifted from code. Commit the regenerated docs." >&2
+  git diff --stat -- docs/ >&2
+  exit 1
+fi
+echo "ok"
+
+echo "== compile check =="
+python -m compileall -q spark_rapids_tpu tools benchmarks tests bench.py __graft_entry__.py
+
+echo "== tests =="
+python -m pytest tests/ -x -q
+
+echo "CI green."
