@@ -1,7 +1,12 @@
 """TPC-H-style benchmark queries running through the full framework
 (reference: integration_tests mortgage Benchmarks.scala + ScaleTest harness).
 
-Usage: python benchmarks/tpch.py [--rows N] [--queries q1,q3,q6] [--cpu]
+12 queries (q1 q3 q4 q5 q6 q9 q10 q12 q13 q14 q18 q19) over the full
+simplified-TPC-H schema from spark_rapids_tpu.datagen; every query runs
+end-to-end through session -> override engine -> exec chain, and each has a
+CPU-oracle equality test in tests/test_tpch_queries.py.
+
+Usage: python benchmarks/tpch.py [--rows N] [--queries q1,q3,...] [--cpu]
 Prints per-query wall-clock for the TPU plan and (optionally) the CPU plan.
 """
 
@@ -23,20 +28,32 @@ def make_session(tpu: bool):
 
 
 def load_tables(s, rows: int, parts: int = 4):
-    from spark_rapids_tpu.datagen import (tpch_customer, tpch_lineitem,
-                                          tpch_orders)
-    li = s.createDataFrame(tpch_lineitem(rows).generate(42, rows, parts),
-                          num_partitions=parts)
-    orders = s.createDataFrame(
-        tpch_orders(rows // 4).generate(42, rows // 4, parts),
-        num_partitions=parts)
-    cust = s.createDataFrame(
-        tpch_customer(rows // 40).generate(42, rows // 40, 1))
-    return li, orders, cust
+    """All eight TPC-H tables at lineitem-row scale `rows` (other tables
+    scaled by the usual TPC-H ratios)."""
+    from spark_rapids_tpu import datagen as dg
+
+    def df(spec, n, p=1):
+        return s.createDataFrame(spec.generate(42, n, p), num_partitions=p)
+
+    n_orders = max(rows // 4, 1)
+    n_cust = max(rows // 40, 1)
+    n_supp = max(rows // 100, 1)
+    n_part = max(rows // 20, 1)
+    return {
+        "lineitem": df(dg.tpch_lineitem(rows), rows, parts),
+        "orders": df(dg.tpch_orders(n_orders), n_orders, parts),
+        "customer": df(dg.tpch_customer(n_cust), n_cust),
+        "supplier": df(dg.tpch_supplier(n_supp), n_supp),
+        "part": df(dg.tpch_part(n_part), n_part),
+        "partsupp": df(dg.tpch_partsupp(n_part, n_supp), n_part * 4),
+        "nation": df(dg.tpch_nation(), dg.N_NATIONS),
+        "region": df(dg.tpch_region(), dg.N_REGIONS),
+    }
 
 
-def q1(s, li, orders, cust):
+def q1(s, t):
     import spark_rapids_tpu.functions as F
+    li = t["lineitem"]
     return (li.filter(F.col("l_shipdate") <= 10471)
             .withColumn("disc_price",
                         F.col("l_extendedprice") * (1 - F.col("l_discount")))
@@ -55,9 +72,10 @@ def q1(s, li, orders, cust):
             .sort("l_returnflag", "l_linestatus"))
 
 
-def q3(s, li, orders, cust):
+def q3(s, t):
     import spark_rapids_tpu.functions as F
-    return (cust.filter(F.col("c_mktsegment") == "A")
+    li, orders, cust = t["lineitem"], t["orders"], t["customer"]
+    return (cust.filter(F.col("c_mktsegment") == "BUILDING")
             .join(orders, on=cust["c_custkey"] == orders["o_custkey"])
             .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
             .withColumn("revenue",
@@ -68,8 +86,45 @@ def q3(s, li, orders, cust):
             .limit(10))
 
 
-def q6(s, li, orders, cust):
+def q4(s, t):
+    """Order-priority checking: semi join on late lineitems."""
     import spark_rapids_tpu.functions as F
+    li, orders = t["lineitem"], t["orders"]
+    late = li.filter(F.col("l_commitdate") < F.col("l_receiptdate"))
+    return (orders.filter((F.col("o_orderdate") >= 8582)
+                          & (F.col("o_orderdate") < 8674))
+            .join(late, on=orders["o_orderkey"] == late["l_orderkey"],
+                  how="leftsemi")
+            .groupBy("o_orderpriority")
+            .agg(F.count_star().alias("order_count"))
+            .sort("o_orderpriority"))
+
+
+def q5(s, t):
+    """Local supplier volume: five-way join down the region axis."""
+    import spark_rapids_tpu.functions as F
+    li, orders, cust = t["lineitem"], t["orders"], t["customer"]
+    supp, nation, region = t["supplier"], t["nation"], t["region"]
+    asia = region.filter(F.col("r_name") == "ASIA")
+    return (cust
+            .join(orders, on=cust["c_custkey"] == orders["o_custkey"])
+            .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
+            .join(supp, on=(li["l_suppkey"] == supp["s_suppkey"])
+                  & (cust["c_nationkey"] == supp["s_nationkey"]))
+            .join(nation, on=supp["s_nationkey"] == nation["n_nationkey"])
+            .join(asia, on=nation["n_regionkey"] == asia["r_regionkey"])
+            .filter((F.col("o_orderdate") >= 8766)
+                    & (F.col("o_orderdate") < 9131))
+            .withColumn("revenue",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .groupBy("n_name")
+            .agg(F.sum(F.col("revenue")).alias("revenue"))
+            .sort(F.col("revenue").desc()))
+
+
+def q6(s, t):
+    import spark_rapids_tpu.functions as F
+    li = t["lineitem"]
     return (li.filter((F.col("l_shipdate") >= 8766)
                       & (F.col("l_shipdate") < 9131)
                       & (F.col("l_discount") >= 0.05)
@@ -79,13 +134,148 @@ def q6(s, li, orders, cust):
                  .alias("revenue")))
 
 
-QUERIES = {"q1": q1, "q3": q3, "q6": q6}
+def q9(s, t):
+    """Product-type profit: part/supplier/partsupp/orders joins + like."""
+    import spark_rapids_tpu.functions as F
+    li, orders = t["lineitem"], t["orders"]
+    supp, nation, part, ps = (t["supplier"], t["nation"], t["part"],
+                              t["partsupp"])
+    green = part.filter(F.col("p_name").like("%green%"))
+    return (li
+            .join(green, on=li["l_partkey"] == green["p_partkey"])
+            .join(supp, on=li["l_suppkey"] == supp["s_suppkey"])
+            .join(ps, on=(li["l_suppkey"] == ps["ps_suppkey"])
+                  & (li["l_partkey"] == ps["ps_partkey"]))
+            .join(orders, on=li["l_orderkey"] == orders["o_orderkey"])
+            .join(nation, on=supp["s_nationkey"] == nation["n_nationkey"])
+            .withColumn("amount",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount"))
+                        - F.col("ps_supplycost") * F.col("l_quantity"))
+            .withColumn("o_year",
+                        (F.col("o_orderdate").cast("int") / 365).cast("int"))
+            .groupBy("n_name", "o_year")
+            .agg(F.sum(F.col("amount")).alias("sum_profit"))
+            .sort("n_name", F.col("o_year").desc()))
+
+
+def q10(s, t):
+    """Returned-item reporting: revenue lost to returns per customer."""
+    import spark_rapids_tpu.functions as F
+    li, orders, cust, nation = (t["lineitem"], t["orders"], t["customer"],
+                                t["nation"])
+    returned = li.filter(F.col("l_returnflag") == "R")
+    return (cust
+            .join(orders, on=cust["c_custkey"] == orders["o_custkey"])
+            .join(returned, on=orders["o_orderkey"] == returned["l_orderkey"])
+            .join(nation, on=cust["c_nationkey"] == nation["n_nationkey"])
+            .filter((F.col("o_orderdate") >= 8674)
+                    & (F.col("o_orderdate") < 8766))
+            .withColumn("revenue",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .groupBy("c_custkey", "c_name", "c_acctbal", "c_phone", "n_name")
+            .agg(F.sum(F.col("revenue")).alias("revenue"))
+            .sort(F.col("revenue").desc())
+            .limit(20))
+
+
+def q12(s, t):
+    """Shipping modes and order priority: conditional aggregation."""
+    import spark_rapids_tpu.functions as F
+    li, orders = t["lineitem"], t["orders"]
+    sel = li.filter(((F.col("l_shipmode") == "MAIL")
+                     | (F.col("l_shipmode") == "SHIP"))
+                    & (F.col("l_commitdate") < F.col("l_receiptdate"))
+                    & (F.col("l_shipdate") < F.col("l_commitdate"))
+                    & (F.col("l_receiptdate") >= 8766)
+                    & (F.col("l_receiptdate") < 9131))
+    high = ((F.col("o_orderpriority") == "1-URGENT")
+            | (F.col("o_orderpriority") == "2-HIGH"))
+    return (orders.join(sel, on=orders["o_orderkey"] == sel["l_orderkey"])
+            .groupBy("l_shipmode")
+            .agg(F.sum(F.when(high, 1).otherwise(0)).alias("high_line_count"),
+                 F.sum(F.when(~high, 1).otherwise(0)).alias("low_line_count"))
+            .sort("l_shipmode"))
+
+
+def q13(s, t):
+    """Customer order-count distribution: left join + two-level agg."""
+    import spark_rapids_tpu.functions as F
+    orders, cust = t["orders"], t["customer"]
+    sel = orders.filter(~F.col("o_orderpriority").like("%NOT%"))
+    per_cust = (cust.join(sel, on=cust["c_custkey"] == sel["o_custkey"],
+                          how="left")
+                .groupBy("c_custkey")
+                .agg(F.count(F.col("o_orderkey")).alias("c_count")))
+    return (per_cust.groupBy("c_count")
+            .agg(F.count_star().alias("custdist"))
+            .sort(F.col("custdist").desc(), F.col("c_count").desc()))
+
+
+def q14(s, t):
+    """Promotion effect: conditional revenue ratio."""
+    import spark_rapids_tpu.functions as F
+    li, part = t["lineitem"], t["part"]
+    sel = li.filter((F.col("l_shipdate") >= 9374)
+                    & (F.col("l_shipdate") < 9404))
+    joined = sel.join(part, on=sel["l_partkey"] == part["p_partkey"])
+    rev = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    promo = F.col("p_type").like("PROMO%")
+    return joined.agg(
+        (F.sum(F.when(promo, rev).otherwise(F.lit(0.0))) * 100.0
+         / F.sum(rev)).alias("promo_revenue"))
+
+
+def q18(s, t):
+    """Large-volume customers: grouped having via filter on aggregate."""
+    import spark_rapids_tpu.functions as F
+    li, orders, cust = t["lineitem"], t["orders"], t["customer"]
+    big = (li.groupBy("l_orderkey")
+           .agg(F.sum(F.col("l_quantity")).alias("total_qty"))
+           .filter(F.col("total_qty") > 150))
+    return (orders
+            .join(big, on=orders["o_orderkey"] == big["l_orderkey"],
+                  how="leftsemi")
+            .join(cust, on=orders["o_custkey"] == cust["c_custkey"])
+            .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
+            .groupBy("c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                     "o_totalprice")
+            .agg(F.sum(F.col("l_quantity")).alias("sum_qty"))
+            .sort(F.col("o_totalprice").desc(), "o_orderdate")
+            .limit(100))
+
+
+def q19(s, t):
+    """Discounted revenue: disjunctive bracketed predicates."""
+    import spark_rapids_tpu.functions as F
+    li, part = t["lineitem"], t["part"]
+    j = li.join(part, on=li["l_partkey"] == part["p_partkey"])
+    qty, size = F.col("l_quantity"), F.col("p_size")
+    common = (((F.col("l_shipmode") == "AIR")
+               | (F.col("l_shipmode") == "REG AIR"))
+              & (F.col("l_shipinstruct") == "DELIVER IN PERSON"))
+    b1 = ((F.col("p_brand") == "Brand#12")
+          & F.col("p_container").like("SM%")
+          & (qty >= 1) & (qty <= 11) & (size >= 1) & (size <= 5))
+    b2 = ((F.col("p_brand") == "Brand#23")
+          & F.col("p_container").like("MED%")
+          & (qty >= 10) & (qty <= 20) & (size >= 1) & (size <= 10))
+    b3 = ((F.col("p_brand") == "Brand#34")
+          & F.col("p_container").like("LG%")
+          & (qty >= 20) & (qty <= 30) & (size >= 1) & (size <= 15))
+    return (j.filter(common & (b1 | b2 | b3))
+            .agg(F.sum(F.col("l_extendedprice") * (1 - F.col("l_discount")))
+                 .alias("revenue")))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q9": q9,
+           "q10": q10, "q12": q12, "q13": q13, "q14": q14, "q18": q18,
+           "q19": q19}
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
-    ap.add_argument("--queries", default="q1,q3,q6")
+    ap.add_argument("--queries", default=",".join(QUERIES))
     ap.add_argument("--cpu", action="store_true",
                     help="also time the CPU (fallback) plan")
     args = ap.parse_args()
@@ -93,10 +283,10 @@ def main() -> None:
     results = {}
     for mode in (["tpu", "cpu"] if args.cpu else ["tpu"]):
         s = make_session(tpu=(mode == "tpu"))
-        li, orders, cust = load_tables(s, args.rows)
+        tables = load_tables(s, args.rows)
         for name in args.queries.split(","):
             fn = QUERIES[name.strip()]
-            df = fn(s, li, orders, cust)
+            df = fn(s, tables)
             t0 = time.perf_counter()
             out = df.to_arrow()
             dt = time.perf_counter() - t0

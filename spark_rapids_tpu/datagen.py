@@ -26,9 +26,12 @@ class ColumnSpec:
     def __init__(self, name: str, kind: str, *, cardinality: Optional[int] = None,
                  skew: float = 0.0, min_val=None, max_val=None,
                  null_prob: float = 0.0, alphabet: str = "abcdefghij",
-                 max_len: int = 12):
+                 max_len: int = 12, values: Optional[Sequence[str]] = None,
+                 sequential: bool = False, modulo: Optional[int] = None,
+                 repeat: int = 1):
         self.name = name
-        self.kind = kind  # int/long/double/string/date/bool/key
+        # int/long/double/string/date/bool/key/seq/choice
+        self.kind = kind
         self.cardinality = cardinality
         self.skew = skew  # 0 = uniform; >0 zipf-ish concentration
         self.min_val = min_val
@@ -36,8 +39,35 @@ class ColumnSpec:
         self.null_prob = null_prob
         self.alphabet = alphabet
         self.max_len = max_len
+        self.values = list(values) if values is not None else None
+        self.sequential = sequential  # choice: values[row % len] (dim tables)
+        self.modulo = modulo          # seq: (row // repeat) % modulo
+        self.repeat = repeat          # seq: each key value repeats this often
 
-    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+    def generate(self, rng: np.random.Generator, n: int,
+                 offset: int = 0) -> pa.Array:
+        if self.kind == "seq":
+            # primary-key column: globally unique (offset carries across
+            # partitions); with modulo/repeat it becomes a deterministic FK
+            vals = (np.arange(offset, offset + n, dtype=np.int64)
+                    // self.repeat)
+            if self.modulo:
+                vals = vals % self.modulo
+            return pa.array(vals, pa.int64())
+        if self.kind == "choice":
+            vals = self.values
+            if self.sequential:
+                idx = (np.arange(offset, offset + n)) % len(vals)
+            elif self.skew > 0:
+                ranks = np.arange(1, len(vals) + 1, dtype=np.float64)
+                w = ranks ** (-self.skew)
+                w /= w.sum()
+                idx = rng.choice(len(vals), size=n, p=w)
+            else:
+                idx = rng.integers(0, len(vals), n)
+            arr = pa.array(np.asarray(vals, dtype=object)[idx].tolist(),
+                           pa.string())
+            return self._with_nulls(arr, rng, n)
         if self.kind in ("key", "int", "long"):
             if self.cardinality:
                 if self.skew > 0:
@@ -86,6 +116,10 @@ class ColumnSpec:
                 arr = pa.array(out)
         else:
             raise ValueError(f"unknown column kind {self.kind}")
+        return self._with_nulls(arr, rng, n)
+
+    def _with_nulls(self, arr: pa.Array, rng: np.random.Generator,
+                    n: int) -> pa.Array:
         if self.null_prob > 0:
             mask = rng.random(n) < self.null_prob
             arr = pa.array([None if m else v
@@ -99,27 +133,62 @@ class TableSpec:
         self.name = name
         self.columns = list(columns)
 
-    def generate_partition(self, seed: int, part: int, rows: int) -> pa.Table:
+    def generate_partition(self, seed: int, part: int, rows: int,
+                           offset: int = 0) -> pa.Table:
         cols = {}
         for c in self.columns:
             rng = _cell_rng(seed, self.name, c.name, part)
-            cols[c.name] = c.generate(rng, rows)
+            cols[c.name] = c.generate(rng, rows, offset=offset)
         return pa.table(cols)
 
     def generate(self, seed: int, rows: int, partitions: int = 1) -> pa.Table:
         per = rows // partitions
-        tables = [self.generate_partition(seed, p,
-                                          per + (1 if p < rows % partitions else 0))
-                  for p in range(partitions)]
+        tables, offset = [], 0
+        for p in range(partitions):
+            n = per + (1 if p < rows % partitions else 0)
+            tables.append(self.generate_partition(seed, p, n, offset=offset))
+            offset += n
         return pa.concat_tables(tables)
 
 
 # --- TPC-H-style schema at a given scale (rows ~ SF * base) -----------------
 
+_SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+_SHIPINSTRUCT = ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                 "TAKE BACK RETURN"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+            "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+            "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+            "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+            "UNITED KINGDOM", "UNITED STATES"]
+_COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+           "blanched", "blue", "blush", "brown", "burlywood", "burnished",
+           "chartreuse", "chiffon", "chocolate", "coral", "cornflower",
+           "cream", "cyan", "dark", "green", "forest", "frosted", "gainsboro",
+           "ghost", "goldenrod", "honeydew", "hot", "indian", "ivory"]
+_TYPES = [f"{a} {b} {c}"
+          for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
+                    "PROMO")
+          for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED")
+          for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+_BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+_CONTAINERS = [f"{a} {b}"
+               for a in ("SM", "MED", "LG", "JUMBO", "WRAP")
+               for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN",
+                         "DRUM")]
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+
+N_NATIONS = len(_NATIONS)
+N_REGIONS = len(_REGIONS)
+
+
 def tpch_lineitem(scale_rows: int) -> TableSpec:
     return TableSpec("lineitem", [
         ColumnSpec("l_orderkey", "key", cardinality=max(scale_rows // 4, 1)),
         ColumnSpec("l_partkey", "key", cardinality=max(scale_rows // 20, 1)),
+        ColumnSpec("l_suppkey", "key", cardinality=max(scale_rows // 100, 1)),
         ColumnSpec("l_quantity", "int", min_val=1, max_val=50),
         ColumnSpec("l_extendedprice", "double", min_val=900.0, max_val=105000.0),
         ColumnSpec("l_discount", "double", min_val=0.0, max_val=0.1),
@@ -129,22 +198,79 @@ def tpch_lineitem(scale_rows: int) -> TableSpec:
         ColumnSpec("l_linestatus", "string", cardinality=2, max_len=1,
                    alphabet="OF"),
         ColumnSpec("l_shipdate", "date", min_val=8035, max_val=10590),
+        ColumnSpec("l_commitdate", "date", min_val=8035, max_val=10590),
+        ColumnSpec("l_receiptdate", "date", min_val=8035, max_val=10590),
+        ColumnSpec("l_shipmode", "choice", values=_SHIPMODES),
+        ColumnSpec("l_shipinstruct", "choice", values=_SHIPINSTRUCT),
     ])
 
 
 def tpch_orders(scale_rows: int) -> TableSpec:
     return TableSpec("orders", [
-        ColumnSpec("o_orderkey", "key", cardinality=max(scale_rows, 1)),
+        ColumnSpec("o_orderkey", "seq"),
         ColumnSpec("o_custkey", "key", cardinality=max(scale_rows // 10, 1)),
         ColumnSpec("o_orderdate", "date", min_val=8035, max_val=10590),
         ColumnSpec("o_totalprice", "double", min_val=800.0, max_val=600000.0),
+        ColumnSpec("o_orderpriority", "choice", values=_PRIORITIES),
+        ColumnSpec("o_orderstatus", "choice", values=["O", "F", "P"]),
     ])
 
 
 def tpch_customer(scale_rows: int) -> TableSpec:
     return TableSpec("customer", [
-        ColumnSpec("c_custkey", "key", cardinality=max(scale_rows, 1)),
-        ColumnSpec("c_mktsegment", "string", cardinality=5, max_len=1,
-                   alphabet="ABCDE"),
+        ColumnSpec("c_custkey", "seq"),
+        ColumnSpec("c_name", "string", max_len=18),
+        ColumnSpec("c_mktsegment", "choice", values=_SEGMENTS),
         ColumnSpec("c_acctbal", "double", min_val=-1000.0, max_val=10000.0),
+        ColumnSpec("c_nationkey", "seq", modulo=N_NATIONS),
+        ColumnSpec("c_phone", "string", alphabet="0123456789-", max_len=15),
+    ])
+
+
+def tpch_supplier(scale_rows: int) -> TableSpec:
+    return TableSpec("supplier", [
+        ColumnSpec("s_suppkey", "seq"),
+        ColumnSpec("s_name", "string", max_len=18),
+        ColumnSpec("s_nationkey", "seq", modulo=N_NATIONS),
+        ColumnSpec("s_acctbal", "double", min_val=-1000.0, max_val=10000.0),
+    ])
+
+
+def tpch_part(scale_rows: int) -> TableSpec:
+    return TableSpec("part", [
+        ColumnSpec("p_partkey", "seq"),
+        ColumnSpec("p_name", "choice", values=[
+            f"{a} {b}" for a in _COLORS for b in ("metal", "steel", "satin")]),
+        ColumnSpec("p_type", "choice", values=_TYPES),
+        ColumnSpec("p_brand", "choice", values=_BRANDS),
+        ColumnSpec("p_container", "choice", values=_CONTAINERS),
+        ColumnSpec("p_size", "int", min_val=1, max_val=50),
+        ColumnSpec("p_retailprice", "double", min_val=900.0, max_val=2000.0),
+    ])
+
+
+def tpch_partsupp(n_parts: int, n_suppliers: int) -> TableSpec:
+    # 4 suppliers per part: ps_partkey = (row // 4) % n_parts — the modulo
+    # keeps the FK inside part's key domain for ANY generated row count
+    return TableSpec("partsupp", [
+        ColumnSpec("ps_partkey", "seq", repeat=4, modulo=max(n_parts, 1)),
+        ColumnSpec("ps_suppkey", "key",
+                   cardinality=max(n_suppliers, 1)),
+        ColumnSpec("ps_availqty", "int", min_val=1, max_val=9999),
+        ColumnSpec("ps_supplycost", "double", min_val=1.0, max_val=1000.0),
+    ])
+
+
+def tpch_nation() -> TableSpec:
+    return TableSpec("nation", [
+        ColumnSpec("n_nationkey", "seq"),
+        ColumnSpec("n_name", "choice", values=_NATIONS, sequential=True),
+        ColumnSpec("n_regionkey", "seq", modulo=N_REGIONS),
+    ])
+
+
+def tpch_region() -> TableSpec:
+    return TableSpec("region", [
+        ColumnSpec("r_regionkey", "seq"),
+        ColumnSpec("r_name", "choice", values=_REGIONS, sequential=True),
     ])

@@ -465,6 +465,8 @@ def _sortable_bits(col: TpuColumnVector):
             f"no sortable encoding for {col.dtype.simple_string()} "
             f"(two-limb carrier)")
     if jnp.issubdtype(d.dtype, jnp.floating):
+        from ..utils.hw import sortable_float_dtype
+        d = d.astype(sortable_float_dtype(d.dtype))
         d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
         canon = jnp.asarray(np.array(np.nan, d.dtype))
         d = jnp.where(jnp.isnan(d), canon, d)
@@ -659,6 +661,8 @@ def _dedup_bits(col_data):
     HashSet merges NaNs) but -0.0 and 0.0 kept distinct (Double.equals)."""
     d = col_data
     if jnp.issubdtype(d.dtype, jnp.floating):
+        from ..utils.hw import sortable_float_dtype
+        d = d.astype(sortable_float_dtype(d.dtype))
         canon = jnp.asarray(np.array(np.nan, d.dtype))
         d = jnp.where(jnp.isnan(d), canon, d)
         return d.view(jnp.int64 if d.dtype == jnp.float64 else jnp.int32)

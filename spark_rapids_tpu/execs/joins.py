@@ -34,14 +34,14 @@ from .aggregates import _sortable_bits
 from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
                    bind_references)
 
-_MIX = np.uint64(0x9E3779B97F4A7C15)
-
-
 def _mix64(h, v):
-    """64-bit mix chain (splitmix-style); the verified-equality pass makes
-    collisions harmless."""
-    h = (h ^ v) * jnp.uint64(_MIX)
-    h = h ^ (h >> 29)
+    """Width-adaptive mix chain (splitmix-style, 64-bit where the backend is
+    natively 64-bit, 32-bit on demoting TPU backends); the verified-equality
+    pass makes collisions harmless."""
+    from ..utils.hw import hash_plane
+    _, mix_const, _, _ = hash_plane()
+    h = (h ^ v) * mix_const
+    h = h ^ (h >> (29 if h.dtype == jnp.uint64 else 15))
     return h
 
 
@@ -67,17 +67,40 @@ def _encode_sides(left_cols: List[TpuColumnVector], right_cols: List[TpuColumnVe
             l_enc.append((jnp.asarray(lbuf), lc.validity))
             r_enc.append((jnp.asarray(rbuf), rc.validity))
         else:
-            l_enc.append((_sortable_bits(lc).astype(jnp.int64), lc.validity))
-            r_enc.append((_sortable_bits(rc).astype(jnp.int64), rc.validity))
+            from ..utils.hw import x64_native
+            lb, rb = _sortable_bits(lc), _sortable_bits(rc)
+            if x64_native():
+                l_enc.append((lb.astype(jnp.int64), lc.validity))
+                r_enc.append((rb.astype(jnp.int64), rc.validity))
+            elif lb.dtype.itemsize == 8 or rb.dtype.itemsize == 8:
+                # demoting backend + 64-bit key: split into two i32 limbs so
+                # the verified-equality pass stays EXACT (a single truncated
+                # i32 would silently join keys equal mod 2^32); floats were
+                # already narrowed to the backend's compute width upstream
+                for b, v in ((lb, l_enc), (rb, r_enc)):
+                    b64 = b.astype(jnp.int64)
+                    v.append(((b64 >> 32).astype(jnp.int32),
+                              lc.validity if v is l_enc else rc.validity))
+                    v.append((b64.astype(jnp.int32),
+                              lc.validity if v is l_enc else rc.validity))
+            else:
+                l_enc.append((lb.astype(jnp.int32), lc.validity))
+                r_enc.append((rb.astype(jnp.int32), rc.validity))
     return l_enc, r_enc
 
 
 def _composite_hash(enc, num_rows: int, capacity: int):
-    """64-bit composite hash + all-keys-valid mask."""
-    h = jnp.full((capacity,), jnp.uint64(0x243F6A8885A308D3), jnp.uint64)
+    """Composite hash (width per backend) + all-keys-valid mask."""
+    from ..utils.hw import hash_plane
+    uint_t, _, init, _ = hash_plane()
+    h = jnp.full((capacity,), init, uint_t)
     ok = row_mask(num_rows, capacity)
     for vals, validity in enc:
-        h = _mix64(h, vals.view(jnp.uint64))
+        if vals.dtype.itemsize == jnp.dtype(uint_t).itemsize:
+            v = vals.view(uint_t)
+        else:  # cross-width: wrap-around cast (equality-preserving mod 2^w)
+            v = vals.astype(uint_t)
+        h = _mix64(h, v)
         if validity is not None:
             ok = ok & validity
     return h, ok
@@ -91,11 +114,12 @@ def _device_equi_join(build_enc, build_rows: int, probe_enc, probe_rows: int):
     bh, b_ok = _composite_hash(build_enc, build_rows, b_cap)
     ph, p_ok = _composite_hash(probe_enc, probe_rows, p_cap)
     # exclude invalid build rows: sort them to the end under a max sentinel
-    sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    from ..utils.hw import hash_plane
+    _, _, _, sentinel = hash_plane()
     sort_key = jnp.where(b_ok, bh, sentinel)
     order = jnp.argsort(sort_key)
     bh_sorted = jnp.take(sort_key, order)
-    ph_safe = jnp.where(p_ok, ph, jnp.uint64(0))
+    ph_safe = jnp.where(p_ok, ph, jnp.zeros((), bh.dtype))
     lo = jnp.searchsorted(bh_sorted, ph_safe, side="left")
     hi = jnp.searchsorted(bh_sorted, ph_safe, side="right")
     counts = jnp.where(p_ok, hi - lo, 0)
@@ -484,13 +508,13 @@ class CpuShuffledHashJoinExec(CpuExec):
         n_l = len(self.children[0].output)
         n_r = len(self.children[1].output)
         lkeys, rkeys = [], []
-        for i, k in enumerate(self.left_keys):
-            lt = lt.append_column(f"__lk_{i}", _norm_key(
-                _as_arr(k.eval_cpu(lt, ctx.eval_ctx))))
+        for i, (lk, rk) in enumerate(zip(self.left_keys, self.right_keys)):
+            la = _norm_key(_as_arr(lk.eval_cpu(lt, ctx.eval_ctx)))
+            ra = _norm_key(_as_arr(rk.eval_cpu(rt, ctx.eval_ctx)))
+            la, ra = _align_key_pair(la, ra)
+            lt = lt.append_column(f"__lk_{i}", la)
             lkeys.append(f"__lk_{i}")
-        for i, k in enumerate(self.right_keys):
-            rt = rt.append_column(f"__rk_{i}", _norm_key(
-                _as_arr(k.eval_cpu(rt, ctx.eval_ctx))))
+            rt = rt.append_column(f"__rk_{i}", ra)
             rkeys.append(f"__rk_{i}")
         l_out = [f"l{i}" for i in range(n_l)]
         r_out = [f"r{i}" for i in range(n_r)]
@@ -552,6 +576,31 @@ class CpuShuffledHashJoinExec(CpuExec):
 def _as_arr(x):
     import pyarrow as pa
     return x.combine_chunks() if isinstance(x, pa.ChunkedArray) else x
+
+
+def _align_key_pair(la, ra):
+    """Promote mismatched join-key types to a common comparable type
+    (date32 vs int as day numbers — shared rule with the comparison
+    predicates; int widths to the wider) — the device plane compares via
+    width-normalized sortable bits, so the CPU oracle must accept the same
+    pairs."""
+    import pyarrow as pa
+    from ..expressions.predicates import _align_date_int
+
+    both_arr = all(isinstance(x, (pa.Array, pa.ChunkedArray))
+                   for x in (la, ra))
+    if both_arr and la.type != ra.type:
+        la, ra = _align_date_int(pa, la, ra)
+        if pa.types.is_date32(la.type) or pa.types.is_date32(ra.type):
+            # date vs non-int (e.g. date32 vs int64-backed date): day numbers
+            la = la.cast(pa.int32()) if pa.types.is_date32(la.type) else la
+            ra = ra.cast(pa.int32()) if pa.types.is_date32(ra.type) else ra
+        if pa.types.is_integer(la.type) and pa.types.is_integer(ra.type) \
+                and la.type != ra.type:
+            target = (la.type if la.type.bit_width >= ra.type.bit_width
+                      else ra.type)
+            la, ra = la.cast(target), ra.cast(target)
+    return la, ra
 
 
 def _norm_key(arr):

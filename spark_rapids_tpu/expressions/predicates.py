@@ -78,6 +78,9 @@ class BinaryComparison(BinaryExpression):
         import pyarrow.compute as pc
         l = self.left.eval_cpu(table, ctx)
         r = self.right.eval_cpu(table, ctx)
+        # date32 vs integer: compare as day numbers, mirroring the device
+        # plane (which stores date32 as int32 days)
+        l, r = _align_date_int(pa, l, r)
         lt = l.type if isinstance(l, (pa.Array, pa.ChunkedArray)) else None
         if lt is not None and (pa.types.is_floating(lt)) and _has_nan(l, r):
             return self._cpu_nan_path(l, r)
@@ -90,6 +93,25 @@ class BinaryComparison(BinaryExpression):
         with np.errstate(invalid="ignore"):
             out = self._np_cmp(ln, rn)
         return pa.array(out, mask=lm | rm)
+
+
+def _align_date_int(pa, l, r):
+    """Cast date32 to int32 day numbers when the comparison's other side is
+    an integer (scalar or array); no-op otherwise."""
+    def is_date(x):
+        return (isinstance(x, (pa.Array, pa.ChunkedArray))
+                and pa.types.is_date32(x.type))
+
+    def is_int(x):
+        if isinstance(x, (pa.Array, pa.ChunkedArray)):
+            return pa.types.is_integer(x.type)
+        return isinstance(x, (int, np.integer)) and not isinstance(x, bool)
+
+    if is_date(l) and is_int(r):
+        l = l.cast(pa.int32())
+    elif is_date(r) and is_int(l):
+        r = r.cast(pa.int32())
+    return l, r
 
 
 def _has_nan(l, r) -> bool:

@@ -143,6 +143,20 @@ class Column:
             else values
         return Column(In(self._expr, [_expr(v) for v in items]))
 
+    def like(self, pattern: str) -> "Column":
+        from .expressions.regex import Like
+        return Column(Like(self._expr, pattern))
+
+    def rlike(self, pattern: str) -> "Column":
+        from .expressions.regex import RLike
+        return Column(RLike(self._expr, pattern))
+
+    def between(self, lower, upper) -> "Column":
+        from .expressions.predicates import And, GreaterThanOrEqual, \
+            LessThanOrEqual
+        return Column(And(GreaterThanOrEqual(self._expr, _expr(lower)),
+                          LessThanOrEqual(self._expr, _expr(upper))))
+
     def startswith(self, other) -> "Column":
         from .expressions.strings import StartsWith
         return Column(StartsWith(self._expr, _expr(other)))

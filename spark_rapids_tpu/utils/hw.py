@@ -1,0 +1,61 @@
+"""Hardware capability probes for the active JAX backend.
+
+The real TPU path (axon) compiles with an X64-removal pass: f64/i64 are
+demoted to 32-bit and programs containing ops that cannot be rewritten
+(notably bitcast-convert on 64-bit types) are rejected at compile time.
+Device code that relies on 64-bit bit views (sortable float encodings, the
+join/shuffle hash plane) must therefore pick its width per backend.
+
+Reference analogue: GpuDeviceManager.scala validates device capabilities at
+startup (validateGpuArchitecture); here the probe is a one-time AOT compile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=None)
+def _x64_native_for(backend: str) -> bool:
+    try:
+        fn = jax.jit(
+            lambda x: jax.lax.bitcast_convert_type(x, jnp.int64) ^ 1)
+        x = jnp.ones((8,), jnp.float64)
+        fn.lower(x).compile()
+        return True
+    except Exception:  # noqa: BLE001 — any rejection means "not native"
+        return False
+
+
+def x64_native() -> bool:
+    """True when the active backend compiles 64-bit bitcasts natively (CPU
+    does; the tunneled TPU demotes X64 and rejects them). Cached per
+    backend name actually in use."""
+    return _x64_native_for(jax.default_backend())
+
+
+def sortable_float_dtype(dtype):
+    """The float dtype whose bit-encoding is safe on this backend: f64 stays
+    f64 where 64-bit bitcasts work, else f32 (the demoting backend computes
+    every f64 op in f32 anyway, so the narrowing is semantics-preserving
+    on-device)."""
+    if dtype == jnp.float64 and not x64_native():
+        return jnp.float32
+    return dtype
+
+
+def hash_plane():
+    """(uint dtype, mix constant, init value, sentinel) for the join/shuffle
+    composite-hash plane. 64-bit splitmix on native backends; 32-bit variant
+    (same structure) on demoting backends — hash collisions only add
+    verified-equality candidates, never wrong results."""
+    import numpy as np
+    if x64_native():
+        return (jnp.uint64, jnp.uint64(np.uint64(0x9E3779B97F4A7C15)),
+                jnp.uint64(np.uint64(0x243F6A8885A308D3)),
+                jnp.uint64(np.uint64(0xFFFFFFFFFFFFFFFF)))
+    return (jnp.uint32, jnp.uint32(0x9E3779B9), jnp.uint32(0x85A308D3),
+            jnp.uint32(0xFFFFFFFF))
