@@ -213,8 +213,11 @@ def _framework_q3(rows: int) -> dict:
     q = tpch.q3(s, tables)
     out = q.to_arrow()  # warm (compiles every stage in the chain)
     # reuse the prebuilt q: results are not memoized, and timing only
-    # re-execution matches the q1/q6 methodology
-    sec = _time_best(lambda: q.to_arrow(), iters=3)
+    # re-execution matches the q1/q6 methodology. ONE timed iteration:
+    # the multi-operator chain is dispatch-bound through the tunnel
+    # (hundreds of program launches at ~0.1 s fixed cost each), so a
+    # single run is representative and keeps bench wall time sane.
+    sec = _time_best(lambda: q.to_arrow(), iters=1)
     return {"sec": sec, "rows_out": out.num_rows, "lineitem_rows": rows}
 
 
@@ -243,6 +246,20 @@ def _cpu_q1(table) -> float:
 
 
 def main() -> None:
+    import os
+
+    import jax
+    # persistent XLA compile cache: the exec chain builds hundreds of
+    # programs; remote compiles through the tunnel cost ~20-40s each, so
+    # cache hits across bench runs matter more than any kernel tweak
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — older jax: cache flag absent
+        pass
+
     n = 1 << 24  # 16.7M rows
     roofline = _calibrate()
     kern = _kernel_q1(n)
@@ -251,7 +268,7 @@ def main() -> None:
     fw = _framework_q1(table)
     fw_rows_per_s = n / fw["sec"]
     q6_s = _framework_q6(table)
-    q3 = _framework_q3(1 << 21)  # 2M lineitem rows through 4 partitions
+    q3 = _framework_q3(1 << 18)  # 262k lineitem rows through 4 partitions
 
     cpu_s = _cpu_q1(table)
     cpu_rows_per_s = n / cpu_s
@@ -295,7 +312,12 @@ def main() -> None:
             "baseline": "reference ETL headline 3.8x (BASELINE.md)",
             "note": ("wall times include the tunnel's fixed ~dispatch "
                      "overhead; device_* numbers are chained-slope marginal "
-                     "times (true silicon throughput)"),
+                     "times (true silicon throughput). Multi-operator "
+                     "queries (q3) are dispatch-bound through the tunnel: "
+                     "each program launch costs ~dispatch_overhead, so "
+                     "their wall time measures launch count, not silicon "
+                     "— the whole-stage-compiled q1 path (2 launches) is "
+                     "the architecture's answer"),
         },
     }))
 

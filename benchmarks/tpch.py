@@ -23,7 +23,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def make_session(tpu: bool):
     from spark_rapids_tpu.session import TpuSession
+    # device-resident shuffle (reference UCX/CACHE_ONLY mode): blocks stay
+    # in HBM as spillable batches — the file mode's Arrow round trip costs
+    # thousands of ~100ms tunnel transfers per query
     return TpuSession({"spark.rapids.sql.enabled": str(tpu).lower(),
+                       "spark.rapids.shuffle.mode":
+                           "ICI" if tpu else "MULTITHREADED",
                        "spark.sql.shuffle.partitions": "8"})
 
 

@@ -11,8 +11,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence
 
+import functools as _functools
+
 import jax.numpy as jnp
 import numpy as np
+
+from jax import jit as _jax_jit
 
 from ..types import DataType, StructField, StructType, from_arrow as arrow_to_type
 from .vector import TpuColumnVector, bucket_capacity, row_mask
@@ -214,13 +218,46 @@ def gather(batch: TpuColumnarBatch, indices, out_rows: int,
     """
     cap = out_capacity if out_capacity is not None else bucket_capacity(out_rows)
     idx = jnp.asarray(indices)[:cap].astype(jnp.int32)
-    valid_idx = (idx >= 0) & (idx < batch.num_rows)
-    safe = jnp.where(valid_idx, idx, 0)
-    pad_mask = row_mask(out_rows, cap)
-    out_cols = []
-    for col in batch.columns:
-        out_cols.append(_gather_column(col, safe, valid_idx & pad_mask, out_rows, cap))
+    # fixed-width columns gather in ONE compiled program (each eager op is a
+    # ~100ms dispatch on the tunneled TPU); strings/lists keep the
+    # host-assisted per-column path
+    fixed = [(i, c) for i, c in enumerate(batch.columns)
+             if c.child is None and c.host_data is None and c.offsets is None]
+    out_cols: list = [None] * len(batch.columns)
+    if fixed:
+        datas = [c.data for _, c in fixed]
+        valids = [c.validity for _, c in fixed]
+        g_datas, g_valids = _gather_fixed_cols(
+            datas, valids, idx, jnp.int32(batch.num_rows),
+            jnp.int32(out_rows))
+        for (i, c), d, v in zip(fixed, g_datas, g_valids):
+            out_cols[i] = TpuColumnVector(c.dtype, d, v, out_rows)
+    if len(fixed) != len(batch.columns):
+        valid_idx = (idx >= 0) & (idx < batch.num_rows)
+        safe = jnp.where(valid_idx, idx, 0)
+        pad_mask = row_mask(out_rows, cap)
+        for i, col in enumerate(batch.columns):
+            if out_cols[i] is None:
+                out_cols[i] = _gather_column(col, safe,
+                                             valid_idx & pad_mask,
+                                             out_rows, cap)
     return TpuColumnarBatch(out_cols, out_rows, batch.names)
+
+
+@_jax_jit
+def _gather_fixed_cols(datas, valids, idx, in_rows, out_rows):
+    cap = idx.shape[0]
+    valid_idx = (idx >= 0) & (idx < in_rows)
+    safe = jnp.where(valid_idx, idx, 0)
+    mask = valid_idx & (jnp.arange(cap) < out_rows)
+    out_d, out_v = [], []
+    for d, v in zip(datas, valids):
+        g = jnp.take(d, safe, axis=0)
+        vv = mask if v is None else (jnp.take(v, safe, axis=0) & mask)
+        vb = vv[:, None] if g.ndim == 2 else vv  # decimal128 limb pairs
+        out_d.append(jnp.where(vb, g, jnp.zeros((), g.dtype)))
+        out_v.append(vv)
+    return out_d, out_v
 
 
 def _gather_column(col: TpuColumnVector, safe_idx, valid, out_rows: int,
@@ -239,33 +276,30 @@ def _gather_column(col: TpuColumnVector, safe_idx, valid, out_rows: int,
     return TpuColumnVector(col.dtype, data, v, out_rows)
 
 
+@_jax_jit
+def _gather_string_plan(offsets, safe_idx, valid):
+    starts = jnp.take(offsets[:-1], safe_idx)
+    ends = jnp.take(offsets[1:], safe_idx)
+    lens = jnp.where(valid, ends - starts, 0)
+    return starts, lens, jnp.sum(lens)
+
+
 def _gather_strings(col: TpuColumnVector, safe_idx, valid, out_rows: int,
                     cap: int) -> TpuColumnVector:
-    """String gather: host-assisted for now. Device offsets/lengths are computed in
-    XLA; byte movement runs on host until the Pallas ragged-gather kernel lands
-    (tracked kernels/strings.py). The reference does this fully in cuDF."""
-    starts = jnp.take(col.offsets[:-1], safe_idx)
-    ends = jnp.take(col.offsets[1:], safe_idx)
-    lens = jnp.where(valid, ends - starts, 0)
-    new_offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                   jnp.cumsum(lens).astype(jnp.int32)])
-    # host byte shuffle
-    h_starts = np.asarray(starts)
-    h_lens = np.asarray(lens)
-    h_chars = np.asarray(col.data)
-    total = int(np.asarray(new_offsets)[-1])
-    out = np.zeros(bucket_capacity(max(total, 1)), dtype=np.uint8)
-    pos = 0
-    for i in range(out_rows):
-        l = int(h_lens[i])
-        if l:
-            s = int(h_starts[i])
-            out[pos:pos + l] = h_chars[s:s + l]
-            pos += l
+    """String gather ON DEVICE via the shared ragged-gather plan
+    (kernels/strings.py build_ranges): offsets math + byte movement are two
+    compiled programs and ONE scalar D→H sync (the output byte capacity).
+    The previous host byte-shuffle fetched the whole column per call — the
+    q3 profile showed 188 s of the 361 s steady-state run inside it."""
+    from ..kernels.strings import build_ranges
+    starts, lens, total_dev = _gather_string_plan(col.offsets, safe_idx,
+                                                  valid)
+    out_cap = bucket_capacity(max(int(total_dev), 1))  # scalar sync
+    data, new_offsets = build_ranges(col.data, starts, lens, out_cap)
     v = valid
     if col.validity is not None:
         v = jnp.take(col.validity, safe_idx) & valid
-    return TpuColumnVector(col.dtype, jnp.asarray(out), v, out_rows,
+    return TpuColumnVector(col.dtype, data, v, out_rows,
                            offsets=new_offsets)
 
 
@@ -316,25 +350,54 @@ def concat_batches(batches: List[TpuColumnarBatch]) -> TpuColumnarBatch:
         return batches[0]
     total = sum(b.num_rows for b in batches)
     names = batches[0].names
-    out_cols: List[TpuColumnVector] = []
+    out_cols: List[Optional[TpuColumnVector]] = [None] * batches[0].num_columns
+    cap = bucket_capacity(total)
+    offs = []
+    pos = 0
+    for b in batches:
+        offs.append(pos)
+        pos += b.num_rows
+    fixed_ix = [ci for ci in range(batches[0].num_columns)
+                if batches[0].columns[ci].offsets is None
+                and batches[0].columns[ci].host_data is None
+                and batches[0].columns[ci].child is None]
+    if fixed_ix:
+        # all fixed-width columns of all batches concatenate in ONE compiled
+        # scatter program; row offsets are traced so varying row counts hit
+        # the same executable (each eager op costs a ~100ms dispatch on the
+        # tunneled TPU)
+        col_datas = [[b.columns[ci].data for b in batches] for ci in fixed_ix]
+        col_valids = [[b.columns[ci].validity for b in batches]
+                      for ci in fixed_ix]
+        ns = [jnp.int32(b.num_rows) for b in batches]
+        offs_t = [jnp.int32(o) for o in offs]
+        outs, outs_v = _concat_fixed_cols(col_datas, col_valids, ns, offs_t,
+                                          jnp.int32(total), out_cap=cap)
+        for ci, d, v in zip(fixed_ix, outs, outs_v):
+            out_cols[ci] = TpuColumnVector(batches[0].columns[ci].dtype,
+                                           d, v, total)
     for ci in range(batches[0].num_columns):
-        cols = [b.columns[ci] for b in batches]
-        if cols[0].offsets is not None or cols[0].host_data is not None:
+        if out_cols[ci] is None:
             import pyarrow as pa
+            cols = [b.columns[ci] for b in batches]
             merged = pa.concat_arrays([c.to_arrow() for c in cols])
-            out_cols.append(TpuColumnVector.from_arrow(merged))
-        else:
-            cap = bucket_capacity(total)
-            data = jnp.zeros((cap,) + cols[0].data.shape[1:],
-                             cols[0].data.dtype)
-            validity = jnp.zeros((cap,), jnp.bool_)
-            pos = 0
-            for c in cols:
-                n = c.num_rows
-                data = data.at[pos:pos + n].set(c.data[:n])
-                validity = validity.at[pos:pos + n].set(
-                    c.validity[:n] if c.validity is not None else jnp.ones((n,), jnp.bool_))
-                pos += n
-            validity = validity & row_mask(total, cap)
-            out_cols.append(TpuColumnVector(cols[0].dtype, data, validity, total))
+            out_cols[ci] = TpuColumnVector.from_arrow(merged)
     return TpuColumnarBatch(out_cols, total, names)
+
+
+@_functools.partial(_jax_jit, static_argnames=("out_cap",))
+def _concat_fixed_cols(col_datas, col_valids, ns, offs, total, out_cap: int):
+    outs, outs_v = [], []
+    mask_final = jnp.arange(out_cap) < total
+    for datas, valids in zip(col_datas, col_valids):
+        out = jnp.zeros((out_cap,) + datas[0].shape[1:], datas[0].dtype)
+        ov = jnp.zeros((out_cap,), jnp.bool_)
+        for d, v, n, off in zip(datas, valids, ns, offs):
+            ar = jnp.arange(d.shape[0])
+            idx = jnp.where(ar < n, ar + off, out_cap)  # OOB rows drop
+            out = out.at[idx].set(d, mode="drop")
+            vv = jnp.ones((d.shape[0],), jnp.bool_) if v is None else v
+            ov = ov.at[idx].set(vv, mode="drop")
+        outs.append(out)
+        outs_v.append(ov & mask_final)
+    return outs, outs_v

@@ -238,6 +238,12 @@ HOST_SPILL_STORAGE_SIZE = _conf("spark.rapids.memory.host.spillStorageSize").doc
     "(reference HOST_SPILL_STORAGE_SIZE, RapidsConf.scala:508)."
 ).startup_only().bytes(1 << 30)
 
+LEAK_TRACKING_DEBUG = _conf("spark.rapids.memory.debug.leakTracking").doc(
+    "Capture creation stacks for every registered device resource and "
+    "raise on double-close (reference MemoryCleaner leak tracking, "
+    "Plugin.scala:581-596). Always-on cheap tracking reports leak counts "
+    "at shutdown even when this is off.").boolean(False)
+
 OOM_RETRY_MAX = _conf("spark.rapids.memory.tpu.oomMaxRetries").doc(
     "Retries of an allocation after synchronizing + spilling before declaring OOM."
 ).integer(3)
@@ -399,6 +405,16 @@ UDF_COMPILER_ENABLED = _conf("spark.rapids.sql.udfCompiler.enabled").doc(
     "Translate row python UDF bytecode into columnar device expressions "
     "where possible (reference udf-compiler/ LogicalPlanRules); "
     "untranslatable UDFs keep the row fallback.").boolean(False)
+PYTHON_UDF_WORKERS = _conf("spark.rapids.sql.python.numWorkers").doc(
+    "Number of separate python worker processes for pandas/arrow UDF "
+    "execution (Arrow-IPC exchange; reference GpuArrowEvalPythonExec + "
+    "python/rapids/worker.py). 0 runs UDFs in-process. UDFs that cannot "
+    "pickle always run in-process.").integer(0)
+CONCURRENT_PYTHON_WORKERS = _conf(
+    "spark.rapids.python.concurrentPythonWorkers").doc(
+    "Admission semaphore: how many python UDF workers may run "
+    "concurrently (reference PythonWorkerSemaphore.scala:98). 0 means "
+    "as many as numWorkers.").integer(0)
 
 # ---------------------------------------------------------------------------
 # Operator toggles (reference: spark.rapids.sql.exec.* generated per rule)

@@ -210,8 +210,10 @@ class TpuCoalesceBatchesExec(TpuExec):
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         target = self.target_rows or ctx.conf.batch_size_rows
+        target_bytes = ctx.conf.batch_size_bytes
         pending: List[TpuColumnarBatch] = []
         rows = 0
+        size = 0
         concat_time = self.metrics["concatTime"]
         n_in = self.metrics["numInputBatches"]
         from ..memory.spill import SpillableColumnarBatch
@@ -228,10 +230,15 @@ class TpuCoalesceBatchesExec(TpuExec):
             n_in.add(1)
             pending.append(SpillableColumnarBatch(b))
             rows += b.num_rows
-            if self.goal != "require_single" and rows >= target:
+            size += pending[-1].size_bytes
+            # whichever target trips first closes the batch (reference
+            # GpuCoalesceIterator honors both GPU_BATCH_SIZE_BYTES and the
+            # row cap)
+            if self.goal != "require_single" and (
+                    rows >= target or (target_bytes and size >= target_bytes)):
                 with concat_time.timed():
                     yield concat_spillables(pending)
-                pending, rows = [], 0
+                pending, rows, size = [], 0, 0
         if pending:
             with concat_time.timed():
                 yield concat_spillables(pending)

@@ -28,7 +28,8 @@ from ..expressions.base import AttributeReference, Expression, to_column
 from ..plan.logical import SortOrder
 from ..types import DoubleT, IntegerT, LongT
 from ..window import (CURRENT_ROW, UNBOUNDED_FOLLOWING, UNBOUNDED_PRECEDING,
-                      DenseRank, Lag, Lead, Rank, RowNumber, WindowExpression)
+                      CumeDist, DenseRank, Lag, Lead, NTile, PercentRank,
+                      Rank, RowNumber, WindowExpression)
 from .aggregates import _sortable_bits
 from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
                    bind_references)
@@ -136,6 +137,11 @@ class TpuWindowExec(TpuExec):
         seg_end = jnp.minimum(seg_end, n)
 
         fn = we.function
+        if isinstance(fn, AggregateFunction) and fn.update_op in (
+                "collect_list", "collect_set"):
+            return self._collect_over_window(we, fn, spec, batch, ctx, perm,
+                                             idxs, seg_start, seg_end, cap, n,
+                                             is_new_order)
         result, validity = self._compute_fn(fn, spec, batch, ctx, perm, idxs,
                                             is_new_part, is_new_order,
                                             seg_start, seg_end, cap, n)
@@ -149,6 +155,89 @@ class TpuWindowExec(TpuExec):
             valid = row_mask(n, cap)
         return TpuColumnVector(fn.dtype, data, valid, n)
 
+    def _collect_over_window(self, we, fn, spec, batch, ctx, perm, idxs,
+                             seg_start, seg_end, cap, n,
+                             is_new_order=None) -> TpuColumnVector:
+        """collect_list over a window as one ragged gather (device);
+        collect_set and exotic frames take the host oracle path (the
+        reference prices set-dedup over windows as a specialized kernel;
+        here it is priced as host-assisted)."""
+        from ..kernels.strings import gather_plan
+        from ..columnar.vector import bucket_capacity
+
+        frame = spec.frame
+        if frame is None:
+            frame = ((UNBOUNDED_PRECEDING, CURRENT_ROW) if spec.order_by
+                     else (UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING))
+        lo_off, hi_off = frame
+        device_ok = (fn.update_op == "collect_list"
+                     and lo_off == UNBOUNDED_PRECEDING
+                     and hi_off in (CURRENT_ROW, UNBOUNDED_FOLLOWING))
+        if not device_ok:
+            return self._host_window_column(we, batch, ctx)
+
+        col = to_column(fn.children[0].eval_tpu(batch, ctx.eval_ctx),
+                        batch, fn.children[0].dtype)
+        if col.offsets is not None or col.child is not None:
+            return self._host_window_column(we, batch, ctx)  # nested elems
+        sdata = jnp.take(col.data, perm)
+        svalid = (jnp.take(col.validity, perm) if col.validity is not None
+                  else jnp.ones((cap,), jnp.bool_))
+        svalid = svalid & jnp.take(row_mask(n, cap), perm)
+
+        # collect_list drops nulls: count/compact valid elements per frame
+        vpref = jnp.cumsum(svalid.astype(jnp.int32))  # 1-based inclusive
+        comp = jnp.zeros((cap,), jnp.int32).at[
+            jnp.where(svalid, vpref - 1, cap)].set(
+            idxs.astype(jnp.int32), mode="drop")
+        lo = seg_start
+        if hi_off == CURRENT_ROW:
+            # default frame is RANGE: current row's PEER GROUP end, not the
+            # row position (ties must see identical lists, like Spark)
+            if spec.order_by and is_new_order is not None \
+                    and (spec.frame is None or spec.frame_type == "range"):
+                next_ostart = jnp.where(is_new_order, idxs, jnp.int64(cap))
+                ord_end = jax.lax.cummin(next_ostart[::-1])[::-1]
+                ord_end = jnp.concatenate(
+                    [ord_end[1:], jnp.full((1,), cap, jnp.int64)])
+                hi = jnp.minimum(ord_end, seg_end) - 1
+            else:
+                hi = idxs
+        else:
+            hi = seg_end - 1
+        vstart = jnp.where(lo > 0,
+                           jnp.take(vpref, jnp.clip(lo - 1, 0, cap - 1)), 0)
+        vend = jnp.take(vpref, jnp.clip(hi, 0, cap - 1))
+        lens_sorted = jnp.maximum(vend - vstart, 0)
+
+        inv = jnp.zeros((cap,), jnp.int32).at[perm].set(
+            jnp.arange(cap, dtype=jnp.int32))
+        lens = jnp.take(lens_sorted, inv) * row_mask(n, cap)
+        starts = jnp.take(vstart, inv)
+        total = int(jnp.sum(lens[:n])) if n else 0  # host sync: output size
+        out_cap = bucket_capacity(max(total, 1))
+        src, in_range, new_offs = gather_plan(starts.astype(jnp.int32),
+                                              lens.astype(jnp.int32), out_cap)
+        elem_pos = comp[jnp.clip(src, 0, cap - 1)]
+        data = jnp.where(in_range, sdata[elem_pos],
+                         jnp.zeros((), sdata.dtype))
+        child = TpuColumnVector(fn.children[0].dtype, data, None, total)
+        return TpuColumnVector(fn.dtype, data, row_mask(n, cap), n,
+                               offsets=new_offs, child=child)
+
+    def _host_window_column(self, we, batch, ctx) -> TpuColumnVector:
+        """Host-assisted path: run the oracle algorithm over the batch's
+        arrow view and re-upload (priced like other host_assisted exprs)."""
+        from ..columnar.batch import _repad
+        table = batch.to_arrow()
+        attr = type("A", (), {"dtype": we.dtype})
+        arr = _cpu_eval_window(we, table, ctx, attr)
+        col = TpuColumnVector.from_arrow(arr)
+        # result must sit at the batch's capacity (filters can leave
+        # num_rows far below it); from_arrow buckets by row count only
+        return col if col.capacity == batch.capacity \
+            else _repad(col, batch.capacity)
+
     def _compute_fn(self, fn, spec, batch, ctx, perm, idxs, is_new_part,
                     is_new_order, seg_start, seg_end, cap, n):
         if isinstance(fn, RowNumber):
@@ -160,6 +249,42 @@ class TpuWindowExec(TpuExec):
             c = jnp.cumsum(is_new_order.astype(jnp.int64))
             base = jnp.take(c, seg_start)
             return (c - base + 1).astype(jnp.int32), None
+        if isinstance(fn, NTile):
+            from ..expressions.base import ExpressionError, Literal
+            nt = fn.children[0]
+            if not isinstance(nt, Literal) or int(nt.value or 0) <= 0:
+                raise ExpressionError(
+                    "ntile requires a positive integer literal")
+            tiles = jnp.int64(int(nt.value))
+            size = seg_end - seg_start
+            k = idxs - seg_start
+            base = size // tiles
+            rem = size % tiles
+            cut = rem * (base + 1)
+            tile = jnp.where(
+                k < cut, k // jnp.maximum(base + 1, 1),
+                rem + (k - cut) // jnp.maximum(base, 1))
+            return (tile + 1).astype(jnp.int32), None
+        if isinstance(fn, PercentRank):
+            last_bnd = jax.lax.cummax(
+                jnp.where(is_new_order, idxs, jnp.int64(0)))
+            rank = last_bnd - seg_start + 1
+            size = seg_end - seg_start
+            pr = jnp.where(size > 1,
+                           (rank - 1).astype(jnp.float64)
+                           / jnp.maximum(size - 1, 1).astype(jnp.float64),
+                           0.0)
+            return pr, None
+        if isinstance(fn, CumeDist):
+            # end (exclusive) of the current peer group: next order boundary
+            next_ostart = jnp.where(is_new_order, idxs, jnp.int64(cap))
+            ord_end = jax.lax.cummin(next_ostart[::-1])[::-1]
+            ord_end = jnp.concatenate(
+                [ord_end[1:], jnp.full((1,), cap, jnp.int64)])
+            ord_end = jnp.minimum(ord_end, seg_end)
+            size = jnp.maximum(seg_end - seg_start, 1)
+            return ((ord_end - seg_start).astype(jnp.float64)
+                    / size.astype(jnp.float64)), None
         if isinstance(fn, (Lead, Lag)):
             col = to_column(fn.children[0].eval_tpu(batch, ctx.eval_ctx),
                             batch, fn.children[0].dtype)
@@ -181,11 +306,12 @@ class TpuWindowExec(TpuExec):
             return data, valid
         if isinstance(fn, AggregateFunction):
             return self._agg_over_frame(fn, spec, batch, ctx, perm, idxs,
-                                        seg_start, seg_end, cap, n)
+                                        seg_start, seg_end, cap, n,
+                                        is_new_order)
         raise NotImplementedError(f"window fn {type(fn).__name__}")
 
     def _agg_over_frame(self, fn, spec, batch, ctx, perm, idxs, seg_start,
-                        seg_end, cap, n):
+                        seg_end, cap, n, is_new_order=None):
         op = fn.update_op
         col = None
         if fn.children:
@@ -200,12 +326,25 @@ class TpuWindowExec(TpuExec):
         svalid = svalid & jnp.take(row_mask(n, cap), perm)
 
         frame = spec.frame
+        range_mode = spec.frame_type == "range" or frame is None
         if frame is None:
-            # Spark default: with ORDER BY → unbounded-preceding..current row;
-            # without → whole partition
+            # Spark default: with ORDER BY → RANGE unbounded-preceding..
+            # current row (peers included); without → whole partition
             frame = ((UNBOUNDED_PRECEDING, CURRENT_ROW) if spec.order_by
                      else (UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING))
         lo_off, hi_off = frame
+        # RANGE CURRENT ROW means the row's whole PEER GROUP (tied order
+        # keys), not the row itself — ROWS-style bounds on a tied window
+        # silently diverge from Spark (r3 review finding)
+        peer_start = peer_end = None
+        if range_mode and is_new_order is not None and spec.order_by:
+            peer_start = jax.lax.cummax(
+                jnp.where(is_new_order, idxs, jnp.int64(0)))
+            next_ostart = jnp.where(is_new_order, idxs, jnp.int64(cap))
+            peer_end = jax.lax.cummin(next_ostart[::-1])[::-1]
+            peer_end = jnp.concatenate(
+                [peer_end[1:], jnp.full((1,), cap, jnp.int64)])
+            peer_end = jnp.minimum(peer_end, seg_end)
 
         acc_dtype = jnp.float64 if op in ("avg",) else (
             jnp.int64 if not jnp.issubdtype(sdata.dtype, jnp.floating)
@@ -233,10 +372,18 @@ class TpuWindowExec(TpuExec):
                              jnp.zeros((), prefix.dtype))
             return jnp.where(hi >= lo, hi_v - lo_v, jnp.zeros((), prefix.dtype))
 
-        lo = seg_start if lo_off == UNBOUNDED_PRECEDING else \
-            jnp.maximum(idxs + lo_off, seg_start)
-        hi = (seg_end - 1) if hi_off == UNBOUNDED_FOLLOWING else \
-            jnp.minimum(idxs + hi_off, seg_end - 1)
+        if lo_off == UNBOUNDED_PRECEDING:
+            lo = seg_start
+        elif peer_start is not None and lo_off == CURRENT_ROW:
+            lo = peer_start
+        else:
+            lo = jnp.maximum(idxs + lo_off, seg_start)
+        if hi_off == UNBOUNDED_FOLLOWING:
+            hi = seg_end - 1
+        elif peer_end is not None and hi_off == CURRENT_ROW:
+            hi = peer_end - 1
+        else:
+            hi = jnp.minimum(idxs + hi_off, seg_end - 1)
 
         if op in ("sum", "count", "avg"):
             s = range_sum(psum, lo, hi)
@@ -259,7 +406,8 @@ class TpuWindowExec(TpuExec):
             avg = s / jnp.where(c > 0, c, 1).astype(jnp.float64)
             return jnp.where(valid, avg, 0.0), valid
         if op in ("min", "max"):
-            if lo_off == UNBOUNDED_PRECEDING and hi_off == CURRENT_ROW:
+            if lo_off == UNBOUNDED_PRECEDING and hi_off == CURRENT_ROW \
+                    and peer_end is None:  # rows mode only: peers need [lo,hi]
                 return self._running_minmax(op, x, svalid, is_new_seg=None,
                                             seg_start=seg_start, idxs=idxs,
                                             sdata=sdata, cap=cap)
@@ -424,6 +572,10 @@ class CpuWindowExec(CpuExec):
         yield pa.table(out).rename_columns([a.name for a in self._output])
 
     def _eval_window(self, we: WindowExpression, t, ctx, attr):
+        return _cpu_eval_window(we, t, ctx, attr)
+
+
+def _cpu_eval_window(we: WindowExpression, t, ctx, attr):
         import math
         import pyarrow as pa
         n = t.num_rows
@@ -459,12 +611,12 @@ class CpuWindowExec(CpuExec):
             while j < len(order) and [vals[order[j]] for vals in part_vals] == pk:
                 j += 1
             rows = order[i:j]
-            self._eval_partition(fn, spec, rows, t, ctx, order_vals, results)
+            _cpu_eval_partition(fn, spec, rows, t, ctx, order_vals, results)
             i = j
         from ..types import to_arrow
         return pa.array(results, type=to_arrow(attr.dtype))
 
-    def _eval_partition(self, fn, spec, rows, t, ctx, order_vals, results):
+def _cpu_eval_partition(fn, spec, rows, t, ctx, order_vals, results):
         n = len(rows)
         if isinstance(fn, RowNumber):
             for k, r in enumerate(rows):
@@ -481,6 +633,38 @@ class CpuWindowExec(CpuExec):
                     prev = cur
                 results[r] = rank if isinstance(fn, Rank) else drank
             return
+        if isinstance(fn, NTile):
+            from ..expressions.base import Literal
+            tiles = int(fn.children[0].value) if isinstance(
+                fn.children[0], Literal) else 1
+            base, rem = n // tiles, n % tiles
+            for k, r in enumerate(rows):
+                if k < rem * (base + 1):
+                    results[r] = k // (base + 1) + 1
+                else:
+                    results[r] = rem + (k - rem * (base + 1)) // max(base, 1) + 1
+            return
+        if isinstance(fn, PercentRank):
+            rank = 0
+            prev = object()
+            for k, r in enumerate(rows):
+                cur = tuple(v[r] for v in order_vals)
+                if cur != prev:
+                    rank = k + 1
+                    prev = cur
+                results[r] = (rank - 1) / (n - 1) if n > 1 else 0.0
+            return
+        if isinstance(fn, CumeDist):
+            k = 0
+            while k < n:
+                j = k
+                cur = tuple(v[rows[k]] for v in order_vals)
+                while j < n and tuple(v[rows[j]] for v in order_vals) == cur:
+                    j += 1
+                for m in range(k, j):
+                    results[rows[m]] = j / n
+                k = j
+            return
         if isinstance(fn, (Lead, Lag)):
             vals = fn.children[0].eval_cpu(t, ctx.eval_ctx).to_pylist()
             off = fn.offset if isinstance(fn, Lead) else -fn.offset
@@ -496,18 +680,51 @@ class CpuWindowExec(CpuExec):
             vals = (fn.children[0].eval_cpu(t, ctx.eval_ctx).to_pylist()
                     if fn.children else [1] * t.num_rows)
             frame = spec.frame
+            range_mode = (spec.frame is None
+                          or getattr(spec, "frame_type", "rows") == "range")
             if frame is None:
                 frame = ((UNBOUNDED_PRECEDING, CURRENT_ROW) if spec.order_by
                          else (UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING))
             lo_off, hi_off = frame
+            peer_lo = peer_hi = None
+            if range_mode and order_vals and spec.order_by:
+                # RANGE CURRENT ROW = the whole peer group of tied keys
+                keys = [tuple(v[r] for v in order_vals) for r in rows]
+                peer_lo, peer_hi = [0] * n, [0] * n
+                start = 0
+                for k in range(1, n + 1):
+                    if k == n or keys[k] != keys[start]:
+                        for m in range(start, k):
+                            peer_lo[m], peer_hi[m] = start, k - 1
+                        start = k
             for k, r in enumerate(rows):
-                lo = 0 if lo_off == UNBOUNDED_PRECEDING else max(0, k + lo_off)
-                hi = n - 1 if hi_off == UNBOUNDED_FOLLOWING else min(n - 1, k + hi_off)
+                if lo_off == UNBOUNDED_PRECEDING:
+                    lo = 0
+                elif peer_lo is not None and lo_off == CURRENT_ROW:
+                    lo = peer_lo[k]
+                else:
+                    lo = max(0, k + lo_off)
+                if hi_off == UNBOUNDED_FOLLOWING:
+                    hi = n - 1
+                elif peer_hi is not None and hi_off == CURRENT_ROW:
+                    hi = peer_hi[k]
+                else:
+                    hi = min(n - 1, k + hi_off)
                 window = [vals[rows[m]] for m in range(lo, hi + 1)] if hi >= lo else []
                 nn = [v for v in window if v is not None]
                 op = fn.update_op
                 if op == "count":
                     results[r] = len(nn)
+                elif op == "collect_list":
+                    results[r] = nn  # empty frame -> [], never null
+                elif op == "collect_set":
+                    seen, out = set(), []
+                    for v in nn:
+                        key = "nan" if v != v else v  # one NaN survives
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(v)
+                    results[r] = out
                 elif not nn:
                     results[r] = None
                 elif op == "sum":

@@ -83,6 +83,22 @@ class Cast(UnaryExpression):
             ln, lm = _np_of(c)
             return pa.array(_float_to_int_np(ln, at.to_pandas_dtype(), ansi, ~lm),
                             mask=lm)
+        if isinstance(src, TimestampType) and isinstance(dst, IntegralType):
+            # Spark timestampToLong = floorDiv(micros, 1e6), not raw micros;
+            # narrower targets wrap like java narrowing (ANSI raises)
+            micros, lm = _np_of(pc.cast(c, pa.int64()))
+            secs = np.floor_divide(micros, 1_000_000)
+            np_t = np.dtype(dst.np_dtype)
+            if np_t.itemsize < 8:
+                lo, hi = _INT_BOUNDS[np_t]
+                if ansi and bool((((secs < lo) | (secs > hi)) & ~lm).any()):
+                    raise ExpressionError("cast overflow")
+                secs = secs.astype(np_t)  # two's-complement wrap
+            return pa.array(secs, mask=lm).cast(at, safe=False)
+        if isinstance(src, IntegralType) and isinstance(dst, TimestampType):
+            secs, lm = _np_of(c)
+            return pa.array(secs.astype(np.int64) * 1_000_000,
+                            mask=lm).cast(at)
         try:
             return pc.cast(c, at, safe=ansi)
         except pa.ArrowInvalid as e:
@@ -165,9 +181,9 @@ def _device_numeric_cast(d, src: DataType, dst: DataType, ansi: bool, valid):
 
 
 def _trunc_div_seconds(d):
-    q = d // 1_000_000
-    r = d - q * 1_000_000
-    return q + ((r != 0) & (d < 0)).astype(jnp.int64)  # floor → Spark uses floor for ts→long
+    # Spark timestampToLong = Math.floorDiv(micros, 1e6): -0.5s -> -1
+    # (jnp integer // is floor division already)
+    return d // 1_000_000
 
 
 def _cast_via_host(col: TpuColumnVector, src, dst, batch, ansi):
@@ -208,25 +224,51 @@ def _format_to_string_arrow(arr, src: DataType):
 
 
 def _spark_float_str(v: float, is_float32: bool) -> str:
+    """Java Double.toString / Float.toString semantics exactly: shortest
+    round-trip digits; plain decimal form when 1e-3 <= |v| < 1e7, otherwise
+    scientific `d.dddEexp` with one digit before the point (reference
+    GpuCast castToString float path / castFloatingTypesToString; the 'Ryu
+    quirks' of VERDICT r2 — python repr switches notation at different
+    thresholds, so the digits are re-laid-out here)."""
     if np.isnan(v):
         return "NaN"
     if np.isinf(v):
         return "Infinity" if v > 0 else "-Infinity"
-    if is_float32:
-        s = repr(np.float32(v))
-    else:
-        s = repr(float(v))
-    # Java prints whole floats as '1.0'; python repr matches for floats
+    f = float(np.float32(v)) if is_float32 else float(v)
+    if f == 0.0:
+        return "-0.0" if np.signbit(f) else "0.0"
+    # shortest round-trip digits (str() is shortest for the type; known
+    # divergence: ties between equally-short reprs can pick a different
+    # digit than Java's Ryu, e.g. Double.MIN_VALUE 5e-324 vs Java 4.9E-324)
+    s = str(np.float32(v)) if is_float32 else repr(f)
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
     if "e" in s or "E" in s:
-        # Java uses E notation with explicit sign handling; normalize
-        mant, _, exp = s.partition("e")
-        exp_i = int(exp)
-        if "." not in mant:
-            mant += ".0"
-        s = f"{mant}E{exp_i}"
-    elif "." not in s and "inf" not in s and "nan" not in s:
-        s += ".0"
-    return s
+        mant, _, exp = s.replace("E", "e").partition("e")
+        exp10 = int(exp)
+    else:
+        mant, exp10 = s, 0
+    # normalize mantissa to pure digit string + exponent of leading digit
+    if "." in mant:
+        int_part, frac = mant.split(".")
+    else:
+        int_part, frac = mant, ""
+    digits = (int_part + frac).lstrip("0")
+    lead_exp = exp10 + len(int_part.lstrip("0")) - 1 if int_part.strip("0") \
+        else exp10 - (len(frac) - len(frac.lstrip("0"))) - 1
+    digits = digits.rstrip("0") or "0"
+    sign = "-" if neg else ""
+    if -3 <= lead_exp < 7:
+        if lead_exp >= 0:
+            ip = digits[:lead_exp + 1].ljust(lead_exp + 1, "0")
+            fp = digits[lead_exp + 1:] or "0"
+        else:
+            ip = "0"
+            fp = "0" * (-lead_exp - 1) + digits
+        return f"{sign}{ip}.{fp}"
+    fp = digits[1:] or "0"
+    return f"{sign}{digits[0]}.{fp}E{lead_exp}"
 
 
 def _parse_string_arrow(arr, dst: DataType, ansi: bool):
@@ -280,20 +322,151 @@ def _parse_string_arrow(arr, dst: DataType, ansi: bool):
                 elif sl in ("-inf", "-infinity"):
                     out.append(float("-inf"))
                 else:
+                    # Java Double.parseDouble accepts a trailing d/D/f/F
+                    # type suffix ("1d" == 1.0); Spark inherits it
+                    if sl and sl[-1] in "df" and len(sl) > 1 \
+                            and (sl[-2].isdigit() or sl[-2] == "."):
+                        s = s[:-1]
                     out.append(float(s))
             except ValueError:
                 if ansi:
                     raise ExpressionError(f"invalid input for cast to {dst}: {s!r}")
                 out.append(None)
         return pa.array(out, type=at)
-    if isinstance(dst, (DateType, TimestampType)):
-        try:
-            return pc.cast(trimmed, at, safe=ansi)
-        except pa.ArrowInvalid as e:
-            if ansi:
-                raise ExpressionError(str(e)) from e
-            return pc.cast(trimmed, at, safe=False)
+    if isinstance(dst, DateType):
+        vals = trimmed.to_pylist() if isinstance(trimmed, pa.Array) \
+            else trimmed.combine_chunks().to_pylist()
+        out = []
+        for s in vals:
+            d = None if s is None else _parse_spark_date(s)
+            if s is not None and d is None and ansi:
+                raise ExpressionError(f"invalid input for cast to date: {s!r}")
+            out.append(d)
+        return pa.array(out, type=pa.date32())
+    if isinstance(dst, TimestampType):
+        vals = trimmed.to_pylist() if isinstance(trimmed, pa.Array) \
+            else trimmed.combine_chunks().to_pylist()
+        out = []
+        for s in vals:
+            us = None if s is None else _parse_spark_timestamp(s)
+            if s is not None and us is None and ansi:
+                raise ExpressionError(
+                    f"invalid input for cast to timestamp: {s!r}")
+            out.append(us)
+        return pa.array(out, type=pa.timestamp("us")).cast(at)
+    if isinstance(dst, DecimalType):
+        vals = trimmed.to_pylist() if isinstance(trimmed, pa.Array) \
+            else trimmed.combine_chunks().to_pylist()
+        out = []
+        for s in vals:
+            d = None if s is None else _parse_spark_decimal(
+                s, dst.precision, dst.scale)
+            if s is not None and d is None and ansi:
+                raise ExpressionError(
+                    f"invalid input for cast to {dst.simple_string()}: {s!r}")
+            out.append(d)
+        return pa.array(out, type=pa.decimal128(dst.precision, dst.scale))
     raise NotImplementedError(f"string cast to {dst}")
+
+
+_DATE_RE = None
+_TIME_RE = None
+
+
+def _parse_spark_date(s: str):
+    """Spark stringToDate: `[+-]y{1,7}[-m[-d]]`, anything after the day
+    allowed when separated by ' ' or 'T' (reference GpuCast castStringToDate;
+    org.apache.spark.sql.catalyst.util.DateTimeUtils.stringToDate).
+    Returns datetime.date or None."""
+    import datetime
+    import re as _re2
+    global _DATE_RE
+    if _DATE_RE is None:
+        _DATE_RE = _re2.compile(
+            r"^([+-]?\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2})(?:[ T].*)?)?)?$")
+    m = _DATE_RE.match(s.strip())
+    if not m:
+        return None
+    y = int(m.group(1))
+    mo = int(m.group(2)) if m.group(2) else 1
+    d = int(m.group(3)) if m.group(3) else 1
+    try:
+        return datetime.date(y, mo, d)  # proleptic Gregorian, 1..9999
+    except ValueError:
+        return None
+
+
+def _parse_spark_timestamp(s: str):
+    """Spark stringToTimestamp (UTC session zone): date part as in
+    stringToDate, optional `[h]h[:[m]m[:[s]s[.f{1,9}]]]` after ' ' or 'T',
+    optional zone `Z` / `UTC` / `GMT` / `[+-]h[h][:mm]`. Returns epoch
+    microseconds (int) or None. 'epoch' special literal supported."""
+    import datetime
+    import re as _re2
+    s = s.strip()
+    if s.lower() == "epoch":
+        return 0
+    global _TIME_RE
+    if _TIME_RE is None:
+        _TIME_RE = _re2.compile(
+            r"^([+-]?\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2})"
+            r"(?:[ T](\d{1,2})(?::(\d{1,2})(?::(\d{1,2})"
+            r"(?:\.(\d{1,9}))?)?)?\s*(.*))?)?)?$")
+    m = _TIME_RE.match(s)
+    if not m:
+        return None
+    y = int(m.group(1))
+    mo = int(m.group(2)) if m.group(2) else 1
+    d = int(m.group(3)) if m.group(3) else 1
+    hh = int(m.group(4)) if m.group(4) else 0
+    mi = int(m.group(5)) if m.group(5) else 0
+    ss = int(m.group(6)) if m.group(6) else 0
+    frac = m.group(7) or ""
+    us = int(frac[:6].ljust(6, "0")) if frac else 0
+    zone = (m.group(8) or "").strip()
+    off_us = 0
+    if zone:
+        zm = _re2.match(r"^(?:Z|z|UTC|GMT)$", zone)
+        if zm:
+            off_us = 0
+        else:
+            zm = _re2.match(r"^([+-])(\d{1,2})(?::(\d{1,2}))?$", zone)
+            if not zm:
+                return None
+            sign = 1 if zm.group(1) == "+" else -1
+            off_us = sign * ((int(zm.group(2)) * 60
+                              + int(zm.group(3) or 0)) * 60 * 1_000_000)
+    if hh > 23 or mi > 59 or ss > 59:
+        return None
+    try:
+        day = datetime.date(y, mo, d)
+    except ValueError:
+        return None
+    epoch_days = (day - datetime.date(1970, 1, 1)).days
+    local = (epoch_days * 86_400_000_000
+             + (hh * 3600 + mi * 60 + ss) * 1_000_000 + us)
+    return local - off_us
+
+
+def _parse_spark_decimal(s: str, precision: int, scale: int):
+    """Spark string→decimal: parse, HALF_UP round to scale, null on
+    overflow/garbage (reference GpuCast castStringToDecimal)."""
+    import decimal
+    try:
+        d = decimal.Decimal(s.strip())
+    except decimal.InvalidOperation:
+        return None
+    if not d.is_finite():
+        return None
+    # default context precision (28) would raise on wide-but-valid
+    # decimal(38) inputs; Spark's Decimal holds 38 digits + rounding room
+    with decimal.localcontext() as dctx:
+        dctx.prec = 60
+        q = d.quantize(decimal.Decimal(1).scaleb(-scale),
+                       rounding=decimal.ROUND_HALF_UP)
+    if len(q.as_tuple().digits) - scale > precision - scale and q != 0:
+        return None  # integral part too wide
+    return q
 
 
 def _cast_scalar(v, src, dst, ansi):

@@ -130,10 +130,39 @@ class RLike(Expression):
             # equals
             from .predicates import EqualTo
             return EqualTo(c, Literal(lit)).eval_tpu(batch, ctx)
+        col = c.eval_tpu(batch, ctx)
+        out = self._device_dfa_match(col, batch)
+        if out is not None:
+            return out
         import pyarrow.compute as pc
-        arr = _to_arrow_side(c.eval_tpu(batch, ctx), batch)
+        arr = _to_arrow_side(col, batch)
         out = pc.match_substring_regex(arr, pattern=self._transpiled)
         return _bool_result_from_arrow(out, batch)
+
+    def _device_dfa_match(self, col, batch):
+        """Compiled byte-DFA table walk on device (kernels/regex_dfa.py), or
+        None when the pattern/column is outside the device subset."""
+        import jax.numpy as jnp
+
+        from ..kernels import strings as SK
+        from ..kernels.regex_dfa import (MAX_DEVICE_ROW_BYTES, compile_dfa,
+                                         rlike_device)
+        from .base import combine_validity, make_column, row_mask
+        from .strings import _dev_str
+        dfa = compile_dfa(self.pattern)
+        if dfa is None or not _dev_str(col):
+            return None
+        if not dfa.ascii_atoms and not SK.is_ascii(col.data):
+            return None  # byte/char mismatch possible: host engine decides
+        lens = col.offsets[1:] - col.offsets[:-1]
+        max_len = int(jnp.max(lens)) if int(lens.shape[0]) else 0
+        if max_len > MAX_DEVICE_ROW_BYTES:
+            return None  # pathological rows: lock-step walk too deep
+        data = rlike_device(col.data, col.offsets, batch.num_rows, dfa,
+                            max_len)
+        valid = combine_validity(batch.capacity, col.validity,
+                                 row_mask(batch.num_rows, batch.capacity))
+        return make_column(BooleanT, data, valid, batch.num_rows)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa

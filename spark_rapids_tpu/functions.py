@@ -350,6 +350,22 @@ def dense_rank() -> Column:
     return Column(DenseRank())
 
 
+def ntile(n: int) -> Column:
+    from .expressions.base import Literal
+    from .window import NTile
+    return Column(NTile(Literal(int(n))))
+
+
+def percent_rank() -> Column:
+    from .window import PercentRank
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from .window import CumeDist
+    return Column(CumeDist())
+
+
 def lead(c, offset: int = 1, default=None) -> Column:
     from .window import Lead
     d = Literal(default) if default is not None else None

@@ -1,0 +1,110 @@
+"""Shutdown leak tracking + double-close detection for device resources.
+
+Reference: cuDF's MemoryCleaner (leak logging at shutdown, re-registered
+hook Plugin.scala:581-596) and the refcount double-close/leak logging in
+GpuColumnVector / RapidsBuffer. jax.Arrays are garbage-collected, so a leak
+here never corrupts memory — but an unclosed SpillableColumnarBatch keeps
+HBM pinned in the catalog past its useful life, which is exactly the class
+of bug the reference's tracker exists to surface.
+
+Always-on cheap tracking (a dict of live tokens); with
+spark.rapids.memory.debug.leakTracking=true each registration also captures
+its creation stack so the shutdown report says WHERE the leak was made, and
+double-closes raise instead of logging.
+"""
+
+from __future__ import annotations
+
+import atexit
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+
+class DoubleCloseError(RuntimeError):
+    pass
+
+
+class _Record:
+    __slots__ = ("token", "kind", "stack", "closed")
+
+    def __init__(self, token: int, kind: str, stack: Optional[str]):
+        self.token = token
+        self.kind = kind
+        self.stack = stack
+        self.closed = False
+
+
+class MemoryCleaner:
+    """Process-wide registry of closeable device resources."""
+
+    _instance: Optional["MemoryCleaner"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._live: Dict[int, _Record] = {}
+        self._next = 0
+        self._mu = threading.Lock()
+        self.debug = False
+        self.double_closes = 0
+
+    @classmethod
+    def get(cls) -> "MemoryCleaner":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = MemoryCleaner()
+                atexit.register(cls._instance._at_shutdown)
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "MemoryCleaner":
+        with cls._lock:
+            cls._instance = MemoryCleaner()
+            return cls._instance
+
+    def set_debug(self, on: bool) -> None:
+        self.debug = on
+
+    def register(self, kind: str) -> int:
+        with self._mu:
+            token = self._next
+            self._next += 1
+            stack = "".join(traceback.format_stack(limit=12)) \
+                if self.debug else None
+            self._live[token] = _Record(token, kind, stack)
+            return token
+
+    def unregister(self, token: int) -> None:
+        """Mark closed; a second unregister of the same token is a
+        double-close (raises in debug mode, counted otherwise)."""
+        with self._mu:
+            if self._live.pop(token, None) is not None:
+                return
+            self.double_closes += 1
+            if self.debug:
+                raise DoubleCloseError(
+                    f"resource token {token} closed twice")
+
+    def live_resources(self) -> List[_Record]:
+        with self._mu:
+            return list(self._live.values())
+
+    def check_leaks(self, raise_on_leak: bool = False) -> List[str]:
+        """Report (and optionally fail on) unclosed resources — the
+        test-suite analogue of the shutdown hook."""
+        leaks = [f"{r.kind} (token {r.token})"
+                 + (f"\n{r.stack}" if r.stack else "")
+                 for r in self.live_resources()]
+        if leaks and raise_on_leak:
+            raise AssertionError(
+                f"{len(leaks)} leaked device resources:\n" + "\n".join(leaks))
+        return leaks
+
+    def _at_shutdown(self) -> None:
+        leaks = self.check_leaks(raise_on_leak=False)
+        if leaks:
+            print(f"[spark-rapids-tpu] MemoryCleaner: {len(leaks)} leaked "
+                  f"resources at shutdown:", file=sys.stderr)
+            for item in leaks[:20]:
+                print(f"  {item}", file=sys.stderr)

@@ -51,41 +51,45 @@ def with_retry(
     Yields one result per (sub-)batch."""
     pending: List[SpillableColumnarBatch] = [spillable]
     attempts = 0
-    while pending:
-        cur = pending[0]
-        try:
-            batch = cur.get_batch()
-            result = fn(batch)
-            pending.pop(0)
-            cur.close()
-            yield result
-            attempts = 0
-        except TpuSplitAndRetryOOM:
-            if stats:
-                stats.split_retries += 1
-            from ..profiling import TaskMetricsRegistry
-            TaskMetricsRegistry.get().add("splitAndRetryCount", 1)
-            if split_policy is None:
-                for s in pending:
-                    s.close()
-                raise
-            pending = split_policy(cur) + pending[1:]
-        except TpuRetryOOM:
-            if stats:
-                stats.retries += 1
-            from ..profiling import TaskMetricsRegistry
-            TaskMetricsRegistry.get().add("retryCount", 1)
-            attempts += 1
-            if attempts > max_retries:
-                for s in pending:
-                    s.close()
-                raise
-            # let pressure drain: spill everything spillable, then retry
-            import time as _time
-            t0 = _time.perf_counter_ns()
-            TpuBufferCatalog.get().synchronous_spill(cur.size_bytes)
-            TaskMetricsRegistry.get().add("retryBlockTimeNs",
-                                          _time.perf_counter_ns() - t0)
+    try:
+        while pending:
+            cur = pending[0]
+            try:
+                batch = cur.get_batch()
+                result = fn(batch)
+                pending.pop(0)
+                cur.close()
+                yield result
+                attempts = 0
+            except TpuSplitAndRetryOOM:
+                if stats:
+                    stats.split_retries += 1
+                from ..profiling import TaskMetricsRegistry
+                TaskMetricsRegistry.get().add("splitAndRetryCount", 1)
+                if split_policy is None:
+                    raise
+                pending = split_policy(cur) + pending[1:]
+            except TpuRetryOOM:
+                if stats:
+                    stats.retries += 1
+                from ..profiling import TaskMetricsRegistry
+                TaskMetricsRegistry.get().add("retryCount", 1)
+                attempts += 1
+                if attempts > max_retries:
+                    raise
+                # let pressure drain: spill everything spillable, then retry
+                import time as _time
+                t0 = _time.perf_counter_ns()
+                TpuBufferCatalog.get().synchronous_spill(cur.size_bytes)
+                TaskMetricsRegistry.get().add("retryBlockTimeNs",
+                                              _time.perf_counter_ns() - t0)
+    finally:
+        # fn may raise (ANSI errors, ...) and a consumer may abandon the
+        # generator: never leak the remaining spillables (close discipline —
+        # the MemoryCleaner shutdown report caught exactly this on the ANSI
+        # path)
+        for s in pending:
+            s.close()
 
 
 def with_retry_no_split(spillable: SpillableColumnarBatch,

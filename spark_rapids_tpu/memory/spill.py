@@ -190,10 +190,14 @@ class SpillableColumnarBatch:
 
     def __init__(self, batch: TpuColumnarBatch,
                  priority: int = ACTIVE_BATCHING_PRIORITY):
+        from .cleaner import MemoryCleaner
         self._catalog = TpuBufferCatalog.get()
         self._handle: Optional[int] = self._catalog.add_batch(batch, priority)
         self.num_rows = batch.num_rows
         self.size_bytes = batch.device_memory_size()
+        self._cleaner_token = MemoryCleaner.get().register(
+            f"SpillableColumnarBatch[{self.num_rows}r "
+            f"{self.size_bytes}B]")
 
     def get_batch(self) -> TpuColumnarBatch:
         if self._handle is None:
@@ -201,9 +205,13 @@ class SpillableColumnarBatch:
         return self._catalog.get_batch(self._handle)
 
     def close(self) -> None:
+        from .cleaner import MemoryCleaner
         if self._handle is not None:
             self._catalog.remove(self._handle)
             self._handle = None
+        # second unregister of the same token IS the double-close signal
+        # (raises in the cleaner's debug mode, counted otherwise)
+        MemoryCleaner.get().unregister(self._cleaner_token)
 
     def __enter__(self) -> "SpillableColumnarBatch":
         return self

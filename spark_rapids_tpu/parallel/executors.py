@@ -116,6 +116,7 @@ def _stable_bucket(table, key_ordinals: Sequence[int],
             f = np.asarray(arr.fill_null(0.0).to_numpy(
                 zero_copy_only=False), np.float64)
             f = np.where(f == 0.0, 0.0, f)  # -0.0 == 0.0
+            f = np.where(np.isnan(f), np.float64("nan"), f)  # one NaN bits
             vals = f.view(np.uint64).astype(np.uint32) \
                 ^ (f.view(np.uint64) >> np.uint64(32)).astype(np.uint32)
         else:
@@ -369,16 +370,20 @@ class ExecutorPool:
         map_ids = list(range(plan.num_partitions()))
         self.run_map_stage(sid, blob, map_ids, key_ordinals, num_reduces)
         results = []
+        max_heals = len(map_ids) + 1
         for rid in range(num_reduces):
-            for attempt in range(3):
+            tables = None
+            for _attempt in range(max_heals):
                 try:
                     tables = self.read_reduce(sid, rid, map_ids)
                     break
                 except FetchFailedError as e:
-                    # re-materialize the lost map output then retry the read
+                    # re-materialize the lost map output then retry the
+                    # read; each attempt can surface a DIFFERENT lost map,
+                    # so allow one heal per map before giving up
                     self.run_map_stage(sid, blob, [e.map_id], key_ordinals,
                                        num_reduces)
-            else:
+            if tables is None:
                 raise RuntimeError(f"reduce {rid} unrecoverable")
             results.append(pa.concat_tables(
                 [t for t in tables if t.num_rows]

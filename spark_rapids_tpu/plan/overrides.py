@@ -402,19 +402,24 @@ register_exec(_CpuScan, "file scan", "spark.rapids.sql.exec.FileSourceScanExec",
 
 def _tag_window(meta: PlanMeta) -> None:
     from ..expressions.aggregates import AggregateFunction
-    from ..window import (DenseRank, Lag, Lead, Rank, RowNumber,
+    from ..window import (CumeDist, DenseRank, Lag, Lead, NTile, PercentRank,
+                          Rank, RowNumber,
                           UNBOUNDED_FOLLOWING, UNBOUNDED_PRECEDING, CURRENT_ROW)
     for we in meta.plan.window_exprs:
         fn = we.function
         if isinstance(fn, AggregateFunction):
-            if fn.update_op not in ("sum", "count", "avg", "min", "max"):
+            if fn.update_op not in ("sum", "count", "avg", "min", "max",
+                                    "collect_list", "collect_set"):
                 meta.will_not_work_on_tpu(
                     f"window aggregate {type(fn).__name__} not supported on TPU")
             # bounded min/max frames run via the sparse-table range reduce
-            # (TpuWindowExec._bounded_minmax) — no frame restriction anymore
+            # (TpuWindowExec._bounded_minmax); collect_list lowers to a
+            # ragged gather for running/whole-partition frames and the
+            # host-assisted oracle otherwise (collect_set always host)
             for c in fn.children:
                 meta.add_exprs([c])
-        elif not isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag)):
+        elif not isinstance(fn, (RowNumber, Rank, DenseRank, Lead, Lag,
+                                 NTile, PercentRank, CumeDist)):
             meta.will_not_work_on_tpu(
                 f"window function {type(fn).__name__} not supported on TPU")
         meta.add_exprs(we.spec.partition_by)

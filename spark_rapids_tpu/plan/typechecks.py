@@ -164,8 +164,10 @@ def _register_builtin_exprs() -> None:
 
     from ..expressions import regex as RX
     register_expr(RX.RLike, TypeSigs.BOOLEAN,
-                  "regex match (transpiled or rewritten; rejects fall back)",
-                  host_assisted=True)
+                  "regex match: literal rewrite or compiled byte-DFA on "
+                  "device (kernels/regex_dfa.py); out-of-subset patterns "
+                  "fall back to the host engine",
+                  incompat="out-of-subset patterns run on host")
     register_expr(RX.RegexpReplace, TypeSigs.STRING, "regex replace",
                   host_assisted=True)
     register_expr(RX.RegexpExtract, TypeSigs.STRING, "regex extract",
@@ -325,6 +327,8 @@ def _register_builtin_exprs() -> None:
     register_expr(WIN.Rank, TypeSigs.integral, "rank()")
     register_expr(WIN.DenseRank, TypeSigs.integral, "dense_rank()")
     register_expr(WIN.NTile, TypeSigs.integral, "ntile(n)")
+    register_expr(WIN.PercentRank, TypeSigs.fp, "percent_rank()")
+    register_expr(WIN.CumeDist, TypeSigs.fp, "cume_dist()")
     register_expr(WIN.Lag, TypeSigs.all_basic + TypeSigs.NULL,
                   "lag(col, offset, default)")
     register_expr(WIN.Lead, TypeSigs.all_basic + TypeSigs.NULL,

@@ -829,8 +829,13 @@ class TpuSession:
 
     def __init__(self, conf: Optional[Dict[str, str]] = None):
         self._settings: Dict[str, str] = dict(conf or {})
+        from .config import LEAK_TRACKING_DEBUG
+        from .memory.cleaner import MemoryCleaner
         from .memory.device import TpuDeviceManager
-        TpuDeviceManager.initialize(self._rapids_conf())
+        rc = self._rapids_conf()
+        TpuDeviceManager.initialize(rc)
+        if rc.get(LEAK_TRACKING_DEBUG):
+            MemoryCleaner.get().set_debug(True)
         self._pool: Optional[_fut.ThreadPoolExecutor] = None
 
     # conf API

@@ -119,13 +119,34 @@ class IciShuffleCatalog:
         with cls._lock:
             if cls._instance is None:
                 cls._instance = cls()
+                import atexit
+                atexit.register(cls._shutdown_instance)
             return cls._instance
+
+    @classmethod
+    def _shutdown_instance(cls) -> None:
+        # close-discipline: catalog-held blocks are owned state, released
+        # at shutdown so the MemoryCleaner report only shows real leaks
+        inst = cls._instance
+        if inst is not None:
+            inst.close_all()
 
     @classmethod
     def reset_for_tests(cls) -> "IciShuffleCatalog":
         with cls._lock:
+            if cls._instance is not None:
+                cls._instance.close_all()
             cls._instance = cls()
             return cls._instance
+
+    def close_all(self) -> None:
+        with self._mu:
+            closed = list(self._blocks.values())
+            self._blocks.clear()
+            self._owner.clear()
+            self._complete = set()
+        for sb in closed:
+            sb.close()
 
     def put_block(self, shuffle_id: int, map_id: int, reduce_id: int,
                   batch: TpuColumnarBatch,

@@ -104,15 +104,31 @@ class ArrowPandasUDF(Expression):
         return out.cast(to_arrow(self._dtype))
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
-        from .expressions.strings import _string_result_from_arrow
         from .columnar.batch import _repad
         args = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype).to_arrow()
                 for c in self.children]
-        out = self._call(args)
+        out = self._call_maybe_worker(args, ctx)
         col = TpuColumnVector.from_arrow(out)
         if col.capacity != batch.capacity:
             col = _repad(col, batch.capacity)
         return col
+
+    def _call_maybe_worker(self, args, ctx):
+        """Ship to a worker process when the pool is configured and the fn
+        pickles; in-process otherwise (reference: worker pool vs row-based
+        CPU fallback wrappers)."""
+        from .config import CONCURRENT_PYTHON_WORKERS, PYTHON_UDF_WORKERS
+        from .types import to_arrow
+        n_workers = ctx.conf.get(PYTHON_UDF_WORKERS)
+        if n_workers and n_workers > 0:
+            from .udf_workers import get_pool, try_pickle
+            blob = try_pickle(self.fn)
+            if blob is not None:
+                permits = ctx.conf.get(CONCURRENT_PYTHON_WORKERS) or None
+                pool = get_pool(n_workers, permits)
+                out = pool.run(blob, args)
+                return out.cast(to_arrow(self._dtype))
+        return self._call(args)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa

@@ -1,0 +1,180 @@
+"""Python UDF worker pool: pandas/arrow UDFs execute in separate worker
+processes with Arrow-IPC argument/result exchange, gated by a
+device-admission semaphore.
+
+Reference analogues:
+  - worker processes + Arrow exchange: GpuArrowEvalPythonExec and the forked
+    python workers in python/rapids/worker.py:22-45 (each worker is its own
+    interpreter so user UDF code cannot stall or crash the executor, and a
+    wedged UDF can be killed)
+  - PythonWorkerSemaphore (python/PythonWorkerSemaphore.scala:98): caps how
+    many python workers may hold device resources concurrently; here the
+    permit is held for the duration of a worker round-trip (the worker's
+    results are uploaded to HBM by the caller on return)
+
+UDFs that cannot pickle (closures over live objects, lambdas) fall back to
+in-process evaluation — the same pricing as the reference's row-based CPU
+fallback wrappers.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import queue as pyqueue
+import threading
+from typing import Dict, Optional, Sequence
+
+_POOL_LOCK = threading.Lock()
+_POOL: Optional["PythonWorkerPool"] = None
+
+
+def _ipc_write(arrays) -> bytes:
+    import io
+
+    import pyarrow as pa
+    names = [f"c{i}" for i in range(len(arrays))]
+    table = pa.table(dict(zip(names, arrays))) if arrays else pa.table({})
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def _ipc_read(blob: bytes):
+    import io
+
+    import pyarrow as pa
+    with pa.ipc.open_stream(io.BytesIO(blob)) as r:
+        t = r.read_all()
+    return [t.column(i).combine_chunks() for i in range(t.num_columns)]
+
+
+def _udf_worker_main(task_q, result_q, concurrent, high_water) -> None:
+    """Worker loop: (fn_blob, args_ipc) -> result_ipc. Tracks concurrency in
+    shared memory so tests can assert the semaphore bound."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        task_id, fn_blob, args_blob = item
+        try:
+            with concurrent.get_lock():
+                concurrent.value += 1
+                if concurrent.value > high_water.value:
+                    high_water.value = concurrent.value
+            fn = pickle.loads(fn_blob)
+            args = _ipc_read(args_blob)
+            out = fn(*args)
+            import pyarrow as pa
+            if not isinstance(out, (pa.Array, pa.ChunkedArray)):
+                out = pa.array(out)
+            if isinstance(out, pa.ChunkedArray):
+                out = out.combine_chunks()
+            result_q.put((task_id, "ok", _ipc_write([out])))
+        except Exception as e:  # noqa: BLE001 — report to driver
+            result_q.put((task_id, "error", repr(e)))
+        finally:
+            with concurrent.get_lock():
+                concurrent.value -= 1
+
+
+class PythonWorkerPool:
+    """N spawned UDF workers + a driver-side admission semaphore."""
+
+    def __init__(self, num_workers: int = 2, permits: Optional[int] = None):
+        self._ctx = mp.get_context("spawn")
+        self.num_workers = num_workers
+        self.permits = permits or num_workers
+        self._task_q = self._ctx.Queue()
+        self._result_q = self._ctx.Queue()
+        self._concurrent = self._ctx.Value("i", 0)
+        self._high_water = self._ctx.Value("i", 0)
+        # reference default: concurrentPythonWorkers == pool size unless
+        # narrowed (PythonWorkerSemaphore.scala:98)
+        self.semaphore = threading.Semaphore(self.permits)
+        self._cond = threading.Condition()
+        self._next_id = 0
+        self._pending: Dict[int, object] = {}
+        self._closed = False
+        self._procs = [
+            self._ctx.Process(target=_udf_worker_main,
+                              args=(self._task_q, self._result_q,
+                                    self._concurrent, self._high_water),
+                              daemon=True)
+            for _ in range(num_workers)]
+        for p in self._procs:
+            p.start()
+        # single dispatcher drains the shared result queue; callers wait on
+        # the condition variable (concurrent callers reading one mp.Queue
+        # directly can park each other's results and deadlock-until-timeout)
+        threading.Thread(target=self._dispatch_results, daemon=True).start()
+
+    def _dispatch_results(self) -> None:
+        while not self._closed:
+            try:
+                tid, status, payload = self._result_q.get(timeout=0.5)
+            except pyqueue.Empty:
+                continue
+            except (OSError, EOFError):
+                return
+            with self._cond:
+                self._pending[tid] = (status, payload)
+                self._cond.notify_all()
+
+    @property
+    def high_water_mark(self) -> int:
+        return self._high_water.value
+
+    def run(self, fn_blob: bytes, arrays, timeout: float = 120.0):
+        """Ship one UDF invocation to a worker; blocks on the admission
+        semaphore, then on the result."""
+        with self.semaphore:
+            with self._cond:
+                task_id = self._next_id
+                self._next_id += 1
+            self._task_q.put((task_id, fn_blob, _ipc_write(list(arrays))))
+            with self._cond:
+                if not self._cond.wait_for(
+                        lambda: task_id in self._pending, timeout=timeout):
+                    raise TimeoutError("python UDF worker timed out")
+                status, payload = self._pending.pop(task_id)
+        if status == "error":
+            raise RuntimeError(f"python UDF worker failed: {payload}")
+        return _ipc_read(payload)[0]
+
+    def shutdown(self) -> None:
+        self._closed = True
+        for _ in self._procs:
+            self._task_q.put(None)
+        for p in self._procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.kill()
+
+
+def get_pool(num_workers: int, permits: Optional[int] = None
+             ) -> PythonWorkerPool:
+    """Process-wide pool (created on first use; resized on config change)."""
+    global _POOL
+    with _POOL_LOCK:
+        want_permits = permits or num_workers
+        if _POOL is None or _POOL.num_workers != num_workers \
+                or _POOL.permits != want_permits:
+            if _POOL is not None:
+                _POOL.shutdown()
+            _POOL = PythonWorkerPool(num_workers, permits)
+        return _POOL
+
+
+def try_pickle(fn) -> Optional[bytes]:
+    """Pickled UDF body, or None when the function cannot ship to a worker
+    (closure over live state) — caller falls back to in-process eval."""
+    try:
+        blob = pickle.dumps(fn)
+        pickle.loads(blob)
+        return blob
+    except Exception:  # noqa: BLE001
+        return None

@@ -137,6 +137,36 @@ class NTile(WindowFunction):
         return IntegerT
 
 
+class PercentRank(WindowFunction):
+    """(rank - 1) / (partition size - 1); 0.0 for single-row partitions
+    (reference GpuPercentRank)."""
+    name = "percent_rank"
+
+    @property
+    def dtype(self):
+        from .types import DoubleT
+        return DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class CumeDist(WindowFunction):
+    """Rows ordered at-or-before current (peers included) / partition size
+    (reference GpuCumeDist)."""
+    name = "cume_dist"
+
+    @property
+    def dtype(self):
+        from .types import DoubleT
+        return DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
 class Lead(WindowFunction):
     name = "lead"
 

@@ -1,0 +1,70 @@
+"""Leak tracking + double-close discipline (VERDICT r2 missing #9;
+reference MemoryCleaner shutdown leak check, Plugin.scala:581-596, and
+GpuColumnVector refcount double-close logging)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+from spark_rapids_tpu.columnar.vector import TpuColumnVector
+from spark_rapids_tpu.memory.cleaner import DoubleCloseError, MemoryCleaner
+from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+
+
+def _batch(n=64):
+    col = TpuColumnVector.from_arrow(pa.array(np.arange(n, dtype=np.int64)))
+    return TpuColumnarBatch([col], n, names=["v"])
+
+
+def test_clean_lifecycle_leaves_no_leaks():
+    cleaner = MemoryCleaner.reset_for_tests()
+    with SpillableColumnarBatch(_batch()) as sb:
+        sb.get_batch()
+    assert cleaner.check_leaks() == []
+    assert cleaner.double_closes == 0
+
+
+def test_unclosed_batch_is_reported_as_leak():
+    cleaner = MemoryCleaner.reset_for_tests()
+    sb = SpillableColumnarBatch(_batch())
+    leaks = cleaner.check_leaks()
+    assert len(leaks) == 1 and "SpillableColumnarBatch" in leaks[0]
+    with pytest.raises(AssertionError, match="leaked device resources"):
+        cleaner.check_leaks(raise_on_leak=True)
+    sb.close()
+    assert cleaner.check_leaks() == []
+
+
+def test_double_close_counted_and_raises_in_debug():
+    cleaner = MemoryCleaner.reset_for_tests()
+    sb = SpillableColumnarBatch(_batch())
+    sb.close()
+    sb.close()  # silent count in non-debug mode
+    assert cleaner.double_closes == 1
+
+    cleaner = MemoryCleaner.reset_for_tests()
+    cleaner.set_debug(True)
+    sb2 = SpillableColumnarBatch(_batch())
+    sb2.close()
+    with pytest.raises(DoubleCloseError):
+        sb2.close()
+
+
+def test_debug_mode_captures_creation_stack():
+    cleaner = MemoryCleaner.reset_for_tests()
+    cleaner.set_debug(True)
+    sb = SpillableColumnarBatch(_batch())
+    leaks = cleaner.check_leaks()
+    assert len(leaks) == 1
+    assert "test_memory_cleaner" in leaks[0]  # stack names this file
+    sb.close()
+
+
+def test_session_conf_enables_debug():
+    from spark_rapids_tpu.session import TpuSession
+    cleaner = MemoryCleaner.reset_for_tests()
+    assert not cleaner.debug
+    TpuSession({"spark.rapids.memory.debug.leakTracking": "true"})
+    assert MemoryCleaner.get().debug
+    MemoryCleaner.reset_for_tests()

@@ -1,0 +1,125 @@
+"""Device regex DFA (kernels/regex_dfa.py): compile-or-reject coverage,
+device-vs-host engine equality, and proof the device path actually fires
+(VERDICT r2 directive 5; reference RegexParser.scala transpile-or-reject)."""
+
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+from spark_rapids_tpu.columnar.vector import TpuColumnVector
+from spark_rapids_tpu.expressions.base import AttributeReference
+from spark_rapids_tpu.expressions.regex import RLike
+from spark_rapids_tpu.kernels.regex_dfa import compile_dfa
+
+SUBJECTS = ["", "a", "abc", "xabcy", "123", "a1b2c3", "hello world",
+            "HELLO", "h\nt", "hat", "ab" * 40, "a@b.com", "x@y.org",
+    "café", "éé", "naïve33", "  spaced  ", "a-b_c.d"]
+
+DEVICE_PATTERNS = [
+    "abc", "^abc", "abc$", "^abc$", "a*", "a+b", "ab?c", "[a-c]+x",
+    "a|bc|def", r"\d{2,3}", "h.t", "[^0-9]+", "(ab)+c", r"\w+@\w+",
+    r"^\w+@\w+\.(com|org)$", r"\s\s", r"[aeiou]{2}", "x{0,2}y",
+    "(a|b)(c|d)e?", r"\.", "a{3,}",
+]
+
+REJECT_PATTERNS = ["a(?=b)", r"(a)\1", r"\p{L}", "a*+", "café",
+                   r"\bword\b", "a$b", "(?<=x)y", "[[:alpha:]]"]
+
+
+def _batch(vals):
+    arr = pa.array(vals, pa.string())
+    col = TpuColumnVector.from_arrow(arr)
+    batch = TpuColumnarBatch([col], len(vals), names=["s"])
+    return batch, col, AttributeReference("s", col.dtype, ordinal=0)
+
+
+@pytest.mark.parametrize("pat", DEVICE_PATTERNS)
+def test_device_dfa_matches_python_re(pat):
+    batch, col, ref = _batch(SUBJECTS)
+    expr = RLike(ref, pat)
+    out = expr._device_dfa_match(col, batch)
+    dfa = compile_dfa(pat)
+    assert dfa is not None, f"{pat} should compile"
+    if not dfa.ascii_atoms:
+        # non-ASCII data present -> the gate must punt to host
+        assert out is None
+        batch, col, ref = _batch([s for s in SUBJECTS if s.isascii()])
+        out = RLike(ref, pat)._device_dfa_match(col, batch)
+        subjects = [s for s in SUBJECTS if s.isascii()]
+    else:
+        subjects = SUBJECTS
+    assert out is not None, f"device path must fire for {pat}"
+    got = out.to_arrow().to_pylist()[:len(subjects)]
+    want = [re.search(pat, s) is not None for s in subjects]
+    assert got == want, (pat, list(zip(subjects, got, want)))
+
+
+@pytest.mark.parametrize("pat", REJECT_PATTERNS)
+def test_out_of_subset_rejects_to_host(pat):
+    assert compile_dfa(pat) is None
+
+
+def test_ascii_atom_pattern_runs_on_utf8_data():
+    """All-ASCII atoms are byte/char exact on any UTF-8 input — the device
+    path must fire even with non-ASCII rows present."""
+    batch, col, ref = _batch(["café 42", "café", "x42"])
+    out = RLike(ref, r"\d{2}")._device_dfa_match(col, batch)
+    assert out is not None
+    assert out.to_arrow().to_pylist()[:3] == [True, False, True]
+
+
+def test_nulls_propagate():
+    batch, col, ref = _batch(["abc", None, "xyz"])
+    out = RLike(ref, "b")._device_dfa_match(col, batch)
+    assert out is not None
+    assert out.to_arrow().to_pylist()[:3] == [True, None, False]
+
+
+def test_long_rows_fall_back():
+    from spark_rapids_tpu.kernels.regex_dfa import MAX_DEVICE_ROW_BYTES
+    batch, col, ref = _batch(["x" * (MAX_DEVICE_ROW_BYTES + 1), "ab"])
+    assert RLike(ref, "ab")._device_dfa_match(col, batch) is None
+
+
+def test_rlike_full_expression_uses_dfa_result():
+    """End-to-end through eval_tpu (non-rewritable pattern so the literal
+    fast path cannot shadow the DFA)."""
+    batch, col, ref = _batch(SUBJECTS)
+    pat = r"[a-z]+\d"
+    got = RLike(ref, pat).eval_tpu(batch).to_arrow().to_pylist()
+    want = [re.search(pat, s) is not None for s in SUBJECTS]
+    assert got[:len(SUBJECTS)] == want
+
+
+def test_dollar_matches_before_final_line_terminator():
+    """Java (non-MULTILINE) '$' matches before a trailing \\n, \\r, or
+    \\r\\n (r3 review finding)."""
+    batch, col, ref = _batch(["abc", "abc\n", "abc\r\n", "abc\r",
+                              "abc\nx", "ab"])
+    out = RLike(ref, "c$")._device_dfa_match(col, batch)
+    assert out is not None
+    assert out.to_arrow().to_pylist()[:6] == [
+        True, True, True, True, False, False]
+    # python re agrees for \n (its $ handles only \n; the wider terminator
+    # set is Java's — asserted explicitly above)
+    assert re.search("c$", "abc\n") is not None
+
+
+def test_octal_escape():
+    batch, col, ref = _batch(["a\x07b", "a0b", "a\x00" + "7b"])
+    out = RLike(ref, r"\07")._device_dfa_match(col, batch)
+    assert out is not None
+    # \07 is BEL, not NUL followed by literal 7 (r3 review finding)
+    assert out.to_arrow().to_pylist()[:3] == [True, False, False]
+    assert compile_dfa("\\0") is None  # bare \0 is illegal in java
+
+
+def test_escaped_range_start_in_class():
+    batch, col, ref = _batch(["C", "-", "F", "A", "E"])
+    out = RLike(ref, r"[\x41-\x45]")._device_dfa_match(col, batch)
+    assert out is not None
+    # \x41-\x45 is the range A-E, not the literals {A, -, E}
+    assert out.to_arrow().to_pylist()[:5] == [True, False, False, True, True]

@@ -181,3 +181,107 @@ def test_running_minmax_nan():
     for agg in (F.min, F.max):
         assert canon(q(tpu, agg).collect()) == canon(q(cpu, agg).collect()), \
             agg.__name__
+
+
+def test_ntile():
+    w = Window.partitionBy("k").orderBy("o", "v")
+    for n in (1, 3, 4, 7):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s, n=n: _df(s).select(
+                F.col("k"), F.col("o"), F.col("v"),
+                F.ntile(n).over(w).alias("t")),
+            ignore_order=True)
+
+
+def test_percent_rank_cume_dist():
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.col("k"), F.col("o"),
+            F.percent_rank().over(w).alias("pr"),
+            F.cume_dist().over(w).alias("cd")),
+        ignore_order=True)
+
+
+def test_percent_rank_single_row_partitions():
+    """size-1 partitions: percent_rank 0.0, cume_dist 1.0."""
+    w = Window.partitionBy("o").orderBy("v")  # o nearly unique at n=40
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=40).select(
+            F.col("o"), F.col("v"),
+            F.percent_rank().over(w).alias("pr"),
+            F.cume_dist().over(w).alias("cd")),
+        ignore_order=True)
+
+
+def test_collect_list_over_window_running_and_whole():
+    """Device ragged-gather path: unbounded..current and whole-partition
+    frames; nulls dropped, empty frames yield []."""
+    wr = Window.partitionBy("k").orderBy("o", "v")
+    ww = Window.partitionBy("k")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=120).select(
+            F.col("k"), F.col("o"), F.col("v"),
+            F.collect_list(F.col("v")).over(wr).alias("running"),
+            F.collect_list(F.col("v")).over(ww).alias("whole")),
+        ignore_order=True)
+
+
+def test_collect_set_over_window_host_assisted():
+    w = Window.partitionBy("k")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=80).select(
+            F.col("k"),
+            F.collect_set(F.col("k")).over(w).alias("ks")),
+        ignore_order=True)
+
+
+def test_collect_list_bounded_frame_host_path():
+    w = Window.partitionBy("k").orderBy("o", "v").rowsBetween(-1, 1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=60).select(
+            F.col("k"), F.col("o"), F.col("v"),
+            F.collect_list(F.col("v")).over(w).alias("nbrs")),
+        ignore_order=True)
+
+
+def test_default_frame_is_range_with_peers():
+    """Spark's default ordered frame is RANGE UNBOUNDED..CURRENT ROW: rows
+    tied on the order key all see the full peer group (r3 review finding —
+    ROWS semantics on ties silently diverges)."""
+    import pyarrow as pa
+
+    t = pa.table({"k": [1, 1, 1, 1, 2, 2],
+                  "o": [10, 10, 10, 20, 5, 5],
+                  "v": [1.0, 2.0, 4.0, 8.0, 16.0, 32.0]})
+    w = Window.partitionBy("k").orderBy("o")
+
+    def fn(s):
+        df = s.createDataFrame(t)
+        return df.select(F.col("k"), F.col("o"), F.col("v"),
+                         F.sum(F.col("v")).over(w).alias("rsum"),
+                         F.min(F.col("v")).over(w).alias("rmin"),
+                         F.count(F.col("v")).over(w).alias("rcnt"),
+                         F.collect_list(F.col("v")).over(w).alias("rlist"))
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=True)
+    # explicit golden: all three o=10 ties share sum 7.0 and the same list
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    rows = fn(s).collect()
+    tied = [r for r in rows if r["k"] == 1 and r["o"] == 10]
+    assert all(r["rsum"] == 7.0 for r in tied)
+    assert all(r["rcnt"] == 3 for r in tied)
+    assert all(sorted(r["rlist"]) == [1.0, 2.0, 4.0] for r in tied)
+
+
+def test_rows_between_keeps_row_semantics_on_ties():
+    import pyarrow as pa
+    t = pa.table({"o": [10, 10, 20], "v": [1.0, 2.0, 4.0]})
+    w = Window.orderBy("o", "v").rowsBetween(-10**9, 0)  # unbounded..current
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    rows = (s.createDataFrame(t)
+            .select(F.col("v"), F.sum(F.col("v")).over(w).alias("rs"))
+            .collect())
+    by_v = {r["v"]: r["rs"] for r in rows}
+    assert by_v == {1.0: 1.0, 2.0: 3.0, 4.0: 7.0}
