@@ -209,7 +209,10 @@ def _framework_q3(rows: int) -> dict:
     import benchmarks.tpch as tpch
 
     s = tpch.make_session(tpu=True)
-    tables = tpch.load_tables(s, rows)
+    # dispatch-bound through the tunnel: wall ∝ program launches, so bench
+    # uses fewer partitions (fewer per-stage tasks), not fewer rows
+    s.conf.set("spark.sql.shuffle.partitions", "4")
+    tables = tpch.load_tables(s, rows, parts=2)
     q = tpch.q3(s, tables)
     out = q.to_arrow()  # warm (compiles every stage in the chain)
     # reuse the prebuilt q: results are not memoized, and timing only
