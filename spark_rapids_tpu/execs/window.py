@@ -37,6 +37,16 @@ from .sort import encode_sort_keys
 from .aggregates import lex_sort_permutation
 
 
+def _ntile_tiles(fn) -> int:
+    """Validated tile count for NTile — the single source of truth shared by
+    the TPU and CPU-oracle paths so the two engines agree on rejection."""
+    from ..expressions.base import ExpressionError, Literal
+    nt = fn.children[0]
+    if not isinstance(nt, Literal) or int(nt.value or 0) <= 0:
+        raise ExpressionError("ntile requires a positive integer literal")
+    return int(nt.value)
+
+
 def _bind_window_expr(we: WindowExpression, inputs) -> WindowExpression:
     fn = bind_references(we.function, inputs)
     spec = we.spec
@@ -250,12 +260,7 @@ class TpuWindowExec(TpuExec):
             base = jnp.take(c, seg_start)
             return (c - base + 1).astype(jnp.int32), None
         if isinstance(fn, NTile):
-            from ..expressions.base import ExpressionError, Literal
-            nt = fn.children[0]
-            if not isinstance(nt, Literal) or int(nt.value or 0) <= 0:
-                raise ExpressionError(
-                    "ntile requires a positive integer literal")
-            tiles = jnp.int64(int(nt.value))
+            tiles = jnp.int64(_ntile_tiles(fn))
             size = seg_end - seg_start
             k = idxs - seg_start
             base = size // tiles
@@ -634,9 +639,7 @@ def _cpu_eval_partition(fn, spec, rows, t, ctx, order_vals, results):
                 results[r] = rank if isinstance(fn, Rank) else drank
             return
         if isinstance(fn, NTile):
-            from ..expressions.base import Literal
-            tiles = int(fn.children[0].value) if isinstance(
-                fn.children[0], Literal) else 1
+            tiles = _ntile_tiles(fn)
             base, rem = n // tiles, n % tiles
             for k, r in enumerate(rows):
                 if k < rem * (base + 1):

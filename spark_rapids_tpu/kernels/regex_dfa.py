@@ -103,6 +103,7 @@ class _Parser:
         self.i = 0
         self.anchored_start = False
         self.anchored_end = False
+        self.top_level_alt = False
 
     def parse(self) -> _Node:
         if self.p.startswith(b"^"):
@@ -111,6 +112,13 @@ class _Parser:
         node = self._alt(top=True)
         if self.i != len(self.p):
             raise RegexReject(f"unparsed tail at {self.i}")
+        if self.top_level_alt and (self.anchored_start or self.anchored_end):
+            # Java scopes a leading ^ / trailing $ to only the first / last
+            # alternative ('^a|b' is '(^a)|(b)'), while this parser would
+            # anchor the whole alternation.  Per-branch anchor modeling is not
+            # implemented, so such patterns must go to the host engine.
+            # '^(a|b)$' is unaffected: its '|' is consumed inside a group.
+            raise RegexReject("anchor over top-level alternation")
         return node
 
     def _peek(self) -> int:
@@ -120,6 +128,8 @@ class _Parser:
         parts = [self._concat(top)]
         while self._peek() == 0x7C:  # '|'
             self.i += 1
+            if top:
+                self.top_level_alt = True
             parts.append(self._concat(top))
         return parts[0] if len(parts) == 1 else _Alt(parts)
 

@@ -120,6 +120,10 @@ def _stable_bucket(table, key_ordinals: Sequence[int],
             vals = f.view(np.uint64).astype(np.uint32) \
                 ^ (f.view(np.uint64) >> np.uint64(32)).astype(np.uint32)
         else:
+            # pyarrow has no direct date32/time32→int64 cast; hop through
+            # int32 (timestamp/date64/time64 cast to int64 directly below)
+            if pa.types.is_date32(arr.type) or pa.types.is_time32(arr.type):
+                arr = arr.cast(pa.int32())
             iv = np.asarray(arr.cast(pa.int64()).fill_null(0).to_numpy(
                 zero_copy_only=False), np.int64)
             u = iv.view(np.uint64)

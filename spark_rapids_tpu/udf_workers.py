@@ -3,14 +3,21 @@ processes with Arrow-IPC argument/result exchange, gated by a
 device-admission semaphore.
 
 Reference analogues:
-  - worker processes + Arrow exchange: GpuArrowEvalPythonExec and the forked
-    python workers in python/rapids/worker.py:22-45 (each worker is its own
-    interpreter so user UDF code cannot stall or crash the executor, and a
-    wedged UDF can be killed)
+  - worker processes + per-worker channels: GpuArrowEvalPythonExec and the
+    forked python workers in python/rapids/worker.py:22-45 (each worker is
+    its own interpreter with its own socket, so user UDF code cannot stall
+    or crash the executor, and a wedged UDF can be killed without touching
+    any other worker)
   - PythonWorkerSemaphore (python/PythonWorkerSemaphore.scala:98): caps how
     many python workers may hold device resources concurrently; here the
     permit is held for the duration of a worker round-trip (the worker's
     results are uploaded to HBM by the caller on return)
+
+Each worker owns a dedicated duplex pipe. A caller acquires an idle worker,
+ships one task, and blocks on that worker's pipe alone — there is no shared
+task/result queue, so killing a wedged worker (SIGKILL on timeout) can only
+tear the pipe of the worker being discarded, never wedge its siblings or a
+shared lock.
 
 UDFs that cannot pickle (closures over live objects, lambdas) fall back to
 in-process evaluation — the same pricing as the reference's row-based CPU
@@ -22,9 +29,8 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import pickle
-import queue as pyqueue
 import threading
-from typing import Dict, Optional, Sequence
+from typing import List, Optional
 
 _POOL_LOCK = threading.Lock()
 _POOL: Optional["PythonWorkerPool"] = None
@@ -51,20 +57,19 @@ def _ipc_read(blob: bytes):
     return [t.column(i).combine_chunks() for i in range(t.num_columns)]
 
 
-def _udf_worker_main(task_q, result_q, concurrent, high_water) -> None:
-    """Worker loop: (fn_blob, args_ipc) -> result_ipc. Tracks concurrency in
-    shared memory so tests can assert the semaphore bound."""
+def _udf_worker_main(conn) -> None:
+    """Worker loop over a dedicated pipe: (fn_blob, args_ipc) ->
+    (status, payload). One request in flight at a time, by construction."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     while True:
-        item = task_q.get()
+        try:
+            item = conn.recv()
+        except (EOFError, OSError):
+            return
         if item is None:
             return
-        task_id, fn_blob, args_blob = item
+        fn_blob, args_blob = item
         try:
-            with concurrent.get_lock():
-                concurrent.value += 1
-                if concurrent.value > high_water.value:
-                    high_water.value = concurrent.value
             fn = pickle.loads(fn_blob)
             args = _ipc_read(args_blob)
             out = fn(*args)
@@ -73,86 +78,131 @@ def _udf_worker_main(task_q, result_q, concurrent, high_water) -> None:
                 out = pa.array(out)
             if isinstance(out, pa.ChunkedArray):
                 out = out.combine_chunks()
-            result_q.put((task_id, "ok", _ipc_write([out])))
+            conn.send(("ok", _ipc_write([out])))
         except Exception as e:  # noqa: BLE001 — report to driver
-            result_q.put((task_id, "error", repr(e)))
-        finally:
-            with concurrent.get_lock():
-                concurrent.value -= 1
+            conn.send(("error", repr(e)))
+
+
+class _Worker:
+    """One spawned process + the driver's end of its dedicated pipe."""
+
+    def __init__(self, ctx):
+        self.conn, child = ctx.Pipe(duplex=True)
+        self.proc = ctx.Process(target=_udf_worker_main, args=(child,),
+                                daemon=True)
+        self.proc.start()
+        child.close()
+
+    def kill(self) -> None:
+        self.proc.kill()
+        self.proc.join(timeout=5)
+        try:
+            self.conn.close()
+        except OSError:
+            pass
 
 
 class PythonWorkerPool:
-    """N spawned UDF workers + a driver-side admission semaphore."""
+    """N spawned UDF workers + a driver-side admission semaphore.
+
+    `high_water_mark` reports the peak number of simultaneously in-flight
+    worker round-trips, which is what the admission semaphore bounds
+    (PythonWorkerSemaphore.scala:98 semantics)."""
 
     def __init__(self, num_workers: int = 2, permits: Optional[int] = None):
         self._ctx = mp.get_context("spawn")
         self.num_workers = num_workers
-        self.permits = permits or num_workers
-        self._task_q = self._ctx.Queue()
-        self._result_q = self._ctx.Queue()
-        self._concurrent = self._ctx.Value("i", 0)
-        self._high_water = self._ctx.Value("i", 0)
         # reference default: concurrentPythonWorkers == pool size unless
         # narrowed (PythonWorkerSemaphore.scala:98)
+        self.permits = permits or num_workers
         self.semaphore = threading.Semaphore(self.permits)
-        self._cond = threading.Condition()
-        self._next_id = 0
-        self._pending: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._idle_cv = threading.Condition(self._lock)
+        self._idle: List[_Worker] = [_Worker(self._ctx)
+                                     for _ in range(num_workers)]
+        self._in_flight = 0
+        self._high_water = 0
         self._closed = False
-        self._procs = [
-            self._ctx.Process(target=_udf_worker_main,
-                              args=(self._task_q, self._result_q,
-                                    self._concurrent, self._high_water),
-                              daemon=True)
-            for _ in range(num_workers)]
-        for p in self._procs:
-            p.start()
-        # single dispatcher drains the shared result queue; callers wait on
-        # the condition variable (concurrent callers reading one mp.Queue
-        # directly can park each other's results and deadlock-until-timeout)
-        threading.Thread(target=self._dispatch_results, daemon=True).start()
-
-    def _dispatch_results(self) -> None:
-        while not self._closed:
-            try:
-                tid, status, payload = self._result_q.get(timeout=0.5)
-            except pyqueue.Empty:
-                continue
-            except (OSError, EOFError):
-                return
-            with self._cond:
-                self._pending[tid] = (status, payload)
-                self._cond.notify_all()
 
     @property
     def high_water_mark(self) -> int:
-        return self._high_water.value
+        return self._high_water
+
+    def _acquire_worker(self) -> _Worker:
+        with self._idle_cv:
+            while not self._idle and not self._closed:
+                self._idle_cv.wait()
+            if self._closed:
+                raise RuntimeError("worker pool is shut down")
+            w = self._idle.pop()
+            self._in_flight += 1
+            if self._in_flight > self._high_water:
+                self._high_water = self._in_flight
+            return w
+
+    def _release_worker(self, w: Optional[_Worker]) -> None:
+        stray = None
+        with self._idle_cv:
+            self._in_flight -= 1
+            if w is not None:
+                if self._closed:
+                    stray = w  # pool shut down while this task ran
+                else:
+                    self._idle.append(w)
+            self._idle_cv.notify()
+        if stray is not None:
+            try:
+                stray.conn.send(None)
+            except (OSError, BrokenPipeError):
+                stray.kill()
 
     def run(self, fn_blob: bytes, arrays, timeout: float = 120.0):
-        """Ship one UDF invocation to a worker; blocks on the admission
-        semaphore, then on the result."""
+        """Ship one UDF invocation to a dedicated worker; blocks on the
+        admission semaphore, then on that worker's pipe.
+
+        On timeout the wedged worker is killed and replaced — only its own
+        pipe is torn, so sibling workers and their callers are unaffected."""
         with self.semaphore:
-            with self._cond:
-                task_id = self._next_id
-                self._next_id += 1
-            self._task_q.put((task_id, fn_blob, _ipc_write(list(arrays))))
-            with self._cond:
-                if not self._cond.wait_for(
-                        lambda: task_id in self._pending, timeout=timeout):
-                    raise TimeoutError("python UDF worker timed out")
-                status, payload = self._pending.pop(task_id)
+            w = self._acquire_worker()
+            replacement: Optional[_Worker] = w
+            try:
+                try:
+                    w.conn.send((fn_blob, _ipc_write(list(arrays))))
+                    if not w.conn.poll(timeout):
+                        w.kill()
+                        replacement = None  # never requeue the dead worker
+                        replacement = _Worker(self._ctx)
+                        raise TimeoutError("python UDF worker timed out")
+                    status, payload = w.conn.recv()
+                except TimeoutError:
+                    raise  # ours (subclass of OSError — don't swallow below)
+                except (EOFError, OSError) as e:
+                    # worker died mid-task (crash/OOM): replace it
+                    w.kill()
+                    replacement = None  # never requeue the dead worker
+                    replacement = _Worker(self._ctx)
+                    raise RuntimeError(f"python UDF worker died: {e!r}")
+            finally:
+                self._release_worker(replacement)
         if status == "error":
             raise RuntimeError(f"python UDF worker failed: {payload}")
         return _ipc_read(payload)[0]
 
     def shutdown(self) -> None:
-        self._closed = True
-        for _ in self._procs:
-            self._task_q.put(None)
-        for p in self._procs:
-            p.join(timeout=2)
-            if p.is_alive():
-                p.kill()
+        with self._idle_cv:
+            self._closed = True
+            workers = list(self._idle)
+            self._idle.clear()
+            self._idle_cv.notify_all()
+        for w in workers:
+            try:
+                w.conn.send(None)
+            except (OSError, BrokenPipeError):
+                pass
+        for w in workers:
+            w.proc.join(timeout=2)
+            if w.proc.is_alive():
+                w.proc.kill()
 
 
 def get_pool(num_workers: int, permits: Optional[int] = None
@@ -162,7 +212,7 @@ def get_pool(num_workers: int, permits: Optional[int] = None
     with _POOL_LOCK:
         want_permits = permits or num_workers
         if _POOL is None or _POOL.num_workers != num_workers \
-                or _POOL.permits != want_permits:
+                or _POOL.permits != want_permits or _POOL._closed:
             if _POOL is not None:
                 _POOL.shutdown()
             _POOL = PythonWorkerPool(num_workers, permits)

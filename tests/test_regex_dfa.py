@@ -26,7 +26,11 @@ DEVICE_PATTERNS = [
 ]
 
 REJECT_PATTERNS = ["a(?=b)", r"(a)\1", r"\p{L}", "a*+", "café",
-                   r"\bword\b", "a$b", "(?<=x)y", "[[:alpha:]]"]
+                   r"\bword\b", "a$b", "(?<=x)y", "[[:alpha:]]",
+                   # Java scopes anchors to one branch of a top-level
+                   # alternation; this parser cannot model that -> host
+                   # (r3 advisor high finding)
+                   "a|b$", "^a|b", "^a|b$", "a|b|c$"]
 
 
 def _batch(vals):
@@ -115,6 +119,23 @@ def test_octal_escape():
     # \07 is BEL, not NUL followed by literal 7 (r3 review finding)
     assert out.to_arrow().to_pylist()[:3] == [True, False, False]
     assert compile_dfa("\\0") is None  # bare \0 is illegal in java
+
+
+def test_anchored_group_alternation_still_compiles():
+    """'^(a|b)$' keeps its '|' inside a group — anchors scope over the whole
+    pattern exactly as in Java, so the device path must keep serving it."""
+    batch, col, ref = _batch(["a", "b", "ab", "xa", ""])
+    out = RLike(ref, "^(a|b)$")._device_dfa_match(col, batch)
+    assert out is not None
+    assert out.to_arrow().to_pylist()[:5] == [True, True, False, False, False]
+
+
+def test_top_level_alternation_with_anchor_is_host_correct():
+    """End-to-end: 'a|b$' on 'ax' must be True (Java: (a)|(b$)) — served by
+    the host fallback after the device reject."""
+    batch, col, ref = _batch(["ax", "b", "cb", "c"])
+    got = RLike(ref, "a|b$").eval_tpu(batch).to_arrow().to_pylist()
+    assert got[:4] == [True, True, True, False]
 
 
 def test_escaped_range_start_in_class():

@@ -30,6 +30,11 @@ def _boom(a):
     raise ValueError("udf exploded")
 
 
+def _wedged(a):
+    time.sleep(3600)
+    return a
+
+
 def test_pandas_udf_through_worker_pool_matches_inprocess():
     t = pa.table({"v": [1.0, 2.5, None, 4.0]})
     results = []
@@ -84,6 +89,45 @@ def test_semaphore_bounds_concurrent_workers(permits, expected_max):
         assert pool.high_water_mark <= permits
         if expected_max > 1:
             assert pool.high_water_mark == expected_max
+    finally:
+        pool.shutdown()
+
+
+def test_wedged_udf_killed_on_timeout():
+    """Timeout must kill+replace the wedged worker (so the concurrency bound
+    holds) and leave the pool fully healthy (r3 advisor finding)."""
+    pool = PythonWorkerPool(num_workers=1, permits=1)
+    try:
+        with pytest.raises(TimeoutError):
+            pool.run(try_pickle(_wedged), [pa.array([1.0])], timeout=1.0)
+        # the wedged worker was replaced; nothing stays in flight
+        assert pool._in_flight == 0
+        assert len(pool._idle) == 1
+        # pool serves new work on the replacement worker
+        out = pool.run(try_pickle(_double_it), [pa.array([5.0])], timeout=60)
+        assert out.to_pylist() == [10.0]
+    finally:
+        pool.shutdown()
+
+
+def test_sibling_worker_survives_a_kill():
+    """A timeout on one worker must not disturb a concurrent task on a
+    sibling — the per-worker-pipe design's core guarantee."""
+    pool = PythonWorkerPool(num_workers=2, permits=2)
+    try:
+        results = {}
+
+        def slow_ok():
+            out = pool.run(try_pickle(_sleepy), [pa.array([2.0])], timeout=60)
+            results["ok"] = out.to_pylist()
+
+        t = threading.Thread(target=slow_ok)
+        t.start()
+        with pytest.raises(TimeoutError):
+            pool.run(try_pickle(_wedged), [pa.array([1.0])], timeout=0.5)
+        t.join(timeout=60)
+        assert results.get("ok") == [2.0]
+        assert pool._in_flight == 0
     finally:
         pool.shutdown()
 
