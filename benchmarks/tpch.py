@@ -1,10 +1,12 @@
 """TPC-H-style benchmark queries running through the full framework
 (reference: integration_tests mortgage Benchmarks.scala + ScaleTest harness).
 
-12 queries (q1 q3 q4 q5 q6 q9 q10 q12 q13 q14 q18 q19) over the full
-simplified-TPC-H schema from spark_rapids_tpu.datagen; every query runs
-end-to-end through session -> override engine -> exec chain, and each has a
-CPU-oracle equality test in tests/test_tpch_queries.py.
+All 22 TPC-H queries over the simplified-TPC-H schema from
+spark_rapids_tpu.datagen; every query runs end-to-end through session ->
+override engine -> exec chain, and each has a CPU-oracle equality test in
+tests/test_tpch_queries.py. Correlated subqueries are hand-decorrelated
+into grouped-agg joins / semi joins / cross-joined scalar aggregates, the
+way Spark's own optimizer lowers them.
 
 Usage: python benchmarks/tpch.py [--rows N] [--queries q1,q3,...] [--cpu]
 Prints per-query wall-clock for the TPU plan and (optionally) the CPU plan.
@@ -272,9 +274,261 @@ def q19(s, t):
                  .alias("revenue")))
 
 
-QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q9": q9,
-           "q10": q10, "q12": q12, "q13": q13, "q14": q14, "q18": q18,
-           "q19": q19}
+def q2(s, t):
+    """Minimum-cost supplier: correlated min-subquery decorrelated into a
+    grouped min joined back on (part, cost)."""
+    import spark_rapids_tpu.functions as F
+    supp, nation, region, part, ps = (t["supplier"], t["nation"], t["region"],
+                                      t["part"], t["partsupp"])
+    europe = region.filter(F.col("r_name") == "EUROPE")
+    esupp = (supp.join(nation, on=supp["s_nationkey"] == nation["n_nationkey"])
+             .join(europe, on=nation["n_regionkey"] == europe["r_regionkey"]))
+    eps = ps.join(esupp, on=ps["ps_suppkey"] == esupp["s_suppkey"])
+    min_cost = (eps.groupBy("ps_partkey")
+                .agg(F.min(F.col("ps_supplycost")).alias("mc_cost"))
+                .select(F.col("ps_partkey").alias("mc_partkey"),
+                        F.col("mc_cost")))
+    sel = part.filter((F.col("p_size") == 15)
+                      & F.col("p_type").like("%BRASS"))
+    big = sel.join(eps, on=sel["p_partkey"] == eps["ps_partkey"])
+    return (big.join(min_cost,
+                     on=(big["ps_partkey"] == min_cost["mc_partkey"])
+                     & (big["ps_supplycost"] == min_cost["mc_cost"]))
+            .select("s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr")
+            .sort(F.col("s_acctbal").desc(), "n_name", "s_name", "p_partkey")
+            .limit(100))
+
+
+def q7(s, t):
+    """Volume shipping between FRANCE and GERMANY: nation self-join via
+    aliased projections (fresh attribute ids on each side)."""
+    import spark_rapids_tpu.functions as F
+    li, orders, cust, supp, nation = (t["lineitem"], t["orders"],
+                                      t["customer"], t["supplier"],
+                                      t["nation"])
+    n1 = nation.select(F.col("n_nationkey").alias("n1_key"),
+                       F.col("n_name").alias("supp_nation"))
+    n2 = nation.select(F.col("n_nationkey").alias("n2_key"),
+                       F.col("n_name").alias("cust_nation"))
+    pair = (((F.col("supp_nation") == "FRANCE")
+             & (F.col("cust_nation") == "GERMANY"))
+            | ((F.col("supp_nation") == "GERMANY")
+               & (F.col("cust_nation") == "FRANCE")))
+    return (li.filter((F.col("l_shipdate") >= 9131)
+                      & (F.col("l_shipdate") <= 9861))
+            .join(supp, on=li["l_suppkey"] == supp["s_suppkey"])
+            .join(orders, on=li["l_orderkey"] == orders["o_orderkey"])
+            .join(cust, on=orders["o_custkey"] == cust["c_custkey"])
+            .join(n1, on=supp["s_nationkey"] == n1["n1_key"])
+            .join(n2, on=cust["c_nationkey"] == n2["n2_key"])
+            .filter(pair)
+            .withColumn("volume",
+                        F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .withColumn("l_year",
+                        (F.col("l_shipdate").cast("int") / 365).cast("int"))
+            .groupBy("supp_nation", "cust_nation", "l_year")
+            .agg(F.sum(F.col("volume")).alias("revenue"))
+            .sort("supp_nation", "cust_nation", "l_year"))
+
+
+def q8(s, t):
+    """National market share: BRAZIL's slice of AMERICA's steel imports,
+    conditional-sum ratio per order year."""
+    import spark_rapids_tpu.functions as F
+    li, orders, cust, supp, nation, region, part = (
+        t["lineitem"], t["orders"], t["customer"], t["supplier"],
+        t["nation"], t["region"], t["part"])
+    america = region.filter(F.col("r_name") == "AMERICA")
+    n1 = nation.select(F.col("n_nationkey").alias("n1_key"),
+                       F.col("n_regionkey").alias("n1_region"))
+    n2 = nation.select(F.col("n_nationkey").alias("n2_key"),
+                       F.col("n_name").alias("supp_nation"))
+    steel = part.filter(F.col("p_type") == "ECONOMY ANODIZED STEEL")
+    vol = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    return (steel.join(li, on=steel["p_partkey"] == li["l_partkey"])
+            .join(supp, on=li["l_suppkey"] == supp["s_suppkey"])
+            .join(orders, on=li["l_orderkey"] == orders["o_orderkey"])
+            .join(cust, on=orders["o_custkey"] == cust["c_custkey"])
+            .join(n1, on=cust["c_nationkey"] == n1["n1_key"])
+            .join(america, on=n1["n1_region"] == america["r_regionkey"])
+            .join(n2, on=supp["s_nationkey"] == n2["n2_key"])
+            .filter((F.col("o_orderdate") >= 9131)
+                    & (F.col("o_orderdate") <= 9861))
+            .withColumn("volume", vol)
+            .withColumn("brazil_volume",
+                        F.when(F.col("supp_nation") == "BRAZIL",
+                               F.col("volume")).otherwise(F.lit(0.0)))
+            .withColumn("o_year",
+                        (F.col("o_orderdate").cast("int") / 365).cast("int"))
+            .groupBy("o_year")
+            .agg((F.sum(F.col("brazil_volume"))
+                  / F.sum(F.col("volume"))).alias("mkt_share"))
+            .sort("o_year"))
+
+
+def q11(s, t):
+    """Important stock: per-part value vs a scalar fraction of the national
+    total (scalar subquery via cross join of a 1-row aggregate)."""
+    import spark_rapids_tpu.functions as F
+    ps, supp, nation = t["partsupp"], t["supplier"], t["nation"]
+    ger = nation.filter(F.col("n_name") == "GERMANY")
+    gps = (ps.join(supp, on=ps["ps_suppkey"] == supp["s_suppkey"])
+           .join(ger, on=supp["s_nationkey"] == ger["n_nationkey"])
+           .withColumn("value",
+                       F.col("ps_supplycost") * F.col("ps_availqty")))
+    per_part = (gps.groupBy("ps_partkey")
+                .agg(F.sum(F.col("value")).alias("part_value")))
+    total = gps.agg((F.sum(F.col("value")) * 0.0001).alias("threshold"))
+    return (per_part.crossJoin(total)
+            .filter(F.col("part_value") > F.col("threshold"))
+            .select("ps_partkey", "part_value")
+            .sort(F.col("part_value").desc(), "ps_partkey"))
+
+
+def q15(s, t):
+    """Top supplier: max-revenue scalar subquery over a revenue view.
+    Revenue is rounded to cents before the equality selection so the TPU
+    and CPU engines (different float summation orders) agree on the max."""
+    import spark_rapids_tpu.functions as F
+    li, supp = t["lineitem"], t["supplier"]
+    rev = (li.filter((F.col("l_shipdate") >= 9496)
+                     & (F.col("l_shipdate") < 9587))
+           .withColumn("r", F.col("l_extendedprice") * (1 - F.col("l_discount")))
+           .groupBy("l_suppkey")
+           .agg(F.round(F.sum(F.col("r")), 2).alias("total_revenue")))
+    maxr = rev.agg(F.max(F.col("total_revenue")).alias("max_revenue"))
+    return (supp.join(rev, on=supp["s_suppkey"] == rev["l_suppkey"])
+            .crossJoin(maxr)
+            .filter(F.col("total_revenue") == F.col("max_revenue"))
+            .select("s_suppkey", "s_name", "total_revenue")
+            .sort("s_suppkey"))
+
+
+def q16(s, t):
+    """Parts/supplier relationship: NOT IN subquery as an anti join, then
+    COUNT(DISTINCT supplier) via distinct + count_star."""
+    import spark_rapids_tpu.functions as F
+    ps, part, supp = t["partsupp"], t["part"], t["supplier"]
+    bad = supp.filter(F.col("s_comment").like("%Customer%Complaints%"))
+    sel = part.filter((F.col("p_brand") != "Brand#45")
+                      & ~F.col("p_type").like("MEDIUM POLISHED%")
+                      & F.col("p_size").isin(49, 14, 23, 45, 19, 3, 36, 9))
+    j = (ps.join(sel, on=ps["ps_partkey"] == sel["p_partkey"])
+         .join(bad, on=ps["ps_suppkey"] == bad["s_suppkey"],
+               how="leftanti"))
+    return (j.select("p_brand", "p_type", "p_size", "ps_suppkey").distinct()
+            .groupBy("p_brand", "p_type", "p_size")
+            .agg(F.count_star().alias("supplier_cnt"))
+            .sort(F.col("supplier_cnt").desc(), "p_brand", "p_type",
+                  "p_size"))
+
+
+def q17(s, t):
+    """Small-quantity-order revenue: correlated per-part average decorrelated
+    into a grouped average joined back."""
+    import spark_rapids_tpu.functions as F
+    li, part = t["lineitem"], t["part"]
+    sel = part.filter((F.col("p_brand") == "Brand#23")
+                      & (F.col("p_container") == "MED BOX"))
+    j = li.join(sel, on=li["l_partkey"] == sel["p_partkey"])
+    thresh = (j.groupBy("p_partkey")
+              .agg((F.avg(F.col("l_quantity")) * 0.2).alias("qty_thresh"))
+              .select(F.col("p_partkey").alias("th_partkey"),
+                      F.col("qty_thresh")))
+    return (j.join(thresh, on=j["p_partkey"] == thresh["th_partkey"])
+            .filter(F.col("l_quantity") < F.col("qty_thresh"))
+            .agg((F.sum(F.col("l_extendedprice")) / 7.0)
+                 .alias("avg_yearly")))
+
+
+def q20(s, t):
+    """Potential part promotion: nested IN-subqueries as semi joins over a
+    half-of-shipped-quantity threshold."""
+    import spark_rapids_tpu.functions as F
+    li, ps, part, supp, nation = (t["lineitem"], t["partsupp"], t["part"],
+                                  t["supplier"], t["nation"])
+    forest = part.filter(F.col("p_name").like("forest%"))
+    fps = ps.join(forest, on=ps["ps_partkey"] == forest["p_partkey"],
+                  how="leftsemi")
+    ship94 = (li.filter((F.col("l_shipdate") >= 8766)
+                        & (F.col("l_shipdate") < 9131))
+              .groupBy("l_partkey", "l_suppkey")
+              .agg((F.sum(F.col("l_quantity")) * 0.5).alias("half_qty")))
+    qual = (fps.join(ship94,
+                     on=(fps["ps_partkey"] == ship94["l_partkey"])
+                     & (fps["ps_suppkey"] == ship94["l_suppkey"]))
+            .filter(F.col("ps_availqty") > F.col("half_qty")))
+    # EGYPT rather than dbgen's CANADA: the chosen nation must own
+    # qualifying suppliers under this generator's seed, or the oracle
+    # result is empty and the test proves nothing
+    egypt = nation.filter(F.col("n_name") == "EGYPT")
+    return (supp.join(qual, on=supp["s_suppkey"] == qual["ps_suppkey"],
+                      how="leftsemi")
+            .join(egypt, on=supp["s_nationkey"] == egypt["n_nationkey"])
+            .select("s_name")
+            .sort("s_name"))
+
+
+def q21(s, t):
+    """Suppliers who kept orders waiting: EXISTS/NOT-EXISTS pair decorrelated
+    into distinct (order, supplier) pair counts + two semi joins."""
+    import spark_rapids_tpu.functions as F
+    li, orders, supp, nation = (t["lineitem"], t["orders"], t["supplier"],
+                                t["nation"])
+    late = li.filter(F.col("l_receiptdate") > F.col("l_commitdate"))
+    multi = (li.select("l_orderkey", "l_suppkey").distinct()
+             .groupBy("l_orderkey")
+             .agg(F.count_star().alias("nsupp"))
+             .filter(F.col("nsupp") > 1)
+             .select(F.col("l_orderkey").alias("multi_key")))
+    one_late = (late.select("l_orderkey", "l_suppkey").distinct()
+                .groupBy("l_orderkey")
+                .agg(F.count_star().alias("nlate"))
+                .filter(F.col("nlate") == 1)
+                .select(F.col("l_orderkey").alias("late_key")))
+    f_orders = orders.filter(F.col("o_orderstatus") == "F")
+    saudi = nation.filter(F.col("n_name") == "SAUDI ARABIA")
+    l1 = (late.join(f_orders, on=late["l_orderkey"] == f_orders["o_orderkey"])
+          .join(supp, on=late["l_suppkey"] == supp["s_suppkey"])
+          .join(saudi, on=supp["s_nationkey"] == saudi["n_nationkey"]))
+    return (l1.join(multi, on=l1["l_orderkey"] == multi["multi_key"],
+                    how="leftsemi")
+            .join(one_late, on=l1["l_orderkey"] == one_late["late_key"],
+                  how="leftsemi")
+            .groupBy("s_name")
+            .agg(F.count_star().alias("numwait"))
+            .sort(F.col("numwait").desc(), "s_name")
+            .limit(100))
+
+
+def q22(s, t):
+    """Global sales opportunity: phone-prefix cohort, scalar average via
+    cross join, NOT EXISTS as an anti join."""
+    import spark_rapids_tpu.functions as F
+    cust, orders = t["customer"], t["orders"]
+    # codes with orderless members under this generator's seed (dbgen's
+    # 13/31/23/... country codes don't exist in the synthetic phones)
+    codes = ["04", "27", "81", "55", "35", "61", "68"]
+    cohort = (cust.withColumn("cntrycode",
+                              F.substring(F.col("c_phone"), 1, 2))
+              .filter(F.col("cntrycode").isin(*codes)))
+    avg_bal = (cohort.filter(F.col("c_acctbal") > 0.0)
+               .agg(F.avg(F.col("c_acctbal")).alias("avg_bal")))
+    no_orders = cohort.join(
+        orders, on=cohort["c_custkey"] == orders["o_custkey"],
+        how="leftanti")
+    return (no_orders.crossJoin(avg_bal)
+            .filter(F.col("c_acctbal") > F.col("avg_bal"))
+            .groupBy("cntrycode")
+            .agg(F.count_star().alias("numcust"),
+                 F.sum(F.col("c_acctbal")).alias("totacctbal"))
+            .sort("cntrycode"))
+
+
+QUERIES = {"q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
+           "q7": q7, "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12,
+           "q13": q13, "q14": q14, "q15": q15, "q16": q16, "q17": q17,
+           "q18": q18, "q19": q19, "q20": q20, "q21": q21, "q22": q22}
 
 
 def main() -> None:

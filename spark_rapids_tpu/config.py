@@ -294,6 +294,26 @@ COMPILED_AGG_MAX_GROUPS = _conf("spark.rapids.tpu.agg.compiled.maxGroups").doc(
     "direct-index; beyond this the general sort-based path runs."
 ).integer(4096)
 
+COMPILED_JOIN_ENABLED = _conf(
+    "spark.rapids.tpu.join.compiledStage.enabled").doc(
+    "Fuse eligible star-shaped join pipelines "
+    "(fact scan->filter->project -> chain of many-to-one equi-joins -> "
+    "groupBy) into ONE jitted XLA program per fact batch: dimension tables "
+    "build as sorted device arrays, the fact side probes them with "
+    "searchsorted + gather inside the trace, and the aggregation groups by "
+    "the dimension row index (dense codes, segment reductions). Kills the "
+    "per-partition program-launch storm of the shuffled-join path on "
+    "high-dispatch-latency links. Ineligible stages (non-equi conditions, "
+    "duplicate build keys, outer joins) fall back transparently."
+).boolean(True)
+
+COMPILED_JOIN_MAX_DIM_ROWS = _conf(
+    "spark.rapids.tpu.join.compiled.maxDimRows").doc(
+    "Largest build-side (dimension) row count the compiled join stage will "
+    "materialize as device probe arrays; beyond this the general shuffled "
+    "join path runs."
+).integer(1 << 22)
+
 SHUFFLE_READER_THREADS = _conf("spark.rapids.shuffle.multiThreaded.reader.threads").doc(
     "Threads for the multithreaded shuffle reader (reference RapidsConf.scala:1866)."
 ).integer(8)

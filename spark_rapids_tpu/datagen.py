@@ -17,9 +17,13 @@ import pyarrow as pa
 
 
 def _cell_rng(seed: int, table: str, column: str, part: int) -> np.random.Generator:
-    # stable per (seed, table, column, partition) stream — the seed-mapping idea
-    key = abs(hash((seed, table, column, part))) % (2**63)
-    return np.random.default_rng(key)
+    # stable per (seed, table, column, partition) stream — the seed-mapping
+    # idea. MUST be process-stable (python's hash() is salted per process,
+    # which made every benchmark run generate different data), so derive the
+    # stream key from a content hash.
+    import zlib
+    key = zlib.crc32(f"{seed}|{table}|{column}|{part}".encode())
+    return np.random.default_rng((seed << 32) ^ key)
 
 
 class ColumnSpec:
@@ -28,7 +32,7 @@ class ColumnSpec:
                  null_prob: float = 0.0, alphabet: str = "abcdefghij",
                  max_len: int = 12, values: Optional[Sequence[str]] = None,
                  sequential: bool = False, modulo: Optional[int] = None,
-                 repeat: int = 1):
+                 repeat: int = 1, derive=None):
         self.name = name
         # int/long/double/string/date/bool/key/seq/choice
         self.kind = kind
@@ -43,6 +47,10 @@ class ColumnSpec:
         self.sequential = sequential  # choice: values[row % len] (dim tables)
         self.modulo = modulo          # seq: (row // repeat) % modulo
         self.repeat = repeat          # seq: each key value repeats this often
+        # derive: fn(cols_so_far: dict[str, pa.Array], rng, n) -> pa.Array —
+        # cross-column FK consistency (e.g. lineitem suppliers drawn from the
+        # part's partsupp suppliers, as the real TPC-H generator does)
+        self.derive = derive
 
     def generate(self, rng: np.random.Generator, n: int,
                  offset: int = 0) -> pa.Array:
@@ -138,7 +146,10 @@ class TableSpec:
         cols = {}
         for c in self.columns:
             rng = _cell_rng(seed, self.name, c.name, part)
-            cols[c.name] = c.generate(rng, rows, offset=offset)
+            if c.kind == "derive":
+                cols[c.name] = c.derive(cols, rng, rows, offset)
+            else:
+                cols[c.name] = c.generate(rng, rows, offset=offset)
         return pa.table(cols)
 
     def generate(self, seed: int, rows: int, partitions: int = 1) -> pa.Table:
@@ -185,10 +196,21 @@ N_REGIONS = len(_REGIONS)
 
 
 def tpch_lineitem(scale_rows: int) -> TableSpec:
+    n_supp = max(scale_rows // 100, 1)
+
+    def _li_suppkey(cols, rng, n, offset=0):
+        # supplier drawn from the part's 4 partsupp suppliers (the real
+        # dbgen invariant: lineitem (part,supp) pairs exist in partsupp) —
+        # mirrors the affine layout in tpch_partsupp below
+        pk = np.asarray(cols["l_partkey"].to_numpy(zero_copy_only=False),
+                        np.int64)
+        j = rng.integers(0, 4, n)
+        return pa.array((31 * pk + 7 * j) % n_supp, pa.int64())
+
     return TableSpec("lineitem", [
         ColumnSpec("l_orderkey", "key", cardinality=max(scale_rows // 4, 1)),
         ColumnSpec("l_partkey", "key", cardinality=max(scale_rows // 20, 1)),
-        ColumnSpec("l_suppkey", "key", cardinality=max(scale_rows // 100, 1)),
+        ColumnSpec("l_suppkey", "derive", derive=_li_suppkey),
         ColumnSpec("l_quantity", "int", min_val=1, max_val=50),
         ColumnSpec("l_extendedprice", "double", min_val=900.0, max_val=105000.0),
         ColumnSpec("l_discount", "double", min_val=0.0, max_val=0.1),
@@ -208,7 +230,10 @@ def tpch_lineitem(scale_rows: int) -> TableSpec:
 def tpch_orders(scale_rows: int) -> TableSpec:
     return TableSpec("orders", [
         ColumnSpec("o_orderkey", "seq"),
-        ColumnSpec("o_custkey", "key", cardinality=max(scale_rows // 10, 1)),
+        # 2/3 of the customer domain: like dbgen, a third of customers have
+        # placed no orders (q13/q22 exercise exactly that population)
+        ColumnSpec("o_custkey", "key",
+                   cardinality=max(2 * scale_rows // 30, 1)),
         ColumnSpec("o_orderdate", "date", min_val=8035, max_val=10590),
         ColumnSpec("o_totalprice", "double", min_val=800.0, max_val=600000.0),
         ColumnSpec("o_orderpriority", "choice", values=_PRIORITIES),
@@ -233,6 +258,11 @@ def tpch_supplier(scale_rows: int) -> TableSpec:
         ColumnSpec("s_name", "string", max_len=18),
         ColumnSpec("s_nationkey", "seq", modulo=N_NATIONS),
         ColumnSpec("s_acctbal", "double", min_val=-1000.0, max_val=10000.0),
+        # a minority of comments carry the q16 exclusion phrase
+        ColumnSpec("s_comment", "choice", values=[
+            "quick deliveries", "ironic packages", "silent deposits",
+            "Customer not Complaints noted", "regular accounts",
+            "slyly final Customer Complaints", "bold requests"]),
     ])
 
 
@@ -241,6 +271,8 @@ def tpch_part(scale_rows: int) -> TableSpec:
         ColumnSpec("p_partkey", "seq"),
         ColumnSpec("p_name", "choice", values=[
             f"{a} {b}" for a in _COLORS for b in ("metal", "steel", "satin")]),
+        ColumnSpec("p_mfgr", "choice", values=[
+            f"Manufacturer#{i}" for i in range(1, 6)]),
         ColumnSpec("p_type", "choice", values=_TYPES),
         ColumnSpec("p_brand", "choice", values=_BRANDS),
         ColumnSpec("p_container", "choice", values=_CONTAINERS),
@@ -251,11 +283,20 @@ def tpch_part(scale_rows: int) -> TableSpec:
 
 def tpch_partsupp(n_parts: int, n_suppliers: int) -> TableSpec:
     # 4 suppliers per part: ps_partkey = (row // 4) % n_parts — the modulo
-    # keeps the FK inside part's key domain for ANY generated row count
+    # keeps the FK inside part's key domain for ANY generated row count.
+    # ps_suppkey is the affine layout lineitem's derive mirrors, so every
+    # lineitem (part,supp) pair exists in partsupp (dbgen invariant).
+    n_s = max(n_suppliers, 1)
+
+    def _ps_suppkey(cols, rng, n, offset=0):
+        pk = np.asarray(cols["ps_partkey"].to_numpy(zero_copy_only=False),
+                        np.int64)
+        j = (np.arange(offset, offset + n)) % 4
+        return pa.array((31 * pk + 7 * j) % n_s, pa.int64())
+
     return TableSpec("partsupp", [
         ColumnSpec("ps_partkey", "seq", repeat=4, modulo=max(n_parts, 1)),
-        ColumnSpec("ps_suppkey", "key",
-                   cardinality=max(n_suppliers, 1)),
+        ColumnSpec("ps_suppkey", "derive", derive=_ps_suppkey),
         ColumnSpec("ps_availqty", "int", min_val=1, max_val=9999),
         ColumnSpec("ps_supplycost", "double", min_val=1.0, max_val=1000.0),
     ])
@@ -273,4 +314,354 @@ def tpch_region() -> TableSpec:
     return TableSpec("region", [
         ColumnSpec("r_regionkey", "seq"),
         ColumnSpec("r_name", "choice", values=_REGIONS, sequential=True),
+    ])
+
+
+# --- TPC-DS-style schema (reference integration_tests tpcds suite; the
+# dimensional model is the standard's, columns trimmed to what the query
+# set touches; date_dim is a REAL calendar so derived columns stay
+# consistent) ---------------------------------------------------------------
+
+TPCDS_BASE_DATE = "1998-01-01"
+TPCDS_DAYS = 2557  # 7 years, 1998-2004
+
+
+def tpcds_date_dim(n_days: int = TPCDS_DAYS) -> pa.Table:
+    """Deterministic calendar dimension: d_date_sk 0..n-1 maps to real dates
+    from TPCDS_BASE_DATE, with year/month/day columns computed from the real
+    calendar (consistent under any query)."""
+    sk = np.arange(n_days, dtype=np.int64)
+    dates = np.datetime64(TPCDS_BASE_DATE) + sk.astype("timedelta64[D]")
+    d = dates.astype("datetime64[D]")
+    years = d.astype("datetime64[Y]").astype(np.int64) + 1970
+    months = d.astype("datetime64[M]").astype(np.int64) % 12 + 1
+    dom = (d - d.astype("datetime64[M]")).astype(np.int64) + 1
+    dow = (d.astype(np.int64) + 4) % 7  # 1970-01-01 was a Thursday
+    day_names = np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"])
+    week_seq = (d.astype(np.int64) + 4) // 7
+    return pa.table({
+        "d_date_sk": pa.array(sk, pa.int64()),
+        "d_date": pa.array(d.astype("datetime64[D]").astype(np.int32)
+                           if False else
+                           (d - np.datetime64("1970-01-01")).astype(np.int32),
+                           pa.date32()),
+        "d_year": pa.array(years.astype(np.int32), pa.int32()),
+        "d_moy": pa.array(months.astype(np.int32), pa.int32()),
+        "d_dom": pa.array(dom.astype(np.int32), pa.int32()),
+        "d_qoy": pa.array(((months - 1) // 3 + 1).astype(np.int32),
+                          pa.int32()),
+        "d_dow": pa.array(dow.astype(np.int32), pa.int32()),
+        "d_day_name": pa.array(day_names[dow], pa.string()),
+        "d_week_seq": pa.array(week_seq, pa.int64()),
+        "d_month_seq": pa.array((years - 1970) * 12 + months - 1, pa.int64()),
+    })
+
+
+_DS_CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Men", "Music",
+                  "Shoes", "Sports", "Women", "Children"]
+_DS_STATES = ["TN", "CA", "TX", "NY", "GA", "OH", "IL", "WA", "MI", "VA"]
+_DS_EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+                 "4 yr Degree", "Advanced Degree", "Unknown"]
+_DS_MARITAL = ["M", "S", "D", "W", "U"]
+_DS_BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
+                     "0-500", "Unknown"]
+_DS_CREDIT = ["Low Risk", "Good", "High Risk", "Unknown"]
+
+
+def tpcds_item(n: int) -> TableSpec:
+    return TableSpec("item", [
+        ColumnSpec("i_item_sk", "seq"),
+        ColumnSpec("i_item_id", "string", cardinality=max(n // 2, 1),
+                   alphabet="ABCDEFGHIJKLMNOP", max_len=16),
+        ColumnSpec("i_category", "choice", values=_DS_CATEGORIES),
+        ColumnSpec("i_class", "choice", values=[
+            f"class{i:02d}" for i in range(20)]),
+        ColumnSpec("i_brand", "choice", values=[
+            f"brand{i:02d}" for i in range(50)]),
+        ColumnSpec("i_brand_id", "int", min_val=1000, max_val=10000),
+        ColumnSpec("i_manufact_id", "int", min_val=1, max_val=1000),
+        ColumnSpec("i_manager_id", "int", min_val=1, max_val=100),
+        ColumnSpec("i_current_price", "double", min_val=0.5, max_val=300.0),
+        ColumnSpec("i_wholesale_cost", "double", min_val=0.2, max_val=90.0),
+        ColumnSpec("i_color", "choice", values=_COLORS),
+        ColumnSpec("i_size", "choice", values=[
+            "small", "medium", "large", "extra large", "petite", "N/A"]),
+    ])
+
+
+def tpcds_store(n: int = 12) -> TableSpec:
+    return TableSpec("store", [
+        ColumnSpec("s_store_sk", "seq"),
+        ColumnSpec("s_store_id", "string", cardinality=n, max_len=8,
+                   alphabet="STORE0123456789"),
+        ColumnSpec("s_store_name", "choice", values=[
+            f"store_{i}" for i in range(n)], sequential=True),
+        ColumnSpec("s_state", "choice", values=_DS_STATES),
+        ColumnSpec("s_county", "choice", values=[
+            f"county{i}" for i in range(8)]),
+        ColumnSpec("s_city", "choice", values=[
+            f"city{i}" for i in range(20)]),
+        ColumnSpec("s_gmt_offset", "double", min_val=-8.0, max_val=-5.0),
+        ColumnSpec("s_number_employees", "int", min_val=200, max_val=300),
+    ])
+
+
+def tpcds_customer(n: int, n_addr: int, n_cdemo: int, n_hdemo: int
+                   ) -> TableSpec:
+    return TableSpec("customer", [
+        ColumnSpec("c_customer_sk", "seq"),
+        ColumnSpec("c_customer_id", "string", cardinality=max(n, 1),
+                   alphabet="CUSTID0123456789", max_len=16),
+        ColumnSpec("c_current_addr_sk", "key", cardinality=max(n_addr, 1)),
+        ColumnSpec("c_current_cdemo_sk", "key", cardinality=max(n_cdemo, 1)),
+        ColumnSpec("c_current_hdemo_sk", "key", cardinality=max(n_hdemo, 1)),
+        ColumnSpec("c_first_name", "string", cardinality=200, max_len=10,
+                   alphabet="abcdefghijklmnop"),
+        ColumnSpec("c_last_name", "string", cardinality=300, max_len=12,
+                   alphabet="abcdefghijklmnop"),
+        ColumnSpec("c_birth_year", "int", min_val=1930, max_val=2000),
+        ColumnSpec("c_birth_country", "choice", values=_NATIONS),
+    ])
+
+
+def tpcds_customer_address(n: int) -> TableSpec:
+    return TableSpec("customer_address", [
+        ColumnSpec("ca_address_sk", "seq"),
+        ColumnSpec("ca_state", "choice", values=_DS_STATES),
+        ColumnSpec("ca_county", "choice", values=[
+            f"county{i}" for i in range(8)]),
+        ColumnSpec("ca_city", "choice", values=[
+            f"city{i}" for i in range(20)]),
+        ColumnSpec("ca_zip", "choice", values=[
+            f"{z:05d}" for z in range(10000, 10080)]),
+        ColumnSpec("ca_country", "choice", values=["United States"]),
+        ColumnSpec("ca_gmt_offset", "double", min_val=-8.0, max_val=-5.0),
+    ])
+
+
+def tpcds_customer_demographics(n: int = 1000) -> TableSpec:
+    return TableSpec("customer_demographics", [
+        ColumnSpec("cd_demo_sk", "seq"),
+        ColumnSpec("cd_gender", "choice", values=["M", "F"]),
+        ColumnSpec("cd_marital_status", "choice", values=_DS_MARITAL),
+        ColumnSpec("cd_education_status", "choice", values=_DS_EDUCATION),
+        ColumnSpec("cd_purchase_estimate", "int", min_val=500, max_val=10000),
+        ColumnSpec("cd_credit_rating", "choice", values=_DS_CREDIT),
+        ColumnSpec("cd_dep_count", "int", min_val=0, max_val=6),
+    ])
+
+
+def tpcds_household_demographics(n: int = 720) -> TableSpec:
+    return TableSpec("household_demographics", [
+        ColumnSpec("hd_demo_sk", "seq"),
+        ColumnSpec("hd_buy_potential", "choice", values=_DS_BUY_POTENTIAL),
+        ColumnSpec("hd_dep_count", "int", min_val=0, max_val=9),
+        ColumnSpec("hd_vehicle_count", "int", min_val=-1, max_val=4),
+    ])
+
+
+def tpcds_promotion(n: int = 30) -> TableSpec:
+    return TableSpec("promotion", [
+        ColumnSpec("p_promo_sk", "seq"),
+        ColumnSpec("p_channel_email", "choice", values=["Y", "N"]),
+        ColumnSpec("p_channel_event", "choice", values=["Y", "N"]),
+        ColumnSpec("p_channel_tv", "choice", values=["Y", "N"]),
+        ColumnSpec("p_channel_dmail", "choice", values=["Y", "N"]),
+    ])
+
+
+def tpcds_warehouse(n: int = 6) -> TableSpec:
+    return TableSpec("warehouse", [
+        ColumnSpec("w_warehouse_sk", "seq"),
+        ColumnSpec("w_warehouse_name", "choice", values=[
+            f"warehouse_{i}" for i in range(n)], sequential=True),
+        ColumnSpec("w_state", "choice", values=_DS_STATES),
+    ])
+
+
+def tpcds_time_dim(n: int = 86400) -> TableSpec:
+    return TableSpec("time_dim", [
+        ColumnSpec("t_time_sk", "seq"),
+        ColumnSpec("t_hour", "seq", repeat=3600, modulo=24),
+        ColumnSpec("t_minute", "seq", repeat=60, modulo=60),
+    ])
+
+
+def tpcds_web_site(n: int = 8) -> TableSpec:
+    return TableSpec("web_site", [
+        ColumnSpec("web_site_sk", "seq"),
+        ColumnSpec("web_name", "choice", values=[
+            f"site_{i}" for i in range(n)], sequential=True),
+    ])
+
+
+def tpcds_ship_mode(n: int = 10) -> TableSpec:
+    return TableSpec("ship_mode", [
+        ColumnSpec("sm_ship_mode_sk", "seq"),
+        ColumnSpec("sm_type", "choice", values=[
+            "EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]),
+        ColumnSpec("sm_carrier", "choice", values=[
+            "UPS", "FEDEX", "AIRBORNE", "USPS", "DHL"]),
+    ])
+
+
+def _sales_money_cols(prefix: str):
+    p = prefix
+    return [
+        ColumnSpec(f"{p}_quantity", "int", min_val=1, max_val=100,
+                   null_prob=0.02),
+        ColumnSpec(f"{p}_wholesale_cost", "double", min_val=1.0,
+                   max_val=100.0),
+        ColumnSpec(f"{p}_list_price", "double", min_val=1.0, max_val=300.0),
+        ColumnSpec(f"{p}_sales_price", "double", min_val=0.0, max_val=300.0,
+                   null_prob=0.02),
+        ColumnSpec(f"{p}_ext_discount_amt", "double", min_val=0.0,
+                   max_val=1000.0),
+        ColumnSpec(f"{p}_ext_sales_price", "double", min_val=0.0,
+                   max_val=30000.0),
+        ColumnSpec(f"{p}_ext_wholesale_cost", "double", min_val=1.0,
+                   max_val=10000.0),
+        ColumnSpec(f"{p}_ext_list_price", "double", min_val=1.0,
+                   max_val=30000.0),
+        ColumnSpec(f"{p}_ext_tax", "double", min_val=0.0, max_val=3000.0),
+        ColumnSpec(f"{p}_coupon_amt", "double", min_val=0.0, max_val=500.0),
+        ColumnSpec(f"{p}_net_paid", "double", min_val=0.0, max_val=30000.0),
+        ColumnSpec(f"{p}_net_profit", "double", min_val=-5000.0,
+                   max_val=10000.0),
+    ]
+
+
+def tpcds_store_sales(rows: int, n_items: int, n_cust: int, n_stores: int,
+                      n_cdemo: int, n_hdemo: int, n_addr: int,
+                      n_promo: int) -> TableSpec:
+    """Item and customer are DETERMINISTIC functions of the row / ticket
+    (item = (17·row+5) mod n_items, customer = 13·ticket mod n_cust), the
+    dsdgen invariant that store_returns rows reference real sales — so
+    sales⋈returns joins on (customer, item, ticket) actually match."""
+    ni, nc = max(n_items, 1), max(n_cust, 1)
+
+    def _ss_item(cols, rng, n, offset=0):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return pa.array((17 * idx + 5) % ni, pa.int64())
+
+    def _ss_cust(cols, rng, n, offset=0):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return pa.array((13 * (idx // 4)) % nc, pa.int64())
+
+    return TableSpec("store_sales", [
+        ColumnSpec("ss_sold_date_sk", "key", cardinality=TPCDS_DAYS,
+                   null_prob=0.01),
+        ColumnSpec("ss_sold_time_sk", "key", cardinality=86400),
+        ColumnSpec("ss_item_sk", "derive", derive=_ss_item),
+        ColumnSpec("ss_customer_sk", "derive", derive=_ss_cust),
+        ColumnSpec("ss_cdemo_sk", "key", cardinality=max(n_cdemo, 1)),
+        ColumnSpec("ss_hdemo_sk", "key", cardinality=max(n_hdemo, 1)),
+        ColumnSpec("ss_addr_sk", "key", cardinality=max(n_addr, 1)),
+        ColumnSpec("ss_store_sk", "key", cardinality=max(n_stores, 1)),
+        ColumnSpec("ss_promo_sk", "key", cardinality=max(n_promo, 1)),
+        ColumnSpec("ss_ticket_number", "seq", repeat=4),
+        *_sales_money_cols("ss"),
+    ])
+
+
+def tpcds_store_returns(rows: int, n_items: int, n_cust: int, n_stores: int,
+                        n_tickets: int) -> TableSpec:
+    """Each return references a real sale: ticket is random, and
+    (item, customer) are re-derived from the ticket with the same affine
+    layout store_sales uses."""
+    ni, nc, nt = max(n_items, 1), max(n_cust, 1), max(n_tickets, 1)
+
+    def _sr_item(cols, rng, n, offset=0):
+        t = np.asarray(cols["sr_ticket_number"].to_numpy(
+            zero_copy_only=False), np.int64)
+        j = rng.integers(0, 4, n)
+        return pa.array((17 * (4 * t + j) + 5) % ni, pa.int64())
+
+    def _sr_cust(cols, rng, n, offset=0):
+        t = np.asarray(cols["sr_ticket_number"].to_numpy(
+            zero_copy_only=False), np.int64)
+        return pa.array((13 * t) % nc, pa.int64())
+
+    return TableSpec("store_returns", [
+        ColumnSpec("sr_returned_date_sk", "key", cardinality=TPCDS_DAYS),
+        ColumnSpec("sr_ticket_number", "key", cardinality=nt),
+        ColumnSpec("sr_item_sk", "derive", derive=_sr_item),
+        ColumnSpec("sr_customer_sk", "derive", derive=_sr_cust),
+        ColumnSpec("sr_store_sk", "key", cardinality=max(n_stores, 1)),
+        ColumnSpec("sr_return_quantity", "int", min_val=1, max_val=40),
+        ColumnSpec("sr_return_amt", "double", min_val=0.0, max_val=5000.0),
+        ColumnSpec("sr_net_loss", "double", min_val=0.0, max_val=3000.0),
+    ])
+
+
+def tpcds_catalog_sales(rows: int, n_items: int, n_cust: int, n_cdemo: int,
+                        n_hdemo: int, n_addr: int, n_promo: int,
+                        n_wh: int) -> TableSpec:
+    return TableSpec("catalog_sales", [
+        ColumnSpec("cs_sold_date_sk", "key", cardinality=TPCDS_DAYS,
+                   null_prob=0.01),
+        ColumnSpec("cs_ship_date_sk", "key", cardinality=TPCDS_DAYS),
+        ColumnSpec("cs_item_sk", "key", cardinality=max(n_items, 1)),
+        ColumnSpec("cs_bill_customer_sk", "key", cardinality=max(n_cust, 1)),
+        ColumnSpec("cs_bill_cdemo_sk", "key", cardinality=max(n_cdemo, 1)),
+        ColumnSpec("cs_bill_hdemo_sk", "key", cardinality=max(n_hdemo, 1)),
+        ColumnSpec("cs_bill_addr_sk", "key", cardinality=max(n_addr, 1)),
+        ColumnSpec("cs_promo_sk", "key", cardinality=max(n_promo, 1)),
+        ColumnSpec("cs_warehouse_sk", "key", cardinality=max(n_wh, 1)),
+        ColumnSpec("cs_ship_mode_sk", "key", cardinality=10),
+        ColumnSpec("cs_call_center_sk", "key", cardinality=4),
+        ColumnSpec("cs_order_number", "seq", repeat=3),
+        *_sales_money_cols("cs"),
+    ])
+
+
+def tpcds_catalog_returns(rows: int, n_items: int, n_orders: int
+                          ) -> TableSpec:
+    return TableSpec("catalog_returns", [
+        ColumnSpec("cr_returned_date_sk", "key", cardinality=TPCDS_DAYS),
+        ColumnSpec("cr_item_sk", "key", cardinality=max(n_items, 1)),
+        ColumnSpec("cr_order_number", "key", cardinality=max(n_orders, 1)),
+        ColumnSpec("cr_return_quantity", "int", min_val=1, max_val=40),
+        ColumnSpec("cr_return_amount", "double", min_val=0.0, max_val=5000.0),
+        ColumnSpec("cr_net_loss", "double", min_val=0.0, max_val=3000.0),
+    ])
+
+
+def tpcds_web_sales(rows: int, n_items: int, n_cust: int, n_addr: int,
+                    n_sites: int, n_promo: int) -> TableSpec:
+    return TableSpec("web_sales", [
+        ColumnSpec("ws_sold_date_sk", "key", cardinality=TPCDS_DAYS,
+                   null_prob=0.01),
+        ColumnSpec("ws_ship_date_sk", "key", cardinality=TPCDS_DAYS),
+        ColumnSpec("ws_sold_time_sk", "key", cardinality=86400),
+        ColumnSpec("ws_item_sk", "key", cardinality=max(n_items, 1)),
+        ColumnSpec("ws_bill_customer_sk", "key", cardinality=max(n_cust, 1)),
+        ColumnSpec("ws_bill_addr_sk", "key", cardinality=max(n_addr, 1)),
+        ColumnSpec("ws_web_site_sk", "key", cardinality=max(n_sites, 1)),
+        ColumnSpec("ws_ship_mode_sk", "key", cardinality=10),
+        ColumnSpec("ws_promo_sk", "key", cardinality=max(n_promo, 1)),
+        ColumnSpec("ws_order_number", "seq", repeat=3),
+        *_sales_money_cols("ws"),
+    ])
+
+
+def tpcds_web_returns(rows: int, n_items: int, n_orders: int) -> TableSpec:
+    return TableSpec("web_returns", [
+        ColumnSpec("wr_returned_date_sk", "key", cardinality=TPCDS_DAYS),
+        ColumnSpec("wr_item_sk", "key", cardinality=max(n_items, 1)),
+        ColumnSpec("wr_order_number", "key", cardinality=max(n_orders, 1)),
+        ColumnSpec("wr_return_quantity", "int", min_val=1, max_val=40),
+        ColumnSpec("wr_return_amt", "double", min_val=0.0, max_val=5000.0),
+        ColumnSpec("wr_net_loss", "double", min_val=0.0, max_val=3000.0),
+    ])
+
+
+def tpcds_inventory(rows: int, n_items: int, n_wh: int) -> TableSpec:
+    return TableSpec("inventory", [
+        ColumnSpec("inv_date_sk", "key", cardinality=TPCDS_DAYS),
+        ColumnSpec("inv_item_sk", "key", cardinality=max(n_items, 1)),
+        ColumnSpec("inv_warehouse_sk", "key", cardinality=max(n_wh, 1)),
+        ColumnSpec("inv_quantity_on_hand", "int", min_val=0, max_val=1000,
+                   null_prob=0.02),
     ])

@@ -570,7 +570,16 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
 
 def _np_merge_carries(spec: _StageSpec, carries: List[Tuple]):
     """Merge per-batch carries (already numpy, fetched in ONE device_get)
-    into (rowcount, per-fn raw-state dicts) — pure host work, no syncs."""
+    into (rowcount, per-fn raw-state dicts) — pure host work, no syncs.
+
+    Float sums may legitimately produce NaN here (a group with +inf in one
+    batch and -inf in another sums to NaN, matching Java), so the merge runs
+    under errstate(invalid=ignore): the NaN is the answer, not an accident."""
+    with np.errstate(invalid="ignore", over="ignore"):
+        return _np_merge_carries_impl(spec, carries)
+
+
+def _np_merge_carries_impl(spec: _StageSpec, carries: List[Tuple]):
     rowcount = None
     merged: List[Dict] = []
     for bi, carry in enumerate(carries):
@@ -674,6 +683,16 @@ class TpuCompiledAggStageExec(TpuExec):
 
     def num_partitions(self) -> int:
         return 1
+
+    def collect_nodes(self):
+        # the fallback subtree holds the exchanges whose shuffle state the
+        # session releases at query end — it MUST stay reachable here, or
+        # every fallback rerun leaks its shuffle blocks in the catalog
+        out = super().collect_nodes()
+        seen = {id(n) for n in out}
+        out.extend(n for n in self.fallback.collect_nodes()
+                   if id(n) not in seen)
+        return out
 
     def node_desc(self) -> str:
         keys = ", ".join(g.name for g in self.spec.grouping) or "<global>"

@@ -265,8 +265,10 @@ def sort_table(table, order: List[SortOrder], ctx: TaskContext):
     sort_cols["__row__"] = pa.array(np.arange(n, dtype=np.int64))
     sort_keys.append(("__row__", "ascending"))
     key_table = pa.table(sort_cols)
-    idx = pc.sort_indices(key_table, sort_keys=sort_keys,
-                          null_placement="at_end")
+    # arrow ≥25 wants null_placement per sort key (key columns are all
+    # non-null by construction — the flag encodes null position)
+    idx = pc.sort_indices(
+        key_table, sort_keys=[(k, d, "at_end") for k, d in sort_keys])
     return table.take(idx)
 
 

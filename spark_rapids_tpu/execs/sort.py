@@ -31,7 +31,8 @@ def encode_sort_keys(cols: List[TpuColumnVector], num_rows: int, capacity: int):
             import pyarrow as pa
             import pyarrow.compute as pc
             arr = c.to_arrow()
-            ranks = pc.rank(arr, sort_keys="ascending", null_placement="at_end",
+            # arrow ≥25 wants null_placement per sort key
+            ranks = pc.rank(arr, sort_keys=[("", "ascending", "at_end")],
                             tiebreaker="dense")
             vals = np.asarray(ranks.to_numpy(zero_copy_only=False)).astype(np.int64)
             buf = np.zeros(capacity, np.int64)

@@ -150,6 +150,91 @@ def get_json_object_impl(doc: Optional[str], path_steps) -> Optional[str]:
     return _render(_walk(value, path_steps), had_wildcard)
 
 
+def device_json_get(col, batch, steps):
+    """Device JSON path extraction (kernels/json_scan.py) for single-name
+    paths ('$.key'), or None when outside the device subset. Per-ROW hybrid:
+    rows the validating scan cannot certify (escapes, float canonicalization,
+    duplicate keys, deep nesting, top-level arrays) are re-run on the host
+    engine and spliced back — one odd row no longer drags the batch to host.
+
+    Reference: GpuGetJsonObject.scala via JNI JSONUtils (device kernel)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..kernels import strings as SK
+    from ..kernels.json_scan import (K_PRIMITIVE, K_STRING, scan_key_spans)
+    from ..columnar.vector import bucket_capacity
+    from .strings import _dev_str, _str_col
+    if (steps is None or len(steps) != 1
+            or not isinstance(steps[0], str)):
+        return None
+    if not _dev_str(col):
+        return None
+    if not SK.is_ascii(col.data):
+        return None  # multi-byte keys/content: host handles encoding corners
+    data, offsets = col.data, col.offsets
+    nbytes = int(data.shape[0])
+    n = int(offsets.shape[0]) - 1
+    if n == 0:
+        return None
+    lens = offsets[1:] - offsets[:-1]
+    max_len = int(jnp.max(lens)) if n else 0
+    if max_len > 4096:
+        return None
+    spans = scan_key_spans(data, offsets, steps[0].encode(), max_len)
+    # servable on device: certified rows whose value renders byte-identically
+    # to the host (raw string without escapes; canonical int; true/false) —
+    # or a null result (invalid doc / missing key / JSON null)
+    is_null_out = (~spans.valid_doc | ~spans.found
+                   | ((spans.kind == K_PRIMITIVE) & (spans.tok == 21)))
+    raw_ok = ((spans.kind == K_STRING)
+              | ((spans.kind == K_PRIMITIVE)
+                 & ((spans.tok == 2) | (spans.tok == 3)
+                    | (spans.tok == 12) | (spans.tok == 17))))
+    serve = spans.confident & (is_null_out | raw_ok)
+    serve_np = np.asarray(serve)
+    row_valid = col.validity
+    out_len = jnp.where(serve & ~is_null_out, spans.length, 0)
+    out_start = jnp.where(serve & ~is_null_out, spans.start, 0)
+    out, offs = SK.build_ranges(data, out_start.astype(jnp.int32),
+                                out_len.astype(jnp.int32),
+                                bucket_capacity(max(nbytes, 1)))
+    validity = ~jnp.asarray(np.asarray(is_null_out))
+    if row_valid is not None:
+        nv = int(validity.shape[0])
+        validity = validity & row_valid[:nv]
+    if bool(np.all(serve_np)):
+        v = jnp.zeros((batch.capacity,), bool).at[
+            :validity.shape[0]].set(validity)
+        return _str_col(batch, out, offs, v, col)
+    # host patch for the unserved minority, spliced row-wise on device
+    import pyarrow as pa
+
+    from ..columnar.vector import TpuColumnVector
+    arr = col.to_arrow()
+    texts = arr.to_pylist()
+    patched = [None] * n
+    for i in np.nonzero(~serve_np)[0]:
+        patched[int(i)] = get_json_object_impl(texts[int(i)], steps)
+    patch_col = TpuColumnVector.from_arrow(pa.array(patched, pa.string()))
+    serve_j = jnp.asarray(serve_np)
+    dev_emit = serve_j & validity
+    patch_valid = (patch_col.validity if patch_col.validity is not None
+                   else jnp.ones((int(patch_col.offsets.shape[0]) - 1,),
+                                 bool))
+    patch_emit = (~serve_j) & patch_valid[:n]
+    p_starts = patch_col.offsets[:-1][:n]
+    p_lens = (patch_col.offsets[1:] - patch_col.offsets[:-1])[:n]
+    out2, offs2 = SK.concat_columns(
+        [(out, offs[:-1], offs[1:] - offs[:-1]),
+         (patch_col.data, p_starts, p_lens)],
+        bucket_capacity(max(nbytes + int(patch_col.data.shape[0]), 1)),
+        part_emit=[dev_emit, patch_emit])
+    final_valid = jnp.where(serve_j, validity, patch_valid[:n])
+    v = jnp.zeros((batch.capacity,), bool).at[:n].set(final_valid)
+    return _str_col(batch, out2, offs2, v, col)
+
+
 class GetJsonObject(Expression):
     """get_json_object(json, path) → string (reference GpuGetJsonObject.scala,
     JNI JSONUtils.getJsonObject)."""
@@ -187,6 +272,9 @@ class GetJsonObject(Expression):
         c = self.children[0].eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
             return TpuScalar(StringT, get_json_object_impl(c.value, steps))
+        out = device_json_get(c, batch, steps)
+        if out is not None:
+            return out
         out = pa.array([get_json_object_impl(v, steps)
                         for v in c.to_arrow().to_pylist()], type=pa.string())
         return _string_result_from_arrow(out, batch)

@@ -359,7 +359,9 @@ class Round(Expression):
         from .base import Literal
         c = self.children[0].eval_cpu(table, ctx)
         scale = self.children[1].value if isinstance(self.children[1], Literal) else 0
-        return pc.round(c, ndigits=scale, round_mode="half_away_from_zero")
+        # arrow ≥25 renamed HALF_UP: half_towards_infinity == Spark's
+        # ROUND_HALF_UP (away from zero for both signs)
+        return pc.round(c, ndigits=scale, round_mode="half_towards_infinity")
 
     def pretty(self) -> str:
         return f"round({self.children[0].pretty()}, {self.children[1].pretty()})"

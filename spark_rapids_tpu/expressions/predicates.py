@@ -352,6 +352,17 @@ class In(Expression):
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         from .base import Literal
+        from ..types import StringType
+        if isinstance(self.value.dtype, StringType):
+            # strings have no dense device scalar form; lower to an OR of
+            # equalities (exactly Spark's IN null semantics: any-true → true,
+            # else any-null → null, else false), served by the device string
+            # equality kernel
+            import functools
+            legs = [EqualTo(self.value, item) for item in self.items]
+            if not legs:
+                return Literal(False, BooleanT).eval_tpu(batch, ctx)
+            return functools.reduce(Or, legs).eval_tpu(batch, ctx)
         v = self.value.eval_tpu(batch, ctx)
         cap = batch.capacity
         mask = row_mask(batch.num_rows, cap)

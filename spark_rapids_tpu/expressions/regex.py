@@ -196,7 +196,11 @@ class RegexpReplace(Expression):
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
         import pyarrow.compute as pc
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        col = self.children[0].eval_tpu(batch, ctx)
+        out = self._device_replace(col, batch)
+        if out is not None:
+            return out
+        arr = _to_arrow_side(col, batch)
         prog = _re.compile(self._transpiled)
         if prog.match(""):
             # empty-matchable patterns: arrow's RE2 global replace advances
@@ -211,6 +215,52 @@ class RegexpReplace(Expression):
                 arr, pattern=self._transpiled,
                 replacement=self._java_to_py_repl())
         return _string_result_from_arrow(out, batch)
+
+    def _device_replace(self, col, batch):
+        """DFA span matching + device byte assembly over HBM buffers, or
+        None when pattern/replacement/column are outside the device subset
+        (reference: cuDF regex replace kernels behind
+        CudfRegexTranspiler/RegexParser.scala:687)."""
+        from ..columnar.vector import bucket_capacity
+        from ..kernels import strings as SK
+        from ..kernels.regex_dfa import (MAX_DEVICE_SPAN_ROW_BYTES,
+                                         compile_exact_dfa,
+                                         match_lengths_device,
+                                         select_leftmost_nonoverlapping)
+        if "$" in self.replacement or "\\" in self.replacement:
+            return None  # group refs / escapes: host engine
+        dfa = compile_exact_dfa(self.pattern)
+        if dfa is None or not _dev_str(col):
+            return None
+        if not dfa.ascii_atoms and not SK.is_ascii(col.data):
+            return None
+        lens = col.offsets[1:] - col.offsets[:-1]
+        max_len = int(jnp.max(lens)) if int(lens.shape[0]) else 0
+        if max_len > MAX_DEVICE_SPAN_ROW_BYTES:
+            return None
+        data, offsets = col.data, col.offsets
+        nbytes = int(data.shape[0])
+        repl = np.frombuffer(self.replacement.encode(), np.uint8)
+        rlen = int(repl.shape[0])
+        mlen = match_lengths_device(data, offsets, dfa, max_len)
+        taken = select_leftmost_nonoverlapping(mlen, offsets, max_len)
+        # covered bytes: +1 at taken starts, -1 at their (exclusive) ends
+        pos = jnp.arange(nbytes, dtype=jnp.int32)
+        delta = jnp.zeros((nbytes + 1,), jnp.int32)
+        delta = delta.at[jnp.where(taken, pos, nbytes)].add(1, mode="drop")
+        delta = delta.at[jnp.where(taken, pos + mlen, nbytes)].add(
+            -1, mode="drop")
+        covered = jnp.cumsum(delta[:-1]) > 0
+        if rlen <= dfa.min_len:
+            out_cap = max(nbytes, 1)
+        else:
+            out_cap = bucket_capacity(
+                (nbytes // dfa.min_len) * rlen + nbytes)
+        out, offs = SK.build_from_contributions(
+            data, ~covered, offsets, out_cap,
+            replace_at=taken, replacement=repl)
+        from .strings import _str_col
+        return _str_col(batch, out, offs, col.validity, col)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa
@@ -255,9 +305,56 @@ class RegexpExtract(Expression):
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
-        arr = _to_arrow_side(self.children[0].eval_tpu(batch, ctx), batch)
+        col = self.children[0].eval_tpu(batch, ctx)
+        out = self._device_extract(col, batch)
+        if out is not None:
+            return out
+        arr = _to_arrow_side(col, batch)
         out = pa.array(self._extract(arr.to_pylist()), pa.string())
         return _string_result_from_arrow(out, batch)
+
+    def _device_extract(self, col, batch):
+        """Whole-match (group 0) extraction on device: first match span via
+        the exact DFA, then a ranged gather. Capture groups (>0) stay on the
+        host engine."""
+        from ..columnar.vector import bucket_capacity
+        from ..kernels import strings as SK
+        from ..kernels.regex_dfa import (MAX_DEVICE_SPAN_ROW_BYTES,
+                                         compile_exact_dfa,
+                                         match_lengths_device)
+        if self.group != 0:
+            return None
+        dfa = compile_exact_dfa(self.pattern)
+        if dfa is None or not _dev_str(col):
+            return None
+        if not dfa.ascii_atoms and not SK.is_ascii(col.data):
+            return None
+        lens = col.offsets[1:] - col.offsets[:-1]
+        max_len = int(jnp.max(lens)) if int(lens.shape[0]) else 0
+        if max_len > MAX_DEVICE_SPAN_ROW_BYTES:
+            return None
+        data, offsets = col.data, col.offsets
+        nbytes = int(data.shape[0])
+        n = int(offsets.shape[0]) - 1
+        if nbytes == 0 or n == 0:
+            from .strings import _str_col
+            return _str_col(batch, data, offsets, col.validity, col)
+        mlen = match_lengths_device(data, offsets, dfa, max_len)
+        rows = SK.byte_rows(offsets, nbytes)
+        pos = jnp.arange(nbytes, dtype=jnp.int32)
+        big = jnp.int32(nbytes)
+        first = SK.segment_min(jnp.where(mlen > 0, pos, big), rows, n,
+                               init=jnp.int32(nbytes))
+        found = first < big
+        start = jnp.where(found, first, 0)
+        length = jnp.where(found,
+                           mlen[jnp.clip(start, 0, nbytes - 1)],
+                           0)  # Spark: no match → empty string
+        out_cap = bucket_capacity(nbytes)
+        out, offs = SK.build_ranges(data, start.astype(jnp.int32),
+                                    length.astype(jnp.int32), out_cap)
+        from .strings import _str_col
+        return _str_col(batch, out, offs, col.validity, col)
 
     def eval_cpu(self, table, ctx=_DEFAULT_CTX):
         import pyarrow as pa

@@ -1,0 +1,377 @@
+"""Device JSON scanning: single-key path extraction over HBM byte buffers.
+
+Reference: GpuGetJsonObject.scala / JNI JSONUtils — the reference runs JSON
+path extraction on device with a custom kernel. TPU re-design: one lockstep
+byte scan (`lax.fori_loop`, byte t of every row per step) carrying a
+validating micro-parser per row:
+
+  * depth counter + a 1-bit-per-depth container-kind stack (int32 bitmask,
+    the simdjson trick) — a real pushdown for JSON's nesting with O(1) state;
+  * a structural automaton (expect-key / after-key / expect-value /
+    after-value) driven by the container kind on pop;
+  * a token DFA validating every primitive's spelling (numbers per RFC 8259,
+    true/false/null) — the host engine strict-parses, so the device must
+    reject what the host rejects;
+  * target-key progress + value-span capture at object depth 1.
+
+Rows the scan cannot certify (backslash escapes, non-canonical numbers,
+depth > 31, structural errors the automaton can't attribute, duplicate key
+hits) report confident=False and are re-run on the host engine — a per-ROW
+hybrid, so one weird row no longer drags the whole batch to the host.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+# --- byte classes -----------------------------------------------------------
+
+_CLS = np.zeros(256, np.int32)
+
+
+def _set(chars: str, v: int) -> None:
+    for ch in chars:
+        _CLS[ord(ch)] = v
+
+
+C_OTHER = 0
+C_LBRACE, C_RBRACE, C_LBRACK, C_RBRACK = 1, 2, 3, 4
+C_COMMA, C_COLON, C_QUOTE, C_WS, C_BSLASH = 5, 6, 7, 8, 9
+C_TOKEN = 10  # primitive token chars: digits, letters, + - .
+
+_set("{", C_LBRACE)
+_set("}", C_RBRACE)
+_set("[", C_LBRACK)
+_set("]", C_RBRACK)
+_set(",", C_COMMA)
+_set(":", C_COLON)
+_set('"', C_QUOTE)
+_set(" \t\n\r", C_WS)
+_set("\\", C_BSLASH)
+_set("0123456789+-.eE", C_TOKEN)
+_set("abcdfghijklmnopqrstuvwxyz", C_TOKEN)  # letters for true/false/null
+_set("ABCDFGHIJKLMNOPQRSTUVWXYZ", C_TOKEN)
+
+# --- primitive-token DFA ----------------------------------------------------
+# States validate numbers (RFC 8259) and the three literals; DEAD rejects.
+# 0 START, 1 MINUS, 2 ZERO, 3 INT, 4 DOT, 5 FRAC, 6 E, 7 ESIGN, 8 EXP,
+# literals: 9.. tr ue / fa lse / nu ll tries, DEAD = 31
+_T_DEAD = 31
+_T_ACCEPT = frozenset({2, 3, 5, 8, 12, 17, 21, 22})  # zero int frac exp literals -0
+
+
+def _build_token_dfa() -> np.ndarray:
+    t = np.full((32, 256), _T_DEAD, np.int32)
+
+    def arc(s, chars, d):
+        for ch in chars:
+            t[s, ord(ch)] = d
+
+    digits = "0123456789"
+    arc(0, "-", 1)
+    arc(0, "0", 2)
+    arc(0, "123456789", 3)
+    arc(1, "0", 22)  # "-0": valid JSON but renders as "0" -> host
+    arc(1, "123456789", 3)
+    arc(3, digits, 3)
+    for s in (2, 3):
+        arc(s, ".", 4)
+        arc(s, "eE", 6)
+    arc(4, digits, 5)
+    arc(5, digits, 5)
+    arc(5, "eE", 6)
+    arc(6, "+-", 7)
+    arc(6, digits, 8)
+    arc(7, digits, 8)
+    arc(8, digits, 8)
+    arc(22, ".", 4)   # -0.5 continues like ZERO
+    arc(22, "eE", 6)
+    # true: 9 10 11 12 ; false: 13 14 15 16 17 ; null: 18 19 20 21
+    arc(0, "t", 9)
+    arc(9, "r", 10)
+    arc(10, "u", 11)
+    arc(11, "e", 12)
+    arc(0, "f", 13)
+    arc(13, "a", 14)
+    arc(14, "l", 15)
+    arc(15, "s", 16)
+    arc(16, "e", 17)
+    arc(0, "n", 18)
+    arc(18, "u", 19)
+    arc(19, "l", 20)
+    arc(20, "l", 21)
+    return t
+
+
+_TOKEN_DFA = _build_token_dfa()
+_TOKEN_ACCEPT = np.zeros(32, bool)
+for _s in _T_ACCEPT:
+    _TOKEN_ACCEPT[_s] = True
+
+# --- structural automaton states -------------------------------------------
+S_START = 0          # before the top-level value
+S_OBJ_KEY = 1        # inside object, expecting a key (or '}': empty object)
+S_OBJ_COLON = 2      # key seen, expecting ':'
+S_OBJ_VALUE = 3      # ':' seen, expecting a value
+S_OBJ_AFTER = 4      # value done, expecting ',' or '}'
+S_ARR_VALUE = 5      # inside array, expecting a value (or ']': empty array)
+S_ARR_AFTER = 6      # value done, expecting ',' or ']'
+S_DONE = 7           # top-level value complete (only ws allowed)
+S_OBJ_KEY2 = 9       # after ',': a key is REQUIRED ('}' here = trailing comma)
+S_ARR_VALUE2 = 10    # after ',': a value is REQUIRED
+
+# value kinds for the captured span
+K_NONE, K_STRING, K_PRIMITIVE, K_OBJECT, K_ARRAY = 0, 1, 2, 3, 4
+
+MAX_DEPTH = 31
+
+
+class JsonSpans(NamedTuple):
+    start: "object"      # int32[n] byte offset of the value (quote excluded)
+    length: "object"     # int32[n] byte length (0 valid for "")
+    kind: "object"       # int32[n] K_*
+    tok: "object"        # int32[n] final token-DFA state of a primitive
+    found: "object"      # bool[n] key present with a captured value
+    valid_doc: "object"  # bool[n] document parses
+    confident: "object"  # bool[n] device result is authoritative
+
+
+def scan_key_spans(data, offsets, key: bytes, max_len: int) -> JsonSpans:
+    """For each row (a JSON document), find the FIRST value of `key` in the
+    top-level object and validate the whole document structurally."""
+    import jax
+    import jax.numpy as jnp
+
+    nbytes = int(data.shape[0])
+    n = int(offsets.shape[0]) - 1
+    starts = offsets[:-1].astype(jnp.int32)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    cls_lut = jnp.asarray(_CLS)
+    tok_dfa = jnp.asarray(_TOKEN_DFA)
+    tok_acc = jnp.asarray(_TOKEN_ACCEPT)
+    kb = np.frombuffer(key, np.uint8)
+    klen = int(kb.shape[0])
+    key_arr = jnp.asarray(np.pad(kb, (0, 1)))  # +1 pad for safe gather
+
+    z32 = jnp.zeros((n,), jnp.int32)
+    zb = jnp.zeros((n,), bool)
+
+    class C(NamedTuple):
+        state: "object"; depth: "object"; arrmask: "object"
+        in_str: "object"; str_is_key: "object"
+        kprog: "object"; armed: "object"
+        tok_state: "object"; in_tok: "object"
+        cap_start: "object"; cap_len: "object"; cap_kind: "object"
+        cap_tok: "object"
+        captured: "object"; cap_depth: "object"; capturing: "object"
+        dup: "object"; bad: "object"; unconf: "object"
+
+    init = C(jnp.full((n,), S_START, jnp.int32), z32, z32,
+             zb, zb, z32, zb, z32, zb,
+             z32, z32, z32, z32, zb, z32, zb, zb, zb, zb)
+
+    def body(t, c):
+        pos = jnp.clip(starts + t, 0, max(nbytes - 1, 0))
+        live = t < lens
+        b = data[pos].astype(jnp.int32) if nbytes else jnp.zeros((n,), jnp.int32)
+        k = cls_lut[b]
+
+        state, depth, arrmask = c.state, c.depth, c.arrmask
+        in_str, str_is_key = c.in_str, c.str_is_key
+        kprog, armed = c.kprog, c.armed
+        tok_state, in_tok = c.tok_state, c.in_tok
+        cap_start, cap_len, cap_kind = c.cap_start, c.cap_len, c.cap_kind
+        cap_tok = c.cap_tok
+        captured, cap_depth, capturing = c.captured, c.cap_depth, c.capturing
+        dup, bad, unconf = c.dup, c.bad, c.unconf
+
+        # ---- inside a string -----------------------------------------
+        bslash = in_str & (k == C_BSLASH)
+        unconf = unconf | (live & bslash)  # escapes: host semantics
+        str_end = in_str & (k == C_QUOTE)
+        # key progress while inside a key string
+        in_key_body = in_str & str_is_key & ~str_end
+        kexp = key_arr[jnp.clip(kprog, 0, klen)].astype(jnp.int32)
+        kmatch = in_key_body & (kprog >= 0) & (kprog < klen) & (b == kexp)
+        kprog = jnp.where(in_key_body,
+                          jnp.where(kmatch, kprog + 1, jnp.int32(-1)),
+                          kprog)
+        # a key string that ends with full progress arms the capture
+        key_hit = (str_end & str_is_key & (depth == 1) & (kprog == klen)
+                   & ~captured & ~capturing)
+        dup = dup | (live & str_end & str_is_key & (depth == 1)
+                     & (kprog == klen) & captured)
+        armed = jnp.where(live & str_end, key_hit, armed)
+        # string VALUE end while capturing a string value at depth cap_depth
+        str_val_end = str_end & capturing & (cap_kind == K_STRING) \
+            & (depth == cap_depth)
+        cap_len = jnp.where(live & str_val_end, pos - cap_start, cap_len)
+        captured = captured | (live & str_val_end)
+        capturing = capturing & ~(live & str_val_end)
+        # structural: leaving a string
+        state = jnp.where(
+            live & str_end,
+            jnp.where(str_is_key, jnp.int32(S_OBJ_COLON),
+                      _after_value_state(depth, arrmask, jnp)),
+            state)
+        in_str = in_str & ~(live & str_end)
+
+        # ---- outside strings -----------------------------------------
+        out = live & ~c.in_str  # state BEFORE this byte
+        ws = out & (k == C_WS)
+
+        # token continuation / termination
+        tok_char = out & (k == C_TOKEN)
+        tok_cont = tok_char & in_tok
+        tok_begin = tok_char & ~in_tok
+        # beginning a token only legal when expecting a value
+        expects_value = ((state == S_START) | (state == S_OBJ_VALUE)
+                         | (state == S_ARR_VALUE)
+                         | (state == S_ARR_VALUE2))
+        bad = bad | (tok_begin & ~expects_value)
+        tok_state = jnp.where(tok_begin, tok_dfa[0, b],
+                              jnp.where(tok_cont, tok_dfa[tok_state, b],
+                                        tok_state))
+        # primitive value capture start
+        prim_cap = tok_begin & armed & (state == S_OBJ_VALUE)
+        cap_start = jnp.where(prim_cap, pos, cap_start)
+        cap_kind = jnp.where(prim_cap, jnp.int32(K_PRIMITIVE), cap_kind)
+        cap_depth = jnp.where(prim_cap, depth, cap_depth)
+        capturing = capturing | prim_cap
+        armed = armed & ~tok_begin
+        in_tok = jnp.where(out, tok_char, in_tok)
+        state = jnp.where(tok_begin, jnp.int32(S_DONE * 0 + 99), state)
+        # 99 = IN_TOKEN sentinel: resolved at the delimiter below
+
+        # token end: a non-token byte while in a 99 state
+        tok_end = out & (state == 99) & ~tok_char
+        unconf = unconf | (tok_end & ~tok_acc[jnp.clip(tok_state, 0, 31)])
+        prim_val_end = tok_end & capturing & (cap_kind == K_PRIMITIVE)
+        cap_len = jnp.where(prim_val_end, pos - cap_start, cap_len)
+        cap_tok = jnp.where(prim_val_end, tok_state, cap_tok)
+        captured = captured | prim_val_end
+        capturing = capturing & ~prim_val_end
+        state = jnp.where(tok_end,
+                          _after_value_state(depth, arrmask, jnp), state)
+
+        # now handle the structural byte itself (unless ws / in token)
+        struct = out & ~ws & ~(state == 99)
+
+        def when(cond, new_state):
+            return cond & struct, new_state
+
+        # '"' opening a string
+        q = struct & (k == C_QUOTE)
+        opening_key = q & ((state == S_OBJ_KEY) | (state == S_OBJ_KEY2))
+        opening_val = q & expects_value
+        bad = bad | (q & ~(opening_key | opening_val))
+        str_is_key = jnp.where(q, opening_key, str_is_key)
+        kprog = jnp.where(opening_key, jnp.int32(0), kprog)
+        in_str = in_str | q
+        # string value capture start (content begins after the quote)
+        s_cap = opening_val & armed & (state == S_OBJ_VALUE)
+        cap_start = jnp.where(s_cap, pos + 1, cap_start)
+        cap_kind = jnp.where(s_cap, jnp.int32(K_STRING), cap_kind)
+        cap_depth = jnp.where(s_cap, depth, cap_depth)
+        capturing = capturing | s_cap
+        armed = armed & ~opening_val
+
+        # '{' / '['
+        open_obj = struct & (k == C_LBRACE)
+        open_arr = struct & (k == C_LBRACK)
+        opener = open_obj | open_arr
+        bad = bad | (opener & ~expects_value)
+        # container value capture start
+        c_cap = opener & armed & (state == S_OBJ_VALUE)
+        cap_start = jnp.where(c_cap, pos, cap_start)
+        cap_kind = jnp.where(c_cap, jnp.where(open_obj,
+                                              jnp.int32(K_OBJECT),
+                                              jnp.int32(K_ARRAY)), cap_kind)
+        cap_depth = jnp.where(c_cap, depth, cap_depth)
+        capturing = capturing | c_cap
+        armed = armed & ~opener
+        # a top-level ARRAY: Spark's name step maps over its elements —
+        # host semantics, out of the device subset
+        unconf = unconf | (open_arr & (c.state == S_START))
+        depth = jnp.where(opener, depth + 1, depth)
+        unconf = unconf | (opener & (depth > MAX_DEPTH))
+        sel = jnp.int32(1) << jnp.clip(depth, 0, 31)
+        arrmask = jnp.where(open_arr, arrmask | sel,
+                            jnp.where(open_obj, arrmask & ~sel, arrmask))
+        state = jnp.where(open_obj, jnp.int32(S_OBJ_KEY),
+                          jnp.where(open_arr, jnp.int32(S_ARR_VALUE), state))
+
+        # '}' / ']'
+        close_obj = struct & (k == C_RBRACE)
+        close_arr = struct & (k == C_RBRACK)
+        closer = close_obj | close_arr
+        in_arr = (arrmask >> jnp.clip(depth, 0, 31)) & 1
+        ok_close_obj = close_obj & (in_arr == 0) & (depth > 0) \
+            & ((state == S_OBJ_AFTER) | (state == S_OBJ_KEY))
+        ok_close_arr = close_arr & (in_arr == 1) & (depth > 0) \
+            & ((state == S_ARR_AFTER) | (state == S_ARR_VALUE))
+        # S_OBJ_KEY2 / S_ARR_VALUE2 (after a comma) do NOT admit a closer:
+        # that's the trailing-comma malformation
+        bad = bad | (closer & ~(ok_close_obj | ok_close_arr))
+        # a closing bracket ending the captured container value
+        cont_end = closer & capturing & (depth == cap_depth + 1) \
+            & ((cap_kind == K_OBJECT) | (cap_kind == K_ARRAY))
+        cap_len = jnp.where(cont_end, pos + 1 - cap_start, cap_len)
+        captured = captured | cont_end
+        capturing = capturing & ~cont_end
+        depth = jnp.where(closer, jnp.maximum(depth - 1, 0), depth)
+        state = jnp.where(closer,
+                          _after_value_state(depth, arrmask, jnp), state)
+
+        # ',' and ':'
+        comma = struct & (k == C_COMMA)
+        in_arr2 = (arrmask >> jnp.clip(depth, 0, 31)) & 1
+        ok_comma = comma & (((state == S_OBJ_AFTER) & (in_arr2 == 0))
+                            | ((state == S_ARR_AFTER) & (in_arr2 == 1)))
+        bad = bad | (comma & ~ok_comma)
+        state = jnp.where(comma & (in_arr2 == 0), jnp.int32(S_OBJ_KEY2),
+                          jnp.where(comma, jnp.int32(S_ARR_VALUE2), state))
+        colon = struct & (k == C_COLON)
+        bad = bad | (colon & ~(state == S_OBJ_COLON))
+        state = jnp.where(colon, jnp.int32(S_OBJ_VALUE), state)
+
+        # any other byte outside strings/tokens is structural garbage
+        bad = bad | (struct & (k == C_OTHER))
+        bad = bad | (struct & (k == C_BSLASH))
+        # ws after DONE is fine; anything else after DONE is garbage
+        bad = bad | (out & (c.state == S_DONE) & ~ws)
+
+        return C(state, depth, arrmask, in_str, str_is_key, kprog, armed,
+                 tok_state, in_tok, cap_start, cap_len, cap_kind, cap_tok,
+                 captured, cap_depth, capturing, dup, bad, unconf)
+
+    final = jax.lax.fori_loop(0, max_len, body, init) if nbytes else init
+
+    # end-of-row resolution: a trailing primitive token ends the document
+    tok_tail = (final.state == 99)
+    tail_ok = tok_tail & tok_acc[jnp.clip(final.tok_state, 0, 31)] \
+        & (final.depth == 0)
+    bad = final.bad
+    unconf_extra = tok_tail & ~tail_ok
+    ends = offsets[1:].astype(jnp.int32)
+    tail_prim = tail_ok & final.capturing & (final.cap_kind == K_PRIMITIVE)
+    cap_len = jnp.where(tail_prim, ends - final.cap_start, final.cap_len)
+    cap_tok = jnp.where(tail_prim, final.tok_state, final.cap_tok)
+    captured = final.captured | tail_prim
+    nonempty = lens > 0
+    done = ((final.state == S_DONE) & (final.depth == 0)) | tail_ok
+    valid_doc = (nonempty & done & ~bad & ~final.in_str
+                 & ~(final.capturing & ~tail_prim))
+    confident = ~final.unconf & ~final.dup & ~unconf_extra
+    return JsonSpans(final.cap_start, cap_len, final.cap_kind, cap_tok,
+                     captured, valid_doc, confident)
+
+
+def _after_value_state(depth, arrmask, jnp):
+    """State to resume after a value completes at `depth`."""
+    in_arr = (arrmask >> jnp.clip(depth, 0, 31)) & 1
+    return jnp.where(depth == 0, jnp.int32(S_DONE),
+                     jnp.where(in_arr == 1, jnp.int32(S_ARR_AFTER),
+                               jnp.int32(S_OBJ_AFTER)))

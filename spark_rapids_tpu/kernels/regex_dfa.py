@@ -104,6 +104,8 @@ class _Parser:
         self.anchored_start = False
         self.anchored_end = False
         self.top_level_alt = False
+        self.has_alternation = False  # any '|' at any depth
+        self.has_lazy = False         # any lazy quantifier marker
 
     def parse(self) -> _Node:
         if self.p.startswith(b"^"):
@@ -128,6 +130,7 @@ class _Parser:
         parts = [self._concat(top)]
         while self._peek() == 0x7C:  # '|'
             self.i += 1
+            self.has_alternation = True
             if top:
                 self.top_level_alt = True
             parts.append(self._concat(top))
@@ -170,9 +173,11 @@ class _Parser:
                 node = self._bounded(node)
             else:
                 break
-            # lazy marker: greedy==lazy for boolean acceptance
+            # lazy marker: greedy==lazy for boolean acceptance (span-based
+            # consumers must check has_lazy and reject)
             if self._peek() == 0x3F:
                 self.i += 1
+                self.has_lazy = True
             if self._peek() == 0x2B:  # possessive
                 raise RegexReject("possessive quantifier")
         return node
@@ -505,3 +510,180 @@ def rlike_device(data, offsets, num_rows: int, dfa: DFA, max_len: int):
 
     final = jax.lax.fori_loop(0, max_len, body, state0)
     return accepting[final]
+
+
+# --- span matching (regexp_replace / regexp_extract) ------------------------
+
+MAX_DEVICE_SPAN_ROW_BYTES = 512  # span walk is O(nbytes · max_row_len)
+
+
+class ExactDFA(DFA):
+    """DFA for exact-at-position matching: no find loops, plus a dead state
+    and the shortest accepted length (for output-capacity bounds)."""
+
+    def __init__(self, base: DFA, dead: int, min_len: int):
+        super().__init__(base.table, base.byte_class, base.accepting,
+                         base.start, base.pattern, base.ascii_atoms)
+        self.dead = dead
+        self.min_len = min_len
+
+
+@functools.lru_cache(maxsize=256)
+def compile_exact_dfa(pattern: str) -> Optional["ExactDFA"]:
+    """Compile for SPAN matching (longest match starting at a position), or
+    None when outside the subset. Rejections beyond compile_dfa's:
+      * '|' anywhere and lazy quantifiers: Java's backtracking engine picks
+        the first-alternative / shortest span, not the longest the DFA
+        computes (greedy-only concat/class/quantifier patterns ARE
+        leftmost-longest, which is what Java picks for them);
+      * anchors: find-with-spans over '^'/'$' is a different machine;
+      * nullable patterns: Java's empty-match advance rules
+        (replaceAll("a*",..) emitting between every char) are out of scope.
+    """
+    try:
+        parser = _Parser(pattern)
+        ast = parser.parse()
+        if parser.anchored_start or parser.anchored_end:
+            raise RegexReject("anchored pattern for span matching")
+        if parser.has_alternation:
+            raise RegexReject("alternation: greedy-first != longest")
+        if parser.has_lazy:
+            raise RegexReject("lazy quantifier span")
+        if ast.count() > MAX_EXPANSION:
+            raise RegexReject("pattern too large")
+        nfa = _NFA()
+        start = nfa.new_state()
+        accept = nfa.new_state()
+        nfa.add(ast, start, accept)
+        ascii_atoms = all(max(s, default=0) < 0x80
+                          for row in nfa.trans for (s, _) in row)
+
+        all_sets = [s for row in nfa.trans for (s, _) in row] or [_ALL]
+        byte_class = _byte_classes(all_sets)
+        n_classes = int(byte_class.max()) + 1
+        reps = [int(np.argmax(byte_class == c)) for c in range(n_classes)]
+
+        d0 = nfa.eclose(frozenset((start,)))
+        if accept in d0:
+            raise RegexReject("nullable pattern (matches empty)")
+        states: List[FrozenSet[int]] = [d0]
+        ids: Dict[FrozenSet[int], int] = {d0: 0}
+        rows: List[List[int]] = []
+        i = 0
+        while i < len(states):
+            cur = states[i]
+            row = []
+            for rep in reps:
+                nxt = set()
+                for s in cur:
+                    for bs, t in nfa.trans[s]:
+                        if rep in bs:
+                            nxt.add(t)
+                closed = nfa.eclose(frozenset(nxt))
+                if closed not in ids:
+                    if len(states) >= MAX_DFA_STATES:
+                        raise RegexReject("DFA too large")
+                    ids[closed] = len(states)
+                    states.append(closed)
+                row.append(ids[closed])
+            rows.append(row)
+            i += 1
+        table = np.asarray(rows, np.int32)
+        accepting = np.asarray([accept in st for st in states], bool)
+        dead = ids.get(frozenset())
+        if dead is None:  # make an explicit dead state
+            dead = len(states)
+            table = np.vstack([table, np.full((1, n_classes), dead,
+                                              np.int32)])
+            accepting = np.append(accepting, False)
+        # shortest accepted length: BFS over the DFA
+        from collections import deque
+        dist = {0: 0}
+        dq = deque([0])
+        min_len = None
+        while dq:
+            s = dq.popleft()
+            if accepting[s]:
+                min_len = dist[s]
+                break
+            for t in table[s]:
+                t = int(t)
+                if t not in dist:
+                    dist[t] = dist[s] + 1
+                    dq.append(t)
+        if not min_len:  # unreachable accept or nullable: host
+            raise RegexReject("no reachable non-empty match")
+        base = DFA(table, byte_class, accepting, 0, pattern, ascii_atoms)
+        return ExactDFA(base, dead, min_len)
+    except RegexReject:
+        return None
+
+
+def match_lengths_device(data, offsets, dfa: "ExactDFA", max_len: int):
+    """int32[nbytes]: longest match length starting at each byte position
+    (0 = no match there). Diagonal DFA walk: every byte position is a lane;
+    step t feeds lane p the byte at p+t, masked at its row end."""
+    import jax
+    import jax.numpy as jnp
+
+    from .strings import byte_rows
+    nbytes = int(data.shape[0])
+    if nbytes == 0:
+        return jnp.zeros((0,), jnp.int32)
+    rows = byte_rows(offsets, nbytes)
+    rowend = jnp.take(offsets, rows + 1).astype(jnp.int32)
+    table = jnp.asarray(dfa.table)
+    cls = jnp.asarray(dfa.byte_class)
+    accepting = jnp.asarray(dfa.accepting)
+    dead = jnp.int32(dfa.dead)
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+
+    def body(t, carry):
+        state, mlen = carry
+        idx = pos + t
+        ok = idx < rowend
+        b = data[jnp.clip(idx, 0, nbytes - 1)].astype(jnp.int32)
+        nxt = table[state, cls[b]]
+        state = jnp.where(ok, nxt, dead)
+        mlen = jnp.where(accepting[state], t + 1, mlen)
+        return state, mlen
+
+    _, mlen = jax.lax.fori_loop(
+        0, max_len, body,
+        (jnp.full((nbytes,), dfa.start, jnp.int32),
+         jnp.zeros((nbytes,), jnp.int32)))
+    return mlen
+
+
+def select_leftmost_nonoverlapping(mlen, offsets, max_row_len: int):
+    """bool[nbytes]: Java replaceAll's match selection — scan each row left
+    to right, take a match when its start is past the previous taken match's
+    end. The scan runs over the row-offset axis (≤ max_row_len steps) with a
+    per-ROW carry, so rows are processed in parallel."""
+    import jax
+    import jax.numpy as jnp
+
+    nbytes = int(mlen.shape[0])
+    n = int(offsets.shape[0]) - 1
+    if nbytes == 0 or n == 0:
+        return jnp.zeros((nbytes,), bool)
+    starts = offsets[:-1].astype(jnp.int32)
+    ends = offsets[1:].astype(jnp.int32)
+
+    def step(allowed, o):
+        j = starts + o
+        ok = j < ends
+        m = mlen[jnp.clip(j, 0, nbytes - 1)]
+        take = ok & (m > 0) & (j >= allowed)
+        allowed = jnp.where(take, j + m, allowed)
+        return allowed, take
+
+    _, takes = jax.lax.scan(step, starts,
+                            jnp.arange(max_row_len, dtype=jnp.int32))
+    # takes: [max_row_len, n] → flat bool[nbytes]
+    grid = starts[None, :] + jnp.arange(max_row_len,
+                                        dtype=jnp.int32)[:, None]
+    ok = grid < ends[None, :]
+    out = jnp.zeros((nbytes + 1,), bool)
+    out = out.at[jnp.where(ok, grid, nbytes)].set(takes, mode="drop")
+    return out[:nbytes]

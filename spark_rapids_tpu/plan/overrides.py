@@ -507,7 +507,8 @@ class TpuOverrides:
         converted = meta.convert_if_needed()
         final = TpuTransitionOverrides.apply(converted, conf)
         from ..execs.compiled import compile_agg_stages
-        return compile_agg_stages(final, conf)
+        from ..execs.compiled_join import compile_join_agg_stages
+        return compile_agg_stages(compile_join_agg_stages(final, conf), conf)
 
     @staticmethod
     def explain_plan(plan: PhysicalPlan, conf: RapidsConf) -> str:

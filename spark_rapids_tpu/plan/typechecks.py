@@ -168,10 +168,15 @@ def _register_builtin_exprs() -> None:
                   "device (kernels/regex_dfa.py); out-of-subset patterns "
                   "fall back to the host engine",
                   incompat="out-of-subset patterns run on host")
-    register_expr(RX.RegexpReplace, TypeSigs.STRING, "regex replace",
-                  host_assisted=True)
-    register_expr(RX.RegexpExtract, TypeSigs.STRING, "regex extract",
-                  host_assisted=True)
+    register_expr(RX.RegexpReplace, TypeSigs.STRING,
+                  "regex replace: DFA span matching + device byte assembly "
+                  "(kernels/regex_dfa.py); out-of-subset patterns / group "
+                  "refs fall back to the host engine",
+                  incompat="out-of-subset patterns run on host")
+    register_expr(RX.RegexpExtract, TypeSigs.STRING,
+                  "regex extract: group 0 via device DFA span matching; "
+                  "capture groups on the host engine",
+                  incompat="capture groups run on host")
     register_expr(RX.Like, TypeSigs.BOOLEAN,
                   "SQL LIKE (device segment matcher)",
                   incompat="non-ASCII handled via host path")
@@ -360,7 +365,10 @@ def _register_builtin_exprs() -> None:
 
     from ..expressions import json as J
     register_expr(J.GetJsonObject, TypeSigs.STRING,
-                  "get_json_object (JSONPath subset)", host_assisted=True)
+                  "get_json_object: single-name paths via the validating "
+                  "device JSON scan (kernels/json_scan.py) with per-row "
+                  "host fallback; multi-step paths on the host engine",
+                  incompat="multi-step paths run on host")
     register_expr(J.JsonToStructs, TypeSigs.nested_common,
                   "from_json (PERMISSIVE)", host_assisted=True)
     register_expr(J.StructsToJson, TypeSigs.STRING, "to_json",

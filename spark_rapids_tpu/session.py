@@ -306,6 +306,35 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self._plan), self.session)
 
+    def distinct(self) -> "DataFrame":
+        """SELECT DISTINCT — lowered to a keys-only hash aggregate (Spark
+        ReplaceDeduplicateWithAggregate; reference GpuHashAggregateExec)."""
+        keys = list(self._plan.output)
+        return DataFrame(L.Aggregate(keys, [], self._plan), self.session)
+
+    def dropDuplicates(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        """Deduplicate on `subset` (default: all columns), keeping the first
+        row per key (Spark Dataset.dropDuplicates via first() aggregates)."""
+        if not subset:
+            return self.distinct()
+        from .expressions.aggregates import First
+        from .expressions.base import Alias
+        keys = [self._plan.resolve_name(c) for c in subset]
+        key_ids = {k.expr_id for k in keys}
+        rest = [a for a in self._plan.output if a.expr_id not in key_ids]
+        aggs = [Alias(First(a, ignore_nulls=False), a.name) for a in rest]
+        node = L.Aggregate(keys, aggs, self._plan)
+        # restore original column order by expr id (names may be duplicated
+        # in join outputs, so a name-based select would be ambiguous)
+        node_out = node.output
+        by_orig = {}
+        for out_attr, orig in zip(node_out[:len(keys)], keys):
+            by_orig[orig.expr_id] = out_attr
+        for out_attr, orig in zip(node_out[len(keys):], rest):
+            by_orig[orig.expr_id] = out_attr
+        ordered = [by_orig[a.expr_id] for a in self._plan.output]
+        return DataFrame(L.Project(ordered, node), self.session)
+
     def sample(self, withReplacement=None, fraction=None, seed=None
                ) -> "DataFrame":
         """pyspark-style sample: sample(fraction), sample(fraction, seed),
@@ -407,19 +436,22 @@ class DataFrame:
         if isinstance(on, str):
             on = [on]
         if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
-            lk = [left.resolve_name(c) for c in on]
-            rk = [right.resolve_name(c) for c in on]
+            lk0 = [left.resolve_name(c) for c in on]
+            rk0 = [right.resolve_name(c) for c in on]
+            lk, rk = _coerce_join_keys(lk0, rk0)
             node = L.Join(left, right, how, lk, rk)
             df = DataFrame(node, self.session)
             # pyspark drops the duplicate USING columns from the right side
+            # (dedup against the raw attrs — coercion may wrap rk in Casts)
             if node.join_type not in ("leftsemi", "semi", "leftanti", "anti"):
                 keep = [a for a in node.output
-                        if not any(a.expr_id == r.expr_id for r in rk)]
+                        if not any(a.expr_id == r.expr_id for r in rk0)]
                 return DataFrame(L.Project(keep, node), self.session)
             return df
         # join on a Column condition: extract equi-keys when possible
         cond = _expr(on)
         lk, rk, residual = _extract_equi_keys(cond, left, right)
+        lk, rk = _coerce_join_keys(lk, rk)
         node = L.Join(left, right, how, lk, rk, residual)
         return DataFrame(node, self.session)
 
@@ -642,6 +674,52 @@ def _project_with_windows(exprs, df: "DataFrame") -> "DataFrame":
 
     new_exprs = [replace(e) for e in exprs]
     return DataFrame(L.Project(new_exprs, node), df.session)
+
+
+def _coerce_join_keys(lk: List[Expression], rk: List[Expression]):
+    """Widen mismatched equi-join key types to a common type (Spark's
+    analyzer findWiderTypeForTwo). Without this, the two co-partitioned
+    exchange sides hash DIFFERENT byte widths (murmur3 hashes int32 and
+    int64 differently, by Spark spec) and silently route matching keys to
+    different partitions — an int32 FK ⋈ int64 PK join then drops ~(1-1/N)
+    of its matches."""
+    from .expressions.cast import Cast
+    from .types import (ByteType, DecimalType, DoubleT, DoubleType,
+                        FloatType, IntegerType, LongType, ShortType)
+    order = {ByteType: 0, ShortType: 1, IntegerType: 2, LongType: 3,
+             FloatType: 4, DoubleType: 5}
+    out_l, out_r = [], []
+    for a, b in zip(lk, rk):
+        ta, tb = a.dtype, b.dtype
+        if isinstance(ta, DecimalType) or isinstance(tb, DecimalType):
+            # decimal keys: only exact precision/scale matches hash alike
+            if repr(ta) != repr(tb):
+                raise ValueError(
+                    f"join key type mismatch {ta} vs {tb}: cast one side "
+                    "explicitly (silently hashing different decimal layouts "
+                    "would mis-route rows across partitions)")
+            out_l.append(a)
+            out_r.append(b)
+            continue
+        if type(ta) is type(tb):
+            out_l.append(a)
+            out_r.append(b)
+            continue
+        ra, rb = order.get(type(ta)), order.get(type(tb))
+        if ra is None or rb is None:
+            # no known widening: equality would need engine-specific
+            # casts AND the two sides would hash different layouts — fail
+            # loudly (Spark's analyzer would insert a cast or reject too)
+            raise ValueError(
+                f"join key type mismatch {ta} vs {tb}: cast one side "
+                "explicitly")
+        if (ra <= 3) != (rb <= 3):
+            common = DoubleT  # integral vs fractional → double
+        else:
+            common = ta if ra >= rb else tb
+        out_l.append(a if type(ta) is type(common) else Cast(a, common))
+        out_r.append(b if type(tb) is type(common) else Cast(b, common))
+    return out_l, out_r
 
 
 def _extract_equi_keys(cond: Expression, left, right):

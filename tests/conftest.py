@@ -18,6 +18,32 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+if os.environ.get("SRT_LEAK_PER_TEST"):
+    # leak-hunting mode: capture creation stacks and attribute each leaked
+    # resource to the test that created it (enable with SRT_LEAK_PER_TEST=1)
+    from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+    MemoryCleaner.get().set_debug(True)
+
+    @pytest.fixture(autouse=True)
+    def _leak_per_test(request):
+        cleaner = MemoryCleaner.get()
+        cleaner.set_debug(True)
+        before = {r.token for r in cleaner.live_resources()}
+        yield
+        after = MemoryCleaner.get()
+        if after is not cleaner:  # a test reset the singleton
+            after.set_debug(True)
+            return
+        new = [r for r in cleaner.live_resources() if r.token not in before]
+        if new:
+            import sys
+            print(f"\n[LEAK] {request.node.nodeid}: "
+                  f"{len(new)} new live resources", file=sys.stderr)
+            for r in new:
+                print(f"  {r.kind} (token {r.token})\n{r.stack or ''}",
+                      file=sys.stderr)
+
+
 @pytest.fixture()
 def session():
     from spark_rapids_tpu.session import TpuSession

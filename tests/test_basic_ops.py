@@ -185,3 +185,34 @@ def test_tagging_fallback_reports_reason():
     assert "ProjectExec" in reasons and "disabled" in reasons
     rows = fn(s).collect()
     assert [r["x"] for r in rows] == list(range(1, 11))
+
+
+def test_drop_duplicates_subset_and_order():
+    """dropDuplicates keeps first row per key, restores column order by
+    attribute id (names can be duplicated in join outputs)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.session import TpuSession
+    for en in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.enabled": en})
+        df = s.createDataFrame(pa.table({
+            "k": [1, 1, 2, 2, 3], "v": ["a", "b", "c", "d", "e"],
+            "w": [10, 11, 12, 13, 14]}))
+        out = df.dropDuplicates(["k"]).to_arrow()
+        assert out.column_names == ["k", "v", "w"]
+        rows = sorted(map(tuple, (r.values() for r in out.to_pylist())))
+        assert rows == [(1, "a", 10), (2, "c", 12), (3, "e", 14)]
+
+
+def test_join_key_type_mismatch_raises():
+    """Uncoercible join-key type pairs must fail loudly, not silently
+    mis-route rows across hash partitions (r4 review finding)."""
+    import pyarrow as pa
+    import pytest as _pytest
+
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({})
+    l = s.createDataFrame(pa.table({"k": ["1", "2"]}))
+    r = s.createDataFrame(pa.table({"k2": [1, 2]}))
+    with _pytest.raises(ValueError, match="join key type mismatch"):
+        l.join(r, on=l["k"] == r["k2"])

@@ -94,6 +94,33 @@ def test_stage_nan_min_max_semantics():
     assert rows[3]["mn"] == 5.0 and rows[3]["mx"] == 5.0
 
 
+def test_stage_inf_sum_carry_merge_is_nan_correct(recwarn):
+    """A group holding +inf in one batch and -inf in another must sum to NaN
+    on both engines (Java float semantics), and the carry merge must do it
+    without emitting a RuntimeWarning (r3 verdict weak #6)."""
+    import warnings
+    t = pa.table({
+        "k": pa.array(["a", "a", "b", "b", "c"] * 2),
+        "v": pa.array([float("inf"), 1.0, 2.0, 3.0, 5.0,
+                       float("-inf"), 4.0, 2.0, 3.0, 5.0]),
+    })
+
+    def q(s):
+        return (s.createDataFrame(t, num_partitions=2)
+                .groupBy("k")
+                .agg(F.sum(F.col("v")).alias("sv"),
+                     F.avg(F.col("v")).alias("av")))
+
+    df = q(TpuSession({}))
+    assert _uses_stage(df)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        rows = {r["k"]: r["sv"] for r in df.collect()}
+    assert math.isnan(rows["a"])  # inf + -inf
+    assert rows["b"] == 10.0 and rows["c"] == 10.0
+    _compare(q)
+
+
 def test_stage_global_agg():
     rng = np.random.default_rng(3)
     t = pa.table({"x": rng.normal(size=4000), "f": rng.random(4000)})

@@ -144,3 +144,84 @@ def test_parse_ddl_struct_form():
     from spark_rapids_tpu.types import parse_ddl
     s = parse_ddl("struct<a: int, b: string>")
     assert [f.name for f in s.fields] == ["a", "b"]
+
+
+def test_get_json_object_device_scan_parity():
+    """The validating device JSON scan must agree with the host engine on
+    valid, malformed, duplicate-key, escaped, and nested docs — and must
+    actually fire (r3 verdict missing #3)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.expressions.base import AttributeReference, Literal
+    from spark_rapids_tpu.expressions.json import (GetJsonObject,
+                                                   device_json_get,
+                                                   get_json_object_impl,
+                                                   parse_json_path)
+
+    docs = [
+        '{"a":"x","b":1}', '{"b":2,"a":"hello world"}', '{"a":123}',
+        '{"a":true,"z":null}', '{"a":null}', '{"a":{"n":1},"b":[1,2]}',
+        '{"a":[1,{"a":"inner"}]}', '{"nested":{"a":"no"},"a":"yes"}',
+        '{"b":"x"}', '{"a":""}', '[{"a":7}]', '123', '{"a":1,}',
+        '{"a" 1}', '{"a":01}', '{"a":tru}', '{"a":"x"',
+        '  {"a":  "sp"  }  ', '{"aa":"wrong","a":"right"}',
+        '{"a":"dup1","a":"dup2"}', '{"a":1.5e3}', '{"a":-42}',
+        'not json at all', '{"a":"esc\\"q"}', None, '{"a":1.50}',
+        '{"a":[1,2,3]}', '{"a":false}', '{}', '{"a":{}}',
+    ]
+    arr = pa.array(docs, pa.string())
+    col = TpuColumnVector.from_arrow(arr)
+    batch = TpuColumnarBatch([col], len(docs), names=["s"])
+    ref = AttributeReference("s", col.dtype, ordinal=0)
+    steps = parse_json_path("$.a")
+    assert device_json_get(col, batch, steps) is not None, \
+        "device JSON scan must fire"
+    e = GetJsonObject(ref, Literal("$.a"))
+    got = e.eval_tpu(batch).to_arrow().to_pylist()[:len(docs)]
+    want = [get_json_object_impl(v, steps) for v in docs]
+    assert got == want, [x for x in zip(docs, got, want) if x[1] != x[2]]
+
+
+def test_get_json_object_device_fuzz():
+    """Random generated JSON (incl. corrupted variants) device-vs-host."""
+    import json as js
+    import random
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.expressions.base import AttributeReference, Literal
+    from spark_rapids_tpu.expressions.json import (GetJsonObject,
+                                                   get_json_object_impl,
+                                                   parse_json_path)
+    rnd = random.Random(3)
+
+    def rand_value(d=0):
+        r = rnd.random()
+        if d > 2 or r < 0.3:
+            return rnd.choice(["s", "t x", 7, -3, 2.5, True, False, None])
+        if r < 0.6:
+            return {rnd.choice("abc"): rand_value(d + 1)
+                    for _ in range(rnd.randint(0, 3))}
+        return [rand_value(d + 1) for _ in range(rnd.randint(0, 3))]
+
+    docs = []
+    for _ in range(150):
+        doc = js.dumps({rnd.choice("abq"): rand_value()
+                        for _ in range(rnd.randint(0, 4))})
+        if rnd.random() < 0.25 and len(doc) > 2:  # corrupt it
+            i = rnd.randrange(len(doc))
+            doc = doc[:i] + rnd.choice(',:}x') + doc[i + 1:]
+        docs.append(doc)
+    arr = pa.array(docs, pa.string())
+    col = TpuColumnVector.from_arrow(arr)
+    batch = TpuColumnarBatch([col], len(docs), names=["s"])
+    ref = AttributeReference("s", col.dtype, ordinal=0)
+    steps = parse_json_path("$.a")
+    e = GetJsonObject(ref, Literal("$.a"))
+    got = e.eval_tpu(batch).to_arrow().to_pylist()[:len(docs)]
+    want = [get_json_object_impl(v, steps) for v in docs]
+    assert got == want, [x for x in zip(docs, got, want) if x[1] != x[2]][:5]

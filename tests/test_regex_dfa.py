@@ -144,3 +144,91 @@ def test_escaped_range_start_in_class():
     assert out is not None
     # \x41-\x45 is the range A-E, not the literals {A, -, E}
     assert out.to_arrow().to_pylist()[:5] == [True, False, False, True, True]
+
+
+# --- span matching: device regexp_replace / regexp_extract ------------------
+
+REPLACE_PATTERNS = [
+    (r"\d+", "#"), ("l+", "L"), (r"\s+", "_"), ("x", "yy"),
+    (r"[0-9]{2,3}", "<n>"), (r"[aeiou]", ""), ("ab", "ba"),
+    (r"\w\d", "*"), ("h.t", "HAT"), (r"[a-c]{2}", "Z"),
+]
+
+SPAN_SUBJECTS = ["", "a", "abc", "xabcy", "123", "a1b2c3", "hello world",
+                 "hat hit hot", "ab" * 30, "  spaced  ", "999", "x1x22x333x",
+                 "aaa bbb ccc", "tail123", None, "no match here!"]
+
+
+@pytest.mark.parametrize("pat,repl", REPLACE_PATTERNS)
+def test_device_regexp_replace_matches_python(pat, repl):
+    import re as _re
+
+    from spark_rapids_tpu.expressions.regex import RegexpReplace
+    batch, col, ref = _batch(SPAN_SUBJECTS)
+    e = RegexpReplace(ref, pat, repl)
+    c = e.children[0].eval_tpu(batch)
+    dev = e._device_replace(c, batch)
+    assert dev is not None, f"device path must fire for {pat}"
+    got = dev.to_arrow().to_pylist()[:len(SPAN_SUBJECTS)]
+    want = [None if v is None else _re.sub(pat, repl, v)
+            for v in SPAN_SUBJECTS]
+    assert got == want, (pat, list(zip(SPAN_SUBJECTS, got, want)))
+
+
+@pytest.mark.parametrize("pat", [r"\d+", "l+", r"[a-c]+", "h.t", r"\w{3}"])
+def test_device_regexp_extract_matches_python(pat):
+    import re as _re
+
+    from spark_rapids_tpu.expressions.regex import RegexpExtract
+    batch, col, ref = _batch(SPAN_SUBJECTS)
+    e = RegexpExtract(ref, pat, 0)
+    c = e.children[0].eval_tpu(batch)
+    dev = e._device_extract(c, batch)
+    assert dev is not None, f"device path must fire for {pat}"
+    got = dev.to_arrow().to_pylist()[:len(SPAN_SUBJECTS)]
+
+    def want_of(v):
+        if v is None:
+            return None
+        m = _re.search(pat, v)
+        return m.group(0) if m else ""
+    want = [want_of(v) for v in SPAN_SUBJECTS]
+    assert got == want, (pat, list(zip(SPAN_SUBJECTS, got, want)))
+
+
+def test_span_subset_rejections():
+    """Outside the span subset -> host engine (alternation, lazy, anchors,
+    nullable patterns, group refs in the replacement)."""
+    from spark_rapids_tpu.kernels.regex_dfa import compile_exact_dfa
+    for pat in ["a|b", "a*?b", "^ab", "ab$", "a*", "x?", "(a|b)c"]:
+        assert compile_exact_dfa(pat) is None, pat
+    # group-ref replacement must not take the device path
+    from spark_rapids_tpu.expressions.regex import RegexpReplace
+    batch, col, ref = _batch(["abc"])
+    e = RegexpReplace(ref, "b", "$0x")
+    c = e.children[0].eval_tpu(batch)
+    assert e._device_replace(c, batch) is None
+
+
+def test_device_replace_fuzz_vs_python():
+    """Random short strings over a small alphabet: device replace must agree
+    with python re.sub (which matches Java for this subset) on every row."""
+    import re as _re
+
+    import numpy.random as npr
+    rng = npr.default_rng(7)
+    alpha = "ab1 x"
+    subjects = ["".join(rng.choice(list(alpha), size=rng.integers(0, 12)))
+                for _ in range(200)]
+    from spark_rapids_tpu.expressions.regex import RegexpReplace
+    for pat, repl in [(r"\d", "N"), ("a+", "A"), ("ab", "-"),
+                      (r"[ax]{2}", "!"), (r"\s", ".")]:
+        batch, col, ref = _batch(subjects)
+        e = RegexpReplace(ref, pat, repl)
+        c = e.children[0].eval_tpu(batch)
+        dev = e._device_replace(c, batch)
+        assert dev is not None
+        got = dev.to_arrow().to_pylist()[:len(subjects)]
+        want = [_re.sub(pat, repl, v) for v in subjects]
+        assert got == want, (pat, [x for x in zip(subjects, got, want)
+                                   if x[1] != x[2]][:3])
