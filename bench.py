@@ -96,16 +96,26 @@ def _kernel_q1(n: int) -> dict:
     from spark_rapids_tpu.kernels.q1 import make_example_batch, q1_final
     from spark_rapids_tpu.kernels.q1 import q1_partial
     from spark_rapids_tpu.kernels.q1 import q1_step as q1_step_xla
-    from spark_rapids_tpu.kernels.q1_pallas import q1_partial_pallas
+    from spark_rapids_tpu.kernels.q1_pallas import (q1_partial_pallas,
+                                                    q1_partial_pallas_mxu)
 
     batch, cutoff = make_example_batch(n)
     cutoff = jnp.int32(cutoff)
-    pallas_step = jax.jit(lambda b, c: q1_final(q1_partial_pallas(b, c)))
-    try:
-        _fetch(pallas_step(batch, cutoff))
-        q1_step, partial_fn, kernel = pallas_step, q1_partial_pallas, "pallas"
-    except Exception:  # noqa: BLE001 — backend rejected the pallas lowering
-        q1_step, partial_fn, kernel = q1_step_xla, q1_partial, "xla"
+    # preference order: MXU-contraction pallas (memory-bound roofline) →
+    # VPU pallas (compute-bound at ~36% bw) → XLA einsum
+    candidates = [
+        ("pallas_mxu", q1_partial_pallas_mxu),
+        ("pallas", q1_partial_pallas),
+    ]
+    q1_step, partial_fn, kernel = q1_step_xla, q1_partial, "xla"
+    for name, pfn in candidates:
+        step = jax.jit(lambda b, c, pfn=pfn: q1_final(pfn(b, c)))
+        try:
+            _fetch(step(batch, cutoff))
+            q1_step, partial_fn, kernel = step, pfn, name
+            break
+        except Exception:  # noqa: BLE001 — backend rejected the lowering
+            continue
     _fetch(q1_step(batch, cutoff))
 
     wall = _time_best(lambda: _fetch(q1_step(batch, cutoff)), iters=8)
@@ -133,6 +143,55 @@ def _kernel_q1(n: int) -> dict:
         "device_GBps": round(bytes_per_pass / device_s / 1e9, 1),
         "wall_s": wall,
         "device_s": device_s,
+    }
+
+
+def _kernel_hash_partition(n: int) -> dict:
+    """Second kernel under the roofline lens (VERDICT r3 #3): the device
+    hash partitioner (murmur3 over an int64 key + mod). Bytes/row = 8 read
+    + 4 written partition id = 12; murmur3 of one long is ~25 int-ops, so
+    on the VPU the kernel needs ~2 ops/byte — near the compute/memory
+    roofline knee; the measured fraction tells which side it lands on."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.expressions.base import AttributeReference
+    from spark_rapids_tpu.shuffle.partitioner import hash_partition_ids
+    from spark_rapids_tpu.types import LongT
+    from spark_rapids_tpu.execs.base import TaskContext
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({})
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(rng.integers(0, 1 << 40, n))
+    col = TpuColumnVector(LongT, vals, None, n)
+    batch = TpuColumnarBatch([col], n, names=["k"])
+    keys = [AttributeReference("k", LongT, ordinal=0)]
+    ctx = TaskContext(0, s._rapids_conf())
+
+    totals = {}
+    for K in (8, 40):
+        def chained(data, K=K):
+            def body(i, acc):
+                b = TpuColumnarBatch(
+                    [TpuColumnVector(LongT, data + acc.astype(jnp.int64),
+                                     None, n)], n, names=["k"])
+                pids = hash_partition_ids(b, keys, 16, ctx)
+                # depend on a REDUCTION over all ids: consuming one element
+                # would let XLA slice-sink the whole elementwise chain down
+                # to a single row and time launch overhead instead
+                return acc + (jnp.sum(pids) & 1).astype(jnp.int32)
+            return jax.lax.fori_loop(0, K, body, jnp.int32(0))
+        f = jax.jit(chained)
+        _fetch(f(vals))
+        totals[K] = _time_best(lambda f=f: _fetch(f(vals)), iters=5)
+    device_s = max((totals[40] - totals[8]) / 32, 1e-9)
+    return {
+        "device_ms": round(device_s * 1e3, 3),
+        "device_Mrows_per_s": round(n / device_s / 1e6, 1),
+        "device_GBps": round(12 * n / device_s / 1e9, 2),
     }
 
 
@@ -216,7 +275,11 @@ def _framework_q3(rows: int, partitions: int, compiled: bool = True) -> dict:
     s.conf.set("spark.sql.shuffle.partitions", str(partitions))
     if not compiled:
         s.conf.set("spark.rapids.tpu.join.compiledStage.enabled", "false")
-    tables = tpch.load_tables(s, rows, parts=4)
+    else:
+        # one resident fact batch == one probe program per run (launch
+        # count must not scale with batch segmentation, same as q1)
+        s.conf.set("spark.rapids.sql.batchSizeRows", str(rows))
+    tables = tpch.load_tables(s, rows, parts=1 if compiled else 4)
     if compiled:
         # fact table resident in HBM (upload amortized, like q1): the timed
         # runs measure the join+agg program, not the tunnel re-upload of
@@ -276,6 +339,7 @@ def main() -> None:
     n = 1 << 24  # 16.7M rows
     roofline = _calibrate()
     kern = _kernel_q1(n)
+    hp = _kernel_hash_partition(n)
 
     table = _lineitem_table(n)
     fw = _framework_q1(table)
@@ -306,6 +370,26 @@ def main() -> None:
                 "fraction_of_measured_bw": round(
                     kern["device_GBps"]
                     / roofline["hbm_read_GBps_measured"], 3),
+                "roofline_analysis": (
+                    "the VPU-reduction kernel does 16 groups x 6 measures "
+                    "x 2 flops = 192 flops/element; at its measured rate "
+                    "that saturates the VPU (~1.8 Tflop/s) — it is "
+                    "COMPUTE-bound, which is why it plateaus near 36% of "
+                    "HBM bw. The pallas_mxu variant moves the one-hot "
+                    "contraction onto the MXU (one [16,E]x[E,8] matmul per "
+                    "tile, ~20 VPU flops/element remain), putting the "
+                    "kernel on the memory-bound roofline"),
+            },
+            "kernel_hash_partition": {
+                **hp,
+                "fraction_of_measured_bw": round(
+                    hp["device_GBps"]
+                    / roofline["hbm_read_GBps_measured"], 3),
+                "roofline_analysis": (
+                    "murmur3(long)+mod is ~25 int-ops over 12 B/row "
+                    "(~2 ops/byte), right at the VPU compute/memory knee; "
+                    "the measured fraction shows which side it lands on "
+                    "for this chip"),
             },
             "framework": {
                 "wall_ms": round(fw["sec"] * 1e3, 2),

@@ -17,7 +17,10 @@ echo "ok"
 echo "== compile check =="
 python -m compileall -q spark_rapids_tpu tools benchmarks tests bench.py __graft_entry__.py
 
-echo "== tests =="
-python -m pytest tests/ -x -q
+echo "== tests (+ leak gate) =="
+# SRT_LEAK_GATE makes conftest fail the run when the process-wide
+# MemoryCleaner still tracks live device resources after the last test
+# (reference: shutdown leak logging treated as a bug, Plugin.scala:581-596)
+SRT_LEAK_GATE=1 python -m pytest tests/ -x -q
 
 echo "CI green."

@@ -69,6 +69,9 @@ class TpuColumnarBatch:
                     leaves.append(buf)
             if c.child is not None:
                 collect(c.child)
+            if c.children is not None:
+                for k in c.children:
+                    collect(k)
 
         for c in self.columns:
             collect(c)
@@ -85,10 +88,13 @@ class TpuColumnarBatch:
             if offsets is not None and not isinstance(offsets, np.ndarray):
                 offsets = next(fetched)
             child = localize(c.child) if c.child is not None else None
+            kids = ([localize(k) for k in c.children]
+                    if c.children is not None else None)
             return TpuColumnVector(c.dtype, data, validity, c.num_rows,
                                    offsets=offsets, child=child,
                                    host_data=c.host_data,
-                                   host_capacity=c.host_capacity)
+                                   host_capacity=c.host_capacity,
+                                   children=kids)
 
         arrays = [localize(c).to_arrow() for c in self.columns]
         # from_arrays, not pa.table(dict(...)): names may repeat (e.g. join
@@ -137,6 +143,9 @@ class TpuColumnarBatch:
                     leaves.append(buf)
             if c.child is not None:
                 collect(c.child)
+            if c.children is not None:
+                for k in c.children:
+                    collect(k)
 
         for c in cols:
             collect(c)
@@ -151,10 +160,13 @@ class TpuColumnarBatch:
             if isinstance(offsets, np.ndarray):
                 offsets = next(uploaded)
             child = rebuild(c.child) if c.child is not None else None
+            kids = ([rebuild(k) for k in c.children]
+                    if c.children is not None else None)
             return TpuColumnVector(c.dtype, data, validity, c.num_rows,
                                    offsets=offsets, child=child,
                                    host_data=c.host_data,
-                                   host_capacity=c.host_capacity)
+                                   host_capacity=c.host_capacity,
+                                   children=kids)
 
         cols = [rebuild(c) for c in cols]
         return TpuColumnarBatch(cols, table.num_rows, list(table.column_names))
@@ -187,6 +199,16 @@ def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
                                host_data=col.host_data, host_capacity=capacity)
     if col.capacity > capacity:
         raise ValueError("cannot shrink capacity")
+    if col.children is not None:
+        pad = capacity - col.capacity
+        validity = col.validity
+        if validity is not None:
+            vxp = np if isinstance(validity, np.ndarray) else jnp
+            validity = vxp.concatenate(
+                [validity, vxp.zeros((pad,), vxp.bool_)])
+        return TpuColumnVector(
+            col.dtype, col.data, validity, col.num_rows,
+            children=[_repad(c, capacity) for c in col.children])
     pad = capacity - col.capacity
     # stay in the numpy domain for host-built columns (deferred batch upload)
     xp = np if isinstance(col.data, np.ndarray) else jnp
@@ -222,7 +244,8 @@ def gather(batch: TpuColumnarBatch, indices, out_rows: int,
     # ~100ms dispatch on the tunneled TPU); strings/lists keep the
     # host-assisted per-column path
     fixed = [(i, c) for i, c in enumerate(batch.columns)
-             if c.child is None and c.host_data is None and c.offsets is None]
+             if c.child is None and c.host_data is None
+             and c.offsets is None and c.children is None]
     out_cols: list = [None] * len(batch.columns)
     if fixed:
         datas = [c.data for _, c in fixed]
@@ -262,6 +285,16 @@ def _gather_fixed_cols(datas, valids, idx, in_rows, out_rows):
 
 def _gather_column(col: TpuColumnVector, safe_idx, valid, out_rows: int,
                    cap: int) -> TpuColumnVector:
+    if col.children is not None:
+        # struct gather = per-child gather under the struct validity
+        # (cuDF gathers STRUCT columns child-wise the same way)
+        v = valid
+        if col.validity is not None:
+            v = jnp.take(col.validity, safe_idx, axis=0) & valid
+        kids = [_gather_column(c, safe_idx, valid, out_rows, cap)
+                for c in col.children]
+        return TpuColumnVector(col.dtype, col.data, v, out_rows,
+                               children=kids)
     if col.child is not None or col.host_data is not None:
         return _gather_lists(col, safe_idx, valid, out_rows, cap)
     if col.offsets is not None:
@@ -360,7 +393,8 @@ def concat_batches(batches: List[TpuColumnarBatch]) -> TpuColumnarBatch:
     fixed_ix = [ci for ci in range(batches[0].num_columns)
                 if batches[0].columns[ci].offsets is None
                 and batches[0].columns[ci].host_data is None
-                and batches[0].columns[ci].child is None]
+                and batches[0].columns[ci].child is None
+                and batches[0].columns[ci].children is None]
     if fixed_ix:
         # all fixed-width columns of all batches concatenate in ONE compiled
         # scatter program; row offsets are traced so varying row counts hit

@@ -18,7 +18,7 @@ GpuColumnVector.java:40). Differences driven by XLA's compilation model:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional, Sequence, Union
+from typing import Any, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -59,13 +59,16 @@ def _np_to_jax(arr: np.ndarray):
 
 
 def device_layout_ok(dt: DataType) -> bool:
-    """Whether a type has a device (jax.Array) layout. Maps/structs stay
-    host-side (host_data-backed columns); decimal beyond precision 18 carries
-    as two int64 limbs per row (kernels/decimal128.py, reference
-    spark-rapids-jni DecimalUtils __int128)."""
+    """Whether a type has a device (jax.Array) layout. Structs are device-
+    resident as child-column tuples (cuDF STRUCT ColumnView analogue);
+    maps stay host-side (host_data-backed columns); decimal beyond
+    precision 18 carries as two int64 limbs per row (kernels/decimal128.py,
+    reference spark-rapids-jni DecimalUtils __int128)."""
     from ..types import MapType, StructType
-    if isinstance(dt, (MapType, StructType)):
+    if isinstance(dt, MapType):
         return False
+    if isinstance(dt, StructType):
+        return all(device_layout_ok(f.data_type) for f in dt.fields)
     if isinstance(dt, ArrayType):
         return device_layout_ok(dt.element_type)
     if isinstance(dt, DecimalType):
@@ -90,12 +93,16 @@ class TpuColumnVector:
     #: (a device offsets buffer + a child column) — the same offsets+data shape
     #: strings already use, generalized one level.
     child: Optional["TpuColumnVector"] = None
-    #: map/struct columns (no device layout yet): the column stays host-side as
+    #: map columns (no device layout yet): the column stays host-side as
     #: a pyarrow Array; device `data` is an empty placeholder. Host-assisted
     #: expressions consume it via to_arrow/to_pylist; gathers route through
     #: arrow take. The tagging layer prices these ops as host_assisted.
     host_data: Optional[Any] = None
     host_capacity: int = 0
+    #: struct columns: one device column per field at the same capacity
+    #: (cuDF STRUCT ColumnView: a validity mask over child columns). The
+    #: struct's own `data` is an empty placeholder.
+    children: Optional[List["TpuColumnVector"]] = None
 
     @property
     def capacity(self) -> int:
@@ -103,6 +110,10 @@ class TpuColumnVector:
             return self.host_capacity
         if self.offsets is not None:
             return int(self.offsets.shape[0]) - 1
+        if self.children is not None:
+            return self.children[0].capacity if self.children \
+                else max(int(self.validity.shape[0])
+                         if self.validity is not None else self.num_rows, 1)
         return int(self.data.shape[0])
 
     @property
@@ -122,6 +133,8 @@ class TpuColumnVector:
             n += self.offsets.size * 4
         if self.child is not None:
             n += self.child.device_memory_size()
+        if self.children is not None:
+            n += sum(c.device_memory_size() for c in self.children)
         return int(n)
 
     # ---- host materialization (the D→H boundary) ----
@@ -141,6 +154,22 @@ class TpuColumnVector:
             mask = ~valid
         else:
             mask = None
+        if self.children is not None:
+            from ..types import StructType as _St
+            fields = self.dtype.fields
+            kids = [c.to_arrow() for c in self.children]
+            kids = [k.combine_chunks() if isinstance(k, pa.ChunkedArray)
+                    else k for k in kids]
+            if mask is not None:
+                bitmap = pa.py_buffer(np.packbits(
+                    valid, bitorder="little").tobytes())
+                nulls = int(mask.sum())
+            else:
+                bitmap, nulls = None, 0
+            atype = pa.struct([(f.name, k.type)
+                               for f, k in zip(fields, kids)])
+            return pa.Array.from_buffers(atype, n, [bitmap],
+                                         null_count=nulls, children=kids)
         if isinstance(self.dtype, ArrayType):
             offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
             n_elems = int(offs[-1]) if n else 0
@@ -243,6 +272,24 @@ class TpuColumnVector:
             validity = np.asarray(arr.is_valid())
         else:
             validity = None
+        from ..types import StructType as _St
+        if isinstance(dtype, _St):
+            # struct = validity over per-field child columns (cuDF STRUCT)
+            cap = bucket_capacity(n, bucket)
+            kids = []
+            for i in range(arr.type.num_fields):
+                kid = TpuColumnVector.from_arrow(arr.field(i), bucket=bucket)
+                if kid.capacity != cap:
+                    from .batch import _repad
+                    kid = _repad(kid, cap)
+                kids.append(kid)
+            vmask = None
+            if validity is not None and not validity.all():
+                v = np.zeros(cap, dtype=bool)
+                v[:n] = validity
+                vmask = _np_to_jax(v)
+            return TpuColumnVector(dtype, jnp.zeros((0,), jnp.int8), vmask,
+                                   n, children=kids)
         if isinstance(dtype, ArrayType):
             if pa.types.is_large_list(arr.type):
                 arr = arr.cast(pa.list_(arr.type.value_type))
@@ -333,6 +380,14 @@ class TpuColumnVector:
             pa_arr = pa.array([value] * num_rows, type=t2a(dtype))
             return TpuColumnVector(dtype, jnp.zeros((0,), jnp.int8), None,
                                    num_rows, host_data=pa_arr, host_capacity=cap)
+        from ..types import StructType as _St
+        if isinstance(dtype, _St):
+            import pyarrow as pa
+            from ..types import to_arrow as t2a
+            from .batch import _repad
+            pa_arr = pa.array([value] * num_rows, type=t2a(dtype))
+            col = TpuColumnVector.from_arrow(pa_arr)
+            return _repad(col, cap) if col.capacity < cap else col
         if isinstance(dtype, ArrayType):
             import pyarrow as pa
             from ..types import to_arrow as t2a

@@ -294,6 +294,22 @@ COMPILED_AGG_MAX_GROUPS = _conf("spark.rapids.tpu.agg.compiled.maxGroups").doc(
     "direct-index; beyond this the general sort-based path runs."
 ).integer(4096)
 
+PARQUET_CHUNK_BYTES = _conf(
+    "spark.rapids.sql.reader.chunked.maxDecodeBytes").doc(
+    "PERFILE parquet reads stream row groups in chunks whose compressed "
+    "footprint stays under this many bytes, bounding host decode memory "
+    "(reference chunked reader, GpuParquetScan + "
+    "spark.rapids.sql.reader.chunked). 0 disables chunking."
+).integer(256 << 20)
+
+PARQUET_REBASE_MODE_READ = _conf(
+    "spark.rapids.sql.parquet.datetimeRebaseModeInRead").doc(
+    "Rebase handling for parquet files WITHOUT the Spark legacy-calendar "
+    "footer marker: CORRECTED reads values as proleptic Gregorian (modern "
+    "writers), LEGACY forces the hybrid Julian->proleptic rebase. Marked "
+    "files always rebase (reference datetimeRebaseUtils.scala)."
+).string("CORRECTED")
+
 COMPILED_JOIN_ENABLED = _conf(
     "spark.rapids.tpu.join.compiledStage.enabled").doc(
     "Fuse eligible star-shaped join pipelines "
@@ -529,6 +545,81 @@ TEST_RETRY_OOM_INJECTION = _conf("spark.rapids.memory.tpu.state.debug.retryOomIn
     "Testing only: inject TpuRetryOOM/TpuSplitAndRetryOOM at allocation points "
     "(reference RmmSpark.forceRetryOOM test hooks)."
 ).internal().string(None)
+
+
+# ---------------------------------------------------------------------------
+# Device-subset sizing knobs (kernels consult these through the session's
+# apply_kernel_tunables at session construction)
+# ---------------------------------------------------------------------------
+
+REGEX_MAX_DEVICE_ROW_BYTES = _conf(
+    "spark.rapids.sql.regexp.maxDeviceRowBytes").doc(
+    "Longest string row the device regex DFA walks (rlike); longer rows "
+    "route the batch to the host engine (reference "
+    "spark.rapids.sql.regexp.enabled + RegexComplexityEstimator sizing)."
+).integer(4096)
+
+REGEX_MAX_SPAN_ROW_BYTES = _conf(
+    "spark.rapids.sql.regexp.maxSpanRowBytes").doc(
+    "Longest string row for device regexp_replace/extract span matching "
+    "(the walk is O(bytes x row_len))."
+).integer(512)
+
+JSON_DEVICE_SCAN_MAX_ROW_BYTES = _conf(
+    "spark.rapids.sql.json.maxDeviceRowBytes").doc(
+    "Longest JSON document the device get_json_object scan processes; "
+    "longer rows route to the host engine."
+).integer(4096)
+
+UDF_WORKER_TIMEOUT_SECONDS = _conf(
+    "spark.rapids.sql.python.workerTimeoutSeconds").doc(
+    "Seconds a python UDF may run in its worker before the worker is "
+    "killed and replaced (reference python worker watchdog)."
+).integer(120)
+
+SHUFFLE_HEARTBEAT_TIMEOUT_SECONDS = _conf(
+    "spark.rapids.shuffle.heartbeat.timeoutSeconds").doc(
+    "Peer liveness window for the shuffle heartbeat registry; peers silent "
+    "longer than this are reported lost and their map outputs invalidated "
+    "(reference RapidsShuffleHeartbeatManager timeout)."
+).integer(30)
+
+CAST_FLOAT_TO_STRING_ENABLED = _conf(
+    "spark.rapids.sql.castFloatToString.enabled").doc(
+    "Enable float->string casts on TPU (Java-exact shortest-round-trip "
+    "formatting; reference castFloatToString incompatibility switch)."
+).boolean(True)
+
+CAST_STRING_TO_FLOAT_ENABLED = _conf(
+    "spark.rapids.sql.castStringToFloat.enabled").doc(
+    "Enable string->float casts on TPU (reference castStringToFloat "
+    "incompatibility switch)."
+).boolean(True)
+
+CAST_STRING_TO_TIMESTAMP_ENABLED = _conf(
+    "spark.rapids.sql.castStringToTimestamp.enabled").doc(
+    "Enable string->timestamp casts on TPU (reference "
+    "castStringToTimestamp incompatibility switch)."
+).boolean(True)
+
+VARIABLE_FLOAT_AGG_ENABLED = _conf(
+    "spark.rapids.sql.variableFloatAgg.enabled").doc(
+    "Allow float aggregations whose result can vary run to run with "
+    "parallelism (sum/avg ordering; reference variableFloatAgg switch). "
+    "When false, float sum/avg aggregations fall back to the CPU."
+).boolean(True)
+
+BUCKETING_WRITE_ENABLED = _conf(
+    "spark.rapids.sql.format.write.bucketing.enabled").doc(
+    "Enable bucketBy writes (per-bucket files with a bucket-spec sidecar; "
+    "reference GpuFileFormatWriter bucketing)."
+).boolean(True)
+
+BUCKETING_READ_PRUNE_ENABLED = _conf(
+    "spark.rapids.sql.format.read.bucketPruning.enabled").doc(
+    "Prune bucketed files by equality filters on the bucket column at scan "
+    "time (reference GpuFileSourceScanExec bucket pruning)."
+).boolean(True)
 
 
 class RapidsConf:

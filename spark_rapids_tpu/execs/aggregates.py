@@ -199,6 +199,19 @@ def _dedup_values(items):
     return uniq
 
 
+def _cast_percentile_value(v: float, fn):
+    """t-digest quantiles interpolate in doubles; approx_percentile answers
+    in the INPUT type like Spark (round-half-even back to integral carriers —
+    decimals carry scaled ints, so they round too)."""
+    from ..types import DecimalType, FloatType, DoubleType
+    if isinstance(fn.children[0].dtype, (FloatType, DoubleType)):
+        return float(v)
+    import math as _math
+    if v != v or _math.isinf(v):
+        return float(v)
+    return int(np.round(v))
+
+
 def _custom_cpu_agg(fn, cols_py: List[list], rows: List[int]):
     """One group's value for a python-grouped aggregate (oracle path)."""
     import math
@@ -206,6 +219,14 @@ def _custom_cpu_agg(fn, cols_py: List[list], rows: List[int]):
     if op == "bloom_filter":
         vals = [v for v in (cols_py[0][r] for r in rows) if v is not None]
         return fn.build(np.asarray(vals, np.int64)) if vals else None
+    if op in ("first", "last"):
+        ignore_nulls = getattr(fn, "ignore_nulls", False)
+        seq = rows if op == "first" else list(reversed(rows))
+        for r in seq:
+            v = cols_py[0][r]
+            if v is not None or not ignore_nulls:
+                return v
+        return None
     if op in ("collect_list", "collect_set"):
         items = [v for v in (cols_py[0][r] for r in rows) if v is not None]
         if op == "collect_list":
@@ -227,6 +248,38 @@ def _custom_cpu_agg(fn, cols_py: List[list], rows: List[int]):
             else:
                 vals.append(v)
         vals.sort()
+        if op == "approx_percentile":
+            # t-digest (same construction as the device bucketing, so the
+            # two engines agree exactly; NaNs excluded from the sketch,
+            # all-NaN groups answer NaN)
+            from ..kernels.tdigest import (build_digest_np, compression_for,
+                                           quantile)
+            from ..types import DecimalType as _Dec
+            if not vals and not nans:
+                return None
+            dt = fn.children[0].dtype
+            dec_scale = dt.scale if isinstance(dt, _Dec) else None
+            if dec_scale is not None:
+                # digest over the scaled-int carrier domain, exactly like
+                # the device path
+                from decimal import Decimal as _D
+                work = [int(_D(v).scaleb(dec_scale)) for v in vals]
+            else:
+                work = vals
+            comp = compression_for(getattr(fn, "accuracy", 10000))
+            means, weights = build_digest_np(np.asarray(work, np.float64),
+                                             comp)
+            outs = []
+            for p in fn.percentages:
+                if not vals:
+                    outs.append(float("nan"))
+                    continue
+                q = _cast_percentile_value(quantile(means, weights, p), fn)
+                if dec_scale is not None:
+                    from decimal import Decimal as _D
+                    q = _D(int(q)).scaleb(-dec_scale)
+                outs.append(q)
+            return outs if fn.is_array else outs[0]
         vals.extend(nans)  # NaN greatest, like the device bit encoding
         if not vals:
             return None
@@ -234,12 +287,9 @@ def _custom_cpu_agg(fn, cols_py: List[list], rows: List[int]):
         outs = []
         for p in fn.percentages:
             t = p * (n - 1)
-            if op == "percentile":
-                lo, hi = math.floor(t), math.ceil(t)
-                outs.append(float(vals[lo])
-                            + (float(vals[hi]) - float(vals[lo])) * (t - lo))
-            else:  # nearest rank (round-half-even, matching jnp.round)
-                outs.append(vals[round(t)])
+            lo, hi = math.floor(t), math.ceil(t)
+            outs.append(float(vals[lo])
+                        + (float(vals[hi]) - float(vals[lo])) * (t - lo))
         return outs if fn.is_array else outs[0]
     # covariance family
     xs, ys = [], []
@@ -284,10 +334,11 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
         col = flat.column(name)
         is_fp = pa.types.is_floating(col.type)
         if fn.update_op in _CUSTOM_CPU_AGGS or (
-                fn.update_op in ("collect_set", "collect_list")
+                fn.update_op in ("collect_set", "collect_list", "first",
+                                 "last")
                 and pa.types.is_nested(col.type)):
-            # nested collect: Arrow's hash_list/hash_distinct lack nested
-            # kernels → python-grouped path
+            # nested inputs: Arrow's hash_* kernels lack struct/list
+            # support → python-grouped path
             names = [f"__c_{i}"]
             work[f"__c_{i}"] = col
             if f"__in2_{i}" in flat.column_names:
@@ -569,7 +620,8 @@ def _segment_update(fn: AggregateFunction, col: Optional[TpuColumnVector],
                               num_rows, sorted_perm)
     if fn.update_op in ("min", "max", "first", "last") and col is not None \
             and not isinstance(col, tuple) \
-            and (col.offsets is not None or col.host_data is not None):
+            and (col.offsets is not None or col.host_data is not None
+                 or col.children is not None):
         # variable-width input (strings/binary/nested): host-assisted segment
         # min/max/first/last over the arrow values (the reference does these
         # in cuDF device kernels; no TPU ragged reduce yet)
@@ -747,26 +799,53 @@ def _segment_collect(fn, col: TpuColumnVector, seg_ids, g_cap: int,
     starts = jnp.full((g_cap,), capacity, jnp.int32).at[
         jnp.where(valid2, seg2, g_cap)].min(pos, mode="drop")
     vals2 = jnp.take(data, perm2)
-    # decimal columns carry scaled ints; exact percentile interpolates in
-    # doubles, so unscale (approx gathers raw carrier values — no unscale)
+    if op == "approx_percentile":
+        # mergeable t-digest, built by device bucketing over the segment-
+        # sorted run (kernels/tdigest.py; reference
+        # GpuApproximatePercentile.scala). NaNs are excluded from the
+        # sketch; an all-NaN group answers NaN.
+        from ..kernels.tdigest import (compression_for,
+                                       grouped_digest_quantiles_device)
+        is_fp = jnp.issubdtype(vals2.dtype, jnp.floating)
+        nonnan2 = valid2 & (~jnp.isnan(vals2) if is_fp
+                            else jnp.ones_like(valid2))
+        n_nn = jnp.zeros((g_cap,), jnp.int64).at[
+            jnp.where(nonnan2, seg2, g_cap)].add(
+            nonnan2.astype(jnp.int64), mode="drop")
+        starts_nn = jnp.full((g_cap,), capacity, jnp.int32).at[
+            jnp.where(nonnan2, seg2, g_cap)].min(pos, mode="drop")
+        comp = compression_for(getattr(fn, "accuracy", 10000))
+        qs = grouped_digest_quantiles_device(
+            vals2.astype(jnp.float64), seg2, nonnan2, starts_nn, n_nn,
+            g_cap, fn.percentages, comp)
+        out = {"n": n_g}
+        int_out = not jnp.issubdtype(
+            np.dtype(fn.dtype.np_dtype) if not fn.is_array
+            else np.dtype(fn.dtype.element_type.np_dtype), np.floating)
+        for k in range(len(fn.percentages)):
+            v = qs[k]
+            v = jnp.where(n_nn > 0, v, jnp.float64(np.nan))
+            if int_out:
+                v = jnp.round(v).astype(
+                    np.dtype(fn.dtype.np_dtype) if not fn.is_array
+                    else np.dtype(fn.dtype.element_type.np_dtype))
+            out[f"p{k}"] = v
+        return out
+    # exact percentile: rank interpolation over the sorted run.
+    # decimal columns carry scaled ints; interpolate in doubles, unscaled
     unscale = (10.0 ** -col.dtype.scale) \
         if isinstance(col.dtype, DecimalType) else 1.0
     out = {"n": n_g}
     for k, p in enumerate(fn.percentages):
         t = p * jnp.maximum(n_g.astype(jnp.float64) - 1.0, 0.0)
-        if op == "percentile":
-            lo = jnp.floor(t).astype(jnp.int64)
-            hi = jnp.ceil(t).astype(jnp.int64)
-            frac = t - lo.astype(jnp.float64)
-            v_lo = jnp.take(vals2, jnp.clip(starts.astype(jnp.int64) + lo,
-                                            0, capacity - 1)).astype(jnp.float64) * unscale
-            v_hi = jnp.take(vals2, jnp.clip(starts.astype(jnp.int64) + hi,
-                                            0, capacity - 1)).astype(jnp.float64) * unscale
-            out[f"p{k}"] = v_lo + (v_hi - v_lo) * frac
-        else:  # approx: nearest-rank, input-typed
-            r = jnp.round(t).astype(jnp.int64)
-            out[f"p{k}"] = jnp.take(vals2, jnp.clip(
-                starts.astype(jnp.int64) + r, 0, capacity - 1))
+        lo = jnp.floor(t).astype(jnp.int64)
+        hi = jnp.ceil(t).astype(jnp.int64)
+        frac = t - lo.astype(jnp.float64)
+        v_lo = jnp.take(vals2, jnp.clip(starts.astype(jnp.int64) + lo,
+                                        0, capacity - 1)).astype(jnp.float64) * unscale
+        v_hi = jnp.take(vals2, jnp.clip(starts.astype(jnp.int64) + hi,
+                                        0, capacity - 1)).astype(jnp.float64) * unscale
+        out[f"p{k}"] = v_lo + (v_hi - v_lo) * frac
     return out
 
 
@@ -899,7 +978,8 @@ def _evaluate_agg(fn: AggregateFunction, state: Dict[str, jnp.ndarray],
         return TpuColumnVector(f.dtype, f.data, f.validity, n_groups,
                                offsets=f.offsets, child=f.child,
                                host_data=f.host_data,
-                               host_capacity=f.host_capacity)
+                               host_capacity=f.host_capacity,
+                               children=f.children)
     if op == "count":
         return TpuColumnVector(LongT, state["count"], None, n_groups)
     if op == "sum":

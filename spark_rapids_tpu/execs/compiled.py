@@ -775,7 +775,8 @@ class TpuCompiledAggStageExec(TpuExec):
             elif isinstance(d.dtype, BooleanType):
                 pass
             else:
-                if col.offsets is not None or col.host_data is not None:
+                if col.offsets is not None or col.host_data is not None \
+                        or col.children is not None:
                     raise _StageFallback()
                 lo, hi = _int_stats(col)
                 if lo is not None:
@@ -796,7 +797,8 @@ class TpuCompiledAggStageExec(TpuExec):
                 flat.append(codes)
                 flat.append(codes >= 0)
             else:
-                if col.offsets is not None or col.host_data is not None:
+                if col.offsets is not None or col.host_data is not None \
+                        or col.children is not None:
                     raise _StageFallback()
                 flat.append(col.data)
                 flat.append(col.validity if col.validity is not None

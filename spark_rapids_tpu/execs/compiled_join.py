@@ -626,6 +626,35 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
     return fn
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("ops",))
+def _merge_carries_dev(cs, ops):
+    out = list(cs[0])
+    for nxt in cs[1:]:
+        for i, op in enumerate(ops):
+            if op == "sum":
+                out[i] = out[i] + nxt[i]
+            elif op == "min":
+                out[i] = jnp.minimum(out[i], nxt[i])
+            elif op == "max":
+                out[i] = jnp.maximum(out[i], nxt[i])
+            else:  # or
+                out[i] = out[i] | nxt[i]
+    return tuple(out)
+
+
+@_functools.partial(jax.jit, static_argnames=("cap_occ",))
+def _compact_carries_dev(ms, mask, cap_occ):
+    pos = jnp.cumsum(mask) - 1
+    n = int(mask.shape[0])
+    idx = jnp.zeros((cap_occ,), jnp.int32).at[
+        jnp.where(mask, pos, cap_occ)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return (idx,) + tuple(jnp.take(m, idx, axis=0) for m in ms)
+
+
 # ---------------------------------------------------------------------------
 # the exec
 # ---------------------------------------------------------------------------
@@ -640,6 +669,10 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         self.spec = spec
         self.fallback = fallback
         self.max_dim_rows = max_dim_rows
+        # dims materialize ONCE per plan instance and are reused across
+        # re-executions — the broadcast-relation semantics
+        # (TpuBroadcastHashJoinExec._build_side memoizes the same way)
+        self._dims_built = None
 
     @property
     def output(self):
@@ -735,7 +768,8 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         flat = [jnp.asarray(padded), jnp.int32(n)]
         for o in d.payload_ordinals:
             vec = TpuColumnVector.from_arrow(sorted_tbl.column(o))
-            if vec.offsets is not None or vec.host_data is not None:
+            if vec.offsets is not None or vec.host_data is not None \
+                    or vec.children is not None:
                 raise _JoinStageFallback()
             data, vv = vec.data, vec.validity
             if data.shape[0] != cap_d:
@@ -753,13 +787,16 @@ class TpuCompiledJoinAggStageExec(TpuExec):
     def _run_compiled(self, ctx: TaskContext) -> TpuColumnarBatch:
         from ..memory.spill import SpillableColumnarBatch
         spec = self.spec
-        with self.metrics["buildTime"].timed():
-            dim_tables, dim_flats, dim_caps = [], [], []
-            for d in spec.dims:
-                tbl, flat, cap_d = self._build_dim(d, ctx)
-                dim_tables.append(tbl)
-                dim_flats.append(flat)
-                dim_caps.append(cap_d)
+        if self._dims_built is None:
+            with self.metrics["buildTime"].timed():
+                dim_tables, dim_flats, dim_caps = [], [], []
+                for d in spec.dims:
+                    tbl, flat, cap_d = self._build_dim(d, ctx)
+                    dim_tables.append(tbl)
+                    dim_flats.append(flat)
+                    dim_caps.append(cap_d)
+                self._dims_built = (dim_tables, dim_flats, dim_caps)
+        dim_tables, dim_flats, dim_caps = self._dims_built
         held: List[SpillableColumnarBatch] = []
         carries = []
         try:
@@ -777,11 +814,55 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                     b = sb.get_batch()
                     carries.append(self._run_batch(
                         b, dim_flats, tuple(dim_caps), ctx))
-                carries_np = jax.device_get(carries)
+                # carries are G-sized (G = group-dim capacity, can be
+                # millions): merge across batches ON DEVICE and fetch ONLY
+                # the occupied groups — a full-G download through a
+                # high-latency link costs more than the whole query
+                if carries:
+                    occ_np, carry_np, nocc = self._merge_and_compact(carries)
+                else:
+                    occ_np, carry_np, nocc = np.zeros(0, np.int64), [], 0
         finally:
             for sb in held:
                 sb.close()
-        return self._assemble(dim_tables, dim_caps, carries_np, ctx)
+        return self._assemble_compact(dim_tables, occ_np, carry_np, nocc,
+                                      ctx)
+
+    def _carry_combine_ops(self) -> List[str]:
+        """Elementwise combine op per carry slot, mirroring
+        _np_merge_carries' layout exactly."""
+        from .compiled import _is_fp
+        ops = ["sum"]  # rowcount
+        for fn in self.spec.agg_fns:
+            op = fn.update_op
+            if not fn.children or op == "count":
+                ops.append("sum")
+            elif op in ("sum", "avg"):
+                ops.extend(["sum", "sum"])
+            elif _is_fp(fn.children[0].dtype):
+                ops.extend([op, "or", "sum", "sum"])
+            else:
+                ops.extend([op, "sum"])
+        return ops
+
+    def _merge_and_compact(self, carries):
+        """Device-side cross-batch carry merge + occupied-group compaction:
+        two small programs and ONE scalar sync, then a download whose size
+        scales with the RESULT (occupied groups), not the group capacity."""
+        ops = tuple(self._carry_combine_ops())
+        merged = (_merge_carries_dev(tuple(carries), ops)
+                  if len(carries) > 1 else carries[0])
+        rowcount = merged[0]
+        G = int(rowcount.shape[0])
+        if self.spec.grouping:
+            occ_mask = rowcount[:G - 1] > 0  # slot G-1 = dropped rows
+        else:
+            occ_mask = jnp.ones((1,), bool)
+        nocc = int(jnp.sum(occ_mask))  # the one scalar sync
+        cap_occ = bucket_capacity(max(nocc, 1))
+        host = jax.device_get(
+            _compact_carries_dev(tuple(merged), occ_mask, cap_occ))
+        return host[0][:nocc], [h[:nocc] for h in host[1:]], nocc
 
     def _run_batch(self, b: TpuColumnarBatch, dim_flats,
                    dim_caps: Tuple[int, ...], ctx: TaskContext):
@@ -790,7 +871,8 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         flat = []
         for o in spec.fact_needed_source:
             col = b.columns[o]
-            if col.offsets is not None or col.host_data is not None:
+            if col.offsets is not None or col.host_data is not None \
+                    or col.children is not None:
                 raise _JoinStageFallback()
             flat.append(col.data)
             flat.append(col.validity if col.validity is not None
@@ -798,35 +880,35 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         fn = _build_join_stage_fn(spec, cap, dim_caps, ctx.eval_ctx)
         return fn(row_mask(b.num_rows, cap), tuple(flat), tuple(dim_flats))
 
-    def _assemble(self, dim_tables, dim_caps, carries, ctx: TaskContext):
+    def _assemble_compact(self, dim_tables, occ_np, carry_np, nocc: int,
+                          ctx: TaskContext):
+        """Host finalize over OCCUPIED groups only: occ_np holds the group
+        dim row of each occupied group; carry_np the compacted states."""
         import pyarrow as pa
 
         from ..types import to_arrow as t2a
         from .aggregates import _bind_agg_refs
         spec = self.spec
-        G = (dim_caps[spec.group_dim] + 1) if spec.group_dim is not None \
-            else 2
 
-        if not carries:
+        if nocc == 0 or not carry_np:
             if spec.grouping:
                 return _host_batch(pa.Table.from_arrays(
                     [pa.nulls(0, t2a(a.dtype)) for a in spec.output],
                     names=[a.name for a in spec.output]))
-            rowcount = np.zeros(G, np.int64)
+            rowcount = np.zeros(1, np.int64)
             states: List[Optional[Dict]] = [None] * len(spec.agg_fns)
-        else:
-            rowcount, states = _np_merge_carries(spec, carries)
-
-        if spec.grouping:
-            occ_idx = np.nonzero(rowcount[:G - 1] > 0)[0]
-        else:
             occ_idx = np.array([0])
+        else:
+            # one already-merged compacted carry: reuse the shared merge
+            # walker to lay the state dicts out
+            rowcount, states = _np_merge_carries(spec, [tuple(carry_np)])
+            occ_idx = np.arange(nocc)
         self.metrics["numGroups"].add(len(occ_idx))
 
         key_arrays = []
         if spec.grouping:
             gtbl = dim_tables[spec.group_dim]
-            take_idx = pa.array(occ_idx, pa.int64())
+            take_idx = pa.array(np.asarray(occ_np, np.int64), pa.int64())
             for o in spec.group_key_ordinals:
                 col = gtbl.column(o).take(take_idx)
                 if isinstance(col, pa.ChunkedArray):

@@ -28,6 +28,42 @@ class WriteSpec:
     write_fn: Callable  # (arrow table, file path) -> None
     partition_by: List[str] = field(default_factory=list)
     options: Dict[str, str] = field(default_factory=dict)
+    bucket_by: List[str] = field(default_factory=list)
+    num_buckets: int = 0
+
+    def _bucket_ids(self, table):
+        """Spark bucketing: pmod(murmur3(bucket cols, seed 42), n) — the
+        same hash the read side uses for pruning and that
+        HashPartitioning.partitionIdExpression defines."""
+        import numpy as np
+
+        from ..expressions.hashexprs import _np_hash_col
+        from ..types import from_arrow as a2t
+        seeds = np.full(table.num_rows, np.uint32(42), np.uint32)
+        for c in self.bucket_by:
+            col = table.column(c)
+            seeds = _np_hash_col(a2t(col.type), col, seeds)
+        h = seeds.view(np.int32).astype(np.int64)
+        return ((h % self.num_buckets) + self.num_buckets) % self.num_buckets
+
+    def _write_leaf(self, table, d: str, part_idx: int) -> int:
+        """Write one directory's files: plain or split into bucket files
+        (reference GpuFileFormatDataWriter bucket spec: one file per bucket
+        id per task, part-NNNNN_BBBBB)."""
+        import numpy as np
+        import pyarrow as pa
+        if not self.num_buckets:
+            self.write_fn(table,
+                          os.path.join(d, f"part-{part_idx:05d}.{self.ext}"))
+            return 1
+        ids = self._bucket_ids(table)
+        n = 0
+        for b in np.unique(ids):
+            sub = table.filter(pa.array(ids == b))
+            self.write_fn(sub, os.path.join(
+                d, f"part-{part_idx:05d}_{int(b):05d}.{self.ext}"))
+            n += 1
+        return n
 
     def write_partition(self, table, part_idx: int) -> int:
         """Write one partition's table; returns number of files written."""
@@ -38,13 +74,9 @@ class WriteSpec:
                                                        self.partition_by):
                 d = os.path.join(self.path, subdir)
                 os.makedirs(d, exist_ok=True)
-                self.write_fn(sub,
-                              os.path.join(d, f"part-{part_idx:05d}.{self.ext}"))
-                n += 1
+                n += self._write_leaf(sub, d, part_idx)
             return n
-        self.write_fn(table,
-                      os.path.join(self.path, f"part-{part_idx:05d}.{self.ext}"))
-        return 1
+        return self._write_leaf(table, self.path, part_idx)
 
 
 class CpuDataWritingCommandExec(CpuExec):

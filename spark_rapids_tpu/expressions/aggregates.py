@@ -263,9 +263,12 @@ class Percentile(AggregateFunction):
 
 
 class ApproximatePercentile(Percentile):
-    """approx_percentile (reference GpuApproximatePercentile.scala, t-digest).
-    Implemented exactly (nearest-rank on the full sorted data): exact answers
-    satisfy any accuracy bound; returns input-typed values like Spark."""
+    """approx_percentile (reference GpuApproximatePercentile.scala): a
+    mergeable t-digest sketch (kernels/tdigest.py) built with device-side
+    bucketing — the k1 scale function maps sorted ranks straight to
+    centroids, so every group's digest falls out of one segment reduction.
+    Partial digests merge through exchanges (merge_digests); quantiles
+    interpolate on centroid midpoints and cast back to the input type."""
 
     update_op = "approx_percentile"
 

@@ -2419,8 +2419,9 @@ class TransformKeys(_MapLambdaOp):
 
 
 class GetStructField(UnaryExpression):
-    """struct.field access (reference GpuGetStructField). Structs are
-    host-resident; this is a host dict-field gather."""
+    """struct.field access (reference GpuGetStructField). Device structs are
+    child-column tuples (cuDF STRUCT ColumnView), so field access is a
+    zero-copy child selection + validity AND — no host hop."""
 
     def __init__(self, child: Expression, name: str):
         super().__init__(child)
@@ -2434,6 +2435,12 @@ class GetStructField(UnaryExpression):
                 return f.data_type
         raise KeyError(self.name)
 
+    def _ordinal(self) -> int:
+        for i, f in enumerate(self.child.dtype.fields):
+            if f.name == self.name:
+                return i
+        raise KeyError(self.name)
+
     def _gather(self, vals):
         return [None if v is None else v.get(self.name) for v in vals]
 
@@ -2443,6 +2450,17 @@ class GetStructField(UnaryExpression):
             v = c.value
             return TpuScalar(self.dtype,
                              None if v is None else v.get(self.name))
+        if getattr(c, "children", None) is not None:
+            kid = c.children[self._ordinal()]
+            if c.validity is None:
+                return kid
+            v = kid.validity & c.validity if kid.validity is not None \
+                else c.validity
+            return TpuColumnVector(kid.dtype, kid.data, v, c.num_rows,
+                                   offsets=kid.offsets, child=kid.child,
+                                   host_data=kid.host_data,
+                                   host_capacity=kid.host_capacity,
+                                   children=kid.children)
         return _result_from_pylist(self._gather(c.to_pylist()), self.dtype,
                                    batch)
 
@@ -2483,6 +2501,24 @@ class GetArrayStructFields(UnaryExpression):
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         c = self.child.eval_tpu(batch, ctx)
+        kid = getattr(c, "child", None)
+        if kid is not None and getattr(kid, "children", None) is not None:
+            # array<struct>: keep the array shell (offsets + validity), swap
+            # the struct child for the selected field's column — zero-copy
+            st = self.child.dtype.element_type
+            ordinal = next(i for i, f in enumerate(st.fields)
+                           if f.name == self.name)
+            elem = kid.children[ordinal]
+            if kid.validity is not None:
+                ev = elem.validity & kid.validity \
+                    if elem.validity is not None else kid.validity
+                elem = TpuColumnVector(elem.dtype, elem.data, ev,
+                                       kid.num_rows, offsets=elem.offsets,
+                                       child=elem.child,
+                                       children=elem.children)
+            return TpuColumnVector(self.dtype, elem.data, c.validity,
+                                   c.num_rows, offsets=c.offsets,
+                                   child=elem)
         return _result_from_pylist(self._gather(c.to_pylist()), self.dtype,
                                    batch)
 
@@ -2496,7 +2532,9 @@ class GetArrayStructFields(UnaryExpression):
 
 
 class CreateNamedStruct(Expression):
-    """named_struct(name1, val1, ...) (reference GpuCreateNamedStruct)."""
+    """named_struct(name1, val1, ...) (reference GpuCreateNamedStruct).
+    Builds a device struct directly from the evaluated child columns when
+    they are device-resident — no host materialization."""
 
     def __init__(self, names: Sequence[str], values: Sequence[Expression]):
         self.names = list(names)
@@ -2512,7 +2550,25 @@ class CreateNamedStruct(Expression):
                 for i in range(n)]
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        import jax.numpy as jnp
         n = batch.num_rows
+        evaled = [c.eval_tpu(batch, ctx) for c in self.children]
+        kids = []
+        device_ok = True
+        for e, c in zip(evaled, self.children):
+            if isinstance(e, TpuScalar):
+                e = TpuColumnVector.from_scalar(e.value, c.dtype, n,
+                                                capacity=batch.capacity)
+            if getattr(e, "host_data", None) is not None:
+                device_ok = False
+                break
+            kids.append(e)
+        if device_ok and kids:
+            cap = max(k.capacity for k in kids)
+            from ..columnar.batch import _repad
+            kids = [_repad(k, cap) if k.capacity < cap else k for k in kids]
+            return TpuColumnVector(self.dtype, jnp.zeros((0,), jnp.int8),
+                                   None, n, children=kids)
         cols = [_pylist_of(None, batch, ctx, c, n) for c in self.children]
         return _result_from_pylist(self._rows(cols, n), self.dtype, batch)
 

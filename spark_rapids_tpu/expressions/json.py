@@ -150,7 +150,7 @@ def get_json_object_impl(doc: Optional[str], path_steps) -> Optional[str]:
     return _render(_walk(value, path_steps), had_wildcard)
 
 
-def device_json_get(col, batch, steps):
+def device_json_get(col, batch, steps, ctx=None):
     """Device JSON path extraction (kernels/json_scan.py) for single-name
     paths ('$.key'), or None when outside the device subset. Per-ROW hybrid:
     rows the validating scan cannot certify (escapes, float canonicalization,
@@ -177,9 +177,13 @@ def device_json_get(col, batch, steps):
     n = int(offsets.shape[0]) - 1
     if n == 0:
         return None
+    cap_bytes = 4096
+    if ctx is not None:
+        from ..config import JSON_DEVICE_SCAN_MAX_ROW_BYTES
+        cap_bytes = ctx.conf.get(JSON_DEVICE_SCAN_MAX_ROW_BYTES)
     lens = offsets[1:] - offsets[:-1]
     max_len = int(jnp.max(lens)) if n else 0
-    if max_len > 4096:
+    if max_len > cap_bytes:
         return None
     spans = scan_key_spans(data, offsets, steps[0].encode(), max_len)
     # servable on device: certified rows whose value renders byte-identically
@@ -272,7 +276,7 @@ class GetJsonObject(Expression):
         c = self.children[0].eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
             return TpuScalar(StringT, get_json_object_impl(c.value, steps))
-        out = device_json_get(c, batch, steps)
+        out = device_json_get(c, batch, steps, ctx)
         if out is not None:
             return out
         out = pa.array([get_json_object_impl(v, steps)

@@ -131,7 +131,7 @@ class RLike(Expression):
             from .predicates import EqualTo
             return EqualTo(c, Literal(lit)).eval_tpu(batch, ctx)
         col = c.eval_tpu(batch, ctx)
-        out = self._device_dfa_match(col, batch)
+        out = self._device_dfa_match(col, batch, ctx)
         if out is not None:
             return out
         import pyarrow.compute as pc
@@ -139,7 +139,7 @@ class RLike(Expression):
         out = pc.match_substring_regex(arr, pattern=self._transpiled)
         return _bool_result_from_arrow(out, batch)
 
-    def _device_dfa_match(self, col, batch):
+    def _device_dfa_match(self, col, batch, ctx=None):
         """Compiled byte-DFA table walk on device (kernels/regex_dfa.py), or
         None when the pattern/column is outside the device subset."""
         import jax.numpy as jnp
@@ -154,9 +154,13 @@ class RLike(Expression):
             return None
         if not dfa.ascii_atoms and not SK.is_ascii(col.data):
             return None  # byte/char mismatch possible: host engine decides
+        cap_bytes = MAX_DEVICE_ROW_BYTES
+        if ctx is not None:
+            from ..config import REGEX_MAX_DEVICE_ROW_BYTES
+            cap_bytes = ctx.conf.get(REGEX_MAX_DEVICE_ROW_BYTES)
         lens = col.offsets[1:] - col.offsets[:-1]
         max_len = int(jnp.max(lens)) if int(lens.shape[0]) else 0
-        if max_len > MAX_DEVICE_ROW_BYTES:
+        if max_len > cap_bytes:
             return None  # pathological rows: lock-step walk too deep
         data = rlike_device(col.data, col.offsets, batch.num_rows, dfa,
                             max_len)
@@ -197,7 +201,7 @@ class RegexpReplace(Expression):
         import pyarrow as pa
         import pyarrow.compute as pc
         col = self.children[0].eval_tpu(batch, ctx)
-        out = self._device_replace(col, batch)
+        out = self._device_replace(col, batch, ctx)
         if out is not None:
             return out
         arr = _to_arrow_side(col, batch)
@@ -216,7 +220,7 @@ class RegexpReplace(Expression):
                 replacement=self._java_to_py_repl())
         return _string_result_from_arrow(out, batch)
 
-    def _device_replace(self, col, batch):
+    def _device_replace(self, col, batch, ctx=None):
         """DFA span matching + device byte assembly over HBM buffers, or
         None when pattern/replacement/column are outside the device subset
         (reference: cuDF regex replace kernels behind
@@ -234,9 +238,13 @@ class RegexpReplace(Expression):
             return None
         if not dfa.ascii_atoms and not SK.is_ascii(col.data):
             return None
+        span_cap = MAX_DEVICE_SPAN_ROW_BYTES
+        if ctx is not None:
+            from ..config import REGEX_MAX_SPAN_ROW_BYTES
+            span_cap = ctx.conf.get(REGEX_MAX_SPAN_ROW_BYTES)
         lens = col.offsets[1:] - col.offsets[:-1]
         max_len = int(jnp.max(lens)) if int(lens.shape[0]) else 0
-        if max_len > MAX_DEVICE_SPAN_ROW_BYTES:
+        if max_len > span_cap:
             return None
         data, offsets = col.data, col.offsets
         nbytes = int(data.shape[0])
@@ -306,14 +314,14 @@ class RegexpExtract(Expression):
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         import pyarrow as pa
         col = self.children[0].eval_tpu(batch, ctx)
-        out = self._device_extract(col, batch)
+        out = self._device_extract(col, batch, ctx)
         if out is not None:
             return out
         arr = _to_arrow_side(col, batch)
         out = pa.array(self._extract(arr.to_pylist()), pa.string())
         return _string_result_from_arrow(out, batch)
 
-    def _device_extract(self, col, batch):
+    def _device_extract(self, col, batch, ctx=None):
         """Whole-match (group 0) extraction on device: first match span via
         the exact DFA, then a ranged gather. Capture groups (>0) stay on the
         host engine."""
@@ -329,9 +337,13 @@ class RegexpExtract(Expression):
             return None
         if not dfa.ascii_atoms and not SK.is_ascii(col.data):
             return None
+        span_cap = MAX_DEVICE_SPAN_ROW_BYTES
+        if ctx is not None:
+            from ..config import REGEX_MAX_SPAN_ROW_BYTES
+            span_cap = ctx.conf.get(REGEX_MAX_SPAN_ROW_BYTES)
         lens = col.offsets[1:] - col.offsets[:-1]
         max_len = int(jnp.max(lens)) if int(lens.shape[0]) else 0
-        if max_len > MAX_DEVICE_SPAN_ROW_BYTES:
+        if max_len > span_cap:
             return None
         data, offsets = col.data, col.offsets
         nbytes = int(data.shape[0])

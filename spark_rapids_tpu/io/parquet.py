@@ -16,6 +16,7 @@ Predicate pushdown prunes row groups by footer statistics before decode.
 from __future__ import annotations
 
 import concurrent.futures as _fut
+import os
 from typing import Iterator, List, Optional, Sequence
 
 from ..columnar.batch import TpuColumnarBatch
@@ -32,23 +33,28 @@ def _split_files(paths: List[str], n: int) -> List[List[str]]:
     return out
 
 
+def _resolve_cache_path(path: str, options: dict) -> str:
+    """Route remote inputs through the local file cache (reference: the
+    spark-rapids-private FileCache hooks in GpuExec/Plugin)."""
+    conf = (options or {}).get("__conf__")
+    if conf is not None:
+        from ..filecache import FileCache
+        from ..config import FILECACHE_ENABLED
+        if conf.get(FILECACHE_ENABLED):
+            return FileCache.get(conf).resolve(
+                path, conf,
+                force=str((options or {}).get("filecache.force",
+                                              "false")).lower() == "true")
+    return path
+
+
 def _read_one(path: str, fmt: str, columns: Optional[List[str]],
               arrow_filter, options: dict):
     import pyarrow as pa
     # deletion vectors / stats are keyed by the ORIGINAL path; look them up
     # before the file cache rewrites it to a local copy
     dv_rows = (options or {}).get("__dv_rows__", {}).get(path)
-    conf = (options or {}).get("__conf__")
-    if conf is not None:
-        # remote inputs route through the local file cache (reference: the
-        # spark-rapids-private FileCache hooks in GpuExec/Plugin)
-        from ..filecache import FileCache
-        from ..config import FILECACHE_ENABLED
-        if conf.get(FILECACHE_ENABLED):
-            path = FileCache.get(conf).resolve(
-                path, conf,
-                force=str((options or {}).get("filecache.force",
-                                              "false")).lower() == "true")
+    path = _resolve_cache_path(path, options)
     if fmt == "parquet":
         import pyarrow.parquet as pq
         fid_map = (options or {}).get("__iceberg_field_ids__")
@@ -63,8 +69,10 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
             t = pq.read_table(path, columns=columns)
             keep = np.ones(t.num_rows, dtype=bool)
             keep[dv_rows.astype(np.int64)] = False
-            return t.filter(pa.array(keep))
-        return pq.read_table(path, columns=columns, filters=arrow_filter)
+            return _postprocess_parquet(t.filter(pa.array(keep)), path,
+                                        options)
+        t = pq.read_table(path, columns=columns, filters=arrow_filter)
+        return _postprocess_parquet(t, path, options)
     if fmt == "orc":
         import pyarrow.orc as paorc
         t = paorc.read_table(path, columns=columns)
@@ -126,6 +134,105 @@ def _read_one(path: str, fmt: str, columns: Optional[List[str]],
     else:
         raise ValueError(f"unknown scan format {fmt}")
     return t
+
+
+def _postprocess_parquet(t, path: str, options: dict, kv_metadata=None):
+    """Per-file parquet parity passes (reference GpuParquetScan.scala:446):
+      * INT96 timestamps decode as timestamp[ns] — normalize to micros;
+      * legacy hybrid-calendar files (footer marker, or forced LEGACY read
+        mode) get their date/timestamp values rebased to proleptic."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from .rebase import needs_rebase, rebase_table
+    # INT96 decodes as timestamp[ns]; the engine works in micros (Spark's
+    # internal unit) — normalize the unit, keep the UTC zone convention
+    ns_cols = [i for i, f in enumerate(t.schema)
+               if pa.types.is_timestamp(f.type) and f.type.unit == "ns"]
+    for i in ns_cols:
+        f = t.schema.field(i)
+        # safe=False: Spark TRUNCATES sub-microsecond precision to micros
+        t = t.set_column(i, f.name, t.column(i).cast(
+            pa.timestamp("us", tz=f.type.tz), safe=False))
+    mode = "CORRECTED"
+    conf = (options or {}).get("__conf__")
+    if conf is not None:
+        from ..config import PARQUET_REBASE_MODE_READ
+        mode = conf.get(PARQUET_REBASE_MODE_READ)
+    has_datetime = any(pa.types.is_date32(f.type)
+                       or pa.types.is_timestamp(f.type) for f in t.schema)
+    if has_datetime:
+        kv = kv_metadata
+        if kv is None:
+            try:
+                kv = pq.ParquetFile(path).metadata.metadata
+            except Exception:  # noqa: BLE001 — no footer: assume modern
+                kv = None
+        if needs_rebase(kv, mode):
+            t = rebase_table(t)
+    return t
+
+
+def _read_parquet_chunks(path: str, columns, arrow_filter, options: dict,
+                         chunk_bytes: int):
+    """Bounded-memory parquet decode: row groups stream out in chunks whose
+    compressed footprint stays under `chunk_bytes`, so a huge file feeds the
+    retry framework chunk-by-chunk instead of OOMing the host in one decode
+    (reference chunked reader, GpuParquetScan.scala + RapidsConf chunked
+    reader limit)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pf = pq.ParquetFile(path)
+    md = pf.metadata
+    n_rg = md.num_row_groups
+
+    def rg_excluded(rg) -> bool:
+        """Row-group pruning by footer statistics for pushed filters."""
+        if not arrow_filter:
+            return False
+        stats = {}
+        for j in range(rg.num_columns):
+            col = rg.column(j)
+            st = col.statistics
+            if st is not None and st.has_min_max:
+                name = col.path_in_schema.split(".")[0]
+                stats[name] = (st.min, st.max)
+        for leaf in arrow_filter:
+            try:
+                name, op, val = leaf
+            except Exception:  # noqa: BLE001 — nested filter shape
+                return False
+            if name not in stats:
+                continue
+            lo, hi = stats[name]
+            try:
+                if ((op in ("=", "==") and (val < lo or val > hi))
+                        or (op in ("<", "<=") and lo > val)
+                        or (op in (">", ">=") and hi < val)):
+                    return True
+            except TypeError:
+                continue
+        return False
+
+    group, group_bytes = [], 0
+    for i in range(n_rg):
+        rg = md.row_group(i)
+        if rg_excluded(rg):
+            continue
+        group.append(i)
+        group_bytes += rg.total_byte_size
+        if group_bytes >= chunk_bytes:
+            yield _postprocess_parquet(
+                pf.read_row_groups(group, columns=columns), path, options,
+                kv_metadata=md.metadata)
+            group, group_bytes = [], 0
+    if group:
+        yield _postprocess_parquet(
+            pf.read_row_groups(group, columns=columns), path, options,
+            kv_metadata=md.metadata)
+    elif n_rg == 0:
+        yield _postprocess_parquet(pf.read(columns=columns), path, options,
+                                   kv_metadata=md.metadata)
 
 
 def _stats_may_match(stats: Optional[dict], arrow_filter) -> bool:
@@ -271,6 +378,57 @@ class FileScanBase:
 
         return [f for f in files if file_ok(f)]
 
+    def _prune_by_bucket(self, files, conf):
+        """Bucket pruning (reference GpuFileSourceScanExec bucketing): an
+        equality filter on the single bucket column keeps only the files of
+        pmod(murmur3(value), numBuckets) — file names carry the bucket id
+        as part-NNNNN_BBBBB."""
+        import re as _re
+
+        import numpy as np
+        spec = (self.options or {}).get("__bucket_spec__")
+        if not spec or not self._arrow_filter:
+            return files
+        from ..config import BUCKETING_READ_PRUNE_ENABLED
+        if conf is not None and not conf.get(BUCKETING_READ_PRUNE_ENABLED):
+            return files
+        cols = spec.get("bucketColumns") or []
+        n = int(spec.get("numBuckets") or 0)
+        if len(cols) != 1 or n <= 0:
+            return files
+        value = None
+        for leaf in self._arrow_filter:
+            try:
+                name, op, val = leaf
+            except Exception:  # noqa: BLE001 — nested filter shape
+                continue
+            if name == cols[0] and op in ("=", "=="):
+                value = val
+                break
+        if value is None:
+            return files
+        import pyarrow as pa
+
+        from ..expressions.hashexprs import _np_hash_col
+        from ..types import to_arrow as t2a
+        # hash with the COLUMN's declared type: murmur3 of int32 and int64
+        # differ, and the writer hashed with the column type
+        attr = next((a for a in self._output_attrs if a.name == cols[0]),
+                    None)
+        if attr is None:
+            return files
+        arr = pa.array([value], type=t2a(attr.dtype))
+        seeds = np.full(1, np.uint32(42), np.uint32)
+        h = _np_hash_col(attr.dtype, arr, seeds).view(np.int32).astype(
+            np.int64)[0]
+        bucket = int(((h % n) + n) % n)
+        pat = _re.compile(rf"part-\d+_{bucket:05d}\.")
+        kept = [f for f in files if pat.search(os.path.basename(f))]
+        # unbucketed files (no _BBBBB suffix) must always be read
+        plain = [f for f in files
+                 if not _re.search(r"part-\d+_\d{5}\.", os.path.basename(f))]
+        return kept + plain
+
     def _partition_tables(self, idx: int, ctx: TaskContext) -> Iterator:
         """Host-side reads for one partition under the selected strategy."""
         import pyarrow as pa
@@ -283,6 +441,7 @@ class FileScanBase:
             files = [f for f in files
                      if _stats_may_match(file_stats.get(f), self._arrow_filter)]
         files = self._prune_by_partition_values(files, ctx.conf)
+        files = self._prune_by_bucket(files, ctx.conf)
         if not files:
             return
         part_names = {n for n, _ in self._partition_columns()}
@@ -330,7 +489,24 @@ class FileScanBase:
             set_input_file(files[0])
             yield pa.concat_tables(tables, promote_options="permissive")
         else:  # PERFILE
+            from ..config import PARQUET_CHUNK_BYTES
+            chunk_bytes = (ctx.conf.get(PARQUET_CHUNK_BYTES)
+                           if self.fmt == "parquet" else 0)
             for f in files:
+                chunkable = (chunk_bytes > 0 and self.fmt == "parquet"
+                             and (self.options or {}).get(
+                                 "__iceberg_field_ids__") is None
+                             and f not in (self.options or {}).get(
+                                 "__dv_rows__", {}))
+                if chunkable:
+                    rp = _resolve_cache_path(f, self.options)
+                    for t in _read_parquet_chunks(rp, cols, row_filter,
+                                                  self.options, chunk_bytes):
+                        t = self._attach_partition_cols(t, f)
+                        if t.num_rows:
+                            set_input_file(f)
+                            yield t
+                    continue
                 t = read(f)
                 if t.num_rows:
                     set_input_file(f)

@@ -14,7 +14,10 @@ _DATA_EXTS = ("parquet", "orc", "csv", "json", "avro", "txt")
 def _dir_files(d: str) -> List[str]:
     out: List[str] = []
     for ext in _DATA_EXTS:
-        out.extend(sorted(_glob.glob(os.path.join(d, f"*.{ext}"))))
+        out.extend(sorted(
+            f for f in _glob.glob(os.path.join(d, f"*.{ext}"))
+            # Spark convention: _metadata/_SUCCESS/.hidden are not data
+            if not os.path.basename(f).startswith(("_", "."))))
     return out
 
 
@@ -135,6 +138,14 @@ class DataFrameReader:
         # per-scan copy: partition metadata must not leak into later loads
         # through the same (reusable) reader object
         scan_options = dict(self._options)
+        for p in paths:
+            spec_path = os.path.join(p, "_bucket_spec.json") \
+                if os.path.isdir(p) else None
+            if spec_path and os.path.exists(spec_path):
+                import json as _json
+                with open(spec_path) as f:
+                    scan_options["__bucket_spec__"] = _json.load(f)
+                break
         if pcols:
             scan_options["__partition_cols__"] = [
                 (c, t) for c, t in _partition_attr_types(pcols, pvals).items()]

@@ -19,6 +19,8 @@ class DataFrameWriter:
         self._mode = "errorifexists"
         self._options = {}
         self._partition_by: List[str] = []
+        self._bucket_by: List[str] = []
+        self._num_buckets = 0
 
     def mode(self, m: str) -> "DataFrameWriter":
         self._mode = m.lower()
@@ -30,6 +32,15 @@ class DataFrameWriter:
 
     def partitionBy(self, *cols: str) -> "DataFrameWriter":
         self._partition_by = list(cols)
+        return self
+
+    def bucketBy(self, num_buckets: int, *cols: str) -> "DataFrameWriter":
+        """Hash-bucketed output (reference GpuFileFormatWriter bucketing):
+        rows split into `num_buckets` files per task by
+        pmod(murmur3(cols), n), with a _bucket_spec.json sidecar the scan
+        uses for bucket pruning."""
+        self._bucket_by = list(cols)
+        self._num_buckets = int(num_buckets)
         return self
 
     def format(self, fmt: str) -> "DataFrameWriter":
@@ -102,8 +113,19 @@ class DataFrameWriter:
         session = self._df.session
         conf = session._rapids_conf()
         child = plan_physical(self._df._plan, conf)
+        bucket_by, num_buckets = self._bucket_by, self._num_buckets
+        if num_buckets:
+            from ..config import BUCKETING_WRITE_ENABLED
+            if not conf.get(BUCKETING_WRITE_ENABLED):
+                bucket_by, num_buckets = [], 0
+            else:
+                import json as _json
+                with open(os.path.join(path, "_bucket_spec.json"), "w") as f:
+                    _json.dump({"numBuckets": num_buckets,
+                                "bucketColumns": bucket_by}, f)
         spec = WriteSpec(fmt or ext, path, ext, write_fn,
-                         list(self._partition_by), dict(self._options))
+                         list(self._partition_by), dict(self._options),
+                         bucket_by=bucket_by, num_buckets=num_buckets)
         cmd = CpuDataWritingCommandExec(child, spec)
         final = TpuOverrides.apply(cmd, conf)
         wrote_files = False

@@ -115,6 +115,98 @@ def q1_partial_pallas(batch: Q1Inputs, cutoff_days,
     )
 
 
+def _q1_kernel_mxu(cutoff_ref, rf_ref, ls_ref, qty_ref, price_ref, disc_ref,
+                   tax_ref, ship_ref, valid_ref, out_ref):
+    """MXU formulation: the [16, E] one-hot contraction runs as ONE matmul
+    per tile instead of 16×6 masked VPU reductions.
+
+    Roofline: the VPU variant does 16 groups × 6 measures × 2 ops per input
+    element = 192 flops/element; at the measured 9.6 Grows/s that is
+    ~1.8 Tflop/s — the VPU's peak, which is why it plateaus at ~36% of HBM
+    bandwidth (it is COMPUTE-bound, not memory-bound). The same contraction
+    as `onehot[16, E] @ measures[E, 8]` rides the MXU's systolic array,
+    taking the per-element VPU work down to building the one-hot and the
+    measure stack (~20 flops/element) — the kernel becomes memory-bound,
+    which is the roofline cuDF's agg kernels sit on (SURVEY §2.4)."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    keep = valid_ref[:, :] & (ship_ref[:, :] <= cutoff_ref[0, 0])
+    w = keep.astype(jnp.float32)
+    price_raw = price_ref[:, :]
+    disc_raw = disc_ref[:, :]
+    qty = qty_ref[:, :] * w
+    price = price_raw * w
+    disc_price = price_raw * (1.0 - disc_raw) * w
+    charge = disc_price * (1.0 + tax_ref[:, :])
+    disc = disc_raw * w
+
+    group = rf_ref[:, :] * N_STATUS + ls_ref[:, :]           # [R, 128] int32
+    flat = group.reshape(1, -1)                              # [1, E]
+    gidx = jax.lax.broadcasted_iota(jnp.int32, (N_GROUPS, 1), 0)
+    onehot = (flat == gidx).astype(jnp.float32)              # [16, E]
+    meas = jnp.concatenate(
+        [m.reshape(-1, 1) for m in
+         (qty, price, disc_price, charge, disc, w,
+          w, w)], axis=1)                                    # [E, 8]
+    out_ref[:, :] += jax.lax.dot_general(
+        onehot, meas, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [16, 8]
+
+
+def q1_partial_pallas_mxu(batch: Q1Inputs, cutoff_days,
+                          interpret: bool = False) -> Q1State:
+    """MXU-contraction variant of the single-pass partial aggregation."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = batch.quantity.shape[0]
+    per_tile = _TILE_ROWS * _LANES
+    padded = -(-n // per_tile) * per_tile
+
+    def shape2d(a, fill):
+        if padded != n:
+            a = jnp.pad(a, (0, padded - n), constant_values=fill)
+        return a.reshape(-1, _LANES)
+
+    rf = shape2d(batch.returnflag, 0)
+    ls = shape2d(batch.linestatus, 0)
+    qty = shape2d(batch.quantity, 0)
+    price = shape2d(batch.extendedprice, 0)
+    disc = shape2d(batch.discount, 0)
+    tax = shape2d(batch.tax, 0)
+    ship = shape2d(batch.shipdate, 0)
+    valid = shape2d(batch.valid, False)
+
+    grid = padded // per_tile
+    col_spec = pl.BlockSpec((_TILE_ROWS, _LANES), lambda i: (i, 0))
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _q1_kernel_mxu,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                col_spec, col_spec, col_spec, col_spec, col_spec, col_spec,
+                col_spec, col_spec,
+            ],
+            out_specs=pl.BlockSpec((N_GROUPS, 8), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((N_GROUPS, 8), jnp.float32),
+            interpret=interpret,
+        )(jnp.asarray([[cutoff_days]], jnp.int32), rf, ls, qty, price, disc,
+          tax, ship, valid)
+
+    return Q1State(
+        sum_qty=out[:, 0], sum_base_price=out[:, 1],
+        sum_disc_price=out[:, 2], sum_charge=out[:, 3],
+        sum_disc=out[:, 4],
+        count=out[:, 5].astype(jnp.int32),
+    )
+
+
 _BEST = {}
 
 

@@ -1,0 +1,172 @@
+"""t-digest sketches for approx_percentile.
+
+Reference: GpuApproximatePercentile.scala — the reference builds mergeable
+t-digest sketches on device (cuDF tdigest kernels) with partial/final merge
+through the shuffle, because map-side pre-aggregation of percentiles needs a
+bounded-size mergeable state.
+
+TPU design: the k1 scale function admits a DIRECT assignment of sorted ranks
+to clusters — cluster(r) = floor(C · (asin(2(r+½)/n − 1)/π + ½)) — so digest
+construction over segment-sorted values is pure vector math + one segment
+reduction per group ("device-side bucketing", no sequential centroid walk).
+The same formula runs in numpy for the CPU oracle, so both engines produce
+IDENTICAL digests for identical input order: oracle parity is exact, not
+just within error bounds.
+
+Merging (partial/final through an exchange) concatenates centroid lists,
+sorts by mean, and re-clusters by cumulative weight with the same scale
+function — bounded size in, bounded size out.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+#: Spark's approx_percentile accuracy default; compression scales with it
+DEFAULT_ACCURACY = 10000
+
+
+def compression_for(accuracy: int) -> int:
+    """Map Spark's accuracy knob to a t-digest compression (centroid
+    budget). cuDF uses delta=max(accuracy/100, 1000)-ish; 100..1000 keeps
+    digests small with error well inside 1/accuracy for realistic data."""
+    return int(min(max(accuracy // 10, 100), 2000))
+
+
+def cluster_ids_for_ranks(n, compression: int, xp=np):
+    """k1-scale cluster index for each rank 0..n-1 of a sorted run (vector
+    formula — the heart of the device bucketing)."""
+    r = (xp.arange(n) + 0.5) / xp.maximum(n, 1)
+    q = xp.clip(2.0 * r - 1.0, -1.0, 1.0)
+    k = compression * (xp.arcsin(q) / xp.pi + 0.5)
+    return xp.clip(k.astype(xp.int32), 0, compression - 1)
+
+
+def build_digest_np(sorted_vals: np.ndarray,
+                    compression: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(means, weights) for one group's sorted values (host path)."""
+    n = len(sorted_vals)
+    if n == 0:
+        return np.zeros(0), np.zeros(0)
+    cid = cluster_ids_for_ranks(n, compression)
+    sums = np.zeros(compression)
+    cnts = np.zeros(compression)
+    np.add.at(sums, cid, sorted_vals.astype(np.float64))
+    np.add.at(cnts, cid, 1.0)
+    occ = cnts > 0
+    return sums[occ] / cnts[occ], cnts[occ]
+
+
+def merge_digests(parts: List[Tuple[np.ndarray, np.ndarray]],
+                  compression: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Partial/final merge: concatenate centroids, sort by mean, re-cluster
+    by cumulative weight under the same scale function."""
+    means = np.concatenate([p[0] for p in parts]) if parts else np.zeros(0)
+    weights = np.concatenate([p[1] for p in parts]) if parts else np.zeros(0)
+    if len(means) == 0:
+        return means, weights
+    order = np.argsort(means, kind="stable")
+    means, weights = means[order], weights[order]
+    total = weights.sum()
+    # cumulative-weight midpoint of each centroid → k1 cluster index
+    cum = np.cumsum(weights)
+    mid = (cum - weights / 2.0) / total
+    q = np.clip(2.0 * mid - 1.0, -1.0, 1.0)
+    cid = np.clip((compression * (np.arcsin(q) / np.pi + 0.5)).astype(
+        np.int64), 0, compression - 1)
+    sums = np.zeros(compression)
+    cnts = np.zeros(compression)
+    np.add.at(sums, cid, means * weights)
+    np.add.at(cnts, cid, weights)
+    occ = cnts > 0
+    return sums[occ] / cnts[occ], cnts[occ]
+
+
+def quantile(means: np.ndarray, weights: np.ndarray, p: float) -> float:
+    """t-digest quantile: linear interpolation between centroid means at
+    cumulative-weight midpoints (the standard estimator)."""
+    if len(means) == 0:
+        return float("nan")
+    if len(means) == 1:
+        return float(means[0])
+    total = weights.sum()
+    target = p * total
+    cum = np.cumsum(weights)
+    mid = cum - weights / 2.0
+    if target <= mid[0]:
+        return float(means[0])
+    if target >= mid[-1]:
+        return float(means[-1])
+    i = int(np.searchsorted(mid, target, side="right")) - 1
+    lo, hi = mid[i], mid[i + 1]
+    f = 0.0 if hi == lo else (target - lo) / (hi - lo)
+    return float(means[i] + (means[i + 1] - means[i]) * f)
+
+
+def grouped_digest_quantiles_device(vals_sorted, seg2, valid2, starts, n_g,
+                                    g_cap: int, percentages,
+                                    compression: int):
+    """Device path: per-group digests + quantiles over segment-sorted data.
+
+    vals_sorted: float64[cap] values in (segment, value) sort order;
+    seg2: int32[cap] segment id per position (g_cap = invalid);
+    starts/n_g: per-group run start / valid count. Returns
+    {k: float64[g_cap]} per requested percentage.
+
+    Clustering: global position p with rank r = p - starts[seg] maps to
+    cluster cid(seg) = seg * C + k1(r / n_seg) — one segment-sum into a
+    [g_cap · C] table builds EVERY group's digest in one shot, matching
+    build_digest_np exactly (same formula, same float64 math)."""
+    import jax
+    import jax.numpy as jnp
+
+    C = compression
+    cap = int(vals_sorted.shape[0])
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    seg_c = jnp.clip(seg2, 0, g_cap - 1)
+    rank = (pos - jnp.take(starts, seg_c)).astype(jnp.float64)
+    n_of = jnp.take(n_g, seg_c).astype(jnp.float64)
+    r = (rank + 0.5) / jnp.maximum(n_of, 1.0)
+    qq = jnp.clip(2.0 * r - 1.0, -1.0, 1.0)
+    k = (C * (jnp.arcsin(qq) / jnp.pi + 0.5)).astype(jnp.int32)
+    k = jnp.clip(k, 0, C - 1)
+    flat = jnp.where(valid2, seg_c * C + k, g_cap * C)
+    sums = jax.ops.segment_sum(
+        jnp.where(valid2, vals_sorted.astype(jnp.float64), 0.0), flat,
+        num_segments=g_cap * C + 1)[:-1].reshape(g_cap, C)
+    cnts = jax.ops.segment_sum(
+        valid2.astype(jnp.float64), flat,
+        num_segments=g_cap * C + 1)[:-1].reshape(g_cap, C)
+    means = jnp.where(cnts > 0, sums / jnp.maximum(cnts, 1.0), 0.0)
+
+    # quantile per group: interpolate on cumulative-weight midpoints over
+    # the C-slot digest (empty slots carry zero weight and never select)
+    cum = jnp.cumsum(cnts, axis=1)
+    total = cum[:, -1:]
+    mid = cum - cnts / 2.0
+    big = jnp.where(cnts > 0, mid, jnp.inf)  # empty slots never match
+    out = {}
+    for kk, p in enumerate(percentages):
+        target = p * total[:, 0]
+        # rightmost occupied slot with mid <= target
+        le = (big <= target[:, None]) & (cnts > 0)
+        has_lo = le.any(axis=1)
+        i_lo = jnp.where(has_lo, (jnp.where(le, jnp.arange(C), -1)
+                                  ).max(axis=1), 0)
+        gt = (big > target[:, None]) & (cnts > 0)
+        has_hi = gt.any(axis=1)
+        i_hi = jnp.where(has_hi,
+                         jnp.where(gt, jnp.arange(C), C).min(axis=1), 0)
+        m_lo = jnp.take_along_axis(means, i_lo[:, None], axis=1)[:, 0]
+        m_hi = jnp.take_along_axis(means, i_hi[:, None], axis=1)[:, 0]
+        d_lo = jnp.take_along_axis(mid, i_lo[:, None], axis=1)[:, 0]
+        d_hi = jnp.take_along_axis(mid, i_hi[:, None], axis=1)[:, 0]
+        frac = jnp.where(d_hi > d_lo, (target - d_lo)
+                         / jnp.maximum(d_hi - d_lo, 1e-300), 0.0)
+        interp = m_lo + (m_hi - m_lo) * jnp.clip(frac, 0.0, 1.0)
+        v = jnp.where(has_lo & has_hi, interp,
+                      jnp.where(has_lo, m_lo, m_hi))
+        out[kk] = v
+    return out

@@ -102,6 +102,14 @@ class MemoryCleaner:
         return leaks
 
     def _at_shutdown(self) -> None:
+        # catalog-held shuffle blocks are OWNED state (released by the
+        # catalog's own shutdown); free them first so the report below only
+        # shows genuine leaks, regardless of atexit registration order
+        try:
+            from ..shuffle.ici import IciShuffleCatalog
+            IciShuffleCatalog._shutdown_instance()
+        except Exception:  # noqa: BLE001 — report must never fail shutdown
+            pass
         leaks = self.check_leaks(raise_on_leak=False)
         if leaks:
             print(f"[spark-rapids-tpu] MemoryCleaner: {len(leaks)} leaked "

@@ -62,6 +62,10 @@ class ExprMeta(RapidsMeta):
             if not self.conf.is_op_enabled(key, True):
                 self.will_not_work_on_tpu(
                     f"expression {type(e).__name__} has been disabled via {key}")
+            from .typechecks import conf_gate_reason
+            gate = conf_gate_reason(e, self.conf)
+            if gate:
+                self.will_not_work_on_tpu(gate)
         for c in self.child_exprs:
             c.tag_for_tpu()
 

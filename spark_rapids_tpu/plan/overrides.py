@@ -180,10 +180,14 @@ def _tag_aggregate(meta: PlanMeta) -> None:
                  "collect_list", "collect_set", "percentile",
                  "approx_percentile", "covar_samp", "covar_pop", "corr",
                  "bloom_filter"}
+    from .typechecks import conf_gate_reason
     for fn in agg_fns:
         if fn.update_op not in supported:
             meta.will_not_work_on_tpu(
                 f"aggregate {type(fn).__name__} is not supported on TPU")
+        gate = conf_gate_reason(fn, meta.conf)
+        if gate:
+            meta.will_not_work_on_tpu(gate)
         for c in fn.children:
             meta.add_exprs([c])
     meta.add_exprs(result_exprs)

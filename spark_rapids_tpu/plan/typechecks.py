@@ -291,8 +291,10 @@ def _register_builtin_exprs() -> None:
                       host_assisted=True)
     for cls in (CL.GetStructField, CL.GetArrayStructFields,
                 CL.CreateNamedStruct):
-        register_expr(cls, sig_nested, f"struct fn {cls.__name__}",
-                      host_assisted=True)
+        register_expr(cls, sig_nested,
+                      f"struct fn {cls.__name__} (device child-column "
+                      "tuples, cuDF STRUCT ColumnView analogue)",
+                      incompat="map-typed fields via host path")
 
     # aggregate functions (reference GpuOverrides expr[Sum]/expr[Max]/... —
     # each aggregate is an expression rule in its own right)
@@ -395,3 +397,40 @@ def _register_builtin_exprs() -> None:
 
 
 _register_builtin_exprs()
+
+
+def conf_gate_reason(e, conf):
+    """Config-driven expression gates beyond the per-class enable switch
+    (reference RapidsConf incompatibility switches: castFloatToString,
+    castStringToFloat, castStringToTimestamp, variableFloatAgg)."""
+    from ..config import (CAST_FLOAT_TO_STRING_ENABLED,
+                          CAST_STRING_TO_FLOAT_ENABLED,
+                          CAST_STRING_TO_TIMESTAMP_ENABLED,
+                          VARIABLE_FLOAT_AGG_ENABLED)
+    from ..expressions.aggregates import Average, Sum
+    from ..expressions.cast import Cast
+    from ..types import (DoubleType, FloatType, StringType, TimestampType)
+    if isinstance(e, Cast) and e.children:
+        src = e.children[0].dtype
+        dst = e.dtype
+        if isinstance(src, (FloatType, DoubleType)) \
+                and isinstance(dst, StringType) \
+                and not conf.get(CAST_FLOAT_TO_STRING_ENABLED):
+            return ("float-to-string cast disabled via "
+                    f"{CAST_FLOAT_TO_STRING_ENABLED.key}")
+        if isinstance(src, StringType) \
+                and isinstance(dst, (FloatType, DoubleType)) \
+                and not conf.get(CAST_STRING_TO_FLOAT_ENABLED):
+            return ("string-to-float cast disabled via "
+                    f"{CAST_STRING_TO_FLOAT_ENABLED.key}")
+        if isinstance(src, StringType) \
+                and isinstance(dst, TimestampType) \
+                and not conf.get(CAST_STRING_TO_TIMESTAMP_ENABLED):
+            return ("string-to-timestamp cast disabled via "
+                    f"{CAST_STRING_TO_TIMESTAMP_ENABLED.key}")
+    if isinstance(e, (Sum, Average)) and e.children \
+            and isinstance(e.children[0].dtype, (FloatType, DoubleType)) \
+            and not conf.get(VARIABLE_FLOAT_AGG_ENABLED):
+        return ("float aggregation result can vary with parallelism; "
+                f"disabled via {VARIABLE_FLOAT_AGG_ENABLED.key}")
+    return None

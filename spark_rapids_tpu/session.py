@@ -170,18 +170,29 @@ class Column:
         return Column(Contains(self._expr, _expr(other)))
 
     def getItem(self, key) -> "Column":
-        """array[i] (0-based) or map[key] access (reference GpuGetArrayItem /
-        GpuGetMapValue)."""
+        """array[i] (0-based), map[key], or struct.field access (reference
+        GpuGetArrayItem / GpuGetMapValue / GpuGetStructField)."""
         from .expressions import collections as _CL
-        from .types import MapType
+        from .types import ArrayType, MapType, StructType
         e = self._expr
         try:
-            is_map = isinstance(e.dtype, MapType)
-        except Exception:  # unresolved — assume array; maps resolve via col refs
-            is_map = False
-        if is_map:
+            dt = e.dtype
+        except Exception:  # unresolved — assume array; others resolve later
+            dt = None
+        if isinstance(dt, MapType):
             return Column(_CL.GetMapValue(e, _expr(key)))
+        if isinstance(dt, StructType) and isinstance(key, str):
+            return Column(_CL.GetStructField(e, key))
+        if isinstance(dt, ArrayType) and isinstance(dt.element_type,
+                                                    StructType) \
+                and isinstance(key, str):
+            return Column(_CL.GetArrayStructFields(e, key))
         return Column(_CL.GetArrayItem(e, _expr(key)))
+
+    def getField(self, name: str) -> "Column":
+        """struct.field access (pyspark Column.getField)."""
+        from .expressions import collections as _CL
+        return Column(_CL.GetStructField(self._expr, name))
 
     def substr(self, start: int, length: int) -> "Column":
         from .expressions.strings import Substring
@@ -690,7 +701,14 @@ def _coerce_join_keys(lk: List[Expression], rk: List[Expression]):
              FloatType: 4, DoubleType: 5}
     out_l, out_r = [], []
     for a, b in zip(lk, rk):
-        ta, tb = a.dtype, b.dtype
+        try:
+            ta, tb = a.dtype, b.dtype
+        except ValueError:
+            # unresolved keys (MERGE builds joins pre-resolution): types are
+            # unified later by the resolver; pass through untouched
+            out_l.append(a)
+            out_r.append(b)
+            continue
         if isinstance(ta, DecimalType) or isinstance(tb, DecimalType):
             # decimal keys: only exact precision/scale matches hash alike
             if repr(ta) != repr(tb):

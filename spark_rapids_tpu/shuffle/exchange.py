@@ -288,7 +288,11 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             from ..columnar.batch import concat_batches
             from .ici import IciShuffleCatalog, ShuffleHeartbeatManager
             catalog = IciShuffleCatalog.get()
-            ShuffleHeartbeatManager.get().register_peer(f"executor-{map_id}")
+            hb = ShuffleHeartbeatManager.get()
+            from ..config import SHUFFLE_HEARTBEAT_TIMEOUT_SECONDS
+            hb.timeout_s = float(map_ctx.conf.get(
+                SHUFFLE_HEARTBEAT_TIMEOUT_SECONDS))
+            hb.register_peer(f"executor-{map_id}")
             acc: List[List[TpuColumnarBatch]] = [[] for _ in range(self._n_out)]
             for parts in self._device_parts(map_id, map_ctx):
                 for p, sub in enumerate(parts):

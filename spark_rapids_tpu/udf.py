@@ -117,7 +117,8 @@ class ArrowPandasUDF(Expression):
         """Ship to a worker process when the pool is configured and the fn
         pickles; in-process otherwise (reference: worker pool vs row-based
         CPU fallback wrappers)."""
-        from .config import CONCURRENT_PYTHON_WORKERS, PYTHON_UDF_WORKERS
+        from .config import (CONCURRENT_PYTHON_WORKERS, PYTHON_UDF_WORKERS,
+                             UDF_WORKER_TIMEOUT_SECONDS)
         from .types import to_arrow
         n_workers = ctx.conf.get(PYTHON_UDF_WORKERS)
         if n_workers and n_workers > 0:
@@ -126,7 +127,9 @@ class ArrowPandasUDF(Expression):
             if blob is not None:
                 permits = ctx.conf.get(CONCURRENT_PYTHON_WORKERS) or None
                 pool = get_pool(n_workers, permits)
-                out = pool.run(blob, args)
+                out = pool.run(
+                    blob, args,
+                    timeout=float(ctx.conf.get(UDF_WORKER_TIMEOUT_SECONDS)))
                 return out.cast(to_arrow(self._dtype))
         return self._call(args)
 

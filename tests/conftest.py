@@ -18,6 +18,28 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+if os.environ.get("SRT_LEAK_GATE"):
+    # CI leak gate: after the whole session, any resource still tracked by
+    # the process-wide MemoryCleaner is a leak and fails the run (the
+    # reference treats shutdown leaks as bugs, Plugin.scala:581-596).
+    # Catalog-held shuffle blocks are owned state released by their atexit
+    # hooks, so they are freed explicitly before the check.
+    def pytest_sessionfinish(session, exitstatus):
+        if exitstatus != 0:
+            return
+        from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+        from spark_rapids_tpu.shuffle.ici import IciShuffleCatalog
+        IciShuffleCatalog._shutdown_instance()
+        leaks = MemoryCleaner.get().check_leaks()
+        if leaks:
+            import sys
+            print(f"\n[LEAK GATE] {len(leaks)} leaked device resources:",
+                  file=sys.stderr)
+            for item in leaks[:20]:
+                print(f"  {item}", file=sys.stderr)
+            session.exitstatus = 1
+
+
 if os.environ.get("SRT_LEAK_PER_TEST"):
     # leak-hunting mode: capture creation stacks and attribute each leaked
     # resource to the test that created it (enable with SRT_LEAK_PER_TEST=1)
@@ -42,6 +64,18 @@ if os.environ.get("SRT_LEAK_PER_TEST"):
             for r in new:
                 print(f"  {r.kind} (token {r.token})\n{r.stack or ''}",
                       file=sys.stderr)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jax_state():
+    """The full suite compiles thousands of XLA CPU executables in one
+    process; unbounded accumulation has produced allocator segfaults deep
+    into the run. Dropping jax's compilation caches between modules bounds
+    the live-executable set (re-compiles within a module stay cached)."""
+    yield
+    import gc
+    jax.clear_caches()
+    gc.collect()
 
 
 @pytest.fixture()

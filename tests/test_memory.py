@@ -124,8 +124,13 @@ def test_retry_gives_up_after_max():
 
 def test_unsplittable_single_row():
     sb = SpillableColumnarBatch(_batch(1, 8))
-    with pytest.raises(TpuSplitAndRetryOOM):
-        split_in_half(sb)
+    try:
+        with pytest.raises(TpuSplitAndRetryOOM):
+            split_in_half(sb)
+    finally:
+        # split_in_half only takes ownership on success; the caller still
+        # owns (and must close) the unsplittable input
+        sb.close()
 
 
 def test_semaphore_limits_concurrency():
