@@ -356,6 +356,7 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
         # group keys must all live on ONE inner dimension (or no grouping)
         group_dim: Optional[int] = None
         group_key_ordinals: List[int] = []
+        group_keys_device = True
         for g in grouping:
             src = _identity_source_ordinal(g.ordinal, top_layers)
             if src is None or src not in col_loc:
@@ -371,6 +372,10 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
             elif group_dim != di:
                 raise _Ineligible()
             group_key_ordinals.append(o)
+            dt = dims[di].plan.output[o].dtype
+            if isinstance(dt, (StringType, DecimalType)) \
+                    or not is_fixed_width(dt):
+                group_keys_device = False
         if group_dim is not None \
                 and dims[group_dim].key_ordinal not in group_key_ordinals:
             # Grouping by dim ROW INDEX is only value-correct when the dim's
@@ -402,6 +407,24 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
                 if lo not in dims[di].payload_ordinals:
                     dims[di].payload_ordinals.append(lo)
 
+        # device-resident output: when the result projection is an identity
+        # over the aggregates AND the group keys are fixed-width, the stage
+        # emits DEVICE columns (keys gathered from dim payloads, aggregates
+        # finalized in-trace) — the whole aggregate never leaves HBM, and a
+        # downstream TopN/sort fetches only its final rows
+        def _identity_result(expr, i):
+            e = expr.children[0] if isinstance(expr, Alias) else expr
+            return (isinstance(e, AttributeReference)
+                    and e.expr_id == -(i + 1))
+
+        device_output = (group_keys_device
+                         and all(_identity_result(e, i)
+                                 for i, e in enumerate(result_exprs)))
+        if device_output and group_dim is not None:
+            for o in group_key_ordinals:
+                if o not in dims[group_dim].payload_ordinals:
+                    dims[group_dim].payload_ordinals.append(o)
+
         # probe-chain payloads gather on device too
         for d in dims:
             if d.probe_loc[0] == "dim":
@@ -431,11 +454,13 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
                     or not is_fixed_width(dt):
                 raise _Ineligible()
 
-        return _JoinStageSpec(
+        spec = _JoinStageSpec(
             fact_source, fact_layers, fact_needed_source, fact_output,
             dims, top_output, col_loc, top_layers, grouping, group_dim,
             group_key_ordinals, agg_fns, result_exprs, list(agg.output),
             needed_top)
+        spec.device_output = device_output
+        return spec
     except _Ineligible:
         return None
 
@@ -486,8 +511,8 @@ def _segment_states(fn, x, v, gcode, G):
 
 
 def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
-                         dim_caps: Tuple[int, ...], eval_ctx):
-    key = spec.cache_key(cap, dim_caps)
+                         dim_caps: Tuple[int, ...], dim_dense, eval_ctx):
+    key = spec.cache_key(cap, dim_caps) + (tuple(dim_dense),)
     fn = _JOIN_STAGE_FN_CACHE.get(key)
     if fn is not None:
         return fn
@@ -545,18 +570,26 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
                 return c.data, v
             _, di, o = loc
             j = dims[di].payload_ordinals.index(o)
-            pdata, pvalid = dim_flat[di][2 + 2 * j], dim_flat[di][3 + 2 * j]
+            pdata, pvalid = dim_flat[di][3 + 2 * j], dim_flat[di][4 + 2 * j]
             idx = dim_idx[di]
             return jnp.take(pdata, idx), jnp.take(pvalid, idx)
 
         for di, d in enumerate(dims):
-            keys, n_valid = dim_flat[di][0], dim_flat[di][1]
+            keys, n_valid, lo = (dim_flat[di][0], dim_flat[di][1],
+                                 dim_flat[di][2])
             pdata, pvalid = resolve_probe(d.probe_loc)
             probe = pdata.astype(jnp.int64)
-            idx = jnp.searchsorted(keys, probe).astype(jnp.int32)
-            idx = jnp.clip(idx, 0, keys.shape[0] - 1)
-            matched = (jnp.take(keys, idx) == probe) & (idx < n_valid) \
-                & pvalid
+            if dim_dense[di]:
+                # contiguous keys: direct addressing, no binary search
+                rel = probe - lo
+                idx = jnp.clip(rel, 0, keys.shape[0] - 1).astype(jnp.int32)
+                matched = ((rel >= 0) & (rel < n_valid.astype(jnp.int64))
+                           & pvalid)
+            else:
+                idx = jnp.searchsorted(keys, probe).astype(jnp.int32)
+                idx = jnp.clip(idx, 0, keys.shape[0] - 1)
+                matched = (jnp.take(keys, idx) == probe) \
+                    & (idx < n_valid) & pvalid
             alive = alive & matched
             dim_idx[di] = idx
 
@@ -570,8 +603,8 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
             else:
                 _, di, lo = loc
                 j = dims[di].payload_ordinals.index(lo)
-                pdata = dim_flat[di][2 + 2 * j]
-                pvalid = dim_flat[di][3 + 2 * j]
+                pdata = dim_flat[di][3 + 2 * j]
+                pvalid = dim_flat[di][4 + 2 * j]
                 top_cols[o] = TpuColumnVector(
                     spec.top_output[o].dtype,
                     jnp.take(pdata, dim_idx[di]),
@@ -653,6 +686,103 @@ def _compact_carries_dev(ms, mask, cap_occ):
         jnp.where(mask, pos, cap_occ)].set(
         jnp.arange(n, dtype=jnp.int32), mode="drop")
     return (idx,) + tuple(jnp.take(m, idx, axis=0) for m in ms)
+
+
+@_functools.partial(jax.jit, static_argnames=("cap_occ", "fnspec"))
+def _finalize_output_dev(merged, occ_mask, key_cols, cap_occ, fnspec):
+    """Compact + finalize IN HBM: occupied-group indices, gathered group-key
+    columns, and per-aggregate (value, validity) arrays — the device-output
+    path of the compiled join stage. fnspec: per fn a tuple
+    (op, is_fp, out_dtype_str)."""
+    pos = jnp.cumsum(occ_mask) - 1
+    n = int(occ_mask.shape[0])
+    idx = jnp.zeros((cap_occ,), jnp.int32).at[
+        jnp.where(occ_mask, pos, cap_occ)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    live = jnp.arange(cap_occ) < jnp.sum(occ_mask)
+    keys_out = []
+    for kdata, kvalid in key_cols:
+        kd = jnp.take(kdata, idx, axis=0)
+        kv = live if kvalid is None else (jnp.take(kvalid, idx) & live)
+        keys_out.append((kd, kv))
+    aggs_out = []
+    ci = 1  # merged[0] = rowcount
+    for op, is_fp, dt_str in fnspec:
+        dt = np.dtype(dt_str)
+        if op == "count":
+            v = jnp.take(merged[ci], idx).astype(dt)
+            aggs_out.append((v, live))
+            ci += 1
+        elif op in ("sum", "avg"):
+            s = jnp.take(merged[ci], idx)
+            c = jnp.take(merged[ci + 1], idx)
+            valid = (c > 0) & live
+            if op == "avg":
+                v = s.astype(jnp.float64) / jnp.where(c > 0, c, 1)
+            else:
+                v = s.astype(dt)
+            aggs_out.append((jnp.where(valid, v, jnp.zeros((), v.dtype)),
+                             valid))
+            ci += 2
+        elif is_fp:  # min/max float: clean, nan_any, nonnan, nonnull
+            clean = jnp.take(merged[ci], idx)
+            nan_any = jnp.take(merged[ci + 1], idx)
+            nonnan = jnp.take(merged[ci + 2], idx)
+            nonnull = jnp.take(merged[ci + 3], idx)
+            # Spark NaN-greatest: max → NaN if any NaN; min → NaN only if
+            # the whole group is NaN
+            if op == "max":
+                v = jnp.where(nan_any, jnp.float64(np.nan),
+                              clean.astype(jnp.float64))
+            else:
+                v = jnp.where(nonnan > 0, clean.astype(jnp.float64),
+                              jnp.float64(np.nan))
+            valid = (nonnull > 0) & live
+            aggs_out.append((jnp.where(valid, v, 0.0).astype(dt), valid))
+            ci += 4
+        else:  # min/max integral
+            red = jnp.take(merged[ci], idx)
+            nonnull = jnp.take(merged[ci + 1], idx)
+            valid = (nonnull > 0) & live
+            aggs_out.append((jnp.where(valid, red,
+                                       jnp.zeros((), red.dtype)).astype(dt),
+                             valid))
+            ci += 2
+    return idx, tuple(keys_out), tuple(aggs_out)
+
+
+# process-wide dim-build cache: the physical plan is rebuilt per execution,
+# so instance-level memoization never survives a re-collect. Keyed by the
+# IDENTITY of the source data objects (strong refs held and re-verified, so
+# id() reuse can never alias) + the dim chain's structural description —
+# the broadcast-relation reuse semantics across replans. Bounded LRU: each
+# entry pins device arrays.
+import collections as _collections
+
+_DIM_BUILD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+_DIM_BUILD_CACHE_MAX = 8
+
+
+def clear_dim_cache() -> None:
+    """Release the cached dimension builds (host tables, source refs, and
+    the HBM key/payload arrays they pin)."""
+    _DIM_BUILD_CACHE.clear()
+
+
+def _dim_sources(plan: PhysicalPlan):
+    out = []
+    for n in plan.collect_nodes():
+        t = getattr(n, "table", None)
+        if t is not None:
+            out.append(t)
+        b = getattr(n, "batches", None)
+        if b is not None:
+            out.extend(b)
+    return out
+
+
+def _dim_structure(plan: PhysicalPlan) -> str:
+    return "|".join(n.node_desc() for n in plan.collect_nodes())
 
 
 # ---------------------------------------------------------------------------
@@ -765,7 +895,14 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         cap_d = bucket_capacity(n)
         padded = np.full(cap_d, np.iinfo(np.int64).max, np.int64)
         padded[:n] = keys
-        flat = [jnp.asarray(padded), jnp.int32(n)]
+        # dense contiguous keys (sequential PKs — the common dimension
+        # shape): probe resolves by SUBTRACTION instead of a 20-gather
+        # binary search over HBM — the probe program's dominant cost
+        dense = bool(n and keys[0] + n - 1 == keys[-1]
+                     and np.all(np.diff(keys) == 1))
+        lo = int(keys[0]) if n else 0
+        flat = [jnp.asarray(padded), jnp.int32(n),
+                jnp.int64(lo if dense else 0)]
         for o in d.payload_ordinals:
             vec = TpuColumnVector.from_arrow(sorted_tbl.column(o))
             if vec.offsets is not None or vec.host_data is not None \
@@ -780,7 +917,7 @@ class TpuCompiledJoinAggStageExec(TpuExec):
             if vv is None:
                 vv = row_mask(n, cap_d)
             flat.extend([data, vv])
-        return sorted_tbl, tuple(flat), cap_d
+        return sorted_tbl, tuple(flat), cap_d, dense
 
     # -- the run -----------------------------------------------------------
 
@@ -789,14 +926,29 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         spec = self.spec
         if self._dims_built is None:
             with self.metrics["buildTime"].timed():
-                dim_tables, dim_flats, dim_caps = [], [], []
+                dim_tables, dim_flats, dim_caps, dim_dense = [], [], [], []
                 for d in spec.dims:
-                    tbl, flat, cap_d = self._build_dim(d, ctx)
+                    key = (_dim_structure(d.plan), d.key_ordinal,
+                           tuple(d.payload_ordinals), d.semi)
+                    srcs = _dim_sources(d.plan)
+                    hit = _DIM_BUILD_CACHE.get(key)
+                    if hit is not None and len(hit[0]) == len(srcs) \
+                            and all(a is b for a, b in zip(hit[0], srcs)):
+                        built = hit[1]
+                        _DIM_BUILD_CACHE.move_to_end(key)
+                    else:
+                        built = self._build_dim(d, ctx)
+                        _DIM_BUILD_CACHE[key] = (srcs, built)
+                        while len(_DIM_BUILD_CACHE) > _DIM_BUILD_CACHE_MAX:
+                            _DIM_BUILD_CACHE.popitem(last=False)
+                    tbl, flat, cap_d, dense = built
                     dim_tables.append(tbl)
                     dim_flats.append(flat)
                     dim_caps.append(cap_d)
-                self._dims_built = (dim_tables, dim_flats, dim_caps)
-        dim_tables, dim_flats, dim_caps = self._dims_built
+                    dim_dense.append(dense)
+                self._dims_built = (dim_tables, dim_flats, dim_caps,
+                                    tuple(dim_dense))
+        dim_tables, dim_flats, dim_caps, dim_dense = self._dims_built
         held: List[SpillableColumnarBatch] = []
         carries = []
         try:
@@ -813,11 +965,18 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                 for sb in held:
                     b = sb.get_batch()
                     carries.append(self._run_batch(
-                        b, dim_flats, tuple(dim_caps), ctx))
+                        b, dim_flats, tuple(dim_caps), dim_dense, ctx))
                 # carries are G-sized (G = group-dim capacity, can be
                 # millions): merge across batches ON DEVICE and fetch ONLY
                 # the occupied groups — a full-G download through a
-                # high-latency link costs more than the whole query
+                # high-latency link costs more than the whole query.
+                # With device_output, not even the occupied groups download:
+                # the stage finalizes in HBM and emits device columns.
+                if carries and getattr(spec, "device_output", False) \
+                        and spec.grouping:
+                    out = self._device_finalize(carries, dim_flats)
+                    if out is not None:
+                        return out
                 if carries:
                     occ_np, carry_np, nocc = self._merge_and_compact(carries)
                 else:
@@ -845,27 +1004,62 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                 ops.extend([op, "sum"])
         return ops
 
+    def _merge_occ(self, carries):
+        """Shared prologue of both download paths: device merge across
+        batches + occupied-group mask (slot G-1 holds dropped rows) + the
+        single scalar sync for the occupied count."""
+        ops = tuple(self._carry_combine_ops())
+        merged = (_merge_carries_dev(tuple(carries), ops)
+                  if len(carries) > 1 else carries[0])
+        G = int(merged[0].shape[0])
+        if self.spec.grouping:
+            occ_mask = merged[0][:G - 1] > 0
+        else:
+            occ_mask = jnp.ones((1,), bool)
+        nocc = int(jnp.sum(occ_mask))  # the one scalar sync
+        return merged, occ_mask, nocc, bucket_capacity(max(nocc, 1))
+
     def _merge_and_compact(self, carries):
         """Device-side cross-batch carry merge + occupied-group compaction:
         two small programs and ONE scalar sync, then a download whose size
         scales with the RESULT (occupied groups), not the group capacity."""
-        ops = tuple(self._carry_combine_ops())
-        merged = (_merge_carries_dev(tuple(carries), ops)
-                  if len(carries) > 1 else carries[0])
-        rowcount = merged[0]
-        G = int(rowcount.shape[0])
-        if self.spec.grouping:
-            occ_mask = rowcount[:G - 1] > 0  # slot G-1 = dropped rows
-        else:
-            occ_mask = jnp.ones((1,), bool)
-        nocc = int(jnp.sum(occ_mask))  # the one scalar sync
-        cap_occ = bucket_capacity(max(nocc, 1))
+        merged, occ_mask, nocc, cap_occ = self._merge_occ(carries)
         host = jax.device_get(
             _compact_carries_dev(tuple(merged), occ_mask, cap_occ))
         return host[0][:nocc], [h[:nocc] for h in host[1:]], nocc
 
+    def _device_finalize(self, carries, dim_flats):
+        """Device-output path: merge, compact, finalize and emit a DEVICE
+        batch (one scalar sync for the row count; no aggregate download)."""
+        from .compiled import _is_fp
+        spec = self.spec
+        merged, occ_mask, nocc, cap_occ = self._merge_occ(carries)
+        gd = spec.dims[spec.group_dim]
+        key_cols = []
+        for o in spec.group_key_ordinals:
+            j = gd.payload_ordinals.index(o)
+            key_cols.append((dim_flats[spec.group_dim][3 + 2 * j],
+                             dim_flats[spec.group_dim][4 + 2 * j]))
+        fnspec = []
+        for fn in spec.agg_fns:
+            is_fp = bool(fn.children) and _is_fp(fn.children[0].dtype)
+            out_dt = np.dtype(np.float64) if fn.update_op == "avg" \
+                else np.dtype(fn.dtype.np_dtype)
+            fnspec.append((fn.update_op, is_fp, out_dt.str))
+        _, keys_out, aggs_out = _finalize_output_dev(
+            merged, occ_mask, tuple(key_cols), cap_occ, tuple(fnspec))
+        ng = len(spec.grouping)
+        cols = []
+        for (kd, kv), attr in zip(keys_out, spec.output[:ng]):
+            cols.append(TpuColumnVector(attr.dtype, kd, kv, nocc))
+        for (vd, vv), attr in zip(aggs_out, spec.output[ng:]):
+            cols.append(TpuColumnVector(attr.dtype, vd, vv, nocc))
+        self.metrics["numGroups"].add(nocc)
+        return TpuColumnarBatch(cols, nocc,
+                                [a.name for a in spec.output])
+
     def _run_batch(self, b: TpuColumnarBatch, dim_flats,
-                   dim_caps: Tuple[int, ...], ctx: TaskContext):
+                   dim_caps: Tuple[int, ...], dim_dense, ctx: TaskContext):
         spec = self.spec
         cap = b.capacity
         flat = []
@@ -877,7 +1071,8 @@ class TpuCompiledJoinAggStageExec(TpuExec):
             flat.append(col.data)
             flat.append(col.validity if col.validity is not None
                         else row_mask(b.num_rows, cap))
-        fn = _build_join_stage_fn(spec, cap, dim_caps, ctx.eval_ctx)
+        fn = _build_join_stage_fn(spec, cap, dim_caps, dim_dense,
+                                  ctx.eval_ctx)
         return fn(row_mask(b.num_rows, cap), tuple(flat), tuple(dim_flats))
 
     def _assemble_compact(self, dim_tables, occ_np, carry_np, nocc: int,

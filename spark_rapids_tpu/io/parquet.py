@@ -144,7 +144,7 @@ def _postprocess_parquet(t, path: str, options: dict, kv_metadata=None):
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    from .rebase import needs_rebase, rebase_table
+    from .rebase import needs_rebase, rebase_scope, rebase_table
     # INT96 decodes as timestamp[ns]; the engine works in micros (Spark's
     # internal unit) — normalize the unit, keep the UTC zone convention
     ns_cols = [i for i, f in enumerate(t.schema)
@@ -169,7 +169,8 @@ def _postprocess_parquet(t, path: str, options: dict, kv_metadata=None):
             except Exception:  # noqa: BLE001 — no footer: assume modern
                 kv = None
         if needs_rebase(kv, mode):
-            t = rebase_table(t)
+            dates, tss = rebase_scope(kv, mode)
+            t = rebase_table(t, rebase_dates=dates, rebase_timestamps=tss)
     return t
 
 

@@ -138,14 +138,15 @@ class DataFrameReader:
         # per-scan copy: partition metadata must not leak into later loads
         # through the same (reusable) reader object
         scan_options = dict(self._options)
-        for p in paths:
-            spec_path = os.path.join(p, "_bucket_spec.json") \
-                if os.path.isdir(p) else None
-            if spec_path and os.path.exists(spec_path):
+        if len(paths) == 1 and os.path.isdir(paths[0]):
+            # single-directory reads only: different paths may carry
+            # DIFFERENT bucket specs, and pruning with the wrong modulus
+            # silently drops rows
+            spec_path = os.path.join(paths[0], "_bucket_spec.json")
+            if os.path.exists(spec_path):
                 import json as _json
                 with open(spec_path) as f:
                     scan_options["__bucket_spec__"] = _json.load(f)
-                break
         if pcols:
             scan_options["__partition_cols__"] = [
                 (c, t) for c, t in _partition_attr_types(pcols, pvals).items()]

@@ -71,7 +71,7 @@ def julian_to_gregorian_micros(micros: np.ndarray) -> np.ndarray:
 
 
 def needs_rebase(kv_metadata: Optional[dict], mode: str) -> bool:
-    """Spark semantics: a file carrying the legacy marker always rebases;
+    """Spark semantics: a file carrying a legacy marker always rebases;
     unmarked files rebase only when the read mode forces LEGACY."""
     if kv_metadata and (LEGACY_DATETIME_KEY in kv_metadata
                        or LEGACY_INT96_KEY in kv_metadata):
@@ -79,16 +79,28 @@ def needs_rebase(kv_metadata: Optional[dict], mode: str) -> bool:
     return str(mode).upper() == "LEGACY"
 
 
-def rebase_table(table):
-    """Rewrite every date32/timestamp column of an Arrow table from hybrid
-    to proleptic values. Nested types are left untouched (legacy writers of
-    nested datetimes predate the cases this models)."""
+def rebase_scope(kv_metadata: Optional[dict], mode: str):
+    """(rebase_dates, rebase_timestamps): Spark scopes the two footer
+    markers separately (datetimeRebaseUtils.scala) — legacyINT96 covers only
+    the INT96-encoded timestamps, legacyDateTime covers dates AND
+    non-INT96 timestamps."""
+    forced = str(mode).upper() == "LEGACY"
+    has_dt = bool(kv_metadata) and LEGACY_DATETIME_KEY in kv_metadata
+    has96 = bool(kv_metadata) and LEGACY_INT96_KEY in kv_metadata
+    return (has_dt or forced, has_dt or has96 or forced)
+
+
+def rebase_table(table, rebase_dates: bool = True,
+                 rebase_timestamps: bool = True):
+    """Rewrite date32/timestamp columns of an Arrow table from hybrid
+    to proleptic values, per-type scoped. Nested types are left untouched
+    (legacy writers of nested datetimes predate the cases this models)."""
     import pyarrow as pa
     out_cols = []
     changed = False
     for col in table.columns:
         t = col.type
-        if pa.types.is_date32(t):
+        if pa.types.is_date32(t) and rebase_dates:
             arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
                 else col
             vals = np.asarray(arr.cast(pa.int32()).to_numpy(
@@ -100,7 +112,7 @@ def rebase_table(table):
                                      mask=~mask if mask is not None
                                      else None).cast(pa.date32()))
             changed = True
-        elif pa.types.is_timestamp(t):
+        elif pa.types.is_timestamp(t) and rebase_timestamps:
             arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
                 else col
             us = arr.cast(pa.timestamp("us", tz=t.tz))

@@ -110,6 +110,11 @@ class MemoryCleaner:
             IciShuffleCatalog._shutdown_instance()
         except Exception:  # noqa: BLE001 — report must never fail shutdown
             pass
+        try:
+            from ..execs.compiled_join import clear_dim_cache
+            clear_dim_cache()
+        except Exception:  # noqa: BLE001
+            pass
         leaks = self.check_leaks(raise_on_leak=False)
         if leaks:
             print(f"[spark-rapids-tpu] MemoryCleaner: {len(leaks)} leaked "
