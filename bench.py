@@ -2,7 +2,15 @@
 override engine → whole-stage compiled aggregation) on the TPU chip, with the
 hand-fused kernel as the ceiling reference and a MEASURED roofline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
+Emits CUMULATIVE JSON lines: after each stage completes, the full
+{"metric", "value", "unit", "vs_baseline", "detail"} snapshot is re-printed
+on one line with everything measured so far (VERDICT r4 #1: a driver timeout
+must lose only the tail, never the headline). The LAST printed line is always
+the most complete result; `detail.complete` is true only when every stage
+ran. Stage order: roofline calibration → q1 kernel → framework q1 + CPU
+baseline (headline printed here, target <5 min even on a cold compile
+cache) → hash-partition kernel → q6 → q3 compiled → q3 general ×2 → q3
+compiled at full 16.7M rows (soft-budget-gated bonus).
 
 Roofline methodology (VERDICT r2 weak #1): the chip sits behind a tunnel with
 a large FIXED per-dispatch+sync cost (~100 ms measured) and jax's
@@ -56,6 +64,15 @@ def _time_best(fn, iters: int = 5) -> float:
     return best
 
 
+def _quiet_explain(q) -> str:
+    """q.explain() both returns AND prints the plan; the driver parses
+    stdout's tail for the result JSON, so plan text must never reach it."""
+    import contextlib
+    import io
+    with contextlib.redirect_stdout(io.StringIO()):
+        return q.explain()
+
+
 def _calibrate() -> dict:
     """Measured roofline: tunnel dispatch overhead + achievable HBM read BW.
 
@@ -75,7 +92,7 @@ def _calibrate() -> dict:
             return jax.lax.fori_loop(0, K, body, jnp.float32(0))
         f = jax.jit(chained)
         _fetch(f(x))
-        totals[K] = _time_best(lambda f=f: _fetch(f(x)), iters=5)
+        totals[K] = _time_best(lambda f=f: _fetch(f(x)), iters=3)
     slope = max((totals[96] - totals[16]) / 80, 1e-9)
     overhead = max(totals[16] - 16 * slope, 0.0)
     del x
@@ -118,7 +135,7 @@ def _kernel_q1(n: int) -> dict:
             continue
     _fetch(q1_step(batch, cutoff))
 
-    wall = _time_best(lambda: _fetch(q1_step(batch, cutoff)), iters=8)
+    wall = _time_best(lambda: _fetch(q1_step(batch, cutoff)), iters=5)
 
     # chained device time: cutoff depends on the carry → not hoistable
     totals = {}
@@ -130,7 +147,7 @@ def _kernel_q1(n: int) -> dict:
             return jax.lax.fori_loop(0, K, body, jnp.float32(0))
         f = jax.jit(chained)
         _fetch(f(batch, cutoff))
-        totals[K] = _time_best(lambda f=f: _fetch(f(batch, cutoff)), iters=5)
+        totals[K] = _time_best(lambda f=f: _fetch(f(batch, cutoff)), iters=3)
     device_s = max((totals[50] - totals[10]) / 40, 1e-9)
     # bytes the kernel streams per pass: 2 int32 keys + 4 f32 measures +
     # int32 shipdate + bool validity = 29 B/row (+ pallas pad negligible)
@@ -186,7 +203,7 @@ def _kernel_hash_partition(n: int) -> dict:
             return jax.lax.fori_loop(0, K, body, jnp.int32(0))
         f = jax.jit(chained)
         _fetch(f(vals))
-        totals[K] = _time_best(lambda f=f: _fetch(f(vals)), iters=5)
+        totals[K] = _time_best(lambda f=f: _fetch(f(vals)), iters=3)
     device_s = max((totals[40] - totals[8]) / 32, 1e-9)
     return {
         "device_ms": round(device_s * 1e3, 3),
@@ -238,7 +255,7 @@ def _framework_q1(table) -> dict:
     s = TpuSession({"spark.rapids.sql.batchSizeRows": str(table.num_rows)})
     df = s.createDataFrame(table, num_partitions=1).device_cache()
     q = _framework_query(df)
-    plan = q.explain()
+    plan = _quiet_explain(q)
     rows = q.collect()  # warm: compiles the stage, memoizes dictionaries
     assert rows, "q1 returned nothing"
     sec = _time_best(lambda: q.collect(), iters=5)
@@ -286,7 +303,7 @@ def _framework_q3(rows: int, partitions: int, compiled: bool = True) -> dict:
         # the 16.7M-row lineitem scan
         tables["lineitem"] = tables["lineitem"].device_cache()
     q = tpch.q3(s, tables)
-    plan = q.explain()
+    plan = _quiet_explain(q)
     out = q.to_arrow()  # warm (compiles every stage in the chain)
     # the general chain is dispatch-bound (hundreds of launches at ~0.1 s
     # fixed cost each): ONE timed iteration keeps bench wall time sane;
@@ -321,8 +338,13 @@ def _cpu_q1(table) -> float:
     return _time_best(run, iters=3)
 
 
+_SOFT_BUDGET_S = float(__import__("os").environ.get("BENCH_SOFT_BUDGET_S",
+                                                    "600"))
+
+
 def main() -> None:
     import os
+    import sys
 
     import jax
     # persistent XLA compile cache: the exec chain builds hundreds of
@@ -336,103 +358,169 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — older jax: cache flag absent
         pass
 
+    t_start = time.perf_counter()
     n = 1 << 24  # 16.7M rows
+    detail = {
+        "rows": n,
+        "complete": False,
+        "baseline": "reference ETL headline 3.8x (BASELINE.md)",
+        "note": ("CUMULATIVE emission: each printed line is the full "
+                 "snapshot so far; parse the LAST line. Wall times include "
+                 "the tunnel's fixed dispatch overhead; device_* numbers "
+                 "are chained-slope marginal times (true silicon "
+                 "throughput). q3_compiled runs the whole-stage compiled "
+                 "join (one program per fact batch); the general shuffled "
+                 "path is reported at 262k rows / 4+8 partitions for "
+                 "comparability with r03. Datagen is process-stable from "
+                 "r04 (crc32 streams), so q3 numbers compare across "
+                 "rounds"),
+    }
+    headline = {"value": None, "vs_baseline": None}
+
+    def emit() -> None:
+        detail["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps({
+            "metric": "tpch_q1_framework_throughput",
+            "value": headline["value"],
+            "unit": "Mrows/s",
+            "vs_baseline": headline["vs_baseline"],
+            "detail": detail,
+        }), flush=True)
+
+    def elapsed() -> float:
+        return time.perf_counter() - t_start
+
+    def stage(name, fn, budget_guard=False):
+        """Run one bench stage; a failure or budget skip records itself in
+        the detail instead of killing the remaining stages."""
+        if budget_guard and elapsed() > _SOFT_BUDGET_S:
+            detail[name] = {"skipped": f"soft budget {_SOFT_BUDGET_S}s "
+                                       f"exceeded at {elapsed():.0f}s"}
+            emit()
+            return None
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — keep later stages alive
+            detail[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            emit()
+            return None
+
+    # ---- fast core: calibration -> q1 kernel -> CPU -> framework q1 ----
     roofline = _calibrate()
+    detail["roofline"] = roofline
+    bw = roofline["hbm_read_GBps_measured"]
+    overhead_s = roofline["dispatch_overhead_ms"] / 1e3
+    emit()
+
     kern = _kernel_q1(n)
-    hp = _kernel_hash_partition(n)
+    detail["kernel"] = {
+        **{k: v for k, v in kern.items() if k not in ("wall_s", "device_s")},
+        "fraction_of_measured_bw": round(kern["device_GBps"] / bw, 3),
+        "roofline_analysis": (
+            "the VPU-reduction kernel does 16 groups x 6 measures "
+            "x 2 flops = 192 flops/element; at its measured rate "
+            "that saturates the VPU (~1.8 Tflop/s) -- it is "
+            "COMPUTE-bound, which is why it plateaus near 36% of "
+            "HBM bw. The pallas_mxu variant moves the one-hot "
+            "contraction onto the MXU (one [16,E]x[E,8] matmul per "
+            "tile, ~20 VPU flops/element remain), putting the "
+            "kernel on the memory-bound roofline"),
+    }
 
     table = _lineitem_table(n)
+    cpu_s = _cpu_q1(table)
+    detail["cpu_ms"] = round(cpu_s * 1e3, 2)
+    detail["cpu_baseline"] = {
+        "method": ("pyarrow compute, best of 3, identical pipeline; "
+                   "thread pool = pyarrow default (recorded below). "
+                   "The shared bench host's load varies run to run -- "
+                   "treat speedup_vs_cpu per-round, not as a trend"),
+        "cpu_threads": __import__("pyarrow").cpu_count(),
+    }
+    emit()
+
     fw = _framework_q1(table)
     fw_rows_per_s = n / fw["sec"]
-    q6_s = _framework_q6(table)
-    # compiled join stage at q1-equal rows (VERDICT r3 #1 done-bar), plus
-    # the general shuffled path at BOTH partition counts (VERDICT r3 #9)
-    q3 = _framework_q3(n, 8)
-    q3_gen4 = _framework_q3(1 << 18, 4, compiled=False)
-    q3_gen8 = _framework_q3(1 << 18, 8, compiled=False)
+    speedup = fw_rows_per_s / (n / cpu_s)
+    headline["value"] = round(fw_rows_per_s / 1e6, 3)
+    headline["vs_baseline"] = round(speedup / 3.8, 3)
+    detail["speedup_vs_cpu"] = round(speedup, 2)
+    detail["framework"] = {
+        "wall_ms": round(fw["sec"] * 1e3, 2),
+        "compiled_stage": fw["compiled"],
+        "Mrows_per_s": round(fw_rows_per_s / 1e6, 1),
+        "over_kernel_wall": round(kern["wall_s"] / fw["sec"], 3),
+        "wall_minus_dispatch_ms": round(
+            max(fw["sec"] - overhead_s, 0) * 1e3, 2),
+    }
+    emit()  # ---- headline is now on stdout, whatever happens later ----
 
-    cpu_s = _cpu_q1(table)
-    cpu_rows_per_s = n / cpu_s
+    def _hp():
+        hp = _kernel_hash_partition(n)
+        detail["kernel_hash_partition"] = {
+            **hp,
+            "fraction_of_measured_bw": round(hp["device_GBps"] / bw, 3),
+            "roofline_analysis": (
+                "murmur3(long)+mod is ~25 int-ops over 12 B/row "
+                "(~2 ops/byte), right at the VPU compute/memory knee; "
+                "the measured fraction shows which side it lands on "
+                "for this chip"),
+        }
+        emit()
+    stage("kernel_hash_partition", _hp)
 
-    speedup = fw_rows_per_s / cpu_rows_per_s
-    overhead_s = roofline["dispatch_overhead_ms"] / 1e3
-    print(json.dumps({
-        "metric": "tpch_q1_framework_throughput",
-        "value": round(fw_rows_per_s / 1e6, 3),
-        "unit": "Mrows/s",
-        "vs_baseline": round(speedup / 3.8, 3),
-        "detail": {
-            "rows": n,
-            "roofline": roofline,
-            "kernel": {
-                **{k: v for k, v in kern.items()
-                   if k not in ("wall_s", "device_s")},
-                "fraction_of_measured_bw": round(
-                    kern["device_GBps"]
-                    / roofline["hbm_read_GBps_measured"], 3),
-                "roofline_analysis": (
-                    "the VPU-reduction kernel does 16 groups x 6 measures "
-                    "x 2 flops = 192 flops/element; at its measured rate "
-                    "that saturates the VPU (~1.8 Tflop/s) — it is "
-                    "COMPUTE-bound, which is why it plateaus near 36% of "
-                    "HBM bw. The pallas_mxu variant moves the one-hot "
-                    "contraction onto the MXU (one [16,E]x[E,8] matmul per "
-                    "tile, ~20 VPU flops/element remain), putting the "
-                    "kernel on the memory-bound roofline"),
-            },
-            "kernel_hash_partition": {
-                **hp,
-                "fraction_of_measured_bw": round(
-                    hp["device_GBps"]
-                    / roofline["hbm_read_GBps_measured"], 3),
-                "roofline_analysis": (
-                    "murmur3(long)+mod is ~25 int-ops over 12 B/row "
-                    "(~2 ops/byte), right at the VPU compute/memory knee; "
-                    "the measured fraction shows which side it lands on "
-                    "for this chip"),
-            },
-            "framework": {
-                "wall_ms": round(fw["sec"] * 1e3, 2),
-                "compiled_stage": fw["compiled"],
-                "Mrows_per_s": round(fw_rows_per_s / 1e6, 1),
-                "over_kernel_wall": round(kern["wall_s"] / fw["sec"], 3),
-                "wall_minus_dispatch_ms": round(
-                    max(fw["sec"] - overhead_s, 0) * 1e3, 2),
-            },
-            "q3_join_shuffle": {
-                "wall_ms": round(q3["sec"] * 1e3, 2),
-                "lineitem_rows": q3["lineitem_rows"],
-                "rows_out": q3["rows_out"],
-                "Mrows_per_s": round(
-                    q3["lineitem_rows"] / q3["sec"] / 1e6, 2),
-                "compiled_join_stage": q3["compiled_join_stage"],
-                "over_q1_wall": round(q3["sec"] / fw["sec"], 2),
-                "general_path_4part_ms": round(q3_gen4["sec"] * 1e3, 1),
-                "general_path_8part_ms": round(q3_gen8["sec"] * 1e3, 1),
-                "general_path_rows": q3_gen4["lineitem_rows"],
-            },
-            "q6_framework_ms": round(q6_s * 1e3, 2),
-            "cpu_ms": round(cpu_s * 1e3, 2),
-            "cpu_baseline": {
-                "method": ("pyarrow compute, best of 3, identical pipeline; "
-                           "thread pool = pyarrow default (recorded below). "
-                           "r02→r03 cpu_ms halved because the shared bench "
-                           "host's load varies run to run — treat "
-                           "speedup_vs_cpu per-round, not as a trend"),
-                "cpu_threads": __import__("pyarrow").cpu_count(),
-            },
-            "speedup_vs_cpu": round(speedup, 2),
-            "baseline": "reference ETL headline 3.8x (BASELINE.md)",
-            "note": ("wall times include the tunnel's fixed ~dispatch "
-                     "overhead; device_* numbers are chained-slope marginal "
-                     "times (true silicon throughput). q3 now runs the "
-                     "compiled join stage (one program per fact batch) at "
-                     "q1-equal rows; the general shuffled path is reported "
-                     "at 262k rows / 4+8 partitions for comparability with "
-                     "r03. Datagen is process-stable from r04 (crc32 "
-                     "streams), so q3 numbers compare across rounds"),
-        },
-    }))
+    def _q6():
+        q6_s = _framework_q6(table)
+        detail["q6_framework_ms"] = round(q6_s * 1e3, 2)
+        emit()
+    stage("q6_framework_ms", _q6)
+
+    def _q3_compiled():
+        q3 = _framework_q3(1 << 22, 8)
+        detail["q3_compiled"] = {
+            "wall_ms": round(q3["sec"] * 1e3, 2),
+            "lineitem_rows": q3["lineitem_rows"],
+            "rows_out": q3["rows_out"],
+            "Mrows_per_s": round(q3["lineitem_rows"] / q3["sec"] / 1e6, 2),
+            "compiled_join_stage": q3["compiled_join_stage"],
+        }
+        emit()
+    stage("q3_compiled", _q3_compiled)
+
+    def _q3_gen(parts):
+        def run():
+            g = _framework_q3(1 << 18, parts, compiled=False)
+            detail.setdefault("q3_general", {})[f"{parts}part"] = {
+                "wall_ms": round(g["sec"] * 1e3, 1),
+                "lineitem_rows": g["lineitem_rows"],
+                "rows_out": g["rows_out"],
+            }
+            emit()
+        return run
+    stage("q3_general_4part", _q3_gen(4), budget_guard=True)
+    stage("q3_general_8part", _q3_gen(8), budget_guard=True)
+
+    def _q3_big():
+        q3 = _framework_q3(n, 8)
+        detail["q3_compiled_16M"] = {
+            "wall_ms": round(q3["sec"] * 1e3, 2),
+            "lineitem_rows": q3["lineitem_rows"],
+            "rows_out": q3["rows_out"],
+            "Mrows_per_s": round(q3["lineitem_rows"] / q3["sec"] / 1e6, 2),
+            "compiled_join_stage": q3["compiled_join_stage"],
+            "over_q1_wall": round(q3["sec"] / fw["sec"], 2),
+        }
+        emit()
+    stage("q3_compiled_16M", _q3_big, budget_guard=True)
+
+    ok_keys = ("kernel_hash_partition", "q6_framework_ms", "q3_compiled",
+               "q3_general_4part", "q3_general_8part", "q3_compiled_16M")
+    detail["complete"] = not any(
+        isinstance(detail.get(k), dict)
+        and ("skipped" in detail[k] or "error" in detail[k])
+        for k in ok_keys)
+    emit()
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -528,6 +528,115 @@ class ExactDFA(DFA):
         self.min_len = min_len
 
 
+def _flatten_atoms(node: _Node) -> List[_Node]:
+    """Top-level atom sequence: Concats inlined, quantified nodes atomic."""
+    if isinstance(node, _Concat):
+        out: List[_Node] = []
+        for p in node.parts:
+            out.extend(_flatten_atoms(p))
+        return out
+    return [node]
+
+
+def _contains_var(node: _Node) -> bool:
+    if isinstance(node, (_Star, _Alt)):
+        return True
+    if isinstance(node, _Concat):
+        return any(_contains_var(p) for p in node.parts)
+    return False
+
+
+def _byteset(node: _Node) -> FrozenSet[int]:
+    if isinstance(node, _Lit):
+        return node.bytes
+    if isinstance(node, _Star):
+        return _byteset(node.inner)
+    if isinstance(node, (_Concat, _Alt)):
+        out: Set[int] = set()
+        for p in node.parts:
+            out |= _byteset(p)
+        return frozenset(out)
+    return frozenset()
+
+
+def _nullable(node: _Node) -> bool:
+    if isinstance(node, (_Star, _Empty)):
+        return True
+    if isinstance(node, _Alt):
+        return any(_nullable(p) for p in node.parts)
+    if isinstance(node, _Concat):
+        return all(_nullable(p) for p in node.parts)
+    return False
+
+
+def _first_set(node: _Node) -> FrozenSet[int]:
+    if isinstance(node, _Lit):
+        return node.bytes
+    if isinstance(node, _Star):
+        return _first_set(node.inner)
+    if isinstance(node, _Alt):
+        out: Set[int] = set()
+        for p in node.parts:
+            out |= _first_set(p)
+        return frozenset(out)
+    if isinstance(node, _Concat):
+        out = set()
+        for p in node.parts:
+            out |= _first_set(p)
+            if not _nullable(p):
+                break
+        return frozenset(out)
+    return frozenset()
+
+
+def _var_atom(seg: _Node) -> Optional[_Node]:
+    """The single-repetition atom of a variable-length segment, or None if
+    the segment is fixed. After the global alternation rejection, any _Alt
+    is a desugared '?' / '{m,n}' optional: [node, _Empty]."""
+    if isinstance(seg, _Star):
+        return seg.inner
+    if isinstance(seg, _Alt):
+        real = [p for p in seg.parts if not isinstance(p, _Empty)]
+        return real[0] if len(real) == 1 else seg
+    return None
+
+
+def _reject_ambiguous_span(ast: _Node) -> None:
+    """Greedy backtracking (Java) == leftmost-longest (this DFA) only for
+    unambiguous-match-length patterns (ADVICE r4 high). Divergence needs a
+    variable-length segment V followed — across only nullable segments — by
+    another variable segment W whose first-set overlaps V's bytes, where at
+    least one of the two repeats a MULTI-byte atom: for `a+(ab)?` on "aab"
+    Java matches "aa" (greedy a+ never gives bytes back to lengthen the
+    total) while the DFA takes "aab". Single-byte-atom chains (a{0,2}x?,
+    [ab]*c*) are safe: one byte per repetition means surrendering a byte to
+    a later single-byte quantifier never extends the overall end. Nested
+    variable quantifiers ((a*b)+) are rejected outright — their inner
+    backtracking order is beyond this static check."""
+    segs = _flatten_atoms(ast)
+    atoms = [_var_atom(s) for s in segs]
+    for i, ai in enumerate(atoms):
+        if ai is None:
+            continue
+        if _contains_var(ai):
+            raise RegexReject("nested variable quantifier span")
+        multi_i = ai.count() >= 2
+        bytes_i = _byteset(segs[i])
+        for j in range(i + 1, len(segs)):
+            aj = atoms[j]
+            if aj is not None:
+                if (bytes_i & _first_set(segs[j])
+                        and (multi_i or aj.count() >= 2)):
+                    raise RegexReject("ambiguous greedy span: variable "
+                                      "segments with overlapping byte sets")
+            # a required segment ends the competition window ONLY if V
+            # could never have consumed it: a required atom overlapping V's
+            # bytes may sit inside V's territory ((ab)*a(bab)? — the 'a'
+            # does not fence off the later (bab)?), so keep scanning
+            if not _nullable(segs[j]) and not (_byteset(segs[j]) & bytes_i):
+                break
+
+
 @functools.lru_cache(maxsize=256)
 def compile_exact_dfa(pattern: str) -> Optional["ExactDFA"]:
     """Compile for SPAN matching (longest match starting at a position), or
@@ -551,6 +660,7 @@ def compile_exact_dfa(pattern: str) -> Optional["ExactDFA"]:
             raise RegexReject("lazy quantifier span")
         if ast.count() > MAX_EXPANSION:
             raise RegexReject("pattern too large")
+        _reject_ambiguous_span(ast)
         nfa = _NFA()
         start = nfa.new_state()
         accept = nfa.new_state()

@@ -363,6 +363,43 @@ class DataFrame:
 
     unionAll = union
 
+    def _set_op(self, other: "DataFrame", keep_right: bool) -> "DataFrame":
+        """INTERSECT / EXCEPT (distinct set semantics). Where Spark rewrites
+        to null-aware semi/anti joins (ReplaceIntersectWithSemiJoin), the
+        TPU lowering rides the aggregate engine instead: union both sides
+        tagged, GROUP BY every column (grouping already treats NULL keys as
+        equal — exactly the null-safe equality set ops need), then filter on
+        which sides contributed. One shuffle, no join, device-typed
+        throughout (joins here can't hash null string keys as equal)."""
+        from .expressions.aggregates import Max
+        from .expressions.base import Alias
+        if len(self._plan.output) != len(other._plan.output):
+            raise ValueError("set op requires equal column counts")
+        names = [a.name for a in self._plan.output]
+        Fn = _functions()
+        tag = lambda df, l, r: df.select(  # noqa: E731
+            *[Column(a).alias(n) for a, n in zip(df._plan.output, names)],
+            Fn.lit(l).alias("__setop_l"), Fn.lit(r).alias("__setop_r"))
+        u = tag(self, 1, 0).union(tag(other, 0, 1))
+        keys = list(u._plan.output[:len(names)])
+        aggs = [Alias(Max(u._plan.output[len(names)]), "__l"),
+                Alias(Max(u._plan.output[len(names) + 1]), "__r")]
+        g = DataFrame(L.Aggregate(keys, aggs, u._plan), self.session)
+        cond = (Fn.col("__l") == 1) & ((Fn.col("__r") == 1) if keep_right
+                                       else (Fn.col("__r") == 0))
+        return g.filter(cond).select(*names)
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        return self._set_op(other, keep_right=True)
+
+    def exceptDistinct(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT DISTINCT — pyspark exposes this as `subtract`. (pyspark's
+        `exceptAll` is duplicate-PRESERVING and is deliberately not aliased
+        to this; it is not implemented.)"""
+        return self._set_op(other, keep_right=False)
+
+    subtract = exceptDistinct
+
     def sort(self, *cols, ascending: Union[bool, List[bool], None] = None) -> "DataFrame":
         order = []
         for i, c in enumerate(cols):
@@ -738,6 +775,11 @@ def _coerce_join_keys(lk: List[Expression], rk: List[Expression]):
         out_l.append(a if type(ta) is type(common) else Cast(a, common))
         out_r.append(b if type(tb) is type(common) else Cast(b, common))
     return out_l, out_r
+
+
+def _functions():
+    from . import functions as F
+    return F
 
 
 def _extract_equi_keys(cond: Expression, left, right):

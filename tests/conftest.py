@@ -27,9 +27,13 @@ if os.environ.get("SRT_LEAK_GATE"):
     def pytest_sessionfinish(session, exitstatus):
         if exitstatus != 0:
             return
+        from spark_rapids_tpu.execs.compiled_join import clear_dim_cache
         from spark_rapids_tpu.memory.cleaner import MemoryCleaner
         from spark_rapids_tpu.shuffle.ici import IciShuffleCatalog
+        # free OWNED state first, same as MemoryCleaner._at_shutdown, so
+        # the gate checks exactly what the shutdown report would show
         IciShuffleCatalog._shutdown_instance()
+        clear_dim_cache()
         leaks = MemoryCleaner.get().check_leaks()
         if leaks:
             import sys

@@ -216,3 +216,29 @@ def test_join_key_type_mismatch_raises():
     r = s.createDataFrame(pa.table({"k2": [1, 2]}))
     with _pytest.raises(ValueError, match="join key type mismatch"):
         l.join(r, on=l["k"] == r["k2"])
+
+
+def test_intersect_except_null_safe_vs_cpu():
+    """INTERSECT/EXCEPT distinct semantics incl. NULL = NULL (Spark
+    ReplaceIntersectWithSemiJoin / ReplaceExceptWithAntiJoin null-aware
+    equality); TPU plan must match the CPU oracle."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.session import TpuSession
+
+    def run(tpu):
+        s = TpuSession({"spark.rapids.sql.enabled": str(tpu).lower()})
+        a = s.createDataFrame(pa.table(
+            {"x": [1, 2, 3, None, 2, 2], "y": ["a", "b", "c", None, "b", "B"]}))
+        b = s.createDataFrame(pa.table(
+            {"x": [2, None, 9, 3], "y": ["b", None, "z", "nope"]}))
+        i = sorted(map(str, a.intersect(b).collect()))
+        e = sorted(map(str, a.exceptDistinct(b).collect()))
+        sub = sorted(map(str, a.subtract(b).collect()))
+        return i, e, sub
+
+    got, want = run(True), run(False)
+    assert got == want
+    i, e, _ = got
+    assert "{'x': None, 'y': None}" in i  # NULL row matched null-safely
+    assert len(i) == 2 and len(e) == 3  # distinct semantics: dup 2/b collapsed

@@ -210,6 +210,50 @@ def test_span_subset_rejections():
     assert e._device_replace(c, batch) is None
 
 
+def test_ambiguous_greedy_span_rejected():
+    """ADVICE r4 high: greedy backtracking (Java) is not leftmost-longest
+    when a variable segment is followed by an overlapping variable segment
+    with a multi-byte atom — those patterns must fall back to host. The
+    canonical case: re.sub('xa{0,2}(ab)?', 'R', 'xaab') == 'Rb' (Java
+    matches 'xaa'), while a longest-match DFA would take 'xaab'."""
+    from spark_rapids_tpu.kernels.regex_dfa import compile_exact_dfa
+    for pat in ["a+(ab)?", "xa{0,2}(ab)?", "a*(ab)*", "(ab)?(aba)?",
+                "(a*b)+", "[ab]+(ba)?"]:
+        assert compile_exact_dfa(pat) is None, pat
+    # single-byte-atom chains stay on device (greedy == longest for them)
+    for pat in ["a{2,4}", "x[ab]{0,3}", "[0-9]{1,3}", "a+b*", "abc[0-9]*"]:
+        assert compile_exact_dfa(pat) is not None, pat
+
+
+def test_overlap_structure_fuzz_vs_python():
+    """Fuzz with patterns that HAVE the overlap structure (ADVICE r4): any
+    such pattern either rejects (host path) or, if admitted, must agree
+    with python re on every row."""
+    import re as _re
+
+    import numpy.random as npr
+
+    from spark_rapids_tpu.expressions.regex import RegexpReplace
+    rng = npr.default_rng(11)
+    alpha = "aabx"
+    subjects = ["".join(rng.choice(list(alpha), size=rng.integers(0, 10)))
+                for _ in range(150)]
+    pats = ["a+(ab)?", "xa{0,2}(ab)?", "a*(ab)*b", "(ab)?(aba)?x",
+            "a+(ba)?", "[ab]{1,2}(bx)?", "a{1,3}b?", "x?a+", "(ab)+x?",
+            "a+(ab){1,2}"]
+    for pat in pats:
+        batch, col, ref = _batch(subjects)
+        e = RegexpReplace(ref, pat, "R")
+        c = e.children[0].eval_tpu(batch)
+        dev = e._device_replace(c, batch)
+        if dev is None:
+            continue  # host fallback: correct by construction
+        got = dev.to_arrow().to_pylist()[:len(subjects)]
+        want = [_re.sub(pat, "R", v) for v in subjects]
+        assert got == want, (pat, [x for x in zip(subjects, got, want)
+                                   if x[1] != x[2]][:3])
+
+
 def test_device_replace_fuzz_vs_python():
     """Random short strings over a small alphabet: device replace must agree
     with python re.sub (which matches Java for this subset) on every row."""
