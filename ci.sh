@@ -27,8 +27,11 @@ echo "== tests (+ leak gate) =="
 STDERR_LOG=$(mktemp)
 trap 'rm -f "$STDERR_LOG"' EXIT
 # plain redirection (NOT a >(tee ...) substitution: bash doesn't wait for
-# the tee, so a grep could read a partial file); replayed to stderr after
-SRT_LEAK_GATE=1 python -m pytest tests/ -x -q 2> "$STDERR_LOG"
+# the tee, so a grep could read a partial file); replayed to stderr after —
+# including on failure, or set -e would discard the diagnostics (and the
+# EXIT trap the log) before anyone sees them
+SRT_LEAK_GATE=1 python -m pytest tests/ -x -q 2> "$STDERR_LOG" \
+  || { cat "$STDERR_LOG" >&2; exit 1; }
 cat "$STDERR_LOG" >&2
 
 echo "== shutdown leak report =="

@@ -927,9 +927,15 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         if self._dims_built is None:
             with self.metrics["buildTime"].timed():
                 dim_tables, dim_flats, dim_caps, dim_dense = [], [], [], []
+                from ..config import ANSI_ENABLED, SESSION_TZ
+                # eval-relevant session conf is part of the key: the same
+                # dim plan under a different timezone/ANSI setting must not
+                # reuse a stale build across sessions sharing source tables
+                conf_fp = (ctx.conf.get(SESSION_TZ),
+                           ctx.conf.get(ANSI_ENABLED))
                 for d in spec.dims:
                     key = (_dim_structure(d.plan), d.key_ordinal,
-                           tuple(d.payload_ordinals), d.semi)
+                           tuple(d.payload_ordinals), d.semi, conf_fp)
                     srcs = _dim_sources(d.plan)
                     hit = _DIM_BUILD_CACHE.get(key)
                     if hit is not None and len(hit[0]) == len(srcs) \

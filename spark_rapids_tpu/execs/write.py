@@ -30,6 +30,11 @@ class WriteSpec:
     options: Dict[str, str] = field(default_factory=dict)
     bucket_by: List[str] = field(default_factory=list)
     num_buckets: int = 0
+    # unique per write job (Spark's part-NNNNN-<uuid> naming): append jobs
+    # must never reuse an earlier job's file names, or they silently
+    # overwrite its output
+    job_id: str = field(default_factory=lambda: __import__("uuid")
+                        .uuid4().hex[:8])
 
     def _bucket_ids(self, table):
         """Spark bucketing: pmod(murmur3(bucket cols, seed 42), n) — the
@@ -53,15 +58,17 @@ class WriteSpec:
         import numpy as np
         import pyarrow as pa
         if not self.num_buckets:
-            self.write_fn(table,
-                          os.path.join(d, f"part-{part_idx:05d}.{self.ext}"))
+            self.write_fn(table, os.path.join(
+                d, f"part-{part_idx:05d}-{self.job_id}.{self.ext}"))
             return 1
         ids = self._bucket_ids(table)
         n = 0
         for b in np.unique(ids):
             sub = table.filter(pa.array(ids == b))
             self.write_fn(sub, os.path.join(
-                d, f"part-{part_idx:05d}_{int(b):05d}.{self.ext}"))
+                d,
+                f"part-{part_idx:05d}-{self.job_id}_{int(b):05d}"
+                f".{self.ext}"))
             n += 1
         return n
 

@@ -169,7 +169,21 @@ def _postprocess_parquet(t, path: str, options: dict, kv_metadata=None):
             except Exception:  # noqa: BLE001 — no footer: assume modern
                 kv = None
         if needs_rebase(kv, mode):
-            dates, tss = rebase_scope(kv, mode)
+            # physical types from the footer: each legacy marker only
+            # rebases its own encoding's columns (legacyINT96 → INT96,
+            # legacyDateTime → dates + INT64 timestamps). Only opened on
+            # the rebase path — the common CORRECTED case never re-reads
+            # the footer.
+            int96 = None
+            try:
+                int96 = {c.name for c in pq.ParquetFile(path).schema
+                         if c.physical_type == "INT96"}
+            except Exception:  # noqa: BLE001
+                pass
+            ts_names = [f.name for f in t.schema
+                        if pa.types.is_timestamp(f.type)]
+            dates, tss = rebase_scope(kv, mode, int96_cols=int96,
+                                      ts_cols=ts_names)
             t = rebase_table(t, rebase_dates=dates, rebase_timestamps=tss)
     return t
 
@@ -423,11 +437,12 @@ class FileScanBase:
         h = _np_hash_col(attr.dtype, arr, seeds).view(np.int32).astype(
             np.int64)[0]
         bucket = int(((h % n) + n) % n)
-        pat = _re.compile(rf"part-\d+_{bucket:05d}\.")
+        pat = _re.compile(rf"part-[^/]*_{bucket:05d}\.")
         kept = [f for f in files if pat.search(os.path.basename(f))]
         # unbucketed files (no _BBBBB suffix) must always be read
         plain = [f for f in files
-                 if not _re.search(r"part-\d+_\d{5}\.", os.path.basename(f))]
+                 if not _re.search(r"part-[^/]*_\d{5}\.",
+                                   os.path.basename(f))]
         return kept + plain
 
     def _partition_tables(self, idx: int, ctx: TaskContext) -> Iterator:

@@ -79,26 +79,52 @@ def needs_rebase(kv_metadata: Optional[dict], mode: str) -> bool:
     return str(mode).upper() == "LEGACY"
 
 
-def rebase_scope(kv_metadata: Optional[dict], mode: str):
+def rebase_scope(kv_metadata: Optional[dict], mode: str,
+                 int96_cols=None, ts_cols=None):
     """(rebase_dates, rebase_timestamps): Spark scopes the two footer
     markers separately (datetimeRebaseUtils.scala) — legacyINT96 covers only
     the INT96-encoded timestamps, legacyDateTime covers dates AND
-    non-INT96 timestamps."""
+    non-INT96 timestamps.
+
+    When the file's INT96 column names are known (`int96_cols` + the
+    file's timestamp column names `ts_cols`), the second element is the
+    exact SET of timestamp columns to rebase, so a legacyDateTime-only
+    marker never touches an INT96 column written CORRECTED and vice
+    versa. Without that knowledge, it degrades to a bool that
+    conservatively covers all timestamps."""
     forced = str(mode).upper() == "LEGACY"
     has_dt = bool(kv_metadata) and LEGACY_DATETIME_KEY in kv_metadata
     has96 = bool(kv_metadata) and LEGACY_INT96_KEY in kv_metadata
-    return (has_dt or forced, has_dt or has96 or forced)
+    if int96_cols is None or ts_cols is None:
+        return (has_dt or forced, has_dt or has96 or forced)
+    sel = set()
+    for name in ts_cols:
+        if name in int96_cols:
+            if has96 or forced:
+                sel.add(name)
+        elif has_dt or forced:
+            sel.add(name)
+    return (has_dt or forced, sel)
 
 
 def rebase_table(table, rebase_dates: bool = True,
-                 rebase_timestamps: bool = True):
+                 rebase_timestamps=True):
     """Rewrite date32/timestamp columns of an Arrow table from hybrid
-    to proleptic values, per-type scoped. Nested types are left untouched
-    (legacy writers of nested datetimes predate the cases this models)."""
+    to proleptic values, per-type scoped. `rebase_timestamps` is a bool
+    covering every timestamp column, or a set of column names (the
+    per-physical-type scoping from rebase_scope). Nested types are left
+    untouched (legacy writers of nested datetimes predate the cases this
+    models)."""
     import pyarrow as pa
+
+    def ts_selected(name) -> bool:
+        if isinstance(rebase_timestamps, bool):
+            return rebase_timestamps
+        return name in rebase_timestamps
+
     out_cols = []
     changed = False
-    for col in table.columns:
+    for name, col in zip(table.column_names, table.columns):
         t = col.type
         if pa.types.is_date32(t) and rebase_dates:
             arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
@@ -112,7 +138,7 @@ def rebase_table(table, rebase_dates: bool = True,
                                      mask=~mask if mask is not None
                                      else None).cast(pa.date32()))
             changed = True
-        elif pa.types.is_timestamp(t) and rebase_timestamps:
+        elif pa.types.is_timestamp(t) and ts_selected(name):
             arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
                 else col
             us = arr.cast(pa.timestamp("us", tz=t.tz))

@@ -120,7 +120,22 @@ class DataFrameWriter:
                 bucket_by, num_buckets = [], 0
             else:
                 import json as _json
-                with open(os.path.join(path, "_bucket_spec.json"), "w") as f:
+                spec_path = os.path.join(path, "_bucket_spec.json")
+                if self._mode == "append" and os.path.exists(spec_path):
+                    # appending with a different bucket spec would leave
+                    # files hashed under two moduli behind one sidecar —
+                    # read-side pruning would silently drop rows (Spark
+                    # rejects the same mismatch at the catalog layer)
+                    with open(spec_path) as f:
+                        old = _json.load(f)
+                    if (old.get("numBuckets") != num_buckets
+                            or old.get("bucketColumns") != bucket_by):
+                        raise ValueError(
+                            f"append to {path} with bucket spec "
+                            f"({num_buckets}, {bucket_by}) conflicts with "
+                            f"existing ({old.get('numBuckets')}, "
+                            f"{old.get('bucketColumns')})")
+                with open(spec_path, "w") as f:
                     _json.dump({"numBuckets": num_buckets,
                                 "bucketColumns": bucket_by}, f)
         spec = WriteSpec(fmt or ext, path, ext, write_fn,

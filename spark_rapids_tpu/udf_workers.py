@@ -120,6 +120,7 @@ class PythonWorkerPool:
         self._idle_cv = threading.Condition(self._lock)
         self._idle: List[_Worker] = [_Worker(self._ctx)
                                      for _ in range(num_workers)]
+        self._num_workers = num_workers
         self._in_flight = 0
         self._high_water = 0
         self._closed = False
@@ -130,11 +131,18 @@ class PythonWorkerPool:
 
     def _acquire_worker(self) -> _Worker:
         with self._idle_cv:
-            while not self._idle and not self._closed:
+            while not self._idle and not self._closed \
+                    and self._in_flight >= self._num_workers:
                 self._idle_cv.wait()
             if self._closed:
                 raise RuntimeError("worker pool is shut down")
-            w = self._idle.pop()
+            if self._idle:
+                w = self._idle.pop()
+            else:
+                # idle empty but capacity remains: a replacement spawn
+                # failed earlier and shrank the pool — respawn lazily so
+                # capacity self-heals instead of callers blocking forever
+                w = _Worker(self._ctx)
             self._in_flight += 1
             if self._in_flight > self._high_water:
                 self._high_water = self._in_flight
@@ -171,7 +179,10 @@ class PythonWorkerPool:
                     if not w.conn.poll(timeout):
                         w.kill()
                         replacement = None  # never requeue the dead worker
-                        replacement = _Worker(self._ctx)
+                        try:
+                            replacement = _Worker(self._ctx)
+                        except Exception:  # noqa: BLE001
+                            pass  # pool self-heals in _acquire_worker
                         raise TimeoutError("python UDF worker timed out")
                     status, payload = w.conn.recv()
                 except TimeoutError:
@@ -180,7 +191,10 @@ class PythonWorkerPool:
                     # worker died mid-task (crash/OOM): replace it
                     w.kill()
                     replacement = None  # never requeue the dead worker
-                    replacement = _Worker(self._ctx)
+                    try:
+                        replacement = _Worker(self._ctx)
+                    except Exception:  # noqa: BLE001
+                        pass  # pool self-heals in _acquire_worker
                     raise RuntimeError(f"python UDF worker died: {e!r}")
             finally:
                 self._release_worker(replacement)

@@ -98,7 +98,9 @@ def test_avro_write_through_session(tmp_path, session):
     write_avro(t, src)
     out = str(tmp_path / "out")
     session.read.avro(src).write.format("avro").save(out)
-    back = session.read.avro(out + "/part-00000.avro").collect()
+    import glob as _glob
+    back = session.read.avro(
+        _glob.glob(out + "/part-*.avro")[0]).collect()
     assert len(back) == 300
 
 

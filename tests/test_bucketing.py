@@ -84,3 +84,17 @@ def test_bucket_pruning_int32_column(tmp_path):
     for probe in (0, 7, 33, 59):
         rows = rdf.filter(F.col("k") == probe).collect()
         assert rows == [{"k": probe, "v": probe}], (probe, rows)
+
+
+def test_bucketed_append_spec_mismatch_rejected(tmp_path):
+    """Appending with a different bucket spec must fail, not silently mix
+    two hash moduli behind one sidecar (ADVICE r4)."""
+    s, path = _write(tmp_path, n_buckets=4)
+    t2 = pa.table({"k": [200, 201], "v": ["a", "b"]})
+    df2 = s.createDataFrame(t2)
+    with pytest.raises(ValueError, match="bucket spec"):
+        df2.write.bucketBy(8, "k").mode("append").parquet(path)
+    # same spec appends fine and stays readable
+    df2.write.bucketBy(4, "k").mode("append").parquet(path)
+    out = s.read.parquet(path).to_arrow()
+    assert out.num_rows == 102
