@@ -61,12 +61,14 @@ def _np_to_jax(arr: np.ndarray):
 def device_layout_ok(dt: DataType) -> bool:
     """Whether a type has a device (jax.Array) layout. Structs are device-
     resident as child-column tuples (cuDF STRUCT ColumnView analogue);
-    maps stay host-side (host_data-backed columns); decimal beyond
-    precision 18 carries as two int64 limbs per row (kernels/decimal128.py,
-    reference spark-rapids-jni DecimalUtils __int128)."""
+    maps are offsets + a struct<key,value> child (cuDF LIST-of-STRUCT,
+    exactly Spark's MapVector layout); decimal beyond precision 18 carries
+    as two int64 limbs per row (kernels/decimal128.py, reference
+    spark-rapids-jni DecimalUtils __int128)."""
     from ..types import MapType, StructType
     if isinstance(dt, MapType):
-        return False
+        return device_layout_ok(dt.key_type) \
+            and device_layout_ok(dt.value_type)
     if isinstance(dt, StructType):
         return all(device_layout_ok(f.data_type) for f in dt.fields)
     if isinstance(dt, ArrayType):
@@ -170,6 +172,30 @@ class TpuColumnVector:
                                for f, k in zip(fields, kids)])
             return pa.Array.from_buffers(atype, n, [bitmap],
                                          null_count=nulls, children=kids)
+        from ..types import MapType as _Mt
+        if isinstance(self.dtype, _Mt):
+            offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
+            n_elems = int(offs[-1]) if n else 0
+            keys = self.child.children[0].to_arrow()
+            items = self.child.children[1].to_arrow()
+            if len(keys) != n_elems:
+                keys = keys.slice(0, n_elems)
+            if len(items) != n_elems:
+                items = items.slice(0, n_elems)
+            if mask is not None:
+                bitmap = pa.py_buffer(np.packbits(
+                    valid, bitorder="little").tobytes())
+                nulls = int(mask.sum())
+            else:
+                bitmap, nulls = None, 0
+            atype = pa.map_(keys.type, items.type)
+            entries = pa.StructArray.from_arrays(
+                [keys, items],
+                fields=[pa.field("key", keys.type, nullable=False),
+                        pa.field("value", items.type, nullable=True)])
+            return pa.Array.from_buffers(
+                atype, n, [bitmap, pa.py_buffer(offs.tobytes())],
+                null_count=nulls, children=[entries])
         if isinstance(self.dtype, ArrayType):
             offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
             n_elems = int(offs[-1]) if n else 0
@@ -290,6 +316,41 @@ class TpuColumnVector:
                 vmask = _np_to_jax(v)
             return TpuColumnVector(dtype, jnp.zeros((0,), jnp.int8), vmask,
                                    n, children=kids)
+        from ..types import MapType as _Mt, StructField as _Sf
+        if isinstance(dtype, _Mt):
+            # map = offsets + struct<key,value> child (cuDF LIST-of-STRUCT)
+            bufs = arr.buffers()
+            off0 = arr.offset
+            offsets = np.frombuffer(bufs[1], dtype=np.int32,
+                                    count=n + 1, offset=off0 * 4).copy()
+            base = int(offsets[0])
+            offsets -= base
+            n_elems = int(offsets[-1])
+            entry_t = _St([_Sf("key", dtype.key_type, False),
+                           _Sf("value", dtype.value_type,
+                               dtype.value_contains_null)])
+            kcol = TpuColumnVector.from_arrow(
+                arr.keys.slice(base, n_elems), bucket=bucket)
+            vcol = TpuColumnVector.from_arrow(
+                arr.items.slice(base, n_elems), bucket=bucket)
+            ecap = max(kcol.capacity, vcol.capacity)
+            from .batch import _repad
+            if kcol.capacity != ecap:
+                kcol = _repad(kcol, ecap)
+            if vcol.capacity != ecap:
+                vcol = _repad(vcol, ecap)
+            child = TpuColumnVector(entry_t, jnp.zeros((0,), jnp.int8),
+                                    None, n_elems, children=[kcol, vcol])
+            cap = bucket_capacity(n, bucket)
+            obuf = np.full(cap + 1, n_elems, dtype=np.int32)
+            obuf[: n + 1] = offsets
+            vmask = None
+            if validity is not None and not validity.all():
+                v = np.zeros(cap, dtype=bool)
+                v[:n] = validity
+                vmask = _np_to_jax(v)
+            return TpuColumnVector(dtype, kcol.data, vmask, n,
+                                   offsets=_np_to_jax(obuf), child=child)
         if isinstance(dtype, ArrayType):
             if pa.types.is_large_list(arr.type):
                 arr = arr.cast(pa.list_(arr.type.value_type))

@@ -458,6 +458,7 @@ def tpcds_household_demographics(n: int = 720) -> TableSpec:
         ColumnSpec("hd_buy_potential", "choice", values=_DS_BUY_POTENTIAL),
         ColumnSpec("hd_dep_count", "int", min_val=0, max_val=9),
         ColumnSpec("hd_vehicle_count", "int", min_val=-1, max_val=4),
+        ColumnSpec("hd_income_band_sk", "key", cardinality=20),
     ])
 
 
@@ -503,6 +504,42 @@ def tpcds_ship_mode(n: int = 10) -> TableSpec:
             "EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]),
         ColumnSpec("sm_carrier", "choice", values=[
             "UPS", "FEDEX", "AIRBORNE", "USPS", "DHL"]),
+    ])
+
+
+def tpcds_reason(n: int = 35) -> TableSpec:
+    return TableSpec("reason", [
+        ColumnSpec("r_reason_sk", "seq"),
+        ColumnSpec("r_reason_desc", "choice",
+                   values=[f"reason {i:02d}" for i in range(n)],
+                   sequential=True),
+    ])
+
+
+def tpcds_call_center(n: int = 4) -> TableSpec:
+    return TableSpec("call_center", [
+        ColumnSpec("cc_call_center_sk", "seq"),
+        ColumnSpec("cc_name", "choice",
+                   values=[f"call_center_{i}" for i in range(n)],
+                   sequential=True),
+        ColumnSpec("cc_manager", "choice",
+                   values=[f"manager_{i}" for i in range(8)]),
+    ])
+
+
+def tpcds_income_band(n: int = 20) -> TableSpec:
+    def _lower(cols, rng, m, offset=0):
+        return pa.array(np.arange(offset, offset + m, dtype=np.int64)
+                        * 10000, pa.int64())
+
+    def _upper(cols, rng, m, offset=0):
+        return pa.array((np.arange(offset, offset + m, dtype=np.int64) + 1)
+                        * 10000 - 1, pa.int64())
+
+    return TableSpec("income_band", [
+        ColumnSpec("ib_income_band_sk", "seq"),
+        ColumnSpec("ib_lower_bound", "derive", derive=_lower),
+        ColumnSpec("ib_upper_bound", "derive", derive=_upper),
     ])
 
 
@@ -589,6 +626,7 @@ def tpcds_store_returns(rows: int, n_items: int, n_cust: int, n_stores: int,
         ColumnSpec("sr_item_sk", "derive", derive=_sr_item),
         ColumnSpec("sr_customer_sk", "derive", derive=_sr_cust),
         ColumnSpec("sr_store_sk", "key", cardinality=max(n_stores, 1)),
+        ColumnSpec("sr_reason_sk", "key", cardinality=35),
         ColumnSpec("sr_return_quantity", "int", min_val=1, max_val=40),
         ColumnSpec("sr_return_amt", "double", min_val=0.0, max_val=5000.0),
         ColumnSpec("sr_net_loss", "double", min_val=0.0, max_val=3000.0),
@@ -612,12 +650,13 @@ def tpcds_catalog_sales(rows: int, n_items: int, n_cust: int, n_cdemo: int,
         ColumnSpec("cs_ship_mode_sk", "key", cardinality=10),
         ColumnSpec("cs_call_center_sk", "key", cardinality=4),
         ColumnSpec("cs_order_number", "seq", repeat=3),
+        ColumnSpec("cs_sold_time_sk", "key", cardinality=86400),
         *_sales_money_cols("cs"),
     ])
 
 
-def tpcds_catalog_returns(rows: int, n_items: int, n_orders: int
-                          ) -> TableSpec:
+def tpcds_catalog_returns(rows: int, n_items: int, n_orders: int,
+                          n_cust: int = 100) -> TableSpec:
     return TableSpec("catalog_returns", [
         ColumnSpec("cr_returned_date_sk", "key", cardinality=TPCDS_DAYS),
         ColumnSpec("cr_item_sk", "key", cardinality=max(n_items, 1)),
@@ -625,11 +664,14 @@ def tpcds_catalog_returns(rows: int, n_items: int, n_orders: int
         ColumnSpec("cr_return_quantity", "int", min_val=1, max_val=40),
         ColumnSpec("cr_return_amount", "double", min_val=0.0, max_val=5000.0),
         ColumnSpec("cr_net_loss", "double", min_val=0.0, max_val=3000.0),
+        ColumnSpec("cr_returning_customer_sk", "key",
+                   cardinality=max(n_cust, 1)),
+        ColumnSpec("cr_call_center_sk", "key", cardinality=4),
     ])
 
 
 def tpcds_web_sales(rows: int, n_items: int, n_cust: int, n_addr: int,
-                    n_sites: int, n_promo: int) -> TableSpec:
+                    n_sites: int, n_promo: int, n_wh: int = 6) -> TableSpec:
     return TableSpec("web_sales", [
         ColumnSpec("ws_sold_date_sk", "key", cardinality=TPCDS_DAYS,
                    null_prob=0.01),
@@ -642,11 +684,13 @@ def tpcds_web_sales(rows: int, n_items: int, n_cust: int, n_addr: int,
         ColumnSpec("ws_ship_mode_sk", "key", cardinality=10),
         ColumnSpec("ws_promo_sk", "key", cardinality=max(n_promo, 1)),
         ColumnSpec("ws_order_number", "seq", repeat=3),
+        ColumnSpec("ws_warehouse_sk", "key", cardinality=max(n_wh, 1)),
         *_sales_money_cols("ws"),
     ])
 
 
-def tpcds_web_returns(rows: int, n_items: int, n_orders: int) -> TableSpec:
+def tpcds_web_returns(rows: int, n_items: int, n_orders: int,
+                      n_cust: int = 100) -> TableSpec:
     return TableSpec("web_returns", [
         ColumnSpec("wr_returned_date_sk", "key", cardinality=TPCDS_DAYS),
         ColumnSpec("wr_item_sk", "key", cardinality=max(n_items, 1)),
@@ -654,6 +698,9 @@ def tpcds_web_returns(rows: int, n_items: int, n_orders: int) -> TableSpec:
         ColumnSpec("wr_return_quantity", "int", min_val=1, max_val=40),
         ColumnSpec("wr_return_amt", "double", min_val=0.0, max_val=5000.0),
         ColumnSpec("wr_net_loss", "double", min_val=0.0, max_val=3000.0),
+        ColumnSpec("wr_returning_customer_sk", "key",
+                   cardinality=max(n_cust, 1)),
+        ColumnSpec("wr_reason_sk", "key", cardinality=35),
     ])
 
 

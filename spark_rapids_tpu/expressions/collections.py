@@ -1487,6 +1487,13 @@ class CreateMap(_HostListOp):
         return _dedupe_pairs(zip(keys, vs))
 
 
+def _device_map(col) -> bool:
+    """Device-resident map column: offsets + struct<key,value> child."""
+    return (isinstance(col, TpuColumnVector) and col.host_data is None
+            and col.offsets is not None and col.child is not None
+            and col.child.children is not None)
+
+
 class MapKeys(_HostListOp):
     def __init__(self, child: Expression):
         self.children = (child,)
@@ -1498,6 +1505,15 @@ class MapKeys(_HostListOp):
     def _combine(self, m):
         p = _as_pairs(m)
         return None if p is None else [k for k, _ in p]
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        v = self.children[0].eval_tpu(batch, ctx)
+        if _device_map(v):
+            # zero-copy: the map's offsets over its keys child column
+            kid = v.child.children[0]
+            return TpuColumnVector(self.dtype, kid.data, v.validity,
+                                   v.num_rows, offsets=v.offsets, child=kid)
+        return self._host_from_vals([v], batch)
 
 
 class MapValues(_HostListOp):
@@ -1512,6 +1528,14 @@ class MapValues(_HostListOp):
     def _combine(self, m):
         p = _as_pairs(m)
         return None if p is None else [v for _, v in p]
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        v = self.children[0].eval_tpu(batch, ctx)
+        if _device_map(v):
+            kid = v.child.children[1]
+            return TpuColumnVector(self.dtype, kid.data, v.validity,
+                                   v.num_rows, offsets=v.offsets, child=kid)
+        return self._host_from_vals([v], batch)
 
 
 class GetMapValue(_HostListOp):
@@ -1530,6 +1554,46 @@ class GetMapValue(_HostListOp):
             if _eq_value(ek, k):
                 return ev
         return None
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from jax.ops import segment_min
+        from ..types import is_fixed_width
+        vals = [c.eval_tpu(batch, ctx) for c in self.children]
+        m, k = vals
+        mt = self.children[0].dtype
+        if (_device_map(m) and is_fixed_width(mt.key_type)
+                and is_fixed_width(mt.value_type)
+                and not (isinstance(k, TpuScalar) and k.value is None)):
+            keys = m.child.children[0]
+            values = m.child.children[1]
+            cap, n = batch.capacity, batch.num_rows
+            offs = m.offsets
+            ecap = int(keys.data.shape[0])
+            e = jnp.arange(ecap, dtype=jnp.int32)
+            elem_row = jnp.clip(
+                jnp.searchsorted(offs[1:cap + 1], e, side="right"),
+                0, max(cap - 1, 0)).astype(jnp.int32)
+            if isinstance(k, TpuScalar):
+                kv = jnp.asarray(k.value, keys.data.dtype)
+                k_valid_row = None
+            else:
+                kv = k.data[elem_row]
+                k_valid_row = k.validity
+            in_elems = e < offs[n]
+            match = (keys.data == kv) & in_elems
+            big = jnp.int32(2**31 - 1)
+            sel = segment_min(jnp.where(match, e, big), elem_row,
+                              num_segments=cap)
+            found = sel < big
+            sel_c = jnp.clip(sel, 0, max(ecap - 1, 0))
+            data = values.data[sel_c]
+            valid = found
+            if values.validity is not None:
+                valid = valid & values.validity[sel_c]
+            valid = combine_validity(cap, valid, m.validity, k_valid_row,
+                                     row_mask(n, cap))
+            return make_column(mt.value_type, data, valid, n)
+        return self._host_from_vals(vals, batch)
 
 
 class MapConcat(_HostListOp):
@@ -2278,6 +2342,19 @@ class MapEntries(_HostListOp):
                                      StructField("value", mt.value_type)]),
                          contains_null=False)
 
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        v = self.children[0].eval_tpu(batch, ctx)
+        if _device_map(v):
+            # the map child IS the entries struct column — dtype change only
+            kid = v.child
+            entry_t = self.dtype.element_type
+            new_kid = TpuColumnVector(entry_t, kid.data, kid.validity,
+                                      kid.num_rows, children=kid.children)
+            return TpuColumnVector(self.dtype, kid.data, v.validity,
+                                   v.num_rows, offsets=v.offsets,
+                                   child=new_kid)
+        return self._host_from_vals([v], batch)
+
     def _combine(self, m):
         if m is None:
             return None
@@ -2358,9 +2435,69 @@ class _MapLambdaOp(_HostListOp):
     def _regroup(self, entries, lambda_vals):
         raise NotImplementedError
 
+    # -- device ------------------------------------------------------------
+    def _device_body_eval(self, m, batch, ctx):
+        """Bound (k, v) body over the flat device entry columns. Returns
+        (res_col, keys, values, seg, in_data) or None when host-bound."""
+        from .base import to_column
+        from ..columnar.batch import TpuColumnarBatch
+        if not _device_map(m):
+            return None
+        keys, values = m.child.children
+        if not (is_fixed_width(keys.dtype) and is_fixed_width(values.dtype)
+                and keys.host_data is None and values.host_data is None):
+            return None
+        fn = self.function
+        args = fn.arguments
+        outer: List[AttributeReference] = []
+
+        def rule(e):
+            if isinstance(e, NamedLambdaVariable):
+                for ai, a in enumerate(args):
+                    if e.var_id == a.var_id:
+                        return _BoundLambdaVar(ai, a.dtype)
+                return None
+            if isinstance(e, AttributeReference):
+                for j, o in enumerate(outer):
+                    if o.expr_id == e.expr_id:
+                        return _BoundLambdaVar(2 + j, e.dtype, e.nullable)
+                outer.append(e)
+                return _BoundLambdaVar(2 + len(outer) - 1, e.dtype,
+                                       e.nullable)
+            return None
+
+        body = fn.body.transform(rule)
+        seg, in_data = _segments(m)
+        cols = [keys, values]
+        for o in outer:
+            oc = o.eval_tpu(batch, ctx)
+            if not is_fixed_width(oc.dtype) or oc.host_data is not None:
+                return None
+            od = jnp.take(oc.data, seg)
+            ov = jnp.take(oc.validity, seg) if oc.validity is not None \
+                else None
+            cols.append(TpuColumnVector(oc.dtype, od, ov, keys.num_rows))
+        pseudo = TpuColumnarBatch(cols, keys.num_rows)
+        res = body.eval_tpu(pseudo, ctx)
+        res_col = to_column(res, pseudo, self.function.dtype)
+        return res_col, keys, values, seg, in_data
+
+    def _device_assemble(self, m, res_col, keys, values, seg, in_data,
+                         batch):
+        return None  # subclass hook; None = fall back to host
+
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         self._sync_vars()
-        maps = _pylist_of(None, batch, ctx, self.children[0], batch.num_rows)
+        mcol = self.children[0].eval_tpu(batch, ctx)
+        if isinstance(mcol, TpuColumnVector):
+            dev = self._device_body_eval(mcol, batch, ctx)
+            if dev is not None:
+                out = self._device_assemble(mcol, *dev, batch)
+                if out is not None:
+                    return out
+        maps = (mcol.to_pylist()[:batch.num_rows]
+                if isinstance(mcol, TpuColumnVector)
+                else [mcol.value] * batch.num_rows)
         return _result_from_pylist(self._apply(maps, ctx, batch, True),
                                    self.dtype, batch)
 
@@ -2383,6 +2520,41 @@ class MapFilter(_MapLambdaOp):
         return [(k, v) for (k, v), keep in zip(entries, lambda_vals)
                 if keep is True]
 
+    def _device_assemble(self, m, res_col, keys, values, seg, in_data,
+                         batch):
+        # keep = predicate strictly True (null drops), entries only
+        keep = res_col.data.astype(jnp.bool_) & in_data
+        if res_col.validity is not None:
+            keep = keep & res_col.validity
+        cap = m.capacity
+        ecap = int(keys.capacity)
+        keep_i = keep.astype(jnp.int32)
+        new_lens = jnp.zeros((cap,), jnp.int32).at[
+            jnp.where(in_data, seg, cap)].add(keep_i, mode="drop")
+        new_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                    jnp.cumsum(new_lens, dtype=jnp.int32)])
+        out_pos = jnp.cumsum(keep_i) - keep_i
+        idx = jnp.where(keep, out_pos, ecap)
+
+        def compact(c):
+            data = jnp.zeros((ecap,), c.data.dtype).at[idx].set(
+                c.data, mode="drop")
+            cv = None
+            if c.validity is not None:
+                cv = jnp.zeros((ecap,), jnp.bool_).at[idx].set(
+                    c.validity, mode="drop")
+            return data, cv
+
+        n_elems = int(new_offs[m.num_rows])
+        kd, kv = compact(keys)
+        vd, vv = compact(values)
+        new_keys = TpuColumnVector(keys.dtype, kd, kv, n_elems)
+        new_vals = TpuColumnVector(values.dtype, vd, vv, n_elems)
+        entry = TpuColumnVector(m.child.dtype, kd, None, n_elems,
+                                children=[new_keys, new_vals])
+        return TpuColumnVector(self.dtype, kd, m.validity, m.num_rows,
+                               offsets=new_offs, child=entry)
+
 
 class TransformValues(_MapLambdaOp):
     """transform_values(m, (k, v) -> newv)."""
@@ -2395,6 +2567,21 @@ class TransformValues(_MapLambdaOp):
 
     def _regroup(self, entries, lambda_vals):
         return [(k, nv) for (k, _), nv in zip(entries, lambda_vals)]
+
+    def _device_assemble(self, m, res_col, keys, values, seg, in_data,
+                         batch):
+        if not is_fixed_width(self.function.dtype):
+            return None
+        # zero-copy keys + offsets; only the values child is rebuilt
+        new_vals = TpuColumnVector(self.function.dtype, res_col.data,
+                                   res_col.validity, values.num_rows)
+        from ..types import StructField as _Sf, StructType as _St2
+        entry_t = _St2([_Sf("key", keys.dtype, False),
+                        _Sf("value", self.function.dtype, True)])
+        entry = TpuColumnVector(entry_t, keys.data, None, keys.num_rows,
+                                children=[keys, new_vals])
+        return TpuColumnVector(self.dtype, keys.data, m.validity,
+                               m.num_rows, offsets=m.offsets, child=entry)
 
 
 class TransformKeys(_MapLambdaOp):

@@ -14,6 +14,7 @@ path inside the op.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -567,6 +568,97 @@ def _fmt_supported(fmt) -> bool:
         return False
 
 
+def _tz_local_micros(micros, ctx):
+    """Epoch micros → session-local wall-clock micros regardless of the
+    input dtype (device TZ table binary search; None = no TZif table)."""
+    from ..tzdb import TimeZoneDB, is_utc
+    if is_utc(getattr(ctx, "tz", None)):
+        return micros
+    db = TimeZoneDB.get(ctx.tz)
+    if db is None:
+        return None
+    return db.utc_to_local(micros.astype(jnp.int64))
+
+
+def _device_fmt_plan(fmt):
+    """Tokenize a Java datetime pattern into [(kind, value)] when every
+    token is fixed-width numeric (yyyy/MM/dd/HH/mm/ss/SSS) or a literal
+    byte — the set a device byte-assembly can format. None otherwise."""
+    if fmt is None:
+        return None
+    toks = []
+    i = 0
+    letters = "GyYMLdHhmsSaEuwWDFkKzZXQqecV'"
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch in letters:
+            j = i
+            while j < len(fmt) and fmt[j] == ch:
+                j += 1
+            run = fmt[i:j]
+            # SSS deliberately absent: the construction-time gate
+            # (_java_to_strftime) rejects it, and strftime's %f (micros)
+            # cannot mirror Java millis on the host-fallback path
+            if run not in ("yyyy", "MM", "dd", "HH", "mm", "ss"):
+                return None
+            toks.append(("f", run))
+            i = j
+        else:
+            b = ch.encode("utf-8")
+            if len(b) != 1:
+                return None
+            toks.append(("l", b[0]))
+            i += 1
+    return toks or None
+
+
+_FMT_WIDTH = {"yyyy": 4, "MM": 2, "dd": 2, "HH": 2, "mm": 2, "ss": 2}
+
+
+def _format_micros_device(micros, valid, n, cap, toks):
+    """Local-wall-clock micros → formatted string column, fully on device:
+    civil fields + per-token zero-padded digit bytes assembled into a
+    (cap, W) byte matrix. Returns None when a year falls outside 1..9999
+    (Java widens yyyy there — variable width, host path)."""
+    from ..columnar.vector import TpuColumnVector
+    from ..types import StringT
+    micros = micros.astype(jnp.int64)
+    days = _floor_div(micros, MICROS_PER_DAY)
+    intra = micros - days * MICROS_PER_DAY
+    y, mo, d = civil_from_days(days)
+    if n:
+        sel = valid[:n] if valid is not None else None
+        ys = jnp.where(sel, y[:n], 2000) if sel is not None else y[:n]
+        # one transfer for both bounds (each eager D→H sync is a full
+        # tunnel round trip)
+        ymin, ymax = map(int, jax.device_get(
+            jnp.stack([jnp.min(ys), jnp.max(ys)])))
+        if ymin < 1 or ymax > 9999:
+            return None
+    secs = intra // 1_000_000
+    fields = {"yyyy": y, "MM": mo, "dd": d,
+              "HH": (secs // 3600).astype(jnp.int32),
+              "mm": ((secs // 60) % 60).astype(jnp.int32),
+              "ss": (secs % 60).astype(jnp.int32),
+              "SSS": ((intra // 1000) % 1000).astype(jnp.int32)}
+    cols = []
+    for kind, v in toks:
+        if kind == "l":
+            cols.append(jnp.full((cap,), np.uint8(v), jnp.uint8))
+        else:
+            val = fields[v].astype(jnp.int32)
+            w = _FMT_WIDTH[v]
+            for k in range(w):
+                digit = (val // (10 ** (w - 1 - k))) % 10
+                cols.append((digit + 48).astype(jnp.uint8))
+    chars = jnp.stack(cols, axis=1).reshape(-1)
+    width = len(cols)
+    lens = jnp.where(jnp.arange(cap) < n, width, 0).astype(jnp.int32)
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(lens, dtype=jnp.int32)])
+    return TpuColumnVector(StringT, chars, valid, n, offsets=offs)
+
+
 class FromUnixTime(Expression):
     """from_unixtime(seconds, fmt) → string, UTC session timezone
     (reference GpuFromUnixTime). Host-assisted formatting."""
@@ -624,6 +716,19 @@ class FromUnixTime(Expression):
             v = self._format_list([c.value], ctx,
                                   self._fmts_of(batch, ctx, 1, True))[0]
             return TpuScalar(self.dtype, v)
+        toks = _device_fmt_plan(self._fmt())
+        if toks is not None and not isinstance(c, TpuScalar) \
+                and getattr(c, "host_data", None) is None:
+            micros = c.data.astype(jnp.int64) * 1_000_000
+            local = _tz_local_micros(micros, ctx)
+            if local is not None:
+                out = _format_micros_device(
+                    local, combine_validity(batch.capacity, c.validity,
+                                            row_mask(batch.num_rows,
+                                                     batch.capacity)),
+                    batch.num_rows, batch.capacity, toks)
+                if out is not None:
+                    return out
         vals = c.to_pylist()
         fmts = self._fmts_of(batch, ctx, len(vals), True)
         return _result_from_pylist(self._format_list(vals, ctx, fmts),
@@ -668,7 +773,10 @@ class DateFormatClass(Expression):
                 out.append(None)
                 continue
             if isinstance(v, _dt.datetime):
-                t = v.astimezone(tz) if v.tzinfo is not None else v
+                # naive values are UTC instants (the _DateField convention:
+                # stored micros are instants, fields display session-local)
+                t = (v if v.tzinfo is not None
+                     else v.replace(tzinfo=_dt.timezone.utc)).astimezone(tz)
             elif isinstance(v, _dt.date):
                 t = _dt.datetime(v.year, v.month, v.day)
             else:
@@ -689,11 +797,30 @@ class DateFormatClass(Expression):
         return v.to_pylist()[:n] if hasattr(v, "to_pylist") else [v] * n
 
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        from .base import Literal
         from ..columnar.vector import TpuScalar
         from .collections import _result_from_pylist
         c = self.children[0].eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
             return TpuScalar(self.dtype, self._format_list([c.value], ctx)[0])
+        f = self.children[1]
+        toks = _device_fmt_plan(f.value if isinstance(f, Literal) else None)
+        if toks is not None and getattr(c, "host_data", None) is None:
+            dt = self.children[0].dtype
+            if isinstance(dt, TimestampType):
+                local = _tz_local_micros(c.data.astype(jnp.int64), ctx)
+            elif isinstance(dt, DateType):
+                local = c.data.astype(jnp.int64) * MICROS_PER_DAY
+            else:
+                local = None
+            if local is not None:
+                out = _format_micros_device(
+                    local, combine_validity(batch.capacity, c.validity,
+                                            row_mask(batch.num_rows,
+                                                     batch.capacity)),
+                    batch.num_rows, batch.capacity, toks)
+                if out is not None:
+                    return out
         return _result_from_pylist(self._format_list(c.to_pylist(), ctx),
                                    self.dtype, batch)
 

@@ -452,6 +452,163 @@ def _np_xxhash_col(dt: DataType, arr, seeds: np.ndarray) -> np.ndarray:
     return np.where(nulls, seeds, h)
 
 
+# ---- device xxhash64 (Spark XXH64) ------------------------------------------
+# Same padded-gather design as the murmur3 device path: per-row masked stride
+# loops over the HBM byte buffer, all arithmetic in wrapping uint64 on the VPU.
+
+
+def _xx_rotl_dev(x, r):
+    r = jnp.uint64(r)
+    return (x << r) | (x >> (jnp.uint64(64) - r))
+
+
+def _xx_fmix_dev(h):
+    h = h ^ (h >> jnp.uint64(33))
+    h = (h * _XP2).astype(jnp.uint64)
+    h = h ^ (h >> jnp.uint64(29))
+    h = (h * _XP3).astype(jnp.uint64)
+    return h ^ (h >> jnp.uint64(32))
+
+
+def _xx_round_dev(acc, val):
+    acc = (acc + val * _XP2).astype(jnp.uint64)
+    return (_xx_rotl_dev(acc, 31) * _XP1).astype(jnp.uint64)
+
+
+def xxhash64_int_dev(v_i32, seed_u64):
+    """Spark XXH64.hashInt on device."""
+    h = seed_u64 + _XP5 + jnp.uint64(4)
+    u = (v_i32.astype(jnp.int64) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint64)
+    h = h ^ (u * _XP1)
+    h = (_xx_rotl_dev(h, 23) * _XP2 + _XP3).astype(jnp.uint64)
+    return _xx_fmix_dev(h)
+
+
+def xxhash64_long_dev(v_i64, seed_u64):
+    """Spark XXH64.hashLong on device."""
+    h = seed_u64 + _XP5 + jnp.uint64(8)
+    u = v_i64.astype(jnp.uint64)
+    h = h ^ ((_xx_rotl_dev((u * _XP2).astype(jnp.uint64), 31) * _XP1)
+             .astype(jnp.uint64))
+    h = (_xx_rotl_dev(h, 27) * _XP1 + _XP4).astype(jnp.uint64)
+    return _xx_fmix_dev(h)
+
+
+def _xxhash64_string_device(col: TpuColumnVector, seed, capacity: int):
+    """Spark XXH64.hashUnsafeBytes on device: the 4-accumulator 32-byte
+    stride loop, then 8-/4-/1-byte tails, each as a per-row masked loop
+    over max_len like the murmur3 string path. O(cap * max_len)."""
+    starts = col.offsets[:-1].astype(jnp.int64)
+    lens = (col.offsets[1:].astype(jnp.int64) - starts)
+    max_len = int(jnp.max(lens)) if col.num_rows else 0
+    data = col.data
+    ncap = max(int(data.shape[0]) - 1, 0)
+
+    def read_u64(base):
+        idx = jnp.clip(base[:, None] + jnp.arange(8)[None, :], 0, ncap)
+        b = jnp.take(data, idx).astype(jnp.uint64)
+        out = b[:, 0]
+        for k in range(1, 8):
+            out = out | (b[:, k] << jnp.uint64(8 * k))
+        return out
+
+    def read_u32(base):
+        idx = jnp.clip(base[:, None] + jnp.arange(4)[None, :], 0, ncap)
+        b = jnp.take(data, idx).astype(jnp.uint64)
+        return b[:, 0] | (b[:, 1] << jnp.uint64(8)) \
+            | (b[:, 2] << jnp.uint64(16)) | (b[:, 3] << jnp.uint64(24))
+
+    seed = jnp.broadcast_to(seed, (capacity,)).astype(jnp.uint64)
+    v1 = seed + _XP1 + _XP2
+    v2 = seed + _XP2
+    v3 = seed
+    v4 = seed - _XP1
+    for sidx in range(max_len // 32):
+        base = starts + 32 * sidx
+        active = lens >= 32 * (sidx + 1)
+        v1 = jnp.where(active, _xx_round_dev(v1, read_u64(base)), v1)
+        v2 = jnp.where(active, _xx_round_dev(v2, read_u64(base + 8)), v2)
+        v3 = jnp.where(active, _xx_round_dev(v3, read_u64(base + 16)), v3)
+        v4 = jnp.where(active, _xx_round_dev(v4, read_u64(base + 24)), v4)
+    h_big = (_xx_rotl_dev(v1, 1) + _xx_rotl_dev(v2, 7)
+             + _xx_rotl_dev(v3, 12) + _xx_rotl_dev(v4, 18))
+    for v in (v1, v2, v3, v4):
+        h_big = ((h_big ^ _xx_round_dev(jnp.uint64(0), v)) * _XP1 + _XP4) \
+            .astype(jnp.uint64)
+    h = jnp.where(lens >= 32, h_big, seed + _XP5)
+    h = h + lens.astype(jnp.uint64)
+    # 8-byte words of the tail (tail < 32 bytes → at most 3)
+    i0 = (lens // 32) * 32
+    for tidx in range(3):
+        pos = i0 + 8 * tidx
+        active = (pos + 8) <= lens
+        w = read_u64(starts + pos)
+        nh = (_xx_rotl_dev(
+            h ^ (_xx_rotl_dev((w * _XP2).astype(jnp.uint64), 31) * _XP1)
+            .astype(jnp.uint64), 27) * _XP1 + _XP4).astype(jnp.uint64)
+        h = jnp.where(active, nh, h)
+    i1 = i0 + ((lens - i0) // 8) * 8
+    # one 4-byte word
+    active4 = (i1 + 4) <= lens
+    w32 = read_u32(starts + i1)
+    nh = (_xx_rotl_dev(h ^ (w32 * _XP1), 23) * _XP2 + _XP3) \
+        .astype(jnp.uint64)
+    h = jnp.where(active4, nh, h)
+    i2 = i1 + jnp.where(active4, 4, 0)
+    # remaining bytes (at most 3)
+    for bidx in range(3):
+        pos = i2 + bidx
+        active = pos < lens
+        byte = jnp.take(data, jnp.clip(starts + pos, 0, ncap)) \
+            .astype(jnp.uint64)
+        nh = (_xx_rotl_dev(h ^ (byte * _XP5), 11) * _XP1).astype(jnp.uint64)
+        h = jnp.where(active, nh, h)
+    return _xx_fmix_dev(h)
+
+
+def xxhash64_col(col: TpuColumnVector, seed, capacity: int):
+    """One device column pass: per-row updated uint64 seeds (nulls keep
+    their incoming seed, like Spark)."""
+    dt = col.dtype
+    d = col.data
+    if isinstance(dt, (BooleanType, ByteType, ShortType, IntegerType,
+                       DateType)):
+        h = xxhash64_int_dev(d.astype(jnp.int32), seed)
+    elif isinstance(dt, (LongType, TimestampType)):
+        h = xxhash64_long_dev(d.astype(jnp.int64), seed)
+    elif isinstance(dt, FloatType):
+        f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+        h = xxhash64_int_dev(f.view(jnp.int32), seed)
+    elif isinstance(dt, DoubleType):
+        f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+        h = xxhash64_long_dev(f.view(jnp.int64), seed)
+    elif isinstance(dt, StringType):
+        h = _xxhash64_string_device(col, seed, capacity)
+    else:
+        raise NotImplementedError(f"xxhash64 of {dt}")
+    if col.validity is not None:
+        h = jnp.where(col.validity, h, seed)
+    return h
+
+
+def xxhash64_batch(cols: Sequence[TpuColumnVector], capacity: int,
+                   seed: int = 42):
+    h = jnp.full((capacity,), np.uint64(seed), jnp.uint64)
+    for c in cols:
+        h = xxhash64_col(c, h, capacity)
+    return h.view(jnp.int64)
+
+
+def _device_hashable(cols, children) -> bool:
+    """All hash inputs are device-resident flat columns (strings must carry
+    offsets); shared gate for the xxhash64/hive-hash device paths."""
+    return all(
+        c.host_data is None and c.children is None
+        and (c.offsets is not None
+             or not isinstance(ch.dtype, StringType))
+        for c, ch in zip(cols, children))
+
+
 class XxHash64(Expression):
     """xxhash64(...) → long (reference GpuXxHash64, HashFunctions.scala)."""
 
@@ -485,9 +642,14 @@ class XxHash64(Expression):
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         from .base import to_column
         from ..types import LongT
-        import pyarrow as pa
         cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
                 for c in self.children]
+        if _device_hashable(cols, self.children):
+            try:
+                h = xxhash64_batch(cols, batch.capacity, self.seed)
+                return make_column(LongT, h, None, batch.num_rows)
+            except NotImplementedError:
+                pass  # dtype outside the device set: host mirror below
         vals = [c.to_arrow() for c in cols]
         h = self._hash_arrays(vals, batch.num_rows)
         return TpuColumnVector.from_numpy(LongT, h,
@@ -566,10 +728,58 @@ class HiveHash(Expression):
                            else [v] * n)
         return pa.array(self._hash_rows(cols_py, n), type=pa.int32())
 
+    @staticmethod
+    def _field_hash_dev(col: TpuColumnVector, dt: DataType, capacity: int):
+        """Per-row Hive field hash on device (uint32); None → 0."""
+        d = col.data
+        if isinstance(dt, BooleanType):
+            h = d.astype(jnp.uint32)
+        elif isinstance(dt, (ByteType, ShortType, IntegerType, DateType)):
+            h = d.astype(jnp.int32).view(jnp.uint32)
+        elif isinstance(dt, LongType):
+            u = d.astype(jnp.int64).view(jnp.uint64)
+            h = ((u >> jnp.uint64(32)) ^ u).astype(jnp.uint32)
+        elif isinstance(dt, FloatType):
+            f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+            h = f.view(jnp.int32).view(jnp.uint32)
+        elif isinstance(dt, DoubleType):
+            f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+            u = f.view(jnp.int64).view(jnp.uint64)
+            h = ((u >> jnp.uint64(32)) ^ u).astype(jnp.uint32)
+        elif isinstance(dt, StringType):
+            # Java String.hashCode over utf-8 SIGNED bytes: h = 31h + b
+            starts = col.offsets[:-1].astype(jnp.int64)
+            lens = col.offsets[1:].astype(jnp.int64) - starts
+            max_len = int(jnp.max(lens)) if col.num_rows else 0
+            data = col.data
+            ncap = max(int(data.shape[0]) - 1, 0)
+            h = jnp.zeros((capacity,), jnp.uint32)
+            for b in range(max_len):
+                idx = jnp.clip(starts + b, 0, ncap)
+                byte = jnp.take(data, idx).astype(jnp.int8) \
+                    .astype(jnp.int32).view(jnp.uint32)
+                nh = (h * jnp.uint32(31) + byte).astype(jnp.uint32)
+                h = jnp.where(b < lens, nh, h)
+        else:
+            raise NotImplementedError(f"hive hash of {dt}")
+        if col.validity is not None:
+            h = jnp.where(col.validity, h, jnp.uint32(0))
+        return h
+
     def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
         from .base import to_column
         cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
                 for c in self.children]
+        if _device_hashable(cols, self.children):
+            try:
+                h = jnp.zeros((batch.capacity,), jnp.uint32)
+                for c, ch in zip(cols, self.children):
+                    fh = self._field_hash_dev(c, ch.dtype, batch.capacity)
+                    h = (h * jnp.uint32(31) + fh).astype(jnp.uint32)
+                return make_column(IntegerT, h.view(jnp.int32), None,
+                                   batch.num_rows)
+            except NotImplementedError:
+                pass  # nested dtype: host mirror below
         cols_py = [c.to_arrow().to_pylist() for c in cols]
         h = self._hash_rows(cols_py, batch.num_rows)
         return TpuColumnVector.from_numpy(IntegerT, h.astype(np.int32),

@@ -1045,6 +1045,10 @@ class StringSplit(_HostRowOp):
         p = self._pat if self._pat is not None else pat
         if limit is None:
             limit = -1
+        if limit == 1:
+            # Java split(re, 1) = the whole string; python maxsplit=0
+            # means UNLIMITED, so it cannot express this case
+            return [s]
         if limit > 0:
             return _re2.split(p, s, maxsplit=limit - 1)
         parts = _re2.split(p, s)
@@ -1052,6 +1056,85 @@ class StringSplit(_HostRowOp):
             while parts and parts[-1] == "":
                 parts.pop()
         return parts
+
+    @staticmethod
+    def _literal_delim(pat):
+        """The single utf-8 byte a trivial Java regex denotes, or None."""
+        meta = set("\\^$.|?*+()[]{}")
+        if pat is None:
+            return None
+        if len(pat) == 1 and pat not in meta:
+            lit = pat
+        elif len(pat) == 2 and pat[0] == "\\" and pat[1] in meta:
+            lit = pat[1]
+        else:
+            return None
+        b = lit.encode("utf-8")
+        return b[0] if len(b) == 1 else None
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        """Device split for single-byte literal delimiters: delimiter scan +
+        two ragged gathers over the HBM byte buffer; the parts column is a
+        string child sharing one materialized chars buffer (reference
+        GpuStringSplit on cuDF's split_record). Regex patterns, multi-byte
+        delimiters, and limit=0 (trailing-empty trim) take the host path."""
+        from .base import Literal, to_column
+        from ..columnar.vector import bucket_capacity, row_mask
+        from ..kernels.strings import gather_plan
+        from ..types import ArrayType, StringT
+        child, pattern, limit = self.children
+        lit = pattern.value if isinstance(pattern, Literal) else None
+        lim = limit.value if isinstance(limit, Literal) else None
+        delim = self._literal_delim(lit)
+        if delim is None or lim is None or lim == 0:
+            return super().eval_tpu(batch, ctx)
+        col = to_column(child.eval_tpu(batch, ctx), batch, child.dtype)
+        if col.host_data is not None or col.offsets is None:
+            return super().eval_tpu(batch, ctx)
+        n, cap = batch.num_rows, batch.capacity
+        offs = col.offsets.astype(jnp.int32)
+        starts, ends = offs[:-1], offs[1:]
+        data = col.data
+        n_chars = int(offs[n]) if n else 0
+        char_cap = max(int(data.shape[0]), 1)
+        valid = col.validity if col.validity is not None \
+            else row_mask(n, cap)
+        is_delim = (data == jnp.uint8(delim)) \
+            & (jnp.arange(char_cap) < n_chars)
+        prefix = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(is_delim.astype(jnp.int32))])
+        cnt = prefix[ends] - prefix[starts]
+        if lim > 0:
+            cnt = jnp.minimum(cnt, lim - 1)
+        parts = jnp.where(valid, cnt + 1, 0)
+        list_offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                     jnp.cumsum(parts, dtype=jnp.int32)])
+        total = int(list_offs[n]) if n else 0
+        pcap = bucket_capacity(total)
+        P = jnp.where(is_delim, size=char_cap,
+                      fill_value=char_cap - 1)[0].astype(jnp.int32)
+        d0 = prefix[starts]
+        j = jnp.arange(pcap, dtype=jnp.int32)
+        row_j = jnp.clip(jnp.searchsorted(list_offs[1:cap + 1], j,
+                                          side="right"),
+                         0, max(cap - 1, 0)).astype(jnp.int32)
+        k = j - list_offs[row_j]
+        pmax = max(char_cap - 1, 0)
+        pstart = jnp.where(k == 0, starts[row_j],
+                           P[jnp.clip(d0[row_j] + k - 1, 0, pmax)] + 1)
+        pend = jnp.where(k == cnt[row_j], ends[row_j],
+                         P[jnp.clip(d0[row_j] + k, 0, pmax)])
+        in_part = j < total
+        plen = jnp.where(in_part, jnp.maximum(pend - pstart, 0), 0)
+        src, in_range, child_offs = gather_plan(pstart, plen, char_cap)
+        chars = jnp.where(in_range,
+                          data[jnp.clip(src, 0, char_cap - 1)],
+                          jnp.zeros((), data.dtype))
+        part_col = TpuColumnVector(StringT, chars, None, total,
+                                   offsets=child_offs)
+        return TpuColumnVector(ArrayType(StringT, contains_null=False),
+                               chars, valid, n, offsets=list_offs,
+                               child=part_col)
 
     def pretty(self) -> str:
         return f"split({self.children[0].pretty()}, {self.children[1].pretty()})"

@@ -99,10 +99,12 @@ def _register_builtin_exprs() -> None:
                       f"math fn {cls.__name__.lower()}")
 
     register_expr(H.Murmur3Hash, TypeSigs.integral, "spark murmur3 hash")
-    register_expr(H.XxHash64, TypeSigs.integral, "spark xxhash64",
-                  host_assisted=True)
-    register_expr(H.HiveHash, TypeSigs.integral, "hive bucketing hash",
-                  host_assisted=True)
+    register_expr(H.XxHash64, TypeSigs.integral,
+                  "spark xxhash64 (device XXH64 over HBM bytes)",
+                  incompat="decimal inputs via host path")
+    register_expr(H.HiveHash, TypeSigs.integral,
+                  "hive bucketing hash (device 31h+b fold)",
+                  incompat="nested inputs via host path")
 
     from ..expressions import datetime as DT
     for cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Quarter, DT.DayOfWeek,
@@ -144,8 +146,9 @@ def _register_builtin_exprs() -> None:
     register_expr(S.ConcatWs, TypeSigs.STRING,
                   "concat_ws (device)",
                   incompat="array args / non-literal separator via host path")
-    register_expr(S.StringSplit, TypeSigs.nested_common, "split to array",
-                  host_assisted=True)
+    register_expr(S.StringSplit, TypeSigs.nested_common,
+                  "split to array (device scan for literal delimiters)",
+                  incompat="regex patterns / limit=0 via host path")
     register_expr(S.OctetLength, TypeSigs.integral,
                   "byte length (device offsets math)")
     register_expr(S.BitLength, TypeSigs.integral,
@@ -215,8 +218,13 @@ def _register_builtin_exprs() -> None:
     for cls in (CL.ArrayJoin, CL.ArraysZip):
         register_expr(cls, sig_nested, f"array fn {cls.__name__}",
                       host_assisted=True)
-    for cls in (CL.CreateMap, CL.MapKeys, CL.MapValues, CL.GetMapValue,
-                CL.MapConcat, CL.MapFromArrays):
+    for cls in (CL.MapKeys, CL.MapValues):
+        register_expr(cls, sig_nested,
+                      f"map fn {cls.__name__} (device zero-copy child)")
+    register_expr(CL.GetMapValue, sig_nested,
+                  "map fn GetMapValue (device segment lookup)",
+                  incompat="string/nested keys via host path")
+    for cls in (CL.CreateMap, CL.MapConcat, CL.MapFromArrays):
         register_expr(cls, sig_nested, f"map fn {cls.__name__}",
                       host_assisted=True)
     register_expr(CL.LambdaFunction, TypeSigs.all, "lambda function")
@@ -272,9 +280,11 @@ def _register_builtin_exprs() -> None:
         register_expr(cls, TypeSigs.TIMESTAMP,
                       f"{cls.__name__.lower()} (device scaling)")
     register_expr(DT.FromUnixTime, TypeSigs.STRING,
-                  "from_unixtime formatting (UTC)", host_assisted=True)
+                  "from_unixtime (device byte assembly, session tz)",
+                  incompat="non-numeric pattern tokens via host path")
     register_expr(DT.DateFormatClass, TypeSigs.STRING,
-                  "date_format (UTC)", host_assisted=True)
+                  "date_format (device byte assembly, session tz)",
+                  incompat="non-numeric pattern tokens via host path")
     register_expr(DT.ToUnixTimestamp, TypeSigs.integral,
                   "to_unix_timestamp (device for ts/date)",
                   incompat="string parsing via host path, UTC only")
@@ -285,10 +295,16 @@ def _register_builtin_exprs() -> None:
     register_expr(CL.ArrayRemove, sig_nested,
                   "array_remove (device for fixed-width + literal)",
                   incompat="non-fixed-width / column needle via host path")
-    for cls in (CL.MapEntries, CL.MapFilter, CL.TransformKeys,
-                CL.TransformValues):
-        register_expr(cls, sig_nested, f"map fn {cls.__name__}",
-                      host_assisted=True)
+    register_expr(CL.MapEntries, sig_nested,
+                  "map fn MapEntries (device zero-copy entries struct)")
+    register_expr(CL.MapFilter, sig_nested,
+                  "map fn MapFilter (device flat-entry predicate + compact)",
+                  incompat="non-fixed-width entries via host path")
+    register_expr(CL.TransformValues, sig_nested,
+                  "map fn TransformValues (device flat-entry lambda)",
+                  incompat="non-fixed-width entries via host path")
+    register_expr(CL.TransformKeys, sig_nested, "map fn TransformKeys",
+                  host_assisted=True)
     for cls in (CL.GetStructField, CL.GetArrayStructFields,
                 CL.CreateNamedStruct):
         register_expr(cls, sig_nested,

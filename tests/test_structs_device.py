@@ -31,10 +31,12 @@ def test_struct_layout_is_device_resident():
     assert col.to_arrow().to_pylist() == _struct_arr().to_pylist()
 
 
-def test_struct_map_field_stays_host():
+def test_struct_map_field_is_device():
+    """r5: maps moved to the device offsets + struct<key,value> layout, so
+    a struct carrying a map is device-resident too."""
     from spark_rapids_tpu.types import StructType as St
     st = St([StructField("m", MapType(StringT, IntegerT), True)])
-    assert not device_layout_ok(st)
+    assert device_layout_ok(st)
 
 
 def test_get_struct_field_is_zero_copy_child():
