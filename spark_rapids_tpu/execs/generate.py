@@ -334,18 +334,27 @@ def _host_stack_fallback(gen: Stack, batch, gathered, ctx, out_names,
 def _json_tuple_batch(gen, batch: TpuColumnarBatch, ctx,
                       out_names: List[str]) -> TpuColumnarBatch:
     """json_tuple emits exactly one row per input row: pass-through columns
-    stay put, field columns come back from the host parse (reference
-    GpuJsonTuple.scala is similarly one-row-per-input)."""
+    stay put. Each field is a top-level key extraction — the device JSON
+    scan serves it one key at a time over the same byte buffer, with the
+    per-row host patch rendering floats/nested values canonically
+    (reference GpuJsonTuple.scala: one kernel pass per field via JNI
+    JSONUtils)."""
     import pyarrow as pa
+    from ..expressions.json import device_json_get
     col = to_column(gen.child.eval_tpu(batch, ctx.eval_ctx), batch)
-    rows = gen.extract_rows(col.to_arrow().to_pylist())
-    gen_cols = []
-    for c in range(len(gen.fields)):
-        arr = pa.array([r[c] for r in rows], type=pa.string())
-        v = TpuColumnVector.from_arrow(arr)
-        if v.capacity < batch.capacity:
-            from ..columnar.batch import _repad
-            v = _repad(v, batch.capacity)
+    gen_cols, rows = [], None
+    for c, field in enumerate(gen.fields):
+        v = device_json_get(col, batch, [field], ctx.eval_ctx,
+                            host_render=lambda t, f=field:
+                            gen.render_field(t, f))
+        if v is None:
+            if rows is None:  # host parse once, reused for every field
+                rows = gen.extract_rows(col.to_arrow().to_pylist())
+            arr = pa.array([r[c] for r in rows], type=pa.string())
+            v = TpuColumnVector.from_arrow(arr)
+            if v.capacity < batch.capacity:
+                from ..columnar.batch import _repad
+                v = _repad(v, batch.capacity)
         gen_cols.append(v)
     return TpuColumnarBatch(list(batch.columns) + gen_cols, batch.num_rows,
                             out_names)

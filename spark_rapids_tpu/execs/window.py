@@ -223,8 +223,18 @@ class TpuWindowExec(TpuExec):
         inv = jnp.zeros((cap,), jnp.int32).at[perm].set(
             jnp.arange(cap, dtype=jnp.int32))
         lens = jnp.take(lens_sorted, inv) * row_mask(n, cap)
+        # the output element count decides the gather's static shape, so a
+        # scalar D→H readback per batch is inherent here (compiled stages
+        # are the no-sync path); start the copy async so it overlaps with
+        # the start-offset gather dispatched below
+        total_dev = jnp.sum(lens[:n]) if n else None
+        if total_dev is not None:
+            try:
+                total_dev.copy_to_host_async()
+            except AttributeError:
+                pass
         starts = jnp.take(vstart, inv)
-        total = int(jnp.sum(lens[:n])) if n else 0  # host sync: output size
+        total = int(total_dev) if n else 0
         out_cap = bucket_capacity(max(total, 1))
         src, in_range, new_offs = gather_plan(starts.astype(jnp.int32),
                                               lens.astype(jnp.int32), out_cap)

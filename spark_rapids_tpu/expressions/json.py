@@ -150,12 +150,15 @@ def get_json_object_impl(doc: Optional[str], path_steps) -> Optional[str]:
     return _render(_walk(value, path_steps), had_wildcard)
 
 
-def device_json_get(col, batch, steps, ctx=None):
+def device_json_get(col, batch, steps, ctx=None, host_render=None):
     """Device JSON path extraction (kernels/json_scan.py) for single-name
     paths ('$.key'), or None when outside the device subset. Per-ROW hybrid:
     rows the validating scan cannot certify (escapes, float canonicalization,
     duplicate keys, deep nesting, top-level arrays) are re-run on the host
     engine and spliced back — one odd row no longer drags the batch to host.
+    `host_render(text) -> Optional[str]` overrides the host engine for the
+    patched rows (json_tuple renders floats canonically, unlike the raw
+    get_json_object span).
 
     Reference: GpuGetJsonObject.scala via JNI JSONUtils (device kernel)."""
     import jax.numpy as jnp
@@ -217,9 +220,11 @@ def device_json_get(col, batch, steps, ctx=None):
     from ..columnar.vector import TpuColumnVector
     arr = col.to_arrow()
     texts = arr.to_pylist()
+    if host_render is None:
+        host_render = lambda t: get_json_object_impl(t, steps)  # noqa: E731
     patched = [None] * n
     for i in np.nonzero(~serve_np)[0]:
-        patched[int(i)] = get_json_object_impl(texts[int(i)], steps)
+        patched[int(i)] = host_render(texts[int(i)])
     patch_col = TpuColumnVector.from_arrow(pa.array(patched, pa.string()))
     serve_j = jnp.asarray(serve_np)
     dev_emit = serve_j & validity
@@ -376,6 +381,189 @@ def from_json_impl(doc: Optional[str], schema: StructType) -> Optional[dict]:
     return _coerce_json_value(v, schema)
 
 
+def device_json_to_structs(col, batch, schema, ctx=None):
+    """Schema-driven multi-field device from_json: ONE validating scan per
+    target key over the same byte buffer, device coercion for
+    int/bool/string fields, per-ROW host patch for everything the scan
+    cannot certify (escapes, float-typed string renders, >19-digit ints,
+    non-object or whitespace-prefixed docs). None = schema/layout outside
+    the device subset entirely (reference GpuJsonToStructs.scala; JNI
+    JSONUtils runs the same one-pass-per-key design).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..kernels import strings as SK
+    from ..kernels.json_scan import (K_STRING, K_PRIMITIVE, scan_key_spans)
+    from ..columnar.vector import TpuColumnVector, bucket_capacity
+    from ..types import (BooleanType, ByteType, IntegerType, IntegralType,
+                         LongType, ShortType)
+    from .strings import _dev_str
+    ok_types = (IntegralType, BooleanType, StringType)
+    if not all(isinstance(f.data_type, ok_types) for f in schema.fields):
+        return None
+    if not _dev_str(col) or not SK.is_ascii(col.data):
+        return None
+    data, offsets = col.data, col.offsets
+    n = int(offsets.shape[0]) - 1
+    nbytes = int(data.shape[0])
+    if n == 0 or not nbytes:
+        return None
+    cap_bytes = 4096
+    if ctx is not None:
+        from ..config import JSON_DEVICE_SCAN_MAX_ROW_BYTES
+        cap_bytes = ctx.conf.get(JSON_DEVICE_SCAN_MAX_ROW_BYTES)
+    starts = offsets[:-1].astype(jnp.int32)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    max_len = int(jnp.max(lens)) if n else 0
+    if max_len > cap_bytes:
+        return None
+    first = data[jnp.clip(starts, 0, nbytes - 1)]
+    is_obj = (first == np.uint8(ord("{"))) & (lens > 0)
+
+    _INT_TOKS = (2, 3, 22)
+
+    def parse_int_span(sp):
+        """Device parse of a canonical JSON integer span (≤19 chars)."""
+        neg = data[jnp.clip(sp.start, 0, nbytes - 1)] == np.uint8(ord("-"))
+        val = jnp.zeros((n,), jnp.int64)
+        for k in range(min(max_len, 20)):
+            pos = jnp.clip(sp.start + k, 0, nbytes - 1)
+            b = data[pos].astype(jnp.int64)
+            is_digit = (b >= 48) & (b <= 57) & (k < sp.length)
+            val = jnp.where(is_digit, val * 10 + (b - 48), val)
+        return jnp.where(neg, -val, val)
+
+    serve = jnp.ones((n,), bool)
+    children_plan = []  # (field, kind, device arrays)
+    for f in schema.fields:
+        sp = scan_key_spans(data, offsets, f.name.encode(), max_len)
+        serve = serve & sp.confident
+        is_null_tok = (sp.kind == K_PRIMITIVE) & (sp.tok == 21)
+        absent = ~sp.found | is_null_tok
+        if isinstance(f.data_type, StringType):
+            raw_ok = ((sp.kind == K_STRING)
+                      | ((sp.kind == K_PRIMITIVE)
+                         & jnp.isin(sp.tok, jnp.asarray(
+                             list(_INT_TOKS) + [12, 17]))))
+            serve = serve & (absent | raw_ok | ~sp.valid_doc)
+            fvalid = sp.found & ~is_null_tok & raw_ok
+            children_plan.append((f, "str", (sp, fvalid)))
+        elif isinstance(f.data_type, BooleanType):
+            is_bool = (sp.kind == K_PRIMITIVE) & jnp.isin(
+                sp.tok, jnp.asarray([12, 17]))
+            fvalid = sp.found & is_bool
+            bval = (sp.tok == 12)
+            children_plan.append((f, "fixed", (bval, fvalid)))
+        else:  # integral
+            is_int = ((sp.kind == K_PRIMITIVE)
+                      & jnp.isin(sp.tok, jnp.asarray(list(_INT_TOKS))))
+            too_long = is_int & (sp.length > 19)
+            serve = serve & ~too_long
+            ival = parse_int_span(sp)
+            bits = {ByteType: 8, ShortType: 16, IntegerType: 32,
+                    LongType: 64}[type(f.data_type)]
+            lo = -(1 << (bits - 1))
+            hi = (1 << (bits - 1)) - 1
+            in_range = (ival >= lo) & (ival <= hi)
+            fvalid = sp.found & is_int & in_range
+            children_plan.append((f, "fixed",
+                                  (ival.astype(f.data_type.np_dtype
+                                               or np.int64), fvalid)))
+        valid_doc = sp.valid_doc  # identical across fields
+    # rows that are not objects need json.loads to decide dict-ness unless
+    # clearly invalid; whitespace-prefixed docs are ambiguous on device
+    serve = serve & (is_obj | ~valid_doc)
+    struct_valid = valid_doc & is_obj
+    rm = jnp.arange(n) < batch.num_rows
+    serve = serve | ~rm  # padding rows have nothing to patch
+    struct_valid = struct_valid & rm
+    if col.validity is not None:
+        struct_valid = struct_valid & col.validity[:n]
+        serve = serve | ~col.validity[:n]  # null input rows: null struct
+    serve_np = np.asarray(serve)
+    all_served = bool(np.all(serve_np))
+    patch_rows = None
+    if not all_served:
+        texts = col.to_arrow().to_pylist()
+        patch_rows = {int(i): from_json_impl(texts[int(i)], schema)
+                      for i in np.nonzero(~serve_np)[0]}
+        patched_idx = np.nonzero(~serve_np)[0]
+        p_struct_valid = np.array(np.asarray(struct_valid))
+        p_struct_valid[patched_idx] = [patch_rows[int(i)] is not None
+                                       for i in patched_idx]
+        struct_valid = jnp.asarray(p_struct_valid)
+    cap = batch.capacity
+    kids = []
+    for f, kind, payload in children_plan:
+        if kind == "fixed":
+            vals, fvalid = payload
+            fvalid = fvalid & struct_valid[:n]
+            buf = jnp.zeros((cap,), vals.dtype).at[:n].set(vals[:n])
+            vb = jnp.zeros((cap,), bool).at[:n].set(fvalid[:n])
+            if not all_served:
+                idx = np.nonzero(~serve_np)[0]
+                pv = []
+                pm = []
+                for i in idx:
+                    r = patch_rows[int(i)]
+                    v = None if r is None else r.get(f.name)
+                    pv.append(0 if v is None else
+                              (1 if v is True else (0 if v is False else v)))
+                    pm.append(v is not None)
+                if len(idx):
+                    buf = buf.at[jnp.asarray(idx)].set(
+                        jnp.asarray(np.asarray(pv, dtype=buf.dtype)))
+                    vb = vb.at[jnp.asarray(idx)].set(
+                        jnp.asarray(np.asarray(pm, dtype=bool)))
+            kids.append(TpuColumnVector(f.data_type, buf, vb,
+                                        batch.num_rows))
+        else:
+            sp, fvalid = payload
+            fvalid = fvalid & struct_valid[:n]
+            out_len = jnp.where(fvalid, sp.length, 0)
+            out_start = jnp.where(fvalid, sp.start, 0)
+            sdata, soffs = SK.build_ranges(
+                data, out_start.astype(jnp.int32),
+                out_len.astype(jnp.int32), bucket_capacity(max(nbytes, 1)))
+            svalid = fvalid
+            if not all_served:
+                import pyarrow as pa
+                patched = [None] * n
+                for i in np.nonzero(~serve_np)[0]:
+                    r = patch_rows[int(i)]
+                    v = None if r is None else r.get(f.name)
+                    patched[int(i)] = v
+                pcol = TpuColumnVector.from_arrow(
+                    pa.array(patched, pa.string()))
+                serve_j = jnp.asarray(serve_np)
+                pvalid = (pcol.validity if pcol.validity is not None
+                          else jnp.ones((int(pcol.offsets.shape[0]) - 1,),
+                                        bool))
+                sdata, soffs = SK.concat_columns(
+                    [(sdata, soffs[:-1], soffs[1:] - soffs[:-1]),
+                     (pcol.data, pcol.offsets[:-1][:n],
+                      (pcol.offsets[1:] - pcol.offsets[:-1])[:n])],
+                    bucket_capacity(max(
+                        nbytes + int(pcol.data.shape[0]), 1)),
+                    part_emit=[serve_j & svalid,
+                               (~serve_j) & pvalid[:n]])
+                svalid = jnp.where(serve_j, svalid, pvalid[:n])
+            sv = jnp.zeros((cap,), bool).at[:n].set(svalid[:n])
+            # offsets at capacity: pad with the final offset
+            pad = cap + 1 - int(soffs.shape[0])
+            if pad > 0:
+                soffs = jnp.concatenate(
+                    [soffs, jnp.full((pad,), soffs[-1], soffs.dtype)])
+            kids.append(TpuColumnVector(StringType(), sdata, sv,
+                                        batch.num_rows, offsets=soffs))
+    from ..columnar.batch import _repad
+    kids = [k if k.capacity == cap else _repad(k, cap) for k in kids]
+    sv = jnp.zeros((cap,), bool).at[:n].set(struct_valid[:n])
+    return TpuColumnVector(schema, jnp.zeros((0,), jnp.int8), sv,
+                           batch.num_rows, children=kids)
+
+
 class JsonToStructs(UnaryExpression):
     """from_json(json, schema) (reference GpuJsonToStructs.scala; cuDF JSON
     reader per batch there, row-wise host parse here)."""
@@ -412,6 +600,9 @@ class JsonToStructs(UnaryExpression):
         if isinstance(c, TpuScalar):
             rows = [from_json_impl(c.value, self.schema_type)] * batch.num_rows
         else:
+            out = device_json_to_structs(c, batch, self.schema_type, ctx)
+            if out is not None:
+                return out
             rows = [from_json_impl(v, self.schema_type)
                     for v in c.to_arrow().to_pylist()]
         col = TpuColumnVector.from_arrow(pa.array(rows, type=at))
@@ -422,6 +613,161 @@ class JsonToStructs(UnaryExpression):
 
     def pretty(self) -> str:
         return f"from_json({self.child.pretty()})"
+
+
+def device_structs_to_json(col, batch, st, ctx=None):
+    """Device to_json for structs of int/bool/string fields: one
+    concat_columns assembly — constant braces/keys/quotes ride the
+    separator mechanism, bools gather from a shared 'truefalse' buffer,
+    ints render into fixed-width digit cells, strings reuse their child
+    byte buffer. Rows whose strings need escaping (or non-ASCII) are
+    host-patched row-wise. None = outside the device subset (reference
+    GpuStructsToJson.scala)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..kernels import strings as SK
+    from ..columnar.vector import TpuColumnVector, bucket_capacity
+    from ..types import BooleanType, IntegralType
+    from .strings import _str_col
+    ok_types = (IntegralType, BooleanType, StringType)
+    if not isinstance(st, StructType) \
+            or not all(isinstance(f.data_type, ok_types) for f in st.fields):
+        return None
+    if not (isinstance(col, TpuColumnVector) and col.children is not None
+            and col.host_data is None):
+        return None
+    kids = col.children
+    if any(k.host_data is not None for k in kids):
+        return None
+    cap = batch.capacity
+    n = batch.num_rows
+    row_ok = jnp.ones((cap,), bool)  # device-confident rows
+    struct_valid = col.validity if col.validity is not None \
+        else jnp.ones((cap,), bool)
+    parts, part_emit, seps = [], [], []
+    zero_starts = jnp.zeros((cap,), jnp.int32)
+    empty = (jnp.zeros((1,), jnp.uint8), zero_starts, zero_starts)
+    all_rows = jnp.ones((cap,), bool)
+
+    def add_const(bts, emit):
+        parts.append(empty)
+        part_emit.append(jnp.zeros((cap,), bool))
+        seps.append((np.frombuffer(bts, np.uint8), emit))
+
+    add_const(b"{", struct_valid)
+    prev_any = jnp.zeros((cap,), bool)
+    bool_buf = jnp.asarray(np.frombuffer(b"truefalse", np.uint8))
+    total_bytes = 2
+    for f, kid in zip(st.fields, kids):
+        fvalid = kid.validity if kid.validity is not None else all_rows
+        emit = fvalid & struct_valid
+        add_const(b",", emit & prev_any)
+        add_const(b'"%s":' % f.name.encode(), emit)
+        total_bytes += len(f.name) + 4
+        if isinstance(f.data_type, BooleanType):
+            b = kid.data.astype(jnp.int32)
+            starts_v = jnp.where(b != 0, 0, 4).astype(jnp.int32)
+            lens_v = jnp.where(b != 0, 4, 5).astype(jnp.int32)
+            parts.append((bool_buf, starts_v, lens_v))
+            part_emit.append(emit)
+            seps.append(None)
+            total_bytes += 5
+        elif isinstance(f.data_type, IntegralType):
+            W = 20
+            v = kid.data.astype(jnp.int64)
+            neg = v < 0
+            # |v| via where (int64 min is unreachable for json ints we emit)
+            av = jnp.where(neg, -v, v)
+            nd = jnp.ones((cap,), jnp.int32)
+            p = jnp.int64(10)
+            for _ in range(18):
+                nd = nd + (av >= p)
+                p = p * 10
+            cells = []
+            for k in range(W):
+                r = W - 1 - k  # digit significance from the right
+                div = jnp.int64(10) ** r if r < 19 else jnp.int64(10**18) * 10
+                digit = (av // div) % 10
+                cells.append((digit + 48).astype(jnp.uint8))
+            mat = jnp.stack(cells, axis=1)  # (cap, W) right-aligned digits
+            start_in = (W - nd).astype(jnp.int32)
+            # place '-' just before the first digit for negatives
+            sign_pos = jnp.clip(start_in - 1, 0, W - 1)
+            mat = jnp.where(
+                (jnp.arange(W)[None, :] == sign_pos[:, None])
+                & neg[:, None], jnp.uint8(ord("-")), mat)
+            flat = mat.reshape(-1)
+            starts_v = (jnp.arange(cap, dtype=jnp.int32) * W
+                        + jnp.where(neg, start_in - 1, start_in))
+            lens_v = nd + neg.astype(jnp.int32)
+            parts.append((flat, starts_v, lens_v))
+            part_emit.append(emit)
+            seps.append(None)
+            total_bytes += W
+        else:  # string
+            if kid.offsets is None:
+                return None
+            s0 = kid.offsets[:-1].astype(jnp.int32)
+            sl = (kid.offsets[1:] - kid.offsets[:-1]).astype(jnp.int32)
+            kdata = kid.data
+            kbytes = int(kdata.shape[0])
+            # rows whose value needs escaping (quote, backslash, control,
+            # non-ASCII) fall to the host patch
+            bad = ((kdata == np.uint8(ord('"')))
+                   | (kdata == np.uint8(ord("\\")))
+                   | (kdata < np.uint8(0x20)) | (kdata >= np.uint8(0x80)))
+            bpref = jnp.concatenate([
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(bad.astype(jnp.int32))])
+            nb = bpref[jnp.clip(s0 + sl, 0, kbytes)] \
+                - bpref[jnp.clip(s0, 0, kbytes)]
+            row_ok = row_ok & ((nb == 0) | ~emit)
+            add_const(b'"', emit)
+            parts.append((kdata, s0, sl))
+            part_emit.append(emit)
+            seps.append(None)
+            add_const(b'"', emit)
+            total_bytes += int(jnp.max(sl)) + 2 if n else 2
+        prev_any = prev_any | emit
+    add_const(b"}", struct_valid)
+    out_cap = bucket_capacity(max(cap * total_bytes, 1))
+    if out_cap > 1 << 26:  # pathological width: keep HBM bounded, go host
+        return None
+    rm = jnp.arange(cap) < n
+    serve = (row_ok | ~struct_valid) & True
+    serve = serve | ~rm
+    out, offs = SK.concat_columns(parts, out_cap, part_emit=part_emit,
+                                  seps=seps)
+    serve_np = np.asarray(serve)
+    validity = struct_valid & rm
+    if bool(np.all(serve_np)):
+        return _str_col(batch, out, offs, validity, col)
+    # host patch for escape-needing rows
+    import pyarrow as pa
+    texts = col.to_arrow().to_pylist()
+    patched = [None] * cap
+    for i in np.nonzero(~serve_np)[0]:
+        v = texts[int(i)]
+        patched[int(i)] = None if v is None else _json.dumps(
+            StructsToJson._to_jsonable(v, st), separators=(",", ":"))
+    pcol = TpuColumnVector.from_arrow(pa.array(patched, pa.string()))
+    serve_j = jnp.asarray(serve_np)
+    pvalid = (pcol.validity if pcol.validity is not None
+              else jnp.ones((int(pcol.offsets.shape[0]) - 1,), bool))
+    pn = int(pcol.offsets.shape[0]) - 1
+    p_starts = jnp.zeros((cap,), jnp.int32).at[:pn].set(
+        pcol.offsets[:-1][:cap])
+    p_lens = jnp.zeros((cap,), jnp.int32).at[:pn].set(
+        (pcol.offsets[1:] - pcol.offsets[:-1])[:cap])
+    pv = jnp.zeros((cap,), bool).at[:pn].set(pvalid[:cap])
+    out2, offs2 = SK.concat_columns(
+        [(out, offs[:-1], offs[1:] - offs[:-1]),
+         (pcol.data, p_starts, p_lens)],
+        bucket_capacity(max(out_cap + int(pcol.data.shape[0]), 1)),
+        part_emit=[serve_j & validity, (~serve_j) & pv])
+    final_valid = jnp.where(serve_j, validity, pv)
+    return _str_col(batch, out2, offs2, final_valid, col)
 
 
 class StructsToJson(UnaryExpression):
@@ -475,6 +821,9 @@ class StructsToJson(UnaryExpression):
         c = self.child.eval_tpu(batch, ctx)
         if isinstance(c, TpuScalar):
             return TpuScalar(StringT, self._row_to_json(c.value))
+        out = device_structs_to_json(c, batch, self.child.dtype, ctx)
+        if out is not None:
+            return out
         out = pa.array([self._row_to_json(v) for v in c.to_arrow().to_pylist()],
                        type=pa.string())
         return _string_result_from_arrow(out, batch)
@@ -503,6 +852,27 @@ class JsonTuple(Generator):
 
     def element_schema(self):
         return [(f"c{i}", StringT, True) for i in range(len(self.fields))]
+
+    def render_field(self, doc: Optional[str], field: str) -> Optional[str]:
+        """One field of one document, json_tuple rendering (floats/nested
+        re-serialized canonically) — the host patch for the device scan."""
+        if doc is None:
+            return None
+        try:
+            parsed = _json.loads(doc)
+            obj = parsed if isinstance(parsed, dict) else None
+        except (ValueError, RecursionError):
+            obj = None
+        v = obj.get(field) if obj is not None else None
+        if v is None:
+            return None
+        if isinstance(v, str):
+            return v
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (dict, list)):
+            return _json.dumps(v, separators=(",", ":"))
+        return _json.dumps(v)
 
     def extract_rows(self, docs: List[Optional[str]]) -> List[List[Optional[str]]]:
         """Per input doc, the extracted field values (one output row each)."""

@@ -388,11 +388,16 @@ def _register_builtin_exprs() -> None:
                   "host fallback; multi-step paths on the host engine",
                   incompat="multi-step paths run on host")
     register_expr(J.JsonToStructs, TypeSigs.nested_common,
-                  "from_json (PERMISSIVE)", host_assisted=True)
-    register_expr(J.StructsToJson, TypeSigs.STRING, "to_json",
-                  host_assisted=True)
-    register_expr(J.JsonTuple, TypeSigs.STRING, "json_tuple generator",
-                  host_assisted=True)
+                  "from_json (PERMISSIVE): one device scan per schema key, "
+                  "device int/bool/string coercion, per-row host patch",
+                  incompat="float/date/nested schema fields via host path")
+    register_expr(J.StructsToJson, TypeSigs.STRING,
+                  "to_json (device byte assembly for int/bool/string "
+                  "structs; escape-needing rows host-patched)",
+                  incompat="float/date/nested fields via host path")
+    register_expr(J.JsonTuple, TypeSigs.STRING,
+                  "json_tuple generator (device scan per field)",
+                  incompat="floats/nested values host-rendered per row")
 
     from ..expressions import bloom as BF
     register_expr(BF.BloomFilterMightContain, TypeSigs.BOOLEAN,

@@ -38,13 +38,27 @@ def round_robin_partition_ids(batch: TpuColumnarBatch, n: int,
 
 
 def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[TpuColumnarBatch]]:
-    """Device split: stable sort by pid, host-sync boundaries, gather slices."""
+    """Device split: stable sort by pid, one async boundary readback,
+    gather slices.
+
+    The n+1 partition bounds decide each output's row count, and the exec
+    protocol carries counts as python ints — so ONE small D→H transfer per
+    batch is inherent to eager host-driven slicing (the compiled stage in
+    execs/compiled.py is the no-sync path). What this avoids is blocking
+    the pipeline for the full round trip: the copy starts immediately
+    after the searchsorted is enqueued, overlapping the transfer with
+    dispatch of the sort/gather work already in flight."""
     cap = batch.capacity
     mask = row_mask(batch.num_rows, cap)
     key = jnp.where(mask, pids, n)  # padding last
     order = jnp.argsort(key, stable=True)
     sorted_pid = jnp.take(key, order)
-    bounds = np.asarray(jnp.searchsorted(sorted_pid, jnp.arange(n + 1)))  # host sync
+    bounds_dev = jnp.searchsorted(sorted_pid, jnp.arange(n + 1))
+    try:
+        bounds_dev.copy_to_host_async()
+    except AttributeError:  # older jax arrays: np.asarray below still works
+        pass
+    bounds = np.asarray(bounds_dev)
     out: List[Optional[TpuColumnarBatch]] = []
     for p in range(n):
         lo, hi = int(bounds[p]), int(bounds[p + 1])

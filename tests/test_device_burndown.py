@@ -166,3 +166,47 @@ def test_map_through_shuffle_and_filter(sessions):
                .select("m", "x")
                .sort("x")
                .limit(50))
+
+
+def test_from_json_device_matches_oracle(sessions):
+    docs = ['{"a": 1, "b": "x", "c": true}',
+            '{"a": 1.5, "b": "y", "c": false}',
+            None, 'not json', '[1,2]', '{}',
+            '{"a": -42, "c": true}',
+            '{"a": 99999999999999999999, "b": "big"}',
+            '{"b": "esc\\"aped"}',
+            '{"a": 300}',
+            '{"a": null, "b": null, "c": null}',
+            '  {"a": 7}',
+            '{"a": "12", "b": 5, "c": "t"}'] * 10
+    rows = [{"j": d} for d in docs]
+    _oracle_eq(sessions, rows, lambda df: df.select(
+        F.from_json(F.col("j"), "a INT, b STRING, c BOOLEAN").alias("s")))
+
+
+def test_to_json_device_matches_oracle(sessions):
+    rng = random.Random(6)
+    rows = []
+    for i in range(120):
+        if i % 11 == 0:
+            rows.append(None)
+        else:
+            rows.append({"a": rng.choice([None, 0, -1, 42, -99999999,
+                                          2**60]),
+                         "b": rng.choice([None, True, False]),
+                         "c": rng.choice([None, "", "plain",
+                                          'he said "hi"', "tab\there",
+                                          "uni∆"])})
+    t = pa.table({"s": pa.array(rows, pa.struct(
+        [("a", pa.int64()), ("b", pa.bool_()), ("c", pa.string())]))})
+    _oracle_eq(sessions, t, lambda df: df.select(
+        F.to_json(F.col("s")).alias("j")))
+
+
+def test_json_tuple_device_matches_oracle(sessions):
+    docs = ['{"a": 1, "b": "x"}', '{"a": 1.50, "b": true}', None,
+            'not json', '{"b": {"c": [1, 2]}}', '{"a": -42}',
+            '{"a": "with \\" escape"}', '{}'] * 8
+    rows = [{"j": d} for d in docs]
+    _oracle_eq(sessions, rows, lambda df: df.select(
+        F.json_tuple(F.col("j"), "a", "b")))

@@ -9,6 +9,23 @@ import benchmarks.tpcds as tpcds
 
 ROWS = 12_000
 
+_done = [0]
+
+
+@pytest.fixture(autouse=True)
+def _bound_xla_within_module():
+    """99 queries x 2 sessions compile thousands of executables in ONE
+    module; the conftest's per-module cache drop never fires inside it and
+    the unbounded live-executable set has segfaulted the allocator deep
+    into the run. Drop caches every 12 queries."""
+    yield
+    _done[0] += 1
+    if _done[0] % 12 == 0:
+        import gc
+        import jax
+        jax.clear_caches()
+        gc.collect()
+
 
 @pytest.fixture(scope="module")
 def suites():
