@@ -29,11 +29,12 @@ Carry layout is IDENTICAL to the compiled aggregation stage
 (execs/compiled.py), so the host-side merge/finalize machinery is shared.
 
 Eligibility (anything else transparently falls back to the shuffled-join
-plan): inner/left-semi single-column equi-joins with no residual condition;
-integral/date join keys; the fact leaf is a device-pure filter/project chain
-over a source; every traced column fixed-width non-decimal; group keys are
-columns of ONE inner dimension (or absent: global aggregate); aggregates
-sum/count/avg/min/max.
+plan): inner/left-semi equi-joins with no residual condition; integral/date
+join keys — multi-column keys pack into one monotone int64 composite at
+build time (r5), so the probe stays a single searchsorted; the fact leaf is
+a device-pure filter/project chain over a source; every traced column
+fixed-width non-decimal; group keys are columns of ONE inner dimension (or
+absent: global aggregate); aggregates sum/count/avg/min/max.
 """
 
 from __future__ import annotations
@@ -71,14 +72,16 @@ class _JoinStageFallback(Exception):
 
 class _DimSpec:
     """One build side: `plan` materializes once; the stream probes its
-    `key_ordinal` column with the value at `probe_loc` (("fact", o) or
-    ("dim", earlier_dim_index, o))."""
+    `key_ordinals` columns with the values at `probe_locs` (each
+    ("fact", o) or ("dim", earlier_dim_index, o)). Multi-column keys pack
+    into one monotone int64 composite at build time (per-key min/stride),
+    so the probe stays a single searchsorted."""
 
-    def __init__(self, plan: PhysicalPlan, key_ordinal: int, probe_loc,
-                 semi: bool):
+    def __init__(self, plan: PhysicalPlan, key_ordinals: List[int],
+                 probe_locs: List, semi: bool):
         self.plan = plan
-        self.key_ordinal = key_ordinal
-        self.probe_loc = probe_loc
+        self.key_ordinals = list(key_ordinals)
+        self.probe_locs = list(probe_locs)
         self.semi = semi
         self.payload_ordinals: List[int] = []  # device-gathered columns
 
@@ -123,7 +126,8 @@ class _JoinStageSpec:
         parts.append("N" + ",".join(map(str, self.fact_needed_source)))
         parts.append("NT" + ",".join(map(str, self.needed_top)))
         for d in self.dims:
-            parts.append(f"D{d.key_ordinal}:{int(d.semi)}:{d.probe_loc}:"
+            parts.append(f"D{tuple(d.key_ordinals)}:{int(d.semi)}:"
+                         f"{tuple(d.probe_locs)}:"
                          + ",".join(map(str, d.payload_ordinals)))
         parts.append(f"G{self.group_dim}")
         return ("|".join(parts), cap, dim_caps)
@@ -162,12 +166,11 @@ def _flatten_join_tree(node: PhysicalPlan):
             raise _Ineligible()
         if node.condition is not None:
             raise _Ineligible()
-        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+        if not node.left_keys or len(node.left_keys) != len(node.right_keys):
             raise _Ineligible()
-        lk = _unwrap_widening_cast(node.left_keys[0])
-        rk = _unwrap_widening_cast(node.right_keys[0])
-        if not (isinstance(lk, AttributeReference)
-                and isinstance(rk, AttributeReference)):
+        lks = [_unwrap_widening_cast(k) for k in node.left_keys]
+        rks = [_unwrap_widening_cast(k) for k in node.right_keys]
+        if not all(isinstance(k, AttributeReference) for k in lks + rks):
             raise _Ineligible()
         semi = node.join_type in ("leftsemi", "semi")
         l_leaves, l_conds = _flatten_join_tree(node.children[0])
@@ -179,7 +182,7 @@ def _flatten_join_tree(node: PhysicalPlan):
                 raise _Ineligible()
         else:
             r_leaves, r_conds = _flatten_join_tree(node.children[1])
-        return l_leaves + r_leaves, l_conds + r_conds + [(lk, rk, semi)]
+        return l_leaves + r_leaves, l_conds + r_conds + [(lks, rks, semi)]
     return [node], []
 
 
@@ -307,30 +310,44 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
         while pending:
             progressed = False
             for cond in list(pending):
-                lk, rk, semi = cond
-                l_loc, r_loc = loc_of(lk), loc_of(rk)
-                if l_loc is None or r_loc is None:
+                lks, rks, semi = cond
+                l_locs = [loc_of(k) for k in lks]
+                r_locs = [loc_of(k) for k in rks]
+                if any(x is None for x in l_locs + r_locs):
                     raise _Ineligible()
                 # semi: only the right side may be the dimension
-                orientations = ((l_loc, r_loc, lk, rk),) if semi else \
-                    ((l_loc, r_loc, lk, rk), (r_loc, l_loc, rk, lk))
+                orientations = ((l_locs, r_locs, lks, rks),) if semi else \
+                    ((l_locs, r_locs, lks, rks),
+                     (r_locs, l_locs, rks, lks))
                 placed = False
-                for probe, dim, probe_attr, dim_attr in orientations:
-                    d_leaf, d_ord = dim
-                    p_leaf, p_ord = probe
+                for p_locs, d_locs, p_attrs, d_attrs in orientations:
+                    # ALL dim-side keys must live on one un-joined leaf
+                    d_leaves = {loc[0] for loc in d_locs}
+                    if len(d_leaves) != 1:
+                        continue
+                    d_leaf = next(iter(d_leaves))
                     if d_leaf == fact_idx or d_leaf in dim_of_leaf:
                         continue
-                    if not isinstance(dim_attr.dtype,
-                                      (IntegralType, DateType)):
+                    if not all(isinstance(a.dtype, (IntegralType, DateType))
+                               for a in d_attrs):
                         continue
-                    if p_leaf == fact_idx:
-                        probe_loc = ("fact", p_ord)
-                    elif p_leaf in dim_of_leaf \
-                            and not dims[dim_of_leaf[p_leaf]].semi:
-                        probe_loc = ("dim", dim_of_leaf[p_leaf], p_ord)
-                    else:
+                    probe_locs = []
+                    ok = True
+                    for (p_leaf, p_ord) in p_locs:
+                        if p_leaf == fact_idx:
+                            probe_locs.append(("fact", p_ord))
+                        elif p_leaf in dim_of_leaf \
+                                and not dims[dim_of_leaf[p_leaf]].semi:
+                            probe_locs.append(
+                                ("dim", dim_of_leaf[p_leaf], p_ord))
+                        else:
+                            ok = False
+                            break
+                    if not ok:
                         continue
-                    spec = _DimSpec(leaves[d_leaf], d_ord, probe_loc, semi)
+                    spec = _DimSpec(leaves[d_leaf],
+                                    [loc[1] for loc in d_locs],
+                                    probe_locs, semi)
                     dim_of_leaf[d_leaf] = len(dims)
                     dims.append(spec)
                     pending.remove(cond)
@@ -376,14 +393,17 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
             if isinstance(dt, (StringType, DecimalType)) \
                     or not is_fixed_width(dt):
                 group_keys_device = False
-        if group_dim is not None \
-                and dims[group_dim].key_ordinal not in group_key_ordinals:
-            # Grouping by dim ROW INDEX is only value-correct when the dim's
-            # (unique) join key is among the group keys: two dim rows can
-            # otherwise share identical non-key payload values, and
-            # row-grouping would split what SQL groups together (found by
-            # TPC-H q21: two suppliers with equal s_name).
-            raise _Ineligible()
+        # Grouping by dim ROW INDEX is only value-correct when the group
+        # key columns are UNIQUE per dim row: two dim rows could otherwise
+        # share identical payload values and row-grouping would split what
+        # SQL groups together (found by TPC-H q21: two suppliers with equal
+        # s_name). Covering all join keys proves it statically (the build
+        # verifies composite uniqueness); a subset defers the uniqueness
+        # check to build time over the materialized dim.
+        group_unique_checked = (
+            group_dim is not None
+            and not (set(dims[group_dim].key_ordinals)
+                     <= set(group_key_ordinals)))
 
         # traced columns: agg children + top layers, walked to the join out
         agg_refs = set()
@@ -427,14 +447,15 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
 
         # probe-chain payloads gather on device too
         for d in dims:
-            if d.probe_loc[0] == "dim":
-                _, di, o = d.probe_loc
-                dt = dims[di].plan.output[o].dtype
-                if isinstance(dt, (StringType, DecimalType)) \
-                        or not is_fixed_width(dt):
-                    raise _Ineligible()
-                if o not in dims[di].payload_ordinals:
-                    dims[di].payload_ordinals.append(o)
+            for loc in d.probe_locs:
+                if loc[0] == "dim":
+                    _, di, o = loc
+                    dt = dims[di].plan.output[o].dtype
+                    if isinstance(dt, (StringType, DecimalType)) \
+                            or not is_fixed_width(dt):
+                        raise _Ineligible()
+                    if o not in dims[di].payload_ordinals:
+                        dims[di].payload_ordinals.append(o)
         for d in dims:
             d.payload_ordinals.sort()
 
@@ -442,8 +463,9 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
         fact_top_needed = {col_loc[o][1] for o in needed_top
                            if col_loc[o][0] == "fact"}
         for d in dims:
-            if d.probe_loc[0] == "fact":
-                fact_top_needed.add(d.probe_loc[1])
+            for loc in d.probe_locs:
+                if loc[0] == "fact":
+                    fact_top_needed.add(loc[1])
         fact_needed_source = sorted(
             _walk_needed(fact_top_needed, fact_layers))
         for o in fact_needed_source:
@@ -460,6 +482,7 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
             group_key_ordinals, agg_fns, result_exprs, list(agg.output),
             needed_top)
         spec.device_output = device_output
+        spec.group_unique_check = group_unique_checked
         return spec
     except _Ineligible:
         return None
@@ -560,7 +583,8 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
         fact_cols = batch.columns  # fact leaf top space
 
         # ---- probe chain ----------------------------------------------
-        # dim_flat per dim: (keys_sorted_i64, n_valid, {payload data+valid})
+        # dim_flat per dim: (keys_sorted_i64, n_valid, lo, mins, strides,
+        # maxs, {payload data+valid})
         dim_idx: List[Optional[jnp.ndarray]] = [None] * len(dims)
 
         def resolve_probe(loc):
@@ -570,26 +594,42 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
                 return c.data, v
             _, di, o = loc
             j = dims[di].payload_ordinals.index(o)
-            pdata, pvalid = dim_flat[di][3 + 2 * j], dim_flat[di][4 + 2 * j]
+            pdata, pvalid = dim_flat[di][6 + 2 * j], dim_flat[di][7 + 2 * j]
             idx = dim_idx[di]
             return jnp.take(pdata, idx), jnp.take(pvalid, idx)
 
         for di, d in enumerate(dims):
             keys, n_valid, lo = (dim_flat[di][0], dim_flat[di][1],
                                  dim_flat[di][2])
-            pdata, pvalid = resolve_probe(d.probe_loc)
-            probe = pdata.astype(jnp.int64)
+            mins, strides, maxs = (dim_flat[di][3], dim_flat[di][4],
+                                   dim_flat[di][5])
+            parts = [resolve_probe(loc) for loc in d.probe_locs]
+            if len(parts) == 1:
+                pdata, pvalid = parts[0]
+                probe = pdata.astype(jnp.int64)
+                in_range = pvalid
+            else:
+                # recompute the build's monotone composite; rows with any
+                # key outside the build ranges can alias a real composite
+                # value, so they are excluded explicitly
+                probe = jnp.zeros((cap,), jnp.int64)
+                in_range = jnp.ones((cap,), bool)
+                for k, (pdata, pvalid) in enumerate(parts):
+                    pv = pdata.astype(jnp.int64)
+                    in_range = in_range & pvalid \
+                        & (pv >= mins[k]) & (pv <= maxs[k])
+                    probe = probe + (pv - mins[k]) * strides[k]
             if dim_dense[di]:
                 # contiguous keys: direct addressing, no binary search
                 rel = probe - lo
                 idx = jnp.clip(rel, 0, keys.shape[0] - 1).astype(jnp.int32)
                 matched = ((rel >= 0) & (rel < n_valid.astype(jnp.int64))
-                           & pvalid)
+                           & in_range)
             else:
                 idx = jnp.searchsorted(keys, probe).astype(jnp.int32)
                 idx = jnp.clip(idx, 0, keys.shape[0] - 1)
                 matched = (jnp.take(keys, idx) == probe) \
-                    & (idx < n_valid) & pvalid
+                    & (idx < n_valid) & in_range
             alive = alive & matched
             dim_idx[di] = idx
 
@@ -603,8 +643,8 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
             else:
                 _, di, lo = loc
                 j = dims[di].payload_ordinals.index(lo)
-                pdata = dim_flat[di][3 + 2 * j]
-                pvalid = dim_flat[di][4 + 2 * j]
+                pdata = dim_flat[di][6 + 2 * j]
+                pvalid = dim_flat[di][7 + 2 * j]
                 top_cols[o] = TpuColumnVector(
                     spec.top_output[o].dtype,
                     jnp.take(pdata, dim_idx[di]),
@@ -761,12 +801,15 @@ import collections as _collections
 
 _DIM_BUILD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
 _DIM_BUILD_CACHE_MAX = 8
+# (dim build cache key, group ordinals) -> group-key-uniqueness verdict
+_GROUP_UNIQUE_CACHE: Dict[Tuple, bool] = {}
 
 
 def clear_dim_cache() -> None:
     """Release the cached dimension builds (host tables, source refs, and
     the HBM key/payload arrays they pin)."""
     _DIM_BUILD_CACHE.clear()
+    _GROUP_UNIQUE_CACHE.clear()
 
 
 def _dim_sources(plan: PhysicalPlan):
@@ -868,19 +911,52 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                 names=[a.name for a in d.plan.output])
         if table.num_rows > self.max_dim_rows:
             raise _JoinStageFallback()
-        key_col = table.column(d.key_ordinal)
-        if isinstance(key_col, pa.ChunkedArray):
-            key_col = key_col.combine_chunks()
-        valid = pc.is_valid(key_col)
+
+        def key_i64(ordinal):
+            kc = table.column(ordinal)
+            if isinstance(kc, pa.ChunkedArray):
+                kc = kc.combine_chunks()
+            if pa.types.is_date32(kc.type) or pa.types.is_time32(kc.type):
+                kc = kc.cast(pa.int32())
+            return np.asarray(kc.cast(pa.int64()).to_numpy(
+                zero_copy_only=False), np.int64)
+
+        valid = None
+        for o in d.key_ordinals:
+            kc = table.column(o)
+            v = pc.is_valid(kc.combine_chunks()
+                            if isinstance(kc, pa.ChunkedArray) else kc)
+            valid = v if valid is None else pc.and_(valid, v)
         table = table.filter(valid)
-        key_col = table.column(d.key_ordinal)
-        if isinstance(key_col, pa.ChunkedArray):
-            key_col = key_col.combine_chunks()
-        if pa.types.is_date32(key_col.type) or pa.types.is_time32(
-                key_col.type):
-            key_col = key_col.cast(pa.int32())
-        keys = np.asarray(key_col.cast(pa.int64()).to_numpy(
-            zero_copy_only=False), np.int64)
+        key_parts = [key_i64(o) for o in d.key_ordinals]
+        nk = len(key_parts)
+        if nk == 1:
+            keys = key_parts[0]
+            mins = np.zeros(1, np.int64)
+            strides = np.ones(1, np.int64)
+            maxs = np.full(1, np.iinfo(np.int64).max - 1, np.int64)
+        else:
+            # monotone composite: (k_i - min_i) * stride_i summed; the probe
+            # recomputes the same packing, so a single searchsorted covers
+            # the whole multi-column key
+            mins = np.array([k.min() if len(k) else 0 for k in key_parts],
+                            np.int64)
+            maxs = np.array([k.max() if len(k) else 0 for k in key_parts],
+                            np.int64)
+            # python-int spans: an int64-wrapping span (keys near both
+            # extremes) must fail the guard, not alias past it
+            spans = [int(hi) - int(lo) + 1 for lo, hi in zip(mins, maxs)]
+            prod = 1
+            for sp in spans:
+                prod *= sp
+            if prod >= 2**62:
+                raise _JoinStageFallback()  # composite would overflow
+            strides = np.ones(nk, np.int64)
+            for i in range(nk - 2, -1, -1):
+                strides[i] = strides[i + 1] * spans[i + 1]
+            keys = np.zeros(len(key_parts[0]), np.int64)
+            for k, mn, st in zip(key_parts, mins, strides):
+                keys = keys + (k - mn) * st
         order = np.argsort(keys, kind="stable")
         keys = keys[order]
         if d.semi:
@@ -898,11 +974,12 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         # dense contiguous keys (sequential PKs — the common dimension
         # shape): probe resolves by SUBTRACTION instead of a 20-gather
         # binary search over HBM — the probe program's dominant cost
-        dense = bool(n and keys[0] + n - 1 == keys[-1]
+        dense = bool(nk == 1 and n and keys[0] + n - 1 == keys[-1]
                      and np.all(np.diff(keys) == 1))
         lo = int(keys[0]) if n else 0
         flat = [jnp.asarray(padded), jnp.int32(n),
-                jnp.int64(lo if dense else 0)]
+                jnp.int64(lo if dense else 0),
+                jnp.asarray(mins), jnp.asarray(strides), jnp.asarray(maxs)]
         for o in d.payload_ordinals:
             vec = TpuColumnVector.from_arrow(sorted_tbl.column(o))
             if vec.offsets is not None or vec.host_data is not None \
@@ -927,6 +1004,7 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         if self._dims_built is None:
             with self.metrics["buildTime"].timed():
                 dim_tables, dim_flats, dim_caps, dim_dense = [], [], [], []
+                dim_keys = []
                 from ..config import ANSI_ENABLED, SESSION_TZ
                 # eval-relevant session conf is part of the key: the same
                 # dim plan under a different timezone/ANSI setting must not
@@ -934,7 +1012,7 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                 conf_fp = (ctx.conf.get(SESSION_TZ),
                            ctx.conf.get(ANSI_ENABLED))
                 for d in spec.dims:
-                    key = (_dim_structure(d.plan), d.key_ordinal,
+                    key = (_dim_structure(d.plan), tuple(d.key_ordinals),
                            tuple(d.payload_ordinals), d.semi, conf_fp)
                     srcs = _dim_sources(d.plan)
                     hit = _DIM_BUILD_CACHE.get(key)
@@ -952,6 +1030,37 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                     dim_flats.append(flat)
                     dim_caps.append(cap_d)
                     dim_dense.append(dense)
+                    dim_keys.append(key)
+                if getattr(spec, "group_unique_check", False):
+                    # group keys are a subset of the dim's join keys:
+                    # row-index grouping is correct only if those columns
+                    # alone are unique over the materialized dim. Ordinal-
+                    # based and numpy-side: attribute NAMES are not unique,
+                    # so pyarrow group_by could KeyError instead of falling
+                    # back. Verdict memoized per (dim build, ordinals).
+                    ukey = (dim_keys[spec.group_dim],
+                            tuple(spec.group_key_ordinals))
+                    uniq = _GROUP_UNIQUE_CACHE.get(ukey)
+                    if uniq is None:
+                        gt = dim_tables[spec.group_dim]
+                        uniq = True
+                        if gt.num_rows > 1:
+                            arrs = [np.asarray(
+                                gt.column(o).combine_chunks()
+                                .to_numpy(zero_copy_only=False))
+                                for o in spec.group_key_ordinals]
+                            order = np.lexsort(arrs[::-1])
+                            eq = np.ones(gt.num_rows - 1, bool)
+                            for a in arrs:
+                                s = a[order]
+                                eq &= s[1:] == s[:-1]
+                            uniq = not bool(np.any(eq))
+                        _GROUP_UNIQUE_CACHE[ukey] = uniq
+                        while len(_GROUP_UNIQUE_CACHE) > 64:
+                            _GROUP_UNIQUE_CACHE.pop(
+                                next(iter(_GROUP_UNIQUE_CACHE)))
+                    if not uniq:
+                        raise _JoinStageFallback()
                 self._dims_built = (dim_tables, dim_flats, dim_caps,
                                     tuple(dim_dense))
         dim_tables, dim_flats, dim_caps, dim_dense = self._dims_built
@@ -1044,8 +1153,8 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         key_cols = []
         for o in spec.group_key_ordinals:
             j = gd.payload_ordinals.index(o)
-            key_cols.append((dim_flats[spec.group_dim][3 + 2 * j],
-                             dim_flats[spec.group_dim][4 + 2 * j]))
+            key_cols.append((dim_flats[spec.group_dim][6 + 2 * j],
+                             dim_flats[spec.group_dim][7 + 2 * j]))
         fnspec = []
         for fn in spec.agg_fns:
             is_fp = bool(fn.children) and _is_fp(fn.children[0].dtype)

@@ -210,3 +210,33 @@ def test_json_tuple_device_matches_oracle(sessions):
     rows = [{"j": d} for d in docs]
     _oracle_eq(sessions, rows, lambda df: df.select(
         F.json_tuple(F.col("j"), "a", "b")))
+
+
+def test_multi_key_compiled_join(sessions):
+    """r5: multi-column equi-keys pack into one monotone composite, so the
+    compiled star-join stage serves them (TPC-H q5's nation-chained shape).
+    Includes the subset-group-key path (uniqueness verified at build)."""
+    import math
+    import random as _r
+    rng = _r.Random(1)
+    tpu_s, cpu_s = sessions
+    cust = [{"ck": i, "nat": i % 5} for i in range(100)]
+    supp = [{"sk": i, "snat": i % 5, "sid": i} for i in range(40)]
+    fact = [{"fc": rng.randint(0, 99), "fs": rng.randint(0, 39),
+             "v": rng.random()} for _ in range(8000)]
+
+    def run(sess):
+        fd = sess.createDataFrame(fact, num_partitions=4)
+        cd = sess.createDataFrame(cust)
+        sd = sess.createDataFrame(supp)
+        j = fd.join(cd, on=fd["fc"] == cd["ck"]).join(
+            sd, on=(F.col("fs") == sd["sk"]) & (F.col("nat") == sd["snat"]))
+        return j.groupBy("sk").agg(F.sum(F.col("v")).alias("sv")).sort("sk")
+
+    q = run(tpu_s)
+    assert "CompiledJoinAggStage" in q.explain()
+    a, b = q.collect(), run(cpu_s).collect()
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x["sk"] == y["sk"]
+        assert math.isclose(x["sv"], y["sv"], rel_tol=1e-9)
