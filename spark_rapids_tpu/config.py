@@ -248,6 +248,12 @@ OOM_RETRY_MAX = _conf("spark.rapids.memory.tpu.oomMaxRetries").doc(
     "Retries of an allocation after synchronizing + spilling before declaring OOM."
 ).integer(3)
 
+TASK_RETRY_LIMIT = _conf("spark.rapids.memory.tpu.taskRetryLimit").doc(
+    "How many times the task-level retry framework re-runs a batch on "
+    "TpuRetryOOM (splitting on TpuSplitAndRetryOOM) before giving up "
+    "(reference RmmRapidsRetryIterator bound)."
+).integer(8)
+
 BUCKET_PADDING = _conf("spark.rapids.tpu.batch.bucketPadding.enabled").doc(
     "Pad batch capacities to power-of-two buckets to bound XLA recompilation under "
     "data-dependent row counts (TPU-specific; no reference analogue — cuDF kernels "
@@ -571,6 +577,32 @@ JSON_DEVICE_SCAN_MAX_ROW_BYTES = _conf(
     "longer rows route to the host engine."
 ).integer(4096)
 
+HASH_DEVICE_MAX_STRING_BYTES = _conf(
+    "spark.rapids.tpu.hash.maxDeviceStringBytes").doc(
+    "Longest string a device hash kernel (murmur3/xxhash64/hive-hash) "
+    "processes with the padded byte-matrix loop; columns with longer rows "
+    "hash on the host (O(rows x max_len) device cost)."
+).integer(4096)
+
+REGEX_MAX_DFA_STATES = _conf(
+    "spark.rapids.tpu.regex.maxDfaStates").doc(
+    "Upper bound on device regex DFA states; patterns compiling larger "
+    "fall back to the host engine (reference regex transpiler state cap)."
+).integer(128)
+
+COMPILED_JOIN_DIM_CACHE_SIZE = _conf(
+    "spark.rapids.tpu.join.compiled.dimCacheSize").doc(
+    "LRU entries in the cross-execution dimension build cache of the "
+    "compiled star-join stage; each entry pins its HBM key/payload arrays."
+).integer(8)
+
+EXECUTOR_HEARTBEAT_TIMEOUT_SECONDS = _conf(
+    "spark.rapids.shuffle.executor.heartbeatTimeoutSeconds").doc(
+    "A multi-process executor worker missing heartbeats for this long is "
+    "declared lost and its tasks re-run (reference "
+    "RapidsShuffleHeartbeatManager intervals)."
+).double(3.0)
+
 UDF_WORKER_TIMEOUT_SECONDS = _conf(
     "spark.rapids.sql.python.workerTimeoutSeconds").doc(
     "Seconds a python UDF may run in its worker before the worker is "
@@ -669,6 +701,20 @@ class RapidsConf:
         s = dict(self._settings)
         s.update({k.replace("__", "."): v for k, v in kv.items()})
         return RapidsConf(s)
+
+
+def declare_expression_flags(names) -> None:
+    """One `spark.rapids.sql.expression.<Name>` boolean entry per registered
+    expression rule — the reference generates exactly this conf per
+    GpuOverrides rule and lists them in the RapidsConf docs. The tagging
+    layer (plan/meta.py) consults these keys on every wrapped expression;
+    declaring them here types and documents them. Called by
+    plan/typechecks.py once its rule registry is populated."""
+    for n in sorted(set(names)):
+        key = f"spark.rapids.sql.expression.{n}"
+        if key in REGISTRY.entries:
+            continue
+        _conf(key).doc(f"Enable expression {n} on TPU.").boolean(True)
 
 
 _DEFAULT = RapidsConf()

@@ -17,6 +17,7 @@ import numpy as np
 from ..columnar.batch import TpuColumnarBatch, compact, concat_batches, slice_batch
 from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
 from ..expressions.base import (AttributeReference, Expression, to_column)
+from ..config import TASK_RETRY_LIMIT as _TRL
 from .base import PhysicalPlan, TaskContext, TpuExec, bind_all, bind_references
 
 
@@ -50,7 +51,8 @@ class TpuProjectExec(TpuExec):
                 # spillable + retry-with-split: projection is row-wise, so split
                 # halves are independently valid outputs (reference
                 # GpuProjectExec withRetrySingleBatch, basicPhysicalOperators.scala:581)
-                yield from with_retry(SpillableColumnarBatch(batch), project)
+                yield from with_retry(SpillableColumnarBatch(batch), project,
+                                      max_retries=ctx.conf.get(_TRL))
 
 
 class TpuFilterExec(TpuExec):
@@ -79,7 +81,8 @@ class TpuFilterExec(TpuExec):
 
         for batch in self.children[0].execute_partition(idx, ctx):
             with op_time.timed():
-                yield from with_retry(SpillableColumnarBatch(batch), do_filter)
+                yield from with_retry(SpillableColumnarBatch(batch), do_filter,
+                                      max_retries=ctx.conf.get(_TRL))
 
 
 class TpuRangeExec(TpuExec):

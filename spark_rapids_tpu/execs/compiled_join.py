@@ -800,7 +800,6 @@ def _finalize_output_dev(merged, occ_mask, key_cols, cap_occ, fnspec):
 import collections as _collections
 
 _DIM_BUILD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
-_DIM_BUILD_CACHE_MAX = 8
 # (dim build cache key, group ordinals) -> group-key-uniqueness verdict
 _GROUP_UNIQUE_CACHE: Dict[Tuple, bool] = {}
 
@@ -1023,7 +1022,9 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                     else:
                         built = self._build_dim(d, ctx)
                         _DIM_BUILD_CACHE[key] = (srcs, built)
-                        while len(_DIM_BUILD_CACHE) > _DIM_BUILD_CACHE_MAX:
+                        from ..config import COMPILED_JOIN_DIM_CACHE_SIZE
+                        cache_max = ctx.conf.get(COMPILED_JOIN_DIM_CACHE_SIZE)
+                        while len(_DIM_BUILD_CACHE) > cache_max:
                             _DIM_BUILD_CACHE.popitem(last=False)
                     tbl, flat, cap_d, dense = built
                     dim_tables.append(tbl)

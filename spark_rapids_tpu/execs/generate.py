@@ -25,6 +25,7 @@ from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
 from ..expressions.base import (AttributeReference, Expression, to_column)
 from ..expressions.generators import Explode, Generator, ReplicateRows, Stack
 from ..types import ArrayType, IntegerT, MapType
+from ..config import TASK_RETRY_LIMIT as _TRL
 from .base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all,
                    bind_references)
 
@@ -203,7 +204,8 @@ class TpuGenerateExec(TpuExec):
             self.metrics["numInputRows"].add(batch.num_rows)
             with op_time.timed():
                 # generators multiply rows; retry-with-split keeps halves valid
-                yield from with_retry(SpillableColumnarBatch(batch), do_generate)
+                yield from with_retry(SpillableColumnarBatch(batch), do_generate,
+                                      max_retries=ctx.conf.get(_TRL))
 
 
 def _device_explode(gen: Explode, batch: TpuColumnarBatch, ctx,
@@ -437,4 +439,5 @@ class TpuExpandExec(TpuExec):
                         # each projection gets its own retryable handle over the
                         # shared device arrays (outer handle keeps them spillable)
                         yield from with_retry(
-                            SpillableColumnarBatch(spill.get_batch()), project)
+                            SpillableColumnarBatch(spill.get_batch()), project,
+                            max_retries=ctx.conf.get(_TRL))

@@ -599,14 +599,29 @@ def xxhash64_batch(cols: Sequence[TpuColumnVector], capacity: int,
     return h.view(jnp.int64)
 
 
-def _device_hashable(cols, children) -> bool:
+def _device_hashable(cols, children, ctx=None) -> bool:
     """All hash inputs are device-resident flat columns (strings must carry
-    offsets); shared gate for the xxhash64/hive-hash device paths."""
-    return all(
-        c.host_data is None and c.children is None
-        and (c.offsets is not None
-             or not isinstance(ch.dtype, StringType))
-        for c, ch in zip(cols, children))
+    offsets, and their longest row must fit the configured device cap —
+    the padded byte-matrix loop costs O(rows x max_len)); shared gate for
+    the xxhash64/hive-hash device paths."""
+    max_bytes = None
+    if ctx is not None:
+        from ..config import HASH_DEVICE_MAX_STRING_BYTES
+        try:
+            max_bytes = ctx.conf.get(HASH_DEVICE_MAX_STRING_BYTES)
+        except Exception:  # noqa: BLE001 — eval ctx without conf
+            max_bytes = None
+    for c, ch in zip(cols, children):
+        if c.host_data is not None or c.children is not None:
+            return False
+        if isinstance(ch.dtype, StringType):
+            if c.offsets is None:
+                return False
+            if max_bytes is not None and c.num_rows:
+                ml = int(jnp.max(c.offsets[1:] - c.offsets[:-1]))
+                if ml > max_bytes:
+                    return False
+    return True
 
 
 class XxHash64(Expression):
@@ -644,7 +659,7 @@ class XxHash64(Expression):
         from ..types import LongT
         cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
                 for c in self.children]
-        if _device_hashable(cols, self.children):
+        if _device_hashable(cols, self.children, ctx):
             try:
                 h = xxhash64_batch(cols, batch.capacity, self.seed)
                 return make_column(LongT, h, None, batch.num_rows)
@@ -770,7 +785,7 @@ class HiveHash(Expression):
         from .base import to_column
         cols = [to_column(c.eval_tpu(batch, ctx), batch, c.dtype)
                 for c in self.children]
-        if _device_hashable(cols, self.children):
+        if _device_hashable(cols, self.children, ctx):
             try:
                 h = jnp.zeros((batch.capacity,), jnp.uint32)
                 for c, ch in zip(cols, self.children):

@@ -97,6 +97,17 @@ def literal_prefix_rewrite(pattern: str) -> Optional[Tuple[str, str]]:
     return ("contains", lit)
 
 
+
+def _dfa_cap(ctx):
+    """Per-session DFA state cap (spark.rapids.tpu.regex.maxDfaStates)."""
+    if ctx is None:
+        return None
+    from ..config import REGEX_MAX_DFA_STATES
+    try:
+        return ctx.conf.get(REGEX_MAX_DFA_STATES)
+    except Exception:  # noqa: BLE001 — eval ctx without conf
+        return None
+
 class RLike(Expression):
     """rlike / regexp: Java `find` semantics (reference GpuRLike)."""
 
@@ -149,7 +160,7 @@ class RLike(Expression):
                                          rlike_device)
         from .base import combine_validity, make_column, row_mask
         from .strings import _dev_str
-        dfa = compile_dfa(self.pattern)
+        dfa = compile_dfa(self.pattern, _dfa_cap(ctx))
         if dfa is None or not _dev_str(col):
             return None
         if not dfa.ascii_atoms and not SK.is_ascii(col.data):
@@ -233,7 +244,7 @@ class RegexpReplace(Expression):
                                          select_leftmost_nonoverlapping)
         if "$" in self.replacement or "\\" in self.replacement:
             return None  # group refs / escapes: host engine
-        dfa = compile_exact_dfa(self.pattern)
+        dfa = compile_exact_dfa(self.pattern, _dfa_cap(ctx))
         if dfa is None or not _dev_str(col):
             return None
         if not dfa.ascii_atoms and not SK.is_ascii(col.data):
@@ -332,7 +343,7 @@ class RegexpExtract(Expression):
                                          match_lengths_device)
         if self.group != 0:
             return None
-        dfa = compile_exact_dfa(self.pattern)
+        dfa = compile_exact_dfa(self.pattern, _dfa_cap(ctx))
         if dfa is None or not _dev_str(col):
             return None
         if not dfa.ascii_atoms and not SK.is_ascii(col.data):

@@ -408,7 +408,8 @@ def _byte_classes(sets: Sequence[FrozenSet[int]]) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=256)
-def compile_dfa(pattern: str) -> Optional[DFA]:
+def compile_dfa(pattern: str,
+                max_states: int = None) -> Optional[DFA]:
     """Compile to a DFA for whole-row acceptance with find semantics folded
     in, or None when the pattern is outside the subset (host fallback)."""
     try:
@@ -463,7 +464,7 @@ def compile_dfa(pattern: str) -> Optional[DFA]:
                             nxt.add(t)
                 closed = nfa.eclose(frozenset(nxt))
                 if closed not in ids:
-                    if len(states) >= MAX_DFA_STATES:
+                    if len(states) >= (max_states or MAX_DFA_STATES):
                         raise RegexReject("DFA too large")
                     ids[closed] = len(states)
                     states.append(closed)
@@ -638,7 +639,8 @@ def _reject_ambiguous_span(ast: _Node) -> None:
 
 
 @functools.lru_cache(maxsize=256)
-def compile_exact_dfa(pattern: str) -> Optional["ExactDFA"]:
+def compile_exact_dfa(pattern: str,
+                      max_states: int = None) -> Optional["ExactDFA"]:
     """Compile for SPAN matching (longest match starting at a position), or
     None when outside the subset. Rejections beyond compile_dfa's:
       * '|' anywhere and lazy quantifiers: Java's backtracking engine picks
@@ -691,7 +693,7 @@ def compile_exact_dfa(pattern: str) -> Optional["ExactDFA"]:
                             nxt.add(t)
                 closed = nfa.eclose(frozenset(nxt))
                 if closed not in ids:
-                    if len(states) >= MAX_DFA_STATES:
+                    if len(states) >= (max_states or MAX_DFA_STATES):
                         raise RegexReject("DFA too large")
                     ids[closed] = len(states)
                     states.append(closed)

@@ -213,7 +213,13 @@ class ExecutorPool:
     (reference: RapidsShuffleHeartbeatManager + Spark task rescheduling)."""
 
     def __init__(self, num_workers: int = 2, shuffle_root: Optional[str] = None,
-                 codec: str = "zstd"):
+                 codec: str = "zstd", hb_timeout_s: Optional[float] = None):
+        if hb_timeout_s is None:
+            from ..config import (EXECUTOR_HEARTBEAT_TIMEOUT_SECONDS,
+                                  default_conf)
+            hb_timeout_s = default_conf().get(
+                EXECUTOR_HEARTBEAT_TIMEOUT_SECONDS)
+        self.hb_timeout_s = float(hb_timeout_s)
         self._ctx = mp.get_context("spawn")
         self.shuffle_root = shuffle_root or tempfile.mkdtemp(
             prefix="tpu_mp_shuffle_")
@@ -252,7 +258,7 @@ class ExecutorPool:
         if p is None or not p.is_alive():
             return False
         hb = self._last_hb[wid]
-        return hb is None or (time.time() - hb) < HB_TIMEOUT_S
+        return hb is None or (time.time() - hb) < self.hb_timeout_s
 
     def live_workers(self) -> List[int]:
         return [w for w in self._procs if self._alive(w)]

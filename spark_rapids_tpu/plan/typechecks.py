@@ -419,6 +419,12 @@ def _register_builtin_exprs() -> None:
 
 _register_builtin_exprs()
 
+# declare the typed per-expression enable flags for every registered rule
+# (reference: one generated spark.rapids.sql.expression.* conf per rule)
+from ..config import declare_expression_flags as _declare_flags  # noqa: E402
+
+_declare_flags(c.__name__ for c in _EXPR_RULES)
+
 
 def conf_gate_reason(e, conf):
     """Config-driven expression gates beyond the per-class enable switch

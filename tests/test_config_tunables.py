@@ -1,0 +1,99 @@
+"""Round-5 config-surface boundary tests: every promoted tunable must be
+READ by the code it governs (reference RapidsConf.scala DSL + generated
+per-expression flags)."""
+
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.session import TpuSession
+
+
+def test_registry_includes_generated_expression_flags():
+    import spark_rapids_tpu.plan.typechecks  # noqa: F401 — triggers declare
+    from spark_rapids_tpu.config import REGISTRY
+    expr = [k for k in REGISTRY.entries if ".sql.expression." in k]
+    assert len(expr) >= 200, len(expr)
+    assert "spark.rapids.sql.expression.XxHash64" in REGISTRY.entries
+
+
+def test_expression_flag_disables_expression():
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.sql.expression.Upper": "false"})
+    df = s.createDataFrame([{"s": "ab"}, {"s": "cd"}])
+    q = df.select(F.upper(F.col("s")).alias("u"))
+    out = q.collect()
+    assert out == [{"u": "AB"}, {"u": "CD"}]  # still correct, on CPU path
+    reasons = str(q.explain_fallback()) if hasattr(
+        q, "explain_fallback") else str(q.explain())
+    assert "disabled via spark.rapids.sql.expression.Upper" in reasons, \
+        reasons[:500]
+
+
+def test_regex_max_dfa_states_falls_back_correctly():
+    rows = [{"s": "abc123"}, {"s": "zzz"}, {"s": None}]
+    a = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.tpu.regex.maxDfaStates": "2"}) \
+        .createDataFrame(rows) \
+        .select(F.rlike(F.col("s"), "abc[0-9]+").alias("m")).collect()
+    b = TpuSession({"spark.rapids.sql.enabled": "false"}) \
+        .createDataFrame(rows) \
+        .select(F.rlike(F.col("s"), "abc[0-9]+").alias("m")).collect()
+    assert a == b
+
+
+def test_hash_device_max_string_bytes_falls_back_correctly():
+    rows = [{"s": "x" * 64}, {"s": "short"}, {"s": None}]
+    a = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.tpu.hash.maxDeviceStringBytes": "4"}) \
+        .createDataFrame(rows) \
+        .select(F.xxhash64(F.col("s")).alias("h")).collect()
+    b = TpuSession({"spark.rapids.sql.enabled": "false"}) \
+        .createDataFrame(rows) \
+        .select(F.xxhash64(F.col("s")).alias("h")).collect()
+    assert a == b
+
+
+def test_task_retry_limit_bounds_retries():
+    from spark_rapids_tpu.memory.hbm import HbmBudget
+    from spark_rapids_tpu.memory.retry import TpuRetryOOM, with_retry
+    from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    import pyarrow as pa
+    col = TpuColumnVector.from_arrow(pa.array([1, 2, 3, 4], pa.int64()))
+    batch = TpuColumnarBatch([col], 4, names=["x"])
+    calls = [0]
+
+    def flaky(b):
+        calls[0] += 1
+        if calls[0] <= 3:
+            raise TpuRetryOOM("injected")
+        return b.num_rows
+
+    # limit below the failure count: gives up
+    calls[0] = 0
+    with pytest.raises(Exception):
+        list(with_retry(SpillableColumnarBatch(batch), flaky,
+                        split_policy=None, max_retries=2))
+    # limit above: succeeds on the 4th call
+    calls[0] = 0
+    out = list(with_retry(SpillableColumnarBatch(batch), flaky,
+                          split_policy=None, max_retries=8))
+    assert out == [4]
+
+
+def test_dim_cache_size_bounds_entries():
+    from spark_rapids_tpu.execs.compiled_join import (_DIM_BUILD_CACHE,
+                                                      clear_dim_cache)
+    clear_dim_cache()
+    s = TpuSession({"spark.rapids.sql.enabled": "true",
+                    "spark.rapids.tpu.join.compiled.dimCacheSize": "1"})
+    fact = [{"k": i % 10, "v": float(i)} for i in range(2000)]
+    for offset in (0, 100):
+        dim = [{"k2": i, "p": i + offset} for i in range(10)]
+        fd = s.createDataFrame(fact, num_partitions=2)
+        dd = s.createDataFrame(dim)
+        (fd.join(dd, on=fd["k"] == dd["k2"])
+         .groupBy("k2").agg(F.sum(F.col("v")).alias("sv")).collect())
+    assert len(_DIM_BUILD_CACHE) <= 1
+    clear_dim_cache()
