@@ -352,20 +352,28 @@ def _gather_lists(col: TpuColumnVector, safe_idx, valid, out_rows: int,
     return _repad(out, cap) if out.capacity < cap else out
 
 
+@_jax_jit
+def _compact_plan(mask, num_rows):
+    """Stable cumsum-scatter compaction plan as ONE program (the eager chain
+    paid ~4 dispatches per batch through the tunnel)."""
+    cap = mask.shape[0]
+    mask = mask & (jnp.arange(cap) < num_rows)
+    positions = jnp.cumsum(mask) - 1  # output slot per kept row
+    # gather indices: for each output slot, index of the kept input row
+    idx = jnp.full((cap,), cap, dtype=jnp.int32)
+    idx = idx.at[jnp.where(mask, positions, cap)].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    return idx, jnp.sum(mask)
+
+
 def compact(batch: TpuColumnarBatch, keep_mask) -> TpuColumnarBatch:
     """Filter: keep rows where mask is True, preserving order
     (reference GpuFilter: boolean mask + cudf apply_boolean_mask,
     basicPhysicalOperators.scala:638). Uses a stable cumsum-scatter; the kept-row
     count is synced to host (it becomes the new logical num_rows)."""
-    mask = jnp.asarray(keep_mask)
     cap = batch.capacity
-    mask = mask & row_mask(batch.num_rows, cap)
-    positions = jnp.cumsum(mask) - 1  # output slot per kept row
-    n_keep = int(jnp.sum(mask))  # D→H sync: one scalar per batch
-    # build gather indices: for each output slot, index of the kept input row
-    idx = jnp.full((cap,), cap, dtype=jnp.int32)
-    idx = idx.at[jnp.where(mask, positions, cap)].set(
-        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    idx, n_dev = _compact_plan(jnp.asarray(keep_mask), batch.num_rows)
+    n_keep = int(n_dev)  # D→H sync: one scalar per batch
     return gather(batch, idx, n_keep, out_capacity=cap)
 
 

@@ -160,8 +160,38 @@ class ConfRegistry:
             when = "Startup" if e.startup_only else "Runtime"
             doc = str(e.doc).replace("|", "\\|")  # keep table cells aligned
             lines.append(f"| {e.key} | {doc} | {e.default} | {when} |")
+        lines += ["", _PATHS_DOC]
         return "\n".join(lines) + "\n"
 
+
+#: prose section appended to the generated config docs (kept here so
+#: docs/configs.md regenerates from one source of truth)
+_PATHS_DOC = """## General vs compiled execution paths
+
+Every query runs on one of two device execution strategies:
+
+* **Compiled whole-stage paths** (`spark.rapids.tpu.agg.compiledStage.enabled`,
+  `spark.rapids.tpu.join.compiledStage.enabled`) fuse an entire eligible
+  pipeline (scan → filter → project → group-by, or a star-join probe chain)
+  into ONE jitted XLA program per batch shape. They are the fastest option but
+  only engage inside a narrow eligibility window (device-pure fixed-width
+  expressions, small key domains / unique build keys, no ANSI); anything else
+  falls back transparently.
+* **The general path** executes operator by operator (project, filter,
+  shuffled join, sort-based aggregate, exchange). With
+  `spark.rapids.tpu.opjit.enabled` (default on) each operator's per-batch
+  device transform is itself jit-compiled and cached process-wide, keyed by a
+  structural fingerprint of its expression forest plus the bucketed batch
+  shape (`spark.rapids.tpu.opjit.cacheSize` bounds the LRU). Unlike the
+  compiled stages this imposes no eligibility window: host-assisted
+  expressions split the trace at the host boundary (the device-pure subtrees
+  run compiled, the host patch stays eager), and anything that cannot trace
+  at all simply stays on the eager path with identical results.
+
+The compiled stages engage first when eligible; the opjit cache accelerates
+everything they leave behind, so dispatch-bound workloads no longer pay one
+host→device round trip per expression node.
+"""
 
 REGISTRY = ConfRegistry()
 _conf = REGISTRY.conf
@@ -299,6 +329,25 @@ COMPILED_AGG_MAX_GROUPS = _conf("spark.rapids.tpu.agg.compiled.maxGroups").doc(
     "Largest combined group-key domain the compiled aggregation stage may "
     "direct-index; beyond this the general sort-based path runs."
 ).integer(4096)
+
+OPJIT_ENABLED = _conf("spark.rapids.tpu.opjit.enabled").doc(
+    "Jit-compile the GENERAL execution path's per-operator device "
+    "transforms (projection/filter expression forests, join key encoding, "
+    "hash partitioning, the sort-based aggregate's sort and reduce phases) "
+    "into XLA executables cached process-wide by a structural fingerprint "
+    "plus bucketed batch shape. Collapses the eager path's per-op dispatch "
+    "storm (each ~100ms through the tunnel) into one launch per operator "
+    "per batch shape. Unlike the compiled whole-stage paths there is no "
+    "eligibility window: subtrees that cannot trace (host-assisted "
+    "expressions, ANSI host-sync checks, string kernels sizing on data) "
+    "split the trace at the host boundary and stay eager."
+).commonly_used().boolean(True)
+
+OPJIT_CACHE_SIZE = _conf("spark.rapids.tpu.opjit.cacheSize").doc(
+    "LRU bound on the general-path executable cache "
+    "(spark.rapids.tpu.opjit.enabled); evicting an entry drops its "
+    "compiled program."
+).integer(256)
 
 PARQUET_CHUNK_BYTES = _conf(
     "spark.rapids.sql.reader.chunked.maxDecodeBytes").doc(

@@ -555,6 +555,30 @@ def encode_group_keys(cols: List[TpuColumnVector], num_rows: int, capacity: int)
     return out
 
 
+def segment_boundaries(enc, perm, rowmask):
+    """Group boundaries over key-sorted rows: (is_new, seg_ids, n_groups).
+    Shared by the eager sort phase and the opjit traced sort phase — the two
+    paths MUST agree bit-for-bit, so there is exactly one copy. `n_groups`
+    is returned as a device scalar (callers sync when they need the int)."""
+    cap = perm.shape[0]
+    is_new = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
+    for vals, validity in enc:
+        sv = jnp.take(vals, perm)
+        neq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                               sv[1:] != sv[:-1]])
+        if validity is not None:
+            nv = jnp.take(validity, perm)
+            vneq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                    nv[1:] != nv[:-1]])
+            neq = neq | vneq
+        is_new = is_new | neq
+    pad = jnp.take(rowmask, perm)
+    is_new = is_new & pad
+    seg_ids = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    ng = jnp.max(jnp.where(pad, seg_ids, -1)) + 1
+    return is_new, seg_ids, ng
+
+
 def lex_sort_permutation(keys, num_rows: int, capacity: int,
                          orders: Optional[List[Tuple[bool, bool]]] = None):
     """Stable lexicographic sort permutation over encoded keys.
@@ -1263,50 +1287,68 @@ class TpuHashAggregateExec(TpuExec):
 
     def _aggregate_batch(self, batch: TpuColumnarBatch, agg_fns, result_exprs,
                          ctx: TaskContext) -> TpuColumnarBatch:
+        """Sort phase + reduce phase, each running as ONE cached executable
+        when it traces (execs/opjit.py) and falling back to the eager op
+        chain otherwise — the two phases gate independently (string group
+        keys can still jit the reduce; collect-style aggregates can still
+        jit the sort). Results are identical either way."""
+        from . import opjit
         cap = batch.capacity
         n = batch.num_rows
-        key_cols = [to_column(g.eval_tpu(batch, ctx.eval_ctx), batch, g.dtype)
-                    for g in self.grouping]
-        in_cols: List[Optional[TpuColumnVector]] = [
-            self._eval_agg_input(fn, batch, ctx) for fn in agg_fns]
+        use_jit = opjit.enabled(ctx.eval_ctx)
+        perm = seg_ids = is_new = key_rows = None
+        key_cols: List[TpuColumnVector] = []
         if self.grouping:
-            with self.metrics["sortTime"].timed():
-                enc = encode_group_keys(key_cols, n, cap)
-                perm = lex_sort_permutation(enc, n, cap)
-                # boundaries in sorted order
-                is_new = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
-                for vals, validity in enc:
-                    sv = jnp.take(vals, perm)
-                    neq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
-                                           sv[1:] != sv[:-1]])
-                    if validity is not None:
-                        nv = jnp.take(validity, perm)
-                        vneq = jnp.concatenate([jnp.ones((1,), jnp.bool_),
-                                                nv[1:] != nv[:-1]])
-                        neq = neq | vneq
-                    is_new = is_new | neq
-                pad = jnp.take(row_mask(n, cap), perm)
-                is_new = is_new & pad
-                seg_ids = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-                n_groups = int(jnp.max(jnp.where(pad, seg_ids, -1))) + 1
+            plan = None
+            if use_jit:
+                with self.metrics["sortTime"].timed():
+                    plan = opjit.agg_sort_plan(self.grouping, batch,
+                                               ctx.eval_ctx, self.metrics)
+            if plan is not None:
+                perm, seg_ids, is_new, n_groups, key_cols = plan
+            else:
+                key_cols = [to_column(g.eval_tpu(batch, ctx.eval_ctx),
+                                      batch, g.dtype)
+                            for g in self.grouping]
+                with self.metrics["sortTime"].timed():
+                    enc = encode_group_keys(key_cols, n, cap)
+                    perm = lex_sort_permutation(enc, n, cap)
+                    is_new, seg_ids, ng = segment_boundaries(
+                        enc, perm, row_mask(n, cap))
+                    n_groups = int(ng)
             self.metrics["numGroups"].add(n_groups)
         else:
-            perm = jnp.arange(cap, dtype=jnp.int32)
-            seg_ids = jnp.zeros((cap,), jnp.int32)
             n_groups = 1
         g_cap = bucket_capacity(max(n_groups, 1))
-        with self.metrics["reduceTime"].timed():
-            states = [_segment_update(fn, col, seg_ids, g_cap, cap, n, perm)
-                      for fn, col in zip(agg_fns, in_cols)]
-            agg_cols = [_evaluate_agg(fn, st, n_groups, g_cap)
-                        for fn, st in zip(agg_fns, states)]
+        agg_cols = None
+        if use_jit:
+            with self.metrics["reduceTime"].timed():
+                red = opjit.agg_reduce(agg_fns, batch, perm, seg_ids, is_new,
+                                       n_groups, g_cap, ctx.eval_ctx,
+                                       self.metrics)
+            if red is not None:
+                # perm/seg_ids/is_new were donated to the reduce program
+                agg_cols, key_rows = red
+        if agg_cols is None:
+            if perm is None:  # ungrouped, reduce ran eager
+                perm = jnp.arange(cap, dtype=jnp.int32)
+                seg_ids = jnp.zeros((cap,), jnp.int32)
+            in_cols: List[Optional[TpuColumnVector]] = [
+                self._eval_agg_input(fn, batch, ctx) for fn in agg_fns]
+            with self.metrics["reduceTime"].timed():
+                states = [_segment_update(fn, col, seg_ids, g_cap, cap, n,
+                                          perm)
+                          for fn, col in zip(agg_fns, in_cols)]
+                agg_cols = [_evaluate_agg(fn, st, n_groups, g_cap)
+                            for fn, st in zip(agg_fns, states)]
         # group key output: first row of each segment
         out_key_cols = []
         if self.grouping:
-            first_pos = jnp.zeros((g_cap,), jnp.int32).at[
-                jnp.where(is_new, seg_ids, g_cap)].set(
-                jnp.arange(cap, dtype=jnp.int32), mode="drop")
-            key_rows = jnp.take(perm, first_pos)
+            if key_rows is None:
+                first_pos = jnp.zeros((g_cap,), jnp.int32).at[
+                    jnp.where(is_new, seg_ids, g_cap)].set(
+                    jnp.arange(cap, dtype=jnp.int32), mode="drop")
+                key_rows = jnp.take(perm, first_pos)
             key_batch = TpuColumnarBatch(key_cols, n)
             gathered = gather(key_batch, key_rows, n_groups, out_capacity=g_cap)
             out_key_cols = gathered.columns
@@ -1314,10 +1356,11 @@ class TpuHashAggregateExec(TpuExec):
         agg_batch = TpuColumnarBatch(list(out_key_cols) + agg_cols, n_groups)
         ng = len(self.grouping)
         final_cols = list(out_key_cols)
-        for expr, attr in zip(result_exprs, self._output[ng:]):
-            bound = _bind_agg_refs(expr, None, ng, self.grouping)
-            r = bound.eval_tpu(agg_batch, ctx.eval_ctx)
-            final_cols.append(to_column(r, agg_batch, attr.dtype))
+        bound = [_bind_agg_refs(expr, None, ng, self.grouping)
+                 for expr in result_exprs]
+        final_cols.extend(opjit.eval_exprs(
+            bound, [attr.dtype for attr in self._output[ng:]], agg_batch,
+            ctx.eval_ctx, self.metrics))
         return TpuColumnarBatch(final_cols, n_groups,
                                 [a.name for a in self._output])
 

@@ -105,6 +105,12 @@ class PhysicalPlan:
         self.metrics["numOutputRows"] = TpuMetric("numOutputRows", ESSENTIAL)
         self.metrics["numOutputBatches"] = TpuMetric("numOutputBatches", MODERATE)
         self.metrics["opTime"] = TpuMetric("opTime", MODERATE)
+        if isinstance(self, TpuExec):
+            # general-path executable cache (execs/opjit.py): per-operator
+            # compile/reuse accounting, mirrored into process-wide counters
+            for name in ("opJitCacheHits", "opJitCacheMisses",
+                         "opJitTraceTime"):
+                self.metrics[name] = TpuMetric(name, DEBUG)
         for name, level in self.additional_metrics().items():
             self.metrics[name] = TpuMetric(name, level)
 

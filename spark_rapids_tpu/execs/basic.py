@@ -38,12 +38,16 @@ class TpuProjectExec(TpuExec):
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         from ..memory.spill import SpillableColumnarBatch
         from ..memory.retry import with_retry
+        from . import opjit
         names = [a.name for a in self._output]
         op_time = self.metrics["opTime"]
+        out_dtypes = [a.dtype for a in self._output]
 
         def project(batch: TpuColumnarBatch) -> TpuColumnarBatch:
-            cols = [to_column(e.eval_tpu(batch, ctx.eval_ctx), batch, a.dtype)
-                    for e, a in zip(self.exprs, self._output)]
+            # jittable subsets of the forest run as ONE cached executable per
+            # batch shape (execs/opjit.py); the rest evaluate eagerly
+            cols = opjit.eval_exprs(self.exprs, out_dtypes, batch,
+                                    ctx.eval_ctx, self.metrics)
             return TpuColumnarBatch(cols, batch.num_rows, names)
 
         for batch in self.children[0].execute_partition(idx, ctx):
@@ -70,13 +74,20 @@ class TpuFilterExec(TpuExec):
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         from ..memory.spill import SpillableColumnarBatch
         from ..memory.retry import with_retry
+        from . import opjit
         op_time = self.metrics["opTime"]
 
         def do_filter(batch: TpuColumnarBatch) -> TpuColumnarBatch:
-            mask_col = to_column(self.condition.eval_tpu(batch, ctx.eval_ctx), batch)
-            mask = mask_col.data.astype(jnp.bool_)
-            if mask_col.validity is not None:
-                mask = mask & mask_col.validity  # null predicate → drop row
+            # predicate eval + null-drop as one cached executable when the
+            # condition traces; eager otherwise (identical mask either way)
+            mask = opjit.filter_mask(self.condition, batch, ctx.eval_ctx,
+                                     self.metrics)
+            if mask is None:
+                mask_col = to_column(
+                    self.condition.eval_tpu(batch, ctx.eval_ctx), batch)
+                mask = mask_col.data.astype(jnp.bool_)
+                if mask_col.validity is not None:
+                    mask = mask & mask_col.validity  # null predicate → drop
             return compact(batch, mask)
 
         for batch in self.children[0].execute_partition(idx, ctx):

@@ -799,16 +799,18 @@ def _finalize_output_dev(merged, occ_mask, key_cols, cap_occ, fnspec):
 # entry pins device arrays.
 import collections as _collections
 
+# key -> (source refs, built arrays, {group ordinals: uniqueness verdict}).
+# The uniqueness verdicts live INSIDE the build entry so a dim rebuilt over
+# changed source data (source-identity mismatch below) starts with no
+# memoized verdict — a structurally-keyed side table would serve a stale
+# "unique" answer after a rebuild and silently split SQL groups.
 _DIM_BUILD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
-# (dim build cache key, group ordinals) -> group-key-uniqueness verdict
-_GROUP_UNIQUE_CACHE: Dict[Tuple, bool] = {}
 
 
 def clear_dim_cache() -> None:
-    """Release the cached dimension builds (host tables, source refs, and
-    the HBM key/payload arrays they pin)."""
+    """Release the cached dimension builds (host tables, source refs, the
+    HBM key/payload arrays they pin, and their uniqueness verdicts)."""
     _DIM_BUILD_CACHE.clear()
-    _GROUP_UNIQUE_CACHE.clear()
 
 
 def _dim_sources(plan: PhysicalPlan):
@@ -1003,13 +1005,13 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         if self._dims_built is None:
             with self.metrics["buildTime"].timed():
                 dim_tables, dim_flats, dim_caps, dim_dense = [], [], [], []
-                dim_keys = []
                 from ..config import ANSI_ENABLED, SESSION_TZ
                 # eval-relevant session conf is part of the key: the same
                 # dim plan under a different timezone/ANSI setting must not
                 # reuse a stale build across sessions sharing source tables
                 conf_fp = (ctx.conf.get(SESSION_TZ),
                            ctx.conf.get(ANSI_ENABLED))
+                dim_entries = []
                 for d in spec.dims:
                     key = (_dim_structure(d.plan), tuple(d.key_ordinals),
                            tuple(d.payload_ordinals), d.semi, conf_fp)
@@ -1017,31 +1019,33 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                     hit = _DIM_BUILD_CACHE.get(key)
                     if hit is not None and len(hit[0]) == len(srcs) \
                             and all(a is b for a, b in zip(hit[0], srcs)):
-                        built = hit[1]
+                        entry = hit
                         _DIM_BUILD_CACHE.move_to_end(key)
                     else:
-                        built = self._build_dim(d, ctx)
-                        _DIM_BUILD_CACHE[key] = (srcs, built)
+                        # rebuild: fresh entry, fresh (empty) verdict memo
+                        entry = (srcs, self._build_dim(d, ctx), {})
+                        _DIM_BUILD_CACHE[key] = entry
                         from ..config import COMPILED_JOIN_DIM_CACHE_SIZE
                         cache_max = ctx.conf.get(COMPILED_JOIN_DIM_CACHE_SIZE)
                         while len(_DIM_BUILD_CACHE) > cache_max:
                             _DIM_BUILD_CACHE.popitem(last=False)
-                    tbl, flat, cap_d, dense = built
+                    tbl, flat, cap_d, dense = entry[1]
                     dim_tables.append(tbl)
                     dim_flats.append(flat)
                     dim_caps.append(cap_d)
                     dim_dense.append(dense)
-                    dim_keys.append(key)
+                    dim_entries.append(entry)
                 if getattr(spec, "group_unique_check", False):
                     # group keys are a subset of the dim's join keys:
                     # row-index grouping is correct only if those columns
                     # alone are unique over the materialized dim. Ordinal-
                     # based and numpy-side: attribute NAMES are not unique,
                     # so pyarrow group_by could KeyError instead of falling
-                    # back. Verdict memoized per (dim build, ordinals).
-                    ukey = (dim_keys[spec.group_dim],
-                            tuple(spec.group_key_ordinals))
-                    uniq = _GROUP_UNIQUE_CACHE.get(ukey)
+                    # back. Verdict memoized IN the dim's build-cache entry
+                    # (a rebuild over changed sources starts a fresh memo).
+                    verdicts = dim_entries[spec.group_dim][2]
+                    uord = tuple(spec.group_key_ordinals)
+                    uniq = verdicts.get(uord)
                     if uniq is None:
                         gt = dim_tables[spec.group_dim]
                         uniq = True
@@ -1056,10 +1060,7 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                                 s = a[order]
                                 eq &= s[1:] == s[:-1]
                             uniq = not bool(np.any(eq))
-                        _GROUP_UNIQUE_CACHE[ukey] = uniq
-                        while len(_GROUP_UNIQUE_CACHE) > 64:
-                            _GROUP_UNIQUE_CACHE.pop(
-                                next(iter(_GROUP_UNIQUE_CACHE)))
+                        verdicts[uord] = uniq
                     if not uniq:
                         raise _JoinStageFallback()
                 self._dims_built = (dim_tables, dim_flats, dim_caps,

@@ -45,6 +45,30 @@ def _mix64(h, v):
     return h
 
 
+def encode_fixed_key_pair(lb, rb, l_validity, r_validity, native: bool,
+                          l_enc: list, r_enc: list) -> None:
+    """Append one fixed-width key pair's cross-side-comparable codes to the
+    per-side encode lists. The 64-bit limb split is a per-PAIR decision, and
+    the eager path and the opjit traced encode both call exactly this code
+    (they must agree bit-for-bit).
+
+    On demoting backends a 64-bit key splits into two i32 limbs so the
+    verified-equality pass stays EXACT (a single truncated i32 would
+    silently join keys equal mod 2^32); floats were already narrowed to the
+    backend's compute width upstream."""
+    if native:
+        l_enc.append((lb.astype(jnp.int64), l_validity))
+        r_enc.append((rb.astype(jnp.int64), r_validity))
+    elif lb.dtype.itemsize == 8 or rb.dtype.itemsize == 8:
+        for b, out, v in ((lb, l_enc, l_validity), (rb, r_enc, r_validity)):
+            b64 = b.astype(jnp.int64)
+            out.append(((b64 >> 32).astype(jnp.int32), v))
+            out.append((b64.astype(jnp.int32), v))
+    else:
+        l_enc.append((lb.astype(jnp.int32), l_validity))
+        r_enc.append((rb.astype(jnp.int32), r_validity))
+
+
 def _encode_sides(left_cols: List[TpuColumnVector], right_cols: List[TpuColumnVector],
                   l_rows: int, r_rows: int, l_cap: int, r_cap: int):
     """Comparable per-key codes for both sides; string keys dictionary-encode
@@ -68,24 +92,9 @@ def _encode_sides(left_cols: List[TpuColumnVector], right_cols: List[TpuColumnVe
             r_enc.append((jnp.asarray(rbuf), rc.validity))
         else:
             from ..utils.hw import x64_native
-            lb, rb = _sortable_bits(lc), _sortable_bits(rc)
-            if x64_native():
-                l_enc.append((lb.astype(jnp.int64), lc.validity))
-                r_enc.append((rb.astype(jnp.int64), rc.validity))
-            elif lb.dtype.itemsize == 8 or rb.dtype.itemsize == 8:
-                # demoting backend + 64-bit key: split into two i32 limbs so
-                # the verified-equality pass stays EXACT (a single truncated
-                # i32 would silently join keys equal mod 2^32); floats were
-                # already narrowed to the backend's compute width upstream
-                for b, v in ((lb, l_enc), (rb, r_enc)):
-                    b64 = b.astype(jnp.int64)
-                    v.append(((b64 >> 32).astype(jnp.int32),
-                              lc.validity if v is l_enc else rc.validity))
-                    v.append((b64.astype(jnp.int32),
-                              lc.validity if v is l_enc else rc.validity))
-            else:
-                l_enc.append((lb.astype(jnp.int32), lc.validity))
-                r_enc.append((rb.astype(jnp.int32), rc.validity))
+            encode_fixed_key_pair(_sortable_bits(lc), _sortable_bits(rc),
+                                  lc.validity, rc.validity, x64_native(),
+                                  l_enc, r_enc)
     return l_enc, r_enc
 
 
@@ -273,8 +282,10 @@ class TpuShuffledHashJoinExec(TpuExec):
             # seed 100 (not the exchange's 42): upstream co-partitioning fixes
             # h42 % N, so re-bucketing with the same seed would collapse into
             # few sub-partitions (GpuSubPartitionHashJoin.scala hashSeed=100)
-            l_ids = hash_partition_ids(left, self.left_keys, k, ctx, seed=100)
-            r_ids = hash_partition_ids(right, self.right_keys, k, ctx, seed=100)
+            l_ids = hash_partition_ids(left, self.left_keys, k, ctx, seed=100,
+                                       metrics=self.metrics)
+            r_ids = hash_partition_ids(right, self.right_keys, k, ctx,
+                                       seed=100, metrics=self.metrics)
             l_parts = split_by_partition(left, l_ids, k)
             r_parts = split_by_partition(right, r_ids, k)
             with self.metrics["joinTime"].timed():
@@ -318,12 +329,21 @@ class TpuShuffledHashJoinExec(TpuExec):
         jt = self.join_type
         names = [a.name for a in self._output]
         l_cap, r_cap = left.capacity, right.capacity
-        lk = [to_column(k.eval_tpu(left, ctx.eval_ctx), left, k.dtype)
-              for k in self.left_keys]
-        rk = [to_column(k.eval_tpu(right, ctx.eval_ctx), right, k.dtype)
-              for k in self.right_keys]
-        l_enc, r_enc = _encode_sides(lk, rk, left.num_rows, right.num_rows,
-                                     l_cap, r_cap)
+        # key eval + sortable-bit encode for BOTH sides as one cached
+        # executable (execs/opjit.py); string/host keys keep the eager path
+        from . import opjit
+        enc = opjit.encode_join_sides(self.left_keys, self.right_keys,
+                                      left, right, ctx.eval_ctx,
+                                      self.metrics)
+        if enc is not None:
+            l_enc, r_enc = enc
+        else:
+            lk = [to_column(k.eval_tpu(left, ctx.eval_ctx), left, k.dtype)
+                  for k in self.left_keys]
+            rk = [to_column(k.eval_tpu(right, ctx.eval_ctx), right, k.dtype)
+                  for k in self.right_keys]
+            l_enc, r_enc = _encode_sides(lk, rk, left.num_rows,
+                                         right.num_rows, l_cap, r_cap)
         # probe = left, build = right
         pi, bi, ok, total, out_cap = _device_equi_join(
             r_enc, right.num_rows, l_enc, left.num_rows)

@@ -85,6 +85,10 @@ class Expression:
         import copy
         new = copy.copy(self)
         new.children = tuple(children)
+        # memoized structural fingerprints (execs/opjit.py) describe the OLD
+        # children; a copy with new children must not inherit them
+        for memo in ("_ojfp", "_ojgate"):
+            new.__dict__.pop(memo, None)
         return new
 
     # --- evaluation -------------------------------------------------------

@@ -577,10 +577,12 @@ def xxhash64_col(col: TpuColumnVector, seed, capacity: int):
     elif isinstance(dt, (LongType, TimestampType)):
         h = xxhash64_long_dev(d.astype(jnp.int64), seed)
     elif isinstance(dt, FloatType):
-        f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+        # -0.0 AND NaN normalization (Java floatToIntBits canonicalizes NaN;
+        # the host oracle does too — shared with the murmur3 path)
+        f = _normalize_float(d)
         h = xxhash64_int_dev(f.view(jnp.int32), seed)
     elif isinstance(dt, DoubleType):
-        f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+        f = _normalize_double(d)
         h = xxhash64_long_dev(f.view(jnp.int64), seed)
     elif isinstance(dt, StringType):
         h = _xxhash64_string_device(col, seed, capacity)
@@ -755,10 +757,11 @@ class HiveHash(Expression):
             u = d.astype(jnp.int64).view(jnp.uint64)
             h = ((u >> jnp.uint64(32)) ^ u).astype(jnp.uint32)
         elif isinstance(dt, FloatType):
-            f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+            # Java Float.floatToIntBits canonicalizes NaN as well as -0.0
+            f = _normalize_float(d)
             h = f.view(jnp.int32).view(jnp.uint32)
         elif isinstance(dt, DoubleType):
-            f = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+            f = _normalize_double(d)
             u = f.view(jnp.int64).view(jnp.uint64)
             h = ((u >> jnp.uint64(32)) ^ u).astype(jnp.uint32)
         elif isinstance(dt, StringType):

@@ -400,6 +400,8 @@ def device_json_to_structs(col, batch, schema, ctx=None):
                          LongType, ShortType)
     from .strings import _dev_str
     ok_types = (IntegralType, BooleanType, StringType)
+    if not schema.fields:
+        return None  # no per-field scans: host fallback decides dict-ness
     if not all(isinstance(f.data_type, ok_types) for f in schema.fields):
         return None
     if not _dev_str(col) or not SK.is_ascii(col.data):
@@ -458,7 +460,13 @@ def device_json_to_structs(col, batch, schema, ctx=None):
         else:  # integral
             is_int = ((sp.kind == K_PRIMITIVE)
                       & jnp.isin(sp.tok, jnp.asarray(list(_INT_TOKS))))
-            too_long = is_int & (sp.length > 19)
+            # 18 digits is the widest span the int64 accumulator parses
+            # without wrapping; 19-digit values can exceed int64 max and
+            # wrap back in-range, so they route to the host patch
+            neg = data[jnp.clip(sp.start, 0, nbytes - 1)] \
+                == np.uint8(ord("-"))
+            digits = sp.length - jnp.where(neg, 1, 0)
+            too_long = is_int & (digits > 18)
             serve = serve & ~too_long
             ival = parse_int_span(sp)
             bits = {ByteType: 8, ShortType: 16, IntegerType: 32,

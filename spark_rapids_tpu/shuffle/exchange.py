@@ -212,7 +212,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                     got = [sb.get_batch() for sb in g]
                     batches.append(concat_batches(got) if len(got) > 1
                                    else got[0])
-                pids = [hash_partition_ids(b, self.keys, n_dev, ctx)
+                pids = [hash_partition_ids(b, self.keys, n_dev, ctx,
+                                           metrics=self.metrics)
                         if b is not None else None for b in batches]
                 parts = mesh_hash_exchange(mesh, batches, pids,
                                            [a.name for a in self.output])
@@ -252,7 +253,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 continue
             with self.metrics["partitionTime"].timed():
                 if self.partitioning == "hash":
-                    pids = hash_partition_ids(batch, self.keys, n, ctx)
+                    pids = hash_partition_ids(batch, self.keys, n, ctx,
+                                              metrics=self.metrics)
                     parts = split_by_partition(batch, pids, n)
                 elif self.partitioning in ("roundrobin", "coalesce"):
                     pids = round_robin_partition_ids(batch, n, map_id)

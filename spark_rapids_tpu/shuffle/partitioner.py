@@ -9,8 +9,11 @@ of cuDF's contiguous split.
 
 from __future__ import annotations
 
+import functools as _functools
+
 from typing import List, Optional, Sequence
 
+import jax as _jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -21,10 +24,19 @@ from ..expressions.hashexprs import murmur3_batch
 
 
 def hash_partition_ids(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
-                       n: int, ctx, seed: int = 42) -> jnp.ndarray:
+                       n: int, ctx, seed: int = 42,
+                       metrics=None) -> jnp.ndarray:
     """Spark HashPartitioning: pmod(murmur3(keys, seed=42), n). Sub-partition
     callers pass a distinct seed so their buckets are independent of the
-    upstream exchange's (reference GpuSubPartitionHashJoin.scala hashSeed=100)."""
+    upstream exchange's (reference GpuSubPartitionHashJoin.scala hashSeed=100).
+
+    The key-eval + murmur3 + pmod chain runs as ONE cached executable when
+    the keys trace (execs/opjit.py); string/host keys stay eager."""
+    from ..execs import opjit
+    pid = opjit.partition_ids(batch, key_exprs, n, ctx.eval_ctx, seed,
+                              metrics)
+    if pid is not None:
+        return pid
     cols = [to_column(k.eval_tpu(batch, ctx.eval_ctx), batch, k.dtype)
             for k in key_exprs]
     h = murmur3_batch(cols, batch.num_rows, batch.capacity, seed)
@@ -35,6 +47,18 @@ def hash_partition_ids(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
 def round_robin_partition_ids(batch: TpuColumnarBatch, n: int,
                               start: int = 0) -> jnp.ndarray:
     return ((jnp.arange(batch.capacity, dtype=jnp.int32) + start) % n)
+
+
+@_functools.partial(_jax.jit, static_argnames=("n",))
+def _split_plan(pids, num_rows, n: int):
+    """Sort-by-pid + partition bounds as one program (the eager version paid
+    ~4 dispatches per batch through the tunnel)."""
+    cap = pids.shape[0]
+    mask = jnp.arange(cap) < num_rows
+    key = jnp.where(mask, pids, n)  # padding last
+    order = jnp.argsort(key, stable=True)
+    sorted_pid = jnp.take(key, order)
+    return order, jnp.searchsorted(sorted_pid, jnp.arange(n + 1))
 
 
 def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[TpuColumnarBatch]]:
@@ -49,11 +73,7 @@ def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[T
     after the searchsorted is enqueued, overlapping the transfer with
     dispatch of the sort/gather work already in flight."""
     cap = batch.capacity
-    mask = row_mask(batch.num_rows, cap)
-    key = jnp.where(mask, pids, n)  # padding last
-    order = jnp.argsort(key, stable=True)
-    sorted_pid = jnp.take(key, order)
-    bounds_dev = jnp.searchsorted(sorted_pid, jnp.arange(n + 1))
+    order, bounds_dev = _split_plan(pids, batch.num_rows, n=n)
     try:
         bounds_dev.copy_to_host_async()
     except AttributeError:  # older jax arrays: np.asarray below still works
