@@ -288,7 +288,8 @@ def _framework_q6(table) -> float:
     return _time_best(lambda: q.collect(), iters=5)
 
 
-def _framework_q3(rows: int, partitions: int, compiled: bool = True) -> dict:
+def _framework_q3(rows: int, partitions: int, compiled: bool = True,
+                  extra_conf: dict = None) -> dict:
     """TPC-H q3: scan → two joins → groupBy → topN, the flagship
     multi-operator path. With the compiled join stage
     (execs/compiled_join.py) the whole probe-chain+aggregation runs as ONE
@@ -300,6 +301,8 @@ def _framework_q3(rows: int, partitions: int, compiled: bool = True) -> dict:
 
     s = tpch.make_session(tpu=True)
     s.conf.set("spark.sql.shuffle.partitions", str(partitions))
+    for k, v in (extra_conf or {}).items():
+        s.conf.set(k, v)
     if not compiled:
         s.conf.set("spark.rapids.tpu.join.compiledStage.enabled", "false")
     else:
@@ -382,10 +385,12 @@ def main() -> None:
                  "join (one program per fact batch); the general shuffled "
                  "path is reported at 262k rows / 4+8 partitions for "
                  "comparability with r03 and now runs under the opjit "
-                 "per-operator executable cache (hit/miss deltas in its "
-                 "detail). Datagen is process-stable from "
-                 "r04 (crc32 streams), so q3 numbers compare across "
-                 "rounds"),
+                 "executable cache with whole-stage segment fusion and "
+                 "pipelined shuffle materialization (dispatch-by-kind "
+                 "deltas in its detail; the 8part_nofuse variant is the "
+                 "per-operator PR 1 baseline on the same rows). Datagen is "
+                 "process-stable from r04 (crc32 streams), so q3 numbers "
+                 "compare across rounds"),
     }
     headline = {"value": None, "vs_baseline": None}
 
@@ -501,30 +506,53 @@ def main() -> None:
         emit()
     stage("q3_compiled", _q3_compiled)
 
-    def _q3_gen(parts):
+    def _q3_gen(parts, fuse=True, tag=None):
         def run():
             # the general path runs through the per-operator executable
-            # cache (spark.rapids.tpu.opjit.enabled, default on): the warm
-            # run traces each operator once, the timed run should be all
-            # cache hits — the hit/miss delta is reported for verification
+            # cache (spark.rapids.tpu.opjit.enabled, default on) and, with
+            # fuse=True, whole-stage segment fusion
+            # (spark.rapids.tpu.opjit.fuseStages): the warm run traces each
+            # program once, the timed run should be all cache hits. The
+            # calls_by_kind delta is the DISPATCH ACCOUNTING (see
+            # docs/configs.md): with fusion on, a fused N-operator chain
+            # contributes ONE "segment" dispatch per batch where the
+            # fusion-off baseline (the PR 1 per-operator path) contributes N
+            # "project"/"filter" dispatches — the segment count, not the
+            # operator count, is what each batch pays through the tunnel.
             from spark_rapids_tpu.execs import opjit
+            extra = {"spark.rapids.tpu.opjit.fuseStages": str(fuse).lower()}
             before = opjit.cache_stats()
-            g = _framework_q3(1 << 18, parts, compiled=False)
+            g = _framework_q3(1 << 18, parts, compiled=False,
+                              extra_conf=extra)
             after = opjit.cache_stats()
-            detail.setdefault("q3_general", {})[f"{parts}part"] = {
+            kinds = {
+                k: after["calls_by_kind"].get(k, 0)
+                - before["calls_by_kind"].get(k, 0)
+                for k in set(after["calls_by_kind"])
+                | set(before["calls_by_kind"])}
+            kinds = {k: v for k, v in sorted(kinds.items()) if v}
+            detail.setdefault("q3_general", {})[tag or f"{parts}part"] = {
                 "wall_ms": round(g["sec"] * 1e3, 1),
                 "lineitem_rows": g["lineitem_rows"],
                 "rows_out": g["rows_out"],
+                "fuse_stages": fuse,
                 "opJitCacheHits": after["hits"] - before["hits"],
                 "opJitCacheMisses": after["misses"] - before["misses"],
                 "opJitTraceTime_s": round(
                     (after["trace_time_ns"] - before["trace_time_ns"]) / 1e9,
                     2),
+                "opJitDispatchesByKind": kinds,
+                "fusedSegmentDispatches": kinds.get("segment", 0),
+                "opjit_cache_len": opjit.cache_len(),
             }
             emit()
         return run
     stage("q3_general_4part", _q3_gen(4), budget_guard=True)
     stage("q3_general_8part", _q3_gen(8), budget_guard=True)
+    # PR 1 baseline on the same row count: fusion off, per-operator programs
+    # only — fusion-on wall time above should beat this strictly
+    stage("q3_general_8part_nofuse", _q3_gen(8, fuse=False, tag="8part_nofuse"),
+          budget_guard=True)
 
     def _q3_big():
         q3 = _framework_q3(n, 8)
@@ -540,7 +568,8 @@ def main() -> None:
     stage("q3_compiled_16M", _q3_big, budget_guard=True)
 
     ok_keys = ("kernel_hash_partition", "q6_framework_ms", "q3_compiled",
-               "q3_general_4part", "q3_general_8part", "q3_compiled_16M")
+               "q3_general_4part", "q3_general_8part",
+               "q3_general_8part_nofuse", "q3_compiled_16M")
     detail["complete"] = not any(
         isinstance(detail.get(k), dict)
         and ("skipped" in detail[k] or "error" in detail[k])

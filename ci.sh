@@ -17,6 +17,16 @@ echo "ok"
 echo "== compile check =="
 python -m compileall -q spark_rapids_tpu tools benchmarks tests bench.py __graft_entry__.py
 
+echo "== fast tier-1 gate (not slow) =="
+# Fail fusion/pipelining regressions in minutes, before the full suite: the
+# hot general-path surface (opjit cache, stage fusion, pipelined shuffle,
+# basic ops, shuffle/exchange) runs first with the slow markers excluded.
+python -m pytest \
+  tests/test_opjit_cache.py tests/test_stage_fusion.py \
+  tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
+  tests/test_shuffle.py \
+  -x -q -m 'not slow' -p no:cacheprovider
+
 echo "== tests (+ leak gate) =="
 # SRT_LEAK_GATE makes conftest fail the run when the process-wide
 # MemoryCleaner still tracks live device resources after the last test

@@ -191,6 +191,41 @@ Every query runs on one of two device execution strategies:
 The compiled stages engage first when eligible; the opjit cache accelerates
 everything they leave behind, so dispatch-bound workloads no longer pay one
 host→device round trip per expression node.
+
+With `spark.rapids.tpu.opjit.fuseStages` (default on) the general path goes
+one step further: maximal chains of adjacent project/filter operators are
+collapsed at plan time into ONE fused segment whose whole expression
+pipeline (every projection forest plus the AND of every filter predicate)
+traces into a single cached executable — a batch then flows through the
+entire chain in one dispatch instead of one per operator. Host-assisted or
+otherwise untraceable operators split the segment at the operator boundary
+(the device-pure prefix/suffix stay fused, the offending operator runs on
+its per-operator program), and a segment whose first trace fails degrades
+to the per-operator programs with bit-identical results.
+
+## Dispatch accounting
+
+On the tunneled TPU every program launch pays a large fixed dispatch+sync
+cost, so the number of *launches per batch* — not kernel time — decides
+general-path wall time. The opjit cache tracks it:
+
+* `opJitCacheHits` / `opJitCacheMisses` (per-operator metrics and the
+  process-wide `opjit.cache_stats()`): one hit or miss is recorded per
+  *program dispatch* through the cache. Eager-pinned fingerprints record
+  nothing — their work runs as raw per-op launches.
+* `cache_stats()["calls_by_kind"]` breaks dispatches down by program kind:
+  `segment` (a fused stage segment: the whole project/filter chain in one
+  launch), `project` / `filter` (single-operator programs), `joinenc`
+  (both join sides' key encode in one launch), `exchsplit` (the exchange
+  map side's hash-partition encode+split pair in one launch), `pids`
+  (hash partitioner alone, e.g. under the mesh collective), `aggsort` /
+  `aggreduce` (the sort-based aggregate's two phases).
+* With fusion on, a fully-fused N-operator chain contributes ONE `segment`
+  dispatch per batch; with fusion off the same chain contributes N
+  `project`/`filter` dispatches. bench.py's q3_general detail reports the
+  per-run deltas so the reduction is directly visible.
+* `opJitTraceTime` isolates first-sight compile cost from steady-state
+  dispatch cost; steady state should be all hits.
 """
 
 REGISTRY = ConfRegistry()
@@ -348,6 +383,43 @@ OPJIT_CACHE_SIZE = _conf("spark.rapids.tpu.opjit.cacheSize").doc(
     "(spark.rapids.tpu.opjit.enabled); evicting an entry drops its "
     "compiled program."
 ).integer(256)
+
+OPJIT_FUSE_STAGES = _conf("spark.rapids.tpu.opjit.fuseStages").doc(
+    "Whole-stage segment fusion for the general path: collapse maximal "
+    "chains of adjacent project/filter operators into one fused segment "
+    "whose entire expression pipeline traces into a SINGLE cached "
+    "executable per batch shape — one dispatch per batch for the whole "
+    "chain instead of one per operator. Host-assisted expressions split "
+    "the segment at the operator boundary (device-pure prefix/suffix stay "
+    "fused); untraceable segments degrade to the per-operator programs "
+    "with identical results. Requires spark.rapids.tpu.opjit.enabled."
+).commonly_used().boolean(True)
+
+SHUFFLE_PIPELINE_ENABLED = _conf(
+    "spark.rapids.tpu.shuffle.pipeline.enabled").doc(
+    "Pipelined exchange materialization: run a shuffle's map tasks "
+    "concurrently through a bounded thread pool (device work gated by the "
+    "TPU semaphore) so one map's deferred host commit I/O overlaps the "
+    "next map's device work, and prefetch the reduce side's "
+    "deserialize+upload while downstream computes (reference "
+    "RapidsShuffleThreadedWriterBase / ...ReaderBase)."
+).commonly_used().boolean(True)
+
+SHUFFLE_PIPELINE_MAP_THREADS = _conf(
+    "spark.rapids.tpu.shuffle.pipeline.mapThreads").doc(
+    "Maximum concurrent map tasks while materializing one exchange "
+    "(spark.rapids.tpu.shuffle.pipeline.enabled). Device-side concurrency "
+    "is still bounded by spark.rapids.tpu.concurrentTpuTasks; extra "
+    "threads overlap host serialization and file I/O with device work."
+).integer(4)
+
+SHUFFLE_PIPELINE_PREFETCH = _conf(
+    "spark.rapids.tpu.shuffle.pipeline.prefetchDepth").doc(
+    "How many reduce-side shuffle blocks the exchange read path "
+    "deserializes and uploads ahead of the consumer "
+    "(spark.rapids.tpu.shuffle.pipeline.enabled). 0 disables read-side "
+    "prefetch."
+).integer(2)
 
 PARQUET_CHUNK_BYTES = _conf(
     "spark.rapids.sql.reader.chunked.maxDecodeBytes").doc(

@@ -1213,17 +1213,27 @@ class TpuHashAggregateExec(TpuExec):
 
     def _sort_fallback(self, batches, agg_fns, result_exprs, ctx,
                        max_rows: int) -> Iterator:
+        from ..config import (SHUFFLE_PIPELINE_ENABLED,
+                              SHUFFLE_PIPELINE_PREFETCH)
         from ..plan.logical import SortOrder
+        from ..utils.pipeline import prefetch_iterator
         from .oocsort import OutOfCoreSorter
         order = [SortOrder(g, True, True) for g in self.grouping]
         ooc = OutOfCoreSorter(order, ctx)
+        depth = (ctx.conf.get(SHUFFLE_PIPELINE_PREFETCH)
+                 if ctx.conf.get(SHUFFLE_PIPELINE_ENABLED) else 0)
+        # slice k+1's merge+gather dispatches overlap slice k's aggregation
+        # (same pipelining discipline as the shuffle read path)
+        slices = prefetch_iterator(
+            ooc.iter_sorted(max_rows, group_boundaries=True), depth)
         try:
             with self.metrics["sortTime"].timed():
                 for b in batches:
                     ooc.add_batch(b)
-            for sl in ooc.iter_sorted(max_rows, group_boundaries=True):
+            for sl in slices:
                 yield self._aggregate_batch(sl, agg_fns, result_exprs, ctx)
         finally:
+            slices.close()  # stop the prefetch worker BEFORE closing ooc
             ooc.close()
 
     def _eval_agg_input(self, fn, batch: TpuColumnarBatch, ctx: TaskContext):

@@ -13,6 +13,7 @@ opTime, ...).
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Sequence
@@ -27,17 +28,22 @@ DEBUG = "DEBUG"
 
 
 class TpuMetric:
-    """Accumulator metric (reference GpuMetric)."""
+    """Accumulator metric (reference GpuMetric). Thread-safe: pipelined
+    exchange map tasks and shuffle prefetch threads (shuffle/exchange.py)
+    accumulate into one operator's metrics concurrently, and an unguarded
+    `+=` from pool threads loses updates."""
 
-    __slots__ = ("name", "level", "value")
+    __slots__ = ("name", "level", "value", "_lock")
 
     def __init__(self, name: str, level: str = MODERATE):
         self.name = name
         self.level = level
         self.value = 0
+        self._lock = threading.Lock()
 
     def add(self, v: int) -> None:
-        self.value += v
+        with self._lock:
+            self.value += v
 
     @contextmanager
     def timed(self):
@@ -45,7 +51,9 @@ class TpuMetric:
         try:
             yield
         finally:
-            self.value += time.perf_counter_ns() - t0
+            dt = time.perf_counter_ns() - t0
+            with self._lock:
+                self.value += dt
 
 
 class TaskContext:

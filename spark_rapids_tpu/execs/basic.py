@@ -22,6 +22,11 @@ from .base import PhysicalPlan, TaskContext, TpuExec, bind_all, bind_references
 
 
 class TpuProjectExec(TpuExec):
+    #: whole-stage segment fusion (execs/fusion.py) may absorb this operator
+    #: into a fused chain: its per-batch transform is a row-wise expression
+    #: forest that collapses by ordinal substitution
+    fusable_segment_op = True
+
     def __init__(self, exprs: Sequence[Expression], child: PhysicalPlan,
                  output: List[AttributeReference]):
         super().__init__([child])
@@ -60,6 +65,10 @@ class TpuProjectExec(TpuExec):
 
 
 class TpuFilterExec(TpuExec):
+    #: fusable into a stage segment (execs/fusion.py): the predicate folds
+    #: into the segment's keep mask and compaction defers to the segment end
+    fusable_segment_op = True
+
     def __init__(self, condition: Expression, child: PhysicalPlan):
         super().__init__([child])
         self.condition = bind_references(condition, child.output)

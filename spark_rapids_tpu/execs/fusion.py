@@ -1,0 +1,330 @@
+"""Whole-stage segment fusion for the GENERAL execution path.
+
+PR 1 (execs/opjit.py) collapsed the general path's dispatch count from
+O(expression nodes) to O(operators): each operator's per-batch transform runs
+as one cached executable. But every operator boundary still materializes a
+batch and pays a full ~100ms host→device round trip through the tunnel, so a
+scan→filter→project→project pipeline still costs one launch PER OPERATOR per
+batch. The compiled whole-stage paths (compiled.py, compiled_join.py) prove
+the fix — fuse the chain into one program — but only inside a narrow
+eligibility window.
+
+This module closes the gap for everything else: a plan-level pass (wired
+through TpuOverrides after the compiled-stage passes) finds maximal chains of
+adjacent general-path project/filter operators and collapses each into a
+TpuFusedSegmentExec. Per batch, the segment flattens its operator pipeline by
+ordinal substitution (classic projection collapse): every output column
+becomes one expression over the segment's INPUT schema, and every filter
+becomes one input-schema predicate. The whole flattened forest plus the AND
+of the filter masks then traces into ONE cached executable
+(opjit.segment_program) — a batch flows through the entire chain in a single
+dispatch, with one compaction at the segment end when filters are present
+(bit-identical to compacting at each filter, because the fusion gate only
+admits row-wise deterministic expressions).
+
+Degradation mirrors PR 1 exactly:
+
+* passthrough columns (including strings and other host-layout columns) are
+  spliced around the program straight from the input batch;
+* a host-assisted or otherwise untraceable operator splits the segment at
+  the operator boundary — the device-pure prefix and suffix stay fused, the
+  offending operator runs its existing per-operator program (which itself
+  splits host-assisted expressions at the host boundary, opjit.eval_exprs);
+* a segment whose first trace fails is pinned eager and every batch after
+  that degrades to the per-operator programs — results are bit-identical
+  either way.
+
+Toggled by spark.rapids.tpu.opjit.fuseStages (requires opjit.enabled).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar.batch import TpuColumnarBatch, compact
+from ..config import OPJIT_ENABLED, OPJIT_FUSE_STAGES, RapidsConf
+from ..config import TASK_RETRY_LIMIT as _TRL
+from ..expressions.base import Expression, to_column
+from .base import PhysicalPlan, TaskContext, TpuExec
+from .basic import TpuFilterExec, TpuProjectExec
+
+
+_MEMO_MISS = object()
+
+#: Cap on a flattened expression's node count. Projection collapse duplicates
+#: shared subtrees symbolically (XLA CSE dedups them in-trace), but a chain
+#: where each column references the previous computed column k times grows
+#: k^depth host-side — Spark's CollapseProject guards the same shape. Sizes
+#: are PROJECTED before any tree is built, so the blowup never materializes;
+#: an over-budget operator just breaks the run and executes per-op.
+_MAX_FUSED_NODES = 512
+
+
+def _projected_size(e: Expression, cur_sizes) -> int:
+    """Node count `e` WOULD have after substitution against a schema whose
+    producing expressions have `cur_sizes` nodes each — computed without
+    building the substituted tree."""
+    from ..expressions.base import AttributeReference
+    if isinstance(e, AttributeReference):
+        if cur_sizes is None:
+            return 1
+        if e.ordinal is None or not (0 <= e.ordinal < len(cur_sizes)):
+            raise ValueError(f"unbound reference {e.name} in segment")
+        return cur_sizes[e.ordinal]
+    return 1 + sum(_projected_size(c, cur_sizes) for c in e.children)
+
+
+def _layout_sig(batch: TpuColumnarBatch):
+    """Everything the run planner's gates read off a batch: column count,
+    carrier dtype, validity presence, and buffer layout (the _inputs_ok
+    fields). Capacity is deliberately absent — the plan is shape-agnostic;
+    only the compiled program (opjit key) specializes on it."""
+    out = []
+    for c in batch.columns:
+        d = c.data
+        out.append((type(c.dtype).__name__,
+                    str(d.dtype) if hasattr(d, "dtype") else None,
+                    c.validity is not None, c.offsets is None,
+                    c.host_data is None, c.child is None,
+                    c.children is None, getattr(d, "ndim", None)))
+    return tuple(out)
+
+
+class TpuFusedSegmentExec(TpuExec):
+    """A maximal chain of adjacent project/filter operators executing as one
+    stage segment: one cached executable per (segment fingerprint, bucketed
+    shape) when the whole chain traces, per-operator programs otherwise.
+
+    `ops` is the fused chain bottom-up (ops[0] consumed `child`'s output);
+    the original exec objects are kept for their bound expressions and
+    output schemas — their own child links are NOT executed."""
+
+    def __init__(self, ops: Sequence[PhysicalPlan], child: PhysicalPlan):
+        super().__init__([child])
+        self._ops = list(ops)
+        self._output = self._ops[-1].output
+        # planned runs memoized by (start op, input-batch layout): the
+        # symbolic flatten + gate walk depends only on those, so steady-state
+        # batches skip the per-batch expression-tree rebuild entirely
+        self._run_memo: dict = {}
+
+    @property
+    def output(self):
+        return self._output
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def node_desc(self) -> str:
+        inner = "+".join(
+            type(o).__name__.replace("Tpu", "").replace("Exec", "")
+            for o in self._ops)
+        return f"TpuFusedSegment[{inner}]"
+
+    def additional_metrics(self):
+        return {"opFusedBatches": "DEBUG", "opFusedFallbackOps": "DEBUG"}
+
+    # --- execution --------------------------------------------------------
+    def internal_do_execute_columnar(self, idx: int,
+                                     ctx: TaskContext) -> Iterator:
+        from ..memory.retry import with_retry
+        from ..memory.spill import SpillableColumnarBatch
+        op_time = self.metrics["opTime"]
+        names = [a.name for a in self._output]
+
+        def transform(batch: TpuColumnarBatch) -> TpuColumnarBatch:
+            return self._transform(batch, ctx).rename(names)
+
+        for batch in self.children[0].execute_partition(idx, ctx):
+            with op_time.timed():
+                # the whole segment is row-wise, so the operator-level
+                # retry-with-split contract holds for the fused chain too
+                yield from with_retry(SpillableColumnarBatch(batch),
+                                      transform,
+                                      max_retries=ctx.conf.get(_TRL))
+
+    def _transform(self, batch: TpuColumnarBatch,
+                   ctx: TaskContext) -> TpuColumnarBatch:
+        from . import opjit
+        cur = batch
+        i = 0
+        n_ops = len(self._ops)
+        while i < n_ops:
+            run = self._planned_run(i, cur, ctx) \
+                if opjit.enabled(ctx.eval_ctx) else None
+            if run is not None:
+                out = self._run_fused(run, cur, ctx)
+                if out is not None:
+                    cur = out
+                    i = run[0]
+                    self.metrics["opFusedBatches"].add(1)
+                    continue
+            # per-operator degradation: exactly the PR 1 path for this op
+            cur = self._apply_op(self._ops[i], cur, ctx)
+            self.metrics["opFusedFallbackOps"].add(1)
+            i += 1
+        return cur
+
+    def _planned_run(self, start: int, batch: TpuColumnarBatch,
+                     ctx: TaskContext):
+        """Memoized _plan_run: keyed by (start, conf fingerprint, layout of
+        the current batch) — everything the plan decision reads. A benign
+        compute-twice race under concurrent partitions lands the same value."""
+        key = (start, bool(ctx.eval_ctx.ansi), _layout_sig(batch))
+        hit = self._run_memo.get(key, _MEMO_MISS)
+        if hit is not _MEMO_MISS:
+            return hit
+        run = self._plan_run(start, batch, ctx)
+        if len(self._run_memo) > 64:  # distinct layouts are few; stay bounded
+            self._run_memo.clear()
+        self._run_memo[key] = run
+        return run
+
+    def _plan_run(self, start: int, batch: TpuColumnarBatch,
+                  ctx: TaskContext):
+        """Greedy maximal fusable run of ops[start:] against `batch`:
+        flatten each operator by ordinal substitution and stop at the first
+        operator whose flattened expressions cannot fuse (not a passthrough
+        and outside the trace gate). Returns (end, out_specs, filters) where
+        out_specs maps each final output position to ('pass', input_attr) or
+        ('jit', input_expr), or None when fewer than two ops fuse."""
+        from . import opjit
+        cur_exprs: Optional[List[Expression]] = None  # None == identity
+        cur_sizes: Optional[List[int]] = None
+        filters: List[Expression] = []
+        end = start
+        try:
+            for op in self._ops[start:]:
+                if isinstance(op, TpuProjectExec):
+                    sizes = [_projected_size(e, cur_sizes)
+                             for e in op.exprs]
+                    if max(sizes, default=0) > _MAX_FUSED_NODES:
+                        break  # shared-subtree blowup: stop before building
+                    subd = [opjit.substitute(e, cur_exprs) for e in op.exprs]
+                    if not all(opjit.fusable_expr(e) for e in subd):
+                        break
+                    cur_exprs = subd
+                    cur_sizes = sizes
+                elif isinstance(op, TpuFilterExec):
+                    if _projected_size(op.condition,
+                                       cur_sizes) > _MAX_FUSED_NODES:
+                        break
+                    cond = opjit.substitute(op.condition, cur_exprs)
+                    if not opjit.segment_gate_ok(cond):
+                        break
+                    filters.append(cond)
+                else:  # unknown fusable marker: never absorb blindly
+                    break
+                end += 1
+        except ValueError:  # unbound reference: not fusable past this point
+            pass
+        if end - start < 2:
+            return None
+        if cur_exprs is None:  # filters only: output schema == input schema
+            from ..expressions.base import AttributeReference
+            cur_exprs = [
+                AttributeReference(a.name, a.dtype, a.nullable, ordinal=o,
+                                   expr_id=a.expr_id)
+                for o, a in enumerate(self._ops[end - 1].output)]
+        out_attrs = self._ops[end - 1].output
+        specs: List[Tuple[str, object]] = []
+        traced: List[Expression] = []
+        for e, attr in zip(cur_exprs, out_attrs):
+            p = opjit.is_passthrough(e)
+            if p:
+                specs.append(("pass", opjit.strip_alias(e)))
+            else:
+                specs.append(("jit", (len(traced), attr.dtype)))
+                traced.append(e)
+        if (traced or filters) and not opjit.segment_inputs_ok(
+                traced + filters, batch):
+            return None
+        return end, specs, traced, filters, out_attrs
+
+    def _run_fused(self, run, batch: TpuColumnarBatch,
+                   ctx: TaskContext) -> Optional[TpuColumnarBatch]:
+        from . import opjit
+        end, specs, traced, filters, out_attrs = run
+        names = [a.name for a in out_attrs]
+        if not traced and not filters:
+            # pure column shuffle (select/reorder): no dispatch at all
+            cols = [batch.columns[spec.ordinal] for _, spec in specs]
+            return TpuColumnarBatch(cols, batch.num_rows, names)
+        dtypes = [spec[1] for kind, spec in specs if kind == "jit"]
+        res = opjit.segment_program(traced, dtypes, filters, batch,
+                                    ctx.eval_ctx, self.metrics)
+        if res is None:
+            return None
+        jit_cols, keep = res
+        cols = []
+        for kind, spec in specs:
+            if kind == "pass":
+                cols.append(batch.columns[spec.ordinal])
+            else:
+                cols.append(jit_cols[spec[0]])
+        out = TpuColumnarBatch(cols, batch.num_rows, names)
+        if keep is not None:
+            out = compact(out, keep)  # ONE compaction for the whole segment
+        return out
+
+    def _apply_op(self, op: PhysicalPlan, batch: TpuColumnarBatch,
+                  ctx: TaskContext) -> TpuColumnarBatch:
+        """One operator on its existing per-operator path (PR 1 semantics:
+        jittable forests/predicates still run as cached programs, the rest
+        eagerly — identical results to the standalone exec)."""
+        from . import opjit
+        if isinstance(op, TpuProjectExec):
+            out_dtypes = [a.dtype for a in op.output]
+            cols = opjit.eval_exprs(op.exprs, out_dtypes, batch,
+                                    ctx.eval_ctx, self.metrics)
+            return TpuColumnarBatch(cols, batch.num_rows,
+                                    [a.name for a in op.output])
+        mask = opjit.filter_mask(op.condition, batch, ctx.eval_ctx,
+                                 self.metrics)
+        if mask is None:
+            mask_col = to_column(op.condition.eval_tpu(batch, ctx.eval_ctx),
+                                 batch)
+            mask = mask_col.data.astype(jnp.bool_)
+            if mask_col.validity is not None:
+                mask = mask & mask_col.validity  # null predicate → drop
+        return compact(batch, mask)
+
+
+# ---------------------------------------------------------------------------
+# plan pass
+# ---------------------------------------------------------------------------
+
+#: general-path operators a segment may absorb (marked in execs/basic.py)
+def _fusable(node: PhysicalPlan) -> bool:
+    return getattr(node, "fusable_segment_op", False)
+
+
+def fuse_stage_segments(plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    """Collapse maximal chains of adjacent fusable general-path operators
+    into TpuFusedSegmentExec nodes. Runs AFTER the compiled-stage passes
+    (they pattern-match the raw project/filter chains) and is a no-op when
+    fusion or the opjit cache is disabled."""
+    if not (conf.get(OPJIT_ENABLED) and conf.get(OPJIT_FUSE_STAGES)):
+        return plan
+    return _fuse(plan)
+
+
+def _fuse(plan: PhysicalPlan) -> PhysicalPlan:
+    if _fusable(plan):
+        chain = [plan]  # top-down
+        node = plan
+        while node.children and _fusable(node.children[0]):
+            node = node.children[0]
+            chain.append(node)
+        if len(chain) >= 2:
+            child = _fuse(node.children[0])
+            return TpuFusedSegmentExec(list(reversed(chain)), child)
+    new_children = [_fuse(c) for c in plan.children]
+    if all(a is b for a, b in zip(new_children, plan.children)):
+        return plan
+    new = copy.copy(plan)
+    new.children = new_children
+    return new

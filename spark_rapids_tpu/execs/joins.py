@@ -256,9 +256,38 @@ class TpuShuffledHashJoinExec(TpuExec):
                 batches.extend(child.execute_partition(p, ctx))
         return concat_batches(batches) if batches else None
 
+    def _collect_sides(self, ctx, idx: int):
+        """Collect both join inputs. The two sides are independent subtrees,
+        so with shuffle pipelining enabled the build side materializes on a
+        worker thread while the probe side materializes here — its shuffle
+        reads, uploads and device dispatches overlap instead of running
+        back-to-back (device concurrency stays bounded by the semaphore)."""
+        from ..config import SHUFFLE_PIPELINE_ENABLED
+        if ctx.conf.get(SHUFFLE_PIPELINE_ENABLED):
+            import threading
+            res: dict = {}
+
+            def collect_right():
+                try:
+                    res["right"] = self._collect_side(self.children[1], ctx,
+                                                      idx)
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    res["err"] = e
+
+            t = threading.Thread(target=collect_right, name="join-side")
+            t.start()
+            try:
+                left = self._collect_side(self.children[0], ctx, idx)
+            finally:
+                t.join()
+            if "err" in res:
+                raise res["err"]
+            return left, res["right"]
+        return (self._collect_side(self.children[0], ctx, idx),
+                self._collect_side(self.children[1], ctx, idx))
+
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
-        left = self._collect_side(self.children[0], ctx, idx)
-        right = self._collect_side(self.children[1], ctx, idx)
+        left, right = self._collect_sides(ctx, idx)
         jt = self.join_type
         names = [a.name for a in self._output]
         l_empty = left is None or left.num_rows == 0
@@ -276,18 +305,17 @@ class TpuShuffledHashJoinExec(TpuExec):
             # pair joined independently — keys land in exactly one pair so
             # outer/semi/anti semantics compose (reference
             # GpuSubPartitionHashJoin.scala)
-            from ..shuffle.partitioner import (hash_partition_ids,
-                                               split_by_partition)
+            from ..shuffle.partitioner import hash_split_parts
             k = max(2, -(-max(left.num_rows, right.num_rows) // max_rows))
             # seed 100 (not the exchange's 42): upstream co-partitioning fixes
             # h42 % N, so re-bucketing with the same seed would collapse into
-            # few sub-partitions (GpuSubPartitionHashJoin.scala hashSeed=100)
-            l_ids = hash_partition_ids(left, self.left_keys, k, ctx, seed=100,
+            # few sub-partitions (GpuSubPartitionHashJoin.scala hashSeed=100).
+            # Each side's encode+split pair runs as one cached executable
+            # when the keys trace (opjit.partition_split_plan).
+            l_parts = hash_split_parts(left, self.left_keys, k, ctx, seed=100,
                                        metrics=self.metrics)
-            r_ids = hash_partition_ids(right, self.right_keys, k, ctx,
+            r_parts = hash_split_parts(right, self.right_keys, k, ctx,
                                        seed=100, metrics=self.metrics)
-            l_parts = split_by_partition(left, l_ids, k)
-            r_parts = split_by_partition(right, r_ids, k)
             with self.metrics["joinTime"].timed():
                 for lp, rp in zip(l_parts, r_parts):
                     out = self._join_pair(lp, rp, names, ctx)

@@ -69,11 +69,18 @@ _FAILED = object()  # call outcome: run the eager fallback
 
 #: process-wide counters (bench.py reads these; per-exec metrics mirror them)
 _STATS = {"hits": 0, "misses": 0, "traces": 0, "trace_time_ns": 0}
+#: dispatch accounting (docs/configs.md "Dispatch accounting"): one entry per
+#: program dispatch through the cache, keyed by program kind ("segment",
+#: "project", "filter", "joinenc", "exchsplit", "pids", "aggsort",
+#: "aggreduce"). A fully fused N-operator chain shows ONE "segment" dispatch
+#: per batch where the per-operator path shows N "project"/"filter"
+#: dispatches; "exchsplit" likewise replaces a "pids"+split-plan pair.
+_KIND_CALLS: Dict[str, int] = {}
 
 
-def cache_stats() -> Dict[str, int]:
+def cache_stats() -> Dict[str, Any]:
     with _LOCK:
-        return dict(_STATS)
+        return {**_STATS, "calls_by_kind": dict(_KIND_CALLS)}
 
 
 def cache_len() -> int:
@@ -130,11 +137,13 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
         _note(metrics, "opJitCacheHits", 1)
         with _LOCK:
             _STATS["hits"] += 1
+            _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
         return entry(*args)
 
     _note(metrics, "opJitCacheMisses", 1)
     with _LOCK:
         _STATS["misses"] += 1
+        _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
     fn = jax.jit(build(), donate_argnums=donate_argnums)
     t0 = time.perf_counter_ns()
     try:
@@ -654,6 +663,51 @@ def partition_ids(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
     return None if out is _FAILED else out
 
 
+def partition_split_plan(batch: TpuColumnarBatch,
+                         key_exprs: Sequence[Expression], n: int,
+                         eval_ctx: EvalContext, seed: int, metrics=None):
+    """The exchange map side's hash-partition ENCODE+SPLIT as one executable:
+    key eval → murmur3 → pmod → stable sort-by-pid → partition bounds, in a
+    single dispatch (the eager path pays one program for the pids and a
+    second for the split plan). Returns (order, bounds) device arrays or
+    None (caller runs the two-program path)."""
+    if not enabled(eval_ctx):
+        return None
+    if not all(_gate_ok(k) for k in key_exprs) \
+            or not _inputs_ok(key_exprs, batch):
+        return None
+    cap = batch.capacity
+    sig = _input_sig(key_exprs, batch)
+    key = ("exchsplit", tuple(_fp(k) for k in key_exprs), cap,
+           len(batch.columns), sig, int(n), int(seed), _conf_fp(eval_ctx))
+    src_dtypes = {o: batch.columns[o].dtype for (o, _, _, _) in sig}
+    n_cols = len(batch.columns)
+    key_exprs = list(key_exprs)
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(*flat):
+            from ..expressions.hashexprs import murmur3_batch
+            rowmask = jnp.arange(cap) < flat[0]
+            tb = _rebuild_batch(flat, sig, src_dtypes, n_cols, cap, rowmask)
+            cols = [to_column(k.eval_tpu(tb, tctx), tb, k.dtype)
+                    for k in key_exprs]
+            h = murmur3_batch(cols, cap, cap, seed)
+            pid = h % n
+            pid = jnp.where(pid < 0, pid + n, pid).astype(jnp.int32)
+            # identical composition to partitioner._split_plan: padding last
+            sort_key = jnp.where(rowmask, pid, n)
+            order = jnp.argsort(sort_key, stable=True)
+            sorted_pid = jnp.take(sort_key, order)
+            return order, jnp.searchsorted(sorted_pid, jnp.arange(n + 1))
+        return fn
+
+    out = _cached_call(key, build, tuple(_flat_args(batch, sig)),
+                       eval_ctx, metrics)
+    return None if out is _FAILED else out
+
+
 # ---------------------------------------------------------------------------
 # sort-based aggregate (execs/aggregates.py): sort phase + reduce phase
 # ---------------------------------------------------------------------------
@@ -799,3 +853,117 @@ def agg_reduce(agg_fns, batch: TpuColumnarBatch, perm, seg_ids, is_new,
     agg_cols = [TpuColumnVector(agg_out_dtype(f), d, v, n_groups)
                 for f, (d, v) in zip(agg_fns, outs)]
     return agg_cols, key_rows
+
+
+# ---------------------------------------------------------------------------
+# whole-stage segment fusion (execs/fusion.py): a chain of project/filter
+# operators flattened into ONE executable per batch shape
+# ---------------------------------------------------------------------------
+
+
+def strip_alias(e: Expression) -> Expression:
+    return e.children[0] if isinstance(e, Alias) else e
+
+
+def substitute(e: Expression, cur_exprs) -> Expression:
+    """Rewrite `e` (bound to the CURRENT schema of a segment position) into an
+    expression over the segment's INPUT schema: every AttributeReference's
+    ordinal indexes `cur_exprs`, the list of input-schema expressions that
+    produce the current schema. `cur_exprs is None` means the current schema
+    IS the input schema (identity). This is classic projection collapse —
+    shared subtrees are duplicated symbolically, which is safe because only
+    deterministic expressions are ever fused (fusion.py gates out the
+    nondeterministic/task-state readers via _gate_ok) and XLA CSE dedups the
+    duplicated work inside the one traced program."""
+    if cur_exprs is None:
+        return e
+
+    def rule(x: Expression):
+        if isinstance(x, AttributeReference):
+            if x.ordinal is None or not (0 <= x.ordinal < len(cur_exprs)):
+                raise ValueError(f"unbound reference {x.name} in segment")
+            return strip_alias(cur_exprs[x.ordinal])
+        return None
+
+    return e.transform(rule)
+
+
+def is_passthrough(e: Expression) -> bool:
+    """A segment output that is just a (possibly aliased) input column: it
+    bypasses the traced program entirely — any dtype, including strings —
+    and is spliced from the input batch into the assembled output."""
+    return _passthrough(e) is not None
+
+
+def fusable_expr(e: Expression) -> bool:
+    """May this (input-schema) expression participate in a fused segment?
+    Either it bypasses as a passthrough column or it traces via the gate."""
+    return is_passthrough(e) or _gate_ok(e)
+
+
+def segment_gate_ok(e: Expression) -> bool:
+    """Public gate for fusion.py (filters must trace; no bypass option)."""
+    return _gate_ok(e)
+
+
+def segment_inputs_ok(exprs: Sequence[Expression],
+                      batch: TpuColumnarBatch) -> bool:
+    return _inputs_ok(exprs, batch)
+
+
+def segment_program(out_exprs: Sequence[Expression],
+                    out_dtypes: Sequence[DataType],
+                    filters: Sequence[Expression],
+                    batch: TpuColumnarBatch, eval_ctx: EvalContext,
+                    metrics=None):
+    """A whole stage segment as ONE executable: every computed output column
+    of the collapsed projection pipeline plus the AND of every filter
+    predicate (null predicate → drop, exactly the eager filter semantics),
+    evaluated over the segment's input batch in a single dispatch. Filters
+    do NOT compact inside the trace — rows stay in place under a keep mask
+    and the caller compacts once at the segment end, which is bit-identical
+    for the row-wise expressions the gate admits. Returns (cols, keep) where
+    keep is None when the segment has no filters, or None when the
+    fingerprint is pinned eager (caller degrades to per-operator programs)."""
+    cap = batch.capacity
+    out_exprs = list(out_exprs)
+    out_dtypes = list(out_dtypes)
+    filters = list(filters)
+    all_exprs = out_exprs + filters
+    sig = _input_sig(all_exprs, batch)
+    key = ("segment", tuple(_fp(e) for e in out_exprs),
+           tuple(_fp(f) for f in filters),
+           tuple(type(d).__name__ for d in out_dtypes), cap,
+           len(batch.columns), sig, _conf_fp(eval_ctx))
+    src_dtypes = {o: batch.columns[o].dtype for (o, _, _, _) in sig}
+    n_cols = len(batch.columns)
+    has_filters = bool(filters)
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(*flat):
+            rowmask = jnp.arange(cap) < flat[0]
+            tb = _rebuild_batch(flat, sig, src_dtypes, n_cols, cap, rowmask)
+            keep = rowmask
+            for f in filters:
+                c = to_column(f.eval_tpu(tb, tctx), tb)
+                m = c.data.astype(jnp.bool_)
+                if c.validity is not None:
+                    m = m & c.validity  # null predicate → drop row
+                keep = keep & m
+            outs = []
+            for e, dt in zip(out_exprs, out_dtypes):
+                c = to_column(e.eval_tpu(tb, tctx), tb, dt)
+                outs.append((c.data, c.validity))
+            return tuple(outs), (keep if has_filters else None)
+        return fn
+
+    out = _cached_call(key, build, tuple(_flat_args(batch, sig)),
+                       eval_ctx, metrics)
+    if out is _FAILED:
+        return None
+    outs, keep = out
+    cols = [TpuColumnVector(dt, d, v, batch.num_rows)
+            for (d, v), dt in zip(outs, out_dtypes)]
+    return cols, keep

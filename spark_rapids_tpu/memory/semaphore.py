@@ -43,7 +43,11 @@ class TpuSemaphore:
     def acquire_if_necessary(self, ctx) -> None:
         """First call for a task blocks for a permit; later calls are no-ops.
         Registers release at task completion (reference: task-completion
-        listener guarantees release, GpuSemaphore.scala)."""
+        listener guarantees release, GpuSemaphore.scala). Safe when two
+        threads share one task context (pipelined exchange map / join side
+        collection): the loser of the first-acquire race hands its extra
+        permit back — release runs once per task, so a double-acquire would
+        otherwise leak a permit permanently."""
         import time
         tid = id(ctx)
         with self._state_lock:
@@ -53,10 +57,14 @@ class TpuSemaphore:
         t0 = time.perf_counter_ns()
         self._sem.acquire()
         waited = time.perf_counter_ns() - t0
-        self.total_waits_ns += waited
         from ..profiling import TaskMetricsRegistry
         TaskMetricsRegistry.get().add("semaphoreWaitNs", waited)
         with self._state_lock:
+            self.total_waits_ns += waited
+            if tid in self._holders:  # lost the first-acquire race
+                self._holders[tid] += 1
+                self._sem.release()
+                return
             self._holders[tid] = 1
         ctx.add_completion_listener(lambda: self.release_if_necessary(ctx))
 

@@ -255,10 +255,8 @@ def _maybe_coordinated_readers(meta: PlanMeta, ch):
         meta.conf.get(AQE_ADVISORY_PARTITION_BYTES),
         meta.conf.get(AQE_SKEW_THRESHOLD) if skew else (1 << 62),
         meta.conf.get(AQE_SKEW_FACTOR), coalesce=bool(coalesce))
-    l = TpuCoordinatedShuffleReaderExec(ch[0], coord, 0)
-    r = TpuCoordinatedShuffleReaderExec(ch[1], coord, 1)
-    l._conf = meta.conf
-    r._conf = meta.conf
+    l = TpuCoordinatedShuffleReaderExec(ch[0], coord, 0, conf=meta.conf)
+    r = TpuCoordinatedShuffleReaderExec(ch[1], coord, 1, conf=meta.conf)
     return [l, r]
 
 
@@ -366,10 +364,9 @@ def _convert_exchange(meta: PlanMeta, ch):
         "Join" in type(parent_plan).__name__
     if meta.conf.get(AQE_COALESCE_ENABLED) and p.partitioning == "hash" \
             and not feeds_join:
-        reader = TpuShuffleReaderExec(
-            exch, meta.conf.get(AQE_ADVISORY_PARTITION_BYTES))
-        reader._conf = meta.conf
-        return reader
+        return TpuShuffleReaderExec(
+            exch, meta.conf.get(AQE_ADVISORY_PARTITION_BYTES),
+            conf=meta.conf)
     return exch
 
 
@@ -512,7 +509,12 @@ class TpuOverrides:
         final = TpuTransitionOverrides.apply(converted, conf)
         from ..execs.compiled import compile_agg_stages
         from ..execs.compiled_join import compile_join_agg_stages
-        return compile_agg_stages(compile_join_agg_stages(final, conf), conf)
+        final = compile_agg_stages(compile_join_agg_stages(final, conf), conf)
+        # whole-stage segment fusion for whatever the compiled stages left
+        # on the general path (execs/fusion.py): adjacent project/filter
+        # chains collapse into one dispatch per batch
+        from ..execs.fusion import fuse_stage_segments
+        return fuse_stage_segments(final, conf)
 
     @staticmethod
     def explain_plan(plan: PhysicalPlan, conf: RapidsConf) -> str:

@@ -137,10 +137,14 @@ class TpuCoordinatedShuffleReaderExec(TpuExec):
     GpuCustomShuffleReaderExec with coalesced AND partial-reducer specs)."""
 
     def __init__(self, exchange, coordinator: JoinReaderCoordinator,
-                 side: int):
+                 side: int, conf=None):
         super().__init__([exchange])
         self.coordinator = coordinator
         self.side = side
+        # planner conf snapshot (same contract as TpuShuffleReaderExec):
+        # num_partitions materializes the exchange, which must see the
+        # session conf, not default_conf
+        self._conf = conf
 
     @property
     def output(self):
@@ -155,7 +159,7 @@ class TpuCoordinatedShuffleReaderExec(TpuExec):
 
     def num_partitions(self) -> int:
         from ..config import default_conf
-        ctx = TaskContext(0, getattr(self, "_conf", None) or default_conf())
+        ctx = TaskContext(0, self._conf or default_conf())
         try:
             return len(self.coordinator.specs(ctx))
         finally:

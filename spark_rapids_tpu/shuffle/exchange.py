@@ -18,8 +18,9 @@ from ..columnar.batch import TpuColumnarBatch
 from ..config import SHUFFLE_PARTITIONS
 from ..expressions.base import AttributeReference, Expression
 from .manager import TpuShuffleManager
-from .partitioner import (hash_partition_ids, np_hash_partition_ids,
-                          round_robin_partition_ids, split_by_partition)
+from .partitioner import (hash_partition_ids, hash_split_parts,
+                          np_hash_partition_ids, round_robin_partition_ids,
+                          split_by_partition)
 from ..execs.base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all)
 
 
@@ -41,6 +42,20 @@ class _ExchangeBase:
         from ..config import SHUFFLE_MODE
         return str(ctx.conf.get(SHUFFLE_MODE)).upper()
 
+    def _map_task_threads(self, ctx: TaskContext) -> int:
+        from ..config import (SHUFFLE_PIPELINE_ENABLED,
+                              SHUFFLE_PIPELINE_MAP_THREADS)
+        if not ctx.conf.get(SHUFFLE_PIPELINE_ENABLED):
+            return 1
+        return max(1, int(ctx.conf.get(SHUFFLE_PIPELINE_MAP_THREADS)))
+
+    def _prefetch_depth(self, ctx: TaskContext) -> int:
+        from ..config import (SHUFFLE_PIPELINE_ENABLED,
+                              SHUFFLE_PIPELINE_PREFETCH)
+        if not ctx.conf.get(SHUFFLE_PIPELINE_ENABLED):
+            return 0
+        return max(0, int(ctx.conf.get(SHUFFLE_PIPELINE_PREFETCH)))
+
     def _ensure_materialized(self, ctx: TaskContext) -> None:
         with self._mat_lock:
             if self._shuffle_id is not None:
@@ -53,18 +68,62 @@ class _ExchangeBase:
                 self._shuffle_id = sid
                 return
             self._n_maps = child.num_partitions()
-            for map_id in range(self._n_maps):
-                self._materialize_map(sid, map_id, ctx, mgr)
+            threads = self._map_task_threads(ctx)
+            if threads > 1 and self._n_maps > 1:
+                self._materialize_maps_pipelined(sid, ctx, mgr, threads)
+            else:
+                for map_id in range(self._n_maps):
+                    self._materialize_map(sid, map_id, ctx, mgr)
             self._shuffle_id = sid
+
+    def _materialize_maps_pipelined(self, sid: int, ctx: TaskContext, mgr,
+                                    n_threads: int) -> None:
+        """Pipelined map-side materialization (reference
+        RapidsShuffleThreadedWriterBase): map tasks run concurrently on a
+        bounded pool, device work gated per task by the TPU semaphore, and
+        each task's deferred host commit (file serialization I/O, released
+        from the semaphore) overlaps sibling maps' device work. Block files
+        are keyed (map, reduce) so completion order cannot change results."""
+        # Pre-materialize nested exchanges serially first: a concurrent map
+        # task must never trigger a recursive materialization while sibling
+        # maps hold device permits — the upstream exchange's own map tasks
+        # would starve for permits and deadlock.
+        for node in self.children[0].collect_nodes():
+            if isinstance(node, _ExchangeBase):
+                node._ensure_materialized(ctx)
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(
+            max_workers=min(n_threads, self._n_maps),
+            thread_name_prefix="exchange-map")
+        try:
+            futs = [pool.submit(self._materialize_map, sid, m, ctx, mgr,
+                                True)
+                    for m in range(self._n_maps)]
+            errors = []
+            for f in futs:  # wait for ALL maps: no partial shuffle state
+                try:
+                    f.result()
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+            if errors:
+                raise errors[0]
+        finally:
+            pool.shutdown(wait=True)
 
     def _try_materialize_collective(self, sid: int, ctx: TaskContext) -> bool:
         """Mesh collective data plane; overridden by the device exchange."""
         return False
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
-                         mgr) -> None:
+                         mgr, gate_device: bool = False) -> None:
         map_ctx = TaskContext(map_id, ctx.conf)
         try:
+            if gate_device and isinstance(self, TpuExec):
+                # pipelined map tasks take a permit up front so concurrent
+                # device work stays bounded by concurrentTpuTasks (lazy
+                # acquisition would let every pool thread dispatch at once)
+                from ..memory.semaphore import TpuSemaphore
+                TpuSemaphore.get(ctx.conf).acquire_if_necessary(map_ctx)
             commit = self._run_map_task(sid, map_id, map_ctx, mgr)
         finally:
             map_ctx.complete()  # releases the semaphore, if held
@@ -236,13 +295,13 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         return True
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
-                         mgr) -> None:
+                         mgr, gate_device: bool = False) -> None:
         if getattr(self, "_collective", False):
             # collective recovery: re-run the whole exchange (a lost block in
             # mesh mode means the collective result was invalidated)
             self._try_materialize_collective(sid, ctx)
             return
-        super()._materialize_map(sid, map_id, ctx, mgr)
+        super()._materialize_map(sid, map_id, ctx, mgr, gate_device)
 
     def _device_parts(self, map_id: int, ctx: TaskContext) -> Iterator[List]:
         """Device partition-split of each input batch (shared by both
@@ -253,9 +312,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 continue
             with self.metrics["partitionTime"].timed():
                 if self.partitioning == "hash":
-                    pids = hash_partition_ids(batch, self.keys, n, ctx,
-                                              metrics=self.metrics)
-                    parts = split_by_partition(batch, pids, n)
+                    # encode+split as ONE cached executable when the keys
+                    # trace (opjit.partition_split_plan)
+                    parts = hash_split_parts(batch, self.keys, n, ctx,
+                                             metrics=self.metrics)
                 elif self.partitioning in ("roundrobin", "coalesce"):
                     pids = round_robin_partition_ids(batch, n, map_id)
                     parts = split_by_partition(batch, pids, n)
@@ -339,12 +399,31 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 if b.num_rows:
                     yield b.rename(names)
             return
+        # pipelined read (reference RapidsShuffleThreadedReaderBase): blocks
+        # stream from the reader pool in map order while the NEXT block's
+        # deserialize+upload is prefetched on a worker thread — downstream
+        # device compute overlaps the tunnel upload instead of waiting on it
         mgr = TpuShuffleManager.get(ctx.conf)
-        with self.metrics["deserializationTime"].timed():
-            tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps)
-        for t in tables:
-            if t.num_rows:
-                yield TpuColumnarBatch.from_arrow(t).rename(names)
+        deser = self.metrics["deserializationTime"]
+
+        def _upload() -> Iterator[TpuColumnarBatch]:
+            # deserializationTime covers producing a device-ready batch:
+            # waiting on the pool's read+deserialize AND the upload (the
+            # actual decode runs on reader threads, so only its non-overlapped
+            # wait is attributable to this task)
+            it = mgr.iter_partition(self._shuffle_id, idx, self._n_maps)
+            while True:
+                with deser.timed():
+                    t = next(it, None)
+                    b = (TpuColumnarBatch.from_arrow(t)
+                         if t is not None and t.num_rows else None)
+                if t is None:
+                    return
+                if b is not None:
+                    yield b.rename(names)
+
+        from ..utils.pipeline import prefetch_iterator
+        yield from prefetch_iterator(_upload(), self._prefetch_depth(ctx))
 
     def execute_partition_maps(self, idx: int, map_ids: Sequence[int],
                                ctx: TaskContext) -> Iterator:
@@ -361,9 +440,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                     yield b.rename(names)
             return
         mgr = TpuShuffleManager.get(ctx.conf)
-        tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps,
-                                    map_ids=list(map_ids))
-        for t in tables:
+        for t in mgr.iter_partition(self._shuffle_id, idx, self._n_maps,
+                                    map_ids=list(map_ids)):
             if t.num_rows:
                 yield TpuColumnarBatch.from_arrow(t).rename(names)
 
@@ -423,9 +501,14 @@ class TpuShuffleReaderExec(TpuExec):
     by sub-partitioning, execs/joins.py, where key co-location is not
     required to survive.)"""
 
-    def __init__(self, child, advisory_bytes: int):
+    def __init__(self, child, advisory_bytes: int, conf=None):
         super().__init__([child])
         self.advisory_bytes = advisory_bytes
+        # planner conf snapshot, threaded in AT CONSTRUCTION: num_partitions
+        # materializes the child exchange, and doing that under default_conf
+        # would let AQE specs diverge between planning and execution
+        # (different shuffle mode / pipeline tunables / partition counts)
+        self._conf = conf
         self._specs: Optional[List[List[int]]] = None
 
     @property
@@ -456,9 +539,10 @@ class TpuShuffleReaderExec(TpuExec):
     def num_partitions(self) -> int:
         from ..execs.base import TaskContext
         from ..config import default_conf
-        # sizes require materialization; use the session conf snapshot the
-        # planner stored on the exchange path
-        ctx = TaskContext(0, getattr(self, "_conf", None) or default_conf())
+        # sizes require materialization; the planner threads its conf
+        # snapshot through the constructor (default_conf only covers readers
+        # built outside the override engine, e.g. hand-assembled test plans)
+        ctx = TaskContext(0, self._conf or default_conf())
         try:
             return len(self._ensure_specs(ctx))
         finally:

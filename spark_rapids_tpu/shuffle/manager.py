@@ -14,7 +14,7 @@ import shutil
 import tempfile
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 from ..config import (RapidsConf, SHUFFLE_COMPRESSION_CODEC,
                       SHUFFLE_READER_THREADS, SHUFFLE_WRITER_THREADS,
@@ -62,6 +62,9 @@ class TpuShuffleManager:
         self._limiter = BytesInFlightLimiter()
         self._next_shuffle_id = 0
         self._id_lock = threading.Lock()
+        # byte counters accumulate from writer/reader POOL threads — an
+        # unguarded += loses updates under concurrency
+        self._stats_lock = threading.Lock()
         self.bytes_written = 0
         self.bytes_read = 0
 
@@ -96,7 +99,8 @@ class TpuShuffleManager:
             try:
                 with open(self._path(shuffle_id, map_id, reduce_id), "wb") as f:
                     f.write(block)
-                self.bytes_written += len(block)
+                with self._stats_lock:
+                    self.bytes_written += len(block)
             finally:
                 self._limiter.release(len(block))
 
@@ -105,10 +109,14 @@ class TpuShuffleManager:
         for f in futures:
             f.result()
 
-    def read_partition(self, shuffle_id: int, reduce_id: int,
-                       n_maps: int, map_ids=None) -> List:
-        """Fetch one reduce partition's blocks from all maps in parallel.
-        `map_ids` restricts to a subset of maps (AQE skew slices)."""
+    def iter_partition(self, shuffle_id: int, reduce_id: int,
+                       n_maps: int, map_ids=None) -> Iterator:
+        """Streaming fetch of one reduce partition's blocks: every map's
+        read+deserialize is submitted to the reader pool up front and tables
+        are yielded in map order as they complete — the consumer can upload
+        block m while blocks m+1.. are still being read (reference
+        RapidsShuffleThreadedReaderBase). `map_ids` restricts to a subset of
+        maps (AQE skew slices)."""
 
         def read_one(map_id: int):
             p = self._path(shuffle_id, map_id, reduce_id)
@@ -116,12 +124,22 @@ class TpuShuffleManager:
                 return None
             with open(p, "rb") as f:
                 block = f.read()
-            self.bytes_read += len(block)
+            with self._stats_lock:
+                self.bytes_read += len(block)
             return deserialize_table(block)
 
         maps = range(n_maps) if map_ids is None else map_ids
         futures = [self._readers.submit(read_one, m) for m in maps]
-        return [t for t in (f.result() for f in futures) if t is not None]
+        for f in futures:
+            t = f.result()
+            if t is not None:
+                yield t
+
+    def read_partition(self, shuffle_id: int, reduce_id: int,
+                       n_maps: int, map_ids=None) -> List:
+        """Fetch one reduce partition's blocks from all maps in parallel."""
+        return list(self.iter_partition(shuffle_id, reduce_id, n_maps,
+                                        map_ids))
 
     def cleanup(self, shuffle_id: int) -> None:
         shutil.rmtree(os.path.join(self.root, f"shuffle_{shuffle_id}"),

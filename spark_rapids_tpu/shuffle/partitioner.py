@@ -72,8 +72,15 @@ def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[T
     the pipeline for the full round trip: the copy starts immediately
     after the searchsorted is enqueued, overlapping the transfer with
     dispatch of the sort/gather work already in flight."""
-    cap = batch.capacity
     order, bounds_dev = _split_plan(pids, batch.num_rows, n=n)
+    return split_with_plan(batch, order, bounds_dev, n)
+
+
+def split_with_plan(batch: TpuColumnarBatch, order, bounds_dev,
+                    n: int) -> List[Optional[TpuColumnarBatch]]:
+    """Slice a batch along an already-computed (order, bounds) split plan
+    (from _split_plan or the fused opjit.partition_split_plan program)."""
+    cap = batch.capacity
     try:
         bounds_dev.copy_to_host_async()
     except AttributeError:  # older jax arrays: np.asarray below still works
@@ -90,6 +97,23 @@ def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[T
                                        0, cap - 1))
         out.append(gather(batch, idx, cnt, bucket_capacity(cnt)))
     return out
+
+
+def hash_split_parts(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
+                     n: int, ctx, seed: int = 42,
+                     metrics=None) -> List[Optional[TpuColumnarBatch]]:
+    """Hash-partition a batch into n slices with the ENCODE+SPLIT pair fused
+    into one cached executable when the keys trace (opjit.partition_split_plan
+    — one dispatch instead of pids + split plan); eager two-program path
+    otherwise, bit-identical either way."""
+    from ..execs import opjit
+    plan = opjit.partition_split_plan(batch, key_exprs, n, ctx.eval_ctx,
+                                      seed, metrics)
+    if plan is not None:
+        return split_with_plan(batch, plan[0], plan[1], n)
+    pids = hash_partition_ids(batch, key_exprs, n, ctx, seed=seed,
+                              metrics=metrics)
+    return split_by_partition(batch, pids, n)
 
 
 def np_hash_partition_ids(table, key_exprs, n: int, ctx) -> np.ndarray:

@@ -191,6 +191,41 @@ def test_ansi_mode_stays_correct():
         df.select((F.col("a") + F.col("b")).alias("x")).collect()
 
 
+def test_dispatch_accounting_segments_not_operators():
+    """Dispatch accounting (docs/configs.md): with stage fusion on, a fused
+    project/filter chain dispatches ONE cached "segment" program per batch;
+    with fusion off the same chain pays one "project"/"filter" program per
+    operator per batch."""
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=2)
+        return (df.filter(F.col("w") % 2 == 0)
+                .withColumn("x", F.col("v") * 2 + 1)
+                .withColumn("y", F.col("x") + F.col("w"))
+                .select("k", "x", "y").collect())
+
+    def kinds(fuse: bool):
+        opjit.clear_cache()
+        conf = dict(_BASE_CONF)
+        conf["spark.rapids.tpu.opjit.fuseStages"] = str(fuse).lower()
+        before = opjit.cache_stats()["calls_by_kind"]
+        out = build(TpuSession(conf))
+        after = opjit.cache_stats()["calls_by_kind"]
+        return out, {k: after.get(k, 0) - before.get(k, 0)
+                     for k in set(after) | set(before)
+                     if after.get(k, 0) != before.get(k, 0)}
+
+    fused_out, fused = kinds(True)
+    perop_out, perop = kinds(False)
+    assert fused_out == perop_out
+    # 2 batches through a 4-op chain: 2 segment dispatches total vs one
+    # filter + computed-project dispatch per operator per batch
+    assert fused.get("segment") == 2
+    assert "project" not in fused and "filter" not in fused
+    assert "segment" not in perop
+    assert perop.get("filter", 0) == 2 and perop.get("project", 0) >= 4
+    assert sum(fused.values()) < sum(perop.values())
+
+
 def test_metrics_registered_on_tpu_execs():
     """Every TpuExec carries the opjit metric taxonomy (execs/base.py)."""
     from spark_rapids_tpu.execs.base import TpuExec

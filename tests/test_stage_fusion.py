@@ -1,0 +1,286 @@
+"""Whole-stage segment fusion (execs/fusion.py): plan-pass shape, one
+dispatch per batch per fused segment (dispatch accounting), bit-parity vs the
+per-operator opjit path and vs fully-eager execution, host-assisted operators
+splitting the segment, and degradation toggles."""
+
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs import opjit
+from spark_rapids_tpu.execs.fusion import TpuFusedSegmentExec
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.planner import plan_physical
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    opjit.clear_cache()
+    yield
+    opjit.clear_cache()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_manager():
+    """Swap in a fresh shuffle manager so these tests get the uncompressed
+    codec even when an earlier suite test latched the singleton with zstd
+    (unavailable in some envs)."""
+    import shutil
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    with TpuShuffleManager._lock:
+        old = TpuShuffleManager._instance
+        TpuShuffleManager._instance = None
+    yield
+    with TpuShuffleManager._lock:
+        cur = TpuShuffleManager._instance
+        TpuShuffleManager._instance = old
+    if cur is not None and cur is not old:
+        shutil.rmtree(cur.root, ignore_errors=True)
+
+
+_ROWS = [
+    {"k": i % 5, "v": None if i % 6 == 0 else float(i) * 0.25,
+     "s": None if i % 9 == 0 else f"s{i % 4}",
+     "w": None if i % 11 == 0 else i}
+    for i in range(300)
+]
+
+_BASE_CONF = {
+    "spark.rapids.tpu.agg.compiledStage.enabled": "false",
+    "spark.rapids.tpu.join.compiledStage.enabled": "false",
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.sql.shuffle.partitions": "3",
+    "spark.rapids.shuffle.compression.codec": "none",
+}
+
+
+def _conf(**kv) -> dict:
+    c = dict(_BASE_CONF)
+    c.update({k.replace("__", "."): v for k, v in kv.items()})
+    return c
+
+
+def _kind_delta(before, after) -> dict:
+    b = before["calls_by_kind"]
+    a = after["calls_by_kind"]
+    return {k: a.get(k, 0) - b.get(k, 0) for k in set(a) | set(b)
+            if a.get(k, 0) != b.get(k, 0)}
+
+
+def _chain(s, parts=2):
+    df = s.createDataFrame(_ROWS, num_partitions=parts)
+    return (df.filter((F.col("w") % 2 == 0) | F.col("v").isNull())
+            .withColumn("x", F.col("v") * 2 + 1)
+            .withColumn("y", F.col("x") + F.col("w"))
+            .select("k", "x", "y", "s", "w"))
+
+
+# ---------------------------------------------------------------------------
+# plan pass
+# ---------------------------------------------------------------------------
+
+
+def _final_plan(q, conf_dict):
+    conf = RapidsConf(conf_dict)
+    return TpuOverrides.apply(plan_physical(q._plan, conf), conf)
+
+
+def test_chain_collapses_into_one_segment():
+    s = TpuSession(_conf())
+    final = _final_plan(_chain(s), _conf())
+    segs = [n for n in final.collect_nodes()
+            if isinstance(n, TpuFusedSegmentExec)]
+    assert len(segs) == 1
+    # filter + 2 withColumn projects + select project
+    assert len(segs[0]._ops) == 4
+    assert "TpuFusedSegment" in final.tree_string()
+
+
+def test_fuse_stages_off_keeps_per_operator_plan():
+    for key in ("spark.rapids.tpu.opjit.fuseStages",
+                "spark.rapids.tpu.opjit.enabled"):
+        c = _conf(**{key.replace(".", "__"): "false"})
+        s = TpuSession(c)
+        final = _final_plan(_chain(s), c)
+        assert not [n for n in final.collect_nodes()
+                    if isinstance(n, TpuFusedSegmentExec)]
+
+
+def test_single_op_is_not_fused():
+    s = TpuSession(_conf())
+    q = s.createDataFrame(_ROWS).filter(F.col("k") > 1)
+    final = _final_plan(q, _conf())
+    assert not [n for n in final.collect_nodes()
+                if isinstance(n, TpuFusedSegmentExec)]
+
+
+# ---------------------------------------------------------------------------
+# dispatch accounting: a fused segment dispatches ONCE per batch
+# ---------------------------------------------------------------------------
+
+
+def test_segment_dispatches_once_per_batch():
+    s = TpuSession(_conf())
+    before = opjit.cache_stats()
+    out = _chain(s, parts=2).collect()  # 2 partitions → 2 batches
+    delta = _kind_delta(before, opjit.cache_stats())
+    assert out
+    # the whole 4-operator chain is device-pure (strings are passthrough):
+    # exactly one segment dispatch per batch, NO per-operator dispatches
+    assert delta.get("segment") == 2
+    assert "project" not in delta and "filter" not in delta
+
+
+def test_fused_dispatch_count_below_per_operator_baseline():
+    s_on = TpuSession(_conf())
+    before = opjit.cache_stats()
+    on = _chain(s_on).collect()
+    d_on = _kind_delta(before, opjit.cache_stats())
+
+    s_off = TpuSession(_conf(spark__rapids__tpu__opjit__fuseStages="false"))
+    before = opjit.cache_stats()
+    off = _chain(s_off).collect()
+    d_off = _kind_delta(before, opjit.cache_stats())
+
+    assert on == off
+    # fusion: one dispatch per batch per SEGMENT; per-op: one per OPERATOR
+    assert sum(d_on.values()) < sum(d_off.values())
+    assert "segment" not in d_off
+    assert d_off.get("filter", 0) >= 2 and d_off.get("project", 0) >= 2
+
+
+def test_fused_segment_cache_hits_across_batches():
+    s = TpuSession(_conf())
+    _chain(s, parts=2).collect()
+    s1 = opjit.cache_stats()
+    assert s1["traces"] >= 1
+    _chain(s, parts=2).collect()  # same shapes: pure hits, no new trace
+    s2 = opjit.cache_stats()
+    assert s2["traces"] == s1["traces"]
+    assert s2["hits"] > s1["hits"]
+
+
+# ---------------------------------------------------------------------------
+# parity: fusion on vs off vs fully eager
+# ---------------------------------------------------------------------------
+
+
+def _parity(build):
+    opjit.clear_cache()
+    on = build(TpuSession(_conf()))
+    off = build(TpuSession(_conf(
+        spark__rapids__tpu__opjit__fuseStages="false")))
+    eager = build(TpuSession(_conf(
+        spark__rapids__tpu__opjit__enabled="false")))
+    assert on == off
+    assert on == eager
+    return on
+
+
+def test_parity_project_filter_chain():
+    out = _parity(lambda s: _chain(s).collect())
+    assert len(out) > 0
+
+
+def test_parity_filters_only_chain():
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=2)
+        return (df.filter(F.col("w") % 2 == 0)
+                .filter(F.col("v") > 1.0).collect())
+    out = _parity(build)
+    assert len(out) > 0
+
+
+def test_parity_null_predicate_drops_rows():
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=1)
+        # w % 2 == 0 is NULL where w is null → those rows drop
+        return (df.filter(F.col("w") % 2 == 0)
+                .withColumn("x", F.col("w") * 3).collect())
+    out = _parity(build)
+    assert all(r["w"] is not None for r in out)
+
+
+def test_parity_string_passthrough_through_filtered_segment():
+    """String columns bypass the traced program but still compact with the
+    segment's keep mask."""
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=2)
+        return (df.filter(F.col("k") >= 2)
+                .withColumn("x", F.col("v") + 0.5)
+                .select("s", "x", "k").collect())
+    out = _parity(build)
+    assert any(r["s"] is not None for r in out)
+
+
+def test_parity_downstream_aggregate_over_fused_segment():
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=2)
+        return (df.filter(F.col("k") > 0)
+                .withColumn("x", F.col("v") * 2)
+                .groupBy("k")
+                .agg(F.sum(F.col("x")).alias("sx"),
+                     F.count(F.col("w")).alias("cw"))).collect()
+    out = _parity(build)
+    assert len(out) == 4
+
+
+# ---------------------------------------------------------------------------
+# host-assisted split + degradation
+# ---------------------------------------------------------------------------
+
+
+def test_host_assisted_op_splits_segment():
+    """A computed string column (device-unfusable operator) mid-chain: the
+    prefix and suffix still run as fused programs, the offending operator
+    degrades to its per-operator path, results bit-identical to eager."""
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=1)
+        return (df.filter(F.col("k") > 0)
+                .withColumn("x", F.col("v") * 2)
+                .withColumn("y", F.concat(F.col("s"), F.lit("_t")))
+                .withColumn("z", F.col("x") + 1)
+                .select("k", "x", "y", "z").collect())
+    opjit.clear_cache()
+    before = opjit.cache_stats()
+    on = build(TpuSession(_conf()))
+    delta = _kind_delta(before, opjit.cache_stats())
+    eager = build(TpuSession(_conf(
+        spark__rapids__tpu__opjit__enabled="false")))
+    assert on == eager
+    # segment programs ran for the fusable prefix (filter+project)
+    assert delta.get("segment", 0) >= 1
+
+
+def test_pure_column_reorder_needs_no_dispatch():
+    """A fused run of pure passthroughs (select reorder after a projection)
+    splices columns without any program dispatch."""
+    def build(s):
+        df = s.createDataFrame(_ROWS, num_partitions=1)
+        return (df.withColumn("x", F.col("v") * 2)
+                .select("x", "k").select("k", "x").collect())
+    out = _parity(build)
+    assert len(out) == len(_ROWS)
+
+
+def test_ansi_mode_still_raises_through_fusion():
+    """ANSI overflow checks host-sync inside eval: the segment trace fails,
+    the fingerprint pins eager, and ANSI semantics survive fusion."""
+    rows = [{"a": 2**62, "b": 2**62}]
+    conf = _conf(spark__sql__ansi__enabled="true")
+    s = TpuSession(conf)
+    df = s.createDataFrame(rows, num_partitions=1)
+    with pytest.raises(Exception):
+        (df.filter(F.col("a") > 0)
+         .select((F.col("a") + F.col("b")).alias("x")).collect())
+
+
+def test_fused_segment_metrics_registered():
+    s = TpuSession(_conf())
+    final = _final_plan(_chain(s), _conf())
+    seg = next(n for n in final.collect_nodes()
+               if isinstance(n, TpuFusedSegmentExec))
+    for name in ("opJitCacheHits", "opJitCacheMisses", "opJitTraceTime",
+                 "opFusedBatches", "opFusedFallbackOps"):
+        assert name in seg.metrics
