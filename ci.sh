@@ -17,6 +17,15 @@ echo "ok"
 echo "== compile check =="
 python -m compileall -q spark_rapids_tpu tools benchmarks tests bench.py __graft_entry__.py
 
+echo "== tracelint (trace-safety & registry consistency) =="
+# Static analyzer (docs/analysis.md): eval_tpu implementations vs the
+# plan/typechecks.py host_assisted declarations, registry drift, and the
+# unlocked-module-state concurrency lint. Fails on any finding not in
+# tools/tracelint_baseline.txt. The docs-drift gate above doubles as the
+# freshness gate for the analyzer-sourced execution-mode column in
+# docs/supported_ops.md.
+python -m tools.tracelint
+
 echo "== fast tier-1 gate (not slow) =="
 # Fail fusion/pipelining regressions in minutes, before the full suite: the
 # hot general-path surface (opjit cache, stage fusion, pipelined shuffle,
@@ -24,7 +33,7 @@ echo "== fast tier-1 gate (not slow) =="
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
-  tests/test_shuffle.py \
+  tests/test_shuffle.py tests/test_tracelint.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== tests (+ leak gate) =="

@@ -1,0 +1,38 @@
+"""Trace-safety & registry-consistency static analysis (tracelint).
+
+Public surface for tools/tracelint.py, tools/gen_docs.py and the tests:
+
+* :func:`analyze_registry` — classify every registered expression's
+  ``eval_tpu`` and cross-check against plan/typechecks.py (TL001–TL004).
+* :func:`lint_tree` — concurrency lint over shuffle/, memory/, execs/
+  (TL010).
+* :func:`corroborate` — dynamic ``jax.eval_shape`` probe vs the static
+  verdicts (TL005).
+* :func:`scan_source` / :func:`scan_function` — detector layer over raw
+  source (test fixtures, kernel modules).
+* :func:`execution_modes` — per-expression execution-mode strings for
+  docs/supported_ops.md.
+
+See docs/analysis.md for the verdict taxonomy and the baseline workflow.
+"""
+
+from .astwalk import (CONDITIONAL_HOST, DEVICE, HOST, UNTRACEABLE, Detection,
+                      FunctionReport, ModuleIndex, worst)
+from .concurrency import lint_module_source, lint_tree
+from .detectors import DETECTOR_IDS, scan_function, scan_source
+from .registry_check import (ExprReport, Finding, analyze_registry,
+                             classify_class, execution_modes)
+
+__all__ = [
+    "CONDITIONAL_HOST", "DEVICE", "HOST", "UNTRACEABLE", "Detection",
+    "DETECTOR_IDS", "ExprReport", "Finding", "FunctionReport", "ModuleIndex",
+    "analyze_registry", "classify_class", "corroborate", "execution_modes",
+    "lint_module_source", "lint_tree", "scan_function", "scan_source",
+    "worst",
+]
+
+
+def corroborate(reports):
+    # jax import deferred: the static passes must work without touching jax
+    from .probe import corroborate as _c
+    return _c(reports)

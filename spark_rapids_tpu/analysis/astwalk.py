@@ -1,0 +1,545 @@
+"""AST machinery for tracelint: taint tracking + conditionality analysis.
+
+The reference plugin catches declaration drift at build time with dedicated
+static tooling (api_validation/ApiValidation.scala compares shim constructor
+signatures; TypeChecks.scala is the single source of truth behind
+supported_ops.md).  Our equivalent hazard after the opjit/fusion PRs is a
+*performance* cliff: `plan/typechecks.py` declarations decide where
+execs/opjit.py and execs/fusion.py split traces, and nothing checked the
+declarations against the ~20 modules of actual `eval_tpu` implementations.
+
+This module provides the shared walking machinery the detectors build on:
+
+* **Taint** — which local names hold *device values*, with three kinds:
+  ``COL`` (TpuColumnVector/TpuScalar results of ``eval_tpu`` /
+  ``batch.column``), ``ARR`` (jax arrays: ``.data``/``.validity``/
+  ``.offsets`` reads, jnp results over tainted inputs) and ``SEQ`` (a python
+  container *of* device values — iterating one is a loop over columns, not a
+  per-row loop).  Host-boundary ops are findings only when they consume a
+  COL/ARR: ``np.asarray(lut)`` over a host table is fine,
+  ``np.asarray(col.data)`` is a device→host sync.
+* **Conditionality** — whether a statement runs on *every* execution of the
+  function or only behind a branch.  The dominant idiom in expressions/ is a
+  guarded device path with a host tail::
+
+      if _ascii_dev(c):
+          ...device kernel...
+          return device_result
+      return _string_result_from_arrow(...)   # conditional: behind the guard
+
+  so code after an ``if`` whose body always returns/raises is the implicit
+  ``else`` (conditional), as are ternary (``IfExp``) arms.
+* **Scalar-fold untainting** — inside ``if isinstance(x, TpuScalar):`` the
+  guarded names are host scalars; host work there is the constant-fold idiom
+  (base.BinaryExpression) and never touches the device.
+* **Helper/method summaries** — module functions and same-module class
+  methods get (host-grade, returns-device, string-layout) summaries so call
+  sites grade `_to_arrow_side(...)` or ``self._host_from_vals(...)``
+  without inter-procedural dataflow.
+
+Pure stdlib `ast`; never imports the analyzed module.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+# Verdicts, ordered from best to worst. `worst()` picks the max.
+DEVICE = "device"                    # no host patterns at all: traceable
+CONDITIONAL_HOST = "conditional-host"  # host work only behind branches
+HOST = "host"                        # host boundary on every execution
+UNTRACEABLE = "untraceable"          # value-dependent control flow / row loops
+
+_VERDICT_RANK = {DEVICE: 0, CONDITIONAL_HOST: 1, HOST: 2, UNTRACEABLE: 3}
+
+# taint kinds
+COL = "col"    # TpuColumnVector / TpuScalar
+ARR = "arr"    # jax array (.data / .validity / jnp result)
+SEQ = "seq"    # python container of device values
+
+DEVICE_KINDS = (COL, ARR)
+
+
+def worst(*verdicts: str) -> str:
+    return max(verdicts, key=_VERDICT_RANK.__getitem__, default=DEVICE)
+
+
+#: attribute reads that are *structural* (static under jax tracing), so the
+#: result of `tainted.attr` is NOT a device value
+STRUCT_ATTRS = frozenset((
+    "dtype", "shape", "ndim", "size", "num_rows", "capacity", "nullable",
+    "name", "names", "precision", "scale", "np_dtype", "fields",
+    "is_null", "value", "host_data", "host_capacity", "element_type",
+    "key_type", "value_type",
+))
+
+#: attribute reads yielding device arrays off a device column
+DEVICE_ARRAY_ATTRS = frozenset(("data", "validity", "offsets"))
+
+#: attribute reads yielding nested device columns off a device column.
+#: NOTE: `.children` is deliberately absent — on Expression nodes it is the
+#: subexpression tuple (host objects), and that reading dominates.
+DEVICE_COL_ATTRS = frozenset(("child",))
+
+#: calls whose results are never device values (and whose arguments are
+#: inspected structurally, not by value)
+EXEMPT_CALLS = frozenset((
+    "isinstance", "issubclass", "hasattr", "getattr", "setattr", "type",
+    "len", "callable", "repr", "id", "super", "range", "enumerate",
+    "sorted", "print", "str",
+))
+
+#: host coercions: calling one of these on a device value syncs it to host
+COERCION_CALLS = frozenset(("bool", "int", "float", "complex"))
+
+#: method calls that cross the device→host boundary when the receiver is a
+#: device value
+HOST_METHODS = frozenset((
+    "to_arrow", "to_numpy", "to_pylist", "as_py", "item", "tolist",
+    "block_until_ready",
+))
+
+#: parameter names that are scalars/metadata, never device values, when
+#: seeding helper analysis
+SCALAR_PARAM_NAMES = frozenset((
+    "self", "cls", "ctx", "conf", "n", "num_rows", "cap", "capacity",
+    "seed", "name", "dtype", "dt", "scale", "precision", "idx", "i", "j",
+    "ordinal", "path", "fmt", "pattern", "tz", "level", "default", "sep",
+    "limit", "kind", "mode", "template", "out_names", "key", "keys_dtype",
+    "expr", "e", "fn", "f", "pick", "op", "cmp_expr", "num_bits",
+))
+
+#: parameter names that are containers of device values
+SEQ_PARAM_NAMES = frozenset((
+    "cols", "columns", "vals", "values", "arrays", "parts", "exprs",
+    "children", "batches", "leaves", "sides", "axes", "kids", "args",
+))
+
+
+def parse_module(source: str, path: str = "<string>") -> ast.Module:
+    return ast.parse(source, filename=path)
+
+
+@dataclass
+class Detection:
+    """One raw detector hit inside a function body."""
+    detector: str
+    line: int
+    snippet: str
+    conditional: bool
+    message: str
+
+
+@dataclass
+class FunctionReport:
+    """Detector output for one function body."""
+    qualname: str
+    detections: List[Detection] = field(default_factory=list)
+    #: function reads ragged/string/nested layout off its inputs
+    #: (`.offsets`, `.child`, string-kernel helpers) — such expressions never
+    #: pass the opjit gate, so declaration conflicts are doc-mode findings,
+    #: not perf errors
+    string_layout: bool = False
+
+    @property
+    def verdict(self) -> str:
+        v = DEVICE
+        for d in self.detections:
+            if d.detector in UNSAFE_DETECTORS:
+                step = UNTRACEABLE if not d.conditional else CONDITIONAL_HOST
+            else:
+                step = HOST if not d.conditional else CONDITIONAL_HOST
+            v = worst(v, step)
+        return v
+
+
+#: detectors whose *unconditional* hit means "cannot trace at all" rather
+#: than "syncs to host" (the distinction only affects reporting text)
+UNSAFE_DETECTORS = frozenset(("value-dependent-branch", "per-row-loop"))
+
+
+@dataclass
+class HelperSummary:
+    """Summary of a module helper / same-module method used at call sites."""
+    host_grade: Optional[str] = None   # None | CONDITIONAL_HOST | HOST
+    returns_device: bool = False
+    string_layout: bool = False
+
+    def merge(self, other: "HelperSummary") -> "HelperSummary":
+        grades = [g for g in (self.host_grade, other.host_grade) if g]
+        return HelperSummary(
+            host_grade=worst(*grades) if grades else None,
+            returns_device=self.returns_device or other.returns_device,
+            string_layout=self.string_layout or other.string_layout)
+
+
+def seed_params(fn: ast.FunctionDef) -> Dict[str, str]:
+    """Taint seeds for analyzing a helper/method in isolation: device-ish
+    params by default, with name heuristics for scalars and containers."""
+    seeds: Dict[str, str] = {}
+    for a in fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs:
+        if a.arg in SCALAR_PARAM_NAMES:
+            continue
+        seeds[a.arg] = SEQ if a.arg in SEQ_PARAM_NAMES else COL
+    return seeds
+
+
+class ModuleIndex:
+    """Per-module context: imports, helper/method summaries, lock names."""
+
+    def __init__(self, source: str, path: str = "<string>"):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = parse_module(source, path)
+        self.import_aliases: Dict[str, str] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.lock_names: Set[str] = set()
+        self.helpers: Dict[str, HelperSummary] = {}
+        #: same-module class methods merged by bare name (conservative on
+        #: collisions); eval-path methods excluded — they are the analysis
+        #: TARGETS, not helpers
+        self.methods: Dict[str, HelperSummary] = {}
+        self._collect()
+        self._summarize()
+
+    # -- collection --------------------------------------------------------
+    def _collect(self) -> None:
+        # imports anywhere (expressions/ commonly imports pyarrow inside
+        # function bodies)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name] = f"{mod}.{a.name}"
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+            elif isinstance(node, ast.Assign):
+                if _is_lock_ctor(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.lock_names.add(t.id)
+
+    def root_module(self, name: str) -> str:
+        """Resolve a local name to its imported dotted origin ('' if local)."""
+        return self.import_aliases.get(name, "")
+
+    def snippet(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()[:120]
+        return ""
+
+    # -- summaries (two passes so helper-calls-helper propagates) ----------
+    _EXCLUDED_METHOD_NAMES = frozenset((
+        "eval_tpu", "eval_cpu", "_compute", "__init__", "dtype", "pretty",
+    ))
+
+    def _summarize(self) -> None:
+        from .detectors import scan_function  # detectors imports only astwalk
+        for _ in range(2):
+            for name, fn in self.functions.items():
+                self.helpers[name] = self._summary_of(fn, name, scan_function)
+            methods: Dict[str, HelperSummary] = {}
+            for cname, cls in self.classes.items():
+                for node in cls.body:
+                    if not isinstance(node, ast.FunctionDef) \
+                            or node.name in self._EXCLUDED_METHOD_NAMES:
+                        continue
+                    s = self._summary_of(node, f"{cname}.{node.name}",
+                                         scan_function)
+                    prev = methods.get(node.name)
+                    methods[node.name] = s if prev is None else prev.merge(s)
+            self.methods = methods
+
+    def _summary_of(self, fn: ast.FunctionDef, qualname: str,
+                    scan_function) -> HelperSummary:
+        rep = scan_function(fn, self, taint_seeds=seed_params(fn),
+                            qualname=qualname)
+        grade = None
+        if any(not d.conditional for d in rep.detections):
+            grade = HOST
+        elif rep.detections:
+            grade = CONDITIONAL_HOST
+        return HelperSummary(host_grade=grade,
+                             returns_device=_returns_device(fn, self),
+                             string_layout=rep.string_layout)
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in ("Lock", "RLock")
+            ) or (isinstance(f, ast.Name) and f.id in ("Lock", "RLock"))
+
+
+def _returns_device(fn: ast.FunctionDef, mod: "ModuleIndex") -> bool:
+    """Does any `return` expression carry a device value derived from the
+    (conservatively seeded) parameters?  Used so `if helper(col):` at a call
+    site can be recognized as a value-dependent branch."""
+    taint = TaintState(seed_params(fn), mod)
+    out = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node: ast.Assign):
+            taint.assign(node.targets, node.value)
+            self.generic_visit(node)
+
+        def visit_Return(self, node: ast.Return):
+            # SEQ counts: `return arr, valid` tuples unpack to device values
+            if node.value is not None \
+                    and taint.kind_of(node.value) is not None:
+                out[0] = True
+
+        def visit_FunctionDef(self, node: ast.FunctionDef):
+            return  # nested defs return separately
+
+    for st in fn.body:
+        V().visit(st)
+    return out[0]
+
+
+class TaintState:
+    """Forward name-level taint: which locals hold device values, by kind."""
+
+    def __init__(self, seeds: Dict[str, str], mod: ModuleIndex):
+        self.kinds: Dict[str, str] = dict(seeds)
+        self.mod = mod
+
+    # -- queries -----------------------------------------------------------
+    def is_device(self, node: ast.AST) -> bool:
+        return self.kind_of(node) in DEVICE_KINDS
+
+    def kind_of(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.kinds.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.kind_of(node.value)
+            if node.attr in DEVICE_ARRAY_ATTRS:
+                return ARR if base in DEVICE_KINDS else None
+            if node.attr in DEVICE_COL_ATTRS:
+                return COL if base in DEVICE_KINDS else None
+            if node.attr in STRUCT_ATTRS:
+                return None
+            return base
+        if isinstance(node, ast.Subscript):
+            base = self.kind_of(node.value)
+            if base == SEQ:
+                return COL
+            return base
+        if isinstance(node, ast.Call):
+            return self.call_kind(node)
+        if isinstance(node, ast.BinOp):
+            return _first_kind(self.kind_of(node.left),
+                               self.kind_of(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.kind_of(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return _first_kind(*(self.kind_of(v) for v in node.values))
+        if isinstance(node, ast.Compare):
+            # comparisons over device arrays yield device bool arrays; `is`
+            # / `is not` identity tests are structural host bools
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return None
+            return _first_kind(self.kind_of(node.left),
+                               *(self.kind_of(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return _first_kind(self.kind_of(node.body),
+                               self.kind_of(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            if any(self.kind_of(e) in DEVICE_KINDS for e in node.elts):
+                return SEQ
+            return None
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            sub = TaintState(dict(self.kinds), self.mod)
+            for gen in node.generators:
+                k = sub.kind_of(gen.iter)
+                sub._mark(gen.target, COL if k else None)
+            if sub.kind_of(node.elt) in DEVICE_KINDS:
+                return SEQ
+            return None
+        if isinstance(node, ast.Starred):
+            return self.kind_of(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.kind_of(node.value)
+        return None
+
+    def _args_device(self, node: ast.Call) -> bool:
+        return any(self.kind_of(a) in DEVICE_KINDS for a in node.args) or any(
+            k.value is not None and self.kind_of(k.value) in DEVICE_KINDS
+            for k in node.keywords)
+
+    def call_kind(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in EXEMPT_CALLS or f.id in COERCION_CALLS:
+                return None
+            if f.id in ("list", "tuple"):
+                return SEQ if self._args_device(node) or any(
+                    self.kind_of(a) == SEQ for a in node.args) else None
+            summary = self.mod.helpers.get(f.id)
+            if summary is not None:
+                return COL if summary.returns_device else None
+        if isinstance(f, ast.Attribute):
+            if f.attr == "eval_tpu":
+                return COL
+            if f.attr == "column" and self.kind_of(f.value) is None:
+                # batch.column(i) — `batch` is seeded COL at eval scan time,
+                # so kind_of(batch)=COL handles it; this arm covers
+                # untracked receivers conservatively as None
+                pass
+            if f.attr in HOST_METHODS:
+                return None  # result is a host value
+            root = _root_name(f)
+            if root is not None:
+                origin = self.mod.root_module(root)
+                if origin.startswith("jax") or root in ("jnp", "jax", "lax"):
+                    # jnp.* over runtime device data stays on device; jnp
+                    # over constants is a trace-time constant.  A SEQ arg
+                    # (jnp.concatenate([a, b])) carries device data too.
+                    if self._args_device(node) or any(
+                            self.kind_of(a) == SEQ for a in node.args):
+                        return ARR
+                    return None
+                if origin.startswith(("numpy", "pyarrow")):
+                    return None  # host result (the host *op* is the finding)
+            if self.kind_of(f.value) in DEVICE_KINDS:
+                # method on a device value (col.slice(...), arr.astype(...))
+                return self.kind_of(f.value)
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                summary = self.mod.methods.get(f.attr)
+                if summary is not None:
+                    return COL if summary.returns_device else None
+        # unknown callable: device args in, assume a device value out
+        return COL if self._args_device(node) else None
+
+    # -- updates -----------------------------------------------------------
+    def assign(self, targets: Sequence[ast.AST], value: ast.AST) -> None:
+        kind = self.kind_of(value)
+        if isinstance(value, (ast.Tuple, ast.List)) \
+                and len(targets) == 1 \
+                and isinstance(targets[0], (ast.Tuple, ast.List)) \
+                and len(targets[0].elts) == len(value.elts):
+            # parallel unpack: a, b = x.data, y  — per-element kinds
+            for t, v in zip(targets[0].elts, value.elts):
+                self._mark(t, self.kind_of(v))
+            return
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)) and kind in DEVICE_KINDS:
+                # tuple unpack of a device-producing call: all targets device
+                for e in t.elts:
+                    self._mark(e, kind)
+            else:
+                self._mark(t, kind)
+
+    def _mark(self, target: ast.AST, kind: Optional[str]) -> None:
+        if isinstance(target, ast.Name):
+            if kind:
+                self.kinds[target.id] = kind
+            else:
+                self.kinds.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mark(e, kind)
+        elif isinstance(target, ast.Starred):
+            self._mark(target.value, kind)
+        # attribute/subscript targets: no name-level tracking
+
+
+def _first_kind(*kinds: Optional[str]) -> Optional[str]:
+    for k in kinds:
+        if k in DEVICE_KINDS:
+            return k
+    for k in kinds:
+        if k:
+            return k
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost name of a dotted access (`pc.utf8_upper` -> 'pc')."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def terminates(body: Sequence[ast.stmt]) -> bool:
+    """All paths through `body` leave the function/loop (return/raise/
+    continue/break)."""
+    for st in body:
+        if isinstance(st, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+            return True
+        if isinstance(st, ast.If) and st.orelse \
+                and terminates(st.body) and terminates(st.orelse):
+            return True
+    return False
+
+
+def may_terminate(body: Sequence[ast.stmt]) -> bool:
+    """SOME path through `body` leaves the function — code after an `if`
+    with such a body is not on every path (conditional).  Nested defs don't
+    count: their returns leave the closure, not this function."""
+
+    class _V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):  # don't descend into closures
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Return(self, node):
+            self.found = True
+
+        visit_Raise = visit_Return
+
+    v = _V()
+    for st in body:
+        v.visit(st)
+    return v.found
+
+
+def isinstance_scalar_names(test: ast.AST) -> Set[str]:
+    """Names proven to be TpuScalar by `isinstance(x, TpuScalar)` tests
+    (possibly `and`-joined).  Inside such a branch the names hold host
+    scalars, so host work on them is the constant-fold idiom, not a sync."""
+    names: Set[str] = set()
+
+    def scalar_check(call: ast.AST) -> Optional[str]:
+        if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Name)
+                and call.func.id == "isinstance" and len(call.args) == 2):
+            return None
+        target, klass = call.args
+        if not isinstance(target, ast.Name):
+            return None
+        kls = [klass] if not isinstance(klass, ast.Tuple) else list(klass.elts)
+        for k in kls:
+            nm = k.attr if isinstance(k, ast.Attribute) else (
+                k.id if isinstance(k, ast.Name) else None)
+            if nm == "TpuScalar":
+                return target.id
+        return None
+
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        for v in test.values:
+            n = scalar_check(v)
+            if n:
+                names.add(n)
+    else:
+        n = scalar_check(test)
+        if n:
+            names.add(n)
+    return names

@@ -1,0 +1,214 @@
+"""Concurrency lint: module-level mutable state mutated outside a lock.
+
+PR 2's pipelined shuffle turned the general path multi-threaded, and the
+bugs it actually hit were exactly this shape: a module-level dict/OrderedDict
+(`opjit._CACHE`, metric accumulators, the semaphore wait counters) mutated
+from pool threads without the module's lock.  This pass finds the pattern
+statically (rule **TL010**, error — baseline the deliberate ones with a
+comment):
+
+* a module-level name bound to a mutable container (dict/list/set literal,
+  ``dict()``/``list()``/``set()``/``OrderedDict()``/``defaultdict()``/
+  ``deque()``) in ``shuffle/``, ``memory/`` or ``execs/``;
+* a function/method in the same module that mutates it — subscript store,
+  ``del``, augmented assignment, or a mutating method call (``append``,
+  ``update``, ``pop``, ``clear``, ...) — with no enclosing ``with`` on a
+  lock (a module-level ``threading.Lock``/``RLock`` or any context-manager
+  whose name looks lock-ish: contains "lock" or ends in ``_mu``).
+
+Module top-level statements (import-time initialization, single-threaded by
+construction) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .astwalk import ModuleIndex
+from .registry_check import Finding
+
+#: packages the lint covers (relative to the spark_rapids_tpu package root)
+DEFAULT_SUBPACKAGES = ("shuffle", "memory", "execs")
+
+_MUTABLE_CTORS = frozenset((
+    "dict", "list", "set", "OrderedDict", "defaultdict", "deque", "Counter",
+))
+
+_MUTATING_METHODS = frozenset((
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "popleft", "appendleft", "clear", "remove", "discard", "setdefault",
+    "sort", "reverse", "move_to_end",
+))
+
+
+def _is_mutable_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if _is_mutable_ctor(value):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or "mutex" in low or low.endswith("_mu") \
+        or low == "_mu"
+
+
+class _FnLint(ast.NodeVisitor):
+    """Walk one function keeping a stack of held locks."""
+
+    def __init__(self, mutables: Set[str], lock_names: Set[str],
+                 mod: ModuleIndex, qualname: str,
+                 findings: List[Finding], relpath: str):
+        self.mutables = mutables
+        self.lock_names = lock_names
+        self.mod = mod
+        self.qualname = qualname
+        self.findings = findings
+        self.relpath = relpath
+        self.lock_depth = 0
+
+    # -- lock scoping ----------------------------------------------------
+    def visit_With(self, node: ast.With):
+        locked = any(self._is_lock_expr(i.context_expr) for i in node.items)
+        if locked:
+            self.lock_depth += 1
+        for st in node.body:
+            self.visit(st)
+        if locked:
+            self.lock_depth -= 1
+
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):  # with lock.acquire_timeout(...) etc.
+            expr = expr.func
+        if isinstance(expr, ast.Name):
+            return expr.id in self.lock_names or _lockish(expr.id)
+        if isinstance(expr, ast.Attribute):
+            return _lockish(expr.attr)
+        return False
+
+    # -- mutations -------------------------------------------------------
+    def _flag(self, node: ast.AST, name: str, how: str) -> None:
+        if self.lock_depth:
+            return
+        self.findings.append(Finding(
+            "TL010", "error",
+            f"{self.relpath}::{self.qualname}",
+            f"module-level mutable `{name}` {how} outside a lock "
+            f"(line {getattr(node, 'lineno', '?')}) — pool threads race on "
+            f"it; guard with the module lock or baseline with a comment"))
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._check_store_target(node, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        t = node.target
+        if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name) \
+                and t.value.id in self.mutables:
+            self._flag(node, t.value.id, "augmented in place")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            self._check_store_target(node, t)
+        self.generic_visit(node)
+
+    def _check_store_target(self, node: ast.AST, target: ast.AST) -> None:
+        if isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id in self.mutables:
+            self._flag(node, target.value.id, "written by subscript")
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.mutables:
+            self._flag(node, f.value.id, f"mutated via .{f.attr}()")
+        self.generic_visit(node)
+
+    def run_body(self, fn: ast.FunctionDef) -> None:
+        """Lint the function's statements (not the def node itself, so the
+        nested-def skip below doesn't swallow the whole body)."""
+        for st in fn.body:
+            self.visit(st)
+
+    # don't descend into nested defs with the current lock state —
+    # "closures run under the caller's lock" is NOT a safe assumption, so
+    # they are linted as their own (unlocked) scope by the module walk
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def lint_module_source(source: str, relpath: str) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        mod = ModuleIndex(source, relpath)
+    except SyntaxError:
+        return findings
+    mutables = _module_mutables(mod.tree)
+    if not mutables:
+        return findings
+    lock_names = set(mod.lock_names)
+
+    def walk_fns(body: Iterable[ast.stmt], prefix: str) -> None:
+        for node in body:
+            if isinstance(node, ast.FunctionDef):
+                qual = f"{prefix}{node.name}"
+                _FnLint(mutables, lock_names, mod, qual, findings,
+                        relpath).run_body(node)
+                walk_fns(node.body, f"{qual}.")
+            elif isinstance(node, ast.ClassDef):
+                walk_fns(node.body, f"{prefix}{node.name}.")
+
+    walk_fns(mod.tree.body, "")
+    return findings
+
+
+def lint_tree(root: Optional[str] = None,
+              subpackages: Tuple[str, ...] = DEFAULT_SUBPACKAGES
+              ) -> List[Finding]:
+    """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    for sub in subpackages:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(d, fname)
+            with open(path) as f:
+                src = f.read()
+            findings.extend(lint_module_source(src, f"{sub}/{fname}"))
+    return findings

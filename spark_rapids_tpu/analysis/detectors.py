@@ -1,0 +1,342 @@
+"""Host-boundary / trace-unsafety detectors over one function body.
+
+Each detector has a stable id (used in findings, baselines and docs):
+
+==========================  ==================================================
+id                          fires on
+==========================  ==================================================
+``np-on-device``            ``np.*`` / ``numpy.*`` call consuming a device
+                            value (``np.asarray(col.data)`` syncs to host)
+``device-get``              ``jax.device_get(...)`` (explicit download)
+``host-method``             ``.to_arrow()`` / ``.to_numpy()`` / ``.to_pylist()``
+                            / ``.as_py()`` / ``.item()`` / ``.tolist()`` /
+                            ``.block_until_ready()`` on a device value
+``pyarrow-on-device``       ``pa.*`` / ``pc.*`` call consuming a device value
+``py-coercion``             ``bool()/int()/float()`` of a device value (the
+                            implicit ``TracerBoolConversionError`` sites)
+``value-dependent-branch``  Python ``if``/``while`` whose test reads a raw
+                            device value (data-dependent control flow)
+``per-row-loop``            Python ``for``/comprehension iterating a device
+                            array row by row (iterating a python list OF
+                            columns is fine and does not fire)
+``host-helper-call``        call of a module helper / same-module method that
+                            itself crosses the host boundary
+                            (e.g. ``_to_arrow_side``, ``self._host_from_vals``)
+==========================  ==================================================
+
+A hit is *conditional* when the statement only runs behind a branch, a
+ternary arm, an except handler, or the implicit else of a guard that
+returns.  Verdict impact (astwalk.FunctionReport.verdict): unconditional
+host hit ⇒ ``host``; conditional-only hits ⇒ ``conditional-host``;
+unconditional branch/loop unsafety ⇒ ``untraceable``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence
+
+from .astwalk import (COERCION_CALLS, COL, DEVICE_KINDS, EXEMPT_CALLS,
+                      HOST, HOST_METHODS, Detection, FunctionReport,
+                      ModuleIndex, TaintState, _root_name,
+                      isinstance_scalar_names, may_terminate, seed_params)
+
+#: detector ids in documentation order
+DETECTOR_IDS = (
+    "np-on-device", "device-get", "host-method", "pyarrow-on-device",
+    "py-coercion", "value-dependent-branch", "per-row-loop",
+    "host-helper-call",
+)
+
+#: helper names marking the function as operating on ragged string/array
+#: layouts (never admitted by the opjit gate), wherever they are defined
+_STRING_LAYOUT_HELPERS = frozenset((
+    "_dev_str", "_ascii_dev", "_sl", "_to_arrow_side",
+    "_string_result_from_arrow", "_bool_result_from_arrow",
+    "starts_lengths", "_expand_list", "_fixed_list", "_eval_list",
+    "_compact_list", "_result_from_pylist",
+))
+
+
+class _Scanner:
+    def __init__(self, fn: ast.FunctionDef, mod: ModuleIndex,
+                 taint_seeds: Dict[str, str], qualname: str):
+        self.fn = fn
+        self.mod = mod
+        self.taint = TaintState(dict(taint_seeds), mod)
+        self.report = FunctionReport(qualname=qualname)
+
+    # ------------------------------------------------------------------
+    def run(self) -> FunctionReport:
+        self._stmts(self.fn.body, cond=False)
+        return self.report
+
+    def _hit(self, detector: str, node: ast.AST, cond: bool, msg: str) -> None:
+        self.report.detections.append(Detection(
+            detector=detector, line=getattr(node, "lineno", 0),
+            snippet=self.mod.snippet(node), conditional=cond, message=msg))
+
+    # -- statements ----------------------------------------------------
+    def _stmts(self, body: Sequence[ast.stmt], cond: bool) -> None:
+        # `guarded` flips once a prior `if` MAY leave the function — the
+        # rest of the body is then not on every path, i.e. conditional.
+        # may_terminate (not terminates) so `if guard: try: return kernel()
+        # except: pass` still makes the host tail the fallback it is.
+        guarded = False
+        for st in body:
+            self._stmt(st, cond or guarded)
+            if isinstance(st, ast.If) and may_terminate(st.body):
+                guarded = True
+
+    def _stmt(self, st: ast.stmt, cond: bool) -> None:
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            if value is not None:
+                self._expr(value, cond)
+            if isinstance(st, ast.Assign):
+                self.taint.assign(st.targets, value)
+            elif isinstance(st, ast.AnnAssign) and value is not None:
+                self.taint.assign([st.target], value)
+            elif isinstance(st, ast.AugAssign):
+                if self.taint.is_device(st.value):
+                    self.taint._mark(st.target, self.taint.kind_of(st.value))
+        elif isinstance(st, (ast.Expr, ast.Return)):
+            if st.value is not None:
+                self._expr(st.value, cond)
+        elif isinstance(st, ast.If):
+            self._branch_test(st.test, cond)
+            scalar_names = isinstance_scalar_names(st.test)
+            saved = dict(self.taint.kinds)
+            # inside `isinstance(x, TpuScalar)` the value is a host scalar
+            for n in scalar_names:
+                self.taint.kinds.pop(n, None)
+            self._stmts(st.body, cond=True)
+            after_body = dict(self.taint.kinds)
+            self.taint.kinds = dict(saved)
+            self._stmts(st.orelse, cond=True)
+            # conservative join: taint acquired in EITHER arm survives (a
+            # name assigned a device value under `if` is device after it),
+            # except the scalar-narrowed names, which only lose taint
+            # inside their guard
+            for k, v in after_body.items():
+                if k not in scalar_names:
+                    self.taint.kinds.setdefault(k, v)
+        elif isinstance(st, ast.While):
+            self._branch_test(st.test, cond)
+            self._stmts(st.body, cond=True)
+        elif isinstance(st, ast.For):
+            self._expr(st.iter, cond)
+            k = self.taint.kind_of(st.iter)
+            if k in DEVICE_KINDS:
+                self._hit("per-row-loop", st, cond,
+                          "python loop iterates a device value row by row")
+            self.taint._mark(st.target, COL if k else None)
+            # a for-body inherits the loop's conditionality: eval loops run
+            # over non-empty children/columns, so a host op inside is paid
+            # per batch — treating it as conditional would let an
+            # unconditional per-batch sync dodge TL001
+            self._stmts(st.body, cond)
+            self._stmts(st.orelse, cond=True)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._expr(item.context_expr, cond)
+                if item.optional_vars is not None:
+                    self.taint._mark(item.optional_vars,
+                                     self.taint.kind_of(item.context_expr))
+            self._stmts(st.body, cond)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body, cond)
+            for h in st.handlers:
+                self._stmts(h.body, cond=True)
+            self._stmts(st.orelse, cond=True)
+            self._stmts(st.finalbody, cond)
+        elif isinstance(st, ast.FunctionDef):
+            # nested closure (e.g. a traced fn): may or may not run —
+            # analyze conservatively as conditional, sharing the namespace
+            self._stmts(st.body, cond=True)
+        elif isinstance(st, ast.Assert):
+            self._branch_test(st.test, cond)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._expr(st.exc, cond)
+        # Pass/Break/Continue/Import/Global/Delete: nothing to do
+
+    # -- branch tests ---------------------------------------------------
+    def _branch_test(self, test: ast.AST, cond: bool) -> None:
+        self._expr(test, cond)
+        if self._test_value_dependent(test):
+            self._hit("value-dependent-branch", test, cond,
+                      "branch condition depends on device data")
+
+    def _test_value_dependent(self, test: ast.AST) -> bool:
+        """A raw device value decides the branch.  Structural forms
+        (isinstance, `is None`, metadata attrs) and explicit host coercions
+        (flagged separately as py-coercion) are exempt."""
+        if isinstance(test, ast.BoolOp):
+            return any(self._test_value_dependent(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._test_value_dependent(test.operand)
+        if isinstance(test, ast.Call):
+            f = test.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in EXEMPT_CALLS or name in COERCION_CALLS:
+                return False  # structural, or already a py-coercion finding
+        return self.taint.kind_of(test) in DEVICE_KINDS
+
+    # -- expressions ----------------------------------------------------
+    def _expr(self, node: ast.AST, cond: bool) -> None:
+        """Recursive expression walk that keeps ternary arms conditional."""
+        if isinstance(node, ast.IfExp):
+            self._branch_test(node.test, cond)
+            self._expr(node.body, True)
+            self._expr(node.orelse, True)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, cond)
+            self._expr(node.func, cond)
+            for a in node.args:
+                self._expr(a, cond)
+            for k in node.keywords:
+                if k.value is not None:
+                    self._expr(k.value, cond)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            saved = dict(self.taint.kinds)
+            for gen in node.generators:
+                self._expr(gen.iter, cond)
+                k = self.taint.kind_of(gen.iter)
+                if k in DEVICE_KINDS:
+                    self._hit("per-row-loop", node, cond,
+                              "comprehension iterates a device value row "
+                              "by row")
+                self.taint._mark(gen.target, COL if k else None)
+                for if_ in gen.ifs:
+                    self._branch_test(if_, cond)
+            if isinstance(node, ast.DictComp):
+                self._expr(node.key, cond)
+                self._expr(node.value, cond)
+            else:
+                self._expr(node.elt, cond)
+            self.taint.kinds = saved
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr == "offsets" \
+                    and self.taint.kind_of(node.value) in DEVICE_KINDS:
+                self.report.string_layout = True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword, ast.Slice)):
+                self._expr(child, cond)
+
+    def _call(self, node: ast.Call, cond: bool) -> None:
+        f = node.func
+        any_device_arg = any(self.taint.is_device(a) for a in node.args) \
+            or any(k.value is not None and self.taint.is_device(k.value)
+                   for k in node.keywords)
+        for k in node.keywords:
+            # constructing a column from a freshly computed device offsets
+            # array => ragged/string output.  ARR only: a pass-through
+            # `offsets=offsets` parameter inside generic constructors like
+            # base.make_column must NOT mark every caller ragged.
+            if k.arg == "offsets" and k.value is not None \
+                    and self.taint.kind_of(k.value) == "arr":
+                self.report.string_layout = True
+
+        summary = None
+        helper_label = None
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in COERCION_CALLS and any_device_arg:
+                self._hit("py-coercion", node, cond,
+                          f"{name}() of a device value syncs to host")
+                return
+            if name in _STRING_LAYOUT_HELPERS:
+                self.report.string_layout = True
+            summary, helper_label = self.mod.helpers.get(name), name
+        elif isinstance(f, ast.Attribute):
+            attr = f.attr
+            root = _root_name(f)
+            origin = self.mod.root_module(root) if root else ""
+            recv_kind = self.taint.kind_of(f.value)
+
+            if attr in _STRING_LAYOUT_HELPERS:
+                self.report.string_layout = True
+            if attr in HOST_METHODS and recv_kind in DEVICE_KINDS:
+                self._hit("host-method", node, cond,
+                          f".{attr}() on a device value is a host hop")
+                return
+            if attr == "device_get" and (origin.startswith("jax")
+                                         or root == "jax"):
+                self._hit("device-get", node, cond,
+                          "jax.device_get downloads to host")
+                return
+            any_seq_arg = any(self.taint.kind_of(a) == "seq"
+                              for a in node.args)
+            if origin.startswith("numpy") and (any_device_arg or any_seq_arg):
+                self._hit("np-on-device", node, cond,
+                          f"np.{attr}() consumes a device value (host sync)")
+                return
+            if origin.startswith("pyarrow") and (any_device_arg
+                                                 or any_seq_arg):
+                self._hit("pyarrow-on-device", node, cond,
+                          f"pyarrow {root}.{attr}() consumes a device value")
+                return
+            if "kernels.strings" in origin:
+                self.report.string_layout = True
+            if isinstance(f.value, ast.Name) and f.value.id in ("self", "cls"):
+                summary = self.mod.methods.get(attr)
+                helper_label = f"self.{attr}"
+
+        if summary is not None:
+            if summary.string_layout:
+                self.report.string_layout = True
+            if summary.host_grade is not None:
+                self._hit("host-helper-call", node,
+                          cond or summary.host_grade != HOST,
+                          f"helper {helper_label}() crosses the host "
+                          f"boundary")
+
+
+def scan_function(fn: ast.FunctionDef, mod: ModuleIndex,
+                  taint_seeds: Optional[Dict[str, str]] = None,
+                  qualname: str = "") -> FunctionReport:
+    """Run every detector over one function body.
+
+    `taint_seeds` maps parameter names to taint kinds on entry.  For an
+    `eval_tpu(self, batch, ctx)` method the seed is `{"batch": COL}` (column
+    access via `batch.column(...)` / child `eval_tpu` produces the taint);
+    for module helpers use astwalk.seed_params (device-ish by default with
+    scalar/sequence name heuristics)."""
+    if taint_seeds is None:
+        taint_seeds = {"batch": COL}
+    return _Scanner(fn, mod, dict(taint_seeds),
+                    qualname or fn.name).run()
+
+
+def find_method(mod: ModuleIndex, class_name: str,
+                method: str) -> Optional[ast.FunctionDef]:
+    cls = mod.classes.get(class_name)
+    if cls is None:
+        return None
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == method:
+            return node
+    return None
+
+
+def scan_source(source: str, path: str = "<string>"):
+    """Classify every function/method in a source blob (test fixtures, kernel
+    modules).  Returns {qualname: FunctionReport}."""
+    mod = ModuleIndex(source, path)
+    out = {}
+    for name, fn in mod.functions.items():
+        out[name] = scan_function(fn, mod, taint_seeds=seed_params(fn),
+                                  qualname=name)
+    for cname, cls in mod.classes.items():
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef):
+                out[f"{cname}.{node.name}"] = scan_function(
+                    node, mod, taint_seeds={"batch": COL},
+                    qualname=f"{cname}.{node.name}")
+    return out

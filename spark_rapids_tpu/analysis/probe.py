@@ -1,0 +1,205 @@
+"""Dynamic corroboration: probe registered expressions with jax.eval_shape.
+
+The static detectors predict whether an `eval_tpu` can trace; this pass
+*checks* the prediction the same way execs/opjit.py discovers it at runtime —
+by tracing.  `jax.eval_shape` runs the function over abstract tracers without
+compiling or executing, so any host-boundary op (`np.asarray` on a tracer,
+``bool()``/``int()`` coercion, ``.item()``, pyarrow conversion) raises one of
+jax's concretization errors exactly where a real opjit trace would fail.
+
+For every trace-relevant registered expression we:
+
+1. build an instance over synthetic fixed-width columns (constructor
+   heuristics over common arities/dtypes; unconstructable classes are
+   reported as *skipped*, never as agreement);
+2. sanity-check it eagerly over a real 8-row batch (an expression that can't
+   even run eagerly says nothing about traceability);
+3. `jax.eval_shape` the same evaluation over abstract inputs.
+
+Probe verdict **traceable**/**untraceable** is then compared with the static
+verdict; a disagreement is finding **TL005** (error): either the detectors
+miss a pattern or the implementation changed under the declaration.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .astwalk import CONDITIONAL_HOST, DEVICE
+from .registry_check import ExprReport, Finding
+
+TRACEABLE = "traceable"
+NOT_TRACEABLE = "untraceable"
+SKIPPED = "skipped"
+
+
+@dataclass
+class ProbeResult:
+    status: str   # traceable | untraceable | skipped
+    detail: str = ""
+
+
+def _trace_failure_types() -> Tuple[type, ...]:
+    from ..execs.opjit import _TRACE_FAILURES
+    return _TRACE_FAILURES
+
+
+def _synthetic_batch():
+    """8-row batch with two columns of every fixed-width family the probes
+    draw children from (nulls included so validity paths trace too)."""
+    import datetime as _dt
+
+    import pyarrow as pa
+
+    from ..columnar.batch import TpuColumnarBatch
+    t = pa.table({
+        "l1": pa.array([1, 2, None, 4, 5, 6, 7, 8], pa.int64()),
+        "l2": pa.array([8, 7, 6, 5, None, 3, 2, 1], pa.int64()),
+        "d1": pa.array([1.5, -2.0, None, 0.0, 3.25, -0.5, 2.0, 9.0]),
+        "d2": pa.array([0.5, 2.0, 4.0, None, -1.0, 8.0, 0.25, 1.0]),
+        "i1": pa.array([1, -2, 3, None, 5, -6, 7, 8], pa.int32()),
+        "i2": pa.array([2, 2, None, 4, 4, 6, 6, 8], pa.int32()),
+        "b1": pa.array([True, False, None, True, False, True, False, True]),
+        "b2": pa.array([False, False, True, True, None, True, False, True]),
+        "dt1": pa.array([_dt.date(2023, 1, 1 + i) for i in range(8)]),
+        "ts1": pa.array([_dt.datetime(2023, 1, 1, 0, 0, i)
+                         for i in range(8)], pa.timestamp("us")),
+    })
+    return TpuColumnarBatch.from_arrow(t)
+
+
+#: child ordinal families over the synthetic batch, tried in order
+_FAMILIES = (("long", (0, 1)), ("double", (2, 3)), ("int", (4, 5)),
+             ("bool", (6, 7)), ("date", (8, 8)), ("timestamp", (9, 9)))
+
+
+def _required_arity(cls: type) -> int:
+    try:
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError):
+        return 1
+    n = 0
+    for name, p in list(sig.parameters.items())[1:]:  # drop self
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if p.default is p.empty:
+            n += 1
+    return n
+
+
+def _candidates(cls: type, batch):
+    """Yield constructed instances to try, cheapest guess first."""
+    from ..expressions.base import AttributeReference
+
+    def ref(ordinal):
+        c = batch.columns[ordinal]
+        return AttributeReference(f"c{ordinal}", c.dtype, True,
+                                  ordinal=ordinal)
+
+    arity = _required_arity(cls)
+    for n_kids in dict.fromkeys((arity, 0, 1, 2, 3)):
+        if n_kids == 0:
+            try:
+                yield cls()
+            except Exception:  # noqa: BLE001 — constructor guess failed
+                pass
+            continue
+        if n_kids < 1 or n_kids > 3:
+            continue
+        for _, (o1, o2) in _FAMILIES:
+            kids = [ref(o1), ref(o2), ref(o1)][:n_kids]
+            try:
+                yield cls(*kids)
+            except Exception:  # noqa: BLE001 — constructor guess failed
+                continue
+
+
+def probe_class(cls: type, batch=None) -> ProbeResult:
+    import jax
+
+    from ..expressions.base import EvalContext, to_column
+    if batch is None:
+        batch = _synthetic_batch()
+    ctx = EvalContext()
+    failures = _trace_failure_types()
+    last_err: Optional[str] = None
+    for expr in _candidates(cls, batch):
+        # eager sanity: dtype resolvable and evaluation succeeds at all
+        try:
+            expr.dtype
+            to_column(expr.eval_tpu(batch, ctx), batch)
+        except Exception as e:  # noqa: BLE001 — candidate doesn't apply
+            last_err = f"eager: {type(e).__name__}: {e}"
+            continue
+
+        dtypes = [c.dtype for c in batch.columns]
+        cap = batch.capacity
+        n = batch.num_rows
+
+        def fn(*flat, _expr=expr):
+            from ..columnar.batch import TpuColumnarBatch
+            from ..columnar.vector import TpuColumnVector
+            cols = [TpuColumnVector(dt, flat[2 * i], flat[2 * i + 1], n)
+                    for i, dt in enumerate(dtypes)]
+            out = to_column(_expr.eval_tpu(TpuColumnarBatch(cols, n), ctx),
+                            batch)
+            leaves = [out.data]
+            if out.validity is not None:
+                leaves.append(out.validity)
+            return tuple(leaves)
+
+        flat = []
+        abstract = []
+        import jax.numpy as jnp
+        ragged = False
+        for c in batch.columns:
+            if c.offsets is not None or c.host_data is not None:
+                ragged = True
+                break
+            v = c.validity if c.validity is not None \
+                else jnp.ones((cap,), jnp.bool_)
+            flat.extend([c.data, v])
+        if ragged:
+            return ProbeResult(SKIPPED, "synthetic batch has ragged columns")
+        abstract = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat]
+        try:
+            jax.eval_shape(fn, *abstract)
+            return ProbeResult(TRACEABLE)
+        except failures as e:
+            return ProbeResult(NOT_TRACEABLE, f"{type(e).__name__}")
+        except Exception as e:  # noqa: BLE001 — ambiguous: not a trace fact
+            return ProbeResult(SKIPPED, f"trace: {type(e).__name__}: {e}")
+    return ProbeResult(SKIPPED, last_err or "no constructible candidate")
+
+
+def corroborate(reports: List[ExprReport]
+                ) -> Tuple[Dict[str, ProbeResult], List[Finding]]:
+    """Probe every trace-relevant report; return per-class results and the
+    TL005 disagreement findings.  `conditional-host` verdicts are exempt: the
+    guard may or may not concretize under trace, both outcomes are consistent
+    with the declaration."""
+    batch = _synthetic_batch()
+    results: Dict[str, ProbeResult] = {}
+    findings: List[Finding] = []
+    for rep in reports:
+        if not rep.trace_relevant:
+            results[rep.cls.__name__] = ProbeResult(
+                SKIPPED, "not trace-relevant (ragged/string or no "
+                "fixed-width signature)")
+            continue
+        res = probe_class(rep.cls, batch)
+        results[rep.cls.__name__] = res
+        if res.status == SKIPPED or rep.verdict == CONDITIONAL_HOST:
+            continue
+        static_traceable = rep.verdict == DEVICE
+        dynamic_traceable = res.status == TRACEABLE
+        if static_traceable != dynamic_traceable:
+            findings.append(Finding(
+                "TL005", "error", rep.location,
+                f"static verdict `{rep.verdict}` disagrees with the "
+                f"jax.eval_shape probe (`{res.status}`"
+                f"{': ' + res.detail if res.detail else ''}) — fix the "
+                f"detector or the declaration"))
+    return results, findings

@@ -1,0 +1,324 @@
+"""Registry cross-check: static eval_tpu verdicts vs plan/typechecks.py.
+
+The analogue of the reference's TypeChecks.scala being the single source of
+truth: here `plan/typechecks.py` declarations (`host_assisted`) drive where
+execs/opjit.py and execs/fusion.py split traces, so a wrong declaration is a
+silent performance cliff.  This pass classifies every registered expression's
+actual `eval_tpu` (and `_compute`) implementation with the AST detectors and
+cross-checks the verdict against the registry:
+
+* **TL001** (error)   declared device (`host_assisted=False`) but the
+  implementation hits the host boundary *unconditionally* — opjit's first
+  trace fails and the fingerprint is pinned eager per batch (the
+  205s-vs-3s q3 regime) without anything saying so.
+* **TL002** (warning) declared `host_assisted=True` but the implementation is
+  fully device-traceable — the flag needlessly splits every fused segment
+  the expression appears in.
+* **TL003** (error)   implemented (`eval_tpu` overridden) in an expressions
+  module but never registered — registry drift; the planner can't price it.
+* **TL004** (info)    declared device with a *guarded* host fallback
+  (conditional host path) — legitimate, surfaced for the docs' execution-mode
+  column, never gated.
+* **TL005** (error)   the dynamic `jax.eval_shape` probe disagrees with the
+  static verdict (only with --corroborate; see probe.py).
+
+Only *trace-relevant* expressions can raise TL001: their type signature must
+include a fixed-width type and the implementation must not consume ragged
+string/array layouts — everything else is rejected by the opjit gate long
+before the declaration matters, so conflicts there are TL004 material.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .astwalk import (CONDITIONAL_HOST, DEVICE, HOST, UNTRACEABLE,
+                      FunctionReport, ModuleIndex, seed_params, worst)
+from .detectors import scan_function
+
+#: TypeEnum members the opjit gate can admit as a node output dtype
+_FIXED_WIDTH_ENUMS = frozenset((
+    "BOOLEAN", "BYTE", "SHORT", "INT", "LONG", "FLOAT", "DOUBLE",
+    "DATE", "TIMESTAMP",
+))
+
+#: eval-path methods analyzed per class (effective implementation via MRO)
+_EVAL_METHODS = ("eval_tpu", "_compute", "_dec128_eval")
+
+
+@dataclass
+class Finding:
+    rule: str        # TL001..TL005 / TL010
+    severity: str    # "error" | "warning" | "info"
+    location: str    # "expressions/strings.py::Upper"
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable baseline key (no line numbers: survives reformatting)."""
+        return f"{self.rule} {self.location}"
+
+    def render(self) -> str:
+        return f"[{self.severity.upper():7s}] {self.rule} {self.location}: " \
+               f"{self.message}"
+
+
+@dataclass
+class ExprReport:
+    cls: type
+    declared_host_assisted: bool
+    verdict: str
+    string_layout: bool
+    trace_relevant: bool
+    provenance: str
+    reports: List[FunctionReport] = field(default_factory=list)
+
+    @property
+    def location(self) -> str:
+        mod = self.cls.__module__.replace("spark_rapids_tpu.", "")
+        return f"{mod}::{self.cls.__name__}"
+
+
+_MODULE_CACHE: Dict[str, ModuleIndex] = {}
+
+
+def _module_index_for(fn) -> Optional[ModuleIndex]:
+    try:
+        path = inspect.getfile(fn)
+    except (TypeError, OSError):
+        return None
+    idx = _MODULE_CACHE.get(path)
+    if idx is None:
+        try:
+            with open(path) as f:
+                idx = ModuleIndex(f.read(), path)
+        except (OSError, SyntaxError):
+            return None
+        _MODULE_CACHE[path] = idx
+    return idx
+
+
+def _method_ast(mod: ModuleIndex, fn) -> Optional[ast.FunctionDef]:
+    qual = getattr(fn, "__qualname__", "")
+    parts = qual.split(".")
+    if len(parts) < 2:
+        return mod.functions.get(parts[0]) if parts else None
+    cls_name, meth = parts[-2], parts[-1]
+    cls = mod.classes.get(cls_name)
+    if cls is None:
+        return None
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == meth:
+            return node
+    return None
+
+
+def classify_class(cls: type) -> Tuple[str, bool, List[FunctionReport]]:
+    """Static verdict for one expression class: worst verdict over its
+    effective eval-path methods, resolved through the MRO so subclasses
+    inherit e.g. BinaryExpression.eval_tpu + their own `_compute`."""
+    from ..expressions.base import Expression
+    verdict = DEVICE
+    string_layout = False
+    reports: List[FunctionReport] = []
+    seen = set()
+    for meth in _EVAL_METHODS:
+        fn = getattr(cls, meth, None)
+        if fn is None:
+            continue
+        fn = getattr(fn, "__func__", fn)
+        base = getattr(Expression, meth, None)
+        base = getattr(base, "__func__", base)
+        if base is not None and fn is base:
+            continue  # the NotImplementedError placeholder
+        key = (getattr(fn, "__module__", ""), getattr(fn, "__qualname__", ""))
+        if key in seen or not key[1]:
+            continue
+        seen.add(key)
+        mod = _module_index_for(fn)
+        if mod is None:
+            continue
+        node = _method_ast(mod, fn)
+        if node is None:
+            continue
+        # seed from the method's own signature: eval_tpu(self, batch, ctx)
+        # reduces to {"batch": COL}, while _compute(self, ldata, rdata, ...)
+        # seeds its device-value operands too — host ops on them must not
+        # be invisible to the detectors
+        rep = scan_function(node, mod, taint_seeds=seed_params(node),
+                            qualname=f"{cls.__name__}.{meth}")
+        reports.append(rep)
+        verdict = worst(verdict, rep.verdict)
+        string_layout = string_layout or rep.string_layout
+    return verdict, string_layout, reports
+
+
+def _has_own_eval_tpu(cls: type) -> bool:
+    from ..expressions.base import Expression
+    return cls.eval_tpu is not Expression.eval_tpu
+
+
+def _sig_fixed_width(rule) -> bool:
+    sig = rule.type_sig
+    if sig is None:
+        return False
+    return bool(set(sig.types) & _FIXED_WIDTH_ENUMS)
+
+
+def analyze_registry() -> Tuple[List[ExprReport], List[Finding]]:
+    """Classify every registered expression and cross-check declarations."""
+    from ..plan.typechecks import all_expr_rules
+    reports: List[ExprReport] = []
+    findings: List[Finding] = []
+    for cls, rule in sorted(all_expr_rules().items(),
+                            key=lambda kv: kv[0].__name__):
+        if getattr(cls, "unevaluable", False) or not _has_own_eval_tpu(cls):
+            # no kernel of its own: driven by an exec or priced via
+            # host_assisted/CPU fallback — api_validation covers the contract
+            continue
+        verdict, string_layout, fn_reports = classify_class(cls)
+        trace_relevant = _sig_fixed_width(rule) and not string_layout
+        rep = ExprReport(cls=cls, declared_host_assisted=rule.host_assisted,
+                         verdict=verdict, string_layout=string_layout,
+                         trace_relevant=trace_relevant,
+                         provenance=getattr(rule, "provenance", "?"),
+                         reports=fn_reports)
+        reports.append(rep)
+        findings.extend(_cross_check(rep))
+    findings.extend(_drift_check(set(all_expr_rules())))
+    return reports, findings
+
+
+def _cross_check(rep: ExprReport) -> List[Finding]:
+    out: List[Finding] = []
+    declared_at = f" (declared at {rep.provenance})"
+    if not rep.declared_host_assisted:
+        if rep.verdict in (HOST, UNTRACEABLE) and rep.trace_relevant:
+            why = "; ".join(
+                f"{d.detector}@{d.line}" for r in rep.reports
+                for d in r.detections if not d.conditional)[:160]
+            out.append(Finding(
+                "TL001", "error", rep.location,
+                f"declared device but eval_tpu hits the host boundary "
+                f"unconditionally ({why}) — opjit pins it eager per batch; "
+                f"flag host_assisted=True or fix the kernel{declared_at}"))
+        elif rep.verdict in (CONDITIONAL_HOST, HOST, UNTRACEABLE):
+            out.append(Finding(
+                "TL004", "info", rep.location,
+                f"device-declared with a guarded host fallback "
+                f"(verdict: {rep.verdict}); fine — surfaced for the "
+                f"execution-mode docs column"))
+    else:
+        if rep.verdict == DEVICE:
+            # only a real split cost when the expression could actually
+            # appear in a trace; ragged/string ops are informational
+            sev = "warning" if rep.trace_relevant else "info"
+            out.append(Finding(
+                "TL002", sev, rep.location,
+                f"declared host_assisted but the implementation is fully "
+                f"device-traceable — the flag splits every fused segment "
+                f"containing it; drop it{declared_at}"))
+    return out
+
+
+def _drift_check(registered: set) -> List[Finding]:
+    """TL003: concrete expression classes with their own eval_tpu that were
+    never registered (the planner can neither price nor gate them)."""
+    import importlib
+    import pkgutil
+
+    from .. import expressions as _exprs_pkg
+    from ..expressions.base import Expression
+
+    findings: List[Finding] = []
+    mod_names = [m.name for m in pkgutil.iter_modules(_exprs_pkg.__path__)
+                 if m.name != "base"]
+    modules = []
+    for name in sorted(mod_names):
+        try:
+            modules.append(importlib.import_module(
+                f"{_exprs_pkg.__name__}.{name}"))
+        except ImportError:
+            continue
+    for module in modules:
+        for name, cls in sorted(vars(module).items()):
+            if not (isinstance(cls, type) and issubclass(cls, Expression)):
+                continue
+            if cls.__module__ != module.__name__ or name.startswith("_"):
+                continue
+            if cls in registered or getattr(cls, "unevaluable", False):
+                continue
+            if "eval_tpu" not in cls.__dict__:
+                continue  # inherits: the defining base carries the contract
+            if any(issubclass(r, cls) and r is not cls for r in registered):
+                continue  # abstract base of registered implementations
+            findings.append(Finding(
+                "TL003", "error",
+                f"{cls.__module__.replace('spark_rapids_tpu.', '')}::{name}",
+                "implements eval_tpu but is not registered in "
+                "plan/typechecks.py — registry drift (planner cannot "
+                "price it)"))
+    return findings
+
+
+def scan_kernels() -> Dict[str, Dict[str, str]]:
+    """Classify every public module-level function under kernels/ (the
+    tentpole also covers kernel implementations, not just expressions).
+    Returns {module: {function: verdict}}.  Kernels that legitimately cross
+    the host boundary (json host patches, regex host fallbacks) show up as
+    host/conditional-host — informational, surfaced by tracelint --verbose,
+    never gated: a kernel's host-ness is priced by the expression that calls
+    it, which the registry cross-check covers."""
+    import os
+
+    from .detectors import scan_function
+    from .astwalk import seed_params
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "kernels")
+    out: Dict[str, Dict[str, str]] = {}
+    for fname in sorted(os.listdir(root)):
+        if not fname.endswith(".py") or fname == "__init__.py":
+            continue
+        path = os.path.join(root, fname)
+        with open(path) as f:
+            src = f.read()
+        try:
+            mod = ModuleIndex(src, path)
+        except SyntaxError:
+            continue
+        verdicts: Dict[str, str] = {}
+        for name, fn in mod.functions.items():
+            if name.startswith("_"):
+                continue
+            rep = scan_function(fn, mod, taint_seeds=seed_params(fn),
+                                qualname=name)
+            verdicts[name] = rep.verdict
+        out[f"kernels/{fname}"] = verdicts
+    return out
+
+
+def execution_modes() -> Dict[type, str]:
+    """Per registered expression: the execution-mode string for
+    docs/supported_ops.md (sourced from analyzer verdict + registry flag)."""
+    from ..plan.typechecks import all_expr_rules
+    modes: Dict[type, str] = {}
+    for cls, rule in all_expr_rules().items():
+        if getattr(cls, "unevaluable", False):
+            modes[cls] = "exec-driven"
+        elif not _has_own_eval_tpu(cls):
+            modes[cls] = "host-assisted" if rule.host_assisted else "cpu fallback"
+        elif rule.host_assisted:
+            modes[cls] = "host-assisted"
+        else:
+            verdict, _, _ = classify_class(cls)
+            # UNTRACEABLE here means data-dependent guards selecting between
+            # a device kernel and a host fallback (the op still runs its
+            # device path eagerly) — "host" would misdescribe it
+            modes[cls] = {DEVICE: "device",
+                          CONDITIONAL_HOST: "device / host fallback",
+                          HOST: "host",
+                          UNTRACEABLE: "device / host fallback"}[verdict]
+    return modes

@@ -42,6 +42,7 @@ compiled program instead of re-tracing.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -356,8 +357,12 @@ def _string_codes(col: TpuColumnVector, domain: _KeyDomain) -> jnp.ndarray:
 # the traced stage
 # ---------------------------------------------------------------------------
 
-# process-wide compiled program cache (structural key → jitted fn)
+# process-wide compiled program cache (structural key → jitted fn).
+# Pipelined exchange / concurrent join collection (PR 2) can build stages
+# from pool threads: the lock makes lookup/insert atomic (a lost race just
+# rebuilds the same program once, benignly).
 _STAGE_FN_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+_STAGE_FN_LOCK = threading.Lock()
 
 
 def _is_fp(dtype: DataType) -> bool:
@@ -371,7 +376,8 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
     domain_sizes = tuple(d.size for d in domains)
     domain_los = tuple(getattr(d, "lo", None) for d in domains)
     key = spec.cache_key(cap, domain_sizes) + (domain_los,)
-    fn = _STAGE_FN_CACHE.get(key)
+    with _STAGE_FN_LOCK:
+        fn = _STAGE_FN_CACHE.get(key)
     if fn is not None:
         return fn
 
@@ -564,7 +570,8 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
         return (oob,) + carry
 
     fn = jax.jit(stage)
-    _STAGE_FN_CACHE[key] = fn
+    with _STAGE_FN_LOCK:
+        _STAGE_FN_CACHE[key] = fn
     return fn
 
 

@@ -41,6 +41,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
+import threading as _threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -493,6 +495,9 @@ def try_extract_join_stage(agg) -> Optional[_JoinStageSpec]:
 # ---------------------------------------------------------------------------
 
 _JOIN_STAGE_FN_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+#: joins collect both sides concurrently (PR 2): cache ops are locked so a
+#: racing build can only cost a benign duplicate trace, never a torn dict
+_JOIN_CACHE_LOCK = _threading.Lock()
 
 
 def _segment_states(fn, x, v, gcode, G):
@@ -536,7 +541,8 @@ def _segment_states(fn, x, v, gcode, G):
 def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
                          dim_caps: Tuple[int, ...], dim_dense, eval_ctx):
     key = spec.cache_key(cap, dim_caps) + (tuple(dim_dense),)
-    fn = _JOIN_STAGE_FN_CACHE.get(key)
+    with _JOIN_CACHE_LOCK:
+        fn = _JOIN_STAGE_FN_CACHE.get(key)
     if fn is not None:
         return fn
 
@@ -695,7 +701,8 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
         return tuple(carry)
 
     fn = jax.jit(stage)
-    _JOIN_STAGE_FN_CACHE[key] = fn
+    with _JOIN_CACHE_LOCK:
+        _JOIN_STAGE_FN_CACHE[key] = fn
     return fn
 
 
@@ -805,12 +812,16 @@ import collections as _collections
 # memoized verdict — a structurally-keyed side table would serve a stale
 # "unique" answer after a rebuild and silently split SQL groups.
 _DIM_BUILD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+#: guards the OrderedDict's LRU bookkeeping (move_to_end/popitem) against
+#: concurrent fact-side tasks sharing one dimension cache
+_DIM_CACHE_LOCK = _threading.Lock()
 
 
 def clear_dim_cache() -> None:
     """Release the cached dimension builds (host tables, source refs, the
     HBM key/payload arrays they pin, and their uniqueness verdicts)."""
-    _DIM_BUILD_CACHE.clear()
+    with _DIM_CACHE_LOCK:
+        _DIM_BUILD_CACHE.clear()
 
 
 def _dim_sources(plan: PhysicalPlan):
@@ -1016,19 +1027,26 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                     key = (_dim_structure(d.plan), tuple(d.key_ordinals),
                            tuple(d.payload_ordinals), d.semi, conf_fp)
                     srcs = _dim_sources(d.plan)
-                    hit = _DIM_BUILD_CACHE.get(key)
-                    if hit is not None and len(hit[0]) == len(srcs) \
-                            and all(a is b for a, b in zip(hit[0], srcs)):
-                        entry = hit
-                        _DIM_BUILD_CACHE.move_to_end(key)
-                    else:
-                        # rebuild: fresh entry, fresh (empty) verdict memo
+                    with _DIM_CACHE_LOCK:
+                        hit = _DIM_BUILD_CACHE.get(key)
+                        if hit is not None and len(hit[0]) == len(srcs) \
+                                and all(a is b
+                                        for a, b in zip(hit[0], srcs)):
+                            entry = hit
+                            _DIM_BUILD_CACHE.move_to_end(key)
+                        else:
+                            entry = None
+                    if entry is None:
+                        # rebuild (outside the lock: device uploads are
+                        # slow): fresh entry, fresh (empty) verdict memo —
+                        # a racing rebuild just wins last, benignly
                         entry = (srcs, self._build_dim(d, ctx), {})
-                        _DIM_BUILD_CACHE[key] = entry
                         from ..config import COMPILED_JOIN_DIM_CACHE_SIZE
                         cache_max = ctx.conf.get(COMPILED_JOIN_DIM_CACHE_SIZE)
-                        while len(_DIM_BUILD_CACHE) > cache_max:
-                            _DIM_BUILD_CACHE.popitem(last=False)
+                        with _DIM_CACHE_LOCK:
+                            _DIM_BUILD_CACHE[key] = entry
+                            while len(_DIM_BUILD_CACHE) > cache_max:
+                                _DIM_BUILD_CACHE.popitem(last=False)
                     tbl, flat, cap_d, dense = entry[1]
                     dim_tables.append(tbl)
                     dim_flats.append(flat)

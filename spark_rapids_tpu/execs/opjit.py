@@ -170,8 +170,9 @@ def _evict(eval_ctx) -> None:
         limit = int(eval_ctx.conf.get(OPJIT_CACHE_SIZE))
     except Exception:  # noqa: BLE001
         limit = 256
-    while len(_CACHE) > max(limit, 1):
-        _CACHE.popitem(last=False)
+    with _LOCK:  # reentrant: callers already inside _LOCK pay nothing
+        while len(_CACHE) > max(limit, 1):
+            _CACHE.popitem(last=False)
 
 
 def _donate(positions: Tuple[int, ...]) -> Tuple[int, ...]:
@@ -371,13 +372,14 @@ def _trace_ctx(eval_ctx: EvalContext) -> EvalContext:
     future one that does bakes in a deterministic default, not whatever
     session happened to trace first."""
     key = _conf_fp(eval_ctx)
-    ctx = _TRACE_CTXS.get(key)
-    if ctx is None:
-        from ..config import RapidsConf
-        ctx = EvalContext(RapidsConf({
-            "spark.sql.ansi.enabled": "true" if key[0] else "false",
-            "spark.sql.session.timeZone": key[1]}))
-        _TRACE_CTXS[key] = ctx
+    with _LOCK:
+        ctx = _TRACE_CTXS.get(key)
+        if ctx is None:
+            from ..config import RapidsConf
+            ctx = EvalContext(RapidsConf({
+                "spark.sql.ansi.enabled": "true" if key[0] else "false",
+                "spark.sql.session.timeZone": key[1]}))
+            _TRACE_CTXS[key] = ctx
     return ctx
 
 

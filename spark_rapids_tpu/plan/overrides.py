@@ -26,16 +26,27 @@ log = logging.getLogger("spark_rapids_tpu")
 
 class ExecRule:
     """Replacement rule for one CPU exec class (reference `exec[INPUT](...)`,
-    GpuOverrides.scala:817)."""
+    GpuOverrides.scala:817).
+
+    `tpu_cls` (dotted path under spark_rapids_tpu, e.g. "execs.sort.
+    TpuSortExec") names the converted operator and `metrics` the operator
+    metrics the rule promises it registers beyond the base set —
+    tools/api_validation.py resolves the class lazily and fails the build
+    when a declared name is missing from the class's metric registration
+    (the reference validates exec signatures per shim the same way)."""
 
     def __init__(self, cpu_cls: type, desc: str, conf_key: str,
                  tag: Callable[[PlanMeta], None],
-                 convert: Callable[[PlanMeta, List[PhysicalPlan]], PhysicalPlan]):
+                 convert: Callable[[PlanMeta, List[PhysicalPlan]], PhysicalPlan],
+                 tpu_cls: Optional[str] = None,
+                 metrics: tuple = ()):
         self.cpu_cls = cpu_cls
         self.desc = desc
         self.conf_key = conf_key
         self._tag = tag
         self._convert = convert
+        self.tpu_cls = tpu_cls
+        self.metrics = tuple(metrics)
 
     def tag(self, meta: PlanMeta) -> None:
         if not meta.conf.is_op_enabled(self.conf_key, True):
@@ -62,9 +73,11 @@ def ensure_host(plan: PhysicalPlan) -> PhysicalPlan:
 _EXEC_RULES: Dict[type, ExecRule] = {}
 
 
-def register_exec(cpu_cls: type, desc: str, conf_key: str, tag=None, convert=None):
+def register_exec(cpu_cls: type, desc: str, conf_key: str, tag=None,
+                  convert=None, tpu_cls=None, metrics=()):
     _EXEC_RULES[cpu_cls] = ExecRule(cpu_cls, desc, conf_key,
-                                    tag or (lambda m: None), convert)
+                                    tag or (lambda m: None), convert,
+                                    tpu_cls=tpu_cls, metrics=metrics)
 
 
 def exec_rules() -> Dict[type, ExecRule]:
@@ -98,9 +111,11 @@ def _convert_scan(meta: PlanMeta, children):
 
 
 register_exec(CE.CpuProjectExec, "projection", "spark.rapids.sql.exec.ProjectExec",
-              _tag_project, _convert_project)
+              _tag_project, _convert_project,
+              tpu_cls="execs.basic.TpuProjectExec")
 register_exec(CE.CpuFilterExec, "filter", "spark.rapids.sql.exec.FilterExec",
-              _tag_filter, _convert_filter)
+              _tag_filter, _convert_filter,
+              tpu_cls="execs.basic.TpuFilterExec")
 register_exec(
     CE.CpuRangeExec, "range", "spark.rapids.sql.exec.RangeExec",
     lambda m: None,
@@ -136,7 +151,8 @@ register_exec(
     CE.CpuTopNExec, "top-N (sort+limit fusion)",
     "spark.rapids.sql.exec.TakeOrderedAndProjectExec",
     lambda m: m.add_exprs([o.child for o in m.plan.order]),
-    lambda m, ch: _TpuTopN(m.plan.n, m.plan.order, ch[0], m.plan.offset))
+    lambda m, ch: _TpuTopN(m.plan.n, m.plan.order, ch[0], m.plan.offset),
+    tpu_cls="execs.sort.TpuTopNExec", metrics=("sortTime",))
 
 
 def _TpuTopN(n, order, child, offset):
@@ -150,7 +166,8 @@ def _register_sample():
         CpuSampleExec, "sample", "spark.rapids.sql.exec.SampleExec",
         lambda m: None,
         lambda m, ch: TpuSampleExec(m.plan.fraction, m.plan.with_replacement,
-                                    m.plan.seed, ch[0]))
+                                    m.plan.seed, ch[0]),
+        tpu_cls="execs.sample.TpuSampleExec", metrics=("sampleTime",))
 
 
 _register_sample()
@@ -166,7 +183,8 @@ def _convert_sort(meta: PlanMeta, ch):
 
 
 register_exec(CE.CpuSortExec, "sort", "spark.rapids.sql.exec.SortExec",
-              _tag_sort, _convert_sort)
+              _tag_sort, _convert_sort,
+              tpu_cls="execs.sort.TpuSortExec", metrics=("sortTime",))
 
 
 def _tag_aggregate(meta: PlanMeta) -> None:
@@ -203,7 +221,9 @@ def _convert_aggregate(meta: PlanMeta, ch):
 from ..execs.aggregates import CpuHashAggregateExec as _CpuAgg  # noqa: E402
 
 register_exec(_CpuAgg, "hash aggregate", "spark.rapids.sql.exec.HashAggregateExec",
-              _tag_aggregate, _convert_aggregate)
+              _tag_aggregate, _convert_aggregate,
+              tpu_cls="execs.aggregates.TpuHashAggregateExec",
+              metrics=("sortTime", "reduceTime", "numGroups"))
 
 
 def _tag_hash_join(meta: PlanMeta) -> None:
@@ -277,7 +297,9 @@ from ..execs.joins import (CpuBroadcastNestedLoopJoinExec as _CpuBnlj,  # noqa: 
 
 register_exec(_CpuShj, "shuffled hash join",
               "spark.rapids.sql.exec.ShuffledHashJoinExec",
-              _tag_hash_join, _convert_hash_join)
+              _tag_hash_join, _convert_hash_join,
+              tpu_cls="execs.joins.TpuShuffledHashJoinExec",
+              metrics=("buildTime", "joinTime", "numPairs"))
 def _convert_broadcast_join(meta: PlanMeta, ch):
     from ..execs.broadcast import TpuBroadcastHashJoinExec
     p = meta.plan
@@ -289,7 +311,9 @@ from ..execs.broadcast import CpuBroadcastHashJoinExec as _CpuBhj  # noqa: E402
 
 register_exec(_CpuBhj, "broadcast hash join",
               "spark.rapids.sql.exec.BroadcastHashJoinExec",
-              _tag_hash_join, _convert_broadcast_join)
+              _tag_hash_join, _convert_broadcast_join,
+              tpu_cls="execs.broadcast.TpuBroadcastHashJoinExec",
+              metrics=("buildTime", "joinTime", "numPairs"))
 register_exec(_CpuBnlj, "broadcast nested loop join",
               "spark.rapids.sql.exec.BroadcastNestedLoopJoinExec",
               _tag_bnlj, _convert_bnlj)
@@ -305,7 +329,9 @@ from ..execs.joins import CpuCartesianProductExec as _CpuCart  # noqa: E402
 
 register_exec(_CpuCart, "cartesian product",
               "spark.rapids.sql.exec.CartesianProductExec",
-              _tag_bnlj, _convert_cartesian)
+              _tag_bnlj, _convert_cartesian,
+              tpu_cls="execs.joins.TpuCartesianProductExec",
+              metrics=("joinTime", "numPairs"))
 
 
 def _tag_write(meta: PlanMeta) -> None:
@@ -326,7 +352,9 @@ from ..execs.write import CpuDataWritingCommandExec as _CpuWrite  # noqa: E402
 
 register_exec(_CpuWrite, "data writing command",
               "spark.rapids.sql.exec.DataWritingCommandExec",
-              _tag_write, _convert_write)
+              _tag_write, _convert_write,
+              tpu_cls="execs.write.TpuDataWritingCommandExec",
+              metrics=("writeTime", "numFiles", "numWrittenRows"))
 
 
 def _convert_subquery_broadcast(meta: PlanMeta, ch):
@@ -374,7 +402,10 @@ from ..shuffle.exchange import CpuShuffleExchangeExec as _CpuExch  # noqa: E402
 
 register_exec(_CpuExch, "shuffle exchange",
               "spark.rapids.sql.exec.ShuffleExchangeExec",
-              _tag_exchange, _convert_exchange)
+              _tag_exchange, _convert_exchange,
+              tpu_cls="shuffle.exchange.TpuShuffleExchangeExec",
+              metrics=("partitionTime", "serializationTime",
+                       "deserializationTime"))
 
 
 def _tag_file_scan(meta: PlanMeta) -> None:
@@ -398,7 +429,9 @@ def _convert_file_scan(meta: PlanMeta, ch):
 from ..io.parquet import CpuFileScanExec as _CpuScan  # noqa: E402
 
 register_exec(_CpuScan, "file scan", "spark.rapids.sql.exec.FileSourceScanExec",
-              _tag_file_scan, _convert_file_scan)
+              _tag_file_scan, _convert_file_scan,
+              tpu_cls="io.parquet.TpuFileScanExec",
+              metrics=("scanTime", "uploadTime", "filesRead"))
 
 
 def _tag_window(meta: PlanMeta) -> None:
@@ -435,7 +468,8 @@ def _convert_window(meta: PlanMeta, ch):
 from ..execs.window import CpuWindowExec as _CpuWin  # noqa: E402
 
 register_exec(_CpuWin, "window", "spark.rapids.sql.exec.WindowExec",
-              _tag_window, _convert_window)
+              _tag_window, _convert_window,
+              tpu_cls="execs.window.TpuWindowExec")
 
 
 def _tag_generate(meta: PlanMeta) -> None:
@@ -468,7 +502,9 @@ from ..execs.generate import (CpuExpandExec as _CpuExpand,  # noqa: E402
                               CpuGenerateExec as _CpuGen)
 
 register_exec(_CpuGen, "generate", "spark.rapids.sql.exec.GenerateExec",
-              _tag_generate, _convert_generate)
+              _tag_generate, _convert_generate,
+              tpu_cls="execs.generate.TpuGenerateExec",
+              metrics=("numInputRows",))
 register_exec(_CpuExpand, "expand", "spark.rapids.sql.exec.ExpandExec",
               _tag_expand, _convert_expand)
 

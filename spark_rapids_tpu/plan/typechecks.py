@@ -9,6 +9,8 @@ SupportedOpsDocs (TypeChecks.scala:1709).
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Dict, Optional, Type
 
 from ..types import TypeSig, TypeSigs
@@ -18,18 +20,30 @@ _EXPR_RULES: Dict[type, "ExprRule"] = {}
 
 class ExprRule:
     def __init__(self, cls: type, type_sig: Optional[TypeSig], desc: str,
-                 incompat: Optional[str] = None, host_assisted: bool = False):
+                 incompat: Optional[str] = None, host_assisted: bool = False,
+                 provenance: str = "?"):
         self.cls = cls
         self.type_sig = type_sig
         self.desc = desc
         self.incompat = incompat
         self.host_assisted = host_assisted  # correct but runs partly on host
+        #: file:line of the register_expr call — tools/tracelint.py points
+        #: its declaration-conflict findings here so a wrong host_assisted
+        #: flag is a one-click fix (reference: supported_ops.md rows link
+        #: back to the GpuOverrides expr[...] registration)
+        self.provenance = provenance
+
+
+def _caller_provenance() -> str:
+    f = sys._getframe(2)
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
 
 
 def register_expr(cls: type, type_sig: Optional[TypeSig], desc: str,
                   incompat: Optional[str] = None,
                   host_assisted: bool = False) -> None:
-    _EXPR_RULES[cls] = ExprRule(cls, type_sig, desc, incompat, host_assisted)
+    _EXPR_RULES[cls] = ExprRule(cls, type_sig, desc, incompat, host_assisted,
+                                provenance=_caller_provenance())
 
 
 def is_expr_registered(cls: type) -> bool:

@@ -284,3 +284,32 @@ def test_fused_segment_metrics_registered():
     for name in ("opJitCacheHits", "opJitCacheMisses", "opJitTraceTime",
                  "opFusedBatches", "opFusedFallbackOps"):
         assert name in seg.metrics
+
+
+def test_misdeclared_host_assisted_flag_splits_segment():
+    """The regression tracelint's TL002 warning guards (docs/analysis.md):
+    flagging a fully-traceable expression host_assisted makes opjit/fusion
+    split every fused segment containing it — dispatch count rises while
+    results stay bit-identical.  The registry cross-check keeps this from
+    happening silently; this asserts the cost is real."""
+    from spark_rapids_tpu.expressions.arithmetic import Multiply
+    from spark_rapids_tpu.plan import typechecks
+
+    def run():
+        opjit.clear_cache()
+        before = opjit.cache_stats()
+        out = _chain(TpuSession(_conf()), parts=1).collect()
+        return out, sum(_kind_delta(before, opjit.cache_stats()).values())
+
+    good, n_good = run()
+    rule = typechecks._EXPR_RULES[Multiply]
+    assert not rule.host_assisted  # tracelint-verified declaration
+    rule.host_assisted = True
+    try:
+        bad, n_bad = run()
+    finally:
+        rule.host_assisted = False
+    assert bad == good  # correctness never depends on the flag
+    # the chain contains `v * 2 + 1`: a wrongly host_assisted Multiply
+    # forces the segment apart into extra per-op/segment programs
+    assert n_bad > n_good, (n_bad, n_good)

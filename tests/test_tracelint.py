@@ -1,0 +1,530 @@
+"""tracelint static-analysis tier (reference SURVEY §4 tier 4 — the
+api_validation/TypeChecks analogue for trace safety):
+
+* every detector exercised on a synthetic true positive AND a near miss;
+* conditionality (guard-with-early-return, ternary arms, scalar-fold);
+* baseline add/remove round-trip through the CLI;
+* registry cross-check over the REAL tree: zero non-baselined findings;
+* a seeded host-sync injected into a device-declared expression makes
+  `tools.tracelint.main` exit non-zero;
+* static verdicts agree with the jax.eval_shape corroboration probe for
+  every registered expression not in the baseline;
+* concurrency lint fixtures + clean real tree;
+* the extended api_validation contracts (declared exec metrics, unevaluable
+  expressions never claim a kernel)."""
+
+import importlib.util
+import os
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from spark_rapids_tpu.analysis import (CONDITIONAL_HOST, DEVICE, HOST,
+                                       UNTRACEABLE, lint_module_source,
+                                       lint_tree, scan_source)
+
+from tools import tracelint
+
+
+# ---------------------------------------------------------------------------
+# detector fixtures: one true positive + one near miss each
+# ---------------------------------------------------------------------------
+
+_PRELUDE = """\
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+import pyarrow.compute as pc
+from spark_rapids_tpu.columnar.vector import TpuScalar
+"""
+
+
+def _verdict(body: str, fn: str = "f"):
+    reports = scan_source(_PRELUDE + textwrap.dedent(body))
+    return reports[fn]
+
+
+def _detectors(rep):
+    return {d.detector for d in rep.detections}
+
+
+def test_np_on_device_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n    return np.asarray(col.data)\n")
+    assert "np-on-device" in _detectors(tp) and tp.verdict == HOST
+    nm = _verdict("def f(col):\n    lut = np.asarray([1, 2, 3])\n"
+                  "    return jnp.asarray(lut)[col.data]\n")
+    assert "np-on-device" not in _detectors(nm) and nm.verdict == DEVICE
+
+
+def test_device_get_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n    return jax.device_get(col.data)\n")
+    assert "device-get" in _detectors(tp) and tp.verdict == HOST
+    nm = _verdict("def f(col):\n    return jax.jit(lambda x: x)(col.data)\n")
+    assert "device-get" not in _detectors(nm)
+
+
+def test_host_method_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n    return col.to_arrow()\n")
+    assert "host-method" in _detectors(tp) and tp.verdict == HOST
+    # to_arrow as a *type* conversion of untainted metadata is not a hop
+    nm = _verdict("def f(col):\n    return to_arrow(col.dtype)\n")
+    assert "host-method" not in _detectors(nm) and nm.verdict == DEVICE
+
+
+def test_pyarrow_on_device_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n    return pc.fill_null(col.data, 0)\n")
+    assert "pyarrow-on-device" in _detectors(tp) and tp.verdict == HOST
+    nm = _verdict("def f(col):\n    sep = pa.array(['a', 'b'])\n"
+                  "    return sep\n")
+    assert "pyarrow-on-device" not in _detectors(nm)
+
+
+def test_py_coercion_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n"
+                  "    if bool(jnp.any(col.data)):\n"
+                  "        raise ValueError('x')\n"
+                  "    return col\n")
+    assert "py-coercion" in _detectors(tp)
+    # coercion of host metadata is fine
+    nm = _verdict("def f(col):\n    return int(col.num_rows)\n")
+    assert "py-coercion" not in _detectors(nm) and nm.verdict == DEVICE
+
+
+def test_value_dependent_branch_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n"
+                  "    if col.data.sum():\n"
+                  "        return col\n"
+                  "    return col\n")
+    assert "value-dependent-branch" in _detectors(tp)
+    assert tp.verdict == UNTRACEABLE
+    # structural tests are exempt: isinstance, `is None`, metadata attrs
+    nm = _verdict("def f(col):\n"
+                  "    if isinstance(col, TpuScalar) or col.validity is None:\n"
+                  "        return col\n"
+                  "    return col\n")
+    assert "value-dependent-branch" not in _detectors(nm)
+    assert nm.verdict == DEVICE
+
+
+def test_per_row_loop_true_positive_and_near_miss():
+    tp = _verdict("def f(col):\n"
+                  "    out = 0\n"
+                  "    for x in col.data:\n"
+                  "        out = out + x\n"
+                  "    return out\n")
+    assert "per-row-loop" in _detectors(tp) and tp.verdict == UNTRACEABLE
+    # iterating a python list OF columns is a loop over operators, not rows
+    nm = _verdict("def f(col):\n"
+                  "    acc = jnp.zeros((col.capacity,))\n"
+                  "    for c in [col, col]:\n"
+                  "        acc = acc + c.data\n"
+                  "    return acc\n")
+    assert "per-row-loop" not in _detectors(nm) and nm.verdict == DEVICE
+
+
+def test_host_helper_call_true_positive_and_near_miss():
+    src = """\
+    def _sync(x):
+        return x.to_arrow()
+
+    def _pure(x):
+        return jnp.abs(x.data)
+
+    def f(col):
+        return _sync(col)
+
+    def g(col):
+        return _pure(col)
+    """
+    reports = scan_source(_PRELUDE + textwrap.dedent(src))
+    assert "host-helper-call" in _detectors(reports["f"])
+    assert reports["f"].verdict == HOST
+    assert "host-helper-call" not in _detectors(reports["g"])
+    assert reports["g"].verdict == DEVICE
+
+
+# ---------------------------------------------------------------------------
+# conditionality
+# ---------------------------------------------------------------------------
+
+def test_guard_with_early_return_makes_host_tail_conditional():
+    """The dominant expressions/ idiom: device path behind a guard, host
+    fallback as the lexically-unconditional tail."""
+    rep = _verdict("def f(col):\n"
+                   "    if col.offsets is None:\n"
+                   "        return jnp.abs(col.data)\n"
+                   "    return col.to_arrow()\n")
+    assert rep.verdict == CONDITIONAL_HOST  # not HOST
+
+
+def test_ternary_arms_are_conditional():
+    rep = _verdict("def f(col):\n"
+                   "    return (col.to_arrow() if col.validity is None"
+                   " else jnp.abs(col.data))\n")
+    assert rep.verdict == CONDITIONAL_HOST
+
+
+def test_scalar_fold_branch_is_not_a_sync():
+    """Inside `isinstance(x, TpuScalar)` the value is a host scalar — the
+    constant-fold idiom of base.BinaryExpression must stay `device`."""
+    rep = _verdict("def f(col):\n"
+                   "    if isinstance(col, TpuScalar):\n"
+                   "        return float(col.value)\n"
+                   "    return jnp.abs(col.data)\n")
+    assert rep.verdict == DEVICE
+
+
+def test_unconditional_host_tail_without_guard_is_host():
+    rep = _verdict("def f(col):\n"
+                   "    x = jnp.abs(col.data)\n"
+                   "    return np.asarray(x)\n")
+    assert rep.verdict == HOST
+
+
+# ---------------------------------------------------------------------------
+# registry cross-check over the real tree
+# ---------------------------------------------------------------------------
+
+def test_real_tree_has_zero_non_baselined_findings():
+    """The acceptance gate: `python -m tools.tracelint` exits 0 on the tree
+    with the checked-in (explicit, commented) baseline."""
+    reports, findings, _ = tracelint.collect_findings()
+    baseline = set(tracelint.load_baseline())
+    fresh = [f for f in findings
+             if f.severity in ("error", "warning") and f.key not in baseline]
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    assert len(reports) > 150  # the whole registry was actually analyzed
+
+
+def test_registered_host_assisted_flags_are_all_backed_by_host_verdicts():
+    """No declared host_assisted flag sits on a fully-device implementation
+    (the TL002 fusion-split regression)."""
+    reports, _, _ = tracelint.collect_findings()
+    wrong = [r.location for r in reports
+             if r.declared_host_assisted and r.verdict == DEVICE]
+    assert wrong == []
+
+
+# ---------------------------------------------------------------------------
+# seeded host-sync injection + baseline round-trip through the CLI
+# ---------------------------------------------------------------------------
+
+_SEEDED = """\
+import numpy as np
+import jax.numpy as jnp
+from spark_rapids_tpu.expressions.base import UnaryExpression, _DEFAULT_CTX
+from spark_rapids_tpu.expressions.base import make_column, combine_validity
+from spark_rapids_tpu.columnar.vector import row_mask
+from spark_rapids_tpu.types import IntegerT
+
+
+class SeededHostSync(UnaryExpression):
+    @property
+    def dtype(self):
+        return IntegerT
+
+    def eval_tpu(self, batch, ctx=_DEFAULT_CTX):
+        c = self.child.eval_tpu(batch, ctx)
+        host = np.asarray(c.data)  # seeded device->host sync
+        valid = combine_validity(batch.capacity, c.validity,
+                                 row_mask(batch.num_rows, batch.capacity))
+        return make_column(IntegerT, jnp.asarray(host), valid,
+                           batch.num_rows)
+"""
+
+
+@pytest.fixture
+def seeded_host_sync(tmp_path):
+    """Import a fixture module with an unconditional host sync and register
+    it as a device-supported expression; unregister afterwards so the docs
+    drift / api_validation tests never see it."""
+    from spark_rapids_tpu.plan import typechecks
+    from spark_rapids_tpu.types import TypeSigs
+    path = tmp_path / "seeded_host_sync_fixture.py"
+    path.write_text(_SEEDED)
+    spec = importlib.util.spec_from_file_location("seeded_host_sync_fixture",
+                                                 str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    cls = mod.SeededHostSync
+    typechecks.register_expr(cls, TypeSigs.integral,
+                             "seeded host sync (test fixture)")
+    try:
+        yield cls
+    finally:
+        del typechecks._EXPR_RULES[cls]
+
+
+def test_seeded_host_sync_fails_and_baseline_roundtrip(seeded_host_sync,
+                                                       tmp_path, capsys):
+    baseline = str(tmp_path / "baseline.txt")
+    # keep the real baseline's entries so tree findings stay suppressed
+    with open(tracelint.BASELINE_PATH) as f:
+        open(baseline, "w").write(f.read())
+
+    # seeded host-sync in a device-declared expression => non-zero exit
+    assert tracelint.main(["--baseline", baseline]) == 1
+    out = capsys.readouterr().out
+    assert "TL001" in out and "SeededHostSync" in out
+
+    # baseline ADD round-trip: --update-baseline suppresses it
+    assert tracelint.main(["--update-baseline", "--baseline", baseline]) == 0
+    assert tracelint.main(["--baseline", baseline]) == 0
+    capsys.readouterr()
+
+    # baseline REMOVE round-trip: once the expression is fixed (here:
+    # unregistered via another update) the stale entry is reported, not fatal
+    keys = tracelint.load_baseline(baseline)
+    assert any("SeededHostSync" in k for k in keys)
+
+
+def test_stale_baseline_entry_is_reported_not_fatal(tmp_path, capsys):
+    baseline = str(tmp_path / "baseline.txt")
+    tracelint.write_baseline(
+        ["TL001 expressions.nowhere::DoesNotExist"], baseline,
+        comments={"TL001 expressions.nowhere::DoesNotExist": "stale test"})
+    assert tracelint.main(["--baseline", baseline]) == 0
+    assert "STALE" in capsys.readouterr().out
+
+
+def test_baseline_comments_survive_update(tmp_path):
+    baseline = str(tmp_path / "baseline.txt")
+    key = "TL001 expressions.nowhere::DoesNotExist"
+    tracelint.write_baseline([key], baseline, comments={key: "why: reasons"})
+    loaded = tracelint.load_baseline(baseline)
+    assert loaded == [key]
+    with open(baseline) as f:
+        assert "# why: reasons" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# dynamic corroboration (jax.eval_shape)
+# ---------------------------------------------------------------------------
+
+def test_static_verdicts_agree_with_eval_shape_probe():
+    """Acceptance: the static verdict agrees with the jax.eval_shape probe
+    for every registered expression not in the baseline."""
+    from spark_rapids_tpu.analysis import analyze_registry, corroborate
+    reports, _ = analyze_registry()
+    results, disagreements = corroborate(reports)
+    baseline = set(tracelint.load_baseline())
+    fresh = [f for f in disagreements if f.key not in baseline]
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    # the probe must actually corroborate a substantial slice, not skip all
+    assert sum(1 for r in results.values() if r.status == "traceable") >= 40
+
+
+def test_probe_flags_the_seeded_sync_dynamically(seeded_host_sync):
+    from spark_rapids_tpu.analysis.probe import probe_class
+    res = probe_class(seeded_host_sync)
+    assert res.status == "untraceable"
+
+
+# ---------------------------------------------------------------------------
+# concurrency lint
+# ---------------------------------------------------------------------------
+
+_CONC_UNLOCKED = """\
+import threading
+
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+def put(k, v):
+    _CACHE[k] = v
+"""
+
+_CONC_LOCKED = _CONC_UNLOCKED.replace(
+    "def put(k, v):\n    _CACHE[k] = v",
+    "def put(k, v):\n    with _LOCK:\n        _CACHE[k] = v")
+
+_CONC_LOCAL = """\
+def put(k, v):
+    cache = {}
+    cache[k] = v
+    return cache
+"""
+
+
+def test_concurrency_lint_fixtures():
+    assert [f.rule for f in lint_module_source(_CONC_UNLOCKED, "m.py")] \
+        == ["TL010"]
+    assert lint_module_source(_CONC_LOCKED, "m.py") == []
+    assert lint_module_source(_CONC_LOCAL, "m.py") == []
+
+
+def test_concurrency_lint_methods_and_aug_and_del():
+    src = _CONC_UNLOCKED + textwrap.dedent("""\
+
+    class C:
+        def bump(self, k):
+            _CACHE[k] += 1
+
+        def drop(self, k):
+            del _CACHE[k]
+
+        def safe(self, k):
+            with _LOCK:
+                _CACHE.pop(k, None)
+    """)
+    findings = lint_module_source(src, "m.py")
+    locs = {f.location for f in findings}
+    assert "m.py::C.bump" in locs and "m.py::C.drop" in locs
+    assert not any("C.safe" in loc for loc in locs)
+
+
+def test_concurrency_lint_real_tree_is_clean():
+    """The PR that introduced the lint fixed everything it found (opjit
+    _TRACE_CTXS/_evict, compiled/compiled_join caches) — keep it that way."""
+    assert [f.render() for f in lint_tree()] == []
+
+
+# ---------------------------------------------------------------------------
+# extended api_validation contracts
+# ---------------------------------------------------------------------------
+
+def _api_validation():
+    tools_dir = os.path.join(ROOT, "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import api_validation
+        return api_validation
+    finally:
+        while tools_dir in sys.path:
+            sys.path.remove(tools_dir)
+
+
+def test_exec_rule_declared_metric_must_exist():
+    api_validation = _api_validation()
+    from spark_rapids_tpu.plan import overrides
+
+    class _FakeCpuExec(overrides.CpuExec):
+        def execute_partition(self, idx, ctx):
+            return iter(())
+
+        @property
+        def output(self):
+            return []
+
+    overrides.register_exec(
+        _FakeCpuExec, "fake", "spark.rapids.sql.exec.ProjectExec",
+        convert=lambda m, ch: None,
+        tpu_cls="execs.sort.TpuSortExec",
+        metrics=("sortTime", "definitelyNotAMetric"))
+    try:
+        violations = api_validation.validate()
+    finally:
+        del overrides._EXEC_RULES[_FakeCpuExec]
+    assert any("definitelyNotAMetric" in v for v in violations)
+    assert not any("declared metric 'sortTime'" in v for v in violations)
+
+
+def test_unevaluable_expression_must_not_claim_a_kernel():
+    api_validation = _api_validation()
+    from spark_rapids_tpu.expressions.base import UnaryExpression
+    from spark_rapids_tpu.plan import typechecks
+    from spark_rapids_tpu.types import IntegerT, TypeSigs
+
+    class _FakeUnevaluable(UnaryExpression):
+        unevaluable = True
+
+        @property
+        def dtype(self):
+            return IntegerT
+
+        def eval_tpu(self, batch, ctx=None):  # contradiction under test
+            raise AssertionError("never runs")
+
+    typechecks.register_expr(_FakeUnevaluable, TypeSigs.integral,
+                             "fake unevaluable", host_assisted=True)
+    try:
+        violations = api_validation.validate()
+    finally:
+        del typechecks._EXPR_RULES[_FakeUnevaluable]
+    assert any("unevaluable but overrides eval_tpu" in v for v in violations)
+    assert any("unevaluable but flagged host_assisted" in v
+               for v in violations)
+
+
+def test_rule_provenance_points_into_typechecks():
+    from spark_rapids_tpu.plan.typechecks import all_expr_rules
+    provs = {r.provenance for r in all_expr_rules().values()}
+    assert all(p.startswith("typechecks.py:") for p in provs), provs
+
+
+def test_execution_mode_column_in_docs():
+    from spark_rapids_tpu.analysis import execution_modes
+    modes = execution_modes()
+    from spark_rapids_tpu.expressions.mathexprs import Sqrt
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from spark_rapids_tpu.expressions.strings import FormatNumber
+    assert modes[Sum] == "exec-driven"
+    assert modes[FormatNumber] == "host-assisted"
+    assert modes[Sqrt] in ("device", "device / host fallback")
+    with open(os.path.join(ROOT, "docs", "supported_ops.md")) as f:
+        txt = f.read()
+    assert "| Execution mode |" in txt or "Execution mode" in txt
+
+
+def test_kernels_scan_covers_modules():
+    """Tentpole coverage: kernel implementations under kernels/ are
+    AST-classified too (informational — their host-ness is priced by the
+    calling expression's registry entry)."""
+    from spark_rapids_tpu.analysis.registry_check import scan_kernels
+    kernels = scan_kernels()
+    assert any(m.endswith("strings.py") for m in kernels)
+    assert any(m.endswith("decimal128.py") for m in kernels)
+    all_fns = {fn: v for fns in kernels.values() for fn, v in fns.items()}
+    assert len(all_fns) >= 30
+    assert set(all_fns.values()) <= {"device", "conditional-host", "host",
+                                     "untraceable"}
+
+
+def test_taint_acquired_in_branch_survives_the_join():
+    """A device value assigned under an `if` is still a device value after
+    it: the unconditional host sync below must not be missed."""
+    rep = _verdict("def f(col, flag):\n"
+                   "    d = None\n"
+                   "    if flag:\n"
+                   "        d = col.data\n"
+                   "    return np.asarray(d)\n")
+    assert "np-on-device" in _detectors(rep)
+
+
+def test_compute_method_params_are_seeded_as_device_values():
+    """classify_class seeds `_compute(self, ldata, rdata, ...)` operands from
+    the signature — host ops on them must be visible, not just on `batch`."""
+    import importlib.util as _ilu
+    import tempfile
+    src = textwrap.dedent("""\
+        import numpy as np
+        from spark_rapids_tpu.expressions.base import BinaryExpression
+        from spark_rapids_tpu.types import IntegerT
+
+
+        class ComputeHostSync(BinaryExpression):
+            @property
+            def dtype(self):
+                return IntegerT
+
+            def _compute(self, ldata, rdata, ctx, valid):
+                return np.asarray(ldata) + np.asarray(rdata)
+        """)
+    with tempfile.NamedTemporaryFile("w", suffix="_chs.py",
+                                     delete=False) as f:
+        f.write(src)
+        path = f.name
+    spec = _ilu.spec_from_file_location("compute_host_sync_fixture", path)
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from spark_rapids_tpu.analysis import HOST, classify_class
+    verdict, _, reports = classify_class(mod.ComputeHostSync)
+    assert verdict == HOST, [(r.qualname, r.verdict) for r in reports]
+    os.unlink(path)

@@ -1,0 +1,3 @@
+"""Build/CI tooling package (`python -m tools.tracelint`, gen_docs,
+api_validation).  The modules also run standalone via `python tools/x.py` —
+each inserts the repo root on sys.path itself."""

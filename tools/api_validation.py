@@ -17,11 +17,63 @@ Run as a script (exits non-zero on violations) or through
 `validate() -> List[str]` from the test suite (SURVEY §4 tier 4).
 """
 
+import ast
+import inspect
 import os
 import sys
+import textwrap
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _resolve_tpu_cls(dotted: str):
+    """'execs.sort.TpuSortExec' → class, imported under spark_rapids_tpu."""
+    import importlib
+    mod_path, _, cls_name = dotted.rpartition(".")
+    mod = importlib.import_module(f"spark_rapids_tpu.{mod_path}")
+    return getattr(mod, cls_name)
+
+
+def _metric_names_of(cls) -> set:
+    """Metric names the class registers: the base set from
+    PhysicalPlan._register_metrics plus every string key its
+    `additional_metrics` overrides mention, collected by AST along the MRO
+    (the methods build literal dicts / subscript-assign literal keys, and
+    instantiating every exec generically is not possible)."""
+    from spark_rapids_tpu.execs.base import TpuExec
+    names = {"numOutputRows", "numOutputBatches", "opTime"}
+    if issubclass(cls, TpuExec):
+        names |= {"opJitCacheHits", "opJitCacheMisses", "opJitTraceTime"}
+    for k in cls.__mro__:
+        fn = k.__dict__.get("additional_metrics")
+        if fn is None:
+            continue
+        try:
+            tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+        except (OSError, SyntaxError, TypeError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str):
+                        names.add(key.value)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.slice, ast.Constant) \
+                            and isinstance(t.slice.value, str):
+                        names.add(t.slice.value)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "dict":
+                # dict(buildTime="MODERATE", ...) kwargs ARE metric names;
+                # kwargs of arbitrary calls are not
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        names.add(kw.arg)
+    return names
 
 
 def validate():
@@ -54,13 +106,45 @@ def validate():
         if rule._convert is None:  # rule.convert is a bound wrapper — check
             violations.append(     # the actual registered callable
                 f"exec {cls.__name__}: rule has no convert fn")
+        if rule.metrics and not rule.tpu_cls:
+            violations.append(
+                f"exec {cls.__name__}: rule declares metrics "
+                f"{rule.metrics} but no tpu_cls to check them against")
+        if rule.tpu_cls:
+            try:
+                tpu_cls = _resolve_tpu_cls(rule.tpu_cls)
+            except (ImportError, AttributeError) as e:
+                violations.append(
+                    f"exec {cls.__name__}: tpu_cls {rule.tpu_cls!r} does "
+                    f"not resolve ({e})")
+            else:
+                have = _metric_names_of(tpu_cls)
+                for m in rule.metrics:
+                    if m not in have:
+                        violations.append(
+                            f"exec {cls.__name__}: declared metric {m!r} "
+                            f"is not registered by {rule.tpu_cls} "
+                            f"(has: {sorted(have)})")
 
     # expression rules ----------------------------------------------------
     base_eval_tpu = Expression.eval_tpu
     base_eval_cpu = Expression.eval_cpu
     for cls, rule in all_expr_rules().items():
         if getattr(cls, "unevaluable", False):
-            continue  # structural: driven by its exec (reference Unevaluable)
+            # structural: driven by its exec (reference Unevaluable) — it
+            # must not ALSO claim a kernel: an eval_tpu override or a
+            # host_assisted flag on an unevaluable expression is dead code
+            # that would mislead the tagging/pricing layers
+            if "eval_tpu" in cls.__dict__:  # own override only — inheriting
+                violations.append(         # an evaluable base is not a claim
+                    f"expression {cls.__name__}: unevaluable but overrides "
+                    f"eval_tpu — the kernel can never run (drop one)")
+            if rule.host_assisted:
+                violations.append(
+                    f"expression {cls.__name__}: unevaluable but flagged "
+                    f"host_assisted — the flag implies an eval path that "
+                    f"does not exist")
+            continue
         has_tpu = cls.eval_tpu is not base_eval_tpu
         has_cpu = cls.eval_cpu is not base_eval_cpu
         supported = getattr(cls, "tpu_supported", True)

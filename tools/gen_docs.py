@@ -22,6 +22,7 @@ def gen_configs_md() -> str:
 def gen_supported_ops_md() -> str:
     import jax
     jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.analysis import execution_modes
     from spark_rapids_tpu.plan.typechecks import all_expr_rules
     from spark_rapids_tpu.plan.overrides import exec_rules
     lines = ["# Supported Operators and Expressions", "",
@@ -30,16 +31,24 @@ def gen_supported_ops_md() -> str:
              "|---|---|---|"]
     for cls, rule in sorted(exec_rules().items(), key=lambda kv: kv[0].__name__):
         lines.append(f"| {cls.__name__} | {_md(rule.desc)} | {rule.conf_key} |")
+    # execution mode column: registry flag + the tracelint analyzer's static
+    # verdict over the actual eval_tpu implementation (docs/analysis.md) —
+    # "device" (fully traceable), "device / host fallback" (guarded host
+    # path), "host" / "host-assisted", "exec-driven" (unevaluable),
+    # "cpu fallback" (no kernel)
+    modes = execution_modes()
     lines += ["", "## Expressions", "",
-              "| Expression | Description | Notes |", "|---|---|---|"]
+              "| Expression | Description | Execution mode | Notes |",
+              "|---|---|---|---|"]
     for cls, rule in sorted(all_expr_rules().items(),
                             key=lambda kv: kv[0].__name__):
+        # host_assisted is already the "host-assisted" execution mode — no
+        # separate note needed
         notes = []
         if rule.incompat:
             notes.append(f"incompat: {rule.incompat}")
-        if rule.host_assisted:
-            notes.append("host-assisted")
-        lines.append(f"| {cls.__name__} | {_md(rule.desc)} | {_md('; '.join(notes))} |")
+        lines.append(f"| {cls.__name__} | {_md(rule.desc)} | "
+                     f"{modes.get(cls, '?')} | {_md('; '.join(notes))} |")
     return "\n".join(lines) + "\n"
 
 

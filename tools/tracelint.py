@@ -1,0 +1,196 @@
+"""tracelint: trace-safety & registry-consistency static analyzer (CLI).
+
+The reference plugin gates merges on dedicated static analysis
+(api_validation/ApiValidation.scala, the TypeChecks.scala-generated docs).
+Our equivalent failure mode after the opjit/fusion PRs is a silent
+performance cliff: plan/typechecks.py `host_assisted` declarations decide
+where execs/opjit.py and execs/fusion.py split traces, and nothing checked
+them against the ~20 modules of eval_tpu implementations.  This tool does:
+
+  registry cross-check  TL001 declared-device-but-unconditional-host (error)
+                        TL002 declared-host-but-fully-traceable     (warning)
+                        TL003 implemented-but-unregistered          (error)
+                        TL004 device-with-guarded-host-fallback     (info)
+  corroboration         TL005 static vs jax.eval_shape disagreement (error,
+                        with --corroborate)
+  concurrency lint      TL010 module-level mutable state mutated outside a
+                        lock in shuffle/ memory/ execs/             (error)
+
+Findings diff against tools/tracelint_baseline.txt (one key per line, `#`
+comments allowed) so exceptions are explicit.  Exit status is non-zero iff
+any non-baselined error/warning finding exists (info never gates).
+
+Usage:
+  python -m tools.tracelint                 # static passes + baseline diff
+  python -m tools.tracelint --corroborate   # + jax.eval_shape probe (TL005)
+  python -m tools.tracelint --update-baseline
+  python -m tools.tracelint --verbose       # include info findings + modes
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tracelint_baseline.txt")
+
+_BASELINE_HEADER = """\
+# tracelint baseline — explicit exceptions to the trace-safety analyzer.
+#
+# One finding key per line: "<RULE> <location>".  A listed finding is
+# reported (with --verbose) but never fails the run; an unlisted error or
+# warning fails `python -m tools.tracelint` and the CI fast tier.
+# Regenerate with `python -m tools.tracelint --update-baseline`, but keep
+# the per-entry comments explaining WHY each exception is acceptable —
+# an uncommented entry is a review smell.
+"""
+
+
+def load_baseline(path=BASELINE_PATH):
+    keys = []
+    if not os.path.exists(path):
+        return keys
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                keys.append(line)
+    return keys
+
+
+def write_baseline(keys, path=BASELINE_PATH, comments=None):
+    """Rewrite the baseline preserving nothing but the header; `comments`
+    maps key -> trailing comment."""
+    comments = comments or {}
+    with open(path, "w") as f:
+        f.write(_BASELINE_HEADER)
+        for k in sorted(keys):
+            c = comments.get(k)
+            f.write(f"{k}  # {c}\n" if c else f"{k}\n")
+
+
+def collect_findings(corroborate=False):
+    """All findings from every pass, plus the expression reports."""
+    from spark_rapids_tpu.analysis import (analyze_registry, lint_tree)
+    reports, findings = analyze_registry()
+    findings = list(findings)
+    findings.extend(lint_tree())
+    probe_results = None
+    if corroborate:
+        from spark_rapids_tpu.analysis import corroborate as _corr
+        probe_results, probe_findings = _corr(reports)
+        findings.extend(probe_findings)
+    return reports, findings, probe_results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tracelint", description=__doc__)
+    ap.add_argument("--corroborate", action="store_true",
+                    help="probe registered expressions with jax.eval_shape "
+                         "and report static/dynamic disagreements (TL005)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite tools/tracelint_baseline.txt with the "
+                         "current error/warning findings (comments reset!)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also show info findings, baselined findings and "
+                         "the per-expression verdict table")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file (default: tools/tracelint_baseline.txt)")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    reports, findings, probe_results = collect_findings(args.corroborate)
+    baseline = set(load_baseline(args.baseline))
+
+    gating = [f for f in findings if f.severity in ("error", "warning")]
+    info = [f for f in findings if f.severity == "info"]
+    fresh = [f for f in gating if f.key not in baseline]
+    suppressed = [f for f in gating if f.key in baseline]
+    present = {f.key for f in gating}
+    # TL005 only exists when the probe ran: without --corroborate those
+    # baseline entries are neither present nor stale — leave them alone
+    stale = sorted(k for k in baseline if k not in present
+                   and not (k.startswith("TL005 ") and not args.corroborate))
+
+    if args.update_baseline:
+        old = load_baseline(args.baseline)
+        # keep existing entries that still fire (and their comments, by
+        # re-reading raw lines), add the new ones uncommented
+        comments = {}
+        if os.path.exists(args.baseline):
+            with open(args.baseline) as f:
+                for line in f:
+                    if "#" in line and not line.lstrip().startswith("#"):
+                        key, c = line.split("#", 1)
+                        comments[key.strip()] = c.strip()
+        keep = [k for k in old if k in present
+                or (k.startswith("TL005 ") and not args.corroborate)]
+        write_baseline(sorted(set(keep) | {f.key for f in fresh}),
+                       args.baseline, comments)
+        print(f"baseline updated: {len(fresh)} added, {len(stale)} removed, "
+              f"{len(keep)} kept -> {args.baseline}")
+        return 0
+
+    n_dev = sum(1 for r in reports if r.verdict == "device")
+    n_cond = sum(1 for r in reports if r.verdict == "conditional-host")
+    n_host = len(reports) - n_dev - n_cond
+    print(f"tracelint: {len(reports)} registered expressions analyzed "
+          f"({n_dev} device / {n_cond} conditional-host / {n_host} host or "
+          f"untraceable), {len(findings)} raw findings")
+    from spark_rapids_tpu.analysis.registry_check import scan_kernels
+    kernels = scan_kernels()
+    k_all = [(m, fn, v) for m, fns in kernels.items()
+             for fn, v in fns.items()]
+    k_dev = sum(1 for _, _, v in k_all if v == "device")
+    print(f"kernels: {len(k_all)} public kernel functions across "
+          f"{len(kernels)} modules ({k_dev} device-traceable)")
+    if args.verbose:
+        for m, fn, v in k_all:
+            if v != "device":
+                print(f"  [kernel] {m}::{fn}: {v}")
+    if probe_results is not None:
+        n_tr = sum(1 for r in probe_results.values() if r.status == "traceable")
+        n_un = sum(1 for r in probe_results.values()
+                   if r.status == "untraceable")
+        n_sk = len(probe_results) - n_tr - n_un
+        print(f"corroboration: {n_tr} traceable / {n_un} untraceable / "
+              f"{n_sk} skipped by the jax.eval_shape probe")
+
+    for f in fresh:
+        print(f.render())
+    if args.verbose:
+        for f in suppressed:
+            print(f"(baselined) {f.render()}")
+        for f in info:
+            print(f.render())
+        print()
+        for r in sorted(reports, key=lambda r: r.location):
+            flags = []
+            if r.declared_host_assisted:
+                flags.append("host_assisted")
+            if r.string_layout:
+                flags.append("string-layout")
+            if r.trace_relevant:
+                flags.append("trace-relevant")
+            print(f"  {r.location:55s} {r.verdict:17s} {' '.join(flags)}")
+    for k in stale:
+        print(f"[STALE  ] baseline entry no longer fires: {k}")
+
+    if fresh:
+        print(f"\nFAIL: {len(fresh)} non-baselined finding(s). Fix them or "
+              f"add to {os.path.relpath(args.baseline)} WITH a comment.")
+        return 1
+    print(f"ok: no non-baselined findings "
+          f"({len(suppressed)} baselined, {len(info)} info, "
+          f"{len(stale)} stale)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
