@@ -36,6 +36,14 @@ python -m pytest \
   tests/test_shuffle.py tests/test_tracelint.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
+echo "== chaos tier (fixed-seed fault injection) =="
+# Seeded chaos soak (docs/robustness.md): injection armed at every site
+# across several fixed seeds; representative queries must stay bit-identical
+# to a clean run with zero leaks and all semaphore permits returned, and
+# corrupted/truncated shuffle blocks must heal via lineage recompute.
+python -m pytest tests/test_chaos.py \
+  -x -q -m 'not slow' -p no:cacheprovider
+
 echo "== tests (+ leak gate) =="
 # SRT_LEAK_GATE makes conftest fail the run when the process-wide
 # MemoryCleaner still tracks live device resources after the last test

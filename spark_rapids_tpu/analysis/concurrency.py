@@ -29,8 +29,14 @@ from typing import Iterable, List, Optional, Set, Tuple
 from .astwalk import ModuleIndex
 from .registry_check import Finding
 
-#: packages the lint covers (relative to the spark_rapids_tpu package root)
-DEFAULT_SUBPACKAGES = ("shuffle", "memory", "execs")
+#: packages the lint covers (relative to the spark_rapids_tpu package root).
+#: chaos/ holds the fault injector's process-wide singleton + trace state,
+#: reached from every pool thread via the woven injection sites.
+DEFAULT_SUBPACKAGES = ("shuffle", "memory", "execs", "chaos")
+
+#: top-level modules with shared state the lint also covers: failure.py's
+#: device-retry path runs on exchange pool threads and prefetch workers.
+DEFAULT_MODULES = ("failure.py", "profiling.py")
 
 _MUTABLE_CTORS = frozenset((
     "dict", "list", "set", "OrderedDict", "defaultdict", "deque", "Counter",
@@ -194,7 +200,8 @@ def lint_module_source(source: str, relpath: str) -> List[Finding]:
 
 
 def lint_tree(root: Optional[str] = None,
-              subpackages: Tuple[str, ...] = DEFAULT_SUBPACKAGES
+              subpackages: Tuple[str, ...] = DEFAULT_SUBPACKAGES,
+              modules: Tuple[str, ...] = DEFAULT_MODULES
               ) -> List[Finding]:
     """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
     if root is None:
@@ -211,4 +218,11 @@ def lint_tree(root: Optional[str] = None,
             with open(path) as f:
                 src = f.read()
             findings.extend(lint_module_source(src, f"{sub}/{fname}"))
+    for fname in modules:
+        path = os.path.join(root, fname)
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            src = f.read()
+        findings.extend(lint_module_source(src, fname))
     return findings

@@ -226,6 +226,18 @@ general-path wall time. The opjit cache tracks it:
   per-run deltas so the reduction is directly visible.
 * `opJitTraceTime` isolates first-sight compile cost from steady-state
   dispatch cost; steady state should be all hits.
+
+## Robustness
+
+Batch-level work survives memory pressure via spill + retry/split
+(`spark.rapids.memory.*`), transient XLA errors heal through bounded
+backoff (`spark.rapids.tpu.deviceRetry.*`), shuffle blocks carry xxhash64
+checksums whose mismatch triggers lineage re-materialization
+(`spark.rapids.tpu.shuffle.checksum.enabled`,
+`spark.rapids.tpu.shuffle.fetchRetry.maxAttempts`), and the whole stack is
+validated under the seeded chaos fault injector
+(`spark.rapids.tpu.test.chaos.*`). The unified story — sites, fault kinds,
+and recovery paths — is in docs/robustness.md.
 """
 
 REGISTRY = ConfRegistry()
@@ -672,6 +684,89 @@ TEST_RETRY_OOM_INJECTION = _conf("spark.rapids.memory.tpu.state.debug.retryOomIn
     "Testing only: inject TpuRetryOOM/TpuSplitAndRetryOOM at allocation points "
     "(reference RmmSpark.forceRetryOOM test hooks)."
 ).internal().string(None)
+
+# ---------------------------------------------------------------------------
+# Robustness: transient device-error retry, shuffle integrity, and the seeded
+# chaos fault-injection harness (docs/robustness.md; reference
+# RmmSpark.forceRetryOOM / the spark-rapids fault-injection tool, SURVEY §7)
+# ---------------------------------------------------------------------------
+DEVICE_RETRY_MAX_ATTEMPTS = _conf("spark.rapids.tpu.deviceRetry.maxAttempts").doc(
+    "How many times a device dispatch (opjit program call, compiled-stage "
+    "launch, ICI block fetch, pipelined shuffle map task) is re-attempted "
+    "after a TRANSIENT device/runtime error (XLA status UNAVAILABLE, "
+    "RESOURCE_EXHAUSTED, ABORTED, CANCELLED) before the error propagates. "
+    "Fatal statuses (INTERNAL, DATA_LOSS, ...) are never retried — they go "
+    "straight to the fatal-failure hook (spark.rapids.tpu.coreDump.dir)."
+).integer(4)
+
+DEVICE_RETRY_BACKOFF_BASE_MS = _conf(
+    "spark.rapids.tpu.deviceRetry.backoffBaseMs").doc(
+    "Base delay of the transient-device-error retry backoff; attempt n "
+    "sleeps min(base * 2^(n-1), backoffMaxMs) scaled by a random jitter in "
+    "[0.5, 1.0]. Blocked time accumulates in the deviceRetryBlockTimeNs "
+    "task metric."
+).double(10.0)
+
+DEVICE_RETRY_BACKOFF_MAX_MS = _conf(
+    "spark.rapids.tpu.deviceRetry.backoffMaxMs").doc(
+    "Upper bound on a single transient-retry backoff sleep."
+).double(2000.0)
+
+SHUFFLE_CHECKSUM_ENABLED = _conf(
+    "spark.rapids.tpu.shuffle.checksum.enabled").doc(
+    "Embed an xxhash64 checksum in every serialized shuffle block and "
+    "verify it on read (the Spark analogue is SPARK-35275 shuffle "
+    "checksums). A mismatched or truncated block raises FetchFailedError "
+    "so the exchange re-materializes the producing map task instead of "
+    "surfacing an arbitrary deserialization error."
+).boolean(True)
+
+SHUFFLE_FETCH_RETRY_MAX = _conf(
+    "spark.rapids.tpu.shuffle.fetchRetry.maxAttempts").doc(
+    "How many times a reduce task re-materializes lost/corrupted map "
+    "outputs (FetchFailedError) before giving up; the final error chains "
+    "the last FetchFailedError as its cause (Spark: stage-retry bound)."
+).integer(4)
+
+CHAOS_ENABLED = _conf("spark.rapids.tpu.test.chaos.enabled").doc(
+    "Testing only: arm the seeded chaos fault injector. Named injection "
+    "sites woven through the stack (hbm.alloc, spill.to_host, "
+    "spill.to_disk, device.dispatch, shuffle.serialize, shuffle.write, "
+    "shuffle.read, ici.fetch, pipeline.task) draw from per-site PRNGs and "
+    "raise configured fault kinds at the configured probability "
+    "(docs/robustness.md)."
+).boolean(False)
+
+CHAOS_SEED = _conf("spark.rapids.tpu.test.chaos.seed").doc(
+    "Chaos injector seed. Each site derives an independent deterministic "
+    "PRNG stream from (seed, site), so a run's injection trace is "
+    "replayable per site regardless of thread interleaving."
+).integer(0)
+
+CHAOS_SITES = _conf("spark.rapids.tpu.test.chaos.sites").doc(
+    "Comma-separated injection sites to arm; empty means every site."
+).string_list([])
+
+CHAOS_KINDS = _conf("spark.rapids.tpu.test.chaos.kinds").doc(
+    "Comma-separated fault kinds to draw from (retry_oom, split_oom, "
+    "transient, fatal, corrupt, truncate, io_error, latency); empty means "
+    "every kind applicable at the site. OOM kinds only fire inside a "
+    "retry-framework scope (where they are healable by design); corrupt/"
+    "truncate only apply at byte-stream sites."
+).string_list([])
+
+CHAOS_PROBABILITY = _conf("spark.rapids.tpu.test.chaos.probability").doc(
+    "Per-site-visit probability of injecting a fault."
+).double(0.05)
+
+CHAOS_MAX_INJECTIONS = _conf("spark.rapids.tpu.test.chaos.maxInjections").doc(
+    "Cap on total randomized injections per configure (0 = unbounded) — a "
+    "guardrail so high probabilities cannot starve a query forever."
+).integer(0)
+
+CHAOS_LATENCY_MS = _conf("spark.rapids.tpu.test.chaos.latencyMs").doc(
+    "Upper bound of the injected delay for the `latency` fault kind."
+).double(2.0)
 
 
 # ---------------------------------------------------------------------------

@@ -811,7 +811,16 @@ class TpuCompiledAggStageExec(TpuExec):
                 flat.append(col.validity if col.validity is not None
                             else row_mask(b.num_rows, cap))
         fn = _build_stage_fn(spec, cap, domains, ctx.eval_ctx)
-        return fn(row_mask(b.num_rows, cap), *flat)
+        # compiled-stage launch = one device dispatch: chaos site + bounded
+        # transient retry (the stage fn is pure over its device inputs)
+        from ..chaos import inject
+        from ..failure import with_device_retry
+
+        def dispatch():
+            inject("device.dispatch", detail="compiled_stage")
+            return fn(row_mask(b.num_rows, cap), *flat)
+
+        return with_device_retry(dispatch, ctx.conf)
 
     def _assemble(self, domains: List[_KeyDomain], carries: List[Tuple],
                   ctx: TaskContext) -> TpuColumnarBatch:

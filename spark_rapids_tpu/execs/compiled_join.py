@@ -1208,7 +1208,17 @@ class TpuCompiledJoinAggStageExec(TpuExec):
                         else row_mask(b.num_rows, cap))
         fn = _build_join_stage_fn(spec, cap, dim_caps, dim_dense,
                                   ctx.eval_ctx)
-        return fn(row_mask(b.num_rows, cap), tuple(flat), tuple(dim_flats))
+        # compiled-stage launch = one device dispatch: chaos site + bounded
+        # transient retry (the stage fn is pure over its device inputs)
+        from ..chaos import inject
+        from ..failure import with_device_retry
+
+        def dispatch():
+            inject("device.dispatch", detail="compiled_join_stage")
+            return fn(row_mask(b.num_rows, cap), tuple(flat),
+                      tuple(dim_flats))
+
+        return with_device_retry(dispatch, ctx.conf)
 
     def _assemble_compact(self, dim_tables, occ_np, carry_np, nocc: int,
                           ctx: TaskContext):

@@ -122,6 +122,27 @@ def _note(metrics, name: str, v: int) -> None:
             m.add(v)
 
 
+def _dispatch(fn, args: Tuple, eval_ctx, kind: str,
+              donated: bool = False):
+    """One program launch through the chaos `device.dispatch` site and the
+    transient-device-error retry: an UNAVAILABLE/RESOURCE_EXHAUSTED hiccup
+    re-dispatches the (idempotent, cached) program with bounded backoff
+    instead of killing the query; fatal statuses and trace failures
+    propagate untouched (failure.with_device_retry). A dispatch with
+    donated input buffers is NOT retried — after a failed launch the
+    donated buffers' state is undefined."""
+    from ..chaos import inject
+    from ..failure import with_device_retry
+
+    def call():
+        inject("device.dispatch", detail=kind)
+        return fn(*args)
+
+    if donated:
+        return call()
+    return with_device_retry(call, getattr(eval_ctx, "conf", None))
+
+
 def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
                  donate_argnums: Tuple[int, ...] = ()):
     """Run the program for `key`, tracing+compiling on first sight. Returns
@@ -138,7 +159,8 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
         with _LOCK:
             _STATS["hits"] += 1
             _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
-        return entry(*args)
+        return _dispatch(entry, args, eval_ctx, key[0],
+                         donated=bool(donate_argnums))
 
     _note(metrics, "opJitCacheMisses", 1)
     with _LOCK:
@@ -147,7 +169,8 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
     fn = jax.jit(build(), donate_argnums=donate_argnums)
     t0 = time.perf_counter_ns()
     try:
-        out = fn(*args)
+        out = _dispatch(fn, args, eval_ctx, key[0],
+                        donated=bool(donate_argnums))
     except _TRACE_FAILURES:
         # not traceable (host sync / host-assisted / ANSI check): pin eager
         with _LOCK:

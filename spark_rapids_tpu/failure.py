@@ -1,4 +1,4 @@
-"""Fatal device-error detection and diagnostic capture.
+"""Device-error classification, transient retry, and diagnostic capture.
 
 Reference (SURVEY.md §5 failure detection):
   * RapidsExecutorPlugin.onTaskFailed → containsCudaFatalException →
@@ -7,43 +7,139 @@ Reference (SURVEY.md §5 failure detection):
   * GpuCoreDumpHandler (GpuCoreDumpHandler.scala:38-190): capture a device
     core dump to distributed storage before exiting.
 
-TPU analogue: XLA surfaces device failures as XlaRuntimeError (and jax
-raises RuntimeError for device-side crashes). `handle_task_failure`
-classifies the error; for fatal ones it writes a diagnostic bundle (device
-topology, memory stats, task metrics, the error) under
-`spark.rapids.tpu.coreDump.dir` and — when `exit_on_fatal` — terminates the
-process so the cluster manager reschedules (tests use exit_on_fatal=False).
+TPU analogue: XLA surfaces device failures as XlaRuntimeError (jaxlib ships
+subclasses, and jax sometimes re-wraps device-side crashes in plain
+RuntimeError carrying the XLA status string). Classification walks the
+cause chain matching device-error-shaped exceptions by type name across the
+MRO or by an XLA status token in a RuntimeError message, then splits them:
+
+  * **transient** statuses (UNAVAILABLE, RESOURCE_EXHAUSTED, ABORTED,
+    CANCELLED) mean the runtime hiccuped but the device is fine — the
+    dispatch sites wrap themselves in `with_device_retry` (bounded
+    exponential backoff + jitter) so these heal instead of killing the
+    query;
+  * **fatal** markers (INTERNAL, DATA_LOSS, device halted, ...) mean the
+    device/runtime is unusable: `handle_task_failure` writes a diagnostic
+    bundle (device topology, memory stats, task metrics, the error) under
+    `spark.rapids.tpu.coreDump.dir` and — when `exit_on_fatal` — terminates
+    the process so the cluster manager reschedules (tests use
+    exit_on_fatal=False). A message carrying both marker classes is fatal.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
+import re
 import time
 import traceback
-from typing import Optional
+from typing import Callable, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
 
 _FATAL_MARKERS = (
-    "DEADLINE_EXCEEDED", "INTERNAL", "device halted", "HBM OOM",
-    "Device or resource busy", "failed to synchronize", "UNAVAILABLE",
+    "DEADLINE_EXCEEDED", "INTERNAL", "DATA_LOSS", "device halted", "HBM OOM",
+    "Device or resource busy", "failed to synchronize",
     "hardware error", "data loss",
 )
+
+#: runtime hiccups that heal on re-dispatch (reference: the CUDA driver's
+#: retryable launch failures; XLA's UNAVAILABLE family)
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "RESOURCE_EXHAUSTED", "ABORTED", "CANCELLED",
+)
+
+#: an XLA/absl status token at large in a plain RuntimeError message marks
+#: the error as device-runtime-shaped even without the XlaRuntimeError type
+_XLA_STATUS_RE = re.compile(
+    r"\b(UNAVAILABLE|RESOURCE_EXHAUSTED|ABORTED|CANCELLED|DEADLINE_EXCEEDED"
+    r"|INTERNAL|DATA_LOSS|FAILED_PRECONDITION|UNIMPLEMENTED|UNKNOWN"
+    r"|OUT_OF_RANGE)\b")
+
+
+def _device_error_messages(exc: BaseException) -> Iterator[str]:
+    """Messages of device-error-shaped exceptions across the cause chain:
+    any type whose MRO contains an XlaRuntimeError (covers jaxlib
+    subclasses), or a plain RuntimeError carrying an XLA status string."""
+    seen = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        names = {t.__name__ for t in type(cur).__mro__}
+        msg = str(cur)
+        if "XlaRuntimeError" in names:
+            yield msg
+        elif isinstance(cur, RuntimeError) and not isinstance(cur, MemoryError) \
+                and _XLA_STATUS_RE.search(msg):
+            yield msg
+        cur = cur.__cause__ or cur.__context__
 
 
 def is_fatal_device_error(exc: BaseException) -> bool:
     """Classify: does this error mean the device/runtime is unusable
     (reference containsCudaFatalException walking the cause chain)?"""
-    seen = set()
-    cur: Optional[BaseException] = exc
-    while cur is not None and id(cur) not in seen:
-        seen.add(id(cur))
-        name = type(cur).__name__
-        if name == "XlaRuntimeError":
-            msg = str(cur)
-            if any(m in msg for m in _FATAL_MARKERS):
-                return True
-        cur = cur.__cause__ or cur.__context__
-    return False
+    return any(any(m in msg for m in _FATAL_MARKERS)
+               for msg in _device_error_messages(exc))
+
+
+def is_transient_device_error(exc: BaseException) -> bool:
+    """A device-runtime error expected to heal on re-dispatch. Fatal markers
+    win when both appear; the retry OOMs (TpuOOM) have their own framework
+    and are never treated as transient."""
+    transient = False
+    for msg in _device_error_messages(exc):
+        if any(m in msg for m in _FATAL_MARKERS):
+            return False
+        if any(m in msg for m in _TRANSIENT_MARKERS):
+            transient = True
+    return transient
+
+
+def with_device_retry(fn: Callable[[], T], conf=None,
+                      max_attempts: Optional[int] = None,
+                      base_ms: Optional[float] = None,
+                      max_ms: Optional[float] = None) -> T:
+    """Run `fn`, re-attempting on TRANSIENT device errors with bounded
+    exponential backoff + jitter (attempt n sleeps
+    min(base * 2^(n-1), max) * U[0.5, 1.0]). Everything else — fatal device
+    errors, the retry OOMs, ordinary exceptions — propagates untouched on
+    the first raise. `fn` must be idempotent (all wrapped dispatch sites
+    are: re-running a cached XLA program, an ICI block fetch, or a keyed
+    shuffle map task).
+
+    Retries and blocked time surface as the deviceRetryCount /
+    deviceRetryBlockTimeNs task metrics (reference GpuTaskMetrics)."""
+    if conf is not None:
+        from .config import (DEVICE_RETRY_BACKOFF_BASE_MS,
+                             DEVICE_RETRY_BACKOFF_MAX_MS,
+                             DEVICE_RETRY_MAX_ATTEMPTS)
+        if max_attempts is None:
+            max_attempts = conf.get(DEVICE_RETRY_MAX_ATTEMPTS)
+        if base_ms is None:
+            base_ms = conf.get(DEVICE_RETRY_BACKOFF_BASE_MS)
+        if max_ms is None:
+            max_ms = conf.get(DEVICE_RETRY_BACKOFF_MAX_MS)
+    attempts_left = 4 if max_attempts is None else int(max_attempts)
+    base = 10.0 if base_ms is None else float(base_ms)
+    cap = 2000.0 if max_ms is None else float(max_ms)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            if attempt >= attempts_left \
+                    or not is_transient_device_error(exc):
+                raise
+            attempt += 1
+            from .profiling import TaskMetricsRegistry
+            reg = TaskMetricsRegistry.get()
+            reg.add("deviceRetryCount", 1)
+            delay = min(cap, base * (2 ** (attempt - 1))) / 1000.0
+            delay *= 0.5 + 0.5 * random.random()
+            t0 = time.perf_counter_ns()
+            time.sleep(delay)
+            reg.add("deviceRetryBlockTimeNs", time.perf_counter_ns() - t0)
 
 
 def write_diagnostic_bundle(exc: BaseException, dump_dir: str,

@@ -46,9 +46,6 @@ class HbmBudget:
         self.oom_max_retries = oom_max_retries
         self._alloc_lock = threading.RLock()
         self._spill_callback: Optional[Callable[[int], int]] = None
-        # test injection state (RmmSpark.force*OOM analogue)
-        self._forced_retry = 0
-        self._forced_split_retry = 0
         self.peak_used = 0
         self.alloc_count = 0
 
@@ -63,6 +60,10 @@ class HbmBudget:
 
     @classmethod
     def reset_for_tests(cls, budget_bytes: Optional[int] = None) -> "HbmBudget":
+        from ..chaos import FaultInjector
+        # forced-OOM counters are part of the budget's test-hook state: a
+        # partially-consumed force must not leak into the next test
+        FaultInjector.get().clear_forced("hbm.alloc")
         with cls._lock:
             cls._instance = HbmBudget(budget_bytes
                                       or TpuDeviceManager.hbm_budget_bytes())
@@ -74,23 +75,22 @@ class HbmBudget:
         self._spill_callback = cb
 
     # --- test injection (reference RmmSpark.forceRetryOOM) -----------------
+    # routed through the chaos fault injector's forced counters so manual
+    # one-shot OOMs and the randomized chaos harness share one site/trace
     def force_retry_oom(self, n: int = 1) -> None:
-        self._forced_retry = n
+        from ..chaos import FaultInjector
+        FaultInjector.get().force("hbm.alloc", "retry_oom", n)
 
     def force_split_and_retry_oom(self, n: int = 1) -> None:
-        self._forced_split_retry = n
+        from ..chaos import FaultInjector
+        FaultInjector.get().force("hbm.alloc", "split_oom", n)
 
     # --- allocation --------------------------------------------------------
     def allocate(self, nbytes: int) -> None:
+        from ..chaos import inject
         with self._alloc_lock:
             self.alloc_count += 1
-            if self._forced_split_retry > 0:
-                self._forced_split_retry -= 1
-                raise TpuSplitAndRetryOOM(
-                    f"injected split-retry OOM ({nbytes} bytes)")
-            if self._forced_retry > 0:
-                self._forced_retry -= 1
-                raise TpuRetryOOM(f"injected retry OOM ({nbytes} bytes)")
+            inject("hbm.alloc", detail=f"{nbytes}B")
             retries = 0
             while self.used + nbytes > self.budget:
                 freed = 0

@@ -49,14 +49,21 @@ def with_retry(
     the catalog spill) and splitting the input on TpuSplitAndRetryOOM. fn MUST
     be idempotent w.r.t. the input batch (reference withRetry contract).
     Yields one result per (sub-)batch."""
+    from ..chaos import retry_scope
     pending: List[SpillableColumnarBatch] = [spillable]
     attempts = 0
     try:
         while pending:
             cur = pending[0]
             try:
-                batch = cur.get_batch()
-                result = fn(batch)
+                # chaos scope: injected OOMs are healable exactly here (the
+                # except arms below absorb them), so the randomized injector
+                # only fires its OOM kinds inside this window; splitting is
+                # only survivable when the input has >= 2 rows and a policy
+                with retry_scope(splittable=split_policy is not None
+                                 and cur.num_rows >= 2):
+                    batch = cur.get_batch()
+                    result = fn(batch)
                 pending.pop(0)
                 cur.close()
                 yield result

@@ -24,6 +24,13 @@ TIER_DEVICE = "DEVICE"
 TIER_HOST = "HOST"
 TIER_DISK = "DISK"
 
+
+class SpillCorruptionError(IOError):
+    """A disk-spilled buffer failed its integrity check on unspill (bit rot,
+    truncation, or chaos-injected corruption). For ICI shuffle blocks the
+    catalog converts this into FetchFailedError so lineage recompute heals
+    it; anywhere else it surfaces as the storage fault it is."""
+
 # Spill priorities (reference SpillPriorities.scala): lower value spills first
 ACTIVE_ON_DECK_PRIORITY = -100
 ACTIVE_BATCHING_PRIORITY = 0
@@ -32,7 +39,7 @@ OUTPUT_FOR_SHUFFLE_PRIORITY = 100
 
 class _Entry:
     __slots__ = ("handle", "tier", "priority", "batch", "host_table",
-                 "disk_path", "nbytes", "names")
+                 "disk_path", "disk_checksum", "nbytes", "names")
 
     def __init__(self, handle: int, batch: TpuColumnarBatch, priority: int):
         self.handle = handle
@@ -41,6 +48,7 @@ class _Entry:
         self.batch = batch
         self.host_table = None
         self.disk_path: Optional[str] = None
+        self.disk_checksum: Optional[int] = None
         self.nbytes = batch.device_memory_size()
         self.names = batch.names
 
@@ -119,10 +127,20 @@ class TpuBufferCatalog:
 
     def _unspill_inner(self, e: _Entry, pa) -> None:
         if e.tier == TIER_DISK:
-            with pa.ipc.open_file(e.disk_path) as r:
+            import io
+            from ..shuffle.serializer import xxhash64_bytes
+            with open(e.disk_path, "rb") as f:
+                data = f.read()
+            if e.disk_checksum is not None \
+                    and xxhash64_bytes(data) != e.disk_checksum:
+                raise SpillCorruptionError(
+                    f"spill file {e.disk_path} failed its xxhash64 "
+                    f"integrity check on unspill ({len(data)} bytes)")
+            with pa.ipc.open_file(io.BytesIO(data)) as r:
                 e.host_table = r.read_all()
             os.unlink(e.disk_path)
             e.disk_path = None
+            e.disk_checksum = None
             e.tier = TIER_HOST
             self.host_used += e.nbytes
         if e.tier == TIER_HOST:
@@ -151,6 +169,9 @@ class TpuBufferCatalog:
         return freed
 
     def _spill_entry_to_host(self, e: _Entry) -> int:
+        from ..chaos import inject
+        inject("spill.to_host")  # before any state mutation: a raised fault
+        # must leave the entry intact on its current tier
         e.host_table = e.batch.to_arrow()
         e.batch = None
         e.tier = TIER_HOST
@@ -172,9 +193,22 @@ class TpuBufferCatalog:
             for e in host_entries:
                 if self.host_used <= self.host_limit:
                     break
+                import io
+                from ..chaos import corrupt_bytes, inject
+                from ..shuffle.serializer import xxhash64_bytes
+                inject("spill.to_disk")  # pre-mutation, like spill.to_host
                 path = os.path.join(self._disk_dir, f"buf_{e.handle}.arrow")
-                with pa.ipc.new_file(path, e.host_table.schema) as w:
+                buf = io.BytesIO()
+                with pa.ipc.new_file(buf, e.host_table.schema) as w:
                     w.write_table(e.host_table)
+                data = buf.getvalue()
+                # checksum BEFORE the chaos mangle: injected corruption must
+                # be detectable on unspill, exactly like real bit rot
+                e.disk_checksum = xxhash64_bytes(data)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(corrupt_bytes("spill.to_disk", data))
+                os.replace(tmp, path)  # atomic: no truncated spill files
                 e.host_table = None
                 e.disk_path = path
                 e.tier = TIER_DISK

@@ -162,7 +162,8 @@ def _run_map_task(payload: dict) -> dict:
                 exist_ok=True)
     for rid in range(num_reduces):
         part = table.filter(buckets == rid) if table.num_rows else table
-        blob = serialize_table(part, codec)
+        blob = serialize_table(part, codec,
+                               checksum=payload.get("checksum", True))
         _atomic_write(
             _block_path(payload["root"], payload["shuffle_id"], map_id, rid),
             blob)
@@ -213,7 +214,8 @@ class ExecutorPool:
     (reference: RapidsShuffleHeartbeatManager + Spark task rescheduling)."""
 
     def __init__(self, num_workers: int = 2, shuffle_root: Optional[str] = None,
-                 codec: str = "zstd", hb_timeout_s: Optional[float] = None):
+                 codec: str = "zstd", hb_timeout_s: Optional[float] = None,
+                 checksum: bool = True):
         if hb_timeout_s is None:
             from ..config import (EXECUTOR_HEARTBEAT_TIMEOUT_SECONDS,
                                   default_conf)
@@ -224,6 +226,7 @@ class ExecutorPool:
         self.shuffle_root = shuffle_root or tempfile.mkdtemp(
             prefix="tpu_mp_shuffle_")
         self.codec = codec
+        self.checksum = bool(checksum)
         # one result queue PER worker: SIGKILLing a worker mid-put can
         # corrupt a shared queue's pipe for every producer; per-worker
         # queues confine the damage to the dead worker
@@ -329,6 +332,7 @@ class ExecutorPool:
                 "key_ordinals": list(key_ordinals),
                 "num_reduces": num_reduces, "root": self.shuffle_root,
                 "shuffle_id": shuffle_id, "codec": self.codec,
+                "checksum": self.checksum,
             })
             pending[tid] = task
             self._dispatch(task)

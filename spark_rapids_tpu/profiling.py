@@ -38,7 +38,7 @@ class TaskMetricsRegistry:
 
     KNOWN = ("semaphoreWaitNs", "retryCount", "splitAndRetryCount",
              "retryBlockTimeNs", "spillToHostBytes", "spillToDiskBytes",
-             "readSpillTimeNs")
+             "readSpillTimeNs", "deviceRetryCount", "deviceRetryBlockTimeNs")
 
     def __init__(self):
         self._vals: Dict[str, int] = {k: 0 for k in self.KNOWN}

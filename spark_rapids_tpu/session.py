@@ -974,6 +974,10 @@ class TpuSession:
         TpuDeviceManager.initialize(rc)
         if rc.get(LEAK_TRACKING_DEBUG):
             MemoryCleaner.get().set_debug(True)
+        # chaos harness (docs/robustness.md): arm/disarm the process-wide
+        # fault injector from spark.rapids.tpu.test.chaos.* when mentioned
+        from .chaos import FaultInjector
+        FaultInjector.maybe_configure(rc)
         self._pool: Optional[_fut.ThreadPoolExecutor] = None
 
     # conf API

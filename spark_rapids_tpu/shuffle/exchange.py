@@ -73,8 +73,23 @@ class _ExchangeBase:
                 self._materialize_maps_pipelined(sid, ctx, mgr, threads)
             else:
                 for map_id in range(self._n_maps):
-                    self._materialize_map(sid, map_id, ctx, mgr)
+                    self._run_map_guarded(sid, map_id, ctx, mgr)
             self._shuffle_id = sid
+
+    def _run_map_guarded(self, sid: int, map_id: int, ctx: TaskContext,
+                         mgr, gate_device: bool = False) -> None:
+        """One map task under the chaos `pipeline.task` site and the
+        transient-device-error retry: a map task is idempotent (block files
+        are keyed (map, reduce); the ICI catalog replaces on put), so an
+        UNAVAILABLE hiccup re-runs the task instead of failing the query."""
+        from ..chaos import inject
+        from ..failure import with_device_retry
+
+        def attempt() -> None:
+            inject("pipeline.task", detail=f"s{sid}m{map_id}")
+            self._materialize_map(sid, map_id, ctx, mgr, gate_device)
+
+        with_device_retry(attempt, ctx.conf)
 
     def _materialize_maps_pipelined(self, sid: int, ctx: TaskContext, mgr,
                                     n_threads: int) -> None:
@@ -83,7 +98,14 @@ class _ExchangeBase:
         bounded pool, device work gated per task by the TPU semaphore, and
         each task's deferred host commit (file serialization I/O, released
         from the semaphore) overlaps sibling maps' device work. Block files
-        are keyed (map, reduce) so completion order cannot change results."""
+        are keyed (map, reduce) so completion order cannot change results.
+
+        Failure discipline: the first failing map cancels every sibling
+        that has not started yet (running ones finish — their semaphore
+        permits and in-flight byte reservations release on their own error
+        paths), and its error propagates after all submitted work has
+        settled, so no map task is still running when the caller sees the
+        failure."""
         # Pre-materialize nested exchanges serially first: a concurrent map
         # task must never trigger a recursive materialization while sibling
         # maps hold device permits — the upstream exchange's own map tasks
@@ -91,19 +113,27 @@ class _ExchangeBase:
         for node in self.children[0].collect_nodes():
             if isinstance(node, _ExchangeBase):
                 node._ensure_materialized(ctx)
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import CancelledError, ThreadPoolExecutor
         pool = ThreadPoolExecutor(
             max_workers=min(n_threads, self._n_maps),
             thread_name_prefix="exchange-map")
         try:
-            futs = [pool.submit(self._materialize_map, sid, m, ctx, mgr,
+            futs = [pool.submit(self._run_map_guarded, sid, m, ctx, mgr,
                                 True)
                     for m in range(self._n_maps)]
             errors = []
-            for f in futs:  # wait for ALL maps: no partial shuffle state
+            for f in futs:  # wait for ALL non-cancelled maps: no map task
+                # may still be running when the error propagates
                 try:
                     f.result()
+                except CancelledError:
+                    continue
                 except BaseException as e:  # noqa: BLE001
+                    if not errors:
+                        # fail fast: not-yet-started siblings are pointless
+                        # work (and would delay the error) — cancel them
+                        for g in futs:
+                            g.cancel()
                     errors.append(e)
             if errors:
                 raise errors[0]
@@ -144,21 +174,12 @@ class _ExchangeBase:
         self._ensure_materialized(ctx)
         sizes = [0] * self._n_out
         if self._shuffle_mode(ctx) == "ICI":
-            from .ici import FetchFailedError, IciShuffleCatalog
+            from .ici import IciShuffleCatalog
             catalog = IciShuffleCatalog.get()
             mgr2 = TpuShuffleManager.get(ctx.conf)
             for r in range(self._n_out):
-                try:
-                    blocks = list(catalog.iter_blocks(self._shuffle_id, r,
-                                                      self._n_maps))
-                except FetchFailedError as ff:
-                    # same recovery as the read path: re-run lost maps
-                    with self._mat_lock:
-                        for map_id in ff.map_ids:
-                            self._materialize_map(self._shuffle_id, map_id,
-                                                  ctx, mgr2)
-                    blocks = list(catalog.iter_blocks(self._shuffle_id, r,
-                                                      self._n_maps))
+                # same bounded recovery as the read path: re-run lost maps
+                blocks = self._ici_fetch_blocks(r, ctx, mgr2, catalog)
                 for b in blocks:
                     sizes[r] += b.device_memory_size()
             return sizes
@@ -190,6 +211,84 @@ class _ExchangeBase:
             p = mgr._path(self._shuffle_id, m, reduce_id)
             out.append(os.path.getsize(p) if os.path.exists(p) else 0)
         return out
+
+    def _fetch_retry_limit(self, ctx: TaskContext) -> int:
+        from ..config import SHUFFLE_FETCH_RETRY_MAX
+        return max(1, int(ctx.conf.get(SHUFFLE_FETCH_RETRY_MAX)))
+
+    def _fetch_tables(self, idx: int, ctx: TaskContext, mgr,
+                      map_ids=None) -> Iterator:
+        """MULTITHREADED-mode reduce fetch with lineage recovery: streams
+        one reduce partition's arrow tables in map order; a FetchFailedError
+        (corrupt/truncated block detected by the checksum, unreadable file)
+        re-materializes the producing map tasks and resumes with the maps
+        not yet consumed — already-yielded blocks are never re-yielded. The
+        attempt count is conf-bounded (spark.rapids.tpu.shuffle.fetchRetry.
+        maxAttempts); the terminal error chains the last FetchFailedError
+        as its cause (Spark: FetchFailed → bounded stage retries)."""
+        from .ici import FetchFailedError
+        limit = self._fetch_retry_limit(ctx)
+        pending = list(map_ids) if map_ids is not None \
+            else list(range(self._n_maps))
+        failures = 0
+        while pending:
+            it = mgr.iter_partition_sources(self._shuffle_id, idx,
+                                            self._n_maps,
+                                            map_ids=list(pending))
+            try:
+                for m, t in it:
+                    pending.remove(m)
+                    if t is not None:
+                        yield t
+            except FetchFailedError as ff:
+                failures += 1
+                if failures > limit:  # maxAttempts counts RECOVERY rounds
+                    raise RuntimeError(
+                        f"shuffle {self._shuffle_id} reduce {idx}: block "
+                        f"fetch failed after {limit} re-materialization "
+                        f"attempts (spark.rapids.tpu.shuffle.fetchRetry."
+                        f"maxAttempts={limit})") from ff
+                with self._mat_lock:
+                    for mm in ff.map_ids:
+                        self._run_map_guarded(self._shuffle_id, mm, ctx,
+                                              mgr)
+
+    def _ici_fetch_blocks(self, idx: int, ctx: TaskContext, mgr, catalog,
+                          metric=None) -> List:
+        """ICI-mode reduce fetch with the same conf-bounded lineage
+        recovery: transient runtime errors heal via with_device_retry, a
+        FetchFailedError (lost peer, invalidated output, corrupted spill
+        tier) re-runs the missing map tasks."""
+        from ..failure import with_device_retry
+        from .ici import FetchFailedError
+        limit = self._fetch_retry_limit(ctx)
+
+        def fetch():
+            if metric is not None:
+                with metric.timed():
+                    return list(catalog.iter_blocks(
+                        self._shuffle_id, idx, self._n_maps))
+            return list(catalog.iter_blocks(self._shuffle_id, idx,
+                                            self._n_maps))
+
+        failures = 0
+        while True:
+            try:
+                return with_device_retry(fetch, ctx.conf)
+            except FetchFailedError as ff:
+                failures += 1
+                if failures > limit:  # same accounting as _fetch_tables:
+                    # maxAttempts counts recovery rounds, and no map is
+                    # re-run whose output could never be fetched again
+                    raise RuntimeError(
+                        f"shuffle {self._shuffle_id} reduce {idx}: "
+                        f"re-materialization failed after {limit} attempts "
+                        f"(spark.rapids.tpu.shuffle.fetchRetry.maxAttempts)"
+                    ) from ff
+                with self._mat_lock:
+                    for map_id in ff.map_ids:
+                        self._run_map_guarded(self._shuffle_id, map_id,
+                                              ctx, mgr)
 
     def cleanup_shuffle(self, conf) -> None:
         """Release this exchange's shuffle blocks/files and allow
@@ -377,24 +476,15 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         if self._shuffle_mode(ctx) == "ICI":
             # device-resident read (reference RapidsCachingReader): local
             # catalog hit, no host round trip; blocks unspill if evicted.
-            # FetchFailed (peer lost, output invalidated) re-runs the missing
-            # map tasks — Spark's stage-retry analogue.
-            from .ici import FetchFailedError, IciShuffleCatalog
+            # FetchFailed (peer lost, output invalidated, corrupted spill
+            # tier) re-runs the missing map tasks — Spark's stage-retry
+            # analogue, conf-bounded with the cause chained.
+            from .ici import IciShuffleCatalog
             catalog = IciShuffleCatalog.get()
             mgr = TpuShuffleManager.get(ctx.conf)
-            for _attempt in range(2):
-                try:
-                    with self.metrics["deserializationTime"].timed():
-                        blocks = list(catalog.iter_blocks(
-                            self._shuffle_id, idx, self._n_maps))
-                    break
-                except FetchFailedError as ff:
-                    with self._mat_lock:
-                        for map_id in ff.map_ids:
-                            self._materialize_map(self._shuffle_id, map_id,
-                                                  ctx, mgr)
-            else:
-                raise RuntimeError("shuffle re-materialization failed twice")
+            blocks = self._ici_fetch_blocks(
+                idx, ctx, mgr, catalog,
+                metric=self.metrics["deserializationTime"])
             for b in blocks:
                 if b.num_rows:
                     yield b.rename(names)
@@ -411,7 +501,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             # waiting on the pool's read+deserialize AND the upload (the
             # actual decode runs on reader threads, so only its non-overlapped
             # wait is attributable to this task)
-            it = mgr.iter_partition(self._shuffle_id, idx, self._n_maps)
+            it = self._fetch_tables(idx, ctx, mgr)
             while True:
                 with deser.timed():
                     t = next(it, None)
@@ -432,16 +522,20 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         self._ensure_materialized(ctx)
         names = [a.name for a in self.output]
         if self._shuffle_mode(ctx) == "ICI":
+            from ..failure import with_device_retry
             from .ici import IciShuffleCatalog
             catalog = IciShuffleCatalog.get()
-            for b in catalog.iter_blocks(self._shuffle_id, idx, self._n_maps,
-                                         map_ids=list(map_ids)):
+            blocks = with_device_retry(
+                lambda: list(catalog.iter_blocks(self._shuffle_id, idx,
+                                                 self._n_maps,
+                                                 map_ids=list(map_ids))),
+                ctx.conf)
+            for b in blocks:
                 if b.num_rows:
                     yield b.rename(names)
             return
         mgr = TpuShuffleManager.get(ctx.conf)
-        for t in mgr.iter_partition(self._shuffle_id, idx, self._n_maps,
-                                    map_ids=list(map_ids)):
+        for t in self._fetch_tables(idx, ctx, mgr, map_ids=list(map_ids)):
             if t.num_rows:
                 yield TpuColumnarBatch.from_arrow(t).rename(names)
 
@@ -485,9 +579,8 @@ class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
     def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
         self._ensure_materialized(ctx)
         mgr = TpuShuffleManager.get(ctx.conf)
-        tables = mgr.read_partition(self._shuffle_id, idx, self._n_maps)
         names = [a.name for a in self.output]
-        for t in tables:
+        for t in self._fetch_tables(idx, ctx, mgr):
             if t.num_rows:
                 yield t.rename_columns(names)
 

@@ -169,8 +169,14 @@ class IciShuffleCatalog:
 
     def iter_blocks(self, shuffle_id: int, reduce_id: int,
                     n_maps: int, map_ids=None) -> Iterator[TpuColumnarBatch]:
-        """Raises FetchFailedError when any map's output was invalidated.
+        """Raises FetchFailedError when any map's output was invalidated —
+        including a block whose disk-spilled bytes fail their integrity
+        check on unspill (the catalog drops that map's output so the
+        exchange re-runs it, instead of surfacing a storage error).
         `map_ids` restricts to a subset of maps (AQE skew slices)."""
+        from ..chaos import inject
+        from ..memory.spill import SpillCorruptionError
+        inject("ici.fetch", detail=f"s{shuffle_id}r{reduce_id}")
         with self._mu:
             missing = [m for m in range(n_maps)
                        if (shuffle_id, m) not in self._complete]
@@ -179,11 +185,29 @@ class IciShuffleCatalog:
         for map_id in (range(n_maps) if map_ids is None else map_ids):
             with self._mu:
                 sb = self._blocks.get((shuffle_id, map_id, reduce_id))
-                # fetch under the lock: a concurrent invalidate/cleanup
-                # could close the spillable after we release it
-                batch = sb.get_batch() if sb is not None else None
+                if sb is None and (shuffle_id, map_id) not in self._complete:
+                    # invalidated since the up-front completeness check (a
+                    # concurrent reduce task hit corruption / a peer was
+                    # lost): silently skipping would DROP this map's rows
+                    raise FetchFailedError(shuffle_id, [map_id])
+                try:
+                    # fetch under the lock: a concurrent invalidate/cleanup
+                    # could close the spillable after we release it
+                    batch = sb.get_batch() if sb is not None else None
+                except SpillCorruptionError as exc:
+                    self._invalidate_map_locked(shuffle_id, map_id)
+                    raise FetchFailedError(shuffle_id, [map_id]) from exc
             if batch is not None:
                 yield batch
+
+    def _invalidate_map_locked(self, shuffle_id: int, map_id: int) -> None:
+        """Drop one map's blocks + completion (caller holds self._mu)."""
+        victims = [k for k in self._blocks
+                   if k[0] == shuffle_id and k[1] == map_id]
+        for k in victims:
+            self._blocks.pop(k).close()
+        self._owner.pop((shuffle_id, map_id), None)
+        self._complete.discard((shuffle_id, map_id))
 
     def block_sizes(self, shuffle_id: int, reduce_id: int,
                     n_maps: int) -> List[int]:

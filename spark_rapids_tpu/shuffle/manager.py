@@ -16,9 +16,9 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional
 
-from ..config import (RapidsConf, SHUFFLE_COMPRESSION_CODEC,
-                      SHUFFLE_READER_THREADS, SHUFFLE_WRITER_THREADS,
-                      default_conf)
+from ..config import (RapidsConf, SHUFFLE_CHECKSUM_ENABLED,
+                      SHUFFLE_COMPRESSION_CODEC, SHUFFLE_READER_THREADS,
+                      SHUFFLE_WRITER_THREADS, default_conf)
 from .serializer import deserialize_table, get_codec, serialize_table
 
 
@@ -53,6 +53,7 @@ class TpuShuffleManager:
         conf = conf or default_conf()
         self.root = tempfile.mkdtemp(prefix="tpu_shuffle_")
         self.codec_name = conf.get(SHUFFLE_COMPRESSION_CODEC)
+        self.checksum = bool(conf.get(SHUFFLE_CHECKSUM_ENABLED))
         self._writers = ThreadPoolExecutor(
             max_workers=conf.get(SHUFFLE_WRITER_THREADS),
             thread_name_prefix="shuffle-writer")
@@ -87,18 +88,35 @@ class TpuShuffleManager:
 
     def write_map_output(self, shuffle_id: int, map_id: int,
                          partition_tables: List) -> None:
-        """Write one map task's per-reduce-partition tables in parallel."""
+        """Write one map task's per-reduce-partition tables in parallel.
+        Each block lands via write-to-tmp + os.replace, so a crash mid-write
+        can never leave a truncated file that `partition_sizes`'s existence
+        check would count as a valid block."""
+        from ..chaos import corrupt_bytes, inject
 
         def write_one(reduce_id: int, table) -> None:
             if table is None or table.num_rows == 0:
                 return
             # codec per task: zstandard compressor objects are not safe under
             # concurrent use from multiple writer threads
-            block = serialize_table(table, get_codec(self.codec_name))
+            block = serialize_table(table, get_codec(self.codec_name),
+                                    checksum=self.checksum)
+            inject("shuffle.write", detail=f"{len(block)}B")
+            # chaos corruption AFTER the checksum was embedded: the read
+            # side must detect it and heal via lineage recompute
+            block = corrupt_bytes("shuffle.write", block)
             self._limiter.acquire(len(block))
+            path = self._path(shuffle_id, map_id, reduce_id)
+            tmp = path + ".tmp"
             try:
-                with open(self._path(shuffle_id, map_id, reduce_id), "wb") as f:
-                    f.write(block)
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(block)
+                    os.replace(tmp, path)
+                except BaseException:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    raise
                 with self._stats_lock:
                     self.bytes_written += len(block)
             finally:
@@ -109,29 +127,49 @@ class TpuShuffleManager:
         for f in futures:
             f.result()
 
-    def iter_partition(self, shuffle_id: int, reduce_id: int,
-                       n_maps: int, map_ids=None) -> Iterator:
-        """Streaming fetch of one reduce partition's blocks: every map's
-        read+deserialize is submitted to the reader pool up front and tables
-        are yielded in map order as they complete — the consumer can upload
-        block m while blocks m+1.. are still being read (reference
-        RapidsShuffleThreadedReaderBase). `map_ids` restricts to a subset of
-        maps (AQE skew slices)."""
+    def iter_partition_sources(self, shuffle_id: int, reduce_id: int,
+                               n_maps: int, map_ids=None) -> Iterator:
+        """Streaming fetch of one reduce partition's blocks as
+        (map_id, table-or-None) pairs in map order: every map's
+        read+deserialize is submitted to the reader pool up front — the
+        consumer can upload block m while blocks m+1.. are still being read
+        (reference RapidsShuffleThreadedReaderBase). `map_ids` restricts to
+        a subset of maps (AQE skew slices). None means the map wrote no
+        block for this partition (legitimately empty). A corrupted or
+        truncated block — or any other deserialization failure — raises
+        FetchFailedError naming the producing map so the exchange can
+        re-materialize it (SPARK-35275 checksum semantics)."""
+        from ..chaos import corrupt_bytes, inject
+        from .ici import FetchFailedError
 
         def read_one(map_id: int):
             p = self._path(shuffle_id, map_id, reduce_id)
             if not os.path.exists(p):
                 return None
-            with open(p, "rb") as f:
-                block = f.read()
+            try:
+                inject("shuffle.read", detail=f"map{map_id}")
+                with open(p, "rb") as f:
+                    block = f.read()
+                block = corrupt_bytes("shuffle.read", block)
+                table = deserialize_table(block)
+            except Exception as exc:  # noqa: BLE001 — any decode failure is
+                # a lost/corrupt block; lineage recompute heals it
+                raise FetchFailedError(shuffle_id, [map_id]) from exc
             with self._stats_lock:
                 self.bytes_read += len(block)
-            return deserialize_table(block)
+            return table
 
-        maps = range(n_maps) if map_ids is None else map_ids
+        maps = list(range(n_maps)) if map_ids is None else list(map_ids)
         futures = [self._readers.submit(read_one, m) for m in maps]
-        for f in futures:
-            t = f.result()
+        for m, f in zip(maps, futures):
+            yield m, f.result()
+
+    def iter_partition(self, shuffle_id: int, reduce_id: int,
+                       n_maps: int, map_ids=None) -> Iterator:
+        """iter_partition_sources without the map ids: yields just the
+        non-empty tables in map order."""
+        for _, t in self.iter_partition_sources(shuffle_id, reduce_id,
+                                                n_maps, map_ids):
             if t is not None:
                 yield t
 

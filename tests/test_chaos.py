@@ -1,0 +1,672 @@
+"""Chaos fault-injection harness (spark_rapids_tpu/chaos/) and the recovery
+paths it proves out: injection-trace determinism, shuffle block integrity
+(checksum → FetchFailed → lineage recompute), transient device-error retry
+with backoff, atomic block writes, pipelined-exchange failure propagation,
+and the multi-seed soak asserting bit-identical results, zero leaks, and
+all semaphore permits returned under injection at every site."""
+
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.chaos import (ALL_SITES, FaultInjector, corrupt_bytes,
+                                    inject, retry_scope)
+from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs.base import TaskContext, TpuExec
+from spark_rapids_tpu.failure import (is_fatal_device_error,
+                                      is_transient_device_error,
+                                      with_device_retry)
+from spark_rapids_tpu.memory.hbm import HbmBudget, TpuRetryOOM
+from spark_rapids_tpu.profiling import TaskMetricsRegistry
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+from spark_rapids_tpu.shuffle.ici import FetchFailedError
+from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+from spark_rapids_tpu.shuffle.serializer import (BlockIntegrityError,
+                                                 deserialize_table,
+                                                 get_codec, serialize_table,
+                                                 xxhash64_bytes)
+
+_BASE_CONF = {
+    "spark.rapids.tpu.agg.compiledStage.enabled": "false",
+    "spark.rapids.tpu.join.compiledStage.enabled": "false",
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.sql.shuffle.partitions": "3",
+    "spark.rapids.shuffle.compression.codec": "none",
+}
+
+
+def _conf(**kv) -> dict:
+    c = dict(_BASE_CONF)
+    c.update({k.replace("__", "."): v for k, v in kv.items()})
+    return c
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    """Every test starts and ends with a disarmed injector — armed chaos
+    must never leak into the rest of the suite."""
+    FaultInjector.reset_for_tests()
+    yield
+    FaultInjector.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_manager():
+    """Fresh shuffle manager: these tests need the uncompressed codec and a
+    private block-store root they can corrupt/inspect."""
+    import shutil
+    with TpuShuffleManager._lock:
+        old = TpuShuffleManager._instance
+        TpuShuffleManager._instance = None
+    yield
+    with TpuShuffleManager._lock:
+        cur = TpuShuffleManager._instance
+        TpuShuffleManager._instance = old
+    if cur is not None and cur is not old:
+        shutil.rmtree(cur.root, ignore_errors=True)
+
+
+def _configure(seed=0, sites=(), kinds=(), probability=0.5, **extra):
+    conf = RapidsConf(_conf(
+        spark__rapids__tpu__test__chaos__enabled="true",
+        spark__rapids__tpu__test__chaos__seed=str(seed),
+        spark__rapids__tpu__test__chaos__sites=",".join(sites),
+        spark__rapids__tpu__test__chaos__kinds=",".join(kinds),
+        spark__rapids__tpu__test__chaos__probability=str(probability),
+        **extra))
+    return FaultInjector.configure(conf)
+
+
+# ---------------------------------------------------------------------------
+# injection-trace determinism
+# ---------------------------------------------------------------------------
+
+
+def _drive_all_sites(rounds: int = 40) -> str:
+    """Deterministic single-threaded workload touching every site."""
+    payload = bytes(range(256)) * 4
+    for _ in range(rounds):
+        for site in ALL_SITES:
+            try:
+                with retry_scope(splittable=True):
+                    inject(site)
+            except BaseException:  # noqa: BLE001 — faults are the point
+                pass
+            corrupt_bytes(site, payload)
+    return FaultInjector.get().trace_text()
+
+
+def test_trace_determinism_same_seed():
+    _configure(seed=77)
+    t1 = _drive_all_sites()
+    _configure(seed=77)
+    t2 = _drive_all_sites()
+    assert t1 and t1 == t2  # byte-identical, and injection actually fired
+
+
+def test_trace_determinism_different_seed():
+    _configure(seed=77)
+    t1 = _drive_all_sites()
+    _configure(seed=78)
+    t2 = _drive_all_sites()
+    assert t1 != t2
+
+
+def test_trace_site_restriction():
+    _configure(seed=5, sites=("hbm.alloc",))
+    _drive_all_sites()
+    trace = FaultInjector.get().trace()
+    assert trace and all(r["site"] == "hbm.alloc" for r in trace)
+
+
+def test_oom_kinds_only_fire_in_retry_scope():
+    _configure(seed=3, sites=("hbm.alloc",),
+               kinds=("retry_oom", "split_oom"), probability=1.0)
+    inject("hbm.alloc")  # outside any retry scope: suppressed
+    with pytest.raises(TpuRetryOOM):
+        with retry_scope(splittable=False):  # split degrades to retry
+            for _ in range(50):
+                inject("hbm.alloc")
+
+
+# ---------------------------------------------------------------------------
+# forced counters (HbmBudget.force_retry_oom routed through the injector)
+# ---------------------------------------------------------------------------
+
+
+def test_force_counters_route_through_injector():
+    HbmBudget.reset_for_tests()
+    budget = HbmBudget.get()
+    budget.force_retry_oom(1)
+    with pytest.raises(TpuRetryOOM):
+        budget.allocate(8)
+    budget.allocate(8)  # counter consumed
+    trace = FaultInjector.get().trace()
+    assert any(r["forced"] and r["kind"] == "retry_oom"
+               and r["site"] == "hbm.alloc" for r in trace)
+    # a partially-consumed force is cleared by the budget reset
+    budget.force_retry_oom(100)
+    HbmBudget.reset_for_tests()
+    HbmBudget.get().allocate(8)
+
+
+# ---------------------------------------------------------------------------
+# shuffle block integrity (serializer framing + checksum)
+# ---------------------------------------------------------------------------
+
+
+def _table(n: int, seed: int = 0):
+    return pa.table({"a": pa.array([(i * 7 + seed) % 100 for i in range(n)],
+                                   type=pa.int64()),
+                     "b": pa.array([float(i % 13) for i in range(n)])})
+
+
+def test_checksum_roundtrip():
+    t = _table(64)
+    blk = serialize_table(t, get_codec("none"))
+    assert deserialize_table(blk).equals(t)
+    # unchecked blocks still round-trip (checksum field 0)
+    blk0 = serialize_table(t, get_codec("none"), checksum=False)
+    assert deserialize_table(blk0).equals(t)
+
+
+def test_checksum_detects_flipped_payload_byte():
+    blk = serialize_table(_table(64), get_codec("none"))
+    for off in (30, 31, len(blk) // 2, len(blk) - 1):  # payload region
+        bad = blk[:off] + bytes([blk[off] ^ 0xFF]) + blk[off + 1:]
+        with pytest.raises(BlockIntegrityError):
+            deserialize_table(bad)
+
+
+def test_checksum_detects_truncation():
+    blk = serialize_table(_table(64), get_codec("none"))
+    for cut in (0, 3, 12, 29, len(blk) - 1):
+        with pytest.raises(BlockIntegrityError):
+            deserialize_table(blk[:cut])
+
+
+def test_legacy_v1_block_still_reads():
+    import io
+    import struct
+    t = _table(16)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    raw = sink.getvalue()
+    v1 = b"TPUS" + struct.pack("<BQ", 0, len(raw)) + raw
+    assert deserialize_table(v1).equals(t)
+
+
+def test_xxhash64_matches_numpy_reference():
+    from spark_rapids_tpu.expressions.hashexprs import np_xxhash64_bytes
+    for n in (0, 1, 7, 31, 32, 33, 100, 5000):
+        data = bytes((i * 131 + n) % 256 for i in range(n))
+        assert xxhash64_bytes(data) == \
+            int(np_xxhash64_bytes(data, 0)) & ((1 << 64) - 1)
+
+
+# ---------------------------------------------------------------------------
+# corrupted block on disk → FetchFailed → lineage recompute heals the query
+# ---------------------------------------------------------------------------
+
+
+class _Source(TpuExec):
+    """Re-executable N-partition device source (lineage recompute re-runs
+    partitions, so execution counts are observable)."""
+
+    def __init__(self, tables, fail_partitions=()):
+        super().__init__([])
+        self._tables = tables
+        self._attrs = None
+        self.fail_partitions = set(fail_partitions)
+        self.executions = []
+        self._mu = threading.Lock()
+
+    @property
+    def output(self):
+        from spark_rapids_tpu.expressions.base import AttributeReference
+        from spark_rapids_tpu.types import from_arrow
+        if self._attrs is None:
+            self._attrs = [
+                AttributeReference(f.name, from_arrow(f.type), True,
+                                   ordinal=i)
+                for i, f in enumerate(self._tables[0].schema)]
+        return self._attrs
+
+    def num_partitions(self) -> int:
+        return len(self._tables)
+
+    def internal_do_execute_columnar(self, idx, ctx):
+        with self._mu:
+            self.executions.append(idx)
+        if idx in self.fail_partitions:
+            raise ValueError(f"source failure in partition {idx}")
+        yield TpuColumnarBatch.from_arrow(self._tables[idx])
+
+
+def _exchange_rows(exch, conf):
+    out = []
+    for p in range(exch.num_partitions()):
+        ctx = TaskContext(p, conf)
+        try:
+            for b in exch.execute_partition(p, ctx):
+                out.append(b.to_arrow())
+        finally:
+            ctx.complete()
+    return [t.column("a").to_pylist() for t in out]
+
+
+def _block_files(mgr):
+    files = []
+    for root, _, names in os.walk(mgr.root):
+        files.extend(os.path.join(root, n) for n in names
+                     if n.endswith(".block"))
+    return sorted(files)
+
+
+@pytest.mark.parametrize("mode", ["flip", "truncate"])
+def test_corrupted_block_heals_via_recompute(mode):
+    conf = RapidsConf(_conf())
+    src = _Source([_table(50, m) for m in range(4)])
+    exch = TpuShuffleExchangeExec(src, "roundrobin", [], 3)
+    clean = TpuShuffleExchangeExec(
+        _Source([_table(50, m) for m in range(4)]), "roundrobin", [], 3)
+    expect = _exchange_rows(clean, conf)
+    ctx = TaskContext(0, conf)
+    try:
+        exch._ensure_materialized(ctx)
+    finally:
+        ctx.complete()
+    mgr = TpuShuffleManager.get(conf)
+    files = _block_files(mgr)
+    assert files
+    victim = files[len(files) // 2]
+    with open(victim, "rb") as f:
+        data = f.read()
+    with open(victim, "wb") as f:
+        if mode == "flip":
+            mid = len(data) // 2
+            f.write(data[:mid] + bytes([data[mid] ^ 0x01])
+                    + data[mid + 1:])
+        else:
+            f.write(data[: len(data) // 2])
+    maps_before = len(src.executions)
+    got = _exchange_rows(exch, conf)
+    assert got == expect  # healed: bit-identical to the clean exchange
+    assert len(src.executions) > maps_before  # lineage recompute ran
+    exch.cleanup_shuffle(conf)
+    clean.cleanup_shuffle(conf)
+
+
+def test_fetch_retry_exhaustion_chains_cause():
+    conf = RapidsConf(_conf(
+        spark__rapids__tpu__shuffle__fetchRetry__maxAttempts="2"))
+    src = _Source([_table(40, m) for m in range(2)])
+    exch = TpuShuffleExchangeExec(src, "roundrobin", [], 2)
+    ctx = TaskContext(0, conf)
+    try:
+        exch._ensure_materialized(ctx)
+        # every subsequent read (including post-recompute re-reads) corrupts
+        FaultInjector.get().force("shuffle.read", "corrupt", 1000)
+        with pytest.raises(RuntimeError, match="after 2 re-materialization"):
+            list(exch.execute_partition(0, ctx))
+    finally:
+        ctx.complete()
+    try:
+        raise_seen = False
+        try:
+            FaultInjector.get().force("shuffle.read", "corrupt", 1000)
+            list(exch.execute_partition(1, TaskContext(1, conf)))
+        except RuntimeError as e:
+            raise_seen = True
+            assert isinstance(e.__cause__, FetchFailedError)
+        assert raise_seen
+    finally:
+        FaultInjector.reset_for_tests()
+        exch.cleanup_shuffle(conf)
+
+
+def test_fetch_retry_limit_counts_recovery_rounds():
+    """maxAttempts=1 still performs ONE re-materialization (it bounds
+    recovery rounds, not read attempts) — a single corrupt block heals."""
+    conf = RapidsConf(_conf(
+        spark__rapids__tpu__shuffle__fetchRetry__maxAttempts="1"))
+    src = _Source([_table(40, m) for m in range(2)])
+    exch = TpuShuffleExchangeExec(src, "roundrobin", [], 2)
+    clean = TpuShuffleExchangeExec(
+        _Source([_table(40, m) for m in range(2)]), "roundrobin", [], 2)
+    expect = _exchange_rows(clean, conf)
+    ctx = TaskContext(0, conf)
+    try:
+        exch._ensure_materialized(ctx)
+    finally:
+        ctx.complete()
+    victim = _block_files(TpuShuffleManager.get(conf))[0]
+    with open(victim, "r+b") as f:
+        f.seek(35)
+        b = f.read(1)
+        f.seek(35)
+        f.write(bytes([b[0] ^ 0x10]))
+    assert _exchange_rows(exch, conf) == expect
+    exch.cleanup_shuffle(conf)
+    clean.cleanup_shuffle(conf)
+
+
+def test_ici_concurrent_invalidation_raises_not_drops():
+    """A map invalidated AFTER a reader's completeness check must raise
+    FetchFailedError when reached — silently yielding nothing would drop
+    that map's rows from the query result."""
+    from spark_rapids_tpu.shuffle.ici import IciShuffleCatalog
+    catalog = IciShuffleCatalog.reset_for_tests()
+    try:
+        for m in range(2):
+            catalog.put_block(7, m, 0,
+                              TpuColumnarBatch.from_arrow(_table(8, m)),
+                              owner=f"executor-{m}")
+            catalog.mark_map_complete(7, m)
+        it = catalog.iter_blocks(7, 0, 2)
+        next(it)  # map 0 consumed; completeness check passed
+        catalog.invalidate_owner("executor-1")  # peer lost mid-iteration
+        with pytest.raises(FetchFailedError):
+            next(it)
+    finally:
+        IciShuffleCatalog.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# atomic block writes
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_leaves_no_partial_block(monkeypatch):
+    conf = RapidsConf(_conf())
+    mgr = TpuShuffleManager(conf)
+    try:
+        # crash between the tmp write and the rename: no .block may appear,
+        # no .tmp may linger (partition_sizes counts by existence)
+        real_replace = os.replace
+
+        def boom(srcp, dstp):
+            raise OSError("simulated crash mid-commit")
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError, match="mid-commit"):
+            mgr.write_map_output(1, 0, [_table(32)])
+        monkeypatch.setattr(os, "replace", real_replace)
+        leftover = [n for _, _, names in os.walk(mgr.root) for n in names]
+        assert leftover == []
+        # io_error injected before the write: same invariant
+        FaultInjector.get().force("shuffle.write", "io_error", 1)
+        with pytest.raises(OSError, match="chaos-injected"):
+            mgr.write_map_output(1, 0, [_table(32)])
+        leftover = [n for _, _, names in os.walk(mgr.root) for n in names]
+        assert leftover == []
+        assert mgr._limiter._in_flight == 0  # reservation released
+    finally:
+        import shutil
+        shutil.rmtree(mgr.root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# pipelined-exchange failure propagation
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_map_failure_cancels_siblings_and_releases_permits():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    TpuSemaphore.reset_for_tests()
+    conf = RapidsConf(_conf(
+        spark__rapids__tpu__shuffle__pipeline__enabled="true",
+        spark__rapids__tpu__shuffle__pipeline__mapThreads="2"))
+    n_maps = 8
+    src = _Source([_table(30, m) for m in range(n_maps)],
+                  fail_partitions={1})
+    exch = TpuShuffleExchangeExec(src, "roundrobin", [], 3)
+    ctx = TaskContext(0, conf)
+    try:
+        with pytest.raises(ValueError, match="partition 1"):
+            exch._ensure_materialized(ctx)
+    finally:
+        ctx.complete()
+    # fail-fast: with 2 pool threads and the failure in map 1, later maps
+    # must have been cancelled before starting
+    assert len(set(src.executions)) < n_maps
+    # every error path released its device permit and byte reservations
+    sem = TpuSemaphore.get(conf)
+    assert sem._sem._value == sem.permits
+    assert TpuShuffleManager.get(conf)._limiter._in_flight == 0
+    TpuSemaphore.reset_for_tests()
+    exch.cleanup_shuffle(conf)
+
+
+# ---------------------------------------------------------------------------
+# transient device-error retry
+# ---------------------------------------------------------------------------
+
+
+def test_classification_breadth():
+    class _XlaBase(RuntimeError):
+        pass
+
+    _XlaBase.__name__ = "XlaRuntimeError"
+
+    class _JaxlibFlavor(_XlaBase):  # subclass matched via the MRO walk
+        pass
+
+    assert is_transient_device_error(
+        _JaxlibFlavor("UNAVAILABLE: socket closed"))
+    assert is_fatal_device_error(_JaxlibFlavor("INTERNAL: device halted"))
+    # plain RuntimeError carrying an XLA status string
+    assert is_transient_device_error(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating"))
+    assert is_fatal_device_error(RuntimeError("DATA_LOSS: buffer poisoned"))
+    # fatal marker wins when both appear
+    assert not is_transient_device_error(
+        RuntimeError("UNAVAILABLE after INTERNAL failure"))
+    # cause-chain walk
+    outer = ValueError("wrapper")
+    outer.__cause__ = RuntimeError("ABORTED: preempted")
+    assert is_transient_device_error(outer)
+    # retry OOMs belong to their own framework
+    assert not is_transient_device_error(TpuRetryOOM("HBM budget"))
+    assert not is_transient_device_error(ValueError("ordinary"))
+
+
+def test_device_retry_heals_transient_with_backoff_bounds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) <= 2:
+            raise RuntimeError("UNAVAILABLE: transient hiccup")
+        return "ok"
+
+    before = TaskMetricsRegistry.get().snapshot()
+    t0 = time.perf_counter()
+    assert with_device_retry(flaky, None, max_attempts=4, base_ms=40,
+                             max_ms=1000) == "ok"
+    dt = time.perf_counter() - t0
+    after = TaskMetricsRegistry.get().snapshot()
+    assert len(calls) == 3
+    # jittered exponential backoff: sleeps in [20+40, 40+80]ms
+    assert 0.06 <= dt <= 2.0
+    assert after["deviceRetryCount"] - before.get("deviceRetryCount", 0) == 2
+    assert after["deviceRetryBlockTimeNs"] > before.get(
+        "deviceRetryBlockTimeNs", 0)
+
+
+def test_device_retry_never_retries_fatal_or_ordinary_errors():
+    for exc in (RuntimeError("INTERNAL: device halted"),
+                ValueError("plain bug"), TpuRetryOOM("oom")):
+        calls = []
+
+        def once(exc=exc):
+            calls.append(1)
+            raise exc
+
+        t0 = time.perf_counter()
+        with pytest.raises(type(exc)):
+            with_device_retry(once, None, max_attempts=5, base_ms=50)
+        assert len(calls) == 1  # not retried
+        assert time.perf_counter() - t0 < 0.05  # and no backoff slept
+
+
+def test_device_retry_exhausts_and_raises():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: still down")
+
+    with pytest.raises(RuntimeError, match="still down"):
+        with_device_retry(always, None, max_attempts=3, base_ms=1,
+                          max_ms=2)
+    assert len(calls) == 4  # initial + 3 retries
+
+
+def test_injected_fatal_reaches_failure_hook(tmp_path):
+    from spark_rapids_tpu.failure import handle_task_failure
+    _configure(seed=1, sites=("device.dispatch",), kinds=("fatal",),
+               probability=1.0)
+    conf = RapidsConf(_conf())
+    try:
+        with_device_retry(lambda: inject("device.dispatch"), conf)
+        raise AssertionError("fault did not fire")
+    except RuntimeError as e:
+        assert is_fatal_device_error(e)
+        bundle_conf = RapidsConf({"spark.rapids.tpu.coreDump.dir":
+                                  str(tmp_path)})
+        path = handle_task_failure(e, bundle_conf, exit_on_fatal=False)
+        assert path is not None and os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# spill-tier integrity
+# ---------------------------------------------------------------------------
+
+
+def test_spill_file_corruption_detected_on_unspill():
+    from spark_rapids_tpu.memory.spill import (SpillCorruptionError,
+                                               TpuBufferCatalog)
+    HbmBudget.reset_for_tests()
+    catalog = TpuBufferCatalog.reset_for_tests()
+    catalog.host_limit = 1  # everything spilled to host goes on to disk
+    from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+    sb = SpillableColumnarBatch(TpuColumnarBatch.from_arrow(_table(64)))
+    try:
+        catalog.synchronous_spill(1 << 40)  # push to host, then disk
+        entry = catalog._entries[sb._handle]
+        assert entry.tier == "DISK" and entry.disk_path
+        with open(entry.disk_path, "r+b") as f:
+            f.seek(20)
+            b = f.read(1)
+            f.seek(20)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(SpillCorruptionError):
+            sb.get_batch()
+    finally:
+        sb.close()
+        TpuBufferCatalog.reset_for_tests()
+        HbmBudget.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# the chaos soak: every site armed, multi-seed, bit-identical results
+# ---------------------------------------------------------------------------
+
+_SOAK_KINDS = "retry_oom,split_oom,transient,latency,corrupt,truncate"
+
+
+def _soak_conf(seed, fuse, **extra):
+    base = dict(
+        spark__rapids__tpu__test__chaos__enabled="true",
+        spark__rapids__tpu__test__chaos__seed=str(seed),
+        spark__rapids__tpu__test__chaos__kinds=_SOAK_KINDS,
+        spark__rapids__tpu__test__chaos__probability="0.12",
+        spark__rapids__tpu__opjit__fuseStages=fuse,
+        # generous heal budgets: the soak must converge for any draw order
+        spark__rapids__tpu__deviceRetry__maxAttempts="8",
+        spark__rapids__tpu__deviceRetry__backoffBaseMs="1",
+        spark__rapids__tpu__deviceRetry__backoffMaxMs="4",
+        spark__rapids__tpu__shuffle__fetchRetry__maxAttempts="8")
+    base.update(extra)
+    return _conf(**base)
+
+
+def _soak_queries(s: TpuSession):
+    """Representative plans: project/filter, shuffle, join, aggregate —
+    integer-exact measures so results are bit-identical under any
+    retry/split schedule."""
+    rows = [{"k": i % 7, "v": i * 3 - 50, "w": i % 13} for i in range(360)]
+    dim = [{"k2": i, "q": i * 11} for i in range(7)]
+    fd = s.createDataFrame(rows, num_partitions=4)
+    dd = s.createDataFrame(dim, num_partitions=2)
+    out = []
+    out.append(fd.filter(fd["v"] > 40)
+               .select((fd["v"] * 2 + fd["w"]).alias("x"),
+                       fd["k"]).sort("x", "k").collect())
+    out.append(fd.repartition(3, "k").sort("k", "v").collect())
+    out.append(fd.join(dd, on=fd["k"] == dd["k2"])
+               .groupBy("k").agg(F.sum(F.col("v")).alias("sv"),
+                                 F.count(F.col("w")).alias("cw"),
+                                 F.max(F.col("q")).alias("mq"))
+               .sort("k").collect())
+    return out
+
+
+@pytest.mark.parametrize("fuse", ["true", "false"])
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_chaos_soak_bit_identical(seed, fuse):
+    from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    TpuSemaphore.reset_for_tests()
+    # clean run first: the injector stays disarmed for the baseline
+    clean = _soak_queries(TpuSession(_conf(
+        spark__rapids__tpu__opjit__fuseStages=fuse)))
+    live_before = len(MemoryCleaner.get().live_resources())
+    chaos_session = TpuSession(_soak_conf(seed, fuse))
+    injector = FaultInjector.get()
+    assert injector.enabled
+    got = _soak_queries(chaos_session)
+    assert got == clean  # bit-identical under injection at every site
+    assert injector.injection_count() > 0  # the soak actually injected
+    # zero leaked device resources across the chaos run
+    assert len(MemoryCleaner.get().live_resources()) == live_before
+    # every semaphore permit returned
+    sem = TpuSemaphore._instance
+    if sem is not None:
+        assert sem._sem._value == sem.permits
+    # shuffle temp dirs cleaned (session cleanup_shuffle at query end)
+    mgr = TpuShuffleManager._instance
+    if mgr is not None:
+        assert _block_files(mgr) == []
+    assert TaskMetricsRegistry.get().snapshot().get("deviceRetryCount",
+                                                    0) >= 0
+    TpuSemaphore.reset_for_tests()
+
+
+def test_chaos_soak_ici_mode():
+    """ICI exchange under transient/latency chaos at the fetch + dispatch +
+    pipeline sites: device-resident blocks heal via with_device_retry."""
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.shuffle.ici import IciShuffleCatalog
+    TpuSemaphore.reset_for_tests()
+    IciShuffleCatalog.reset_for_tests()
+    base = dict(spark__rapids__shuffle__mode="ICI")
+    clean = _soak_queries(TpuSession(_conf(**base)))
+    got = _soak_queries(TpuSession(_soak_conf(
+        404, "true",
+        spark__rapids__tpu__test__chaos__sites=(
+            "ici.fetch,device.dispatch,pipeline.task"),
+        spark__rapids__tpu__test__chaos__kinds="transient,latency",
+        **base)))
+    assert got == clean
+    assert FaultInjector.get().injection_count() > 0
+    IciShuffleCatalog.reset_for_tests()
+    TpuSemaphore.reset_for_tests()

@@ -147,8 +147,14 @@ def test_fatal_classification():
     assert not is_fatal_device_error(_FakeXlaError("INVALID_ARGUMENT: shape"))
     # cause-chain walk
     outer = RuntimeError("wrapper")
-    outer.__cause__ = _FakeXlaError("UNAVAILABLE: connection lost")
+    outer.__cause__ = _FakeXlaError("DATA_LOSS: corrupted on-device buffer")
     assert is_fatal_device_error(outer)
+    # UNAVAILABLE is a TRANSIENT status since the device-retry split: it
+    # heals via with_device_retry instead of killing the executor
+    lost = _FakeXlaError("UNAVAILABLE: connection lost")
+    assert not is_fatal_device_error(lost)
+    from spark_rapids_tpu.failure import is_transient_device_error
+    assert is_transient_device_error(lost)
 
 
 def test_diagnostic_bundle(tmp_path):
