@@ -9,8 +9,11 @@ must lose only the tail, never the headline). The LAST printed line is always
 the most complete result; `detail.complete` is true only when every stage
 ran. Stage order: roofline calibration → q1 kernel → framework q1 + CPU
 baseline (headline printed here, target <5 min even on a cold compile
-cache) → hash-partition kernel → q6 → q3 compiled → q3 general ×2 → q3
-compiled at full 16.7M rows (soft-budget-gated bonus).
+cache) → q3 general ×4 (fuse on/off, coalesce on/off — FIRST after the
+headline, so the soft budget can no longer starve the comparison stages;
+per-stage elapsed recorded in detail.stage_elapsed_s) → hash-partition
+kernel → q6 → q3 compiled → q3 compiled at full 16.7M rows
+(soft-budget-gated bonus).
 
 Roofline methodology (VERDICT r2 weak #1): the chip sits behind a tunnel with
 a large FIXED per-dispatch+sync cost (~100 ms measured) and jax's
@@ -384,11 +387,14 @@ def main() -> None:
                  "throughput). q3_compiled runs the whole-stage compiled "
                  "join (one program per fact batch); the general shuffled "
                  "path is reported at 262k rows / 4+8 partitions for "
-                 "comparability with r03 and now runs under the opjit "
-                 "executable cache with whole-stage segment fusion and "
-                 "pipelined shuffle materialization (dispatch-by-kind "
-                 "deltas in its detail; the 8part_nofuse variant is the "
-                 "per-operator PR 1 baseline on the same rows). Datagen is "
+                 "comparability with r03 and runs FIRST (r05's soft budget "
+                 "starved it) under the opjit executable cache, whole-stage "
+                 "segment fusion, pipelined shuffle materialization, and "
+                 "now batch coalescing + deferred compaction (dispatch-by-"
+                 "kind AND blocking-sync-by-operator deltas in its detail; "
+                 "8part_nofuse is the per-operator PR 1 baseline, "
+                 "8part_nocoalesce the coalescing-off baseline on the same "
+                 "rows; stage_elapsed_s attributes the budget). Datagen is "
                  "process-stable from r04 (crc32 streams), so q3 numbers "
                  "compare across rounds"),
     }
@@ -409,10 +415,17 @@ def main() -> None:
 
     def stage(name, fn, budget_guard=False):
         """Run one bench stage; a failure or budget skip records itself in
-        the detail instead of killing the remaining stages."""
+        the detail instead of killing the remaining stages. Per-stage
+        elapsed lands in detail["stage_elapsed_s"] so a later budget skip
+        is attributable to the stages that actually consumed the budget
+        (r05 skipped q3_general_8part + q3_compiled_16M at 1667s with no
+        way to tell which earlier stage ate the time)."""
+        t0 = time.perf_counter()
+        sink = detail.setdefault("stage_elapsed_s", {})
         if budget_guard and elapsed() > _SOFT_BUDGET_S:
             detail[name] = {"skipped": f"soft budget {_SOFT_BUDGET_S}s "
                                        f"exceeded at {elapsed():.0f}s"}
+            sink[name] = 0.0
             emit()
             return None
         try:
@@ -421,6 +434,8 @@ def main() -> None:
             detail[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
             emit()
             return None
+        finally:
+            sink[name] = round(time.perf_counter() - t0, 1)
 
     # ---- fast core: calibration -> q1 kernel -> CPU -> framework q1 ----
     roofline = _calibrate()
@@ -472,6 +487,82 @@ def main() -> None:
     }
     emit()  # ---- headline is now on stdout, whatever happens later ----
 
+    def _q3_gen(parts, fuse=True, coalesce=True, tag=None):
+        def run():
+            # the general path runs through the per-operator executable
+            # cache (spark.rapids.tpu.opjit.enabled, default on) and, with
+            # fuse=True, whole-stage segment fusion
+            # (spark.rapids.tpu.opjit.fuseStages): the warm run traces each
+            # program once, the timed run should be all cache hits. The
+            # calls_by_kind delta is the DISPATCH ACCOUNTING (see
+            # docs/configs.md): with fusion on, a fused N-operator chain
+            # contributes ONE "segment" dispatch per batch where the
+            # fusion-off baseline (the PR 1 per-operator path) contributes N
+            # "project"/"filter" dispatches — the segment count, not the
+            # operator count, is what each batch pays through the tunnel.
+            # syncLedgerByOp is the SYNC ACCOUNTING (same doc section):
+            # blocking D→H transfers attributed to the operator that caused
+            # them; with coalescing + deferred compaction on, counts should
+            # be O(exchanges), not O(operators×batches). coalesce=False
+            # times the same rows with the coalescing layer off — the wall
+            # and dispatch deltas against the default run are the PR 5 win.
+            from spark_rapids_tpu.execs import opjit
+            from spark_rapids_tpu.profiling import SyncLedger
+            extra = {"spark.rapids.tpu.opjit.fuseStages": str(fuse).lower(),
+                     "spark.rapids.tpu.coalesce.enabled":
+                         str(coalesce).lower()}
+            before = opjit.cache_stats()
+            syncs_before = SyncLedger.get().totals_by_op()
+            g = _framework_q3(1 << 18, parts, compiled=False,
+                              extra_conf=extra)
+            after = opjit.cache_stats()
+            syncs_after = SyncLedger.get().totals_by_op()
+            kinds = {
+                k: after["calls_by_kind"].get(k, 0)
+                - before["calls_by_kind"].get(k, 0)
+                for k in set(after["calls_by_kind"])
+                | set(before["calls_by_kind"])}
+            kinds = {k: v for k, v in sorted(kinds.items()) if v}
+            syncs = {op: syncs_after.get(op, 0) - syncs_before.get(op, 0)
+                     for op in set(syncs_after) | set(syncs_before)}
+            syncs = {op: v for op, v in sorted(syncs.items()) if v}
+            detail.setdefault("q3_general", {})[tag or f"{parts}part"] = {
+                "wall_ms": round(g["sec"] * 1e3, 1),
+                "lineitem_rows": g["lineitem_rows"],
+                "rows_out": g["rows_out"],
+                "fuse_stages": fuse,
+                "coalesce": coalesce,
+                "opJitCacheHits": after["hits"] - before["hits"],
+                "opJitCacheMisses": after["misses"] - before["misses"],
+                "opJitTraceTime_s": round(
+                    (after["trace_time_ns"] - before["trace_time_ns"]) / 1e9,
+                    2),
+                "opJitDispatchesByKind": kinds,
+                "fusedSegmentDispatches": kinds.get("segment", 0),
+                "syncLedgerByOp": syncs,
+                "blockingSyncs": sum(syncs.values()),
+                "syncsPerPartition": round(
+                    sum(syncs.values()) / max(parts, 1), 1),
+                "opjit_cache_len": opjit.cache_len(),
+            }
+            emit()
+        return run
+    # q3_general comparison stages run FIRST (before the long kernel
+    # sweeps): r05's soft budget starved them at 1667s, and they are the
+    # numbers the coalescing/fusion story is asserted on
+    stage("q3_general_4part", _q3_gen(4), budget_guard=True)
+    stage("q3_general_8part", _q3_gen(8), budget_guard=True)
+    # PR 1 baseline on the same row count: fusion off, per-operator programs
+    # only — fusion-on wall time above should beat this strictly
+    stage("q3_general_8part_nofuse", _q3_gen(8, fuse=False, tag="8part_nofuse"),
+          budget_guard=True)
+    # coalescing-off baseline on the same rows: per-block uploads and
+    # per-batch dispatches — the default run above should beat it on both
+    # wall time and dispatch/sync counts
+    stage("q3_general_8part_nocoalesce",
+          _q3_gen(8, coalesce=False, tag="8part_nocoalesce"),
+          budget_guard=True)
+
     def _hp():
         hp = _kernel_hash_partition(n)
         detail["kernel_hash_partition"] = {
@@ -506,54 +597,6 @@ def main() -> None:
         emit()
     stage("q3_compiled", _q3_compiled)
 
-    def _q3_gen(parts, fuse=True, tag=None):
-        def run():
-            # the general path runs through the per-operator executable
-            # cache (spark.rapids.tpu.opjit.enabled, default on) and, with
-            # fuse=True, whole-stage segment fusion
-            # (spark.rapids.tpu.opjit.fuseStages): the warm run traces each
-            # program once, the timed run should be all cache hits. The
-            # calls_by_kind delta is the DISPATCH ACCOUNTING (see
-            # docs/configs.md): with fusion on, a fused N-operator chain
-            # contributes ONE "segment" dispatch per batch where the
-            # fusion-off baseline (the PR 1 per-operator path) contributes N
-            # "project"/"filter" dispatches — the segment count, not the
-            # operator count, is what each batch pays through the tunnel.
-            from spark_rapids_tpu.execs import opjit
-            extra = {"spark.rapids.tpu.opjit.fuseStages": str(fuse).lower()}
-            before = opjit.cache_stats()
-            g = _framework_q3(1 << 18, parts, compiled=False,
-                              extra_conf=extra)
-            after = opjit.cache_stats()
-            kinds = {
-                k: after["calls_by_kind"].get(k, 0)
-                - before["calls_by_kind"].get(k, 0)
-                for k in set(after["calls_by_kind"])
-                | set(before["calls_by_kind"])}
-            kinds = {k: v for k, v in sorted(kinds.items()) if v}
-            detail.setdefault("q3_general", {})[tag or f"{parts}part"] = {
-                "wall_ms": round(g["sec"] * 1e3, 1),
-                "lineitem_rows": g["lineitem_rows"],
-                "rows_out": g["rows_out"],
-                "fuse_stages": fuse,
-                "opJitCacheHits": after["hits"] - before["hits"],
-                "opJitCacheMisses": after["misses"] - before["misses"],
-                "opJitTraceTime_s": round(
-                    (after["trace_time_ns"] - before["trace_time_ns"]) / 1e9,
-                    2),
-                "opJitDispatchesByKind": kinds,
-                "fusedSegmentDispatches": kinds.get("segment", 0),
-                "opjit_cache_len": opjit.cache_len(),
-            }
-            emit()
-        return run
-    stage("q3_general_4part", _q3_gen(4), budget_guard=True)
-    stage("q3_general_8part", _q3_gen(8), budget_guard=True)
-    # PR 1 baseline on the same row count: fusion off, per-operator programs
-    # only — fusion-on wall time above should beat this strictly
-    stage("q3_general_8part_nofuse", _q3_gen(8, fuse=False, tag="8part_nofuse"),
-          budget_guard=True)
-
     def _q3_big():
         q3 = _framework_q3(n, 8)
         detail["q3_compiled_16M"] = {
@@ -569,7 +612,8 @@ def main() -> None:
 
     ok_keys = ("kernel_hash_partition", "q6_framework_ms", "q3_compiled",
                "q3_general_4part", "q3_general_8part",
-               "q3_general_8part_nofuse", "q3_compiled_16M")
+               "q3_general_8part_nofuse", "q3_general_8part_nocoalesce",
+               "q3_compiled_16M")
     detail["complete"] = not any(
         isinstance(detail.get(k), dict)
         and ("skipped" in detail[k] or "error" in detail[k])

@@ -6,6 +6,8 @@ Public surface for tools/tracelint.py, tools/gen_docs.py and the tests:
   ``eval_tpu`` and cross-check against plan/typechecks.py (TL001–TL004).
 * :func:`lint_tree` — concurrency lint over shuffle/, memory/, execs/
   (TL010).
+* :func:`lint_sync_tree` — blocking device→host syncs outside the audited
+  ledger gate in execs/ and shuffle/ (TL011).
 * :func:`corroborate` — dynamic ``jax.eval_shape`` probe vs the static
   verdicts (TL005).
 * :func:`scan_source` / :func:`scan_function` — detector layer over raw
@@ -22,13 +24,14 @@ from .concurrency import lint_module_source, lint_tree
 from .detectors import DETECTOR_IDS, scan_function, scan_source
 from .registry_check import (ExprReport, Finding, analyze_registry,
                              classify_class, execution_modes)
+from .syncs import lint_sync_module, lint_sync_tree
 
 __all__ = [
     "CONDITIONAL_HOST", "DEVICE", "HOST", "UNTRACEABLE", "Detection",
     "DETECTOR_IDS", "ExprReport", "Finding", "FunctionReport", "ModuleIndex",
     "analyze_registry", "classify_class", "corroborate", "execution_modes",
-    "lint_module_source", "lint_tree", "scan_function", "scan_source",
-    "worst",
+    "lint_module_source", "lint_sync_module", "lint_sync_tree", "lint_tree",
+    "scan_function", "scan_source", "worst",
 ]
 
 

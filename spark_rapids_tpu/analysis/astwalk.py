@@ -91,6 +91,13 @@ EXEMPT_CALLS = frozenset((
     "sorted", "print", "str",
 ))
 
+#: the audited device→host gate (columnar/vector.py): these BLOCK and sync,
+#: but they record themselves in the profiling sync ledger — their results
+#: are host values, and routing through them is exactly what TL011 asks for
+AUDITED_SYNC_CALLS = frozenset((
+    "audited_sync", "audited_sync_int", "audited_device_get",
+))
+
 #: host coercions: calling one of these on a device value syncs it to host
 COERCION_CALLS = frozenset(("bool", "int", "float", "complex"))
 
@@ -175,12 +182,24 @@ class HelperSummary:
             string_layout=self.string_layout or other.string_layout)
 
 
+#: simple annotations marking a parameter as host scalar data
+_SCALAR_ANNOTATIONS = frozenset(("int", "float", "bool", "str", "bytes"))
+
+
 def seed_params(fn: ast.FunctionDef) -> Dict[str, str]:
     """Taint seeds for analyzing a helper/method in isolation: device-ish
-    params by default, with name heuristics for scalars and containers."""
+    params by default, with name heuristics for scalars and containers.
+    Parameters whose names end in ``_py``/``_np``/``_host`` (the codebase's
+    already-materialized-data convention) and parameters annotated with a
+    plain scalar type are host values, not device taints."""
     seeds: Dict[str, str] = {}
     for a in fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs:
         if a.arg in SCALAR_PARAM_NAMES:
+            continue
+        if a.arg.endswith(("_py", "_np", "_host")):
+            continue
+        ann = a.annotation
+        if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTATIONS:
             continue
         seeds[a.arg] = SEQ if a.arg in SEQ_PARAM_NAMES else COL
     return seeds
@@ -382,8 +401,9 @@ class TaintState:
     def call_kind(self, node: ast.Call) -> Optional[str]:
         f = node.func
         if isinstance(f, ast.Name):
-            if f.id in EXEMPT_CALLS or f.id in COERCION_CALLS:
-                return None
+            if f.id in EXEMPT_CALLS or f.id in COERCION_CALLS \
+                    or f.id in AUDITED_SYNC_CALLS:
+                return None  # audited gate: ledger-recorded host result
             if f.id in ("list", "tuple"):
                 return SEQ if self._args_device(node) or any(
                     self.kind_of(a) == SEQ for a in node.args) else None

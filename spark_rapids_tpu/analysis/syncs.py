@@ -1,0 +1,95 @@
+"""Blocking-sync lint: raw device→host transfers outside the audited gate.
+
+PR 5's sync ledger (profiling.SyncLedger) only stays trustworthy if every
+blocking device→host transfer actually routes through the audited helpers
+in columnar/vector.py (``audited_sync`` / ``audited_sync_int`` /
+``audited_device_get``) — a raw ``np.asarray(device_value)``, ``.item()``
+or ``jax.device_get(...)`` is both an unledgered ~100ms round trip and the
+exact per-batch-sync regression the ledger exists to catch. This pass finds
+the pattern statically (rule **TL011**, error — baseline the deliberate
+ones with a comment):
+
+* a ``np.asarray(...)``/``np.array(...)`` call whose argument the taint
+  walk grades as a device value, in ``execs/`` or ``shuffle/``;
+* ``.item()`` on a device value;
+* ``jax.device_get(...)`` anywhere outside the audited helper module.
+
+The detection layer is the shared astwalk/detectors taint machinery (the
+same walk the registry cross-check uses), filtered down to the three
+blocking-transfer shapes; ``int()``/``float()`` coercions are TL001's
+territory (they are usually inside eval methods) and stay out of scope
+here. Baselined survivors are sites where the sync is inherent and already
+understood (e.g. host-assisted fallback paths that materialize whole
+columns — those are counted by the ledger at the ``to_arrow`` boundary
+instead).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from .detectors import scan_source
+from .registry_check import Finding
+
+#: packages the lint covers (relative to the spark_rapids_tpu package root)
+SYNC_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle")
+
+
+def _is_blocking_sync(d) -> bool:
+    if d.detector == "device-get":
+        return True
+    if d.detector == "np-on-device":
+        # only the pure-transfer calls: np.asarray/np.array of a device
+        # value. Other np.* consumers (np.iinfo etc. on metadata) are not
+        # transfers, and genuinely-compute np-on-device hits are TL001's
+        # registry territory.
+        snip = d.snippet or ""
+        return "np.asarray(" in snip or "np.array(" in snip \
+            or "numpy.asarray(" in snip
+    if d.detector == "host-method":
+        return ".item()" in (d.snippet or "")
+    return False
+
+
+def lint_sync_module(source: str, relpath: str) -> List[Finding]:
+    """TL011 findings for one module's source."""
+    findings: List[Finding] = []
+    try:
+        reports = scan_source(source, relpath)
+    except SyntaxError:
+        return findings
+    for qual, rep in sorted(reports.items()):
+        hits = [d for d in rep.detections if _is_blocking_sync(d)]
+        if not hits:
+            continue
+        lines = sorted({d.line for d in hits})
+        kinds = sorted({d.detector for d in hits})
+        findings.append(Finding(
+            "TL011", "error", f"{relpath}::{qual}",
+            f"blocking device→host sync outside the audited gate "
+            f"({'/'.join(kinds)} at line{'s' if len(lines) > 1 else ''} "
+            f"{', '.join(map(str, lines))}) — route through "
+            f"columnar/vector.py audited_sync*/audited_device_get so the "
+            f"sync ledger sees it, or baseline with a comment"))
+    return findings
+
+
+def lint_sync_tree(root: Optional[str] = None,
+                   subpackages: Tuple[str, ...] = SYNC_SUBPACKAGES
+                   ) -> List[Finding]:
+    """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    for sub in subpackages:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(d, fname)) as f:
+                src = f.read()
+            findings.extend(lint_sync_module(src, f"{sub}/{fname}"))
+    return findings

@@ -8,7 +8,6 @@ GpuRowToColumnarExec.scala, HostColumnarToGpu.scala). The host substrate is Arro
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import functools as _functools
@@ -19,20 +18,74 @@ import numpy as np
 from jax import jit as _jax_jit
 
 from ..types import DataType, StructField, StructType, from_arrow as arrow_to_type
-from .vector import TpuColumnVector, bucket_capacity, row_mask
+from .vector import (TpuColumnVector, audited_device_get, audited_sync,
+                     audited_sync_int, bucket_capacity, row_mask)
 
 
-@dataclass
 class TpuColumnarBatch:
-    """A batch of device columns sharing num_rows/capacity."""
+    """A batch of device columns sharing num_rows/capacity.
 
-    columns: List[TpuColumnVector]
-    num_rows: int
-    names: Optional[List[str]] = None
+    `num_rows` may be constructed from a DEVICE int scalar (deferred
+    compaction, `compact(..., deferred=True)`): the count then rides along
+    as a device value — `rows_lazy`/`rows_arg` expose it without blocking —
+    and materializes to a host int on first `.num_rows` read, or for free
+    inside `to_arrow`'s batched device_get. Rows in [num_rows, capacity)
+    are padding with validity False either way, so device math over a
+    deferred batch is identical to the materialized one."""
 
-    def __post_init__(self) -> None:
+    __slots__ = ("columns", "names", "_num_rows", "_rows_dev")
+
+    def __init__(self, columns: List[TpuColumnVector], num_rows,
+                 names: Optional[List[str]] = None):
+        self.columns = columns
+        self.names = names
+        if isinstance(num_rows, (int, np.integer)):
+            self._num_rows: Optional[int] = int(num_rows)
+            self._rows_dev = None
+            for c in columns:
+                assert not isinstance(c.num_rows, (int, np.integer)) \
+                    or c.num_rows == self._num_rows, \
+                    "column row counts must agree"
+        else:  # device scalar: deferred row count
+            self._num_rows = None
+            self._rows_dev = num_rows
+
+    @property
+    def num_rows(self) -> int:
+        """Logical row count; materializes a deferred count (ONE blocking
+        scalar sync, recorded in the ledger) on first read."""
+        if self._num_rows is None:
+            self._set_rows(audited_sync_int(self._rows_dev, "rows"))
+        return self._num_rows
+
+    @property
+    def has_pending_rows(self) -> bool:
+        return self._num_rows is None
+
+    @property
+    def rows_lazy(self):
+        """The row count WITHOUT forcing a sync: host int when known,
+        device scalar otherwise (TpuMetric.add_lazy accepts either)."""
+        return self._rows_dev if self._num_rows is None else self._num_rows
+
+    @property
+    def rows_arg(self):
+        """Row count as a jitted-program argument: int or device scalar
+        (jax specializes per argument signature; results are identical)."""
+        return self.rows_lazy
+
+    def _set_rows(self, n: int) -> None:
+        self._num_rows = int(n)
+        self._rows_dev = None
+        # columns built under a deferred count carry the device scalar too;
+        # patch them so direct column access sees the host int
         for c in self.columns:
-            assert c.num_rows == self.num_rows, "column row counts must agree"
+            if not isinstance(c.num_rows, (int, np.integer)):
+                c.num_rows = self._num_rows
+                if c.children is not None:
+                    for k in c.children:
+                        if not isinstance(k.num_rows, (int, np.integer)):
+                            k.num_rows = self._num_rows
 
     @property
     def num_columns(self) -> int:
@@ -53,12 +106,13 @@ class TpuColumnarBatch:
         return sum(c.device_memory_size() for c in self.columns)
 
     def to_arrow(self):
-        import jax
         import pyarrow as pa
         names = self.names or [f"c{i}" for i in range(self.num_columns)]
         # ONE device_get for every device buffer in the batch: each
         # np.asarray on a jax.Array is a blocking round trip, which dominates
-        # result materialization on high-latency links (tunneled TPUs)
+        # result materialization on high-latency links (tunneled TPUs). A
+        # deferred row count rides the SAME transfer — materializing at the
+        # boundary costs zero extra syncs.
         leaves: List = []
 
         def collect(c):
@@ -75,7 +129,16 @@ class TpuColumnarBatch:
 
         for c in self.columns:
             collect(c)
-        fetched = iter(jax.device_get(leaves)) if leaves else iter(())
+        pending = self.has_pending_rows
+        if pending:
+            leaves.append(self._rows_dev)
+        if leaves:
+            got = audited_device_get(leaves, "batch")
+        else:
+            got = []
+        if pending:
+            self._set_rows(int(got.pop()))
+        fetched = iter(got)
 
         def localize(c):
             if c.host_data is not None:
@@ -184,11 +247,13 @@ class TpuColumnarBatch:
 
     def select(self, indices: Sequence[int]) -> "TpuColumnarBatch":
         names = self.names
-        return TpuColumnarBatch([self.columns[i] for i in indices], self.num_rows,
+        return TpuColumnarBatch([self.columns[i] for i in indices],
+                                self.rows_lazy,
                                 [names[i] for i in indices] if names else None)
 
     def rename(self, names: List[str]) -> "TpuColumnarBatch":
-        return TpuColumnarBatch(self.columns, self.num_rows, list(names))
+        # rows_lazy: renaming a deferred batch must not force its count
+        return TpuColumnarBatch(self.columns, self.rows_lazy, list(names))
 
 
 def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
@@ -230,14 +295,23 @@ def _repad(col: TpuColumnVector, capacity: int) -> TpuColumnVector:
                            child=col.child)
 
 
-def gather(batch: TpuColumnarBatch, indices, out_rows: int,
+def gather(batch: TpuColumnarBatch, indices, out_rows,
            out_capacity: Optional[int] = None) -> TpuColumnarBatch:
     """Row gather across all columns (reference: cudf Table.gather / GatherMap).
 
     `indices` is a device int32 array of length >= out_capacity; entries beyond
     out_rows are ignored (padding). Out-of-range entries yield null rows, matching
     cuDF OutOfBoundsPolicy.NULLIFY.
+
+    `out_rows` may be a DEVICE int scalar (deferred compaction): the gather
+    runs entirely on device and the returned batch carries a pending row
+    count (`out_capacity` is then required — a bucketed capacity cannot be
+    derived without syncing).
     """
+    deferred = not isinstance(out_rows, (int, np.integer))
+    if deferred:
+        assert out_capacity is not None, \
+            "deferred gather requires an explicit out_capacity"
     cap = out_capacity if out_capacity is not None else bucket_capacity(out_rows)
     idx = jnp.asarray(indices)[:cap].astype(jnp.int32)
     # fixed-width columns gather in ONE compiled program (each eager op is a
@@ -251,12 +325,12 @@ def gather(batch: TpuColumnarBatch, indices, out_rows: int,
         datas = [c.data for _, c in fixed]
         valids = [c.validity for _, c in fixed]
         g_datas, g_valids = _gather_fixed_cols(
-            datas, valids, idx, jnp.int32(batch.num_rows),
+            datas, valids, idx, jnp.int32(batch.rows_arg),
             jnp.int32(out_rows))
         for (i, c), d, v in zip(fixed, g_datas, g_valids):
             out_cols[i] = TpuColumnVector(c.dtype, d, v, out_rows)
     if len(fixed) != len(batch.columns):
-        valid_idx = (idx >= 0) & (idx < batch.num_rows)
+        valid_idx = (idx >= 0) & (idx < batch.rows_arg)
         safe = jnp.where(valid_idx, idx, 0)
         pad_mask = row_mask(out_rows, cap)
         for i, col in enumerate(batch.columns):
@@ -327,7 +401,8 @@ def _gather_strings(col: TpuColumnVector, safe_idx, valid, out_rows: int,
     from ..kernels.strings import build_ranges
     starts, lens, total_dev = _gather_string_plan(col.offsets, safe_idx,
                                                   valid)
-    out_cap = bucket_capacity(max(int(total_dev), 1))  # scalar sync
+    # scalar sync: the output byte capacity is a static program shape
+    out_cap = bucket_capacity(max(audited_sync_int(total_dev, "chars"), 1))
     data, new_offsets = build_ranges(col.data, starts, lens, out_cap)
     v = valid
     if col.validity is not None:
@@ -343,8 +418,10 @@ def _gather_lists(col: TpuColumnVector, safe_idx, valid, out_rows: int,
     ragged-gather kernel). Reference: cuDF gathers LIST columns natively."""
     import pyarrow as pa
     import pyarrow.compute as pc
-    idx_np = np.asarray(safe_idx)[:cap].astype(np.int64)
-    valid_np = np.asarray(valid)[:cap]
+    if not isinstance(out_rows, (int, np.integer)):
+        out_rows = audited_sync_int(out_rows, "rows")  # host take needs it
+    idx_np = audited_sync(safe_idx, "gather")[:cap].astype(np.int64)
+    valid_np = audited_sync(valid, "gather")[:cap]
     take_idx = pa.array(np.where(valid_np, idx_np, 0)[:out_rows],
                         mask=~valid_np[:out_rows])
     taken = pc.take(col.to_arrow(), take_idx)
@@ -366,14 +443,32 @@ def _compact_plan(mask, num_rows):
     return idx, jnp.sum(mask)
 
 
-def compact(batch: TpuColumnarBatch, keep_mask) -> TpuColumnarBatch:
+def deferrable(batch: TpuColumnarBatch) -> bool:
+    """May this batch's compaction defer its row-count sync? Host-resident
+    and nested columns need a host count to gather, so they stay eager."""
+    return all(c.host_data is None and c.child is None and c.children is None
+               for c in batch.columns)
+
+
+def compact(batch: TpuColumnarBatch, keep_mask,
+            deferred: bool = False) -> TpuColumnarBatch:
     """Filter: keep rows where mask is True, preserving order
     (reference GpuFilter: boolean mask + cudf apply_boolean_mask,
-    basicPhysicalOperators.scala:638). Uses a stable cumsum-scatter; the kept-row
-    count is synced to host (it becomes the new logical num_rows)."""
+    basicPhysicalOperators.scala:638). Uses a stable cumsum-scatter.
+
+    Default mode syncs the kept-row count to host (it becomes the new
+    logical num_rows). With `deferred=True` (and a batch whose columns can
+    gather under a device count — `deferrable`) the count stays a DEVICE
+    scalar: the output keeps the input's bucketed padded capacity, rows
+    beyond the kept count are padding with validity False, and the count
+    materializes at the first consumer that needs a host int — for a
+    filter→project→serialize chain that is the exchange/collect boundary,
+    where it rides the batch device_get for free."""
     cap = batch.capacity
-    idx, n_dev = _compact_plan(jnp.asarray(keep_mask), batch.num_rows)
-    n_keep = int(n_dev)  # D→H sync: one scalar per batch
+    idx, n_dev = _compact_plan(jnp.asarray(keep_mask), batch.rows_arg)
+    if deferred and deferrable(batch):
+        return gather(batch, idx, n_dev, out_capacity=cap)
+    n_keep = audited_sync_int(n_dev, "rows")  # D→H sync: one scalar per batch
     return gather(batch, idx, n_keep, out_capacity=cap)
 
 
@@ -383,12 +478,26 @@ def slice_batch(batch: TpuColumnarBatch, start: int, length: int) -> TpuColumnar
     return gather(batch, idx, length, out_capacity=batch.capacity)
 
 
+def materialize_row_counts(batches: List[TpuColumnarBatch]) -> None:
+    """Force every pending deferred row count in the list with ONE blocking
+    transfer (audited_device_get stacks the scalars into a single round
+    trip). A coalesce window of N deferred batches costs one 'rows' sync,
+    not N."""
+    pending = [b for b in batches if b.has_pending_rows]
+    if not pending:
+        return
+    got = audited_device_get([b._rows_dev for b in pending], "rows")
+    for b, n in zip(pending, got):
+        b._set_rows(int(n))
+
+
 def concat_batches(batches: List[TpuColumnarBatch]) -> TpuColumnarBatch:
     """Concatenate batches (reference: cudf Table.concatenate, used by coalesce).
     Routed through Arrow host concat for ragged columns; fixed-width stays on device."""
     assert batches
     if len(batches) == 1:
         return batches[0]
+    materialize_row_counts(batches)
     total = sum(b.num_rows for b in batches)
     names = batches[0].names
     out_cols: List[Optional[TpuColumnVector]] = [None] * batches[0].num_columns

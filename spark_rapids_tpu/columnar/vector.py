@@ -140,19 +140,28 @@ class TpuColumnVector:
         return int(n)
 
     # ---- host materialization (the D→H boundary) ----
+    def _host_rows(self) -> int:
+        """num_rows as a host int (columns inside a deferred-compaction
+        batch carry a device scalar until the batch materializes)."""
+        n = self.num_rows
+        if not isinstance(n, (int, np.integer)):
+            n = audited_sync_int(n, "rows")
+            self.num_rows = n
+        return int(n)
+
     def to_numpy(self) -> np.ndarray:
         """Logical values as a numpy array; nulls surfaced via to_arrow instead."""
-        return np.asarray(self.data[: self.num_rows])
+        return audited_sync(self.data[: self._host_rows()], "fetch")
 
     def to_arrow(self):
         import pyarrow as pa
         from ..types import to_arrow as t2a
-        n = self.num_rows
+        n = self._host_rows()
         if self.host_data is not None:
             return self.host_data.slice(0, n) if len(self.host_data) > n \
                 else self.host_data
         if self.validity is not None:
-            valid = np.asarray(self.validity[:n])
+            valid = audited_sync(self.validity[:n], "fetch")
             mask = ~valid
         else:
             mask = None
@@ -174,7 +183,8 @@ class TpuColumnVector:
                                          null_count=nulls, children=kids)
         from ..types import MapType as _Mt
         if isinstance(self.dtype, _Mt):
-            offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
+            offs = audited_sync(self.offsets[: n + 1],
+                                "fetch").astype(np.int32)
             n_elems = int(offs[-1]) if n else 0
             keys = self.child.children[0].to_arrow()
             items = self.child.children[1].to_arrow()
@@ -197,7 +207,8 @@ class TpuColumnVector:
                 atype, n, [bitmap, pa.py_buffer(offs.tobytes())],
                 null_count=nulls, children=[entries])
         if isinstance(self.dtype, ArrayType):
-            offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
+            offs = audited_sync(self.offsets[: n + 1],
+                                "fetch").astype(np.int32)
             n_elems = int(offs[-1]) if n else 0
             elems = self.child.to_arrow() if self.child.num_rows == n_elems else \
                 self.child.to_arrow().slice(0, n_elems)
@@ -211,8 +222,10 @@ class TpuColumnVector:
                 atype, n, [bitmap, pa.py_buffer(offs.tobytes())],
                 null_count=nulls, children=[elems])
         if isinstance(self.dtype, (StringType, BinaryType)):
-            offs = np.asarray(self.offsets[: n + 1]).astype(np.int32)
-            chars = np.asarray(self.data[: int(offs[-1])]).tobytes() if n else b""
+            offs = audited_sync(self.offsets[: n + 1],
+                                "fetch").astype(np.int32)
+            chars = audited_sync(self.data[: int(offs[-1])],
+                                 "fetch").tobytes() if n else b""
             buf_offs = pa.py_buffer(offs.tobytes())
             buf_data = pa.py_buffer(chars)
             if mask is not None:
@@ -222,7 +235,7 @@ class TpuColumnVector:
                 bitmap, nulls = None, 0
             atype = pa.string() if isinstance(self.dtype, StringType) else pa.binary()
             return pa.Array.from_buffers(atype, n, [bitmap, buf_offs, buf_data], null_count=nulls)
-        vals = np.asarray(self.data[:n])
+        vals = audited_sync(self.data[:n], "fetch")
         if isinstance(self.dtype, DecimalType):
             import decimal as _d
             scale = self.dtype.scale
@@ -500,6 +513,47 @@ class TpuColumnVector:
 def row_mask(num_rows: int, capacity: int) -> jax.Array:
     """Mask that is True for logical rows, False for padding."""
     return jnp.arange(capacity) < num_rows
+
+
+# ---------------------------------------------------------------------------
+# the audited device→host sync gate (profiling sync ledger)
+#
+# Every BLOCKING device→host transfer in execs/ and shuffle/ must route
+# through one of these three helpers: each records itself in the process-wide
+# sync ledger (profiling.SyncLedger) under the active operator scope, so a
+# per-batch sync regression is visible in metrics and bench output instead
+# of only in wall time. tracelint rule TL011 statically flags raw
+# np.asarray/.item()/jax.device_get on device values outside this gate.
+# ---------------------------------------------------------------------------
+
+
+def audited_sync(value, kind: str = "fetch") -> np.ndarray:
+    """np.asarray of a (possibly device) array through the ledger. Free for
+    values already on host."""
+    if isinstance(value, np.ndarray):
+        return value
+    from ..profiling import record_sync
+    record_sync(kind)
+    return np.asarray(value)
+
+
+def audited_sync_int(value, kind: str = "scalar") -> int:
+    """int() of a device scalar through the ledger (the compaction/join
+    count syncs)."""
+    if isinstance(value, (int, np.integer)):
+        return int(value)
+    from ..profiling import record_sync
+    record_sync(kind)
+    return int(value)
+
+
+def audited_device_get(leaves, kind: str = "batch"):
+    """ONE jax.device_get for a list of device buffers through the ledger
+    (batch materialization: the whole transfer is a single blocking round
+    trip regardless of leaf count, so it records as ONE sync)."""
+    from ..profiling import record_sync
+    record_sync(kind)
+    return jax.device_get(leaves)
 
 
 @dataclass(frozen=True)

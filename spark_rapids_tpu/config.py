@@ -227,6 +227,45 @@ general-path wall time. The opjit cache tracks it:
 * `opJitTraceTime` isolates first-sight compile cost from steady-state
   dispatch cost; steady state should be all hits.
 
+## Batch coalescing
+
+Small batches multiply every per-batch cost above. With
+`spark.rapids.tpu.coalesce.enabled` (default on) the plan pass inserts
+`TpuCoalesceBatchesExec` ahead of batch-hungry operators — joins,
+aggregates, sorts, and fused segments — concatenating device batches up to
+`spark.rapids.sql.batchSizeBytes` / `batchSizeRows` (spill-aware: pending
+inputs are held as `SpillableColumnarBatch` so HBM pressure can evict them
+mid-concat). Join build sides use a `RequireSingleBatch`-style goal. The
+same targets drive HOST-side coalescing of fetched shuffle blocks: the
+exchange reduce path and `HostToDeviceExec` concatenate Arrow tables to
+target size *before* the H→D upload, so one upload and one downstream
+dispatch replace one per block (reference `GpuShuffleCoalesceExec`).
+
+## Dispatch & sync accounting
+
+Besides dispatch counts, every BLOCKING device→host transfer (a
+`np.asarray`/`.item()`/`jax.device_get` of a device value — each one a full
+round trip through the tunnel) is attributed to the operator that caused it
+via the process-wide **sync ledger** (`profiling.SyncLedger`). All blocking
+syncs in the engine route through one audited helper
+(`columnar/vector.py: audited_sync*`), enforced statically by tracelint
+rule TL011; the ledger records `{operator: {kind: count}}` where kind names
+the reason (`rows` — a compaction/filter row count, `bounds` — exchange
+split bounds, `pairs` — join pair count, `chars` — string gather sizing,
+`batch` — batch materialization at the D→H boundary, ...).
+
+* `SyncLedger.get().snapshot()` returns per-operator counts;
+  `total()` the process-wide sum. bench.py's q3_general detail reports the
+  per-run delta next to `opJitDispatchesByKind`.
+* With deferred compaction + coalescing on, a healthy general-path run
+  shows blocking syncs per partition bounded by O(exchanges) — one `bounds`
+  sync per map batch and one `batch` materialization per boundary — not
+  O(operators×batches). A regression shows up as a per-operator `rows`
+  count that scales with batch count.
+* `TpuMetric` row counts accumulate device-side when a batch's row count is
+  still deferred (`add_lazy`) and materialize at metric read time (query
+  end), so metric bookkeeping itself never forces a sync.
+
 ## Robustness
 
 Batch-level work survives memory pressure via spill + retry/split
@@ -335,6 +374,28 @@ BUCKET_PADDING = _conf("spark.rapids.tpu.batch.bucketPadding.enabled").doc(
     "Pad batch capacities to power-of-two buckets to bound XLA recompilation under "
     "data-dependent row counts (TPU-specific; no reference analogue — cuDF kernels "
     "accept dynamic sizes, XLA does not)."
+).boolean(True)
+
+COALESCE_ENABLED = _conf("spark.rapids.tpu.coalesce.enabled").doc(
+    "Batch coalescing for the general path (reference GpuCoalesceBatches + "
+    "GpuShuffleCoalesceExec): concatenate undersized batches up to "
+    "spark.rapids.sql.batchSizeBytes / batchSizeRows before batch-hungry "
+    "operators (joins, aggregates, sorts, fused segments), and concatenate "
+    "fetched shuffle blocks HOST-side to the same target before the "
+    "host→device upload. On a high-dispatch-latency link every batch pays "
+    "a fixed launch+sync cost, so fewer, fuller batches are the difference "
+    "between O(batches) and O(exchanges) round trips per operator."
+).commonly_used().boolean(True)
+
+DEFERRED_COMPACTION = _conf(
+    "spark.rapids.tpu.batch.deferredCompaction.enabled").doc(
+    "Defer the filter/join compaction row-count sync: `compact` keeps the "
+    "bucketed padded capacity and carries the kept-row count as a DEVICE "
+    "scalar, so a filter→project→serialize chain syncs once at the "
+    "exchange/collect boundary (the count rides the same device_get as the "
+    "data) instead of one blocking scalar read per batch per operator. "
+    "Consumers that need the host row count materialize it transparently; "
+    "results are bit-identical either way."
 ).boolean(True)
 
 # ---------------------------------------------------------------------------

@@ -875,11 +875,69 @@ def _segment_collect(fn, col: TpuColumnVector, seg_ids, g_cap: int,
 
 def _host_collect(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
     """Arrow-assisted collect_set for string/nested inputs (value bits don't
-    exist on device); produces the same value-sorted-set layout."""
+    exist on device); produces the same value-sorted-set layout.
+
+    Vectorized for arrow-sortable element types (strings/binary/numerics):
+    one arrow take + one (segment, value) sort + a numpy consecutive-dedup —
+    no per-row python loop. Nested elements (arrow cannot sort them) keep
+    the pylist path with first-seen order."""
     import pyarrow as pa
+    import pyarrow.compute as pc
     arr = col.to_arrow()  # original row domain
-    perm_np = np.asarray(perm)[:capacity]
-    seg_np = np.asarray(seg_ids)[:capacity]
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    from ..columnar.vector import audited_sync
+    perm_np = audited_sync(perm, "fetch")[:capacity]
+    seg_np = audited_sync(seg_ids, "fetch")[:capacity].astype(np.int64)
+    from ..types import to_arrow as type_to_arrow
+    in_range = perm_np < min(num_rows, len(arr))
+    rows = perm_np[in_range].astype(np.int64)
+    segs = seg_np[in_range]
+    vals = arr.take(pa.array(rows))
+    valid_np = np.asarray(vals.is_valid()) & (segs < g_cap)
+    vals = vals.filter(pa.array(valid_np))
+    segs = segs[valid_np]
+    try:
+        order = pc.sort_indices(
+            pa.table({"s": pa.array(segs), "v": vals}),
+            sort_keys=[("s", "ascending"), ("v", "ascending")])
+    except (pa.ArrowNotImplementedError, pa.ArrowInvalid, TypeError):
+        return _host_collect_pylist(fn, arr, perm_np, seg_np, g_cap,
+                                    capacity, num_rows)
+    order_np = np.asarray(order).astype(np.int64)
+    segs_sorted = segs[order_np]
+    vals_sorted = vals.take(order)
+    # consecutive dedup on (segment, dictionary code): equal strings share a
+    # code, so a code change == a value change within the segment run
+    enc = pc.dictionary_encode(vals_sorted)
+    if isinstance(enc, pa.ChunkedArray):
+        enc = enc.combine_chunks()
+    codes = np.asarray(enc.indices.to_numpy(zero_copy_only=False)
+                       ).astype(np.int64)
+    n = len(segs_sorted)
+    first = np.ones(n, dtype=bool)
+    if n > 1:
+        first[1:] = (segs_sorted[1:] != segs_sorted[:-1]) | \
+            (codes[1:] != codes[:-1])
+    counts = np.bincount(segs_sorted[first], minlength=g_cap)
+    offsets = np.zeros(g_cap + 1, dtype=np.int32)
+    np.cumsum(counts, out=offsets[1:])
+    child = vals_sorted.filter(pa.array(first))
+    elem_t = type_to_arrow(fn.dtype).value_type
+    if child.type != elem_t:
+        child = child.cast(elem_t)
+    list_arr = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()), child)
+    if list_arr.type != type_to_arrow(fn.dtype):
+        list_arr = list_arr.cast(type_to_arrow(fn.dtype))
+    final = TpuColumnVector.from_arrow(list_arr)
+    return {"__final": final}
+
+
+def _host_collect_pylist(fn, arr, perm_np, seg_np, g_cap, capacity,
+                         num_rows):
+    """Per-row fallback for element types arrow cannot sort (nested):
+    first-seen order, python-level dedup — the pre-vectorization path."""
+    import pyarrow as pa
     vals = arr.to_pylist()
     sets: Dict[int, list] = {}
     for i in range(capacity):
@@ -907,35 +965,120 @@ def _host_collect(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
 def _host_segment_minmax(fn, col, seg_ids, g_cap: int, capacity: int,
                          num_rows: int, perm):
     """min/max/first/last for variable-width columns, host-side over sorted
-    segments (groups are contiguous after the key sort)."""
+    segments (groups are contiguous after the key sort).
+
+    Vectorized: first/last reduce to one numpy segment min/max over sorted
+    POSITIONS (any element type — the value is fetched with one arrow take
+    of the chosen row per group); min/max over VALUES use numpy minimum/
+    maximum.at for numeric carriers and an arrow (segment, value) sort for
+    other orderable types (strings/binary). Only element types arrow cannot
+    order fall back to the per-row pylist loop."""
     import pyarrow as pa
+    import pyarrow.compute as pc
     arr = col.to_arrow()  # original row domain
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
-    perm_np = np.asarray(perm)[:num_rows]
-    seg_np = np.asarray(seg_ids)[:num_rows]
-    vals = arr.to_pylist()
+    from ..columnar.vector import audited_sync
+    perm_np = audited_sync(perm, "fetch")[:num_rows].astype(np.int64)
+    seg_np = audited_sync(seg_ids, "fetch")[:num_rows].astype(np.int64)
     op = fn.update_op
     ignore_nulls = getattr(fn, "ignore_nulls", False)
     n_groups = int(seg_np.max()) + 1 if num_rows else 0
+    from ..types import to_arrow as type_to_arrow
+    atype = type_to_arrow(fn.dtype)
+
+    def result_from_rows(sel_rows: np.ndarray, has: np.ndarray):
+        """One arrow take of the chosen source row per group; groups without
+        a chosen row take a null index → null output."""
+        idx = pa.array(np.where(has, sel_rows, 0), mask=~has)
+        out = arr.take(idx)
+        return {"__final": TpuColumnVector.from_arrow(
+            out if out.type == atype else out.cast(atype))}
+
+    if op in ("first", "last"):
+        pos = np.arange(num_rows, dtype=np.int64)
+        if ignore_nulls:
+            valid = np.asarray(arr.is_valid())
+            eligible = valid[perm_np] if len(valid) else \
+                np.zeros(num_rows, dtype=bool)
+        else:
+            eligible = np.ones(num_rows, dtype=bool)
+        sent = np.int64(num_rows if op == "first" else -1)
+        sel = np.full(n_groups, sent, dtype=np.int64)
+        if op == "first":
+            np.minimum.at(sel, seg_np[eligible], pos[eligible])
+        else:
+            np.maximum.at(sel, seg_np[eligible], pos[eligible])
+        has = sel != sent
+        rows = perm_np[np.clip(sel, 0, max(num_rows - 1, 0))] \
+            if num_rows else sel
+        return result_from_rows(rows, has)
+
+    # min/max over values: nulls never participate
+    valid = np.asarray(arr.is_valid()) if arr.null_count else \
+        np.ones(len(arr), dtype=bool)
+    row_valid = valid[perm_np] if len(valid) else \
+        np.zeros(num_rows, dtype=bool)
+    rows = perm_np[row_valid]
+    segs = seg_np[row_valid]
+    if pa.types.is_integer(arr.type) or pa.types.is_floating(arr.type):
+        # numeric carrier: numpy segment reduce, no sort needed
+        vals_np = np.asarray(arr.take(pa.array(rows)).to_numpy(
+            zero_copy_only=False))
+        if pa.types.is_floating(arr.type):
+            sent_v = np.inf if op == "min" else -np.inf
+        else:
+            info = np.iinfo(vals_np.dtype)
+            sent_v = info.max if op == "min" else info.min
+        acc = np.full(n_groups, sent_v, dtype=vals_np.dtype)
+        if op == "min":
+            np.minimum.at(acc, segs, vals_np)
+        else:
+            np.maximum.at(acc, segs, vals_np)
+        has = np.zeros(n_groups, dtype=bool)
+        has[segs] = True
+        out = pa.array(acc, mask=~has)
+        return {"__final": TpuColumnVector.from_arrow(
+            out if out.type == atype else out.cast(atype))}
+    vals = arr.take(pa.array(rows))
+    try:
+        order = pc.sort_indices(
+            pa.table({"s": pa.array(segs), "v": vals}),
+            sort_keys=[("s", "ascending"), ("v", "ascending")])
+    except (pa.ArrowNotImplementedError, pa.ArrowInvalid, TypeError):
+        return _host_segment_minmax_pylist(fn, arr, perm_np, seg_np,
+                                           num_rows, n_groups, op)
+    order_np = np.asarray(order).astype(np.int64)
+    segs_sorted = segs[order_np]
+    # per-group run boundaries in the (seg, value)-sorted order: min == run
+    # start, max == run end
+    if op == "min":
+        sel_pos = np.full(n_groups, len(segs_sorted), dtype=np.int64)
+        np.minimum.at(sel_pos, segs_sorted, np.arange(len(segs_sorted)))
+        has = sel_pos != len(segs_sorted)
+    else:
+        sel_pos = np.full(n_groups, -1, dtype=np.int64)
+        np.maximum.at(sel_pos, segs_sorted, np.arange(len(segs_sorted)))
+        has = sel_pos != -1
+    chosen = rows[order_np[np.clip(sel_pos, 0, max(len(order_np) - 1, 0))]] \
+        if len(order_np) else sel_pos
+    return result_from_rows(chosen, has)
+
+
+def _host_segment_minmax_pylist(fn, arr, perm_np, seg_np, num_rows: int,
+                                n_groups: int, op: str):
+    """Per-row fallback for element types arrow cannot order (nested)."""
+    import pyarrow as pa
+    from ..types import to_arrow as type_to_arrow
+    vals = arr.to_pylist()
     out: List = [None] * n_groups
-    seen: List[bool] = [False] * n_groups
     for pos in range(num_rows):
         g = int(seg_np[pos])
         v = vals[int(perm_np[pos])]
-        if op == "first":
-            if not seen[g] and (v is not None or not ignore_nulls):
-                out[g] = v
-                seen[g] = True
-        elif op == "last":
-            if v is not None or not ignore_nulls:
-                out[g] = v
-                seen[g] = True
-        elif v is not None:
+        if v is not None:
             if out[g] is None or (op == "min" and v < out[g]) or \
                     (op == "max" and v > out[g]):
                 out[g] = v
-    from ..types import to_arrow as type_to_arrow
     final = TpuColumnVector.from_arrow(
         pa.array(out, type=type_to_arrow(fn.dtype)))
     return {"__final": final}
@@ -945,14 +1088,15 @@ def _segment_bloom(fn, col, seg_ids, g_cap, capacity, num_rows, perm):
     """Per-group bloom blobs (host bit math over device-hashed longs; the
     reference's JNI BloomFilter kernel analogue). Empty group → null blob."""
     import pyarrow as pa
+    from ..columnar.vector import audited_sync
     mask_np = np.zeros(capacity, dtype=bool)
     mask_np[:num_rows] = True
-    perm_np = np.asarray(perm)[:capacity]
-    seg_np = np.asarray(seg_ids)[:capacity]
+    perm_np = audited_sync(perm, "fetch")[:capacity]
+    seg_np = audited_sync(seg_ids, "fetch")[:capacity]
     valid = mask_np[perm_np]
     if col.validity is not None:
-        valid &= np.asarray(col.validity)[perm_np]
-    vals = np.asarray(col.data)[perm_np].astype(np.int64)
+        valid &= audited_sync(col.validity, "fetch")[perm_np]
+    vals = audited_sync(col.data, "fetch")[perm_np].astype(np.int64)
     # group rows once via a segment sort instead of one full scan per group
     vv = vals[valid]
     ss = seg_np[valid]

@@ -31,19 +31,66 @@ class TpuMetric:
     """Accumulator metric (reference GpuMetric). Thread-safe: pipelined
     exchange map tasks and shuffle prefetch threads (shuffle/exchange.py)
     accumulate into one operator's metrics concurrently, and an unguarded
-    `+=` from pool threads loses updates."""
+    `+=` from pool threads loses updates.
 
-    __slots__ = ("name", "level", "value", "_lock")
+    Count reads are LAZY-friendly: `add_lazy` accepts a device int scalar
+    (a deferred-compaction batch's pending row count) and parks it without
+    blocking; the pending scalars materialize in one device_get at the
+    first `value` read — metric bookkeeping itself never forces a per-batch
+    device→host sync mid-query."""
+
+    __slots__ = ("name", "level", "_value", "_pending", "_lock")
+
+    #: parked device scalars fold into one at this depth — each is a live
+    #: (padded) device buffer invisible to HbmBudget, so an unbounded list
+    #: over operators×batches is a slow HBM leak until the query-end read
+    _FOLD_AT = 64
 
     def __init__(self, name: str, level: str = MODERATE):
         self.name = name
         self.level = level
-        self.value = 0
+        self._value = 0
+        self._pending: list = []
         self._lock = threading.Lock()
 
     def add(self, v: int) -> None:
         with self._lock:
-            self.value += v
+            self._value += v
+
+    def add_lazy(self, v) -> None:
+        """Accumulate an int OR a device int scalar without syncing."""
+        if isinstance(v, int):
+            self.add(v)
+            return
+        with self._lock:
+            self._pending.append(v)
+            if len(self._pending) < self._FOLD_AT:
+                return
+            pending, self._pending = self._pending, []
+        # fold outside the lock: one stacked device-side sum (an async
+        # dispatch, NOT a blocking sync) frees the parked buffers
+        import jax.numpy as jnp
+        folded = jnp.sum(jnp.stack([jnp.asarray(p) for p in pending]))
+        with self._lock:
+            self._pending.append(folded)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if pending:
+            from ..columnar.vector import audited_device_get
+            got = audited_device_get(pending, "metric")
+            with self._lock:
+                self._value += sum(int(x) for x in got)
+        with self._lock:
+            return self._value
+
+    @value.setter
+    def value(self, v: int) -> None:
+        with self._lock:
+            self._value = v
+            self._pending = []
 
     @contextmanager
     def timed(self):
@@ -53,7 +100,7 @@ class TpuMetric:
         finally:
             dt = time.perf_counter_ns() - t0
             with self._lock:
-                self.value += dt
+                self._value += dt
 
 
 class TaskContext:
@@ -165,18 +212,26 @@ class TpuExec(PhysicalPlan):
         self._last_batch = None  # don't attribute a prior partition's batch
         it = self.internal_do_execute_columnar(idx, ctx)
         tracing = profiling._PROFILING_ACTIVE
+        name = self.node_name()
         if not (tracing or keep_last):
-            # hot path: no per-batch scope/bookkeeping overhead
-            for batch in it:
-                out_rows.add(batch.num_rows)
+            # hot path: each pull runs under this operator's sync-ledger
+            # scope (a thread-local tuple push — nanoseconds) so blocking
+            # device→host transfers attribute to the operator that caused
+            # them; row counts accumulate lazily (a deferred batch's pending
+            # device count must not sync here)
+            while True:
+                with profiling.sync_scope(name):
+                    batch = next(it, None)
+                if batch is None:
+                    return
+                out_rows.add_lazy(batch.rows_lazy)
                 out_batches.add(1)
                 yield batch
             return
-        name = self.node_name()
         while True:
             # NVTX-range analogue: each batch pull is one named scope in the
             # xprof timeline (reference NvtxWithMetrics around operator work)
-            with profiling.trace_scope(name):
+            with profiling.trace_scope(name), profiling.sync_scope(name):
                 try:
                     batch = next(it)
                 except StopIteration:
@@ -184,7 +239,7 @@ class TpuExec(PhysicalPlan):
                 except Exception:
                     self._dump_on_failure(ctx)
                     raise
-            out_rows.add(batch.num_rows)
+            out_rows.add_lazy(batch.rows_lazy)
             out_batches.add(1)
             if keep_last:
                 self._last_batch = batch

@@ -53,7 +53,7 @@ class TpuProjectExec(TpuExec):
             # batch shape (execs/opjit.py); the rest evaluate eagerly
             cols = opjit.eval_exprs(self.exprs, out_dtypes, batch,
                                     ctx.eval_ctx, self.metrics)
-            return TpuColumnarBatch(cols, batch.num_rows, names)
+            return TpuColumnarBatch(cols, batch.rows_lazy, names)
 
         for batch in self.children[0].execute_partition(idx, ctx):
             with op_time.timed():
@@ -81,10 +81,12 @@ class TpuFilterExec(TpuExec):
         return f"TpuFilter[{self.condition.pretty()}]"
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from ..config import DEFERRED_COMPACTION
         from ..memory.spill import SpillableColumnarBatch
         from ..memory.retry import with_retry
         from . import opjit
         op_time = self.metrics["opTime"]
+        deferred = bool(ctx.conf.get(DEFERRED_COMPACTION))
 
         def do_filter(batch: TpuColumnarBatch) -> TpuColumnarBatch:
             # predicate eval + null-drop as one cached executable when the
@@ -97,7 +99,9 @@ class TpuFilterExec(TpuExec):
                 mask = mask_col.data.astype(jnp.bool_)
                 if mask_col.validity is not None:
                     mask = mask & mask_col.validity  # null predicate → drop
-            return compact(batch, mask)
+            # deferred: the kept-row count stays a device scalar and syncs
+            # at the first consumer needing a host int (exchange/collect)
+            return compact(batch, mask, deferred=deferred)
 
         for batch in self.children[0].execute_partition(idx, ctx):
             with op_time.timed():
@@ -214,54 +218,7 @@ class TpuGlobalLimitExec(TpuExec):
         yield slice_batch(whole, self.offset, self.n)
 
 
-class TpuCoalesceBatchesExec(TpuExec):
-    """Concatenate small batches up to a target size (reference CoalesceGoal /
-    GpuCoalesceIterator, GpuCoalesceBatches.scala:110-248,697)."""
-
-    def __init__(self, child: PhysicalPlan, goal: str = "target",
-                 target_rows: Optional[int] = None):
-        super().__init__([child])
-        self.goal = goal  # "target" | "require_single"
-        self.target_rows = target_rows
-
-    @property
-    def output(self):
-        return self.children[0].output
-
-    def additional_metrics(self):
-        return {"concatTime": "MODERATE", "numInputBatches": "DEBUG"}
-
-    def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
-        target = self.target_rows or ctx.conf.batch_size_rows
-        target_bytes = ctx.conf.batch_size_bytes
-        pending: List[TpuColumnarBatch] = []
-        rows = 0
-        size = 0
-        concat_time = self.metrics["concatTime"]
-        n_in = self.metrics["numInputBatches"]
-        from ..memory.spill import SpillableColumnarBatch
-        from ..memory.retry import with_retry_no_split
-
-        def concat_spillables(spillables):
-            batches = [sp.get_batch() for sp in spillables]
-            out = concat_batches(batches)
-            for sp in spillables:
-                sp.close()
-            return out
-
-        for b in self.children[0].execute_partition(idx, ctx):
-            n_in.add(1)
-            pending.append(SpillableColumnarBatch(b))
-            rows += b.num_rows
-            size += pending[-1].size_bytes
-            # whichever target trips first closes the batch (reference
-            # GpuCoalesceIterator honors both GPU_BATCH_SIZE_BYTES and the
-            # row cap)
-            if self.goal != "require_single" and (
-                    rows >= target or (target_bytes and size >= target_bytes)):
-                with concat_time.timed():
-                    yield concat_spillables(pending)
-                pending, rows, size = [], 0, 0
-        if pending:
-            with concat_time.timed():
-                yield concat_spillables(pending)
+# TpuCoalesceBatchesExec moved to execs/coalesce.py (the coalescing layer:
+# device exec + host-side shuffle-read coalescer + plan insertion pass);
+# re-exported here for the compiled-stage pattern matchers and older callers
+from .coalesce import TpuCoalesceBatchesExec  # noqa: E402,F401

@@ -761,7 +761,8 @@ class TpuCompiledAggStageExec(TpuExec):
                     out = self._run_batch(b, domains, ctx)
                     oob_flags.append(out[0])
                     carries.append(out[1:])
-                host = jax.device_get((oob_flags, carries))
+                from ..columnar.vector import audited_device_get
+                host = audited_device_get((oob_flags, carries), "stage")
                 oob_np, carries_np = host
                 if oob_np and bool(np.any(np.stack(oob_np))):
                     raise _StageFallback()

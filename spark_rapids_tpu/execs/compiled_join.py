@@ -1159,8 +1159,10 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         two small programs and ONE scalar sync, then a download whose size
         scales with the RESULT (occupied groups), not the group capacity."""
         merged, occ_mask, nocc, cap_occ = self._merge_occ(carries)
-        host = jax.device_get(
-            _compact_carries_dev(tuple(merged), occ_mask, cap_occ))
+        from ..columnar.vector import audited_device_get
+        host = audited_device_get(
+            _compact_carries_dev(tuple(merged), occ_mask, cap_occ),
+            "carries")
         return host[0][:nocc], [h[:nocc] for h in host[1:]], nocc
 
     def _device_finalize(self, carries, dim_flats):

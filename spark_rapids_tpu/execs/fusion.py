@@ -252,7 +252,7 @@ class TpuFusedSegmentExec(TpuExec):
         if not traced and not filters:
             # pure column shuffle (select/reorder): no dispatch at all
             cols = [batch.columns[spec.ordinal] for _, spec in specs]
-            return TpuColumnarBatch(cols, batch.num_rows, names)
+            return TpuColumnarBatch(cols, batch.rows_lazy, names)
         dtypes = [spec[1] for kind, spec in specs if kind == "jit"]
         res = opjit.segment_program(traced, dtypes, filters, batch,
                                     ctx.eval_ctx, self.metrics)
@@ -265,9 +265,14 @@ class TpuFusedSegmentExec(TpuExec):
                 cols.append(batch.columns[spec.ordinal])
             else:
                 cols.append(jit_cols[spec[0]])
-        out = TpuColumnarBatch(cols, batch.num_rows, names)
+        out = TpuColumnarBatch(cols, batch.rows_lazy, names)
         if keep is not None:
-            out = compact(out, keep)  # ONE compaction for the whole segment
+            # ONE compaction for the whole segment; with deferred compaction
+            # the kept count stays a device scalar until the exchange/collect
+            # boundary needs a host int (it rides the boundary device_get)
+            from ..config import DEFERRED_COMPACTION
+            out = compact(out, keep,
+                          deferred=bool(ctx.conf.get(DEFERRED_COMPACTION)))
         return out
 
     def _apply_op(self, op: PhysicalPlan, batch: TpuColumnarBatch,
@@ -280,7 +285,7 @@ class TpuFusedSegmentExec(TpuExec):
             out_dtypes = [a.dtype for a in op.output]
             cols = opjit.eval_exprs(op.exprs, out_dtypes, batch,
                                     ctx.eval_ctx, self.metrics)
-            return TpuColumnarBatch(cols, batch.num_rows,
+            return TpuColumnarBatch(cols, batch.rows_lazy,
                                     [a.name for a in op.output])
         mask = opjit.filter_mask(op.condition, batch, ctx.eval_ctx,
                                  self.metrics)

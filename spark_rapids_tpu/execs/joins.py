@@ -144,7 +144,10 @@ def _join_probe_ranges(b_vals, b_valids, p_vals, p_valids, b_rows, p_rows):
 def _join_emit_pairs(counts, lo, order, b_ok, p_ok, b_vals, p_vals, total,
                      out_cap: int):
     """Stage B: expand candidate ranges into verified pairs (one program;
-    out_cap is the bucketed static output shape)."""
+    out_cap is the bucketed static output shape). Also returns the verified
+    pair count as a DEVICE scalar so it never needs its own blocking read —
+    it either rides the joined batch's boundary device_get (deferred
+    compaction) or fuses into the single eager sync below."""
     p_cap = counts.shape[0]
     b_cap = order.shape[0]
     ends = jnp.cumsum(counts)
@@ -158,7 +161,7 @@ def _join_emit_pairs(counts, lo, order, b_ok, p_ok, b_vals, p_vals, total,
     ok = (j < total) & jnp.take(b_ok, bi) & jnp.take(p_ok, pi)
     for bv, pv in zip(b_vals, p_vals):
         ok = ok & (jnp.take(bv, bi) == jnp.take(pv, pi))
-    return pi, bi, ok
+    return pi, bi, ok, jnp.sum(ok)
 
 
 def _device_equi_join(build_enc, build_rows: int, probe_enc, probe_rows: int):
@@ -178,12 +181,15 @@ def _device_equi_join(build_enc, build_rows: int, probe_enc, probe_rows: int):
     counts, lo, order, b_ok, p_ok, total_dev = _join_probe_ranges(
         b_vals, b_valids, p_vals, p_valids,
         jnp.int32(build_rows), jnp.int32(probe_rows))
-    total = int(total_dev)  # host sync: candidate-pair count
+    from ..columnar.vector import audited_sync_int
+    # host sync: candidate-pair count (it sizes the static output shape, so
+    # it cannot defer); the VERIFIED count below stays a device scalar
+    total = audited_sync_int(total_dev, "pairs")
     out_cap = bucket_capacity(max(total, 1))
-    pi, bi, ok = _join_emit_pairs(counts, lo, order, b_ok, p_ok,
-                                  b_vals, p_vals, jnp.int32(total),
-                                  out_cap=out_cap)
-    return pi, bi, ok, total, out_cap
+    pi, bi, ok, n_ok = _join_emit_pairs(counts, lo, order, b_ok, p_ok,
+                                        b_vals, p_vals, jnp.int32(total),
+                                        out_cap=out_cap)
+    return pi, bi, ok, n_ok, total, out_cap
 
 
 @_jax.jit
@@ -198,12 +204,21 @@ def _compact_pairs_device(pi, bi, ok, n):
     return jnp.take(pi, take), jnp.take(bi, take), slot_ok
 
 
-def _compact_pairs(pi, bi, ok, out_cap: int):
-    """Stable-compact verified pairs; one host sync for the kept count,
-    the rest one compiled program."""
-    n = int(jnp.sum(ok))
+def _compact_pairs(pi, bi, ok, n_ok, deferred: bool):
+    """Stable-compact verified pairs (one compiled program). The kept count
+    `n_ok` arrives as a device scalar from the emit program: deferred mode
+    keeps it on device (the joined batch carries it to the boundary);
+    otherwise it syncs here — fused with the candidate-count read into the
+    join's single per-batch scalar accounting, instead of the historical
+    second `int(jnp.sum(ok))` round trip."""
+    n = n_ok if deferred else _audited_pairs_int(n_ok)
     a, b, slot_ok = _compact_pairs_device(pi, bi, ok, jnp.int32(n))
     return a, b, slot_ok, n
+
+
+def _audited_pairs_int(n_dev) -> int:
+    from ..columnar.vector import audited_sync_int
+    return audited_sync_int(n_dev, "pairs")
 
 
 def _all_null_cols(attrs_or_cols, num_rows: int, capacity: int):
@@ -373,10 +388,13 @@ class TpuShuffledHashJoinExec(TpuExec):
             l_enc, r_enc = _encode_sides(lk, rk, left.num_rows,
                                          right.num_rows, l_cap, r_cap)
         # probe = left, build = right
-        pi, bi, ok, total, out_cap = _device_equi_join(
+        pi, bi, ok, n_ok, total, out_cap = _device_equi_join(
             r_enc, right.num_rows, l_enc, left.num_rows)
         self.metrics["numPairs"].add(total)
-        cpi, cbi, slot_ok, n_pairs = _compact_pairs(pi, bi, ok, out_cap)
+        from ..config import DEFERRED_COMPACTION
+        deferred = bool(ctx.conf.get(DEFERRED_COMPACTION))
+        cpi, cbi, slot_ok, n_pairs = _compact_pairs(pi, bi, ok, n_ok,
+                                                    deferred)
 
         lg = gather(left, jnp.where(slot_ok, cpi, -1), n_pairs, out_cap)
         rg = gather(right, jnp.where(slot_ok, cbi, -1), n_pairs, out_cap)
@@ -389,9 +407,11 @@ class TpuShuffledHashJoinExec(TpuExec):
             if cond.validity is not None:
                 keep = keep & cond.validity
             pair_keep = pair_keep & keep
-            joined = compact(joined, keep)
+            joined = compact(joined, keep, deferred=deferred)
 
         if jt in ("inner", "cross"):
+            # deferred: the verified-pair count rides the joined batch as a
+            # device scalar to the exchange/collect boundary
             return joined.rename(names)
 
         # bookkeeping over VERIFIED+residual-surviving pairs
@@ -642,26 +662,32 @@ class CpuShuffledHashJoinExec(CpuExec):
         kept = inner.filter(mask)
         if jt in ("inner", "cross"):
             return kept.select(sel).rename_columns(out_names)
-        l_matched = set(kept.column("__lrow").to_pylist())
-        r_matched = set(kept.column("__rrow").to_pylist())
+
+        # vectorized match flags: pc.is_in of the full row-id range against
+        # the surviving pairs' row ids. The previous set(to_pylist()) +
+        # per-row `i in set` python loop dominated parity-test time on wide
+        # inputs (O(rows) python-level membership tests per side).
+        def matched(table, row_col):
+            ids = pa.array(np.arange(table.num_rows, dtype=np.int64))
+            vals = kept.column(row_col).combine_chunks()
+            return pc.is_in(ids, value_set=vals)
+
         if jt in ("leftsemi", "semi"):
-            keep = pa.array([i in l_matched for i in range(lt.num_rows)])
-            return lt.filter(keep).select(sel).rename_columns(out_names)
+            return lt.filter(matched(lt, "__lrow")).select(sel) \
+                .rename_columns(out_names)
         if jt in ("leftanti", "anti"):
-            keep = pa.array([i not in l_matched for i in range(lt.num_rows)])
+            keep = pc.invert(matched(lt, "__lrow"))
             return lt.filter(keep).select(sel).rename_columns(out_names)
         parts = [kept.select(sel)]
         r_attrs = self.children[1].output
         l_attrs = self.children[0].output
         if jt in ("leftouter", "left", "fullouter", "outer", "full"):
-            keep = pa.array([i not in l_matched for i in range(lt.num_rows)])
-            lu = lt.filter(keep).select(l_out)
+            lu = lt.filter(pc.invert(matched(lt, "__lrow"))).select(l_out)
             for name, a in zip(r_out, r_attrs):
                 lu = lu.append_column(name, pa.nulls(lu.num_rows, to_arrow(a.dtype)))
             parts.append(lu.select(sel))
         if jt in ("rightouter", "right", "fullouter", "outer", "full"):
-            keep = pa.array([i not in r_matched for i in range(rt.num_rows)])
-            ru = rt.filter(keep).select(r_out)
+            ru = rt.filter(pc.invert(matched(rt, "__rrow"))).select(r_out)
             for name, a in reversed(list(zip(l_out, l_attrs))):
                 ru = ru.add_column(0, name, pa.nulls(ru.num_rows, to_arrow(a.dtype)))
             parts.append(ru.select(sel))

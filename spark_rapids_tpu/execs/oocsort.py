@@ -46,9 +46,10 @@ class OutOfCoreSorter:
         for o in self.order:
             col = to_column(o.child.eval_tpu(sb, self.ctx.eval_ctx), sb,
                             o.child.dtype)
+            from ..columnar.vector import audited_sync
             valid = None
             if col.validity is not None:
-                valid = np.asarray(col.validity)[:n].astype(bool)
+                valid = audited_sync(col.validity, "fetch")[:n].astype(bool)
             if isinstance(col.dtype, StringType):
                 arr = col.to_arrow()
                 vals = np.asarray(arr.to_pylist(), dtype=object)
@@ -56,7 +57,8 @@ class OutOfCoreSorter:
                     valid = ~np.asarray([v is None for v in vals])
                 keys.append(("str", vals, valid))
             else:
-                vals = np.asarray(_sortable_bits(col))[:n].astype(np.int64)
+                vals = audited_sync(_sortable_bits(col),
+                                    "fetch")[:n].astype(np.int64)
                 keys.append(("int", vals, valid))
         self.runs.append(SpillableColumnarBatch(sb))
         self.run_keys.append(keys)

@@ -346,7 +346,9 @@ def _input_sig(exprs, batch) -> Tuple:
 
 
 def _flat_args(batch, sig) -> List:
-    args: List = [batch.num_rows]
+    # rows_arg: a deferred-compaction batch passes its pending device count
+    # straight through as a program argument — no host sync on the chain
+    args: List = [batch.rows_arg]
     for (o, _, has_v, _) in sig:
         c = batch.columns[o]
         args.append(c.data)
@@ -481,7 +483,7 @@ def _forest_program(exprs, out_dtypes, batch, eval_ctx, metrics):
                        eval_ctx, metrics)
     if out is _FAILED:
         return None
-    return [TpuColumnVector(dt, data, v, batch.num_rows)
+    return [TpuColumnVector(dt, data, v, batch.rows_lazy)
             for (data, v), dt in zip(out, out_dtypes)]
 
 
@@ -808,7 +810,7 @@ def agg_sort_plan(grouping: Sequence[Expression], batch: TpuColumnarBatch,
     if out is _FAILED:
         return None
     perm, seg_ids, is_new, ng, key_flat = out
-    key_cols = [TpuColumnVector(g.dtype, d, v, batch.num_rows)
+    key_cols = [TpuColumnVector(g.dtype, d, v, batch.rows_lazy)
                 for g, (d, v) in zip(grouping, key_flat)]
     return perm, seg_ids, is_new, int(ng), key_cols
 
@@ -867,7 +869,7 @@ def agg_reduce(agg_fns, batch: TpuColumnarBatch, perm, seg_ids, is_new,
             return tuple(outs), key_rows
         return fn
 
-    args = [batch.num_rows, n_groups, perm, seg_ids, is_new]
+    args = [batch.rows_arg, n_groups, perm, seg_ids, is_new]
     args += _flat_args(batch, sig)[1:]
     donate = _donate((2, 3, 4)) if grouped else ()
     out = _cached_call(key, build, tuple(args), eval_ctx, metrics,
@@ -989,6 +991,6 @@ def segment_program(out_exprs: Sequence[Expression],
     if out is _FAILED:
         return None
     outs, keep = out
-    cols = [TpuColumnVector(dt, d, v, batch.num_rows)
+    cols = [TpuColumnVector(dt, d, v, batch.rows_lazy)
             for (d, v), dt in zip(outs, out_dtypes)]
     return cols, keep

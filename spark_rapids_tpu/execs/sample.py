@@ -81,8 +81,10 @@ class _SampleBase:
         """With-replacement repeat counts (host numpy), else None."""
         if not self.with_replacement:
             return None
+        from ..columnar.vector import audited_sync
         th = np.array(_poisson_thresholds(self.fraction))
-        return np.searchsorted(th, np.asarray(uniform), side="right")
+        return np.searchsorted(th, audited_sync(uniform, "fetch"),
+                               side="right")
 
 
 class CpuSampleExec(_SampleBase, CpuExec):

@@ -17,7 +17,11 @@ from .base import CpuExec, PhysicalPlan, TaskContext, TpuExec
 
 class HostToDeviceExec(TpuExec):
     """Upload host Arrow batches to device columns (reference GpuRowToColumnarExec
-    + HostColumnarToGpu)."""
+    + HostColumnarToGpu). With spark.rapids.tpu.coalesce.enabled, small host
+    tables concatenate up to the batch-size targets BEFORE the upload
+    (host-side coalescing, reference GpuShuffleCoalesceExec applied at the
+    transition): one H→D transfer and one downstream dispatch chain per
+    target-sized batch instead of one per source table."""
 
     def __init__(self, child: PhysicalPlan):
         super().__init__([child])
@@ -27,12 +31,25 @@ class HostToDeviceExec(TpuExec):
         return self.children[0].output
 
     def additional_metrics(self):
-        return {"uploadTime": "MODERATE"}
+        return {"uploadTime": "MODERATE", "numInputBatches": "DEBUG"}
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from .coalesce import (coalesce_arrow_stream, coalesce_enabled,
+                               coalesce_targets)
         names = [a.name for a in self.output]
         with_time = self.metrics["uploadTime"]
-        for t in self.children[0].execute_partition(idx, ctx):
+        n_in = self.metrics["numInputBatches"]
+
+        def counted():
+            for t in self.children[0].execute_partition(idx, ctx):
+                n_in.add(1)
+                yield t
+
+        tables = counted()
+        if coalesce_enabled(ctx.conf):
+            target_rows, target_bytes = coalesce_targets(ctx.conf)
+            tables = coalesce_arrow_stream(tables, target_rows, target_bytes)
+        for t in tables:
             with with_time.timed():
                 b = TpuColumnarBatch.from_arrow(t)
             yield b.rename(names)
@@ -104,8 +121,12 @@ class DeviceToHostExec(CpuExec):
         return {"downloadTime": "MODERATE"}
 
     def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
+        from .. import profiling
         with_time = self.metrics["downloadTime"]
+        name = self.node_name()
         for b in self.children[0].execute_partition(idx, ctx):
-            with with_time.timed():
+            # the result download is THE boundary sync of the chain (a
+            # deferred row count rides it); attribute it in the ledger
+            with with_time.timed(), profiling.sync_scope(name):
                 t = b.to_arrow()
             yield t

@@ -227,8 +227,12 @@ class SpillableColumnarBatch:
         from .cleaner import MemoryCleaner
         self._catalog = TpuBufferCatalog.get()
         self._handle: Optional[int] = self._catalog.add_batch(batch, priority)
-        self.num_rows = batch.num_rows
+        # a deferred-compaction batch's row count stays a device scalar here:
+        # wrapping a batch must not force the sync its producer deferred
+        self._rows_lazy = batch.rows_lazy
         self.size_bytes = batch.device_memory_size()
+        rows_label = self._rows_lazy if isinstance(self._rows_lazy, int) \
+            else "?"
         # pin the cleaner INSTANCE: close() must unregister from the same
         # book we registered in, or a reset_for_tests between creation and
         # close (long-lived caches, shutdown hooks) strands the token in the
@@ -236,8 +240,21 @@ class SpillableColumnarBatch:
         # CI gate, checking the current instance, passes (VERDICT r4 weak #2)
         self._cleaner = MemoryCleaner.get()
         self._cleaner_token = self._cleaner.register(
-            f"SpillableColumnarBatch[{self.num_rows}r "
+            f"SpillableColumnarBatch[{rows_label}r "
             f"{self.size_bytes}B]")
+
+    @property
+    def num_rows(self) -> int:
+        if not isinstance(self._rows_lazy, int):
+            from ..columnar.vector import audited_sync_int
+            self._rows_lazy = audited_sync_int(self._rows_lazy, "rows")
+        return self._rows_lazy
+
+    @property
+    def rows_lazy(self):
+        """Row count WITHOUT forcing: host int when known, device scalar
+        otherwise (see materialize_spillable_counts for the batched force)."""
+        return self._rows_lazy
 
     def get_batch(self) -> TpuColumnarBatch:
         if self._handle is None:
@@ -257,3 +274,20 @@ class SpillableColumnarBatch:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def materialize_spillable_counts(spillables: List[SpillableColumnarBatch]) -> int:
+    """Force every pending deferred row count in the list with ONE batched
+    transfer and return the exact total. A coalesce window deciding whether
+    its row target really tripped pays one sync for the whole window, not
+    one per batch."""
+    import numpy as np
+    dev_ix = [i for i, sp in enumerate(spillables)
+              if not isinstance(sp._rows_lazy, (int, np.integer))]
+    if dev_ix:
+        from ..columnar.vector import audited_device_get
+        got = audited_device_get([spillables[i]._rows_lazy for i in dev_ix],
+                                 "rows")
+        for i, n in zip(dev_ix, got):
+            spillables[i]._rows_lazy = int(n)
+    return sum(int(sp._rows_lazy) for sp in spillables)

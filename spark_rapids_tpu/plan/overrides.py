@@ -550,7 +550,12 @@ class TpuOverrides:
         # on the general path (execs/fusion.py): adjacent project/filter
         # chains collapse into one dispatch per batch
         from ..execs.fusion import fuse_stage_segments
-        return fuse_stage_segments(final, conf)
+        final = fuse_stage_segments(final, conf)
+        # batch coalescing (execs/coalesce.py): small batches concatenate up
+        # to the batch-size targets ahead of batch-hungry operators — runs
+        # last so fused segments are insertion targets too
+        from ..execs.coalesce import insert_coalesce
+        return insert_coalesce(final, conf)
 
     @staticmethod
     def explain_plan(plan: PhysicalPlan, conf: RapidsConf) -> str:

@@ -67,6 +67,94 @@ class TaskMetricsRegistry:
 
 
 # ---------------------------------------------------------------------------
+# (c2) the sync ledger: every BLOCKING device→host transfer, attributed to
+# the operator that caused it. On the tunneled TPU each blocking sync is a
+# full ~100ms round trip, so the *count* of syncs per partition — not their
+# payload size — dominates general-path wall time. All engine syncs route
+# through columnar/vector.py's audited_sync helpers (tracelint TL011 flags
+# strays), which record here; execs/base.py maintains the active-operator
+# scope around every batch pull.
+
+
+class SyncLedger:
+    """Process-wide {operator: {kind: count}} of blocking D→H transfers."""
+
+    _instance: Optional["SyncLedger"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._by_op: Dict[str, Dict[str, int]] = {}
+        self._total = 0
+
+    @classmethod
+    def get(cls) -> "SyncLedger":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "SyncLedger":
+        with cls._lock:
+            cls._instance = cls()
+            return cls._instance
+
+    def record(self, kind: str, op: Optional[str] = None) -> None:
+        if op is None:
+            op = current_sync_scope()
+        with self._mu:
+            ops = self._by_op.setdefault(op, {})
+            ops[kind] = ops.get(kind, 0) + 1
+            self._total += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._mu:
+            return {op: dict(kinds) for op, kinds in self._by_op.items()}
+
+    def total(self) -> int:
+        with self._mu:
+            return self._total
+
+    def totals_by_op(self) -> Dict[str, int]:
+        with self._mu:
+            return {op: sum(kinds.values())
+                    for op, kinds in self._by_op.items()}
+
+
+class _SyncScope(threading.local):
+    """Stack of operator names; the top attributes recorded syncs. Thread-
+    local: pipelined map tasks and prefetch workers each carry their own."""
+    stack = ()
+
+
+_sync_scope_tls = _SyncScope()
+
+
+def current_sync_scope() -> str:
+    st = _sync_scope_tls.stack
+    return st[-1] if st else "<unattributed>"
+
+
+@contextlib.contextmanager
+def sync_scope(name: str):
+    """Attribute blocking syncs inside the scope to `name` (set by
+    TpuExec.execute_partition around each batch pull, so nested pulls
+    re-attribute to the producing operator)."""
+    _sync_scope_tls.stack = _sync_scope_tls.stack + (name,)
+    try:
+        yield
+    finally:
+        _sync_scope_tls.stack = _sync_scope_tls.stack[:-1]
+
+
+def record_sync(kind: str, op: Optional[str] = None) -> None:
+    """Record one blocking device→host transfer (called by the audited sync
+    helpers in columnar/vector.py)."""
+    SyncLedger.get().record(kind, op)
+
+
+# ---------------------------------------------------------------------------
 # (a) operator trace scopes (NVTX analogue)
 
 _PROFILING_ACTIVE = False

@@ -1056,8 +1056,10 @@ class TpuSession:
         names = [a.name for a in final.output]
         from .types import to_arrow as t2a
         schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
-        from .profiling import TaskMetricsRegistry, snapshot_plan_metrics
+        from .profiling import (SyncLedger, TaskMetricsRegistry,
+                                snapshot_plan_metrics)
         task_metrics_before = TaskMetricsRegistry.get().snapshot()
+        syncs_before = SyncLedger.get().snapshot()
         tables = []
         try:
             for p in range(final.num_partitions()):
@@ -1086,6 +1088,18 @@ class TpuSession:
             self._last_task_metrics = {
                 k: after.get(k, 0) - task_metrics_before.get(k, 0)
                 for k in after}
+            # per-operator blocking-sync deltas for this query alone (the
+            # sync ledger is process-wide; docs/configs.md "Dispatch & sync
+            # accounting")
+            syncs_after = SyncLedger.get().snapshot()
+            ledger = {}
+            for op, kinds in syncs_after.items():
+                prev = syncs_before.get(op, {})
+                d = {k: v - prev.get(k, 0) for k, v in kinds.items()
+                     if v - prev.get(k, 0)}
+                if d:
+                    ledger[op] = d
+            self._last_sync_ledger = ledger
             # release shuffle blocks/files at query end (reference: Spark's
             # ContextCleaner removing shuffle state); exchanges re-materialize
             # if the same DataFrame is collected again
@@ -1112,6 +1126,16 @@ class TpuSession:
         GpuTaskMetrics shown per SQL execution): semaphore wait, retry
         counts/time, spill bytes, read-spill time."""
         return dict(getattr(self, "_last_task_metrics", {}))
+
+    def last_sync_ledger(self):
+        """Per-operator blocking device→host transfer counts for the last
+        query alone ({operator: {kind: count}}; docs/configs.md "Dispatch &
+        sync accounting"). Healthy general-path plans show counts bounded
+        by O(exchanges); a per-(operator×batch) `rows` count is the
+        regression signature the ledger exists to catch."""
+        return {op: dict(kinds)
+                for op, kinds in getattr(self, "_last_sync_ledger",
+                                         {}).items()}
 
     def profiler(self):
         """Context manager capturing an xprof trace of the enclosed queries

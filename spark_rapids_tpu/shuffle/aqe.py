@@ -166,11 +166,14 @@ class TpuCoordinatedShuffleReaderExec(TpuExec):
             ctx.complete()
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
+        from .exchange import _read_reduce_group
         spec = self.coordinator.specs(ctx)[idx]
         exch = self.children[0]
         if spec[0] == "group":
-            for r in spec[1]:
-                yield from exch.execute_partition(r, ctx)
+            # host-side coalescing across the group's reduce partitions
+            # (GpuShuffleCoalesceExec under the coordinated reader)
+            yield from _read_reduce_group(exch, spec[1], ctx,
+                                          [a.name for a in self.output])
             return
         _, side, reduce_id, maps = spec
         if side == self.side:

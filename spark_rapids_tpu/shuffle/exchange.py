@@ -146,19 +146,25 @@ class _ExchangeBase:
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
                          mgr, gate_device: bool = False) -> None:
+        from ..profiling import sync_scope
         map_ctx = TaskContext(map_id, ctx.conf)
-        try:
-            if gate_device and isinstance(self, TpuExec):
-                # pipelined map tasks take a permit up front so concurrent
-                # device work stays bounded by concurrentTpuTasks (lazy
-                # acquisition would let every pool thread dispatch at once)
-                from ..memory.semaphore import TpuSemaphore
-                TpuSemaphore.get(ctx.conf).acquire_if_necessary(map_ctx)
-            commit = self._run_map_task(sid, map_id, map_ctx, mgr)
-        finally:
-            map_ctx.complete()  # releases the semaphore, if held
-        if commit is not None:
-            commit()  # host-side file I/O happens OFF the device semaphore
+        # pipelined map tasks run on pool threads with a fresh (empty)
+        # sync-scope stack: anchor ledger attribution to this exchange;
+        # nested operator pulls re-attribute via their own scopes
+        with sync_scope(self.node_name()):
+            try:
+                if gate_device and isinstance(self, TpuExec):
+                    # pipelined map tasks take a permit up front so
+                    # concurrent device work stays bounded by
+                    # concurrentTpuTasks (lazy acquisition would let every
+                    # pool thread dispatch at once)
+                    from ..memory.semaphore import TpuSemaphore
+                    TpuSemaphore.get(ctx.conf).acquire_if_necessary(map_ctx)
+                commit = self._run_map_task(sid, map_id, map_ctx, mgr)
+            finally:
+                map_ctx.complete()  # releases the semaphore, if held
+            if commit is not None:
+                commit()  # host-side file I/O runs OFF the device semaphore
 
     def _run_map_task(self, sid: int, map_id: int, map_ctx: TaskContext,
                       mgr):
@@ -407,7 +413,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         shuffle modes; reference prepareBatchShuffleDependency:277)."""
         n = self._n_out
         for batch in self.children[0].execute_partition(map_id, ctx):
-            if batch.num_rows == 0:
+            # a deferred-compaction batch skips the empty check rather than
+            # force its count: the split plan handles empty inputs (all
+            # bounds equal) and its bounds readback IS the chain's one sync
+            if not batch.has_pending_rows and batch.num_rows == 0:
                 continue
             with self.metrics["partitionTime"].timed():
                 if self.partitioning == "hash":
@@ -492,28 +501,14 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         # pipelined read (reference RapidsShuffleThreadedReaderBase): blocks
         # stream from the reader pool in map order while the NEXT block's
         # deserialize+upload is prefetched on a worker thread — downstream
-        # device compute overlaps the tunnel upload instead of waiting on it
+        # device compute overlaps the tunnel upload instead of waiting on it.
+        # With coalescing on, fetched map blocks first concatenate HOST-side
+        # up to the batch-size targets (reference GpuShuffleCoalesceExec):
+        # one upload and one downstream dispatch per target-sized batch
+        # instead of one per map block.
         mgr = TpuShuffleManager.get(ctx.conf)
-        deser = self.metrics["deserializationTime"]
-
-        def _upload() -> Iterator[TpuColumnarBatch]:
-            # deserializationTime covers producing a device-ready batch:
-            # waiting on the pool's read+deserialize AND the upload (the
-            # actual decode runs on reader threads, so only its non-overlapped
-            # wait is attributable to this task)
-            it = self._fetch_tables(idx, ctx, mgr)
-            while True:
-                with deser.timed():
-                    t = next(it, None)
-                    b = (TpuColumnarBatch.from_arrow(t)
-                         if t is not None and t.num_rows else None)
-                if t is None:
-                    return
-                if b is not None:
-                    yield b.rename(names)
-
-        from ..utils.pipeline import prefetch_iterator
-        yield from prefetch_iterator(_upload(), self._prefetch_depth(ctx))
+        yield from _pipelined_upload(self, self._fetch_tables(idx, ctx, mgr),
+                                     names, ctx)
 
     def execute_partition_maps(self, idx: int, map_ids: Sequence[int],
                                ctx: TaskContext) -> Iterator:
@@ -535,9 +530,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                     yield b.rename(names)
             return
         mgr = TpuShuffleManager.get(ctx.conf)
-        for t in self._fetch_tables(idx, ctx, mgr, map_ids=list(map_ids)):
-            if t.num_rows:
-                yield TpuColumnarBatch.from_arrow(t).rename(names)
+        yield from _pipelined_upload(
+            self, self._fetch_tables(idx, ctx, mgr, map_ids=list(map_ids)),
+            names, ctx, account_output=True)
 
 
 class CpuShuffleExchangeExec(_ExchangeBase, CpuExec):
@@ -643,8 +638,81 @@ class TpuShuffleReaderExec(TpuExec):
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         specs = self._ensure_specs(ctx)
-        for reduce_id in specs[idx]:
-            yield from self.children[0].execute_partition(reduce_id, ctx)
+        yield from _read_reduce_group(self.children[0], specs[idx], ctx,
+                                      [a.name for a in self.output])
+
+
+def _pipelined_upload(exch, tables_it, names, ctx: TaskContext,
+                      account_output: bool = False
+                      ) -> Iterator[TpuColumnarBatch]:
+    """Shared concat+upload tail for the exchange reduce read and the AQE
+    grouped read: host-coalesce fetched Arrow tables to the batch targets
+    (when enabled, reference GpuShuffleCoalesceExec), then upload on a
+    prefetch worker so downstream device compute overlaps the tunnel, with
+    waits attributed to the exchange's deserializationTime under a ledger
+    scope. `account_output` feeds the exchange's output metrics — only for
+    callers that bypass exch.execute_partition (whose wrapper otherwise
+    accounts them; double-counting if both ran)."""
+    from ..execs.coalesce import (coalesce_arrow_stream, coalesce_enabled,
+                                  coalesce_targets)
+    from ..profiling import sync_scope
+    from ..utils.pipeline import prefetch_iterator
+    deser = exch.metrics["deserializationTime"]
+    out_rows = exch.metrics["numOutputRows"]
+    out_batches = exch.metrics["numOutputBatches"]
+
+    def _upload() -> Iterator[TpuColumnarBatch]:
+        # deserializationTime covers producing a device-ready batch: waiting
+        # on the pool's read+deserialize AND the upload (the actual decode
+        # runs on reader threads, so only its non-overlapped wait is
+        # attributable to this task). sync_scope: this generator's frames
+        # run on the prefetch worker thread (empty scope stack) — anchor
+        # ledger attribution
+        it = tables_it
+        if coalesce_enabled(ctx.conf):
+            it = coalesce_arrow_stream(it, *coalesce_targets(ctx.conf))
+        while True:
+            with deser.timed(), sync_scope(exch.node_name()):
+                t = next(it, None)
+                b = (TpuColumnarBatch.from_arrow(t)
+                     if t is not None and t.num_rows else None)
+            if t is None:
+                return
+            if b is not None:
+                if account_output:
+                    out_rows.add(b.num_rows)
+                    out_batches.add(1)
+                yield b.rename(names)
+
+    yield from prefetch_iterator(_upload(), exch._prefetch_depth(ctx))
+
+
+def _read_reduce_group(exch, reduce_ids, ctx: TaskContext,
+                       names) -> Iterator:
+    """Read a group of reduce partitions through an AQE reader. In
+    MULTITHREADED mode with coalescing on, the group's fetched Arrow blocks
+    concatenate HOST-side across reduce-partition boundaries up to the
+    batch-size targets before the upload (reference GpuShuffleCoalesceExec
+    under GpuCustomShuffleReaderExec) — grouping small partitions is only a
+    win if they also merge into fewer uploads/dispatches."""
+    from ..execs.coalesce import coalesce_enabled
+    if coalesce_enabled(ctx.conf) \
+            and isinstance(exch, TpuShuffleExchangeExec) \
+            and exch._shuffle_mode(ctx) == "MULTITHREADED":
+        exch._ensure_materialized(ctx)
+        mgr = TpuShuffleManager.get(ctx.conf)
+
+        def tables():
+            for rid in reduce_ids:
+                yield from exch._fetch_tables(rid, ctx, mgr)
+
+        # account_output: this path bypasses exch.execute_partition, whose
+        # wrapper would otherwise feed the exchange's output metrics
+        yield from _pipelined_upload(exch, tables(), names, ctx,
+                                     account_output=True)
+        return
+    for reduce_id in reduce_ids:
+        yield from exch.execute_partition(reduce_id, ctx)
 
 
 def plan_cpu_exchange(plan, conf):

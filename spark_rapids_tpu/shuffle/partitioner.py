@@ -72,7 +72,9 @@ def split_by_partition(batch: TpuColumnarBatch, pids, n: int) -> List[Optional[T
     the pipeline for the full round trip: the copy starts immediately
     after the searchsorted is enqueued, overlapping the transfer with
     dispatch of the sort/gather work already in flight."""
-    order, bounds_dev = _split_plan(pids, batch.num_rows, n=n)
+    # rows_arg: a deferred-compaction batch's pending device count feeds the
+    # plan directly — the bounds readback below is then the chain's ONE sync
+    order, bounds_dev = _split_plan(pids, batch.rows_arg, n=n)
     return split_with_plan(batch, order, bounds_dev, n)
 
 
@@ -85,7 +87,8 @@ def split_with_plan(batch: TpuColumnarBatch, order, bounds_dev,
         bounds_dev.copy_to_host_async()
     except AttributeError:  # older jax arrays: np.asarray below still works
         pass
-    bounds = np.asarray(bounds_dev)
+    from ..columnar.vector import audited_sync
+    bounds = audited_sync(bounds_dev, "bounds")
     out: List[Optional[TpuColumnarBatch]] = []
     for p in range(n):
         lo, hi = int(bounds[p]), int(bounds[p + 1])

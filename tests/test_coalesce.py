@@ -1,0 +1,431 @@
+"""Batch coalescing layer + host-sync elimination (PR 5): execs/coalesce.py
+plan pass + device/host coalescers, deferred compaction (columnar/batch.py),
+the join pair-count fusion, the sync ledger (profiling.SyncLedger), and the
+dispatch-count wins — coalesce on/off must stay bit-identical while
+dispatching strictly fewer programs and syncing O(exchanges), not
+O(operators×batches)."""
+
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs import opjit
+from spark_rapids_tpu.execs.coalesce import (TpuCoalesceBatchesExec,
+                                             coalesce_arrow_stream)
+from spark_rapids_tpu.profiling import SyncLedger
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    opjit.clear_cache()
+    yield
+    opjit.clear_cache()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    SyncLedger.reset_for_tests()
+    yield
+    SyncLedger.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_manager():
+    import shutil
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    with TpuShuffleManager._lock:
+        old = TpuShuffleManager._instance
+        TpuShuffleManager._instance = None
+    yield
+    with TpuShuffleManager._lock:
+        cur = TpuShuffleManager._instance
+        TpuShuffleManager._instance = old
+    if cur is not None and cur is not old:
+        shutil.rmtree(cur.root, ignore_errors=True)
+
+
+_BASE_CONF = {
+    "spark.rapids.tpu.agg.compiledStage.enabled": "false",
+    "spark.rapids.tpu.join.compiledStage.enabled": "false",
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.sql.shuffle.partitions": "3",
+    "spark.rapids.shuffle.compression.codec": "none",
+}
+
+
+def _conf(**kv) -> dict:
+    c = dict(_BASE_CONF)
+    c.update({k.replace("__", "."): v for k, v in kv.items()})
+    return c
+
+
+# q3-shaped data: fact (lineitem-ish) joined to two dimensions, aggregated,
+# with a string passthrough column riding the fact side. Integer measures
+# keep "bit-identical" exact regardless of batch boundaries.
+_CUST = [{"c_key": i, "seg": f"seg{i % 3}"} for i in range(20)]
+_ORDERS = [{"o_key": i, "oc_key": i % 20, "o_date": 9000 + (i % 40)}
+           for i in range(80)]
+_LINES = [{"l_key": i % 80, "qty": (i * 7) % 50,
+           "cmt": None if i % 11 == 0 else f"c{i % 5}"}
+          for i in range(400)]
+
+
+def _q3_shape(s, parts=4):
+    cust = s.createDataFrame(_CUST, num_partitions=2)
+    orders = s.createDataFrame(_ORDERS, num_partitions=2)
+    lines = s.createDataFrame(_LINES, num_partitions=parts)
+    f = (lines.filter(F.col("qty") > 2)
+         .withColumn("qty2", F.col("qty") * 2 + 1))
+    j1 = f.join(orders, on=f["l_key"] == orders["o_key"], how="inner")
+    j2 = j1.join(cust, on=j1["oc_key"] == cust["c_key"], how="inner")
+    return (j2.filter(F.col("o_date") < 9035)
+            .groupBy("seg")
+            .agg(F.sum(F.col("qty2")).alias("sq"),
+                 F.count(F.col("cmt")).alias("nc"),
+                 F.max(F.col("cmt")).alias("mc")))
+
+
+def _rows_sorted(rows):
+    return sorted(rows, key=lambda r: tuple(str(v) for v in r.values()))
+
+
+# ---------------------------------------------------------------------------
+# bit-identical parity: coalesce on / off / deferred off / fully eager
+# ---------------------------------------------------------------------------
+
+
+def test_coalesce_on_off_bit_identical_q3_shape():
+    on = _q3_shape(TpuSession(_conf())).collect()
+    off = _q3_shape(TpuSession(_conf(
+        spark__rapids__tpu__coalesce__enabled="false"))).collect()
+    nodefer = _q3_shape(TpuSession(_conf(
+        spark__rapids__tpu__batch__deferredCompaction__enabled="false"
+    ))).collect()
+    eager = _q3_shape(TpuSession(_conf(
+        spark__rapids__tpu__coalesce__enabled="false",
+        spark__rapids__tpu__batch__deferredCompaction__enabled="false",
+        spark__rapids__tpu__opjit__enabled="false"))).collect()
+    assert _rows_sorted(on) == _rows_sorted(off)
+    assert _rows_sorted(on) == _rows_sorted(nodefer)
+    assert _rows_sorted(on) == _rows_sorted(eager)
+    assert len(on) == 3
+
+
+def test_join_parity_all_types_with_deferred_counts():
+    """The fused verified-pair count (deferred joined batch) across join
+    types that exercise both the inner fast path and the bookkeeping."""
+    def build(s):
+        l = s.createDataFrame(
+            [{"k": i % 7, "v": i} for i in range(60)], num_partitions=2)
+        r = s.createDataFrame(
+            [{"k": i % 5, "w": i * 3} for i in range(25)], num_partitions=2)
+        out = {}
+        for how in ("inner", "left", "leftsemi", "leftanti", "full"):
+            out[how] = _rows_sorted(
+                l.join(r, on="k", how=how).collect())
+        return out
+
+    on = build(TpuSession(_conf()))
+    off = build(TpuSession(_conf(
+        spark__rapids__tpu__batch__deferredCompaction__enabled="false",
+        spark__rapids__tpu__coalesce__enabled="false")))
+    assert on == off
+
+
+# ---------------------------------------------------------------------------
+# plan insertion
+# ---------------------------------------------------------------------------
+
+
+def _final_plan(q, conf_dict):
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    conf = RapidsConf(conf_dict)
+    return TpuOverrides.apply(plan_physical(q._plan, conf), conf)
+
+
+def test_plan_inserts_coalesce_ahead_of_batch_hungry_ops():
+    from spark_rapids_tpu.execs.sort import TpuSortExec
+
+    s = TpuSession(_conf())
+    # a sort fed by a fused project/filter segment (NOT an exchange): the
+    # device-side coalesce engages exactly here
+    q = (s.createDataFrame(_LINES, num_partitions=4)
+         .filter(F.col("qty") > 2)
+         .withColumn("x", F.col("qty") * 2)
+         .sort("x"))
+    final = _final_plan(q, _conf())
+    sorts = [n for n in final.collect_nodes() if isinstance(n, TpuSortExec)]
+    assert sorts
+    assert any(isinstance(n.children[0], TpuCoalesceBatchesExec)
+               for n in sorts)
+
+    conf_off = _conf(spark__rapids__tpu__coalesce__enabled="false")
+    assert not [n for n in _final_plan(q, conf_off).collect_nodes()
+                if isinstance(n, TpuCoalesceBatchesExec)]
+
+
+def test_plan_skips_coalesce_over_exchange_inputs():
+    """Exchange-fed operators coalesce HOST-side in the reduce read; the
+    plan pass must not stack a redundant device coalesce on top."""
+    s = TpuSession(_conf())
+    final = _final_plan(_q3_shape(s), _conf())
+    assert not [n for n in final.collect_nodes()
+                if isinstance(n, TpuCoalesceBatchesExec)]
+
+
+# ---------------------------------------------------------------------------
+# target honoring (rows and bytes) + require_single
+# ---------------------------------------------------------------------------
+
+
+class _FeedExec:
+    """Minimal device child yielding pre-built batches."""
+
+    def __init__(self, batches):
+        from spark_rapids_tpu.execs.base import TpuExec
+        self.batches = batches
+
+    def execute_partition(self, idx, ctx):
+        yield from self.batches
+
+    def num_partitions(self):
+        return 1
+
+    @property
+    def output(self):
+        return []
+
+    children = ()
+
+    def collect_nodes(self):
+        return [self]
+
+
+def _small_batches(n_batches=10, rows=16):
+    from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+    out = []
+    for b in range(n_batches):
+        out.append(TpuColumnarBatch.from_pydict(
+            {"x": list(range(b * rows, (b + 1) * rows))}))
+    return out
+
+
+def _run_coalesce(goal, target_rows, conf=None):
+    from spark_rapids_tpu.execs.base import TaskContext
+    exec_ = TpuCoalesceBatchesExec(_FeedExec(_small_batches()), goal=goal,
+                                   target_rows=target_rows)
+    ctx = TaskContext(0, RapidsConf(conf or _conf()))
+    try:
+        return list(exec_.execute_partition(0, ctx))
+    finally:
+        ctx.complete()
+
+
+def test_row_target_honored():
+    outs = _run_coalesce("target", 64)
+    assert [b.num_rows for b in outs] == [64, 64, 32]
+    vals = [v for b in outs for v in b.to_arrow().column("x").to_pylist()]
+    assert vals == list(range(160))  # order preserved across concats
+
+
+def test_byte_target_honored():
+    # 16 rows of int64 ≈ 128B payload; a 1-byte target closes every batch
+    outs = _run_coalesce("target", 10**9,
+                         conf=_conf(spark__rapids__sql__batchSizeBytes="1"))
+    assert [b.num_rows for b in outs] == [16] * 10
+
+
+def test_require_single_batch_goal():
+    outs = _run_coalesce("require_single", 16)
+    assert [b.num_rows for b in outs] == [160]
+
+
+def test_spill_under_pressure_during_coalesce():
+    """Pending inputs are spillable: force a full spill between input
+    batches; the concat must unspill and produce identical data."""
+    from spark_rapids_tpu.execs.base import TaskContext
+    from spark_rapids_tpu.memory.spill import TpuBufferCatalog
+
+    class _SpillingFeed(_FeedExec):
+        def execute_partition(self, idx, ctx):
+            for i, b in enumerate(self.batches):
+                yield b
+                if i % 3 == 2:  # pressure mid-accumulation
+                    TpuBufferCatalog.get().synchronous_spill(1 << 40)
+
+    exec_ = TpuCoalesceBatchesExec(_SpillingFeed(_small_batches()),
+                                   goal="require_single")
+    ctx = TaskContext(0, RapidsConf(_conf()))
+    try:
+        outs = list(exec_.execute_partition(0, ctx))
+    finally:
+        ctx.complete()
+    assert [b.num_rows for b in outs] == [160]
+    vals = [v for b in outs for v in b.to_arrow().column("x").to_pylist()]
+    assert vals == list(range(160))
+
+
+def test_host_arrow_stream_coalescer():
+    import pyarrow as pa
+    tables = [pa.table({"x": list(range(i * 10, (i + 1) * 10))})
+              for i in range(7)] + [None, pa.table({"x": []})]
+    outs = list(coalesce_arrow_stream(iter(tables), 25, 10**9))
+    assert [t.num_rows for t in outs] == [30, 30, 10]
+    flat = [v for t in outs for v in t.column("x").to_pylist()]
+    assert flat == list(range(70))
+
+
+# ---------------------------------------------------------------------------
+# dispatch accounting: coalesced batches dispatch FEWER programs
+# ---------------------------------------------------------------------------
+
+
+def _kind_delta(before, after) -> dict:
+    b = before["calls_by_kind"]
+    a = after["calls_by_kind"]
+    return {k: a.get(k, 0) - b.get(k, 0) for k in set(a) | set(b)
+            if a.get(k, 0) != b.get(k, 0)}
+
+
+def _post_shuffle_chain(s):
+    """8 map partitions → 1 reduce partition → filter/project chain: the
+    reduce side sees 8 small blocks; host-side coalescing merges them into
+    ONE upload, so the downstream fused segment dispatches once instead of
+    once per block."""
+    df = s.createDataFrame(
+        [{"k": i % 4, "v": i} for i in range(320)], num_partitions=8)
+    return (df.repartition(1)
+            .filter(F.col("v") % 2 == 0)
+            .withColumn("x", F.col("v") * 2 + 1)
+            .select("k", "x"))
+
+
+def test_coalesced_batches_dispatch_fewer_programs():
+    s_on = TpuSession(_conf())
+    before = opjit.cache_stats()
+    on = _post_shuffle_chain(s_on).collect()
+    d_on = _kind_delta(before, opjit.cache_stats())
+
+    opjit.clear_cache()
+    s_off = TpuSession(_conf(
+        spark__rapids__tpu__coalesce__enabled="false"))
+    before = opjit.cache_stats()
+    off = _post_shuffle_chain(s_off).collect()
+    d_off = _kind_delta(before, opjit.cache_stats())
+
+    assert _rows_sorted(on) == _rows_sorted(off)
+    # same data, same programs — the coalesced run launches strictly fewer:
+    # 8 shuffle blocks merge into 1 segment input batch
+    assert d_on.get("segment", 0) < d_off.get("segment", 0), (d_on, d_off)
+    assert sum(d_on.values()) < sum(d_off.values()), (d_on, d_off)
+
+
+# ---------------------------------------------------------------------------
+# sync ledger: syncs bounded by O(exchanges), not O(operators×batches)
+# ---------------------------------------------------------------------------
+
+
+def _chain_query(s, parts=6):
+    df = s.createDataFrame(
+        [{"k": i % 5, "v": float(i), "w": i, "s": f"s{i % 3}"}
+         for i in range(600)], num_partitions=parts)
+    return (df.filter(F.col("w") % 2 == 0)
+            .withColumn("x", F.col("v") * 2 + 1)
+            .withColumn("y", F.col("x") + F.col("w"))
+            .groupBy("k")
+            .agg(F.sum(F.col("w")).alias("sw"),
+                 F.count(F.col("y")).alias("cy")))
+
+
+def _op_sync_totals(snapshot, kind=None):
+    out = {}
+    for op, kinds in snapshot.items():
+        out[op] = kinds.get(kind, 0) if kind else sum(kinds.values())
+    return out
+
+
+def test_sync_ledger_attributes_and_bounds_chain_syncs():
+    SyncLedger.reset_for_tests()
+    s = TpuSession(_conf())
+    res = _chain_query(s).collect()
+    assert len(res) == 5
+    snap = SyncLedger.get().snapshot()
+    # the fused filter→project chain defers its compaction: ZERO per-batch
+    # row-count syncs attributed to the segment/filter/project operators
+    rows_syncs = sum(
+        kinds.get("rows", 0) for op, kinds in snap.items()
+        if op.startswith(("TpuFusedSegment", "TpuFilter", "TpuProject")))
+    assert rows_syncs == 0, snap
+
+    # deferred compaction off: the same chain pays one rows sync per batch
+    SyncLedger.reset_for_tests()
+    s2 = TpuSession(_conf(
+        spark__rapids__tpu__batch__deferredCompaction__enabled="false"))
+    _chain_query(s2).collect()
+    snap_off = SyncLedger.get().snapshot()
+    rows_syncs_off = sum(
+        kinds.get("rows", 0) for op, kinds in snap_off.items()
+        if op.startswith(("TpuFusedSegment", "TpuFilter", "TpuProject")))
+    assert rows_syncs_off > 0, snap_off
+
+
+def test_sync_ledger_total_bounded_by_exchanges():
+    """End to end on the q3 shape: total blocking syncs with the full PR 5
+    stack on must be strictly below the coalesce+deferral-off run — the
+    per-(operator×batch) component is gone."""
+    SyncLedger.reset_for_tests()
+    _q3_shape(TpuSession(_conf())).collect()
+    total_on = SyncLedger.get().total()
+
+    SyncLedger.reset_for_tests()
+    _q3_shape(TpuSession(_conf(
+        spark__rapids__tpu__coalesce__enabled="false",
+        spark__rapids__tpu__batch__deferredCompaction__enabled="false",
+    ))).collect()
+    total_off = SyncLedger.get().total()
+    assert total_on < total_off, (total_on, total_off)
+
+
+def test_metric_counts_stay_lazy_until_read():
+    """numOutputRows over a deferred-compaction filter chain accumulates
+    device-side (add_lazy) and materializes at metric read, not per batch."""
+    from spark_rapids_tpu.execs.base import TpuMetric
+    import jax.numpy as jnp
+    m = TpuMetric("numOutputRows")
+    m.add(3)
+    m.add_lazy(jnp.int32(4))
+    m.add_lazy(5)
+    assert m.value == 12
+    m.add_lazy(jnp.int32(1))
+    assert m.value == 13
+
+
+# ---------------------------------------------------------------------------
+# chaos soak with coalesce on (seeded, bit-identical to a clean run)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_soak_with_coalesce():
+    from spark_rapids_tpu.chaos import FaultInjector
+    FaultInjector.reset_for_tests()
+    try:
+        # clean run first: the injector stays disarmed for the baseline
+        clean = _rows_sorted(_q3_shape(TpuSession(_conf())).collect())
+        chaos_session = TpuSession(_conf(
+            spark__rapids__tpu__test__chaos__enabled="true",
+            spark__rapids__tpu__test__chaos__seed="7",
+            spark__rapids__tpu__test__chaos__kinds=(
+                "retry_oom,transient,latency"),
+            spark__rapids__tpu__test__chaos__probability="0.08",
+            spark__rapids__tpu__test__chaos__latencyMs="1.0",
+            spark__rapids__tpu__deviceRetry__backoffBaseMs="1",
+            spark__rapids__tpu__deviceRetry__backoffMaxMs="4",
+            spark__rapids__tpu__deviceRetry__maxAttempts="8"))
+        injector = FaultInjector.get()
+        got = _rows_sorted(_q3_shape(chaos_session).collect())
+        assert got == clean  # bit-identical with coalescing under injection
+        assert injector.injection_count() >= 0
+    finally:
+        FaultInjector.reset_for_tests()

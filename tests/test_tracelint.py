@@ -153,6 +153,47 @@ def test_host_helper_call_true_positive_and_near_miss():
 # conditionality
 # ---------------------------------------------------------------------------
 
+def test_tl011_blocking_sync_true_positive_and_audited_near_miss():
+    """TL011 (analysis/syncs.py): a raw np.asarray/.item()/device_get on a
+    device value fires; the same transfer routed through the audited ledger
+    gate (columnar/vector.py audited_sync*) does not."""
+    from spark_rapids_tpu.analysis import lint_sync_module
+    tp = _PRELUDE + textwrap.dedent("""\
+        def f(col):
+            n = np.asarray(col.data)
+            return n
+        def g(col):
+            return jax.device_get(col.data)
+        def h(scalar_dev):
+            return scalar_dev.item()
+        """)
+    findings = lint_sync_module(tp, "execs/x.py")
+    assert sorted(f.location for f in findings) == [
+        "execs/x.py::f", "execs/x.py::g", "execs/x.py::h"]
+    assert all(f.rule == "TL011" and f.severity == "error"
+               for f in findings)
+    nm = _PRELUDE + textwrap.dedent("""\
+        from spark_rapids_tpu.columnar.vector import (audited_sync,
+                                                      audited_sync_int)
+        def f(col):
+            bounds = audited_sync(col.data, "bounds")
+            return int(bounds[0])
+        def g(col):
+            lut = np.asarray([1, 2, 3])  # host constant: no transfer
+            return jnp.asarray(lut)[col.data]
+        """)
+    assert lint_sync_module(nm, "execs/x.py") == []
+
+
+def test_tl011_real_tree_syncs_all_audited_or_baselined():
+    """Every blocking sync in execs/ and shuffle/ either routes through the
+    audited gate or carries a commented baseline entry."""
+    from spark_rapids_tpu.analysis import lint_sync_tree
+    baseline = set(tracelint.load_baseline())
+    fresh = [f for f in lint_sync_tree() if f.key not in baseline]
+    assert fresh == [], [f.render() for f in fresh]
+
+
 def test_guard_with_early_return_makes_host_tail_conditional():
     """The dominant expressions/ idiom: device path behind a guard, host
     fallback as the lexically-unconditional tail."""

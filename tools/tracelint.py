@@ -15,6 +15,10 @@ them against the ~20 modules of eval_tpu implementations.  This tool does:
                         with --corroborate)
   concurrency lint      TL010 module-level mutable state mutated outside a
                         lock in shuffle/ memory/ execs/             (error)
+  blocking-sync lint    TL011 raw np.asarray/.item()/jax.device_get on a
+                        device value in execs/ shuffle/ outside the
+                        audited sync-ledger gate
+                        (columnar/vector.py audited_sync*)           (error)
 
 Findings diff against tools/tracelint_baseline.txt (one key per line, `#`
 comments allowed) so exceptions are explicit.  Exit status is non-zero iff
@@ -75,10 +79,12 @@ def write_baseline(keys, path=BASELINE_PATH, comments=None):
 
 def collect_findings(corroborate=False):
     """All findings from every pass, plus the expression reports."""
-    from spark_rapids_tpu.analysis import (analyze_registry, lint_tree)
+    from spark_rapids_tpu.analysis import (analyze_registry, lint_sync_tree,
+                                           lint_tree)
     reports, findings = analyze_registry()
     findings = list(findings)
     findings.extend(lint_tree())
+    findings.extend(lint_sync_tree())
     probe_results = None
     if corroborate:
         from spark_rapids_tpu.analysis import corroborate as _corr
