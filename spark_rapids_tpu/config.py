@@ -219,13 +219,66 @@ general-path wall time. The opjit cache tracks it:
   (both join sides' key encode in one launch), `exchsplit` (the exchange
   map side's hash-partition encode+split pair in one launch), `pids`
   (hash partitioner alone, e.g. under the mesh collective), `aggsort` /
-  `aggreduce` (the sort-based aggregate's two phases).
+  `aggreduce` (the sort-based aggregate's two phases), plus the
+  whole-stage and partition-grouped kinds: `joinprobe` / `joinemit` (a
+  fused segment's streamed-side join probe and pair-emit+downstream
+  halves), `aggstage` (the grouped aggregate's whole update as one
+  launch), `segmentg` (one fused segment over a GROUP of partitions'
+  batches) and `exchsplitg` (the hash encode+split of a whole partition
+  group with one bounds readback).
 * With fusion on, a fully-fused N-operator chain contributes ONE `segment`
   dispatch per batch; with fusion off the same chain contributes N
   `project`/`filter` dispatches. bench.py's q3_general detail reports the
   per-run deltas so the reduction is directly visible.
 * `opJitTraceTime` isolates first-sight compile cost from steady-state
   dispatch cost; steady state should be all hits.
+
+## Per-plan and per-partition dispatch
+
+The compiled stages reach O(exchanges) launches by construction; the
+general path reaches it by composing four mechanisms, each with its own
+toggle, all default-on:
+
+* **Segments across joins** (`spark.rapids.tpu.opjit.fuseJoins`): a fused
+  stage segment absorbs a streamed-side inner equi-join at its bottom. The
+  build side materializes ONCE per partition — segment build children get
+  the `RequireSingleBatch` coalesce goal (or arrive host-concatenated from
+  an exchange read) — and each probe batch runs exactly TWO launches
+  (`joinprobe`: upstream chain + key encode + hash-range probe; `joinemit`:
+  pair expansion + verification + both-side gather + the flattened
+  downstream chain + one compaction), split only at the inherent
+  candidate-count sync. String keys, non-inner join types, oversized
+  builds (which need sub-partitioning) and host-assisted expressions
+  delegate that partition to the original join operator unchanged.
+* **Segments across partial aggregation**
+  (`spark.rapids.tpu.opjit.fuseAggs`): a grouped hash-aggregate at the top
+  of a segment — or standing alone — runs its whole update (key eval,
+  encode, stable sort, segment boundaries, every measure update and
+  finalization, group-key gather) as ONE `aggstage` launch with a
+  capacity-bucketed group table, so the group count stays a DEVICE scalar
+  instead of syncing between the old sort and reduce phases. Unsupported
+  aggregates degrade to the two-phase path with identical results.
+* **Batched multi-partition dispatch**
+  (`spark.rapids.tpu.dispatch.partitionBatch`, default 8): the per-
+  *partition* launch axis folds the same way the per-operator axis did.
+  The exchange map side schedules partition GROUPS: member partitions'
+  same-layout batches run one grouped segment program (`segmentg`) through
+  the child's `execute_partitions` entry point, their hash encode+split
+  plans run one grouped launch (`exchsplitg`), and ALL member split bounds
+  ride one device→host readback. One TPU-semaphore permit gates the whole
+  group (member task contexts are adopted onto it), and block identity is
+  unchanged — each member still commits under its own map id, so reduce
+  reads and lineage recovery never observe the grouping. Set to 1 for the
+  per-partition behavior.
+* **Pipelined group scheduling**: the shuffle pipeline pool
+  (`spark.rapids.tpu.shuffle.pipeline.*`) submits partition groups, not
+  single partitions, as its schedulable units, so retry, chaos injection
+  and cancellation wrap a whole group exactly like they wrapped one map.
+
+tests/test_whole_stage_dispatch.py locks the result in: a q3-shaped
+general-path plan must show only whole-stage dispatch kinds, a total
+launch count bounded by a small constant per exchange, and bit-identical
+results against every degraded configuration.
 
 ## Batch coalescing
 
@@ -467,6 +520,47 @@ OPJIT_FUSE_STAGES = _conf("spark.rapids.tpu.opjit.fuseStages").doc(
     "fused); untraceable segments degrade to the per-operator programs "
     "with identical results. Requires spark.rapids.tpu.opjit.enabled."
 ).commonly_used().boolean(True)
+
+OPJIT_FUSE_JOINS = _conf("spark.rapids.tpu.opjit.fuseJoins").doc(
+    "Let fused stage segments absorb an inner equi-join: the build side "
+    "materializes ONCE per partition (one cached build program: key eval + "
+    "encode + hash + sort), and each probe batch runs the upstream "
+    "projection/filter chain, probe-key encode and hash-range probe as one "
+    "cached program, then pair expansion, verification, both-side gathers "
+    "and the downstream chain as a second — two launches plus the inherent "
+    "pair-count sync per probe batch instead of one launch per operator. "
+    "String keys, residual-match-sensitive join types and host-assisted "
+    "expressions degrade to the per-operator join with identical results. "
+    "Requires spark.rapids.tpu.opjit.fuseStages."
+).commonly_used().boolean(True)
+
+OPJIT_FUSE_AGGS = _conf("spark.rapids.tpu.opjit.fuseAggs").doc(
+    "Run the sort-based grouped aggregate's whole update — grouping-key "
+    "eval, encode, stable sort, segment boundaries, every measure update "
+    "and finalization, and the group-key gather — as ONE cached executable "
+    "with a fixed-size (input-capacity-bucketed) group table, so the group "
+    "count stays a DEVICE scalar instead of syncing between the sort and "
+    "reduce phases. Fused stage segments also absorb such an aggregate as "
+    "their final stage. Unsupported aggregates (collect/percentile "
+    "family, decimal accumulators, variable-width inputs) degrade to the "
+    "two-phase aggsort/aggreduce path with identical results. Requires "
+    "spark.rapids.tpu.opjit.enabled."
+).commonly_used().boolean(True)
+
+DISPATCH_PARTITION_BATCH = _conf(
+    "spark.rapids.tpu.dispatch.partitionBatch").doc(
+    "Batched multi-partition dispatch: the exchange map side and the fused "
+    "segment executor process up to this many partitions per program "
+    "launch — member batches enter ONE cached grouped program (each padded "
+    "to its capacity bucket; a composite member×partition sort key keeps "
+    "per-partition identity) so the hash-partition encode+split pair and "
+    "the segment transform launch once per partition GROUP, and the split "
+    "bounds of the whole group ride one device→host readback. The shuffle "
+    "pipeline pool schedules partition groups instead of single "
+    "partitions. 1 disables grouping (per-partition dispatch, the PR 2 "
+    "behavior); block identity, ordering and lineage recovery are "
+    "unchanged either way."
+).commonly_used().integer(8)
 
 SHUFFLE_PIPELINE_ENABLED = _conf(
     "spark.rapids.tpu.shuffle.pipeline.enabled").doc(

@@ -384,7 +384,10 @@ def _arrow_aggregate(flat, key_names: List[str], agg_specs, grouping):
             work_table = work_table.append_column(
                 "__dummy", pa.array(np.ones(work_table.num_rows, np.int8)))
             agg_calls.append(("__dummy", "count", None))
-        gb = pa.TableGroupBy(work_table, key_names)
+        # first/last are ordered aggregators: arrow only supports them in
+        # single-threaded execution (and row order matters for them anyway)
+        ordered = any(op in ("first", "last") for _, op, _ in agg_calls)
+        gb = pa.TableGroupBy(work_table, key_names, use_threads=not ordered)
         res = gb.aggregate([(n, op) if o is None else (n, op, o)
                             for n, op, o in agg_calls])
         get = lambda n, op: res.column(f"{n}_{op}")
@@ -1313,10 +1316,9 @@ class TpuHashAggregateExec(TpuExec):
 
     def additional_metrics(self):
         return {"sortTime": "MODERATE", "reduceTime": "MODERATE",
-                "numGroups": "DEBUG"}
+                "numGroups": "DEBUG", "opFusedAggBatches": "DEBUG"}
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
-        from ..config import BATCH_SIZE_ROWS
         child = self.children[0]
         batches: List[TpuColumnarBatch] = []
         if self.per_partition:
@@ -1324,6 +1326,14 @@ class TpuHashAggregateExec(TpuExec):
         else:
             for p in range(child.num_partitions()):
                 batches.extend(child.execute_partition(p, ctx))
+        yield from self.aggregate_batches(batches, ctx)
+
+    def aggregate_batches(self, batches: List[TpuColumnarBatch],
+                          ctx: TaskContext) -> Iterator:
+        """Aggregate already-collected input batches — the entry point a
+        fused stage segment (execs/fusion.py) uses when the aggregate is its
+        trailing stage, and the body of the normal per-partition path."""
+        from ..config import BATCH_SIZE_ROWS
         agg_fns, result_exprs = split_result_exprs(self.aggregates)
         if not batches:
             if not self.grouping:
@@ -1448,8 +1458,13 @@ class TpuHashAggregateExec(TpuExec):
         jit the sort). Results are identical either way."""
         from . import opjit
         cap = batch.capacity
-        n = batch.num_rows
         use_jit = opjit.enabled(ctx.eval_ctx)
+        if use_jit and self.grouping:
+            fused = self._fused_aggregate_batch(batch, agg_fns, result_exprs,
+                                                ctx)
+            if fused is not None:
+                return fused
+        n = batch.num_rows
         perm = seg_ids = is_new = key_rows = None
         key_cols: List[TpuColumnVector] = []
         if self.grouping:
@@ -1516,6 +1531,41 @@ class TpuHashAggregateExec(TpuExec):
             bound, [attr.dtype for attr in self._output[ng:]], agg_batch,
             ctx.eval_ctx, self.metrics))
         return TpuColumnarBatch(final_cols, n_groups,
+                                [a.name for a in self._output])
+
+    def _fused_aggregate_batch(self, batch: TpuColumnarBatch, agg_fns,
+                               result_exprs,
+                               ctx: TaskContext) -> Optional[TpuColumnarBatch]:
+        """The whole grouped update as ONE launch (opjit.agg_stage_program,
+        spark.rapids.tpu.opjit.fuseAggs): the group table is sized to the
+        batch's capacity bucket so the group count stays a DEVICE scalar —
+        no sort→reduce phase-boundary sync. Falls back (None) to the
+        two-phase path for unsupported aggregates with identical results."""
+        from ..config import DEFERRED_COMPACTION, OPJIT_FUSE_AGGS
+        from . import opjit
+        if not ctx.conf.get(OPJIT_FUSE_AGGS):
+            return None
+        with self.metrics["reduceTime"].timed():
+            fused = opjit.agg_stage_program(self.grouping, agg_fns, batch,
+                                            ctx.eval_ctx, self.metrics)
+        if fused is None:
+            return None
+        key_cols, agg_cols, ng_dev = fused
+        self.metrics["numGroups"].add_lazy(ng_dev)
+        self.metrics["opFusedAggBatches"].add(1)
+        ng_rows = ng_dev
+        if not ctx.conf.get(DEFERRED_COMPACTION):
+            from ..columnar.vector import audited_sync_int
+            ng_rows = audited_sync_int(ng_dev, "rows")
+        agg_batch = TpuColumnarBatch(list(key_cols) + list(agg_cols), ng_rows)
+        nk = len(self.grouping)
+        final_cols = list(agg_batch.columns[:nk])
+        bound = [_bind_agg_refs(expr, None, nk, self.grouping)
+                 for expr in result_exprs]
+        final_cols.extend(opjit.eval_exprs(
+            bound, [attr.dtype for attr in self._output[nk:]], agg_batch,
+            ctx.eval_ctx, self.metrics))
+        return TpuColumnarBatch(final_cols, agg_batch.rows_lazy,
                                 [a.name for a in self._output])
 
     def _empty_global_result(self, agg_fns, result_exprs, ctx):

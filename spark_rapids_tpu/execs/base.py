@@ -102,6 +102,18 @@ class TpuMetric:
             with self._lock:
                 self._value += dt
 
+    # plans (and their metric dicts) ship to worker processes by pickle
+    # (parallel/executors.py): the lock can't cross, and parked device
+    # scalars are process-local — materialize them into the value first
+    # (plan shipping happens once per stage, never per batch)
+    def __getstate__(self):
+        return (self.name, self.level, self.value)
+
+    def __setstate__(self, state):
+        self.name, self.level, self._value = state
+        self._pending = []
+        self._lock = threading.Lock()
+
 
 class TaskContext:
     """Per-task execution context (partition id, conf, metric sink).
@@ -178,6 +190,18 @@ class PhysicalPlan:
 
     def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
         raise NotImplementedError
+
+    def execute_partitions(self, ids: Sequence[int], ctx_of) -> Iterator:
+        """Multi-partition entry point (batched multi-partition dispatch,
+        spark.rapids.tpu.dispatch.partitionBatch): yield (partition_id,
+        batch) for every partition in `ids`, in id order. `ctx_of(i)`
+        supplies the per-partition TaskContext (partition-id-dependent
+        expressions must see their own id). The default runs partitions
+        one at a time; operators that can batch a whole partition group
+        into one device launch override it (TpuFusedSegmentExec)."""
+        for i in ids:
+            for batch in self.execute_partition(i, ctx_of(i)):
+                yield i, batch
 
     # --- plan utilities ---------------------------------------------------
     def tree_string(self, indent: int = 0) -> str:

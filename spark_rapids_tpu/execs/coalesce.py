@@ -181,8 +181,12 @@ def _batch_hungry_children(node: PhysicalPlan):
         # build side (right; the symmetric join may flip per partition, but
         # both sides are fully collected either way) wants ONE batch
         return [(0, "target"), (1, "require_single")]
-    if isinstance(node, (TpuHashAggregateExec, TpuSortExec,
-                         TpuFusedSegmentExec)):
+    if isinstance(node, TpuFusedSegmentExec):
+        # a segment that absorbed a join materializes each build child ONCE
+        # per partition (the fused probe needs a single build batch)
+        return [(0, "target")] + [(i, "require_single")
+                                  for i in node.build_child_indices]
+    if isinstance(node, (TpuHashAggregateExec, TpuSortExec)):
         return [(0, "target")]
     return []
 
@@ -209,17 +213,28 @@ def insert_coalesce(plan: PhysicalPlan, conf) -> PhysicalPlan:
     """Wrap batch-hungry operators' device inputs in TpuCoalesceBatchesExec
     (reference GpuTransitionOverrides inserting GpuCoalesceBatches per
     CoalesceGoal). Runs after the fusion pass so fused segments are targets
-    too; no-op when spark.rapids.tpu.coalesce.enabled is off."""
+    too; compiled-stage fallback subtrees are rewritten through the same
+    id-memo (they execute whenever a stage bails, and must see the same
+    coalesced inputs — sharing the memo keeps exchanges shared between a
+    stage's children and its fallback). No-op when
+    spark.rapids.tpu.coalesce.enabled is off."""
     if not coalesce_enabled(conf):
         return plan
     from ..config import SHUFFLE_MODE
     exchanges_host_coalesced = str(conf.get(SHUFFLE_MODE)).upper() != "ICI"
-    return _insert(plan, exchanges_host_coalesced)
+    return _insert(plan, exchanges_host_coalesced, {})
 
 
-def _insert(plan: PhysicalPlan, exchanges_host_coalesced: bool) -> PhysicalPlan:
-    new_children = [_insert(c, exchanges_host_coalesced)
+def _insert(plan: PhysicalPlan, exchanges_host_coalesced: bool,
+            memo: dict) -> PhysicalPlan:
+    cached = memo.get(id(plan))
+    if cached is not None:
+        return cached
+    new_children = [_insert(c, exchanges_host_coalesced, memo)
                     for c in plan.children]
+    fb = getattr(plan, "fallback", None)
+    new_fb = _insert(fb, exchanges_host_coalesced, memo) \
+        if isinstance(fb, PhysicalPlan) else fb
     wants = dict(_batch_hungry_children(plan))
     wrapped = []
     for i, c in enumerate(new_children):
@@ -228,8 +243,13 @@ def _insert(plan: PhysicalPlan, exchanges_host_coalesced: bool) -> PhysicalPlan:
                 and not _already_coalesced(c, exchanges_host_coalesced):
             c = TpuCoalesceBatchesExec(c, goal=goal)
         wrapped.append(c)
-    if all(a is b for a, b in zip(wrapped, plan.children)):
+    if all(a is b for a, b in zip(wrapped, plan.children)) \
+            and new_fb is fb:
+        memo[id(plan)] = plan
         return plan
     new = copy.copy(plan)
     new.children = wrapped
+    if new_fb is not fb:
+        new.fallback = new_fb
+    memo[id(plan)] = new
     return new

@@ -729,7 +729,11 @@ class TpuCompiledAggStageExec(TpuExec):
     def _run_compiled(self, ctx: TaskContext) -> TpuColumnarBatch:
         from ..memory.spill import SpillableColumnarBatch
         spec = self.spec
-        src = spec.source
+        # pull through the plan-tree link, NOT the spec's captured source:
+        # passes that run after stage compilation (whole-stage segment
+        # fusion, coalescing) rewrite children[0], and executing the stale
+        # spec.source would silently run the pre-fusion operator chain
+        src = self.children[0]
         held: List[SpillableColumnarBatch] = []
         domains = [_KeyDomain(g.dtype) for g in spec.grouping]
         carries = []

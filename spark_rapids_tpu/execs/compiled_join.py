@@ -1087,7 +1087,10 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         held: List[SpillableColumnarBatch] = []
         carries = []
         try:
-            src = spec.fact_source
+            # the plan-tree link, not the captured spec.fact_source: passes
+            # after stage compilation (segment fusion, coalescing) rewrite
+            # children[0] and the stale pointer would bypass them
+            src = self.children[0]
             for p in range(src.num_partitions()):
                 pctx = TaskContext(p, ctx.conf)
                 try:

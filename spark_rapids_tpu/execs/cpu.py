@@ -265,10 +265,15 @@ def sort_table(table, order: List[SortOrder], ctx: TaskContext):
     sort_cols["__row__"] = pa.array(np.arange(n, dtype=np.int64))
     sort_keys.append(("__row__", "ascending"))
     key_table = pa.table(sort_cols)
-    # arrow ≥25 wants null_placement per sort key (key columns are all
-    # non-null by construction — the flag encodes null position)
-    idx = pc.sort_indices(
-        key_table, sort_keys=[(k, d, "at_end") for k, d in sort_keys])
+    # arrow ≥25 wants null_placement per sort key; older arrows only take
+    # (name, order) pairs plus the kwarg (key columns are all non-null by
+    # construction — the flag encodes null position, so placement is moot)
+    try:
+        idx = pc.sort_indices(
+            key_table, sort_keys=[(k, d, "at_end") for k, d in sort_keys])
+    except (ValueError, TypeError):
+        idx = pc.sort_indices(key_table, sort_keys=sort_keys,
+                              null_placement="at_end")
     return table.take(idx)
 
 

@@ -72,9 +72,15 @@ _STATS = {"hits": 0, "misses": 0, "traces": 0, "trace_time_ns": 0}
 #: dispatch accounting (docs/configs.md "Dispatch accounting"): one entry per
 #: program dispatch through the cache, keyed by program kind ("segment",
 #: "project", "filter", "joinenc", "exchsplit", "pids", "aggsort",
-#: "aggreduce"). A fully fused N-operator chain shows ONE "segment" dispatch
-#: per batch where the per-operator path shows N "project"/"filter"
-#: dispatches; "exchsplit" likewise replaces a "pids"+split-plan pair.
+#: "aggreduce", plus the whole-stage/grouped kinds: "segmentg" — one fused
+#: segment over a GROUP of partitions' batches, "exchsplitg" — the hash
+#: encode+split of a whole partition group, "joinprobe"/"joinemit" — a fused
+#: segment's streamed-side join probe and pair-emit+downstream halves,
+#: "aggstage" — the sort-based aggregate's whole update as one launch). A
+#: fully fused N-operator chain shows ONE "segment" dispatch per batch where
+#: the per-operator path shows N "project"/"filter" dispatches; "exchsplit"
+#: likewise replaces a "pids"+split-plan pair, and the grouped kinds replace
+#: one dispatch PER PARTITION with one per partition group.
 _KIND_CALLS: Dict[str, int] = {}
 
 
@@ -938,6 +944,26 @@ def segment_inputs_ok(exprs: Sequence[Expression],
     return _inputs_ok(exprs, batch)
 
 
+def _segment_body(out_exprs, out_dtypes, filters, sig, src_dtypes,
+                  n_cols: int, cap: int, tctx, flat):
+    """Single-batch segment evaluation — shared by the per-batch program and
+    the grouped (multi-partition) program so the two are bit-identical."""
+    rowmask = jnp.arange(cap) < flat[0]
+    tb = _rebuild_batch(flat, sig, src_dtypes, n_cols, cap, rowmask)
+    keep = rowmask
+    for f in filters:
+        c = to_column(f.eval_tpu(tb, tctx), tb)
+        m = c.data.astype(jnp.bool_)
+        if c.validity is not None:
+            m = m & c.validity  # null predicate → drop row
+        keep = keep & m
+    outs = []
+    for e, dt in zip(out_exprs, out_dtypes):
+        c = to_column(e.eval_tpu(tb, tctx), tb, dt)
+        outs.append((c.data, c.validity))
+    return tuple(outs), (keep if filters else None)
+
+
 def segment_program(out_exprs: Sequence[Expression],
                     out_dtypes: Sequence[DataType],
                     filters: Sequence[Expression],
@@ -970,20 +996,8 @@ def segment_program(out_exprs: Sequence[Expression],
 
     def build():
         def fn(*flat):
-            rowmask = jnp.arange(cap) < flat[0]
-            tb = _rebuild_batch(flat, sig, src_dtypes, n_cols, cap, rowmask)
-            keep = rowmask
-            for f in filters:
-                c = to_column(f.eval_tpu(tb, tctx), tb)
-                m = c.data.astype(jnp.bool_)
-                if c.validity is not None:
-                    m = m & c.validity  # null predicate → drop row
-                keep = keep & m
-            outs = []
-            for e, dt in zip(out_exprs, out_dtypes):
-                c = to_column(e.eval_tpu(tb, tctx), tb, dt)
-                outs.append((c.data, c.validity))
-            return tuple(outs), (keep if has_filters else None)
+            return _segment_body(out_exprs, out_dtypes, filters, sig,
+                                 src_dtypes, n_cols, cap, tctx, flat)
         return fn
 
     out = _cached_call(key, build, tuple(_flat_args(batch, sig)),
@@ -994,3 +1008,469 @@ def segment_program(out_exprs: Sequence[Expression],
     cols = [TpuColumnVector(dt, d, v, batch.rows_lazy)
             for (d, v), dt in zip(outs, out_dtypes)]
     return cols, keep
+
+
+def segment_program_grouped(out_exprs: Sequence[Expression],
+                            out_dtypes: Sequence[DataType],
+                            filters: Sequence[Expression],
+                            batches: Sequence[TpuColumnarBatch],
+                            eval_ctx: EvalContext, metrics=None):
+    """Batched multi-partition dispatch of one fused segment: N partitions'
+    batches run the SAME flattened segment in ONE launch ("segmentg"),
+    reusing _segment_body per member so results are bit-identical to N
+    single-batch "segment" dispatches. Member batches may differ in bucketed
+    capacity (the cache key covers the capacity tuple); they must share the
+    input layout (callers group by layout). Returns a list of (cols, keep)
+    per member, or None when the fingerprint is pinned eager."""
+    out_exprs = list(out_exprs)
+    out_dtypes = list(out_dtypes)
+    filters = list(filters)
+    all_exprs = out_exprs + filters
+    sig = _input_sig(all_exprs, batches[0])
+    caps = tuple(b.capacity for b in batches)
+    key = ("segmentg", tuple(_fp(e) for e in out_exprs),
+           tuple(_fp(f) for f in filters),
+           tuple(type(d).__name__ for d in out_dtypes), caps,
+           len(batches[0].columns), sig, _conf_fp(eval_ctx))
+    src_dtypes = {o: batches[0].columns[o].dtype for (o, _, _, _) in sig}
+    n_cols = len(batches[0].columns)
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(member_flats):
+            return tuple(
+                _segment_body(out_exprs, out_dtypes, filters, sig,
+                              src_dtypes, n_cols, cap, tctx, flat)
+                for cap, flat in zip(caps, member_flats))
+        return fn
+
+    args = tuple(tuple(_flat_args(b, sig)) for b in batches)
+    out = _cached_call(key, build, (args,), eval_ctx, metrics)
+    if out is _FAILED:
+        return None
+    results = []
+    for b, (outs, keep) in zip(batches, out):
+        cols = [TpuColumnVector(dt, d, v, b.rows_lazy)
+                for (d, v), dt in zip(outs, out_dtypes)]
+        results.append((cols, keep))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# grouped hash-partition split (shuffle/partitioner.py, shuffle/exchange.py):
+# the encode+split plans of a whole partition GROUP in one launch
+# ---------------------------------------------------------------------------
+
+
+def partition_split_plan_grouped(batches: Sequence[TpuColumnarBatch],
+                                 key_exprs_per_lane, n: int,
+                                 eval_ctx: EvalContext, seed: int,
+                                 metrics=None):
+    """N lanes' (key eval → murmur3 → pmod → stable sort → bounds) split
+    plans as ONE executable ("exchsplitg") — the batched multi-partition
+    form of partition_split_plan. Each lane's plan is computed with exactly
+    the single-lane composition, so slices are bit-identical to per-lane
+    dispatch; only the launch count (and the bounds readback, which the
+    caller batches into one transfer) changes. Lanes may carry distinct key
+    expressions (the join sub-partitioner splits both sides in one launch).
+    Returns (orders, bounds) lists of device arrays, or None."""
+    if not enabled(eval_ctx):
+        return None
+    lanes = list(zip(batches, key_exprs_per_lane))
+    for b, keys in lanes:
+        if not all(_gate_ok(k) for k in keys) or not _inputs_ok(keys, b):
+            return None
+    sigs = tuple(_input_sig(keys, b) for b, keys in lanes)
+    caps = tuple(b.capacity for b, _ in lanes)
+    key = ("exchsplitg",
+           tuple(tuple(_fp(k) for k in keys) for _, keys in lanes),
+           caps, tuple(len(b.columns) for b, _ in lanes), sigs, int(n),
+           int(seed), _conf_fp(eval_ctx))
+    lane_meta = []
+    for (b, keys), sig in zip(lanes, sigs):
+        lane_meta.append((list(keys), sig,
+                          {o: b.columns[o].dtype for (o, _, _, _) in sig},
+                          len(b.columns), b.capacity))
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(lane_flats):
+            from ..expressions.hashexprs import murmur3_batch
+            orders, bounds = [], []
+            for (keys, sig_l, dt_l, ncol_l, cap_l), flat in zip(lane_meta,
+                                                                lane_flats):
+                rowmask = jnp.arange(cap_l) < flat[0]
+                tb = _rebuild_batch(flat, sig_l, dt_l, ncol_l, cap_l,
+                                    rowmask)
+                cols = [to_column(k.eval_tpu(tb, tctx), tb, k.dtype)
+                        for k in keys]
+                h = murmur3_batch(cols, cap_l, cap_l, seed)
+                pid = h % n
+                pid = jnp.where(pid < 0, pid + n, pid).astype(jnp.int32)
+                sort_key = jnp.where(rowmask, pid, n)  # padding last
+                order = jnp.argsort(sort_key, stable=True)
+                sorted_pid = jnp.take(sort_key, order)
+                orders.append(order)
+                bounds.append(jnp.searchsorted(sorted_pid,
+                                               jnp.arange(n + 1)))
+            return tuple(orders), tuple(bounds)
+        return fn
+
+    args = tuple(tuple(_flat_args(b, sig)) for (b, _), sig in zip(lanes,
+                                                                  sigs))
+    out = _cached_call(key, build, (args,), eval_ctx, metrics)
+    if out is _FAILED:
+        return None
+    return list(out[0]), list(out[1])
+
+
+# ---------------------------------------------------------------------------
+# fused join probe (execs/fusion.py): the streamed side of an inner equi-join
+# absorbed into a stage segment. Two programs split at the inherent
+# candidate-count sync: "joinprobe" (upstream chain + key encode + hash-range
+# probe) and "joinemit" (pair expansion + verify + both-side gather +
+# downstream chain + one compaction).
+# ---------------------------------------------------------------------------
+
+
+def join_probe_gate_ok(key_exprs, filters, out_exprs) -> bool:
+    return all(_gate_ok(e) for e in list(key_exprs) + list(filters)
+               + list(out_exprs))
+
+
+def plain_device_col(col) -> bool:
+    """Fixed-width single-vector device layout — the only layout the fused
+    join can pass through its traced gather."""
+    return (col.offsets is None and col.host_data is None
+            and col.child is None and col.children is None
+            and getattr(col.data, "ndim", 1) == 1)
+
+
+def _key_cols_sig(cols) -> Tuple:
+    return tuple((str(c.data.dtype), c.validity is not None) for c in cols)
+
+
+def join_probe_program(out_exprs, out_dtypes, filters, key_exprs,
+                       batch: TpuColumnarBatch, build_keys, build_rows,
+                       eval_ctx: EvalContext, metrics=None):
+    """The probe half of a fused join in ONE launch: apply the flattened
+    upstream projection/filter chain to the probe batch, evaluate+encode the
+    probe keys, encode the build keys (passed as device args so both sides
+    make the same cross-width limb decisions, exactly like
+    joins._encode_sides), composite-hash both sides and range-probe the
+    sorted build hashes (joins._join_probe_ranges — the same traced code the
+    unfused join runs, so candidates are bit-identical). Upstream filters do
+    not compact: failing rows are masked out of p_ok, which produces the
+    same candidate set and pair order the compact-then-probe path does.
+
+    Returns (state, jit_cols) where state carries everything the emit
+    program needs (counts/lo/order/b_ok/p_ok/encoded values/total), or None
+    when pinned eager."""
+    cap = batch.capacity
+    b_cap = build_keys[0].capacity
+    out_exprs = list(out_exprs)
+    out_dtypes = list(out_dtypes)
+    filters = list(filters)
+    key_exprs = list(key_exprs)
+    all_exprs = out_exprs + filters + key_exprs
+    sig = _input_sig(all_exprs, batch)
+    from ..utils.hw import x64_native
+    native = x64_native()
+    bsig = _key_cols_sig(build_keys)
+    key = ("joinprobe", tuple(_fp(e) for e in out_exprs),
+           tuple(_fp(f) for f in filters),
+           tuple(_fp(k) for k in key_exprs),
+           tuple(type(d).__name__ for d in out_dtypes), cap, b_cap,
+           len(batch.columns), sig, bsig, native, _conf_fp(eval_ctx))
+    src_dtypes = {o: batch.columns[o].dtype for (o, _, _, _) in sig}
+    n_cols = len(batch.columns)
+    b_dtypes = [c.dtype for c in build_keys]
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(flat, bkeys, b_rows):
+            from .aggregates import _sortable_bits
+            from .joins import _join_probe_ranges, encode_fixed_key_pair
+            rowmask = jnp.arange(cap) < flat[0]
+            tb = _rebuild_batch(flat, sig, src_dtypes, n_cols, cap, rowmask)
+            keep = rowmask
+            for f in filters:
+                c = to_column(f.eval_tpu(tb, tctx), tb)
+                m = c.data.astype(jnp.bool_)
+                if c.validity is not None:
+                    m = m & c.validity
+                keep = keep & m
+            p_enc, b_enc = [], []
+            for k, dt, (b_data, b_valid) in zip(key_exprs, b_dtypes, bkeys):
+                pc = to_column(k.eval_tpu(tb, tctx), tb, k.dtype)
+                bv = TpuColumnVector(dt, b_data, b_valid, b_cap)
+                p_valid = (pc.validity & keep) if pc.validity is not None \
+                    else keep
+                # probe = left, build = right: identical call shape to
+                # joins._encode_sides so the limb decisions agree
+                encode_fixed_key_pair(_sortable_bits(pc), _sortable_bits(bv),
+                                      p_valid, b_valid, native, p_enc, b_enc)
+            def split(enc, c):
+                vals = [v for v, _ in enc]
+                valids = [vd if vd is not None
+                          else jnp.ones((c,), jnp.bool_) for _, vd in enc]
+                return vals, valids
+            p_vals, p_valids = split(p_enc, cap)
+            b_vals, b_valids = split(b_enc, b_cap)
+            counts, lo, order, b_ok, p_ok, total = _join_probe_ranges(
+                b_vals, b_valids, p_vals, p_valids,
+                jnp.int32(b_rows), jnp.int32(flat[0]))
+            outs = []
+            for e, dt in zip(out_exprs, out_dtypes):
+                c = to_column(e.eval_tpu(tb, tctx), tb, dt)
+                outs.append((c.data, c.validity))
+            return (counts, lo, order, b_ok, p_ok, tuple(b_vals),
+                    tuple(p_vals), total, tuple(outs))
+        return fn
+
+    bkey_args = tuple((c.data, c.validity) for c in build_keys)
+    out = _cached_call(
+        key, build,
+        (tuple(_flat_args(batch, sig)), bkey_args, build_rows),
+        eval_ctx, metrics)
+    if out is _FAILED:
+        return None
+    counts, lo, order, b_ok, p_ok, b_vals, p_vals, total, outs = out
+    state = {"counts": counts, "lo": lo, "order": order, "b_ok": b_ok,
+             "p_ok": p_ok, "b_vals": list(b_vals), "p_vals": list(p_vals),
+             "total": total}
+    jit_cols = [TpuColumnVector(dt, d, v, batch.rows_lazy)
+                for (d, v), dt in zip(outs, out_dtypes)]
+    return state, jit_cols
+
+
+def join_emit_program(post_specs, post_traced, post_dtypes, post_filters,
+                      state, probe_cols, build_cols, probe_rows, build_rows,
+                      out_cap: int, n_left: int,
+                      eval_ctx: EvalContext, metrics=None,
+                      want_indices: bool = False):
+    """The emit half of a fused join in ONE launch: expand candidate ranges
+    into pairs, verify key equality, stable-compact the verified pairs,
+    gather BOTH sides' needed columns, run the flattened downstream chain
+    over the joined schema and compact once. The pair math reuses
+    joins._join_emit_pairs / _compact_pairs_device and the gather reuses
+    columnar.batch._gather_fixed_cols, so every intermediate is
+    bit-identical to the per-operator join. Returns (cols, n_out_dev,
+    probe_idx, build_idx) with the kept count as a DEVICE scalar, or None
+    when pinned eager.
+
+    probe_cols/build_cols map joined-schema ordinals (< n_left probe-side,
+    >= n_left build-side) to fixed-width device columns; post_specs maps
+    each output position to ('pass', joined_ordinal), ('jit', slot) or
+    ('host', joined_ordinal) — 'host' outputs (strings and other
+    host-layout passthroughs) are NOT produced by the trace; with
+    want_indices=True the program also returns the FINAL (post-filter,
+    compacted) per-side pair indices, -1-padded, so the caller can gather
+    them through columnar.batch.gather exactly like the unfused join."""
+    post_traced = list(post_traced)
+    post_dtypes = list(post_dtypes)
+    post_filters = list(post_filters)
+    p_ords = sorted(probe_cols)
+    b_ords = sorted(build_cols)
+    psig = _key_cols_sig([probe_cols[o] for o in p_ords])
+    bsig = _key_cols_sig([build_cols[o] for o in b_ords])
+    key = ("joinemit", tuple(post_specs),
+           tuple(_fp(e) for e in post_traced),
+           tuple(_fp(f) for f in post_filters),
+           tuple(type(d).__name__ for d in post_dtypes), out_cap,
+           tuple(p_ords), tuple(b_ords), psig, bsig, n_left,
+           len(state["b_vals"]), bool(want_indices), _conf_fp(eval_ctx))
+    p_dtypes = {o: probe_cols[o].dtype for o in p_ords}
+    b_dtypes = {o: build_cols[o].dtype for o in b_ords}
+    n_joined = max([n_left] + [o + 1 for o in p_ords + b_ords])
+    # dtype per TRACED slot: post_dtypes is positional over ALL outputs
+    jit_dtypes = [post_dtypes[pos] for pos, (kind, _) in enumerate(post_specs)
+                  if kind == "jit"]
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(counts, lo, order, b_ok, p_ok, b_vals, p_vals, total,
+               p_flat, b_flat, p_rows, b_rows):
+            from ..columnar.batch import _compact_plan, _gather_fixed_cols
+            from .joins import _compact_pairs_device, _join_emit_pairs
+            pi, bi, ok, n_ok = _join_emit_pairs(
+                counts, lo, order, b_ok, p_ok, list(b_vals), list(p_vals),
+                total, out_cap=out_cap)
+            cpi, cbi, slot_ok = _compact_pairs_device(pi, bi, ok, n_ok)
+            pair_mask = jnp.arange(out_cap) < n_ok
+
+            def gather_side(flat, idx, rows):
+                datas = [d for d, _ in flat]
+                valids = [v for _, v in flat]
+                return _gather_fixed_cols(datas, valids,
+                                          jnp.where(slot_ok, idx, -1),
+                                          jnp.int32(rows), n_ok)
+            pg_d, pg_v = gather_side(p_flat, cpi, p_rows) if p_flat \
+                else ([], [])
+            bg_d, bg_v = gather_side(b_flat, cbi, b_rows) if b_flat \
+                else ([], [])
+            # joined-schema batch for the downstream chain: unreferenced
+            # ordinals get typed dummies (never read)
+            # in-trace batch convention (_rebuild_batch): num_rows == cap, a
+            # CONCRETE int — the pair mask (slot < n_ok) already lives in
+            # every gathered validity, so expressions see padding slots as
+            # invalid and never need the traced count as a host int
+            cols: List[Optional[TpuColumnVector]] = [None] * n_joined
+            for o, d, v in zip(p_ords, pg_d, pg_v):
+                cols[o] = TpuColumnVector(p_dtypes[o], d, v, out_cap)
+            for o, d, v in zip(b_ords, bg_d, bg_v):
+                cols[o] = TpuColumnVector(b_dtypes[o], d, v, out_cap)
+            for o in range(n_joined):
+                if cols[o] is None:
+                    cols[o] = TpuColumnVector(
+                        IntegerT, jnp.zeros((out_cap,), jnp.int32),
+                        jnp.zeros((out_cap,), jnp.bool_), out_cap)
+            jb = TpuColumnarBatch(cols, out_cap)
+            keep = pair_mask
+            for f in post_filters:
+                c = to_column(f.eval_tpu(jb, tctx), jb)
+                m = c.data.astype(jnp.bool_)
+                if c.validity is not None:
+                    m = m & c.validity
+                keep = keep & m
+            outs = []
+            jit_res = [to_column(e.eval_tpu(jb, tctx), jb, dt)
+                       for e, dt in zip(post_traced, jit_dtypes)]
+            for kind, spec in post_specs:
+                if kind == "pass":
+                    outs.append((cols[spec].data, cols[spec].validity))
+                elif kind == "jit":
+                    outs.append((jit_res[spec].data, jit_res[spec].validity))
+                # 'host' outputs gather outside the trace
+            fpi_raw = jnp.where(slot_ok, cpi, -1).astype(jnp.int32)
+            fbi_raw = jnp.where(slot_ok, cbi, -1).astype(jnp.int32)
+            if not post_filters:
+                if not want_indices:
+                    return tuple(outs), n_ok, ()
+                return tuple(outs), n_ok, (fpi_raw, fbi_raw)
+            idx2, n_out = _compact_plan(keep, n_ok)
+            datas = [d for d, _ in outs]
+            valids = [v for _, v in outs]
+            g_d, g_v = _gather_fixed_cols(datas, valids, idx2,
+                                          jnp.int32(n_ok), n_out)
+            if not want_indices:
+                return tuple(zip(g_d, g_v)), n_out, ()
+            # thread the filter compaction through the pair indices so the
+            # host gather sees exactly the surviving pairs, in order
+            ok2 = (idx2 < n_ok) & (jnp.arange(out_cap) < n_out)
+            safe2 = jnp.where(ok2, idx2, 0)
+            fpi = jnp.where(ok2, jnp.take(fpi_raw, safe2), -1)
+            fbi = jnp.where(ok2, jnp.take(fbi_raw, safe2), -1)
+            return tuple(zip(g_d, g_v)), n_out, (fpi, fbi)
+        return fn
+
+    args = (state["counts"], state["lo"], state["order"], state["b_ok"],
+            state["p_ok"], tuple(state["b_vals"]), tuple(state["p_vals"]),
+            state["total"],
+            tuple((probe_cols[o].data, probe_cols[o].validity)
+                  for o in p_ords),
+            tuple((build_cols[o].data, build_cols[o].validity)
+                  for o in b_ords),
+            probe_rows, build_rows)
+    out = _cached_call(key, build, args, eval_ctx, metrics)
+    if out is _FAILED:
+        return None
+    outs, n_out, idxs = out
+    return list(outs), n_out, (tuple(idxs) if idxs else None)
+
+
+# ---------------------------------------------------------------------------
+# fused aggregate stage (execs/aggregates.py): the sort-based grouped
+# aggregate's whole update — key sort, segment boundaries, every measure
+# update + finalization, group-key gather — as ONE launch with a
+# capacity-bucketed group table, so the group count never syncs mid-query
+# ---------------------------------------------------------------------------
+
+
+def agg_stage_program(grouping, agg_fns, batch: TpuColumnarBatch,
+                      eval_ctx: EvalContext, metrics=None):
+    """One launch for the whole grouped-aggregate update (the "fixed-size
+    hash-table" form of partial aggregation: the group table is sized to the
+    batch's capacity bucket — an upper bound on distinct keys — so no
+    phase-boundary n_groups sync is needed; padding groups carry validity
+    False exactly like padding rows). Reuses encode_group_keys /
+    lex_sort_permutation / segment_boundaries / _segment_update /
+    _evaluate_agg, the same code the two-phase aggsort/aggreduce path runs,
+    so results are bit-identical. Returns (key_cols, agg_cols, n_groups_dev)
+    or None when unsupported/pinned (caller runs the two-phase path)."""
+    if not enabled(eval_ctx) or not grouping:
+        return None
+    if not all(_gate_ok(g) for g in grouping) \
+            or not all(_agg_fn_ok(f) for f in agg_fns):
+        return None
+    in_exprs = list(grouping) + [c for f in agg_fns for c in f.children]
+    if not _inputs_ok(in_exprs, batch):
+        return None
+    cap = batch.capacity
+    sig = _input_sig(in_exprs, batch)
+    key = ("aggstage", tuple(_fp(g) for g in grouping),
+           tuple(_fp(f) for f in agg_fns), cap, len(batch.columns), sig,
+           _conf_fp(eval_ctx))
+    src_dtypes = {o: batch.columns[o].dtype for (o, _, _, _) in sig}
+    n_cols = len(batch.columns)
+    grouping = list(grouping)
+    agg_fns = list(agg_fns)
+
+    tctx = _trace_ctx(eval_ctx)
+
+    def build():
+        def fn(*flat):
+            from .aggregates import (_evaluate_agg, _segment_update,
+                                     encode_group_keys, lex_sort_permutation,
+                                     segment_boundaries)
+            n_rows = flat[0]
+            rowmask = jnp.arange(cap) < n_rows
+            tb = _rebuild_batch(flat, sig, src_dtypes, n_cols, cap, rowmask)
+            key_cols = [to_column(g.eval_tpu(tb, tctx), tb, g.dtype)
+                        for g in grouping]
+            enc = encode_group_keys(key_cols, cap, cap)
+            perm = lex_sort_permutation(enc, n_rows, cap)
+            is_new, seg_ids, ng = segment_boundaries(enc, perm, rowmask)
+            outs = []
+            for f in agg_fns:
+                if len(f.children) >= 2:
+                    col = tuple(to_column(c.eval_tpu(tb, tctx), tb, c.dtype)
+                                for c in f.children)
+                elif f.children:
+                    col = to_column(f.children[0].eval_tpu(tb, tctx), tb,
+                                    f.children[0].dtype)
+                else:
+                    col = None
+                st = _segment_update(f, col, seg_ids, cap, cap, n_rows, perm)
+                c = _evaluate_agg(f, st, ng, cap)
+                outs.append((c.data, c.validity))
+            # group keys: first sorted row of each segment
+            first_pos = jnp.zeros((cap,), jnp.int32).at[
+                jnp.where(is_new, seg_ids, cap)].set(
+                jnp.arange(cap, dtype=jnp.int32), mode="drop")
+            key_rows = jnp.take(perm, first_pos)
+            gmask = jnp.arange(cap) < ng
+            keys_out = []
+            for c in key_cols:
+                d = jnp.take(c.data, key_rows, axis=0)
+                v = (jnp.take(c.validity, key_rows) if c.validity is not None
+                     else jnp.ones((cap,), jnp.bool_)) & gmask
+                vb = v[:, None] if d.ndim == 2 else v
+                keys_out.append((jnp.where(vb, d, jnp.zeros((), d.dtype)), v))
+            return tuple(keys_out), tuple(outs), ng
+        return fn
+
+    out = _cached_call(key, build, tuple(_flat_args(batch, sig)),
+                       eval_ctx, metrics)
+    if out is _FAILED:
+        return None
+    keys_out, outs, ng = out
+    key_cols = [TpuColumnVector(g.dtype, d, v, ng)
+                for g, (d, v) in zip(grouping, keys_out)]
+    agg_cols = [TpuColumnVector(agg_out_dtype(f), d, v, ng)
+                for f, (d, v) in zip(agg_fns, outs)]
+    return key_cols, agg_cols, ng

@@ -31,9 +31,14 @@ def encode_sort_keys(cols: List[TpuColumnVector], num_rows: int, capacity: int):
             import pyarrow as pa
             import pyarrow.compute as pc
             arr = c.to_arrow()
-            # arrow ≥25 wants null_placement per sort key
-            ranks = pc.rank(arr, sort_keys=[("", "ascending", "at_end")],
-                            tiebreaker="dense")
+            # arrow ≥25 wants null_placement per sort key; older arrows
+            # only accept an order string plus the kwarg
+            try:
+                ranks = pc.rank(arr, sort_keys=[("", "ascending", "at_end")],
+                                tiebreaker="dense")
+            except (ValueError, TypeError):
+                ranks = pc.rank(arr, sort_keys="ascending",
+                                null_placement="at_end", tiebreaker="dense")
             vals = np.asarray(ranks.to_numpy(zero_copy_only=False)).astype(np.int64)
             buf = np.zeros(capacity, np.int64)
             buf[:num_rows] = vals

@@ -24,6 +24,7 @@ class TpuSemaphore:
         self.permits = permits
         self._sem = threading.BoundedSemaphore(permits)
         self._holders: Dict[int, int] = {}  # task id -> acquire depth
+        self._shared: set = set()  # task ids riding another task's permit
         self._state_lock = threading.Lock()
         self.total_waits_ns = 0
 
@@ -51,6 +52,8 @@ class TpuSemaphore:
         import time
         tid = id(ctx)
         with self._state_lock:
+            if tid in self._shared:
+                return  # rides its group's permit (adopt)
             if tid in self._holders:
                 self._holders[tid] += 1
                 return
@@ -68,9 +71,31 @@ class TpuSemaphore:
             self._holders[tid] = 1
         ctx.add_completion_listener(lambda: self.release_if_necessary(ctx))
 
+    def adopt(self, parent_ctx, child_ctx) -> None:
+        """Batched multi-partition dispatch (spark.rapids.tpu.dispatch.
+        partitionBatch): a partition GROUP is one unit of device work gated
+        by ONE permit, held by the group's context. Member task contexts are
+        adopted so their own acquire_if_necessary calls (scans take a permit
+        per task) become no-ops — G members each blocking for a permit from
+        one pool thread would deadlock the pool against concurrentTpuTasks.
+        The parent must already hold; members release nothing at completion
+        (the parent's completion releases the one real permit)."""
+        ptid, ctid = id(parent_ctx), id(child_ctx)
+        with self._state_lock:
+            if ptid not in self._holders and ptid not in self._shared:
+                return  # parent holds nothing: child acquires normally
+            if ctid in self._holders or ctid in self._shared:
+                return
+            self._shared.add(ctid)
+        child_ctx.add_completion_listener(
+            lambda: self.release_if_necessary(child_ctx))
+
     def release_if_necessary(self, ctx) -> None:
         tid = id(ctx)
         with self._state_lock:
+            if tid in self._shared:
+                self._shared.discard(tid)
+                return  # shared rider: the real permit is the parent's
             if tid not in self._holders:
                 return
             del self._holders[tid]

@@ -548,12 +548,15 @@ class TpuOverrides:
         final = compile_agg_stages(compile_join_agg_stages(final, conf), conf)
         # whole-stage segment fusion for whatever the compiled stages left
         # on the general path (execs/fusion.py): adjacent project/filter
-        # chains collapse into one dispatch per batch
+        # chains — plus an inner-join probe at the segment bottom
+        # (opjit.fuseJoins) and a trailing grouped aggregate at its top
+        # (opjit.fuseAggs) — collapse into one segment between exchanges
         from ..execs.fusion import fuse_stage_segments
         final = fuse_stage_segments(final, conf)
         # batch coalescing (execs/coalesce.py): small batches concatenate up
         # to the batch-size targets ahead of batch-hungry operators — runs
-        # last so fused segments are insertion targets too
+        # last so fused segments are insertion targets too (a segment that
+        # absorbed a join gets require_single on its build children)
         from ..execs.coalesce import insert_coalesce
         return insert_coalesce(final, conf)
 

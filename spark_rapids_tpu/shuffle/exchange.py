@@ -10,7 +10,7 @@ manager and re-uploads to device.
 from __future__ import annotations
 
 import threading
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -19,8 +19,8 @@ from ..config import SHUFFLE_PARTITIONS
 from ..expressions.base import AttributeReference, Expression
 from .manager import TpuShuffleManager
 from .partitioner import (hash_partition_ids, hash_split_parts,
-                          np_hash_partition_ids, round_robin_partition_ids,
-                          split_by_partition)
+                          hash_split_parts_grouped, np_hash_partition_ids,
+                          round_robin_partition_ids, split_by_partition)
 from ..execs.base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all)
 
 
@@ -69,11 +69,18 @@ class _ExchangeBase:
                 return
             self._n_maps = child.num_partitions()
             threads = self._map_task_threads(ctx)
-            if threads > 1 and self._n_maps > 1:
-                self._materialize_maps_pipelined(sid, ctx, mgr, threads)
+            # batched multi-partition dispatch: the unit of scheduling is a
+            # partition GROUP (spark.rapids.tpu.dispatch.partitionBatch);
+            # group size 1 is exactly the PR 2 per-partition behavior
+            group = self._map_group_size(ctx) if self._n_maps > 1 else 1
+            groups = [list(range(s, min(s + group, self._n_maps)))
+                      for s in range(0, self._n_maps, max(1, group))]
+            if threads > 1 and len(groups) > 1:
+                self._materialize_maps_pipelined(sid, ctx, mgr, threads,
+                                                 groups)
             else:
-                for map_id in range(self._n_maps):
-                    self._run_map_guarded(sid, map_id, ctx, mgr)
+                for ids in groups:
+                    self._run_group_guarded(sid, ids, ctx, mgr)
             self._shuffle_id = sid
 
     def _run_map_guarded(self, sid: int, map_id: int, ctx: TaskContext,
@@ -91,8 +98,35 @@ class _ExchangeBase:
 
         with_device_retry(attempt, ctx.conf)
 
+    def _map_group_size(self, ctx: TaskContext) -> int:
+        """How many map partitions one scheduled task processes (batched
+        multi-partition dispatch). 1 — per-partition tasks — except for the
+        TPU exchange in MULTITHREADED mode, which reads
+        spark.rapids.tpu.dispatch.partitionBatch."""
+        return 1
+
+    def _run_group_guarded(self, sid: int, ids: List[int], ctx: TaskContext,
+                           mgr, gate_device: bool = False) -> None:
+        """One partition GROUP as a schedulable unit. Idempotent exactly
+        like a single map task — a retry rewrites every member's block
+        files, keyed (map, reduce) — so the same chaos site and transient
+        device-error retry wrap the whole group."""
+        if len(ids) == 1:
+            self._run_map_guarded(sid, ids[0], ctx, mgr, gate_device)
+            return
+        from ..chaos import inject
+        from ..failure import with_device_retry
+
+        def attempt() -> None:
+            inject("pipeline.task", detail=f"s{sid}g{ids[0]}-{ids[-1]}")
+            self._materialize_map_group(sid, ids, ctx, mgr)
+
+        with_device_retry(attempt, ctx.conf)
+
     def _materialize_maps_pipelined(self, sid: int, ctx: TaskContext, mgr,
-                                    n_threads: int) -> None:
+                                    n_threads: int,
+                                    groups: Optional[List[List[int]]] = None
+                                    ) -> None:
         """Pipelined map-side materialization (reference
         RapidsShuffleThreadedWriterBase): map tasks run concurrently on a
         bounded pool, device work gated per task by the TPU semaphore, and
@@ -113,14 +147,16 @@ class _ExchangeBase:
         for node in self.children[0].collect_nodes():
             if isinstance(node, _ExchangeBase):
                 node._ensure_materialized(ctx)
+        if groups is None:
+            groups = [[m] for m in range(self._n_maps)]
         from concurrent.futures import CancelledError, ThreadPoolExecutor
         pool = ThreadPoolExecutor(
-            max_workers=min(n_threads, self._n_maps),
+            max_workers=min(n_threads, len(groups)),
             thread_name_prefix="exchange-map")
         try:
-            futs = [pool.submit(self._run_map_guarded, sid, m, ctx, mgr,
+            futs = [pool.submit(self._run_group_guarded, sid, ids, ctx, mgr,
                                 True)
-                    for m in range(self._n_maps)]
+                    for ids in groups]
             errors = []
             for f in futs:  # wait for ALL non-cancelled maps: no map task
                 # may still be running when the error propagates
@@ -478,6 +514,157 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             return None
         tables = self._partition_map_task(map_id, map_ctx)
         return lambda: mgr.write_map_output(sid, map_id, tables)
+
+    # --- batched multi-partition dispatch ---------------------------------
+    def _map_group_size(self, ctx: TaskContext) -> int:
+        """Both shuffle modes group: MULTITHREADED defers each member's
+        host commit off the permit as before, ICI commits device-resident
+        blocks to the catalog under the group permit (each member still
+        owns its blocks — lineage recovery re-runs SINGLE maps). The ICI
+        collective path is tried before grouping and wins when eligible."""
+        from ..config import DISPATCH_PARTITION_BATCH
+        try:
+            return max(1, int(ctx.conf.get(DISPATCH_PARTITION_BATCH)))
+        except (TypeError, ValueError):
+            return 1
+
+    def _materialize_map_group(self, sid: int, ids: List[int],
+                               ctx: TaskContext, mgr) -> None:
+        """One map GROUP (spark.rapids.tpu.dispatch.partitionBatch): members
+        pull through the child's multi-partition entry point
+        (execute_partitions — a fused segment runs same-layout member
+        batches as ONE grouped launch) and their hash splits run grouped
+        launches with ONE bounds readback per launch. Block identity is
+        unchanged: each member's tables commit under its own map id, so
+        reduce reads and lineage recovery (which re-runs SINGLE maps via
+        _materialize_map) never observe the grouping."""
+        from ..memory.semaphore import TpuSemaphore
+        from ..profiling import sync_scope
+        # Pre-materialize nested exchanges BEFORE taking the group permit:
+        # the group holds its one permit across the whole member pull, and a
+        # nested exchange materializing inside that window would block on
+        # fresh map contexts waiting for the permit this thread already
+        # holds — a single-thread self-deadlock the pipelined path avoids
+        # the same way. (Grouping can collapse the map side to ONE group,
+        # which routes even pipeline-enabled plans through this serial path.)
+        for node in self.children[0].collect_nodes():
+            if isinstance(node, _ExchangeBase):
+                node._ensure_materialized(ctx)
+        sem = TpuSemaphore.get(ctx.conf)
+        group_ctx = TaskContext(ids[0], ctx.conf)
+        member_ctxs: Dict[int, TaskContext] = {}
+
+        def ctx_of(i: int) -> TaskContext:
+            mc = member_ctxs.get(i)
+            if mc is None:
+                mc = member_ctxs[i] = TaskContext(i, ctx.conf)
+                # members ride the group's one permit: G members blocking
+                # for their own permits from one pool thread would deadlock
+                # the pool against concurrentTpuTasks
+                sem.adopt(group_ctx, mc)
+            return mc
+
+        with sync_scope(self.node_name()):
+            try:
+                # ONE permit for the whole group — the group is one unit of
+                # device work (member batches share grouped launches)
+                sem.acquire_if_necessary(group_ctx)
+                commits = self._run_map_group_task(sid, ids, ctx_of, mgr)
+            finally:
+                for mc in member_ctxs.values():
+                    mc.complete()
+                group_ctx.complete()  # releases the permit
+            for commit in commits:
+                commit()  # host-side file I/O runs OFF the device semaphore
+
+    def _run_map_group_task(self, sid: int, ids: List[int], ctx_of,
+                            mgr) -> List:
+        import pyarrow as pa
+        ici = self._shuffle_mode(ctx_of(ids[0])) == "ICI"
+        if ici:
+            # device-resident sink (reference UCX RapidsCachingWriter):
+            # blocks stay on device and commit to the catalog HERE, under
+            # the group permit (the put IS device work) — no host commit
+            from ..config import SHUFFLE_HEARTBEAT_TIMEOUT_SECONDS
+            from .ici import IciShuffleCatalog, ShuffleHeartbeatManager
+            catalog = IciShuffleCatalog.get()
+            hb = ShuffleHeartbeatManager.get()
+            hb.timeout_s = float(ctx_of(ids[0]).conf.get(
+                SHUFFLE_HEARTBEAT_TIMEOUT_SECONDS))
+            for i in ids:
+                hb.register_peer(f"executor-{i}")
+        n = self._n_out
+        group = len(ids)
+        acc: Dict[int, List[List]] = {i: [[] for _ in range(n)] for i in ids}
+        pending: List[Tuple[int, TpuColumnarBatch]] = []
+
+        def sink(i: int, parts) -> None:
+            if ici:
+                for p, sub in enumerate(parts):
+                    if sub is not None and sub.num_rows:
+                        acc[i][p].append(sub)
+                return
+            with self.metrics["serializationTime"].timed():
+                for p, sub in enumerate(parts):
+                    if sub is not None and sub.num_rows:
+                        acc[i][p].append(sub.to_arrow())
+
+        def flush() -> None:
+            if not pending:
+                return
+            lanes, pending[:] = list(pending), []
+            with self.metrics["partitionTime"].timed():
+                parts_per_lane = None
+                if len(lanes) > 1:
+                    # N lanes' encode+split in ONE launch, ONE bounds
+                    # readback (opjit "exchsplitg")
+                    parts_per_lane = hash_split_parts_grouped(
+                        [b for _, b in lanes], self.keys, n,
+                        ctx_of(lanes[0][0]), metrics=self.metrics)
+                if parts_per_lane is None:  # untraceable keys: per-batch
+                    parts_per_lane = [
+                        hash_split_parts(b, self.keys, n, ctx_of(i),
+                                         metrics=self.metrics)
+                        for i, b in lanes]
+            for (i, _), parts in zip(lanes, parts_per_lane):
+                sink(i, parts)
+
+        for i, batch in self.children[0].execute_partitions(list(ids),
+                                                            ctx_of):
+            if not batch.has_pending_rows and batch.num_rows == 0:
+                continue
+            if self.partitioning == "hash":
+                pending.append((i, batch))
+                if len(pending) >= group:
+                    flush()
+                continue
+            with self.metrics["partitionTime"].timed():
+                if self.partitioning in ("roundrobin", "coalesce"):
+                    pids = round_robin_partition_ids(batch, n, i)
+                    parts = split_by_partition(batch, pids, n)
+                elif self.partitioning == "single":
+                    parts = [batch] + [None] * (n - 1)
+                else:
+                    raise NotImplementedError(self.partitioning)
+            sink(i, parts)
+        flush()
+        if ici:
+            from ..columnar.batch import concat_batches
+            for i in ids:
+                for p, batches in enumerate(acc[i]):
+                    if batches:
+                        blk = batches[0] if len(batches) == 1 \
+                            else concat_batches(batches)
+                        catalog.put_block(sid, i, p, blk,
+                                          owner=f"executor-{i}")
+                catalog.mark_map_complete(sid, i)
+            return []
+        commits = []
+        for i in ids:
+            tables = [pa.concat_tables(a) if a else None for a in acc[i]]
+            commits.append(
+                lambda t=tables, m=i: mgr.write_map_output(sid, m, t))
+        return commits
 
     def internal_do_execute_columnar(self, idx: int, ctx: TaskContext) -> Iterator:
         self._ensure_materialized(ctx)

@@ -82,13 +82,21 @@ def split_with_plan(batch: TpuColumnarBatch, order, bounds_dev,
                     n: int) -> List[Optional[TpuColumnarBatch]]:
     """Slice a batch along an already-computed (order, bounds) split plan
     (from _split_plan or the fused opjit.partition_split_plan program)."""
-    cap = batch.capacity
     try:
         bounds_dev.copy_to_host_async()
     except AttributeError:  # older jax arrays: np.asarray below still works
         pass
     from ..columnar.vector import audited_sync
     bounds = audited_sync(bounds_dev, "bounds")
+    return _slice_split(batch, order, bounds, n)
+
+
+def _slice_split(batch: TpuColumnarBatch, order, bounds,
+                 n: int) -> List[Optional[TpuColumnarBatch]]:
+    """Gather the n partition slices given HOST bounds (the readback already
+    happened — per batch in split_with_plan, or ONE transfer for a whole
+    partition group in hash_split_parts_grouped)."""
+    cap = batch.capacity
     out: List[Optional[TpuColumnarBatch]] = []
     for p in range(n):
         lo, hi = int(bounds[p]), int(bounds[p + 1])
@@ -117,6 +125,34 @@ def hash_split_parts(batch: TpuColumnarBatch, key_exprs: Sequence[Expression],
     pids = hash_partition_ids(batch, key_exprs, n, ctx, seed=seed,
                               metrics=metrics)
     return split_by_partition(batch, pids, n)
+
+
+def hash_split_parts_grouped(batches: Sequence[TpuColumnarBatch],
+                             key_exprs: Sequence[Expression], n: int, ctx,
+                             seed: int = 42, metrics=None
+                             ) -> Optional[List[List[Optional[TpuColumnarBatch]]]]:
+    """Batched multi-partition dispatch of the hash split: N map partitions'
+    batches run their encode+split plans as ONE cached executable
+    (opjit.partition_split_plan_grouped) and ALL lanes' partition bounds come
+    back in ONE device→host transfer — per-lane slices are bit-identical to
+    hash_split_parts. Returns one parts list per input batch, or None when
+    the keys don't trace (callers fall back to the per-batch split)."""
+    from ..execs import opjit
+    plans = opjit.partition_split_plan_grouped(
+        batches, [list(key_exprs)] * len(batches), n, ctx.eval_ctx, seed,
+        metrics)
+    if plans is None:
+        return None
+    orders, bounds_dev = plans
+    for bd in bounds_dev:
+        try:
+            bd.copy_to_host_async()
+        except AttributeError:
+            pass
+    from ..columnar.vector import audited_device_get
+    host_bounds = audited_device_get(bounds_dev, "bounds")
+    return [_slice_split(b, o, hb, n)
+            for b, o, hb in zip(batches, orders, host_bounds)]
 
 
 def np_hash_partition_ids(table, key_exprs, n: int, ctx) -> np.ndarray:

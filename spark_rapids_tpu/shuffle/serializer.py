@@ -18,6 +18,7 @@ FetchFailedError so the exchange re-materializes the producing map task
 
 from __future__ import annotations
 
+import functools
 import io
 import struct
 from typing import List, Optional
@@ -149,9 +150,37 @@ class ZstdCodec(CompressionCodec):
         return zstandard.ZstdDecompressor().decompress(data)
 
 
+def zstd_available() -> bool:
+    """True when some zstd engine exists: the C++ native bridge built, or
+    the python zstandard module importable."""
+    from .. import native_bridge
+    if native_bridge.available():
+        return True
+    import importlib.util
+    return importlib.util.find_spec("zstandard") is not None
+
+
+@functools.lru_cache(maxsize=None)
+def _warn_zstd_unavailable() -> None:
+    import warnings
+    warnings.warn(
+        "zstd requested for shuffle compression but neither the native "
+        "bridge nor the python zstandard module is available; writing "
+        "uncompressed blocks (the frame header records the codec per "
+        "block, so readers are unaffected)")
+
+
 def get_codec(name: str) -> CompressionCodec:
     name = (name or "none").lower()
     if name == "zstd":
+        if not zstd_available():
+            # degrade, don't fail: environments without any zstd engine
+            # (no libzstd headers for the native build, no python module)
+            # still shuffle correctly — each block's header names its own
+            # codec, so uncompressed blocks interleave freely with zstd
+            # ones written by better-equipped processes
+            _warn_zstd_unavailable()
+            return CompressionCodec()
         return ZstdCodec()
     if name in ("none", "copy"):
         return CompressionCodec()
@@ -206,8 +235,14 @@ def deserialize_table(block: bytes):
                 "shuffle block xxhash64 checksum mismatch "
                 f"({payload_len}-byte payload)")
     if codec_id == 1:
-        import zstandard
-        payload = zstandard.ZstdDecompressor().decompress(payload,
-                                                          max_output_size=raw_len)
+        from .. import native_bridge
+        out = (native_bridge.zstd_decompress(payload, raw_len)
+               if native_bridge.available() else None)
+        if out is not None:
+            payload = out
+        else:
+            import zstandard
+            payload = zstandard.ZstdDecompressor().decompress(
+                payload, max_output_size=raw_len)
     with pa.ipc.open_stream(io.BytesIO(payload)) as r:
         return r.read_all()

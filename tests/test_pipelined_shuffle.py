@@ -196,9 +196,12 @@ class _RecordingSource(TpuExec):
 
 
 def _exchange_rows(pipelined: bool):
+    # partitionBatch=1: this test exercises PER-PARTITION pool scheduling
+    # (grouped dispatch would batch all 4 maps into one schedulable unit)
     conf = RapidsConf(_conf(
         spark__rapids__tpu__shuffle__pipeline__enabled=str(pipelined).lower(),
-        spark__rapids__tpu__shuffle__pipeline__mapThreads="4"))
+        spark__rapids__tpu__shuffle__pipeline__mapThreads="4",
+        spark__rapids__tpu__dispatch__partitionBatch="1"))
     src = _RecordingSource([_table(50, m) for m in range(4)])
     exch = TpuShuffleExchangeExec(src, "roundrobin", [], 3)
     out = []

@@ -20,6 +20,46 @@ def test_serializer_roundtrip():
         assert back.equals(t)
 
 
+def test_zstd_codec_degrades_when_unavailable(monkeypatch):
+    """Environments with neither the native bridge nor python zstandard
+    still shuffle: get_codec('zstd') degrades to uncompressed blocks and
+    the per-block codec header keeps readers correct."""
+    import warnings
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.shuffle import serializer
+
+    monkeypatch.setattr(serializer, "zstd_available", lambda: False)
+    serializer._warn_zstd_unavailable.cache_clear()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        codec = serializer.get_codec("zstd")
+    assert codec.name == "none"
+    t = pa.table({"a": [1, 2, None], "s": ["x", None, "zz"]})
+    blk = serializer.serialize_table(t, codec)
+    assert serializer.deserialize_table(blk).equals(t)
+
+
+def test_metric_pickles_across_process_boundary():
+    """Plans (and their metric dicts) ship to executor-pool workers by
+    pickle: the metric lock must not cross, parked lazy scalars fold into
+    the value, and the copy accumulates independently."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.execs.base import TpuMetric
+
+    m = TpuMetric("numOutputRows")
+    m.add(5)
+    m.add_lazy(jnp.asarray(7))
+    back = pickle.loads(pickle.dumps(m))
+    assert (back.name, back.value) == ("numOutputRows", 12)
+    back.add(1)
+    assert back.value == 13 and m.value == 12
+
+
 def test_repartition_preserves_rows():
     gens = [("a", IntegerGen()), ("s", StringGen())]
 

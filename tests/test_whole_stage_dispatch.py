@@ -1,0 +1,407 @@
+"""Whole-stage segments across joins/partial-agg + batched multi-partition
+dispatch (PR 6): a q3-shaped general-path plan must launch O(exchanges)
+programs — join probe/emit and the fused aggregate update as segment stages,
+the exchange map side split per partition GROUP — with results bit-identical
+to every degraded configuration (per-operator join/agg, per-partition
+dispatch, fully eager), including under host-assisted splits and seeded
+chaos."""
+
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs import opjit
+from spark_rapids_tpu.execs.fusion import TpuFusedSegmentExec
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.planner import plan_physical
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    opjit.clear_cache()
+    yield
+    opjit.clear_cache()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_manager():
+    """Fresh shuffle manager: uncompressed codec even when an earlier suite
+    test latched the singleton with zstd (unavailable in some envs)."""
+    import shutil
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    with TpuShuffleManager._lock:
+        old = TpuShuffleManager._instance
+        TpuShuffleManager._instance = None
+    yield
+    with TpuShuffleManager._lock:
+        cur = TpuShuffleManager._instance
+        TpuShuffleManager._instance = old
+    if cur is not None and cur is not old:
+        shutil.rmtree(cur.root, ignore_errors=True)
+
+
+_BASE_CONF = {
+    "spark.rapids.tpu.agg.compiledStage.enabled": "false",
+    "spark.rapids.tpu.join.compiledStage.enabled": "false",
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.sql.shuffle.partitions": "4",
+    "spark.rapids.shuffle.compression.codec": "none",
+}
+
+#: every degraded knob in one place: the PR 5 baseline configuration
+_OFF = {
+    "spark__rapids__tpu__opjit__fuseJoins": "false",
+    "spark__rapids__tpu__opjit__fuseAggs": "false",
+    "spark__rapids__tpu__dispatch__partitionBatch": "1",
+}
+
+
+def _conf(**kv) -> dict:
+    c = dict(_BASE_CONF)
+    c.update({k.replace("__", "."): v for k, v in kv.items()})
+    return c
+
+
+def _kind_delta(before, after) -> dict:
+    b = before["calls_by_kind"]
+    a = after["calls_by_kind"]
+    return {k: a.get(k, 0) - b.get(k, 0) for k in set(a) | set(b)
+            if a.get(k, 0) != b.get(k, 0)}
+
+
+_ORDERS = [{"o_orderkey": i, "o_custkey": i % 7,
+            "o_orderdate": 9000 + i % 60} for i in range(120)]
+_LINEITEM = [{"l_orderkey": i % 120, "l_extendedprice": i * 3 - 50,
+              "l_discount": i % 10, "l_shipdate": 9500 + i % 90}
+             for i in range(600)]
+
+
+def _q3_shaped(s: TpuSession, parts: int = 2):
+    """scan → filter → shuffled inner join → project → groupBy: the shape
+    whose general path the tentpole targets. Integer-exact measures so
+    results are bit-identical under any launch/retry schedule."""
+    li = s.createDataFrame(_LINEITEM, num_partitions=parts)
+    od = s.createDataFrame(_ORDERS, num_partitions=parts)
+    return (li.filter(F.col("l_shipdate") > 9510)
+            .join(od, li["l_orderkey"] == od["o_orderkey"], "inner")
+            .withColumn("revenue",
+                        F.col("l_extendedprice") * (F.lit(100)
+                                                    - F.col("l_discount")))
+            .groupBy("o_orderdate")
+            .agg(F.sum(F.col("revenue")).alias("rev"),
+                 F.count(F.col("l_orderkey")).alias("n"))
+            .sort("o_orderdate"))
+
+
+def _run(conf_kv, collect=None, parts: int = 2):
+    opjit.clear_cache()
+    s = TpuSession(_conf(**conf_kv))
+    q = _q3_shaped(s, parts) if collect is None else collect(s)
+    before = opjit.cache_stats()
+    rows = q.collect()
+    return rows, _kind_delta(before, opjit.cache_stats())
+
+
+# ---------------------------------------------------------------------------
+# plan pass: the join joins the segment, the build side gets require_single
+# ---------------------------------------------------------------------------
+
+
+def _final_plan(q, conf_dict):
+    conf = RapidsConf(conf_dict)
+    return TpuOverrides.apply(plan_physical(q._plan, conf), conf)
+
+
+def test_join_absorbed_into_segment_plan_shape():
+    s = TpuSession(_conf())
+    final = _final_plan(_q3_shaped(s), _conf())
+    segs = [n for n in final.collect_nodes()
+            if isinstance(n, TpuFusedSegmentExec)]
+    join_segs = [g for g in segs if g._has_join]
+    assert join_segs, final.tree_string()
+    seg = join_segs[0]
+    assert seg.build_child_indices  # the build side is a segment child
+    from spark_rapids_tpu.execs.coalesce import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+    for i in seg.build_child_indices:
+        b = seg.children[i]
+        # exchange-fed builds coalesce HOST-side at the reduce read (PR 5);
+        # anything else gets the require_single device coalesce. Either
+        # way _collect_build concats to the ONE batch the probe needs.
+        if isinstance(b, TpuCoalesceBatchesExec):
+            assert b.goal == "require_single", final.tree_string()
+        else:
+            assert isinstance(b, _ExchangeBase), final.tree_string()
+
+
+def test_fuse_joins_off_keeps_join_out_of_segments():
+    c = _conf(spark__rapids__tpu__opjit__fuseJoins="false")
+    s = TpuSession(c)
+    final = _final_plan(_q3_shaped(s), c)
+    assert not [n for n in final.collect_nodes()
+                if isinstance(n, TpuFusedSegmentExec) and n._has_join]
+
+
+# ---------------------------------------------------------------------------
+# dispatch accounting: O(exchanges), not O(operators×partitions×batches)
+# ---------------------------------------------------------------------------
+
+
+def test_q3_shaped_dispatch_kinds_whole_stage():
+    """Fused + partition-batched: the launch log shows ONLY whole-stage
+    kinds — the join runs as probe+emit segment halves, the aggregate as
+    one staged update, the map split grouped — never the per-operator
+    joinenc/aggsort/aggreduce/project kinds it replaces."""
+    rows, delta = _run({})
+    assert rows
+    assert delta.get("joinprobe", 0) >= 1
+    assert delta.get("joinemit", 0) >= 1
+    assert delta.get("aggstage", 0) >= 1
+    assert delta.get("exchsplitg", 0) >= 1
+    for per_op in ("joinenc", "aggsort", "aggreduce", "project",
+                   "exchsplit", "segment"):
+        assert per_op not in delta, delta
+
+
+def test_q3_shaped_dispatch_count_o_exchanges():
+    """The tentpole bound: total launches stay within a small constant per
+    exchange and strictly below the per-operator/per-partition baseline."""
+    on_rows, d_on = _run({})
+    off_rows, d_off = _run(_OFF)
+    assert on_rows == off_rows  # bit-identical across the whole matrix
+    total_on, total_off = sum(d_on.values()), sum(d_off.values())
+    assert total_on < total_off, (d_on, d_off)
+    s = TpuSession(_conf())
+    final = _final_plan(_q3_shaped(s), _conf())
+    from spark_rapids_tpu.shuffle.exchange import _ExchangeBase
+    n_exch = len([n for n in final.collect_nodes()
+                  if isinstance(n, _ExchangeBase)])
+    assert n_exch >= 2
+    # O(exchanges): each exchange boundary contributes a bounded handful of
+    # launches (grouped map split + the consuming segment's probe/emit or
+    # staged-agg update), independent of the operator count above it
+    assert total_on <= 6 * n_exch, (total_on, n_exch, d_on)
+
+
+def test_dispatches_do_not_scale_with_partition_count():
+    """Tripling the MAP partition count must not triple the launch count
+    when partition batching is on (the map side encodes+splits per GROUP);
+    with partitionBatch=1 the per-partition launches scale ~linearly."""
+    def at(parts, extra):
+        _, delta = _run(dict(extra), parts=parts)
+        return sum(delta.values())
+
+    on_2, on_6 = at(2, {}), at(6, {})
+    off_2, off_6 = at(2, _OFF), at(6, _OFF)
+    assert off_6 > off_2  # per-partition dispatch scales with partitions
+    # grouped dispatch absorbs the extra partitions into the same groups
+    assert (on_6 - on_2) < (off_6 - off_2), (on_2, on_6, off_2, off_6)
+
+
+def test_map_group_split_one_launch_per_group():
+    """8 map partitions, partitionBatch=8: the hash encode+split of the
+    whole map side runs as ONE grouped launch per flush instead of 8."""
+    def counts(pbatch):
+        opjit.clear_cache()
+        s = TpuSession(_conf(
+            spark__rapids__tpu__dispatch__partitionBatch=str(pbatch)))
+        rows = [{"k": i % 11, "v": i} for i in range(880)]
+        df = s.createDataFrame(rows, num_partitions=8)
+        before = opjit.cache_stats()
+        out = df.repartition(4, "k").collect()
+        return sorted(map(str, out)), _kind_delta(before,
+                                                  opjit.cache_stats())
+
+    out_g, d_g = counts(8)
+    out_1, d_1 = counts(1)
+    assert out_g == out_1
+    assert d_g.get("exchsplitg", 0) >= 1
+    assert "exchsplitg" not in d_1
+    grouped = d_g.get("exchsplitg", 0) + d_g.get("exchsplit", 0)
+    assert grouped < d_1.get("exchsplit", 0), (d_g, d_1)
+
+
+# ---------------------------------------------------------------------------
+# parity across the toggle matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv", [
+    {},  # everything on (default)
+    {"spark__rapids__tpu__opjit__fuseJoins": "false"},
+    {"spark__rapids__tpu__opjit__fuseAggs": "false"},
+    {"spark__rapids__tpu__dispatch__partitionBatch": "1"},
+    {"spark__rapids__tpu__dispatch__partitionBatch": "3"},
+    _OFF,
+    {"spark__rapids__tpu__opjit__fuseStages": "false"},
+    {"spark__rapids__tpu__opjit__enabled": "false"},
+])
+def test_q3_shaped_parity_across_toggles(kv):
+    base, _ = _run({"spark__rapids__tpu__opjit__enabled": "false"})
+    got, _ = _run(kv)
+    assert got == base
+
+
+def test_parity_deferred_compaction_off():
+    """The fused probe's pair-count sync and the staged agg's group count
+    behave identically when deferred compaction is disabled (every count
+    materializes eagerly)."""
+    base, _ = _run({})
+    got, _ = _run({"spark__rapids__tpu__batch__deferredCompaction__enabled":
+                   "false"})
+    assert got == base
+
+
+def test_compiled_stage_executes_fused_children_and_fallback():
+    """The compiled agg stage pulls through its plan-tree child link and
+    its FALLBACK subtree is rewritten by the fusion/coalesce passes (via a
+    shared id-memo): with near-unique group keys the stage always bails to
+    the fallback, and that rerun must hit the fused join (joinprobe), not
+    the stale pre-fusion operator chain (joinenc)."""
+    def build(s):
+        li = s.createDataFrame(_LINEITEM, num_partitions=2)
+        od = s.createDataFrame(_ORDERS, num_partitions=2)
+        return (li.join(od, li["l_orderkey"] == od["o_orderkey"], "inner")
+                .groupBy("o_orderkey")  # near-unique: stage falls back
+                .agg(F.sum(F.col("l_extendedprice")).alias("sp"))
+                .sort("o_orderkey"))
+    compiled_on = {"spark__rapids__tpu__agg__compiledStage__enabled": "true"}
+    on_rows, delta = _run(compiled_on, collect=build)
+    eager_rows, _ = _run({"spark__rapids__tpu__opjit__enabled": "false"},
+                         collect=build)
+    assert on_rows == eager_rows
+    assert "joinenc" not in delta, delta
+    assert delta.get("joinprobe", 0) >= 1
+
+    from spark_rapids_tpu.execs.compiled import TpuCompiledAggStageExec
+    c = _conf(**compiled_on)
+    s = TpuSession(c)
+    final = _final_plan(build(s), c)
+    stages = [n for n in final.collect_nodes()
+              if isinstance(n, TpuCompiledAggStageExec)]
+    if stages:  # the pass compiled the stage: its fallback must be fused
+        assert any(isinstance(n, TpuFusedSegmentExec)
+                   for n in stages[0].fallback.collect_nodes()), \
+            stages[0].fallback.tree_string()
+
+
+def test_left_join_delegates_with_identical_results():
+    """Non-inner joins stay on the original operator (the fusion pass never
+    absorbs them) — same results, no joinprobe launches."""
+    def build(s):
+        li = s.createDataFrame(_LINEITEM, num_partitions=2)
+        od = s.createDataFrame(_ORDERS, num_partitions=2)
+        return (li.join(od, li["l_orderkey"] == od["o_orderkey"], "left")
+                .groupBy("o_orderdate")
+                .agg(F.count(F.col("l_orderkey")).alias("n"))
+                .sort("o_orderdate"))
+    on_rows, delta = _run({}, collect=build)
+    off_rows, _ = _run({"spark__rapids__tpu__opjit__enabled": "false"},
+                       collect=build)
+    assert on_rows == off_rows
+    assert "joinprobe" not in delta
+
+
+# ---------------------------------------------------------------------------
+# host-assisted split inside a join segment
+# ---------------------------------------------------------------------------
+
+
+def test_host_assisted_op_between_join_and_agg_splits_segment():
+    """A host-assisted op (format_number: numeric → string via host) in the
+    chain above the join: the join probe still fuses — the flatten breaks
+    BEFORE the host-assisted projection, whose output never enters the
+    traced gather — the op degrades per-operator, and the results match
+    the fully-eager run bit-for-bit."""
+    def build(s):
+        li = s.createDataFrame(_LINEITEM, num_partitions=2)
+        od = s.createDataFrame(_ORDERS, num_partitions=2)
+        return (li.join(od, li["l_orderkey"] == od["o_orderkey"], "inner")
+                .withColumn("x", F.col("l_extendedprice") * 2)
+                .withColumn("tag", F.format_number(F.col("x"), 0))
+                .select("o_orderdate", "x", "tag"))
+
+    def key(r):
+        return (r["o_orderdate"], r["x"], r["tag"])
+    on_rows, delta = _run({}, collect=build)
+    eager_rows, _ = _run({"spark__rapids__tpu__opjit__enabled": "false"},
+                         collect=build)
+    assert sorted(on_rows, key=key) == sorted(eager_rows, key=key)
+    assert delta.get("joinprobe", 0) >= 1  # the probe half still fused
+
+
+# ---------------------------------------------------------------------------
+# sync ledger: fused never syncs more than per-operator
+# ---------------------------------------------------------------------------
+
+
+def test_sync_ledger_fused_not_worse_than_per_operator():
+    from spark_rapids_tpu.profiling import SyncLedger
+
+    def total(kv):
+        opjit.clear_cache()
+        SyncLedger.reset_for_tests()
+        s = TpuSession(_conf(**kv))
+        _q3_shaped(s).collect()
+        return SyncLedger.get().total()
+
+    assert total({}) <= total(_OFF)
+
+
+# ---------------------------------------------------------------------------
+# chaos-soak parity: whole-stage + grouped dispatch under fault injection
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [17, 404])
+def test_chaos_soak_whole_stage_parity(seed):
+    from spark_rapids_tpu.chaos import FaultInjector
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    FaultInjector.reset_for_tests()
+    TpuSemaphore.reset_for_tests()
+    try:
+        clean, _ = _run({})
+        chaos_kv = {
+            "spark__rapids__tpu__test__chaos__enabled": "true",
+            "spark__rapids__tpu__test__chaos__seed": str(seed),
+            "spark__rapids__tpu__test__chaos__kinds":
+                "transient,latency,corrupt",
+            "spark__rapids__tpu__test__chaos__probability": "0.12",
+            "spark__rapids__tpu__deviceRetry__maxAttempts": "8",
+            "spark__rapids__tpu__deviceRetry__backoffBaseMs": "1",
+            "spark__rapids__tpu__deviceRetry__backoffMaxMs": "4",
+            "spark__rapids__tpu__shuffle__fetchRetry__maxAttempts": "8",
+        }
+        got, _ = _run(chaos_kv)
+        assert got == clean
+        assert FaultInjector.get().injection_count() > 0
+        sem = TpuSemaphore._instance
+        if sem is not None:  # every permit returned (adopt() releases clean)
+            assert sem._sem._value == sem.permits
+    finally:
+        FaultInjector.reset_for_tests()
+        TpuSemaphore.reset_for_tests()
+
+
+def test_pipelined_group_scheduling_no_permit_leak():
+    """mapThreads>1 × partitionBatch>1: partition groups are the pool's
+    schedulable unit; member contexts ride the group permit (adopt) and the
+    pool must neither deadlock nor leak permits."""
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    TpuSemaphore.reset_for_tests()
+    try:
+        opjit.clear_cache()
+        s = TpuSession(_conf(
+            spark__rapids__tpu__shuffle__pipeline__mapThreads="4",
+            spark__rapids__tpu__dispatch__partitionBatch="3"))
+        rows = [{"k": i % 5, "v": i} for i in range(900)]
+        df = s.createDataFrame(rows, num_partitions=6)
+        out = (df.repartition(4, "k").groupBy("k")
+               .agg(F.sum(F.col("v")).alias("sv")).sort("k").collect())
+        assert len(out) == 5
+        sem = TpuSemaphore._instance
+        if sem is not None:
+            assert sem._sem._value == sem.permits
+    finally:
+        TpuSemaphore.reset_for_tests()
