@@ -42,6 +42,7 @@ our_speedup / 3.8 (>1.0 beats the reference's headline ratio).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -250,6 +251,41 @@ def _kernel_hash_partition(n: int) -> dict:
     }
 
 
+_TRACE_DIR = os.environ.get(
+    "BENCH_TRACE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_artifacts"))
+
+
+def _trace_artifacts(s, run_once, tag: str) -> dict:
+    """One EXTRA run with the query timeline tracer armed
+    (docs/observability.md), AFTER the timed iterations so measured numbers
+    stay untraced. Emits the stage's Chrome trace + diagnostics bundle
+    under BENCH_TRACE_DIR (default ./bench_artifacts) and returns the
+    artifact paths plus the bundle's reconciliation verdict — the bundle's
+    per-operator dispatch+sync counts must reconcile with the opjit
+    calls_by_kind delta and the SyncLedger delta for the same run."""
+    s.conf.set("spark.rapids.tpu.trace.enabled", "true")
+    s.conf.set("spark.rapids.tpu.trace.dir", _TRACE_DIR)
+    s.conf.set("spark.rapids.tpu.trace.tag", tag)
+    try:
+        run_once()
+        p = s.last_query_profile() or {}
+    except Exception as e:  # noqa: BLE001 — the artifact run must not
+        # invalidate the already-recorded timings
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        s.conf.set("spark.rapids.tpu.trace.enabled", "false")
+    return {
+        "artifacts": p.get("artifacts"),
+        "reconcile": p.get("reconcile"),
+        "dispatches_by_kind": p.get("dispatches_by_kind"),
+        "sync_events_total": p.get("sync_events_total"),
+        "traced_duration_ms": p.get("duration_ms"),
+        "dropped_events": p.get("dropped_events"),
+    }
+
+
 def _lineitem_table(n: int):
     """Q1-shaped lineitem columns (strings for the group keys, like TPC-H)."""
     import pyarrow as pa
@@ -297,10 +333,12 @@ def _framework_q1(table) -> dict:
     rows = q.collect()  # warm: compiles the stage, memoizes dictionaries
     assert rows, "q1 returned nothing"
     sec = _time_best(lambda: q.collect(), iters=5)
-    return {"sec": sec, "compiled": "TpuCompiledAggStage" in plan}
+    prof = _trace_artifacts(s, lambda: q.collect(), "q1_framework")
+    return {"sec": sec, "compiled": "TpuCompiledAggStage" in plan,
+            "profile": prof}
 
 
-def _framework_q6(table) -> float:
+def _framework_q6(table) -> dict:
     import spark_rapids_tpu.functions as F
     from spark_rapids_tpu.session import TpuSession
     s = TpuSession({"spark.rapids.sql.batchSizeRows": str(table.num_rows)})
@@ -313,11 +351,14 @@ def _framework_q6(table) -> float:
          .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
               .alias("revenue")))
     q.collect()
-    return _time_best(lambda: q.collect(), iters=5)
+    sec = _time_best(lambda: q.collect(), iters=5)
+    return {"sec": sec,
+            "profile": _trace_artifacts(s, lambda: q.collect(),
+                                        "q6_framework")}
 
 
 def _framework_q3(rows: int, partitions: int, compiled: bool = True,
-                  extra_conf: dict = None) -> dict:
+                  extra_conf: dict = None, trace_tag: str = None) -> dict:
     """TPC-H q3: scan → two joins → groupBy → topN, the flagship
     multi-operator path. With the compiled join stage
     (execs/compiled_join.py) the whole probe-chain+aggregation runs as ONE
@@ -350,15 +391,35 @@ def _framework_q3(rows: int, partitions: int, compiled: bool = True,
     # fixed cost each): ONE timed iteration keeps bench wall time sane;
     # the compiled stage is a handful of launches: best-of-3
     sec = _time_best(lambda: q.to_arrow(), iters=3 if compiled else 1)
+    # counter snapshot BEFORE the extra traced run: callers bracketing
+    # dispatch/sync deltas (q3_general's accounting story) must see the
+    # warm+timed runs only, not the artifact run appended below
+    from spark_rapids_tpu.execs import opjit
+    from spark_rapids_tpu.profiling import SyncLedger
+    counters = {"opjit": opjit.cache_stats(),
+                "sync_totals": SyncLedger.get().totals_by_op()}
+    prof = _trace_artifacts(s, lambda: q.to_arrow(), trace_tag) \
+        if trace_tag else None
     return {"sec": sec, "rows_out": out.num_rows, "lineitem_rows": rows,
             "partitions": partitions,
-            "compiled_join_stage": "TpuCompiledJoinAggStage" in plan}
+            "compiled_join_stage": "TpuCompiledJoinAggStage" in plan,
+            "counters_after_timed": counters, "profile": prof}
 
 
 def _num(x):
     """The measured value if the stage produced one, else None ("invalid"
     markers and absent stages never leak into arithmetic)."""
     return x if isinstance(x, (int, float)) else None
+
+
+def _reconciled(trace: dict):
+    """Whether a stage's diagnostics bundle reconciled with the dispatch
+    and sync ground-truth counters (None when the stage produced none)."""
+    rec = (trace or {}).get("reconcile")
+    if not isinstance(rec, dict):
+        return None
+    return bool(rec.get("dispatch_ok", True) and rec.get("sync_ok", True)
+                and not rec.get("overflow"))
 
 
 def _ratio(a, b, digits: int = 3):
@@ -403,8 +464,7 @@ def _cpu_q1(table) -> float:
     return _time_best(run, iters=3)
 
 
-_SOFT_BUDGET_S = float(__import__("os").environ.get("BENCH_SOFT_BUDGET_S",
-                                                    "600"))
+_SOFT_BUDGET_S = float(os.environ.get("BENCH_SOFT_BUDGET_S", "600"))
 
 
 def main() -> None:
@@ -445,7 +505,12 @@ def main() -> None:
                  "8part_nocoalesce the coalescing-off baseline on the same "
                  "rows; stage_elapsed_s attributes the budget). Datagen is "
                  "process-stable from r04 (crc32 streams), so q3 numbers "
-                 "compare across rounds"),
+                 "compare across rounds. Each query stage additionally "
+                 "runs ONCE traced (after its timed iterations, so the "
+                 "timings stay untraced) and ships a Chrome trace + "
+                 "diagnostics bundle under trace_dir whose per-operator "
+                 "dispatch+sync counts reconcile with calls_by_kind and "
+                 "the SyncLedger (docs/observability.md)"),
     }
     headline = {"value": None, "vs_baseline": None}
 
@@ -535,6 +600,7 @@ def main() -> None:
         "wall_minus_dispatch_ms": (round(
             max(fw["sec"] - overhead_s, 0) * 1e3, 2)
             if overhead_ms is not None else None),
+        "trace": fw.get("profile"),
     }
     emit()  # ---- headline is now on stdout, whatever happens later ----
 
@@ -576,10 +642,14 @@ def main() -> None:
                          "8" if pbatch else "1"}
             before = opjit.cache_stats()
             syncs_before = SyncLedger.get().totals_by_op()
-            g = _framework_q3(1 << 18, parts, compiled=False,
-                              extra_conf=extra)
-            after = opjit.cache_stats()
-            syncs_after = SyncLedger.get().totals_by_op()
+            g = _framework_q3(
+                1 << 18, parts, compiled=False, extra_conf=extra,
+                trace_tag=f"q3_general_{tag or f'{parts}part'}")
+            # after-snapshots taken INSIDE _framework_q3 before its traced
+            # artifact run, so the deltas cover warm+timed only (keeping
+            # them comparable with r03–r05 rounds)
+            after = g["counters_after_timed"]["opjit"]
+            syncs_after = g["counters_after_timed"]["sync_totals"]
             kinds = {
                 k: after["calls_by_kind"].get(k, 0)
                 - before["calls_by_kind"].get(k, 0)
@@ -611,6 +681,10 @@ def main() -> None:
                 "syncsPerPartition": round(
                     sum(syncs.values()) / max(parts, 1), 1),
                 "opjit_cache_len": opjit.cache_len(),
+                # timeline artifacts from one extra traced run (untimed):
+                # the Chrome trace + diagnostics bundle per stage, with the
+                # bundle's reconciliation against calls_by_kind + SyncLedger
+                "trace": g.get("profile"),
             }
             emit()
         return run
@@ -655,19 +729,21 @@ def main() -> None:
     stage("kernel_hash_partition", _hp)
 
     def _q6():
-        q6_s = _framework_q6(table)
-        detail["q6_framework_ms"] = round(q6_s * 1e3, 2)
+        q6 = _framework_q6(table)
+        detail["q6_framework_ms"] = round(q6["sec"] * 1e3, 2)
+        detail["q6_trace"] = q6.get("profile")
         emit()
     stage("q6_framework_ms", _q6)
 
     def _q3_compiled():
-        q3 = _framework_q3(1 << 22, 8)
+        q3 = _framework_q3(1 << 22, 8, trace_tag="q3_compiled")
         detail["q3_compiled"] = {
             "wall_ms": round(q3["sec"] * 1e3, 2),
             "lineitem_rows": q3["lineitem_rows"],
             "rows_out": q3["rows_out"],
             "Mrows_per_s": round(q3["lineitem_rows"] / q3["sec"] / 1e6, 2),
             "compiled_join_stage": q3["compiled_join_stage"],
+            "trace": q3.get("profile"),
         }
         emit()
     stage("q3_compiled", _q3_compiled)
@@ -730,6 +806,15 @@ def main() -> None:
             "q3_general_dispatches_nojoinagg": base.get("dispatchesTotal"),
             "q3_general_by_kind": g8.get("opJitDispatchesByKind"),
             "q3_general_blocking_syncs": g8.get("blockingSyncs"),
+            # per-stage Chrome traces + diagnostics bundles live under
+            # trace_dir (one extra untimed traced run per query stage);
+            # reconciled == each bundle's per-operator dispatch+sync counts
+            # match the calls_by_kind and SyncLedger deltas for that run
+            "trace_dir": _TRACE_DIR,
+            "q3_general_bundle": ((g8.get("trace") or {}).get("artifacts")
+                                  or {}).get("bundle"),
+            "q3_general_reconciled": _reconciled(g8.get("trace")),
+            "q3_compiled_reconciled": _reconciled(q3c.get("trace")),
             "elapsed_s": detail.get("elapsed_s"),
             "complete": detail["complete"],
             "skipped_or_failed": skipped or None,

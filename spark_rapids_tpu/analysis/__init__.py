@@ -8,6 +8,9 @@ Public surface for tools/tracelint.py, tools/gen_docs.py and the tests:
   (TL010).
 * :func:`lint_sync_tree` — blocking device→host syncs outside the audited
   ledger gate in execs/ and shuffle/ (TL011).
+* :func:`lint_obs_tree` — span/event emission discipline in execs/,
+  shuffle/ and memory/: route through the obs API, never sync inside an
+  event argument (TL012).
 * :func:`corroborate` — dynamic ``jax.eval_shape`` probe vs the static
   verdicts (TL005).
 * :func:`scan_source` / :func:`scan_function` — detector layer over raw
@@ -22,6 +25,7 @@ from .astwalk import (CONDITIONAL_HOST, DEVICE, HOST, UNTRACEABLE, Detection,
                       FunctionReport, ModuleIndex, worst)
 from .concurrency import lint_module_source, lint_tree
 from .detectors import DETECTOR_IDS, scan_function, scan_source
+from .obslint import lint_obs_module, lint_obs_tree
 from .registry_check import (ExprReport, Finding, analyze_registry,
                              classify_class, execution_modes)
 from .syncs import lint_sync_module, lint_sync_tree
@@ -30,7 +34,8 @@ __all__ = [
     "CONDITIONAL_HOST", "DEVICE", "HOST", "UNTRACEABLE", "Detection",
     "DETECTOR_IDS", "ExprReport", "Finding", "FunctionReport", "ModuleIndex",
     "analyze_registry", "classify_class", "corroborate", "execution_modes",
-    "lint_module_source", "lint_sync_module", "lint_sync_tree", "lint_tree",
+    "lint_module_source", "lint_obs_module", "lint_obs_tree",
+    "lint_sync_module", "lint_sync_tree", "lint_tree",
     "scan_function", "scan_source", "worst",
 ]
 

@@ -1,0 +1,217 @@
+"""Observability lint (rule **TL012**): span/event emission discipline.
+
+The obs layer (docs/observability.md) is only trustworthy if engine code
+follows two rules, checked statically here over ``execs/``, ``shuffle/``
+and ``memory/``:
+
+1. **Route through the obs API.** Emission sites must use the public
+   helpers (``obs.span`` / ``obs.event`` / ``obs.current_span``) — not the
+   tracer internals (``QueryTracer``, ``_Span``, the ring-buffer
+   ``_append``) and not raw ``jax.profiler`` annotations (those belong in
+   profiling.py's ``trace_scope``, which carries the off-fast-path). A
+   bypass would skip the ``_ACTIVE`` gate (overhead when tracing is off),
+   the category filter, and the thread-local span stacks (corrupting the
+   tree for every later span on that thread).
+
+2. **Instrumentation must not introduce unaudited blocking syncs.** A
+   span/event ARGUMENT that forces a device value to host
+   (``np.asarray(...)``, ``.item()``, ``jax.device_get(...)``, or
+   ``int()``/``float()`` of a jnp expression) is a hidden ~100 ms round
+   trip through the tunnel that fires exactly when someone turns tracing
+   on — the observer would perturb the observed, and the sync would bypass
+   the audited ledger gate (TL011's contract). Event args must be values
+   the caller already has on host.
+
+Both are errors; the baseline stays EMPTY — our own instrumentation
+complies, and new emission sites must too.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Tuple
+
+from .registry_check import Finding
+
+#: packages the lint covers (relative to the spark_rapids_tpu package root)
+OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory")
+
+#: names that count as obs emission entry points when bound from the obs
+#: package (rule 2 scans their call arguments)
+_EMIT_NAMES = ("span", "event")
+
+#: tracer internals whose use outside obs/ is a rule-1 finding
+_INTERNAL_NAMES = ("QueryTracer", "_Span", "_NullSpan")
+_INTERNAL_ATTRS = ("_append", "_alloc_span", "_ring")
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('jax.profiler.start_trace',
+    'obs.event', ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_blocking_call(call: ast.Call) -> Optional[str]:
+    """The blocking-sync shapes of TL011, syntactically: raw transfer calls
+    plus int()/float() coercion of a jnp/jax expression."""
+    name = _dotted(call.func)
+    if name.endswith(("np.asarray", "numpy.asarray", "np.array",
+                      "numpy.array")):
+        return name
+    if name in ("jax.device_get", "device_get") \
+            or name.endswith(".device_get"):
+        return name
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
+            and not call.args:
+        return _dotted(call.func)
+    if name in ("int", "float") and call.args:
+        inner = _dotted(call.args[0].func) if isinstance(
+            call.args[0], ast.Call) else _dotted(call.args[0])
+        if inner.startswith(("jnp.", "jax.")):
+            return f"{name}({inner})"
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.stack: List[str] = []
+        self.obs_modules: set = set()   # names bound to the obs pkg/tracer
+        self.obs_helpers: set = set()   # emission helpers imported by name
+        self.hits: List[Tuple[str, int, str]] = []  # (qual, line, msg)
+
+    # --- import tracking ---------------------------------------------------
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if mod.endswith("obs") or ".obs." in f".{mod}." or \
+                mod.endswith("obs.tracer"):
+            for a in node.names:
+                bound = a.asname or a.name
+                if a.name in _EMIT_NAMES:
+                    self.obs_helpers.add(bound)
+                elif a.name in ("tracer",) or a.name == "obs":
+                    self.obs_modules.add(bound)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name.endswith(".obs") or a.name.endswith(".obs.tracer"):
+                self.obs_modules.add(a.asname or a.name.split(".")[-1])
+        self.generic_visit(node)
+
+    # --- qualname tracking --------------------------------------------------
+    def _qual(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    # --- the rules -----------------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        name = _dotted(node)
+        if name.startswith("jax.profiler."):
+            self.hits.append((
+                self._qual(), node.lineno,
+                f"raw jax.profiler use ({name}) — emission sites route "
+                f"through the obs API (obs.span/obs.event) or "
+                f"profiling.trace_scope, which carry the tracing-off "
+                f"fast path"))
+        elif node.attr in _INTERNAL_ATTRS and self._is_obs_value(node.value):
+            self.hits.append((
+                self._qual(), node.lineno,
+                f"tracer internal ({name}) — use the public obs helpers; "
+                f"bypassing them skips the _ACTIVE gate and the "
+                f"thread-local span stacks"))
+        self.generic_visit(node)
+
+    def _is_obs_value(self, node: ast.AST) -> bool:
+        name = _dotted(node)
+        head = name.split(".")[0]
+        return head in self.obs_modules or "QueryTracer" in name
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in _INTERNAL_NAMES and isinstance(node.ctx, ast.Load):
+            self.hits.append((
+                self._qual(), node.lineno,
+                f"tracer internal ({node.id}) — construct spans/events "
+                f"through the public obs helpers only"))
+        self.generic_visit(node)
+
+    def _is_emit_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id in self.obs_helpers
+        if isinstance(f, ast.Attribute) and f.attr in _EMIT_NAMES:
+            return self._is_obs_value(f.value)
+        return False
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_emit_call(node):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call):
+                        blocked = _is_blocking_call(sub)
+                        if blocked:
+                            self.hits.append((
+                                self._qual(), sub.lineno,
+                                f"blocking device→host sync ({blocked}) "
+                                f"inside a span/event argument — "
+                                f"instrumentation must not sync; pass a "
+                                f"value the caller already holds on host"))
+        self.generic_visit(node)
+
+
+def lint_obs_module(source: str, relpath: str) -> List[Finding]:
+    """TL012 findings for one module's source."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    v = _Visitor(relpath)
+    v.visit(tree)
+    findings: List[Finding] = []
+    seen = set()
+    for qual, line, msg in v.hits:
+        key = f"{relpath}::{qual}"
+        if (key, msg) in seen:
+            continue
+        seen.add((key, msg))
+        findings.append(Finding("TL012", "error", key,
+                                f"{msg} (line {line})"))
+    return findings
+
+
+def lint_obs_tree(root: Optional[str] = None,
+                  subpackages: Tuple[str, ...] = OBS_SUBPACKAGES
+                  ) -> List[Finding]:
+    """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    for sub in subpackages:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(d, fname)) as f:
+                src = f.read()
+            findings.extend(lint_obs_module(src, f"{sub}/{fname}"))
+    return findings

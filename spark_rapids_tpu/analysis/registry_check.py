@@ -51,7 +51,7 @@ _EVAL_METHODS = ("eval_tpu", "_compute", "_dec128_eval")
 
 @dataclass
 class Finding:
-    rule: str        # TL001..TL005 / TL010
+    rule: str        # TL001..TL005 / TL010..TL012
     severity: str    # "error" | "warning" | "info"
     location: str    # "expressions/strings.py::Upper"
     message: str

@@ -312,6 +312,7 @@ class FaultInjector:
                 return
             self._trace.append(_Record(site, seq, kind,
                                        detail=detail, forced=forced))
+        self._obs_event(site, seq, kind, detail, forced)
         self._raise(site, kind, delay)
 
     def mangle(self, site: str, data: bytes) -> bytes:
@@ -336,10 +337,23 @@ class FaultInjector:
             self._trace.append(_Record(
                 site, seq, kind, detail=f"@{offset}/{len(data)}",
                 forced=forced))
+        self._obs_event(site, seq, kind, f"@{offset}/{len(data)}", forced)
         if kind == "truncate":
             return data[:offset]
         return data[:offset] + bytes([data[offset] ^ 0x5A]) \
             + data[offset + 1:]
+
+    @staticmethod
+    def _obs_event(site: str, seq: int, kind: str, detail: str,
+                   forced: bool) -> None:
+        """Mirror one injection into the query timeline: the event fires
+        inside whatever span the injection interrupted (the failing map
+        task / operator pull), so the trace shows the fault exactly where
+        it struck — next to the device.retry event that healed it."""
+        from ..obs import tracer as _obs
+        if _obs._ACTIVE:
+            _obs.event("chaos", cat="chaos", site=site, seq=seq, kind=kind,
+                       detail=detail, forced=forced)
 
     def _raise(self, site: str, kind: str, delay: float) -> None:
         if kind == "latency":

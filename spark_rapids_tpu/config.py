@@ -319,6 +319,20 @@ split bounds, `pairs` — join pair count, `chars` — string gather sizing,
   still deferred (`add_lazy`) and materialize at metric read time (query
   end), so metric bookkeeping itself never forces a sync.
 
+## Query timeline tracing
+
+`spark.rapids.tpu.trace.enabled` arms the query-scoped span/event tracer
+(`spark_rapids_tpu/obs/`): one ring-buffered, thread-aware record per query
+tying every operator's time to its dispatches, blocking syncs, HBM
+allocations/spills/semaphore waits, shuffle map/reduce/fetch-retries,
+transient-error retries and chaos injections. Three views export from the
+same record: a Chrome trace (perfetto / `chrome://tracing`),
+`session.explain("metrics")` (the executed plan annotated per node with its
+actual metrics, dispatch and sync counts), and the machine-readable
+diagnostics bundle `session.last_query_profile()` whose per-operator counts
+reconcile against `calls_by_kind` and the sync ledger. See
+docs/observability.md for the span model, event taxonomy and bundle schema.
+
 ## Robustness
 
 Batch-level work survives memory pressure via spill + retry/split
@@ -833,6 +847,48 @@ METRICS_LEVEL = _conf("spark.rapids.sql.metrics.level").doc(
 PROFILE_PATH_PREFIX = _conf("spark.rapids.profile.pathPrefix").doc(
     "If set, write jax profiler traces for task execution under this path "
     "(reference spark.rapids.profile.* CUPTI profiler)."
+).string(None)
+
+TRACE_ENABLED = _conf("spark.rapids.tpu.trace.enabled").doc(
+    "Query timeline tracing (docs/observability.md): record a span tree "
+    "per query — query → partition task → operator → shuffle map task — "
+    "with instant events for opjit/compiled dispatches (kind + cache "
+    "hit/miss), audited device→host syncs, HBM alloc/spill/semaphore "
+    "waits, shuffle map/reduce/fetch-retry, transient device-error "
+    "retries, and chaos injections. Exported as Chrome trace-event JSON "
+    "(perfetto-loadable), session.explain(\"metrics\"), and the "
+    "session.last_query_profile() diagnostics bundle. Near-zero overhead "
+    "when off (a module-flag check per site)."
+).commonly_used().boolean(False)
+
+TRACE_BUFFER_EVENTS = _conf("spark.rapids.tpu.trace.bufferEvents").doc(
+    "Ring-buffer capacity of the query tracer in records (one span costs "
+    "two records, one instant event one). On overflow the oldest records "
+    "are overwritten and the diagnostics bundle reports the drop count "
+    "(its reconciliation downgrades to 'overflow' instead of disagreeing "
+    "silently)."
+).integer(262144)
+
+TRACE_CATEGORIES = _conf("spark.rapids.tpu.trace.categories").doc(
+    "Comma-separated event/span categories to record (op, task, dispatch, "
+    "sync, memory, shuffle, shuffle.map, retry, chaos); empty records "
+    "everything. Note that filtering out 'dispatch' or 'sync' makes the "
+    "bundle's reconciliation against calls_by_kind / the SyncLedger "
+    "report a mismatch by construction."
+).string_list([])
+
+TRACE_TAG = _conf("spark.rapids.tpu.trace.tag").doc(
+    "Stem prefix for traced-query names and their artifact files "
+    "(<tag>-<n>.trace.json instead of query-<n>.trace.json) — bench.py "
+    "tags each stage so artifacts from different stages never collide."
+).string(None)
+
+TRACE_DIR = _conf("spark.rapids.tpu.trace.dir").doc(
+    "When set (and tracing is enabled), every traced query writes its "
+    "Chrome trace (<query>.trace.json) and diagnostics bundle "
+    "(<query>.profile.json) under this directory; the paths are recorded "
+    "in last_query_profile()['artifacts']. bench.py points this at its "
+    "artifact directory so each stage ships a loadable trace."
 ).string(None)
 
 TEST_RETRY_OOM_INJECTION = _conf("spark.rapids.memory.tpu.state.debug.retryOomInjection").doc(

@@ -229,13 +229,16 @@ class TpuExec(PhysicalPlan):
     def execute_partition(self, idx: int, ctx: TaskContext) -> Iterator:
         from .. import profiling
         from ..config import DEBUG_DUMP_PATH
+        from ..obs import tracer as obs
         out_rows = self.metrics["numOutputRows"]
         out_batches = self.metrics["numOutputBatches"]
         dump = ctx.conf.get(DEBUG_DUMP_PATH)
         keep_last = bool(dump)
         self._last_batch = None  # don't attribute a prior partition's batch
         it = self.internal_do_execute_columnar(idx, ctx)
-        tracing = profiling._PROFILING_ACTIVE
+        # the query tracer (obs) rides the same slow path as xprof tracing:
+        # the untraced hot loop below stays free of per-batch span setup
+        tracing = profiling._PROFILING_ACTIVE or obs._ACTIVE
         name = self.node_name()
         if not (tracing or keep_last):
             # hot path: each pull runs under this operator's sync-ledger
@@ -255,7 +258,11 @@ class TpuExec(PhysicalPlan):
         while True:
             # NVTX-range analogue: each batch pull is one named scope in the
             # xprof timeline (reference NvtxWithMetrics around operator work)
-            with profiling.trace_scope(name), profiling.sync_scope(name):
+            # AND one operator span in the obs query timeline — upstream
+            # operators' pulls run inside this generator frame on the same
+            # thread stack, so the span tree nests exactly like the plan
+            with profiling.trace_scope(name), profiling.sync_scope(name), \
+                    obs.span(name, cat="op", partition=idx):
                 try:
                     batch = next(it)
                 except StopIteration:

@@ -1217,6 +1217,11 @@ class TpuCompiledJoinAggStageExec(TpuExec):
         # transient retry (the stage fn is pure over its device inputs)
         from ..chaos import inject
         from ..failure import with_device_retry
+        from ..obs import tracer as _obs
+
+        if _obs._ACTIVE:
+            _obs.event("dispatch", cat="dispatch", kind="compiledjoin",
+                       source="compiled")
 
         def dispatch():
             inject("device.dispatch", detail="compiled_join_stage")

@@ -51,6 +51,7 @@ from ..columnar.vector import TpuColumnVector, device_layout_ok
 from ..config import OPJIT_CACHE_SIZE, OPJIT_ENABLED
 from ..expressions.base import (Alias, AttributeReference, EvalContext,
                                 Expression, Literal, to_column)
+from ..obs import tracer as _obs
 from ..types import (DataType, DecimalType, DoubleT, IntegerT, LongT,
                      NullType, StringType, is_fixed_width)
 
@@ -165,6 +166,11 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
         with _LOCK:
             _STATS["hits"] += 1
             _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
+        # one timeline event per program dispatch, recorded exactly where
+        # calls_by_kind increments so the two counters reconcile per query
+        if _obs._ACTIVE:
+            _obs.event("dispatch", cat="dispatch", kind=key[0],
+                       cache="hit", source="opjit")
         return _dispatch(entry, args, eval_ctx, key[0],
                          donated=bool(donate_argnums))
 
@@ -172,6 +178,9 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
     with _LOCK:
         _STATS["misses"] += 1
         _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
+    if _obs._ACTIVE:
+        _obs.event("dispatch", cat="dispatch", kind=key[0],
+                   cache="miss", source="opjit")
     fn = jax.jit(build(), donate_argnums=donate_argnums)
     t0 = time.perf_counter_ns()
     try:

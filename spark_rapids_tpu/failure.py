@@ -132,7 +132,14 @@ def with_device_retry(fn: Callable[[], T], conf=None,
                     or not is_transient_device_error(exc):
                 raise
             attempt += 1
+            from .obs import tracer as _obs
             from .profiling import TaskMetricsRegistry
+            if _obs._ACTIVE:
+                # the healing retry lands in the SAME span as the failure
+                # (and as any chaos injection that caused it) — the query
+                # timeline shows fault and recovery correlated in place
+                _obs.event("device.retry", cat="retry", attempt=attempt,
+                           error=type(exc).__name__, message=str(exc)[:120])
             reg = TaskMetricsRegistry.get()
             reg.add("deviceRetryCount", 1)
             delay = min(cap, base * (2 ** (attempt - 1))) / 1000.0

@@ -60,8 +60,11 @@ class TpuSemaphore:
         t0 = time.perf_counter_ns()
         self._sem.acquire()
         waited = time.perf_counter_ns() - t0
+        from ..obs import tracer as _obs
         from ..profiling import TaskMetricsRegistry
         TaskMetricsRegistry.get().add("semaphoreWaitNs", waited)
+        if _obs._ACTIVE:
+            _obs.event("semaphore.wait", cat="memory", wait_ns=waited)
         with self._state_lock:
             self.total_waits_ns += waited
             if tid in self._holders:  # lost the first-acquire race

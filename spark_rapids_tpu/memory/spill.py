@@ -119,11 +119,15 @@ class TpuBufferCatalog:
     def _unspill(self, e: _Entry) -> None:
         import pyarrow as pa
         import time as _time
+        from ..obs import tracer as _obs
         from ..profiling import TaskMetricsRegistry
         t0 = _time.perf_counter_ns()
         self._unspill_inner(e, pa)
-        TaskMetricsRegistry.get().add("readSpillTimeNs",
-                                      _time.perf_counter_ns() - t0)
+        dt = _time.perf_counter_ns() - t0
+        TaskMetricsRegistry.get().add("readSpillTimeNs", dt)
+        if _obs._ACTIVE:
+            _obs.event("spill.read", cat="memory", bytes=e.nbytes,
+                       wait_ns=dt)
 
     def _unspill_inner(self, e: _Entry, pa) -> None:
         if e.tier == TIER_DISK:
@@ -170,8 +174,11 @@ class TpuBufferCatalog:
 
     def _spill_entry_to_host(self, e: _Entry) -> int:
         from ..chaos import inject
+        from ..obs import tracer as _obs
         inject("spill.to_host")  # before any state mutation: a raised fault
         # must leave the entry intact on its current tier
+        if _obs._ACTIVE:
+            _obs.event("spill.to_host", cat="memory", bytes=e.nbytes)
         e.host_table = e.batch.to_arrow()
         e.batch = None
         e.tier = TIER_HOST
@@ -197,6 +204,10 @@ class TpuBufferCatalog:
                 from ..chaos import corrupt_bytes, inject
                 from ..shuffle.serializer import xxhash64_bytes
                 inject("spill.to_disk")  # pre-mutation, like spill.to_host
+                from ..obs import tracer as _obs
+                if _obs._ACTIVE:
+                    _obs.event("spill.to_disk", cat="memory",
+                               bytes=e.nbytes)
                 path = os.path.join(self._disk_dir, f"buf_{e.handle}.arrow")
                 buf = io.BytesIO()
                 with pa.ipc.new_file(buf, e.host_table.schema) as w:

@@ -1,0 +1,29 @@
+"""obs: the query-scoped observability layer (docs/observability.md).
+
+One correlated record per query over dispatch, sync, memory, shuffle,
+retry and chaos — a ring-buffered, thread-aware span/event tracer
+(:mod:`.tracer`, near-zero-cost when ``spark.rapids.tpu.trace.enabled`` is
+off) with three exports from the same record (:mod:`.export`):
+
+* Chrome trace-event JSON (perfetto / ``chrome://tracing``),
+* ``session.explain("metrics")`` — the executed plan annotated per node
+  (:mod:`.explain`; works with tracing off, from the session snapshots),
+* the machine-readable diagnostics bundle
+  (``session.last_query_profile()``), whose per-operator dispatch+sync
+  counts reconcile against opjit ``calls_by_kind`` and the SyncLedger.
+
+Instrumentation sites in execs//shuffle//memory/ must emit through this
+package's :func:`span` / :func:`event` helpers (tracelint rule TL012) and
+must never put a blocking device→host sync in a span/event argument.
+"""
+
+from .explain import render_explain_metrics
+from .export import build_bundle, chrome_trace, span_tree, write_artifacts
+from .tracer import (QueryTracer, begin_query, current_span, end_query,
+                     event, is_active, span)
+
+__all__ = [
+    "QueryTracer", "begin_query", "build_bundle", "chrome_trace",
+    "current_span", "end_query", "event", "is_active",
+    "render_explain_metrics", "span", "span_tree", "write_artifacts",
+]

@@ -1,0 +1,291 @@
+"""Query-scoped span/event tracer: the one correlated record of a query.
+
+Reference (PAPER.md §5): the plugin wraps every operator in NVTX ranges
+(NvtxWithMetrics.scala), ships a built-in sampled profiler
+(profiler.scala:37) and surfaces leveled SQLMetrics in the Spark SQL UI
+(GpuExec.scala:41) — one artifact diagnoses a regression. Our pre-existing
+equivalents (TpuMetric levels, SyncLedger, opjit `calls_by_kind`,
+TaskMetricsRegistry, chaos `trace_text()`) were islands; this module is the
+record that ties them together per query:
+
+* a **span tree** — query → partition task → operator → shuffle map task —
+  built from begin/end records pushed on thread-local stacks (thread-aware:
+  pipelined exchange map tasks and prefetch workers carry their own stacks,
+  and a worker-thread span nests under the submitting span via an explicit
+  ``parent``);
+* **instant events** inside those spans — opjit/compiled dispatches
+  (kind + cache hit/miss), audited D→H syncs (piggybacking the SyncLedger's
+  thread-local operator scopes, so attribution is IDENTICAL to the ledger),
+  HBM alloc/pressure, spill to host/disk/read-back, semaphore waits,
+  shuffle map/reduce/fetch-retry, transient device-error retries, and chaos
+  injections.
+
+Design constraints:
+
+* **Near-zero cost when off**: every public entry point first reads the
+  module-level ``_ACTIVE`` flag (a plain bool, no lock); ``span()`` returns
+  a shared null context manager. Sites in the per-batch hot path
+  additionally branch on ``_ACTIVE`` themselves (execs/base.py keeps its
+  untraced fast loop).
+* **Ring-buffered**: records land in a ``deque(maxlen=bufferEvents)`` —
+  a runaway query overwrites its oldest records instead of growing without
+  bound; the export layer reports the drop count and downgrades
+  reconciliation to "overflow" instead of lying.
+* **One query at a time**: the tracer is process-wide (instrumentation
+  sites have no session handle, exactly like the SyncLedger); a second
+  concurrent ``begin_query`` simply gets ``None`` and runs untraced.
+
+Exports (obs/export.py): Chrome trace-event JSON (perfetto /
+``chrome://tracing``), the span tree, and the per-query diagnostics bundle.
+See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..profiling import current_sync_scope
+
+#: record layout (tuples, not objects: the tracer may absorb hundreds of
+#: thousands of records per query):
+#:   (phase, ts_ns, tid, span_id, parent_id, name, cat, op, args)
+#: phase: "B" span begin / "E" span end / "i" instant event
+REC_PHASE, REC_TS, REC_TID, REC_SPAN, REC_PARENT, REC_NAME, REC_CAT, \
+    REC_OP, REC_ARGS = range(9)
+
+#: hot-path gate — read unlocked everywhere; flipped only under the
+#: tracer lock by begin_query/end_query
+_ACTIVE = False
+
+#: category filter (frozenset or None == all); set at begin_query
+_CATS: Optional[frozenset] = None
+
+
+class _SpanStack(threading.local):
+    """Per-thread stack of open span ids (tuple; same idiom as the
+    profiling sync-scope stack)."""
+    stack: Tuple[int, ...] = ()
+
+
+_tls = _SpanStack()
+
+
+class _NullSpan:
+    """Shared no-op context manager returned when tracing is off."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class QueryTracer:
+    """Process-wide ring-buffered recorder. Use the module-level helpers
+    (``span`` / ``event`` / ``begin_query`` / ``end_query``) — they carry
+    the off-fast-path; this class is the storage."""
+
+    _instance: Optional["QueryTracer"] = None
+    _cls_lock = threading.Lock()
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=65536)
+        self._appended = 0
+        self._next_span = 1
+        self._query: Optional[Dict[str, Any]] = None
+        self._t0_ns = 0
+
+    @classmethod
+    def get(cls) -> "QueryTracer":
+        with cls._cls_lock:
+            if cls._instance is None:
+                cls._instance = QueryTracer()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "QueryTracer":
+        global _ACTIVE, _CATS
+        with cls._cls_lock:
+            _ACTIVE = False
+            _CATS = None
+            _tls.stack = ()
+            cls._instance = QueryTracer()
+            return cls._instance
+
+    # --- lifecycle ---------------------------------------------------------
+    def begin(self, name: str, buffer_events: int,
+              categories=()) -> Optional[int]:
+        """Open a query record and its root span; returns the root span id,
+        or None when another query already owns the tracer."""
+        global _ACTIVE, _CATS
+        with self._mu:
+            if self._query is not None:
+                return None
+            self._ring = deque(maxlen=max(int(buffer_events), 1024))
+            self._appended = 0
+            self._next_span = 1
+            self._t0_ns = time.perf_counter_ns()
+            root = self._alloc_span()
+            self._query = {"name": name, "root": root}
+            _CATS = frozenset(categories) or None
+            _ACTIVE = True
+        # root span rides the CALLING thread's stack so partition spans nest
+        self._push(root)
+        self._append(("B", 0, threading.get_ident(), root, None,
+                      name, "query", None, None))
+        return root
+
+    def end(self, root: int) -> Dict[str, Any]:
+        """Close the query record; returns the raw profile dict consumed by
+        obs/export.py."""
+        global _ACTIVE, _CATS
+        self._append(("E", time.perf_counter_ns() - self._t0_ns,
+                      threading.get_ident(), root, None, None, "query",
+                      None, None))
+        self._pop(root)
+        with self._mu:
+            q = self._query or {"name": "?", "root": root}
+            events = list(self._ring)
+            dropped = self._appended - len(self._ring)
+            self._query = None
+            _ACTIVE = False
+            _CATS = None
+            return {"name": q["name"], "root": q["root"], "events": events,
+                    "dropped": dropped, "duration_ns": events[-1][REC_TS]
+                    if events else 0}
+
+    # --- recording ---------------------------------------------------------
+    def _alloc_span(self) -> int:
+        sid = self._next_span
+        self._next_span += 1
+        return sid
+
+    def _append(self, rec: Tuple) -> None:
+        with self._mu:
+            self._ring.append(rec)
+            self._appended += 1
+
+    def begin_span(self, ts: int, tid: int, parent: Optional[int],
+                   name: str, cat: str, op: str,
+                   args: Optional[Dict[str, Any]]) -> int:
+        """Allocate a span id and append its begin record under ONE lock
+        acquisition (pool threads hammer this during traced shuffles)."""
+        with self._mu:
+            sid = self._alloc_span()
+            self._ring.append(("B", ts, tid, sid, parent, name, cat, op,
+                               args))
+            self._appended += 1
+        return sid
+
+    @staticmethod
+    def _push(sid: int) -> None:
+        _tls.stack = _tls.stack + (sid,)
+
+    @staticmethod
+    def _pop(sid: int) -> None:
+        st = _tls.stack
+        if st and st[-1] == sid:
+            _tls.stack = st[:-1]
+
+    def now_ns(self) -> int:
+        return time.perf_counter_ns() - self._t0_ns
+
+
+class _Span:
+    """Open span context manager (only constructed when tracing is on)."""
+
+    __slots__ = ("_name", "_cat", "_parent", "_args", "_sid", "_tracer")
+
+    def __init__(self, name: str, cat: str, parent: Optional[int],
+                 args: Optional[Dict[str, Any]]):
+        self._name = name
+        self._cat = cat
+        self._parent = parent
+        self._args = args or None
+        self._sid = 0
+        # lock-free singleton read: _instance is always set while _ACTIVE
+        # (begin_query goes through get())
+        self._tracer = QueryTracer._instance or QueryTracer.get()
+
+    def __enter__(self) -> int:
+        tr = self._tracer
+        st = _tls.stack
+        # natural nesting wins; the explicit parent serves worker threads
+        # whose stacks start empty (pipelined shuffle map tasks)
+        parent = st[-1] if st else self._parent
+        sid = tr.begin_span(tr.now_ns(), threading.get_ident(), parent,
+                            self._name, self._cat, current_sync_scope(),
+                            self._args)
+        self._sid = sid
+        tr._push(sid)
+        return sid
+
+    def __exit__(self, *exc) -> bool:
+        tr = self._tracer
+        tr._pop(self._sid)
+        tr._append(("E", tr.now_ns(), threading.get_ident(), self._sid,
+                    None, None, self._cat, None, None))
+        return False
+
+
+def span(name: str, cat: str = "op", parent: Optional[int] = None, **args):
+    """Context manager for one timed span. Near-free when tracing is off.
+    ``parent`` is only honored when the current thread has no open span
+    (cross-thread nesting: capture ``current_span()`` on the submitting
+    thread, pass it to the worker)."""
+    if not _ACTIVE:
+        return _NULL_SPAN
+    if _CATS is not None and cat not in _CATS and cat != "query":
+        return _NULL_SPAN
+    return _Span(name, cat, parent, args or None)
+
+
+def event(name: str, cat: str = "event", op: Optional[str] = None,
+          **args) -> None:
+    """One instant event inside the current span. ``op`` defaults to the
+    profiling sync-scope operator (so sync/dispatch events reconcile
+    exactly with the SyncLedger's attribution)."""
+    if not _ACTIVE:
+        return
+    if _CATS is not None and cat not in _CATS:
+        return
+    tr = QueryTracer._instance
+    if tr is None:  # racing a reset; nothing to record into
+        return
+    st = _tls.stack
+    tr._append(("i", tr.now_ns(), threading.get_ident(),
+                st[-1] if st else None, None, name, cat,
+                op if op is not None else current_sync_scope(),
+                args or None))
+
+
+def current_span() -> Optional[int]:
+    """Id of the innermost open span on this thread (None when tracing is
+    off or the thread has no span) — capture before handing work to a pool
+    thread, pass as ``span(..., parent=...)`` there."""
+    if not _ACTIVE:
+        return None
+    st = _tls.stack
+    return st[-1] if st else None
+
+
+def is_active() -> bool:
+    return _ACTIVE
+
+
+def begin_query(name: str, buffer_events: int = 262144,
+                categories=()) -> Optional[int]:
+    """Arm the tracer for one query; None when another query is tracing."""
+    return QueryTracer.get().begin(name, buffer_events, categories)
+
+
+def end_query(root: int) -> Dict[str, Any]:
+    return QueryTracer.get().end(root)

@@ -107,6 +107,12 @@ class SyncLedger:
             ops = self._by_op.setdefault(op, {})
             ops[kind] = ops.get(kind, 0) + 1
             self._total += 1
+        # piggyback the query tracer (obs): one instant event per blocking
+        # sync, attributed with the SAME operator scope the ledger used, so
+        # the diagnostics bundle reconciles with the ledger exactly
+        from .obs import tracer as _obs
+        if _obs._ACTIVE:
+            _obs.event("sync", cat="sync", op=op, kind=kind)
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         with self._mu:

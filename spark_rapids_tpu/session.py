@@ -631,6 +631,10 @@ class DataFrame:
         print(self.limit(n).to_arrow().to_pandas().to_string())
 
     def explain(self, mode: str = "formatted") -> str:
+        if str(mode) == "metrics":
+            # the executed-plan annotation lives on the session (it renders
+            # the LAST collected query's snapshots — run a collect() first)
+            return self.session.explain("metrics")
         conf = self.session._rapids_conf()
         cpu_plan = plan_physical(self._plan, conf)
         final = TpuOverrides.apply(cpu_plan, conf)
@@ -1060,14 +1064,35 @@ class TpuSession:
                                 snapshot_plan_metrics)
         task_metrics_before = TaskMetricsRegistry.get().snapshot()
         syncs_before = SyncLedger.get().snapshot()
+        # query timeline tracer (docs/observability.md): arm the process-
+        # wide tracer for this query; None when off OR when another query
+        # already owns it (that query keeps tracing, this one runs untraced)
+        from . import obs
+        from .config import TRACE_BUFFER_EVENTS, TRACE_CATEGORIES, \
+            TRACE_ENABLED
+        qroot = None
+        opjit_before = None
+        if conf.get(TRACE_ENABLED):
+            from .config import TRACE_TAG
+            self._query_seq = getattr(self, "_query_seq", 0) + 1
+            tag = conf.get(TRACE_TAG)
+            stem = tag if tag and str(tag) != "None" else "query"
+            qroot = obs.begin_query(
+                f"{stem}-{self._query_seq}",
+                buffer_events=conf.get(TRACE_BUFFER_EVENTS),
+                categories=conf.get(TRACE_CATEGORIES))
+            if qroot is not None:
+                from .execs import opjit
+                opjit_before = opjit.cache_stats()["calls_by_kind"]
         tables = []
         try:
             for p in range(final.num_partitions()):
                 ctx = TaskContext(p, conf)
                 try:
-                    for t in final.execute_partition(p, ctx):
-                        if t.num_rows:
-                            tables.append(t.rename_columns(names))
+                    with obs.span(f"partition {p}", cat="task", partition=p):
+                        for t in final.execute_partition(p, ctx):
+                            if t.num_rows:
+                                tables.append(t.rename_columns(names))
                 except BaseException as exc:
                     # fatal device errors capture diagnostics and (outside
                     # tests) exit so the cluster manager reschedules
@@ -1084,6 +1109,7 @@ class TpuSession:
             # snapshot metrics into plain dicts so the plan (and any device
             # buffers it references) is not pinned past the query
             self._last_metrics_snapshot = snapshot_plan_metrics(final)
+            self._last_plan_tree = _plan_tree_snapshot(final)
             after = TaskMetricsRegistry.get().snapshot()
             self._last_task_metrics = {
                 k: after.get(k, 0) - task_metrics_before.get(k, 0)
@@ -1100,6 +1126,13 @@ class TpuSession:
                 if d:
                     ledger[op] = d
             self._last_sync_ledger = ledger
+            if qroot is not None:
+                self._finish_query_profile(qroot, conf, opjit_before)
+            else:
+                # honor the last_query_profile contract: an untraced query
+                # (tracing off, or the process-wide tracer owned by another
+                # query) must not leave a previous query's bundle behind
+                self._last_query_profile = None
             # release shuffle blocks/files at query end (reference: Spark's
             # ContextCleaner removing shuffle state); exchanges re-materialize
             # if the same DataFrame is collected again
@@ -1109,6 +1142,38 @@ class TpuSession:
         if not tables:
             return schema.empty_table()
         return pa.concat_tables(tables).cast(schema)
+
+    def _finish_query_profile(self, qroot: int, conf, opjit_before) -> None:
+        """Close the tracer, build the diagnostics bundle (metric snapshot +
+        sync-ledger delta + dispatch-by-kind delta + the span/event record),
+        and write the Chrome trace + bundle artifacts when
+        spark.rapids.tpu.trace.dir is set. IMPORTANT: all inputs are the
+        deltas this query caused — the bundle's reconciliation asserts the
+        tracer saw every dispatch (calls_by_kind) and every blocking sync
+        (SyncLedger) the pre-existing counters saw."""
+        from . import obs
+        from .config import TRACE_DIR
+        from .execs import opjit
+        profile = obs.end_query(qroot)
+        disp_after = opjit.cache_stats()["calls_by_kind"]
+        disp_delta = {
+            k: disp_after.get(k, 0) - (opjit_before or {}).get(k, 0)
+            for k in set(disp_after) | set(opjit_before or {})}
+        bundle = obs.build_bundle(
+            profile,
+            plan_tree=self._last_plan_tree,
+            metrics=self._last_metrics_snapshot,
+            sync_ledger=self._last_sync_ledger,
+            dispatch_delta=disp_delta,
+            task_metrics=self._last_task_metrics)
+        out_dir = conf.get(TRACE_DIR)
+        if out_dir and str(out_dir) != "None":
+            try:
+                obs.write_artifacts(bundle, profile, str(out_dir),
+                                    profile.get("name", "query"))
+            except OSError:
+                bundle["artifacts"] = {"error": "trace.dir not writable"}
+        self._last_query_profile = bundle
 
     def last_query_metrics(self, level: Optional[str] = None):
         """Per-operator metrics of the last executed query (the reference
@@ -1137,6 +1202,38 @@ class TpuSession:
                 for op, kinds in getattr(self, "_last_sync_ledger",
                                          {}).items()}
 
+    def last_query_profile(self):
+        """The diagnostics bundle of the last TRACED query
+        (spark.rapids.tpu.trace.enabled; docs/observability.md "Bundle
+        schema"): span tree, per-operator dispatch+sync counts reconciled
+        against calls_by_kind and the sync ledger, chaos/retry event
+        correlation, and — when spark.rapids.tpu.trace.dir is set — the
+        paths of the written Chrome trace and bundle JSON under
+        ['artifacts']. None when the last query ran untraced."""
+        return getattr(self, "_last_query_profile", None)
+
+    def explain(self, mode: str = "metrics", level: Optional[str] = None
+                ) -> str:
+        """session-level explain over the LAST EXECUTED query. Mode
+        "metrics" (the Spark SQL UI plan-graph analogue, reference GpuExec
+        SQLMetrics): the executed physical plan annotated per node with its
+        actual metric values, opjit dispatch counts (hits/misses) and
+        blocking-sync counts. Works with tracing off — the inputs are the
+        session's always-captured per-query snapshots."""
+        if str(mode) != "metrics":
+            raise ValueError(
+                f"TpuSession.explain supports mode='metrics'; for plan "
+                f"shape use DataFrame.explain() (got {mode!r})")
+        from .config import METRICS_LEVEL
+        from .obs import render_explain_metrics
+        lvl = str(level or self._rapids_conf().get(METRICS_LEVEL))
+        s = render_explain_metrics(
+            getattr(self, "_last_plan_tree", []),
+            getattr(self, "_last_metrics_snapshot", {}) or {},
+            self.last_sync_ledger(), level=lvl)
+        print(s)
+        return s
+
     def profiler(self):
         """Context manager capturing an xprof trace of the enclosed queries
         (reference ProfilerOnExecutor; requires
@@ -1150,6 +1247,24 @@ class TpuSession:
 
     def stop(self) -> None:
         pass
+
+
+def _plan_tree_snapshot(plan) -> List[dict]:
+    """Plain-data snapshot of the executed physical plan for
+    explain("metrics") and the diagnostics bundle — preorder, so index i
+    matches snapshot_plan_metrics's "i:NodeName" keys, and no node (or
+    device buffer it pins) survives past the query."""
+    out: List[dict] = []
+
+    def walk(node, depth: int) -> None:
+        out.append({"i": len(out), "depth": depth,
+                    "name": node.node_name(), "desc": node.node_desc(),
+                    "tpu": node.is_tpu})
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(plan, 0)
+    return out
 
 
 def get_session(**conf) -> TpuSession:

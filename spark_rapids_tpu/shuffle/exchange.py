@@ -17,6 +17,7 @@ import numpy as np
 from ..columnar.batch import TpuColumnarBatch
 from ..config import SHUFFLE_PARTITIONS
 from ..expressions.base import AttributeReference, Expression
+from ..obs import tracer as obs
 from .manager import TpuShuffleManager
 from .partitioner import (hash_partition_ids, hash_split_parts,
                           hash_split_parts_grouped, np_hash_partition_ids,
@@ -63,25 +64,33 @@ class _ExchangeBase:
             mgr = TpuShuffleManager.get(ctx.conf)
             sid = mgr.new_shuffle_id()
             child = self.children[0]
-            if self._try_materialize_collective(sid, ctx):
-                self._n_maps = 1  # one collective "map": the whole exchange
+            # map-task spans on pool threads (empty span stacks) nest under
+            # this materialization span via the captured parent id
+            self._obs_parent = obs.current_span()
+            with obs.span(f"exchange s{sid} materialize", cat="shuffle",
+                          shuffle=sid) as mat_span:
+                if mat_span is not None:
+                    self._obs_parent = mat_span
+                if self._try_materialize_collective(sid, ctx):
+                    self._n_maps = 1  # one collective "map": whole exchange
+                    self._shuffle_id = sid
+                    return
+                self._n_maps = child.num_partitions()
+                threads = self._map_task_threads(ctx)
+                # batched multi-partition dispatch: the unit of scheduling
+                # is a partition GROUP (spark.rapids.tpu.dispatch.
+                # partitionBatch); group size 1 is exactly the PR 2
+                # per-partition behavior
+                group = self._map_group_size(ctx) if self._n_maps > 1 else 1
+                groups = [list(range(s, min(s + group, self._n_maps)))
+                          for s in range(0, self._n_maps, max(1, group))]
+                if threads > 1 and len(groups) > 1:
+                    self._materialize_maps_pipelined(sid, ctx, mgr, threads,
+                                                     groups)
+                else:
+                    for ids in groups:
+                        self._run_group_guarded(sid, ids, ctx, mgr)
                 self._shuffle_id = sid
-                return
-            self._n_maps = child.num_partitions()
-            threads = self._map_task_threads(ctx)
-            # batched multi-partition dispatch: the unit of scheduling is a
-            # partition GROUP (spark.rapids.tpu.dispatch.partitionBatch);
-            # group size 1 is exactly the PR 2 per-partition behavior
-            group = self._map_group_size(ctx) if self._n_maps > 1 else 1
-            groups = [list(range(s, min(s + group, self._n_maps)))
-                      for s in range(0, self._n_maps, max(1, group))]
-            if threads > 1 and len(groups) > 1:
-                self._materialize_maps_pipelined(sid, ctx, mgr, threads,
-                                                 groups)
-            else:
-                for ids in groups:
-                    self._run_group_guarded(sid, ids, ctx, mgr)
-            self._shuffle_id = sid
 
     def _run_map_guarded(self, sid: int, map_id: int, ctx: TaskContext,
                          mgr, gate_device: bool = False) -> None:
@@ -186,8 +195,13 @@ class _ExchangeBase:
         map_ctx = TaskContext(map_id, ctx.conf)
         # pipelined map tasks run on pool threads with a fresh (empty)
         # sync-scope stack: anchor ledger attribution to this exchange;
-        # nested operator pulls re-attribute via their own scopes
-        with sync_scope(self.node_name()):
+        # nested operator pulls re-attribute via their own scopes. The obs
+        # map-task span nests under the materialization span cross-thread
+        # via the captured parent id.
+        with sync_scope(self.node_name()), \
+                obs.span(f"map s{sid}m{map_id}", cat="shuffle.map",
+                         parent=getattr(self, "_obs_parent", None),
+                         shuffle=sid, map=map_id):
             try:
                 if gate_device and isinstance(self, TpuExec):
                     # pipelined map tasks take a permit up front so
@@ -284,6 +298,10 @@ class _ExchangeBase:
                         yield t
             except FetchFailedError as ff:
                 failures += 1
+                if obs._ACTIVE:
+                    obs.event("shuffle.fetchRetry", cat="shuffle",
+                              shuffle=self._shuffle_id, reduce=idx,
+                              maps=list(ff.map_ids), attempt=failures)
                 if failures > limit:  # maxAttempts counts RECOVERY rounds
                     raise RuntimeError(
                         f"shuffle {self._shuffle_id} reduce {idx}: block "
@@ -319,6 +337,10 @@ class _ExchangeBase:
                 return with_device_retry(fetch, ctx.conf)
             except FetchFailedError as ff:
                 failures += 1
+                if obs._ACTIVE:
+                    obs.event("shuffle.fetchRetry", cat="shuffle",
+                              shuffle=self._shuffle_id, reduce=idx,
+                              maps=list(ff.map_ids), attempt=failures)
                 if failures > limit:  # same accounting as _fetch_tables:
                     # maxAttempts counts recovery rounds, and no map is
                     # re-run whose output could never be fetched again
@@ -564,7 +586,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 sem.adopt(group_ctx, mc)
             return mc
 
-        with sync_scope(self.node_name()):
+        with sync_scope(self.node_name()), \
+                obs.span(f"map s{sid}g{ids[0]}-{ids[-1]}", cat="shuffle.map",
+                         parent=getattr(self, "_obs_parent", None),
+                         shuffle=sid, maps=list(ids)):
             try:
                 # ONE permit for the whole group — the group is one unit of
                 # device work (member batches share grouped launches)
@@ -866,6 +891,11 @@ def _pipelined_upload(exch, tables_it, names, ctx: TaskContext,
             if t is None:
                 return
             if b is not None:
+                if obs._ACTIVE:
+                    # one reduce-side block fetched+uploaded (the row count
+                    # stays out of the args: an event must never force a
+                    # deferred device count — TL012)
+                    obs.event("shuffle.read", cat="shuffle")
                 if account_output:
                     out_rows.add(b.num_rows)
                     out_batches.add(1)

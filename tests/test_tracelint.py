@@ -194,6 +194,79 @@ def test_tl011_real_tree_syncs_all_audited_or_baselined():
     assert fresh == [], [f.render() for f in fresh]
 
 
+def test_tl012_sync_in_event_arg_true_positive_and_near_miss():
+    """TL012 (analysis/obslint.py): a blocking device→host transfer inside
+    a span/event ARGUMENT fires (the observer would perturb the observed,
+    outside the audited ledger gate); host-held values do not."""
+    from spark_rapids_tpu.analysis import lint_obs_module
+    tp = textwrap.dedent("""\
+        from ..obs import tracer as obs
+        import numpy as np
+        import jax.numpy as jnp
+        def f(col):
+            obs.event("rows", n=int(np.asarray(col.data)[0]))
+        def g(col):
+            obs.event("rows", n=col.count.item())
+        def h(col):
+            obs.event("sum", n=int(jnp.sum(col.data)))
+        """)
+    findings = lint_obs_module(tp, "execs/x.py")
+    assert sorted(f.location for f in findings) == [
+        "execs/x.py::f", "execs/x.py::g", "execs/x.py::h"]
+    assert all(f.rule == "TL012" and f.severity == "error"
+               for f in findings)
+    nm = textwrap.dedent("""\
+        from ..columnar.vector import audited_sync_int
+        from ..obs import tracer as obs
+        def f(col, nbytes):
+            obs.event("hbm.alloc", bytes=nbytes)
+        def g(col):
+            n = audited_sync_int(col.count, "rows")  # audited, OUTSIDE args
+            obs.event("rows", n=n)
+        """)
+    assert lint_obs_module(nm, "execs/x.py") == []
+
+
+def test_tl012_bypassing_obs_api_true_positive_and_near_miss():
+    """TL012: raw jax.profiler annotations and tracer internals in engine
+    packages fire; the public helpers (and profiling.trace_scope) do not."""
+    from spark_rapids_tpu.analysis import lint_obs_module
+    tp = textwrap.dedent("""\
+        import jax
+        from ..obs.tracer import QueryTracer
+        def f(name):
+            with jax.profiler.TraceAnnotation(name):
+                pass
+        def g():
+            QueryTracer.get()._append(("i", 0))
+        """)
+    findings = lint_obs_module(tp, "shuffle/x.py")
+    locs = sorted(f.location for f in findings)
+    assert "shuffle/x.py::f" in locs and "shuffle/x.py::g" in locs
+    nm = textwrap.dedent("""\
+        from .. import profiling
+        from ..obs import tracer as obs
+        def f(name, idx):
+            with profiling.trace_scope(name), obs.span(name, cat="op",
+                                                       partition=idx):
+                pass
+        def g():
+            obs.event("dispatch", kind="segment", cache="hit")
+        """)
+    assert lint_obs_module(nm, "execs/x.py") == []
+
+
+def test_tl012_real_tree_emission_clean():
+    """The shipped execs//shuffle//memory/ instrumentation routes through
+    the obs API with no blocking syncs in event args — the TL012 baseline
+    stays EMPTY (the ISSUE 8 bar)."""
+    from spark_rapids_tpu.analysis import lint_obs_tree
+    baseline = set(tracelint.load_baseline())
+    assert not any(k.startswith("TL012") for k in baseline)
+    fresh = [f for f in lint_obs_tree() if f.key not in baseline]
+    assert fresh == [], [f.render() for f in fresh]
+
+
 def test_guard_with_early_return_makes_host_tail_conditional():
     """The dominant expressions/ idiom: device path behind a guard, host
     fallback as the lexically-unconditional tail."""

@@ -19,6 +19,10 @@ them against the ~20 modules of eval_tpu implementations.  This tool does:
                         device value in execs/ shuffle/ outside the
                         audited sync-ledger gate
                         (columnar/vector.py audited_sync*)           (error)
+  observability lint    TL012 span/event emission in execs/ shuffle/
+                        memory/ bypassing the obs API (tracer internals,
+                        raw jax.profiler), or a blocking device→host sync
+                        inside a span/event argument                 (error)
 
 Findings diff against tools/tracelint_baseline.txt (one key per line, `#`
 comments allowed) so exceptions are explicit.  Exit status is non-zero iff
@@ -79,12 +83,13 @@ def write_baseline(keys, path=BASELINE_PATH, comments=None):
 
 def collect_findings(corroborate=False):
     """All findings from every pass, plus the expression reports."""
-    from spark_rapids_tpu.analysis import (analyze_registry, lint_sync_tree,
-                                           lint_tree)
+    from spark_rapids_tpu.analysis import (analyze_registry, lint_obs_tree,
+                                           lint_sync_tree, lint_tree)
     reports, findings = analyze_registry()
     findings = list(findings)
     findings.extend(lint_tree())
     findings.extend(lint_sync_tree())
+    findings.extend(lint_obs_tree())
     probe_results = None
     if corroborate:
         from spark_rapids_tpu.analysis import corroborate as _corr
