@@ -406,6 +406,97 @@ def _framework_q3(rows: int, partitions: int, compiled: bool = True,
             "counters_after_timed": counters, "profile": prof}
 
 
+def _scan_agg(rows: int) -> dict:
+    """scan_agg: a scan→agg query over a multi-GB datagen lineitem parquet
+    table, device parquet decode ON vs OFF (ROADMAP item 4 done-bar: wall
+    dominated by device time, not host decode). Reports the host-decode vs
+    device-decode ms breakdown from the scan's decodeTime/hostDecodeTime
+    metrics (the same numbers the `scan.decode` obs spans carry in the
+    traced artifact run) plus the decode-dispatch count, which must be
+    O(row-groups) for the scan."""
+    import pyarrow.parquet as pq
+
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu import datagen
+    from spark_rapids_tpu.io import device_decode as dd
+    from spark_rapids_tpu.session import TpuSession
+
+    d = os.path.join(_TRACE_DIR, "scan_agg_data")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"lineitem_{rows}.parquet")
+    if not os.path.exists(path):
+        # stream partitions through one writer so datagen memory stays
+        # bounded; ~1M-row row groups give the device decoder real chunks
+        spec = datagen.tpch_lineitem(rows)
+        per = min(rows, 1 << 21)
+        writer, offset, part = None, 0, 0
+        while offset < rows:
+            n = min(per, rows - offset)
+            t = spec.generate_partition(0, part, n, offset=offset)
+            if writer is None:
+                writer = pq.ParquetWriter(path, t.schema,
+                                          compression="snappy")
+            writer.write_table(t, row_group_size=1 << 20)
+            offset += n
+            part += 1
+        writer.close()
+    file_gb = round(os.path.getsize(path) / 1e9, 3)
+    n_rg = pq.ParquetFile(path).metadata.num_row_groups
+
+    def build_query(s):
+        df = s.read.parquet(path)
+        return (df.filter(F.col("l_quantity") < 30)
+                .groupBy("l_returnflag")
+                .agg(F.sum(F.col("l_extendedprice")).alias("sum_price"),
+                     F.sum(F.col("l_discount")).alias("sum_disc"),
+                     F.count(F.col("l_quantity")).alias("cnt")))
+
+    def run(device_on: bool, tag: str) -> dict:
+        s = TpuSession({
+            "spark.rapids.tpu.parquet.deviceDecode.enabled":
+                str(device_on).lower(),
+            "spark.rapids.sql.metricsLevel": "DEBUG"})
+        q = build_query(s)
+        q.collect()  # warm: compiles the decode + agg programs
+        before = dd.decode_stats()
+        sec = _time_best(lambda: q.collect(), iters=2)
+        after = dd.decode_stats()
+        m = s.last_query_metrics("DEBUG")
+        scan = next((v for k, v in m.items() if "FileScan" in str(k)), {})
+        prof = _trace_artifacts(s, lambda: q.collect(), tag)
+        return {
+            "wall_ms": round(sec * 1e3, 1),
+            "rows_per_s": round(rows / sec, 1),
+            "device_decode_ms": round(scan.get("decodeTime", 0) / 1e6, 1),
+            "host_decode_ms": round(
+                scan.get("hostDecodeTime", 0) / 1e6, 1),
+            "upload_ms": round(scan.get("uploadTime", 0) / 1e6, 1),
+            "decode_dispatches": after["dispatches"] - before["dispatches"],
+            "fallback_columns": after["fallback_columns"]
+            - before["fallback_columns"],
+            "trace": prof,
+        }
+
+    on = run(True, "scan_agg_device")
+    off = run(False, "scan_agg_host")
+    dispatch_ok = 0 < on["decode_dispatches"] <= 2 * n_rg  # timed iters
+    return {
+        "rows": rows,
+        "file_gb": file_gb,
+        "row_groups": n_rg,
+        "device_on": on,
+        "device_off": off,
+        "decode_dispatches_O_row_groups": dispatch_ok,
+        "wall_speedup_on_vs_off": _ratio(off["wall_ms"], on["wall_ms"]),
+        # done-bar: with device decode on, the wall should be dominated by
+        # device work (decode dispatches + agg), not host pyarrow decode
+        "host_decode_share_on": _ratio(on["host_decode_ms"],
+                                       on["wall_ms"]),
+        "host_decode_share_off": _ratio(off["host_decode_ms"],
+                                        off["wall_ms"]),
+    }
+
+
 def _num(x):
     """The measured value if the stage produced one, else None ("invalid"
     markers and absent stages never leak into arithmetic)."""
@@ -714,6 +805,15 @@ def main() -> None:
           _q3_gen(8, coalesce=False, tag="8part_nocoalesce"),
           budget_guard=True)
 
+    def _scan():
+        rows = int(os.environ.get("BENCH_SCAN_ROWS", str(1 << 24)))
+        detail["scan_agg"] = _scan_agg(rows)
+        emit()
+    # ROADMAP item 4 done-bar stage: device parquet decode on vs off over a
+    # multi-GB datagen lineitem table, with the host-vs-device decode ms
+    # breakdown and the O(row-groups) dispatch count
+    stage("scan_agg", _scan, budget_guard=True)
+
     def _hp():
         hp = _kernel_hash_partition(n)
         detail["kernel_hash_partition"] = {
@@ -765,7 +865,7 @@ def main() -> None:
                "q3_general_4part", "q3_general_8part",
                "q3_general_8part_nojoinagg", "q3_general_8part_nogroup",
                "q3_general_8part_nofuse", "q3_general_8part_nocoalesce",
-               "q3_compiled_16M")
+               "scan_agg", "q3_compiled_16M")
     detail["complete"] = not any(
         isinstance(detail.get(k), dict)
         and ("skipped" in detail[k] or "error" in detail[k])
@@ -783,6 +883,9 @@ def main() -> None:
     g8 = q3g.get("8part", {})
     base = q3g.get("8part_nojoinagg", {})
     q3c = detail.get("q3_compiled", {})
+    sa = detail.get("scan_agg", {})
+    sa_on = sa.get("device_on", {}) if isinstance(sa, dict) else {}
+    sa_off = sa.get("device_off", {}) if isinstance(sa, dict) else {}
     skipped = [k for k in ok_keys
                if isinstance(detail.get(k), dict)
                and ("skipped" in detail[k] or "error" in detail[k])]
@@ -815,6 +918,21 @@ def main() -> None:
                                   or {}).get("bundle"),
             "q3_general_reconciled": _reconciled(g8.get("trace")),
             "q3_compiled_reconciled": _reconciled(q3c.get("trace")),
+            # scan_agg: device parquet decode on vs off (ROADMAP item 4) —
+            # wall + the host-decode vs device-decode ms breakdown from the
+            # scan metrics/obs spans, and the O(row-groups) dispatch count
+            "scan_agg_file_gb": sa.get("file_gb"),
+            "scan_agg_row_groups": sa.get("row_groups"),
+            "scan_agg_on_wall_ms": sa_on.get("wall_ms"),
+            "scan_agg_off_wall_ms": sa_off.get("wall_ms"),
+            "scan_agg_on_device_decode_ms": sa_on.get("device_decode_ms"),
+            "scan_agg_on_host_decode_ms": sa_on.get("host_decode_ms"),
+            "scan_agg_off_host_decode_ms": sa_off.get("host_decode_ms"),
+            "scan_agg_decode_dispatches": sa_on.get("decode_dispatches"),
+            "scan_agg_dispatches_O_row_groups":
+                sa.get("decode_dispatches_O_row_groups"),
+            "scan_agg_speedup_on_vs_off":
+                sa.get("wall_speedup_on_vs_off"),
             "elapsed_s": detail.get("elapsed_s"),
             "complete": detail["complete"],
             "skipped_or_failed": skipped or None,

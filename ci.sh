@@ -37,11 +37,13 @@ echo "== fast tier-1 gate (not slow) =="
 # general-path surface (opjit cache, stage fusion incl. the join/agg
 # segment stages and partition-batched dispatch counters, pipelined
 # shuffle, basic ops, shuffle/exchange, the query timeline tracer +
-# bundle reconciliation) with the slow markers excluded.
+# bundle reconciliation, and the device parquet decode oracles incl.
+# the O(row-groups) dispatch assertion) with the slow markers excluded.
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
   tests/test_shuffle.py tests/test_tracelint.py tests/test_obs.py \
+  tests/test_parquet_device_decode.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="

@@ -4,9 +4,9 @@ Design (ISSUE 4 tentpole):
 
 * **Sites** are stable names woven through the stack (`hbm.alloc`,
   `spill.to_host`, `spill.to_disk`, `device.dispatch`, `shuffle.serialize`,
-  `shuffle.write`, `shuffle.read`, `ici.fetch`, `pipeline.task`). A site
-  either *checks* (`inject(site)` — may raise a fault or sleep) or *mangles*
-  a byte stream (`corrupt_bytes(site, data)`).
+  `shuffle.write`, `shuffle.read`, `ici.fetch`, `pipeline.task`,
+  `scan.read`). A site either *checks* (`inject(site)` — may raise a fault
+  or sleep) or *mangles* a byte stream (`corrupt_bytes(site, data)`).
 
 * **Determinism**: each site owns an independent PRNG seeded from
   (seed, site) via sha256, so the per-site sequence of draws — and therefore
@@ -42,7 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 ALL_SITES = (
     "hbm.alloc", "spill.to_host", "spill.to_disk", "device.dispatch",
     "shuffle.serialize", "shuffle.write", "shuffle.read", "ici.fetch",
-    "pipeline.task",
+    "pipeline.task", "scan.read",
 )
 
 ALL_KINDS = (
@@ -64,6 +64,7 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "shuffle.read": ("corrupt", "truncate", "io_error", "latency"),
     "ici.fetch": ("transient", "latency"),
     "pipeline.task": ("transient", "latency", "io_error"),
+    "scan.read": ("corrupt", "truncate", "io_error", "latency"),
 }
 
 _BYTE_KINDS = ("corrupt", "truncate")

@@ -333,6 +333,27 @@ diagnostics bundle `session.last_query_profile()` whose per-operator counts
 reconcile against `calls_by_kind` and the sync ledger. See
 docs/observability.md for the span model, event taxonomy and bundle schema.
 
+## Device parquet decode
+
+With `spark.rapids.tpu.parquet.deviceDecode.enabled` (default on) parquet
+scans stop decoding on the host: the host does only footer/row-group
+metadata, the Thrift page-header walk, page decompression, and the
+RLE/bit-packed run-header walk, then stages raw page bytes into HBM and
+runs ONE cached decode program per row group (bit-unpacking, RLE/dictionary
+run expansion, dictionary gather, definition-level → validity expansion
+with null compaction into the padded batch layout, PLAIN fixed-width
+reinterpret — the reference's semaphore-then-cuDF-device-decode shape,
+GpuParquetScan.scala:1983). Launches are recorded under the
+`parquet_decode` kind in the dispatch accounting, so a scan costs
+O(row-groups) dispatches, not O(pages) or O(columns). Columns the device
+cannot decode (strings, nested, INT96, exotic encodings) automatically
+demote to per-column host pyarrow decode zipped into the same batch;
+corrupt/truncated pages heal per row group via host re-read
+(`spark.rapids.tpu.parquet.deviceDecode.verify` adds a paranoid
+bit-identity cross-check); encrypted files raise the reference's clean
+message naming the file and the CPU fallback route. Coverage matrix and
+fallback rules: docs/io.md.
+
 ## Robustness
 
 Batch-level work survives memory pressure via spill + retry/split
@@ -617,6 +638,35 @@ PARQUET_REBASE_MODE_READ = _conf(
     "writers), LEGACY forces the hybrid Julian->proleptic rebase. Marked "
     "files always rebase (reference datetimeRebaseUtils.scala)."
 ).string("CORRECTED")
+
+PARQUET_DEVICE_DECODE_ENABLED = _conf(
+    "spark.rapids.tpu.parquet.deviceDecode.enabled").doc(
+    "Decode parquet pages ON DEVICE for the flat fixed-width column "
+    "classes (PLAIN / RLE_DICTIONARY / RLE int32/int64/float/double/"
+    "boolean/date/timestamp-micros, with definition-level nulls): the host "
+    "does only footer/row-group metadata, the page-header walk and page "
+    "decompression, then stages raw page bytes into HBM and runs ONE "
+    "cached decode program per row group (reference GpuParquetScan "
+    "semaphore-then-cuDF-decode). Columns the device cannot decode "
+    "(strings, nested, INT96, exotic encodings) automatically demote to "
+    "host pyarrow decode per column and zip into the same batch; decode "
+    "errors heal per row group via host re-read. Note: the device path "
+    "streams files serially per partition, one row group at a time — "
+    "spark.rapids.sql.format.parquet.reader.type and the chunked-reader "
+    "byte limit govern the HOST path only (per-row-group staging is the "
+    "device path's memory bound, the reference's chunked-decode shape). "
+    "Off = the original whole-table host pyarrow decode + upload path."
+).boolean(True)
+
+PARQUET_DEVICE_DECODE_VERIFY = _conf(
+    "spark.rapids.tpu.parquet.deviceDecode.verify").doc(
+    "Paranoia cross-check for spark.rapids.tpu.parquet.deviceDecode."
+    "enabled: after each device-decoded row group, re-decode the same "
+    "columns with host pyarrow and require bit-identical results; a "
+    "mismatch (e.g. corrupted staged bytes that slipped past the "
+    "structural page checks) falls the row group back to the host decode. "
+    "Debug/soak tool — roughly doubles scan cost."
+).boolean(False)
 
 COMPILED_JOIN_ENABLED = _conf(
     "spark.rapids.tpu.join.compiledStage.enabled").doc(

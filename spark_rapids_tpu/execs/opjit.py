@@ -90,6 +90,18 @@ def cache_stats() -> Dict[str, Any]:
         return {**_STATS, "calls_by_kind": dict(_KIND_CALLS)}
 
 
+def record_external_dispatch(kind: str) -> None:
+    """Fold a program launch made OUTSIDE the opjit cache (e.g. the parquet
+    device-decode programs, kind "parquet_decode") into the process-wide
+    dispatch accounting: calls_by_kind, the timeline dispatch events, and
+    therefore the diagnostics-bundle reconciliation all see it."""
+    with _LOCK:
+        _KIND_CALLS[kind] = _KIND_CALLS.get(kind, 0) + 1
+    if _obs._ACTIVE:
+        _obs.event("dispatch", cat="dispatch", kind=kind, cache="extern",
+                   source=kind)
+
+
 def cache_len() -> int:
     with _LOCK:
         return len(_CACHE)

@@ -162,8 +162,52 @@ class FileCache:
             except OSError:
                 pass
 
+    def range_reader(self, path: str, conf: RapidsConf) -> "RangeReader":
+        """Byte-range reader for `path`, resolving remote inputs through
+        the cache ONCE and keeping one open file handle (the device
+        parquet decoder reads one range per column chunk per row group;
+        reference: the private FileCache's byte-range API)."""
+        local = self.resolve(path, conf) if conf.get(FILECACHE_ENABLED) \
+            else path
+        return RangeReader(path, self._source_of(local))
+
+    def read_range(self, path: str, conf: RapidsConf, offset: int,
+                   length: int) -> bytes:
+        """One-shot `range_reader` read (convenience for single ranges)."""
+        with self.range_reader(path, conf) as r:
+            return r.read(offset, length)
+
     def stats(self) -> dict:
         with self._mu:
             return {"hits": self.hits, "misses": self.misses,
                     "evictions": self.evictions, "bytes": self._used,
                     "entries": len(self._entries)}
+
+
+class RangeReader:
+    """One open handle for many byte-range reads of one (resolved) file.
+    Chaos site ``scan.read`` covers both the read attempt
+    (io_error/latency) and the returned bytes (corrupt/truncate), so scan
+    robustness is testable like the shuffle block paths. Closes on
+    `close()`/context exit; a leaked reader closes with its file object."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self._f = open(source, "rb")
+
+    def read(self, offset: int, length: int) -> bytes:
+        from .chaos import corrupt_bytes, inject
+        inject("scan.read", detail=self.path)
+        self._f.seek(offset)
+        data = self._f.read(length)
+        return corrupt_bytes("scan.read", data)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "RangeReader":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -87,3 +87,71 @@ def split_conjuncts(cond: Expression) -> List[Expression]:
 
 def pushable(e: Expression) -> bool:
     return _leaf_filter(e) is not None
+
+
+def rg_excluded(rg, arrow_filter) -> bool:
+    """Row-group pruning by footer statistics for pushed filters: True only
+    when a pushed min/max leaf provably excludes every row of the row group
+    (reference GpuParquetFileFilterHandler row-group filtering). Shared by
+    the host chunked reader and the device decode path so both prune
+    identically."""
+    if not arrow_filter:
+        return False
+    stats = {}
+    for j in range(rg.num_columns):
+        col = rg.column(j)
+        st = col.statistics
+        if st is not None and st.has_min_max:
+            name = col.path_in_schema.split(".")[0]
+            stats[name] = (st.min, st.max)
+    for leaf in arrow_filter:
+        try:
+            name, op, val = leaf
+        except Exception:  # noqa: BLE001 — nested filter shape
+            return False
+        if name not in stats:
+            continue
+        lo, hi = stats[name]
+        try:
+            if ((op in ("=", "==") and (val < lo or val > hi))
+                    or (op in ("<", "<=") and lo > val)
+                    or (op in (">", ">=") and hi < val)):
+                return True
+        except TypeError:
+            continue
+    return False
+
+
+def dataset_filter_expr(arrow_filter):
+    """Pushed-filter tuples → a pyarrow.dataset expression, or None when
+    nothing is convertible. Used by the ORC read path: pyarrow's ORC
+    dataset applies the expression with stripe/row-group statistics
+    pruning (the ORC analogue of the parquet `filters=` pushdown); the
+    exact Filter exec above the scan keeps results identical either way."""
+    try:
+        import pyarrow.compute as pc
+    except Exception:  # noqa: BLE001 — compute module unavailable
+        return None
+    expr = None
+    for leaf in arrow_filter or ():
+        try:
+            name, op, val = leaf
+        except Exception:  # noqa: BLE001 — nested filter shape
+            continue
+        f = pc.field(name)
+        if op in ("=", "=="):
+            e = f == val
+        elif op == "<":
+            e = f < val
+        elif op == "<=":
+            e = f <= val
+        elif op == ">":
+            e = f > val
+        elif op == ">=":
+            e = f >= val
+        elif op == "in":
+            e = f.isin(list(val))
+        else:
+            continue
+        expr = e if expr is None else (expr & e)
+    return expr

@@ -1,0 +1,168 @@
+"""Device-side Parquet page-decode kernels (XLA, jit-composable).
+
+Reference: the plugin decodes parquet bytes ON DEVICE after host staging —
+`GpuParquetScan.scala:1983,2506` acquires the semaphore and hands the raw
+(decompressed) column-chunk bytes to cuDF's page decoders. The TPU analogue
+lives here: every O(rows) transform of the Parquet physical encodings is a
+pure jnp function over device uint8 buffers, composed per row group into ONE
+cached program by io/device_decode.py. The host touches only O(pages) +
+O(runs) metadata (footer, page headers, RLE run headers) and the
+decompression pass; the unpack/expand/gather/scatter work below runs on
+device.
+
+Encodings covered (the flat fixed-width column classes):
+
+* **bit-unpacking** (`unpack_bits`) — 1..32-bit packed little-endian values
+  at arbitrary per-element bit offsets (PLAIN booleans, bit-packed literal
+  runs, dictionary indices of any per-page bit width);
+* **RLE / bit-packed hybrid run expansion** (`expand_runs`) — dictionary
+  indices and definition levels. The host walks the varint run headers into
+  a run table (one row per run: output start, absolute bit offset, repeated
+  value, literal flag, bit width); the kernel positions every output element
+  in its run with one `searchsorted` and either bit-unpacks (literal run) or
+  broadcasts the run value (RLE run);
+* **dictionary gather** (`dictionary_gather`) — expanded indices into the
+  PLAIN-decoded dictionary values;
+* **definition levels → validity** (`validity_from_defs`) and **null
+  compaction** (`expand_dense`) — Parquet stores only non-null values
+  densely; the scatter re-expands them into the padded-batch layout
+  `columnar/batch.py` uses (rows in [num_rows, capacity) stay zero/invalid);
+* **PLAIN fixed-width reinterpret** (`plain_fixed_width`) — raw
+  little-endian value bytes to int8/16/32/64, float32/64 carriers via byte
+  math + bitcast (no host round trip).
+
+All functions are shape-polymorphic jnp (no data-dependent host syncs), so
+tracelint's kernel scan classifies them device-clean and io/device_decode.py
+can fuse any per-row-group combination into a single dispatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: run-table column indices (int64 [n_runs, 5] built by io/device_decode.py;
+#: padding runs carry start = RUN_PAD_START so searchsorted never lands on
+#: them)
+RUN_START, RUN_BITOFF, RUN_VALUE, RUN_LITERAL, RUN_WIDTH = range(5)
+RUN_COLS = 5
+RUN_PAD_START = 1 << 62
+
+
+def unpack_bits(data_u8, bit_offsets, widths):
+    """Unpack little-endian bit-packed values of per-element `widths` (1..32
+    bits) starting at absolute `bit_offsets` into uint64 values.
+
+    `data_u8` must carry >= 8 bytes of zero padding past the last addressed
+    bit (io/device_decode.py pads every staged buffer); out-of-range offsets
+    clip into the padding and decode to garbage the caller masks off.
+    """
+    pos = bit_offsets.astype(jnp.int64)
+    byte = pos >> 3
+    shift = (pos & 7).astype(jnp.uint64)
+    word = jnp.zeros(pos.shape, jnp.uint64)
+    for k in range(5):  # 5 bytes cover any 32-bit value at any bit shift
+        word = word | (jnp.take(data_u8, byte + k, mode="clip")
+                       .astype(jnp.uint64) << jnp.uint64(8 * k))
+    mask = (jnp.uint64(1) << widths.astype(jnp.uint64)) - jnp.uint64(1)
+    return (word >> shift) & mask
+
+
+def expand_runs(run_table, data_u8, out_len: int):
+    """Expand an RLE / bit-packed hybrid run table into `out_len` int64
+    values (dictionary indices or definition levels).
+
+    Each output element finds its run by binary search over the run starts,
+    then either broadcasts the run's repeated value (RLE run) or bit-unpacks
+    its element from the staged page bytes (bit-packed literal run).
+    Elements past the last real run read padding and are masked downstream.
+    """
+    idx = jnp.arange(out_len, dtype=jnp.int64)
+    starts = run_table[:, RUN_START]
+    r = jnp.searchsorted(starts, idx, side="right") - 1
+    r = jnp.clip(r, 0, run_table.shape[0] - 1)
+    local = idx - jnp.take(starts, r, mode="clip")
+    width = jnp.take(run_table[:, RUN_WIDTH], r, mode="clip")
+    bitoff = jnp.take(run_table[:, RUN_BITOFF], r, mode="clip") \
+        + local * width
+    unpacked = unpack_bits(data_u8, bitoff, width).astype(jnp.int64)
+    literal = jnp.take(run_table[:, RUN_LITERAL], r, mode="clip") != 0
+    value = jnp.take(run_table[:, RUN_VALUE], r, mode="clip")
+    return jnp.where(literal, unpacked, value)
+
+
+def validity_from_defs(def_levels, max_def, num_rows):
+    """Definition levels → dense validity mask over the padded capacity.
+    Rows in [num_rows, capacity) are padding and always invalid."""
+    n = def_levels.shape[0]
+    in_range = jnp.arange(n, dtype=jnp.int64) < num_rows
+    return (def_levels == max_def) & in_range
+
+
+def expand_dense(dense, validity):
+    """Null compaction inverse: scatter the densely-stored non-null values
+    into their row slots (Parquet data pages store only rows whose
+    definition level is max_def). Null/padding rows read zero."""
+    pos = jnp.cumsum(validity.astype(jnp.int64)) - 1
+    safe = jnp.clip(pos, 0, dense.shape[0] - 1)
+    g = jnp.take(dense, safe, axis=0, mode="clip")
+    return jnp.where(validity, g, jnp.zeros((), dense.dtype))
+
+
+def dictionary_gather(dict_values, indices):
+    """Gather decoded dictionary values by expanded indices (clipped: padding
+    indices land on dictionary slot 0 and are masked by validity)."""
+    return jnp.take(dict_values, indices.astype(jnp.int32), axis=0,
+                    mode="clip")
+
+
+def plain_fixed_width(data_u8, itemsize: int, kind: str):
+    """PLAIN fixed-width reinterpret: little-endian value bytes → carrier
+    values, entirely on device (byte combine + bitcast).
+
+    kind: "i" signed int, "u" unsigned int, "f" float; itemsize 1/2/4/8.
+    """
+    b = data_u8.reshape(-1, itemsize).astype(jnp.uint64)
+    word = jnp.zeros((b.shape[0],), jnp.uint64)
+    for k in range(itemsize):
+        word = word | (b[:, k] << jnp.uint64(8 * k))
+    if kind == "f":
+        if itemsize == 4:
+            return jax.lax.bitcast_convert_type(
+                word.astype(jnp.uint32), jnp.float32)
+        return jax.lax.bitcast_convert_type(word, jnp.float64)
+    target = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32, 8: jnp.int64}[itemsize]
+    if kind == "u":
+        utarget = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
+                   8: jnp.uint64}[itemsize]
+        return word.astype(utarget)
+    narrow = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
+              8: jnp.uint64}[itemsize]
+    return jax.lax.bitcast_convert_type(word.astype(narrow), target)
+
+
+def merge_plain_segments(seg_table, plain_values, base, out_len: int):
+    """Mid-chunk dictionary fallback: once a writer's dictionary overflows,
+    later data pages store PLAIN values while earlier pages stay
+    dictionary-indexed (parquet's standard fallback; cuDF decodes such
+    chunks natively). `seg_table` marks each data page's dense range
+    ([dense_start, plain_src_start, 0, is_plain, 0] rows): elements inside
+    a PLAIN page's range read `plain_values[src_start + (i - dense_start)]`,
+    everything else keeps `base` (the dictionary-gathered stream)."""
+    idx = jnp.arange(out_len, dtype=jnp.int64)
+    starts = seg_table[:, RUN_START]
+    r = jnp.searchsorted(starts, idx, side="right") - 1
+    r = jnp.clip(r, 0, seg_table.shape[0] - 1)
+    src = jnp.take(seg_table[:, RUN_BITOFF], r, mode="clip") \
+        + idx - jnp.take(starts, r, mode="clip")
+    is_plain = jnp.take(seg_table[:, RUN_LITERAL], r, mode="clip") != 0
+    vals = jnp.take(plain_values,
+                    jnp.clip(src, 0, plain_values.shape[0] - 1), axis=0)
+    return jnp.where(is_plain, vals, base)
+
+
+def decode_bool_runs(run_table, data_u8, out_len: int):
+    """Boolean values from the run machinery: PLAIN bit-packed pages stage
+    as one literal run each (width 1), RLE-encoded pages (data page v2) as
+    ordinary runs — either way the expansion is `expand_runs` != 0."""
+    return expand_runs(run_table, data_u8, out_len) != 0

@@ -143,7 +143,11 @@ def _counts(profile: Dict[str, Any]):
             c = slot.setdefault("dispatch_cache", {})
             hit = str(args.get("cache", "?"))
             c[hit] = c.get(hit, 0) + 1
-            if args.get("source") == "opjit":
+            if args.get("source") == "opjit" or args.get("cache") == "extern":
+                # "extern" = launches recorded into calls_by_kind from
+                # outside the opjit cache (opjit.record_external_dispatch,
+                # e.g. the parquet device-decode programs) — they must
+                # count here too or reconciliation would always fail
                 disp_by_kind[kind] = disp_by_kind.get(kind, 0) + 1
         elif cat == "sync":
             kind = str(args.get("kind", "?"))

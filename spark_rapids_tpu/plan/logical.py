@@ -90,7 +90,20 @@ class FileScan(LogicalPlan):
         p = self.paths[0]
         if self.fmt == "parquet":
             import pyarrow.parquet as pq
-            sch = pq.read_schema(p)
+            try:
+                sch = pq.read_schema(p)
+            except Exception:
+                # encrypted inputs fail here first (before any scan):
+                # surface the reference's clean message instead of
+                # pyarrow's cryptic one (GpuParquetScan.scala:590)
+                from ..io.device_decode import (ParquetEncryptedException,
+                                               detect_encryption,
+                                               encrypted_message)
+                reason = detect_encryption(p)
+                if reason is not None:
+                    raise ParquetEncryptedException(
+                        encrypted_message(p, reason)) from None
+                raise
         elif self.fmt == "orc":
             import pyarrow.orc as paorc
             sch = paorc.ORCFile(p).schema

@@ -1,0 +1,425 @@
+"""Device-side parquet decode: per-encoding oracles vs pyarrow, per-column
+fallback parity, O(row-groups) dispatch accounting, chaos scan.read healing,
+and encrypted-file detection (reference GpuParquetScan device decode +
+GpuParquetScan.scala:590 encryption semantics)."""
+
+import os
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.chaos import FaultInjector
+from spark_rapids_tpu.io import device_decode as dd
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_decode_state():
+    dd.reset_for_tests()
+    FaultInjector.reset_for_tests()
+    yield
+    FaultInjector.reset_for_tests()
+
+
+def _mixed_table(n=4000, null_every=5, seed=7):
+    rng = np.random.default_rng(seed)
+
+    def nulled(vals, k):
+        return [None if k and i % k == 0 else v for i, v in enumerate(vals)]
+
+    return pa.table({
+        "i32": pa.array(nulled([int(x) for x in
+                                rng.integers(-2**31, 2**31, n)], null_every),
+                        pa.int32()),
+        "i64": pa.array(nulled([int(x) for x in
+                                rng.integers(-2**63, 2**63, n)], null_every),
+                        pa.int64()),
+        "f32": pa.array(rng.normal(size=n).astype(np.float32), pa.float32()),
+        "f64": pa.array(nulled([float(x) for x in rng.normal(size=n)],
+                               null_every), pa.float64()),
+        "bool": pa.array(nulled([bool(i % 3 == 0) for i in range(n)],
+                                null_every)),
+        "date": pa.array(nulled([i % 20000 for i in range(n)], null_every),
+                         pa.date32()),
+        "ts": pa.array(nulled([1_600_000_000_000_000 + i for i in range(n)],
+                              null_every), pa.timestamp("us")),
+        "i8": pa.array(nulled([i % 120 - 60 for i in range(n)], null_every),
+                       pa.int8()),
+        "lowcard": pa.array((np.arange(n) % 5).astype(np.int64)),
+    })
+
+
+def _device_read(path, conf=None):
+    s = TpuSession(dict(conf or {}))
+    return s.read.parquet(path).to_arrow()
+
+
+def _assert_tables_equal(got, ref):
+    assert got.num_rows == ref.num_rows
+    for c in ref.column_names:
+        a = got.column(c).combine_chunks()
+        b = ref.column(c).combine_chunks()
+        if a.type != b.type:
+            a = a.cast(b.type)
+        assert a.equals(b), f"column {c} differs"
+
+
+def _write(tmp_path, table, name="t.parquet", **kw):
+    p = str(tmp_path / name)
+    pq.write_table(table, p, **kw)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# per-encoding oracles: bit-identical vs the pyarrow decode
+# ---------------------------------------------------------------------------
+
+
+def test_plain_encoding_oracle(tmp_path):
+    p = _write(tmp_path, _mixed_table(), use_dictionary=False,
+               compression="snappy", row_group_size=1500)
+    got = _device_read(p)
+    _assert_tables_equal(got, pq.read_table(p))
+    st = dd.decode_stats()
+    assert st["dispatches"] == 3  # one per row group
+    assert st["fallback_columns"] == 0
+
+
+def test_rle_dictionary_oracle(tmp_path):
+    p = _write(tmp_path, _mixed_table(), use_dictionary=True,
+               compression="snappy", row_group_size=1500, data_page_size=800)
+    got = _device_read(p)
+    _assert_tables_equal(got, pq.read_table(p))
+    assert dd.decode_stats()["fallback_columns"] == 0
+
+
+def test_bitpacked_boolean_oracle(tmp_path):
+    n = 3000
+    t = pa.table({
+        "b_dense": pa.array([bool(i % 7 == 0) for i in range(n)]),
+        "b_null": pa.array([None if i % 4 == 0 else bool(i % 2)
+                            for i in range(n)]),
+        "b_allnull": pa.array([None] * n, pa.bool_()),
+    })
+    p = _write(tmp_path, t, compression="snappy", row_group_size=1000,
+               data_page_size=200)
+    _assert_tables_equal(_device_read(p), pq.read_table(p))
+    assert dd.decode_stats()["fallback_columns"] == 0
+
+
+@pytest.mark.parametrize("null_every", [0, 2, 1])
+def test_def_level_null_densities(tmp_path, null_every):
+    """Mixed null densities including no-null (null_every=0) and all-null
+    (null_every=1) pages."""
+    n = 2500
+    vals = [None if null_every and i % null_every == 0 else i
+            for i in range(n)]
+    t = pa.table({"v": pa.array(vals, pa.int64()),
+                  "w": pa.array(vals, pa.int32())})
+    p = _write(tmp_path, t, compression="snappy", row_group_size=800,
+               data_page_size=300)
+    _assert_tables_equal(_device_read(p), pq.read_table(p))
+    assert dd.decode_stats()["fallback_columns"] == 0
+
+
+def test_data_page_v2_oracle(tmp_path):
+    p = _write(tmp_path, _mixed_table(), compression="snappy",
+               data_page_version="2.0", row_group_size=1500,
+               data_page_size=700)
+    _assert_tables_equal(_device_read(p), pq.read_table(p))
+    assert dd.decode_stats()["fallback_columns"] == 0
+
+
+@pytest.mark.parametrize("codec", ["snappy", "zstd", "gzip", "NONE"])
+def test_codecs(tmp_path, codec):
+    p = _write(tmp_path, _mixed_table(1500), compression=codec,
+               row_group_size=600)
+    _assert_tables_equal(_device_read(p), pq.read_table(p))
+    assert dd.decode_stats()["dispatches"] == 3
+
+
+# ---------------------------------------------------------------------------
+# dispatch accounting: O(row-groups) launches per scan
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_counter_o_row_groups(tmp_path):
+    """Many pages per row group must still cost ONE decode dispatch per
+    row group — not O(pages), not O(columns)."""
+    from spark_rapids_tpu.execs import opjit
+    n = 6000
+    t = _mixed_table(n)
+    p = _write(tmp_path, t, compression="snappy", row_group_size=1000,
+               data_page_size=200)  # ~dozens of pages per group
+    md = pq.ParquetFile(p).metadata
+    assert md.num_row_groups == 6
+    before = opjit.cache_stats()["calls_by_kind"].get("parquet_decode", 0)
+    _assert_tables_equal(_device_read(p), pq.read_table(p))
+    st = dd.decode_stats()
+    assert st["dispatches"] == md.num_row_groups
+    assert st["row_groups"] == md.num_row_groups
+    # the launches land in the process-wide dispatch accounting too
+    after = opjit.cache_stats()["calls_by_kind"].get("parquet_decode", 0)
+    assert after - before == md.num_row_groups
+
+
+def test_row_group_pruning_still_prunes(tmp_path):
+    """Footer-statistics pruning applies before any decode dispatch: a
+    pushed filter that excludes whole row groups skips their launches."""
+    n = 4000
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "v": pa.array(np.arange(n, dtype=np.float64))})
+    p = _write(tmp_path, t, row_group_size=1000)
+    s = TpuSession({})
+    got = (s.read.parquet(p).filter(F.col("k") >= 3500).to_arrow()
+           .sort_by("k"))
+    assert got.column("k").to_pylist() == list(range(3500, 4000))
+    assert dd.decode_stats()["dispatches"] == 1  # 3 of 4 groups pruned
+
+
+# ---------------------------------------------------------------------------
+# per-column fallback parity: device + pinned-host columns in ONE batch
+# ---------------------------------------------------------------------------
+
+
+def test_per_column_fallback_parity(tmp_path):
+    n = 2000
+    t = pa.table({
+        "dev_i": pa.array([None if i % 6 == 0 else i for i in range(n)],
+                          pa.int64()),
+        "host_s": pa.array([None if i % 9 == 0 else f"s{i % 23}"
+                            for i in range(n)]),  # BYTE_ARRAY: host decode
+        "dev_f": pa.array(np.arange(n) * 0.25, pa.float64()),
+    })
+    p = _write(tmp_path, t, compression="snappy", row_group_size=700)
+    got = _device_read(p)
+    _assert_tables_equal(got, pq.read_table(p))
+    st = dd.decode_stats()
+    assert st["fallback_columns"] >= 3  # host_s once per row group
+    assert st["device_columns"] >= 6
+    assert st["dispatches"] == 3
+
+
+def test_device_decode_off_matches(tmp_path):
+    p = _write(tmp_path, _mixed_table(1200), row_group_size=500)
+    on = _device_read(p)
+    st = dd.decode_stats()
+    assert st["dispatches"] == 3
+    dd.reset_for_tests()
+    off = _device_read(
+        p, {"spark.rapids.tpu.parquet.deviceDecode.enabled": "false"})
+    assert dd.decode_stats()["dispatches"] == 0
+    _assert_tables_equal(on, off)
+
+
+def test_query_parity_device_vs_cpu(tmp_path):
+    p = _write(tmp_path, _mixed_table(3000), compression="snappy",
+               row_group_size=1000)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(p)
+        .filter(F.col("i64").isNotNull() & (F.col("lowcard") >= 2))
+        .groupBy("lowcard").agg(F.count(F.col("i32")).alias("c"),
+                                F.sum(F.col("f64")).alias("sf")),
+        # per-row-group device batches sum floats in a different
+        # association order than the CPU whole-file read
+        ignore_order=True, approx_float=True)
+
+
+def test_partitioned_directory_device_decode(tmp_path):
+    root = tmp_path / "part"
+    for k in (1, 2):
+        d = root / f"k={k}"
+        d.mkdir(parents=True)
+        n = 600
+        t = pa.table({"v": pa.array(np.arange(n, dtype=np.int64) * k),
+                      "f": pa.array(np.arange(n) * 0.5, pa.float64())})
+        pq.write_table(t, str(d / "f0.parquet"), row_group_size=250)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(str(root)).filter(F.col("k") == 2),
+        ignore_order=True)
+    assert dd.decode_stats()["dispatches"] > 0
+
+
+def test_verify_conf_passes_on_clean_files(tmp_path):
+    p = _write(tmp_path, _mixed_table(1000), row_group_size=400)
+    got = _device_read(
+        p, {"spark.rapids.tpu.parquet.deviceDecode.verify": "true"})
+    _assert_tables_equal(got, pq.read_table(p))
+    assert dd.decode_stats()["dispatches"] == 3
+
+
+# ---------------------------------------------------------------------------
+# chaos scan.read: corrupt/truncated page bytes → clean fallback, never
+# wrong data
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_truncated_page_heals_via_host(tmp_path):
+    p = _write(tmp_path, _mixed_table(2000), compression="snappy",
+               row_group_size=700)
+    ref = pq.read_table(p)
+    inj = FaultInjector.get()
+    inj.force("scan.read", "truncate", 2)
+    got = _device_read(p)
+    _assert_tables_equal(got, ref)
+    assert inj.injection_count() == 2
+    st = dd.decode_stats()
+    assert (st["fallback_columns"] + st["fallback_row_groups"]
+            + st["fallback_files"]) > 0
+
+
+def test_chaos_corrupt_page_with_verify_never_wrong(tmp_path):
+    """A flipped byte that still decompresses/parses could silently decode
+    wrong values; with the verify cross-check armed the mismatch (or the
+    structural failure) demotes to host — results stay bit-identical."""
+    p = _write(tmp_path, _mixed_table(2000), compression="snappy",
+               row_group_size=700)
+    ref = pq.read_table(p)
+    inj = FaultInjector.get()
+    inj.force("scan.read", "corrupt", 3)
+    got = _device_read(
+        p, {"spark.rapids.tpu.parquet.deviceDecode.verify": "true"})
+    _assert_tables_equal(got, ref)
+    assert inj.injection_count() == 3
+
+
+def test_chaos_io_error_heals(tmp_path):
+    p = _write(tmp_path, _mixed_table(1000), row_group_size=500)
+    ref = pq.read_table(p)
+    inj = FaultInjector.get()
+    inj.force("scan.read", "io_error", 1)
+    _assert_tables_equal(_device_read(p), ref)
+
+
+# ---------------------------------------------------------------------------
+# encrypted-parquet detection (reference GpuParquetScan.scala:590)
+# ---------------------------------------------------------------------------
+
+
+def _fake_encrypted_footer_file(tmp_path, name="enc.parquet"):
+    """A parquet file whose tail carries the encrypted-footer PARE magic."""
+    p = _write(tmp_path, pa.table({"a": pa.array([1, 2, 3], pa.int64())}),
+               name=name)
+    raw = bytearray(open(p, "rb").read())
+    raw[-4:] = b"PARE"
+    enc = str(tmp_path / ("pare_" + name))
+    open(enc, "wb").write(bytes(raw))
+    return enc
+
+
+def test_encrypted_footer_message_names_file_and_reason(tmp_path):
+    enc = _fake_encrypted_footer_file(tmp_path)
+    s = TpuSession({})
+    with pytest.raises(dd.ParquetEncryptedException) as ei:
+        s.read.parquet(enc).to_arrow()
+    msg = str(ei.value)
+    assert enc in msg                       # names the file
+    assert "encrypted" in msg               # names the reason
+    assert "PARE" in msg
+    assert "CPU" in msg                     # names the fallback route
+
+
+def test_encrypted_footer_message_on_cpu_path(tmp_path):
+    """The host/CPU scan path raises the same clean message instead of
+    pyarrow's cryptic magic-bytes error."""
+    enc = _fake_encrypted_footer_file(tmp_path)
+    s = TpuSession({"spark.rapids.sql.enabled": "false"})
+    with pytest.raises(dd.ParquetEncryptedException) as ei:
+        s.read.parquet(enc).to_arrow()
+    assert enc in str(ei.value) and "encrypted" in str(ei.value)
+
+
+def test_plaintext_footer_crypto_metadata_detected(tmp_path):
+    """Plaintext-footer mode: the footer parses but FileMetaData carries
+    encryption_algorithm (field 8) — detection flags it without PARE."""
+    p = _write(tmp_path, pa.table({"a": pa.array([1, 2, 3], pa.int64())}))
+    raw = bytearray(open(p, "rb").read())
+    flen = struct.unpack("<I", raw[-8:-4])[0]
+    footer = bytes(raw[-8 - flen:-8])
+    fields, endpos = dd._read_struct(footer, 0)
+    last = max(fields)
+    assert endpos == len(footer) and 0 < 8 - last <= 15
+    # splice an empty struct at field id 8 (encryption_algorithm) before
+    # the stop byte, then rewrite the footer length
+    new_footer = footer[:endpos - 1] \
+        + bytes([((8 - last) << 4) | 12, 0x00, 0x00])
+    out = bytes(raw[:-8 - flen]) + new_footer \
+        + struct.pack("<I", len(new_footer)) + b"PAR1"
+    enc = str(tmp_path / "ptfooter.parquet")
+    open(enc, "wb").write(out)
+    reason = dd.detect_encryption(enc)
+    assert reason is not None and "plaintext footer" in reason
+    s = TpuSession({})
+    with pytest.raises(dd.ParquetEncryptedException) as ei:
+        s.read.parquet(enc).to_arrow()
+    assert enc in str(ei.value)
+
+
+def test_detect_encryption_negative(tmp_path):
+    p = _write(tmp_path, pa.table({"a": pa.array([1], pa.int64())}))
+    assert dd.detect_encryption(p) is None
+    short = str(tmp_path / "short.bin")
+    open(short, "wb").write(b"tiny")
+    assert dd.detect_encryption(short) is None
+
+
+# ---------------------------------------------------------------------------
+# ORC predicate pushdown oracle: pruning never changes results
+# ---------------------------------------------------------------------------
+
+
+def _orc_file(tmp_path, n=5000):
+    import pyarrow.orc as paorc
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array([None if i % 5 == 0 else i * 0.5 for i in range(n)],
+                      pa.float64()),
+        "s": pa.array([f"g{i % 7}" for i in range(n)]),
+    })
+    p = str(tmp_path / "t.orc")
+    paorc.write_table(t, p, stripe_size=64 << 10)
+    return p
+
+
+def test_orc_pushdown_oracle(tmp_path):
+    """The same ORC query with scan filters pushed (default) and with the
+    exact same predicate applied only above the scan must agree — pruning
+    never changes results (and the CPU session agrees too)."""
+    p = _orc_file(tmp_path)
+
+    def q(s):
+        return (s.read.orc(p)
+                .filter((F.col("k") >= 1234) & (F.col("k") < 2500))
+                .groupBy("s").agg(F.count(F.col("k")).alias("c"),
+                                  F.sum(F.col("v")).alias("sv")))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_orc_pushdown_filters_reach_scan(tmp_path):
+    """The scan-level pushdown itself prunes rows before the Filter exec:
+    read through the TPU session and check the pushed filter produced
+    exactly the filtered row set."""
+    p = _orc_file(tmp_path, n=2000)
+    s = TpuSession({})
+    got = (s.read.orc(p).filter(F.col("k") == 77).to_arrow())
+    assert got.num_rows == 1
+    assert got.column("k").to_pylist() == [77]
+
+
+# ---------------------------------------------------------------------------
+# tracelint: the new kernels classify device-clean
+# ---------------------------------------------------------------------------
+
+
+def test_parquet_decode_kernels_classify_device():
+    from spark_rapids_tpu.analysis.registry_check import scan_kernels
+    verdicts = scan_kernels()["kernels/parquet_decode.py"]
+    assert verdicts, "kernel scan found no public parquet decode kernels"
+    assert all(v == "device" for v in verdicts.values()), verdicts
