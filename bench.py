@@ -848,6 +848,39 @@ def main() -> None:
         emit()
     stage("q3_compiled", _q3_compiled)
 
+    def _multichip():
+        # MULTICHIP stage (ROADMAP item 2): sharded execution over the real
+        # device topology — mesh session vs single-device baseline per
+        # query, bit-identity + O(exchanges) collective launches + the
+        # collective-time breakdown. On a 1-chip host it records an honest
+        # skip; the CPU-simulated 8-device round runs through
+        # __graft_entry__.dryrun_multichip and lands in MULTICHIP_r0N.
+        import jax as _j
+        n_dev = len(_j.devices())
+        if n_dev < 2:
+            detail["multichip"] = {
+                "skipped": f"single-device topology (n_devices={n_dev}); "
+                           "the CPU-simulated mesh round is recorded via "
+                           "__graft_entry__.dryrun_multichip"}
+            emit()
+            return
+        import sys as _sys
+        root = os.path.dirname(os.path.abspath(__file__))
+        if root not in _sys.path:
+            _sys.path.insert(0, root)
+        import benchmarks.multichip as mc
+        rows = int(os.environ.get("MULTICHIP_ROWS", str(1 << 18)))
+        summary = mc.run(n_dev, rows)
+        summary.pop("records", None)
+        if summary.get("errors"):
+            # surface per-query failures under the key the completeness
+            # check scans for — a half-dead multichip round is not complete
+            summary["error"] = ("query stages failed: "
+                                f"{sorted(summary['errors'])}")
+        detail["multichip"] = summary
+        emit()
+    stage("multichip", _multichip, budget_guard=True)
+
     def _q3_big():
         q3 = _framework_q3(n, 8)
         detail["q3_compiled_16M"] = {
@@ -865,7 +898,7 @@ def main() -> None:
                "q3_general_4part", "q3_general_8part",
                "q3_general_8part_nojoinagg", "q3_general_8part_nogroup",
                "q3_general_8part_nofuse", "q3_general_8part_nocoalesce",
-               "scan_agg", "q3_compiled_16M")
+               "scan_agg", "multichip", "q3_compiled_16M")
     detail["complete"] = not any(
         isinstance(detail.get(k), dict)
         and ("skipped" in detail[k] or "error" in detail[k])
@@ -889,6 +922,9 @@ def main() -> None:
     skipped = [k for k in ok_keys
                if isinstance(detail.get(k), dict)
                and ("skipped" in detail[k] or "error" in detail[k])]
+    _mc = detail.get("multichip", {}) if isinstance(
+        detail.get("multichip"), dict) else {}
+    _mc_q = (_mc.get("queries") or {}).get("tpch_q3", {})
     summary = {
         "metric": "tpch_q1_framework_throughput",
         "value": headline["value"],
@@ -933,6 +969,17 @@ def main() -> None:
                 sa.get("decode_dispatches_O_row_groups"),
             "scan_agg_speedup_on_vs_off":
                 sa.get("wall_speedup_on_vs_off"),
+            # multichip (mesh data plane): the q3 per-chip throughput, the
+            # fabric collective totals, and the two gate bits — the full
+            # per-query record is detail["multichip"] (cumulative lines) /
+            # the MULTICHIP_r0N round
+            "multichip_q3_per_chip_rows_s": _mc_q.get("per_chip_rows_per_s"),
+            "multichip_collective_launches":
+                _mc.get("collective_launches_total"),
+            "multichip_collective_ms": _mc.get("collective_ms_total"),
+            "multichip_bit_identical": _mc.get("bit_identical_all"),
+            "multichip_O_exchanges":
+                _mc.get("collective_launches_O_exchanges"),
             "elapsed_s": detail.get("elapsed_s"),
             "complete": detail["complete"],
             "skipped_or_failed": skipped or None,

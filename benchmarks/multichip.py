@@ -1,0 +1,189 @@
+"""MULTICHIP bench: sharded multi-chip query execution over the mesh data
+plane (ROADMAP item 2 done-bar).
+
+Runs TPC-H q1/q3/q18 and a TPC-DS sample (q3) through the full framework
+twice per query — mesh session (collective exchanges, grouped root
+dispatch) vs single-device baseline — via
+`spark_rapids_tpu.parallel.sharded.run_mesh_query`, asserting bit-identical
+results and O(exchanges) collective launches, then prints ONE compact
+parseable JSON summary line LAST (per-chip rows/s, collective-time
+breakdown, scaling efficiency vs 1 chip).
+
+Queries are written with explicit column pruning (`select` before
+joins/aggregations, as Spark's optimizer would produce): exchanges carry
+only referenced columns, so int/date/double payloads ride the fabric
+collective while string-carrying exchanges (q1's group keys, q18's final
+c_name aggregation) take the per-map device-resident path and are reported
+as such — the per-query `collective_launches` vs `exchanges` split is the
+honest coverage number.
+
+Usage: python benchmarks/multichip.py [--devices N] [--rows N]
+(on a machine without N real chips, run through
+`__graft_entry__.dryrun_multichip`, which virtualizes an N-device CPU
+platform first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _tpch_tables(s, rows: int, parts: int):
+    import benchmarks.tpch as tpch
+    return tpch.load_tables(s, rows, parts=parts)
+
+
+def _q1(rows: int, parts: int):
+    def build(s):
+        import benchmarks.tpch as tpch
+        return tpch.q1(s, _tpch_tables(s, rows, parts))
+    return build
+
+
+def _q3(rows: int, parts: int):
+    """TPC-H q3 with optimizer-style column pruning: every exchange payload
+    is fixed-width (keys/dates/doubles), so the whole query rides the
+    collective data plane."""
+    def build(s):
+        import spark_rapids_tpu.functions as F
+        t = _tpch_tables(s, rows, parts)
+        cust = (t["customer"].filter(F.col("c_mktsegment") == "BUILDING")
+                .select("c_custkey"))
+        orders = t["orders"].select("o_orderkey", "o_custkey", "o_orderdate")
+        li = t["lineitem"].select("l_orderkey", "l_extendedprice",
+                                  "l_discount")
+        return (cust.join(orders, on=cust["c_custkey"] == orders["o_custkey"])
+                .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
+                .withColumn("revenue",
+                            F.col("l_extendedprice")
+                            * (1 - F.col("l_discount")))
+                .groupBy("o_orderkey", "o_orderdate")
+                .agg(F.sum(F.col("revenue")).alias("revenue"))
+                .sort(F.col("revenue").desc(), "o_orderkey")
+                .limit(10))
+    return build
+
+
+def _q18(rows: int, parts: int):
+    """TPC-H q18, pruned: the join/semi-join exchanges carry int keys and
+    ride the collective; the final aggregation groups on c_custkey (the
+    c_name lookup is equivalent on this schema and keeps the last exchange
+    fixed-width)."""
+    def build(s):
+        import spark_rapids_tpu.functions as F
+        t = _tpch_tables(s, rows, parts)
+        li = t["lineitem"].select("l_orderkey", "l_quantity")
+        orders = t["orders"].select("o_orderkey", "o_custkey",
+                                    "o_orderdate", "o_totalprice")
+        cust = t["customer"].select("c_custkey")
+        big = (li.groupBy("l_orderkey")
+               .agg(F.sum(F.col("l_quantity")).alias("total_qty"))
+               .filter(F.col("total_qty") > 150))
+        return (orders
+                .join(big, on=orders["o_orderkey"] == big["l_orderkey"],
+                      how="leftsemi")
+                .join(cust, on=orders["o_custkey"] == cust["c_custkey"])
+                .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
+                .groupBy("c_custkey", "o_orderkey", "o_orderdate",
+                         "o_totalprice")
+                .agg(F.sum(F.col("l_quantity")).alias("sum_qty"))
+                .sort(F.col("o_totalprice").desc(), "o_orderdate")
+                .limit(100))
+    return build
+
+
+def _tpcds_q3(rows: int, parts: int):
+    """TPC-DS q3 sample, pruned to fixed-width exchange payloads (brand id
+    instead of the brand string in the group keys; the name resolves from
+    item downstream in a real report)."""
+    def build(s):
+        import benchmarks.tpcds as tpcds
+        import spark_rapids_tpu.functions as F
+        t = tpcds.load_tables(s, rows, parts=parts)
+        ss = t["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
+                                     "ss_ext_sales_price")
+        item = (t["item"].filter(F.col("i_manufact_id").between(100, 250))
+                .select("i_item_sk", "i_brand_id"))
+        nov = (t["date_dim"].filter(F.col("d_moy") == 11)
+               .select("d_date_sk", "d_year"))
+        return (ss.join(nov, on=ss["ss_sold_date_sk"] == nov["d_date_sk"])
+                .join(item, on=ss["ss_item_sk"] == item["i_item_sk"])
+                .groupBy("d_year", "i_brand_id")
+                .agg(F.sum(F.col("ss_ext_sales_price")).alias("sum_agg"))
+                .sort("d_year", F.col("sum_agg").desc(), "i_brand_id")
+                .limit(100))
+    return build
+
+
+def run(n_devices: int, rows: int) -> dict:
+    """All four stages; a stage failure records itself and the remaining
+    stages still run (same discipline as bench.py)."""
+    from spark_rapids_tpu.parallel.sharded import run_mesh_query, summarize
+
+    # identical batch segmentation in BOTH runs (one batch per reduce
+    # partition): float partial-aggregation is only bit-reproducible under
+    # identical segmentation — the collective emits ONE block per reduce
+    # partition while the per-map path coalesces several, and a different
+    # batch split changes the float accumulation order (same property as
+    # the reference's GPU-vs-CPU aggregation). Pinning the batch size to
+    # the input isolates what the bit-identity check is FOR: the data
+    # plane moves every row to the right shard, unchanged.
+    extra = {"spark.rapids.sql.batchSizeRows": str(max(rows, 1 << 16))}
+    # fact tables load with parts == mesh size so BOTH plans (mesh and
+    # baseline) are structurally identical: the planner sizes exchanges by
+    # min(shuffle.partitions, child partitions), so fewer input parts would
+    # give the baseline narrower exchanges than the aligned mesh plan —
+    # structurally different plans aggregate floats in different orders
+    stages = [
+        ("tpch_q1", _q1(rows, n_devices), rows),
+        ("tpch_q3", _q3(rows, n_devices), rows),
+        ("tpch_q18", _q18(rows, n_devices), rows),
+        ("tpcds_q3", _tpcds_q3(rows, n_devices), rows),
+    ]
+    records, input_rows, errors, elapsed = [], {}, {}, {}
+    for name, build, n_rows in stages:
+        t0 = time.perf_counter()
+        try:
+            rec = run_mesh_query(name, build, n_devices=n_devices,
+                                 extra_conf=extra)
+            records.append(rec)
+            input_rows[name] = n_rows
+        except Exception as e:  # noqa: BLE001 — keep later stages alive
+            errors[name] = f"{type(e).__name__}: {e}"[:300]
+        elapsed[name] = round(time.perf_counter() - t0, 1)
+    summary = summarize(records, n_devices, input_rows)
+    summary["rows"] = rows
+    summary["stage_elapsed_s"] = elapsed
+    if errors:
+        summary["errors"] = errors
+    import jax
+    summary["platform"] = jax.default_backend()
+    summary["records"] = records
+    return summary
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="mesh size (default: all visible devices)")
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("MULTICHIP_ROWS",
+                                               str(1 << 16))))
+    args = ap.parse_args()
+    import jax
+    n = args.devices or len(jax.devices())
+    summary = run(n, args.rows)
+    records = summary.pop("records", [])
+    # full detail first (humans), then the ONE compact machine-read line
+    print(json.dumps({"detail": records}, indent=None), flush=True)
+    print(json.dumps(summary, separators=(",", ":")), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,8 +6,9 @@
 #   ./ci.sh            SMOKE tier (<15 min): docs drift, compile check,
 #                      tracelint, the fast `-m 'not slow'` tier-1 set, and
 #                      the fixed-seed chaos soak.
-#   SRT_FULL=1 ./ci.sh the smoke tier PLUS the full suite with the
+#   CI_FULL=1 ./ci.sh  the smoke tier PLUS the full suite with the
 #                      MemoryCleaner leak gate — the nightly bar.
+#                      (SRT_FULL=1 is the legacy spelling, still honored.)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -37,13 +38,17 @@ echo "== fast tier-1 gate (not slow) =="
 # general-path surface (opjit cache, stage fusion incl. the join/agg
 # segment stages and partition-batched dispatch counters, pipelined
 # shuffle, basic ops, shuffle/exchange, the query timeline tracer +
-# bundle reconciliation, and the device parquet decode oracles incl.
-# the O(row-groups) dispatch assertion) with the slow markers excluded.
+# bundle reconciliation, the device parquet decode oracles incl. the
+# O(row-groups) dispatch assertion, and the mesh data plane — collective
+# exchange parity across fusion/coalesce, the O(exchanges) launch
+# counter, AQE device statistics, and the lost-shard/slow-link chaos
+# heal) with the slow markers excluded.
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
   tests/test_shuffle.py tests/test_tracelint.py tests/test_obs.py \
   tests/test_parquet_device_decode.py \
+  tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="
@@ -54,8 +59,8 @@ echo "== chaos tier (fixed-seed fault injection) =="
 python -m pytest tests/test_chaos.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
-if [[ "${SRT_FULL:-0}" != "1" ]]; then
-  echo "CI green (smoke tier). Full suite + leak gate: SRT_FULL=1 ./ci.sh"
+if [[ "${CI_FULL:-0}" != "1" && "${SRT_FULL:-0}" != "1" ]]; then
+  echo "CI green (smoke tier). Full suite + leak gate: CI_FULL=1 ./ci.sh"
   exit 0
 fi
 

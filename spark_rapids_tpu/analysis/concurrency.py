@@ -31,8 +31,10 @@ from .registry_check import Finding
 
 #: packages the lint covers (relative to the spark_rapids_tpu package root).
 #: chaos/ holds the fault injector's process-wide singleton + trace state,
-#: reached from every pool thread via the woven injection sites.
-DEFAULT_SUBPACKAGES = ("shuffle", "memory", "execs", "chaos")
+#: reached from every pool thread via the woven injection sites; parallel/
+#: holds the mesh-exchange program cache and collective-launch counters,
+#: reached from concurrent query threads.
+DEFAULT_SUBPACKAGES = ("shuffle", "memory", "execs", "chaos", "parallel")
 
 #: top-level modules with shared state the lint also covers: failure.py's
 #: device-retry path runs on exchange pool threads and prefetch workers.

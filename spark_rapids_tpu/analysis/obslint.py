@@ -1,8 +1,8 @@
 """Observability lint (rule **TL012**): span/event emission discipline.
 
 The obs layer (docs/observability.md) is only trustworthy if engine code
-follows two rules, checked statically here over ``execs/``, ``shuffle/``
-and ``memory/``:
+follows two rules, checked statically here over ``execs/``, ``shuffle/``,
+``memory/`` and ``parallel/`` (the mesh.exchange spans):
 
 1. **Route through the obs API.** Emission sites must use the public
    helpers (``obs.span`` / ``obs.event`` / ``obs.current_span``) — not the
@@ -35,7 +35,7 @@ from typing import List, Optional, Tuple
 from .registry_check import Finding
 
 #: packages the lint covers (relative to the spark_rapids_tpu package root)
-OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory")
+OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory", "parallel")
 
 #: names that count as obs emission entry points when bound from the obs
 #: package (rule 2 scans their call arguments)

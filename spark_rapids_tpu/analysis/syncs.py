@@ -10,7 +10,9 @@ the pattern statically (rule **TL011**, error — baseline the deliberate
 ones with a comment):
 
 * a ``np.asarray(...)``/``np.array(...)`` call whose argument the taint
-  walk grades as a device value, in ``execs/`` or ``shuffle/``;
+  walk grades as a device value, in ``execs/``, ``shuffle/`` or
+  ``parallel/`` (the mesh data plane must not reintroduce unaudited
+  syncs);
 * ``.item()`` on a device value;
 * ``jax.device_get(...)`` anywhere outside the audited helper module.
 
@@ -33,7 +35,7 @@ from .detectors import scan_source
 from .registry_check import Finding
 
 #: packages the lint covers (relative to the spark_rapids_tpu package root)
-SYNC_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle")
+SYNC_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "parallel")
 
 
 def _is_blocking_sync(d) -> bool:

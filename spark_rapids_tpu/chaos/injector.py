@@ -42,7 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 ALL_SITES = (
     "hbm.alloc", "spill.to_host", "spill.to_disk", "device.dispatch",
     "shuffle.serialize", "shuffle.write", "shuffle.read", "ici.fetch",
-    "pipeline.task", "scan.read",
+    "pipeline.task", "scan.read", "mesh.shard", "mesh.link",
 )
 
 ALL_KINDS = (
@@ -65,6 +65,14 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "ici.fetch": ("transient", "latency"),
     "pipeline.task": ("transient", "latency", "io_error"),
     "scan.read": ("corrupt", "truncate", "io_error", "latency"),
+    # mesh data plane (docs/distributed.md): a LOST SHARD (io_error at the
+    # collective read — the exchange converts it into catalog invalidation
+    # so FetchFailed lineage recovery re-runs the collective) and a SLOW
+    # SHARD (latency); a SLOW or FLAPPING ICI LINK fires inside the
+    # collective launch (latency stalls the transfer; transient heals via
+    # with_device_retry re-running the idempotent staging)
+    "mesh.shard": ("io_error", "latency"),
+    "mesh.link": ("transient", "latency"),
 }
 
 _BYTE_KINDS = ("corrupt", "truncate")

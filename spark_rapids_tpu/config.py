@@ -354,6 +354,27 @@ bit-identity cross-check); encrypted files raise the reference's clean
 message naming the file and the CPU fallback route. Coverage matrix and
 fallback rules: docs/io.md.
 
+## Mesh data plane (sharded multi-chip execution)
+
+With `spark.rapids.tpu.mesh.enabled` and `spark.rapids.shuffle.mode=ICI` a
+session becomes a MESH SESSION: the planner re-plans hash exchanges to
+exactly mesh-size reduce partitions (`spark.rapids.tpu.mesh.alignPartitions`)
+and marks every fixed-width exchange collective, so each one materializes
+as ONE `lax.all_to_all` (hash) or shard-0 funnel (single) over the
+interconnect (`spark.rapids.tpu.mesh.collectiveExchange.enabled`) instead
+of per-map catalog puts — the reference's UCX transport re-expressed as an
+XLA collective. Exchange-time per-shard row/byte counters double as the
+AQE partition statistics (no block is ever fetched to answer planning),
+the session's root pull batches every chip's partition into one grouped
+launch (`spark.rapids.tpu.dispatch.partitionBatch`), collective launches
+land in the dispatch accounting under the `mesh_collective` kind inside
+`mesh.exchange` timeline spans, and the lost-shard / slow-link chaos sites
+(`mesh.shard`, `mesh.link`) heal through the same FetchFailed lineage
+recovery as any lost map. Exchanges whose payload has no fixed-width
+device layout (strings, nested) transparently keep the per-map
+device-resident path. Design, fault model and the MULTICHIP bench:
+docs/distributed.md.
+
 ## Robustness
 
 Batch-level work survives memory pressure via spill + retry/split
@@ -511,6 +532,26 @@ MESH_SIZE = _conf("spark.rapids.tpu.mesh.size").doc(
     "Mesh size (number of devices) for the collective exchange; 0 = all "
     "visible devices."
 ).integer(0)
+
+MESH_COLLECTIVE_ENABLED = _conf(
+    "spark.rapids.tpu.mesh.collectiveExchange.enabled").doc(
+    "Materialize eligible exchanges of a mesh session as ONE fabric "
+    "collective (lax.all_to_all for hash partitioning, the shard-0 funnel "
+    "for single partitioning) instead of per-map catalog puts. Off keeps "
+    "the per-map device-resident ICI path (every block still device-side, "
+    "but one materialization per map partition). Requires "
+    "spark.rapids.tpu.mesh.enabled and spark.rapids.shuffle.mode=ICI."
+).boolean(True)
+
+MESH_ALIGN_PARTITIONS = _conf(
+    "spark.rapids.tpu.mesh.alignPartitions").doc(
+    "When a mesh session is active, the planner re-plans hash exchanges to "
+    "exactly mesh-size reduce partitions so every exchange is collective-"
+    "eligible (the on-device murmur3 % n routing must match the shard "
+    "count). Partition count is an execution detail — results are "
+    "identical at any count — so mesh sessions stop depending on the user "
+    "hand-tuning spark.sql.shuffle.partitions to the topology."
+).boolean(True)
 
 COMPILED_AGG_ENABLED = _conf("spark.rapids.tpu.agg.compiledStage.enabled").doc(
     "Fuse eligible scan->filter->project->groupBy pipelines into ONE jitted "

@@ -130,3 +130,17 @@ class DeviceToHostExec(CpuExec):
             with with_time.timed(), profiling.sync_scope(name):
                 t = b.to_arrow()
             yield t
+
+    def execute_partitions(self, ids, ctx_of) -> Iterator:
+        """Grouped root pull (mesh sessions): forward the whole partition
+        group to the device child in ONE multi-partition pull, so a fused
+        top stage runs every chip's partition in a single grouped launch
+        (spark.rapids.tpu.dispatch.partitionBatch) instead of one launch
+        per partition. Emission order matches the per-partition path."""
+        from .. import profiling
+        with_time = self.metrics["downloadTime"]
+        name = self.node_name()
+        for i, b in self.children[0].execute_partitions(ids, ctx_of):
+            with with_time.timed(), profiling.sync_scope(name):
+                t = b.to_arrow()
+            yield i, t

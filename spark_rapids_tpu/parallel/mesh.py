@@ -3,38 +3,51 @@
 This is the framework integration of the UCX-mode shuffle (SURVEY.md §2.7:
 shuffle-plugin/ UCXShuffleTransport.scala, RapidsShuffleInternalManagerBase.
 scala:238): when a jax.sharding.Mesh is configured, `TpuShuffleExchangeExec`
-routes its hash exchange through ONE jitted `shard_map` program whose
+routes its exchange through ONE jitted `shard_map` program whose
 `lax.all_to_all` moves every column's rows between shards over the
 interconnect — XLA schedules the ICI transfers that the reference hand-codes
 as UCX transactions. The exchange is collective: all map inputs are sharded
-row-wise over the mesh, re-bucketed by murmur3(key) % n_shards on-device, and
-each shard receives exactly its reduce partition.
+row-wise over the mesh, re-bucketed by murmur3(key) % n_shards on-device
+(hash partitioning) or funneled to shard 0 (single partitioning — the
+partial→final aggregation / global-limit merge funnel), and each shard
+receives exactly its reduce partition.
 
 Static-shape strategy (XLA cannot size buffers data-dependently):
   1. partition ids are computed per shard-group batch with the normal
      expression path (shuffle/partitioner.py);
-  2. ONE host sync reads the per-(shard, dest) counts and picks a bucketed
-     slot capacity — the analogue of the reference sizing contiguousSplit
-     slices before handing them to the transport;
+  2. ONE audited host sync reads the per-(shard, dest) counts and picks a
+     bucketed slot capacity — the analogue of the reference sizing
+     contiguousSplit slices before handing them to the transport. The SAME
+     counts are the exchange's device-side partition statistics: exact
+     per-reduce row/byte sizes are known at exchange time, so AQE planning
+     (`partition_sizes`) never re-fetches blocks, and the received batches
+     compact under HOST-KNOWN counts (zero per-partition count syncs);
   3. the jitted exchange scatters rows into [n_shards, slot_cap] send
      buffers and `all_to_all`s them; receive-validity rides along.
 Compiled programs are cached by (mesh, capacity, slot_cap, column dtypes) so
-steady-state queries reuse one executable.
+steady-state queries reuse one executable. Every launch lands in the
+process-wide dispatch accounting as kind "mesh_collective"
+(`opjit.record_external_dispatch`) and — when the query tracer is armed —
+inside a `mesh.exchange` span carrying the per-chip send-row breakdown and
+the stage/launch/wait timing split (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..columnar.batch import TpuColumnarBatch, _repad, compact
-from ..columnar.vector import TpuColumnVector, bucket_capacity, row_mask
-from ..config import MESH_ENABLED, MESH_SIZE
+from ..columnar.batch import TpuColumnarBatch, _compact_plan, _repad, gather
+from ..columnar.vector import (TpuColumnVector, audited_device_get,
+                               bucket_capacity, row_mask)
+from ..config import MESH_ENABLED, MESH_SIZE, SHUFFLE_MODE
+from ..obs import tracer as obs
 
 _AXIS = "data"
 
@@ -69,6 +82,18 @@ class MeshContext:
             cls._meshes = {}
 
 
+def mesh_session_active(conf) -> Optional[Mesh]:
+    """The mesh this session's PLANNER should target, or None. A mesh
+    session is active when the mesh is enabled, the shuffle mode is ICI
+    (the collective commits device-resident blocks to the ICI catalog) and
+    the topology offers >= 2 devices — the condition under which
+    plan/overrides.py selects the collective exchange and aligns hash
+    partition counts to the mesh."""
+    if str(conf.get(SHUFFLE_MODE)).upper() != "ICI":
+        return None
+    return MeshContext.get(conf)
+
+
 def mesh_eligible_output(output) -> bool:
     """Static (plan-time) eligibility: every column must have a fixed-width
     device layout for the all_to_all to carry it. Strings/nested fall back to
@@ -79,8 +104,45 @@ def mesh_eligible_output(output) -> bool:
                for a in output)
 
 
-# compiled exchange cache: (mesh, cap, slot_cap, col sig) -> jitted fn
+# compiled exchange cache: (mesh, cap, slot_cap, col sig) -> jitted fn.
+# Guarded: collective exchanges can materialize from concurrent query
+# threads (TL010 — same discipline as the opjit executable cache).
+_CACHE_LOCK = threading.Lock()
 _EXCHANGE_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+
+# collective-launch statistics (bench MULTICHIP stage + the O(exchanges)
+# assertion read these next to opjit calls_by_kind["mesh_collective"]).
+_STATS_LOCK = threading.Lock()
+_STATS = {"launches": 0, "rows_sent": 0, "stage_ns": 0, "launch_ns": 0,
+          "wait_ns": 0}
+
+
+def collective_stats() -> Dict[str, int]:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_collective_stats() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _record_launch(rows: int, stage_ns: int, launch_ns: int,
+                   wait_ns: int) -> None:
+    with _STATS_LOCK:
+        _STATS["launches"] += 1
+        _STATS["rows_sent"] += rows
+        _STATS["stage_ns"] += stage_ns
+        _STATS["launch_ns"] += launch_ns
+        _STATS["wait_ns"] += wait_ns
+
+
+class MeshExchangeResult(NamedTuple):
+    """One collective exchange's outputs + its device-side statistics."""
+    batches: List[TpuColumnarBatch]  # one compacted batch per reduce part
+    rows: List[int]                  # exact received rows per reduce part
+    bytes: List[int]                 # device bytes per reduce part
 
 
 def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
@@ -88,7 +150,8 @@ def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
     """One jitted shard_map program moving `len(sig)` columns + validity via
     all_to_all. `sig` is ((dtype_str, has_validity), ...)."""
     key = (mesh, n_dev, slot_cap, sig)
-    fn = _EXCHANGE_CACHE.get(key)
+    with _CACHE_LOCK:
+        fn = _EXCHANGE_CACHE.get(key)
     if fn is not None:
         return fn
 
@@ -138,34 +201,62 @@ def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
     out_specs = tuple([spec] * (1 + n_cols + n_valid))
     fn = jax.jit(shard_map(exchange, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_rep=False))
-    _EXCHANGE_CACHE[key] = fn
+    with _CACHE_LOCK:
+        _EXCHANGE_CACHE[key] = fn
     return fn
 
 
-def mesh_hash_exchange(mesh: Mesh, group_batches: List[Optional[TpuColumnarBatch]],
+def _fixed_row_bytes(ref: TpuColumnarBatch, has_valid: List[bool]) -> int:
+    """Device bytes per row of a fixed-width batch (carrier itemsize +
+    1 byte per validity lane) — the row→byte scale for the device-side
+    partition statistics."""
+    total = 0
+    for i, c in enumerate(ref.columns):
+        total += int(np.dtype(c.data.dtype).itemsize)
+        if has_valid[i]:
+            total += 1
+    return total
+
+
+def mesh_hash_exchange(mesh: Mesh,
+                       group_batches: List[Optional[TpuColumnarBatch]],
                        pids_list: List[Optional[jnp.ndarray]],
-                       names: Sequence[str]) -> List[TpuColumnarBatch]:
+                       names: Sequence[str],
+                       shuffle_id: int = -1) -> MeshExchangeResult:
     """Collective hash exchange: `group_batches[d]` is the (possibly empty)
     concatenated map input assigned to shard d, `pids_list[d]` its
     destination-partition ids. Returns one compacted device batch per reduce
-    partition (= per shard)."""
+    partition (= per shard) plus the exact per-reduce row/byte counts
+    derived from the sizing counts (the device-side statistics AQE plans
+    against — no block fetch, no extra sync)."""
+    from ..chaos import inject
+    from ..execs import opjit
     n_dev = mesh.devices.size
     assert len(group_batches) == n_dev
+    t_stage0 = time.perf_counter_ns()
     ref = next(b for b in group_batches if b is not None)
     dtypes = [c.dtype for c in ref.columns]
     cap = bucket_capacity(max([b.capacity for b in group_batches
                                if b is not None] + [1]))
 
-    # per-(shard, dest) counts -> slot capacity (ONE host sync for all
-    # shards' pid arrays; a per-shard np.asarray loop would pay one round
-    # trip each on high-latency links)
-    live = [(b, p) for b, p in zip(group_batches, pids_list)
+    # per-(shard, dest) counts -> slot capacity AND the exchange's partition
+    # statistics (ONE audited host sync for all shards' pid arrays; a
+    # per-shard np.asarray loop would pay one round trip each on
+    # high-latency links)
+    live = [(d, b, p) for d, (b, p) in enumerate(zip(group_batches,
+                                                     pids_list))
             if b is not None and b.num_rows]
-    fetched = jax.device_get([p for _b, p in live]) if live else []
+    fetched = audited_device_get([p for _d, _b, p in live], "mesh_counts") \
+        if live else []
     max_count = 1
-    for (b, _p), pids_np in zip(live, fetched):
-        counts = np.bincount(pids_np[: b.num_rows], minlength=n_dev)
+    recv_rows = np.zeros(n_dev, np.int64)
+    send_rows = np.zeros(n_dev, np.int64)
+    for (shard, b, _p), pids_np in zip(live, fetched):
+        counts = np.bincount(np.asarray(pids_np)[: b.num_rows],
+                             minlength=n_dev)
         max_count = max(max_count, int(counts.max()))
+        recv_rows += counts
+        send_rows[shard] += int(counts.sum())
     slot_cap = bucket_capacity(max_count)
 
     # stack per-shard arrays into globally sharded [n_dev * cap] inputs
@@ -209,7 +300,26 @@ def mesh_hash_exchange(mesh: Mesh, group_batches: List[Optional[TpuColumnarBatch
     flat = [shard(col_data[i]) for i in range(len(dtypes))] + \
            [shard(col_valid[i]) for i in range(len(dtypes))]
     fn = _build_exchange(mesh, n_dev, slot_cap, tuple(sig))
-    outs = fn(dest_g, *flat)
+    t_launch0 = time.perf_counter_ns()
+    # chaos `mesh.link`: a slow or flapping ICI link. Latency sleeps here
+    # (the transfer stalls); a transient error propagates to the caller's
+    # with_device_retry, which re-runs the whole (idempotent) staging.
+    inject("mesh.link", detail=f"s{shuffle_id}")
+    with obs.span(f"mesh.exchange s{shuffle_id}", cat="shuffle.collective",
+                  shuffle=shuffle_id, n_dev=n_dev, slot_cap=slot_cap,
+                  per_chip_rows=[int(x) for x in send_rows]):
+        outs = fn(dest_g, *flat)
+        t_wait0 = time.perf_counter_ns()
+        # the collective is the stage boundary: waiting for it here is the
+        # exchange's one blocking device sync (no data moves to host — the
+        # ledger records the wait so per-query sync accounting stays exact)
+        from ..profiling import record_sync
+        record_sync("collective_wait")
+        jax.block_until_ready(outs)
+        t_end = time.perf_counter_ns()
+    opjit.record_external_dispatch("mesh_collective")
+    _record_launch(int(send_rows.sum()), t_launch0 - t_stage0,
+                   t_wait0 - t_launch0, t_end - t_wait0)
     rowok = outs[0]
     pos = 1
     recv_data: List[jnp.ndarray] = []
@@ -223,9 +333,14 @@ def mesh_hash_exchange(mesh: Mesh, group_batches: List[Optional[TpuColumnarBatch
         else:
             recv_valid.append(None)
 
-    # slice per shard, compact out the slot gaps
+    # slice per shard, compact out the slot gaps. The kept-row count per
+    # shard is KNOWN host-side from the sizing counts (slot_cap >= the
+    # largest bucket, so nothing was dropped): compact under the known
+    # count instead of paying one scalar sync per reduce partition.
     local = n_dev * slot_cap
+    row_bytes = _fixed_row_bytes(ref, has_valid)
     results: List[TpuColumnarBatch] = []
+    sizes: List[int] = []
     for r in range(n_dev):
         sl = slice(r * local, (r + 1) * local)
         ok = rowok[sl]
@@ -234,5 +349,33 @@ def mesh_hash_exchange(mesh: Mesh, group_batches: List[Optional[TpuColumnarBatch
             v = recv_valid[i][sl] if recv_valid[i] is not None else None
             cols.append(TpuColumnVector(dt, recv_data[i][sl], v, local))
         batch = TpuColumnarBatch(cols, local, list(names))
-        results.append(compact(batch, ok))
-    return results
+        idx, _n_dev_count = _compact_plan(jnp.asarray(ok), batch.rows_arg)
+        results.append(gather(batch, idx, int(recv_rows[r]),
+                              out_capacity=local))
+        sizes.append(int(recv_rows[r]) * row_bytes)
+    return MeshExchangeResult(results, [int(x) for x in recv_rows], sizes)
+
+
+def mesh_single_exchange(mesh: Mesh,
+                         group_batches: List[Optional[TpuColumnarBatch]],
+                         names: Sequence[str],
+                         shuffle_id: int = -1) -> MeshExchangeResult:
+    """Collective SINGLE-partition funnel: every shard's rows move to shard
+    0 in one all_to_all — the fabric path for partial→final aggregation and
+    global limit/top-N merges (the reduce-scatter analogue: per-shard
+    partial states were already reduced locally by the partial stage; the
+    collective carries only the states). Returns mesh-size results where
+    only reduce partition 0 is non-empty.
+
+    Cost note: this reuses the hash-exchange program with all-zero
+    destinations, so each shard still ships a full [n_dev, slot_cap] send
+    buffer — slot groups 1..n-1 are padding the receivers discard,
+    ~n_dev× the payload in fabric traffic. Acceptable for the state-merge
+    funnels this serves (payloads are per-shard partial STATES, already
+    reduced); a ragged gather / all_gather layout is the follow-up if a
+    row-heavy single exchange ever rides it (ROADMAP item 2)."""
+    pids = [None if b is None
+            else jnp.zeros((b.capacity,), jnp.int32)
+            for b in group_batches]
+    return mesh_hash_exchange(mesh, group_batches, pids, names,
+                              shuffle_id=shuffle_id)

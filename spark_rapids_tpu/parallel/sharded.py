@@ -1,0 +1,235 @@
+"""Plan-driven sharded multi-chip query execution over the mesh data plane.
+
+This module is the driver side of ROADMAP item 2 ("make the ICI mesh the
+production data plane"): given any session query, it runs the SAME plan two
+ways —
+
+  * **mesh**: a mesh session (`spark.rapids.tpu.mesh.enabled`, ICI shuffle
+    mode) where the planner aligns hash exchanges to the mesh, eligible
+    exchanges materialize as ONE fabric collective each
+    (`parallel/mesh.py`), AQE consumes the exchange-time device-side size
+    counters, and the session's root pull drives all partitions through the
+    grouped multi-partition dispatch;
+  * **single-device baseline**: the identical plan with the mesh disabled
+    (per-map device-resident ICI path on the default device) — the
+    bit-identity oracle and the 1-chip denominator for scaling efficiency.
+
+and returns per-query statistics: wall times, per-chip rows/s, the
+collective launch count against the plan's exchange count (the
+O(exchanges) assertion — launches must NOT scale with partitions), and the
+stage/launch/wait breakdown of collective time accumulated by
+`parallel.mesh.collective_stats`.
+
+Unlike the hand-written q1 step this replaces (`distributed.py`, kept for
+the kernel-level dryrun), nothing here is query-specific: the planner —
+not this runner — decides which exchanges ride the fabric, so any
+session query (TPC-H, TPC-DS, ad-hoc DataFrames) shards the same way.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .mesh import collective_stats
+
+
+def mesh_settings(n_devices: int, extra: Optional[Dict[str, str]] = None
+                  ) -> Dict[str, str]:
+    """Session settings for a mesh data-plane session of `n_devices`
+    chips. Compiled whole-stage shortcuts are disabled so every stage
+    boundary is a REAL exchange (the thing this data plane accelerates);
+    the partition batch matches the mesh so whole-stage segments launch
+    once per group."""
+    s = {
+        "spark.rapids.shuffle.mode": "ICI",
+        "spark.rapids.tpu.mesh.enabled": "true",
+        "spark.rapids.tpu.mesh.size": str(n_devices),
+        "spark.sql.shuffle.partitions": str(n_devices),
+        "spark.rapids.tpu.dispatch.partitionBatch": str(n_devices),
+        "spark.sql.autoBroadcastJoinThreshold": "0",
+        "spark.rapids.tpu.agg.compiledStage.enabled": "false",
+        "spark.rapids.tpu.join.compiledStage.enabled": "false",
+    }
+    s.update(extra or {})
+    return s
+
+
+def baseline_settings(n_devices: int,
+                      extra: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, str]:
+    """The single-device baseline: identical plan shape (same partition
+    count, same device-resident ICI shuffle, same disabled shortcuts) with
+    the mesh off — per-map materialization on the default device."""
+    s = mesh_settings(n_devices, extra)
+    s["spark.rapids.tpu.mesh.enabled"] = "false"
+    return s
+
+
+def compare_tables(a, b) -> Tuple[bool, float]:
+    """(bit_identical, max_abs_err) between two Arrow tables after a
+    canonical whole-row sort. Identity is EXACT (float bit patterns, null
+    masks); max_abs_err reports the largest float divergence when not."""
+    import pyarrow as pa
+    if a.num_rows != b.num_rows or a.column_names != b.column_names:
+        return False, float("inf")
+    if a.num_rows:
+        keys = [(n, "ascending") for n in a.column_names]
+        a = a.sort_by(keys)
+        b = b.sort_by(keys)
+    worst = 0.0
+    same = True
+    for name in a.column_names:
+        ca = a.column(name).combine_chunks()
+        cb = b.column(name).combine_chunks()
+        # host Arrow values throughout (the query already collected):
+        # .to_numpy on the pyarrow arrays, never np.asarray on anything the
+        # taint walk could grade device (TL011 covers parallel/)
+        na = ca.is_null().to_numpy(zero_copy_only=False)
+        nb = cb.is_null().to_numpy(zero_copy_only=False)
+        if not np.array_equal(na, nb):
+            return False, float("inf")
+        if pa.types.is_floating(ca.type):
+            va = ca.to_numpy(zero_copy_only=False)
+            vb = cb.to_numpy(zero_copy_only=False)
+            va = np.where(na, 0.0, va)
+            vb = np.where(nb, 0.0, vb)
+            if not np.array_equal(va, vb, equal_nan=True):
+                same = False
+                both = np.isfinite(va) & np.isfinite(vb)
+                if both.any():
+                    worst = max(worst,
+                                float(np.abs(va[both] - vb[both]).max()))
+                else:
+                    worst = float("inf")
+        else:
+            if ca.drop_null().to_pylist() != cb.drop_null().to_pylist():
+                return False, float("inf")
+    return same, worst
+
+
+def _count_exchanges(session) -> int:
+    """Exchange nodes in the last executed plan (the session snapshots the
+    tree for every query — works untraced)."""
+    tree = getattr(session, "_last_plan_tree", None) or []
+    return sum(1 for n in tree if "ShuffleExchange" in str(n.get("name", "")))
+
+
+def _dispatch_kind(kind: str) -> int:
+    from ..execs import opjit
+    return opjit.cache_stats()["calls_by_kind"].get(kind, 0)
+
+
+def run_mesh_query(name: str, build: Callable, *, n_devices: int,
+                   iters: int = 2,
+                   extra_conf: Optional[Dict[str, str]] = None) -> Dict:
+    """Run `build(session) -> DataFrame` on the mesh data plane and on the
+    single-device baseline; return the comparison record (see module
+    docstring). `build` is called once per session — its DataFrame is
+    collected `iters` times on each (first collect warms the executable
+    caches; the best of the rest is the wall time)."""
+    from ..session import TpuSession
+
+    def timed_run(settings, measure: bool) -> Tuple[object, float, Dict]:
+        s = TpuSession(dict(settings))
+        q = build(s)
+        out = q.to_arrow()  # warm: traces/compiles every program
+        best = float("inf")
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            out = q.to_arrow()
+            best = min(best, time.perf_counter() - t0)
+        if not measure:
+            # the baseline contributes only results + wall time — skip the
+            # counter-bracketed extra collect (a whole wasted execution)
+            return out, best, {}
+        # one more collect bracketed by the collective counters: exchanges
+        # re-materialize per collect, so this measures launches PER QUERY
+        before_launches = collective_stats()
+        before_kind = _dispatch_kind("mesh_collective")
+        out = q.to_arrow()
+        stats = collective_stats()
+        delta = {k: stats[k] - before_launches[k] for k in stats}
+        delta["dispatch_kind"] = _dispatch_kind("mesh_collective") \
+            - before_kind
+        return out, best, {"collective": delta,
+                           "exchanges": _count_exchanges(s)}
+
+    out_mesh, wall_mesh, info = timed_run(
+        mesh_settings(n_devices, extra_conf), measure=True)
+    out_one, wall_one, _ = timed_run(
+        baseline_settings(n_devices, extra_conf), measure=False)
+    identical, max_err = compare_tables(out_mesh, out_one)
+    col = info["collective"]
+    launches = col["launches"]
+    # O(exchanges): each exchange materializes at most ONE collective per
+    # query — never one per partition. The dispatch-accounting kind must
+    # agree with the mesh module's own launch counter.
+    launches_ok = (launches <= info["exchanges"]
+                   and launches == col["dispatch_kind"])
+    return {
+        "query": name,
+        "rows_out": out_mesh.num_rows,
+        "n_devices": n_devices,
+        "wall_ms_mesh": round(wall_mesh * 1e3, 1),
+        "wall_ms_single": round(wall_one * 1e3, 1),
+        "scaling_vs_single": round(wall_one / wall_mesh, 3)
+        if wall_mesh > 0 else None,
+        "bit_identical": identical,
+        "max_abs_err": max_err,
+        "exchanges": info["exchanges"],
+        "collective_launches": launches,
+        "collective_launches_O_exchanges": launches_ok,
+        "collective_rows": col["rows_sent"],
+        "collective_stage_ms": round(col["stage_ns"] / 1e6, 2),
+        "collective_launch_ms": round(col["launch_ns"] / 1e6, 2),
+        "collective_wait_ms": round(col["wait_ns"] / 1e6, 2),
+    }
+
+
+def summarize(records: List[Dict], n_devices: int,
+              input_rows: Dict[str, int]) -> Dict:
+    """The MULTICHIP stage's compact summary (ONE parseable line — the
+    r05 lesson: the driver keeps only the stdout tail). Per-chip rows/s is
+    the mesh run's input-row throughput divided by the chip count; scaling
+    efficiency is speedup-over-1-chip / n_chips."""
+    per_query = {}
+    total_launches = 0
+    total_collective_ms = 0.0
+    all_identical = True
+    all_o_exchanges = True
+    for r in records:
+        rows = input_rows.get(r["query"], 0)
+        mesh_s = r["wall_ms_mesh"] / 1e3
+        per_query[r["query"]] = {
+            "rows": rows,
+            "rows_per_s": round(rows / mesh_s, 1) if mesh_s > 0 else None,
+            "per_chip_rows_per_s": round(rows / mesh_s / n_devices, 1)
+            if mesh_s > 0 else None,
+            "wall_ms": r["wall_ms_mesh"],
+            "wall_ms_single": r["wall_ms_single"],
+            "scaling_efficiency": round(
+                (r["scaling_vs_single"] or 0) / n_devices, 3),
+            "bit_identical": r["bit_identical"],
+            "exchanges": r["exchanges"],
+            "collective_launches": r["collective_launches"],
+            "collective_ms": round(r["collective_stage_ms"]
+                                   + r["collective_launch_ms"]
+                                   + r["collective_wait_ms"], 2),
+        }
+        total_launches += r["collective_launches"]
+        total_collective_ms += per_query[r["query"]]["collective_ms"]
+        all_identical = all_identical and r["bit_identical"]
+        all_o_exchanges = all_o_exchanges \
+            and r["collective_launches_O_exchanges"]
+    return {
+        "metric": "multichip_sharded_execution",
+        "n_devices": n_devices,
+        "queries": per_query,
+        "collective_launches_total": total_launches,
+        "collective_ms_total": round(total_collective_ms, 2),
+        "bit_identical_all": all_identical,
+        "collective_launches_O_exchanges": all_o_exchanges,
+    }

@@ -373,14 +373,54 @@ def _tag_exchange(meta: PlanMeta) -> None:
     meta.add_exprs(meta.plan.keys)
 
 
+def _mesh_align_consistent(meta: PlanMeta) -> bool:
+    """May this exchange re-plan to mesh-size partitions without breaking
+    co-partitioning? A join pairs partition i of both inputs, so BOTH of
+    its exchanges must make the same alignment decision — each side
+    independently checks every sibling exchange's static eligibility and
+    aligns only when all would. Non-join parents have no pairing
+    constraint."""
+    from ..parallel.mesh import mesh_eligible_output
+    from ..shuffle.exchange import CpuShuffleExchangeExec
+    parent = meta.parent
+    if parent is None or "Join" not in type(parent.plan).__name__:
+        return True
+    for sib in parent.child_plans:
+        sp = sib.plan
+        if isinstance(sp, CpuShuffleExchangeExec) \
+                and sp.partitioning == "hash" \
+                and not mesh_eligible_output(sp.output):
+            return False
+    return True
+
+
 def _convert_exchange(meta: PlanMeta, ch):
     from ..config import (AQE_COALESCE_ENABLED,
-                          AQE_ADVISORY_PARTITION_BYTES)
+                          AQE_ADVISORY_PARTITION_BYTES,
+                          MESH_ALIGN_PARTITIONS, MESH_COLLECTIVE_ENABLED)
+    from ..parallel.mesh import mesh_eligible_output, mesh_session_active
     from ..shuffle.exchange import (TpuShuffleExchangeExec,
                                     TpuShuffleReaderExec)
     p = meta.plan
-    exch = TpuShuffleExchangeExec(ch[0], p.partitioning, p.keys,
-                                  p.num_partitions())
+    n_out = p.num_partitions()
+    # mesh session (docs/distributed.md): the planner — not a runtime
+    # probe — selects the collective data plane. Hash exchanges re-plan to
+    # mesh-size partitions (alignPartitions) so the on-device murmur3 % n
+    # routing matches the shard count, and eligible exchanges carry
+    # `collective_planned` so materialization runs ONE fabric collective.
+    mesh = mesh_session_active(meta.conf) \
+        if meta.conf.get(MESH_COLLECTIVE_ENABLED) else None
+    eligible = mesh is not None \
+        and p.partitioning in ("hash", "single") \
+        and mesh_eligible_output(ch[0].output)
+    if eligible and p.partitioning == "hash" \
+            and meta.conf.get(MESH_ALIGN_PARTITIONS) \
+            and _mesh_align_consistent(meta):
+        n_out = mesh.devices.size
+    exch = TpuShuffleExchangeExec(ch[0], p.partitioning, p.keys, n_out)
+    if eligible and (p.partitioning == "single"
+                     or n_out == mesh.devices.size):
+        exch.collective_planned = True
     # AQE partition coalescing (reference GpuCustomShuffleReaderExec).
     # NOT applied when the exchange feeds a co-partitioned join: each side
     # would coalesce on its own sizes and partition i of the left would no

@@ -1084,19 +1084,33 @@ class TpuSession:
             if qroot is not None:
                 from .execs import opjit
                 opjit_before = opjit.cache_stats()["calls_by_kind"]
+        from .parallel.mesh import mesh_session_active
+        # mesh session (docs/distributed.md): the root pull drives ALL
+        # partitions through the multi-partition entry point in one group,
+        # so the top whole-stage segment (between the last exchange and the
+        # result) executes every chip's partition in a single grouped
+        # launch — the same batched dispatch the exchange map side uses
+        n_parts = final.num_partitions()
+        group_pull = n_parts > 1 and mesh_session_active(conf) is not None
         tables = []
         try:
-            for p in range(final.num_partitions()):
-                ctx = TaskContext(p, conf)
+            if group_pull:
+                ids = list(range(n_parts))
+                ctxs = {}
+
+                def ctx_of(i):
+                    c = ctxs.get(i)
+                    if c is None:
+                        c = ctxs[i] = TaskContext(i, conf)
+                    return c
+
                 try:
-                    with obs.span(f"partition {p}", cat="task", partition=p):
-                        for t in final.execute_partition(p, ctx):
+                    with obs.span(f"partition group 0-{ids[-1]}", cat="task",
+                                  partitions=n_parts):
+                        for _p, t in final.execute_partitions(ids, ctx_of):
                             if t.num_rows:
                                 tables.append(t.rename_columns(names))
                 except BaseException as exc:
-                    # fatal device errors capture diagnostics and (outside
-                    # tests) exit so the cluster manager reschedules
-                    # (reference RapidsExecutorPlugin.onTaskFailed)
                     from .config import FATAL_ERROR_EXIT
                     from .failure import handle_task_failure
                     handle_task_failure(
@@ -1104,7 +1118,29 @@ class TpuSession:
                         exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
                     raise
                 finally:
-                    ctx.complete()
+                    for c in ctxs.values():
+                        c.complete()
+            else:
+                for p in range(n_parts):
+                    ctx = TaskContext(p, conf)
+                    try:
+                        with obs.span(f"partition {p}", cat="task",
+                                      partition=p):
+                            for t in final.execute_partition(p, ctx):
+                                if t.num_rows:
+                                    tables.append(t.rename_columns(names))
+                    except BaseException as exc:
+                        # fatal device errors capture diagnostics and
+                        # (outside tests) exit so the cluster manager
+                        # reschedules (RapidsExecutorPlugin.onTaskFailed)
+                        from .config import FATAL_ERROR_EXIT
+                        from .failure import handle_task_failure
+                        handle_task_failure(
+                            exc, conf,
+                            exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
+                        raise
+                    finally:
+                        ctx.complete()
         finally:
             # snapshot metrics into plain dicts so the plan (and any device
             # buffers it references) is not pinned past the query

@@ -225,20 +225,26 @@ class _ExchangeBase:
 
     def partition_sizes(self, ctx: TaskContext) -> List[int]:
         """Post-materialization byte size per reduce partition (the map
-        output statistics AQE plans against)."""
+        output statistics AQE plans against). ICI mode serves DEVICE-SIDE
+        counters: the collective keeps the exchange-time per-shard byte
+        counts, and the per-map catalog tracks block sizes at put time —
+        neither path fetches (or unspills) a block to answer AQE."""
         import os
         self._ensure_materialized(ctx)
+        if getattr(self, "_collective", False):
+            return list(self._collective_sizes)
         sizes = [0] * self._n_out
         if self._shuffle_mode(ctx) == "ICI":
             from .ici import IciShuffleCatalog
             catalog = IciShuffleCatalog.get()
             mgr2 = TpuShuffleManager.get(ctx.conf)
-            for r in range(self._n_out):
-                # same bounded recovery as the read path: re-run lost maps
-                blocks = self._ici_fetch_blocks(r, ctx, mgr2, catalog)
-                for b in blocks:
-                    sizes[r] += b.device_memory_size()
-            return sizes
+            # same bounded FetchFailed recovery as the read path (a lost
+            # map's sizes are unknowable until its output is re-run), but
+            # the sizes themselves come from catalog metadata, not blocks
+            return self._ici_recovering_fetch(
+                -1, ctx, mgr2,
+                lambda: catalog.reduce_sizes(self._shuffle_id, self._n_maps,
+                                             self._n_out))
         mgr = TpuShuffleManager.get(ctx.conf)
         for r in range(self._n_out):
             for m in range(self._n_maps):
@@ -246,6 +252,15 @@ class _ExchangeBase:
                 if os.path.exists(p):
                     sizes[r] += os.path.getsize(p)
         return sizes
+
+    def partition_row_counts(self, ctx: TaskContext) -> Optional[List[int]]:
+        """Exact per-reduce ROW counts when the exchange materialized
+        collectively (from the device-side sizing counters); None when only
+        byte sizes are known (per-map paths)."""
+        self._ensure_materialized(ctx)
+        if getattr(self, "_collective", False):
+            return list(self._collective_rows)
+        return None
 
     def map_block_sizes(self, reduce_id: int, ctx: TaskContext) -> List[int]:
         """Per-map byte sizes of one reduce partition — the granularity AQE
@@ -315,14 +330,10 @@ class _ExchangeBase:
 
     def _ici_fetch_blocks(self, idx: int, ctx: TaskContext, mgr, catalog,
                           metric=None) -> List:
-        """ICI-mode reduce fetch with the same conf-bounded lineage
-        recovery: transient runtime errors heal via with_device_retry, a
+        """ICI-mode reduce fetch with conf-bounded lineage recovery:
+        transient runtime errors heal via with_device_retry, a
         FetchFailedError (lost peer, invalidated output, corrupted spill
         tier) re-runs the missing map tasks."""
-        from ..failure import with_device_retry
-        from .ici import FetchFailedError
-        limit = self._fetch_retry_limit(ctx)
-
         def fetch():
             if metric is not None:
                 with metric.timed():
@@ -331,6 +342,15 @@ class _ExchangeBase:
             return list(catalog.iter_blocks(self._shuffle_id, idx,
                                             self._n_maps))
 
+        return self._ici_recovering_fetch(idx, ctx, mgr, fetch)
+
+    def _ici_recovering_fetch(self, idx: int, ctx: TaskContext, mgr, fetch):
+        """Run `fetch` (blocks, sizes, any catalog read) under the shared
+        ICI recovery discipline: with_device_retry for transients, bounded
+        re-materialization of exactly the maps a FetchFailedError names."""
+        from ..failure import with_device_retry
+        from .ici import FetchFailedError
+        limit = self._fetch_retry_limit(ctx)
         failures = 0
         while True:
             try:
@@ -385,28 +405,64 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         return {"partitionTime": "MODERATE", "serializationTime": "MODERATE",
                 "deserializationTime": "MODERATE"}
 
+    def _collective_mesh(self, ctx: TaskContext):
+        """The mesh this exchange's collective would run on, or None.
+        Plan-time selection (plan/overrides.py sets `collective_planned`
+        when a mesh session is active) covers hash AND single
+        partitionings; un-planned exchanges (hand-assembled plans, tests)
+        keep the dynamic hash-only eligibility check."""
+        if self._shuffle_mode(ctx) != "ICI":
+            return None
+        from ..config import MESH_COLLECTIVE_ENABLED
+        if not ctx.conf.get(MESH_COLLECTIVE_ENABLED):
+            return None
+        from ..parallel.mesh import (MeshContext, mesh_eligible_output,
+                                     mesh_session_active)
+        if not mesh_eligible_output(self.output):
+            return None
+        if getattr(self, "collective_planned", False):
+            mesh = mesh_session_active(ctx.conf)
+        elif self.partitioning == "hash":
+            mesh = MeshContext.get(ctx.conf, self._n_out)
+        else:
+            return None
+        if mesh is None:
+            return None
+        # hash routing computes murmur3 % n_shards on-device: the reduce
+        # partition count must equal the mesh size exactly (the planner's
+        # alignPartitions pass guarantees this for mesh sessions)
+        if self.partitioning == "hash" \
+                and mesh.devices.size != self._n_out:
+            return None
+        return mesh
+
     def _try_materialize_collective(self, sid: int, ctx: TaskContext) -> bool:
         """ICI-mesh data plane (reference UCX mode, shuffle-plugin/
         UCXShuffleTransport.scala): ONE jitted all_to_all moves every shard's
-        hash-bucketed rows to its reduce partition's shard. Used when a mesh
-        is configured, the exchange is a hash partitioning onto exactly
-        mesh-size partitions, and all columns have fixed-width device layouts.
-        Results land in the device-resident catalog keyed as a single
-        collective map output; FetchFailed recovery re-runs the collective."""
-        if self._shuffle_mode(ctx) != "ICI" or self.partitioning != "hash":
-            return False
-        from ..parallel.mesh import (MeshContext, mesh_eligible_output,
-                                     mesh_hash_exchange)
-        mesh = MeshContext.get(ctx.conf, self._n_out)
+        hash-bucketed rows to its reduce partition's shard (or funnels every
+        shard's rows to shard 0 for single partitioning — the partial→final
+        aggregation merge). Used when a mesh session is active (planner
+        selection) or the exchange is a hash partitioning onto exactly
+        mesh-size partitions, and all columns have fixed-width device
+        layouts. Results land in the device-resident catalog keyed as a
+        single collective map output, with the exchange-time per-shard
+        row/byte counters kept as the partition statistics AQE plans
+        against; FetchFailed recovery re-runs the collective."""
+        # a re-materialization (next query after cleanup_shuffle) must not
+        # inherit the previous query's collective verdict: if this attempt
+        # declines or falls back, the per-map path owns the shuffle id
+        self._collective = False
+        mesh = self._collective_mesh(ctx)
         if mesh is None:
             return False
-        if not mesh_eligible_output(self.output):
-            return False
         from ..columnar.batch import concat_batches
+        from ..failure import with_device_retry
         from ..memory.hbm import TpuOOM
         from ..memory.spill import SpillableColumnarBatch
+        from ..parallel.mesh import mesh_hash_exchange, mesh_single_exchange
+        from ..profiling import sync_scope
         from .ici import IciShuffleCatalog
-        n_dev = self._n_out
+        n_dev = mesh.devices.size
         child = self.children[0]
         # collect per-shard groups as SPILLABLE batches so HBM pressure from
         # later map partitions can evict earlier outputs (the per-map ICI path
@@ -424,21 +480,34 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             if not any(groups):
                 IciShuffleCatalog.get().mark_map_complete(sid, 0)
                 self._collective = True
+                self._collective_rows = [0] * self._n_out
+                self._collective_sizes = [0] * self._n_out
                 return True
-            with self.metrics["partitionTime"].timed():
-                batches = []
-                for g in groups:
-                    if not g:
-                        batches.append(None)
-                        continue
-                    got = [sb.get_batch() for sb in g]
-                    batches.append(concat_batches(got) if len(got) > 1
-                                   else got[0])
-                pids = [hash_partition_ids(b, self.keys, n_dev, ctx,
-                                           metrics=self.metrics)
-                        if b is not None else None for b in batches]
-                parts = mesh_hash_exchange(mesh, batches, pids,
-                                           [a.name for a in self.output])
+
+            def run_collective():
+                # idempotent: a transient fault on the fabric (chaos
+                # mesh.link) re-stages from the still-open spillables
+                with self.metrics["partitionTime"].timed(), \
+                        sync_scope(self.node_name()):
+                    batches = []
+                    for g in groups:
+                        if not g:
+                            batches.append(None)
+                            continue
+                        got = [sb.get_batch() for sb in g]
+                        batches.append(concat_batches(got) if len(got) > 1
+                                       else got[0])
+                    names = [a.name for a in self.output]
+                    if self.partitioning == "single":
+                        return mesh_single_exchange(mesh, batches, names,
+                                                    shuffle_id=sid)
+                    pids = [hash_partition_ids(b, self.keys, n_dev, ctx,
+                                               metrics=self.metrics)
+                            if b is not None else None for b in batches]
+                    return mesh_hash_exchange(mesh, batches, pids, names,
+                                              shuffle_id=sid)
+
+            result = with_device_retry(run_collective, ctx.conf)
         except TpuOOM:
             # memory pressure while staging the collective: the per-map path
             # has the full incremental-spill discipline; drop any partial
@@ -450,21 +519,46 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 for sb in g:
                     sb.close()
         catalog = IciShuffleCatalog.get()
-        for r, blk in enumerate(parts):
-            if blk.num_rows:
+        for r in range(self._n_out):
+            blk = result.batches[r]
+            if result.rows[r]:
                 catalog.put_block(sid, 0, r, blk, owner="mesh-collective")
         catalog.mark_map_complete(sid, 0)
         self._collective = True
+        # device-side partition statistics: exact per-reduce row/byte counts
+        # from the exchange's sizing counters — partition_sizes (AQE) serves
+        # these without fetching (or unspilling) a single block
+        self._collective_rows = list(result.rows[: self._n_out])
+        self._collective_sizes = list(result.bytes[: self._n_out])
         return True
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
                          mgr, gate_device: bool = False) -> None:
         if getattr(self, "_collective", False):
             # collective recovery: re-run the whole exchange (a lost block in
-            # mesh mode means the collective result was invalidated)
-            self._try_materialize_collective(sid, ctx)
+            # mesh mode means the collective result was invalidated). The
+            # per-map fallback is NOT sound here — map id 0 covers the whole
+            # child, not child partition 0 — so a failed re-run must raise.
+            if not self._try_materialize_collective(sid, ctx):
+                raise RuntimeError(
+                    f"shuffle {sid}: collective re-materialization failed "
+                    f"(mesh no longer eligible)")
             return
         super()._materialize_map(sid, map_id, ctx, mgr, gate_device)
+
+    def _chaos_lost_shard(self, idx: int, catalog) -> None:
+        """Chaos `mesh.shard`: a shard's HBM lost the collective output
+        (peer chip dropped). Converts the injected io_error into catalog
+        invalidation so the fetch path raises FetchFailedError and the
+        existing lineage recovery re-runs the collective — exactly how a
+        real lost peer heals (Spark: lost executor → stage retry)."""
+        if not getattr(self, "_collective", False):
+            return
+        from ..chaos import inject
+        try:
+            inject("mesh.shard", detail=f"s{self._shuffle_id}r{idx}")
+        except OSError:
+            catalog.invalidate_map(self._shuffle_id, 0)
 
     def _device_parts(self, map_id: int, ctx: TaskContext) -> Iterator[List]:
         """Device partition-split of each input batch (shared by both
@@ -703,6 +797,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             from .ici import IciShuffleCatalog
             catalog = IciShuffleCatalog.get()
             mgr = TpuShuffleManager.get(ctx.conf)
+            self._chaos_lost_shard(idx, catalog)
             blocks = self._ici_fetch_blocks(
                 idx, ctx, mgr, catalog,
                 metric=self.metrics["deserializationTime"])

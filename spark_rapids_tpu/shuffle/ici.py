@@ -209,6 +209,31 @@ class IciShuffleCatalog:
         self._owner.pop((shuffle_id, map_id), None)
         self._complete.discard((shuffle_id, map_id))
 
+    def reduce_sizes(self, shuffle_id: int, n_maps: int,
+                     n_reduces: int) -> List[int]:
+        """Per-reduce-partition byte totals from catalog metadata alone
+        (sizes are tracked at put time from the spillable's device byte
+        count — AQE statistics never unspill or fetch a block). Raises
+        FetchFailedError for incomplete maps, exactly like the block fetch,
+        so the caller's recovery loop re-runs lost maps first."""
+        with self._mu:
+            missing = [m for m in range(n_maps)
+                       if (shuffle_id, m) not in self._complete]
+            if missing:
+                raise FetchFailedError(shuffle_id, missing)
+            out = [0] * n_reduces
+            for (sid, _m, r), sb in self._blocks.items():
+                if sid == shuffle_id and r < n_reduces:
+                    out[r] += sb.size_bytes
+            return out
+
+    def invalidate_map(self, shuffle_id: int, map_id: int) -> None:
+        """Drop one map's blocks + completion (a lost peer/shard observed
+        by a reader): the next fetch raises FetchFailedError and lineage
+        recovery re-runs exactly this map."""
+        with self._mu:
+            self._invalidate_map_locked(shuffle_id, map_id)
+
     def block_sizes(self, shuffle_id: int, reduce_id: int,
                     n_maps: int) -> List[int]:
         """Per-map device byte sizes of one reduce partition — one lock pass

@@ -70,6 +70,25 @@ if os.environ.get("SRT_LEAK_PER_TEST"):
                       file=sys.stderr)
 
 
+@pytest.fixture()
+def collective_spy(monkeypatch):
+    """Records each exchange materialization's collective verdict (True =
+    the mesh all_to_all ran, False = per-map fallback). Shared by the mesh
+    shuffle + mesh data-plane suites."""
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+    runs = []
+    orig = TpuShuffleExchangeExec._try_materialize_collective
+
+    def spy(self, sid, ctx):
+        used = orig(self, sid, ctx)
+        runs.append(used)
+        return used
+
+    monkeypatch.setattr(TpuShuffleExchangeExec,
+                        "_try_materialize_collective", spy)
+    return runs
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_jax_state():
     """The full suite compiles thousands of XLA CPU executables in one

@@ -22,21 +22,8 @@ MESH_CONF = {
 }
 
 
-@pytest.fixture()
-def collective_spy(monkeypatch):
-    """Asserts the collective all_to_all path actually materialized at least
-    one exchange (not the per-map fallback)."""
-    runs = []
-    orig = TpuShuffleExchangeExec._try_materialize_collective
-
-    def spy(self, sid, ctx):
-        used = orig(self, sid, ctx)
-        runs.append(used)
-        return used
-
-    monkeypatch.setattr(TpuShuffleExchangeExec, "_try_materialize_collective",
-                        spy)
-    return runs
+# the collective_spy fixture (records per-exchange collective verdicts)
+# lives in conftest.py, shared with tests/test_mesh_dataplane.py
 
 
 def _tables(seed=7, n=5000, n2=400):
