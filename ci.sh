@@ -26,12 +26,21 @@ python -m compileall -q spark_rapids_tpu tools benchmarks tests bench.py __graft
 
 echo "== tracelint (trace-safety & registry consistency) =="
 # Static analyzer (docs/analysis.md): eval_tpu implementations vs the
-# plan/typechecks.py host_assisted declarations, registry drift, and the
-# unlocked-module-state concurrency lint. Fails on any finding not in
-# tools/tracelint_baseline.txt. The docs-drift gate above doubles as the
-# freshness gate for the analyzer-sourced execution-mode column in
-# docs/supported_ops.md.
+# plan/typechecks.py host_assisted declarations, registry drift, the
+# unlocked-module-state concurrency lint, and the TL02x resource-lifetime
+# + lock-discipline passes (leak-freedom on all paths, blocking-under-
+# lock, the declared lock order, chaos coverage of unwind paths). Fails
+# on any finding not in tools/tracelint_baseline.txt. The docs-drift gate
+# above doubles as the freshness gate for the analyzer-sourced
+# execution-mode column in docs/supported_ops.md.
 python -m tools.tracelint
+
+echo "== api validation (registry + conf consistency) =="
+# Structural registry contracts plus the conf-consistency check: every
+# spark.rapids.tpu.*/spark.rapids.shuffle.* key read in the package is
+# declared in config.py and documented in docs/configs.md, and vice
+# versa (no documented-but-dead or declared-but-dead keys).
+python -m tools.api_validation
 
 echo "== fast tier-1 gate (not slow) =="
 # Fail fusion/pipelining/dispatch regressions in minutes: the hot
@@ -47,7 +56,7 @@ python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
   tests/test_shuffle.py tests/test_tracelint.py tests/test_obs.py \
-  tests/test_parquet_device_decode.py \
+  tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   -x -q -m 'not slow' -p no:cacheprovider
 

@@ -11,6 +11,13 @@ Public surface for tools/tracelint.py, tools/gen_docs.py and the tests:
 * :func:`lint_obs_tree` — span/event emission discipline in execs/,
   shuffle/ and memory/: route through the obs API, never sync inside an
   event argument (TL012).
+* :func:`lint_lifecycle_tree` — resource-lifetime pass over execs/,
+  shuffle/, memory/, parallel/, io/ and session.py: leak-freedom on all
+  paths incl. exceptions (TL020) and chaos coverage of the unwind paths
+  (TL023).
+* :func:`lint_locks_tree` — lock discipline: no blocking op under a
+  process-wide lock (TL021), global lock graph vs the declared partial
+  order (TL022).
 * :func:`corroborate` — dynamic ``jax.eval_shape`` probe vs the static
   verdicts (TL005).
 * :func:`scan_source` / :func:`scan_function` — detector layer over raw
@@ -25,18 +32,21 @@ from .astwalk import (CONDITIONAL_HOST, DEVICE, HOST, UNTRACEABLE, Detection,
                       FunctionReport, ModuleIndex, worst)
 from .concurrency import lint_module_source, lint_tree
 from .detectors import DETECTOR_IDS, scan_function, scan_source
+from .lifecycle import lint_lifecycle_module, lint_lifecycle_tree
+from .locks import LOCK_ORDER, lint_locks_module, lint_locks_tree
 from .obslint import lint_obs_module, lint_obs_tree
 from .registry_check import (ExprReport, Finding, analyze_registry,
                              classify_class, execution_modes)
 from .syncs import lint_sync_module, lint_sync_tree
 
 __all__ = [
-    "CONDITIONAL_HOST", "DEVICE", "HOST", "UNTRACEABLE", "Detection",
-    "DETECTOR_IDS", "ExprReport", "Finding", "FunctionReport", "ModuleIndex",
-    "analyze_registry", "classify_class", "corroborate", "execution_modes",
-    "lint_module_source", "lint_obs_module", "lint_obs_tree",
-    "lint_sync_module", "lint_sync_tree", "lint_tree",
-    "scan_function", "scan_source", "worst",
+    "CONDITIONAL_HOST", "DEVICE", "HOST", "LOCK_ORDER", "UNTRACEABLE",
+    "Detection", "DETECTOR_IDS", "ExprReport", "Finding", "FunctionReport",
+    "ModuleIndex", "analyze_registry", "classify_class", "corroborate",
+    "execution_modes", "lint_lifecycle_module", "lint_lifecycle_tree",
+    "lint_locks_module", "lint_locks_tree", "lint_module_source",
+    "lint_obs_module", "lint_obs_tree", "lint_sync_module",
+    "lint_sync_tree", "lint_tree", "scan_function", "scan_source", "worst",
 ]
 
 

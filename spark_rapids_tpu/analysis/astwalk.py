@@ -495,6 +495,51 @@ def _root_name(node: ast.AST) -> Optional[str]:
     return None
 
 
+def call_name(node: ast.Call) -> Optional[str]:
+    """Bare callee name of a call: `f(...)` -> 'f', `x.m(...)` -> 'm'.
+    Shared by the concurrency/lifecycle/lock passes."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def lockish(name: str) -> bool:
+    """Does a name look like a lock? One heuristic for every pass (TL010
+    lock recognition, TL021/TL022 graph nodes, TL020's transparent
+    lock-`with` scan) so a naming-pattern tweak cannot diverge them."""
+    low = name.lower()
+    return "lock" in low or "mutex" in low or low.endswith("_mu") \
+        or low == "_mu"
+
+
+def iter_module_sources(root=None, subpackages=(), modules=()):
+    """Yield ``(relpath, source)`` for every module a tree-wide lint pass
+    covers — the one walk shared by TL010/TL011/TL012/TL02x so an
+    exclusion rule applies to every pass at once. ``root`` defaults to
+    the spark_rapids_tpu package directory."""
+    import os
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for sub in subpackages:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(d, fname)) as f:
+                yield f"{sub}/{fname}", f.read()
+    for fname in modules:
+        path = os.path.join(root, fname)
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            yield fname, f.read()
+
+
 def terminates(body: Sequence[ast.stmt]) -> bool:
     """All paths through `body` leave the function/loop (return/raise/
     continue/break)."""

@@ -23,10 +23,9 @@ construction) are exempt.
 from __future__ import annotations
 
 import ast
-import os
 from typing import Iterable, List, Optional, Set, Tuple
 
-from .astwalk import ModuleIndex
+from .astwalk import ModuleIndex, lockish as _lockish
 from .registry_check import Finding
 
 #: packages the lint covers (relative to the spark_rapids_tpu package root).
@@ -78,12 +77,6 @@ def _module_mutables(tree: ast.Module) -> Set[str]:
                 if isinstance(t, ast.Name):
                     out.add(t.id)
     return out
-
-
-def _lockish(name: str) -> bool:
-    low = name.lower()
-    return "lock" in low or "mutex" in low or low.endswith("_mu") \
-        or low == "_mu"
 
 
 class _FnLint(ast.NodeVisitor):
@@ -206,25 +199,8 @@ def lint_tree(root: Optional[str] = None,
               modules: Tuple[str, ...] = DEFAULT_MODULES
               ) -> List[Finding]:
     """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
-    if root is None:
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from .astwalk import iter_module_sources
     findings: List[Finding] = []
-    for sub in subpackages:
-        d = os.path.join(root, sub)
-        if not os.path.isdir(d):
-            continue
-        for fname in sorted(os.listdir(d)):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(d, fname)
-            with open(path) as f:
-                src = f.read()
-            findings.extend(lint_module_source(src, f"{sub}/{fname}"))
-    for fname in modules:
-        path = os.path.join(root, fname)
-        if not os.path.isfile(path):
-            continue
-        with open(path) as f:
-            src = f.read()
-        findings.extend(lint_module_source(src, fname))
+    for relpath, src in iter_module_sources(root, subpackages, modules):
+        findings.extend(lint_module_source(src, relpath))
     return findings

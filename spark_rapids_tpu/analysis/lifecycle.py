@@ -1,0 +1,914 @@
+"""Resource-lifetime lint (rules **TL020** / **TL023**): leak-freedom on
+every path, and chaos coverage of the unwind paths the proof relies on.
+
+ROADMAP item 1 (concurrent multi-tenant serving) needs "zero permit/HBM
+leaks" with many sessions sharing one device pool.  Today that property is
+only checked *dynamically* — chaos soaks assert `MemoryCleaner` growth is
+zero and every `TpuSemaphore` permit returns.  The reference plugin enforces
+the discipline structurally (`RapidsBufferCatalog` ownership,
+`GpuSemaphore` acquire/release pairing, the `Retryable` contract); this
+pass enforces it statically, before the scheduler multiplies acquire sites:
+
+**TL020** — a resource acquisition whose release is not guaranteed on all
+paths *including exception paths*.  Tracked acquisitions:
+
+* ``SpillableColumnarBatch(...)`` (cleaner-registered; close() frees the
+  catalog handle + HBM)
+* ``OutOfCoreSorter(...)`` (owns a list of spillable runs)
+* ``FileCache...range_reader(...)`` / ``RangeReader(...)`` / ``open(...)``
+  (open file handles)
+* ``ThreadPoolExecutor(...)`` (worker threads), ``prefetch_iterator(...)``
+  (producer thread)
+* ``obs.begin_query(...)`` (arms the process-wide tracer: a missed
+  ``end_query`` leaves every later query untraced)
+* ``TpuSemaphore...acquire_if_necessary(ctx)`` on a **locally created**
+  ``TaskContext`` (the permit releases via the completion listener, so the
+  guarantee is ``ctx.complete()`` in a ``finally``; a ctx received as a
+  parameter is caller-owned)
+
+A tracked acquisition is accepted when it is
+
+* the context expression of a ``with`` (RAII), or
+* released (``close``/``shutdown``/``complete``/``end_query``/a helper
+  whose summary releases its parameter) in a ``finally`` whose ``try``
+  covers the acquisition — or begins after it with only non-raising
+  statements in between, or
+* released in straight-line code with **no raise-capable statement**
+  between acquisition and release, or
+* ownership-transferred: returned/yielded, stored on ``self``/into a
+  container that is itself released or returned, or passed to a recognized
+  ownership-taking sink (``with_retry``/``with_retry_no_split`` close their
+  spillable; the shuffle catalogs own committed blocks).
+
+Helper summaries (same-module functions/methods, two passes like
+astwalk's) make the check interprocedural: a ``finally`` calling
+``self._finish_query_profile(qroot, ...)`` counts as releasing ``qroot``
+because that method passes it to ``end_query``.
+
+**TL023** — resource-scope chaos coverage: inside a TL020-tracked scope
+(the ``try`` body protecting a tracked resource, or a resource ``with``
+body), every raise-capable *external boundary* (raw file IO, device
+dispatch waits) must sit under a registered chaos site from
+``chaos/injector.py``'s ``ALL_SITES`` — either the callable is known to
+inject one internally (the WIRED table below, validated against
+``ALL_SITES`` at import), or an ``inject("site")`` call covers the scope.
+Otherwise the unwind path the TL020 verdict just proved safe can never be
+*exercised* by the soaks — an untestable proof rots.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astwalk import call_name as _call_name, lockish as _lockish
+from .registry_check import Finding
+
+#: packages/modules the lint covers (relative to the spark_rapids_tpu root)
+LIFECYCLE_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory",
+                                          "parallel", "io")
+LIFECYCLE_MODULES: Tuple[str, ...] = ("session.py", "filecache.py")
+
+#: constructor / factory names that ACQUIRE a resource, -> (kind, releases)
+RESOURCE_CTORS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "SpillableColumnarBatch": ("spillable", ("close",)),
+    "OutOfCoreSorter": ("ooc-sorter", ("close",)),
+    "RangeReader": ("file-handle", ("close",)),
+    "DeviceFileDecoder": ("file-handle", ("close",)),
+    "open": ("file-handle", ("close",)),
+    "ThreadPoolExecutor": ("thread-pool", ("shutdown",)),
+    "prefetch_iterator": ("prefetch", ("close",)),
+    "begin_query": ("query-trace", ()),  # released via end_query(name)
+}
+
+#: attribute-call acquirers (receiver-independent): x.range_reader(...)
+RESOURCE_METHODS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "range_reader": ("file-handle", ("close",)),
+}
+
+#: functions that release the resource passed as their first argument
+RELEASE_FUNCS = frozenset(("end_query",))
+
+#: method names that release their receiver
+RELEASE_METHODS = frozenset(("close", "shutdown", "complete", "unpersist",
+                             "stop"))
+
+#: callables that take OWNERSHIP of a resource argument (close it on every
+#: path themselves — with_retry's finally, the catalogs' handle ownership)
+TRANSFER_SINKS = frozenset((
+    "with_retry", "with_retry_no_split", "split_in_half",
+    "materialize_spillable_counts",  # reads only, never escapes/raises
+))
+
+#: call names that never raise for our purposes (safe between an
+#: acquisition and its release/transfer)
+_SAFE_CALLS = frozenset((
+    "len", "int", "float", "bool", "str", "isinstance", "issubclass",
+    "getattr", "hasattr", "id", "range", "enumerate", "zip", "list",
+    "dict", "tuple", "set", "sorted", "min", "max", "repr", "type",
+))
+_SAFE_METHODS = frozenset((
+    "append", "add", "get", "items", "keys", "values", "extend", "pop",
+    "setdefault", "discard",
+))
+
+# --- TL023 tables -----------------------------------------------------------
+
+#: raise-capable external boundaries: direct calls by (dotted-suffix) name
+BOUNDARY_CALLS = {
+    "open": "io", "copyfile": "io", "replace": "io", "unlink": "io",
+    "mkstemp": "io", "makedirs": "io",
+    "read_table": "io", "write_table": "io", "read_row_groups": "io",
+    "block_until_ready": "dispatch", "device_put": "dispatch",
+}
+
+#: callables KNOWN to run under a registered chaos site internally (the
+#: site each maps to is asserted to exist in chaos.injector.ALL_SITES)
+WIRED_CALLS: Dict[str, str] = {
+    # device work: every opjit/compiled launch injects device.dispatch,
+    # and with_device_retry heals transients around it
+    "execute_partition": "device.dispatch",
+    "execute_partitions": "device.dispatch",
+    "with_device_retry": "device.dispatch",
+    "decode_row_group": "scan.read",
+    # spill tiers: writes inject spill.to_host/to_disk; unspill reads what
+    # to_disk corrupted (checksum verified)
+    "get_batch": "spill.to_disk",
+    "add_batch": "hbm.alloc",
+    "allocate": "hbm.alloc",
+    "synchronous_spill": "spill.to_host",
+    # shuffle planes
+    "write_map_output": "shuffle.write",
+    "iter_partition": "shuffle.read",
+    "iter_partition_sources": "shuffle.read",
+    "iter_blocks": "ici.fetch",
+    "put_block": "ici.fetch",
+    "mesh_hash_exchange": "mesh.link",
+    "mesh_single_exchange": "mesh.link",
+    # scan byte ranges (RangeReader.read injects scan.read itself, but
+    # bare `.read` is far too generic a name to waive a whole scope on —
+    # only the distinctive entry points are wired)
+    "read_range": "scan.read",
+}
+
+
+def _validate_wired_sites() -> None:
+    """The WIRED table is a contract against the injector's registry: a
+    typo'd or stale site name here would silently waive TL023 coverage."""
+    from ..chaos.injector import ALL_SITES
+    unknown = sorted((set(WIRED_CALLS.values())
+                      | set(BOUNDARY_SITE_HINTS.values()))
+                     - set(ALL_SITES))
+    assert not unknown, f"lifecycle WIRED sites not in ALL_SITES: {unknown}"
+
+
+#: per boundary class, the site a fix would typically register under
+BOUNDARY_SITE_HINTS = {"io": "scan.read", "dispatch": "device.dispatch"}
+
+
+def _summary_of_call(summaries: Dict[str, "_FnSummary"],
+                     call: ast.Call) -> Optional["_FnSummary"]:
+    """Same-module summary for a call site. Plain-name calls resolve by
+    function name; attribute calls resolve ONLY when the receiver is
+    ``self``/``cls`` — `d.get(k)` must never inherit a summary from an
+    unrelated module function named ``get`` (the locks pass qualifies its
+    keys for exactly the same reason)."""
+    nm = _call_name(call)
+    if nm is None:
+        return None
+    f = call.func
+    if isinstance(f, ast.Name):
+        return summaries.get(nm)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in ("self", "cls"):
+        return summaries.get(nm)
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _Acquisition:
+    __slots__ = ("kind", "releases", "name", "node")
+
+    def __init__(self, kind: str, releases: Tuple[str, ...],
+                 name: Optional[str], node: ast.AST):
+        self.kind = kind
+        self.releases = releases
+        self.name = name            # bound local name, if any
+        self.node = node
+
+
+class _FnSummary:
+    """Interprocedural summary of one module function / method."""
+
+    __slots__ = ("releases_params", "returns_resource", "injects")
+
+    def __init__(self):
+        self.releases_params: Set[str] = set()   # param names it releases
+        self.returns_resource: Optional[Tuple[str, Tuple[str, ...]]] = None
+        self.injects: Set[str] = set()           # chaos sites it injects
+
+
+def _collect_functions(tree: ast.Module):
+    """(qualname, FunctionDef, class_name) for every def in the module."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            out.append((node.name, node, None))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    out.append((f"{node.name}.{sub.name}", sub, node.name))
+    return out
+
+
+def _summarize(fn: ast.FunctionDef,
+               summaries: Dict[str, _FnSummary]) -> _FnSummary:
+    s = _FnSummary()
+    params = {a.arg for a in fn.args.args + fn.args.posonlyargs
+              + fn.args.kwonlyargs}
+    acquired_names: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            nm = _call_name(node)
+            if nm is None:
+                continue
+            if nm == "inject" and node.args and isinstance(
+                    node.args[0], ast.Constant):
+                s.injects.add(str(node.args[0].value))
+            # x.close() / end_query(x) releasing a parameter
+            if isinstance(node.func, ast.Attribute) \
+                    and nm in RELEASE_METHODS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in params:
+                s.releases_params.add(node.func.value.id)
+            if nm in RELEASE_FUNCS and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in params:
+                s.releases_params.add(node.args[0].id)
+            # transitive: helper(qroot) where helper releases its param
+            sub = _summary_of_call(summaries, node)
+            if sub is not None and sub.releases_params:
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in params:
+                        s.releases_params.add(a.id)
+                s.injects |= sub.injects
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            if isinstance(v, ast.Call):
+                nm = _call_name(v)
+                res = RESOURCE_CTORS.get(nm) if nm else None
+                if res is None and nm in RESOURCE_METHODS:
+                    res = RESOURCE_METHODS[nm]
+                if res is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            acquired_names[t.id] = res
+        elif isinstance(node, ast.Return) and isinstance(node.value,
+                                                         ast.Name):
+            if node.value.id in acquired_names:
+                s.returns_resource = acquired_names[node.value.id]
+        elif isinstance(node, ast.Return) and isinstance(node.value,
+                                                         ast.Call):
+            nm = _call_name(node.value)
+            if nm in RESOURCE_CTORS:
+                s.returns_resource = RESOURCE_CTORS[nm]
+            elif nm in RESOURCE_METHODS:
+                s.returns_resource = RESOURCE_METHODS[nm]
+    return s
+
+
+def _merge_summaries(a: _FnSummary, b: _FnSummary) -> _FnSummary:
+    """Same bare name on different classes: conservative merge — a param
+    counts as released only if EVERY same-named method releases it (the
+    release side accepts code, so union would hide leaks); resource
+    returns and injects widen (the flagging/coverage side)."""
+    m = _FnSummary()
+    m.releases_params = a.releases_params & b.releases_params
+    m.returns_resource = a.returns_resource or b.returns_resource
+    m.injects = a.injects | b.injects
+    return m
+
+
+def _module_summaries(tree: ast.Module) -> Dict[str, _FnSummary]:
+    fns = _collect_functions(tree)
+    summaries: Dict[str, _FnSummary] = {}
+    for _ in range(2):  # two passes so helper-calls-helper propagates
+        fresh: Dict[str, _FnSummary] = {}
+        for qual, fn, _cls in fns:
+            s = _summarize(fn, summaries)
+            fresh[qual] = s
+            prev = fresh.get(fn.name)
+            fresh[fn.name] = s if prev is None or prev is fresh[qual] \
+                else _merge_summaries(prev, s)
+        summaries = fresh
+    return summaries
+
+
+def _is_safe_stmt(st: ast.stmt) -> bool:
+    """No raise-capable work: assignments/expressions whose calls are all
+    trivial. Compound statements are raise-capable (their bodies run
+    arbitrary code). Release calls (``x.close()``) count as safe — closing
+    one resource between acquiring and transferring another is the normal
+    hand-over sequence and presumed non-raising."""
+    if isinstance(st, (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                       ast.Nonlocal, ast.Import, ast.ImportFrom)):
+        return True
+    if not isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                           ast.Expr, ast.Return, ast.Yield)):
+        return False
+    for node in ast.walk(st):
+        if isinstance(node, ast.Call):
+            nm = _call_name(node)
+            if isinstance(node.func, ast.Attribute):
+                if nm not in _SAFE_METHODS and nm not in RELEASE_METHODS:
+                    return False
+            elif nm not in _SAFE_CALLS:
+                return False
+        elif isinstance(node, (ast.Raise, ast.Await)):
+            return False
+    return True
+
+
+def _handler_releases_and_reraises(tr: ast.Try, name: str,
+                                   releases: Tuple[str, ...],
+                                   summaries: Dict[str, _FnSummary],
+                                   containers: Dict[str, Set[str]]) -> bool:
+    """``except BaseException: name.close(); raise`` — the equivalent of a
+    finally for a resource the success path goes on to transfer."""
+    for h in tr.handlers:
+        if not any(isinstance(s, ast.Raise) and s.exc is None
+                   for s in h.body):
+            continue
+        if _releases_name(h.body, name, releases, summaries, containers):
+            return True
+    return False
+
+
+def _lockish_with(st: ast.With) -> bool:
+    """A `with` whose every item is a lock/metric-timer style context that
+    cannot own our resource: scanning through it keeps straight-line
+    visibility (`with self._mu: self._blocks[k] = sb`)."""
+    for item in st.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            nm = _call_name(expr)
+            if nm in ("timed", "sync_scope", "span", "trace_scope",
+                      "nullcontext", "retry_scope"):
+                continue
+            return False
+        name = expr.attr if isinstance(expr, ast.Attribute) else (
+            expr.id if isinstance(expr, ast.Name) else "")
+        if not _lockish(name):
+            return False
+    return True
+
+
+def _releases_name(body: List[ast.stmt], name: str,
+                   releases: Tuple[str, ...],
+                   summaries: Dict[str, _FnSummary],
+                   containers: Dict[str, Set[str]]) -> bool:
+    """Does `body` (recursively) release `name` — directly, through a
+    releasing helper, or by iterating a container `name` was stored in and
+    closing the elements?"""
+    roots = {name} | {c for c, members in containers.items()
+                      if name in members}
+    for st in body:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = _call_name(node)
+            if isinstance(node.func, ast.Attribute) \
+                    and (nm in releases or nm in RELEASE_METHODS):
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and recv.id in roots:
+                    return True
+            if nm in RELEASE_FUNCS and any(
+                    isinstance(a, ast.Name) and a.id in roots
+                    for a in node.args):
+                return True
+            sub = _summary_of_call(summaries, node)
+            if sub is not None and sub.releases_params and any(
+                    isinstance(a, ast.Name) and a.id in roots
+                    for a in node.args):
+                return True
+        # container iteration: for g in groups: ... sb.close() — any close
+        # inside a for whose iterated root is one of ours counts
+        for node in ast.walk(st):
+            if isinstance(node, ast.For) \
+                    and _names_in(node.iter) & roots:
+                for sub_node in ast.walk(node):
+                    if isinstance(sub_node, ast.Call) \
+                            and isinstance(sub_node.func, ast.Attribute) \
+                            and (sub_node.func.attr in releases
+                                 or sub_node.func.attr in RELEASE_METHODS):
+                        return True
+    return False
+
+
+class _FnScan:
+    """TL020/TL023 scan of one function body."""
+
+    def __init__(self, mod_lines: List[str], qualname: str, relpath: str,
+                 summaries: Dict[str, _FnSummary],
+                 findings: List[Finding]):
+        self.lines = mod_lines
+        self.qualname = qualname
+        self.relpath = relpath
+        self.summaries = summaries
+        self.findings = findings
+        self.params: Set[str] = set()
+        #: container name -> resource names appended into it
+        self.containers: Dict[str, Set[str]] = {}
+        #: names known to be containers (list/dict literals)
+        self.container_names: Set[str] = set()
+        self.transferred_containers: Set[str] = set()
+
+    # -- entry --------------------------------------------------------------
+    def run(self, fn: ast.FunctionDef) -> None:
+        self.params = {a.arg for a in fn.args.args + fn.args.posonlyargs
+                       + fn.args.kwonlyargs}
+        self._prescan_containers(fn)
+        self._scan_block(fn.body, try_stack=[], in_tracked_scope=False,
+                         cont=[], covered=False)
+
+    def _scope_has_inject(self, stmts: List[ast.stmt]) -> bool:
+        """Any chaos-injectable raise site in the scope — a direct
+        ``inject()``, a same-module helper whose summary injects, or a
+        call from the cross-module WIRED table (APIs that run under a
+        registered site internally). TL023 coverage is scope-granular:
+        one registered raise site per tracked scope makes the unwind
+        path exercisable."""
+        for st in stmts:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call):
+                    nm = _call_name(node)
+                    if nm == "inject" or nm in WIRED_CALLS:
+                        return True
+                    sub = _summary_of_call(self.summaries, node)
+                    if sub is not None and sub.injects:
+                        return True
+        return False
+
+    def _prescan_containers(self, fn: ast.FunctionDef) -> None:
+        self.local_ctxs: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                continue
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, (ast.List, ast.Dict, ast.ListComp,
+                                 ast.DictComp)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.container_names.add(t.id)
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and _call_name(node.value) == "TaskContext":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.local_ctxs.add(t.id)
+            if isinstance(node, (ast.Return, ast.Yield)) \
+                    and node.value is not None:
+                for nm in _names_in(node.value):
+                    self.transferred_containers.add(nm)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        for nm in _names_in(node.value):
+                            self.transferred_containers.add(nm)
+
+    # -- acquisition discovery ---------------------------------------------
+    def _acq_of_call(self, call: ast.Call) -> Optional[_Acquisition]:
+        nm = _call_name(call)
+        if nm is None:
+            return None
+        if isinstance(call.func, ast.Name) and nm in RESOURCE_CTORS:
+            kind, rel = RESOURCE_CTORS[nm]
+            return _Acquisition(kind, rel, None, call)
+        if isinstance(call.func, ast.Attribute):
+            if nm in RESOURCE_METHODS:
+                kind, rel = RESOURCE_METHODS[nm]
+                return _Acquisition(kind, rel, None, call)
+            if nm in RESOURCE_CTORS and nm == "begin_query":
+                return _Acquisition("query-trace", (), None, call)
+        sub = _summary_of_call(self.summaries, call)
+        if sub is not None and sub.returns_resource is not None:
+            kind, rel = sub.returns_resource
+            return _Acquisition(kind, rel, None, call)
+        return None
+
+    def _flag(self, acq: _Acquisition, why: str) -> None:
+        line = getattr(acq.node, "lineno", 0)
+        snippet = self.lines[line - 1].strip()[:100] \
+            if 1 <= line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            "TL020", "error", f"{self.relpath}::{self.qualname}",
+            f"{acq.kind} acquired at line {line} ({snippet!r}) {why} — "
+            f"release it in a finally/with, or transfer ownership "
+            f"(return/store/recognized sink)"))
+
+    # -- block scan ---------------------------------------------------------
+    def _scan_block(self, stmts: List[ast.stmt], try_stack: List[ast.Try],
+                    in_tracked_scope: bool, cont: List[List[ast.stmt]],
+                    covered: bool) -> None:
+        for i, st in enumerate(stmts):
+            # the continuation a child block sees: the rest of THIS block,
+            # then the enclosing continuations (straight-line visibility
+            # across compound-statement boundaries)
+            sub_cont = [stmts[i + 1:]] + cont
+            if isinstance(st, ast.Try):
+                tracked = in_tracked_scope or self._finally_releases_any(st)
+                cov = covered or (tracked and self._scope_has_inject(
+                    st.body + st.finalbody))
+                self._scan_block(st.body, try_stack + [st], tracked,
+                                 sub_cont, cov)
+                for h in st.handlers:
+                    self._scan_block(h.body, try_stack, in_tracked_scope,
+                                     sub_cont, cov)
+                self._scan_block(st.orelse, try_stack + [st], tracked,
+                                 sub_cont, cov)
+                self._scan_block(st.finalbody, try_stack, in_tracked_scope,
+                                 sub_cont, cov)
+                continue
+            if isinstance(st, ast.With):
+                tracked = in_tracked_scope
+                for item in st.items:
+                    if isinstance(item.context_expr, ast.Call) \
+                            and self._acq_of_call(item.context_expr):
+                        tracked = True  # with-managed resource scope
+                cov = covered or (tracked and self._scope_has_inject(
+                    st.body))
+                if in_tracked_scope and not cov:
+                    # boundaries in the with ITEMS themselves (`with
+                    # open(...)` inside a tracked try)
+                    for item in st.items:
+                        self._check_boundaries(item.context_expr)
+                self._scan_block(st.body, try_stack, tracked, sub_cont,
+                                 cov)
+                continue
+            if isinstance(st, (ast.If,)):
+                self._scan_block(st.body, try_stack, in_tracked_scope,
+                                 sub_cont, covered)
+                self._scan_block(st.orelse, try_stack, in_tracked_scope,
+                                 sub_cont, covered)
+                continue
+            if isinstance(st, (ast.For, ast.While)):
+                # no continuation into post-loop code: a per-iteration
+                # acquisition must settle inside the iteration (a release
+                # after the loop covers only the last one)
+                self._scan_block(st.body, try_stack, in_tracked_scope, [],
+                                 covered)
+                self._scan_block(st.orelse, try_stack, in_tracked_scope,
+                                 sub_cont, covered)
+                continue
+            if isinstance(st, ast.FunctionDef):
+                # nested def: scanned as its own scope by the module walk
+                continue
+            self._scan_stmt(st, stmts, i, try_stack, in_tracked_scope,
+                            cont, covered)
+
+    def _finally_releases_any(self, tr: ast.Try) -> bool:
+        if not tr.finalbody:
+            return False
+        for node in ast.walk(ast.Module(body=tr.finalbody,
+                                        type_ignores=[])):
+            if isinstance(node, ast.Call):
+                nm = _call_name(node)
+                if nm in RELEASE_METHODS or nm in RELEASE_FUNCS:
+                    return True
+                sub = _summary_of_call(self.summaries, node)
+                if sub is not None and sub.releases_params:
+                    return True
+        return False
+
+    # -- statement-level acquisition handling -------------------------------
+    def _scan_stmt(self, st: ast.stmt, block: List[ast.stmt], idx: int,
+                   try_stack: List[ast.Try], in_tracked_scope: bool,
+                   cont: List[List[ast.stmt]], covered: bool) -> None:
+        if in_tracked_scope and not covered:
+            self._check_boundaries(st)
+        # semaphore permit on a LOCALLY CREATED TaskContext (a ctx the
+        # caller handed in — incl. closure ctxs of nested defs — is
+        # caller-owned and completes there)
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node) == "acquire_if_necessary" \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                ctx_name = node.args[0].id
+                if ctx_name not in self.local_ctxs:
+                    continue
+                acq = _Acquisition("semaphore-permit", ("complete",),
+                                   ctx_name, node)
+                if not self._release_guaranteed(acq, block, idx, try_stack,
+                                                cont):
+                    self._flag(acq, "holds a device permit whose "
+                               "ctx.complete() is not guaranteed on "
+                               "exception paths")
+        if isinstance(st, (ast.Assign, ast.AnnAssign)):
+            value = st.value
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            if isinstance(value, ast.Call):
+                acq = self._acq_of_call(value)
+                if acq is not None:
+                    name = targets[0].id \
+                        if len(targets) == 1 \
+                        and isinstance(targets[0], ast.Name) else None
+                    if name is None:
+                        if all(isinstance(t, (ast.Attribute, ast.Subscript))
+                               for t in targets):
+                            return  # stored on self/container: transferred
+                        self._flag(acq, "is never bound to a releasable "
+                                   "name")
+                        return
+                    acq.name = name
+                    if not self._release_guaranteed(acq, block, idx,
+                                                    try_stack, cont):
+                        self._flag(acq, "has no guaranteed release on "
+                                   "exception paths")
+                else:
+                    self._check_inline_acquisitions(value)
+            return
+        if isinstance(st, ast.Expr):
+            v = st.value
+            delegated = isinstance(v, (ast.Yield, ast.YieldFrom))
+            if delegated and isinstance(v.value, ast.Call):
+                v = v.value
+            if isinstance(v, ast.Call):
+                acq = self._acq_of_call(v)
+                if acq is not None and not delegated:
+                    # a bare discarded `SpillableColumnarBatch(b)`;
+                    # `yield (from) ACQ(...)` hands it to the consumer —
+                    # GeneratorExit/close reaches the delegate's finally
+                    self._flag(acq, "is never bound to a releasable name")
+                elif acq is None:
+                    self._check_inline_acquisitions(v)
+            return
+        if isinstance(st, ast.Return) and isinstance(st.value, ast.Call):
+            # `return ACQ(...)` transfers; inline args inside still checked
+            self._check_inline_acquisitions(st.value)
+
+    def _check_inline_acquisitions(self, call: ast.Call) -> None:
+        """ACQ(...) passed directly as an argument: fine into a transfer
+        sink or container append; a leak anywhere else."""
+        nm = _call_name(call)
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            if not isinstance(a, ast.Call):
+                continue
+            acq = self._acq_of_call(a)
+            if acq is None:
+                self._check_inline_acquisitions(a)
+                continue
+            if nm in TRANSFER_SINKS:
+                continue
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("append", "add", "put"):
+                continue
+            sub = _summary_of_call(self.summaries, call)
+            if sub is not None and sub.releases_params:
+                continue  # the callee closes what it is handed
+            self._flag(acq, f"is passed straight into {nm}() which is not "
+                       f"a recognized ownership sink")
+
+    # -- the disposition decision ------------------------------------------
+    def _release_guaranteed(self, acq: _Acquisition, block: List[ast.stmt],
+                            idx: int, try_stack: List[ast.Try],
+                            cont: List[List[ast.stmt]]) -> bool:
+        name = acq.name
+        assert name is not None
+        # 1. a finally (or a close-and-reraise handler) on the enclosing-try
+        #    stack releases it: the exception path is covered from here on
+        for tr in try_stack:
+            if tr.finalbody and _releases_name(
+                    tr.finalbody, name, acq.releases, self.summaries,
+                    self.containers):
+                return True
+            if _handler_releases_and_reraises(tr, name, acq.releases,
+                                              self.summaries,
+                                              self.containers):
+                return True
+        # 2. straight-line follow-up: the rest of this block, then the
+        #    enclosing continuations (crossing with/if/try boundaries the
+        #    scan entered)
+        verdict = self._scan_followup(block[idx + 1:], acq)
+        if verdict is not None:
+            return verdict
+        for seq in cont:
+            verdict = self._scan_followup(seq, acq)
+            if verdict is not None:
+                return verdict
+        return False
+
+    def _scan_followup(self, stmts: List[ast.stmt],
+                       acq: _Acquisition) -> Optional[bool]:
+        """True/False once decided; None to keep scanning the enclosing
+        continuation."""
+        name = acq.name
+        for st in stmts:
+            if isinstance(st, ast.With) and _lockish_with(st):
+                # transparent: `with self._mu: self._blocks[k] = sb`
+                sub = self._scan_followup(st.body, acq)
+                if sub is not None:
+                    return sub
+                continue
+            disp = self._stmt_disposition(st, acq)
+            if disp in ("released", "transferred", "try-release"):
+                return True
+            if disp is not None and disp.startswith("container:"):
+                c = disp.split(":", 1)[1]
+                self.containers.setdefault(c, set()).add(name)
+                if c in self.transferred_containers:
+                    return True
+                continue
+            if not _is_safe_stmt(st):
+                # raise-capable work before any release/transfer: the
+                # exception path leaks
+                return False
+        return None
+
+    def _stmt_disposition(self, st: ast.stmt,
+                          acq: _Acquisition) -> Optional[str]:
+        name = acq.name
+        if isinstance(st, ast.Return):
+            if st.value is not None and name in _names_in(st.value):
+                return "transferred"
+            return None
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Yield):
+            if st.value.value is not None \
+                    and name in _names_in(st.value.value):
+                return "transferred"
+            return None
+        if isinstance(st, ast.Try):
+            # acquisition immediately followed by a try whose finally — or
+            # whose close-and-reraise handler — releases it
+            if st.finalbody and _releases_name(
+                    st.finalbody, name, acq.releases, self.summaries,
+                    self.containers):
+                return "try-release"
+            if _handler_releases_and_reraises(st, name, acq.releases,
+                                              self.summaries,
+                                              self.containers):
+                return "try-release"
+            return None
+        if isinstance(st, ast.Assign):
+            # self.x = name / container[k] = name → ownership transfer
+            if name in _names_in(st.value):
+                for t in st.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        return "transferred"
+            return None
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            nm = _call_name(call)
+            arg_names = set()
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                arg_names |= _names_in(a)
+            if isinstance(call.func, ast.Attribute):
+                recv = call.func.value
+                if nm in acq.releases or nm in RELEASE_METHODS:
+                    if isinstance(recv, ast.Name) and recv.id == name:
+                        return "released"
+                if nm in ("append", "add", "put") and name in arg_names:
+                    root = recv
+                    while isinstance(root, (ast.Subscript, ast.Attribute)):
+                        root = root.value
+                    if isinstance(root, ast.Name):
+                        if isinstance(call.func.value, ast.Attribute) or \
+                                root.id in self.container_names or \
+                                root.id in self.params:
+                            return f"container:{root.id}" \
+                                if root.id in self.container_names \
+                                else "transferred"
+                    return "transferred"
+            if nm in RELEASE_FUNCS and name in arg_names:
+                return "released"
+            if nm in TRANSFER_SINKS and name in arg_names:
+                return "transferred"
+            sub = _summary_of_call(self.summaries, call)
+            if sub is not None and sub.releases_params \
+                    and name in arg_names:
+                return "released"
+            return None
+        return None
+
+    # -- TL023 --------------------------------------------------------------
+    def _check_boundaries(self, st: ast.stmt) -> None:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = _call_name(node)
+            if nm is None or nm not in BOUNDARY_CALLS:
+                continue
+            if self._covered_by_inject(st):
+                continue
+            klass = BOUNDARY_CALLS[nm]
+            hint = BOUNDARY_SITE_HINTS.get(klass, "a registered site")
+            line = getattr(node, "lineno", 0)
+            self.findings.append(Finding(
+                "TL023", "error", f"{self.relpath}::{self.qualname}",
+                f"raise-capable {klass} boundary `{nm}` at line {line} "
+                f"inside a resource-tracked scope has no registered chaos "
+                f"site — the unwind path TL020 just proved safe cannot be "
+                f"exercised by the soaks; route it through a chaos-wired "
+                f"API or inject() under `{hint}`"))
+
+    def _covered_by_inject(self, st: ast.stmt) -> bool:
+        """Same-statement coverage (the scope-level flag handles the
+        rest): an adjacent inject()/wired call in the statement."""
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                nm = _call_name(node)
+                if nm == "inject" or nm in WIRED_CALLS:
+                    return True
+                sub = _summary_of_call(self.summaries, node)
+                if sub is not None and sub.injects:
+                    return True
+        return False
+
+
+def _check_owner_class(cls: ast.ClassDef, relpath: str,
+                       findings: List[Finding]) -> None:
+    """A class that stores a tracked resource on ``self`` has taken
+    ownership: it must expose a release method (``close``/``shutdown``/
+    ``unpersist``/``__exit__``) so ITS owner can uphold TL020 — a resource
+    parked on an attribute of a close-less class is a leak with extra
+    steps (the DeviceFileDecoder shape: an open RangeReader pinned until
+    GC)."""
+    stored: List[Tuple[str, int, str]] = []  # (attr, line, kind)
+    has_release = False
+    for node in cls.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name in RELEASE_METHODS or node.name == "__exit__":
+            has_release = True
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call):
+                nm = _call_name(sub.value)
+                res = RESOURCE_CTORS.get(nm) if nm else None
+                if res is None and nm in RESOURCE_METHODS:
+                    res = RESOURCE_METHODS[nm]
+                if res is None:
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        stored.append((t.attr, sub.lineno, res[0]))
+    if stored and not has_release:
+        attr, line, kind = stored[0]
+        findings.append(Finding(
+            "TL020", "error", f"{relpath}::{cls.name}",
+            f"class stores a {kind} on self.{attr} (line {line}) but "
+            f"defines no close/shutdown/__exit__ — its owner cannot "
+            f"release the resource, so every instance leaks it until GC"))
+
+
+def lint_lifecycle_module(source: str, relpath: str) -> List[Finding]:
+    """TL020/TL023 findings for one module's source."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError:
+        return findings
+    _validate_wired_sites()
+    lines = source.splitlines()
+    summaries = _module_summaries(tree)
+
+    def walk(body, prefix: str) -> None:
+        for node in body:
+            if isinstance(node, ast.FunctionDef):
+                qual = f"{prefix}{node.name}"
+                _FnScan(lines, qual, relpath, summaries, findings).run(node)
+                walk(node.body, f"{qual}.")
+            elif isinstance(node, ast.ClassDef):
+                _check_owner_class(node, relpath, findings)
+                walk(node.body, f"{prefix}{node.name}.")
+
+    walk(tree.body, "")
+    # one finding per (rule, location): dedupe repeated per-line hits so the
+    # baseline key granularity matches the other TL rules
+    seen: Set[Tuple[str, str, str]] = set()
+    out: List[Finding] = []
+    for f in findings:
+        k = (f.rule, f.location, f.message)
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(f)
+    return out
+
+
+def lint_lifecycle_tree(root: Optional[str] = None,
+                        subpackages: Tuple[str, ...] = LIFECYCLE_SUBPACKAGES,
+                        modules: Tuple[str, ...] = LIFECYCLE_MODULES
+                        ) -> List[Finding]:
+    """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
+    from .astwalk import iter_module_sources
+    findings: List[Finding] = []
+    for relpath, src in iter_module_sources(root, subpackages, modules):
+        findings.extend(lint_lifecycle_module(src, relpath))
+    return findings

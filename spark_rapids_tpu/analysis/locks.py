@@ -1,0 +1,577 @@
+"""Lock-discipline lint (rules **TL021** / **TL022**): no blocking work
+under a process-wide lock, and a declared global lock order.
+
+PR 2's bounded pool made every exchange map task a sibling of every other
+query's tasks; ROADMAP item 1 multiplies that by N sessions. Two static
+properties keep that safe:
+
+**TL021** — a blocking operation executed while holding a *process-wide*
+lock (a module-level ``Lock``/``RLock`` or a class-level singleton
+``_lock``). Blocking here means the audited device→host syncs
+(``audited_sync*`` / ``audited_device_get``), collective waits
+(``block_until_ready``), pool joins (``result()`` / ``join()`` /
+``futures.wait`` / ``shutdown(wait=True)``), semaphore acquisition and
+``time.sleep``-style backoff. Any of these under the opjit/compiled/mesh
+program-cache locks, the metric locks or the manager locks stalls every
+sibling on the PR 2 pool for the full wait. Instance locks
+(``self._mat_lock`` — per-exchange memoization) are out of TL021's scope:
+they serialize one object, not the process. Same-module helper/method
+summaries make the check one level interprocedural.
+
+**TL022** — lock-order cycles. The pass builds the global lock graph:
+
+* nodes: module-level locks (``module.py::_LOCK``), class-attribute locks
+  (``Class._lock``) and instance-attribute locks merged by attribute name
+  under their class (``HbmBudget._alloc_lock``);
+* edges: a ``with`` on lock A whose body acquires lock B — lexically, or
+  through a call whose summary (same-module, plus the curated
+  cross-module table below) says it acquires B.
+
+The graph is checked against :data:`LOCK_ORDER`, the **declared partial
+order** (outermost level first). Every edge must go from a lower level to
+a strictly higher one; re-acquiring the *same* lock is allowed only for
+locks constructed as ``RLock``. A lock missing from the declared order is
+itself a finding: the order is the documentation the next acquire site
+needs (docs/analysis.md mirrors it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .astwalk import call_name as _call_name, lockish as _lockish
+from .registry_check import Finding
+
+#: packages/modules the lint covers
+LOCKS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory",
+                                      "parallel", "io", "chaos", "obs")
+LOCKS_MODULES: Tuple[str, ...] = ("session.py", "filecache.py",
+                                  "profiling.py", "failure.py")
+
+#: blocking call names for TL021 (syntactic, receiver-independent)
+BLOCKING_CALLS = frozenset((
+    "audited_sync", "audited_sync_int", "audited_device_get",
+    "block_until_ready", "sleep", "result", "join", "wait",
+    "with_device_retry", "collective_wait",
+))
+#: blocking METHOD names that need a plausibly-blocking receiver to avoid
+#: false positives on str.join etc.
+_RECEIVER_SENSITIVE = frozenset(("join", "result", "wait"))
+
+#: the declared global lock order, OUTERMOST level first. An acquire edge
+#: must go strictly downward in this list. Kept in code (not a data file)
+#: so a new lock fails TL022 until its place in the order is declared —
+#: mirrored in docs/analysis.md. Lookup is most-specific-first: an exact
+#: ``Class.attr`` entry beats a bare ``attr`` entry, so per-class
+#: exceptions (``QueryTracer._mu`` as a terminal leaf) coexist with the
+#: generic ``_mu`` level.
+LOCK_ORDER: Tuple[Tuple[str, ...], ...] = (
+    # L0 — long-held orchestration locks: exchange materialization /
+    # broadcast build serialize whole stages and call into everything below
+    ("_mat_lock", "_broadcast_lock"),
+    # L1 — the buffer-catalog singleton ctor (wires the HBM spill callback,
+    # so its get() reaches L2/L4 while constructing)
+    ("TpuBufferCatalog._lock",),
+    # L2 — spillable registration (RLock: the HBM spill callback re-enters
+    # it on the allocating thread)
+    ("_reg_lock",),
+    # L3 — HBM accounting (RLock; held across the synchronous spill drain)
+    ("_alloc_lock",),
+    # L4 — remaining singleton get() locks (ctor-only critical sections)
+    ("HbmBudget._lock", "TpuSemaphore._lock", "TpuShuffleManager._lock",
+     "MeshContext._lock", "MemoryCleaner._lock", "TpuDeviceManager._lock",
+     "FileCache._lock", "IciShuffleCatalog._lock",
+     "ShuffleHeartbeatManager._lock", "FaultInjector._cls_lock",
+     "QueryTracer._cls_lock", "TaskMetricsRegistry._lock",
+     "SyncLedger._lock"),
+    # L5 — state/stats/program-cache leaf locks: short critical sections
+    # that publish precomputed values
+    ("_state_lock", "_id_lock", "_stats_lock", "_mu", "_LOCK",
+     "_CACHE_LOCK", "_STATS_LOCK", "_STAGE_FN_LOCK", "_JOIN_CACHE_LOCK",
+     "_DIM_CACHE_LOCK", "_lock", "_evict_lock"),
+    # L6 — observability/chaos terminals: reached from every layer above
+    # (event emission, fault injection), acquire nothing themselves
+    ("QueryTracer._mu", "FaultInjector._mu", "SyncLedger._mu",
+     "TaskMetricsRegistry._mu"),
+)
+
+#: curated cross-module acquire summaries: callable name -> lock ids it
+#: may acquire while running (one level deep is enough — the graph edges
+#: compose). Kept minimal: only APIs commonly called under other locks.
+CROSS_MODULE_ACQUIRES: Dict[str, Tuple[str, ...]] = {
+    "allocate": ("_alloc_lock",),
+    "free": ("_alloc_lock",),
+    "add_batch": ("_reg_lock", "_alloc_lock"),
+    "get_batch": ("_reg_lock",),
+    "synchronous_spill": ("_reg_lock",),
+    "acquire_if_necessary": ("_state_lock",),
+    "release_if_necessary": ("_state_lock",),
+    "record_external_dispatch": ("_LOCK",),
+    "put_block": ("IciShuffleCatalog._mu", "_reg_lock", "_alloc_lock"),
+    "inject": ("FaultInjector._cls_lock", "FaultInjector._mu"),
+    "corrupt_bytes": ("FaultInjector._cls_lock", "FaultInjector._mu"),
+    "event": ("QueryTracer._mu",),
+    "record_sync": ("SyncLedger._lock", "SyncLedger._mu",
+                    "TaskMetricsRegistry._lock", "TaskMetricsRegistry._mu"),
+}
+
+#: singleton classes whose ``X.get()`` briefly takes the class get-lock —
+#: resolved cross-module by receiver name (`HbmBudget.get()` under the
+#: catalog's _reg_lock is a real _reg_lock → HbmBudget._lock edge)
+KNOWN_SINGLETONS: Dict[str, str] = {
+    "HbmBudget": "HbmBudget._lock",
+    "TpuBufferCatalog": "TpuBufferCatalog._lock",
+    "TpuSemaphore": "TpuSemaphore._lock",
+    "TpuShuffleManager": "TpuShuffleManager._lock",
+    "MeshContext": "MeshContext._lock",
+    "MemoryCleaner": "MemoryCleaner._lock",
+    "FileCache": "FileCache._lock",
+    "IciShuffleCatalog": "IciShuffleCatalog._lock",
+    "ShuffleHeartbeatManager": "ShuffleHeartbeatManager._lock",
+    "FaultInjector": "FaultInjector._cls_lock",
+    "QueryTracer": "QueryTracer._cls_lock",
+    "TaskMetricsRegistry": "TaskMetricsRegistry._lock",
+    "SyncLedger": "SyncLedger._lock",
+    "TpuDeviceManager": "TpuDeviceManager._lock",
+}
+
+class _LockDef:
+    __slots__ = ("ident", "rlock", "module_level", "class_level")
+
+    def __init__(self, ident: str, rlock: bool, module_level: bool,
+                 class_level: bool = False):
+        self.ident = ident
+        self.rlock = rlock
+        self.module_level = module_level
+        self.class_level = class_level
+
+    @property
+    def process_wide(self) -> bool:
+        """Module-level locks and class-ATTRIBUTE locks (the singleton
+        `_lock = threading.Lock()` pattern) gate the whole process; locks
+        assigned per instance in a method serialize one object only."""
+        return self.module_level or self.class_level
+
+
+def _is_lock_ctor(node: ast.AST) -> Optional[bool]:
+    """None if not a lock constructor; else True for RLock."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name in ("Lock", "RLock"):
+        return name == "RLock"
+    return None
+
+
+def _collect_locks(tree: ast.Module, relpath: str) -> Dict[str, _LockDef]:
+    """All lock definitions in the module, keyed by identity:
+    module-level ``relpath::NAME``, class/instance attrs ``Class.attr``."""
+    out: Dict[str, _LockDef] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            rl = _is_lock_ctor(node.value)
+            if rl is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = _LockDef(f"{relpath}::{t.id}", rl, True)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.Assign):
+                    rl = _is_lock_ctor(sub.value)
+                    if rl is not None:
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                out[f"{node.name}.{t.id}"] = _LockDef(
+                                    f"{node.name}.{t.id}", rl, False,
+                                    class_level=True)
+                elif isinstance(sub, ast.FunctionDef):
+                    for n in ast.walk(sub):
+                        if isinstance(n, ast.Assign):
+                            rl = _is_lock_ctor(n.value)
+                            if rl is None:
+                                continue
+                            for t in n.targets:
+                                if isinstance(t, ast.Attribute) and \
+                                        isinstance(t.value, ast.Name) and \
+                                        t.value.id in ("self", "cls"):
+                                    out[f"{node.name}.{t.attr}"] = _LockDef(
+                                        f"{node.name}.{t.attr}", rl, False)
+    return out
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "location", "line")
+
+    def __init__(self, src: str, dst: str, location: str, line: int):
+        self.src = src
+        self.dst = dst
+        self.location = location
+        self.line = line
+
+
+def _level_of(ident: str) -> Optional[int]:
+    """Declared level of a lock identity. Module-level locks match by bare
+    name (``x.py::_LOCK`` → ``_LOCK``); attribute locks first by
+    ``Class.attr`` then by bare attr."""
+    bare = ident.split("::")[-1]
+    attr = bare.split(".")[-1]
+    for lvl, names in enumerate(LOCK_ORDER):
+        if bare in names:
+            return lvl
+    for lvl, names in enumerate(LOCK_ORDER):
+        if attr in names:
+            return lvl
+    return None
+
+
+class _ModuleLockScan:
+    """One module's TL021 hits + TL022 edges."""
+
+    def __init__(self, tree: ast.Module, relpath: str):
+        self.tree = tree
+        self.relpath = relpath
+        self.locks = _collect_locks(tree, relpath)
+        self.class_names = {n.name for n in tree.body
+                            if isinstance(n, ast.ClassDef)}
+        #: (class|None, fn name) -> lock identities it may acquire
+        #: (transitive within the module, 2 passes). Qualified keys avoid
+        #: name collisions (dict ``.get()`` vs a singleton classmethod
+        #: ``get``).
+        self.acquires: Dict[Tuple[Optional[str], str], Set[str]] = {}
+        #: (class|None, fn name) -> blocking-op description (TL021 summary)
+        self.blocks: Dict[Tuple[Optional[str], str], Optional[str]] = {}
+        self.findings: List[Finding] = []
+        self.edges: List[_Edge] = []
+        self._summarize()
+
+    # -- lock identity at a with-site ---------------------------------------
+    def _lock_ident(self, expr: ast.AST,
+                    cls_name: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Name):
+            if expr.id in self.locks:
+                return self.locks[expr.id].ident
+            if _lockish(expr.id):
+                return f"{self.relpath}::{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute) and _lockish(expr.attr):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                owner = cls_name or "?"
+            elif isinstance(base, ast.Name):
+                owner = base.id  # ClassName._lock or instance var
+            else:
+                owner = "?"
+            key = f"{owner}.{expr.attr}"
+            if key in self.locks:
+                return self.locks[key].ident
+            return key
+        return None
+
+    def _is_rlock(self, ident: str) -> bool:
+        bare = ident.split("::")[-1]
+        for d in self.locks.values():
+            if d.ident == ident or d.ident.endswith(bare):
+                return d.rlock
+        # unknown definition site: attribute-name heuristic (the two RLocks
+        # in the tree are _alloc_lock/_reg_lock; anything else is a Lock)
+        return bare.split(".")[-1] in ("_alloc_lock", "_reg_lock")
+
+    # -- call resolution ----------------------------------------------------
+    def _call_acquires(self, node: ast.Call,
+                       current_cls: Optional[str]) -> Set[str]:
+        """Lock identities a call may take: curated cross-module table,
+        singleton ``X.get()``, and same-module summaries resolved by
+        QUALIFIED name (receiver ``self``/``cls`` → the current class, a
+        class Name → that class, a plain Name → a module function; an
+        arbitrary receiver like ``self._entries.get`` resolves to nothing —
+        dict methods must not inherit a classmethod's summary)."""
+        nm = _call_name(node)
+        out: Set[str] = set()
+        if nm is None:
+            return out
+        if nm in CROSS_MODULE_ACQUIRES:
+            out.update(CROSS_MODULE_ACQUIRES[nm])
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in self.class_names:
+                out.update(self.acquires.get((f.id, "__init__"), ()))
+            else:
+                out.update(self.acquires.get((None, nm), ()))
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            recv = f.value.id
+            if recv in ("self", "cls"):
+                out.update(self.acquires.get((current_cls, nm), ()))
+            elif recv in self.class_names:
+                out.update(self.acquires.get((recv, nm), ()))
+            elif recv in KNOWN_SINGLETONS and nm == "get":
+                out.add(KNOWN_SINGLETONS[recv])
+        return out
+
+    def _call_blocks(self, node: ast.Call,
+                     current_cls: Optional[str]) -> Optional[str]:
+        nm = _call_name(node)
+        if nm is None:
+            return None
+        f = node.func
+        key = None
+        if isinstance(f, ast.Name):
+            key = (None, nm)
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id in ("self", "cls"):
+                key = (current_cls, nm)
+            elif f.value.id in self.class_names:
+                key = (f.value.id, nm)
+        sub = self.blocks.get(key) if key else None
+        return f"{nm}() which blocks via {sub}" if sub else None
+
+    # -- summaries ----------------------------------------------------------
+    def _summarize(self) -> None:
+        fns = []
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                fns.append((node, None))
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        fns.append((sub, node.name))
+        for _ in range(2):
+            for fn, cls in fns:
+                acq: Set[str] = set()
+                blocking: Optional[str] = None
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            ident = self._lock_ident(item.context_expr, cls)
+                            if ident:
+                                acq.add(ident)
+                    elif isinstance(node, ast.Call):
+                        acq.update(self._call_acquires(node, cls))
+                        b = self._blocking_name(node) \
+                            or self._call_blocks(node, cls)
+                        if b:
+                            blocking = blocking or b
+                self.acquires[(cls, fn.name)] = acq
+                self.blocks[(cls, fn.name)] = blocking
+
+    def _blocking_name(self, node: ast.Call) -> Optional[str]:
+        nm = _call_name(node)
+        if nm not in BLOCKING_CALLS:
+            return None
+        if nm in _RECEIVER_SENSITIVE:
+            # f.result(), t.join(), ev.wait(): require a Name/attr receiver
+            # that is not a string-ish join idiom (", ".join)
+            if not isinstance(node.func, ast.Attribute):
+                return None
+            if isinstance(node.func.value, ast.Constant):
+                return None
+        return nm
+
+    # -- the walk -----------------------------------------------------------
+    def run(self) -> None:
+        def walk(body: Iterable[ast.stmt], prefix: str,
+                 cls: Optional[str]) -> None:
+            for node in body:
+                if isinstance(node, ast.FunctionDef):
+                    qual = f"{prefix}{node.name}"
+                    self._scan_fn(node, qual, cls)
+                    walk(node.body, f"{qual}.", cls)
+                elif isinstance(node, ast.ClassDef):
+                    walk(node.body, f"{prefix}{node.name}.", node.name)
+
+        walk(self.tree.body, "", None)
+
+    def _scan_fn(self, fn: ast.FunctionDef, qual: str,
+                 cls: Optional[str]) -> None:
+        self._scan_block(fn.body, [], qual, cls)
+
+    def _scan_block(self, body: Iterable[ast.stmt], held: List[str],
+                    qual: str, cls: Optional[str]) -> None:
+        for st in body:
+            if isinstance(st, ast.FunctionDef):
+                continue  # nested defs are their own (unlocked) scope
+            if isinstance(st, ast.With):
+                # items of ONE `with A, B:` acquire in order — B nests
+                # under A exactly like the two-statement form, so the
+                # held stack grows item by item
+                inner = list(held)
+                for item in st.items:
+                    ident = self._lock_ident(item.context_expr, cls)
+                    if ident:
+                        if inner and inner[-1] != ident:
+                            self.edges.append(_Edge(
+                                inner[-1], ident,
+                                f"{self.relpath}::{qual}", st.lineno))
+                        if ident in inner:
+                            if not self._is_rlock(ident):
+                                self.findings.append(Finding(
+                                    "TL022", "error",
+                                    f"{self.relpath}::{qual}",
+                                    f"re-acquiring non-reentrant lock "
+                                    f"{ident} already held (line "
+                                    f"{st.lineno}) — self-deadlock"))
+                        else:
+                            inner.append(ident)
+                self._scan_block(st.body, inner, qual, cls)
+                continue
+            if held:
+                self._check_blocking(st, held, qual, cls)
+                self._check_called_acquires(st, held, qual, cls)
+            for sub_body in _sub_bodies(st):
+                self._scan_block(sub_body, held, qual, cls)
+
+    def _check_blocking(self, st: ast.stmt, held: List[str],
+                        qual: str, cls: Optional[str]) -> None:
+        # only process-wide locks gate TL021
+        wide = [h for h in held if self._is_process_wide(h)]
+        if not wide:
+            return
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                b = self._blocking_name(node) or self._call_blocks(node,
+                                                                   cls)
+                if b:
+                    self.findings.append(Finding(
+                        "TL021", "error", f"{self.relpath}::{qual}",
+                        f"blocking operation {b} at line {node.lineno} "
+                        f"while holding process-wide lock {wide[-1]} — "
+                        f"every sibling task on the pool stalls for the "
+                        f"full wait; release the lock first (compute "
+                        f"outside, publish under the lock)"))
+
+    def _is_process_wide(self, ident: str) -> bool:
+        bare = ident.split("::")[-1]
+        if "::" in ident:  # module-level lock
+            return True
+        d = self.locks.get(bare)
+        if d is not None:
+            return d.process_wide
+        for ld in self.locks.values():
+            if ld.ident == ident:
+                return ld.process_wide
+        return False
+
+    def _check_called_acquires(self, st: ast.stmt, held: List[str],
+                               qual: str, cls: Optional[str]) -> None:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            for ident in self._call_acquires(node, cls):
+                if ident in held:
+                    continue  # reentrancy handled at with-sites
+                self.edges.append(_Edge(held[-1], ident,
+                                        f"{self.relpath}::{qual}",
+                                        node.lineno))
+
+
+def _sub_bodies(st: ast.stmt):
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(st, attr, None)
+        if b:
+            yield b
+    for h in getattr(st, "handlers", ()) or ():
+        yield h.body
+
+
+def _check_order(edges: Sequence[_Edge]) -> List[Finding]:
+    """Declared-partial-order + cycle check over the merged lock graph."""
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    graph: Dict[str, Set[str]] = {}
+    for e in edges:
+        graph.setdefault(e.src, set()).add(e.dst)
+        key = (e.src, e.dst, e.location)
+        if key in seen:
+            continue
+        seen.add(key)
+        ls, ld = _level_of(e.src), _level_of(e.dst)
+        if ls is None:
+            findings.append(Finding(
+                "TL022", "error", e.location,
+                f"lock {e.src} (held at line {e.line}) is not in the "
+                f"declared lock order (analysis/locks.py LOCK_ORDER) — "
+                f"declare its level before nesting other locks under it"))
+            continue
+        if ld is None:
+            findings.append(Finding(
+                "TL022", "error", e.location,
+                f"lock {e.dst} (acquired at line {e.line} under {e.src}) "
+                f"is not in the declared lock order (analysis/locks.py "
+                f"LOCK_ORDER)"))
+            continue
+        if ld <= ls:
+            findings.append(Finding(
+                "TL022", "error", e.location,
+                f"lock-order violation at line {e.line}: {e.dst} "
+                f"(level {ld}) acquired while holding {e.src} "
+                f"(level {ls}) — the declared order requires strictly "
+                f"outer→inner nesting (see docs/analysis.md)"))
+    # cycle check independent of the declared levels (same-level cycles)
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = 1
+        stack.append(n)
+        for m in graph.get(n, ()):  # pragma: no branch
+            if color.get(m, 0) == 1:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, 0) == 0:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        stack.pop()
+        color[n] = 2
+        return None
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            cyc = dfs(n)
+            if cyc:
+                findings.append(Finding(
+                    "TL022", "error", "locks::global-graph",
+                    f"lock-order cycle: {' -> '.join(cyc)} — two threads "
+                    f"taking these in opposite order deadlock"))
+                break
+    return findings
+
+
+def lint_locks_module(source: str, relpath: str
+                      ) -> Tuple[List[Finding], List[_Edge]]:
+    """TL021 findings + raw lock-graph edges for one module."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError:
+        return [], []
+    scan = _ModuleLockScan(tree, relpath)
+    scan.run()
+    # dedupe per (rule, location, message)
+    seen: Set[Tuple[str, str, str]] = set()
+    out: List[Finding] = []
+    for f in scan.findings:
+        k = (f.rule, f.location, f.message)
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(f)
+    return out, scan.edges
+
+
+def lint_locks_tree(root: Optional[str] = None,
+                    subpackages: Tuple[str, ...] = LOCKS_SUBPACKAGES,
+                    modules: Tuple[str, ...] = LOCKS_MODULES
+                    ) -> List[Finding]:
+    """TL021 over every module + TL022 over the merged global lock graph."""
+    from .astwalk import iter_module_sources
+    findings: List[Finding] = []
+    edges: List[_Edge] = []
+    for relpath, src in iter_module_sources(root, subpackages, modules):
+        fs, es = lint_locks_module(src, relpath)
+        findings.extend(fs)
+        edges.extend(es)
+    findings.extend(_check_order(edges))
+    return findings

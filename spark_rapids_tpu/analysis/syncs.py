@@ -28,7 +28,6 @@ instead).
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional, Tuple
 
 from .detectors import scan_source
@@ -81,17 +80,8 @@ def lint_sync_tree(root: Optional[str] = None,
                    subpackages: Tuple[str, ...] = SYNC_SUBPACKAGES
                    ) -> List[Finding]:
     """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
-    if root is None:
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from .astwalk import iter_module_sources
     findings: List[Finding] = []
-    for sub in subpackages:
-        d = os.path.join(root, sub)
-        if not os.path.isdir(d):
-            continue
-        for fname in sorted(os.listdir(d)):
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(d, fname)) as f:
-                src = f.read()
-            findings.extend(lint_sync_module(src, f"{sub}/{fname}"))
+    for relpath, src in iter_module_sources(root, subpackages):
+        findings.extend(lint_sync_module(src, relpath))
     return findings

@@ -1374,20 +1374,23 @@ class TpuHashAggregateExec(TpuExec):
         from .oocsort import OutOfCoreSorter
         order = [SortOrder(g, True, True) for g in self.grouping]
         ooc = OutOfCoreSorter(order, ctx)
-        depth = (ctx.conf.get(SHUFFLE_PIPELINE_PREFETCH)
-                 if ctx.conf.get(SHUFFLE_PIPELINE_ENABLED) else 0)
-        # slice k+1's merge+gather dispatches overlap slice k's aggregation
-        # (same pipelining discipline as the shuffle read path)
-        slices = prefetch_iterator(
-            ooc.iter_sorted(max_rows, group_boundaries=True), depth)
         try:
-            with self.metrics["sortTime"].timed():
-                for b in batches:
-                    ooc.add_batch(b)
-            for sl in slices:
-                yield self._aggregate_batch(sl, agg_fns, result_exprs, ctx)
+            depth = (ctx.conf.get(SHUFFLE_PIPELINE_PREFETCH)
+                     if ctx.conf.get(SHUFFLE_PIPELINE_ENABLED) else 0)
+            # slice k+1's merge+gather dispatches overlap slice k's
+            # aggregation (same pipelining discipline as the shuffle read)
+            slices = prefetch_iterator(
+                ooc.iter_sorted(max_rows, group_boundaries=True), depth)
+            try:
+                with self.metrics["sortTime"].timed():
+                    for b in batches:
+                        ooc.add_batch(b)
+                for sl in slices:
+                    yield self._aggregate_batch(sl, agg_fns, result_exprs,
+                                                ctx)
+            finally:
+                slices.close()  # stop the prefetch worker FIRST
         finally:
-            slices.close()  # stop the prefetch worker BEFORE closing ooc
             ooc.close()
 
     def _eval_agg_input(self, fn, batch: TpuColumnarBatch, ctx: TaskContext):

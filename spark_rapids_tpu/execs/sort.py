@@ -144,29 +144,34 @@ class TpuSortExec(TpuExec):
             batches: List[TpuColumnarBatch] = []
             total = 0
             ooc = None
-            for p in range(child.num_partitions()):
-                for b in child.execute_partition(p, ctx):
-                    total += b.num_rows
-                    if ooc is not None:
-                        ooc.add_batch(b)
-                        continue
-                    batches.append(b)
-                    if total > max_rows:
-                        # input exceeds one device batch → out-of-core path
-                        # (reference GpuOutOfCoreSortIterator)
-                        from .oocsort import OutOfCoreSorter
-                        ooc = OutOfCoreSorter(self.order, ctx)
-                        with self.metrics["sortTime"].timed():
-                            for queued in batches:
-                                ooc.add_batch(queued)
-                        batches = []
-            if ooc is not None:
-                try:
+            # the sorter owns spillable runs from its very first add_batch:
+            # a failure while LATER batches stream in (device error, chaos
+            # spill fault) must still close the parked runs, so the whole
+            # ingest+emit window sits under one finally (TL020)
+            try:
+                for p in range(child.num_partitions()):
+                    for b in child.execute_partition(p, ctx):
+                        total += b.num_rows
+                        if ooc is not None:
+                            ooc.add_batch(b)
+                            continue
+                        batches.append(b)
+                        if total > max_rows:
+                            # input exceeds one device batch → out-of-core
+                            # path (reference GpuOutOfCoreSortIterator)
+                            from .oocsort import OutOfCoreSorter
+                            ooc = OutOfCoreSorter(self.order, ctx)
+                            with self.metrics["sortTime"].timed():
+                                for queued in batches:
+                                    ooc.add_batch(queued)
+                            batches = []
+                if ooc is not None:
                     with self.metrics["sortTime"].timed():
                         yield from ooc.iter_sorted(max_rows)
-                finally:
+                    return
+            finally:
+                if ooc is not None:
                     ooc.close()
-                return
             if not batches:
                 return
             whole = concat_batches(batches)

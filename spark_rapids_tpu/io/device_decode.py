@@ -848,32 +848,59 @@ class DeviceFileDecoder:
             self.md = self.pf.metadata
         except Exception as e:  # noqa: BLE001 — unreadable footer
             raise DeviceDecodeError(f"{path}: cannot read footer ({e})")
-        if self.md.num_row_groups == 0:
-            raise DeviceDecodeError(f"{path}: no row groups")
-        self.arrow_schema = self.pf.schema_arrow
-        has_datetime = any(
-            pa.types.is_date32(f.type) or pa.types.is_timestamp(f.type)
-            for f in self.arrow_schema)
-        if has_datetime and needs_rebase(
-                self.md.metadata, conf.get(PARQUET_REBASE_MODE_READ)):
-            raise DeviceDecodeError(
-                f"{path}: legacy calendar rebase required")
-        # leaf (column-chunk) index by name, flat columns only
-        self.leaf_by_name: Dict[str, int] = {}
-        rg0 = self.md.row_group(0)
-        for j in range(rg0.num_columns):
-            p = rg0.column(j).path_in_schema
-            if "." not in p:
-                self.leaf_by_name[p] = j
-        for a in self.attrs:
-            if a.name not in self.leaf_by_name:
+        try:
+            if self.md.num_row_groups == 0:
+                raise DeviceDecodeError(f"{path}: no row groups")
+            self.arrow_schema = self.pf.schema_arrow
+            has_datetime = any(
+                pa.types.is_date32(f.type) or pa.types.is_timestamp(f.type)
+                for f in self.arrow_schema)
+            if has_datetime and needs_rebase(
+                    self.md.metadata, conf.get(PARQUET_REBASE_MODE_READ)):
                 raise DeviceDecodeError(
-                    f"{path}: column {a.name} not in file")
-        self.attrs_by_name = {a.name: a for a in self.attrs}
-        self.verify = bool(conf.get(PARQUET_DEVICE_DECODE_VERIFY))
-        # ONE resolved handle for all chunk-range reads of this file
-        # (a wide scan reads columns × row-groups ranges)
-        self.reader = FileCache.get(conf).range_reader(path, conf)
+                    f"{path}: legacy calendar rebase required")
+            # leaf (column-chunk) index by name, flat columns only
+            self.leaf_by_name: Dict[str, int] = {}
+            rg0 = self.md.row_group(0)
+            for j in range(rg0.num_columns):
+                p = rg0.column(j).path_in_schema
+                if "." not in p:
+                    self.leaf_by_name[p] = j
+            for a in self.attrs:
+                if a.name not in self.leaf_by_name:
+                    raise DeviceDecodeError(
+                        f"{path}: column {a.name} not in file")
+            self.attrs_by_name = {a.name: a for a in self.attrs}
+            self.verify = bool(conf.get(PARQUET_DEVICE_DECODE_VERIFY))
+            # ONE resolved handle for all chunk-range reads of this file
+            # (a wide scan reads columns × row-groups ranges)
+            self.reader = FileCache.get(conf).range_reader(path, conf)
+        except BaseException:
+            # validation raised after pf opened: the caller gets no
+            # decoder object to close, so the footer fd must not ride
+            # until GC — one leaked fd per host-fallback file otherwise
+            try:
+                self.pf.close()
+            except AttributeError:
+                pass
+            raise
+
+    def close(self) -> None:
+        """Release the byte-range handle (and the footer reader): one open
+        fd per scanned file must not ride until GC (TL020 — the scan loop
+        closes each decoder in a finally)."""
+        self.reader.close()
+        try:
+            self.pf.close()
+        except AttributeError:  # older pyarrow: no ParquetFile.close
+            pass
+
+    def __enter__(self) -> "DeviceFileDecoder":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     def row_groups(self, row_filter=None) -> List[int]:
         """Non-empty row groups surviving footer-statistics pruning (the

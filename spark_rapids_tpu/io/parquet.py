@@ -686,22 +686,29 @@ class TpuFileScanExec(FileScanBase, TpuExec):
                 yield from host_file(f)
                 continue
             self.metrics["filesRead"].add(1)
-            for rgi in dec.row_groups(row_filter):
-                try:
-                    # the decoder acquires the semaphore only for its
-                    # device staging+dispatch; host page walking overlaps
-                    # other tasks' device work. decodeTime/hostDecodeTime
-                    # split inside decode_row_group.
-                    batch = dec.decode_row_group(rgi, self.metrics,
-                                                 ctx=ctx)
-                    batch = self._attach_partition_vectors(batch, f, names)
-                except DeviceDecodeError:
-                    from .device_decode import _bump
-                    _bump("fallback_row_groups")
-                    # host_row_group already carries the full output schema
-                    batch = host_row_group(f, dec, rgi)
-                self._set_input_file(ctx, f)
-                yield batch
+            try:
+                for rgi in dec.row_groups(row_filter):
+                    try:
+                        # the decoder acquires the semaphore only for its
+                        # device staging+dispatch; host page walking
+                        # overlaps other tasks' device work.
+                        # decodeTime/hostDecodeTime split inside
+                        # decode_row_group.
+                        batch = dec.decode_row_group(rgi, self.metrics,
+                                                     ctx=ctx)
+                        batch = self._attach_partition_vectors(batch, f,
+                                                               names)
+                    except DeviceDecodeError:
+                        from .device_decode import _bump
+                        _bump("fallback_row_groups")
+                        # host_row_group carries the full output schema
+                        batch = host_row_group(f, dec, rgi)
+                    self._set_input_file(ctx, f)
+                    yield batch
+            finally:
+                # one open range-reader fd per file: released even when a
+                # downstream operator abandons the scan mid-file (TL020)
+                dec.close()
 
     def _attach_partition_vectors(self, batch: TpuColumnarBatch, f: str,
                                   names) -> TpuColumnarBatch:

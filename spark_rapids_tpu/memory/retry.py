@@ -31,10 +31,19 @@ def split_in_half(spillable: SpillableColumnarBatch) -> List[SpillableColumnarBa
     if n < 2:
         raise TpuSplitAndRetryOOM("cannot split a batch of fewer than 2 rows")
     half = n // 2
-    first = SpillableColumnarBatch(slice_batch(batch, 0, half))
-    second = SpillableColumnarBatch(slice_batch(batch, half, n - half))
+    halves: List[SpillableColumnarBatch] = []
+    try:
+        halves.append(SpillableColumnarBatch(slice_batch(batch, 0, half)))
+        halves.append(SpillableColumnarBatch(slice_batch(batch, half,
+                                                         n - half)))
+    except BaseException:
+        # registering the second half can itself OOM mid-split (its
+        # catalog add allocates): the first half must not leak (TL020)
+        for s in halves:
+            s.close()
+        raise
     spillable.close()
-    return [first, second]
+    return halves
 
 
 def with_retry(

@@ -1070,20 +1070,6 @@ class TpuSession:
         from . import obs
         from .config import TRACE_BUFFER_EVENTS, TRACE_CATEGORIES, \
             TRACE_ENABLED
-        qroot = None
-        opjit_before = None
-        if conf.get(TRACE_ENABLED):
-            from .config import TRACE_TAG
-            self._query_seq = getattr(self, "_query_seq", 0) + 1
-            tag = conf.get(TRACE_TAG)
-            stem = tag if tag and str(tag) != "None" else "query"
-            qroot = obs.begin_query(
-                f"{stem}-{self._query_seq}",
-                buffer_events=conf.get(TRACE_BUFFER_EVENTS),
-                categories=conf.get(TRACE_CATEGORIES))
-            if qroot is not None:
-                from .execs import opjit
-                opjit_before = opjit.cache_stats()["calls_by_kind"]
         from .parallel.mesh import mesh_session_active
         # mesh session (docs/distributed.md): the root pull drives ALL
         # partitions through the multi-partition entry point in one group,
@@ -1092,6 +1078,23 @@ class TpuSession:
         # launch — the same batched dispatch the exchange map side uses
         n_parts = final.num_partitions()
         group_pull = n_parts > 1 and mesh_session_active(conf) is not None
+        qroot = None
+        opjit_before = None
+        if conf.get(TRACE_ENABLED):
+            from .config import TRACE_TAG
+            from .execs import opjit
+            self._query_seq = getattr(self, "_query_seq", 0) + 1
+            tag = conf.get(TRACE_TAG)
+            stem = tag if tag and str(tag) != "None" else "query"
+            # snapshot BEFORE arming (nothing dispatches in between), so
+            # begin_query is the last raise-capable step before the
+            # try/finally that guarantees end_query: an exception here
+            # must never strand the process-wide tracer armed (TL020)
+            opjit_before = opjit.cache_stats()["calls_by_kind"]
+            qroot = obs.begin_query(
+                f"{stem}-{self._query_seq}",
+                buffer_events=conf.get(TRACE_BUFFER_EVENTS),
+                categories=conf.get(TRACE_CATEGORIES))
         tables = []
         try:
             if group_pull:

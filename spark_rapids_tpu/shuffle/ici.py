@@ -190,13 +190,23 @@ class IciShuffleCatalog:
                     # concurrent reduce task hit corruption / a peer was
                     # lost): silently skipping would DROP this map's rows
                     raise FetchFailedError(shuffle_id, [map_id])
-                try:
-                    # fetch under the lock: a concurrent invalidate/cleanup
-                    # could close the spillable after we release it
-                    batch = sb.get_batch() if sb is not None else None
-                except SpillCorruptionError as exc:
+            try:
+                # fetch OUTSIDE the catalog lock: get_batch can unspill
+                # (disk read + HBM allocation) and holding _mu across it
+                # both stalls every concurrent put and inverts the
+                # declared lock order (TL022: _mu is a leaf below the
+                # spill catalog's _reg_lock). A concurrent invalidate/
+                # cleanup closing the spillable after we released _mu
+                # surfaces as ValueError/KeyError — the block is GONE,
+                # which is exactly a FetchFailed: lineage recovery re-runs
+                # the map.
+                batch = sb.get_batch() if sb is not None else None
+            except SpillCorruptionError as exc:
+                with self._mu:
                     self._invalidate_map_locked(shuffle_id, map_id)
-                    raise FetchFailedError(shuffle_id, [map_id]) from exc
+                raise FetchFailedError(shuffle_id, [map_id]) from exc
+            except (ValueError, KeyError) as exc:
+                raise FetchFailedError(shuffle_id, [map_id]) from exc
             if batch is not None:
                 yield batch
 

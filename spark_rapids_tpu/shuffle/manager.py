@@ -76,6 +76,25 @@ class TpuShuffleManager:
                 cls._instance = TpuShuffleManager(conf)
             return cls._instance
 
+    def shutdown(self) -> None:
+        """Stop the writer/reader pools and drop the block store. A
+        replaced manager instance (tests swap `_instance`) must not keep
+        its pool threads and spill directory alive until interpreter
+        exit (TL020: the pools are owned resources)."""
+        self._writers.shutdown(wait=True)
+        self._readers.shutdown(wait=True)
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    @classmethod
+    def reset_for_tests(cls,
+                        conf: Optional[RapidsConf] = None
+                        ) -> "TpuShuffleManager":
+        with cls._lock:
+            old, cls._instance = cls._instance, None
+        if old is not None:
+            old.shutdown()
+        return cls.get(conf)
+
     def new_shuffle_id(self) -> int:
         with self._id_lock:
             self._next_shuffle_id += 1

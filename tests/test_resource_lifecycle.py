@@ -1,0 +1,205 @@
+"""Runtime cross-check for the TL020/TL023 static verdicts (ISSUE 11
+satellite): inject io_error/transient faults at the chaos sites the
+analyzer relies on, INSIDE one TL020-tracked scope per resource class, and
+assert every resource returns to baseline — permits, HBM bytes, spill
+dirs, MemoryCleaner count, open file handles, the process-wide tracer.
+
+The static pass proves the unwind path releases; this suite actually
+drives the unwind path the proof assumed (the dynamic twin — exactly why
+TL023 demands a registered chaos site in every tracked scope)."""
+
+import os
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F  # noqa: F401 — session dep
+from spark_rapids_tpu.chaos import FaultInjector
+from spark_rapids_tpu.columnar.batch import TpuColumnarBatch
+from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+from spark_rapids_tpu.memory.hbm import HbmBudget
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spill import (SpillableColumnarBatch,
+                                           TpuBufferCatalog)
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    FaultInjector.reset_for_tests()
+    TpuSemaphore.reset_for_tests()
+    yield
+    FaultInjector.reset_for_tests()
+    TpuSemaphore.reset_for_tests()
+
+
+def _table(n=512):
+    return pa.table({"k": pa.array([i % 7 for i in range(n)], pa.int64()),
+                     "v": pa.array([i * 3 - 11 for i in range(n)],
+                                   pa.int64())})
+
+
+def _baseline():
+    return {"cleaner": len(MemoryCleaner.get().live_resources()),
+            "hbm": HbmBudget.get().used}
+
+
+def _assert_baseline(before):
+    assert len(MemoryCleaner.get().live_resources()) == before["cleaner"]
+    assert HbmBudget.get().used == before["hbm"]
+    sem = TpuSemaphore._instance
+    if sem is not None:
+        assert sem._sem._value == sem.permits  # every permit returned
+
+
+# ---------------------------------------------------------------------------
+# resource class: spillable batches (with_retry / split_in_half scope)
+# ---------------------------------------------------------------------------
+
+def test_split_under_pressure_with_spill_io_error_leaks_nothing():
+    """io_error at `spill.to_host` while the retry framework splits a
+    batch under HBM pressure: the second half's registration fails
+    mid-split — the first half AND the original must both close (the
+    split_in_half + with_retry finally discipline TL020 verified)."""
+    from spark_rapids_tpu.memory.hbm import TpuSplitAndRetryOOM
+    from spark_rapids_tpu.memory.retry import with_retry
+    HbmBudget.reset_for_tests()
+    TpuBufferCatalog.reset_for_tests()
+    before = _baseline()
+    sb = SpillableColumnarBatch(TpuColumnarBatch.from_arrow(_table(2048)))
+    used0 = HbmBudget.get().used
+    # first half fits, registering the second trips the budget → the
+    # spill drain runs → forced io_error surfaces mid-split
+    HbmBudget.get().budget = int(used0 * 1.6)
+    FaultInjector.get().force("spill.to_host", "io_error", 4)
+
+    calls = {"n": 0}
+
+    def fn(batch):
+        calls["n"] += 1
+        raise TpuSplitAndRetryOOM("force a split")
+
+    with pytest.raises(OSError):
+        list(with_retry(sb, fn))
+    assert calls["n"] >= 1
+    FaultInjector.get().clear_forced()
+    _assert_baseline(before)
+    # no stray spill files either (the disk tier stayed clean)
+    catalog = TpuBufferCatalog.get()
+    assert os.listdir(catalog._disk_dir) == []
+    HbmBudget.reset_for_tests()
+    TpuBufferCatalog.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# resource class: out-of-core sorter (spillable runs) via the sort exec
+# ---------------------------------------------------------------------------
+
+def test_oocsort_unwind_on_spill_io_error_leaks_nothing():
+    """io_error at `spill.to_host` while a global sort parks spillable
+    runs under a tiny HBM budget: the ingest dies mid-stream with runs
+    already registered — every parked run must close on the unwind (the
+    sort.py try/finally TL020 demanded)."""
+    try:
+        HbmBudget.reset_for_tests()
+        TpuBufferCatalog.reset_for_tests()
+        probe = TpuColumnarBatch.from_arrow(_table(64))
+        run_bytes = probe.device_memory_size()
+        # room for ~3 parked runs, then pressure → spill → forced io_error
+        HbmBudget.reset_for_tests(budget_bytes=run_bytes * 3 + 64)
+        TpuBufferCatalog.reset_for_tests()
+        before = _baseline()
+        s = TpuSession({"spark.rapids.sql.batchSizeRows": "64"})
+        rows = [{"k": (i * 37) % 1000, "v": i} for i in range(600)]
+        df = s.createDataFrame(rows, num_partitions=2).sort("k")
+        FaultInjector.get().force("spill.to_host", "io_error", 8)
+        with pytest.raises(OSError):
+            df.collect()
+        assert FaultInjector.get().injection_count() > 0
+        FaultInjector.get().clear_forced()
+        _assert_baseline(before)
+        assert os.listdir(TpuBufferCatalog.get()._disk_dir) == []
+    finally:
+        # restore the real budget for the rest of the suite
+        HbmBudget.reset_for_tests()
+        TpuBufferCatalog.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# resource class: semaphore permits (exchange map pipeline scope)
+# ---------------------------------------------------------------------------
+
+def test_exchange_map_io_error_returns_all_permits():
+    """io_error at `pipeline.task` (not transient: with_device_retry must
+    NOT heal it) fails map tasks that hold device permits — every permit
+    and every staged block must release on the unwind."""
+    before = _baseline()
+    s = TpuSession({
+        "spark.sql.shuffle.partitions": "3",
+        "spark.rapids.tpu.shuffle.pipeline.enabled": "true",
+    })
+    rows = [{"k": i % 5, "v": i} for i in range(400)]
+    df = s.createDataFrame(rows, num_partitions=4).repartition(3, "k")
+    FaultInjector.get().force("pipeline.task", "io_error", 2)
+    with pytest.raises(Exception):
+        df.collect()
+    FaultInjector.get().clear_forced()
+    _assert_baseline(before)
+
+
+# ---------------------------------------------------------------------------
+# resource class: file handles (scan range readers)
+# ---------------------------------------------------------------------------
+
+def test_scan_with_io_error_keeps_fd_count_stable(tmp_path):
+    """scan.read io_error inside the device-decode scope: the per-file
+    RangeReader handles close deterministically whether the row group
+    healed via host fallback or the scan unwound (TL020's
+    DeviceFileDecoder.close contract). Open-fd count is the oracle."""
+    import pyarrow.parquet as pq
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"t{i}.parquet")
+        pq.write_table(_table(1024), p, row_group_size=256)
+        paths.append(p)
+
+    def fd_count():
+        return len(os.listdir("/proc/self/fd"))
+
+    s = TpuSession({})
+    s.read.parquet(paths[0]).to_arrow()  # warm caches/jit
+    before = fd_count()
+    FaultInjector.get().force("scan.read", "io_error", 3)
+    got = s.read.parquet(str(tmp_path)).to_arrow()  # heals via host
+    assert got.num_rows == 3 * 1024
+    FaultInjector.get().clear_forced()
+    # abandoned scan: a LIMIT closes the generator mid-file — the decoder
+    # must close with it, not wait for GC
+    s.read.parquet(str(tmp_path)).limit(5).to_arrow()
+    assert fd_count() == before
+
+
+# ---------------------------------------------------------------------------
+# resource class: the process-wide query tracer
+# ---------------------------------------------------------------------------
+
+def test_tracer_disarmed_after_failed_traced_query():
+    """A traced query that dies must still end_query on the unwind —
+    otherwise the process-wide tracer stays armed and every later query
+    silently runs untraced (the session.py TL020 fix)."""
+    from spark_rapids_tpu import obs
+    before = _baseline()
+    s = TpuSession({"spark.rapids.tpu.trace.enabled": "true",
+                    "spark.sql.shuffle.partitions": "2"})
+    rows = [{"k": i % 3, "v": i} for i in range(100)]
+    df = s.createDataFrame(rows, num_partitions=2).repartition(2, "k")
+    FaultInjector.get().force("pipeline.task", "io_error", 2)
+    with pytest.raises(Exception):
+        df.collect()
+    FaultInjector.get().clear_forced()
+    assert not obs.is_active()
+    # the next traced query can arm the tracer again (nothing stranded)
+    root = obs.begin_query("post-failure")
+    assert root is not None
+    obs.end_query(root)
+    _assert_baseline(before)

@@ -612,6 +612,438 @@ def test_taint_acquired_in_branch_survives_the_join():
     assert "np-on-device" in _detectors(rep)
 
 
+# ---------------------------------------------------------------------------
+# TL020/TL023 resource-lifetime lint + TL021/TL022 lock discipline
+# ---------------------------------------------------------------------------
+
+def _tl020(src: str, relpath: str = "execs/x.py"):
+    from spark_rapids_tpu.analysis import lint_lifecycle_module
+    return lint_lifecycle_module(textwrap.dedent(src), relpath)
+
+
+def test_tl020_unreleased_spillable_true_positive():
+    """An acquisition followed by raise-capable work with no finally/
+    transfer leaks on the exception path."""
+    findings = _tl020("""\
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def f(batch, work):
+            sb = SpillableColumnarBatch(batch)
+            out = work(sb.get_batch())
+            sb.close()
+            return out
+        """)
+    assert [f.rule for f in findings] == ["TL020"]
+    assert "execs/x.py::f" == findings[0].location
+
+
+def test_tl020_finally_and_ctx_manager_near_misses():
+    findings = _tl020("""\
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def f(batch, work):
+            sb = SpillableColumnarBatch(batch)
+            try:
+                return work(sb.get_batch())
+            finally:
+                sb.close()
+        def g(batch, work):
+            with SpillableColumnarBatch(batch) as sb:
+                return work(sb.get_batch())
+        def h(batch, work):
+            sb = SpillableColumnarBatch(batch)
+            try:
+                return work(sb.get_batch())
+            except BaseException:
+                sb.close()
+                raise
+        """)
+    assert findings == []
+
+
+def test_tl020_ownership_transfer_near_misses():
+    """return/yield, container append, self-store and the recognized
+    sinks (with_retry* close what they are handed) all transfer."""
+    findings = _tl020("""\
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def ret(batch):
+            sb = SpillableColumnarBatch(batch)
+            return sb
+        def sink(batch, fn):
+            return with_retry_no_split(SpillableColumnarBatch(batch), fn)
+        class Owner:
+            def __init__(self):
+                self.runs = []
+            def park(self, batch):
+                self.runs.append(SpillableColumnarBatch(batch))
+            def close(self):
+                for r in self.runs:
+                    r.close()
+        """)
+    assert findings == []
+
+
+def test_tl020_release_must_cover_the_acquisition():
+    """A finally that releases is NOT enough when raise-capable work runs
+    between the acquisition and the try (the session begin_query shape)."""
+    findings = _tl020("""\
+        from spark_rapids_tpu.obs.tracer import begin_query, end_query
+        def f(risky):
+            q = begin_query("q")
+            risky()
+            try:
+                return 1
+            finally:
+                if q is not None:
+                    end_query(q)
+        """)
+    assert [f.rule for f in findings] == ["TL020"]
+    assert "query-trace" in findings[0].message
+
+
+def test_tl020_release_through_helper_summary():
+    """Interprocedural: a finally calling a same-module helper that passes
+    the resource to end_query counts as the release."""
+    findings = _tl020("""\
+        from spark_rapids_tpu.obs.tracer import begin_query, end_query
+        def _finish(q, extra):
+            profile = end_query(q)
+            return profile
+        def f(risky):
+            q = begin_query("q")
+            try:
+                return risky()
+            finally:
+                if q is not None:
+                    _finish(q, 1)
+        """)
+    assert findings == []
+
+
+def test_tl020_semaphore_permit_on_local_ctx():
+    """acquire_if_necessary on a locally created TaskContext needs
+    ctx.complete() in a finally; a caller-owned ctx is exempt."""
+    tp = _tl020("""\
+        def f(sem, conf, work):
+            ctx = TaskContext(0, conf)
+            sem.acquire_if_necessary(ctx)
+            work(ctx)
+            ctx.complete()
+        """)
+    assert [f.rule for f in tp] == ["TL020"]
+    assert "semaphore-permit" in tp[0].message
+    nm = _tl020("""\
+        def f(sem, conf, work):
+            ctx = TaskContext(0, conf)
+            try:
+                sem.acquire_if_necessary(ctx)
+                work(ctx)
+            finally:
+                ctx.complete()
+        def caller_owned(sem, ctx, work):
+            sem.acquire_if_necessary(ctx)
+            work(ctx)
+        """)
+    assert nm == []
+
+
+def test_tl020_owner_class_without_release_method():
+    """A class storing a tracked resource on self must expose close():
+    otherwise its owner cannot uphold the discipline (the
+    DeviceFileDecoder shape)."""
+    tp = _tl020("""\
+        class Decoder:
+            def __init__(self, cache, path, conf):
+                self.reader = cache.range_reader(path, conf)
+        """)
+    assert [f.rule for f in tp] == ["TL020"]
+    assert "close" in tp[0].message
+    nm = _tl020("""\
+        class Decoder:
+            def __init__(self, cache, path, conf):
+                self.reader = cache.range_reader(path, conf)
+            def close(self):
+                self.reader.close()
+        """)
+    assert nm == []
+
+
+def test_tl023_uncovered_boundary_in_tracked_scope():
+    """Raw file IO inside a resource-tracked scope with no chaos site
+    cannot be exercised by the soaks; an inject() in scope (or a
+    chaos-wired callable) covers it."""
+    tp = _tl020("""\
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def f(batch, path):
+            sb = SpillableColumnarBatch(batch)
+            try:
+                with open(path, "rb") as fh:
+                    data = fh.read(8)
+                return data
+            finally:
+                sb.close()
+        """)
+    assert "TL023" in {f.rule for f in tp}
+    nm = _tl020("""\
+        from spark_rapids_tpu.chaos import inject
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def f(batch, path):
+            sb = SpillableColumnarBatch(batch)
+            try:
+                inject("scan.read", detail=path)
+                with open(path, "rb") as fh:
+                    data = fh.read(8)
+                return data
+            finally:
+                sb.close()
+        """)
+    assert [f.rule for f in nm if f.rule == "TL023"] == []
+
+
+def test_tl023_wired_sites_exist_in_injector():
+    """The WIRED/BOUNDARY site names are a contract against
+    chaos/injector.py's ALL_SITES — validated at lint time."""
+    from spark_rapids_tpu.analysis.lifecycle import (BOUNDARY_SITE_HINTS,
+                                                     WIRED_CALLS)
+    from spark_rapids_tpu.chaos.injector import ALL_SITES
+    assert set(WIRED_CALLS.values()) <= set(ALL_SITES)
+    assert set(BOUNDARY_SITE_HINTS.values()) <= set(ALL_SITES)
+
+
+def _tl021(src: str, relpath: str = "execs/x.py"):
+    from spark_rapids_tpu.analysis import lint_locks_module
+    findings, edges = lint_locks_module(textwrap.dedent(src), relpath)
+    return findings, edges
+
+
+def test_tl021_blocking_under_module_lock_true_positive():
+    findings, _ = _tl021("""\
+        import threading
+        from spark_rapids_tpu.columnar.vector import audited_sync
+        _LOCK = threading.Lock()
+        _CACHE = {}
+        def f(key, col):
+            with _LOCK:
+                _CACHE[key] = audited_sync(col.data, "bounds")
+        """)
+    assert [f.rule for f in findings] == ["TL021"]
+    assert "audited_sync" in findings[0].message
+
+
+def test_tl021_lock_released_first_near_miss():
+    """The canonical fix: compute (block) outside, publish under the
+    lock — and instance locks are out of TL021's scope."""
+    findings, _ = _tl021("""\
+        import threading
+        from spark_rapids_tpu.columnar.vector import audited_sync
+        _LOCK = threading.Lock()
+        _CACHE = {}
+        def f(key, col):
+            bounds = audited_sync(col.data, "bounds")
+            with _LOCK:
+                _CACHE[key] = bounds
+        class C:
+            def __init__(self):
+                self._mat_lock = threading.Lock()
+            def g(self, col):
+                with self._mat_lock:  # instance lock: memoization, not
+                    return audited_sync(col.data, "x")  # process-wide
+        """)
+    assert [f for f in findings if f.rule == "TL021"] == []
+
+
+def test_tl021_class_singleton_lock_is_process_wide():
+    """Blocking under a class-ATTRIBUTE lock (the singleton `_lock`
+    pattern) fires like a module-level lock: it gates the whole process."""
+    findings, _ = _tl021("""\
+        import threading
+        class Mgr:
+            _lock = threading.Lock()
+            @classmethod
+            def drain(cls, futs):
+                with cls._lock:
+                    for f in futs:
+                        f.result()
+        """)
+    assert [f.rule for f in findings] == ["TL021"]
+
+
+def test_tl020_summary_lookup_is_receiver_aware():
+    """A module function named like a common method (`get`) must not
+    poison unrelated `d.get(k)` attribute calls with its resource
+    summary (the locks-pass qualified-key discipline)."""
+    findings = _tl020("""\
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def get(batch):
+            return SpillableColumnarBatch(batch)
+        def unrelated(d, k, work):
+            v = d.get(k)
+            work(v)
+            return v
+        """)
+    assert findings == []
+
+
+def test_tl021_blocking_through_helper_summary():
+    """Interprocedural: a helper that joins pool futures, called under a
+    module-level lock, is still a TL021."""
+    findings, _ = _tl021("""\
+        import threading
+        _LOCK = threading.Lock()
+        def _drain(futs):
+            for f in futs:
+                f.result()
+        def g(futs):
+            with _LOCK:
+                _drain(futs)
+        """)
+    assert [f.rule for f in findings] == ["TL021"]
+
+
+def test_tl022_order_violation_and_cycle():
+    from spark_rapids_tpu.analysis.locks import _check_order
+    _, edges = _tl021("""\
+        import threading
+        _mat_lock = threading.Lock()
+        _reg_lock = threading.RLock()
+        def good():
+            with _mat_lock:
+                with _reg_lock:
+                    pass
+        def bad():
+            with _reg_lock:
+                with _mat_lock:
+                    pass
+        """, relpath="shuffle/x.py")
+    findings = _check_order(edges)
+    assert any("lock-order violation" in f.message for f in findings)
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_tl022_declared_order_near_miss_and_unknown_lock():
+    from spark_rapids_tpu.analysis.locks import _check_order
+    _, edges = _tl021("""\
+        import threading
+        _mat_lock = threading.Lock()
+        _state_lock = threading.Lock()
+        def good():
+            with _mat_lock:
+                with _state_lock:
+                    pass
+        """)
+    assert _check_order(edges) == []
+    _, edges = _tl021("""\
+        import threading
+        _mat_lock = threading.Lock()
+        _weird_new_lock = threading.Lock()
+        def f():
+            with _mat_lock:
+                with _weird_new_lock:
+                    pass
+        """)
+    findings = _check_order(edges)
+    assert any("not in the declared lock order" in f.message
+               for f in findings)
+
+
+def test_tl022_multi_item_with_records_edges():
+    """`with A, B:` nests B under A exactly like the two-statement form —
+    the one-line inversion must not slip past the order check."""
+    from spark_rapids_tpu.analysis.locks import _check_order
+    _, edges = _tl021("""\
+        import threading
+        _mat_lock = threading.Lock()
+        _reg_lock = threading.RLock()
+        def bad():
+            with _reg_lock, _mat_lock:
+                pass
+        """)
+    findings = _check_order(edges)
+    assert any("lock-order violation" in f.message for f in findings)
+
+
+def test_tl023_wired_call_covers_the_scope():
+    """A tracked scope driven through a chaos-wired API (with_device_retry
+    runs under device.dispatch internally) is exercisable — a raw
+    boundary in the same scope needs no extra inject()."""
+    covered = _tl020("""\
+        from spark_rapids_tpu.failure import with_device_retry
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def f(batch, arrs, conf):
+            sb = SpillableColumnarBatch(batch)
+            try:
+                with_device_retry(lambda: None, conf)
+                for a in arrs:
+                    a.block_until_ready()
+                return 1
+            finally:
+                sb.close()
+        """)
+    assert [f.rule for f in covered if f.rule == "TL023"] == []
+    bare = _tl020("""\
+        from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+        def f(batch, arrs):
+            sb = SpillableColumnarBatch(batch)
+            try:
+                for a in arrs:
+                    a.block_until_ready()
+                return 1
+            finally:
+                sb.close()
+        """)
+    assert "TL023" in {f.rule for f in bare}
+
+
+def test_tl022_self_deadlock_on_plain_lock():
+    findings, _ = _tl021("""\
+        import threading
+        _STATS_LOCK = threading.Lock()
+        def f():
+            with _STATS_LOCK:
+                with _STATS_LOCK:
+                    pass
+        """)
+    assert any(f.rule == "TL022" and "self-deadlock" in f.message
+               for f in findings)
+
+
+def test_tl02x_real_tree_is_clean_with_empty_baseline():
+    """The acceptance bar: TL020–TL023 over execs/, shuffle/, memory/,
+    parallel/, io/, session.py surface ZERO findings and the committed
+    baseline contains no TL02x entries (real findings were fixed, not
+    suppressed — the TL010/TL011/TL012 precedent)."""
+    from spark_rapids_tpu.analysis import (lint_lifecycle_tree,
+                                           lint_locks_tree)
+    baseline = tracelint.load_baseline()
+    assert not any(k.startswith(("TL020", "TL021", "TL022", "TL023"))
+                   for k in baseline)
+    fresh = lint_lifecycle_tree() + lint_locks_tree()
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_declared_lock_order_covers_the_tree():
+    """Every lock the graph walk sees in the shipped tree has a declared
+    level (TL022's 'declare before you nest' contract is enforceable)."""
+    from spark_rapids_tpu.analysis.locks import (LOCK_ORDER,
+                                                 lint_locks_tree)
+    assert len(LOCK_ORDER) >= 5
+    assert [f for f in lint_locks_tree()
+            if "not in the declared lock order" in f.message] == []
+
+
+def test_cli_only_filter_and_list_rules(capsys):
+    """`--only TL020,...` runs just the selected passes; `--list-rules`
+    enumerates the rule families (docs/analysis.md workflow)."""
+    assert tracelint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("TL001", "TL010", "TL011", "TL012", "TL020", "TL021",
+                 "TL022", "TL023"):
+        assert rule in out
+    assert tracelint.main(["--only", "TL020,TL021,TL022,TL023"]) == 0
+    out = capsys.readouterr().out
+    assert "--only" in out and "ok: no non-baselined findings" in out
+    assert tracelint.main(["--only", "TL999"]) == 2
+
+
 def test_compute_method_params_are_seeded_as_device_values():
     """classify_class seeds `_compute(self, ldata, rdata, ...)` operands from
     the signature — host ops on them must be visible, not just on `batch`."""

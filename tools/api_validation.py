@@ -76,6 +76,145 @@ def _metric_names_of(cls) -> set:
     return names
 
 
+_CONF_KEY_RE = None
+
+
+def _conf_keys_in_text(text: str):
+    """spark.rapids.tpu.* / spark.rapids.shuffle.* key candidates mentioned
+    in a string (f-string fragments and doc prose included)."""
+    global _CONF_KEY_RE
+    import re
+    if _CONF_KEY_RE is None:
+        _CONF_KEY_RE = re.compile(
+            r"spark\.rapids\.(?:tpu|shuffle)\.[A-Za-z0-9_.]+")
+    return [m.rstrip(".") for m in _CONF_KEY_RE.findall(text)]
+
+
+def _config_constant_names():
+    """config.py module-level NAME -> conf key, from the builder DSL
+    (``NAME = conf("key").doc(...)...``)."""
+    import spark_rapids_tpu.config as cfg
+    root = os.path.dirname(cfg.__file__)
+    out = {}
+    with open(cfg.__file__) as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        # innermost conf("key") of the builder chain
+        # (conf("k").doc(...).booleanConf.createWithDefault(...))
+        for sub in ast.walk(node.value):
+            if not (isinstance(sub, ast.Call) and sub.args
+                    and isinstance(sub.args[0], ast.Constant)
+                    and isinstance(sub.args[0].value, str)):
+                continue
+            f_ = sub.func
+            fname = f_.id if isinstance(f_, ast.Name) else (
+                f_.attr if isinstance(f_, ast.Attribute) else "")
+            if fname in ("conf", "_conf") \
+                    and sub.args[0].value.startswith("spark."):
+                out[node.targets[0].id] = sub.args[0].value
+                break
+    return out, root
+
+
+def conf_consistency():
+    """Conf-consistency check (the tracelint-adjacent registry contract):
+
+    * every ``spark.rapids.tpu.*`` / ``spark.rapids.shuffle.*`` key
+      mentioned anywhere in ``spark_rapids_tpu/`` must be declared in
+      config.py's registry (a candidate that is a strict prefix of a
+      registered key — ``spark.rapids.tpu.test.chaos`` in prose — is fine);
+    * every registered key must appear in the regenerated docs/configs.md;
+    * every key documented in the configs.md TABLE must be registered (no
+      documented-but-dead keys);
+    * every registered tpu/shuffle key must actually be READ somewhere
+      outside config.py — via its config constant or its literal key —
+      in the package, tests, or benchmarks (no declared-but-dead keys).
+    """
+    from spark_rapids_tpu.config import REGISTRY
+    registered = set(REGISTRY.entries)
+    scoped = {k for k in registered
+              if k.startswith(("spark.rapids.tpu.", "spark.rapids.shuffle."))}
+    constants, pkg_root = _config_constant_names()
+    key_to_consts = {}
+    for name, key in constants.items():
+        key_to_consts.setdefault(key, set()).add(name)
+    violations = []
+
+    used_keys = set()
+    used_consts = set()
+    repo_root = os.path.dirname(pkg_root)
+    scan_roots = [pkg_root,
+                  os.path.join(repo_root, "tests"),
+                  os.path.join(repo_root, "benchmarks")]
+    for root in scan_roots:
+        for dirpath, _dirs, files in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                in_pkg = path.startswith(pkg_root)
+                is_config = in_pkg and fname == "config.py" \
+                    and dirpath == pkg_root
+                with open(path) as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src)
+                except SyntaxError:
+                    continue
+                rel = os.path.relpath(path, repo_root)
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Constant) \
+                            and isinstance(node.value, str):
+                        for key in _conf_keys_in_text(node.value):
+                            if not is_config:
+                                used_keys.add(key)
+                            if in_pkg and not is_config \
+                                    and key not in registered \
+                                    and not any(r.startswith(key + ".")
+                                                for r in registered):
+                                violations.append(
+                                    f"conf: {rel} reads undeclared key "
+                                    f"{key!r} — declare it in config.py "
+                                    f"(and regenerate docs/configs.md)")
+                    elif isinstance(node, ast.Name) and not is_config \
+                            and node.id in constants:
+                        used_consts.add(node.id)
+
+    # registry ↔ docs
+    docs_path = os.path.join(repo_root, "docs", "configs.md")
+    with open(docs_path) as f:
+        doc_lines = f.read().splitlines()
+    doc_keys = {line.split("|")[1].strip() for line in doc_lines
+                if line.startswith("| spark.rapids")}
+    # gen_docs.py documents the non-internal spark.rapids.* surface
+    # (passthrough spark.sql.* compatibility keys are Spark's docs, not
+    # ours; internal() test hooks are deliberately undocumented)
+    documentable = {k for k in registered
+                    if k.startswith("spark.rapids.")
+                    and not REGISTRY.entries[k].internal}
+    for key in sorted(documentable - doc_keys):
+        violations.append(
+            f"conf: registered key {key!r} missing from docs/configs.md — "
+            f"run tools/gen_docs.py")
+    for key in sorted(doc_keys - registered):
+        violations.append(
+            f"conf: docs/configs.md documents {key!r} but config.py does "
+            f"not declare it (documented-but-dead)")
+
+    # declared-but-dead: no literal use and no constant use anywhere
+    for key in sorted(scoped - used_keys):
+        if not (key_to_consts.get(key, set()) & used_consts):
+            violations.append(
+                f"conf: key {key!r} is declared in config.py but read "
+                f"nowhere (package, tests, benchmarks) — dead conf")
+    return violations
+
+
 def validate():
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -163,6 +302,7 @@ def validate():
                 violations.append(
                     f"expression {cls.__name__}: type_sig lacks check()")
 
+    violations.extend(conf_consistency())
     return violations
 
 

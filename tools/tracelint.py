@@ -23,6 +23,19 @@ them against the ~20 modules of eval_tpu implementations.  This tool does:
                         memory/ bypassing the obs API (tracer internals,
                         raw jax.profiler), or a blocking device→host sync
                         inside a span/event argument                 (error)
+  resource lifetime     TL020 a tracked acquisition (spillables, permits,
+                        file handles, pools, the query tracer) whose
+                        release is not guaranteed on all paths incl.
+                        exceptions (finally / ctx manager / recognized
+                        ownership transfer)                          (error)
+  lock discipline       TL021 blocking op (audited sync, collective wait,
+                        pool result/join, sleep) under a process-wide
+                        lock                                         (error)
+                        TL022 lock graph vs the declared partial order
+                        (analysis/locks.py LOCK_ORDER) + cycle check (error)
+  chaos coverage        TL023 raise-capable external boundary inside a
+                        TL020-tracked scope with no registered chaos
+                        site — the unwind path cannot be exercised   (error)
 
 Findings diff against tools/tracelint_baseline.txt (one key per line, `#`
 comments allowed) so exceptions are explicit.  Exit status is non-zero iff
@@ -31,6 +44,8 @@ any non-baselined error/warning finding exists (info never gates).
 Usage:
   python -m tools.tracelint                 # static passes + baseline diff
   python -m tools.tracelint --corroborate   # + jax.eval_shape probe (TL005)
+  python -m tools.tracelint --only TL020,TL022   # one detector, fast
+  python -m tools.tracelint --list-rules
   python -m tools.tracelint --update-baseline
   python -m tools.tracelint --verbose       # include info findings + modes
 """
@@ -81,20 +96,67 @@ def write_baseline(keys, path=BASELINE_PATH, comments=None):
             f.write(f"{k}  # {c}\n" if c else f"{k}\n")
 
 
-def collect_findings(corroborate=False):
-    """All findings from every pass, plus the expression reports."""
-    from spark_rapids_tpu.analysis import (analyze_registry, lint_obs_tree,
+#: rule families by pass: (rules, one-line description) — drives
+#: --list-rules and the --only pass selection (an unselected pass is
+#: skipped entirely, not just filtered, for fast local iteration)
+RULE_PASSES = (
+    (("TL001", "TL002", "TL003", "TL004"),
+     "registry cross-check: eval_tpu verdicts vs plan/typechecks.py"),
+    (("TL005",),
+     "jax.eval_shape corroboration probe (needs --corroborate)"),
+    (("TL010",),
+     "concurrency lint: module-level mutable state mutated outside a lock"),
+    (("TL011",),
+     "blocking-sync lint: raw device→host transfers outside the audited "
+     "gate"),
+    (("TL012",),
+     "observability lint: obs-API emission discipline, no syncs in event "
+     "args"),
+    (("TL020", "TL023"),
+     "resource lifetime: guaranteed release on all paths + chaos coverage "
+     "of the unwind paths"),
+    (("TL021", "TL022"),
+     "lock discipline: no blocking under process-wide locks; lock graph "
+     "vs the declared order"),
+)
+
+ALL_RULES = tuple(r for rules, _ in RULE_PASSES for r in rules)
+
+
+def _selected(only, rules) -> bool:
+    return only is None or bool(set(rules) & only)
+
+
+def collect_findings(corroborate=False, only=None):
+    """All findings from every (selected) pass, plus the expression
+    reports. `only` is a set of rule ids: passes producing none of them
+    are skipped entirely."""
+    from spark_rapids_tpu.analysis import (analyze_registry,
+                                           lint_lifecycle_tree,
+                                           lint_locks_tree, lint_obs_tree,
                                            lint_sync_tree, lint_tree)
-    reports, findings = analyze_registry()
-    findings = list(findings)
-    findings.extend(lint_tree())
-    findings.extend(lint_sync_tree())
-    findings.extend(lint_obs_tree())
+    findings = []
+    reports = []
+    if _selected(only, ("TL001", "TL002", "TL003", "TL004", "TL005")):
+        reports, reg_findings = analyze_registry()
+        findings.extend(reg_findings)
+    if _selected(only, ("TL010",)):
+        findings.extend(lint_tree())
+    if _selected(only, ("TL011",)):
+        findings.extend(lint_sync_tree())
+    if _selected(only, ("TL012",)):
+        findings.extend(lint_obs_tree())
+    if _selected(only, ("TL020", "TL023")):
+        findings.extend(lint_lifecycle_tree())
+    if _selected(only, ("TL021", "TL022")):
+        findings.extend(lint_locks_tree())
     probe_results = None
-    if corroborate:
+    if corroborate and _selected(only, ("TL005",)):
         from spark_rapids_tpu.analysis import corroborate as _corr
         probe_results, probe_findings = _corr(reports)
         findings.extend(probe_findings)
+    if only is not None:
+        findings = [f for f in findings if f.rule in only]
     return reports, findings, probe_results
 
 
@@ -111,12 +173,36 @@ def main(argv=None) -> int:
                          "the per-expression verdict table")
     ap.add_argument("--baseline", default=BASELINE_PATH,
                     help="baseline file (default: tools/tracelint_baseline.txt)")
+    ap.add_argument("--only", default=None, metavar="TLxxx[,TLxxx]",
+                    help="run only the passes producing these rules "
+                         "(fast local iteration on one detector)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list every rule id with its pass and exit")
     args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rules, desc in RULE_PASSES:
+            print(f"{'/'.join(rules):28s} {desc}")
+        return 0
+
+    only = None
+    if args.only:
+        only = {r.strip().upper() for r in args.only.split(",") if r.strip()}
+        unknown = only - set(ALL_RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                  f"(see --list-rules)")
+            return 2
+        if args.update_baseline:
+            print("--update-baseline with --only would clobber the other "
+                  "rules' entries; run it without --only")
+            return 2
 
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    reports, findings, probe_results = collect_findings(args.corroborate)
+    reports, findings, probe_results = collect_findings(args.corroborate,
+                                                        only)
     baseline = set(load_baseline(args.baseline))
 
     gating = [f for f in findings if f.severity in ("error", "warning")]
@@ -125,9 +211,11 @@ def main(argv=None) -> int:
     suppressed = [f for f in gating if f.key in baseline]
     present = {f.key for f in gating}
     # TL005 only exists when the probe ran: without --corroborate those
-    # baseline entries are neither present nor stale — leave them alone
+    # baseline entries are neither present nor stale — leave them alone.
+    # Under --only, entries for unselected rules are likewise untouched.
     stale = sorted(k for k in baseline if k not in present
-                   and not (k.startswith("TL005 ") and not args.corroborate))
+                   and not (k.startswith("TL005 ") and not args.corroborate)
+                   and (only is None or k.split(" ", 1)[0] in only))
 
     if args.update_baseline:
         old = load_baseline(args.baseline)
@@ -148,23 +236,27 @@ def main(argv=None) -> int:
               f"{len(keep)} kept -> {args.baseline}")
         return 0
 
-    n_dev = sum(1 for r in reports if r.verdict == "device")
-    n_cond = sum(1 for r in reports if r.verdict == "conditional-host")
-    n_host = len(reports) - n_dev - n_cond
-    print(f"tracelint: {len(reports)} registered expressions analyzed "
-          f"({n_dev} device / {n_cond} conditional-host / {n_host} host or "
-          f"untraceable), {len(findings)} raw findings")
-    from spark_rapids_tpu.analysis.registry_check import scan_kernels
-    kernels = scan_kernels()
-    k_all = [(m, fn, v) for m, fns in kernels.items()
-             for fn, v in fns.items()]
-    k_dev = sum(1 for _, _, v in k_all if v == "device")
-    print(f"kernels: {len(k_all)} public kernel functions across "
-          f"{len(kernels)} modules ({k_dev} device-traceable)")
-    if args.verbose:
-        for m, fn, v in k_all:
-            if v != "device":
-                print(f"  [kernel] {m}::{fn}: {v}")
+    if _selected(only, ("TL001", "TL002", "TL003", "TL004", "TL005")):
+        n_dev = sum(1 for r in reports if r.verdict == "device")
+        n_cond = sum(1 for r in reports if r.verdict == "conditional-host")
+        n_host = len(reports) - n_dev - n_cond
+        print(f"tracelint: {len(reports)} registered expressions analyzed "
+              f"({n_dev} device / {n_cond} conditional-host / {n_host} host "
+              f"or untraceable), {len(findings)} raw findings")
+        from spark_rapids_tpu.analysis.registry_check import scan_kernels
+        kernels = scan_kernels()
+        k_all = [(m, fn, v) for m, fns in kernels.items()
+                 for fn, v in fns.items()]
+        k_dev = sum(1 for _, _, v in k_all if v == "device")
+        print(f"kernels: {len(k_all)} public kernel functions across "
+              f"{len(kernels)} modules ({k_dev} device-traceable)")
+        if args.verbose:
+            for m, fn, v in k_all:
+                if v != "device":
+                    print(f"  [kernel] {m}::{fn}: {v}")
+    else:
+        print(f"tracelint --only {','.join(sorted(only))}: "
+              f"{len(findings)} raw findings")
     if probe_results is not None:
         n_tr = sum(1 for r in probe_results.values() if r.status == "traceable")
         n_un = sum(1 for r in probe_results.values()
