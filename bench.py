@@ -276,8 +276,20 @@ def _trace_artifacts(s, run_once, tag: str) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
     finally:
         s.conf.set("spark.rapids.tpu.trace.enabled", "false")
+    # the always-on registry snapshot ships as a per-stage artifact next to
+    # the Chrome trace (cumulative at this point of the run — diffing two
+    # stages' snapshots isolates one stage's counters)
+    metrics_path = None
+    try:
+        os.makedirs(_TRACE_DIR, exist_ok=True)
+        metrics_path = os.path.join(_TRACE_DIR, f"{tag}.metrics.json")
+        with open(metrics_path, "w") as f:
+            json.dump(s.metrics_snapshot(), f, default=str)
+    except Exception:  # noqa: BLE001 — artifact-only, never fail the run
+        metrics_path = None
     return {
         "artifacts": p.get("artifacts"),
+        "metrics_snapshot": metrics_path,
         "reconcile": p.get("reconcile"),
         "dispatches_by_kind": p.get("dispatches_by_kind"),
         "sync_events_total": p.get("sync_events_total"),

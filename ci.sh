@@ -35,6 +35,14 @@ echo "== tracelint (trace-safety & registry consistency) =="
 # execution-mode column in docs/supported_ops.md.
 python -m tools.tracelint
 
+echo "== obs self-check (metrics registry + flight recorder + tracer) =="
+# Exercises the always-on observability plane in-process (docs/
+# observability.md): registry counter/gauge/histogram round trips with
+# quantile readouts, query-lifecycle histograms, CONCURRENT per-query
+# tracing with counted (never silent) capacity drops, and the flight
+# recorder's postmortem bundle assembly.
+python -m tools.obs_report --self-check
+
 echo "== api validation (registry + conf consistency) =="
 # Structural registry contracts plus the conf-consistency check: every
 # spark.rapids.tpu.*/spark.rapids.shuffle.* key read in the package is
@@ -56,6 +64,7 @@ python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
   tests/test_shuffle.py tests/test_tracelint.py tests/test_obs.py \
+  tests/test_obs_serving.py \
   tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   -x -q -m 'not slow' -p no:cacheprovider

@@ -83,13 +83,17 @@ LOCK_ORDER: Tuple[Tuple[str, ...], ...] = (
      "MeshContext._lock", "MemoryCleaner._lock", "TpuDeviceManager._lock",
      "FileCache._lock", "IciShuffleCatalog._lock",
      "ShuffleHeartbeatManager._lock", "FaultInjector._cls_lock",
-     "QueryTracer._cls_lock", "TaskMetricsRegistry._lock",
-     "SyncLedger._lock"),
+     "TaskMetricsRegistry._lock", "SyncLedger._lock"),
+    # L4b — obs query-lifecycle lock: commits the active-query gauge into
+    # the registry structure lock (L5) while held, so an interleaved
+    # begin/end pair can never publish a stale count
+    ("_QL_LOCK",),
     # L5 — state/stats/program-cache leaf locks: short critical sections
-    # that publish precomputed values
+    # that publish precomputed values (_REG_LOCK: the obs tracer registry
+    # + metrics-registry structure locks)
     ("_state_lock", "_id_lock", "_stats_lock", "_mu", "_LOCK",
      "_CACHE_LOCK", "_STATS_LOCK", "_STAGE_FN_LOCK", "_JOIN_CACHE_LOCK",
-     "_DIM_CACHE_LOCK", "_lock", "_evict_lock"),
+     "_DIM_CACHE_LOCK", "_lock", "_evict_lock", "_REG_LOCK"),
     # L6 — observability/chaos terminals: reached from every layer above
     # (event emission, fault injection), acquire nothing themselves
     ("QueryTracer._mu", "FaultInjector._mu", "SyncLedger._mu",

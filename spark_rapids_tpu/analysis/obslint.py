@@ -1,26 +1,34 @@
-"""Observability lint (rule **TL012**): span/event emission discipline.
+"""Observability lint (rule **TL012**): emission discipline for the whole
+obs plane — tracer spans/events, metrics-registry increments, and flight-
+recorder notes.
 
 The obs layer (docs/observability.md) is only trustworthy if engine code
 follows two rules, checked statically here over ``execs/``, ``shuffle/``,
 ``memory/`` and ``parallel/`` (the mesh.exchange spans):
 
 1. **Route through the obs API.** Emission sites must use the public
-   helpers (``obs.span`` / ``obs.event`` / ``obs.current_span``) — not the
-   tracer internals (``QueryTracer``, ``_Span``, the ring-buffer
-   ``_append``) and not raw ``jax.profiler`` annotations (those belong in
-   profiling.py's ``trace_scope``, which carries the off-fast-path). A
-   bypass would skip the ``_ACTIVE`` gate (overhead when tracing is off),
-   the category filter, and the thread-local span stacks (corrupting the
-   tree for every later span on that thread).
+   helpers (``obs.span`` / ``obs.event`` / ``obs.dispatch_event`` /
+   ``obs.sync_event`` / ``obs.current_span``; ``metrics.counter_inc`` /
+   ``gauge_set`` / ``gauge_max`` / ``histogram_observe``;
+   ``flight.note``) — not the tracer internals (``QueryTracer``,
+   ``_Span``, the ring-buffer ``_append``), not the registry internals
+   (``MetricsRegistry`` cells), and not raw ``jax.profiler`` annotations
+   (those belong in profiling.py's ``trace_scope``, which carries the
+   off-fast-path). A bypass would skip the ``_ACTIVE``/enabled gates
+   (overhead when off), the category filter, and the thread-local span
+   stacks (corrupting the tree for every later span on that thread).
 
-2. **Instrumentation must not introduce unaudited blocking syncs.** A
-   span/event ARGUMENT that forces a device value to host
+2. **Instrumentation must not introduce unaudited blocking syncs.** An
+   emission ARGUMENT — a span/event arg, a registry label or value, a
+   flight-note field — that forces a device value to host
    (``np.asarray(...)``, ``.item()``, ``jax.device_get(...)``, or
    ``int()``/``float()`` of a jnp expression) is a hidden ~100 ms round
-   trip through the tunnel that fires exactly when someone turns tracing
-   on — the observer would perturb the observed, and the sync would bypass
-   the audited ledger gate (TL011's contract). Event args must be values
-   the caller already has on host.
+   trip through the tunnel that fires exactly when the observability
+   plane is on — the observer would perturb the observed, and the sync
+   would bypass the audited ledger gate (TL011's contract). Emission args
+   must be values the caller already has on host; the always-on registry
+   makes this non-negotiable (the sync would fire on EVERY query, not
+   just traced ones).
 
 Both are errors; the baseline stays EMPTY — our own instrumentation
 complies, and new emission sites must too.
@@ -37,12 +45,20 @@ from .registry_check import Finding
 OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory", "parallel")
 
 #: names that count as obs emission entry points when bound from the obs
-#: package (rule 2 scans their call arguments)
-_EMIT_NAMES = ("span", "event")
+#: package (rule 2 scans their call arguments): tracer spans/events,
+#: per-query counter events, metrics-registry increments, flight notes
+_EMIT_NAMES = ("span", "event", "dispatch_event", "sync_event",
+               "counter_inc", "gauge_set", "gauge_max",
+               "histogram_observe", "note")
 
-#: tracer internals whose use outside obs/ is a rule-1 finding
-_INTERNAL_NAMES = ("QueryTracer", "_Span", "_NullSpan")
-_INTERNAL_ATTRS = ("_append", "_alloc_span", "_ring")
+#: obs submodules whose attribute calls are emission sites when imported
+#: (``from ..obs import tracer as obs`` / ``metrics`` / ``flight``)
+_OBS_MODULE_NAMES = ("tracer", "metrics", "flight", "obs")
+
+#: tracer/registry internals whose use outside obs/ is a rule-1 finding
+_INTERNAL_NAMES = ("QueryTracer", "_Span", "_NullSpan", "MetricsRegistry")
+_INTERNAL_ATTRS = ("_append", "_alloc_span", "_ring", "_cells",
+                   "_counters", "_gauges", "_hists")
 
 
 def _dotted(node: ast.AST) -> str:
@@ -90,18 +106,19 @@ class _Visitor(ast.NodeVisitor):
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         mod = node.module or ""
         if mod.endswith("obs") or ".obs." in f".{mod}." or \
-                mod.endswith("obs.tracer"):
+                mod.endswith(("obs.tracer", "obs.metrics", "obs.flight")):
             for a in node.names:
                 bound = a.asname or a.name
                 if a.name in _EMIT_NAMES:
                     self.obs_helpers.add(bound)
-                elif a.name in ("tracer",) or a.name == "obs":
+                elif a.name in _OBS_MODULE_NAMES:
                     self.obs_modules.add(bound)
         self.generic_visit(node)
 
     def visit_Import(self, node: ast.Import) -> None:
         for a in node.names:
-            if a.name.endswith(".obs") or a.name.endswith(".obs.tracer"):
+            if a.name.endswith((".obs", ".obs.tracer", ".obs.metrics",
+                                ".obs.flight")):
                 self.obs_modules.add(a.asname or a.name.split(".")[-1])
         self.generic_visit(node)
 

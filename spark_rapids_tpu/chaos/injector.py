@@ -358,8 +358,16 @@ class FaultInjector:
         """Mirror one injection into the query timeline: the event fires
         inside whatever span the injection interrupted (the failing map
         task / operator pull), so the trace shows the fault exactly where
-        it struck — next to the device.retry event that healed it."""
+        it struck — next to the device.retry event that healed it. Every
+        injection also lands in the always-on registry (per-site counter)
+        and the crash flight recorder, so a postmortem bundle shows the
+        fault that preceded the death even when nothing was traced."""
+        from ..obs import flight as _flight
+        from ..obs import metrics as _metrics
         from ..obs import tracer as _obs
+        _metrics.counter_inc("chaos.injections", site=site, kind=kind)
+        _flight.note("chaos.inject", site=site, seq=seq, kind=kind,
+                     detail=detail, forced=forced)
         if _obs._ACTIVE:
             _obs.event("chaos", cat="chaos", site=site, seq=seq, kind=kind,
                        detail=detail, forced=forced)

@@ -321,17 +321,40 @@ split bounds, `pairs` — join pair count, `chars` — string gather sizing,
 
 ## Query timeline tracing
 
-`spark.rapids.tpu.trace.enabled` arms the query-scoped span/event tracer
+`spark.rapids.tpu.trace.enabled` arms the per-query span/event tracer
 (`spark_rapids_tpu/obs/`): one ring-buffered, thread-aware record per query
 tying every operator's time to its dispatches, blocking syncs, HBM
 allocations/spills/semaphore waits, shuffle map/reduce/fetch-retries,
-transient-error retries and chaos injections. Three views export from the
-same record: a Chrome trace (perfetto / `chrome://tracing`),
+transient-error retries and chaos injections. Tracing is CONCURRENT: each
+query gets its own tracer routed by thread-local scopes (up to
+`spark.rapids.tpu.trace.maxConcurrentQueries` at once; a query beyond the
+cap runs untraced and increments the `trace.dropped_queries` registry
+counter — never silently). Three views export from the same record: a
+Chrome trace (perfetto / `chrome://tracing`),
 `session.explain("metrics")` (the executed plan annotated per node with its
 actual metrics, dispatch and sync counts), and the machine-readable
 diagnostics bundle `session.last_query_profile()` whose per-operator counts
-reconcile against `calls_by_kind` and the sync ledger. See
-docs/observability.md for the span model, event taxonomy and bundle schema.
+reconcile against its OWN query's `calls_by_kind` / sync-ledger deltas even
+when other queries run concurrently. See docs/observability.md for the span
+model, event taxonomy and bundle schema.
+
+## Always-on metrics + crash flight recorder
+
+Independent of tracing, the `spark.rapids.tpu.obs.*` surface keeps the
+serving-era aggregate layer always on: a process-wide metrics registry
+(`spark.rapids.tpu.obs.metrics.enabled`, default on — query latency and
+rows/s log2-bucket histograms with p50/p95/p99 readouts, HBM high-water and
+pressure counters, spill bytes, cache hit rates, device-retry/chaos/fetch-
+retry counts; read via `session.metrics_snapshot()` or `python -m
+tools.obs_report`) and a crash flight recorder
+(`spark.rapids.tpu.obs.flightRecorderEvents`) whose ring of recent notable
+events lands — together with a full registry snapshot and HBM/semaphore/
+spill state — in a postmortem bundle under
+`spark.rapids.tpu.obs.postmortemDir` whenever a fatal device error, an
+exhausted transient-retry loop, or a genuine HBM budget OOM kills a query.
+docs/observability.md documents the registry naming scheme and the
+postmortem schema; `python -m tools.bench_diff` gates one bench round
+against the previous one on these numbers.
 
 ## Device parquet decode
 
@@ -980,6 +1003,42 @@ TRACE_DIR = _conf("spark.rapids.tpu.trace.dir").doc(
     "(<query>.profile.json) under this directory; the paths are recorded "
     "in last_query_profile()['artifacts']. bench.py points this at its "
     "artifact directory so each stage ships a loadable trace."
+).string(None)
+
+TRACE_MAX_CONCURRENT = _conf(
+    "spark.rapids.tpu.trace.maxConcurrentQueries").doc(
+    "Capacity cap on simultaneously traced queries (each armed tracer "
+    "owns one ring buffer of bufferEvents records). Tracing is per-query: "
+    "N concurrent sessions each trace their own query with independent "
+    "span trees and reconciliation. A query arriving beyond the cap runs "
+    "untraced and increments the always-on trace.dropped_queries registry "
+    "counter — never a silent drop (docs/observability.md)."
+).integer(16)
+
+OBS_METRICS_ENABLED = _conf("spark.rapids.tpu.obs.metrics.enabled").doc(
+    "The always-on process-wide metrics registry (docs/observability.md "
+    "\"Metrics registry\"): counters, gauges and log2-bucket histograms — "
+    "query latency p50/p95/p99 and rows/s, HBM high-water and pressure "
+    "events, spill bytes, cache hit rates, device-retry and chaos counts. "
+    "Read via session.metrics_snapshot() or `python -m tools.obs_report`. "
+    "The hot path is one dict lookup plus an in-place add; disable only "
+    "to rule the registry out while debugging."
+).boolean(True)
+
+OBS_FLIGHT_EVENTS = _conf("spark.rapids.tpu.obs.flightRecorderEvents").doc(
+    "Ring capacity of the always-on crash flight recorder (notable events "
+    "only: query begin/end, chaos injections, device retries, HBM "
+    "pressure/OOM, disk spills, fetch retries). The last events land in "
+    "the postmortem bundle when a query dies hard."
+).integer(512)
+
+OBS_POSTMORTEM_DIR = _conf("spark.rapids.tpu.obs.postmortemDir").doc(
+    "When set, a fatal device error, an exhausted transient-retry loop, "
+    "or a genuine HBM budget OOM writes a postmortem bundle "
+    "(postmortem-<reason>-<ms>.json) under this directory: the flight "
+    "recorder's last-K events, the full metrics-registry snapshot, "
+    "HBM/semaphore/spill state, the active query names and the failure "
+    "itself (docs/observability.md \"Postmortem bundle\")."
 ).string(None)
 
 TEST_RETRY_OOM_INJECTION = _conf("spark.rapids.memory.tpu.state.debug.retryOomInjection").doc(

@@ -237,8 +237,12 @@ class TpuExec(PhysicalPlan):
         self._last_batch = None  # don't attribute a prior partition's batch
         it = self.internal_do_execute_columnar(idx, ctx)
         # the query tracer (obs) rides the same slow path as xprof tracing:
-        # the untraced hot loop below stays free of per-batch span setup
-        tracing = profiling._PROFILING_ACTIVE or obs._ACTIVE
+        # the untraced hot loop below stays free of per-batch span setup.
+        # thread_traced: tracing is per-query now — a query that is NOT
+        # being traced stays on the fast loop even while a concurrent
+        # session's query is traced on another thread
+        tracing = profiling._PROFILING_ACTIVE or (obs._ACTIVE and
+                                                  obs.thread_traced())
         name = self.node_name()
         if not (tracing or keep_last):
             # hot path: each pull runs under this operator's sync-ledger

@@ -280,12 +280,19 @@ class TpuShuffledHashJoinExec(TpuExec):
         from ..config import SHUFFLE_PIPELINE_ENABLED
         if ctx.conf.get(SHUFFLE_PIPELINE_ENABLED):
             import threading
+            from ..obs import tracer as _obs
             res: dict = {}
+            # per-query tracing routes by thread: the side-collector thread
+            # inherits this query's tracer via the captured handoff token,
+            # so its shuffle reads/uploads/dispatches stay in THIS query's
+            # record (no-op when untraced)
+            obs_parent = _obs.current_span()
 
             def collect_right():
                 try:
-                    res["right"] = self._collect_side(self.children[1], ctx,
-                                                      idx)
+                    with _obs.inherit(obs_parent):
+                        res["right"] = self._collect_side(self.children[1],
+                                                          ctx, idx)
                 except BaseException as e:  # noqa: BLE001 — re-raised below
                     res["err"] = e
 

@@ -98,8 +98,7 @@ def record_external_dispatch(kind: str) -> None:
     with _LOCK:
         _KIND_CALLS[kind] = _KIND_CALLS.get(kind, 0) + 1
     if _obs._ACTIVE:
-        _obs.event("dispatch", cat="dispatch", kind=kind, cache="extern",
-                   source=kind)
+        _obs.dispatch_event(kind, cache="extern", source=kind)
 
 
 def cache_len() -> int:
@@ -178,11 +177,11 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
         with _LOCK:
             _STATS["hits"] += 1
             _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
-        # one timeline event per program dispatch, recorded exactly where
-        # calls_by_kind increments so the two counters reconcile per query
+        # one timeline event + per-query dispatch count per program
+        # dispatch, recorded exactly where calls_by_kind increments so the
+        # counters reconcile per query (even under concurrent queries)
         if _obs._ACTIVE:
-            _obs.event("dispatch", cat="dispatch", kind=key[0],
-                       cache="hit", source="opjit")
+            _obs.dispatch_event(key[0], cache="hit", source="opjit")
         return _dispatch(entry, args, eval_ctx, key[0],
                          donated=bool(donate_argnums))
 
@@ -191,8 +190,7 @@ def _cached_call(key: Tuple, build, args: Tuple, eval_ctx, metrics,
         _STATS["misses"] += 1
         _KIND_CALLS[key[0]] = _KIND_CALLS.get(key[0], 0) + 1
     if _obs._ACTIVE:
-        _obs.event("dispatch", cat="dispatch", kind=key[0],
-                   cache="miss", source="opjit")
+        _obs.dispatch_event(key[0], cache="miss", source="opjit")
     fn = jax.jit(build(), donate_argnums=donate_argnums)
     t0 = time.perf_counter_ns()
     try:

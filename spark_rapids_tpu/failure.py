@@ -128,10 +128,22 @@ def with_device_retry(fn: Callable[[], T], conf=None,
         try:
             return fn()
         except BaseException as exc:  # noqa: BLE001 — classified below
-            if attempt >= attempts_left \
-                    or not is_transient_device_error(exc):
+            transient = is_transient_device_error(exc)
+            if attempt >= attempts_left or not transient:
+                if transient and attempt >= attempts_left:
+                    # exhausted retry: the runtime would not heal — dump a
+                    # postmortem bundle (flight ring + registry snapshot +
+                    # device state) before the error propagates
+                    from .obs import flight as _flight
+                    _flight.note("device.retry_exhausted",
+                                 attempts=attempt,
+                                 error=type(exc).__name__,
+                                 message=str(exc)[:120])
+                    _flight.postmortem("retry_exhausted", exc, conf)
                 raise
             attempt += 1
+            from .obs import flight as _flight
+            from .obs import metrics as _metrics
             from .obs import tracer as _obs
             from .profiling import TaskMetricsRegistry
             if _obs._ACTIVE:
@@ -140,6 +152,9 @@ def with_device_retry(fn: Callable[[], T], conf=None,
                 # timeline shows fault and recovery correlated in place
                 _obs.event("device.retry", cat="retry", attempt=attempt,
                            error=type(exc).__name__, message=str(exc)[:120])
+            _metrics.counter_inc("device.retries")
+            _flight.note("device.retry", attempt=attempt,
+                         error=type(exc).__name__, message=str(exc)[:120])
             reg = TaskMetricsRegistry.get()
             reg.add("deviceRetryCount", 1)
             delay = min(cap, base * (2 ** (attempt - 1))) / 1000.0
@@ -193,8 +208,35 @@ def handle_task_failure(exc: BaseException, conf,
     """Executor failure hook (reference RapidsExecutorPlugin.onTaskFailed).
     Returns the diagnostic path when a fatal error was captured."""
     from .config import CORE_DUMP_DIR
+    # a GENUINE HBM budget exhaustion (marked at the raise site in
+    # memory/hbm.py; chaos-injected retry-OOMs lack the marker) that
+    # reached the task-failure hook was NOT healed by the retry framework
+    # — this, not the raise site, is where the query actually dies, so
+    # dump the hbm_oom postmortem here (no false incidents for healed OOMs)
+    from .memory.hbm import TpuOOM
+    cur: Optional[BaseException] = exc
+    seen: set = set()
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, TpuOOM) and getattr(cur, "budget_exhausted",
+                                               False):
+            from .obs import flight as _flight
+            _flight.note("hbm.oom_unhealed", error=str(cur)[:200])
+            _flight.postmortem("hbm_oom", exc, conf)
+            break
+        cur = cur.__cause__ or cur.__context__
     if not is_fatal_device_error(exc):
         return None
+    # crash flight recorder (docs/observability.md): the fatal error and
+    # its postmortem bundle — last-K flight events, registry snapshot,
+    # HBM/semaphore/spill state, active query names — land under
+    # spark.rapids.tpu.obs.postmortemDir before any exit
+    from .obs import flight as _flight
+    from .obs import metrics as _metrics
+    _metrics.counter_inc("device.fatal_errors")
+    _flight.note("device.fatal", error=type(exc).__name__,
+                 message=str(exc)[:200])
+    _flight.postmortem("fatal_device_error", exc, conf)
     dump_dir = conf.get(CORE_DUMP_DIR)
     path = None
     if dump_dir:

@@ -88,6 +88,7 @@ class HbmBudget:
     # --- allocation --------------------------------------------------------
     def allocate(self, nbytes: int) -> None:
         from ..chaos import inject
+        from ..obs import metrics as _metrics
         from ..obs import tracer as _obs
         with self._alloc_lock:
             self.alloc_count += 1
@@ -101,20 +102,35 @@ class HbmBudget:
                 if self._spill_callback is not None:
                     freed = self._spill_callback(
                         self.used + nbytes - self.budget)
+                # allocation under pressure: the spill-or-synchronize
+                # loop is where HBM waits hide — counted in the always-on
+                # registry (pressure is rare by construction)
+                _metrics.counter_inc("hbm.pressure_events")
                 if _obs._ACTIVE:
-                    # allocation under pressure: the spill-or-synchronize
-                    # loop is where HBM waits hide
                     _obs.event("hbm.pressure", cat="memory", bytes=nbytes,
                                used=self.used, freed=freed)
                 if freed <= 0:
                     retries += 1
                     if retries > self.oom_max_retries:
-                        raise TpuRetryOOM(
+                        from ..obs import flight as _flight
+                        _metrics.counter_inc("hbm.oom_events")
+                        exc = TpuRetryOOM(
                             f"HBM budget exhausted: used={self.used} "
                             f"request={nbytes} budget={self.budget}")
+                        # marks this as a REAL budget exhaustion (vs the
+                        # chaos-injected healable TpuRetryOOM). No
+                        # postmortem HERE: the retry framework above may
+                        # still heal this by spilling/splitting — the dump
+                        # happens in failure.handle_task_failure, reached
+                        # only when the OOM actually kills the query
+                        exc.budget_exhausted = True
+                        _flight.note("hbm.oom", used=self.used,
+                                     request=nbytes, budget=self.budget)
+                        raise exc
                     TpuDeviceManager.synchronize()
             self.used += nbytes
             self.peak_used = max(self.peak_used, self.used)
+            _metrics.gauge_max("hbm.high_water_bytes", self.peak_used)
 
     def free(self, nbytes: int) -> None:
         with self._alloc_lock:

@@ -60,9 +60,12 @@ class TpuSemaphore:
         t0 = time.perf_counter_ns()
         self._sem.acquire()
         waited = time.perf_counter_ns() - t0
+        from ..obs import metrics as _metrics
         from ..obs import tracer as _obs
         from ..profiling import TaskMetricsRegistry
         TaskMetricsRegistry.get().add("semaphoreWaitNs", waited)
+        _metrics.counter_inc("semaphore.waits")
+        _metrics.counter_inc("semaphore.wait_ns", waited)
         if _obs._ACTIVE:
             _obs.event("semaphore.wait", cat="memory", wait_ns=waited)
         with self._state_lock:

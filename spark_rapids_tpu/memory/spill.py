@@ -125,6 +125,8 @@ class TpuBufferCatalog:
         self._unspill_inner(e, pa)
         dt = _time.perf_counter_ns() - t0
         TaskMetricsRegistry.get().add("readSpillTimeNs", dt)
+        from ..obs import metrics as _metrics
+        _metrics.counter_inc("spill.read_bytes", e.nbytes)
         if _obs._ACTIVE:
             _obs.event("spill.read", cat="memory", bytes=e.nbytes,
                        wait_ns=dt)
@@ -177,6 +179,8 @@ class TpuBufferCatalog:
         from ..obs import tracer as _obs
         inject("spill.to_host")  # before any state mutation: a raised fault
         # must leave the entry intact on its current tier
+        from ..obs import metrics as _metrics
+        _metrics.counter_inc("spill.to_host_bytes", e.nbytes)
         if _obs._ACTIVE:
             _obs.event("spill.to_host", cat="memory", bytes=e.nbytes)
         e.host_table = e.batch.to_arrow()
@@ -204,7 +208,12 @@ class TpuBufferCatalog:
                 from ..chaos import corrupt_bytes, inject
                 from ..shuffle.serializer import xxhash64_bytes
                 inject("spill.to_disk")  # pre-mutation, like spill.to_host
+                from ..obs import flight as _flight
+                from ..obs import metrics as _metrics
                 from ..obs import tracer as _obs
+                _metrics.counter_inc("spill.to_disk_bytes", e.nbytes)
+                # disk spill is rare and a pressure signal: flight-note it
+                _flight.note("spill.to_disk", bytes=e.nbytes)
                 if _obs._ACTIVE:
                     _obs.event("spill.to_disk", cat="memory",
                                bytes=e.nbytes)

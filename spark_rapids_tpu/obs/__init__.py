@@ -1,29 +1,45 @@
-"""obs: the query-scoped observability layer (docs/observability.md).
+"""obs: the production observability plane (docs/observability.md).
 
-One correlated record per query over dispatch, sync, memory, shuffle,
-retry and chaos — a ring-buffered, thread-aware span/event tracer
-(:mod:`.tracer`, near-zero-cost when ``spark.rapids.tpu.trace.enabled`` is
-off) with three exports from the same record (:mod:`.export`):
+Three connected layers over dispatch, sync, memory, shuffle, retry and
+chaos:
 
-* Chrome trace-event JSON (perfetto / ``chrome://tracing``),
-* ``session.explain("metrics")`` — the executed plan annotated per node
+* **Concurrent per-query tracing** (:mod:`.tracer`, near-zero-cost when
+  ``spark.rapids.tpu.trace.enabled`` is off): each query gets its own
+  ring-buffered, thread-aware span/event tracer routed by thread-local
+  scopes — N sessions trace N queries simultaneously — with three exports
+  from the same record (:mod:`.export`): Chrome trace-event JSON
+  (perfetto / ``chrome://tracing``), ``session.explain("metrics")``
   (:mod:`.explain`; works with tracing off, from the session snapshots),
-* the machine-readable diagnostics bundle
-  (``session.last_query_profile()``), whose per-operator dispatch+sync
-  counts reconcile against opjit ``calls_by_kind`` and the SyncLedger.
+  and the diagnostics bundle (``session.last_query_profile()``) whose
+  per-operator dispatch+sync counts reconcile against its OWN query's
+  ``calls_by_kind``/SyncLedger deltas.
+* **Always-on metrics registry** (:mod:`.metrics`): process-wide
+  counters, gauges and log2-bucket histograms (query latency p50/p95/p99,
+  rows/s, HBM high-water, spill bytes, cache hit rates, retry/chaos
+  counts) — ``session.metrics_snapshot()`` / ``python -m
+  tools.obs_report``.
+* **Crash flight recorder** (:mod:`.flight`): a small always-on ring of
+  notable events that dumps a postmortem bundle (last-K events, registry
+  snapshot, HBM/semaphore/spill state, active queries) under
+  ``spark.rapids.tpu.obs.postmortemDir`` on a fatal device error, an
+  exhausted retry, or an HBM OOM.
 
-Instrumentation sites in execs//shuffle//memory/ must emit through this
-package's :func:`span` / :func:`event` helpers (tracelint rule TL012) and
-must never put a blocking device→host sync in a span/event argument.
+Instrumentation sites in execs//shuffle//memory//parallel/ must emit
+through this package's :func:`span` / :func:`event` / metric helpers
+(tracelint rule TL012) and must never put a blocking device→host sync in
+an emission argument.
 """
 
 from .explain import render_explain_metrics
 from .export import build_bundle, chrome_trace, span_tree, write_artifacts
-from .tracer import (QueryTracer, begin_query, current_span, end_query,
-                     event, is_active, span)
+from .tracer import (QueryTracer, SpanRef, begin_query, current_span,
+                     end_query, event, inherit, is_active, span,
+                     thread_traced)
+from . import flight, metrics
 
 __all__ = [
-    "QueryTracer", "begin_query", "build_bundle", "chrome_trace",
-    "current_span", "end_query", "event", "is_active",
-    "render_explain_metrics", "span", "span_tree", "write_artifacts",
+    "QueryTracer", "SpanRef", "begin_query", "build_bundle", "chrome_trace",
+    "current_span", "end_query", "event", "flight", "inherit", "is_active",
+    "metrics", "render_explain_metrics", "span", "span_tree",
+    "thread_traced", "write_artifacts",
 ]

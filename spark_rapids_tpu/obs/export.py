@@ -181,7 +181,12 @@ def build_bundle(profile: Dict[str, Any],
     by_op, disp_by_kind, sync_total, by_cat, chaos, retries = \
         _counts(profile)
     dropped = int(profile.get("dropped", 0))
-    reconcile: Dict[str, Any] = {"overflow": dropped > 0}
+    # exclusive: no other query (traced or not) overlapped this one, so
+    # process-wide counter deltas were attributable; when False the caller
+    # passed the tracer's own per-query counters instead (obs/tracer.py)
+    reconcile: Dict[str, Any] = {
+        "overflow": dropped > 0,
+        "exclusive": bool(profile.get("exclusive", True))}
     if dispatch_delta is not None:
         want = {k: v for k, v in dispatch_delta.items() if v}
         reconcile["dispatch_ok"] = dropped > 0 or disp_by_kind == want

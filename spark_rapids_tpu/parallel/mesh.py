@@ -136,6 +136,12 @@ def _record_launch(rows: int, stage_ns: int, launch_ns: int,
         _STATS["stage_ns"] += stage_ns
         _STATS["launch_ns"] += launch_ns
         _STATS["wait_ns"] += wait_ns
+    # always-on registry (docs/observability.md): the collective's blocking
+    # wait is the fabric's user-visible latency — histogram it per launch
+    # (rare: one per exchange) so a serving dashboard sees the tail;
+    # the running totals above fold into metrics_snapshot() as-is
+    from ..obs import metrics as _metrics
+    _metrics.histogram_observe("mesh.collective_wait_ms", wait_ns / 1e6)
 
 
 class MeshExchangeResult(NamedTuple):

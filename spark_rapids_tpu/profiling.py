@@ -108,11 +108,13 @@ class SyncLedger:
             ops[kind] = ops.get(kind, 0) + 1
             self._total += 1
         # piggyback the query tracer (obs): one instant event per blocking
-        # sync, attributed with the SAME operator scope the ledger used, so
-        # the diagnostics bundle reconciles with the ledger exactly
+        # sync PLUS the bound tracer's per-query sync counter, attributed
+        # with the SAME operator scope the ledger used — the diagnostics
+        # bundle reconciles against its own query's deltas even when other
+        # queries run concurrently (the process-wide ledger cross-bleeds)
         from .obs import tracer as _obs
         if _obs._ACTIVE:
-            _obs.event("sync", cat="sync", op=op, kind=kind)
+            _obs.sync_event(op, kind)
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         with self._mu:

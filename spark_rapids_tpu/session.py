@@ -982,6 +982,14 @@ class TpuSession:
         # fault injector from spark.rapids.tpu.test.chaos.* when mentioned
         from .chaos import FaultInjector
         FaultInjector.maybe_configure(rc)
+        # observability plane (docs/observability.md): apply the always-on
+        # metrics-registry switch and arm the crash flight recorder's
+        # postmortem dir / ring size (same arm-once pattern as chaos)
+        from .config import OBS_METRICS_ENABLED
+        from .obs import flight as _flight
+        from .obs import metrics as _obs_metrics
+        _obs_metrics.set_enabled(rc.get(OBS_METRICS_ENABLED))
+        _flight.maybe_configure(rc)
         self._pool: Optional[_fut.ThreadPoolExecutor] = None
 
     # conf API
@@ -1078,25 +1086,37 @@ class TpuSession:
         # launch — the same batched dispatch the exchange map side uses
         n_parts = final.num_partitions()
         group_pull = n_parts > 1 and mesh_session_active(conf) is not None
+        from .config import TRACE_TAG
+        self._query_seq = getattr(self, "_query_seq", 0) + 1
+        tag = conf.get(TRACE_TAG)
+        stem = tag if tag and str(tag) != "None" else "query"
+        qname = f"{stem}-{self._query_seq}"
+        # always-on metrics registry (docs/observability.md): EVERY query
+        # (traced or not) registers its lifecycle — the queries.active
+        # gauge/list, the latency + rows/s histograms, and the epoch the
+        # tracer's exclusivity check reads
+        qtok = obs.metrics.query_begin(qname, session=stem)
         qroot = None
         opjit_before = None
-        if conf.get(TRACE_ENABLED):
-            from .config import TRACE_TAG
-            from .execs import opjit
-            self._query_seq = getattr(self, "_query_seq", 0) + 1
-            tag = conf.get(TRACE_TAG)
-            stem = tag if tag and str(tag) != "None" else "query"
-            # snapshot BEFORE arming (nothing dispatches in between), so
-            # begin_query is the last raise-capable step before the
-            # try/finally that guarantees end_query: an exception here
-            # must never strand the process-wide tracer armed (TL020)
-            opjit_before = opjit.cache_stats()["calls_by_kind"]
-            qroot = obs.begin_query(
-                f"{stem}-{self._query_seq}",
-                buffer_events=conf.get(TRACE_BUFFER_EVENTS),
-                categories=conf.get(TRACE_CATEGORIES))
         tables = []
+        failed = True  # cleared by the last statement of the try body
         try:
+            if conf.get(TRACE_ENABLED):
+                from .config import TRACE_MAX_CONCURRENT
+                from .execs import opjit
+                # arm FIRST inside the try whose finally guarantees
+                # end_query (TL020: an exception can never strand a tracer
+                # armed) and query_end. The snapshot BEFORE arming (nothing
+                # dispatches in between) is only trusted when the query ran
+                # EXCLUSIVELY — a concurrent query's bundle reconciles
+                # against the tracer's own per-query counters instead (no
+                # cross-query bleed).
+                opjit_before = opjit.cache_stats()["calls_by_kind"]
+                qroot = obs.begin_query(
+                    qname,
+                    buffer_events=conf.get(TRACE_BUFFER_EVENTS),
+                    categories=conf.get(TRACE_CATEGORIES),
+                    max_concurrent=conf.get(TRACE_MAX_CONCURRENT))
             if group_pull:
                 ids = list(range(n_parts))
                 ctxs = {}
@@ -1144,6 +1164,7 @@ class TpuSession:
                         raise
                     finally:
                         ctx.complete()
+            failed = False  # reached only when every partition completed
         finally:
             # snapshot metrics into plain dicts so the plan (and any device
             # buffers it references) is not pinned past the query
@@ -1178,6 +1199,9 @@ class TpuSession:
             for node in final.collect_nodes():
                 if hasattr(node, "cleanup_shuffle"):
                     node.cleanup_shuffle(conf)
+            obs.metrics.query_end(
+                qtok, rows=sum(t.num_rows for t in tables),
+                failed=failed, session=stem)
         if not tables:
             return schema.empty_table()
         return pa.concat_tables(tables).cast(schema)
@@ -1194,10 +1218,24 @@ class TpuSession:
         from .config import TRACE_DIR
         from .execs import opjit
         profile = obs.end_query(qroot)
-        disp_after = opjit.cache_stats()["calls_by_kind"]
-        disp_delta = {
-            k: disp_after.get(k, 0) - (opjit_before or {}).get(k, 0)
-            for k in set(disp_after) | set(opjit_before or {})}
+        if profile.get("exclusive", True):
+            # no other query overlapped: the process-wide counter deltas
+            # are attributable to this query — the strongest ground truth
+            # (incremented by code paths independent of the tracer)
+            disp_after = opjit.cache_stats()["calls_by_kind"]
+            disp_delta = {
+                k: disp_after.get(k, 0) - (opjit_before or {}).get(k, 0)
+                for k in set(disp_after) | set(opjit_before or {})}
+        else:
+            # concurrent queries: process-wide deltas cross-bleed, so the
+            # bundle reconciles against THIS query's own counters — kept
+            # by the tracer at exactly the sites where calls_by_kind and
+            # the SyncLedger increment, routed by the thread binding
+            disp_delta = {k: v for k, v in
+                          profile.get("dispatch_counts", {}).items() if v}
+            self._last_sync_ledger = {
+                op: dict(kinds)
+                for op, kinds in profile.get("sync_counts", {}).items()}
         bundle = obs.build_bundle(
             profile,
             plan_tree=self._last_plan_tree,
@@ -1250,6 +1288,19 @@ class TpuSession:
         paths of the written Chrome trace and bundle JSON under
         ['artifacts']. None when the last query ran untraced."""
         return getattr(self, "_last_query_profile", None)
+
+    def metrics_snapshot(self):
+        """The always-on process-wide metrics registry readout
+        (docs/observability.md "Metrics registry"): counters, gauges and
+        log2-bucket histograms — query latency p50/p95/p99 and rows/s per
+        session, active queries, HBM high-water/pressure, spill bytes,
+        retry and chaos counts — plus the engine's other process-wide
+        counters folded in at read time (opjit cache stats incl. hit
+        rate, mesh collective_stats, SyncLedger totals, task metrics,
+        shuffle bytes, HBM state). Same payload as
+        ``python -m tools.obs_report``. Needs no tracing."""
+        from .obs import metrics as _metrics
+        return _metrics.full_snapshot()
 
     def explain(self, mode: str = "metrics", level: Optional[str] = None
                 ) -> str:

@@ -17,6 +17,7 @@ import numpy as np
 from ..columnar.batch import TpuColumnarBatch
 from ..config import SHUFFLE_PARTITIONS
 from ..expressions.base import AttributeReference, Expression
+from ..obs import flight, metrics
 from ..obs import tracer as obs
 from .manager import TpuShuffleManager
 from .partitioner import (hash_partition_ids, hash_split_parts,
@@ -313,6 +314,10 @@ class _ExchangeBase:
                         yield t
             except FetchFailedError as ff:
                 failures += 1
+                metrics.counter_inc("shuffle.fetch_retries")
+                flight.note("shuffle.fetchRetry", shuffle=self._shuffle_id,
+                            reduce=idx, maps=list(ff.map_ids),
+                            attempt=failures)
                 if obs._ACTIVE:
                     obs.event("shuffle.fetchRetry", cat="shuffle",
                               shuffle=self._shuffle_id, reduce=idx,
@@ -357,6 +362,10 @@ class _ExchangeBase:
                 return with_device_retry(fetch, ctx.conf)
             except FetchFailedError as ff:
                 failures += 1
+                metrics.counter_inc("shuffle.fetch_retries")
+                flight.note("shuffle.fetchRetry", shuffle=self._shuffle_id,
+                            reduce=idx, maps=list(ff.map_ids),
+                            attempt=failures)
                 if obs._ACTIVE:
                     obs.event("shuffle.fetchRetry", cat="shuffle",
                               shuffle=self._shuffle_id, reduce=idx,

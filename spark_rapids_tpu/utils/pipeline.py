@@ -36,6 +36,12 @@ def prefetch_iterator(it: Iterator[T], depth: int) -> Iterator[T]:
         return
     q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
     stop = threading.Event()
+    # the producer runs `it`'s frames on the worker thread: inherit the
+    # consumer's query tracer (per-query tracing routes by thread — an
+    # unbound worker's spans/syncs would vanish from the owning query's
+    # record and break bundle reconciliation); a no-op when untraced
+    from ..obs import tracer as _obs
+    obs_parent = _obs.current_span()
 
     def _put(item) -> bool:
         while not stop.is_set():
@@ -48,9 +54,10 @@ def prefetch_iterator(it: Iterator[T], depth: int) -> Iterator[T]:
 
     def work() -> None:
         try:
-            for item in it:
-                if not _put(item):
-                    return
+            with _obs.inherit(obs_parent):
+                for item in it:
+                    if not _put(item):
+                        return
         except BaseException as e:  # noqa: BLE001 — delivered to consumer
             _put(_Err(e))
             return

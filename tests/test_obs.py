@@ -314,12 +314,19 @@ def test_ring_overflow_reported_not_lied_about():
     assert bundle["reconcile"]["overflow"]
 
 
-def test_second_concurrent_query_runs_untraced():
-    """One query owns the tracer at a time: a nested begin gets None and
-    the owner's record stays intact."""
+def test_nested_begin_on_same_thread_drops_counted_not_silent():
+    """Tracing is per-query now (concurrent queries each trace —
+    tests/test_obs_serving.py); the one remaining drop case is a NESTED
+    begin on a thread already tracing a query, and it is counted in the
+    trace.dropped_queries registry counter instead of being silent."""
+    from spark_rapids_tpu.obs import metrics as obs_metrics
+    obs_metrics.MetricsRegistry.reset_for_tests()
     root = obs_tracer.begin_query("owner")
     assert root is not None
-    assert obs_tracer.begin_query("intruder") is None
+    assert obs_tracer.begin_query("nested-on-same-thread") is None
+    snap = obs_metrics.MetricsRegistry.get().snapshot()
+    assert snap["counters"]["trace.dropped_queries"] == \
+        {"reason=nested_thread": 1}
     with obs_tracer.span("op", cat="op"):
         obs_tracer.event("sync", cat="sync", kind="rows")
     profile = obs_tracer.end_query(root)
@@ -327,6 +334,7 @@ def test_second_concurrent_query_runs_untraced():
     assert not obs_tracer.is_active()
     tree = obs.span_tree(profile)
     assert tree["children"] and tree["children"][0]["name"] == "op"
+    obs_metrics.MetricsRegistry.reset_for_tests()
 
 
 def test_explicit_parent_nests_worker_thread_span():

@@ -256,6 +256,45 @@ def test_tl012_bypassing_obs_api_true_positive_and_near_miss():
     assert lint_obs_module(nm, "execs/x.py") == []
 
 
+def test_tl012_metrics_and_flight_emission_true_positive_and_near_miss():
+    """TL012 extension (ISSUE 12): registry increments and flight notes
+    are emission sites too — a blocking D→H sync in a label/value/field
+    argument fires (the always-on registry would pay it on EVERY query),
+    and registry internals are off-limits outside obs/."""
+    from spark_rapids_tpu.analysis import lint_obs_module
+    tp = textwrap.dedent("""\
+        import jax.numpy as jnp
+        import numpy as np
+        from ..obs import flight, metrics
+        def f(col):
+            metrics.counter_inc("spill.bytes", int(jnp.sum(col.nbytes)))
+        def g(col):
+            metrics.histogram_observe("rows", col.count.item())
+        def h(col):
+            flight.note("oom", used=int(np.asarray(col.used)[0]))
+        def k(reg):
+            from ..obs.metrics import MetricsRegistry
+            MetricsRegistry.get()._counters["x"] = {}
+        """)
+    findings = lint_obs_module(tp, "memory/x.py")
+    locs = sorted({f.location for f in findings})
+    assert locs == ["memory/x.py::f", "memory/x.py::g", "memory/x.py::h",
+                    "memory/x.py::k"], [f.render() for f in findings]
+    assert all(f.rule == "TL012" and f.severity == "error"
+               for f in findings)
+    nm = textwrap.dedent("""\
+        from ..obs import flight
+        from ..obs.metrics import counter_inc, gauge_max, histogram_observe
+        def f(nbytes, peak):
+            counter_inc("spill.bytes", nbytes)
+            gauge_max("hbm.high_water_bytes", peak)
+            histogram_observe("wait_ns", 123, site="exchange")
+        def g(used):
+            flight.note("hbm.oom", used=used)
+        """)
+    assert lint_obs_module(nm, "memory/x.py") == []
+
+
 def test_tl012_real_tree_emission_clean():
     """The shipped execs//shuffle//memory/ instrumentation routes through
     the obs API with no blocking syncs in event args — the TL012 baseline

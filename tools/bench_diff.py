@@ -1,0 +1,156 @@
+"""bench_diff: compare two bench rounds and flag throughput regressions.
+
+Usage:
+    python -m tools.bench_diff BENCH_r05.json BENCH_r06.json
+    python -m tools.bench_diff MULTICHIP_r05.json MULTICHIP_r06.json \\
+        --threshold 0.10
+
+Both ``BENCH_r0N.json`` (bench.py's driver record: the final compact
+summary line under ``parsed``) and ``MULTICHIP_r0N.json``
+(``parsed.queries.<q>`` per-query records) are understood; the tool walks
+the parsed payload collecting every throughput-shaped metric
+(``*rows_per_s`` / ``*rows_s`` / ``*Mrows_s`` / ``*speedup*`` /
+``*scaling_efficiency`` / ``*hit_rate`` — higher is better; with
+``--include-overhead`` also ``dispatch_overhead_ms`` — lower is better)
+and compares NEW against OLD per key. A metric that degraded beyond
+``--threshold`` (default 10%) is a REGRESSION; any regression exits
+non-zero, so a driver round gates automatically against the previous one:
+
+    python -m tools.bench_diff MULTICHIP_r05.json MULTICHIP_r06.json \\
+        || echo "throughput regressed — investigate before landing r06"
+
+Keys present in only one round (new stages, skipped stages) are reported
+but never fail the diff; a round whose ``parsed`` payload is null (the
+bench crashed before its summary line) exits 2 with a clear message.
+Workflow: docs/observability.md "Comparing bench rounds".
+"""
+
+import argparse
+import json
+import re
+import sys
+
+#: throughput-shaped keys: HIGHER is better
+_HIGHER_RE = re.compile(
+    r"(rows_per_s|rows_s|Mrows_s|speedup|scaling_efficiency|hit_rate)$")
+#: overhead keys (opt-in): LOWER is better
+_LOWER_RE = re.compile(r"(dispatch_overhead_ms|collective_ms(_total)?)$")
+
+
+def _walk(obj, prefix=""):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _walk(v, f"{prefix}.{k}" if prefix else str(k))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        yield prefix, float(obj)
+
+
+def extract_metrics(parsed, include_overhead=False):
+    """{dotted_key: (value, higher_is_better)} for every comparable
+    throughput metric in a parsed bench payload."""
+    out = {}
+    for path, v in _walk(parsed):
+        if _HIGHER_RE.search(path):
+            out[path] = (v, True)
+        elif include_overhead and _LOWER_RE.search(path):
+            out[path] = (v, False)
+    return out
+
+
+def load_parsed(path):
+    with open(path) as f:
+        doc = json.load(f)
+    # driver records wrap the summary under "parsed"; accept a bare
+    # summary object too (e.g. a locally captured final line)
+    parsed = doc.get("parsed", doc) if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        raise ValueError(
+            f"{path}: no parsed bench payload (the round's final summary "
+            f"line was not captured — 'parsed' is null)")
+    return parsed
+
+
+def diff(old, new, threshold, include_overhead=False):
+    """Compare two parsed payloads; returns (regressions, improvements,
+    unchanged, only_old, only_new) where each entry is
+    (key, old_value, new_value, ratio)."""
+    om = extract_metrics(old, include_overhead)
+    nm = extract_metrics(new, include_overhead)
+    regressions, improvements, unchanged = [], [], []
+    for key in sorted(set(om) & set(nm)):
+        ov, higher = om[key]
+        nv, _ = nm[key]
+        if ov == 0 or nv == 0:
+            # a zero endpoint has no meaningful ratio, but the DIRECTION
+            # still gates: overhead appearing from zero (or throughput
+            # collapsing to zero) is a regression, not "unchanged"
+            if ov == nv:
+                unchanged.append((key, ov, nv, None))
+            elif (nv > ov) == higher:
+                improvements.append((key, ov, nv, None))
+            else:
+                regressions.append((key, ov, nv, None))
+            continue
+        ratio = nv / ov
+        # normalize so >1 always means "better"
+        better = ratio if higher else 1.0 / ratio
+        if better < 1.0 - threshold:
+            regressions.append((key, ov, nv, ratio))
+        elif better > 1.0 + threshold:
+            improvements.append((key, ov, nv, ratio))
+        else:
+            unchanged.append((key, ov, nv, ratio))
+    only_old = sorted(set(om) - set(nm))
+    only_new = sorted(set(nm) - set(om))
+    return regressions, improvements, unchanged, only_old, only_new
+
+
+def _fmt(rows, label):
+    lines = [f"## {label} ({len(rows)})"]
+    for key, ov, nv, ratio in rows:
+        r = f" ({ratio:.2f}x)" if ratio is not None else ""
+        lines.append(f"  {key}: {ov:g} -> {nv:g}{r}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_diff", description=__doc__)
+    ap.add_argument("old", help="previous round (BENCH_*.json / "
+                                "MULTICHIP_*.json)")
+    ap.add_argument("new", help="new round to gate")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative degradation that counts as a "
+                         "regression (default 0.10 = 10%%)")
+    ap.add_argument("--include-overhead", action="store_true",
+                    help="also gate lower-is-better overhead metrics "
+                         "(dispatch_overhead_ms, collective_ms)")
+    args = ap.parse_args(argv)
+    try:
+        old = load_parsed(args.old)
+        new = load_parsed(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    regressions, improvements, unchanged, only_old, only_new = diff(
+        old, new, args.threshold, args.include_overhead)
+    print(f"# bench_diff {args.old} -> {args.new} "
+          f"(threshold {args.threshold:.0%})")
+    if regressions:
+        print("\n".join(_fmt(regressions, "REGRESSIONS")))
+    if improvements:
+        print("\n".join(_fmt(improvements, "improvements")))
+    print(f"## within threshold: {len(unchanged)}")
+    if only_old:
+        print(f"## only in {args.old}: {only_old}")
+    if only_new:
+        print(f"## only in {args.new}: {only_new}")
+    if regressions:
+        print(f"bench_diff: {len(regressions)} regression(s) beyond "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print("bench_diff: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

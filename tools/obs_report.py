@@ -1,0 +1,198 @@
+"""obs_report: read out the always-on metrics registry, or self-check the
+observability plane.
+
+Usage:
+    python -m tools.obs_report                # human-readable snapshot
+    python -m tools.obs_report --json         # raw JSON (dashboards/diffing)
+    python -m tools.obs_report --self-check   # exercise registry + flight
+                                              # recorder + concurrent tracer
+                                              # wiring; exit non-zero on any
+                                              # broken invariant (CI fast tier)
+
+The snapshot is ``spark_rapids_tpu.obs.metrics.full_snapshot()`` — the same
+payload ``session.metrics_snapshot()`` serves: registry counters/gauges/
+histograms (with p50/p95/p99 readouts) plus the engine's other process-wide
+counters folded in (opjit cache stats, mesh collective_stats, SyncLedger,
+task metrics, chaos, shuffle, HBM). Schema: docs/observability.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _render(snap: dict) -> str:
+    lines = ["# spark-rapids-tpu metrics snapshot", ""]
+    q = snap.get("queries", {})
+    lines.append(f"active queries: {len(q.get('active', []))} "
+                 f"{q.get('active', [])} (epoch {q.get('epoch')})")
+    for section in ("counters", "gauges"):
+        vals = snap.get(section, {})
+        if vals:
+            lines += ["", f"## {section}"]
+            for name in sorted(vals):
+                for labels, v in sorted(vals[name].items()):
+                    tag = f"{{{labels}}}" if labels else ""
+                    lines.append(f"  {name}{tag} = {v}")
+    hists = snap.get("histograms", {})
+    if hists:
+        lines += ["", "## histograms (log2 buckets)"]
+        for name in sorted(hists):
+            for labels, h in sorted(hists[name].items()):
+                tag = f"{{{labels}}}" if labels else ""
+                lines.append(
+                    f"  {name}{tag}: count={h['count']} sum={h['sum']:.1f} "
+                    f"p50={h['p50']:.0f} p95={h['p95']:.0f} "
+                    f"p99={h['p99']:.0f}")
+    ext = snap.get("external", {})
+    if ext:
+        lines += ["", "## folded process-wide counters"]
+        for k in sorted(ext):
+            lines.append(f"  {k}: {json.dumps(ext[k], default=str)}")
+    return "\n".join(lines)
+
+
+def _self_check() -> int:
+    """Exercise the plane end-to-end in-process; print PASS/FAIL lines and
+    return a process exit code. Deliberately cheap (no session, no device
+    work) so the CI fast tier can run it on every commit."""
+    from spark_rapids_tpu.obs import flight, metrics
+    from spark_rapids_tpu.obs import tracer as obs_tracer
+
+    failures = []
+
+    def check(name, cond, detail=""):
+        print(f"  {'PASS' if cond else 'FAIL'}: {name}"
+              + (f" ({detail})" if detail and not cond else ""))
+        if not cond:
+            failures.append(name)
+
+    metrics.MetricsRegistry.reset_for_tests()
+    metrics.reset_query_state_for_tests()
+    flight.reset_for_tests()
+    obs_tracer.QueryTracer.reset_for_tests()
+
+    # registry: counter/gauge/histogram round trip with known quantiles
+    metrics.counter_inc("selfcheck.counter", 3, site="a")
+    metrics.counter_inc("selfcheck.counter", 2, site="a")
+    metrics.gauge_max("selfcheck.gauge", 7)
+    metrics.gauge_max("selfcheck.gauge", 5)
+    for v in (1, 2, 4, 100, 1000):
+        metrics.histogram_observe("selfcheck.hist", v)
+    snap = metrics.MetricsRegistry.get().snapshot()
+    check("counter accumulates per label set",
+          snap["counters"].get("selfcheck.counter", {}).get("site=a") == 5,
+          str(snap["counters"]))
+    check("gauge_max keeps the high-water",
+          snap["gauges"].get("selfcheck.gauge", {}).get("") == 7)
+    h = snap["histograms"].get("selfcheck.hist", {}).get("", {})
+    check("histogram count/sum", h.get("count") == 5
+          and abs(h.get("sum", 0) - 1107) < 1e-9)
+    check("histogram p50 within a factor of two of the median",
+          2 <= h.get("p50", 0) <= 8, str(h))
+    check("histogram p99 reaches the top observation's bucket",
+          h.get("p99", 0) >= 1000, str(h))
+
+    # query lifecycle feeds the latency histogram + active gauge
+    tok = metrics.query_begin("selfcheck-q")
+    check("active query listed",
+          "selfcheck-q" in metrics.active_queries())
+    metrics.query_end(tok, rows=1000)
+    snap = metrics.MetricsRegistry.get().snapshot()
+    lat = snap["histograms"].get("query.latency_ms", {})
+    check("query latency histogram populated",
+          any(c.get("count") for c in lat.values()), str(lat))
+
+    # concurrent tracing: two tracers on two threads, zero silent drops
+    import threading
+    results = {}
+
+    def trace_one(key):
+        tr = obs_tracer.begin_query(f"selfcheck-{key}")
+        results[key] = tr
+        if tr is not None:
+            with obs_tracer.span("op", cat="op"):
+                # the path profiling.SyncLedger.record takes: ring event
+                # plus the tracer's per-query sync counter
+                obs_tracer.sync_event("X", "rows")
+            results[f"{key}-profile"] = obs_tracer.end_query(tr)
+
+    t = threading.Thread(target=trace_one, args=("bg",))
+    tr_fg = obs_tracer.begin_query("selfcheck-fg")
+    t.start()
+    t.join()
+    check("two queries trace concurrently",
+          tr_fg is not None and results.get("bg") is not None)
+    prof_bg = results.get("bg-profile") or {}
+    check("concurrent tracer records its own events",
+          prof_bg.get("sync_counts", {}).get("X", {}).get("rows") == 1,
+          str(prof_bg.get("sync_counts")))
+    if tr_fg is not None:
+        obs_tracer.end_query(tr_fg)
+
+    # capacity drop is counted, never silent
+    tr1 = obs_tracer.begin_query("cap-owner", max_concurrent=1)
+
+    def try_over_capacity():
+        results["over"] = obs_tracer.begin_query("cap-over",
+                                                 max_concurrent=1)
+
+    t2 = threading.Thread(target=try_over_capacity)
+    t2.start()
+    t2.join()
+    snap = metrics.MetricsRegistry.get().snapshot()
+    drops = snap["counters"].get("trace.dropped_queries", {})
+    check("capacity drop returns None and increments "
+          "trace.dropped_queries",
+          results.get("over") is None and sum(drops.values()) >= 1,
+          str(drops))
+    if tr1 is not None:
+        obs_tracer.end_query(tr1)
+
+    # flight recorder: notes land in the ring and in a postmortem bundle
+    flight.note("selfcheck.note", value=42)
+    pm = flight.build_postmortem("selfcheck", RuntimeError("boom"),
+                                 last_k=16)
+    check("flight note in postmortem last-K",
+          any(r.get("event") == "selfcheck.note"
+              for r in pm["flight_events"]))
+    check("postmortem carries a registry snapshot",
+          pm.get("metrics", {}).get("schema")
+          == "spark-rapids-tpu/metrics/1")
+    check("postmortem carries engine state",
+          "hbm" in pm.get("engine_state", {}))
+
+    metrics.MetricsRegistry.reset_for_tests()
+    metrics.reset_query_state_for_tests()
+    flight.reset_for_tests()
+    obs_tracer.QueryTracer.reset_for_tests()
+    if failures:
+        print(f"self-check FAILED: {failures}")
+        return 1
+    print("self-check ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obs_report", description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the human rendering")
+    ap.add_argument("--self-check", action="store_true",
+                    help="exercise the observability plane; exit non-zero "
+                         "on a broken invariant")
+    args = ap.parse_args(argv)
+    if args.self_check:
+        return _self_check()
+    from spark_rapids_tpu.obs import metrics
+    snap = metrics.full_snapshot()
+    print(json.dumps(snap, indent=2, default=str) if args.json
+          else _render(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
