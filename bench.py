@@ -988,7 +988,14 @@ def main() -> None:
             "multichip_q3_per_chip_rows_s": _mc_q.get("per_chip_rows_per_s"),
             "multichip_collective_launches":
                 _mc.get("collective_launches_total"),
-            "multichip_collective_ms": _mc.get("collective_ms_total"),
+            "multichip_collective_ms": _mc.get(
+                "collective_phases_ms_total",
+                _mc.get("collective_ms_total")),
+            # mesh efficiency profiler (obs/mesh_profile.py): q3's named-
+            # phase wall attribution + worst-exchange skew — the round
+            # explains its own efficiency number
+            "multichip_q3_attribution": _mc_q.get("efficiency_attribution"),
+            "multichip_q3_skew": _mc_q.get("skew"),
             "multichip_bit_identical": _mc.get("bit_identical_all"),
             "multichip_O_exchanges":
                 _mc.get("collective_launches_O_exchanges"),

@@ -58,8 +58,10 @@ echo "== fast tier-1 gate (not slow) =="
 # bundle reconciliation, the device parquet decode oracles incl. the
 # O(row-groups) dispatch assertion, and the mesh data plane — collective
 # exchange parity across fusion/coalesce, the O(exchanges) launch
-# counter, AQE device statistics, and the lost-shard/slow-link chaos
-# heal) with the slow markers excluded.
+# counter, AQE device statistics, the lost-shard/slow-link chaos heal,
+# and the mesh efficiency profiler: phase-wall attribution, skew/
+# straggler reporting, the collective watchdog, zero profiler syncs)
+# with the slow markers excluded.
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
@@ -67,6 +69,7 @@ python -m pytest \
   tests/test_obs_serving.py \
   tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
+  tests/test_mesh_profile.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="

@@ -44,16 +44,25 @@ from .registry_check import Finding
 #: packages the lint covers (relative to the spark_rapids_tpu package root)
 OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory", "parallel")
 
+#: individual modules additionally covered: obs/mesh_profile.py is part of
+#: the obs package but is itself an EMITTER (registry histograms, flight
+#: notes, the watchdog) — its emission arguments obey the same
+#: no-blocking-sync contract as engine code
+OBS_MODULES: Tuple[str, ...] = ("obs/mesh_profile.py",)
+
 #: names that count as obs emission entry points when bound from the obs
 #: package (rule 2 scans their call arguments): tracer spans/events,
-#: per-query counter events, metrics-registry increments, flight notes
+#: per-query counter events, metrics-registry increments, flight notes,
+#: mesh-profiler records
 _EMIT_NAMES = ("span", "event", "dispatch_event", "sync_event",
                "counter_inc", "gauge_set", "gauge_max",
-               "histogram_observe", "note")
+               "histogram_observe", "note", "record_exchange",
+               "record_fallback")
 
 #: obs submodules whose attribute calls are emission sites when imported
-#: (``from ..obs import tracer as obs`` / ``metrics`` / ``flight``)
-_OBS_MODULE_NAMES = ("tracer", "metrics", "flight", "obs")
+#: (``from ..obs import tracer as obs`` / ``metrics`` / ``flight`` /
+#: ``mesh_profile``)
+_OBS_MODULE_NAMES = ("tracer", "metrics", "flight", "obs", "mesh_profile")
 
 #: tracer/registry internals whose use outside obs/ is a rule-1 finding
 _INTERNAL_NAMES = ("QueryTracer", "_Span", "_NullSpan", "MetricsRegistry")
@@ -105,8 +114,12 @@ class _Visitor(ast.NodeVisitor):
     # --- import tracking ---------------------------------------------------
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         mod = node.module or ""
-        if mod.endswith("obs") or ".obs." in f".{mod}." or \
-                mod.endswith(("obs.tracer", "obs.metrics", "obs.flight")):
+        # a module inside obs/ itself imports siblings relatively
+        # (``from . import metrics``) — same binding rules apply
+        in_obs_pkg = self.relpath.startswith("obs/") and not mod
+        if in_obs_pkg or mod.endswith("obs") or ".obs." in f".{mod}." or \
+                mod.endswith(("obs.tracer", "obs.metrics", "obs.flight",
+                              "obs.mesh_profile")):
             for a in node.names:
                 bound = a.asname or a.name
                 if a.name in _EMIT_NAMES:
@@ -214,11 +227,13 @@ def lint_obs_module(source: str, relpath: str) -> List[Finding]:
 
 
 def lint_obs_tree(root: Optional[str] = None,
-                  subpackages: Tuple[str, ...] = OBS_SUBPACKAGES
+                  subpackages: Tuple[str, ...] = OBS_SUBPACKAGES,
+                  modules: Tuple[str, ...] = OBS_MODULES
                   ) -> List[Finding]:
     """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
     from .astwalk import iter_module_sources
     findings: List[Finding] = []
-    for relpath, src in iter_module_sources(root, subpackages):
+    for relpath, src in iter_module_sources(root, subpackages,
+                                            modules=modules):
         findings.extend(lint_obs_module(src, relpath))
     return findings

@@ -356,6 +356,27 @@ docs/observability.md documents the registry naming scheme and the
 postmortem schema; `python -m tools.bench_diff` gates one bench round
 against the previous one on these numbers.
 
+## Mesh efficiency profiler + collective watchdog
+
+On a mesh session, every collective exchange additionally records a
+per-exchange efficiency profile (`spark_rapids_tpu/obs/mesh_profile.py`):
+the phase walls (host staging / program launch / collective wait /
+compact), the per-chip send/recv rows and bytes from the already-synced
+sizing counters (ZERO extra device syncs), and a skew table — max/median
+per-chip rows, the imbalance factor, and the straggler chip id when one
+chip's share exceeds `spark.rapids.tpu.obs.meshStragglerFactor` × the
+median. Profiles land in `last_query_profile()['mesh']`,
+`session.metrics_snapshot()` (with the `mesh.skew_imbalance` /
+`mesh.straggler_wait_ms` registry histograms), `python -m tools.obs_report
+--mesh`, and the MULTICHIP bench's per-query `efficiency_attribution`. A
+collective blocked past `spark.rapids.tpu.obs.collectiveWatchdogMs` trips
+the watchdog WHILE still waiting (flight-recorder event +
+`mesh.watchdog_fired` counter — a hung chip is otherwise indistinguishable
+from a slow one); past `spark.rapids.tpu.obs.collectiveWatchdogFatalMs` it
+dumps a postmortem bundle. Mesh-session exchanges routed per-map record
+WHY (`mesh.per_map_exchange{reason}`, `explain("metrics")`
+`per_map=` annotations). See docs/observability.md "Mesh profiling".
+
 ## Device parquet decode
 
 With `spark.rapids.tpu.parquet.deviceDecode.enabled` (default on) parquet
@@ -1031,6 +1052,35 @@ OBS_FLIGHT_EVENTS = _conf("spark.rapids.tpu.obs.flightRecorderEvents").doc(
     "pressure/OOM, disk spills, fetch retries). The last events land in "
     "the postmortem bundle when a query dies hard."
 ).integer(512)
+
+OBS_COLLECTIVE_WATCHDOG_MS = _conf(
+    "spark.rapids.tpu.obs.collectiveWatchdogMs").doc(
+    "Collective watchdog (docs/observability.md \"Mesh profiling\"): a "
+    "mesh collective exchange whose launch+wait window exceeds this many "
+    "milliseconds emits a flight-recorder event (mesh.watchdog) and the "
+    "mesh.watchdog_fired registry counter WHILE the wait is still "
+    "blocked — on real hardware a hung chip manifests exactly as an "
+    "unbounded collective wait, and without the watchdog it is "
+    "indistinguishable from a slow one. 0 disables."
+).integer(30000)
+
+OBS_COLLECTIVE_WATCHDOG_FATAL_MS = _conf(
+    "spark.rapids.tpu.obs.collectiveWatchdogFatalMs").doc(
+    "When > 0, a collective still blocked after this many milliseconds "
+    "dumps a postmortem bundle under spark.rapids.tpu.obs.postmortemDir "
+    "(the incident artifact exists even if the process never returns "
+    "from the wait) and counts mesh.watchdog_fatal. Keep well above "
+    "collectiveWatchdogMs; 0 (default) disables the fatal tier."
+).integer(0)
+
+OBS_MESH_STRAGGLER_FACTOR = _conf(
+    "spark.rapids.tpu.obs.meshStragglerFactor").doc(
+    "Straggler threshold for the mesh efficiency profiler: an exchange "
+    "whose heaviest chip receives more than this multiple of the median "
+    "per-chip rows reports that chip as the straggler (skew table in "
+    "last_query_profile()['mesh'] and the MULTICHIP summary) and feeds "
+    "the mesh.straggler_wait_ms histogram."
+).double(2.0)
 
 OBS_POSTMORTEM_DIR = _conf("spark.rapids.tpu.obs.postmortemDir").doc(
     "When set, a fatal device error, an exhausted transient-retry loop, "

@@ -23,6 +23,12 @@ chaos:
   snapshot, HBM/semaphore/spill state, active queries) under
   ``spark.rapids.tpu.obs.postmortemDir`` on a fatal device error, an
   exhausted retry, or an HBM OOM.
+* **Mesh efficiency profiler** (:mod:`.mesh_profile`): per-collective-
+  exchange wall attribution (staging/launch/wait/compact), per-chip skew
+  and straggler reporting, "why not collective" fallback reasons, and
+  the collective watchdog — the distributed layer over the three above
+  (``last_query_profile()['mesh']``, the MULTICHIP bench's
+  ``efficiency_attribution``, ``mesh.watchdog_fired``).
 
 Instrumentation sites in execs//shuffle//memory//parallel/ must emit
 through this package's :func:`span` / :func:`event` / metric helpers
@@ -35,11 +41,11 @@ from .export import build_bundle, chrome_trace, span_tree, write_artifacts
 from .tracer import (QueryTracer, SpanRef, begin_query, current_span,
                      end_query, event, inherit, is_active, span,
                      thread_traced)
-from . import flight, metrics
+from . import flight, mesh_profile, metrics
 
 __all__ = [
     "QueryTracer", "SpanRef", "begin_query", "build_bundle", "chrome_trace",
     "current_span", "end_query", "event", "flight", "inherit", "is_active",
-    "metrics", "render_explain_metrics", "span", "span_tree",
-    "thread_traced", "write_artifacts",
+    "mesh_profile", "metrics", "render_explain_metrics", "span",
+    "span_tree", "thread_traced", "write_artifacts",
 ]

@@ -21,7 +21,7 @@ profiler's file dumps; here they are projections of one ring buffer):
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .tracer import (REC_ARGS, REC_CAT, REC_NAME, REC_OP, REC_PARENT,
                      REC_PHASE, REC_SPAN, REC_TID, REC_TS)
@@ -74,12 +74,86 @@ def span_tree(profile: Dict[str, Any]) -> Dict[str, Any]:
     return root
 
 
+#: pid of the synthesized per-device track group in the Chrome trace (the
+#: engine's real threads render under pid 1)
+MESH_DEVICE_PID = 2
+
+
+def _mesh_tracks(profile: Dict[str, Any]) -> tuple:
+    """Synthesize the multi-chip view from the SAME ring record: one track
+    per device (pid ``MESH_DEVICE_PID``, tid = device index) with the
+    collective wait of every exchange as an "X" complete event ALIGNED
+    across tracks (the wait is the fabric barrier: every chip is in it
+    together), plus flow events ("s"/"f", id = the exchange's profile seq)
+    tying each producer ``mesh.profile`` record to its consumer
+    ``mesh.read`` events. Emitted through the existing tracer records, so
+    concurrent-query routing needs no new machinery — a query's trace
+    only ever contains its own exchanges. Returns (events, device_ids)."""
+    evs: List[Dict[str, Any]] = []
+    devices: set = set()
+    reads: Dict[int, List[Tuple[float, int]]] = {}  # seq -> [(ts_us, tid)]
+    for rec in profile["events"]:
+        if rec[REC_PHASE] != "i" or rec[REC_NAME] != "mesh.read":
+            continue
+        args = rec[REC_ARGS] or {}
+        seq = args.get("exchange_seq")
+        if seq is not None:
+            reads.setdefault(int(seq), []).append(
+                (rec[REC_TS] / 1e3, rec[REC_TID]))
+    for rec in profile["events"]:
+        if rec[REC_PHASE] != "i" or rec[REC_NAME] != "mesh.profile":
+            continue
+        args = rec[REC_ARGS] or {}
+        phases = args.get("phases_ms") or {}
+        n_dev = int(args.get("n_dev", 0))
+        seq = args.get("exchange_seq")
+        if not n_dev or seq is None:
+            continue
+        # the profile event is recorded at the end of compact: walk back
+        # through the phase walls to place the aligned wait window
+        end_us = rec[REC_TS] / 1e3
+        compact_us = float(phases.get("compact", 0.0)) * 1e3
+        wait_us = float(phases.get("collective_wait", 0.0)) * 1e3
+        wait_end = end_us - compact_us
+        wait_start = max(0.0, wait_end - wait_us)
+        recv = args.get("recv_rows") or []
+        skew = args.get("skew") or {}
+        name = f"collective s{args.get('shuffle', '?')}"
+        for d in range(n_dev):
+            devices.add(d)
+            dev_args = {"exchange_seq": seq,
+                        "rows_recv": recv[d] if d < len(recv) else None}
+            if skew.get("straggler_chip") == d:
+                dev_args["straggler"] = True
+            evs.append({"ph": "X", "name": name, "cat": "mesh",
+                        "ts": wait_start, "dur": max(wait_us, 1.0),
+                        "pid": MESH_DEVICE_PID, "tid": d,
+                        "args": dev_args})
+        # producer→consumer flows: anchor the start on the producing
+        # thread inside the exchange span, finish at each consumer read
+        consumers = reads.get(int(seq), [])
+        if consumers:
+            evs.append({"ph": "s", "id": int(seq), "name": "mesh.flow",
+                        "cat": "mesh", "ts": end_us, "pid": 1,
+                        "tid": rec[REC_TID]})
+            for ts_us, tid in consumers:
+                evs.append({"ph": "f", "bp": "e", "id": int(seq),
+                            "name": "mesh.flow", "cat": "mesh",
+                            "ts": max(ts_us, end_us), "pid": 1,
+                            "tid": tid})
+    return evs, sorted(devices)
+
+
 def chrome_trace(profile: Dict[str, Any],
                  process_name: str = "spark-rapids-tpu") -> Dict[str, Any]:
     """Chrome trace-event JSON (the "JSON object format"): open in perfetto
     (ui.perfetto.dev → Open trace) or chrome://tracing. B/E pairs are
     emitted per thread in record order, which our per-thread span stacks
-    guarantee to be properly nested."""
+    guarantee to be properly nested. Queries that rode the mesh data plane
+    additionally render one track per DEVICE (process "mesh devices") with
+    the collective wait of every exchange aligned across tracks and flow
+    arrows from producer exchange to consumer read
+    (docs/observability.md "Mesh profiling")."""
     evs: List[Dict[str, Any]] = []
     tids = set()
     opened = set()
@@ -110,11 +184,20 @@ def chrome_trace(profile: Dict[str, Any],
             evs.append({"ph": "i", "s": "t", "name": rec[REC_NAME],
                         "cat": rec[REC_CAT], "ts": ts_us, "pid": 1,
                         "tid": rec[REC_TID], "args": args})
+    mesh_evs, device_ids = _mesh_tracks(profile)
     meta = [{"ph": "M", "name": "process_name", "pid": 1,
              "args": {"name": process_name}}]
     meta += [{"ph": "M", "name": "thread_name", "pid": 1, "tid": t,
               "args": {"name": f"thread-{t}"}} for t in sorted(tids)]
-    return {"traceEvents": meta + evs, "displayTimeUnit": "ms",
+    if device_ids:
+        meta.append({"ph": "M", "name": "process_name",
+                     "pid": MESH_DEVICE_PID,
+                     "args": {"name": "mesh devices"}})
+        meta += [{"ph": "M", "name": "thread_name",
+                  "pid": MESH_DEVICE_PID, "tid": d,
+                  "args": {"name": f"device-{d}"}} for d in device_ids]
+    return {"traceEvents": meta + evs + mesh_evs,
+            "displayTimeUnit": "ms",
             "otherData": {"query": profile.get("name"),
                           "dropped_events": profile.get("dropped", 0)}}
 
@@ -171,13 +254,18 @@ def build_bundle(profile: Dict[str, Any],
                  metrics: Optional[Dict[str, Dict[str, int]]] = None,
                  sync_ledger: Optional[Dict[str, Dict[str, int]]] = None,
                  dispatch_delta: Optional[Dict[str, int]] = None,
-                 task_metrics: Optional[Dict[str, int]] = None
-                 ) -> Dict[str, Any]:
+                 task_metrics: Optional[Dict[str, int]] = None,
+                 mesh_profiles: Optional[List[Dict[str, Any]]] = None,
+                 mesh_fallbacks: Optional[List[Dict[str, Any]]] = None,
+                 mesh_dropped: int = 0) -> Dict[str, Any]:
     """The machine-readable per-query diagnostics bundle
     (docs/observability.md "Bundle schema"). `sync_ledger` and
     `dispatch_delta` are the SAME-query deltas of the SyncLedger and of
     opjit ``cache_stats()["calls_by_kind"]`` — the bundle's own event
-    counts must reconcile with them exactly unless the ring overflowed."""
+    counts must reconcile with them exactly unless the ring overflowed.
+    `mesh_profiles` / `mesh_fallbacks` are this query's collective-
+    exchange records (obs/mesh_profile.py) — present only for queries
+    that ran on a mesh session."""
     by_op, disp_by_kind, sync_total, by_cat, chaos, retries = \
         _counts(profile)
     dropped = int(profile.get("dropped", 0))
@@ -198,7 +286,7 @@ def build_bundle(profile: Dict[str, Any],
         reconcile["sync_ok"] = dropped > 0 or got_syncs == want_syncs
         reconcile["sync_total_expected"] = sum(
             sum(k.values()) for k in want_syncs.values())
-    return {
+    bundle = {
         "schema": "spark-rapids-tpu/query-profile/1",
         "query": profile.get("name"),
         "duration_ms": round(profile.get("duration_ns", 0) / 1e6, 3),
@@ -215,6 +303,28 @@ def build_bundle(profile: Dict[str, Any],
         "retry_events": retries,
         "reconcile": reconcile,
     }
+    if mesh_profiles or mesh_fallbacks or mesh_dropped:
+        # mesh section (docs/observability.md "Mesh profiling"): the
+        # per-exchange phase breakdown + skew table, the worst-imbalance
+        # exchange, the per-map fallback reason counts, and the count of
+        # records the bounded profiler rings evicted inside this query's
+        # window (never presented as a complete set when it is not)
+        reasons: Dict[str, int] = {}
+        for f in mesh_fallbacks or []:
+            reasons[f["reason"]] = reasons.get(f["reason"], 0) + 1
+        worst = max((p for p in mesh_profiles or []),
+                    key=lambda p: p["skew"]["imbalance"], default=None)
+        bundle["mesh"] = {
+            "exchanges": list(mesh_profiles or []),
+            "per_map_reasons": reasons,
+            "skew_worst": None if worst is None else {
+                "exchange": worst["exchange"], "seq": worst["seq"],
+                **worst["skew"]},
+            "watchdog_fired": any(p.get("watchdog_fired")
+                                  for p in mesh_profiles or []),
+            "dropped_records": int(mesh_dropped),
+        }
+    return bundle
 
 
 def write_artifacts(bundle: Dict[str, Any], profile: Dict[str, Any],

@@ -334,6 +334,15 @@ def full_snapshot() -> Dict[str, Any]:
         from ..parallel.mesh import collective_stats
         return collective_stats()
 
+    def _mesh_profiles():
+        # the mesh efficiency profiler's recent per-exchange records
+        # (phase walls + skew tables) and the per-map fallback reasons —
+        # the metrics_snapshot() "mesh" readout next to the registry's
+        # mesh.* histograms
+        from . import mesh_profile
+        return {"recent_exchanges": mesh_profile.recent(16),
+                "per_map_reasons": mesh_profile.fallback_counts()}
+
     def _syncs():
         from ..profiling import SyncLedger
         led = SyncLedger.get()
@@ -359,6 +368,7 @@ def full_snapshot() -> Dict[str, Any]:
 
     fold("opjit", _opjit)
     fold("collective", _collective)
+    fold("mesh_profiles", _mesh_profiles)
     fold("sync_ledger", _syncs)
     fold("task_metrics", _task_metrics)
     fold("chaos", _chaos)

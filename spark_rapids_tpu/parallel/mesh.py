@@ -114,7 +114,7 @@ _EXCHANGE_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
 # assertion read these next to opjit calls_by_kind["mesh_collective"]).
 _STATS_LOCK = threading.Lock()
 _STATS = {"launches": 0, "rows_sent": 0, "stage_ns": 0, "launch_ns": 0,
-          "wait_ns": 0}
+          "wait_ns": 0, "compact_ns": 0}
 
 
 def collective_stats() -> Dict[str, int]:
@@ -129,13 +129,14 @@ def reset_collective_stats() -> None:
 
 
 def _record_launch(rows: int, stage_ns: int, launch_ns: int,
-                   wait_ns: int) -> None:
+                   wait_ns: int, compact_ns: int) -> None:
     with _STATS_LOCK:
         _STATS["launches"] += 1
         _STATS["rows_sent"] += rows
         _STATS["stage_ns"] += stage_ns
         _STATS["launch_ns"] += launch_ns
         _STATS["wait_ns"] += wait_ns
+        _STATS["compact_ns"] += compact_ns
     # always-on registry (docs/observability.md): the collective's blocking
     # wait is the fabric's user-visible latency — histogram it per launch
     # (rare: one per exchange) so a serving dashboard sees the tail;
@@ -149,6 +150,7 @@ class MeshExchangeResult(NamedTuple):
     batches: List[TpuColumnarBatch]  # one compacted batch per reduce part
     rows: List[int]                  # exact received rows per reduce part
     bytes: List[int]                 # device bytes per reduce part
+    profile: Optional[Dict] = None   # obs/mesh_profile.py record
 
 
 def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
@@ -228,15 +230,19 @@ def mesh_hash_exchange(mesh: Mesh,
                        group_batches: List[Optional[TpuColumnarBatch]],
                        pids_list: List[Optional[jnp.ndarray]],
                        names: Sequence[str],
-                       shuffle_id: int = -1) -> MeshExchangeResult:
+                       shuffle_id: int = -1,
+                       partitioning: str = "hash") -> MeshExchangeResult:
     """Collective hash exchange: `group_batches[d]` is the (possibly empty)
     concatenated map input assigned to shard d, `pids_list[d]` its
     destination-partition ids. Returns one compacted device batch per reduce
     partition (= per shard) plus the exact per-reduce row/byte counts
     derived from the sizing counts (the device-side statistics AQE plans
-    against — no block fetch, no extra sync)."""
+    against — no block fetch, no extra sync) and the exchange's
+    efficiency profile (obs/mesh_profile.py: phase walls + per-chip skew,
+    all from host values this function already holds)."""
     from ..chaos import inject
     from ..execs import opjit
+    from ..obs import mesh_profile as mprof
     n_dev = mesh.devices.size
     assert len(group_batches) == n_dev
     t_stage0 = time.perf_counter_ns()
@@ -307,59 +313,93 @@ def mesh_hash_exchange(mesh: Mesh,
            [shard(col_valid[i]) for i in range(len(dtypes))]
     fn = _build_exchange(mesh, n_dev, slot_cap, tuple(sig))
     t_launch0 = time.perf_counter_ns()
-    # chaos `mesh.link`: a slow or flapping ICI link. Latency sleeps here
-    # (the transfer stalls); a transient error propagates to the caller's
+    # pre-allocated profile seq: the span args and the consumer read's
+    # flow events reference the profile before it is recorded
+    seq = mprof.alloc_seq()
+    # the span covers launch → wait → compact (staging_ms rides as an arg:
+    # the per-chip send counts it reports only exist after the sizing
+    # sync). The watchdog arms around ONLY the fabric window — inject +
+    # launch + wait — and disarms before the host-side compact: chaos
+    # `mesh.link` (a slow or flapping ICI link) injects inside it, so a
+    # stalled transfer trips the watchdog exactly like a hung chip would,
+    # while a long (pure-CPU) compact never raises a false "hung chip".
+    # Latency sleeps here; a transient error propagates to the caller's
     # with_device_retry, which re-runs the whole (idempotent) staging.
-    inject("mesh.link", detail=f"s{shuffle_id}")
-    with obs.span(f"mesh.exchange s{shuffle_id}", cat="shuffle.collective",
-                  shuffle=shuffle_id, n_dev=n_dev, slot_cap=slot_cap,
+    with obs.span(f"mesh.exchange s{shuffle_id}",
+                  cat="shuffle.collective", shuffle=shuffle_id,
+                  n_dev=n_dev, slot_cap=slot_cap, exchange_seq=seq,
+                  staging_ms=round((t_launch0 - t_stage0) / 1e6, 3),
                   per_chip_rows=[int(x) for x in send_rows]):
-        outs = fn(dest_g, *flat)
-        t_wait0 = time.perf_counter_ns()
-        # the collective is the stage boundary: waiting for it here is the
-        # exchange's one blocking device sync (no data moves to host — the
-        # ledger records the wait so per-query sync accounting stays exact)
-        from ..profiling import record_sync
-        record_sync("collective_wait")
-        jax.block_until_ready(outs)
-        t_end = time.perf_counter_ns()
-    opjit.record_external_dispatch("mesh_collective")
-    _record_launch(int(send_rows.sum()), t_launch0 - t_stage0,
-                   t_wait0 - t_launch0, t_end - t_wait0)
-    rowok = outs[0]
-    pos = 1
-    recv_data: List[jnp.ndarray] = []
-    recv_valid: List[Optional[jnp.ndarray]] = []
-    for i in range(len(dtypes)):
-        recv_data.append(outs[pos])
-        pos += 1
-        if has_valid[i]:
-            recv_valid.append(outs[pos])
+        with mprof.collective_watchdog(shuffle_id, n_dev) as wd:
+            inject("mesh.link", detail=f"s{shuffle_id}")
+            outs = fn(dest_g, *flat)
+            t_wait0 = time.perf_counter_ns()
+            # the collective is the stage boundary: waiting for it here is
+            # the exchange's one blocking device sync (no data moves to
+            # host — the ledger records the wait so per-query sync
+            # accounting stays exact)
+            from ..profiling import record_sync
+            record_sync("collective_wait")
+            jax.block_until_ready(outs)
+            t_end = time.perf_counter_ns()
+        opjit.record_external_dispatch("mesh_collective")
+        rowok = outs[0]
+        pos = 1
+        recv_data: List[jnp.ndarray] = []
+        recv_valid: List[Optional[jnp.ndarray]] = []
+        for i in range(len(dtypes)):
+            recv_data.append(outs[pos])
             pos += 1
-        else:
-            recv_valid.append(None)
+            if has_valid[i]:
+                recv_valid.append(outs[pos])
+                pos += 1
+            else:
+                recv_valid.append(None)
 
-    # slice per shard, compact out the slot gaps. The kept-row count per
-    # shard is KNOWN host-side from the sizing counts (slot_cap >= the
-    # largest bucket, so nothing was dropped): compact under the known
-    # count instead of paying one scalar sync per reduce partition.
-    local = n_dev * slot_cap
-    row_bytes = _fixed_row_bytes(ref, has_valid)
-    results: List[TpuColumnarBatch] = []
-    sizes: List[int] = []
-    for r in range(n_dev):
-        sl = slice(r * local, (r + 1) * local)
-        ok = rowok[sl]
-        cols = []
-        for i, dt in enumerate(dtypes):
-            v = recv_valid[i][sl] if recv_valid[i] is not None else None
-            cols.append(TpuColumnVector(dt, recv_data[i][sl], v, local))
-        batch = TpuColumnarBatch(cols, local, list(names))
-        idx, _n_dev_count = _compact_plan(jnp.asarray(ok), batch.rows_arg)
-        results.append(gather(batch, idx, int(recv_rows[r]),
-                              out_capacity=local))
-        sizes.append(int(recv_rows[r]) * row_bytes)
-    return MeshExchangeResult(results, [int(x) for x in recv_rows], sizes)
+        # slice per shard, compact out the slot gaps. The kept-row count
+        # per shard is KNOWN host-side from the sizing counts (slot_cap >=
+        # the largest bucket, so nothing was dropped): compact under the
+        # known count instead of paying one scalar sync per reduce
+        # partition.
+        local = n_dev * slot_cap
+        row_bytes = _fixed_row_bytes(ref, has_valid)
+        results: List[TpuColumnarBatch] = []
+        sizes: List[int] = []
+        for r in range(n_dev):
+            sl = slice(r * local, (r + 1) * local)
+            ok = rowok[sl]
+            cols = []
+            for i, dt in enumerate(dtypes):
+                v = recv_valid[i][sl] if recv_valid[i] is not None else None
+                cols.append(TpuColumnVector(dt, recv_data[i][sl], v, local))
+            batch = TpuColumnarBatch(cols, local, list(names))
+            idx, _n_dev_count = _compact_plan(jnp.asarray(ok),
+                                              batch.rows_arg)
+            results.append(gather(batch, idx, int(recv_rows[r]),
+                                  out_capacity=local))
+            sizes.append(int(recv_rows[r]) * row_bytes)
+        t_compact_end = time.perf_counter_ns()
+        profile = mprof.record_exchange(
+            seq, shuffle_id, partitioning, n_dev,
+            send_rows=[int(x) for x in send_rows],
+            recv_rows=[int(x) for x in recv_rows], recv_bytes=sizes,
+            stage_ns=t_launch0 - t_stage0, launch_ns=t_wait0 - t_launch0,
+            wait_ns=t_end - t_wait0, compact_ns=t_compact_end - t_end,
+            watchdog_fired=wd.fired)
+        if profile is not None:
+            # the full attribution record as an instant event: the Chrome
+            # export derives the per-device tracks + producer→consumer
+            # flows from it (all values already host-side)
+            obs.event("mesh.profile", cat="mesh", exchange_seq=seq,
+                      shuffle=shuffle_id, n_dev=n_dev,
+                      phases_ms=dict(profile["phases_ms"]),
+                      recv_rows=list(profile["recv_rows"]),
+                      skew=dict(profile["skew"]))
+    _record_launch(int(send_rows.sum()), t_launch0 - t_stage0,
+                   t_wait0 - t_launch0, t_end - t_wait0,
+                   t_compact_end - t_end)
+    return MeshExchangeResult(results, [int(x) for x in recv_rows], sizes,
+                              profile)
 
 
 def mesh_single_exchange(mesh: Mesh,
@@ -384,4 +424,4 @@ def mesh_single_exchange(mesh: Mesh,
             else jnp.zeros((b.capacity,), jnp.int32)
             for b in group_batches]
     return mesh_hash_exchange(mesh, group_batches, pids, names,
-                              shuffle_id=shuffle_id)
+                              shuffle_id=shuffle_id, partitioning="single")

@@ -16,9 +16,12 @@ ways —
 
 and returns per-query statistics: wall times, per-chip rows/s, the
 collective launch count against the plan's exchange count (the
-O(exchanges) assertion — launches must NOT scale with partitions), and the
-stage/launch/wait breakdown of collective time accumulated by
-`parallel.mesh.collective_stats`.
+O(exchanges) assertion — launches must NOT scale with partitions), the
+staging/launch/wait/compact phase breakdown of collective time
+(`parallel.mesh.collective_stats` + the per-exchange profiles and skew
+tables from `obs/mesh_profile.py`), the per-map "why not collective"
+reasons, and the named-phase `efficiency_attribution` of the profiled
+mesh wall (docs/distributed.md "Diagnosing poor scaling").
 
 Unlike the hand-written q1 step this replaces (`distributed.py`, kept for
 the kernel-level dryrun), nothing here is query-specific: the planner —
@@ -133,6 +136,7 @@ def run_mesh_query(name: str, build: Callable, *, n_devices: int,
     from ..session import TpuSession
 
     def timed_run(settings, measure: bool) -> Tuple[object, float, Dict]:
+        from ..obs import mesh_profile
         s = TpuSession(dict(settings))
         q = build(s)
         out = q.to_arrow()  # warm: traces/compiles every program
@@ -146,16 +150,29 @@ def run_mesh_query(name: str, build: Callable, *, n_devices: int,
             # counter-bracketed extra collect (a whole wasted execution)
             return out, best, {}
         # one more collect bracketed by the collective counters: exchanges
-        # re-materialize per collect, so this measures launches PER QUERY
+        # re-materialize per collect, so this measures launches PER QUERY.
+        # The SAME collect's wall anchors the phase attribution (the phase
+        # walls and the wall must come from one execution or the
+        # percentages lie).
         before_launches = collective_stats()
         before_kind = _dispatch_kind("mesh_collective")
+        seq0 = mesh_profile.current_seq()
+        t0 = time.perf_counter()
         out = q.to_arrow()
+        wall_profiled = time.perf_counter() - t0
         stats = collective_stats()
         delta = {k: stats[k] - before_launches[k] for k in stats}
         delta["dispatch_kind"] = _dispatch_kind("mesh_collective") \
             - before_kind
+        profiles = mesh_profile.profiles_since(seq0)
+        reasons: Dict[str, int] = {}
+        for f in mesh_profile.fallbacks_since(seq0):
+            reasons[f["reason"]] = reasons.get(f["reason"], 0) + 1
         return out, best, {"collective": delta,
-                           "exchanges": _count_exchanges(s)}
+                           "exchanges": _count_exchanges(s),
+                           "wall_profiled_s": wall_profiled,
+                           "profiles": profiles,
+                           "per_map_reasons": reasons}
 
     out_mesh, wall_mesh, info = timed_run(
         mesh_settings(n_devices, extra_conf), measure=True)
@@ -169,12 +186,18 @@ def run_mesh_query(name: str, build: Callable, *, n_devices: int,
     # agree with the mesh module's own launch counter.
     launches_ok = (launches <= info["exchanges"]
                    and launches == col["dispatch_kind"])
+    # worst-skew exchange of the profiled collect (the per-exchange skew
+    # tables ride the full record; this is the one-line summary)
+    profiles = info.get("profiles") or []
+    worst = max(profiles, key=lambda p: p["skew"]["imbalance"],
+                default=None)
     return {
         "query": name,
         "rows_out": out_mesh.num_rows,
         "n_devices": n_devices,
         "wall_ms_mesh": round(wall_mesh * 1e3, 1),
         "wall_ms_single": round(wall_one * 1e3, 1),
+        "wall_ms_profiled": round(info["wall_profiled_s"] * 1e3, 1),
         "scaling_vs_single": round(wall_one / wall_mesh, 3)
         if wall_mesh > 0 else None,
         "bit_identical": identical,
@@ -186,7 +209,41 @@ def run_mesh_query(name: str, build: Callable, *, n_devices: int,
         "collective_stage_ms": round(col["stage_ns"] / 1e6, 2),
         "collective_launch_ms": round(col["launch_ns"] / 1e6, 2),
         "collective_wait_ms": round(col["wait_ns"] / 1e6, 2),
+        "collective_compact_ms": round(col["compact_ns"] / 1e6, 2),
+        "exchange_profiles": profiles,
+        "per_map_reasons": info.get("per_map_reasons") or {},
+        "skew_worst": None if worst is None else {
+            "exchange": worst["exchange"], **worst["skew"]},
+        "watchdog_fired": any(p.get("watchdog_fired") for p in profiles),
     }
+
+
+def attribute_efficiency(record: Dict) -> Dict[str, float]:
+    """Named-phase attribution of one query's PROFILED mesh wall
+    (staging / launch / collective-wait / compact from the collective
+    counters, compute = the residual outside the exchange path) as
+    percentages — the `efficiency_attribution` the MULTICHIP compact line
+    carries so each round explains its own efficiency number. The phase
+    walls and the wall come from the SAME collect (run_mesh_query's
+    bracketed execution), so the split is exact."""
+    wall_ms = record.get("wall_ms_profiled") or record.get("wall_ms_mesh")
+    if not wall_ms:
+        return {}
+    phases = {
+        "staging": record.get("collective_stage_ms", 0.0),
+        "launch": record.get("collective_launch_ms", 0.0),
+        "collective_wait": record.get("collective_wait_ms", 0.0),
+        "compact": record.get("collective_compact_ms", 0.0),
+    }
+    out = {k: round(100.0 * v / wall_ms, 1) for k, v in phases.items()}
+    named = sum(out.values())
+    out["compute"] = round(max(0.0, 100.0 - named), 1)
+    # NOT clamped to 100: a value above 100 means the summed phase walls
+    # exceeded the wall they were measured against (a phase/wall mismatch
+    # bug) — clamping would mask exactly the overcount this key exists to
+    # surface
+    out["attributed_pct"] = round(named + out["compute"], 1)
+    return out
 
 
 def summarize(records: List[Dict], n_devices: int,
@@ -194,7 +251,10 @@ def summarize(records: List[Dict], n_devices: int,
     """The MULTICHIP stage's compact summary (ONE parseable line — the
     r05 lesson: the driver keeps only the stdout tail). Per-chip rows/s is
     the mesh run's input-row throughput divided by the chip count; scaling
-    efficiency is speedup-over-1-chip / n_chips."""
+    efficiency is speedup-over-1-chip / n_chips. The single collective_ms
+    scalar of r06 is replaced by the per-phase walls + skew summary +
+    efficiency_attribution (obs/mesh_profile.py); the full per-exchange
+    profiles ride the detail records."""
     per_query = {}
     total_launches = 0
     total_collective_ms = 0.0
@@ -203,24 +263,38 @@ def summarize(records: List[Dict], n_devices: int,
     for r in records:
         rows = input_rows.get(r["query"], 0)
         mesh_s = r["wall_ms_mesh"] / 1e3
+        phases = {
+            "staging": round(r["collective_stage_ms"], 1),
+            "launch": round(r["collective_launch_ms"], 1),
+            "collective_wait": round(r["collective_wait_ms"], 1),
+            "compact": round(r.get("collective_compact_ms", 0.0), 1),
+        }
+        # compact-line discipline (the r05 lesson: the driver keeps ~2000
+        # chars of stdout): no key whose value is derivable from another —
+        # rows/bit_identical/wall_ms_single ride the detail records, the
+        # worst-skew summary keeps only the verdict fields
+        sk = r.get("skew_worst")
+        ea = attribute_efficiency(r)
+        ea = {k: v for k, v in ea.items()
+              if v or k in ("compute", "attributed_pct")}
         per_query[r["query"]] = {
-            "rows": rows,
-            "rows_per_s": round(rows / mesh_s, 1) if mesh_s > 0 else None,
             "per_chip_rows_per_s": round(rows / mesh_s / n_devices, 1)
             if mesh_s > 0 else None,
             "wall_ms": r["wall_ms_mesh"],
-            "wall_ms_single": r["wall_ms_single"],
             "scaling_efficiency": round(
                 (r["scaling_vs_single"] or 0) / n_devices, 3),
-            "bit_identical": r["bit_identical"],
             "exchanges": r["exchanges"],
             "collective_launches": r["collective_launches"],
-            "collective_ms": round(r["collective_stage_ms"]
-                                   + r["collective_launch_ms"]
-                                   + r["collective_wait_ms"], 2),
+            "phases_ms": phases,
+            "efficiency_attribution": ea,
+            "skew": None if sk is None else {
+                "exchange": sk["exchange"],
+                "imbalance": sk["imbalance"],
+                "straggler_chip": sk["straggler_chip"]},
+            "per_map_exchanges": r.get("per_map_reasons") or {},
         }
         total_launches += r["collective_launches"]
-        total_collective_ms += per_query[r["query"]]["collective_ms"]
+        total_collective_ms += sum(phases.values())
         all_identical = all_identical and r["bit_identical"]
         all_o_exchanges = all_o_exchanges \
             and r["collective_launches_O_exchanges"]
@@ -229,7 +303,13 @@ def summarize(records: List[Dict], n_devices: int,
         "n_devices": n_devices,
         "queries": per_query,
         "collective_launches_total": total_launches,
-        "collective_ms_total": round(total_collective_ms, 2),
+        # RENAMED from r06's collective_ms_total: the total now includes
+        # the compact phase, and bench_diff gates collective totals
+        # lower-is-better — reusing the old key with a wider composition
+        # would read as a spurious 4–5× regression against r06
+        "collective_phases_ms_total": round(total_collective_ms, 2),
         "bit_identical_all": all_identical,
         "collective_launches_O_exchanges": all_o_exchanges,
+        "watchdog_fired_any": any(r.get("watchdog_fired")
+                                  for r in records),
     }

@@ -408,8 +408,8 @@ def _convert_exchange(meta: PlanMeta, ch):
     # mesh-size partitions (alignPartitions) so the on-device murmur3 % n
     # routing matches the shard count, and eligible exchanges carry
     # `collective_planned` so materialization runs ONE fabric collective.
-    mesh = mesh_session_active(meta.conf) \
-        if meta.conf.get(MESH_COLLECTIVE_ENABLED) else None
+    ms = mesh_session_active(meta.conf)
+    mesh = ms if meta.conf.get(MESH_COLLECTIVE_ENABLED) else None
     eligible = mesh is not None \
         and p.partitioning in ("hash", "single") \
         and mesh_eligible_output(ch[0].output)
@@ -421,6 +421,19 @@ def _convert_exchange(meta: PlanMeta, ch):
     if eligible and (p.partitioning == "single"
                      or n_out == mesh.devices.size):
         exch.collective_planned = True
+    elif ms is not None:
+        # plan-time "why not collective" (obs/mesh_profile.py): a mesh
+        # session routed this exchange per-map — say why in the plan
+        # (node_desc → explain("metrics")) instead of a code comment
+        if mesh is None:
+            reason = "collective_conf_off"
+        elif p.partitioning not in ("hash", "single"):
+            reason = f"partitioning_{p.partitioning}"
+        elif not mesh_eligible_output(ch[0].output):
+            reason = "string_or_nested_payload"
+        else:
+            reason = "partitions_misaligned"
+        exch._collective_reason = reason
     # AQE partition coalescing (reference GpuCustomShuffleReaderExec).
     # NOT applied when the exchange feeds a co-partitioned join: each side
     # would coalesce on its own sizes and partition i of the left would no

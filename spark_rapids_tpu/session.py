@@ -987,9 +987,13 @@ class TpuSession:
         # postmortem dir / ring size (same arm-once pattern as chaos)
         from .config import OBS_METRICS_ENABLED
         from .obs import flight as _flight
+        from .obs import mesh_profile as _mesh_profile
         from .obs import metrics as _obs_metrics
         _obs_metrics.set_enabled(rc.get(OBS_METRICS_ENABLED))
         _flight.maybe_configure(rc)
+        # mesh efficiency profiler: collective watchdog thresholds +
+        # straggler factor (docs/observability.md "Mesh profiling")
+        _mesh_profile.maybe_configure(rc)
         self._pool: Optional[_fut.ThreadPoolExecutor] = None
 
     # conf API
@@ -1099,6 +1103,10 @@ class TpuSession:
         qroot = None
         opjit_before = None
         tables = []
+        # window for this query's collective-exchange profiles (mesh
+        # efficiency profiler): profiles are tagged with the traced query
+        # name when one is bound; the seq window covers untraced queries
+        mesh_seq0 = obs.mesh_profile.current_seq()
         failed = True  # cleared by the last statement of the try body
         try:
             if conf.get(TRACE_ENABLED):
@@ -1186,6 +1194,18 @@ class TpuSession:
                 if d:
                     ledger[op] = d
             self._last_sync_ledger = ledger
+            # this query's per-exchange mesh profiles + per-map fallback
+            # reasons (empty outside mesh sessions): the bundle's `mesh`
+            # section and the sharded runner both read these
+            self._last_mesh_profiles = obs.mesh_profile.profiles_since(
+                mesh_seq0, query=qname)
+            self._last_mesh_fallbacks = obs.mesh_profile.fallbacks_since(
+                mesh_seq0, query=qname)
+            # honesty: records evicted from the bounded profiler rings
+            # inside this query's window (exchange-heavy / concurrent
+            # load) are COUNTED, not silently missing from the bundle
+            self._last_mesh_dropped = obs.mesh_profile.window_dropped(
+                mesh_seq0)
             if qroot is not None:
                 self._finish_query_profile(qroot, conf, opjit_before)
             else:
@@ -1242,7 +1262,10 @@ class TpuSession:
             metrics=self._last_metrics_snapshot,
             sync_ledger=self._last_sync_ledger,
             dispatch_delta=disp_delta,
-            task_metrics=self._last_task_metrics)
+            task_metrics=self._last_task_metrics,
+            mesh_profiles=getattr(self, "_last_mesh_profiles", None),
+            mesh_fallbacks=getattr(self, "_last_mesh_fallbacks", None),
+            mesh_dropped=getattr(self, "_last_mesh_dropped", 0))
         out_dir = conf.get(TRACE_DIR)
         if out_dir and str(out_dir) != "None":
             try:

@@ -408,7 +408,16 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         return self.children[0].output
 
     def node_desc(self) -> str:
-        return f"TpuShuffleExchange[{self.partitioning}, n={self._n_out}]"
+        base = f"TpuShuffleExchange[{self.partitioning}, n={self._n_out}"
+        # "why not collective" surfaced where the plan is read
+        # (explain("metrics"), the bundle's plan tree): a mesh-session
+        # exchange that rode the per-map path says why — MULTICHIP_r06's
+        # q1 showed `collective_launches: 0` with the reason buried in a
+        # code comment (obs/mesh_profile.py)
+        reason = getattr(self, "_collective_reason", None)
+        if reason and not getattr(self, "_collective", False):
+            return f"{base}, per_map={reason}]"
+        return base + "]"
 
     def additional_metrics(self):
         return {"partitionTime": "MODERATE", "serializationTime": "MODERATE",
@@ -419,30 +428,42 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         Plan-time selection (plan/overrides.py sets `collective_planned`
         when a mesh session is active) covers hash AND single
         partitionings; un-planned exchanges (hand-assembled plans, tests)
-        keep the dynamic hash-only eligibility check."""
+        keep the dynamic hash-only eligibility check. Every decline
+        records its reason on the node (the plan-time reason from
+        overrides.py is kept unless a runtime check finds a different
+        cause)."""
         if self._shuffle_mode(ctx) != "ICI":
-            return None
-        from ..config import MESH_COLLECTIVE_ENABLED
-        if not ctx.conf.get(MESH_COLLECTIVE_ENABLED):
             return None
         from ..parallel.mesh import (MeshContext, mesh_eligible_output,
                                      mesh_session_active)
-        if not mesh_eligible_output(self.output):
+        # reasons are only meaningful inside a mesh session — a plain ICI
+        # session's per-map exchanges are not "fallbacks" from anything
+        in_mesh_session = mesh_session_active(ctx.conf) is not None
+
+        def decline(reason: str):
+            if in_mesh_session:
+                self._collective_reason = reason
             return None
+
+        from ..config import MESH_COLLECTIVE_ENABLED
+        if not ctx.conf.get(MESH_COLLECTIVE_ENABLED):
+            return decline("collective_conf_off")
+        if not mesh_eligible_output(self.output):
+            return decline("string_or_nested_payload")
         if getattr(self, "collective_planned", False):
             mesh = mesh_session_active(ctx.conf)
         elif self.partitioning == "hash":
             mesh = MeshContext.get(ctx.conf, self._n_out)
         else:
-            return None
+            return decline(f"partitioning_{self.partitioning}")
         if mesh is None:
-            return None
+            return decline("mesh_unavailable")
         # hash routing computes murmur3 % n_shards on-device: the reduce
         # partition count must equal the mesh size exactly (the planner's
         # alignPartitions pass guarantees this for mesh sessions)
         if self.partitioning == "hash" \
                 and mesh.devices.size != self._n_out:
-            return None
+            return decline("partitions_misaligned")
         return mesh
 
     def _try_materialize_collective(self, sid: int, ctx: TaskContext) -> bool:
@@ -463,6 +484,13 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         self._collective = False
         mesh = self._collective_mesh(ctx)
         if mesh is None:
+            reason = getattr(self, "_collective_reason", None)
+            if reason:
+                # mesh-session exchange routed per-map: count the reason
+                # (mesh.per_map_exchange{reason}) for the multichip
+                # summary / explain("metrics") — obs/mesh_profile.py
+                from ..obs import mesh_profile as _mprof
+                _mprof.record_fallback(sid, reason)
             return False
         from ..columnar.batch import concat_batches
         from ..failure import with_device_retry
@@ -491,6 +519,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 self._collective = True
                 self._collective_rows = [0] * self._n_out
                 self._collective_sizes = [0] * self._n_out
+                self._collective_seq = None
                 return True
 
             def run_collective():
@@ -521,6 +550,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             # memory pressure while staging the collective: the per-map path
             # has the full incremental-spill discipline; drop any partial
             # state for this shuffle id and let the caller run per-map
+            self._collective_reason = "staging_oom"
+            from ..obs import mesh_profile as _mprof
+            _mprof.record_fallback(sid, "staging_oom")
             IciShuffleCatalog.get().cleanup(sid)
             return False
         finally:
@@ -539,6 +571,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         # these without fetching (or unspilling) a single block
         self._collective_rows = list(result.rows[: self._n_out])
         self._collective_sizes = list(result.bytes[: self._n_out])
+        # profile seq: the consumer read's flow event references it so the
+        # Chrome export ties producer exchange → consumer read
+        self._collective_seq = (result.profile or {}).get("seq")
         return True
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
@@ -807,6 +842,14 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             catalog = IciShuffleCatalog.get()
             mgr = TpuShuffleManager.get(ctx.conf)
             self._chaos_lost_shard(idx, catalog)
+            if obs._ACTIVE and getattr(self, "_collective", False) \
+                    and getattr(self, "_collective_seq", None) is not None:
+                # consumer side of the producer→consumer flow: the Chrome
+                # export ties this read back to the collective exchange
+                # that produced the block (flow id = the profile seq)
+                obs.event("mesh.read", cat="shuffle",
+                          exchange_seq=self._collective_seq,
+                          shuffle=self._shuffle_id, reduce=idx)
             blocks = self._ici_fetch_blocks(
                 idx, ctx, mgr, catalog,
                 metric=self.metrics["deserializationTime"])

@@ -428,6 +428,62 @@ def test_bench_diff_gates_regressions_including_zero_endpoints():
     assert not reg and unch
 
 
+def test_bench_diff_multichip_payloads():
+    """tools/bench_diff.py MULTICHIP awareness (ISSUE 13): the stub r05
+    round (no parsed payload) exits 2 instead of reporting "ok";
+    scaling_efficiency / per_chip_rows_per_s gate higher-is-better and
+    the mesh profiler's phase walls gate LOWER-is-better by default —
+    no --include-overhead needed."""
+    import copy
+    from tools.bench_diff import diff, extract_metrics, load_parsed, main
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r05 = os.path.join(root, "MULTICHIP_r05.json")
+    r06 = os.path.join(root, "MULTICHIP_r06.json")
+    # r05 is the stub round: a driver record without a parsed summary
+    # must be an explicit failure (exit 2), never a silent "no metrics"
+    with pytest.raises(ValueError):
+        load_parsed(r05)
+    assert main([r05, r06]) == 2
+    old = load_parsed(r06)
+    assert old["metric"] == "multichip_sharded_execution"
+    # identical rounds diff clean
+    assert main([r06, r06]) == 0
+    # a degraded copy: efficiency halved, per-chip throughput halved
+    new = copy.deepcopy(old)
+    new["queries"]["tpch_q3"]["scaling_efficiency"] /= 2
+    new["queries"]["tpch_q3"]["per_chip_rows_per_s"] /= 2
+    reg, _imp, _unch, _, _ = diff(old, new, 0.10)
+    assert {r[0] for r in reg} == {
+        "queries.tpch_q3.scaling_efficiency",
+        "queries.tpch_q3.per_chip_rows_per_s"}
+    # phase walls (r07+ schema): lower-is-better BY DEFAULT for
+    # multichip payloads — a wall growing 50% regresses, one shrinking
+    # improves
+    o7 = {"metric": "multichip_sharded_execution",
+          "queries": {"q": {"per_chip_rows_per_s": 100.0,
+                            "phases_ms": {"staging": 10.0, "launch": 4.0,
+                                          "collective_wait": 20.0,
+                                          "compact": 2.0}}},
+          "collective_phases_ms_total": 36.0}
+    n7 = copy.deepcopy(o7)
+    n7["queries"]["q"]["phases_ms"]["collective_wait"] = 30.0
+    n7["queries"]["q"]["phases_ms"]["compact"] = 1.0
+    reg, imp, _u, _, _ = diff(o7, n7, 0.10)
+    assert [r[0] for r in reg] == [
+        "queries.q.phases_ms.collective_wait"]
+    assert [r[0] for r in imp] == ["queries.q.phases_ms.compact"]
+    # phase walls are NOT gated for non-multichip payloads without the
+    # overhead opt-in
+    plain = {"summary": {"phases_ms": {"staging": 10.0}}}
+    assert extract_metrics(plain) == {}
+    # r06 (per-query collective_ms) vs an r07-schema payload: renamed
+    # keys report as only-old/only-new, never a spurious regression
+    reg, _i, _u, only_old, only_new = diff(old, o7, 0.10)
+    assert not reg
+    assert any(k.endswith(".collective_ms") for k in only_old)
+    assert any(k.endswith(".collective_wait") for k in only_new)
+
+
 def test_flight_ring_is_bounded_and_ordered():
     for i in range(2000):
         obs_flight.note("flood", i=i)

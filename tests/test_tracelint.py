@@ -295,8 +295,54 @@ def test_tl012_metrics_and_flight_emission_true_positive_and_near_miss():
     assert lint_obs_module(nm, "memory/x.py") == []
 
 
+def test_tl012_mesh_profiler_coverage():
+    """TL012 extension (ISSUE 13): obs/mesh_profile.py is itself an
+    emitter and its emission sites are covered — including the package-
+    relative ``from . import metrics`` binding obs-internal modules use —
+    and the mesh-profiler record helpers (record_exchange /
+    record_fallback) are emission entry points wherever they are
+    called from."""
+    from spark_rapids_tpu.analysis import lint_obs_module
+    from spark_rapids_tpu.analysis.astwalk import iter_module_sources
+    from spark_rapids_tpu.analysis.obslint import OBS_MODULES
+    # the module walk the tree lint uses actually covers the file
+    covered = [rel for rel, _src in iter_module_sources(
+        None, (), modules=OBS_MODULES)]
+    assert "obs/mesh_profile.py" in covered
+    tp = textwrap.dedent("""\
+        import jax.numpy as jnp
+        from . import metrics
+        def f(recv):
+            metrics.histogram_observe("mesh.skew_imbalance",
+                                      int(jnp.max(recv)))
+        """)
+    findings = lint_obs_module(tp, "obs/mesh_profile.py")
+    assert [f.location for f in findings] == ["obs/mesh_profile.py::f"]
+    assert findings[0].rule == "TL012"
+    tp2 = textwrap.dedent("""\
+        import jax.numpy as jnp
+        from ..obs import mesh_profile
+        def g(sid, rows):
+            mesh_profile.record_exchange(
+                1, sid, "hash", 8, send_rows=[int(jnp.sum(rows))],
+                recv_rows=[0], recv_bytes=[0], stage_ns=0, launch_ns=0,
+                wait_ns=0, compact_ns=0)
+        """)
+    findings = lint_obs_module(tp2, "shuffle/x.py")
+    assert [f.location for f in findings] == ["shuffle/x.py::g"]
+    nm = textwrap.dedent("""\
+        from . import metrics
+        from ..obs import mesh_profile
+        def f(imbalance, sid, reason):
+            metrics.histogram_observe("mesh.skew_imbalance", imbalance)
+            mesh_profile.record_fallback(sid, reason)
+        """)
+    assert lint_obs_module(nm, "obs/mesh_profile.py") == []
+
+
 def test_tl012_real_tree_emission_clean():
-    """The shipped execs//shuffle//memory/ instrumentation routes through
+    """The shipped execs//shuffle//memory/ instrumentation — plus
+    obs/mesh_profile.py's own emission sites (ISSUE 13) — routes through
     the obs API with no blocking syncs in event args — the TL012 baseline
     stays EMPTY (the ISSUE 8 bar)."""
     from spark_rapids_tpu.analysis import lint_obs_tree

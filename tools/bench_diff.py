@@ -19,9 +19,20 @@ non-zero, so a driver round gates automatically against the previous one:
     python -m tools.bench_diff MULTICHIP_r05.json MULTICHIP_r06.json \\
         || echo "throughput regressed — investigate before landing r06"
 
+MULTICHIP payloads (``metric == "multichip_sharded_execution"``) are
+understood explicitly: ``scaling_efficiency`` and ``per_chip_rows_per_s``
+are higher-is-better gates like any throughput key, and the collective
+PHASE WALLS from the mesh efficiency profiler (``phases_ms.staging`` /
+``launch`` / ``collective_wait`` / ``compact``, plus
+``collective_ms(_total)``) gate LOWER-is-better by default — no
+``--include-overhead`` needed, because for a data plane whose efficiency
+problem IS unattributed wall, a phase wall growing 10% is exactly the
+regression the profiler exists to catch.
+
 Keys present in only one round (new stages, skipped stages) are reported
-but never fail the diff; a round whose ``parsed`` payload is null (the
-bench crashed before its summary line) exits 2 with a clear message.
+but never fail the diff; a round whose ``parsed`` payload is null or
+missing (the bench crashed before its summary line — e.g. the stub
+MULTICHIP_r05 round) exits 2 with a clear message.
 Workflow: docs/observability.md "Comparing bench rounds".
 """
 
@@ -35,6 +46,19 @@ _HIGHER_RE = re.compile(
     r"(rows_per_s|rows_s|Mrows_s|speedup|scaling_efficiency|hit_rate)$")
 #: overhead keys (opt-in): LOWER is better
 _LOWER_RE = re.compile(r"(dispatch_overhead_ms|collective_ms(_total)?)$")
+#: MULTICHIP phase walls (mesh efficiency profiler): LOWER is better,
+#: gated by DEFAULT for multichip payloads. collective_ms(_total) is the
+#: r06-era schema; collective_phases_ms_total is its r07+ replacement
+#: (wider composition: +compact — renamed so cross-era diffs report
+#: only-old/only-new instead of a spurious regression)
+_MULTICHIP_LOWER_RE = re.compile(
+    r"(phases_ms\.(staging|launch|collective_wait|compact)"
+    r"|collective_ms(_total)?|collective_phases_ms_total)$")
+
+
+def is_multichip(parsed) -> bool:
+    return isinstance(parsed, dict) \
+        and parsed.get("metric") == "multichip_sharded_execution"
 
 
 def _walk(obj, prefix=""):
@@ -47,11 +71,15 @@ def _walk(obj, prefix=""):
 
 def extract_metrics(parsed, include_overhead=False):
     """{dotted_key: (value, higher_is_better)} for every comparable
-    throughput metric in a parsed bench payload."""
+    throughput metric in a parsed bench payload. MULTICHIP payloads gate
+    their collective phase walls lower-is-better by default."""
+    multichip = is_multichip(parsed)
     out = {}
     for path, v in _walk(parsed):
         if _HIGHER_RE.search(path):
             out[path] = (v, True)
+        elif multichip and _MULTICHIP_LOWER_RE.search(path):
+            out[path] = (v, False)
         elif include_overhead and _LOWER_RE.search(path):
             out[path] = (v, False)
     return out
@@ -60,9 +88,16 @@ def extract_metrics(parsed, include_overhead=False):
 def load_parsed(path):
     with open(path) as f:
         doc = json.load(f)
-    # driver records wrap the summary under "parsed"; accept a bare
-    # summary object too (e.g. a locally captured final line)
-    parsed = doc.get("parsed", doc) if isinstance(doc, dict) else None
+    parsed = None
+    if isinstance(doc, dict):
+        if "parsed" in doc or "tail" in doc or "rc" in doc:
+            # a driver round record: the summary MUST be under "parsed" —
+            # falling back to the wrapper would diff rc/n_devices and
+            # silently report a crashed round as "no regressions"
+            parsed = doc.get("parsed")
+        else:
+            # a bare summary object (e.g. a locally captured final line)
+            parsed = doc
     if not isinstance(parsed, dict):
         raise ValueError(
             f"{path}: no parsed bench payload (the round's final summary "

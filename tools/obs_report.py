@@ -4,10 +4,15 @@ observability plane.
 Usage:
     python -m tools.obs_report                # human-readable snapshot
     python -m tools.obs_report --json         # raw JSON (dashboards/diffing)
+    python -m tools.obs_report --mesh         # + the mesh section: collective
+                                              # stats, recent per-exchange
+                                              # profiles (phase walls + skew),
+                                              # per-map fallback reasons
     python -m tools.obs_report --self-check   # exercise registry + flight
                                               # recorder + concurrent tracer
-                                              # wiring; exit non-zero on any
-                                              # broken invariant (CI fast tier)
+                                              # + mesh profiler wiring; exit
+                                              # non-zero on any broken
+                                              # invariant (CI fast tier)
 
 The snapshot is ``spark_rapids_tpu.obs.metrics.full_snapshot()`` — the same
 payload ``session.metrics_snapshot()`` serves: registry counters/gauges/
@@ -23,6 +28,53 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _render_mesh(snap: dict) -> str:
+    """The --mesh section: collective launch stats, the recent
+    per-exchange profiles (phase walls + skew table + straggler), and the
+    per-map fallback reasons — everything the mesh efficiency profiler
+    keeps (docs/observability.md "Mesh profiling")."""
+    lines = ["", "## mesh (collective data plane)"]
+    ext = snap.get("external", {})
+    col = ext.get("collective", {}) or {}
+    if col and "error" not in col:
+        lines.append(
+            f"  collectives: launches={col.get('launches', 0)} "
+            f"rows={col.get('rows_sent', 0)} "
+            f"stage={col.get('stage_ns', 0) / 1e6:.1f}ms "
+            f"launch={col.get('launch_ns', 0) / 1e6:.1f}ms "
+            f"wait={col.get('wait_ns', 0) / 1e6:.1f}ms "
+            f"compact={col.get('compact_ns', 0) / 1e6:.1f}ms")
+    mp = ext.get("mesh_profiles", {}) or {}
+    reasons = mp.get("per_map_reasons") or {}
+    if reasons:
+        lines.append("  per-map exchanges (why not collective): "
+                     + ", ".join(f"{k}={v}"
+                                 for k, v in sorted(reasons.items())))
+    recents = mp.get("recent_exchanges") or []
+    if not recents:
+        lines.append("  no collective exchanges recorded")
+    for p in recents:
+        ph = p.get("phases_ms", {})
+        sk = p.get("skew", {})
+        strag = sk.get("straggler_chip")
+        lines.append(
+            f"  exchange s{p.get('exchange')} seq={p.get('seq')} "
+            f"[{p.get('partitioning')}, n_dev={p.get('n_dev')}] "
+            f"query={p.get('query') or '-'}"
+            + (" WATCHDOG" if p.get("watchdog_fired") else ""))
+        lines.append(
+            f"    phases_ms: staging={ph.get('staging')} "
+            f"launch={ph.get('launch')} "
+            f"wait={ph.get('collective_wait')} "
+            f"compact={ph.get('compact')}")
+        lines.append(
+            f"    skew: imbalance={sk.get('imbalance')} "
+            f"max={sk.get('max_rows')} median={sk.get('median_rows')}"
+            + (f" straggler=chip{strag}" if strag is not None else ""))
+        lines.append(f"    recv_rows: {p.get('recv_rows')}")
+    return "\n".join(lines)
 
 
 def _render(snap: dict) -> str:
@@ -153,6 +205,59 @@ def _self_check() -> int:
     if tr1 is not None:
         obs_tracer.end_query(tr1)
 
+    # mesh efficiency profiler: skew math, profile recording, registry
+    # histograms, fallback reasons, the watchdog timer, and the --mesh
+    # rendering over the resulting snapshot
+    from spark_rapids_tpu.obs import mesh_profile
+    mesh_profile.reset_for_tests()
+    seq = mesh_profile.alloc_seq()
+    prof = mesh_profile.record_exchange(
+        seq, shuffle_id=7, partitioning="hash", n_dev=4,
+        send_rows=[100, 100, 100, 100], recv_rows=[370, 10, 10, 10],
+        recv_bytes=[3700, 100, 100, 100], stage_ns=2_000_000,
+        launch_ns=1_000_000, wait_ns=4_000_000, compact_ns=500_000)
+    check("mesh profile records phase walls",
+          prof is not None
+          and prof["phases_ms"]["collective_wait"] == 4.0, str(prof))
+    check("skew report names the heavy chip",
+          prof["skew"]["straggler_chip"] == 0
+          and prof["skew"]["imbalance"] > 2.0, str(prof["skew"]))
+    mesh_profile.record_fallback(8, "string_or_nested_payload")
+    snap = metrics.MetricsRegistry.get().snapshot()
+    check("mesh.skew_imbalance histogram populated",
+          any(c.get("count")
+              for c in snap["histograms"].get("mesh.skew_imbalance",
+                                              {}).values()))
+    check("mesh.straggler_wait_ms histogram populated",
+          any(c.get("count")
+              for c in snap["histograms"].get("mesh.straggler_wait_ms",
+                                              {}).values()))
+    check("per-map fallback reason counted",
+          mesh_profile.fallback_counts()
+          .get("string_or_nested_payload") == 1)
+    import time as _time
+    wd_holder = {}
+    # arm with an explicitly tiny threshold through maybe_configure
+    from spark_rapids_tpu.config import RapidsConf
+    mesh_profile.maybe_configure(RapidsConf({
+        "spark.rapids.tpu.obs.collectiveWatchdogMs": "5"}))
+    with mesh_profile.collective_watchdog(9, 4) as wd:
+        _time.sleep(0.08)
+        wd_holder["fired"] = wd.fired
+    snap = metrics.MetricsRegistry.get().snapshot()
+    fired = snap["counters"].get("mesh.watchdog_fired", {})
+    check("collective watchdog trips while the wait is blocked",
+          wd_holder.get("fired") and sum(fired.values()) >= 1,
+          str(fired))
+    check("watchdog note lands in the flight ring",
+          any(r.get("event") == "mesh.watchdog"
+              for r in flight.snapshot()))
+    mesh_render = _render_mesh(metrics.full_snapshot())
+    check("--mesh rendering shows the exchange + straggler",
+          "exchange s7" in mesh_render and "straggler=chip0" in mesh_render,
+          mesh_render[:200])
+    mesh_profile.reset_for_tests()
+
     # flight recorder: notes land in the ring and in a postmortem bundle
     flight.note("selfcheck.note", value=42)
     pm = flight.build_postmortem("selfcheck", RuntimeError("boom"),
@@ -181,6 +286,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="obs_report", description=__doc__)
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the human rendering")
+    ap.add_argument("--mesh", action="store_true",
+                    help="append the mesh section (collective stats, "
+                         "recent per-exchange profiles, fallback reasons)")
     ap.add_argument("--self-check", action="store_true",
                     help="exercise the observability plane; exit non-zero "
                          "on a broken invariant")
@@ -189,8 +297,13 @@ def main(argv=None) -> int:
         return _self_check()
     from spark_rapids_tpu.obs import metrics
     snap = metrics.full_snapshot()
-    print(json.dumps(snap, indent=2, default=str) if args.json
-          else _render(snap))
+    if args.json:
+        print(json.dumps(snap, indent=2, default=str))
+    else:
+        out = _render(snap)
+        if args.mesh:
+            out += "\n" + _render_mesh(snap)
+        print(out)
     return 0
 
 
