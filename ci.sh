@@ -69,7 +69,7 @@ python -m pytest \
   tests/test_obs_serving.py \
   tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
-  tests/test_mesh_profile.py \
+  tests/test_mesh_profile.py tests/test_query_lifecycle.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="
@@ -77,7 +77,12 @@ echo "== chaos tier (fixed-seed fault injection) =="
 # across several fixed seeds; representative queries must stay bit-identical
 # to a clean run with zero leaks and all semaphore permits returned, and
 # corrupted/truncated shuffle blocks must heal via lineage recompute.
+# The query-lifecycle soak rides here too: N=4 concurrent sessions ×
+# mixed queries under seeded chaos (incl. the sched.admit and
+# query.cancel sites), bit-identical to single-session runs with zero
+# permit/HBM leaks and per-session bundles that reconcile.
 python -m pytest tests/test_chaos.py \
+  'tests/test_query_lifecycle.py::test_concurrent_session_soak_bit_identical_zero_leaks' \
   -x -q -m 'not slow' -p no:cacheprovider
 
 if [[ "${CI_FULL:-0}" != "1" && "${SRT_FULL:-0}" != "1" ]]; then

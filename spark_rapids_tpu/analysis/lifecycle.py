@@ -67,7 +67,7 @@ from .registry_check import Finding
 
 #: packages/modules the lint covers (relative to the spark_rapids_tpu root)
 LIFECYCLE_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory",
-                                          "parallel", "io")
+                                          "parallel", "io", "serving")
 LIFECYCLE_MODULES: Tuple[str, ...] = ("session.py", "filecache.py")
 
 #: constructor / factory names that ACQUIRE a resource, -> (kind, releases)
@@ -80,6 +80,11 @@ RESOURCE_CTORS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ThreadPoolExecutor": ("thread-pool", ("shutdown",)),
     "prefetch_iterator": ("prefetch", ("close",)),
     "begin_query": ("query-trace", ()),  # released via end_query(name)
+    # a QueryContext registers itself in the scheduler's session index at
+    # submit time — leaked unclosed, session.cancel()/stop() and the
+    # postmortem's queued/running listing would name it forever
+    # (serving/query_context.py; close is idempotent)
+    "QueryContext": ("query-ctx", ("close",)),
 }
 
 #: attribute-call acquirers (receiver-independent): x.range_reader(...)
@@ -150,6 +155,12 @@ WIRED_CALLS: Dict[str, str] = {
     # bare `.read` is far too generic a name to waive a whole scope on —
     # only the distinctive entry points are wired)
     "read_range": "scan.read",
+    # query lifecycle (serving/): submission runs under the scheduler's
+    # admission site, and every cooperative checkpoint doubles as the
+    # `query.cancel` chaos site — the cancellation unwind paths TL020
+    # proves ARE exercisable
+    "submit_and_run": "sched.admit",
+    "checkpoint": "query.cancel",
 }
 
 

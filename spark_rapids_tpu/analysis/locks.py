@@ -45,7 +45,8 @@ from .registry_check import Finding
 
 #: packages/modules the lint covers
 LOCKS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory",
-                                      "parallel", "io", "chaos", "obs")
+                                      "parallel", "io", "chaos", "obs",
+                                      "serving")
 LOCKS_MODULES: Tuple[str, ...] = ("session.py", "filecache.py",
                                   "profiling.py", "failure.py")
 
@@ -88,6 +89,13 @@ LOCK_ORDER: Tuple[Tuple[str, ...], ...] = (
     # the registry structure lock (L5) while held, so an interleaved
     # begin/end pair can never publish a stale count
     ("_QL_LOCK",),
+    # L4c — the query scheduler's admission lock (serving/scheduler.py):
+    # same discipline as _QL_LOCK — the queue-depth gauge commits into
+    # the registry structure lock (L5) under it; grant WAITS happen on
+    # per-ticket events OUTSIDE it, chaos/flight emission after release.
+    # QueryContext._mu needs no entry: it falls through to the generic
+    # `_mu` leaf level (state flips only, emission outside the lock).
+    ("QueryScheduler._mu", "QueryScheduler._cls_lock"),
     # L5 — state/stats/program-cache leaf locks: short critical sections
     # that publish precomputed values (_REG_LOCK: the obs tracer registry
     # + metrics-registry structure locks)

@@ -43,11 +43,12 @@ ALL_SITES = (
     "hbm.alloc", "spill.to_host", "spill.to_disk", "device.dispatch",
     "shuffle.serialize", "shuffle.write", "shuffle.read", "ici.fetch",
     "pipeline.task", "scan.read", "mesh.shard", "mesh.link",
+    "sched.admit", "query.cancel",
 )
 
 ALL_KINDS = (
     "retry_oom", "split_oom", "transient", "fatal", "corrupt", "truncate",
-    "io_error", "latency",
+    "io_error", "latency", "cancel",
 )
 
 #: which fault kinds make sense at each site. `inject` draws from the
@@ -73,6 +74,15 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     # with_device_retry re-running the idempotent staging)
     "mesh.shard": ("io_error", "latency"),
     "mesh.link": ("transient", "latency"),
+    # query lifecycle (docs/robustness.md "Query lifecycle"): the
+    # scheduler's admission point (latency = queue delay; io_error = a
+    # failed admission — the query dies QUEUED, before any resource is
+    # acquired) and the cooperative cancellation checkpoints (cancel =
+    # the bound query's cancel token arms AT this exact boundary, racing
+    # a user cancel against every task boundary in the stack; latency =
+    # a slow checkpoint)
+    "sched.admit": ("latency", "io_error"),
+    "query.cancel": ("cancel", "latency"),
 }
 
 _BYTE_KINDS = ("corrupt", "truncate")
@@ -161,6 +171,10 @@ class FaultInjector:
         self._trace: List[_Record] = []
         self._injected = 0
         self._forced: Dict[Tuple[str, str], int] = {}
+        # checks to SKIP before a forced counter starts firing: lets a
+        # test land a fault at exactly the k-th visit of a site (the
+        # cancel-at-every-boundary sweep in test_resource_lifecycle.py)
+        self._forced_skip: Dict[Tuple[str, str], int] = {}
         # read un-locked on the hot path; flipped under the lock
         self._armed = self.enabled
 
@@ -211,13 +225,17 @@ class FaultInjector:
             return cls._instance
 
     # --- test hooks (reference RmmSpark.forceRetryOOM) ---------------------
-    def force(self, site: str, kind: str, n: int = 1) -> None:
+    def force(self, site: str, kind: str, n: int = 1,
+              skip: int = 0) -> None:
         """Arm `n` deterministic one-shot faults at `site` (SET, not add —
-        the RmmSpark.forceRetryOOM counter semantics)."""
+        the RmmSpark.forceRetryOOM counter semantics). `skip` lets the
+        first `skip` checks of the site pass clean first, so a test can
+        land the fault at exactly the k-th boundary visit."""
         if site not in ALL_SITES or kind not in ALL_KINDS:
             raise ValueError(f"unknown chaos site/kind {site!r}/{kind!r}")
         with self._mu:
             self._forced[(site, kind)] = int(n)
+            self._forced_skip[(site, kind)] = int(skip)
             self._armed = self.enabled or any(
                 v > 0 for v in self._forced.values())
 
@@ -229,6 +247,7 @@ class FaultInjector:
             for key in list(self._forced):
                 if site is None or key[0] == site:
                     del self._forced[key]
+                    self._forced_skip.pop(key, None)
             self._armed = self.enabled or any(
                 v > 0 for v in self._forced.values())
 
@@ -261,13 +280,18 @@ class FaultInjector:
     def _pop_forced(self, site: str, wanted: Tuple[str, ...]
                     ) -> Optional[str]:
         # split before retry mirrors the old HbmBudget counter precedence
-        order = ("split_oom", "retry_oom", "transient", "fatal", "corrupt",
-                 "truncate", "io_error", "latency")
+        order = ("cancel", "split_oom", "retry_oom", "transient", "fatal",
+                 "corrupt", "truncate", "io_error", "latency")
         for kind in order:
             if kind not in wanted:
                 continue
             n = self._forced.get((site, kind), 0)
             if n > 0:
+                sk = self._forced_skip.get((site, kind), 0)
+                if sk > 0:  # this kind passes the visit clean; other
+                    # forced kinds at the site still get their turn
+                    self._forced_skip[(site, kind)] = sk - 1
+                    continue
                 self._forced[(site, kind)] = n - 1
                 self._armed = self.enabled or any(
                     v > 0 for v in self._forced.values())
@@ -390,6 +414,19 @@ class FaultInjector:
                 f"INTERNAL: chaos-injected fatal device error at {site}")
         if kind == "io_error":
             raise OSError(f"chaos-injected io error at {site}")
+        if kind == "cancel":
+            # query-lifecycle chaos (docs/robustness.md "Query
+            # lifecycle"): arm the bound query's cancel token — so every
+            # OTHER thread serving the query trips at its next checkpoint
+            # too, exactly like a user cancel — then raise here, at this
+            # boundary
+            from ..serving.query_context import (QueryCancelledError,
+                                                 current)
+            q = current()
+            if q is not None:
+                q.cancel(reason=f"chaos at {site}")
+            raise QueryCancelledError(
+                f"chaos-injected cancel at {site}")
         raise AssertionError(f"unhandled chaos kind {kind}")
 
 

@@ -430,6 +430,37 @@ checksums whose mismatch triggers lineage re-materialization
 validated under the seeded chaos fault injector
 (`spark.rapids.tpu.test.chaos.*`). The unified story — sites, fault kinds,
 and recovery paths — is in docs/robustness.md.
+
+## Query lifecycle & multi-tenant scheduling
+
+Every query submits through the process-wide scheduler service
+(serving/scheduler.py — many session frontends, one device owner):
+
+* **Admission control.** A submission enters a bounded FIFO queue
+  (`spark.rapids.tpu.sched.maxQueuedQueries`) drained round-robin across
+  sessions; it is admitted when a concurrency slot is free
+  (`spark.rapids.tpu.sched.maxConcurrentQueries`) and HBM usage is under
+  `spark.rapids.tpu.sched.hbmAdmissionWatermark` × budget (waived when
+  nothing is running). Past the queue bound, submission fails fast with
+  the typed `QueryQueueFull` backpressure error — load sheds at the
+  front door instead of stacking working sets until HBM pressure OOMs
+  every query on the device.
+* **Deadlines & cancellation.** Each query carries a cancel token and an
+  optional deadline (`spark.rapids.tpu.query.timeoutMs`,
+  `df.collect(timeout=seconds)`, `session.cancel()`). Cancellation is
+  cooperative: checkpoints at every task boundary (partition-task start,
+  batch pull, exchange map task, reduce fetch, mesh collective launch,
+  UDF worker round-trip) observe the token and unwind through the
+  TL020-audited release paths, so a cancelled or timed-out query returns
+  ALL permits, HBM, spill files and its tracer to baseline.
+* **Fault isolation.** A fatal device error (or an exhausted per-query
+  retry budget, `spark.rapids.tpu.query.retryBudget`) fails that query
+  alone: with concurrent queries in flight the process is NOT exited —
+  the failure is quarantined (postmortem dump + `query.quarantined`
+  counter) and healthy neighbors run to completion.
+
+State machine, cancellation semantics, and the fault-isolation matrix:
+docs/robustness.md "Query lifecycle".
 """
 
 REGISTRY = ConfRegistry()
@@ -1122,6 +1153,55 @@ DEVICE_RETRY_BACKOFF_MAX_MS = _conf(
     "spark.rapids.tpu.deviceRetry.backoffMaxMs").doc(
     "Upper bound on a single transient-retry backoff sleep."
 ).double(2000.0)
+
+# ---------------------------------------------------------------------------
+# Query lifecycle & multi-tenant scheduler (docs/robustness.md "Query
+# lifecycle"; serving/scheduler.py — the GpuSemaphore-admission analogue
+# lifted from per-task to per-query, SURVEY §2.4/§7)
+# ---------------------------------------------------------------------------
+QUERY_TIMEOUT_MS = _conf("spark.rapids.tpu.query.timeoutMs").doc(
+    "Default per-query deadline in milliseconds (0 disables). A query "
+    "past its deadline is cancelled COOPERATIVELY: the next checkpoint "
+    "(partition-task start, batch pull, exchange map task / reduce "
+    "fetch, mesh collective launch, UDF worker round-trip) raises "
+    "QueryDeadlineExceeded and the unwind releases every permit, HBM "
+    "byte, spill file and the query's tracer. df.collect(timeout=seconds)"
+    " overrides it per call; session.cancel() cancels without a deadline."
+).commonly_used().integer(0)
+
+QUERY_RETRY_BUDGET = _conf("spark.rapids.tpu.query.retryBudget").doc(
+    "Total TRANSIENT device-error retries one query may consume across "
+    "all of its tasks (each site's attempts stay bounded by "
+    "spark.rapids.tpu.deviceRetry.maxAttempts). Past the budget the next "
+    "transient error fails that query alone — a flapping query cannot "
+    "sit in retry/backoff loops holding the shared pool's permits while "
+    "healthy queries queue behind it."
+).integer(64)
+
+SCHED_MAX_CONCURRENT = _conf(
+    "spark.rapids.tpu.sched.maxConcurrentQueries").doc(
+    "How many admitted queries may execute concurrently against the "
+    "device pool (the per-query analogue of concurrentTpuTasks: admitted "
+    "queries' tasks still contend on the TpuSemaphore). Queued "
+    "submissions past this bound wait FIFO with round-robin fairness "
+    "across sessions."
+).commonly_used().integer(8)
+
+SCHED_MAX_QUEUE = _conf("spark.rapids.tpu.sched.maxQueuedQueries").doc(
+    "Bound on the scheduler's admission queue across all sessions. A "
+    "submission past the bound is rejected immediately with the typed "
+    "QueryQueueFull backpressure error — shedding load at the front door "
+    "instead of stacking working sets until HBM pressure OOMs every "
+    "query on the device."
+).integer(64)
+
+SCHED_HBM_WATERMARK = _conf(
+    "spark.rapids.tpu.sched.hbmAdmissionWatermark").doc(
+    "Admit a queued query only while HbmBudget usage is at or below this "
+    "fraction of the budget (and a concurrency slot is free). Waived "
+    "when no query is running, so admission always makes progress even "
+    "if parked state keeps usage high."
+).double(0.9)
 
 SHUFFLE_CHECKSUM_ENABLED = _conf(
     "spark.rapids.tpu.shuffle.checksum.enabled").doc(

@@ -20,6 +20,7 @@ from typing import Dict, Iterator, List, Optional, Sequence
 
 from ..config import RapidsConf, default_conf
 from ..expressions.base import AttributeReference, EvalContext, Expression
+from ..serving.query_context import checkpoint as _cancel_checkpoint
 from ..types import StructField, StructType
 
 ESSENTIAL = "ESSENTIAL"
@@ -251,6 +252,10 @@ class TpuExec(PhysicalPlan):
             # them; row counts accumulate lazily (a deferred batch's pending
             # device count must not sync here)
             while True:
+                # cooperative cancellation (docs/robustness.md "Query
+                # lifecycle"): one thread-local read when no query
+                # lifecycle is bound — the hot loop stays hot
+                _cancel_checkpoint(name)
                 with profiling.sync_scope(name):
                     batch = next(it, None)
                 if batch is None:
@@ -260,6 +265,7 @@ class TpuExec(PhysicalPlan):
                 yield batch
             return
         while True:
+            _cancel_checkpoint(name)
             # NVTX-range analogue: each batch pull is one named scope in the
             # xprof timeline (reference NvtxWithMetrics around operator work)
             # AND one operator span in the obs query timeline — upstream

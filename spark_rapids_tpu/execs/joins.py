@@ -287,10 +287,14 @@ class TpuShuffledHashJoinExec(TpuExec):
             # so its shuffle reads/uploads/dispatches stay in THIS query's
             # record (no-op when untraced)
             obs_parent = _obs.current_span()
+            # the query lifecycle binding rides the same handoff: a
+            # cancel/deadline trips the build-side collection too
+            from ..serving import query_context as _qlc
+            qctx = _qlc.current()
 
             def collect_right():
                 try:
-                    with _obs.inherit(obs_parent):
+                    with _obs.inherit(obs_parent), _qlc.bind(qctx):
                         res["right"] = self._collect_side(self.children[1],
                                                           ctx, idx)
                 except BaseException as e:  # noqa: BLE001 — re-raised below

@@ -141,6 +141,21 @@ def with_device_retry(fn: Callable[[], T], conf=None,
                                  message=str(exc)[:120])
                     _flight.postmortem("retry_exhausted", exc, conf)
                 raise
+            # per-query retry budget (spark.rapids.tpu.query.retryBudget,
+            # docs/robustness.md "Query lifecycle"): the per-site attempt
+            # bound above caps ONE dispatch's retries; the query-wide
+            # budget caps the SUM, so a flapping query fails alone
+            # instead of cycling retry/backoff across thousands of tasks
+            # while healthy queries wait on the pool
+            from .serving.query_context import consume_retry_budget
+            if not consume_retry_budget():
+                from .obs import flight as _flight
+                from .obs import metrics as _metrics
+                _metrics.counter_inc("query.retry_budget_exhausted")
+                _flight.note("query.retry_budget_exhausted",
+                             error=type(exc).__name__,
+                             message=str(exc)[:120])
+                raise
             attempt += 1
             from .obs import flight as _flight
             from .obs import metrics as _metrics
@@ -244,8 +259,21 @@ def handle_task_failure(exc: BaseException, conf,
             path = write_diagnostic_bundle(exc, str(dump_dir))
         except Exception:  # noqa: BLE001 — never mask the original failure
             pass
+    # fault isolation (docs/robustness.md "Query lifecycle"): a fatal
+    # error with CONCURRENT queries in flight is quarantined — the
+    # postmortem above is already on disk, the failed query unwinds (its
+    # scheduler slot and resources release on the raise), and the
+    # survivors run to completion. Counted regardless of exit_on_fatal so
+    # dashboards keyed on query.quarantined see the incident either way.
+    if _metrics.active_query_count() > 1:
+        _metrics.counter_inc("query.quarantined")
+        _flight.note("query.quarantined",
+                     active=_metrics.active_query_count(),
+                     error=type(exc).__name__)
+        return path
     if exit_on_fatal:
-        # the reference exits the executor so Spark reschedules elsewhere
-        # (logGpuDebugInfoAndExit); tests pass exit_on_fatal=False
+        # single-tenant: the reference exits the executor so Spark
+        # reschedules elsewhere (logGpuDebugInfoAndExit); tests pass
+        # exit_on_fatal=False
         os._exit(1)
     return path

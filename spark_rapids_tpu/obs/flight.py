@@ -127,10 +127,21 @@ def _engine_state() -> Dict[str, Any]:
                 "spilled_to_host": c.spilled_to_host,
                 "spilled_to_disk": c.spilled_to_disk}
 
+    def _sched():
+        from ..serving.scheduler import QueryScheduler
+        s = QueryScheduler._instance
+        if s is None:
+            return {}
+        # a postmortem must NAME the queries that were queued, running
+        # or cancelling when the process died (docs/robustness.md
+        # "Query lifecycle")
+        return s.snapshot()
+
     from . import metrics as _metrics
     fold("hbm", _metrics.hbm_state)
     fold("semaphore", _sem)
     fold("spill", _spill)
+    fold("scheduler", _sched)
     return state
 
 

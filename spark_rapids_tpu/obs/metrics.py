@@ -366,6 +366,15 @@ def full_snapshot() -> Dict[str, Any]:
         return {"bytes_written": mgr.bytes_written,
                 "bytes_read": mgr.bytes_read}
 
+    def _scheduler():
+        # the query scheduler's admission state (queued/running names,
+        # limits) — docs/robustness.md "Query lifecycle"
+        from ..serving.scheduler import QueryScheduler
+        s = QueryScheduler._instance  # no side-effect instantiation
+        if s is None:
+            return {}
+        return s.snapshot()
+
     fold("opjit", _opjit)
     fold("collective", _collective)
     fold("mesh_profiles", _mesh_profiles)
@@ -373,6 +382,7 @@ def full_snapshot() -> Dict[str, Any]:
     fold("task_metrics", _task_metrics)
     fold("chaos", _chaos)
     fold("shuffle", _shuffle)
+    fold("scheduler", _scheduler)
     fold("hbm", hbm_state)
     out["external"] = ext
     return out

@@ -243,6 +243,11 @@ def mesh_hash_exchange(mesh: Mesh,
     from ..chaos import inject
     from ..execs import opjit
     from ..obs import mesh_profile as mprof
+    from ..serving.query_context import checkpoint as _cancel_checkpoint
+    # collective-launch cancellation boundary: last stop before the
+    # staging sync + fabric program — a cancelled/timed-out query never
+    # launches the collective (docs/robustness.md "Query lifecycle")
+    _cancel_checkpoint(f"mesh.collective s{shuffle_id}")
     n_dev = mesh.devices.size
     assert len(group_batches) == n_dev
     t_stage0 = time.perf_counter_ns()

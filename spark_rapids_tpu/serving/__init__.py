@@ -1,0 +1,37 @@
+"""serving: the multi-tenant query lifecycle layer (docs/robustness.md
+"Query lifecycle").
+
+Two modules implement the "columnar compute service" shape (SURVEY §7 —
+many session frontends, ONE device owner):
+
+* :mod:`.query_context` — per-query lifecycle state: a ``QueryContext``
+  carries the cancel token, the optional deadline and the per-query
+  transient-retry budget, bound to the executing thread(s) the same way
+  the sync ledger and the per-query tracer are. Cooperative cancellation
+  ``checkpoint()`` calls sit at every task boundary that already exists
+  (partition-task start in the session loop, batch pull in execs/base.py,
+  exchange map task and reduce fetch in shuffle/exchange.py, collective
+  launch in parallel/mesh.py, the UDF worker round-trip) and unwind
+  through the TL020-proven release paths, so a cancelled or timed-out
+  query returns ALL permits, HBM, spill files and its tracer to baseline.
+* :mod:`.scheduler` — the process-wide ``QueryScheduler`` (HBM admission
+  control, bounded FIFO queue with round-robin fairness across sessions,
+  typed ``QueryQueueFull`` backpressure) plus the executor service the
+  per-partition driving loop moved into from ``session.py``
+  (:func:`~.scheduler.execute_plan`).
+
+This package deliberately keeps ``query_context`` import-light (no
+engine imports at module scope): hot paths in ``execs/base.py`` import
+it at module load, and the scheduler — which does import the exec layer —
+is only pulled in lazily by the session front door.
+"""
+
+from .query_context import (QueryCancelledError, QueryContext,
+                            QueryDeadlineExceeded, QueryQueueFull, bind,
+                            checkpoint, consume_retry_budget, current)
+
+__all__ = [
+    "QueryCancelledError", "QueryContext", "QueryDeadlineExceeded",
+    "QueryQueueFull", "bind", "checkpoint", "consume_retry_budget",
+    "current",
+]

@@ -1,0 +1,236 @@
+"""Per-query lifecycle state: cancel token, deadline, retry budget.
+
+Reference analogue: Spark cancels a job group by flagging its TaskContexts
+and letting tasks observe the flag at safe points (TaskContext.isInterrupted;
+the plugin's retry framework re-checks between attempts). XLA dispatches are
+not preemptible, so cancellation here is **cooperative**: the engine checks
+:func:`checkpoint` at every pre-existing task boundary — never mid-kernel —
+and a tripped check raises :class:`QueryCancelledError` /
+:class:`QueryDeadlineExceeded`, unwinding through exactly the release paths
+the TL020 static proof covers (finally blocks, ``with`` scopes, completion
+listeners). Nothing new is released on cancellation; the point is that the
+*existing* unwind discipline runs.
+
+State machine (docs/robustness.md "Query lifecycle")::
+
+    QUEUED ──admit──► RUNNING ──ok──► FINISHED
+      │                 │ └─error───► FAILED
+      │                 └─cancel/deadline──► CANCELLING ─unwound─► CANCELLED
+      └─cancel/deadline/queue-reject while queued ────────────────► CANCELLED
+                                                    (deadline → TIMED_OUT)
+
+Thread routing follows the sync-ledger/tracer idiom: :func:`bind` attaches a
+context to the calling thread; pool handoffs (exchange map tasks, prefetch
+workers) re-bind the captured context on the worker so a cancel lands on
+every thread serving the query. An unbound thread's :func:`checkpoint` is a
+single thread-local read — the execs/base.py hot loop stays effectively
+free when no query lifecycle is in play.
+
+Errors subclass ``BaseException`` on purpose: the shuffle layer converts
+*any* ``Exception`` during a block decode into ``FetchFailedError`` and
+heals it by re-running map tasks — a cancellation must never be "healed"
+into a recompute loop, and ``failure.with_device_retry`` must never retry
+it (its transient classifier already says no, and the ``BaseException``
+ancestry keeps every generic ``except Exception`` recovery path out of the
+way). ``QueryQueueFull`` is an ordinary ``Exception``: backpressure is a
+normal, retryable client-facing condition.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+#: lifecycle states (docs/robustness.md "Query lifecycle")
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+CANCELLING = "CANCELLING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+TIMED_OUT = "TIMED_OUT"
+
+_TERMINAL = (FINISHED, FAILED, CANCELLED, TIMED_OUT)
+
+
+class QueryCancelledError(BaseException):
+    """The query's cancel token was set (user cancel, session.stop(),
+    chaos `query.cancel`). BaseException: see module docstring."""
+
+
+class QueryDeadlineExceeded(QueryCancelledError):
+    """The query ran past its deadline (spark.rapids.tpu.query.timeoutMs
+    or df.collect(timeout=...)) and was cancelled at a checkpoint."""
+
+
+class QueryQueueFull(Exception):
+    """Typed backpressure: the scheduler's bounded admission queue is full
+    (spark.rapids.tpu.sched.maxQueuedQueries). The submission was rejected
+    BEFORE any resource was acquired — resubmit later or shed load."""
+
+
+class QueryContext:
+    """One submitted query's lifecycle handle: cancel token + optional
+    deadline + per-query retry budget. Owner discipline (TL020): created
+    by the executor front door, used as a ``with`` context so the
+    scheduler registration releases on every path."""
+
+    def __init__(self, name: str, session_id: str = "default",
+                 deadline_ns: Optional[int] = None,
+                 retry_budget: int = 64):
+        self.name = name
+        self.session_id = session_id
+        #: absolute time.perf_counter_ns() deadline, or None
+        self.deadline_ns = deadline_ns
+        self.state = QUEUED
+        self.cancel_reason: Optional[str] = None
+        self._cancel = threading.Event()
+        self._mu = threading.Lock()
+        self._retry_budget = int(retry_budget)
+        self._closed = False
+
+    # --- cancellation -------------------------------------------------------
+    def cancel(self, reason: str = "user") -> None:
+        """Arm the cancel token (idempotent; first reason wins). The query
+        keeps running until its next cooperative checkpoint observes the
+        token — there is nothing safe to interrupt mid-dispatch."""
+        with self._mu:
+            if self._cancel.is_set() or self.state in _TERMINAL:
+                return
+            self.cancel_reason = reason
+            if self.state == RUNNING:
+                self.state = CANCELLING
+        self._cancel.set()
+        from ..obs import flight as _flight
+        _flight.note("query.cancelling", query=self.name,
+                     session=self.session_id, reason=reason)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def deadline_exceeded(self) -> bool:
+        return (self.deadline_ns is not None
+                and time.perf_counter_ns() >= self.deadline_ns)
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (None when no deadline)."""
+        if self.deadline_ns is None:
+            return None
+        return max(0.0, (self.deadline_ns - time.perf_counter_ns()) / 1e9)
+
+    def check(self, boundary: str = "") -> None:
+        """Raise if cancelled or past deadline — the cooperative
+        cancellation point. Deadline expiry arms the cancel token too, so
+        every other thread serving this query trips at ITS next check."""
+        if self._cancel.is_set():
+            if self.cancel_reason == "deadline":
+                raise QueryDeadlineExceeded(
+                    f"query {self.name} exceeded its deadline "
+                    f"(observed at {boundary or 'checkpoint'})")
+            raise QueryCancelledError(
+                f"query {self.name} cancelled "
+                f"({self.cancel_reason or 'unknown'}) "
+                f"at {boundary or 'checkpoint'}")
+        if self.deadline_exceeded():
+            self.cancel(reason="deadline")
+            raise QueryDeadlineExceeded(
+                f"query {self.name} exceeded its deadline at "
+                f"{boundary or 'checkpoint'}")
+
+    # --- retry budget -------------------------------------------------------
+    def consume_retry(self) -> bool:
+        """Take one unit of the per-query transient-retry budget
+        (spark.rapids.tpu.query.retryBudget). False = exhausted: the
+        caller fails THIS query instead of retrying — one flapping query
+        cannot sit in retry loops starving the shared pool."""
+        with self._mu:
+            if self._retry_budget <= 0:
+                return False
+            self._retry_budget -= 1
+            return True
+
+    # --- state machine ------------------------------------------------------
+    def mark_running(self) -> None:
+        with self._mu:
+            if self.state == QUEUED:
+                self.state = RUNNING
+
+    def finish(self, exc: Optional[BaseException] = None) -> str:
+        """Record the terminal state from the execution outcome."""
+        with self._mu:
+            if self.state in _TERMINAL:
+                return self.state
+            if exc is None:
+                self.state = FINISHED
+            elif isinstance(exc, QueryDeadlineExceeded):
+                self.state = TIMED_OUT
+            elif isinstance(exc, QueryCancelledError):
+                self.state = CANCELLED
+            else:
+                self.state = FAILED
+            return self.state
+
+    # --- ownership (TL020) --------------------------------------------------
+    def close(self) -> None:
+        """Deregister from the scheduler's active-query index (idempotent).
+        A context that dies unregistered would keep session.cancel() and
+        the postmortem's queued/running listing lying forever."""
+        if self._closed:
+            return
+        self._closed = True
+        from .scheduler import QueryScheduler
+        sched = QueryScheduler._instance
+        if sched is not None:
+            sched._deregister(self)
+
+    def __enter__(self) -> "QueryContext":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.finish(exc)
+        self.close()
+
+
+# --- thread binding (the sync-ledger idiom) ---------------------------------
+
+_TL = threading.local()
+
+
+@contextlib.contextmanager
+def bind(qctx: Optional[QueryContext]) -> Iterator[None]:
+    """Bind `qctx` to the calling thread for the scope (None = keep the
+    current binding — pool handoffs pass whatever they captured)."""
+    prev = getattr(_TL, "q", None)
+    _TL.q = qctx if qctx is not None else prev
+    try:
+        yield
+    finally:
+        _TL.q = prev
+
+
+def current() -> Optional[QueryContext]:
+    return getattr(_TL, "q", None)
+
+
+def checkpoint(boundary: str = "") -> None:
+    """The cooperative cancellation point, called at every pre-existing
+    task boundary. Unbound thread: one thread-local read, nothing else.
+    Bound: the chaos `query.cancel` site fires first (so a seeded soak can
+    race a cancellation against this exact boundary), then the context's
+    cancel/deadline check."""
+    q = getattr(_TL, "q", None)
+    if q is None:
+        return
+    from ..chaos import inject
+    inject("query.cancel", detail=boundary)
+    q.check(boundary)
+
+
+def consume_retry_budget() -> bool:
+    """failure.with_device_retry's hook: True when no query is bound (the
+    per-site attempt bound still applies) or budget remains."""
+    q = getattr(_TL, "q", None)
+    return True if q is None else q.consume_retry()

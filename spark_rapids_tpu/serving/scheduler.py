@@ -1,0 +1,657 @@
+"""The query scheduler/executor service: ONE device owner, many frontends.
+
+Reference: GpuSemaphore gates how many tasks may hold the device
+(GpuSemaphore.scala, SURVEY §2.4) and the plugin's failure hooks isolate a
+fatal task (SURVEY §3.1); SURVEY §7 prescribes the "columnar compute
+service" shape — many session frontends submitting to one device-owning
+scheduler. This module is that service for the TPU engine:
+
+* :class:`QueryScheduler` — process-wide admission control. A submitted
+  query enters a bounded FIFO queue (per session, drained round-robin so
+  one chatty session cannot starve its neighbors); past the bound the
+  submission fails FAST with the typed :class:`QueryQueueFull`
+  backpressure error instead of piling more working sets onto an
+  already-saturated device (the OOM-everyone failure mode). A queued query
+  is admitted only when a concurrency slot is free
+  (``spark.rapids.tpu.sched.maxConcurrentQueries``) AND HBM usage is under
+  the admission watermark (``spark.rapids.tpu.sched.hbmAdmissionWatermark``
+  × budget — waived when nothing is running, so admission always makes
+  progress). Execution is caller-runs: the submitting thread executes its
+  own query once admitted, so tracer/ledger/lifecycle thread bindings all
+  stay on the thread that owns them.
+* :func:`execute_plan` — the executor half of the old ``TpuSession._execute``
+  (session.py keeps session STATE; the per-partition driving loop,
+  failure handling and per-query snapshotting live here). Every query gets
+  a :class:`~.query_context.QueryContext` (cancel token + deadline + retry
+  budget) bound around its whole execution window.
+
+Lock discipline (TL021/TL022): ``QueryScheduler._mu`` is declared in
+``analysis/locks.py``'s ``LOCK_ORDER`` one level above the metrics-registry
+structure lock — the queue-depth gauge commits under it (the ``_QL_LOCK``
+idiom: an interleaved enqueue/dequeue pair must not publish a stale count)
+— and nothing blocking ever runs under it: grant waits happen on per-ticket
+events outside the lock, chaos/flight emission happens after release.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..execs.base import TaskContext
+from ..obs import flight as _flight
+from ..obs import metrics as _metrics
+from .query_context import (QueryCancelledError, QueryContext,
+                            QueryDeadlineExceeded, QueryQueueFull, bind,
+                            checkpoint)
+
+#: sessions alive in this process (weak: an abandoned, never-stopped
+#: session must not pin itself here forever). TpuSession registers at
+#: construction and discards itself in stop(); the LAST session to stop
+#: releases the process-wide shuffle manager.
+_LIVE_SESSIONS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_session(session) -> None:
+    # a new frontend re-owns the shared state: any pending release from
+    # a previous last-session stop() is obsolete (this session's stop()
+    # will re-request it)
+    global _SHARED_RELEASE_PENDING
+    _SHARED_RELEASE_PENDING = False
+    _LIVE_SESSIONS.add(session)
+
+
+def release_session(session) -> None:
+    _LIVE_SESSIONS.discard(session)
+
+
+def other_live_sessions(session) -> bool:
+    """Any session frontend OTHER than `session` still alive? Gates the
+    shared-resource teardown in TpuSession.stop()."""
+    return any(s is not session for s in _LIVE_SESSIONS)
+
+
+#: set when the last session stopped but shared state could not be
+#: released yet (a straggler query outlived stop()'s drain timeout);
+#: re-checked when queries end, so the release happens when the
+#: straggler finally finishes instead of never
+_SHARED_RELEASE_PENDING = False
+
+
+def request_shared_release() -> bool:
+    """Mark the process-wide shuffle manager for release (called by the
+    LAST session's stop()) and attempt it now. Returns True if released."""
+    global _SHARED_RELEASE_PENDING
+    _SHARED_RELEASE_PENDING = True
+    return maybe_release_shared()
+
+
+def maybe_release_shared() -> bool:
+    """Release the shuffle manager iff a release is pending AND no live
+    session or active query remains. Cheap no-op otherwise (one module
+    bool read) — execute_plan calls this after every query so a query
+    that outlived its session's stop() drain still triggers the
+    teardown when it ends."""
+    global _SHARED_RELEASE_PENDING
+    if not _SHARED_RELEASE_PENDING:
+        return False
+    if len(_LIVE_SESSIONS) or _metrics.active_query_count():
+        return False
+    from ..shuffle.manager import TpuShuffleManager
+    with TpuShuffleManager._lock:
+        mgr = TpuShuffleManager._instance
+        TpuShuffleManager._instance = None
+    _SHARED_RELEASE_PENDING = False
+    if mgr is not None:
+        mgr.shutdown()
+    return True
+
+
+class _Ticket:
+    __slots__ = ("qctx", "granted", "enq_ns")
+
+    def __init__(self, qctx: QueryContext):
+        self.qctx = qctx
+        self.granted = threading.Event()
+        self.enq_ns = time.perf_counter_ns()
+
+
+class QueryScheduler:
+    """Process-wide admission-controlled query scheduler (module doc)."""
+
+    _instance: Optional["QueryScheduler"] = None
+    _cls_lock = threading.Lock()
+
+    def __init__(self, max_queue: int = 64, max_concurrent: int = 8,
+                 hbm_watermark: float = 0.9):
+        self.max_queue = int(max_queue)
+        self.max_concurrent = int(max_concurrent)
+        self.hbm_watermark = float(hbm_watermark)
+        self._mu = threading.Lock()
+        # session id -> FIFO of queued tickets; _rr holds ids of sessions
+        # with a non-empty queue, rotated one grant at a time
+        self._queues: Dict[str, deque] = {}
+        self._rr: deque = deque()
+        self._queued = 0
+        self._running: Dict[int, QueryContext] = {}  # id(ticket) -> qctx
+        # every live QueryContext (queued or running) by session, for
+        # session.cancel()/stop() and the postmortem listing
+        self._by_session: Dict[str, List[QueryContext]] = {}
+        self._tls = threading.local()
+
+    # --- lifecycle ----------------------------------------------------------
+    @classmethod
+    def get(cls, conf=None) -> "QueryScheduler":
+        with cls._cls_lock:
+            if cls._instance is None:
+                cls._instance = QueryScheduler()
+            inst = cls._instance
+        if conf is not None:
+            inst._maybe_configure(conf)
+        return inst
+
+    @classmethod
+    def reset_for_tests(cls) -> "QueryScheduler":
+        global _SHARED_RELEASE_PENDING
+        _SHARED_RELEASE_PENDING = False
+        with cls._cls_lock:
+            cls._instance = QueryScheduler()
+            return cls._instance
+
+    def _maybe_configure(self, conf) -> None:
+        """Only EXPLICITLY SET sched keys overwrite the process state (the
+        flight/mesh_profile maybe_configure pattern: a default-conf session
+        must not silently resize another session's scheduler)."""
+        from ..config import (SCHED_HBM_WATERMARK, SCHED_MAX_CONCURRENT,
+                              SCHED_MAX_QUEUE)
+        with self._mu:
+            if conf.get_raw(SCHED_MAX_QUEUE.key) is not None:
+                self.max_queue = int(conf.get(SCHED_MAX_QUEUE))
+            if conf.get_raw(SCHED_MAX_CONCURRENT.key) is not None:
+                self.max_concurrent = max(
+                    1, int(conf.get(SCHED_MAX_CONCURRENT)))
+            if conf.get_raw(SCHED_HBM_WATERMARK.key) is not None:
+                self.hbm_watermark = float(conf.get(SCHED_HBM_WATERMARK))
+
+    def shutdown(self) -> None:
+        """Cancel everything queued or running (the owner-class release for
+        the QueryContexts parked on self)."""
+        with self._mu:
+            pending = [q for qs in self._by_session.values() for q in qs]
+        for q in pending:
+            q.cancel(reason="scheduler.shutdown")
+
+    # --- admission core (self._mu held) ------------------------------------
+    def _hbm_headroom_ok(self) -> bool:
+        from ..memory.hbm import HbmBudget
+        b = HbmBudget._instance  # no side-effect instantiation
+        if b is None or b.budget <= 0:
+            return True
+        return b.used <= self.hbm_watermark * b.budget
+
+    def _admit_locked(self) -> None:
+        """Grant as many queued tickets as the watermarks allow, rotating
+        round-robin across sessions. Grants are Event.set — the waiting
+        submitter thread runs its own query."""
+        while self._rr and len(self._running) < self.max_concurrent:
+            # HBM admission watermark, waived when the device is idle so
+            # admission can always make progress (a budget left high by
+            # parked state must not wedge the queue)
+            if self._running and not self._hbm_headroom_ok():
+                break
+            sid = self._rr[0]
+            q = self._queues.get(sid)
+            if not q:
+                self._rr.popleft()
+                continue
+            ticket = q.popleft()
+            if q:
+                self._rr.rotate(-1)
+            else:
+                self._rr.popleft()
+                del self._queues[sid]
+            self._queued -= 1
+            self._running[id(ticket)] = ticket.qctx
+            ticket.granted.set()
+        # committed under the lock (the _QL_LOCK idiom): an interleaved
+        # enqueue/release pair must never publish a stale depth
+        _metrics.gauge_set("sched.queue_depth", self._queued)
+
+    def _release(self, ticket: _Ticket) -> None:
+        """Return `ticket`'s slot (running) or queue entry (never admitted)
+        and admit successors. Idempotent."""
+        with self._mu:
+            if self._running.pop(id(ticket), None) is None:
+                sid = ticket.qctx.session_id
+                q = self._queues.get(sid)
+                if q is not None:
+                    try:
+                        q.remove(ticket)
+                        self._queued -= 1
+                    except ValueError:
+                        pass
+                    if not q:
+                        del self._queues[sid]
+                        try:
+                            self._rr.remove(sid)
+                        except ValueError:
+                            pass
+            self._admit_locked()
+
+    def _deregister(self, qctx: QueryContext) -> None:
+        """QueryContext.close() hook: drop it from the session index."""
+        with self._mu:
+            lst = self._by_session.get(qctx.session_id)
+            if lst is None:
+                return
+            lst[:] = [q for q in lst if q is not qctx]
+            if not lst:
+                del self._by_session[qctx.session_id]
+
+    # --- the submission path ------------------------------------------------
+    def submit_and_run(self, qctx: QueryContext, fn):
+        """Enqueue `qctx`, wait for admission, then run `fn` on the calling
+        thread with the context bound. Raises QueryQueueFull past the queue
+        bound; a cancel/deadline while QUEUED raises without running
+        anything. Nested execution (a query submitting a query on the same
+        thread) bypasses admission — the caller-runs model would deadlock
+        a thread against its own held slot."""
+        if getattr(self._tls, "admitted", False):
+            # nested execution rides the OUTER query's admission slot AND
+            # its cancel token: the outer (registered) context stays
+            # bound, so session.cancel()/stop()/deadlines interrupt the
+            # nested work too — re-binding the nested context would hand
+            # checkpoints a token nothing can ever arm (the nested
+            # context is registered nowhere; it is part of the outer
+            # query's work)
+            qctx.mark_running()
+            return fn()
+        ticket = _Ticket(qctx)
+        with self._mu:
+            if self._queued >= self.max_queue:
+                _metrics.counter_inc("query.rejected_queue_full")
+                rejected = True
+            else:
+                rejected = False
+                self._queues.setdefault(qctx.session_id,
+                                        deque()).append(ticket)
+                if qctx.session_id not in self._rr:
+                    self._rr.append(qctx.session_id)
+                self._queued += 1
+                self._by_session.setdefault(qctx.session_id,
+                                            []).append(qctx)
+                self._admit_locked()
+        if rejected:
+            _flight.note("query.rejected", query=qctx.name,
+                         session=qctx.session_id, reason="queue_full")
+            raise QueryQueueFull(
+                f"query {qctx.name} rejected: admission queue full "
+                f"(spark.rapids.tpu.sched.maxQueuedQueries="
+                f"{self.max_queue})")
+        _flight.note("query.queued", query=qctx.name,
+                     session=qctx.session_id)
+        try:
+            # grant wait OFF the lock; short poll so a cancel or deadline
+            # arriving while queued is observed promptly, and admission is
+            # re-evaluated each tick (HBM headroom can open mid-query,
+            # with no completion event to trigger a grant)
+            while not ticket.granted.wait(timeout=0.05):
+                qctx.check("sched.queue")
+                with self._mu:
+                    self._admit_locked()
+            # chaos `sched.admit` fires BEFORE the admission is recorded:
+            # latency extends the measured queue delay (it lands in the
+            # sched.admit_wait_ms histogram), io_error fails the query
+            # still QUEUED — no query.admitted flight event, no query
+            # work started, no resource acquired
+            from ..chaos import inject
+            inject("sched.admit", detail=qctx.name)
+            wait_ms = (time.perf_counter_ns() - ticket.enq_ns) / 1e6
+            _metrics.histogram_observe("sched.admit_wait_ms", wait_ms)
+            _flight.note("query.admitted", query=qctx.name,
+                         session=qctx.session_id,
+                         wait_ms=round(wait_ms, 3))
+            self._tls.admitted = True
+            try:
+                with bind(qctx):
+                    qctx.mark_running()
+                    return fn()
+            finally:
+                self._tls.admitted = False
+        except QueryDeadlineExceeded:
+            _metrics.counter_inc("query.deadline_exceeded")
+            _flight.note("query.deadline_exceeded", query=qctx.name,
+                         session=qctx.session_id)
+            raise
+        except QueryCancelledError:
+            _metrics.counter_inc("query.cancelled")
+            _flight.note("query.cancelled", query=qctx.name,
+                         session=qctx.session_id,
+                         reason=qctx.cancel_reason)
+            raise
+        finally:
+            self._release(ticket)
+
+    # --- session-level control ---------------------------------------------
+    def cancel_session(self, session_id: str,
+                       reason: str = "session.cancel") -> int:
+        """Arm the cancel token of every queued/running query of one
+        session frontend; returns how many were flagged."""
+        with self._mu:
+            targets = list(self._by_session.get(session_id, ()))
+        for q in targets:
+            q.cancel(reason=reason)
+        return len(targets)
+
+    def drain_session(self, session_id: str, timeout_s: float = 30.0
+                      ) -> bool:
+        """Wait (bounded) until a session has no queued or running query —
+        the stop() barrier after cancel_session."""
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._mu:
+                if not self._by_session.get(session_id):
+                    return True
+            time.sleep(0.01)
+        with self._mu:
+            return not self._by_session.get(session_id)
+
+    # --- observability ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Queued/running query names + states for the postmortem bundle
+        and metrics_snapshot — a crash dump must NAME the queries that
+        were queued, running or cancelling when the process died."""
+        with self._mu:
+            running = [{"query": q.name, "session": q.session_id,
+                        "state": q.state}
+                       for q in self._running.values()]
+            queued = [{"query": t.qctx.name, "session": sid,
+                       "state": t.qctx.state}
+                      for sid, dq in self._queues.items() for t in dq]
+            return {"max_concurrent": self.max_concurrent,
+                    "max_queue": self.max_queue,
+                    "hbm_watermark": self.hbm_watermark,
+                    "queue_depth": self._queued,
+                    "running": running, "queued": queued}
+
+
+# ---------------------------------------------------------------------------
+# the executor service: the per-partition driving loop moved out of
+# TpuSession._execute (session.py keeps the front door + session state)
+# ---------------------------------------------------------------------------
+
+
+def execute_plan(session, plan, timeout: Optional[float] = None):
+    """Plan, admit, and execute one query for `session`, returning the
+    pyarrow result table. `timeout` (seconds) overrides the session's
+    spark.rapids.tpu.query.timeoutMs deadline for this call."""
+    import pyarrow as pa
+
+    from ..config import QUERY_RETRY_BUDGET, QUERY_TIMEOUT_MS, TRACE_TAG
+    from ..plan.overrides import TpuOverrides
+    from ..plan.planner import plan_physical
+    from ..types import to_arrow as t2a
+    conf = session._rapids_conf()
+    cpu_plan = plan_physical(plan, conf)
+    final = TpuOverrides.apply(cpu_plan, conf)
+    schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
+    session._query_seq = getattr(session, "_query_seq", 0) + 1
+    tag = conf.get(TRACE_TAG)
+    stem = tag if tag and str(tag) != "None" else "query"
+    if stem == "query":
+        # untagged sessions fold the session id into the query name:
+        # concurrent sessions each minting "query-1" would collide in
+        # every name-keyed filter (the STRICT mesh-profile query filter
+        # would bleed one tenant's exchanges into another's bundle).
+        # Tagged names stay `<tag>-<n>` — the bench artifact contract.
+        sid_n = session._session_id.rsplit("-", 1)[-1]
+        qname = f"query-s{sid_n}-{session._query_seq}"
+    else:
+        qname = f"{stem}-{session._query_seq}"
+    timeout_ms = float(timeout) * 1000.0 if timeout is not None \
+        else float(conf.get(QUERY_TIMEOUT_MS))
+    deadline_ns = (time.perf_counter_ns() + int(timeout_ms * 1e6)
+                   if timeout_ms and timeout_ms > 0 else None)
+    sched = QueryScheduler.get(conf)
+    try:
+        with QueryContext(qname, session_id=session._session_id,
+                          deadline_ns=deadline_ns,
+                          retry_budget=conf.get(QUERY_RETRY_BUDGET)
+                          ) as qctx:
+            tables = sched.submit_and_run(
+                qctx, lambda: _run_admitted(session, final, conf, qctx,
+                                            stem, qname))
+    finally:
+        # a query that outlived its session's stop() drain releases the
+        # shared state the stop could not (no-op unless pending)
+        maybe_release_shared()
+    if not tables:
+        return schema.empty_table()
+    return pa.concat_tables(tables).cast(schema)
+
+
+def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
+                  qname: str) -> List:
+    """One admitted query's execution window: partition loop(s), failure
+    handling, and the per-query observability snapshotting. Runs on the
+    submitting thread with the QueryContext bound."""
+    from .. import obs
+    from ..config import (TRACE_BUFFER_EVENTS, TRACE_CATEGORIES,
+                          TRACE_ENABLED)
+    from ..parallel.mesh import mesh_session_active
+    from ..profiling import (SyncLedger, TaskMetricsRegistry,
+                             snapshot_plan_metrics)
+    task_metrics_before = TaskMetricsRegistry.get().snapshot()
+    syncs_before = SyncLedger.get().snapshot()
+    # mesh session (docs/distributed.md): the root pull drives ALL
+    # partitions through the multi-partition entry point in one group,
+    # so the top whole-stage segment (between the last exchange and the
+    # result) executes every chip's partition in a single grouped
+    # launch — the same batched dispatch the exchange map side uses
+    n_parts = final.num_partitions()
+    names = [a.name for a in final.output]
+    group_pull = n_parts > 1 and mesh_session_active(conf) is not None
+    # always-on metrics registry (docs/observability.md): EVERY query
+    # (traced or not) registers its lifecycle — the queries.active
+    # gauge/list, the latency + rows/s histograms, and the epoch the
+    # tracer's exclusivity check reads
+    qtok = obs.metrics.query_begin(qname, session=stem)
+    qroot = None
+    opjit_before = None
+    tables: List = []
+    # window for this query's collective-exchange profiles (mesh
+    # efficiency profiler): profiles are tagged with the traced query
+    # name when one is bound; the seq window covers untraced queries
+    mesh_seq0 = obs.mesh_profile.current_seq()
+    failed = True  # cleared by the last statement of the try body
+    try:
+        if conf.get(TRACE_ENABLED):
+            from ..config import TRACE_MAX_CONCURRENT
+            from ..execs import opjit
+            # arm FIRST inside the try whose finally guarantees
+            # end_query (TL020: an exception can never strand a tracer
+            # armed) and query_end. The snapshot BEFORE arming (nothing
+            # dispatches in between) is only trusted when the query ran
+            # EXCLUSIVELY — a concurrent query's bundle reconciles
+            # against the tracer's own per-query counters instead (no
+            # cross-query bleed).
+            opjit_before = opjit.cache_stats()["calls_by_kind"]
+            qroot = obs.begin_query(
+                qname,
+                buffer_events=conf.get(TRACE_BUFFER_EVENTS),
+                categories=conf.get(TRACE_CATEGORIES),
+                max_concurrent=conf.get(TRACE_MAX_CONCURRENT))
+        if group_pull:
+            ids = list(range(n_parts))
+            ctxs: Dict[int, TaskContext] = {}
+
+            def ctx_of(i):
+                c = ctxs.get(i)
+                if c is None:
+                    c = ctxs[i] = TaskContext(i, conf)
+                return c
+
+            try:
+                checkpoint(f"task.group 0-{ids[-1]}")
+                with obs.span(f"partition group 0-{ids[-1]}", cat="task",
+                              partitions=n_parts):
+                    for _p, t in final.execute_partitions(ids, ctx_of):
+                        if t.num_rows:
+                            tables.append(t.rename_columns(names))
+            except BaseException as exc:
+                from ..config import FATAL_ERROR_EXIT
+                from ..failure import handle_task_failure
+                handle_task_failure(
+                    exc, conf,
+                    exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
+                raise
+            finally:
+                for c in ctxs.values():
+                    c.complete()
+        else:
+            for p in range(n_parts):
+                # cooperative cancellation at partition-task start: a
+                # cancelled/timed-out query stops scheduling new tasks
+                # before any of this partition's resources are acquired
+                checkpoint(f"task.start p{p}")
+                ctx = TaskContext(p, conf)
+                try:
+                    with obs.span(f"partition {p}", cat="task",
+                                  partition=p):
+                        for t in final.execute_partition(p, ctx):
+                            if t.num_rows:
+                                tables.append(t.rename_columns(names))
+                except BaseException as exc:
+                    # fatal device errors capture diagnostics and
+                    # (outside tests) exit so the cluster manager
+                    # reschedules (RapidsExecutorPlugin.onTaskFailed)
+                    from ..config import FATAL_ERROR_EXIT
+                    from ..failure import handle_task_failure
+                    handle_task_failure(
+                        exc, conf,
+                        exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
+                    raise
+                finally:
+                    ctx.complete()
+        failed = False  # reached only when every partition completed
+    finally:
+        # snapshot metrics into plain dicts so the plan (and any device
+        # buffers it references) is not pinned past the query
+        session._last_metrics_snapshot = snapshot_plan_metrics(final)
+        session._last_plan_tree = _plan_tree_snapshot(final)
+        after = TaskMetricsRegistry.get().snapshot()
+        session._last_task_metrics = {
+            k: after.get(k, 0) - task_metrics_before.get(k, 0)
+            for k in after}
+        # per-operator blocking-sync deltas for this query alone (the
+        # sync ledger is process-wide; docs/configs.md "Dispatch & sync
+        # accounting")
+        syncs_after = SyncLedger.get().snapshot()
+        ledger = {}
+        for op, kinds in syncs_after.items():
+            prev = syncs_before.get(op, {})
+            d = {k: v - prev.get(k, 0) for k, v in kinds.items()
+                 if v - prev.get(k, 0)}
+            if d:
+                ledger[op] = d
+        session._last_sync_ledger = ledger
+        # this query's per-exchange mesh profiles + per-map fallback
+        # reasons (empty outside mesh sessions): the bundle's `mesh`
+        # section and the sharded runner both read these
+        session._last_mesh_profiles = obs.mesh_profile.profiles_since(
+            mesh_seq0, query=qname)
+        session._last_mesh_fallbacks = obs.mesh_profile.fallbacks_since(
+            mesh_seq0, query=qname)
+        # honesty: records evicted from the bounded profiler rings
+        # inside this query's window (exchange-heavy / concurrent
+        # load) are COUNTED, not silently missing from the bundle
+        session._last_mesh_dropped = obs.mesh_profile.window_dropped(
+            mesh_seq0)
+        if qroot is not None:
+            _finish_query_profile(session, qroot, conf, opjit_before)
+        else:
+            # honor the last_query_profile contract: an untraced query
+            # (tracing off, or the process-wide tracer owned by another
+            # query) must not leave a previous query's bundle behind
+            session._last_query_profile = None
+        # release shuffle blocks/files at query end (reference: Spark's
+        # ContextCleaner removing shuffle state); exchanges re-materialize
+        # if the same DataFrame is collected again
+        for node in final.collect_nodes():
+            if hasattr(node, "cleanup_shuffle"):
+                node.cleanup_shuffle(conf)
+        obs.metrics.query_end(
+            qtok, rows=sum(t.num_rows for t in tables),
+            failed=failed, session=stem)
+    return tables
+
+
+def _finish_query_profile(session, qroot, conf, opjit_before) -> None:
+    """Close the tracer, build the diagnostics bundle (metric snapshot +
+    sync-ledger delta + dispatch-by-kind delta + the span/event record),
+    and write the Chrome trace + bundle artifacts when
+    spark.rapids.tpu.trace.dir is set. IMPORTANT: all inputs are the
+    deltas this query caused — the bundle's reconciliation asserts the
+    tracer saw every dispatch (calls_by_kind) and every blocking sync
+    (SyncLedger) the pre-existing counters saw."""
+    from .. import obs
+    from ..config import TRACE_DIR
+    from ..execs import opjit
+    profile = obs.end_query(qroot)
+    if profile.get("exclusive", True):
+        # no other query overlapped: the process-wide counter deltas
+        # are attributable to this query — the strongest ground truth
+        # (incremented by code paths independent of the tracer)
+        disp_after = opjit.cache_stats()["calls_by_kind"]
+        disp_delta = {
+            k: disp_after.get(k, 0) - (opjit_before or {}).get(k, 0)
+            for k in set(disp_after) | set(opjit_before or {})}
+    else:
+        # concurrent queries: process-wide deltas cross-bleed, so the
+        # bundle reconciles against THIS query's own counters — kept
+        # by the tracer at exactly the sites where calls_by_kind and
+        # the SyncLedger increment, routed by the thread binding
+        disp_delta = {k: v for k, v in
+                      profile.get("dispatch_counts", {}).items() if v}
+        session._last_sync_ledger = {
+            op: dict(kinds)
+            for op, kinds in profile.get("sync_counts", {}).items()}
+    bundle = obs.build_bundle(
+        profile,
+        plan_tree=session._last_plan_tree,
+        metrics=session._last_metrics_snapshot,
+        sync_ledger=session._last_sync_ledger,
+        dispatch_delta=disp_delta,
+        task_metrics=session._last_task_metrics,
+        mesh_profiles=getattr(session, "_last_mesh_profiles", None),
+        mesh_fallbacks=getattr(session, "_last_mesh_fallbacks", None),
+        mesh_dropped=getattr(session, "_last_mesh_dropped", 0))
+    out_dir = conf.get(TRACE_DIR)
+    if out_dir and str(out_dir) != "None":
+        try:
+            obs.write_artifacts(bundle, profile, str(out_dir),
+                                profile.get("name", "query"))
+        except OSError:
+            bundle["artifacts"] = {"error": "trace.dir not writable"}
+    session._last_query_profile = bundle
+
+
+def _plan_tree_snapshot(plan) -> List[dict]:
+    """Plain-data snapshot of the executed physical plan for
+    explain("metrics") and the diagnostics bundle — preorder, so index i
+    matches snapshot_plan_metrics's "i:NodeName" keys, and no node (or
+    device buffer it pins) survives past the query."""
+    out: List[dict] = []
+
+    def walk(node, depth: int) -> None:
+        out.append({"i": len(out), "depth": depth,
+                    "name": node.node_name(), "desc": node.node_desc(),
+                    "tpu": node.is_tpu})
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(plan, 0)
+    return out

@@ -11,6 +11,7 @@ TPU + transitions) → partition-parallel execution.
 from __future__ import annotations
 
 import concurrent.futures as _fut
+import itertools as _itertools
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 from .config import RapidsConf
@@ -19,7 +20,6 @@ from .expressions.base import (Alias, AttributeReference, Expression, Literal,
 from .plan import logical as L
 from .plan.overrides import TpuOverrides
 from .plan.planner import plan_physical
-from .execs.base import TaskContext
 
 
 class Column:
@@ -535,14 +535,18 @@ class DataFrame:
                          self.session)
 
     # --- actions ----------------------------------------------------------
-    def to_arrow(self):
-        import pyarrow as pa
-        return self.session._execute(self._plan)
+    def to_arrow(self, timeout: Optional[float] = None):
+        return self.session._execute(self._plan, timeout=timeout)
 
     toArrow = to_arrow
 
-    def collect(self) -> List[dict]:
-        return self.to_arrow().to_pylist()
+    def collect(self, timeout: Optional[float] = None) -> List[dict]:
+        """Execute and fetch all rows. `timeout` (seconds) sets a deadline
+        for THIS query (overriding spark.rapids.tpu.query.timeoutMs): past
+        it the query is cancelled at the next cooperative checkpoint and
+        raises QueryDeadlineExceeded with every resource released
+        (docs/robustness.md "Query lifecycle")."""
+        return self.to_arrow(timeout=timeout).to_pylist()
 
     def toPandas(self):
         return self.to_arrow().to_pandas()
@@ -558,6 +562,12 @@ class DataFrame:
         from .plan.overrides import TpuOverrides
         from .plan.planner import plan_physical
         from .columnar.batch import TpuColumnarBatch
+        if self.session._stopped:
+            # same contract as _execute: a stopped session must not
+            # silently resurrect the shared shuffle manager (the ML
+            # interop path materializes exchanges too)
+            raise RuntimeError(
+                f"TpuSession {self.session._session_id} is stopped")
         conf = self.session._rapids_conf()
         final = TpuOverrides.apply(plan_physical(self._plan, conf), conf)
         # strip the final device→host transition: the caller wants device data
@@ -969,6 +979,9 @@ class TpuSession:
 
     builder = property(lambda self: TpuSessionBuilder())
 
+    #: session-id mint (itertools.count.__next__ is atomic in CPython)
+    _session_ids = _itertools.count(1)
+
     def __init__(self, conf: Optional[Dict[str, str]] = None):
         self._settings: Dict[str, str] = dict(conf or {})
         from .config import LEAK_TRACKING_DEBUG
@@ -995,6 +1008,18 @@ class TpuSession:
         # straggler factor (docs/observability.md "Mesh profiling")
         _mesh_profile.maybe_configure(rc)
         self._pool: Optional[_fut.ThreadPoolExecutor] = None
+        # query lifecycle (docs/robustness.md): this session is one
+        # frontend of the process-wide scheduler — queries submit under
+        # its id (session.cancel()/stop() target exactly its queries),
+        # and the LAST frontend to stop() releases shared state
+        from .serving import scheduler as _sched
+        # itertools.count: concurrent constructors must not mint duplicate
+        # ids — a shared id would merge two tenants' admission queues and
+        # make one session's cancel()/stop() drain the other's queries
+        self._session_id = f"sess-{next(TpuSession._session_ids)}"
+        self._stopped = False
+        _sched.register_session(self)
+        _sched.QueryScheduler.get(rc)
 
     # conf API
     class _Conf:
@@ -1064,216 +1089,23 @@ class TpuSession:
         return DataFrameReader(self)
 
     # --- execution --------------------------------------------------------
-    def _execute(self, plan: L.LogicalPlan):
-        import pyarrow as pa
-        conf = self._rapids_conf()
-        cpu_plan = plan_physical(plan, conf)
-        final = TpuOverrides.apply(cpu_plan, conf)
-        names = [a.name for a in final.output]
-        from .types import to_arrow as t2a
-        schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
-        from .profiling import (SyncLedger, TaskMetricsRegistry,
-                                snapshot_plan_metrics)
-        task_metrics_before = TaskMetricsRegistry.get().snapshot()
-        syncs_before = SyncLedger.get().snapshot()
-        # query timeline tracer (docs/observability.md): arm the process-
-        # wide tracer for this query; None when off OR when another query
-        # already owns it (that query keeps tracing, this one runs untraced)
-        from . import obs
-        from .config import TRACE_BUFFER_EVENTS, TRACE_CATEGORIES, \
-            TRACE_ENABLED
-        from .parallel.mesh import mesh_session_active
-        # mesh session (docs/distributed.md): the root pull drives ALL
-        # partitions through the multi-partition entry point in one group,
-        # so the top whole-stage segment (between the last exchange and the
-        # result) executes every chip's partition in a single grouped
-        # launch — the same batched dispatch the exchange map side uses
-        n_parts = final.num_partitions()
-        group_pull = n_parts > 1 and mesh_session_active(conf) is not None
-        from .config import TRACE_TAG
-        self._query_seq = getattr(self, "_query_seq", 0) + 1
-        tag = conf.get(TRACE_TAG)
-        stem = tag if tag and str(tag) != "None" else "query"
-        qname = f"{stem}-{self._query_seq}"
-        # always-on metrics registry (docs/observability.md): EVERY query
-        # (traced or not) registers its lifecycle — the queries.active
-        # gauge/list, the latency + rows/s histograms, and the epoch the
-        # tracer's exclusivity check reads
-        qtok = obs.metrics.query_begin(qname, session=stem)
-        qroot = None
-        opjit_before = None
-        tables = []
-        # window for this query's collective-exchange profiles (mesh
-        # efficiency profiler): profiles are tagged with the traced query
-        # name when one is bound; the seq window covers untraced queries
-        mesh_seq0 = obs.mesh_profile.current_seq()
-        failed = True  # cleared by the last statement of the try body
-        try:
-            if conf.get(TRACE_ENABLED):
-                from .config import TRACE_MAX_CONCURRENT
-                from .execs import opjit
-                # arm FIRST inside the try whose finally guarantees
-                # end_query (TL020: an exception can never strand a tracer
-                # armed) and query_end. The snapshot BEFORE arming (nothing
-                # dispatches in between) is only trusted when the query ran
-                # EXCLUSIVELY — a concurrent query's bundle reconciles
-                # against the tracer's own per-query counters instead (no
-                # cross-query bleed).
-                opjit_before = opjit.cache_stats()["calls_by_kind"]
-                qroot = obs.begin_query(
-                    qname,
-                    buffer_events=conf.get(TRACE_BUFFER_EVENTS),
-                    categories=conf.get(TRACE_CATEGORIES),
-                    max_concurrent=conf.get(TRACE_MAX_CONCURRENT))
-            if group_pull:
-                ids = list(range(n_parts))
-                ctxs = {}
-
-                def ctx_of(i):
-                    c = ctxs.get(i)
-                    if c is None:
-                        c = ctxs[i] = TaskContext(i, conf)
-                    return c
-
-                try:
-                    with obs.span(f"partition group 0-{ids[-1]}", cat="task",
-                                  partitions=n_parts):
-                        for _p, t in final.execute_partitions(ids, ctx_of):
-                            if t.num_rows:
-                                tables.append(t.rename_columns(names))
-                except BaseException as exc:
-                    from .config import FATAL_ERROR_EXIT
-                    from .failure import handle_task_failure
-                    handle_task_failure(
-                        exc, conf,
-                        exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
-                    raise
-                finally:
-                    for c in ctxs.values():
-                        c.complete()
-            else:
-                for p in range(n_parts):
-                    ctx = TaskContext(p, conf)
-                    try:
-                        with obs.span(f"partition {p}", cat="task",
-                                      partition=p):
-                            for t in final.execute_partition(p, ctx):
-                                if t.num_rows:
-                                    tables.append(t.rename_columns(names))
-                    except BaseException as exc:
-                        # fatal device errors capture diagnostics and
-                        # (outside tests) exit so the cluster manager
-                        # reschedules (RapidsExecutorPlugin.onTaskFailed)
-                        from .config import FATAL_ERROR_EXIT
-                        from .failure import handle_task_failure
-                        handle_task_failure(
-                            exc, conf,
-                            exit_on_fatal=conf.get(FATAL_ERROR_EXIT))
-                        raise
-                    finally:
-                        ctx.complete()
-            failed = False  # reached only when every partition completed
-        finally:
-            # snapshot metrics into plain dicts so the plan (and any device
-            # buffers it references) is not pinned past the query
-            self._last_metrics_snapshot = snapshot_plan_metrics(final)
-            self._last_plan_tree = _plan_tree_snapshot(final)
-            after = TaskMetricsRegistry.get().snapshot()
-            self._last_task_metrics = {
-                k: after.get(k, 0) - task_metrics_before.get(k, 0)
-                for k in after}
-            # per-operator blocking-sync deltas for this query alone (the
-            # sync ledger is process-wide; docs/configs.md "Dispatch & sync
-            # accounting")
-            syncs_after = SyncLedger.get().snapshot()
-            ledger = {}
-            for op, kinds in syncs_after.items():
-                prev = syncs_before.get(op, {})
-                d = {k: v - prev.get(k, 0) for k, v in kinds.items()
-                     if v - prev.get(k, 0)}
-                if d:
-                    ledger[op] = d
-            self._last_sync_ledger = ledger
-            # this query's per-exchange mesh profiles + per-map fallback
-            # reasons (empty outside mesh sessions): the bundle's `mesh`
-            # section and the sharded runner both read these
-            self._last_mesh_profiles = obs.mesh_profile.profiles_since(
-                mesh_seq0, query=qname)
-            self._last_mesh_fallbacks = obs.mesh_profile.fallbacks_since(
-                mesh_seq0, query=qname)
-            # honesty: records evicted from the bounded profiler rings
-            # inside this query's window (exchange-heavy / concurrent
-            # load) are COUNTED, not silently missing from the bundle
-            self._last_mesh_dropped = obs.mesh_profile.window_dropped(
-                mesh_seq0)
-            if qroot is not None:
-                self._finish_query_profile(qroot, conf, opjit_before)
-            else:
-                # honor the last_query_profile contract: an untraced query
-                # (tracing off, or the process-wide tracer owned by another
-                # query) must not leave a previous query's bundle behind
-                self._last_query_profile = None
-            # release shuffle blocks/files at query end (reference: Spark's
-            # ContextCleaner removing shuffle state); exchanges re-materialize
-            # if the same DataFrame is collected again
-            for node in final.collect_nodes():
-                if hasattr(node, "cleanup_shuffle"):
-                    node.cleanup_shuffle(conf)
-            obs.metrics.query_end(
-                qtok, rows=sum(t.num_rows for t in tables),
-                failed=failed, session=stem)
-        if not tables:
-            return schema.empty_table()
-        return pa.concat_tables(tables).cast(schema)
-
-    def _finish_query_profile(self, qroot: int, conf, opjit_before) -> None:
-        """Close the tracer, build the diagnostics bundle (metric snapshot +
-        sync-ledger delta + dispatch-by-kind delta + the span/event record),
-        and write the Chrome trace + bundle artifacts when
-        spark.rapids.tpu.trace.dir is set. IMPORTANT: all inputs are the
-        deltas this query caused — the bundle's reconciliation asserts the
-        tracer saw every dispatch (calls_by_kind) and every blocking sync
-        (SyncLedger) the pre-existing counters saw."""
-        from . import obs
-        from .config import TRACE_DIR
-        from .execs import opjit
-        profile = obs.end_query(qroot)
-        if profile.get("exclusive", True):
-            # no other query overlapped: the process-wide counter deltas
-            # are attributable to this query — the strongest ground truth
-            # (incremented by code paths independent of the tracer)
-            disp_after = opjit.cache_stats()["calls_by_kind"]
-            disp_delta = {
-                k: disp_after.get(k, 0) - (opjit_before or {}).get(k, 0)
-                for k in set(disp_after) | set(opjit_before or {})}
-        else:
-            # concurrent queries: process-wide deltas cross-bleed, so the
-            # bundle reconciles against THIS query's own counters — kept
-            # by the tracer at exactly the sites where calls_by_kind and
-            # the SyncLedger increment, routed by the thread binding
-            disp_delta = {k: v for k, v in
-                          profile.get("dispatch_counts", {}).items() if v}
-            self._last_sync_ledger = {
-                op: dict(kinds)
-                for op, kinds in profile.get("sync_counts", {}).items()}
-        bundle = obs.build_bundle(
-            profile,
-            plan_tree=self._last_plan_tree,
-            metrics=self._last_metrics_snapshot,
-            sync_ledger=self._last_sync_ledger,
-            dispatch_delta=disp_delta,
-            task_metrics=self._last_task_metrics,
-            mesh_profiles=getattr(self, "_last_mesh_profiles", None),
-            mesh_fallbacks=getattr(self, "_last_mesh_fallbacks", None),
-            mesh_dropped=getattr(self, "_last_mesh_dropped", 0))
-        out_dir = conf.get(TRACE_DIR)
-        if out_dir and str(out_dir) != "None":
-            try:
-                obs.write_artifacts(bundle, profile, str(out_dir),
-                                    profile.get("name", "query"))
-            except OSError:
-                bundle["artifacts"] = {"error": "trace.dir not writable"}
-        self._last_query_profile = bundle
+    def _execute(self, plan: L.LogicalPlan,
+                 timeout: Optional[float] = None):
+        """Submit one query through the scheduler/executor service
+        (serving/scheduler.py — docs/robustness.md "Query lifecycle"):
+        admission control (bounded queue, HBM watermark, round-robin
+        fairness across sessions), a per-query cancel token + optional
+        deadline, and the per-partition driving loop. The session keeps
+        only query STATE (the _last_* snapshots the executor writes
+        back); the device-owning loop lives in the service."""
+        if self._stopped:
+            # a stopped session already released (or ceded) the shared
+            # state; executing would silently resurrect the shuffle
+            # manager with no owner left to ever shut it down
+            raise RuntimeError(
+                f"TpuSession {self._session_id} is stopped")
+        from .serving.scheduler import execute_plan
+        return execute_plan(self, plan, timeout=timeout)
 
     def last_query_metrics(self, level: Optional[str] = None):
         """Per-operator metrics of the last executed query (the reference
@@ -1358,26 +1190,61 @@ class TpuSession:
             raise ValueError("set spark.rapids.profile.pathPrefix to profile")
         return TpuProfiler(prefix)
 
+    def cancel(self) -> int:
+        """Cancel this session's in-flight (queued or running) queries:
+        each observes its cancel token at the next cooperative checkpoint
+        and unwinds through the audited release paths — permits, HBM,
+        spill files and its tracer return to baseline. Returns how many
+        queries were flagged (docs/robustness.md "Query lifecycle")."""
+        from .serving.scheduler import QueryScheduler
+        return QueryScheduler.get().cancel_session(self._session_id)
+
     def stop(self) -> None:
-        pass
+        """Shut this session frontend down (idempotent): cancel + drain
+        its in-flight queries, shut down its thread pool, drop the
+        per-query snapshot state (which can pin plan trees), and — when
+        this was the LAST live session with nothing running anywhere —
+        release the process-wide shuffle manager (pools + block store,
+        the TpuShuffleManager.shutdown() contract)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        from .obs import flight as _flight
+        from .serving import scheduler as _sched
+        sched = _sched.QueryScheduler.get()
+        n = sched.cancel_session(self._session_id, reason="session.stop")
+        drained = sched.drain_session(self._session_id, timeout_s=30.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        # release tracer/flight-adjacent bindings: the snapshot state the
+        # executor parked on this session (bundles reference plan trees
+        # and, through them, device buffers)
+        for attr in ("_last_query_profile", "_last_plan_tree",
+                     "_last_metrics_snapshot", "_last_sync_ledger",
+                     "_last_task_metrics", "_last_mesh_profiles",
+                     "_last_mesh_fallbacks"):
+            if hasattr(self, attr):
+                setattr(self, attr, None)
+        _flight.note("session.stop", session=self._session_id,
+                     cancelled=n, drained=drained)
+        _sched.release_session(self)
+        if not _sched.other_live_sessions(self):
+            # last frontend gone: the shuffle manager's pools/block store
+            # have no remaining owner (a later session lazily recreates
+            # the singleton). Released now when the device pool is idle;
+            # if a straggler query outlived the drain timeout, the
+            # release stays PENDING and fires when that query ends
+            # (scheduler.maybe_release_shared in execute_plan's finally).
+            _sched.request_shared_release()
 
+    # with-style lifetime (TL020 owner-class rule: a class parking
+    # resources on self exposes __exit__/stop)
+    def __enter__(self) -> "TpuSession":
+        return self
 
-def _plan_tree_snapshot(plan) -> List[dict]:
-    """Plain-data snapshot of the executed physical plan for
-    explain("metrics") and the diagnostics bundle — preorder, so index i
-    matches snapshot_plan_metrics's "i:NodeName" keys, and no node (or
-    device buffer it pins) survives past the query."""
-    out: List[dict] = []
-
-    def walk(node, depth: int) -> None:
-        out.append({"i": len(out), "depth": depth,
-                    "name": node.node_name(), "desc": node.node_desc(),
-                    "tpu": node.is_tpu})
-        for c in node.children:
-            walk(c, depth + 1)
-
-    walk(plan, 0)
-    return out
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
 
 
 def get_session(**conf) -> TpuSession:

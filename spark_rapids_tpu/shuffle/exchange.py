@@ -19,6 +19,7 @@ from ..config import SHUFFLE_PARTITIONS
 from ..expressions.base import AttributeReference, Expression
 from ..obs import flight, metrics
 from ..obs import tracer as obs
+from ..serving import query_context as qlc
 from .manager import TpuShuffleManager
 from .partitioner import (hash_partition_ids, hash_split_parts,
                           hash_split_parts_grouped, np_hash_partition_ids,
@@ -66,8 +67,11 @@ class _ExchangeBase:
             sid = mgr.new_shuffle_id()
             child = self.children[0]
             # map-task spans on pool threads (empty span stacks) nest under
-            # this materialization span via the captured parent id
+            # this materialization span via the captured parent id; the
+            # query lifecycle binding rides along the same way, so a
+            # cancel/deadline trips map tasks on pool threads too
             self._obs_parent = obs.current_span()
+            self._query_ctx = qlc.current()
             with obs.span(f"exchange s{sid} materialize", cat="shuffle",
                           shuffle=sid) as mat_span:
                 if mat_span is not None:
@@ -103,10 +107,15 @@ class _ExchangeBase:
         from ..failure import with_device_retry
 
         def attempt() -> None:
+            qlc.checkpoint(f"exchange.map s{sid}m{map_id}")
             inject("pipeline.task", detail=f"s{sid}m{map_id}")
             self._materialize_map(sid, map_id, ctx, mgr, gate_device)
 
-        with_device_retry(attempt, ctx.conf)
+        # bind the owning query on this (possibly pool) thread: the
+        # checkpoint above, the per-query retry budget, and any nested
+        # checkpoints in the member pull all route to the right query
+        with qlc.bind(getattr(self, "_query_ctx", None)):
+            with_device_retry(attempt, ctx.conf)
 
     def _map_group_size(self, ctx: TaskContext) -> int:
         """How many map partitions one scheduled task processes (batched
@@ -128,10 +137,12 @@ class _ExchangeBase:
         from ..failure import with_device_retry
 
         def attempt() -> None:
+            qlc.checkpoint(f"exchange.group s{sid}g{ids[0]}-{ids[-1]}")
             inject("pipeline.task", detail=f"s{sid}g{ids[0]}-{ids[-1]}")
             self._materialize_map_group(sid, ids, ctx, mgr)
 
-        with_device_retry(attempt, ctx.conf)
+        with qlc.bind(getattr(self, "_query_ctx", None)):
+            with_device_retry(attempt, ctx.conf)
 
     def _materialize_maps_pipelined(self, sid: int, ctx: TaskContext, mgr,
                                     n_threads: int,
@@ -304,6 +315,9 @@ class _ExchangeBase:
             else list(range(self._n_maps))
         failures = 0
         while pending:
+            # reduce-fetch cancellation boundary: runs on the consumer
+            # thread (bound) or a prefetch worker (bound via inheritance)
+            qlc.checkpoint(f"exchange.fetch s{self._shuffle_id}r{idx}")
             it = mgr.iter_partition_sources(self._shuffle_id, idx,
                                             self._n_maps,
                                             map_ids=list(pending))
@@ -358,6 +372,7 @@ class _ExchangeBase:
         limit = self._fetch_retry_limit(ctx)
         failures = 0
         while True:
+            qlc.checkpoint(f"exchange.fetch s{self._shuffle_id}r{idx}")
             try:
                 return with_device_retry(fetch, ctx.conf)
             except FetchFailedError as ff:

@@ -30,7 +30,10 @@ import multiprocessing as mp
 import os
 import pickle
 import threading
+import time
 from typing import List, Optional
+
+from .serving import query_context as _qlc
 
 _POOL_LOCK = threading.Lock()
 _POOL: Optional["PythonWorkerPool"] = None
@@ -169,32 +172,52 @@ class PythonWorkerPool:
         admission semaphore, then on that worker's pipe.
 
         On timeout the wedged worker is killed and replaced — only its own
-        pipe is torn, so sibling workers and their callers are unaffected."""
+        pipe is torn, so sibling workers and their callers are unaffected.
+
+        The round-trip is a cooperative cancellation boundary (docs/
+        robustness.md "Query lifecycle"): the poll runs in short slices
+        re-checking the bound query's cancel token/deadline, so a
+        cancelled query abandons the round-trip promptly instead of
+        blocking the full timeout. An abandoned worker still computing is
+        killed and replaced — its pending result must never be delivered
+        to the NEXT caller of a recycled worker."""
+        _qlc.checkpoint("udf.run")
         with self.semaphore:
             w = self._acquire_worker()
             replacement: Optional[_Worker] = w
+
+            def discard_and_replace() -> Optional[_Worker]:
+                # kill the (wedged/abandoned/dead) worker — never requeue
+                # it, its pipe state is stale — and best-effort respawn
+                w.kill()
+                try:
+                    return _Worker(self._ctx)
+                except Exception:  # noqa: BLE001
+                    return None  # pool self-heals in _acquire_worker
+
             try:
                 try:
                     w.conn.send((fn_blob, _ipc_write(list(arrays))))
-                    if not w.conn.poll(timeout):
-                        w.kill()
-                        replacement = None  # never requeue the dead worker
+                    end = time.monotonic() + timeout
+                    while not w.conn.poll(
+                            min(0.2, max(0.0, end - time.monotonic()))):
                         try:
-                            replacement = _Worker(self._ctx)
-                        except Exception:  # noqa: BLE001
-                            pass  # pool self-heals in _acquire_worker
-                        raise TimeoutError("python UDF worker timed out")
+                            _qlc.checkpoint("udf.poll")
+                        except BaseException:
+                            # cancelled mid-round-trip: the in-flight
+                            # result is stale — discard the worker, unwind
+                            replacement = discard_and_replace()
+                            raise
+                        if time.monotonic() >= end:
+                            replacement = discard_and_replace()
+                            raise TimeoutError(
+                                "python UDF worker timed out")
                     status, payload = w.conn.recv()
                 except TimeoutError:
                     raise  # ours (subclass of OSError — don't swallow below)
                 except (EOFError, OSError) as e:
                     # worker died mid-task (crash/OOM): replace it
-                    w.kill()
-                    replacement = None  # never requeue the dead worker
-                    try:
-                        replacement = _Worker(self._ctx)
-                    except Exception:  # noqa: BLE001
-                        pass  # pool self-heals in _acquire_worker
+                    replacement = discard_and_replace()
                     raise RuntimeError(f"python UDF worker died: {e!r}")
             finally:
                 self._release_worker(replacement)

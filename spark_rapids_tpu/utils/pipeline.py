@@ -41,7 +41,12 @@ def prefetch_iterator(it: Iterator[T], depth: int) -> Iterator[T]:
     # unbound worker's spans/syncs would vanish from the owning query's
     # record and break bundle reconciliation); a no-op when untraced
     from ..obs import tracer as _obs
+    from ..serving import query_context as _qlc
     obs_parent = _obs.current_span()
+    # same for the query lifecycle binding: checkpoints inside the
+    # producer's frames (reduce fetch, nested operator pulls) must see
+    # the consumer's query so a cancel/deadline stops the prefetch too
+    qctx = _qlc.current()
 
     def _put(item) -> bool:
         while not stop.is_set():
@@ -54,7 +59,7 @@ def prefetch_iterator(it: Iterator[T], depth: int) -> Iterator[T]:
 
     def work() -> None:
         try:
-            with _obs.inherit(obs_parent):
+            with _obs.inherit(obs_parent), _qlc.bind(qctx):
                 for item in it:
                     if not _put(item):
                         return

@@ -1,0 +1,611 @@
+"""Query lifecycle robustness (ISSUE 14): the scheduler service — HBM
+admission control, bounded-queue backpressure, round-robin fairness,
+deadlines, cooperative cancellation, per-query retry budgets, fault
+isolation — and the N=4 concurrent-session chaos soak (ROADMAP 1(c)).
+
+The cancellation-cleanliness sweep (cancel at every checkpoint boundary →
+resources return to baseline) lives in test_resource_lifecycle.py as the
+dynamic twin of TL020; this suite covers the scheduler semantics and the
+multi-tenant acceptance bars."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import spark_rapids_tpu.functions as F  # noqa: F401 — session dep
+from spark_rapids_tpu.chaos import FaultInjector
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+from spark_rapids_tpu.memory.hbm import HbmBudget
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.obs import metrics as obs_metrics
+from spark_rapids_tpu.serving.query_context import (QueryCancelledError,
+                                                    QueryContext,
+                                                    QueryDeadlineExceeded,
+                                                    QueryQueueFull)
+from spark_rapids_tpu.serving.scheduler import QueryScheduler
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    FaultInjector.reset_for_tests()
+    QueryScheduler.reset_for_tests()
+    yield
+    FaultInjector.reset_for_tests()
+    QueryScheduler.reset_for_tests()
+
+
+def _counter(name):
+    cells = obs_metrics.MetricsRegistry.get().snapshot()["counters"].get(
+        name, {})
+    return sum(cells.values())
+
+
+def _resource_baseline():
+    return {"cleaner": len(MemoryCleaner.get().live_resources()),
+            "hbm": HbmBudget.get().used}
+
+
+def _assert_resource_baseline(before):
+    assert len(MemoryCleaner.get().live_resources()) == before["cleaner"]
+    assert HbmBudget.get().used == before["hbm"]
+    sem = TpuSemaphore._instance
+    if sem is not None:
+        assert sem._sem._value == sem.permits
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_conf_deadline_raises_typed_error_and_counts():
+    before = _counter("query.deadline_exceeded")
+    s = TpuSession({"spark.rapids.tpu.query.timeoutMs": "1"})
+    rows = [{"k": i % 50, "v": i} for i in range(4000)]
+    df = s.createDataFrame(rows, num_partitions=8).repartition(
+        8, "k").groupBy("k").sum("v")
+    with pytest.raises(QueryDeadlineExceeded):
+        df.collect()
+    assert _counter("query.deadline_exceeded") == before + 1
+    # the per-call override WINS over the session conf: a generous call
+    # timeout lets the same frame complete
+    assert len(df.collect(timeout=300)) == 50
+
+
+def test_collect_timeout_overrides_session_conf():
+    s = TpuSession({})  # no session deadline
+    df = s.createDataFrame([{"v": i} for i in range(100)],
+                           num_partitions=4)
+    with pytest.raises(QueryDeadlineExceeded):
+        df.collect(timeout=0.0000001)
+    assert len(df.collect()) == 100  # and the session stays healthy
+
+
+# ---------------------------------------------------------------------------
+# queue-full backpressure + admission
+# ---------------------------------------------------------------------------
+
+def test_queue_full_is_typed_backpressure_and_counted():
+    sched = QueryScheduler.get()
+    sched.max_concurrent, sched.max_queue = 1, 1
+    before = _counter("query.rejected_queue_full")
+    hold, started = threading.Event(), threading.Event()
+
+    def occupier():
+        with QueryContext("occ", "sA") as q:
+            sched.submit_and_run(
+                q, lambda: (started.set(), hold.wait(10)))
+
+    t0 = threading.Thread(target=occupier)
+    t0.start()
+    assert started.wait(10)
+
+    queued_up = threading.Event()
+    errs = {}
+
+    def queued():
+        try:
+            with QueryContext("waiting", "sB") as q:
+                queued_up.set()
+                sched.submit_and_run(q, lambda: None)
+        except BaseException as e:  # noqa: BLE001
+            errs["queued"] = e
+
+    t1 = threading.Thread(target=queued)
+    t1.start()
+    assert queued_up.wait(10)
+    time.sleep(0.2)  # let the ticket actually enqueue
+    # the queue (bound 1) is full: the third submission is REJECTED with
+    # the typed error before acquiring anything
+    with pytest.raises(QueryQueueFull):
+        with QueryContext("rejected", "sC") as q:
+            sched.submit_and_run(q, lambda: None)
+    assert _counter("query.rejected_queue_full") == before + 1
+    hold.set()
+    t0.join()
+    t1.join()
+    assert not errs
+
+
+def test_round_robin_fairness_across_sessions():
+    """One chatty session queues 2 ahead of a neighbor's 1; the neighbor's
+    query is granted between them (FIFO per session, RR across)."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent = 1
+    hold, started = threading.Event(), threading.Event()
+    order = []
+
+    def occupier():
+        with QueryContext("occ", "s0") as q:
+            sched.submit_and_run(
+                q, lambda: (started.set(), hold.wait(10)))
+
+    t0 = threading.Thread(target=occupier)
+    t0.start()
+    assert started.wait(10)
+
+    def submit(name, sid):
+        def run():
+            with QueryContext(name, sid) as q:
+                sched.submit_and_run(q, lambda: order.append(name))
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    threads = [submit("a1", "A")]
+    time.sleep(0.15)
+    threads.append(submit("a2", "A"))
+    time.sleep(0.15)
+    threads.append(submit("b1", "B"))
+    time.sleep(0.15)
+    hold.set()
+    t0.join()
+    for t in threads:
+        t.join()
+    assert order == ["a1", "b1", "a2"]
+
+
+def test_hbm_watermark_gates_admission_until_headroom():
+    """A second query is NOT admitted while one runs with HBM above the
+    watermark; it admits within a poll tick once headroom opens. With the
+    device idle the watermark is waived (progress guarantee)."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent, sched.hbm_watermark = 4, 0.5
+    b = HbmBudget.reset_for_tests(budget_bytes=1_000_000)
+    try:
+        b.used = 900_000  # way above the 0.5 watermark
+        hold, started = threading.Event(), threading.Event()
+
+        def q1():  # admitted: nothing running → watermark waived
+            with QueryContext("q1", "s") as q:
+                sched.submit_and_run(
+                    q, lambda: (started.set(), hold.wait(10)))
+
+        t1 = threading.Thread(target=q1)
+        t1.start()
+        assert started.wait(10)
+        ran = []
+
+        def q2():
+            with QueryContext("q2", "s") as q:
+                sched.submit_and_run(q, lambda: ran.append(1))
+
+        t2 = threading.Thread(target=q2)
+        t2.start()
+        time.sleep(0.4)
+        assert not ran  # held back by the watermark while q1 runs
+        b.used = 100_000  # headroom opens mid-query...
+        t2.join(timeout=10)
+        assert ran  # ...and the waiter's re-evaluation admits it
+        hold.set()
+        t1.join()
+    finally:
+        hold.set()
+        HbmBudget.reset_for_tests()
+
+
+def test_sched_admit_chaos_io_error_fails_admission_cleanly():
+    s = TpuSession({})
+    df = s.createDataFrame([{"v": i} for i in range(50)],
+                           num_partitions=2)
+    assert len(df.collect()) == 50  # warm
+    before = _resource_baseline()
+    FaultInjector.get().force("sched.admit", "io_error", 1)
+    with pytest.raises(OSError):
+        df.collect()
+    FaultInjector.get().clear_forced()
+    _assert_resource_baseline(before)
+    assert len(df.collect()) == 50
+
+
+# ---------------------------------------------------------------------------
+# session.cancel() / stop() / with-style
+# ---------------------------------------------------------------------------
+
+def test_session_cancel_cancels_inflight_query():
+    from spark_rapids_tpu.obs import flight
+    # stretch the query with latency chaos at the checkpoint site so the
+    # cancel window is wide
+    FaultInjector.configure(RapidsConf({
+        "spark.rapids.tpu.test.chaos.enabled": "true",
+        "spark.rapids.tpu.test.chaos.sites": "query.cancel",
+        "spark.rapids.tpu.test.chaos.kinds": "latency",
+        "spark.rapids.tpu.test.chaos.probability": "1.0",
+        "spark.rapids.tpu.test.chaos.latencyMs": "30",
+    }))
+    before_cancelled = _counter("query.cancelled")
+    s = TpuSession({"spark.sql.shuffle.partitions": "3"})
+    rows = [{"k": i % 20, "v": i} for i in range(2000)]
+    df = s.createDataFrame(rows, num_partitions=4).repartition(
+        3, "k").groupBy("k").sum("v")
+    errs = {}
+
+    def run():
+        try:
+            df.collect()
+        except BaseException as e:  # noqa: BLE001
+            errs["q"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 10
+    while obs_metrics.active_query_count() == 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    flagged = s.cancel()
+    t.join(timeout=30)
+    assert flagged >= 1
+    assert isinstance(errs.get("q"), QueryCancelledError)
+    assert _counter("query.cancelled") == before_cancelled + 1
+    events = [r["event"] for r in flight.snapshot()]
+    assert "query.cancelling" in events
+    assert "query.cancelled" in events
+
+
+def test_session_stop_is_idempotent_and_releases_shared_state():
+    import weakref
+
+    from spark_rapids_tpu.serving import scheduler as sched_mod
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    old_live = sched_mod._LIVE_SESSIONS
+    sched_mod._LIVE_SESSIONS = weakref.WeakSet()
+    try:
+        s1 = TpuSession({"spark.sql.shuffle.partitions": "2"})
+        s2 = TpuSession({})
+        rows = [{"k": i % 3, "v": i} for i in range(100)]
+        s1.createDataFrame(rows, num_partitions=2).repartition(
+            2, "k").to_arrow()
+        mgr = TpuShuffleManager.get()
+        root = mgr.root
+        s1.stop()
+        # s2 is still a live frontend: the shared manager must survive
+        assert TpuShuffleManager._instance is mgr
+        s1.stop()  # idempotent
+        # a stopped session refuses to execute — it must not silently
+        # resurrect the shared shuffle manager with no owner left
+        with pytest.raises(RuntimeError, match="stopped"):
+            s1.range(5).count()
+        s2.stop()  # LAST session out: pools + block store released
+        assert TpuShuffleManager._instance is None
+        assert not os.path.exists(root)
+        # a later session lazily recreates the singleton
+        s3 = TpuSession({})
+        assert s3.range(10).count() == 10
+    finally:
+        sched_mod._LIVE_SESSIONS = old_live
+
+
+def test_shared_release_deferred_past_straggler_query():
+    """If the last session's stop() cannot release the shuffle manager
+    (a straggler query outlived the drain), the release stays PENDING
+    and fires when the straggler ends — never silently skipped forever."""
+    import weakref
+
+    from spark_rapids_tpu.serving import scheduler as sched_mod
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    old_live = sched_mod._LIVE_SESSIONS
+    sched_mod._LIVE_SESSIONS = weakref.WeakSet()  # no live frontends
+    try:
+        mgr = TpuShuffleManager.get()
+        tok = obs_metrics.query_begin("straggler")  # a query still active
+        assert not sched_mod.request_shared_release()  # pending, not done
+        assert TpuShuffleManager._instance is mgr
+        obs_metrics.query_end(tok)  # the straggler finally finishes...
+        assert sched_mod.maybe_release_shared()  # ...and the release fires
+        assert TpuShuffleManager._instance is None
+        # a new frontend cancels any stale pending release
+        s = TpuSession({})
+        assert not sched_mod.maybe_release_shared()
+        s.stop()
+    finally:
+        sched_mod._LIVE_SESSIONS = old_live
+        sched_mod._SHARED_RELEASE_PENDING = False
+
+
+def test_session_with_style_and_stop_drains_inflight():
+    FaultInjector.configure(RapidsConf({
+        "spark.rapids.tpu.test.chaos.enabled": "true",
+        "spark.rapids.tpu.test.chaos.sites": "query.cancel",
+        "spark.rapids.tpu.test.chaos.kinds": "latency",
+        "spark.rapids.tpu.test.chaos.probability": "1.0",
+        "spark.rapids.tpu.test.chaos.latencyMs": "30",
+    }))
+    errs = {}
+    with TpuSession({"spark.sql.shuffle.partitions": "3"}) as s:
+        rows = [{"k": i % 20, "v": i} for i in range(2000)]
+        df = s.createDataFrame(rows, num_partitions=4).repartition(
+            3, "k").groupBy("k").sum("v")
+
+        def run():
+            try:
+                df.collect()
+            except BaseException as e:  # noqa: BLE001
+                errs["q"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 10
+        while obs_metrics.active_query_count() == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+    # __exit__ → stop(): the in-flight query was cancelled AND drained
+    # before stop returned
+    t.join(timeout=30)
+    assert isinstance(errs.get("q"), QueryCancelledError)
+    assert s._stopped
+    assert obs_metrics.active_query_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# fault isolation
+# ---------------------------------------------------------------------------
+
+def test_fatal_quarantine_skips_exit_with_concurrent_queries(monkeypatch):
+    """exit_on_fatal with CONCURRENT healthy queries quarantines the
+    failed query (counter + flight) instead of killing the process; the
+    single-tenant case still exits (the managed-executor contract)."""
+    import spark_rapids_tpu.failure as failure
+    exited = []
+    monkeypatch.setattr(os, "_exit", lambda code: exited.append(code))
+    conf = RapidsConf({})
+    t1 = obs_metrics.query_begin("iso-a")
+    t2 = obs_metrics.query_begin("iso-b")
+    before = _counter("query.quarantined")
+    try:
+        failure.handle_task_failure(
+            RuntimeError("INTERNAL: chaos-injected fatal device error"),
+            conf, exit_on_fatal=True)
+    finally:
+        obs_metrics.query_end(t1)
+        obs_metrics.query_end(t2)
+    assert exited == []
+    assert _counter("query.quarantined") == before + 1
+    t3 = obs_metrics.query_begin("iso-solo")
+    try:
+        failure.handle_task_failure(
+            RuntimeError("INTERNAL: fatal again"), conf,
+            exit_on_fatal=True)
+    finally:
+        obs_metrics.query_end(t3)
+    assert exited == [1]
+
+
+def test_fatal_in_one_query_leaves_concurrent_queries_correct(tmp_path):
+    """A chaos-injected fatal error kills exactly ONE in-flight query;
+    every other concurrent query (3 sessions × several queries) completes
+    bit-identical to its clean baseline, the failure lands in a
+    postmortem bundle, and metrics_snapshot() shows it."""
+    N = 3
+    confs = [{"spark.sql.shuffle.partitions": str(2 + i),
+              "spark.rapids.tpu.obs.postmortemDir": str(tmp_path),
+              "spark.rapids.tpu.deviceRetry.backoffBaseMs": "1"}
+             for i in range(N)]
+
+    def queries(s, i):
+        rows = [{"k": (j * 7 + i) % 13, "v": j * 3 - 50}
+                for j in range(300)]
+        fd = s.createDataFrame(rows, num_partitions=3)
+        return [fd.repartition(2 + i, "k").groupBy("k").sum("v"),
+                fd.filter(fd["v"] > 0).select("k"),
+                fd.sort("v")]
+
+    # clean baselines, one fresh session each
+    baselines = []
+    for i in range(N):
+        s = TpuSession(confs[i])
+        baselines.append([sorted(q.collect(), key=str)
+                          for q in queries(s, i)])
+    fatal_before = _counter("device.fatal_errors")
+    sessions = [TpuSession(confs[i]) for i in range(N)]
+    barrier = threading.Barrier(N)
+    results = [[] for _ in range(N)]
+    errors = [[] for _ in range(N)]
+
+    def run(i):
+        barrier.wait(timeout=30)
+        for rep in range(3):
+            for q in queries(sessions[i], i):
+                try:
+                    results[i].append(sorted(q.collect(), key=str))
+                except BaseException as e:  # noqa: BLE001
+                    results[i].append(None)
+                    errors[i].append(e)
+
+    # ONE fatal, delivered to whichever query dispatches next once the
+    # threads are racing — the session whose query eats it keeps serving
+    # its remaining queries
+    FaultInjector.get().force("device.dispatch", "fatal", 1)
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    FaultInjector.get().clear_forced()
+    all_errors = [e for lst in errors for e in lst]
+    assert len(all_errors) == 1, all_errors  # exactly one query died
+    assert "INTERNAL" in str(all_errors[0])
+    # every completed query is bit-identical to its baseline
+    for i in range(N):
+        for rep in range(3):
+            for j, expect in enumerate(baselines[i]):
+                got = results[i][rep * len(baselines[i]) + j]
+                if got is not None:
+                    assert got == expect, (i, rep, j)
+    assert _counter("device.fatal_errors") == fatal_before + 1
+    pm = [f for f in os.listdir(tmp_path)
+          if f.startswith("postmortem-fatal_device_error")]
+    assert pm, "fatal error left no postmortem bundle"
+    snap = sessions[0].metrics_snapshot()
+    assert sum(snap["counters"].get("device.fatal_errors",
+                                    {}).values()) >= 1
+
+
+def test_retry_budget_exhaustion_fails_query_alone():
+    before = _counter("query.retry_budget_exhausted")
+    s = TpuSession({"spark.rapids.tpu.query.retryBudget": "0",
+                    "spark.rapids.tpu.deviceRetry.maxAttempts": "8",
+                    "spark.rapids.tpu.deviceRetry.backoffBaseMs": "1"})
+    df = s.createDataFrame([{"v": i} for i in range(100)],
+                           num_partitions=2).filter(F.col("v") > 10)
+    assert len(df.collect()) == 89  # warm
+    FaultInjector.get().force("device.dispatch", "transient", 1)
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        df.collect()
+    FaultInjector.get().clear_forced()
+    assert _counter("query.retry_budget_exhausted") == before + 1
+    # the same fault under the default budget heals transparently — the
+    # budget isolates the flapping query, it does not disable the retry
+    s2 = TpuSession({"spark.rapids.tpu.deviceRetry.backoffBaseMs": "1"})
+    df2 = s2.createDataFrame([{"v": i} for i in range(100)],
+                             num_partitions=2).filter(F.col("v") > 10)
+    assert len(df2.collect()) == 89
+    FaultInjector.get().force("device.dispatch", "transient", 1)
+    assert len(df2.collect()) == 89
+    FaultInjector.get().clear_forced()
+
+
+# ---------------------------------------------------------------------------
+# observability coverage
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_flight_events_and_postmortem_scheduler_state():
+    from spark_rapids_tpu.obs import flight
+    s = TpuSession({})
+    s.createDataFrame([{"v": 1}]).collect()
+    events = [r["event"] for r in flight.snapshot()]
+    assert "query.queued" in events
+    assert "query.admitted" in events
+    pm = flight.build_postmortem("test")
+    sched_state = pm["engine_state"]["scheduler"]
+    assert set(sched_state) >= {"queued", "running", "queue_depth",
+                                "max_concurrent"}
+    snap = s.metrics_snapshot()
+    assert "sched.queue_depth" in snap["gauges"]
+    assert "sched.admit_wait_ms" in snap["histograms"]
+    assert "scheduler" in snap["external"]
+
+
+# ---------------------------------------------------------------------------
+# the N=4 concurrent-session chaos soak (ROADMAP 1(c) / acceptance bar)
+# ---------------------------------------------------------------------------
+
+_SOAK_CHAOS = {
+    "spark.rapids.tpu.test.chaos.enabled": "true",
+    "spark.rapids.tpu.test.chaos.seed": "11",
+    # healable kinds only: the soak's bar is bit-identity, so no kind may
+    # legitimately change results (`query.cancel` still draws latency)
+    "spark.rapids.tpu.test.chaos.kinds":
+        "retry_oom,split_oom,transient,latency",
+    "spark.rapids.tpu.test.chaos.probability": "0.08",
+    "spark.rapids.tpu.test.chaos.latencyMs": "2",
+}
+
+_SOAK_SESSION = {
+    "spark.rapids.tpu.shuffle.pipeline.enabled": "true",
+    "spark.rapids.tpu.trace.enabled": "true",
+    "spark.rapids.tpu.deviceRetry.maxAttempts": "8",
+    "spark.rapids.tpu.deviceRetry.backoffBaseMs": "1",
+    "spark.rapids.tpu.deviceRetry.backoffMaxMs": "4",
+    "spark.rapids.tpu.shuffle.fetchRetry.maxAttempts": "8",
+}
+
+
+def _soak_queries(s: TpuSession, i: int):
+    """Mixed shapes, integer-exact measures (bit-identical under any
+    retry/split schedule): project/filter, shuffled agg, join, sort."""
+    rows = [{"k": (j * 7 + i) % 11, "v": j * 3 - 50, "w": j % 13}
+            for j in range(360)]
+    dim = [{"k2": j, "q": j * 11} for j in range(11)]
+    fd = s.createDataFrame(rows, num_partitions=4)
+    dd = s.createDataFrame(dim, num_partitions=2)
+    return [
+        fd.filter(fd["v"] > 0).select("k", "w"),
+        fd.repartition(3 + i, "k").groupBy("k").sum("v"),
+        fd.join(dd, fd["k"] == dd["k2"], "inner").groupBy("k").sum("q"),
+        fd.sort("v", "k", "w"),
+    ]
+
+
+def test_concurrent_session_soak_bit_identical_zero_leaks():
+    """N=4 sessions × mixed queries × seeded chaos at EVERY site (incl.
+    sched.admit and query.cancel): results bit-identical to clean
+    single-session runs, zero permit/HBM/cleaner leaks, and each
+    session's last_query_profile() bundle reconciles."""
+    N = 4
+    # clean single-session baselines first (chaos off)
+    baselines = []
+    for i in range(N):
+        s = TpuSession({"spark.sql.shuffle.partitions": "4"})
+        baselines.append([sorted(q.collect(), key=str)
+                          for q in _soak_queries(s, i)])
+        s.stop()
+    before = _resource_baseline()
+    # the chaos conf rides the session conf (the session arms the
+    # process-wide injector at construction, the test_chaos soak idiom)
+    sessions = [
+        TpuSession(dict(_SOAK_SESSION, **_SOAK_CHAOS,
+                        **{"spark.sql.shuffle.partitions": "4",
+                           "spark.rapids.tpu.trace.tag": f"soak{i}"}))
+        for i in range(N)]
+    barrier = threading.Barrier(N)
+    results = [None] * N
+    errors = {}
+
+    def run(i):
+        try:
+            barrier.wait(timeout=60)
+            out = []
+            for _rep in range(2):
+                out.append([sorted(q.collect(), key=str)
+                            for q in _soak_queries(sessions[i], i)])
+            results[i] = out
+        except BaseException as e:  # noqa: BLE001
+            errors[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert FaultInjector.get().injection_count() > 0  # chaos actually ran
+    for i in range(N):
+        for rep_out in results[i]:
+            assert rep_out == baselines[i], f"session {i} diverged"
+    # per-session bundles reconcile (each query traced under concurrency:
+    # reconciliation runs against the query's OWN counters)
+    for i, s in enumerate(sessions):
+        p = s.last_query_profile()
+        assert p is not None, f"session {i} last query ran untraced"
+        rec = p["reconcile"]
+        assert not rec["overflow"]
+        assert rec["dispatch_ok"], (i, p["dispatches_by_kind"])
+        assert rec["sync_ok"], (i, p["by_operator"])
+    # zero leaks: permits, HBM, cleaner all at baseline
+    FaultInjector.reset_for_tests()
+    _assert_resource_baseline(before)
+    for s in sessions:
+        s.stop()

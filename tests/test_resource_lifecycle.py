@@ -33,6 +33,13 @@ def _fresh_state():
     TpuSemaphore.reset_for_tests()
 
 
+def _add_one_pd(a):
+    # module-level so it pickles into the UDF worker processes; the fn
+    # contract is fn(*pyarrow.Array) -> pyarrow.Array
+    import pyarrow.compute as pc
+    return pc.add(a, 1.0)
+
+
 def _table(n=512):
     return pa.table({"k": pa.array([i % 7 for i in range(n)], pa.int64()),
                      "v": pa.array([i * 3 - 11 for i in range(n)],
@@ -177,6 +184,127 @@ def test_scan_with_io_error_keeps_fd_count_stable(tmp_path):
     # must close with it, not wait for GC
     s.read.parquet(str(tmp_path)).limit(5).to_arrow()
     assert fd_count() == before
+
+
+# ---------------------------------------------------------------------------
+# cancellation cleanliness: the dynamic twin of TL020 for the query
+# lifecycle (ISSUE 14). A cancel landing at ANY cooperative checkpoint —
+# partition-task start, batch pull, exchange map task, reduce fetch, mesh
+# collective launch — must unwind through the audited release paths:
+# permits, HBM, spill dirs, the MemoryCleaner and the tracer all return
+# to baseline. The chaos `query.cancel` site fires at every checkpoint;
+# force(..., skip=k) lands the cancel at exactly the k-th boundary visit,
+# so the sweep walks the cancellation across the query's whole lifetime.
+# ---------------------------------------------------------------------------
+
+_CANCEL_SHAPES = {
+    "pipeline": {
+        "spark.sql.shuffle.partitions": "3",
+        "spark.rapids.tpu.shuffle.pipeline.enabled": "true",
+    },
+    "sort": {
+        "spark.rapids.sql.batchSizeRows": "128",
+    },
+    "mesh": {
+        "spark.rapids.shuffle.mode": "ICI",
+        "spark.rapids.tpu.mesh.enabled": "true",
+        "spark.sql.shuffle.partitions": "8",
+    },
+}
+
+
+def _cancel_query(shape: str, s: TpuSession):
+    rows = [{"k": (i * 37) % 50, "v": i * 3 - 11} for i in range(800)]
+    df = s.createDataFrame(rows, num_partitions=4)
+    if shape == "sort":
+        return df.sort("k")
+    return df.repartition(int(
+        s.conf.get("spark.sql.shuffle.partitions")), "k").groupBy(
+        "k").sum("v")
+
+
+@pytest.mark.parametrize("skip", [0, 1, 2, 5, 11, 23])
+@pytest.mark.parametrize("shape", sorted(_CANCEL_SHAPES))
+def test_cancel_at_each_checkpoint_returns_all_resources(shape, skip):
+    from spark_rapids_tpu import obs
+    from spark_rapids_tpu.serving.query_context import QueryCancelledError
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    s = TpuSession(dict(_CANCEL_SHAPES[shape],
+                        **{"spark.rapids.tpu.trace.enabled": "true"}))
+    df = _cancel_query(shape, s)
+    expected = sorted(df.collect(), key=str)  # clean warm run
+    before = _baseline()
+    mgr_root = TpuShuffleManager.get().root
+    dirs_before = set(os.listdir(mgr_root))
+    FaultInjector.get().force("query.cancel", "cancel", 1, skip=skip)
+    try:
+        got = df.collect()
+        # skip beyond the query's last checkpoint: it completes — also a
+        # valid outcome of "cancel raced against every boundary"
+        assert sorted(got, key=str) == expected
+    except QueryCancelledError:
+        pass
+    finally:
+        FaultInjector.get().clear_forced()
+    _assert_baseline(before)
+    # the tracer disarmed (a cancelled traced query must end_query on
+    # the unwind) and the shuffle store kept no stray block dirs
+    assert not obs.is_active()
+    assert set(os.listdir(mgr_root)) <= dirs_before
+    # the session is healthy: the SAME DataFrame re-executes cleanly
+    assert sorted(df.collect(), key=str) == expected
+
+
+def test_deadline_expiry_mid_query_returns_all_resources():
+    """The deadline flavor of the sweep: a timeout that can only fire
+    mid-execution (first checkpoint passes, a later one trips) releases
+    everything — the TIMED_OUT path shares the cancel unwind."""
+    import time as _time
+
+    from spark_rapids_tpu.serving.query_context import \
+        QueryDeadlineExceeded
+    s = TpuSession({"spark.sql.shuffle.partitions": "3",
+                    "spark.rapids.tpu.shuffle.pipeline.enabled": "true"})
+    rows = [{"k": i % 20, "v": i} for i in range(2000)]
+    df = s.createDataFrame(rows, num_partitions=4).repartition(
+        3, "k").groupBy("k").sum("v")
+    expected = sorted(df.collect(), key=str)
+    before = _baseline()
+    # latency chaos stretches the query so a short deadline lands inside
+    FaultInjector.get().force("query.cancel", "latency", 50)
+    t0 = _time.monotonic()
+    with pytest.raises(QueryDeadlineExceeded):
+        df.collect(timeout=0.001)
+    FaultInjector.get().clear_forced()
+    assert _time.monotonic() - t0 < 30  # cooperative, but prompt
+    _assert_baseline(before)
+    assert sorted(df.collect(), key=str) == expected
+
+
+def test_cancel_during_udf_worker_round_trip_returns_all_resources():
+    """Cancellation at the UDF worker round-trip boundary: the abandoned
+    worker is killed and replaced (its stale result must never reach the
+    next caller), the permit/pool state stays sane, and the pool still
+    serves the re-run."""
+    from spark_rapids_tpu.serving.query_context import QueryCancelledError
+    from spark_rapids_tpu.types import DoubleType
+    from spark_rapids_tpu.udf import pandas_udf
+    s = TpuSession({"spark.rapids.sql.python.numWorkers": "2"})
+    add_one = pandas_udf(DoubleType())(_add_one_pd)
+    rows = [{"v": float(i)} for i in range(64)]
+    df = s.createDataFrame(rows, num_partitions=2)
+    out = df.select(add_one(F.col("v")).alias("w"))
+    expected = sorted(out.collect(), key=str)
+    before = _baseline()
+    FaultInjector.get().force("query.cancel", "cancel", 1, skip=2)
+    try:
+        out.collect()
+    except QueryCancelledError:
+        pass
+    finally:
+        FaultInjector.get().clear_forced()
+    _assert_baseline(before)
+    assert sorted(out.collect(), key=str) == expected
 
 
 # ---------------------------------------------------------------------------

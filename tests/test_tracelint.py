@@ -884,6 +884,83 @@ def test_tl023_uncovered_boundary_in_tracked_scope():
     assert [f.rule for f in nm if f.rule == "TL023"] == []
 
 
+def test_tl020_query_context_tracked_in_serving():
+    """ISSUE 14: a QueryContext acquisition (it registers in the
+    scheduler's session index) with raise-capable work and no guaranteed
+    close leaks; the with-style RAII the executor uses is accepted."""
+    tp = _tl020("""\
+        from spark_rapids_tpu.serving.query_context import QueryContext
+        def f(run):
+            q = QueryContext("q", "s")
+            out = run(q)
+            q.close()
+            return out
+        """, relpath="serving/x.py")
+    assert [f.rule for f in tp] == ["TL020"]
+    assert "query-ctx" in tp[0].message
+    nm = _tl020("""\
+        from spark_rapids_tpu.serving.query_context import QueryContext
+        def f(run):
+            with QueryContext("q", "s") as q:
+                return run(q)
+        def g(run):
+            q = QueryContext("q", "s")
+            try:
+                return run(q)
+            finally:
+                q.close()
+        """, relpath="serving/x.py")
+    assert [f.rule for f in nm if f.rule == "TL020"] == []
+
+
+def test_serving_package_is_covered_by_tl02x():
+    """The lint walks serving/ (the scheduler is exactly the multiplier
+    TL020-TL023 were built to de-risk), the scheduler lock is declared in
+    the lock order, and the lifecycle WIRED table covers the new sites."""
+    from spark_rapids_tpu.analysis.lifecycle import (LIFECYCLE_SUBPACKAGES,
+                                                     WIRED_CALLS)
+    from spark_rapids_tpu.analysis.locks import (LOCK_ORDER,
+                                                 LOCKS_SUBPACKAGES)
+    assert "serving" in LIFECYCLE_SUBPACKAGES
+    assert "serving" in LOCKS_SUBPACKAGES
+    declared = {name for level in LOCK_ORDER for name in level}
+    assert "QueryScheduler._mu" in declared
+    assert WIRED_CALLS["submit_and_run"] == "sched.admit"
+    assert WIRED_CALLS["checkpoint"] == "query.cancel"
+
+
+def test_tl022_scheduler_lock_level_orders_correctly():
+    """Under QueryScheduler._mu the registry structure lock (one level
+    below) is legal; re-acquiring a long-held orchestration lock
+    (_mat_lock, declared ABOVE it) is a violation."""
+    from spark_rapids_tpu.analysis.locks import _check_order
+    _, edges = _tl021("""\
+        import threading
+        _REG_LOCK = threading.Lock()
+        class QueryScheduler:
+            def __init__(self):
+                self._mu = threading.Lock()
+            def depth(self):
+                with self._mu:
+                    with _REG_LOCK:
+                        pass
+        """, relpath="serving/scheduler.py")
+    assert _check_order(edges) == []
+    _, edges = _tl021("""\
+        import threading
+        _mat_lock = threading.Lock()
+        class QueryScheduler:
+            def __init__(self):
+                self._mu = threading.Lock()
+            def bad(self):
+                with self._mu:
+                    with _mat_lock:
+                        pass
+        """, relpath="serving/scheduler.py")
+    findings = _check_order(edges)
+    assert any("lock-order violation" in f.message for f in findings)
+
+
 def test_tl023_wired_sites_exist_in_injector():
     """The WIRED/BOUNDARY site names are a contract against
     chaos/injector.py's ALL_SITES — validated at lint time."""
