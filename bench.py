@@ -463,12 +463,22 @@ def _scan_agg(rows: int) -> dict:
                      F.sum(F.col("l_discount")).alias("sum_disc"),
                      F.count(F.col("l_quantity")).alias("cnt")))
 
-    def run(device_on: bool, tag: str) -> dict:
+    def build_strings_query(s):
+        # the STRING-column variant (device BYTE_ARRAY decode): three
+        # string scan columns, string group keys — zero scan.fallback
+        # expected with device decode on, and the dictionary codes from
+        # the parquet pages feed the group-key encode directly
+        df = s.read.parquet(path)
+        return (df.groupBy("l_returnflag", "l_linestatus", "l_shipmode")
+                .agg(F.sum(F.col("l_quantity")).alias("sum_qty"),
+                     F.count(F.col("l_shipinstruct")).alias("cnt")))
+
+    def run(device_on: bool, tag: str, build=build_query) -> dict:
         s = TpuSession({
             "spark.rapids.tpu.parquet.deviceDecode.enabled":
                 str(device_on).lower(),
             "spark.rapids.sql.metricsLevel": "DEBUG"})
-        q = build_query(s)
+        q = build(s)
         q.collect()  # warm: compiles the decode + agg programs
         before = dd.decode_stats()
         sec = _time_best(lambda: q.collect(), iters=2)
@@ -491,6 +501,8 @@ def _scan_agg(rows: int) -> dict:
 
     on = run(True, "scan_agg_device")
     off = run(False, "scan_agg_host")
+    s_on = run(True, "scan_agg_strings_device", build_strings_query)
+    s_off = run(False, "scan_agg_strings_host", build_strings_query)
     dispatch_ok = 0 < on["decode_dispatches"] <= 2 * n_rg  # timed iters
     return {
         "rows": rows,
@@ -498,6 +510,14 @@ def _scan_agg(rows: int) -> dict:
         "row_groups": n_rg,
         "device_on": on,
         "device_off": off,
+        # string-column dataset variant (device BYTE_ARRAY decode): same
+        # file, string scan columns + string group keys, on vs off
+        "strings_on": s_on,
+        "strings_off": s_off,
+        "strings_wall_speedup_on_vs_off": _ratio(s_off["wall_ms"],
+                                                 s_on["wall_ms"]),
+        # the done-bar: BYTE_ARRAY columns must not demote to host
+        "strings_fallback_columns_on": s_on["fallback_columns"],
         "decode_dispatches_O_row_groups": dispatch_ok,
         "wall_speedup_on_vs_off": _ratio(off["wall_ms"], on["wall_ms"]),
         # done-bar: with device decode on, the wall should be dominated by
@@ -981,6 +1001,12 @@ def main() -> None:
                 sa.get("decode_dispatches_O_row_groups"),
             "scan_agg_speedup_on_vs_off":
                 sa.get("wall_speedup_on_vs_off"),
+            # string-column variant: device BYTE_ARRAY decode on vs off,
+            # and the zero-fallback done-bar for BYTE_ARRAY columns
+            "scan_agg_strings_speedup_on_vs_off":
+                sa.get("strings_wall_speedup_on_vs_off"),
+            "scan_agg_strings_fallbacks":
+                sa.get("strings_fallback_columns_on"),
             # multichip (mesh data plane): the q3 per-chip throughput, the
             # fabric collective totals, and the two gate bits — the full
             # per-query record is detail["multichip"] (cumulative lines) /
@@ -996,6 +1022,11 @@ def main() -> None:
             # explains its own efficiency number
             "multichip_q3_attribution": _mc_q.get("efficiency_attribution"),
             "multichip_q3_skew": _mc_q.get("skew"),
+            # dictionary-encoded string exchanges (q1 group keys, q18
+            # c_name): count + map-side encode wall across all queries
+            "multichip_string_collectives":
+                _mc.get("string_collectives_total"),
+            "multichip_dict_encode_ms": _mc.get("dict_encode_ms_total"),
             "multichip_bit_identical": _mc.get("bit_identical_all"),
             "multichip_O_exchanges":
                 _mc.get("collective_launches_O_exchanges"),

@@ -11,11 +11,14 @@ breakdown, scaling efficiency vs 1 chip).
 
 Queries are written with explicit column pruning (`select` before
 joins/aggregations, as Spark's optimizer would produce): exchanges carry
-only referenced columns, so int/date/double payloads ride the fabric
-collective while string-carrying exchanges (q1's group keys, q18's final
-c_name aggregation) take the per-map device-resident path and are reported
-as such — the per-query `collective_launches` vs `exchanges` split is the
-honest coverage number.
+only referenced columns. String-carrying exchanges (q1's group keys,
+q18's final c_name aggregation) ride the collective too since the
+dictionary-encode pass landed (`spark.rapids.tpu.exchange.
+dictionaryEncode.enabled`): the fabric moves int32 codes plus one
+broadcast dictionary per exchange, and the summary records how many
+exchanges used it (`string_collectives`, `dict_encode_ms`) — the
+per-query `collective_launches` vs `exchanges` split stays the honest
+coverage number, now expected to match.
 
 Usage: python benchmarks/multichip.py [--devices N] [--rows N]
 (on a machine without N real chips, run through
@@ -71,17 +74,17 @@ def _q3(rows: int, parts: int):
 
 
 def _q18(rows: int, parts: int):
-    """TPC-H q18, pruned: the join/semi-join exchanges carry int keys and
-    ride the collective; the final aggregation groups on c_custkey (the
-    c_name lookup is equivalent on this schema and keeps the last exchange
-    fixed-width)."""
+    """TPC-H q18, pruned but FAITHFUL on the group keys: the final
+    aggregation groups on c_name + c_custkey like the spec query — the
+    c_name string payload rides the collective as dictionary codes (the
+    r06 round had to substitute c_custkey to stay fixed-width)."""
     def build(s):
         import spark_rapids_tpu.functions as F
         t = _tpch_tables(s, rows, parts)
         li = t["lineitem"].select("l_orderkey", "l_quantity")
         orders = t["orders"].select("o_orderkey", "o_custkey",
                                     "o_orderdate", "o_totalprice")
-        cust = t["customer"].select("c_custkey")
+        cust = t["customer"].select("c_custkey", "c_name")
         big = (li.groupBy("l_orderkey")
                .agg(F.sum(F.col("l_quantity")).alias("total_qty"))
                .filter(F.col("total_qty") > 150))
@@ -90,8 +93,8 @@ def _q18(rows: int, parts: int):
                       how="leftsemi")
                 .join(cust, on=orders["o_custkey"] == cust["c_custkey"])
                 .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
-                .groupBy("c_custkey", "o_orderkey", "o_orderdate",
-                         "o_totalprice")
+                .groupBy("c_name", "c_custkey", "o_orderkey",
+                         "o_orderdate", "o_totalprice")
                 .agg(F.sum(F.col("l_quantity")).alias("sum_qty"))
                 .sort(F.col("o_totalprice").desc(), "o_orderdate")
                 .limit(100))

@@ -61,6 +61,9 @@ echo "== fast tier-1 gate (not slow) =="
 # counter, AQE device statistics, the lost-shard/slow-link chaos heal,
 # and the mesh efficiency profiler: phase-wall attribution, skew/
 # straggler reporting, the collective watchdog, zero profiler syncs)
+# and the device-native string pipeline — BYTE_ARRAY decode oracles,
+# the dictionary-encoded collective exchange round trip + overflow
+# fallback, and the dictionary-coded group-key dispatch assertion)
 # with the slow markers excluded.
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
@@ -70,6 +73,7 @@ python -m pytest \
   tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   tests/test_mesh_profile.py tests/test_query_lifecycle.py \
+  tests/test_string_pipeline.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="

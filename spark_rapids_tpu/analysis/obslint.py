@@ -47,8 +47,10 @@ OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory", "parallel")
 #: individual modules additionally covered: obs/mesh_profile.py is part of
 #: the obs package but is itself an EMITTER (registry histograms, flight
 #: notes, the watchdog) — its emission arguments obey the same
-#: no-blocking-sync contract as engine code
-OBS_MODULES: Tuple[str, ...] = ("obs/mesh_profile.py",)
+#: no-blocking-sync contract as engine code. io/device_decode.py emits
+#: scan.page/scan.fallback events per staged page/demoted column (the
+#: BYTE_ARRAY string staging added more of them) — same contract.
+OBS_MODULES: Tuple[str, ...] = ("obs/mesh_profile.py", "io/device_decode.py")
 
 #: names that count as obs emission entry points when bound from the obs
 #: package (rule 2 scans their call arguments): tracer spans/events,

@@ -407,8 +407,38 @@ def _gather_strings(col: TpuColumnVector, safe_idx, valid, out_rows: int,
     v = valid
     if col.validity is not None:
         v = jnp.take(col.validity, safe_idx) & valid
-    return TpuColumnVector(col.dtype, data, v, out_rows,
-                           offsets=new_offsets)
+    out = TpuColumnVector(col.dtype, data, v, out_rows,
+                          offsets=new_offsets)
+    de = getattr(col, "dict_encoding", None)
+    if de is not None:
+        # the dictionary codes gather with the SAME indices (one extra
+        # take), so compaction/filtering keeps the column's device
+        # encoding alive for downstream group-key consumers
+        codes, dcol = de
+        g = jnp.where(v, jnp.take(codes, safe_idx), jnp.int32(0))
+        out.dict_encoding = (g, dcol)
+    return out
+
+
+def decode_dictionary_column(dict_col: TpuColumnVector,
+                             codes_col: TpuColumnVector, out_rows: int,
+                             cap: int) -> TpuColumnVector:
+    """Dictionary decode-on-read: int32 codes (null lanes zeroed) + a
+    dictionary string column → the materialized string column, entirely on
+    device via the shared ragged gather (ONE scalar sync for the char
+    capacity). The codes ride along as the rebuilt column's
+    ``dict_encoding`` so downstream group-key encoding never re-derives
+    them — the reduce side of the dictionary-encoded collective exchange
+    and any other consumer of (codes, dictionary) pairs decode through
+    here."""
+    idx = jnp.asarray(codes_col.data)[:cap].astype(jnp.int32)
+    valid = row_mask(out_rows, cap)
+    if codes_col.validity is not None:
+        valid = codes_col.validity[:cap] & valid
+    safe = jnp.clip(idx, 0, max(int(dict_col.num_rows) - 1, 0))
+    out = _gather_strings(dict_col, safe, valid, out_rows, cap)
+    out.dict_encoding = (jnp.where(valid, safe, jnp.int32(0)), dict_col)
+    return out
 
 
 def _gather_lists(col: TpuColumnVector, safe_idx, valid, out_rows: int,

@@ -18,7 +18,7 @@ GpuColumnVector.java:40). Differences driven by XLA's compilation model:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, List, Optional, Sequence, Union
+from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,32 @@ def _np_to_jax(arr: np.ndarray):
     if _keep_host.active:
         return arr
     return jnp.asarray(arr)
+
+
+def rebase_string_offsets(buffers, n: int, arrow_offset: int = 0,
+                          copy: bool = True
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Rebase one Arrow string/binary array's raw buffers to zero-based
+    offsets + exactly the addressed bytes: `(offsets[n+1] int32 starting at
+    0, chars uint8)`. A sliced Arrow array's offsets point into the PARENT
+    buffer at an arbitrary base — every consumer of the raw buffers
+    (device upload, vectorized hashing, decode staging) needs the same
+    subtract-the-base / slice-the-bytes dance, so there is exactly one
+    copy of it (`from_arrow`, `parallel/executors._string_hash_u32`).
+    `buffers` is the `arr.buffers()` list ([validity, offsets, data]).
+    `copy=False` returns views into the Arrow buffers (offsets still
+    copied — they are rewritten in place) for transient readers that do
+    not outlive the array (the hash path)."""
+    offsets = np.frombuffer(buffers[1], dtype=np.int32, count=n + 1,
+                            offset=arrow_offset * 4).copy()
+    base = int(offsets[0])
+    offsets -= base
+    nbytes = int(offsets[-1])
+    if not nbytes:
+        return offsets, np.zeros(0, np.uint8)
+    chars = np.frombuffer(buffers[2], dtype=np.uint8, count=nbytes,
+                          offset=base)
+    return offsets, (chars.copy() if copy else chars)
 
 
 def device_layout_ok(dt: DataType) -> bool:
@@ -105,6 +131,21 @@ class TpuColumnVector:
     #: (cuDF STRUCT ColumnView: a validity mask over child columns). The
     #: struct's own `data` is an empty placeholder.
     children: Optional[List["TpuColumnVector"]] = None
+    #: string/binary columns only: an OPTIONAL device dictionary encoding
+    #: riding next to the materialized offsets+bytes — `(codes, dictionary)`
+    #: where `codes` is an int32 array of this column's capacity (null and
+    #: padding lanes zeroed) and `dictionary` is a plain string
+    #: TpuColumnVector holding the DISTINCT values (codes preserve
+    #: equality: row i == row j iff codes[i] == codes[j] under equal
+    #: validity). Producers: the device parquet decoder (RLE_DICTIONARY
+    #: pages — the parquet dictionary IS the encoding) and the
+    #: dictionary-encoded collective exchange's decode-on-read. Consumers:
+    #: group-key encoding (`execs/aggregates.encode_group_keys` and the
+    #: opjit sort-plan program) use the codes directly so string-keyed
+    #: aggregation needs no host dictionary pass. Best-effort cache: any
+    #: transform that cannot cheaply carry it just drops it — correctness
+    #: never depends on its presence.
+    dict_encoding: Optional[Tuple[Any, "TpuColumnVector"]] = None
 
     @property
     def capacity(self) -> int:
@@ -133,6 +174,14 @@ class TpuColumnVector:
             n += self.validity.size
         if self.offsets is not None:
             n += self.offsets.size * 4
+        if self.dict_encoding is not None:
+            # the codes buffer is owned per column and freed with it (a
+            # spill drops the encoding); the DICTIONARY column is shared
+            # across every column gathered from the same source and is
+            # accounted where it is owned (e.g. the exchange's spillable
+            # dictionary batch), so only the codes count here
+            codes = self.dict_encoding[0]
+            n += codes.size * codes.dtype.itemsize
         if self.child is not None:
             n += self.child.device_memory_size()
         if self.children is not None:
@@ -389,14 +438,8 @@ class TpuColumnVector:
         if isinstance(dtype, (StringType, BinaryType)):
             if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
                 arr = arr.cast(pa.string() if isinstance(dtype, StringType) else pa.binary())
-            bufs = arr.buffers()
-            off0 = arr.offset
-            offsets = np.frombuffer(bufs[1], dtype=np.int32,
-                                    count=n + 1, offset=off0 * 4).copy()
-            base = offsets[0]
-            offsets -= base
-            chars = np.frombuffer(bufs[2], dtype=np.uint8,
-                                  count=int(offsets[-1]), offset=int(base)).copy()
+            offsets, chars = rebase_string_offsets(arr.buffers(), n,
+                                                   arr.offset)
             if validity is not None:
                 # zero out data regions of null rows? keep: gathers only read valid rows
                 pass

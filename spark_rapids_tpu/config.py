@@ -389,8 +389,15 @@ with null compaction into the padded batch layout, PLAIN fixed-width
 reinterpret — the reference's semaphore-then-cuDF-device-decode shape,
 GpuParquetScan.scala:1983). Launches are recorded under the
 `parquet_decode` kind in the dispatch accounting, so a scan costs
-O(row-groups) dispatches, not O(pages) or O(columns). Columns the device
-cannot decode (strings, nested, INT96, exotic encodings) automatically
+O(row-groups) dispatches, not O(pages) or O(columns). BYTE_ARRAY
+string/binary columns decode into the engine's offsets+bytes device
+layout (PLAIN length-prefix walks host-side, dictionary pages ship raw
+bytes + the index run table; the device program cumsums row lengths into
+int32 offsets and byte-gathers the chars), and RLE_DICTIONARY string
+columns surface the parquet dictionary as a device `dict_encoding` so
+string group keys feed the key-encode programs as int32 codes. Columns
+the device cannot decode (nested, INT96, FIXED_LEN_BYTE_ARRAY, exotic
+encodings) automatically
 demote to per-column host pyarrow decode zipped into the same batch;
 corrupt/truncated pages heal per row group via host re-read
 (`spark.rapids.tpu.parquet.deviceDecode.verify` adds a paranoid
@@ -414,8 +421,16 @@ launch (`spark.rapids.tpu.dispatch.partitionBatch`), collective launches
 land in the dispatch accounting under the `mesh_collective` kind inside
 `mesh.exchange` timeline spans, and the lost-shard / slow-link chaos sites
 (`mesh.shard`, `mesh.link`) heal through the same FetchFailed lineage
-recovery as any lost map. Exchanges whose payload has no fixed-width
-device layout (strings, nested) transparently keep the per-map
+recovery as any lost map. String/binary payloads ride the collective as
+int32 dictionary codes plus ONE broadcast dictionary per exchange
+(`spark.rapids.tpu.exchange.dictionaryEncode.enabled` — the analogue of
+the reference's compressed shuffle batches): the map side encodes across
+all shards, the reduce side decodes on read with a device gather and
+keeps the codes as each column's `dict_encoding` for downstream group
+keys; an exchange past the cardinality/2^31-byte guards
+(`spark.rapids.tpu.exchange.dictionaryEncode.maxCardinality`) falls back
+per-map with reason `dictionary_overflow`. Only nested or host-only
+payloads transparently keep the per-map
 device-resident path. Design, fault model and the MULTICHIP bench:
 docs/distributed.md.
 
@@ -617,6 +632,32 @@ MESH_COLLECTIVE_ENABLED = _conf(
     "but one materialization per map partition). Requires "
     "spark.rapids.tpu.mesh.enabled and spark.rapids.shuffle.mode=ICI."
 ).boolean(True)
+
+EXCHANGE_DICT_ENCODE_ENABLED = _conf(
+    "spark.rapids.tpu.exchange.dictionaryEncode.enabled").doc(
+    "Let string/binary exchange payloads ride the mesh collective as "
+    "fixed-width int32 dictionary codes plus ONE per-exchange broadcast "
+    "dictionary (the TPU analogue of the reference's compressed shuffle "
+    "batches, RapidsShuffleCompression): the map side dictionary-encodes "
+    "each string column across all shards, the lax.all_to_all moves only "
+    "the codes, and the reduce side decodes on read with a device gather "
+    "— the rebuilt columns keep the codes as their dict_encoding so "
+    "string-keyed downstream aggregation consumes them directly. Requires "
+    "a mesh session; exchanges whose dictionary trips the cardinality or "
+    "2^31-byte guards fall back to the per-map path with reason "
+    "dictionary_overflow. Off = string-payload exchanges always ride the "
+    "per-map device-resident path."
+).boolean(True)
+
+EXCHANGE_DICT_MAX_CARDINALITY = _conf(
+    "spark.rapids.tpu.exchange.dictionaryEncode.maxCardinality").doc(
+    "Cardinality guard for spark.rapids.tpu.exchange.dictionaryEncode."
+    "enabled: an exchange whose string columns hold more distinct values "
+    "than this (or more than 2^31 distinct bytes — the int32 offsets "
+    "range) is not worth a broadcast dictionary and falls back to the "
+    "per-map path (reason dictionary_overflow in "
+    "mesh.per_map_exchange{reason} and explain(\"metrics\"))."
+).integer(1 << 20)
 
 MESH_ALIGN_PARTITIONS = _conf(
     "spark.rapids.tpu.mesh.alignPartitions").doc(

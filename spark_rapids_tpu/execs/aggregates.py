@@ -537,11 +537,18 @@ def _sortable_bits(col: TpuColumnVector):
 
 
 def encode_group_keys(cols: List[TpuColumnVector], num_rows: int, capacity: int):
-    """Per-key (sortable_value, validity) pairs. Strings are dictionary-encoded
-    host-side (codes preserve equality; order not needed for grouping)."""
+    """Per-key (sortable_value, validity) pairs. Strings carrying a device
+    `dict_encoding` (parquet dictionary pages, the dictionary exchange's
+    decode-on-read) use their codes DIRECTLY — equality-preserving int32,
+    zero host work; strings without one dictionary-encode host-side (codes
+    preserve equality; order not needed for grouping)."""
     out = []
     for c in cols:
         if isinstance(c.dtype, StringType):
+            de = getattr(c, "dict_encoding", None)
+            if de is not None:
+                out.append((de[0], c.validity))
+                continue
             import pyarrow as pa
             import pyarrow.compute as pc
             arr = c.to_arrow()
@@ -1472,12 +1479,25 @@ class TpuHashAggregateExec(TpuExec):
         key_cols: List[TpuColumnVector] = []
         if self.grouping:
             plan = None
+            dc = None
             if use_jit:
+                # string keys carrying a device dict_encoding trace the
+                # sort phase over their int32 codes (ONE launch) instead
+                # of dropping to the eager chain at the string boundary
+                dc = self._dict_coded_sort_inputs(batch)
+                sort_grouping, sort_batch = dc if dc is not None \
+                    else (self.grouping, batch)
                 with self.metrics["sortTime"].timed():
-                    plan = opjit.agg_sort_plan(self.grouping, batch,
+                    plan = opjit.agg_sort_plan(sort_grouping, sort_batch,
                                                ctx.eval_ctx, self.metrics)
             if plan is not None:
                 perm, seg_ids, is_new, n_groups, key_cols = plan
+                if dc is not None:
+                    # the traced key columns are the CODES; the output key
+                    # columns are the real columns (every grouping expr in
+                    # the dc path is a bare reference, so this is free)
+                    key_cols = [batch.columns[g.ordinal]
+                                for g in self.grouping]
             else:
                 key_cols = [to_column(g.eval_tpu(batch, ctx.eval_ctx),
                                       batch, g.dtype)
@@ -1535,6 +1555,41 @@ class TpuHashAggregateExec(TpuExec):
             ctx.eval_ctx, self.metrics))
         return TpuColumnarBatch(final_cols, n_groups,
                                 [a.name for a in self._output])
+
+    def _dict_coded_sort_inputs(self, batch: TpuColumnarBatch):
+        """Traced sort-phase inputs for STRING group keys: when every
+        grouping expr is a bare column reference and every string key
+        column carries a device `dict_encoding` (parquet dictionary pages,
+        the dictionary exchange's decode-on-read), the sort phase traces
+        over int32 code columns appended to a widened batch — the opjit
+        key-encode program consumes the codes directly, so string-keyed
+        aggregation stays device-resident with the same ONE-launch sort
+        phase fixed-width keys get. Returns (grouping, batch) with the
+        string keys substituted, or None (caller uses the original)."""
+        from ..types import IntegerType
+        if not any(isinstance(g.dtype, StringType) for g in self.grouping):
+            return None
+        if not all(isinstance(g, AttributeReference)
+                   and g.ordinal is not None
+                   and 0 <= g.ordinal < len(batch.columns)
+                   for g in self.grouping):
+            return None
+        new_cols = list(batch.columns)
+        new_grouping: List[AttributeReference] = []
+        for g in self.grouping:
+            if not isinstance(g.dtype, StringType):
+                new_grouping.append(g)
+                continue
+            col = batch.columns[g.ordinal]
+            de = getattr(col, "dict_encoding", None)
+            if de is None:
+                return None
+            new_grouping.append(AttributeReference(
+                f"{g.name}__dictcode", IntegerType(), g.nullable,
+                ordinal=len(new_cols)))
+            new_cols.append(TpuColumnVector(IntegerType(), de[0],
+                                            col.validity, batch.rows_lazy))
+        return new_grouping, TpuColumnarBatch(new_cols, batch.rows_lazy)
 
     def _fused_aggregate_batch(self, batch: TpuColumnarBatch, agg_fns,
                                result_exprs,

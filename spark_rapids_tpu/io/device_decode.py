@@ -19,12 +19,23 @@ classes:
   type, bit layout) spec plus bucketed buffer shapes, and each dispatch is
   recorded under the ``parquet_decode`` kind in the process-wide dispatch
   accounting (`opjit.cache_stats()["calls_by_kind"]`);
-* columns the device path cannot decode (nested, BYTE_ARRAY strings,
-  INT96, unsupported encodings/codecs, mid-chunk dictionary fallback)
-  decode on host via pyarrow for just that column and zip into the same
-  `TpuColumnarBatch` — the per-column fallback the meta/typecheck machinery
-  already expresses for expressions, applied to scans
-  (`spark.rapids.tpu.parquet.deviceDecode.enabled`, per-column
+* BYTE_ARRAY string/binary columns decode into the engine's own
+  offsets+bytes device layout (`columnar/vector.py`): PLAIN pages walk
+  their 4-byte length prefixes host-side into per-value (start, length)
+  tables (vectorized pointer-doubling — no per-value Python), dictionary
+  pages ship the raw dictionary bytes plus the index run table, and the
+  device program cumsums row lengths into the int32 offsets vector and
+  byte-gathers the char buffer (`kernels/parquet_decode.string_offsets` /
+  `gather_string_bytes`). RLE_DICTIONARY string columns additionally
+  surface the parquet dictionary as a device `dict_encoding`
+  (codes + dictionary column) so downstream group-by key encoding
+  consumes the codes without a host dictionary pass;
+* columns the device path cannot decode (nested, INT96,
+  FIXED_LEN_BYTE_ARRAY, unsupported encodings/codecs, mid-chunk
+  dictionary fallback) decode on host via pyarrow for just that column
+  and zip into the same `TpuColumnarBatch` — the per-column fallback the
+  meta/typecheck machinery already expresses for expressions, applied to
+  scans (`spark.rapids.tpu.parquet.deviceDecode.enabled`, per-column
   auto-demotion).
 
 Robustness: staged bytes route through the `FileCache` range reader (chaos site
@@ -49,9 +60,9 @@ import numpy as np
 
 from ..columnar.vector import TpuColumnVector, bucket_capacity
 from ..obs import tracer as _obs
-from ..types import (BooleanType, ByteType, DataType, DateType, DoubleType,
-                     FloatType, IntegerType, LongType, ShortType,
-                     TimestampType, from_arrow as arrow_to_type,
+from ..types import (BinaryType, BooleanType, ByteType, DataType, DateType,
+                     DoubleType, FloatType, IntegerType, LongType, ShortType,
+                     StringType, TimestampType, from_arrow as arrow_to_type,
                      to_arrow as type_to_arrow)
 
 
@@ -314,6 +325,89 @@ def _count_valid(data, start: int, end: int, n: int) -> int:
     return total
 
 
+def _byte_array_starts(region: np.ndarray,
+                       n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Value start positions + byte lengths of `n` length-prefixed
+    BYTE_ARRAY values in `region` (a PLAIN data-page value region or a
+    dictionary page), without a per-value Python loop: the next-value map
+    (pos → pos + 4 + le32(pos)) is built for every byte position
+    vectorized, then the set of value starts doubles each pass (pointer
+    jumping: after pass k the first 2^k starts are known — log2(n)
+    vectorized gathers total). A chain that runs out of bounds (bogus
+    length, truncated region) raises ValueError."""
+    if n <= 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    m = len(region)
+    if m < 4:
+        raise ValueError("BYTE_ARRAY region too short")
+    r = region.astype(np.int64)
+    le = r[: m - 3] | (r[1: m - 2] << 8) | (r[2: m - 1] << 16) \
+        | (r[3:] << 24)
+    # positions past m-4 have no readable prefix: they map to the sentinel
+    # m, where the jump table is a fixed point — a broken chain parks there
+    nxt = np.minimum(np.arange(m - 3, dtype=np.int64) + 4 + le, m)
+    nxt = np.concatenate([nxt, np.full(4, m, np.int64)])  # index m valid
+    starts = np.zeros(1, np.int64)
+    jump = nxt
+    while len(starts) < n:
+        take = min(len(starts), n - len(starts))
+        if int(starts[:take].max(initial=0)) >= m:
+            raise ValueError("BYTE_ARRAY values overrun the page")
+        starts = np.concatenate([starts, jump[starts[:take]]])
+        if len(starts) < n:
+            jump = jump[jump]
+    starts = starts[:n]
+    if int(starts.max()) > m - 4:
+        raise ValueError("BYTE_ARRAY values overrun the page")
+    lengths = le[starts]
+    if int((starts + 4 + lengths).max()) > m:
+        raise ValueError("BYTE_ARRAY value out of bounds")
+    return starts, lengths
+
+
+def _accum_index_counts(data, start: int, end: int, bw: int, n: int,
+                        counts: np.ndarray) -> None:
+    """Histogram one page's dictionary indices (RLE / bit-packed hybrid
+    region) into `counts` — O(region bytes) vectorized, no device round
+    trip. The exact output char total (counts · dictionary lengths) sizes
+    the staged string char buffer, so the one decode dispatch per row
+    group keeps a static shape. An index outside the dictionary raises
+    (the device expansion would gather garbage bytes)."""
+    n_dict = len(counts)
+    out = 0
+    vbytes = (bw + 7) // 8
+    pos = start
+    while out < n and pos < end:
+        h, pos = _varint(data, pos)
+        if h & 1:
+            cnt = (h >> 1) * 8
+            take = min(cnt, n - out)
+            nbytes = (cnt * bw + 7) // 8
+            if bw:
+                bits = np.unpackbits(
+                    np.frombuffer(data, np.uint8, count=nbytes, offset=pos),
+                    bitorder="little")
+                vals = bits[: take * bw].reshape(take, bw).astype(np.int64) \
+                    @ (np.int64(1) << np.arange(bw, dtype=np.int64))
+                if take and int(vals.max()) >= n_dict:
+                    raise ValueError("dictionary index out of range")
+                np.add.at(counts, vals, 1)
+            else:
+                counts[0] += take
+            pos += nbytes
+        else:
+            cnt = h >> 1
+            v = int.from_bytes(data[pos: pos + vbytes], "little") \
+                if vbytes else 0
+            pos += vbytes
+            take = min(cnt, n - out)
+            if take:
+                if v >= n_dict:
+                    raise ValueError("dictionary index out of range")
+                counts[v] += take
+        out += cnt
+
+
 # ---------------------------------------------------------------------------
 # per-column decode plans (eligibility) and staged buffers
 # ---------------------------------------------------------------------------
@@ -367,7 +461,9 @@ def _column_plan(attr, leaf_idx: int, sc, cc, field_type) -> _ColPlan:
         isz, vkind = 1, "b"
     elif phys in _PHYS_FIXED:
         isz, vkind = _PHYS_FIXED[phys]
-    else:  # BYTE_ARRAY strings, INT96, FIXED_LEN_BYTE_ARRAY
+    elif phys == "BYTE_ARRAY":
+        isz, vkind = 0, "s"  # variable width: offsets+bytes device layout
+    else:  # INT96, FIXED_LEN_BYTE_ARRAY
         raise DeviceDecodeError(f"physical type {phys}")
     unsupported = set(cc.encodings) - _SUPPORTED_ENCODINGS
     if unsupported:
@@ -386,11 +482,17 @@ def _column_plan(attr, leaf_idx: int, sc, cc, field_type) -> _ColPlan:
     import pyarrow as pa
     if pa.types.is_timestamp(field_type) and field_type.unit != "us":
         raise DeviceDecodeError(f"timestamp unit {field_type.unit}")
-    if not isinstance(src, (BooleanType, ByteType, ShortType, IntegerType,
-                            LongType, FloatType, DoubleType, DateType,
-                            TimestampType)):
+    if vkind == "s":
+        # strings/binary: the value bytes are copied verbatim — only the
+        # identity "cast" is value-preserving on device
+        if not isinstance(src, (StringType, BinaryType)) \
+                or type(src) is not type(attr.dtype):
+            raise DeviceDecodeError(f"byte-array type {src} -> {attr.dtype}")
+    elif not isinstance(src, (BooleanType, ByteType, ShortType, IntegerType,
+                              LongType, FloatType, DoubleType, DateType,
+                              TimestampType)):
         raise DeviceDecodeError(f"column type {src}")
-    if not _cast_ok(src, attr.dtype):
+    elif not _cast_ok(src, attr.dtype):
         raise DeviceDecodeError(f"cast {src} -> {attr.dtype}")
     return _ColPlan(attr.name, leaf_idx, phys, isz, vkind, attr.dtype,
                     sc.max_definition_level == 1)
@@ -403,9 +505,14 @@ def _column_plan(attr, leaf_idx: int, sc, cc, field_type) -> _ColPlan:
 
 @dataclass
 class _Staged:
-    """One column's host-staged buffers + its program-spec fragment."""
+    """One column's host-staged buffers + its program-spec fragment.
+    String columns staged from dictionary pages additionally carry the
+    parsed dictionary (zero-based offsets + contiguous chars) so the
+    assembled column can surface a device `dict_encoding`."""
     spec: Tuple
     arrays: List[np.ndarray]
+    dict_offsets: Optional[np.ndarray] = None
+    dict_chars: Optional[np.ndarray] = None
 
 
 def _pad_bytes(parts: List[bytes], min_len: int = 0) -> np.ndarray:
@@ -441,11 +548,251 @@ def _decompress(codec: Optional[str], body, usize: int) -> bytes:
     return data
 
 
+_STRING_CHAR_LIMIT = 1 << 31  # int32 offsets: > 2^31 chars cannot address
+
+
+def _stage_string_column(chunk: bytes, cc, plan: _ColPlan, num_rows: int,
+                         cap: int) -> _Staged:
+    """BYTE_ARRAY staging: def-level runs exactly like the fixed path;
+    value regions stage as either an index run table + raw dictionary
+    bytes (RLE_DICTIONARY pages) or per-value (start, length) tables into
+    the concatenated PLAIN regions (4-byte prefixes walked host-side by
+    vectorized pointer doubling). The exact output char total is computed
+    host-side (index histogram · dictionary lengths, or the sum of PLAIN
+    lengths) so the one decode dispatch keeps a static char capacity."""
+    codec = _CODECS[cc.compression]
+    obs_on = _obs._ACTIVE
+    lv_runs: List[List[int]] = []
+    lv_parts: List[bytes] = []
+    lv_bits = 0
+    val_runs: List[List[int]] = []      # dictionary-index runs
+    val_parts: List[bytes] = []
+    val_bits = 0
+    idx_counts: Optional[np.ndarray] = None
+    plain_srcs: List[np.ndarray] = []   # PLAIN per-value starts (chars)
+    plain_lens: List[np.ndarray] = []
+    plain_parts: List[bytes] = []
+    plain_base = 0
+    dict_srcs = dict_lens = None
+    dict_bytes: Optional[bytes] = None
+    n_dict = 0
+    saw_dict = saw_plain = False
+    rows_seen = 0
+    dense_seen = 0
+    try:
+        pos = 0
+        end = len(chunk)
+        while pos < end and rows_seen < num_rows:
+            hdr, dpos = _read_struct(chunk, pos)
+            ptype, usize, csize = hdr[1], hdr[2], hdr[3]
+            if usize < 0 or csize < 0 or dpos + csize > end:
+                raise ValueError("page body out of bounds")
+            body = chunk[dpos:dpos + csize]
+            pos = dpos + csize
+            if obs_on:
+                _obs.event("scan.page", cat="io", column=plan.name,
+                           page_type=ptype, compressed=csize,
+                           uncompressed=usize)
+            if ptype == _PAGE_DICT:
+                dph = hdr[7]
+                if dph[2] not in (_ENC_PLAIN, _ENC_PLAIN_DICT):
+                    raise ValueError(f"dictionary encoding {dph[2]}")
+                data = _decompress(codec, body, usize)
+                n_dict = dph[1]
+                region = np.frombuffer(data, np.uint8)
+                starts, lens = _byte_array_starts(region, n_dict)
+                dict_srcs, dict_lens = starts + 4, lens
+                dict_bytes = data
+                idx_counts = np.zeros(max(n_dict, 1), np.int64)
+                continue
+            if ptype not in (_PAGE_DATA_V1, _PAGE_DATA_V2):
+                continue  # index pages etc.: metadata only
+            if ptype == _PAGE_DATA_V1:
+                data = _decompress(codec, body, usize)
+                dph = hdr[5]
+                nv, enc, denc = dph[1], dph[2], dph[3]
+                p = 0
+                if plan.nullable:
+                    if denc != _ENC_RLE:
+                        raise ValueError(f"def-level encoding {denc}")
+                    (dlen,) = struct.unpack_from("<i", data, 0)
+                    p = 4 + dlen
+                    if dlen < 0 or p > len(data):
+                        raise ValueError("def levels out of bounds")
+                    lv_runs += _walk_runs(data, 4, p, 1, nv,
+                                          rows_seen, lv_bits)
+                    lv_parts.append(data[4:p])
+                    lv_bits += dlen * 8
+                    nnn = _count_valid(data, 4, p, nv)
+                else:
+                    nnn = nv
+                region = data[p:]
+            else:  # v2
+                v2 = hdr[8]
+                nv, nnulls, enc = v2[1], v2[2], v2[4]
+                dl_len, rl_len = v2[5], v2[6]
+                if rl_len:
+                    raise ValueError("repetition levels on flat column")
+                if dl_len + rl_len > csize:
+                    raise ValueError("levels out of bounds")
+                levels = bytes(body[:dl_len])
+                region = body[dl_len:]
+                if codec is not None and v2.get(7, True):
+                    region = _decompress(codec, region, usize - dl_len)
+                else:
+                    region = bytes(region)
+                if plan.nullable:
+                    lv_runs += _walk_runs(levels, 0, dl_len, 1, nv,
+                                          rows_seen, lv_bits)
+                    lv_parts.append(levels)
+                    lv_bits += dl_len * 8
+                elif nnulls:
+                    raise ValueError("nulls in a required column")
+                nnn = nv - nnulls
+            rows_seen += nv
+            if nnn:
+                if enc in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
+                    saw_dict = True
+                    if idx_counts is None:
+                        raise ValueError("dictionary-encoded page before "
+                                         "the dictionary page")
+                    if not region:
+                        raise ValueError("empty dictionary-indices page")
+                    bw = region[0]
+                    if bw > 32:
+                        raise ValueError(f"index bit width {bw}")
+                    val_runs += _walk_runs(region, 1, len(region), bw, nnn,
+                                           dense_seen, val_bits)
+                    val_parts.append(region[1:])
+                    val_bits += (len(region) - 1) * 8
+                    _accum_index_counts(region, 1, len(region), bw, nnn,
+                                        idx_counts)
+                elif enc == _ENC_PLAIN:
+                    saw_plain = True
+                    rb = np.frombuffer(bytes(region), np.uint8)
+                    starts, lens = _byte_array_starts(rb, nnn)
+                    plain_srcs.append(starts + 4 + plain_base)
+                    plain_lens.append(lens)
+                    plain_parts.append(bytes(region))
+                    plain_base += len(region)
+                else:
+                    raise ValueError(f"value encoding {enc}")
+            dense_seen += nnn
+        if rows_seen != num_rows:
+            raise ValueError(f"pages cover {rows_seen} of {num_rows} rows")
+        if saw_dict and saw_plain:
+            # mid-chunk dictionary fallback on a STRING column: merging two
+            # ragged sources into one gather plan is not worth the program
+            # complexity (rare writer-overflow shape) — demote, never wrong
+            raise DeviceDecodeError(
+                f"column {plan.name}: mixed dictionary+PLAIN string chunk")
+        if saw_dict and dict_bytes is None:
+            raise ValueError("dictionary-encoded pages without a "
+                             "dictionary page")
+    except DeviceDecodeError:
+        raise
+    except (KeyError, ValueError, IndexError, struct.error,
+            OverflowError) as e:
+        raise DeviceDecodeError(
+            f"column {plan.name}: malformed page data ({e})")
+    except Exception as e:  # noqa: BLE001 — codec errors etc.
+        raise DeviceDecodeError(f"column {plan.name}: {e}")
+
+    out_kind = "s" if isinstance(plan.out_dtype, StringType) else "b"
+    arrays: List[np.ndarray] = []
+    if plan.nullable:
+        lvr = _pad_runs(lv_runs)
+        lvb = _pad_bytes(lv_parts)
+        arrays += [lvr, lvb]
+        lv_shape = (lvr.shape[0], lvb.shape[0])
+    else:
+        lv_shape = None
+    if saw_dict:
+        total_chars = int(idx_counts @ dict_lens) if n_dict else 0
+        if total_chars >= _STRING_CHAR_LIMIT:
+            raise DeviceDecodeError(
+                f"column {plan.name}: {total_chars} chars exceed the int32 "
+                f"offsets range")
+        char_cap = bucket_capacity(max(total_chars, 1))
+        vr = _pad_runs(val_runs)
+        vb = _pad_bytes(val_parts)
+        dict_cap = bucket_capacity(max(n_dict, 1))
+        dsrc = np.zeros(dict_cap, np.int64)
+        dsrc[:n_dict] = dict_srcs
+        dln = np.zeros(dict_cap, np.int32)
+        dln[:n_dict] = dict_lens
+        db = _pad_bytes([dict_bytes])
+        arrays += [vr, vb, dsrc, dln, db]
+        # the parquet dictionary doubles as the column's device
+        # dict_encoding — but codes only preserve equality when the
+        # writer's dictionary is actually duplicate-free (true for every
+        # real writer; cheap to prove, catastrophic to assume)
+        region = np.frombuffer(dict_bytes, np.uint8)
+        doffs = np.zeros(n_dict + 1, np.int64)
+        np.cumsum(dict_lens, out=doffs[1:])
+        if int(doffs[-1]):
+            src_idx = np.repeat(dict_srcs, dict_lens) + (
+                np.arange(int(doffs[-1]), dtype=np.int64)
+                - np.repeat(doffs[:-1], dict_lens))
+            dchars = region[src_idx]
+        else:
+            dchars = np.zeros(0, np.uint8)
+        # vectorized duplicate-free proof (no per-entry Python): entries
+        # are distinct iff their (length, zero-padded bytes) rows are —
+        # the length column disambiguates a real trailing NUL from
+        # padding. Oversized dictionaries skip the attach instead of
+        # paying an O(n_dict × max_len) matrix (decode stays correct;
+        # the encoding is only an optimization).
+        max_len = int(dict_lens.max()) if n_dict else 0
+        if n_dict and n_dict * max(max_len, 1) <= (1 << 26):
+            mat = np.zeros((n_dict, max_len), np.uint8)
+            if int(doffs[-1]):
+                rows = np.repeat(np.arange(n_dict), dict_lens)
+                cols = np.arange(int(doffs[-1]), dtype=np.int64) \
+                    - np.repeat(doffs[:-1], dict_lens)
+                mat[rows, cols] = dchars
+            lenb = dict_lens.astype("<u4").view(np.uint8).reshape(n_dict, 4)
+            keyed = np.concatenate([lenb, mat], axis=1)
+            uniq = np.unique(keyed, axis=0).shape[0] == n_dict
+        else:
+            uniq = False
+        emit_codes = bool(n_dict) and uniq
+        spec = ("str_dict", plan.nullable, out_kind, lv_shape,
+                (vr.shape[0], vb.shape[0]), dict_cap, db.shape[0], cap,
+                char_cap, emit_codes)
+        return _Staged(spec, arrays,
+                       dict_offsets=doffs.astype(np.int32)
+                       if emit_codes else None,
+                       dict_chars=dchars if emit_codes else None)
+    # PLAIN (or an all-null chunk with no staged values)
+    all_lens = np.concatenate(plain_lens) if plain_lens \
+        else np.zeros(0, np.int64)
+    total_chars = int(all_lens.sum())
+    if total_chars >= _STRING_CHAR_LIMIT:
+        raise DeviceDecodeError(
+            f"column {plan.name}: {total_chars} chars exceed the int32 "
+            f"offsets range")
+    char_cap = bucket_capacity(max(total_chars, 1))
+    dense_cap = bucket_capacity(max(dense_seen, 1))
+    srcs = np.zeros(dense_cap, np.int64)
+    lens = np.zeros(dense_cap, np.int32)
+    if len(all_lens):
+        srcs[:dense_seen] = np.concatenate(plain_srcs)
+        lens[:dense_seen] = all_lens
+    vb = _pad_bytes(plain_parts)
+    arrays += [srcs, lens, vb]
+    spec = ("str_plain", plan.nullable, out_kind, lv_shape, dense_cap,
+            vb.shape[0], cap, char_cap)
+    return _Staged(spec, arrays)
+
+
 def _stage_column(chunk: bytes, cc, plan: _ColPlan, num_rows: int,
                   cap: int) -> _Staged:
     """Walk one column chunk's pages: parse headers, decompress, walk run
     headers, and build the staged uint8/run-table buffers the device program
     consumes. Raises DeviceDecodeError on anything structurally off."""
+    if plan.vkind == "s":
+        return _stage_string_column(chunk, cc, plan, num_rows, cap)
     codec = _CODECS[cc.compression]
     obs_on = _obs._ACTIVE
     lv_runs: List[List[int]] = []
@@ -695,6 +1042,42 @@ def _build_program(specs: Tuple[Tuple, ...]):
         outs = []
         for spec in specs:
             kind = spec[0]
+            if kind in ("str_plain", "str_dict"):
+                # BYTE_ARRAY → offsets+bytes device layout: row lengths
+                # cumsum into int32 offsets, one searchsorted byte gather
+                # materializes the chars (kernels/parquet_decode.py)
+                nullable = spec[1]
+                cap = spec[7] if kind == "str_dict" else spec[6]
+                char_cap = spec[8] if kind == "str_dict" else spec[7]
+                if nullable:
+                    lv_runs = next(it)
+                    lv_bytes = next(it)
+                    defs = K.expand_runs(lv_runs, lv_bytes, cap)
+                    valid = K.validity_from_defs(defs, 1, num_rows)
+                else:
+                    valid = jnp.arange(cap, dtype=jnp.int64) < num_rows
+                if kind == "str_dict":
+                    vr, vb = next(it), next(it)
+                    dsrc, dlen, db = next(it), next(it), next(it)
+                    idx = K.expand_runs(vr, vb, cap)
+                    src_dense = K.dictionary_gather(dsrc, idx)
+                    len_dense = K.dictionary_gather(dlen, idx)
+                else:
+                    src_dense, len_dense = next(it), next(it)
+                    db = next(it)
+                row_len = K.expand_dense(len_dense, valid)
+                row_src = K.expand_dense(src_dense, valid)
+                offs = K.string_offsets(row_len)
+                chars = K.gather_string_bytes(db, row_src, offs, char_cap)
+                outs.append(offs)
+                outs.append(chars)
+                outs.append(valid if nullable else None)
+                if kind == "str_dict" and spec[9]:
+                    # the parquet dictionary codes ride along as the
+                    # column's device dict_encoding (null lanes zeroed)
+                    outs.append(K.expand_dense(idx, valid)
+                                .astype(jnp.int32))
+                continue
             cap = spec[-1]
             nullable = spec[4] if kind != "bool" else spec[2]
             out_np = spec[3] if kind != "bool" else spec[1]
@@ -1013,8 +1396,24 @@ class DeviceFileDecoder:
                 out_it = iter(outs)
                 dev_cols: Dict[str, TpuColumnVector] = {}
                 for st, plan in zip(staged, kept):
+                    kind = st.spec[0]
+                    if kind in ("str_plain", "str_dict"):
+                        offs = next(out_it)
+                        chars = next(out_it)
+                        valid = next(out_it) if st.spec[1] else None
+                        col = TpuColumnVector(plan.out_dtype, chars, valid,
+                                              num_rows, offsets=offs)
+                        if kind == "str_dict" and st.spec[9]:
+                            codes = next(out_it)
+                            col.dict_encoding = (
+                                codes,
+                                TpuColumnVector.from_strings(
+                                    plan.out_dtype, st.dict_offsets,
+                                    st.dict_chars))
+                        dev_cols[plan.name] = col
+                        continue
                     data = next(out_it)
-                    nullable = st.spec[4] if st.spec[0] != "bool" \
+                    nullable = st.spec[4] if kind != "bool" \
                         else st.spec[2]
                     valid = next(out_it) if nullable else None
                     dev_cols[plan.name] = TpuColumnVector(

@@ -29,7 +29,15 @@ Encodings covered (the flat fixed-width column classes):
   `columnar/batch.py` uses (rows in [num_rows, capacity) stay zero/invalid);
 * **PLAIN fixed-width reinterpret** (`plain_fixed_width`) — raw
   little-endian value bytes to int8/16/32/64, float32/64 carriers via byte
-  math + bitcast (no host round trip).
+  math + bitcast (no host round trip);
+* **BYTE_ARRAY strings** (`string_offsets`, `gather_string_bytes`) — the
+  variable-width classes decode into the engine's own Arrow-style
+  offsets+bytes layout (`columnar/vector.py`): per-row byte lengths (from
+  the 4-byte PLAIN length prefixes, or gathered from the dictionary's
+  entry lengths) cumsum into the int32 offsets vector, and one
+  searchsorted byte gather materializes the char buffer — the same ragged
+  shape `kernels/strings.py` computes over, so a decoded string column is
+  immediately a first-class device string column.
 
 All functions are shape-polymorphic jnp (no data-dependent host syncs), so
 tracelint's kernel scan classifies them device-clean and io/device_decode.py
@@ -159,6 +167,33 @@ def merge_plain_segments(seg_table, plain_values, base, out_len: int):
     vals = jnp.take(plain_values,
                     jnp.clip(src, 0, plain_values.shape[0] - 1), axis=0)
     return jnp.where(is_plain, vals, base)
+
+
+def string_offsets(row_lengths):
+    """Per-row byte lengths → the Arrow-style int32 offsets vector
+    (length capacity+1, offsets[0] == 0). Null and padding rows carry
+    length 0, so their offsets repeat the running total — exactly the
+    layout `TpuColumnVector.from_strings` builds host-side."""
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(row_lengths.astype(jnp.int32), dtype=jnp.int32)])
+
+
+def gather_string_bytes(data_u8, row_starts, offsets, out_len: int):
+    """Materialize the output char buffer: output byte j belongs to row
+    r = searchsorted(offsets, j) and reads
+    `data_u8[row_starts[r] + (j - offsets[r])]` (the dictionary bytes or
+    the staged PLAIN value region). Bytes past the total length
+    (offsets[-1]) are zero padding."""
+    j = jnp.arange(out_len, dtype=jnp.int32)
+    r = jnp.searchsorted(offsets[1:], j, side="right").astype(jnp.int32)
+    r = jnp.clip(r, 0, row_starts.shape[0] - 1)
+    src = jnp.take(row_starts, r).astype(jnp.int64) \
+        + (j - jnp.take(offsets, r)).astype(jnp.int64)
+    in_range = j < offsets[offsets.shape[0] - 1]
+    got = jnp.take(data_u8, jnp.clip(src, 0, data_u8.shape[0] - 1),
+                   mode="clip")
+    return jnp.where(in_range, got, jnp.uint8(0))
 
 
 def decode_bool_runs(run_table, data_u8, out_len: int):

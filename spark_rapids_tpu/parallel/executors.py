@@ -68,18 +68,19 @@ def _string_hash_u32(arr) -> np.ndarray:
     31^row_start) — no per-row Python loop. Only determinism matters here
     (bucket assignment), not hash quality."""
     import pyarrow as pa
+
+    from ..columnar.vector import rebase_string_offsets
     arr = arr.cast(pa.string())
     if arr.null_count:
         arr = arr.fill_null("")
-    buffers = arr.buffers()  # [validity, offsets, data]
-    offsets = np.frombuffer(buffers[1], np.int32,
-                            count=len(arr) + 1, offset=arr.offset * 4)
-    data_start, data_end = int(offsets[0]), int(offsets[-1])
-    if data_end == data_start:
+    # zero-based offsets + exactly the addressed bytes (the shared
+    # offsets-rebase the device decode staging uses too); copy=False —
+    # the buffers are only read within this call
+    offsets, chars = rebase_string_offsets(arr.buffers(), len(arr),
+                                           arr.offset, copy=False)
+    if not len(chars):
         return np.zeros(len(arr), np.uint32)
-    b = np.frombuffer(buffers[2], np.uint8,
-                      count=data_end - data_start,
-                      offset=data_start).astype(np.uint32)
+    b = chars.astype(np.uint32)
     with np.errstate(over="ignore"):
         pow31 = np.empty(len(b), np.uint32)
         pow31[0] = 1
@@ -87,7 +88,7 @@ def _string_hash_u32(arr) -> np.ndarray:
         weighted = b * pow31
         csum = np.concatenate([[np.uint32(0)],
                                np.cumsum(weighted, dtype=np.uint32)])
-        starts = (offsets - data_start).astype(np.int64)
+        starts = offsets.astype(np.int64)
         seg = csum[starts[1:]] - csum[starts[:-1]]
         # shift each row's weights back to 31^0: multiply by inv31^row_start
         # (rows starting at data_end are empty; the clipped index is unused

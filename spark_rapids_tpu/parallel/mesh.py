@@ -94,14 +94,33 @@ def mesh_session_active(conf) -> Optional[Mesh]:
     return MeshContext.get(conf)
 
 
-def mesh_eligible_output(output) -> bool:
-    """Static (plan-time) eligibility: every column must have a fixed-width
-    device layout for the all_to_all to carry it. Strings/nested fall back to
-    the in-process catalog path until the ragged device layout lands."""
+def collective_payload(output, conf) -> Optional[str]:
+    """Payload classification for the collective data plane (shared by the
+    planner's exchange selection and the runtime eligibility check):
+
+    * ``"fixed"`` — every column has a fixed-width device layout; the
+      all_to_all carries the raw buffers;
+    * ``"dict"`` — the variable-width columns are all strings/binary
+      (offsets+bytes device layout): they ride as int32 dictionary codes
+      plus one broadcast dictionary per exchange
+      (``spark.rapids.tpu.exchange.dictionaryEncode.enabled``), the TPU
+      analogue of the reference's compressed shuffle batches;
+    * ``None`` — nested or host-only payloads: per-map path.
+    """
     from ..columnar.vector import device_layout_ok
-    from ..types import is_fixed_width
-    return all(is_fixed_width(a.dtype) and device_layout_ok(a.dtype)
-               for a in output)
+    from ..config import EXCHANGE_DICT_ENCODE_ENABLED
+    from ..types import BinaryType, StringType, is_fixed_width
+    has_var = False
+    for a in output:
+        if is_fixed_width(a.dtype) and device_layout_ok(a.dtype):
+            continue
+        if isinstance(a.dtype, (StringType, BinaryType)):
+            has_var = True
+            continue
+        return None
+    if not has_var:
+        return "fixed"
+    return "dict" if conf.get(EXCHANGE_DICT_ENCODE_ENABLED) else None
 
 
 # compiled exchange cache: (mesh, cap, slot_cap, col sig) -> jitted fn.
@@ -114,7 +133,10 @@ _EXCHANGE_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
 # assertion read these next to opjit calls_by_kind["mesh_collective"]).
 _STATS_LOCK = threading.Lock()
 _STATS = {"launches": 0, "rows_sent": 0, "stage_ns": 0, "launch_ns": 0,
-          "wait_ns": 0, "compact_ns": 0}
+          "wait_ns": 0, "compact_ns": 0,
+          # dictionary-encoded string exchanges (the MULTICHIP summary's
+          # multichip_string_collectives / dict_encode_ms keys)
+          "dict_exchanges": 0, "dict_encode_ns": 0}
 
 
 def collective_stats() -> Dict[str, int]:
@@ -126,6 +148,14 @@ def reset_collective_stats() -> None:
     with _STATS_LOCK:
         for k in _STATS:
             _STATS[k] = 0
+
+
+def record_dict_encode(ns: int) -> None:
+    """One exchange's map-side dictionary-encode pass completed (every
+    value is host-known: a perf_counter wall — zero device syncs)."""
+    with _STATS_LOCK:
+        _STATS["dict_exchanges"] += 1
+        _STATS["dict_encode_ns"] += ns
 
 
 def _record_launch(rows: int, stage_ns: int, launch_ns: int,

@@ -205,6 +205,10 @@ def run_mesh_query(name: str, build: Callable, *, n_devices: int,
         "exchanges": info["exchanges"],
         "collective_launches": launches,
         "collective_launches_O_exchanges": launches_ok,
+        # dictionary-encoded string exchanges (codes + one broadcast
+        # dictionary over the fabric) and their map-side encode wall
+        "string_collectives": col.get("dict_exchanges", 0),
+        "dict_encode_ms": round(col.get("dict_encode_ns", 0) / 1e6, 2),
         "collective_rows": col["rows_sent"],
         "collective_stage_ms": round(col["stage_ns"] / 1e6, 2),
         "collective_launch_ms": round(col["launch_ns"] / 1e6, 2),
@@ -258,6 +262,8 @@ def summarize(records: List[Dict], n_devices: int,
     per_query = {}
     total_launches = 0
     total_collective_ms = 0.0
+    total_string_collectives = 0
+    total_dict_encode_ms = 0.0
     all_identical = True
     all_o_exchanges = True
     for r in records:
@@ -285,6 +291,8 @@ def summarize(records: List[Dict], n_devices: int,
                 (r["scaling_vs_single"] or 0) / n_devices, 3),
             "exchanges": r["exchanges"],
             "collective_launches": r["collective_launches"],
+            "string_collectives": r.get("string_collectives", 0),
+            "dict_encode_ms": r.get("dict_encode_ms", 0.0),
             "phases_ms": phases,
             "efficiency_attribution": ea,
             "skew": None if sk is None else {
@@ -293,8 +301,14 @@ def summarize(records: List[Dict], n_devices: int,
                 "straggler_chip": sk["straggler_chip"]},
             "per_map_exchanges": r.get("per_map_reasons") or {},
         }
+        if not per_query[r["query"]]["string_collectives"]:
+            # compact-line discipline: zero-valued dictionary keys elide
+            del per_query[r["query"]]["string_collectives"]
+            del per_query[r["query"]]["dict_encode_ms"]
         total_launches += r["collective_launches"]
         total_collective_ms += sum(phases.values())
+        total_string_collectives += r.get("string_collectives", 0)
+        total_dict_encode_ms += r.get("dict_encode_ms", 0.0)
         all_identical = all_identical and r["bit_identical"]
         all_o_exchanges = all_o_exchanges \
             and r["collective_launches_O_exchanges"]
@@ -303,6 +317,11 @@ def summarize(records: List[Dict], n_devices: int,
         "n_devices": n_devices,
         "queries": per_query,
         "collective_launches_total": total_launches,
+        # string exchanges riding the fabric as dictionary codes + one
+        # broadcast dictionary each (the r06 burndown: q1's agg exchange
+        # and q18's c_name final agg were per_map=string_or_nested_payload)
+        "string_collectives_total": total_string_collectives,
+        "dict_encode_ms_total": round(total_dict_encode_ms, 2),
         # RENAMED from r06's collective_ms_total: the total now includes
         # the compact phase, and bench_diff gates collective totals
         # lower-is-better — reusing the old key with a wider composition

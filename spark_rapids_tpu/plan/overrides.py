@@ -380,7 +380,7 @@ def _mesh_align_consistent(meta: PlanMeta) -> bool:
     independently checks every sibling exchange's static eligibility and
     aligns only when all would. Non-join parents have no pairing
     constraint."""
-    from ..parallel.mesh import mesh_eligible_output
+    from ..parallel.mesh import collective_payload
     from ..shuffle.exchange import CpuShuffleExchangeExec
     parent = meta.parent
     if parent is None or "Join" not in type(parent.plan).__name__:
@@ -389,7 +389,7 @@ def _mesh_align_consistent(meta: PlanMeta) -> bool:
         sp = sib.plan
         if isinstance(sp, CpuShuffleExchangeExec) \
                 and sp.partitioning == "hash" \
-                and not mesh_eligible_output(sp.output):
+                and collective_payload(sp.output, meta.conf) is None:
             return False
     return True
 
@@ -398,7 +398,7 @@ def _convert_exchange(meta: PlanMeta, ch):
     from ..config import (AQE_COALESCE_ENABLED,
                           AQE_ADVISORY_PARTITION_BYTES,
                           MESH_ALIGN_PARTITIONS, MESH_COLLECTIVE_ENABLED)
-    from ..parallel.mesh import mesh_eligible_output, mesh_session_active
+    from ..parallel.mesh import collective_payload, mesh_session_active
     from ..shuffle.exchange import (TpuShuffleExchangeExec,
                                     TpuShuffleReaderExec)
     p = meta.plan
@@ -408,11 +408,16 @@ def _convert_exchange(meta: PlanMeta, ch):
     # mesh-size partitions (alignPartitions) so the on-device murmur3 % n
     # routing matches the shard count, and eligible exchanges carry
     # `collective_planned` so materialization runs ONE fabric collective.
+    # String payloads are eligible via the dictionary-encode pass
+    # (collective_payload == "dict"): the fabric carries int32 codes plus
+    # one broadcast dictionary instead of raw bytes.
     ms = mesh_session_active(meta.conf)
     mesh = ms if meta.conf.get(MESH_COLLECTIVE_ENABLED) else None
+    payload = collective_payload(ch[0].output, meta.conf) \
+        if mesh is not None else None
     eligible = mesh is not None \
         and p.partitioning in ("hash", "single") \
-        and mesh_eligible_output(ch[0].output)
+        and payload is not None
     if eligible and p.partitioning == "hash" \
             and meta.conf.get(MESH_ALIGN_PARTITIONS) \
             and _mesh_align_consistent(meta):
@@ -429,7 +434,7 @@ def _convert_exchange(meta: PlanMeta, ch):
             reason = "collective_conf_off"
         elif p.partitioning not in ("hash", "single"):
             reason = f"partitioning_{p.partitioning}"
-        elif not mesh_eligible_output(ch[0].output):
+        elif collective_payload(ch[0].output, meta.conf) is None:
             reason = "string_or_nested_payload"
         else:
             reason = "partitions_misaligned"
@@ -458,7 +463,7 @@ register_exec(_CpuExch, "shuffle exchange",
               _tag_exchange, _convert_exchange,
               tpu_cls="shuffle.exchange.TpuShuffleExchangeExec",
               metrics=("partitionTime", "serializationTime",
-                       "deserializationTime"))
+                       "deserializationTime", "dictionaryEncodeTime"))
 
 
 def _tag_file_scan(meta: PlanMeta) -> None:

@@ -27,6 +27,13 @@ from .partitioner import (hash_partition_ids, hash_split_parts,
 from ..execs.base import (CpuExec, PhysicalPlan, TaskContext, TpuExec, bind_all)
 
 
+class _DictionaryOverflow(Exception):
+    """A collective exchange's string payload is not worth a broadcast
+    dictionary (cardinality guard, or >2^31 distinct bytes — beyond the
+    int32 offsets range); the exchange falls back to the per-map path
+    with reason ``dictionary_overflow``."""
+
+
 class _ExchangeBase:
     """Shared map-side materialization (runs once, guarded)."""
 
@@ -409,6 +416,9 @@ class _ExchangeBase:
         from .ici import IciShuffleCatalog
         IciShuffleCatalog.get().cleanup(sid)
         TpuShuffleManager.get(conf).cleanup(sid)
+        close_dicts = getattr(self, "_close_dicts", None)
+        if close_dicts is not None:  # dictionary-exchange broadcast state
+            close_dicts()
 
 
 class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
@@ -436,7 +446,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 
     def additional_metrics(self):
         return {"partitionTime": "MODERATE", "serializationTime": "MODERATE",
-                "deserializationTime": "MODERATE"}
+                "deserializationTime": "MODERATE",
+                "dictionaryEncodeTime": "MODERATE"}
 
     def _collective_mesh(self, ctx: TaskContext):
         """The mesh this exchange's collective would run on, or None.
@@ -449,7 +460,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         cause)."""
         if self._shuffle_mode(ctx) != "ICI":
             return None
-        from ..parallel.mesh import (MeshContext, mesh_eligible_output,
+        from ..parallel.mesh import (MeshContext, collective_payload,
                                      mesh_session_active)
         # reasons are only meaningful inside a mesh session — a plain ICI
         # session's per-map exchanges are not "fallbacks" from anything
@@ -463,8 +474,13 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         from ..config import MESH_COLLECTIVE_ENABLED
         if not ctx.conf.get(MESH_COLLECTIVE_ENABLED):
             return decline("collective_conf_off")
-        if not mesh_eligible_output(self.output):
+        payload = collective_payload(self.output, ctx.conf)
+        if payload is None:
             return decline("string_or_nested_payload")
+        # "dict": string columns ride the fabric as int32 codes + one
+        # broadcast dictionary per exchange (encode pass at materialize,
+        # decode-on-read) — spark.rapids.tpu.exchange.dictionaryEncode
+        self._dict_payload = payload == "dict"
         if getattr(self, "collective_planned", False):
             mesh = mesh_session_active(ctx.conf)
         elif self.partitioning == "hash":
@@ -497,6 +513,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         # inherit the previous query's collective verdict: if this attempt
         # declines or falls back, the per-map path owns the shuffle id
         self._collective = False
+        self._close_dicts()
         mesh = self._collective_mesh(ctx)
         if mesh is None:
             reason = getattr(self, "_collective_reason", None)
@@ -539,7 +556,10 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
 
             def run_collective():
                 # idempotent: a transient fault on the fabric (chaos
-                # mesh.link) re-stages from the still-open spillables
+                # mesh.link) re-stages from the still-open spillables —
+                # and a lost-map recovery re-runs the dictionary ENCODE
+                # along with everything else (the dictionaries are a pure
+                # function of the still-open map outputs)
                 with self.metrics["partitionTime"].timed(), \
                         sync_scope(self.node_name()):
                     batches = []
@@ -551,16 +571,36 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                         batches.append(concat_batches(got) if len(got) > 1
                                        else got[0])
                     names = [a.name for a in self.output]
+                    pids = None
+                    if self.partitioning == "hash":
+                        # partition ids hash the ORIGINAL key values (a
+                        # dictionary code is exchange-local; hashing it
+                        # would break co-partitioning with sibling
+                        # exchanges)
+                        pids = [hash_partition_ids(b, self.keys, n_dev,
+                                                   ctx,
+                                                   metrics=self.metrics)
+                                if b is not None else None
+                                for b in batches]
+                    if getattr(self, "_dict_payload", False):
+                        batches = self._encode_dict_payload(batches, ctx)
                     if self.partitioning == "single":
                         return mesh_single_exchange(mesh, batches, names,
                                                     shuffle_id=sid)
-                    pids = [hash_partition_ids(b, self.keys, n_dev, ctx,
-                                               metrics=self.metrics)
-                            if b is not None else None for b in batches]
                     return mesh_hash_exchange(mesh, batches, pids, names,
                                               shuffle_id=sid)
 
             result = with_device_retry(run_collective, ctx.conf)
+        except _DictionaryOverflow:
+            # the broadcast dictionary is not worth it (cardinality guard,
+            # or >2^31 distinct bytes — beyond int32 offsets): the per-map
+            # device-resident path carries raw strings natively
+            self._collective_reason = "dictionary_overflow"
+            from ..obs import mesh_profile as _mprof
+            _mprof.record_fallback(sid, "dictionary_overflow")
+            IciShuffleCatalog.get().cleanup(sid)
+            self._close_dicts()
+            return False
         except TpuOOM:
             # memory pressure while staging the collective: the per-map path
             # has the full incremental-spill discipline; drop any partial
@@ -569,6 +609,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
             from ..obs import mesh_profile as _mprof
             _mprof.record_fallback(sid, "staging_oom")
             IciShuffleCatalog.get().cleanup(sid)
+            self._close_dicts()
             return False
         finally:
             for g in groups:
@@ -590,6 +631,111 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         # Chrome export ties producer exchange → consumer read
         self._collective_seq = (result.profile or {}).get("seq")
         return True
+
+    def _close_dicts(self) -> None:
+        dcols = getattr(self, "_dict_cols", None)
+        if dcols:
+            for sb in dcols.values():
+                sb.close()
+        self._dict_cols = None
+
+    def _encode_dict_payload(self, batches, ctx: TaskContext):
+        """Map-side dictionary-encode pass of the collective exchange:
+        build ONE dictionary per string/binary column across ALL shards'
+        map outputs, replace each column with its int32 codes (nulls ride
+        the code validity), and park the dictionaries as SPILLABLE device
+        batches on the exchange — under HBM pressure they spill and
+        restore through the same v2 framing + checksum tier as any
+        shuffle block, and `cleanup_shuffle` releases them with the
+        blocks. The fabric then moves fixed-width codes instead of raw
+        bytes (reference analogue: nvcomp-compressed shuffle batches);
+        the reduce side decodes on read (`_decode_dict_block`). Raises
+        `_DictionaryOverflow` past the cardinality / 2^31-byte guards."""
+        import time
+
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        from ..columnar.vector import TpuColumnVector
+        from ..config import EXCHANGE_DICT_MAX_CARDINALITY
+        from ..memory.spill import SpillableColumnarBatch
+        from ..parallel import mesh as _mesh
+        from ..types import BinaryType, IntegerType, StringType
+        t0 = time.perf_counter_ns()
+        self._close_dicts()
+        str_ords = [i for i, a in enumerate(self.output)
+                    if isinstance(a.dtype, (StringType, BinaryType))]
+        max_card = int(ctx.conf.get(EXCHANGE_DICT_MAX_CARDINALITY))
+        dict_cols: Dict[int, SpillableColumnarBatch] = {}
+        codes_by_shard: Dict[int, Dict[int, TpuColumnVector]] = {}
+        try:
+            with self.metrics["dictionaryEncodeTime"].timed():
+                for o in str_ords:
+                    per = [b.columns[o].to_arrow() if b is not None
+                           else None for b in batches]
+                    per = [a.combine_chunks()
+                           if isinstance(a, pa.ChunkedArray) else a
+                           for a in per]
+                    from ..types import to_arrow as _t2a
+                    chunks = [a for a in per if a is not None and len(a)]
+                    combined = pa.chunked_array(
+                        chunks or [], type=_t2a(self.output[o].dtype))
+                    uniq = pc.unique(combined).drop_null()
+                    nbytes = pc.sum(pc.binary_length(uniq)).as_py() or 0
+                    if len(uniq) > max_card or nbytes >= (1 << 31):
+                        raise _DictionaryOverflow(
+                            f"ordinal {o}: {len(uniq)} distinct values / "
+                            f"{nbytes} bytes")
+                    dcol = TpuColumnVector.from_arrow(uniq)
+                    dict_cols[o] = SpillableColumnarBatch(
+                        TpuColumnarBatch([dcol], len(uniq)))
+                    for shard, arr in enumerate(per):
+                        if arr is None:
+                            continue
+                        b = batches[shard]
+                        codes = pc.index_in(arr, value_set=uniq)
+                        vals = np.asarray(
+                            codes.fill_null(0).to_numpy(
+                                zero_copy_only=False)).astype(np.int32)
+                        validity = (np.asarray(codes.is_valid())
+                                    if codes.null_count else None)
+                        codes_by_shard.setdefault(shard, {})[o] = \
+                            TpuColumnVector.from_numpy(
+                                IntegerType(), vals, validity,
+                                capacity=b.capacity)
+        except BaseException:
+            for sb in dict_cols.values():
+                sb.close()
+            raise
+        out = []
+        for shard, b in enumerate(batches):
+            if b is None:
+                out.append(None)
+                continue
+            cols = list(b.columns)
+            for o, c in codes_by_shard.get(shard, {}).items():
+                cols[o] = c
+            out.append(TpuColumnarBatch(cols, b.num_rows, b.names))
+        self._dict_cols = dict_cols
+        _mesh.record_dict_encode(time.perf_counter_ns() - t0)
+        return out
+
+    def _decode_dict_block(self, b: TpuColumnarBatch) -> TpuColumnarBatch:
+        """Reduce-side decode-on-read of a dictionary-encoded collective
+        block: codes + the exchange's broadcast dictionary → materialized
+        string columns via the device ragged gather, with the codes kept
+        as each column's `dict_encoding` so a string-keyed downstream
+        aggregation consumes them directly."""
+        dcols = getattr(self, "_dict_cols", None)
+        if not dcols or not getattr(self, "_collective", False):
+            return b
+        from ..columnar.batch import decode_dictionary_column
+        cols = list(b.columns)
+        for o, sb in dcols.items():
+            dcol = sb.get_batch().columns[0]
+            cols[o] = decode_dictionary_column(dcol, cols[o], b.num_rows,
+                                               b.capacity)
+        return TpuColumnarBatch(cols, b.num_rows, b.names)
 
     def _materialize_map(self, sid: int, map_id: int, ctx: TaskContext,
                          mgr, gate_device: bool = False) -> None:
@@ -870,7 +1016,9 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 metric=self.metrics["deserializationTime"])
             for b in blocks:
                 if b.num_rows:
-                    yield b.rename(names)
+                    # dictionary-encoded collective blocks decode on read
+                    # (codes + broadcast dictionary → device strings)
+                    yield self._decode_dict_block(b).rename(names)
             return
         # pipelined read (reference RapidsShuffleThreadedReaderBase): blocks
         # stream from the reader pool in map order while the NEXT block's
@@ -901,7 +1049,7 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 ctx.conf)
             for b in blocks:
                 if b.num_rows:
-                    yield b.rename(names)
+                    yield self._decode_dict_block(b).rename(names)
             return
         mgr = TpuShuffleManager.get(ctx.conf)
         yield from _pipelined_upload(

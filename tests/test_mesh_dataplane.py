@@ -254,20 +254,29 @@ def test_planner_align_off_keeps_conf_count():
     assert not getattr(exch, "collective_planned", False)
 
 
-def test_planner_string_payload_not_collective():
-    s = TpuSession(_mesh_conf())
+def test_planner_string_payload_dictionary_planned():
+    """A string payload is collective-planned via the dictionary-encode
+    pass (codes + one broadcast dictionary ride the fabric); with the
+    conf off it keeps the per-map path as before."""
     rng = np.random.default_rng(2)
     t = pa.table({"k": rng.integers(0, 10, 500),
                   "s": pa.array([f"x{i % 5}" for i in range(500)])})
-    df = (s.createDataFrame(t, num_partitions=4)
-          .groupBy("k").agg(F.max(F.col("s")).alias("ms")))
-    from spark_rapids_tpu.plan.overrides import TpuOverrides
-    from spark_rapids_tpu.plan.planner import plan_physical
-    conf = s._rapids_conf()
-    final = TpuOverrides.apply(plan_physical(df._plan, conf), conf)
-    exch = _find_exchange(final)
-    assert exch is not None
-    assert not getattr(exch, "collective_planned", False)
+
+    def planned(extra):
+        s = TpuSession(_mesh_conf(**extra))
+        df = (s.createDataFrame(t, num_partitions=4)
+              .groupBy("k").agg(F.max(F.col("s")).alias("ms")))
+        from spark_rapids_tpu.plan.overrides import TpuOverrides
+        from spark_rapids_tpu.plan.planner import plan_physical
+        conf = s._rapids_conf()
+        final = TpuOverrides.apply(plan_physical(df._plan, conf), conf)
+        exch = _find_exchange(final)
+        assert exch is not None
+        return getattr(exch, "collective_planned", False)
+
+    assert planned({})
+    assert not planned(
+        {"spark.rapids.tpu.exchange.dictionaryEncode.enabled": "false"})
 
 
 # ---------------------------------------------------------------------------

@@ -335,7 +335,11 @@ def test_per_map_reason_surfaces_everywhere():
     rng = np.random.default_rng(2)
     t = pa.table({"k": rng.integers(0, 10, 800),
                   "s": pa.array([f"x{i % 5}" for i in range(800)])})
-    s = TpuSession(_mesh_conf(**{"spark.rapids.tpu.trace.enabled": "true"}))
+    # dictionary encode OFF: this test exercises the per-map REASON
+    # surfaces (with it on, a string payload rides the collective)
+    s = TpuSession(_mesh_conf(**{
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.exchange.dictionaryEncode.enabled": "false"}))
     df = (s.createDataFrame(t, num_partitions=4)
           .groupBy("k").agg(F.max(F.col("s")).alias("ms")))
     df.collect()

@@ -93,14 +93,15 @@ def test_mesh_exchange_with_nulls(collective_spy):
     assert any(collective_spy)
 
 
-def test_mesh_string_columns_fall_back(collective_spy):
-    """String columns have no fixed-width device layout yet: the exchange must
-    take the per-map catalog path and still produce correct results."""
+def test_mesh_string_columns_ride_or_fall_back(collective_spy):
+    """String columns ride the collective as dictionary codes + one
+    broadcast dictionary (correct results either way); with the
+    dictionary-encode conf off they must take the per-map catalog path as
+    before."""
     rng = np.random.default_rng(5)
     t = pa.table({"k": rng.integers(0, 20, 1000),
                   "s": pa.array([f"s{int(x) % 7}" for x in
                                  rng.integers(0, 100, 1000)])})
-    s = TpuSession(dict(MESH_CONF))
     cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
 
     def q(sess):
@@ -108,11 +109,18 @@ def test_mesh_string_columns_fall_back(collective_spy):
                 .groupBy("k").agg(F.count(F.col("s")),
                                   F.max(F.col("s"))))
 
-    a = sorted(map(str, q(s).collect()))
     b = sorted(map(str, q(cpu).collect()))
-    assert a == b
+    s = TpuSession(dict(MESH_CONF))
+    assert sorted(map(str, q(s).collect())) == b
+    assert any(collective_spy), \
+        "string exchange should have ridden the dictionary collective"
+    collective_spy.clear()
+    s_off = TpuSession({
+        **MESH_CONF,
+        "spark.rapids.tpu.exchange.dictionaryEncode.enabled": "false"})
+    assert sorted(map(str, q(s_off).collect())) == b
     assert collective_spy and not any(collective_spy), \
-        "string exchange should have fallen back"
+        "with dictionaryEncode off the string exchange must fall back"
 
 
 def test_mesh_skewed_keys(collective_spy):

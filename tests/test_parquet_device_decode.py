@@ -193,16 +193,21 @@ def test_per_column_fallback_parity(tmp_path):
     t = pa.table({
         "dev_i": pa.array([None if i % 6 == 0 else i for i in range(n)],
                           pa.int64()),
-        "host_s": pa.array([None if i % 9 == 0 else f"s{i % 23}"
-                            for i in range(n)]),  # BYTE_ARRAY: host decode
+        # decimal128 → FIXED_LEN_BYTE_ARRAY: genuinely host-only (strings
+        # decode on device since the BYTE_ARRAY kernels landed)
+        "host_d": pa.array([None if i % 9 == 0 else __import__(
+            "decimal").Decimal(i) / 4 for i in range(n)],
+            pa.decimal128(25, 2)),
         "dev_f": pa.array(np.arange(n) * 0.25, pa.float64()),
+        "dev_s": pa.array([None if i % 9 == 0 else f"s{i % 23}"
+                           for i in range(n)]),  # BYTE_ARRAY: device decode
     })
     p = _write(tmp_path, t, compression="snappy", row_group_size=700)
     got = _device_read(p)
     _assert_tables_equal(got, pq.read_table(p))
     st = dd.decode_stats()
-    assert st["fallback_columns"] >= 3  # host_s once per row group
-    assert st["device_columns"] >= 6
+    assert st["fallback_columns"] >= 3  # host_l once per row group
+    assert st["device_columns"] >= 9    # incl. the string column
     assert st["dispatches"] == 3
 
 

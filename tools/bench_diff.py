@@ -24,7 +24,8 @@ understood explicitly: ``scaling_efficiency`` and ``per_chip_rows_per_s``
 are higher-is-better gates like any throughput key, and the collective
 PHASE WALLS from the mesh efficiency profiler (``phases_ms.staging`` /
 ``launch`` / ``collective_wait`` / ``compact``, plus
-``collective_ms(_total)``) gate LOWER-is-better by default — no
+``collective_ms(_total)`` and the r07+ dictionary-exchange encode wall
+``dict_encode_ms(_total)``) gate LOWER-is-better by default — no
 ``--include-overhead`` needed, because for a data plane whose efficiency
 problem IS unattributed wall, a phase wall growing 10% is exactly the
 regression the profiler exists to catch.
@@ -53,7 +54,8 @@ _LOWER_RE = re.compile(r"(dispatch_overhead_ms|collective_ms(_total)?)$")
 #: only-old/only-new instead of a spurious regression)
 _MULTICHIP_LOWER_RE = re.compile(
     r"(phases_ms\.(staging|launch|collective_wait|compact)"
-    r"|collective_ms(_total)?|collective_phases_ms_total)$")
+    r"|collective_ms(_total)?|collective_phases_ms_total"
+    r"|dict_encode_ms(_total)?)$")
 
 
 def is_multichip(parsed) -> bool:
