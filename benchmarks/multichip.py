@@ -20,6 +20,16 @@ exchanges used it (`string_collectives`, `dict_encode_ms`) — the
 per-query `collective_launches` vs `exchanges` split stays the honest
 coverage number, now expected to match.
 
+Since the fused dataplane (ISSUE 16) the summary also carries
+`compact_fused` (True when every exchange compacted INSIDE the one
+cached collective dispatch — False is a regression), the staging-pool
+`staging_reuse_hits` counter, and `overlap_segments` (non-zero only when
+the opt-in segmented exchange/compute overlap ran; set
+``MULTICHIP_OVERLAP=K`` to arm `spark.rapids.tpu.exchange.overlap.*`
+with K segments for a round). tools/bench_diff.py gates the
+compact/staging phase walls lower-is-better and treats the two new
+counters as neutral.
+
 Usage: python benchmarks/multichip.py [--devices N] [--rows N]
 (on a machine without N real chips, run through
 `__graft_entry__.dryrun_multichip`, which virtualizes an N-device CPU
@@ -138,6 +148,14 @@ def run(n_devices: int, rows: int) -> dict:
     # the input isolates what the bit-identity check is FOR: the data
     # plane moves every row to the right shard, unchanged.
     extra = {"spark.rapids.sql.batchSizeRows": str(max(rows, 1 << 16))}
+    # opt-in overlap round (ISSUE 16): MULTICHIP_OVERLAP=K arms the
+    # segmented exchange/compute overlap; bit-identity still asserts
+    overlap_k = int(os.environ.get("MULTICHIP_OVERLAP", "0") or 0)
+    if overlap_k > 1:
+        extra.update({
+            "spark.rapids.tpu.exchange.overlap.enabled": "true",
+            "spark.rapids.tpu.exchange.overlap.segments": str(overlap_k),
+        })
     # fact tables load with parts == mesh size so BOTH plans (mesh and
     # baseline) are structurally identical: the planner sizes exchanges by
     # min(shuffle.partitions, child partitions), so fewer input parts would

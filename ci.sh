@@ -59,6 +59,8 @@ echo "== fast tier-1 gate (not slow) =="
 # O(row-groups) dispatch assertion, and the mesh data plane — collective
 # exchange parity across fusion/coalesce, the O(exchanges) launch
 # counter, AQE device statistics, the lost-shard/slow-link chaos heal,
+# the fused-compact/overlap bit-identity + mid-segment chaos soak, the
+# collective-path AQE skew splits (test_aqe_skew.py),
 # and the mesh efficiency profiler: phase-wall attribution, skew/
 # straggler reporting, the collective watchdog, zero profiler syncs)
 # and the device-native string pipeline — BYTE_ARRAY decode oracles,
@@ -73,7 +75,7 @@ python -m pytest \
   tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   tests/test_mesh_profile.py tests/test_query_lifecycle.py \
-  tests/test_string_pipeline.py \
+  tests/test_string_pipeline.py tests/test_aqe_skew.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="

@@ -30,7 +30,16 @@ follows two rules, checked statically here over ``execs/``, ``shuffle/``,
    makes this non-negotiable (the sync would fire on EVERY query, not
    just traced ones).
 
-Both are errors; the baseline stays EMPTY — our own instrumentation
+3. **The fused collective dataplane stays one dispatch.** The post-
+   collective compact of ``parallel/mesh.py`` runs INSIDE the cached
+   exchange program (scatter to ``bases[src] + pos`` under the host-known
+   sizing counts — ISSUE 16's fused compact): a call to the host-compact
+   idiom (``columnar.batch._compact_plan`` / ``gather``) in that module
+   re-introduces the per-partition host round-trips the fusion removed,
+   so it fails static analysis here rather than waiting for a bench round
+   to notice the compact wall is back.
+
+All are errors; the baseline stays EMPTY — our own instrumentation
 complies, and new emission sites must too.
 """
 
@@ -70,6 +79,12 @@ _OBS_MODULE_NAMES = ("tracer", "metrics", "flight", "obs", "mesh_profile")
 _INTERNAL_NAMES = ("QueryTracer", "_Span", "_NullSpan", "MetricsRegistry")
 _INTERNAL_ATTRS = ("_append", "_alloc_span", "_ring", "_cells",
                    "_counters", "_gauges", "_hists")
+
+#: rule 3 — the fused one-dispatch surface: modules whose post-collective
+#: consumption must stay inside the ONE cached exchange program; calling
+#: the host-compact idiom there is the regression the fusion removed
+_FUSED_DISPATCH_MODULES: Tuple[str, ...] = ("parallel/mesh.py",)
+_HOST_COMPACT_CALLS: Tuple[str, ...] = ("_compact_plan", "gather")
 
 
 def _dotted(node: ast.AST) -> str:
@@ -193,6 +208,17 @@ class _Visitor(ast.NodeVisitor):
         return False
 
     def visit_Call(self, node: ast.Call) -> None:
+        if self.relpath in _FUSED_DISPATCH_MODULES:
+            last = _dotted(node.func).split(".")[-1]
+            if last in _HOST_COMPACT_CALLS:
+                self.hits.append((
+                    self._qual(), node.lineno,
+                    f"host-side compact ({_dotted(node.func)}) in the "
+                    f"fused collective dataplane — the post-collective "
+                    f"compact is part of the ONE cached exchange dispatch "
+                    f"(scatter to bases[src]+pos under the host-known "
+                    f"sizing counts); a host _compact_plan/gather here "
+                    f"regresses the compact wall the fusion removed"))
         if self._is_emit_call(node):
             for arg in list(node.args) + [k.value for k in node.keywords]:
                 for sub in ast.walk(arg):

@@ -669,6 +669,36 @@ MESH_ALIGN_PARTITIONS = _conf(
     "hand-tuning spark.sql.shuffle.partitions to the topology."
 ).boolean(True)
 
+EXCHANGE_OVERLAP_ENABLED = _conf(
+    "spark.rapids.tpu.exchange.overlap.enabled").doc(
+    "Segment eligible collective exchanges so segment k+1's all_to_all is "
+    "in flight on the fabric while the fused post-collective compact "
+    "consumes segment k (exchange/compute overlap, "
+    "parallel/mesh.py). Every segment scatters to the same final row "
+    "positions the unsegmented program uses, so results are bit-identical "
+    "at any segment count; the exchange still records exactly ONE "
+    "mesh_collective launch (segments count under mesh_overlap_segment). "
+    "Correctness-first default: off — each exchange runs as one fused "
+    "program."
+).boolean(False)
+
+EXCHANGE_OVERLAP_SEGMENTS = _conf(
+    "spark.rapids.tpu.exchange.overlap.segments").doc(
+    "Segment count K for spark.rapids.tpu.exchange.overlap.enabled: the "
+    "collective payload splits into K slot-axis segments, double-buffered "
+    "so at most one segment's transfer overlaps one segment's compact. "
+    "Values <= 1 disable segmentation."
+).integer(2)
+
+EXCHANGE_OVERLAP_MIN_ROWS = _conf(
+    "spark.rapids.tpu.exchange.overlap.minSlotRows").doc(
+    "Minimum per-bucket slot capacity (rows) for the segmented overlap "
+    "path to engage: below it, per-segment launch overhead dominates "
+    "whatever transfer time the overlap could hide and the exchange runs "
+    "unsegmented (the sizing sync already knows the capacity, so the "
+    "decision costs nothing)."
+).integer(1024)
+
 COMPILED_AGG_ENABLED = _conf("spark.rapids.tpu.agg.compiledStage.enabled").doc(
     "Fuse eligible scan->filter->project->groupBy pipelines into ONE jitted "
     "XLA program with a direct-indexed group table (small key domains only). "

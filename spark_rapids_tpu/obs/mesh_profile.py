@@ -219,7 +219,10 @@ def record_exchange(seq: int, shuffle_id: int, partitioning: str,
                     n_dev: int, send_rows: List[int], recv_rows: List[int],
                     recv_bytes: List[int], stage_ns: int, launch_ns: int,
                     wait_ns: int, compact_ns: int,
-                    watchdog_fired: bool = False
+                    watchdog_fired: bool = False,
+                    compact_fused: bool = False,
+                    staging_reuse_hits: int = 0,
+                    overlap_segments: int = 0
                     ) -> Optional[Dict[str, Any]]:
     """Record one collective exchange's profile. Every argument is a host
     value the collective already computed (the sizing counters and the
@@ -250,6 +253,14 @@ def record_exchange(seq: int, shuffle_id: int, partitioning: str,
         },
         "skew": skew,
         "watchdog_fired": bool(watchdog_fired),
+        # r07 fused dataplane keys (docs/distributed.md "Fused compact &
+        # overlap"): whether the post-collective compact ran inside the
+        # collective dispatch, how many staged pad pieces came from the
+        # staging pool, and the segment count when the exchange rode the
+        # overlapped path (0 = unsegmented)
+        "compact_fused": bool(compact_fused),
+        "staging_reuse_hits": int(staging_reuse_hits),
+        "overlap_segments": int(overlap_segments),
     }
     # registry histograms (docs/observability.md "Mesh profiling"):
     # imbalance ×100 so the log2 buckets resolve 1.28x from 2.56x from

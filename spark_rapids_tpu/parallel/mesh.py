@@ -19,34 +19,63 @@ Static-shape strategy (XLA cannot size buffers data-dependently):
      bucketed slot capacity — the analogue of the reference sizing
      contiguousSplit slices before handing them to the transport. The SAME
      counts are the exchange's device-side partition statistics: exact
-     per-reduce row/byte sizes are known at exchange time, so AQE planning
-     (`partition_sizes`) never re-fetches blocks, and the received batches
-     compact under HOST-KNOWN counts (zero per-partition count syncs);
+     per-reduce AND per-source row/byte sizes are known at exchange time,
+     so AQE planning (`partition_sizes`, skew `map_block_sizes`) never
+     re-fetches blocks;
   3. the jitted exchange scatters rows into [n_shards, slot_cap] send
-     buffers and `all_to_all`s them; receive-validity rides along.
-Compiled programs are cached by (mesh, capacity, slot_cap, column dtypes) so
-steady-state queries reuse one executable. Every launch lands in the
-process-wide dispatch accounting as kind "mesh_collective"
-(`opjit.record_external_dispatch`) and — when the query tracer is armed —
-inside a `mesh.exchange` span carrying the per-chip send-row breakdown and
-the stage/launch/wait timing split (docs/observability.md).
+     buffers, `all_to_all`s them, and — because the per-source counts are
+     host-known — FUSES the post-collective compact into the same program:
+     received slot (src s, pos p) scatters straight to its final row
+     `bases[s] + p` (`bases` = exclusive cumsum of this shard's receive
+     counts), reproducing bit-for-bit the (src asc, stable) order the old
+     host-side compact produced, with zero host round-trips. The per-reduce
+     output blocks leave the program replicated, so downstream consumers
+     mix blocks freely.
+
+Staging is donation-friendly: the concatenated global inputs are DONATED
+to the exchange program (`donate_argnums`, gated off on the CPU backend
+exactly like execs/opjit._donate) so XLA reuses their HBM for the outputs,
+and constant pad pieces (empty-shard columns, destination fills) come from
+a small process-wide staging pool keyed by (kind, capacity, dtype, fill) —
+`mesh.staging_reuse_hits` counts the copies that no longer happen.
+
+Exchange/compute overlap (`spark.rapids.tpu.exchange.overlap.*`, default
+OFF — correctness first): the payload splits into K segments along the
+slot axis; segment k+1's all_to_all is in flight while the fused compact
+consumes segment k into donated accumulators. Every segment scatters to
+the SAME final row positions the unsegmented program uses, so results are
+bit-identical at any K. Chaos `mesh.link` fires per segment
+(`detail="s<id>seg<k>"`); a mid-segment fault abandons the donated
+accumulators and the caller's with_device_retry re-stages from the still-
+open spillables, so no donated buffer is ever applied twice.
+
+Compiled programs are cached by (mesh, capacity, slot_cap, column dtypes)
+so steady-state queries reuse one executable. Every exchange lands in the
+process-wide dispatch accounting as ONE kind "mesh_collective" launch
+(`opjit.record_external_dispatch`) — O(exchanges) regardless of overlap;
+segment launches count separately under "mesh_overlap_segment" — and,
+when the query tracer is armed, inside a `mesh.exchange` span carrying the
+per-chip send-row breakdown and the stage/launch/wait timing split
+(docs/observability.md).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..columnar.batch import TpuColumnarBatch, _compact_plan, _repad, gather
+from ..columnar.batch import TpuColumnarBatch, _repad
 from ..columnar.vector import (TpuColumnVector, audited_device_get,
                                bucket_capacity, row_mask)
-from ..config import MESH_ENABLED, MESH_SIZE, SHUFFLE_MODE
+from ..config import (EXCHANGE_OVERLAP_ENABLED, EXCHANGE_OVERLAP_MIN_ROWS,
+                      EXCHANGE_OVERLAP_SEGMENTS, MESH_ENABLED, MESH_SIZE,
+                      SHUFFLE_MODE)
 from ..obs import tracer as obs
 
 _AXIS = "data"
@@ -80,6 +109,7 @@ class MeshContext:
     def reset_for_tests(cls) -> None:
         with cls._lock:
             cls._meshes = {}
+        reset_staging_pool()
 
 
 def mesh_session_active(conf) -> Optional[Mesh]:
@@ -127,7 +157,47 @@ def collective_payload(output, conf) -> Optional[str]:
 # Guarded: collective exchanges can materialize from concurrent query
 # threads (TL010 — same discipline as the opjit executable cache).
 _CACHE_LOCK = threading.Lock()
-_EXCHANGE_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+_EXCHANGE_CACHE: Dict[Tuple, object] = {}
+
+# staging pool: constant pad pieces (empty-shard columns, destination
+# fills) keyed by (kind, capacity, dtype, fill). jax.Arrays are immutable
+# and the pieces feed jnp.concatenate (which copies into the donated
+# global input), so pooling them is safe even though the concatenated
+# staging buffer itself is donated to the exchange program.
+_POOL_LOCK = threading.Lock()
+_STAGING_POOL: Dict[Tuple, jax.Array] = {}
+_STAGING_POOL_MAX = 256
+
+
+def _pooled_fill(kind: str, cap: int, dtype, fill) -> Tuple[jax.Array, int]:
+    """A pooled constant array (cap,) of `fill`; returns (array, hit)."""
+    key = (kind, int(cap), str(jnp.dtype(dtype)), fill)
+    with _POOL_LOCK:
+        arr = _STAGING_POOL.get(key)
+    if arr is not None:
+        return arr, 1
+    arr = jnp.full((cap,), fill, dtype)
+    with _POOL_LOCK:
+        if len(_STAGING_POOL) < _STAGING_POOL_MAX:
+            _STAGING_POOL[key] = arr
+    return arr, 0
+
+
+def reset_staging_pool() -> None:
+    with _POOL_LOCK:
+        _STAGING_POOL.clear()
+
+
+def _donate(positions: Iterable[int]) -> Tuple[int, ...]:
+    """Buffer-donation argnums for the staged collective inputs: XLA may
+    reuse their HBM for the program's outputs instead of allocating fresh
+    buffers. The CPU backend does not implement donation (it warns and
+    copies) — same gate as execs/opjit._donate. Donated staging is never
+    retried in place: a faulted exchange re-stages from the spillables
+    (with_device_retry around run_collective), so a donated buffer is
+    consumed at most once."""
+    return tuple(positions) if jax.default_backend() != "cpu" else ()
+
 
 # collective-launch statistics (bench MULTICHIP stage + the O(exchanges)
 # assertion read these next to opjit calls_by_kind["mesh_collective"]).
@@ -136,7 +206,10 @@ _STATS = {"launches": 0, "rows_sent": 0, "stage_ns": 0, "launch_ns": 0,
           "wait_ns": 0, "compact_ns": 0,
           # dictionary-encoded string exchanges (the MULTICHIP summary's
           # multichip_string_collectives / dict_encode_ms keys)
-          "dict_exchanges": 0, "dict_encode_ns": 0}
+          "dict_exchanges": 0, "dict_encode_ns": 0,
+          # staging-pool reuse + segmented-overlap accounting (r07 fused
+          # dataplane keys: docs/distributed.md "Fused compact & overlap")
+          "staging_reuse_hits": 0, "overlap_segments": 0}
 
 
 def collective_stats() -> Dict[str, int]:
@@ -159,7 +232,9 @@ def record_dict_encode(ns: int) -> None:
 
 
 def _record_launch(rows: int, stage_ns: int, launch_ns: int,
-                   wait_ns: int, compact_ns: int) -> None:
+                   wait_ns: int, compact_ns: int,
+                   staging_reuse_hits: int = 0,
+                   overlap_segments: int = 0) -> None:
     with _STATS_LOCK:
         _STATS["launches"] += 1
         _STATS["rows_sent"] += rows
@@ -167,12 +242,15 @@ def _record_launch(rows: int, stage_ns: int, launch_ns: int,
         _STATS["launch_ns"] += launch_ns
         _STATS["wait_ns"] += wait_ns
         _STATS["compact_ns"] += compact_ns
+        _STATS["staging_reuse_hits"] += staging_reuse_hits
+        _STATS["overlap_segments"] += overlap_segments
     # always-on registry (docs/observability.md): the collective's blocking
     # wait is the fabric's user-visible latency — histogram it per launch
     # (rare: one per exchange) so a serving dashboard sees the tail;
     # the running totals above fold into metrics_snapshot() as-is
     from ..obs import metrics as _metrics
     _metrics.histogram_observe("mesh.collective_wait_ms", wait_ns / 1e6)
+    _metrics.counter_inc("mesh.staging_reuse_hits", staging_reuse_hits)
 
 
 class MeshExchangeResult(NamedTuple):
@@ -181,12 +259,23 @@ class MeshExchangeResult(NamedTuple):
     rows: List[int]                  # exact received rows per reduce part
     bytes: List[int]                 # device bytes per reduce part
     profile: Optional[Dict] = None   # obs/mesh_profile.py record
+    #: per reduce partition: rows contributed by each SOURCE shard (the
+    #: sizing counts' column) — the fused block's row order is (source
+    #: asc, stable), so a contiguous source range is a contiguous row
+    #: range: AQE skew splitting slices on these (map_block_sizes)
+    src_rows: Optional[List[List[int]]] = None
+    row_bytes: int = 0               # device bytes per row (fixed layout)
 
 
 def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
                     sig: Tuple[Tuple[str, bool], ...]):
-    """One jitted shard_map program moving `len(sig)` columns + validity via
-    all_to_all. `sig` is ((dtype_str, has_validity), ...)."""
+    """ONE jitted program: shard_map all_to_all moving `len(sig)` columns +
+    validity AND the fused post-collective compact — received slot (src s,
+    pos p) scatters to final row `bases[s] + p` under the host-known
+    per-source counts, so the outputs need no host-side compact at all.
+    Returns the per-reduce blocks lane-major (`n_lanes * n_dev` outputs,
+    each replicated so downstream consumers mix blocks across partitions).
+    `sig` is ((dtype_str, has_validity), ...)."""
     key = (mesh, n_dev, slot_cap, sig)
     with _CACHE_LOCK:
         fn = _EXCHANGE_CACHE.get(key)
@@ -194,9 +283,11 @@ def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
         return fn
 
     n_cols = len(sig)
+    local = n_dev * slot_cap
 
-    def exchange(dest, *flat):
-        # per-shard local views: dest [cap], columns/validities [cap]
+    def exchange(dest, counts, *flat):
+        # per-shard local views: dest [cap], counts [n_dev] (rows each
+        # SOURCE shard sends to this shard), columns/validities [cap]
         cap = dest.shape[0]
         order = jnp.argsort(dest, stable=True)
         sorted_dest = jnp.take(dest, order)
@@ -206,42 +297,194 @@ def _build_exchange(mesh: Mesh, n_dev: int, slot_cap: int,
             sorted_dest + 1].add(one, mode="drop")
         starts = jnp.cumsum(run_start)[:-1]
         pos_in_bucket = idx - jnp.take(starts, sorted_dest)
-        live = sorted_dest < n_dev
-        keep = live & (pos_in_bucket < slot_cap)
+        keep = (sorted_dest < n_dev) & (pos_in_bucket < slot_cap)
         send_slot = jnp.where(keep, sorted_dest * slot_cap + pos_in_bucket,
-                              n_dev * slot_cap)
+                              local)
+        # fused compact: the receive side's slot (s, p) is occupied iff
+        # p < counts[s]; its final row is bases[s] + p — identical to the
+        # (src asc, stable in-bucket) order the host compact produced
+        slot_src = jnp.arange(local, dtype=jnp.int32) // slot_cap
+        slot_pos = jnp.arange(local, dtype=jnp.int32) % slot_cap
+        bases = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+        occupied = slot_pos < jnp.take(counts, slot_src)
+        out_idx = jnp.where(occupied,
+                            jnp.take(bases, slot_src) + slot_pos, local)
 
         def a2a(x):
             x = x.reshape(n_dev, slot_cap)
             return jax.lax.all_to_all(x, _AXIS, split_axis=0, concat_axis=0,
                                       tiled=False).reshape(-1)
 
-        def scatter_send(x, fill, dt):
-            buf = jnp.full((n_dev * slot_cap,), fill, dt).at[send_slot].set(
+        def move(x, fill, dt):
+            buf = jnp.full((local,), fill, dt).at[send_slot].set(
                 jnp.take(x, order), mode="drop")
-            return a2a(buf)
+            recv = a2a(buf)
+            return jnp.full((local,), fill, dt).at[out_idx].set(
+                recv, mode="drop")
 
-        rowok = a2a(jnp.zeros((n_dev * slot_cap,), jnp.bool_).at[
-            send_slot].set(keep, mode="drop"))
-        outs = [rowok]
+        outs = []
         datas = flat[:n_cols]
         valids = flat[n_cols:]
         for (dt, has_v), d, v in zip(sig, datas, valids):
-            outs.append(scatter_send(d, 0, d.dtype))
+            outs.append(move(d, 0, d.dtype))
             if has_v:
-                outs.append(scatter_send(v, False, jnp.bool_))
+                outs.append(move(v, False, jnp.bool_))
         return tuple(outs)
 
     from .distributed import shard_map
     spec = P(_AXIS)
     n_valid = sum(1 for _, has_v in sig if has_v)
-    in_specs = tuple([spec] * (1 + 2 * n_cols))
-    out_specs = tuple([spec] * (1 + n_cols + n_valid))
-    fn = jax.jit(shard_map(exchange, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_rep=False))
+    n_lanes = n_cols + n_valid
+    n_flat = 2 * n_cols
+    sm = shard_map(exchange, mesh=mesh,
+                   in_specs=tuple([spec] * (2 + n_flat)),
+                   out_specs=tuple([spec] * n_lanes), check_rep=False)
+
+    def whole(dest, counts, *flat):
+        outs = sm(dest, counts, *flat)
+        blocks = []
+        for arr in outs:
+            for r in range(n_dev):
+                blocks.append(arr[r * local:(r + 1) * local])
+        return tuple(blocks)
+
+    fn = jax.jit(whole, out_shardings=NamedSharding(mesh, P()),
+                 donate_argnums=_donate((0,) + tuple(
+                     range(2, 2 + n_flat))))
     with _CACHE_LOCK:
         _EXCHANGE_CACHE[key] = fn
     return fn
+
+
+def _build_overlap(mesh: Mesh, n_dev: int, slot_cap: int, k_seg: int,
+                   sig: Tuple[Tuple[str, bool], ...]):
+    """The segmented exchange's cached programs (overlap mode):
+
+    * ``prep``   — ONE dispatch computing every lane's send-layout buffer
+                   (slot pitch padded to ``k_seg * seg_cap``);
+    * ``a2a``    — per-segment all_to_all of all lanes; the segment index
+                   is a TRACED scalar, so all K segments share one
+                   executable;
+    * ``comp``   — per-segment fused compact scattering the received
+                   segment into DONATED accumulators at the same final
+                   rows the unsegmented program uses (bit-identical at
+                   any K);
+    * ``fin``    — replicate-and-slice the accumulators into per-reduce
+                   blocks (same output layout as `_build_exchange`).
+
+    Returns (prep, a2a, comp, fin, seg_cap)."""
+    key = (mesh, n_dev, slot_cap, k_seg, sig, "overlap")
+    with _CACHE_LOCK:
+        progs = _EXCHANGE_CACHE.get(key)
+    if progs is not None:
+        return progs
+
+    n_cols = len(sig)
+    seg_cap = -(-slot_cap // k_seg)
+    slot_capP = k_seg * seg_cap
+    local = n_dev * slot_cap
+    localP = n_dev * slot_capP
+    n_valid = sum(1 for _, has_v in sig if has_v)
+    n_lanes = n_cols + n_valid
+    n_flat = 2 * n_cols
+
+    def prepare(dest, *flat):
+        cap = dest.shape[0]
+        order = jnp.argsort(dest, stable=True)
+        sorted_dest = jnp.take(dest, order)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        one = jnp.ones((cap,), jnp.int32)
+        run_start = jnp.zeros((n_dev + 2,), jnp.int32).at[
+            sorted_dest + 1].add(one, mode="drop")
+        starts = jnp.cumsum(run_start)[:-1]
+        pos_in_bucket = idx - jnp.take(starts, sorted_dest)
+        keep = (sorted_dest < n_dev) & (pos_in_bucket < slot_cap)
+        send_slot = jnp.where(keep,
+                              sorted_dest * slot_capP + pos_in_bucket,
+                              localP)
+        outs = []
+        datas = flat[:n_cols]
+        valids = flat[n_cols:]
+        for (dt, has_v), d, v in zip(sig, datas, valids):
+            outs.append(jnp.full((localP,), 0, d.dtype).at[send_slot].set(
+                jnp.take(d, order), mode="drop"))
+            if has_v:
+                outs.append(jnp.full((localP,), False, jnp.bool_).at[
+                    send_slot].set(jnp.take(v, order), mode="drop"))
+        return tuple(outs)
+
+    def seg_a2a(k, *sends):
+        outs = []
+        for s in sends:
+            x = s.reshape(n_dev, slot_capP)
+            seg = jax.lax.dynamic_slice(
+                x, (jnp.int32(0), (k * jnp.int32(seg_cap)).astype(jnp.int32)),
+                (n_dev, seg_cap))
+            outs.append(jax.lax.all_to_all(
+                seg, _AXIS, split_axis=0, concat_axis=0,
+                tiled=False).reshape(-1))
+        return tuple(outs)
+
+    def seg_compact(k, counts, *accseg):
+        accs = accseg[:n_lanes]
+        segs = accseg[n_lanes:]
+        nloc = n_dev * seg_cap
+        seg_src = jnp.arange(nloc, dtype=jnp.int32) // seg_cap
+        seg_pos = jnp.arange(nloc, dtype=jnp.int32) % seg_cap
+        p = k * seg_cap + seg_pos
+        bases = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+        occupied = p < jnp.take(counts, seg_src)
+        out_idx = jnp.where(occupied, jnp.take(bases, seg_src) + p, local)
+        return tuple(acc.at[out_idx].set(seg, mode="drop")
+                     for acc, seg in zip(accs, segs))
+
+    def finalize(*accs):
+        blocks = []
+        for acc in accs:
+            for r in range(n_dev):
+                blocks.append(acc[r * local:(r + 1) * local])
+        return tuple(blocks)
+
+    from .distributed import shard_map
+    spec = P(_AXIS)
+    rep = NamedSharding(mesh, P())
+    prep = jax.jit(
+        shard_map(prepare, mesh=mesh,
+                  in_specs=tuple([spec] * (1 + n_flat)),
+                  out_specs=tuple([spec] * n_lanes), check_rep=False),
+        donate_argnums=_donate(range(1 + n_flat)))
+    a2a = jax.jit(
+        shard_map(seg_a2a, mesh=mesh,
+                  in_specs=(P(),) + tuple([spec] * n_lanes),
+                  out_specs=tuple([spec] * n_lanes), check_rep=False))
+    comp = jax.jit(
+        shard_map(seg_compact, mesh=mesh,
+                  in_specs=(P(), spec) + tuple([spec] * (2 * n_lanes)),
+                  out_specs=tuple([spec] * n_lanes), check_rep=False),
+        donate_argnums=_donate(range(2, 2 + 2 * n_lanes)))
+    fin = jax.jit(finalize, out_shardings=rep,
+                  donate_argnums=_donate(range(n_lanes)))
+    progs = (prep, a2a, comp, fin, seg_cap)
+    with _CACHE_LOCK:
+        _EXCHANGE_CACHE[key] = progs
+    return progs
+
+
+def _overlap_segments(conf, slot_cap: int) -> int:
+    """Segment count for this exchange, or 0 (unsegmented). Correctness-
+    first default: overlap only when explicitly enabled AND the slot
+    capacity clears the minimum (below it, per-segment launch overhead
+    dominates whatever the fabric could hide)."""
+    if conf is None or not conf.get(EXCHANGE_OVERLAP_ENABLED):
+        return 0
+    k = int(conf.get(EXCHANGE_OVERLAP_SEGMENTS))
+    if k <= 1 or slot_cap < max(k, int(conf.get(EXCHANGE_OVERLAP_MIN_ROWS))):
+        return 0
+    return k
 
 
 def _fixed_row_bytes(ref: TpuColumnarBatch, has_valid: List[bool]) -> int:
@@ -261,15 +504,19 @@ def mesh_hash_exchange(mesh: Mesh,
                        pids_list: List[Optional[jnp.ndarray]],
                        names: Sequence[str],
                        shuffle_id: int = -1,
-                       partitioning: str = "hash") -> MeshExchangeResult:
+                       partitioning: str = "hash",
+                       conf=None) -> MeshExchangeResult:
     """Collective hash exchange: `group_batches[d]` is the (possibly empty)
     concatenated map input assigned to shard d, `pids_list[d]` its
     destination-partition ids. Returns one compacted device batch per reduce
-    partition (= per shard) plus the exact per-reduce row/byte counts
-    derived from the sizing counts (the device-side statistics AQE plans
-    against — no block fetch, no extra sync) and the exchange's
-    efficiency profile (obs/mesh_profile.py: phase walls + per-chip skew,
-    all from host values this function already holds)."""
+    partition (= per shard) — compaction happens INSIDE the collective
+    program (fused compact) under the host-known sizing counts — plus the
+    exact per-reduce row/byte counts AND the per-source row split (the
+    device-side statistics AQE plans coalescing and skew slicing against —
+    no block fetch, no extra sync) and the exchange's efficiency profile
+    (obs/mesh_profile.py: phase walls + per-chip skew, all from host
+    values this function already holds). `conf` (optional — direct kernel
+    callers may omit it) gates the segmented overlap path."""
     from ..chaos import inject
     from ..execs import opjit
     from ..obs import mesh_profile as mprof
@@ -296,18 +543,29 @@ def mesh_hash_exchange(mesh: Mesh,
     fetched = audited_device_get([p for _d, _b, p in live], "mesh_counts") \
         if live else []
     max_count = 1
-    recv_rows = np.zeros(n_dev, np.int64)
-    send_rows = np.zeros(n_dev, np.int64)
+    counts_m = np.zeros((n_dev, n_dev), np.int64)
     for (shard, b, _p), pids_np in zip(live, fetched):
         counts = np.bincount(np.asarray(pids_np)[: b.num_rows],
                              minlength=n_dev)
         max_count = max(max_count, int(counts.max()))
-        recv_rows += counts
-        send_rows[shard] += int(counts.sum())
+        counts_m[shard] += counts
+    recv_rows = counts_m.sum(axis=0)
+    send_rows = counts_m.sum(axis=1)
     slot_cap = bucket_capacity(max_count)
+    overlap_k = _overlap_segments(conf, slot_cap)
 
-    # stack per-shard arrays into globally sharded [n_dev * cap] inputs
+    # stack per-shard arrays into globally sharded [n_dev * cap] inputs;
+    # constant pad pieces (empty shards, destination fills) come from the
+    # staging pool — the copies they replace are the "staging" wall
     sharding = NamedSharding(mesh, P(_AXIS))
+    reuse_hits = 0
+
+    def pad(kind: str, dtype, fill):
+        nonlocal reuse_hits
+        arr, hit = _pooled_fill(kind, cap, dtype, fill)
+        reuse_hits += hit
+        return arr
+
     sig = []
     col_data: List[List[jnp.ndarray]] = []
     col_valid: List[List[jnp.ndarray]] = []
@@ -320,8 +578,8 @@ def mesh_hash_exchange(mesh: Mesh,
         datas, valids = [], []
         for b in group_batches:
             if b is None:
-                datas.append(jnp.zeros((cap,), carrier))
-                valids.append(jnp.zeros((cap,), jnp.bool_))
+                datas.append(pad("zeros", carrier, 0))
+                valids.append(pad("mask", jnp.bool_, False))
             else:
                 c = _repad(b.columns[i], cap)
                 datas.append(c.data)
@@ -332,7 +590,7 @@ def mesh_hash_exchange(mesh: Mesh,
     dests = []
     for b, pids in zip(group_batches, pids_list):
         if b is None or not b.num_rows:
-            dests.append(jnp.full((cap,), n_dev, jnp.int32))
+            dests.append(pad("dest", jnp.int32, n_dev))
         else:
             p = jnp.asarray(pids)[:cap].astype(jnp.int32)
             if p.shape[0] < cap:
@@ -344,30 +602,42 @@ def mesh_hash_exchange(mesh: Mesh,
         return jax.device_put(jnp.concatenate(arrs), sharding)
 
     dest_g = shard(dests)
+    counts_g = shard([jnp.asarray(counts_m[:, r].astype(np.int32))
+                      for r in range(n_dev)])
     flat = [shard(col_data[i]) for i in range(len(dtypes))] + \
            [shard(col_valid[i]) for i in range(len(dtypes))]
-    fn = _build_exchange(mesh, n_dev, slot_cap, tuple(sig))
+    if overlap_k:
+        ovl = _build_overlap(mesh, n_dev, slot_cap, overlap_k, tuple(sig))
+    else:
+        fn = _build_exchange(mesh, n_dev, slot_cap, tuple(sig))
     t_launch0 = time.perf_counter_ns()
     # pre-allocated profile seq: the span args and the consumer read's
     # flow events reference the profile before it is recorded
     seq = mprof.alloc_seq()
-    # the span covers launch → wait → compact (staging_ms rides as an arg:
-    # the per-chip send counts it reports only exist after the sizing
-    # sync). The watchdog arms around ONLY the fabric window — inject +
-    # launch + wait — and disarms before the host-side compact: chaos
-    # `mesh.link` (a slow or flapping ICI link) injects inside it, so a
-    # stalled transfer trips the watchdog exactly like a hung chip would,
-    # while a long (pure-CPU) compact never raises a false "hung chip".
-    # Latency sleeps here; a transient error propagates to the caller's
-    # with_device_retry, which re-runs the whole (idempotent) staging.
+    # the span covers launch → wait → block construction (staging_ms rides
+    # as an arg: the per-chip send counts it reports only exist after the
+    # sizing sync). The watchdog arms around ONLY the fabric window —
+    # inject + launch + wait: chaos `mesh.link` (a slow or flapping ICI
+    # link) injects inside it, so a stalled transfer trips the watchdog
+    # exactly like a hung chip would. Latency sleeps here; a transient
+    # error propagates to the caller's with_device_retry, which re-runs
+    # the whole (idempotent) staging — donated buffers are abandoned, not
+    # reused.
     with obs.span(f"mesh.exchange s{shuffle_id}",
                   cat="shuffle.collective", shuffle=shuffle_id,
                   n_dev=n_dev, slot_cap=slot_cap, exchange_seq=seq,
                   staging_ms=round((t_launch0 - t_stage0) / 1e6, 3),
+                  overlap_segments=overlap_k,
                   per_chip_rows=[int(x) for x in send_rows]):
         with mprof.collective_watchdog(shuffle_id, n_dev) as wd:
-            inject("mesh.link", detail=f"s{shuffle_id}")
-            outs = fn(dest_g, *flat)
+            if overlap_k:
+                outs = _launch_overlapped(ovl, overlap_k, mesh, n_dev,
+                                          slot_cap, tuple(sig), sharding,
+                                          dest_g, counts_g, flat,
+                                          shuffle_id)
+            else:
+                inject("mesh.link", detail=f"s{shuffle_id}")
+                outs = fn(dest_g, counts_g, *flat)
             t_wait0 = time.perf_counter_ns()
             # the collective is the stage boundary: waiting for it here is
             # the exchange's one blocking device sync (no data moves to
@@ -378,40 +648,33 @@ def mesh_hash_exchange(mesh: Mesh,
             jax.block_until_ready(outs)
             t_end = time.perf_counter_ns()
         opjit.record_external_dispatch("mesh_collective")
-        rowok = outs[0]
-        pos = 1
-        recv_data: List[jnp.ndarray] = []
-        recv_valid: List[Optional[jnp.ndarray]] = []
-        for i in range(len(dtypes)):
-            recv_data.append(outs[pos])
-            pos += 1
-            if has_valid[i]:
-                recv_valid.append(outs[pos])
-                pos += 1
-            else:
-                recv_valid.append(None)
 
-        # slice per shard, compact out the slot gaps. The kept-row count
-        # per shard is KNOWN host-side from the sizing counts (slot_cap >=
-        # the largest bucket, so nothing was dropped): compact under the
-        # known count instead of paying one scalar sync per reduce
-        # partition.
+        # assemble per-reduce batches from the program's replicated block
+        # outputs (lane-major). The compact already happened INSIDE the
+        # dispatch: rows [0, recv_rows[r]) are final, the tail is padding
+        # (zeros, validity False) — no host compact, no per-partition
+        # sync (the counts were host-known from the sizing sync).
         local = n_dev * slot_cap
         row_bytes = _fixed_row_bytes(ref, has_valid)
+        lane_of: List[Tuple[int, Optional[int]]] = []
+        li = 0
+        for i in range(len(dtypes)):
+            d_li, li = li, li + 1
+            v_li = None
+            if has_valid[i]:
+                v_li, li = li, li + 1
+            lane_of.append((d_li, v_li))
         results: List[TpuColumnarBatch] = []
         sizes: List[int] = []
         for r in range(n_dev):
-            sl = slice(r * local, (r + 1) * local)
-            ok = rowok[sl]
             cols = []
             for i, dt in enumerate(dtypes):
-                v = recv_valid[i][sl] if recv_valid[i] is not None else None
-                cols.append(TpuColumnVector(dt, recv_data[i][sl], v, local))
-            batch = TpuColumnarBatch(cols, local, list(names))
-            idx, _n_dev_count = _compact_plan(jnp.asarray(ok),
-                                              batch.rows_arg)
-            results.append(gather(batch, idx, int(recv_rows[r]),
-                                  out_capacity=local))
+                d_li, v_li = lane_of[i]
+                v = outs[v_li * n_dev + r] if v_li is not None else None
+                cols.append(TpuColumnVector(dt, outs[d_li * n_dev + r], v,
+                                            int(recv_rows[r])))
+            results.append(TpuColumnarBatch(cols, int(recv_rows[r]),
+                                            list(names)))
             sizes.append(int(recv_rows[r]) * row_bytes)
         t_compact_end = time.perf_counter_ns()
         profile = mprof.record_exchange(
@@ -420,7 +683,8 @@ def mesh_hash_exchange(mesh: Mesh,
             recv_rows=[int(x) for x in recv_rows], recv_bytes=sizes,
             stage_ns=t_launch0 - t_stage0, launch_ns=t_wait0 - t_launch0,
             wait_ns=t_end - t_wait0, compact_ns=t_compact_end - t_end,
-            watchdog_fired=wd.fired)
+            watchdog_fired=wd.fired, compact_fused=True,
+            staging_reuse_hits=reuse_hits, overlap_segments=overlap_k)
         if profile is not None:
             # the full attribution record as an instant event: the Chrome
             # export derives the per-device tracks + producer→consumer
@@ -432,15 +696,57 @@ def mesh_hash_exchange(mesh: Mesh,
                       skew=dict(profile["skew"]))
     _record_launch(int(send_rows.sum()), t_launch0 - t_stage0,
                    t_wait0 - t_launch0, t_end - t_wait0,
-                   t_compact_end - t_end)
+                   t_compact_end - t_end, staging_reuse_hits=reuse_hits,
+                   overlap_segments=overlap_k)
+    src_rows = [[int(counts_m[s][r]) for s in range(n_dev)]
+                for r in range(n_dev)]
     return MeshExchangeResult(results, [int(x) for x in recv_rows], sizes,
-                              profile)
+                              profile, src_rows, row_bytes)
+
+
+def _launch_overlapped(progs, k_seg: int, mesh: Mesh, n_dev: int,
+                       slot_cap: int, sig: Tuple[Tuple[str, bool], ...],
+                       sharding, dest_g, counts_g, flat,
+                       shuffle_id: int) -> Tuple:
+    """Double-buffered segmented exchange: segment k+1's all_to_all is
+    dispatched BEFORE segment k's fused compact, so the fabric moves the
+    next segment while the compact consumes the current one. Every segment
+    scatters to the same final rows the unsegmented program uses —
+    bit-identical at any K. Chaos `mesh.link` fires per segment
+    (mid-segment soak): a raised fault abandons the donated accumulators
+    mid-flight and the caller re-stages — nothing is applied twice."""
+    from ..chaos import inject
+    from ..execs import opjit
+    prep, a2a, comp, fin, _seg_cap = progs
+    sends = prep(dest_g, *flat)
+    # fresh (never pooled) accumulators: comp donates them each segment
+    accs = []
+    for dt, has_v in sig:
+        accs.append(jax.device_put(
+            jnp.zeros((n_dev * n_dev * slot_cap,), jnp.dtype(dt)),
+            sharding))
+        if has_v:
+            accs.append(jax.device_put(
+                jnp.zeros((n_dev * n_dev * slot_cap,), jnp.bool_),
+                sharding))
+    accs = tuple(accs)
+    seg = a2a(jnp.int32(0), *sends)
+    for k in range(k_seg):
+        # next segment's collective goes on the stream BEFORE this
+        # segment's compact — the overlap window
+        nxt = a2a(jnp.int32(k + 1), *sends) if k + 1 < k_seg else None
+        opjit.record_external_dispatch("mesh_overlap_segment")
+        inject("mesh.link", detail=f"s{shuffle_id}seg{k}")
+        accs = comp(jnp.int32(k), counts_g, *accs, *seg)
+        seg = nxt
+    return fin(*accs)
 
 
 def mesh_single_exchange(mesh: Mesh,
                          group_batches: List[Optional[TpuColumnarBatch]],
                          names: Sequence[str],
-                         shuffle_id: int = -1) -> MeshExchangeResult:
+                         shuffle_id: int = -1,
+                         conf=None) -> MeshExchangeResult:
     """Collective SINGLE-partition funnel: every shard's rows move to shard
     0 in one all_to_all — the fabric path for partial→final aggregation and
     global limit/top-N merges (the reduce-scatter analogue: per-shard
@@ -459,4 +765,5 @@ def mesh_single_exchange(mesh: Mesh,
             else jnp.zeros((b.capacity,), jnp.int32)
             for b in group_batches]
     return mesh_hash_exchange(mesh, group_batches, pids, names,
-                              shuffle_id=shuffle_id, partitioning="single")
+                              shuffle_id=shuffle_id, partitioning="single",
+                              conf=conf)

@@ -210,6 +210,14 @@ def run_mesh_query(name: str, build: Callable, *, n_devices: int,
         "string_collectives": col.get("dict_exchanges", 0),
         "dict_encode_ms": round(col.get("dict_encode_ns", 0) / 1e6, 2),
         "collective_rows": col["rows_sent"],
+        # r07 fused dataplane keys: compact fused into the collective
+        # dispatch on EVERY profiled exchange, staged pad pieces served
+        # from the staging pool, and segments launched by the overlapped
+        # path (0 = the correctness-first unsegmented default)
+        "compact_fused": all(p.get("compact_fused") for p in profiles)
+        if profiles else True,
+        "staging_reuse_hits": col.get("staging_reuse_hits", 0),
+        "overlap_segments": col.get("overlap_segments", 0),
         "collective_stage_ms": round(col["stage_ns"] / 1e6, 2),
         "collective_launch_ms": round(col["launch_ns"] / 1e6, 2),
         "collective_wait_ms": round(col["wait_ns"] / 1e6, 2),
@@ -293,6 +301,12 @@ def summarize(records: List[Dict], n_devices: int,
             "collective_launches": r["collective_launches"],
             "string_collectives": r.get("string_collectives", 0),
             "dict_encode_ms": r.get("dict_encode_ms", 0.0),
+            # r07 fused dataplane keys (ISSUE 16): compact_fused is the
+            # headline invariant (never elided — a False here means a
+            # regression back to host compact); the counters elide at zero
+            "compact_fused": bool(r.get("compact_fused", False)),
+            "staging_reuse_hits": r.get("staging_reuse_hits", 0),
+            "overlap_segments": r.get("overlap_segments", 0),
             "phases_ms": phases,
             "efficiency_attribution": ea,
             "skew": None if sk is None else {
@@ -305,6 +319,9 @@ def summarize(records: List[Dict], n_devices: int,
             # compact-line discipline: zero-valued dictionary keys elide
             del per_query[r["query"]]["string_collectives"]
             del per_query[r["query"]]["dict_encode_ms"]
+        for zk in ("staging_reuse_hits", "overlap_segments"):
+            if not per_query[r["query"]][zk]:
+                del per_query[r["query"]][zk]
         total_launches += r["collective_launches"]
         total_collective_ms += sum(phases.values())
         total_string_collectives += r.get("string_collectives", 0)
@@ -328,6 +345,10 @@ def summarize(records: List[Dict], n_devices: int,
         # would read as a spurious 4–5× regression against r06
         "collective_phases_ms_total": round(total_collective_ms, 2),
         "bit_identical_all": all_identical,
+        # the fused-compact invariant over the whole round: False means
+        # some exchange fell back to a host-side compact (the r06 wall)
+        "compact_fused_all": all(bool(r.get("compact_fused", False))
+                                 for r in records),
         "collective_launches_O_exchanges": all_o_exchanges,
         "watchdog_fired_any": any(r.get("watchdog_fired")
                                   for r in records),

@@ -284,10 +284,21 @@ class _ExchangeBase:
     def map_block_sizes(self, reduce_id: int, ctx: TaskContext) -> List[int]:
         """Per-map byte sizes of one reduce partition — the granularity AQE
         skew splitting slices on (reference PartialReducerPartitionSpec maps).
-        Returns [] when the exchange has no per-map blocks (collective mode
-        materializes one fused block, which cannot be sliced)."""
+        A collective exchange materializes ONE fused block per reduce
+        partition, but its row order is (source shard asc, stable), so the
+        per-SOURCE row counts from the sizing sync are its map statistics:
+        slice m == source shard m, and a contiguous group of sources is a
+        contiguous row range of the block (execute_partition_maps serves it
+        by slicing — no per-map blocks needed). Returns [] only when the
+        exchange truly has nothing to slice on."""
         import os
         self._ensure_materialized(ctx)
+        if getattr(self, "_collective", False):
+            src = getattr(self, "_collective_src_rows", None)
+            if src is None or reduce_id >= len(src):
+                return []
+            rb = int(getattr(self, "_collective_row_bytes", 0))
+            return [int(n) * rb for n in src[reduce_id]]
         if self._shuffle_mode(ctx) == "ICI":
             from .ici import IciShuffleCatalog
             catalog = IciShuffleCatalog.get()
@@ -552,6 +563,8 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                 self._collective_rows = [0] * self._n_out
                 self._collective_sizes = [0] * self._n_out
                 self._collective_seq = None
+                self._collective_src_rows = None
+                self._collective_row_bytes = 0
                 return True
 
             def run_collective():
@@ -586,9 +599,11 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
                         batches = self._encode_dict_payload(batches, ctx)
                     if self.partitioning == "single":
                         return mesh_single_exchange(mesh, batches, names,
-                                                    shuffle_id=sid)
+                                                    shuffle_id=sid,
+                                                    conf=ctx.conf)
                     return mesh_hash_exchange(mesh, batches, pids, names,
-                                              shuffle_id=sid)
+                                              shuffle_id=sid,
+                                              conf=ctx.conf)
 
             result = with_device_retry(run_collective, ctx.conf)
         except _DictionaryOverflow:
@@ -627,6 +642,13 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
         # these without fetching (or unspilling) a single block
         self._collective_rows = list(result.rows[: self._n_out])
         self._collective_sizes = list(result.bytes[: self._n_out])
+        # per-SOURCE row split of each reduce block (the sizing counts'
+        # columns): the fused block's row order is (source asc, stable),
+        # so AQE skew slicing serves a contiguous source range as a
+        # contiguous row slice (map_block_sizes / execute_partition_maps)
+        self._collective_src_rows = None if result.src_rows is None \
+            else [list(sr) for sr in result.src_rows[: self._n_out]]
+        self._collective_row_bytes = int(result.row_bytes)
         # profile seq: the consumer read's flow event references it so the
         # Chrome export ties producer exchange → consumer read
         self._collective_seq = (result.profile or {}).get("seq")
@@ -1035,9 +1057,37 @@ class TpuShuffleExchangeExec(_ExchangeBase, TpuExec):
     def execute_partition_maps(self, idx: int, map_ids: Sequence[int],
                                ctx: TaskContext) -> Iterator:
         """One reduce partition restricted to a subset of map outputs — a
-        skew SLICE (reference PartialReducerPartitionSpec read)."""
+        skew SLICE (reference PartialReducerPartitionSpec read). On the
+        collective path "map" means SOURCE SHARD: the fused block's rows
+        are ordered (source asc, stable) and the per-source row counts are
+        host-known from the sizing sync, so a contiguous source group is
+        served as one device slice of the block — the skewed reduce
+        partition splits without ever having had per-map blocks."""
         self._ensure_materialized(ctx)
         names = [a.name for a in self.output]
+        if getattr(self, "_collective", False) \
+                and getattr(self, "_collective_src_rows", None) is not None:
+            from ..columnar.batch import slice_batch
+            from .ici import IciShuffleCatalog
+            src = self._collective_src_rows[idx]
+            ms = sorted(int(m) for m in map_ids)
+            assert ms == list(range(ms[0], ms[-1] + 1)), \
+                f"collective skew slice must be a contiguous source " \
+                f"range, got {ms}"  # _slices builds groups in source order
+            start = sum(src[s] for s in range(ms[0]))
+            length = sum(src[s] for s in ms)
+            if not length:
+                return
+            catalog = IciShuffleCatalog.get()
+            mgr = TpuShuffleManager.get(ctx.conf)
+            blocks = self._ici_fetch_blocks(
+                idx, ctx, mgr, catalog,
+                metric=self.metrics["deserializationTime"])
+            for b in blocks:  # exactly one fused block per reduce part
+                if b.num_rows:
+                    full = self._decode_dict_block(b).rename(names)
+                    yield slice_batch(full, start, length)
+            return
         if self._shuffle_mode(ctx) == "ICI":
             from ..failure import with_device_retry
             from .ici import IciShuffleCatalog

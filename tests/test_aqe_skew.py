@@ -88,6 +88,49 @@ def test_skew_split_actually_splits(monkeypatch):
     assert slices, planned
 
 
+def test_collective_skew_split(monkeypatch, collective_spy):
+    """ISSUE 16: skew splits on the COLLECTIVE exchange path. The fused
+    compact lays each reduce partition out source-contiguously (scatter to
+    bases[src]+pos), so map_block_sizes surfaces real per-source sizes
+    from the sizing sync and a skewed reduce partition slice-serves — no
+    host re-partitioning, results bit-identical to the CPU oracle."""
+    from spark_rapids_tpu.shuffle import aqe as aqe_mod
+    planned = []
+    orig = aqe_mod.JoinReaderCoordinator._plan
+
+    def recording(self, ctx):
+        specs = orig(self, ctx)
+        planned.append(specs)
+        return specs
+
+    monkeypatch.setattr(aqe_mod.JoinReaderCoordinator, "_plan", recording)
+    runs = collective_spy
+    mesh = {
+        "spark.rapids.shuffle.mode": "ICI",
+        "spark.rapids.tpu.mesh.enabled": "true",
+        "spark.sql.shuffle.partitions": "8",
+        "spark.rapids.tpu.dispatch.partitionBatch": "8",
+        # the split target is the EXCHANGE; compiled stages would skip it
+        "spark.rapids.tpu.agg.compiledStage.enabled": "false",
+        "spark.rapids.tpu.join.compiledStage.enabled": "false",
+    }
+    conf = {**BASE, **mesh,
+            "spark.sql.adaptive.skewJoin.enabled": "true",
+            "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes": "512",
+            "spark.sql.adaptive.skewJoin.skewedPartitionFactor": "1",
+            "spark.sql.adaptive.advisoryPartitionSizeInBytes": "1024"}
+    tpu = TpuSession({"spark.rapids.sql.enabled": "true", **conf})
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false", **BASE})
+    rows, dim = _data(600, skew_frac=0.8), _dim()
+    got = _q(tpu, rows, dim, "inner").collect()
+    want = _q(cpu, rows, dim, "inner").collect()
+    assert got == want
+    assert any(runs), "collective data plane never ran"
+    slices = [s for specs in planned for s in specs if s[0] == "slice"]
+    assert slices, \
+        f"no slice specs on the collective path (planned={planned})"
+
+
 def test_full_outer_never_splits():
     conf = {**BASE, "spark.sql.adaptive.skewJoin.enabled": "true",
             "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes": "1",

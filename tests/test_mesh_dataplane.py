@@ -425,6 +425,154 @@ def test_chaos_mesh_soak(seed):
 
 
 # ---------------------------------------------------------------------------
+# exchange/compute overlap (ISSUE 16): bit-identity, dispatch accounting,
+# and mid-segment chaos under donated buffers
+# ---------------------------------------------------------------------------
+
+def _overlap_conf(**extra):
+    base = _mesh_conf(**{
+        "spark.rapids.tpu.exchange.overlap.enabled": "true",
+        "spark.rapids.tpu.exchange.overlap.segments": "3",
+        # test payloads are tiny; drop the floor so they still segment
+        "spark.rapids.tpu.exchange.overlap.minSlotRows": "1",
+    })
+    base.update(extra)
+    return base
+
+
+def test_overlap_bit_identity_and_dispatch_counts(collective_spy):
+    """Overlap on vs off: bit-identical results (float sum accumulation
+    order included — the segmented scatter lands every row at the same
+    bases[src]+pos slot), ONE mesh_collective dispatch per exchange
+    preserved, and the per-segment launches accounted under their own
+    mesh_overlap_segment kind, agreeing with the mesh module's counter."""
+    from spark_rapids_tpu.execs import opjit
+    from spark_rapids_tpu.parallel import mesh as pmesh
+    fact, dim = _tables(seed=31)
+    runs = collective_spy
+    off = _q3_shaped(TpuSession(_mesh_conf()), fact, dim).collect()
+    s = TpuSession(_overlap_conf())
+    q = _q3_shaped(s, fact, dim)
+    assert q.collect() == off  # warm overlapped run already bit-identical
+    assert any(runs)
+
+    def kinds():
+        by = opjit.cache_stats()["calls_by_kind"]
+        return (by.get("mesh_collective", 0),
+                by.get("mesh_overlap_segment", 0))
+
+    coll0, seg0 = kinds()
+    stats0 = pmesh.collective_stats()
+    assert q.collect() == off
+    coll1, seg1 = kinds()
+    stats1 = pmesh.collective_stats()
+    launches = stats1["launches"] - stats0["launches"]
+    exchanges = sum(1 for nd in s._last_plan_tree
+                    if "ShuffleExchange" in nd["name"])
+    # O(exchanges) holds under overlap: segments are NOT extra collectives
+    assert launches >= 1
+    assert coll1 - coll0 == launches
+    assert launches <= exchanges
+    # every exchange segmented (minSlotRows=1): K segment dispatches each,
+    # reconciled exactly against the registry's overlap_segments counter
+    seg_delta = seg1 - seg0
+    assert seg_delta == 3 * launches
+    assert stats1["overlap_segments"] - stats0["overlap_segments"] \
+        == seg_delta
+
+
+def test_overlap_floor_keeps_unsegmented_path():
+    """With the minSlotRows floor above the slot capacity the conf is on
+    but every exchange stays on the single-program path: zero
+    mesh_overlap_segment dispatches, results unchanged."""
+    from spark_rapids_tpu.execs import opjit
+    fact, dim = _tables(seed=32)
+    off = _q3_shaped(TpuSession(_mesh_conf()), fact, dim).collect()
+
+    def seg():
+        return opjit.cache_stats()["calls_by_kind"].get(
+            "mesh_overlap_segment", 0)
+
+    before = seg()
+    got = _q3_shaped(
+        TpuSession(_overlap_conf(**{
+            "spark.rapids.tpu.exchange.overlap.minSlotRows": "100000000"})),
+        fact, dim).collect()
+    assert got == off
+    assert seg() == before
+
+
+def test_chaos_mid_segment_transient_heals(collective_spy):
+    """A mesh.link transient fired MID-SEGMENT under overlap: the failed
+    exchange retries from the still-open spillables (donated staging
+    buffers are consumed at most once — the abandoned accumulators are
+    never re-fed), heals bit-identical, and the chaos trace shows the
+    per-segment injection site detail."""
+    fact, dim = _tables(seed=33)
+    clean = _q3_shaped(TpuSession(_mesh_conf()), fact, dim).collect()
+    runs = collective_spy
+    s = TpuSession(_overlap_conf(**{
+        "spark.rapids.tpu.deviceRetry.backoffBaseMs": "1",
+        "spark.rapids.tpu.deviceRetry.backoffMaxMs": "4"}))
+    inj = FaultInjector.get()
+    inj.force("mesh.link", "transient", 1)
+    try:
+        got = _q3_shaped(s, fact, dim).collect()
+    finally:
+        inj.clear_forced()
+    assert got == clean
+    assert any(runs)
+    # the fault landed on a segment launch, not the legacy whole-exchange
+    # site: overlap mode tags mesh.link checks with the segment index
+    assert any(r["site"] == "mesh.link" and "seg" in r["detail"]
+               for r in inj.trace())
+
+
+def test_chaos_mesh_soak_overlap():
+    """The ISSUE 16 soak: seeded chaos armed at the mesh sites with the
+    segmented overlap dataplane ON — faults land mid-segment, retries
+    re-stage without double-applying donated buffers, results stay
+    bit-identical, and nothing leaks (device resources, catalog blocks,
+    semaphore permits)."""
+    from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    seed = 333
+    fact, dim = _tables(seed=seed)
+    TpuSemaphore.reset_for_tests()
+    IciShuffleCatalog.reset_for_tests()
+    clean = _q3_shaped(TpuSession(_mesh_conf()), fact, dim).collect()
+    live_before = len(MemoryCleaner.get().live_resources())
+    blocks_before = IciShuffleCatalog.get().block_count()
+    chaos = {
+        "spark.rapids.tpu.test.chaos.enabled": "true",
+        "spark.rapids.tpu.test.chaos.seed": str(seed),
+        "spark.rapids.tpu.test.chaos.sites":
+            "mesh.shard,mesh.link,ici.fetch,device.dispatch",
+        "spark.rapids.tpu.test.chaos.kinds":
+            "io_error,transient,latency",
+        "spark.rapids.tpu.test.chaos.probability": "0.2",
+        "spark.rapids.tpu.test.chaos.latencyMs": "1",
+        "spark.rapids.tpu.deviceRetry.maxAttempts": "8",
+        "spark.rapids.tpu.deviceRetry.backoffBaseMs": "1",
+        "spark.rapids.tpu.deviceRetry.backoffMaxMs": "4",
+        "spark.rapids.tpu.shuffle.fetchRetry.maxAttempts": "8",
+    }
+    s = TpuSession(_overlap_conf(**chaos))
+    injector = FaultInjector.get()
+    assert injector.enabled
+    got = _q3_shaped(s, fact, dim).collect()
+    FaultInjector.reset_for_tests()
+    assert got == clean
+    assert injector.injection_count() > 0
+    assert len(MemoryCleaner.get().live_resources()) == live_before
+    assert IciShuffleCatalog.get().block_count() == blocks_before
+    sem = TpuSemaphore._instance
+    if sem is not None:
+        assert sem._sem._value == sem.permits
+    TpuSemaphore.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
 # observability: mesh.exchange span + exact reconciliation
 # ---------------------------------------------------------------------------
 

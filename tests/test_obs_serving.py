@@ -484,6 +484,58 @@ def test_bench_diff_multichip_payloads():
     assert any(k.endswith(".collective_wait") for k in only_new)
 
 
+def test_bench_diff_fused_dataplane_keys_neutral():
+    """ISSUE 16: the fused-dataplane counters (staging_reuse_hits scales
+    with exchange volume, overlap_segments echoes config) NEVER gate in
+    either direction, while the compact/staging phase walls the fusion
+    targets keep gating lower-is-better against the real r06 round."""
+    import copy
+    from tools.bench_diff import diff, extract_metrics, load_parsed
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r06 = load_parsed(os.path.join(root, "MULTICHIP_r06.json"))
+
+    def r07(reuse, segs):
+        return {
+            "metric": "multichip_sharded_execution",
+            "queries": {"tpch_q3": {
+                "per_chip_rows_per_s": 100.0,
+                "compact_fused": True,
+                "staging_reuse_hits": reuse,
+                "overlap_segments": segs,
+                "phases_ms": {"staging": 5.0, "launch": 2.0,
+                              "collective_wait": 10.0, "compact": 1.0},
+            }},
+            "staging_reuse_hits_total": reuse,
+        }
+
+    # neutral: never extracted as metrics, so a knob change (overlap off
+    # → on) or a longer round (more reuse hits) can't fake a regression
+    m = extract_metrics(r07(100, 4))
+    assert not any("staging_reuse_hits" in k or "overlap_segments" in k
+                   for k in m)
+    assert "queries.tpch_q3.compact_fused" not in m  # bools never walk
+    reg, _i, _u, _oo, _on = diff(r07(1000, 0), r07(0, 4), 0.10)
+    assert not reg
+    # the walls the fusion burns down still gate lower-is-better within
+    # the r07 era...
+    worse = copy.deepcopy(r07(10, 2))
+    worse["queries"]["tpch_q3"]["phases_ms"]["compact"] = 50.0
+    worse["queries"]["tpch_q3"]["phases_ms"]["staging"] = 20.0
+    reg, _i, _u, _oo, _on = diff(r07(10, 2), worse, 0.10)
+    assert {r[0] for r in reg} == {
+        "queries.tpch_q3.phases_ms.compact",
+        "queries.tpch_q3.phases_ms.staging"}
+    # ...and against the real r06 round (older collective_ms schema — the
+    # r07 phases report only-new) the neutral counters never surface
+    om = extract_metrics(r06)
+    assert any(k.endswith(".collective_ms") for k in om)
+    reg, _i, _u, _oo, only_new = diff(r06, r07(10, 2), 0.10)
+    assert any(k.endswith("phases_ms.compact") for k in only_new)
+    assert not any("staging_reuse_hits" in r[0] or "overlap_segments" in r[0]
+                   for r in reg)
+    assert not any("staging_reuse_hits" in k for k in only_new)
+
+
 def test_flight_ring_is_bounded_and_ordered():
     for i in range(2000):
         obs_flight.note("flood", i=i)

@@ -340,6 +340,38 @@ def test_tl012_mesh_profiler_coverage():
     assert lint_obs_module(nm, "obs/mesh_profile.py") == []
 
 
+def test_tl012_fused_dataplane_no_host_compact():
+    """TL012 rule 3 (ISSUE 16): the post-collective compact of
+    parallel/mesh.py is fused into the ONE cached exchange dispatch — a
+    host _compact_plan/gather call re-appearing in that module is the
+    regression the fusion removed and fails static analysis; the same
+    calls elsewhere (columnar code legitimately compacts on host) stay
+    clean."""
+    from spark_rapids_tpu.analysis import lint_obs_module
+    tp = textwrap.dedent("""\
+        from ..columnar.batch import _compact_plan, gather
+        def consume(batch, keep):
+            plan = _compact_plan(keep)
+            return gather(batch, plan)
+        """)
+    findings = lint_obs_module(tp, "parallel/mesh.py")
+    assert len(findings) == 2
+    assert all(f.rule == "TL012" and f.severity == "error"
+               for f in findings)
+    assert all(f.location == "parallel/mesh.py::consume" for f in findings)
+    assert any("host-side compact" in f.message for f in findings)
+    # attribute-qualified calls are the same regression
+    tp2 = textwrap.dedent("""\
+        from ..columnar import batch as cb
+        def consume(b, keep):
+            return cb.gather(b, cb._compact_plan(keep))
+        """)
+    assert len(lint_obs_module(tp2, "parallel/mesh.py")) == 2
+    # outside the fused-dispatch surface the idiom is legitimate
+    assert lint_obs_module(tp, "columnar/x.py") == []
+    assert lint_obs_module(tp, "shuffle/x.py") == []
+
+
 def test_tl012_real_tree_emission_clean():
     """The shipped execs//shuffle//memory/ instrumentation — plus
     obs/mesh_profile.py's own emission sites (ISSUE 13) — routes through

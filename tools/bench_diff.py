@@ -28,7 +28,10 @@ PHASE WALLS from the mesh efficiency profiler (``phases_ms.staging`` /
 ``dict_encode_ms(_total)``) gate LOWER-is-better by default — no
 ``--include-overhead`` needed, because for a data plane whose efficiency
 problem IS unattributed wall, a phase wall growing 10% is exactly the
-regression the profiler exists to catch.
+regression the profiler exists to catch. The r07 fused-dataplane counters
+(``staging_reuse_hits``, ``overlap_segments``) are explicitly NEUTRAL —
+one is a reuse-volume counter, the other a config echo; neither gates in
+either direction.
 
 Keys present in only one round (new stages, skipped stages) are reported
 but never fail the diff; a round whose ``parsed`` payload is null or
@@ -56,6 +59,13 @@ _MULTICHIP_LOWER_RE = re.compile(
     r"(phases_ms\.(staging|launch|collective_wait|compact)"
     r"|collective_ms(_total)?|collective_phases_ms_total"
     r"|dict_encode_ms(_total)?)$")
+#: r07 fused-dataplane keys that must NEVER gate in either direction:
+#: staging_reuse_hits counts staging-pool reuse (it scales with how many
+#: exchanges the round ran, not with data-plane quality) and
+#: overlap_segments echoes the exchange.overlap.* CONFIG — diffing either
+#: across rounds would turn a knob change into a fake regression.
+#: (compact_fused is a bool and bools never walk as metrics.)
+_NEUTRAL_RE = re.compile(r"(staging_reuse_hits|overlap_segments)$")
 
 
 def is_multichip(parsed) -> bool:
@@ -78,6 +88,8 @@ def extract_metrics(parsed, include_overhead=False):
     multichip = is_multichip(parsed)
     out = {}
     for path, v in _walk(parsed):
+        if _NEUTRAL_RE.search(path):
+            continue
         if _HIGHER_RE.search(path):
             out[path] = (v, True)
         elif multichip and _MULTICHIP_LOWER_RE.search(path):
