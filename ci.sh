@@ -27,12 +27,15 @@ python -m compileall -q spark_rapids_tpu tools benchmarks tests bench.py __graft
 echo "== tracelint (trace-safety & registry consistency) =="
 # Static analyzer (docs/analysis.md): eval_tpu implementations vs the
 # plan/typechecks.py host_assisted declarations, registry drift, the
-# unlocked-module-state concurrency lint, and the TL02x resource-lifetime
+# unlocked-module-state concurrency lint, the TL02x resource-lifetime
 # + lock-discipline passes (leak-freedom on all paths, blocking-under-
-# lock, the declared lock order, chaos coverage of unwind paths). Fails
-# on any finding not in tools/tracelint_baseline.txt. The docs-drift gate
-# above doubles as the freshness gate for the analyzer-sourced
-# execution-mode column in docs/supported_ops.md.
+# lock, the declared lock order, chaos coverage of unwind paths), and the
+# TL03x jit-discipline passes (cache-key stability, static-shape
+# bucketing, trace purity, donated-buffer safety over every
+# cached-program surface). Fails on any finding not in
+# tools/tracelint_baseline.txt. The docs-drift gate above doubles as the
+# freshness gate for the analyzer-sourced execution-mode column in
+# docs/supported_ops.md.
 python -m tools.tracelint
 
 echo "== obs self-check (metrics registry + flight recorder + tracer) =="
@@ -43,11 +46,14 @@ echo "== obs self-check (metrics registry + flight recorder + tracer) =="
 # recorder's postmortem bundle assembly.
 python -m tools.obs_report --self-check
 
-echo "== api validation (registry + conf consistency) =="
+echo "== api validation (registry + conf + metrics consistency) =="
 # Structural registry contracts plus the conf-consistency check: every
 # spark.rapids.tpu.*/spark.rapids.shuffle.* key read in the package is
 # declared in config.py and documented in docs/configs.md, and vice
-# versa (no documented-but-dead or declared-but-dead keys).
+# versa (no documented-but-dead or declared-but-dead keys). The metrics
+# mirror rides along: every counter/gauge/histogram registry key emitted
+# in the package appears in docs/observability.md's registry table and
+# vice versa, so dashboards built from the docs never watch a dead name.
 python -m tools.api_validation
 
 echo "== fast tier-1 gate (not slow) =="
@@ -76,6 +82,7 @@ python -m pytest \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   tests/test_mesh_profile.py tests/test_query_lifecycle.py \
   tests/test_string_pipeline.py tests/test_aqe_skew.py \
+  tests/test_env_skips.py tests/test_recompile_stability.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== chaos tier (fixed-seed fault injection) =="

@@ -18,6 +18,10 @@ Public surface for tools/tracelint.py, tools/gen_docs.py and the tests:
 * :func:`lint_locks_tree` — lock discipline: no blocking op under a
   process-wide lock (TL021), global lock graph vs the declared partial
   order (TL022).
+* :func:`lint_jit_tree` — program-cache & dispatch discipline over the
+  cached-program surfaces: cache-key stability (TL030), static-shape
+  bucketing (TL031), trace purity (TL032), donated-buffer safety
+  (TL033).
 * :func:`corroborate` — dynamic ``jax.eval_shape`` probe vs the static
   verdicts (TL005).
 * :func:`scan_source` / :func:`scan_function` — detector layer over raw
@@ -32,6 +36,7 @@ from .astwalk import (CONDITIONAL_HOST, DEVICE, HOST, UNTRACEABLE, Detection,
                       FunctionReport, ModuleIndex, worst)
 from .concurrency import lint_module_source, lint_tree
 from .detectors import DETECTOR_IDS, scan_function, scan_source
+from .jitlint import lint_jit_module, lint_jit_tree
 from .lifecycle import lint_lifecycle_module, lint_lifecycle_tree
 from .locks import LOCK_ORDER, lint_locks_module, lint_locks_tree
 from .obslint import lint_obs_module, lint_obs_tree
@@ -43,7 +48,8 @@ __all__ = [
     "CONDITIONAL_HOST", "DEVICE", "HOST", "LOCK_ORDER", "UNTRACEABLE",
     "Detection", "DETECTOR_IDS", "ExprReport", "Finding", "FunctionReport",
     "ModuleIndex", "analyze_registry", "classify_class", "corroborate",
-    "execution_modes", "lint_lifecycle_module", "lint_lifecycle_tree",
+    "execution_modes", "lint_jit_module", "lint_jit_tree",
+    "lint_lifecycle_module", "lint_lifecycle_tree",
     "lint_locks_module", "lint_locks_tree", "lint_module_source",
     "lint_obs_module", "lint_obs_tree", "lint_sync_module",
     "lint_sync_tree", "lint_tree", "scan_function", "scan_source", "worst",

@@ -1,0 +1,1276 @@
+"""jitlint: program-cache & dispatch-discipline analyzer (TL030–TL033).
+
+The engine's performance contract — ONE cached program per operator
+forest / exchange / row group, O(exchanges) collective launches, donated
+staging — rests on four invariants that until now lived only in reviewers'
+heads and after-the-fact counter assertions.  This pass proves them
+statically over the cached-program surfaces (`execs/`, `kernels/`,
+`parallel/`, `io/`, `shuffle/`):
+
+**TL030 cache-key stability** — every cached-program key must be built
+from hashable, bounded-cardinality, value-stable components.  Flagged
+inside key expressions (a cache-dict ``.get``/``[k] =`` argument, or any
+local conventionally named ``key``/``cache_key``):
+
+* float literals (FP noise aliases or explodes entries);
+* ``id(...)``/``hash(obj)`` (identity is per-process, per-object: a
+  restarted worker or a rebuilt plan never hits);
+* per-query values (names matching query/session/task/request ids,
+  timestamps) — unbounded cardinality, the cache becomes a leak;
+* inline conf reads (``conf.get(...)`` / ``.conf`` chains) — hoist a
+  bounded fingerprint (the ``_conf_fp``/``conf_fp`` idiom) instead, so
+  reviewers can see exactly which conf axes key the program;
+* unhashable displays (list/dict/set literals).
+
+Names carrying a sanctioned fingerprint (``*fp*``, ``*fingerprint*``,
+``*sig*``) are trusted and not resolved further — that is the approved
+way to put derived state into a key.
+
+**TL031 static-shape bucketing** — a value fetched from the device
+(``audited_device_get``/``audited_sync*``/``.item()``/``jax.device_get``)
+is data-dependent; if it reaches an array-allocation shape or a program
+cache key without passing through ``bucket_capacity`` (or another
+``bucket*`` helper), every distinct batch recompiles — the per-shape
+recompile the hit-rate counters only reveal after the fact.  Taint is
+tracked per function and cleared by the bucketing helpers.
+
+**TL032 trace purity** — a function body that gets traced (``jax.jit``
+directly, through ``shard_map``, via a decorator, or as the inner def a
+``build`` closure hands to ``opjit._cached_call``) must be pure w.r.t.
+the host: no wall-clock, no host RNG, no blocking syncs, no mutable
+module-global reads, no conf lookups, and no capture of a live session
+context (``eval_ctx``/``ctx``) — a conf captured at trace time but keyed
+out of the fingerprint is a WRONG-RESULTS bug (first trace wins for every
+later conf), not just a perf bug.  The sanctioned idiom is
+``opjit._trace_ctx(eval_ctx)``: a detached minimal context whose conf
+axes are exactly the ``_conf_fp`` components in the cache key.
+Complements TL011/TL012, which cover runtime emission sites, not trace
+closures.
+
+**TL033 donated-buffer safety** — a buffer passed at a ``donate_argnums``
+position is dead after dispatch.  Donating programs are discovered from
+``jax.jit(..., donate_argnums=...)`` (including positions built with the
+``_donate((...))`` / ``_donate(range(a, b))`` gate) and propagated
+TL020-style through same-module helper returns, program-cache dicts and
+single-binding call parameters.  Flagged:
+
+* a read of a donated name after the dispatch, on any path that does not
+  rebind it first (rebinding at the dispatch itself —
+  ``accs = comp(*accs)`` — is the sanctioned double-buffer pattern);
+* a donated ref that lives in an outliving container (module-level pool
+  or cache, ``self.`` attribute) — the container now holds a dead buffer;
+* a donating dispatch reachable under ``with_device_retry`` whose donated
+  buffers are captured free variables or parameters of the retried
+  callable — after a failed launch their state is undefined, so the
+  retry MUST re-stage from still-open spillables (buffers constructed
+  INSIDE the retried callable, the shuffle/exchange.py discipline).
+
+Donation tracking is deliberately conservative: a program whose donated
+positions cannot be resolved statically is not tracked (opjit's generic
+``_cached_call``/``_dispatch`` plumbing guards donated dispatches
+dynamically and is modeled explicitly instead).
+
+All four report one finding per (rule, function) with line numbers in the
+message, keyed ``relpath::qualname`` — stable under reformatting, same
+baseline machinery as every other tracelint pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from .registry_check import Finding
+
+#: subpackages the lint covers — every cached-program / donation surface
+JIT_SUBPACKAGES: Tuple[str, ...] = ("execs", "kernels", "parallel", "io",
+                                    "shuffle")
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name for Name/Attribute chains ('' otherwise)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        return "." + ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    return _dotted(call.func)
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _walk_no_defs(root: ast.AST):
+    """ast.walk that does not descend into nested function defs/lambdas
+    (their bodies belong to a different scope/analysis)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+#: per-function node-list memo — every pass re-traverses the same defs,
+#: and ast.walk dominates the lint wall time without it (the --only
+#: TL03x loop must stay sub-second); keyed by the node object itself
+#: (keeps it alive — no id-reuse hazard) and cleared per module
+_WALK_CACHE: Dict[ast.AST, List[ast.AST]] = {}
+
+
+def _walk(node: ast.AST) -> List[ast.AST]:
+    nodes = _WALK_CACHE.get(node)
+    if nodes is None:
+        nodes = list(ast.walk(node))
+        _WALK_CACHE[node] = nodes
+    return nodes
+
+
+def _local_defs(fn: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """Directly nested function defs of `fn` (not recursing into them)."""
+    out = {}
+    for st in _walk(fn):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and st is not fn:
+            out.setdefault(st.name, st)
+    return out
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for elt in target.elts:
+            out.extend(_assigned_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    return []
+
+
+def _fn_params(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _mutable_globals(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to mutable displays/constructors (the
+    state TL010 guards with locks; a traced body must never read them)."""
+    out: Set[str] = set()
+    for st in tree.body:
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+            value = st.value
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            targets = [st.target]
+            value = st.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+        if isinstance(value, ast.Call) and _last(_call_name(value)) in (
+                "dict", "list", "set", "OrderedDict", "defaultdict",
+                "deque"):
+            mutable = True
+        if mutable:
+            for t in targets:
+                out.update(_assigned_names(t))
+    return out
+
+
+def _module_cache_dicts(tree: ast.Module) -> Set[str]:
+    """Module-level dict-valued names — program caches, pools, memo maps."""
+    caches: Set[str] = set()
+    for st in tree.body:
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets, value = st.targets, st.value
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            targets, value = [st.target], st.value
+        else:
+            continue
+        is_dict = isinstance(value, ast.Dict) or (
+            isinstance(value, ast.Call)
+            and _last(_call_name(value)) in ("dict", "OrderedDict",
+                                             "defaultdict"))
+        if is_dict:
+            for t in targets:
+                caches.update(_assigned_names(t))
+    return caches
+
+
+# ---------------------------------------------------------------------------
+# TL030 — cache-key stability
+# ---------------------------------------------------------------------------
+
+#: a name that IS a fingerprint/signature: trusted, never resolved deeper
+_SANCTIONED_KEY_NAME = re.compile(r"fp|fingerprint|sig", re.I)
+#: per-query / unbounded-cardinality value names
+_PER_QUERY_NAME = re.compile(
+    r"(?:^|_)(?:query|session|task|request|shuffle)_?id(?:$|_)"
+    r"|timestamp|(?:^|_)now(?:$|_)", re.I)
+#: helper calls whose first positional arg is a program-cache key
+#: (opjit._cached_call and friends)
+_CACHE_CALL = re.compile(r"cached?_call|_cached", re.I)
+_CLOCK_PREFIXES = ("time.", "datetime.")
+_CLOCK_CALLS = {"perf_counter", "perf_counter_ns", "monotonic",
+                "monotonic_ns", "time_ns"}
+
+
+def _key_component_issues(expr: ast.AST, local_assigns: Dict[str, ast.AST],
+                          depth: int = 0) -> List[Tuple[int, str]]:
+    """(line, issue) pairs for one cache-key expression.  Names with a
+    local assignment are resolved one level (fingerprint-named locals are
+    trusted as-is)."""
+    issues: List[Tuple[int, str]] = []
+    seen: Set[int] = set()
+
+    def visit(node: ast.AST, d: int) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            issues.append((line, f"float literal {node.value!r}"))
+        elif isinstance(node, (ast.List, ast.Dict, ast.Set)):
+            issues.append((line, "unhashable "
+                           f"{type(node).__name__.lower()} display"))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            last = _last(name)
+            if last in ("id", "hash") and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                issues.append((line, f"identity hash {last}(...)"))
+            elif name.startswith(_CLOCK_PREFIXES) or last in _CLOCK_CALLS:
+                issues.append((line, f"wall-clock read {name}(...)"))
+            elif name.startswith(("uuid.", "random.", "np.random.",
+                                  "numpy.random.")):
+                issues.append((line, f"per-call random value {name}(...)"))
+            elif last == "get" and isinstance(node.func, ast.Attribute) \
+                    and "conf" in _dotted(node.func.value).lower():
+                issues.append((line, "inline conf read "
+                               f"{_dotted(node.func.value)}.get(...) — "
+                               "hoist a bounded _conf_fp-style fingerprint"))
+            for sub in ast.iter_child_nodes(node):
+                visit(sub, d)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if _PER_QUERY_NAME.search(node.id):
+                issues.append((line, f"per-query value '{node.id}'"))
+            elif not _SANCTIONED_KEY_NAME.search(node.id) and d < 2:
+                resolved = local_assigns.get(node.id)
+                if resolved is not None:
+                    visit(resolved, d + 1)
+        elif isinstance(node, ast.Attribute):
+            if _PER_QUERY_NAME.search(node.attr):
+                issues.append((line, f"per-query value '.{node.attr}'"))
+            # do not resolve through attribute bases
+        else:
+            for sub in ast.iter_child_nodes(node):
+                visit(sub, d)
+
+    visit(expr, depth)
+    return issues
+
+
+_ASSIGN_MAP_CACHE: Dict[ast.AST, Dict[str, ast.AST]] = {}
+
+
+def _function_assign_map(fn: ast.FunctionDef) -> Dict[str, ast.AST]:
+    """name -> last assigned value expr (single-target assigns only)."""
+    cached = _ASSIGN_MAP_CACHE.get(fn)
+    if cached is not None:
+        return cached
+    out: Dict[str, ast.AST] = {}
+    for st in _walk(fn):
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            out[st.targets[0].id] = st.value
+    _ASSIGN_MAP_CACHE[fn] = out
+    return out
+
+
+def _cache_key_exprs(fn: ast.FunctionDef, caches: Set[str]
+                     ) -> List[ast.AST]:
+    """Key expressions this function feeds into a program cache: args of
+    cache-dict get/setdefault/subscript, plus arg0 of cache helpers
+    (opjit._cached_call).  Local dicts / per-query registries (shuffle
+    block maps, sort-key accumulators) are deliberately out of scope —
+    only module-level program caches have the ONE-program contract."""
+    exprs: List[ast.AST] = []
+    assigns = _function_assign_map(fn)
+    for st in _walk(fn):
+        if isinstance(st, ast.Call) and isinstance(st.func, ast.Attribute) \
+                and st.func.attr in ("get", "setdefault", "pop") \
+                and isinstance(st.func.value, ast.Name) \
+                and st.func.value.id in caches and st.args:
+            exprs.append(st.args[0])
+        elif isinstance(st, ast.Subscript) \
+                and isinstance(st.value, ast.Name) \
+                and st.value.id in caches:
+            exprs.append(st.slice)
+        elif isinstance(st, ast.Call) \
+                and _CACHE_CALL.search(_last(_call_name(st))) and st.args:
+            exprs.append(st.args[0])
+    # dedupe: a `key = ...` local used at two cache sites appears once
+    uniq: List[ast.AST] = []
+    seen: Set[int] = set()
+    for e in exprs:
+        resolved = e
+        if isinstance(e, ast.Name) and e.id in assigns:
+            resolved = assigns[e.id]
+        if id(resolved) not in seen:
+            seen.add(id(resolved))
+            uniq.append(resolved)
+    return uniq
+
+
+def _lint_cache_keys(fn: ast.FunctionDef, caches: Set[str], relpath: str
+                     ) -> List[Finding]:
+    assigns = _function_assign_map(fn)
+    issues: List[Tuple[int, str]] = []
+    for expr in _cache_key_exprs(fn, caches):
+        issues.extend(_key_component_issues(expr, assigns))
+    if not issues:
+        return []
+    issues = sorted(set(issues))
+    detail = "; ".join(f"line {ln}: {msg}" for ln, msg in issues)
+    return [Finding(
+        "TL030", "error", f"{relpath}::{fn.name}",
+        f"unstable cached-program key component(s): {detail} — keys must "
+        f"be hashable, bounded-cardinality and value-stable (structural "
+        f"fingerprints + _conf_fp, never identity/floats/per-query "
+        f"values/inline conf reads); see docs/analysis.md cache-key "
+        f"design rules")]
+
+
+# ---------------------------------------------------------------------------
+# TL031 — static-shape bucketing
+# ---------------------------------------------------------------------------
+
+_SYNC_SUFFIXES = ("audited_device_get", "audited_sync", "audited_sync_int",
+                  "device_get")
+_ALLOC_CALLS = {"zeros", "ones", "full", "empty", "arange"}
+_BUCKET_NAME = re.compile(r"bucket")
+
+
+def _is_sync_call(node: ast.Call) -> bool:
+    name = _call_name(node)
+    if _last(name) in _SYNC_SUFFIXES or name == "jax.device_get":
+        return True
+    return isinstance(node.func, ast.Attribute) \
+        and node.func.attr == "item" and not node.args
+
+
+def _contains_sync_call(expr: ast.AST) -> bool:
+    return any(isinstance(node, ast.Call) and _is_sync_call(node)
+               for node in ast.walk(expr))
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _is_bucketed(expr: ast.AST) -> bool:
+    """The whole value passes through a bucketing/slot-cap helper."""
+    e = expr
+    while isinstance(e, ast.Call):
+        if _BUCKET_NAME.search(_last(_call_name(e)) or ""):
+            return True
+        return False
+    return False
+
+
+def _tainted_names(fn: ast.FunctionDef) -> Dict[str, int]:
+    """name -> taint-source line, forward-propagated (two passes so loop
+    carried assignments converge), cleared by bucket* helpers."""
+    # taint can only originate at a sync call; almost no function has one,
+    # so one memoized scan prunes the quadratic statement passes below
+    if not any(isinstance(n, ast.Call) and _is_sync_call(n)
+               for n in _walk(fn)):
+        return {}
+    tainted: Dict[str, int] = {}
+    stmts = [st for st in _walk(fn)
+             if isinstance(st, (ast.Assign, ast.AugAssign, ast.For))]
+    # ast.walk is BFS; re-sort by source position for forward flow
+    stmts.sort(key=lambda s: (s.lineno, s.col_offset))
+    for _ in range(2):
+        for st in stmts:
+            if isinstance(st, ast.For):
+                # `for x in zip(.., tainted, ..)` style unpack
+                if _names_in(st.iter) & tainted.keys() \
+                        or _contains_sync_call(st.iter):
+                    line = st.lineno
+                    for name in _assigned_names(st.target):
+                        tainted.setdefault(name, line)
+                continue
+            value = st.value
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            names = []
+            for t in targets:
+                names.extend(_assigned_names(t))
+            if not names:
+                continue
+            if _is_bucketed(value):
+                for name in names:
+                    tainted.pop(name, None)
+                continue
+            src = None
+            if _contains_sync_call(value):
+                src = value.lineno
+            else:
+                hit = _names_in(value) & tainted.keys()
+                if hit:
+                    src = min(tainted[h] for h in hit)
+            if src is not None:
+                for name in names:
+                    tainted.setdefault(name, src)
+            elif isinstance(st, ast.Assign):
+                # clean reassignment kills earlier taint
+                for name in names:
+                    tainted.pop(name, None)
+    return tainted
+
+
+def _lint_bucketing(fn: ast.FunctionDef, caches: Set[str], relpath: str
+                    ) -> List[Finding]:
+    tainted = _tainted_names(fn)
+    if not tainted:
+        return []
+    issues: List[Tuple[int, str]] = []
+    for node in _walk(fn):
+        # only DEVICE allocations (jnp/jax): a host numpy array with a
+        # data-dependent shape never enters a jitted signature
+        if isinstance(node, ast.Call) \
+                and _last(_call_name(node)) in _ALLOC_CALLS \
+                and _call_name(node).split(".")[0] in ("jnp", "jax"):
+            shape_args = list(node.args[:1]) + [
+                kw.value for kw in node.keywords if kw.arg == "shape"]
+            for a in shape_args:
+                for name in sorted(_names_in(a) & tainted.keys()):
+                    issues.append(
+                        (node.lineno,
+                         f"device-derived '{name}' (synced at line "
+                         f"{tainted[name]}) in allocation shape"))
+    assigns = _function_assign_map(fn)
+    for expr in _cache_key_exprs(fn, caches):
+        for name in sorted(_names_in(expr) & tainted.keys()):
+            if isinstance(assigns.get(name), ast.AST) \
+                    and _is_bucketed(assigns[name]):
+                continue
+            issues.append(
+                (expr.lineno,
+                 f"device-derived '{name}' (synced at line "
+                 f"{tainted[name]}) in a program cache key"))
+    if not issues:
+        return []
+    issues = sorted(set(issues))
+    detail = "; ".join(f"line {ln}: {msg}" for ln, msg in issues)
+    return [Finding(
+        "TL031", "error", f"{relpath}::{fn.name}",
+        f"data-dependent shape enters a jitted signature unbucketed: "
+        f"{detail} — pass device-derived sizes through "
+        f"columnar/vector.py bucket_capacity (or a slot-cap helper) so "
+        f"repeated batches reuse ONE compiled program")]
+
+
+# ---------------------------------------------------------------------------
+# TL032 — trace purity
+# ---------------------------------------------------------------------------
+
+_LIVE_CTX_NAMES = {"eval_ctx", "ctx"}
+_TRACE_CTX_CALL = "_trace_ctx"
+
+
+def _traced_defs(fn: ast.FunctionDef) -> List[ast.FunctionDef]:
+    """Function defs inside `fn` whose bodies get traced: jitted directly
+    (`jax.jit(f)` / decorator), through shard_map, or returned by a build
+    closure handed to opjit._cached_call."""
+    defs = _local_defs(fn)
+    traced: Dict[str, ast.FunctionDef] = {}
+
+    def mark(name: str) -> None:
+        if name in defs:
+            traced.setdefault(name, defs[name])
+
+    for node in _walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _last(_call_name(node))
+        if callee in ("jit", "pjit", "shard_map", "pallas_call"):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    mark(a.id)
+        elif callee == "_cached_call" and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Name):
+            build = defs.get(node.args[1].id)
+            if build is not None:
+                for st in _walk(build):
+                    if isinstance(st, ast.Return) \
+                            and isinstance(st.value, ast.Name):
+                        mark(st.value.id)
+    # decorator form (top-level and nested)
+    for name, d in defs.items():
+        for dec in d.decorator_list:
+            dn = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+            if _last(dn) in ("jit", "pjit") or ".jit" in dn:
+                traced.setdefault(name, d)
+    return list(traced.values())
+
+
+def _enclosing_bindings(fn: ast.FunctionDef, traced: ast.FunctionDef
+                        ) -> Tuple[Set[str], Set[str]]:
+    """(params-of-enclosing-scopes, names bound via _trace_ctx) visible to
+    the traced def as free variables."""
+    params: Set[str] = set(_fn_params(fn))
+    via_trace_ctx: Set[str] = set()
+    for st in _walk(fn):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and st is not fn and st is not traced:
+            params.update(_fn_params(st))
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call) \
+                and _last(_call_name(st.value)) == _TRACE_CTX_CALL:
+            for t in st.targets:
+                via_trace_ctx.update(_assigned_names(t))
+    return params, via_trace_ctx
+
+
+def _lint_trace_purity(fn: ast.FunctionDef, mutable_globals: Set[str],
+                       relpath: str, qual_prefix: str = "") -> List[Finding]:
+    issues: List[Tuple[int, str]] = []
+    for traced in _traced_defs(fn):
+        local_names = set(_fn_params(traced))
+        for st in _walk(traced):
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.For,
+                               ast.withitem)):
+                tgts = []
+                if isinstance(st, ast.Assign):
+                    tgts = st.targets
+                elif isinstance(st, ast.AugAssign):
+                    tgts = [st.target]
+                elif isinstance(st, ast.For):
+                    tgts = [st.target]
+                elif st.optional_vars is not None:
+                    tgts = [st.optional_vars]
+                for t in tgts:
+                    local_names.update(_assigned_names(t))
+            if isinstance(st, (ast.FunctionDef, ast.Lambda)):
+                local_names.update(_fn_params(st))
+                if isinstance(st, ast.FunctionDef):
+                    local_names.add(st.name)
+        enclosing_params, via_tctx = _enclosing_bindings(fn, traced)
+        for node in _walk(traced):
+            line = getattr(node, "lineno", traced.lineno)
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                last = _last(name)
+                if name.startswith(_CLOCK_PREFIXES) \
+                        or last in _CLOCK_CALLS:
+                    issues.append((line, f"wall-clock read {name}(...)"))
+                elif name.startswith(("random.", "np.random.",
+                                      "numpy.random.", "uuid.")):
+                    issues.append((line, f"host RNG {name}(...)"))
+                elif _last(name) in _SYNC_SUFFIXES \
+                        or name == "jax.device_get" \
+                        or name in ("np.asarray", "np.array",
+                                    "numpy.asarray", "numpy.array"):
+                    issues.append(
+                        (line, f"host sync {name}(...) inside the traced "
+                               f"body (forces a trace-time transfer and a "
+                               f"concretization error on device)"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    issues.append((line, "host sync .item() inside the "
+                                   "traced body"))
+                elif last == "get" and isinstance(node.func, ast.Attribute) \
+                        and "conf" in _dotted(node.func.value).lower():
+                    issues.append(
+                        (line, f"conf lookup "
+                               f"{_dotted(node.func.value)}.get(...) "
+                               f"captured at trace time"))
+            elif isinstance(node, ast.Attribute) and node.attr == "conf" \
+                    and isinstance(node.ctx, ast.Load):
+                issues.append((line, f"live conf read "
+                               f"{_dotted(node)} captured at trace time"))
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id not in local_names:
+                if node.id in mutable_globals:
+                    issues.append(
+                        (line, f"mutable module global '{node.id}' read "
+                               f"inside the traced body (value frozen at "
+                               f"trace time, races at runtime)"))
+                elif node.id in _LIVE_CTX_NAMES \
+                        and node.id in enclosing_params \
+                        and node.id not in via_tctx:
+                    issues.append(
+                        (line, f"live session context '{node.id}' captured "
+                               f"at trace time — conf state it carries is "
+                               f"frozen into the FIRST traced program and "
+                               f"silently reused for every other conf; "
+                               f"rebind through opjit._trace_ctx() and put "
+                               f"_conf_fp(eval_ctx) in the cache key"))
+    if not issues:
+        return []
+    issues = sorted(set(issues))
+    detail = "; ".join(f"line {ln}: {msg}" for ln, msg in issues)
+    return [Finding(
+        "TL032", "error", f"{relpath}::{qual_prefix}{fn.name}",
+        f"impure traced closure: {detail}")]
+
+
+# ---------------------------------------------------------------------------
+# TL033 — donated-buffer safety
+# ---------------------------------------------------------------------------
+
+
+class _DonSpec:
+    """Statically-resolved donation positions of a jitted program:
+    `exact` positions plus an optional `floor` (positions >= floor are
+    donated — the `_donate(range(a, b))` form, where only the start is a
+    literal)."""
+
+    __slots__ = ("exact", "floor")
+
+    def __init__(self, exact: Set[int], floor: Optional[int] = None):
+        self.exact = exact
+        self.floor = floor
+
+    def donated_args(self, call: ast.Call) -> List[ast.AST]:
+        out = []
+        pos = 0
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                # a starred arg spans >= pos: donated if any exact
+                # position or the floor can reach it
+                if (self.floor is not None and True) \
+                        or any(p >= pos for p in self.exact):
+                    out.append(a.value)
+                pos += 1  # at least one
+                continue
+            if pos in self.exact or (self.floor is not None
+                                     and pos >= self.floor):
+                out.append(a)
+            pos += 1
+        return out
+
+    def merge(self, other: "_DonSpec") -> "_DonSpec":
+        floor = self.floor if other.floor is None else (
+            other.floor if self.floor is None
+            else min(self.floor, other.floor))
+        return _DonSpec(self.exact | other.exact, floor)
+
+
+#: donation info: a _DonSpec, or a tuple of per-element infos, or None
+_DonInfo = Union[_DonSpec, Tuple, None]
+
+
+def _resolve_donate_expr(expr: ast.AST) -> Optional[_DonSpec]:
+    """Positions from a donate_argnums expression.  Handles int/tuple
+    literals, `_donate(<expr>)` wrappers, `range(a[, b])`, `tuple(...)`
+    and `lit_tuple + tuple(range(a, b))`.  None when unresolvable (an
+    unresolvable donation is NOT tracked — conservative silence beats a
+    false post-read flag)."""
+    if isinstance(expr, ast.Call) and _last(_call_name(expr)) in (
+            "_donate", "tuple"):
+        if not expr.args:
+            return None
+        return _resolve_donate_expr(expr.args[0])
+    if isinstance(expr, ast.IfExp):
+        # `_donate((..)) if grouped else ()`: the donating branch governs
+        for branch in (expr.body, expr.orelse):
+            got = _resolve_donate_expr(branch)
+            if got is not None and (got.exact or got.floor is not None):
+                return got
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return _DonSpec({expr.value})
+    if isinstance(expr, ast.Tuple):
+        exact: Set[int] = set()
+        for elt in expr.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                exact.add(elt.value)
+            else:
+                return None
+        return _DonSpec(exact)
+    if isinstance(expr, ast.Call) and _last(_call_name(expr)) == "range":
+        start = expr.args[0] if len(expr.args) >= 2 else \
+            ast.Constant(value=0)
+        if len(expr.args) == 1:
+            start = ast.Constant(value=0)
+        if isinstance(start, ast.Constant) and isinstance(start.value, int):
+            return _DonSpec(set(), floor=start.value)
+        return None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _resolve_donate_expr(expr.left)
+        right = _resolve_donate_expr(expr.right)
+        if left is not None and right is not None:
+            return left.merge(right)
+        return None
+    return None
+
+
+def _jit_don_spec(call: ast.Call) -> Optional[_DonSpec]:
+    """_DonSpec of a `jax.jit(...)` call, or None if not donating /
+    unresolvable."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _resolve_donate_expr(kw.value)
+    return None
+
+
+class _FnDonSummary:
+    """Per-module-function donation summary (TL020-style helper summary):
+    what the function returns, donation-wise."""
+
+    __slots__ = ("returns",)
+
+    def __init__(self):
+        self.returns: _DonInfo = None
+
+
+def _merge_info(a: _DonInfo, b: _DonInfo) -> _DonInfo:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, _DonSpec) and isinstance(b, _DonSpec):
+        return a.merge(b)
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return tuple(_merge_info(x, y) for x, y in zip(a, b))
+    return a  # shape conflict: keep the first (conservative)
+
+
+def _donation_env(fn: ast.FunctionDef,
+                  summaries: Dict[str, _FnDonSummary],
+                  cache_info: Dict[str, _DonInfo],
+                  param_info: Dict[str, _DonInfo]) -> Dict[str, _DonInfo]:
+    """name -> donation info for locals of `fn` (single forward pass —
+    builder results, cache loads, tuple unpacks, param bindings)."""
+    env: Dict[str, _DonInfo] = dict(param_info)
+
+    def info_of(expr: ast.AST) -> _DonInfo:
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, ast.Call):
+            cn = _call_name(expr)
+            if _last(cn) in ("jit", "pjit"):
+                return _jit_don_spec(expr)
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr == "get" \
+                    and isinstance(expr.func.value, ast.Name):
+                return cache_info.get(expr.func.value.id)
+            summ = summaries.get(_last(cn))
+            if summ is not None:
+                return summ.returns
+            return None
+        if isinstance(expr, ast.Subscript) \
+                and isinstance(expr.value, ast.Name):
+            base = cache_info.get(expr.value.id)
+            if base is None:
+                base = env.get(expr.value.id)
+            if isinstance(base, tuple) \
+                    and isinstance(expr.slice, ast.Constant) \
+                    and isinstance(expr.slice.value, int) \
+                    and 0 <= expr.slice.value < len(base):
+                return base[expr.slice.value]
+            return base if isinstance(base, _DonSpec) else None
+        if isinstance(expr, ast.Tuple):
+            infos = tuple(info_of(e) for e in expr.elts)
+            return infos if any(i is not None for i in infos) else None
+        if isinstance(expr, ast.IfExp):
+            return _merge_info(info_of(expr.body), info_of(expr.orelse))
+        return None
+
+    for st in _walk(fn):
+        if not isinstance(st, ast.Assign):
+            continue
+        info = info_of(st.value)
+        if info is None:
+            continue
+        for t in st.targets:
+            if isinstance(t, ast.Name):
+                env[t.id] = _merge_info(env.get(t.id), info)
+            elif isinstance(t, (ast.Tuple, ast.List)) \
+                    and isinstance(info, tuple) \
+                    and len(t.elts) == len(info):
+                for elt, i in zip(t.elts, info):
+                    if isinstance(elt, ast.Name) and i is not None:
+                        env[elt.id] = _merge_info(env.get(elt.id), i)
+    return env
+
+
+def _module_don_summaries(tree: ast.Module, caches: Set[str]
+                          ) -> Tuple[Dict[str, _FnDonSummary],
+                                     Dict[str, _DonInfo]]:
+    """Fixpoint over return summaries + cache-dict content infos."""
+    fns = {st.name: st for st in tree.body
+           if isinstance(st, ast.FunctionDef)}
+    summaries = {name: _FnDonSummary() for name in fns}
+    cache_info: Dict[str, _DonInfo] = {}
+    for _ in range(3):
+        changed = False
+        for name, fn in fns.items():
+            env = _donation_env(fn, summaries, cache_info, {})
+            ret: _DonInfo = None
+            for st in _walk(fn):
+                if isinstance(st, ast.Return) and st.value is not None:
+                    if isinstance(st.value, ast.Name):
+                        ret = _merge_info(ret, env.get(st.value.id))
+                    elif isinstance(st.value, ast.Tuple):
+                        infos = tuple(
+                            env.get(e.id) if isinstance(e, ast.Name)
+                            else None for e in st.value.elts)
+                        if any(i is not None for i in infos):
+                            ret = _merge_info(ret, infos)
+                    elif isinstance(st.value, ast.Call):
+                        cn = _last(_call_name(st.value))
+                        if cn in ("jit", "pjit"):
+                            ret = _merge_info(ret,
+                                              _jit_don_spec(st.value))
+                        elif cn in summaries:
+                            ret = _merge_info(ret, summaries[cn].returns)
+                # cache stores: CACHE[key] = donating-value
+                if isinstance(st, ast.Assign):
+                    for t in st.targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in caches:
+                            v = st.value
+                            vi: _DonInfo = None
+                            if isinstance(v, ast.Name):
+                                vi = env.get(v.id)
+                            elif isinstance(v, ast.Call) \
+                                    and _last(_call_name(v)) in ("jit",
+                                                                 "pjit"):
+                                vi = _jit_don_spec(v)
+                            if vi is not None:
+                                old = cache_info.get(t.value.id)
+                                new = _merge_info(old, vi)
+                                if repr_info(new) != repr_info(old):
+                                    cache_info[t.value.id] = new
+                                    changed = True
+            old = summaries[name].returns
+            new = _merge_info(old, ret)
+            if repr_info(new) != repr_info(old):
+                summaries[name].returns = new
+                changed = True
+        if not changed:
+            break
+    return summaries, cache_info
+
+
+def repr_info(info: _DonInfo) -> str:
+    if info is None:
+        return "-"
+    if isinstance(info, _DonSpec):
+        return f"D({sorted(info.exact)},{info.floor})"
+    return "(" + ",".join(repr_info(i) for i in info) + ")"
+
+
+def _param_bindings(tree: ast.Module,
+                    summaries: Dict[str, _FnDonSummary],
+                    cache_info: Dict[str, _DonInfo]
+                    ) -> Dict[str, Dict[str, _DonInfo]]:
+    """fn-name -> {param -> info} from intramodule call sites (only kept
+    when every call site agrees)."""
+    fns = {st.name: st for st in tree.body
+           if isinstance(st, ast.FunctionDef)}
+    bound: Dict[str, Dict[str, List[_DonInfo]]] = {
+        n: {} for n in fns}
+    for caller in fns.values():
+        env = _donation_env(caller, summaries, cache_info, {})
+        for node in _walk(caller):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = _last(_call_name(node))
+            callee = fns.get(cn)
+            if callee is None:
+                continue
+            params = _fn_params(callee)
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred) or i >= len(params):
+                    break
+                info = env.get(a.id) if isinstance(a, ast.Name) else None
+                bound[cn].setdefault(params[i], []).append(info)
+    out: Dict[str, Dict[str, _DonInfo]] = {}
+    for name, per_param in bound.items():
+        agreed: Dict[str, _DonInfo] = {}
+        for param, infos in per_param.items():
+            reprs = {repr_info(i) for i in infos}
+            if len(reprs) == 1 and infos[0] is not None:
+                agreed[param] = infos[0]
+        if agreed:
+            out[name] = agreed
+    return out
+
+
+def _stmt_sequence(fn: ast.FunctionDef) -> List[ast.stmt]:
+    """Statements of `fn` in source order (flattened, loop bodies kept as
+    units for the wrap-around scan)."""
+    return list(fn.body)
+
+
+class _DonatedCallSite:
+    __slots__ = ("call", "stmt", "donated_names", "loop")
+
+    def __init__(self, call, stmt, donated_names, loop):
+        self.call = call
+        self.stmt = stmt
+        self.donated_names = donated_names
+        self.loop = loop
+
+
+def _find_donating_calls(fn: ast.FunctionDef, env: Dict[str, _DonInfo]
+                         ) -> List[_DonatedCallSite]:
+    # prune: the block scan below only matters if some call could donate —
+    # a _cached_call dispatch or a callee with donation info
+    for n in _walk(fn):
+        if isinstance(n, ast.Call):
+            fname = _last(_call_name(n))
+            if fname == "_cached_call" or fname in env \
+                    or (isinstance(n.func, ast.Name) and n.func.id in env):
+                break
+    else:
+        return []
+    sites: List[_DonatedCallSite] = []
+
+    def check(root: ast.AST, stmt: ast.stmt,
+              loop: Optional[ast.stmt]) -> None:
+        for node in _walk_no_defs(root):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = None
+            call = node
+            fname = _last(_call_name(node))
+            if fname == "_cached_call":
+                # opjit's dispatch helper: donated positions index the
+                # args TUPLE (3rd positional), not the call's own args
+                dk = [kw.value for kw in node.keywords
+                      if kw.arg == "donate_argnums"]
+                if dk:
+                    spec = _resolve_donate_expr(dk[0])
+                    if spec is not None and len(node.args) >= 3:
+                        call = _args_tuple_as_call(node.args[2], fn)
+                        if call is None:
+                            spec = None
+            else:
+                target = env.get(fname) if fname in env else None
+                if isinstance(node.func, ast.Name):
+                    target = env.get(node.func.id)
+                if isinstance(target, _DonSpec):
+                    spec = target
+            if spec is None:
+                continue
+            names: Set[str] = set()
+            for a in spec.donated_args(call):
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+            if names:
+                sites.append(_DonatedCallSite(node, stmt, names, loop))
+
+    def scan_block(block: Sequence[ast.stmt],
+                   loop: Optional[ast.stmt]) -> None:
+        for st in block:
+            if isinstance(st, (ast.For, ast.While, ast.If, ast.With,
+                               ast.Try)):
+                # check only the statement HEADER here; bodies are scanned
+                # by recursion (so a dispatch inside a loop body is seen
+                # exactly once, with `loop` = its innermost loop and
+                # `stmt` = its own statement, keeping the rebind-kill and
+                # wrap-around scans sound)
+                headers: List[ast.AST] = []
+                if isinstance(st, ast.For):
+                    headers = [st.iter]
+                elif isinstance(st, (ast.While, ast.If)):
+                    headers = [st.test]
+                elif isinstance(st, ast.With):
+                    headers = [i.context_expr for i in st.items]
+                for h in headers:
+                    check(h, st, loop)
+                inner = st if isinstance(st, (ast.For, ast.While)) else loop
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, attr, None)
+                    if sub:
+                        scan_block(sub, inner)
+                for handler in getattr(st, "handlers", None) or ():
+                    scan_block(handler.body, loop)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            else:
+                check(st, st, loop)
+
+    scan_block(fn.body, None)
+    return sites
+
+
+def _args_tuple_as_call(expr: ast.AST, fn: ast.FunctionDef
+                        ) -> Optional[ast.Call]:
+    """Model `tuple(args)` / a tuple display handed to _cached_call as a
+    pseudo-call so _DonSpec.donated_args can index it."""
+    if isinstance(expr, ast.Call) and _last(_call_name(expr)) == "tuple" \
+            and expr.args:
+        expr = expr.args[0]
+    if isinstance(expr, ast.Name):
+        resolved = _function_assign_map(fn).get(expr.id)
+        if resolved is not None:
+            expr = resolved
+    elts = None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        elts = list(expr.elts)
+    if elts is None:
+        return None
+    fake = ast.Call(func=ast.Name(id="<args>", ctx=ast.Load()),
+                    args=elts, keywords=[])
+    return fake
+
+
+def _loads_before_store(stmts: Sequence[ast.stmt], names: Set[str],
+                        issues: List[Tuple[int, str]],
+                        start_after: Optional[ast.stmt] = None) -> Set[str]:
+    """Scan `stmts` in order for Loads of `names`; a Store kills a name.
+    Returns the names still live (not yet stored)."""
+    live = set(names)
+    seen_start = start_after is None
+    for st in stmts:
+        if not seen_start:
+            if st is start_after:
+                seen_start = True
+            continue
+        if not live:
+            break
+        # loads first, in AST order — but the assignment VALUE is
+        # evaluated before its targets bind, so examine value loads, then
+        # kill stored targets
+        stored: Set[str] = set()
+        for node in ast.walk(st):
+            if isinstance(node, ast.Name) and node.id in live:
+                if isinstance(node.ctx, ast.Load):
+                    issues.append(
+                        (node.lineno,
+                         f"donated buffer '{node.id}' read after "
+                         f"dispatch"))
+                    live.discard(node.id)
+                elif isinstance(node.ctx, ast.Store):
+                    stored.add(node.id)
+        live -= stored
+    return live
+
+
+def _lint_donation(fn: ast.FunctionDef,
+                   env: Dict[str, _DonInfo],
+                   module_globals: Set[str],
+                   relpath: str) -> List[Finding]:
+    issues: List[Tuple[int, str]] = []
+    assigns = _function_assign_map(fn)
+    sites = _find_donating_calls(fn, env)
+
+    for site in sites:
+        names = set(site.donated_names)
+        # the sanctioned double-buffer rebind: `accs = comp(*accs)` —
+        # the donated name is dead AND rebound in the same statement
+        if isinstance(site.stmt, ast.Assign):
+            for t in site.stmt.targets:
+                names -= set(_assigned_names(t))
+        # pooled/outliving refs at donated positions
+        for name in sorted(names):
+            src = assigns.get(name)
+            if isinstance(src, ast.Subscript) \
+                    and isinstance(src.value, ast.Name) \
+                    and src.value.id in module_globals:
+                issues.append(
+                    (site.call.lineno,
+                     f"donated buffer '{name}' is a ref into module-level "
+                     f"container '{src.value.id}' — the pool now holds a "
+                     f"dead buffer"))
+        # stores of donated refs into outliving containers anywhere in fn
+        for st in _walk(fn):
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    tgt_container = None
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in module_globals:
+                        tgt_container = t.value.id
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        tgt_container = f"self.{t.attr}"
+                    if tgt_container and isinstance(st.value, ast.Name) \
+                            and st.value.id in names:
+                        issues.append(
+                            (st.lineno,
+                             f"donated buffer '{st.value.id}' stored into "
+                             f"outliving container '{tgt_container}'"))
+        # post-dispatch reads: rest of the enclosing block, with one
+        # wrap-around pass when the dispatch sits in a loop
+        if site.loop is not None:
+            body = site.loop.body
+            live = _loads_before_store(body, names, issues,
+                                       start_after=_enclosing_stmt(
+                                           body, site.stmt))
+            if live:
+                live = _loads_before_store(body, live, issues)
+        container = _containing_block(fn, site.stmt)
+        if container is not None:
+            _loads_before_store(container, names, issues,
+                                start_after=_enclosing_stmt(container,
+                                                            site.stmt))
+
+    # with_device_retry over a donating callable with captured buffers
+    defs = _local_defs(fn)
+    for node in _walk(fn):
+        if not isinstance(node, ast.Call) \
+                or _last(_call_name(node)) != "with_device_retry" \
+                or not node.args:
+            continue
+        target = node.args[0]
+        callee: Optional[ast.FunctionDef] = None
+        if isinstance(target, ast.Name) and target.id in defs:
+            callee = defs[target.id]
+        if callee is None:
+            continue
+        callee_locals: Set[str] = set(_fn_params(callee))
+        for st in _walk(callee):
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    callee_locals.update(_assigned_names(t))
+        inner_env = {k: v for k, v in env.items()}
+        for sub in _find_donating_calls(callee, inner_env):
+            captured = sorted(n for n in sub.donated_names
+                              if n not in callee_locals
+                              or n in _fn_params(callee))
+            if captured:
+                issues.append(
+                    (node.lineno,
+                     f"donating dispatch (line {sub.call.lineno}) under "
+                     f"with_device_retry donates captured buffer(s) "
+                     f"{', '.join(captured)} — after a failed launch "
+                     f"their state is undefined; re-stage fresh buffers "
+                     f"from still-open spillables INSIDE the retried "
+                     f"callable (shuffle/exchange.py run_collective "
+                     f"discipline)"))
+
+    if not issues:
+        return []
+    issues = sorted(set(issues))
+    detail = "; ".join(f"line {ln}: {msg}" for ln, msg in issues)
+    return [Finding(
+        "TL033", "error", f"{relpath}::{fn.name}",
+        f"donated-buffer misuse: {detail} — a buffer at a donate_argnums "
+        f"position is dead after dispatch (docs/analysis.md donated-"
+        f"buffer ownership model)")]
+
+
+def _enclosing_stmt(block: Sequence[ast.stmt], stmt: ast.stmt
+                    ) -> Optional[ast.stmt]:
+    """The element of `block` that contains (or is) `stmt`."""
+    for st in block:
+        if st is stmt:
+            return st
+        for sub in ast.walk(st):
+            if sub is stmt:
+                return st
+    return None
+
+
+def _containing_block(fn: ast.FunctionDef, stmt: ast.stmt
+                      ) -> Optional[Sequence[ast.stmt]]:
+    """The innermost statement list of `fn` containing `stmt`."""
+    result: Optional[Sequence[ast.stmt]] = None
+
+    def visit(block: Sequence[ast.stmt]) -> None:
+        nonlocal result
+        for st in block:
+            if st is stmt:
+                result = block
+                return
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    visit(sub)
+            handlers = getattr(st, "handlers", None)
+            if handlers:
+                for h in handlers:
+                    visit(h.body)
+            items = getattr(st, "items", None)
+            if items is not None:  # ast.With
+                pass
+
+    visit(fn.body)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# module entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_jit_module(source: str, relpath: str) -> List[Finding]:
+    """TL030/TL031/TL032/TL033 findings for one module's source."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return findings
+    _WALK_CACHE.clear()  # per-module memos: previous tree's nodes are dead
+    _ASSIGN_MAP_CACHE.clear()
+    caches = _module_cache_dicts(tree)
+    mutable = _mutable_globals(tree)
+    summaries, cache_info = _module_don_summaries(tree, caches)
+    params = _param_bindings(tree, summaries, cache_info)
+
+    def lint_function(fn: ast.FunctionDef, qual_prefix: str = "") -> None:
+        findings.extend(_lint_cache_keys(fn, caches, relpath))
+        findings.extend(_lint_bucketing(fn, caches, relpath))
+        findings.extend(_lint_trace_purity(fn, mutable, relpath,
+                                           qual_prefix))
+        env = _donation_env(fn, summaries, cache_info,
+                            params.get(fn.name, {}))
+        findings.extend(_lint_donation(fn, env, mutable | caches, relpath))
+
+    for st in tree.body:
+        if isinstance(st, ast.FunctionDef):
+            lint_function(st)
+        elif isinstance(st, ast.ClassDef):
+            for sub in st.body:
+                if isinstance(sub, ast.FunctionDef):
+                    lint_function(sub, qual_prefix=f"{st.name}.")
+    return findings
+
+
+def lint_jit_tree(root: Optional[str] = None,
+                  subpackages: Tuple[str, ...] = JIT_SUBPACKAGES
+                  ) -> List[Finding]:
+    """Lint the shipped tree (root defaults to the spark_rapids_tpu pkg)."""
+    from .astwalk import iter_module_sources
+    findings: List[Finding] = []
+    for relpath, src in iter_module_sources(root, subpackages):
+        findings.extend(lint_jit_module(src, relpath))
+    return findings

@@ -373,13 +373,19 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
                     domains: List["_KeyDomain"], eval_ctx):
     """Build + jit the stage program (cached process-wide). Returns
     fn(rowmask, *flat) -> (oob, rowcount, *carry)."""
+    from .opjit import _conf_fp, _trace_ctx
     domain_sizes = tuple(d.size for d in domains)
     domain_los = tuple(getattr(d, "lo", None) for d in domains)
-    key = spec.cache_key(cap, domain_sizes) + (domain_los,)
+    key = spec.cache_key(cap, domain_sizes) + (domain_los,
+                                               _conf_fp(eval_ctx))
     with _STAGE_FN_LOCK:
         fn = _STAGE_FN_CACHE.get(key)
     if fn is not None:
         return fn
+    # the traced closure must capture the detached trace context, never the
+    # live eval_ctx: conf read through it is frozen into the program, and
+    # the fingerprint above is exactly what keys it (TL032)
+    tctx = _trace_ctx(eval_ctx)
 
     source_attrs = list(spec.source.output)
     needed = spec.needed_source_ordinals
@@ -424,7 +430,7 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
         mask = rowmask
         for layer in layers:
             if layer[0] == "filter":
-                c = to_column(layer[1].eval_tpu(batch, eval_ctx), batch)
+                c = to_column(layer[1].eval_tpu(batch, tctx), batch)
                 m = c.data.astype(jnp.bool_)
                 if c.validity is not None:
                     m = m & c.validity
@@ -439,7 +445,7 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
                         new_cols.append(batch.columns[src.ordinal])
                     else:
                         new_cols.append(to_column(
-                            e.eval_tpu(batch, eval_ctx), batch, a.dtype))
+                            e.eval_tpu(batch, tctx), batch, a.dtype))
                 batch = TpuColumnarBatch(new_cols, cap)
 
         # combined group code + out-of-domain detection
@@ -468,7 +474,7 @@ def _build_stage_fn(spec: _StageSpec, cap: int,
         meas = []
         for fn_ in agg_fns:
             if fn_.children:
-                c = to_column(fn_.children[0].eval_tpu(batch, eval_ctx),
+                c = to_column(fn_.children[0].eval_tpu(batch, tctx),
                               batch, fn_.children[0].dtype)
                 v = c.validity if c.validity is not None else rowmask
                 meas.append((c.data, v & mask))

@@ -540,11 +540,17 @@ def _segment_states(fn, x, v, gcode, G):
 
 def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
                          dim_caps: Tuple[int, ...], dim_dense, eval_ctx):
-    key = spec.cache_key(cap, dim_caps) + (tuple(dim_dense),)
+    from .opjit import _conf_fp, _trace_ctx
+    key = spec.cache_key(cap, dim_caps) + (tuple(dim_dense),
+                                           _conf_fp(eval_ctx))
     with _JOIN_CACHE_LOCK:
         fn = _JOIN_STAGE_FN_CACHE.get(key)
     if fn is not None:
         return fn
+    # the traced closure must capture the detached trace context, never the
+    # live eval_ctx: conf read through it is frozen into the program, and
+    # the fingerprint above is exactly what keys it (TL032)
+    tctx = _trace_ctx(eval_ctx)
 
     source_attrs = list(spec.fact_source.output)
     needed_src = spec.fact_needed_source
@@ -569,7 +575,7 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
         alive = rowmask
         for layer in fact_layers:
             if layer[0] == "filter":
-                c = to_column(layer[1].eval_tpu(batch, eval_ctx), batch)
+                c = to_column(layer[1].eval_tpu(batch, tctx), batch)
                 m = c.data.astype(jnp.bool_)
                 if c.validity is not None:
                     m = m & c.validity
@@ -584,7 +590,7 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
                         new_cols.append(batch.columns[src.ordinal])
                     else:
                         new_cols.append(to_column(
-                            e.eval_tpu(batch, eval_ctx), batch, a.dtype))
+                            e.eval_tpu(batch, tctx), batch, a.dtype))
                 batch = TpuColumnarBatch(new_cols, cap)
         fact_cols = batch.columns  # fact leaf top space
 
@@ -663,7 +669,7 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
         jbatch = TpuColumnarBatch(top_cols, cap)
         for layer in top_layers:
             if layer[0] == "filter":
-                c = to_column(layer[1].eval_tpu(jbatch, eval_ctx), jbatch)
+                c = to_column(layer[1].eval_tpu(jbatch, tctx), jbatch)
                 m = c.data.astype(jnp.bool_)
                 if c.validity is not None:
                     m = m & c.validity
@@ -678,7 +684,7 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
                         new_cols.append(jbatch.columns[src.ordinal])
                     else:
                         new_cols.append(to_column(
-                            e.eval_tpu(jbatch, eval_ctx), jbatch, a.dtype))
+                            e.eval_tpu(jbatch, tctx), jbatch, a.dtype))
                 jbatch = TpuColumnarBatch(new_cols, cap)
 
         # ---- grouped segment aggregation -------------------------------
@@ -691,7 +697,7 @@ def _build_join_stage_fn(spec: _JoinStageSpec, cap: int,
             alive.astype(jnp.int64), gcode, num_segments=G)]
         for fn_ in spec.agg_fns:
             if fn_.children:
-                c = to_column(fn_.children[0].eval_tpu(jbatch, eval_ctx),
+                c = to_column(fn_.children[0].eval_tpu(jbatch, tctx),
                               jbatch, fn_.children[0].dtype)
                 v = c.validity if c.validity is not None else rowmask
                 carry.extend(_segment_states(fn_, c.data, v & alive,

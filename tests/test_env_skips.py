@@ -1,0 +1,88 @@
+"""Meta-test over the environmental skipifs.
+
+Five tier-1 tests fail only because of the environment this image ships
+(no python `zstandard` module; a jax build whose `enable_x64` context
+manager is gone), not because of engine regressions.  They carry precise
+`skipif` marks so the dot count stays pure signal — and THIS module pins
+those marks to the exact environmental facts, so:
+
+* a fixed environment (zstandard installed, jax restoring the scope or the
+  pallas kernel ported) flips the condition to False and the tests run
+  again automatically — nobody has to remember to remove a blanket skip;
+* nobody can widen the skip to paper over a real engine failure: the
+  conditions asserted here are recomputed from the environment, and the
+  reasons must name the module that needs the dependency.
+"""
+
+import importlib.util
+
+import jax
+import pytest
+
+import test_avro_hive
+import test_q1_kernels
+
+
+def _skipif_marks(fn):
+    return [m for m in getattr(fn, "pytestmark", [])
+            if m.name == "skipif"]
+
+
+# ---------------------------------------------------------------------------
+# pallas-on-CPU: 4 tests gated on the jax.enable_x64 scope
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_skips_track_enable_x64_presence():
+    """The 4 pallas interpret-mode tests skip IFF jax lacks enable_x64."""
+    fact = not hasattr(jax, "enable_x64")
+    for fn in (test_q1_kernels.test_pallas_matches_xla,
+               test_q1_kernels.test_pallas_respects_validity_mask):
+        marks = _skipif_marks(fn)
+        assert marks, f"{fn.__name__} lost its environmental skipif"
+        for m in marks:
+            assert bool(m.args[0]) == fact, (
+                f"{fn.__name__} skip condition diverged from the "
+                f"environment: hasattr(jax, 'enable_x64') is {not fact}")
+            assert "jax.enable_x64" in m.kwargs["reason"]
+            assert "q1_pallas" in m.kwargs["reason"], (
+                "skip reason must name the module needing the scope")
+
+
+def test_pallas_fallback_is_not_skipped():
+    """q1_step_best's clean-fallback contract must hold on EVERY backend —
+    that test is engine signal, never an environmental skip."""
+    assert not _skipif_marks(test_q1_kernels.test_best_step_falls_back_cleanly)
+
+
+# ---------------------------------------------------------------------------
+# avro zstandard codec: 1 param gated on the python module
+# ---------------------------------------------------------------------------
+
+
+def test_avro_zstandard_skip_tracks_module_presence():
+    fact = importlib.util.find_spec("zstandard") is None
+    params = [p for m in test_avro_hive.test_avro_roundtrip_codecs.pytestmark
+              if m.name == "parametrize" for p in m.args[1]]
+    zstd = [p for p in params
+            if isinstance(p, type(pytest.param("x"))) and
+            p.values == ("zstandard",)]
+    assert len(zstd) == 1, "zstandard codec param missing from the matrix"
+    marks = [m for m in zstd[0].marks if m.name == "skipif"]
+    assert marks, "zstandard param lost its environmental skipif"
+    for m in marks:
+        assert bool(m.args[0]) == fact, (
+            "zstandard skip condition diverged from the environment: "
+            f"find_spec('zstandard') is None is {fact}")
+        assert "zstandard" in m.kwargs["reason"]
+        assert "io/avro.py" in m.kwargs["reason"], (
+            "skip reason must name the module needing the dependency")
+
+
+def test_other_codecs_not_skipped():
+    """Only the zstandard param is environmental — the five codecs the
+    image supports stay unconditional."""
+    params = [p for m in test_avro_hive.test_avro_roundtrip_codecs.pytestmark
+              if m.name == "parametrize" for p in m.args[1]]
+    plain = [p for p in params if isinstance(p, str)]
+    assert sorted(plain) == ["bzip2", "deflate", "null", "snappy", "xz"]

@@ -1224,15 +1224,241 @@ def test_declared_lock_order_covers_the_tree():
             if "not in the declared lock order" in f.message] == []
 
 
+# ---------------------------------------------------------------------------
+# TL030–TL033: jit-discipline lint (analysis/jitlint.py) — one true
+# positive + one near miss per rule, then the real tree must be clean
+# ---------------------------------------------------------------------------
+
+
+def _jit_findings(src, relpath="execs/fixture.py"):
+    from spark_rapids_tpu.analysis import lint_jit_module
+    return lint_jit_module(textwrap.dedent(src), relpath)
+
+
+def test_tl030_unstable_key_true_positive():
+    findings = _jit_findings("""\
+        _CACHE = {}
+
+        def dispatch(spec, query_id, eval_ctx):
+            key = (id(spec), 0.25, query_id,
+                   eval_ctx.conf.get("spark.sql.ansi.enabled"))
+            return _CACHE.get(key)
+        """)
+    assert [f.rule for f in findings] == ["TL030"]
+    assert findings[0].location == "execs/fixture.py::dispatch"
+    msg = findings[0].message
+    assert "identity hash id(...)" in msg
+    assert "float literal 0.25" in msg
+    assert "per-query value 'query_id'" in msg
+    assert "inline conf read" in msg
+
+
+def test_tl030_fingerprinted_key_and_local_registry_near_misses():
+    """A structural-fingerprint key is the sanctioned shape; function-
+    local dicts (per-query block registries, sort-key memos) are out of
+    scope — only module-level program caches carry the one-program
+    contract."""
+    assert _jit_findings("""\
+        _CACHE = {}
+
+        def dispatch(spec_fp, cap, eval_ctx):
+            key = (spec_fp, cap, _conf_fp(eval_ctx))
+            return _CACHE.get(key)
+
+        def put_block(shuffle_id, map_id, block):
+            blocks = {}
+            blocks[(shuffle_id, map_id)] = block
+            return blocks
+        """) == []
+
+
+def test_tl031_unbucketed_shape_true_positives():
+    findings = _jit_findings("""\
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar.vector import audited_sync_int
+
+        _CACHE = {}
+
+        def emit(counts):
+            n = audited_sync_int(counts.max())
+            return jnp.zeros((n,), dtype=jnp.int32)
+
+        def dispatch(counts):
+            rows = audited_sync_int(counts.sum())
+            key = ("agg", rows)
+            return _CACHE.get(key)
+        """)
+    assert [f.rule for f in findings] == ["TL031", "TL031"]
+    assert "device-derived 'n'" in findings[0].message
+    assert "allocation shape" in findings[0].message
+    assert "device-derived 'rows'" in findings[1].message
+    assert "program cache key" in findings[1].message
+
+
+def test_tl031_bucketed_and_host_numpy_near_misses():
+    """bucket_capacity cleanses the taint (that IS the discipline); a
+    host numpy allocation never enters a jitted signature."""
+    assert _jit_findings("""\
+        import numpy as np
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar.vector import (audited_sync_int,
+                                                      bucket_capacity)
+
+        def emit(counts):
+            cap = bucket_capacity(audited_sync_int(counts.max()))
+            return jnp.zeros((cap,), dtype=jnp.int32)
+
+        def host_collect(counts):
+            n = audited_sync_int(counts.sum())
+            return np.zeros(n, dtype=np.int64)
+        """) == []
+
+
+def test_tl032_impure_traced_closure_true_positive():
+    """The closure a build function returns to _cached_call is a traced
+    body: host state read there is frozen into the program."""
+    findings = _jit_findings("""\
+        import time
+        import numpy as np
+
+        _STATS = {}
+
+        def dispatch(key, batch, eval_ctx, metrics):
+            def build():
+                def prog(data):
+                    t0 = time.perf_counter()
+                    scale = eval_ctx.conf.get("spark.sql.ansi.enabled")
+                    host = np.asarray(data)
+                    stats = _STATS
+                    return data * scale
+                return prog
+            return _cached_call(key, build, (batch,), eval_ctx, metrics)
+        """)
+    assert [f.rule for f in findings] == ["TL032"]
+    msg = findings[0].message
+    assert "wall-clock read time.perf_counter(...)" in msg
+    assert "conf lookup" in msg
+    assert "host sync np.asarray(...)" in msg
+    assert "mutable module global '_STATS'" in msg
+    assert "live session context 'eval_ctx'" in msg
+
+
+def test_tl032_trace_ctx_rebind_near_miss():
+    """The sanctioned shape: the traced body reads the detached
+    _trace_ctx snapshot, whose conf content _conf_fp keys."""
+    assert _jit_findings("""\
+        def dispatch(key, batch, eval_ctx, metrics):
+            tctx = _trace_ctx(eval_ctx)
+            def build():
+                def prog(data):
+                    return data * (2 if tctx.ansi else 1)
+                return prog
+            return _cached_call(key, build, (batch,), eval_ctx, metrics)
+        """) == []
+
+
+def test_tl033_post_dispatch_read_and_outliving_store_true_positives():
+    findings = _jit_findings("""\
+        import jax
+
+        _POOL = {}
+
+        def _kernel(x):
+            return x + 1
+
+        def step(x):
+            prog = jax.jit(_kernel, donate_argnums=(0,))
+            out = prog(x)
+            return out + x
+
+        def stash(buf):
+            prog = jax.jit(_kernel, donate_argnums=(0,))
+            out = prog(buf)
+            _POOL["a"] = buf
+            return out
+        """)
+    assert [f.rule for f in findings] == ["TL033", "TL033"]
+    assert "donated buffer 'x' read after dispatch" in findings[0].message
+    assert "outliving container '_POOL'" in findings[1].message
+
+
+def test_tl033_retry_over_donating_dispatch_true_positive():
+    """A donating dispatch under with_device_retry with a captured
+    pre-staged buffer: after a failed launch its state is undefined."""
+    findings = _jit_findings("""\
+        import jax
+
+        def _kernel(x):
+            return x + 1
+
+        def launch(staged):
+            prog = jax.jit(_kernel, donate_argnums=(0,))
+
+            def attempt():
+                return prog(staged)
+
+            return with_device_retry(attempt)
+        """)
+    assert [f.rule for f in findings] == ["TL033"]
+    assert "with_device_retry" in findings[0].message
+    assert "staged" in findings[0].message
+    assert "re-stage" in findings[0].message
+
+
+def test_tl033_rebind_and_restage_near_misses():
+    """The two sanctioned donation shapes: the same-statement double-
+    buffer rebind (loop wrap-around included), and a retried callable
+    that stages its own fresh buffers inside itself."""
+    assert _jit_findings("""\
+        import jax
+
+        def _kernel(x):
+            return x + 1
+
+        def double_buffer(x):
+            prog = jax.jit(_kernel, donate_argnums=(0,))
+            for _ in range(3):
+                x = prog(x)
+            return x
+
+        def launch(spill):
+            prog = jax.jit(_kernel, donate_argnums=(0,))
+
+            def attempt():
+                staged = spill.to_device()
+                return prog(staged)
+
+            return with_device_retry(attempt)
+        """) == []
+
+
+def test_tl03x_real_tree_is_clean_with_empty_baseline():
+    """The acceptance bar: TL030–TL033 over every cached-program surface
+    (execs/, kernels/, parallel/, io/, shuffle/) surface ZERO findings
+    and the committed baseline contains no TL03x entries — the real
+    findings (the compiled agg/join stage builders capturing the live
+    eval_ctx with conf state keyed out of the fingerprint) were fixed,
+    not suppressed."""
+    from spark_rapids_tpu.analysis import lint_jit_tree
+    baseline = tracelint.load_baseline()
+    assert not any(k.startswith(("TL030", "TL031", "TL032", "TL033"))
+                   for k in baseline)
+    fresh = lint_jit_tree()
+    assert fresh == [], [f.render() for f in fresh]
+
+
 def test_cli_only_filter_and_list_rules(capsys):
     """`--only TL020,...` runs just the selected passes; `--list-rules`
     enumerates the rule families (docs/analysis.md workflow)."""
     assert tracelint.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("TL001", "TL010", "TL011", "TL012", "TL020", "TL021",
-                 "TL022", "TL023"):
+                 "TL022", "TL023", "TL030", "TL031", "TL032", "TL033"):
         assert rule in out
     assert tracelint.main(["--only", "TL020,TL021,TL022,TL023"]) == 0
+    out = capsys.readouterr().out
+    assert "--only" in out and "ok: no non-baselined findings" in out
+    assert tracelint.main(["--only", "TL030,TL031,TL032,TL033"]) == 0
     out = capsys.readouterr().out
     assert "--only" in out and "ok: no non-baselined findings" in out
     assert tracelint.main(["--only", "TL999"]) == 2

@@ -215,6 +215,112 @@ def conf_consistency():
     return violations
 
 
+_METRIC_EMITTERS = ("counter_inc", "gauge_set", "gauge_max",
+                    "histogram_observe")
+
+
+def _emitted_metric_names():
+    """Every registry key emitted in the package, with the file that emits
+    it: literal first args of the obs/metrics.py emission functions, plus
+    both branches of a literal conditional (`"a" if ok else "b"`).  A
+    non-literal key defeats both this check and dashboard grep-ability, so
+    it is reported as a violation rather than silently skipped."""
+    import spark_rapids_tpu as pkg
+    pkg_root = os.path.dirname(pkg.__file__)
+    repo_root = os.path.dirname(pkg_root)
+    names = {}
+    non_literal = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            rel = os.path.relpath(path, repo_root)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                callee = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else "")
+                if callee not in _METRIC_EMITTERS or not node.args:
+                    continue
+                arg0 = node.args[0]
+                literals = []
+                if isinstance(arg0, ast.Constant) \
+                        and isinstance(arg0.value, str):
+                    literals = [arg0.value]
+                elif isinstance(arg0, ast.IfExp) and all(
+                        isinstance(b, ast.Constant)
+                        and isinstance(b.value, str)
+                        for b in (arg0.body, arg0.orelse)):
+                    literals = [arg0.body.value, arg0.orelse.value]
+                else:
+                    non_literal.append(f"{rel}:{node.lineno}")
+                for name in literals:
+                    names.setdefault(name, set()).add(rel)
+    return names, non_literal, repo_root
+
+
+def _documented_metric_names(repo_root):
+    """Names from the docs/observability.md metrics REGISTRY table (the one
+    whose header is `| metric | type | ... |`) — backticked, multi-name
+    rows joined with ' / '.  The doc has other `|`-tables (event names,
+    snapshot keys); only the registry table states the emission contract."""
+    import re
+    path = os.path.join(repo_root, "docs", "observability.md")
+    names = set()
+    in_table = False
+    with open(path) as f:
+        for line in f:
+            if line.startswith("| metric |"):
+                in_table = True
+                continue
+            if in_table:
+                if not line.startswith("|"):
+                    in_table = False
+                    continue
+                if line.startswith("|---"):
+                    continue
+                cell = line.split("|")[1].strip()
+                names.update(re.findall(r"`([^`]+)`", cell))
+    return names, path
+
+
+def metrics_consistency():
+    """Metrics-name consistency (the conf-consistency mirror for the
+    observability registry): every counter/gauge/histogram key the package
+    emits is documented in docs/observability.md's registry table, and
+    every documented key is actually emitted — a dashboard built from the
+    docs must never watch a dead name, and a new emission site must
+    publish its name."""
+    violations = []
+    emitted, non_literal, repo_root = _emitted_metric_names()
+    for loc in non_literal:
+        violations.append(
+            f"metrics: {loc} emits a registry key that is not a string "
+            f"literal (or a literal conditional) — literal names keep the "
+            f"registry grep-able and this check exact")
+    documented, docs_path = _documented_metric_names(repo_root)
+    docs_rel = os.path.relpath(docs_path, repo_root)
+    for name in sorted(set(emitted) - documented):
+        files = ", ".join(sorted(emitted[name]))
+        violations.append(
+            f"metrics: {name!r} (emitted by {files}) is missing from the "
+            f"{docs_rel} registry table")
+    for name in sorted(documented - set(emitted)):
+        violations.append(
+            f"metrics: {docs_rel} documents {name!r} but nothing in the "
+            f"package emits it (documented-but-dead)")
+    return violations
+
+
 def validate():
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -303,6 +409,7 @@ def validate():
                     f"expression {cls.__name__}: type_sig lacks check()")
 
     violations.extend(conf_consistency())
+    violations.extend(metrics_consistency())
     return violations
 
 

@@ -36,6 +36,18 @@ them against the ~20 modules of eval_tpu implementations.  This tool does:
   chaos coverage        TL023 raise-capable external boundary inside a
                         TL020-tracked scope with no registered chaos
                         site — the unwind path cannot be exercised   (error)
+  jit discipline        TL030 unstable cached-program key component
+                        (identity hashes, floats, per-query values,
+                        inline conf reads)                           (error)
+                        TL031 data-dependent shape enters a jitted
+                        signature without bucket_capacity/slot-cap   (error)
+                        TL032 impure traced closure: host sync, RNG,
+                        wall-clock, mutable global or conf/live-ctx
+                        capture inside a traced body                 (error)
+                        TL033 donated-buffer misuse: post-dispatch
+                        read, ref in an outliving container, donating
+                        dispatch under with_device_retry without
+                        re-staging                                   (error)
 
 Findings diff against tools/tracelint_baseline.txt (one key per line, `#`
 comments allowed) so exceptions are explicit.  Exit status is non-zero iff
@@ -118,6 +130,9 @@ RULE_PASSES = (
     (("TL021", "TL022"),
      "lock discipline: no blocking under process-wide locks; lock graph "
      "vs the declared order"),
+    (("TL030", "TL031", "TL032", "TL033"),
+     "jit discipline: cache-key stability, static-shape bucketing, trace "
+     "purity, donated-buffer safety"),
 )
 
 ALL_RULES = tuple(r for rules, _ in RULE_PASSES for r in rules)
@@ -131,7 +146,7 @@ def collect_findings(corroborate=False, only=None):
     """All findings from every (selected) pass, plus the expression
     reports. `only` is a set of rule ids: passes producing none of them
     are skipped entirely."""
-    from spark_rapids_tpu.analysis import (analyze_registry,
+    from spark_rapids_tpu.analysis import (analyze_registry, lint_jit_tree,
                                            lint_lifecycle_tree,
                                            lint_locks_tree, lint_obs_tree,
                                            lint_sync_tree, lint_tree)
@@ -150,6 +165,8 @@ def collect_findings(corroborate=False, only=None):
         findings.extend(lint_lifecycle_tree())
     if _selected(only, ("TL021", "TL022")):
         findings.extend(lint_locks_tree())
+    if _selected(only, ("TL030", "TL031", "TL032", "TL033")):
+        findings.extend(lint_jit_tree())
     probe_results = None
     if corroborate and _selected(only, ("TL005",)):
         from spark_rapids_tpu.analysis import corroborate as _corr
