@@ -926,11 +926,41 @@ def main() -> None:
         emit()
     stage("q3_compiled_16M", _q3_big, budget_guard=True)
 
+    def _serving():
+        # SLO-aware serving (ROADMAP item 1 / docs/serving.md): N tenant
+        # sessions x mixed TPC-H through the scheduler's class/EDF/quota/
+        # shed admission path. Runs LAST: the tenant sessions retune the
+        # process-global scheduler (maxConcurrentQueries, shedAfterMs), so
+        # nothing downstream may depend on the default admission knobs —
+        # and the scheduler is reset afterwards anyway.
+        import sys as _sys
+        root = os.path.dirname(os.path.abspath(__file__))
+        if root not in _sys.path:
+            _sys.path.insert(0, root)
+        import benchmarks.serving as srv
+        out = {}
+        try:
+            for n_sessions in (1, 4, 16):
+                reps = 1 if n_sessions >= 16 else 2
+                r = srv.run(n_sessions, rows=1 << 12, reps=reps)
+                if r.get("errors"):
+                    out["error"] = (f"n{n_sessions} tenant failures: "
+                                    f"{r['errors'][:3]}")
+                out[f"n{n_sessions}"] = r
+                detail["serving"] = out
+                emit()
+        finally:
+            from spark_rapids_tpu.serving.scheduler import QueryScheduler
+            QueryScheduler.reset_for_tests()
+        detail["serving"] = out
+        emit()
+    stage("serving", _serving)
+
     ok_keys = ("kernel_hash_partition", "q6_framework_ms", "q3_compiled",
                "q3_general_4part", "q3_general_8part",
                "q3_general_8part_nojoinagg", "q3_general_8part_nogroup",
                "q3_general_8part_nofuse", "q3_general_8part_nocoalesce",
-               "scan_agg", "multichip", "q3_compiled_16M")
+               "scan_agg", "multichip", "q3_compiled_16M", "serving")
     detail["complete"] = not any(
         isinstance(detail.get(k), dict)
         and ("skipped" in detail[k] or "error" in detail[k])
@@ -957,6 +987,16 @@ def main() -> None:
     _mc = detail.get("multichip", {}) if isinstance(
         detail.get("multichip"), dict) else {}
     _mc_q = (_mc.get("queries") or {}).get("tpch_q3", {})
+    _srv = detail.get("serving", {}) if isinstance(
+        detail.get("serving"), dict) else {}
+
+    def _srv_n(n, key, cls=None):
+        d = _srv.get(f"n{n}", {})
+        if not isinstance(d, dict):
+            return None
+        if cls is not None:
+            d = (d.get("classes") or {}).get(cls, {})
+        return d.get(key)
     summary = {
         "metric": "tpch_q1_framework_throughput",
         "value": headline["value"],
@@ -1030,6 +1070,22 @@ def main() -> None:
             "multichip_bit_identical": _mc.get("bit_identical_all"),
             "multichip_O_exchanges":
                 _mc.get("collective_launches_O_exchanges"),
+            # SLO-aware serving (docs/serving.md): N tenants x mixed TPC-H
+            # through the class/EDF/quota/shed admission path. Aggregate
+            # rows/s per N (higher is better), interactive-class p95 and
+            # p95 admission wait at the contended N (lower is better —
+            # bench_diff gates the serving_* keys), and the N=16 shed
+            # count (how often overload protection actually fired)
+            "serving_n1_rows_per_s": _srv_n(1, "rows_per_s"),
+            "serving_n4_rows_per_s": _srv_n(4, "rows_per_s"),
+            "serving_n16_rows_per_s": _srv_n(16, "rows_per_s"),
+            "serving_n4_interactive_p95_ms":
+                _srv_n(4, "p95_ms", cls="interactive"),
+            "serving_n16_interactive_p95_ms":
+                _srv_n(16, "p95_ms", cls="interactive"),
+            "serving_n16_interactive_admit_wait_p95_ms":
+                _srv_n(16, "admit_wait_p95_ms", cls="interactive"),
+            "serving_n16_shed_total": _srv_n(16, "shed_total"),
             "elapsed_s": detail.get("elapsed_s"),
             "complete": detail["complete"],
             "skipped_or_failed": skipped or None,

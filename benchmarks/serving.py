@@ -1,0 +1,154 @@
+"""SLO-aware serving benchmark: N concurrent sessions x mixed TPC-H
+queries through the QueryScheduler (docs/serving.md; ROADMAP item 1).
+
+Each of the N sessions is one tenant thread with an SLO class assigned
+round-robin (interactive / batch / background), running a mixed TPC-H
+query set (q1 aggregate, q6 filter-sum, q3 join) against its own small
+tables. Everything flows through the real admission path: per-class EDF
+queues, the HBM watermark, per-tenant quotas, and — when the device
+saturates — load shedding of the lowest class (a shed submission comes
+back as a typed QueryShed result and is counted, not retried, so the
+stage wall stays bounded).
+
+Reported per N (bench.py `serving` stage, N in {1, 4, 16}): aggregate
+rows/s over the stage wall, per-class p50/p95 query latency, p95
+admission wait, and the shed count. tools/bench_diff.py gates aggregate
+rows/s (higher is better) and interactive p95 (lower is better) across
+rounds.
+
+Usage: python benchmarks/serving.py [--sessions N] [--rows N] [--reps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+#: tenant class mix: one interactive tenant in three — enough contention
+#: from the lower classes that overload protection is actually exercised
+CLASS_OF = ("interactive", "batch", "background")
+
+
+def _percentile(vals, q: float):
+    if not vals:
+        return None
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def run(n_sessions: int, rows: int = 1 << 13, reps: int = 2,
+        max_concurrent: int = 4, shed_after_ms: float = 500.0,
+        queries=("q1", "q6", "q3")) -> dict:
+    """One serving round: N tenant threads x `reps` passes over the mixed
+    query set. Returns the per-N summary dict (see module docstring)."""
+    import benchmarks.tpch as tpch
+    from spark_rapids_tpu.serving.query_context import QueryShed
+    from spark_rapids_tpu.session import TpuSession
+
+    barrier = threading.Barrier(n_sessions)
+    lock = threading.Lock()
+    per_query = []   # (cls, wall_ms, admit_wait_ms, rows_in)
+    sheds = []       # (cls, retry_after_s)
+    errors = []
+
+    def tenant(i: int) -> None:
+        cls = CLASS_OF[i % len(CLASS_OF)]
+        s = TpuSession({
+            "spark.rapids.sql.enabled": "true",
+            "spark.rapids.shuffle.mode": "ICI",
+            "spark.sql.shuffle.partitions": "4",
+            "spark.rapids.tpu.query.priority": cls,
+            "spark.rapids.tpu.sched.maxConcurrentQueries":
+                str(max_concurrent),
+            "spark.rapids.tpu.sched.shedAfterMs": str(shed_after_ms),
+        })
+        try:
+            tables = tpch.load_tables(s, rows, parts=2)
+            barrier.wait(timeout=120)
+            for _rep in range(reps):
+                for qname in queries:
+                    q = getattr(tpch, qname)(s, tables)
+                    t0 = time.perf_counter()
+                    # interactive tenants submit WITH a (generous)
+                    # deadline so EDF ordering within the class is live;
+                    # it never expires at these row counts
+                    out = q.collect(
+                        timeout=300 if cls == "interactive" else None)
+                    wall_ms = (time.perf_counter() - t0) * 1e3
+                    if isinstance(out, QueryShed):
+                        with lock:
+                            sheds.append((cls, out.retry_after_s))
+                        # honor the hint (bounded) so the tenant backs
+                        # off like a real client, but never resubmit —
+                        # the stage wall must stay bounded
+                        time.sleep(min(out.retry_after_s, 0.25))
+                        continue
+                    with lock:
+                        per_query.append(
+                            (cls, wall_ms, s.last_admit_wait_ms(), rows))
+        except Exception as e:  # noqa: BLE001 — summarized per tenant
+            with lock:
+                errors.append(f"{cls}[{i}]: {type(e).__name__}: {e}")
+        finally:
+            s.stop()
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=tenant, args=(i,),
+                                name=f"serving-tenant-{i}", daemon=True)
+               for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    wall_s = time.perf_counter() - t_start
+
+    classes = {}
+    for cls in CLASS_OF[:max(1, min(n_sessions, len(CLASS_OF)))]:
+        walls = [w for c, w, _a, _r in per_query if c == cls]
+        waits = [a for c, _w, a, _r in per_query
+                 if c == cls and a is not None]
+        n_shed = sum(1 for c, _h in sheds if c == cls)
+        if not walls and not n_shed:
+            continue
+        classes[cls] = {
+            "n": len(walls), "shed": n_shed,
+            "p50_ms": round(_percentile(walls, 0.50), 2) if walls else None,
+            "p95_ms": round(_percentile(walls, 0.95), 2) if walls else None,
+            "admit_wait_p95_ms":
+                round(_percentile(waits, 0.95), 3) if waits else None,
+        }
+    all_waits = [a for _c, _w, a, _r in per_query if a is not None]
+    total_rows = sum(r for _c, _w, _a, r in per_query)
+    return {
+        "sessions": n_sessions, "rows": rows, "reps": reps,
+        "max_concurrent": max_concurrent, "shed_after_ms": shed_after_ms,
+        "wall_s": round(wall_s, 2),
+        "queries_done": len(per_query),
+        "shed_total": len(sheds),
+        "rows_per_s": round(total_rows / wall_s, 1) if wall_s > 0 else None,
+        "admit_wait_p95_ms":
+            round(_percentile(all_waits, 0.95), 3) if all_waits else None,
+        "classes": classes,
+        "errors": errors or None,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sessions", type=int, default=4)
+    ap.add_argument("--rows", type=int, default=1 << 13)
+    ap.add_argument("--reps", type=int, default=2)
+    args = ap.parse_args()
+    print(json.dumps(run(args.sessions, rows=args.rows, reps=args.reps),
+                     indent=2))
+
+
+if __name__ == "__main__":
+    main()

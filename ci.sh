@@ -71,19 +71,35 @@ echo "== fast tier-1 gate (not slow) =="
 # straggler reporting, the collective watchdog, zero profiler syncs)
 # and the device-native string pipeline — BYTE_ARRAY decode oracles,
 # the dictionary-encoded collective exchange round trip + overflow
-# fallback, and the dictionary-coded group-key dispatch assertion)
-# with the slow markers excluded.
+# fallback, and the dictionary-coded group-key dispatch assertion),
+# plus the SLO serving layer (docs/serving.md: class precedence/EDF/
+# aging/quota ordering, typed QueryShed front door, sched.shed chaos,
+# leak-free shed rounds — the N=16 soak is slow-marked and rides the
+# CI_FULL full suite), with the slow markers excluded.
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
   tests/test_shuffle.py tests/test_tracelint.py tests/test_obs.py \
-  tests/test_obs_serving.py \
+  tests/test_obs_serving.py tests/test_serving.py \
   tests/test_parquet_device_decode.py tests/test_resource_lifecycle.py \
   tests/test_mesh_shuffle.py tests/test_mesh_dataplane.py \
   tests/test_mesh_profile.py tests/test_query_lifecycle.py \
   tests/test_string_pipeline.py tests/test_aqe_skew.py \
   tests/test_env_skips.py tests/test_recompile_stability.py \
   -x -q -m 'not slow' -p no:cacheprovider
+
+echo "== serving-stage smoke (N=4, small rows) =="
+# The bench serving stage end-to-end at N=4 tenants with small row
+# counts (docs/serving.md "Proven by"): mixed SLO classes through the
+# real admission path must complete with zero per-tenant errors. The
+# N=16 shed soak runs in the CI_FULL tier (slow marker).
+python - <<'EOF'
+from benchmarks import serving
+r = serving.run(4, rows=1 << 10, reps=1)
+assert not r.get("errors"), r["errors"]
+print("ok: %.0f rows/s aggregate, %d shed" % (
+    r["rows_per_s"], r["shed_total"]))
+EOF
 
 echo "== chaos tier (fixed-seed fault injection) =="
 # Seeded chaos soak (docs/robustness.md): injection armed at every site

@@ -161,6 +161,10 @@ WIRED_CALLS: Dict[str, str] = {
     # proves ARE exercisable
     "submit_and_run": "sched.admit",
     "checkpoint": "query.cancel",
+    # load shedding (docs/serving.md): both shed paths — running victim
+    # and queued victim — fire the sched.shed site before arming the token
+    "_shed_victim": "sched.shed",
+    "_try_shed_queued": "sched.shed",
 }
 
 

@@ -43,7 +43,7 @@ ALL_SITES = (
     "hbm.alloc", "spill.to_host", "spill.to_disk", "device.dispatch",
     "shuffle.serialize", "shuffle.write", "shuffle.read", "ici.fetch",
     "pipeline.task", "scan.read", "mesh.shard", "mesh.link",
-    "sched.admit", "query.cancel",
+    "sched.admit", "query.cancel", "sched.shed",
 )
 
 ALL_KINDS = (
@@ -83,6 +83,12 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     # a slow checkpoint)
     "sched.admit": ("latency", "io_error"),
     "query.cancel": ("cancel", "latency"),
+    # the load-shed decision point (docs/serving.md): fires BEFORE the
+    # victim's cancel token arms — latency delays the shed, io_error
+    # fails the shed attempt itself (the victim survives the pass; a
+    # queue-full submission degrades to typed QueryQueueFull
+    # backpressure, the overload path re-decides next tick)
+    "sched.shed": ("latency", "io_error"),
 }
 
 _BYTE_KINDS = ("corrupt", "truncate")

@@ -1274,6 +1274,42 @@ SCHED_HBM_WATERMARK = _conf(
     "if parked state keeps usage high."
 ).double(0.9)
 
+QUERY_PRIORITY = _conf("spark.rapids.tpu.query.priority").doc(
+    "SLO priority class for this session's queries: 'interactive', "
+    "'batch' or 'background' (docs/serving.md). Admission is strict "
+    "class precedence with earliest-deadline-first within a class; "
+    "under sustained overload the scheduler sheds the LOWEST queued or "
+    "running class first, returning a typed QueryShed result with a "
+    "retry-after hint. df.collect(priority=...) overrides per call."
+).commonly_used().string("interactive")
+
+SCHED_CLASS_AGING_MS = _conf("spark.rapids.tpu.sched.classAgingMs").doc(
+    "Anti-starvation bound for the SLO class queues: a ticket queued "
+    "longer than this is promoted over class precedence (oldest such "
+    "ticket first), so background work still drains under a persistent "
+    "interactive load. 0 disables aging (strict precedence only)."
+).double(10000.0)
+
+SCHED_TENANT_HBM_QUOTA = _conf(
+    "spark.rapids.tpu.sched.tenantHbmQuota").doc(
+    "Per-tenant HBM quota as a fraction of the HbmBudget, layered ON TOP "
+    "of the global admission watermark: a session whose live queries' "
+    "attributed device bytes exceed quota x budget has its next query "
+    "queue (sched.quota_defer_total) even when the device has headroom. "
+    "<= 0 disables per-tenant quotas (the default)."
+).double(0.0)
+
+SCHED_SHED_AFTER_MS = _conf("spark.rapids.tpu.sched.shedAfterMs").doc(
+    "Sustained-overload load-shedding bound: when a queued query has "
+    "waited past this with every concurrency slot held and a STRICTLY "
+    "lower class running, the scheduler sheds the lowest running class "
+    "through the cooperative cancel token (one victim per admission "
+    "pass; the unwind is the TL020-proven release path). The shed "
+    "client gets a typed QueryShed result with a retry-after hint. "
+    "0 disables overload shedding; queue-full shedding of a strictly "
+    "lower queued class is always on."
+).double(5000.0)
+
 SHUFFLE_CHECKSUM_ENABLED = _conf(
     "spark.rapids.tpu.shuffle.checksum.enabled").doc(
     "Embed an xxhash64 checksum in every serialized shuffle block and "

@@ -129,41 +129,57 @@ class TpuCoalesceBatchesExec(TpuExec):
                 sp.close()
             return out
 
-        for b in self.children[0].execute_partition(idx, ctx):
-            n_in.add(1)
-            pending.append(SpillableColumnarBatch(b))
-            # a deferred row count (compact(deferred=True) upstream) must NOT
-            # be forced here — one sync per input batch is exactly the round
-            # trip this layer exists to amortize. Count the padded capacity
-            # as an upper bound instead.
-            rl = b.rows_lazy
-            if isinstance(rl, (int, np.integer)):
-                rows += int(rl)
-            else:
-                rows += b.capacity
-                estimated = True
-            size += pending[-1].size_bytes
-            if self.goal == "require_single":
-                continue
-            # whichever target trips first closes the batch (reference
-            # GpuCoalesceIterator honors both GPU_BATCH_SIZE_BYTES and the
-            # row cap). Padded bytes are real HBM occupancy, so the byte
-            # target closes on the estimate; the row target needs exact
-            # counts — a capacity-counted window of heavily-filtered batches
-            # may hold far fewer rows than its buckets suggest, and closing
-            # early would defeat the merge. Materializing is ONE batched
-            # transfer for the whole window, not one sync per batch.
-            size_tripped = bool(target_bytes) and size >= target_bytes
-            if not size_tripped and estimated and rows >= target:
-                rows = materialize_spillable_counts(pending)
-                estimated = False
-            if size_tripped or rows >= target:
+        try:
+            for b in self.children[0].execute_partition(idx, ctx):
+                n_in.add(1)
+                pending.append(SpillableColumnarBatch(b))
+                # a deferred row count (compact(deferred=True) upstream) must
+                # NOT be forced here — one sync per input batch is exactly the
+                # round trip this layer exists to amortize. Count the padded
+                # capacity as an upper bound instead.
+                rl = b.rows_lazy
+                if isinstance(rl, (int, np.integer)):
+                    rows += int(rl)
+                else:
+                    rows += b.capacity
+                    estimated = True
+                size += pending[-1].size_bytes
+                if self.goal == "require_single":
+                    continue
+                # whichever target trips first closes the batch (reference
+                # GpuCoalesceIterator honors both GPU_BATCH_SIZE_BYTES and the
+                # row cap). Padded bytes are real HBM occupancy, so the byte
+                # target closes on the estimate; the row target needs exact
+                # counts — a capacity-counted window of heavily-filtered
+                # batches may hold far fewer rows than its buckets suggest,
+                # and closing early would defeat the merge. Materializing is
+                # ONE batched transfer for the whole window, not one sync per
+                # batch.
+                size_tripped = bool(target_bytes) and size >= target_bytes
+                if not size_tripped and estimated and rows >= target:
+                    rows = materialize_spillable_counts(pending)
+                    estimated = False
+                if size_tripped or rows >= target:
+                    with concat_time.timed():
+                        out = concat_spillables(pending)
+                    # rebind BEFORE the yield: concat_spillables closed every
+                    # staged input, and the unwind finally below must only
+                    # ever see still-open ones
+                    pending, rows, size, estimated = [], 0, 0, False
+                    yield out
+            if pending:
                 with concat_time.timed():
-                    yield concat_spillables(pending)
-                pending, rows, size, estimated = [], 0, 0, False
-        if pending:
-            with concat_time.timed():
-                yield concat_spillables(pending)
+                    out = concat_spillables(pending)
+                pending = []
+                yield out
+        finally:
+            # a cancel/shed/deadline trip (or any error) raised from the
+            # child's next pull lands exactly while this window is staged —
+            # the spillables registered above must not outlive the unwind
+            # (close discipline; the serving shed soak caught this as a
+            # per-shed SpillableColumnarBatch leak)
+            for sp in pending:
+                sp.close()
 
 
 # ---------------------------------------------------------------------------

@@ -131,7 +131,14 @@ class HbmBudget:
             self.used += nbytes
             self.peak_used = max(self.peak_used, self.used)
             _metrics.gauge_max("hbm.high_water_bytes", self.peak_used)
+        # per-tenant attribution (docs/serving.md): one thread-local read
+        # + a GIL add on the bound QueryContext — outside the alloc lock
+        # (no lock is taken; plain counter discipline)
+        from ..serving.query_context import charge_hbm
+        charge_hbm(nbytes)
 
     def free(self, nbytes: int) -> None:
         with self._alloc_lock:
             self.used = max(0, self.used - nbytes)
+        from ..serving.query_context import release_hbm
+        release_hbm(nbytes)

@@ -219,22 +219,40 @@ def histogram_observe(name: str, value, **labels) -> None:
 # tracer's exclusivity check reads all come from this one place.
 
 _QL_LOCK = threading.Lock()
-_ACTIVE_QUERIES: Dict[int, Tuple[str, int]] = {}  # token -> (name, t0_ns)
+# token -> (name, t0_ns, priority class or None)
+_ACTIVE_QUERIES: Dict[int, Tuple[str, int, Optional[str]]] = {}
 _EPOCH = 0
 _NEXT_TOKEN = 1
 
 
-def query_begin(name: str, session: str = "default") -> int:
-    """Register a query start; returns the token for :func:`query_end`."""
+def _set_active_gauges_locked() -> None:
+    """queries.active total plus one labelled cell per SLO class with an
+    active query (docs/serving.md): a dashboard watching
+    queries.active{cls=interactive} sees exactly the class the shed
+    policy protects. Committed under the lifecycle lock — an interleaved
+    begin/end pair must not overwrite a gauge with a stale count."""
+    gauge_set("queries.active", len(_ACTIVE_QUERIES))
+    by_cls: Dict[str, int] = {}
+    for _name, _t0, cls in _ACTIVE_QUERIES.values():
+        if cls is not None:
+            by_cls[cls] = by_cls.get(cls, 0) + 1
+    from ..serving.query_context import PRIORITIES
+    for cls in PRIORITIES:
+        gauge_set("queries.active", by_cls.get(cls, 0), cls=cls)
+
+
+def query_begin(name: str, session: str = "default",
+                cls: Optional[str] = None) -> int:
+    """Register a query start; returns the token for :func:`query_end`.
+    `cls` is the SLO priority class (None for lifecycle paths that
+    predate classes — counted in the total, not any per-class cell)."""
     global _EPOCH, _NEXT_TOKEN
     with _QL_LOCK:
         _EPOCH += 1
         token = _NEXT_TOKEN
         _NEXT_TOKEN += 1
-        _ACTIVE_QUERIES[token] = (name, time.perf_counter_ns())
-        # gauge committed under the lifecycle lock: an interleaved
-        # begin/end pair must not overwrite the gauge with a stale count
-        gauge_set("queries.active", len(_ACTIVE_QUERIES))
+        _ACTIVE_QUERIES[token] = (name, time.perf_counter_ns(), cls)
+        _set_active_gauges_locked()
     from . import flight as _flight
     _flight.note("query.begin", query=name, session=session)
     return token
@@ -246,10 +264,10 @@ def query_end(token: int, rows: Optional[int] = None,
     Idempotent on an unknown token."""
     with _QL_LOCK:
         entry = _ACTIVE_QUERIES.pop(token, None)
-        gauge_set("queries.active", len(_ACTIVE_QUERIES))
+        _set_active_gauges_locked()
     if entry is None:
         return
-    name, t0 = entry
+    name, t0, _cls = entry
     latency_ms = (time.perf_counter_ns() - t0) / 1e6
     counter_inc("queries.failed" if failed else "queries.completed",
                 session=session)
@@ -264,7 +282,7 @@ def query_end(token: int, rows: Optional[int] = None,
 
 def active_queries() -> List[str]:
     with _QL_LOCK:
-        return [name for name, _t0 in _ACTIVE_QUERIES.values()]
+        return [name for name, _t0, _cls in _ACTIVE_QUERIES.values()]
 
 
 def active_query_count() -> int:

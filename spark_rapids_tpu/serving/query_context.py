@@ -18,6 +18,18 @@ State machine (docs/robustness.md "Query lifecycle")::
       │                 └─cancel/deadline──► CANCELLING ─unwound─► CANCELLED
       └─cancel/deadline/queue-reject while queued ────────────────► CANCELLED
                                                     (deadline → TIMED_OUT)
+                                                    (shed     → SHED)
+
+SLO classes (docs/serving.md): every submission carries a *priority class*
+— ``interactive`` > ``batch`` > ``background`` — and an optional deadline.
+The scheduler admits earliest-deadline-first within a class with strict
+precedence across classes (plus an anti-starvation aging bound), and under
+sustained overload **sheds** the lowest class through the same cooperative
+cancel token: :meth:`QueryContext.shed` arms the token with a retry-after
+hint, the next checkpoint raises :class:`QueryShedError` (a
+``QueryCancelledError``, so the TL020-proven unwind paths run unchanged),
+and the front door converts it into a typed :class:`QueryShed` RESULT —
+load shedding is an answer ("come back in ~N seconds"), not an error.
 
 Thread routing follows the sync-ledger/tracer idiom: :func:`bind` attaches a
 context to the calling thread; pool handoffs (exchange map tasks, prefetch
@@ -51,8 +63,24 @@ FINISHED = "FINISHED"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
 TIMED_OUT = "TIMED_OUT"
+SHED = "SHED"
 
-_TERMINAL = (FINISHED, FAILED, CANCELLED, TIMED_OUT)
+_TERMINAL = (FINISHED, FAILED, CANCELLED, TIMED_OUT, SHED)
+
+#: SLO priority classes, best first (docs/serving.md): strict precedence
+#: across classes at admission, EDF within a class, and under sustained
+#: overload the WORST class is shed first. Rank = index (lower is better).
+PRIORITIES = ("interactive", "batch", "background")
+PRIORITY_RANK = {cls: i for i, cls in enumerate(PRIORITIES)}
+
+
+def validate_priority(priority: str) -> str:
+    p = str(priority).lower()
+    if p not in PRIORITY_RANK:
+        raise ValueError(
+            f"unknown priority class {priority!r} "
+            f"(expected one of {', '.join(PRIORITIES)})")
+    return p
 
 
 class QueryCancelledError(BaseException):
@@ -63,6 +91,39 @@ class QueryCancelledError(BaseException):
 class QueryDeadlineExceeded(QueryCancelledError):
     """The query ran past its deadline (spark.rapids.tpu.query.timeoutMs
     or df.collect(timeout=...)) and was cancelled at a checkpoint."""
+
+
+class QueryShedError(QueryCancelledError):
+    """The scheduler shed this query to protect higher classes under
+    sustained overload (docs/serving.md "Load shedding"). Unwinds through
+    the same cancel paths as any cancellation; the executor front door
+    converts it into a :class:`QueryShed` RESULT carrying the retry-after
+    hint — client code never sees this exception from collect()."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class QueryShed:
+    """Typed load-shed RESULT (not an error): the query was unwound
+    leak-free before completion; resubmit after ``retry_after_s``.
+    Returned by df.collect()/to_arrow() in place of the row payload."""
+
+    __slots__ = ("query", "session", "priority", "reason", "retry_after_s")
+
+    def __init__(self, query: str, session: str, priority: str,
+                 reason: str, retry_after_s: float):
+        self.query = query
+        self.session = session
+        self.priority = priority
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+    def __repr__(self) -> str:
+        return (f"QueryShed(query={self.query!r}, session={self.session!r},"
+                f" priority={self.priority!r}, reason={self.reason!r},"
+                f" retry_after_s={self.retry_after_s:.3f})")
 
 
 class QueryQueueFull(Exception):
@@ -79,13 +140,26 @@ class QueryContext:
 
     def __init__(self, name: str, session_id: str = "default",
                  deadline_ns: Optional[int] = None,
-                 retry_budget: int = 64):
+                 retry_budget: int = 64,
+                 priority: str = "interactive"):
         self.name = name
         self.session_id = session_id
         #: absolute time.perf_counter_ns() deadline, or None
         self.deadline_ns = deadline_ns
+        #: SLO class (PRIORITIES); drives admission order and shed order
+        self.priority = validate_priority(priority)
         self.state = QUEUED
         self.cancel_reason: Optional[str] = None
+        #: retry-after hint set by QueryScheduler when this query is shed
+        self.shed_retry_after_s: Optional[float] = None
+        #: measured admission wait (ms), written at grant time — the
+        #: bench serving stage reads it back per query
+        self.admit_wait_ms: Optional[float] = None
+        #: net HBM bytes charged by this query's bound threads (lock-free
+        #: GIL adds, the metrics-cell idiom: a rare lost update is the
+        #: standard monitoring tradeoff). The scheduler sums a tenant's
+        #: live contexts against its quota at admission time.
+        self.hbm_bytes = 0
         self._cancel = threading.Event()
         self._mu = threading.Lock()
         self._retry_budget = int(retry_budget)
@@ -106,6 +180,19 @@ class QueryContext:
         from ..obs import flight as _flight
         _flight.note("query.cancelling", query=self.name,
                      session=self.session_id, reason=reason)
+
+    def shed(self, retry_after_s: float = 1.0,
+             reason: str = "shed") -> None:
+        """Arm the cancel token for LOAD SHEDDING: same cooperative
+        machinery as cancel() (idempotent, observed at the next
+        checkpoint, unwinds through the TL020-proven release paths) but
+        the check raises QueryShedError so the front door can answer with
+        a typed QueryShed result instead of an error."""
+        with self._mu:
+            if self._cancel.is_set() or self.state in _TERMINAL:
+                return
+            self.shed_retry_after_s = float(retry_after_s)
+        self.cancel(reason=reason)
 
     @property
     def cancelled(self) -> bool:
@@ -130,6 +217,12 @@ class QueryContext:
                 raise QueryDeadlineExceeded(
                     f"query {self.name} exceeded its deadline "
                     f"(observed at {boundary or 'checkpoint'})")
+            if self.shed_retry_after_s is not None:
+                raise QueryShedError(
+                    f"query {self.name} ({self.priority}) shed by the "
+                    f"scheduler ({self.cancel_reason}) at "
+                    f"{boundary or 'checkpoint'}",
+                    retry_after_s=self.shed_retry_after_s)
             raise QueryCancelledError(
                 f"query {self.name} cancelled "
                 f"({self.cancel_reason or 'unknown'}) "
@@ -167,6 +260,8 @@ class QueryContext:
                 self.state = FINISHED
             elif isinstance(exc, QueryDeadlineExceeded):
                 self.state = TIMED_OUT
+            elif isinstance(exc, QueryShedError):
+                self.state = SHED
             elif isinstance(exc, QueryCancelledError):
                 self.state = CANCELLED
             else:
@@ -234,3 +329,24 @@ def consume_retry_budget() -> bool:
     per-site attempt bound still applies) or budget remains."""
     q = getattr(_TL, "q", None)
     return True if q is None else q.consume_retry()
+
+
+def charge_hbm(nbytes: int) -> None:
+    """HbmBudget.allocate's attribution hook: charge device bytes to the
+    query bound on the allocating thread (no-op unbound — pool warm-up,
+    session caches). Per-tenant quota admission sums the tenant's live
+    contexts' net charges (docs/serving.md "Per-tenant HBM quotas")."""
+    q = getattr(_TL, "q", None)
+    if q is not None:
+        q.hbm_bytes += nbytes
+
+
+def release_hbm(nbytes: int) -> None:
+    """HbmBudget.free's hook: un-charge bytes freed on a bound thread.
+    Frees on UNBOUND threads (MemoryCleaner, session teardown) are not
+    attributable; the residue disappears when the context closes — quota
+    accounting is admission-time and per-live-query by design, so the
+    skew is bounded by one query's lifetime."""
+    q = getattr(_TL, "q", None)
+    if q is not None:
+        q.hbm_bytes = max(0, q.hbm_bytes - nbytes)

@@ -7,16 +7,31 @@ service" shape — many session frontends submitting to one device-owning
 scheduler. This module is that service for the TPU engine:
 
 * :class:`QueryScheduler` — process-wide admission control. A submitted
-  query enters a bounded FIFO queue (per session, drained round-robin so
-  one chatty session cannot starve its neighbors); past the bound the
-  submission fails FAST with the typed :class:`QueryQueueFull`
-  backpressure error instead of piling more working sets onto an
-  already-saturated device (the OOM-everyone failure mode). A queued query
-  is admitted only when a concurrency slot is free
+  query enters a bounded FIFO queue (per session, within its SLO class);
+  past the bound the submission fails FAST with the typed
+  :class:`QueryQueueFull` backpressure error instead of piling more
+  working sets onto an already-saturated device (the OOM-everyone failure
+  mode) — unless a strictly lower class is queued, in which case the
+  LOWEST class is shed to make room (docs/serving.md). A queued query is
+  admitted only when a concurrency slot is free
   (``spark.rapids.tpu.sched.maxConcurrentQueries``) AND HBM usage is under
   the admission watermark (``spark.rapids.tpu.sched.hbmAdmissionWatermark``
   × budget — waived when nothing is running, so admission always makes
-  progress). Execution is caller-runs: the submitting thread executes its
+  progress) AND the submitting tenant is under its per-tenant HBM quota
+  (``spark.rapids.tpu.sched.tenantHbmQuota`` × budget: an over-quota
+  tenant queues even when the device has headroom). Admission order is
+  SLO-aware: strict class precedence (``interactive`` > ``batch`` >
+  ``background``), earliest-deadline-first within a class across session
+  queue heads, round-robin across a class's sessions on deadline ties,
+  and an anti-starvation aging bound
+  (``spark.rapids.tpu.sched.classAgingMs``) that promotes any ticket
+  queued past the bound so ``background`` still drains under pressure.
+  Sustained overload (a higher-class ticket waiting past
+  ``spark.rapids.tpu.sched.shedAfterMs`` with every slot held and a
+  lower-class query running) sheds the LOWEST running class through the
+  cooperative cancel token — the unwind is the TL020-proven release path,
+  and the client gets a typed ``QueryShed`` result with a retry-after
+  hint. Execution is caller-runs: the submitting thread executes its
   own query once admitted, so tracer/ledger/lifecycle thread bindings all
   stay on the thread that owns them.
 * :func:`execute_plan` — the executor half of the old ``TpuSession._execute``
@@ -44,8 +59,9 @@ from typing import Any, Dict, List, Optional
 from ..execs.base import TaskContext
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
-from .query_context import (QueryCancelledError, QueryContext,
-                            QueryDeadlineExceeded, QueryQueueFull, bind,
+from .query_context import (PRIORITIES, PRIORITY_RANK, QueryCancelledError,
+                            QueryContext, QueryDeadlineExceeded,
+                            QueryQueueFull, QueryShed, QueryShedError, bind,
                             checkpoint)
 
 #: sessions alive in this process (weak: an abandoned, never-stopped
@@ -111,12 +127,16 @@ def maybe_release_shared() -> bool:
 
 
 class _Ticket:
-    __slots__ = ("qctx", "granted", "enq_ns")
+    __slots__ = ("qctx", "granted", "enq_ns", "quota_deferred")
 
     def __init__(self, qctx: QueryContext):
         self.qctx = qctx
         self.granted = threading.Event()
         self.enq_ns = time.perf_counter_ns()
+        # sched.quota_defer_total counts DEFERRED TICKETS, not admission
+        # passes: set on the first quota skip so the 50ms re-poll loop
+        # cannot inflate the counter
+        self.quota_deferred = False
 
 
 class QueryScheduler:
@@ -126,21 +146,40 @@ class QueryScheduler:
     _cls_lock = threading.Lock()
 
     def __init__(self, max_queue: int = 64, max_concurrent: int = 8,
-                 hbm_watermark: float = 0.9):
+                 hbm_watermark: float = 0.9, class_aging_ms: float = 10000.0,
+                 tenant_hbm_quota: float = 0.0,
+                 shed_after_ms: float = 5000.0):
         self.max_queue = int(max_queue)
         self.max_concurrent = int(max_concurrent)
         self.hbm_watermark = float(hbm_watermark)
+        #: a ticket queued past this bound is promoted over class
+        #: precedence (anti-starvation: background still drains); 0 = off
+        self.class_aging_ms = float(class_aging_ms)
+        #: per-tenant HBM quota as a fraction of the budget; <=0 = off
+        self.tenant_hbm_quota = float(tenant_hbm_quota)
+        #: sustained-overload bound: a higher-class ticket waiting past
+        #: this with all slots held sheds the lowest running class; 0 = off
+        self.shed_after_ms = float(shed_after_ms)
         self._mu = threading.Lock()
-        # session id -> FIFO of queued tickets; _rr holds ids of sessions
-        # with a non-empty queue, rotated one grant at a time
-        self._queues: Dict[str, deque] = {}
-        self._rr: deque = deque()
+        # class -> session id -> FIFO of queued tickets (FIFO per session
+        # within a class; EDF across session heads within the class);
+        # _rr[cls] holds ids of that class's sessions with a non-empty
+        # queue — rotation is PER CLASS, so one class draining cannot
+        # perturb another class's fairness position (the PR 14 global
+        # rotation would have: a background grant used to advance the
+        # same cursor interactive grants read)
+        self._queues: Dict[str, Dict[str, deque]] = {}
+        self._rr: Dict[str, deque] = {}
         self._queued = 0
         self._running: Dict[int, QueryContext] = {}  # id(ticket) -> qctx
         # every live QueryContext (queued or running) by session, for
-        # session.cancel()/stop() and the postmortem listing
+        # session.cancel()/stop(), tenant-quota accounting and the
+        # postmortem listing
         self._by_session: Dict[str, List[QueryContext]] = {}
         self._tls = threading.local()
+        # EMA of completed-query wall seconds — the retry-after hint's
+        # scale (GIL attr, monitoring-counter discipline)
+        self._lat_ema_s = 0.5
 
     # --- lifecycle ----------------------------------------------------------
     @classmethod
@@ -165,8 +204,9 @@ class QueryScheduler:
         """Only EXPLICITLY SET sched keys overwrite the process state (the
         flight/mesh_profile maybe_configure pattern: a default-conf session
         must not silently resize another session's scheduler)."""
-        from ..config import (SCHED_HBM_WATERMARK, SCHED_MAX_CONCURRENT,
-                              SCHED_MAX_QUEUE)
+        from ..config import (SCHED_CLASS_AGING_MS, SCHED_HBM_WATERMARK,
+                              SCHED_MAX_CONCURRENT, SCHED_MAX_QUEUE,
+                              SCHED_SHED_AFTER_MS, SCHED_TENANT_HBM_QUOTA)
         with self._mu:
             if conf.get_raw(SCHED_MAX_QUEUE.key) is not None:
                 self.max_queue = int(conf.get(SCHED_MAX_QUEUE))
@@ -175,6 +215,13 @@ class QueryScheduler:
                     1, int(conf.get(SCHED_MAX_CONCURRENT)))
             if conf.get_raw(SCHED_HBM_WATERMARK.key) is not None:
                 self.hbm_watermark = float(conf.get(SCHED_HBM_WATERMARK))
+            if conf.get_raw(SCHED_CLASS_AGING_MS.key) is not None:
+                self.class_aging_ms = float(conf.get(SCHED_CLASS_AGING_MS))
+            if conf.get_raw(SCHED_TENANT_HBM_QUOTA.key) is not None:
+                self.tenant_hbm_quota = float(
+                    conf.get(SCHED_TENANT_HBM_QUOTA))
+            if conf.get_raw(SCHED_SHED_AFTER_MS.key) is not None:
+                self.shed_after_ms = float(conf.get(SCHED_SHED_AFTER_MS))
 
     def shutdown(self) -> None:
         """Cancel everything queued or running (the owner-class release for
@@ -192,54 +239,273 @@ class QueryScheduler:
             return True
         return b.used <= self.hbm_watermark * b.budget
 
-    def _admit_locked(self) -> None:
-        """Grant as many queued tickets as the watermarks allow, rotating
-        round-robin across sessions. Grants are Event.set — the waiting
-        submitter thread runs its own query."""
-        while self._rr and len(self._running) < self.max_concurrent:
+    def _quota_bytes(self) -> Optional[int]:
+        """Per-tenant HBM quota in bytes, or None when disabled (quota
+        conf <= 0, or no budget to take a fraction of)."""
+        if self.tenant_hbm_quota <= 0:
+            return None
+        from ..memory.hbm import HbmBudget
+        b = HbmBudget._instance  # no side-effect instantiation
+        if b is None or b.budget <= 0:
+            return None
+        return int(self.tenant_hbm_quota * b.budget)
+
+    def _over_quota_locked(self, sid: str,
+                           quota_bytes: Optional[int]) -> bool:
+        """Tenant usage = the net HBM bytes charged to the tenant's LIVE
+        QueryContexts (query_context.charge_hbm at HbmBudget.allocate).
+        Over quota, the tenant's next ticket queues even when the device
+        has headroom — the global watermark still applies on top."""
+        if quota_bytes is None:
+            return False
+        return sum(q.hbm_bytes
+                   for q in self._by_session.get(sid, ())) > quota_bytes
+
+    def _skip_quota_locked(self, ticket: _Ticket, sid: str,
+                           quota_bytes: Optional[int]) -> bool:
+        if not self._over_quota_locked(sid, quota_bytes):
+            return False
+        if not ticket.quota_deferred:
+            ticket.quota_deferred = True
+            _metrics.counter_inc("sched.quota_defer_total", session=sid)
+            _flight.note("query.quota_deferred", query=ticket.qctx.name,
+                         session=sid)
+        return True
+
+    def _take_locked(self, cls: str, sid: str, ticket: _Ticket) -> _Ticket:
+        """Dequeue a picked ticket and advance the PER-CLASS round-robin:
+        the granted session moves to the back of ITS class's rotation
+        only — fairness counters are per class, so a background grant can
+        never advance the cursor interactive grants are ordered by."""
+        dq = self._queues[cls][sid]
+        dq.popleft()
+        rot = self._rr.get(cls)
+        if rot is not None:
+            try:
+                rot.remove(sid)
+            except ValueError:
+                pass
+            if dq:
+                rot.append(sid)
+            if not rot:
+                del self._rr[cls]
+        if not dq:
+            del self._queues[cls][sid]
+            if not self._queues[cls]:
+                del self._queues[cls]
+        self._queued -= 1
+        return ticket
+
+    def _pick_locked(self, now_ns: int) -> Optional[_Ticket]:
+        """SLO-aware pick: (1) anti-starvation aging — the OLDEST ticket
+        queued past classAgingMs wins regardless of class, so background
+        still drains under a persistent interactive load; (2) strict
+        class precedence, earliest-deadline-first across the class's
+        session queue heads (per-session order stays FIFO), rotation
+        order breaking deadline ties (per-class round-robin). Over-quota
+        tenants are skipped in both passes. None = nothing admittable."""
+        quota = self._quota_bytes()
+        if self.class_aging_ms > 0:
+            bound_ns = int(self.class_aging_ms * 1e6)
+            aged: Optional[tuple] = None
+            for cls in PRIORITIES:
+                for sid in self._rr.get(cls, ()):
+                    dq = self._queues.get(cls, {}).get(sid)
+                    if not dq:
+                        continue
+                    head = dq[0]
+                    if now_ns - head.enq_ns < bound_ns:
+                        continue
+                    if self._skip_quota_locked(head, sid, quota):
+                        continue
+                    if aged is None or head.enq_ns < aged[2].enq_ns:
+                        aged = (cls, sid, head)
+            if aged is not None:
+                return self._take_locked(*aged)
+        for cls in PRIORITIES:
+            best: Optional[tuple] = None
+            best_key = float("inf")
+            for sid in self._rr.get(cls, ()):
+                dq = self._queues.get(cls, {}).get(sid)
+                if not dq:
+                    continue
+                head = dq[0]
+                if self._skip_quota_locked(head, sid, quota):
+                    continue
+                key = (float(head.qctx.deadline_ns)
+                       if head.qctx.deadline_ns is not None
+                       else float("inf"))
+                # strict < keeps the earliest rotation position on ties:
+                # deadline-less tickets fall back to pure round-robin
+                if best is None or key < best_key:
+                    best, best_key = (cls, sid, head), key
+            if best is not None:
+                return self._take_locked(*best)
+        return None
+
+    def _overload_victim_locked(self, now_ns: int
+                                ) -> Optional[QueryContext]:
+        """Sustained overload: a higher-class ticket has waited past
+        shedAfterMs with every slot held while a strictly lower class
+        runs → shed the LOWEST running class, one victim per pass (the
+        freed slot re-evaluates before anything else is shed)."""
+        if (self.shed_after_ms <= 0 or not self._queued
+                or len(self._running) < self.max_concurrent):
+            return None
+        quota = self._quota_bytes()
+        bound_ns = int(self.shed_after_ms * 1e6)
+        waiter_rank: Optional[int] = None
+        for cls, per_sid in self._queues.items():
+            r = PRIORITY_RANK[cls]
+            for sid, dq in per_sid.items():
+                if not dq:
+                    continue
+                head = dq[0]
+                # an over-quota tenant's wait is self-inflicted
+                # backpressure, not device overload — never sheds others
+                if self._over_quota_locked(sid, quota):
+                    continue
+                if (now_ns - head.enq_ns >= bound_ns
+                        and (waiter_rank is None or r < waiter_rank)):
+                    waiter_rank = r
+        if waiter_rank is None:
+            return None
+        victim: Optional[QueryContext] = None
+        vrank = waiter_rank
+        for q in self._running.values():
+            r = PRIORITY_RANK.get(q.priority, 0)
+            if r > vrank and not q.cancelled:
+                victim, vrank = q, r
+        return victim
+
+    def _admit_locked(self) -> Optional[QueryContext]:
+        """Grant as many queued tickets as the watermarks allow (SLO
+        order — _pick_locked). Grants are Event.set — the waiting
+        submitter thread runs its own query. Returns the overload-shed
+        victim, if any, for the CALLER to arm outside the lock (the
+        cancel token's flight/chaos emission must not run under _mu)."""
+        now_ns = time.perf_counter_ns()
+        while self._queued and len(self._running) < self.max_concurrent:
             # HBM admission watermark, waived when the device is idle so
             # admission can always make progress (a budget left high by
             # parked state must not wedge the queue)
             if self._running and not self._hbm_headroom_ok():
                 break
-            sid = self._rr[0]
-            q = self._queues.get(sid)
-            if not q:
-                self._rr.popleft()
-                continue
-            ticket = q.popleft()
-            if q:
-                self._rr.rotate(-1)
-            else:
-                self._rr.popleft()
-                del self._queues[sid]
-            self._queued -= 1
+            ticket = self._pick_locked(now_ns)
+            if ticket is None:
+                break
             self._running[id(ticket)] = ticket.qctx
             ticket.granted.set()
+        victim = self._overload_victim_locked(now_ns)
         # committed under the lock (the _QL_LOCK idiom): an interleaved
         # enqueue/release pair must never publish a stale depth
         _metrics.gauge_set("sched.queue_depth", self._queued)
+        return victim
+
+    def _admit_and_shed(self) -> None:
+        """The admission entry point off the submit/poll/release paths:
+        run one admission pass, then arm any overload victim OUTSIDE the
+        lock (chaos + cancel-token flight emission)."""
+        with self._mu:
+            victim = self._admit_locked()
+        if victim is not None:
+            self._shed_victim(victim, reason="overload")
+
+    # --- load shedding (docs/serving.md) ------------------------------------
+    def _retry_after_s(self) -> float:
+        """Client retry hint: roughly how long until a resubmission could
+        be admitted — queue depth over concurrency, scaled by the EMA of
+        recent query walls. A hint, not a promise (GIL reads)."""
+        ema = max(0.05, float(self._lat_ema_s))
+        depth = self._queued / max(1, self.max_concurrent)
+        return min(30.0, round((depth + 1.0) * ema, 3))
+
+    def _arm_shed(self, qctx: QueryContext, reason: str) -> None:
+        if qctx.cancelled:
+            return
+        hint = self._retry_after_s()
+        qctx.shed(retry_after_s=hint, reason=f"shed.{reason}")
+        _metrics.counter_inc("sched.shed_total", cls=qctx.priority)
+        _flight.note("query.shed", query=qctx.name,
+                     session=qctx.session_id, cls=qctx.priority,
+                     reason=reason, retry_after_s=hint)
+
+    def _shed_victim(self, qctx: QueryContext, reason: str) -> bool:
+        """Shed one RUNNING victim: the chaos `sched.shed` site fires
+        BEFORE the token arms (latency delays the shed; io_error fails
+        the shed attempt — the victim survives this pass and the next
+        admission pass re-decides), then the cooperative cancel token
+        arms with the retry-after hint. The victim unwinds through the
+        TL020-proven release paths at its next checkpoint."""
+        from ..chaos import inject
+        try:
+            inject("sched.shed", detail=qctx.name)
+        except OSError:
+            _flight.note("query.shed_aborted", query=qctx.name,
+                         session=qctx.session_id, reason=reason)
+            return False
+        self._arm_shed(qctx, reason)
+        return True
+
+    def _try_shed_queued(self, ticket: _Ticket, reason: str) -> bool:
+        """Shed one QUEUED victim to make room for a higher-class
+        submission. Chaos fires before any state change; io_error fails
+        the shed (False → the submission degrades to typed QueryQueueFull
+        backpressure). The victim's waiting thread observes its armed
+        token at the next 50ms poll tick and unwinds without ever having
+        run. True = scheduler state may have changed; retry the enqueue
+        (the victim may instead have been granted in the race window —
+        that also frees queue space)."""
+        from ..chaos import inject
+        try:
+            inject("sched.shed", detail=ticket.qctx.name)
+        except OSError:
+            _flight.note("query.shed_aborted", query=ticket.qctx.name,
+                         session=ticket.qctx.session_id, reason=reason)
+            return False
+        with self._mu:
+            removed = self._remove_ticket_locked(ticket)
+        if removed:
+            self._arm_shed(ticket.qctx, reason)
+        return True
+
+    def _remove_ticket_locked(self, ticket: _Ticket) -> bool:
+        """Drop a still-queued ticket from its class/session queue
+        (shed-while-queued, or a never-admitted release). Idempotent."""
+        cls = ticket.qctx.priority
+        sid = ticket.qctx.session_id
+        per_sid = self._queues.get(cls)
+        dq = per_sid.get(sid) if per_sid else None
+        if dq is None:
+            return False
+        try:
+            dq.remove(ticket)
+        except ValueError:
+            return False
+        self._queued -= 1
+        if not dq:
+            del per_sid[sid]
+            if not per_sid:
+                del self._queues[cls]
+            rot = self._rr.get(cls)
+            if rot is not None:
+                try:
+                    rot.remove(sid)
+                except ValueError:
+                    pass
+                if not rot:
+                    del self._rr[cls]
+        return True
 
     def _release(self, ticket: _Ticket) -> None:
         """Return `ticket`'s slot (running) or queue entry (never admitted)
         and admit successors. Idempotent."""
         with self._mu:
             if self._running.pop(id(ticket), None) is None:
-                sid = ticket.qctx.session_id
-                q = self._queues.get(sid)
-                if q is not None:
-                    try:
-                        q.remove(ticket)
-                        self._queued -= 1
-                    except ValueError:
-                        pass
-                    if not q:
-                        del self._queues[sid]
-                        try:
-                            self._rr.remove(sid)
-                        except ValueError:
-                            pass
-            self._admit_locked()
+                self._remove_ticket_locked(ticket)
+            victim = self._admit_locked()
+        if victim is not None:
+            self._shed_victim(victim, reason="overload")
 
     def _deregister(self, qctx: QueryContext) -> None:
         """QueryContext.close() hook: drop it from the session index."""
@@ -270,21 +536,41 @@ class QueryScheduler:
             qctx.mark_running()
             return fn()
         ticket = _Ticket(qctx)
-        with self._mu:
-            if self._queued >= self.max_queue:
-                _metrics.counter_inc("query.rejected_queue_full")
-                rejected = True
-            else:
-                rejected = False
-                self._queues.setdefault(qctx.session_id,
-                                        deque()).append(ticket)
-                if qctx.session_id not in self._rr:
-                    self._rr.append(qctx.session_id)
-                self._queued += 1
-                self._by_session.setdefault(qctx.session_id,
-                                            []).append(qctx)
-                self._admit_locked()
-        if rejected:
+        my_rank = PRIORITY_RANK[qctx.priority]
+        cls = qctx.priority
+        enqueued = False
+        victim: Optional[QueryContext] = None
+        # bounded shed-to-make-room loop: a full queue rejects a
+        # submission ONLY when no strictly lower class is queued behind
+        # it — otherwise the lowest (youngest-first) class is shed and
+        # the enqueue retried. Same-or-higher classes queued means the
+        # typed QueryQueueFull backpressure stands, exactly as before.
+        for _attempt in range(4):
+            queued_victim: Optional[_Ticket] = None
+            with self._mu:
+                if self._queued < self.max_queue:
+                    self._queues.setdefault(cls, {}).setdefault(
+                        qctx.session_id, deque()).append(ticket)
+                    rot = self._rr.setdefault(cls, deque())
+                    if qctx.session_id not in rot:
+                        rot.append(qctx.session_id)
+                    self._queued += 1
+                    self._by_session.setdefault(qctx.session_id,
+                                                []).append(qctx)
+                    victim = self._admit_locked()
+                    enqueued = True
+                else:
+                    queued_victim = self._find_queued_victim_locked(
+                        my_rank)
+            if enqueued:
+                break
+            if queued_victim is None or not self._try_shed_queued(
+                    queued_victim, reason="queue_full"):
+                break
+        if victim is not None:
+            self._shed_victim(victim, reason="overload")
+        if not enqueued:
+            _metrics.counter_inc("query.rejected_queue_full")
             _flight.note("query.rejected", query=qctx.name,
                          session=qctx.session_id, reason="queue_full")
             raise QueryQueueFull(
@@ -292,7 +578,7 @@ class QueryScheduler:
                 f"(spark.rapids.tpu.sched.maxQueuedQueries="
                 f"{self.max_queue})")
         _flight.note("query.queued", query=qctx.name,
-                     session=qctx.session_id)
+                     session=qctx.session_id, cls=qctx.priority)
         try:
             # grant wait OFF the lock; short poll so a cancel or deadline
             # arriving while queued is observed promptly, and admission is
@@ -300,8 +586,7 @@ class QueryScheduler:
             # with no completion event to trigger a grant)
             while not ticket.granted.wait(timeout=0.05):
                 qctx.check("sched.queue")
-                with self._mu:
-                    self._admit_locked()
+                self._admit_and_shed()
             # chaos `sched.admit` fires BEFORE the admission is recorded:
             # latency extends the measured queue delay (it lands in the
             # sched.admit_wait_ms histogram), io_error fails the query
@@ -310,17 +595,33 @@ class QueryScheduler:
             from ..chaos import inject
             inject("sched.admit", detail=qctx.name)
             wait_ms = (time.perf_counter_ns() - ticket.enq_ns) / 1e6
+            qctx.admit_wait_ms = wait_ms
             _metrics.histogram_observe("sched.admit_wait_ms", wait_ms)
+            _metrics.histogram_observe("sched.class_admit_wait_ms",
+                                       wait_ms, cls=qctx.priority)
             _flight.note("query.admitted", query=qctx.name,
-                         session=qctx.session_id,
+                         session=qctx.session_id, cls=qctx.priority,
                          wait_ms=round(wait_ms, 3))
             self._tls.admitted = True
             try:
                 with bind(qctx):
                     qctx.mark_running()
-                    return fn()
+                    out = fn()
             finally:
                 self._tls.admitted = False
+            # completed-query wall EMA — the retry-after hint's scale
+            run_s = ((time.perf_counter_ns() - ticket.enq_ns) / 1e9
+                     - wait_ms / 1e3)
+            self._lat_ema_s = (0.8 * self._lat_ema_s
+                               + 0.2 * max(1e-3, run_s))
+            return out
+        except QueryShedError:
+            # counted at arm time (sched.shed_total); deliberately NOT
+            # query.cancelled — shedding is a scheduler answer, and the
+            # front door converts it into a typed QueryShed result
+            _flight.note("query.shed_unwound", query=qctx.name,
+                         session=qctx.session_id, cls=qctx.priority)
+            raise
         except QueryDeadlineExceeded:
             _metrics.counter_inc("query.deadline_exceeded")
             _flight.note("query.deadline_exceeded", query=qctx.name,
@@ -334,6 +635,25 @@ class QueryScheduler:
             raise
         finally:
             self._release(ticket)
+
+    def _find_queued_victim_locked(self, my_rank: int
+                                   ) -> Optional["_Ticket"]:
+        """Lowest-class queued ticket STRICTLY below `my_rank`, youngest
+        first (least sunk queue wait), for shed-to-make-room."""
+        for cls in reversed(PRIORITIES):
+            if PRIORITY_RANK[cls] <= my_rank:
+                return None
+            per_sid = self._queues.get(cls)
+            if not per_sid:
+                continue
+            youngest: Optional[_Ticket] = None
+            for dq in per_sid.values():
+                for t in dq:
+                    if youngest is None or t.enq_ns > youngest.enq_ns:
+                        youngest = t
+            if youngest is not None:
+                return youngest
+        return None
 
     # --- session-level control ---------------------------------------------
     def cancel_session(self, session_id: str,
@@ -366,15 +686,22 @@ class QueryScheduler:
         were queued, running or cancelling when the process died."""
         with self._mu:
             running = [{"query": q.name, "session": q.session_id,
-                        "state": q.state}
+                        "cls": q.priority, "state": q.state}
                        for q in self._running.values()]
-            queued = [{"query": t.qctx.name, "session": sid,
+            queued = [{"query": t.qctx.name, "session": sid, "cls": cls,
                        "state": t.qctx.state}
-                      for sid, dq in self._queues.items() for t in dq]
+                      for cls, per_sid in self._queues.items()
+                      for sid, dq in per_sid.items() for t in dq]
+            tenant_hbm = {sid: sum(q.hbm_bytes for q in qs)
+                          for sid, qs in self._by_session.items()}
             return {"max_concurrent": self.max_concurrent,
                     "max_queue": self.max_queue,
                     "hbm_watermark": self.hbm_watermark,
+                    "class_aging_ms": self.class_aging_ms,
+                    "tenant_hbm_quota": self.tenant_hbm_quota,
+                    "shed_after_ms": self.shed_after_ms,
                     "queue_depth": self._queued,
+                    "tenant_hbm_bytes": tenant_hbm,
                     "running": running, "queued": queued}
 
 
@@ -384,13 +711,18 @@ class QueryScheduler:
 # ---------------------------------------------------------------------------
 
 
-def execute_plan(session, plan, timeout: Optional[float] = None):
+def execute_plan(session, plan, timeout: Optional[float] = None,
+                 priority: Optional[str] = None):
     """Plan, admit, and execute one query for `session`, returning the
-    pyarrow result table. `timeout` (seconds) overrides the session's
-    spark.rapids.tpu.query.timeoutMs deadline for this call."""
+    pyarrow result table — or a typed :class:`QueryShed` result when the
+    scheduler shed the query under overload (docs/serving.md). `timeout`
+    (seconds) overrides the session's spark.rapids.tpu.query.timeoutMs
+    deadline for this call; `priority` overrides the session's
+    spark.rapids.tpu.query.priority SLO class."""
     import pyarrow as pa
 
-    from ..config import QUERY_RETRY_BUDGET, QUERY_TIMEOUT_MS, TRACE_TAG
+    from ..config import (QUERY_PRIORITY, QUERY_RETRY_BUDGET,
+                          QUERY_TIMEOUT_MS, TRACE_TAG)
     from ..plan.overrides import TpuOverrides
     from ..plan.planner import plan_physical
     from ..types import to_arrow as t2a
@@ -415,15 +747,32 @@ def execute_plan(session, plan, timeout: Optional[float] = None):
         else float(conf.get(QUERY_TIMEOUT_MS))
     deadline_ns = (time.perf_counter_ns() + int(timeout_ms * 1e6)
                    if timeout_ms and timeout_ms > 0 else None)
+    cls = str(priority if priority is not None
+              else conf.get(QUERY_PRIORITY))
     sched = QueryScheduler.get(conf)
     try:
         with QueryContext(qname, session_id=session._session_id,
                           deadline_ns=deadline_ns,
-                          retry_budget=conf.get(QUERY_RETRY_BUDGET)
-                          ) as qctx:
-            tables = sched.submit_and_run(
-                qctx, lambda: _run_admitted(session, final, conf, qctx,
-                                            stem, qname))
+                          retry_budget=conf.get(QUERY_RETRY_BUDGET),
+                          priority=cls) as qctx:
+            try:
+                tables = sched.submit_and_run(
+                    qctx, lambda: _run_admitted(session, final, conf,
+                                                qctx, stem, qname))
+            except QueryShedError as e:
+                # typed load-shed RESULT, not an error: the unwind
+                # already ran the TL020-proven release paths; the client
+                # resubmits after the hint (docs/serving.md). finish(e)
+                # records the SHED terminal state HERE — the swallowed
+                # exception never reaches __exit__'s finish
+                qctx.finish(e)
+                return QueryShed(
+                    query=qname, session=session._session_id,
+                    priority=qctx.priority,
+                    reason=qctx.cancel_reason or "shed",
+                    retry_after_s=e.retry_after_s)
+            finally:
+                session._last_admit_wait_ms = qctx.admit_wait_ms
     finally:
         # a query that outlived its session's stop() drain releases the
         # shared state the stop could not (no-op unless pending)
@@ -458,7 +807,8 @@ def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
     # (traced or not) registers its lifecycle — the queries.active
     # gauge/list, the latency + rows/s histograms, and the epoch the
     # tracer's exclusivity check reads
-    qtok = obs.metrics.query_begin(qname, session=stem)
+    qtok = obs.metrics.query_begin(qname, session=stem,
+                                   cls=qctx.priority)
     qroot = None
     opjit_before = None
     tables: List = []

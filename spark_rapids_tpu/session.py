@@ -535,18 +535,29 @@ class DataFrame:
                          self.session)
 
     # --- actions ----------------------------------------------------------
-    def to_arrow(self, timeout: Optional[float] = None):
-        return self.session._execute(self._plan, timeout=timeout)
+    def to_arrow(self, timeout: Optional[float] = None,
+                 priority: Optional[str] = None):
+        return self.session._execute(self._plan, timeout=timeout,
+                                     priority=priority)
 
     toArrow = to_arrow
 
-    def collect(self, timeout: Optional[float] = None) -> List[dict]:
+    def collect(self, timeout: Optional[float] = None,
+                priority: Optional[str] = None):
         """Execute and fetch all rows. `timeout` (seconds) sets a deadline
         for THIS query (overriding spark.rapids.tpu.query.timeoutMs): past
         it the query is cancelled at the next cooperative checkpoint and
         raises QueryDeadlineExceeded with every resource released
-        (docs/robustness.md "Query lifecycle")."""
-        return self.to_arrow(timeout=timeout).to_pylist()
+        (docs/robustness.md "Query lifecycle"). `priority` overrides the
+        session's SLO class (spark.rapids.tpu.query.priority) for this
+        call. Under sustained overload the scheduler may SHED the query —
+        the return value is then a typed ``QueryShed`` result carrying a
+        retry-after hint instead of the row list (docs/serving.md)."""
+        out = self.to_arrow(timeout=timeout, priority=priority)
+        from .serving.query_context import QueryShed
+        if isinstance(out, QueryShed):
+            return out
+        return out.to_pylist()
 
     def toPandas(self):
         return self.to_arrow().to_pandas()
@@ -1090,14 +1101,16 @@ class TpuSession:
 
     # --- execution --------------------------------------------------------
     def _execute(self, plan: L.LogicalPlan,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 priority: Optional[str] = None):
         """Submit one query through the scheduler/executor service
         (serving/scheduler.py — docs/robustness.md "Query lifecycle"):
-        admission control (bounded queue, HBM watermark, round-robin
-        fairness across sessions), a per-query cancel token + optional
-        deadline, and the per-partition driving loop. The session keeps
-        only query STATE (the _last_* snapshots the executor writes
-        back); the device-owning loop lives in the service."""
+        admission control (bounded queue per SLO class, HBM watermark +
+        per-tenant quota, per-class round-robin fairness across
+        sessions), a per-query cancel token + optional deadline, and the
+        per-partition driving loop. The session keeps only query STATE
+        (the _last_* snapshots the executor writes back); the
+        device-owning loop lives in the service."""
         if self._stopped:
             # a stopped session already released (or ceded) the shared
             # state; executing would silently resurrect the shuffle
@@ -1105,7 +1118,16 @@ class TpuSession:
             raise RuntimeError(
                 f"TpuSession {self._session_id} is stopped")
         from .serving.scheduler import execute_plan
-        return execute_plan(self, plan, timeout=timeout)
+        return execute_plan(self, plan, timeout=timeout,
+                            priority=priority)
+
+    def last_admit_wait_ms(self) -> Optional[float]:
+        """Admission-queue wait of this session's last executed query in
+        milliseconds (None before any query, or when the last query was
+        rejected/shed while still queued). The bench serving stage reads
+        this per query; the process-wide distribution is the
+        sched.class_admit_wait_ms histogram."""
+        return getattr(self, "_last_admit_wait_ms", None)
 
     def last_query_metrics(self, level: Optional[str] = None):
         """Per-operator metrics of the last executed query (the reference

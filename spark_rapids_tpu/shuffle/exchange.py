@@ -79,30 +79,42 @@ class _ExchangeBase:
             # cancel/deadline trips map tasks on pool threads too
             self._obs_parent = obs.current_span()
             self._query_ctx = qlc.current()
-            with obs.span(f"exchange s{sid} materialize", cat="shuffle",
-                          shuffle=sid) as mat_span:
-                if mat_span is not None:
-                    self._obs_parent = mat_span
-                if self._try_materialize_collective(sid, ctx):
-                    self._n_maps = 1  # one collective "map": whole exchange
+            try:
+                with obs.span(f"exchange s{sid} materialize", cat="shuffle",
+                              shuffle=sid) as mat_span:
+                    if mat_span is not None:
+                        self._obs_parent = mat_span
+                    if self._try_materialize_collective(sid, ctx):
+                        self._n_maps = 1  # one collective "map": whole
+                        self._shuffle_id = sid  # exchange
+                        return
+                    self._n_maps = child.num_partitions()
+                    threads = self._map_task_threads(ctx)
+                    # batched multi-partition dispatch: the unit of
+                    # scheduling is a partition GROUP (spark.rapids.tpu.
+                    # dispatch.partitionBatch); group size 1 is exactly the
+                    # PR 2 per-partition behavior
+                    group = self._map_group_size(ctx) if self._n_maps > 1 \
+                        else 1
+                    groups = [list(range(s, min(s + group, self._n_maps)))
+                              for s in range(0, self._n_maps, max(1, group))]
+                    if threads > 1 and len(groups) > 1:
+                        self._materialize_maps_pipelined(sid, ctx, mgr,
+                                                         threads, groups)
+                    else:
+                        for ids in groups:
+                            self._run_group_guarded(sid, ids, ctx, mgr)
                     self._shuffle_id = sid
-                    return
-                self._n_maps = child.num_partitions()
-                threads = self._map_task_threads(ctx)
-                # batched multi-partition dispatch: the unit of scheduling
-                # is a partition GROUP (spark.rapids.tpu.dispatch.
-                # partitionBatch); group size 1 is exactly the PR 2
-                # per-partition behavior
-                group = self._map_group_size(ctx) if self._n_maps > 1 else 1
-                groups = [list(range(s, min(s + group, self._n_maps)))
-                          for s in range(0, self._n_maps, max(1, group))]
-                if threads > 1 and len(groups) > 1:
-                    self._materialize_maps_pipelined(sid, ctx, mgr, threads,
-                                                     groups)
-                else:
-                    for ids in groups:
-                        self._run_group_guarded(sid, ids, ctx, mgr)
-                self._shuffle_id = sid
+            except BaseException:
+                # A cancel/shed/deadline trip (or any map-task error)
+                # unwinding MID-materialization leaves blocks already
+                # committed under `sid` while self._shuffle_id is still
+                # None — cleanup_shuffle keys off _shuffle_id and would
+                # never visit them, so each such unwind would strand the
+                # finished maps' device blocks in the catalog for the
+                # life of the process.
+                self._abort_materialization(sid, ctx.conf)
+                raise
 
     def _run_map_guarded(self, sid: int, map_id: int, ctx: TaskContext,
                          mgr, gate_device: bool = False) -> None:
@@ -424,6 +436,12 @@ class _ExchangeBase:
             self._shuffle_id = None
         if sid is None:
             return
+        self._abort_materialization(sid, conf)
+
+    def _abort_materialization(self, sid: int, conf) -> None:
+        """Release every block/file committed under `sid` regardless of
+        whether _shuffle_id was ever set — shared by the normal query-end
+        release and the mid-materialization unwind path."""
         from .ici import IciShuffleCatalog
         IciShuffleCatalog.get().cleanup(sid)
         TpuShuffleManager.get(conf).cleanup(sid)

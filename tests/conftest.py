@@ -18,6 +18,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak, excluded from the fast tier "
+        "(runs in the CI_FULL full-suite tier)")
+
+
 if os.environ.get("SRT_LEAK_GATE"):
     # CI leak gate: after the whole session, any resource still tracked by
     # the process-wide MemoryCleaner is a leak and fails the run (the

@@ -536,6 +536,41 @@ def test_bench_diff_fused_dataplane_keys_neutral():
     assert not any("staging_reuse_hits" in k for k in only_new)
 
 
+def test_bench_diff_serving_keys():
+    """ISSUE 19: the serving stage's SLO keys gate — rows_per_s drops
+    regress (higher-is-better like every throughput key), interactive
+    p95 RISING regresses BY DEFAULT (no --include-overhead; the latency
+    SLO is the point of the stage), and shed_total is neutral in both
+    directions (the shed count tracks timing jitter, not quality)."""
+    from tools.bench_diff import diff, extract_metrics
+
+    def round_(rows_s, p95, sheds):
+        return {"summary": {"serving_n1_rows_per_s": 5000.0,
+                            "serving_n16_rows_per_s": rows_s,
+                            "serving_n16_interactive_p95_ms": p95,
+                            "serving_n16_shed_total": sheds}}
+
+    old = round_(1000.0, 40.0, 2)
+    m = extract_metrics(old)
+    # p95 gated lower-is-better WITHOUT the overhead opt-in; shed_total
+    # never extracted at all
+    assert m["summary.serving_n16_rows_per_s"] == (1000.0, True)
+    assert m["summary.serving_n16_interactive_p95_ms"] == (40.0, False)
+    assert not any("shed_total" in k for k in m)
+    # throughput drop + p95 rise both regress in the default gate
+    reg, _i, _u, _, _ = diff(old, round_(800.0, 80.0, 30), 0.10)
+    assert {r[0] for r in reg} == {
+        "summary.serving_n16_rows_per_s",
+        "summary.serving_n16_interactive_p95_ms"}
+    # p95 falling is an improvement; a shed-count swing alone (either
+    # direction) never surfaces as regression OR improvement
+    reg, imp, _u, _, _ = diff(old, round_(1000.0, 20.0, 0), 0.10)
+    assert not reg
+    assert [r[0] for r in imp] == ["summary.serving_n16_interactive_p95_ms"]
+    reg, imp, _u, _, _ = diff(old, round_(1000.0, 40.0, 500), 0.10)
+    assert not reg and not imp
+
+
 def test_flight_ring_is_bounded_and_ordered():
     for i in range(2000):
         obs_flight.note("flood", i=i)

@@ -1,0 +1,606 @@
+"""SLO-aware serving under overload (ISSUE 19): priority classes with
+strict precedence, EDF-within-class admission, PER-CLASS round-robin
+fairness, anti-starvation aging, per-tenant HBM quotas, and typed load
+shedding through the checkpointed-cancel unwind (docs/serving.md).
+
+The fast tests pin the scheduler semantics deterministically at the
+QueryContext/QueryScheduler level; the front-door tests prove the
+``QueryShed`` result contract through real sessions; the N=16 soak
+(slow — CI_FULL tier) is the acceptance bar: a flooding background load
+is shed while interactive p95 stays within a fixed bound of its
+unloaded value, every non-shed result is bit-identical to the clean
+run, and nothing leaks."""
+
+import threading
+import time
+
+import pytest
+
+import spark_rapids_tpu.functions as F  # noqa: F401 — session dep
+from spark_rapids_tpu.chaos import FaultInjector
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory.cleaner import MemoryCleaner
+from spark_rapids_tpu.memory.hbm import HbmBudget
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.obs import flight
+from spark_rapids_tpu.obs import metrics as obs_metrics
+from spark_rapids_tpu.serving.query_context import (QueryContext,
+                                                    QueryQueueFull,
+                                                    QueryShed,
+                                                    QueryShedError,
+                                                    validate_priority)
+from spark_rapids_tpu.serving.scheduler import QueryScheduler
+from spark_rapids_tpu.session import TpuSession
+
+#: latency chaos at the cancel-checkpoint site stretches a query so the
+#: shed window is wide — the test_query_lifecycle cancel-test idiom
+_STRETCH_CHAOS = {
+    "spark.rapids.tpu.test.chaos.enabled": "true",
+    "spark.rapids.tpu.test.chaos.sites": "query.cancel",
+    "spark.rapids.tpu.test.chaos.kinds": "latency",
+    "spark.rapids.tpu.test.chaos.probability": "1.0",
+    "spark.rapids.tpu.test.chaos.latencyMs": "30",
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    FaultInjector.reset_for_tests()
+    QueryScheduler.reset_for_tests()
+    yield
+    FaultInjector.reset_for_tests()
+    QueryScheduler.reset_for_tests()
+
+
+def _counter(name):
+    cells = obs_metrics.MetricsRegistry.get().snapshot()["counters"].get(
+        name, {})
+    return sum(cells.values())
+
+
+def _resource_baseline():
+    return {"cleaner": len(MemoryCleaner.get().live_resources()),
+            "hbm": HbmBudget.get().used}
+
+
+def _assert_resource_baseline(before):
+    assert len(MemoryCleaner.get().live_resources()) == before["cleaner"]
+    assert HbmBudget.get().used == before["hbm"]
+    sem = TpuSemaphore._instance
+    if sem is not None:
+        assert sem._sem._value == sem.permits
+
+
+def _occupy(sched, priority="interactive", session_id="occ-s"):
+    """Hold one admission slot until the returned release() is called."""
+    hold, started = threading.Event(), threading.Event()
+
+    def occupier():
+        with QueryContext("occ", session_id, priority=priority) as q:
+            try:
+                sched.submit_and_run(
+                    q, lambda: (started.set(), hold.wait(15)))
+            except QueryShedError:
+                pass
+
+    t = threading.Thread(target=occupier)
+    t.start()
+    assert started.wait(10)
+
+    def release():
+        hold.set()
+        t.join(timeout=10)
+
+    return release
+
+
+def _submit_async(sched, name, sid, priority, sink, deadline_ns=None,
+                  errs=None):
+    """Submit on a worker thread; append `name` to `sink` when granted."""
+    def run():
+        try:
+            with QueryContext(name, sid, priority=priority,
+                              deadline_ns=deadline_ns) as q:
+                sched.submit_and_run(q, lambda: sink.append(name))
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            if errs is not None:
+                errs[name] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# class semantics: validation, precedence, per-class RR, EDF, aging
+# ---------------------------------------------------------------------------
+
+def test_priority_validation_rejects_unknown_class():
+    assert validate_priority("batch") == "batch"
+    with pytest.raises(ValueError):
+        validate_priority("realtime")
+    with pytest.raises(ValueError):
+        QueryContext("q", "s", priority="urgent")
+
+
+def test_strict_class_precedence_orders_grants():
+    """Arrival order background → batch → interactive; grant order is
+    exactly class rank."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent = 1
+    release = _occupy(sched)
+    order = []
+    threads = []
+    for name, sid, cls in (("g1", "G", "background"),
+                           ("b1", "B", "batch"),
+                           ("i1", "I", "interactive")):
+        threads.append(_submit_async(sched, name, sid, cls, order))
+        time.sleep(0.15)  # let the ticket actually enqueue
+    release()
+    for t in threads:
+        t.join(timeout=10)
+    assert order == ["i1", "b1", "g1"]
+
+
+def test_per_class_round_robin_fairness():
+    """Within EACH class the grant rotation is round-robin across that
+    class's sessions — fairness accounting is per class, so one class's
+    grants never advance the cursor another class's grants are ordered
+    by (the PR 14 shared-rotation accounting pinned per class)."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent = 1
+    release = _occupy(sched)
+    order = []
+    threads = []
+    # interactive: A queues 2 ahead of B's 1; background: G queues 2
+    # ahead of H's 1. Expected: all interactive first (A, B, A — FIFO
+    # per session, RR across), then background with ITS OWN rotation
+    # intact (G, H, G).
+    for name, sid, cls in (("a1", "A", "interactive"),
+                           ("a2", "A", "interactive"),
+                           ("g1", "G", "background"),
+                           ("g2", "G", "background"),
+                           ("b1", "B", "interactive"),
+                           ("h1", "H", "background")):
+        threads.append(_submit_async(sched, name, sid, cls, order))
+        time.sleep(0.12)
+    release()
+    for t in threads:
+        t.join(timeout=10)
+    assert order == ["a1", "b1", "a2", "g1", "h1", "g2"]
+
+
+def test_edf_within_class_across_sessions():
+    """Deadline-ordered admission: the later-arriving query with the
+    EARLIER deadline is granted first within its class."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent = 1
+    release = _occupy(sched)
+    order = []
+    now = time.perf_counter_ns()
+    t1 = _submit_async(sched, "late", "A", "interactive", order,
+                       deadline_ns=now + 600 * 10**9)
+    time.sleep(0.15)
+    t2 = _submit_async(sched, "early", "B", "interactive", order,
+                       deadline_ns=now + 300 * 10**9)
+    time.sleep(0.15)
+    # a deadline-less ticket sorts after any deadline (inf key)
+    t3 = _submit_async(sched, "none", "C", "interactive", order)
+    time.sleep(0.15)
+    release()
+    for t in (t1, t2, t3):
+        t.join(timeout=10)
+    assert order == ["early", "late", "none"]
+
+
+def test_aging_promotes_starved_lower_class():
+    """Anti-starvation: a background ticket queued past classAgingMs is
+    granted ahead of a fresher interactive ticket."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent = 1
+    sched.class_aging_ms = 200.0
+    release = _occupy(sched)
+    order = []
+    t1 = _submit_async(sched, "g1", "G", "background", order)
+    time.sleep(0.35)  # g1's wait crosses the aging bound
+    t2 = _submit_async(sched, "i1", "I", "interactive", order)
+    time.sleep(0.15)
+    release()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert order == ["g1", "i1"]
+
+
+# ---------------------------------------------------------------------------
+# per-tenant HBM quota
+# ---------------------------------------------------------------------------
+
+def test_tenant_hbm_quota_defers_admission_and_counts():
+    """An over-quota tenant's next query queues even with free slots and
+    device headroom; other tenants admit; the deferred query admits once
+    the tenant's usage drops. sched.quota_defer_total counts the ticket
+    ONCE."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent, sched.tenant_hbm_quota = 4, 0.1
+    HbmBudget.reset_for_tests(budget_bytes=1_000_000)  # quota = 100_000
+    try:
+        before = _counter("sched.quota_defer_total")
+        hold, started = threading.Event(), threading.Event()
+        order = []
+
+        def occupier():
+            with QueryContext("t-big", "T") as q:
+                def body():
+                    # charge while RUNNING (a queued query holds nothing)
+                    q.hbm_bytes = 200_000  # tenant T: 2x over quota
+                    started.set()
+                    hold.wait(15)
+
+                sched.submit_and_run(q, body)
+
+        t0 = threading.Thread(target=occupier)
+        t0.start()
+        assert started.wait(10)
+        t1 = _submit_async(sched, "t-next", "T", "interactive", order)
+        time.sleep(0.4)
+        assert order == []  # T is over quota: queues despite 3 free slots
+        assert _counter("sched.quota_defer_total") == before + 1
+        t2 = _submit_async(sched, "other", "O", "interactive", order)
+        t2.join(timeout=10)
+        assert order == ["other"]  # quota is PER tenant
+        hold.set()
+        t0.join(timeout=10)
+        t1.join(timeout=10)  # T's usage dropped → t-next admits
+        assert order == ["other", "t-next"]
+        # the defer was counted once, not once per 50ms poll tick
+        assert _counter("sched.quota_defer_total") == before + 1
+    finally:
+        hold.set()
+        HbmBudget.reset_for_tests()
+
+
+def test_hbm_charge_attributes_to_bound_query_context():
+    """HbmBudget.allocate/free charge the bound QueryContext's hbm_bytes
+    (the attribution the quota check sums)."""
+    from spark_rapids_tpu.serving import query_context as qlc
+    b = HbmBudget.reset_for_tests(budget_bytes=1_000_000)
+    try:
+        q = QueryContext("q", "s")
+        with qlc.bind(q):
+            b.allocate(4096)
+            assert q.hbm_bytes == 4096
+            b.free(1024)
+            assert q.hbm_bytes == 3072
+            b.free(4096)  # clamps at zero, never negative
+            assert q.hbm_bytes == 0
+        b.allocate(512)  # unbound thread: budget moves, no attribution
+        assert q.hbm_bytes == 0
+        b.free(512)
+        q.close()
+    finally:
+        HbmBudget.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# load shedding: overload path, queue-full path, chaos site
+# ---------------------------------------------------------------------------
+
+def test_overload_sheds_lowest_running_class():
+    """All slots held by background while interactive waits past
+    shedAfterMs → the background victim's checkpoint raises
+    QueryShedError with a positive retry-after hint; sched.shed_total
+    counts it under its class."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent, sched.shed_after_ms = 1, 150.0
+    before = _counter("sched.shed_total")
+    errs, order = {}, []
+    started = threading.Event()
+
+    def victim():
+        from spark_rapids_tpu.serving import query_context as qlc
+
+        def body():
+            # submit_and_run binds the context: the module checkpoint is
+            # exactly what real task boundaries call
+            started.set()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                qlc.checkpoint("test.loop")
+                time.sleep(0.02)
+
+        try:
+            with QueryContext("bg", "G", priority="background") as q:
+                sched.submit_and_run(q, body)
+        except QueryShedError as e:
+            errs["bg"] = e
+
+    t0 = threading.Thread(target=victim)
+    t0.start()
+    assert started.wait(10)
+    t1 = _submit_async(sched, "fg", "I", "interactive", order)
+    t0.join(timeout=15)
+    t1.join(timeout=15)
+    assert order == ["fg"]
+    e = errs.get("bg")
+    assert isinstance(e, QueryShedError)
+    assert e.retry_after_s > 0
+    assert _counter("sched.shed_total") == before + 1
+    events = [r["event"] for r in flight.snapshot()]
+    assert "query.shed" in events and "query.shed_unwound" in events
+
+
+def test_queue_full_sheds_lower_class_only():
+    """Queue-full backpressure is class-aware: a higher-class submission
+    sheds the youngest queued strictly-lower-class ticket and takes its
+    place; a same-class submission still gets typed QueryQueueFull."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent, sched.max_queue = 1, 1
+    sched.shed_after_ms = 0  # isolate the queue-full path
+    release = _occupy(sched, priority="interactive")
+    order, errs = [], {}
+    tq = _submit_async(sched, "g-queued", "G", "background", order,
+                       errs=errs)
+    time.sleep(0.2)  # g-queued fills the queue (bound 1)
+    ti = _submit_async(sched, "i1", "I", "interactive", order, errs=errs)
+    tq.join(timeout=10)  # the background victim unwinds without running
+    assert isinstance(errs.get("g-queued"), QueryShedError)
+    time.sleep(0.2)  # i1 now holds the only queue slot
+    with pytest.raises(QueryQueueFull):
+        with QueryContext("i2", "J", priority="interactive") as q:
+            sched.submit_and_run(q, lambda: order.append("i2"))
+    release()
+    ti.join(timeout=10)
+    assert order == ["i1"]
+    assert "g-queued" not in order
+
+
+def test_shed_chaos_io_error_degrades_to_queue_full():
+    """The chaos `sched.shed` site fires BEFORE any state change: an
+    io_error fails the shed attempt, the victim survives untouched, and
+    the queue-full submission degrades to typed QueryQueueFull."""
+    sched = QueryScheduler.get()
+    sched.max_concurrent, sched.max_queue = 1, 1
+    sched.shed_after_ms = 0
+    release = _occupy(sched, priority="interactive")
+    order, errs = [], {}
+    tq = _submit_async(sched, "g-queued", "G", "background", order,
+                       errs=errs)
+    time.sleep(0.2)
+    FaultInjector.get().force("sched.shed", "io_error", 1)
+    with pytest.raises(QueryQueueFull):
+        with QueryContext("i1", "I", priority="interactive") as q:
+            sched.submit_and_run(q, lambda: order.append("i1"))
+    FaultInjector.get().clear_forced()
+    events = [r["event"] for r in flight.snapshot()]
+    assert "query.shed_aborted" in events
+    release()
+    tq.join(timeout=10)  # the victim survived the failed shed and RAN
+    assert order == ["g-queued"]
+    assert "g-queued" not in errs
+
+
+# ---------------------------------------------------------------------------
+# front door: the QueryShed result contract
+# ---------------------------------------------------------------------------
+
+def _mk_session(cls, extra=None):
+    conf = {
+        "spark.sql.shuffle.partitions": "3",
+        "spark.rapids.tpu.query.priority": cls,
+        "spark.rapids.tpu.sched.maxConcurrentQueries": "1",
+        "spark.rapids.tpu.sched.shedAfterMs": "150",
+    }
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _agg_df(s, rows=2000):
+    data = [{"k": i % 20, "v": i} for i in range(rows)]
+    return s.createDataFrame(data, num_partitions=4).repartition(
+        3, "k").groupBy("k").sum("v")
+
+
+def test_front_door_returns_typed_queryshed_and_recovers():
+    """collect() on a shed query returns a typed QueryShed result (not an
+    exception) carrying class/reason/retry-after; the non-shed query's
+    result is bit-identical to the clean run; resubmission succeeds."""
+    bg = _mk_session("background")
+    fg = _mk_session("interactive")
+    bg_df, fg_df = _agg_df(bg), _agg_df(fg)
+    expected = sorted(fg_df.collect(), key=str)  # clean warm run
+    expected_bg = sorted(bg_df.collect(), key=str)
+    # stretch queries so the overload window is wide (chaos rides the
+    # session conf; process-wide injector)
+    FaultInjector.configure(RapidsConf(dict(_STRETCH_CHAOS)))
+    out = {}
+
+    def run_bg():
+        out["bg"] = bg_df.collect()
+
+    t = threading.Thread(target=run_bg)
+    t.start()
+    deadline = time.monotonic() + 10
+    while obs_metrics.active_query_count() == 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    fg_out = fg_df.collect()  # waits past shedAfterMs → sheds bg
+    t.join(timeout=30)
+    FaultInjector.reset_for_tests()
+    shed = out["bg"]
+    assert isinstance(shed, QueryShed), shed
+    assert shed.priority == "background"
+    assert shed.session == bg._session_id
+    assert shed.reason.startswith("shed")
+    assert 0 < shed.retry_after_s <= 30
+    assert sorted(fg_out, key=str) == expected  # bit-identical non-shed
+    # the shed tenant retries after the hint and SUCCEEDS (chaos off,
+    # no contention): the unwind left the query re-runnable
+    assert sorted(bg_df.collect(), key=str) == expected_bg
+    bg.stop()
+    fg.stop()
+
+
+def test_shed_rounds_leak_free_under_chaos():
+    """Satellite: repeated shed rounds through real sessions with the
+    chaos `sched.shed` site armed (latency kind) — zero growth in
+    cleaner-tracked resources, HBM, and semaphore permits across
+    rounds (the PR 11 leak assertions)."""
+    bg = _mk_session("background")
+    fg = _mk_session("interactive")
+    bg_df, fg_df = _agg_df(bg), _agg_df(fg)
+    expected = sorted(fg_df.collect(), key=str)
+    sorted(bg_df.collect(), key=str)  # warm both paths
+    before = _resource_baseline()
+    sheds = 0
+    for _round in range(2):
+        FaultInjector.configure(RapidsConf(dict(
+            _STRETCH_CHAOS,
+            **{"spark.rapids.tpu.test.chaos.sites":
+                "query.cancel,sched.shed"})))
+        out = {}
+
+        def run_bg():
+            out["bg"] = bg_df.collect()
+
+        t = threading.Thread(target=run_bg)
+        t.start()
+        deadline = time.monotonic() + 10
+        while obs_metrics.active_query_count() == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        fg_out = fg_df.collect()
+        t.join(timeout=30)
+        FaultInjector.reset_for_tests()
+        assert sorted(fg_out, key=str) == expected
+        if isinstance(out["bg"], QueryShed):
+            sheds += 1
+        _assert_resource_baseline(before)
+    assert sheds >= 1  # the shed path actually exercised
+    bg.stop()
+    fg.stop()
+
+
+# ---------------------------------------------------------------------------
+# N=16 soak (CI_FULL tier): the ISSUE acceptance bar
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_n16_soak_interactive_p95_protected_bit_identical():
+    """16 tenants (interactive/batch/background round-robin) × mixed
+    queries through the real admission path: background floods are shed,
+    interactive p95 stays within a fixed bound of its unloaded value,
+    every completed (non-shed) result is bit-identical to the clean
+    single-tenant run, and resources return to baseline."""
+    N, REPS = 16, 2
+    classes = ["interactive", "batch", "background"]
+
+    def queries(s, i):
+        # the FLOOD is real work: batch/background tenants run ~6x the
+        # interactive row count, so they hold admission slots long
+        # enough that a warm-cache run still saturates the device and
+        # overload protection actually fires
+        n = 1200 if classes[i % 3] == "interactive" else 7000
+        rows = [{"k": (j * 7 + i) % 13, "v": j * 3 - 40}
+                for j in range(n)]
+        fd = s.createDataFrame(rows, num_partitions=4)
+        return [fd.repartition(3, "k").groupBy("k").sum("v"),
+                fd.filter(fd["v"] > 0).groupBy("k").sum("v")]
+
+    # clean baselines, one tenant at a time (chaos off: these are the
+    # bit-identity references)
+    baselines = []
+    for i in range(N):
+        s = TpuSession({"spark.sql.shuffle.partitions": "3"})
+        baselines.append([sorted(q.collect(), key=str)
+                          for q in queries(s, i)])
+        s.stop()
+
+    # every timed run below — unloaded AND loaded — is stretched by the
+    # same latency chaos at the checkpoint site, so (a) queries run long
+    # enough that a 16-tenant flood genuinely saturates the 4 slots and
+    # sheds fire, and (b) the p95 comparison is apples-to-apples. The
+    # chaos conf rides the SESSION confs (a chaos-less session conf
+    # re-arms the process injector off — the maybe_configure hook).
+    unloaded_walls = []
+    s = TpuSession(dict(_STRETCH_CHAOS,
+                        **{"spark.sql.shuffle.partitions": "3"}))
+    for _rep in range(3):
+        for q in queries(s, 0):
+            t0 = time.perf_counter()
+            q.collect(timeout=300)
+            unloaded_walls.append(time.perf_counter() - t0)
+    s.stop()
+    unloaded_walls.sort()
+    p95_unloaded = unloaded_walls[int(0.95 * (len(unloaded_walls) - 1))]
+
+    # the correctness assertions (no errors, bit-identity, interactive
+    # never shed, resource baseline) hold on EVERY attempt; the two
+    # TIMING expectations (the flood actually shed something, loaded p95
+    # within its bound) depend on thread scheduling on a shared box, so
+    # a miss there alone retries the load generation once before failing
+    for attempt in range(2):
+        before = _resource_baseline()
+        sessions = [
+            TpuSession(dict(_STRETCH_CHAOS, **{
+                "spark.sql.shuffle.partitions": "3",
+                "spark.rapids.tpu.query.priority": classes[i % 3],
+                "spark.rapids.tpu.sched.maxConcurrentQueries": "4",
+                "spark.rapids.tpu.sched.shedAfterMs": "150",
+            })) for i in range(N)]
+        barrier = threading.Barrier(N)
+        walls = {c: [] for c in classes}
+        sheds = {c: 0 for c in classes}
+        mismatches = []
+        errors = {}
+
+        def run(i):
+            cls = classes[i % 3]
+            try:
+                barrier.wait(timeout=60)
+                for _rep in range(REPS):
+                    for qi, q in enumerate(queries(sessions[i], i)):
+                        t0 = time.perf_counter()
+                        out = q.collect(
+                            timeout=300 if cls == "interactive" else None)
+                        if isinstance(out, QueryShed):
+                            sheds[cls] += 1
+                            time.sleep(min(out.retry_after_s, 0.2))
+                            continue
+                        walls[cls].append(time.perf_counter() - t0)
+                        if sorted(out, key=str) != baselines[i][qi]:
+                            mismatches.append((i, qi))
+            except BaseException as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        FaultInjector.reset_for_tests()
+        assert not errors, errors
+        assert not mismatches, mismatches  # bit-identical non-shed results
+        # the protected class is NEVER shed (overload only sheds
+        # STRICTLY below the starved waiter's class) — structural, no
+        # retry
+        assert sheds["interactive"] == 0
+        iw = sorted(walls["interactive"])
+        assert iw, "no interactive query completed"
+        p95_loaded = iw[int(0.95 * (len(iw) - 1))]
+        _assert_resource_baseline(before)
+        for s in sessions:
+            s.stop()
+        # timing expectations: the flood was real (lower-class work got
+        # shed) and the SLO bound held — loaded interactive p95 within a
+        # fixed multiple + constant of unloaded (generous for shared-CI
+        # jitter, but far below the unbounded starvation this feature
+        # exists to prevent)
+        flood_real = sheds["background"] + sheds["batch"] >= 1
+        slo_held = p95_loaded <= p95_unloaded * 12 + 3.0
+        if flood_real and slo_held:
+            break
+    else:
+        assert sheds["background"] + sheds["batch"] >= 1, sheds
+        assert p95_loaded <= p95_unloaded * 12 + 3.0, \
+            (p95_loaded, p95_unloaded)

@@ -10,8 +10,10 @@ summary line under ``parsed``) and ``MULTICHIP_r0N.json``
 (``parsed.queries.<q>`` per-query records) are understood; the tool walks
 the parsed payload collecting every throughput-shaped metric
 (``*rows_per_s`` / ``*rows_s`` / ``*Mrows_s`` / ``*speedup*`` /
-``*scaling_efficiency`` / ``*hit_rate`` — higher is better; with
-``--include-overhead`` also ``dispatch_overhead_ms`` — lower is better)
+``*scaling_efficiency`` / ``*hit_rate`` — higher is better; the serving
+stage's SLO latency keys ``serving_*p95_ms`` — lower is better, gated by
+default; with ``--include-overhead`` also ``dispatch_overhead_ms`` —
+lower is better)
 and compares NEW against OLD per key. A metric that degraded beyond
 ``--threshold`` (default 10%) is a REGRESSION; any regression exits
 non-zero, so a driver round gates automatically against the previous one:
@@ -59,6 +61,15 @@ _MULTICHIP_LOWER_RE = re.compile(
     r"(phases_ms\.(staging|launch|collective_wait|compact)"
     r"|collective_ms(_total)?|collective_phases_ms_total"
     r"|dict_encode_ms(_total)?)$")
+#: serving SLO latency keys (bench serving stage, docs/serving.md):
+#: LOWER is better and gated by DEFAULT — interactive p95 regressing under
+#: the same load IS the SLO regression this stage exists to catch. The
+#: aggregate serving_n*_rows_per_s keys gate higher-is-better via
+#: _HIGHER_RE like every other throughput key; serving_n16_shed_total
+#: matches neither direction on purpose (the shed count tracks timing
+#: jitter, not quality — both "more shedding" and "less shedding" can
+#: accompany a healthy round).
+_SERVING_LOWER_RE = re.compile(r"serving_.*(p95|p99)_ms$")
 #: r07 fused-dataplane keys that must NEVER gate in either direction:
 #: staging_reuse_hits counts staging-pool reuse (it scales with how many
 #: exchanges the round ran, not with data-plane quality) and
@@ -92,6 +103,8 @@ def extract_metrics(parsed, include_overhead=False):
             continue
         if _HIGHER_RE.search(path):
             out[path] = (v, True)
+        elif _SERVING_LOWER_RE.search(path):
+            out[path] = (v, False)
         elif multichip and _MULTICHIP_LOWER_RE.search(path):
             out[path] = (v, False)
         elif include_overhead and _LOWER_RE.search(path):
