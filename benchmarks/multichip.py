@@ -9,9 +9,12 @@ results and O(exchanges) collective launches, then prints ONE compact
 parseable JSON summary line LAST (per-chip rows/s, collective-time
 breakdown, scaling efficiency vs 1 chip).
 
-Queries are written with explicit column pruning (`select` before
-joins/aggregations, as Spark's optimizer would produce): exchanges carry
-only referenced columns. String-carrying exchanges (q1's group keys,
+Queries are written WITHOUT hand-pruning selects since ISSUE 17: the
+logical optimizer's column-pruning pass (plan/optimizer.py, on by
+default) narrows every exchange to the referenced columns the way the
+hand-written `select`s used to — run() asserts per record that the
+planner-pruned plans still run bit-identically with ZERO per-map
+exchange fallbacks. String-carrying exchanges (q1's group keys,
 q18's final c_name aggregation) ride the collective too since the
 dictionary-encode pass landed (`spark.rapids.tpu.exchange.
 dictionaryEncode.enabled`): the fabric moves int32 codes plus one
@@ -60,17 +63,16 @@ def _q1(rows: int, parts: int):
 
 
 def _q3(rows: int, parts: int):
-    """TPC-H q3 with optimizer-style column pruning: every exchange payload
-    is fixed-width (keys/dates/doubles), so the whole query rides the
+    """TPC-H q3, unpruned: the optimizer's ColumnPruning pass narrows the
+    scans and exchange payloads to keys/dates/doubles (what the hand-
+    written selects did through r07), so the whole query rides the
     collective data plane."""
     def build(s):
         import spark_rapids_tpu.functions as F
         t = _tpch_tables(s, rows, parts)
-        cust = (t["customer"].filter(F.col("c_mktsegment") == "BUILDING")
-                .select("c_custkey"))
-        orders = t["orders"].select("o_orderkey", "o_custkey", "o_orderdate")
-        li = t["lineitem"].select("l_orderkey", "l_extendedprice",
-                                  "l_discount")
+        cust = t["customer"].filter(F.col("c_mktsegment") == "BUILDING")
+        orders = t["orders"]
+        li = t["lineitem"]
         return (cust.join(orders, on=cust["c_custkey"] == orders["o_custkey"])
                 .join(li, on=orders["o_orderkey"] == li["l_orderkey"])
                 .withColumn("revenue",
@@ -84,17 +86,18 @@ def _q3(rows: int, parts: int):
 
 
 def _q18(rows: int, parts: int):
-    """TPC-H q18, pruned but FAITHFUL on the group keys: the final
+    """TPC-H q18, unpruned and FAITHFUL on the group keys: the final
     aggregation groups on c_name + c_custkey like the spec query — the
     c_name string payload rides the collective as dictionary codes (the
-    r06 round had to substitute c_custkey to stay fixed-width)."""
+    r06 round had to substitute c_custkey to stay fixed-width). Column
+    pruning is the optimizer's job now, including the lineitem relation
+    referenced on BOTH join branches."""
     def build(s):
         import spark_rapids_tpu.functions as F
         t = _tpch_tables(s, rows, parts)
-        li = t["lineitem"].select("l_orderkey", "l_quantity")
-        orders = t["orders"].select("o_orderkey", "o_custkey",
-                                    "o_orderdate", "o_totalprice")
-        cust = t["customer"].select("c_custkey", "c_name")
+        li = t["lineitem"]
+        orders = t["orders"]
+        cust = t["customer"]
         big = (li.groupBy("l_orderkey")
                .agg(F.sum(F.col("l_quantity")).alias("total_qty"))
                .filter(F.col("total_qty") > 150))
@@ -112,19 +115,17 @@ def _q18(rows: int, parts: int):
 
 
 def _tpcds_q3(rows: int, parts: int):
-    """TPC-DS q3 sample, pruned to fixed-width exchange payloads (brand id
-    instead of the brand string in the group keys; the name resolves from
-    item downstream in a real report)."""
+    """TPC-DS q3 sample, unpruned: the optimizer narrows the exchange
+    payloads to fixed width (the group keys use the brand ID, not the
+    brand string; the name resolves from item downstream in a real
+    report)."""
     def build(s):
         import benchmarks.tpcds as tpcds
         import spark_rapids_tpu.functions as F
         t = tpcds.load_tables(s, rows, parts=parts)
-        ss = t["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
-                                     "ss_ext_sales_price")
-        item = (t["item"].filter(F.col("i_manufact_id").between(100, 250))
-                .select("i_item_sk", "i_brand_id"))
-        nov = (t["date_dim"].filter(F.col("d_moy") == 11)
-               .select("d_date_sk", "d_year"))
+        ss = t["store_sales"]
+        item = t["item"].filter(F.col("i_manufact_id").between(100, 250))
+        nov = t["date_dim"].filter(F.col("d_moy") == 11)
         return (ss.join(nov, on=ss["ss_sold_date_sk"] == nov["d_date_sk"])
                 .join(item, on=ss["ss_item_sk"] == item["i_item_sk"])
                 .groupBy("d_year", "i_brand_id")
@@ -173,6 +174,16 @@ def run(n_devices: int, rows: int) -> dict:
         try:
             rec = run_mesh_query(name, build, n_devices=n_devices,
                                  extra_conf=extra)
+            # ISSUE 17 gate: the hand-written pruning selects are gone —
+            # the optimizer-pruned plans must STILL run bit-identically
+            # over the collective plane with zero per-map fallbacks
+            assert rec["bit_identical"], \
+                f"{name}: optimizer-pruned plan not bit-identical"
+            assert rec["collective_launches_O_exchanges"], \
+                f"{name}: collective launches not O(exchanges)"
+            assert not rec["per_map_reasons"], \
+                (f"{name}: per-map exchange fallbacks after optimizer "
+                 f"pruning: {rec['per_map_reasons']}")
             records.append(rec)
             input_rows[name] = n_rows
         except Exception as e:  # noqa: BLE001 — keep later stages alive

@@ -32,7 +32,9 @@ echo "== tracelint (trace-safety & registry consistency) =="
 # lock, the declared lock order, chaos coverage of unwind paths), and the
 # TL03x jit-discipline passes (cache-key stability, static-shape
 # bucketing, trace purity, donated-buffer safety over every
-# cached-program surface). Fails on any finding not in
+# cached-program surface, plus TL034: the plan-cache fingerprint
+# builders in serving/ — pinned identity only, no per-query values,
+# live conf reads or bare schema objects). Fails on any finding not in
 # tools/tracelint_baseline.txt. The docs-drift gate above doubles as the
 # freshness gate for the analyzer-sourced execution-mode column in
 # docs/supported_ops.md.
@@ -75,7 +77,13 @@ echo "== fast tier-1 gate (not slow) =="
 # plus the SLO serving layer (docs/serving.md: class precedence/EDF/
 # aging/quota ordering, typed QueryShed front door, sched.shed chaos,
 # leak-free shed rounds — the N=16 soak is slow-marked and rides the
-# CI_FULL full suite), with the slow markers excluded.
+# CI_FULL full suite), and the repeated-query hot path (docs/serving.md
+# "Plan cache & logical optimizer": fingerprint collision/punch-out
+# semantics, hit/re-bind bit-identity incl. pushed parquet filters,
+# conf/fileset/relation invalidation, LRU bounds, cross-session sharing,
+# plus the optimizer oracle — every pass vs rules-off ground truth on
+# TPC-H/TPC-DS shapes and the per-rule off-switches), with the slow
+# markers excluded.
 python -m pytest \
   tests/test_opjit_cache.py tests/test_stage_fusion.py \
   tests/test_pipelined_shuffle.py tests/test_basic_ops.py \
@@ -86,6 +94,7 @@ python -m pytest \
   tests/test_mesh_profile.py tests/test_query_lifecycle.py \
   tests/test_string_pipeline.py tests/test_aqe_skew.py \
   tests/test_env_skips.py tests/test_recompile_stability.py \
+  tests/test_plan_cache.py tests/test_logical_optimizer.py \
   -x -q -m 'not slow' -p no:cacheprovider
 
 echo "== serving-stage smoke (N=4, small rows) =="
@@ -99,6 +108,29 @@ r = serving.run(4, rows=1 << 10, reps=1)
 assert not r.get("errors"), r["errors"]
 print("ok: %.0f rows/s aggregate, %d shed" % (
     r["rows_per_s"], r["shed_total"]))
+EOF
+
+echo "== hot-repeat smoke (plan cache on the bench hot path) =="
+# The bench hot_repeat stage at tiny scale (docs/serving.md "Plan cache
+# & logical optimizer"): literal-varying q6/q3 resubmissions must hit
+# the scheduler-owned plan cache deterministically (1 miss + iters-1
+# hits per shape) and the warm path must beat the cold plan. The <10%
+# planning-share done-bar is gated at REAL scale by tools/bench_diff.py
+# (hot_repeat_planning_share_pct, lower-is-better) — at 4K rows the
+# ~2 ms hit-path re-bind dominates a ~15 ms query, so the smoke checks
+# cache behavior, not the share.
+python - <<'EOF'
+import bench
+r = bench._hot_repeat(bench._lineitem_table(1 << 12), iters=4,
+                      q3_rows=1 << 12)
+for q in ("q6", "q3_compiled"):
+    s = r[q]
+    assert s["plan_cache_misses"] == 1, (q, s)
+    assert s["plan_cache_hits"] == 3, (q, s)
+    assert s["steady_ms"] <= s["first_ms"], (q, s)
+assert r["hit_rate"] == 0.75, r["hit_rate"]
+print("ok: hit_rate=%.2f share=%.1f%% warm_p50=%.0fms" % (
+    r["hit_rate"], r["planning_share_pct"], r["warm_p50_ms"]))
 EOF
 
 echo "== chaos tier (fixed-seed fault injection) =="

@@ -22,6 +22,9 @@ Public surface for tools/tracelint.py, tools/gen_docs.py and the tests:
   cached-program surfaces: cache-key stability (TL030), static-shape
   bucketing (TL031), trace purity (TL032), donated-buffer safety
   (TL033).
+* :func:`lint_plan_key_tree` — plan-cache key stability over serving/:
+  unpinned identity, per-query values, live conf reads and bare schema
+  objects inside fingerprint/``*_sig`` builders (TL034).
 * :func:`corroborate` — dynamic ``jax.eval_shape`` probe vs the static
   verdicts (TL005).
 * :func:`scan_source` / :func:`scan_function` — detector layer over raw
@@ -36,7 +39,8 @@ from .astwalk import (CONDITIONAL_HOST, DEVICE, HOST, UNTRACEABLE, Detection,
                       FunctionReport, ModuleIndex, worst)
 from .concurrency import lint_module_source, lint_tree
 from .detectors import DETECTOR_IDS, scan_function, scan_source
-from .jitlint import lint_jit_module, lint_jit_tree
+from .jitlint import (lint_jit_module, lint_jit_tree, lint_plan_key_module,
+                      lint_plan_key_tree)
 from .lifecycle import lint_lifecycle_module, lint_lifecycle_tree
 from .locks import LOCK_ORDER, lint_locks_module, lint_locks_tree
 from .obslint import lint_obs_module, lint_obs_tree
@@ -51,7 +55,8 @@ __all__ = [
     "execution_modes", "lint_jit_module", "lint_jit_tree",
     "lint_lifecycle_module", "lint_lifecycle_tree",
     "lint_locks_module", "lint_locks_tree", "lint_module_source",
-    "lint_obs_module", "lint_obs_tree", "lint_sync_module",
+    "lint_obs_module", "lint_obs_tree", "lint_plan_key_module",
+    "lint_plan_key_tree", "lint_sync_module",
     "lint_sync_tree", "lint_tree", "scan_function", "scan_source", "worst",
 ]
 

@@ -1,4 +1,4 @@
-"""jitlint: program-cache & dispatch-discipline analyzer (TL030–TL033).
+"""jitlint: program-cache & dispatch-discipline analyzer (TL030–TL034).
 
 The engine's performance contract — ONE cached program per operator
 forest / exchange / row group, O(exchanges) collective launches, donated
@@ -70,9 +70,34 @@ positions cannot be resolved statically is not tracked (opjit's generic
 ``_cached_call``/``_dispatch`` plumbing guards donated dispatches
 dynamically and is modeled explicitly instead).
 
-All four report one finding per (rule, function) with line numbers in the
+**TL034 plan-cache key surface** — the scheduler-owned PLAN cache
+(``serving/plan_cache.py``) keys finished physical plans, so its
+fingerprint builders get the same scrutiny as program-cache keys but
+with a different sanction rule.  Inside every ``fingerprint``/``*_sig``
+function under ``serving/``, flagged:
+
+* ``id(...)``/``hash(...)`` of an object NOT pinned by the entry —
+  identity is only stable while the object is alive, so the sanctioned
+  idiom records the object (or its id) in a ``pins``/``rel_ids``
+  container the entry keeps (``rel_ids.append(id(plan))``, the mesh
+  token next to ``pins.append(mesh)``); unpinned identity is the TL030
+  bug with a longer fuse;
+* per-query values, wall-clock reads, per-call randomness — unbounded
+  cardinality;
+* live conf reads (``conf.get(...)``) inside a key builder — key off
+  the pre-filtered ``plan_relevant_conf`` items so every fingerprinted
+  axis is visible in one place (the TL032 bug class: a conf read at
+  build time but absent from the key silently reuses stale plans);
+* bare schema-ish objects (``output``/``attrs``/``schema``/``fields``)
+  fed to key material (f-strings, token appends, hashing) without a
+  ``_attrs_sig``/``_safe_repr`` wrapper — default reprs carry expr_ids
+  and addresses, so the "signature" changes per plan object.
+
+All five report one finding per (rule, function) with line numbers in the
 message, keyed ``relpath::qualname`` — stable under reformatting, same
-baseline machinery as every other tracelint pass.
+baseline machinery as every other tracelint pass.  TL030–TL033 cover the
+JIT surfaces (``lint_jit_tree``); TL034 covers ``serving/``
+(``lint_plan_key_tree``).
 """
 
 from __future__ import annotations
@@ -1225,6 +1250,169 @@ def _containing_block(fn: ast.FunctionDef, stmt: ast.stmt
 
     visit(fn.body)
     return result
+
+
+# ---------------------------------------------------------------------------
+# TL034 — plan-cache key surface
+# ---------------------------------------------------------------------------
+
+#: subpackages holding plan-fingerprint builders (the scheduler-owned
+#: plan cache) — a separate surface from JIT_SUBPACKAGES because the
+#: sanction rules differ (pinned identity is legal here, see below)
+PLAN_KEY_SUBPACKAGES: Tuple[str, ...] = ("serving",)
+
+#: a function that BUILDS plan-cache key material: the fingerprint
+#: entry point and every ``*_sig`` helper it composes
+_PLAN_KEY_FN = re.compile(r"(?:^|_)fingerprint(?:$|_)|sig$", re.I)
+
+#: a container that PINS objects for the lifetime of a cache entry —
+#: identity recorded alongside an append to one of these is stable
+#: (the entry keeps the object alive, so its id() can never be recycled)
+_PIN_CONTAINER = re.compile(r"rel_ids|pins|pinned", re.I)
+
+#: a bare schema-ish collection (attribute lists carry expr_ids and
+#: default reprs) — must pass through a ``*_sig``/``_safe_repr`` wrapper
+#: before landing in key material
+_SCHEMA_NAME = re.compile(r"(?:^|_)(?:schema|output|attrs|fields)$", re.I)
+
+
+def _pin_sanctioned_names(fn: ast.FunctionDef) -> Set[str]:
+    """Dotted names whose identity is pinned by this function: the `x` of
+    ``pins.append(x)`` / ``rel_ids.append(id(x))`` / ``pins = [x, ...]``.
+    ``id(x)`` for a pinned `x` is the sanctioned identity-fingerprint
+    idiom (plan_cache._node_sig / fingerprint's mesh token)."""
+    out: Set[str] = set()
+
+    def record(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Call) and _last(_call_name(arg)) == "id" \
+                and arg.args:
+            arg = arg.args[0]
+        name = _dotted(arg)
+        if name:
+            out.add(name)
+
+    for st in _walk_no_defs(fn):
+        if isinstance(st, ast.Call) and isinstance(st.func, ast.Attribute) \
+                and st.func.attr in ("append", "add") \
+                and _PIN_CONTAINER.search(_dotted(st.func.value)):
+            for a in st.args:
+                record(a)
+        elif isinstance(st, (ast.Assign, ast.AnnAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            value = st.value
+            if value is None or not isinstance(value, (ast.List, ast.Tuple)):
+                continue
+            if any(_PIN_CONTAINER.search(_dotted(t)) for t in targets):
+                for el in value.elts:
+                    record(el)
+    return out
+
+
+def _key_material_values(fn: ast.FunctionDef) -> List[ast.AST]:
+    """Expressions that land in this function's key material: values
+    formatted into f-strings, args appended to token/part lists, and args
+    of hashing calls."""
+    out: List[ast.AST] = []
+    for st in _walk_no_defs(fn):
+        if isinstance(st, ast.FormattedValue):
+            out.append(st.value)
+        elif isinstance(st, ast.Call):
+            name = _call_name(st)
+            if isinstance(st.func, ast.Attribute) \
+                    and st.func.attr in ("append", "extend", "join") \
+                    and not _PIN_CONTAINER.search(_dotted(st.func.value)):
+                out.extend(st.args)
+            elif name.startswith("hashlib.") \
+                    or _last(name) in ("sha1", "sha256", "md5", "blake2b"):
+                out.extend(st.args)
+    return out
+
+
+def _lint_plan_key_fn(fn: ast.FunctionDef, relpath: str,
+                      qual_prefix: str = "") -> List[Finding]:
+    if not _PLAN_KEY_FN.search(fn.name):
+        return []
+    pinned = _pin_sanctioned_names(fn)
+    issues: List[Tuple[int, str]] = []
+    for node in _walk_no_defs(fn):
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            last = _last(name)
+            if last in ("id", "hash") and node.args:
+                arg = _dotted(node.args[0])
+                if not arg or arg not in pinned:
+                    issues.append((
+                        line, f"unpinned identity {last}({arg or '...'}) — "
+                        "identity may only key plan-cache material when the "
+                        "object is pinned by the entry (rel_ids/pins)"))
+            elif name.startswith(_CLOCK_PREFIXES) or last in _CLOCK_CALLS:
+                issues.append((line, f"wall-clock read {name}(...)"))
+            elif name.startswith(("uuid.", "random.", "np.random.",
+                                  "numpy.random.")):
+                issues.append((line, f"per-call random value {name}(...)"))
+            elif last == "get" and isinstance(node.func, ast.Attribute) \
+                    and "conf" in _dotted(node.func.value).lower():
+                issues.append((
+                    line, "live conf read "
+                    f"{_dotted(node.func.value)}.get(...) inside a key "
+                    "builder — key off the pre-filtered plan_relevant_conf "
+                    "items instead"))
+        elif isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            ident = node.id if isinstance(node, ast.Name) else node.attr
+            if _PER_QUERY_NAME.search(ident):
+                issues.append((line, f"per-query value '{ident}' — "
+                               "unbounded cardinality, the cache leaks"))
+    for value in _key_material_values(fn):
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            ident = value.id if isinstance(value, ast.Name) else value.attr
+            if _SCHEMA_NAME.search(ident):
+                issues.append((
+                    getattr(value, "lineno", 0),
+                    f"un-fingerprinted schema object '{_dotted(value)}' in "
+                    "key material — wrap it (_attrs_sig/_safe_repr) so the "
+                    "signature is value-stable, not repr-of-the-moment"))
+    if not issues:
+        return []
+    issues = sorted(set(issues))
+    detail = "; ".join(f"line {ln}: {msg}" for ln, msg in issues)
+    return [Finding(
+        "TL034", "error", f"{relpath}::{qual_prefix}{fn.name}",
+        f"unstable plan-cache key component(s): {detail} — plan "
+        f"fingerprints must be value-stable and bounded (structural "
+        f"signatures + plan-relevant conf items; identity only when "
+        f"entry-pinned); see docs/analysis.md cache-key design rules")]
+
+
+def lint_plan_key_module(source: str, relpath: str) -> List[Finding]:
+    """TL034 findings for one module's source."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return findings
+    for st in tree.body:
+        if isinstance(st, ast.FunctionDef):
+            findings.extend(_lint_plan_key_fn(st, relpath))
+        elif isinstance(st, ast.ClassDef):
+            for sub in st.body:
+                if isinstance(sub, ast.FunctionDef):
+                    findings.extend(_lint_plan_key_fn(
+                        sub, relpath, qual_prefix=f"{st.name}."))
+    return findings
+
+
+def lint_plan_key_tree(root: Optional[str] = None,
+                       subpackages: Tuple[str, ...] = PLAN_KEY_SUBPACKAGES
+                       ) -> List[Finding]:
+    """Lint the plan-cache key surface of the shipped tree."""
+    from .astwalk import iter_module_sources
+    findings: List[Finding] = []
+    for relpath, src in iter_module_sources(root, subpackages):
+        findings.extend(lint_plan_key_module(src, relpath))
+    return findings
 
 
 # ---------------------------------------------------------------------------

@@ -51,7 +51,8 @@ from typing import List, Optional, Tuple
 from .registry_check import Finding
 
 #: packages the lint covers (relative to the spark_rapids_tpu package root)
-OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory", "parallel")
+OBS_SUBPACKAGES: Tuple[str, ...] = ("execs", "shuffle", "memory", "parallel",
+                                    "serving")
 
 #: individual modules additionally covered: obs/mesh_profile.py is part of
 #: the obs package but is itself an EMITTER (registry histograms, flight

@@ -982,6 +982,45 @@ OPTIMIZER_TRANSITION_ROW_COST = _conf(
     "boundary. Kept low by default: every pipeline here starts host-side, "
     "so the upload edge is priced as one amortized copy, not a per-operator "
     "penalty.").double(0.00002)
+LOGICAL_COLUMN_PRUNING = _conf(
+    "spark.rapids.tpu.optimizer.columnPruning.enabled").doc(
+    "Logical column pruning: the planner inserts projections restricted "
+    "to the columns an operator's ancestors actually reference, so "
+    "exchanges carry fixed-width/dict-coded payloads without hand-written "
+    "selects (docs/serving.md \"Plan cache & logical optimizer\")."
+).boolean(True)
+LOGICAL_PUSHDOWN = _conf(
+    "spark.rapids.tpu.optimizer.pushdown.enabled").doc(
+    "Logical filter/projection pushdown through explicit exchanges "
+    "(hash-partitioned Repartition) and pure-rename projections, so rows "
+    "are dropped before they are shuffled."
+).boolean(True)
+LOGICAL_JOIN_STRATEGY = _conf(
+    "spark.rapids.tpu.optimizer.joinStrategy.enabled").doc(
+    "Cost-based build-side choice: swap a join's inputs when the "
+    "row-count estimate (plan/cbo.py RowCountPlanVisitor) says the left "
+    "side is much smaller than the right, so the smaller side becomes "
+    "the build/broadcast side (reference CostBasedOptimizer.scala). The "
+    "original output column order is restored by a projection."
+).boolean(True)
+LOGICAL_JOIN_SWAP_RATIO = _conf(
+    "spark.rapids.tpu.optimizer.joinStrategy.swapRatio").doc(
+    "Hysteresis for the cost-based build-side swap: the estimated right "
+    "(build) side must exceed the left side by this factor before the "
+    "sides are swapped, so near-equal estimates (which are noisy) never "
+    "flip the plan shape."
+).double(1.5)
+PLAN_CACHE_ENABLED = _conf("spark.rapids.tpu.plan.cache.enabled").doc(
+    "Process-wide plan cache owned by the serving scheduler: a "
+    "normalized-logical-plan + schema + conf fingerprint maps to the "
+    "fully converted physical plan with literal parameter slots; hits "
+    "bypass physical planning and override conversion and only re-bind "
+    "literal slots (docs/serving.md \"Plan cache & logical optimizer\")."
+).boolean(True)
+PLAN_CACHE_MAX_ENTRIES = _conf("spark.rapids.tpu.plan.cache.maxEntries").doc(
+    "Plan-cache capacity; least-recently-used entries are evicted past "
+    "this bound."
+).integer(256)
 UDF_COMPILER_ENABLED = _conf("spark.rapids.sql.udfCompiler.enabled").doc(
     "Translate row python UDF bytecode into columnar device expressions "
     "where possible (reference udf-compiler/ LogicalPlanRules); "

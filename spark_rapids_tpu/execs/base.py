@@ -140,6 +140,80 @@ class TaskContext:
         self._completion_listeners.clear()
 
 
+import threading as _threading
+
+#: lock flavors replaced wholesale on clone (a clone must never serialize
+#: on — or deadlock with — the template's locks)
+_LOCK_TYPES = (type(_threading.Lock()), type(_threading.RLock()))
+
+
+def _rebind_value(v, rebind: dict):
+    """Parameter-slot re-binding for ONE attribute value: replace template
+    Literal objects (matched by identity) with this submission's literals,
+    recursing through lists/tuples/SortOrder. Expression.transform
+    preserves unchanged subtrees, so attributes and non-parameter
+    expressions stay shared with the template."""
+
+    def rule(e: Expression):
+        return rebind.get(id(e))
+
+    def walk(v):
+        if isinstance(v, Expression):
+            return v.transform(rule)
+        if isinstance(v, list):
+            return [walk(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(walk(x) for x in v)
+        if type(v).__name__ == "SortOrder":
+            nc = walk(v.child)
+            if nc is v.child:
+                return v
+            import copy
+            nv = copy.copy(v)
+            nv.child = nc
+            return nv
+        return v
+
+    return walk(v)
+
+
+def _rebind_plan_exprs(node: "PhysicalPlan", rebind: dict) -> None:
+    """Re-bind every expression attribute of one cloned node — projections,
+    filter conditions, pushed parquet filters, join keys, sort orders."""
+    for k, v in list(node.__dict__.items()):
+        if k in ("children", "metrics") or isinstance(v, dict):
+            continue
+        node.__dict__[k] = _rebind_value(v, rebind)
+
+
+def _clone_spec(spec, rebind, memo):
+    """Clone a compiled-stage spec object (classes marked ``_PLAN_SPEC``:
+    the compiled agg/join-agg stage patterns). Specs capture BOTH
+    expressions (filter/project layers, grouping, agg fns — which must see
+    re-bound literals, or a cache hit would execute the template
+    submission's parameter values) and nested PhysicalPlans (a join dim's
+    build subtree — which EXECUTES, so it must be this clone's copy, not
+    the template's). Nested plans go through the shared memo so spec links
+    and plan-tree links land on the same clones."""
+    import copy
+
+    def walk(v):
+        if isinstance(v, PhysicalPlan):
+            return v.clone_for_execution(rebind, memo)
+        if getattr(v, "_PLAN_SPEC", False):
+            nv = copy.copy(v)
+            for k, x in list(nv.__dict__.items()):
+                nv.__dict__[k] = walk(x)
+            return nv
+        if isinstance(v, (list, tuple)):
+            return type(v)(walk(x) for x in v)
+        if rebind:
+            return _rebind_value(v, rebind)
+        return v
+
+    return walk(spec)
+
+
 class PhysicalPlan:
     """Base physical operator."""
 
@@ -203,6 +277,121 @@ class PhysicalPlan:
         for i in ids:
             for batch in self.execute_partition(i, ctx_of(i)):
                 yield i, batch
+
+    # --- plan-cache clone protocol ----------------------------------------
+    def clone_for_execution(self, rebind: Optional[dict] = None,
+                            memo: Optional[dict] = None) -> "PhysicalPlan":
+        """Structural clone of the plan for ONE execution.
+
+        The plan cache (serving/plan_cache.py) stores a physical TEMPLATE
+        that never executes; every submission — hit or miss — runs a clone,
+        so per-query mutable state (metrics, shuffle ids, broadcast/
+        subquery memos, AQE specs) never crosses queries and cached plans
+        never pin device buffers. ``rebind`` maps ``id(template_literal)``
+        → replacement Literal (parameter-slot re-binding); ``memo`` keeps
+        shared subtrees (a reused exchange, the two sides of an AQE
+        coordinator) shared in the clone. Immutable planning products —
+        expressions, output attributes, conf snapshots — are shared with
+        the template; only execution state is fresh."""
+        if memo is None:
+            memo = {}
+        got = memo.get(id(self))
+        if got is not None:
+            return got
+        import copy
+        new = copy.copy(self)
+        memo[id(self)] = new
+        new.children = [c.clone_for_execution(rebind, memo)
+                        for c in self.children]
+        # plan-valued attrs OUTSIDE children carry expressions + execution
+        # state too: a fused segment's absorbed operator chain (`_ops`), a
+        # compiled stage's `fallback` subtree. The memo keeps nodes shared
+        # with the children (a fused join's rewired child links, a
+        # fallback's exchanges) pointing at the SAME clones.
+        for k, v in list(new.__dict__.items()):
+            if k == "children":
+                continue
+            if isinstance(v, PhysicalPlan):
+                new.__dict__[k] = v.clone_for_execution(rebind, memo)
+            elif isinstance(v, (list, tuple)) and v \
+                    and all(isinstance(x, PhysicalPlan) for x in v):
+                new.__dict__[k] = type(v)(
+                    x.clone_for_execution(rebind, memo) for x in v)
+            elif getattr(v, "_PLAN_SPEC", False):
+                # compiled-stage spec: expressions + nested dim plans live
+                # OUTSIDE the node's own attrs — clone/rebind through the
+                # same memo (see _clone_spec)
+                new.__dict__[k] = _clone_spec(v, rebind, memo)
+        new.metrics = {}
+        new._register_metrics()
+        if rebind:
+            _rebind_plan_exprs(new, rebind)
+        new._reset_execution_state(memo, rebind)
+        return new
+
+    def _reset_execution_state(self, memo: dict,
+                               rebind: Optional[dict] = None) -> None:
+        """Drop every piece of per-execution state copy.copy carried over.
+        Centralized by attribute convention rather than per-class overrides:
+        the attrs below are the complete set of cross-query memos in the
+        exec layer (exchange materialization, broadcast/subquery builds,
+        compiled-join dim caches, AQE reader specs, DPP subqueries)."""
+        import threading
+        d = self.__dict__
+        for k, v in list(d.items()):
+            if isinstance(v, _LOCK_TYPES):
+                d[k] = threading.Lock()
+        d.pop("_last_batch", None)
+        if "_shuffle_id" in d:           # _ExchangeBase materialization
+            d["_shuffle_id"] = None
+            d["_n_maps"] = 0
+            for k in ("_obs_parent", "_query_ctx", "_collective_rows",
+                      "_collective_sizes", "_close_dicts"):
+                d.pop(k, None)
+        if "_broadcast_done" in d:       # broadcast build-side memo
+            d["_broadcast_done"] = False
+            d["_broadcast_batch"] = None
+        if "_values" in d:               # subquery value memo
+            d["_values"] = None
+        if "_dims_built" in d:           # compiled-join dim-side memo
+            d["_dims_built"] = None
+        for k in ("_run_memo", "_join_memo"):
+            if k in d:                   # fused-segment planned-run memos:
+                d[k] = {}                # cached runs hold pre-rebind exprs
+        coord = d.get("coordinator")
+        if coord is not None and hasattr(coord, "_specs"):
+            # AQE join-reader coordinator: shared by BOTH sibling readers;
+            # clone it once (memo) pointing at the cloned exchanges
+            key = ("coordinator", id(coord))
+            nc = memo.get(key)
+            if nc is None:
+                import copy
+                nc = copy.copy(coord)
+                nc.left = coord.left.clone_for_execution(rebind, memo)
+                nc.right = coord.right.clone_for_execution(rebind, memo)
+                nc._specs = None
+                nc._lock = threading.Lock()
+                nc.skew_splits = 0
+                memo[key] = nc
+            d["coordinator"] = nc
+        if rebind and "pushed_filters" in d and "_arrow_filter" in d:
+            # pushed parquet filters were re-bound above, but the derived
+            # pyarrow filter bakes the literal VALUES — recompute it, or a
+            # hit would prune files/row groups with the PREVIOUS
+            # submission's probe values
+            from ..io.base_scan import arrow_filter_from_condition
+            d["_arrow_filter"] = arrow_filter_from_condition(
+                d["pushed_filters"])
+        opts = d.get("options")
+        if isinstance(opts, dict) and opts.get("__dpp_filters__"):
+            # DPP subqueries reference the join's build subtree: clone via
+            # the same memo so they execute the rebound build side, not the
+            # template's
+            opts = dict(opts)
+            opts["__dpp_filters__"] = [
+                (col, sq.clone_for_execution(rebind, memo))
+                for col, sq in opts["__dpp_filters__"]]
+            d["options"] = opts
 
     # --- plan utilities ---------------------------------------------------
     def tree_string(self, indent: int = 0) -> str:

@@ -130,6 +130,10 @@ def _fingerprint(e: Expression) -> str:
 class _StageSpec:
     """Extracted pattern: source → layers (bottom-up) → grouping/aggs."""
 
+    #: plan-cache clone protocol (execs/base.py _clone_spec): the spec's
+    #: layer expressions must see re-bound parameter literals on a hit
+    _PLAN_SPEC = True
+
     def __init__(self, source, layers, grouping, key_source_ordinals,
                  agg_fns, result_exprs, output, needed_source_ordinals):
         self.source = source

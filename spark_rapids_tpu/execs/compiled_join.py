@@ -79,6 +79,10 @@ class _DimSpec:
     into one monotone int64 composite at build time (per-key min/stride),
     so the probe stays a single searchsorted."""
 
+    #: plan-cache clone protocol (execs/base.py _clone_spec): the dim's
+    #: build subtree EXECUTES, so a cached-plan clone needs its own copy
+    _PLAN_SPEC = True
+
     def __init__(self, plan: PhysicalPlan, key_ordinals: List[int],
                  probe_locs: List, semi: bool):
         self.plan = plan
@@ -89,6 +93,9 @@ class _DimSpec:
 
 
 class _JoinStageSpec:
+    #: plan-cache clone protocol (execs/base.py _clone_spec)
+    _PLAN_SPEC = True
+
     def __init__(self, fact_source, fact_layers, fact_needed_source,
                  fact_output, dims, top_output, col_loc, top_layers,
                  grouping, group_dim, group_key_ordinals, agg_fns,

@@ -131,12 +131,23 @@ class CachedRelation(LogicalPlan):
         for b in self.batches:
             b.close()
         self.batches = []
+        _invalidate_cached_plans_for(self)
 
     def node_desc(self) -> str:
         disk = sum(1 for b in self.batches if b.on_disk)
         return (f"CachedRelation[{self.num_rows} rows, "
                 f"{len(self.batches)} batches, {self.compressed_bytes} bytes"
                 + (f", {disk} on disk" if disk else "") + "]")
+
+
+def _invalidate_cached_plans_for(relation) -> None:
+    """Cached physical plans capture the relation's batches by reference;
+    dropping the relation must drop those plans too or a hit would replay
+    freed data."""
+    from ..serving.scheduler import QueryScheduler
+    inst = QueryScheduler.peek()
+    if inst is not None:
+        inst.plan_cache.invalidate_relation(id(relation))
 
 
 class DeviceCachedRelation(LogicalPlan):

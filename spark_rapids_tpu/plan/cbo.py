@@ -76,6 +76,100 @@ class RowCountPlanVisitor:
         return child
 
 
+def estimate_logical_rows(plan) -> Optional[float]:
+    """Cardinality estimate over the LOGICAL plan (plan/logical.py nodes),
+    reusing RowCountPlanVisitor's selectivity defaults. Used by the logical
+    optimizer's cost-based join choice, where no physical plan exists yet.
+    Returns None when nothing about the subtree can be sized."""
+    import os
+    name = type(plan).__name__
+    children = [estimate_logical_rows(c) for c in plan.children]
+    child = children[0] if children else None
+    V = RowCountPlanVisitor
+    if name in ("LocalRelation", "CachedRelation"):
+        t = getattr(plan, "table", None)
+        return float(t.num_rows) if t is not None else None
+    if name == "DeviceCachedRelation":
+        n = getattr(plan, "num_rows", None)
+        n = n() if callable(n) else n
+        return float(n) if n is not None else None
+    if name == "Range":
+        try:
+            return float(max(0, (plan.end - plan.start) // plan.step))
+        except Exception:
+            return None
+    if name == "FileScan":
+        if plan.fmt == "parquet":
+            total_rows = 0
+            try:
+                import pyarrow.parquet as pq
+                for p in plan.paths:
+                    total_rows += pq.ParquetFile(p).metadata.num_rows
+                return float(total_rows)
+            except Exception:
+                pass
+        total = 0
+        for p in plan.paths:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                total += 1 << 20
+        return max(1.0, total / V.FILE_ROW_BYTES)
+    if child is None:
+        return None
+    if name == "Filter":
+        return child * V.FILTER_SELECTIVITY
+    if name == "Aggregate":
+        return max(1.0, child * V.AGG_RATIO)
+    if name == "Join":
+        sized = [c for c in children if c is not None]
+        return max(sized) if sized else None
+    if name == "Union":
+        return float(sum(c for c in children if c is not None))
+    if name == "Limit":
+        n = getattr(plan, "n", None)
+        return float(min(n, child)) if n is not None else child
+    if name == "Sample":
+        return child * getattr(plan, "fraction", 1.0)
+    return child
+
+
+#: per-dtype row-width heuristic for logical size estimates: fixed-width
+#: types by storage width, variable-width by a typical payload
+_VAR_WIDTH_BYTES = 24.0
+
+
+def _attr_width(dtype) -> float:
+    w = getattr(dtype, "byte_width", None)
+    if isinstance(w, (int, float)) and w > 0:
+        return float(w)
+    tname = type(dtype).__name__
+    if "Boolean" in tname or "Byte" in tname:
+        return 1.0
+    if "Short" in tname:
+        return 2.0
+    if "Int" in tname or "Float" in tname or "Date" in tname:
+        return 4.0
+    return _VAR_WIDTH_BYTES if ("String" in tname or "Binary" in tname
+                                or "Array" in tname or "Map" in tname
+                                or "Struct" in tname) else 8.0
+
+
+def estimate_logical_bytes(plan) -> Optional[float]:
+    """Estimated materialized size of a logical subtree's output: estimated
+    rows x per-dtype width of the output schema. Drives the build-side swap
+    and the broadcast-vs-shuffled fallback when ``estimated_size_bytes``
+    cannot size the physical build side."""
+    rows = estimate_logical_rows(plan)
+    if rows is None:
+        return None
+    try:
+        row_bytes = sum(_attr_width(a.dtype) for a in plan.output)
+    except Exception:
+        return None
+    return rows * max(1.0, row_bytes)
+
+
 def _op_weight(plan) -> float:
     """Relative per-row operator weight (joins/sorts/aggs cost more than
     projections; mirrors the reference's per-operator cost overrides)."""

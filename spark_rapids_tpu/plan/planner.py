@@ -117,6 +117,15 @@ def plan_physical(plan: L.LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
                                            estimated_size_bytes)
             threshold = conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
             r_size = estimated_size_bytes(right)
+            if r_size is None:
+                # broadcast-vs-shuffled decided by ESTIMATED size, not only
+                # a directly measurable build side: fall back to the CBO's
+                # logical cardinality estimate (reference
+                # CostBasedOptimizer.scala RowCountPlanVisitor)
+                from ..config import LOGICAL_JOIN_STRATEGY
+                from .cbo import estimate_logical_bytes
+                if conf.get(LOGICAL_JOIN_STRATEGY):
+                    r_size = estimate_logical_bytes(plan.right)
             _plan_dpp(plan, left, right, conf, threshold, r_size)
             if (threshold > 0 and r_size is not None and r_size <= threshold
                     and plan.join_type in BROADCAST_RIGHT_TYPES

@@ -180,6 +180,10 @@ class QueryScheduler:
         # EMA of completed-query wall seconds — the retry-after hint's
         # scale (GIL attr, monitoring-counter discipline)
         self._lat_ema_s = 0.5
+        # process-wide plan cache (serving/plan_cache.py): scheduler-owned
+        # so ALL session frontends share one cache; its own lock, never _mu
+        from .plan_cache import PlanCache
+        self.plan_cache = PlanCache()
 
     # --- lifecycle ----------------------------------------------------------
     @classmethod
@@ -193,6 +197,13 @@ class QueryScheduler:
         return inst
 
     @classmethod
+    def peek(cls) -> Optional["QueryScheduler"]:
+        """The live instance WITHOUT creating one (invalidation hooks must
+        not boot a scheduler just to find an empty cache)."""
+        with cls._cls_lock:
+            return cls._instance
+
+    @classmethod
     def reset_for_tests(cls) -> "QueryScheduler":
         global _SHARED_RELEASE_PENDING
         _SHARED_RELEASE_PENDING = False
@@ -204,9 +215,10 @@ class QueryScheduler:
         """Only EXPLICITLY SET sched keys overwrite the process state (the
         flight/mesh_profile maybe_configure pattern: a default-conf session
         must not silently resize another session's scheduler)."""
-        from ..config import (SCHED_CLASS_AGING_MS, SCHED_HBM_WATERMARK,
-                              SCHED_MAX_CONCURRENT, SCHED_MAX_QUEUE,
-                              SCHED_SHED_AFTER_MS, SCHED_TENANT_HBM_QUOTA)
+        from ..config import (PLAN_CACHE_MAX_ENTRIES, SCHED_CLASS_AGING_MS,
+                              SCHED_HBM_WATERMARK, SCHED_MAX_CONCURRENT,
+                              SCHED_MAX_QUEUE, SCHED_SHED_AFTER_MS,
+                              SCHED_TENANT_HBM_QUOTA)
         with self._mu:
             if conf.get_raw(SCHED_MAX_QUEUE.key) is not None:
                 self.max_queue = int(conf.get(SCHED_MAX_QUEUE))
@@ -222,6 +234,8 @@ class QueryScheduler:
                     conf.get(SCHED_TENANT_HBM_QUOTA))
             if conf.get_raw(SCHED_SHED_AFTER_MS.key) is not None:
                 self.shed_after_ms = float(conf.get(SCHED_SHED_AFTER_MS))
+        if conf.get_raw(PLAN_CACHE_MAX_ENTRIES.key) is not None:
+            self.plan_cache.configure(conf.get(PLAN_CACHE_MAX_ENTRIES))
 
     def shutdown(self) -> None:
         """Cancel everything queued or running (the owner-class release for
@@ -702,7 +716,8 @@ class QueryScheduler:
                     "shed_after_ms": self.shed_after_ms,
                     "queue_depth": self._queued,
                     "tenant_hbm_bytes": tenant_hbm,
-                    "running": running, "queued": queued}
+                    "running": running, "queued": queued,
+                    "plan_cache": self.plan_cache.stats()}
 
 
 # ---------------------------------------------------------------------------
@@ -723,13 +738,12 @@ def execute_plan(session, plan, timeout: Optional[float] = None,
 
     from ..config import (QUERY_PRIORITY, QUERY_RETRY_BUDGET,
                           QUERY_TIMEOUT_MS, TRACE_TAG)
-    from ..plan.overrides import TpuOverrides
-    from ..plan.planner import plan_physical
     from ..types import to_arrow as t2a
+    # ONE conf snapshot at submission: every later planning step (logical
+    # optimize, physical plan, override pass, plan-cache fingerprint) reads
+    # this frozen view, so a concurrent conf.set() can never produce a plan
+    # half-built under two conf views (GpuOverrides.scala:4565 analogue)
     conf = session._rapids_conf()
-    cpu_plan = plan_physical(plan, conf)
-    final = TpuOverrides.apply(cpu_plan, conf)
-    schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
     session._query_seq = getattr(session, "_query_seq", 0) + 1
     tag = conf.get(TRACE_TAG)
     stem = tag if tag and str(tag) != "None" else "query"
@@ -750,6 +764,21 @@ def execute_plan(session, plan, timeout: Optional[float] = None,
     cls = str(priority if priority is not None
               else conf.get(QUERY_PRIORITY))
     sched = QueryScheduler.get(conf)
+    # planning runs INSIDE the admitted window (see _run_admitted) so the
+    # plan.build span lands in the traced bundle and planning wall counts
+    # into the query's latency histogram; the closure carries the one conf
+    # snapshot into the scheduler-owned plan cache
+    holder: Dict[str, Any] = {}
+
+    def plan_fn():
+        from .plan_cache import build_or_fetch
+        final, cache_status, rules = build_or_fetch(session, sched, plan,
+                                                    conf)
+        holder["final"] = final
+        session._last_plan_cache = cache_status
+        session._last_opt_rules = rules
+        return final
+
     try:
         with QueryContext(qname, session_id=session._session_id,
                           deadline_ns=deadline_ns,
@@ -757,7 +786,7 @@ def execute_plan(session, plan, timeout: Optional[float] = None,
                           priority=cls) as qctx:
             try:
                 tables = sched.submit_and_run(
-                    qctx, lambda: _run_admitted(session, final, conf,
+                    qctx, lambda: _run_admitted(session, plan_fn, conf,
                                                 qctx, stem, qname))
             except QueryShedError as e:
                 # typed load-shed RESULT, not an error: the unwind
@@ -777,16 +806,20 @@ def execute_plan(session, plan, timeout: Optional[float] = None,
         # a query that outlived its session's stop() drain releases the
         # shared state the stop could not (no-op unless pending)
         maybe_release_shared()
+    final = holder["final"]
+    schema = pa.schema([(a.name, t2a(a.dtype)) for a in final.output])
     if not tables:
         return schema.empty_table()
     return pa.concat_tables(tables).cast(schema)
 
 
-def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
+def _run_admitted(session, plan_fn, conf, qctx: QueryContext, stem: str,
                   qname: str) -> List:
-    """One admitted query's execution window: partition loop(s), failure
-    handling, and the per-query observability snapshotting. Runs on the
-    submitting thread with the QueryContext bound."""
+    """One admitted query's execution window: planning (via the scheduler-
+    owned plan cache), partition loop(s), failure handling, and the
+    per-query observability snapshotting. Runs on the submitting thread
+    with the QueryContext bound; planning runs AFTER the tracer arms so
+    the plan.build span is part of the query's bundle."""
     from .. import obs
     from ..config import (TRACE_BUFFER_EVENTS, TRACE_CATEGORIES,
                           TRACE_ENABLED)
@@ -795,22 +828,16 @@ def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
                              snapshot_plan_metrics)
     task_metrics_before = TaskMetricsRegistry.get().snapshot()
     syncs_before = SyncLedger.get().snapshot()
-    # mesh session (docs/distributed.md): the root pull drives ALL
-    # partitions through the multi-partition entry point in one group,
-    # so the top whole-stage segment (between the last exchange and the
-    # result) executes every chip's partition in a single grouped
-    # launch — the same batched dispatch the exchange map side uses
-    n_parts = final.num_partitions()
-    names = [a.name for a in final.output]
-    group_pull = n_parts > 1 and mesh_session_active(conf) is not None
     # always-on metrics registry (docs/observability.md): EVERY query
     # (traced or not) registers its lifecycle — the queries.active
     # gauge/list, the latency + rows/s histograms, and the epoch the
-    # tracer's exclusivity check reads
+    # tracer's exclusivity check reads. Registered BEFORE planning so
+    # planning wall counts into the query latency window.
     qtok = obs.metrics.query_begin(qname, session=stem,
                                    cls=qctx.priority)
     qroot = None
     opjit_before = None
+    final = None
     tables: List = []
     # window for this query's collective-exchange profiles (mesh
     # efficiency profiler): profiles are tagged with the traced query
@@ -834,6 +861,22 @@ def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
                 buffer_events=conf.get(TRACE_BUFFER_EVENTS),
                 categories=conf.get(TRACE_CATEGORIES),
                 max_concurrent=conf.get(TRACE_MAX_CONCURRENT))
+        # planning: plan-cache fetch (literal re-bind) or full logical
+        # optimize → physical plan → override pass — one span, one
+        # histogram, so planning share is measurable from the bundle
+        t_plan0 = time.perf_counter_ns()
+        with obs.span("plan.build", cat="plan"):
+            final = plan_fn()
+        obs.metrics.histogram_observe(
+            "plan.build_ms", (time.perf_counter_ns() - t_plan0) / 1e6)
+        # mesh session (docs/distributed.md): the root pull drives ALL
+        # partitions through the multi-partition entry point in one group,
+        # so the top whole-stage segment (between the last exchange and the
+        # result) executes every chip's partition in a single grouped
+        # launch — the same batched dispatch the exchange map side uses
+        n_parts = final.num_partitions()
+        names = [a.name for a in final.output]
+        group_pull = n_parts > 1 and mesh_session_active(conf) is not None
         if group_pull:
             ids = list(range(n_parts))
             ctxs: Dict[int, TaskContext] = {}
@@ -889,9 +932,12 @@ def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
         failed = False  # reached only when every partition completed
     finally:
         # snapshot metrics into plain dicts so the plan (and any device
-        # buffers it references) is not pinned past the query
-        session._last_metrics_snapshot = snapshot_plan_metrics(final)
-        session._last_plan_tree = _plan_tree_snapshot(final)
+        # buffers it references) is not pinned past the query; a planning
+        # failure (final is None) leaves no stale previous-query snapshot
+        session._last_metrics_snapshot = (
+            snapshot_plan_metrics(final) if final is not None else None)
+        session._last_plan_tree = (
+            _plan_tree_snapshot(final) if final is not None else None)
         after = TaskMetricsRegistry.get().snapshot()
         session._last_task_metrics = {
             k: after.get(k, 0) - task_metrics_before.get(k, 0)
@@ -930,9 +976,10 @@ def _run_admitted(session, final, conf, qctx: QueryContext, stem: str,
         # release shuffle blocks/files at query end (reference: Spark's
         # ContextCleaner removing shuffle state); exchanges re-materialize
         # if the same DataFrame is collected again
-        for node in final.collect_nodes():
-            if hasattr(node, "cleanup_shuffle"):
-                node.cleanup_shuffle(conf)
+        if final is not None:
+            for node in final.collect_nodes():
+                if hasattr(node, "cleanup_shuffle"):
+                    node.cleanup_shuffle(conf)
         obs.metrics.query_end(
             qtok, rows=sum(t.num_rows for t in tables),
             failed=failed, session=stem)

@@ -580,7 +580,9 @@ class DataFrame:
             raise RuntimeError(
                 f"TpuSession {self.session._session_id} is stopped")
         conf = self.session._rapids_conf()
-        final = TpuOverrides.apply(plan_physical(self._plan, conf), conf)
+        from .plan.optimizer import optimize_logical
+        optimized, _ = optimize_logical(self._plan, conf)
+        final = TpuOverrides.apply(plan_physical(optimized, conf), conf)
         # strip the final device→host transition: the caller wants device data
         while isinstance(final, DeviceToHostExec):
             final = final.children[0]
@@ -657,16 +659,39 @@ class DataFrame:
             # the LAST collected query's snapshots — run a collect() first)
             return self.session.explain("metrics")
         conf = self.session._rapids_conf()
-        cpu_plan = plan_physical(self._plan, conf)
+        from .config import PLAN_CACHE_ENABLED
+        from .plan.optimizer import explain_logical, optimize_logical
+        from .serving.plan_cache import fingerprint
+        from .serving.scheduler import QueryScheduler
+        status = "off"
+        if conf.get(PLAN_CACHE_ENABLED):
+            fp = fingerprint(self._plan, conf)
+            if fp is None:
+                status = "uncacheable"
+            else:
+                inst = QueryScheduler.peek()
+                status = ("hit" if inst is not None
+                          and inst.plan_cache.peek(fp.key) else "miss")
+        optimized, rules = optimize_logical(self._plan, conf)
+        cpu_plan = plan_physical(optimized, conf)
         final = TpuOverrides.apply(cpu_plan, conf)
-        s = final.tree_string()
+        lines = [f"planCache={status}"]
+        if rules:
+            lines.append(f"appliedRules={', '.join(rules)}")
+            lines.append("== Optimized Logical Plan ==")
+            lines.append(explain_logical(optimized))
+            lines.append("== Physical Plan ==")
+        lines.append(final.tree_string())
+        s = "\n".join(lines)
         print(s)
         return s
 
     def explain_fallback(self) -> str:
         """reference ExplainPlan: report what would not run on TPU."""
+        from .plan.optimizer import optimize_logical
         conf = self.session._rapids_conf()
-        cpu_plan = plan_physical(self._plan, conf)
+        optimized, _ = optimize_logical(self._plan, conf)
+        cpu_plan = plan_physical(optimized, conf)
         return TpuOverrides.explain_plan(cpu_plan, conf)
 
 
@@ -1039,12 +1064,14 @@ class TpuSession:
 
         def set(self, key: str, value: Any) -> None:
             self._s._settings[key] = str(value)
+            _invalidate_cached_plans(key, str(value))
 
         def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
             return self._s._settings.get(key, default)
 
         def unset(self, key: str) -> None:
             self._s._settings.pop(key, None)
+            _invalidate_cached_plans(key, None)
 
     @property
     def conf(self) -> "_Conf":
@@ -1245,7 +1272,8 @@ class TpuSession:
         for attr in ("_last_query_profile", "_last_plan_tree",
                      "_last_metrics_snapshot", "_last_sync_ledger",
                      "_last_task_metrics", "_last_mesh_profiles",
-                     "_last_mesh_fallbacks"):
+                     "_last_mesh_fallbacks", "_last_plan_cache",
+                     "_last_opt_rules"):
             if hasattr(self, attr):
                 setattr(self, attr, None)
         _flight.note("session.stop", session=self._session_id,
@@ -1267,6 +1295,16 @@ class TpuSession:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.stop()
+
+
+def _invalidate_cached_plans(key: str, value: Optional[str]) -> None:
+    """Conf-change invalidation hook for the scheduler-owned plan cache: a
+    plan-relevant key changing drops entries planned under another value
+    (session.conf.set/unset; no-op before the scheduler exists)."""
+    from .serving.scheduler import QueryScheduler
+    inst = QueryScheduler.peek()
+    if inst is not None:
+        inst.plan_cache.invalidate_conf(key, value)
 
 
 def get_session(**conf) -> TpuSession:

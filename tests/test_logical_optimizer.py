@@ -1,0 +1,392 @@
+"""Logical optimizer (ISSUE 20): result oracles — optimized plans must
+produce the same answers as the rules-off pipeline across TPC-H
+q1/q3/q6/q18, a TPC-DS pair, and string/nested schemas — plus plan-shape
+assertions for each rule (FileScan narrowing, pass-through Projects at
+Join/Aggregate inputs, Filter/Project pushdown through Repartition,
+cost-based build-side swap with a restoring Project), per-rule off
+switches, and rules-off parity (disabled pipeline is the identity)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import benchmarks.tpcds as tpcds
+import benchmarks.tpch as tpch
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.optimizer import (RULE_JOIN, RULE_PRUNE,
+                                             RULE_PUSHDOWN, optimize_logical)
+from spark_rapids_tpu.serving.scheduler import QueryScheduler
+from spark_rapids_tpu.session import TpuSession
+
+ROWS = 2_500
+#: every rule toggled off — there is deliberately no master switch; each
+#: pass has its own conf (docs/configs.md)
+OFF = {"spark.rapids.tpu.optimizer.columnPruning.enabled": "false",
+       "spark.rapids.tpu.optimizer.pushdown.enabled": "false",
+       "spark.rapids.tpu.optimizer.joinStrategy.enabled": "false"}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_scheduler():
+    QueryScheduler.reset_for_tests()
+    yield
+    QueryScheduler.reset_for_tests()
+
+
+def _canon(table):
+    """Sort-insensitive canonical form with float rounding (the optimizer
+    may reorder accumulation — swapped build sides, narrowed exchanges)."""
+    cols = sorted(table.column_names)
+    rows = []
+    for i in range(table.num_rows):
+        row = []
+        for c in cols:
+            v = table.column(c)[i].as_py()
+            if isinstance(v, float):
+                v = round(v, 4)
+            row.append(v)
+        rows.append(tuple(row))
+    none_low = [tuple((x is None, x if x is not None else 0) for x in r)
+                for r in rows]
+    return [rows[i] for i in np.argsort(
+        np.array([str(r) for r in none_low]))]
+
+
+def _assert_same(opt, off, tag):
+    assert off.num_rows > 0, f"{tag}: rules-off oracle returned no rows"
+    assert opt.num_rows == off.num_rows, (
+        f"{tag}: {opt.num_rows} vs rules-off {off.num_rows} rows")
+    assert sorted(opt.column_names) == sorted(off.column_names)
+    for g, w in zip(_canon(opt), _canon(off)):
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float) and isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=1e-4, abs=1e-4), (
+                    f"{tag}: {g} != {w}")
+            else:
+                assert gv == wv, f"{tag}: {g} != {w}"
+
+
+def _nodes(plan, cls=None):
+    out = []
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if cls is None or isinstance(n, cls):
+            out.append(n)
+        stack.extend(n.children)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# oracles: optimized == rules-off across representative TPC-H/TPC-DS queries
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_pair():
+    s_opt = tpch.make_session(tpu=True)
+    s_off = tpch.make_session(tpu=True)
+    for k, v in OFF.items():
+        s_off.conf.set(k, v)
+    return (s_opt, tpch.load_tables(s_opt, ROWS, parts=2),
+            s_off, tpch.load_tables(s_off, ROWS, parts=2))
+
+
+@pytest.mark.parametrize("name", ["q1", "q3", "q6", "q18"])
+def test_tpch_oracle_vs_rules_off(name, tpch_pair):
+    s_opt, t_opt, s_off, t_off = tpch_pair
+    fn = tpch.QUERIES[name]
+    _assert_same(fn(s_opt, t_opt).to_arrow(), fn(s_off, t_off).to_arrow(),
+                 name)
+
+
+@pytest.mark.parametrize("name", ["q3", "q19"])
+def test_tpcds_oracle_vs_rules_off(name):
+    s_opt = tpcds.make_session(tpu=True)
+    s_off = tpcds.make_session(tpu=True)
+    for k, v in OFF.items():
+        s_off.conf.set(k, v)
+    fn = tpcds.QUERIES[name]
+    _assert_same(fn(s_opt, tpcds.load_tables(s_opt, ROWS,
+                                             parts=2)).to_arrow(),
+                 fn(s_off, tpcds.load_tables(s_off, ROWS,
+                                             parts=2)).to_arrow(),
+                 f"tpcds_{name}")
+
+
+def test_string_schema_oracle():
+    """Group/filter on string keys: pruning must not disturb dictionary
+    payloads riding the exchanges."""
+    t = pa.table({
+        "tag": pa.array([f"tag_{i % 7}" for i in range(512)]),
+        "city": pa.array(["berlin", "lyon", "osaka", "quito"][i % 4]
+                         for i in range(512)),
+        "v": pa.array([float(i) for i in range(512)]),
+        "unused": pa.array([f"pad{i}" for i in range(512)]),
+    })
+
+    def q(s):
+        df = s.createDataFrame(t, num_partitions=4)
+        return (df.filter(F.col("city") != "quito")
+                .repartition(4, "tag")
+                .groupBy("tag").agg(F.sum(F.col("v")).alias("sv"),
+                                    F.count(F.col("city")).alias("n")))
+
+    opt = q(TpuSession({})).to_arrow()
+    off = q(TpuSession(dict(OFF))).to_arrow()
+    _assert_same(opt, off, "string_schema")
+
+
+def test_nested_schema_oracle():
+    """A struct column the query never references must prune away without
+    touching the rows that survive; a referenced struct passes through."""
+    struct = pa.array([{"a": i % 5, "b": f"s{i}"} for i in range(256)],
+                      pa.struct([("a", pa.int64()), ("b", pa.string())]))
+    t = pa.table({"k": pa.array([i % 8 for i in range(256)]),
+                  "v": pa.array([float(i) for i in range(256)]),
+                  "s": struct})
+
+    def q_drops_struct(s):
+        df = s.createDataFrame(t, num_partitions=2)
+        return df.filter(F.col("v") >= 32.0).groupBy("k").agg(
+            F.sum(F.col("v")).alias("sv"))
+
+    def q_keeps_struct(s):
+        df = s.createDataFrame(t, num_partitions=2)
+        return df.filter(F.col("k") == 3).select("s", "v")
+
+    for tag, q in (("drops_struct", q_drops_struct),
+                   ("keeps_struct", q_keeps_struct)):
+        _assert_same(q(TpuSession({})).to_arrow(),
+                     q(TpuSession(dict(OFF))).to_arrow(), tag)
+
+
+# ---------------------------------------------------------------------------
+# plan-shape: column pruning
+# ---------------------------------------------------------------------------
+
+def test_filescan_output_narrowed(tmp_path):
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0],
+                             "c": ["x", "y", "z"]}), p)
+    s = TpuSession({})
+    df = s.read.parquet(p).filter(F.col("a") > 1).select("b")
+    optimized, rules = optimize_logical(df._plan, s._rapids_conf())
+    assert RULE_PRUNE in rules
+    scans = _nodes(optimized, L.FileScan)
+    assert len(scans) == 1
+    # the scan reads only the referenced columns (filter's a, projected b)
+    assert sorted(a.name for a in scans[0].output) == ["a", "b"]
+    assert RULE_PRUNE in scans[0]._opt_rules
+
+
+def test_aggregate_input_gets_passthrough_project():
+    """In-memory relations always scan full width, so pruning wraps a wide
+    aggregate input in a pass-through Project of exactly the referenced
+    columns — that Project is what narrows the pre-agg exchange."""
+    s = TpuSession({})
+    rows = [{"k": i % 4, "v": float(i), "w": i * 2, "pad": f"p{i}"}
+            for i in range(64)]
+    df = s.createDataFrame(rows, num_partitions=2)
+    q = df.groupBy("k").agg(F.sum(F.col("v")).alias("sv"))
+    optimized, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_PRUNE in rules
+    agg = _nodes(optimized, L.Aggregate)[0]
+    proj = agg.children[0]
+    assert isinstance(proj, L.Project)
+    assert sorted(a.name for a in proj.output) == ["k", "v"]
+    assert RULE_PRUNE in proj._opt_rules
+
+
+def test_join_inputs_projected_down():
+    s = TpuSession({})
+    left = s.createDataFrame(
+        [{"id": i, "lv": float(i), "lpad": "x" * 8} for i in range(32)],
+        num_partitions=2)
+    right = s.createDataFrame(
+        [{"rid": i % 16, "rv": i * 10, "rpad": "y" * 8} for i in range(32)],
+        num_partitions=2)
+    q = left.join(right, on=left["id"] == right["rid"]).select("id", "rv")
+    optimized, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_PRUNE in rules
+    join = _nodes(optimized, L.Join)[0]
+    for side, want in zip(join.children, (["id"], ["rid", "rv"])):
+        assert isinstance(side, L.Project), "join side not projected down"
+        assert sorted(a.name for a in side.output) == want
+
+
+def test_unreferenced_aggregate_column_dropped():
+    s = TpuSession({})
+    df = s.createDataFrame(
+        [{"k": i % 4, "v": float(i), "w": i * 2} for i in range(64)],
+        num_partitions=2)
+    q = (df.groupBy("k").agg(F.sum(F.col("v")).alias("sv"),
+                             F.sum(F.col("w")).alias("sw"))
+         .select("k", "sv"))
+    optimized, _ = optimize_logical(q._plan, s._rapids_conf())
+    agg = _nodes(optimized, L.Aggregate)[0]
+    assert [a.name for a in agg.output] == ["k", "sv"]  # sw pruned away
+    # and the results still match the unoptimized pipeline
+    _assert_same(q.to_arrow(), (lambda s2: (
+        s2.createDataFrame([{"k": i % 4, "v": float(i), "w": i * 2}
+                            for i in range(64)], num_partitions=2)
+        .groupBy("k").agg(F.sum(F.col("v")).alias("sv"),
+                          F.sum(F.col("w")).alias("sw"))
+        .select("k", "sv")))(TpuSession(dict(OFF))).to_arrow(),
+        "agg_prune")
+
+
+def test_column_pruning_disabled_by_rule_toggle():
+    s = TpuSession({"spark.rapids.tpu.optimizer.columnPruning.enabled":
+                    "false"})
+    df = s.createDataFrame(
+        [{"k": i % 4, "v": float(i), "pad": f"p{i}"} for i in range(64)],
+        num_partitions=2)
+    q = df.groupBy("k").agg(F.sum(F.col("v")).alias("sv"))
+    _, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_PRUNE not in rules
+
+
+# ---------------------------------------------------------------------------
+# plan-shape: pushdown through Repartition
+# ---------------------------------------------------------------------------
+
+def test_filter_pushed_below_repartition():
+    s = TpuSession({})
+    df = s.createDataFrame(
+        [{"k": i % 8, "v": float(i)} for i in range(128)], num_partitions=2)
+    q = df.repartition(4, "k").filter(F.col("v") > 10.0)
+    optimized, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_PUSHDOWN in rules
+    # Filter(Repartition(c)) became Repartition(Filter(c))
+    node = optimized
+    while isinstance(node, L.Project):  # pruning may wrap the root
+        node = node.children[0]
+    assert isinstance(node, L.Repartition)
+    assert any(isinstance(n, L.Filter) for n in _nodes(node.children[0]))
+    _assert_same(q.to_arrow(),
+                 (TpuSession(dict(OFF)).createDataFrame(
+                     [{"k": i % 8, "v": float(i)} for i in range(128)],
+                     num_partitions=2)
+                  .repartition(4, "k").filter(F.col("v") > 10.0)).to_arrow(),
+                 "filter_pushdown")
+
+
+def test_pruning_project_pushed_below_repartition_keeps_keys():
+    s = TpuSession({})
+    df = s.createDataFrame(
+        [{"k": i % 8, "v": float(i), "pad": "z" * 4} for i in range(64)],
+        num_partitions=2)
+    conf = s._rapids_conf()
+    # key survives the projection -> push down
+    q = df.repartition(4, "k").select("k", "v")
+    optimized, rules = optimize_logical(q._plan, conf)
+    assert RULE_PUSHDOWN in rules
+    node = optimized
+    while isinstance(node, L.Project):
+        node = node.children[0]
+    assert isinstance(node, L.Repartition)
+    assert sorted(a.name for a in node.children[0].output) == ["k", "v"]
+    # key does NOT survive -> the Project must stay above the exchange
+    q2 = df.repartition(4, "k").select("v")
+    optimized2, _ = optimize_logical(q2._plan, conf)
+    reps = _nodes(optimized2, L.Repartition)
+    assert reps and all(
+        not isinstance(r.children[0], L.Project)
+        or {"k"} <= {a.name for a in r.children[0].output}
+        for r in reps), "hash key pruned out from under the exchange"
+
+
+def test_pushdown_disabled_by_rule_toggle():
+    s = TpuSession({"spark.rapids.tpu.optimizer.pushdown.enabled": "false"})
+    df = s.createDataFrame(
+        [{"k": i % 8, "v": float(i)} for i in range(128)], num_partitions=2)
+    q = df.repartition(4, "k").filter(F.col("v") > 10.0)
+    _, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_PUSHDOWN not in rules
+
+
+# ---------------------------------------------------------------------------
+# plan-shape: cost-based build-side swap
+# ---------------------------------------------------------------------------
+
+def _skew_pair(s):
+    small = s.createDataFrame(
+        [{"id": i, "name": f"n{i}"} for i in range(8)], num_partitions=1)
+    big = s.createDataFrame(
+        [{"fid": i % 8, "v": float(i), "pad": "b" * 16} for i in range(4096)],
+        num_partitions=2)
+    return small, big
+
+
+def test_join_swap_builds_smaller_side():
+    """Inner equi-join whose right (build) side is ~500x the left: the
+    optimizer swaps the sides and restores the original column order with
+    a Project."""
+    s = TpuSession({})
+    small, big = _skew_pair(s)
+    q = small.join(big, on=small["id"] == big["fid"])
+    optimized, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_JOIN in rules
+    assert isinstance(optimized, L.Project)
+    assert RULE_JOIN in optimized._opt_rules
+    join = _nodes(optimized, L.Join)[0]
+    assert getattr(join, "_opt_swapped", False)
+    # sides swapped: the big relation now feeds the LEFT (stream) side
+    left_names = {a.name for a in join.children[0].output}
+    assert "fid" in left_names or "v" in left_names
+    # restoring Project keeps the ORIGINAL parent-visible column order
+    assert [a.name for a in optimized.output] \
+        == [a.name for a in q._plan.output]
+    _assert_same(q.to_arrow(), (lambda s2: (lambda sm, bg: sm.join(
+        bg, on=sm["id"] == bg["fid"]))(*_skew_pair(s2)))(
+        TpuSession(dict(OFF))).to_arrow(), "join_swap")
+
+
+def test_join_swap_respects_ratio_hysteresis():
+    """Near-equal sides stay put: the swap needs swapRatio headroom."""
+    s = TpuSession({})
+    a = s.createDataFrame(
+        [{"id": i, "x": float(i)} for i in range(64)], num_partitions=2)
+    b = s.createDataFrame(
+        [{"bid": i, "y": float(i)} for i in range(64)], num_partitions=2)
+    q = a.join(b, on=a["id"] == b["bid"])
+    optimized, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_JOIN not in rules
+    assert not any(getattr(j, "_opt_swapped", False)
+                   for j in _nodes(optimized, L.Join))
+
+
+def test_join_swap_disabled_by_rule_toggle():
+    s = TpuSession({"spark.rapids.tpu.optimizer.joinStrategy.enabled":
+                    "false"})
+    small, big = _skew_pair(s)
+    q = small.join(big, on=small["id"] == big["fid"])
+    _, rules = optimize_logical(q._plan, s._rapids_conf())
+    assert RULE_JOIN not in rules
+
+
+# ---------------------------------------------------------------------------
+# rules-off parity + explain surface
+# ---------------------------------------------------------------------------
+
+def test_rules_off_is_identity():
+    s = TpuSession(dict(OFF))
+    df = s.createDataFrame(
+        [{"k": i % 4, "v": float(i)} for i in range(32)], num_partitions=2)
+    plan = df.filter(F.col("v") > 3.0).select("k")._plan
+    optimized, rules = optimize_logical(plan, s._rapids_conf())
+    assert optimized is plan  # the disabled pipeline returns the input plan
+    assert rules == []
+
+
+def test_explain_lists_applied_rules(capsys):
+    s = TpuSession({})
+    df = s.createDataFrame(
+        [{"k": i % 4, "v": float(i), "pad": i} for i in range(32)],
+        num_partitions=2)
+    txt = df.groupBy("k").agg(F.sum(F.col("v")).alias("sv")).explain()
+    assert "appliedRules=" in txt
+    assert RULE_PRUNE in txt
+    assert "== Optimized Logical Plan ==" in txt

@@ -571,6 +571,64 @@ def test_bench_diff_serving_keys():
     assert not reg and not imp
 
 
+def test_bench_diff_planning_keys():
+    """ISSUE 20: the hot_repeat planning keys gate lower-is-better in
+    EVERY payload (the planning tax the plan cache exists to eliminate),
+    hit/miss volume counters stay neutral, hit_rate gates higher — and
+    against a real pre-plan-cache round the new keys report only-new,
+    never a spurious regression."""
+    import copy
+    from tools.bench_diff import diff, extract_metrics, load_parsed
+
+    def round_(share, wall, warm, hits, misses, rate):
+        return {"summary": {"hot_repeat_planning_share_pct": share,
+                            "hot_repeat_planning_wall_ms": wall,
+                            "hot_repeat_warm_p50_ms": warm,
+                            "hot_repeat_plan_cache_hits": hits,
+                            "hot_repeat_plan_cache_misses": misses,
+                            "hot_repeat_hit_rate": rate}}
+
+    m = extract_metrics(round_(4.0, 12.0, 25.0, 10, 2, 10 / 12))
+    # lower-is-better planning keys gate WITHOUT --include-overhead and
+    # without a multichip payload marker
+    assert m["summary.hot_repeat_planning_share_pct"] == (4.0, False)
+    assert m["summary.hot_repeat_planning_wall_ms"] == (12.0, False)
+    assert m["summary.hot_repeat_warm_p50_ms"] == (25.0, False)
+    assert m["summary.hot_repeat_hit_rate"][1] is True
+    # volume counters scale with how many submissions a round ran — they
+    # must never be extracted as gated metrics
+    assert not any("plan_cache_hits" in k or "plan_cache_misses" in k
+                   for k in m)
+    # planning share doubling + warm p50 doubling regress; a longer round
+    # (more hits AND more misses) alone cannot fail the diff
+    reg, imp, _u, _, _ = diff(round_(4.0, 12.0, 25.0, 10, 2, 10 / 12),
+                              round_(9.0, 30.0, 60.0, 100, 20, 10 / 12),
+                              0.10)
+    assert {r[0] for r in reg} == {"summary.hot_repeat_planning_share_pct",
+                                   "summary.hot_repeat_planning_wall_ms",
+                                   "summary.hot_repeat_warm_p50_ms"}
+    # hit_rate collapsing regresses too (higher-is-better)
+    reg, _i, _u, _, _ = diff(round_(4.0, 12.0, 25.0, 10, 2, 0.9),
+                             round_(4.0, 12.0, 25.0, 10, 2, 0.4), 0.10)
+    assert [r[0] for r in reg] == ["summary.hot_repeat_hit_rate"]
+    # vs a REAL earlier round: planning keys are new — only-new, no
+    # regression, and the old round's metrics all still extract
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r07 = load_parsed(os.path.join(root, "MULTICHIP_r07.json"))
+    r_new = copy.deepcopy(r07)
+    r_new["hot_repeat_planning_share_pct"] = 3.0
+    r_new["hot_repeat_planning_wall_ms"] = 9.0
+    r_new["hot_repeat_warm_p50_ms"] = 20.0
+    r_new["hot_repeat_plan_cache_hits"] = 22
+    r_new["hot_repeat_hit_rate"] = 22 / 24
+    reg, _i, _u, only_old, only_new = diff(r07, r_new, 0.10)
+    assert not reg and not only_old
+    assert set(only_new) == {"hot_repeat_planning_share_pct",
+                             "hot_repeat_planning_wall_ms",
+                             "hot_repeat_warm_p50_ms",
+                             "hot_repeat_hit_rate"}
+
+
 def test_flight_ring_is_bounded_and_ordered():
     for i in range(2000):
         obs_flight.note("flood", i=i)

@@ -1432,19 +1432,105 @@ def test_tl033_rebind_and_restage_near_misses():
         """) == []
 
 
+# ---------------------------------------------------------------------------
+# TL034: plan-cache key surface (analysis/jitlint.py lint_plan_key_*)
+# ---------------------------------------------------------------------------
+
+
+def _plan_key_findings(src, relpath="serving/fixture.py"):
+    from spark_rapids_tpu.analysis import lint_plan_key_module
+    return lint_plan_key_module(textwrap.dedent(src), relpath)
+
+
+def test_tl034_unpinned_identity_and_per_query_true_positives():
+    """id() of an object the entry does NOT pin, plus a per-query value
+    in key material — both unstable plan-cache key components."""
+    findings = _plan_key_findings("""\
+        def _node_sig(plan, tokens):
+            tokens.append(f"rel:{id(plan)}")
+
+        def fingerprint(plan, conf, query_id):
+            tokens = [f"q:{query_id}", str(hash(plan))]
+            return "|".join(tokens)
+        """)
+    assert [f.rule for f in findings] == ["TL034", "TL034"]
+    assert findings[0].location == "serving/fixture.py::_node_sig"
+    assert "unpinned identity id(plan)" in findings[0].message
+    assert findings[1].location == "serving/fixture.py::fingerprint"
+    assert "unpinned identity hash(plan)" in findings[1].message
+    assert "per-query value 'query_id'" in findings[1].message
+
+
+def test_tl034_live_conf_read_and_bare_schema_true_positives():
+    findings = _plan_key_findings("""\
+        import hashlib
+
+        def _conf_sig(conf):
+            return str(conf.get("spark.sql.ansi.enabled"))
+
+        def _struct_sig(plan, tokens):
+            tokens.append(plan.output)
+            return hashlib.sha256(f"{plan.schema}".encode()).hexdigest()
+        """)
+    assert [f.rule for f in findings] == ["TL034", "TL034"]
+    assert "live conf read conf.get(...)" in findings[0].message
+    msg = findings[1].message
+    assert "un-fingerprinted schema object 'plan.output'" in msg
+    assert "un-fingerprinted schema object 'plan.schema'" in msg
+
+
+def test_tl034_pinned_identity_and_wrapped_schema_near_misses():
+    """The sanctioned shapes from serving/plan_cache.py: identity that
+    rides next to a rel_ids/pins registration (the entry keeps the
+    object alive, so id() is stable), and schema objects wrapped in a
+    ``*_sig`` call before entering key material."""
+    assert _plan_key_findings("""\
+        def _node_sig(plan, rel_ids, tokens, id_map):
+            rel_ids.append(id(plan))
+            tokens.append(f"rel:{id(plan)}:{_attrs_sig(plan.output, id_map)}")
+
+        def fingerprint(plan, conf, mesh):
+            pins = [plan]
+            tokens = []
+            if mesh is not None:
+                pins.append(mesh)
+                tokens.append(f"mesh:{id(mesh)}:{len(mesh.devices)}")
+            items = plan_relevant_conf(conf)
+            tokens.append(",".join(f"{k}={v!r}" for k, v in items.items()))
+            return "|".join(tokens), pins
+        """) == []
+
+
+def test_tl034_only_lints_key_surface_functions():
+    """A serving/ function that is not a fingerprint/sig builder (the
+    cache's knob reads, admission plumbing) is out of scope — the knob
+    read in build_or_fetch is how the cache is switched off, not key
+    material."""
+    assert _plan_key_findings("""\
+        def build_or_fetch(session, sched, plan, conf):
+            if str(conf.get("spark.rapids.tpu.plan.cache.enabled")) == "false":
+                return None, "off"
+            return sched.plan_cache, id(plan)
+        """) == []
+
+
 def test_tl03x_real_tree_is_clean_with_empty_baseline():
     """The acceptance bar: TL030–TL033 over every cached-program surface
-    (execs/, kernels/, parallel/, io/, shuffle/) surface ZERO findings
-    and the committed baseline contains no TL03x entries — the real
-    findings (the compiled agg/join stage builders capturing the live
-    eval_ctx with conf state keyed out of the fingerprint) were fixed,
-    not suppressed."""
-    from spark_rapids_tpu.analysis import lint_jit_tree
+    (execs/, kernels/, parallel/, io/, shuffle/) and TL034 over the
+    serving/ plan-cache key surface produce ZERO findings and the
+    committed baseline contains no TL03x entries — the real findings
+    (the compiled agg/join stage builders capturing the live eval_ctx
+    with conf state keyed out of the fingerprint) were fixed, not
+    suppressed."""
+    from spark_rapids_tpu.analysis import lint_jit_tree, lint_plan_key_tree
     baseline = tracelint.load_baseline()
-    assert not any(k.startswith(("TL030", "TL031", "TL032", "TL033"))
+    assert not any(k.startswith(("TL030", "TL031", "TL032", "TL033",
+                                 "TL034"))
                    for k in baseline)
     fresh = lint_jit_tree()
     assert fresh == [], [f.render() for f in fresh]
+    plan_key = lint_plan_key_tree()
+    assert plan_key == [], [f.render() for f in plan_key]
 
 
 def test_cli_only_filter_and_list_rules(capsys):
@@ -1453,7 +1539,8 @@ def test_cli_only_filter_and_list_rules(capsys):
     assert tracelint.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("TL001", "TL010", "TL011", "TL012", "TL020", "TL021",
-                 "TL022", "TL023", "TL030", "TL031", "TL032", "TL033"):
+                 "TL022", "TL023", "TL030", "TL031", "TL032", "TL033",
+                 "TL034"):
         assert rule in out
     assert tracelint.main(["--only", "TL020,TL021,TL022,TL023"]) == 0
     out = capsys.readouterr().out

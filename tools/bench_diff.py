@@ -35,6 +35,16 @@ regression the profiler exists to catch. The r07 fused-dataplane counters
 one is a reuse-volume counter, the other a config echo; neither gates in
 either direction.
 
+The hot_repeat planning keys (``planning_share_pct``,
+``planning_wall_ms``, ``warm_p50_ms``) gate LOWER-is-better by DEFAULT in
+every payload: they measure the driver-side planning tax on a repeated
+submission, and the plan cache exists precisely to keep them down. The
+raw hit/miss COUNTS (``plan_cache_hits``, ``plan_cache_misses``) are
+NEUTRAL — they scale with how many submissions a round happened to run,
+not with cache quality; the quality signal is ``hit_rate``, which already
+gates higher-is-better. Against a pre-plan-cache round all of these
+report as only-new, never as a regression.
+
 Keys present in only one round (new stages, skipped stages) are reported
 but never fail the diff; a round whose ``parsed`` payload is null or
 missing (the bench crashed before its summary line — e.g. the stub
@@ -76,7 +86,17 @@ _SERVING_LOWER_RE = re.compile(r"serving_.*(p95|p99)_ms$")
 #: overlap_segments echoes the exchange.overlap.* CONFIG — diffing either
 #: across rounds would turn a knob change into a fake regression.
 #: (compact_fused is a bool and bools never walk as metrics.)
-_NEUTRAL_RE = re.compile(r"(staging_reuse_hits|overlap_segments)$")
+#: plan_cache_hits/misses are volume counters (scale with submissions run,
+#: not cache quality — hit_rate is the gated quality signal)
+_NEUTRAL_RE = re.compile(
+    r"(staging_reuse_hits|overlap_segments"
+    r"|plan_cache_hits|plan_cache_misses)$")
+#: hot_repeat planning keys: LOWER is better, gated by default for ALL
+#: payloads — the planning tax on a repeated submission is what the plan
+#: cache exists to eliminate; warm_p50_ms is the steady-state wall the
+#: cache hit path must keep down
+_PLAN_LOWER_RE = re.compile(
+    r"(planning_share_pct|planning_wall_ms|warm_p50_ms)$")
 
 
 def is_multichip(parsed) -> bool:
@@ -103,6 +123,8 @@ def extract_metrics(parsed, include_overhead=False):
             continue
         if _HIGHER_RE.search(path):
             out[path] = (v, True)
+        elif _PLAN_LOWER_RE.search(path):
+            out[path] = (v, False)
         elif _SERVING_LOWER_RE.search(path):
             out[path] = (v, False)
         elif multichip and _MULTICHIP_LOWER_RE.search(path):

@@ -100,6 +100,17 @@ def _render(snap: dict) -> str:
                     f"  {name}{tag}: count={h['count']} sum={h['sum']:.1f} "
                     f"p50={h['p50']:.0f} p95={h['p95']:.0f} "
                     f"p99={h['p99']:.0f}")
+    pc = (snap.get("external", {}).get("scheduler", {}) or {}) \
+        .get("plan_cache")
+    if pc and "error" not in pc:
+        lines += ["", "## plan cache (scheduler-owned, docs/serving.md)"]
+        lines.append(
+            f"  entries={pc.get('entries', 0)}/{pc.get('capacity', 0)} "
+            f"hits={pc.get('hits', 0)} misses={pc.get('misses', 0)} "
+            f"invalidations={pc.get('invalidations', 0)}")
+        per = pc.get("per_entry_hits") or {}
+        for label, h in sorted(per.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  entry {label}: hits={h}")
     ext = snap.get("external", {})
     if ext:
         lines += ["", "## folded process-wide counters"]

@@ -48,6 +48,10 @@ them against the ~20 modules of eval_tpu implementations.  This tool does:
                         read, ref in an outliving container, donating
                         dispatch under with_device_retry without
                         re-staging                                   (error)
+  plan-cache keys       TL034 unstable plan-cache key component in a
+                        serving/ fingerprint builder (unpinned
+                        identity, per-query values, live conf reads,
+                        un-fingerprinted schema objects)             (error)
 
 Findings diff against tools/tracelint_baseline.txt (one key per line, `#`
 comments allowed) so exceptions are explicit.  Exit status is non-zero iff
@@ -133,6 +137,9 @@ RULE_PASSES = (
     (("TL030", "TL031", "TL032", "TL033"),
      "jit discipline: cache-key stability, static-shape bucketing, trace "
      "purity, donated-buffer safety"),
+    (("TL034",),
+     "plan-cache keys: fingerprint builders in serving/ — pinned identity "
+     "only, no per-query values/live conf reads/bare schema objects"),
 )
 
 ALL_RULES = tuple(r for rules, _ in RULE_PASSES for r in rules)
@@ -149,6 +156,7 @@ def collect_findings(corroborate=False, only=None):
     from spark_rapids_tpu.analysis import (analyze_registry, lint_jit_tree,
                                            lint_lifecycle_tree,
                                            lint_locks_tree, lint_obs_tree,
+                                           lint_plan_key_tree,
                                            lint_sync_tree, lint_tree)
     findings = []
     reports = []
@@ -167,6 +175,8 @@ def collect_findings(corroborate=False, only=None):
         findings.extend(lint_locks_tree())
     if _selected(only, ("TL030", "TL031", "TL032", "TL033")):
         findings.extend(lint_jit_tree())
+    if _selected(only, ("TL034",)):
+        findings.extend(lint_plan_key_tree())
     probe_results = None
     if corroborate and _selected(only, ("TL005",)):
         from spark_rapids_tpu.analysis import corroborate as _corr
